@@ -1,0 +1,60 @@
+"""ray_tpu: a TPU-native distributed AI framework.
+
+A ground-up rebuild of the reference distributed runtime's capabilities
+(task/actor runtime, gang scheduling, Train/Tune/Serve/Data/RL libraries)
+designed for the TPU execution model: XLA-compiled SPMD steps over device
+meshes with ICI collectives as the data plane, and a lean host control plane
+over TCP/DCN for everything that is not a jitted step.
+
+Public surface mirrors the reference's `ray` package:
+    ray_tpu.init / remote / get / put / wait / shutdown / kill / cancel
+    ray_tpu.get_actor, ray_tpu.util.placement_group, ...
+"""
+
+from ray_tpu._version import __version__
+from ray_tpu._private.ids import ObjectRef
+from ray_tpu._private.scheduler import (
+    NodeAffinitySchedulingStrategy,
+    PlacementGroupSchedulingStrategy,
+)
+from ray_tpu.api import (
+    available_resources,
+    cancel,
+    cluster_resources,
+    free,
+    get,
+    get_actor,
+    get_runtime_context,
+    init,
+    is_initialized,
+    kill,
+    nodes,
+    put,
+    remote,
+    shutdown,
+    wait,
+)
+from ray_tpu import exceptions
+
+__all__ = [
+    "__version__",
+    "ObjectRef",
+    "available_resources",
+    "cancel",
+    "cluster_resources",
+    "exceptions",
+    "free",
+    "get",
+    "get_actor",
+    "get_runtime_context",
+    "init",
+    "is_initialized",
+    "kill",
+    "nodes",
+    "put",
+    "remote",
+    "shutdown",
+    "wait",
+    "NodeAffinitySchedulingStrategy",
+    "PlacementGroupSchedulingStrategy",
+]

@@ -1,0 +1,68 @@
+"""Runtime config flags, env-overridable.
+
+Counterpart of the reference's RAY_CONFIG table
+(reference: src/ray/common/ray_config_def.h — 224 ``RAY_CONFIG(type, name,
+default)`` entries overridable via ``RAY_{name}`` env vars). Here the table is
+a typed dataclass; every field can be overridden with ``RAY_TPU_<NAME>`` env
+vars or programmatically via ``ray_tpu.init(_system_config={...})``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any
+
+
+def _env(name: str, default: Any, typ: type) -> Any:
+    raw = os.environ.get(f"RAY_TPU_{name.upper()}")
+    if raw is None:
+        return default
+    if typ is bool:
+        return raw.lower() in ("1", "true", "yes")
+    return typ(raw)
+
+
+@dataclasses.dataclass
+class Config:
+    # --- object store ---
+    object_store_memory: int = 512 * 1024 * 1024
+    # Objects <= this many bytes go through the in-process memory store /
+    # control plane inline rather than shm (reference analogue:
+    # max_direct_call_object_size in ray_config_def.h).
+    max_inline_object_size: int = 100 * 1024
+    object_spilling_dir: str = ""
+    # Start spilling when the store passes this fraction of capacity.
+    object_spilling_threshold: float = 0.8
+
+    # --- scheduling ---
+    num_cpus_default: int = 0  # 0 => autodetect
+    worker_pool_prestart: int = 0  # extra idle workers to keep warm
+    scheduler_spread_threshold: float = 0.5  # hybrid policy pack->spread cutoff
+
+    # --- fault tolerance ---
+    task_max_retries_default: int = 3
+    actor_max_restarts_default: int = 0
+    health_check_period_s: float = 1.0
+    health_check_timeout_s: float = 30.0
+
+    # --- timeouts ---
+    worker_register_timeout_s: float = 30.0
+    get_timeout_poll_s: float = 0.01
+
+    # --- task events / observability ---
+    task_events_max_buffer: int = 100000
+    metrics_report_interval_s: float = 5.0
+
+    def apply_overrides(self, overrides: dict | None = None) -> "Config":
+        cfg = dataclasses.replace(self)
+        for f in dataclasses.fields(cfg):
+            setattr(cfg, f.name, _env(f.name, getattr(cfg, f.name), f.type_obj if hasattr(f, "type_obj") else type(getattr(cfg, f.name))))
+        for k, v in (overrides or {}).items():
+            if not hasattr(cfg, k):
+                raise ValueError(f"unknown system config key: {k}")
+            setattr(cfg, k, v)
+        return cfg
+
+
+GLOBAL_CONFIG = Config().apply_overrides()

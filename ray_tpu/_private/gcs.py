@@ -1,0 +1,1308 @@
+"""Head control service: cluster metadata + scheduling + object directory.
+
+This process-resident service plays the roles that the reference splits
+across three C++ daemons:
+  - GCS (reference: src/ray/gcs/gcs_server/gcs_server.h:90 — actor/node/job/PG
+    tables, KV, pubsub, health) → the tables + KV here,
+  - raylet/NodeManager (reference: src/ray/raylet/node_manager.h:123 — worker
+    leases, dispatch, dependency management) → WorkerPool + dispatch loop,
+  - plasma store ownership (reference: src/ray/object_manager/plasma/store.h:55)
+    → ObjectDirectory over the C++ shm arena (src/object_store/arena.cc).
+
+Design departure (SURVEY.md §7): the hot path on TPU is the jitted step, not
+per-task dispatch, so the control plane favors simplicity and correctness —
+one head service, coarse lock, dedicated dispatch thread — while the data
+plane (tensors) bypasses it entirely via ICI collectives.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+import time
+import traceback
+import uuid
+from collections import deque
+from typing import Any
+
+from ray_tpu._private import rpc
+from ray_tpu._private.config import Config
+from ray_tpu._private.scheduler import (
+    ClusterScheduler,
+    NodeEntry,
+    PlacementGroupSchedulingStrategy,
+    ResourceSet,
+)
+from ray_tpu._private.shm_store import ShmArena
+from ray_tpu._private.task_spec import ActorSpec, TaskSpec
+
+# Object directory entry states.
+CREATING, SEALED, SPILLED, LOST = "CREATING", "SEALED", "SPILLED", "LOST"
+# Task states (mirrors the reference's task state machine used by the state
+# API, reference: src/ray/protobuf/gcs.proto TaskStatus).
+PENDING, SCHEDULED, RUNNING, FINISHED, FAILED = (
+    "PENDING_ARGS_AVAIL",
+    "SCHEDULED",
+    "RUNNING",
+    "FINISHED",
+    "FAILED",
+)
+
+
+class ObjectEntry:
+    __slots__ = (
+        "object_id", "state", "offset", "size", "inline", "spill_path",
+        "refcount", "read_pins", "task_pins", "lru", "is_error", "owner_id",
+        "created_at",
+    )
+
+    def __init__(self, object_id: str, owner_id: str):
+        self.object_id = object_id
+        self.state = CREATING
+        self.offset: int | None = None
+        self.size = 0
+        self.inline: bytes | None = None
+        self.spill_path: str | None = None
+        self.refcount = 0
+        self.read_pins = 0
+        self.task_pins = 0
+        self.lru = 0
+        self.is_error = False
+        self.owner_id = owner_id
+        self.created_at = time.time()
+
+
+class WorkerRecord:
+    __slots__ = (
+        "worker_id", "node_id", "conn", "proc", "pid", "busy", "actor_id",
+        "inflight", "started_at", "tpu_chips", "acquired", "ready",
+    )
+
+    def __init__(self, worker_id: str, node_id: str, proc):
+        self.worker_id = worker_id
+        self.node_id = node_id
+        self.conn: rpc.Connection | None = None
+        self.proc = proc
+        self.pid = proc.pid if proc else os.getpid()
+        self.busy = False
+        self.actor_id: str | None = None
+        # In-flight tasks by task_id: actors with max_concurrency > 1 can
+        # have several; completion messages are matched by id (a completion
+        # for call N must not clobber the record of call N+1).
+        self.inflight: dict[str, TaskSpec] = {}
+        self.started_at = time.time()
+        self.tpu_chips: list[int] = []
+        self.acquired: ResourceSet | None = None
+        self.ready = False  # set by worker_ready (two-phase registration)
+
+
+class ActorRecord:
+    __slots__ = (
+        "spec", "state", "worker_id", "node_id", "restarts", "pending",
+        "death_cause", "created_at",
+    )
+
+    def __init__(self, spec: ActorSpec):
+        self.spec = spec
+        self.state = "PENDING_CREATION"
+        self.worker_id: str | None = None
+        self.node_id: str | None = None
+        self.restarts = 0
+        self.pending: deque[TaskSpec] = deque()
+        self.death_cause = ""
+        self.created_at = time.time()
+
+
+class PlacementGroupRecord:
+    __slots__ = ("pg_id", "name", "bundles", "strategy", "state", "node_per_bundle", "waiters")
+
+    def __init__(self, pg_id: str, name: str, bundles, strategy: str):
+        self.pg_id = pg_id
+        self.name = name
+        self.bundles = bundles
+        self.strategy = strategy
+        self.state = "PENDING"
+        self.node_per_bundle: list[str] | None = None
+        self.waiters: list[tuple[rpc.Connection, str]] = []
+
+
+class Head:
+    """The head service. Runs inside the driver process (threads)."""
+
+    def __init__(
+        self,
+        config: Config,
+        num_cpus: float | None = None,
+        num_tpus: float | None = None,
+        resources: dict[str, float] | None = None,
+        session_dir: str | None = None,
+    ):
+        self.config = config
+        self.session_id = uuid.uuid4().hex[:12]
+        self.session_dir = session_dir or f"/tmp/ray_tpu/session_{self.session_id}"
+        os.makedirs(self.session_dir, exist_ok=True)
+        self.spill_dir = config.object_spilling_dir or os.path.join(self.session_dir, "spill")
+        os.makedirs(self.spill_dir, exist_ok=True)
+
+        self.shm_name = f"/ray_tpu_{self.session_id}"
+        self.arena = ShmArena(self.shm_name, config.object_store_memory)
+
+        self.lock = threading.RLock()
+        self.dispatch_event = threading.Event()
+
+        # --- tables ---
+        self.objects: dict[str, ObjectEntry] = {}
+        self.get_waiters: dict[str, tuple[rpc.Connection, set[str]]] = {}
+        self._waiter_ids: dict[str, list[str]] = {}
+        self.wait_waiters: dict[str, tuple[rpc.Connection, list[str], int]] = {}
+        self.kv: dict[tuple[str, str], bytes] = {}
+        self.actors: dict[str, ActorRecord] = {}
+        self.named_actors: dict[tuple[str, str], str] = {}
+        self.pgs: dict[str, PlacementGroupRecord] = {}
+        self.task_queue: deque[TaskSpec] = deque()
+        self.tasks: dict[str, dict] = {}  # task_id -> state record (state API)
+        self.finished_tasks: deque[str] = deque(maxlen=config.task_events_max_buffer)
+        self.workers: dict[str, WorkerRecord] = {}
+        self.clients: dict[str, rpc.Connection] = {}  # client_id -> conn
+        self.task_events: deque[dict] = deque(maxlen=config.task_events_max_buffer)
+        self.metrics: dict[str, Any] = {}
+        self._lru_tick = 0
+        self._shutdown = False
+        self._subscribers: dict[str, list[rpc.Connection]] = {}  # pubsub topic
+
+        # --- local node (head node) ---
+        node_resources = self._detect_resources(num_cpus, num_tpus, resources)
+        self.scheduler = ClusterScheduler(config.scheduler_spread_threshold)
+        self.node_id = "node-" + uuid.uuid4().hex[:8]
+        self.scheduler.add_node(
+            NodeEntry(
+                node_id=self.node_id,
+                address="127.0.0.1",
+                total=ResourceSet(node_resources),
+                available=ResourceSet(node_resources),
+            )
+        )
+        self.node_resources = node_resources
+        # TPU chip pool for visibility pinning (reference:
+        # python/ray/_private/accelerators/tpu.py:193).
+        self.tpu_chip_pool: dict[str, list[int]] = {
+            self.node_id: list(range(int(node_resources.get("TPU", 0))))
+        }
+        self.max_pool_workers = max(2, int(node_resources.get("CPU", 2)))
+
+        self.server = rpc.Server(
+            self._handle, on_close=self._on_conn_close, host="127.0.0.1"
+        )
+        self.address = self.server.address
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, daemon=True, name="head-dispatch"
+        )
+        self._dispatcher.start()
+
+    # ------------------------------------------------------------------
+    # bootstrap helpers
+
+    def _detect_resources(self, num_cpus, num_tpus, custom) -> dict[str, float]:
+        res = dict(custom or {})
+        res["CPU"] = float(num_cpus if num_cpus is not None else os.cpu_count() or 1)
+        if num_tpus is not None:
+            res["TPU"] = float(num_tpus)
+        else:
+            try:
+                from ray_tpu.accelerators.tpu import TPUAcceleratorManager
+
+                n = TPUAcceleratorManager.get_current_node_num_accelerators()
+                if n:
+                    res["TPU"] = float(n)
+                    res.update(TPUAcceleratorManager.get_current_node_additional_resources())
+            except Exception:
+                pass
+        try:
+            import psutil
+
+            res["memory"] = float(psutil.virtual_memory().total)
+        except Exception:
+            res["memory"] = 8e9
+        res[f"node:{self.node_id if hasattr(self, 'node_id') else '127.0.0.1'}"] = 1.0
+        return res
+
+    def spawn_worker(self, node_id: str) -> WorkerRecord:
+        """Fork a pool worker process on `node_id` (local node only for now;
+        remote nodes will route through their supervisor — reference
+        analogue: WorkerPool::StartWorkerProcess, raylet/worker_pool.h:224)."""
+        worker_id = "worker-" + uuid.uuid4().hex[:8]
+        env = dict(os.environ)
+        env["RAY_TPU_WORKER_ID"] = worker_id
+        env["RAY_TPU_HEAD"] = f"{self.address[0]}:{self.address[1]}"
+        env["RAY_TPU_SHM"] = f"{self.shm_name}:{self.config.object_store_memory}"
+        env["RAY_TPU_NODE_ID"] = node_id
+        logs = os.path.join(self.session_dir, "logs")
+        os.makedirs(logs, exist_ok=True)
+        out = open(os.path.join(logs, f"{worker_id}.log"), "ab")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.worker"],
+            env=env,
+            stdout=out,
+            stderr=subprocess.STDOUT,
+            cwd=os.getcwd(),
+        )
+        rec = WorkerRecord(worker_id, node_id, proc)
+        with self.lock:
+            self.workers[worker_id] = rec
+        return rec
+
+    # ------------------------------------------------------------------
+    # RPC handling
+
+    def _handle(self, kind: str, body: dict, conn: rpc.Connection):
+        method = getattr(self, f"_h_{kind}", None)
+        if method is None:
+            raise rpc.RpcError(f"unknown message kind {kind!r}")
+        return method(body, conn)
+
+    def _on_conn_close(self, conn: rpc.Connection) -> None:
+        info = conn.peer_info
+        client_id = info.get("client_id")
+        if client_id is None:
+            return
+        with self.lock:
+            self.clients.pop(client_id, None)
+            rec = self.workers.get(client_id)
+        if rec is not None:
+            self._handle_worker_death(rec)
+
+    # --- registration ---
+
+    def _h_register(self, body: dict, conn: rpc.Connection):
+        ctype = body["client_type"]  # "driver" | "worker"
+        if ctype == "worker":
+            client_id = body["worker_id"]
+            with self.lock:
+                rec = self.workers.get(client_id)
+                if rec is None:
+                    # worker from a previous epoch / unknown: reject
+                    raise rpc.RpcError(f"unknown worker {client_id}")
+                rec.conn = conn
+                self.clients[client_id] = conn
+                conn.peer_info = {"client_id": client_id, "type": "worker"}
+            self.dispatch_event.set()
+        else:
+            client_id = "driver-" + uuid.uuid4().hex[:8]
+            conn.peer_info = {"client_id": client_id, "type": "driver"}
+            with self.lock:
+                self.clients[client_id] = conn
+        return {
+            "client_id": client_id,
+            "shm_name": self.shm_name,
+            "shm_capacity": self.config.object_store_memory,
+            "node_id": self.node_id,
+            "session_dir": self.session_dir,
+        }
+
+    def _h_worker_ready(self, body: dict, conn):
+        with self.lock:
+            rec = self.workers.get(body["worker_id"])
+            if rec is None:
+                return None
+            rec.ready = True
+            if rec.actor_id is not None:
+                self._maybe_push_creation(rec)
+        self.dispatch_event.set()
+        return None
+
+    # --- object store ---
+
+    def _h_create_object(self, body: dict, conn):
+        object_id, size, owner = body["object_id"], body["size"], body["owner_id"]
+        with self.lock:
+            offset = self._alloc_with_spill(size)
+            if offset is None:
+                raise rpc.RpcError(
+                    f"ObjectStoreFullError: cannot allocate {size} bytes "
+                    f"(in use {self.arena.in_use}/{self.arena.capacity})"
+                )
+            entry = self.objects.get(object_id) or ObjectEntry(object_id, owner)
+            if entry.offset is not None:
+                # Re-creation (e.g. task retry rewriting its return id):
+                # release the stale block instead of leaking it.
+                self.arena.free(entry.offset)
+            if entry.spill_path:
+                try:
+                    os.unlink(entry.spill_path)
+                except OSError:
+                    pass
+                entry.spill_path = None
+            entry.inline = None
+            entry.offset, entry.size, entry.owner_id = offset, size, owner
+            entry.state = CREATING
+            if entry.refcount == 0:
+                entry.refcount = 1
+            self.objects[object_id] = entry
+        return {"offset": offset}
+
+    def _alloc_with_spill(self, size: int) -> int | None:
+        offset = self.arena.alloc(size)
+        if offset is not None:
+            return offset
+        # Spill LRU sealed, unpinned objects until the allocation fits
+        # (reference analogue: LocalObjectManager spilling,
+        # raylet/local_object_manager.h:45).
+        candidates = sorted(
+            (e for e in self.objects.values() if e.state == SEALED and e.read_pins == 0 and e.offset is not None),
+            key=lambda e: e.lru,
+        )
+        for e in candidates:
+            self._spill(e)
+            offset = self.arena.alloc(size)
+            if offset is not None:
+                return offset
+        return None
+
+    def _spill(self, entry: ObjectEntry) -> None:
+        path = os.path.join(self.spill_dir, entry.object_id)
+        with open(path, "wb") as f:
+            f.write(self.arena.view(entry.offset, entry.size))
+        self.arena.free(entry.offset)
+        entry.offset = None
+        entry.spill_path = path
+        entry.state = SPILLED
+
+    def _restore(self, entry: ObjectEntry) -> bool:
+        offset = self._alloc_with_spill(entry.size)
+        if offset is None:
+            return False
+        with open(entry.spill_path, "rb") as f:
+            data = f.read()
+        self.arena.view(offset, entry.size)[:] = data
+        os.unlink(entry.spill_path)
+        entry.spill_path = None
+        entry.offset = offset
+        entry.state = SEALED
+        return True
+
+    def _h_seal_object(self, body: dict, conn):
+        with self.lock:
+            entry = self.objects.get(body["object_id"])
+            if entry is None:
+                raise rpc.RpcError(f"seal of unknown object {body['object_id']}")
+            entry.state = SEALED
+            entry.is_error = body.get("is_error", False)
+            self._lru_tick += 1
+            entry.lru = self._lru_tick
+            self._on_sealed(entry.object_id)
+        self.dispatch_event.set()
+        return {}
+
+    def _h_put_inline(self, body: dict, conn):
+        object_id = body["object_id"]
+        with self.lock:
+            entry = self.objects.get(object_id) or ObjectEntry(object_id, body["owner_id"])
+            entry.inline = body["payload"]
+            entry.size = len(entry.inline)
+            entry.state = SEALED
+            entry.is_error = body.get("is_error", False)
+            if entry.refcount == 0:
+                entry.refcount = 1
+            self._lru_tick += 1
+            entry.lru = self._lru_tick
+            self.objects[object_id] = entry
+            self._on_sealed(object_id)
+        self.dispatch_event.set()
+        return {}
+
+    def _on_sealed(self, object_id: str) -> None:
+        """Resolve get/wait waiters; wake dependency-blocked tasks. lock held."""
+        for waiter_id, (conn, ids) in list(self.get_waiters.items()):
+            if object_id in ids:
+                ids.discard(object_id)
+                if not ids:
+                    del self.get_waiters[waiter_id]
+                    self._send_metas(conn, waiter_id)
+        for waiter_id, (conn, ids, num_returns) in list(self.wait_waiters.items()):
+            ready = [i for i in ids if self._is_ready(i)]
+            if len(ready) >= num_returns:
+                del self.wait_waiters[waiter_id]
+                try:
+                    conn.cast("wait_ready", {"waiter_id": waiter_id, "ready": ready})
+                except rpc.ConnectionLost:
+                    pass
+
+    def _is_ready(self, object_id: str) -> bool:
+        e = self.objects.get(object_id)
+        return e is not None and e.state in (SEALED, SPILLED)
+
+    def _meta_for(self, entry: ObjectEntry) -> tuple:
+        if entry.inline is not None:
+            return ("inline", entry.inline, entry.is_error)
+        if entry.state == SPILLED:
+            if not self._restore(entry):
+                # Slow path: serve straight from disk.
+                with open(entry.spill_path, "rb") as f:
+                    return ("inline", f.read(), entry.is_error)
+        if entry.state == SEALED:
+            entry.read_pins += 1
+            return ("shm", entry.offset, entry.size, entry.is_error)
+        return ("lost", f"object {entry.object_id} is {entry.state}", False)
+
+    def _send_metas(self, conn: rpc.Connection, waiter_id: str) -> None:
+        metas = {}
+        ids = self._waiter_ids.pop(waiter_id, [])
+        for oid in ids:
+            entry = self.objects.get(oid)
+            if entry is None:
+                metas[oid] = ("lost", f"object {oid} unknown (freed?)", False)
+            else:
+                metas[oid] = self._meta_for(entry)
+        try:
+            conn.cast("objects_ready", {"waiter_id": waiter_id, "metas": metas})
+        except rpc.ConnectionLost:
+            pass
+
+    def _h_get_meta(self, body: dict, conn):
+        waiter_id, ids = body["waiter_id"], body["ids"]
+        with self.lock:
+            self._waiter_ids[waiter_id] = list(ids)
+            missing = {i for i in ids if not self._is_ready(i)}
+            # Missing ids may be return values of tasks still in flight —
+            # wait for their seal. The client applies its own timeout.
+            if missing:
+                self.get_waiters[waiter_id] = (conn, missing)
+            else:
+                self._send_metas(conn, waiter_id)
+        return None
+
+    def _h_read_done(self, body: dict, conn):
+        with self.lock:
+            for oid in body["ids"]:
+                e = self.objects.get(oid)
+                if e is not None and e.read_pins > 0:
+                    e.read_pins -= 1
+                    if e.refcount <= 0:
+                        self._maybe_free(e)
+        return None
+
+    def _h_wait(self, body: dict, conn):
+        waiter_id, ids, num_returns = body["waiter_id"], body["ids"], body["num_returns"]
+        with self.lock:
+            ready = [i for i in ids if self._is_ready(i)]
+            if len(ready) >= num_returns:
+                conn.cast("wait_ready", {"waiter_id": waiter_id, "ready": ready})
+            else:
+                self.wait_waiters[waiter_id] = (conn, list(ids), num_returns)
+        return None
+
+    def _h_wait_check(self, body: dict, conn):
+        with self.lock:
+            return {"ready": [i for i in body["ids"] if self._is_ready(i)]}
+
+    def _h_cancel_wait(self, body: dict, conn):
+        with self.lock:
+            self.wait_waiters.pop(body["waiter_id"], None)
+            self.get_waiters.pop(body["waiter_id"], None)
+            if hasattr(self, "_waiter_ids"):
+                self._waiter_ids.pop(body["waiter_id"], None)
+        return None
+
+    def _h_del_ref(self, body: dict, conn):
+        with self.lock:
+            for oid in body["ids"]:
+                e = self.objects.get(oid)
+                if e is not None:
+                    e.refcount -= 1
+                    self._maybe_free(e)
+        return None
+
+    def _h_add_ref(self, body: dict, conn):
+        with self.lock:
+            for oid in body["ids"]:
+                e = self.objects.get(oid)
+                if e is not None:
+                    e.refcount += 1
+        return None
+
+    def _h_free_objects(self, body: dict, conn):
+        with self.lock:
+            for oid in body["ids"]:
+                e = self.objects.get(oid)
+                if e is not None:
+                    e.refcount = 0
+                    self._maybe_free(e, force=body.get("force", False))
+        return {}
+
+    def _maybe_free(self, entry: ObjectEntry, force: bool = False) -> None:
+        if entry.refcount > 0 and not force:
+            return
+        if entry.task_pins > 0 and not force:
+            return
+        if entry.read_pins > 0:
+            # A client still holds a shm meta for this object; freeing now
+            # would let the arena reuse the region under the reader. The
+            # read_done handler re-invokes _maybe_free.
+            return
+        if entry.offset is not None:
+            self.arena.free(entry.offset)
+        if entry.spill_path:
+            try:
+                os.unlink(entry.spill_path)
+            except OSError:
+                pass
+        self.objects.pop(entry.object_id, None)
+
+    # --- KV store (reference: GCS InternalKV, gcs_service.proto) ---
+
+    def _h_kv_put(self, body, conn):
+        key = (body.get("ns", ""), body["key"])
+        with self.lock:
+            if not body.get("overwrite", True) and key in self.kv:
+                return {"added": False}
+            self.kv[key] = body["value"]
+        return {"added": True}
+
+    def _h_kv_get(self, body, conn):
+        with self.lock:
+            return {"value": self.kv.get((body.get("ns", ""), body["key"]))}
+
+    def _h_kv_del(self, body, conn):
+        with self.lock:
+            existed = self.kv.pop((body.get("ns", ""), body["key"]), None) is not None
+        return {"deleted": existed}
+
+    def _h_kv_keys(self, body, conn):
+        ns, prefix = body.get("ns", ""), body.get("prefix", "")
+        with self.lock:
+            return {"keys": [k for (n, k) in self.kv if n == ns and k.startswith(prefix)]}
+
+    def _h_kv_exists(self, body, conn):
+        with self.lock:
+            return {"exists": (body.get("ns", ""), body["key"]) in self.kv}
+
+    # --- pubsub (reference: src/ray/pubsub/publisher.h:300) ---
+
+    def _h_subscribe(self, body, conn):
+        with self.lock:
+            self._subscribers.setdefault(body["topic"], []).append(conn)
+        return {}
+
+    def _h_publish(self, body, conn):
+        with self.lock:
+            subs = list(self._subscribers.get(body["topic"], []))
+        for s in subs:
+            try:
+                s.cast("pubsub_message", {"topic": body["topic"], "data": body["data"]})
+            except rpc.ConnectionLost:
+                pass
+        return {}
+
+    # --- task submission ---
+
+    def _h_submit_task(self, body, conn):
+        spec: TaskSpec = body["spec"]
+        with self.lock:
+            for oid in spec.return_ids:
+                entry = self.objects.get(oid) or ObjectEntry(oid, spec.owner_id)
+                entry.refcount = max(entry.refcount, 1)
+                self.objects[oid] = entry
+            for dep in spec.deps:
+                e = self.objects.get(dep)
+                if e is not None:
+                    e.task_pins += 1
+            self.tasks[spec.task_id] = {
+                "task_id": spec.task_id,
+                "name": spec.name,
+                "state": PENDING,
+                "type": "ACTOR_TASK" if spec.actor_id else ("ACTOR_CREATION_TASK" if spec.actor_creation else "NORMAL_TASK"),
+                "submitted_at": time.time(),
+                "node_id": None,
+                "worker_id": None,
+            }
+            if spec.actor_id is not None:
+                self._enqueue_actor_task(spec)
+            else:
+                self.task_queue.append(spec)
+        self.dispatch_event.set()
+        return None
+
+    def _h_cancel_task(self, body, conn):
+        # Accepts a task id or one of the task's return object ids (the
+        # public `cancel(ref)` passes the ref).
+        task_id = body["task_id"]
+        with self.lock:
+            for spec in list(self.task_queue):
+                if spec.task_id == task_id or task_id in spec.return_ids:
+                    self.task_queue.remove(spec)
+                    self._fail_task(spec, "TaskCancelledError: cancelled before execution")
+                    return {"cancelled": True}
+            # Running: signal the worker.
+            for rec in self.workers.values():
+                if task_id in rec.inflight and rec.conn:
+                    try:
+                        rec.conn.cast("cancel", {"task_id": task_id})
+                    except rpc.ConnectionLost:
+                        pass
+                    return {"cancelled": False, "signalled": True}
+        return {"cancelled": False}
+
+    def _h_task_finished(self, body, conn):
+        worker_id = body["worker_id"]
+        with self.lock:
+            rec = self.workers.get(worker_id)
+            if rec is None:
+                return None
+            spec = rec.inflight.pop(body.get("task_id", ""), None)
+            if spec is not None:
+                t = self.tasks.get(spec.task_id)
+                if t:
+                    t["state"] = FAILED if body.get("failed") else FINISHED
+                    t["finished_at"] = time.time()
+                    self.finished_tasks.append(spec.task_id)
+                for dep in spec.deps:
+                    e = self.objects.get(dep)
+                    if e is not None and e.task_pins > 0:
+                        e.task_pins -= 1
+                        self._maybe_free(e)
+            if rec.actor_id is None:
+                if not rec.inflight:
+                    rec.busy = False
+                if rec.acquired is not None:
+                    self.scheduler.release(rec.node_id, rec.acquired)
+                    self._return_tpu_chips(rec)
+                    rec.acquired = None
+            else:
+                actor = self.actors.get(rec.actor_id)
+                if actor is not None and spec is not None and spec.actor_creation:
+                    actor.state = "ALIVE" if not body.get("failed") else "DEAD"
+                    if actor.state == "DEAD":
+                        actor.death_cause = "creation task failed"
+                        self._drain_actor_queue(actor)
+                        if actor.spec.name:
+                            self.named_actors.pop(
+                                (actor.spec.namespace, actor.spec.name), None
+                            )
+                        # Retire the dedicated worker and return its
+                        # reservation — otherwise failed creations leak
+                        # CPUs/chips and a zombie process each.
+                        if rec.acquired is not None:
+                            self.scheduler.release(rec.node_id, rec.acquired)
+                            self._return_tpu_chips(rec)
+                            rec.acquired = None
+                        if rec.conn is not None:
+                            try:
+                                rec.conn.cast("kill", {})
+                            except rpc.ConnectionLost:
+                                pass
+                # flush queued calls for this actor
+                if actor is not None:
+                    self._flush_actor(actor)
+                rec.busy = bool(rec.inflight)
+        self.dispatch_event.set()
+        return None
+
+    # --- actors ---
+
+    def _h_create_actor(self, body, conn):
+        spec: ActorSpec = body["spec"]
+        with self.lock:
+            if spec.name:
+                key = (spec.namespace, spec.name)
+                if key in self.named_actors:
+                    raise rpc.RpcError(f"actor name {spec.name!r} already taken")
+                self.named_actors[key] = spec.actor_id
+            self.actors[spec.actor_id] = ActorRecord(spec)
+        self.dispatch_event.set()
+        return {"actor_id": spec.actor_id}
+
+    def _h_submit_actor_task(self, body, conn):
+        spec: TaskSpec = body["spec"]
+        with self.lock:
+            for oid in spec.return_ids:
+                entry = self.objects.get(oid) or ObjectEntry(oid, spec.owner_id)
+                entry.refcount = max(entry.refcount, 1)
+                self.objects[oid] = entry
+            for dep in spec.deps:
+                e = self.objects.get(dep)
+                if e is not None:
+                    e.task_pins += 1
+            self.tasks[spec.task_id] = {
+                "task_id": spec.task_id,
+                "name": spec.name,
+                "state": PENDING,
+                "type": "ACTOR_TASK",
+                "submitted_at": time.time(),
+                "node_id": None,
+                "worker_id": None,
+            }
+            self._enqueue_actor_task(spec)
+        self.dispatch_event.set()
+        return None
+
+    def _enqueue_actor_task(self, spec: TaskSpec) -> None:
+        actor = self.actors.get(spec.actor_id)
+        if actor is None or actor.state == "DEAD":
+            self._fail_task(
+                spec,
+                f"ActorDiedError: actor {spec.actor_id} is dead"
+                + (f" ({actor.death_cause})" if actor else ""),
+                kind="actor_died",
+            )
+            return
+        actor.pending.append(spec)
+        if actor.state == "ALIVE":
+            self._flush_actor(actor)
+
+    def _flush_actor(self, actor: ActorRecord) -> None:
+        """Push queued calls to the actor's worker respecting dependencies.
+        lock held."""
+        if actor.state != "ALIVE" or actor.worker_id is None:
+            return
+        rec = self.workers.get(actor.worker_id)
+        if rec is None or rec.conn is None:
+            return
+        # Strict submission-order dispatch: stop at the first call whose
+        # args are not yet available (later calls must not overtake it —
+        # per-handle ordering, reference: sequential_actor_submit_queue.h).
+        while actor.pending:
+            spec = actor.pending[0]
+            if not all(self._is_ready(d) for d in spec.deps):
+                break
+            actor.pending.popleft()
+            self._push_to_worker(rec, spec)
+
+    def _h_kill_actor(self, body, conn):
+        with self.lock:
+            actor = self.actors.get(body["actor_id"])
+            if actor is None:
+                return {}
+            if body.get("no_restart", True):
+                actor.spec.max_restarts = 0
+            rec = self.workers.get(actor.worker_id) if actor.worker_id else None
+        if rec is not None and rec.proc is not None:
+            rec.proc.kill()
+        else:
+            with self.lock:
+                actor.state = "DEAD"
+                actor.death_cause = "killed before start"
+                self._drain_actor_queue(actor)
+        return {}
+
+    def _h_get_named_actor(self, body, conn):
+        with self.lock:
+            actor_id = self.named_actors.get((body.get("namespace", ""), body["name"]))
+            if actor_id is None:
+                raise rpc.RpcError(f"no actor named {body['name']!r}")
+            actor = self.actors[actor_id]
+            return {
+                "actor_id": actor_id,
+                "cls_func_id": actor.spec.cls_func_id,
+                "max_concurrency": actor.spec.max_concurrency,
+            }
+
+    def _drain_actor_queue(self, actor: ActorRecord) -> None:
+        while actor.pending:
+            spec = actor.pending.popleft()
+            self._fail_task(
+                spec,
+                f"ActorDiedError: actor died ({actor.death_cause})",
+                kind="actor_died",
+            )
+
+    # --- placement groups ---
+
+    def _h_create_pg(self, body, conn):
+        pg_id = "pg-" + uuid.uuid4().hex[:8]
+        rec = PlacementGroupRecord(pg_id, body.get("name", ""), body["bundles"], body["strategy"])
+        with self.lock:
+            self.pgs[pg_id] = rec
+            # `ready()` object: sealed once the gang reservation commits.
+            entry = ObjectEntry(pg_id + ":ready", "head")
+            entry.refcount = 1
+            self.objects[pg_id + ":ready"] = entry
+            self._try_place_pg(rec)
+        return {"pg_id": pg_id}
+
+    def _try_place_pg(self, rec: PlacementGroupRecord) -> None:
+        """lock held. Gang-reserve bundle resources (2PC analogue:
+        gcs_placement_group_scheduler.h prepare/commit collapsed to one step
+        since the head owns all node availability)."""
+        if rec.state == "CREATED":
+            return
+        placement = self.scheduler.place_bundles(rec.bundles, rec.strategy)
+        if placement is None:
+            return
+        for node_id, bundle in zip(placement, rec.bundles):
+            self.scheduler.acquire(node_id, ResourceSet(bundle))
+        rec.node_per_bundle = placement
+        rec.state = "CREATED"
+        self._seal_inline(rec.pg_id + ":ready", True)
+        for conn, waiter_id in rec.waiters:
+            try:
+                conn.cast("pg_ready", {"waiter_id": waiter_id, "pg_id": rec.pg_id})
+            except rpc.ConnectionLost:
+                pass
+        rec.waiters.clear()
+
+    def _h_pg_wait(self, body, conn):
+        with self.lock:
+            rec = self.pgs.get(body["pg_id"])
+            if rec is None:
+                raise rpc.RpcError(f"unknown placement group {body['pg_id']}")
+            if rec.state == "CREATED":
+                conn.cast("pg_ready", {"waiter_id": body["waiter_id"], "pg_id": rec.pg_id})
+            else:
+                rec.waiters.append((conn, body["waiter_id"]))
+        return None
+
+    def _h_remove_pg(self, body, conn):
+        with self.lock:
+            rec = self.pgs.pop(body["pg_id"], None)
+            if rec is not None and rec.state == "CREATED":
+                for node_id, bundle in zip(rec.node_per_bundle, rec.bundles):
+                    self.scheduler.release(node_id, ResourceSet(bundle))
+            # Retry other pending PGs with the freed resources.
+            for other in self.pgs.values():
+                self._try_place_pg(other)
+        self.dispatch_event.set()
+        return {}
+
+    # --- cluster info / state API ---
+
+    def _h_cluster_resources(self, body, conn):
+        with self.lock:
+            total: dict[str, float] = {}
+            avail: dict[str, float] = {}
+            for n in self.scheduler.alive_nodes():
+                for k, v in n.total.to_dict().items():
+                    total[k] = total.get(k, 0) + v
+                for k, v in n.available.to_dict().items():
+                    avail[k] = avail.get(k, 0) + v
+            return {"total": total, "available": avail}
+
+    def _h_get_nodes(self, body, conn):
+        with self.lock:
+            return {
+                "nodes": [
+                    {
+                        "node_id": n.node_id,
+                        "address": n.address,
+                        "alive": n.alive,
+                        "resources": n.total.to_dict(),
+                        "available": n.available.to_dict(),
+                        "labels": n.labels,
+                    }
+                    for n in self.scheduler.nodes.values()
+                ]
+            }
+
+    def _h_list_tasks(self, body, conn):
+        with self.lock:
+            recs = list(self.tasks.values())
+        limit = body.get("limit", 1000)
+        return {"tasks": recs[-limit:]}
+
+    def _h_list_actors(self, body, conn):
+        with self.lock:
+            return {
+                "actors": [
+                    {
+                        "actor_id": a.spec.actor_id,
+                        "name": a.spec.name,
+                        "state": a.state,
+                        "node_id": a.node_id,
+                        "pid": self.workers[a.worker_id].pid if a.worker_id in self.workers else None,
+                        "restarts": a.restarts,
+                        "class_name": a.spec.name or a.spec.cls_func_id,
+                    }
+                    for a in self.actors.values()
+                ]
+            }
+
+    def _h_list_objects(self, body, conn):
+        with self.lock:
+            return {
+                "objects": [
+                    {
+                        "object_id": e.object_id,
+                        "state": e.state,
+                        "size": e.size,
+                        "refcount": e.refcount,
+                        "owner": e.owner_id,
+                    }
+                    for e in self.objects.values()
+                ]
+            }
+
+    def _h_list_workers(self, body, conn):
+        with self.lock:
+            return {
+                "workers": [
+                    {
+                        "worker_id": w.worker_id,
+                        "node_id": w.node_id,
+                        "pid": w.pid,
+                        "busy": w.busy,
+                        "actor_id": w.actor_id,
+                    }
+                    for w in self.workers.values()
+                ]
+            }
+
+    def _h_store_stats(self, body, conn):
+        with self.lock:
+            return {
+                "capacity": self.arena.capacity,
+                "in_use": self.arena.in_use,
+                "num_objects": self.arena.num_objects,
+                "largest_free": self.arena.largest_free,
+                "num_entries": len(self.objects),
+                "num_spilled": sum(1 for e in self.objects.values() if e.state == SPILLED),
+            }
+
+    def _h_task_events(self, body, conn):
+        with self.lock:
+            self.task_events.extend(body["events"])
+        return None
+
+    def _h_report_metrics(self, body, conn):
+        with self.lock:
+            self.metrics.update(body["metrics"])
+        return None
+
+    def _h_get_metrics(self, body, conn):
+        with self.lock:
+            return {"metrics": dict(self.metrics)}
+
+    def _h_get_task_events(self, body, conn):
+        with self.lock:
+            return {"events": list(self.task_events)[-body.get("limit", 10000):]}
+
+    # ------------------------------------------------------------------
+    # dispatch loop (the raylet role)
+
+    def _dispatch_loop(self) -> None:
+        while not self._shutdown:
+            self.dispatch_event.wait(timeout=0.2)
+            self.dispatch_event.clear()
+            try:
+                self._dispatch_once()
+            except Exception:
+                traceback.print_exc()
+
+    def _dispatch_once(self) -> None:
+        with self.lock:
+            # 1. actor creations first (they unblock queued calls)
+            for actor in list(self.actors.values()):
+                if actor.state == "PENDING_CREATION":
+                    self._try_start_actor(actor)
+                elif actor.state == "ALIVE" and actor.pending:
+                    # Calls parked behind unresolved args: deps may have
+                    # sealed since (the seal sets dispatch_event).
+                    self._flush_actor(actor)
+            # 2. normal tasks FIFO with skip-over for blocked ones
+            requeue: deque[TaskSpec] = deque()
+            spawned = False
+            while self.task_queue:
+                spec = self.task_queue.popleft()
+                if not all(self._is_ready(d) for d in spec.deps):
+                    requeue.append(spec)
+                    continue
+                node = self.scheduler.pick_node(
+                    ResourceSet(spec.resources), self._resolve_strategy(spec)
+                )
+                if node is None:
+                    requeue.append(spec)
+                    continue
+                rec = self._idle_worker(node.node_id)
+                if rec is None:
+                    if not spawned and self._can_spawn(node.node_id):
+                        self.spawn_worker(node.node_id)
+                        spawned = True
+                    requeue.append(spec)
+                    continue
+                demand = ResourceSet(spec.resources)
+                self.scheduler.acquire(node.node_id, demand)
+                rec.acquired = demand
+                self._assign_tpu_chips(rec, spec.resources)
+                self._push_to_worker(rec, spec)
+            self.task_queue = requeue
+
+    def _resolve_strategy(self, spec: TaskSpec):
+        s = spec.scheduling_strategy
+        if isinstance(s, PlacementGroupSchedulingStrategy):
+            pg = self.pgs.get(getattr(s.placement_group, "id", None) or s.placement_group)
+            if pg is None or pg.state != "CREATED":
+                return "___unplaceable___"  # no node matches until PG ready
+            idx = s.placement_group_bundle_index
+            node_id = pg.node_per_bundle[idx if idx >= 0 else 0]
+            from ray_tpu._private.scheduler import NodeAffinitySchedulingStrategy
+
+            return NodeAffinitySchedulingStrategy(node_id=node_id, soft=False)
+        return s
+
+    def _idle_worker(self, node_id: str) -> WorkerRecord | None:
+        for rec in self.workers.values():
+            if (
+                rec.node_id == node_id
+                and rec.conn is not None
+                and rec.ready
+                and not rec.busy
+                and rec.actor_id is None
+            ):
+                return rec
+        return None
+
+    def _can_spawn(self, node_id: str) -> bool:
+        count = sum(1 for r in self.workers.values() if r.node_id == node_id and r.actor_id is None)
+        return count < self.max_pool_workers
+
+    def _push_to_worker(self, rec: WorkerRecord, spec: TaskSpec) -> None:
+        rec.busy = True
+        rec.inflight[spec.task_id] = spec
+        t = self.tasks.get(spec.task_id)
+        if t:
+            t["state"] = RUNNING
+            t["node_id"] = rec.node_id
+            t["worker_id"] = rec.worker_id
+            t["started_at"] = time.time()
+        try:
+            rec.conn.cast(
+                "push_task",
+                {"spec": spec, "tpu_chips": rec.tpu_chips},
+            )
+        except rpc.ConnectionLost:
+            pass  # worker death handler requeues
+
+    def _try_start_actor(self, actor: ActorRecord) -> None:
+        """lock held. Reserve resources, spawn a dedicated worker, send the
+        creation task once it registers."""
+        spec = actor.spec
+        demand = ResourceSet(spec.resources)
+        node = self.scheduler.pick_node(demand, self._resolve_actor_strategy(spec))
+        if node is None:
+            return
+        if not self.scheduler.acquire(node.node_id, demand):
+            return
+        rec = self.spawn_worker(node.node_id)
+        rec.actor_id = spec.actor_id
+        rec.acquired = demand
+        self._assign_tpu_chips(rec, spec.resources)
+        actor.state = "STARTING"
+        actor.worker_id = rec.worker_id
+        actor.node_id = node.node_id
+        # Defer the creation push until the worker registers (it has no conn
+        # yet). A creation TaskSpec is queued on the record.
+        creation = TaskSpec(
+            task_id="task-" + uuid.uuid4().hex[:12],
+            name=f"{spec.name or 'Actor'}.__init__",
+            func_id=spec.cls_func_id,
+            args=spec.init_args,
+            deps=spec.deps,
+            return_ids=[spec.actor_id + ":creation"],
+            resources=spec.resources,
+            owner_id=spec.owner_id,
+            actor_creation=True,
+            max_retries=0,
+        )
+        ce = self.objects.get(creation.return_ids[0]) or ObjectEntry(creation.return_ids[0], spec.owner_id)
+        ce.refcount = max(ce.refcount, 1)
+        self.objects[creation.return_ids[0]] = ce
+        rec.inflight[creation.task_id] = creation
+        rec.busy = True
+        self.tasks[creation.task_id] = {
+            "task_id": creation.task_id,
+            "name": creation.name,
+            "state": SCHEDULED,
+            "type": "ACTOR_CREATION_TASK",
+            "submitted_at": time.time(),
+            "node_id": node.node_id,
+            "worker_id": rec.worker_id,
+        }
+        self._pending_creation_push = getattr(self, "_pending_creation_push", {})
+        self._pending_creation_push[rec.worker_id] = creation
+        # If already registered (restart case), push now.
+        if rec.conn is not None:
+            self._maybe_push_creation(rec)
+
+    def _resolve_actor_strategy(self, spec: ActorSpec):
+        class _Shim:
+            pass
+
+        shim = _Shim()
+        shim.scheduling_strategy = spec.scheduling_strategy
+        return self._resolve_strategy(shim)  # type: ignore[arg-type]
+
+    def _maybe_push_creation(self, rec: WorkerRecord) -> None:
+        pending = getattr(self, "_pending_creation_push", {})
+        if not rec.ready:
+            return
+        creation = pending.pop(rec.worker_id, None)
+        if creation is not None and rec.conn is not None:
+            actor = self.actors.get(rec.actor_id)
+            try:
+                rec.conn.cast(
+                    "become_actor",
+                    {
+                        "spec": creation,
+                        "actor_id": rec.actor_id,
+                        "max_concurrency": actor.spec.max_concurrency if actor else 1,
+                        "tpu_chips": rec.tpu_chips,
+                    },
+                )
+                self.tasks[creation.task_id]["state"] = RUNNING
+            except rpc.ConnectionLost:
+                pass
+
+    # TPU chip visibility assignment (reference semantics:
+    # _private/accelerators/tpu.py set_current_process_visible_accelerator_ids
+    # :193 — TPU_VISIBLE_CHIPS) handled at dispatch.
+    def _assign_tpu_chips(self, rec: WorkerRecord, resources: dict[str, float]) -> None:
+        n = int(resources.get("TPU", 0))
+        if n <= 0:
+            return
+        pool = self.tpu_chip_pool.get(rec.node_id, [])
+        rec.tpu_chips = pool[:n]
+        self.tpu_chip_pool[rec.node_id] = pool[n:]
+
+    def _return_tpu_chips(self, rec: WorkerRecord) -> None:
+        if rec.tpu_chips:
+            self.tpu_chip_pool.setdefault(rec.node_id, []).extend(rec.tpu_chips)
+            rec.tpu_chips = []
+
+    # ------------------------------------------------------------------
+    # failure handling
+
+    def _handle_worker_death(self, rec: WorkerRecord) -> None:
+        """Worker connection dropped or process died.
+
+        Reference analogues: task retry on worker crash
+        (core_worker/task_manager.h:216 max_retries), actor restart
+        (gcs/gcs_server/gcs_actor_manager.h:96 max_restarts)."""
+        with self.lock:
+            self.workers.pop(rec.worker_id, None)
+            if rec.acquired is not None:
+                self.scheduler.release(rec.node_id, rec.acquired)
+                self._return_tpu_chips(rec)
+                rec.acquired = None
+            inflight = list(rec.inflight.values())
+            rec.inflight = {}
+            if rec.actor_id is not None:
+                self._handle_actor_worker_death(rec, inflight)
+            else:
+                for spec in inflight:
+                    if spec.retries_used < spec.max_retries:
+                        spec.retries_used += 1
+                        t = self.tasks.get(spec.task_id)
+                        if t:
+                            t["state"] = PENDING
+                            t["retries"] = spec.retries_used
+                        self.task_queue.appendleft(spec)
+                    else:
+                        self._fail_task(
+                            spec,
+                            f"WorkerCrashedError: worker {rec.worker_id} died while "
+                            f"running {spec.name} (after {spec.retries_used} retries)",
+                            kind="worker_crashed",
+                        )
+        self.dispatch_event.set()
+
+    def _handle_actor_worker_death(self, rec: WorkerRecord, inflight: list[TaskSpec]) -> None:
+        """lock held."""
+        actor = self.actors.get(rec.actor_id)
+        if actor is None or actor.state == "DEAD":
+            return
+        creation_spec = None
+        for spec in inflight:
+            if spec.actor_creation:
+                creation_spec = spec
+                continue
+            # In-flight calls die with the actor.
+            self._fail_task(
+                spec,
+                f"ActorDiedError: actor {rec.actor_id} died while running {spec.name}",
+                kind="actor_died",
+            )
+        if actor.spec.max_restarts != 0 and (
+            actor.spec.max_restarts < 0 or actor.restarts < actor.spec.max_restarts
+        ):
+            actor.restarts += 1
+            actor.state = "PENDING_CREATION"
+            actor.worker_id = None
+            # queued (not yet pushed) calls survive the restart
+        else:
+            actor.state = "DEAD"
+            actor.death_cause = "worker process died"
+            if creation_spec is not None:
+                self._seal_error(
+                    rec.actor_id + ":creation",
+                    "ActorDiedError: actor creation worker died",
+                    kind="actor_died",
+                )
+            self._drain_actor_queue(actor)
+            if actor.spec.name:
+                self.named_actors.pop((actor.spec.namespace, actor.spec.name), None)
+
+    def _fail_task(self, spec: TaskSpec, message: str, kind: str = "task_error") -> None:
+        """lock held. Seal each return id with an error payload."""
+        t = self.tasks.get(spec.task_id)
+        if t:
+            t["state"] = FAILED
+            t["error"] = message
+            t["finished_at"] = time.time()
+        for oid in spec.return_ids:
+            self._seal_error(oid, message, kind)
+        for dep in spec.deps:
+            e = self.objects.get(dep)
+            if e is not None and e.task_pins > 0:
+                e.task_pins -= 1
+                self._maybe_free(e)
+
+    def _seal_inline(self, object_id: str, value) -> None:
+        """lock held. Seal a head-produced value (e.g. PG readiness)."""
+        from ray_tpu._private import serialization
+
+        payload = serialization.dumps(value)
+        entry = self.objects.get(object_id) or ObjectEntry(object_id, "head")
+        entry.inline = payload
+        entry.size = len(payload)
+        entry.state = SEALED
+        if entry.refcount == 0:
+            entry.refcount = 1
+        self.objects[object_id] = entry
+        self._on_sealed(object_id)
+
+    def _seal_error(self, object_id: str, message: str, kind: str) -> None:
+        from ray_tpu._private import serialization
+
+        payload = serialization.dumps({"__rtpu_error__": kind, "message": message})
+        entry = self.objects.get(object_id) or ObjectEntry(object_id, "head")
+        entry.inline = payload
+        entry.size = len(payload)
+        entry.state = SEALED
+        entry.is_error = True
+        if entry.refcount == 0:
+            entry.refcount = 1
+        self.objects[object_id] = entry
+        self._on_sealed(object_id)
+
+    # ------------------------------------------------------------------
+
+    def shutdown(self) -> None:
+        self._shutdown = True
+        with self.lock:
+            workers = list(self.workers.values())
+        for rec in workers:
+            try:
+                if rec.conn:
+                    rec.conn.cast("kill", {})
+            except rpc.ConnectionLost:
+                pass
+        deadline = time.time() + 2.0
+        for rec in workers:
+            if rec.proc is None:
+                continue
+            try:
+                rec.proc.wait(timeout=max(0.05, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                rec.proc.kill()
+        self.server.stop()
+        self.arena.close(unlink=True)

@@ -1,0 +1,123 @@
+"""Identifiers for objects, tasks, actors, nodes, placement groups.
+
+Counterpart of the reference's ID types (reference: src/ray/common/id.h,
+python/ray/includes/unique_ids.pxi). 16 random bytes, hex-rendered.
+
+ObjectRef carries an `owned` bit: the process that created the ref (the
+owner, reference: src/ray/core_worker/reference_count.h:72) decrements the
+owner refcount on GC; deserialized copies are borrows and do not. Borrowed
+refs are kept alive while in-flight tasks hold them via head-side arg pinning
+(see gcs.py ObjectDirectory.pin_for_task).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Callable
+
+
+def _hex_id() -> str:
+    return os.urandom(16).hex()
+
+
+class BaseID:
+    __slots__ = ("_hex",)
+    _kind = "id"
+
+    def __init__(self, hex_str: str | None = None):
+        self._hex = hex_str or _hex_id()
+
+    def hex(self) -> str:
+        return self._hex
+
+    def __eq__(self, other):
+        return type(other) is type(self) and other._hex == self._hex
+
+    def __hash__(self):
+        return hash((self._kind, self._hex))
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._hex[:12]})"
+
+    def __reduce__(self):
+        return (type(self), (self._hex,))
+
+
+class TaskID(BaseID):
+    _kind = "task"
+
+
+class ActorID(BaseID):
+    _kind = "actor"
+
+
+class NodeID(BaseID):
+    _kind = "node"
+
+
+class PlacementGroupID(BaseID):
+    _kind = "pg"
+
+
+# Registered at runtime by the worker/driver core so ObjectRef GC can notify
+# the owner directory without an import cycle.
+_ref_removed_callback: Callable[[str], None] | None = None
+_ref_lock = threading.Lock()
+
+
+def set_ref_removed_callback(cb: Callable[[str], None] | None) -> None:
+    global _ref_removed_callback
+    with _ref_lock:
+        _ref_removed_callback = cb
+
+
+class ObjectRef:
+    """Future-like handle to an object in the cluster.
+
+    Reference analogue: python/ray/includes/object_ref.pxi + ownership
+    semantics from src/ray/core_worker/reference_count.h.
+    """
+
+    __slots__ = ("_hex", "_owned", "__weakref__")
+
+    def __init__(self, hex_str: str | None = None, *, _owned: bool = False):
+        self._hex = hex_str or _hex_id()
+        self._owned = _owned
+
+    def hex(self) -> str:
+        return self._hex
+
+    def is_owned(self) -> bool:
+        return self._owned
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other._hex == self._hex
+
+    def __hash__(self):
+        return hash(("obj", self._hex))
+
+    def __repr__(self):
+        return f"ObjectRef({self._hex[:12]})"
+
+    def __reduce__(self):
+        # Deserialized copies are borrows: they never decrement the owner
+        # count (the borrow is covered by task-arg pinning at the directory).
+        return (ObjectRef, (self._hex,))
+
+    def __del__(self):
+        if self._owned:
+            try:
+                with _ref_lock:
+                    cb = _ref_removed_callback
+                if cb is not None:
+                    cb(self._hex)
+            except Exception:
+                # Interpreter teardown: module globals may already be None.
+                pass
+
+    # Allow `ray_tpu.get(ref)` ergonomics in asyncio contexts later.
+    def future(self):
+        from ray_tpu._private.worker_context import global_runtime
+
+        return global_runtime().get_async(self)

@@ -1,0 +1,246 @@
+"""Control-plane RPC: framed, bidirectional messaging over TCP.
+
+Counterpart of the reference's gRPC wrapper layer (reference: src/ray/rpc/,
+5.9k LoC; client pools in rpc/worker/core_worker_client_pool.h). The control
+plane rides DCN/loopback TCP; the data plane (tensors) never touches this —
+it uses XLA collectives over ICI (SURVEY.md §5 "Distributed communication
+backend").
+
+Frame: [u32 length][pickled (kind, msg_id, body)]. Each connection is
+bidirectional: either side can issue requests ("call") and push one-way
+notifications ("cast"). A reader thread per connection dispatches to the
+registered handler; replies resolve per-call futures.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+import traceback
+from concurrent.futures import Future
+from typing import Any, Callable
+
+_HDR = struct.Struct("<I")
+
+REPLY = "__reply__"
+ERROR = "__error__"
+
+
+class RpcError(Exception):
+    pass
+
+
+class ConnectionLost(RpcError):
+    pass
+
+
+class Connection:
+    """One bidirectional framed-message connection.
+
+    handler(kind, body, conn) is invoked on the reader thread for every
+    non-reply message; its return value (for `call`s) is sent back as a reply.
+    Handlers that may block should offload to their own executor.
+    """
+
+    def __init__(
+        self,
+        sock: socket.socket,
+        handler: Callable[[str, dict, "Connection"], Any] | None = None,
+        on_close: Callable[["Connection"], None] | None = None,
+        name: str = "",
+    ):
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock = sock
+        self._handler = handler
+        self._on_close = on_close
+        self.name = name
+        self.peer_info: dict = {}  # set during registration by the server
+        self._send_lock = threading.Lock()
+        self._pending: dict[int, Future] = {}
+        self._pending_lock = threading.Lock()
+        self._next_id = 0
+        self._closed = threading.Event()
+        self._reader = threading.Thread(target=self._read_loop, daemon=True, name=f"rpc-read-{name}")
+        self._reader.start()
+
+    # --- sending ---
+
+    def _send(self, kind: str, msg_id: int, body: Any) -> None:
+        data = pickle.dumps((kind, msg_id, body), protocol=5)
+        with self._send_lock:
+            try:
+                self._sock.sendall(_HDR.pack(len(data)) + data)
+            except OSError as e:
+                raise ConnectionLost(str(e)) from e
+
+    def call(self, kind: str, body: dict | None = None, timeout: float | None = None) -> Any:
+        """Request/response; raises RpcError on remote exception."""
+        fut: Future = Future()
+        with self._pending_lock:
+            self._next_id += 1
+            msg_id = self._next_id
+            self._pending[msg_id] = fut
+        try:
+            self._send(kind, msg_id, body or {})
+            return fut.result(timeout)
+        finally:
+            with self._pending_lock:
+                self._pending.pop(msg_id, None)
+
+    def cast(self, kind: str, body: dict | None = None) -> None:
+        """One-way notification."""
+        self._send(kind, 0, body or {})
+
+    # --- receiving ---
+
+    def _recv_exact(self, n: int) -> bytes | None:
+        chunks = []
+        while n:
+            try:
+                chunk = self._sock.recv(min(n, 1 << 20))
+            except OSError:
+                return None
+            if not chunk:
+                return None
+            chunks.append(chunk)
+            n -= len(chunk)
+        return b"".join(chunks)
+
+    def _read_loop(self) -> None:
+        while not self._closed.is_set():
+            hdr = self._recv_exact(_HDR.size)
+            if hdr is None:
+                break
+            body = self._recv_exact(_HDR.unpack(hdr)[0])
+            if body is None:
+                break
+            kind, msg_id, payload = pickle.loads(body)
+            if kind == REPLY or kind == ERROR:
+                with self._pending_lock:
+                    fut = self._pending.pop(msg_id, None)
+                if fut is not None:
+                    if kind == ERROR:
+                        fut.set_exception(RpcError(payload))
+                    else:
+                        fut.set_result(payload)
+                continue
+            self._dispatch(kind, msg_id, payload)
+        self._shutdown()
+
+    def _dispatch(self, kind: str, msg_id: int, payload: dict) -> None:
+        try:
+            result = self._handler(kind, payload, self) if self._handler else None
+            if msg_id:
+                self._send(REPLY, msg_id, result)
+        except ConnectionLost:
+            pass
+        except Exception:
+            if msg_id:
+                try:
+                    self._send(ERROR, msg_id, traceback.format_exc())
+                except ConnectionLost:
+                    pass
+            else:
+                # A failed cast has no reply channel — losing the error makes
+                # protocol bugs invisible. Surface it loudly.
+                import sys
+
+                print(
+                    f"[rpc] handler for cast {kind!r} raised:\n{traceback.format_exc()}",
+                    file=sys.stderr,
+                )
+
+    def _shutdown(self) -> None:
+        if self._closed.is_set():
+            return
+        self._closed.set()
+        with self._pending_lock:
+            pending = list(self._pending.values())
+            self._pending.clear()
+        for fut in pending:
+            if not fut.done():
+                fut.set_exception(ConnectionLost("connection closed"))
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        if self._on_close:
+            try:
+                self._on_close(self)
+            except Exception:
+                pass
+
+    def close(self) -> None:
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self._shutdown()
+
+    @property
+    def closed(self) -> bool:
+        return self._closed.is_set()
+
+
+class Server:
+    """Accepts connections; each gets the shared handler."""
+
+    def __init__(
+        self,
+        handler: Callable[[str, dict, Connection], Any],
+        on_connect: Callable[[Connection], None] | None = None,
+        on_close: Callable[[Connection], None] | None = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+    ):
+        self._handler = handler
+        self._on_connect = on_connect
+        self._on_close = on_close
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(512)
+        self.address = self._sock.getsockname()
+        self.connections: list[Connection] = []
+        self._lock = threading.Lock()
+        self._stopped = threading.Event()
+        self._thread = threading.Thread(target=self._accept_loop, daemon=True, name="rpc-accept")
+        self._thread.start()
+
+    def _accept_loop(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                sock, addr = self._sock.accept()
+            except OSError:
+                break
+            conn = Connection(sock, self._handler, self._remove, name=str(addr))
+            with self._lock:
+                self.connections.append(conn)
+            if self._on_connect:
+                self._on_connect(conn)
+
+    def _remove(self, conn: Connection) -> None:
+        with self._lock:
+            if conn in self.connections:
+                self.connections.remove(conn)
+        if self._on_close:
+            self._on_close(conn)
+
+    def stop(self) -> None:
+        self._stopped.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        with self._lock:
+            conns = list(self.connections)
+        for c in conns:
+            c.close()
+
+
+def connect(address: tuple[str, int], handler=None, on_close=None, name: str = "") -> Connection:
+    sock = socket.create_connection(address, timeout=30)
+    sock.settimeout(None)
+    return Connection(sock, handler, on_close, name=name)

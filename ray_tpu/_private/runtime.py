@@ -1,0 +1,317 @@
+"""Client-side core runtime: the in-process library of every driver/worker.
+
+Counterpart of the reference's CoreWorker
+(reference: src/ray/core_worker/core_worker.h:172 — task submission, object
+put/get, ownership; Python binding _raylet.pyx:2974). Scoped down: ownership
+bookkeeping lives in the head's ObjectDirectory; this side tracks owned refs
+(GC → del_ref), resolves get/wait futures pushed back by the head, and reads
+shm payloads zero-copy before copying out.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+import uuid
+from concurrent.futures import Future
+from concurrent.futures import TimeoutError as FutureTimeoutError
+from typing import Any, Callable, Sequence
+
+import cloudpickle
+
+from ray_tpu._private import ids as ids_mod
+from ray_tpu._private import rpc, serialization
+from ray_tpu._private.config import GLOBAL_CONFIG
+from ray_tpu._private.ids import ObjectRef
+from ray_tpu._private.shm_store import ShmClient
+from ray_tpu._private.task_spec import ActorSpec, TaskSpec
+from ray_tpu.exceptions import (
+    ActorDiedError,
+    GetTimeoutError,
+    ObjectLostError,
+    RayTpuError,
+    TaskError,
+    WorkerCrashedError,
+)
+
+_ERROR_KINDS = {
+    "worker_crashed": WorkerCrashedError,
+    "actor_died": ActorDiedError,
+    "task_error": RayTpuError,
+    "object_lost": ObjectLostError,
+}
+
+
+class CoreRuntime:
+    def __init__(
+        self,
+        address: tuple[str, int],
+        client_type: str = "driver",
+        worker_id: str | None = None,
+        message_handler: Callable[[str, dict], Any] | None = None,
+    ):
+        self._waiters: dict[str, Future] = {}
+        self._waiters_lock = threading.Lock()
+        self._message_handler = message_handler
+        self._closed = False
+        self.conn = rpc.connect(address, handler=self._handle, name=client_type)
+        reg = self.conn.call(
+            "register",
+            {"client_type": client_type, "worker_id": worker_id, "pid": os.getpid()},
+            timeout=GLOBAL_CONFIG.worker_register_timeout_s,
+        )
+        self.client_id = reg["client_id"]
+        self.node_id = reg["node_id"]
+        self.session_dir = reg["session_dir"]
+        self.shm = ShmClient(reg["shm_name"], reg["shm_capacity"])
+        self._fn_cache: dict[str, Any] = {}
+        self._fn_ids: dict[int, str] = {}  # id(fn) -> func_id
+        ids_mod.set_ref_removed_callback(self._on_ref_removed)
+
+    # ------------------------------------------------------------------
+    # inbound messages
+
+    def _handle(self, kind: str, body: dict, conn: rpc.Connection):
+        if kind in ("objects_ready", "wait_ready", "pg_ready"):
+            with self._waiters_lock:
+                fut = self._waiters.pop(body["waiter_id"], None)
+            if fut is not None and not fut.done():
+                fut.set_result(body)
+            elif kind == "objects_ready":
+                # The get() already timed out: nobody will read these metas,
+                # so release the read pins the head took in _meta_for.
+                stale = [oid for oid, m in body["metas"].items() if m[0] == "shm"]
+                if stale:
+                    try:
+                        self.conn.cast("read_done", {"ids": stale})
+                    except rpc.ConnectionLost:
+                        pass
+            return None
+        if self._message_handler is not None:
+            return self._message_handler(kind, body)
+        return None
+
+    def _new_waiter(self) -> tuple[str, Future]:
+        waiter_id = uuid.uuid4().hex[:16]
+        fut: Future = Future()
+        with self._waiters_lock:
+            self._waiters[waiter_id] = fut
+        return waiter_id, fut
+
+    def _on_ref_removed(self, hex_id: str) -> None:
+        if self._closed or self.conn.closed:
+            return
+        try:
+            self.conn.cast("del_ref", {"ids": [hex_id]})
+        except rpc.ConnectionLost:
+            pass
+
+    # ------------------------------------------------------------------
+    # objects
+
+    def put(self, value: Any, *, _object_id: str | None = None, _is_error: bool = False) -> ObjectRef:
+        object_id = _object_id or os.urandom(16).hex()
+        header, buffers = serialization.serialize(value)
+        size = serialization.serialized_size(header, buffers)
+        if size <= GLOBAL_CONFIG.max_inline_object_size:
+            payload = bytearray(size)
+            serialization.write_to(memoryview(payload), header, buffers)
+            self.conn.call(
+                "put_inline",
+                {
+                    "object_id": object_id,
+                    "payload": bytes(payload),
+                    "owner_id": self.client_id,
+                    "is_error": _is_error,
+                },
+            )
+        else:
+            try:
+                reply = self.conn.call(
+                    "create_object",
+                    {"object_id": object_id, "size": size, "owner_id": self.client_id},
+                )
+            except rpc.RpcError as e:
+                if "ObjectStoreFullError" in str(e):
+                    from ray_tpu.exceptions import ObjectStoreFullError
+
+                    raise ObjectStoreFullError(
+                        f"cannot store {size}-byte object: object store full "
+                        f"(even after spilling)"
+                    ) from None
+                raise
+            view = self.shm.view(reply["offset"], size)
+            serialization.write_to(view, header, buffers)
+            view.release()
+            self.conn.call("seal_object", {"object_id": object_id, "is_error": _is_error})
+        return ObjectRef(object_id, _owned=_object_id is None)
+
+    def get(self, refs: ObjectRef | Sequence[ObjectRef], timeout: float | None = None) -> Any:
+        single = isinstance(refs, ObjectRef)
+        ref_list = [refs] if single else list(refs)
+        if not ref_list:
+            return [] if not single else None
+        id_list = [r.hex() for r in ref_list]
+        waiter_id, fut = self._new_waiter()
+        self.conn.cast("get_meta", {"waiter_id": waiter_id, "ids": id_list})
+        try:
+            body = fut.result(timeout)
+        except FutureTimeoutError:
+            self.conn.cast("cancel_wait", {"waiter_id": waiter_id})
+            raise GetTimeoutError(f"get timed out after {timeout}s on {ref_list}") from None
+        finally:
+            with self._waiters_lock:
+                self._waiters.pop(waiter_id, None)
+        metas = body["metas"]
+        values = []
+        read_ids = []
+        try:
+            for hex_id in id_list:
+                meta = metas[hex_id]
+                if meta[0] == "inline":
+                    _, payload, is_error = meta
+                    values.append(self._deserialize(payload, is_error))
+                elif meta[0] == "shm":
+                    _, offset, size, is_error = meta
+                    read_ids.append(hex_id)
+                    view = self.shm.view(offset, size)
+                    try:
+                        # Copy out of shm before releasing the read pin so the
+                        # head may spill/evict afterwards. (Zero-copy pinned
+                        # reads are a planned optimization.)
+                        values.append(self._deserialize(bytes(view), is_error))
+                    finally:
+                        view.release()
+                else:
+                    raise ObjectLostError(meta[1])
+        finally:
+            if read_ids:
+                self.conn.cast("read_done", {"ids": read_ids})
+        return values[0] if single else values
+
+    def get_async(self, ref: ObjectRef) -> Future:
+        waiter_id, fut = self._new_waiter()
+        result: Future = Future()
+
+        def _done(f: Future):
+            try:
+                body = f.result()
+                meta = body["metas"][ref.hex()]
+                if meta[0] == "inline":
+                    result.set_result(self._deserialize(meta[1], meta[2]))
+                elif meta[0] == "shm":
+                    view = self.shm.view(meta[1], meta[2])
+                    try:
+                        result.set_result(self._deserialize(bytes(view), meta[3]))
+                    finally:
+                        view.release()
+                        self.conn.cast("read_done", {"ids": [ref.hex()]})
+                else:
+                    result.set_exception(ObjectLostError(meta[1]))
+            except Exception as e:  # noqa: BLE001
+                result.set_exception(e)
+
+        fut.add_done_callback(_done)
+        self.conn.cast("get_meta", {"waiter_id": waiter_id, "ids": [ref.hex()]})
+        return result
+
+    def _deserialize(self, payload: bytes, is_error: bool) -> Any:
+        value = serialization.loads(payload)
+        if is_error:
+            if isinstance(value, dict) and "__rtpu_error__" in value:
+                exc_cls = _ERROR_KINDS.get(value["__rtpu_error__"], RayTpuError)
+                raise exc_cls(value["message"])
+            if isinstance(value, BaseException):
+                raise value
+            raise RayTpuError(str(value))
+        return value
+
+    def wait(
+        self,
+        refs: Sequence[ObjectRef],
+        num_returns: int = 1,
+        timeout: float | None = None,
+    ) -> tuple[list[ObjectRef], list[ObjectRef]]:
+        id_list = [r.hex() for r in refs]
+        by_id = {r.hex(): r for r in refs}
+        waiter_id, fut = self._new_waiter()
+        self.conn.cast(
+            "wait", {"waiter_id": waiter_id, "ids": id_list, "num_returns": num_returns}
+        )
+        try:
+            body = fut.result(timeout)
+            ready_ids = body["ready"]
+        except FutureTimeoutError:
+            self.conn.cast("cancel_wait", {"waiter_id": waiter_id})
+            ready_ids = self.conn.call("wait_check", {"ids": id_list})["ready"]
+        ready_set = set(ready_ids[:num_returns])
+        ready = [by_id[i] for i in id_list if i in ready_set]
+        not_ready = [by_id[i] for i in id_list if i not in ready_set]
+        return ready, not_ready
+
+    def free(self, refs: Sequence[ObjectRef], force: bool = False) -> None:
+        self.conn.call("free_objects", {"ids": [r.hex() for r in refs], "force": force})
+
+    # ------------------------------------------------------------------
+    # functions
+
+    def register_function(self, fn: Any) -> str:
+        cached = self._fn_ids.get(id(fn))
+        if cached is not None:
+            return cached
+        blob = cloudpickle.dumps(fn)
+        func_id = "fn:" + hashlib.sha256(blob).hexdigest()[:32]
+        self.conn.call("kv_put", {"ns": "__functions__", "key": func_id, "value": blob, "overwrite": False})
+        self._fn_ids[id(fn)] = func_id
+        self._fn_cache[func_id] = fn
+        return func_id
+
+    def get_function(self, func_id: str) -> Any:
+        fn = self._fn_cache.get(func_id)
+        if fn is None:
+            reply = self.conn.call("kv_get", {"ns": "__functions__", "key": func_id})
+            if reply["value"] is None:
+                raise RayTpuError(f"function {func_id} not found in KV")
+            fn = cloudpickle.loads(reply["value"])
+            self._fn_cache[func_id] = fn
+        return fn
+
+    # ------------------------------------------------------------------
+    # tasks / actors
+
+    @staticmethod
+    def pack_args(args: tuple, kwargs: dict) -> tuple[bytes, list[str]]:
+        deps = [
+            a.hex() for a in list(args) + list(kwargs.values()) if isinstance(a, ObjectRef)
+        ]
+        return cloudpickle.dumps((args, kwargs), protocol=5), deps
+
+    def submit_task(self, spec: TaskSpec) -> None:
+        self.conn.cast("submit_task", {"spec": spec})
+
+    def submit_actor_task(self, spec: TaskSpec) -> None:
+        self.conn.cast("submit_actor_task", {"spec": spec})
+
+    def create_actor(self, spec: ActorSpec) -> None:
+        self.conn.call("create_actor", {"spec": spec})
+
+    # ------------------------------------------------------------------
+
+    def kv_put(self, key: str, value: bytes, ns: str = "", overwrite: bool = True) -> bool:
+        return self.conn.call("kv_put", {"ns": ns, "key": key, "value": value, "overwrite": overwrite})["added"]
+
+    def kv_get(self, key: str, ns: str = "") -> bytes | None:
+        return self.conn.call("kv_get", {"ns": ns, "key": key})["value"]
+
+    def kv_del(self, key: str, ns: str = "") -> bool:
+        return self.conn.call("kv_del", {"ns": ns, "key": key})["deleted"]
+
+    def kv_keys(self, prefix: str = "", ns: str = "") -> list[str]:
+        return self.conn.call("kv_keys", {"ns": ns, "prefix": prefix})["keys"]
+
+    def close(self) -> None:
+        self._closed = True
+        ids_mod.set_ref_removed_callback(None)
+        self.conn.close()
+        self.shm.close()

@@ -1,0 +1,256 @@
+"""Cluster resource model and scheduling policies.
+
+Counterpart of the reference's scheduler stack (reference:
+src/ray/common/scheduling/cluster_resource_data.h:36,290 — ResourceRequest /
+NodeResources with fixed-point arithmetic; policy implementations under
+src/ray/raylet/scheduling/policy/: hybrid_scheduling_policy.h:50,
+bundle_scheduling_policy.h, composite_scheduling_policy.h:33).
+
+Resources are arbitrary named floats (CPU, TPU, memory, custom markers like
+``TPU-v4-16-head``). Fixed-point at 1e-4 granularity avoids float drift when
+fractional resources are repeatedly acquired/returned — same motivation as
+the reference's FixedPoint (fixed_point.h).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Iterable
+
+GRANULARITY = 10000  # 1e-4 units
+
+
+def _fp(v: float) -> int:
+    return round(v * GRANULARITY)
+
+
+def _unfp(v: int) -> float:
+    return v / GRANULARITY
+
+
+class ResourceSet:
+    """A bag of named fixed-point resource quantities."""
+
+    __slots__ = ("_r",)
+
+    def __init__(self, resources: dict[str, float] | None = None):
+        self._r: dict[str, int] = {k: _fp(v) for k, v in (resources or {}).items() if _fp(v) != 0}
+
+    @classmethod
+    def _raw(cls, r: dict[str, int]) -> "ResourceSet":
+        rs = cls()
+        rs._r = {k: v for k, v in r.items() if v != 0}
+        return rs
+
+    def to_dict(self) -> dict[str, float]:
+        return {k: _unfp(v) for k, v in self._r.items()}
+
+    def get(self, name: str) -> float:
+        return _unfp(self._r.get(name, 0))
+
+    def is_empty(self) -> bool:
+        return not self._r
+
+    def fits(self, other: "ResourceSet") -> bool:
+        """True if `other` (a demand) fits within self (availability)."""
+        return all(self._r.get(k, 0) >= v for k, v in other._r.items())
+
+    def subtract(self, other: "ResourceSet") -> None:
+        for k, v in other._r.items():
+            self._r[k] = self._r.get(k, 0) - v
+            if self._r[k] == 0:
+                del self._r[k]
+
+    def add(self, other: "ResourceSet") -> None:
+        for k, v in other._r.items():
+            self._r[k] = self._r.get(k, 0) + v
+            if self._r[k] == 0:
+                del self._r[k]
+
+    def copy(self) -> "ResourceSet":
+        return ResourceSet._raw(dict(self._r))
+
+    def keys(self) -> Iterable[str]:
+        return self._r.keys()
+
+    def __repr__(self):
+        return f"ResourceSet({self.to_dict()})"
+
+
+@dataclasses.dataclass
+class NodeEntry:
+    node_id: str
+    address: str
+    total: ResourceSet
+    available: ResourceSet
+    labels: dict[str, str] = dataclasses.field(default_factory=dict)
+    alive: bool = True
+    last_heartbeat: float = dataclasses.field(default_factory=time.monotonic)
+
+    def utilization(self) -> float:
+        """Max over resource kinds of used/total — the hybrid policy's score."""
+        best = 0.0
+        for k in self.total.keys():
+            tot = self.total.get(k)
+            if tot <= 0:
+                continue
+            used = tot - self.available.get(k)
+            best = max(best, used / tot)
+        return best
+
+
+# --- scheduling strategies (user-facing mirrors util/scheduling_strategies) ---
+
+
+@dataclasses.dataclass
+class NodeAffinitySchedulingStrategy:
+    """Pin to a node (reference: util/scheduling_strategies.py NodeAffinity)."""
+
+    node_id: str
+    soft: bool = False
+
+
+@dataclasses.dataclass
+class PlacementGroupSchedulingStrategy:
+    placement_group: object  # PlacementGroup handle
+    placement_group_bundle_index: int = -1
+
+
+class ClusterScheduler:
+    """Picks a node for each resource demand.
+
+    Policy composition mirrors the reference's CompositeSchedulingPolicy:
+    "DEFAULT" = hybrid pack-until-threshold-then-spread
+    (hybrid_scheduling_policy.h:50), "SPREAD" = least-utilized round robin,
+    node affinity, and placement-group bundle placement with
+    PACK/SPREAD/STRICT_PACK/STRICT_SPREAD (bundle_scheduling_policy.h).
+    """
+
+    def __init__(self, spread_threshold: float = 0.5):
+        self.nodes: dict[str, NodeEntry] = {}
+        self.spread_threshold = spread_threshold
+        self._rr_counter = 0
+
+    # --- membership ---
+
+    def add_node(self, node: NodeEntry) -> None:
+        self.nodes[node.node_id] = node
+
+    def remove_node(self, node_id: str) -> None:
+        self.nodes.pop(node_id, None)
+
+    def alive_nodes(self) -> list[NodeEntry]:
+        return [n for n in self.nodes.values() if n.alive]
+
+    # --- selection ---
+
+    def pick_node(self, demand: ResourceSet, strategy=None) -> NodeEntry | None:
+        nodes = self.alive_nodes()
+        if not nodes:
+            return None
+        if isinstance(strategy, NodeAffinitySchedulingStrategy):
+            node = self.nodes.get(strategy.node_id)
+            if node is not None and node.alive and node.available.fits(demand):
+                return node
+            if not strategy.soft:
+                return None
+            # fall through to default policy
+        feasible = [n for n in nodes if n.total.fits(demand)]
+        available = [n for n in feasible if n.available.fits(demand)]
+        if not available:
+            return None
+        if strategy == "SPREAD":
+            # least utilized first, round-robin tiebreak
+            self._rr_counter += 1
+            return min(
+                available,
+                key=lambda n: (round(n.utilization(), 4), (hash(n.node_id) + self._rr_counter) % len(available)),
+            )
+        # hybrid: among nodes below the utilization threshold, pack onto the
+        # most utilized (minimize fragmentation); else spread to least.
+        below = [n for n in available if n.utilization() < self.spread_threshold]
+        if below:
+            return max(below, key=lambda n: (round(n.utilization(), 4), n.node_id))
+        return min(available, key=lambda n: (round(n.utilization(), 4), n.node_id))
+
+    def acquire(self, node_id: str, demand: ResourceSet) -> bool:
+        node = self.nodes.get(node_id)
+        if node is None or not node.available.fits(demand):
+            return False
+        node.available.subtract(demand)
+        return True
+
+    def release(self, node_id: str, demand: ResourceSet) -> None:
+        node = self.nodes.get(node_id)
+        if node is not None:
+            node.available.add(demand)
+
+    # --- placement groups ---
+
+    def place_bundles(
+        self, bundles: list[dict[str, float]], policy: str
+    ) -> list[str] | None:
+        """Returns a node id per bundle, or None if infeasible now.
+
+        All-or-nothing (gang) placement — the caller reserves atomically,
+        mirroring the 2PC prepare/commit of the reference's
+        GcsPlacementGroupScheduler (gcs_placement_group_scheduler.h).
+        """
+        demands = [ResourceSet(b) for b in bundles]
+        # Work on a scratch copy of availability for atomicity.
+        scratch = {n.node_id: n.available.copy() for n in self.alive_nodes()}
+        placement: list[str] = []
+
+        def nodes_by_util():
+            return sorted(self.alive_nodes(), key=lambda n: n.utilization())
+
+        if policy in ("STRICT_PACK",):
+            for node in self.alive_nodes():
+                avail = scratch[node.node_id].copy()
+                if all(self._take(avail, d) for d in demands):
+                    return [node.node_id] * len(demands)
+            return None
+        if policy in ("STRICT_SPREAD",):
+            nodes = nodes_by_util()
+            if len(nodes) < len(demands):
+                return None
+            used: set[str] = set()
+            for d in demands:
+                pick = next(
+                    (n for n in nodes if n.node_id not in used and scratch[n.node_id].fits(d)),
+                    None,
+                )
+                if pick is None:
+                    return None
+                used.add(pick.node_id)
+                scratch[pick.node_id].subtract(d)
+                placement.append(pick.node_id)
+            return placement
+        # PACK (best effort pack) / SPREAD (best effort spread)
+        prefer_pack = policy == "PACK"
+        for d in demands:
+            candidates = [n for n in self.alive_nodes() if scratch[n.node_id].fits(d)]
+            if not candidates:
+                return None
+            if prefer_pack:
+                # Prefer nodes already used by this group, then most-utilized.
+                pick = min(
+                    candidates,
+                    key=lambda n: (n.node_id not in placement, -n.utilization(), n.node_id),
+                )
+            else:
+                pick = min(
+                    candidates,
+                    key=lambda n: (placement.count(n.node_id), n.utilization(), n.node_id),
+                )
+            scratch[pick.node_id].subtract(d)
+            placement.append(pick.node_id)
+        return placement
+
+    @staticmethod
+    def _take(avail: ResourceSet, d: ResourceSet) -> bool:
+        if avail.fits(d):
+            avail.subtract(d)
+            return True
+        return False

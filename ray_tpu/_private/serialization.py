@@ -1,0 +1,109 @@
+"""Object serialization with zero-copy numpy/jax buffers.
+
+Counterpart of the reference's serialization layer
+(reference: python/ray/_private/serialization.py, cloudpickle fork under
+python/ray/cloudpickle/, zero-copy arrow in arrow_serialization.py). Uses
+upstream cloudpickle + pickle protocol 5 out-of-band buffers so large numpy
+arrays land in shared memory unsharded and deserialize as zero-copy views.
+
+Wire layout of a serialized object:
+    [u32 magic][u64 len(header)][header pickle bytes]
+    [u64 nbuffers]([u64 aligned_offset][u64 len])* [padded buffers...]
+Buffers are 64-byte aligned inside the payload so zero-copy numpy views keep
+alignment guarantees.
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+from typing import Any
+
+import cloudpickle
+
+MAGIC = 0x52545055  # 'RTPU'
+_ALIGN = 64
+
+
+def _pad(n: int) -> int:
+    return (n + _ALIGN - 1) & ~(_ALIGN - 1)
+
+
+def _to_host(obj: Any) -> Any:
+    """Move jax arrays to host numpy before pickling (device buffers are not
+    picklable; tensors normally shouldn't transit the object store at all —
+    see shm_store docstring — but small ones are allowed for convenience)."""
+    try:
+        import jax
+        import numpy as np
+
+        if isinstance(obj, jax.Array):
+            return np.asarray(obj)
+    except Exception:
+        pass
+    return obj
+
+
+def serialize(obj: Any) -> tuple[bytes, list[pickle.PickleBuffer]]:
+    """Returns (header_bytes, oob_buffers)."""
+    obj = _to_host(obj)
+    buffers: list[pickle.PickleBuffer] = []
+    header = cloudpickle.dumps(obj, protocol=5, buffer_callback=buffers.append)
+    return header, buffers
+
+
+def serialized_size(header: bytes, buffers: list[pickle.PickleBuffer]) -> int:
+    n = 4 + 8 + len(header)
+    n = _pad(n + 8 + 16 * len(buffers))
+    for b in buffers:
+        n = _pad(n + len(b.raw()))
+    return n
+
+
+def write_to(view: memoryview, header: bytes, buffers: list[pickle.PickleBuffer]) -> int:
+    """Writes the object into `view`; returns bytes written."""
+    struct.pack_into("<IQ", view, 0, MAGIC, len(header))
+    pos = 12
+    view[pos : pos + len(header)] = header
+    pos += len(header)
+    index_pos = pos
+    pos = _pad(pos + 8 + 16 * len(buffers))
+    struct.pack_into("<Q", view, index_pos, len(buffers))
+    ipos = index_pos + 8
+    for b in buffers:
+        raw = b.raw()
+        struct.pack_into("<QQ", view, ipos, pos, len(raw))
+        ipos += 16
+        view[pos : pos + len(raw)] = raw
+        pos = _pad(pos + len(raw))
+    return pos
+
+
+def dumps(obj: Any) -> bytes:
+    header, buffers = serialize(obj)
+    size = serialized_size(header, buffers)
+    out = bytearray(size)
+    write_to(memoryview(out), header, buffers)
+    return bytes(out)
+
+
+def loads_from(view: memoryview) -> Any:
+    """Deserializes from a view; numpy arrays are zero-copy into the view."""
+    magic, hlen = struct.unpack_from("<IQ", view, 0)
+    if magic != MAGIC:
+        raise ValueError("corrupt object payload")
+    pos = 12
+    header = bytes(view[pos : pos + hlen])
+    pos += hlen
+    (nbuf,) = struct.unpack_from("<Q", view, pos)
+    pos += 8
+    bufs = []
+    for _ in range(nbuf):
+        off, blen = struct.unpack_from("<QQ", view, pos)
+        pos += 16
+        bufs.append(view[off : off + blen])
+    return pickle.loads(header, buffers=bufs)
+
+
+def loads(data: bytes | memoryview) -> Any:
+    return loads_from(memoryview(data))

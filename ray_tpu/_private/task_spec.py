@@ -1,0 +1,49 @@
+"""Task and actor specs exchanged between driver, head, and workers.
+
+Counterpart of the reference's TaskSpecification protobuf
+(reference: src/ray/protobuf/common.proto TaskSpec; built in
+python/ray/_raylet.pyx submit_task :3709 / create_actor :3795).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+
+@dataclasses.dataclass
+class TaskSpec:
+    task_id: str
+    name: str
+    func_id: str  # KV key of the serialized function/class
+    args: bytes  # cloudpickled (args, kwargs) with ObjectRefs embedded
+    deps: list[str]  # object ids appearing top-level in args
+    return_ids: list[str]
+    resources: dict[str, float]
+    owner_id: str  # client id of the submitter
+    max_retries: int = 0
+    retries_used: int = 0
+    scheduling_strategy: Any = None
+    runtime_env: dict | None = None
+    # actor fields
+    actor_id: str | None = None  # set for actor method calls
+    actor_creation: bool = False
+    method_name: str = ""
+    seq_no: int = 0  # per-caller ordering for actor calls
+
+
+@dataclasses.dataclass
+class ActorSpec:
+    actor_id: str
+    name: str | None  # named actor registry key
+    namespace: str
+    cls_func_id: str
+    init_args: bytes
+    deps: list[str]
+    resources: dict[str, float]
+    max_restarts: int
+    max_concurrency: int
+    owner_id: str
+    scheduling_strategy: Any = None
+    runtime_env: dict | None = None
+    lifetime: str | None = None  # "detached" or None

@@ -1,0 +1,196 @@
+"""Worker process: executes tasks and hosts actors.
+
+Counterpart of the reference's default_worker.py main loop + the executor
+half of CoreWorker (reference:
+python/ray/_private/workers/default_worker.py:194 `worker.main_loop()`;
+src/ray/core_worker/transport/task_receiver.cc:38 HandleTask;
+core_worker.cc:3253 ExecuteTask; actor concurrency via
+transport/concurrency_group_manager.h:37).
+
+The head pushes `push_task` / `become_actor` messages over the registered
+connection; a FIFO thread-pool executor runs them (pool size 1 for normal
+workers and ordered actors, `max_concurrency` for concurrent actors —
+threaded-actor semantics).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import traceback
+from concurrent.futures import ThreadPoolExecutor
+
+import cloudpickle
+
+from ray_tpu._private import worker_context
+from ray_tpu._private.ids import ObjectRef
+from ray_tpu._private.runtime import CoreRuntime
+from ray_tpu._private.task_spec import TaskSpec
+from ray_tpu.exceptions import TaskError
+
+
+class Worker:
+    def __init__(self, head_addr: tuple[str, int], worker_id: str, node_id: str):
+        self.worker_id = worker_id
+        self.node_id = node_id
+        # Executor and actor state MUST exist before the runtime connects:
+        # the head may push a task the instant registration lands, racing
+        # Worker.__init__'s remaining lines on the reader thread.
+        self.executor = ThreadPoolExecutor(max_workers=1, thread_name_prefix="task-exec")
+        self.actor_instance = None
+        self.actor_id: str | None = None
+        self._exit = threading.Event()
+        self.runtime = CoreRuntime(
+            head_addr,
+            client_type="worker",
+            worker_id=worker_id,
+            message_handler=self._on_message,
+        )
+        worker_context.set_runtime(self.runtime)
+        # Driver/head gone -> exit (the connection is our lease).
+        self.runtime.conn._on_close = lambda conn: os._exit(0)
+        # Two-phase registration: the head dispatches nothing until this
+        # lands, guaranteeing __init__ finished before the first push_task.
+        self.runtime.conn.cast("worker_ready", {"worker_id": self.worker_id})
+
+    # ------------------------------------------------------------------
+
+    def _on_message(self, kind: str, body: dict):
+        if kind == "push_task":
+            self.executor.submit(self._run_task_guarded, body["spec"], body.get("tpu_chips"))
+        elif kind == "become_actor":
+            self.actor_id = body["actor_id"]
+            maxc = max(1, int(body.get("max_concurrency", 1)))
+            if maxc > 1:
+                self.executor = ThreadPoolExecutor(
+                    max_workers=maxc, thread_name_prefix="actor-exec"
+                )
+            self._set_tpu_env(body.get("tpu_chips"))
+            self.executor.submit(self._run_task_guarded, body["spec"], None)
+        elif kind == "kill":
+            self._exit.set()
+            os._exit(0)
+        elif kind == "cancel":
+            pass  # queued-task cancellation is handled head-side; running
+            # tasks are force-cancelled by killing the worker process.
+        return None
+
+    @staticmethod
+    def _set_tpu_env(chips) -> None:
+        """TPU chip visibility pinning (reference semantics:
+        _private/accelerators/tpu.py:193 set_current_process_visible_…)."""
+        if chips:
+            os.environ["TPU_VISIBLE_CHIPS"] = ",".join(str(c) for c in chips)
+            os.environ["TPU_CHIPS_PER_PROCESS_BOUNDS"] = f"1,{len(chips)},1"
+
+    # ------------------------------------------------------------------
+
+    def _run_task_guarded(self, spec: TaskSpec, tpu_chips) -> None:
+        failed = False
+        try:
+            failed = not self._run_task(spec, tpu_chips)
+        except Exception:
+            traceback.print_exc()
+            failed = True
+        finally:
+            try:
+                self.runtime.conn.cast(
+                    "task_finished",
+                    {
+                        "worker_id": self.worker_id,
+                        "task_id": spec.task_id,
+                        "failed": failed,
+                    },
+                )
+            except Exception:
+                pass
+
+    def _run_task(self, spec: TaskSpec, tpu_chips) -> bool:
+        """Returns True on success. Stores results/errors for return ids."""
+        saved_env: dict[str, str | None] = {}
+        env_vars = (spec.runtime_env or {}).get("env_vars", {})
+        if tpu_chips:
+            env_vars = dict(env_vars)
+            env_vars["TPU_VISIBLE_CHIPS"] = ",".join(str(c) for c in tpu_chips)
+        for k, v in env_vars.items():
+            saved_env[k] = os.environ.get(k)
+            os.environ[k] = str(v)
+        worker_context.set_task_context(
+            worker_context.TaskContext(spec.task_id, self.actor_id, self.node_id)
+        )
+        try:
+            args, kwargs = cloudpickle.loads(spec.args)
+            args = [self._resolve(a) for a in args]
+            kwargs = {k: self._resolve(v) for k, v in kwargs.items()}
+
+            if spec.actor_creation:
+                cls = self.runtime.get_function(spec.func_id)
+                self.actor_instance = cls(*args, **kwargs)
+                self.runtime.put("ok", _object_id=spec.return_ids[0])
+                return True
+            if spec.actor_id is not None:
+                method = getattr(self.actor_instance, spec.method_name)
+                result = method(*args, **kwargs)
+            else:
+                result = self.runtime.get_function(spec.func_id)(*args, **kwargs)
+            self._store_returns(spec, result)
+            return True
+        except Exception as e:  # noqa: BLE001
+            err = TaskError(repr(e), traceback.format_exc(), spec.name)
+            for oid in spec.return_ids:
+                try:
+                    self.runtime.put(err, _object_id=oid, _is_error=True)
+                except Exception:
+                    traceback.print_exc()
+            return False
+        finally:
+            worker_context.set_task_context(None)
+            for k, v in saved_env.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    def _resolve(self, value):
+        if isinstance(value, ObjectRef):
+            return self.runtime.get(value)
+        return value
+
+    def _store_returns(self, spec: TaskSpec, result) -> None:
+        n = len(spec.return_ids)
+        if n == 0:
+            return
+        if n == 1:
+            self.runtime.put(result, _object_id=spec.return_ids[0])
+            return
+        values = list(result) if isinstance(result, (tuple, list)) else None
+        if values is None or len(values) != n:
+            raise ValueError(
+                f"task {spec.name} declared num_returns={n} but returned "
+                f"{type(result).__name__} of length "
+                f"{len(result) if hasattr(result, '__len__') else 'n/a'}"
+            )
+        for oid, v in zip(spec.return_ids, values):
+            self.runtime.put(v, _object_id=oid)
+
+    def main_loop(self) -> None:
+        self._exit.wait()
+
+
+def main() -> None:
+    import faulthandler
+    import signal
+
+    faulthandler.register(signal.SIGUSR1, all_threads=True)
+    head_host, head_port = os.environ["RAY_TPU_HEAD"].rsplit(":", 1)
+    worker = Worker(
+        (head_host, int(head_port)),
+        os.environ["RAY_TPU_WORKER_ID"],
+        os.environ["RAY_TPU_NODE_ID"],
+    )
+    worker.main_loop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

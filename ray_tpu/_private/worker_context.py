@@ -1,0 +1,60 @@
+"""Process-global runtime context shared by the public API and workers.
+
+Counterpart of the reference's global worker singleton
+(reference: python/ray/_private/worker.py global_worker / Worker class).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:
+    from ray_tpu._private.runtime import CoreRuntime
+
+_lock = threading.Lock()
+_runtime: "CoreRuntime | None" = None
+_head = None  # set when this process hosts the head (driver)
+_task_context = threading.local()
+
+
+def set_runtime(rt, head=None) -> None:
+    global _runtime, _head
+    with _lock:
+        _runtime = rt
+        _head = head
+
+
+def global_runtime() -> "CoreRuntime":
+    if _runtime is None:
+        raise RuntimeError("ray_tpu is not initialized; call ray_tpu.init() first")
+    return _runtime
+
+
+def try_runtime():
+    return _runtime
+
+
+def get_head():
+    return _head
+
+
+def is_initialized() -> bool:
+    return _runtime is not None
+
+
+class TaskContext:
+    """Per-task runtime context (reference: ray.get_runtime_context())."""
+
+    def __init__(self, task_id: str = "", actor_id: str | None = None, node_id: str = ""):
+        self.task_id = task_id
+        self.actor_id = actor_id
+        self.node_id = node_id
+
+
+def set_task_context(ctx: TaskContext | None) -> None:
+    _task_context.ctx = ctx
+
+
+def get_task_context() -> TaskContext:
+    return getattr(_task_context, "ctx", None) or TaskContext()

@@ -1,0 +1,142 @@
+"""@ray_tpu.remote on classes: actors.
+
+Counterpart of the reference's actor frontend (reference:
+python/ray/actor.py — ActorClass ``remote`` :752, ActorHandle, ActorMethod)
+over the head's actor table (GcsActorManager analogue in _private/gcs.py).
+Calls are routed by the head to the actor's dedicated worker and executed
+FIFO (or concurrently with ``max_concurrency`` > 1 — threaded actors).
+"""
+
+from __future__ import annotations
+
+import os
+import uuid
+
+from ray_tpu._private.config import GLOBAL_CONFIG
+from ray_tpu._private.ids import ActorID, ObjectRef
+from ray_tpu._private.task_spec import ActorSpec, TaskSpec
+from ray_tpu._private.worker_context import global_runtime
+from ray_tpu.remote_function import _normalize_resources
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1):
+        self._handle = handle
+        self._name = name
+        self._num_returns = num_returns
+
+    def options(self, num_returns: int = 1, **_):
+        return ActorMethod(self._handle, self._name, num_returns)
+
+    def remote(self, *args, **kwargs):
+        return self._handle._submit_method(self._name, args, kwargs, self._num_returns)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"actor method {self._name} cannot be called directly; use .remote()"
+        )
+
+
+class ActorHandle:
+    def __init__(self, actor_id: str, method_names: tuple[str, ...] = ()):
+        self._actor_id = actor_id
+        self._method_names = method_names
+        self._seq = 0
+
+    @property
+    def actor_id(self) -> ActorID:
+        return ActorID(self._actor_id)
+
+    def __getattr__(self, name: str) -> ActorMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return ActorMethod(self, name)
+
+    def _submit_method(self, method: str, args, kwargs, num_returns: int):
+        rt = global_runtime()
+        packed, deps = rt.pack_args(args, kwargs)
+        return_ids = [os.urandom(16).hex() for _ in range(num_returns)]
+        self._seq += 1
+        spec = TaskSpec(
+            task_id="task-" + uuid.uuid4().hex[:12],
+            name=f"actor.{method}",
+            func_id="",  # resolved from the actor instance worker-side
+            args=packed,
+            deps=deps,
+            return_ids=return_ids,
+            resources={},
+            owner_id=rt.client_id,
+            actor_id=self._actor_id,
+            method_name=method,
+            seq_no=self._seq,
+        )
+        rt.submit_actor_task(spec)
+        refs = [ObjectRef(oid, _owned=True) for oid in return_ids]
+        return refs[0] if num_returns == 1 else refs
+
+    def __reduce__(self):
+        return (ActorHandle, (self._actor_id, self._method_names))
+
+    def __repr__(self):
+        return f"ActorHandle({self._actor_id[:16]})"
+
+
+class ActorClass:
+    def __init__(self, cls, **actor_options):
+        self._cls = cls
+        self._opts = actor_options
+        self.__name__ = getattr(cls, "__name__", "Actor")
+
+    def options(self, **overrides) -> "ActorClass":
+        opts = dict(self._opts)
+        opts.update(overrides)
+        return ActorClass(self._cls, **opts)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"actor class {self.__name__} cannot be instantiated directly; "
+            f"use {self.__name__}.remote()"
+        )
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        from ray_tpu import api
+
+        api.auto_init()
+        rt = global_runtime()
+        opts = self._opts
+        cls_func_id = rt.register_function(self._cls)
+        packed, deps = rt.pack_args(args, kwargs)
+        actor_id = "actor-" + uuid.uuid4().hex[:12]
+        # Actors hold 0 CPUs while idle by default (many actors per node),
+        # mirroring the reference's default actor resource semantics.
+        spec = ActorSpec(
+            actor_id=actor_id,
+            name=opts.get("name"),
+            namespace=opts.get("namespace", api.get_namespace()),
+            cls_func_id=cls_func_id,
+            init_args=packed,
+            deps=deps,
+            resources=_normalize_resources(
+                opts.get("num_cpus"),
+                opts.get("num_tpus") or opts.get("num_gpus"),
+                opts.get("memory"),
+                opts.get("resources"),
+                default_cpus=0.0,
+            ),
+            max_restarts=int(opts.get("max_restarts", GLOBAL_CONFIG.actor_max_restarts_default)),
+            max_concurrency=int(opts.get("max_concurrency", 1)),
+            owner_id=rt.client_id,
+            scheduling_strategy=opts.get("scheduling_strategy"),
+            runtime_env=opts.get("runtime_env"),
+            lifetime=opts.get("lifetime"),
+        )
+        rt.create_actor(spec)
+        methods = tuple(
+            n for n in dir(self._cls) if callable(getattr(self._cls, n, None)) and not n.startswith("_")
+        )
+        return ActorHandle(actor_id, methods)
+
+
+def creation_ref(handle: ActorHandle) -> ObjectRef:
+    """ObjectRef sealed when the actor finishes __init__ (or fails)."""
+    return ObjectRef(handle._actor_id + ":creation")

@@ -1,0 +1,203 @@
+"""Public core API: init / remote / get / put / wait / actors / cluster info.
+
+Counterpart of the reference's top-level API (reference:
+python/ray/_private/worker.py — ray.init :1285, ray.get :2660, ray.put :2814,
+ray.wait :2879, ray.remote :3267, ray.shutdown :1895, ray.kill, ray.cancel,
+ray.get_actor).
+"""
+
+from __future__ import annotations
+
+import atexit
+import threading
+from typing import Any, Sequence
+
+from ray_tpu._private import worker_context
+from ray_tpu._private.config import GLOBAL_CONFIG, Config
+from ray_tpu._private.ids import ObjectRef
+from ray_tpu._private.runtime import CoreRuntime
+from ray_tpu._private.worker_context import global_runtime
+
+_init_lock = threading.Lock()
+_namespace = ""
+
+
+def init(
+    address: str | None = None,
+    *,
+    num_cpus: float | None = None,
+    num_tpus: float | None = None,
+    resources: dict[str, float] | None = None,
+    object_store_memory: int | None = None,
+    namespace: str = "",
+    ignore_reinit_error: bool = False,
+    _system_config: dict | None = None,
+) -> dict:
+    """Start (or connect to) a cluster and attach this process as driver.
+
+    With no address, starts an in-process head (the GCS/raylet/object-store
+    roles — see _private/gcs.py) exactly like the reference's single-node
+    ``ray.init()`` starts a head node. ``address="host:port"`` connects to an
+    existing head started by another driver or `ray-tpu start`.
+    """
+    global _namespace
+    with _init_lock:
+        if worker_context.is_initialized():
+            if ignore_reinit_error:
+                return context_info()
+            raise RuntimeError("ray_tpu.init() called twice; pass ignore_reinit_error=True")
+        _namespace = namespace
+        cfg = Config().apply_overrides(_system_config)
+        if object_store_memory:
+            cfg.object_store_memory = int(object_store_memory)
+        if address is None:
+            from ray_tpu._private.gcs import Head
+
+            head = Head(cfg, num_cpus=num_cpus, num_tpus=num_tpus, resources=resources)
+            rt = CoreRuntime(head.address, client_type="driver")
+            worker_context.set_runtime(rt, head)
+        else:
+            host, port = address.rsplit(":", 1)
+            rt = CoreRuntime((host, int(port)), client_type="driver")
+            worker_context.set_runtime(rt, None)
+        atexit.register(shutdown)
+        return context_info()
+
+
+def auto_init() -> None:
+    if not worker_context.is_initialized():
+        init()
+
+
+def context_info() -> dict:
+    rt = global_runtime()
+    return {"node_id": rt.node_id, "session_dir": rt.session_dir, "client_id": rt.client_id}
+
+
+def shutdown() -> None:
+    with _init_lock:
+        rt = worker_context.try_runtime()
+        head = worker_context.get_head()
+        if rt is None:
+            return
+        worker_context.set_runtime(None, None)
+        try:
+            rt.close()
+        except Exception:
+            pass
+        if head is not None:
+            head.shutdown()
+    try:
+        atexit.unregister(shutdown)
+    except Exception:
+        pass
+
+
+def is_initialized() -> bool:
+    return worker_context.is_initialized()
+
+
+def get_namespace() -> str:
+    return _namespace
+
+
+def remote(*args, **kwargs):
+    """``@remote`` / ``@remote(num_cpus=..., num_tpus=..., ...)``."""
+    from ray_tpu.remote_function import make_remote
+
+    if len(args) == 1 and not kwargs and callable(args[0]):
+        return make_remote(args[0], {})
+    if args:
+        raise TypeError("@remote takes keyword options only, e.g. @remote(num_cpus=2)")
+
+    def decorator(fn_or_class):
+        return make_remote(fn_or_class, kwargs)
+
+    return decorator
+
+
+def put(value: Any) -> ObjectRef:
+    auto_init()
+    return global_runtime().put(value)
+
+
+def get(refs: ObjectRef | Sequence[ObjectRef], *, timeout: float | None = None):
+    auto_init()
+    return global_runtime().get(refs, timeout=timeout)
+
+
+def wait(
+    refs: Sequence[ObjectRef],
+    *,
+    num_returns: int = 1,
+    timeout: float | None = None,
+    fetch_local: bool = True,
+):
+    if isinstance(refs, ObjectRef):
+        raise TypeError("wait() expects a list of ObjectRefs")
+    auto_init()
+    return global_runtime().wait(refs, num_returns=num_returns, timeout=timeout)
+
+
+def kill(actor_handle, *, no_restart: bool = True) -> None:
+    rt = global_runtime()
+    rt.conn.call("kill_actor", {"actor_id": actor_handle._actor_id, "no_restart": no_restart})
+
+
+def cancel(ref: ObjectRef, *, force: bool = False) -> None:
+    rt = global_runtime()
+    # Map the return ref back to its task via the head's task table.
+    rt.conn.call("cancel_task", {"task_id": ref.hex(), "force": force})
+
+
+def get_actor(name: str, namespace: str | None = None):
+    from ray_tpu.actor import ActorHandle
+
+    rt = global_runtime()
+    reply = rt.conn.call(
+        "get_named_actor",
+        {"name": name, "namespace": namespace if namespace is not None else _namespace},
+    )
+    return ActorHandle(reply["actor_id"])
+
+
+def cluster_resources() -> dict[str, float]:
+    return global_runtime().conn.call("cluster_resources", {})["total"]
+
+
+def available_resources() -> dict[str, float]:
+    return global_runtime().conn.call("cluster_resources", {})["available"]
+
+
+def nodes() -> list[dict]:
+    return global_runtime().conn.call("get_nodes", {})["nodes"]
+
+
+def free(refs: Sequence[ObjectRef], *, force: bool = False) -> None:
+    global_runtime().free(refs, force=force)
+
+
+class RuntimeContext:
+    """Reference analogue: ray.runtime_context.RuntimeContext."""
+
+    @property
+    def node_id(self) -> str:
+        ctx = worker_context.get_task_context()
+        return ctx.node_id or global_runtime().node_id
+
+    def get_task_id(self) -> str:
+        return worker_context.get_task_context().task_id
+
+    def get_actor_id(self) -> str | None:
+        return worker_context.get_task_context().actor_id
+
+    def get_node_id(self) -> str:
+        return self.node_id
+
+    @property
+    def namespace(self) -> str:
+        return _namespace
+
+
+def get_runtime_context() -> RuntimeContext:
+    return RuntimeContext()

@@ -1,0 +1,67 @@
+"""User-facing exception types.
+
+Counterpart of the reference's python/ray/exceptions.py (RayTaskError,
+RayActorError, ObjectLostError, GetTimeoutError, WorkerCrashedError, ...).
+"""
+
+from __future__ import annotations
+
+
+class RayTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class TaskError(RayTpuError):
+    """A task raised an exception remotely; re-raised at `get`.
+
+    Reference analogue: ray.exceptions.RayTaskError — carries the remote
+    traceback string so the user sees the true failure site.
+    """
+
+    def __init__(self, cause_repr: str, remote_traceback: str, task_name: str = ""):
+        self.cause_repr = cause_repr
+        self.remote_traceback = remote_traceback
+        self.task_name = task_name
+        super().__init__(
+            f"task {task_name or '<unknown>'} failed: {cause_repr}\n"
+            f"--- remote traceback ---\n{remote_traceback}"
+        )
+
+    def __reduce__(self):
+        return (TaskError, (self.cause_repr, self.remote_traceback, self.task_name))
+
+
+class WorkerCrashedError(RayTpuError):
+    """The worker process executing the task died unexpectedly."""
+
+
+class ActorError(RayTpuError):
+    """Base for actor-related failures."""
+
+
+class ActorDiedError(ActorError):
+    """The actor is dead; pending and future calls fail with this."""
+
+
+class ActorUnavailableError(ActorError):
+    """The actor is temporarily unreachable (e.g. restarting)."""
+
+
+class ObjectLostError(RayTpuError):
+    """The object's value was lost and could not be reconstructed."""
+
+
+class ObjectStoreFullError(RayTpuError):
+    """Allocation failed even after spilling."""
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    """`get` exceeded its timeout."""
+
+
+class PlacementGroupUnschedulableError(RayTpuError):
+    """The placement group cannot fit on the cluster."""
+
+
+class RuntimeEnvSetupError(RayTpuError):
+    """Preparing the task/actor runtime environment failed."""

@@ -1,0 +1,96 @@
+"""@ray_tpu.remote on functions.
+
+Counterpart of the reference's RemoteFunction
+(reference: python/ray/remote_function.py:303 `_remote`; decorator at
+python/ray/_private/worker.py:3267).
+"""
+
+from __future__ import annotations
+
+import os
+import uuid
+from typing import Any
+
+from ray_tpu._private.config import GLOBAL_CONFIG
+from ray_tpu._private.ids import ObjectRef
+from ray_tpu._private.task_spec import TaskSpec
+from ray_tpu._private.worker_context import global_runtime
+
+
+def _normalize_resources(
+    num_cpus: float | None,
+    num_tpus: float | None,
+    memory: float | None,
+    resources: dict[str, float] | None,
+    default_cpus: float = 1.0,
+) -> dict[str, float]:
+    res = dict(resources or {})
+    res["CPU"] = float(default_cpus if num_cpus is None else num_cpus)
+    if num_tpus:
+        res["TPU"] = float(num_tpus)
+    if memory:
+        res["memory"] = float(memory)
+    return {k: v for k, v in res.items() if v}
+
+
+class RemoteFunction:
+    def __init__(self, fn, **task_options):
+        self._fn = fn
+        self._opts = task_options
+        self.__name__ = getattr(fn, "__name__", "remote_fn")
+        self.__doc__ = getattr(fn, "__doc__", None)
+
+    def options(self, **overrides) -> "RemoteFunction":
+        opts = dict(self._opts)
+        opts.update(overrides)
+        return RemoteFunction(self._fn, **opts)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"remote function {self.__name__} cannot be called directly; "
+            f"use {self.__name__}.remote()"
+        )
+
+    def remote(self, *args, **kwargs):
+        from ray_tpu import api
+
+        api.auto_init()
+        rt = global_runtime()
+        opts = self._opts
+        num_returns = int(opts.get("num_returns", 1))
+        func_id = rt.register_function(self._fn)
+        packed, deps = rt.pack_args(args, kwargs)
+        return_ids = [os.urandom(16).hex() for _ in range(num_returns)]
+        spec = TaskSpec(
+            task_id="task-" + uuid.uuid4().hex[:12],
+            name=opts.get("name", self.__name__),
+            func_id=func_id,
+            args=packed,
+            deps=deps,
+            return_ids=return_ids,
+            resources=_normalize_resources(
+                opts.get("num_cpus"),
+                opts.get("num_tpus") or opts.get("num_gpus"),
+                opts.get("memory"),
+                opts.get("resources"),
+            ),
+            owner_id=rt.client_id,
+            max_retries=int(
+                opts.get("max_retries", GLOBAL_CONFIG.task_max_retries_default)
+            ),
+            scheduling_strategy=opts.get("scheduling_strategy"),
+            runtime_env=opts.get("runtime_env"),
+        )
+        rt.submit_task(spec)
+        refs = [ObjectRef(oid, _owned=True) for oid in return_ids]
+        return refs[0] if num_returns == 1 else refs
+
+
+def make_remote(fn_or_class: Any, options: dict):
+    import inspect
+
+    from ray_tpu.actor import ActorClass
+
+    if inspect.isclass(fn_or_class):
+        return ActorClass(fn_or_class, **options)
+    return RemoteFunction(fn_or_class, **options)

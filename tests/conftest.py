@@ -1,0 +1,43 @@
+"""Test configuration.
+
+JAX tests run on a virtual 8-device CPU mesh (multi-host TPU topology is
+simulated the way the reference simulates multi-node clusters with in-process
+fixtures — SURVEY.md §4 "lesson"). Must be set before jax is imported
+anywhere in the process; worker subprocesses inherit the env and therefore
+also stay off the real TPU.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def ray_start():
+    """Fresh single-node cluster per test (reference analogue:
+    ray_start_regular in python/ray/tests/conftest.py:580)."""
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4, object_store_memory=64 * 1024 * 1024)
+    yield
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(scope="module")
+def ray_start_shared():
+    """Shared cluster for cheap read-only tests."""
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4, object_store_memory=64 * 1024 * 1024, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()

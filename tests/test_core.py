@@ -1,0 +1,360 @@
+"""Core runtime tests: tasks, objects, actors, fault tolerance.
+
+Modeled on the reference's python/ray/tests/test_basic*.py,
+test_actor*.py, test_reconstruction*.py coverage areas.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import (
+    ActorDiedError,
+    GetTimeoutError,
+    TaskError,
+    WorkerCrashedError,
+)
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4, object_store_memory=64 * 1024 * 1024)
+    yield
+    ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------- objects
+
+
+def test_put_get_roundtrip(cluster):
+    for value in [1, "hello", {"a": [1, 2]}, (None, True), b"\x00\xff" * 100]:
+        assert ray_tpu.get(ray_tpu.put(value)) == value
+
+
+def test_put_get_numpy_large(cluster):
+    arr = np.random.rand(512, 1024)  # 4 MiB -> shm path
+    out = ray_tpu.get(ray_tpu.put(arr))
+    np.testing.assert_array_equal(arr, out)
+
+
+def test_get_many(cluster):
+    refs = [ray_tpu.put(i) for i in range(50)]
+    assert ray_tpu.get(refs) == list(range(50))
+
+
+def test_get_timeout(cluster):
+    @ray_tpu.remote
+    def never():
+        time.sleep(60)
+
+    with pytest.raises(GetTimeoutError):
+        ray_tpu.get(never.remote(), timeout=0.2)
+
+
+# ---------------------------------------------------------------- tasks
+
+
+def test_simple_task(cluster):
+    @ray_tpu.remote
+    def f(x):
+        return x * 2
+
+    assert ray_tpu.get(f.remote(21)) == 42
+
+
+def test_task_dependencies(cluster):
+    @ray_tpu.remote
+    def f(x):
+        return x + 1
+
+    ref = f.remote(0)
+    for _ in range(5):
+        ref = f.remote(ref)
+    assert ray_tpu.get(ref) == 6
+
+
+def test_task_numpy_arg(cluster):
+    @ray_tpu.remote
+    def total(a):
+        return float(a.sum())
+
+    big = np.ones((256, 1024))
+    assert ray_tpu.get(total.remote(ray_tpu.put(big))) == big.size
+
+
+def test_multi_return(cluster):
+    @ray_tpu.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    a, b, c = three.remote()
+    assert ray_tpu.get([a, b, c]) == [1, 2, 3]
+
+
+def test_task_error_propagates(cluster):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("kaboom")
+
+    with pytest.raises(TaskError, match="kaboom"):
+        ray_tpu.get(boom.remote())
+
+
+def test_error_propagates_through_dependency(cluster):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("root cause")
+
+    @ray_tpu.remote
+    def consume(x):
+        return x
+
+    # The consumer receives the error when resolving its arg and fails too.
+    with pytest.raises(TaskError, match="root cause"):
+        ray_tpu.get(consume.remote(boom.remote()))
+
+
+def test_parallel_execution(cluster):
+    @ray_tpu.remote
+    def slow():
+        time.sleep(0.5)
+        return 1
+
+    t0 = time.time()
+    assert sum(ray_tpu.get([slow.remote() for _ in range(4)])) == 4
+    assert time.time() - t0 < 1.9  # 4 serial would be >= 2.0
+
+
+def test_task_options(cluster):
+    @ray_tpu.remote
+    def whoami():
+        return os.getpid()
+
+    assert isinstance(ray_tpu.get(whoami.options(num_cpus=2).remote()), int)
+
+
+def test_runtime_env_env_vars(cluster):
+    @ray_tpu.remote(runtime_env={"env_vars": {"MY_TEST_VAR": "abc"}})
+    def read_env():
+        return os.environ.get("MY_TEST_VAR")
+
+    assert ray_tpu.get(read_env.remote()) == "abc"
+
+
+def test_nested_tasks(cluster):
+    @ray_tpu.remote
+    def inner(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def outer(x):
+        return ray_tpu.get(inner.remote(x)) + 10
+
+    assert ray_tpu.get(outer.remote(0)) == 11
+
+
+# ---------------------------------------------------------------- wait
+
+
+def test_wait_basic(cluster):
+    @ray_tpu.remote
+    def quick(i):
+        return i
+
+    refs = [quick.remote(i) for i in range(4)]
+    ready, not_ready = ray_tpu.wait(refs, num_returns=4, timeout=10)
+    assert len(ready) == 4 and not not_ready
+
+
+def test_wait_partial(cluster):
+    @ray_tpu.remote
+    def slow():
+        time.sleep(30)
+
+    @ray_tpu.remote
+    def quick():
+        return 1
+
+    r_slow, r_quick = slow.remote(), quick.remote()
+    ready, not_ready = ray_tpu.wait([r_slow, r_quick], num_returns=1, timeout=10)
+    assert ready == [r_quick] and not_ready == [r_slow]
+    ray_tpu.cancel(r_slow)
+
+
+# ---------------------------------------------------------------- actors
+
+
+def test_actor_basic(cluster):
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self, start):
+            self.v = start
+
+        def inc(self, k=1):
+            self.v += k
+            return self.v
+
+    c = Counter.remote(100)
+    assert ray_tpu.get(c.inc.remote()) == 101
+    assert ray_tpu.get(c.inc.remote(9)) == 110
+
+
+def test_actor_ordering(cluster):
+    @ray_tpu.remote
+    class Log:
+        def __init__(self):
+            self.items = []
+
+        def append(self, x):
+            self.items.append(x)
+
+        def get(self):
+            return self.items
+
+    log = Log.remote()
+    for i in range(20):
+        log.append.remote(i)
+    assert ray_tpu.get(log.get.remote()) == list(range(20))
+
+
+def test_named_actor(cluster):
+    @ray_tpu.remote
+    class Svc:
+        def ping(self):
+            return "pong"
+
+    Svc.options(name="svc-test").remote()
+    handle = ray_tpu.get_actor("svc-test")
+    assert ray_tpu.get(handle.ping.remote()) == "pong"
+
+
+def test_actor_handle_passing(cluster):
+    @ray_tpu.remote
+    class Store:
+        def __init__(self):
+            self.v = {}
+
+        def set(self, k, v):
+            self.v[k] = v
+
+        def get(self, k):
+            return self.v.get(k)
+
+    @ray_tpu.remote
+    def writer(store):
+        ray_tpu.get(store.set.remote("from", "task"))
+        return True
+
+    s = Store.remote()
+    assert ray_tpu.get(writer.remote(s))
+    assert ray_tpu.get(s.get.remote("from")) == "task"
+
+
+def test_actor_error(cluster):
+    @ray_tpu.remote
+    class Bad:
+        def fail(self):
+            raise RuntimeError("actor method failed")
+
+    b = Bad.remote()
+    with pytest.raises(TaskError, match="actor method failed"):
+        ray_tpu.get(b.fail.remote())
+
+
+def test_actor_death_and_restart(cluster):
+    @ray_tpu.remote(max_restarts=1)
+    class Fragile:
+        def crash(self):
+            os._exit(1)
+
+        def ping(self):
+            return "ok"
+
+    f = Fragile.remote()
+    assert ray_tpu.get(f.ping.remote()) == "ok"
+    with pytest.raises(ActorDiedError):
+        ray_tpu.get(f.crash.remote(), timeout=20)
+    assert ray_tpu.get(f.ping.remote(), timeout=30) == "ok"
+
+
+def test_kill_actor(cluster):
+    @ray_tpu.remote
+    class Victim:
+        def ping(self):
+            return 1
+
+    v = Victim.remote()
+    assert ray_tpu.get(v.ping.remote()) == 1
+    ray_tpu.kill(v)
+    time.sleep(0.5)
+    with pytest.raises(ActorDiedError):
+        ray_tpu.get(v.ping.remote(), timeout=20)
+
+
+def test_actor_max_concurrency(cluster):
+    @ray_tpu.remote(max_concurrency=4)
+    class Conc:
+        def ready(self):
+            return True
+
+        def slow(self):
+            time.sleep(0.6)
+            return 1
+
+    c = Conc.remote()
+    ray_tpu.get(c.ready.remote(), timeout=20)  # wait for startup
+    t0 = time.time()
+    assert sum(ray_tpu.get([c.slow.remote() for _ in range(4)], timeout=20)) == 4
+    assert time.time() - t0 < 2.0  # serial would be 2.4s
+
+
+# ---------------------------------------------------------------- fault tolerance
+
+
+def test_task_retry_on_worker_crash(cluster, tmp_path):
+    marker = str(tmp_path / "marker")
+
+    @ray_tpu.remote(max_retries=2)
+    def flaky():
+        if not os.path.exists(marker):
+            open(marker, "w").write("x")
+            os._exit(1)
+        return "recovered"
+
+    assert ray_tpu.get(flaky.remote(), timeout=40) == "recovered"
+
+
+def test_no_retry_fails(cluster):
+    @ray_tpu.remote(max_retries=0)
+    def die():
+        os._exit(1)
+
+    with pytest.raises(WorkerCrashedError):
+        ray_tpu.get(die.remote(), timeout=40)
+
+
+# ---------------------------------------------------------------- cluster info
+
+
+def test_cluster_resources(cluster):
+    total = ray_tpu.cluster_resources()
+    assert total["CPU"] == 4.0
+    assert "memory" in total
+
+
+def test_nodes(cluster):
+    nodes = ray_tpu.nodes()
+    assert len(nodes) == 1 and nodes[0]["alive"]
+
+
+def test_runtime_context_in_task(cluster):
+    @ray_tpu.remote
+    def ctx():
+        c = ray_tpu.get_runtime_context()
+        return c.get_task_id(), c.get_node_id()
+
+    task_id, node_id = ray_tpu.get(ctx.remote())
+    assert task_id.startswith("task-") and node_id.startswith("node-")

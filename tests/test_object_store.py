@@ -1,0 +1,122 @@
+"""Object store tests: arena allocator, serialization, spilling, refcounts.
+
+Reference coverage analogue: plasma tests + python/ray/tests/test_object_spilling.py.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private import serialization
+from ray_tpu._private.shm_store import ShmArena
+
+
+# ---------------------------------------------------------------- arena (C++)
+
+
+def test_arena_alloc_free_coalesce():
+    arena = ShmArena("/test_arena_1", 1 << 20)
+    try:
+        a = arena.alloc(1000)
+        b = arena.alloc(2000)
+        c = arena.alloc(3000)
+        assert {a, b, c} and len({a, b, c}) == 3
+        assert arena.in_use >= 6000
+        arena.free(b)
+        arena.free(a)
+        arena.free(c)
+        assert arena.in_use == 0
+        # After coalescing the full capacity is one block again.
+        assert arena.largest_free == 1 << 20
+    finally:
+        arena.close()
+
+
+def test_arena_oom_returns_none():
+    arena = ShmArena("/test_arena_2", 1 << 16)
+    try:
+        assert arena.alloc(1 << 17) is None
+        x = arena.alloc(1 << 15)
+        assert x is not None
+    finally:
+        arena.close()
+
+
+def test_arena_alignment():
+    arena = ShmArena("/test_arena_3", 1 << 20)
+    try:
+        offs = [arena.alloc(1), arena.alloc(63), arena.alloc(65)]
+        assert all(o % 64 == 0 for o in offs)
+    finally:
+        arena.close()
+
+
+def test_arena_shared_visibility():
+    from ray_tpu._private.shm_store import ShmClient
+
+    arena = ShmArena("/test_arena_4", 1 << 20)
+    try:
+        off = arena.alloc(128)
+        arena.view(off, 5)[:] = b"hello"
+        client = ShmClient("/test_arena_4", 1 << 20)
+        assert bytes(client.view(off, 5)) == b"hello"
+        client.close()
+    finally:
+        arena.close()
+
+
+# ---------------------------------------------------------------- serialization
+
+
+def test_serialization_roundtrip():
+    for obj in [42, "s", [1, {"k": (2, 3)}], None, b"bytes"]:
+        assert serialization.loads(serialization.dumps(obj)) == obj
+
+
+def test_serialization_numpy_zero_copy_layout():
+    arr = np.arange(10000, dtype=np.float32)
+    data = serialization.dumps(arr)
+    out = serialization.loads(data)
+    np.testing.assert_array_equal(arr, out)
+    # Out-of-band buffer should make the payload ~ the array size, not 2x.
+    assert len(data) < arr.nbytes + 4096
+
+
+def test_serialization_mixed_buffers():
+    obj = {"a": np.ones(1000), "b": np.zeros((10, 10), dtype=np.int8), "c": "x"}
+    out = serialization.loads(serialization.dumps(obj))
+    np.testing.assert_array_equal(out["a"], obj["a"])
+    np.testing.assert_array_equal(out["b"], obj["b"])
+    assert out["c"] == "x"
+
+
+# ---------------------------------------------------------------- spilling
+
+
+def test_object_spilling_roundtrip():
+    # Store fits ~2 of the 4MiB objects; the rest must spill and restore.
+    ray_tpu.init(num_cpus=2, object_store_memory=10 * 1024 * 1024)
+    try:
+        arrays = [np.full((512, 1024), i, dtype=np.float64) for i in range(6)]
+        refs = [ray_tpu.put(a) for a in arrays]
+        for i, ref in enumerate(refs):
+            out = ray_tpu.get(ref)
+            np.testing.assert_array_equal(out, arrays[i])
+        from ray_tpu._private.worker_context import get_head
+
+        stats = get_head().arena
+        assert stats.in_use <= 10 * 1024 * 1024
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_free_objects():
+    ray_tpu.init(num_cpus=2, object_store_memory=32 * 1024 * 1024)
+    try:
+        ref = ray_tpu.put(np.ones(1_000_000))
+        ray_tpu.free([ref])
+        from ray_tpu._private.worker_context import get_head
+
+        assert get_head().arena.in_use == 0
+    finally:
+        ray_tpu.shutdown()

@@ -1,0 +1,157 @@
+"""Scheduler unit tests with fake nodes — in-process, like the reference's
+C++ scheduler tests (reference: raylet/scheduling/cluster_task_manager_test.cc,
+hybrid_scheduling_policy_test.cc pattern: fake resource views, no processes).
+"""
+
+import pytest
+
+from ray_tpu._private.scheduler import (
+    ClusterScheduler,
+    NodeAffinitySchedulingStrategy,
+    NodeEntry,
+    ResourceSet,
+)
+
+
+def make_node(node_id, cpu=8.0, tpu=0.0):
+    res = {"CPU": cpu}
+    if tpu:
+        res["TPU"] = tpu
+    return NodeEntry(
+        node_id=node_id,
+        address="10.0.0.1",
+        total=ResourceSet(res),
+        available=ResourceSet(res),
+    )
+
+
+def test_resource_set_arithmetic():
+    a = ResourceSet({"CPU": 4, "TPU": 8})
+    b = ResourceSet({"CPU": 1.5})
+    assert a.fits(b)
+    a.subtract(b)
+    assert a.get("CPU") == 2.5
+    a.add(b)
+    assert a.get("CPU") == 4.0
+
+
+def test_fractional_resources_no_drift():
+    a = ResourceSet({"CPU": 1.0})
+    d = ResourceSet({"CPU": 0.1})
+    for _ in range(10):
+        a.subtract(d)
+    assert a.get("CPU") == 0.0
+    assert a.is_empty()
+
+
+def test_pick_node_infeasible():
+    s = ClusterScheduler()
+    s.add_node(make_node("n1", cpu=2))
+    assert s.pick_node(ResourceSet({"CPU": 4})) is None
+
+
+def test_hybrid_packs_below_threshold():
+    s = ClusterScheduler(spread_threshold=0.5)
+    n1, n2 = make_node("n1"), make_node("n2")
+    s.add_node(n1)
+    s.add_node(n2)
+    # Put some load on n1 (25% — still below threshold): hybrid packs onto it.
+    s.acquire("n1", ResourceSet({"CPU": 2}))
+    pick = s.pick_node(ResourceSet({"CPU": 1}))
+    assert pick.node_id == "n1"
+
+
+def test_hybrid_spreads_above_threshold():
+    s = ClusterScheduler(spread_threshold=0.5)
+    n1, n2 = make_node("n1"), make_node("n2")
+    s.add_node(n1)
+    s.add_node(n2)
+    s.acquire("n1", ResourceSet({"CPU": 6}))  # 75% > threshold
+    pick = s.pick_node(ResourceSet({"CPU": 1}))
+    assert pick.node_id == "n2"
+
+
+def test_spread_strategy():
+    s = ClusterScheduler()
+    for i in range(3):
+        s.add_node(make_node(f"n{i}"))
+    s.acquire("n0", ResourceSet({"CPU": 4}))
+    pick = s.pick_node(ResourceSet({"CPU": 1}), strategy="SPREAD")
+    assert pick.node_id != "n0"
+
+
+def test_node_affinity():
+    s = ClusterScheduler()
+    s.add_node(make_node("n1"))
+    s.add_node(make_node("n2"))
+    strat = NodeAffinitySchedulingStrategy(node_id="n2")
+    assert s.pick_node(ResourceSet({"CPU": 1}), strat).node_id == "n2"
+    # Hard affinity to a full node fails.
+    s.acquire("n2", ResourceSet({"CPU": 8}))
+    assert s.pick_node(ResourceSet({"CPU": 1}), strat) is None
+    # Soft affinity falls back.
+    strat_soft = NodeAffinitySchedulingStrategy(node_id="n2", soft=True)
+    assert s.pick_node(ResourceSet({"CPU": 1}), strat_soft).node_id == "n1"
+
+
+def test_acquire_release():
+    s = ClusterScheduler()
+    s.add_node(make_node("n1", cpu=2))
+    d = ResourceSet({"CPU": 2})
+    assert s.acquire("n1", d)
+    assert not s.acquire("n1", ResourceSet({"CPU": 1}))
+    s.release("n1", d)
+    assert s.acquire("n1", ResourceSet({"CPU": 1}))
+
+
+# ------------------------------------------------------- placement groups
+
+
+def test_pg_strict_pack_single_node():
+    s = ClusterScheduler()
+    s.add_node(make_node("n1", cpu=8))
+    s.add_node(make_node("n2", cpu=8))
+    placement = s.place_bundles([{"CPU": 3}, {"CPU": 3}], "STRICT_PACK")
+    assert placement is not None and len(set(placement)) == 1
+
+
+def test_pg_strict_pack_infeasible():
+    s = ClusterScheduler()
+    s.add_node(make_node("n1", cpu=4))
+    s.add_node(make_node("n2", cpu=4))
+    assert s.place_bundles([{"CPU": 3}, {"CPU": 3}], "STRICT_PACK") is None
+
+
+def test_pg_strict_spread():
+    s = ClusterScheduler()
+    for i in range(3):
+        s.add_node(make_node(f"n{i}", cpu=4))
+    placement = s.place_bundles([{"CPU": 2}] * 3, "STRICT_SPREAD")
+    assert placement is not None and len(set(placement)) == 3
+    assert s.place_bundles([{"CPU": 2}] * 4, "STRICT_SPREAD") is None
+
+
+def test_pg_spread_best_effort():
+    s = ClusterScheduler()
+    s.add_node(make_node("n1", cpu=8))
+    s.add_node(make_node("n2", cpu=8))
+    placement = s.place_bundles([{"CPU": 2}] * 4, "SPREAD")
+    assert placement is not None
+    assert placement.count("n1") == 2 and placement.count("n2") == 2
+
+
+def test_pg_pack_prefers_one_node():
+    s = ClusterScheduler()
+    s.add_node(make_node("n1", cpu=8))
+    s.add_node(make_node("n2", cpu=8))
+    placement = s.place_bundles([{"CPU": 2}] * 3, "PACK")
+    assert placement is not None and len(set(placement)) == 1
+
+
+def test_pg_tpu_slice_bundles():
+    """A v4-16-style gang: 2 hosts x 4 chips, STRICT_SPREAD over hosts."""
+    s = ClusterScheduler()
+    s.add_node(make_node("host0", cpu=8, tpu=4))
+    s.add_node(make_node("host1", cpu=8, tpu=4))
+    placement = s.place_bundles([{"TPU": 4}, {"TPU": 4}], "STRICT_SPREAD")
+    assert placement is not None and len(set(placement)) == 2
