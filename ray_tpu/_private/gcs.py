@@ -42,6 +42,11 @@ from ray_tpu._private.task_spec import ActorSpec, TaskSpec
 CREATING, SEALED, SPILLED, LOST = "CREATING", "SEALED", "SPILLED", "LOST"
 # Task states (mirrors the reference's task state machine used by the state
 # API, reference: src/ray/protobuf/gcs.proto TaskStatus).
+# Sentinel: strategy resolves to "cannot place now" (e.g. its placement
+# group is still pending) — dispatch must requeue, never fall through to the
+# default policy.
+UNPLACEABLE = object()
+
 PENDING, SCHEDULED, RUNNING, FINISHED, FAILED = (
     "PENDING_ARGS_AVAIL",
     "SCHEDULED",
@@ -77,7 +82,7 @@ class ObjectEntry:
 class WorkerRecord:
     __slots__ = (
         "worker_id", "node_id", "conn", "proc", "pid", "busy", "actor_id",
-        "inflight", "started_at", "tpu_chips", "acquired", "ready",
+        "inflight", "started_at", "tpu_chips", "acquired", "ready", "pg_alloc",
     )
 
     def __init__(self, worker_id: str, node_id: str, proc):
@@ -95,6 +100,7 @@ class WorkerRecord:
         self.started_at = time.time()
         self.tpu_chips: list[int] = []
         self.acquired: ResourceSet | None = None
+        self.pg_alloc: tuple[str, int, ResourceSet] | None = None  # (pg_id, bundle, demand)
         self.ready = False  # set by worker_ready (two-phase registration)
 
 
@@ -116,7 +122,10 @@ class ActorRecord:
 
 
 class PlacementGroupRecord:
-    __slots__ = ("pg_id", "name", "bundles", "strategy", "state", "node_per_bundle", "waiters")
+    __slots__ = (
+        "pg_id", "name", "bundles", "strategy", "state", "node_per_bundle",
+        "waiters", "bundle_used",
+    )
 
     def __init__(self, pg_id: str, name: str, bundles, strategy: str):
         self.pg_id = pg_id
@@ -126,6 +135,16 @@ class PlacementGroupRecord:
         self.state = "PENDING"
         self.node_per_bundle: list[str] | None = None
         self.waiters: list[tuple[rpc.Connection, str]] = []
+        # Per-bundle resource accounting: work scheduled into a bundle
+        # consumes its reservation, bounded by the bundle size (reference:
+        # bundle resource bookkeeping in NewPlacementGroupResourceManager,
+        # raylet/placement_group_resource_manager.h:90).
+        self.bundle_used: list[ResourceSet] = [ResourceSet({}) for _ in bundles]
+
+    def bundle_fits(self, index: int, demand: ResourceSet) -> bool:
+        remaining = ResourceSet(self.bundles[index])
+        remaining.subtract(self.bundle_used[index])
+        return remaining.fits(demand)
 
 
 class Head:
@@ -238,6 +257,12 @@ class Head:
         env["RAY_TPU_HEAD"] = f"{self.address[0]}:{self.address[1]}"
         env["RAY_TPU_SHM"] = f"{self.shm_name}:{self.config.object_store_memory}"
         env["RAY_TPU_NODE_ID"] = node_id
+        # Workers resolve functions pickled by reference (module+name), so
+        # they need the driver's import roots (reference analogue: workers
+        # inherit the driver's sys.path / working_dir runtime env).
+        extra = [p for p in sys.path if p and os.path.isdir(p)]
+        existing = env.get("PYTHONPATH", "")
+        env["PYTHONPATH"] = os.pathsep.join(extra + ([existing] if existing else []))
         logs = os.path.join(self.session_dir, "logs")
         os.makedirs(logs, exist_ok=True)
         out = open(os.path.join(logs, f"{worker_id}.log"), "ab")
@@ -665,10 +690,7 @@ class Head:
             if rec.actor_id is None:
                 if not rec.inflight:
                     rec.busy = False
-                if rec.acquired is not None:
-                    self.scheduler.release(rec.node_id, rec.acquired)
-                    self._return_tpu_chips(rec)
-                    rec.acquired = None
+                self._release_worker_allocation(rec)
             else:
                 actor = self.actors.get(rec.actor_id)
                 if actor is not None and spec is not None and spec.actor_creation:
@@ -683,10 +705,7 @@ class Head:
                         # Retire the dedicated worker and return its
                         # reservation — otherwise failed creations leak
                         # CPUs/chips and a zombie process each.
-                        if rec.acquired is not None:
-                            self.scheduler.release(rec.node_id, rec.acquired)
-                            self._return_tpu_chips(rec)
-                            rec.acquired = None
+                        self._release_worker_allocation(rec)
                         if rec.conn is not None:
                             try:
                                 rec.conn.cast("kill", {})
@@ -1003,35 +1022,74 @@ class Head:
             spawned = False
             while self.task_queue:
                 spec = self.task_queue.popleft()
-                if not all(self._is_ready(d) for d in spec.deps):
-                    requeue.append(spec)
-                    continue
-                node = self.scheduler.pick_node(
-                    ResourceSet(spec.resources), self._resolve_strategy(spec)
-                )
-                if node is None:
-                    requeue.append(spec)
-                    continue
-                rec = self._idle_worker(node.node_id)
-                if rec is None:
-                    if not spawned and self._can_spawn(node.node_id):
-                        self.spawn_worker(node.node_id)
-                        spawned = True
-                    requeue.append(spec)
-                    continue
-                demand = ResourceSet(spec.resources)
-                self.scheduler.acquire(node.node_id, demand)
-                rec.acquired = demand
-                self._assign_tpu_chips(rec, spec.resources)
-                self._push_to_worker(rec, spec)
+                try:
+                    if not self._validate_strategy(spec):
+                        continue  # failed with an error object
+                    if not all(self._is_ready(d) for d in spec.deps):
+                        requeue.append(spec)
+                        continue
+                    strategy = self._resolve_strategy(spec)
+                    if strategy is UNPLACEABLE:
+                        requeue.append(spec)
+                        continue
+                    demand = self._effective_demand(spec.resources, spec.scheduling_strategy)
+                    node = self.scheduler.pick_node(demand, strategy)
+                    if node is None:
+                        requeue.append(spec)
+                        continue
+                    rec = self._idle_worker(node.node_id)
+                    if rec is None:
+                        if not spawned and self._can_spawn(node.node_id):
+                            self.spawn_worker(node.node_id)
+                            spawned = True
+                        requeue.append(spec)
+                        continue
+                    if not self._try_allocate(
+                        rec, node.node_id, spec.resources, spec.scheduling_strategy
+                    ):
+                        requeue.append(spec)
+                        continue
+                    self._push_to_worker(rec, spec)
+                except Exception:
+                    # One malformed spec must not wedge the dispatch loop or
+                    # drop the requeue of healthy tasks.
+                    traceback.print_exc()
+                    self._fail_task(spec, f"SchedulingError: {traceback.format_exc()}")
             self.task_queue = requeue
+
+    def _validate_strategy(self, spec: TaskSpec) -> bool:
+        """Fail specs with malformed strategies up front. lock held."""
+        s = spec.scheduling_strategy
+        if isinstance(s, PlacementGroupSchedulingStrategy):
+            pg_id = getattr(s.placement_group, "id", None) or s.placement_group
+            pg = self.pgs.get(pg_id)
+            if pg is None:
+                self._fail_task(spec, f"SchedulingError: unknown placement group {pg_id}")
+                return False
+            if s.placement_group_bundle_index >= len(pg.bundles):
+                self._fail_task(
+                    spec,
+                    f"SchedulingError: bundle index {s.placement_group_bundle_index} "
+                    f"out of range for {len(pg.bundles)}-bundle placement group",
+                )
+                return False
+        return True
+
+    @staticmethod
+    def _effective_demand(resources, strategy) -> ResourceSet:
+        """PG-scheduled work consumes the bundle's reservation, not fresh
+        node resources (reference semantics: tasks in a placement group use
+        reserved bundle resources)."""
+        if isinstance(strategy, PlacementGroupSchedulingStrategy):
+            return ResourceSet({})
+        return ResourceSet(resources)
 
     def _resolve_strategy(self, spec: TaskSpec):
         s = spec.scheduling_strategy
         if isinstance(s, PlacementGroupSchedulingStrategy):
             pg = self.pgs.get(getattr(s.placement_group, "id", None) or s.placement_group)
             if pg is None or pg.state != "CREATED":
-                return "___unplaceable___"  # no node matches until PG ready
+                return UNPLACEABLE
             idx = s.placement_group_bundle_index
             node_id = pg.node_per_bundle[idx if idx >= 0 else 0]
             from ray_tpu._private.scheduler import NodeAffinitySchedulingStrategy
@@ -1076,16 +1134,19 @@ class Head:
         """lock held. Reserve resources, spawn a dedicated worker, send the
         creation task once it registers."""
         spec = actor.spec
-        demand = ResourceSet(spec.resources)
-        node = self.scheduler.pick_node(demand, self._resolve_actor_strategy(spec))
-        if node is None:
+        strategy = self._resolve_actor_strategy(spec)
+        if strategy is UNPLACEABLE:
             return
-        if not self.scheduler.acquire(node.node_id, demand):
+        demand = self._effective_demand(spec.resources, spec.scheduling_strategy)
+        node = self.scheduler.pick_node(demand, strategy)
+        if node is None:
             return
         rec = self.spawn_worker(node.node_id)
         rec.actor_id = spec.actor_id
-        rec.acquired = demand
-        self._assign_tpu_chips(rec, spec.resources)
+        if not self._try_allocate(rec, node.node_id, spec.resources, spec.scheduling_strategy):
+            rec.proc.kill()
+            self.workers.pop(rec.worker_id, None)
+            return
         actor.state = "STARTING"
         actor.worker_id = rec.worker_id
         actor.node_id = node.node_id
@@ -1152,16 +1213,67 @@ class Head:
             except rpc.ConnectionLost:
                 pass
 
+    def _try_allocate(self, rec: WorkerRecord, node_id: str, resources: dict, strategy) -> bool:
+        """lock held. Reserve resources for `rec` from the node pool, or from
+        the placement-group bundle when PG-scheduled. Assigns TPU chips;
+        rolls back on partial failure."""
+        demand = ResourceSet(resources)
+        if isinstance(strategy, PlacementGroupSchedulingStrategy):
+            pg_id = getattr(strategy.placement_group, "id", None) or strategy.placement_group
+            pg = self.pgs.get(pg_id)
+            if pg is None or pg.state != "CREATED":
+                return False
+            idx = strategy.placement_group_bundle_index
+            if idx < 0:
+                idx = next(
+                    (i for i in range(len(pg.bundles)) if pg.bundle_fits(i, demand)), -1
+                )
+                if idx < 0:
+                    return False
+            if not pg.bundle_fits(idx, demand):
+                return False
+            if not self._assign_tpu_chips(rec, resources):
+                return False
+            pg.bundle_used[idx].add(demand)
+            rec.pg_alloc = (pg_id, idx, demand)
+            return True
+        if not self.scheduler.acquire(node_id, demand):
+            return False
+        if not self._assign_tpu_chips(rec, resources):
+            self.scheduler.release(node_id, demand)
+            return False
+        rec.acquired = demand
+        return True
+
+    def _release_worker_allocation(self, rec: WorkerRecord) -> None:
+        """lock held. Return node or PG-bundle resources + chips."""
+        if rec.acquired is not None:
+            self.scheduler.release(rec.node_id, rec.acquired)
+            rec.acquired = None
+        if rec.pg_alloc is not None:
+            pg_id, idx, demand = rec.pg_alloc
+            pg = self.pgs.get(pg_id)
+            if pg is not None and idx < len(pg.bundle_used):
+                pg.bundle_used[idx].subtract(demand)
+            rec.pg_alloc = None
+        self._return_tpu_chips(rec)
+
     # TPU chip visibility assignment (reference semantics:
     # _private/accelerators/tpu.py set_current_process_visible_accelerator_ids
     # :193 — TPU_VISIBLE_CHIPS) handled at dispatch.
-    def _assign_tpu_chips(self, rec: WorkerRecord, resources: dict[str, float]) -> None:
+    def _assign_tpu_chips(self, rec: WorkerRecord, resources: dict[str, float]) -> bool:
+        """Returns False if the chip pool cannot cover the request — callers
+        must treat that as unschedulable, never run with fewer chips than
+        the resource contract promised."""
         n = int(resources.get("TPU", 0))
         if n <= 0:
-            return
+            return True
         pool = self.tpu_chip_pool.get(rec.node_id, [])
+        if len(pool) < n:
+            return False
         rec.tpu_chips = pool[:n]
         self.tpu_chip_pool[rec.node_id] = pool[n:]
+        return True
 
     def _return_tpu_chips(self, rec: WorkerRecord) -> None:
         if rec.tpu_chips:
@@ -1179,10 +1291,7 @@ class Head:
         (gcs/gcs_server/gcs_actor_manager.h:96 max_restarts)."""
         with self.lock:
             self.workers.pop(rec.worker_id, None)
-            if rec.acquired is not None:
-                self.scheduler.release(rec.node_id, rec.acquired)
-                self._return_tpu_chips(rec)
-                rec.acquired = None
+            self._release_worker_allocation(rec)
             inflight = list(rec.inflight.values())
             rec.inflight = {}
             if rec.actor_id is not None:

@@ -1,0 +1,57 @@
+"""ray_tpu.train — distributed training on TPU.
+
+Public surface mirrors the reference's ``ray.train`` (+``ray.train.torch``
+replaced by the JAX backend):
+
+    from ray_tpu.train import (JaxTrainer, ScalingConfig, RunConfig,
+                               Checkpoint, report, get_checkpoint, get_context)
+"""
+
+from ray_tpu.train.backend import Backend, BackendConfig, JaxBackend, JaxConfig
+from ray_tpu.train.checkpoint import (
+    Checkpoint,
+    CheckpointManager,
+    load_pytree,
+    save_pytree,
+)
+from ray_tpu.train.config import (
+    CheckpointConfig,
+    FailureConfig,
+    Result,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.train.session import (
+    TrainContext,
+    get_checkpoint,
+    get_context,
+    get_dataset_shard,
+    make_temp_checkpoint_dir,
+    report,
+)
+from ray_tpu.train.trainer import JaxTrainer
+from ray_tpu.train import jax_utils
+
+__all__ = [
+    "Backend",
+    "BackendConfig",
+    "Checkpoint",
+    "CheckpointConfig",
+    "CheckpointManager",
+    "FailureConfig",
+    "JaxBackend",
+    "JaxConfig",
+    "JaxTrainer",
+    "Result",
+    "RunConfig",
+    "ScalingConfig",
+    "TrainContext",
+    "get_checkpoint",
+    "get_context",
+    "get_dataset_shard",
+    "jax_utils",
+    "load_pytree",
+    "make_temp_checkpoint_dir",
+    "report",
+    "save_pytree",
+]

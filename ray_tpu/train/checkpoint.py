@@ -1,0 +1,159 @@
+"""Checkpoint abstraction + top-k retention manager.
+
+Counterpart of the reference's Checkpoint (train/_checkpoint.py:56 — a
+directory + to/from_directory) and _CheckpointManager
+(train/_internal/checkpoint_manager.py:43 — top-k by score). Storage is a
+filesystem path (fsspec/cloud URIs are a later round; the StorageContext
+analogue is RunConfig.resolved_storage_path).
+
+For JAX state, prefer `save_pytree`/`load_pytree` (orbax under the hood,
+async-capable) over hand-pickling — checkpoint/restore speed bounds elastic
+recovery on TPU (SURVEY.md §7 "hard parts" (c)).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tempfile
+from typing import Any
+
+
+class Checkpoint:
+    """A directory of training state."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(os.path.abspath(path))
+
+    def to_directory(self, dest: str | None = None) -> str:
+        dest = dest or tempfile.mkdtemp(prefix="rtpu_ckpt_")
+        if os.path.abspath(dest) != os.path.abspath(self.path):
+            shutil.copytree(self.path, dest, dirs_exist_ok=True)
+        return dest
+
+    def as_directory(self):
+        """Context manager yielding a readable directory path."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _ctx():
+            yield self.path
+
+        return _ctx()
+
+    def __repr__(self):
+        return f"Checkpoint({self.path})"
+
+    # --- jax pytree helpers ---
+
+    def save_pytree(self, state: Any, name: str = "state") -> None:
+        save_pytree(state, os.path.join(self.path, name))
+
+    def load_pytree(self, target: Any = None, name: str = "state") -> Any:
+        return load_pytree(os.path.join(self.path, name), target)
+
+
+def save_pytree(state: Any, path: str) -> None:
+    """Orbax-backed pytree save (works for flax/optax/jax state)."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    if os.path.exists(path):
+        shutil.rmtree(path)
+    with ocp.PyTreeCheckpointer() as ckptr:
+        ckptr.save(path, state)
+
+
+def load_pytree(path: str, target: Any = None) -> Any:
+    import orbax.checkpoint as ocp
+
+    with ocp.PyTreeCheckpointer() as ckptr:
+        if target is not None:
+            return ckptr.restore(os.path.abspath(path), item=target)
+        return ckptr.restore(os.path.abspath(path))
+
+
+class CheckpointManager:
+    """Top-k retention over a storage directory (reference:
+    _internal/checkpoint_manager.py:43)."""
+
+    def __init__(
+        self,
+        storage_path: str,
+        num_to_keep: int | None = None,
+        score_attribute: str | None = None,
+        score_order: str = "max",
+    ):
+        self.storage_path = storage_path
+        self.num_to_keep = num_to_keep
+        self.score_attribute = score_attribute
+        self.score_order = score_order
+        self._records: list[dict] = []  # {path, score, index, metrics}
+        self._index = 0
+        os.makedirs(storage_path, exist_ok=True)
+
+    def register(self, checkpoint_dir: str, metrics: dict | None = None) -> Checkpoint:
+        """Move a freshly written checkpoint into managed storage."""
+        metrics = metrics or {}
+        dest = os.path.join(self.storage_path, f"checkpoint_{self._index:06d}")
+        if os.path.abspath(checkpoint_dir) != dest:
+            if os.path.exists(dest):
+                shutil.rmtree(dest)
+            shutil.move(checkpoint_dir, dest)
+        score = metrics.get(self.score_attribute) if self.score_attribute else None
+        self._records.append(
+            {"path": dest, "score": score, "index": self._index, "metrics": metrics}
+        )
+        self._index += 1
+        self._save_manifest()
+        self._enforce_retention()
+        return Checkpoint(dest)
+
+    def _enforce_retention(self) -> None:
+        if self.num_to_keep is None or len(self._records) <= self.num_to_keep:
+            return
+        # Keep best k by score; unscored checkpoints rank BELOW every scored
+        # one (they only survive when fewer than k scored exist), latest
+        # breaks ties.
+        def sort_key(r):
+            if r["score"] is None:
+                return (0, 0.0, r["index"])
+            value = r["score"] if self.score_order == "max" else -r["score"]
+            return (1, value, r["index"])
+
+        ranked = sorted(self._records, key=sort_key, reverse=True)
+        keep = set(id(r) for r in ranked[: self.num_to_keep])
+        for r in list(self._records):
+            if id(r) not in keep:
+                shutil.rmtree(r["path"], ignore_errors=True)
+                self._records.remove(r)
+        self._save_manifest()
+
+    def _save_manifest(self) -> None:
+        manifest = [
+            {k: v for k, v in r.items() if k != "metrics"} | {"metrics": r["metrics"]}
+            for r in self._records
+        ]
+        with open(os.path.join(self.storage_path, "manifest.json"), "w") as f:
+            json.dump(manifest, f, default=str)
+
+    @property
+    def latest(self) -> Checkpoint | None:
+        if not self._records:
+            return None
+        return Checkpoint(max(self._records, key=lambda r: r["index"])["path"])
+
+    @property
+    def best(self) -> Checkpoint | None:
+        if not self._records:
+            return None
+        scored = [r for r in self._records if r["score"] is not None]
+        if not scored:
+            return self.latest
+        best = (max if self.score_order == "max" else min)(scored, key=lambda r: r["score"])
+        return Checkpoint(best["path"])

@@ -1,0 +1,90 @@
+"""Train configuration dataclasses.
+
+Counterpart of the reference's air/config.py (ScalingConfig, RunConfig,
+FailureConfig, CheckpointConfig) and train/base_trainer.py Result handling
+(air/result.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any
+
+
+@dataclasses.dataclass
+class ScalingConfig:
+    """How to scale training (reference: air/config.py ScalingConfig).
+
+    TPU-native semantics:
+      - ``num_workers``: worker processes. On a multi-host pod: one per host
+        (each host drives its local chips; cross-host sync over ICI/DCN).
+      - ``use_tpu`` + ``tpus_per_worker``: chips reserved and made visible
+        per worker (TPU_VISIBLE_CHIPS pinning).
+      - ``topology="mesh"``: single-controller SPMD — ONE worker owns every
+        local chip and the train loop runs under pjit/shard_map on a Mesh.
+        This is the idiomatic hot path (SURVEY.md §7); multi-worker mode
+        exists for host-level parallelism (env runners, data loaders) and
+        multi-host process-per-host layouts.
+    """
+
+    num_workers: int = 1
+    use_tpu: bool = False
+    tpus_per_worker: float | None = None
+    cpus_per_worker: float | None = None
+    resources_per_worker: dict[str, float] | None = None
+    topology: str = "workers"  # "workers" | "mesh"
+    placement_strategy: str = "PACK"
+
+    def worker_resources(self) -> dict[str, float]:
+        res = dict(self.resources_per_worker or {})
+        res.setdefault("CPU", self.cpus_per_worker if self.cpus_per_worker is not None else 1.0)
+        if self.use_tpu:
+            res.setdefault("TPU", self.tpus_per_worker if self.tpus_per_worker is not None else 1.0)
+        return res
+
+
+@dataclasses.dataclass
+class FailureConfig:
+    """Reference: air/config.py FailureConfig. max_failures<0 = infinite."""
+
+    max_failures: int = 0
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    """Reference: air/config.py CheckpointConfig (top-k retention)."""
+
+    num_to_keep: int | None = None
+    checkpoint_score_attribute: str | None = None
+    checkpoint_score_order: str = "max"  # "max" | "min"
+
+
+@dataclasses.dataclass
+class RunConfig:
+    """Reference: air/config.py RunConfig."""
+
+    name: str | None = None
+    storage_path: str | None = None
+    failure_config: FailureConfig | None = None
+    checkpoint_config: CheckpointConfig | None = None
+
+    def resolved_storage_path(self) -> str:
+        base = self.storage_path or os.path.expanduser("~/ray_tpu_results")
+        name = self.name or "train_run"
+        return os.path.join(base, name)
+
+
+@dataclasses.dataclass
+class Result:
+    """Outcome of a run (reference: air/result.py Result)."""
+
+    metrics: dict[str, Any]
+    checkpoint: "Any | None"  # Checkpoint
+    path: str
+    metrics_history: list[dict[str, Any]] = dataclasses.field(default_factory=list)
+    error: Exception | None = None
+
+    @property
+    def best_checkpoints(self):
+        return [self.checkpoint] if self.checkpoint else []

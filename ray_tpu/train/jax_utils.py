@@ -1,0 +1,80 @@
+"""JAX helpers for the per-worker training loop.
+
+The counterparts of the reference's prepare_model/prepare_data_loader
+(reference: train/torch/train_loop_utils.py:163,493 — DDP/FSDP wrapping),
+reshaped for JAX: instead of wrapping a module, these prepare *pytrees* and
+*gradient sync* for the chosen parallelism mode.
+
+Modes:
+  - In-process mesh (topology="mesh"): don't use these — shard with
+    NamedSharding/pjit and let XLA insert collectives (ray_tpu.parallel).
+  - Process-per-host DP: `allreduce_gradients` averages grad pytrees across
+    workers via the host collective group; on a real pod the same loop can
+    use jax.distributed + in-jit psum instead.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def sync_model_params(params, group_name: str = None):
+    """Broadcast rank 0's params to all workers (reference analogue: DDP's
+    initial parameter broadcast)."""
+    import jax
+
+    from ray_tpu.train.session import get_session
+    from ray_tpu.util import collective
+
+    session = get_session()
+    if session.world_size == 1:
+        return params
+    group = collective.get_group(group_name or f"train-{session.experiment_name}")
+    leaves, treedef = jax.tree.flatten(params)
+    synced = [group.broadcast(np.asarray(leaf), src=0) for leaf in leaves]
+    return jax.tree.unflatten(treedef, [jax.numpy.asarray(s) for s in synced])
+
+
+def allreduce_gradients(grads, group_name: str = None, op: str = "mean"):
+    """Average gradient pytrees across DP workers.
+
+    All leaves are packed into ONE flat buffer per call (bucketing — same
+    motivation as DDP gradient buckets) so the collective count per step is
+    1, not n_layers.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.train.session import get_session
+    from ray_tpu.util import collective
+
+    session = get_session()
+    if session.world_size == 1:
+        return grads
+    group = collective.get_group(group_name or f"train-{session.experiment_name}")
+    leaves, treedef = jax.tree.flatten(grads)
+    # One flat f32 buffer for the wire; each leaf's own dtype is restored on
+    # unpack so bf16 training loops keep bf16 grads (reduction in f32 is the
+    # standard numerically-safe choice).
+    shapes = [l.shape for l in leaves]
+    dtypes = [np.asarray(l).dtype for l in leaves]
+    sizes = [int(np.prod(s)) if s else 1 for s in shapes]
+    flat = np.concatenate(
+        [np.asarray(l).astype(np.float32, copy=False).ravel() for l in leaves]
+    )
+    reduced = group.allreduce(flat, op=op)
+    out, pos = [], 0
+    for shape, size, dtype in zip(shapes, sizes, dtypes):
+        out.append(jnp.asarray(reduced[pos : pos + size].reshape(shape).astype(dtype)))
+        pos += size
+    return jax.tree.unflatten(treedef, out)
+
+
+def barrier(group_name: str = None):
+    from ray_tpu.train.session import get_session
+    from ray_tpu.util import collective
+
+    session = get_session()
+    if session.world_size == 1:
+        return
+    collective.get_group(group_name or f"train-{session.experiment_name}").barrier()

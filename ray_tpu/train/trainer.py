@@ -1,0 +1,130 @@
+"""JaxTrainer: the TPU-native DataParallelTrainer.
+
+Counterpart of the reference's DataParallelTrainer/TorchTrainer path
+(reference: train/data_parallel_trainer.py:26 — training_loop :427;
+torch/torch_trainer.py:11; fit entry base_trainer.py:651), redesigned as a
+standalone Train-v2-style controller (reference:
+train/v2/_internal/execution/controller/controller.py:91) so training does
+not route through Tune (SURVEY.md §7 build-order note).
+
+The per-worker loop runs JAX: on one worker per host, in-jit collectives
+(psum under shard_map / pjit shardings) carry gradients over ICI; the
+host-level collective group carries control-plane sync. With
+``topology="mesh"`` a single worker drives every local chip as a Mesh —
+the idiomatic single-controller SPMD mode.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Any, Callable
+
+import ray_tpu
+from ray_tpu.exceptions import RayTpuError
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import (
+    CheckpointConfig,
+    FailureConfig,
+    Result,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.train.worker_group import RunStateActor, WorkerGroup
+
+
+class JaxTrainer:
+    def __init__(
+        self,
+        train_loop_per_worker: Callable,
+        *,
+        train_loop_config: dict | None = None,
+        scaling_config: ScalingConfig | None = None,
+        run_config: RunConfig | None = None,
+        backend_config=None,
+        datasets: dict[str, Any] | None = None,
+    ):
+        from ray_tpu.train.backend import JaxConfig
+
+        self.train_loop_per_worker = train_loop_per_worker
+        self.train_loop_config = train_loop_config
+        self.scaling_config = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        self.backend_config = backend_config if backend_config is not None else JaxConfig()
+        self.datasets = datasets or {}
+
+    # ------------------------------------------------------------------
+
+    def _dataset_shards(self, n: int) -> list[dict[str, Any]] | None:
+        """Split datasets across workers (reference analogue: DataConfig +
+        streaming_split, train/_internal/data_config.py:12)."""
+        if not self.datasets:
+            return None
+        shards: list[dict[str, Any]] = [dict() for _ in range(n)]
+        for name, ds in self.datasets.items():
+            if hasattr(ds, "streaming_split"):
+                for i, shard in enumerate(ds.streaming_split(n)):
+                    shards[i][name] = shard
+            elif hasattr(ds, "split"):
+                for i, shard in enumerate(ds.split(n)):
+                    shards[i][name] = shard
+            else:
+                for i in range(n):
+                    shards[i][name] = ds
+        return shards
+
+    def fit(self) -> Result:
+        ray_tpu.api.auto_init()
+        scaling = self.scaling_config
+        if scaling.topology == "mesh" and scaling.num_workers != 1:
+            raise ValueError("topology='mesh' uses a single controller worker")
+        name = self.run_config.name or f"JaxTrainer_{uuid.uuid4().hex[:6]}"
+        storage = self.run_config.resolved_storage_path()
+        failure_config = self.run_config.failure_config or FailureConfig()
+        ckpt_config = self.run_config.checkpoint_config or CheckpointConfig()
+
+        state = RunStateActor.remote(storage, ckpt_config)
+        failures_left = failure_config.max_failures
+        latest_ckpt: str | None = None
+        start_iteration = 0
+        error: Exception | None = None
+
+        while True:
+            group = WorkerGroup(scaling, self.backend_config, group_name=f"train-{name}")
+            try:
+                refs = group.run(
+                    self.train_loop_per_worker,
+                    self.train_loop_config,
+                    state,
+                    name,
+                    latest_ckpt,
+                    self._dataset_shards(scaling.num_workers),
+                    start_iteration,
+                )
+                ray_tpu.get(refs)
+                error = None
+                break
+            except RayTpuError as e:  # covers actor death, crashes, task errors
+                error = e
+                latest_ckpt = ray_tpu.get(state.latest_checkpoint_path.remote())
+                start_iteration = len(ray_tpu.get(state.get_history.remote()))
+                if failures_left == 0:
+                    break
+                if failures_left > 0:
+                    failures_left -= 1
+                time.sleep(0.5)  # let worker-death cleanup settle
+            finally:
+                group.shutdown()
+
+        history = ray_tpu.get(state.get_history.remote())
+        best = ray_tpu.get(state.best_checkpoint_path.remote())
+        result = Result(
+            metrics=history[-1] if history else {},
+            checkpoint=Checkpoint(best) if best else None,
+            path=storage,
+            metrics_history=history,
+            error=error,
+        )
+        if error is not None:
+            raise error
+        return result

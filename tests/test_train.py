@@ -1,0 +1,210 @@
+"""JaxTrainer end-to-end: the MNIST-MLP DataParallel slice (SURVEY.md §7
+build step 4) on the virtual CPU mesh, plus checkpoint/failure handling.
+
+Reference coverage analogue: train/tests/test_data_parallel_trainer.py,
+test_backend.py, checkpoint manager tests.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.train import (
+    Checkpoint,
+    CheckpointConfig,
+    CheckpointManager,
+    FailureConfig,
+    JaxTrainer,
+    RunConfig,
+    ScalingConfig,
+)
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4, object_store_memory=128 * 1024 * 1024)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_trainer_single_worker_reports(cluster, tmp_path_factory):
+    storage = str(tmp_path_factory.mktemp("run1"))
+
+    def loop(config):
+        from ray_tpu import train
+
+        for i in range(config["iters"]):
+            train.report({"loss": 1.0 / (i + 1)})
+
+    result = JaxTrainer(
+        loop,
+        train_loop_config={"iters": 3},
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="single", storage_path=storage),
+    ).fit()
+    assert len(result.metrics_history) == 3
+    assert result.metrics["loss"] == pytest.approx(1 / 3)
+    assert result.metrics["training_iteration"] == 2
+
+
+def _mlp_dp_loop(config):
+    """Data-parallel MLP on synthetic MNIST-like data: each worker computes
+    grads under jit, gradients averaged across workers, loss must drop."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu import train
+    from ray_tpu.train import jax_utils
+
+    ctx = train.get_context()
+    rank, world = ctx.get_world_rank(), ctx.get_world_size()
+    rng = np.random.RandomState(1234 + rank)
+    x = rng.rand(256, 64).astype(np.float32)
+    w_true = np.linspace(-1, 1, 64 * 10).reshape(64, 10).astype(np.float32)
+    y = (x @ w_true).argmax(axis=1)
+
+    key = jax.random.PRNGKey(0)  # same init everywhere
+    k1, k2 = jax.random.split(key)
+    params = {
+        "w1": jax.random.normal(k1, (64, 128)) * 0.1,
+        "b1": jnp.zeros(128),
+        "w2": jax.random.normal(k2, (128, 10)) * 0.1,
+        "b2": jnp.zeros(10),
+    }
+    params = jax_utils.sync_model_params(params)
+
+    @jax.jit
+    def loss_fn(p, xb, yb):
+        h = jax.nn.relu(xb @ p["w1"] + p["b1"])
+        logits = h @ p["w2"] + p["b2"]
+        return -jnp.mean(
+            jax.nn.log_softmax(logits)[jnp.arange(len(yb)), yb]
+        )
+
+    grad_fn = jax.jit(jax.value_and_grad(loss_fn))
+    lr = 0.5
+    for i in range(8):
+        loss, grads = grad_fn(params, x, y)
+        grads = jax_utils.allreduce_gradients(grads)
+        params = jax.tree.map(lambda p, g: p - lr * g, params, grads)
+        train.report({"loss": float(loss)})
+
+
+def test_trainer_dp_two_workers_loss_drops(cluster, tmp_path_factory):
+    storage = str(tmp_path_factory.mktemp("run2"))
+    result = JaxTrainer(
+        _mlp_dp_loop,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="dp2", storage_path=storage),
+    ).fit()
+    losses = [m["loss"] for m in result.metrics_history]
+    assert len(losses) == 8
+    assert losses[-1] < losses[0] * 0.7, losses
+
+
+def test_trainer_checkpointing(cluster, tmp_path_factory):
+    storage = str(tmp_path_factory.mktemp("run3"))
+
+    def loop():
+        import json
+
+        from ray_tpu import train
+
+        for i in range(3):
+            d = train.make_temp_checkpoint_dir()
+            with open(os.path.join(d, "state.json"), "w") as f:
+                json.dump({"iter": i}, f)
+            train.report({"score": float(i)}, checkpoint=Checkpoint.from_directory(d))
+
+    result = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(
+            name="ckpt",
+            storage_path=storage,
+            checkpoint_config=CheckpointConfig(
+                num_to_keep=2, checkpoint_score_attribute="score"
+            ),
+        ),
+    ).fit()
+    assert result.checkpoint is not None
+    import json
+
+    with open(os.path.join(result.checkpoint.path, "state.json")) as f:
+        assert json.load(f)["iter"] == 2
+    # retention: only 2 kept
+    kept = [d for d in os.listdir(os.path.join(storage, "ckpt")) if d.startswith("checkpoint_")]
+    assert len(kept) == 2
+
+
+def test_trainer_failure_restart_resumes_from_checkpoint(cluster, tmp_path_factory, tmp_path):
+    storage = str(tmp_path_factory.mktemp("run4"))
+    marker = str(tmp_path / "crashed_once")
+
+    def loop(config):
+        import json
+
+        from ray_tpu import train
+
+        start = 0
+        ckpt = train.get_checkpoint()
+        if ckpt is not None:
+            with open(os.path.join(ckpt.path, "state.json")) as f:
+                start = json.load(f)["iter"] + 1
+        for i in range(start, 5):
+            if i == 2 and not os.path.exists(config["marker"]):
+                open(config["marker"], "w").write("x")
+                os._exit(1)
+            d = train.make_temp_checkpoint_dir()
+            with open(os.path.join(d, "state.json"), "w") as f:
+                json.dump({"iter": i}, f)
+            train.report({"iter": float(i)}, checkpoint=Checkpoint.from_directory(d))
+
+    result = JaxTrainer(
+        loop,
+        train_loop_config={"marker": marker},
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(
+            name="restart",
+            storage_path=storage,
+            failure_config=FailureConfig(max_failures=2),
+        ),
+    ).fit()
+    # Crashed at iter 2, resumed from checkpoint of iter 1, finished 2..4.
+    iters = [m["iter"] for m in result.metrics_history]
+    assert iters[-1] == 4.0
+    assert 2.0 in iters
+
+
+def test_trainer_raises_without_failure_budget(cluster, tmp_path_factory):
+    storage = str(tmp_path_factory.mktemp("run5"))
+
+    def loop():
+        os._exit(1)
+
+    with pytest.raises(Exception):
+        JaxTrainer(
+            loop,
+            scaling_config=ScalingConfig(num_workers=1),
+            run_config=RunConfig(name="fail", storage_path=storage),
+        ).fit()
+
+
+def test_checkpoint_manager_topk(tmp_path):
+    m = CheckpointManager(
+        str(tmp_path / "store"), num_to_keep=2, score_attribute="acc", score_order="max"
+    )
+    import tempfile
+
+    for i, acc in enumerate([0.1, 0.9, 0.5, 0.7]):
+        d = tempfile.mkdtemp()
+        open(os.path.join(d, "x"), "w").write(str(i))
+        m.register(d, {"acc": acc})
+    kept_scores = sorted(r["score"] for r in m._records)
+    assert kept_scores == [0.7, 0.9]
+    assert m.best is not None
+    with open(os.path.join(m.best.path, "x")) as f:
+        assert f.read() == "1"  # the 0.9 checkpoint
