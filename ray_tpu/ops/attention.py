@@ -1,0 +1,283 @@
+"""Attention ops: reference, blockwise (flash-style), and Pallas TPU kernel.
+
+The reference framework ships no attention kernels at all — attention
+lives inside vLLM/torch models it orchestrates (reference delegates TP/PP
+to vLLM via engine kwargs, llm/_internal/batch/stages/
+vllm_engine_stage.py:646-647). A TPU-native framework owns this layer:
+the MXU wants large fused QK^T/PV matmuls, and HBM wants the O(T^2)
+scores matrix never materialized.
+
+Shapes follow [batch, seq, heads, head_dim] throughout.
+
+Three tiers:
+  - ``dot_product_attention`` — O(T^2)-memory reference; ground truth in
+    tests and the fallback for odd shapes.
+  - ``blockwise_attention`` — online-softmax lax.scan over key blocks:
+    O(T) memory, fully differentiable, XLA-fusable; the default training
+    path (pairs with jax.checkpoint for remat).
+  - ``flash_attention`` — Pallas TPU forward kernel (interpret-mode on
+    CPU); custom_vjp whose backward is the blockwise path, so training
+    through it stays O(T) memory.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+def _causal_mask(q_pos, k_pos):
+    return q_pos[:, None] >= k_pos[None, :]
+
+
+def dot_product_attention(q, k, v, *, causal: bool = True, q_offset: int = 0):
+    """Reference attention. q: [B,Tq,H,D], k/v: [B,Tk,H,D].
+
+    ``q_offset`` is the global position of q's first row relative to k
+    (used by decode steps and by ring attention's shifted blocks).
+    """
+    *_, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    s = s * scale
+    if causal:
+        q_pos = q_offset + jnp.arange(q.shape[1])
+        k_pos = jnp.arange(k.shape[1])
+        s = jnp.where(_causal_mask(q_pos, k_pos)[None, None], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(p.dtype)).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Online-softmax building block shared by blockwise + ring attention.
+# ---------------------------------------------------------------------------
+
+
+def online_softmax_block(q, k, v, m, l, o, *, q_pos, k_pos, causal,
+                         k_valid=None):
+    """One flash step: fold key block (k, v) into accumulators (m, l, o).
+
+    q [B,Tq,H,D]; k/v [B,Tk,H,D]; m,l [B,H,Tq]; o [B,Tq,H,D] float32.
+    ``k_valid`` [Tk] masks padded keys. Masked-out scores contribute
+    exactly zero probability, so fully masked blocks are no-ops (no
+    -inf NaN traps).
+    """
+    d = q.shape[-1]
+    scale = 1.0 / math.sqrt(d)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    s = s * scale
+    mask = None
+    if causal:
+        mask = _causal_mask(q_pos, k_pos)
+    if k_valid is not None:
+        valid = jnp.broadcast_to(k_valid[None, :], (q.shape[1], k.shape[1]))
+        mask = valid if mask is None else (mask & valid)
+    if mask is not None:
+        mask = mask[None, None]
+        s = jnp.where(mask, s, _NEG_INF)
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    p = jnp.exp(s - m_new[..., None])
+    if mask is not None:
+        p = jnp.where(mask, p, 0.0)
+    correction = jnp.exp(m - m_new)
+    l_new = l * correction + p.sum(axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    o_new = o * correction.transpose(0, 2, 1)[..., None] + pv
+    return m_new, l_new, o_new
+
+
+def _finalize(o, l):
+    return o / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+
+
+def blockwise_attention(q, k, v, *, causal: bool = True, block_k: int = 512,
+                        q_offset: int = 0):
+    """Flash-style attention as a lax.scan over key blocks: O(T) memory,
+    differentiable, MXU-friendly block matmuls."""
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    block_k = min(block_k, tk)
+    n_blocks = (tk + block_k - 1) // block_k
+    pad = n_blocks * block_k - tk
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    kb = k.reshape(b, n_blocks, block_k, h, d).transpose(1, 0, 2, 3, 4)
+    vb = v.reshape(b, n_blocks, block_k, h, d).transpose(1, 0, 2, 3, 4)
+    q_pos = q_offset + jnp.arange(tq)
+
+    def step(carry, blk):
+        m, l, o = carry
+        kblk, vblk, idx = blk
+        k_pos = idx * block_k + jnp.arange(block_k)
+        m, l, o = online_softmax_block(
+            q, kblk, vblk, m, l, o, q_pos=q_pos, k_pos=k_pos, causal=causal,
+            k_valid=(k_pos < tk) if pad else None,
+        )
+        return (m, l, o), None
+
+    m0 = jnp.full((b, h, tq), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, tq), jnp.float32)
+    o0 = jnp.zeros((b, tq, h, d), jnp.float32)
+    (m, l, o), _ = jax.lax.scan(
+        step, (m0, l0, o0), (kb, vb, jnp.arange(n_blocks))
+    )
+    return _finalize(o, l).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas TPU flash-attention forward kernel.
+# ---------------------------------------------------------------------------
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
+                      *, block_q, block_k, n_k, causal, scale):
+    import jax.experimental.pallas as pl
+
+    q_blk = pl.program_id(1)
+    k_blk = pl.program_id(2)
+
+    @pl.when(k_blk == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    def _compute():
+        q = q_ref[0]  # [block_q, d]
+        k = k_ref[0]  # [block_k, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [block_q, block_k]
+        if causal:
+            q_pos = q_blk * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = k_blk * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            mask = q_pos >= k_pos
+            s = jnp.where(mask, s, _NEG_INF)
+        m_prev = m_ref[:]
+        m_new = jnp.maximum(m_prev, s.max(axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        if causal:
+            p = jnp.where(mask, p, 0.0)
+        corr = jnp.exp(m_prev - m_new)
+        l_ref[:] = l_ref[:] * corr + p.sum(axis=1, keepdims=True)
+        m_ref[:] = m_new
+        pv = jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_ref[:] = acc_ref[:] * corr + pv
+
+    if causal:
+        # Skip blocks strictly above the diagonal (whole block masked).
+        @pl.when(k_blk * block_k <= q_blk * block_q + block_q - 1)
+        def _():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(k_blk == n_k - 1)
+    def _emit():
+        o_ref[0] = (acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, *, causal, block_q, block_k, interpret):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    scale = 1.0 / math.sqrt(d)
+    bh = b * h
+    qf = q.transpose(0, 2, 1, 3).reshape(bh, tq, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(bh, tk, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(bh, tk, d)
+    block_q = min(block_q, tq)
+    block_k = min(block_k, tk)
+    if tq % block_q or tk % block_k:
+        raise ValueError(f"seq lens ({tq},{tk}) must divide blocks ({block_q},{block_k})")
+    n_q, n_k = tq // block_q, tk // block_k
+
+    kernel = functools.partial(
+        _flash_fwd_kernel, block_q=block_q, block_k=block_k, n_k=n_k,
+        causal=causal, scale=scale,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(bh, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b_, i, j: (b_, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b_, i, j: (b_, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b_, i, j: (b_, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b_, i, j: (b_, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(b, h, tq, d).transpose(0, 2, 1, 3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def flash_attention(q, k, v, causal: bool = True, block_q: int = 256,
+                    block_k: int = 256):
+    """Pallas flash attention (TPU kernel; interpreter on CPU).
+
+    Training through it is supported: backward runs the O(T)-memory
+    blockwise path under autodiff (recompute, flash-style).
+    """
+    interpret = jax.devices()[0].platform != "tpu"
+    return _flash_forward(
+        q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+        interpret=interpret,
+    )
+
+
+def _flash_fwd_rule(q, k, v, causal, block_q, block_k):
+    out = flash_attention(q, k, v, causal, block_q, block_k)
+    return out, (q, k, v)
+
+
+def _flash_bwd_rule(causal, block_q, block_k, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: blockwise_attention(q_, k_, v_, causal=causal,
+                                               block_k=block_k),
+        q, k, v,
+    )
+    return vjp(g)
+
+
+flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def attention(q, k, v, *, causal: bool = True, impl: str = "auto"):
+    """Dispatch: 'reference' | 'blockwise' | 'flash' | 'auto'.
+
+    'auto' uses the Pallas kernel on TPU when shapes tile cleanly, else
+    the blockwise path.
+    """
+    if impl == "reference":
+        return dot_product_attention(q, k, v, causal=causal)
+    if impl == "blockwise":
+        return blockwise_attention(q, k, v, causal=causal)
+    if impl == "flash":
+        return flash_attention(q, k, v, causal)
+    tq, tk = q.shape[1], k.shape[1]
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu and tq % 256 == 0 and tk % 256 == 0:
+        return flash_attention(q, k, v, causal)
+    return blockwise_attention(q, k, v, causal=causal)

@@ -1,0 +1,60 @@
+"""ray_tpu.parallel: mesh-first parallelism strategies.
+
+The TPU-native counterpart of the reference's parallelism surface
+(SURVEY.md §2.4): DP/FSDP (train/torch/train_loop_utils.py:12,36), TP/PP
+(delegated to vLLM upstream), and the greenfield sequence/context and
+expert parallelism. All strategies are expressed as mesh axes + sharding
+rules compiled by XLA, not as process-group wrapper objects.
+"""
+
+from ray_tpu.parallel.mesh import (
+    AXIS_DATA,
+    AXIS_EXPERT,
+    AXIS_FSDP,
+    AXIS_PIPELINE,
+    AXIS_SEQUENCE,
+    AXIS_TENSOR,
+    BATCH_AXES,
+    DEFAULT_AXIS_ORDER,
+    MeshConfig,
+    batch_sharding,
+    mesh_axis_size,
+    single_device_mesh,
+)
+from ray_tpu.parallel.pipeline import (
+    pipeline_stage_params,
+    pipelined_apply,
+    spmd_pipeline,
+)
+from ray_tpu.parallel.sharding import (
+    constrain,
+    fsdp_spec_for,
+    infer_param_specs,
+    make_shardings,
+    replicated,
+    shard_params,
+)
+
+__all__ = [
+    "AXIS_DATA",
+    "AXIS_EXPERT",
+    "AXIS_FSDP",
+    "AXIS_PIPELINE",
+    "AXIS_SEQUENCE",
+    "AXIS_TENSOR",
+    "BATCH_AXES",
+    "DEFAULT_AXIS_ORDER",
+    "MeshConfig",
+    "batch_sharding",
+    "mesh_axis_size",
+    "single_device_mesh",
+    "pipeline_stage_params",
+    "pipelined_apply",
+    "spmd_pipeline",
+    "constrain",
+    "fsdp_spec_for",
+    "infer_param_specs",
+    "make_shardings",
+    "replicated",
+    "shard_params",
+]

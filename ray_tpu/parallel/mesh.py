@@ -1,0 +1,139 @@
+"""Device-mesh construction: the unit of the TPU hot path.
+
+The reference multiplexes processes over nodes (placement groups supply
+actor gangs; NCCL rings are built out-of-band — reference:
+util/placement_group.py:41, train/torch/config.py:66-115). The TPU-native
+inversion (SURVEY.md §7) makes the *mesh* the schedulable unit: a
+``jax.sharding.Mesh`` over a pod slice, with named axes for every
+parallelism strategy the reference ships or delegates (DP/FSDP from
+train/torch/train_loop_utils.py:12,36; TP/PP delegated to vLLM engine
+kwargs llm/_internal/batch/stages/vllm_engine_stage.py:646-647; SP/CP and
+EP absent upstream — greenfield here, SURVEY.md §2.4).
+
+Axis conventions (outer→inner; inner axes map to physically-adjacent
+chips so their collectives ride the fastest ICI loops):
+
+    pipeline > data > fsdp > expert > sequence > tensor
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+import numpy as np
+
+AXIS_PIPELINE = "pipeline"
+AXIS_DATA = "data"
+AXIS_FSDP = "fsdp"
+AXIS_EXPERT = "expert"
+AXIS_SEQUENCE = "sequence"
+AXIS_TENSOR = "tensor"
+
+# Outer→inner physical order. Tensor-parallel collectives are per-layer
+# (highest frequency) so the tensor axis gets the innermost, fastest ICI
+# neighbours; pipeline crosses slice/host boundaries least often.
+DEFAULT_AXIS_ORDER = (
+    AXIS_PIPELINE,
+    AXIS_DATA,
+    AXIS_FSDP,
+    AXIS_EXPERT,
+    AXIS_SEQUENCE,
+    AXIS_TENSOR,
+)
+
+# Batch-like axes: a global batch dimension is sharded over all of these
+# together (data-parallel replicas and fsdp shards both consume distinct
+# examples; fsdp additionally shards params).
+BATCH_AXES = (AXIS_DATA, AXIS_FSDP)
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Declarative mesh shape. Any axis left at 1 collapses away.
+
+    ``data=-1`` (default) absorbs all remaining devices, so
+    ``MeshConfig(tensor=4)`` on 16 chips gives a 4×4 data×tensor mesh.
+    """
+
+    data: int = -1
+    fsdp: int = 1
+    tensor: int = 1
+    pipeline: int = 1
+    sequence: int = 1
+    expert: int = 1
+    axis_order: tuple = field(default=DEFAULT_AXIS_ORDER)
+
+    def sizes(self) -> dict:
+        return {
+            AXIS_PIPELINE: self.pipeline,
+            AXIS_DATA: self.data,
+            AXIS_FSDP: self.fsdp,
+            AXIS_EXPERT: self.expert,
+            AXIS_SEQUENCE: self.sequence,
+            AXIS_TENSOR: self.tensor,
+        }
+
+    def resolve(self, num_devices: int) -> dict:
+        """Fill in the -1 axis and validate the factorization."""
+        sizes = self.sizes()
+        wildcard = [a for a, n in sizes.items() if n == -1]
+        if len(wildcard) > 1:
+            raise ValueError(f"at most one mesh axis may be -1, got {wildcard}")
+        fixed = math.prod(n for n in sizes.values() if n != -1)
+        if wildcard:
+            if num_devices % fixed:
+                raise ValueError(
+                    f"{num_devices} devices not divisible by fixed axes product {fixed}"
+                )
+            sizes[wildcard[0]] = num_devices // fixed
+        elif fixed != num_devices:
+            raise ValueError(
+                f"mesh axes product {fixed} != device count {num_devices}"
+            )
+        return sizes
+
+    def build(self, devices=None) -> "jax.sharding.Mesh":
+        """Materialize a Mesh over ``devices`` (default: all devices).
+
+        On TPU, ``mesh_utils.create_device_mesh`` lays axes out along the
+        physical torus; elsewhere (CPU tests) a row-major reshape is used.
+        """
+        import jax
+        from jax.sharding import Mesh
+
+        if devices is None:
+            devices = jax.devices()
+        sizes = self.resolve(len(devices))
+        axis_names = tuple(a for a in self.axis_order if sizes[a] > 1)
+        shape = tuple(sizes[a] for a in axis_names)
+        if not axis_names:
+            axis_names, shape = (AXIS_DATA,), (1,)
+        if devices[0].platform == "tpu":
+            from jax.experimental import mesh_utils
+
+            mesh_devices = mesh_utils.create_device_mesh(shape, devices=devices)
+        else:
+            mesh_devices = np.asarray(devices).reshape(shape)
+        return Mesh(mesh_devices, axis_names)
+
+
+def single_device_mesh() -> "jax.sharding.Mesh":
+    import jax
+    from jax.sharding import Mesh
+
+    return Mesh(np.asarray(jax.devices()[:1]), (AXIS_DATA,))
+
+
+def mesh_axis_size(mesh, axis: str) -> int:
+    """Size of ``axis`` in ``mesh``, treating absent axes as 1."""
+    return mesh.shape.get(axis, 1)
+
+
+def batch_sharding(mesh) -> "jax.sharding.NamedSharding":
+    """Sharding for a batch-leading array: leading dim split over every
+    batch-like axis present in the mesh, trailing dims replicated."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    present = tuple(a for a in BATCH_AXES if mesh_axis_size(mesh, a) > 1)
+    return NamedSharding(mesh, PartitionSpec(present if present else None))

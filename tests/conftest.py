@@ -17,6 +17,19 @@ if "host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# The env vars alone are not enough when a sitecustomize has already
+# imported jax (its config defaults are then frozen from the original
+# environment). jax.config.update rewrites the live config, and the
+# backend has not been initialized yet at conftest-import time.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+assert jax.device_count() == 8, (
+    "tests require the virtual 8-device CPU mesh, got "
+    f"{jax.devices()}"
+)
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import pytest  # noqa: E402

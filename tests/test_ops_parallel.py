@@ -1,0 +1,146 @@
+"""Correctness of ops/ kernels and parallel/ strategies on the virtual
+8-device CPU mesh (test strategy per SURVEY.md §4 "lesson")."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.ops import (
+    blockwise_attention,
+    dot_product_attention,
+    flash_attention,
+    ring_attention_sharded,
+)
+from ray_tpu.parallel import (
+    AXIS_DATA,
+    AXIS_FSDP,
+    AXIS_SEQUENCE,
+    AXIS_TENSOR,
+    MeshConfig,
+    fsdp_spec_for,
+    infer_param_specs,
+    pipelined_apply,
+    shard_params,
+)
+from jax.sharding import PartitionSpec as P
+
+
+def _qkv(b=2, t=128, h=4, d=32, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    shape = (b, t, h, d)
+    return tuple(jax.random.normal(k, shape, jnp.float32) for k in ks)
+
+
+def test_blockwise_matches_reference():
+    q, k, v = _qkv()
+    ref = dot_product_attention(q, k, v, causal=True)
+    blk = blockwise_attention(q, k, v, causal=True, block_k=32)
+    np.testing.assert_allclose(ref, blk, atol=2e-5, rtol=2e-5)
+
+
+def test_blockwise_noncausal_with_padding():
+    q, k, v = _qkv(t=100)  # 100 % 32 != 0 → exercises the pad path
+    ref = dot_product_attention(q, k, v, causal=False)
+    blk = blockwise_attention(q, k, v, causal=False, block_k=32)
+    np.testing.assert_allclose(ref, blk, atol=2e-5, rtol=2e-5)
+
+
+def test_flash_kernel_matches_reference():
+    q, k, v = _qkv(t=128)
+    ref = dot_product_attention(q, k, v, causal=True)
+    out = flash_attention(q, k, v, True, 64, 64)
+    np.testing.assert_allclose(ref, out, atol=2e-5, rtol=2e-5)
+
+
+def test_flash_gradients_match_reference():
+    q, k, v = _qkv(b=1, t=64, h=2, d=16)
+
+    def loss_ref(q, k, v):
+        return dot_product_attention(q, k, v, causal=True).sum()
+
+    def loss_flash(q, k, v):
+        return flash_attention(q, k, v, True, 32, 32).sum()
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    g_fl = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ref, g_fl):
+        np.testing.assert_allclose(a, b, atol=1e-4, rtol=1e-4)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_attention_matches_reference(causal):
+    mesh = MeshConfig(data=1, sequence=8).build()
+    q, k, v = _qkv(b=2, t=128, h=2, d=16)
+    ref = dot_product_attention(q, k, v, causal=causal)
+    out = ring_attention_sharded(q, k, v, mesh, causal=causal)
+    np.testing.assert_allclose(ref, np.asarray(out), atol=2e-5, rtol=2e-5)
+
+
+def test_ring_attention_grads_flow():
+    mesh = MeshConfig(data=1, sequence=8).build()
+    q, k, v = _qkv(b=1, t=64, h=2, d=16)
+
+    def loss(q, k, v):
+        return ring_attention_sharded(q, k, v, mesh).sum()
+
+    def loss_ref(q, k, v):
+        return dot_product_attention(q, k, v).sum()
+
+    g = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, g_ref):
+        np.testing.assert_allclose(np.asarray(a), b, atol=1e-4, rtol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# parallel/
+# ---------------------------------------------------------------------------
+
+
+def test_mesh_config_wildcard_and_order():
+    mesh = MeshConfig(tensor=2).build()  # data absorbs 4
+    assert mesh.shape[AXIS_DATA] == 4 and mesh.shape[AXIS_TENSOR] == 2
+    assert mesh.axis_names == (AXIS_DATA, AXIS_TENSOR)
+    with pytest.raises(ValueError):
+        MeshConfig(data=3, tensor=5).build()
+
+
+def test_fsdp_spec_inference():
+    assert fsdp_spec_for((128, 64), 8) == P(AXIS_FSDP, None)
+    # base TP spec on dim 0 → fsdp takes dim 1
+    assert fsdp_spec_for((128, 64), 8, P(AXIS_TENSOR, None)) == P(AXIS_TENSOR, AXIS_FSDP)
+    # nothing divisible → untouched
+    assert fsdp_spec_for((7, 5), 8) == P(None, None)
+
+
+def test_shard_params_places_on_mesh():
+    mesh = MeshConfig(data=1, fsdp=8).build()
+    params = {"w": jnp.ones((64, 16)), "b": jnp.ones((3,))}
+    placed, shardings = shard_params(params, mesh)
+    specs = infer_param_specs(params, mesh)
+    assert specs["w"] == P(AXIS_FSDP, None)
+    assert specs["b"] == P(None)
+    assert placed["w"].sharding.is_equivalent_to(shardings["w"], 2)
+
+
+def test_spmd_pipeline_matches_sequential():
+    """4-stage linear pipeline == sequential composition of the stages."""
+    mesh = MeshConfig(data=1, pipeline=4).build(jax.devices()[:4])
+    key = jax.random.PRNGKey(1)
+    dim = 8
+    params = [
+        {"w": jax.random.normal(k, (dim, dim)) / np.sqrt(dim)}
+        for k in jax.random.split(key, 4)
+    ]
+    batch = jax.random.normal(jax.random.PRNGKey(2), (16, dim))
+
+    def stage(p, x):
+        return jnp.tanh(x @ p["w"])
+
+    expected = batch
+    for p in params:
+        expected = stage(p, expected)
+
+    out = pipelined_apply(stage, params, mesh, batch, num_microbatches=8)
+    np.testing.assert_allclose(np.asarray(out), expected, atol=1e-5, rtol=1e-5)
