@@ -1,0 +1,197 @@
+"""Datasources: read tasks over files/ranges (reference analogue:
+python/ray/data/datasource/ — parquet, csv, json, text, numpy, binary).
+
+A datasource yields ``ReadTask``s — serializable zero-arg callables, each
+producing an iterator of blocks. One task per file (or per range shard)
+is the parallelism unit the executor schedules over the cluster.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import os
+from typing import Callable, Iterator
+
+import numpy as np
+
+from ray_tpu.data.block import Block, BlockMetadata
+
+
+class ReadTask:
+    def __init__(self, fn: Callable[[], Iterator[Block]],
+                 metadata: BlockMetadata | None = None):
+        self._fn = fn
+        self.metadata = metadata or BlockMetadata(None, None)
+
+    def __call__(self) -> Iterator[Block]:
+        return self._fn()
+
+
+def _expand_paths(paths) -> list[str]:
+    if isinstance(paths, (str, os.PathLike)):
+        paths = [paths]
+    out = []
+    for p in paths:
+        p = os.fspath(p)
+        if os.path.isdir(p):
+            for root, _, files in os.walk(p):
+                out.extend(
+                    os.path.join(root, f) for f in sorted(files)
+                    if not f.startswith((".", "_"))
+                )
+        elif any(c in p for c in "*?["):
+            out.extend(sorted(_glob.glob(p)))
+        else:
+            out.append(p)
+    if not out:
+        raise FileNotFoundError(f"no files matched {paths!r}")
+    return out
+
+
+def range_tasks(n: int, parallelism: int) -> list[ReadTask]:
+    parallelism = max(1, min(parallelism, n or 1))
+    per = (n + parallelism - 1) // parallelism
+    tasks = []
+    for i in range(0, n, per):
+        lo, hi = i, min(i + per, n)
+
+        def fn(lo=lo, hi=hi):
+            yield {"id": np.arange(lo, hi, dtype=np.int64)}
+
+        tasks.append(ReadTask(fn, BlockMetadata(hi - lo, (hi - lo) * 8)))
+    return tasks
+
+
+def range_tensor_tasks(n: int, shape: tuple, parallelism: int) -> list[ReadTask]:
+    parallelism = max(1, min(parallelism, n or 1))
+    per = (n + parallelism - 1) // parallelism
+    tasks = []
+    for i in range(0, n, per):
+        lo, hi = i, min(i + per, n)
+
+        def fn(lo=lo, hi=hi):
+            base = np.arange(lo, hi, dtype=np.int64).reshape((-1,) + (1,) * len(shape))
+            yield {"data": np.broadcast_to(base, (hi - lo,) + tuple(shape)).copy()}
+
+        size = (hi - lo) * int(np.prod(shape)) * 8
+        tasks.append(ReadTask(fn, BlockMetadata(hi - lo, size)))
+    return tasks
+
+
+def _file_tasks(paths, reader: Callable[[str], Iterator[Block]]) -> list[ReadTask]:
+    tasks = []
+    for path in _expand_paths(paths):
+        def fn(path=path):
+            return reader(path)
+
+        meta = BlockMetadata(
+            None, os.path.getsize(path) if os.path.exists(path) else None,
+            input_files=[path],
+        )
+        tasks.append(ReadTask(fn, meta))
+    return tasks
+
+
+def parquet_tasks(paths, columns=None) -> list[ReadTask]:
+    def read(path):
+        import pyarrow.parquet as pq
+
+        f = pq.ParquetFile(path)
+        for batch in f.iter_batches(columns=columns):
+            import pyarrow as pa
+
+            yield pa.Table.from_batches([batch])
+
+    return _file_tasks(paths, read)
+
+
+def csv_tasks(paths, **csv_kwargs) -> list[ReadTask]:
+    def read(path):
+        import pyarrow.csv as pacsv
+
+        yield pacsv.read_csv(path, **csv_kwargs)
+
+    return _file_tasks(paths, read)
+
+
+def json_tasks(paths) -> list[ReadTask]:
+    def read(path):
+        import pyarrow.json as pajson
+
+        yield pajson.read_json(path)
+
+    return _file_tasks(paths, read)
+
+
+def text_tasks(paths, *, drop_empty_lines: bool = True) -> list[ReadTask]:
+    def read(path):
+        with open(path, "r", errors="replace") as f:
+            lines = [ln.rstrip("\n") for ln in f]
+        if drop_empty_lines:
+            lines = [ln for ln in lines if ln]
+        yield {"text": np.asarray(lines, dtype=object)}
+
+    return _file_tasks(paths, read)
+
+
+def numpy_tasks(paths) -> list[ReadTask]:
+    def read(path):
+        arr = np.load(path, allow_pickle=False)
+        yield {"data": arr}
+
+    return _file_tasks(paths, read)
+
+
+def binary_tasks(paths, *, include_paths: bool = False) -> list[ReadTask]:
+    def read(path):
+        with open(path, "rb") as f:
+            data = f.read()
+        block = {"bytes": np.asarray([data], dtype=object)}
+        if include_paths:
+            block["path"] = np.asarray([path], dtype=object)
+        yield block
+
+    return _file_tasks(paths, read)
+
+
+# -- writers ----------------------------------------------------------------
+
+def write_parquet_block(block: Block, path: str, idx: int) -> str:
+    import pyarrow.parquet as pq
+
+    from ray_tpu.data.block import BlockAccessor
+
+    os.makedirs(path, exist_ok=True)
+    out = os.path.join(path, f"part-{idx:06d}.parquet")
+    pq.write_table(BlockAccessor(block).to_arrow(), out)
+    return out
+
+
+def write_csv_block(block: Block, path: str, idx: int) -> str:
+    import pyarrow.csv as pacsv
+
+    from ray_tpu.data.block import BlockAccessor
+
+    os.makedirs(path, exist_ok=True)
+    out = os.path.join(path, f"part-{idx:06d}.csv")
+    pacsv.write_csv(BlockAccessor(block).to_arrow(), out)
+    return out
+
+
+def write_json_block(block: Block, path: str, idx: int) -> str:
+    import json
+
+    from ray_tpu.data.block import BlockAccessor
+
+    os.makedirs(path, exist_ok=True)
+    out = os.path.join(path, f"part-{idx:06d}.jsonl")
+    with open(out, "w") as f:
+        for row in BlockAccessor(block).iter_rows():
+            if not isinstance(row, dict):
+                row = {"item": row}
+            f.write(json.dumps(
+                {k: v.tolist() if isinstance(v, np.ndarray) else
+                 (v.item() if isinstance(v, np.generic) else v)
+                 for k, v in row.items()}
+            ) + "\n")
+    return out

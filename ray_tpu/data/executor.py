@@ -1,0 +1,414 @@
+"""Streaming execution of Dataset plans.
+
+Reference analogue: data/_internal/execution/streaming_executor.py:48 —
+a thread running a PhysicalOperator graph with backpressure. Here the
+plan is compiled into **fused map stages** (consecutive row/batch ops
+collapse into one function, the reference's operator-fusion rule) and
+executed either:
+
+  - as ray_tpu tasks, one per input block, with a bounded in-flight
+    window (backpressure) when a cluster is initialized; or
+  - inline in a thread pool (pure-local iteration, zero-setup mode).
+
+All-to-all ops (repartition/shuffle/sort) are barrier stages that
+materialize their input.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait as fwait
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterator
+
+import numpy as np
+
+from ray_tpu.data.block import Block, BlockAccessor
+from ray_tpu.data.datasource import ReadTask
+
+
+# -- logical ops ------------------------------------------------------------
+
+class LogicalOp:
+    pass
+
+
+@dataclass
+class Read(LogicalOp):
+    tasks: list = field(default_factory=list)  # list[ReadTask]
+
+
+@dataclass
+class InputData(LogicalOp):
+    blocks: list = field(default_factory=list)
+
+
+@dataclass
+class MapBatches(LogicalOp):
+    fn: Callable
+    batch_size: int | None = None
+    batch_format: str = "numpy"
+    fn_constructor: Callable | None = None  # class-based UDF (actor-ish)
+
+
+@dataclass
+class MapRows(LogicalOp):
+    fn: Callable
+
+
+@dataclass
+class Filter(LogicalOp):
+    fn: Callable
+
+
+@dataclass
+class FlatMap(LogicalOp):
+    fn: Callable
+
+
+@dataclass
+class AddColumn(LogicalOp):
+    name: str
+    fn: Callable
+
+
+@dataclass
+class DropColumns(LogicalOp):
+    cols: tuple
+
+
+@dataclass
+class SelectColumns(LogicalOp):
+    cols: tuple
+
+
+@dataclass
+class RenameColumns(LogicalOp):
+    mapping: dict
+
+
+@dataclass
+class Limit(LogicalOp):
+    n: int
+
+
+@dataclass
+class Repartition(LogicalOp):
+    n: int
+
+
+@dataclass
+class RandomShuffle(LogicalOp):
+    seed: int | None = None
+
+
+@dataclass
+class Sort(LogicalOp):
+    key: str
+    descending: bool = False
+
+
+@dataclass
+class UnionOp(LogicalOp):
+    others: list = field(default_factory=list)  # list[list[LogicalOp]]
+
+
+@dataclass
+class ZipOp(LogicalOp):
+    other: list = field(default_factory=list)  # plan
+
+
+FUSABLE = (MapBatches, MapRows, Filter, FlatMap, AddColumn, DropColumns,
+           SelectColumns, RenameColumns)
+
+
+# -- fused stage execution ---------------------------------------------------
+
+def _apply_op(op, blocks: Iterator[Block]) -> Iterator[Block]:
+    if isinstance(op, MapBatches):
+        fn = op.fn
+        if op.fn_constructor is not None:
+            inst = op.fn_constructor()
+            fn = inst.__call__ if callable(inst) else inst
+        for block in _rebatch(blocks, op.batch_size):
+            batch = BlockAccessor(block).to_batch(op.batch_format)
+            out = fn(batch)
+            if out is None:
+                continue
+            yield BlockAccessor.batch_to_block(out)
+    elif isinstance(op, MapRows):
+        for block in blocks:
+            rows = [op.fn(r) for r in BlockAccessor(block).iter_rows()]
+            yield BlockAccessor.from_rows(rows)
+    elif isinstance(op, Filter):
+        for block in blocks:
+            acc = BlockAccessor(block)
+            keep = np.asarray(
+                [bool(op.fn(r)) for r in acc.iter_rows()], dtype=bool
+            )
+            if keep.any():
+                yield acc.take_indices(np.nonzero(keep)[0])
+    elif isinstance(op, FlatMap):
+        for block in blocks:
+            rows = []
+            for r in BlockAccessor(block).iter_rows():
+                rows.extend(op.fn(r))
+            if rows:
+                yield BlockAccessor.from_rows(rows)
+    elif isinstance(op, AddColumn):
+        for block in blocks:
+            cols = BlockAccessor(block).to_numpy()
+            cols[op.name] = np.asarray(op.fn(cols))
+            yield cols
+    elif isinstance(op, DropColumns):
+        for block in blocks:
+            cols = BlockAccessor(block).to_numpy()
+            yield {k: v for k, v in cols.items() if k not in op.cols}
+    elif isinstance(op, SelectColumns):
+        for block in blocks:
+            cols = BlockAccessor(block).to_numpy()
+            yield {k: cols[k] for k in op.cols}
+    elif isinstance(op, RenameColumns):
+        for block in blocks:
+            cols = BlockAccessor(block).to_numpy()
+            yield {op.mapping.get(k, k): v for k, v in cols.items()}
+    else:  # pragma: no cover
+        raise TypeError(f"not a fusable op: {op}")
+
+
+def _rebatch(blocks: Iterator[Block], batch_size: int | None) -> Iterator[Block]:
+    """Re-chunk a block stream to exactly ``batch_size`` rows (last batch
+    may be short). None → pass blocks through unchanged."""
+    if batch_size is None:
+        yield from blocks
+        return
+    buf: list[Block] = []
+    buffered = 0
+    for block in blocks:
+        buf.append(block)
+        buffered += BlockAccessor(block).num_rows()
+        while buffered >= batch_size:
+            merged = BlockAccessor.concat(buf)
+            acc = BlockAccessor(merged)
+            yield acc.slice(0, batch_size)
+            rest = acc.slice(batch_size, acc.num_rows())
+            buf = [rest] if BlockAccessor(rest).num_rows() else []
+            buffered -= batch_size
+    if buffered:
+        yield BlockAccessor.concat(buf)
+
+
+def run_fused_stage(source, ops: list) -> list[Block]:
+    """Run a chain of fusable ops over one input (a ReadTask or a block).
+    This is the function shipped to the cluster as one task."""
+    if isinstance(source, ReadTask):
+        blocks: Iterator[Block] = source()
+    else:
+        blocks = iter([source])
+    for op in ops:
+        blocks = _apply_op(op, blocks)
+    return list(blocks)
+
+
+# -- streaming driver --------------------------------------------------------
+
+def _bounded_map(inputs: list, fn: Callable, parallelism: int,
+                 use_tasks: bool) -> Iterator[list[Block]]:
+    """Apply ``fn`` over ``inputs`` with at most ``parallelism`` in
+    flight; yield results in submission order (streaming backpressure —
+    the role of the reference's resource-budget OpState queues)."""
+    if parallelism <= 1 or len(inputs) <= 1:
+        for item in inputs:
+            yield fn(item)
+        return
+    if use_tasks:
+        import ray_tpu
+
+        remote_fn = ray_tpu.remote(fn)
+        pending: dict[int, Any] = {}
+        next_submit = 0
+        next_yield = 0
+        while next_yield < len(inputs):
+            while next_submit < len(inputs) and len(pending) < parallelism:
+                pending[next_submit] = remote_fn.remote(inputs[next_submit])
+                next_submit += 1
+            yield ray_tpu.get(pending.pop(next_yield))
+            next_yield += 1
+    else:
+        with ThreadPoolExecutor(max_workers=parallelism) as pool:
+            futs = {}
+            next_submit = 0
+            next_yield = 0
+            while next_yield < len(inputs):
+                while next_submit < len(inputs) and len(futs) < parallelism:
+                    futs[next_submit] = pool.submit(fn, inputs[next_submit])
+                    next_submit += 1
+                yield futs.pop(next_yield).result()
+                next_yield += 1
+
+
+def execute_plan(plan: list, ctx) -> Iterator[Block]:
+    """Stream blocks out of a logical plan."""
+    i = 0
+    stream: Iterator[Block] | None = None
+    while i < len(plan):
+        op = plan[i]
+        if isinstance(op, (Read, InputData)):
+            # Fuse the longest run of fusable ops after the source.
+            j = i + 1
+            fused = []
+            while j < len(plan) and isinstance(plan[j], FUSABLE):
+                fused.append(plan[j])
+                j += 1
+            inputs = op.tasks if isinstance(op, Read) else op.blocks
+            use_tasks = ctx.use_tasks and _cluster_up()
+
+            def run(src, _fused=tuple(fused)):
+                return run_fused_stage(src, list(_fused))
+
+            def gen(inputs=inputs, run=run, use_tasks=use_tasks):
+                for out in _bounded_map(list(inputs), run, ctx.parallelism,
+                                        use_tasks):
+                    yield from out
+
+            stream = gen()
+            i = j
+        elif isinstance(op, FUSABLE):
+            stream = _apply_op(op, stream)
+            i += 1
+        elif isinstance(op, Limit):
+            stream = _limit_stream(stream, op.n)
+            i += 1
+        elif isinstance(op, Repartition):
+            blocks = list(stream)
+            stream = iter(_repartition(blocks, op.n))
+            i += 1
+        elif isinstance(op, RandomShuffle):
+            blocks = list(stream)
+            stream = iter(_shuffle(blocks, op.seed))
+            i += 1
+        elif isinstance(op, Sort):
+            blocks = list(stream)
+            stream = iter(_sort(blocks, op.key, op.descending))
+            i += 1
+        elif isinstance(op, UnionOp):
+            streams = [stream] + [execute_plan(p, ctx) for p in op.others]
+
+            def chain(streams=streams):
+                for s in streams:
+                    yield from s
+
+            stream = chain()
+            i += 1
+        elif isinstance(op, ZipOp):
+            stream = _zip_streams(stream, execute_plan(op.other, ctx))
+            i += 1
+        else:
+            raise TypeError(f"unknown logical op {op}")
+    return stream if stream is not None else iter(())
+
+
+def _cluster_up() -> bool:
+    try:
+        import ray_tpu
+
+        return ray_tpu.is_initialized()
+    except Exception:
+        return False
+
+
+def _limit_stream(stream, n):
+    remaining = n
+    for block in stream:
+        if remaining <= 0:
+            return
+        acc = BlockAccessor(block)
+        if acc.num_rows() <= remaining:
+            remaining -= acc.num_rows()
+            yield block
+        else:
+            yield acc.slice(0, remaining)
+            return
+
+
+def _repartition(blocks, n):
+    merged = BlockAccessor.concat(blocks)
+    acc = BlockAccessor(merged)
+    total = acc.num_rows()
+    per = total // n
+    extra = total % n
+    out, start = [], 0
+    for k in range(n):
+        size = per + (1 if k < extra else 0)
+        out.append(acc.slice(start, start + size))
+        start += size
+    return out
+
+
+def _shuffle(blocks, seed):
+    merged = BlockAccessor.concat(blocks)
+    acc = BlockAccessor(merged)
+    rng = np.random.default_rng(seed)
+    idx = rng.permutation(acc.num_rows())
+    return [acc.take_indices(idx)]
+
+
+def _sort(blocks, key, descending):
+    merged = BlockAccessor.concat(blocks)
+    acc = BlockAccessor(merged)
+    col = acc.to_numpy()[key]
+    idx = np.argsort(col, kind="stable")
+    if descending:
+        idx = idx[::-1]
+    return [acc.take_indices(idx)]
+
+
+def _zip_streams(a, b):
+    abuf = _RowBuffer(a)
+    bbuf = _RowBuffer(b)
+    while True:
+        blk_a = abuf.next_chunk()
+        if blk_a is None:
+            break
+        n = BlockAccessor(blk_a).num_rows()
+        blk_b = bbuf.take(n)
+        if blk_b is None:
+            raise ValueError("zip: datasets have different lengths")
+        cols = dict(BlockAccessor(blk_a).to_numpy())
+        for k, v in BlockAccessor(blk_b).to_numpy().items():
+            name = k
+            while name in cols:
+                name = name + "_1"
+            cols[name] = v
+        yield cols
+    if bbuf.take(1) is not None:
+        raise ValueError("zip: datasets have different lengths")
+
+
+class _RowBuffer:
+    def __init__(self, stream):
+        self._stream = stream
+        self._buf = []
+        self._n = 0
+
+    def next_chunk(self):
+        if self._buf:
+            blk = self._buf.pop(0)
+            self._n -= BlockAccessor(blk).num_rows()
+            return blk
+        return next(self._stream, None)
+
+    def take(self, n):
+        while self._n < n:
+            blk = next(self._stream, None)
+            if blk is None:
+                return None
+            self._buf.append(blk)
+            self._n += BlockAccessor(blk).num_rows()
+        merged = BlockAccessor.concat(self._buf)
+        acc = BlockAccessor(merged)
+        out = acc.slice(0, n)
+        rest = acc.slice(n, acc.num_rows())
+        self._buf = [rest] if BlockAccessor(rest).num_rows() else []
+        self._n = BlockAccessor(rest).num_rows() if self._buf else 0
+        return out

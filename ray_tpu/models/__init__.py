@@ -1,0 +1,47 @@
+"""Model library (flagship: decoder-only transformer LMs).
+
+The reference orchestrates external torch models (TorchTrainer user
+modules; vLLM engines for ray.llm) and ships none of its own; the
+TPU-native framework owns this layer so Train/Serve/bench recipes are
+self-contained. See ray_tpu.models.transformer.
+"""
+
+from ray_tpu.models.transformer import (
+    TransformerConfig,
+    cross_entropy_loss,
+    decode_step,
+    forward,
+    generate,
+    gpt2_medium,
+    gpt2_small,
+    gpt2_xl,
+    init_kv_cache,
+    init_params,
+    init_train_state,
+    llama2_7b,
+    llama3_8b,
+    lm_loss,
+    make_train_step,
+    partition_specs,
+    tiny,
+)
+
+__all__ = [
+    "TransformerConfig",
+    "cross_entropy_loss",
+    "decode_step",
+    "forward",
+    "generate",
+    "gpt2_small",
+    "gpt2_medium",
+    "gpt2_xl",
+    "init_kv_cache",
+    "init_params",
+    "init_train_state",
+    "llama2_7b",
+    "llama3_8b",
+    "lm_loss",
+    "make_train_step",
+    "partition_specs",
+    "tiny",
+]

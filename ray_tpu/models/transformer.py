@@ -1,0 +1,570 @@
+"""Decoder-only transformer LM: the flagship model family.
+
+The reference framework ships no model implementations of its own — its
+Train/RLlib/llm libraries orchestrate torch models (TorchTrainer wraps a
+user nn.Module, reference: train/torch/torch_trainer.py:11; ray.llm
+delegates to vLLM engines, llm/_internal/batch/stages/vllm_engine_stage.py)
+— so the north-star recipes (GPT-2 125M DDP, Llama-family FSDP/TP;
+BASELINE.json) need a model library here. This one is TPU-first:
+
+  - Params are plain pytrees with a **stacked layer axis** so the forward
+    pass is one ``lax.scan`` over layers: compile time is O(1) in depth
+    and XLA pipelines the per-layer DMAs.
+  - Compute in bfloat16, params in float32, statistics/softmax in float32
+    (the MXU-native mixed-precision recipe).
+  - Attention uses the O(T)-memory blockwise/Pallas-flash ops
+    (ray_tpu.ops.attention); sequence parallelism composes via
+    ray_tpu.ops.ring_attention in the shard_map path.
+  - ``partition_specs()`` exports the megatron-style TP layout (heads and
+    ffn sharded over the ``tensor`` axis); FSDP layering on top is done by
+    parallel.sharding.infer_param_specs, so dp/fsdp/tp/sp all come from
+    the same param tree.
+
+Two architectures behind one config:
+  - ``arch="gpt2"``  — learned positions, LayerNorm, GELU MLP, tied head.
+  - ``arch="llama"`` — RoPE, RMSNorm, SwiGLU, GQA, untied head.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, replace
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ray_tpu.ops.attention import attention, dot_product_attention
+from ray_tpu.ops.layers import (
+    apply_rope,
+    gelu_mlp,
+    layer_norm,
+    rms_norm,
+    rope_frequencies,
+    swiglu,
+)
+from ray_tpu.parallel.mesh import AXIS_DATA, AXIS_FSDP, AXIS_SEQUENCE, AXIS_TENSOR
+from ray_tpu.parallel.sharding import constrain
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 50304          # GPT-2 BPE padded to a multiple of 128
+    n_layers: int = 12
+    d_model: int = 768
+    n_heads: int = 12
+    n_kv_heads: int | None = None    # < n_heads → GQA (llama arch only)
+    d_ff: int | None = None          # default: 4*d_model (gpt2), 8/3*d (llama)
+    max_seq_len: int = 1024
+    arch: str = "gpt2"               # "gpt2" | "llama"
+    rope_theta: float = 10000.0
+    dtype: str = "bfloat16"          # activation/compute dtype
+    param_dtype: str = "float32"
+    tie_embeddings: bool | None = None  # default: True for gpt2, False for llama
+    attn_impl: str = "auto"          # ray_tpu.ops.attention dispatch
+    remat: bool = True               # checkpoint each layer (HBM↔FLOPs trade)
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads or self.n_heads
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @property
+    def ffn_dim(self) -> int:
+        if self.d_ff is not None:
+            return self.d_ff
+        if self.arch == "llama":
+            # 8/3 * d rounded up to a multiple of 256 (MXU tiling)
+            return ((int(8 * self.d_model / 3) + 255) // 256) * 256
+        return 4 * self.d_model
+
+    @property
+    def tied(self) -> bool:
+        if self.tie_embeddings is not None:
+            return self.tie_embeddings
+        return self.arch == "gpt2"
+
+    @property
+    def compute_dtype(self):
+        return jnp.dtype(self.dtype)
+
+    def num_params(self) -> int:
+        return sum(
+            int(math.prod(p.shape)) for p in jax.tree.leaves(self.shapes())
+        )
+
+    def shapes(self):
+        """ShapeDtypeStruct pytree of the parameters (used by init,
+        partition_specs, and abstract eval without materializing)."""
+        return jax.eval_shape(lambda: init_params(jax.random.PRNGKey(0), self))
+
+
+# -- presets ----------------------------------------------------------------
+
+def gpt2_small(**kw) -> TransformerConfig:
+    """GPT-2 124M — the reference's Ray-Train-GPT-2 north-star model
+    (BASELINE.json config #2)."""
+    return replace(TransformerConfig(), **kw)
+
+
+def gpt2_medium(**kw) -> TransformerConfig:
+    return replace(
+        TransformerConfig(n_layers=24, d_model=1024, n_heads=16), **kw
+    )
+
+
+def gpt2_xl(**kw) -> TransformerConfig:
+    return replace(
+        TransformerConfig(n_layers=48, d_model=1600, n_heads=25), **kw
+    )
+
+
+def llama2_7b(**kw) -> TransformerConfig:
+    return replace(
+        TransformerConfig(
+            vocab_size=32000, n_layers=32, d_model=4096, n_heads=32,
+            n_kv_heads=32, d_ff=11008, max_seq_len=4096, arch="llama",
+        ),
+        **kw,
+    )
+
+
+def llama3_8b(**kw) -> TransformerConfig:
+    return replace(
+        TransformerConfig(
+            vocab_size=128256, n_layers=32, d_model=4096, n_heads=32,
+            n_kv_heads=8, d_ff=14336, max_seq_len=8192, arch="llama",
+            rope_theta=500000.0,
+        ),
+        **kw,
+    )
+
+
+def tiny(**kw) -> TransformerConfig:
+    """Test-sized model (CI on the 8-device CPU mesh)."""
+    return replace(
+        TransformerConfig(
+            vocab_size=256, n_layers=2, d_model=64, n_heads=4,
+            max_seq_len=128, remat=False,
+        ),
+        **kw,
+    )
+
+
+# -- init -------------------------------------------------------------------
+
+def init_params(rng, config: TransformerConfig):
+    """Initialize the parameter pytree.
+
+    Layer params carry a leading [n_layers] axis (consumed by lax.scan).
+    GPT-2 init: N(0, 0.02), residual-out projections scaled by
+    1/sqrt(2*n_layers).
+    """
+    c = config
+    pdt = jnp.dtype(c.param_dtype)
+    L, D, H, KV, Dh, F = (
+        c.n_layers, c.d_model, c.n_heads, c.kv_heads, c.head_dim, c.ffn_dim,
+    )
+    std = 0.02
+    res_std = std / math.sqrt(2 * L)
+    keys = iter(jax.random.split(rng, 16))
+
+    def norm(key, *shape, s=std):
+        return (jax.random.normal(key, shape, jnp.float32) * s).astype(pdt)
+
+    params = {
+        "embed": {"tokens": norm(next(keys), c.vocab_size, D)},
+        "layers": {
+            "attn": {
+                "wq": norm(next(keys), L, D, H, Dh),
+                "wk": norm(next(keys), L, D, KV, Dh),
+                "wv": norm(next(keys), L, D, KV, Dh),
+                "wo": norm(next(keys), L, H, Dh, D, s=res_std),
+            },
+        },
+        "final_norm": {"w": jnp.ones((D,), pdt)},
+    }
+    if c.arch == "gpt2":
+        params["embed"]["pos"] = norm(next(keys), c.max_seq_len, D)
+        params["layers"]["ln1"] = {
+            "w": jnp.ones((L, D), pdt), "b": jnp.zeros((L, D), pdt)
+        }
+        params["layers"]["ln2"] = {
+            "w": jnp.ones((L, D), pdt), "b": jnp.zeros((L, D), pdt)
+        }
+        params["layers"]["mlp"] = {
+            "w_in": norm(next(keys), L, D, F),
+            "b_in": jnp.zeros((L, F), pdt),
+            "w_out": norm(next(keys), L, F, D, s=res_std),
+            "b_out": jnp.zeros((L, D), pdt),
+        }
+        params["final_norm"]["b"] = jnp.zeros((D,), pdt)
+    else:
+        params["layers"]["ln1"] = {"w": jnp.ones((L, D), pdt)}
+        params["layers"]["ln2"] = {"w": jnp.ones((L, D), pdt)}
+        params["layers"]["mlp"] = {
+            "w_gate": norm(next(keys), L, D, F),
+            "w_up": norm(next(keys), L, D, F),
+            "w_down": norm(next(keys), L, F, D, s=res_std),
+        }
+    if not c.tied:
+        params["lm_head"] = norm(next(keys), D, c.vocab_size)
+    return params
+
+
+# -- partitioning -----------------------------------------------------------
+
+def partition_specs(config: TransformerConfig):
+    """Megatron-style TP base specs mirroring the param tree.
+
+    Heads / ffn-hidden shard over the ``tensor`` axis so each attention
+    and MLP block is a pair of column→row parallel matmuls (one psum per
+    block, inserted by GSPMD). Vocab shards over ``tensor`` in the
+    embedding/head. FSDP is layered on top by infer_param_specs.
+    """
+    c = config
+    specs = {
+        "embed": {"tokens": P(AXIS_TENSOR, None)},
+        "layers": {
+            "attn": {
+                "wq": P(None, None, AXIS_TENSOR, None),
+                "wk": P(None, None, AXIS_TENSOR, None),
+                "wv": P(None, None, AXIS_TENSOR, None),
+                "wo": P(None, AXIS_TENSOR, None, None),
+            },
+            "ln1": None,
+            "ln2": None,
+        },
+        "final_norm": None,
+    }
+    if c.arch == "gpt2":
+        specs["embed"]["pos"] = P(None, None)
+        specs["layers"]["mlp"] = {
+            "w_in": P(None, None, AXIS_TENSOR),
+            "b_in": P(None, AXIS_TENSOR),
+            "w_out": P(None, AXIS_TENSOR, None),
+            "b_out": None,
+        }
+    else:
+        specs["layers"]["mlp"] = {
+            "w_gate": P(None, None, AXIS_TENSOR),
+            "w_up": P(None, None, AXIS_TENSOR),
+            "w_down": P(None, AXIS_TENSOR, None),
+        }
+    if not c.tied:
+        specs["lm_head"] = P(None, AXIS_TENSOR)
+    # Expand None-marked subtrees to per-leaf None specs.
+    return _mirror(specs, config.shapes())
+
+
+def _mirror(specs, shapes):
+    """Expand a spec tree with None-subtree shorthands to exactly mirror
+    the param tree structure."""
+    if isinstance(shapes, dict):
+        out = {}
+        for k, sub in shapes.items():
+            s = specs.get(k) if isinstance(specs, dict) else None
+            out[k] = _mirror(s, sub)
+        return out
+    return specs  # leaf: a PartitionSpec or None
+
+
+# -- forward ----------------------------------------------------------------
+
+_BATCH = (AXIS_DATA, AXIS_FSDP)
+
+
+def forward(params, tokens, config: TransformerConfig, *, mesh=None,
+            positions=None):
+    """Logits for ``tokens`` [B, T] → [B, T, vocab] (float32).
+
+    ``mesh`` adds with_sharding_constraint annotations on activations
+    (batch over data+fsdp, heads/ffn over tensor); pass None outside pjit.
+    """
+    c = config
+    dt = c.compute_dtype
+    B, T = tokens.shape
+
+    def con(x, *spec):
+        return constrain(x, mesh, *spec) if mesh is not None else x
+
+    x = params["embed"]["tokens"][tokens].astype(dt)
+    if c.arch == "gpt2":
+        if positions is None:
+            pos_emb = params["embed"]["pos"][:T]
+        else:
+            pos_emb = params["embed"]["pos"][positions]
+        x = x + pos_emb.astype(dt)
+        rope = None
+    else:
+        cos, sin = rope_frequencies(c.head_dim, c.max_seq_len,
+                                    theta=c.rope_theta)
+        rope = (cos, sin)
+    x = con(x, _BATCH, AXIS_SEQUENCE, None)
+
+    def layer(x, lp):
+        return _block(x, lp, c, rope=rope, con=con, positions=positions)
+
+    if c.remat:
+        layer = jax.checkpoint(layer)
+
+    x, _ = jax.lax.scan(lambda h, lp: (layer(h, lp), None), x,
+                        params["layers"])
+
+    if c.arch == "gpt2":
+        x = layer_norm(x, params["final_norm"]["w"], params["final_norm"]["b"])
+    else:
+        x = rms_norm(x, params["final_norm"]["w"])
+    head = (params["embed"]["tokens"].T if c.tied else params["lm_head"])
+    logits = jnp.einsum("btd,dv->btv", x, head.astype(dt),
+                        preferred_element_type=jnp.float32)
+    return con(logits, _BATCH, AXIS_SEQUENCE, AXIS_TENSOR)
+
+
+def _block(x, lp, c: TransformerConfig, *, rope, con, positions=None):
+    """One transformer block (pre-norm residual)."""
+    dt = c.compute_dtype
+    if c.arch == "gpt2":
+        h = layer_norm(x, lp["ln1"]["w"], lp["ln1"]["b"])
+    else:
+        h = rms_norm(x, lp["ln1"]["w"])
+    q = jnp.einsum("btd,dhk->bthk", h, lp["attn"]["wq"].astype(dt))
+    k = jnp.einsum("btd,dhk->bthk", h, lp["attn"]["wk"].astype(dt))
+    v = jnp.einsum("btd,dhk->bthk", h, lp["attn"]["wv"].astype(dt))
+    if rope is not None:
+        cos, sin = rope
+        q = apply_rope(q, cos, sin, positions=positions)
+        k = apply_rope(k, cos, sin, positions=positions)
+    k, v = _expand_gqa(k, v, c)
+    q = con(q, _BATCH, AXIS_SEQUENCE, AXIS_TENSOR, None)
+    o = attention(q, k, v, causal=True, impl=c.attn_impl)
+    o = jnp.einsum("bthk,hkd->btd", o, lp["attn"]["wo"].astype(dt))
+    x = x + o
+
+    if c.arch == "gpt2":
+        h = layer_norm(x, lp["ln2"]["w"], lp["ln2"]["b"])
+        m = gelu_mlp(h, lp["mlp"]["w_in"].astype(dt), lp["mlp"]["b_in"].astype(dt),
+                     lp["mlp"]["w_out"].astype(dt), lp["mlp"]["b_out"].astype(dt))
+    else:
+        h = rms_norm(x, lp["ln2"]["w"])
+        m = swiglu(h, lp["mlp"]["w_gate"].astype(dt), lp["mlp"]["w_up"].astype(dt),
+                   lp["mlp"]["w_down"].astype(dt))
+    return x + m
+
+
+def _expand_gqa(k, v, c: TransformerConfig):
+    if c.kv_heads == c.n_heads:
+        return k, v
+    rep = c.n_heads // c.kv_heads
+    return (jnp.repeat(k, rep, axis=2), jnp.repeat(v, rep, axis=2))
+
+
+# -- loss / train step ------------------------------------------------------
+
+def cross_entropy_loss(logits, targets, *, mask=None, z_loss: float = 0.0):
+    """Token-level CE in float32 with optional z-loss regularizer.
+
+    logits [B,T,V] (any dtype; upcast), targets [B,T] int, mask [B,T]
+    (1 = contributes). Returns (scalar loss, dict metrics).
+    """
+    logits = logits.astype(jnp.float32)
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    nll = lse - gold
+    if z_loss:
+        nll = nll + z_loss * jnp.square(lse)
+    if mask is None:
+        denom = nll.size
+        loss = nll.sum() / denom
+        acc = (logits.argmax(-1) == targets).mean()
+    else:
+        mask = mask.astype(jnp.float32)
+        denom = jnp.maximum(mask.sum(), 1.0)
+        loss = (nll * mask).sum() / denom
+        acc = ((logits.argmax(-1) == targets) * mask).sum() / denom
+    return loss, {"loss": loss, "accuracy": acc,
+                  "perplexity": jnp.exp(jnp.minimum(loss, 20.0))}
+
+
+def lm_loss(params, batch, config: TransformerConfig, *, mesh=None,
+            z_loss: float = 0.0):
+    """Next-token LM loss. batch: {"tokens": [B,T]} (targets = shift) or
+    {"inputs","targets"[,"mask"]}."""
+    if "inputs" in batch:
+        inp, tgt = batch["inputs"], batch["targets"]
+        mask = batch.get("mask")
+    else:
+        toks = batch["tokens"]
+        inp, tgt = toks[:, :-1], toks[:, 1:]
+        mask = batch.get("mask")
+        if mask is not None:
+            mask = mask[:, 1:]
+    logits = forward(params, inp, config, mesh=mesh)
+    return cross_entropy_loss(logits, tgt, mask=mask, z_loss=z_loss)
+
+
+def make_train_step(config: TransformerConfig, optimizer, *, mesh=None,
+                    z_loss: float = 0.0):
+    """Build the jittable training step.
+
+    state: {"params", "opt_state", "step"}. With a mesh, jit it with
+    donate_argnums=(0,) and sharded in/out shardings (see
+    parallel.sharding.shard_params); GSPMD inserts the grad
+    reduce-scatters/all-reduces the reference gets from DDP/FSDP wrappers
+    (reference: train/torch/train_loop_utils.py:12,36).
+    """
+
+    def loss_fn(params, batch):
+        return lm_loss(params, batch, config, mesh=mesh, z_loss=z_loss)
+
+    def train_step(state, batch):
+        (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state["params"], batch
+        )
+        updates, opt_state = optimizer.update(
+            grads, state["opt_state"], state["params"]
+        )
+        params = jax.tree.map(
+            lambda p, u: (p + u.astype(p.dtype)), state["params"], updates
+        )
+        gnorm = jnp.sqrt(sum(
+            jnp.sum(jnp.square(g.astype(jnp.float32)))
+            for g in jax.tree.leaves(grads)
+        ))
+        metrics = dict(metrics, grad_norm=gnorm)
+        return {"params": params, "opt_state": opt_state,
+                "step": state["step"] + 1}, metrics
+
+    return train_step
+
+
+def init_train_state(rng, config: TransformerConfig, optimizer):
+    params = init_params(rng, config)
+    return {
+        "params": params,
+        "opt_state": optimizer.init(params),
+        "step": jnp.zeros((), jnp.int32),
+    }
+
+
+# -- decode (KV cache) ------------------------------------------------------
+
+def init_kv_cache(config: TransformerConfig, batch_size: int, max_len: int):
+    """Preallocated decode cache: [L, B, max_len, KV, Dh] per k/v."""
+    c = config
+    shape = (c.n_layers, batch_size, max_len, c.kv_heads, c.head_dim)
+    return {
+        "k": jnp.zeros(shape, c.compute_dtype),
+        "v": jnp.zeros(shape, c.compute_dtype),
+        "pos": jnp.zeros((), jnp.int32),
+    }
+
+
+def decode_step(params, tokens, cache, config: TransformerConfig):
+    """One autoregressive step: tokens [B, S] appended at cache['pos'].
+
+    Returns (logits [B, S, V] float32, updated cache). S=1 for pure
+    decode; S>1 for prefill. Static shapes throughout → one compiled
+    program serves both prefill (S=prompt) and decode (S=1).
+    """
+    c = config
+    dt = c.compute_dtype
+    B, S = tokens.shape
+    pos0 = cache["pos"]
+    positions = pos0 + jnp.arange(S)
+
+    x = params["embed"]["tokens"][tokens].astype(dt)
+    if c.arch == "gpt2":
+        x = x + params["embed"]["pos"][positions].astype(dt)
+        rope = None
+    else:
+        cos, sin = rope_frequencies(c.head_dim, c.max_seq_len,
+                                    theta=c.rope_theta)
+        rope = (cos, sin)
+
+    def layer(x, lp_and_cache):
+        lp, kc, vc = lp_and_cache
+        if c.arch == "gpt2":
+            h = layer_norm(x, lp["ln1"]["w"], lp["ln1"]["b"])
+        else:
+            h = rms_norm(x, lp["ln1"]["w"])
+        q = jnp.einsum("btd,dhk->bthk", h, lp["attn"]["wq"].astype(dt))
+        k = jnp.einsum("btd,dhk->bthk", h, lp["attn"]["wk"].astype(dt))
+        v = jnp.einsum("btd,dhk->bthk", h, lp["attn"]["wv"].astype(dt))
+        if rope is not None:
+            q = apply_rope(q, *rope, positions=positions)
+            k = apply_rope(k, *rope, positions=positions)
+        kc = jax.lax.dynamic_update_slice(kc, k, (0, pos0, 0, 0))
+        vc = jax.lax.dynamic_update_slice(vc, v, (0, pos0, 0, 0))
+        kf, vf = _expand_gqa(kc, vc, c)
+        # Causality against global positions doubles as the cache-validity
+        # mask: unwritten slots sit at k_pos > current positions.
+        o = dot_product_attention(q, kf, vf, causal=True,
+                                  q_offset=pos0).astype(dt)
+        o = jnp.einsum("bshk,hkd->bsd", o, lp["attn"]["wo"].astype(dt))
+        x = x + o
+        if c.arch == "gpt2":
+            h = layer_norm(x, lp["ln2"]["w"], lp["ln2"]["b"])
+            m = gelu_mlp(h, lp["mlp"]["w_in"].astype(dt),
+                         lp["mlp"]["b_in"].astype(dt),
+                         lp["mlp"]["w_out"].astype(dt),
+                         lp["mlp"]["b_out"].astype(dt))
+        else:
+            h = rms_norm(x, lp["ln2"]["w"])
+            m = swiglu(h, lp["mlp"]["w_gate"].astype(dt),
+                       lp["mlp"]["w_up"].astype(dt),
+                       lp["mlp"]["w_down"].astype(dt))
+        return x + m, (kc, vc)
+
+    def scan_body(x, xs):
+        lp, kc, vc = xs
+        x, (kc, vc) = layer(x, (lp, kc, vc))
+        return x, (kc, vc)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        scan_body, x, (params["layers"], cache["k"], cache["v"])
+    )
+    if c.arch == "gpt2":
+        x = layer_norm(x, params["final_norm"]["w"], params["final_norm"]["b"])
+    else:
+        x = rms_norm(x, params["final_norm"]["w"])
+    head = (params["embed"]["tokens"].T if c.tied else params["lm_head"])
+    logits = jnp.einsum("bsd,dv->bsv", x, head.astype(dt),
+                        preferred_element_type=jnp.float32)
+    new_cache = {"k": k_new, "v": v_new, "pos": pos0 + S}
+    return logits, new_cache
+
+
+def generate(params, prompt, config: TransformerConfig, *, max_new_tokens: int,
+             temperature: float = 0.0, rng=None, max_len: int | None = None):
+    """Greedy/temperature sampling loop (prefill + lax.scan decode)."""
+    # Accept numpy param trees (e.g. fresh from device_get / a checkpoint):
+    # numpy arrays can't be indexed by tracers inside the scan.
+    params = jax.tree.map(jnp.asarray, params)
+    prompt = jnp.asarray(prompt)
+    B, T = prompt.shape
+    max_len = max_len or min(config.max_seq_len, T + max_new_tokens)
+    cache = init_kv_cache(config, B, max_len)
+    logits, cache = decode_step(params, prompt, cache, config)
+    last = logits[:, -1]
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+
+    def sample(key, lg):
+        if temperature == 0.0:
+            return lg.argmax(-1).astype(prompt.dtype)
+        return jax.random.categorical(key, lg / temperature).astype(prompt.dtype)
+
+    def step(carry, key):
+        cache, lg = carry
+        tok = sample(key, lg)
+        logits, cache = decode_step(params, tok[:, None], cache, config)
+        return (cache, logits[:, -1]), tok
+
+    keys = jax.random.split(rng, max_new_tokens)
+    (_, _), toks = jax.lax.scan(step, (cache, last), keys)
+    return jnp.concatenate([prompt, toks.T], axis=1)

@@ -41,27 +41,37 @@ def ring_attention(q, k, v, *, axis_name: str = AXIS_SEQUENCE,
 
     q_pos = rank * t_local + jnp.arange(t_local)
 
-    def step(carry, s):
-        k_cur, v_cur, m, l, o = carry
-        # Issue next shard's permute first so the DMA overlaps this
-        # block's matmuls (XLA schedules the independent ops together).
-        k_nxt = jax.lax.ppermute(k_cur, axis_name, ring)
-        v_nxt = jax.lax.ppermute(v_cur, axis_name, ring)
+    def fold(k_cur, v_cur, m, l, o, s):
         # After s hops along the +1 ring, this rank holds the shard that
         # originated at rank - s.
         src = jax.lax.rem(rank - s + n, n)
         k_pos = src * t_local + jnp.arange(t_local)
-        m, l, o = online_softmax_block(
+        return online_softmax_block(
             q, k_cur, v_cur, m, l, o, q_pos=q_pos, k_pos=k_pos, causal=causal
         )
+
+    def step(carry, s):
+        k_cur, v_cur, m, l, o = carry
+        # Issue this shard's permute before folding it in so the DMA
+        # overlaps the block's matmuls (XLA schedules independent ops
+        # together).
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, ring)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, ring)
+        m, l, o = fold(k_cur, v_cur, m, l, o, s)
         return (k_nxt, v_nxt, m, l, o), None
 
     m0 = jnp.full((b, h, t_local), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((b, h, t_local), jnp.float32)
     o0 = jnp.zeros((b, t_local, h, d), jnp.float32)
-    (_, _, m, l, o), _ = jax.lax.scan(
-        step, (k, v, m0, l0, o0), jnp.arange(n)
+    if n == 1:
+        m, l, o = fold(k, v, m0, l0, o0, jnp.int32(0))
+        return _finalize(o, l).astype(q.dtype)
+    # n-1 permuted steps in the scan; the last resident shard is folded
+    # outside the loop so no dead permute crosses the ring.
+    (k_last, v_last, m, l, o), _ = jax.lax.scan(
+        step, (k, v, m0, l0, o0), jnp.arange(n - 1)
     )
+    m, l, o = fold(k_last, v_last, m, l, o, jnp.int32(n - 1))
     return _finalize(o, l).astype(q.dtype)
 
 

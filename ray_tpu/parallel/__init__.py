@@ -22,6 +22,7 @@ from ray_tpu.parallel.mesh import (
     single_device_mesh,
 )
 from ray_tpu.parallel.pipeline import (
+    pipeline_last_to_all,
     pipeline_stage_params,
     pipelined_apply,
     spmd_pipeline,
@@ -48,6 +49,7 @@ __all__ = [
     "batch_sharding",
     "mesh_axis_size",
     "single_device_mesh",
+    "pipeline_last_to_all",
     "pipeline_stage_params",
     "pipelined_apply",
     "spmd_pipeline",

@@ -102,7 +102,7 @@ def _prune_axes(entry, mesh):
     if entry is None:
         return None
     if isinstance(entry, (tuple, list)):
-        kept = tuple(a for a in entry if mesh_axis_size(mesh, a) > 1 or a in mesh.shape)
+        kept = tuple(a for a in entry if a in mesh.shape)
         return kept if kept else None
     return entry if entry in mesh.shape else None
 

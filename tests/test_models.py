@@ -1,0 +1,149 @@
+"""Model library tests (tiny configs on the 8-device CPU mesh)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from ray_tpu import models
+from ray_tpu.parallel.mesh import MeshConfig
+from ray_tpu.parallel.sharding import infer_param_specs, make_shardings
+
+
+@pytest.fixture(scope="module", params=["gpt2", "llama"])
+def arch(request):
+    return request.param
+
+
+def _cfg(arch, **kw):
+    base = dict(dtype="float32")
+    base.update(kw)
+    cfg = models.tiny(arch=arch, **base)
+    if arch == "llama":
+        cfg = models.tiny(arch="llama", n_kv_heads=2, **base)
+    return cfg
+
+
+def test_forward_shapes(arch):
+    cfg = _cfg(arch)
+    params = models.init_params(jax.random.PRNGKey(0), cfg)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, cfg.vocab_size)
+    logits = models.forward(params, toks, cfg)
+    assert logits.shape == (2, 16, cfg.vocab_size)
+    assert logits.dtype == jnp.float32
+    assert bool(jnp.isfinite(logits).all())
+
+
+def test_causality(arch):
+    """Changing a future token must not affect earlier logits."""
+    cfg = _cfg(arch)
+    params = models.init_params(jax.random.PRNGKey(0), cfg)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (1, 12), 0, cfg.vocab_size)
+    toks2 = toks.at[0, -1].set((toks[0, -1] + 1) % cfg.vocab_size)
+    a = models.forward(params, toks, cfg)
+    b = models.forward(params, toks2, cfg)
+    np.testing.assert_allclose(a[:, :-1], b[:, :-1], atol=1e-4)
+
+
+def test_train_step_learns(arch):
+    """A few steps on a fixed batch reduces loss."""
+    cfg = _cfg(arch)
+    opt = optax.adamw(1e-2)
+    state = models.init_train_state(jax.random.PRNGKey(0), cfg, opt)
+    step = jax.jit(models.make_train_step(cfg, opt))
+    batch = {
+        "tokens": jax.random.randint(jax.random.PRNGKey(2), (4, 32), 0,
+                                     cfg.vocab_size)
+    }
+    state, m0 = step(state, batch)
+    for _ in range(10):
+        state, m = step(state, batch)
+    assert float(m["loss"]) < float(m0["loss"])
+    assert int(state["step"]) == 11
+    assert bool(jnp.isfinite(m["grad_norm"]))
+
+
+def test_loss_mask():
+    cfg = _cfg("gpt2")
+    params = models.init_params(jax.random.PRNGKey(0), cfg)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, cfg.vocab_size)
+    mask = jnp.ones((2, 16)).at[:, 8:].set(0)
+    full, _ = models.lm_loss(params, {"tokens": toks}, cfg)
+    masked, _ = models.lm_loss(params, {"tokens": toks, "mask": mask}, cfg)
+    assert not np.isclose(float(full), float(masked))
+
+
+def test_sharded_train_step(arch):
+    """pjit the train step over a 2x2x2 dp×fsdp×tensor mesh."""
+    cfg = _cfg(arch)
+    mesh = MeshConfig(data=2, fsdp=2, tensor=2).build()
+    opt = optax.adamw(1e-2)
+    state = models.init_train_state(jax.random.PRNGKey(0), cfg, opt)
+    base = models.partition_specs(cfg)
+    specs = infer_param_specs(state["params"], mesh, base)
+    shardings = make_shardings(mesh, specs)
+    state = {
+        "params": jax.tree.map(jax.device_put, state["params"], shardings),
+        "opt_state": state["opt_state"],
+        "step": state["step"],
+    }
+    step = jax.jit(models.make_train_step(cfg, opt, mesh=mesh),
+                   donate_argnums=(0,))
+    batch = {
+        "tokens": jax.random.randint(jax.random.PRNGKey(2), (4, 32), 0,
+                                     cfg.vocab_size)
+    }
+    state, metrics = step(state, batch)
+    state, metrics = step(state, batch)
+    assert bool(jnp.isfinite(metrics["loss"]))
+
+    # Sharded result matches single-device result.
+    state2 = models.init_train_state(jax.random.PRNGKey(0), cfg, opt)
+    step2 = jax.jit(models.make_train_step(cfg, opt))
+    state2, _ = step2(state2, batch)
+    state2, m2 = step2(state2, batch)
+    np.testing.assert_allclose(float(metrics["loss"]), float(m2["loss"]),
+                               rtol=2e-3)
+
+
+def test_decode_matches_forward(arch):
+    """Prefill+decode through the KV cache == full forward logits."""
+    cfg = _cfg(arch)
+    params = models.init_params(jax.random.PRNGKey(0), cfg)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 10), 0, cfg.vocab_size)
+    full = models.forward(params, toks, cfg)
+
+    cache = models.init_kv_cache(cfg, 2, 16)
+    logits_p, cache = models.decode_step(params, toks[:, :6], cache, cfg)
+    np.testing.assert_allclose(np.asarray(logits_p), np.asarray(full[:, :6]),
+                               atol=2e-3)
+    for t in range(6, 10):
+        logits_t, cache = models.decode_step(params, toks[:, t:t + 1], cache,
+                                             cfg)
+        np.testing.assert_allclose(np.asarray(logits_t[:, 0]),
+                                   np.asarray(full[:, t]), atol=2e-3)
+
+
+def test_generate(arch):
+    cfg = _cfg(arch)
+    params = models.init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 5), 0,
+                                cfg.vocab_size)
+    out = models.generate(params, prompt, cfg, max_new_tokens=7)
+    assert out.shape == (2, 12)
+    np.testing.assert_array_equal(np.asarray(out[:, :5]), np.asarray(prompt))
+
+
+def test_partition_specs_mirror_params(arch):
+    cfg = _cfg(arch)
+    params = models.init_params(jax.random.PRNGKey(0), cfg)
+    specs = models.partition_specs(cfg)
+    # Same tree structure.
+    jax.tree.map(lambda p, s: None, params, specs,
+                 is_leaf=lambda x: x is None or not isinstance(x, dict))
+
+
+def test_param_counts():
+    assert 120e6 < models.gpt2_small().num_params() < 170e6
+    assert 6e9 < models.llama2_7b().num_params() < 7.5e9
