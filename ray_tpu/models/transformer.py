@@ -548,6 +548,11 @@ def generate(params, prompt, config: TransformerConfig, *, max_new_tokens: int,
     prompt = jnp.asarray(prompt)
     B, T = prompt.shape
     max_len = max_len or min(config.max_seq_len, T + max_new_tokens)
+    # Never decode past the cache/pos-embedding capacity: out-of-range
+    # dynamic_update_slice writes clamp silently and corrupt the cache.
+    max_new_tokens = min(max_new_tokens, max_len - T)
+    if max_new_tokens <= 0:
+        return prompt
     cache = init_kv_cache(config, B, max_len)
     logits, cache = decode_step(params, prompt, cache, config)
     last = logits[:, -1]

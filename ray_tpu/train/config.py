@@ -58,6 +58,9 @@ class CheckpointConfig:
     num_to_keep: int | None = None
     checkpoint_score_attribute: str | None = None
     checkpoint_score_order: str = "max"  # "max" | "min"
+    # Tune: auto-save trial state every N iterations (0 = only on
+    # pause/exploit). Reference: air/config.py CheckpointConfig.checkpoint_frequency.
+    checkpoint_frequency: int = 0
 
 
 @dataclasses.dataclass
@@ -68,6 +71,9 @@ class RunConfig:
     storage_path: str | None = None
     failure_config: FailureConfig | None = None
     checkpoint_config: CheckpointConfig | None = None
+    # Stop criterion (used by Tune): dict of metric -> threshold, or a
+    # callable result -> bool (reference: air/config.py RunConfig.stop).
+    stop: Any = None
 
     def resolved_storage_path(self) -> str:
         base = self.storage_path or os.path.expanduser("~/ray_tpu_results")

@@ -1,0 +1,73 @@
+"""ray_tpu.tune: hyperparameter search over the actor runtime.
+
+Counterpart of the reference's python/ray/tune (SURVEY.md §2.3): Tuner.fit
+drives a TuneController event loop over trial actors; searchers generate
+configs, schedulers make early-stopping / PBT decisions."""
+
+from ray_tpu.train.config import CheckpointConfig, FailureConfig, Result, RunConfig
+from ray_tpu.tune.schedulers import (
+    ASHAScheduler,
+    AsyncHyperBandScheduler,
+    FIFOScheduler,
+    MedianStoppingRule,
+    PopulationBasedTraining,
+    TrialScheduler,
+)
+from ray_tpu.tune.search import (
+    BasicVariantGenerator,
+    OptunaSearch,
+    Searcher,
+    choice,
+    grid_search,
+    lograndint,
+    loguniform,
+    quniform,
+    randint,
+    randn,
+    sample_from,
+    uniform,
+)
+from ray_tpu.tune.trainable import (
+    Trainable,
+    get_checkpoint,
+    get_trial_dir,
+    get_trial_id,
+    report,
+)
+from ray_tpu.tune.tuner import ResultGrid, Trial, TuneConfig, TuneController, Tuner, run
+
+__all__ = [
+    "ASHAScheduler",
+    "CheckpointConfig",
+    "AsyncHyperBandScheduler",
+    "BasicVariantGenerator",
+    "FIFOScheduler",
+    "FailureConfig",
+    "MedianStoppingRule",
+    "OptunaSearch",
+    "PopulationBasedTraining",
+    "Result",
+    "ResultGrid",
+    "RunConfig",
+    "Searcher",
+    "Trainable",
+    "Trial",
+    "TrialScheduler",
+    "TuneConfig",
+    "TuneController",
+    "Tuner",
+    "choice",
+    "get_checkpoint",
+    "get_trial_dir",
+    "get_trial_id",
+    "grid_search",
+    "lograndint",
+    "loguniform",
+    "quniform",
+    "randint",
+    "randn",
+    "report",
+    "run",
+    "sample_from",
+    "uniform",
+]

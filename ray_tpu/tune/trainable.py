@@ -1,0 +1,276 @@
+"""Trainable API + the trial actor that hosts it.
+
+Counterpart of the reference's tune/trainable/trainable.py:57 (class API:
+setup/step/save_checkpoint/load_checkpoint) and function trainables
+(tune/trainable/function_trainable.py — the user function runs on its own
+thread and `tune.report` hands results to the controller with
+backpressure). One `TrialActor` process hosts one trial; the controller
+drives it via `step()` calls, so pausing/stopping a trial never blocks the
+experiment loop.
+"""
+
+from __future__ import annotations
+
+import inspect
+import os
+import queue
+import shutil
+import threading
+import time
+from typing import Any, Callable, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+
+DONE = "done"
+TRAINING_ITERATION = "training_iteration"
+
+
+class Trainable:
+    """Class trainable (reference: tune/trainable/trainable.py:57)."""
+
+    def __init__(self, config: dict | None = None, trial_dir: str | None = None):
+        self.config = config or {}
+        self.trial_dir = trial_dir or os.getcwd()
+        self.iteration = 0
+        self.setup(self.config)
+
+    # --- subclass surface ---
+
+    def setup(self, config: dict) -> None:
+        pass
+
+    def step(self) -> dict:
+        raise NotImplementedError
+
+    def save_checkpoint(self, checkpoint_dir: str) -> Optional[dict]:
+        raise NotImplementedError(f"{type(self).__name__} does not implement save_checkpoint")
+
+    def load_checkpoint(self, checkpoint_dir: str) -> None:
+        raise NotImplementedError(f"{type(self).__name__} does not implement load_checkpoint")
+
+    def cleanup(self) -> None:
+        pass
+
+    def reset_config(self, new_config: dict) -> bool:
+        """Return True if the trainable can hot-swap configs (PBT exploit
+        without an actor restart). Default: not supported."""
+        return False
+
+    # --- driver surface ---
+
+    def train(self) -> dict:
+        result = self.step() or {}
+        self.iteration += 1
+        result.setdefault(TRAINING_ITERATION, self.iteration)
+        return result
+
+
+class _StopTrial(Exception):
+    """Raised inside a function trainable's thread to unwind it."""
+
+
+class _TuneSession:
+    """Per-process session backing `tune.report` inside function trainables."""
+
+    def __init__(self, trial_id: str, trial_dir: str, checkpoint: Checkpoint | None):
+        self.trial_id = trial_id
+        self.trial_dir = trial_dir
+        self.checkpoint = checkpoint
+        self.results: "queue.Queue[tuple]" = queue.Queue()
+        self.resume = threading.Event()
+        self.stopped = False
+
+    def report(self, metrics: dict, checkpoint: Checkpoint | None = None) -> None:
+        if self.stopped:
+            raise _StopTrial()
+        if checkpoint is not None:
+            self.checkpoint = checkpoint
+        self.results.put(("result", dict(metrics), checkpoint))
+        # Backpressure: wait for the controller to consume this result
+        # before computing the next one (reference function-trainable
+        # semantics), so PAUSE/STOP decisions apply promptly.
+        self.resume.wait()
+        self.resume.clear()
+        if self.stopped:
+            raise _StopTrial()
+
+
+_session: _TuneSession | None = None
+
+
+def _set_session(s: _TuneSession | None) -> None:
+    global _session
+    _session = s
+
+
+def get_session() -> _TuneSession:
+    if _session is None:
+        raise RuntimeError("tune.report()/get_checkpoint() called outside a Tune trial")
+    return _session
+
+
+def in_session() -> bool:
+    return _session is not None
+
+
+def report(metrics: dict, checkpoint: Checkpoint | None = None) -> None:
+    """Public `tune.report` (also reachable as ray_tpu.tune.report)."""
+    get_session().report(metrics, checkpoint)
+
+
+def get_checkpoint() -> Checkpoint | None:
+    return get_session().checkpoint
+
+
+def get_trial_id() -> str:
+    return get_session().trial_id
+
+
+def get_trial_dir() -> str:
+    return get_session().trial_dir
+
+
+def is_function_trainable(t: Any) -> bool:
+    return callable(t) and not (inspect.isclass(t) and issubclass(t, Trainable))
+
+
+class TrialActor:
+    """Hosts one trial: a Trainable instance or a function-on-a-thread.
+
+    Spawned via ray_tpu actors by the TuneController
+    (reference analogue: tune_controller.py:964 _schedule_trial_actor).
+    """
+
+    def __init__(
+        self,
+        trainable: Any,
+        config: dict,
+        trial_id: str,
+        trial_dir: str,
+        checkpoint_path: str | None = None,
+    ):
+        os.makedirs(trial_dir, exist_ok=True)
+        self._config = config
+        self._trial_id = trial_id
+        self._trial_dir = trial_dir
+        self._ckpt_index = 0
+        self._latest_ckpt = checkpoint_path
+        self._start = time.monotonic()
+        self._trainable: Trainable | None = None
+        self._fn: Callable | None = None
+        self._thread: threading.Thread | None = None
+        self._fn_error: list[BaseException] = []
+        self._last_metrics: dict = {}
+        self._iter = 0
+        if is_function_trainable(trainable):
+            self._fn = trainable
+            self._sess = _TuneSession(
+                trial_id, trial_dir, Checkpoint(checkpoint_path) if checkpoint_path else None
+            )
+        else:
+            self._trainable = trainable(config, trial_dir)
+            if checkpoint_path:
+                self._trainable.load_checkpoint(checkpoint_path)
+                # Iteration count continues from the checkpoint's manifest.
+                meta = os.path.join(checkpoint_path, ".tune_iteration")
+                if os.path.exists(meta):
+                    with open(meta) as f:
+                        self._trainable.iteration = int(f.read())
+
+    # ------------------------------------------------------------------
+
+    def _fn_main(self) -> None:
+        _set_session(self._sess)
+        try:
+            self._fn(self._config)
+        except _StopTrial:
+            pass
+        except BaseException as e:  # noqa: BLE001 — surfaced via step()
+            self._fn_error.append(e)
+        finally:
+            self._sess.results.put(("done",))
+            _set_session(None)
+
+    def step(self) -> dict:
+        """Run/collect one reporting interval. Returns the result dict with
+        `done=True` appended when the trial is finished."""
+        if self._trainable is not None:
+            result = self._trainable.train()
+            result[DONE] = bool(result.get(DONE, False))
+            result["time_total_s"] = time.monotonic() - self._start
+            return result
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._fn_main, daemon=True, name="tune-fn")
+            self._thread.start()
+        item = self._sess.results.get()
+        if item[0] == "done":
+            self._thread.join()
+            if self._fn_error:
+                raise self._fn_error[0]
+            # Function returned: final result repeats the last reported
+            # metrics with done=True (reference function-trainable behavior).
+            final = dict(self._last_metrics)
+            final[DONE] = True
+            final[TRAINING_ITERATION] = max(self._iter, 1)
+            final["time_total_s"] = time.monotonic() - self._start
+            return final
+        _, metrics, checkpoint = item
+        if checkpoint is not None:
+            self._latest_ckpt = self._persist(checkpoint)
+        self._sess.resume.set()
+        self._iter += 1
+        metrics.setdefault(TRAINING_ITERATION, self._iter)
+        metrics[DONE] = bool(metrics.get(DONE, False))
+        metrics["time_total_s"] = time.monotonic() - self._start
+        self._last_metrics = {k: v for k, v in metrics.items() if k != "time_total_s"}
+        return metrics
+
+    def _persist(self, checkpoint: Checkpoint) -> str:
+        dest = os.path.join(self._trial_dir, f"checkpoint_{self._ckpt_index:06d}")
+        self._ckpt_index += 1
+        if os.path.abspath(checkpoint.path) != dest:
+            if os.path.exists(dest):
+                shutil.rmtree(dest)
+            shutil.copytree(checkpoint.path, dest)
+        return dest
+
+    def save(self) -> str | None:
+        """Checkpoint the trial; returns the checkpoint path."""
+        if self._trainable is not None:
+            dest = os.path.join(self._trial_dir, f"checkpoint_{self._ckpt_index:06d}")
+            self._ckpt_index += 1
+            os.makedirs(dest, exist_ok=True)
+            self._trainable.save_checkpoint(dest)
+            with open(os.path.join(dest, ".tune_iteration"), "w") as f:
+                f.write(str(self._trainable.iteration))
+            self._latest_ckpt = dest
+            return dest
+        return self._latest_ckpt  # function trials: latest reported checkpoint
+
+    def latest_checkpoint(self) -> str | None:
+        return self._latest_ckpt
+
+    def reset(self, new_config: dict) -> bool:
+        """PBT exploit fast path: swap config in place if supported."""
+        if self._trainable is not None and self._trainable.reset_config(new_config):
+            self._trainable.config = new_config
+            self._config = new_config
+            return True
+        return False
+
+    def restore(self, checkpoint_path: str) -> None:
+        if self._trainable is not None:
+            self._trainable.load_checkpoint(checkpoint_path)
+            meta = os.path.join(checkpoint_path, ".tune_iteration")
+            if os.path.exists(meta):
+                with open(meta) as f:
+                    self._trainable.iteration = int(f.read())
+        self._latest_ckpt = checkpoint_path
+
+    def stop(self) -> None:
+        if self._trainable is not None:
+            self._trainable.cleanup()
+        elif self._thread is not None and self._thread.is_alive():
+            self._sess.stopped = True
+            self._sess.resume.set()
+            self._thread.join(timeout=2.0)

@@ -1,0 +1,296 @@
+"""Tune: searchers, schedulers, controller loop, checkpoint/restore, PBT.
+
+Modeled on the reference's tune test strategy (SURVEY.md §4 — Tune 55 test
+files, e.g. test_tune_restore.py, test_trial_scheduler.py): fast function/
+class trainables with deterministic curves so scheduler decisions are
+assertable."""
+
+from __future__ import annotations
+
+import os
+import random
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.tune.schedulers import ExploitDecision
+from ray_tpu.tune.search import BasicVariantGenerator
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _cluster():
+    ray_tpu.init(ignore_reinit_error=True)
+    yield
+
+
+# ---------------------------------------------------------------------------
+# search spaces
+
+
+def test_basic_variant_grid_and_samples():
+    space = {
+        "a": tune.grid_search([1, 2, 3]),
+        "b": tune.grid_search(["x", "y"]),
+        "c": tune.uniform(0.0, 1.0),
+        "fixed": 7,
+    }
+    gen = BasicVariantGenerator(space, num_samples=2, seed=0)
+    variants = [gen.suggest(str(i)) for i in range(len(gen))]
+    assert len(variants) == 12  # 3 * 2 grid, times 2 samples
+    assert gen.suggest("overflow") is None
+    assert {v["a"] for v in variants} == {1, 2, 3}
+    assert all(0.0 <= v["c"] <= 1.0 and v["fixed"] == 7 for v in variants)
+
+
+def test_domain_sampling():
+    rng = random.Random(0)
+    assert 1 <= tune.randint(1, 10).sample(rng) < 10
+    assert 1e-4 <= tune.loguniform(1e-4, 1e-1).sample(rng) <= 1e-1
+    assert tune.choice([1, 2]).sample(rng) in (1, 2)
+    q = tune.quniform(0.0, 1.0, 0.25).sample(rng)
+    assert abs(q / 0.25 - round(q / 0.25)) < 1e-9
+    cfg = {"a": 2, "b": tune.sample_from(lambda c: c["a"] * 10)}
+    gen = BasicVariantGenerator(cfg, num_samples=1)
+    assert gen.suggest("t")["b"] == 20
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: function trainable
+
+
+def _objective(config):
+    score = 0.0
+    for _ in range(5):
+        score += config["lr"]
+        tune.report({"score": score})
+
+
+def test_tuner_function_trainable(tmp_path):
+    results = tune.Tuner(
+        _objective,
+        param_space={"lr": tune.grid_search([0.1, 1.0, 10.0])},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+        run_config=tune.RunConfig(name="fn_grid", storage_path=str(tmp_path)),
+    ).fit()
+    assert len(results) == 3
+    best = results.get_best_result()
+    assert best.metrics["config"]["lr"] == 10.0
+    assert best.metrics["score"] == pytest.approx(50.0)
+    assert best.metrics["training_iteration"] == 5
+    assert results.num_errors == 0
+
+
+def test_tune_run_with_stop_criterion(tmp_path):
+    grid = tune.run(
+        _objective,
+        config={"lr": tune.grid_search([1.0])},
+        metric="score",
+        mode="max",
+        stop={"training_iteration": 2},
+        storage_path=str(tmp_path),
+    )
+    assert grid[0].metrics["training_iteration"] == 2
+
+
+# ---------------------------------------------------------------------------
+# class trainable + checkpointing
+
+
+class Counter(tune.Trainable):
+    def setup(self, config):
+        self.x = 0
+        self.mult = config.get("mult", 1)
+
+    def step(self):
+        self.x += self.mult
+        return {"value": self.x, "done": self.iteration + 1 >= 4}
+
+    def save_checkpoint(self, d):
+        with open(os.path.join(d, "x.txt"), "w") as f:
+            f.write(str(self.x))
+
+    def load_checkpoint(self, d):
+        with open(os.path.join(d, "x.txt")) as f:
+            self.x = int(f.read())
+
+
+def test_class_trainable_runs_to_done(tmp_path):
+    grid = tune.Tuner(
+        Counter,
+        param_space={"mult": tune.grid_search([1, 3])},
+        tune_config=tune.TuneConfig(metric="value", mode="max"),
+        run_config=tune.RunConfig(name="cls", storage_path=str(tmp_path)),
+    ).fit()
+    best = grid.get_best_result()
+    assert best.metrics["value"] == 12  # 4 steps * mult 3
+    assert best.metrics["done"] is True
+
+
+class Flaky(tune.Trainable):
+    """Fails once at iteration 3 (per actor incarnation) to exercise
+    restore-from-checkpoint retry."""
+
+    def setup(self, config):
+        self.x = 0
+        self.crashed = False
+
+    def step(self):
+        self.x += 1
+        if self.x == 3 and not self.crashed:
+            raise RuntimeError("boom")
+        return {"value": self.x, "done": self.x >= 5}
+
+    def save_checkpoint(self, d):
+        with open(os.path.join(d, "x.txt"), "w") as f:
+            f.write(f"{self.x}")
+
+    def load_checkpoint(self, d):
+        with open(os.path.join(d, "x.txt")) as f:
+            self.x = int(f.read())
+        self.crashed = True  # survived a restart
+
+
+def test_trial_failure_retry_restores(tmp_path):
+    grid = tune.Tuner(
+        Flaky,
+        param_space={},
+        tune_config=tune.TuneConfig(metric="value", mode="max"),
+        run_config=tune.RunConfig(
+            name="flaky",
+            storage_path=str(tmp_path),
+            failure_config=tune.FailureConfig(max_failures=2),
+            checkpoint_config=tune.CheckpointConfig(checkpoint_frequency=1),
+        ),
+    ).fit()
+    assert grid.num_errors == 0
+    assert grid[0].metrics["value"] == 5
+
+
+# ---------------------------------------------------------------------------
+# schedulers
+
+
+def _curve(config):
+    # Deterministic learning curves: good trials grow fast.
+    for i in range(1, 9):
+        tune.report({"acc": config["slope"] * i})
+
+
+def test_asha_stops_bad_trials(tmp_path):
+    grid = tune.Tuner(
+        _curve,
+        param_space={"slope": tune.grid_search([1, 2, 3, 4, 5, 6, 7, 8])},
+        tune_config=tune.TuneConfig(
+            metric="acc",
+            mode="max",
+            scheduler=tune.ASHAScheduler(max_t=8, grace_period=2, reduction_factor=2),
+            max_concurrent_trials=4,
+        ),
+        run_config=tune.RunConfig(name="asha", storage_path=str(tmp_path)),
+    ).fit()
+    iters = {r.metrics["config"]["slope"]: r.metrics["training_iteration"] for r in grid}
+    assert grid.get_best_result().metrics["config"]["slope"] == 8
+    # At least one poor trial must have been cut before max_t.
+    assert min(iters.values()) < 8
+    # The best trial ran to completion.
+    assert iters[8] == 8
+
+
+def test_median_stopping_rule_decisions():
+    sched = tune.MedianStoppingRule(
+        metric="acc", mode="max", grace_period=2, min_samples_required=2
+    )
+
+    class T:
+        def __init__(self, tid):
+            self.trial_id = tid
+
+    # Two strong trials establish the median.
+    for tid, acc in (("a", 10.0), ("b", 12.0)):
+        for it in range(1, 4):
+            assert sched.on_trial_result(T(tid), {"training_iteration": it, "acc": acc}) == "CONTINUE"
+    # A weak trial past grace gets stopped.
+    t = T("weak")
+    sched.on_trial_result(t, {"training_iteration": 1, "acc": 1.0})
+    assert sched.on_trial_result(t, {"training_iteration": 3, "acc": 1.0}) == "STOP"
+
+
+class PBTTrainable(tune.Trainable):
+    def setup(self, config):
+        self.score = 0.0
+
+    def step(self):
+        self.score += self.config["rate"]
+        return {"score": self.score, "done": self.iteration + 1 >= 12}
+
+    def save_checkpoint(self, d):
+        with open(os.path.join(d, "s.txt"), "w") as f:
+            f.write(str(self.score))
+
+    def load_checkpoint(self, d):
+        with open(os.path.join(d, "s.txt")) as f:
+            self.score = float(f.read())
+
+
+def test_pbt_synch_exploits_better_config(tmp_path):
+    # Synchronized PBT (reference pbt.py synch=True): all trials pause at
+    # each perturbation boundary, bottom quantile clones top quantile. This
+    # is deterministic regardless of relative trial speed.
+    pbt = tune.PopulationBasedTraining(
+        metric="score",
+        mode="max",
+        perturbation_interval=3,
+        hyperparam_mutations={"rate": tune.uniform(0.5, 2.0)},
+        quantile_fraction=0.5,
+        synch=True,
+        seed=0,
+    )
+    grid = tune.Tuner(
+        PBTTrainable,
+        param_space={"rate": tune.grid_search([0.1, 2.0])},
+        tune_config=tune.TuneConfig(metric="score", mode="max", scheduler=pbt),
+        run_config=tune.RunConfig(name="pbt", storage_path=str(tmp_path)),
+    ).fit()
+    # The weak trial (rate=0.1 → 1.2 if never exploited) must have cloned
+    # the strong trial's state (score ≥ 6.0 at the first boundary) and a
+    # rate ≥ 0.5, so every final score clears 1.2 by a wide margin.
+    scores = [r.metrics["score"] for r in grid]
+    assert all(s > 5.0 for s in scores), scores
+    configs = [r.metrics["config"]["rate"] for r in grid]
+    assert 0.1 not in configs  # the weak config was replaced at a boundary
+
+
+def test_pbt_emits_exploit_decision():
+    pbt = tune.PopulationBasedTraining(
+        metric="m", mode="max", perturbation_interval=2,
+        hyperparam_mutations={"p": [1, 2, 4]}, quantile_fraction=0.5, seed=1,
+    )
+
+    class T:
+        def __init__(self, tid, config):
+            self.trial_id, self.config = tid, config
+            self.experiment_trials = []
+
+    hi, lo = T("hi", {"p": 4}), T("lo", {"p": 1})
+    hi.experiment_trials = lo.experiment_trials = [hi, lo]
+    assert pbt.on_trial_result(hi, {"training_iteration": 2, "m": 100.0}) == "CONTINUE"
+    d = pbt.on_trial_result(lo, {"training_iteration": 2, "m": 1.0})
+    assert isinstance(d, ExploitDecision)
+    assert d.source is hi
+    assert "p" in d.new_config
+
+
+# ---------------------------------------------------------------------------
+# concurrency cap
+
+
+def test_max_concurrent_trials_and_time_fields(tmp_path):
+    grid = tune.Tuner(
+        _objective,
+        param_space={"lr": tune.grid_search([0.1] * 6)},
+        tune_config=tune.TuneConfig(metric="score", mode="max", max_concurrent_trials=2),
+        run_config=tune.RunConfig(name="cap", storage_path=str(tmp_path)),
+    ).fit()
+    assert len(grid) == 6
+    assert all("time_total_s" in r.metrics for r in grid)
