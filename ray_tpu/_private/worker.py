@@ -78,11 +78,20 @@ class Worker:
 
     @staticmethod
     def _set_tpu_env(chips) -> None:
-        """TPU chip visibility pinning (reference semantics:
-        _private/accelerators/tpu.py:193 set_current_process_visible_…)."""
+        """TPU chip visibility pinning for the actor lifetime (reference
+        semantics: _private/accelerators/tpu.py:193
+        set_current_process_visible_…). Actors without a TPU lease are
+        pinned to CPU jax — same policy as the reference making unleased
+        GPUs invisible (CUDA_VISIBLE_DEVICES=\"\"): parallel actors must
+        not contend for the chips the driver owns. Only effective before
+        this process's first jax import (the normal case — user code is
+        imported lazily). Normal tasks get the same pinning per-task with
+        save/restore in _run_task."""
         if chips:
             os.environ["TPU_VISIBLE_CHIPS"] = ",".join(str(c) for c in chips)
             os.environ["TPU_CHIPS_PER_PROCESS_BOUNDS"] = f"1,{len(chips)},1"
+        elif "jax" not in sys.modules:
+            os.environ["JAX_PLATFORMS"] = "cpu"
 
     # ------------------------------------------------------------------
 
@@ -113,6 +122,12 @@ class Worker:
         if tpu_chips:
             env_vars = dict(env_vars)
             env_vars["TPU_VISIBLE_CHIPS"] = ",".join(str(c) for c in tpu_chips)
+        elif spec.actor_id is None and "jax" not in sys.modules and "JAX_PLATFORMS" not in env_vars:
+            # Chipless task: keep this worker's (first) jax import off the
+            # TPU. Applied on the executor thread with save/restore, so a
+            # later TPU-leased task on this worker is unaffected.
+            env_vars = dict(env_vars)
+            env_vars["JAX_PLATFORMS"] = "cpu"
         for k, v in env_vars.items():
             saved_env[k] = os.environ.get(k)
             os.environ[k] = str(v)

@@ -547,7 +547,7 @@ def generate(params, prompt, config: TransformerConfig, *, max_new_tokens: int,
     params = jax.tree.map(jnp.asarray, params)
     prompt = jnp.asarray(prompt)
     B, T = prompt.shape
-    max_len = max_len or min(config.max_seq_len, T + max_new_tokens)
+    max_len = min(max_len or T + max_new_tokens, config.max_seq_len)
     # Never decode past the cache/pos-embedding capacity: out-of-range
     # dynamic_update_slice writes clamp silently and corrupt the cache.
     max_new_tokens = min(max_new_tokens, max_len - T)

@@ -1,0 +1,37 @@
+"""ray_tpu.rllib: reinforcement learning on the TPU-native runtime.
+
+Counterpart of the reference's rllib new API stack (SURVEY.md §2.3):
+EnvRunners (CPU actors) sample vectorized envs; the JaxLearner runs one
+jitted update step — data-parallel scaling is a mesh sharding on the batch,
+not DDP. Algorithms are Tune Trainables (Tuner(PPO, ...) works)."""
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig, vtrace
+from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
+from ray_tpu.rllib.core.learner import JaxLearner, LearnerGroup
+from ray_tpu.rllib.core.rl_module import (
+    DiscreteActorCriticModule,
+    RLModule,
+    RLModuleSpec,
+)
+from ray_tpu.rllib.env.env_runner import EnvRunnerGroup, SingleAgentEnvRunner
+from ray_tpu.rllib.sample_batch import SampleBatch, compute_gae
+
+__all__ = [
+    "Algorithm",
+    "AlgorithmConfig",
+    "DiscreteActorCriticModule",
+    "EnvRunnerGroup",
+    "IMPALA",
+    "IMPALAConfig",
+    "JaxLearner",
+    "LearnerGroup",
+    "PPO",
+    "PPOConfig",
+    "RLModule",
+    "RLModuleSpec",
+    "SampleBatch",
+    "SingleAgentEnvRunner",
+    "compute_gae",
+    "vtrace",
+]

@@ -1,0 +1,197 @@
+"""Algorithm + AlgorithmConfig: the RL training controller.
+
+Counterpart of the reference's Algorithm (rllib/algorithms/algorithm.py:199
+— a Tune Trainable; step :924, training_step :1749) and AlgorithmConfig
+(algorithm_config.py — fluent .environment()/.training()/.env_runners()
+builder). Algorithm subclasses ray_tpu.tune.Trainable, so `Tuner(PPO, ...)`
+works exactly like the reference's `Tuner("PPO", ...)`."""
+
+from __future__ import annotations
+
+import copy
+import os
+import pickle
+from typing import Any, Callable, Optional, Type
+
+import numpy as np
+
+from ray_tpu.rllib.core.rl_module import RLModuleSpec
+from ray_tpu.rllib.env.env_runner import EnvRunnerGroup
+from ray_tpu.tune.trainable import Trainable
+
+
+class AlgorithmConfig:
+    """Fluent builder (reference: rllib/algorithms/algorithm_config.py)."""
+
+    def __init__(self, algo_class: Optional[Type["Algorithm"]] = None):
+        self.algo_class = algo_class
+        # environment
+        self.env: Any = None
+        self.observation_dim: int | None = None
+        self.action_dim: int | None = None
+        # env runners
+        self.num_env_runners = 0
+        self.num_envs_per_env_runner = 8
+        self.num_cpus_per_env_runner = 1.0
+        self.rollout_fragment_length = 64
+        # training
+        self.lr = 3e-4
+        self.gamma = 0.99
+        self.train_batch_size = 512
+        self.minibatch_size = 128
+        self.num_epochs = 4
+        self.grad_clip: float | None = 0.5
+        self.model: dict = {"hidden": (64, 64)}
+        # learner
+        self.num_learners = 0
+        self.mesh = None  # jax Mesh for in-jit data parallelism
+        # misc
+        self.seed = 0
+
+    # --- fluent sections ---
+
+    def environment(self, env: Any = None, *, observation_dim: int | None = None,
+                    action_dim: int | None = None) -> "AlgorithmConfig":
+        if env is not None:
+            self.env = env
+        if observation_dim is not None:
+            self.observation_dim = observation_dim
+        if action_dim is not None:
+            self.action_dim = action_dim
+        return self
+
+    def env_runners(self, *, num_env_runners: int | None = None,
+                    num_envs_per_env_runner: int | None = None,
+                    rollout_fragment_length: int | None = None,
+                    num_cpus_per_env_runner: float | None = None) -> "AlgorithmConfig":
+        if num_env_runners is not None:
+            self.num_env_runners = num_env_runners
+        if num_envs_per_env_runner is not None:
+            self.num_envs_per_env_runner = num_envs_per_env_runner
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        if num_cpus_per_env_runner is not None:
+            self.num_cpus_per_env_runner = num_cpus_per_env_runner
+        return self
+
+    def training(self, **kwargs) -> "AlgorithmConfig":
+        for k, v in kwargs.items():
+            if not hasattr(self, k):
+                raise AttributeError(f"unknown training option {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def learners(self, *, num_learners: int | None = None, mesh=None) -> "AlgorithmConfig":
+        if num_learners is not None:
+            self.num_learners = num_learners
+        if mesh is not None:
+            self.mesh = mesh
+        return self
+
+    def debugging(self, *, seed: int | None = None) -> "AlgorithmConfig":
+        if seed is not None:
+            self.seed = seed
+        return self
+
+    # --- resolution ---
+
+    def _infer_spaces(self) -> None:
+        if self.observation_dim is not None and self.action_dim is not None:
+            return
+        from ray_tpu.rllib.env.env_runner import _make_env_fn
+
+        env = _make_env_fn(self.env)()
+        try:
+            self.observation_dim = int(np.prod(env.observation_space.shape))
+            self.action_dim = int(env.action_space.n)
+        finally:
+            try:
+                env.close()
+            except Exception:
+                pass
+
+    def rl_module_spec(self) -> RLModuleSpec:
+        return RLModuleSpec(
+            observation_dim=self.observation_dim,
+            action_dim=self.action_dim,
+            hidden=tuple(self.model.get("hidden", (64, 64))),
+        )
+
+    def copy(self) -> "AlgorithmConfig":
+        mesh, self.mesh = self.mesh, None  # Mesh is not deep-copyable
+        try:
+            c = copy.deepcopy(self)
+        finally:
+            self.mesh = mesh
+        c.mesh = mesh
+        return c
+
+    def build(self) -> "Algorithm":
+        if self.algo_class is None:
+            raise ValueError("config has no algo_class; use PPOConfig()/IMPALAConfig()")
+        self._infer_spaces()
+        return self.algo_class(config=self.copy())
+
+
+class Algorithm(Trainable):
+    """Reference: rllib/algorithms/algorithm.py:199. A Tune Trainable whose
+    step() is `training_step()` plus metric aggregation."""
+
+    config_class: Type[AlgorithmConfig] = AlgorithmConfig
+
+    def __init__(self, config: AlgorithmConfig | dict | None = None, trial_dir: str | None = None):
+        if isinstance(config, dict):
+            # Invoked by Tune with a plain dict: overlay onto the default
+            # config (keys are AlgorithmConfig attribute names).
+            base = self.config_class()
+            for k, v in config.items():
+                setattr(base, k, v)
+            config = base
+        elif config is None:
+            config = self.config_class()
+        config._infer_spaces()
+        self.algo_config = config
+        super().__init__(config={}, trial_dir=trial_dir)
+
+    def setup(self, config: dict) -> None:
+        cfg = self.algo_config
+        self.env_runner_group = EnvRunnerGroup(cfg)
+        self._rng = np.random.default_rng(cfg.seed)
+        self.build_learner(cfg)  # algorithm-specific
+
+    def build_learner(self, cfg: AlgorithmConfig) -> None:
+        raise NotImplementedError
+
+    def training_step(self) -> dict:
+        raise NotImplementedError
+
+    def step(self) -> dict:
+        result = self.training_step()
+        result.update(self.env_runner_group.get_metrics())
+        return result
+
+    def train(self) -> dict:  # Trainable.train adds iteration bookkeeping
+        return super().train()
+
+    # --- checkpointing (reference: Checkpointable mixin utils/checkpoints.py) ---
+
+    def save_checkpoint(self, checkpoint_dir: str) -> None:
+        state = self.learner_group.get_state()
+        with open(os.path.join(checkpoint_dir, "algo_state.pkl"), "wb") as f:
+            pickle.dump({"learner": state, "iteration": self.iteration}, f)
+
+    def load_checkpoint(self, checkpoint_dir: str) -> None:
+        with open(os.path.join(checkpoint_dir, "algo_state.pkl"), "rb") as f:
+            state = pickle.load(f)
+        self.learner_group.set_state(state["learner"])
+        self.iteration = state["iteration"]
+
+    def get_weights(self):
+        return self.learner_group.get_weights()
+
+    def cleanup(self) -> None:
+        self.env_runner_group.stop()
+        if hasattr(self, "learner_group"):
+            self.learner_group.stop()
+
+    stop = cleanup

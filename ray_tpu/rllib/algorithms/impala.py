@@ -1,0 +1,189 @@
+"""IMPALA: asynchronous sampling + V-trace off-policy correction.
+
+Counterpart of the reference's IMPALA (rllib/algorithms/impala/impala.py:599
+— async sample queues, weight broadcast) with vtrace_torch.py rewritten as
+a `lax.scan` compiled into the learner step. Env runners sample with
+slightly stale weights; the learner corrects with clipped importance
+ratios. The async loop uses ray_tpu.wait over per-runner sample futures —
+a runner is re-armed with fresh weights the moment its batch lands."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import ray_tpu
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.core.learner import JaxLearner, LearnerGroup
+from ray_tpu.rllib.core.rl_module import categorical_entropy, categorical_logp
+from ray_tpu.rllib.sample_batch import (
+    ACTIONS,
+    BEHAVIOR_LOGITS,
+    NEXT_OBS,
+    OBS,
+    REWARDS,
+    TERMINATEDS,
+    TRUNCATEDS,
+    SampleBatch,
+)
+
+
+def vtrace(
+    target_logp,  # [T, B] log pi(a|s) under the learner policy
+    behavior_logp,  # [T, B] log mu(a|s) under the sampling policy
+    rewards,  # [T, B]
+    values,  # [T, B] V(s_t) under the learner policy
+    next_values,  # [T, B] V(s_{t+1}); at truncation, V(terminal obs)
+    terminateds,  # [T, B] float {0,1}
+    truncateds,  # [T, B] float {0,1}
+    gamma: float,
+    clip_rho_threshold: float = 1.0,
+    clip_c_threshold: float = 1.0,
+):
+    """V-trace targets + policy-gradient advantages (reference:
+    rllib/algorithms/impala/vtrace_torch.py; Espeholt et al. 2018).
+
+    Returns (vs, pg_advantages), both [T, B], gradients stopped."""
+    rhos = jnp.exp(target_logp - behavior_logp)
+    clipped_rhos = jnp.minimum(rhos, clip_rho_threshold)
+    cs = jnp.minimum(rhos, clip_c_threshold)
+    not_term = 1.0 - terminateds
+    chain = not_term * (1.0 - truncateds)  # next row is a fresh episode
+    deltas = clipped_rhos * (rewards + gamma * next_values * not_term - values)
+
+    def backward(acc, xs):
+        delta, c, ch = xs
+        acc = delta + gamma * c * ch * acc
+        return acc, acc
+
+    _, dvs_rev = jax.lax.scan(
+        backward,
+        jnp.zeros_like(deltas[0]),
+        (deltas[::-1], cs[::-1], chain[::-1]),
+    )
+    dvs = dvs_rev[::-1]
+    vs = values + dvs
+    # vs_{t+1} for the pg advantage: shift; at rollout end approximate with
+    # next_values (exact when the trajectory ends or bootstraps there).
+    vs_next = jnp.concatenate([vs[1:], next_values[-1:]], axis=0)
+    vs_next = chain * vs_next + (1.0 - chain) * next_values
+    pg_adv = clipped_rhos * (rewards + gamma * vs_next * not_term - values)
+    return jax.lax.stop_gradient(vs), jax.lax.stop_gradient(pg_adv)
+
+
+class IMPALAConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(algo_class=IMPALA)
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.01
+        self.clip_rho_threshold = 1.0
+        self.clip_c_threshold = 1.0
+        self.num_env_runners = 2  # async needs remote runners
+        self.train_batch_size = 512
+        self.max_requests_in_flight = 2
+
+
+def make_impala_loss(cfg: IMPALAConfig, T: int):
+    gamma = cfg.gamma
+
+    def loss_fn(params, apply_fn, batch):
+        tm = lambda a: a.reshape((T, -1) + a.shape[1:])  # noqa: E731  t-major
+        obs, next_obs = tm(batch[OBS]), tm(batch[NEXT_OBS])
+        actions = tm(batch[ACTIONS])
+        out = apply_fn(params, obs)
+        logits, values = out["action_dist_inputs"], out["vf_preds"]
+        next_values = apply_fn(params, next_obs)["vf_preds"]
+        target_logp = categorical_logp(logits, actions)
+        behavior_logits = tm(batch[BEHAVIOR_LOGITS])
+        behavior_logp = categorical_logp(behavior_logits, actions)
+        vs, pg_adv = vtrace(
+            target_logp,
+            behavior_logp,
+            tm(batch[REWARDS]),
+            values,
+            next_values,
+            tm(batch[TERMINATEDS]).astype(jnp.float32),
+            tm(batch[TRUNCATEDS]).astype(jnp.float32),
+            gamma,
+            cfg.clip_rho_threshold,
+            cfg.clip_c_threshold,
+        )
+        policy_loss = -(target_logp * pg_adv).mean()
+        vf_loss = 0.5 * jnp.square(values - vs).mean()
+        entropy = categorical_entropy(logits).mean()
+        total = policy_loss + cfg.vf_loss_coeff * vf_loss - cfg.entropy_coeff * entropy
+        return total, {
+            "policy_loss": policy_loss,
+            "vf_loss": vf_loss,
+            "entropy": entropy,
+            "mean_rho": jnp.exp(target_logp - behavior_logp).mean(),
+        }
+
+    return loss_fn
+
+
+class IMPALA(Algorithm):
+    config_class = IMPALAConfig
+
+    def build_learner(self, cfg: IMPALAConfig) -> None:
+        tx = optax.adam(cfg.lr)
+        if cfg.grad_clip is not None:
+            tx = optax.chain(optax.clip_by_global_norm(cfg.grad_clip), tx)
+        loss_fn = make_impala_loss(cfg, cfg.rollout_fragment_length)
+        spec = cfg.rl_module_spec()
+        mesh, seed = cfg.mesh, cfg.seed
+
+        def factory():
+            return JaxLearner(spec.build(seed=seed), loss_fn, tx, mesh=mesh)
+
+        # IMPALA's learner is driver-local (the chips belong to the driver);
+        # async scale-out is on the env-runner side.
+        self.learner_group = LearnerGroup(factory, num_learners=0)
+        self._inflight: dict = {}  # ObjectRef -> runner handle
+
+    def _arm(self, runner, weights_ref) -> None:
+        ref = runner.sample.remote(weights_ref)
+        self._inflight[ref] = runner
+
+    def training_step(self) -> dict:
+        cfg = self.algo_config
+        if not self.env_runner_group.remote_runners:
+            raise ValueError("IMPALA requires num_env_runners >= 1 (async path)")
+        weights_ref = ray_tpu.put(self.learner_group.get_weights())
+        # Prime the pipeline.
+        for runner in self.env_runner_group.remote_runners:
+            while (
+                sum(1 for r in self._inflight.values() if r is runner)
+                < cfg.max_requests_in_flight
+            ):
+                self._arm(runner, weights_ref)
+        collected: list[SampleBatch] = []
+        total = 0
+        metrics: dict = {}
+        num_updates = 0
+        while total < cfg.train_batch_size:
+            ready, _ = ray_tpu.wait(list(self._inflight), num_returns=1)
+            for ref in ready:
+                runner = self._inflight.pop(ref)
+                batch = ray_tpu.get(ref)
+                collected.append(batch)
+                total += len(batch)
+                # Re-arm immediately with the freshest weights (broadcast).
+                self._arm(runner, weights_ref)
+            # Learn on whatever has arrived once we have a full rollout set
+            # (off-policy correction absorbs the staleness).
+            while collected:
+                b = collected.pop(0)
+                metrics = self.learner_group.local.update(b)
+                num_updates += 1
+                weights_ref = ray_tpu.put(self.learner_group.get_weights())
+        metrics["num_env_steps_sampled"] = total
+        metrics["num_learner_updates"] = num_updates
+        return metrics
+
+    def cleanup(self) -> None:
+        # Drain in-flight sampling futures before killing runners.
+        self._inflight.clear()
+        super().cleanup()

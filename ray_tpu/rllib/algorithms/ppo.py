@@ -1,0 +1,152 @@
+"""PPO: clipped-surrogate policy optimization.
+
+Counterpart of the reference's PPO (rllib/algorithms/ppo/ppo.py:362 —
+training_step :388: synchronous_parallel_sample → LearnerGroup.update →
+sync weights) with the loss from ppo_torch_learner / ppo_learner rewritten
+as a pure jax function compiled into the learner step."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.core.learner import JaxLearner, LearnerGroup
+from ray_tpu.rllib.core.rl_module import (
+    categorical_entropy,
+    categorical_logp,
+)
+from ray_tpu.rllib.sample_batch import (
+    ACTIONS,
+    ADVANTAGES,
+    LOGP,
+    NEXT_OBS,
+    OBS,
+    REWARDS,
+    TERMINATEDS,
+    TRUNCATEDS,
+    VALUE_TARGETS,
+    VF_PREDS,
+    SampleBatch,
+    compute_gae,
+)
+
+
+class PPOConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(algo_class=PPO)
+        self.clip_param = 0.2
+        self.vf_clip_param = 10.0
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.0
+        self.lambda_ = 0.95
+        self.kl_target: float | None = None  # early-stop epochs when exceeded
+
+    def training(self, **kwargs) -> "PPOConfig":
+        # Accept reference spellings.
+        if "lambda_" not in kwargs and "lambda" in kwargs:
+            kwargs["lambda_"] = kwargs.pop("lambda")
+        return super().training(**kwargs)
+
+
+def make_ppo_loss(cfg: PPOConfig):
+    clip, vf_clip = cfg.clip_param, cfg.vf_clip_param
+    vf_coeff, ent_coeff = cfg.vf_loss_coeff, cfg.entropy_coeff
+
+    def loss_fn(params, apply_fn, batch):
+        out = apply_fn(params, batch[OBS])
+        logits = out["action_dist_inputs"]
+        logp = categorical_logp(logits, batch[ACTIONS])
+        ratio = jnp.exp(logp - batch[LOGP])
+        adv = batch[ADVANTAGES]
+        # Per-minibatch advantage normalization (reference PPO default).
+        adv = (adv - adv.mean()) / jnp.maximum(adv.std(), 1e-4)
+        surrogate = jnp.minimum(
+            ratio * adv, jnp.clip(ratio, 1.0 - clip, 1.0 + clip) * adv
+        )
+        policy_loss = -surrogate.mean()
+
+        vf = out[VF_PREDS]
+        vf_err = jnp.square(vf - batch[VALUE_TARGETS])
+        vf_loss = jnp.clip(vf_err, 0.0, vf_clip).mean()
+
+        entropy = categorical_entropy(logits).mean()
+        total = policy_loss + vf_coeff * vf_loss - ent_coeff * entropy
+        kl = (batch[LOGP] - logp).mean()
+        return total, {
+            "policy_loss": policy_loss,
+            "vf_loss": vf_loss,
+            "entropy": entropy,
+            "mean_kl": kl,
+        }
+
+    return loss_fn
+
+
+class PPO(Algorithm):
+    config_class = PPOConfig
+
+    def build_learner(self, cfg: PPOConfig) -> None:
+        tx = optax.adam(cfg.lr)
+        if cfg.grad_clip is not None:
+            tx = optax.chain(optax.clip_by_global_norm(cfg.grad_clip), tx)
+        loss_fn = make_ppo_loss(cfg)
+        spec = cfg.rl_module_spec()
+        mesh = cfg.mesh
+        seed = cfg.seed
+
+        def factory():
+            return JaxLearner(spec.build(seed=seed), loss_fn, tx, mesh=mesh)
+
+        self.learner_group = LearnerGroup(factory, num_learners=cfg.num_learners)
+        # Module held only for its pure apply fn (bootstrap values); params
+        # come from the learner group each iteration.
+        self._ref_module = spec.build(seed=0)
+        self._value_fn = jax.jit(lambda p, o: self._ref_module.apply(p, o)[VF_PREDS])
+
+    def _postprocess(self, batch: SampleBatch, weights) -> SampleBatch:
+        """Attach GAE advantages/targets (reference:
+        postprocessing.compute_advantages via the learner connector)."""
+        cfg = self.algo_config
+        next_values = np.asarray(self._value_fn(
+            jax.tree.map(jnp.asarray, weights), jnp.asarray(batch[NEXT_OBS])
+        ))
+        # Reshape flat [T*B] rows back to [T, B] (row-major by t).
+        B_total = len(batch)
+        T = cfg.rollout_fragment_length
+        B = B_total // T
+        shape = lambda a: a.reshape(T, B)  # noqa: E731
+        adv, targets = compute_gae(
+            shape(batch[REWARDS]),
+            shape(batch[VF_PREDS]),
+            next_values.reshape(T, B),
+            shape(batch[TERMINATEDS]),
+            shape(batch[TRUNCATEDS]),
+            cfg.gamma,
+            cfg.lambda_,
+        )
+        batch[ADVANTAGES] = adv.reshape(-1)
+        batch[VALUE_TARGETS] = targets.reshape(-1)
+        return batch
+
+    def training_step(self) -> dict:
+        cfg = self.algo_config
+        weights = self.learner_group.get_weights()
+        # 1. sample (synchronous_parallel_sample, execution/rollout_ops.py:20)
+        # GAE runs on each runner's t-major batch before flat concat.
+        batches: list[SampleBatch] = []
+        total = 0
+        while total < cfg.train_batch_size:
+            for b in self.env_runner_group.sample_batches(weights):
+                batches.append(self._postprocess(b, weights))
+                total += len(b)
+        batch = SampleBatch.concat_samples(batches)
+        # 2. learn
+        metrics = self.learner_group.update_epochs(
+            batch,
+            num_epochs=cfg.num_epochs,
+            minibatch_size=cfg.minibatch_size,
+        )
+        return {"num_env_steps_sampled": len(batch), **metrics}

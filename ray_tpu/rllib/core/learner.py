@@ -1,0 +1,240 @@
+"""JaxLearner + LearnerGroup: the jitted update path.
+
+Counterpart of the reference's Learner (rllib/core/learner/learner.py:107 —
+compute_losses :887, compute_gradients :459, apply_gradients :602, update
+:971) and LearnerGroup (learner_group.py:72). Redesign: where TorchLearner
+wraps modules in DDP over NCCL (torch_learner.py:436-539), JaxLearner runs
+ONE jitted step; scaling across chips is a `data`-axis NamedSharding on the
+batch, XLA inserting the gradient all-reduce over ICI (SURVEY.md §2.4
+"Async RL parallelism" row). A LearnerGroup of remote actors exists for
+host-level scale-out (each actor drives its own mesh)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+class JaxLearner:
+    """Owns (params, opt_state) and a compiled update step.
+
+    `loss_fn(params, apply_fn, batch) -> (loss, metrics_dict)` is supplied
+    by the algorithm (PPO/IMPALA define theirs)."""
+
+    def __init__(
+        self,
+        module,  # RLModule: provides .params and .apply
+        loss_fn: Callable,
+        optimizer: optax.GradientTransformation,
+        mesh: Optional[jax.sharding.Mesh] = None,
+        data_axis: str = "data",
+        seed: int = 0,
+    ):
+        self.module = module
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self.opt_state = optimizer.init(module.params)
+        self.mesh = mesh
+        self._metrics: dict = {}
+        # Advances across update_epochs calls: fresh minibatch permutations
+        # every training_step.
+        self._rng = np.random.default_rng(seed)
+
+        apply_fn = module.apply
+
+        def _update(params, opt_state, batch):
+            (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, apply_fn, batch
+            )
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            metrics["total_loss"] = loss
+            metrics["grad_norm"] = optax.global_norm(grads)
+            return params, opt_state, metrics
+
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            replicated = NamedSharding(mesh, P())
+            batch_sharded = NamedSharding(mesh, P(data_axis))
+            self._jit_update = jax.jit(
+                _update,
+                in_shardings=(replicated, replicated, batch_sharded),
+                out_shardings=(replicated, replicated, replicated),
+                donate_argnums=(0, 1),
+            )
+        else:
+            self._jit_update = jax.jit(_update, donate_argnums=(0, 1))
+
+    # ------------------------------------------------------------------
+
+    def update(self, batch: SampleBatch) -> dict:
+        """One gradient step on `batch` (already minibatched by the algo)."""
+        jbatch = {k: jnp.asarray(v) for k, v in batch.items()}
+        self.module.params, self.opt_state, metrics = self._jit_update(
+            self.module.params, self.opt_state, jbatch
+        )
+        self._metrics = {k: float(v) for k, v in metrics.items()}
+        return self._metrics
+
+    def update_epochs(
+        self,
+        batch: SampleBatch,
+        *,
+        num_epochs: int,
+        minibatch_size: int,
+        rng: np.random.Generator | None = None,
+    ) -> dict:
+        """SGD epochs over shuffled minibatches (reference: Learner.update
+        with minibatching)."""
+        rng = rng or self._rng
+        last: dict = {}
+        for _ in range(num_epochs):
+            shuffled = batch.shuffle(rng)
+            for mb in shuffled.minibatches(minibatch_size):
+                last = self.update(mb)
+        return last
+
+    def get_weights(self):
+        return self.module.get_weights()
+
+    def set_weights(self, weights) -> None:
+        self.module.set_weights(weights)
+        # Optimizer state refers to the old param tree only by structure;
+        # moments keep their values (intended for weight broadcast where
+        # structure is unchanged).
+
+    def get_state(self) -> dict:
+        return {
+            "params": jax.tree.map(np.asarray, self.module.params),
+            "opt_state": jax.tree.map(
+                lambda x: np.asarray(x) if isinstance(x, jax.Array) else x,
+                self.opt_state,
+            ),
+        }
+
+    def set_state(self, state: dict) -> None:
+        self.module.set_weights(state["params"])
+        self.opt_state = jax.tree.map(
+            lambda ref, x: jnp.asarray(x) if isinstance(ref, jax.Array) else x,
+            self.opt_state,
+            state["opt_state"],
+        )
+
+
+class LearnerGroup:
+    """Local learner or remote learner actors (reference:
+    rllib/core/learner/learner_group.py:72 — update :194).
+
+    With num_learners == 0 the learner lives in the driver process (the
+    common TPU mode: the driver owns the chips). With N > 0, N actors each
+    update on a batch shard and the group averages the resulting weights
+    (host-level DP over DCN)."""
+
+    def __init__(self, learner_factory: Callable[[], JaxLearner], num_learners: int = 0):
+        import ray_tpu
+
+        self.num_learners = num_learners
+        if num_learners == 0:
+            self.local = learner_factory()
+            self.remotes = []
+        else:
+            self.local = None
+            actor_cls = ray_tpu.remote(num_cpus=1)(_LearnerActor)
+            self.remotes = [actor_cls.remote(learner_factory) for _ in range(num_learners)]
+
+    def update_epochs(self, batch: SampleBatch, **kw) -> dict:
+        import ray_tpu
+
+        if self.local is not None:
+            return self.local.update_epochs(batch, **kw)
+        n = self.num_learners
+        if len(batch) < n:
+            # Too few rows to shard: replicate (identical updates beat
+            # empty shards whose mean() would be NaN).
+            refs = [r.update_epochs.remote(batch, **kw) for r in self.remotes]
+        else:
+            # np.array_split-style bounds: remainder rows spread over the
+            # first shards, nothing dropped.
+            bounds = np.linspace(0, len(batch), n + 1, dtype=int)
+            refs = [
+                r.update_epochs.remote(batch.slice(int(bounds[i]), int(bounds[i + 1])), **kw)
+                for i, r in enumerate(self.remotes)
+            ]
+        metrics = ray_tpu.get(refs)
+        self._average_weights()
+        return metrics[0]
+
+    def _average_weights(self) -> None:
+        import ray_tpu
+
+        all_w = ray_tpu.get([r.get_weights.remote() for r in self.remotes])
+        avg = jax.tree.map(lambda *xs: np.mean(np.stack(xs), axis=0), *all_w)
+        ray_tpu.get([r.set_weights.remote(avg) for r in self.remotes])
+
+    def get_weights(self):
+        import ray_tpu
+
+        if self.local is not None:
+            return self.local.get_weights()
+        return ray_tpu.get(self.remotes[0].get_weights.remote())
+
+    def set_weights(self, weights) -> None:
+        import ray_tpu
+
+        if self.local is not None:
+            self.local.set_weights(weights)
+        else:
+            ray_tpu.get([r.set_weights.remote(weights) for r in self.remotes])
+
+    def get_state(self) -> dict:
+        import ray_tpu
+
+        if self.local is not None:
+            return self.local.get_state()
+        return ray_tpu.get(self.remotes[0].get_state.remote())
+
+    def set_state(self, state: dict) -> None:
+        import ray_tpu
+
+        if self.local is not None:
+            self.local.set_state(state)
+        else:
+            ray_tpu.get([r.set_state.remote(state) for r in self.remotes])
+
+    def stop(self) -> None:
+        import ray_tpu
+
+        for r in self.remotes:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
+
+
+class _LearnerActor:
+    """Actor wrapper so a JaxLearner can live in a worker process."""
+
+    def __init__(self, factory: Callable[[], JaxLearner]):
+        self.learner = factory()
+
+    def update_epochs(self, batch, **kw):
+        return self.learner.update_epochs(batch, **kw)
+
+    def get_weights(self):
+        return self.learner.get_weights()
+
+    def set_weights(self, w):
+        self.learner.set_weights(w)
+
+    def get_state(self):
+        return self.learner.get_state()
+
+    def set_state(self, s):
+        self.learner.set_state(s)

@@ -1,0 +1,120 @@
+"""RLModule: the framework-agnostic policy-network container, JAX edition.
+
+Counterpart of the reference's RLModule (rllib/core/rl_module/rl_module.py:260
+— forward_inference/forward_exploration/forward_train over a spec) rebuilt on
+flax: parameters are an explicit pytree (no module-owned mutable state), so
+the same apply function serves the env runner (host CPU / single chip) and
+the learner (sharded mesh) — weight sync is just shipping the pytree.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass
+class RLModuleSpec:
+    """Reference: rllib/core/rl_module/rl_module.py RLModuleSpec."""
+
+    observation_dim: int
+    action_dim: int
+    hidden: Sequence[int] = (64, 64)
+    module_class: "type[RLModule] | None" = None
+
+    def build(self, seed: int = 0) -> "RLModule":
+        cls = self.module_class or DiscreteActorCriticModule
+        return cls(self, seed)
+
+
+class RLModule:
+    """Holds a param pytree + pure apply fns. Subclasses define the net."""
+
+    def __init__(self, spec: RLModuleSpec, seed: int = 0):
+        self.spec = spec
+        self.params = self.init_params(jax.random.PRNGKey(seed))
+        self._jit_inference = jax.jit(self.apply)
+
+    # --- subclass surface (pure functions of (params, obs)) ---
+
+    def init_params(self, rng) -> Any:
+        raise NotImplementedError
+
+    def apply(self, params, obs) -> dict:
+        """Returns at least {"action_dist_inputs": logits, "vf_preds": v}."""
+        raise NotImplementedError
+
+    # --- shared ---
+
+    def forward_inference(self, obs: np.ndarray) -> dict:
+        out = self._jit_inference(self.params, jnp.asarray(obs))
+        return {k: np.asarray(v) for k, v in out.items()}
+
+    forward_exploration = forward_inference
+
+    def get_weights(self) -> Any:
+        return jax.tree.map(np.asarray, self.params)
+
+    def set_weights(self, weights: Any) -> None:
+        self.params = jax.tree.map(jnp.asarray, weights)
+
+
+def _mlp_init(rng, sizes: Sequence[int]):
+    params = []
+    for i, (m, n) in enumerate(zip(sizes[:-1], sizes[1:])):
+        rng, k = jax.random.split(rng)
+        # Orthogonal init, standard for PPO stability.
+        w = jax.nn.initializers.orthogonal(scale=np.sqrt(2))(k, (m, n), jnp.float32)
+        params.append({"w": w, "b": jnp.zeros((n,), jnp.float32)})
+    return params
+
+
+def _mlp_apply(layers, x, activate_last: bool = False):
+    for i, layer in enumerate(layers):
+        x = x @ layer["w"] + layer["b"]
+        if i < len(layers) - 1 or activate_last:
+            x = jnp.tanh(x)
+    return x
+
+
+class DiscreteActorCriticModule(RLModule):
+    """Shared-torso MLP with categorical policy + value heads
+    (reference analogue: rllib default MLP catalog for PPO)."""
+
+    def init_params(self, rng) -> Any:
+        s = self.spec
+        k1, k2, k3 = jax.random.split(rng, 3)
+        torso_sizes = [s.observation_dim, *s.hidden]
+        pi_head = _mlp_init(k2, [s.hidden[-1], s.action_dim])
+        vf_head = _mlp_init(k3, [s.hidden[-1], 1])
+        # Small final policy layer → near-uniform initial policy.
+        pi_head[-1]["w"] = pi_head[-1]["w"] * 0.01
+        return {
+            "torso": _mlp_init(k1, torso_sizes),
+            "pi": pi_head,
+            "vf": vf_head,
+        }
+
+    def apply(self, params, obs) -> dict:
+        h = _mlp_apply(params["torso"], obs, activate_last=True)
+        logits = _mlp_apply(params["pi"], h)
+        value = _mlp_apply(params["vf"], h)[..., 0]
+        return {"action_dist_inputs": logits, "vf_preds": value}
+
+
+def categorical_logp(logits: jnp.ndarray, actions: jnp.ndarray) -> jnp.ndarray:
+    logp = jax.nn.log_softmax(logits)
+    return jnp.take_along_axis(logp, actions[..., None].astype(jnp.int32), axis=-1)[..., 0]
+
+
+def categorical_entropy(logits: jnp.ndarray) -> jnp.ndarray:
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.sum(jnp.exp(logp) * logp, axis=-1)
+
+
+def sample_categorical(rng_key, logits: jnp.ndarray) -> jnp.ndarray:
+    return jax.random.categorical(rng_key, logits, axis=-1)

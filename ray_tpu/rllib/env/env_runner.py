@@ -1,0 +1,280 @@
+"""EnvRunners: CPU actors that step vectorized envs with the current policy.
+
+Counterpart of the reference's SingleAgentEnvRunner
+(rllib/env/single_agent_env_runner.py:68) and EnvRunnerGroup
+(rllib/env/env_runner_group.py:71 — remote actors, foreach/async fanout).
+Redesign notes: env stepping stays host-side numpy; policy inference is one
+jitted batched forward per vector step (the TPU/XLA-friendly shape — no
+per-env Python forward). Episode bookkeeping uses SAME_STEP autoreset
+semantics implemented locally so value bootstrapping is exact for
+truncations and version-stable across gymnasium releases."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.sample_batch import (
+    ACTIONS,
+    BEHAVIOR_LOGITS,
+    LOGP,
+    NEXT_OBS,
+    OBS,
+    REWARDS,
+    TERMINATEDS,
+    TRUNCATEDS,
+    VF_PREDS,
+    SampleBatch,
+)
+
+
+class _SyncVectorEnv:
+    """N single envs stepped together with immediate (same-step) reset.
+
+    On done, the returned obs is the NEXT episode's initial observation and
+    the terminal observation is kept in `final_obs` for bootstrapping."""
+
+    def __init__(self, env_fns: list[Callable[[], Any]], seed: int = 0):
+        self.envs = [fn() for fn in env_fns]
+        self.n = len(self.envs)
+        self._seed = seed
+
+    def reset(self) -> np.ndarray:
+        obs = [e.reset(seed=self._seed + i)[0] for i, e in enumerate(self.envs)]
+        return np.stack(obs).astype(np.float32)
+
+    def step(self, actions: np.ndarray):
+        obs_out, rewards, terms, truncs, final_obs = [], [], [], [], [None] * self.n
+        for i, (env, a) in enumerate(zip(self.envs, actions)):
+            obs, r, term, trunc, _ = env.step(a)
+            if term or trunc:
+                final_obs[i] = np.asarray(obs, np.float32)
+                obs = env.reset()[0]
+            obs_out.append(obs)
+            rewards.append(r)
+            terms.append(term)
+            truncs.append(trunc)
+        return (
+            np.stack(obs_out).astype(np.float32),
+            np.asarray(rewards, np.float32),
+            np.asarray(terms, bool),
+            np.asarray(truncs, bool),
+            final_obs,
+        )
+
+    def close(self):
+        for e in self.envs:
+            try:
+                e.close()
+            except Exception:
+                pass
+
+
+def _make_env_fn(env: Any) -> Callable[[], Any]:
+    if callable(env):
+        return env
+    if isinstance(env, str):
+        import gymnasium
+
+        return lambda: gymnasium.make(env)
+    raise TypeError(f"env must be a gym id or callable, got {type(env)}")
+
+
+class SingleAgentEnvRunner:
+    """Samples fixed-length rollouts (reference:
+    rllib/env/single_agent_env_runner.py:68 sample())."""
+
+    def __init__(self, config: "AlgorithmConfig", seed: int = 0):  # noqa: F821
+        self.config = config
+        self.num_envs = config.num_envs_per_env_runner
+        self.rollout_len = config.rollout_fragment_length
+        self.vec = _SyncVectorEnv(
+            [_make_env_fn(config.env) for _ in range(self.num_envs)], seed=seed
+        )
+        self.module = config.rl_module_spec().build(seed=seed)
+        self.obs = self.vec.reset()
+        self._rng = np.random.default_rng(seed)
+        # Per-env running episode stats.
+        self._ep_return = np.zeros(self.num_envs, np.float64)
+        self._ep_len = np.zeros(self.num_envs, np.int64)
+        self._completed_returns: list[float] = []
+        self._completed_lengths: list[int] = []
+
+    # ------------------------------------------------------------------
+
+    def set_weights(self, weights) -> None:
+        self.module.set_weights(weights)
+
+    def get_weights(self):
+        return self.module.get_weights()
+
+    def sample(self, weights=None) -> SampleBatch:
+        """One rollout of [T, B] transitions, flattened to [T*B] with GAE
+        inputs attached (vf_preds, bootstrap via next_obs values)."""
+        if weights is not None:
+            self.module.set_weights(weights)
+        T, B = self.rollout_len, self.num_envs
+        obs_buf = np.empty((T, B) + self.obs.shape[1:], np.float32)
+        act_buf = np.empty((T, B), np.int64)
+        logp_buf = np.empty((T, B), np.float32)
+        vf_buf = np.empty((T, B), np.float32)
+        logits_buf: np.ndarray | None = None
+        rew_buf = np.empty((T, B), np.float32)
+        term_buf = np.empty((T, B), bool)
+        trunc_buf = np.empty((T, B), bool)
+        next_obs_buf = np.empty_like(obs_buf)
+
+        for t in range(T):
+            out = self.module.forward_exploration(self.obs)
+            logits = out["action_dist_inputs"]
+            if logits_buf is None:
+                logits_buf = np.empty((T, B, logits.shape[-1]), np.float32)
+            # Gumbel-max sampling host-side (cheap, avoids device rng state).
+            g = self._rng.gumbel(size=logits.shape).astype(np.float32)
+            actions = np.argmax(logits + g, axis=-1)
+            logp_all = logits - _logsumexp(logits)
+            obs_buf[t] = self.obs
+            act_buf[t] = actions
+            logp_buf[t] = np.take_along_axis(logp_all, actions[:, None], 1)[:, 0]
+            vf_buf[t] = out[VF_PREDS]
+            logits_buf[t] = logits
+            next_obs, rewards, terms, truncs, final_obs = self.vec.step(actions)
+            # Bootstrapping for truncated (time-limit) episodes uses the
+            # true terminal observation, not the post-reset one.
+            next_for_value = next_obs.copy()
+            for i, fo in enumerate(final_obs):
+                if fo is not None:
+                    next_for_value[i] = fo
+            rew_buf[t], term_buf[t], trunc_buf[t] = rewards, terms, truncs
+            next_obs_buf[t] = next_for_value
+            self._track_episodes(rewards, terms, truncs)
+            self.obs = next_obs
+
+        flat = lambda a: a.reshape((T * B,) + a.shape[2:])  # noqa: E731
+        return SampleBatch(
+            {
+                OBS: flat(obs_buf),
+                ACTIONS: flat(act_buf),
+                LOGP: flat(logp_buf),
+                VF_PREDS: flat(vf_buf),
+                BEHAVIOR_LOGITS: flat(logits_buf),
+                REWARDS: flat(rew_buf),
+                TERMINATEDS: flat(term_buf),
+                TRUNCATEDS: flat(trunc_buf),
+                NEXT_OBS: flat(next_obs_buf),
+                "t": np.tile(np.arange(T)[:, None], (1, B)).reshape(-1),
+                "env_id": np.tile(np.arange(B)[None, :], (T, 1)).reshape(-1),
+            }
+        )
+
+    def _track_episodes(self, rewards, terms, truncs) -> None:
+        self._ep_return += rewards
+        self._ep_len += 1
+        done = terms | truncs
+        for i in np.nonzero(done)[0]:
+            self._completed_returns.append(float(self._ep_return[i]))
+            self._completed_lengths.append(int(self._ep_len[i]))
+            self._ep_return[i] = 0.0
+            self._ep_len[i] = 0
+
+    def get_metrics(self) -> dict:
+        """Drain episode stats (reference: env runner metrics logger)."""
+        rets, lens = self._completed_returns, self._completed_lengths
+        self._completed_returns, self._completed_lengths = [], []
+        if not rets:
+            return {"num_episodes": 0}
+        return {
+            "num_episodes": len(rets),
+            "episode_return_mean": float(np.mean(rets)),
+            "episode_return_max": float(np.max(rets)),
+            "episode_return_min": float(np.min(rets)),
+            "episode_len_mean": float(np.mean(lens)),
+        }
+
+    def stop(self) -> None:
+        self.vec.close()
+
+
+def _logsumexp(x: np.ndarray) -> np.ndarray:
+    m = x.max(axis=-1, keepdims=True)
+    return m + np.log(np.exp(x - m).sum(axis=-1, keepdims=True))
+
+
+class EnvRunnerGroup:
+    """Remote env-runner actors + local fallback (reference:
+    rllib/env/env_runner_group.py:71)."""
+
+    def __init__(self, config: "AlgorithmConfig"):  # noqa: F821
+        import ray_tpu
+
+        self.config = config
+        self.num_remote = config.num_env_runners
+        if self.num_remote == 0:
+            self.local_runner: Optional[SingleAgentEnvRunner] = SingleAgentEnvRunner(
+                config, seed=config.seed
+            )
+            self.remote_runners = []
+        else:
+            self.local_runner = None
+            cls = ray_tpu.remote(num_cpus=config.num_cpus_per_env_runner)(
+                SingleAgentEnvRunner
+            )
+            self.remote_runners = [
+                cls.remote(config, seed=config.seed + 1000 * (i + 1))
+                for i in range(self.num_remote)
+            ]
+
+    def sample(self, weights=None) -> SampleBatch:
+        return SampleBatch.concat_samples(self.sample_batches(weights))
+
+    def sample_batches(self, weights=None) -> list[SampleBatch]:
+        """Per-runner batches. Each keeps its own [T*B] t-major layout, so
+        time-structured postprocessing (GAE/vtrace) must happen per batch
+        BEFORE concatenation."""
+        import ray_tpu
+
+        if self.local_runner is not None:
+            return [self.local_runner.sample(weights)]
+        ref = ray_tpu.put(weights) if weights is not None else None
+        return ray_tpu.get([r.sample.remote(ref) for r in self.remote_runners])
+
+    def sample_async(self, weights=None) -> list:
+        """Kick off sampling on every remote runner; returns refs
+        (reference: foreach_env_runner_async — the IMPALA path)."""
+        import ray_tpu
+
+        ref = ray_tpu.put(weights) if weights is not None else None
+        return [(r, r.sample.remote(ref)) for r in self.remote_runners]
+
+    def get_metrics(self) -> dict:
+        import ray_tpu
+
+        if self.local_runner is not None:
+            per = [self.local_runner.get_metrics()]
+        else:
+            per = ray_tpu.get([r.get_metrics.remote() for r in self.remote_runners])
+        merged: dict = {"num_episodes": sum(m.get("num_episodes", 0) for m in per)}
+        means = [m["episode_return_mean"] for m in per if "episode_return_mean" in m]
+        if means:
+            weights = [m["num_episodes"] for m in per if "episode_return_mean" in m]
+            merged["episode_return_mean"] = float(np.average(means, weights=weights))
+            merged["episode_return_max"] = max(m["episode_return_max"] for m in per if "episode_return_max" in m)
+            merged["episode_len_mean"] = float(
+                np.average(
+                    [m["episode_len_mean"] for m in per if "episode_len_mean" in m],
+                    weights=weights,
+                )
+            )
+        return merged
+
+    def stop(self) -> None:
+        import ray_tpu
+
+        if self.local_runner is not None:
+            self.local_runner.stop()
+        for r in self.remote_runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass

@@ -252,6 +252,16 @@ class PopulationBasedTraining(TrialScheduler):
             return self.CONTINUE
         return ExploitDecision(source=source, new_config=self._mutate(source.config))
 
+    def on_trial_complete(self, trial: "Trial", result: dict | None) -> None:
+        # Dead trials must neither anchor the bottom quantile nor be
+        # picked as exploit sources.
+        self._latest.pop(trial.trial_id, None)
+        self._at_boundary.discard(trial.trial_id)
+
+    def on_trial_error(self, trial: "Trial") -> None:
+        self._latest.pop(trial.trial_id, None)
+        self._at_boundary.discard(trial.trial_id)
+
     # --- synch-mode controller hooks ---
 
     def may_resume(self, trial: "Trial") -> bool:

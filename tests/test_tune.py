@@ -20,8 +20,11 @@ from ray_tpu.tune.search import BasicVariantGenerator
 
 @pytest.fixture(scope="module", autouse=True)
 def _cluster():
-    ray_tpu.init(ignore_reinit_error=True)
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=8, object_store_memory=128 * 1024 * 1024)
     yield
+    ray_tpu.shutdown()
 
 
 # ---------------------------------------------------------------------------
