@@ -1,0 +1,46 @@
+"""ray_tpu.data: streaming datasets for training pipelines.
+
+Counterpart of the reference's python/ray/data (SURVEY.md §2.3 — Dataset
+builds a logical plan run by a streaming executor over the cluster;
+blocks are Arrow tables / numpy dicts). Batches come out as numpy or jax
+arrays shaped for an XLA step; `streaming_split` feeds JaxTrainer workers."""
+
+from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata
+from ray_tpu.data.dataset import (
+    DataContext,
+    DataIterator,
+    Dataset,
+    from_arrow,
+    from_items,
+    from_numpy,
+    from_pandas,
+    range,
+    range_tensor,
+    read_binary_files,
+    read_csv,
+    read_json,
+    read_numpy,
+    read_parquet,
+    read_text,
+)
+
+__all__ = [
+    "Block",
+    "BlockAccessor",
+    "BlockMetadata",
+    "DataContext",
+    "DataIterator",
+    "Dataset",
+    "from_arrow",
+    "from_items",
+    "from_numpy",
+    "from_pandas",
+    "range",
+    "range_tensor",
+    "read_binary_files",
+    "read_csv",
+    "read_json",
+    "read_numpy",
+    "read_parquet",
+    "read_text",
+]

@@ -189,12 +189,25 @@ class BlockAccessor:
             return {}
         first = blocks[0]
         if _is_table(first):
+            blocks = [b if _is_table(b) else BlockAccessor(b).to_arrow() for b in blocks]
             return pa.concat_tables(blocks, promote_options="default")
         if isinstance(first, dict):
-            keys = first.keys()
+            # Mixed kinds coerce to the first block's kind (a union of a
+            # numpy source with a parquet source is legitimate).
+            blocks = [
+                b if isinstance(b, dict) else BlockAccessor(b).to_numpy()
+                for b in blocks
+            ]
+            keys = set(first.keys())
+            for b in blocks[1:]:
+                if set(b.keys()) != keys:
+                    raise ValueError(
+                        "cannot concat blocks with differing schemas: "
+                        f"{sorted(keys)} vs {sorted(b.keys())}"
+                    )
             return {
                 k: np.concatenate([np.asarray(b[k]) for b in blocks])
-                for k in keys
+                for k in first.keys()
             }
         out = []
         for b in blocks:

