@@ -151,13 +151,20 @@ def cancel(ref: ObjectRef, *, force: bool = False) -> None:
 
 
 def get_actor(name: str, namespace: str | None = None):
+    from ray_tpu._private import rpc
     from ray_tpu.actor import ActorHandle
 
     rt = global_runtime()
-    reply = rt.conn.call(
-        "get_named_actor",
-        {"name": name, "namespace": namespace if namespace is not None else _namespace},
-    )
+    try:
+        reply = rt.conn.call(
+            "get_named_actor",
+            {"name": name, "namespace": namespace if namespace is not None else _namespace},
+        )
+    except rpc.RpcError as e:
+        if "no actor named" in str(e):
+            # Reference behavior: ray.get_actor raises ValueError.
+            raise ValueError(str(e)) from None
+        raise
     return ActorHandle(reply["actor_id"])
 
 

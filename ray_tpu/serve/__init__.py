@@ -1,0 +1,42 @@
+"""ray_tpu.serve: model serving on the actor runtime.
+
+Counterpart of the reference's python/ray/serve (SURVEY.md §3.5):
+@serve.deployment classes scale as replica actors under a controller's
+reconcile loop; DeploymentHandles route with power-of-two-choices; an
+aiohttp proxy provides HTTP ingress; autoscaling follows ongoing-request
+load."""
+
+from ray_tpu.serve.api import (
+    delete,
+    get_deployment_handle,
+    get_proxy_port,
+    run,
+    shutdown,
+    start,
+    status,
+)
+from ray_tpu.serve.deployment import (
+    Application,
+    AutoscalingConfig,
+    Deployment,
+    DeploymentConfig,
+    deployment,
+)
+from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
+
+__all__ = [
+    "Application",
+    "AutoscalingConfig",
+    "Deployment",
+    "DeploymentConfig",
+    "DeploymentHandle",
+    "DeploymentResponse",
+    "delete",
+    "deployment",
+    "get_deployment_handle",
+    "get_proxy_port",
+    "run",
+    "shutdown",
+    "start",
+    "status",
+]

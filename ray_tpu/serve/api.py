@@ -1,0 +1,146 @@
+"""serve public API: run / start / shutdown / handles / status.
+
+Counterpart of the reference's serve/api.py (serve.run :591 →
+client.deploy_application → ServeController) — SURVEY.md §3.5 call stack."""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+import ray_tpu
+from ray_tpu.exceptions import RayTpuError
+from ray_tpu.serve.controller import ServeController, _HandleMarker
+from ray_tpu.serve.deployment import Application, Deployment
+from ray_tpu.serve.handle import DeploymentHandle
+from ray_tpu.serve.proxy import HTTPProxy
+
+_controller = None
+_proxy = None
+
+
+def start(*, http_host: str = "127.0.0.1", http_port: int = 0, proxy: bool = True):
+    """Ensure the controller (and optionally the HTTP proxy) are running."""
+    global _controller, _proxy
+    ray_tpu.api.auto_init()
+    if _controller is None:
+        try:
+            _controller = ray_tpu.get_actor("SERVE_CONTROLLER", namespace="serve")
+        except (RayTpuError, ValueError):
+            cls = ray_tpu.remote(num_cpus=0, max_concurrency=16, name="SERVE_CONTROLLER",
+                                 namespace="serve")(ServeController)
+            _controller = cls.remote()
+            ray_tpu.get(_controller.ping.remote())  # wait until live
+    if proxy and _proxy is None:
+        cls = ray_tpu.remote(num_cpus=0, max_concurrency=32, name="SERVE_PROXY",
+                             namespace="serve")(HTTPProxy)
+        _proxy = cls.remote(http_host, http_port)
+        ray_tpu.get(_proxy.ping.remote())
+    return _controller
+
+
+def _specs_from_app(app: Application, route_prefix: str | None) -> list[dict]:
+    nodes = app.flatten()
+    specs = []
+    for node in nodes:
+        dep: Deployment = node.deployment
+        args = tuple(
+            _HandleMarker(a.deployment.name) if isinstance(a, Application) else a
+            for a in node.init_args
+        )
+        kwargs = {
+            k: _HandleMarker(v.deployment.name) if isinstance(v, Application) else v
+            for k, v in node.init_kwargs.items()
+        }
+        prefix = dep.route_prefix
+        if node is nodes[-1]:  # ingress (root of the bind tree)
+            prefix = route_prefix if route_prefix is not None else (prefix or "/")
+        specs.append(
+            {
+                "name": dep.name,
+                "cls": dep.cls,
+                "config": dep.config,
+                "init_args": args,
+                "init_kwargs": kwargs,
+                "route_prefix": prefix,
+            }
+        )
+    return specs
+
+
+def run(app: Application | Deployment, *, route_prefix: str | None = None,
+        _blocking_ready: bool = True, proxy: bool = True) -> DeploymentHandle:
+    """Deploy an application; returns a handle to its ingress deployment."""
+    if isinstance(app, Deployment):
+        app = app.bind()
+    controller = start(proxy=proxy)
+    specs = _specs_from_app(app, route_prefix)
+    ray_tpu.get(controller.deploy_application.remote(specs))
+    if _proxy is not None:
+        routes = ray_tpu.get(controller.get_routes.remote())
+        ray_tpu.get(_proxy.update_routes.remote(routes))
+    handle = DeploymentHandle(app.deployment.name)
+    if _blocking_ready:
+        _wait_ready(controller, app.deployment.name)
+    return handle
+
+
+def _wait_ready(controller, name: str, timeout_s: float = 30.0) -> None:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        st = ray_tpu.get(controller.status.remote()).get(name)
+        # Ready = first replica serving (full scale-out continues in the
+        # background; reference serve.run readiness semantics).
+        if st and st["running_replicas"] >= 1:
+            return
+        time.sleep(0.1)
+    raise TimeoutError(f"deployment {name} not ready after {timeout_s}s")
+
+
+def get_deployment_handle(name: str) -> DeploymentHandle:
+    return DeploymentHandle(name)
+
+
+def get_proxy_port() -> int:
+    if _proxy is None:
+        raise RayTpuError("serve proxy not running")
+    return ray_tpu.get(_proxy.get_port.remote())
+
+
+def status() -> dict:
+    global _controller
+    if _controller is None:
+        start(proxy=False)
+    return ray_tpu.get(_controller.status.remote())
+
+
+def delete(name: str) -> None:
+    if _controller is not None:
+        ray_tpu.get(_controller.delete_deployment.remote(name))
+        if _proxy is not None:
+            routes = ray_tpu.get(_controller.get_routes.remote())
+            ray_tpu.get(_proxy.update_routes.remote(routes))
+
+
+def shutdown() -> None:
+    global _controller, _proxy
+    if _controller is not None:
+        try:
+            ray_tpu.get(_controller.shutdown_deployments.remote(), timeout=30)
+        except RayTpuError:
+            pass
+        finally:
+            # The controller must die even if draining timed out: its
+            # reconcile loop is already stopped, and a live-but-stopped
+            # named actor would be reused as a zombie by the next start().
+            try:
+                ray_tpu.kill(_controller)
+            except RayTpuError:
+                pass
+        _controller = None
+    if _proxy is not None:
+        try:
+            ray_tpu.kill(_proxy)
+        except RayTpuError:
+            pass
+        _proxy = None

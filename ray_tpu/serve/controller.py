@@ -1,0 +1,240 @@
+"""ServeController: deployment state reconciliation.
+
+Counterpart of the reference's ServeController actor
+(serve/_private/controller.py:84) with the DeploymentState FSM
+(deployment_state.py:1249,2330): a background reconcile loop drives each
+deployment's replica set toward its target (scale up/down, replace dead
+replicas, autoscale from ongoing-request metrics). Handles/proxies read
+the versioned routing table (`get_replicas`) — the pull analogue of the
+reference's LongPollHost config pushdown (long_poll.py:204)."""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+import uuid
+from typing import Any
+
+import ray_tpu
+from ray_tpu.exceptions import RayTpuError
+from ray_tpu.serve.deployment import AutoscalingConfig, DeploymentConfig
+from ray_tpu.serve.handle import DeploymentHandle
+from ray_tpu.serve.replica import Replica
+
+
+class _HandleMarker:
+    """Placeholder for a child deployment in init args (resolved to a
+    DeploymentHandle inside the replica process)."""
+
+    def __init__(self, name: str):
+        self.name = name
+
+
+class _DeploymentState:
+    def __init__(self, spec: dict):
+        self.spec = spec
+        self.config: DeploymentConfig = spec["config"]
+        self.replicas: dict[str, Any] = {}  # rid -> ActorHandle
+        self.version = 0
+        self.last_metrics: dict[str, dict] = {}
+        self.target = self.config.num_replicas
+        asc = self.config.autoscaling_config
+        if asc is not None:
+            self.target = max(asc.min_replicas, min(self.config.num_replicas, asc.max_replicas))
+        self._last_downscale = time.monotonic()
+
+    def status(self) -> dict:
+        return {
+            "name": self.spec["name"],
+            "target_replicas": self.target,
+            "running_replicas": len(self.replicas),
+            "version": self.version,
+        }
+
+
+class ServeController:
+    """Runs as a named actor (SERVE_CONTROLLER @ namespace 'serve')."""
+
+    RECONCILE_PERIOD_S = 0.25
+
+    def __init__(self):
+        self._deployments: dict[str, _DeploymentState] = {}
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._reconcile_loop, daemon=True, name="serve-reconcile"
+        )
+        self._thread.start()
+
+    # -- API (called by serve.run / handles / proxy) -----------------------
+
+    def deploy_application(self, specs: list[dict]) -> None:
+        """Deploy/refresh deployments (children-first order from
+        Application.flatten)."""
+        with self._lock:
+            for spec in specs:
+                existing = self._deployments.get(spec["name"])
+                if existing is not None:
+                    # Config update: keep replicas, adopt new target;
+                    # code changes take effect on replica replacement.
+                    existing.spec = spec
+                    existing.config = spec["config"]
+                    existing.target = spec["config"].num_replicas
+                else:
+                    self._deployments[spec["name"]] = _DeploymentState(spec)
+        self._reconcile_once()  # synchronous first pass: fast readiness
+
+    def get_replicas(self, deployment_name: str) -> dict:
+        with self._lock:
+            st = self._deployments.get(deployment_name)
+            if st is None:
+                raise RayTpuError(f"no deployment named {deployment_name!r}")
+            return {
+                "version": st.version,
+                "replicas": [(rid, actor) for rid, actor in st.replicas.items()],
+            }
+
+    def get_routes(self) -> dict[str, str]:
+        with self._lock:
+            routes = {}
+            for st in self._deployments.values():
+                prefix = st.spec.get("route_prefix")
+                if prefix:
+                    routes[prefix] = st.spec["name"]
+            return routes
+
+    def status(self) -> dict:
+        with self._lock:
+            return {name: st.status() for name, st in self._deployments.items()}
+
+    def delete_deployment(self, name: str) -> None:
+        with self._lock:
+            st = self._deployments.pop(name, None)
+        if st is not None:
+            for actor in st.replicas.values():
+                self._kill(actor)
+
+    def shutdown_deployments(self) -> None:
+        with self._lock:
+            names = list(self._deployments)
+        for n in names:
+            self.delete_deployment(n)
+        self._stop.set()
+
+    def ping(self) -> str:
+        return "pong"
+
+    # -- reconciliation ----------------------------------------------------
+
+    def _reconcile_loop(self) -> None:
+        while not self._stop.wait(self.RECONCILE_PERIOD_S):
+            try:
+                self._reconcile_once()
+            except Exception:  # noqa: BLE001 — the loop must survive
+                import traceback
+
+                traceback.print_exc()
+
+    def _reconcile_once(self) -> None:
+        with self._lock:
+            states = list(self._deployments.values())
+        for st in states:
+            self._probe_health(st)
+            self._autoscale(st)
+            self._scale_to_target(st)
+
+    def _probe_health(self, st: _DeploymentState) -> None:
+        dead = []
+        refs = []
+        with self._lock:
+            items = list(st.replicas.items())
+        for rid, actor in items:
+            try:
+                refs.append((rid, actor.get_metrics.remote()))
+            except RayTpuError:
+                dead.append(rid)
+        # One bounded wait for the whole replica set — a saturated replica
+        # costs the loop at most the deadline, not deadline × replicas.
+        if refs:
+            ready, _ = ray_tpu.wait(
+                [ref for _, ref in refs], num_returns=len(refs), timeout=5.0
+            )
+            ready_set = {r.hex() for r in ready}
+            for rid, ref in refs:
+                if ref.hex() not in ready_set:
+                    # Slow ≠ dead (busy replica, or still constructing on
+                    # a loaded host). Dead workers fail fast — the head
+                    # errors their pending calls on disconnect.
+                    continue
+                try:
+                    st.last_metrics[rid] = ray_tpu.get(ref)
+                except RayTpuError:
+                    dead.append(rid)
+        if dead:
+            with self._lock:
+                for rid in dead:
+                    actor = st.replicas.pop(rid, None)
+                    st.last_metrics.pop(rid, None)
+                    if actor is not None:
+                        self._kill(actor)
+                st.version += 1
+
+    def _autoscale(self, st: _DeploymentState) -> None:
+        asc: AutoscalingConfig | None = st.config.autoscaling_config
+        if asc is None:
+            return
+        ongoing = sum(m.get("ongoing", 0) for m in st.last_metrics.values())
+        desired = math.ceil(ongoing / max(asc.target_ongoing_requests, 1e-9))
+        desired = max(asc.min_replicas, min(asc.max_replicas, desired))
+        now = time.monotonic()
+        if desired > st.target:
+            st.target = desired  # upscale immediately
+            st._last_downscale = now
+        elif desired < st.target:
+            if now - st._last_downscale >= asc.downscale_delay_s:
+                st.target = max(desired, st.target - 1)  # step down gently
+                st._last_downscale = now
+        else:
+            st._last_downscale = now
+
+    def _scale_to_target(self, st: _DeploymentState) -> None:
+        with self._lock:
+            current = len(st.replicas)
+            if current < st.target:
+                for _ in range(st.target - current):
+                    rid, actor = self._start_replica(st)
+                    st.replicas[rid] = actor
+                st.version += 1
+            elif current > st.target:
+                doomed = list(st.replicas)[st.target - current:]
+                for rid in doomed:
+                    actor = st.replicas.pop(rid)
+                    st.last_metrics.pop(rid, None)
+                    self._kill(actor)
+                st.version += 1
+
+    def _start_replica(self, st: _DeploymentState) -> tuple[str, Any]:
+        spec = st.spec
+        rid = f"{spec['name']}#{uuid.uuid4().hex[:6]}"
+        opts = dict(spec["config"].ray_actor_options)
+        opts.setdefault("num_cpus", 0)
+        opts["max_concurrency"] = max(2, spec["config"].max_ongoing_requests)
+        actor_cls = ray_tpu.remote(**opts)(Replica)
+        init_args = tuple(self._resolve(a) for a in spec["init_args"])
+        init_kwargs = {k: self._resolve(v) for k, v in spec["init_kwargs"].items()}
+        actor = actor_cls.remote(spec["cls"], init_args, init_kwargs, spec["name"], rid)
+        return rid, actor
+
+    @staticmethod
+    def _resolve(arg):
+        if isinstance(arg, _HandleMarker):
+            return DeploymentHandle(arg.name)
+        return arg
+
+    @staticmethod
+    def _kill(actor) -> None:
+        try:
+            ray_tpu.kill(actor)
+        except RayTpuError:
+            pass

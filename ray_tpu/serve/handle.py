@@ -1,0 +1,172 @@
+"""DeploymentHandle: client-side router to a deployment's replicas.
+
+Counterpart of the reference's DeploymentHandle (serve/handle.py:625) and
+the power-of-two-choices replica scheduler
+(serve/_private/replica_scheduler/pow_2_scheduler.py): pick two random
+replicas, route to the one with fewer requests this handle has in flight.
+Replica-set changes propagate by version polling against the controller —
+the long-poll (long_poll.py:204) analogue with a pull cadence."""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any
+
+import ray_tpu
+from ray_tpu.exceptions import ActorError, RayTpuError
+
+
+class DeploymentResponse:
+    """Future for one request (reference: handle.py DeploymentResponse).
+
+    If the routed-to replica died before completing, `result()` transparently
+    re-issues the request through the handle (the reference router's
+    retry-on-replica-death behavior)."""
+
+    def __init__(self, ref, on_done=None, retry=None):
+        self._ref = ref
+        self._on_done = on_done
+        self._retry = retry
+        self._done = False
+
+    def result(self, timeout_s: float | None = None) -> Any:
+        try:
+            value = ray_tpu.get(self._ref, timeout=timeout_s)
+        except ActorError:
+            if self._finish() and self._retry is not None:
+                nxt = self._retry()
+                if nxt is not None:
+                    return nxt.result(timeout_s=timeout_s)
+            raise
+        self._finish()
+        return value
+
+    def _finish(self) -> bool:
+        if not self._done:
+            self._done = True
+            if self._on_done is not None:
+                self._on_done()
+            return True
+        return False
+
+    @property
+    def ref(self):
+        """The underlying ObjectRef (composition: pass to other calls)."""
+        return self._ref
+
+
+class DeploymentHandle:
+    _REFRESH_S = 1.0
+
+    def __init__(self, deployment_name: str, controller=None, method: str = "__call__"):
+        self.deployment_name = deployment_name
+        self._method = method
+        self._controller = controller
+        self._replicas: list = []
+        self._version = -1
+        self._last_refresh = 0.0
+        self._inflight: dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    # -- controller discovery (lazy: handles are cheap to pickle) ----------
+
+    def _get_controller(self):
+        if self._controller is None:
+            self._controller = ray_tpu.get_actor("SERVE_CONTROLLER", namespace="serve")
+        return self._controller
+
+    def _refresh(self, force: bool = False) -> None:
+        now = time.monotonic()
+        if not force and self._replicas and now - self._last_refresh < self._REFRESH_S:
+            return
+        info = ray_tpu.get(
+            self._get_controller().get_replicas.remote(self.deployment_name)
+        )
+        with self._lock:
+            self._replicas = info["replicas"]
+            self._version = info["version"]
+            self._last_refresh = now
+            self._inflight = {
+                rid: self._inflight.get(rid, 0) for rid, _ in self._replicas
+            }
+
+    # -- routing -----------------------------------------------------------
+
+    def _pick(self):
+        """Power-of-two-choices over this handle's in-flight counts."""
+        with self._lock:
+            reps = list(self._replicas)
+        if not reps:
+            raise RayTpuError(
+                f"deployment {self.deployment_name!r} has no running replicas"
+            )
+        if len(reps) == 1:
+            return reps[0]
+        a, b = random.sample(reps, 2)
+        return a if self._inflight.get(a[0], 0) <= self._inflight.get(b[0], 0) else b
+
+    def options(self, *, method_name: str | None = None) -> "DeploymentHandle":
+        h = DeploymentHandle(self.deployment_name, self._controller,
+                             method_name or self._method)
+        # Share router state with the parent: the replica cache stays warm
+        # (no per-call controller RPC) and power-of-two choices sees ALL
+        # in-flight requests, not just this method-view's.
+        h._replicas, h._version = self._replicas, self._version
+        h._last_refresh = self._last_refresh
+        h._inflight = self._inflight
+        h._lock = self._lock
+        return h
+
+    def __getattr__(self, name: str) -> "DeploymentHandle":
+        if name.startswith("_"):
+            raise AttributeError(name)
+        # Cache the method view: repeated h.method.remote() calls reuse one
+        # child handle, so its replica cache warms up instead of being
+        # rebuilt (and re-fetched from the controller) per call.
+        child = self.options(method_name=name)
+        self.__dict__[name] = child
+        return child
+
+    def remote(self, *args, _retries_left: int = 2, **kwargs) -> DeploymentResponse:
+        self._refresh()
+        # Unwrap response objects for composition: pass the underlying ref
+        # so the downstream task consumes the upstream output directly.
+        args = tuple(a.ref if isinstance(a, DeploymentResponse) else a for a in args)
+        kwargs = {k: (v.ref if isinstance(v, DeploymentResponse) else v)
+                  for k, v in kwargs.items()}
+
+        def retry() -> "DeploymentResponse | None":
+            if _retries_left <= 0:
+                return None
+            self._refresh(force=True)
+            return self.remote(*args, _retries_left=_retries_left - 1, **kwargs)
+        last_err: Exception | None = None
+        for _ in range(3):  # retry across replica death
+            try:
+                rid, actor = self._pick()
+            except RayTpuError as e:
+                # Replica set may be mid-rollout: force-refresh and retry.
+                last_err = e
+                time.sleep(0.2)
+                self._refresh(force=True)
+                continue
+            with self._lock:
+                self._inflight[rid] = self._inflight.get(rid, 0) + 1
+
+            def done(rid=rid):
+                with self._lock:
+                    self._inflight[rid] = max(0, self._inflight.get(rid, 0) - 1)
+
+            try:
+                ref = actor.handle_request.remote(self._method, args, kwargs)
+                return DeploymentResponse(ref, on_done=done, retry=retry)
+            except ActorError as e:
+                done()
+                last_err = e
+                self._refresh(force=True)
+        raise last_err or RayTpuError("routing failed")
+
+    def __reduce__(self):
+        return (DeploymentHandle, (self.deployment_name, None, self._method))

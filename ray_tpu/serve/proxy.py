@@ -1,0 +1,135 @@
+"""HTTP proxy: the ingress actor.
+
+Counterpart of the reference's HTTPProxy/ProxyActor (serve/_private/proxy.py
+:754,:1131 — uvicorn/ASGI). Here: an aiohttp server on its own event-loop
+thread inside a proxy actor. Routes come from the controller's route table
+(route_prefix → deployment); requests are routed through a DeploymentHandle
+(power-of-two choices) and awaited without blocking the loop."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import socket
+import threading
+from typing import Any
+
+from ray_tpu.serve.handle import DeploymentHandle
+
+
+class HTTPProxy:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._host = host
+        self._handles: dict[str, DeploymentHandle] = {}
+        self._routes: dict[str, str] = {}
+        self._port = port
+        self._ready = threading.Event()
+        self._loop: asyncio.AbstractEventLoop | None = None
+        # Bind the socket synchronously so get_port is correct immediately.
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._port = self._sock.getsockname()[1]
+        self._thread = threading.Thread(target=self._serve, daemon=True, name="http-proxy")
+        self._thread.start()
+        self._ready.wait(timeout=10)
+
+    # -- control -----------------------------------------------------------
+
+    def get_port(self) -> int:
+        return self._port
+
+    def update_routes(self, routes: dict[str, str]) -> None:
+        """route_prefix -> deployment name (pushed by serve.run/delete).
+        Handles are populated BEFORE the route table swap (requests racing
+        this update must never see a route without a handle), and stale
+        handles are dropped."""
+        handles = {
+            name: self._handles.get(name) or DeploymentHandle(name)
+            for name in routes.values()
+        }
+        self._handles.update(handles)
+        self._routes = dict(routes)
+        for name in list(self._handles):
+            if name not in handles:
+                del self._handles[name]
+
+    def ping(self) -> str:
+        return "pong"
+
+    # -- server ------------------------------------------------------------
+
+    def _serve(self) -> None:
+        from aiohttp import web
+
+        async def handle(request: "web.Request") -> "web.Response":
+            path = request.path.rstrip("/") or "/"
+            name = self._match_route(path)
+            if name is None:
+                return web.json_response(
+                    {"error": f"no route for {path}"}, status=404
+                )
+            if request.method == "POST":
+                raw = await request.read()
+                try:
+                    payload = json.loads(raw) if raw else {}
+                except json.JSONDecodeError:
+                    payload = raw.decode()
+            else:
+                payload = dict(request.query)
+            try:
+                handle_ = self._handles[name]
+
+                def call() -> Any:
+                    # Routing (blocking controller RPCs, retry sleeps) AND
+                    # the result wait both stay off the event loop.
+                    return handle_.remote(payload).result(timeout_s=30.0)
+
+                result = await asyncio.get_running_loop().run_in_executor(None, call)
+            except Exception as e:  # noqa: BLE001 — surface to the client
+                return web.json_response({"error": str(e)}, status=500)
+            return self._encode(web, result)
+
+        async def run():
+            app = web.Application()
+            app.router.add_route("*", "/{tail:.*}", handle)
+            runner = web.AppRunner(app)
+            await runner.setup()
+            site = web.SockSite(runner, self._sock)
+            await site.start()
+            self._ready.set()
+            while True:  # park forever; actor kill tears the process down
+                await asyncio.sleep(3600)
+
+        self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self._loop)
+        self._loop.run_until_complete(run())
+
+    def _match_route(self, path: str) -> str | None:
+        # Longest-prefix match (reference: proxy route matching).
+        best, best_len = None, -1
+        for prefix, name in self._routes.items():
+            p = prefix.rstrip("/") or "/"
+            if (path == p or path.startswith(p + "/") or p == "/") and len(p) > best_len:
+                best, best_len = name, len(p)
+        return best
+
+    @staticmethod
+    def _encode(web, result: Any):
+        import numpy as np
+
+        def default(o):
+            if isinstance(o, np.ndarray):
+                return o.tolist()
+            if isinstance(o, np.generic):
+                return o.item()
+            raise TypeError(f"not JSON serializable: {type(o)}")
+
+        if isinstance(result, (bytes, bytearray)):
+            return web.Response(body=bytes(result))
+        if isinstance(result, str):
+            return web.Response(text=result)
+        return web.Response(
+            text=json.dumps(result, default=default),
+            content_type="application/json",
+        )

@@ -1,0 +1,67 @@
+"""Replica: the actor hosting one copy of a deployment's user class.
+
+Counterpart of the reference's serve/_private/replica.py — wraps the user
+callable, counts ongoing requests (the autoscaling signal), exposes a
+health check. Runs with max_concurrency > 1 so requests overlap up to
+max_ongoing_requests (threaded-actor semantics here; the reference uses
+an asyncio replica event loop)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+
+class Replica:
+    def __init__(self, cls_or_fn, init_args: tuple, init_kwargs: dict,
+                 deployment_name: str, replica_id: str):
+        self.deployment_name = deployment_name
+        self.replica_id = replica_id
+        self._ongoing = 0
+        self._total = 0
+        self._lock = threading.Lock()
+        if isinstance(cls_or_fn, type):
+            self.instance = cls_or_fn(*init_args, **init_kwargs)
+        else:
+            self.instance = cls_or_fn  # plain function deployment
+
+    def handle_request(self, method: str, args: tuple, kwargs: dict) -> Any:
+        import ray_tpu
+        from ray_tpu._private.ids import ObjectRef
+
+        with self._lock:
+            self._ongoing += 1
+            self._total += 1
+        try:
+            # Composition: upstream DeploymentResponses arrive as nested
+            # ObjectRefs (handle.remote unwraps .ref); await them here.
+            args = tuple(ray_tpu.get(a) if isinstance(a, ObjectRef) else a for a in args)
+            kwargs = {k: (ray_tpu.get(v) if isinstance(v, ObjectRef) else v)
+                      for k, v in kwargs.items()}
+            if method in ("__call__", ""):
+                target = self.instance
+            else:
+                target = getattr(self.instance, method)
+            return target(*args, **kwargs)
+        finally:
+            with self._lock:
+                self._ongoing -= 1
+
+    def get_metrics(self) -> dict:
+        with self._lock:
+            return {
+                "replica_id": self.replica_id,
+                "ongoing": self._ongoing,
+                "total": self._total,
+            }
+
+    def check_health(self) -> bool:
+        user_check = getattr(self.instance, "check_health", None)
+        if callable(user_check):
+            user_check()
+        return True
+
+    def reconfigure(self, user_config: Any) -> None:
+        hook = getattr(self.instance, "reconfigure", None)
+        if callable(hook):
+            hook(user_config)
