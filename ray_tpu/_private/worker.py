@@ -96,7 +96,10 @@ class Worker:
     # ------------------------------------------------------------------
 
     def _run_task_guarded(self, spec: TaskSpec, tpu_chips) -> None:
+        import time
+
         failed = False
+        start = time.time()
         try:
             failed = not self._run_task(spec, tpu_chips)
         except Exception:
@@ -110,6 +113,26 @@ class Worker:
                         "worker_id": self.worker_id,
                         "task_id": spec.task_id,
                         "failed": failed,
+                    },
+                )
+                # Profile event → head task-event buffer (reference:
+                # core_worker/task_event_buffer.h:225 → GcsTaskManager;
+                # consumed by `ray timeline`, profiling.py:124).
+                self.runtime.conn.cast(
+                    "task_events",
+                    {
+                        "events": [
+                            {
+                                "task_id": spec.task_id,
+                                "name": spec.name,
+                                "worker_id": self.worker_id,
+                                "node_id": self.node_id,
+                                "pid": os.getpid(),
+                                "start": start,
+                                "end": time.time(),
+                                "failed": failed,
+                            }
+                        ]
                     },
                 )
             except Exception:

@@ -1,0 +1,20 @@
+"""ray_tpu.util: collective groups, placement groups, pools, queues,
+metrics, and the state/introspection API (reference: ray.util)."""
+
+from ray_tpu.util.actor_pool import ActorPool
+from ray_tpu.util.placement_group import (
+    placement_group,
+    placement_group_table,
+    remove_placement_group,
+)
+from ray_tpu.util.queue import Empty, Full, Queue
+
+__all__ = [
+    "ActorPool",
+    "Empty",
+    "Full",
+    "Queue",
+    "placement_group",
+    "placement_group_table",
+    "remove_placement_group",
+]

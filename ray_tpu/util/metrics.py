@@ -1,0 +1,201 @@
+"""User-defined metrics: Counter / Gauge / Histogram.
+
+Counterpart of the reference's ray.util.metrics (util/metrics.py —
+Counter :163, Gauge :216, Histogram :294, exported via the per-node
+Prometheus agent). Here metrics are pushed to the head's metric table
+keyed by (name, reporter, tags) and aggregated on read; `get_metrics_report`
+/ `prometheus_text` are the scrape surface."""
+
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+import uuid
+from typing import Any, Optional, Sequence
+
+from ray_tpu._private.worker_context import global_runtime
+
+_FLUSH_INTERVAL_S = 1.0
+
+
+class _MetricBase:
+    TYPE = "untyped"
+
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Sequence[str] | None = None):
+        if not name:
+            raise ValueError("metric name must be non-empty")
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys or ())
+        self._default_tags: dict[str, str] = {}
+        self._values: dict[tuple, Any] = {}
+        self._lock = threading.Lock()
+        self._last_flush = 0.0
+        # Per-instance series id: a re-created metric object (reused
+        # worker, new task) contributes a NEW series instead of
+        # overwriting the previous instance's accumulated value.
+        self._instance_id = uuid.uuid4().hex[:8]
+
+    def set_default_tags(self, tags: dict[str, str]) -> "_MetricBase":
+        self._default_tags = dict(tags)
+        return self
+
+    def _tag_tuple(self, tags: Optional[dict]) -> tuple:
+        merged = dict(self._default_tags)
+        if tags:
+            merged.update(tags)
+        extra = set(merged) - set(self.tag_keys)
+        if extra:
+            raise ValueError(f"undeclared tag keys {sorted(extra)} for {self.name}")
+        return tuple((k, merged.get(k, "")) for k in self.tag_keys)
+
+    def _flush(self, force: bool = False) -> None:
+        now = time.monotonic()
+        if not force and now - self._last_flush < _FLUSH_INTERVAL_S:
+            return
+        self._last_flush = now
+        try:
+            rt = global_runtime()
+        except Exception:
+            return  # not connected; metrics are best-effort
+        with self._lock:
+            payload = {}
+            for tags, value in self._values.items():
+                key = f"{self.name}|{rt.client_id}|{self._instance_id}|{dict(tags)}"
+                payload[key] = {
+                    "name": self.name,
+                    "type": self.TYPE,
+                    "description": self.description,
+                    "tags": dict(tags),
+                    "value": value,
+                    "reporter": f"{rt.client_id}/{self._instance_id}",
+                    "ts": time.time(),
+                }
+        try:
+            rt.conn.cast("report_metrics", {"metrics": payload})
+        except Exception:
+            pass
+
+
+class Counter(_MetricBase):
+    """Monotonic counter (reference: util/metrics.py:163)."""
+
+    TYPE = "counter"
+
+    def inc(self, value: float = 1.0, tags: dict | None = None) -> None:
+        if value < 0:
+            raise ValueError("Counter.inc() requires value >= 0")
+        key = self._tag_tuple(tags)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + value
+        self._flush()
+
+
+class Gauge(_MetricBase):
+    """Point-in-time value (reference: util/metrics.py:216)."""
+
+    TYPE = "gauge"
+
+    def set(self, value: float, tags: dict | None = None) -> None:
+        with self._lock:
+            self._values[self._tag_tuple(tags)] = float(value)
+        self._flush()
+
+
+class Histogram(_MetricBase):
+    """Bucketed observations (reference: util/metrics.py:294)."""
+
+    TYPE = "histogram"
+
+    def __init__(self, name: str, description: str = "",
+                 boundaries: Sequence[float] | None = None,
+                 tag_keys: Sequence[str] | None = None):
+        super().__init__(name, description, tag_keys)
+        if not boundaries:
+            boundaries = [0.001, 0.01, 0.1, 1.0, 10.0, 100.0]
+        self.boundaries = sorted(float(b) for b in boundaries)
+
+    def observe(self, value: float, tags: dict | None = None) -> None:
+        key = self._tag_tuple(tags)
+        with self._lock:
+            state = self._values.get(key)
+            if state is None:
+                state = {
+                    "buckets": [0] * (len(self.boundaries) + 1),
+                    "sum": 0.0,
+                    "count": 0,
+                    "boundaries": self.boundaries,
+                }
+                self._values[key] = state
+            idx = bisect.bisect_left(self.boundaries, value)
+            state["buckets"][idx] += 1
+            state["sum"] += value
+            state["count"] += 1
+        self._flush()
+
+
+def flush_all_of(*metrics: _MetricBase) -> None:
+    for m in metrics:
+        m._flush(force=True)
+
+
+def get_metrics_report() -> dict[str, dict]:
+    """All reported metric points, aggregated across reporters: counters
+    and histograms sum; gauges keep the latest per reporter-tagset."""
+    raw = global_runtime().conn.call("get_metrics", {})["metrics"]
+    agg: dict[str, dict] = {}
+    for point in raw.values():
+        name = point["name"]
+        tags = tuple(sorted(point["tags"].items()))
+        entry = agg.setdefault(name, {"type": point["type"], "series": {}})
+        series = entry["series"]
+        if point["type"] == "counter":
+            series[tags] = series.get(tags, 0.0) + point["value"]
+        elif point["type"] == "histogram":
+            cur = series.get(tags)
+            if cur is None:
+                series[tags] = {k: (list(v) if isinstance(v, list) else v)
+                                for k, v in point["value"].items()}
+            else:
+                cur["sum"] += point["value"]["sum"]
+                cur["count"] += point["value"]["count"]
+                cur["buckets"] = [a + b for a, b in zip(cur["buckets"], point["value"]["buckets"])]
+        else:  # gauge: one series per (reporter, tags); latest write wins
+            series[(("__reporter__", point["reporter"]),) + tags] = point["value"]
+    return agg
+
+
+def prometheus_text() -> str:
+    """Prometheus exposition format (the per-node MetricsAgent surface,
+    reference: _private/metrics_agent.py:492)."""
+    lines = []
+    for name, entry in get_metrics_report().items():
+        lines.append(f"# TYPE {name} {entry['type']}")
+        for tags, value in entry["series"].items():
+            # "__reporter__" (gauge per-reporter series) renders as a
+            # reporter label so duplicate-named samples stay distinct.
+            pairs = [("reporter", v) if k == "__reporter__" else (k, v)
+                     for k, v in tags]
+            label_body = ",".join(f'{k}="{v}"' for k, v in pairs)
+            label = "{" + label_body + "}" if label_body else ""
+            if entry["type"] == "histogram":
+                for b, c in zip(value["boundaries"] + [float("inf")],
+                                _cumulative(value["buckets"])):
+                    le = f'le="{b}"'
+                    bucket_label = "{" + (label_body + "," if label_body else "") + le + "}"
+                    lines.append(f"{name}_bucket{bucket_label} {c}")
+                lines.append(f"{name}_sum{label} {value['sum']}")
+                lines.append(f"{name}_count{label} {value['count']}")
+            else:
+                lines.append(f"{name}{label} {value}")
+    return "\n".join(lines) + "\n"
+
+
+def _cumulative(buckets: list[int]) -> list[int]:
+    out, total = [], 0
+    for b in buckets:
+        total += b
+        out.append(total)
+    return out

@@ -1,0 +1,123 @@
+"""State API: cluster introspection.
+
+Counterpart of the reference's ray.util.state (util/state/api.py —
+list_actors :784, list_tasks :1011, summarize_tasks :1368), backed by the
+head's task/actor/object/worker tables instead of GCS task events."""
+
+from __future__ import annotations
+
+import time
+from collections import Counter
+from typing import Any
+
+from ray_tpu._private.worker_context import global_runtime
+
+
+def _call(method: str, body: dict | None = None) -> dict:
+    return global_runtime().conn.call(method, body or {})
+
+
+def _filtered(rows: list[dict], filters) -> list[dict]:
+    """filters: list of (key, predicate '=' or '!=', value) tuples."""
+    if not filters:
+        return rows
+    out = []
+    for r in rows:
+        ok = True
+        for key, op, value in filters:
+            have = r.get(key)
+            if op == "=":
+                ok = ok and str(have) == str(value)
+            elif op == "!=":
+                ok = ok and str(have) != str(value)
+            else:
+                raise ValueError(f"unsupported filter op {op!r}")
+        if ok:
+            out.append(r)
+    return out
+
+
+def list_tasks(*, filters=None, limit: int = 1000) -> list[dict]:
+    # With filters, fetch the full table window before filtering —
+    # otherwise matches outside the last `limit` rows are silently missed.
+    server_limit = limit if not filters else 1_000_000
+    rows = _call("list_tasks", {"limit": server_limit})["tasks"]
+    return _filtered([dict(r) for r in rows], filters)[:limit]
+
+
+def list_actors(*, filters=None, limit: int = 1000) -> list[dict]:
+    rows = _call("list_actors")["actors"]
+    return _filtered(rows, filters)[:limit]
+
+
+def list_objects(*, filters=None, limit: int = 1000) -> list[dict]:
+    rows = _call("list_objects")["objects"]
+    return _filtered(rows, filters)[:limit]
+
+
+def list_workers(*, filters=None, limit: int = 1000) -> list[dict]:
+    rows = _call("list_workers")["workers"]
+    return _filtered(rows, filters)[:limit]
+
+
+def list_nodes(*, filters=None, limit: int = 1000) -> list[dict]:
+    rows = _call("get_nodes")["nodes"]
+    return _filtered(rows, filters)[:limit]
+
+
+def summarize_tasks() -> dict:
+    """Counts by (name, state) — reference: util/state/api.py:1368."""
+    by_name: dict[str, Counter] = {}
+    for t in list_tasks(limit=100000):
+        by_name.setdefault(t["name"], Counter())[t["state"]] += 1
+    return {
+        name: {"state_counts": dict(states), "total": sum(states.values())}
+        for name, states in by_name.items()
+    }
+
+
+def summarize_actors() -> dict:
+    states = Counter(a["state"] for a in list_actors(limit=100000))
+    return {"state_counts": dict(states), "total": sum(states.values())}
+
+
+def object_store_stats() -> dict:
+    return _call("store_stats")
+
+
+def get_task_events(limit: int = 10000) -> list[dict]:
+    return _call("get_task_events", {"limit": limit})["events"]
+
+
+def timeline(filename: str | None = None) -> "list | str":
+    """Chrome-trace export of task profile events (reference:
+    _private/profiling.py:124 `ray timeline`). Load the result in
+    chrome://tracing or Perfetto."""
+    events = get_task_events()
+    trace = []
+    node_index: dict[str, int] = {}  # Chrome traces want integer pids
+    for ev in events:
+        pid = node_index.setdefault(ev["node_id"], len(node_index))
+        trace.append(
+            {
+                "cat": "task",
+                "name": ev["name"],
+                "ph": "X",  # complete event
+                "ts": ev["start"] * 1e6,
+                "dur": (ev["end"] - ev["start"]) * 1e6,
+                "pid": pid,
+                "tid": int(ev["pid"]),
+                "args": {
+                    "task_id": ev["task_id"],
+                    "node_id": ev["node_id"],
+                    "failed": ev.get("failed", False),
+                },
+            }
+        )
+    if filename is None:
+        return trace
+    import json
+
+    with open(filename, "w") as f:
+        json.dump(trace, f)
+    return filename

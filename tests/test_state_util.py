@@ -1,0 +1,251 @@
+"""State API, metrics, ActorPool, Queue, timeline.
+
+Modeled on the reference's observability tests (SURVEY.md §5 —
+util/state list_actors/list_tasks, util/metrics Counter/Gauge/Histogram,
+`ray timeline` Chrome-trace export)."""
+
+from __future__ import annotations
+
+import json
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import ActorPool, Queue
+from ray_tpu.util import metrics as um
+from ray_tpu.util import state as us
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _cluster():
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=8, object_store_memory=64 * 1024 * 1024)
+    yield
+    ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# state API
+
+
+def test_list_tasks_and_summary():
+    @ray_tpu.remote
+    def work(x):
+        return x * 2
+
+    ray_tpu.get([work.remote(i) for i in range(5)])
+    tasks = us.list_tasks()
+    mine = [t for t in tasks if t["name"] == "work"]
+    assert len(mine) == 5
+    assert all(t["state"] == "FINISHED" for t in mine)
+    summary = us.summarize_tasks()
+    assert summary["work"]["total"] == 5
+    assert summary["work"]["state_counts"].get("FINISHED") == 5
+
+
+def test_list_actors_states():
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return "pong"
+
+    a = A.remote()
+    ray_tpu.get(a.ping.remote())
+    actors = us.list_actors(filters=[("state", "=", "ALIVE")])
+    assert any(x["state"] == "ALIVE" for x in actors)
+    ray_tpu.kill(a)
+    time.sleep(0.3)
+    dead = us.list_actors(filters=[("state", "=", "DEAD")])
+    assert dead  # the killed actor shows up as DEAD
+
+
+def test_list_objects_and_store_stats():
+    ref = ray_tpu.put(b"x" * 1024)
+    objs = us.list_objects()
+    assert any(o["object_id"] == ref.hex() for o in objs)
+    stats = us.object_store_stats()
+    assert stats["capacity"] > 0
+    assert "in_use" in stats
+
+
+def test_list_workers_and_nodes():
+    assert len(us.list_nodes()) == 1
+    workers = us.list_workers()
+    assert isinstance(workers, list)
+
+
+def test_timeline_chrome_trace(tmp_path):
+    @ray_tpu.remote
+    def traced():
+        time.sleep(0.01)
+        return 1
+
+    ray_tpu.get([traced.remote() for _ in range(3)])
+    time.sleep(0.3)  # task_events casts are async
+    path = us.timeline(str(tmp_path / "trace.json"))
+    events = json.load(open(path))
+    mine = [e for e in events if e["name"] == "traced"]
+    assert len(mine) == 3
+    assert all(e["ph"] == "X" and e["dur"] > 0 for e in mine)
+
+
+# ---------------------------------------------------------------------------
+# metrics
+
+
+def test_counter_gauge_histogram_report():
+    c = um.Counter("req_total", tag_keys=("route",))
+    c.inc(1.0, {"route": "/a"})
+    c.inc(2.0, {"route": "/a"})
+    c.inc(5.0, {"route": "/b"})
+    g = um.Gauge("inflight")
+    g.set(7.0)
+    h = um.Histogram("latency_s", boundaries=[0.1, 1.0])
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(3.0)
+    um.flush_all_of(c, g, h)
+    report = um.get_metrics_report()
+    series = report["req_total"]["series"]
+    assert series[(("route", "/a"),)] == 3.0
+    assert series[(("route", "/b"),)] == 5.0
+    assert 7.0 in report["inflight"]["series"].values()
+    hs = list(report["latency_s"]["series"].values())[0]
+    assert hs["count"] == 3 and hs["buckets"] == [1, 1, 1]
+    text = um.prometheus_text()
+    assert "# TYPE req_total counter" in text
+    assert 'req_total{route="/a"} 3.0' in text
+
+
+def test_counter_rejects_negative_and_bad_tags():
+    c = um.Counter("neg", tag_keys=("k",))
+    with pytest.raises(ValueError):
+        c.inc(-1.0)
+    with pytest.raises(ValueError):
+        c.inc(1.0, {"undeclared": "x"})
+
+
+def test_metrics_aggregate_across_workers():
+    @ray_tpu.remote
+    def emit(i):
+        from ray_tpu.util import metrics as um2
+
+        c = um2.Counter("cross_worker_total")
+        c.inc(10.0)
+        um2.flush_all_of(c)
+        return i
+
+    ray_tpu.get([emit.remote(i) for i in range(3)])
+    report = um.get_metrics_report()
+    total = sum(report["cross_worker_total"]["series"].values())
+    assert total == 30.0
+
+
+# ---------------------------------------------------------------------------
+# ActorPool
+
+
+def test_actor_pool_ordered_and_unordered():
+    @ray_tpu.remote
+    class Sq:
+        def compute(self, x):
+            return x * x
+
+    pool = ActorPool([Sq.remote() for _ in range(2)])
+    results = list(pool.map(lambda a, v: a.compute.remote(v), [1, 2, 3, 4]))
+    assert results == [1, 4, 9, 16]
+    unordered = sorted(
+        pool.map_unordered(lambda a, v: a.compute.remote(v), [5, 6])
+    )
+    assert unordered == [25, 36]
+
+
+def test_actor_pool_queues_when_busy():
+    @ray_tpu.remote
+    class Slow:
+        def go(self, x):
+            time.sleep(0.1)
+            return x
+
+    pool = ActorPool([Slow.remote()])
+    for i in range(3):
+        pool.submit(lambda a, v: a.go.remote(v), i)
+    assert not pool.has_free()
+    assert [pool.get_next() for _ in range(3)] == [0, 1, 2]
+    assert pool.has_free()
+
+
+# ---------------------------------------------------------------------------
+# Queue
+
+
+def test_queue_fifo_and_batches():
+    q = Queue()
+    for i in range(5):
+        q.put(i)
+    assert q.qsize() == 5
+    assert q.get() == 0
+    assert q.get_nowait_batch(2) == [1, 2]
+    q.put_nowait_batch([10, 11])
+    assert [q.get() for _ in range(4)] == [3, 4, 10, 11]
+    assert q.empty()
+    q.shutdown()
+
+
+def test_queue_maxsize_and_timeouts():
+    from ray_tpu.util import Empty, Full
+
+    q = Queue(maxsize=1)
+    q.put("a")
+    with pytest.raises(Full):
+        q.put("b", block=False)
+    assert q.get() == "a"
+    with pytest.raises(Empty):
+        q.get_nowait()
+    with pytest.raises(Empty):
+        q.get(timeout=0.2)
+    q.shutdown()
+
+
+def test_queue_batch_put_is_atomic():
+    from ray_tpu.util import Full
+
+    q = Queue(maxsize=2)
+    with pytest.raises(Full):
+        q.put_nowait_batch([1, 2, 3])
+    assert q.qsize() == 0  # nothing partially inserted
+    q.put_nowait_batch([1, 2])
+    assert q.qsize() == 2
+    q.shutdown()
+
+
+def test_actor_pool_timeout_preserves_state():
+    @ray_tpu.remote
+    class Slow2:
+        def go(self, x):
+            time.sleep(0.6)
+            return x
+
+    pool = ActorPool([Slow2.remote()])
+    pool.submit(lambda a, v: a.go.remote(v), 42)
+    with pytest.raises(TimeoutError):
+        pool.get_next(timeout=0.05)
+    # Result is still pending and retrievable; ordering intact.
+    assert pool.has_next()
+    assert pool.get_next(timeout=5.0) == 42
+
+
+def test_queue_shared_between_tasks():
+    q = Queue()
+
+    @ray_tpu.remote
+    def producer(q, n):
+        for i in range(n):
+            q.put(i)
+        return n
+
+    ray_tpu.get(producer.remote(q, 4))
+    assert sorted(q.get() for _ in range(4)) == [0, 1, 2, 3]
+    q.shutdown()
