@@ -45,6 +45,11 @@ class Config:
     actor_max_restarts_default: int = 0
     health_check_period_s: float = 1.0
     health_check_timeout_s: float = 30.0
+    # Lineage reconstruction (reference: task_manager.h:223 max_lineage_bytes,
+    # object_recovery_manager.h:43): producing TaskSpecs retained per return
+    # object, re-executed when a freed/lost object is fetched again.
+    max_lineage_entries: int = 100_000
+    max_object_reconstructions: int = 3
 
     # --- timeouts ---
     worker_register_timeout_s: float = 30.0

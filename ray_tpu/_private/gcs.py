@@ -187,6 +187,12 @@ class Head:
         self.clients: dict[str, rpc.Connection] = {}  # client_id -> conn
         self.task_events: deque[dict] = deque(maxlen=config.task_events_max_buffer)
         self.metrics: dict[str, Any] = {}
+        # Lineage: return object id -> producing TaskSpec (normal tasks).
+        # Reference: owner-side lineage pinning (task_manager.h:223) +
+        # ObjectRecoveryManager re-execution (object_recovery_manager.h:43).
+        self.lineage: dict[str, TaskSpec] = {}
+        self.lineage_order: deque[str] = deque()
+        self.reconstructions: dict[str, int] = {}
         self._lru_tick = 0
         self._shutdown = False
         self._subscribers: dict[str, list[rpc.Connection]] = {}  # pubsub topic
@@ -489,7 +495,15 @@ class Head:
         waiter_id, ids = body["waiter_id"], body["ids"]
         with self.lock:
             self._waiter_ids[waiter_id] = list(ids)
-            missing = {i for i in ids if not self._is_ready(i)}
+            missing = set()
+            for i in ids:
+                if self._is_ready(i):
+                    continue
+                # Freed-but-reconstructable objects re-execute their
+                # producing task (lineage); the seal unblocks this waiter.
+                self._maybe_reconstruct(i)
+                if not self._is_ready(i):
+                    missing.add(i)
             # Missing ids may be return values of tasks still in flight —
             # wait for their seal. The client applies its own timeout.
             if missing:
@@ -511,6 +525,9 @@ class Head:
     def _h_wait(self, body: dict, conn):
         waiter_id, ids, num_returns = body["waiter_id"], body["ids"], body["num_returns"]
         with self.lock:
+            for i in ids:
+                if not self._is_ready(i):
+                    self._maybe_reconstruct(i)
             ready = [i for i in ids if self._is_ready(i)]
             if len(ready) >= num_returns:
                 conn.cast("wait_ready", {"waiter_id": waiter_id, "ready": ready})
@@ -520,6 +537,9 @@ class Head:
 
     def _h_wait_check(self, body: dict, conn):
         with self.lock:
+            for i in body["ids"]:
+                if not self._is_ready(i):
+                    self._maybe_reconstruct(i)
             return {"ready": [i for i in body["ids"] if self._is_ready(i)]}
 
     def _h_cancel_wait(self, body: dict, conn):
@@ -646,8 +666,73 @@ class Head:
                 self._enqueue_actor_task(spec)
             else:
                 self.task_queue.append(spec)
+                self._record_lineage(spec)
         self.dispatch_event.set()
         return None
+
+    def _record_lineage(self, spec: TaskSpec) -> None:
+        """lock held. Remember who produces each return id (bounded)."""
+        for oid in spec.return_ids:
+            self.lineage[oid] = spec
+            self.lineage_order.append(oid)
+        while len(self.lineage_order) > self.config.max_lineage_entries:
+            old = self.lineage_order.popleft()
+            self.lineage.pop(old, None)
+
+    def _maybe_reconstruct(self, oid: str) -> bool:
+        """lock held. If `oid` is gone but its producing task is known,
+        re-execute the task (recursively re-creating missing deps).
+        Returns True when the object is ready, in flight, or now queued
+        for reconstruction. Reference: object_recovery_manager.h:43."""
+        entry = self.objects.get(oid)
+        if entry is not None and entry.state in (CREATING, SEALED, SPILLED):
+            return True  # fine or already being (re)produced
+        spec = self.lineage.get(oid)
+        if spec is None:
+            return False
+        # Budget is per re-EXECUTION of the producing task, not per return
+        # id (a 2-return task recovered once charges once).
+        used = self.reconstructions.get(spec.task_id, 0)
+        if used >= self.config.max_object_reconstructions:
+            return False
+        self.reconstructions[spec.task_id] = used + 1
+        # Resurrect entries for every return id BEFORE recursing so
+        # diamond-shaped lineage doesn't resubmit the same task twice.
+        for rid in spec.return_ids:
+            e = self.objects.get(rid) or ObjectEntry(rid, spec.owner_id)
+            e.state = CREATING
+            e.inline = None
+            if e.refcount == 0:
+                e.refcount = 1
+            self.objects[rid] = e
+        # Validate/recover ALL deps before pinning ANY: a failure must not
+        # touch pins that belong to other in-flight consumers of the deps.
+        for dep in spec.deps:
+            if not self._maybe_reconstruct(dep) and not self._is_ready(dep):
+                # Unrecoverable dep: seal errors on the return ids only
+                # (no dep-pin release — nothing was pinned this round).
+                msg = (
+                    f"ObjectLostError: cannot reconstruct {oid}: dependency "
+                    f"{dep} is lost with no lineage"
+                )
+                t_rec = self.tasks.get(spec.task_id)
+                if t_rec is not None:
+                    t_rec["state"] = FAILED
+                    t_rec["error"] = msg
+                for rid in spec.return_ids:
+                    self._seal_error(rid, msg, kind="object_lost")
+                return True  # error is sealed; getters unblock with it
+        for dep in spec.deps:
+            e = self.objects.get(dep)
+            if e is not None:
+                e.task_pins += 1
+        t = self.tasks.get(spec.task_id)
+        if t is not None:
+            t["state"] = PENDING
+            t["reconstructions"] = used + 1
+        self.task_queue.append(spec)
+        self.dispatch_event.set()
+        return True
 
     def _h_cancel_task(self, body, conn):
         # Accepts a task id or one of the task's return object ids (the
@@ -985,6 +1070,12 @@ class Head:
     def _h_report_metrics(self, body, conn):
         with self.lock:
             self.metrics.update(body["metrics"])
+            # Bounded like task_events: evict oldest series beyond the cap
+            # (each short-lived metric instance contributes a series key).
+            overflow = len(self.metrics) - self.config.task_events_max_buffer
+            if overflow > 0:
+                for key in list(self.metrics)[:overflow]:
+                    del self.metrics[key]
         return None
 
     def _h_get_metrics(self, body, conn):

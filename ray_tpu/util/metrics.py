@@ -63,6 +63,14 @@ class _MetricBase:
         with self._lock:
             payload = {}
             for tags, value in self._values.items():
+                if isinstance(value, dict):
+                    # Snapshot mutable state (histograms) under the lock —
+                    # conn.cast serializes after release and must not race
+                    # concurrent observe() mutations.
+                    value = {
+                        k: (list(v) if isinstance(v, list) else v)
+                        for k, v in value.items()
+                    }
                 key = f"{self.name}|{rt.client_id}|{self._instance_id}|{dict(tags)}"
                 payload[key] = {
                     "name": self.name,

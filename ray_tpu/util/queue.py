@@ -57,6 +57,12 @@ class _QueueActor:
         out = [self.items.popleft() for _ in range(min(n, len(self.items)))]
         return out, True
 
+    def get_exact(self, n: int) -> tuple[list, bool]:
+        """All-or-nothing batch pop (reference get_nowait_batch semantics)."""
+        if len(self.items) < n:
+            return [], False
+        return [self.items.popleft() for _ in range(n)], True
+
 
 class Queue:
     def __init__(self, maxsize: int = 0, *, actor_options: dict | None = None):
@@ -101,7 +107,9 @@ class Queue:
         return self.get(block=False)
 
     def get_nowait_batch(self, n: int) -> list:
-        items, _ = ray_tpu.get(self._actor.get.remote(n))
+        items, ok = ray_tpu.get(self._actor.get_exact.remote(n))
+        if not ok:
+            raise Empty(f"queue holds fewer than {n} items")
         return items
 
     def put_nowait_batch(self, items: list) -> None:
