@@ -661,6 +661,7 @@ class Head:
                 "submitted_at": time.time(),
                 "node_id": None,
                 "worker_id": None,
+                "resources": dict(spec.resources or {}),
             }
             if spec.actor_id is not None:
                 self._enqueue_actor_task(spec)
@@ -1016,6 +1017,7 @@ class Head:
                         "pid": self.workers[a.worker_id].pid if a.worker_id in self.workers else None,
                         "restarts": a.restarts,
                         "class_name": a.spec.name or a.spec.cls_func_id,
+                        "resources": dict(a.spec.resources or {}),
                     }
                     for a in self.actors.values()
                 ]

@@ -55,6 +55,7 @@ class CoreRuntime:
         self._waiters_lock = threading.Lock()
         self._message_handler = message_handler
         self._closed = False
+        self.address = address  # head (host, port) — job drivers reconnect here
         self.conn = rpc.connect(address, handler=self._handle, name=client_type)
         reg = self.conn.call(
             "register",

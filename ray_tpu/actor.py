@@ -31,6 +31,12 @@ class ActorMethod:
     def remote(self, *args, **kwargs):
         return self._handle._submit_method(self._name, args, kwargs, self._num_returns)
 
+    def bind(self, *args, **kwargs):
+        """Capture this call as a DAG node (reference: dag/class_node.py)."""
+        from ray_tpu.dag.nodes import ClassMethodNode
+
+        return ClassMethodNode(self, args, kwargs)
+
     def __call__(self, *args, **kwargs):
         raise TypeError(
             f"actor method {self._name} cannot be called directly; use .remote()"

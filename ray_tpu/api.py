@@ -9,6 +9,7 @@ ray.get_actor).
 from __future__ import annotations
 
 import atexit
+import os
 import threading
 from typing import Any, Sequence
 
@@ -50,6 +51,17 @@ def init(
         cfg = Config().apply_overrides(_system_config)
         if object_store_memory:
             cfg.object_store_memory = int(object_store_memory)
+        if address is None:
+            # Job drivers inherit their cluster (reference: RAY_ADDRESS).
+            address = os.environ.get("RAY_TPU_ADDRESS") or None
+        if address == "auto":
+            env_addr = os.environ.get("RAY_TPU_ADDRESS")
+            if not env_addr or env_addr == "auto":
+                raise ConnectionError(
+                    "address='auto' requires RAY_TPU_ADDRESS to hold a "
+                    "host:port cluster address"
+                )
+            address = env_addr
         if address is None:
             from ray_tpu._private.gcs import Head
 

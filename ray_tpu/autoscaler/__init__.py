@@ -1,0 +1,27 @@
+"""ray_tpu.autoscaler: demand-driven node scaling.
+
+Counterpart of the reference's autoscaler (SURVEY.md §2.2 —
+StandardAutoscaler autoscaler/_private/autoscaler.py:172,
+ResourceDemandScheduler resource_demand_scheduler.py:102 bin-packing,
+NodeProvider plugins, FakeMultiNodeProvider for tests). The v1 control
+loop: read pending resource demand from the head, bin-pack onto available
+node types, ask the provider to launch/terminate. Cloud providers are
+round-2+; the provider ABC + fake provider make the loop testable exactly
+the way the reference tests its autoscaler (§4 "lesson")."""
+
+from ray_tpu.autoscaler.autoscaler import (
+    AutoscalerConfig,
+    NodeType,
+    ResourceDemandScheduler,
+    StandardAutoscaler,
+)
+from ray_tpu.autoscaler.node_provider import FakeNodeProvider, NodeProvider
+
+__all__ = [
+    "AutoscalerConfig",
+    "FakeNodeProvider",
+    "NodeProvider",
+    "NodeType",
+    "ResourceDemandScheduler",
+    "StandardAutoscaler",
+]

@@ -1,0 +1,220 @@
+"""StandardAutoscaler + ResourceDemandScheduler.
+
+Reference: autoscaler/_private/autoscaler.py:172 (update loop: demand in,
+launch/terminate out, idle timeout) and resource_demand_scheduler.py:102
+(first-fit-decreasing bin-packing of pending demands onto node types)."""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import time
+from typing import Dict, Optional
+
+from ray_tpu.autoscaler.node_provider import NodeProvider
+
+
+@dataclasses.dataclass
+class NodeType:
+    """An launchable node shape (reference: available_node_types in the
+    cluster YAML — resources per type, min/max workers)."""
+
+    name: str
+    resources: dict[str, float]
+    min_workers: int = 0
+    max_workers: int = 10
+
+
+@dataclasses.dataclass
+class AutoscalerConfig:
+    node_types: list[NodeType] = dataclasses.field(default_factory=list)
+    idle_timeout_s: float = 60.0
+    max_launch_batch: int = 8
+    upscaling_speed: float = 1.0  # extra headroom multiplier on launches
+
+
+class ResourceDemandScheduler:
+    """Bin-pack pending demands onto existing capacity + new nodes
+    (reference: resource_demand_scheduler.py:102 get_nodes_to_launch)."""
+
+    def __init__(self, node_types: list[NodeType]):
+        self.node_types = {t.name: t for t in node_types}
+
+    @staticmethod
+    def _fits(capacity: dict, demand: dict) -> bool:
+        return all(capacity.get(k, 0.0) >= v for k, v in demand.items())
+
+    @staticmethod
+    def _consume(capacity: dict, demand: dict) -> None:
+        for k, v in demand.items():
+            capacity[k] = capacity.get(k, 0.0) - v
+
+    def get_nodes_to_launch(
+        self,
+        pending_demands: list[dict],
+        available_capacities: list[dict],
+        current_counts: Dict[str, int],
+    ) -> Dict[str, int]:
+        """First-fit-decreasing: place each demand on existing/planned
+        capacity, else plan the smallest node type that fits it."""
+        capacities = [dict(c) for c in available_capacities]
+        to_launch: Dict[str, int] = {}
+        demands = sorted(
+            pending_demands, key=lambda d: -sum(d.values())
+        )
+        for demand in demands:
+            if not demand:
+                continue
+            placed = False
+            for cap in capacities:
+                if self._fits(cap, demand):
+                    self._consume(cap, demand)
+                    placed = True
+                    break
+            if placed:
+                continue
+            # Smallest type that fits, respecting max_workers.
+            candidates = sorted(
+                (t for t in self.node_types.values() if self._fits(dict(t.resources), demand)),
+                key=lambda t: sum(t.resources.values()),
+            )
+            for t in candidates:
+                planned = current_counts.get(t.name, 0) + to_launch.get(t.name, 0)
+                if planned >= t.max_workers:
+                    continue
+                to_launch[t.name] = to_launch.get(t.name, 0) + 1
+                cap = dict(t.resources)
+                self._consume(cap, demand)
+                capacities.append(cap)
+                placed = True
+                break
+            # Unplaceable by any type: skip (the reference also reports
+            # infeasible demands rather than looping).
+        return to_launch
+
+
+class StandardAutoscaler:
+    """The v1 update loop (reference: autoscaler.py:172 update()).
+
+    Demand sources: head task table (PENDING rows with resources) and
+    PENDING_CREATION actors — the same signal the reference's monitor
+    pulls from the GCS resource-demand broadcast."""
+
+    def __init__(self, provider: NodeProvider, config: AutoscalerConfig,
+                 demand_source=None):
+        self.provider = provider
+        self.config = config
+        self.scheduler = ResourceDemandScheduler(config.node_types)
+        self._demand_source = demand_source or self._head_demand
+        self._idle_since: dict[str, float] = {}
+
+    # -- demand ------------------------------------------------------------
+
+    @staticmethod
+    def _head_demand() -> list[dict]:
+        from ray_tpu.util import state as us
+
+        # Unplaced work = queued tasks (head state PENDING_ARGS_AVAIL) +
+        # actors awaiting creation (their creation task row only appears at
+        # dispatch, so the actor table is the demand signal).
+        demands = [
+            t.get("resources", {})
+            for t in us.list_tasks(
+                filters=[("state", "=", "PENDING_ARGS_AVAIL")], limit=10000
+            )
+        ]
+        demands += [
+            a.get("resources", {})
+            for a in us.list_actors(
+                filters=[("state", "=", "PENDING_CREATION")], limit=10000
+            )
+        ]
+        return [d for d in demands if d]
+
+    @staticmethod
+    def _cluster_has_busy_workers() -> bool:
+        """Provider node ids and head node ids are different namespaces
+        (no mapping until multi-node attach lands), so the no-callback
+        idle check is conservative: ANY busy worker anywhere blocks idle
+        termination cluster-wide."""
+        try:
+            from ray_tpu.util import state as us
+
+            # Only workers EXECUTING something block termination; idle
+            # resident actors (job manager, dashboard) don't — their
+            # placement is head-side, not on provider nodes.
+            return any(w.get("busy") for w in us.list_workers())
+        except Exception:
+            return True  # can't tell → never terminate on a guess
+
+    # -- update loop -------------------------------------------------------
+
+    def update(self, node_is_idle=None) -> dict:
+        """One reconcile pass; returns {launched: {...}, terminated: [...]}."""
+        cfg = self.config
+        nodes = self.provider.non_terminated_nodes()
+        counts: Dict[str, int] = {}
+        for nid in nodes:
+            t = self.provider.node_type_of(nid)
+            counts[t] = counts.get(t, 0) + 1
+
+        launched: Dict[str, int] = {}
+        # 1. min_workers floors.
+        for t in cfg.node_types:
+            deficit = t.min_workers - counts.get(t.name, 0)
+            if deficit > 0:
+                self.provider.create_node(t.name, deficit)
+                launched[t.name] = launched.get(t.name, 0) + deficit
+                counts[t.name] = t.min_workers
+        # 2. demand-driven launches. Booting nodes (launched on earlier
+        #    ticks OR the floor launches above, not running yet) count as
+        #    available capacity so pending demand doesn't launch a new
+        #    node every tick.
+        nodes = self.provider.non_terminated_nodes()  # includes step-1 floors
+        booting_capacity = [
+            dict(self.scheduler.node_types[self.provider.node_type_of(nid)].resources)
+            for nid in nodes
+            if not self.provider.is_running(nid)
+            and self.provider.node_type_of(nid) in self.scheduler.node_types
+        ]
+        demands = self._demand_source()
+        plan = self.scheduler.get_nodes_to_launch(demands, booting_capacity, counts)
+        # upscaling_speed bounds launches per tick relative to cluster size
+        # (reference: autoscaler.py upscaling_speed semantics).
+        # Reference formula: at least 5 per tick, scaled by cluster size.
+        budget = min(
+            cfg.max_launch_batch,
+            max(5, math.ceil(cfg.upscaling_speed * max(1, len(nodes)))),
+        )
+        for name, n in plan.items():
+            n = min(n, budget)
+            if n <= 0:
+                continue
+            budget -= n
+            self.provider.create_node(name, n)
+            launched[name] = launched.get(name, 0) + n
+            counts[name] = counts.get(name, 0) + n
+        # 3. idle termination (respecting min_workers). Without an explicit
+        # idle callback: idle only when no pending demand AND no busy
+        # worker anywhere — running work is never torn down on a guess.
+        any_busy = self._cluster_has_busy_workers() if node_is_idle is None else False
+        terminated: list[str] = []
+        now = time.monotonic()
+        for nid in self.provider.non_terminated_nodes():
+            if node_is_idle is not None:
+                idle = node_is_idle(nid)
+            else:
+                idle = not demands and not any_busy
+            if not idle:
+                self._idle_since.pop(nid, None)
+                continue
+            since = self._idle_since.setdefault(nid, now)
+            tname = self.provider.node_type_of(nid)
+            t = self.scheduler.node_types.get(tname)
+            floor = t.min_workers if t else 0
+            if now - since >= cfg.idle_timeout_s and counts.get(tname, 0) > floor:
+                self.provider.terminate_node(nid)
+                counts[tname] -= 1
+                terminated.append(nid)
+                self._idle_since.pop(nid, None)
+        return {"launched": launched, "terminated": terminated}

@@ -1,0 +1,66 @@
+"""NodeProvider ABC + fake provider.
+
+Reference: autoscaler/node_provider.py (cloud plugins under
+autoscaler/aws|gcp|azure/...) and the test-bearing FakeMultiNodeProvider
+(autoscaler/_private/fake_multi_node/node_provider.py:236)."""
+
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Dict, Optional
+
+
+class NodeProvider:
+    """Minimal provider surface (reference: autoscaler/node_provider.py)."""
+
+    def create_node(self, node_type: str, count: int = 1) -> list[str]:
+        raise NotImplementedError
+
+    def terminate_node(self, node_id: str) -> None:
+        raise NotImplementedError
+
+    def non_terminated_nodes(self) -> list[str]:
+        raise NotImplementedError
+
+    def node_type_of(self, node_id: str) -> str:
+        raise NotImplementedError
+
+    def is_running(self, node_id: str) -> bool:
+        raise NotImplementedError
+
+
+class FakeNodeProvider(NodeProvider):
+    """In-memory nodes with a configurable launch delay (reference:
+    fake_multi_node/node_provider.py — fakes cloud nodes so the REAL
+    autoscaler loop is exercised)."""
+
+    def __init__(self, launch_delay_s: float = 0.0):
+        self.launch_delay_s = launch_delay_s
+        self._nodes: Dict[str, dict] = {}
+
+    def create_node(self, node_type: str, count: int = 1) -> list[str]:
+        out = []
+        for _ in range(count):
+            nid = f"fake-{node_type}-{uuid.uuid4().hex[:6]}"
+            self._nodes[nid] = {
+                "type": node_type,
+                "launched_at": time.monotonic(),
+            }
+            out.append(nid)
+        return out
+
+    def terminate_node(self, node_id: str) -> None:
+        self._nodes.pop(node_id, None)
+
+    def non_terminated_nodes(self) -> list[str]:
+        return list(self._nodes)
+
+    def node_type_of(self, node_id: str) -> str:
+        return self._nodes[node_id]["type"]
+
+    def is_running(self, node_id: str) -> bool:
+        n = self._nodes.get(node_id)
+        if n is None:
+            return False
+        return time.monotonic() - n["launched_at"] >= self.launch_delay_s

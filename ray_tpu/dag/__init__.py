@@ -1,0 +1,34 @@
+"""ray_tpu.dag: static dataflow graphs over actors (compiled graphs).
+
+Counterpart of the reference's Compiled Graphs / accelerated DAG
+(python/ray/dag — CompiledDAG compiled_dag_node.py:806, InputNode,
+ClassMethodNode via .bind(), with_tensor_transport): a DAG of actor-method
+calls captured once, then executed repeatedly with one submission wave per
+`execute()` — intermediate values flow actor→actor through the object
+store, never through the driver.
+
+TPU-native notes: the reference compiles NCCL p2p channels between GPU
+actors (torch_tensor_nccl_channel.py:44). Here device tensors inside ONE
+process stay on device (jax arrays); cross-actor hops serialize through
+shm — the in-jit path (shard_map pipeline, parallel/pipeline.py) is the
+idiomatic TPU fast lane for chip-to-chip, and `ray_tpu.dag` is the
+host-level orchestration fabric (multi-host MPMD pipelines over DCN).
+"""
+
+from ray_tpu.dag.nodes import (
+    ClassMethodNode,
+    CompiledDAG,
+    DAGNode,
+    FunctionNode,
+    InputNode,
+    MultiOutputNode,
+)
+
+__all__ = [
+    "ClassMethodNode",
+    "CompiledDAG",
+    "DAGNode",
+    "FunctionNode",
+    "InputNode",
+    "MultiOutputNode",
+]

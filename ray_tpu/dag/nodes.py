@@ -1,0 +1,154 @@
+"""DAG nodes + compiled execution.
+
+Reference: python/ray/dag/dag_node.py (DAGNode), class_node.py
+(ClassMethodNode via ActorMethod.bind), input_node.py (InputNode),
+output_node.py (MultiOutputNode), compiled_dag_node.py:806 (CompiledDAG).
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Any, Callable, Optional
+
+import ray_tpu
+
+
+class DAGNode:
+    """A node in a static dataflow graph. Args may reference upstream
+    DAGNodes (top-level positions)."""
+
+    def __init__(self, args: tuple = (), kwargs: dict | None = None):
+        self._bound_args = args
+        self._bound_kwargs = kwargs or {}
+        self._uuid = uuid.uuid4().hex[:8]
+
+    def _upstream(self) -> list["DAGNode"]:
+        ups = [a for a in self._bound_args if isinstance(a, DAGNode)]
+        ups += [v for v in self._bound_kwargs.values() if isinstance(v, DAGNode)]
+        return ups
+
+    # -- eager one-shot execution (reference: DAGNode.execute) -------------
+
+    def execute(self, *input_values) -> Any:
+        """Run the whole upstream graph once; returns ObjectRef(s)."""
+        memo: dict[str, Any] = {}
+        return self._execute_into(memo, input_values)
+
+    def _execute_into(self, memo: dict, input_values: tuple):
+        if self._uuid in memo:
+            return memo[self._uuid]
+        resolved_args = [
+            a._execute_into(memo, input_values) if isinstance(a, DAGNode) else a
+            for a in self._bound_args
+        ]
+        resolved_kwargs = {
+            k: (v._execute_into(memo, input_values) if isinstance(v, DAGNode) else v)
+            for k, v in self._bound_kwargs.items()
+        }
+        out = self._submit(resolved_args, resolved_kwargs, input_values)
+        memo[self._uuid] = out
+        return out
+
+    def _submit(self, args: list, kwargs: dict, input_values: tuple):
+        raise NotImplementedError
+
+    def experimental_compile(self) -> "CompiledDAG":
+        """Freeze the graph for repeated execution (reference:
+        dag.experimental_compile(), compiled_dag_node.py:806)."""
+        return CompiledDAG(self)
+
+    def __reduce__(self):  # DAG nodes are driver-side only
+        raise TypeError("DAGNode is not serializable; pass ObjectRefs instead")
+
+
+class InputNode(DAGNode):
+    """Placeholder for the per-execution input (reference:
+    dag/input_node.py). Usable as a context manager:
+
+        with InputNode() as inp:
+            out = actor.fn.bind(inp)
+    """
+
+    def __init__(self):
+        super().__init__()
+
+    def __enter__(self) -> "InputNode":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        return None
+
+    def _submit(self, args, kwargs, input_values):
+        if len(input_values) == 1:
+            return input_values[0]
+        return input_values
+
+
+class ClassMethodNode(DAGNode):
+    """actor.method.bind(...) (reference: dag/class_node.py)."""
+
+    def __init__(self, actor_method, args: tuple, kwargs: dict):
+        super().__init__(args, kwargs)
+        self._method = actor_method
+
+    def _submit(self, args, kwargs, input_values):
+        return self._method.remote(*args, **kwargs)
+
+
+class FunctionNode(DAGNode):
+    """fn.bind(...) on a @remote function (reference: dag/function_node.py)."""
+
+    def __init__(self, remote_fn, args: tuple, kwargs: dict):
+        super().__init__(args, kwargs)
+        self._remote_fn = remote_fn
+
+    def _submit(self, args, kwargs, input_values):
+        return self._remote_fn.remote(*args, **kwargs)
+
+
+class MultiOutputNode(DAGNode):
+    """Bundle several leaves into one execute() result (reference:
+    dag/output_node.py)."""
+
+    def __init__(self, outputs: list[DAGNode]):
+        super().__init__(tuple(outputs), {})
+
+    def _submit(self, args, kwargs, input_values):
+        return list(args)
+
+
+class CompiledDAG:
+    """Pre-planned repeated execution of a DAG.
+
+    The reference pins actor loops and reuses mutable channels
+    (compiled_dag_node.py:806); here compilation precomputes the
+    topological submission order once, so each execute() is exactly one
+    wave of actor-call submissions chained by ObjectRefs — intermediate
+    results never touch the driver."""
+
+    def __init__(self, root: DAGNode):
+        self._root = root
+        self._order: list[DAGNode] = []
+        seen: set[str] = set()
+
+        def topo(node: DAGNode):
+            if node._uuid in seen:
+                return
+            for up in node._upstream():
+                topo(up)
+            seen.add(node._uuid)
+            self._order.append(node)
+
+        topo(root)
+        self._destroyed = False
+
+    def execute(self, *input_values) -> Any:
+        if self._destroyed:
+            raise RuntimeError("CompiledDAG was torn down")
+        memo: dict[str, Any] = {}
+        for node in self._order:
+            node._execute_into(memo, input_values)
+        return memo[self._root._uuid]
+
+    def teardown(self) -> None:
+        self._destroyed = True

@@ -1,0 +1,146 @@
+"""Dashboard: HTTP cluster-state endpoint.
+
+Counterpart of the reference's dashboard head (SURVEY.md §2.2 —
+dashboard/head.py + modules for actors/nodes/jobs/metrics; the React
+frontend is out of scope). JSON API over aiohttp in a dedicated actor:
+
+    GET /            tiny HTML summary
+    GET /api/cluster resources + nodes + object store stats
+    GET /api/actors  /api/tasks  /api/objects  /api/workers  /api/jobs
+    GET /api/task_summary
+    GET /metrics     Prometheus exposition text
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import socket
+import threading
+
+import ray_tpu
+
+
+class DashboardServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._host = host
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._port = self._sock.getsockname()[1]
+        self._ready = threading.Event()
+        self._thread = threading.Thread(target=self._serve, daemon=True, name="dashboard")
+        self._thread.start()
+        self._ready.wait(timeout=10)
+
+    def get_port(self) -> int:
+        return self._port
+
+    def ping(self) -> str:
+        return "pong"
+
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _payload(path: str):
+        from ray_tpu.util import metrics as um
+        from ray_tpu.util import state as us
+
+        if path == "/api/cluster":
+            return {
+                "resources_total": ray_tpu.cluster_resources(),
+                "resources_available": ray_tpu.available_resources(),
+                "nodes": us.list_nodes(),
+                "object_store": us.object_store_stats(),
+            }
+        if path == "/api/actors":
+            return {"actors": us.list_actors()}
+        if path == "/api/tasks":
+            return {"tasks": us.list_tasks()}
+        if path == "/api/task_summary":
+            return us.summarize_tasks()
+        if path == "/api/objects":
+            return {"objects": us.list_objects()}
+        if path == "/api/workers":
+            return {"workers": us.list_workers()}
+        if path == "/api/jobs":
+            from ray_tpu.job_submission import list_jobs
+
+            return {"jobs": list_jobs()}
+        if path == "/metrics":
+            return um.prometheus_text()
+        if path == "/":
+            from ray_tpu.util import state as us2
+
+            summary = us2.summarize_tasks()
+            rows = "".join(
+                f"<tr><td>{name}</td><td>{info['total']}</td>"
+                f"<td>{json.dumps(info['state_counts'])}</td></tr>"
+                for name, info in summary.items()
+            )
+            return (
+                "<html><head><title>ray_tpu dashboard</title></head><body>"
+                "<h2>ray_tpu cluster</h2>"
+                f"<pre>{json.dumps(ray_tpu.cluster_resources(), indent=1)}</pre>"
+                "<h3>Tasks</h3><table border=1><tr><th>name</th><th>total</th>"
+                f"<th>states</th></tr>{rows}</table>"
+                "<p>API: /api/cluster /api/actors /api/tasks /api/objects "
+                "/api/workers /api/jobs /metrics</p></body></html>"
+            )
+        return None
+
+    def _serve(self) -> None:
+        from aiohttp import web
+
+        async def handle(request: "web.Request") -> "web.Response":
+            loop = asyncio.get_running_loop()
+            try:
+                payload = await loop.run_in_executor(None, self._payload, request.path)
+            except Exception as e:  # noqa: BLE001
+                return web.json_response({"error": str(e)}, status=500)
+            if payload is None:
+                return web.json_response({"error": "not found"}, status=404)
+            if isinstance(payload, str):
+                ctype = "text/html" if payload.startswith("<") else "text/plain"
+                return web.Response(text=payload, content_type=ctype)
+            return web.Response(text=json.dumps(payload, default=str),
+                                content_type="application/json")
+
+        async def run():
+            app = web.Application()
+            app.router.add_get("/{tail:.*}", handle)
+            runner = web.AppRunner(app)
+            await runner.setup()
+            site = web.SockSite(runner, self._sock)
+            await site.start()
+            self._ready.set()
+            while True:
+                await asyncio.sleep(3600)
+
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        loop.run_until_complete(run())
+
+
+_dashboard = None
+
+
+def start_dashboard(host: str = "127.0.0.1", port: int = 0) -> int:
+    """Launch the dashboard actor; returns the bound port."""
+    global _dashboard
+    ray_tpu.api.auto_init()
+    if _dashboard is None:
+        cls = ray_tpu.remote(num_cpus=0, max_concurrency=8, name="DASHBOARD",
+                             namespace="_dashboard")(DashboardServer)
+        _dashboard = cls.remote(host, port)
+    return ray_tpu.get(_dashboard.get_port.remote())
+
+
+def stop_dashboard() -> None:
+    global _dashboard
+    if _dashboard is not None:
+        try:
+            ray_tpu.kill(_dashboard)
+        except Exception:
+            pass
+        _dashboard = None

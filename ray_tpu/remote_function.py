@@ -51,6 +51,12 @@ class RemoteFunction:
             f"use {self.__name__}.remote()"
         )
 
+    def bind(self, *args, **kwargs):
+        """Capture this call as a DAG node (reference: dag/function_node.py)."""
+        from ray_tpu.dag.nodes import FunctionNode
+
+        return FunctionNode(self, args, kwargs)
+
     def remote(self, *args, **kwargs):
         from ray_tpu import api
 

@@ -1,0 +1,290 @@
+"""DAG graphs, job submission, dashboard, autoscaler decisions.
+
+Reference test models: dag tests (python/ray/dag/tests), job manager tests
+(dashboard/modules/job/tests), autoscaler resource-demand tests
+(tests/test_resource_demand_scheduler.py)."""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler import (
+    AutoscalerConfig,
+    FakeNodeProvider,
+    NodeType,
+    ResourceDemandScheduler,
+    StandardAutoscaler,
+)
+from ray_tpu.dag import InputNode, MultiOutputNode
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _cluster():
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=8, object_store_memory=64 * 1024 * 1024)
+    yield
+    ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# DAG
+
+
+def test_function_dag_execute():
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    @ray_tpu.remote
+    def mul(a, b):
+        return a * b
+
+    with InputNode() as inp:
+        s = add.bind(inp, 10)
+        out = mul.bind(s, 2)
+    ref = out.execute(5)
+    assert ray_tpu.get(ref) == 30
+
+
+def test_actor_dag_compiled_repeated_execution():
+    @ray_tpu.remote
+    class Stage:
+        def __init__(self, k):
+            self.k = k
+            self.calls = 0
+
+        def apply(self, x):
+            self.calls += 1
+            return x + self.k
+
+        def count(self):
+            return self.calls
+
+    a = Stage.remote(1)
+    b = Stage.remote(100)
+    with InputNode() as inp:
+        dag = b.apply.bind(a.apply.bind(inp))
+    compiled = dag.experimental_compile()
+    for i in range(5):
+        assert ray_tpu.get(compiled.execute(i)) == i + 101
+    # Both stages really ran per execute (pinned actors, stateful).
+    assert ray_tpu.get(a.count.remote()) == 5
+    compiled.teardown()
+    with pytest.raises(RuntimeError):
+        compiled.execute(0)
+
+
+def test_dag_diamond_runs_shared_node_once():
+    @ray_tpu.remote
+    class Tracker:
+        def __init__(self):
+            self.n = 0
+
+        def produce(self, x):
+            self.n += 1
+            return x
+
+        def count(self):
+            return self.n
+
+    t = Tracker.remote()
+
+    @ray_tpu.remote
+    def combine(a, b):
+        return (a, b)
+
+    with InputNode() as inp:
+        shared = t.produce.bind(inp)
+        out = combine.bind(shared, shared)
+    assert ray_tpu.get(out.execute(7)) == (7, 7)
+    assert ray_tpu.get(t.count.remote()) == 1  # memoized: one call per execute
+
+
+def test_multi_output_node():
+    @ray_tpu.remote
+    def inc(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def dec(x):
+        return x - 1
+
+    with InputNode() as inp:
+        dag = MultiOutputNode([inc.bind(inp), dec.bind(inp)])
+    refs = dag.execute(10)
+    assert ray_tpu.get(refs) == [11, 9]
+
+
+# ---------------------------------------------------------------------------
+# jobs
+
+
+def test_job_submission_lifecycle():
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    client = JobSubmissionClient()
+    job_id = client.submit_job(
+        entrypoint="python -c \"print('job ran ok')\"",
+        runtime_env={"env_vars": {"MY_FLAG": "42"}},
+    )
+    status = client.wait_until_finished(job_id, timeout_s=60)
+    assert status == "SUCCEEDED"
+    assert "job ran ok" in client.get_job_logs(job_id)
+    assert any(j["job_id"] == job_id for j in client.list_jobs())
+
+
+def test_job_driver_joins_cluster():
+    """The job's driver connects to THIS cluster (RAY_TPU_ADDRESS), so it
+    sees named actors created before submission."""
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    @ray_tpu.remote(name="job_target", num_cpus=0)
+    class Target:
+        def hello(self):
+            return "from-cluster"
+
+    t = Target.remote()
+    ray_tpu.get(t.hello.remote())
+
+    script = (
+        "import ray_tpu; ray_tpu.init();"
+        "a = ray_tpu.get_actor('job_target');"
+        "print('GOT:', ray_tpu.get(a.hello.remote()))"
+    )
+    client = JobSubmissionClient()
+    job_id = client.submit_job(entrypoint=f'python -c "{script}"')
+    status = client.wait_until_finished(job_id, timeout_s=90)
+    logs = client.get_job_logs(job_id)
+    assert status == "SUCCEEDED", logs
+    assert "GOT: from-cluster" in logs
+
+
+def test_job_failure_reported():
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    client = JobSubmissionClient()
+    job_id = client.submit_job(entrypoint="python -c 'raise SystemExit(3)'")
+    assert client.wait_until_finished(job_id, timeout_s=60) == "FAILED"
+    assert "exited with code 3" in client.get_job_info(job_id)["message"]
+
+
+def test_job_stop_from_another_client():
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    a = JobSubmissionClient()
+    job_id = a.submit_job(entrypoint="python -c 'import time; time.sleep(60)'")
+    deadline = time.monotonic() + 30
+    while a.get_job_status(job_id) == "PENDING" and time.monotonic() < deadline:
+        time.sleep(0.2)
+    # A DIFFERENT client can stop it: supervisors live in the shared
+    # JobManager actor, not in the submitting client.
+    b = JobSubmissionClient()
+    assert b.stop_job(job_id) is True
+    assert b.wait_until_finished(job_id, timeout_s=30) == "STOPPED"
+
+
+def test_job_supervisor_death_marks_failed():
+    from ray_tpu.job_submission import JobSubmissionClient
+    from ray_tpu.util import state as us
+
+    client = JobSubmissionClient()
+    before = {a["actor_id"] for a in us.list_actors()}
+    job_id = client.submit_job(entrypoint="python -c 'import time; time.sleep(120)'")
+    deadline = time.monotonic() + 30
+    while client.get_job_status(job_id) == "PENDING" and time.monotonic() < deadline:
+        time.sleep(0.2)
+    # Kill the supervisor actor (the only new actor since submission).
+    new = [a for a in us.list_actors()
+           if a["actor_id"] not in before and a["state"] == "ALIVE"]
+    assert len(new) == 1
+    import os as _os
+    import signal as _signal
+
+    _os.kill(new[0]["pid"], _signal.SIGKILL)
+    # The JobManager monitor notices the dead run() future.
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if client.get_job_status(job_id) == "FAILED":
+            break
+        time.sleep(0.3)
+    info = client.get_job_info(job_id)
+    assert info["status"] == "FAILED", info
+    assert "supervisor died" in info["message"]
+
+
+# ---------------------------------------------------------------------------
+# dashboard
+
+
+def test_dashboard_endpoints():
+    import requests
+
+    from ray_tpu.dashboard import start_dashboard, stop_dashboard
+
+    @ray_tpu.remote
+    def traced_job():
+        return 1
+
+    ray_tpu.get(traced_job.remote())
+    port = start_dashboard()
+    base = f"http://127.0.0.1:{port}"
+    try:
+        cluster = requests.get(base + "/api/cluster", timeout=10).json()
+        assert cluster["resources_total"]["CPU"] == 8.0
+        tasks = requests.get(base + "/api/tasks", timeout=10).json()["tasks"]
+        assert any(t["name"] == "traced_job" for t in tasks)
+        assert requests.get(base + "/", timeout=10).status_code == 200
+        assert requests.get(base + "/metrics", timeout=10).status_code == 200
+        assert requests.get(base + "/api/nope", timeout=10).status_code == 404
+    finally:
+        stop_dashboard()
+
+
+# ---------------------------------------------------------------------------
+# autoscaler
+
+
+def test_demand_scheduler_bin_packing():
+    sched = ResourceDemandScheduler(
+        [
+            NodeType("small", {"CPU": 4}),
+            NodeType("big", {"CPU": 16, "TPU": 4}),
+        ]
+    )
+    # 6 x 1-CPU demands: 4 fit one small node, 2 spill to a second.
+    plan = sched.get_nodes_to_launch([{"CPU": 1}] * 6, [], {})
+    assert plan == {"small": 2}
+    # TPU demand needs the big type even though small is cheaper.
+    plan = sched.get_nodes_to_launch([{"TPU": 2}], [], {})
+    assert plan == {"big": 1}
+    # Existing capacity absorbs demand: nothing to launch.
+    plan = sched.get_nodes_to_launch([{"CPU": 2}], [{"CPU": 8}], {})
+    assert plan == {}
+    # max_workers cap respected.
+    capped = ResourceDemandScheduler([NodeType("small", {"CPU": 1}, max_workers=1)])
+    plan = capped.get_nodes_to_launch([{"CPU": 1}] * 3, [], {})
+    assert plan == {"small": 1}
+
+
+def test_standard_autoscaler_loop_scales_up_and_down():
+    provider = FakeNodeProvider()
+    demands = [[{"CPU": 4}] * 3]  # mutable cell
+
+    cfg = AutoscalerConfig(
+        node_types=[NodeType("worker", {"CPU": 4}, min_workers=1, max_workers=5)],
+        idle_timeout_s=0.0,
+    )
+    scaler = StandardAutoscaler(provider, cfg, demand_source=lambda: demands[0])
+    r = scaler.update()
+    # min_workers=1 + 3 pending 4-CPU demands → 1 floor node + 3 launched
+    assert sum(r["launched"].values()) == 4
+    assert len(provider.non_terminated_nodes()) == 4
+    # Demand drains → idle nodes terminate down to min_workers.
+    demands[0] = []
+    r = scaler.update()
+    assert len(provider.non_terminated_nodes()) == 1
+    assert len(r["terminated"]) == 3
