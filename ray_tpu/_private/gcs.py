@@ -1000,8 +1000,14 @@ class Head:
             }
 
     def _h_list_tasks(self, body, conn):
+        state = body.get("state")
         with self.lock:
-            recs = list(self.tasks.values())
+            if state is not None:
+                # Server-side state filter: hot pollers (autoscaler) must
+                # not ship the whole task table per tick.
+                recs = [t for t in self.tasks.values() if t["state"] == state]
+            else:
+                recs = list(self.tasks.values())
         limit = body.get("limit", 1000)
         return {"tasks": recs[-limit:]}
 

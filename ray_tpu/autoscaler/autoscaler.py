@@ -31,6 +31,10 @@ class AutoscalerConfig:
     idle_timeout_s: float = 60.0
     max_launch_batch: int = 8
     upscaling_speed: float = 1.0  # extra headroom multiplier on launches
+    # Nodes launched within this window count as capacity even after the
+    # provider reports them running (workers take time to register with
+    # the head) — prevents relaunch thrash on persistent pending demand.
+    launch_grace_s: float = 120.0
 
 
 class ResourceDemandScheduler:
@@ -107,6 +111,7 @@ class StandardAutoscaler:
         self.scheduler = ResourceDemandScheduler(config.node_types)
         self._demand_source = demand_source or self._head_demand
         self._idle_since: dict[str, float] = {}
+        self._launched_at: dict[str, float] = {}  # node_id -> launch time
 
     # -- demand ------------------------------------------------------------
 
@@ -159,23 +164,32 @@ class StandardAutoscaler:
             counts[t] = counts.get(t, 0) + 1
 
         launched: Dict[str, int] = {}
+
+        def create(name: str, n: int) -> None:
+            for nid in self.provider.create_node(name, n):
+                self._launched_at[nid] = time.monotonic()
+            launched[name] = launched.get(name, 0) + n
+
         # 1. min_workers floors.
         for t in cfg.node_types:
             deficit = t.min_workers - counts.get(t.name, 0)
             if deficit > 0:
-                self.provider.create_node(t.name, deficit)
-                launched[t.name] = launched.get(t.name, 0) + deficit
+                create(t.name, deficit)
                 counts[t.name] = t.min_workers
         # 2. demand-driven launches. Booting nodes (launched on earlier
         #    ticks OR the floor launches above, not running yet) count as
         #    available capacity so pending demand doesn't launch a new
         #    node every tick.
         nodes = self.provider.non_terminated_nodes()  # includes step-1 floors
+        now_ts = time.monotonic()
         booting_capacity = [
             dict(self.scheduler.node_types[self.provider.node_type_of(nid)].resources)
             for nid in nodes
-            if not self.provider.is_running(nid)
-            and self.provider.node_type_of(nid) in self.scheduler.node_types
+            if self.provider.node_type_of(nid) in self.scheduler.node_types
+            and (
+                not self.provider.is_running(nid)
+                or now_ts - self._launched_at.get(nid, 0.0) < cfg.launch_grace_s
+            )
         ]
         demands = self._demand_source()
         plan = self.scheduler.get_nodes_to_launch(demands, booting_capacity, counts)
@@ -191,8 +205,7 @@ class StandardAutoscaler:
             if n <= 0:
                 continue
             budget -= n
-            self.provider.create_node(name, n)
-            launched[name] = launched.get(name, 0) + n
+            create(name, n)
             counts[name] = counts.get(name, 0) + n
         # 3. idle termination (respecting min_workers). Without an explicit
         # idle callback: idle only when no pending demand AND no busy
@@ -217,4 +230,5 @@ class StandardAutoscaler:
                 counts[tname] -= 1
                 terminated.append(nid)
                 self._idle_since.pop(nid, None)
+                self._launched_at.pop(nid, None)
         return {"launched": launched, "terminated": terminated}

@@ -118,37 +118,23 @@ class MultiOutputNode(DAGNode):
 
 
 class CompiledDAG:
-    """Pre-planned repeated execution of a DAG.
+    """A frozen DAG handle for repeated execution.
 
     The reference pins actor loops and reuses mutable channels
-    (compiled_dag_node.py:806); here compilation precomputes the
-    topological submission order once, so each execute() is exactly one
-    wave of actor-call submissions chained by ObjectRefs — intermediate
-    results never touch the driver."""
+    (compiled_dag_node.py:806). Here each execute() is one wave of
+    actor-call submissions chained by ObjectRefs (the memoized recursion
+    of DAGNode._execute_into) — intermediate results never touch the
+    driver; the actors are pinned by construction. Reusable device
+    channels are a later-round optimization."""
 
     def __init__(self, root: DAGNode):
         self._root = root
-        self._order: list[DAGNode] = []
-        seen: set[str] = set()
-
-        def topo(node: DAGNode):
-            if node._uuid in seen:
-                return
-            for up in node._upstream():
-                topo(up)
-            seen.add(node._uuid)
-            self._order.append(node)
-
-        topo(root)
         self._destroyed = False
 
     def execute(self, *input_values) -> Any:
         if self._destroyed:
             raise RuntimeError("CompiledDAG was torn down")
-        memo: dict[str, Any] = {}
-        for node in self._order:
-            node._execute_into(memo, input_values)
-        return memo[self._root._uuid]
+        return self._root._execute_into({}, input_values)
 
     def teardown(self) -> None:
         self._destroyed = True

@@ -126,13 +126,22 @@ _dashboard = None
 
 
 def start_dashboard(host: str = "127.0.0.1", port: int = 0) -> int:
-    """Launch the dashboard actor; returns the bound port."""
+    """Launch (or attach to) the dashboard actor; returns the bound port."""
+    from ray_tpu._private import rpc
+
     global _dashboard
     ray_tpu.api.auto_init()
     if _dashboard is None:
-        cls = ray_tpu.remote(num_cpus=0, max_concurrency=8, name="DASHBOARD",
-                             namespace="_dashboard")(DashboardServer)
-        _dashboard = cls.remote(host, port)
+        try:
+            _dashboard = ray_tpu.get_actor("DASHBOARD", namespace="_dashboard")
+        except ValueError:
+            try:
+                cls = ray_tpu.remote(num_cpus=0, max_concurrency=8, name="DASHBOARD",
+                                     namespace="_dashboard")(DashboardServer)
+                _dashboard = cls.remote(host, port)
+            except rpc.RpcError:
+                # Creation race with another client: attach instead.
+                _dashboard = ray_tpu.get_actor("DASHBOARD", namespace="_dashboard")
     return ray_tpu.get(_dashboard.get_port.remote())
 
 

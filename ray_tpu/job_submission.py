@@ -46,6 +46,8 @@ class JobSupervisor:
 
     def __init__(self, job_id: str, entrypoint: str, env_vars: dict,
                  log_path: str, head_address: str):
+        import threading
+
         self.job_id = job_id
         self.entrypoint = entrypoint
         self.env_vars = env_vars
@@ -53,6 +55,7 @@ class JobSupervisor:
         self.head_address = head_address
         self.proc: subprocess.Popen | None = None
         self._stopped = False
+        self._lock = threading.Lock()
 
     def _put_status(self, status: str, message: str = "") -> None:
         rt = global_runtime()
@@ -67,22 +70,26 @@ class JobSupervisor:
         rt.kv_put(self.job_id, json.dumps(record).encode(), ns=_NS)
 
     def run(self) -> str:
-        if self._stopped:
-            # stop() landed while the job was still PENDING: never launch.
-            self._put_status(STOPPED, "stopped before start")
-            return STOPPED
         env = dict(os.environ)
         env.update({str(k): str(v) for k, v in self.env_vars.items()})
         env["RAY_TPU_HEAD"] = self.head_address
         env["RAY_TPU_JOB_ID"] = self.job_id
         # The job driver connects to THIS cluster, not a new head.
         env["RAY_TPU_ADDRESS"] = self.head_address
-        self._put_status(RUNNING)
         with open(self.log_path, "wb") as logf:
-            self.proc = subprocess.Popen(
-                self.entrypoint, shell=True, stdout=logf, stderr=subprocess.STDOUT,
-                env=env,
-            )
+            # Launch atomically w.r.t. stop(): a stop that wins the lock
+            # first prevents the Popen entirely.
+            with self._lock:
+                if self._stopped:
+                    self._put_status(STOPPED, "stopped before start")
+                    return STOPPED
+                self._put_status(RUNNING)
+                # New session → own process group, so stop()/cleanup kills
+                # compound entrypoints (sh -c a && b), not just the shell.
+                self.proc = subprocess.Popen(
+                    self.entrypoint, shell=True, stdout=logf,
+                    stderr=subprocess.STDOUT, env=env, start_new_session=True,
+                )
             code = self.proc.wait()
         if self._stopped:
             self._put_status(STOPPED, "stopped by user")
@@ -94,13 +101,25 @@ class JobSupervisor:
         return FAILED
 
     def stop(self) -> bool:
-        self._stopped = True
-        if self.proc is not None and self.proc.poll() is None:
-            self.proc.terminate()
+        import signal
+
+        with self._lock:
+            self._stopped = True
+            proc = self.proc
+        if proc is None:
+            return True  # run() will observe _stopped and never launch
+        if proc.poll() is None:
             try:
-                self.proc.wait(timeout=5)
+                os.killpg(proc.pid, signal.SIGTERM)
+            except (ProcessLookupError, PermissionError):
+                proc.terminate()
+            try:
+                proc.wait(timeout=5)
             except subprocess.TimeoutExpired:
-                self.proc.kill()
+                try:
+                    os.killpg(proc.pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    proc.kill()
             return True
         return False
 

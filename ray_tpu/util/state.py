@@ -38,10 +38,18 @@ def _filtered(rows: list[dict], filters) -> list[dict]:
 
 
 def list_tasks(*, filters=None, limit: int = 1000) -> list[dict]:
-    # With filters, fetch the full table window before filtering —
-    # otherwise matches outside the last `limit` rows are silently missed.
-    server_limit = limit if not filters else 1_000_000
-    rows = _call("list_tasks", {"limit": server_limit})["tasks"]
+    # A state equality filter is pushed down to the head (hot path for
+    # autoscaler/dashboard polls); remaining filters apply client-side
+    # over the full table window so matches outside the last `limit`
+    # rows aren't silently missed.
+    filters = list(filters or [])
+    body: dict = {"limit": limit if not filters else 1_000_000}
+    for f in filters:
+        if f[0] == "state" and f[1] == "=":
+            body["state"] = f[2]
+            filters.remove(f)
+            break
+    rows = _call("list_tasks", body)["tasks"]
     return _filtered([dict(r) for r in rows], filters)[:limit]
 
 

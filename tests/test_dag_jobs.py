@@ -280,11 +280,16 @@ def test_standard_autoscaler_loop_scales_up_and_down():
     )
     scaler = StandardAutoscaler(provider, cfg, demand_source=lambda: demands[0])
     r = scaler.update()
-    # min_workers=1 + 3 pending 4-CPU demands → 1 floor node + 3 launched
-    assert sum(r["launched"].values()) == 4
-    assert len(provider.non_terminated_nodes()) == 4
+    # min_workers floor (1 node, absorbs one 4-CPU demand within its
+    # launch grace) + 2 more for the remaining demands.
+    assert sum(r["launched"].values()) == 3
+    assert len(provider.non_terminated_nodes()) == 3
+    # Persistent demand must NOT relaunch: fresh nodes count as capacity.
+    r = scaler.update()
+    assert sum(r["launched"].values()) == 0, r
+    assert len(provider.non_terminated_nodes()) == 3
     # Demand drains → idle nodes terminate down to min_workers.
     demands[0] = []
     r = scaler.update()
     assert len(provider.non_terminated_nodes()) == 1
-    assert len(r["terminated"]) == 3
+    assert len(r["terminated"]) == 2
