@@ -51,6 +51,10 @@ class Config:
     max_lineage_entries: int = 100_000
     max_object_reconstructions: int = 3
 
+    # --- networking ---
+    head_host: str = "127.0.0.1"  # 0.0.0.0 for multi-host clusters
+    head_port: int = 0  # 0 = ephemeral; CLI `start --head` defaults 6380
+
     # --- timeouts ---
     worker_register_timeout_s: float = 30.0
     get_timeout_poll_s: float = 0.01

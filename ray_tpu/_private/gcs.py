@@ -90,7 +90,9 @@ class WorkerRecord:
         self.node_id = node_id
         self.conn: rpc.Connection | None = None
         self.proc = proc
-        self.pid = proc.pid if proc else os.getpid()
+        # Remote (agent-spawned) workers report their real pid at
+        # registration; None until then.
+        self.pid = proc.pid if proc else None
         self.busy = False
         self.actor_id: str | None = None
         # In-flight tasks by task_id: actors with max_concurrency > 1 can
@@ -187,6 +189,12 @@ class Head:
         self.clients: dict[str, rpc.Connection] = {}  # client_id -> conn
         self.task_events: deque[dict] = deque(maxlen=config.task_events_max_buffer)
         self.metrics: dict[str, Any] = {}
+        self.node_agents: dict[str, rpc.Connection] = {}  # node_id -> agent conn
+        from concurrent.futures import ThreadPoolExecutor
+
+        # Meta replies (which may embed payload bytes for remote clients)
+        # are sent from here, never while holding self.lock.
+        self._send_pool = ThreadPoolExecutor(max_workers=2, thread_name_prefix="meta-send")
         # Lineage: return object id -> producing TaskSpec (normal tasks).
         # Reference: owner-side lineage pinning (task_manager.h:223) +
         # ObjectRecoveryManager re-execution (object_recovery_manager.h:43).
@@ -218,7 +226,10 @@ class Head:
         self.max_pool_workers = max(2, int(node_resources.get("CPU", 2)))
 
         self.server = rpc.Server(
-            self._handle, on_close=self._on_conn_close, host="127.0.0.1"
+            self._handle,
+            on_close=self._on_conn_close,
+            host=config.head_host,
+            port=config.head_port,
         )
         self.address = self.server.address
         self._dispatcher = threading.Thread(
@@ -254,9 +265,12 @@ class Head:
         return res
 
     def spawn_worker(self, node_id: str) -> WorkerRecord:
-        """Fork a pool worker process on `node_id` (local node only for now;
-        remote nodes will route through their supervisor — reference
-        analogue: WorkerPool::StartWorkerProcess, raylet/worker_pool.h:224)."""
+        """Start a pool worker on `node_id`: fork locally, or route the
+        spawn through the node's agent connection for remote nodes
+        (reference analogue: WorkerPool::StartWorkerProcess,
+        raylet/worker_pool.h:224; remote = raylet-side pool)."""
+        if node_id != self.node_id:
+            return self._spawn_remote_worker(node_id)
         worker_id = "worker-" + uuid.uuid4().hex[:8]
         env = dict(os.environ)
         env["RAY_TPU_WORKER_ID"] = worker_id
@@ -271,17 +285,39 @@ class Head:
         env["PYTHONPATH"] = os.pathsep.join(extra + ([existing] if existing else []))
         logs = os.path.join(self.session_dir, "logs")
         os.makedirs(logs, exist_ok=True)
-        out = open(os.path.join(logs, f"{worker_id}.log"), "ab")
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu._private.worker"],
-            env=env,
-            stdout=out,
-            stderr=subprocess.STDOUT,
-            cwd=os.getcwd(),
-        )
+        with open(os.path.join(logs, f"{worker_id}.log"), "ab") as out:
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu._private.worker"],
+                env=env,
+                stdout=out,
+                stderr=subprocess.STDOUT,
+                cwd=os.getcwd(),
+            )  # the child keeps its inherited fd; don't leak one per spawn
         rec = WorkerRecord(worker_id, node_id, proc)
         with self.lock:
             self.workers[worker_id] = rec
+        return rec
+
+    def _spawn_remote_worker(self, node_id: str) -> WorkerRecord:
+        """Ask the node's agent to fork a worker (reference: raylet spawns
+        its own workers after the GCS-side lease decision)."""
+        worker_id = "worker-" + uuid.uuid4().hex[:8]
+        rec = WorkerRecord(worker_id, node_id, None)
+        with self.lock:
+            agent = self.node_agents.get(node_id)
+            self.workers[worker_id] = rec
+        if agent is not None:
+            try:
+                agent.cast(
+                    "spawn_worker",
+                    {
+                        "worker_id": worker_id,
+                        "head": f"{self.address[0]}:{self.address[1]}",
+                        "node_id": node_id,
+                    },
+                )
+            except rpc.ConnectionLost:
+                pass  # node-death handler cleans the record up
         return rec
 
     # ------------------------------------------------------------------
@@ -295,6 +331,13 @@ class Head:
 
     def _on_conn_close(self, conn: rpc.Connection) -> None:
         info = conn.peer_info
+        node_id = info.get("node_agent_for")
+        if node_id is not None:
+            with self.lock:
+                if self.node_agents.get(node_id) is not conn:
+                    return  # stale connection of a re-joined node
+            self._handle_node_death(node_id)
+            return
         client_id = info.get("client_id")
         if client_id is None:
             return
@@ -304,10 +347,31 @@ class Head:
         if rec is not None:
             self._handle_worker_death(rec)
 
+    def _handle_node_death(self, node_id: str) -> None:
+        """Agent connection dropped: the whole node is gone (reference:
+        GcsNodeManager node-death path + health checks,
+        gcs_health_check_manager.h:45 — here the TCP session IS the
+        lease). Workers of the node are declared dead so their tasks
+        retry elsewhere; the node leaves the schedulable set."""
+        with self.lock:
+            self.node_agents.pop(node_id, None)
+            node = self.scheduler.nodes.get(node_id)
+            if node is not None:
+                node.alive = False
+            doomed = [r for r in self.workers.values() if r.node_id == node_id]
+        for rec in doomed:
+            self._handle_worker_death(rec)
+        self.dispatch_event.set()
+
     # --- registration ---
 
     def _h_register(self, body: dict, conn: rpc.Connection):
         ctype = body["client_type"]  # "driver" | "worker"
+        # Off-host clients can't mmap the head's shared memory; their
+        # object path degrades to inline payloads over the connection
+        # (reference analogue: remote plasma access goes through the
+        # object manager's chunked transfer, not local mmap).
+        remote = not body.get("can_shm", True)
         if ctype == "worker":
             client_id = body["worker_id"]
             with self.lock:
@@ -316,21 +380,56 @@ class Head:
                     # worker from a previous epoch / unknown: reject
                     raise rpc.RpcError(f"unknown worker {client_id}")
                 rec.conn = conn
+                rec.pid = body.get("pid", rec.pid)
                 self.clients[client_id] = conn
-                conn.peer_info = {"client_id": client_id, "type": "worker"}
+                conn.peer_info = {"client_id": client_id, "type": "worker",
+                                  "remote": remote}
             self.dispatch_event.set()
         else:
             client_id = "driver-" + uuid.uuid4().hex[:8]
-            conn.peer_info = {"client_id": client_id, "type": "driver"}
             with self.lock:
+                # Shm-fallback re-register on the same connection: drop the
+                # first registration's entry.
+                stale = conn.peer_info.get("client_id")
+                if stale:
+                    self.clients.pop(stale, None)
                 self.clients[client_id] = conn
+            conn.peer_info = {"client_id": client_id, "type": "driver",
+                              "remote": remote}
         return {
             "client_id": client_id,
-            "shm_name": self.shm_name,
+            "shm_name": None if remote else self.shm_name,
             "shm_capacity": self.config.object_store_memory,
             "node_id": self.node_id,
             "session_dir": self.session_dir,
         }
+
+    def _h_register_node(self, body: dict, conn: rpc.Connection):
+        """A node agent joins the cluster (reference: raylet registration
+        with the GCS node table, gcs_node_manager.h:49)."""
+        from ray_tpu._private.scheduler import NodeEntry, ResourceSet
+
+        node_id = body.get("node_id") or ("node-" + uuid.uuid4().hex[:8])
+        resources = dict(body.get("resources") or {})
+        resources.setdefault(f"node:{node_id}", 1.0)
+        entry = NodeEntry(
+            node_id=node_id,
+            address=body.get("address", "?"),
+            total=ResourceSet(resources),
+            available=ResourceSet(resources),
+            labels=dict(body.get("labels") or {}),
+        )
+        with self.lock:
+            # Re-join with a fixed node id: neuter the stale connection so
+            # its eventual close can't evict the fresh agent.
+            old = self.node_agents.get(node_id)
+            if old is not None and old is not conn:
+                old.peer_info.pop("node_agent_for", None)
+            self.scheduler.add_node(entry)
+            self.node_agents[node_id] = conn
+        conn.peer_info = {"node_agent_for": node_id}
+        self.dispatch_event.set()
+        return {"node_id": node_id, "session_dir": self.session_dir}
 
     def _h_worker_ready(self, body: dict, conn):
         with self.lock:
@@ -464,7 +563,7 @@ class Head:
         e = self.objects.get(object_id)
         return e is not None and e.state in (SEALED, SPILLED)
 
-    def _meta_for(self, entry: ObjectEntry) -> tuple:
+    def _meta_for(self, entry: ObjectEntry, remote: bool = False) -> tuple:
         if entry.inline is not None:
             return ("inline", entry.inline, entry.is_error)
         if entry.state == SPILLED:
@@ -473,6 +572,14 @@ class Head:
                 with open(entry.spill_path, "rb") as f:
                     return ("inline", f.read(), entry.is_error)
         if entry.state == SEALED:
+            if remote:
+                # Off-host client: copy out under the lock and ship bytes
+                # over the connection (no mmap, no read pin to release).
+                return (
+                    "inline",
+                    bytes(self.arena.view(entry.offset, entry.size)),
+                    entry.is_error,
+                )
             entry.read_pins += 1
             return ("shm", entry.offset, entry.size, entry.is_error)
         return ("lost", f"object {entry.object_id} is {entry.state}", False)
@@ -480,16 +587,23 @@ class Head:
     def _send_metas(self, conn: rpc.Connection, waiter_id: str) -> None:
         metas = {}
         ids = self._waiter_ids.pop(waiter_id, [])
+        remote = bool(conn.peer_info.get("remote"))
         for oid in ids:
             entry = self.objects.get(oid)
             if entry is None:
                 metas[oid] = ("lost", f"object {oid} unknown (freed?)", False)
             else:
-                metas[oid] = self._meta_for(entry)
-        try:
-            conn.cast("objects_ready", {"waiter_id": waiter_id, "metas": metas})
-        except rpc.ConnectionLost:
-            pass
+                metas[oid] = self._meta_for(entry, remote=remote)
+        # The cast happens OFF the head lock path: for remote clients the
+        # metas embed full payloads, and a blocking sendall to a slow peer
+        # under self.lock would freeze all scheduling.
+        def _cast(conn=conn, waiter_id=waiter_id, metas=metas):
+            try:
+                conn.cast("objects_ready", {"waiter_id": waiter_id, "metas": metas})
+            except rpc.ConnectionLost:
+                pass
+
+        self._send_pool.submit(_cast)
 
     def _h_get_meta(self, body: dict, conn):
         waiter_id, ids = body["waiter_id"], body["ids"]
@@ -884,6 +998,13 @@ class Head:
             rec = self.workers.get(actor.worker_id) if actor.worker_id else None
         if rec is not None and rec.proc is not None:
             rec.proc.kill()
+        elif rec is not None and rec.conn is not None:
+            # Remote worker: tell it to exit; its connection drop runs the
+            # normal death handling.
+            try:
+                rec.conn.cast("kill", {})
+            except rpc.ConnectionLost:
+                pass
         else:
             with self.lock:
                 actor.state = "DEAD"
@@ -1243,7 +1364,10 @@ class Head:
         rec = self.spawn_worker(node.node_id)
         rec.actor_id = spec.actor_id
         if not self._try_allocate(rec, node.node_id, spec.resources, spec.scheduling_strategy):
-            rec.proc.kill()
+            if rec.proc is not None:
+                rec.proc.kill()
+            # Remote spawn: the worker registers, finds its record gone,
+            # and exits (registration is rejected for unknown workers).
             self.workers.pop(rec.worker_id, None)
             return
         actor.state = "STARTING"

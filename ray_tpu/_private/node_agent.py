@@ -1,0 +1,149 @@
+"""Node agent: joins a cluster and forks workers on this machine.
+
+Counterpart of the reference's raylet daemon role (SURVEY.md §1 L1 —
+NodeManager raylet/node_manager.h:123: per-node worker pool + resource
+reporting; here scheduling stays centralized in the head, so the agent is
+the worker-pool half only). The TCP session to the head is the node's
+lease: the connection dropping IS node death (reference: GCS health
+checks, gcs_health_check_manager.h:45).
+
+Start via CLI: ``ray-tpu start --address <head_host:port>``.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+import threading
+from typing import Optional
+
+from ray_tpu._private import rpc
+from ray_tpu._private.config import GLOBAL_CONFIG
+
+
+class NodeAgent:
+    def __init__(
+        self,
+        head_address: tuple[str, int],
+        *,
+        num_cpus: float | None = None,
+        num_tpus: float | None = None,
+        resources: dict | None = None,
+        labels: dict | None = None,
+        node_id: str | None = None,
+        force_remote_objects: bool = False,
+    ):
+        self.head_address = head_address
+        self.force_remote_objects = force_remote_objects
+        self.procs: dict[str, subprocess.Popen] = {}
+        self._exit = threading.Event()
+        self.conn = rpc.connect(
+            head_address,
+            handler=self._handle,
+            name="node_agent",
+            on_close=lambda conn: self._exit.set(),
+        )
+        res = self._detect_resources(num_cpus, num_tpus, resources)
+        reply = self.conn.call(
+            "register_node",
+            {
+                "node_id": node_id,
+                "resources": res,
+                "labels": labels or {},
+                "address": socket.gethostname(),
+            },
+            timeout=GLOBAL_CONFIG.worker_register_timeout_s,
+        )
+        self.node_id = reply["node_id"]
+        self.session_dir = reply["session_dir"]
+
+    @staticmethod
+    def _detect_resources(num_cpus, num_tpus, resources) -> dict:
+        res = dict(resources or {})
+        if num_cpus is not None:
+            res["CPU"] = float(num_cpus)
+        else:
+            res.setdefault("CPU", float(os.cpu_count() or 1))
+        if num_tpus is not None:
+            res["TPU"] = float(num_tpus)
+        return res
+
+    # ------------------------------------------------------------------
+
+    def _handle(self, kind: str, body: dict, conn: rpc.Connection):
+        if kind == "spawn_worker":
+            self._spawn(body)
+        elif kind == "shutdown_node":
+            self._exit.set()
+        return None
+
+    def _spawn(self, body: dict) -> None:
+        worker_id = body["worker_id"]
+        env = dict(os.environ)
+        env["RAY_TPU_WORKER_ID"] = worker_id
+        # Use the address THIS agent dialed, not the head's bind address —
+        # a head bound to 0.0.0.0 would otherwise tell remote workers to
+        # connect to their own loopback.
+        env["RAY_TPU_HEAD"] = f"{self.head_address[0]}:{self.head_address[1]}"
+        env["RAY_TPU_NODE_ID"] = body["node_id"]
+        if self.force_remote_objects:
+            # Tests: same-host agents exercise the off-host object path.
+            env["RAY_TPU_REMOTE"] = "1"
+        log_dir = os.path.join(
+            os.environ.get("TMPDIR", "/tmp"), "ray_tpu_agent", self.node_id, "logs"
+        )
+        os.makedirs(log_dir, exist_ok=True)
+        with open(os.path.join(log_dir, f"{worker_id}.log"), "ab") as out:
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu._private.worker"],
+                env=env,
+                stdout=out,
+                stderr=subprocess.STDOUT,
+                cwd=os.getcwd(),
+            )  # child keeps its inherited fd; parent must not leak one per spawn
+        self.procs[worker_id] = proc
+
+    def run_forever(self) -> None:
+        self._exit.wait()
+        self.shutdown()
+
+    def shutdown(self) -> None:
+        for proc in self.procs.values():
+            if proc.poll() is None:
+                proc.kill()
+        try:
+            self.conn.close()
+        except Exception:
+            pass
+
+
+def main() -> None:
+    import argparse
+
+    p = argparse.ArgumentParser(description="ray_tpu node agent")
+    p.add_argument("--address", required=True, help="head host:port")
+    p.add_argument("--num-cpus", type=float, default=None)
+    p.add_argument("--num-tpus", type=float, default=None)
+    p.add_argument("--resources", default=None, help='JSON, e.g. \'{"side": 1}\'')
+    p.add_argument("--node-id", default=None)
+    p.add_argument("--force-remote-objects", action="store_true")
+    args = p.parse_args()
+    host, port = args.address.rsplit(":", 1)
+    import json
+
+    agent = NodeAgent(
+        (host, int(port)),
+        num_cpus=args.num_cpus,
+        num_tpus=args.num_tpus,
+        resources=json.loads(args.resources) if args.resources else None,
+        node_id=args.node_id,
+        force_remote_objects=args.force_remote_objects,
+    )
+    print(f"node agent up: node_id={agent.node_id}", flush=True)
+    agent.run_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -57,15 +57,34 @@ class CoreRuntime:
         self._closed = False
         self.address = address  # head (host, port) — job drivers reconnect here
         self.conn = rpc.connect(address, handler=self._handle, name=client_type)
+        # Off-host clients (or forced-remote for tests) skip the shm fast
+        # path; the head ships object payloads inline over the connection.
+        can_shm = os.environ.get("RAY_TPU_REMOTE") != "1"
         reg = self.conn.call(
             "register",
-            {"client_type": client_type, "worker_id": worker_id, "pid": os.getpid()},
+            {"client_type": client_type, "worker_id": worker_id,
+             "pid": os.getpid(), "can_shm": can_shm},
             timeout=GLOBAL_CONFIG.worker_register_timeout_s,
         )
         self.client_id = reg["client_id"]
         self.node_id = reg["node_id"]
         self.session_dir = reg["session_dir"]
-        self.shm = ShmClient(reg["shm_name"], reg["shm_capacity"])
+        if reg["shm_name"] is not None:
+            try:
+                self.shm = ShmClient(reg["shm_name"], reg["shm_capacity"])
+            except FileNotFoundError:
+                # Same-host assumption failed (container boundary, ...):
+                # re-register as a remote client.
+                reg = self.conn.call(
+                    "register",
+                    {"client_type": client_type, "worker_id": worker_id,
+                     "pid": os.getpid(), "can_shm": False},
+                    timeout=GLOBAL_CONFIG.worker_register_timeout_s,
+                )
+                self.client_id = reg["client_id"]
+                self.shm = None
+        else:
+            self.shm = None
         self._fn_cache: dict[str, Any] = {}
         self._fn_ids: dict[int, str] = {}  # id(fn) -> func_id
         ids_mod.set_ref_removed_callback(self._on_ref_removed)
@@ -115,7 +134,7 @@ class CoreRuntime:
         object_id = _object_id or os.urandom(16).hex()
         header, buffers = serialization.serialize(value)
         size = serialization.serialized_size(header, buffers)
-        if size <= GLOBAL_CONFIG.max_inline_object_size:
+        if self.shm is None or size <= GLOBAL_CONFIG.max_inline_object_size:
             payload = bytearray(size)
             serialization.write_to(memoryview(payload), header, buffers)
             self.conn.call(
@@ -315,4 +334,5 @@ class CoreRuntime:
         self._closed = True
         ids_mod.set_ref_removed_callback(None)
         self.conn.close()
-        self.shm.close()
+        if self.shm is not None:
+            self.shm.close()

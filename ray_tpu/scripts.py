@@ -1,0 +1,206 @@
+"""ray-tpu CLI: start/status/submit/list/timeline.
+
+Counterpart of the reference's CLI (python/ray/scripts/scripts.py —
+`ray start` :647, `ray status`, `ray submit`, `ray timeline`, `ray list`
+via util.state). `start --head` runs a standalone head service;
+`start --address` joins as a node agent.
+
+    ray-tpu start --head --port 6380 --num-cpus 8
+    ray-tpu start --address 127.0.0.1:6380 --num-cpus 4
+    ray-tpu status --address 127.0.0.1:6380
+    ray-tpu submit --address 127.0.0.1:6380 -- python my_job.py
+    ray-tpu list tasks --address 127.0.0.1:6380
+    ray-tpu timeline --address 127.0.0.1:6380 -o trace.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _connect(address: str) -> None:
+    import ray_tpu
+
+    ray_tpu.init(address=address)
+
+
+def cmd_start(args) -> int:
+    if args.head:
+        from ray_tpu._private.config import Config
+        from ray_tpu._private.gcs import Head
+
+        cfg = Config()
+        cfg.head_host = args.host
+        cfg.head_port = args.port
+        if args.object_store_memory:
+            cfg.object_store_memory = int(args.object_store_memory)
+        head = Head(cfg, num_cpus=args.num_cpus, num_tpus=args.num_tpus,
+                    resources=json.loads(args.resources) if args.resources else None)
+        host, port = head.address
+        if host == "0.0.0.0":
+            import socket
+
+            try:
+                shown = socket.gethostbyname(socket.gethostname())
+            except OSError:
+                shown = "<this-host>"
+        else:
+            shown = host
+        print(f"ray_tpu head up at {shown}:{port}", flush=True)
+        print(f"  connect: ray_tpu.init(address='{shown}:{port}')", flush=True)
+        print(f"  join:    ray-tpu start --address {shown}:{port}", flush=True)
+        try:
+            import threading
+
+            threading.Event().wait()  # serve forever
+        except KeyboardInterrupt:
+            head.shutdown()
+        return 0
+    if not args.address:
+        print("either --head or --address is required", file=sys.stderr)
+        return 2
+    from ray_tpu._private.node_agent import NodeAgent
+
+    host, port = args.address.rsplit(":", 1)
+    agent = NodeAgent(
+        (host, int(port)),
+        num_cpus=args.num_cpus,
+        num_tpus=args.num_tpus,
+        resources=json.loads(args.resources) if args.resources else None,
+        node_id=args.node_id,
+        force_remote_objects=args.force_remote_objects,
+    )
+    print(f"node agent up: node_id={agent.node_id}", flush=True)
+    try:
+        agent.run_forever()
+    except KeyboardInterrupt:
+        agent.shutdown()
+    return 0
+
+
+def cmd_status(args) -> int:
+    import ray_tpu
+
+    _connect(args.address)
+    info = {
+        "resources_total": ray_tpu.cluster_resources(),
+        "resources_available": ray_tpu.available_resources(),
+        "nodes": ray_tpu.nodes(),
+    }
+    print(json.dumps(info, indent=2, default=str))
+    return 0
+
+
+def cmd_submit(args) -> int:
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    client = JobSubmissionClient(address=args.address)
+    entrypoint = " ".join(args.entrypoint)
+    job_id = client.submit_job(entrypoint=entrypoint)
+    print(f"submitted {job_id}")
+    if args.wait:
+        status = client.wait_until_finished(job_id, timeout_s=args.timeout)
+        print(f"{job_id}: {status}")
+        print(client.get_job_logs(job_id), end="")
+        return 0 if status == "SUCCEEDED" else 1
+    return 0
+
+
+def cmd_list(args) -> int:
+    from ray_tpu.util import state as us
+
+    _connect(args.address)
+    fn = {
+        "tasks": us.list_tasks,
+        "actors": us.list_actors,
+        "objects": us.list_objects,
+        "workers": us.list_workers,
+        "nodes": us.list_nodes,
+    }[args.kind]
+    print(json.dumps(fn(limit=args.limit), indent=2, default=str))
+    return 0
+
+
+def cmd_summary(args) -> int:
+    from ray_tpu.util import state as us
+
+    _connect(args.address)
+    print(json.dumps(us.summarize_tasks(), indent=2))
+    return 0
+
+
+def cmd_timeline(args) -> int:
+    from ray_tpu.util import state as us
+
+    _connect(args.address)
+    path = us.timeline(args.output)
+    print(f"wrote {path}")
+    return 0
+
+
+def cmd_dashboard(args) -> int:
+    from ray_tpu.dashboard import start_dashboard
+
+    _connect(args.address)
+    port = start_dashboard(port=args.port)
+    print(f"dashboard at http://127.0.0.1:{port}/")
+    import threading
+
+    threading.Event().wait()
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(prog="ray-tpu", description=__doc__.splitlines()[0])
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("start", help="start a head or join as a node")
+    sp.add_argument("--head", action="store_true")
+    sp.add_argument("--address", default=None, help="join an existing head")
+    sp.add_argument("--host", default="127.0.0.1")
+    sp.add_argument("--port", type=int, default=6380)
+    sp.add_argument("--num-cpus", type=float, default=None)
+    sp.add_argument("--num-tpus", type=float, default=None)
+    sp.add_argument("--resources", default=None, help="JSON dict")
+    sp.add_argument("--object-store-memory", type=int, default=None)
+    sp.add_argument("--node-id", default=None)
+    sp.add_argument("--force-remote-objects", action="store_true",
+                    help=argparse.SUPPRESS)  # test hook
+    sp.set_defaults(fn=cmd_start)
+
+    for name, fn in (("status", cmd_status), ("summary", cmd_summary)):
+        s = sub.add_parser(name)
+        s.add_argument("--address", required=True)
+        s.set_defaults(fn=fn)
+
+    s = sub.add_parser("submit", help="run an entrypoint as a cluster job")
+    s.add_argument("--address", required=True)
+    s.add_argument("--wait", action="store_true")
+    s.add_argument("--timeout", type=float, default=600.0)
+    s.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    s.set_defaults(fn=cmd_submit)
+
+    s = sub.add_parser("list")
+    s.add_argument("kind", choices=["tasks", "actors", "objects", "workers", "nodes"])
+    s.add_argument("--address", required=True)
+    s.add_argument("--limit", type=int, default=100)
+    s.set_defaults(fn=cmd_list)
+
+    s = sub.add_parser("timeline")
+    s.add_argument("--address", required=True)
+    s.add_argument("-o", "--output", default="timeline.json")
+    s.set_defaults(fn=cmd_timeline)
+
+    s = sub.add_parser("dashboard")
+    s.add_argument("--address", required=True)
+    s.add_argument("--port", type=int, default=0)
+    s.set_defaults(fn=cmd_dashboard)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

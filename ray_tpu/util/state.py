@@ -43,12 +43,14 @@ def list_tasks(*, filters=None, limit: int = 1000) -> list[dict]:
     # over the full table window so matches outside the last `limit`
     # rows aren't silently missed.
     filters = list(filters or [])
-    body: dict = {"limit": limit if not filters else 1_000_000}
+    body: dict = {}
     for f in filters:
         if f[0] == "state" and f[1] == "=":
             body["state"] = f[2]
             filters.remove(f)
             break
+    # Only filters that remain CLIENT-side force a full-table fetch.
+    body["limit"] = limit if not filters else 1_000_000
     rows = _call("list_tasks", body)["tasks"]
     return _filtered([dict(r) for r in rows], filters)[:limit]
 
