@@ -23,6 +23,9 @@ class TaskSpec:
     owner_id: str  # client id of the submitter
     max_retries: int = 0
     retries_used: int = 0
+    # Streaming generator task: yielded items are stored under
+    # deterministic ids ({task_id}:g{i}); return_ids[0] seals the count.
+    streaming: bool = False
     scheduling_strategy: Any = None
     runtime_env: dict | None = None
     # actor fields

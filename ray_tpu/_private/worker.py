@@ -172,7 +172,10 @@ class Worker:
                 result = method(*args, **kwargs)
             else:
                 result = self.runtime.get_function(spec.func_id)(*args, **kwargs)
-            self._store_returns(spec, result)
+            if spec.streaming:
+                self._store_generator_items(spec, result)
+            else:
+                self._store_returns(spec, result)
             return True
         except Exception as e:  # noqa: BLE001
             err = TaskError(repr(e), traceback.format_exc(), spec.name)
@@ -194,6 +197,22 @@ class Worker:
         if isinstance(value, ObjectRef):
             return self.runtime.get(value)
         return value
+
+    def _store_generator_items(self, spec: TaskSpec, result) -> None:
+        """Streaming generator: store each yielded item under its
+        deterministic id as produced, then seal the count into the return
+        object (reference: ReportGeneratorItemReturns,
+        core_worker.proto:402). Items become visible to the consumer's
+        ObjectRefGenerator immediately; an exception mid-iteration falls
+        through to the caller's error path, which seals the error into
+        the return object and unblocks the consumer."""
+        from ray_tpu.generator import item_object_id
+
+        count = 0
+        for item in result:
+            self.runtime.put(item, _object_id=item_object_id(spec.task_id, count))
+            count += 1
+        self.runtime.put(count, _object_id=spec.return_ids[0])
 
     def _store_returns(self, spec: TaskSpec, result) -> None:
         n = len(spec.return_ids)

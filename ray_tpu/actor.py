@@ -20,12 +20,12 @@ from ray_tpu.remote_function import _normalize_resources
 
 
 class ActorMethod:
-    def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1):
+    def __init__(self, handle: "ActorHandle", name: str, num_returns=1):
         self._handle = handle
         self._name = name
         self._num_returns = num_returns
 
-    def options(self, num_returns: int = 1, **_):
+    def options(self, num_returns=1, **_):
         return ActorMethod(self._handle, self._name, num_returns)
 
     def remote(self, *args, **kwargs):
@@ -44,9 +44,15 @@ class ActorMethod:
 
 
 class ActorHandle:
-    def __init__(self, actor_id: str, method_names: tuple[str, ...] = ()):
+    def __init__(
+        self,
+        actor_id: str,
+        method_names: tuple[str, ...] = (),
+        gen_methods: tuple[str, ...] = (),
+    ):
         self._actor_id = actor_id
         self._method_names = method_names
+        self._gen_methods = gen_methods
         self._seq = 0
 
     @property
@@ -56,11 +62,15 @@ class ActorHandle:
     def __getattr__(self, name: str) -> ActorMethod:
         if name.startswith("_"):
             raise AttributeError(name)
-        return ActorMethod(self, name)
+        default_nr = "streaming" if name in self._gen_methods else 1
+        return ActorMethod(self, name, default_nr)
 
-    def _submit_method(self, method: str, args, kwargs, num_returns: int):
+    def _submit_method(self, method: str, args, kwargs, num_returns):
         rt = global_runtime()
         packed, deps = rt.pack_args(args, kwargs)
+        streaming = num_returns in ("streaming", "dynamic")
+        if streaming:
+            num_returns = 1
         return_ids = [os.urandom(16).hex() for _ in range(num_returns)]
         self._seq += 1
         spec = TaskSpec(
@@ -75,13 +85,18 @@ class ActorHandle:
             actor_id=self._actor_id,
             method_name=method,
             seq_no=self._seq,
+            streaming=streaming,
         )
         rt.submit_actor_task(spec)
+        if streaming:
+            from ray_tpu.generator import ObjectRefGenerator
+
+            return ObjectRefGenerator(spec.task_id, ObjectRef(return_ids[0], _owned=True))
         refs = [ObjectRef(oid, _owned=True) for oid in return_ids]
         return refs[0] if num_returns == 1 else refs
 
     def __reduce__(self):
-        return (ActorHandle, (self._actor_id, self._method_names))
+        return (ActorHandle, (self._actor_id, self._method_names, self._gen_methods))
 
     def __repr__(self):
         return f"ActorHandle({self._actor_id[:16]})"
@@ -137,10 +152,15 @@ class ActorClass:
             lifetime=opts.get("lifetime"),
         )
         rt.create_actor(spec)
+        import inspect
+
         methods = tuple(
             n for n in dir(self._cls) if callable(getattr(self._cls, n, None)) and not n.startswith("_")
         )
-        return ActorHandle(actor_id, methods)
+        gen_methods = tuple(
+            n for n in methods if inspect.isgeneratorfunction(getattr(self._cls, n, None))
+        )
+        return ActorHandle(actor_id, methods, gen_methods)
 
 
 def creation_ref(handle: ActorHandle) -> ObjectRef:

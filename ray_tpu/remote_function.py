@@ -58,12 +58,20 @@ class RemoteFunction:
         return FunctionNode(self, args, kwargs)
 
     def remote(self, *args, **kwargs):
+        import inspect
+
         from ray_tpu import api
 
         api.auto_init()
         rt = global_runtime()
         opts = self._opts
-        num_returns = int(opts.get("num_returns", 1))
+        nr_opt = opts.get("num_returns", 1)
+        # Generator functions stream by default (reference: _raylet.pyx
+        # streaming generators; num_returns="streaming"/"dynamic").
+        streaming = nr_opt in ("streaming", "dynamic") or (
+            nr_opt == 1 and inspect.isgeneratorfunction(self._fn)
+        )
+        num_returns = 1 if streaming else int(nr_opt)
         func_id = rt.register_function(self._fn)
         packed, deps = rt.pack_args(args, kwargs)
         return_ids = [os.urandom(16).hex() for _ in range(num_returns)]
@@ -86,8 +94,13 @@ class RemoteFunction:
             ),
             scheduling_strategy=opts.get("scheduling_strategy"),
             runtime_env=opts.get("runtime_env"),
+            streaming=streaming,
         )
         rt.submit_task(spec)
+        if streaming:
+            from ray_tpu.generator import ObjectRefGenerator
+
+            return ObjectRefGenerator(spec.task_id, ObjectRef(return_ids[0], _owned=True))
         refs = [ObjectRef(oid, _owned=True) for oid in return_ids]
         return refs[0] if num_returns == 1 else refs
 

@@ -1,0 +1,110 @@
+"""Streaming generator returns (reference: python/ray/tests/test_streaming_generator.py)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.generator import ObjectRefGenerator
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _cluster():
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_generator_function_streams():
+    @ray_tpu.remote
+    def gen(n):
+        for i in range(n):
+            yield i * i
+
+    g = gen.remote(5)
+    assert isinstance(g, ObjectRefGenerator)
+    values = [ray_tpu.get(ref) for ref in g]
+    assert values == [0, 1, 4, 9, 16]
+
+
+def test_explicit_streaming_option():
+    @ray_tpu.remote
+    def listy(n):
+        return list(range(n))
+
+    # num_returns="streaming" on a normal function returning an iterable.
+    g = listy.options(num_returns="streaming").remote(3)
+    assert [ray_tpu.get(r) for r in g] == [0, 1, 2]
+
+
+def test_items_available_before_task_finishes():
+    @ray_tpu.remote
+    def slow_gen():
+        import time
+
+        yield "first"
+        time.sleep(30)  # long tail: consumer must not wait for this
+        yield "last"
+
+    g = slow_gen.remote()
+    first_ref = next(g)
+    assert ray_tpu.get(first_ref) == "first"
+
+
+def test_error_mid_stream():
+    @ray_tpu.remote(max_retries=0)
+    def bad_gen():
+        yield 1
+        yield 2
+        raise ValueError("boom")
+
+    g = bad_gen.remote()
+    assert ray_tpu.get(next(g)) == 1
+    assert ray_tpu.get(next(g)) == 2
+    with pytest.raises(Exception):
+        # The failure seals an error into the done object; consuming past
+        # the produced items raises it.
+        ray_tpu.get(next(g))
+
+
+def test_empty_generator():
+    @ray_tpu.remote
+    def empty():
+        return
+        yield  # pragma: no cover
+
+    g = empty.remote()
+    assert list(g) == []
+
+
+def test_actor_generator_method():
+    @ray_tpu.remote
+    class Streamer:
+        def __init__(self):
+            self.base = 10
+
+        def produce(self, n):
+            for i in range(n):
+                yield self.base + i
+
+        def plain(self):
+            return "ok"
+
+    a = Streamer.remote()
+    g = a.produce.remote(3)
+    assert isinstance(g, ObjectRefGenerator)
+    assert [ray_tpu.get(r) for r in g] == [10, 11, 12]
+    # Non-generator methods are unaffected.
+    assert ray_tpu.get(a.plain.remote()) == "ok"
+    ray_tpu.kill(a)
+
+
+def test_generator_survives_pickle_roundtrip():
+    @ray_tpu.remote
+    def gen():
+        yield 42
+
+    @ray_tpu.remote
+    def consume(g):
+        return sum(ray_tpu.get(r) for r in g)
+
+    g = gen.remote()
+    assert ray_tpu.get(consume.remote(g)) == 42
