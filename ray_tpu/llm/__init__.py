@@ -1,0 +1,23 @@
+"""ray_tpu.llm: LLM batch inference and OpenAI-compatible serving.
+
+Counterpart of the reference's python/ray/llm (vLLM-backed batch stages +
+Serve deployments). TPU-native: the engine is a JAX slot-cache
+continuous-batching decoder (engine.py / model_runner.py) instead of a
+delegated CUDA engine.
+"""
+
+from ray_tpu.llm.batch import LLMPredictor, build_llm_processor
+from ray_tpu.llm.config import LLMConfig, SamplingParams
+from ray_tpu.llm.engine import LLMEngine, RequestOutput
+from ray_tpu.llm.serving import LLMServer, build_openai_app
+
+__all__ = [
+    "LLMConfig",
+    "SamplingParams",
+    "LLMEngine",
+    "RequestOutput",
+    "LLMServer",
+    "build_openai_app",
+    "LLMPredictor",
+    "build_llm_processor",
+]

@@ -1,0 +1,77 @@
+"""LLM engine/serving configuration.
+
+Counterpart of the reference's LLMConfig (reference:
+python/ray/llm/_internal/serve/configs/server_models.py — model id,
+engine kwargs incl. tensor_parallel_size, accelerator type; and the batch
+path's vLLM engine kwargs, llm/_internal/batch/stages/vllm_engine_stage.py
+:646-647). TPU-native: instead of delegating to an external CUDA engine,
+the config describes a JAX decode engine (ray_tpu.llm.engine) over the
+in-repo transformer family — slot count (max concurrent sequences), static
+KV-cache length, prefill length buckets — everything XLA needs to stay
+static-shaped.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any
+
+from ray_tpu.models import transformer as tfm
+
+
+@dataclass
+class SamplingParams:
+    """Per-request sampling knobs (reference: vLLM SamplingParams subset)."""
+
+    max_tokens: int = 64
+    temperature: float = 0.0
+    stop_token_ids: tuple[int, ...] = ()
+    # Reserved for future logit-processing extensions.
+    extra: dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class LLMConfig:
+    """Describes one servable model + its engine geometry."""
+
+    model_id: str = "tiny"
+    # TransformerConfig instance, or the name of a factory in
+    # ray_tpu.models.transformer (e.g. "gpt2_small", "llama2_7b", "tiny").
+    model: Any = None
+    # Engine geometry (static shapes → one compile per bucket).
+    max_num_seqs: int = 8  # decode slots (continuous-batching width)
+    max_seq_len: int = 512  # KV-cache capacity per slot
+    prefill_buckets: tuple[int, ...] = (32, 64, 128, 256)
+    # "byte" (offline-safe, vocab 256+specials) or a HF tokenizer path.
+    tokenizer: str = "byte"
+    # Sharding: number of mesh devices for tensor parallelism (1 = none).
+    tensor_parallel_size: int = 1
+    sampling_defaults: SamplingParams = field(default_factory=SamplingParams)
+    # Optional checkpoint directory (orbax/npz) to load params from.
+    checkpoint_path: str | None = None
+    seed: int = 0
+
+    def resolve_model(self) -> tfm.TransformerConfig:
+        if isinstance(self.model, tfm.TransformerConfig):
+            cfg = self.model
+        elif isinstance(self.model, str) or self.model is None:
+            name = self.model or self.model_id
+            factory = getattr(tfm, name, None)
+            if factory is None:
+                raise ValueError(
+                    f"unknown model {name!r}: not a TransformerConfig and not "
+                    f"a factory in ray_tpu.models.transformer"
+                )
+            cfg = factory()
+            if self.tokenizer == "byte" and cfg.vocab_size < 512:
+                # Factory-named models are randomly initialized, so the
+                # vocab can be grown to fit the byte tokenizer's specials
+                # (259 ids; 512 keeps the lm_head MXU-tile aligned).
+                cfg = dataclasses.replace(cfg, vocab_size=512)
+        else:
+            raise TypeError(f"model must be TransformerConfig or str, got {type(self.model)}")
+        # The engine clamps its cache length to the model's position
+        # capacity (LLMEngine.max_len), so a default 512 geometry works
+        # with short-context models out of the box.
+        return cfg

@@ -1,0 +1,245 @@
+"""LLMEngine: continuous-batching JAX decode engine.
+
+Counterpart of the reference's vLLM engine wrapper (reference:
+llm/_internal/batch/stages/vllm_engine_stage.py — request queue, engine
+step loop; serve side llm/_internal/serve/deployments/llm/). TPU-native
+design: no paged attention, no CUDA graphs — a static slot cache
+(model_runner.py) and a host-side scheduler:
+
+  admit:  while a slot is free and requests wait, prefill one prompt
+          (bucket-padded → few compiles) into the free slot;
+  step:   one jitted decode advances every active slot by one token;
+  retire: slots finishing (EOS / max_tokens / cache full) free up.
+
+The whole engine is synchronous and single-threaded; concurrency comes
+from serving it inside an actor (one engine per replica) and from the
+batch dimension itself.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import threading
+from typing import Any, Iterable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.llm import model_runner
+from ray_tpu.llm.config import LLMConfig, SamplingParams
+from ray_tpu.llm.tokenizer import load_tokenizer
+from ray_tpu.models import transformer as tfm
+
+
+@dataclasses.dataclass
+class Request:
+    request_id: str
+    prompt_tokens: list[int]
+    params: SamplingParams
+    generated: list[int] = dataclasses.field(default_factory=list)
+    finished: bool = False
+    finish_reason: str | None = None
+
+
+@dataclasses.dataclass
+class RequestOutput:
+    request_id: str
+    token_ids: list[int]
+    text: str
+    finish_reason: str | None
+    num_prompt_tokens: int
+
+
+class LLMEngine:
+    def __init__(self, config: LLMConfig, params: Any = None):
+        self.config = config
+        self.model_config = config.resolve_model()
+        self.tokenizer = load_tokenizer(config.tokenizer)
+        c = self.model_config
+        tok_vocab = getattr(self.tokenizer, "vocab_size", None)
+        if tok_vocab is not None and tok_vocab > c.vocab_size:
+            raise ValueError(
+                f"tokenizer vocab ({tok_vocab}, incl. special tokens) exceeds "
+                f"model vocab_size ({c.vocab_size}); special-token ids would "
+                f"silently clamp in the embedding lookup. Use a model with "
+                f"vocab_size >= {tok_vocab}."
+            )
+        # Engine cache capacity is capped by the model's position capacity.
+        self.max_len = min(config.max_seq_len, c.max_seq_len)
+        if params is None:
+            if config.checkpoint_path:
+                params = _load_checkpoint(config.checkpoint_path)
+            else:
+                params = tfm.init_params(jax.random.PRNGKey(config.seed), c)
+        self.params = params
+        B = config.max_num_seqs
+        self.cache = model_runner.init_slot_cache(c, B, self.max_len)
+        # Host-side scheduling state (uploaded per decode call): keeping
+        # positions on host avoids a device→host sync per slot per token.
+        self.positions = np.zeros((B,), np.int32)
+        self.last_tokens = np.zeros((B,), np.int32)
+        self.temps = np.zeros((B,), np.float32)
+        self.slots: list[Request | None] = [None] * B
+        self.waiting: collections.deque[Request] = collections.deque()
+        self._rng = jax.random.PRNGKey(config.seed + 1)
+        self._step_count = 0
+        # generate()/step() mutate slot state and the donated cache buffer;
+        # serving replicas run threaded (max_concurrency > 1), so the engine
+        # serializes itself rather than trusting every caller to.
+        self._lock = threading.Lock()
+
+    # -- request intake ----------------------------------------------------
+
+    def add_request(self, request_id: str, prompt: str | list[int],
+                    sampling_params: SamplingParams | None = None) -> None:
+        sp = sampling_params or self.config.sampling_defaults
+        toks = (self.tokenizer.encode(prompt) if isinstance(prompt, str)
+                else list(prompt))
+        toks = toks[: self.max_len - 1]
+        self.waiting.append(Request(request_id, toks, sp))
+
+    def has_unfinished(self) -> bool:
+        return bool(self.waiting) or any(s is not None for s in self.slots)
+
+    # -- scheduling --------------------------------------------------------
+
+    def _bucket(self, n: int) -> int:
+        for b in self.config.prefill_buckets:
+            if b >= n and b <= self.max_len:
+                return b
+        return self.max_len
+
+    def _admit(self, outputs: list[RequestOutput]) -> None:
+        for slot in range(len(self.slots)):
+            if self.slots[slot] is not None or not self.waiting:
+                continue
+            req = self.waiting.popleft()
+            L = len(req.prompt_tokens)
+            S = self._bucket(L)
+            padded = np.full((1, S), 0, np.int32)
+            padded[0, :L] = req.prompt_tokens
+            last_logits, self.cache = model_runner.prefill(
+                self.params, jnp.asarray(padded), jnp.int32(L),
+                jnp.int32(slot), self.cache, config=self.model_config,
+            )
+            tok = self._sample_host(np.asarray(last_logits), req.params)
+            self.positions[slot] = L
+            self.slots[slot] = req
+            self.temps[slot] = req.params.temperature
+            self.last_tokens[slot] = tok
+            req.generated.append(tok)
+            self._maybe_finish(slot, outputs)
+
+    def _sample_host(self, logits: np.ndarray, sp: SamplingParams) -> int:
+        if sp.temperature <= 0.0:
+            return int(logits.argmax())
+        self._rng, key = jax.random.split(self._rng)
+        return int(jax.random.categorical(key, jnp.asarray(logits) / sp.temperature))
+
+    def _stop_ids(self, sp: SamplingParams) -> set[int]:
+        stop = set(sp.stop_token_ids)
+        eos = getattr(self.tokenizer, "eos_token_id", None)
+        if eos is not None:
+            stop.add(int(eos))
+        return stop
+
+    def _maybe_finish(self, slot: int, outputs: list[RequestOutput]) -> None:
+        req = self.slots[slot]
+        pos = int(self.positions[slot])
+        reason = None
+        if req.generated and req.generated[-1] in self._stop_ids(req.params):
+            req.generated.pop()  # don't surface the stop token
+            reason = "stop"
+        elif len(req.generated) >= req.params.max_tokens:
+            reason = "length"
+        elif pos >= self.max_len - 1:
+            reason = "length"  # KV cache exhausted
+        if reason is not None:
+            req.finished = True
+            req.finish_reason = reason
+            outputs.append(RequestOutput(
+                request_id=req.request_id,
+                token_ids=list(req.generated),
+                text=self.tokenizer.decode(req.generated),
+                finish_reason=reason,
+                num_prompt_tokens=len(req.prompt_tokens),
+            ))
+            self.slots[slot] = None
+
+    # -- the engine iteration ---------------------------------------------
+
+    def step(self) -> list[RequestOutput]:
+        """One engine iteration: admit waiting requests, then advance all
+        active slots one token. Returns outputs finished this step."""
+        outputs: list[RequestOutput] = []
+        self._admit(outputs)
+        active = [i for i, s in enumerate(self.slots) if s is not None]
+        if not active:
+            return outputs
+        self._rng, key = jax.random.split(self._rng)
+        toks, _logits, self.cache = model_runner.decode(
+            self.params,
+            jnp.asarray(self.last_tokens),
+            jnp.asarray(self.positions),
+            self.cache,
+            jnp.asarray(self.temps),
+            key,
+            config=self.model_config,
+        )
+        toks = np.asarray(toks)
+        # Only active slots advance; inactive slots' writes land at their
+        # stale position and are reclaimed by the next prefill's mask.
+        self.positions[active] += 1
+        self._step_count += 1
+        for slot in active:
+            req = self.slots[slot]
+            tok = int(toks[slot])
+            self.last_tokens[slot] = tok
+            req.generated.append(tok)
+            self._maybe_finish(slot, outputs)
+        return outputs
+
+    # -- convenience batch API --------------------------------------------
+
+    def generate(self, prompts: Iterable[str | list[int]],
+                 sampling_params: SamplingParams | None = None,
+                 ) -> list[RequestOutput]:
+        """Run a batch of prompts to completion. Thread-safe: concurrent
+        callers (threaded serving replicas) are serialized on the engine
+        lock, and request ids are unique per call so interleaved batches
+        can never swap outputs."""
+        import uuid
+
+        with self._lock:
+            tag = uuid.uuid4().hex[:8]
+            order: list[str] = []
+            for i, p in enumerate(prompts):
+                rid = f"req-{tag}-{i}"
+                order.append(rid)
+                self.add_request(rid, p, sampling_params)
+            done: dict[str, RequestOutput] = {}
+            while self.has_unfinished():
+                for out in self.step():
+                    done[out.request_id] = out
+            return [done[rid] for rid in order]
+
+
+def _load_checkpoint(path: str):
+    """npz (flat dotted keys) or orbax checkpoint directory."""
+    import os
+
+    if os.path.isfile(path) and path.endswith(".npz"):
+        flat = dict(np.load(path))
+        tree: dict = {}
+        for k, v in flat.items():
+            parts = k.split(".")
+            node = tree
+            for p in parts[:-1]:
+                node = node.setdefault(p, {})
+            node[parts[-1]] = jnp.asarray(v)
+        return tree
+    import orbax.checkpoint as ocp
+
+    return ocp.StandardCheckpointer().restore(path)

@@ -1,0 +1,187 @@
+"""Slot-based prefill/decode steps for continuous batching.
+
+The reference's serving data plane is vLLM's paged-attention CUDA engine
+(reference: llm/_internal/batch/stages/vllm_engine_stage.py). The
+TPU-native equivalent avoids paging entirely: XLA wants static shapes, so
+the KV cache is one preallocated array of ``max_num_seqs`` slots ×
+``max_seq_len`` rows, and continuous batching is expressed as
+
+  - ``prefill``: run one (bucket-padded) prompt through the model and
+    write its K/V rows into slot ``s`` — a ``dynamic_update_slice``;
+  - ``decode``: ONE jitted step advancing ALL slots together, each at its
+    own position (``positions`` vector), with per-slot causal masking
+    ``k_pos <= pos[b]``. Inactive/garbage slots are masked out by the
+    same rule: rows beyond a slot's position are never attended, and each
+    decode write lands exactly at ``pos[b]``, reclaiming any stale row
+    before the mask can reach it.
+
+Both steps donate the cache, so XLA updates it in place on device.
+Sampling (greedy / temperature) happens inside the decode program: only
+the sampled token ids [B] come back to the host each step.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models.transformer import TransformerConfig, _expand_gqa
+from ray_tpu.ops.attention import dot_product_attention
+from ray_tpu.ops.layers import (
+    apply_rope,
+    gelu_mlp,
+    layer_norm,
+    rms_norm,
+    rope_frequencies,
+    swiglu,
+)
+
+
+def init_slot_cache(config: TransformerConfig, num_slots: int, max_len: int):
+    c = config
+    shape = (c.n_layers, num_slots, max_len, c.kv_heads, c.head_dim)
+    return {
+        "k": jnp.zeros(shape, c.compute_dtype),
+        "v": jnp.zeros(shape, c.compute_dtype),
+    }
+
+
+def _norm1(x, lp, c):
+    if c.arch == "gpt2":
+        return layer_norm(x, lp["ln1"]["w"], lp["ln1"]["b"])
+    return rms_norm(x, lp["ln1"]["w"])
+
+
+def _mlp(x, lp, c, dt):
+    if c.arch == "gpt2":
+        h = layer_norm(x, lp["ln2"]["w"], lp["ln2"]["b"])
+        return gelu_mlp(h, lp["mlp"]["w_in"].astype(dt), lp["mlp"]["b_in"].astype(dt),
+                        lp["mlp"]["w_out"].astype(dt), lp["mlp"]["b_out"].astype(dt))
+    h = rms_norm(x, lp["ln2"]["w"])
+    return swiglu(h, lp["mlp"]["w_gate"].astype(dt), lp["mlp"]["w_up"].astype(dt),
+                  lp["mlp"]["w_down"].astype(dt))
+
+
+def _final_logits(x, params, c, dt):
+    if c.arch == "gpt2":
+        x = layer_norm(x, params["final_norm"]["w"], params["final_norm"]["b"])
+    else:
+        x = rms_norm(x, params["final_norm"]["w"])
+    head = params["embed"]["tokens"].T if c.tied else params["lm_head"]
+    return jnp.einsum("bsd,dv->bsv", x, head.astype(dt),
+                      preferred_element_type=jnp.float32)
+
+
+@partial(jax.jit, static_argnames=("config",), donate_argnames=("cache",))
+def prefill(params, tokens, true_len, slot, cache, *, config: TransformerConfig):
+    """Run one padded prompt [1, S] and write K/V into cache slot.
+
+    Returns (last_logits [V] float32, cache'). ``true_len`` is the
+    unpadded prompt length; the returned logits are taken at position
+    true_len-1, so right-padding never leaks into the first sampled
+    token (causal attention at that position only sees real tokens).
+    """
+    c = config
+    dt = c.compute_dtype
+    _, S = tokens.shape
+    positions = jnp.arange(S)
+
+    x = params["embed"]["tokens"][tokens].astype(dt)
+    if c.arch == "gpt2":
+        x = x + params["embed"]["pos"][positions].astype(dt)
+        rope = None
+    else:
+        rope = rope_frequencies(c.head_dim, c.max_seq_len, theta=c.rope_theta)
+
+    def body(x, xs):
+        lp, kc, vc = xs
+        h = _norm1(x, lp, c)
+        q = jnp.einsum("btd,dhk->bthk", h, lp["attn"]["wq"].astype(dt))
+        k = jnp.einsum("btd,dhk->bthk", h, lp["attn"]["wk"].astype(dt))
+        v = jnp.einsum("btd,dhk->bthk", h, lp["attn"]["wv"].astype(dt))
+        if rope is not None:
+            q = apply_rope(q, *rope, positions=positions)
+            k = apply_rope(k, *rope, positions=positions)
+        kc = jax.lax.dynamic_update_slice(kc, k, (slot, 0, 0, 0))
+        vc = jax.lax.dynamic_update_slice(vc, v, (slot, 0, 0, 0))
+        kf, vf = _expand_gqa(k, v, c)
+        o = dot_product_attention(q, kf, vf, causal=True).astype(dt)
+        o = jnp.einsum("bshk,hkd->bsd", o, lp["attn"]["wo"].astype(dt))
+        x = x + o
+        return x + _mlp(x, lp, c, dt), (kc, vc)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        body, x, (params["layers"], cache["k"], cache["v"])
+    )
+    logits = _final_logits(x, params, c, dt)  # [1, S, V]
+    last = jax.lax.dynamic_index_in_dim(logits[0], true_len - 1, axis=0,
+                                        keepdims=False)
+    return last, {"k": k_new, "v": v_new}
+
+
+@partial(jax.jit, static_argnames=("config",), donate_argnames=("cache",))
+def decode(params, tokens, positions, cache, temperature, rng,
+           *, config: TransformerConfig):
+    """One decode step for all slots: tokens [B], positions [B].
+
+    Writes each slot's new K/V row at its own position, attends with the
+    per-slot mask ``k_pos <= pos[b]``, samples in-program (greedy where
+    temperature == 0, categorical otherwise) and returns
+    (sampled_tokens [B] int32, last_logits [B, V] float32, cache').
+    """
+    c = config
+    dt = c.compute_dtype
+    B = tokens.shape[0]
+    T = cache["k"].shape[2]
+    barange = jnp.arange(B)
+
+    x = params["embed"]["tokens"][tokens][:, None, :].astype(dt)  # [B,1,D]
+    if c.arch == "gpt2":
+        x = x + params["embed"]["pos"][positions][:, None, :].astype(dt)
+        rope = None
+    else:
+        cos, sin = rope_frequencies(c.head_dim, c.max_seq_len, theta=c.rope_theta)
+        # Per-slot rotation tables [B, 1, 1, Dh/2].
+        rope = (cos[positions][:, None, None, :], sin[positions][:, None, None, :])
+
+    def rot(t):  # t: [B, 1, H, Dh]
+        cb, sb = rope
+        t1, t2 = jnp.split(t.astype(jnp.float32), 2, axis=-1)
+        return jnp.concatenate([t1 * cb - t2 * sb, t2 * cb + t1 * sb],
+                               axis=-1).astype(t.dtype)
+
+    kmask = (jnp.arange(T)[None, :] <= positions[:, None])  # [B, T]
+
+    def body(x, xs):
+        lp, kc, vc = xs  # kc/vc: [B, T, KV, Dh]
+        h = _norm1(x, lp, c)
+        q = jnp.einsum("btd,dhk->bthk", h, lp["attn"]["wq"].astype(dt))
+        k = jnp.einsum("btd,dhk->bthk", h, lp["attn"]["wk"].astype(dt))
+        v = jnp.einsum("btd,dhk->bthk", h, lp["attn"]["wv"].astype(dt))
+        if rope is not None:
+            q, k = rot(q), rot(k)
+        kc = kc.at[barange, positions].set(k[:, 0])
+        vc = vc.at[barange, positions].set(v[:, 0])
+        kf, vf = _expand_gqa(kc, vc, c)  # [B, T, H, Dh]
+        scale = 1.0 / (c.head_dim ** 0.5)
+        scores = jnp.einsum("bshk,bthk->bhst", (q * scale).astype(jnp.float32),
+                            kf.astype(jnp.float32))  # [B, H, 1, T]
+        scores = jnp.where(kmask[:, None, None, :], scores, -1e30)
+        p = jax.nn.softmax(scores, axis=-1)
+        o = jnp.einsum("bhst,bthk->bshk", p, vf.astype(jnp.float32)).astype(dt)
+        o = jnp.einsum("bshk,hkd->bsd", o, lp["attn"]["wo"].astype(dt))
+        x = x + o
+        return x + _mlp(x, lp, c, dt), (kc, vc)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        body, x, (params["layers"], cache["k"], cache["v"])
+    )
+    logits = _final_logits(x, params, c, dt)[:, 0]  # [B, V]
+    greedy = logits.argmax(-1).astype(jnp.int32)
+    temp = jnp.clip(temperature, 1e-6, None)[:, None]
+    keys = jax.random.split(rng, B)
+    sampled = jax.vmap(jax.random.categorical)(keys, logits / temp).astype(jnp.int32)
+    toks = jnp.where(temperature <= 0.0, greedy, sampled)
+    return toks, logits, {"k": k_new, "v": v_new}

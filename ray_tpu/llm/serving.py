@@ -1,0 +1,139 @@
+"""OpenAI-compatible serving on top of ray_tpu.serve.
+
+Counterpart of the reference's ray.llm serving stack (reference:
+python/ray/llm/_internal/serve/ — LLMServer deployment + router building
+an OpenAI-compatible app over Serve; placement-group-backed engine
+replicas, serve/deployments/llm/vllm/vllm_models.py:159). Here each
+replica hosts a JAX LLMEngine; requests hit the Serve HTTP proxy and are
+dispatched by payload shape (the proxy forwards JSON bodies):
+
+  {"messages": [...]}  → chat completion   (POST /v1/chat/completions)
+  {"prompt": "..."}    → text completion   (POST /v1/completions)
+  anything else        → model listing     (GET /v1/models)
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Any
+
+from ray_tpu.llm.config import LLMConfig, SamplingParams
+from ray_tpu.llm.engine import LLMEngine
+from ray_tpu.serve.deployment import deployment
+
+
+def _as_list(v) -> list:
+    if v is None:
+        return []
+    return v if isinstance(v, list) else [v]
+
+
+class LLMServer:
+    """One engine per replica; scale via num_replicas in build_openai_app."""
+
+    def __init__(self, config: LLMConfig, params: Any = None):
+        self.config = config
+        self.engine = LLMEngine(config, params)
+
+    # -- OpenAI schema helpers --------------------------------------------
+
+    def _sampling(self, payload: dict) -> SamplingParams:
+        d = self.config.sampling_defaults
+        stop_ids = tuple(payload.get("stop_token_ids", d.stop_token_ids))
+        # OpenAI "stop" strings: supported for stops that tokenize to a
+        # single id (the engine stops on token ids, not substrings).
+        for s in _as_list(payload.get("stop")):
+            toks = self.engine.tokenizer.encode(s, add_bos=False)
+            if len(toks) == 1:
+                stop_ids += (toks[0],)
+        return SamplingParams(
+            max_tokens=int(payload.get("max_tokens", d.max_tokens)),
+            temperature=float(payload.get("temperature", d.temperature)),
+            stop_token_ids=stop_ids,
+        )
+
+    def _render_chat(self, messages: list[dict]) -> str:
+        # Minimal chat template (byte tokenizer has no special chat tokens).
+        parts = [f"{m.get('role', 'user')}: {m.get('content', '')}"
+                 for m in messages]
+        parts.append("assistant:")
+        return "\n".join(parts)
+
+    def _usage(self, outs: list) -> dict:
+        p = sum(o.num_prompt_tokens for o in outs)
+        c = sum(len(o.token_ids) for o in outs)
+        return {"prompt_tokens": p, "completion_tokens": c,
+                "total_tokens": p + c}
+
+    # -- entrypoint (Serve routes JSON bodies here) -----------------------
+
+    def __call__(self, payload: Any = None) -> dict:
+        payload = payload if isinstance(payload, dict) else {}
+        if "messages" in payload:
+            return self.chat(payload)
+        if "prompt" in payload:
+            return self.completions(payload)
+        return self.models()
+
+    def models(self) -> dict:
+        return {
+            "object": "list",
+            "data": [{
+                "id": self.config.model_id,
+                "object": "model",
+                "owned_by": "ray_tpu",
+            }],
+        }
+
+    def completions(self, payload: dict) -> dict:
+        prompt = payload["prompt"]
+        # OpenAI accepts: a string, a list of strings, a token array
+        # (list of ints = ONE pre-tokenized prompt), or a list of token
+        # arrays.
+        if isinstance(prompt, list) and prompt and all(
+            isinstance(t, int) for t in prompt
+        ):
+            prompts = [prompt]
+        elif isinstance(prompt, list):
+            prompts = prompt
+        else:
+            prompts = [prompt]
+        outs = self.engine.generate(prompts, self._sampling(payload))
+        return {
+            "id": f"cmpl-{uuid.uuid4().hex[:24]}",
+            "object": "text_completion",
+            "created": int(time.time()),
+            "model": self.config.model_id,
+            "choices": [
+                {"index": i, "text": o.text, "finish_reason": o.finish_reason}
+                for i, o in enumerate(outs)
+            ],
+            "usage": self._usage(outs),
+        }
+
+    def chat(self, payload: dict) -> dict:
+        prompt = self._render_chat(payload["messages"])
+        out = self.engine.generate([prompt], self._sampling(payload))[0]
+        return {
+            "id": f"chatcmpl-{uuid.uuid4().hex[:24]}",
+            "object": "chat.completion",
+            "created": int(time.time()),
+            "model": self.config.model_id,
+            "choices": [{
+                "index": 0,
+                "message": {"role": "assistant", "content": out.text},
+                "finish_reason": out.finish_reason,
+            }],
+            "usage": self._usage([out]),
+        }
+
+
+def build_openai_app(config: LLMConfig, *, num_replicas: int = 1,
+                     name: str | None = None):
+    """Serve Application exposing the OpenAI API under /v1 (reference:
+    ray.serve.llm build_openai_app). Run with serve.run(app,
+    route_prefix=\"/v1\")."""
+    dep = deployment(LLMServer, name=name or f"llm:{config.model_id}",
+                     num_replicas=num_replicas)
+    return dep.bind(config)

@@ -1,0 +1,79 @@
+"""ray_tpu.llm end-to-end: OpenAI HTTP serving via Serve, Data batch stage.
+
+Reference analogue: ray.llm serve integration tests + batch processor
+tests (python/ray/llm/tests/).
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.llm import LLMConfig, SamplingParams, build_llm_processor, build_openai_app
+from ray_tpu.models import transformer as tfm
+
+
+def tiny_config(**kw):
+    defaults = dict(
+        model=tfm.tiny(vocab_size=512, max_seq_len=128),
+        max_num_seqs=2,
+        max_seq_len=48,
+        prefill_buckets=(8, 16, 32),
+        sampling_defaults=SamplingParams(max_tokens=4),
+    )
+    defaults.update(kw)
+    return LLMConfig(**defaults)
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _cluster():
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=8, object_store_memory=128 * 1024 * 1024)
+    yield
+    try:
+        serve.shutdown()
+    finally:
+        ray_tpu.shutdown()
+
+
+def _post(url: str, payload: dict) -> dict:
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=120) as r:
+        return json.loads(r.read())
+
+
+def test_openai_http_endpoints():
+    app = build_openai_app(tiny_config())
+    serve.run(app, route_prefix="/v1")
+    port = serve.get_proxy_port()
+    base = f"http://127.0.0.1:{port}/v1"
+
+    r = _post(f"{base}/completions", {"prompt": "hello", "max_tokens": 3})
+    assert r["object"] == "text_completion"
+    assert r["usage"]["completion_tokens"] <= 3
+
+    r = _post(f"{base}/chat/completions",
+              {"messages": [{"role": "user", "content": "hi"}], "max_tokens": 3})
+    assert r["object"] == "chat.completion"
+
+    with urllib.request.urlopen(f"{base}/models", timeout=60) as resp:
+        r = json.loads(resp.read())
+    assert r["object"] == "list"
+
+
+def test_batch_inference_over_dataset():
+    import ray_tpu.data as rdata
+
+    ds = rdata.from_items([{"prompt": f"p{i}"} for i in range(6)])
+    ds = build_llm_processor(ds, tiny_config(), batch_size=3)
+    rows = ds.take_all()
+    assert len(rows) == 6
+    assert all(isinstance(r["generated_text"], str) for r in rows)
