@@ -64,10 +64,14 @@ class LLMConfig:
                     f"a factory in ray_tpu.models.transformer"
                 )
             cfg = factory()
-            if self.tokenizer == "byte" and cfg.vocab_size < 512:
-                # Factory-named models are randomly initialized, so the
-                # vocab can be grown to fit the byte tokenizer's specials
-                # (259 ids; 512 keeps the lm_head MXU-tile aligned).
+            if (self.tokenizer == "byte" and cfg.vocab_size < 512
+                    and not self.checkpoint_path):
+                # Factory-named models with no checkpoint are randomly
+                # initialized, so the vocab can be grown to fit the byte
+                # tokenizer's specials (259 ids; 512 keeps the lm_head
+                # MXU-tile aligned). With a checkpoint the config must
+                # match the saved shapes — the engine's vocab guard then
+                # reports the mismatch loudly instead.
                 cfg = dataclasses.replace(cfg, vocab_size=512)
         else:
             raise TypeError(f"model must be TransformerConfig or str, got {type(self.model)}")

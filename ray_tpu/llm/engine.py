@@ -58,7 +58,18 @@ class LLMEngine:
         self.model_config = config.resolve_model()
         self.tokenizer = load_tokenizer(config.tokenizer)
         c = self.model_config
-        tok_vocab = getattr(self.tokenizer, "vocab_size", None)
+        if c.n_experts > 0:
+            raise NotImplementedError(
+                "MoE decode is not wired into the slot engine yet; "
+                "train with MoE (models.transformer + Train) and serve dense."
+            )
+        # len(tokenizer) counts added special tokens on HF tokenizers;
+        # vocab_size alone excludes them and would let special-token ids
+        # silently clamp in the embedding gather.
+        try:
+            tok_vocab = len(self.tokenizer)
+        except TypeError:
+            tok_vocab = getattr(self.tokenizer, "vocab_size", None)
         if tok_vocab is not None and tok_vocab > c.vocab_size:
             raise ValueError(
                 f"tokenizer vocab ({tok_vocab}, incl. special tokens) exceeds "

@@ -29,6 +29,15 @@ def _as_list(v) -> list:
     return v if isinstance(v, list) else [v]
 
 
+def _encode_plain(tok, s: str) -> list[int]:
+    """Encode without special tokens, across ByteTokenizer (add_bos kwarg)
+    and HF tokenizers (add_special_tokens kwarg)."""
+    try:
+        return tok.encode(s, add_bos=False)
+    except TypeError:
+        return tok.encode(s, add_special_tokens=False)
+
+
 class LLMServer:
     """One engine per replica; scale via num_replicas in build_openai_app."""
 
@@ -44,7 +53,7 @@ class LLMServer:
         # OpenAI "stop" strings: supported for stops that tokenize to a
         # single id (the engine stops on token ids, not substrings).
         for s in _as_list(payload.get("stop")):
-            toks = self.engine.tokenizer.encode(s, add_bos=False)
+            toks = _encode_plain(self.engine.tokenizer, s)
             if len(toks) == 1:
                 stop_ids += (toks[0],)
         return SamplingParams(

@@ -18,6 +18,9 @@ class ByteTokenizer:
     EOS = 258
     vocab_size = 259
 
+    def __len__(self) -> int:
+        return self.vocab_size
+
     @property
     def eos_token_id(self) -> int:
         return self.EOS

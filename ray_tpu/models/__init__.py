@@ -22,12 +22,16 @@ from ray_tpu.models.transformer import (
     llama3_8b,
     lm_loss,
     make_train_step,
+    moe_small,
     partition_specs,
     tiny,
+    tiny_moe,
 )
 
 __all__ = [
     "TransformerConfig",
+    "moe_small",
+    "tiny_moe",
     "cross_entropy_loss",
     "decode_step",
     "forward",

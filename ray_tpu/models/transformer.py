@@ -44,7 +44,13 @@ from ray_tpu.ops.layers import (
     rope_frequencies,
     swiglu,
 )
-from ray_tpu.parallel.mesh import AXIS_DATA, AXIS_FSDP, AXIS_SEQUENCE, AXIS_TENSOR
+from ray_tpu.parallel.mesh import (
+    AXIS_DATA,
+    AXIS_EXPERT,
+    AXIS_FSDP,
+    AXIS_SEQUENCE,
+    AXIS_TENSOR,
+)
 from ray_tpu.parallel.sharding import constrain
 
 
@@ -70,6 +76,12 @@ class TransformerConfig:
     # 1/3 FLOP overhead. Small models should prefer "dots".
     remat_policy: str = "full"       # "full" | "dots"
     scan_layers: bool = True         # lax.scan over layers vs unrolled loop
+    # Mixture of Experts (llama arch only; 0 = dense FFN). Greenfield vs
+    # the reference (SURVEY.md §2.4: EP absent upstream) — see ops/moe.py.
+    n_experts: int = 0
+    expert_top_k: int = 2
+    expert_capacity_factor: float = 1.25
+    router_aux_weight: float = 0.01
 
     @property
     def kv_heads(self) -> int:
@@ -150,6 +162,26 @@ def llama3_8b(**kw) -> TransformerConfig:
     )
 
 
+def moe_small(**kw) -> TransformerConfig:
+    """Mixtral-style MoE on the small-llama geometry: 8 experts, top-2.
+    Per-token FLOPs ≈ dense small; total params ≈ 8× the FFN stack."""
+    defaults = dict(
+        vocab_size=32000, n_layers=12, d_model=768, n_heads=12,
+        max_seq_len=2048, arch="llama", n_experts=8, expert_top_k=2,
+    )
+    defaults.update(kw)
+    return TransformerConfig(**defaults)
+
+
+def tiny_moe(**kw) -> TransformerConfig:
+    defaults = dict(
+        vocab_size=256, n_layers=2, d_model=64, n_heads=4, max_seq_len=128,
+        arch="llama", n_experts=4, expert_top_k=2,
+    )
+    defaults.update(kw)
+    return TransformerConfig(**defaults)
+
+
 def tiny(**kw) -> TransformerConfig:
     """Test-sized model (CI on the 8-device CPU mesh)."""
     return replace(
@@ -171,6 +203,8 @@ def init_params(rng, config: TransformerConfig):
     1/sqrt(2*n_layers).
     """
     c = config
+    if c.n_experts > 0 and c.arch != "llama":
+        raise ValueError("MoE (n_experts > 0) requires arch='llama'")
     pdt = jnp.dtype(c.param_dtype)
     L, D, H, KV, Dh, F = (
         c.n_layers, c.d_model, c.n_heads, c.kv_heads, c.head_dim, c.ffn_dim,
@@ -212,11 +246,20 @@ def init_params(rng, config: TransformerConfig):
     else:
         params["layers"]["ln1"] = {"w": jnp.ones((L, D), pdt)}
         params["layers"]["ln2"] = {"w": jnp.ones((L, D), pdt)}
-        params["layers"]["mlp"] = {
-            "w_gate": norm(next(keys), L, D, F),
-            "w_up": norm(next(keys), L, D, F),
-            "w_down": norm(next(keys), L, F, D, s=res_std),
-        }
+        if c.n_experts > 0:
+            E = c.n_experts
+            params["layers"]["router"] = {"w": norm(next(keys), L, D, E)}
+            params["layers"]["mlp"] = {
+                "w_gate": norm(next(keys), L, E, D, F),
+                "w_up": norm(next(keys), L, E, D, F),
+                "w_down": norm(next(keys), L, E, F, D, s=res_std),
+            }
+        else:
+            params["layers"]["mlp"] = {
+                "w_gate": norm(next(keys), L, D, F),
+                "w_up": norm(next(keys), L, D, F),
+                "w_down": norm(next(keys), L, F, D, s=res_std),
+            }
     if not c.tied:
         params["lm_head"] = norm(next(keys), D, c.vocab_size)
     return params
@@ -255,6 +298,13 @@ def partition_specs(config: TransformerConfig):
             "w_out": P(None, AXIS_TENSOR, None),
             "b_out": None,
         }
+    elif c.n_experts > 0:
+        specs["layers"]["router"] = {"w": P(None, None, None)}
+        specs["layers"]["mlp"] = {
+            "w_gate": P(None, AXIS_EXPERT, None, AXIS_TENSOR),
+            "w_up": P(None, AXIS_EXPERT, None, AXIS_TENSOR),
+            "w_down": P(None, AXIS_EXPERT, AXIS_TENSOR, None),
+        }
     else:
         specs["layers"]["mlp"] = {
             "w_gate": P(None, None, AXIS_TENSOR),
@@ -285,11 +335,13 @@ _BATCH = (AXIS_DATA, AXIS_FSDP)
 
 
 def forward(params, tokens, config: TransformerConfig, *, mesh=None,
-            positions=None):
+            positions=None, return_aux: bool = False):
     """Logits for ``tokens`` [B, T] → [B, T, vocab] (float32).
 
     ``mesh`` adds with_sharding_constraint annotations on activations
     (batch over data+fsdp, heads/ffn over tensor); pass None outside pjit.
+    ``return_aux`` additionally returns the mean per-layer router
+    load-balance loss (MoE models; 0 for dense).
     """
     c = config
     dt = c.compute_dtype
@@ -325,15 +377,18 @@ def forward(params, tokens, config: TransformerConfig, *, mesh=None,
             layer = jax.checkpoint(layer)
 
     if c.scan_layers:
-        x, _ = jax.lax.scan(lambda h, lp: (layer(h, lp), None), x,
-                            params["layers"])
+        x, auxs = jax.lax.scan(lambda h, lp: layer(h, lp), x,
+                               params["layers"])
+        aux = auxs.mean()
     else:
         # Unrolled: larger compile, but lets XLA schedule across layer
         # boundaries (and sidesteps scan-differentiation limits on some
         # backends when remat is off).
+        aux = jnp.zeros((), jnp.float32)
         for i in range(c.n_layers):
             lp = jax.tree.map(lambda a, i=i: a[i], params["layers"])
-            x = layer(x, lp)
+            x, aux_i = layer(x, lp)
+            aux = aux + aux_i / c.n_layers
 
     if c.arch == "gpt2":
         x = layer_norm(x, params["final_norm"]["w"], params["final_norm"]["b"])
@@ -342,7 +397,8 @@ def forward(params, tokens, config: TransformerConfig, *, mesh=None,
     head = (params["embed"]["tokens"].T if c.tied else params["lm_head"])
     logits = jnp.einsum("btd,dv->btv", x, head.astype(dt),
                         preferred_element_type=jnp.float32)
-    return con(logits, _BATCH, AXIS_SEQUENCE, AXIS_TENSOR)
+    logits = con(logits, _BATCH, AXIS_SEQUENCE, AXIS_TENSOR)
+    return (logits, aux) if return_aux else logits
 
 
 def _block(x, lp, c: TransformerConfig, *, rope, con, positions=None):
@@ -377,15 +433,26 @@ def _block(x, lp, c: TransformerConfig, *, rope, con, positions=None):
     o = jnp.einsum("bthk,hkd->btd", o, lp["attn"]["wo"].astype(dt))
     x = x + o
 
+    aux = jnp.zeros((), jnp.float32)
     if c.arch == "gpt2":
         h = layer_norm(x, lp["ln2"]["w"], lp["ln2"]["b"])
         m = gelu_mlp(h, lp["mlp"]["w_in"].astype(dt), lp["mlp"]["b_in"].astype(dt),
                      lp["mlp"]["w_out"].astype(dt), lp["mlp"]["b_out"].astype(dt))
+    elif c.n_experts > 0:
+        from ray_tpu.ops.moe import moe_swiglu
+
+        h = rms_norm(x, lp["ln2"]["w"])
+        m, aux = moe_swiglu(
+            h, lp["router"]["w"], lp["mlp"]["w_gate"], lp["mlp"]["w_up"],
+            lp["mlp"]["w_down"], top_k=c.expert_top_k,
+            capacity_factor=c.expert_capacity_factor,
+            constrain_fn=lambda t: con(t, AXIS_EXPERT, None, None),
+        )
     else:
         h = rms_norm(x, lp["ln2"]["w"])
         m = swiglu(h, lp["mlp"]["w_gate"].astype(dt), lp["mlp"]["w_up"].astype(dt),
                    lp["mlp"]["w_down"].astype(dt))
-    return x + m
+    return x + m, aux
 
 
 def _expand_gqa(k, v, c: TransformerConfig):
@@ -435,8 +502,12 @@ def lm_loss(params, batch, config: TransformerConfig, *, mesh=None,
         mask = batch.get("mask")
         if mask is not None:
             mask = mask[:, 1:]
-    logits = forward(params, inp, config, mesh=mesh)
-    return cross_entropy_loss(logits, tgt, mask=mask, z_loss=z_loss)
+    logits, aux = forward(params, inp, config, mesh=mesh, return_aux=True)
+    loss, metrics = cross_entropy_loss(logits, tgt, mask=mask, z_loss=z_loss)
+    if config.n_experts > 0:
+        loss = loss + config.router_aux_weight * aux
+        metrics = dict(metrics, router_aux=aux, loss=loss)
+    return loss, metrics
 
 
 def make_train_step(config: TransformerConfig, optimizer, *, mesh=None,
@@ -488,6 +559,10 @@ def init_train_state(rng, config: TransformerConfig, optimizer):
 def init_kv_cache(config: TransformerConfig, batch_size: int, max_len: int):
     """Preallocated decode cache: [L, B, max_len, KV, Dh] per k/v."""
     c = config
+    if c.n_experts > 0:
+        raise NotImplementedError(
+            "KV-cache decode for MoE models is not implemented yet"
+        )
     shape = (c.n_layers, batch_size, max_len, c.kv_heads, c.head_dim)
     return {
         "k": jnp.zeros(shape, c.compute_dtype),

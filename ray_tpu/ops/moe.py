@@ -1,0 +1,102 @@
+"""Mixture-of-Experts ops: top-k routing with static-shape dispatch.
+
+The reference has no expert parallelism anywhere (SURVEY.md §2.4: EP —
+"Absent"; vLLM handles MoE internally for inference only), so this is
+greenfield, built the TPU way (GShard/Switch-style): routing is expressed
+as dense one-hot dispatch/combine einsums over a fixed per-expert
+capacity — every shape static, every op an MXU matmul or a cheap
+elementwise, zero dynamic gathers. Under a mesh, the expert dimension of
+the dispatched activations is sharded over the ``expert`` axis
+(parallel.mesh.AXIS_EXPERT) and GSPMD lowers the dispatch/combine
+einsums into ``all_to_all`` collectives over ICI.
+
+Aux (load-balance) loss follows Switch Transformer: E * Σ_e f_e · p_e,
+where f_e is the fraction of tokens routed to expert e and p_e the mean
+router probability — minimized when routing is uniform.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+def expert_capacity(num_tokens: int, num_experts: int, top_k: int,
+                    capacity_factor: float) -> int:
+    """Per-expert token slots, rounded up to a multiple of 8 (lane-friendly)."""
+    c = int(math.ceil(top_k * num_tokens / num_experts * capacity_factor))
+    return max(8, ((c + 7) // 8) * 8)
+
+
+def topk_dispatch(router_logits, top_k: int, capacity: int):
+    """Build dispatch/combine tensors from router logits [G, E].
+
+    Returns (dispatch [G, E, C] float, combine [G, E, C] float, aux_loss
+    scalar). Tokens are assigned to their top-k experts in choice order;
+    each expert has C slots filled first-come-first-served (position =
+    running count of earlier tokens choosing it); overflow tokens are
+    dropped for that expert (their combine weight is 0 → they pass
+    through the residual unchanged, the standard Switch behavior).
+    """
+    G, E = router_logits.shape
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+    topv, topi = jax.lax.top_k(probs, top_k)  # [G, k]
+    # Renormalize the selected gates so combine weights sum to 1 per token.
+    topv = topv / jnp.maximum(topv.sum(-1, keepdims=True), 1e-9)
+
+    counts = jnp.zeros((E,), jnp.int32)
+    dispatch = jnp.zeros((G, E, capacity), jnp.float32)
+    combine = jnp.zeros((G, E, capacity), jnp.float32)
+    for j in range(top_k):  # unrolled: top_k is tiny (1 or 2 typically)
+        oh = jax.nn.one_hot(topi[:, j], E, dtype=jnp.int32)  # [G, E]
+        pos = jnp.cumsum(oh, axis=0) - 1 + counts[None, :]  # slot per token
+        counts = counts + oh.sum(axis=0)
+        # Slot index at the chosen expert; capacity overflow → index C,
+        # which one_hot maps to an all-zero row (the token is dropped).
+        pos_sel = (pos * oh).sum(-1)  # [G]
+        kept = ((pos < capacity) & (oh > 0)).any(-1)
+        slot = jax.nn.one_hot(jnp.where(kept, pos_sel, capacity),
+                              capacity, dtype=jnp.float32)  # [G, C]
+        d_j = oh.astype(jnp.float32)[:, :, None] * slot[:, None, :]
+        dispatch = dispatch + d_j
+        combine = combine + topv[:, j][:, None, None] * d_j
+
+    # Switch aux loss on the FULL probability mass (pre-top-k).
+    frac_routed = dispatch.sum(axis=(0, 2)) / jnp.maximum(G, 1)  # f_e
+    mean_prob = probs.mean(axis=0)  # p_e
+    aux = E * jnp.sum(frac_routed * mean_prob)
+    return dispatch, combine, aux
+
+
+def moe_swiglu(x, router_w, w_gate, w_up, w_down, *, top_k: int,
+               capacity_factor: float = 1.25, constrain_fn=None):
+    """MoE SwiGLU FFN for one layer.
+
+    x [B, S, D]; router_w [D, E]; w_gate/w_up [E, D, F]; w_down [E, F, D].
+    Returns (out [B, S, D], aux_loss scalar). ``constrain_fn`` (optional)
+    annotates the [E, C, D] dispatched activations with the expert-axis
+    sharding so GSPMD inserts the all_to_alls.
+    """
+    B, S, D = x.shape
+    E = router_w.shape[-1]
+    G = B * S
+    dt = x.dtype
+    xg = x.reshape(G, D)
+    logits = xg.astype(jnp.float32) @ router_w.astype(jnp.float32)
+    C = expert_capacity(G, E, top_k, capacity_factor)
+    dispatch, combine, aux = topk_dispatch(logits, top_k, C)
+    # Dispatch: [G,E,C] × [G,D] → [E,C,D] (one big MXU matmul).
+    ein = xg.astype(jnp.float32)
+    expert_in = jnp.einsum("gec,gd->ecd", dispatch, ein).astype(dt)
+    if constrain_fn is not None:
+        expert_in = constrain_fn(expert_in)
+    g = jax.nn.silu(jnp.einsum("ecd,edf->ecf", expert_in, w_gate.astype(dt)))
+    u = jnp.einsum("ecd,edf->ecf", expert_in, w_up.astype(dt))
+    expert_out = jnp.einsum("ecf,efd->ecd", g * u, w_down.astype(dt))
+    if constrain_fn is not None:
+        expert_out = constrain_fn(expert_out)
+    out = jnp.einsum("gec,ecd->gd", combine,
+                     expert_out.astype(jnp.float32)).astype(dt)
+    return out.reshape(B, S, D), aux
