@@ -86,7 +86,7 @@ class CoreRuntime:
         else:
             self.shm = None
         self._fn_cache: dict[str, Any] = {}
-        self._fn_ids: dict[int, str] = {}  # id(fn) -> func_id
+        self._fn_ids: dict = {}  # id(fn) -> (weakref(fn), func_id)
         ids_mod.set_ref_removed_callback(self._on_ref_removed)
 
     # ------------------------------------------------------------------
@@ -277,13 +277,26 @@ class CoreRuntime:
     # functions
 
     def register_function(self, fn: Any) -> str:
+        # The id-keyed fast path must not outlive fn: a GC'd function's
+        # address can be reused by a brand-new function, which would then
+        # resolve to the WRONG func_id (observed with functions
+        # deserialized in a loop, e.g. workflow step replay). A weakref
+        # both validates identity and evicts the entry on collection —
+        # no pinning, no unbounded growth.
+        import weakref
+
         cached = self._fn_ids.get(id(fn))
-        if cached is not None:
-            return cached
+        if cached is not None and cached[0]() is fn:
+            return cached[1]
         blob = cloudpickle.dumps(fn)
         func_id = "fn:" + hashlib.sha256(blob).hexdigest()[:32]
         self.conn.call("kv_put", {"ns": "__functions__", "key": func_id, "value": blob, "overwrite": False})
-        self._fn_ids[id(fn)] = func_id
+        try:
+            key = id(fn)
+            ref = weakref.ref(fn, lambda _, k=key: self._fn_ids.pop(k, None))
+            self._fn_ids[key] = (ref, func_id)
+        except TypeError:
+            pass  # not weakref-able: skip the fast path; content hash dedups
         self._fn_cache[func_id] = fn
         return func_id
 

@@ -30,12 +30,15 @@ def _as_list(v) -> list:
 
 
 def _encode_plain(tok, s: str) -> list[int]:
-    """Encode without special tokens, across ByteTokenizer (add_bos kwarg)
-    and HF tokenizers (add_special_tokens kwarg)."""
-    try:
+    """Encode without special tokens. Dispatch on type, NOT try/except:
+    HF slow tokenizers silently swallow unknown kwargs like add_bos
+    (they only log a warning), which would leave add_special_tokens=True
+    and silently break single-token stop detection."""
+    from ray_tpu.llm.tokenizer import ByteTokenizer
+
+    if isinstance(tok, ByteTokenizer):
         return tok.encode(s, add_bos=False)
-    except TypeError:
-        return tok.encode(s, add_special_tokens=False)
+    return tok.encode(s, add_special_tokens=False)
 
 
 class LLMServer:

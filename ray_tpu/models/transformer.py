@@ -446,7 +446,9 @@ def _block(x, lp, c: TransformerConfig, *, rope, con, positions=None):
             h, lp["router"]["w"], lp["mlp"]["w_gate"], lp["mlp"]["w_up"],
             lp["mlp"]["w_down"], top_k=c.expert_top_k,
             capacity_factor=c.expert_capacity_factor,
-            constrain_fn=lambda t: con(t, AXIS_EXPERT, None, None),
+            # Group count n can be 1 (< data-axis size), so only the
+            # expert dim is constrained; GSPMD lays out the rest.
+            constrain_fn=lambda t: con(t, None, AXIS_EXPERT, None, None),
         )
     else:
         h = rms_norm(x, lp["ln2"]["w"])

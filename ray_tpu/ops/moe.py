@@ -70,33 +70,53 @@ def topk_dispatch(router_logits, top_k: int, capacity: int):
     return dispatch, combine, aux
 
 
+def _group_size(total: int, target: int) -> int:
+    """Largest divisor of ``total`` that is <= target (trace-time)."""
+    g = min(target, total)
+    while total % g:
+        g -= 1
+    return g
+
+
 def moe_swiglu(x, router_w, w_gate, w_up, w_down, *, top_k: int,
-               capacity_factor: float = 1.25, constrain_fn=None):
+               capacity_factor: float = 1.25, group_size: int = 1024,
+               constrain_fn=None):
     """MoE SwiGLU FFN for one layer.
 
     x [B, S, D]; router_w [D, E]; w_gate/w_up [E, D, F]; w_down [E, F, D].
-    Returns (out [B, S, D], aux_loss scalar). ``constrain_fn`` (optional)
-    annotates the [E, C, D] dispatched activations with the expert-axis
-    sharding so GSPMD inserts the all_to_alls.
+    Returns (out [B, S, D], aux_loss scalar).
+
+    Tokens are processed in GROUPS of ~``group_size`` (GShard-style):
+    dispatch/combine are [n, g, E, C_g] with C_g ∝ g, so memory and
+    dispatch FLOPs scale O(G·g) instead of the O(G²) a single global
+    dispatch would cost — the difference between fitting seq-2048
+    batches in HBM and not. ``constrain_fn`` (optional) annotates the
+    [n, E, C, D] dispatched activations (group dim batch-sharded, expert
+    dim over the expert axis) so GSPMD inserts the all_to_alls.
     """
     B, S, D = x.shape
     E = router_w.shape[-1]
     G = B * S
     dt = x.dtype
-    xg = x.reshape(G, D)
-    logits = xg.astype(jnp.float32) @ router_w.astype(jnp.float32)
-    C = expert_capacity(G, E, top_k, capacity_factor)
-    dispatch, combine, aux = topk_dispatch(logits, top_k, C)
-    # Dispatch: [G,E,C] × [G,D] → [E,C,D] (one big MXU matmul).
+    g = _group_size(G, group_size)
+    n = G // g
+    xg = x.reshape(n, g, D)
+    logits = jnp.einsum("ngd,de->nge", xg.astype(jnp.float32),
+                        router_w.astype(jnp.float32))
+    C = expert_capacity(g, E, top_k, capacity_factor)
+    dispatch, combine, aux = jax.vmap(
+        lambda lg: topk_dispatch(lg, top_k, C)
+    )(logits)  # [n, g, E, C] ×2, aux [n]
     ein = xg.astype(jnp.float32)
-    expert_in = jnp.einsum("gec,gd->ecd", dispatch, ein).astype(dt)
+    expert_in = jnp.einsum("ngec,ngd->necd", dispatch, ein).astype(dt)
     if constrain_fn is not None:
         expert_in = constrain_fn(expert_in)
-    g = jax.nn.silu(jnp.einsum("ecd,edf->ecf", expert_in, w_gate.astype(dt)))
-    u = jnp.einsum("ecd,edf->ecf", expert_in, w_up.astype(dt))
-    expert_out = jnp.einsum("ecf,efd->ecd", g * u, w_down.astype(dt))
+    gate = jax.nn.silu(jnp.einsum("necd,edf->necf", expert_in,
+                                  w_gate.astype(dt)))
+    up = jnp.einsum("necd,edf->necf", expert_in, w_up.astype(dt))
+    expert_out = jnp.einsum("necf,efd->necd", gate * up, w_down.astype(dt))
     if constrain_fn is not None:
         expert_out = constrain_fn(expert_out)
-    out = jnp.einsum("gec,ecd->gd", combine,
+    out = jnp.einsum("ngec,necd->ngd", combine,
                      expert_out.astype(jnp.float32)).astype(dt)
-    return out.reshape(B, S, D), aux
+    return out.reshape(B, S, D), aux.mean()

@@ -1,0 +1,405 @@
+"""Durable workflows: checkpointed DAG execution with resume.
+
+Counterpart of the reference's python/ray/workflow (api.py `workflow.run`
+/ `run_async` / `resume` / `get_output` / `list_all`; step results
+durably logged to storage so a crashed driver resumes where it left off;
+dynamic workflows via `workflow.continuation`). Implementation here:
+
+  - A DAG built with ``fn.bind(...)`` is *frozen* into a JSON-safe spec
+    (functions cloudpickled, upstream edges by step id) and persisted, so
+    resume does not need the original driver process.
+  - Steps execute as cluster tasks (``fn.remote``) level-by-level
+    (independent steps run in parallel); each result is written to
+    storage before any dependent is submitted — the workflow is
+    re-entrant at step granularity.
+  - A step returning ``workflow.continuation(dag)`` splices the new
+    sub-DAG in durably (dynamic workflows).
+
+Storage layout (filesystem; base dir from RAY_TPU_WORKFLOW_DIR):
+    {base}/{workflow_id}/dag.pkl         frozen spec (grows with continuations)
+    {base}/{workflow_id}/meta.json       status: RUNNING | SUCCESS | FAILED
+    {base}/{workflow_id}/steps/{sid}.pkl durable step results
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any
+
+import cloudpickle
+
+import ray_tpu
+from ray_tpu.dag.nodes import DAGNode, FunctionNode
+
+__all__ = [
+    "run", "run_async", "resume", "resume_async", "get_output",
+    "get_status", "list_all", "delete", "continuation", "Continuation",
+]
+
+
+def _base_dir() -> str:
+    d = os.environ.get("RAY_TPU_WORKFLOW_DIR", "/tmp/ray_tpu/workflows")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+class Continuation:
+    """Marker returned by a step to splice a sub-DAG into the workflow."""
+
+    def __init__(self, dag: DAGNode):
+        if not isinstance(dag, DAGNode):
+            raise TypeError("continuation() takes a DAG node (fn.bind(...))")
+        self.dag = dag
+
+    def __reduce__(self):
+        # Travels worker→driver as its frozen spec (DAGNodes themselves
+        # are not serializable).
+        return (_rebuild_continuation, (_freeze(self.dag),))
+
+
+def _rebuild_continuation(spec):
+    c = Continuation.__new__(Continuation)
+    c.dag = None
+    c.spec = spec
+    return c
+
+
+def continuation(dag: DAGNode) -> Continuation:
+    """Dynamic workflows (reference: workflow.continuation)."""
+    return Continuation(dag)
+
+
+# -- freezing ---------------------------------------------------------------
+
+def _freeze(root: DAGNode) -> dict:
+    """DAG → durable spec {steps: {sid: {fn, args, kwargs, deps}}, output}.
+
+    Only FunctionNode graphs are durable (actor methods hold process
+    state that cannot be replayed from storage — same restriction as the
+    reference's workflow steps being task-based).
+    """
+    steps: dict[str, dict] = {}
+    ids: dict[str, str] = {}  # node uuid -> step id
+    counter = [0]
+
+    def visit(node: DAGNode) -> str:
+        if node._uuid in ids:
+            return ids[node._uuid]
+        if not isinstance(node, FunctionNode):
+            raise TypeError(
+                f"workflows support function steps only (fn.bind); got "
+                f"{type(node).__name__}"
+            )
+        for up in node._upstream():
+            visit(up)
+        fn = node._remote_fn
+        sid = f"{counter[0]:04d}_{getattr(fn, '__name__', 'step')}"
+        counter[0] += 1
+        ids[node._uuid] = sid
+
+        def enc(v):
+            if isinstance(v, DAGNode):
+                return {"__step__": ids[v._uuid]}
+            return {"__val__": cloudpickle.dumps(v).hex()}
+
+        steps[sid] = {
+            "fn": cloudpickle.dumps(fn._fn).hex(),
+            "opts": fn._opts,
+            "args": [enc(a) for a in node._bound_args],
+            "kwargs": {k: enc(v) for k, v in node._bound_kwargs.items()},
+            "deps": sorted({ids[u._uuid] for u in node._upstream()}),
+        }
+        return sid
+
+    out = visit(root)
+    return {"steps": steps, "output": out}
+
+
+# -- storage ----------------------------------------------------------------
+
+class _Store:
+    def __init__(self, workflow_id: str):
+        self.dir = os.path.join(_base_dir(), workflow_id)
+        self.steps_dir = os.path.join(self.dir, "steps")
+        os.makedirs(self.steps_dir, exist_ok=True)
+
+    def save_spec(self, spec: dict) -> None:
+        _atomic_write(os.path.join(self.dir, "dag.pkl"),
+                      cloudpickle.dumps(spec))
+
+    def load_spec(self) -> dict:
+        with open(os.path.join(self.dir, "dag.pkl"), "rb") as f:
+            return cloudpickle.loads(f.read())
+
+    def save_meta(self, **kw) -> None:
+        meta = self.load_meta()
+        meta.update(kw)
+        _atomic_write(os.path.join(self.dir, "meta.json"),
+                      json.dumps(meta).encode())
+
+    def load_meta(self) -> dict:
+        try:
+            with open(os.path.join(self.dir, "meta.json")) as f:
+                return json.load(f)
+        except FileNotFoundError:
+            return {}
+
+    def step_path(self, sid: str) -> str:
+        return os.path.join(self.steps_dir, f"{sid}.pkl")
+
+    def has_step(self, sid: str) -> bool:
+        return os.path.exists(self.step_path(sid))
+
+    def save_step(self, sid: str, value: Any) -> None:
+        _atomic_write(self.step_path(sid), cloudpickle.dumps(value))
+
+    def load_step(self, sid: str) -> Any:
+        with open(self.step_path(sid), "rb") as f:
+            return cloudpickle.loads(f.read())
+
+
+def _atomic_write(path: str, data: bytes) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+    os.replace(tmp, path)
+
+
+# -- execution --------------------------------------------------------------
+
+def _execute(spec: dict, store: _Store) -> Any:
+    """Run all steps not yet in storage, deps-first, parallel within a
+    level. Returns the output step's value."""
+    import ray_tpu.remote_function as rf
+
+    steps = spec["steps"]
+    done: dict[str, Any] = {}
+    pending = set(steps)
+
+    def load_done(sid):
+        done[sid] = store.load_step(sid)
+
+    for sid in list(pending):
+        if store.has_step(sid):
+            load_done(sid)
+            pending.discard(sid)
+
+    while pending:
+        ready = [s for s in pending
+                 if all(d in done for d in steps[s]["deps"])]
+        if not ready:
+            raise RuntimeError(
+                f"workflow deadlock: pending={sorted(pending)} with no "
+                f"satisfiable dependencies"
+            )
+        refs = {}
+        for sid in ready:
+            st = steps[sid]
+            fn = cloudpickle.loads(bytes.fromhex(st["fn"]))
+
+            def dec(v):
+                if "__step__" in v:
+                    return done[v["__step__"]]
+                return cloudpickle.loads(bytes.fromhex(v["__val__"]))
+
+            args = [dec(a) for a in st["args"]]
+            kwargs = {k: dec(v) for k, v in st["kwargs"].items()}
+            remote = rf.RemoteFunction(fn, **(st.get("opts") or {}))
+            refs[sid] = remote.remote(*args, **kwargs)
+        first_error: BaseException | None = None
+        for sid, ref in refs.items():
+            # Persist every successful sibling even when another step in
+            # the same level fails — resume must never replay a step that
+            # already ran (side effects would double-fire).
+            try:
+                value = ray_tpu.get(ref)
+                if isinstance(value, Continuation):
+                    value = _splice_continuation(spec, store, sid, value)
+            except BaseException as e:  # noqa: BLE001
+                first_error = first_error or e
+                continue
+            store.save_step(sid, value)
+            done[sid] = value
+            pending.discard(sid)
+        if first_error is not None:
+            raise first_error
+    return done[spec["output"]]
+
+
+def _splice_continuation(spec: dict, store: _Store, sid: str,
+                         cont: Continuation) -> Any:
+    """Execute a continuation sub-DAG durably; its output becomes the
+    step's value. The merged spec is persisted so resume replays it."""
+    sub = getattr(cont, "spec", None) or _freeze(cont.dag)
+    prefixed = {}
+    for ssid, st in sub["steps"].items():
+        st = dict(st)
+        st["deps"] = [f"{sid}.{d}" for d in st["deps"]]
+        st["args"] = [_prefix_ref(a, sid) for a in st["args"]]
+        st["kwargs"] = {k: _prefix_ref(v, sid) for k, v in st["kwargs"].items()}
+        prefixed[f"{sid}.{ssid}"] = st
+    spec["steps"].update(prefixed)
+    # Persist the FULL merged graph, not the (possibly truncated) spec
+    # this nested _execute is running — a crash between here and the
+    # final save must leave dag.pkl resumable to the real output.
+    full = store.load_spec()
+    full["steps"].update(spec["steps"])
+    store.save_spec(full)
+    # Execute ONLY the continuation's subgraph; passing the full merged
+    # table would re-enter still-pending outer steps and recurse forever.
+    target = f"{sid}.{sub['output']}"
+    needed: set[str] = set()
+    frontier = [target]
+    while frontier:
+        s = frontier.pop()
+        if s in needed:
+            continue
+        needed.add(s)
+        frontier.extend(spec["steps"][s]["deps"])
+    sub_spec = {"steps": {k: spec["steps"][k] for k in needed},
+                "output": target}
+    return _execute(sub_spec, store)
+
+
+def _prefix_ref(v: dict, prefix: str) -> dict:
+    if "__step__" in v:
+        return {"__step__": f"{prefix}.{v['__step__']}"}
+    return v
+
+
+# -- public API -------------------------------------------------------------
+
+def run(dag: DAGNode, *, workflow_id: str | None = None) -> Any:
+    """Execute a DAG durably; blocks until the result is available.
+
+    Re-running a SUCCESS id returns the stored result. Re-running a
+    FAILED/RUNNING id with the *same* DAG resumes it; with a *different*
+    DAG it raises (stale step results from the old graph must not leak
+    into the new one — delete() or pick a fresh id)."""
+    workflow_id = workflow_id or f"workflow-{int(time.time() * 1000):x}"
+    store = _Store(workflow_id)
+    meta = store.load_meta()
+    if meta.get("status") == "SUCCESS":
+        return store.load_step(meta["output"])
+    spec = _freeze(dag)
+    fp = _fingerprint(spec)
+    if meta and meta.get("fingerprint") not in (None, fp):
+        raise ValueError(
+            f"workflow id {workflow_id!r} already exists with a different "
+            f"DAG (status={meta.get('status')}); workflow.delete() it or "
+            f"use a new id"
+        )
+    store.save_spec(spec)
+    store.save_meta(status="RUNNING", output=spec["output"],
+                    fingerprint=fp, created_at=time.time())
+    return _finish(store, spec)
+
+
+def _fingerprint(spec: dict) -> str:
+    import hashlib
+
+    # Hash graph structure + bound argument values but NOT the function
+    # bytecode: cloudpickle bytes are not guaranteed stable across driver
+    # restarts, and a re-run after a code fix SHOULD resume (same
+    # semantics as resume()). Changed args/structure are the hazard.
+    h = hashlib.sha256()
+    for sid in sorted(spec["steps"]):
+        st = spec["steps"][sid]
+        h.update(sid.encode())
+        for a in st["args"]:
+            h.update(json.dumps(a, sort_keys=True).encode())
+        for k in sorted(st["kwargs"]):
+            h.update(k.encode())
+            h.update(json.dumps(st["kwargs"][k], sort_keys=True).encode())
+    return h.hexdigest()[:32]
+
+
+def _finish(store: _Store, spec: dict) -> Any:
+    try:
+        result = _execute(spec, store)
+    except Exception as e:  # noqa: BLE001
+        store.save_meta(status="FAILED", error=repr(e))
+        raise
+    store.save_meta(status="SUCCESS", output=spec["output"])
+    return result
+
+
+def run_async(dag: DAGNode, *, workflow_id: str | None = None) -> Future:
+    workflow_id = workflow_id or f"workflow-{int(time.time() * 1000):x}"
+    fut: Future = Future()
+
+    def target():
+        try:
+            fut.set_result(run(dag, workflow_id=workflow_id))
+        except BaseException as e:  # noqa: BLE001
+            fut.set_exception(e)
+
+    t = threading.Thread(target=target, daemon=True,
+                         name=f"workflow-{workflow_id}")
+    t.start()
+    fut.workflow_id = workflow_id  # type: ignore[attr-defined]
+    return fut
+
+
+def resume(workflow_id: str) -> Any:
+    """Re-run a FAILED/RUNNING workflow from its durable state: completed
+    steps load from storage, the rest re-execute."""
+    store = _Store(workflow_id)
+    meta = store.load_meta()
+    if not meta:
+        raise ValueError(f"no workflow {workflow_id!r} in storage")
+    spec = store.load_spec()
+    store.save_meta(status="RUNNING")
+    return _finish(store, spec)
+
+
+def resume_async(workflow_id: str) -> Future:
+    fut: Future = Future()
+
+    def target():
+        try:
+            fut.set_result(resume(workflow_id))
+        except BaseException as e:  # noqa: BLE001
+            fut.set_exception(e)
+
+    threading.Thread(target=target, daemon=True).start()
+    return fut
+
+
+def get_output(workflow_id: str) -> Any:
+    store = _Store(workflow_id)
+    meta = store.load_meta()
+    if meta.get("status") != "SUCCESS":
+        raise ValueError(
+            f"workflow {workflow_id!r} status={meta.get('status')}; "
+            f"output only available after SUCCESS (use resume())"
+        )
+    return store.load_step(meta["output"])
+
+
+def get_status(workflow_id: str) -> str | None:
+    return _Store(workflow_id).load_meta().get("status")
+
+
+def list_all(status_filter: str | None = None) -> list[tuple[str, str]]:
+    out = []
+    base = _base_dir()
+    for wid in sorted(os.listdir(base)):
+        meta_path = os.path.join(base, wid, "meta.json")
+        if not os.path.exists(meta_path):
+            continue
+        with open(meta_path) as f:
+            status = json.load(f).get("status", "UNKNOWN")
+        if status_filter is None or status == status_filter:
+            out.append((wid, status))
+    return out
+
+
+def delete(workflow_id: str) -> None:
+    import shutil
+
+    shutil.rmtree(os.path.join(_base_dir(), workflow_id), ignore_errors=True)
