@@ -6,8 +6,13 @@ jitted update step — data-parallel scaling is a mesh sharding on the batch,
 not DDP. Algorithms are Tune Trainables (Tuner(PPO, ...) works)."""
 
 from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.algorithms.appo import APPO, APPOConfig
+from ray_tpu.rllib.algorithms.bc import BC, BCConfig
+from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
 from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig, vtrace
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
+from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
+from ray_tpu.rllib.replay_buffer import ReplayBuffer
 from ray_tpu.rllib.core.learner import JaxLearner, LearnerGroup
 from ray_tpu.rllib.core.rl_module import (
     DiscreteActorCriticModule,
@@ -18,8 +23,17 @@ from ray_tpu.rllib.env.env_runner import EnvRunnerGroup, SingleAgentEnvRunner
 from ray_tpu.rllib.sample_batch import SampleBatch, compute_gae
 
 __all__ = [
+    "APPO",
+    "APPOConfig",
     "Algorithm",
     "AlgorithmConfig",
+    "BC",
+    "BCConfig",
+    "DQN",
+    "DQNConfig",
+    "ReplayBuffer",
+    "SAC",
+    "SACConfig",
     "DiscreteActorCriticModule",
     "EnvRunnerGroup",
     "IMPALA",

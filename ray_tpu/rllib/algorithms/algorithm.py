@@ -45,6 +45,10 @@ class AlgorithmConfig:
         # learner
         self.num_learners = 0
         self.mesh = None  # jax Mesh for in-jit data parallelism
+        # action space (filled by _infer_spaces; Box envs set continuous)
+        self.continuous = False
+        self.action_low: Any = None
+        self.action_high: Any = None
         # misc
         self.seed = 0
 
@@ -98,12 +102,25 @@ class AlgorithmConfig:
     def _infer_spaces(self) -> None:
         if self.observation_dim is not None and self.action_dim is not None:
             return
+        if self.env is None:
+            raise ValueError(
+                "no env configured: pass environment(env=...) or explicit "
+                "observation_dim/action_dim (offline algorithms)"
+            )
         from ray_tpu.rllib.env.env_runner import _make_env_fn
 
         env = _make_env_fn(self.env)()
         try:
             self.observation_dim = int(np.prod(env.observation_space.shape))
-            self.action_dim = int(env.action_space.n)
+            space = env.action_space
+            if hasattr(space, "n"):  # Discrete
+                self.action_dim = int(space.n)
+                self.continuous = False
+            else:  # Box
+                self.action_dim = int(np.prod(space.shape))
+                self.continuous = True
+                self.action_low = np.asarray(space.low, np.float32)
+                self.action_high = np.asarray(space.high, np.float32)
         finally:
             try:
                 env.close()
@@ -115,6 +132,7 @@ class AlgorithmConfig:
             observation_dim=self.observation_dim,
             action_dim=self.action_dim,
             hidden=tuple(self.model.get("hidden", (64, 64))),
+            module_class=getattr(self, "module_class", None),
         )
 
     def copy(self) -> "AlgorithmConfig":
@@ -155,7 +173,8 @@ class Algorithm(Trainable):
 
     def setup(self, config: dict) -> None:
         cfg = self.algo_config
-        self.env_runner_group = EnvRunnerGroup(cfg)
+        # Offline algorithms (BC/CQL-style) may have no env at all.
+        self.env_runner_group = EnvRunnerGroup(cfg) if cfg.env is not None else None
         self._rng = np.random.default_rng(cfg.seed)
         self.build_learner(cfg)  # algorithm-specific
 
@@ -167,7 +186,8 @@ class Algorithm(Trainable):
 
     def step(self) -> dict:
         result = self.training_step()
-        result.update(self.env_runner_group.get_metrics())
+        if self.env_runner_group is not None:
+            result.update(self.env_runner_group.get_metrics())
         return result
 
     def train(self) -> dict:  # Trainable.train adds iteration bookkeeping
@@ -190,7 +210,8 @@ class Algorithm(Trainable):
         return self.learner_group.get_weights()
 
     def cleanup(self) -> None:
-        self.env_runner_group.stop()
+        if getattr(self, "env_runner_group", None) is not None:
+            self.env_runner_group.stop()
         if hasattr(self, "learner_group"):
             self.learner_group.stop()
 

@@ -1,0 +1,90 @@
+"""APPO: asynchronous PPO (IMPALA architecture + clipped surrogate).
+
+Counterpart of the reference's APPO (rllib/algorithms/appo/appo.py — an
+IMPALA subclass whose loss applies the PPO clip to v-trace-corrected
+advantages). Here likewise: the async sample/learn pipeline is inherited
+from IMPALA; only the loss changes.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig, vtrace
+from ray_tpu.rllib.core.learner import JaxLearner, LearnerGroup
+from ray_tpu.rllib.core.rl_module import categorical_entropy, categorical_logp
+from ray_tpu.rllib.sample_batch import (
+    ACTIONS,
+    BEHAVIOR_LOGITS,
+    NEXT_OBS,
+    OBS,
+    REWARDS,
+    TERMINATEDS,
+    TRUNCATEDS,
+)
+
+
+class APPOConfig(IMPALAConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = APPO
+        self.clip_param = 0.2  # PPO surrogate clip on the IS ratio
+
+
+def make_appo_loss(cfg: APPOConfig, T: int):
+    gamma, clip = cfg.gamma, cfg.clip_param
+
+    def loss_fn(params, apply_fn, batch):
+        tm = lambda a: a.reshape((T, -1) + a.shape[1:])  # noqa: E731
+        obs, next_obs = tm(batch[OBS]), tm(batch[NEXT_OBS])
+        actions = tm(batch[ACTIONS])
+        out = apply_fn(params, obs)
+        logits, values = out["action_dist_inputs"], out["vf_preds"]
+        next_values = apply_fn(params, next_obs)["vf_preds"]
+        target_logp = categorical_logp(logits, actions)
+        behavior_logp = categorical_logp(tm(batch[BEHAVIOR_LOGITS]), actions)
+        vs, pg_adv = vtrace(
+            target_logp, behavior_logp,
+            tm(batch[REWARDS]), values, next_values,
+            tm(batch[TERMINATEDS]).astype(jnp.float32),
+            tm(batch[TRUNCATEDS]).astype(jnp.float32),
+            gamma, cfg.clip_rho_threshold, cfg.clip_c_threshold,
+        )
+        # PPO clip on the importance ratio (the APPO twist on IMPALA).
+        ratio = jnp.exp(target_logp - behavior_logp)
+        surrogate = jnp.minimum(
+            ratio * pg_adv, jnp.clip(ratio, 1 - clip, 1 + clip) * pg_adv
+        )
+        policy_loss = -surrogate.mean()
+        vf_loss = 0.5 * jnp.square(values - vs).mean()
+        entropy = categorical_entropy(logits).mean()
+        total = (policy_loss + cfg.vf_loss_coeff * vf_loss
+                 - cfg.entropy_coeff * entropy)
+        return total, {
+            "policy_loss": policy_loss,
+            "vf_loss": vf_loss,
+            "entropy": entropy,
+            "mean_ratio": ratio.mean(),
+        }
+
+    return loss_fn
+
+
+class APPO(IMPALA):
+    config_class = APPOConfig
+
+    def build_learner(self, cfg: APPOConfig) -> None:
+        import optax
+
+        tx = optax.adam(cfg.lr)
+        if cfg.grad_clip is not None:
+            tx = optax.chain(optax.clip_by_global_norm(cfg.grad_clip), tx)
+        loss_fn = make_appo_loss(cfg, cfg.rollout_fragment_length)
+        spec = cfg.rl_module_spec()
+        mesh, seed = cfg.mesh, cfg.seed
+
+        def factory():
+            return JaxLearner(spec.build(seed=seed), loss_fn, tx, mesh=mesh)
+
+        self.learner_group = LearnerGroup(factory, num_learners=0)
+        self._inflight = {}

@@ -1,0 +1,188 @@
+"""DQN: double Q-learning with a target network and replay.
+
+Counterpart of the reference's DQN (rllib/algorithms/dqn/dqn.py — new API
+stack: EnvRunner sampling → EpisodeReplayBuffer → TorchLearner with
+double-Q + target net). TPU reshape: the Q-update is a single jitted step;
+TD targets are computed by a second jitted fn over (online, target)
+params, so the learner stays a plain (params, batch) → grads program and
+the target net is an algorithm-held pytree (hard-synced every
+``target_network_update_freq`` env steps, reference default behavior).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.core.learner import JaxLearner, LearnerGroup
+from ray_tpu.rllib.core.rl_module import RLModule, _mlp_apply, _mlp_init
+from ray_tpu.rllib.replay_buffer import ReplayBuffer
+from ray_tpu.rllib.sample_batch import (
+    ACTIONS,
+    NEXT_OBS,
+    OBS,
+    REWARDS,
+    TERMINATEDS,
+    SampleBatch,
+)
+
+
+class DQNConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(algo_class=DQN)
+        self.lr = 5e-4
+        self.replay_buffer_capacity = 50_000
+        self.learning_starts = 1000  # env steps before updates begin
+        self.target_network_update_freq = 500  # env steps between hard syncs
+        self.num_gradient_steps = 32  # per training_step
+        self.double_q = True
+        self.n_step = 1
+        self.epsilon = (1.0, 0.05)  # (initial, final)
+        self.epsilon_timesteps = 10_000
+        self.train_batch_size = 32  # replay minibatch rows
+        self.tau = 1.0  # 1.0 = hard update
+
+    def rl_module_spec(self):
+        # Env runners and the learner both build from this spec, so the
+        # Q-module (with its epsilon-greedy exploration) rides the config.
+        spec = super().rl_module_spec()
+        if spec.module_class is None:
+            spec.module_class = _qmodule_factory(self)
+        return spec
+
+
+class QModule(RLModule):
+    """MLP Q-network with built-in epsilon-greedy exploration.
+
+    The epsilon schedule advances on a local step counter per runner —
+    exploration state never needs to ride the weight broadcast."""
+
+    def __init__(self, spec, seed: int = 0, *, epsilon=(1.0, 0.05),
+                 epsilon_timesteps=10_000, num_envs: int = 1):
+        self._eps0, self._eps1 = epsilon
+        self._eps_steps = max(1, epsilon_timesteps)
+        self._env_steps = 0
+        self._num_envs = num_envs
+        super().__init__(spec, seed)
+
+    def init_params(self, rng):
+        s = self.spec
+        return {"q": _mlp_init(rng, [s.observation_dim, *s.hidden, s.action_dim])}
+
+    def apply(self, params, obs) -> dict:
+        q = _mlp_apply(params["q"], obs)
+        return {"q_values": q, "action_dist_inputs": q, "vf_preds": q.max(-1)}
+
+    def explore_actions(self, obs, rng: np.random.Generator):
+        frac = min(1.0, self._env_steps / self._eps_steps)
+        eps = self._eps0 + frac * (self._eps1 - self._eps0)
+        self._env_steps += len(obs)
+        q = self.forward_inference(obs)["q_values"]
+        greedy = q.argmax(-1)
+        random = rng.integers(0, q.shape[-1], size=len(obs))
+        take_random = rng.random(len(obs)) < eps
+        return np.where(take_random, random, greedy).astype(np.int64), {}
+
+
+def make_dqn_loss():
+    def loss_fn(params, apply_fn, batch):
+        q = apply_fn(params, batch[OBS])["q_values"]
+        qa = jnp.take_along_axis(
+            q, batch[ACTIONS][:, None].astype(jnp.int32), axis=-1
+        )[:, 0]
+        td = qa - batch["td_targets"]
+        loss = optax.huber_loss(td).mean()
+        return loss, {"qf_loss": loss, "qf_mean": qa.mean(),
+                      "td_error_abs": jnp.abs(td).mean()}
+
+    return loss_fn
+
+
+class DQN(Algorithm):
+    config_class = DQNConfig
+
+    def build_learner(self, cfg: DQNConfig) -> None:
+        spec = cfg.rl_module_spec()
+        if cfg.num_learners > 0:
+            raise ValueError(
+                "DQN drives its learner locally (replay + target net live "
+                "with the driver); num_learners > 0 is not supported"
+            )
+        tx = optax.adam(cfg.lr)
+        if cfg.grad_clip is not None:
+            tx = optax.chain(optax.clip_by_global_norm(cfg.grad_clip), tx)
+        mesh, seed = cfg.mesh, cfg.seed
+
+        def factory():
+            return JaxLearner(spec.build(seed=seed), loss_fn=make_dqn_loss(),
+                              optimizer=tx, mesh=mesh)
+
+        self.learner_group = LearnerGroup(factory, num_learners=0)
+        self.buffer = ReplayBuffer(cfg.replay_buffer_capacity, seed=cfg.seed)
+        self.target_weights = self.learner_group.get_weights()
+        self._env_steps_total = 0
+        self._last_target_sync = 0
+        self._module = spec.build(seed=0)
+
+        gamma, double_q = cfg.gamma, cfg.double_q
+        apply_fn = self._module.apply
+
+        @jax.jit
+        def td_targets(online, target, next_obs, rewards, terminateds):
+            qt = apply_fn(target, next_obs)["q_values"]
+            if double_q:
+                a_star = apply_fn(online, next_obs)["q_values"].argmax(-1)
+                q_next = jnp.take_along_axis(qt, a_star[:, None], -1)[:, 0]
+            else:
+                q_next = qt.max(-1)
+            return rewards + gamma * (1.0 - terminateds) * q_next
+
+        self._td_targets = td_targets
+
+    def training_step(self) -> dict:
+        cfg = self.algo_config
+        weights = self.learner_group.get_weights()
+        batch = self.env_runner_group.sample(weights)
+        self.buffer.add(batch)
+        self._env_steps_total += len(batch)
+        metrics: dict = {"num_env_steps_sampled": self._env_steps_total,
+                         "replay_buffer_size": len(self.buffer)}
+        if self._env_steps_total < cfg.learning_starts:
+            return metrics
+        online = jax.tree.map(jnp.asarray, weights)
+        target = jax.tree.map(jnp.asarray, self.target_weights)
+        for _ in range(cfg.num_gradient_steps):
+            mb = self.buffer.sample(cfg.train_batch_size)
+            mb["td_targets"] = np.asarray(self._td_targets(
+                online, target, jnp.asarray(mb[NEXT_OBS]),
+                jnp.asarray(mb[REWARDS]),
+                jnp.asarray(mb[TERMINATEDS], jnp.float32),
+            ))
+            metrics.update(self.learner_group.local.update(mb))
+        if (self._env_steps_total - self._last_target_sync
+                >= cfg.target_network_update_freq):
+            w = self.learner_group.get_weights()
+            if cfg.tau >= 1.0:
+                self.target_weights = w
+            else:
+                self.target_weights = jax.tree.map(
+                    lambda t, o: (1 - cfg.tau) * t + cfg.tau * o,
+                    self.target_weights, w,
+                )
+            self._last_target_sync = self._env_steps_total
+        return metrics
+
+
+def _qmodule_factory(cfg: DQNConfig):
+    eps, eps_t = cfg.epsilon, cfg.epsilon_timesteps
+    num_envs = cfg.num_envs_per_env_runner
+
+    class _Q(QModule):
+        def __init__(self, spec, seed: int = 0):
+            super().__init__(spec, seed, epsilon=eps, epsilon_timesteps=eps_t,
+                             num_envs=num_envs)
+
+    return _Q

@@ -1,0 +1,257 @@
+"""SAC: soft actor-critic for continuous control.
+
+Counterpart of the reference's SAC (rllib/algorithms/sac/ — squashed
+gaussian policy, twin Q critics, entropy temperature auto-tuning, polyak
+target nets, replay). TPU reshape: actor/critic/alpha losses are summed
+into ONE jitted update with stop_gradient walls between them (critic
+grads do not flow into the policy term and vice versa), so the whole SAC
+update is a single XLA program; the target critic is an algorithm-held
+pytree polyak-updated on host.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.core.learner import JaxLearner, LearnerGroup
+from ray_tpu.rllib.core.rl_module import RLModule, _mlp_apply, _mlp_init
+from ray_tpu.rllib.replay_buffer import ReplayBuffer
+from ray_tpu.rllib.sample_batch import (
+    ACTIONS,
+    NEXT_OBS,
+    OBS,
+    REWARDS,
+    TERMINATEDS,
+    SampleBatch,
+)
+
+LOG_STD_MIN, LOG_STD_MAX = -20.0, 2.0
+
+
+class SACConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(algo_class=SAC)
+        self.lr = 3e-4
+        self.replay_buffer_capacity = 100_000
+        self.learning_starts = 500
+        self.num_gradient_steps = 32
+        self.train_batch_size = 64
+        self.tau = 0.005  # polyak for the target critic
+        self.initial_alpha = 1.0
+        self.target_entropy: float | None = None  # default: -action_dim
+        self.grad_clip = None
+
+    def rl_module_spec(self):
+        spec = super().rl_module_spec()
+        if spec.module_class is None:
+            center, half = _action_affine(self.action_low, self.action_high)
+            spec.module_class = _sac_module_factory(self.initial_alpha,
+                                                    center, half)
+        return spec
+
+
+def gaussian_sample(params, apply_out, key):
+    """Reparameterized squashed-gaussian sample: a = tanh(u)·scale,
+    with the tanh-corrected log-prob."""
+    mean, log_std = apply_out["mean"], apply_out["log_std"]
+    std = jnp.exp(log_std)
+    u = mean + std * jax.random.normal(key, mean.shape)
+    logp_u = (-0.5 * jnp.square((u - mean) / std)
+              - log_std - 0.5 * jnp.log(2.0 * jnp.pi)).sum(-1)
+    a = jnp.tanh(u)
+    # d tanh correction (numerically-stable formulation).
+    logp = logp_u - (2.0 * (jnp.log(2.0) - u - jax.nn.softplus(-2.0 * u))).sum(-1)
+    return a, logp
+
+
+def _action_affine(low, high):
+    """Map tanh output [-1, 1] onto [low, high]: a = center + half·tanh(u).
+    Handles asymmetric Box spaces (low != -high)."""
+    if high is None:
+        return 0.0, 1.0
+    low = np.asarray(low, np.float32)
+    high = np.asarray(high, np.float32)
+    return (high + low) / 2.0, (high - low) / 2.0
+
+
+class SACModule(RLModule):
+    """Policy (mean/log_std heads) + twin Q critics + log_alpha, one tree."""
+
+    action_center: np.ndarray | float = 0.0
+    action_half: np.ndarray | float = 1.0
+    initial_alpha: float = 1.0
+
+    def init_params(self, rng):
+        s = self.spec
+        kp, k1, k2 = jax.random.split(rng, 3)
+        qin = s.observation_dim + s.action_dim
+        return {
+            "pi": {
+                "torso": _mlp_init(kp, [s.observation_dim, *s.hidden]),
+                "mean": _mlp_init(jax.random.fold_in(kp, 1),
+                                  [s.hidden[-1], s.action_dim]),
+                "log_std": _mlp_init(jax.random.fold_in(kp, 2),
+                                     [s.hidden[-1], s.action_dim]),
+            },
+            "q1": _mlp_init(k1, [qin, *s.hidden, 1]),
+            "q2": _mlp_init(k2, [qin, *s.hidden, 1]),
+            "log_alpha": jnp.asarray(np.log(self.initial_alpha), jnp.float32),
+        }
+
+    def apply(self, params, obs) -> dict:
+        h = _mlp_apply(params["pi"]["torso"], obs, activate_last=True)
+        mean = _mlp_apply(params["pi"]["mean"], h)
+        log_std = jnp.clip(_mlp_apply(params["pi"]["log_std"], h),
+                           LOG_STD_MIN, LOG_STD_MAX)
+        return {"mean": mean, "log_std": log_std,
+                "action_dist_inputs": mean, "vf_preds": mean[..., 0] * 0.0}
+
+    @staticmethod
+    def q_apply(q_params, obs, actions):
+        x = jnp.concatenate([obs, actions], axis=-1)
+        return _mlp_apply(q_params, x)[..., 0]
+
+    def explore_actions(self, obs, rng: np.random.Generator):
+        out = self.forward_inference(obs)
+        mean, log_std = out["mean"], out["log_std"]
+        u = mean + np.exp(log_std) * rng.standard_normal(mean.shape).astype(np.float32)
+        a = self.action_center + self.action_half * np.tanh(u)
+        return a.astype(np.float32), {}
+
+
+def make_sac_loss(cfg: SACConfig, action_center, action_half,
+                  target_entropy: float):
+    gamma, sg = cfg.gamma, jax.lax.stop_gradient
+    center = jnp.asarray(action_center, jnp.float32)
+    half = jnp.asarray(action_half, jnp.float32)
+
+    def loss_fn(params, apply_fn, batch):
+        key = batch["rng"]
+        k1, k2 = jax.random.split(key)
+        obs, acts = batch[OBS], batch[ACTIONS]
+        # Buffer actions are env-scaled; critics see normalized [-1, 1].
+        acts_n = (acts - center) / half
+        alpha = jnp.exp(params["log_alpha"])
+
+        # -- critic loss (targets precomputed outside; see DQN note) -----
+        q1 = SACModule.q_apply(params["q1"], obs, acts_n)
+        q2 = SACModule.q_apply(params["q2"], obs, acts_n)
+        target = batch["td_targets"]
+        critic_loss = (jnp.square(q1 - target).mean()
+                       + jnp.square(q2 - target).mean())
+
+        # -- actor loss: fresh reparam sample through frozen critics ------
+        out = apply_fn(params, obs)
+        a_pi, logp_pi = gaussian_sample(params, out, k1)
+        q1_pi = SACModule.q_apply(sg(params["q1"]), obs, a_pi)
+        q2_pi = SACModule.q_apply(sg(params["q2"]), obs, a_pi)
+        q_pi = jnp.minimum(q1_pi, q2_pi)
+        actor_loss = (sg(alpha) * logp_pi - q_pi).mean()
+
+        # -- temperature loss --------------------------------------------
+        alpha_loss = (-params["log_alpha"]
+                      * sg(logp_pi + target_entropy)).mean()
+
+        total = critic_loss + actor_loss + alpha_loss
+        return total, {
+            "critic_loss": critic_loss,
+            "actor_loss": actor_loss,
+            "alpha_loss": alpha_loss,
+            "alpha": alpha,
+            "entropy": -logp_pi.mean(),
+            "q1_mean": q1.mean(),
+        }
+
+    return loss_fn
+
+
+class SAC(Algorithm):
+    config_class = SACConfig
+
+    def build_learner(self, cfg: SACConfig) -> None:
+        spec = cfg.rl_module_spec()
+        if cfg.num_learners > 0:
+            raise ValueError(
+                "SAC drives its learner locally (replay + target nets live "
+                "with the driver); num_learners > 0 is not supported"
+            )
+        target_entropy = (cfg.target_entropy
+                          if cfg.target_entropy is not None
+                          else -float(cfg.action_dim))
+        center, half = _action_affine(cfg.action_low, cfg.action_high)
+        tx = optax.adam(cfg.lr)
+        if cfg.grad_clip is not None:
+            tx = optax.chain(optax.clip_by_global_norm(cfg.grad_clip), tx)
+        loss_fn = make_sac_loss(cfg, center, half, target_entropy)
+        mesh, seed = cfg.mesh, cfg.seed
+
+        def factory():
+            return JaxLearner(spec.build(seed=seed), loss_fn=loss_fn,
+                              optimizer=tx, mesh=mesh)
+
+        self.learner_group = LearnerGroup(factory, num_learners=0)
+        self.buffer = ReplayBuffer(cfg.replay_buffer_capacity, seed=cfg.seed)
+        w = self.learner_group.get_weights()
+        self.target_q = {"q1": w["q1"], "q2": w["q2"]}
+        self._env_steps_total = 0
+        self._module = spec.build(seed=0)
+        self._key = jax.random.PRNGKey(cfg.seed)
+
+        gamma = cfg.gamma
+        apply_fn = self._module.apply
+
+        @jax.jit
+        def td_targets(params, target_q, key, next_obs, rewards, terminateds):
+            out = apply_fn(params, next_obs)
+            a2, logp2 = gaussian_sample(params, out, key)
+            q1t = SACModule.q_apply(target_q["q1"], next_obs, a2)
+            q2t = SACModule.q_apply(target_q["q2"], next_obs, a2)
+            alpha = jnp.exp(params["log_alpha"])
+            soft_q = jnp.minimum(q1t, q2t) - alpha * logp2
+            return rewards + gamma * (1.0 - terminateds) * soft_q
+
+        self._td_targets = td_targets
+
+    def training_step(self) -> dict:
+        cfg = self.algo_config
+        weights = self.learner_group.get_weights()
+        batch = self.env_runner_group.sample(weights)
+        self.buffer.add(batch)
+        self._env_steps_total += len(batch)
+        metrics: dict = {"num_env_steps_sampled": self._env_steps_total,
+                         "replay_buffer_size": len(self.buffer)}
+        if self._env_steps_total < cfg.learning_starts:
+            return metrics
+        for _ in range(cfg.num_gradient_steps):
+            mb = self.buffer.sample(cfg.train_batch_size)
+            params = jax.tree.map(jnp.asarray,
+                                  self.learner_group.local.module.params)
+            self._key, kt, ku = jax.random.split(self._key, 3)
+            mb["td_targets"] = np.asarray(self._td_targets(
+                params, jax.tree.map(jnp.asarray, self.target_q), kt,
+                jnp.asarray(mb[NEXT_OBS]), jnp.asarray(mb[REWARDS]),
+                jnp.asarray(mb[TERMINATEDS], jnp.float32),
+            ))
+            mb["rng"] = np.asarray(ku)
+            metrics.update(self.learner_group.local.update(mb))
+            # Polyak target update every gradient step (reference default).
+            w = self.learner_group.local.module.params
+            self.target_q = jax.tree.map(
+                lambda t, o: (1 - cfg.tau) * jnp.asarray(t) + cfg.tau * o,
+                self.target_q, {"q1": w["q1"], "q2": w["q2"]},
+            )
+        return metrics
+
+
+def _sac_module_factory(initial_alpha: float, action_center, action_half):
+    class _SAC(SACModule):
+        pass
+
+    _SAC.initial_alpha = initial_alpha
+    _SAC.action_center = action_center
+    _SAC.action_half = action_half
+    return _SAC

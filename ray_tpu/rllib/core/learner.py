@@ -75,8 +75,10 @@ class JaxLearner:
     # ------------------------------------------------------------------
 
     def update(self, batch: SampleBatch) -> dict:
-        """One gradient step on `batch` (already minibatched by the algo)."""
-        jbatch = {k: jnp.asarray(v) for k, v in batch.items()}
+        """One gradient step on `batch` (already minibatched by the algo).
+        Values may be nested pytrees (off-policy algos pass rng keys /
+        precomputed target structures alongside the flat columns)."""
+        jbatch = jax.tree.map(jnp.asarray, dict(batch))
         self.module.params, self.opt_state, metrics = self._jit_update(
             self.module.params, self.opt_state, jbatch
         )

@@ -114,6 +114,11 @@ class SingleAgentEnvRunner:
         inputs attached (vf_preds, bootstrap via next_obs values)."""
         if weights is not None:
             self.module.set_weights(weights)
+        # Modules owning their exploration (epsilon-greedy DQN, squashed
+        # gaussian SAC, any continuous policy) take the generic path: the
+        # module decides actions/extras, the runner just steps envs.
+        if hasattr(self.module, "explore_actions"):
+            return self._sample_generic()
         T, B = self.rollout_len, self.num_envs
         obs_buf = np.empty((T, B) + self.obs.shape[1:], np.float32)
         act_buf = np.empty((T, B), np.int64)
@@ -167,6 +172,55 @@ class SingleAgentEnvRunner:
                 "env_id": np.tile(np.arange(B)[None, :], (T, 1)).reshape(-1),
             }
         )
+
+    def _sample_generic(self) -> SampleBatch:
+        """Rollout driven by module.explore_actions(obs, rng) ->
+        (actions, extras). Collects the off-policy transition tuple
+        (obs, action, reward, terminated, truncated, next_obs) plus any
+        per-step extras the module returns (e.g. logp)."""
+        T, B = self.rollout_len, self.num_envs
+        obs_buf = np.empty((T, B) + self.obs.shape[1:], np.float32)
+        next_obs_buf = np.empty_like(obs_buf)
+        rew_buf = np.empty((T, B), np.float32)
+        term_buf = np.empty((T, B), bool)
+        trunc_buf = np.empty((T, B), bool)
+        act_buf = None
+        extra_bufs: dict[str, np.ndarray] = {}
+
+        for t in range(T):
+            actions, extras = self.module.explore_actions(self.obs, self._rng)
+            actions = np.asarray(actions)
+            if act_buf is None:
+                act_buf = np.empty((T,) + actions.shape, actions.dtype)
+            act_buf[t] = actions
+            for k, v in (extras or {}).items():
+                v = np.asarray(v)
+                if k not in extra_bufs:
+                    extra_bufs[k] = np.empty((T,) + v.shape, v.dtype)
+                extra_bufs[k][t] = v
+            next_obs, rewards, terms, truncs, final_obs = self.vec.step(actions)
+            next_for_value = next_obs.copy()
+            for i, fo in enumerate(final_obs):
+                if fo is not None:
+                    next_for_value[i] = fo
+            obs_buf[t] = self.obs
+            rew_buf[t], term_buf[t], trunc_buf[t] = rewards, terms, truncs
+            next_obs_buf[t] = next_for_value
+            self._track_episodes(rewards, terms, truncs)
+            self.obs = next_obs
+
+        flat = lambda a: a.reshape((T * B,) + a.shape[2:])  # noqa: E731
+        out = SampleBatch({
+            OBS: flat(obs_buf),
+            ACTIONS: flat(act_buf),
+            REWARDS: flat(rew_buf),
+            TERMINATEDS: flat(term_buf),
+            TRUNCATEDS: flat(trunc_buf),
+            NEXT_OBS: flat(next_obs_buf),
+        })
+        for k, buf in extra_bufs.items():
+            out[k] = flat(buf)
+        return out
 
     def _track_episodes(self, rewards, terms, truncs) -> None:
         self._ep_return += rewards

@@ -171,21 +171,46 @@ def _atomic_write(path: str, data: bytes) -> None:
 
 # -- execution --------------------------------------------------------------
 
+def _reachable(steps: dict, target: str) -> set[str]:
+    needed: set[str] = set()
+    frontier = [target]
+    while frontier:
+        s = frontier.pop()
+        if s in needed:
+            continue
+        needed.add(s)
+        frontier.extend(steps[s]["deps"])
+    return needed
+
+
 def _execute(spec: dict, store: _Store) -> Any:
-    """Run all steps not yet in storage, deps-first, parallel within a
-    level. Returns the output step's value."""
+    """Run the steps reachable from spec['output'] that are not yet in
+    storage, deps-first, parallel within a level. Returns the output
+    step's value.
+
+    Only the reachable subgraph runs here: spliced continuation steps
+    are NOT dependency-linked to the outer output and execute exclusively
+    through their parent step's continuation marker (below) — running
+    them at this level too would double-execute them on resume."""
     import ray_tpu.remote_function as rf
 
     steps = spec["steps"]
     done: dict[str, Any] = {}
-    pending = set(steps)
-
-    def load_done(sid):
-        done[sid] = store.load_step(sid)
+    pending = _reachable(steps, spec["output"])
 
     for sid in list(pending):
         if store.has_step(sid):
-            load_done(sid)
+            value = store.load_step(sid)
+            if isinstance(value, dict) and "__continuation__" in value:
+                # The step durably resolved to a continuation before the
+                # crash: finish (or load) its subgraph instead of
+                # re-running the step.
+                value = _execute(
+                    {"steps": steps, "output": value["__continuation__"]},
+                    store,
+                )
+                store.save_step(sid, value)
+            done[sid] = value
             pending.discard(sid)
 
     while pending:
@@ -249,20 +274,12 @@ def _splice_continuation(spec: dict, store: _Store, sid: str,
     full = store.load_spec()
     full["steps"].update(spec["steps"])
     store.save_spec(full)
-    # Execute ONLY the continuation's subgraph; passing the full merged
-    # table would re-enter still-pending outer steps and recurse forever.
+    # Durably mark the step as resolved-to-a-continuation BEFORE running
+    # the subgraph: a crash mid-subgraph then resumes INTO the subgraph
+    # instead of re-running this step (whose side effects already fired).
     target = f"{sid}.{sub['output']}"
-    needed: set[str] = set()
-    frontier = [target]
-    while frontier:
-        s = frontier.pop()
-        if s in needed:
-            continue
-        needed.add(s)
-        frontier.extend(spec["steps"][s]["deps"])
-    sub_spec = {"steps": {k: spec["steps"][k] for k in needed},
-                "output": target}
-    return _execute(sub_spec, store)
+    store.save_step(sid, {"__continuation__": target})
+    return _execute({"steps": spec["steps"], "output": target}, store)
 
 
 def _prefix_ref(v: dict, prefix: str) -> dict:
@@ -280,19 +297,22 @@ def run(dag: DAGNode, *, workflow_id: str | None = None) -> Any:
     FAILED/RUNNING id with the *same* DAG resumes it; with a *different*
     DAG it raises (stale step results from the old graph must not leak
     into the new one — delete() or pick a fresh id)."""
-    workflow_id = workflow_id or f"workflow-{int(time.time() * 1000):x}"
+    workflow_id = workflow_id or f"workflow-{_uuid_hex()}"
     store = _Store(workflow_id)
     meta = store.load_meta()
-    if meta.get("status") == "SUCCESS":
-        return store.load_step(meta["output"])
     spec = _freeze(dag)
     fp = _fingerprint(spec)
+    # Fingerprint check FIRST: a SUCCESS entry for a different DAG must
+    # raise, not silently return the other DAG's output.
     if meta and meta.get("fingerprint") not in (None, fp):
         raise ValueError(
             f"workflow id {workflow_id!r} already exists with a different "
             f"DAG (status={meta.get('status')}); workflow.delete() it or "
-            f"use a new id"
+            f"use a new id (same-DAG reruns resume; workflow.resume() "
+            f"skips this check)"
         )
+    if meta.get("status") == "SUCCESS":
+        return store.load_step(meta["output"])
     store.save_spec(spec)
     store.save_meta(status="RUNNING", output=spec["output"],
                     fingerprint=fp, created_at=time.time())
@@ -306,6 +326,9 @@ def _fingerprint(spec: dict) -> str:
     # bytecode: cloudpickle bytes are not guaranteed stable across driver
     # restarts, and a re-run after a code fix SHOULD resume (same
     # semantics as resume()). Changed args/structure are the hazard.
+    # Caveat: args whose pickling is order-unstable (sets under a new
+    # PYTHONHASHSEED) can fingerprint differently across processes —
+    # resume(workflow_id) bypasses this check for exactly that case.
     h = hashlib.sha256()
     for sid in sorted(spec["steps"]):
         st = spec["steps"][sid]
@@ -328,8 +351,14 @@ def _finish(store: _Store, spec: dict) -> Any:
     return result
 
 
+def _uuid_hex() -> str:
+    import uuid
+
+    return uuid.uuid4().hex[:16]
+
+
 def run_async(dag: DAGNode, *, workflow_id: str | None = None) -> Future:
-    workflow_id = workflow_id or f"workflow-{int(time.time() * 1000):x}"
+    workflow_id = workflow_id or f"workflow-{_uuid_hex()}"
     fut: Future = Future()
 
     def target():

@@ -186,3 +186,43 @@ def test_reused_id_with_different_dag_rejected():
         workflow.run(fail_then.bind(1), workflow_id=wid)
     with pytest.raises(ValueError, match="different DAG"):
         workflow.run(fail_then.bind(2), workflow_id=wid)  # changed args
+
+
+def test_continuation_parent_not_replayed_on_resume(tmp_path):
+    """Failure INSIDE a continuation subgraph: resume finishes the
+    subgraph without re-running the parent step (its side effect fired)."""
+    parent_marker = tmp_path / "parent_runs"
+    flag = tmp_path / "sub_ok"
+
+    @ray_tpu.remote(max_retries=0)
+    def parent(flag_path):
+        with open(parent_marker, "a") as f:
+            f.write("p")
+        return workflow.continuation(sub.bind(flag_path))
+
+    @ray_tpu.remote(max_retries=0)
+    def sub(flag_path):
+        if not os.path.exists(flag_path):
+            raise RuntimeError("sub fails first time")
+        return "done"
+
+    wid = _wid()
+    with pytest.raises(Exception):
+        workflow.run(parent.bind(str(flag)), workflow_id=wid)
+    assert parent_marker.read_text() == "p"
+    flag.write_text("ok")
+    assert workflow.resume(wid) == "done"
+    assert parent_marker.read_text() == "p"  # parent ran exactly once
+
+
+def test_success_id_with_different_dag_raises():
+    @ray_tpu.remote
+    def val(x):
+        return x
+
+    wid = _wid()
+    assert workflow.run(val.bind(1), workflow_id=wid) == 1
+    with pytest.raises(ValueError, match="different DAG"):
+        workflow.run(val.bind(2), workflow_id=wid)
+    # Same DAG still returns the cached result.
+    assert workflow.run(val.bind(1), workflow_id=wid) == 1
