@@ -51,6 +51,13 @@ class Config:
     max_lineage_entries: int = 100_000
     max_object_reconstructions: int = 3
 
+    # --- memory monitor / OOM killing ---
+    # Reference: memory_monitor.h:52 (enabled when usage threshold < 1.0),
+    # worker_killing_policy_retriable_fifo.h.
+    memory_monitor_enabled: bool = True
+    memory_usage_threshold: float = 0.95
+    memory_monitor_interval_s: float = 1.0
+
     # --- networking ---
     head_host: str = "127.0.0.1"  # 0.0.0.0 for multi-host clusters
     head_port: int = 0  # 0 = ephemeral; CLI `start --head` defaults 6380

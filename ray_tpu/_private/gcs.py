@@ -237,6 +237,27 @@ class Head:
         )
         self._dispatcher.start()
 
+        # OOM protection: kill-and-retry busy workers under host memory
+        # pressure (memory_monitor.py; reference memory_monitor.h:52).
+        self.memory_monitor = None
+        if config.memory_monitor_enabled and config.memory_usage_threshold < 1.0:
+            from ray_tpu._private.memory_monitor import MemoryMonitor
+
+            self.memory_monitor = MemoryMonitor(
+                self,
+                threshold=config.memory_usage_threshold,
+                interval_s=config.memory_monitor_interval_s,
+            )
+            self.memory_monitor.start()
+
+        # Local-only usage summary (reference: usage_lib.py; no egress).
+        try:
+            from ray_tpu._private.usage_stats import record_cluster_usage
+
+            record_cluster_usage(self)
+        except Exception:
+            pass
+
     # ------------------------------------------------------------------
     # bootstrap helpers
 
@@ -1620,6 +1641,8 @@ class Head:
 
     def shutdown(self) -> None:
         self._shutdown = True
+        if self.memory_monitor is not None:
+            self.memory_monitor.stop()
         with self.lock:
             workers = list(self.workers.values())
         for rec in workers:

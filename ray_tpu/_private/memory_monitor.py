@@ -1,0 +1,151 @@
+"""Host-memory monitor + OOM worker-killing policy.
+
+Counterpart of the reference's MemoryMonitor
+(reference: src/ray/common/memory_monitor.h:52 — cgroup/system usage
+polling) and the worker-killing policies
+(raylet/worker_killing_policy_retriable_fifo.h — prefer retriable tasks,
+newest first; worker_killing_policy_group_by_owner.h). When host memory
+passes the threshold, one busy worker is killed per tick; the existing
+worker-death machinery (gcs._handle_worker_death) then retries its task
+or restarts its actor, exactly as if it had crashed.
+
+Victim policy (first match wins):
+  1. newest worker running a RETRIABLE normal task (retries remain),
+  2. newest worker running any normal task,
+  3. newest RESTARTABLE actor worker.
+Actors without restart budget are never chosen (killing them converts
+memory pressure into permanent application failure).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable
+
+
+def system_memory_usage() -> tuple[int, int]:
+    """(used_bytes, total_bytes), cgroup-v2-aware (container limits win
+    over the host numbers when present and lower)."""
+    used = total = 0
+    try:
+        with open("/proc/meminfo") as f:
+            info = {}
+            for line in f:
+                k, v = line.split(":", 1)
+                info[k] = int(v.strip().split()[0]) * 1024
+        total = info["MemTotal"]
+        used = total - info.get("MemAvailable", 0)
+    except Exception:
+        return 0, 0
+    try:
+        with open("/sys/fs/cgroup/memory.max") as f:
+            raw = f.read().strip()
+        if raw != "max":
+            cg_total = int(raw)
+            if 0 < cg_total < total:
+                with open("/sys/fs/cgroup/memory.current") as f:
+                    used = int(f.read().strip())
+                total = cg_total
+    except Exception:
+        pass
+    return used, total
+
+
+class MemoryMonitor:
+    def __init__(
+        self,
+        head,
+        threshold: float = 0.95,
+        interval_s: float = 1.0,
+        usage_fn: Callable[[], tuple[int, int]] | None = None,
+        min_kill_interval_s: float = 2.0,
+    ):
+        self._head = head
+        self._threshold = threshold
+        self._interval = interval_s
+        self._usage_fn = usage_fn or system_memory_usage
+        self._min_kill_interval = min_kill_interval_s
+        self._last_kill = 0.0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.num_kills = 0
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="memory-monitor"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._interval):
+            try:
+                self.tick()
+            except Exception:
+                pass  # monitoring must never take the head down
+
+    def tick(self) -> bool:
+        """One poll; returns True if a worker was killed."""
+        used, total = self._usage_fn()
+        if total <= 0 or used / total < self._threshold:
+            return False
+        now = time.time()
+        if now - self._last_kill < self._min_kill_interval:
+            return False  # give the previous kill time to free memory
+        victim = self._pick_victim()
+        if victim is None:
+            return False
+        self._last_kill = now
+        self.num_kills += 1
+        task_names = [s.name for s in victim.inflight.values()]
+        self._head.metrics["memory_monitor_kills"] = self.num_kills
+        self._head.task_events.append({
+            "event": "oom_kill",
+            "worker_id": victim.worker_id,
+            "tasks": task_names,
+            "used_bytes": used,
+            "total_bytes": total,
+            "ts": now,
+        })
+        self._kill(victim)
+        return True
+
+    def _pick_victim(self):
+        head = self._head
+        with head.lock:
+            busy = [r for r in head.workers.values() if r.inflight]
+            newest = sorted(busy, key=lambda r: -r.started_at)
+            # 1. retriable normal tasks, newest first.
+            for r in newest:
+                if r.actor_id is None and all(
+                    s.retries_used < s.max_retries for s in r.inflight.values()
+                ):
+                    return r
+            # 2. any normal task.
+            for r in newest:
+                if r.actor_id is None:
+                    return r
+            # 3. restartable actors only.
+            for r in newest:
+                actor = head.actors.get(r.actor_id)
+                if actor is None:
+                    continue
+                mr = actor.spec.max_restarts
+                if mr != 0 and (mr < 0 or actor.restarts < mr):
+                    return r
+        return None
+
+    def _kill(self, victim) -> None:
+        # Kill the process; the connection close triggers
+        # _handle_worker_death → retry/restart (the OOM path reuses the
+        # crash path end to end, like the reference raylet's policy kills).
+        try:
+            if victim.proc is not None:
+                victim.proc.kill()
+            elif victim.conn is not None:
+                victim.conn.cast("kill", {})
+        except Exception:
+            pass

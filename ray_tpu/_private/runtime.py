@@ -50,6 +50,7 @@ class CoreRuntime:
         client_type: str = "driver",
         worker_id: str | None = None,
         message_handler: Callable[[str, dict], Any] | None = None,
+        force_remote: bool = False,
     ):
         self._waiters: dict[str, Future] = {}
         self._waiters_lock = threading.Lock()
@@ -57,9 +58,10 @@ class CoreRuntime:
         self._closed = False
         self.address = address  # head (host, port) — job drivers reconnect here
         self.conn = rpc.connect(address, handler=self._handle, name=client_type)
-        # Off-host clients (or forced-remote for tests) skip the shm fast
-        # path; the head ships object payloads inline over the connection.
-        can_shm = os.environ.get("RAY_TPU_REMOTE") != "1"
+        # Off-host clients (ray:// drivers, or forced-remote for tests)
+        # skip the shm fast path; the head ships object payloads inline
+        # over the connection.
+        can_shm = not force_remote and os.environ.get("RAY_TPU_REMOTE") != "1"
         reg = self.conn.call(
             "register",
             {"client_type": client_type, "worker_id": worker_id,

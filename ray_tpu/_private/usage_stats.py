@@ -1,0 +1,50 @@
+"""Usage stats: opt-out, local-file only.
+
+Counterpart of the reference's usage_lib
+(reference: python/ray/_private/usage/usage_lib.py:220,390 — opt-out
+telemetry reporting cluster metadata). This build never egresses:
+a summary JSON is written under the session dir so operators can see
+exactly what WOULD be reported; RAY_TPU_USAGE_STATS_ENABLED=0 disables
+even that.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+
+def usage_stats_enabled() -> bool:
+    return os.environ.get("RAY_TPU_USAGE_STATS_ENABLED", "1") not in ("0", "false")
+
+
+def record_cluster_usage(head) -> str | None:
+    """Write the local usage summary; returns the path (or None if off)."""
+    if not usage_stats_enabled():
+        return None
+    # NEVER import jax here: this runs inside Head startup, and
+    # initializing the TPU backend in the head daemon would grab the
+    # chips away from the workers (the head deliberately detects TPUs
+    # via sysfs/env only — see gcs._detect_resources).
+    num_tpus = int(head.node_resources.get("TPU", 0))
+    backend = "tpu" if num_tpus else "cpu"
+    from ray_tpu._version import __version__
+
+    payload = {
+        "schema_version": 1,
+        "ray_tpu_version": __version__,
+        "session_id": head.session_id,
+        "collected_at": time.time(),
+        "total_num_cpus": head.node_resources.get("CPU", 0),
+        "total_num_tpus": num_tpus,
+        "accelerator_backend": backend,
+        "os": os.uname().sysname.lower(),
+    }
+    path = os.path.join(head.session_dir, "usage_stats.json")
+    try:
+        with open(path, "w") as f:
+            json.dump(payload, f, indent=2)
+    except OSError:
+        return None
+    return path

@@ -69,8 +69,17 @@ def init(
             rt = CoreRuntime(head.address, client_type="driver")
             worker_context.set_runtime(rt, head)
         else:
+            # "ray://host:port" — Ray-Client-style remote driver
+            # (reference: util/client, ray.init("ray://...")): same wire
+            # protocol, but the shm fast path is skipped up front (the
+            # driver is assumed off-host; objects ship inline).
+            force_remote = False
+            if address.startswith("ray://"):
+                address = address[len("ray://"):]
+                force_remote = True
             host, port = address.rsplit(":", 1)
-            rt = CoreRuntime((host, int(port)), client_type="driver")
+            rt = CoreRuntime((host, int(port)), client_type="driver",
+                             force_remote=force_remote)
             worker_context.set_runtime(rt, None)
         atexit.register(shutdown)
         return context_info()

@@ -11,7 +11,6 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig, vtrace
-from ray_tpu.rllib.core.learner import JaxLearner, LearnerGroup
 from ray_tpu.rllib.core.rl_module import categorical_entropy, categorical_logp
 from ray_tpu.rllib.sample_batch import (
     ACTIONS,
@@ -73,18 +72,5 @@ def make_appo_loss(cfg: APPOConfig, T: int):
 class APPO(IMPALA):
     config_class = APPOConfig
 
-    def build_learner(self, cfg: APPOConfig) -> None:
-        import optax
-
-        tx = optax.adam(cfg.lr)
-        if cfg.grad_clip is not None:
-            tx = optax.chain(optax.clip_by_global_norm(cfg.grad_clip), tx)
-        loss_fn = make_appo_loss(cfg, cfg.rollout_fragment_length)
-        spec = cfg.rl_module_spec()
-        mesh, seed = cfg.mesh, cfg.seed
-
-        def factory():
-            return JaxLearner(spec.build(seed=seed), loss_fn, tx, mesh=mesh)
-
-        self.learner_group = LearnerGroup(factory, num_learners=0)
-        self._inflight = {}
+    def make_loss(self, cfg):
+        return make_appo_loss(cfg, cfg.rollout_fragment_length)

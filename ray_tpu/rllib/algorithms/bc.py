@@ -50,9 +50,9 @@ class BC(Algorithm):
         if cfg.offline_data is None:
             raise ValueError("BC requires config.offline(offline_data=...)")
         self._dataset = _to_sample_batch(cfg.offline_data)
-        tx = optax.adam(cfg.lr)
-        if cfg.grad_clip is not None:
-            tx = optax.chain(optax.clip_by_global_norm(cfg.grad_clip), tx)
+        from ray_tpu.rllib.core.learner import make_optimizer
+
+        tx = make_optimizer(cfg)
         spec = cfg.rl_module_spec()
         mesh, seed = cfg.mesh, cfg.seed
         loss_fn = make_bc_loss()

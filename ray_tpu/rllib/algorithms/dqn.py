@@ -111,9 +111,9 @@ class DQN(Algorithm):
                 "DQN drives its learner locally (replay + target net live "
                 "with the driver); num_learners > 0 is not supported"
             )
-        tx = optax.adam(cfg.lr)
-        if cfg.grad_clip is not None:
-            tx = optax.chain(optax.clip_by_global_norm(cfg.grad_clip), tx)
+        from ray_tpu.rllib.core.learner import make_optimizer
+
+        tx = make_optimizer(cfg)
         mesh, seed = cfg.mesh, cfg.seed
 
         def factory():
@@ -152,10 +152,12 @@ class DQN(Algorithm):
                          "replay_buffer_size": len(self.buffer)}
         if self._env_steps_total < cfg.learning_starts:
             return metrics
-        online = jax.tree.map(jnp.asarray, weights)
         target = jax.tree.map(jnp.asarray, self.target_weights)
         for _ in range(cfg.num_gradient_steps):
             mb = self.buffer.sample(cfg.train_batch_size)
+            # Fresh online params each step: double-Q action selection
+            # must track the learner, not a snapshot from before the loop.
+            online = self.learner_group.local.module.params
             mb["td_targets"] = np.asarray(self._td_targets(
                 online, target, jnp.asarray(mb[NEXT_OBS]),
                 jnp.asarray(mb[REWARDS]),

@@ -127,11 +127,15 @@ def make_impala_loss(cfg: IMPALAConfig, T: int):
 class IMPALA(Algorithm):
     config_class = IMPALAConfig
 
+    def make_loss(self, cfg):
+        """Loss factory hook; APPO overrides with the clipped variant."""
+        return make_impala_loss(cfg, cfg.rollout_fragment_length)
+
     def build_learner(self, cfg: IMPALAConfig) -> None:
-        tx = optax.adam(cfg.lr)
-        if cfg.grad_clip is not None:
-            tx = optax.chain(optax.clip_by_global_norm(cfg.grad_clip), tx)
-        loss_fn = make_impala_loss(cfg, cfg.rollout_fragment_length)
+        from ray_tpu.rllib.core.learner import make_optimizer
+
+        tx = make_optimizer(cfg)
+        loss_fn = self.make_loss(cfg)
         spec = cfg.rl_module_spec()
         mesh, seed = cfg.mesh, cfg.seed
 

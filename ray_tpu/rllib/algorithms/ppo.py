@@ -89,9 +89,9 @@ class PPO(Algorithm):
     config_class = PPOConfig
 
     def build_learner(self, cfg: PPOConfig) -> None:
-        tx = optax.adam(cfg.lr)
-        if cfg.grad_clip is not None:
-            tx = optax.chain(optax.clip_by_global_norm(cfg.grad_clip), tx)
+        from ray_tpu.rllib.core.learner import make_optimizer
+
+        tx = make_optimizer(cfg)
         loss_fn = make_ppo_loss(cfg)
         spec = cfg.rl_module_spec()
         mesh = cfg.mesh

@@ -183,9 +183,9 @@ class SAC(Algorithm):
                           if cfg.target_entropy is not None
                           else -float(cfg.action_dim))
         center, half = _action_affine(cfg.action_low, cfg.action_high)
-        tx = optax.adam(cfg.lr)
-        if cfg.grad_clip is not None:
-            tx = optax.chain(optax.clip_by_global_norm(cfg.grad_clip), tx)
+        from ray_tpu.rllib.core.learner import make_optimizer
+
+        tx = make_optimizer(cfg)
         loss_fn = make_sac_loss(cfg, center, half, target_entropy)
         mesh, seed = cfg.mesh, cfg.seed
 

@@ -21,6 +21,15 @@ import optax
 from ray_tpu.rllib.sample_batch import SampleBatch
 
 
+def make_optimizer(cfg) -> optax.GradientTransformation:
+    """The shared algorithm optimizer: adam(lr) with optional global-norm
+    clipping (reference: Learner._configure_optimizers default)."""
+    tx = optax.adam(cfg.lr)
+    if cfg.grad_clip is not None:
+        tx = optax.chain(optax.clip_by_global_norm(cfg.grad_clip), tx)
+    return tx
+
+
 class JaxLearner:
     """Owns (params, opt_state) and a compiled update step.
 

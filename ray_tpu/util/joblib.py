@@ -1,0 +1,81 @@
+"""joblib backend on cluster tasks (sklearn parallelism on the cluster).
+
+Counterpart of the reference's ray.util.joblib
+(python/ray/util/joblib/__init__.py register_ray + ray_backend.py —
+a joblib ParallelBackendBase whose effective_n_jobs is the cluster CPU
+count and whose apply_async ships batches as tasks).
+
+    from ray_tpu.util.joblib import register_ray_tpu
+    register_ray_tpu()
+    with joblib.parallel_backend("ray_tpu"):
+        Parallel(n_jobs=-1)(delayed(f)(i) for i in range(100))
+"""
+
+from __future__ import annotations
+
+
+def register_ray_tpu() -> None:
+    from joblib.parallel import register_parallel_backend
+
+    register_parallel_backend("ray_tpu", _make_backend())
+
+
+def _make_backend():
+    from joblib._parallel_backends import ParallelBackendBase
+
+    class RayTpuBackend(ParallelBackendBase):
+        supports_timeout = True
+
+        def effective_n_jobs(self, n_jobs):
+            import ray_tpu
+
+            ray_tpu.api.auto_init()
+            cpus = max(1, int(ray_tpu.cluster_resources().get("CPU", 1)))
+            if n_jobs is None:
+                return cpus
+            if n_jobs < 0:
+                # joblib convention: -1 = all CPUs, -2 = all but one, ...
+                return max(1, cpus + 1 + n_jobs)
+            return min(n_jobs, cpus)
+
+        def apply_async(self, func, callback=None):
+            import ray_tpu
+
+            @ray_tpu.remote
+            def run():
+                return func()
+
+            ref = run.remote()
+            fut = _Future(ref)
+            if callback is not None:
+                import threading
+
+                def waiter():
+                    try:
+                        callback(fut.get())
+                    except Exception:
+                        pass
+
+                threading.Thread(target=waiter, daemon=True).start()
+            return fut
+
+        def configure(self, n_jobs=1, parallel=None, **kwargs):
+            self.parallel = parallel
+            return self.effective_n_jobs(n_jobs)
+
+    return RayTpuBackend
+
+
+class _Future:
+    def __init__(self, ref):
+        self._ref = ref
+        self._result = None
+        self._done = False
+
+    def get(self, timeout=None):
+        import ray_tpu
+
+        if not self._done:
+            self._result = ray_tpu.get(self._ref, timeout=timeout)
+            self._done = True
+        return self._result

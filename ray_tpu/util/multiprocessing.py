@@ -1,0 +1,165 @@
+"""multiprocessing.Pool API over cluster tasks.
+
+Counterpart of the reference's ray.util.multiprocessing
+(python/ray/util/multiprocessing/pool.py — a Pool whose workers are Ray
+actors, drop-in for the stdlib API). Here ``processes`` bounds in-flight
+concurrency via chunked task submission; stdlib semantics covered:
+map/starmap/imap/imap_unordered/apply/apply_async + context manager.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Iterable
+
+import ray_tpu
+
+
+class AsyncResult:
+    def __init__(self, refs: list, single: bool):
+        self._refs = refs
+        self._single = single
+        self._outcome: tuple[bool, Any] | None = None  # (ok, value/exc)
+
+    def get(self, timeout: float | None = None):
+        if self._outcome is None:
+            try:
+                out = ray_tpu.get(self._refs, timeout=timeout)
+            except ray_tpu.exceptions.GetTimeoutError:
+                # Stdlib contract: Pool results raise
+                # multiprocessing.TimeoutError (NOT builtin TimeoutError).
+                import multiprocessing
+
+                raise multiprocessing.TimeoutError() from None
+            except Exception as e:  # noqa: BLE001 — stdlib Pool re-raises
+                self._outcome = (False, e)
+            else:
+                flat = [x for chunk in out for x in chunk]
+                self._outcome = (True, flat[0] if self._single else flat)
+        ok, value = self._outcome
+        if ok:
+            return value
+        raise value
+
+    def wait(self, timeout: float | None = None) -> None:
+        ray_tpu.wait(self._refs, num_returns=len(self._refs), timeout=timeout)
+
+    def ready(self) -> bool:
+        ready, _ = ray_tpu.wait(self._refs, num_returns=len(self._refs),
+                                timeout=0)
+        return len(ready) == len(self._refs)
+
+    def successful(self) -> bool:
+        if self._outcome is None:
+            try:
+                self.get()
+            except Exception:
+                pass
+        return bool(self._outcome and self._outcome[0])
+
+
+class Pool:
+    """API-compatible subset of multiprocessing.Pool on cluster tasks."""
+
+    def __init__(self, processes: int | None = None, initializer=None,
+                 initargs: tuple = ()):
+        ray_tpu.api.auto_init()
+        self._processes = processes or int(
+            ray_tpu.cluster_resources().get("CPU", 4)
+        )
+        self._initializer = initializer
+        self._initargs = initargs
+        self._closed = False
+
+    # -- helpers -----------------------------------------------------------
+
+    def _chunked_task(self):
+        init, initargs = self._initializer, self._initargs
+
+        @ray_tpu.remote
+        def run_chunk(fn: Callable, chunk: list, star: bool):
+            if init is not None:
+                init(*initargs)
+            return [fn(*args) if star else fn(args) for args in chunk]
+
+        return run_chunk
+
+    def _submit(self, fn, iterable, star: bool, chunksize: int | None):
+        items = list(iterable)
+        if not items:
+            return []
+        if chunksize is None:
+            chunksize = max(1, len(items) // (self._processes * 4) or 1)
+        task = self._chunked_task()
+        return [
+            task.remote(fn, items[i:i + chunksize], star)
+            for i in range(0, len(items), chunksize)
+        ]
+
+    # -- stdlib surface ----------------------------------------------------
+
+    def map(self, fn: Callable, iterable: Iterable, chunksize: int | None = None):
+        return self.map_async(fn, iterable, chunksize).get()
+
+    def map_async(self, fn, iterable, chunksize=None) -> AsyncResult:
+        self._check_open()
+        return AsyncResult(self._submit(fn, iterable, False, chunksize), False)
+
+    def starmap(self, fn: Callable, iterable: Iterable, chunksize=None):
+        return self.starmap_async(fn, iterable, chunksize).get()
+
+    def starmap_async(self, fn, iterable, chunksize=None) -> AsyncResult:
+        self._check_open()
+        return AsyncResult(self._submit(fn, iterable, True, chunksize), False)
+
+    def imap(self, fn: Callable, iterable: Iterable, chunksize: int = 1):
+        self._check_open()
+        refs = self._submit(fn, iterable, False, chunksize)
+        for ref in refs:
+            yield from ray_tpu.get(ref)
+
+    def imap_unordered(self, fn: Callable, iterable: Iterable, chunksize: int = 1):
+        self._check_open()
+        refs = self._submit(fn, iterable, False, chunksize)
+        pending = list(refs)
+        while pending:
+            ready, pending = ray_tpu.wait(pending, num_returns=1)
+            for ref in ready:
+                yield from ray_tpu.get(ref)
+
+    def apply(self, fn: Callable, args: tuple = (), kwargs: dict | None = None):
+        return self.apply_async(fn, args, kwargs).get()
+
+    def apply_async(self, fn, args: tuple = (), kwargs: dict | None = None) -> AsyncResult:
+        self._check_open()
+        kwargs = kwargs or {}
+        init, initargs = self._initializer, self._initargs
+
+        @ray_tpu.remote
+        def run_one():
+            if init is not None:
+                init(*initargs)
+            return [fn(*args, **kwargs)]
+
+        return AsyncResult([run_one.remote()], True)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def _check_open(self):
+        if self._closed:
+            raise ValueError("Pool is closed")
+
+    def close(self) -> None:
+        self._closed = True
+
+    terminate = close
+
+    def join(self) -> None:
+        if not self._closed:
+            raise ValueError("join() before close()")
+
+    def __enter__(self) -> "Pool":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

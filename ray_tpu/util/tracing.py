@@ -1,0 +1,100 @@
+"""User-level tracing spans, merged into the cluster timeline.
+
+Counterpart of the reference's tracing/profiling helpers
+(reference: python/ray/util/tracing/tracing_helper.py:34-127 — opt-in
+OpenTelemetry spans around task/actor calls — and _private/profiling.py:84
+``profile`` events buffered through TaskEventBuffer into `ray timeline`).
+Here spans are lightweight dicts cast to the head's task-event buffer, so
+``ray_tpu.util.state.timeline()`` renders user spans alongside task
+execution spans in the same Chrome trace. OpenTelemetry export is
+attached on top when the package is importable.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import os
+import threading
+import time
+from typing import Any
+
+_local = threading.local()
+
+
+def _emit(event: dict) -> None:
+    from ray_tpu._private.worker_context import try_runtime
+
+    rt = try_runtime()
+    if rt is None:
+        return
+    try:
+        rt.conn.cast("task_events", {"events": [event]})
+    except Exception:
+        pass
+
+
+@contextlib.contextmanager
+def span(name: str, **attributes: Any):
+    """Record a named span:
+
+        with tracing.span("preprocess", rows=123):
+            ...
+
+    Nesting is tracked per-thread; child spans carry their parent's name
+    in ``parent`` so trace viewers can reconstruct the hierarchy."""
+    parent = getattr(_local, "span_name", None)
+    _local.span_name = name
+    start = time.time()
+    error = None
+    # Optional OpenTelemetry bridge.
+    otel_cm = None
+    try:
+        from opentelemetry import trace as otel_trace  # type: ignore
+
+        otel_cm = otel_trace.get_tracer("ray_tpu").start_as_current_span(name)
+        otel_cm.__enter__()
+    except Exception:
+        otel_cm = None
+    try:
+        yield
+    except BaseException as e:
+        error = repr(e)
+        raise
+    finally:
+        if otel_cm is not None:
+            try:
+                otel_cm.__exit__(None, None, None)
+            except Exception:
+                pass
+        _local.span_name = parent
+        end = time.time()
+        from ray_tpu._private import worker_context
+
+        ctx = worker_context.get_task_context()
+        _emit({
+            "event": "span",
+            "name": name,
+            "parent": parent,
+            "task_id": getattr(ctx, "task_id", None),
+            "worker_id": None,
+            "node_id": getattr(ctx, "node_id", None),
+            "pid": os.getpid(),
+            "start": start,
+            "end": end,
+            "failed": error is not None,
+            "attributes": {**attributes, **({"error": error} if error else {})},
+        })
+
+
+def trace(fn=None, *, name: str | None = None):
+    """Decorator form of span()."""
+    def wrap(f):
+        @functools.wraps(f)
+        def inner(*args, **kwargs):
+            with span(name or f.__qualname__):
+                return f(*args, **kwargs)
+
+        return inner
+
+    return wrap(fn) if fn is not None else wrap

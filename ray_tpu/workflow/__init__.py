@@ -313,7 +313,15 @@ def run(dag: DAGNode, *, workflow_id: str | None = None) -> Any:
         )
     if meta.get("status") == "SUCCESS":
         return store.load_step(meta["output"])
-    store.save_spec(spec)
+    if meta.get("fingerprint") == fp:
+        # Same-DAG rerun of a FAILED/RUNNING workflow: resume from the
+        # STORED spec — it may contain continuation splices the freshly
+        # frozen dag doesn't; overwriting it would orphan stored
+        # continuation markers (their targets live only in the merged
+        # graph).
+        spec = store.load_spec()
+    else:
+        store.save_spec(spec)
     store.save_meta(status="RUNNING", output=spec["output"],
                     fingerprint=fp, created_at=time.time())
     return _finish(store, spec)

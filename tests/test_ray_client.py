@@ -1,0 +1,67 @@
+"""Ray-Client-style remote drivers: ray_tpu.init("ray://host:port")
+(reference: python/ray/util/client — remote driver proxying over gRPC;
+here the same wire protocol with inline object shipping)."""
+
+import subprocess
+import sys
+
+import pytest
+
+import ray_tpu
+
+
+def test_ray_scheme_remote_driver():
+    """A second driver process connects via ray:// and round-trips tasks,
+    actors, puts, and named-actor lookup against this process's head."""
+    ray_tpu.init(num_cpus=4, object_store_memory=64 * 1024 * 1024)
+    try:
+        from ray_tpu._private.worker_context import global_runtime
+
+        host, port = global_runtime().address
+
+        @ray_tpu.remote
+        class Registry:
+            def __init__(self):
+                self.items = []
+
+            def add(self, x):
+                self.items.append(x)
+                return len(self.items)
+
+            def all(self):
+                return self.items
+
+        reg = Registry.options(name="registry", lifetime="detached").remote()
+        ray_tpu.get(reg.add.remote("from-head"))
+
+        script = f"""
+import numpy as np
+import ray_tpu
+ray_tpu.init("ray://{host}:{port}")
+assert ray_tpu.is_initialized()
+
+@ray_tpu.remote
+def double(x):
+    return x * 2
+
+assert ray_tpu.get(double.remote(21)) == 42
+# Large object: ships inline (no shm on a remote driver).
+arr = np.arange(100_000, dtype=np.float64)
+ref = ray_tpu.put(arr)
+assert float(ray_tpu.get(ref).sum()) == float(arr.sum())
+# Named actor from the other driver.
+reg = ray_tpu.get_actor("registry")
+n = ray_tpu.get(reg.add.remote("from-client"))
+assert n == 2, n
+ray_tpu.shutdown()
+print("CLIENT_OK")
+"""
+        proc = subprocess.run(
+            [sys.executable, "-c", script], capture_output=True, text=True,
+            timeout=120,
+        )
+        assert "CLIENT_OK" in proc.stdout, (proc.stdout, proc.stderr)
+        assert ray_tpu.get(reg.all.remote()) == ["from-head", "from-client"]
+        ray_tpu.kill(reg)
+    finally:
+        ray_tpu.shutdown()

@@ -1,0 +1,107 @@
+"""util bridges: multiprocessing.Pool, joblib backend, tracing spans,
+usage stats (reference: ray.util.multiprocessing/joblib tests,
+tracing_helper tests, usage_lib tests)."""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.multiprocessing import Pool
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _cluster():
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4, object_store_memory=64 * 1024 * 1024)
+    yield
+    ray_tpu.shutdown()
+
+
+def _sq(x):
+    return x * x
+
+
+def _add(a, b):
+    return a + b
+
+
+def test_pool_map_and_starmap():
+    with Pool(processes=2) as p:
+        assert p.map(_sq, range(10)) == [x * x for x in range(10)]
+        assert p.starmap(_add, [(1, 2), (3, 4)]) == [3, 7]
+
+
+def test_pool_apply_and_async():
+    p = Pool(processes=2)
+    assert p.apply(_add, (20, 22)) == 42
+    r = p.apply_async(_sq, (7,))
+    assert r.get(timeout=30) == 49
+    assert r.successful()
+    p.close()
+    with pytest.raises(ValueError):
+        p.map(_sq, [1])
+
+
+def test_pool_imap_orders():
+    with Pool(processes=2) as p:
+        assert list(p.imap(_sq, range(6), chunksize=2)) == [0, 1, 4, 9, 16, 25]
+        assert sorted(p.imap_unordered(_sq, range(6), chunksize=2)) == sorted(
+            x * x for x in range(6)
+        )
+
+
+def test_joblib_backend():
+    joblib = pytest.importorskip("joblib")
+    from ray_tpu.util.joblib import register_ray_tpu
+
+    register_ray_tpu()
+    with joblib.parallel_backend("ray_tpu"):
+        out = joblib.Parallel(n_jobs=2)(
+            joblib.delayed(_sq)(i) for i in range(8)
+        )
+    assert out == [i * i for i in range(8)]
+
+
+def test_tracing_spans_land_in_timeline():
+    from ray_tpu.util import state, tracing
+
+    @ray_tpu.remote
+    def traced_task():
+        with tracing.span("inner-work", rows=10):
+            time.sleep(0.01)
+        return "ok"
+
+    assert ray_tpu.get(traced_task.remote()) == "ok"
+    deadline = time.time() + 10
+    names = []
+    while time.time() < deadline:
+        events = state.get_task_events()
+        names = [e.get("name") for e in events if e.get("event") == "span"]
+        if "inner-work" in names:
+            break
+        time.sleep(0.1)
+    assert "inner-work" in names
+
+    @tracing.trace
+    def decorated():
+        return 5
+
+    assert decorated() == 5
+
+
+def test_usage_stats_file_written():
+    from ray_tpu._private.worker_context import get_head
+
+    head = get_head()
+    path = os.path.join(head.session_dir, "usage_stats.json")
+    assert os.path.exists(path)
+    with open(path) as f:
+        payload = json.load(f)
+    assert payload["ray_tpu_version"]
+    assert payload["total_num_cpus"] == 4

@@ -226,3 +226,25 @@ def test_success_id_with_different_dag_raises():
         workflow.run(val.bind(2), workflow_id=wid)
     # Same DAG still returns the cached result.
     assert workflow.run(val.bind(1), workflow_id=wid) == 1
+
+
+def test_run_rerun_resumes_continuation(tmp_path):
+    """run() (not resume) re-invoked after a failure inside a continuation
+    must pick up the merged spec, not clobber it (regression)."""
+    flag = tmp_path / "go"
+
+    @ray_tpu.remote(max_retries=0)
+    def parent(flag_path):
+        return workflow.continuation(child.bind(flag_path))
+
+    @ray_tpu.remote(max_retries=0)
+    def child(flag_path):
+        if not os.path.exists(flag_path):
+            raise RuntimeError("first attempt fails")
+        return 99
+
+    wid = _wid()
+    with pytest.raises(Exception):
+        workflow.run(parent.bind(str(flag)), workflow_id=wid)
+    flag.write_text("ok")
+    assert workflow.run(parent.bind(str(flag)), workflow_id=wid) == 99
