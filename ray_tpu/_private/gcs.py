@@ -376,9 +376,7 @@ class Head:
         retry elsewhere; the node leaves the schedulable set."""
         with self.lock:
             self.node_agents.pop(node_id, None)
-            node = self.scheduler.nodes.get(node_id)
-            if node is not None:
-                node.alive = False
+            self.scheduler.mark_dead(node_id)
             doomed = [r for r in self.workers.values() if r.node_id == node_id]
         for rec in doomed:
             self._handle_worker_death(rec)

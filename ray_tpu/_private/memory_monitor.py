@@ -95,12 +95,11 @@ class MemoryMonitor:
         now = time.time()
         if now - self._last_kill < self._min_kill_interval:
             return False  # give the previous kill time to free memory
-        victim = self._pick_victim()
+        victim, task_names = self._pick_victim()
         if victim is None:
             return False
         self._last_kill = now
         self.num_kills += 1
-        task_names = [s.name for s in victim.inflight.values()]
         self._head.metrics["memory_monitor_kills"] = self.num_kills
         self._head.task_events.append({
             "event": "oom_kill",
@@ -114,20 +113,32 @@ class MemoryMonitor:
         return True
 
     def _pick_victim(self):
+        """Returns (victim, its task names) — names snapshotted under the
+        head lock (the inflight dict mutates concurrently as tasks finish).
+        Only workers on the HEAD's node are candidates: the monitor
+        measures this host's memory, and killing a remote worker cannot
+        relieve it (remote nodes run their own monitors)."""
         head = self._head
         with head.lock:
-            busy = [r for r in head.workers.values() if r.inflight]
+            busy = [
+                r for r in head.workers.values()
+                if r.inflight and r.node_id == head.node_id
+            ]
             newest = sorted(busy, key=lambda r: -r.started_at)
+
+            def result(r):
+                return r, [s.name for s in r.inflight.values()]
+
             # 1. retriable normal tasks, newest first.
             for r in newest:
                 if r.actor_id is None and all(
                     s.retries_used < s.max_retries for s in r.inflight.values()
                 ):
-                    return r
+                    return result(r)
             # 2. any normal task.
             for r in newest:
                 if r.actor_id is None:
-                    return r
+                    return result(r)
             # 3. restartable actors only.
             for r in newest:
                 actor = head.actors.get(r.actor_id)
@@ -135,8 +146,8 @@ class MemoryMonitor:
                     continue
                 mr = actor.spec.max_restarts
                 if mr != 0 and (mr < 0 or actor.restarts < mr):
-                    return r
-        return None
+                    return result(r)
+        return None, []
 
     def _kill(self, victim) -> None:
         # Kill the process; the connection close triggers

@@ -131,14 +131,40 @@ class ClusterScheduler:
         self.nodes: dict[str, NodeEntry] = {}
         self.spread_threshold = spread_threshold
         self._rr_counter = 0
+        # C++ scheduler core (src/scheduler/scheduler.cc): membership and
+        # acquire/release are mirrored; the hybrid/SPREAD pick runs native
+        # (reference: the decision lives in C++ ClusterResourceScheduler,
+        # cluster_resource_scheduler.h:46). Absent the .so, the pure-Python
+        # path below is authoritative.
+        self._native = None
+        try:
+            from ray_tpu._private.native_sched import NativeScheduler, available
+
+            if available():
+                self._native = NativeScheduler(spread_threshold)
+        except Exception:
+            self._native = None
 
     # --- membership ---
 
     def add_node(self, node: NodeEntry) -> None:
         self.nodes[node.node_id] = node
+        if self._native is not None:
+            self._native.add_node(
+                node.node_id, node.total.to_dict(), node.available.to_dict()
+            )
 
     def remove_node(self, node_id: str) -> None:
         self.nodes.pop(node_id, None)
+        if self._native is not None:
+            self._native.remove_node(node_id)
+
+    def mark_dead(self, node_id: str) -> None:
+        node = self.nodes.get(node_id)
+        if node is not None:
+            node.alive = False
+        if self._native is not None:
+            self._native.set_alive(node_id, False)
 
     def alive_nodes(self) -> list[NodeEntry]:
         return [n for n in self.nodes.values() if n.alive]
@@ -156,6 +182,11 @@ class ClusterScheduler:
             if not strategy.soft:
                 return None
             # fall through to default policy
+        if self._native is not None:
+            picked = self._native.pick_node(
+                demand.to_dict(), spread=strategy == "SPREAD"
+            )
+            return self.nodes.get(picked) if picked is not None else None
         feasible = [n for n in nodes if n.total.fits(demand)]
         available = [n for n in feasible if n.available.fits(demand)]
         if not available:
@@ -179,12 +210,16 @@ class ClusterScheduler:
         if node is None or not node.available.fits(demand):
             return False
         node.available.subtract(demand)
+        if self._native is not None:
+            self._native.acquire(node_id, demand.to_dict())
         return True
 
     def release(self, node_id: str, demand: ResourceSet) -> None:
         node = self.nodes.get(node_id)
         if node is not None:
             node.available.add(demand)
+            if self._native is not None:
+                self._native.release(node_id, demand.to_dict())
 
     # --- placement groups ---
 

@@ -14,6 +14,17 @@ from typing import Any, Callable, Iterable
 
 import ray_tpu
 
+# Worker-process-local record of pools whose initializer already ran here —
+# stdlib contract: initializer fires once per worker process, not per task.
+_initialized_pools: set = set()
+
+
+def _maybe_init(pool_id: str, init, initargs) -> None:
+    if init is None or pool_id in _initialized_pools:
+        return
+    _initialized_pools.add(pool_id)
+    init(*initargs)
+
 
 class AsyncResult:
     def __init__(self, refs: list, single: bool):
@@ -63,23 +74,25 @@ class Pool:
 
     def __init__(self, processes: int | None = None, initializer=None,
                  initargs: tuple = ()):
+        import uuid
+
         ray_tpu.api.auto_init()
         self._processes = processes or int(
             ray_tpu.cluster_resources().get("CPU", 4)
         )
         self._initializer = initializer
         self._initargs = initargs
+        self._pool_id = uuid.uuid4().hex  # once-per-worker initializer key
         self._closed = False
 
     # -- helpers -----------------------------------------------------------
 
     def _chunked_task(self):
-        init, initargs = self._initializer, self._initargs
+        init, initargs, pool_id = self._initializer, self._initargs, self._pool_id
 
         @ray_tpu.remote
         def run_chunk(fn: Callable, chunk: list, star: bool):
-            if init is not None:
-                init(*initargs)
+            _maybe_init(pool_id, init, initargs)
             return [fn(*args) if star else fn(args) for args in chunk]
 
         return run_chunk
@@ -133,12 +146,11 @@ class Pool:
     def apply_async(self, fn, args: tuple = (), kwargs: dict | None = None) -> AsyncResult:
         self._check_open()
         kwargs = kwargs or {}
-        init, initargs = self._initializer, self._initargs
+        init, initargs, pool_id = self._initializer, self._initargs, self._pool_id
 
         @ray_tpu.remote
         def run_one():
-            if init is not None:
-                init(*initargs)
+            _maybe_init(pool_id, init, initargs)
             return [fn(*args, **kwargs)]
 
         return AsyncResult([run_one.remote()], True)

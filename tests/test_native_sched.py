@@ -1,0 +1,101 @@
+"""C++ scheduler core: differential equivalence against the pure-Python
+policy (reference analogue: cluster_task_manager_test.cc /
+hybrid_scheduling_policy_test.cc — in-process scheduler tests with fake
+resource views; here the Python implementation is the oracle)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from ray_tpu._private import native_sched
+from ray_tpu._private.scheduler import ClusterScheduler, NodeEntry, ResourceSet
+
+pytestmark = pytest.mark.skipif(
+    not native_sched.available(), reason="libsched.so not built"
+)
+
+
+def make_pair(threshold=0.5):
+    nat = ClusterScheduler(threshold)
+    assert nat._native is not None, "native core must load for this test"
+    py = ClusterScheduler(threshold)
+    py._native = None
+    return nat, py
+
+
+def add(s: ClusterScheduler, nid: str, **res):
+    s.add_node(NodeEntry(node_id=nid, address="x", total=ResourceSet(res),
+                         available=ResourceSet(res)))
+
+
+def test_differential_hybrid_fuzz():
+    rng = np.random.default_rng(0)
+    nat, py = make_pair()
+    node_ids = [f"node-{i:02d}" for i in range(6)]
+    for nid in node_ids:
+        res = {"CPU": float(rng.integers(2, 16)),
+               "memory": float(rng.integers(1, 8) * 1024)}
+        if rng.random() < 0.5:
+            res["TPU"] = float(rng.integers(1, 8))
+        add(nat, nid, **res)
+        add(py, nid, **res)
+
+    held: list[tuple[str, ResourceSet]] = []
+    for step in range(300):
+        r = rng.random()
+        if r < 0.6:  # schedule something
+            demand = ResourceSet({"CPU": float(rng.integers(1, 4))})
+            if rng.random() < 0.3:
+                demand = ResourceSet({"CPU": 1.0, "TPU": float(rng.integers(1, 4))})
+            pick_n = nat.pick_node(demand)
+            pick_p = py.pick_node(demand)
+            assert (pick_n is None) == (pick_p is None), step
+            if pick_n is not None:
+                assert pick_n.node_id == pick_p.node_id, (
+                    step, pick_n.node_id, pick_p.node_id,
+                    {n.node_id: round(n.utilization(), 4) for n in py.alive_nodes()},
+                )
+                assert nat.acquire(pick_n.node_id, demand)
+                assert py.acquire(pick_p.node_id, demand)
+                held.append((pick_n.node_id, demand))
+        elif held:  # release something
+            idx = int(rng.integers(0, len(held)))
+            nid, demand = held.pop(idx)
+            nat.release(nid, demand)
+            py.release(nid, demand)
+
+
+def test_native_infeasible_and_death():
+    nat, _ = make_pair()
+    add(nat, "a", CPU=4)
+    add(nat, "b", CPU=8)
+    # Infeasible everywhere.
+    assert nat.pick_node(ResourceSet({"CPU": 100})) is None
+    # Feasible on b only.
+    picked = nat.pick_node(ResourceSet({"CPU": 6}))
+    assert picked.node_id == "b"
+    nat.mark_dead("b")
+    assert nat.pick_node(ResourceSet({"CPU": 6})) is None
+
+
+def test_native_spread_prefers_least_utilized():
+    nat, _ = make_pair()
+    add(nat, "a", CPU=10)
+    add(nat, "b", CPU=10)
+    assert nat.acquire("a", ResourceSet({"CPU": 8}))
+    for _ in range(5):
+        picked = nat.pick_node(ResourceSet({"CPU": 1}), strategy="SPREAD")
+        assert picked.node_id == "b"
+
+
+def test_native_pack_below_threshold():
+    nat, _ = make_pair(threshold=0.5)
+    add(nat, "a", CPU=10)
+    add(nat, "b", CPU=10)
+    assert nat.acquire("a", ResourceSet({"CPU": 3}))  # util 0.3 < 0.5
+    # Hybrid packs onto the most utilized below-threshold node.
+    assert nat.pick_node(ResourceSet({"CPU": 1})).node_id == "a"
+    assert nat.acquire("a", ResourceSet({"CPU": 3}))  # util 0.6 now
+    # a is over threshold: spread to b.
+    assert nat.pick_node(ResourceSet({"CPU": 1})).node_id == "b"
