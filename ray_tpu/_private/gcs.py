@@ -423,6 +423,16 @@ class Head:
             "session_dir": self.session_dir,
         }
 
+    def _h_oom_pressure(self, body: dict, conn: rpc.Connection):
+        """A node agent reports host memory pressure: run the kill policy
+        scoped to that node (the agent has no task/worker tables)."""
+        if self.memory_monitor is not None:
+            self.memory_monitor.kill_on_node(
+                body["node_id"], body.get("used_bytes", 0),
+                body.get("total_bytes", 0),
+            )
+        return None
+
     def _h_register_node(self, body: dict, conn: rpc.Connection):
         """A node agent joins the cluster (reference: raylet registration
         with the GCS node table, gcs_node_manager.h:49)."""

@@ -88,14 +88,21 @@ class MemoryMonitor:
                 pass  # monitoring must never take the head down
 
     def tick(self) -> bool:
-        """One poll; returns True if a worker was killed."""
+        """One poll of the HEAD host; returns True if a worker was killed."""
         used, total = self._usage_fn()
         if total <= 0 or used / total < self._threshold:
             return False
+        return self.kill_on_node(self._head.node_id, used, total)
+
+    def kill_on_node(self, node_id: str, used: int, total: int) -> bool:
+        """Apply the kill policy to one node's workers (the head's own
+        tick, or a remote node agent reporting pressure via
+        'oom_pressure'). Rate-limited globally so one kill gets time to
+        free memory before the next."""
         now = time.time()
         if now - self._last_kill < self._min_kill_interval:
-            return False  # give the previous kill time to free memory
-        victim, task_names = self._pick_victim()
+            return False
+        victim, task_names = self._pick_victim(node_id)
         if victim is None:
             return False
         self._last_kill = now
@@ -104,6 +111,7 @@ class MemoryMonitor:
         self._head.task_events.append({
             "event": "oom_kill",
             "worker_id": victim.worker_id,
+            "node_id": node_id,
             "tasks": task_names,
             "used_bytes": used,
             "total_bytes": total,
@@ -112,17 +120,17 @@ class MemoryMonitor:
         self._kill(victim)
         return True
 
-    def _pick_victim(self):
+    def _pick_victim(self, node_id: str):
         """Returns (victim, its task names) — names snapshotted under the
         head lock (the inflight dict mutates concurrently as tasks finish).
-        Only workers on the HEAD's node are candidates: the monitor
-        measures this host's memory, and killing a remote worker cannot
-        relieve it (remote nodes run their own monitors)."""
+        Candidates are scoped to ``node_id``: memory pressure is per-host,
+        and killing a worker elsewhere cannot relieve it. Remote nodes'
+        agents measure their own memory and report via 'oom_pressure'."""
         head = self._head
         with head.lock:
             busy = [
                 r for r in head.workers.values()
-                if r.inflight and r.node_id == head.node_id
+                if r.inflight and r.node_id == node_id
             ]
             newest = sorted(busy, key=lambda r: -r.started_at)
 

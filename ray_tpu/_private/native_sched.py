@@ -10,9 +10,8 @@ from __future__ import annotations
 
 import ctypes
 import os
-import threading
 
-_FP = 10_000  # fixed-point scale, matches scheduler._fp
+from ray_tpu._private.scheduler import GRANULARITY as _FP  # shared fp scale
 
 
 def _load():

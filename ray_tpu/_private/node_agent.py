@@ -58,6 +58,32 @@ class NodeAgent:
         )
         self.node_id = reply["node_id"]
         self.session_dir = reply["session_dir"]
+        # OOM protection for THIS node: the agent watches local memory and
+        # reports pressure; the head (which owns the worker/task tables and
+        # the retriable-first policy) picks and kills a victim scoped to
+        # this node (reference: per-raylet MemoryMonitor, memory_monitor.h).
+        self._mem_thread = threading.Thread(
+            target=self._memory_watch, daemon=True, name="agent-mem-watch"
+        )
+        self._mem_thread.start()
+
+    def _memory_watch(self) -> None:
+        from ray_tpu._private.memory_monitor import system_memory_usage
+
+        cfg = GLOBAL_CONFIG
+        if not cfg.memory_monitor_enabled or cfg.memory_usage_threshold >= 1.0:
+            return
+        while not self._exit.wait(cfg.memory_monitor_interval_s):
+            try:
+                used, total = system_memory_usage()
+                if total > 0 and used / total >= cfg.memory_usage_threshold:
+                    self.conn.cast("oom_pressure", {
+                        "node_id": self.node_id,
+                        "used_bytes": used,
+                        "total_bytes": total,
+                    })
+            except Exception:
+                pass
 
     @staticmethod
     def _detect_resources(num_cpus, num_tpus, resources) -> dict:

@@ -21,6 +21,25 @@ from typing import Iterable
 GRANULARITY = 10000  # 1e-4 units
 
 
+def _round4(x: float) -> int:
+    """Deterministic 4-decimal utilization rounding shared with the C++
+    core (scheduler.cc Round4): floor(x·1e4 + 0.5) over the SAME double
+    math on both sides — Python's round() (decimal, half-even) and C++
+    std::round (half-away) disagree on edge values."""
+    import math
+
+    return math.floor(x * 10000.0 + 0.5)
+
+
+def _fnv1a(s: str) -> int:
+    """64-bit FNV-1a — the deterministic SPREAD tie-break hash, identical
+    in scheduler.cc."""
+    h = 0xCBF29CE484222325
+    for b in s.encode():
+        h = ((h ^ b) * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
+    return h
+
+
 def _fp(v: float) -> int:
     return round(v * GRANULARITY)
 
@@ -192,18 +211,21 @@ class ClusterScheduler:
         if not available:
             return None
         if strategy == "SPREAD":
-            # least utilized first, round-robin tiebreak
+            # Least utilized first, deterministic round-robin tiebreak.
+            # FNV-1a (not Python's randomized str hash) so the C++ core
+            # makes bit-identical picks (scheduler.cc).
             self._rr_counter += 1
             return min(
                 available,
-                key=lambda n: (round(n.utilization(), 4), (hash(n.node_id) + self._rr_counter) % len(available)),
+                key=lambda n: (_round4(n.utilization()),
+                               (_fnv1a(n.node_id) + self._rr_counter) % len(available)),
             )
         # hybrid: among nodes below the utilization threshold, pack onto the
         # most utilized (minimize fragmentation); else spread to least.
         below = [n for n in available if n.utilization() < self.spread_threshold]
         if below:
-            return max(below, key=lambda n: (round(n.utilization(), 4), n.node_id))
-        return min(available, key=lambda n: (round(n.utilization(), 4), n.node_id))
+            return max(below, key=lambda n: (_round4(n.utilization()), n.node_id))
+        return min(available, key=lambda n: (_round4(n.utilization()), n.node_id))
 
     def acquire(self, node_id: str, demand: ResourceSet) -> bool:
         node = self.nodes.get(node_id)

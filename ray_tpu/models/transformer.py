@@ -76,6 +76,11 @@ class TransformerConfig:
     # 1/3 FLOP overhead. Small models should prefer "dots".
     remat_policy: str = "full"       # "full" | "dots"
     scan_layers: bool = True         # lax.scan over layers vs unrolled loop
+    # Chunked LM-head loss: compute logits/CE in chunks of this many
+    # tokens inside a remat'd scan, so the [B,T,vocab] float32 logits
+    # tensor is never materialized (peak-memory, not FLOPs, is what caps
+    # batch size on a single chip). 0 = off (single fused head matmul).
+    loss_chunk: int = 0
     # Mixture of Experts (llama arch only; 0 = dense FFN). Greenfield vs
     # the reference (SURVEY.md §2.4: EP absent upstream) — see ops/moe.py.
     n_experts: int = 0
@@ -335,13 +340,16 @@ _BATCH = (AXIS_DATA, AXIS_FSDP)
 
 
 def forward(params, tokens, config: TransformerConfig, *, mesh=None,
-            positions=None, return_aux: bool = False):
+            positions=None, return_aux: bool = False,
+            return_hidden: bool = False):
     """Logits for ``tokens`` [B, T] → [B, T, vocab] (float32).
 
     ``mesh`` adds with_sharding_constraint annotations on activations
     (batch over data+fsdp, heads/ffn over tensor); pass None outside pjit.
     ``return_aux`` additionally returns the mean per-layer router
-    load-balance loss (MoE models; 0 for dense).
+    load-balance loss (MoE models; 0 for dense). ``return_hidden`` skips
+    the LM head and returns the final normed hidden states [B, T, D]
+    (the chunked-loss path applies the head itself).
     """
     c = config
     dt = c.compute_dtype
@@ -394,6 +402,8 @@ def forward(params, tokens, config: TransformerConfig, *, mesh=None,
         x = layer_norm(x, params["final_norm"]["w"], params["final_norm"]["b"])
     else:
         x = rms_norm(x, params["final_norm"]["w"])
+    if return_hidden:
+        return (x, aux) if return_aux else x
     head = (params["embed"]["tokens"].T if c.tied else params["lm_head"])
     logits = jnp.einsum("btd,dv->btv", x, head.astype(dt),
                         preferred_element_type=jnp.float32)
@@ -491,6 +501,57 @@ def cross_entropy_loss(logits, targets, *, mask=None, z_loss: float = 0.0):
                   "perplexity": jnp.exp(jnp.minimum(loss, 20.0))}
 
 
+def chunked_ce_loss(x, head, targets, *, mask=None, z_loss: float = 0.0,
+                    chunk: int = 2048):
+    """CE over a chunked LM head: x [B,T,D] (final hidden), head [D,V].
+
+    Logits exist only chunk-at-a-time inside a remat'd lax.scan — the
+    backward pass recomputes each chunk's logits instead of keeping the
+    [B,T,V] float32 tensor alive, trading ~1 extra head matmul for
+    gigabytes of HBM (what actually caps batch size on one chip)."""
+    B, T, D = x.shape
+    N = B * T
+    xf = x.reshape(N, D)
+    tf = targets.reshape(N)
+    mf = (mask.reshape(N).astype(jnp.float32) if mask is not None
+          else jnp.ones((N,), jnp.float32))
+    chunk = min(chunk, N)
+    n_chunks = (N + chunk - 1) // chunk
+    pad = n_chunks * chunk - N
+    if pad:
+        xf = jnp.concatenate([xf, jnp.zeros((pad, D), xf.dtype)])
+        tf = jnp.concatenate([tf, jnp.zeros((pad,), tf.dtype)])
+        mf = jnp.concatenate([mf, jnp.zeros((pad,), mf.dtype)])
+    xc = xf.reshape(n_chunks, chunk, D)
+    tc = tf.reshape(n_chunks, chunk)
+    mc = mf.reshape(n_chunks, chunk)
+
+    @jax.checkpoint
+    def body(carry, xs):
+        nll_sum, correct_sum = carry
+        xb, tb, mb = xs
+        logits = jnp.einsum("cd,dv->cv", xb, head,
+                            preferred_element_type=jnp.float32)
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, tb[:, None], axis=-1)[:, 0]
+        nll = lse - gold
+        if z_loss:
+            nll = nll + z_loss * jnp.square(lse)
+        correct = (logits.argmax(-1) == tb).astype(jnp.float32)
+        return (nll_sum + (nll * mb).sum(),
+                correct_sum + (correct * mb).sum()), None
+
+    (nll_sum, correct_sum), _ = jax.lax.scan(
+        body, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
+        (xc, tc, mc),
+    )
+    denom = jnp.maximum(mf.sum(), 1.0)
+    loss = nll_sum / denom
+    acc = correct_sum / denom
+    return loss, {"loss": loss, "accuracy": acc,
+                  "perplexity": jnp.exp(jnp.minimum(loss, 20.0))}
+
+
 def lm_loss(params, batch, config: TransformerConfig, *, mesh=None,
             z_loss: float = 0.0):
     """Next-token LM loss. batch: {"tokens": [B,T]} (targets = shift) or
@@ -504,8 +565,18 @@ def lm_loss(params, batch, config: TransformerConfig, *, mesh=None,
         mask = batch.get("mask")
         if mask is not None:
             mask = mask[:, 1:]
-    logits, aux = forward(params, inp, config, mesh=mesh, return_aux=True)
-    loss, metrics = cross_entropy_loss(logits, tgt, mask=mask, z_loss=z_loss)
+    if config.loss_chunk > 0:
+        x, aux = forward(params, inp, config, mesh=mesh, return_aux=True,
+                         return_hidden=True)
+        head = (params["embed"]["tokens"].T if config.tied
+                else params["lm_head"]).astype(config.compute_dtype)
+        loss, metrics = chunked_ce_loss(x, head, tgt, mask=mask,
+                                        z_loss=z_loss,
+                                        chunk=config.loss_chunk)
+    else:
+        logits, aux = forward(params, inp, config, mesh=mesh, return_aux=True)
+        loss, metrics = cross_entropy_loss(logits, tgt, mask=mask,
+                                           z_loss=z_loss)
     if config.n_experts > 0:
         loss = loss + config.router_aux_weight * aux
         metrics = dict(metrics, router_aux=aux, loss=loss)

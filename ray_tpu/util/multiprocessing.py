@@ -22,8 +22,11 @@ _initialized_pools: set = set()
 def _maybe_init(pool_id: str, init, initargs) -> None:
     if init is None or pool_id in _initialized_pools:
         return
-    _initialized_pools.add(pool_id)
+    # Record success only AFTER the initializer returns: a transient
+    # failure must be retried on this worker's next task, not silently
+    # skipped leaving every later task uninitialized.
     init(*initargs)
+    _initialized_pools.add(pool_id)
 
 
 class AsyncResult:

@@ -61,8 +61,21 @@ struct Sched {
   uint64_t rr = 0;
 };
 
-// Round to 4 decimals, matching the Python tie-break rounding.
-double Round4(double x) { return std::round(x * 10000.0) / 10000.0; }
+// Deterministic 4-decimal utilization rounding, bit-identical to the
+// Python oracle's _round4 (floor(x*1e4 + 0.5) over doubles).
+int64_t Round4(double x) {
+  return static_cast<int64_t>(std::floor(x * 10000.0 + 0.5));
+}
+
+// 64-bit FNV-1a, identical to scheduler._fnv1a (SPREAD tie-break).
+uint64_t Fnv1a(const std::string& s) {
+  uint64_t h = 0xCBF29CE484222325ULL;
+  for (unsigned char c : s) {
+    h ^= c;
+    h *= 0x100000001B3ULL;
+  }
+  return h;
+}
 
 }  // namespace
 
@@ -119,35 +132,31 @@ void sched_release(void* h, int64_t key, int n, const uint32_t* ids,
 }
 
 // strategy: 0 = hybrid (default), 1 = SPREAD.
-// Returns the chosen node key, or -1 if no node currently fits, or -2 if no
-// node could EVER fit (infeasible: total < demand everywhere) — the caller
-// distinguishes queue-and-wait from reject.
+// Returns the chosen node key, or -1 if no node currently fits (the head
+// queues the task either way, exactly like the Python policy).
 int64_t sched_pick_node(void* h, int n, const uint32_t* ids,
                         const int64_t* amts, int strategy) {
   auto* s = static_cast<Sched*>(h);
-  bool any_feasible = false;
   const Node* best = nullptr;
   int64_t best_key = -1;
-  double best_score = 0.0;
+  int64_t best_score = 0;
 
   std::vector<std::pair<int64_t, const Node*>> available;
   for (const auto& [key, node] : s->nodes) {
     if (!node.alive) continue;
     if (!Node::Fits(node.total, n, ids, amts)) continue;
-    any_feasible = true;
     if (Node::Fits(node.avail, n, ids, amts)) available.emplace_back(key, &node);
   }
-  if (available.empty()) return any_feasible ? -1 : -2;
+  if (available.empty()) return -1;
 
-  if (strategy == 1) {  // SPREAD: least utilized, rr tie-break
+  if (strategy == 1) {  // SPREAD: least utilized, deterministic rr tie-break
     s->rr++;
     size_t m = available.size();
-    best = nullptr;
     uint64_t best_tb = 0;
     for (size_t i = 0; i < m; i++) {
       const auto& [key, node] = available[i];
-      double u = Round4(node->Utilization());
-      uint64_t tb = (std::hash<std::string>{}(node->name) + s->rr) % m;
+      int64_t u = Round4(node->Utilization());
+      uint64_t tb = (Fnv1a(node->name) + s->rr) % m;
       if (best == nullptr || u < best_score ||
           (u == best_score && tb < best_tb)) {
         best = node;
@@ -168,7 +177,7 @@ int64_t sched_pick_node(void* h, int n, const uint32_t* ids,
 
   if (!below.empty()) {
     for (const auto& [key, node] : below) {
-      double u = Round4(node->Utilization());
+      int64_t u = Round4(node->Utilization());
       if (best == nullptr || u > best_score ||
           (u == best_score && node->name > best->name)) {
         best = node;
@@ -179,7 +188,7 @@ int64_t sched_pick_node(void* h, int n, const uint32_t* ids,
     return best_key;
   }
   for (const auto& [key, node] : available) {
-    double u = Round4(node->Utilization());
+    int64_t u = Round4(node->Utilization());
     if (best == nullptr || u < best_score ||
         (u == best_score && node->name < best->name)) {
       best = node;

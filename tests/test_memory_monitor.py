@@ -104,3 +104,41 @@ def test_non_restartable_actor_never_killed():
     assert mon.tick() is False  # nothing killable → no kill
     assert ray_tpu.get(ref) == "done"
     ray_tpu.kill(a)
+
+
+def test_oom_pressure_message_from_agent_kills_on_that_node():
+    """The head's oom_pressure handler (fed by remote node agents) applies
+    the kill policy scoped to the reporting node."""
+    head = _head()
+    assert head.memory_monitor is not None
+    head.memory_monitor._min_kill_interval = 0.0
+
+    @ray_tpu.remote(max_retries=1)
+    def hang(path):
+        if not os.path.exists(path):
+            with open(path, "w") as f:
+                f.write("x")
+            time.sleep(30)
+        return "recovered"
+
+    path = f"/tmp/ray_tpu_oomagent_{os.getpid()}"
+    try:
+        ref = hang.remote(path)
+        deadline = time.time() + 10
+        while not os.path.exists(path) and time.time() < deadline:
+            time.sleep(0.05)
+        kills_before = head.memory_monitor.num_kills
+        # Pressure on an unknown node: no candidates there, nothing killed.
+        head._h_oom_pressure({"node_id": "node-nonexistent",
+                              "used_bytes": 99, "total_bytes": 100}, None)
+        assert head.memory_monitor.num_kills == kills_before
+        # Pressure on the task's node: the worker is killed and retries.
+        head._h_oom_pressure({"node_id": head.node_id,
+                              "used_bytes": 99, "total_bytes": 100}, None)
+        assert head.memory_monitor.num_kills == kills_before + 1
+        assert ray_tpu.get(ref, timeout=30) == "recovered"
+    finally:
+        try:
+            os.remove(path)
+        except OSError:
+            pass

@@ -147,3 +147,27 @@ def test_partition_specs_mirror_params(arch):
 def test_param_counts():
     assert 120e6 < models.gpt2_small().num_params() < 170e6
     assert 6e9 < models.llama2_7b().num_params() < 7.5e9
+
+
+def test_chunked_ce_matches_dense_loss():
+    """loss_chunk path must agree with the fused-logits path (same params,
+    same batch) — it is a memory layout change, not a numerics change."""
+    import jax
+    import numpy as np
+
+    from ray_tpu.models import transformer as tfm
+
+    c_dense = tfm.tiny(dtype="float32")
+    c_chunk = tfm.tiny(dtype="float32", loss_chunk=64)
+    params = tfm.init_params(jax.random.PRNGKey(0), c_dense)
+    batch = {"tokens": jax.random.randint(jax.random.PRNGKey(1), (2, 33), 0,
+                                          c_dense.vocab_size)}
+    l1, m1 = tfm.lm_loss(params, batch, c_dense)
+    l2, m2 = tfm.lm_loss(params, batch, c_chunk)
+    assert np.allclose(float(l1), float(l2), rtol=1e-5)
+    assert np.allclose(float(m1["accuracy"]), float(m2["accuracy"]))
+    # Gradients agree too.
+    g1 = jax.grad(lambda p: tfm.lm_loss(p, batch, c_dense)[0])(params)
+    g2 = jax.grad(lambda p: tfm.lm_loss(p, batch, c_chunk)[0])(params)
+    for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+        assert np.allclose(np.asarray(a), np.asarray(b), atol=1e-4)

@@ -48,8 +48,9 @@ def test_differential_hybrid_fuzz():
             demand = ResourceSet({"CPU": float(rng.integers(1, 4))})
             if rng.random() < 0.3:
                 demand = ResourceSet({"CPU": 1.0, "TPU": float(rng.integers(1, 4))})
-            pick_n = nat.pick_node(demand)
-            pick_p = py.pick_node(demand)
+            strategy = "SPREAD" if rng.random() < 0.3 else None
+            pick_n = nat.pick_node(demand, strategy)
+            pick_p = py.pick_node(demand, strategy)
             assert (pick_n is None) == (pick_p is None), step
             if pick_n is not None:
                 assert pick_n.node_id == pick_p.node_id, (
