@@ -1415,6 +1415,7 @@ class Head:
             owner_id=spec.owner_id,
             actor_creation=True,
             max_retries=0,
+            runtime_env=spec.runtime_env,
         )
         ce = self.objects.get(creation.return_ids[0]) or ObjectEntry(creation.return_ids[0], spec.owner_id)
         ce.refcount = max(ce.refcount, 1)

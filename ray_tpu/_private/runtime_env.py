@@ -1,0 +1,154 @@
+"""Runtime environments: per-task/actor working_dir, py_modules, env_vars.
+
+Counterpart of the reference's runtime-env system
+(reference: python/ray/_private/runtime_env/ — working_dir.py py_modules
+packaging into GCS-hosted zip packages, plugin API plugin.py; the
+runtime-env agent applies them before user code runs). Scoped to the
+plugins that work with zero egress:
+
+  env_vars     — applied around task execution (worker.py, pre-existing)
+  working_dir  — a local directory zipped at submit time, content-hash
+                 stored in the cluster KV, extracted + chdir'd worker-side
+  py_modules   — same packaging, each entry prepended to sys.path
+
+pip/conda envs require network egress and are rejected with a clear error
+(pre-bake packages into the image instead — the reference's recommended
+production posture as well).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import os
+import sys
+import zipfile
+
+_EXCLUDE_DIRS = {"__pycache__", ".git", ".pytest_cache", "node_modules"}
+MAX_PACKAGE_BYTES = 100 * 1024 * 1024  # reference default cap is 100 MiB
+
+
+def _zip_dir(path: str, *, under_basename: bool = False) -> bytes:
+    """under_basename=True archives a directory UNDER its own name (the
+    py_modules contract: passing /path/to/my_module must make
+    `import my_module` work from the extract root — reference semantics,
+    _private/runtime_env/py_modules.py)."""
+    buf = io.BytesIO()
+    base = os.path.abspath(path)
+    prefix = os.path.basename(base) if under_basename else ""
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+        if os.path.isfile(base):
+            zf.write(base, os.path.basename(base))
+        else:
+            for root, dirs, files in os.walk(base):
+                dirs[:] = [d for d in dirs if d not in _EXCLUDE_DIRS]
+                for f in sorted(files):
+                    if f.endswith(".pyc"):
+                        continue
+                    full = os.path.join(root, f)
+                    arc = os.path.join(prefix, os.path.relpath(full, base))
+                    zf.write(full, arc)
+    blob = buf.getvalue()
+    if len(blob) > MAX_PACKAGE_BYTES:
+        raise ValueError(
+            f"runtime_env package {path!r} is {len(blob)} bytes "
+            f"(cap {MAX_PACKAGE_BYTES}); exclude large data directories"
+        )
+    return blob
+
+
+def pack(runtime_env: dict | None, rt) -> dict | None:
+    """Driver-side: upload local dirs into the cluster KV, rewrite the env
+    to URIs (reference: working_dir.py upload_package_if_needed)."""
+    if not runtime_env:
+        return runtime_env
+    for bad in ("pip", "conda", "uv"):
+        if runtime_env.get(bad):
+            raise ValueError(
+                f"runtime_env[{bad!r}] needs network egress, which this "
+                f"deployment does not have; pre-install the packages in "
+                f"the worker image instead"
+            )
+    env = dict(runtime_env)
+
+    def upload(path: str, *, under_basename: bool = False) -> str:
+        blob = _zip_dir(path, under_basename=under_basename)
+        uri = "pkg:" + hashlib.sha256(blob).hexdigest()[:32]
+        rt.kv_put(uri, blob, ns="__runtime_env__", overwrite=False)
+        return uri
+
+    if env.get("working_dir") and not str(env["working_dir"]).startswith("pkg:"):
+        env["working_dir"] = upload(env["working_dir"])
+    if env.get("py_modules"):
+        # A module DIRECTORY is archived under its basename so the extract
+        # root makes `import <basename>` work (single files land at the
+        # root already).
+        env["py_modules"] = [
+            m if str(m).startswith("pkg:") else upload(m, under_basename=os.path.isdir(m))
+            for m in env["py_modules"]
+        ]
+    return env
+
+
+class AppliedEnv:
+    """Worker-side application with exact undo (normal tasks run many
+    different envs in one process; actors apply once for life)."""
+
+    def __init__(self):
+        self._saved_cwd: str | None = None
+        self._added_paths: list[str] = []
+
+    def apply(self, runtime_env: dict | None, rt, cache_dir: str) -> None:
+        if not runtime_env:
+            return
+        wd_uri = runtime_env.get("working_dir")
+        if wd_uri:
+            target = _materialize(wd_uri, rt, cache_dir)
+            self._saved_cwd = os.getcwd()
+            os.chdir(target)
+            sys.path.insert(0, target)
+            self._added_paths.append(target)
+        for uri in runtime_env.get("py_modules") or []:
+            target = _materialize(uri, rt, cache_dir)
+            sys.path.insert(0, target)
+            self._added_paths.append(target)
+
+    def undo(self) -> None:
+        if self._saved_cwd is not None:
+            try:
+                os.chdir(self._saved_cwd)
+            except OSError:
+                pass
+            self._saved_cwd = None
+        for p in self._added_paths:
+            try:
+                sys.path.remove(p)
+            except ValueError:
+                pass
+        self._added_paths = []
+
+
+def _materialize(uri: str, rt, cache_dir: str) -> str:
+    """Extract a KV-hosted package into the content-addressed cache
+    (idempotent across tasks/workers on this host)."""
+    target = os.path.join(cache_dir, uri.replace(":", "_"))
+    marker = target + ".ok"
+    if os.path.exists(marker):
+        return target
+    blob = rt.kv_get(uri, ns="__runtime_env__")
+    if blob is None:
+        raise RuntimeError(f"runtime_env package {uri} not found in cluster KV")
+    tmp = target + f".tmp{os.getpid()}"
+    os.makedirs(tmp, exist_ok=True)
+    with zipfile.ZipFile(io.BytesIO(blob)) as zf:
+        zf.extractall(tmp)
+    try:
+        os.rename(tmp, target)
+    except OSError:
+        # Another worker won the race; its copy is identical (same hash).
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+    with open(marker, "w") as f:
+        f.write("ok")
+    return target

@@ -157,7 +157,22 @@ class Worker:
         worker_context.set_task_context(
             worker_context.TaskContext(spec.task_id, self.actor_id, self.node_id)
         )
+        applied_env = None
         try:
+            # working_dir / py_modules (runtime_env.py): applied per task
+            # with undo; actors keep theirs for life (no undo on the
+            # creation task). INSIDE the try: a materialization failure
+            # must store a TaskError into the return ids like any other
+            # task failure (or the driver's get would hang forever).
+            if spec.runtime_env and (
+                spec.runtime_env.get("working_dir") or spec.runtime_env.get("py_modules")
+            ):
+                from ray_tpu._private.runtime_env import AppliedEnv
+
+                applied_env = AppliedEnv()
+                cache = os.path.join(self.runtime.session_dir, "runtime_env_cache")
+                os.makedirs(cache, exist_ok=True)
+                applied_env.apply(spec.runtime_env, self.runtime, cache)
             args, kwargs = cloudpickle.loads(spec.args)
             args = [self._resolve(a) for a in args]
             kwargs = {k: self._resolve(v) for k, v in kwargs.items()}
@@ -187,11 +202,18 @@ class Worker:
             return False
         finally:
             worker_context.set_task_context(None)
-            for k, v in saved_env.items():
-                if v is None:
-                    os.environ.pop(k, None)
-                else:
-                    os.environ[k] = v
+            if spec.actor_creation:
+                # The actor's runtime env (working_dir, env_vars) lives for
+                # the actor's lifetime — this worker is dedicated to it.
+                pass
+            else:
+                if applied_env is not None and spec.actor_id is None:
+                    applied_env.undo()
+                for k, v in saved_env.items():
+                    if v is None:
+                        os.environ.pop(k, None)
+                    else:
+                        os.environ[k] = v
 
     def _resolve(self, value):
         if isinstance(value, ObjectRef):

@@ -16,7 +16,7 @@ from ray_tpu._private.config import GLOBAL_CONFIG
 from ray_tpu._private.ids import ActorID, ObjectRef
 from ray_tpu._private.task_spec import ActorSpec, TaskSpec
 from ray_tpu._private.worker_context import global_runtime
-from ray_tpu.remote_function import _normalize_resources
+from ray_tpu.remote_function import _normalize_resources, _pack_env
 
 
 class ActorMethod:
@@ -148,7 +148,7 @@ class ActorClass:
             max_concurrency=int(opts.get("max_concurrency", 1)),
             owner_id=rt.client_id,
             scheduling_strategy=opts.get("scheduling_strategy"),
-            runtime_env=opts.get("runtime_env"),
+            runtime_env=_pack_env(opts.get("runtime_env"), rt),
             lifetime=opts.get("lifetime"),
         )
         rt.create_actor(spec)

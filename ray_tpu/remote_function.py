@@ -17,6 +17,14 @@ from ray_tpu._private.task_spec import TaskSpec
 from ray_tpu._private.worker_context import global_runtime
 
 
+def _pack_env(runtime_env: dict | None, rt) -> dict | None:
+    if not runtime_env:
+        return runtime_env
+    from ray_tpu._private.runtime_env import pack
+
+    return pack(runtime_env, rt)
+
+
 def _normalize_resources(
     num_cpus: float | None,
     num_tpus: float | None,
@@ -93,7 +101,7 @@ class RemoteFunction:
                 opts.get("max_retries", GLOBAL_CONFIG.task_max_retries_default)
             ),
             scheduling_strategy=opts.get("scheduling_strategy"),
-            runtime_env=opts.get("runtime_env"),
+            runtime_env=_pack_env(opts.get("runtime_env"), rt),
             streaming=streaming,
         )
         rt.submit_task(spec)

@@ -29,7 +29,7 @@ def sync_model_params(params, group_name: str = None):
     session = get_session()
     if session.world_size == 1:
         return params
-    group = collective.get_group(group_name or f"train-{session.experiment_name}")
+    group = collective.get_group(group_name or session.group_name)
     leaves, treedef = jax.tree.flatten(params)
     synced = [group.broadcast(np.asarray(leaf), src=0) for leaf in leaves]
     return jax.tree.unflatten(treedef, [jax.numpy.asarray(s) for s in synced])
@@ -51,7 +51,7 @@ def allreduce_gradients(grads, group_name: str = None, op: str = "mean"):
     session = get_session()
     if session.world_size == 1:
         return grads
-    group = collective.get_group(group_name or f"train-{session.experiment_name}")
+    group = collective.get_group(group_name or session.group_name)
     leaves, treedef = jax.tree.flatten(grads)
     # One flat f32 buffer for the wire; each leaf's own dtype is restored on
     # unpack so bf16 training loops keep bf16 grads (reduction in f32 is the
@@ -77,4 +77,4 @@ def barrier(group_name: str = None):
     session = get_session()
     if session.world_size == 1:
         return
-    collective.get_group(group_name or f"train-{session.experiment_name}").barrier()
+    collective.get_group(group_name or session.group_name).barrier()

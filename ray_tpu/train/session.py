@@ -28,12 +28,16 @@ class TrainSession:
         latest_checkpoint: Checkpoint | None = None,
         dataset_shards: dict[str, Any] | None = None,
         start_iteration: int = 0,
+        group_name: str | None = None,
     ):
         self.rank = rank
         self.world_size = world_size
         self.local_rank = local_rank
         self.collector = collector
         self.experiment_name = experiment_name
+        # The attempt-unique collective/process-group name (worker_group
+        # passes it through; falls back to the legacy derivation).
+        self.group_name = group_name or f"train-{experiment_name}"
         self.latest_checkpoint = latest_checkpoint
         self.dataset_shards = dataset_shards or {}
         # Non-zero after failure recovery so training_iteration stays

@@ -1,0 +1,199 @@
+"""Torch training backend: DDP over gloo on the cluster's worker group.
+
+Counterpart of the reference's ray.train.torch
+(reference: train/torch/config.py:36 TorchConfig, :66
+_setup_torch_process_group, :115 dist.init_process_group(nccl|gloo);
+torch/torch_trainer.py:11 TorchTrainer; train_loop_utils.py:163
+prepare_model wrapping DDP, :493 prepare_data_loader). On TPU machines
+torch runs CPU-side (data prep, reference models, CI parity tests), so
+the process group backend is gloo; the JAX backend (backend.py) owns the
+accelerator path.
+
+    def loop():
+        model = prepare_model(Net())
+        loader = prepare_data_loader(DataLoader(ds, batch_size=32))
+        ...
+
+    TorchTrainer(loop, scaling_config=ScalingConfig(num_workers=4)).fit()
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import socket
+import time
+from typing import Any
+
+from ray_tpu.train.backend import Backend, BackendConfig
+from ray_tpu.train.trainer import JaxTrainer
+
+
+@dataclasses.dataclass
+class TorchConfig(BackendConfig):
+    """Reference: train/torch/config.py:36. backend: gloo (CPU hosts)."""
+
+    backend: str = "gloo"
+    init_timeout_s: float = 120.0
+
+    def backend_cls(self):
+        return _TorchBackend
+
+
+def _free_port() -> int:
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _host_ip() -> str:
+    try:
+        return socket.gethostbyname(socket.gethostname())
+    except OSError:
+        return "127.0.0.1"
+
+
+class _TorchBackend(Backend):
+    """Rank 0 publishes a TCP rendezvous address through the cluster KV
+    (the reference broadcasts the rank-0 worker address through the
+    worker group, config.py:66-113); every rank then joins the gloo
+    process group."""
+
+    def on_worker_setup(self, rank: int, world_size: int, group_name: str,
+                        config: TorchConfig | None = None) -> None:
+        config = config or TorchConfig()
+        os.environ["RANK"] = str(rank)
+        os.environ["WORLD_SIZE"] = str(world_size)
+        os.environ["LOCAL_RANK"] = str(rank)
+        if world_size <= 1:
+            return
+        import torch.distributed as dist
+
+        from ray_tpu._private.worker_context import global_runtime
+
+        rt = global_runtime()
+        key = f"torch_pg_addr:{group_name}"
+        if rank == 0:
+            addr = f"{_host_ip()}:{_free_port()}"
+            rt.kv_put(key, addr.encode(), ns="__train__")
+        else:
+            deadline = time.time() + config.init_timeout_s
+            addr = None
+            while time.time() < deadline:
+                raw = rt.kv_get(key, ns="__train__")
+                if raw:
+                    addr = raw.decode()
+                    break
+                time.sleep(0.05)
+            if addr is None:
+                raise TimeoutError(
+                    f"rank {rank}: no torch process-group address published "
+                    f"by rank 0 within {config.init_timeout_s}s"
+                )
+        dist.init_process_group(
+            backend=config.backend,
+            init_method=f"tcp://{addr}",
+            rank=rank,
+            world_size=world_size,
+        )
+
+    def on_shutdown(self, worker_group, backend_config) -> None:
+        try:
+            import torch.distributed as dist
+
+            if dist.is_initialized():
+                dist.destroy_process_group()
+        except Exception:
+            pass
+        # Best-effort rendezvous-key cleanup (the attempt-unique group
+        # name already prevents stale reads; this just avoids KV litter).
+        try:
+            from ray_tpu._private.worker_context import try_runtime
+
+            rt = try_runtime()
+            if rt is not None:
+                rt.kv_del(f"torch_pg_addr:{worker_group.group_name}",
+                          ns="__train__")
+        except Exception:
+            pass
+
+
+class TorchTrainer(JaxTrainer):
+    """Reference: train/torch/torch_trainer.py:11 — a DataParallelTrainer
+    whose backend sets up the torch process group."""
+
+    def __init__(self, train_loop_per_worker, *, backend_config=None, **kw):
+        super().__init__(
+            train_loop_per_worker,
+            backend_config=backend_config or TorchConfig(),
+            **kw,
+        )
+
+
+def get_device():
+    """Reference: ray.train.torch.get_device — CPU here (TPU compute goes
+    through the JAX path)."""
+    import torch
+
+    return torch.device("cpu")
+
+
+def prepare_model(model, *, ddp_kwargs: dict | None = None):
+    """Wrap in DistributedDataParallel when world_size > 1 (reference:
+    train_loop_utils.py:163)."""
+    import torch.distributed as dist
+
+    if dist.is_available() and dist.is_initialized() and dist.get_world_size() > 1:
+        from torch.nn.parallel import DistributedDataParallel
+
+        return DistributedDataParallel(model, **(ddp_kwargs or {}))
+    return model
+
+
+def prepare_data_loader(loader, *, add_dist_sampler: bool = True):
+    """Shard a DataLoader across workers via DistributedSampler
+    (reference: train_loop_utils.py:493). The original loader's ordering
+    contract is preserved: shuffle only if the incoming sampler shuffles
+    (a sequential validation loader stays sequential per shard)."""
+    import torch.distributed as dist
+
+    if not (dist.is_available() and dist.is_initialized()
+            and dist.get_world_size() > 1 and add_dist_sampler):
+        return loader
+    from torch.utils.data import DataLoader, RandomSampler
+    from torch.utils.data.distributed import DistributedSampler
+
+    sampler = getattr(loader, "sampler", None)
+    if isinstance(sampler, DistributedSampler):
+        return loader
+    if getattr(loader, "batch_sampler", None) is not None and not hasattr(
+        loader.batch_sampler, "sampler"
+    ):
+        # Custom batch_sampler: cannot be rebuilt faithfully — leave the
+        # loader alone (each worker sees the full data; same reference
+        # behavior for non-default batch samplers).
+        return loader
+    shuffle = isinstance(sampler, RandomSampler)
+    return DataLoader(
+        loader.dataset,
+        batch_size=loader.batch_size,
+        sampler=DistributedSampler(loader.dataset, shuffle=shuffle),
+        num_workers=getattr(loader, "num_workers", 0),
+        collate_fn=getattr(loader, "collate_fn", None),
+        drop_last=getattr(loader, "drop_last", False),
+        pin_memory=getattr(loader, "pin_memory", False),
+        worker_init_fn=getattr(loader, "worker_init_fn", None),
+        generator=getattr(loader, "generator", None),
+        persistent_workers=getattr(loader, "persistent_workers", False),
+    )
+
+
+__all__ = [
+    "TorchConfig",
+    "TorchTrainer",
+    "get_device",
+    "prepare_model",
+    "prepare_data_loader",
+]

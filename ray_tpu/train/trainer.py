@@ -90,7 +90,14 @@ class JaxTrainer:
         error: Exception | None = None
 
         while True:
-            group = WorkerGroup(scaling, self.backend_config, group_name=f"train-{name}")
+            # Attempt-unique group name: collective groups and the torch
+            # process-group rendezvous key (train/torch) are keyed by it —
+            # a retry must never read the previous (dead) attempt's
+            # rendezvous state.
+            group = WorkerGroup(
+                scaling, self.backend_config,
+                group_name=f"train-{name}-{uuid.uuid4().hex[:8]}",
+            )
             try:
                 refs = group.run(
                     self.train_loop_per_worker,

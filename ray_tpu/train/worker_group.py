@@ -93,6 +93,7 @@ class TrainWorker:
             local_rank=self.rank,
             collector=collector,
             experiment_name=experiment_name,
+            group_name=self.group_name,
             latest_checkpoint=Checkpoint(latest_ckpt_path) if latest_ckpt_path else None,
             dataset_shards=dataset_shards,
             start_iteration=start_iteration,
