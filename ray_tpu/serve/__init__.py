@@ -9,6 +9,7 @@ load."""
 from ray_tpu.serve.api import (
     delete,
     get_deployment_handle,
+    get_grpc_port,
     get_proxy_port,
     run,
     shutdown,
@@ -34,6 +35,7 @@ __all__ = [
     "delete",
     "deployment",
     "get_deployment_handle",
+    "get_grpc_port",
     "get_proxy_port",
     "run",
     "shutdown",

@@ -107,6 +107,13 @@ def get_proxy_port() -> int:
     return ray_tpu.get(_proxy.get_port.remote())
 
 
+def get_grpc_port() -> int:
+    """Port of the gRPC ingress (reference: gRPCProxy); -1 if disabled."""
+    if _proxy is None:
+        raise RayTpuError("serve proxy not running")
+    return ray_tpu.get(_proxy.get_grpc_port.remote())
+
+
 def status() -> dict:
     global _controller
     if _controller is None:

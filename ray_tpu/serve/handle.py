@@ -57,6 +57,49 @@ class DeploymentResponse:
         return self._ref
 
 
+class DeploymentResponseGenerator:
+    """Streaming response: iterate to receive items as the replica's
+    generator produces them (reference: DeploymentResponseGenerator,
+    serve/handle.py — streaming handle results)."""
+
+    def __init__(self, ref_gen, on_done=None):
+        self._gen = ref_gen
+        self._on_done = on_done
+        self._finished = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        import ray_tpu
+
+        try:
+            return ray_tpu.get(next(self._gen))
+        except StopIteration:
+            self._finish()
+            raise
+        except Exception:
+            self._finish()
+            raise
+
+    def _finish(self):
+        if not self._finished:
+            self._finished = True
+            if self._on_done is not None:
+                self._on_done()
+
+    def close(self):
+        """Release routing accounting when abandoning the stream early
+        (for ... break). Also fired by GC as a backstop."""
+        self._finish()
+
+    def __del__(self):
+        try:
+            self._finish()
+        except Exception:
+            pass
+
+
 class DeploymentHandle:
     _REFRESH_S = 1.0
 
@@ -69,6 +112,7 @@ class DeploymentHandle:
         self._last_refresh = 0.0
         self._inflight: dict[str, int] = {}
         self._lock = threading.Lock()
+        self._stream = False
 
     # -- controller discovery (lazy: handles are cheap to pickle) ----------
 
@@ -107,9 +151,11 @@ class DeploymentHandle:
         a, b = random.sample(reps, 2)
         return a if self._inflight.get(a[0], 0) <= self._inflight.get(b[0], 0) else b
 
-    def options(self, *, method_name: str | None = None) -> "DeploymentHandle":
+    def options(self, *, method_name: str | None = None,
+                stream: bool | None = None) -> "DeploymentHandle":
         h = DeploymentHandle(self.deployment_name, self._controller,
                              method_name or self._method)
+        h._stream = self._stream if stream is None else stream
         # Share router state with the parent: the replica cache stays warm
         # (no per-call controller RPC) and power-of-two choices sees ALL
         # in-flight requests, not just this method-view's.
@@ -160,6 +206,13 @@ class DeploymentHandle:
                     self._inflight[rid] = max(0, self._inflight.get(rid, 0) - 1)
 
             try:
+                if self._stream:
+                    # Streaming: the replica's generator method returns an
+                    # ObjectRefGenerator; items surface as produced.
+                    gen = actor.handle_request_streaming.remote(
+                        self._method, args, kwargs
+                    )
+                    return DeploymentResponseGenerator(gen, on_done=done)
                 ref = actor.handle_request.remote(self._method, args, kwargs)
                 return DeploymentResponse(ref, on_done=done, retry=retry)
             except ActorError as e:

@@ -33,11 +33,28 @@ class HTTPProxy:
         self._thread = threading.Thread(target=self._serve, daemon=True, name="http-proxy")
         self._thread.start()
         self._ready.wait(timeout=10)
+        # gRPC ingress beside HTTP (reference: gRPCProxy in the same
+        # proxy actor, serve/_private/proxy.py:534); optional — absent
+        # grpcio just disables the listener.
+        self._grpc = None
+        try:
+            from ray_tpu.serve.grpc_proxy import GrpcIngress
+
+            self._grpc = GrpcIngress(host)
+        except ImportError:
+            pass  # grpcio not installed: HTTP-only
+        except Exception as e:  # noqa: BLE001 — real failures must be visible
+            import sys
+
+            print(f"[serve] gRPC ingress failed to start: {e!r}", file=sys.stderr)
 
     # -- control -----------------------------------------------------------
 
     def get_port(self) -> int:
         return self._port
+
+    def get_grpc_port(self) -> int:
+        return self._grpc.get_port() if self._grpc is not None else -1
 
     def update_routes(self, routes: dict[str, str]) -> None:
         """route_prefix -> deployment name (pushed by serve.run/delete).
@@ -53,6 +70,8 @@ class HTTPProxy:
         for name in list(self._handles):
             if name not in handles:
                 del self._handles[name]
+        if self._grpc is not None:
+            self._grpc.update_routes(routes)
 
     def ping(self) -> str:
         return "pong"
@@ -77,9 +96,18 @@ class HTTPProxy:
                     payload = raw.decode()
             else:
                 payload = dict(request.query)
+            handle_ = self._handles.get(name)
+            if handle_ is None:
+                # Route table swapped concurrently (serve.delete race).
+                return web.json_response(
+                    {"error": f"no route for {path}"}, status=404
+                )
+            if "text/event-stream" in request.headers.get("Accept", ""):
+                # SSE streaming: the deployment method must be a generator;
+                # each yielded item becomes one `data:` event as produced
+                # (reference: streaming responses through the proxy).
+                return await self._stream_sse(web, request, handle_, payload)
             try:
-                handle_ = self._handles[name]
-
                 def call() -> Any:
                     # Routing (blocking controller RPCs, retry sleeps) AND
                     # the result wait both stay off the event loop.
@@ -104,6 +132,67 @@ class HTTPProxy:
         self._loop = asyncio.new_event_loop()
         asyncio.set_event_loop(self._loop)
         self._loop.run_until_complete(run())
+
+    async def _stream_sse(self, web, request, handle_, payload):
+        loop = asyncio.get_running_loop()
+        # Bounded queue = backpressure: a slow client blocks the pump
+        # thread instead of buffering the stream unboundedly.
+        queue: asyncio.Queue = asyncio.Queue(maxsize=16)
+        stop = threading.Event()
+
+        def pump():
+            gen = None
+            try:
+                gen = handle_.options(stream=True).remote(payload)
+                for item in gen:
+                    if stop.is_set():
+                        break
+                    fut = asyncio.run_coroutine_threadsafe(
+                        queue.put(("item", item)), loop
+                    )
+                    fut.result(timeout=60)
+            except Exception as e:  # noqa: BLE001
+                if not stop.is_set():
+                    try:
+                        asyncio.run_coroutine_threadsafe(
+                            queue.put(("error", str(e))), loop
+                        ).result(timeout=5)
+                    except Exception:
+                        pass
+            finally:
+                # Early termination must release routing accounting.
+                if gen is not None and hasattr(gen, "close"):
+                    gen.close()
+                try:
+                    asyncio.run_coroutine_threadsafe(
+                        queue.put(("end", None)), loop
+                    ).result(timeout=5)
+                except Exception:
+                    pass
+
+        resp = web.StreamResponse(headers={
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-cache",
+        })
+        await resp.prepare(request)
+        threading.Thread(target=pump, daemon=True).start()
+        try:
+            while True:
+                kind, item = await queue.get()
+                if kind == "end":
+                    break
+                if kind == "error":
+                    await resp.write(f"event: error\ndata: {json.dumps(item)}\n\n".encode())
+                    break
+                await resp.write(f"data: {json.dumps(item, default=str)}\n\n".encode())
+            await resp.write_eof()
+        finally:
+            # Client gone (write raised) or stream done: stop the pump and
+            # drain so a blocked put() wakes up.
+            stop.set()
+            while not queue.empty():
+                queue.get_nowait()
+        return resp
 
     def _match_route(self, path: str) -> str | None:
         # Longest-prefix match (reference: proxy route matching).

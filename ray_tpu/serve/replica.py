@@ -25,24 +25,42 @@ class Replica:
         else:
             self.instance = cls_or_fn  # plain function deployment
 
-    def handle_request(self, method: str, args: tuple, kwargs: dict) -> Any:
+    def _resolve_call(self, method: str, args: tuple, kwargs: dict):
+        """Shared request plumbing: await composed upstream ObjectRefs
+        (handle.remote unwraps .ref) and resolve the target callable."""
         import ray_tpu
         from ray_tpu._private.ids import ObjectRef
 
+        args = tuple(ray_tpu.get(a) if isinstance(a, ObjectRef) else a for a in args)
+        kwargs = {k: (ray_tpu.get(v) if isinstance(v, ObjectRef) else v)
+                  for k, v in kwargs.items()}
+        target = (self.instance if method in ("__call__", "")
+                  else getattr(self.instance, method))
+        return target, args, kwargs
+
+    def handle_request(self, method: str, args: tuple, kwargs: dict) -> Any:
         with self._lock:
             self._ongoing += 1
             self._total += 1
         try:
-            # Composition: upstream DeploymentResponses arrive as nested
-            # ObjectRefs (handle.remote unwraps .ref); await them here.
-            args = tuple(ray_tpu.get(a) if isinstance(a, ObjectRef) else a for a in args)
-            kwargs = {k: (ray_tpu.get(v) if isinstance(v, ObjectRef) else v)
-                      for k, v in kwargs.items()}
-            if method in ("__call__", ""):
-                target = self.instance
-            else:
-                target = getattr(self.instance, method)
+            target, args, kwargs = self._resolve_call(method, args, kwargs)
             return target(*args, **kwargs)
+        finally:
+            with self._lock:
+                self._ongoing -= 1
+
+    def handle_request_streaming(self, method: str, args: tuple, kwargs: dict):
+        """Generator variant: yields the user generator's items one by one.
+        Being itself a generator actor method, callers receive an
+        ObjectRefGenerator whose items appear as produced (reference:
+        streaming deployment responses through the proxy,
+        serve/_private/proxy response streaming)."""
+        with self._lock:
+            self._ongoing += 1
+            self._total += 1
+        try:
+            target, args, kwargs = self._resolve_call(method, args, kwargs)
+            yield from target(*args, **kwargs)
         finally:
             with self._lock:
                 self._ongoing -= 1

@@ -219,3 +219,74 @@ def test_deleted_route_returns_404():
     serve.delete("Gone")
     r = requests.post(f"http://127.0.0.1:{port}/gone", json={}, timeout=10)
     assert r.status_code == 404, r.text
+
+
+def test_grpc_ingress_roundtrip():
+    """gRPC ingress beside HTTP (reference: gRPCProxy) — json and pickle
+    encodings, explicit + defaulted deployment targeting."""
+    pytest.importorskip("grpc")
+    from ray_tpu.serve.grpc_proxy import grpc_request
+
+    @serve.deployment(name="gecho")
+    class GrpcEcho:
+        def __call__(self, payload):
+            return {"echoed": payload}
+
+    serve.run(GrpcEcho.bind(), route_prefix="/gecho")
+    port = serve.get_grpc_port()
+    assert port > 0
+    addr = f"127.0.0.1:{port}"
+
+    r = grpc_request(addr, {"x": 1}, deployment="gecho")
+    assert r == {"echoed": {"x": 1}}
+    # Envelope targeting + pickle encoding.
+    r = grpc_request(addr, {"deployment": "gecho", "payload": [1, 2]})
+    assert r == {"echoed": [1, 2]}
+    r = grpc_request(addr, {"x": (1, 2)}, deployment="gecho", encoding="pickle")
+    assert r == {"echoed": {"x": (1, 2)}}
+    # Unknown deployment → NOT_FOUND surfaces as RpcError.
+    import grpc as grpc_mod
+
+    with pytest.raises(grpc_mod.RpcError):
+        grpc_request(addr, {}, deployment="nope")
+
+
+def test_streaming_deployment_handle():
+    """Generator deployments stream items through the handle as produced
+    (reference: DeploymentResponseGenerator)."""
+    @serve.deployment(name="streamer")
+    class Streamer:
+        def __call__(self, payload):
+            n = int(payload.get("n", 3))
+            for i in range(n):
+                yield {"i": i}
+
+    serve.run(Streamer.bind(), route_prefix="/streamer")
+    h = serve.get_deployment_handle("streamer")
+    items = list(h.options(stream=True).remote({"n": 4}))
+    assert items == [{"i": 0}, {"i": 1}, {"i": 2}, {"i": 3}]
+
+
+def test_streaming_http_sse():
+    import urllib.request
+
+    @serve.deployment(name="ssegen")
+    class SSEGen:
+        def __call__(self, payload):
+            for i in range(3):
+                yield {"chunk": i}
+
+    serve.run(SSEGen.bind(), route_prefix="/ssegen")
+    port = serve.get_proxy_port()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/ssegen",
+        data=b"{}",
+        headers={"Content-Type": "application/json",
+                 "Accept": "text/event-stream"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=60) as r:
+        assert r.headers["Content-Type"].startswith("text/event-stream")
+        body = r.read().decode()
+    assert body.count("data:") == 3
+    assert '"chunk": 2' in body
