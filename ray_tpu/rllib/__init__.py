@@ -8,7 +8,9 @@ not DDP. Algorithms are Tune Trainables (Tuner(PPO, ...) works)."""
 from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
 from ray_tpu.rllib.algorithms.appo import APPO, APPOConfig
 from ray_tpu.rllib.algorithms.bc import BC, BCConfig
+from ray_tpu.rllib.algorithms.cql import CQL, CQLConfig
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
+from ray_tpu.rllib.algorithms.marwil import MARWIL, MARWILConfig
 from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig, vtrace
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
 from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
@@ -20,6 +22,12 @@ from ray_tpu.rllib.core.rl_module import (
     RLModuleSpec,
 )
 from ray_tpu.rllib.env.env_runner import EnvRunnerGroup, SingleAgentEnvRunner
+from ray_tpu.rllib.env.multi_agent import (
+    MultiAgentEnv,
+    MultiAgentEnvRunner,
+    MultiAgentEnvRunnerGroup,
+    shared_policy_mapping_fn,
+)
 from ray_tpu.rllib.sample_batch import SampleBatch, compute_gae
 
 __all__ = [
@@ -29,8 +37,12 @@ __all__ = [
     "AlgorithmConfig",
     "BC",
     "BCConfig",
+    "CQL",
+    "CQLConfig",
     "DQN",
     "DQNConfig",
+    "MARWIL",
+    "MARWILConfig",
     "ReplayBuffer",
     "SAC",
     "SACConfig",
@@ -40,6 +52,10 @@ __all__ = [
     "IMPALAConfig",
     "JaxLearner",
     "LearnerGroup",
+    "MultiAgentEnv",
+    "MultiAgentEnvRunner",
+    "MultiAgentEnvRunnerGroup",
+    "shared_policy_mapping_fn",
     "PPO",
     "PPOConfig",
     "RLModule",

@@ -49,6 +49,11 @@ class AlgorithmConfig:
         self.continuous = False
         self.action_low: Any = None
         self.action_high: Any = None
+        # multi-agent (reference: AlgorithmConfig.multi_agent,
+        # rllib/algorithms/algorithm_config.py)
+        self.policies: dict | None = None
+        self.policy_mapping_fn = None
+        self.policies_to_train: list | None = None
         # misc
         self.seed = 0
 
@@ -92,6 +97,29 @@ class AlgorithmConfig:
             self.mesh = mesh
         return self
 
+    def multi_agent(self, *, policies: dict | list | None = None,
+                    policy_mapping_fn=None,
+                    policies_to_train: list | None = None) -> "AlgorithmConfig":
+        """Configure multi-agent training (reference:
+        algorithm_config.py multi_agent()). ``policies`` maps module id →
+        RLModuleSpec | dict(observation_dim=, action_dim=) | None
+        (None: dims inferred from the env's agents routed to that module);
+        a plain list of ids is shorthand for all-None specs."""
+        if policies is not None:
+            if isinstance(policies, (list, tuple, set)):
+                policies = {mid: None for mid in policies}
+            self.policies = dict(policies)
+        if policy_mapping_fn is not None:
+            self.policy_mapping_fn = policy_mapping_fn
+        if policies_to_train is not None:
+            self.policies_to_train = list(policies_to_train)
+        # Default mapping fn is filled in by _resolve_multi_agent_specs.
+        return self
+
+    @property
+    def is_multi_agent(self) -> bool:
+        return self.policies is not None
+
     def debugging(self, *, seed: int | None = None) -> "AlgorithmConfig":
         if seed is not None:
             self.seed = seed
@@ -100,6 +128,9 @@ class AlgorithmConfig:
     # --- resolution ---
 
     def _infer_spaces(self) -> None:
+        if self.is_multi_agent:
+            self._resolve_multi_agent_specs()
+            return
         if self.observation_dim is not None and self.action_dim is not None:
             return
         if self.env is None:
@@ -126,6 +157,72 @@ class AlgorithmConfig:
                 env.close()
             except Exception:
                 pass
+
+    def _resolve_multi_agent_specs(self) -> None:
+        """Turn every policies[mid] entry into a concrete RLModuleSpec,
+        inferring dims from the env's agents where unspecified."""
+        if self.policy_mapping_fn is None:
+            from ray_tpu.rllib.env.multi_agent import shared_policy_mapping_fn
+
+            self.policy_mapping_fn = shared_policy_mapping_fn
+        needs_env = any(
+            not isinstance(s, RLModuleSpec)
+            and not (isinstance(s, dict) and "observation_dim" in s)
+            for s in self.policies.values()
+        )
+        agent_dims: dict = {}
+        if needs_env:
+            if not callable(self.env):
+                raise ValueError(
+                    "multi-agent spec inference needs environment(env=callable)"
+                )
+            env = self.env()
+            try:
+                for a in env.possible_agents:
+                    agent_dims[a] = (env.observation_dims[a], env.action_dims[a])
+            finally:
+                try:
+                    env.close()
+                except Exception:
+                    pass
+        resolved: dict[str, RLModuleSpec] = {}
+        for mid, s in self.policies.items():
+            if isinstance(s, RLModuleSpec):
+                resolved[mid] = s
+            elif isinstance(s, dict):
+                resolved[mid] = RLModuleSpec(
+                    observation_dim=s["observation_dim"],
+                    action_dim=s["action_dim"],
+                    hidden=tuple(s.get("hidden", self.model.get("hidden", (64, 64)))),
+                    module_class=s.get("module_class"),
+                )
+            else:  # None: first env agent mapping to this module defines dims
+                dims = None
+                for a, (od, ad) in agent_dims.items():
+                    if self.policy_mapping_fn(a, 0) == mid:
+                        dims = (od, ad)
+                        break
+                if dims is None:
+                    raise ValueError(
+                        f"cannot infer spaces for module {mid!r}: no env agent "
+                        f"maps to it; pass an explicit spec"
+                    )
+                resolved[mid] = RLModuleSpec(
+                    observation_dim=dims[0], action_dim=dims[1],
+                    hidden=tuple(self.model.get("hidden", (64, 64))),
+                )
+        self.policies = resolved
+
+    def rl_module_specs(self) -> "dict[str, RLModuleSpec]":
+        """Per-module specs (multi-agent); single-agent configs expose their
+        one spec under the default module id."""
+        if self.is_multi_agent:
+            if any(not isinstance(s, RLModuleSpec) for s in self.policies.values()):
+                self._resolve_multi_agent_specs()
+            return dict(self.policies)
+        from ray_tpu.rllib.env.multi_agent import DEFAULT_MODULE_ID
+
+        return {DEFAULT_MODULE_ID: self.rl_module_spec()}
 
     def rl_module_spec(self) -> RLModuleSpec:
         return RLModuleSpec(
@@ -156,6 +253,7 @@ class Algorithm(Trainable):
     step() is `training_step()` plus metric aggregation."""
 
     config_class: Type[AlgorithmConfig] = AlgorithmConfig
+    supports_multi_agent: bool = False
 
     def __init__(self, config: AlgorithmConfig | dict | None = None, trial_dir: str | None = None):
         if isinstance(config, dict):
@@ -167,6 +265,11 @@ class Algorithm(Trainable):
             config = base
         elif config is None:
             config = self.config_class()
+        if config.is_multi_agent and not self.supports_multi_agent:
+            raise ValueError(
+                f"{type(self).__name__} does not support multi-agent "
+                f"training; use PPO or drop .multi_agent() from the config"
+            )
         config._infer_spaces()
         self.algo_config = config
         super().__init__(config={}, trial_dir=trial_dir)
@@ -174,7 +277,14 @@ class Algorithm(Trainable):
     def setup(self, config: dict) -> None:
         cfg = self.algo_config
         # Offline algorithms (BC/CQL-style) may have no env at all.
-        self.env_runner_group = EnvRunnerGroup(cfg) if cfg.env is not None else None
+        if cfg.env is None:
+            self.env_runner_group = None
+        elif cfg.is_multi_agent:
+            from ray_tpu.rllib.env.multi_agent import MultiAgentEnvRunnerGroup
+
+            self.env_runner_group = MultiAgentEnvRunnerGroup(cfg)
+        else:
+            self.env_runner_group = EnvRunnerGroup(cfg)
         self._rng = np.random.default_rng(cfg.seed)
         self.build_learner(cfg)  # algorithm-specific
 

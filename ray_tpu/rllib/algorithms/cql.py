@@ -1,0 +1,233 @@
+"""CQL: conservative Q-learning (offline SAC).
+
+Counterpart of the reference's CQL (rllib/algorithms/cql/cql.py — SAC with
+the CQL(H) conservative regularizer trained from offline data;
+cql_torch_learner computes the logsumexp penalty over sampled actions).
+Built on the same SACModule/twin-critic machinery: the critic loss gains
+
+    alpha_cql * ( logsumexp_a Q(s, a) - Q(s, a_data) )
+
+where the logsumexp is importance-sampled with `num_actions` uniform
+actions plus policy actions at s and s' (each weighted by its proposal
+log-density, as in the CQL paper / reference implementation). The actor
+warm-starts with behavior cloning for ``bc_iters`` updates
+(cql.py bc_iters) before switching to the SAC actor loss.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.bc import _to_sample_batch
+from ray_tpu.rllib.algorithms.sac import (
+    SACConfig,
+    SACModule,
+    _action_affine,
+    gaussian_sample,
+)
+from ray_tpu.rllib.core.learner import JaxLearner, LearnerGroup, make_optimizer
+from ray_tpu.rllib.sample_batch import (
+    ACTIONS,
+    NEXT_OBS,
+    OBS,
+    REWARDS,
+    TERMINATEDS,
+    SampleBatch,
+)
+
+
+class CQLConfig(SACConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = CQL
+        self.offline_data = None
+        self.bc_iters = 200           # actor BC warm-up updates
+        self.cql_alpha = 5.0          # min_q_weight in the reference
+        self.num_actions = 4          # sampled actions per logsumexp term
+        self.num_gradient_steps = 16
+        self.learning_starts = 0
+
+    def offline(self, offline_data) -> "CQLConfig":
+        self.offline_data = offline_data
+        return self
+
+
+def _squashed_gaussian_logp(out, actions_n):
+    """log pi(a|s) for given normalized actions under the squashed
+    gaussian (inverse of gaussian_sample's tanh)."""
+    mean, log_std = out["mean"], out["log_std"]
+    a = jnp.clip(actions_n, -1.0 + 1e-6, 1.0 - 1e-6)
+    u = jnp.arctanh(a)
+    std = jnp.exp(log_std)
+    logp_u = (-0.5 * jnp.square((u - mean) / std)
+              - log_std - 0.5 * jnp.log(2.0 * jnp.pi)).sum(-1)
+    return logp_u - (2.0 * (jnp.log(2.0) - u - jax.nn.softplus(-2.0 * u))).sum(-1)
+
+
+def make_cql_loss(cfg: CQLConfig, action_center, action_half,
+                  target_entropy: float):
+    gamma, sg = cfg.gamma, jax.lax.stop_gradient
+    center = jnp.asarray(action_center, jnp.float32)
+    half = jnp.asarray(action_half, jnp.float32)
+    n_act = cfg.num_actions
+    cql_alpha = cfg.cql_alpha
+
+    def _q_both(params, obs, acts_n):
+        return (SACModule.q_apply(params["q1"], obs, acts_n),
+                SACModule.q_apply(params["q2"], obs, acts_n))
+
+    def loss_fn(params, apply_fn, batch):
+        key = batch["rng"]
+        k_pi, k_rand, k_cur, k_nxt = jax.random.split(key, 4)
+        obs, acts = batch[OBS], batch[ACTIONS]
+        nxt = batch[NEXT_OBS]
+        acts_n = (acts - center) / half
+        alpha = jnp.exp(params["log_alpha"])
+        B, d = acts_n.shape
+
+        # -- standard SAC critic TD loss ---------------------------------
+        q1, q2 = _q_both(params, obs, acts_n)
+        target = batch["td_targets"]
+        td_loss = jnp.square(q1 - target).mean() + jnp.square(q2 - target).mean()
+
+        # -- CQL(H) conservative penalty ---------------------------------
+        # Importance-sampled logsumexp over: uniform actions (density
+        # 2^-d on [-1,1]^d), policy actions at s, policy actions at s'.
+        def tiled(o):
+            return jnp.repeat(o, n_act, axis=0)  # [B*n, obs_dim]
+
+        rand_a = jax.random.uniform(k_rand, (B * n_act, d), minval=-1.0,
+                                    maxval=1.0)
+        out_cur = apply_fn(params, obs)
+        out_nxt = apply_fn(params, nxt)
+        cur_a, cur_logp = gaussian_sample(
+            None, {"mean": tiled(out_cur["mean"]),
+                   "log_std": tiled(out_cur["log_std"])}, k_cur)
+        nxt_a, nxt_logp = gaussian_sample(
+            None, {"mean": tiled(out_nxt["mean"]),
+                   "log_std": tiled(out_nxt["log_std"])}, k_nxt)
+        rand_logp = jnp.full((B * n_act,), -d * jnp.log(2.0))
+
+        def penalty(qkey):
+            qs = []
+            for a_s, lp in ((rand_a, rand_logp), (cur_a, sg(cur_logp)),
+                            (nxt_a, sg(nxt_logp))):
+                q = SACModule.q_apply(params[qkey], tiled(obs), a_s)
+                qs.append((q - lp).reshape(B, n_act))
+            cat = jnp.concatenate(qs, axis=1)  # [B, 3n]
+            lse = jax.scipy.special.logsumexp(cat, axis=1) - jnp.log(3.0 * n_act)
+            q_data = SACModule.q_apply(params[qkey], obs, acts_n)
+            return (lse - q_data).mean()
+
+        cql_pen = penalty("q1") + penalty("q2")
+        critic_loss = td_loss + cql_alpha * cql_pen
+
+        # -- actor: BC warm-up then SAC objective ------------------------
+        a_pi, logp_pi = gaussian_sample(params, out_cur, k_pi)
+        q_pi = jnp.minimum(
+            SACModule.q_apply(sg(params["q1"]), obs, a_pi),
+            SACModule.q_apply(sg(params["q2"]), obs, a_pi),
+        )
+        bc_logp = _squashed_gaussian_logp(out_cur, acts_n)
+        sac_actor = (sg(alpha) * logp_pi - q_pi).mean()
+        bc_actor = (sg(alpha) * logp_pi - bc_logp).mean()
+        use_bc = batch["use_bc"]  # scalar 0/1 carried in the batch
+        actor_loss = use_bc * bc_actor + (1.0 - use_bc) * sac_actor
+
+        alpha_loss = (-params["log_alpha"] * sg(logp_pi + target_entropy)).mean()
+
+        total = critic_loss + actor_loss + alpha_loss
+        return total, {
+            "critic_loss": critic_loss,
+            "td_loss": td_loss,
+            "cql_penalty": cql_pen,
+            "actor_loss": actor_loss,
+            "alpha": alpha,
+            "q1_mean": q1.mean(),
+        }
+
+    return loss_fn
+
+
+class CQL(Algorithm):
+    config_class = CQLConfig
+
+    def build_learner(self, cfg: CQLConfig) -> None:
+        if cfg.offline_data is None:
+            raise ValueError("CQL requires config.offline(offline_data=...)")
+        if cfg.num_learners > 0:
+            raise ValueError(
+                "CQL drives its learner locally (replay sampling + target "
+                "nets live with the driver); num_learners > 0 is not "
+                "supported"
+            )
+        self._dataset = _to_sample_batch(cfg.offline_data)
+        for col in (OBS, ACTIONS, REWARDS, NEXT_OBS):
+            if col not in self._dataset:
+                raise ValueError(f"CQL offline data needs a {col!r} column")
+        if TERMINATEDS not in self._dataset:
+            self._dataset[TERMINATEDS] = np.zeros(len(self._dataset), bool)
+        spec = cfg.rl_module_spec()
+        target_entropy = (cfg.target_entropy if cfg.target_entropy is not None
+                          else -float(cfg.action_dim))
+        center, half = _action_affine(cfg.action_low, cfg.action_high)
+        tx = make_optimizer(cfg)
+        loss_fn = make_cql_loss(cfg, center, half, target_entropy)
+        mesh, seed = cfg.mesh, cfg.seed
+
+        def factory():
+            return JaxLearner(spec.build(seed=seed), loss_fn=loss_fn,
+                              optimizer=tx, mesh=mesh)
+
+        self.learner_group = LearnerGroup(factory, num_learners=0)
+        w = self.learner_group.get_weights()
+        self.target_q = {"q1": w["q1"], "q2": w["q2"]}
+        self._module = spec.build(seed=0)
+        self._key = jax.random.PRNGKey(cfg.seed)
+        self._updates = 0
+
+        gamma = cfg.gamma
+        apply_fn = self._module.apply
+
+        @jax.jit
+        def td_targets(params, target_q, key, next_obs, rewards, terminateds):
+            out = apply_fn(params, next_obs)
+            a2, logp2 = gaussian_sample(params, out, key)
+            q1t = SACModule.q_apply(target_q["q1"], next_obs, a2)
+            q2t = SACModule.q_apply(target_q["q2"], next_obs, a2)
+            alpha = jnp.exp(params["log_alpha"])
+            soft_q = jnp.minimum(q1t, q2t) - alpha * logp2
+            return rewards + gamma * (1.0 - terminateds) * soft_q
+
+        self._td_targets = td_targets
+        self._rng = np.random.default_rng(cfg.seed)
+
+    def training_step(self) -> dict:
+        cfg = self.algo_config
+        metrics: dict = {"num_offline_rows": len(self._dataset)}
+        n = len(self._dataset)
+        for _ in range(cfg.num_gradient_steps):
+            idx = self._rng.integers(0, n, size=cfg.train_batch_size)
+            mb = SampleBatch({k: v[idx] for k, v in self._dataset.items()})
+            params = jax.tree.map(jnp.asarray,
+                                  self.learner_group.local.module.params)
+            self._key, kt, ku = jax.random.split(self._key, 3)
+            mb["td_targets"] = np.asarray(self._td_targets(
+                params, jax.tree.map(jnp.asarray, self.target_q), kt,
+                jnp.asarray(mb[NEXT_OBS]), jnp.asarray(mb[REWARDS]),
+                jnp.asarray(mb[TERMINATEDS], jnp.float32),
+            ))
+            mb["rng"] = np.asarray(ku)
+            mb["use_bc"] = np.float32(1.0 if self._updates < cfg.bc_iters else 0.0)
+            metrics.update(self.learner_group.local.update(mb))
+            self._updates += 1
+            w = self.learner_group.local.module.params
+            self.target_q = jax.tree.map(
+                lambda t, o: (1 - cfg.tau) * jnp.asarray(t) + cfg.tau * o,
+                self.target_q, {"q1": w["q1"], "q2": w["q2"]},
+            )
+        metrics["num_gradient_updates"] = self._updates
+        return metrics

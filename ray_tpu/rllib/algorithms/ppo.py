@@ -87,15 +87,36 @@ def make_ppo_loss(cfg: PPOConfig):
 
 class PPO(Algorithm):
     config_class = PPOConfig
+    supports_multi_agent = True
 
     def build_learner(self, cfg: PPOConfig) -> None:
         from ray_tpu.rllib.core.learner import make_optimizer
 
         tx = make_optimizer(cfg)
         loss_fn = make_ppo_loss(cfg)
-        spec = cfg.rl_module_spec()
         mesh = cfg.mesh
         seed = cfg.seed
+
+        if cfg.is_multi_agent:
+            from ray_tpu.rllib.env.multi_agent import MultiAgentLearnerGroup
+
+            specs = cfg.rl_module_specs()
+            factories = {
+                mid: (lambda s=s: JaxLearner(s.build(seed=seed), loss_fn, tx,
+                                             mesh=mesh))
+                for mid, s in specs.items()
+            }
+            self.learner_group = MultiAgentLearnerGroup(
+                factories, policies_to_train=cfg.policies_to_train
+            )
+            self._ref_modules = {mid: s.build(seed=0) for mid, s in specs.items()}
+            self._value_fns = {
+                mid: jax.jit(lambda p, o, m=m: m.apply(p, o)[VF_PREDS])
+                for mid, m in self._ref_modules.items()
+            }
+            return
+
+        spec = cfg.rl_module_spec()
 
         def factory():
             return JaxLearner(spec.build(seed=seed), loss_fn, tx, mesh=mesh)
@@ -131,8 +152,51 @@ class PPO(Algorithm):
         batch[VALUE_TARGETS] = targets.reshape(-1)
         return batch
 
+    def _postprocess_fragment(self, frag: SampleBatch, value_fn, params) -> SampleBatch:
+        """GAE over one contiguous (env, agent) fragment — the [T, B] math
+        with B=1 and per-step NEXT_OBS bootstrapping."""
+        cfg = self.algo_config
+        next_values = np.asarray(value_fn(params, jnp.asarray(frag[NEXT_OBS])))
+        col = lambda a: np.asarray(a).reshape(-1, 1)  # noqa: E731
+        adv, targets = compute_gae(
+            col(frag[REWARDS]), col(frag[VF_PREDS]), next_values.reshape(-1, 1),
+            col(frag[TERMINATEDS]), col(frag[TRUNCATEDS]),
+            cfg.gamma, cfg.lambda_,
+        )
+        frag[ADVANTAGES] = adv.reshape(-1)
+        frag[VALUE_TARGETS] = targets.reshape(-1)
+        return frag
+
+    def _multi_agent_training_step(self) -> dict:
+        """Reference: multi-agent PPO training_step — sample per-module
+        episode fragments, GAE each, then per-module SGD epochs."""
+        cfg = self.algo_config
+        weights = self.learner_group.get_weights()
+        jweights = {mid: jax.tree.map(jnp.asarray, w) for mid, w in weights.items()}
+        per_module: dict[str, list[SampleBatch]] = {}
+        total = 0
+        while total < cfg.train_batch_size:
+            frags = self.env_runner_group.sample_fragments(weights)
+            for mid, flist in frags.items():
+                for f in flist:
+                    per_module.setdefault(mid, []).append(
+                        self._postprocess_fragment(
+                            f, self._value_fns[mid], jweights[mid]
+                        )
+                    )
+                    total += len(f)
+        batches = {
+            mid: SampleBatch.concat_samples(fl) for mid, fl in per_module.items()
+        }
+        metrics = self.learner_group.update_epochs(
+            batches, num_epochs=cfg.num_epochs, minibatch_size=cfg.minibatch_size,
+        )
+        return {"num_env_steps_sampled": total, **metrics}
+
     def training_step(self) -> dict:
         cfg = self.algo_config
+        if cfg.is_multi_agent:
+            return self._multi_agent_training_step()
         weights = self.learner_group.get_weights()
         # 1. sample (synchronous_parallel_sample, execution/rollout_ops.py:20)
         # GAE runs on each runner's t-major batch before flat concat.

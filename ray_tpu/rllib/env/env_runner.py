@@ -135,13 +135,10 @@ class SingleAgentEnvRunner:
             logits = out["action_dist_inputs"]
             if logits_buf is None:
                 logits_buf = np.empty((T, B, logits.shape[-1]), np.float32)
-            # Gumbel-max sampling host-side (cheap, avoids device rng state).
-            g = self._rng.gumbel(size=logits.shape).astype(np.float32)
-            actions = np.argmax(logits + g, axis=-1)
-            logp_all = logits - _logsumexp(logits)
+            actions, logp = gumbel_sample_logits(logits, self._rng)
             obs_buf[t] = self.obs
             act_buf[t] = actions
-            logp_buf[t] = np.take_along_axis(logp_all, actions[:, None], 1)[:, 0]
+            logp_buf[t] = logp
             vf_buf[t] = out[VF_PREDS]
             logits_buf[t] = logits
             next_obs, rewards, terms, truncs, final_obs = self.vec.step(actions)
@@ -236,18 +233,22 @@ class SingleAgentEnvRunner:
         """Drain episode stats (reference: env runner metrics logger)."""
         rets, lens = self._completed_returns, self._completed_lengths
         self._completed_returns, self._completed_lengths = [], []
-        if not rets:
-            return {"num_episodes": 0}
-        return {
-            "num_episodes": len(rets),
-            "episode_return_mean": float(np.mean(rets)),
-            "episode_return_max": float(np.max(rets)),
-            "episode_return_min": float(np.min(rets)),
-            "episode_len_mean": float(np.mean(lens)),
-        }
+        return summarize_episodes(rets, lens)
 
     def stop(self) -> None:
         self.vec.close()
+
+
+def summarize_episodes(returns: list[float], lengths: list[int]) -> dict:
+    if not returns:
+        return {"num_episodes": 0}
+    return {
+        "num_episodes": len(returns),
+        "episode_return_mean": float(np.mean(returns)),
+        "episode_return_max": float(np.max(returns)),
+        "episode_return_min": float(np.min(returns)),
+        "episode_len_mean": float(np.mean(lengths)),
+    }
 
 
 def _logsumexp(x: np.ndarray) -> np.ndarray:
@@ -255,9 +256,40 @@ def _logsumexp(x: np.ndarray) -> np.ndarray:
     return m + np.log(np.exp(x - m).sum(axis=-1, keepdims=True))
 
 
+def gumbel_sample_logits(
+    logits: np.ndarray, rng: np.random.Generator
+) -> tuple[np.ndarray, np.ndarray]:
+    """Sample categorical actions host-side via gumbel-max (avoids device
+    rng state) and return (actions, logp_of_actions)."""
+    g = rng.gumbel(size=logits.shape).astype(np.float32)
+    actions = np.argmax(logits + g, axis=-1)
+    logp_all = logits - _logsumexp(logits)
+    return actions, np.take_along_axis(logp_all, actions[..., None], -1)[..., 0]
+
+
+def merge_episode_metrics(per: list[dict]) -> dict:
+    """Episode-count-weighted merge of per-runner summarize_episodes dicts."""
+    merged: dict = {"num_episodes": sum(m.get("num_episodes", 0) for m in per)}
+    with_eps = [m for m in per if "episode_return_mean" in m]
+    if with_eps:
+        w = [m["num_episodes"] for m in with_eps]
+        merged["episode_return_mean"] = float(
+            np.average([m["episode_return_mean"] for m in with_eps], weights=w)
+        )
+        merged["episode_return_max"] = max(m["episode_return_max"] for m in with_eps)
+        merged["episode_return_min"] = min(m["episode_return_min"] for m in with_eps)
+        merged["episode_len_mean"] = float(
+            np.average([m["episode_len_mean"] for m in with_eps], weights=w)
+        )
+    return merged
+
+
 class EnvRunnerGroup:
     """Remote env-runner actors + local fallback (reference:
-    rllib/env/env_runner_group.py:71)."""
+    rllib/env/env_runner_group.py:71). Subclasses swap ``runner_cls``
+    (multi-agent group) without re-implementing the fan-out."""
+
+    runner_cls: type = None  # set below (class defined later in this file)
 
     def __init__(self, config: "AlgorithmConfig"):  # noqa: F821
         import ray_tpu
@@ -265,14 +297,12 @@ class EnvRunnerGroup:
         self.config = config
         self.num_remote = config.num_env_runners
         if self.num_remote == 0:
-            self.local_runner: Optional[SingleAgentEnvRunner] = SingleAgentEnvRunner(
-                config, seed=config.seed
-            )
+            self.local_runner = self.runner_cls(config, seed=config.seed)
             self.remote_runners = []
         else:
             self.local_runner = None
             cls = ray_tpu.remote(num_cpus=config.num_cpus_per_env_runner)(
-                SingleAgentEnvRunner
+                self.runner_cls
             )
             self.remote_runners = [
                 cls.remote(config, seed=config.seed + 1000 * (i + 1))
@@ -308,19 +338,7 @@ class EnvRunnerGroup:
             per = [self.local_runner.get_metrics()]
         else:
             per = ray_tpu.get([r.get_metrics.remote() for r in self.remote_runners])
-        merged: dict = {"num_episodes": sum(m.get("num_episodes", 0) for m in per)}
-        means = [m["episode_return_mean"] for m in per if "episode_return_mean" in m]
-        if means:
-            weights = [m["num_episodes"] for m in per if "episode_return_mean" in m]
-            merged["episode_return_mean"] = float(np.average(means, weights=weights))
-            merged["episode_return_max"] = max(m["episode_return_max"] for m in per if "episode_return_max" in m)
-            merged["episode_len_mean"] = float(
-                np.average(
-                    [m["episode_len_mean"] for m in per if "episode_len_mean" in m],
-                    weights=weights,
-                )
-            )
-        return merged
+        return merge_episode_metrics(per)
 
     def stop(self) -> None:
         import ray_tpu
@@ -332,3 +350,6 @@ class EnvRunnerGroup:
                 ray_tpu.kill(r)
             except Exception:
                 pass
+
+
+EnvRunnerGroup.runner_cls = SingleAgentEnvRunner

@@ -1,0 +1,175 @@
+"""Multi-agent RLlib: env runner fragment semantics + PPO learning.
+
+Mirrors the reference's multi-agent coverage
+(rllib/env/tests/test_multi_agent_env_runner.py, multi-agent PPO in
+rllib/tuned_examples/ppo/multi_agent_*.py) on the JAX stack.
+"""
+
+import numpy as np
+import pytest
+
+from ray_tpu.rllib import PPOConfig
+from ray_tpu.rllib.env.multi_agent import (
+    DEFAULT_MODULE_ID,
+    MultiAgentEnv,
+    MultiAgentEnvRunner,
+)
+from ray_tpu.rllib.sample_batch import OBS, ACTIONS, REWARDS, TERMINATEDS
+
+
+class SignalMatch(MultiAgentEnv):
+    """Two agents each see a one-hot signal; reward 1 for matching action
+    to the signal index. Trivially learnable: random policy scores 1/3."""
+
+    possible_agents = ["a0", "a1"]
+    observation_dims = {"a0": 3, "a1": 3}
+    action_dims = {"a0": 3, "a1": 3}
+
+    def __init__(self, episode_len: int = 8):
+        self.episode_len = episode_len
+        self._rng = np.random.default_rng(0)
+        self.t = 0
+
+    def _obs(self):
+        self.signals = {a: int(self._rng.integers(3)) for a in self.possible_agents}
+        return {
+            a: np.eye(3, dtype=np.float32)[self.signals[a]]
+            for a in self.possible_agents
+        }
+
+    def reset(self, seed=None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self.t = 0
+        return self._obs(), {}
+
+    def step(self, action_dict):
+        rewards = {
+            a: float(action_dict[a] == self.signals[a]) for a in action_dict
+        }
+        self.t += 1
+        done = self.t >= self.episode_len
+        obs = self._obs() if not done else {}
+        return obs, rewards, {"__all__": done}, {"__all__": False}, {}
+
+
+class TurnBased(MultiAgentEnv):
+    """Agents alternate turns; the mover's reward arrives with the
+    opponent's next move (tests open-transition reward accumulation)."""
+
+    possible_agents = ["p0", "p1"]
+    observation_dims = {"p0": 2, "p1": 2}
+    action_dims = {"p0": 2, "p1": 2}
+
+    def __init__(self):
+        self.t = 0
+
+    def reset(self, seed=None):
+        self.t = 0
+        return {"p0": np.zeros(2, np.float32)}, {}
+
+    def step(self, action_dict):
+        self.t += 1
+        mover = "p0" if self.t % 2 == 1 else "p1"
+        other = "p1" if mover == "p0" else "p0"
+        assert list(action_dict) == [mover]
+        done = self.t >= 6
+        obs = {} if done else {other: np.full(2, self.t, np.float32)}
+        # Reward for the PREVIOUS mover, delivered one step late.
+        rewards = {other: 0.5} if self.t > 1 else {}
+        return obs, rewards, {"__all__": done}, {"__all__": False}, {}
+
+
+def _ma_config(**training):
+    return (
+        PPOConfig()
+        .environment(env=lambda: SignalMatch())
+        .multi_agent(policies=["a0", "a1"],
+                     policy_mapping_fn=lambda agent_id, env_index=0, **kw: agent_id)
+        .env_runners(num_envs_per_env_runner=4, rollout_fragment_length=16)
+        .training(train_batch_size=128, minibatch_size=64, num_epochs=4,
+                  lr=3e-2, entropy_coeff=0.0, **training)
+        .debugging(seed=7)
+    )
+
+
+def test_runner_emits_per_module_fragments():
+    cfg = _ma_config()
+    cfg._infer_spaces()
+    runner = MultiAgentEnvRunner(cfg, seed=0)
+    frags = runner.sample()
+    assert set(frags) == {"a0", "a1"}
+    for mid, fl in frags.items():
+        assert fl, f"no fragments for {mid}"
+        for f in fl:
+            assert f[OBS].shape[1] == 3
+            assert len(f[ACTIONS]) == len(f[REWARDS]) == len(f)
+            # Episode length 8 with rollout 16: fragments never exceed one
+            # episode.
+            assert len(f) <= 8
+    # Full-episode fragments end with terminated=True on the last row.
+    done_frags = [f for fl in frags.values() for f in fl if f[TERMINATEDS].any()]
+    assert done_frags
+    for f in done_frags:
+        assert f[TERMINATEDS][-1]
+        assert not f[TERMINATEDS][:-1].any()
+    runner.stop()
+
+
+def test_turn_based_reward_attribution():
+    cfg = (
+        PPOConfig()
+        .environment(env=lambda: TurnBased())
+        .multi_agent(policies=["shared"],
+                     policy_mapping_fn=lambda *a, **k: "shared")
+        .env_runners(num_envs_per_env_runner=1, rollout_fragment_length=6)
+        .debugging(seed=3)
+    )
+    cfg._infer_spaces()
+    runner = MultiAgentEnvRunner(cfg, seed=0)
+    frags = runner.sample()["shared"]
+    # One episode of 6 turns: p0 moves at t=1,3,5 (3 transitions), p1 at
+    # t=2,4,6 (3 transitions). Every completed move earns the delayed 0.5
+    # except the final mover (episode ends before payout).
+    total = np.concatenate([f[REWARDS] for f in frags])
+    assert len(total) == 6
+    assert pytest.approx(float(total.sum()), abs=1e-6) == 0.5 * 5
+    runner.stop()
+
+
+def test_multi_agent_ppo_learns_signal_match():
+    algo = _ma_config().build()
+    try:
+        first = None
+        result = {}
+        for _ in range(15):
+            result = algo.train()
+            if first is None and "episode_return_mean" in result:
+                first = result["episode_return_mean"]
+            if result.get("episode_return_mean", 0) > 13.0:
+                break
+        # Random play: 2 agents * 8 steps * 1/3 ≈ 5.3; learned: → 16.
+        assert result["episode_return_mean"] > 10.0, result
+    finally:
+        algo.cleanup()
+
+
+def test_multi_agent_shared_policy_and_checkpoint(tmp_path):
+    cfg = (
+        PPOConfig()
+        .environment(env=lambda: SignalMatch())
+        .multi_agent(policies=[DEFAULT_MODULE_ID])
+        .env_runners(num_envs_per_env_runner=2, rollout_fragment_length=8)
+        .training(train_batch_size=32, minibatch_size=16, num_epochs=1)
+    )
+    algo = cfg.build()
+    try:
+        algo.train()
+        w = algo.get_weights()
+        assert set(w) == {DEFAULT_MODULE_ID}
+        ckpt = tmp_path / "ckpt"
+        ckpt.mkdir()
+        algo.save_checkpoint(str(ckpt))
+        algo.load_checkpoint(str(ckpt))
+    finally:
+        algo.cleanup()
