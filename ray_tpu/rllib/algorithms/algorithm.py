@@ -305,16 +305,31 @@ class Algorithm(Trainable):
 
     # --- checkpointing (reference: Checkpointable mixin utils/checkpoints.py) ---
 
+    def get_extra_state(self) -> dict:
+        """Algorithm-held state beyond the learner (target networks,
+        moving statistics, rng keys). Subclasses override both hooks so
+        checkpoints capture their full training state."""
+        return {}
+
+    def set_extra_state(self, state: dict) -> None:
+        pass
+
     def save_checkpoint(self, checkpoint_dir: str) -> None:
         state = self.learner_group.get_state()
         with open(os.path.join(checkpoint_dir, "algo_state.pkl"), "wb") as f:
-            pickle.dump({"learner": state, "iteration": self.iteration}, f)
+            pickle.dump({
+                "learner": state,
+                "iteration": self.iteration,
+                "extra": self.get_extra_state(),
+            }, f)
 
     def load_checkpoint(self, checkpoint_dir: str) -> None:
         with open(os.path.join(checkpoint_dir, "algo_state.pkl"), "rb") as f:
             state = pickle.load(f)
         self.learner_group.set_state(state["learner"])
         self.iteration = state["iteration"]
+        if state.get("extra"):
+            self.set_extra_state(state["extra"])
 
     def get_weights(self):
         return self.learner_group.get_weights()

@@ -155,6 +155,18 @@ def make_cql_loss(cfg: CQLConfig, action_center, action_half,
 class CQL(Algorithm):
     config_class = CQLConfig
 
+    def get_extra_state(self) -> dict:
+        return {
+            "target_q": jax.tree.map(np.asarray, self.target_q),
+            "updates": self._updates,
+            "key": np.asarray(self._key),
+        }
+
+    def set_extra_state(self, state: dict) -> None:
+        self.target_q = state["target_q"]
+        self._updates = state["updates"]
+        self._key = jnp.asarray(state["key"])
+
     def build_learner(self, cfg: CQLConfig) -> None:
         if cfg.offline_data is None:
             raise ValueError("CQL requires config.offline(offline_data=...)")

@@ -104,6 +104,18 @@ def make_dqn_loss():
 class DQN(Algorithm):
     config_class = DQNConfig
 
+    def get_extra_state(self) -> dict:
+        return {
+            "target_weights": jax.tree.map(np.asarray, self.target_weights),
+            "env_steps_total": self._env_steps_total,
+            "last_target_sync": self._last_target_sync,
+        }
+
+    def set_extra_state(self, state: dict) -> None:
+        self.target_weights = state["target_weights"]
+        self._env_steps_total = state["env_steps_total"]
+        self._last_target_sync = state["last_target_sync"]
+
     def build_learner(self, cfg: DQNConfig) -> None:
         spec = cfg.rl_module_spec()
         if cfg.num_learners > 0:

@@ -136,6 +136,12 @@ class MARWIL(Algorithm):
         self.learner_group = LearnerGroup(factory, num_learners=cfg.num_learners)
         self._ma_adv_norm = 1.0  # RMS of advantages, host-side moving stat
 
+    def get_extra_state(self) -> dict:
+        return {"ma_adv_norm": self._ma_adv_norm}
+
+    def set_extra_state(self, state: dict) -> None:
+        self._ma_adv_norm = state["ma_adv_norm"]
+
     def training_step(self) -> dict:
         cfg = self.algo_config
         rate = cfg.moving_average_sqd_adv_norm_update_rate

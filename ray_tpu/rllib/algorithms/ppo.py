@@ -156,7 +156,16 @@ class PPO(Algorithm):
         """GAE over one contiguous (env, agent) fragment — the [T, B] math
         with B=1 and per-step NEXT_OBS bootstrapping."""
         cfg = self.algo_config
-        next_values = np.asarray(value_fn(params, jnp.asarray(frag[NEXT_OBS])))
+        # Fragment lengths vary per episode; pad the jitted value call to a
+        # power-of-two bucket so XLA sees a bounded set of shapes instead
+        # of recompiling per length.
+        n = len(frag)
+        next_obs = np.asarray(frag[NEXT_OBS])
+        bucket = 1 << max(n - 1, 0).bit_length()
+        if bucket != n:
+            pad = np.repeat(next_obs[-1:], bucket - n, axis=0)
+            next_obs = np.concatenate([next_obs, pad], axis=0)
+        next_values = np.asarray(value_fn(params, jnp.asarray(next_obs)))[:n]
         col = lambda a: np.asarray(a).reshape(-1, 1)  # noqa: E731
         adv, targets = compute_gae(
             col(frag[REWARDS]), col(frag[VF_PREDS]), next_values.reshape(-1, 1),

@@ -172,6 +172,18 @@ def make_sac_loss(cfg: SACConfig, action_center, action_half,
 class SAC(Algorithm):
     config_class = SACConfig
 
+    def get_extra_state(self) -> dict:
+        return {
+            "target_q": jax.tree.map(np.asarray, self.target_q),
+            "env_steps_total": self._env_steps_total,
+            "key": np.asarray(self._key),
+        }
+
+    def set_extra_state(self, state: dict) -> None:
+        self.target_q = state["target_q"]
+        self._env_steps_total = state["env_steps_total"]
+        self._key = jnp.asarray(state["key"])
+
     def build_learner(self, cfg: SACConfig) -> None:
         spec = cfg.rl_module_spec()
         if cfg.num_learners > 0:

@@ -271,6 +271,14 @@ class MultiAgentEnvRunner:
                     self.traj[env_i] = {}
                     self._agent_to_module[env_i] = {}
                     obs = env.reset()[0]
+                else:
+                    # Per-agent done while the episode continues: the env
+                    # may include the dead agent's FINAL observation in
+                    # obs (reference convention); it must not act again.
+                    obs = {
+                        a: o for a, o in obs.items()
+                        if not (bool(term.get(a, False)) or bool(trunc.get(a, False)))
+                    }
                 self.cur_obs[env_i] = obs
 
         # 3. Rollout boundary: flush fragments, truncating open transitions

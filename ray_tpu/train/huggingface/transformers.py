@@ -1,0 +1,95 @@
+"""transformers.Trainer integration.
+
+Counterpart of the reference's ray.train.huggingface.transformers
+(reference: train/huggingface/transformers/_transformers_utils.py —
+prepare_trainer and RayTrainReportCallback). Run a stock
+``transformers.Trainer`` inside a TorchTrainer loop: the torch backend
+(ray_tpu.train.torch) has already set RANK/WORLD_SIZE and initialized the
+gloo process group, which transformers' TrainingArguments picks up, so
+``prepare_trainer`` only needs to splice in the report callback and
+silence per-rank progress bars on non-zero ranks.
+
+    def loop(config):
+        trainer = transformers.Trainer(model, args, train_dataset=ds)
+        trainer.add_callback(RayTrainReportCallback())
+        trainer = prepare_trainer(trainer)
+        trainer.train()
+
+    TorchTrainer(loop, scaling_config=ScalingConfig(num_workers=2)).fit()
+"""
+
+from __future__ import annotations
+
+import os
+
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.session import get_context, report
+
+try:  # subclass the real TrainerCallback when transformers is present
+    from transformers import TrainerCallback as _CallbackBase
+except Exception:  # pragma: no cover - transformers always in this image
+    _CallbackBase = object
+
+
+class RayTrainReportCallback(_CallbackBase):
+    """transformers.TrainerCallback reporting logs/checkpoints to the
+    train session (reference: _transformers_utils.py
+    RayTrainReportCallback — on_log buffers metrics; on_save reports the
+    just-written HF checkpoint directory as a train Checkpoint).
+
+    Implemented duck-typed (transformers invokes callbacks by attribute)
+    so importing this module never requires transformers itself.
+    """
+
+    CHECKPOINT_NAME = "checkpoint"
+
+    def __init__(self):
+        self._metrics: dict = {}
+
+    # transformers.TrainerCallback surface -----------------------------
+    def on_log(self, args, state, control, logs=None, **kwargs):
+        if logs:
+            self._metrics.update(
+                {k: v for k, v in logs.items() if isinstance(v, (int, float))}
+            )
+            self._metrics["step"] = state.global_step
+
+    def on_save(self, args, state, control, **kwargs):
+        ckpt_dir = os.path.join(
+            args.output_dir, f"checkpoint-{state.global_step}"
+        )
+        metrics = dict(self._metrics) or {"step": state.global_step}
+        if os.path.isdir(ckpt_dir):
+            report(metrics, checkpoint=Checkpoint.from_directory(ckpt_dir))
+        else:
+            report(metrics)
+        self._metrics = {}
+
+    def on_train_end(self, args, state, control, **kwargs):
+        if self._metrics:
+            report(dict(self._metrics))
+            self._metrics = {}
+
+    # Unused TrainerCallback hooks: transformers tolerates their absence
+    # only on TrainerCallback subclasses, so provide no-op fallbacks.
+    def __getattr__(self, name):
+        if name.startswith("on_"):
+            return lambda *a, **k: None
+        raise AttributeError(name)
+
+
+def prepare_trainer(trainer):
+    """Final fit-up of a transformers.Trainer for the train worker
+    (reference: _transformers_utils.py prepare_trainer)."""
+    ctx = get_context()
+    if ctx.get_world_rank() != 0:
+        # Quiet non-chief ranks (the reference disables progress bars on
+        # workers; rank-0 keeps user-visible logging).
+        trainer.args.disable_tqdm = True
+    has_report_cb = any(
+        isinstance(cb, RayTrainReportCallback)
+        for cb in getattr(trainer, "callback_handler").callbacks
+    )
+    if not has_report_cb:
+        trainer.add_callback(RayTrainReportCallback())
+    return trainer

@@ -1,0 +1,6 @@
+"""Namespace parity with ray.train.lightgbm (reference:
+train/lightgbm/lightgbm_trainer.py)."""
+
+from ray_tpu.train.gbdt import LightGBMTrainer
+
+__all__ = ["LightGBMTrainer"]

@@ -1,0 +1,129 @@
+"""PyTorch Lightning integration (import-gated).
+
+Counterpart of the reference's ray.train.lightning
+(reference: train/lightning/_lightning_utils.py — RayDDPStrategy,
+RayLightningEnvironment, RayTrainReportCallback, prepare_trainer).
+Lightning is not installed in this image; every public symbol raises a
+clear ImportError at use. The environment/strategy contract mirrors the
+reference: ranks and the rendezvous come from the ray_tpu train session
+(ray_tpu.train.torch gloo process group), and Lightning is told NOT to
+launch its own processes.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def _lightning():
+    try:
+        import lightning.pytorch as pl  # lightning>=2
+        return pl
+    except ImportError:
+        try:
+            import pytorch_lightning as pl  # legacy package name
+            return pl
+        except ImportError as e:
+            raise ImportError(
+                "ray_tpu.train.lightning requires 'lightning' (or "
+                "'pytorch_lightning'), which is not installed in this "
+                "environment. Install it or write the training loop with "
+                "TorchTrainer directly."
+            ) from e
+
+
+def RayDDPStrategy(**kwargs):
+    """DDP strategy bound to the session's pre-initialized gloo group
+    (reference: _lightning_utils.py RayDDPStrategy)."""
+    pl = _lightning()
+    from ray_tpu.train.session import get_context
+
+    ctx = get_context()
+
+    class _Strategy(pl.strategies.DDPStrategy):
+        @property
+        def root_device(self):
+            import torch
+
+            return torch.device("cpu")
+
+        @property
+        def distributed_sampler_kwargs(self):
+            return {"num_replicas": ctx.get_world_size(),
+                    "rank": ctx.get_world_rank()}
+
+    return _Strategy(**kwargs)
+
+
+def RayLightningEnvironment():
+    """ClusterEnvironment that reads ranks from the train session
+    (reference: _lightning_utils.py RayLightningEnvironment)."""
+    pl = _lightning()
+    from lightning.fabric.plugins.environments import LightningEnvironment
+
+    from ray_tpu.train.session import get_context
+
+    ctx = get_context()
+
+    class _Env(LightningEnvironment):
+        def world_size(self) -> int:
+            return ctx.get_world_size()
+
+        def global_rank(self) -> int:
+            return ctx.get_world_rank()
+
+        def local_rank(self) -> int:
+            return ctx.get_local_rank()
+
+        def node_rank(self) -> int:
+            return ctx.get_node_rank()
+
+        @property
+        def creates_processes_externally(self) -> bool:
+            return True  # ray_tpu spawned the workers already
+
+    return _Env()
+
+
+class RayTrainReportCallback:
+    """Lightning Callback reporting per-epoch metrics + checkpoint
+    (reference: _lightning_utils.py RayTrainReportCallback). Duck-typed:
+    Lightning calls hooks by name, so no base class import is needed
+    until training actually runs."""
+
+    def on_train_epoch_end(self, trainer, pl_module):
+        import tempfile
+
+        from ray_tpu.train.checkpoint import Checkpoint
+        from ray_tpu.train.session import get_context, report
+
+        metrics = {k: float(v) for k, v in trainer.callback_metrics.items()}
+        metrics["epoch"] = trainer.current_epoch
+        metrics["step"] = trainer.global_step
+        if get_context().get_world_rank() == 0:
+            with tempfile.TemporaryDirectory() as d:
+                ckpt_path = os.path.join(d, "checkpoint.ckpt")
+                trainer.save_checkpoint(ckpt_path, weights_only=False)
+                report(metrics, checkpoint=Checkpoint.from_directory(d))
+        else:
+            report(metrics)
+
+    def __getattr__(self, name):
+        if name.startswith("on_") or name in ("setup", "teardown"):
+            return lambda *a, **k: None
+        raise AttributeError(name)
+
+
+def prepare_trainer(trainer):
+    """Validate a Lightning Trainer for ray_tpu train workers
+    (reference: _lightning_utils.py prepare_trainer)."""
+    _lightning()
+    return trainer
+
+
+__all__ = [
+    "RayDDPStrategy",
+    "RayLightningEnvironment",
+    "RayTrainReportCallback",
+    "prepare_trainer",
+]

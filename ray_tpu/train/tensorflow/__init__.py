@@ -1,0 +1,141 @@
+"""TensorFlow training backend: MultiWorkerMirroredStrategy via TF_CONFIG.
+
+Counterpart of the reference's ray.train.tensorflow
+(reference: train/tensorflow/config.py — _setup_tensorflow_environment
+builds TF_CONFIG from the worker group's addresses;
+tensorflow_trainer.py TensorflowTrainer; train_loop_utils.py
+prepare_dataset_shard). Every worker publishes host:port through the
+cluster KV; once all ranks are visible each assembles the identical
+TF_CONFIG cluster spec and the user loop creates
+``tf.distribute.MultiWorkerMirroredStrategy()``.
+
+    def loop(config):
+        strategy = tf.distribute.MultiWorkerMirroredStrategy()
+        with strategy.scope():
+            model = build_and_compile()
+        model.fit(...)
+
+    TensorflowTrainer(loop, scaling_config=ScalingConfig(num_workers=2)).fit()
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import socket
+import time
+
+from ray_tpu.train.backend import Backend, BackendConfig
+from ray_tpu.train.trainer import JaxTrainer
+
+
+@dataclasses.dataclass
+class TensorflowConfig(BackendConfig):
+    """Reference: train/tensorflow/config.py TensorflowConfig."""
+
+    init_timeout_s: float = 120.0
+
+    def backend_cls(self):
+        return _TensorflowBackend
+
+
+def _free_port() -> int:
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _host_ip() -> str:
+    try:
+        return socket.gethostbyname(socket.gethostname())
+    except OSError:
+        return "127.0.0.1"
+
+
+class _TensorflowBackend(Backend):
+    """All-rank address exchange through the cluster KV (the reference
+    gathers every worker's address via the worker group and pushes
+    TF_CONFIG to each, config.py _setup_tensorflow_environment)."""
+
+    def on_worker_setup(self, rank: int, world_size: int, group_name: str,
+                        config: TensorflowConfig | None = None) -> None:
+        config = config or TensorflowConfig()
+        if world_size <= 1:
+            os.environ.pop("TF_CONFIG", None)
+            return
+        from ray_tpu._private.worker_context import global_runtime
+
+        rt = global_runtime()
+        addr = f"{_host_ip()}:{_free_port()}"
+        rt.kv_put(f"tf_addr:{group_name}:{rank}", addr.encode(), ns="__train__")
+        workers: list[str | None] = [None] * world_size
+        deadline = time.time() + config.init_timeout_s
+        while time.time() < deadline:
+            missing = False
+            for r in range(world_size):
+                if workers[r] is None:
+                    raw = rt.kv_get(f"tf_addr:{group_name}:{r}", ns="__train__")
+                    if raw:
+                        workers[r] = raw.decode()
+                    else:
+                        missing = True
+            if not missing:
+                break
+            time.sleep(0.05)
+        else:
+            absent = [r for r, w in enumerate(workers) if w is None]
+            raise TimeoutError(
+                f"rank {rank}: TF_CONFIG rendezvous incomplete after "
+                f"{config.init_timeout_s}s; missing ranks {absent}"
+            )
+        os.environ["TF_CONFIG"] = json.dumps({
+            "cluster": {"worker": workers},
+            "task": {"type": "worker", "index": rank},
+        })
+
+    def on_shutdown(self, worker_group, backend_config) -> None:
+        try:
+            from ray_tpu._private.worker_context import try_runtime
+
+            rt = try_runtime()
+            if rt is not None:
+                for r in range(worker_group.scaling.num_workers):
+                    rt.kv_del(f"tf_addr:{worker_group.group_name}:{r}",
+                              ns="__train__")
+        except Exception:
+            pass
+
+
+class TensorflowTrainer(JaxTrainer):
+    """Reference: train/tensorflow/tensorflow_trainer.py — a
+    DataParallelTrainer whose backend assembles TF_CONFIG."""
+
+    def __init__(self, train_loop_per_worker, *, backend_config=None, **kw):
+        super().__init__(
+            train_loop_per_worker,
+            backend_config=backend_config or TensorflowConfig(),
+            **kw,
+        )
+
+
+def prepare_dataset_shard(dataset):
+    """Disable tf.data auto-sharding: the shard handed to this worker is
+    already its slice (reference: train/tensorflow/train_loop_utils.py
+    prepare_dataset_shard)."""
+    import tensorflow as tf
+
+    options = tf.data.Options()
+    options.experimental_distribute.auto_shard_policy = (
+        tf.data.experimental.AutoShardPolicy.OFF
+    )
+    return dataset.with_options(options)
+
+
+__all__ = [
+    "TensorflowConfig",
+    "TensorflowTrainer",
+    "prepare_dataset_shard",
+]

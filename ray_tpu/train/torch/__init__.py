@@ -64,11 +64,16 @@ class _TorchBackend(Backend):
     def on_worker_setup(self, rank: int, world_size: int, group_name: str,
                         config: TorchConfig | None = None) -> None:
         config = config or TorchConfig()
+        if world_size <= 1:
+            # A lone worker must look non-distributed: libraries that key
+            # off RANK (transformers' TrainingArguments does) would try an
+            # env:// rendezvous that was never set up.
+            for var in ("RANK", "WORLD_SIZE", "LOCAL_RANK"):
+                os.environ.pop(var, None)
+            return
         os.environ["RANK"] = str(rank)
         os.environ["WORLD_SIZE"] = str(world_size)
         os.environ["LOCAL_RANK"] = str(rank)
-        if world_size <= 1:
-            return
         import torch.distributed as dist
 
         from ray_tpu._private.worker_context import global_runtime

@@ -1,0 +1,6 @@
+"""Namespace parity with ray.train.xgboost (reference:
+train/xgboost/xgboost_trainer.py)."""
+
+from ray_tpu.train.gbdt import XGBoostTrainer
+
+__all__ = ["XGBoostTrainer"]

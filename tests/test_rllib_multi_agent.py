@@ -137,6 +137,62 @@ def test_turn_based_reward_attribution():
     runner.stop()
 
 
+class EarlyLeave(MultiAgentEnv):
+    """Agent a1 terminates at t=2 (its final obs IS included in the obs
+    dict, reference convention); a0 plays to the end at t=5."""
+
+    possible_agents = ["a0", "a1"]
+    observation_dims = {"a0": 2, "a1": 2}
+    action_dims = {"a0": 2, "a1": 2}
+
+    def __init__(self):
+        self.t = 0
+
+    def reset(self, seed=None):
+        self.t = 0
+        return {a: np.zeros(2, np.float32) for a in self.possible_agents}, {}
+
+    def step(self, acts):
+        # A dead agent must never act again.
+        if self.t >= 2:
+            assert "a1" not in acts, f"a1 acted after termination (t={self.t})"
+        self.t += 1
+        done = self.t >= 5
+        obs = {"a0": np.full(2, self.t, np.float32)}
+        term = {"__all__": done}
+        if self.t == 2:
+            obs["a1"] = np.full(2, -1.0, np.float32)  # final obs
+            term["a1"] = True
+        rew = {a: 1.0 for a in (["a0", "a1"] if self.t <= 2 else ["a0"])}
+        return obs, rew, term, {"__all__": False}, {}
+
+
+def test_per_agent_early_termination():
+    cfg = (
+        PPOConfig()
+        .environment(env=lambda: EarlyLeave())
+        .multi_agent(policies=["shared"],
+                     policy_mapping_fn=lambda *a, **k: "shared")
+        .env_runners(num_envs_per_env_runner=1, rollout_fragment_length=10)
+        .debugging(seed=0)
+    )
+    cfg._infer_spaces()
+    runner = MultiAgentEnvRunner(cfg, seed=0)
+    frags = runner.sample()["shared"]
+    # a1 acts once (t=0), then only observes again at its termination:
+    # ONE transition, terminated, with rewards from t=1 AND t=2
+    # accumulated while the transition was open.
+    a1_frags = [f for f in frags if len(f) == 1]
+    assert a1_frags, [(len(f), f[TERMINATEDS].tolist()) for f in frags]
+    for f in a1_frags:
+        assert f[TERMINATEDS][-1]
+        assert f[REWARDS][0] == pytest.approx(2.0)
+    # a0 plays full 5-step episodes ending terminated.
+    a0_frags = [f for f in frags if len(f) == 5]
+    assert a0_frags and all(f[TERMINATEDS][-1] for f in a0_frags)
+    runner.stop()
+
+
 def test_multi_agent_ppo_learns_signal_match():
     algo = _ma_config().build()
     try:
