@@ -1,0 +1,24 @@
+// C++ frontend smoke example (reference: cpp/example/example.cc).
+#include <cstdio>
+
+#include "ray_tpu/api.h"
+
+int main() {
+  ray_tpu::Init(R"({"num_cpus": 2, "object_store_memory": 33554432})");
+
+  // Task round trip.
+  auto ref = ray_tpu::TaskExpr("6 * 7");
+  double v = ray_tpu::GetDouble(ref);
+  std::printf("task: %g\n", v);
+  if (v != 42.0) return 1;
+
+  // Put/Get + handle release.
+  auto p = ray_tpu::Put(2.5);
+  if (ray_tpu::GetDouble(p) != 2.5) return 2;
+  ray_tpu::Free(p);
+  ray_tpu::Free(ref);
+
+  ray_tpu::Shutdown();
+  std::printf("CPP-OK\n");
+  return 0;
+}

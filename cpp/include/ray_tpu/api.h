@@ -1,0 +1,72 @@
+// C++ API frontend for the ray_tpu runtime.
+//
+// Counterpart of the reference's C++ API (reference: cpp/include/ray/api.h
+// — ray::Init/Shutdown, ray::Task(fn).Remote(args...), ray::Get, actors via
+// ray::Actor(Factory::Create).Remote(); runtime under cpp/src/ray/runtime/*
+// wraps the core-worker library). Design difference: this runtime's control
+// plane is a Python+C++ hybrid, so the C++ frontend embeds a CPython
+// interpreter and drives the same public API the Python frontend uses —
+// one behavior, two frontends — instead of duplicating the task protocol
+// in native code. Values cross the boundary as doubles/ints/strings
+// (the common remote-compute types of the reference's C++ API examples).
+//
+// Usage:
+//   ray_tpu::Init();
+//   auto ref = ray_tpu::Task("mymodule.square", 7.0);   // submits f.remote
+//   double out = ray_tpu::GetDouble(ref);
+//   ray_tpu::Shutdown();
+//
+// Build: g++ app.cc $(python3-config --includes --ldflags --embed) -lray_tpu_api
+
+#pragma once
+
+#include <string>
+#include <vector>
+
+namespace ray_tpu {
+
+// Start (or connect to) a cluster in this process. kwargs_json is passed to
+// ray_tpu.init(**kwargs) — e.g. R"({"num_cpus": 4})".
+void Init(const std::string& kwargs_json = "{}");
+
+void Shutdown();
+
+// An object reference handle (opaque id into the embedded runtime).
+struct ObjectRef {
+  long long id;
+};
+
+// Submit `module.function` with double arguments; returns a reference.
+ObjectRef Task(const std::string& qualified_fn,
+               const std::vector<double>& args);
+ObjectRef Task(const std::string& qualified_fn, double arg);
+
+// Submit a Python expression task: evaluates `expr` remotely with no args
+// (for quick checks / tests without authoring a module).
+ObjectRef TaskExpr(const std::string& expr);
+
+// Blocking gets.
+double GetDouble(const ObjectRef& ref);
+std::string GetString(const ObjectRef& ref);
+
+// Put a double into the object store.
+ObjectRef Put(double value);
+
+// Actors: create `module.Class(args...)`, call methods, get results.
+struct ActorHandle {
+  long long id;
+};
+ActorHandle Actor(const std::string& qualified_cls,
+                  const std::vector<double>& args = {});
+ObjectRef Call(const ActorHandle& actor, const std::string& method,
+               const std::vector<double>& args = {});
+
+}  // namespace ray_tpu
+namespace ray_tpu {
+
+// Release a handle held by the embedded interpreter (the object-store
+// entry it pins becomes collectable). Safe to call once per handle.
+void Free(const ObjectRef& ref);
+void Free(const ActorHandle& actor);
+
+}  // namespace ray_tpu

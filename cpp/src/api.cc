@@ -1,0 +1,294 @@
+// C++ API frontend implementation: embedded CPython driving ray_tpu.
+// Reference analogue: cpp/src/ray/runtime/native_ray_runtime.cc (the
+// reference's C++ runtime binds the core-worker C++ lib directly; here the
+// runtime is reached through its public Python API — see api.h docstring).
+
+#include "ray_tpu/api.h"
+
+#include <Python.h>
+
+#include <mutex>
+#include <stdexcept>
+#include <unordered_map>
+
+namespace ray_tpu {
+namespace {
+
+std::mutex g_mu;
+bool g_initialized = false;
+long long g_next_id = 1;
+// Live references/handles held by the embedded interpreter.
+std::unordered_map<long long, PyObject*> g_objects;
+
+// GIL discipline: Init() releases the GIL after bootstrapping (so Python
+// daemon threads — e.g. the driver log monitor — keep running while the
+// C++ app computes), and every entrypoint re-acquires it around its
+// Python work via this guard. Combined with g_mu this makes the API safe
+// to call from any C++ thread.
+class GilGuard {
+ public:
+  GilGuard() : state_(PyGILState_Ensure()) {}
+  ~GilGuard() { PyGILState_Release(state_); }
+
+ private:
+  PyGILState_STATE state_;
+};
+
+void ThrowPyError(const std::string& where) {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  std::string msg = where + ": python error";
+  if (value != nullptr) {
+    PyObject* s = PyObject_Str(value);
+    if (s != nullptr) {
+      msg = where + ": " + PyUnicode_AsUTF8(s);
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  throw std::runtime_error(msg);
+}
+
+// Run `code` in a fresh dict against the __main__ globals; returns the
+// object bound to name `out` (new reference).
+PyObject* RunAndTake(const std::string& code,
+                     PyObject* locals_in = nullptr) {
+  PyObject* main_mod = PyImport_AddModule("__main__");  // borrowed
+  PyObject* globals = PyModule_GetDict(main_mod);       // borrowed
+  PyObject* locals = locals_in ? locals_in : PyDict_New();
+  PyObject* res =
+      PyRun_String(code.c_str(), Py_file_input, globals, locals);
+  if (res == nullptr) {
+    if (locals_in == nullptr) Py_DECREF(locals);
+    ThrowPyError("exec");
+  }
+  Py_DECREF(res);
+  PyObject* out = PyDict_GetItemString(locals, "out");  // borrowed
+  Py_XINCREF(out);
+  if (locals_in == nullptr) Py_DECREF(locals);
+  if (out == nullptr) throw std::runtime_error("exec: no `out` produced");
+  return out;
+}
+
+long long Store(PyObject* obj) {
+  long long id = g_next_id++;
+  g_objects[id] = obj;  // takes the reference
+  return id;
+}
+
+PyObject* Lookup(long long id) {
+  auto it = g_objects.find(id);
+  if (it == g_objects.end()) throw std::runtime_error("unknown ref id");
+  return it->second;
+}
+
+PyObject* DoubleList(const std::vector<double>& args) {
+  PyObject* lst = PyList_New(static_cast<Py_ssize_t>(args.size()));
+  for (size_t i = 0; i < args.size(); ++i) {
+    PyList_SetItem(lst, static_cast<Py_ssize_t>(i),
+                   PyFloat_FromDouble(args[i]));
+  }
+  return lst;
+}
+
+PyThreadState* g_saved_ts = nullptr;
+
+}  // namespace
+
+void Init(const std::string& kwargs_json) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  if (g_initialized) return;
+  if (!Py_IsInitialized()) Py_Initialize();
+  PyObject* locals = PyDict_New();
+  PyObject* kw = PyUnicode_FromString(kwargs_json.c_str());
+  PyDict_SetItemString(locals, "kwargs_json", kw);
+  Py_DECREF(kw);
+  try {
+    PyObject* out = RunAndTake(
+        "import json\n"
+        "import ray_tpu\n"
+        "ray_tpu.init(**json.loads(kwargs_json))\n"
+        "out = True\n",
+        locals);
+    Py_DECREF(out);
+  } catch (...) {
+    Py_DECREF(locals);
+    throw;
+  }
+  Py_DECREF(locals);
+  g_initialized = true;
+  // Drop the GIL so Python daemon threads run while C++ computes;
+  // entrypoints re-acquire via GilGuard.
+  g_saved_ts = PyEval_SaveThread();
+}
+
+void Shutdown() {
+  std::lock_guard<std::mutex> lock(g_mu);
+  if (!g_initialized) return;
+  {
+    GilGuard gil;
+    for (auto& kv : g_objects) Py_DECREF(kv.second);
+    g_objects.clear();
+    PyObject* out =
+        RunAndTake("import ray_tpu\nray_tpu.shutdown()\nout = True\n");
+    Py_DECREF(out);
+  }
+  if (g_saved_ts != nullptr) {
+    PyEval_RestoreThread(g_saved_ts);
+    g_saved_ts = nullptr;
+  }
+  g_initialized = false;
+}
+
+ObjectRef Task(const std::string& qualified_fn,
+               const std::vector<double>& args) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  GilGuard gil;
+  PyObject* locals = PyDict_New();
+  PyObject* fn = PyUnicode_FromString(qualified_fn.c_str());
+  PyDict_SetItemString(locals, "fn_name", fn);
+  Py_DECREF(fn);
+  PyObject* lst = DoubleList(args);
+  PyDict_SetItemString(locals, "args", lst);
+  Py_DECREF(lst);
+  PyObject* out = RunAndTake(
+      "import importlib\n"
+      "import ray_tpu\n"
+      "mod, _, name = fn_name.rpartition('.')\n"
+      "f = getattr(importlib.import_module(mod), name)\n"
+      "out = ray_tpu.remote(f).remote(*args)\n",
+      locals);
+  Py_DECREF(locals);
+  return ObjectRef{Store(out)};
+}
+
+ObjectRef Task(const std::string& qualified_fn, double arg) {
+  return Task(qualified_fn, std::vector<double>{arg});
+}
+
+ObjectRef TaskExpr(const std::string& expr) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  GilGuard gil;
+  PyObject* locals = PyDict_New();
+  PyObject* e = PyUnicode_FromString(expr.c_str());
+  PyDict_SetItemString(locals, "expr", e);
+  Py_DECREF(e);
+  PyObject* out = RunAndTake(
+      "import ray_tpu\n"
+      "def _expr_task(src):\n"
+      "    return eval(src, {}, {})\n"
+      "out = ray_tpu.remote(_expr_task).remote(expr)\n",
+      locals);
+  Py_DECREF(locals);
+  return ObjectRef{Store(out)};
+}
+
+ObjectRef Put(double value) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  GilGuard gil;
+  PyObject* locals = PyDict_New();
+  PyObject* v = PyFloat_FromDouble(value);
+  PyDict_SetItemString(locals, "value", v);
+  Py_DECREF(v);
+  PyObject* out = RunAndTake("import ray_tpu\nout = ray_tpu.put(value)\n",
+                             locals);
+  Py_DECREF(locals);
+  return ObjectRef{Store(out)};
+}
+
+namespace {
+PyObject* GetObject(const ObjectRef& ref) {
+  PyObject* locals = PyDict_New();
+  PyDict_SetItemString(locals, "ref", Lookup(ref.id));
+  PyObject* out =
+      RunAndTake("import ray_tpu\nout = ray_tpu.get(ref)\n", locals);
+  Py_DECREF(locals);
+  return out;
+}
+}  // namespace
+
+double GetDouble(const ObjectRef& ref) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  GilGuard gil;
+  PyObject* out = GetObject(ref);
+  double v = PyFloat_AsDouble(out);
+  Py_DECREF(out);
+  if (PyErr_Occurred()) ThrowPyError("GetDouble");
+  return v;
+}
+
+std::string GetString(const ObjectRef& ref) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  GilGuard gil;
+  PyObject* out = GetObject(ref);
+  PyObject* s = PyObject_Str(out);
+  Py_DECREF(out);
+  if (s == nullptr) ThrowPyError("GetString");
+  std::string v = PyUnicode_AsUTF8(s);
+  Py_DECREF(s);
+  return v;
+}
+
+ActorHandle Actor(const std::string& qualified_cls,
+                  const std::vector<double>& args) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  GilGuard gil;
+  PyObject* locals = PyDict_New();
+  PyObject* cls = PyUnicode_FromString(qualified_cls.c_str());
+  PyDict_SetItemString(locals, "cls_name", cls);
+  Py_DECREF(cls);
+  PyObject* lst = DoubleList(args);
+  PyDict_SetItemString(locals, "args", lst);
+  Py_DECREF(lst);
+  PyObject* out = RunAndTake(
+      "import importlib\n"
+      "import ray_tpu\n"
+      "mod, _, name = cls_name.rpartition('.')\n"
+      "c = getattr(importlib.import_module(mod), name)\n"
+      "out = ray_tpu.remote(c).remote(*args)\n",
+      locals);
+  Py_DECREF(locals);
+  return ActorHandle{Store(out)};
+}
+
+ObjectRef Call(const ActorHandle& actor, const std::string& method,
+               const std::vector<double>& args) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  GilGuard gil;
+  PyObject* locals = PyDict_New();
+  PyDict_SetItemString(locals, "actor", Lookup(actor.id));
+  PyObject* m = PyUnicode_FromString(method.c_str());
+  PyDict_SetItemString(locals, "method", m);
+  Py_DECREF(m);
+  PyObject* lst = DoubleList(args);
+  PyDict_SetItemString(locals, "args", lst);
+  Py_DECREF(lst);
+  PyObject* out =
+      RunAndTake("out = getattr(actor, method).remote(*args)\n", locals);
+  Py_DECREF(locals);
+  return ObjectRef{Store(out)};
+}
+
+void Free(const ObjectRef& ref) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  GilGuard gil;
+  auto it = g_objects.find(ref.id);
+  if (it != g_objects.end()) {
+    Py_DECREF(it->second);
+    g_objects.erase(it);
+  }
+}
+
+void Free(const ActorHandle& actor) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  GilGuard gil;
+  auto it = g_objects.find(actor.id);
+  if (it != g_objects.end()) {
+    Py_DECREF(it->second);
+    g_objects.erase(it);
+  }
+}
+
+}  // namespace ray_tpu

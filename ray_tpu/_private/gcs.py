@@ -267,15 +267,12 @@ class Head:
         if num_tpus is not None:
             res["TPU"] = float(num_tpus)
         else:
-            try:
-                from ray_tpu.accelerators.tpu import TPUAcceleratorManager
+            # All registered vendor managers contribute (TPU, GPU,
+            # neuron_cores, plugins) — reference: resource_spec.py
+            # resolving _private/accelerators at node start.
+            from ray_tpu.accelerators.accelerator import merge_detected_resources
 
-                n = TPUAcceleratorManager.get_current_node_num_accelerators()
-                if n:
-                    res["TPU"] = float(n)
-                    res.update(TPUAcceleratorManager.get_current_node_additional_resources())
-            except Exception:
-                pass
+            merge_detected_resources(res)
         try:
             import psutil
 
@@ -315,6 +312,12 @@ class Head:
                 cwd=os.getcwd(),
             )  # the child keeps its inherited fd; don't leak one per spawn
         rec = WorkerRecord(worker_id, node_id, proc)
+        # Best-effort cgroup v2 isolation: workers land in the node's
+        # application slice (reference: cgroup_setup.h; no-op without a
+        # writable cgroupfs).
+        from ray_tpu._private.cgroup import CgroupSetup
+
+        CgroupSetup.get_or_create(self, self.node_id).add_worker_process(proc.pid)
         with self.lock:
             self.workers[worker_id] = rec
         return rec

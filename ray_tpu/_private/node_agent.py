@@ -94,6 +94,10 @@ class NodeAgent:
             res.setdefault("CPU", float(os.cpu_count() or 1))
         if num_tpus is not None:
             res["TPU"] = float(num_tpus)
+        else:
+            from ray_tpu.accelerators.accelerator import merge_detected_resources
+
+            merge_detected_resources(res)
         return res
 
     # ------------------------------------------------------------------
@@ -130,6 +134,10 @@ class NodeAgent:
                 cwd=os.getcwd(),
             )  # child keeps its inherited fd; parent must not leak one per spawn
         self.procs[worker_id] = proc
+        # Best-effort cgroup v2 isolation (reference: cgroup_setup.h).
+        from ray_tpu._private.cgroup import CgroupSetup
+
+        CgroupSetup.get_or_create(self, self.node_id).add_worker_process(proc.pid)
 
     def run_forever(self) -> None:
         self._exit.wait()

@@ -1,0 +1,23 @@
+"""Accelerator managers (reference: python/ray/_private/accelerators/)."""
+
+from ray_tpu.accelerators.accelerator import (
+    AcceleratorManager,
+    NeuronAcceleratorManager,
+    NvidiaGPUAcceleratorManager,
+    detect_node_accelerators,
+    get_accelerator_manager,
+    get_all_accelerator_managers,
+    register_accelerator_manager,
+)
+from ray_tpu.accelerators.tpu import TPUAcceleratorManager
+
+__all__ = [
+    "AcceleratorManager",
+    "NeuronAcceleratorManager",
+    "NvidiaGPUAcceleratorManager",
+    "TPUAcceleratorManager",
+    "detect_node_accelerators",
+    "get_accelerator_manager",
+    "get_all_accelerator_managers",
+    "register_accelerator_manager",
+]

@@ -1,0 +1,161 @@
+"""AcceleratorManager plugin ABC + vendor managers.
+
+Counterpart of the reference's accelerator plugin layer
+(reference: python/ray/_private/accelerators/accelerator.py:5
+AcceleratorManager ABC; nvidia_gpu.py, amd_gpu.py, intel_gpu.py, hpu.py,
+npu.py, neuron.py, tpu.py registered in __init__.py). The TPU manager
+(ray_tpu.accelerators.tpu) is the first-class path on this framework; the
+managers here make heterogeneous clusters schedulable: CPU-host nodes,
+NVIDIA GPU nodes (data preprocessing fleets in front of a TPU pod), and
+any future vendor via ``register_accelerator_manager``.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import Optional
+
+
+class AcceleratorManager:
+    """Static-method contract (reference: accelerator.py:5). All methods
+    are classmethod/static so managers never need instantiation."""
+
+    @staticmethod
+    def get_resource_name() -> str:
+        raise NotImplementedError
+
+    @staticmethod
+    def get_visible_accelerator_ids_env_var() -> str:
+        raise NotImplementedError
+
+    @staticmethod
+    def get_current_node_num_accelerators() -> int:
+        raise NotImplementedError
+
+    @staticmethod
+    def get_current_node_accelerator_type() -> Optional[str]:
+        return None
+
+    @staticmethod
+    def get_current_process_visible_accelerator_ids() -> Optional[list[str]]:
+        return None
+
+    @staticmethod
+    def set_current_process_visible_accelerator_ids(ids: list[str]) -> None:
+        pass
+
+    @staticmethod
+    def get_current_node_additional_resources() -> dict:
+        return {}
+
+
+class NvidiaGPUAcceleratorManager(AcceleratorManager):
+    """Reference: _private/accelerators/nvidia_gpu.py — resource "GPU",
+    CUDA_VISIBLE_DEVICES pinning, /proc|nvml discovery."""
+
+    @staticmethod
+    def get_resource_name() -> str:
+        return "GPU"
+
+    @staticmethod
+    def get_visible_accelerator_ids_env_var() -> str:
+        return "CUDA_VISIBLE_DEVICES"
+
+    @staticmethod
+    def get_current_node_num_accelerators() -> int:
+        visible = os.environ.get("CUDA_VISIBLE_DEVICES")
+        if visible is not None:
+            return 0 if visible in ("", "NoDevFiles") else len(visible.split(","))
+        # /proc/driver/nvidia/gpus has one subdir per device (the
+        # reference uses pynvml; device files avoid the dependency).
+        try:
+            return len(os.listdir("/proc/driver/nvidia/gpus"))
+        except OSError:
+            return 0
+
+    @staticmethod
+    def get_current_process_visible_accelerator_ids() -> Optional[list[str]]:
+        v = os.environ.get("CUDA_VISIBLE_DEVICES")
+        if v is None:
+            return None
+        return [] if v in ("", "NoDevFiles") else v.split(",")
+
+    @staticmethod
+    def set_current_process_visible_accelerator_ids(ids: list[str]) -> None:
+        os.environ["CUDA_VISIBLE_DEVICES"] = ",".join(str(i) for i in ids)
+
+
+class NeuronAcceleratorManager(AcceleratorManager):
+    """Reference: _private/accelerators/neuron.py — AWS Inferentia/
+    Trainium, resource "neuron_cores", NEURON_RT_VISIBLE_CORES."""
+
+    @staticmethod
+    def get_resource_name() -> str:
+        return "neuron_cores"
+
+    @staticmethod
+    def get_visible_accelerator_ids_env_var() -> str:
+        return "NEURON_RT_VISIBLE_CORES"
+
+    @staticmethod
+    def get_current_node_num_accelerators() -> int:
+        visible = os.environ.get("NEURON_RT_VISIBLE_CORES")
+        if visible:
+            return len(visible.split(","))
+        return len(glob.glob("/dev/neuron*"))
+
+    @staticmethod
+    def set_current_process_visible_accelerator_ids(ids: list[str]) -> None:
+        os.environ["NEURON_RT_VISIBLE_CORES"] = ",".join(str(i) for i in ids)
+
+
+_MANAGERS: dict[str, type[AcceleratorManager]] = {}
+
+
+def register_accelerator_manager(mgr: type[AcceleratorManager]) -> None:
+    """Plugin hook (reference: accelerators/__init__.py registry dict)."""
+    _MANAGERS[mgr.get_resource_name()] = mgr
+
+
+def get_accelerator_manager(resource_name: str) -> Optional[type[AcceleratorManager]]:
+    return _MANAGERS.get(resource_name)
+
+
+def get_all_accelerator_managers() -> list[type[AcceleratorManager]]:
+    return list(_MANAGERS.values())
+
+
+def detect_node_accelerators() -> dict[str, float]:
+    """Resources contributed by every registered manager on this node
+    (reference: resource_spec.py resolving managers at node start)."""
+    out: dict[str, float] = {}
+    for mgr in _MANAGERS.values():
+        n = mgr.get_current_node_num_accelerators()
+        if n > 0:
+            out[mgr.get_resource_name()] = float(n)
+            out.update(mgr.get_current_node_additional_resources())
+    return out
+
+
+def merge_detected_resources(res: dict) -> dict:
+    """setdefault every detected accelerator into ``res`` (user-supplied
+    counts win); never raises — detection failures leave res unchanged.
+    Shared by the head and the node agent's resource bootstrap."""
+    try:
+        for name, n in detect_node_accelerators().items():
+            res.setdefault(name, n)
+    except Exception:
+        pass
+    return res
+
+
+def _register_builtins() -> None:
+    from ray_tpu.accelerators.tpu import TPUAcceleratorManager
+
+    for mgr in (TPUAcceleratorManager, NvidiaGPUAcceleratorManager,
+                NeuronAcceleratorManager):
+        register_accelerator_manager(mgr)
+
+
+_register_builtins()

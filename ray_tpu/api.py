@@ -21,6 +21,7 @@ from ray_tpu._private.worker_context import global_runtime
 
 _init_lock = threading.Lock()
 _namespace = ""
+_log_monitor = None
 
 
 def init(
@@ -32,6 +33,7 @@ def init(
     object_store_memory: int | None = None,
     namespace: str = "",
     ignore_reinit_error: bool = False,
+    log_to_driver: bool = True,
     _system_config: dict | None = None,
 ) -> dict:
     """Start (or connect to) a cluster and attach this process as driver.
@@ -68,6 +70,15 @@ def init(
             head = Head(cfg, num_cpus=num_cpus, num_tpus=num_tpus, resources=resources)
             rt = CoreRuntime(head.address, client_type="driver")
             worker_context.set_runtime(rt, head)
+            if log_to_driver:
+                # Reference: log_monitor.py streaming worker logs to the
+                # driver console (ray.init(log_to_driver=True) default).
+                from ray_tpu._private.log_monitor import LogMonitor
+
+                global _log_monitor
+                _log_monitor = LogMonitor(
+                    os.path.join(head.session_dir, "logs"))
+                _log_monitor.start()
         else:
             # "ray://host:port" — Ray-Client-style remote driver
             # (reference: util/client, ray.init("ray://...")): same wire
@@ -96,9 +107,13 @@ def context_info() -> dict:
 
 
 def shutdown() -> None:
+    global _log_monitor
     with _init_lock:
         rt = worker_context.try_runtime()
         head = worker_context.get_head()
+        if _log_monitor is not None:
+            _log_monitor.stop()
+            _log_monitor = None
         if rt is None:
             return
         worker_context.set_runtime(None, None)

@@ -90,6 +90,7 @@ class Result:
     path: str
     metrics_history: list[dict[str, Any]] = dataclasses.field(default_factory=list)
     error: Exception | None = None
+    config: dict[str, Any] | None = None  # trial config (reference: Result.config)
 
     @property
     def best_checkpoints(self):

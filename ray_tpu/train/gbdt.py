@@ -35,12 +35,16 @@ def _require(module: str, trainer: str):
         ) from e
 
 
-def _shard_to_matrix(shard) -> tuple[np.ndarray, np.ndarray, str]:
+def _shard_to_matrix(shard, label_col: str = "label") -> tuple[np.ndarray, np.ndarray, str]:
     """(features, label, label_column) from a Dataset shard of dict rows."""
     rows = list(shard.iter_rows()) if hasattr(shard, "iter_rows") else list(shard)
     if not rows:
         raise ValueError("empty dataset shard")
-    label_col = "label" if "label" in rows[0] else sorted(rows[0])[-1]
+    if label_col not in rows[0]:
+        raise ValueError(
+            f"label column {label_col!r} not in dataset columns "
+            f"{sorted(rows[0])}"
+        )
     feat_cols = [c for c in rows[0] if c != label_col]
     X = np.asarray([[r[c] for c in feat_cols] for r in rows], np.float32)
     y = np.asarray([r[label_col] for r in rows], np.float32)
@@ -93,7 +97,8 @@ class XGBoostTrainer(GBDTTrainer):
             import xgboost as xgb
 
             ctx = get_context()
-            X, y, _ = _shard_to_matrix(get_dataset_shard("train"))
+            X, y, _ = _shard_to_matrix(get_dataset_shard("train"),
+                                       config["label_column"])
             dtrain = xgb.DMatrix(X, label=y)
             results: dict = {}
             bst = xgb.train(
@@ -129,7 +134,8 @@ class LightGBMTrainer(GBDTTrainer):
             import lightgbm as lgb
 
             ctx = get_context()
-            X, y, _ = _shard_to_matrix(get_dataset_shard("train"))
+            X, y, _ = _shard_to_matrix(get_dataset_shard("train"),
+                                       config["label_column"])
             train_set = lgb.Dataset(X, label=y)
             evals: dict = {}
             bst = lgb.train(

@@ -9,12 +9,15 @@ from ray_tpu.tune.schedulers import (
     ASHAScheduler,
     AsyncHyperBandScheduler,
     FIFOScheduler,
+    HyperBandScheduler,
     MedianStoppingRule,
     PopulationBasedTraining,
     TrialScheduler,
+    TuneBOHB,
 )
 from ray_tpu.tune.search import (
     BasicVariantGenerator,
+    HyperOptSearch,
     OptunaSearch,
     Searcher,
     choice,
@@ -38,6 +41,9 @@ from ray_tpu.tune.tuner import ResultGrid, Trial, TuneConfig, TuneController, Tu
 
 __all__ = [
     "ASHAScheduler",
+    "HyperBandScheduler",
+    "HyperOptSearch",
+    "TuneBOHB",
     "CheckpointConfig",
     "AsyncHyperBandScheduler",
     "BasicVariantGenerator",

@@ -291,3 +291,140 @@ class PopulationBasedTraining(TrialScheduler):
             decisions.setdefault(t, (t.config, None))
         self._at_boundary.clear()
         return decisions
+
+
+class HyperBandScheduler(TrialScheduler):
+    """Synchronous HyperBand (reference: tune/schedulers/hyperband.py).
+
+    Trials are assigned to brackets s = s_max..0; bracket s admits
+    n_s = ceil((s_max+1)/(s+1) * eta^s) trials with initial rung budget
+    r_s = max_t * eta^-s. At each rung every live bracket member PAUSES
+    until the whole bracket arrives, then the top 1/eta continue to the
+    next rung (budget *= eta) and the rest stop — the synchronous cut
+    ASHA deliberately forgoes. Losers are reaped through the
+    ``pending_stops`` controller hook."""
+
+    def __init__(
+        self,
+        time_attr: str = "training_iteration",
+        metric: str | None = None,
+        mode: str = "max",
+        max_t: int = 81,
+        reduction_factor: float = 3,
+    ):
+        self.time_attr = time_attr
+        self.metric, self.mode = metric, mode
+        self.max_t = max_t
+        self.eta = reduction_factor
+        self._s_max = int(math.log(max_t) / math.log(reduction_factor))
+        self._next_s = self._s_max
+        self._brackets: list[dict] = []
+        self._trial_bracket: Dict[str, dict] = {}
+        self._to_stop: set[str] = set()
+
+    def _new_bracket(self) -> dict:
+        s = self._next_s
+        self._next_s = self._s_max if self._next_s == 0 else self._next_s - 1
+        n = int(math.ceil((self._s_max + 1) / (s + 1) * self.eta**s))
+        r = self.max_t * self.eta ** (-s)
+        bracket = {"s": s, "n": n, "r": max(1.0, r), "trials": [],
+                   "scores": {}, "reached": set()}
+        self._brackets.append(bracket)
+        return bracket
+
+    def on_trial_add(self, trial: "Trial") -> None:
+        bracket = next(
+            (b for b in self._brackets if len(b["trials"]) < b["n"]), None
+        ) or self._new_bracket()
+        bracket["trials"].append(trial)
+        self._trial_bracket[trial.trial_id] = bracket
+
+    def _live_members(self, bracket: dict) -> list:
+        return [t for t in bracket["trials"]
+                if t.status not in ("TERMINATED", "ERROR")
+                and t.trial_id not in self._to_stop]
+
+    def _cut(self, bracket: dict) -> None:
+        """All live members reached the rung: keep the top 1/eta."""
+        ranked = sorted(bracket["scores"].items(), key=lambda kv: kv[1])
+        n_live = len(ranked)
+        keep = max(1, int(n_live / self.eta))
+        if bracket["r"] * self.eta > self.max_t:
+            keep = n_live  # final rung: everyone left runs to max_t
+        losers = [tid for tid, _ in ranked[:-keep]] if keep < n_live else []
+        self._to_stop.update(losers)
+        bracket["r"] = bracket["r"] * self.eta
+        bracket["scores"] = {}
+        bracket["reached"] = set()
+
+    def on_trial_result(self, trial: "Trial", result: dict):
+        t = result.get(self.time_attr, 0)
+        if t >= self.max_t:
+            return self.STOP
+        bracket = self._trial_bracket.get(trial.trial_id)
+        if bracket is None:
+            return self.CONTINUE
+        if t < bracket["r"] or trial.trial_id in bracket["reached"]:
+            return self.CONTINUE
+        score = self._score(result)
+        if score is None:
+            return self.CONTINUE
+        bracket["reached"].add(trial.trial_id)
+        bracket["scores"][trial.trial_id] = score
+        live = self._live_members(bracket)
+        if all(x.trial_id in bracket["reached"] for x in live):
+            self._cut(bracket)
+            if trial.trial_id in self._to_stop:
+                self._to_stop.discard(trial.trial_id)
+                return self.STOP
+            return self.CONTINUE
+        return self.PAUSE
+
+    def on_trial_complete(self, trial: "Trial", result: dict | None) -> None:
+        self._finalize(trial)
+
+    def on_trial_error(self, trial: "Trial") -> None:
+        self._finalize(trial)
+
+    def _finalize(self, trial: "Trial") -> None:
+        bracket = self._trial_bracket.pop(trial.trial_id, None)
+        self._to_stop.discard(trial.trial_id)
+        if bracket is None:
+            return
+        bracket["reached"].discard(trial.trial_id)
+        bracket["scores"].pop(trial.trial_id, None)
+        # A member dying can complete the rung for the rest.
+        live = self._live_members(bracket)
+        if live and bracket["reached"] and all(
+            x.trial_id in bracket["reached"] for x in live
+        ):
+            self._cut(bracket)
+
+    # --- controller hooks ---
+
+    def may_resume(self, trial: "Trial") -> bool:
+        if trial.trial_id in self._to_stop:
+            return False
+        bracket = self._trial_bracket.get(trial.trial_id)
+        if bracket is None:
+            return True
+        # Resume only once the rung cut released this trial.
+        return trial.trial_id not in bracket["reached"]
+
+    def pending_stops(self, trials) -> list:
+        out = [t for t in trials
+               if t.trial_id in self._to_stop and t.status == "PAUSED"]
+        return out
+
+
+class TuneBOHB(TrialScheduler):
+    """BOHB (reference: tune/schedulers/hb_bohb.py + search/bohb) needs the
+    hpbandster package, which is not installed in this image; construction
+    raises with guidance. Use HyperBandScheduler + OptunaSearch for a
+    comparable model-based bandit setup."""
+
+    def __init__(self, *a, **kw):
+        raise ImportError(
+            "TuneBOHB requires 'hpbandster', which is not installed in this "
+            "environment. Use HyperBandScheduler (+ OptunaSearch) instead."
+        )

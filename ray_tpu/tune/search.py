@@ -273,3 +273,95 @@ try:  # optional backend, mirrors reference tune/search/optuna/optuna_search.py
 
 except ImportError:  # pragma: no cover
     OptunaSearch = None  # type: ignore[assignment]
+
+
+try:  # optional backend, mirrors reference tune/search/hyperopt
+    import hyperopt as _hyperopt  # noqa: F401
+
+    class HyperOptSearch(Searcher):
+        """TPE via hyperopt (reference: tune/search/hyperopt/
+        hyperopt_search.py). Space entries map to hp.uniform/loguniform/
+        quniform/randint/choice from the shared Domain types."""
+
+        def __init__(self, space: dict, metric: str, mode: str = "max",
+                     seed: int | None = None):
+            from hyperopt import hp
+
+            self.metric, self.mode = metric, mode
+            self._space = dict(space)
+            hspace = {}
+            for k, v in self._space.items():
+                if isinstance(v, Float):
+                    if v.q:
+                        hspace[k] = (hp.qloguniform(k, math.log(v.lower),
+                                                    math.log(v.upper), v.q)
+                                     if v.log
+                                     else hp.quniform(k, v.lower, v.upper, v.q))
+                    else:
+                        hspace[k] = (hp.loguniform(k, math.log(v.lower),
+                                                   math.log(v.upper))
+                                     if v.log
+                                     else hp.uniform(k, v.lower, v.upper))
+                elif isinstance(v, Integer):
+                    hspace[k] = hp.randint(k, v.lower, v.upper)
+                elif isinstance(v, Categorical):
+                    hspace[k] = hp.choice(k, v.categories)
+                elif isinstance(v, Normal):
+                    hspace[k] = hp.normal(k, v.mean, v.sd)
+                # Other domains (SampleFrom, plugins) are outside TPE's
+                # model: resolved per-suggest by direct sampling below.
+            self._hspace = hspace
+            self._py_rng = random.Random(seed)
+            self._domain = _hyperopt.Domain(lambda c: 0.0, hspace)
+            self._hp_trials = _hyperopt.Trials()
+            self._rng = __import__("numpy").random.default_rng(seed)
+            self._tid = 0
+            self._by_trial: dict[str, int] = {}
+
+        def suggest(self, trial_id: str):
+            from hyperopt import base
+
+            self._tid += 1
+            seed = int(self._rng.integers(2**31))
+            new = _hyperopt.tpe.suggest(
+                [self._tid], self._domain, self._hp_trials, seed)
+            self._hp_trials.insert_trial_docs(new)
+            self._hp_trials.refresh()
+            doc = self._hp_trials._dynamic_trials[-1]
+            vals = {k: v[0] for k, v in doc["misc"]["vals"].items() if v}
+            cfg = dict(self._space)
+            for k, v in self._space.items():
+                if isinstance(v, Categorical) and k in vals:
+                    cfg[k] = v.categories[int(vals[k])]
+                elif k in vals:
+                    cfg[k] = int(vals[k]) if isinstance(v, Integer) else float(vals[k])
+                elif isinstance(v, SampleFrom):
+                    cfg[k] = v.fn(cfg)
+                elif isinstance(v, Domain):
+                    # Domain outside the TPE model: plain random sample.
+                    cfg[k] = v.sample(self._py_rng)
+            self._by_trial[trial_id] = self._tid
+            doc["state"] = base.JOB_STATE_RUNNING
+            return cfg
+
+        def on_trial_complete(self, trial_id: str, result=None, error: bool = False):
+            from hyperopt import base
+
+            tid = self._by_trial.pop(trial_id, None)
+            if tid is None:
+                return
+            doc = next((d for d in self._hp_trials._dynamic_trials
+                        if d["tid"] == tid), None)
+            if doc is None:
+                return
+            if error or result is None or self.metric not in result:
+                doc["state"] = base.JOB_STATE_ERROR
+            else:
+                score = float(result[self.metric])
+                loss = -score if self.mode == "max" else score
+                doc["result"] = {"loss": loss, "status": base.STATUS_OK}
+                doc["state"] = base.JOB_STATE_DONE
+            self._hp_trials.refresh()
+
+except ImportError:  # pragma: no cover
+    HyperOptSearch = None  # type: ignore[assignment]

@@ -199,7 +199,14 @@ class TuneController:
         return sum(1 for t in self.trials if t.status == RUNNING)
 
     def _maybe_fill(self) -> None:
-        # Scheduler-gated resumes first (synch PBT exploit cycle).
+        # Scheduler-demanded terminations first (HyperBand rung losers are
+        # PAUSED when the cut happens; the scheduler reaps them here).
+        pending_stops = getattr(self.scheduler, "pending_stops", None)
+        if pending_stops:
+            for t in pending_stops(self.trials):
+                if t.status == PAUSED:
+                    self._complete(t, t.last_result)
+        # Scheduler-gated resumes next (synch PBT exploit cycle).
         resume_decisions = getattr(self.scheduler, "resume_decisions", None)
         if resume_decisions:
             for trial, (cfg, ckpt) in resume_decisions(self.trials).items():
@@ -364,6 +371,7 @@ class Tuner:
                 path=t.trial_dir,
                 metrics_history=t.metrics_history,
                 error=t.error,
+                config=t.config,
             )
             for t in trials
         ]

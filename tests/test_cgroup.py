@@ -1,0 +1,54 @@
+"""Cgroup v2 worker isolation (reference: src/ray/common/cgroup/
+cgroup_setup.h + fake_cgroup_setup.h)."""
+
+import os
+
+from ray_tpu._private.cgroup import CgroupSetup, FakeCgroupSetup, cgroup_v2_available
+
+
+def test_unavailable_root_disables_cleanly(tmp_path):
+    # A plain directory is not a cgroup2 mount: setup must disable, and
+    # every method must be a harmless no-op.
+    cg = CgroupSetup("n1", root=str(tmp_path))
+    assert not cg.enabled
+    assert not cg.add_worker_process(os.getpid())
+    assert not cg.add_system_process(1)
+    cg.set_system_reserved(cpu_weight=100)
+    cg.remove_worker(123)
+    cg.teardown()
+
+
+def test_fake_cgroup_records_operations():
+    cg = FakeCgroupSetup("n2")
+    assert cg.enabled
+    assert cg.add_system_process(42)
+    assert cg.add_worker_process(100, memory_bytes=1 << 20)
+    assert cg.add_worker_process(101)
+    assert cg.system_procs == [42]
+    assert cg.worker_procs == {100: 1 << 20, 101: None}
+    cg.remove_worker(100)
+    assert 100 not in cg.worker_procs
+    cg.set_system_reserved(cpu_weight=50, memory_min=1 << 30)
+    assert cg.reserved["cpu_weight"] == 50
+    cg.teardown()
+    assert not cg.enabled
+
+
+def test_simulated_cgroupfs_tree(tmp_path):
+    # Simulate a writable cgroup2 root: the marker file is all the
+    # availability check needs, and the tree/cap writes are plain files.
+    root = tmp_path / "cg"
+    root.mkdir()
+    (root / "cgroup.controllers").write_text("cpu memory\n")
+    assert cgroup_v2_available(str(root))
+    cg = CgroupSetup("n3", root=str(root))
+    assert cg.enabled
+    assert cg.add_worker_process(os.getpid(), memory_bytes=123456)
+    child = root / "ray_tpu_node_n3" / "workers" / f"worker_{os.getpid()}"
+    assert (child / "memory.max").read_text() == "123456"
+    assert (child / "cgroup.procs").read_text() == str(os.getpid())
+    cg.remove_worker(os.getpid())
+    # rmdir fails on non-empty (files remain) — tolerated.
+    cg.set_system_reserved(cpu_weight=10, memory_min=5)
+    assert (root / "ray_tpu_node_n3" / "system" / "cpu.weight").read_text() == "10"
+    cg.teardown()

@@ -1,0 +1,28 @@
+"""C++ API frontend (reference: cpp/ — ray::Init/Task/Get example app).
+Builds the embedded-runtime C++ library + example with g++ and runs it."""
+
+import os
+import shutil
+import subprocess
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.skipif(shutil.which("g++") is None, reason="no C++ toolchain")
+def test_cpp_frontend_builds_and_runs():
+    build = subprocess.run(
+        ["make", "-C", os.path.join(REPO, "cpp")],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert build.returncode == 0, build.stderr[-2000:]
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    run = subprocess.run(
+        [os.path.join(REPO, "cpp", "build", "example")],
+        capture_output=True, text=True, timeout=240, env=env,
+    )
+    assert run.returncode == 0, (run.stdout[-1000:], run.stderr[-2000:])
+    assert "CPP-OK" in run.stdout
+    assert "task: 42" in run.stdout

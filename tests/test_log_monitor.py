@@ -1,0 +1,51 @@
+"""Worker log streaming to the driver (reference: _private/log_monitor.py,
+ray.init(log_to_driver=True))."""
+
+import io
+import os
+import time
+
+import ray_tpu
+from ray_tpu._private.log_monitor import LogMonitor
+
+
+def test_log_monitor_tails_incrementally(tmp_path):
+    out = io.StringIO()
+    mon = LogMonitor(str(tmp_path), out=out)
+    log = tmp_path / "worker-abc.log"
+    log.write_bytes(b"hello\nworld\n")
+    assert mon.poll_once() == 2
+    # Partial line held back until its newline arrives.
+    with open(log, "ab") as f:
+        f.write(b"part")
+    assert mon.poll_once() == 0
+    with open(log, "ab") as f:
+        f.write(b"ial\n")
+    assert mon.poll_once() == 1
+    text = out.getvalue()
+    assert "(worker-abc) hello" in text
+    assert "(worker-abc) partial" in text
+    assert text.count("hello") == 1  # no re-emission
+
+
+def test_worker_prints_reach_driver(capfd):
+    ray_tpu.init(num_cpus=2, object_store_memory=32 * 1024 * 1024)
+    try:
+        @ray_tpu.remote
+        def shout():
+            print("LOUD-AND-CLEAR")
+            return 1
+
+        assert ray_tpu.get(shout.remote()) == 1
+        # The monitor polls on an interval; give it a moment.
+        deadline = time.time() + 5
+        seen = ""
+        while time.time() < deadline:
+            seen += capfd.readouterr().out
+            if "LOUD-AND-CLEAR" in seen:
+                break
+            time.sleep(0.2)
+        assert "LOUD-AND-CLEAR" in seen
+        assert "(worker-" in seen  # prefixed with the writing worker
+    finally:
+        ray_tpu.shutdown()

@@ -187,7 +187,10 @@ def test_asha_stops_bad_trials(tmp_path):
         tune_config=tune.TuneConfig(
             metric="acc",
             mode="max",
-            scheduler=tune.ASHAScheduler(max_t=8, grace_period=2, reduction_factor=2),
+            # grace 1 => rungs at 1,2,4: enough cut points that some trial
+            # is culled under any async arrival order (the flake seen with
+            # grace 2 under machine load was all trials slipping through).
+            scheduler=tune.ASHAScheduler(max_t=8, grace_period=1, reduction_factor=2),
             max_concurrent_trials=4,
         ),
         run_config=tune.RunConfig(name="asha", storage_path=str(tmp_path)),

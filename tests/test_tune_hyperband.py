@@ -1,0 +1,77 @@
+"""Synchronous HyperBand scheduler (reference: tune/schedulers/hyperband.py)
++ accelerator manager plugin layer."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.tune import HyperBandScheduler, TuneConfig, Tuner
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _cluster():
+    ray_tpu.init(num_cpus=4, object_store_memory=64 * 1024 * 1024)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_hyperband_prunes_to_best(tmp_path):
+    # Quality is known at birth: trainable reports score = config["q"]
+    # every iteration. HyperBand must terminate low-q trials early and
+    # run the best to max_t.
+    def trainable(config):
+        for i in range(30):
+            tune.report({"score": config["q"] + 0.001 * i})
+
+    scheduler = HyperBandScheduler(metric="score", mode="max", max_t=27,
+                                   reduction_factor=3)
+    tuner = Tuner(
+        trainable,
+        param_space={"q": tune.grid_search([0.1, 0.2, 0.3, 0.4, 0.5,
+                                            0.6, 0.7, 0.8, 0.9])},
+        tune_config=TuneConfig(scheduler=scheduler, metric="score",
+                               mode="max", max_concurrent_trials=3),
+    )
+    results = tuner.fit()
+    best = results.get_best_result()
+    assert best.config["q"] == pytest.approx(0.9)
+    # Early stopping happened: total iterations well under 9 * 30.
+    iters = sum(len(r.metrics_history) for r in results)
+    assert iters < 9 * 30 * 0.7, iters
+    # The winner ran furthest.
+    by_q = {r.config["q"]: len(r.metrics_history) for r in results}
+    assert by_q[0.9] == max(by_q.values())
+    assert min(by_q.values()) < max(by_q.values())
+
+
+def test_hyperband_bracket_math():
+    s = HyperBandScheduler(max_t=81, reduction_factor=3)
+    b0 = s._new_bracket()
+    assert b0["s"] == 4
+    assert b0["n"] == 81  # ceil(5/5 * 3^4)
+    assert b0["r"] == pytest.approx(1.0)
+    b1 = s._new_bracket()
+    assert b1["s"] == 3 and b1["r"] == pytest.approx(3.0)
+
+
+def test_bohb_gate():
+    with pytest.raises(ImportError, match="hpbandster"):
+        tune.TuneBOHB()
+
+
+def test_accelerator_manager_registry(monkeypatch):
+    from ray_tpu.accelerators import (
+        NvidiaGPUAcceleratorManager,
+        detect_node_accelerators,
+        get_accelerator_manager,
+    )
+
+    assert get_accelerator_manager("TPU") is not None
+    assert get_accelerator_manager("GPU") is NvidiaGPUAcceleratorManager
+    monkeypatch.setenv("CUDA_VISIBLE_DEVICES", "0,1,2")
+    assert NvidiaGPUAcceleratorManager.get_current_node_num_accelerators() == 3
+    res = detect_node_accelerators()
+    assert res.get("GPU") == 3.0
+    monkeypatch.setenv("CUDA_VISIBLE_DEVICES", "")
+    assert NvidiaGPUAcceleratorManager.get_current_node_num_accelerators() == 0
