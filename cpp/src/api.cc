@@ -34,6 +34,20 @@ class GilGuard {
   PyGILState_STATE state_;
 };
 
+// Owns one PyObject reference; releases it on scope exit (including the
+// exception paths out of RunAndTake).
+class PyRef {
+ public:
+  explicit PyRef(PyObject* p) : p_(p) {}
+  ~PyRef() { Py_XDECREF(p_); }
+  PyObject* get() const { return p_; }
+  PyRef(const PyRef&) = delete;
+  PyRef& operator=(const PyRef&) = delete;
+
+ private:
+  PyObject* p_;
+};
+
 void ThrowPyError(const std::string& where) {
   PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
   PyErr_Fetch(&type, &value, &tb);
@@ -101,23 +115,19 @@ void Init(const std::string& kwargs_json) {
   std::lock_guard<std::mutex> lock(g_mu);
   if (g_initialized) return;
   if (!Py_IsInitialized()) Py_Initialize();
-  PyObject* locals = PyDict_New();
-  PyObject* kw = PyUnicode_FromString(kwargs_json.c_str());
-  PyDict_SetItemString(locals, "kwargs_json", kw);
-  Py_DECREF(kw);
-  try {
-    PyObject* out = RunAndTake(
+  {
+    // Scoped: these references must be released BEFORE the GIL is
+    // dropped below (their destructors call Py_XDECREF).
+    PyRef locals(PyDict_New());
+    PyRef kw(PyUnicode_FromString(kwargs_json.c_str()));
+    PyDict_SetItemString(locals.get(), "kwargs_json", kw.get());
+    PyRef out(RunAndTake(
         "import json\n"
         "import ray_tpu\n"
         "ray_tpu.init(**json.loads(kwargs_json))\n"
         "out = True\n",
-        locals);
-    Py_DECREF(out);
-  } catch (...) {
-    Py_DECREF(locals);
-    throw;
+        locals.get()));
   }
-  Py_DECREF(locals);
   g_initialized = true;
   // Drop the GIL so Python daemon threads run while C++ computes;
   // entrypoints re-acquire via GilGuard.
@@ -131,9 +141,8 @@ void Shutdown() {
     GilGuard gil;
     for (auto& kv : g_objects) Py_DECREF(kv.second);
     g_objects.clear();
-    PyObject* out =
-        RunAndTake("import ray_tpu\nray_tpu.shutdown()\nout = True\n");
-    Py_DECREF(out);
+    PyRef out(
+        RunAndTake("import ray_tpu\nray_tpu.shutdown()\nout = True\n"));
   }
   if (g_saved_ts != nullptr) {
     PyEval_RestoreThread(g_saved_ts);
@@ -146,21 +155,18 @@ ObjectRef Task(const std::string& qualified_fn,
                const std::vector<double>& args) {
   std::lock_guard<std::mutex> lock(g_mu);
   GilGuard gil;
-  PyObject* locals = PyDict_New();
-  PyObject* fn = PyUnicode_FromString(qualified_fn.c_str());
-  PyDict_SetItemString(locals, "fn_name", fn);
-  Py_DECREF(fn);
-  PyObject* lst = DoubleList(args);
-  PyDict_SetItemString(locals, "args", lst);
-  Py_DECREF(lst);
+  PyRef locals(PyDict_New());
+  PyRef fn(PyUnicode_FromString(qualified_fn.c_str()));
+  PyDict_SetItemString(locals.get(), "fn_name", fn.get());
+  PyRef lst(DoubleList(args));
+  PyDict_SetItemString(locals.get(), "args", lst.get());
   PyObject* out = RunAndTake(
       "import importlib\n"
       "import ray_tpu\n"
       "mod, _, name = fn_name.rpartition('.')\n"
       "f = getattr(importlib.import_module(mod), name)\n"
       "out = ray_tpu.remote(f).remote(*args)\n",
-      locals);
-  Py_DECREF(locals);
+      locals.get());
   return ObjectRef{Store(out)};
 }
 
@@ -171,41 +177,35 @@ ObjectRef Task(const std::string& qualified_fn, double arg) {
 ObjectRef TaskExpr(const std::string& expr) {
   std::lock_guard<std::mutex> lock(g_mu);
   GilGuard gil;
-  PyObject* locals = PyDict_New();
-  PyObject* e = PyUnicode_FromString(expr.c_str());
-  PyDict_SetItemString(locals, "expr", e);
-  Py_DECREF(e);
+  PyRef locals(PyDict_New());
+  PyRef e(PyUnicode_FromString(expr.c_str()));
+  PyDict_SetItemString(locals.get(), "expr", e.get());
   PyObject* out = RunAndTake(
       "import ray_tpu\n"
       "def _expr_task(src):\n"
       "    return eval(src, {}, {})\n"
       "out = ray_tpu.remote(_expr_task).remote(expr)\n",
-      locals);
-  Py_DECREF(locals);
+      locals.get());
   return ObjectRef{Store(out)};
 }
 
 ObjectRef Put(double value) {
   std::lock_guard<std::mutex> lock(g_mu);
   GilGuard gil;
-  PyObject* locals = PyDict_New();
-  PyObject* v = PyFloat_FromDouble(value);
-  PyDict_SetItemString(locals, "value", v);
-  Py_DECREF(v);
+  PyRef locals(PyDict_New());
+  PyRef v(PyFloat_FromDouble(value));
+  PyDict_SetItemString(locals.get(), "value", v.get());
   PyObject* out = RunAndTake("import ray_tpu\nout = ray_tpu.put(value)\n",
-                             locals);
-  Py_DECREF(locals);
+                             locals.get());
   return ObjectRef{Store(out)};
 }
 
 namespace {
 PyObject* GetObject(const ObjectRef& ref) {
-  PyObject* locals = PyDict_New();
-  PyDict_SetItemString(locals, "ref", Lookup(ref.id));
-  PyObject* out =
-      RunAndTake("import ray_tpu\nout = ray_tpu.get(ref)\n", locals);
-  Py_DECREF(locals);
-  return out;
+  PyRef locals(PyDict_New());
+  PyDict_SetItemString(locals.get(), "ref", Lookup(ref.id));
+  return RunAndTake("import ray_tpu\nout = ray_tpu.get(ref)\n",
+                    locals.get());
 }
 }  // namespace
 
@@ -235,21 +235,18 @@ ActorHandle Actor(const std::string& qualified_cls,
                   const std::vector<double>& args) {
   std::lock_guard<std::mutex> lock(g_mu);
   GilGuard gil;
-  PyObject* locals = PyDict_New();
-  PyObject* cls = PyUnicode_FromString(qualified_cls.c_str());
-  PyDict_SetItemString(locals, "cls_name", cls);
-  Py_DECREF(cls);
-  PyObject* lst = DoubleList(args);
-  PyDict_SetItemString(locals, "args", lst);
-  Py_DECREF(lst);
+  PyRef locals(PyDict_New());
+  PyRef cls(PyUnicode_FromString(qualified_cls.c_str()));
+  PyDict_SetItemString(locals.get(), "cls_name", cls.get());
+  PyRef lst(DoubleList(args));
+  PyDict_SetItemString(locals.get(), "args", lst.get());
   PyObject* out = RunAndTake(
       "import importlib\n"
       "import ray_tpu\n"
       "mod, _, name = cls_name.rpartition('.')\n"
       "c = getattr(importlib.import_module(mod), name)\n"
       "out = ray_tpu.remote(c).remote(*args)\n",
-      locals);
-  Py_DECREF(locals);
+      locals.get());
   return ActorHandle{Store(out)};
 }
 
@@ -257,17 +254,15 @@ ObjectRef Call(const ActorHandle& actor, const std::string& method,
                const std::vector<double>& args) {
   std::lock_guard<std::mutex> lock(g_mu);
   GilGuard gil;
-  PyObject* locals = PyDict_New();
-  PyDict_SetItemString(locals, "actor", Lookup(actor.id));
-  PyObject* m = PyUnicode_FromString(method.c_str());
-  PyDict_SetItemString(locals, "method", m);
-  Py_DECREF(m);
-  PyObject* lst = DoubleList(args);
-  PyDict_SetItemString(locals, "args", lst);
-  Py_DECREF(lst);
+  PyRef locals(PyDict_New());
+  PyDict_SetItemString(locals.get(), "actor", Lookup(actor.id));
+  PyRef m(PyUnicode_FromString(method.c_str()));
+  PyDict_SetItemString(locals.get(), "method", m.get());
+  PyRef lst(DoubleList(args));
+  PyDict_SetItemString(locals.get(), "args", lst.get());
   PyObject* out =
-      RunAndTake("out = getattr(actor, method).remote(*args)\n", locals);
-  Py_DECREF(locals);
+      RunAndTake("out = getattr(actor, method).remote(*args)\n",
+                 locals.get());
   return ObjectRef{Store(out)};
 }
 

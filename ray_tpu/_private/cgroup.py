@@ -64,6 +64,14 @@ class CgroupSetup:
             system = os.path.join(node_path, "system")
             os.makedirs(workers, exist_ok=True)
             os.makedirs(system, exist_ok=True)
+            # Per-worker children under workers/ need the memory controller
+            # delegated one more level down; and cgroup v2's
+            # no-internal-process rule means workers/ itself must stay
+            # process-free — every worker lives in a child (per-worker
+            # capped dir, or the shared uncapped one).
+            self._try_write(os.path.join(workers, "cgroup.subtree_control"),
+                            "+memory")
+            os.makedirs(os.path.join(workers, "shared"), exist_ok=True)
             self.node_path, self.workers_path, self.system_path = (
                 node_path, workers, system)
             self.enabled = True
@@ -97,8 +105,10 @@ class CgroupSetup:
         if not self.enabled:
             return False
         if memory_bytes is None:
+            # Shared child, not workers/ itself (no-internal-process rule).
             return self._try_write(
-                os.path.join(self.workers_path, "cgroup.procs"), str(pid))
+                os.path.join(self.workers_path, "shared", "cgroup.procs"),
+                str(pid))
         child = os.path.join(self.workers_path, f"worker_{pid}")
         try:
             os.makedirs(child, exist_ok=True)

@@ -1655,6 +1655,9 @@ class Head:
         self._shutdown = True
         if self.memory_monitor is not None:
             self.memory_monitor.stop()
+        cg = getattr(self, "_cgroup", None)
+        if cg is not None:
+            cg.teardown()
         with self.lock:
             workers = list(self.workers.values())
         for rec in workers:

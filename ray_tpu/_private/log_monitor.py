@@ -74,6 +74,10 @@ class LogMonitor:
             except OSError:
                 continue
             offset = self._offsets.get(name, 0)
+            if size < offset:
+                # Truncated/recreated file: restart from the beginning.
+                offset = 0
+                self._offsets[name] = 0
             if size <= offset:
                 continue
             try:

@@ -147,6 +147,9 @@ class NodeAgent:
         for proc in self.procs.values():
             if proc.poll() is None:
                 proc.kill()
+        cg = getattr(self, "_cgroup", None)
+        if cg is not None:
+            cg.teardown()
         try:
             self.conn.close()
         except Exception:

@@ -15,6 +15,7 @@ idiomatic TPU fast lane for chip-to-chip, and `ray_tpu.dag` is the
 host-level orchestration fabric (multi-host MPMD pipelines over DCN).
 """
 
+from ray_tpu.dag.collective_node import AllReduceNode, allreduce
 from ray_tpu.dag.nodes import (
     ClassMethodNode,
     CompiledDAG,
@@ -25,6 +26,8 @@ from ray_tpu.dag.nodes import (
 )
 
 __all__ = [
+    "AllReduceNode",
+    "allreduce",
     "ClassMethodNode",
     "CompiledDAG",
     "DAGNode",

@@ -1,0 +1,87 @@
+"""Collective nodes inside DAGs: allreduce across actor outputs.
+
+Counterpart of the reference's compiled-graph collectives
+(reference: python/ray/dag/collective_node.py:116 CollectiveOutputNode +
+python/ray/experimental/collective/allreduce.py — N actor outputs
+all-reduced with NCCL inside the compiled graph, one reduced copy per
+participant). TPU-native redesign: collectives BETWEEN jitted programs on
+the same mesh belong inside jit (psum over ICI — parallel/ops layer);
+the DAG-level collective is the host-plane equivalent for cross-actor /
+cross-host reductions: gather the N bound outputs through the object
+store, reduce once host-side, and hand every downstream consumer the
+same reduced object. The API shape mirrors the reference:
+
+    with InputNode() as x:
+        outs = [w.grad.bind(x) for w in workers]
+        reduced = AllReduceNode(outs, op="sum")
+        dag = MultiOutputNode([w.apply.bind(reduced) for w in workers])
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.dag.nodes import DAGNode
+
+_OPS = {
+    "sum": lambda xs: _tree_reduce(xs, np.add),
+    "mean": lambda xs: _tree_scale(_tree_reduce(xs, np.add), 1.0 / len(xs)),
+    "max": lambda xs: _tree_reduce(xs, np.maximum),
+    "min": lambda xs: _tree_reduce(xs, np.minimum),
+}
+
+
+def _tree_reduce(values, op):
+    """Reduce a list of (nested) arrays elementwise with `op`."""
+    first = values[0]
+    if isinstance(first, dict):
+        return {k: _tree_reduce([v[k] for v in values], op) for k in first}
+    if isinstance(first, (list, tuple)):
+        red = [_tree_reduce([v[i] for v in values], op)
+               for i in range(len(first))]
+        return type(first)(red)
+    out = np.asarray(first)
+    for v in values[1:]:
+        out = op(out, np.asarray(v))
+    return out
+
+
+def _tree_scale(value, s):
+    if isinstance(value, dict):
+        return {k: _tree_scale(v, s) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return type(value)(_tree_scale(v, s) for v in value)
+    return np.asarray(value) * s
+
+
+def _allreduce_task(op: str, *values):
+    return _OPS[op](list(values))
+
+
+class AllReduceNode(DAGNode):
+    """All-reduce the outputs of `nodes`; the node's value is the reduced
+    pytree (numpy leaves). op: sum | mean | max | min."""
+
+    def __init__(self, nodes: Sequence[DAGNode], op: str = "sum"):
+        if op not in _OPS:
+            raise ValueError(f"op must be one of {sorted(_OPS)}, got {op!r}")
+        if not nodes:
+            raise ValueError("AllReduceNode needs at least one input node")
+        super().__init__(args=tuple(nodes))
+        self.op = op
+
+    def _submit(self, args: list, kwargs: dict, input_values: tuple):
+        # args are the upstream ObjectRefs/values; reduce in a task so the
+        # reduced object lives in the store (each consumer reads the same
+        # copy — the reference's "one reduced tensor per participant"
+        # becomes one shared immutable object here).
+        return ray_tpu.remote(_allreduce_task).remote(self.op, *args)
+
+
+def allreduce(nodes: Sequence[DAGNode], op: str = "sum") -> AllReduceNode:
+    """Functional spelling (reference:
+    ray.experimental.collective.allreduce.bind)."""
+    return AllReduceNode(nodes, op=op)

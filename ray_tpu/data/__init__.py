@@ -6,6 +6,7 @@ blocks are Arrow tables / numpy dicts). Batches come out as numpy or jax
 arrays shaped for an XLA step; `streaming_split` feeds JaxTrainer workers."""
 
 from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata
+from ray_tpu.data.datasource import Datasource, ReadTask
 from ray_tpu.data.dataset import (
     DataContext,
     DataIterator,
@@ -17,6 +18,7 @@ from ray_tpu.data.dataset import (
     range,
     range_tensor,
     read_binary_files,
+    read_datasource,
     read_csv,
     read_json,
     read_numpy,
@@ -38,6 +40,9 @@ __all__ = [
     "range",
     "range_tensor",
     "read_binary_files",
+    "read_datasource",
+    "Datasource",
+    "ReadTask",
     "read_csv",
     "read_json",
     "read_numpy",

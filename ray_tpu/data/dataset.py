@@ -435,3 +435,15 @@ def read_numpy(paths) -> Dataset:
 
 def read_binary_files(paths, *, include_paths: bool = False) -> Dataset:
     return Dataset([Read(tasks=ds_mod.binary_tasks(paths, include_paths=include_paths))])
+
+
+def read_datasource(datasource, *, parallelism: int = -1) -> Dataset:
+    """Read from a custom Datasource plugin (reference:
+    ray.data.read_datasource, data/read_api.py)."""
+    if parallelism <= 0:
+        parallelism = DataContext.get_current().parallelism
+    tasks = datasource.get_read_tasks(parallelism)
+    if not tasks:
+        raise ValueError(
+            f"datasource {datasource.get_name()} produced no read tasks")
+    return Dataset([Read(tasks=tasks)])

@@ -195,3 +195,27 @@ def write_json_block(block: Block, path: str, idx: int) -> str:
                  for k, v in row.items()}
             ) + "\n")
     return out
+
+
+class Datasource:
+    """Custom datasource plugin ABC (reference:
+    data/datasource/datasource.py Datasource — get_read_tasks(parallelism)
+    returning ReadTasks; ray.data.read_datasource). Subclass and implement
+    ``get_read_tasks``; optionally ``estimate_inmemory_data_size``.
+
+        class MySource(Datasource):
+            def get_read_tasks(self, parallelism):
+                return [ReadTask(lambda i=i: iter([{'x': np.arange(i)}]))
+                        for i in range(parallelism)]
+
+        ds = ray_tpu.data.read_datasource(MySource(), parallelism=8)
+    """
+
+    def get_read_tasks(self, parallelism: int) -> list[ReadTask]:
+        raise NotImplementedError
+
+    def estimate_inmemory_data_size(self) -> int | None:
+        return None
+
+    def get_name(self) -> str:
+        return type(self).__name__

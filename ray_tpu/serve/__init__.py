@@ -7,11 +7,13 @@ aiohttp proxy provides HTTP ingress; autoscaling follows ongoing-request
 load."""
 
 from ray_tpu.serve.api import (
+    build,
     delete,
     get_deployment_handle,
     get_grpc_port,
     get_proxy_port,
     run,
+    run_from_config,
     shutdown,
     start,
     status,
@@ -38,6 +40,8 @@ __all__ = [
     "get_grpc_port",
     "get_proxy_port",
     "run",
+    "run_from_config",
+    "build",
     "shutdown",
     "start",
     "status",

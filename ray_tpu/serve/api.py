@@ -5,13 +5,15 @@ client.deploy_application → ServeController) — SURVEY.md §3.5 call stack.""
 
 from __future__ import annotations
 
+import dataclasses
 import time
 from typing import Any
 
 import ray_tpu
 from ray_tpu.exceptions import RayTpuError
 from ray_tpu.serve.controller import ServeController, _HandleMarker
-from ray_tpu.serve.deployment import Application, Deployment
+from ray_tpu.serve.deployment import (Application, AutoscalingConfig,
+                                      Deployment, DeploymentConfig)
 from ray_tpu.serve.handle import DeploymentHandle
 from ray_tpu.serve.proxy import HTTPProxy
 
@@ -75,14 +77,8 @@ def run(app: Application | Deployment, *, route_prefix: str | None = None,
         app = app.bind()
     controller = start(proxy=proxy)
     specs = _specs_from_app(app, route_prefix)
-    ray_tpu.get(controller.deploy_application.remote(specs))
-    if _proxy is not None:
-        routes = ray_tpu.get(controller.get_routes.remote())
-        ray_tpu.get(_proxy.update_routes.remote(routes))
-    handle = DeploymentHandle(app.deployment.name)
-    if _blocking_ready:
-        _wait_ready(controller, app.deployment.name)
-    return handle
+    _deploy_specs(controller, specs, wait=_blocking_ready)
+    return DeploymentHandle(app.deployment.name)
 
 
 def _wait_ready(controller, name: str, timeout_s: float = 30.0) -> None:
@@ -151,3 +147,132 @@ def shutdown() -> None:
         except RayTpuError:
             pass
         _proxy = None
+
+
+# -- declarative config (reference: serve/schema.py ServeDeploySchema,
+#    `serve build` / `serve deploy` config files) -------------------------
+
+
+def _import_path(path: str):
+    """Resolve a dotted path where the module/attribute boundary is
+    unknown (nested classes: 'pkg.mod.Outer.Inner'): import the longest
+    importable module prefix, then getattr the rest."""
+    import importlib
+
+    parts = path.split(".")
+    for i in range(len(parts) - 1, 0, -1):
+        mod_name = ".".join(parts[:i])
+        try:
+            obj = importlib.import_module(mod_name)
+        except ImportError:
+            continue
+        for attr in parts[i:]:
+            obj = getattr(obj, attr)
+        return obj
+    raise ImportError(f"cannot resolve import path {path!r}")
+
+
+def _deploy_specs(controller, specs: list[dict], *, wait: bool = True) -> None:
+    """Shared deploy + proxy-route push + readiness wait (used by run()
+    and run_from_config())."""
+    ray_tpu.get(controller.deploy_application.remote(specs))
+    if _proxy is not None:
+        routes = ray_tpu.get(controller.get_routes.remote())
+        ray_tpu.get(_proxy.update_routes.remote(routes))
+    if wait and specs:
+        _wait_ready(controller, specs[-1]["name"])
+
+
+def build(app: "Application | Deployment", *, route_prefix: str | None = None,
+          name: str = "default") -> dict:
+    """Emit a declarative, JSON/YAML-serializable config for an app
+    (reference: serve build — serve/scripts.py). Deployment classes must
+    be importable (module.ClassName); handles between deployments are
+    encoded as {"__handle__": name} markers."""
+    if isinstance(app, Deployment):
+        app = app.bind()
+    specs = _specs_from_app(app, route_prefix)
+    deployments = []
+    for spec in specs:
+        cls = spec["cls"]
+        if cls.__module__ in ("__main__", None) or "<locals>" in cls.__qualname__:
+            raise ValueError(
+                f"deployment {spec['name']!r} class must be importable "
+                f"(module.ClassName) to build a config; move it out of "
+                f"__main__ / function scope"
+            )
+        cfg = spec["config"]
+
+        def enc(v):
+            if isinstance(v, _HandleMarker):
+                return {"__handle__": v.name}
+            return v
+
+        deployments.append({
+            "name": spec["name"],
+            "import_path": f"{cls.__module__}.{cls.__qualname__}",
+            "num_replicas": cfg.num_replicas,
+            "max_ongoing_requests": cfg.max_ongoing_requests,
+            "autoscaling_config": (
+                dataclasses.asdict(cfg.autoscaling_config)
+                if cfg.autoscaling_config else None
+            ),
+            "ray_actor_options": dict(cfg.ray_actor_options),
+            "route_prefix": spec["route_prefix"],
+            "init_args": [enc(a) for a in spec["init_args"]],
+            "init_kwargs": {k: enc(v) for k, v in spec["init_kwargs"].items()},
+        })
+    return {"applications": [{"name": name, "deployments": deployments}]}
+
+
+def run_from_config(config: dict | str, *, proxy: bool = True) -> None:
+    """Deploy from a config produced by ``build`` (or hand-written YAML/
+    JSON; reference: `serve deploy config.yaml`). ``config`` may be a
+    dict, a path to a .yaml/.json file, or a JSON string."""
+    import json as _json
+    import os as _os
+
+    if isinstance(config, str):
+        if _os.path.exists(config):
+            with open(config) as f:
+                text = f.read()
+            if config.endswith((".yaml", ".yml")):
+                import yaml
+
+                config = yaml.safe_load(text)
+            else:
+                config = _json.loads(text)
+        else:
+            config = _json.loads(config)
+    controller = start(proxy=proxy)
+    for app in config["applications"]:
+        specs = []
+        for d in app["deployments"]:
+            cls = _import_path(d["import_path"])
+            if isinstance(cls, Deployment):
+                # Import paths usually name the @serve.deployment-decorated
+                # module attribute; the replica needs the inner class.
+                cls = cls.cls
+
+            def dec(v):
+                if isinstance(v, dict) and set(v) == {"__handle__"}:
+                    return _HandleMarker(v["__handle__"])
+                return v
+
+            auto = d.get("autoscaling_config")
+            cfg = DeploymentConfig(
+                num_replicas=d.get("num_replicas", 1),
+                max_ongoing_requests=d.get("max_ongoing_requests", 16),
+                autoscaling_config=(AutoscalingConfig(**auto) if auto else None),
+                ray_actor_options=d.get("ray_actor_options", {}),
+            )
+            specs.append({
+                "name": d["name"],
+                "cls": cls,
+                "config": cfg,
+                "init_args": tuple(dec(a) for a in d.get("init_args", [])),
+                "init_kwargs": {k: dec(v)
+                                for k, v in d.get("init_kwargs", {}).items()},
+                "route_prefix": d.get("route_prefix"),
+            })
+        _deploy_specs(controller, specs)

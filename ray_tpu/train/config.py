@@ -35,6 +35,16 @@ class ScalingConfig:
     resources_per_worker: dict[str, float] | None = None
     topology: str = "workers"  # "workers" | "mesh"
     placement_strategy: str = "PACK"
+    # Elastic range (reference: train/v2 scaling_policy — a failure retry
+    # may restart with fewer workers when the cluster shrank; None =
+    # fixed-size gang of num_workers). XLA's compiled world is rigid
+    # WITHIN an attempt, so elasticity happens at restart boundaries:
+    # restart = recompile with the new world size.
+    min_workers: int | None = None
+
+    @property
+    def elastic(self) -> bool:
+        return self.min_workers is not None and self.min_workers < self.num_workers
 
     def worker_resources(self) -> dict[str, float]:
         res = dict(self.resources_per_worker or {})

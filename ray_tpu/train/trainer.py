@@ -16,6 +16,7 @@ the idiomatic single-controller SPMD mode.
 
 from __future__ import annotations
 
+import dataclasses
 import time
 import uuid
 from typing import Any, Callable
@@ -73,6 +74,28 @@ class JaxTrainer:
                     shards[i][name] = ds
         return shards
 
+    @staticmethod
+    def _max_placeable_workers(scaling: ScalingConfig) -> int:
+        """How many worker gangs the cluster can place right now, judged
+        against TOTAL per-node capacity of alive nodes (reference:
+        train/v2 scaling policy reacting to resource availability)."""
+        per_worker = scaling.worker_resources()
+        if not any(v > 0 for v in per_worker.values()):
+            return scaling.num_workers  # zero-demand workers always fit
+        fit = 0
+        try:
+            for node in ray_tpu.nodes():
+                if not node.get("alive", True):
+                    continue
+                total = dict(node.get("resources", {}))
+                while all(total.get(k, 0.0) >= v for k, v in per_worker.items()):
+                    for k, v in per_worker.items():
+                        total[k] = total.get(k, 0.0) - v
+                    fit += 1
+        except Exception:
+            return scaling.num_workers
+        return fit
+
     def fit(self) -> Result:
         ray_tpu.api.auto_init()
         scaling = self.scaling_config
@@ -119,6 +142,15 @@ class JaxTrainer:
                     break
                 if failures_left > 0:
                     failures_left -= 1
+                if scaling.elastic:
+                    # Elastic restart (reference: train/v2 scaling_policy +
+                    # failure_handling): re-fit the gang to what the
+                    # cluster can actually place now, down to min_workers.
+                    # The next attempt recompiles at the new world size.
+                    fit = self._max_placeable_workers(scaling)
+                    new_n = max(scaling.min_workers, min(scaling.num_workers, fit))
+                    if new_n != scaling.num_workers:
+                        scaling = dataclasses.replace(scaling, num_workers=new_n)
                 time.sleep(0.5)  # let worker-death cleanup settle
             finally:
                 group.shutdown()

@@ -1,0 +1,90 @@
+"""Serializability inspector.
+
+Counterpart of the reference's ray.util.check_serialize
+(reference: python/ray/util/check_serialize.py —
+inspect_serializability recursively probes an object and prints a tree of
+the members that fail to pickle, so users can find the lambda/lock/socket
+buried in their task closure). Same approach: try the runtime's
+serializer, and on failure descend into closures, attributes, and
+containers to locate the leaf offenders.
+"""
+
+from __future__ import annotations
+
+import inspect
+from dataclasses import dataclass
+from typing import Any, Optional, Set, Tuple
+
+from ray_tpu._private import serialization
+
+
+@dataclass(eq=False)  # identity hash: leaves may be unhashable values
+class FailureTuple:
+    """One unserializable leaf: the object, its name, and its parent."""
+
+    obj: Any
+    name: str
+    parent: Any
+
+    def __repr__(self) -> str:
+        return f"FailTuple({self.name} [obj={self.obj!r}, parent={self.parent!r}])"
+
+
+def _serializable(obj: Any) -> bool:
+    try:
+        serialization.dumps(obj)
+        return True
+    except Exception:
+        return False
+
+
+def _inspect(obj: Any, name: str, parent: Any, failures: list[FailureTuple],
+             seen: Set[int], depth: int) -> bool:
+    """Returns True when serializable; appends leaf failures otherwise."""
+    if _serializable(obj):
+        return True
+    if id(obj) in seen or depth > 10:
+        return False
+    seen.add(id(obj))
+
+    children: list[Tuple[str, Any]] = []
+    if inspect.isfunction(obj):
+        # Closure cells and globals referenced by the function. This may
+        # itself raise on broken closures (empty cells) — exactly the
+        # objects under diagnosis, so degrade to a leaf report.
+        try:
+            closure = inspect.getclosurevars(obj)
+            children += list(closure.nonlocals.items())
+            children += list(closure.globals.items())
+        except Exception:
+            pass
+    elif isinstance(obj, dict):
+        children += [(str(k), v) for k, v in obj.items()]
+    elif isinstance(obj, (list, tuple, set)):
+        children += [(f"[{i}]", v) for i, v in enumerate(obj)]
+    elif hasattr(obj, "__dict__"):
+        children += list(vars(obj).items())
+
+    found_deeper = False
+    for child_name, child in children:
+        if not _serializable(child):
+            found_deeper = True
+            _inspect(child, f"{name}.{child_name}", obj, failures, seen,
+                     depth + 1)
+    if not found_deeper:
+        failures.append(FailureTuple(obj=obj, name=name, parent=parent))
+    return False
+
+
+def inspect_serializability(
+    base_obj: Any, name: Optional[str] = None
+) -> Tuple[bool, Set[FailureTuple]]:
+    """Check whether ``base_obj`` is serializable by the runtime; returns
+    (ok, failures) where each failure names a leaf object that cannot be
+    pickled (reference: check_serialize.py inspect_serializability)."""
+    name = name or getattr(base_obj, "__name__", repr(base_obj)[:40])
+    failures: list[FailureTuple] = []
+    ok = _inspect(base_obj, name, None, failures, set(), 0)
+    if not ok and not failures:
+        failures.append(FailureTuple(obj=base_obj, name=name, parent=None))
+    return ok, set(failures)

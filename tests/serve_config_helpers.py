@@ -1,0 +1,19 @@
+"""Importable deployment classes for serve build/deploy config tests."""
+
+from ray_tpu import serve
+
+
+@serve.deployment
+class Doubler:
+    def __call__(self, x):
+        return 2 * x
+
+
+@serve.deployment
+class Chain:
+    def __init__(self, inner):
+        self.inner = inner
+
+    def __call__(self, x):
+        doubled = self.inner.remote(x).result()
+        return doubled + 1

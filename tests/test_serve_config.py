@@ -1,0 +1,92 @@
+"""Declarative serve configs (reference: serve/schema.py + `serve build`/
+`serve deploy`) and custom datasources (reference: data/datasource
+Datasource + read_datasource)."""
+
+import json
+
+import numpy as np
+import pytest
+
+import ray_tpu
+import ray_tpu.data
+from ray_tpu import serve
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _cluster():
+    ray_tpu.init(num_cpus=4, object_store_memory=64 * 1024 * 1024)
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def test_serve_build_and_run_from_config():
+    from tests.serve_config_helpers import Chain, Doubler
+
+    app = Chain.bind(Doubler.bind())
+    config = serve.build(app, route_prefix="/chain")
+    # The config is JSON-serializable (what `serve build > config.json`
+    # would write).
+    text = json.dumps(config)
+    deployments = config["applications"][0]["deployments"]
+    assert {d["name"] for d in deployments} == {"Chain", "Doubler"}
+    chain = next(d for d in deployments if d["name"] == "Chain")
+    assert chain["import_path"].endswith("serve_config_helpers.Chain")
+    assert chain["init_args"] == [{"__handle__": "Doubler"}]
+    assert chain["route_prefix"] == "/chain"
+
+    serve.run_from_config(json.loads(text), proxy=False)
+    handle = serve.get_deployment_handle("Chain")
+    assert handle.remote(5).result() == 11  # 5*2 + 1
+    serve.delete("Chain")
+    serve.delete("Doubler")
+
+
+def test_serve_build_rejects_main_classes():
+    @serve.deployment
+    class Local:  # defined in the test module at runtime — importable
+        def __call__(self):
+            return 0
+
+    Local.cls.__module__ = "__main__"  # simulate a __main__ class
+    with pytest.raises(ValueError, match="importable"):
+        serve.build(Local.bind())
+
+
+class SquaresSource(ray_tpu.data.Datasource):
+    """n^2 rows split across read tasks."""
+
+    def __init__(self, n: int):
+        self.n = n
+
+    def get_read_tasks(self, parallelism):
+        from ray_tpu.data.datasource import ReadTask
+
+        chunk = max(1, self.n // parallelism)
+        tasks = []
+        for start in range(0, self.n, chunk):
+            stop = min(start + chunk, self.n)
+
+            def read(start=start, stop=stop):
+                arr = np.arange(start, stop)
+                yield {"x": arr, "sq": arr * arr}
+
+            tasks.append(ReadTask(read))
+        return tasks
+
+
+def test_read_datasource_custom_plugin():
+    ds = ray_tpu.data.read_datasource(SquaresSource(20), parallelism=4)
+    assert ds.count() == 20
+    assert ds.sum("sq") == sum(i * i for i in range(20))
+    rows = ds.take(3)
+    assert rows[0]["sq"] == 0 and rows[2]["sq"] == 4
+
+
+def test_read_datasource_empty_rejected():
+    class Empty(ray_tpu.data.Datasource):
+        def get_read_tasks(self, parallelism):
+            return []
+
+    with pytest.raises(ValueError, match="no read tasks"):
+        ray_tpu.data.read_datasource(Empty())

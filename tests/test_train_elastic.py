@@ -1,0 +1,69 @@
+"""Elastic train restarts (reference: train/v2/_internal/execution/
+scaling_policy + failure_handling — a failed attempt may restart with a
+smaller world when the cluster shrank)."""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu.train import FailureConfig, JaxTrainer, RunConfig, ScalingConfig
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _cluster():
+    ray_tpu.init(num_cpus=4, object_store_memory=64 * 1024 * 1024)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_max_placeable_workers_counts_gangs():
+    fit = JaxTrainer._max_placeable_workers(
+        ScalingConfig(num_workers=8, cpus_per_worker=1.0)
+    )
+    assert fit == 4  # 4-CPU cluster, 1 CPU per worker
+    fit2 = JaxTrainer._max_placeable_workers(
+        ScalingConfig(num_workers=8, cpus_per_worker=3.0)
+    )
+    assert fit2 == 1
+
+
+def test_elastic_restart_shrinks_world(tmp_path, monkeypatch):
+    marker = tmp_path / "crashed_once"
+
+    def loop(config):
+        from ray_tpu import train
+
+        ctx = train.get_context()
+        if not os.path.exists(config["marker"]):
+            if ctx.get_world_rank() == 0:
+                open(config["marker"], "w").close()
+                os._exit(1)  # simulate a host loss on attempt 0
+            import time
+
+            time.sleep(30)  # peers die with the gang teardown
+        train.report({"world_size": ctx.get_world_size()})
+
+    # Pretend the post-failure cluster only fits 2 workers.
+    monkeypatch.setattr(
+        JaxTrainer, "_max_placeable_workers", staticmethod(lambda scaling: 2)
+    )
+    trainer = JaxTrainer(
+        loop,
+        train_loop_config={"marker": str(marker)},
+        scaling_config=ScalingConfig(num_workers=3, cpus_per_worker=1.0,
+                                     min_workers=2),
+        run_config=RunConfig(name="elastic", storage_path=str(tmp_path),
+                             failure_config=FailureConfig(max_failures=2)),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["world_size"] == 2  # shrank from 3
+    assert marker.exists()
+
+
+def test_fixed_scaling_never_shrinks():
+    cfg = ScalingConfig(num_workers=4)
+    assert not cfg.elastic
+    assert ScalingConfig(num_workers=4, min_workers=2).elastic
+    assert not ScalingConfig(num_workers=2, min_workers=2).elastic

@@ -1,0 +1,100 @@
+"""util extras: scheduling strategies public module, serializability
+inspector, DAG collective allreduce (reference: util/scheduling_strategies,
+util/check_serialize, dag/collective_node.py + experimental/collective)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.dag import AllReduceNode, InputNode, MultiOutputNode
+from ray_tpu.util.check_serialize import inspect_serializability
+from ray_tpu.util.scheduling_strategies import (
+    NodeAffinitySchedulingStrategy,
+    SPREAD_SCHEDULING_STRATEGY,
+)
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _cluster():
+    ray_tpu.init(num_cpus=4, object_store_memory=64 * 1024 * 1024)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_node_affinity_strategy_end_to_end():
+    node_id = ray_tpu.nodes()[0]["node_id"]
+
+    @ray_tpu.remote
+    def where():
+        from ray_tpu._private import worker_context
+
+        return worker_context.get_task_context().node_id
+
+    pinned = where.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(node_id=node_id)
+    )
+    assert ray_tpu.get(pinned.remote()) == node_id
+    spread = where.options(scheduling_strategy=SPREAD_SCHEDULING_STRATEGY)
+    assert ray_tpu.get(spread.remote()) == node_id  # single node: same
+
+
+def test_inspect_serializability_finds_leaf():
+    lock = threading.Lock()
+
+    def closure_over_lock():
+        return lock
+
+    ok, failures = inspect_serializability(closure_over_lock)
+    assert not ok
+    names = {f.name for f in failures}
+    assert any("lock" in n for n in names), names
+
+    ok2, failures2 = inspect_serializability(lambda x: x + 1)
+    assert ok2 and not failures2
+
+    class Holder:
+        def __init__(self):
+            self.fine = 3
+            self.bad = threading.Lock()
+
+    ok3, failures3 = inspect_serializability(Holder())
+    assert not ok3
+    assert any("bad" in f.name for f in failures3)
+
+
+def test_dag_allreduce_across_actors():
+    @ray_tpu.remote
+    class Worker:
+        def __init__(self, val):
+            self.val = val
+
+        def grads(self, x):
+            return {"w": np.full(3, self.val, np.float64) * x}
+
+        def apply(self, reduced):
+            return float(reduced["w"].sum())
+
+    workers = [Worker.remote(float(i + 1)) for i in range(3)]
+    with InputNode() as x:
+        outs = [w.grads.bind(x) for w in workers]
+        reduced = AllReduceNode(outs, op="mean")
+        dag = MultiOutputNode([w.apply.bind(reduced) for w in workers])
+
+    results = ray_tpu.get(dag.execute(2.0))
+    # mean over vals (1,2,3) = 2.0; * x(2.0) * 3 elements = 12.0 each.
+    assert results == [pytest.approx(12.0)] * 3
+
+    compiled = dag.experimental_compile()
+    assert ray_tpu.get(compiled.execute(1.0)) == [pytest.approx(6.0)] * 3
+    compiled.teardown()
+    for w in workers:
+        ray_tpu.kill(w)
+
+
+def test_dag_allreduce_validation():
+    with pytest.raises(ValueError, match="op"):
+        AllReduceNode([InputNode()], op="median")
+    with pytest.raises(ValueError, match="at least one"):
+        AllReduceNode([])
