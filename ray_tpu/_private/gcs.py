@@ -1655,9 +1655,6 @@ class Head:
         self._shutdown = True
         if self.memory_monitor is not None:
             self.memory_monitor.stop()
-        cg = getattr(self, "_cgroup", None)
-        if cg is not None:
-            cg.teardown()
         with self.lock:
             workers = list(self.workers.values())
         for rec in workers:
@@ -1674,5 +1671,14 @@ class Head:
                 rec.proc.wait(timeout=max(0.05, deadline - time.time()))
             except subprocess.TimeoutExpired:
                 rec.proc.kill()
+                try:
+                    rec.proc.wait(timeout=1.0)
+                except subprocess.TimeoutExpired:
+                    pass
+        # Cgroup teardown only after the workers are gone: rmdir on a
+        # populated cgroup is EBUSY.
+        cg = getattr(self, "_cgroup", None)
+        if cg is not None:
+            cg.teardown()
         self.server.stop()
         self.arena.close(unlink=True)

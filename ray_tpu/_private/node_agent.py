@@ -147,6 +147,13 @@ class NodeAgent:
         for proc in self.procs.values():
             if proc.poll() is None:
                 proc.kill()
+        for proc in self.procs.values():
+            try:
+                proc.wait(timeout=2.0)
+            except Exception:
+                pass
+        # Only after the workers actually exited (rmdir on a populated
+        # cgroup is EBUSY).
         cg = getattr(self, "_cgroup", None)
         if cg is not None:
             cg.teardown()

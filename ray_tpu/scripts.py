@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import shlex
 import sys
 
 
@@ -97,7 +98,10 @@ def cmd_submit(args) -> int:
     from ray_tpu.job_submission import JobSubmissionClient
 
     client = JobSubmissionClient(address=args.address)
-    entrypoint = " ".join(args.entrypoint)
+    entry = args.entrypoint
+    if entry and entry[0] == "--":
+        entry = entry[1:]
+    entrypoint = shlex.join(entry)
     job_id = client.submit_job(entrypoint=entrypoint)
     print(f"submitted {job_id}")
     if args.wait:
@@ -152,6 +156,57 @@ def cmd_dashboard(args) -> int:
     return 0
 
 
+def cmd_job(args) -> int:
+    """`ray-tpu job ...` (reference: dashboard/modules/job/cli.py —
+    ray job submit/status/logs/stop/list)."""
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    client = JobSubmissionClient(address=args.address)
+    if args.job_cmd == "submit":
+        return cmd_submit(args)  # same namespace shape; one implementation
+    if args.job_cmd == "status":
+        info = client.get_job_info(args.job_id)
+        print(json.dumps(info, indent=2, default=str))
+        return 0
+    if args.job_cmd == "logs":
+        print(client.get_job_logs(args.job_id), end="")
+        return 0
+    if args.job_cmd == "stop":
+        stopped = client.stop_job(args.job_id)
+        print("stopped" if stopped else "not running")
+        return 0
+    if args.job_cmd == "list":
+        for info in client.list_jobs():
+            print(f"{info.get('job_id')}\t{info.get('status')}\t"
+                  f"{info.get('entrypoint', '')[:60]}")
+        return 0
+    raise SystemExit(f"unknown job command {args.job_cmd!r}")
+
+
+def cmd_serve(args) -> int:
+    """`ray-tpu serve ...` (reference: serve/scripts.py — serve
+    deploy/status/shutdown)."""
+    from ray_tpu import serve
+
+    _connect(args.address)
+    if args.serve_cmd == "deploy":
+        serve.run_from_config(args.config_file)
+        print(f"deployed from {args.config_file}")
+        st = serve.status()
+        for name, info in st.items():
+            print(f"  {name}: {info['running_replicas']}/"
+                  f"{info['target_replicas']} replicas")
+        return 0
+    if args.serve_cmd == "status":
+        print(json.dumps(serve.status(), indent=2, default=str))
+        return 0
+    if args.serve_cmd == "shutdown":
+        serve.shutdown()
+        print("serve shut down")
+        return 0
+    raise SystemExit(f"unknown serve command {args.serve_cmd!r}")
+
+
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(prog="ray-tpu", description=__doc__.splitlines()[0])
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -197,6 +252,31 @@ def main(argv: list[str] | None = None) -> int:
     s.add_argument("--address", required=True)
     s.add_argument("--port", type=int, default=0)
     s.set_defaults(fn=cmd_dashboard)
+
+    s = sub.add_parser("job", help="job submission (submit/status/logs/stop/list)")
+    jsub = s.add_subparsers(dest="job_cmd", required=True)
+    j = jsub.add_parser("submit")
+    j.add_argument("--address", required=True)
+    j.add_argument("--wait", action="store_true")
+    j.add_argument("--timeout", type=float, default=600.0)
+    j.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    for name in ("status", "logs", "stop"):
+        j = jsub.add_parser(name)
+        j.add_argument("--address", required=True)
+        j.add_argument("job_id")
+    j = jsub.add_parser("list")
+    j.add_argument("--address", required=True)
+    s.set_defaults(fn=cmd_job)
+
+    s = sub.add_parser("serve", help="model serving (deploy/status/shutdown)")
+    ssub = s.add_subparsers(dest="serve_cmd", required=True)
+    v = ssub.add_parser("deploy")
+    v.add_argument("--address", required=True)
+    v.add_argument("config_file")
+    for name in ("status", "shutdown"):
+        v = ssub.add_parser(name)
+        v.add_argument("--address", required=True)
+    s.set_defaults(fn=cmd_serve)
 
     args = p.parse_args(argv)
     return args.fn(args)

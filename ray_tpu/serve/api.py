@@ -110,11 +110,25 @@ def get_grpc_port() -> int:
     return ray_tpu.get(_proxy.get_grpc_port.remote())
 
 
-def status() -> dict:
+def _resolve_controller():
+    """Attach to a cluster's existing controller without creating one
+    (read-only callers; cross-process CLI). Returns None if serve was
+    never started."""
     global _controller
     if _controller is None:
-        start(proxy=False)
-    return ray_tpu.get(_controller.status.remote())
+        try:
+            _controller = ray_tpu.get_actor("SERVE_CONTROLLER",
+                                            namespace="serve")
+        except Exception:
+            return None
+    return _controller
+
+
+def status() -> dict:
+    controller = _resolve_controller()
+    if controller is None:
+        return {}  # serve not running — a status query must not start it
+    return ray_tpu.get(controller.status.remote())
 
 
 def delete(name: str) -> None:
@@ -127,6 +141,9 @@ def delete(name: str) -> None:
 
 def shutdown() -> None:
     global _controller, _proxy
+    # A fresh process (CLI `serve shutdown`) attaches to the cluster's
+    # controller by name first — shutdown must work cross-process.
+    _resolve_controller()
     if _controller is not None:
         try:
             ray_tpu.get(_controller.shutdown_deployments.remote(), timeout=30)
@@ -141,6 +158,12 @@ def shutdown() -> None:
             except RayTpuError:
                 pass
         _controller = None
+    if _proxy is None:
+        # Cross-process: the proxy is a named actor too.
+        try:
+            _proxy = ray_tpu.get_actor("SERVE_PROXY", namespace="serve")
+        except Exception:
+            _proxy = None
     if _proxy is not None:
         try:
             ray_tpu.kill(_proxy)
@@ -164,8 +187,12 @@ def _import_path(path: str):
         mod_name = ".".join(parts[:i])
         try:
             obj = importlib.import_module(mod_name)
-        except ImportError:
-            continue
+        except ModuleNotFoundError as e:
+            # Only swallow "this prefix is not a module"; a missing
+            # dependency INSIDE a located module is the user's real error.
+            if e.name == mod_name or (e.name and mod_name.startswith(e.name + ".")):
+                continue
+            raise
         for attr in parts[i:]:
             obj = getattr(obj, attr)
         return obj
