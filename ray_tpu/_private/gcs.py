@@ -295,6 +295,7 @@ class Head:
         env["RAY_TPU_HEAD"] = f"{self.address[0]}:{self.address[1]}"
         env["RAY_TPU_SHM"] = f"{self.shm_name}:{self.config.object_store_memory}"
         env["RAY_TPU_NODE_ID"] = node_id
+        env["RAY_TPU_SESSION_DIR"] = self.session_dir
         # Workers resolve functions pickled by reference (module+name), so
         # they need the driver's import roots (reference analogue: workers
         # inherit the driver's sys.path / working_dir runtime env).

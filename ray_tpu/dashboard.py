@@ -21,6 +21,53 @@ import threading
 import ray_tpu
 
 
+def _logs_dir() -> str | None:
+    import os
+
+    from ray_tpu._private.worker_context import get_head
+
+    head = get_head()
+    if head is not None:
+        return os.path.join(head.session_dir, "logs")
+    # Remote dashboard actor: the session dir travels via env.
+    sess = os.environ.get("RAY_TPU_SESSION_DIR")
+    return os.path.join(sess, "logs") if sess else None
+
+
+def _log_index() -> list[dict]:
+    import os
+
+    d = _logs_dir()
+    if not d or not os.path.isdir(d):
+        return []
+    out = []
+    for name in sorted(os.listdir(d)):
+        if name.endswith(".log"):
+            try:
+                size = os.path.getsize(os.path.join(d, name))
+            except OSError:
+                size = 0
+            out.append({"name": name[:-4], "bytes": size})
+    return out
+
+
+def _log_tail(name: str, max_bytes: int = 64 * 1024) -> dict:
+    import os
+
+    d = _logs_dir()
+    if not d or "/" in name or ".." in name:
+        return {"name": name, "lines": []}
+    path = os.path.join(d, f"{name}.log")
+    try:
+        size = os.path.getsize(path)
+        with open(path, "rb") as f:
+            f.seek(max(0, size - max_bytes))
+            text = f.read().decode("utf-8", errors="replace")
+    except OSError:
+        return {"name": name, "lines": []}
+    return {"name": name, "lines": text.splitlines()[-500:]}
+
+
 class DashboardServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         self._host = host
@@ -67,6 +114,17 @@ class DashboardServer:
             from ray_tpu.job_submission import list_jobs
 
             return {"jobs": list_jobs()}
+        if path == "/api/serve":
+            # Reference: dashboard/modules/serve — deployment statuses.
+            from ray_tpu import serve
+
+            return {"deployments": serve.status()}
+        if path == "/api/logs":
+            # Reference: dashboard/modules/log — per-worker log index.
+            return {"logs": _log_index()}
+        if path.startswith("/api/logs/"):
+            name = path[len("/api/logs/"):]
+            return _log_tail(name)
         if path == "/metrics":
             return um.prometheus_text()
         if path == "/":
@@ -136,8 +194,22 @@ def start_dashboard(host: str = "127.0.0.1", port: int = 0) -> int:
             _dashboard = ray_tpu.get_actor("DASHBOARD", namespace="_dashboard")
         except ValueError:
             try:
-                cls = ray_tpu.remote(num_cpus=0, max_concurrency=8, name="DASHBOARD",
-                                     namespace="_dashboard")(DashboardServer)
+                # Pin to the head node: the log endpoints read the head's
+                # session logs directory, which only exists there
+                # (reference: the dashboard head process runs on the head).
+                from ray_tpu.util.scheduling_strategies import (
+                    NodeAffinitySchedulingStrategy,
+                )
+
+                # The head registers itself before any agent joins, so it
+                # is the first entry in the node table.
+                head_node = ray_tpu.nodes()[0]["node_id"]
+                cls = ray_tpu.remote(
+                    num_cpus=0, max_concurrency=8, name="DASHBOARD",
+                    namespace="_dashboard",
+                    scheduling_strategy=NodeAffinitySchedulingStrategy(
+                        node_id=head_node),
+                )(DashboardServer)
                 _dashboard = cls.remote(host, port)
             except rpc.RpcError:
                 # Creation race with another client: attach instead.

@@ -17,6 +17,8 @@ from ray_tpu.data.dataset import (
     from_pandas,
     range,
     range_tensor,
+    from_huggingface,
+    from_torch,
     read_binary_files,
     read_datasource,
     read_csv,
@@ -39,6 +41,8 @@ __all__ = [
     "from_pandas",
     "range",
     "range_tensor",
+    "from_huggingface",
+    "from_torch",
     "read_binary_files",
     "read_datasource",
     "Datasource",

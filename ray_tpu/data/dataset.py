@@ -437,6 +437,35 @@ def read_binary_files(paths, *, include_paths: bool = False) -> Dataset:
     return Dataset([Read(tasks=ds_mod.binary_tasks(paths, include_paths=include_paths))])
 
 
+def from_huggingface(hf_dataset) -> Dataset:
+    """Ingest a Hugging Face ``datasets.Dataset`` (reference:
+    ray.data.from_huggingface, data/read_api.py). Arrow-backed HF datasets
+    convert column-wise without row materialization."""
+    try:
+        if getattr(hf_dataset, "_indices", None) is not None:
+            # select/shuffle/filter keep an indices mapping over the full
+            # backing table; materialize it or we'd read unselected rows.
+            hf_dataset = hf_dataset.flatten_indices()
+        table = hf_dataset.data.table  # pyarrow.Table behind the HF dataset
+    except AttributeError:
+        table = None
+    if table is not None:
+        return from_arrow(table)
+    rows = [dict(r) for r in hf_dataset]
+    return from_items(rows)
+
+
+def from_torch(torch_dataset) -> Dataset:
+    """Ingest a map-style torch Dataset (reference: ray.data.from_torch) —
+    rows are (sample, label) tuples or dicts."""
+    # NB: this module's `range` is ray_tpu.data.range (a Dataset factory);
+    # index with the builtin.
+    import builtins
+
+    rows = [torch_dataset[i] for i in builtins.range(len(torch_dataset))]
+    return from_items(rows)
+
+
 def read_datasource(datasource, *, parallelism: int = -1) -> Dataset:
     """Read from a custom Datasource plugin (reference:
     ray.data.read_datasource, data/read_api.py)."""

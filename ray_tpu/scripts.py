@@ -161,9 +161,9 @@ def cmd_job(args) -> int:
     ray job submit/status/logs/stop/list)."""
     from ray_tpu.job_submission import JobSubmissionClient
 
-    client = JobSubmissionClient(address=args.address)
     if args.job_cmd == "submit":
         return cmd_submit(args)  # same namespace shape; one implementation
+    client = JobSubmissionClient(address=args.address)
     if args.job_cmd == "status":
         info = client.get_job_info(args.job_id)
         print(json.dumps(info, indent=2, default=str))

@@ -440,3 +440,91 @@ def delete(workflow_id: str) -> None:
     import shutil
 
     shutil.rmtree(os.path.join(_base_dir(), workflow_id), ignore_errors=True)
+
+
+# -- events (reference: python/ray/workflow/api.py wait_for_event +
+#    workflow/event_listener.py EventListener; events are delivered
+#    exactly-once because the receiving step's result is checkpointed) ----
+
+
+class EventListener:
+    """Poll-based event provider (reference: event_listener.py — the
+    reference's is coroutine-based; polling maps better onto checkpointed
+    task steps). Subclass and implement ``poll_for_event``."""
+
+    def poll_for_event(self) -> Any:
+        """Block until the event arrives; return its payload."""
+        raise NotImplementedError
+
+
+class KVEventListener(EventListener):
+    """Default listener: waits for a key in the cluster KV (events are
+    posted with ``workflow.trigger_event``)."""
+
+    def __init__(self, event_key: str, poll_interval_s: float = 0.1,
+                 timeout_s: float | None = None):
+        self.event_key = event_key
+        self.poll_interval_s = poll_interval_s
+        self.timeout_s = timeout_s
+
+    def poll_for_event(self) -> Any:
+        import time as _time
+
+        from ray_tpu._private.worker_context import global_runtime
+        from ray_tpu._private import serialization
+
+        rt = global_runtime()
+        deadline = (_time.time() + self.timeout_s) if self.timeout_s is not None else None
+        while True:
+            raw = rt.kv_get(self.event_key, ns="__wf_events__")
+            if raw:
+                # Consume-once: the receiving step checkpoints the payload,
+                # so the KV copy is deleted — a later workflow reusing the
+                # key waits for a FRESH event instead of reading a stale
+                # one (and the namespace doesn't grow unboundedly).
+                try:
+                    rt.kv_del(self.event_key, ns="__wf_events__")
+                except Exception:
+                    pass
+                return serialization.loads(raw)
+            if deadline is not None and _time.time() > deadline:
+                raise TimeoutError(
+                    f"no event {self.event_key!r} within {self.timeout_s}s")
+            _time.sleep(self.poll_interval_s)
+
+
+def trigger_event(event_key: str, payload: Any = True) -> None:
+    """Post an event for KVEventListener waiters (works from any driver
+    or task in the cluster)."""
+    from ray_tpu._private.worker_context import global_runtime
+    from ray_tpu._private import serialization
+
+    global_runtime().kv_put(event_key, serialization.dumps(payload),
+                            ns="__wf_events__")
+
+
+def _poll_listener(listener_cls, *args, **kwargs):
+    return listener_cls(*args, **kwargs).poll_for_event()
+
+
+def wait_for_event(listener_cls_or_key, *args, **kwargs) -> DAGNode:
+    """A workflow step that completes when the event arrives (reference:
+    workflow/api.py wait_for_event). Pass an EventListener subclass plus
+    its constructor args, or just a string key for the KV listener:
+
+        gate = workflow.wait_for_event("deploy-approved", timeout_s=60)
+        dag = finalize.bind(gate)
+
+    Exactly-once: after the event is first received, the step's
+    checkpointed result replays on resume without re-waiting."""
+    import ray_tpu as _rt
+
+    if isinstance(listener_cls_or_key, str):
+        return _rt.remote(_poll_listener).bind(
+            KVEventListener, listener_cls_or_key, *args, **kwargs)
+    if not (isinstance(listener_cls_or_key, type)
+            and issubclass(listener_cls_or_key, EventListener)):
+        raise TypeError(
+            "wait_for_event takes an event key string or an EventListener "
+            f"subclass, got {listener_cls_or_key!r}")
+    return _rt.remote(_poll_listener).bind(listener_cls_or_key, *args, **kwargs)

@@ -248,3 +248,58 @@ def test_run_rerun_resumes_continuation(tmp_path):
         workflow.run(parent.bind(str(flag)), workflow_id=wid)
     flag.write_text("ok")
     assert workflow.run(parent.bind(str(flag)), workflow_id=wid) == 99
+
+
+def test_wait_for_event_kv(tmp_path):
+    """Events gate workflow steps; checkpointed exactly-once (reference:
+    workflow/api.py wait_for_event + event system tests)."""
+    import threading
+    import time
+
+    @ray_tpu.remote
+    def finalize(payload):
+        return f"done:{payload}"
+
+    key = "evt-" + uuid.uuid4().hex[:6]
+    dag = finalize.bind(workflow.wait_for_event(key, timeout_s=30))
+    wid = _wid()
+
+    def fire():
+        time.sleep(0.5)
+        workflow.trigger_event(key, "approved")
+
+    t = threading.Thread(target=fire)
+    t.start()
+    out = workflow.run(dag, workflow_id=wid)
+    t.join()
+    assert out == "done:approved"
+    # Resume replays the checkpointed event without waiting again (the
+    # event key is NOT re-fired; a re-wait would block 30s and time out).
+    assert workflow.resume(wid) == "done:approved"
+
+
+def test_wait_for_event_timeout():
+    @ray_tpu.remote
+    def use(x):
+        return x
+
+    dag = use.bind(workflow.wait_for_event("never-" + uuid.uuid4().hex[:6],
+                                           timeout_s=0.3))
+    with pytest.raises(Exception, match="no event"):
+        workflow.run(dag, workflow_id=_wid())
+
+
+def test_wait_for_event_custom_listener():
+    class Instant(workflow.EventListener):
+        def poll_for_event(self):
+            return 42
+
+    @ray_tpu.remote
+    def use(x):
+        return x + 1
+
+    dag = use.bind(workflow.wait_for_event(Instant))
+    assert workflow.run(dag, workflow_id=_wid()) == 43
+
+    with pytest.raises(TypeError, match="EventListener"):
+        workflow.wait_for_event(123)
