@@ -32,6 +32,9 @@ class Config:
     # max_direct_call_object_size in ray_config_def.h).
     max_inline_object_size: int = 100 * 1024
     object_spilling_dir: str = ""
+    # Backend selection JSON (reference: RAY_object_spilling_config):
+    # {"type": "filesystem"|"smart_open", "params": {...}}
+    object_spilling_config: dict | None = None
     # Start spilling when the store passes this fraction of capacity.
     object_spilling_threshold: float = 0.8
 

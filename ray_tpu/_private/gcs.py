@@ -165,7 +165,10 @@ class Head:
         self.session_dir = session_dir or f"/tmp/ray_tpu/session_{self.session_id}"
         os.makedirs(self.session_dir, exist_ok=True)
         self.spill_dir = config.object_spilling_dir or os.path.join(self.session_dir, "spill")
-        os.makedirs(self.spill_dir, exist_ok=True)
+        from ray_tpu._private.external_storage import setup_external_storage
+
+        self.external_storage = setup_external_storage(
+            config.object_spilling_config, self.spill_dir)
 
         self.shm_name = f"/ray_tpu_{self.session_id}"
         self.arena = ShmArena(self.shm_name, config.object_store_memory)
@@ -492,10 +495,7 @@ class Head:
                 # release the stale block instead of leaking it.
                 self.arena.free(entry.offset)
             if entry.spill_path:
-                try:
-                    os.unlink(entry.spill_path)
-                except OSError:
-                    pass
+                self.external_storage.delete(entry.spill_path)
                 entry.spill_path = None
             entry.inline = None
             entry.offset, entry.size, entry.owner_id = offset, size, owner
@@ -524,22 +524,19 @@ class Head:
         return None
 
     def _spill(self, entry: ObjectEntry) -> None:
-        path = os.path.join(self.spill_dir, entry.object_id)
-        with open(path, "wb") as f:
-            f.write(self.arena.view(entry.offset, entry.size))
+        entry.spill_path = self.external_storage.spill(
+            entry.object_id, self.arena.view(entry.offset, entry.size))
         self.arena.free(entry.offset)
         entry.offset = None
-        entry.spill_path = path
         entry.state = SPILLED
 
     def _restore(self, entry: ObjectEntry) -> bool:
         offset = self._alloc_with_spill(entry.size)
         if offset is None:
             return False
-        with open(entry.spill_path, "rb") as f:
-            data = f.read()
+        data = self.external_storage.restore(entry.spill_path)
         self.arena.view(offset, entry.size)[:] = data
-        os.unlink(entry.spill_path)
+        self.external_storage.delete(entry.spill_path)
         entry.spill_path = None
         entry.offset = offset
         entry.state = SEALED
@@ -601,9 +598,10 @@ class Head:
             return ("inline", entry.inline, entry.is_error)
         if entry.state == SPILLED:
             if not self._restore(entry):
-                # Slow path: serve straight from disk.
-                with open(entry.spill_path, "rb") as f:
-                    return ("inline", f.read(), entry.is_error)
+                # Slow path: serve straight from external storage.
+                return ("inline",
+                        self.external_storage.restore(entry.spill_path),
+                        entry.is_error)
         if entry.state == SEALED:
             if remote:
                 # Off-host client: copy out under the lock and ship bytes
@@ -736,10 +734,7 @@ class Head:
         if entry.offset is not None:
             self.arena.free(entry.offset)
         if entry.spill_path:
-            try:
-                os.unlink(entry.spill_path)
-            except OSError:
-                pass
+            self.external_storage.delete(entry.spill_path)
         self.objects.pop(entry.object_id, None)
 
     # --- KV store (reference: GCS InternalKV, gcs_service.proto) ---
@@ -1681,5 +1676,12 @@ class Head:
         cg = getattr(self, "_cgroup", None)
         if cg is not None:
             cg.teardown()
+        # Spilled objects die with the session (reference: spilled files
+        # live under the session dir; external backends get their cleanup
+        # hook invoked here).
+        try:
+            self.external_storage.destroy()
+        except Exception:
+            pass
         self.server.stop()
         self.arena.close(unlink=True)

@@ -69,6 +69,13 @@ def pack(runtime_env: dict | None, rt) -> dict | None:
                 f"deployment does not have; pre-install the packages in "
                 f"the worker image instead"
             )
+    for bad in ("container", "image_uri"):
+        if runtime_env.get(bad):
+            raise ValueError(
+                f"runtime_env[{bad!r}] needs a container runtime on every "
+                f"node (reference: _private/runtime_env/image_uri.py); "
+                f"this deployment runs workers as host processes"
+            )
     env = dict(runtime_env)
 
     def upload(path: str, *, under_basename: bool = False) -> str:

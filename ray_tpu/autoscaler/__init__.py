@@ -16,9 +16,19 @@ from ray_tpu.autoscaler.autoscaler import (
     StandardAutoscaler,
 )
 from ray_tpu.autoscaler.node_provider import FakeNodeProvider, NodeProvider
+from ray_tpu.autoscaler.v2 import (
+    AutoscalerV2,
+    Instance,
+    InstanceStorage,
+    Reconciler,
+)
 
 __all__ = [
     "AutoscalerConfig",
+    "AutoscalerV2",
+    "Instance",
+    "InstanceStorage",
+    "Reconciler",
     "FakeNodeProvider",
     "NodeProvider",
     "NodeType",

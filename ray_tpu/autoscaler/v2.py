@@ -1,0 +1,215 @@
+"""Autoscaler v2: instance manager + reconciler.
+
+Counterpart of the reference's autoscaler v2
+(reference: python/ray/autoscaler/v2/autoscaler.py:42 Autoscaler;
+instance_manager/ — InstanceStorage with versioned updates, Reconciler
+driving instances through an explicit lifecycle, cloud_providers/).
+Instances progress:
+
+    QUEUED -> REQUESTED -> ALLOCATED -> RAY_RUNNING
+           -> RAY_STOPPING -> TERMINATING -> TERMINATED
+
+v1 (autoscaler.py StandardAutoscaler) makes launch/terminate decisions
+directly from provider polls; v2 separates the *decision* (Reconciler
+diffing demand against the instance table) from the *observation*
+(provider and cluster state folded into instance statuses), which makes
+every transition unit-testable and crash-recoverable — the instance table
+is the single source of truth.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+import uuid
+from typing import Callable, Optional
+
+from ray_tpu.autoscaler.autoscaler import AutoscalerConfig, ResourceDemandScheduler
+from ray_tpu.autoscaler.node_provider import NodeProvider
+
+# Instance lifecycle states (reference: instance_manager/common.py).
+QUEUED = "QUEUED"
+REQUESTED = "REQUESTED"
+ALLOCATED = "ALLOCATED"
+RAY_RUNNING = "RAY_RUNNING"
+TERMINATING = "TERMINATING"
+TERMINATED = "TERMINATED"
+
+
+@dataclasses.dataclass
+class Instance:
+    instance_id: str
+    node_type: str
+    status: str = QUEUED
+    cloud_instance_id: Optional[str] = None
+    launch_time: float = 0.0
+    idle_since: Optional[float] = None
+    _storage: "InstanceStorage | None" = None
+
+    def transition(self, status: str) -> None:
+        self.status = status
+        if self._storage is not None:
+            self._storage.version += 1
+
+
+class InstanceStorage:
+    """Versioned instance table (reference: instance_manager/
+    instance_storage.py). ``version`` advances on every upsert, sweep,
+    AND lifecycle transition, so pollers can cheaply detect churn."""
+
+    def __init__(self):
+        self._instances: dict[str, Instance] = {}
+        self.version = 0
+
+    def upsert(self, inst: Instance) -> None:
+        inst._storage = self
+        self._instances[inst.instance_id] = inst
+        self.version += 1
+
+    def get(self, instance_id: str) -> Optional[Instance]:
+        return self._instances.get(instance_id)
+
+    def all(self, *statuses: str) -> list[Instance]:
+        out = list(self._instances.values())
+        if statuses:
+            out = [i for i in out if i.status in statuses]
+        return out
+
+    def sweep_terminated(self) -> int:
+        dead = [i.instance_id for i in self._instances.values()
+                if i.status == TERMINATED]
+        for iid in dead:
+            del self._instances[iid]
+        if dead:
+            self.version += 1
+        return len(dead)
+
+
+class Reconciler:
+    """One reconcile pass = observe + decide + act (reference:
+    instance_manager/reconciler.py Reconciler.reconcile)."""
+
+    def __init__(self, provider: NodeProvider, storage: InstanceStorage,
+                 config: AutoscalerConfig):
+        self.provider = provider
+        self.storage = storage
+        self.config = config
+        self.scheduler = ResourceDemandScheduler(config.node_types)
+
+    # -- observation -----------------------------------------------------
+
+    def _sync_cloud_state(self, ray_running: Callable[[str], bool]) -> None:
+        """Fold provider + cluster observations into instance statuses."""
+        live = set(self.provider.non_terminated_nodes())
+        for inst in self.storage.all(REQUESTED, ALLOCATED, RAY_RUNNING,
+                                     TERMINATING):
+            cid = inst.cloud_instance_id
+            if inst.status == TERMINATING:
+                if cid not in live:
+                    inst.transition(TERMINATED)
+                continue
+            if cid is None or cid not in live:
+                # Cloud lost the node under us (preemption).
+                inst.transition(TERMINATED)
+                continue
+            if inst.status == REQUESTED and self.provider.is_running(cid):
+                inst.transition(ALLOCATED)
+            if inst.status == ALLOCATED and ray_running(cid):
+                inst.transition(RAY_RUNNING)
+
+    # -- decision + action -----------------------------------------------
+
+    def _launch_for_demand(self, demands: list[dict]) -> dict[str, int]:
+        # Capacity already owned = instances not terminating (booked at
+        # full node size; the anti-thrash stance of the v1 loop).
+        counts: dict[str, int] = {}
+        capacities: list[dict] = []
+        for inst in self.storage.all(QUEUED, REQUESTED, ALLOCATED,
+                                     RAY_RUNNING):
+            counts[inst.node_type] = counts.get(inst.node_type, 0) + 1
+            nt = self.scheduler.node_types.get(inst.node_type)
+            if nt is not None:
+                capacities.append(dict(nt.resources))
+        to_launch = self.scheduler.get_nodes_to_launch(
+            demands, capacities, counts
+        )
+        for node_type, count in to_launch.items():
+            for _ in range(count):
+                inst = Instance(
+                    instance_id="inst-" + uuid.uuid4().hex[:8],
+                    node_type=node_type,
+                    launch_time=time.monotonic(),
+                )
+                self.storage.upsert(inst)
+        return to_launch
+
+    def _request_queued(self) -> None:
+        for inst in self.storage.all(QUEUED):
+            cid = self.provider.create_node(inst.node_type, 1)[0]
+            inst.cloud_instance_id = cid
+            inst.transition(REQUESTED)
+
+    def _terminate_idle(self, node_is_idle: Callable[[str], bool]) -> list[str]:
+        out = []
+        now = time.monotonic()
+        for inst in self.storage.all(RAY_RUNNING):
+            if node_is_idle(inst.cloud_instance_id):
+                if inst.idle_since is None:
+                    inst.idle_since = now
+                elif now - inst.idle_since >= self.config.idle_timeout_s:
+                    self.provider.terminate_node(inst.cloud_instance_id)
+                    inst.transition(TERMINATING)
+                    out.append(inst.cloud_instance_id)
+            else:
+                inst.idle_since = None
+        return out
+
+    def reconcile(self, demands: list[dict],
+                  ray_running: Callable[[str], bool],
+                  node_is_idle: Callable[[str], bool]) -> dict:
+        self._sync_cloud_state(ray_running)
+        launched = self._launch_for_demand(demands)
+        self._request_queued()
+        terminated = self._terminate_idle(node_is_idle)
+        swept = self.storage.sweep_terminated()
+        return {
+            "launched": launched,
+            "terminated": terminated,
+            "swept": swept,
+            "instances": {
+                s: len(self.storage.all(s))
+                for s in (QUEUED, REQUESTED, ALLOCATED, RAY_RUNNING,
+                          TERMINATING)
+            },
+        }
+
+
+class AutoscalerV2:
+    """Ties the reconciler to live cluster signals (reference:
+    v2/autoscaler.py Autoscaler.update_autoscaling_state)."""
+
+    def __init__(self, provider: NodeProvider, config: AutoscalerConfig,
+                 demand_source: Callable[[], list[dict]] | None = None):
+        from ray_tpu.autoscaler.autoscaler import StandardAutoscaler
+
+        self.storage = InstanceStorage()
+        self.reconciler = Reconciler(provider, self.storage, config)
+        self._demand_source = demand_source or StandardAutoscaler._head_demand
+        self.provider = provider
+
+    def update(self, *, ray_running: Callable[[str], bool] | None = None,
+               node_is_idle: Callable[[str], bool] | None = None) -> dict:
+        from ray_tpu.autoscaler.autoscaler import StandardAutoscaler
+
+        demands = self._demand_source()
+        if ray_running is None:
+            ray_running = self.provider.is_running
+        if node_is_idle is None:
+            # v1's conservative default: pending demand or any busy worker
+            # blocks idle termination cluster-wide (no per-node mapping
+            # without a callback) — prevents scale-down/up thrash while
+            # queued work exists.
+            busy = StandardAutoscaler._cluster_has_busy_workers()
+            idle = not demands and not busy
+            node_is_idle = lambda cid: idle  # noqa: E731
+        return self.reconciler.reconcile(demands, ray_running, node_is_idle)

@@ -8,12 +8,15 @@ from ray_tpu.util.placement_group import (
     remove_placement_group,
 )
 from ray_tpu.util.queue import Empty, Full, Queue
+from ray_tpu.util.serialization import deregister_serializer, register_serializer
 
 __all__ = [
     "ActorPool",
     "Empty",
     "Full",
     "Queue",
+    "deregister_serializer",
+    "register_serializer",
     "placement_group",
     "placement_group_table",
     "remove_placement_group",

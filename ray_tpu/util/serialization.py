@@ -1,0 +1,40 @@
+"""Custom serializer registration.
+
+Counterpart of the reference's ray.util.serialization
+(reference: python/ray/util/serialization.py — register_serializer /
+deregister_serializer installing per-class reducers into the worker's
+serialization context). Implementation: a copyreg reducer that embeds the
+deserializer (cloudpickle serializes it by value), so workers reconstruct
+objects without any receiver-side registration step.
+
+    class Conn: ...                      # unpicklable (sockets inside)
+    ray_tpu.util.register_serializer(
+        Conn,
+        serializer=lambda c: c.address,
+        deserializer=lambda addr: Conn(addr),
+    )
+"""
+
+from __future__ import annotations
+
+import copyreg
+from typing import Any, Callable
+
+
+def _reconstruct(deserializer: Callable, payload: Any):
+    return deserializer(payload)
+
+
+def register_serializer(cls: type, *, serializer: Callable[[Any], Any],
+                        deserializer: Callable[[Any], Any]) -> None:
+    """Route pickling of ``cls`` instances through ``serializer`` (must
+    return something picklable); workers rebuild via ``deserializer``."""
+
+    def reducer(obj):
+        return _reconstruct, (deserializer, serializer(obj))
+
+    copyreg.pickle(cls, reducer)
+
+
+def deregister_serializer(cls: type) -> None:
+    copyreg.dispatch_table.pop(cls, None)

@@ -482,11 +482,11 @@ class KVEventListener(EventListener):
                 # so the KV copy is deleted — a later workflow reusing the
                 # key waits for a FRESH event instead of reading a stale
                 # one (and the namespace doesn't grow unboundedly).
-                try:
-                    rt.kv_del(self.event_key, ns="__wf_events__")
-                except Exception:
-                    pass
-                return serialization.loads(raw)
+                # kv_del is atomic head-side: with concurrent waiters on
+                # one key, exactly the deleting winner delivers; losers
+                # keep waiting for the next event.
+                if rt.kv_del(self.event_key, ns="__wf_events__"):
+                    return serialization.loads(raw)
             if deadline is not None and _time.time() > deadline:
                 raise TimeoutError(
                     f"no event {self.event_key!r} within {self.timeout_s}s")

@@ -1,0 +1,85 @@
+"""Autoscaler v2 reconciler (reference: autoscaler/v2 — instance manager,
+lifecycle transitions, idle scale-down)."""
+
+import pytest
+
+from ray_tpu.autoscaler import (
+    AutoscalerConfig,
+    FakeNodeProvider,
+    NodeType,
+    Reconciler,
+)
+from ray_tpu.autoscaler.v2 import (
+    ALLOCATED,
+    QUEUED,
+    RAY_RUNNING,
+    REQUESTED,
+    TERMINATING,
+    InstanceStorage,
+)
+
+
+def _setup(launch_delay=0.0, idle_timeout=0.0):
+    provider = FakeNodeProvider(launch_delay_s=launch_delay)
+    config = AutoscalerConfig(
+        node_types=[NodeType("cpu4", {"CPU": 4.0}, max_workers=5)],
+        idle_timeout_s=idle_timeout,
+    )
+    storage = InstanceStorage()
+    return provider, Reconciler(provider, storage, config), storage
+
+
+def test_demand_launches_through_lifecycle():
+    provider, rec, storage = _setup()
+    ray_nodes = set()
+    res = rec.reconcile([{"CPU": 4.0}, {"CPU": 4.0}],
+                        ray_running=lambda cid: cid in ray_nodes,
+                        node_is_idle=lambda cid: False)
+    assert res["launched"] == {"cpu4": 2}
+    # QUEUED instances were provider-requested in the same pass and the
+    # fake provider runs them instantly -> ALLOCATED on next observe.
+    assert len(provider.non_terminated_nodes()) == 2
+    res = rec.reconcile([], lambda cid: cid in ray_nodes, lambda cid: False)
+    assert res["instances"][ALLOCATED] == 2
+    # Cluster reports ray up on both -> RAY_RUNNING; no relaunch occurs
+    # while capacity covers the demand.
+    ray_nodes.update(provider.non_terminated_nodes())
+    res = rec.reconcile([{"CPU": 4.0}], lambda cid: cid in ray_nodes,
+                        lambda cid: False)
+    assert res["launched"] == {}
+    assert res["instances"][RAY_RUNNING] == 2
+
+
+def test_idle_scale_down_and_sweep():
+    provider, rec, storage = _setup(idle_timeout=0.0)
+    rec.reconcile([{"CPU": 1.0}], lambda cid: True, lambda cid: False)
+    rec.reconcile([], lambda cid: True, lambda cid: False)
+    assert storage.all(RAY_RUNNING)
+    # Two passes: first marks idle_since, second terminates (timeout 0).
+    rec.reconcile([], lambda cid: True, lambda cid: True)
+    res = rec.reconcile([], lambda cid: True, lambda cid: True)
+    assert res["terminated"] or res["swept"]
+    # Terminated instances leave the table once the cloud confirms.
+    res = rec.reconcile([], lambda cid: True, lambda cid: True)
+    assert not provider.non_terminated_nodes()
+    assert not storage.all(RAY_RUNNING, TERMINATING)
+
+
+def test_preempted_node_detected_and_replaced():
+    provider, rec, storage = _setup()
+    rec.reconcile([{"CPU": 2.0}], lambda cid: True, lambda cid: False)
+    rec.reconcile([], lambda cid: True, lambda cid: False)
+    (inst,) = storage.all(ALLOCATED, RAY_RUNNING)
+    # Cloud preempts the instance out from under us.
+    provider.terminate_node(inst.cloud_instance_id)
+    res = rec.reconcile([{"CPU": 2.0}], lambda cid: True, lambda cid: False)
+    # The dead instance was swept and demand relaunched a replacement.
+    assert res["launched"] == {"cpu4": 1}
+    assert len(provider.non_terminated_nodes()) == 1
+
+
+def test_max_workers_cap():
+    provider, rec, _ = _setup()
+    res = rec.reconcile([{"CPU": 4.0}] * 9, lambda cid: False,
+                        lambda cid: False)
+    assert sum(res["launched"].values()) == 5  # capped by max_workers

@@ -105,3 +105,36 @@ def test_usage_stats_file_written():
         payload = json.load(f)
     assert payload["ray_tpu_version"]
     assert payload["total_num_cpus"] == 4
+
+
+def test_register_custom_serializer():
+    """reference: util/serialization.py register_serializer tests."""
+    from ray_tpu.util.serialization import (
+        deregister_serializer,
+        register_serializer,
+    )
+
+    class Conn:
+        def __init__(self, address):
+            self.address = address
+            import threading
+            self.lock = threading.Lock()  # unpicklable member
+
+    try:
+        with pytest.raises(Exception):
+            ray_tpu.get(ray_tpu.put(Conn("db:5432")))
+        register_serializer(
+            Conn,
+            serializer=lambda c: c.address,
+            deserializer=lambda addr: Conn(addr),
+        )
+        out = ray_tpu.get(ray_tpu.put(Conn("db:5432")))
+        assert out.address == "db:5432"
+
+        @ray_tpu.remote
+        def probe(c):
+            return c.address
+
+        assert ray_tpu.get(probe.remote(Conn("db:1"))) == "db:1"
+    finally:
+        deregister_serializer(Conn)
