@@ -20,6 +20,12 @@ def _env(name: str, default: Any, typ: type) -> Any:
         return default
     if typ is bool:
         return raw.lower() in ("1", "true", "yes")
+    if default is None or typ in (dict, list, type(None)):
+        # Structured / optional fields come in as JSON
+        # (reference: RAY_object_spilling_config is a JSON string).
+        import json
+
+        return json.loads(raw)
     return typ(raw)
 
 

@@ -37,11 +37,13 @@ class FileSystemStorage(ExternalStorage):
     def __init__(self, directory_path: str):
         self.directory_path = directory_path
         os.makedirs(directory_path, exist_ok=True)
+        self._spilled: set[str] = set()
 
     def spill(self, object_id: str, data: memoryview) -> str:
         path = os.path.join(self.directory_path, object_id)
         with open(path, "wb") as f:
             f.write(data)
+        self._spilled.add(path)
         return path
 
     def restore(self, url: str) -> bytes:
@@ -49,20 +51,17 @@ class FileSystemStorage(ExternalStorage):
             return f.read()
 
     def delete(self, url: str) -> None:
+        self._spilled.discard(url)
         try:
             os.unlink(url)
         except OSError:
             pass
 
     def destroy(self) -> None:
-        try:
-            for name in os.listdir(self.directory_path):
-                try:
-                    os.unlink(os.path.join(self.directory_path, name))
-                except OSError:
-                    pass
-        except OSError:
-            pass
+        # Only THIS session's spill files: the directory may be shared
+        # (a user-configured path serving several clusters).
+        for path in list(self._spilled):
+            self.delete(path)
 
 
 class SmartOpenStorage(ExternalStorage):

@@ -109,7 +109,22 @@ class Reconciler:
                     inst.transition(TERMINATED)
                 continue
             if cid is None or cid not in live:
-                # Cloud lost the node under us (preemption).
+                if (inst.status == REQUESTED
+                        and time.monotonic() - inst.launch_time
+                        < self.config.launch_grace_s):
+                    # Eventually-consistent provider listing: a freshly
+                    # requested node may lag non_terminated_nodes().
+                    # Within the grace window, keep waiting instead of
+                    # declaring it preempted (which would leak the booting
+                    # VM and relaunch a duplicate).
+                    continue
+                # Cloud lost the node under us (preemption) — or the
+                # grace window expired: reclaim best-effort and drop it.
+                if cid is not None:
+                    try:
+                        self.provider.terminate_node(cid)
+                    except Exception:
+                        pass
                 inst.transition(TERMINATED)
                 continue
             if inst.status == REQUESTED and self.provider.is_running(cid):

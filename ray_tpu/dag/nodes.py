@@ -57,6 +57,29 @@ class DAGNode:
         dag.experimental_compile(), compiled_dag_node.py:806)."""
         return CompiledDAG(self)
 
+    def with_tensor_transport(self, transport: str = "auto") -> "DAGNode":
+        """Declare the tensor transport for this node's output (reference:
+        dag_node.py with_tensor_transport / with_type_hint — GPU actors
+        get NCCL p2p channels, torch_tensor_nccl_channel.py:44).
+
+        TPU-native transports:
+          - "auto"/"shm": host shared-memory object store (default; device
+            arrays are fetched to host on serialization). The in-jit
+            shard_map pipeline is the chip-to-chip fast lane — DAG edges
+            are host-level by design (see package docstring).
+          - "nccl": not applicable on TPU — raises with guidance.
+        """
+        if transport == "nccl":
+            raise ValueError(
+                "NCCL transport does not exist on TPU; chip-to-chip "
+                "movement belongs inside the jitted program (shard_map + "
+                "collectives, ray_tpu.parallel). DAG edges use host shm."
+            )
+        if transport not in ("auto", "shm"):
+            raise ValueError(f"unknown tensor transport {transport!r}")
+        self._tensor_transport = transport
+        return self
+
     def __reduce__(self):  # DAG nodes are driver-side only
         raise TypeError("DAGNode is not serializable; pass ObjectRefs instead")
 

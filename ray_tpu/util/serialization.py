@@ -25,10 +25,24 @@ def _reconstruct(deserializer: Callable, payload: Any):
     return deserializer(payload)
 
 
+# cls -> the dispatch entry (if any) that existed before registration,
+# restored on deregister so user-installed copyreg reducers survive.
+_previous_entries: dict[type, Any] = {}
+
+
 def register_serializer(cls: type, *, serializer: Callable[[Any], Any],
                         deserializer: Callable[[Any], Any]) -> None:
     """Route pickling of ``cls`` instances through ``serializer`` (must
-    return something picklable); workers rebuild via ``deserializer``."""
+    return something picklable); workers rebuild via ``deserializer``.
+
+    Scope note (design difference vs the reference, which hooks only
+    Ray's serialization context): this installs a copyreg reducer, so it
+    affects EVERY pickle of ``cls`` in this process — including
+    copy.deepcopy and user pickle.dumps. That is what makes the hook
+    work with zero receiver-side setup (the deserializer ships by value
+    inside the stream)."""
+    if cls not in _previous_entries:
+        _previous_entries[cls] = copyreg.dispatch_table.get(cls)
 
     def reducer(obj):
         return _reconstruct, (deserializer, serializer(obj))
@@ -37,4 +51,8 @@ def register_serializer(cls: type, *, serializer: Callable[[Any], Any],
 
 
 def deregister_serializer(cls: type) -> None:
-    copyreg.dispatch_table.pop(cls, None)
+    prev = _previous_entries.pop(cls, None)
+    if prev is not None:
+        copyreg.dispatch_table[cls] = prev
+    else:
+        copyreg.dispatch_table.pop(cls, None)

@@ -138,3 +138,53 @@ def test_register_custom_serializer():
         assert ray_tpu.get(probe.remote(Conn("db:1"))) == "db:1"
     finally:
         deregister_serializer(Conn)
+
+
+def test_dask_graph_scheduler():
+    """reference: util/dask scheduler tests (dask protocol graphs are
+    plain dicts — executable without dask installed)."""
+    from operator import add, mul
+
+    from ray_tpu.util.dask import ray_dask_get
+
+    dsk = {
+        "x": 1,
+        "y": (add, "x", 2),
+        "z": (mul, "y", "y"),
+        "w": (add, "z", (add, "x", "x")),  # nested task
+    }
+    assert ray_dask_get(dsk, ["z"]) == [9]
+    assert ray_dask_get(dsk, ["w", "y"]) == [11, 3]
+    assert ray_dask_get(dsk, [["z", "y"]]) == [[9, 3]]
+
+    with pytest.raises(ValueError, match="cycle"):
+        ray_dask_get({"a": (add, "b", 1), "b": (add, "a", 1)}, ["a"])
+
+
+def test_dask_enable_gates():
+    try:
+        import dask  # noqa: F401
+        pytest.skip("dask installed")
+    except ImportError:
+        pass
+    from ray_tpu.util.dask import enable_dask_on_ray
+
+    with pytest.raises(ImportError, match="dask"):
+        enable_dask_on_ray()
+
+
+def test_with_tensor_transport_shim():
+    """reference: dag_node.with_tensor_transport — TPU-native semantics."""
+    @ray_tpu.remote
+    class A:
+        def f(self, x):
+            return x + 1
+
+    a = A.remote()
+    node = a.f.bind(2).with_tensor_transport("auto")
+    assert ray_tpu.get(node.execute()) == 3
+    with pytest.raises(ValueError, match="NCCL"):
+        a.f.bind(1).with_tensor_transport("nccl")
+    with pytest.raises(ValueError, match="unknown"):
+        a.f.bind(1).with_tensor_transport("carrier-pigeon")
+    ray_tpu.kill(a)
