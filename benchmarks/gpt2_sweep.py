@@ -1,0 +1,74 @@
+"""GPT-2 training-throughput sweep (run on the real TPU).
+
+Explores the headline-bench knobs around the tuned v5e config
+(bench.py: batch 24, no-remat, unrolled, bf16 attention buffers,
+chunked CE): vocab padding to an MXU-friendly multiple, CE chunk size,
+batch size. Prints one JSON line per config; feed the winner back into
+bench.py.
+
+    python benchmarks/gpt2_sweep.py [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import jax
+import optax
+
+
+def run(batch=24, seq=1024, steps=10, **cfg_kw):
+    from ray_tpu import models
+
+    cfg = models.gpt2_small(max_seq_len=seq, remat=False, scan_layers=False,
+                            **cfg_kw)
+    opt = optax.chain(optax.clip_by_global_norm(1.0),
+                      optax.adamw(3e-4, weight_decay=0.1))
+    state = models.init_train_state(jax.random.PRNGKey(0), cfg, opt)
+    step = jax.jit(models.make_train_step(cfg, opt), donate_argnums=(0,))
+    # Tokens drawn from the REAL GPT-2 vocab regardless of padding.
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq + 1), 0,
+                                50257)
+    b = {"tokens": tokens}
+    try:
+        for _ in range(2):
+            state, m = step(state, b)
+            float(m["loss"])
+        t0 = time.time()
+        for _ in range(steps):
+            state, m = step(state, b)
+        float(m["loss"])
+        return batch * seq * steps / (time.time() - t0)
+    except Exception as e:  # noqa: BLE001 - sweep must survive OOM configs
+        return f"FAIL {type(e).__name__}: {str(e)[:100]}"
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--quick", action="store_true")
+    args = p.parse_args()
+
+    grid = [
+        dict(loss_chunk=4096),                       # current bench config
+        dict(loss_chunk=4096, vocab_size=50304),     # pad to 128-multiple
+        dict(loss_chunk=8192, vocab_size=50304),
+        dict(loss_chunk=2048, vocab_size=50304),
+        dict(batch=28, loss_chunk=4096, vocab_size=50304),
+        dict(batch=20, loss_chunk=4096, vocab_size=50304),
+    ]
+    if args.quick:
+        grid = grid[:2]
+    best = None
+    for kw in grid:
+        r = run(**kw)
+        print(json.dumps({**kw, "tok_s": r}), flush=True)
+        if isinstance(r, float) and (best is None or r > best[1]):
+            best = (kw, r)
+    if best:
+        print(json.dumps({"best": best[0], "tok_s": best[1]}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,109 @@
+"""Core-op microbenchmarks.
+
+Counterpart of the reference's microbenchmark
+(reference: python/ray/_private/ray_perf.py:93 main() — timeit'd single/
+multi client task throughput, actor calls, put/get, driven by
+release/microbenchmark/run_microbenchmark.py). Run:
+
+    python benchmarks/microbenchmark.py [--json]
+
+Prints one line per op; --json emits a single JSON dict (the shape the
+release pipeline records).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+import ray_tpu
+
+
+def timeit(name: str, fn, multiplier: int = 1, *, results: dict,
+           min_time_s: float = 1.0) -> None:
+    # Warmup pass, then measure whole-loop wall time (reference:
+    # ray_perf.py timeit).
+    fn()
+    start = time.perf_counter()
+    count = 0
+    while time.perf_counter() - start < min_time_s:
+        fn()
+        count += 1
+    dt = time.perf_counter() - start
+    rate = count * multiplier / dt
+    results[name] = rate
+    print(f"{name}: {rate:,.0f} /s  (count={count} dt={dt:.2f}s)")
+
+
+def main(as_json: bool = False) -> dict:
+    ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024 * 1024,
+                 log_to_driver=False)
+    results: dict[str, float] = {}
+
+    @ray_tpu.remote
+    def small_task():
+        return b"ok"
+
+    # Warm the whole worker pool first (reference: ray_perf warms up
+    # before timing) — otherwise the first timed wave measures worker
+    # process spawn + import, not steady-state dispatch.
+    ray_tpu.get([small_task.remote() for _ in range(64)])
+
+    # single client task sync throughput
+    timeit("single client tasks sync",
+           lambda: ray_tpu.get(small_task.remote()), results=results)
+
+    # batched async submission
+    N = 100
+    timeit("single client tasks async",
+           lambda: ray_tpu.get([small_task.remote() for _ in range(N)]),
+           N, results=results)
+
+    # put/get small
+    timeit("single client put sync",
+           lambda: ray_tpu.put(b"x" * 100), results=results)
+    ref_small = ray_tpu.put(b"y" * 100)
+    timeit("single client get sync",
+           lambda: ray_tpu.get(ref_small), results=results)
+
+    # put/get 1 MiB numpy (zero-copy path)
+    arr = np.random.rand(128, 1024)  # 1 MiB
+    timeit("single client put 1MiB",
+           lambda: ray_tpu.put(arr), results=results)
+    ref_big = ray_tpu.put(arr)
+    timeit("single client get 1MiB",
+           lambda: ray_tpu.get(ref_big), results=results)
+
+    # actor call throughput
+    @ray_tpu.remote
+    class Echo:
+        def ping(self, x=None):
+            return x
+
+    actor = Echo.remote()
+    timeit("single client actor calls sync",
+           lambda: ray_tpu.get(actor.ping.remote()), results=results)
+    timeit("single client actor calls async",
+           lambda: ray_tpu.get([actor.ping.remote() for _ in range(N)]),
+           N, results=results)
+
+    # actor arg passing by reference
+    timeit("actor calls with 1MiB arg (by ref)",
+           lambda: ray_tpu.get(actor.ping.remote(ref_big)),
+           results=results)
+
+    ray_tpu.kill(actor)
+    ray_tpu.shutdown()
+    if as_json:
+        print(json.dumps({"microbenchmark": results}))
+    return results
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--json", action="store_true")
+    args = p.parse_args()
+    main(as_json=args.json)

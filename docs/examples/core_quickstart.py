@@ -1,0 +1,42 @@
+"""Quickstart: tasks, actors, objects (doc-code; reference analogue:
+doc/source/ray-core/doc_code/getting_started.py)."""
+
+import numpy as np
+
+import ray_tpu
+
+ray_tpu.init(num_cpus=2, object_store_memory=64 * 1024 * 1024)
+
+# Tasks: decorated functions run on cluster workers.
+@ray_tpu.remote
+def square(x):
+    return x * x
+
+futures = [square.remote(i) for i in range(4)]
+assert ray_tpu.get(futures) == [0, 1, 4, 9]
+
+# Objects: put once, pass by reference.
+big = ray_tpu.put(np.arange(1_000_000))
+
+@ray_tpu.remote
+def total(arr):
+    return int(arr.sum())
+
+assert ray_tpu.get(total.remote(big)) == 499999500000
+
+# Actors: stateful workers.
+@ray_tpu.remote
+class Counter:
+    def __init__(self):
+        self.n = 0
+
+    def add(self, k=1):
+        self.n += k
+        return self.n
+
+c = Counter.remote()
+ray_tpu.get(c.add.remote())
+assert ray_tpu.get(c.add.remote(10)) == 11
+
+ray_tpu.shutdown()
+print("OK")

@@ -1,0 +1,25 @@
+"""Dataset pipeline doc-code (reference analogue:
+doc/source/data/doc_code/quick_start.py)."""
+
+import ray_tpu
+import ray_tpu.data as rdata
+
+ray_tpu.init(num_cpus=2, object_store_memory=64 * 1024 * 1024)
+
+ds = (
+    rdata.range(1000)
+    .map(lambda row: {"id": row["id"], "sq": row["id"] ** 2})
+    .filter(lambda row: row["id"] % 2 == 0)
+)
+assert ds.count() == 500
+assert ds.take(2)[1]["sq"] == 4
+
+# Split across trainers.
+shards = ds.split(2)
+assert sum(s.count() for s in shards) == 500
+
+# Aggregations.
+assert rdata.range(10).sum("id") == 45
+
+ray_tpu.shutdown()
+print("OK")

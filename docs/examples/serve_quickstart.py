@@ -1,0 +1,31 @@
+"""Serving doc-code (reference analogue:
+doc/source/serve/doc_code/quickstart.py)."""
+
+import json
+import urllib.request
+
+import ray_tpu
+from ray_tpu import serve
+
+ray_tpu.init(num_cpus=2, object_store_memory=64 * 1024 * 1024)
+
+@serve.deployment
+class Hello:
+    def __call__(self, name):
+        return {"hello": name}
+
+handle = serve.run(Hello.bind(), proxy=True)
+assert handle.remote("tpu").result() == {"hello": "tpu"}
+
+port = serve.get_proxy_port()
+body = json.dumps("world").encode()
+req = urllib.request.Request(
+    f"http://127.0.0.1:{port}/", data=body,
+    headers={"Content-Type": "application/json"},
+)
+with urllib.request.urlopen(req, timeout=30) as r:
+    assert json.loads(r.read()) == {"hello": "world"}
+
+serve.shutdown()
+ray_tpu.shutdown()
+print("OK")

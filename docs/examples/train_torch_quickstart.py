@@ -1,0 +1,35 @@
+"""Distributed training doc-code (reference analogue:
+doc/source/train/doc_code/torch_quickstart.py — gloo DDP here)."""
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu import train
+from ray_tpu.train import ScalingConfig
+from ray_tpu.train.torch import TorchTrainer, prepare_model
+
+ray_tpu.init(num_cpus=4, object_store_memory=64 * 1024 * 1024)
+
+def train_loop(config):
+    import torch
+
+    model = prepare_model(torch.nn.Linear(4, 1))
+    opt = torch.optim.SGD(model.parameters(), lr=0.1)
+    rng = np.random.default_rng(train.get_context().get_world_rank())
+    for epoch in range(3):
+        X = torch.as_tensor(rng.standard_normal((64, 4)), dtype=torch.float32)
+        y = X.sum(dim=1, keepdim=True)
+        loss = torch.nn.functional.mse_loss(model(X), y)
+        opt.zero_grad()
+        loss.backward()
+        opt.step()
+        train.report({"epoch": epoch, "loss": float(loss)})
+
+result = TorchTrainer(
+    train_loop, scaling_config=ScalingConfig(num_workers=2)
+).fit()
+assert result.error is None
+assert result.metrics["epoch"] == 2
+
+ray_tpu.shutdown()
+print("OK")

@@ -1,0 +1,21 @@
+"""Tune doc-code (reference analogue:
+doc/source/tune/doc_code/key_concepts.py)."""
+
+import ray_tpu
+from ray_tpu import tune
+
+ray_tpu.init(num_cpus=2, object_store_memory=64 * 1024 * 1024)
+
+def objective(config):
+    for step in range(5):
+        tune.report({"score": config["a"] * step})
+
+grid = tune.Tuner(
+    objective,
+    param_space={"a": tune.grid_search([1, 2, 3])},
+    tune_config=tune.TuneConfig(metric="score", mode="max"),
+).fit()
+assert grid.get_best_result().config["a"] == 3
+
+ray_tpu.shutdown()
+print("OK")

@@ -16,6 +16,14 @@ from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig, vtrace
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
 from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
 from ray_tpu.rllib.replay_buffer import ReplayBuffer
+from ray_tpu.rllib.connectors import (
+    ClipRewards,
+    ConnectorPipelineV2,
+    ConnectorV2,
+    FlattenObservations,
+    LambdaConnector,
+    NormalizeObservations,
+)
 from ray_tpu.rllib.core.learner import JaxLearner, LearnerGroup
 from ray_tpu.rllib.core.rl_module import (
     DiscreteActorCriticModule,
@@ -49,6 +57,12 @@ __all__ = [
     "ReplayBuffer",
     "SAC",
     "SACConfig",
+    "ClipRewards",
+    "ConnectorPipelineV2",
+    "ConnectorV2",
+    "FlattenObservations",
+    "LambdaConnector",
+    "NormalizeObservations",
     "DiscreteActorCriticModule",
     "EnvRunnerGroup",
     "IMPALA",

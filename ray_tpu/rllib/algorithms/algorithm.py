@@ -49,6 +49,10 @@ class AlgorithmConfig:
         self.continuous = False
         self.action_low: Any = None
         self.action_high: Any = None
+        # connectors v2 (reference: AlgorithmConfig.env_to_module_connector
+        # / learner_connector — rllib/connectors/)
+        self.env_to_module_connector = None
+        self.learner_connector = None
         # multi-agent (reference: AlgorithmConfig.multi_agent,
         # rllib/algorithms/algorithm_config.py)
         self.policies: dict | None = None
@@ -72,7 +76,10 @@ class AlgorithmConfig:
     def env_runners(self, *, num_env_runners: int | None = None,
                     num_envs_per_env_runner: int | None = None,
                     rollout_fragment_length: int | None = None,
-                    num_cpus_per_env_runner: float | None = None) -> "AlgorithmConfig":
+                    num_cpus_per_env_runner: float | None = None,
+                    env_to_module_connector=None) -> "AlgorithmConfig":
+        if env_to_module_connector is not None:
+            self.env_to_module_connector = env_to_module_connector
         if num_env_runners is not None:
             self.num_env_runners = num_env_runners
         if num_envs_per_env_runner is not None:
@@ -254,6 +261,7 @@ class Algorithm(Trainable):
 
     config_class: Type[AlgorithmConfig] = AlgorithmConfig
     supports_multi_agent: bool = False
+    supports_learner_connector: bool = False
 
     def __init__(self, config: AlgorithmConfig | dict | None = None, trial_dir: str | None = None):
         if isinstance(config, dict):
@@ -269,6 +277,13 @@ class Algorithm(Trainable):
             raise ValueError(
                 f"{type(self).__name__} does not support multi-agent "
                 f"training; use PPO or drop .multi_agent() from the config"
+            )
+        if (config.learner_connector is not None
+                and not self.supports_learner_connector):
+            raise ValueError(
+                f"{type(self).__name__} does not apply learner connectors; "
+                f"currently supported by PPO. Preprocess the data in your "
+                f"env or module instead."
             )
         config._infer_spaces()
         self.algo_config = config
@@ -316,12 +331,19 @@ class Algorithm(Trainable):
 
     def save_checkpoint(self, checkpoint_dir: str) -> None:
         state = self.learner_group.get_state()
+        payload = {
+            "learner": state,
+            "iteration": self.iteration,
+            "extra": self.get_extra_state(),
+        }
+        group = getattr(self, "env_runner_group", None)
+        if group is not None and hasattr(group, "get_connector_state"):
+            # Env-to-module connector stats (running normalizers) are part
+            # of the trained artifact: the policy expects inputs scaled by
+            # the converged statistics.
+            payload["connector_state"] = group.get_connector_state()
         with open(os.path.join(checkpoint_dir, "algo_state.pkl"), "wb") as f:
-            pickle.dump({
-                "learner": state,
-                "iteration": self.iteration,
-                "extra": self.get_extra_state(),
-            }, f)
+            pickle.dump(payload, f)
 
     def load_checkpoint(self, checkpoint_dir: str) -> None:
         with open(os.path.join(checkpoint_dir, "algo_state.pkl"), "rb") as f:
@@ -330,6 +352,10 @@ class Algorithm(Trainable):
         self.iteration = state["iteration"]
         if state.get("extra"):
             self.set_extra_state(state["extra"])
+        group = getattr(self, "env_runner_group", None)
+        if (state.get("connector_state") is not None and group is not None
+                and hasattr(group, "set_connector_state")):
+            group.set_connector_state(state["connector_state"])
 
     def get_weights(self):
         return self.learner_group.get_weights()

@@ -88,6 +88,7 @@ def make_ppo_loss(cfg: PPOConfig):
 class PPO(Algorithm):
     config_class = PPOConfig
     supports_multi_agent = True
+    supports_learner_connector = True
 
     def build_learner(self, cfg: PPOConfig) -> None:
         from ray_tpu.rllib.core.learner import make_optimizer
@@ -96,6 +97,10 @@ class PPO(Algorithm):
         loss_fn = make_ppo_loss(cfg)
         mesh = cfg.mesh
         seed = cfg.seed
+
+        from ray_tpu.rllib.connectors import build_pipeline
+
+        self._learner_pipe = build_pipeline(cfg.learner_connector)
 
         if cfg.is_multi_agent:
             from ray_tpu.rllib.env.multi_agent import MultiAgentLearnerGroup
@@ -188,6 +193,8 @@ class PPO(Algorithm):
             frags = self.env_runner_group.sample_fragments(weights)
             for mid, flist in frags.items():
                 for f in flist:
+                    if self._learner_pipe is not None:
+                        f = self._learner_pipe(f)  # before GAE, like SA path
                     per_module.setdefault(mid, []).append(
                         self._postprocess_fragment(
                             f, self._value_fns[mid], jweights[mid]
@@ -213,6 +220,8 @@ class PPO(Algorithm):
         total = 0
         while total < cfg.train_batch_size:
             for b in self.env_runner_group.sample_batches(weights):
+                if self._learner_pipe is not None:
+                    b = self._learner_pipe(b)
                 batches.append(self._postprocess(b, weights))
                 total += len(b)
         batch = SampleBatch.concat_samples(batches)

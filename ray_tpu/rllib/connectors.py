@@ -1,0 +1,179 @@
+"""Connectors v2: composable batch/observation transform pipelines.
+
+Counterpart of the reference's connector framework
+(reference: rllib/connectors/ — ConnectorV2 base connector_v2.py,
+env-to-module pipelines applied by EnvRunners before the RLModule
+forward, learner pipelines applied to train batches before the update;
+wired via AlgorithmConfig.env_to_module_connector /
+learner_connector). Same two hook points here:
+
+- env-to-module: SingleAgentEnvRunner passes raw vector observations
+  through the pipeline before every forward call; the transformed
+  observations are what land in the sample batch.
+- learner: algorithms pass each rollout batch through the pipeline
+  BEFORE advantage postprocessing (so e.g. reward clipping shapes GAE
+  too) and before the jitted update.
+
+Connectors are host-side numpy transforms — exactly the work that should
+NOT live inside the jitted step (dynamic shapes, python logic), which is
+why the pipeline sits at the host/XLA boundary.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import numpy as np
+
+
+class ConnectorV2:
+    """One transform stage (reference: connectors/connector_v2.py)."""
+
+    def __call__(self, data: Any, **kwargs) -> Any:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        """Hook for connectors carrying episode-scoped state. The built-in
+        vectorized runner uses same-step autoreset and shares one pipeline
+        across envs, so it never calls this — custom sequential runners
+        may; built-in connectors keep running (episode-agnostic) state."""
+
+
+class ConnectorPipelineV2(ConnectorV2):
+    """Ordered composition (reference: connector_pipeline_v2.py)."""
+
+    def __init__(self, connectors: Sequence[ConnectorV2] = ()):
+        self.connectors = list(connectors)
+
+    def __call__(self, data: Any, **kwargs) -> Any:
+        for c in self.connectors:
+            data = c(data, **kwargs)
+        return data
+
+    def reset(self) -> None:
+        for c in self.connectors:
+            c.reset()
+
+    def append(self, connector: ConnectorV2) -> "ConnectorPipelineV2":
+        self.connectors.append(connector)
+        return self
+
+    def prepend(self, connector: ConnectorV2) -> "ConnectorPipelineV2":
+        self.connectors.insert(0, connector)
+        return self
+
+    def get_state(self) -> list:
+        return [c.get_state() if hasattr(c, "get_state") else None
+                for c in self.connectors]
+
+    def set_state(self, states: list) -> None:
+        if len(states) != len(self.connectors):
+            raise ValueError(
+                f"connector state has {len(states)} entries but the "
+                f"pipeline has {len(self.connectors)} connectors — the "
+                f"pipeline changed since the checkpoint was written"
+            )
+        for c, s in zip(self.connectors, states):
+            if s is not None and hasattr(c, "set_state"):
+                c.set_state(s)
+
+
+class LambdaConnector(ConnectorV2):
+    """Wrap a plain function as a connector."""
+
+    def __init__(self, fn: Callable[[Any], Any]):
+        self.fn = fn
+
+    def __call__(self, data: Any, **kwargs) -> Any:
+        return self.fn(data)
+
+
+class FlattenObservations(ConnectorV2):
+    """[B, ...] -> [B, prod(...)] (reference:
+    connectors/env_to_module/flatten_observations.py)."""
+
+    def __call__(self, obs: np.ndarray, **kwargs) -> np.ndarray:
+        obs = np.asarray(obs, np.float32)
+        return obs.reshape(obs.shape[0], -1)
+
+
+class NormalizeObservations(ConnectorV2):
+    """Running mean/std normalization (reference:
+    connectors/env_to_module/mean_std_filter.py — per-runner running
+    filter, like the reference's MeanStdFilter; stats are checkpointed
+    through the runner's connector state and seeded onto restored
+    runners; concurrent runners accumulate independently, as in the
+    reference without explicit filter syncing)."""
+
+    def __init__(self, epsilon: float = 1e-8, clip: float | None = 10.0):
+        self.eps = epsilon
+        self.clip = clip
+        self._count = 0.0
+        self._mean: np.ndarray | None = None
+        self._m2: np.ndarray | None = None
+
+    def __call__(self, obs: np.ndarray, *, update: bool = True, **kwargs):
+        obs = np.asarray(obs, np.float32)
+        if self._mean is None:
+            self._mean = np.zeros(obs.shape[1:], np.float64)
+            self._m2 = np.zeros(obs.shape[1:], np.float64)
+        if update:
+            # Chan's parallel update: fold the whole [B, ...] block in one
+            # vectorized step (no per-row Python loop on the hot path).
+            block = obs.reshape(-1, *self._mean.shape).astype(np.float64)
+            n_b = float(block.shape[0])
+            if n_b > 0:
+                mean_b = block.mean(axis=0)
+                m2_b = ((block - mean_b) ** 2).sum(axis=0)
+                delta = mean_b - self._mean
+                total = self._count + n_b
+                self._mean += delta * (n_b / total)
+                self._m2 += m2_b + delta**2 * (self._count * n_b / total)
+                self._count = total
+        var = self._m2 / max(self._count, 1.0)
+        out = (obs - self._mean) / np.sqrt(var + self.eps)
+        if self.clip is not None:
+            out = np.clip(out, -self.clip, self.clip)
+        return out.astype(np.float32)
+
+    def get_state(self) -> dict:
+        return {"count": self._count, "mean": self._mean, "m2": self._m2}
+
+    def set_state(self, state: dict) -> None:
+        self._count = state["count"]
+        self._mean = state["mean"]
+        self._m2 = state["m2"]
+
+
+class ClipRewards(ConnectorV2):
+    """Learner-side reward clipping (reference:
+    connectors/learner/... reward clipping used by Atari configs)."""
+
+    def __init__(self, limit: float = 1.0):
+        self.limit = limit
+
+    def __call__(self, batch, **kwargs):
+        from ray_tpu.rllib.sample_batch import REWARDS
+
+        if REWARDS in batch:
+            batch[REWARDS] = np.clip(batch[REWARDS], -self.limit, self.limit)
+        return batch
+
+
+def build_pipeline(spec) -> ConnectorPipelineV2 | None:
+    """Normalize user input: None | callable-factory | connector |
+    list-of-connectors -> pipeline."""
+    if spec is None:
+        return None
+    if isinstance(spec, ConnectorPipelineV2):
+        return spec
+    if isinstance(spec, ConnectorV2):
+        return ConnectorPipelineV2([spec])
+    if callable(spec):  # factory (reference passes factories for actors)
+        return build_pipeline(spec())
+    if isinstance(spec, (list, tuple)):
+        return ConnectorPipelineV2([
+            c if isinstance(c, ConnectorV2) else LambdaConnector(c)
+            for c in spec
+        ])
+    raise TypeError(f"cannot build a connector pipeline from {spec!r}")

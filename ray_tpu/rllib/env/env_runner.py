@@ -93,7 +93,14 @@ class SingleAgentEnvRunner:
             [_make_env_fn(config.env) for _ in range(self.num_envs)], seed=seed
         )
         self.module = config.rl_module_spec().build(seed=seed)
-        self.obs = self.vec.reset()
+        # env-to-module connector pipeline (reference: EnvRunner applying
+        # the env_to_module connector before the RLModule forward;
+        # transformed observations are what lands in the sample batch).
+        from ray_tpu.rllib.connectors import build_pipeline
+
+        self._obs_pipe = build_pipeline(
+            getattr(config, "env_to_module_connector", None))
+        self.obs = self._connect(self.vec.reset())
         self._rng = np.random.default_rng(seed)
         # Per-env running episode stats.
         self._ep_return = np.zeros(self.num_envs, np.float64)
@@ -103,11 +110,23 @@ class SingleAgentEnvRunner:
 
     # ------------------------------------------------------------------
 
+    def _connect(self, obs: np.ndarray, update: bool = True) -> np.ndarray:
+        if self._obs_pipe is None:
+            return obs
+        return np.asarray(self._obs_pipe(obs, update=update))
+
     def set_weights(self, weights) -> None:
         self.module.set_weights(weights)
 
     def get_weights(self):
         return self.module.get_weights()
+
+    def get_connector_state(self):
+        return self._obs_pipe.get_state() if self._obs_pipe else None
+
+    def set_connector_state(self, state) -> None:
+        if self._obs_pipe is not None and state is not None:
+            self._obs_pipe.set_state(state)
 
     def sample(self, weights=None) -> SampleBatch:
         """One rollout of [T, B] transitions, flattened to [T*B] with GAE
@@ -142,16 +161,22 @@ class SingleAgentEnvRunner:
             vf_buf[t] = out[VF_PREDS]
             logits_buf[t] = logits
             next_obs, rewards, terms, truncs, final_obs = self.vec.step(actions)
+            t_next = self._connect(next_obs)
             # Bootstrapping for truncated (time-limit) episodes uses the
-            # true terminal observation, not the post-reset one.
-            next_for_value = next_obs.copy()
-            for i, fo in enumerate(final_obs):
-                if fo is not None:
-                    next_for_value[i] = fo
+            # true terminal observation, not the post-reset one. Terminal
+            # rows run through the connector without updating its running
+            # stats (their values were never acted on).
+            next_for_value = t_next.copy()
+            done_idx = [i for i, fo in enumerate(final_obs) if fo is not None]
+            if done_idx:
+                finals = self._connect(
+                    np.stack([final_obs[i] for i in done_idx]), update=False)
+                for j, i in enumerate(done_idx):
+                    next_for_value[i] = finals[j]
             rew_buf[t], term_buf[t], trunc_buf[t] = rewards, terms, truncs
             next_obs_buf[t] = next_for_value
             self._track_episodes(rewards, terms, truncs)
-            self.obs = next_obs
+            self.obs = t_next
 
         flat = lambda a: a.reshape((T * B,) + a.shape[2:])  # noqa: E731
         return SampleBatch(
@@ -196,15 +221,19 @@ class SingleAgentEnvRunner:
                     extra_bufs[k] = np.empty((T,) + v.shape, v.dtype)
                 extra_bufs[k][t] = v
             next_obs, rewards, terms, truncs, final_obs = self.vec.step(actions)
-            next_for_value = next_obs.copy()
-            for i, fo in enumerate(final_obs):
-                if fo is not None:
-                    next_for_value[i] = fo
+            t_next = self._connect(next_obs)
+            next_for_value = t_next.copy()
+            done_idx = [i for i, fo in enumerate(final_obs) if fo is not None]
+            if done_idx:
+                finals = self._connect(
+                    np.stack([final_obs[i] for i in done_idx]), update=False)
+                for j, i in enumerate(done_idx):
+                    next_for_value[i] = finals[j]
             obs_buf[t] = self.obs
             rew_buf[t], term_buf[t], trunc_buf[t] = rewards, terms, truncs
             next_obs_buf[t] = next_for_value
             self._track_episodes(rewards, terms, truncs)
-            self.obs = next_obs
+            self.obs = t_next
 
         flat = lambda a: a.reshape((T * B,) + a.shape[2:])  # noqa: E731
         out = SampleBatch({
@@ -339,6 +368,28 @@ class EnvRunnerGroup:
         else:
             per = ray_tpu.get([r.get_metrics.remote() for r in self.remote_runners])
         return merge_episode_metrics(per)
+
+    def get_connector_state(self):
+        """First runner's pipeline state (checkpoint representative)."""
+        import ray_tpu
+
+        if self.local_runner is not None:
+            return self.local_runner.get_connector_state()
+        if self.remote_runners:
+            return ray_tpu.get(self.remote_runners[0].get_connector_state.remote())
+        return None
+
+    def set_connector_state(self, state) -> None:
+        """Seed every runner's pipeline (restore path)."""
+        import ray_tpu
+
+        if state is None:
+            return
+        if self.local_runner is not None:
+            self.local_runner.set_connector_state(state)
+        else:
+            ray_tpu.get([r.set_connector_state.remote(state)
+                         for r in self.remote_runners])
 
     def stop(self) -> None:
         import ray_tpu

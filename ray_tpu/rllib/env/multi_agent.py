@@ -144,6 +144,12 @@ class MultiAgentEnvRunner:
         env_fn = config.env if callable(config.env) else None
         if env_fn is None:
             raise TypeError("multi-agent env must be a callable returning MultiAgentEnv")
+        if getattr(config, "env_to_module_connector", None) is not None:
+            raise NotImplementedError(
+                "env_to_module_connector is not yet supported by the "
+                "multi-agent runner (per-module pipelines pending); "
+                "preprocess observations in the env"
+            )
         self.envs = [env_fn() for _ in range(self.num_envs)]
         self.mapping_fn: Callable = config.policy_mapping_fn
         specs = config.rl_module_specs()

@@ -11,6 +11,7 @@ scheduler=ray_dask_get)``.
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Any, Hashable, Mapping
 
 import ray_tpu
@@ -20,14 +21,23 @@ def _is_task(v: Any) -> bool:
     return isinstance(v, tuple) and len(v) > 0 and callable(v[0])
 
 
+@dataclasses.dataclass
+class _Nested:
+    """A nested task shipped INSIDE its parent ray task: evaluated on the
+    worker at materialization (dask semantics — nested tuples are not
+    separate graph nodes), never inline on the driver."""
+
+    fn: Any
+    args: list
+
+
 def _resolve(expr: Any, refs: dict):
-    """Rewrite graph keys inside args to their computed ObjectRefs."""
-    if isinstance(expr, (list, tuple)) and not _is_task(expr):
-        return type(expr)(_resolve(e, refs) for e in expr)
+    """Rewrite graph keys to ObjectRefs and nested tasks to _Nested."""
     if _is_task(expr):
-        # Nested task: execute inline at materialization (dask semantics).
         fn, *args = expr
-        return fn(*[_materialize(_resolve(a, refs)) for a in args])
+        return _Nested(fn, [_resolve(a, refs) for a in args])
+    if isinstance(expr, (list, tuple)):
+        return type(expr)(_resolve(e, refs) for e in expr)
     if isinstance(expr, Hashable) and expr in refs:
         return refs[expr]
     return expr
@@ -38,6 +48,8 @@ def _materialize(v: Any):
 
     if isinstance(v, ObjectRef):
         return ray_tpu.get(v)
+    if isinstance(v, _Nested):
+        return v.fn(*[_materialize(a) for a in v.args])
     if isinstance(v, (list, tuple)):
         return type(v)(_materialize(x) for x in v)
     return v
@@ -55,35 +67,42 @@ def ray_dask_get(dsk: Mapping, keys, **kwargs):
         ray_dask_get(dsk, ["z"])  ->  [9]
     """
     remote_run = ray_tpu.remote(_run_task)
+    # Standard Kahn: dependency sets computed once, ready-queue driven —
+    # O(V + E) submission.
+    deps = {k: _graph_deps(v, dsk) for k, v in dsk.items()}
+    dependents: dict = {k: set() for k in dsk}
+    for k, ds in deps.items():
+        for d in ds:
+            dependents[d].add(k)
+    missing = {k for k, ds in deps.items() if k in ds}
+    ready = [k for k, ds in deps.items() if not ds]
     refs: dict = {}
-    # Kahn-style topological submission over the graph dict.
-    pending = dict(dsk)
-    while pending:
-        progressed = False
-        for key in list(pending):
-            expr = pending[key]
-            deps = _graph_deps(expr, dsk)
-            # A self-dependency is a cycle like any other: no exclusion.
-            if any(d in pending for d in deps):
-                continue
-            if _is_task(expr):
-                fn, *args = expr
-                refs[key] = remote_run.remote(
-                    fn, *[_resolve(a, refs) for a in args]
-                )
-            else:
-                refs[key] = _resolve(expr, refs)
-            del pending[key]
-            progressed = True
-        if not progressed:
-            raise ValueError(
-                f"dask graph has a cycle or missing keys: {sorted(pending)}"
-            )
+    submitted = 0
+    while ready:
+        key = ready.pop()
+        expr = dsk[key]
+        if _is_task(expr):
+            fn, *args = expr
+            refs[key] = remote_run.remote(fn, *[_resolve(a, refs) for a in args])
+        else:
+            refs[key] = _resolve(expr, refs)
+        submitted += 1
+        for child in dependents[key]:
+            deps[child].discard(key)
+            if not deps[child]:
+                ready.append(child)
+    if submitted != len(dsk):
+        unsubmitted = sorted(k for k in dsk if k not in refs)
+        raise ValueError(
+            f"dask graph has a cycle or missing keys: {unsubmitted or sorted(missing)}"
+        )
 
     def fetch(k):
         if isinstance(k, list):
             return [fetch(x) for x in k]
-        return _materialize(refs[k] if k in refs else k)
+        if k not in refs:
+            raise KeyError(f"requested key {k!r} is not in the graph")
+        return _materialize(refs[k])
 
     return [fetch(k) for k in keys]
 

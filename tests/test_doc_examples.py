@@ -1,0 +1,27 @@
+"""Doc-code runs green in CI (reference: SURVEY.md §4 "doc tests" —
+runnable snippets under doc/source/*/doc_code executed in CI)."""
+
+import glob
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLES = sorted(glob.glob(os.path.join(REPO, "docs", "examples", "*.py")))
+
+
+@pytest.mark.parametrize("path", EXAMPLES, ids=[os.path.basename(p) for p in EXAMPLES])
+def test_doc_example_runs(path):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    out = subprocess.run([sys.executable, path], capture_output=True,
+                         text=True, timeout=300, env=env)
+    assert out.returncode == 0, (out.stdout[-1500:], out.stderr[-2500:])
+    assert "OK" in out.stdout
+
+
+def test_examples_exist():
+    assert len(EXAMPLES) >= 4

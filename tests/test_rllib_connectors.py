@@ -1,0 +1,76 @@
+"""Connectors v2 (reference: rllib/connectors — env-to-module + learner
+pipelines, mean-std filter, reward clipping)."""
+
+import numpy as np
+import pytest
+
+from ray_tpu.rllib import (
+    ClipRewards,
+    ConnectorPipelineV2,
+    FlattenObservations,
+    LambdaConnector,
+    NormalizeObservations,
+    PPOConfig,
+)
+from ray_tpu.rllib.connectors import build_pipeline
+from ray_tpu.rllib.sample_batch import REWARDS, OBS, SampleBatch
+
+
+def test_pipeline_composition_and_builders():
+    pipe = build_pipeline([lambda x: x + 1, lambda x: x * 2])
+    assert pipe(np.array([1.0]))[0] == 4.0
+    assert build_pipeline(None) is None
+    single = build_pipeline(FlattenObservations())
+    assert isinstance(single, ConnectorPipelineV2)
+    factory = build_pipeline(lambda: [FlattenObservations()])
+    assert isinstance(factory, ConnectorPipelineV2)
+    with pytest.raises(TypeError):
+        build_pipeline(42)
+
+
+def test_flatten_and_normalize():
+    flat = FlattenObservations()
+    out = flat(np.zeros((3, 2, 4)))
+    assert out.shape == (3, 8)
+
+    norm = NormalizeObservations(clip=5.0)
+    rng = np.random.default_rng(0)
+    data = rng.normal(3.0, 2.0, size=(500, 4)).astype(np.float32)
+    for i in range(0, 500, 50):
+        out = norm(data[i:i + 50])
+    # After enough samples the output distribution is ~standardized.
+    assert abs(float(out.mean())) < 0.3
+    assert 0.5 < float(out.std()) < 1.6
+    # update=False must not move the stats.
+    state = norm.get_state()
+    norm(np.full((10, 4), 100.0, np.float32), update=False)
+    assert norm.get_state()["count"] == state["count"]
+
+
+def test_clip_rewards_connector():
+    batch = SampleBatch({REWARDS: np.array([-5.0, 0.3, 7.0])})
+    out = ClipRewards(1.0)(batch)
+    np.testing.assert_allclose(out[REWARDS], [-1.0, 0.3, 1.0])
+
+
+def test_ppo_with_connectors_learns():
+    algo = (
+        PPOConfig()
+        .environment(env="CartPole-v1")
+        .env_runners(num_envs_per_env_runner=8, rollout_fragment_length=64,
+                     env_to_module_connector=lambda: [NormalizeObservations()])
+        .training(train_batch_size=512, minibatch_size=128, num_epochs=6,
+                  lr=3e-3, learner_connector=lambda: [ClipRewards(1.0)])
+        .debugging(seed=0)
+        .build()
+    )
+    try:
+        best = 0.0
+        for _ in range(12):
+            result = algo.train()
+            best = max(best, result.get("episode_return_mean", 0.0))
+            if best > 120:
+                break
+        assert best > 100, best
+    finally:
+        algo.cleanup()

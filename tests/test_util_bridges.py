@@ -157,8 +157,25 @@ def test_dask_graph_scheduler():
     assert ray_dask_get(dsk, ["w", "y"]) == [11, 3]
     assert ray_dask_get(dsk, [["z", "y"]]) == [[9, 3]]
 
+    # Nested tasks run on the worker, not inline on the driver.
+    import os as _os
+    driver_pid = _os.getpid()
+
+    def pid_of_nested():
+        return _os.getpid()
+
+    def passthrough(x):
+        return x
+
+    out = ray_dask_get({"p": (passthrough, (pid_of_nested,))}, ["p"])[0]
+    assert out != driver_pid
+
     with pytest.raises(ValueError, match="cycle"):
         ray_dask_get({"a": (add, "b", 1), "b": (add, "a", 1)}, ["a"])
+    with pytest.raises(ValueError, match="cycle"):
+        ray_dask_get({"a": (add, "a", 1)}, ["a"])  # self-cycle
+    with pytest.raises(KeyError, match="not in the graph"):
+        ray_dask_get({"x": (add, 1, 2)}, ["X"])
 
 
 def test_dask_enable_gates():
