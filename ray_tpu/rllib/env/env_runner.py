@@ -115,6 +115,21 @@ class SingleAgentEnvRunner:
             return obs
         return np.asarray(self._obs_pipe(obs, update=update))
 
+    def _connected_next(self, next_obs, final_obs):
+        """(t_next, next_for_value): next obs through the connector, with
+        terminal rows substituted by their true final observation run
+        through the pipeline WITHOUT updating running stats (their values
+        are only bootstrapped, never acted on)."""
+        t_next = self._connect(next_obs)
+        next_for_value = t_next.copy()
+        done_idx = [i for i, fo in enumerate(final_obs) if fo is not None]
+        if done_idx:
+            finals = self._connect(
+                np.stack([final_obs[i] for i in done_idx]), update=False)
+            for j, i in enumerate(done_idx):
+                next_for_value[i] = finals[j]
+        return t_next, next_for_value
+
     def set_weights(self, weights) -> None:
         self.module.set_weights(weights)
 
@@ -161,18 +176,9 @@ class SingleAgentEnvRunner:
             vf_buf[t] = out[VF_PREDS]
             logits_buf[t] = logits
             next_obs, rewards, terms, truncs, final_obs = self.vec.step(actions)
-            t_next = self._connect(next_obs)
             # Bootstrapping for truncated (time-limit) episodes uses the
-            # true terminal observation, not the post-reset one. Terminal
-            # rows run through the connector without updating its running
-            # stats (their values were never acted on).
-            next_for_value = t_next.copy()
-            done_idx = [i for i, fo in enumerate(final_obs) if fo is not None]
-            if done_idx:
-                finals = self._connect(
-                    np.stack([final_obs[i] for i in done_idx]), update=False)
-                for j, i in enumerate(done_idx):
-                    next_for_value[i] = finals[j]
+            # true terminal observation, not the post-reset one.
+            t_next, next_for_value = self._connected_next(next_obs, final_obs)
             rew_buf[t], term_buf[t], trunc_buf[t] = rewards, terms, truncs
             next_obs_buf[t] = next_for_value
             self._track_episodes(rewards, terms, truncs)
@@ -221,14 +227,7 @@ class SingleAgentEnvRunner:
                     extra_bufs[k] = np.empty((T,) + v.shape, v.dtype)
                 extra_bufs[k][t] = v
             next_obs, rewards, terms, truncs, final_obs = self.vec.step(actions)
-            t_next = self._connect(next_obs)
-            next_for_value = t_next.copy()
-            done_idx = [i for i, fo in enumerate(final_obs) if fo is not None]
-            if done_idx:
-                finals = self._connect(
-                    np.stack([final_obs[i] for i in done_idx]), update=False)
-                for j, i in enumerate(done_idx):
-                    next_for_value[i] = finals[j]
+            t_next, next_for_value = self._connected_next(next_obs, final_obs)
             obs_buf[t] = self.obs
             rew_buf[t], term_buf[t], trunc_buf[t] = rewards, terms, truncs
             next_obs_buf[t] = next_for_value

@@ -192,6 +192,15 @@ class MultiAgentEnvRunner:
     def get_weights(self) -> dict:
         return {mid: m.get_weights() for mid, m in self.modules.items()}
 
+    def get_connector_state(self):
+        # Connector pipelines are not yet supported multi-agent (rejected
+        # in __init__); the checkpoint path still probes via the shared
+        # EnvRunnerGroup surface.
+        return None
+
+    def set_connector_state(self, state) -> None:
+        pass
+
     def sample(self, weights: dict | None = None) -> dict[str, list[SampleBatch]]:
         if weights is not None:
             self.set_weights(weights)

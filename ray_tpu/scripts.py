@@ -131,7 +131,10 @@ def cmd_summary(args) -> int:
     from ray_tpu.util import state as us
 
     _connect(args.address)
-    print(json.dumps(us.summarize_tasks(), indent=2))
+    kind = getattr(args, "kind", "tasks") or "tasks"
+    fn = {"tasks": us.summarize_tasks, "actors": us.summarize_actors,
+          "objects": us.summarize_objects}[kind]
+    print(json.dumps(fn(), indent=2))
     return 0
 
 
@@ -225,10 +228,15 @@ def main(argv: list[str] | None = None) -> int:
                     help=argparse.SUPPRESS)  # test hook
     sp.set_defaults(fn=cmd_start)
 
-    for name, fn in (("status", cmd_status), ("summary", cmd_summary)):
-        s = sub.add_parser(name)
-        s.add_argument("--address", required=True)
-        s.set_defaults(fn=fn)
+    s = sub.add_parser("status")
+    s.add_argument("--address", required=True)
+    s.set_defaults(fn=cmd_status)
+
+    s = sub.add_parser("summary")
+    s.add_argument("kind", nargs="?", default="tasks",
+                   choices=["tasks", "actors", "objects"])
+    s.add_argument("--address", required=True)
+    s.set_defaults(fn=cmd_summary)
 
     s = sub.add_parser("submit", help="run an entrypoint as a cluster job")
     s.add_argument("--address", required=True)

@@ -74,7 +74,6 @@ def ray_dask_get(dsk: Mapping, keys, **kwargs):
     for k, ds in deps.items():
         for d in ds:
             dependents[d].add(k)
-    missing = {k for k, ds in deps.items() if k in ds}
     ready = [k for k, ds in deps.items() if not ds]
     refs: dict = {}
     submitted = 0
@@ -94,7 +93,7 @@ def ray_dask_get(dsk: Mapping, keys, **kwargs):
     if submitted != len(dsk):
         unsubmitted = sorted(k for k in dsk if k not in refs)
         raise ValueError(
-            f"dask graph has a cycle or missing keys: {unsubmitted or sorted(missing)}"
+            f"dask graph has a cycle or missing keys: {unsubmitted}"
         )
 
     def fetch(k):

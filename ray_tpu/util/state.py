@@ -91,6 +91,21 @@ def summarize_actors() -> dict:
     return {"state_counts": dict(states), "total": sum(states.values())}
 
 
+def summarize_objects() -> dict:
+    """Counts + bytes by state (reference: util/state summarize_objects)."""
+    objs = list_objects(limit=100000)
+    states = Counter(o["state"] for o in objs)
+    size_by_state: dict[str, int] = Counter()
+    for o in objs:
+        size_by_state[o["state"]] += int(o.get("size", 0) or 0)
+    return {
+        "state_counts": dict(states),
+        "bytes_by_state": dict(size_by_state),
+        "total": len(objs),
+        "total_bytes": sum(size_by_state.values()),
+    }
+
+
 def object_store_stats() -> dict:
     return _call("store_stats")
 

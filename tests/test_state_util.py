@@ -249,3 +249,17 @@ def test_queue_shared_between_tasks():
     ray_tpu.get(producer.remote(q, 4))
     assert sorted(q.get() for _ in range(4)) == [0, 1, 2, 3]
     q.shutdown()
+
+
+def test_summarize_objects():
+    """reference: util/state summarize_objects."""
+    import numpy as np
+
+    from ray_tpu.util import state as us
+
+    ref = ray_tpu.put(np.zeros(1000))
+    summary = us.summarize_objects()
+    assert summary["total"] >= 1
+    assert summary["total_bytes"] > 0
+    assert "SEALED" in summary["state_counts"]
+    del ref
