@@ -48,6 +48,18 @@ class Worker:
             message_handler=self._on_message,
         )
         worker_context.set_runtime(self.runtime)
+        # Driver-level default runtime env: nested submissions from this
+        # worker inherit it (reference: JobConfig runtime_env inheritance).
+        try:
+            raw = self.runtime.kv_get("default_runtime_env",
+                                      ns="__runtime_env__")
+            if raw:
+                from ray_tpu._private import serialization
+
+                worker_context.set_default_runtime_env(
+                    serialization.loads(raw))
+        except Exception:
+            pass
         # Driver/head gone -> exit (the connection is our lease).
         self.runtime.conn._on_close = lambda conn: os._exit(0)
         # Two-phase registration: the head dispatches nothing until this

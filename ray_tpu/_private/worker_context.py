@@ -39,6 +39,20 @@ def get_head():
     return _head
 
 
+_default_runtime_env: dict | None = None
+
+
+def set_default_runtime_env(env: "dict | None") -> None:
+    """Driver-level runtime env applied under every task/actor env
+    (reference: ray.init(runtime_env=...) via JobConfig)."""
+    global _default_runtime_env
+    _default_runtime_env = env
+
+
+def get_default_runtime_env() -> "dict | None":
+    return _default_runtime_env
+
+
 def is_initialized() -> bool:
     return _runtime is not None
 

@@ -34,6 +34,7 @@ def init(
     namespace: str = "",
     ignore_reinit_error: bool = False,
     log_to_driver: bool = True,
+    runtime_env: dict | None = None,
     _system_config: dict | None = None,
 ) -> dict:
     """Start (or connect to) a cluster and attach this process as driver.
@@ -92,6 +93,26 @@ def init(
             rt = CoreRuntime((host, int(port)), client_type="driver",
                              force_remote=force_remote)
             worker_context.set_runtime(rt, None)
+        if runtime_env:
+            # Packed once here (uploads working_dir/py_modules into the
+            # cluster KV); per-task envs overlay on top of it. Published
+            # to the KV so WORKER-side submissions (nested tasks) inherit
+            # it too (reference: JobConfig runtime_env inheritance).
+            try:
+                from ray_tpu._private import serialization
+                from ray_tpu._private.runtime_env import pack
+
+                rt2 = worker_context.global_runtime()
+                packed = pack(runtime_env, rt2)
+                worker_context.set_default_runtime_env(packed)
+                rt2.kv_put("default_runtime_env", serialization.dumps(packed),
+                           ns="__runtime_env__")
+            except Exception:
+                # A bad env must not leave a half-initialized session
+                # (head + monitor alive, atexit unregistered, re-init
+                # refused).
+                _teardown_locked()
+                raise
         atexit.register(shutdown)
         return context_info()
 
@@ -106,23 +127,29 @@ def context_info() -> dict:
     return {"node_id": rt.node_id, "session_dir": rt.session_dir, "client_id": rt.client_id}
 
 
-def shutdown() -> None:
+def _teardown_locked() -> None:
+    """Tear the session down; caller holds _init_lock."""
     global _log_monitor
+    rt = worker_context.try_runtime()
+    head = worker_context.get_head()
+    if _log_monitor is not None:
+        _log_monitor.stop()
+        _log_monitor = None
+    if rt is None:
+        return
+    worker_context.set_runtime(None, None)
+    worker_context.set_default_runtime_env(None)
+    try:
+        rt.close()
+    except Exception:
+        pass
+    if head is not None:
+        head.shutdown()
+
+
+def shutdown() -> None:
     with _init_lock:
-        rt = worker_context.try_runtime()
-        head = worker_context.get_head()
-        if _log_monitor is not None:
-            _log_monitor.stop()
-            _log_monitor = None
-        if rt is None:
-            return
-        worker_context.set_runtime(None, None)
-        try:
-            rt.close()
-        except Exception:
-            pass
-        if head is not None:
-            head.shutdown()
+        _teardown_locked()
     try:
         atexit.unregister(shutdown)
     except Exception:

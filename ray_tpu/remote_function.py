@@ -18,11 +18,25 @@ from ray_tpu._private.worker_context import global_runtime
 
 
 def _pack_env(runtime_env: dict | None, rt) -> dict | None:
+    from ray_tpu._private.worker_context import get_default_runtime_env
+
+    default = get_default_runtime_env()
     if not runtime_env:
-        return runtime_env
+        return dict(default) if default else runtime_env
     from ray_tpu._private.runtime_env import pack
 
-    return pack(runtime_env, rt)
+    packed = pack(runtime_env, rt)
+    if default:
+        merged = dict(default)
+        # Per-task keys win; env_vars merge key-wise (reference:
+        # runtime_env inheritance semantics).
+        for k, v in packed.items():
+            if k == "env_vars" and "env_vars" in merged:
+                merged["env_vars"] = {**merged["env_vars"], **v}
+            else:
+                merged[k] = v
+        return merged
+    return packed
 
 
 def _normalize_resources(

@@ -118,3 +118,50 @@ def test_actor_keeps_env_vars():
     assert ray_tpu.get(a.get.remote()) == "on"
     assert ray_tpu.get(a.get.remote()) == "on"
     ray_tpu.kill(a)
+
+
+def test_driver_level_runtime_env(tmp_path):
+    """reference: ray.init(runtime_env=...) — every task inherits the
+    driver env; per-task envs overlay it."""
+    import ray_tpu
+
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2, object_store_memory=32 * 1024 * 1024,
+                 runtime_env={"env_vars": {"DRIVER_LEVEL": "yes",
+                                           "SHARED": "from-driver"}})
+    try:
+        @ray_tpu.remote
+        def read(name):
+            import os
+            return os.environ.get(name)
+
+        assert ray_tpu.get(read.remote("DRIVER_LEVEL")) == "yes"
+        # Per-task env overlays and wins on conflicts.
+        t = read.options(runtime_env={"env_vars": {"SHARED": "from-task"}})
+        assert ray_tpu.get(t.remote("SHARED")) == "from-task"
+        assert ray_tpu.get(t.remote("DRIVER_LEVEL")) == "yes"
+
+        # Nested submissions from a worker inherit the driver env too.
+        @ray_tpu.remote
+        def outer():
+            return ray_tpu.get(read.remote("DRIVER_LEVEL"))
+
+        assert ray_tpu.get(outer.remote()) == "yes"
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_init_runtime_env_failure_cleans_up():
+    """A rejected driver env must not leave a half-initialized session."""
+    import ray_tpu
+
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    with pytest.raises(ValueError, match="pip"):
+        ray_tpu.init(num_cpus=1, object_store_memory=32 * 1024 * 1024,
+                     runtime_env={"pip": ["requests"]})
+    assert not ray_tpu.is_initialized()
+    # A corrected retry works.
+    ray_tpu.init(num_cpus=1, object_store_memory=32 * 1024 * 1024)
+    ray_tpu.shutdown()
