@@ -240,6 +240,22 @@ class Head:
         )
         self._dispatcher.start()
 
+        # Warm pool (reference: WorkerPool pre-starting idle language
+        # workers, raylet/worker_pool.h:224): first tasks skip the
+        # process-spawn + import latency. Opt-in via
+        # _system_config={"worker_pool_prestart": N}.
+        for _ in range(min(config.worker_pool_prestart,
+                           self.max_pool_workers)):
+            try:
+                self.spawn_worker(self.node_id)
+            except Exception:
+                import sys as _sys
+
+                traceback.print_exc()
+                print("ray_tpu: worker prestart failed; first tasks will "
+                      "pay cold-start latency", file=_sys.stderr)
+                break
+
         # OOM protection: kill-and-retry busy workers under host memory
         # pressure (memory_monitor.py; reference memory_monitor.h:52).
         self.memory_monitor = None

@@ -33,6 +33,13 @@ def _to_host(obj: Any) -> Any:
     """Move jax arrays to host numpy before pickling (device buffers are not
     picklable; tensors normally shouldn't transit the object store at all —
     see shm_store docstring — but small ones are allowed for convenience)."""
+    import sys
+
+    if "jax" not in sys.modules:
+        # jax was never imported in this process, so obj cannot be a jax
+        # array — and we must NOT pay the jax import (it dominates a
+        # worker's first-task latency for plain-Python workloads).
+        return obj
     try:
         import jax
         import numpy as np

@@ -48,18 +48,6 @@ class Worker:
             message_handler=self._on_message,
         )
         worker_context.set_runtime(self.runtime)
-        # Driver-level default runtime env: nested submissions from this
-        # worker inherit it (reference: JobConfig runtime_env inheritance).
-        try:
-            raw = self.runtime.kv_get("default_runtime_env",
-                                      ns="__runtime_env__")
-            if raw:
-                from ray_tpu._private import serialization
-
-                worker_context.set_default_runtime_env(
-                    serialization.loads(raw))
-        except Exception:
-            pass
         # Driver/head gone -> exit (the connection is our lease).
         self.runtime.conn._on_close = lambda conn: os._exit(0)
         # Two-phase registration: the head dispatches nothing until this
@@ -167,7 +155,8 @@ class Worker:
             saved_env[k] = os.environ.get(k)
             os.environ[k] = str(v)
         worker_context.set_task_context(
-            worker_context.TaskContext(spec.task_id, self.actor_id, self.node_id)
+            worker_context.TaskContext(spec.task_id, self.actor_id,
+                                       self.node_id, spec.runtime_env)
         )
         applied_env = None
         try:

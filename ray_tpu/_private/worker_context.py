@@ -60,10 +60,15 @@ def is_initialized() -> bool:
 class TaskContext:
     """Per-task runtime context (reference: ray.get_runtime_context())."""
 
-    def __init__(self, task_id: str = "", actor_id: str | None = None, node_id: str = ""):
+    def __init__(self, task_id: str = "", actor_id: str | None = None,
+                 node_id: str = "", runtime_env: "dict | None" = None):
         self.task_id = task_id
         self.actor_id = actor_id
         self.node_id = node_id
+        # The executing task's (already merged) runtime env — the default
+        # that nested submissions inherit (reference: parent runtime_env
+        # inheritance via JobConfig/worker context).
+        self.runtime_env = runtime_env
 
 
 def set_task_context(ctx: TaskContext | None) -> None:

@@ -95,18 +95,15 @@ def init(
             worker_context.set_runtime(rt, None)
         if runtime_env:
             # Packed once here (uploads working_dir/py_modules into the
-            # cluster KV); per-task envs overlay on top of it. Published
-            # to the KV so WORKER-side submissions (nested tasks) inherit
-            # it too (reference: JobConfig runtime_env inheritance).
+            # cluster KV); per-task envs overlay on top of it. Nested
+            # submissions inherit through the PARENT task's merged env
+            # (worker_context.TaskContext.runtime_env) — race-free and
+            # driver-scoped, no shared mutable key.
             try:
-                from ray_tpu._private import serialization
                 from ray_tpu._private.runtime_env import pack
 
-                rt2 = worker_context.global_runtime()
-                packed = pack(runtime_env, rt2)
-                worker_context.set_default_runtime_env(packed)
-                rt2.kv_put("default_runtime_env", serialization.dumps(packed),
-                           ns="__runtime_env__")
+                worker_context.set_default_runtime_env(
+                    pack(runtime_env, worker_context.global_runtime()))
             except Exception:
                 # A bad env must not leave a half-initialized session
                 # (head + monitor alive, atexit unregistered, re-init
