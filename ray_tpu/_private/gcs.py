@@ -249,11 +249,9 @@ class Head:
             try:
                 self.spawn_worker(self.node_id)
             except Exception:
-                import sys as _sys
-
                 traceback.print_exc()
                 print("ray_tpu: worker prestart failed; first tasks will "
-                      "pay cold-start latency", file=_sys.stderr)
+                      "pay cold-start latency", file=sys.stderr)
                 break
 
         # OOM protection: kill-and-retry busy workers under host memory

@@ -61,6 +61,9 @@ class Worker:
             self.executor.submit(self._run_task_guarded, body["spec"], body.get("tpu_chips"))
         elif kind == "become_actor":
             self.actor_id = body["actor_id"]
+            # Actor-lifetime env: actor METHOD tasks carry no runtime_env
+            # of their own; nested submissions inherit the creation env.
+            self.actor_runtime_env = body["spec"].runtime_env
             maxc = max(1, int(body.get("max_concurrency", 1)))
             if maxc > 1:
                 self.executor = ThreadPoolExecutor(
@@ -141,6 +144,8 @@ class Worker:
     def _run_task(self, spec: TaskSpec, tpu_chips) -> bool:
         """Returns True on success. Stores results/errors for return ids."""
         saved_env: dict[str, str | None] = {}
+        inherited_env = spec.runtime_env or getattr(
+            self, "actor_runtime_env", None)
         env_vars = (spec.runtime_env or {}).get("env_vars", {})
         if tpu_chips:
             env_vars = dict(env_vars)
@@ -156,8 +161,12 @@ class Worker:
             os.environ[k] = str(v)
         worker_context.set_task_context(
             worker_context.TaskContext(spec.task_id, self.actor_id,
-                                       self.node_id, spec.runtime_env)
+                                       self.node_id, inherited_env)
         )
+        # Thread-local context misses user-spawned threads; keep a
+        # process-level fallback too (best-effort under actor
+        # max_concurrency with heterogeneous per-call envs).
+        worker_context.set_process_runtime_env(inherited_env)
         applied_env = None
         try:
             # working_dir / py_modules (runtime_env.py): applied per task

@@ -40,6 +40,19 @@ def get_head():
 
 
 _default_runtime_env: dict | None = None
+_process_runtime_env: dict | None = None
+
+
+def set_process_runtime_env(env: "dict | None") -> None:
+    """Worker-side fallback for nested submissions from user-spawned
+    threads (the task context is thread-local): the env of the task/actor
+    this process is currently executing."""
+    global _process_runtime_env
+    _process_runtime_env = env
+
+
+def get_process_runtime_env() -> "dict | None":
+    return _process_runtime_env
 
 
 def set_default_runtime_env(env: "dict | None") -> None:

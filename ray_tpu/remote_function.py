@@ -20,13 +20,16 @@ from ray_tpu._private.worker_context import global_runtime
 def _pack_env(runtime_env: dict | None, rt) -> dict | None:
     from ray_tpu._private.worker_context import (
         get_default_runtime_env,
+        get_process_runtime_env,
         get_task_context,
     )
 
     # Driver: the init()-level default. Worker: the executing (parent)
     # task's merged env — nested submissions inherit it (reference:
-    # runtime_env inheritance).
-    default = get_default_runtime_env() or get_task_context().runtime_env
+    # runtime_env inheritance). The process-level fallback covers
+    # submissions from user-spawned threads inside a task.
+    default = (get_default_runtime_env() or get_task_context().runtime_env
+               or get_process_runtime_env())
     if not runtime_env:
         return dict(default) if default else runtime_env
     from ray_tpu._private.runtime_env import pack

@@ -358,34 +358,3 @@ def test_runtime_context_in_task(cluster):
 
     task_id, node_id = ray_tpu.get(ctx.remote())
     assert task_id.startswith("task-") and node_id.startswith("node-")
-
-
-def test_worker_pool_prestart():
-    """reference: WorkerPool pre-started idle workers (worker_pool.h:224)."""
-    import time
-
-    import ray_tpu
-    from ray_tpu._private.worker_context import get_head
-
-    if ray_tpu.is_initialized():
-        ray_tpu.shutdown()
-    ray_tpu.init(num_cpus=2, object_store_memory=32 * 1024 * 1024,
-                 _system_config={"worker_pool_prestart": 2})
-    try:
-        head = get_head()
-        assert len(head.workers) == 2  # warmed at init, before any task
-
-        @ray_tpu.remote
-        def f():
-            return 1
-
-        # Warm pool: first task does not pay a spawn.
-        deadline = time.time() + 10
-        while time.time() < deadline:
-            if any(r.ready for r in head.workers.values()):
-                break
-            time.sleep(0.05)
-        assert ray_tpu.get(f.remote()) == 1
-        assert len(head.workers) == 2  # no extra spawn for the first task
-    finally:
-        ray_tpu.shutdown()

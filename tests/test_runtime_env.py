@@ -165,3 +165,39 @@ def test_init_runtime_env_failure_cleans_up():
     # A corrected retry works.
     ray_tpu.init(num_cpus=1, object_store_memory=32 * 1024 * 1024)
     ray_tpu.shutdown()
+
+
+def test_actor_method_nested_inheritance():
+    """Nested submissions from actor methods and from user-spawned threads
+    inherit the driver env (reference: runtime_env inheritance)."""
+    import ray_tpu
+
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2, object_store_memory=32 * 1024 * 1024,
+                 runtime_env={"env_vars": {"DRIVER_LEVEL": "yes"}})
+    try:
+        @ray_tpu.remote
+        def read(name):
+            import os
+            return os.environ.get(name)
+
+        @ray_tpu.remote
+        class Submitter:
+            def nested(self):
+                return ray_tpu.get(read.remote("DRIVER_LEVEL"))
+
+            def nested_from_thread(self):
+                from concurrent.futures import ThreadPoolExecutor
+
+                with ThreadPoolExecutor(1) as pool:
+                    return pool.submit(
+                        lambda: ray_tpu.get(read.remote("DRIVER_LEVEL"))
+                    ).result()
+
+        a = Submitter.remote()
+        assert ray_tpu.get(a.nested.remote()) == "yes"
+        assert ray_tpu.get(a.nested_from_thread.remote()) == "yes"
+        ray_tpu.kill(a)
+    finally:
+        ray_tpu.shutdown()

@@ -1,0 +1,109 @@
+"""Stress: high-churn tasks/actors/objects under one session (reference:
+python/ray/tests/test_stress.py / test_stress_sharded.py — correctness
+under concurrency is covered by stress, SURVEY.md §5)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _cluster():
+    ray_tpu.init(num_cpus=4, object_store_memory=128 * 1024 * 1024,
+                 _system_config={"worker_pool_prestart": 4})
+    yield
+    ray_tpu.shutdown()
+
+
+def test_many_small_tasks():
+    @ray_tpu.remote
+    def inc(x):
+        return x + 1
+
+    refs = [inc.remote(i) for i in range(1000)]
+    out = ray_tpu.get(refs)
+    assert out == list(range(1, 1001))
+
+
+def test_deep_dependency_chain():
+    @ray_tpu.remote
+    def add_one(x):
+        return x + 1
+
+    ref = 0
+    for _ in range(200):
+        ref = add_one.remote(ref)
+    assert ray_tpu.get(ref) == 200
+
+
+def test_wide_fanout_fanin():
+    @ray_tpu.remote
+    def leaf(i):
+        return np.full(1000, i, np.int64)
+
+    @ray_tpu.remote
+    def reduce_all(*parts):
+        return int(sum(p.sum() for p in parts))
+
+    leaves = [leaf.remote(i) for i in range(64)]
+    total = ray_tpu.get(reduce_all.remote(*leaves))
+    assert total == sum(i * 1000 for i in range(64))
+
+
+def test_object_churn_with_frees():
+    refs = []
+    for wave in range(20):
+        refs = [ray_tpu.put(np.random.rand(64, 64)) for _ in range(20)]
+        # Half freed explicitly, half dropped (refcount GC).
+        ray_tpu.free(refs[:10])
+        for r in refs[10:]:
+            assert ray_tpu.get(r).shape == (64, 64)
+
+
+def test_concurrent_driver_threads():
+    """Multiple threads submitting through one driver runtime."""
+    @ray_tpu.remote
+    def sq(x):
+        return x * x
+
+    errors = []
+    results = {}
+
+    def worker(tid):
+        try:
+            results[tid] = ray_tpu.get([sq.remote(i) for i in range(50)])
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(t,)) for t in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors
+    for tid in range(8):
+        assert results[tid] == [i * i for i in range(50)]
+
+
+def test_actor_swarm():
+    @ray_tpu.remote
+    class Acc:
+        def __init__(self):
+            self.total = 0
+
+        def add(self, x):
+            self.total += x
+            return self.total
+
+        def value(self):
+            return self.total
+
+    actors = [Acc.remote() for _ in range(16)]
+    for wave in range(5):
+        ray_tpu.get([a.add.remote(wave) for a in actors])
+    assert ray_tpu.get([a.value.remote() for a in actors]) == [10] * 16
+    for a in actors:
+        ray_tpu.kill(a)
