@@ -1154,6 +1154,7 @@ class Head:
                         "node_id": n.node_id,
                         "address": n.address,
                         "alive": n.alive,
+                        "is_head": n.node_id == self.node_id,
                         "resources": n.total.to_dict(),
                         "available": n.available.to_dict(),
                         "labels": n.labels,

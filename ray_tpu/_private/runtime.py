@@ -290,7 +290,7 @@ class CoreRuntime:
         cached = self._fn_ids.get(id(fn))
         if cached is not None and cached[0]() is fn:
             return cached[1]
-        blob = cloudpickle.dumps(fn)
+        blob = serialization.dumps_scoped(fn)
         func_id = "fn:" + hashlib.sha256(blob).hexdigest()[:32]
         self.conn.call("kv_put", {"ns": "__functions__", "key": func_id, "value": blob, "overwrite": False})
         try:
@@ -320,7 +320,7 @@ class CoreRuntime:
         deps = [
             a.hex() for a in list(args) + list(kwargs.values()) if isinstance(a, ObjectRef)
         ]
-        return cloudpickle.dumps((args, kwargs), protocol=5), deps
+        return serialization.dumps_scoped((args, kwargs)), deps
 
     def submit_task(self, spec: TaskSpec) -> None:
         self.conn.cast("submit_task", {"spec": spec})

@@ -15,6 +15,7 @@ alignment guarantees.
 
 from __future__ import annotations
 
+import io
 import pickle
 import struct
 from typing import Any
@@ -51,12 +52,53 @@ def _to_host(obj: Any) -> Any:
     return obj
 
 
+# Reducers installed via ray_tpu.util.register_serializer. Scoped to THIS
+# serializer (reference: the worker's SerializationContext custom-type
+# table, _private/serialization.py) — plain pickle.dumps/copy.deepcopy in
+# user code are untouched.
+custom_reducers: dict[type, Any] = {}
+
+
+class _RuntimePickler(cloudpickle.Pickler):
+    """CloudPickler with the runtime's custom reducers layered on top.
+
+    Hooked via reducer_override (PEP 574), not dispatch_table: the C
+    pickler snapshots self.dispatch_table at __init__, so an instance
+    assignment after super().__init__ is never consulted — and mutating
+    cloudpickle's class-level table would be process-global again.
+    reducer_override is called for every non-builtin object and takes
+    priority, which is exactly the per-pickler scoping we need."""
+
+    def reducer_override(self, obj):
+        reducer = custom_reducers.get(type(obj))
+        if reducer is not None:
+            return reducer(obj)
+        return super().reducer_override(obj)
+
+
+def _dump(obj: Any, protocol: int = 5, buffer_callback=None) -> bytes:
+    if not custom_reducers:
+        return cloudpickle.dumps(obj, protocol=protocol,
+                                 buffer_callback=buffer_callback)
+    f = io.BytesIO()
+    _RuntimePickler(f, protocol=protocol,
+                    buffer_callback=buffer_callback).dump(obj)
+    return f.getvalue()
+
+
+def dumps_scoped(obj: Any, protocol: int = 5) -> bytes:
+    """cloudpickle.dumps honoring the runtime's custom reducers — the
+    pickler for anything crossing a process boundary (task args, function
+    blobs, workflow step values, serve payloads); plain in-process
+    pickling stays untouched."""
+    return _dump(obj, protocol)
+
+
 def serialize(obj: Any) -> tuple[bytes, list[pickle.PickleBuffer]]:
     """Returns (header_bytes, oob_buffers)."""
     obj = _to_host(obj)
     buffers: list[pickle.PickleBuffer] = []
-    header = cloudpickle.dumps(obj, protocol=5, buffer_callback=buffers.append)
-    return header, buffers
+    return _dump(obj, 5, buffers.append), buffers
 
 
 def serialized_size(header: bytes, buffers: list[pickle.PickleBuffer]) -> int:

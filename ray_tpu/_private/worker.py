@@ -64,6 +64,7 @@ class Worker:
             # Actor-lifetime env: actor METHOD tasks carry no runtime_env
             # of their own; nested submissions inherit the creation env.
             self.actor_runtime_env = body["spec"].runtime_env
+            worker_context.set_process_base_runtime_env(self.actor_runtime_env)
             maxc = max(1, int(body.get("max_concurrency", 1)))
             if maxc > 1:
                 self.executor = ThreadPoolExecutor(
@@ -164,9 +165,9 @@ class Worker:
                                        self.node_id, inherited_env)
         )
         # Thread-local context misses user-spawned threads; keep a
-        # process-level fallback too (best-effort under actor
-        # max_concurrency with heterogeneous per-call envs).
-        worker_context.set_process_runtime_env(inherited_env)
+        # process-level fallback too, refcounted so a finished task's env
+        # never lingers (restored to the actor-lifetime env in finally).
+        env_token = worker_context.push_process_runtime_env(inherited_env)
         applied_env = None
         try:
             # working_dir / py_modules (runtime_env.py): applied per task
@@ -212,6 +213,7 @@ class Worker:
             return False
         finally:
             worker_context.set_task_context(None)
+            worker_context.pop_process_runtime_env(env_token)
             if spec.actor_creation:
                 # The actor's runtime env (working_dir, env_vars) lives for
                 # the actor's lifetime — this worker is dedicated to it.

@@ -40,19 +40,47 @@ def get_head():
 
 
 _default_runtime_env: dict | None = None
-_process_runtime_env: dict | None = None
+_process_env_lock = threading.Lock()
+_process_base_env: dict | None = None  # actor-lifetime env
+_active_task_envs: dict[int, "dict | None"] = {}  # in-flight task envs
+_env_token_counter = 0
 
 
-def set_process_runtime_env(env: "dict | None") -> None:
+def set_process_base_runtime_env(env: "dict | None") -> None:
+    """Actor-lifetime env: the fallback that outlives any single method
+    call (set once at become_actor)."""
+    global _process_base_env
+    with _process_env_lock:
+        _process_base_env = env
+
+
+def push_process_runtime_env(env: "dict | None") -> int:
     """Worker-side fallback for nested submissions from user-spawned
-    threads (the task context is thread-local): the env of the task/actor
-    this process is currently executing."""
-    global _process_runtime_env
-    _process_runtime_env = env
+    threads (the task context is thread-local): record the env of a task
+    this process started executing. Returns a token for the matching
+    pop. Under actor max_concurrency>1 with heterogeneous per-call envs
+    the 'current' env is ambiguous for user threads — last-started wins
+    while in flight; when the last task finishes the actor-lifetime env
+    (or None) is restored, so no per-call env can leak past its task."""
+    global _env_token_counter
+    with _process_env_lock:
+        _env_token_counter += 1
+        token = _env_token_counter
+        _active_task_envs[token] = env
+        return token
+
+
+def pop_process_runtime_env(token: int) -> None:
+    with _process_env_lock:
+        _active_task_envs.pop(token, None)
 
 
 def get_process_runtime_env() -> "dict | None":
-    return _process_runtime_env
+    with _process_env_lock:
+        if _active_task_envs:
+            # Most recently started in-flight task.
+            return _active_task_envs[max(_active_task_envs)]
+        return _process_base_env
 
 
 def set_default_runtime_env(env: "dict | None") -> None:

@@ -201,9 +201,11 @@ def start_dashboard(host: str = "127.0.0.1", port: int = 0) -> int:
                     NodeAffinitySchedulingStrategy,
                 )
 
-                # The head registers itself before any agent joins, so it
-                # is the first entry in the node table.
-                head_node = ray_tpu.nodes()[0]["node_id"]
+                nodes = ray_tpu.nodes()
+                head_node = next(
+                    (n["node_id"] for n in nodes if n.get("is_head")),
+                    nodes[0]["node_id"],
+                )
                 cls = ray_tpu.remote(
                     num_cpus=0, max_concurrency=8, name="DASHBOARD",
                     namespace="_dashboard",

@@ -313,6 +313,10 @@ class Algorithm(Trainable):
         result = self.training_step()
         if self.env_runner_group is not None:
             result.update(self.env_runner_group.get_metrics())
+            if hasattr(self.env_runner_group, "sync_connector_states"):
+                # Keep running-normalizer stats consistent across remote
+                # runners (reference: MeanStdFilter periodic sync).
+                self.env_runner_group.sync_connector_states()
         return result
 
     def train(self) -> dict:  # Trainable.train adds iteration bookkeeping

@@ -369,14 +369,41 @@ class EnvRunnerGroup:
         return merge_episode_metrics(per)
 
     def get_connector_state(self):
-        """First runner's pipeline state (checkpoint representative)."""
+        """Merged pipeline state across ALL runners (reference:
+        MeanStdFilter sync semantics): gather every runner's state,
+        combine stateful connectors (Chan's parallel combine for running
+        normalizers), and broadcast the merged stats back so each runner
+        keeps normalizing with the shared statistics."""
         import ray_tpu
+
+        from ray_tpu.rllib.connectors import merge_pipeline_states
 
         if self.local_runner is not None:
             return self.local_runner.get_connector_state()
-        if self.remote_runners:
-            return ray_tpu.get(self.remote_runners[0].get_connector_state.remote())
-        return None
+        if not self.remote_runners:
+            return None
+        states = ray_tpu.get([r.get_connector_state.remote()
+                              for r in self.remote_runners])
+        merged, mergeable = merge_pipeline_states(states)
+        if merged is not None:
+            # Gathering HARVESTED each runner's delta, so the merged
+            # result must go back even with one runner or the samples
+            # would vanish from every future merge. Broadcast ONLY
+            # genuinely merged positions; unmergeable (unknown-kind)
+            # connector state stays per-runner — a None entry is skipped
+            # by ConnectorPipelineV2.set_state.
+            broadcast = [m if ok else None
+                         for m, ok in zip(merged, mergeable)]
+            if any(b is not None for b in broadcast):
+                ray_tpu.get([r.set_connector_state.remote(broadcast)
+                             for r in self.remote_runners])
+        return merged
+
+    def sync_connector_states(self) -> None:
+        """Periodic cross-runner stats sync (called by Algorithm.step);
+        no-op with a local runner or no stateful connectors."""
+        if self.local_runner is None and len(self.remote_runners) > 1:
+            self.get_connector_state()
 
     def set_connector_state(self, state) -> None:
         """Seed every runner's pipeline (restore path)."""

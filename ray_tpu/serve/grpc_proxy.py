@@ -77,9 +77,9 @@ class GrpcIngress:
                 context.abort(grpc.StatusCode.INTERNAL, str(e))
             try:
                 if encoding == "pickle":
-                    import cloudpickle
+                    from ray_tpu._private.serialization import dumps_scoped
 
-                    return cloudpickle.dumps(result)
+                    return dumps_scoped(result)
                 return json.dumps(result, default=_json_default).encode()
             except Exception as e:  # noqa: BLE001
                 context.abort(grpc.StatusCode.INTERNAL,
@@ -137,9 +137,9 @@ def grpc_request(address: str, payload: Any, *, deployment: str | None = None,
     channel = grpc.insecure_channel(address)
     try:
         if encoding == "pickle":
-            import cloudpickle
+            from ray_tpu._private.serialization import dumps_scoped
 
-            body = cloudpickle.dumps(payload)
+            body = dumps_scoped(payload)
         else:
             body = json.dumps(payload).encode()
         callable_ = channel.unary_unary(f"/{SERVICE}/{METHOD}")

@@ -31,15 +31,33 @@ class _Nested:
     args: list
 
 
+def _in_table(expr: Any, table: Mapping) -> bool:
+    """True iff expr is a key of table. Tuples pass isinstance(x, Hashable)
+    even when their elements don't, so membership itself can raise."""
+    if not isinstance(expr, Hashable):
+        return False
+    try:
+        return expr in table
+    except TypeError:
+        return False
+
+
 def _resolve(expr: Any, refs: dict):
-    """Rewrite graph keys to ObjectRefs and nested tasks to _Nested."""
+    """Rewrite graph keys to ObjectRefs and nested tasks to _Nested.
+
+    Key lookup happens BEFORE any tuple handling other than the task
+    check: dask collections use tuple keys like ("chunk-...", 0), and
+    dask.core treats a non-task tuple that is a graph key as a key, not
+    as a structure to descend.  Only lists are descended (dask.core
+    semantics) — a non-task, non-key tuple is a literal.
+    """
     if _is_task(expr):
         fn, *args = expr
         return _Nested(fn, [_resolve(a, refs) for a in args])
-    if isinstance(expr, (list, tuple)):
-        return type(expr)(_resolve(e, refs) for e in expr)
-    if isinstance(expr, Hashable) and expr in refs:
+    if _in_table(expr, refs):
         return refs[expr]
+    if isinstance(expr, list):
+        return [_resolve(e, refs) for e in expr]
     return expr
 
 
@@ -107,15 +125,18 @@ def ray_dask_get(dsk: Mapping, keys, **kwargs):
 
 
 def _graph_deps(expr: Any, dsk: Mapping) -> set:
+    """Same traversal order as _resolve: task → key (tuples included) →
+    list descent.  Checking the tuple itself against dsk before
+    descending is what keeps dask-collection tuple keys intact."""
     out: set = set()
     if _is_task(expr):
         for a in expr[1:]:
             out |= _graph_deps(a, dsk)
-    elif isinstance(expr, (list, tuple)):
+    elif _in_table(expr, dsk):
+        out.add(expr)
+    elif isinstance(expr, list):
         for a in expr:
             out |= _graph_deps(a, dsk)
-    elif isinstance(expr, Hashable) and expr in dsk:
-        out.add(expr)
     return out
 
 

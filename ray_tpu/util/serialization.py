@@ -3,9 +3,14 @@
 Counterpart of the reference's ray.util.serialization
 (reference: python/ray/util/serialization.py — register_serializer /
 deregister_serializer installing per-class reducers into the worker's
-serialization context). Implementation: a copyreg reducer that embeds the
-deserializer (cloudpickle serializes it by value), so workers reconstruct
-objects without any receiver-side registration step.
+serialization context). The reducer embeds the deserializer (cloudpickle
+serializes it by value), so workers reconstruct objects without any
+receiver-side registration step.
+
+Scoping matches the reference: the reducer lives in the runtime's own
+serialization context (`_private.serialization.custom_reducers`), NOT in
+the process-global copyreg dispatch table — `copy.deepcopy` and user
+`pickle.dumps` of the class are unaffected.
 
     class Conn: ...                      # unpicklable (sockets inside)
     ray_tpu.util.register_serializer(
@@ -17,42 +22,27 @@ objects without any receiver-side registration step.
 
 from __future__ import annotations
 
-import copyreg
 from typing import Any, Callable
+
+from ray_tpu._private.serialization import custom_reducers
 
 
 def _reconstruct(deserializer: Callable, payload: Any):
     return deserializer(payload)
 
 
-# cls -> the dispatch entry (if any) that existed before registration,
-# restored on deregister so user-installed copyreg reducers survive.
-_previous_entries: dict[type, Any] = {}
-
-
 def register_serializer(cls: type, *, serializer: Callable[[Any], Any],
                         deserializer: Callable[[Any], Any]) -> None:
-    """Route pickling of ``cls`` instances through ``serializer`` (must
-    return something picklable); workers rebuild via ``deserializer``.
-
-    Scope note (design difference vs the reference, which hooks only
-    Ray's serialization context): this installs a copyreg reducer, so it
-    affects EVERY pickle of ``cls`` in this process — including
-    copy.deepcopy and user pickle.dumps. That is what makes the hook
-    work with zero receiver-side setup (the deserializer ships by value
-    inside the stream)."""
-    if cls not in _previous_entries:
-        _previous_entries[cls] = copyreg.dispatch_table.get(cls)
+    """Route object-store serialization of ``cls`` instances through
+    ``serializer`` (must return something picklable); workers rebuild via
+    ``deserializer``. Only ray_tpu transfers are affected — in-process
+    pickling of the class keeps its normal behavior."""
 
     def reducer(obj):
         return _reconstruct, (deserializer, serializer(obj))
 
-    copyreg.pickle(cls, reducer)
+    custom_reducers[cls] = reducer
 
 
 def deregister_serializer(cls: type) -> None:
-    prev = _previous_entries.pop(cls, None)
-    if prev is not None:
-        copyreg.dispatch_table[cls] = prev
-    else:
-        copyreg.dispatch_table.pop(cls, None)
+    custom_reducers.pop(cls, None)

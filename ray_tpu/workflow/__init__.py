@@ -32,6 +32,8 @@ from typing import Any
 
 import cloudpickle
 
+from ray_tpu._private.serialization import dumps_scoped
+
 import ray_tpu
 from ray_tpu.dag.nodes import DAGNode, FunctionNode
 
@@ -104,10 +106,10 @@ def _freeze(root: DAGNode) -> dict:
         def enc(v):
             if isinstance(v, DAGNode):
                 return {"__step__": ids[v._uuid]}
-            return {"__val__": cloudpickle.dumps(v).hex()}
+            return {"__val__": dumps_scoped(v).hex()}
 
         steps[sid] = {
-            "fn": cloudpickle.dumps(fn._fn).hex(),
+            "fn": dumps_scoped(fn._fn).hex(),
             "opts": fn._opts,
             "args": [enc(a) for a in node._bound_args],
             "kwargs": {k: enc(v) for k, v in node._bound_kwargs.items()},
@@ -129,7 +131,7 @@ class _Store:
 
     def save_spec(self, spec: dict) -> None:
         _atomic_write(os.path.join(self.dir, "dag.pkl"),
-                      cloudpickle.dumps(spec))
+                      dumps_scoped(spec))
 
     def load_spec(self) -> dict:
         with open(os.path.join(self.dir, "dag.pkl"), "rb") as f:
@@ -155,7 +157,7 @@ class _Store:
         return os.path.exists(self.step_path(sid))
 
     def save_step(self, sid: str, value: Any) -> None:
-        _atomic_write(self.step_path(sid), cloudpickle.dumps(value))
+        _atomic_write(self.step_path(sid), dumps_scoped(value))
 
     def load_step(self, sid: str) -> Any:
         with open(self.step_path(sid), "rb") as f:

@@ -109,3 +109,27 @@ def test_tpu_accelerator_manager_env(monkeypatch):
 
     assert os.environ["TPU_VISIBLE_CHIPS"] == "0,1,2,3"
     assert os.environ["TPU_CHIPS_PER_PROCESS_BOUNDS"] == "2,2,1"
+
+
+def test_process_runtime_env_refcounted():
+    """ADVICE r1: a finished task's env must not linger as the
+    process-level fallback; concurrent tasks see last-started-wins and
+    the actor-lifetime base env returns once all are done."""
+    from ray_tpu._private import worker_context as wc
+
+    base = {"env_vars": {"A": "base"}}
+    wc.set_process_base_runtime_env(base)
+    try:
+        assert wc.get_process_runtime_env() == base
+        t1 = wc.push_process_runtime_env({"env_vars": {"A": "t1"}})
+        t2 = wc.push_process_runtime_env({"env_vars": {"A": "t2"}})
+        assert wc.get_process_runtime_env() == {"env_vars": {"A": "t2"}}
+        wc.pop_process_runtime_env(t2)
+        assert wc.get_process_runtime_env() == {"env_vars": {"A": "t1"}}
+        wc.pop_process_runtime_env(t1)
+        # No stale per-call env after the last task finishes.
+        assert wc.get_process_runtime_env() == base
+        wc.pop_process_runtime_env(t1)  # double-pop is harmless
+        assert wc.get_process_runtime_env() == base
+    finally:
+        wc.set_process_base_runtime_env(None)

@@ -47,6 +47,60 @@ def test_flatten_and_normalize():
     assert norm.get_state()["count"] == state["count"]
 
 
+def test_normalize_stats_merge_across_runners():
+    """Cross-runner sync (reference: MeanStdFilter merge semantics):
+    merging N runners' states must equal the stats of the union of their
+    data, with no double counting across repeated sync rounds."""
+    from ray_tpu.rllib.connectors import merge_pipeline_states
+
+    rng = np.random.default_rng(1)
+    shards = [rng.normal(i, 1.0 + i, size=(200, 3)).astype(np.float32)
+              for i in range(3)]
+    runners = [NormalizeObservations() for _ in shards]
+    for r, d in zip(runners, shards):
+        r(d)
+
+    merged = merge_pipeline_states([[r.get_state()] for r in runners])[0][0]
+    alldata = np.concatenate(shards, axis=0).astype(np.float64)
+    assert merged["count"] == alldata.shape[0]
+    np.testing.assert_allclose(merged["mean"], alldata.mean(0), rtol=1e-10)
+    np.testing.assert_allclose(
+        merged["m2"] / merged["count"], alldata.var(0), rtol=1e-10)
+
+    # Broadcast back, accumulate more, merge again: counts add exactly
+    # once (deltas restart at zero after the sync).
+    for r in runners:
+        r.set_state(merged)
+    more = [rng.normal(0, 1, size=(50, 3)).astype(np.float32)
+            for _ in runners]
+    for r, d in zip(runners, more):
+        r(d)
+    merged2 = merge_pipeline_states([[r.get_state()] for r in runners])[0][0]
+    assert merged2["count"] == alldata.shape[0] + 150
+    alldata2 = np.concatenate([alldata] + [m.astype(np.float64)
+                                           for m in more], axis=0)
+    np.testing.assert_allclose(merged2["mean"], alldata2.mean(0), rtol=1e-9)
+    np.testing.assert_allclose(
+        merged2["m2"] / merged2["count"], alldata2.var(0), rtol=1e-9)
+
+    # Partial broadcast failure: runner 2 misses the merged state. Its
+    # delta was harvested at gather, so the next merge must still count
+    # every sample exactly once (freshest base + fresh deltas only).
+    for r in runners[:2]:
+        r.set_state(merged2)
+    extra = [rng.normal(0, 1, size=(30, 3)).astype(np.float32)
+             for _ in runners]
+    for r, d in zip(runners, extra):
+        r(d)
+    merged3 = merge_pipeline_states([[r.get_state()] for r in runners])[0][0]
+    assert merged3["count"] == merged2["count"] + 90
+    alldata3 = np.concatenate([alldata2] + [e.astype(np.float64)
+                                            for e in extra], axis=0)
+    np.testing.assert_allclose(merged3["mean"], alldata3.mean(0), rtol=1e-9)
+    np.testing.assert_allclose(
+        merged3["m2"] / merged3["count"], alldata3.var(0), rtol=1e-9)
+
+
 def test_clip_rewards_connector():
     batch = SampleBatch({REWARDS: np.array([-5.0, 0.3, 7.0])})
     out = ClipRewards(1.0)(batch)

@@ -136,6 +136,20 @@ def test_register_custom_serializer():
             return c.address
 
         assert ray_tpu.get(probe.remote(Conn("db:1"))) == "db:1"
+
+        # Scoped to the runtime's serializer (reference: worker
+        # SerializationContext isolation): plain pickle and deepcopy do
+        # NOT go through the custom reducer.
+        import copy
+        import pickle as _pickle
+
+        with pytest.raises((TypeError, AttributeError)):
+            _pickle.dumps(Conn("db:raw"))
+        # deepcopy must NOT silently route through the reducer either:
+        # the lock member is un-deepcopyable, so it raises (the global
+        # copyreg hook would have silently rebuilt from just .address).
+        with pytest.raises(TypeError):
+            copy.deepcopy(Conn("db:deep"))
     finally:
         deregister_serializer(Conn)
 
@@ -176,6 +190,30 @@ def test_dask_graph_scheduler():
         ray_dask_get({"a": (add, "a", 1)}, ["a"])  # self-cycle
     with pytest.raises(KeyError, match="not in the graph"):
         ray_dask_get({"x": (add, 1, 2)}, ["X"])
+
+
+def test_dask_tuple_keys():
+    """Dask collections key their graphs with tuples like ('chunk', i):
+    a non-task tuple that IS a graph key must resolve as a dependency
+    edge, and a non-task, non-key tuple is a literal (dask.core
+    semantics — lists descend, tuples don't)."""
+    from operator import add
+
+    from ray_tpu.util.dask import ray_dask_get
+
+    dsk = {
+        ("chunk-a", 0): 10,
+        ("chunk-a", 1): (add, ("chunk-a", 0), 5),
+        "total": (add, ("chunk-a", 1), ("chunk-a", 0)),
+        # list of keys descends; literal tuple of key-shaped strings
+        # stays a literal.
+        "gather": (sorted, [("chunk-a", 1), ("chunk-a", 0)]),
+        "lit": (len, ("chunk-a", "not-a-key", "x")),
+    }
+    total, gather, lit = ray_dask_get(dsk, ["total", "gather", "lit"])
+    assert total == 25
+    assert gather == [10, 15]
+    assert lit == 3
 
 
 def test_dask_enable_gates():
