@@ -83,9 +83,11 @@ class WorkerRecord:
     __slots__ = (
         "worker_id", "node_id", "conn", "proc", "pid", "busy", "actor_id",
         "inflight", "started_at", "tpu_chips", "acquired", "ready", "pg_alloc",
+        "tpu_capable",
     )
 
-    def __init__(self, worker_id: str, node_id: str, proc):
+    def __init__(self, worker_id: str, node_id: str, proc,
+                 tpu_capable: bool = False):
         self.worker_id = worker_id
         self.node_id = node_id
         self.conn: rpc.Connection | None = None
@@ -104,6 +106,10 @@ class WorkerRecord:
         self.acquired: ResourceSet | None = None
         self.pg_alloc: tuple[str, int, ResourceSet] | None = None  # (pg_id, bundle, demand)
         self.ready = False  # set by worker_ready (two-phase registration)
+        # Spawned with device-plugin hooks intact (can take TPU leases).
+        # Chipless pool workers spawn with the hooks stripped so their
+        # jax can never touch — or hang on — the TPU path.
+        self.tpu_capable = tpu_capable
 
 
 class ActorRecord:
@@ -244,10 +250,15 @@ class Head:
         # workers, raylet/worker_pool.h:224): first tasks skip the
         # process-spawn + import latency. Opt-in via
         # _system_config={"worker_pool_prestart": N}.
-        for _ in range(min(config.worker_pool_prestart,
-                           self.max_pool_workers)):
+        # TPU-capable and chipless pools are disjoint, so on a TPU node
+        # part of the prestart budget goes to TPU-capable workers or the
+        # first TPU task would always pay cold-start.
+        n_prestart = min(config.worker_pool_prestart, self.max_pool_workers)
+        n_tpu = min(n_prestart // 2, int(node_resources.get("TPU", 0))) \
+            if node_resources.get("TPU", 0) else 0
+        for i in range(n_prestart):
             try:
-                self.spawn_worker(self.node_id)
+                self.spawn_worker(self.node_id, tpu_capable=i < n_tpu)
             except Exception:
                 traceback.print_exc()
                 print("ray_tpu: worker prestart failed; first tasks will "
@@ -299,13 +310,20 @@ class Head:
         res[f"node:{self.node_id if hasattr(self, 'node_id') else '127.0.0.1'}"] = 1.0
         return res
 
-    def spawn_worker(self, node_id: str) -> WorkerRecord:
+    def spawn_worker(self, node_id: str,
+                     tpu_capable: bool = False) -> WorkerRecord:
         """Start a pool worker on `node_id`: fork locally, or route the
         spawn through the node's agent connection for remote nodes
         (reference analogue: WorkerPool::StartWorkerProcess,
-        raylet/worker_pool.h:224; remote = raylet-side pool)."""
+        raylet/worker_pool.h:224; remote = raylet-side pool).
+
+        ``tpu_capable`` workers keep any TPU device-plugin startup hooks
+        so they can take chip leases; chipless pool workers spawn with
+        the hooks stripped (hermetic.strip_plugin_hooks) — a plugin that
+        loads at interpreter start ignores per-task JAX_PLATFORMS pins
+        and would capture or hang the worker's jax on the TPU path."""
         if node_id != self.node_id:
-            return self._spawn_remote_worker(node_id)
+            return self._spawn_remote_worker(node_id, tpu_capable)
         worker_id = "worker-" + uuid.uuid4().hex[:8]
         env = dict(os.environ)
         env["RAY_TPU_WORKER_ID"] = worker_id
@@ -319,6 +337,10 @@ class Head:
         extra = [p for p in sys.path if p and os.path.isdir(p)]
         existing = env.get("PYTHONPATH", "")
         env["PYTHONPATH"] = os.pathsep.join(extra + ([existing] if existing else []))
+        if not tpu_capable:
+            from ray_tpu._private.hermetic import strip_plugin_hooks
+
+            strip_plugin_hooks(env)
         logs = os.path.join(self.session_dir, "logs")
         os.makedirs(logs, exist_ok=True)
         with open(os.path.join(logs, f"{worker_id}.log"), "ab") as out:
@@ -329,7 +351,7 @@ class Head:
                 stderr=subprocess.STDOUT,
                 cwd=os.getcwd(),
             )  # the child keeps its inherited fd; don't leak one per spawn
-        rec = WorkerRecord(worker_id, node_id, proc)
+        rec = WorkerRecord(worker_id, node_id, proc, tpu_capable)
         # Best-effort cgroup v2 isolation: workers land in the node's
         # application slice (reference: cgroup_setup.h; no-op without a
         # writable cgroupfs).
@@ -340,11 +362,12 @@ class Head:
             self.workers[worker_id] = rec
         return rec
 
-    def _spawn_remote_worker(self, node_id: str) -> WorkerRecord:
+    def _spawn_remote_worker(self, node_id: str,
+                             tpu_capable: bool = False) -> WorkerRecord:
         """Ask the node's agent to fork a worker (reference: raylet spawns
         its own workers after the GCS-side lease decision)."""
         worker_id = "worker-" + uuid.uuid4().hex[:8]
-        rec = WorkerRecord(worker_id, node_id, None)
+        rec = WorkerRecord(worker_id, node_id, None, tpu_capable)
         with self.lock:
             agent = self.node_agents.get(node_id)
             self.workers[worker_id] = rec
@@ -356,6 +379,7 @@ class Head:
                         "worker_id": worker_id,
                         "head": f"{self.address[0]}:{self.address[1]}",
                         "node_id": node_id,
+                        "tpu_capable": tpu_capable,
                     },
                 )
             except rpc.ConnectionLost:
@@ -1300,10 +1324,13 @@ class Head:
                     if node is None:
                         requeue.append(spec)
                         continue
-                    rec = self._idle_worker(node.node_id)
+                    need_tpu = float(spec.resources.get("TPU", 0)) > 0
+                    rec = self._idle_worker(node.node_id, need_tpu)
                     if rec is None:
-                        if not spawned and self._can_spawn(node.node_id):
-                            self.spawn_worker(node.node_id)
+                        if not spawned and self._can_spawn(node.node_id,
+                                                           need_tpu):
+                            self.spawn_worker(node.node_id,
+                                              tpu_capable=need_tpu)
                             spawned = True
                         requeue.append(spec)
                         continue
@@ -1360,7 +1387,12 @@ class Head:
             return NodeAffinitySchedulingStrategy(node_id=node_id, soft=False)
         return s
 
-    def _idle_worker(self, node_id: str) -> WorkerRecord | None:
+    def _idle_worker(self, node_id: str,
+                     need_tpu: bool = False) -> WorkerRecord | None:
+        """TPU tasks need a plugin-intact (tpu_capable) worker; chipless
+        tasks need a hook-stripped one — a tpu_capable worker running a
+        chipless task would still initialize the TPU plugin on its first
+        jax use, contending for chips the lease never granted."""
         for rec in self.workers.values():
             if (
                 rec.node_id == node_id
@@ -1368,12 +1400,21 @@ class Head:
                 and rec.ready
                 and not rec.busy
                 and rec.actor_id is None
+                and rec.tpu_capable == need_tpu
             ):
                 return rec
         return None
 
-    def _can_spawn(self, node_id: str) -> bool:
-        count = sum(1 for r in self.workers.values() if r.node_id == node_id and r.actor_id is None)
+    def _can_spawn(self, node_id: str, tpu_capable: bool = False) -> bool:
+        """Pool caps are per worker kind: TPU-capable and hook-stripped
+        pool workers are disjoint (cannot serve each other's tasks), so
+        a pool full of idle TPU workers must not starve chipless tasks
+        of their own spawn budget — and vice versa."""
+        count = sum(
+            1 for r in self.workers.values()
+            if r.node_id == node_id and r.actor_id is None
+            and r.tpu_capable == tpu_capable
+        )
         return count < self.max_pool_workers
 
     def _push_to_worker(self, rec: WorkerRecord, spec: TaskSpec) -> None:
@@ -1404,7 +1445,9 @@ class Head:
         node = self.scheduler.pick_node(demand, strategy)
         if node is None:
             return
-        rec = self.spawn_worker(node.node_id)
+        rec = self.spawn_worker(
+            node.node_id,
+            tpu_capable=float(spec.resources.get("TPU", 0)) > 0)
         rec.actor_id = spec.actor_id
         if not self._try_allocate(rec, node.node_id, spec.resources, spec.scheduling_strategy):
             if rec.proc is not None:

@@ -1,0 +1,77 @@
+"""Hermetic CPU-JAX subprocess environments.
+
+Multi-chip behavior is validated on a virtual N-device CPU mesh (the way
+the reference simulates multi-node clusters in-process — SURVEY.md §4,
+reference: python/ray/cluster_utils.py:135). That only works if the
+subprocess is *hermetic*: a TPU device plugin registered by an
+interpreter-startup hook (sitecustomize on PYTHONPATH, gated by its own
+env vars) can wrap jax backend initialization and block or capture even
+``JAX_PLATFORMS=cpu`` processes when the hardware path is degraded.
+
+``hermetic_cpu_env`` builds an environment that (a) pins jax to a CPU
+platform with a forced device count and (b) strips interpreter-startup
+hooks — PYTHONPATH entries shipping a ``sitecustomize.py`` and the env
+gates that activate them — so the child's jax sees only what we ask for.
+"""
+
+from __future__ import annotations
+
+import os
+
+# Env vars that gate experimental device-plugin site hooks. Unset in
+# hermetic children so the hook never activates.
+_PLUGIN_GATE_PREFIXES = ("PALLAS_AXON_", "AXON_")
+_PLUGIN_GATE_VARS = ("PALLAS_AXON_POOL_IPS",)
+
+
+def _has_sitecustomize(path: str) -> bool:
+    try:
+        return os.path.isfile(os.path.join(path, "sitecustomize.py"))
+    except OSError:
+        return False
+
+
+def hermetic_cpu_env(n_devices: int,
+                     base: "dict[str, str] | None" = None) -> dict:
+    """Environment for a subprocess that must run jax on ``n_devices``
+    virtual CPU devices regardless of what device plugins this process
+    inherited."""
+    env = strip_plugin_hooks(dict(os.environ if base is None else base))
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if "host_platform_device_count" not in f]
+    flags.append(f"--xla_force_host_platform_device_count={n_devices}")
+    env["XLA_FLAGS"] = " ".join(flags)
+    return env
+
+
+def strip_plugin_hooks(env: dict) -> dict:
+    """Remove TPU device-plugin interpreter-startup hooks from a spawn
+    env IN PLACE (gate vars + PYTHONPATH entries shipping a
+    sitecustomize.py). Used for chipless pool workers: the TPU-invisible
+    analogue of the reference's CUDA_VISIBLE_DEVICES="" (reference:
+    _private/accelerators/tpu.py:193 visibility pinning) for plugins
+    that load at interpreter start and would otherwise capture or hang
+    the worker's jax backend init regardless of JAX_PLATFORMS."""
+    for k in list(env):
+        if k in _PLUGIN_GATE_VARS or k.startswith(_PLUGIN_GATE_PREFIXES):
+            env.pop(k)
+    parts = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+             if p and not _has_sitecustomize(p)]
+    env["PYTHONPATH"] = os.pathsep.join(parts)
+    return env
+
+
+def is_hermetic_cpu() -> bool:
+    """True when this process was launched from hermetic_cpu_env:
+    cpu-pinned AND free of every plugin gate that strip_plugin_hooks
+    removes (gate vars, gate prefixes, sitecustomize PYTHONPATH
+    entries) — the same set, so the two can't drift apart."""
+    if os.environ.get("JAX_PLATFORMS") != "cpu":
+        return False
+    if any(k in _PLUGIN_GATE_VARS or k.startswith(_PLUGIN_GATE_PREFIXES)
+           for k in os.environ):
+        return False
+    return not any(
+        p and _has_sitecustomize(p)
+        for p in os.environ.get("PYTHONPATH", "").split(os.pathsep))

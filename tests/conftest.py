@@ -10,29 +10,56 @@ also stay off the real TPU.
 import os
 import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-
-# The env vars alone are not enough when a sitecustomize has already
-# imported jax (its config defaults are then frozen from the original
-# environment). jax.config.update rewrites the live config, and the
-# backend has not been initialized yet at conftest-import time.
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
-assert jax.device_count() == 8, (
-    "tests require the virtual 8-device CPU mesh, got "
-    f"{jax.devices()}"
-)
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    """Hermeticity: if this interpreter inherited a TPU device-plugin
+    site hook (its gate vars are set), env pins are NOT enough — the
+    hook wraps backend init and can hang even JAX_PLATFORMS=cpu when the
+    hardware path is degraded. Re-exec the whole pytest run once under a
+    sanitized environment (plugin gates unset, startup-hook PYTHONPATH
+    entries stripped, cpu pinned) so tests never depend on TPU
+    reachability.
+
+    Done from pytest_configure, not conftest import: initial conftests
+    load inside the capture manager's global-capture window, where fds
+    1/2 point at capture temp files — an exec there would silently send
+    the whole run's output into them. By configure time capture is
+    suspended and the real fds are back.
+    """
+    from ray_tpu._private.hermetic import hermetic_cpu_env, is_hermetic_cpu
+
+    if not is_hermetic_cpu() and os.environ.get("_RAY_TPU_TEST_REEXEC") != "1":
+        env = hermetic_cpu_env(8)
+        env["_RAY_TPU_TEST_REEXEC"] = "1"
+        # -m pytest, not argv[0]: pytest's __main__.py run as a script
+        # path loses console output.
+        os.execve(sys.executable,
+                  [sys.executable, "-m", "pytest"] + sys.argv[1:], env)
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
+    # The env vars alone are not enough when a sitecustomize has already
+    # imported jax (its config defaults are then frozen from the original
+    # environment). jax.config.update rewrites the live config, and the
+    # backend has not been initialized yet at configure time (test
+    # modules import later, during collection).
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 8)
+    assert jax.device_count() == 8, (
+        "tests require the virtual 8-device CPU mesh, got "
+        f"{jax.devices()}"
+    )
 
 
 @pytest.fixture

@@ -1,0 +1,92 @@
+"""Worker backend-env hermeticity.
+
+A TPU device plugin that loads from an interpreter-startup hook
+(sitecustomize on PYTHONPATH, activated by its own env gates) ignores
+per-task JAX_PLATFORMS pins. Chipless pool workers must therefore spawn
+with the hook stripped — the TPU-invisible analogue of the reference
+making unleased GPUs invisible via CUDA_VISIBLE_DEVICES="" (reference:
+python/ray/_private/accelerators/tpu.py:193) — while TPU-leased workers
+keep it plus their chip pinning.
+"""
+
+import os
+
+import pytest
+
+import ray_tpu
+
+GATE = "PALLAS_AXON_POOL_IPS"
+
+
+@pytest.fixture
+def fake_plugin_env(tmp_path, monkeypatch):
+    hook_dir = tmp_path / "fake_site"
+    hook_dir.mkdir()
+    (hook_dir / "sitecustomize.py").write_text("")
+    monkeypatch.setenv(GATE, "10.0.0.1")
+    monkeypatch.setenv(
+        "PYTHONPATH",
+        str(hook_dir) + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    yield str(hook_dir)
+
+
+def test_chipless_worker_strips_plugin_hooks(fake_plugin_env):
+    ray_tpu.init(num_cpus=2, object_store_memory=32 * 1024 * 1024)
+    try:
+        @ray_tpu.remote
+        def probe():
+            return {
+                "gate": os.environ.get(GATE),
+                "pythonpath": os.environ.get("PYTHONPATH", ""),
+            }
+
+        out = ray_tpu.get(probe.remote())
+        assert out["gate"] is None
+        assert fake_plugin_env not in out["pythonpath"]
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_tpu_worker_keeps_plugin_and_pins_chips(fake_plugin_env):
+    ray_tpu.init(num_cpus=2, resources={"TPU": 2},
+                 object_store_memory=32 * 1024 * 1024)
+    try:
+        @ray_tpu.remote(resources={"TPU": 1})
+        def tpu_probe():
+            return {
+                "gate": os.environ.get(GATE),
+                "chips": os.environ.get("TPU_VISIBLE_CHIPS"),
+            }
+
+        @ray_tpu.remote
+        def cpu_probe():
+            return os.environ.get(GATE)
+
+        out = ray_tpu.get(tpu_probe.remote())
+        assert out["gate"] == "10.0.0.1"
+        assert out["chips"] is not None
+        # Chipless work in the same cluster still lands on a stripped
+        # worker — TPU and CPU pool workers are disjoint.
+        assert ray_tpu.get(cpu_probe.remote()) is None
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_tpu_actor_worker_keeps_plugin(fake_plugin_env):
+    ray_tpu.init(num_cpus=2, resources={"TPU": 2},
+                 object_store_memory=32 * 1024 * 1024)
+    try:
+        @ray_tpu.remote(resources={"TPU": 2})
+        class TpuActor:
+            def probe(self):
+                return {
+                    "gate": os.environ.get(GATE),
+                    "chips": os.environ.get("TPU_VISIBLE_CHIPS"),
+                }
+
+        a = TpuActor.remote()
+        out = ray_tpu.get(a.probe.remote())
+        assert out["gate"] == "10.0.0.1"
+        assert out["chips"] == "0,1"
+    finally:
+        ray_tpu.shutdown()
