@@ -34,9 +34,11 @@ from ray_tpu.api import (
     shutdown,
     wait,
 )
+from ray_tpu.actor import method
 from ray_tpu import exceptions
 
 __all__ = [
+    "method",
     "__version__",
     "ObjectRef",
     "available_resources",

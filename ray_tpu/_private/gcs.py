@@ -1516,6 +1516,9 @@ class Head:
                         "spec": creation,
                         "actor_id": rec.actor_id,
                         "max_concurrency": actor.spec.max_concurrency if actor else 1,
+                        "concurrency_groups": getattr(
+                            actor.spec, "concurrency_groups", None
+                        ) if actor else None,
                         "tpu_chips": rec.tpu_chips,
                     },
                 )

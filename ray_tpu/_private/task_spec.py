@@ -33,6 +33,10 @@ class TaskSpec:
     actor_creation: bool = False
     method_name: str = ""
     seq_no: int = 0  # per-caller ordering for actor calls
+    # Concurrency group this call runs under (reference:
+    # core_worker/transport/concurrency_group_manager.h:37). None =
+    # method-level annotation or the default group.
+    concurrency_group: str | None = None
 
 
 @dataclasses.dataclass
@@ -50,3 +54,8 @@ class ActorSpec:
     scheduling_strategy: Any = None
     runtime_env: dict | None = None
     lifetime: str | None = None  # "detached" or None
+    # {"group_name": max_concurrency} (reference:
+    # concurrency_group_manager.h:37; Python API
+    # @ray.remote(concurrency_groups={...})). Applies to threaded AND
+    # async actors; the default group runs at max_concurrency.
+    concurrency_groups: dict | None = None

@@ -15,6 +15,8 @@ threaded-actor semantics).
 
 from __future__ import annotations
 
+import asyncio
+import inspect
 import os
 import sys
 import threading
@@ -30,6 +32,41 @@ from ray_tpu._private.task_spec import TaskSpec
 from ray_tpu.exceptions import TaskError
 
 
+class _AsyncActorExecutor:
+    """Event loop hosting an async actor's method calls (reference:
+    boost::fiber execution for async actors, transport/fiber.h:17 +
+    ConcurrencyGroupManager, concurrency_group_manager.h:37).
+
+    All coroutines run on ONE loop thread — methods interleave at await
+    points, bounded per concurrency group by an asyncio.Semaphore. Sync
+    methods of an async actor run inline on the loop (reference
+    semantics: they block it)."""
+
+    def __init__(self, groups: dict[str, int], default_limit: int):
+        self.loop = asyncio.new_event_loop()
+        self._limits = dict(groups or {})
+        self._default_limit = default_limit
+        self._sems: dict[str, asyncio.Semaphore] = {}
+        threading.Thread(target=self._run, daemon=True,
+                         name="actor-asyncio").start()
+
+    def _run(self) -> None:
+        asyncio.set_event_loop(self.loop)
+        self.loop.run_forever()
+
+    def semaphore(self, group: str | None) -> asyncio.Semaphore:
+        """Loop-thread only (single-threaded: no lock needed)."""
+        key = group or "_default"
+        sem = self._sems.get(key)
+        if sem is None:
+            limit = self._limits.get(key, self._default_limit)
+            sem = self._sems[key] = asyncio.Semaphore(limit)
+        return sem
+
+    def submit(self, coro) -> None:
+        asyncio.run_coroutine_threadsafe(coro, self.loop)
+
+
 class Worker:
     def __init__(self, head_addr: tuple[str, int], worker_id: str, node_id: str):
         self.worker_id = worker_id
@@ -40,6 +77,20 @@ class Worker:
         self.executor = ThreadPoolExecutor(max_workers=1, thread_name_prefix="task-exec")
         self.actor_instance = None
         self.actor_id: str | None = None
+        # Async-actor event loop (set after creation when the class has
+        # coroutine methods) and threaded per-concurrency-group pools.
+        self.async_exec: _AsyncActorExecutor | None = None
+        self.group_execs: dict[str, ThreadPoolExecutor] = {}
+        self.actor_concurrency_groups: dict | None = None
+        self.actor_max_concurrency = 1
+        # Two pools for coroutine-side blocking IO. Fetch (arg
+        # resolution) can block on objects produced by this actor's OWN
+        # pending calls; stores must never queue behind those blocked
+        # threads or the actor deadlocks — hence a dedicated store pool.
+        self._fetch_pool = ThreadPoolExecutor(max_workers=8,
+                                              thread_name_prefix="actor-fetch")
+        self._store_pool = ThreadPoolExecutor(max_workers=4,
+                                              thread_name_prefix="actor-store")
         self._exit = threading.Event()
         self.runtime = CoreRuntime(
             head_addr,
@@ -58,14 +109,24 @@ class Worker:
 
     def _on_message(self, kind: str, body: dict):
         if kind == "push_task":
-            self.executor.submit(self._run_task_guarded, body["spec"], body.get("tpu_chips"))
+            spec = body["spec"]
+            if (self.async_exec is not None and spec.actor_id is not None
+                    and not spec.actor_creation):
+                self.async_exec.submit(self._run_task_async_guarded(spec))
+            else:
+                self._executor_for(spec).submit(
+                    self._run_task_guarded, spec, body.get("tpu_chips"))
         elif kind == "become_actor":
             self.actor_id = body["actor_id"]
             # Actor-lifetime env: actor METHOD tasks carry no runtime_env
             # of their own; nested submissions inherit the creation env.
             self.actor_runtime_env = body["spec"].runtime_env
             worker_context.set_process_base_runtime_env(self.actor_runtime_env)
-            maxc = max(1, int(body.get("max_concurrency", 1)))
+            # 0 = unset (see ActorClass.remote): threaded actors treat it
+            # as 1; async actors treat it as the 1000 default.
+            maxc = int(body.get("max_concurrency") or 0)
+            self.actor_max_concurrency = maxc
+            self.actor_concurrency_groups = body.get("concurrency_groups")
             if maxc > 1:
                 self.executor = ThreadPoolExecutor(
                     max_workers=maxc, thread_name_prefix="actor-exec"
@@ -96,6 +157,139 @@ class Worker:
             os.environ["TPU_CHIPS_PER_PROCESS_BOUNDS"] = f"1,{len(chips)},1"
         elif "jax" not in sys.modules:
             os.environ["JAX_PLATFORMS"] = "cpu"
+
+    # ------------------------------------------------------------------
+    # actor concurrency plumbing
+
+    def _task_group(self, spec: TaskSpec) -> str | None:
+        """Per-call group override, else the method's @ray_tpu.method
+        annotation, else the default group."""
+        if spec.concurrency_group:
+            return spec.concurrency_group
+        fn = getattr(type(self.actor_instance), spec.method_name, None) \
+            if self.actor_instance is not None else None
+        return getattr(fn, "__ray_tpu_concurrency_group__", None)
+
+    def _executor_for(self, spec: TaskSpec) -> ThreadPoolExecutor:
+        if spec.actor_id is None or spec.actor_creation or not self.group_execs:
+            return self.executor
+        group = self._task_group(spec)
+        return self.group_execs.get(group, self.executor)
+
+    def _setup_actor_executor(self) -> None:
+        """After __init__ of the actor instance (the head holds method
+        calls until creation completes, so the mode switch cannot race a
+        push): async actors get an event loop; threaded actors with
+        concurrency_groups get one pool per group (reference:
+        concurrency_group_manager.h:37)."""
+        cls = type(self.actor_instance)
+        is_async = any(
+            inspect.iscoroutinefunction(getattr(cls, n, None))
+            or inspect.isasyncgenfunction(getattr(cls, n, None))
+            for n in dir(cls) if not n.startswith("_")
+        )
+        groups = self.actor_concurrency_groups
+        if is_async:
+            # Reference default: async actors run up to 1000 concurrent
+            # calls unless max_concurrency narrows it — including an
+            # EXPLICIT max_concurrency=1 (0 means the user never set it).
+            limit = (self.actor_max_concurrency
+                     if self.actor_max_concurrency >= 1 else 1000)
+            self.async_exec = _AsyncActorExecutor(groups or {}, limit)
+        elif groups:
+            self.group_execs = {
+                name: ThreadPoolExecutor(
+                    max_workers=limit,
+                    thread_name_prefix=f"actor-cg-{name}")
+                for name, limit in groups.items()
+            }
+
+    async def _run_task_async_guarded(self, spec: TaskSpec) -> None:
+        import time
+
+        start = time.time()
+        failed = False
+        sem = self.async_exec.semaphore(self._task_group(spec))
+        async with sem:
+            try:
+                failed = not await self._run_task_async(spec)
+            except Exception:
+                traceback.print_exc()
+                failed = True
+        try:
+            self.runtime.conn.cast(
+                "task_finished",
+                {"worker_id": self.worker_id, "task_id": spec.task_id,
+                 "failed": failed},
+            )
+            self.runtime.conn.cast(
+                "task_events",
+                {"events": [{
+                    "task_id": spec.task_id, "name": spec.name,
+                    "worker_id": self.worker_id, "node_id": self.node_id,
+                    "pid": os.getpid(), "start": start,
+                    "end": time.time(), "failed": failed,
+                }]},
+            )
+        except Exception:
+            pass
+
+    async def _run_task_async(self, spec: TaskSpec) -> bool:
+        """Async-actor method execution: coroutines await on the loop;
+        blocking IO offloads to the fetch/store pools. The
+        task context rides a ContextVar, so interleaved calls each keep
+        their own across awaits."""
+        loop = asyncio.get_running_loop()
+        inherited = getattr(self, "actor_runtime_env", None)
+        env_token = worker_context.push_process_runtime_env(inherited)
+        worker_context.set_task_context(
+            worker_context.TaskContext(spec.task_id, self.actor_id,
+                                       self.node_id, inherited))
+        try:
+            args, kwargs = await loop.run_in_executor(
+                self._fetch_pool, self._load_args, spec)
+            method = getattr(self.actor_instance, spec.method_name)
+            result = method(*args, **kwargs)
+            if inspect.iscoroutine(result):
+                result = await result
+            if spec.streaming:
+                if hasattr(result, "__anext__"):
+                    await self._store_async_gen(spec, result)
+                else:
+                    await loop.run_in_executor(
+                        self._store_pool, self._store_generator_items, spec,
+                        result)
+            else:
+                await loop.run_in_executor(
+                    self._store_pool, self._store_returns, spec, result)
+            return True
+        except Exception as e:  # noqa: BLE001
+            err = TaskError(repr(e), traceback.format_exc(), spec.name)
+            await loop.run_in_executor(
+                self._store_pool, self._store_error, spec, err)
+            return False
+        finally:
+            worker_context.set_task_context(None)
+            worker_context.pop_process_runtime_env(env_token)
+
+    async def _store_async_gen(self, spec: TaskSpec, agen) -> None:
+        """Streaming async generator (reference: async generators over
+        ReportGeneratorItemReturns): items stored as produced."""
+        from functools import partial
+
+        from ray_tpu.generator import item_object_id
+
+        loop = asyncio.get_running_loop()
+        count = 0
+        async for item in agen:
+            await loop.run_in_executor(
+                self._store_pool,
+                partial(self.runtime.put, item,
+                        _object_id=item_object_id(spec.task_id, count)))
+            count += 1
+        await loop.run_in_executor(
+            self._store_pool,
+            partial(self.runtime.put, count, _object_id=spec.return_ids[0]))
 
     # ------------------------------------------------------------------
 
@@ -184,13 +378,12 @@ class Worker:
                 cache = os.path.join(self.runtime.session_dir, "runtime_env_cache")
                 os.makedirs(cache, exist_ok=True)
                 applied_env.apply(spec.runtime_env, self.runtime, cache)
-            args, kwargs = cloudpickle.loads(spec.args)
-            args = [self._resolve(a) for a in args]
-            kwargs = {k: self._resolve(v) for k, v in kwargs.items()}
+            args, kwargs = self._load_args(spec)
 
             if spec.actor_creation:
                 cls = self.runtime.get_function(spec.func_id)
                 self.actor_instance = cls(*args, **kwargs)
+                self._setup_actor_executor()
                 self.runtime.put("ok", _object_id=spec.return_ids[0])
                 return True
             if spec.actor_id is not None:
@@ -204,12 +397,8 @@ class Worker:
                 self._store_returns(spec, result)
             return True
         except Exception as e:  # noqa: BLE001
-            err = TaskError(repr(e), traceback.format_exc(), spec.name)
-            for oid in spec.return_ids:
-                try:
-                    self.runtime.put(err, _object_id=oid, _is_error=True)
-                except Exception:
-                    traceback.print_exc()
+            self._store_error(
+                spec, TaskError(repr(e), traceback.format_exc(), spec.name))
             return False
         finally:
             worker_context.set_task_context(None)
@@ -226,6 +415,18 @@ class Worker:
                         os.environ.pop(k, None)
                     else:
                         os.environ[k] = v
+
+    def _load_args(self, spec: TaskSpec):
+        args, kwargs = cloudpickle.loads(spec.args)
+        return ([self._resolve(a) for a in args],
+                {k: self._resolve(v) for k, v in kwargs.items()})
+
+    def _store_error(self, spec: TaskSpec, err: TaskError) -> None:
+        for oid in spec.return_ids:
+            try:
+                self.runtime.put(err, _object_id=oid, _is_error=True)
+            except Exception:
+                traceback.print_exc()
 
     def _resolve(self, value):
         if isinstance(value, ObjectRef):

@@ -12,10 +12,17 @@ from typing import TYPE_CHECKING
 if TYPE_CHECKING:
     from ray_tpu._private.runtime import CoreRuntime
 
+import contextvars
+
 _lock = threading.Lock()
 _runtime: "CoreRuntime | None" = None
 _head = None  # set when this process hosts the head (driver)
-_task_context = threading.local()
+# ContextVar, not threading.local: plain threads each see their own
+# value (fresh threads start empty, same as a thread-local), and asyncio
+# preserves it per-task — async actor methods interleaving on one event
+# loop each keep their own task context across awaits.
+_task_context: "contextvars.ContextVar[TaskContext | None]" = (
+    contextvars.ContextVar("ray_tpu_task_context", default=None))
 
 
 def set_runtime(rt, head=None) -> None:
@@ -113,8 +120,8 @@ class TaskContext:
 
 
 def set_task_context(ctx: TaskContext | None) -> None:
-    _task_context.ctx = ctx
+    _task_context.set(ctx)
 
 
 def get_task_context() -> TaskContext:
-    return getattr(_task_context, "ctx", None) or TaskContext()
+    return _task_context.get() or TaskContext()

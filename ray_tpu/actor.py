@@ -20,16 +20,23 @@ from ray_tpu.remote_function import _normalize_resources, _pack_env
 
 
 class ActorMethod:
-    def __init__(self, handle: "ActorHandle", name: str, num_returns=1):
+    def __init__(self, handle: "ActorHandle", name: str, num_returns=1,
+                 concurrency_group: str | None = None):
         self._handle = handle
         self._name = name
         self._num_returns = num_returns
+        self._concurrency_group = concurrency_group
 
-    def options(self, num_returns=1, **_):
-        return ActorMethod(self._handle, self._name, num_returns)
+    def options(self, num_returns=None, concurrency_group=None, **_):
+        return ActorMethod(
+            self._handle, self._name,
+            self._num_returns if num_returns is None else num_returns,
+            concurrency_group or self._concurrency_group)
 
     def remote(self, *args, **kwargs):
-        return self._handle._submit_method(self._name, args, kwargs, self._num_returns)
+        return self._handle._submit_method(
+            self._name, args, kwargs, self._num_returns,
+            self._concurrency_group)
 
     def bind(self, *args, **kwargs):
         """Capture this call as a DAG node (reference: dag/class_node.py)."""
@@ -49,10 +56,13 @@ class ActorHandle:
         actor_id: str,
         method_names: tuple[str, ...] = (),
         gen_methods: tuple[str, ...] = (),
+        method_meta: dict | None = None,
     ):
         self._actor_id = actor_id
         self._method_names = method_names
         self._gen_methods = gen_methods
+        # {name: (num_returns, concurrency_group)} from @ray_tpu.method.
+        self._method_meta = method_meta or {}
         self._seq = 0
 
     @property
@@ -62,10 +72,13 @@ class ActorHandle:
     def __getattr__(self, name: str) -> ActorMethod:
         if name.startswith("_"):
             raise AttributeError(name)
-        default_nr = "streaming" if name in self._gen_methods else 1
-        return ActorMethod(self, name, default_nr)
+        nr, group = self._method_meta.get(name, (1, None))
+        if name in self._gen_methods:
+            nr = "streaming"
+        return ActorMethod(self, name, nr, group)
 
-    def _submit_method(self, method: str, args, kwargs, num_returns):
+    def _submit_method(self, method: str, args, kwargs, num_returns,
+                       concurrency_group: str | None = None):
         rt = global_runtime()
         packed, deps = rt.pack_args(args, kwargs)
         streaming = num_returns in ("streaming", "dynamic")
@@ -86,6 +99,7 @@ class ActorHandle:
             method_name=method,
             seq_no=self._seq,
             streaming=streaming,
+            concurrency_group=concurrency_group,
         )
         rt.submit_actor_task(spec)
         if streaming:
@@ -96,7 +110,8 @@ class ActorHandle:
         return refs[0] if num_returns == 1 else refs
 
     def __reduce__(self):
-        return (ActorHandle, (self._actor_id, self._method_names, self._gen_methods))
+        return (ActorHandle, (self._actor_id, self._method_names,
+                              self._gen_methods, self._method_meta))
 
     def __repr__(self):
         return f"ActorHandle({self._actor_id[:16]})"
@@ -145,7 +160,12 @@ class ActorClass:
                 default_cpus=0.0,
             ),
             max_restarts=int(opts.get("max_restarts", GLOBAL_CONFIG.actor_max_restarts_default)),
-            max_concurrency=int(opts.get("max_concurrency", 1)),
+            # 0 = unset: async actors then default to 1000-way
+            # concurrency, while an EXPLICIT max_concurrency=1 really
+            # serializes their coroutines (reference semantics).
+            max_concurrency=int(opts.get("max_concurrency") or 0),
+            concurrency_groups=_validate_concurrency_groups(
+                opts.get("concurrency_groups")),
             owner_id=rt.client_id,
             scheduling_strategy=opts.get("scheduling_strategy"),
             runtime_env=_pack_env(opts.get("runtime_env"), rt),
@@ -158,9 +178,50 @@ class ActorClass:
             n for n in dir(self._cls) if callable(getattr(self._cls, n, None)) and not n.startswith("_")
         )
         gen_methods = tuple(
-            n for n in methods if inspect.isgeneratorfunction(getattr(self._cls, n, None))
+            n for n in methods
+            if inspect.isgeneratorfunction(getattr(self._cls, n, None))
+            or inspect.isasyncgenfunction(getattr(self._cls, n, None))
         )
-        return ActorHandle(actor_id, methods, gen_methods)
+        meta = {}
+        for n in methods:
+            fn = getattr(self._cls, n, None)
+            nr = getattr(fn, "__ray_tpu_num_returns__", 1)
+            cg = getattr(fn, "__ray_tpu_concurrency_group__", None)
+            if nr != 1 or cg is not None:
+                meta[n] = (nr, cg)
+        return ActorHandle(actor_id, methods, gen_methods, meta)
+
+
+def _validate_concurrency_groups(groups) -> dict | None:
+    """{"name": limit} (reference: concurrency_group_manager.h:37 via
+    @ray.remote(concurrency_groups={...}))."""
+    if groups is None:
+        return None
+    if not isinstance(groups, dict) or not all(
+        isinstance(k, str) and int(v) >= 1 for k, v in groups.items()
+    ):
+        raise ValueError(
+            "concurrency_groups must be a dict of group name -> positive "
+            f"max concurrency, got {groups!r}"
+        )
+    return {k: int(v) for k, v in groups.items()}
+
+
+def method(num_returns=1, concurrency_group: str | None = None):
+    """Per-method defaults (reference: python/ray/actor.py ray.method):
+
+        @ray_tpu.remote(concurrency_groups={"io": 2})
+        class A:
+            @ray_tpu.method(concurrency_group="io")
+            async def fetch(self): ...
+    """
+
+    def decorator(fn):
+        fn.__ray_tpu_num_returns__ = num_returns
+        fn.__ray_tpu_concurrency_group__ = concurrency_group
+        return fn
+
+    return decorator
 
 
 def creation_ref(handle: ActorHandle) -> ObjectRef:

@@ -237,6 +237,144 @@ class LLMEngine:
             return [done[rid] for rid in order]
 
 
+class AsyncLLMEngine:
+    """Async request-level driver over LLMEngine (reference:
+    llm/_internal/batch/stages/vllm_engine_stage.py engine loop; vLLM's
+    AsyncLLMEngine pattern). One background thread drives engine.step();
+    callers submit requests and await per-request futures — so requests
+    from CONCURRENT callers join the same running batch (true continuous
+    batching across HTTP requests), instead of serializing whole batches
+    behind the engine lock the way sync generate() does.
+
+    Optionally streams: ``generate(..., stream=True)`` returns an async
+    iterator of incremental token ids as the slot advances.
+    """
+
+    def __init__(self, engine: LLMEngine):
+        import queue as _queue
+
+        self.engine = engine
+        # Share the engine's own lock so sync generate() and this driver
+        # can never interleave engine state mutations.
+        self._lock = engine._lock
+        self._waiters: dict[str, Any] = {}          # rid -> concurrent Future
+        self._streams: dict[str, _queue.SimpleQueue] = {}
+        self._seen: dict[str, int] = {}             # rid -> tokens streamed
+        self._wake = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="llm-engine-loop")
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while True:
+            self._wake.wait()
+            while True:
+                with self._lock:
+                    if not self.engine.has_unfinished():
+                        self._wake.clear()
+                        break
+                    try:
+                        outs = self.engine.step()
+                        self._push_stream_tokens()
+                    except Exception as e:  # noqa: BLE001
+                        # A dead driver thread would hang every pending
+                        # AND future request; fail them all instead and
+                        # keep the loop alive (sync generate() would have
+                        # propagated the exception to its caller too).
+                        self._fail_all(e)
+                        continue
+                for out in outs:
+                    q = self._streams.pop(out.request_id, None)
+                    if q is not None:
+                        # Tokens from the finishing step never hit
+                        # _push_stream_tokens (the slot is cleared inside
+                        # step()): emit the unseen tail before the
+                        # terminal output so the incremental stream is
+                        # complete.
+                        n = self._seen.get(out.request_id, 0)
+                        for tok in out.token_ids[n:]:
+                            q.put(int(tok))
+                        q.put(out)  # terminal: the RequestOutput itself
+                    self._seen.pop(out.request_id, None)
+                    fut = self._waiters.pop(out.request_id, None)
+                    if fut is not None and not fut.done():
+                        fut.set_result(out)
+
+    def _fail_all(self, exc: Exception) -> None:
+        """lock held. Resolve every pending request with the failure and
+        reset the engine's queues so the loop can go idle."""
+        for fut in self._waiters.values():
+            if not fut.done():
+                fut.set_exception(exc)
+        self._waiters.clear()
+        for q in self._streams.values():
+            q.put(exc)  # aiter re-raises it
+        self._streams.clear()
+        self._seen.clear()
+        self.engine.waiting.clear()
+        self.engine.slots = [None] * len(self.engine.slots)
+
+    def _push_stream_tokens(self) -> None:
+        """lock held. Emit tokens generated since the last step to any
+        registered stream queues."""
+        if not self._streams:
+            return
+        for slot_req in self.engine.slots:
+            if slot_req is None:
+                continue
+            q = self._streams.get(slot_req.request_id)
+            if q is None:
+                continue
+            n = self._seen.get(slot_req.request_id, 0)
+            for tok in slot_req.generated[n:]:
+                q.put(int(tok))
+            self._seen[slot_req.request_id] = len(slot_req.generated)
+
+    async def generate(self, prompt: "str | list[int]",
+                       sampling_params: SamplingParams | None = None,
+                       stream: bool = False):
+        """Awaitable single-request generation; with stream=True returns
+        an async iterator yielding token ids then the final
+        RequestOutput."""
+        import asyncio
+        import concurrent.futures
+        import queue as _queue
+        import uuid as _uuid
+
+        loop = asyncio.get_running_loop()
+        rid = f"areq-{_uuid.uuid4().hex[:12]}"
+        # Tokenize off-loop (it is the only slow pre-admission work).
+        if isinstance(prompt, str):
+            toks = await loop.run_in_executor(
+                None, self.engine.tokenizer.encode, prompt)
+        else:
+            toks = list(prompt)
+        if stream:
+            q: _queue.SimpleQueue = _queue.SimpleQueue()
+            with self._lock:
+                self.engine.add_request(rid, toks, sampling_params)
+                self._streams[rid] = q
+                self._seen[rid] = 0
+            self._wake.set()
+
+            async def aiter():
+                while True:
+                    item = await loop.run_in_executor(None, q.get)
+                    if isinstance(item, Exception):
+                        raise item
+                    yield item
+                    if isinstance(item, RequestOutput):
+                        return
+
+            return aiter()
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+        with self._lock:
+            self.engine.add_request(rid, toks, sampling_params)
+            self._waiters[rid] = fut
+        self._wake.set()
+        return await asyncio.wrap_future(fut)
+
+
 def _load_checkpoint(path: str):
     """npz (flat dotted keys) or orbax checkpoint directory."""
     import os

@@ -45,8 +45,14 @@ class LLMServer:
     """One engine per replica; scale via num_replicas in build_openai_app."""
 
     def __init__(self, config: LLMConfig, params: Any = None):
+        from ray_tpu.llm.engine import AsyncLLMEngine
+
         self.config = config
         self.engine = LLMEngine(config, params)
+        # Request-level continuous batching: concurrent HTTP requests on
+        # this (async) replica join the engine's running batch instead
+        # of serializing whole generate() calls.
+        self.async_engine = AsyncLLMEngine(self.engine)
 
     # -- OpenAI schema helpers --------------------------------------------
 
@@ -80,12 +86,12 @@ class LLMServer:
 
     # -- entrypoint (Serve routes JSON bodies here) -----------------------
 
-    def __call__(self, payload: Any = None) -> dict:
+    async def __call__(self, payload: Any = None) -> dict:
         payload = payload if isinstance(payload, dict) else {}
         if "messages" in payload:
-            return self.chat(payload)
+            return await self.chat(payload)
         if "prompt" in payload:
-            return self.completions(payload)
+            return await self.completions(payload)
         return self.models()
 
     def models(self) -> dict:
@@ -98,7 +104,7 @@ class LLMServer:
             }],
         }
 
-    def completions(self, payload: dict) -> dict:
+    async def completions(self, payload: dict) -> dict:
         prompt = payload["prompt"]
         # OpenAI accepts: a string, a list of strings, a token array
         # (list of ints = ONE pre-tokenized prompt), or a list of token
@@ -111,7 +117,11 @@ class LLMServer:
             prompts = prompt
         else:
             prompts = [prompt]
-        outs = self.engine.generate(prompts, self._sampling(payload))
+        import asyncio
+
+        sp = self._sampling(payload)
+        outs = await asyncio.gather(
+            *[self.async_engine.generate(p, sp) for p in prompts])
         return {
             "id": f"cmpl-{uuid.uuid4().hex[:24]}",
             "object": "text_completion",
@@ -124,9 +134,9 @@ class LLMServer:
             "usage": self._usage(outs),
         }
 
-    def chat(self, payload: dict) -> dict:
+    async def chat(self, payload: dict) -> dict:
         prompt = self._render_chat(payload["messages"])
-        out = self.engine.generate([prompt], self._sampling(payload))[0]
+        out = await self.async_engine.generate(prompt, self._sampling(payload))
         return {
             "id": f"chatcmpl-{uuid.uuid4().hex[:24]}",
             "object": "chat.completion",

@@ -223,7 +223,9 @@ class ServeController:
         actor_cls = ray_tpu.remote(**opts)(Replica)
         init_args = tuple(self._resolve(a) for a in spec["init_args"])
         init_kwargs = {k: self._resolve(v) for k, v in spec["init_kwargs"].items()}
-        actor = actor_cls.remote(spec["cls"], init_args, init_kwargs, spec["name"], rid)
+        actor = actor_cls.remote(
+            spec["cls"], init_args, init_kwargs, spec["name"], rid,
+            max_ongoing_requests=spec["config"].max_ongoing_requests)
         return rid, actor
 
     @staticmethod

@@ -56,6 +56,18 @@ class DeploymentResponse:
         """The underlying ObjectRef (composition: pass to other calls)."""
         return self._ref
 
+    def __await__(self):
+        """Awaitable inside async deployments (reference: DeploymentHandle
+        responses are awaitable in replica code). The blocking get runs
+        in the loop's default executor so the replica loop stays free."""
+        import asyncio
+
+        loop = asyncio.get_event_loop()
+        return loop.run_in_executor(None, self.result).__await__()
+
+
+_ASTOP = object()  # end-of-stream sentinel for async iteration
+
 
 class DeploymentResponseGenerator:
     """Streaming response: iterate to receive items as the replica's
@@ -87,6 +99,28 @@ class DeploymentResponseGenerator:
             self._finished = True
             if self._on_done is not None:
                 self._on_done()
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self):
+        """Async iteration for async deployments composing streams.
+        StopIteration cannot cross an executor future (the event loop
+        rewrites it to RuntimeError), so end-of-stream travels as a
+        sentinel."""
+        import asyncio
+
+        def step():
+            try:
+                return self.__next__()
+            except StopIteration:
+                return _ASTOP
+
+        loop = asyncio.get_event_loop()
+        item = await loop.run_in_executor(None, step)
+        if item is _ASTOP:
+            raise StopAsyncIteration
+        return item
 
     def close(self):
         """Release routing accounting when abandoning the stream early

@@ -2,24 +2,39 @@
 
 Counterpart of the reference's serve/_private/replica.py — wraps the user
 callable, counts ongoing requests (the autoscaling signal), exposes a
-health check. Runs with max_concurrency > 1 so requests overlap up to
-max_ongoing_requests (threaded-actor semantics here; the reference uses
-an asyncio replica event loop)."""
+health check. The replica is an ASYNC actor (its handler methods are
+coroutines), so requests overlap on one event loop up to the actor's
+concurrency bound — the reference's asyncio replica event loop. Async
+user methods await natively; sync user methods run in a thread pool so
+they cannot stall the loop (reference: sync methods offloaded to the
+replica's executor)."""
 
 from __future__ import annotations
 
+import asyncio
+import inspect
 import threading
+from concurrent.futures import ThreadPoolExecutor
 from typing import Any
+
+_STOP = object()
 
 
 class Replica:
     def __init__(self, cls_or_fn, init_args: tuple, init_kwargs: dict,
-                 deployment_name: str, replica_id: str):
+                 deployment_name: str, replica_id: str,
+                 max_ongoing_requests: int = 16):
         self.deployment_name = deployment_name
         self.replica_id = replica_id
         self._ongoing = 0
         self._total = 0
         self._lock = threading.Lock()
+        # Sync user code runs here, off the replica event loop — sized by
+        # max_ongoing_requests so the knob governs sync parallelism the
+        # way it did for threaded replicas.
+        self._user_pool = ThreadPoolExecutor(
+            max_workers=max(2, int(max_ongoing_requests)),
+            thread_name_prefix="replica-user")
         if isinstance(cls_or_fn, type):
             self.instance = cls_or_fn(*init_args, **init_kwargs)
         else:
@@ -38,34 +53,69 @@ class Replica:
                   else getattr(self.instance, method))
         return target, args, kwargs
 
-    def handle_request(self, method: str, args: tuple, kwargs: dict) -> Any:
+    async def handle_request(self, method: str, args: tuple, kwargs: dict) -> Any:
         with self._lock:
             self._ongoing += 1
             self._total += 1
         try:
-            target, args, kwargs = self._resolve_call(method, args, kwargs)
-            return target(*args, **kwargs)
+            loop = asyncio.get_running_loop()
+            target, args, kwargs = await loop.run_in_executor(
+                self._user_pool, self._resolve_call, method, args, kwargs)
+            if inspect.iscoroutinefunction(getattr(target, "__call__", target)) \
+                    or inspect.iscoroutinefunction(target):
+                return await target(*args, **kwargs)
+            result = await loop.run_in_executor(
+                self._user_pool, lambda: target(*args, **kwargs))
+            if inspect.iscoroutine(result):
+                return await result
+            return result
         finally:
             with self._lock:
                 self._ongoing -= 1
 
-    def handle_request_streaming(self, method: str, args: tuple, kwargs: dict):
-        """Generator variant: yields the user generator's items one by one.
-        Being itself a generator actor method, callers receive an
-        ObjectRefGenerator whose items appear as produced (reference:
-        streaming deployment responses through the proxy,
-        serve/_private/proxy response streaming)."""
+    async def handle_request_streaming(self, method: str, args: tuple, kwargs: dict):
+        """Streaming variant: an async generator either way — async user
+        generators are consumed natively, sync ones are stepped in the
+        user pool so a slow producer never blocks the replica loop
+        (reference: streaming deployment responses, serve/_private/proxy
+        response streaming)."""
         with self._lock:
             self._ongoing += 1
             self._total += 1
         try:
-            target, args, kwargs = self._resolve_call(method, args, kwargs)
-            yield from target(*args, **kwargs)
+            loop = asyncio.get_running_loop()
+            target, args, kwargs = await loop.run_in_executor(
+                self._user_pool, self._resolve_call, method, args, kwargs)
+            # Invoke off-loop: a sync method doing real work before
+            # returning its iterable (e.g. computing a full list) must
+            # not stall every other request on this replica. Generator
+            # functions return instantly either way.
+            out = await loop.run_in_executor(
+                self._user_pool, lambda: target(*args, **kwargs))
+            if inspect.iscoroutine(out):
+                out = await out
+            if hasattr(out, "__anext__"):
+                async for item in out:
+                    yield item
+                return
+            it = iter(out)
+
+            def step():
+                try:
+                    return next(it)
+                except StopIteration:
+                    return _STOP
+
+            while True:
+                item = await loop.run_in_executor(self._user_pool, step)
+                if item is _STOP:
+                    return
+                yield item
         finally:
             with self._lock:
                 self._ongoing -= 1
 
-    def get_metrics(self) -> dict:
+    async def get_metrics(self) -> dict:
         with self._lock:
             return {
                 "replica_id": self.replica_id,
@@ -73,13 +123,17 @@ class Replica:
                 "total": self._total,
             }
 
-    def check_health(self) -> bool:
+    async def check_health(self) -> bool:
         user_check = getattr(self.instance, "check_health", None)
         if callable(user_check):
-            user_check()
+            result = user_check()
+            if inspect.iscoroutine(result):
+                await result
         return True
 
-    def reconfigure(self, user_config: Any) -> None:
+    async def reconfigure(self, user_config: Any) -> None:
         hook = getattr(self.instance, "reconfigure", None)
         if callable(hook):
-            hook(user_config)
+            result = hook(user_config)
+            if inspect.iscoroutine(result):
+                await result

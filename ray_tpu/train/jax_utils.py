@@ -31,7 +31,9 @@ def sync_model_params(params, group_name: str = None):
         return params
     group = collective.get_group(group_name or session.group_name)
     leaves, treedef = jax.tree.flatten(params)
-    synced = [group.broadcast(np.asarray(leaf), src=0) for leaf in leaves]
+    # ONE broadcast for the whole pytree (leaves ship as a single object)
+    # — n_leaves round-trips collapse to one.
+    synced = group.broadcast_object([np.asarray(l) for l in leaves], src=0)
     return jax.tree.unflatten(treedef, [jax.numpy.asarray(s) for s in synced])
 
 

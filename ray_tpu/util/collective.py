@@ -123,11 +123,27 @@ class CollectiveGroup:
         return shards[self.rank]
 
     def broadcast(self, tensor: np.ndarray | None, src: int = 0, timeout: float = 60.0) -> np.ndarray:
+        return np.asarray(self.broadcast_object(
+            None if tensor is None else np.asarray(tensor), src, timeout))
+
+    def broadcast_object(self, obj: Any, src: int = 0, timeout: float = 60.0) -> Any:
+        """Broadcast any picklable object. All-blocking: every rank acks
+        and the source waits for the acks (NCCL-style synchronous
+        collective). This is load-bearing for GC, not just semantics —
+        _post's lazy seq-2 deletion is only sound when no rank can run
+        two sequences ahead of the slowest; a fire-and-forget source
+        posting K broadcasts would delete keys a slow joiner (e.g. a
+        worker still importing jax) has not read yet, deadlocking it."""
         seq = self._next_seq("broadcast")
         if self.rank == src:
-            self._post("broadcast", seq, np.asarray(tensor))
-            return np.asarray(tensor)
-        return self._fetch("broadcast", seq, src, timeout)
+            self._post("broadcast", seq, obj)
+            out = obj
+        else:
+            out = self._fetch("broadcast", seq, src, timeout)
+        self._post("broadcast_ack", seq, 0)
+        for r in range(self.world_size):
+            self._fetch("broadcast_ack", seq, r, timeout)
+        return out
 
     def barrier(self, timeout: float = 60.0) -> None:
         self.allgather(np.zeros(1), timeout=timeout)

@@ -1,0 +1,227 @@
+"""Asyncio actors + concurrency groups.
+
+Reference: async actors execute coroutine methods concurrently on one
+event loop (core_worker/transport/fiber.h:17, actor_scheduling_queue.h);
+concurrency groups bound per-group parallelism
+(concurrency_group_manager.h:37, @ray.remote(concurrency_groups={...})).
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def cluster():
+    ray_tpu.init(num_cpus=4, object_store_memory=64 * 1024 * 1024)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_async_methods_interleave(cluster):
+    """Two in-flight calls awaiting each other's signal can only finish
+    if they interleave on the loop — threads are not needed."""
+
+    @ray_tpu.remote
+    class Rendezvous:
+        def __init__(self):
+            import asyncio
+
+            self.event = asyncio.Event()
+
+        async def waiter(self):
+            await self.event.wait()
+            return "woke"
+
+        async def setter(self):
+            self.event.set()
+            return "set"
+
+    a = Rendezvous.remote()
+    w = a.waiter.remote()
+    time.sleep(0.2)  # waiter is parked on the loop
+    assert ray_tpu.get(a.setter.remote(), timeout=30) == "set"
+    assert ray_tpu.get(w, timeout=30) == "woke"
+
+
+def test_async_concurrency_many_calls(cluster):
+    """100 sleeping coroutines finish in ~one sleep, not 100."""
+
+    @ray_tpu.remote
+    class Sleeper:
+        async def nap(self, s):
+            import asyncio
+
+            await asyncio.sleep(s)
+            return s
+
+    a = Sleeper.remote()
+    t0 = time.time()
+    out = ray_tpu.get([a.nap.remote(0.3) for _ in range(100)], timeout=60)
+    assert out == [0.3] * 100
+    assert time.time() - t0 < 8.0
+
+
+def test_async_actor_state_and_context(cluster):
+    """Interleaved calls share instance state; nested submissions from
+    inside a coroutine work (ContextVar-carried task context)."""
+
+    @ray_tpu.remote
+    def double(x):
+        return 2 * x
+
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        async def bump(self):
+            import asyncio
+
+            self.n += 1
+            await asyncio.sleep(0.01)
+            return self.n
+
+        async def nested(self, x):
+            return ray_tpu.get(double.remote(x))
+
+    c = Counter.remote()
+    ray_tpu.get([c.bump.remote() for _ in range(10)], timeout=30)
+    assert ray_tpu.get(c.bump.remote(), timeout=30) == 11
+    assert ray_tpu.get(c.nested.remote(21), timeout=30) == 42
+
+
+def test_async_max_concurrency_bound(cluster):
+    """max_concurrency bounds the loop's in-flight calls."""
+
+    @ray_tpu.remote(max_concurrency=2)
+    class Gate:
+        def __init__(self):
+            self.active = 0
+            self.peak = 0
+
+        async def hold(self):
+            import asyncio
+
+            self.active += 1
+            self.peak = max(self.peak, self.active)
+            await asyncio.sleep(0.2)
+            self.active -= 1
+            return self.peak
+
+    g = Gate.remote()
+    peaks = ray_tpu.get([g.hold.remote() for _ in range(6)], timeout=30)
+    assert max(peaks) == 2, peaks
+
+
+def test_async_errors_and_generators(cluster):
+    @ray_tpu.remote
+    class A:
+        async def boom(self):
+            raise ValueError("async kaboom")
+
+        async def stream(self, n):
+            import asyncio
+
+            for i in range(n):
+                await asyncio.sleep(0.01)
+                yield i * i
+
+    a = A.remote()
+    with pytest.raises(Exception, match="kaboom"):
+        ray_tpu.get(a.boom.remote(), timeout=30)
+    got = [ray_tpu.get(r, timeout=30) for r in a.stream.remote(4)]
+    assert got == [0, 1, 4, 9]
+
+
+def test_concurrency_groups_async(cluster):
+    """Per-group semaphores: the io group runs 2-wide while compute
+    stays serialized."""
+
+    @ray_tpu.remote(concurrency_groups={"io": 2, "compute": 1})
+    class Worker:
+        def __init__(self):
+            self.io_active = 0
+            self.io_peak = 0
+            self.c_active = 0
+            self.c_peak = 0
+
+        @ray_tpu.method(concurrency_group="io")
+        async def fetch(self):
+            import asyncio
+
+            self.io_active += 1
+            self.io_peak = max(self.io_peak, self.io_active)
+            await asyncio.sleep(0.15)
+            self.io_active -= 1
+
+        @ray_tpu.method(concurrency_group="compute")
+        async def crunch(self):
+            import asyncio
+
+            self.c_active += 1
+            self.c_peak = max(self.c_peak, self.c_active)
+            await asyncio.sleep(0.15)
+            self.c_active -= 1
+
+        async def peaks(self):
+            return self.io_peak, self.c_peak
+
+    w = Worker.remote()
+    refs = [w.fetch.remote() for _ in range(4)] + \
+           [w.crunch.remote() for _ in range(4)]
+    ray_tpu.get(refs, timeout=30)
+    io_peak, c_peak = ray_tpu.get(w.peaks.remote(), timeout=30)
+    assert io_peak == 2, io_peak
+    assert c_peak == 1, c_peak
+
+
+def test_concurrency_groups_threaded(cluster):
+    """Threaded actors get one pool per group; per-call override via
+    .options(concurrency_group=...)."""
+
+    @ray_tpu.remote(max_concurrency=4, concurrency_groups={"solo": 1})
+    class T:
+        def __init__(self):
+            self.solo_active = 0
+            self.solo_peak = 0
+            import threading
+
+            self.lock = threading.Lock()
+
+        def slow(self):
+            with self.lock:
+                self.solo_active += 1
+                self.solo_peak = max(self.solo_peak, self.solo_active)
+            time.sleep(0.15)
+            with self.lock:
+                self.solo_active -= 1
+            return True
+
+        def peak(self):
+            return self.solo_peak
+
+    t = T.remote()
+    refs = [t.slow.options(concurrency_group="solo").remote()
+            for _ in range(3)]
+    assert all(ray_tpu.get(refs, timeout=30))
+    assert ray_tpu.get(t.peak.remote(), timeout=30) == 1
+
+
+def test_sync_actor_unchanged(cluster):
+    """Plain sync actors keep strict FIFO single-thread semantics."""
+
+    @ray_tpu.remote
+    class S:
+        def __init__(self):
+            self.log = []
+
+        def add(self, i):
+            self.log.append(i)
+            return list(self.log)
+
+    s = S.remote()
+    outs = ray_tpu.get([s.add.remote(i) for i in range(5)], timeout=30)
+    assert outs[-1] == [0, 1, 2, 3, 4]

@@ -42,6 +42,17 @@ class Rank:
     def recvfrom(self, src):
         return self.g.recv(src)
 
+    def broadcast_many(self, values, delay=0.0):
+        """src=0 path: fire k broadcasts back to back."""
+        import time
+
+        time.sleep(delay)
+        if self.rank == 0:
+            return [float(self.g.broadcast(np.array([v]), timeout=20)[0])
+                    for v in values]
+        return [float(self.g.broadcast(None, timeout=20)[0])
+                for _ in values]
+
 
 def test_allreduce(cluster):
     world = [Rank.remote(3, r) for r in range(3)]
@@ -57,6 +68,18 @@ def test_broadcast(cluster):
     )
     np.testing.assert_array_equal(outs[0], outs[1])
     np.testing.assert_array_equal(outs[1], np.full(2, 7.0))
+
+
+def test_broadcast_slow_joiner(cluster):
+    """Regression: the source must not outrun consumers — its lazy seq-2
+    key GC would delete broadcasts a slow joiner (worker still importing
+    jax) never read, deadlocking it. Broadcast is all-blocking now."""
+    world = [Rank.remote(2, r) for r in range(2)]
+    vals = [float(i) for i in range(6)]
+    fast = world[0].broadcast_many.remote(vals)
+    slow = world[1].broadcast_many.remote(vals, delay=2.0)
+    out_fast, out_slow = ray_tpu.get([fast, slow], timeout=60)
+    assert out_fast == vals and out_slow == vals
 
 
 def test_allgather_and_reducescatter(cluster):

@@ -109,14 +109,18 @@ def test_temperature_sampling_runs():
 def test_openai_server_dispatch():
     from ray_tpu.llm.serving import LLMServer
 
+    import asyncio
+
     server = LLMServer(tiny_config())
-    r = server({"prompt": "hi", "max_tokens": 3})
+    r = asyncio.run(server({"prompt": "hi", "max_tokens": 3}))
     assert r["object"] == "text_completion"
     assert r["choices"][0]["finish_reason"] in ("length", "stop")
-    r = server({"messages": [{"role": "user", "content": "hi"}], "max_tokens": 3})
+    r = asyncio.run(
+        server({"messages": [{"role": "user", "content": "hi"}],
+                "max_tokens": 3}))
     assert r["object"] == "chat.completion"
     assert r["choices"][0]["message"]["role"] == "assistant"
-    r = server({})
+    r = asyncio.run(server({}))
     assert r["object"] == "list" and r["data"][0]["id"] == "tiny"
 
 
@@ -158,8 +162,63 @@ def test_concurrent_generate_thread_safety():
 def test_token_array_prompt_openai():
     from ray_tpu.llm.serving import LLMServer
 
+    import asyncio
+
     server = LLMServer(tiny_config())
-    r = server({"prompt": [72, 105, 33], "max_tokens": 2})
+    r = asyncio.run(server({"prompt": [72, 105, 33], "max_tokens": 2}))
     assert r["object"] == "text_completion"
     assert len(r["choices"]) == 1  # one pre-tokenized prompt, not three
     assert r["usage"]["prompt_tokens"] == 3
+
+
+def test_async_engine_concurrent_requests_share_batch():
+    """vLLM AsyncLLMEngine analogue: requests from concurrent callers
+    join the SAME running batch — total decode steps stay near one
+    request's worth, not the sum."""
+    import asyncio
+
+    from ray_tpu.llm.engine import AsyncLLMEngine, LLMEngine
+    from ray_tpu.llm.config import SamplingParams
+
+    eng = LLMEngine(tiny_config())
+    aeng = AsyncLLMEngine(eng)
+    sp = SamplingParams(max_tokens=12, temperature=0.0)
+
+    async def main():
+        return await asyncio.gather(
+            *[aeng.generate([65 + i, 66, 67], sp) for i in range(4)])
+
+    outs = asyncio.run(main())
+    assert len(outs) == 4
+    assert all(o.finish_reason in ("stop", "length") for o in outs)
+    # 4 requests x 12 tokens serialized would be ~48 steps; batched
+    # together they fit in well under half that.
+    assert eng._step_count < 24, eng._step_count
+
+
+def test_async_engine_token_streaming():
+    """stream=True yields incremental token ids, then the final
+    RequestOutput."""
+    import asyncio
+
+    from ray_tpu.llm.engine import (
+        AsyncLLMEngine,
+        LLMEngine,
+        RequestOutput,
+    )
+    from ray_tpu.llm.config import SamplingParams
+
+    eng = LLMEngine(tiny_config())
+    aeng = AsyncLLMEngine(eng)
+    sp = SamplingParams(max_tokens=6, temperature=0.0)
+
+    async def main():
+        agen = await aeng.generate([72, 105], sp, stream=True)
+        items = [item async for item in agen]
+        return items
+
+    items = asyncio.run(main())
+    assert isinstance(items[-1], RequestOutput)
+    toks = [t for t in items[:-1] if isinstance(t, int)]
+    assert toks == items[-1].token_ids[: len(toks)]
+    assert len(toks) >= 1

@@ -290,3 +290,66 @@ def test_streaming_http_sse():
         body = r.read().decode()
     assert body.count("data:") == 3
     assert '"chunk": 2' in body
+
+
+def test_async_deployment_single_replica_concurrency():
+    """One replica overlaps async requests on its event loop (reference:
+    asyncio replica, serve/_private/replica.py) — N slow awaits finish
+    in ~one sleep, and an async generator streams while other requests
+    proceed."""
+    import time as _time
+
+    @serve.deployment(num_replicas=1, max_ongoing_requests=16)
+    class AsyncD:
+        def __init__(self):
+            self.calls = 0
+
+        async def __call__(self, x):
+            import asyncio
+
+            self.calls += 1
+            await asyncio.sleep(0.3)
+            return x * 2
+
+        async def stream(self, n):
+            import asyncio
+
+            for i in range(n):
+                await asyncio.sleep(0.02)
+                yield i
+
+    h = serve.run(AsyncD.bind(), proxy=False)
+    if True:
+        t0 = _time.time()
+        rs = [h.remote(i) for i in range(10)]
+        outs = [r.result(timeout_s=30) for r in rs]
+        elapsed = _time.time() - t0
+        assert outs == [2 * i for i in range(10)]
+        # Serial execution would take >= 3.0s.
+        assert elapsed < 2.0, elapsed
+
+        sh = h.options(method_name="stream", stream=True)
+        items = list(sh.remote(5))
+        assert items == [0, 1, 2, 3, 4]
+
+
+def test_async_deployment_composition_await():
+    """An async deployment awaiting a downstream handle response
+    (reference: awaitable DeploymentResponse in replica code)."""
+    @serve.deployment
+    class Down:
+        async def __call__(self, x):
+            return x + 1
+
+    @serve.deployment
+    class Up:
+        def __init__(self, down):
+            self.down = down
+
+        async def __call__(self, x):
+            first = await self.down.remote(x)
+            second = await self.down.remote(first)
+            return second
+
+    h = serve.run(Up.bind(Down.bind()), proxy=False)
+    assert h.remote(40).result(timeout_s=30) == 42
