@@ -63,8 +63,18 @@ class _AsyncActorExecutor:
             sem = self._sems[key] = asyncio.Semaphore(limit)
         return sem
 
-    def submit(self, coro) -> None:
-        asyncio.run_coroutine_threadsafe(coro, self.loop)
+    def submit(self, coro, on_error=None) -> None:
+        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+
+        def _done(f):
+            exc = f.exception()
+            if exc is not None and on_error is not None:
+                on_error(exc)
+
+        # The guarded coroutine reports task_finished itself; this
+        # callback only catches failures BEFORE its try block (or loop
+        # rejection), which would otherwise hang the caller's get.
+        fut.add_done_callback(_done)
 
 
 class Worker:
@@ -112,7 +122,10 @@ class Worker:
             spec = body["spec"]
             if (self.async_exec is not None and spec.actor_id is not None
                     and not spec.actor_creation):
-                self.async_exec.submit(self._run_task_async_guarded(spec))
+                self.async_exec.submit(
+                    self._run_task_async_guarded(spec),
+                    on_error=lambda exc, s=spec: self._async_task_crashed(
+                        s, exc))
             else:
                 self._executor_for(spec).submit(
                     self._run_task_guarded, spec, body.get("tpu_chips"))
@@ -203,6 +216,24 @@ class Worker:
                     thread_name_prefix=f"actor-cg-{name}")
                 for name, limit in groups.items()
             }
+
+    def _async_task_crashed(self, spec: TaskSpec, exc: BaseException) -> None:
+        """A coroutine failed outside its own error handling (before the
+        guarded try, or the loop rejected it): store the error and report
+        completion so the caller's get never hangs."""
+        traceback.print_exception(type(exc), exc, exc.__traceback__)
+        try:
+            self._store_error(spec, TaskError(repr(exc), "", spec.name))
+        except Exception:
+            traceback.print_exc()
+        try:
+            self.runtime.conn.cast(
+                "task_finished",
+                {"worker_id": self.worker_id, "task_id": spec.task_id,
+                 "failed": True},
+            )
+        except Exception:
+            pass
 
     async def _run_task_async_guarded(self, spec: TaskSpec) -> None:
         import time

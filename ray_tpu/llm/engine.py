@@ -100,6 +100,10 @@ class LLMEngine:
         # serving replicas run threaded (max_concurrency > 1), so the engine
         # serializes itself rather than trusting every caller to.
         self._lock = threading.Lock()
+        # Finished outputs for requests this caller did NOT submit (an
+        # AsyncLLMEngine driving the same engine) are handed here instead
+        # of being dropped — see AsyncLLMEngine, which registers itself.
+        self._foreign_output_listener = None
 
     # -- request intake ----------------------------------------------------
 
@@ -230,10 +234,17 @@ class LLMEngine:
                 rid = f"req-{tag}-{i}"
                 order.append(rid)
                 self.add_request(rid, p, sampling_params)
+            mine = set(order)
             done: dict[str, RequestOutput] = {}
-            while self.has_unfinished():
+            # Step until THIS call's requests finish. Other requests
+            # (an AsyncLLMEngine's) may share the batch; their outputs
+            # go to the registered listener, never dropped.
+            while len(done) < len(mine) and self.has_unfinished():
                 for out in self.step():
-                    done[out.request_id] = out
+                    if out.request_id in mine:
+                        done[out.request_id] = out
+                    elif self._foreign_output_listener is not None:
+                        self._foreign_output_listener(out)
             return [done[rid] for rid in order]
 
 
@@ -261,6 +272,9 @@ class AsyncLLMEngine:
         self._streams: dict[str, _queue.SimpleQueue] = {}
         self._seen: dict[str, int] = {}             # rid -> tokens streamed
         self._wake = threading.Event()
+        # If someone calls the sync engine.generate() while we have
+        # requests in flight, its stepping delivers our outputs here.
+        engine._foreign_output_listener = self._deliver
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name="llm-engine-loop")
         self._thread.start()
@@ -284,21 +298,25 @@ class AsyncLLMEngine:
                         self._fail_all(e)
                         continue
                 for out in outs:
-                    q = self._streams.pop(out.request_id, None)
-                    if q is not None:
-                        # Tokens from the finishing step never hit
-                        # _push_stream_tokens (the slot is cleared inside
-                        # step()): emit the unseen tail before the
-                        # terminal output so the incremental stream is
-                        # complete.
-                        n = self._seen.get(out.request_id, 0)
-                        for tok in out.token_ids[n:]:
-                            q.put(int(tok))
-                        q.put(out)  # terminal: the RequestOutput itself
-                    self._seen.pop(out.request_id, None)
-                    fut = self._waiters.pop(out.request_id, None)
-                    if fut is not None and not fut.done():
-                        fut.set_result(out)
+                    self._deliver(out)
+
+    def _deliver(self, out: RequestOutput) -> None:
+        """Resolve the waiter/stream for one finished request. Called by
+        the driver loop and (for batch-sharing) by sync generate()."""
+        q = self._streams.pop(out.request_id, None)
+        if q is not None:
+            # Tokens from the finishing step never hit
+            # _push_stream_tokens (the slot is cleared inside step()):
+            # emit the unseen tail before the terminal output so the
+            # incremental stream is complete.
+            n = self._seen.get(out.request_id, 0)
+            for tok in out.token_ids[n:]:
+                q.put(int(tok))
+            q.put(out)  # terminal: the RequestOutput itself
+        self._seen.pop(out.request_id, None)
+        fut = self._waiters.pop(out.request_id, None)
+        if fut is not None and not fut.done():
+            fut.set_result(out)
 
     def _fail_all(self, exc: Exception) -> None:
         """lock held. Resolve every pending request with the failure and

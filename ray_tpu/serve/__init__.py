@@ -26,8 +26,13 @@ from ray_tpu.serve.deployment import (
     deployment,
 )
 from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
+from ray_tpu.serve.batching import batch
+from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 
 __all__ = [
+    "batch",
+    "get_multiplexed_model_id",
+    "multiplexed",
     "Application",
     "AutoscalingConfig",
     "Deployment",

@@ -1,0 +1,114 @@
+"""@serve.batch — transparent request coalescing.
+
+Counterpart of the reference's python/ray/serve/batching.py: an async
+method decorated with ``@serve.batch`` receives LISTS of the items its
+callers passed individually; concurrent calls enqueue, and a flusher
+invokes the wrapped function once per batch of up to ``max_batch_size``
+items (or whatever arrived within ``batch_wait_timeout_s`` of the first
+item). On TPU this is the serving throughput lever: one batched forward
+pass feeds the MXU a [B, ...] matmul instead of B vector ones.
+
+    @serve.deployment
+    class Model:
+        @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.05)
+        async def __call__(self, inputs: list) -> list:
+            return self.model(np.stack(inputs)).tolist()
+"""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+from typing import Any, Callable
+
+
+class _BatchState:
+    """Per-(instance, method) pending batch."""
+
+    __slots__ = ("items", "futures", "flusher")
+
+    def __init__(self):
+        self.items: list = []
+        self.futures: list = []
+        self.flusher: asyncio.Task | None = None
+
+
+def batch(_fn: Callable | None = None, *, max_batch_size: int = 10,
+          batch_wait_timeout_s: float = 0.01):
+    """Decorate an async function/method taking a LIST of items and
+    returning a list of results of the same length. Callers invoke it
+    with a SINGLE item and await their own result (reference:
+    serve/batching.py @serve.batch)."""
+
+    def decorator(fn):
+        if not asyncio.iscoroutinefunction(fn):
+            raise TypeError(
+                "@serve.batch requires an async def function (it awaits "
+                f"the batch on the replica event loop); got {fn!r}"
+            )
+        states: dict[int, _BatchState] = {}  # id(instance) or 0 for free fns
+
+        async def flush_after_wait(state: _BatchState, bound_args):
+            try:
+                await asyncio.sleep(batch_wait_timeout_s)
+            except asyncio.CancelledError:
+                return  # a full batch already flushed
+            _flush(state, bound_args)
+
+        def _flush(state: _BatchState, bound_args) -> None:
+            items, futures = state.items, state.futures
+            state.items, state.futures = [], []
+            if state.flusher is not None:
+                state.flusher.cancel()
+                state.flusher = None
+            if not items:
+                return
+            asyncio.ensure_future(_run_batch(items, futures, bound_args))
+
+        async def _run_batch(items, futures, bound_args) -> None:
+            try:
+                results = await fn(*bound_args, items)
+                if results is None or len(results) != len(items):
+                    raise ValueError(
+                        f"@serve.batch function {fn.__name__} returned "
+                        f"{0 if results is None else len(results)} results "
+                        f"for a batch of {len(items)}"
+                    )
+                for f, r in zip(futures, results):
+                    if not f.done():
+                        f.set_result(r)
+            except Exception as e:  # noqa: BLE001
+                for f in futures:
+                    if not f.done():
+                        f.set_exception(e)
+
+        @functools.wraps(fn)
+        async def wrapper(*args):
+            # Bound method: args = (self, item); free function: (item,).
+            if len(args) == 2:
+                bound_args, item = (args[0],), args[1]
+                key = id(args[0])
+            elif len(args) == 1:
+                bound_args, item = (), args[0]
+                key = 0
+            else:
+                raise TypeError(
+                    "@serve.batch methods take exactly one request item"
+                )
+            state = states.setdefault(key, _BatchState())
+            fut = asyncio.get_running_loop().create_future()
+            state.items.append(item)
+            state.futures.append(fut)
+            if len(state.items) >= max_batch_size:
+                _flush(state, bound_args)
+            elif state.flusher is None or state.flusher.done():
+                state.flusher = asyncio.ensure_future(
+                    flush_after_wait(state, bound_args))
+            return await fut
+
+        wrapper._ray_tpu_serve_batch = True  # introspection/testing
+        return wrapper
+
+    if _fn is not None:  # bare @serve.batch
+        return decorator(_fn)
+    return decorator

@@ -147,6 +147,7 @@ class DeploymentHandle:
         self._inflight: dict[str, int] = {}
         self._lock = threading.Lock()
         self._stream = False
+        self._model_id = ""  # multiplexing (serve/multiplex.py)
 
     # -- controller discovery (lazy: handles are cheap to pickle) ----------
 
@@ -173,23 +174,32 @@ class DeploymentHandle:
     # -- routing -----------------------------------------------------------
 
     def _pick(self):
-        """Power-of-two-choices over this handle's in-flight counts."""
+        """Power-of-two-choices over this handle's in-flight counts; a
+        multiplexed model id instead routes by rendezvous hashing so the
+        model's replica-local cache keeps hitting (serve/multiplex.py)."""
         with self._lock:
             reps = list(self._replicas)
         if not reps:
             raise RayTpuError(
                 f"deployment {self.deployment_name!r} has no running replicas"
             )
+        if self._model_id:
+            from ray_tpu.serve.multiplex import rendezvous_pick
+
+            return rendezvous_pick(reps, self._model_id)
         if len(reps) == 1:
             return reps[0]
         a, b = random.sample(reps, 2)
         return a if self._inflight.get(a[0], 0) <= self._inflight.get(b[0], 0) else b
 
     def options(self, *, method_name: str | None = None,
-                stream: bool | None = None) -> "DeploymentHandle":
+                stream: bool | None = None,
+                multiplexed_model_id: str | None = None) -> "DeploymentHandle":
         h = DeploymentHandle(self.deployment_name, self._controller,
                              method_name or self._method)
         h._stream = self._stream if stream is None else stream
+        h._model_id = (self._model_id if multiplexed_model_id is None
+                       else multiplexed_model_id)
         # Share router state with the parent: the replica cache stays warm
         # (no per-call controller RPC) and power-of-two choices sees ALL
         # in-flight requests, not just this method-view's.
@@ -244,10 +254,11 @@ class DeploymentHandle:
                     # Streaming: the replica's generator method returns an
                     # ObjectRefGenerator; items surface as produced.
                     gen = actor.handle_request_streaming.remote(
-                        self._method, args, kwargs
+                        self._method, args, kwargs, self._model_id
                     )
                     return DeploymentResponseGenerator(gen, on_done=done)
-                ref = actor.handle_request.remote(self._method, args, kwargs)
+                ref = actor.handle_request.remote(
+                    self._method, args, kwargs, self._model_id)
                 return DeploymentResponse(ref, on_done=done, retry=retry)
             except ActorError as e:
                 done()

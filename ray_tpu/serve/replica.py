@@ -53,10 +53,16 @@ class Replica:
                   else getattr(self.instance, method))
         return target, args, kwargs
 
-    async def handle_request(self, method: str, args: tuple, kwargs: dict) -> Any:
+    async def handle_request(self, method: str, args: tuple, kwargs: dict,
+                             multiplexed_model_id: str = "") -> Any:
+        import contextvars
+
+        from ray_tpu.serve.multiplex import _set_request_model_id
+
         with self._lock:
             self._ongoing += 1
             self._total += 1
+        _set_request_model_id(multiplexed_model_id)
         try:
             loop = asyncio.get_running_loop()
             target, args, kwargs = await loop.run_in_executor(
@@ -64,8 +70,12 @@ class Replica:
             if inspect.iscoroutinefunction(getattr(target, "__call__", target)) \
                     or inspect.iscoroutinefunction(target):
                 return await target(*args, **kwargs)
+            # ctx.run: sync user code in the pool still sees
+            # serve.get_multiplexed_model_id() (run_in_executor does not
+            # propagate contextvars by itself).
+            ctx = contextvars.copy_context()
             result = await loop.run_in_executor(
-                self._user_pool, lambda: target(*args, **kwargs))
+                self._user_pool, lambda: ctx.run(target, *args, **kwargs))
             if inspect.iscoroutine(result):
                 return await result
             return result
@@ -73,15 +83,22 @@ class Replica:
             with self._lock:
                 self._ongoing -= 1
 
-    async def handle_request_streaming(self, method: str, args: tuple, kwargs: dict):
+    async def handle_request_streaming(self, method: str, args: tuple,
+                                       kwargs: dict,
+                                       multiplexed_model_id: str = ""):
         """Streaming variant: an async generator either way — async user
         generators are consumed natively, sync ones are stepped in the
         user pool so a slow producer never blocks the replica loop
         (reference: streaming deployment responses, serve/_private/proxy
         response streaming)."""
+        import contextvars
+
+        from ray_tpu.serve.multiplex import _set_request_model_id
+
         with self._lock:
             self._ongoing += 1
             self._total += 1
+        _set_request_model_id(multiplexed_model_id)
         try:
             loop = asyncio.get_running_loop()
             target, args, kwargs = await loop.run_in_executor(
@@ -90,8 +107,9 @@ class Replica:
             # returning its iterable (e.g. computing a full list) must
             # not stall every other request on this replica. Generator
             # functions return instantly either way.
+            ctx = contextvars.copy_context()
             out = await loop.run_in_executor(
-                self._user_pool, lambda: target(*args, **kwargs))
+                self._user_pool, lambda: ctx.run(target, *args, **kwargs))
             if inspect.iscoroutine(out):
                 out = await out
             if hasattr(out, "__anext__"):
@@ -107,7 +125,11 @@ class Replica:
                     return _STOP
 
             while True:
-                item = await loop.run_in_executor(self._user_pool, step)
+                # ctx.run so generator-body steps see the request's
+                # multiplexed model id too (steps are sequential, so
+                # re-entering the copied context each time is safe).
+                item = await loop.run_in_executor(
+                    self._user_pool, ctx.run, step)
                 if item is _STOP:
                     return
                 yield item

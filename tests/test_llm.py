@@ -222,3 +222,29 @@ def test_async_engine_token_streaming():
     toks = [t for t in items[:-1] if isinstance(t, int)]
     assert toks == items[-1].token_ids[: len(toks)]
     assert len(toks) >= 1
+
+
+def test_sync_generate_shares_engine_with_async_driver():
+    """Sync generate() stepping an engine with in-flight async requests
+    must hand their outputs to the AsyncLLMEngine, not drop them."""
+    import asyncio
+
+    from ray_tpu.llm.engine import AsyncLLMEngine, LLMEngine
+    from ray_tpu.llm.config import SamplingParams
+
+    eng = LLMEngine(tiny_config())
+    aeng = AsyncLLMEngine(eng)
+    sp = SamplingParams(max_tokens=10, temperature=0.0)
+
+    async def main():
+        pending = asyncio.ensure_future(aeng.generate([65, 66], sp))
+        await asyncio.sleep(0.05)  # let the driver admit it
+        loop = asyncio.get_running_loop()
+        sync_outs = await loop.run_in_executor(
+            None, lambda: eng.generate([[70, 71]], sp))
+        async_out = await asyncio.wait_for(pending, timeout=30)
+        return sync_outs, async_out
+
+    sync_outs, async_out = asyncio.run(main())
+    assert sync_outs[0].finish_reason in ("stop", "length")
+    assert async_out.finish_reason in ("stop", "length")

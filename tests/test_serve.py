@@ -319,18 +319,17 @@ def test_async_deployment_single_replica_concurrency():
                 yield i
 
     h = serve.run(AsyncD.bind(), proxy=False)
-    if True:
-        t0 = _time.time()
-        rs = [h.remote(i) for i in range(10)]
-        outs = [r.result(timeout_s=30) for r in rs]
-        elapsed = _time.time() - t0
-        assert outs == [2 * i for i in range(10)]
-        # Serial execution would take >= 3.0s.
-        assert elapsed < 2.0, elapsed
+    t0 = _time.time()
+    rs = [h.remote(i) for i in range(10)]
+    outs = [r.result(timeout_s=30) for r in rs]
+    elapsed = _time.time() - t0
+    assert outs == [2 * i for i in range(10)]
+    # Serial execution would take >= 3.0s.
+    assert elapsed < 2.0, elapsed
 
-        sh = h.options(method_name="stream", stream=True)
-        items = list(sh.remote(5))
-        assert items == [0, 1, 2, 3, 4]
+    sh = h.options(method_name="stream", stream=True)
+    items = list(sh.remote(5))
+    assert items == [0, 1, 2, 3, 4]
 
 
 def test_async_deployment_composition_await():

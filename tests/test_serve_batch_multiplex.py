@@ -1,0 +1,177 @@
+"""@serve.batch request coalescing + model multiplexing.
+
+Reference: python/ray/serve/batching.py (@serve.batch),
+python/ray/serve/multiplex.py (+ serve.get_multiplexed_model_id)."""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _cluster():
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=8, object_store_memory=128 * 1024 * 1024)
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(autouse=True)
+def _clean_apps():
+    yield
+    try:
+        for name in list(serve.status()):
+            serve.delete(name)
+    except Exception:
+        pass
+
+
+def test_batch_coalesces_under_concurrent_load():
+    """Concurrent requests coalesce: far fewer underlying batch calls
+    than requests, every caller gets its own result."""
+
+    @serve.deployment(num_replicas=1, max_ongoing_requests=64)
+    class Batched:
+        def __init__(self):
+            self.batch_sizes = []
+
+        @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.05)
+        async def __call__(self, items: list) -> list:
+            self.batch_sizes.append(len(items))
+            return [x * 10 for x in items]
+
+        async def stats(self):
+            return list(self.batch_sizes)
+
+    h = serve.run(Batched.bind(), proxy=False)
+    rs = [h.remote(i) for i in range(32)]
+    outs = [r.result(timeout_s=30) for r in rs]
+    assert outs == [i * 10 for i in range(32)]
+    sizes = h.stats.remote().result(timeout_s=30)
+    assert sum(sizes) == 32
+    # Coalescing actually happened (not 32 singleton batches) and the
+    # cap was respected.
+    assert max(sizes) > 1
+    assert max(sizes) <= 8
+    assert len(sizes) < 32
+
+
+def test_batch_wait_timeout_flushes_partial():
+    @serve.deployment(num_replicas=1, max_ongoing_requests=16)
+    class B:
+        @serve.batch(max_batch_size=100, batch_wait_timeout_s=0.05)
+        async def __call__(self, items: list) -> list:
+            return [len(items)] * len(items)
+
+    h = serve.run(B.bind(), proxy=False)
+    t0 = time.time()
+    out = h.remote("x").result(timeout_s=30)
+    assert out == 1  # flushed alone by the timer
+    assert time.time() - t0 < 5.0
+
+
+def test_batch_error_propagates_to_every_caller():
+    @serve.deployment(num_replicas=1, max_ongoing_requests=16)
+    class B:
+        @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.02)
+        async def __call__(self, items: list) -> list:
+            raise RuntimeError("batch exploded")
+
+    h = serve.run(B.bind(), proxy=False)
+    rs = [h.remote(i) for i in range(4)]
+    for r in rs:
+        with pytest.raises(Exception, match="batch exploded"):
+            r.result(timeout_s=30)
+
+
+def test_batch_validates_result_length():
+    @serve.deployment(num_replicas=1, max_ongoing_requests=16)
+    class B:
+        @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.02)
+        async def __call__(self, items: list) -> list:
+            return [1]  # wrong length
+
+    h = serve.run(B.bind(), proxy=False)
+    rs = [h.remote(i) for i in range(3)]
+    for r in rs:
+        with pytest.raises(Exception, match="returned 1 results"):
+            r.result(timeout_s=30)
+
+
+def test_multiplexed_lru_and_context():
+    """Two model ids swap through a 1-model cache; the request's model
+    id is visible via serve.get_multiplexed_model_id()."""
+
+    @serve.deployment(num_replicas=1, max_ongoing_requests=16)
+    class Lora:
+        def __init__(self):
+            self.loads = []
+
+        @serve.multiplexed(max_num_models_per_replica=1)
+        async def get_model(self, model_id: str):
+            self.loads.append(model_id)
+            return {"id": model_id}
+
+        async def __call__(self, payload):
+            mid = serve.get_multiplexed_model_id()
+            model = await self.get_model(mid)
+            return {"served_by": model["id"], "ctx": mid}
+
+        async def loads_so_far(self):
+            return list(self.loads)
+
+    h = serve.run(Lora.bind(), proxy=False)
+    ha = h.options(multiplexed_model_id="lora-a")
+    hb = h.options(multiplexed_model_id="lora-b")
+    assert ha.remote({}).result(timeout_s=30) == {
+        "served_by": "lora-a", "ctx": "lora-a"}
+    assert ha.remote({}).result(timeout_s=30)["served_by"] == "lora-a"
+    assert hb.remote({}).result(timeout_s=30)["served_by"] == "lora-b"
+    assert ha.remote({}).result(timeout_s=30)["served_by"] == "lora-a"
+    loads = h.loads_so_far.remote().result(timeout_s=30)
+    # a, then b (evicts a), then a again (evicts b): 3 loads, cache of 1.
+    assert loads == ["lora-a", "lora-b", "lora-a"]
+
+
+def test_multiplexed_routing_affinity():
+    """The same model id keeps hitting the same replica (rendezvous
+    hashing), so its cache stays warm across requests."""
+    import os
+
+    @serve.deployment(num_replicas=3, max_ongoing_requests=16)
+    class M:
+        @serve.multiplexed(max_num_models_per_replica=4)
+        async def get_model(self, model_id: str):
+            return model_id
+
+        async def __call__(self, payload):
+            await self.get_model(serve.get_multiplexed_model_id())
+            return os.getpid()
+
+    h = serve.run(M.bind(), proxy=False)
+    for mid in ("m1", "m2", "m3"):
+        pids = {h.options(multiplexed_model_id=mid).remote({}).result(
+            timeout_s=30) for _ in range(6)}
+        assert len(pids) == 1, (mid, pids)
+
+
+def test_multiplexed_requires_model_id():
+    @serve.deployment(num_replicas=1)
+    class M:
+        @serve.multiplexed()
+        async def get_model(self, model_id: str):
+            return model_id
+
+        async def __call__(self, payload):
+            return await self.get_model(serve.get_multiplexed_model_id())
+
+    h = serve.run(M.bind(), proxy=False)
+    with pytest.raises(Exception, match="no model id"):
+        h.remote({}).result(timeout_s=30)
