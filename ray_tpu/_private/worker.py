@@ -279,8 +279,19 @@ class Worker:
         try:
             args, kwargs = await loop.run_in_executor(
                 self._fetch_pool, self._load_args, spec)
-            method = getattr(self.actor_instance, spec.method_name)
-            result = method(*args, **kwargs)
+            if spec.method_name == "__rtpu_dag_loop__":
+                from functools import partial
+
+                from ray_tpu.dag.channel_exec import actor_dag_loop
+
+                # Fully blocking resident loop: give it its own default-
+                # executor thread, never the event loop.
+                result = await loop.run_in_executor(
+                    None, partial(actor_dag_loop, self.actor_instance,
+                                  *args, **kwargs))
+            else:
+                method = getattr(self.actor_instance, spec.method_name)
+                result = method(*args, **kwargs)
             if inspect.iscoroutine(result):
                 result = await result
             if spec.streaming:
@@ -418,8 +429,17 @@ class Worker:
                 self.runtime.put("ok", _object_id=spec.return_ids[0])
                 return True
             if spec.actor_id is not None:
-                method = getattr(self.actor_instance, spec.method_name)
-                result = method(*args, **kwargs)
+                if spec.method_name == "__rtpu_dag_loop__":
+                    # Reserved: the compiled-DAG resident loop runs the
+                    # instance's bound methods off channels (reference:
+                    # pinned actor executables, compiled_dag_node.py:806).
+                    from ray_tpu.dag.channel_exec import actor_dag_loop
+
+                    result = actor_dag_loop(self.actor_instance, *args,
+                                            **kwargs)
+                else:
+                    method = getattr(self.actor_instance, spec.method_name)
+                    result = method(*args, **kwargs)
             else:
                 result = self.runtime.get_function(spec.func_id)(*args, **kwargs)
             if spec.streaming:

@@ -183,6 +183,15 @@ def put(value: Any) -> ObjectRef:
 
 def get(refs: ObjectRef | Sequence[ObjectRef], *, timeout: float | None = None):
     auto_init()
+    from ray_tpu.dag.nodes import CompiledDAGRef
+
+    # Channel-compiled DAG results resolve through their channel, not
+    # the object store (reference: ray.get on CompiledDAGRef).
+    if isinstance(refs, CompiledDAGRef):
+        return refs.get(timeout_s=timeout if timeout is not None else 60.0)
+    if isinstance(refs, (list, tuple)) and any(
+            isinstance(r, CompiledDAGRef) for r in refs):
+        return [get(r, timeout=timeout) for r in refs]
     return global_runtime().get(refs, timeout=timeout)
 
 

@@ -140,24 +140,187 @@ class MultiOutputNode(DAGNode):
         return list(args)
 
 
+class CompiledDAGRef:
+    """Result handle for one channel-compiled execution (reference:
+    CompiledDAGRef, compiled_dag_node.py). Results are a stream: get()
+    must be called in submission order (each ref carries its execution
+    index and fails loudly on a mismatch rather than silently returning
+    another execution's result)."""
+
+    def __init__(self, dag: "CompiledDAG", seq: int):
+        self._dag = dag
+        self._seq = seq
+        self._value: Any = None
+        self._error: Exception | None = None
+        self._done = False
+
+    def get(self, timeout_s: float = 60.0) -> Any:
+        if not self._done:
+            if self._dag._next_read_seq != self._seq:
+                raise RuntimeError(
+                    f"compiled-DAG results must be consumed in submission "
+                    f"order: this ref is execution #{self._seq}, the next "
+                    f"unread result is #{self._dag._next_read_seq}"
+                )
+            from ray_tpu.experimental.channel import ChannelTimeout
+
+            try:
+                self._value = self._dag._read_output(timeout_s)
+            except ChannelTimeout:
+                raise  # nothing consumed: the same ref may retry
+            except Exception as e:  # noqa: BLE001
+                # Execution error: its outputs were fully drained, so
+                # the stream stays aligned; this ref re-raises forever.
+                self._error = e
+                self._done = True
+                self._dag._next_read_seq += 1
+                raise
+            self._done = True
+            self._dag._next_read_seq += 1
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+
 class CompiledDAG:
     """A frozen DAG handle for repeated execution.
 
-    The reference pins actor loops and reuses mutable channels
-    (compiled_dag_node.py:806). Here each execute() is one wave of
-    actor-call submissions chained by ObjectRefs (the memoized recursion
-    of DAGNode._execute_into) — intermediate results never touch the
-    driver; the actors are pinned by construction. Reusable device
-    channels are a later-round optimization."""
+    Channel mode (the reference's design — pinned per-actor execution
+    loops + reusable mutable channels, compiled_dag_node.py:806 +
+    experimental_mutable_object_manager.h:44): compile() spawns a
+    resident loop task on every participating actor, connected by
+    shared-memory Channels; execute() writes the input channel and
+    returns a CompiledDAGRef whose get() reads the output channel. No
+    per-execution task submission at all.
 
-    def __init__(self, root: DAGNode):
+    Fallback: graphs with non-actor nodes, or actors that cannot reach
+    the driver's /dev/shm (ready handshake timeout), run as one wave of
+    ObjectRef-chained actor calls per execute() — the pre-channel
+    behavior (execute then returns ObjectRef(s) directly)."""
+
+    def __init__(self, root: DAGNode, channel_capacity: int = 8 << 20):
         self._root = root
         self._destroyed = False
+        self._mode = "legacy"
+        self._channels: dict = {}
+        self._loop_refs: list = []
+        self._pending_outputs = 0
+        self._exec_seq = 0
+        self._next_read_seq = 0
+        try:
+            self._try_compile_channels(channel_capacity)
+        except Exception:
+            self._teardown_channels()
+            self._mode = "legacy"
+
+    # -- channel mode ------------------------------------------------------
+
+    def _try_compile_channels(self, capacity: int) -> None:
+        from ray_tpu.actor import ActorMethod
+        from ray_tpu.dag import channel_exec
+        from ray_tpu.experimental.channel import Channel, ChannelTimeout
+
+        plan = channel_exec.build_plan(self._root, capacity)
+        if plan is None:
+            return
+        # Driver creates every channel up front; actors open by name.
+        for name, spec in plan["channels"].items():
+            self._channels[name] = Channel(
+                capacity=spec["capacity"], num_readers=spec["num_readers"],
+                name=name)
+        self._plan = plan
+        self._loop_refs = [
+            ActorMethod(plan["handles"][aid],
+                        channel_exec.LOOP_METHOD).remote(aplan)
+            for aid, aplan in plan["plans"].items()
+        ]
+        # Ready handshake: every loop opened its channels. A timeout
+        # (off-host actor: no shared /dev/shm) falls back to legacy.
+        try:
+            for aplan in plan["plans"].values():
+                ch = self._channels[aplan["ready_channel"]]
+                ch.begin_read(timeout_s=20.0)
+                ch.end_read()
+        except ChannelTimeout:
+            raise RuntimeError("compiled-DAG ready handshake timed out")
+        self._mode = "channels"
+
+    def _read_output(self, timeout_s: float) -> Any:
+        from ray_tpu.dag.channel_exec import _DagError
+        from ray_tpu.experimental.channel import ChannelTimeout
+
+        import time as _time
+
+        deadline = _time.monotonic() + timeout_s
+        outs = []
+        first_error: "_DagError | None" = None
+        for i, name in enumerate(self._plan["output_chans"]):
+            ch = self._channels[name]
+            if i > 0:
+                # Later outputs of the SAME execution wave arrive almost
+                # together; a fresh allowance keeps one slow-first-read
+                # timeout from leaving the stream half-drained.
+                deadline = max(deadline, _time.monotonic() + 10.0)
+            while True:
+                try:
+                    value = ch.begin_read(
+                        timeout_s=min(0.5, max(0.05, deadline - _time.monotonic())))
+                    break
+                except ChannelTimeout:
+                    self._raise_if_loop_crashed()
+                    if _time.monotonic() > deadline:
+                        raise
+            try:
+                import copy
+
+                value = copy.deepcopy(value)
+            finally:
+                ch.end_read()
+            if isinstance(value, _DagError):
+                # Keep draining: EVERY output channel must consume this
+                # execution's message or later executions' reads would
+                # pair results from different waves.
+                first_error = first_error or value
+            outs.append(value)
+        self._pending_outputs -= 1
+        if first_error is not None:
+            first_error.raise_()
+        return outs if self._plan["multi_output"] else outs[0]
+
+    def _raise_if_loop_crashed(self) -> None:
+        """Surface loop-start failures (the loop tasks seal 'started'
+        after moving to their background thread; an error there means
+        channel setup failed on the actor)."""
+        import ray_tpu
+
+        for ref in self._loop_refs:
+            done, _ = ray_tpu.wait([ref], num_returns=1, timeout=0.0)
+            if done:
+                ray_tpu.get(done[0])  # raises if the loop failed to start
+
+    # -- public ------------------------------------------------------------
 
     def execute(self, *input_values) -> Any:
         if self._destroyed:
             raise RuntimeError("CompiledDAG was torn down")
-        return self._root._execute_into({}, input_values)
+        if self._mode != "channels":
+            return self._root._execute_into({}, input_values)
+        if self._plan["input_chan"] is not None:
+            value = input_values[0] if len(input_values) == 1 else input_values
+            self._channels[self._plan["input_chan"]].write(value)
+        self._pending_outputs += 1
+        ref = CompiledDAGRef(self, self._exec_seq)
+        self._exec_seq += 1
+        return ref
 
     def teardown(self) -> None:
+        self._teardown_channels()
         self._destroyed = True
+
+    def _teardown_channels(self) -> None:
+        for ch in self._channels.values():
+            try:
+                ch.close()
+            except Exception:
+                pass
+        self._channels = {}

@@ -1,0 +1,11 @@
+"""ray_tpu.experimental: mutable channels for compiled-DAG pipelines.
+
+Counterpart of the reference's python/ray/experimental/channel package
+(shared_memory_channel.py, torch_tensor_nccl_channel.py): reusable
+buffers that bypass the per-call task RPC + object store path for
+actor-to-actor tensor handoff.
+"""
+
+from ray_tpu.experimental.channel import Channel
+
+__all__ = ["Channel"]

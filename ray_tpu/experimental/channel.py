@@ -1,0 +1,197 @@
+"""Mutable shared-memory channels (Python binding).
+
+Counterpart of the reference's shared-memory channel
+(reference: python/ray/experimental/channel/shared_memory_channel.py;
+native protocol: src/ray/core_worker/experimental_mutable_object_manager.h:44
+WriteAcquire/ReadAcquire/ReadRelease). The slot is allocated once and
+REUSED for every message — the per-message cost is one serialize into
+mapped memory plus two atomic transitions, no RPC, no object-store
+bookkeeping. See src/channel/channel.cc for the wire protocol.
+
+Usage:
+    ch = Channel(capacity=2 << 20, num_readers=1)   # writer side
+    ch.write(np.ones((512, 512)))                   # blocks on slow reader
+    # reader side (handle arrives by pickling):
+    value = ch.begin_read()       # zero-copy views into the slot
+    ...use value...
+    ch.end_read()                 # allows the next write
+
+Tensors: jax arrays are fetched to host on write (device buffers are not
+shareable across processes); chip-to-chip movement belongs INSIDE jitted
+programs (shard_map + collectives) — the channel is the host-hop lane
+for actor pipelines, matching the reference's CPU shared-memory channel
+role.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import uuid
+from typing import Any
+
+from ray_tpu._private import serialization
+
+
+def _load_lib() -> ctypes.CDLL:
+    path = os.path.join(os.path.dirname(os.path.dirname(__file__)),
+                        "_native", "libchannel.so")
+    if not os.path.exists(path):
+        raise RuntimeError(
+            "libchannel.so not built; run `make -C src` at the repo root")
+    lib = ctypes.CDLL(path)
+    lib.rtpu_chan_create.restype = ctypes.c_int64
+    lib.rtpu_chan_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                     ctypes.c_uint32, ctypes.c_uint32]
+    lib.rtpu_chan_open.restype = ctypes.c_int64
+    lib.rtpu_chan_open.argtypes = [ctypes.c_char_p]
+    lib.rtpu_chan_capacity.restype = ctypes.c_uint64
+    lib.rtpu_chan_capacity.argtypes = [ctypes.c_int64]
+    lib.rtpu_chan_write_acquire.restype = ctypes.c_void_p
+    lib.rtpu_chan_write_acquire.argtypes = [ctypes.c_int64, ctypes.c_double]
+    lib.rtpu_chan_write_commit.restype = ctypes.c_int
+    lib.rtpu_chan_write_commit.argtypes = [ctypes.c_int64, ctypes.c_uint64]
+    lib.rtpu_chan_read_acquire.restype = ctypes.c_int64
+    lib.rtpu_chan_read_acquire.argtypes = [
+        ctypes.c_int64, ctypes.POINTER(ctypes.c_void_p), ctypes.c_double]
+    lib.rtpu_chan_read_release.restype = ctypes.c_int
+    lib.rtpu_chan_read_release.argtypes = [ctypes.c_int64]
+    lib.rtpu_chan_close.restype = ctypes.c_int
+    lib.rtpu_chan_close.argtypes = [ctypes.c_int64]
+    lib.rtpu_chan_is_closed.restype = ctypes.c_int
+    lib.rtpu_chan_is_closed.argtypes = [ctypes.c_int64]
+    lib.rtpu_chan_destroy.restype = ctypes.c_int
+    lib.rtpu_chan_destroy.argtypes = [ctypes.c_int64, ctypes.c_int]
+    return lib
+
+
+_lib: ctypes.CDLL | None = None
+
+
+def _get_lib() -> ctypes.CDLL:
+    global _lib
+    if _lib is None:
+        _lib = _load_lib()
+    return _lib
+
+
+class ChannelClosed(Exception):
+    """The channel was torn down (CompiledDAG.teardown or peer exit)."""
+
+
+class ChannelTimeout(Exception):
+    pass
+
+
+class Channel:
+    """Single-writer fixed-reader-count mutable channel.
+
+    The slot area is a RING of ``num_slots`` payload slots, so the
+    writer can run up to num_slots messages ahead of the slowest reader
+    — on shared-core hosts this amortizes context switches across the
+    ring depth instead of forcing an alternation per message.
+
+    Pickling transfers the NAME only — the receiving process opens the
+    same shm region. Exactly ``num_readers`` processes must read every
+    message or the writer stalls (reference semantics: mutable objects
+    have a static reader set)."""
+
+    def __init__(self, capacity: int = 8 << 20, num_readers: int = 1,
+                 name: str | None = None, _create: bool = True,
+                 num_slots: int = 4):
+        self.name = name or f"/rtpu-chan-{uuid.uuid4().hex[:12]}"
+        self.capacity = capacity
+        self.num_readers = num_readers
+        self.num_slots = num_slots
+        self._creator = _create
+        lib = _get_lib()
+        if _create:
+            h = lib.rtpu_chan_create(self.name.encode(), capacity,
+                                     num_readers, num_slots)
+        else:
+            h = lib.rtpu_chan_open(self.name.encode())
+        if h < 0:
+            raise OSError(-h, f"channel {self.name}: {os.strerror(-h)}")
+        self._h = h
+        if not _create:
+            self.capacity = lib.rtpu_chan_capacity(h)
+
+    # -- writer side -------------------------------------------------------
+
+    def write(self, value: Any, timeout_s: float = 60.0) -> None:
+        """Serialize ``value`` directly into the slot (zero-copy for
+        numpy buffers). Blocks until every reader released the previous
+        message."""
+        lib = _get_lib()
+        header, buffers = serialization.serialize(value)
+        size = serialization.serialized_size(header, buffers)
+        if size > self.capacity:
+            raise ValueError(
+                f"serialized value ({size} B) exceeds channel capacity "
+                f"({self.capacity} B); size the channel for the largest "
+                f"message")
+        ptr = lib.rtpu_chan_write_acquire(self._h, ctypes.c_double(timeout_s))
+        if not ptr:
+            self._raise_wait_failure("write")
+        view = (ctypes.c_char * self.capacity).from_address(ptr)
+        n = serialization.write_to(memoryview(view).cast("B"), header, buffers)
+        if lib.rtpu_chan_write_commit(self._h, n) != 0:
+            raise RuntimeError("channel write commit failed")
+
+    # -- reader side -------------------------------------------------------
+
+    def begin_read(self, timeout_s: float = 60.0) -> Any:
+        """Next message, deserialized zero-copy FROM the slot: returned
+        numpy arrays view the shared memory and stay valid until
+        end_read() (reference: ReadAcquire)."""
+        lib = _get_lib()
+        out = ctypes.c_void_p()
+        n = lib.rtpu_chan_read_acquire(self._h, ctypes.byref(out),
+                                       ctypes.c_double(timeout_s))
+        if n < 0:
+            if n == -2:
+                raise ChannelClosed(self.name)
+            if n == -1:
+                raise ChannelTimeout(
+                    f"no message on {self.name} within {timeout_s}s")
+            raise RuntimeError(f"read_acquire failed ({n}) on {self.name}")
+        view = memoryview(
+            (ctypes.c_char * n).from_address(out.value)).cast("B")
+        return serialization.loads_from(view)
+
+    def end_read(self) -> None:
+        """Release the slot for the next write (reference: ReadRelease).
+        Any zero-copy views from begin_read are invalid after this."""
+        if _get_lib().rtpu_chan_read_release(self._h) != 0:
+            raise RuntimeError("end_read without begin_read")
+
+    def read(self, timeout_s: float = 60.0) -> Any:
+        """begin_read + deep copy + end_read: safe to hold indefinitely."""
+        import copy
+
+        value = self.begin_read(timeout_s)
+        try:
+            return copy.deepcopy(value)
+        finally:
+            self.end_read()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def close(self) -> None:
+        """Wake all blocked peers with ChannelClosed."""
+        _get_lib().rtpu_chan_close(self._h)
+
+    def _raise_wait_failure(self, op: str) -> None:
+        if _get_lib().rtpu_chan_is_closed(self._h):
+            raise ChannelClosed(self.name)
+        raise ChannelTimeout(f"{op} on {self.name}: readers did not "
+                             f"release the previous message in time")
+
+    def __reduce__(self):
+        return (Channel, (self.capacity, self.num_readers, self.name, False))
+
+    def __del__(self):
+        try:
+            _get_lib().rtpu_chan_destroy(self._h, 1 if self._creator else 0)
+        except Exception:
+            pass
