@@ -60,6 +60,16 @@ class Config:
     max_lineage_entries: int = 100_000
     max_object_reconstructions: int = 3
 
+    # --- head fault tolerance (reference: gcs_init_data.h +
+    # redis_store_client.h:111 — persistent GCS state; here a periodic
+    # snapshot file instead of Redis) ---
+    gcs_snapshot_path: str = ""  # empty = persistence disabled
+    gcs_snapshot_interval_s: float = 1.0
+    # How long node agents / drivers keep retrying the head address
+    # after a connection drop before giving up.
+    agent_reconnect_grace_s: float = 60.0
+    driver_reconnect_grace_s: float = 60.0
+
     # --- memory monitor / OOM killing ---
     # Reference: memory_monitor.h:52 (enabled when usage threshold < 1.0),
     # worker_killing_policy_retriable_fifo.h.

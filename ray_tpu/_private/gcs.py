@@ -234,6 +234,27 @@ class Head:
         }
         self.max_pool_workers = max(2, int(node_resources.get("CPU", 2)))
 
+        # --- head fault tolerance (reference: gcs_init_data.h bulk load
+        # + redis_store_client.h persistent tables; here a snapshot file,
+        # see _private/gcs_persistence.py) --- must happen BEFORE the
+        # server accepts connections so restored state is visible to the
+        # first reconnecting client.
+        self._snapshot_path = config.gcs_snapshot_path or None
+        self._snapshot_dirty = False
+        if self._snapshot_path and os.path.exists(self._snapshot_path):
+            from ray_tpu._private import gcs_persistence
+
+            payload = gcs_persistence.load_snapshot(self._snapshot_path)
+            if payload is not None:
+                stats = gcs_persistence.restore_into(self, payload)
+                print(f"ray_tpu head: restored snapshot "
+                      f"({stats['actors_restored']} actors to restart, "
+                      f"{stats['kv_keys']} KV keys, {stats['pgs']} PGs)",
+                      file=sys.stderr)
+        if self._snapshot_path:
+            threading.Thread(target=self._snapshot_loop, daemon=True,
+                             name="gcs-snapshot").start()
+
         self.server = rpc.Server(
             self._handle,
             on_close=self._on_conn_close,
@@ -309,6 +330,31 @@ class Head:
             res["memory"] = 8e9
         res[f"node:{self.node_id if hasattr(self, 'node_id') else '127.0.0.1'}"] = 1.0
         return res
+
+    # --- head FT: write-behind snapshots --------------------------------
+
+    def _mark_dirty(self) -> None:
+        """Durable-table mutation: schedule a snapshot (no-op when
+        persistence is disabled)."""
+        self._snapshot_dirty = True
+
+    def _snapshot_loop(self) -> None:
+        while not self._shutdown:
+            time.sleep(self.config.gcs_snapshot_interval_s)
+            if self._snapshot_dirty:
+                self._snapshot_now()
+
+    def _snapshot_now(self) -> None:
+        from ray_tpu._private import gcs_persistence
+
+        try:
+            with self.lock:
+                self._snapshot_dirty = False
+                payload = gcs_persistence.build_payload(self)
+            # Pickle + fsync outside the lock: RPC handlers keep running.
+            gcs_persistence.write_blob(payload, self._snapshot_path)
+        except Exception:
+            traceback.print_exc()
 
     def spawn_worker(self, node_id: str,
                      tpu_capable: bool = False) -> WorkerRecord:
@@ -501,6 +547,11 @@ class Head:
                 old.peer_info.pop("node_agent_for", None)
             self.scheduler.add_node(entry)
             self.node_agents[node_id] = conn
+            # New capacity: retry pending placement groups (also the
+            # re-placement path for PGs restored from a head snapshot).
+            for pg in self.pgs.values():
+                if pg.state == "PENDING":
+                    self._try_place_pg(pg)
         conn.peer_info = {"node_agent_for": node_id}
         self.dispatch_event.set()
         return {"node_id": node_id, "session_dir": self.session_dir}
@@ -783,6 +834,7 @@ class Head:
             if not body.get("overwrite", True) and key in self.kv:
                 return {"added": False}
             self.kv[key] = body["value"]
+            self._mark_dirty()
         return {"added": True}
 
     def _h_kv_get(self, body, conn):
@@ -792,6 +844,8 @@ class Head:
     def _h_kv_del(self, body, conn):
         with self.lock:
             existed = self.kv.pop((body.get("ns", ""), body["key"]), None) is not None
+            if existed:
+                self._mark_dirty()
         return {"deleted": existed}
 
     def _h_kv_keys(self, body, conn):
@@ -961,6 +1015,7 @@ class Head:
                 actor = self.actors.get(rec.actor_id)
                 if actor is not None and spec is not None and spec.actor_creation:
                     actor.state = "ALIVE" if not body.get("failed") else "DEAD"
+                    self._mark_dirty()
                     if actor.state == "DEAD":
                         actor.death_cause = "creation task failed"
                         self._drain_actor_queue(actor)
@@ -995,6 +1050,7 @@ class Head:
                     raise rpc.RpcError(f"actor name {spec.name!r} already taken")
                 self.named_actors[key] = spec.actor_id
             self.actors[spec.actor_id] = ActorRecord(spec)
+            self._mark_dirty()
         self.dispatch_event.set()
         return {"actor_id": spec.actor_id}
 
@@ -1076,6 +1132,7 @@ class Head:
                 actor.state = "DEAD"
                 actor.death_cause = "killed before start"
                 self._drain_actor_queue(actor)
+                self._mark_dirty()
         return {}
 
     def _h_get_named_actor(self, body, conn):
@@ -1106,6 +1163,7 @@ class Head:
         rec = PlacementGroupRecord(pg_id, body.get("name", ""), body["bundles"], body["strategy"])
         with self.lock:
             self.pgs[pg_id] = rec
+            self._mark_dirty()
             # `ready()` object: sealed once the gang reservation commits.
             entry = ObjectEntry(pg_id + ":ready", "head")
             entry.refcount = 1
@@ -1148,6 +1206,8 @@ class Head:
     def _h_remove_pg(self, body, conn):
         with self.lock:
             rec = self.pgs.pop(body["pg_id"], None)
+            if rec is not None:
+                self._mark_dirty()
             if rec is not None and rec.state == "CREATED":
                 for node_id, bundle in zip(rec.node_per_bundle, rec.bundles):
                     self.scheduler.release(node_id, ResourceSet(bundle))
@@ -1649,6 +1709,7 @@ class Head:
             actor.restarts += 1
             actor.state = "PENDING_CREATION"
             actor.worker_id = None
+            self._mark_dirty()
             # queued (not yet pushed) calls survive the restart
         else:
             actor.state = "DEAD"
@@ -1662,6 +1723,7 @@ class Head:
             self._drain_actor_queue(actor)
             if actor.spec.name:
                 self.named_actors.pop((actor.spec.namespace, actor.spec.name), None)
+            self._mark_dirty()
 
     def _fail_task(self, spec: TaskSpec, message: str, kind: str = "task_error") -> None:
         """lock held. Seal each return id with an error payload."""
@@ -1710,6 +1772,8 @@ class Head:
 
     def shutdown(self) -> None:
         self._shutdown = True
+        if self._snapshot_path and self._snapshot_dirty:
+            self._snapshot_now()
         if self.memory_monitor is not None:
             self.memory_monitor.stop()
         with self.lock:

@@ -1,0 +1,147 @@
+"""Head state snapshot/restore (GCS fault tolerance).
+
+Counterpart of the reference's persistent GCS storage + restart recovery
+(reference: gcs/store_client/redis_store_client.h:111 — Redis-backed
+head tables; gcs/gcs_server/gcs_init_data.h — bulk-loading all tables on
+GCS restart; gcs_redis_failure_detector.h). Design difference: a single
+periodic snapshot FILE (atomic replace) instead of an external Redis —
+the head is the only writer, so a write-behind snapshot of its in-memory
+tables gives the same restart story without a second service.
+
+What persists: the KV store (which also carries serialized functions and
+actor class blobs, so restarts can respawn actors), actor specs and
+restart counters, the named-actor registry, placement-group specs, and
+the head's node identity. What intentionally does NOT persist: object
+store contents and directory (objects are lost on head failure; lineage
+re-execution rebuilds what is re-requested), in-flight task state
+(owners resubmit), and worker records (all worker processes die with
+their head connection — the lease model)."""
+
+from __future__ import annotations
+
+import os
+import pickle
+import tempfile
+import time
+from typing import TYPE_CHECKING, Any
+
+if TYPE_CHECKING:
+    from ray_tpu._private.gcs import Head
+
+FORMAT_VERSION = 1
+
+
+def build_payload(head: "Head") -> dict:
+    """Serialize the durable tables into a picklable payload. Caller
+    holds head.lock — keep this cheap; the disk write happens outside
+    the lock (write_blob)."""
+    actors = []
+    for actor_id, rec in head.actors.items():
+        actors.append({
+            "actor_id": actor_id,
+            "spec": rec.spec,
+            "state": rec.state,
+            "restarts": rec.restarts,
+        })
+    pgs = []
+    for pg_id, pg in head.pgs.items():
+        pgs.append({
+            "pg_id": pg_id,
+            "name": pg.name,
+            "bundles": pg.bundles,
+            "strategy": pg.strategy,
+        })
+    return {
+        "version": FORMAT_VERSION,
+        "written_at": time.time(),
+        "session_id": head.session_id,
+        "node_id": head.node_id,
+        "kv": dict(head.kv),
+        "actors": actors,
+        "named_actors": dict(head.named_actors),
+        "pgs": pgs,
+    }
+
+
+def write_blob(payload: dict, path: str) -> None:
+    """Atomic snapshot write (called WITHOUT head.lock: pickling +
+    fsync of a many-MB KV under the lock would stall every RPC
+    handler)."""
+    blob = pickle.dumps(payload, protocol=5)
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=".gcs-snap-")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def load_snapshot(path: str) -> "dict | None":
+    try:
+        with open(path, "rb") as f:
+            payload = pickle.load(f)
+    except (FileNotFoundError, EOFError, pickle.UnpicklingError):
+        return None
+    if payload.get("version") != FORMAT_VERSION:
+        return None
+    return payload
+
+
+def restore_into(head: "Head", payload: dict) -> dict:
+    """Populate a fresh Head's tables from a snapshot (called during
+    __init__, before the RPC server accepts connections — the analogue
+    of GcsInitData's bulk load). Returns restore stats.
+
+    Every worker process of the previous head epoch is gone (the head
+    connection was their lease), so all snapshot actors are dead; the
+    ones whose restart budget allows it are queued for restart exactly
+    like worker-death restarts (reference: gcs_actor_manager.h:96
+    max_restarts semantics — a head failover consumes one restart).
+    """
+    from ray_tpu._private.gcs import ActorRecord, PlacementGroupRecord
+
+    head.kv.update(payload.get("kv", {}))
+    restored = skipped = 0
+    restorable_ids = set()
+    for entry in payload.get("actors", []):
+        spec = entry["spec"]
+        if entry["state"] == "DEAD":
+            skipped += 1
+            continue
+        restarts = entry["restarts"] + 1
+        if spec.max_restarts >= 0 and restarts > spec.max_restarts:
+            skipped += 1
+            continue
+        rec = ActorRecord(spec)
+        rec.restarts = restarts
+        rec.state = "PENDING_CREATION"
+        head.actors[entry["actor_id"]] = rec
+        restorable_ids.add(entry["actor_id"])
+        restored += 1
+    for key, actor_id in payload.get("named_actors", {}).items():
+        if actor_id in restorable_ids:
+            head.named_actors[key] = actor_id
+    for entry in payload.get("pgs", []):
+        from ray_tpu._private.gcs import ObjectEntry
+
+        pg = PlacementGroupRecord(entry["pg_id"], entry["name"],
+                                  entry["bundles"], entry["strategy"])
+        head.pgs[entry["pg_id"]] = pg
+        # Recreate the ready() object; placement itself retries when
+        # nodes (re-)register and as the head's own resources free up.
+        ready = ObjectEntry(entry["pg_id"] + ":ready", "head")
+        ready.refcount = 1
+        head.objects[entry["pg_id"] + ":ready"] = ready
+        head._try_place_pg(pg)
+    return {"actors_restored": restored, "actors_skipped": skipped,
+            "kv_keys": len(payload.get("kv", {})),
+            "pgs": len(payload.get("pgs", []))}

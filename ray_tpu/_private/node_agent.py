@@ -39,19 +39,20 @@ class NodeAgent:
         self.force_remote_objects = force_remote_objects
         self.procs: dict[str, subprocess.Popen] = {}
         self._exit = threading.Event()
+        self._labels = labels or {}
+        self._resources = self._detect_resources(num_cpus, num_tpus, resources)
         self.conn = rpc.connect(
             head_address,
             handler=self._handle,
             name="node_agent",
-            on_close=lambda conn: self._exit.set(),
+            on_close=self._on_head_lost,
         )
-        res = self._detect_resources(num_cpus, num_tpus, resources)
         reply = self.conn.call(
             "register_node",
             {
                 "node_id": node_id,
-                "resources": res,
-                "labels": labels or {},
+                "resources": self._resources,
+                "labels": self._labels,
                 "address": socket.gethostname(),
             },
             timeout=GLOBAL_CONFIG.worker_register_timeout_s,
@@ -66,6 +67,74 @@ class NodeAgent:
             target=self._memory_watch, daemon=True, name="agent-mem-watch"
         )
         self._mem_thread.start()
+
+    def _on_head_lost(self, _conn) -> None:
+        """Head connection dropped. Instead of dying (the pre-FT lease
+        semantics), retry the head address for a grace window and
+        RE-REGISTER under the same node_id — a restarted head re-adopts
+        this node (reference: raylets reconnecting to a recovered GCS,
+        gcs_redis_failure_detector.h + gcs_init_data.h)."""
+        if self._exit.is_set():
+            return
+        threading.Thread(target=self._reconnect_loop, daemon=True,
+                         name="agent-reconnect").start()
+
+    def _reconnect_loop(self) -> None:
+        import time
+
+        deadline = time.time() + GLOBAL_CONFIG.agent_reconnect_grace_s
+        # Old-epoch workers die with their head connections, but not
+        # instantly (one may be mid-task): give them a moment, then
+        # TERMINATE stragglers — the new epoch schedules against this
+        # node's full resources, so ghosts must not keep holding them.
+        for proc in list(self.procs.values()):
+            try:
+                proc.wait(timeout=0.5)
+            except Exception:
+                try:
+                    proc.terminate()
+                    proc.wait(timeout=2.0)
+                except Exception:
+                    try:
+                        proc.kill()
+                    except Exception:
+                        pass
+        self.procs.clear()
+        while time.time() < deadline and not self._exit.is_set():
+            conn = None
+            try:
+                conn = rpc.connect(
+                    self.head_address,
+                    handler=self._handle,
+                    name="node_agent",
+                    on_close=self._on_head_lost,
+                )
+                reply = conn.call(
+                    "register_node",
+                    {
+                        "node_id": self.node_id,
+                        "resources": self._resources,
+                        "labels": self._labels,
+                        "address": socket.gethostname(),
+                    },
+                    timeout=GLOBAL_CONFIG.worker_register_timeout_s,
+                )
+                self.conn = conn
+                self.session_dir = reply["session_dir"]
+                print(f"node agent {self.node_id}: re-registered with "
+                      f"restarted head", flush=True)
+                return
+            except Exception:
+                if conn is not None:
+                    # Half-open connection: detach its close hook so it
+                    # cannot spawn a second reconnect loop.
+                    conn._on_close = None
+                    try:
+                        conn.close()
+                    except Exception:
+                        pass
+                time.sleep(1.0)
+        self._exit.set()
 
     def _memory_watch(self) -> None:
         from ray_tpu._private.memory_monitor import system_memory_usage

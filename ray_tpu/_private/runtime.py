@@ -56,8 +56,10 @@ class CoreRuntime:
         self._waiters_lock = threading.Lock()
         self._message_handler = message_handler
         self._closed = False
+        self.client_type = client_type
         self.address = address  # head (host, port) — job drivers reconnect here
-        self.conn = rpc.connect(address, handler=self._handle, name=client_type)
+        self.conn = rpc.connect(address, handler=self._handle,
+                                name=client_type, on_close=self._on_conn_lost)
         # Off-host clients (ray:// drivers, or forced-remote for tests)
         # skip the shm fast path; the head ships object payloads inline
         # over the connection.
@@ -113,6 +115,78 @@ class CoreRuntime:
         if self._message_handler is not None:
             return self._message_handler(kind, body)
         return None
+
+    def _on_conn_lost(self, _conn) -> None:
+        """Head connection dropped (reference: GCS client reconnect after
+        GCS failover). Pending waiters fail fast — their objects' head
+        epoch is gone — and drivers retry the head address for a grace
+        window, re-registering so NEW work proceeds against the restarted
+        head. Workers override this hook (their connection is a lease:
+        they exit)."""
+        if self._closed:
+            return
+        with self._waiters_lock:
+            waiters = list(self._waiters.values())
+            self._waiters.clear()
+        for fut in waiters:
+            if not fut.done():
+                fut.set_exception(
+                    rpc.ConnectionLost("head connection lost"))
+        if self.client_type == "driver":
+            threading.Thread(target=self._reconnect_loop, daemon=True,
+                             name="driver-reconnect").start()
+
+    def _reconnect_loop(self) -> None:
+        import time
+
+        deadline = time.time() + GLOBAL_CONFIG.driver_reconnect_grace_s
+        while not self._closed and time.time() < deadline:
+            conn = None
+            try:
+                conn = rpc.connect(self.address, handler=self._handle,
+                                   name=self.client_type,
+                                   on_close=self._on_conn_lost)
+                reg = conn.call(
+                    "register",
+                    {"client_type": self.client_type, "worker_id": None,
+                     "pid": os.getpid(),
+                     "can_shm": getattr(self, "shm", None) is not None},
+                    timeout=GLOBAL_CONFIG.worker_register_timeout_s,
+                )
+                if reg["shm_name"] is not None:
+                    try:
+                        # The restarted head has a NEW shm arena.
+                        self.shm = ShmClient(reg["shm_name"],
+                                             reg["shm_capacity"])
+                    except FileNotFoundError:
+                        # Same fallback as __init__: stay registered as a
+                        # remote (inline-payload) client, or the head
+                        # would keep shipping shm metas we cannot map.
+                        self.shm = None
+                        reg = conn.call(
+                            "register",
+                            {"client_type": self.client_type,
+                             "worker_id": None, "pid": os.getpid(),
+                             "can_shm": False},
+                            timeout=GLOBAL_CONFIG.worker_register_timeout_s,
+                        )
+                self.client_id = reg["client_id"]
+                self.node_id = reg["node_id"]
+                self.session_dir = reg["session_dir"]
+                self.conn = conn
+                print("ray_tpu: driver re-registered with restarted head",
+                      flush=True)
+                return
+            except Exception:
+                if conn is not None:
+                    # A half-open connection must not fire _on_conn_lost
+                    # later and spawn a SECOND reconnect loop.
+                    conn._on_close = None
+                    try:
+                        conn.close()
+                    except Exception:
+                        pass
+                time.sleep(1.0)
 
     def _new_waiter(self) -> tuple[str, Future]:
         waiter_id = uuid.uuid4().hex[:16]

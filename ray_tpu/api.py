@@ -188,7 +188,8 @@ def get(refs: ObjectRef | Sequence[ObjectRef], *, timeout: float | None = None):
     # Channel-compiled DAG results resolve through their channel, not
     # the object store (reference: ray.get on CompiledDAGRef).
     if isinstance(refs, CompiledDAGRef):
-        return refs.get(timeout_s=timeout if timeout is not None else 60.0)
+        # timeout=None blocks indefinitely, matching ObjectRef gets.
+        return refs.get(timeout_s=timeout)
     if isinstance(refs, (list, tuple)) and any(
             isinstance(r, CompiledDAGRef) for r in refs):
         return [get(r, timeout=timeout) for r in refs]

@@ -154,7 +154,9 @@ class CompiledDAGRef:
         self._error: Exception | None = None
         self._done = False
 
-    def get(self, timeout_s: float = 60.0) -> Any:
+    def get(self, timeout_s: "float | None" = 60.0) -> Any:
+        if timeout_s is None:
+            timeout_s = float("inf")
         if not self._done:
             if self._dag._next_read_seq != self._seq:
                 raise RuntimeError(
@@ -204,9 +206,9 @@ class CompiledDAG:
         self._mode = "legacy"
         self._channels: dict = {}
         self._loop_refs: list = []
-        self._pending_outputs = 0
         self._exec_seq = 0
         self._next_read_seq = 0
+        self._partial_outs: list = []
         try:
             self._try_compile_channels(channel_capacity)
         except Exception:
@@ -252,11 +254,15 @@ class CompiledDAG:
         import time as _time
 
         deadline = _time.monotonic() + timeout_s
-        outs = []
-        first_error: "_DagError | None" = None
-        for i, name in enumerate(self._plan["output_chans"]):
+        # Resumable drain: on ChannelTimeout the already-read outputs of
+        # this execution stay in _partial_outs, so a retried get()
+        # continues with the REMAINING channels instead of re-reading a
+        # drained one (which would consume the next execution's message
+        # and misalign every later result).
+        outs = self._partial_outs
+        for name in self._plan["output_chans"][len(outs):]:
             ch = self._channels[name]
-            if i > 0:
+            if outs:
                 # Later outputs of the SAME execution wave arrive almost
                 # together; a fresh allowance keeps one slow-first-read
                 # timeout from leaving the stream half-drained.
@@ -276,13 +282,12 @@ class CompiledDAG:
                 value = copy.deepcopy(value)
             finally:
                 ch.end_read()
-            if isinstance(value, _DagError):
-                # Keep draining: EVERY output channel must consume this
-                # execution's message or later executions' reads would
-                # pair results from different waves.
-                first_error = first_error or value
             outs.append(value)
-        self._pending_outputs -= 1
+        self._partial_outs = []
+        # EVERY output channel drained; only now surface branch errors,
+        # keeping later executions' streams aligned.
+        first_error = next((v for v in outs if isinstance(v, _DagError)),
+                           None)
         if first_error is not None:
             first_error.raise_()
         return outs if self._plan["multi_output"] else outs[0]
@@ -308,7 +313,6 @@ class CompiledDAG:
         if self._plan["input_chan"] is not None:
             value = input_values[0] if len(input_values) == 1 else input_values
             self._channels[self._plan["input_chan"]].write(value)
-        self._pending_outputs += 1
         ref = CompiledDAGRef(self, self._exec_seq)
         self._exec_seq += 1
         return ref

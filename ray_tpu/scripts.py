@@ -37,6 +37,10 @@ def cmd_start(args) -> int:
         cfg.head_port = args.port
         if args.object_store_memory:
             cfg.object_store_memory = int(args.object_store_memory)
+        if getattr(args, "snapshot_path", None):
+            # Head FT: persist durable tables; a restart with the same
+            # path restores them (reference: redis-backed GCS state).
+            cfg.gcs_snapshot_path = args.snapshot_path
         head = Head(cfg, num_cpus=args.num_cpus, num_tpus=args.num_tpus,
                     resources=json.loads(args.resources) if args.resources else None)
         host, port = head.address
@@ -216,6 +220,8 @@ def main(argv: list[str] | None = None) -> int:
 
     sp = sub.add_parser("start", help="start a head or join as a node")
     sp.add_argument("--head", action="store_true")
+    sp.add_argument("--snapshot-path", default=None,
+                    help="head FT: snapshot file for durable state")
     sp.add_argument("--address", default=None, help="join an existing head")
     sp.add_argument("--host", default="127.0.0.1")
     sp.add_argument("--port", type=int, default=6380)
