@@ -60,6 +60,11 @@ class Config:
     max_lineage_entries: int = 100_000
     max_object_reconstructions: int = 3
 
+    # --- P2P object plane (reference: per-node plasma + chunked
+    # push/pull, push_manager.h:32 / pull_manager.h:57) ---
+    agent_object_store_memory: int = 256 * 1024 * 1024
+    p2p_chunk_size: int = 4 * 1024 * 1024
+
     # --- head fault tolerance (reference: gcs_init_data.h +
     # redis_store_client.h:111 — persistent GCS state; here a periodic
     # snapshot file instead of Redis) ---

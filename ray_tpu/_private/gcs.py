@@ -60,7 +60,7 @@ class ObjectEntry:
     __slots__ = (
         "object_id", "state", "offset", "size", "inline", "spill_path",
         "refcount", "read_pins", "task_pins", "lru", "is_error", "owner_id",
-        "created_at",
+        "created_at", "location", "remote_offset",
     )
 
     def __init__(self, object_id: str, owner_id: str):
@@ -77,6 +77,11 @@ class ObjectEntry:
         self.is_error = False
         self.owner_id = owner_id
         self.created_at = time.time()
+        # P2P: node hosting the payload in its agent store (the head
+        # keeps only this directory entry; reference:
+        # ownership_based_object_directory.h:39).
+        self.location: str | None = None
+        self.remote_offset: int | None = None
 
 
 class WorkerRecord:
@@ -199,6 +204,7 @@ class Head:
         self.task_events: deque[dict] = deque(maxlen=config.task_events_max_buffer)
         self.metrics: dict[str, Any] = {}
         self.node_agents: dict[str, rpc.Connection] = {}  # node_id -> agent conn
+        self.node_transfer_addrs: dict[str, tuple] = {}  # node_id -> (ip, port)
         from concurrent.futures import ThreadPoolExecutor
 
         # Meta replies (which may embed payload bytes for remote clients)
@@ -467,7 +473,20 @@ class Head:
         retry elsewhere; the node leaves the schedulable set."""
         with self.lock:
             self.node_agents.pop(node_id, None)
+            self.node_transfer_addrs.pop(node_id, None)
             self.scheduler.mark_dead(node_id)
+            # P2P payloads hosted by the dead node are gone; mark the
+            # entries lost so fetches trigger lineage reconstruction
+            # instead of hanging (reference: object_recovery_manager.h).
+            # Snapshot first: _maybe_reconstruct INSERTS entries for
+            # freed dependency ids, which would blow up an iteration
+            # over the live dict.
+            lost = [e for e in self.objects.values()
+                    if e.location == node_id and e.state == SEALED]
+            for e in lost:
+                e.state = LOST
+                e.location = None
+                self._maybe_reconstruct(e.object_id)
             doomed = [r for r in self.workers.values() if r.node_id == node_id]
         for rec in doomed:
             self._handle_worker_death(rec)
@@ -510,7 +529,10 @@ class Head:
             "client_id": client_id,
             "shm_name": None if remote else self.shm_name,
             "shm_capacity": self.config.object_store_memory,
-            "node_id": self.node_id,
+            # A worker's node is where it was spawned (P2P object
+            # locations are recorded against it); drivers sit on the
+            # head node.
+            "node_id": rec.node_id if ctype == "worker" else self.node_id,
             "session_dir": self.session_dir,
         }
 
@@ -530,6 +552,13 @@ class Head:
         from ray_tpu._private.scheduler import NodeEntry, ResourceSet
 
         node_id = body.get("node_id") or ("node-" + uuid.uuid4().hex[:8])
+        if body.get("transfer_port"):
+            try:
+                peer_ip = conn._sock.getpeername()[0]
+            except OSError:
+                peer_ip = "127.0.0.1"
+            self.node_transfer_addrs[node_id] = (peer_ip,
+                                                 int(body["transfer_port"]))
         resources = dict(body.get("resources") or {})
         resources.setdefault(f"node:{node_id}", 1.0)
         entry = NodeEntry(
@@ -644,6 +673,31 @@ class Head:
         self.dispatch_event.set()
         return {}
 
+    def _h_put_p2p(self, body: dict, conn):
+        """Directory-only registration of an object whose payload lives
+        in a node agent's local store (reference: object location
+        updates into the ownership-based directory,
+        ownership_based_object_directory.h:39). The bytes never touch
+        the head."""
+        object_id = body["object_id"]
+        with self.lock:
+            entry = self.objects.get(object_id) or ObjectEntry(
+                object_id, body["owner_id"])
+            entry.location = body["node_id"]
+            entry.remote_offset = body["offset"]
+            entry.size = body["size"]
+            entry.inline = None
+            entry.state = SEALED
+            entry.is_error = body.get("is_error", False)
+            if entry.refcount == 0:
+                entry.refcount = 1
+            self._lru_tick += 1
+            entry.lru = self._lru_tick
+            self.objects[object_id] = entry
+            self._on_sealed(object_id)
+        self.dispatch_event.set()
+        return {}
+
     def _h_put_inline(self, body: dict, conn):
         object_id = body["object_id"]
         with self.lock:
@@ -692,6 +746,13 @@ class Head:
                         self.external_storage.restore(entry.spill_path),
                         entry.is_error)
         if entry.state == SEALED:
+            if entry.location is not None:
+                # P2P object: the head is directory only — the client
+                # pulls the bytes straight from the hosting node's agent
+                # (reference: pull_manager.h:57).
+                return ("p2p", entry.object_id, entry.location,
+                        self.node_transfer_addrs.get(entry.location),
+                        entry.remote_offset, entry.size, entry.is_error)
             if remote:
                 # Off-host client: copy out under the lock and ship bytes
                 # over the connection (no mmap, no read pin to release).
@@ -824,6 +885,14 @@ class Head:
             self.arena.free(entry.offset)
         if entry.spill_path:
             self.external_storage.delete(entry.spill_path)
+        if entry.location is not None:
+            agent = self.node_agents.get(entry.location)
+            if agent is not None:
+                try:
+                    agent.cast("free_object",
+                               {"object_id": entry.object_id})
+                except rpc.ConnectionLost:
+                    pass
         self.objects.pop(entry.object_id, None)
 
     # --- KV store (reference: GCS InternalKV, gcs_service.proto) ---

@@ -41,6 +41,23 @@ class NodeAgent:
         self._exit = threading.Event()
         self._labels = labels or {}
         self._resources = self._detect_resources(num_cpus, num_tpus, resources)
+        # --- node-local object store + P2P transfer server (reference:
+        # per-node plasma store + chunked push/pull, push_manager.h:32 /
+        # pull_manager.h:57). Large objects created on this node live in
+        # THIS arena; the head keeps only the directory entry, and other
+        # nodes pull chunks straight from here — bytes never traverse
+        # the head. ---
+        import uuid as _uuid
+
+        from ray_tpu._private.shm_store import ShmArena
+
+        self.store_name = f"/ray_tpu_agent_{_uuid.uuid4().hex[:10]}"
+        self.store_capacity = GLOBAL_CONFIG.agent_object_store_memory
+        self.store = ShmArena(self.store_name, self.store_capacity)
+        self.local_objects: dict[str, tuple[int, int]] = {}  # id -> (off, size)
+        self._store_lock = threading.Lock()
+        self.transfer_server = rpc.Server(self._transfer_handle,
+                                          host="0.0.0.0", port=0)
         self.conn = rpc.connect(
             head_address,
             handler=self._handle,
@@ -54,6 +71,7 @@ class NodeAgent:
                 "resources": self._resources,
                 "labels": self._labels,
                 "address": socket.gethostname(),
+                "transfer_port": self.transfer_server.address[1],
             },
             timeout=GLOBAL_CONFIG.worker_register_timeout_s,
         )
@@ -116,11 +134,21 @@ class NodeAgent:
                         "resources": self._resources,
                         "labels": self._labels,
                         "address": socket.gethostname(),
+                        "transfer_port": self.transfer_server.address[1],
                     },
                     timeout=GLOBAL_CONFIG.worker_register_timeout_s,
                 )
                 self.conn = conn
                 self.session_dir = reply["session_dir"]
+                # The old head's object directory died with it: every
+                # local payload is unreferenced now. Reclaim the arena.
+                with self._store_lock:
+                    for offset, _ in self.local_objects.values():
+                        try:
+                            self.store.free(offset)
+                        except Exception:
+                            pass
+                    self.local_objects.clear()
                 print(f"node agent {self.node_id}: re-registered with "
                       f"restarted head", flush=True)
                 return
@@ -174,9 +202,52 @@ class NodeAgent:
     def _handle(self, kind: str, body: dict, conn: rpc.Connection):
         if kind == "spawn_worker":
             self._spawn(body)
+        elif kind == "free_object":
+            # Head directory says the object's refcount hit zero.
+            with self._store_lock:
+                loc = self.local_objects.pop(body["object_id"], None)
+                if loc is not None:
+                    self.store.free(loc[0])
         elif kind == "shutdown_node":
             self._exit.set()
         return None
+
+    def _transfer_handle(self, kind: str, body: dict, conn: rpc.Connection):
+        """Store-plane RPCs: local workers allocate/seal; remote nodes
+        pull chunks (reference: ObjectManager push/pull protocol,
+        push_manager.h:32 — here pull-based: the consumer drives)."""
+        if kind == "alloc":
+            with self._store_lock:
+                offset = self.store.alloc(body["size"])
+            if offset is None:
+                raise rpc.RpcError(
+                    f"ObjectStoreFullError: agent store cannot allocate "
+                    f"{body['size']} bytes")
+            return {"offset": offset}
+        if kind == "seal_local":
+            with self._store_lock:
+                self.local_objects[body["object_id"]] = (
+                    body["offset"], body["size"])
+            return {}
+        if kind == "pull":
+            with self._store_lock:
+                loc = self.local_objects.get(body["object_id"])
+            if loc is None:
+                raise rpc.RpcError(
+                    f"object {body['object_id']} not on this node")
+            offset, size = loc
+            start = body["start"]
+            n = min(body["length"], size - start)
+            view = self.store.view(offset + start, n)
+            try:
+                return {"data": bytes(view), "total": size}
+            finally:
+                view.release()
+        if kind == "abort_alloc":
+            with self._store_lock:
+                self.store.free(body["offset"])
+            return {}
+        raise rpc.RpcError(f"unknown transfer op {kind!r}")
 
     def _spawn(self, body: dict) -> None:
         worker_id = body["worker_id"]
@@ -195,6 +266,11 @@ class NodeAgent:
         if self.force_remote_objects:
             # Tests: same-host agents exercise the off-host object path.
             env["RAY_TPU_REMOTE"] = "1"
+        # Workers on this node use the agent's local store for large
+        # objects (P2P data plane; name:capacity:host:port).
+        env["RAY_TPU_AGENT_STORE"] = (
+            f"{self.store_name}:{self.store_capacity}:"
+            f"127.0.0.1:{self.transfer_server.address[1]}")
         log_dir = os.path.join(
             os.environ.get("TMPDIR", "/tmp"), "ray_tpu_agent", self.node_id, "logs"
         )
@@ -231,6 +307,14 @@ class NodeAgent:
         cg = getattr(self, "_cgroup", None)
         if cg is not None:
             cg.teardown()
+        try:
+            self.transfer_server.stop()
+        except Exception:
+            pass
+        try:
+            self.store.close(unlink=True)
+        except Exception:
+            pass
         try:
             self.conn.close()
         except Exception:

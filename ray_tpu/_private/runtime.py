@@ -89,6 +89,24 @@ class CoreRuntime:
                 self.shm = None
         else:
             self.shm = None
+        # --- P2P object plane (reference: per-node plasma + chunked
+        # pull, pull_manager.h:57): workers on agent-managed nodes store
+        # large objects in the NODE's arena and other nodes pull chunks
+        # straight from its transfer server — bytes never traverse the
+        # head. RAY_TPU_AGENT_STORE=name:capacity:host:port.
+        self.agent_shm = None
+        self.agent_addr: tuple[str, int] | None = None
+        self._agent_conn: rpc.Connection | None = None
+        self._peer_conns: dict[tuple, rpc.Connection] = {}
+        store_env = os.environ.get("RAY_TPU_AGENT_STORE")
+        if store_env and client_type == "worker":
+            try:
+                name, cap, host, port = store_env.rsplit(":", 3)
+                self.agent_shm = ShmClient(name, int(cap))
+                self.agent_addr = (host, int(port))
+            except (ValueError, FileNotFoundError):
+                self.agent_shm = None
+                self.agent_addr = None
         self._fn_cache: dict[str, Any] = {}
         self._fn_ids: dict = {}  # id(fn) -> (weakref(fn), func_id)
         ids_mod.set_ref_removed_callback(self._on_ref_removed)
@@ -173,6 +191,11 @@ class CoreRuntime:
                 self.client_id = reg["client_id"]
                 self.node_id = reg["node_id"]
                 self.session_dir = reg["session_dir"]
+                # The new head's KV may lack function blobs exported to
+                # the old one (no snapshot, or crash inside the flush
+                # window): drop the "already exported" cache so the next
+                # submission re-publishes each function.
+                self._fn_ids.clear()
                 self.conn = conn
                 print("ray_tpu: driver re-registered with restarted head",
                       flush=True)
@@ -206,10 +229,75 @@ class CoreRuntime:
     # ------------------------------------------------------------------
     # objects
 
+    def _agent(self) -> rpc.Connection:
+        if self._agent_conn is None or self._agent_conn.closed:
+            self._agent_conn = rpc.connect(self.agent_addr, name="store")
+        return self._agent_conn
+
+    def _put_p2p(self, object_id: str, header, buffers, size: int,
+                 is_error: bool) -> bool:
+        """Store into this node's agent arena; register directory-only
+        with the head. Returns False when the local store is full (the
+        caller falls back to the inline path)."""
+        try:
+            offset = self._agent().call("alloc", {"size": size})["offset"]
+        except rpc.RpcError as e:
+            if "ObjectStoreFullError" in str(e):
+                return False
+            raise
+        sealed = False
+        try:
+            view = self.agent_shm.view(offset, size)
+            serialization.write_to(view, header, buffers)
+            view.release()
+            self._agent().call("seal_local", {
+                "object_id": object_id, "offset": offset, "size": size})
+            sealed = True
+            self.conn.call("put_p2p", {
+                "object_id": object_id, "node_id": self.node_id,
+                "offset": offset, "size": size,
+                "owner_id": self.client_id, "is_error": is_error,
+            })
+            return True
+        except BaseException:
+            if not sealed:
+                # Pre-seal failure only: once sealed, the agent's object
+                # map owns the offset — freeing it here would recycle
+                # memory a directory-routed reader may still pull.
+                try:
+                    self._agent().call("abort_alloc", {"offset": offset})
+                except Exception:
+                    pass
+            raise
+
+    def _pull_p2p(self, object_id: str, addr: tuple, size: int) -> bytes:
+        """Chunked pull from the hosting node's agent (reference:
+        pull_manager.h:57)."""
+        key = tuple(addr)
+        conn = self._peer_conns.get(key)
+        if conn is None or conn.closed:
+            conn = self._peer_conns[key] = rpc.connect(
+                (addr[0], int(addr[1])), name="pull")
+        chunk = GLOBAL_CONFIG.p2p_chunk_size
+        buf = bytearray(size)
+        pos = 0
+        while pos < size:
+            reply = conn.call("pull", {"object_id": object_id,
+                                       "start": pos,
+                                       "length": min(chunk, size - pos)})
+            data = reply["data"]
+            buf[pos:pos + len(data)] = data
+            pos += len(data)
+        return bytes(buf)
+
     def put(self, value: Any, *, _object_id: str | None = None, _is_error: bool = False) -> ObjectRef:
         object_id = _object_id or os.urandom(16).hex()
         header, buffers = serialization.serialize(value)
         size = serialization.serialized_size(header, buffers)
+        if (self.shm is None and self.agent_shm is not None
+                and size > GLOBAL_CONFIG.max_inline_object_size):
+            if self._put_p2p(object_id, header, buffers, size, _is_error):
+                return ObjectRef(object_id, _owned=_object_id is None)
         if self.shm is None or size <= GLOBAL_CONFIG.max_inline_object_size:
             payload = bytearray(size)
             serialization.write_to(memoryview(payload), header, buffers)
@@ -279,6 +367,8 @@ class CoreRuntime:
                         values.append(self._deserialize(bytes(view), is_error))
                     finally:
                         view.release()
+                elif meta[0] == "p2p":
+                    values.append(self._read_p2p(meta))
                 else:
                     raise ObjectLostError(meta[1])
         finally:
@@ -303,6 +393,18 @@ class CoreRuntime:
                     finally:
                         view.release()
                         self.conn.cast("read_done", {"ids": [ref.hex()]})
+                elif meta[0] == "p2p":
+                    # Chunked network pull: never on the connection's
+                    # dispatch thread (it would stall every other
+                    # incoming head message for the transfer duration).
+                    def _pull():
+                        try:
+                            result.set_result(self._read_p2p(meta))
+                        except Exception as e:  # noqa: BLE001
+                            result.set_exception(e)
+
+                    threading.Thread(target=_pull, daemon=True,
+                                     name="p2p-pull").start()
                 else:
                     result.set_exception(ObjectLostError(meta[1]))
             except Exception as e:  # noqa: BLE001
@@ -311,6 +413,24 @@ class CoreRuntime:
         fut.add_done_callback(_done)
         self.conn.cast("get_meta", {"waiter_id": waiter_id, "ids": [ref.hex()]})
         return result
+
+    def _read_p2p(self, meta: tuple) -> Any:
+        """("p2p", object_id, node_id, (ip, port), offset, size, is_error):
+        same-node readers map the agent arena directly; everyone else
+        pulls chunks from the hosting node's transfer server."""
+        _, object_id, node_id, addr, offset, size, is_error = meta
+        if node_id == self.node_id and self.agent_shm is not None:
+            view = self.agent_shm.view(offset, size)
+            try:
+                return self._deserialize(bytes(view), is_error)
+            finally:
+                view.release()
+        if addr is None:
+            raise ObjectLostError(
+                f"object {object_id} lives on node {node_id} with no "
+                f"reachable transfer server")
+        return self._deserialize(
+            self._pull_p2p(object_id, addr, size), is_error)
 
     def _deserialize(self, payload: bytes, is_error: bool) -> Any:
         value = serialization.loads(payload)
