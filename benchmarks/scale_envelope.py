@@ -1,0 +1,180 @@
+"""Scale-envelope suite (reference: release/benchmarks/README.md:9-31 —
+the scalability envelope: queued tasks on one node, object args to one
+task, objects in one get, broadcast to many nodes).
+
+Emits ONE JSON line (also written to SCALE.json at the repo root) so
+rounds can be compared. Sized by SCALE_PROFILE:
+  quick — CI-friendly (seconds; used by tests/test_scale_envelope.py)
+  full  — the envelope targets (>=100k queued tasks, 1k-ref get, wide
+          fanout, multi-hundred-MiB broadcast over simulated nodes)
+
+Run: python benchmarks/scale_envelope.py [quick|full]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+PROFILES = {
+    "quick": {
+        "queued_tasks": 2000,
+        "get_refs": 300,
+        "fanout_args": 300,
+        "broadcast_mb": 16,
+        "broadcast_nodes": 2,
+        "actors": 8,
+    },
+    "full": {
+        "queued_tasks": 100_000,
+        "get_refs": 1000,
+        "fanout_args": 1000,
+        "broadcast_mb": 256,
+        "broadcast_nodes": 3,
+        "actors": 40,
+    },
+}
+
+
+def run(profile_name: str) -> dict:
+    import numpy as np
+
+    import ray_tpu
+
+    p = PROFILES[profile_name]
+    results: dict = {"profile": profile_name, "ncpu": os.cpu_count()}
+
+    ray_tpu.init(num_cpus=max(4, os.cpu_count() or 4),
+                 object_store_memory=768 * 1024 * 1024)
+
+    # 1. Queued-task flood: submission must not collapse with a deep
+    #    backlog (reference row: 1M+ tasks queued on one node).
+    @ray_tpu.remote
+    def nop(i):
+        return i
+
+    n = p["queued_tasks"]
+    t0 = time.time()
+    refs = [nop.remote(i) for i in range(n)]
+    submit_dt = time.time() - t0
+    results["queued_tasks"] = n
+    results["task_submit_per_s"] = round(n / submit_dt, 1)
+    t0 = time.time()
+    # Drain in windows: one get over the full flood measures the
+    # many-ref-get wall (section 2), not completion throughput.
+    last = None
+    for i in range(0, n, 5000):
+        last = ray_tpu.get(refs[i:i + 5000], timeout=3600)[-1]
+    results["task_complete_per_s"] = round(
+        n / (time.time() - t0 + submit_dt), 1)
+    assert last == n - 1
+    del refs
+
+    # 2. Many-ref get (reference row: 10k+ objects in one ray.get).
+    k = p["get_refs"]
+    objs = [ray_tpu.put(np.arange(16) + i) for i in range(k)]
+    t0 = time.time()
+    vals = ray_tpu.get(objs, timeout=600)
+    results["get_refs"] = k
+    results["get_refs_per_s"] = round(k / (time.time() - t0), 1)
+    assert len(vals) == k
+
+    # 3. Wide fanout: one task consuming many object args (reference
+    #    row: 10k+ object args to one task).
+    @ray_tpu.remote
+    def gather(*parts):
+        return sum(int(x[0]) for x in parts)
+
+    t0 = time.time()
+    total = ray_tpu.get(gather.remote(*objs[: p["fanout_args"]]), timeout=600)
+    results["fanout_args"] = p["fanout_args"]
+    results["fanout_s"] = round(time.time() - t0, 2)
+    assert total == sum(range(p["fanout_args"]))
+
+    # 4. Actor swarm round-trip.
+    @ray_tpu.remote
+    class Member:
+        def pid(self):
+            return os.getpid()
+
+    t0 = time.time()
+    actors = [Member.remote() for _ in range(p["actors"])]
+    pids = ray_tpu.get([a.pid.remote() for a in actors], timeout=600)
+    results["actors"] = p["actors"]
+    results["actor_spawn_roundtrip_s"] = round(time.time() - t0, 2)
+    assert len(set(pids)) == p["actors"]
+    for a in actors:
+        ray_tpu.kill(a)
+
+    # 5. Broadcast a large object to simulated nodes (reference row:
+    #    1 GiB broadcast to 50+ nodes): every agent node pulls the
+    #    payload P2P/inline and checksums it.
+    from ray_tpu._private.worker_context import get_head
+
+    head = get_head()
+    env = dict(os.environ)
+    env.pop("RAY_TPU_REMOTE", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    agents = []
+    for i in range(p["broadcast_nodes"]):
+        agents.append(subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.node_agent",
+             "--address", f"{head.address[0]}:{head.address[1]}",
+             "--num-cpus", "2", "--resources",
+             json.dumps({f"bnode{i}": 1}), "--node-id", f"bnode-{i}",
+             "--force-remote-objects"],
+            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT))
+    try:
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if len([x for x in ray_tpu.nodes() if x["alive"]]) >= 1 + len(agents):
+                break
+            time.sleep(0.3)
+
+        mb = p["broadcast_mb"]
+        blob = np.random.default_rng(3).standard_normal(mb * 131072 // 8)
+        ref = ray_tpu.put(blob)
+        expect = float(blob[:1024].sum())
+
+        @ray_tpu.remote
+        def crc(arr):
+            return float(arr[:1024].sum())
+
+        t0 = time.time()
+        checks = ray_tpu.get(
+            [crc.options(resources={f"bnode{i}": 1}).remote(ref)
+             for i in range(len(agents))],
+            timeout=1200,
+        )
+        dt = time.time() - t0
+        assert all(abs(c - expect) < 1e-6 for c in checks)
+        results["broadcast_mb"] = mb
+        results["broadcast_nodes"] = len(agents)
+        results["broadcast_gib_per_s"] = round(
+            mb * len(agents) / 1024 / dt, 3)
+        results["broadcast_s"] = round(dt, 2)
+    finally:
+        for a in agents:
+            a.kill()
+    ray_tpu.shutdown()
+    return results
+
+
+def main() -> None:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    profile = (sys.argv[1] if len(sys.argv) > 1
+               else os.environ.get("SCALE_PROFILE", "full"))
+    results = run(profile)
+    line = json.dumps(results)
+    print(line)
+    with open(os.path.join(REPO, "SCALE.json"), "w") as f:
+        f.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()

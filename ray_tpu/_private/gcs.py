@@ -749,7 +749,10 @@ class Head:
             if entry.location is not None:
                 # P2P object: the head is directory only — the client
                 # pulls the bytes straight from the hosting node's agent
-                # (reference: pull_manager.h:57).
+                # (reference: pull_manager.h:57). Read-pinned like shm
+                # metas: the free_object cast to the agent must not fire
+                # mid-pull (client sends read_done when finished).
+                entry.read_pins += 1
                 return ("p2p", entry.object_id, entry.location,
                         self.node_transfer_addrs.get(entry.location),
                         entry.remote_offset, entry.size, entry.is_error)
@@ -1433,11 +1436,40 @@ class Head:
                     # Calls parked behind unresolved args: deps may have
                     # sealed since (the seal sets dispatch_event).
                     self._flush_actor(actor)
-            # 2. normal tasks FIFO with skip-over for blocked ones
+            # 2. normal tasks FIFO with skip-over for blocked ones.
+            # Per-pass scan budgets keep a deep backlog LINEAR: without
+            # them a 100k-task flood re-runs pick_node over the whole
+            # queue on every pass (O(N^2) total — observed as a 0%-CPU-
+            # looking livelock at the scale envelope). Once dispatch
+            # saturates (consecutive no-idle-worker misses) or the scan
+            # budget is spent, the rest of the queue carries over
+            # untouched; the next capacity event rescans from the front.
             requeue: deque[TaskSpec] = deque()
             spawned = False
+            no_worker_misses = 0
+            scanned = 0
+            # Per-pass memo: a deep backlog is mostly identical specs,
+            # and this loop runs UNDER the head lock — every repeated
+            # pick_node / idle-worker scan here directly stalls worker
+            # put/finish RPCs. Cache keyed by resource shape (default
+            # strategy only); invalidated when an allocation fails.
+            pick_cache: dict = {}
+            no_worker: set = set()
+            _MISS = object()
             while self.task_queue:
+                if no_worker_misses >= 64 or scanned >= 4096:
+                    # Budget exhausted: ROTATE — unscanned tasks go to
+                    # the FRONT of the next pass and the scanned-but-
+                    # unplaced prefix to the back, so a long infeasible
+                    # prefix cannot starve feasible tasks behind it
+                    # (FIFO is already best-effort due to skip-over).
+                    rest = self.task_queue
+                    self.task_queue = deque()
+                    rest.extend(requeue)
+                    requeue = rest
+                    break
                 spec = self.task_queue.popleft()
+                scanned += 1
                 try:
                     if not self._validate_strategy(spec):
                         continue  # failed with an error object
@@ -1449,11 +1481,22 @@ class Head:
                         requeue.append(spec)
                         continue
                     demand = self._effective_demand(spec.resources, spec.scheduling_strategy)
-                    node = self.scheduler.pick_node(demand, strategy)
+                    rkey = (tuple(sorted(spec.resources.items()))
+                            if spec.scheduling_strategy is None else None)
+                    node = pick_cache.get(rkey, _MISS) if rkey is not None \
+                        else _MISS
+                    if node is _MISS:
+                        node = self.scheduler.pick_node(demand, strategy)
+                        if rkey is not None:
+                            pick_cache[rkey] = node
                     if node is None:
                         requeue.append(spec)
                         continue
                     need_tpu = float(spec.resources.get("TPU", 0)) > 0
+                    if (node.node_id, need_tpu) in no_worker:
+                        requeue.append(spec)
+                        no_worker_misses += 1
+                        continue
                     rec = self._idle_worker(node.node_id, need_tpu)
                     if rec is None:
                         if not spawned and self._can_spawn(node.node_id,
@@ -1461,13 +1504,17 @@ class Head:
                             self.spawn_worker(node.node_id,
                                               tpu_capable=need_tpu)
                             spawned = True
+                        no_worker.add((node.node_id, need_tpu))
                         requeue.append(spec)
+                        no_worker_misses += 1
                         continue
                     if not self._try_allocate(
                         rec, node.node_id, spec.resources, spec.scheduling_strategy
                     ):
+                        pick_cache.pop(rkey, None)
                         requeue.append(spec)
                         continue
+                    no_worker_misses = 0
                     self._push_to_worker(rec, spec)
                 except Exception:
                     # One malformed spec must not wedge the dispatch loop or

@@ -232,17 +232,20 @@ class NodeAgent:
         if kind == "pull":
             with self._store_lock:
                 loc = self.local_objects.get(body["object_id"])
-            if loc is None:
-                raise rpc.RpcError(
-                    f"object {body['object_id']} not on this node")
-            offset, size = loc
-            start = body["start"]
-            n = min(body["length"], size - start)
-            view = self.store.view(offset + start, n)
-            try:
-                return {"data": bytes(view), "total": size}
-            finally:
-                view.release()
+                if loc is None:
+                    raise rpc.RpcError(
+                        f"object {body['object_id']} not on this node")
+                offset, size = loc
+                start = body["start"]
+                n = min(body["length"], size - start)
+                # Copy under the lock: a concurrent free_object +
+                # realloc must not recycle the region mid-read.
+                view = self.store.view(offset + start, n)
+                try:
+                    data = bytes(view)
+                finally:
+                    view.release()
+            return {"data": data, "total": size}
         if kind == "abort_alloc":
             with self._store_lock:
                 self.store.free(body["offset"])

@@ -352,29 +352,67 @@ class CoreRuntime:
         read_ids = []
         try:
             for hex_id in id_list:
-                meta = metas[hex_id]
-                if meta[0] == "inline":
-                    _, payload, is_error = meta
-                    values.append(self._deserialize(payload, is_error))
-                elif meta[0] == "shm":
-                    _, offset, size, is_error = meta
-                    read_ids.append(hex_id)
-                    view = self.shm.view(offset, size)
-                    try:
-                        # Copy out of shm before releasing the read pin so the
-                        # head may spill/evict afterwards. (Zero-copy pinned
-                        # reads are a planned optimization.)
-                        values.append(self._deserialize(bytes(view), is_error))
-                    finally:
-                        view.release()
-                elif meta[0] == "p2p":
-                    values.append(self._read_p2p(meta))
-                else:
-                    raise ObjectLostError(meta[1])
+                values.append(
+                    self._value_from_meta(hex_id, metas[hex_id], read_ids))
         finally:
             if read_ids:
                 self.conn.cast("read_done", {"ids": read_ids})
         return values[0] if single else values
+
+    def _value_from_meta(self, hex_id: str, meta: tuple,
+                         read_ids: list) -> Any:
+        """Resolve one object meta to its value. ``read_ids`` collects
+        ids whose head-side read pin must be released (the caller casts
+        read_done)."""
+        if meta[0] == "inline":
+            return self._deserialize(meta[1], meta[2])
+        if meta[0] == "shm":
+            _, offset, size, is_error = meta
+            read_ids.append(hex_id)
+            view = self.shm.view(offset, size)
+            try:
+                # Copy out of shm before releasing the read pin so the
+                # head may spill/evict afterwards. (Zero-copy pinned
+                # reads are a planned optimization.)
+                return self._deserialize(bytes(view), is_error)
+            finally:
+                view.release()
+        if meta[0] == "p2p":
+            read_ids.append(hex_id)  # p2p metas are read-pinned too
+            return self._read_p2p_retrying(hex_id, meta, read_ids)
+        raise ObjectLostError(meta[1])
+
+    def _read_p2p_retrying(self, hex_id: str, meta: tuple,
+                           read_ids: list, attempts: int = 4) -> Any:
+        """A pull can race the hosting node's death; the head marks the
+        entry LOST and lineage re-executes the producer (reference:
+        object_recovery_manager.h:43), so on failure re-resolve the meta
+        through the head instead of surfacing a hard error."""
+        import time as _time
+
+        for i in range(attempts):
+            try:
+                return self._read_p2p(meta)
+            except (rpc.ConnectionLost, rpc.RpcError, ObjectLostError,
+                    OSError):
+                if i == attempts - 1:
+                    raise
+                _time.sleep(0.5 * (i + 1))
+                waiter_id, fut = self._new_waiter()
+                self.conn.cast("get_meta",
+                               {"waiter_id": waiter_id, "ids": [hex_id]})
+                try:
+                    body = fut.result(30)
+                finally:
+                    with self._waiters_lock:
+                        self._waiters.pop(waiter_id, None)
+                fresh = body["metas"][hex_id]
+                if fresh[0] != "p2p":
+                    # Reconstructed into the head store (or errored):
+                    # resolve through the generic path.
+                    return self._value_from_meta(hex_id, fresh, read_ids)
+                read_ids.append(hex_id)  # new pin from the fresh meta
+                meta = fresh
 
     def get_async(self, ref: ObjectRef) -> Future:
         waiter_id, fut = self._new_waiter()
@@ -398,10 +436,20 @@ class CoreRuntime:
                     # dispatch thread (it would stall every other
                     # incoming head message for the transfer duration).
                     def _pull():
+                        # The initial meta carried a read pin already.
+                        read_ids: list = [ref.hex()]
                         try:
-                            result.set_result(self._read_p2p(meta))
+                            result.set_result(self._read_p2p_retrying(
+                                ref.hex(), meta, read_ids))
                         except Exception as e:  # noqa: BLE001
                             result.set_exception(e)
+                        finally:
+                            if read_ids:
+                                try:
+                                    self.conn.cast("read_done",
+                                                   {"ids": read_ids})
+                                except rpc.ConnectionLost:
+                                    pass
 
                     threading.Thread(target=_pull, daemon=True,
                                      name="p2p-pull").start()

@@ -1,0 +1,20 @@
+"""Scale-envelope smoke (quick profile) — the full envelope runs via
+benchmarks/scale_envelope.py (reference: release/benchmarks/README.md)."""
+
+import sys
+import os
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "benchmarks"))
+
+
+def test_scale_envelope_quick():
+    import scale_envelope
+
+    results = scale_envelope.run("quick")
+    assert results["task_submit_per_s"] > 100
+    assert results["task_complete_per_s"] > 50
+    assert results["get_refs_per_s"] > 50
+    assert results["broadcast_gib_per_s"] > 0
+    assert results["actors"] == 8
