@@ -51,6 +51,16 @@ def run(profile_name: str) -> dict:
 
     ray_tpu.init(num_cpus=max(4, os.cpu_count() or 4),
                  object_store_memory=768 * 1024 * 1024)
+    try:
+        return _run_sections(p, results)
+    finally:
+        ray_tpu.shutdown()
+
+
+def _run_sections(p: dict, results: dict) -> dict:
+    import numpy as np
+
+    import ray_tpu
 
     # 1. Queued-task flood: submission must not collapse with a deep
     #    backlog (reference row: 1M+ tasks queued on one node).
@@ -161,7 +171,11 @@ def run(profile_name: str) -> dict:
     finally:
         for a in agents:
             a.kill()
-    ray_tpu.shutdown()
+        for a in agents:
+            try:
+                a.wait(timeout=5)
+            except Exception:
+                pass
     return results
 
 

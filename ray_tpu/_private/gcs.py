@@ -1515,6 +1515,13 @@ class Head:
                         requeue.append(spec)
                         continue
                     no_worker_misses = 0
+                    # Drop the memoized pick after a successful dispatch:
+                    # the allocation changed utilization, and the hybrid
+                    # pack/spread policy must see it (native parity). The
+                    # memo then only dedupes the SCAN-miss path, which is
+                    # what made deep backlogs quadratic.
+                    if rkey is not None:
+                        pick_cache.pop(rkey, None)
                     self._push_to_worker(rec, spec)
                 except Exception:
                     # One malformed spec must not wedge the dispatch loop or

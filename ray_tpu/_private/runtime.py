@@ -123,7 +123,8 @@ class CoreRuntime:
             elif kind == "objects_ready":
                 # The get() already timed out: nobody will read these metas,
                 # so release the read pins the head took in _meta_for.
-                stale = [oid for oid, m in body["metas"].items() if m[0] == "shm"]
+                stale = [oid for oid, m in body["metas"].items()
+                         if m[0] in ("shm", "p2p")]  # both are read-pinned
                 if stale:
                     try:
                         self.conn.cast("read_done", {"ids": stale})
@@ -350,11 +351,19 @@ class CoreRuntime:
         metas = body["metas"]
         values = []
         read_ids = []
+        visited = 0
         try:
             for hex_id in id_list:
                 values.append(
                     self._value_from_meta(hex_id, metas[hex_id], read_ids))
+                visited += 1
         finally:
+            # The head pinned EVERY shm/p2p meta up front; if resolution
+            # raised mid-batch (e.g. a stored task error), the unvisited
+            # metas' pins must still be released or their objects leak.
+            for hex_id in id_list[visited + 1:]:
+                if metas[hex_id][0] in ("shm", "p2p"):
+                    read_ids.append(hex_id)
             if read_ids:
                 self.conn.cast("read_done", {"ids": read_ids})
         return values[0] if single else values
@@ -387,12 +396,14 @@ class CoreRuntime:
         """A pull can race the hosting node's death; the head marks the
         entry LOST and lineage re-executes the producer (reference:
         object_recovery_manager.h:43), so on failure re-resolve the meta
-        through the head instead of surfacing a hard error."""
+        through the head instead of surfacing a hard error. Only the
+        TRANSPORT is retried — a stored user error deserializes (and
+        raises) exactly once, outside the retry scope."""
         import time as _time
 
         for i in range(attempts):
             try:
-                return self._read_p2p(meta)
+                payload, is_error = self._fetch_p2p_bytes(meta)
             except (rpc.ConnectionLost, rpc.RpcError, ObjectLostError,
                     OSError):
                 if i == attempts - 1:
@@ -403,6 +414,11 @@ class CoreRuntime:
                                {"waiter_id": waiter_id, "ids": [hex_id]})
                 try:
                     body = fut.result(30)
+                except FutureTimeoutError:
+                    # Leave no orphan waiter: a late reply would carry a
+                    # fresh read pin nobody releases.
+                    self.conn.cast("cancel_wait", {"waiter_id": waiter_id})
+                    raise
                 finally:
                     with self._waiters_lock:
                         self._waiters.pop(waiter_id, None)
@@ -413,6 +429,8 @@ class CoreRuntime:
                     return self._value_from_meta(hex_id, fresh, read_ids)
                 read_ids.append(hex_id)  # new pin from the fresh meta
                 meta = fresh
+            else:
+                return self._deserialize(payload, is_error)
 
     def get_async(self, ref: ObjectRef) -> Future:
         waiter_id, fut = self._new_waiter()
@@ -462,23 +480,23 @@ class CoreRuntime:
         self.conn.cast("get_meta", {"waiter_id": waiter_id, "ids": [ref.hex()]})
         return result
 
-    def _read_p2p(self, meta: tuple) -> Any:
-        """("p2p", object_id, node_id, (ip, port), offset, size, is_error):
-        same-node readers map the agent arena directly; everyone else
+    def _fetch_p2p_bytes(self, meta: tuple) -> tuple:
+        """Transport half of a p2p read: ("p2p", object_id, node_id,
+        (ip, port), offset, size, is_error) -> (payload, is_error).
+        Same-node readers map the agent arena directly; everyone else
         pulls chunks from the hosting node's transfer server."""
         _, object_id, node_id, addr, offset, size, is_error = meta
         if node_id == self.node_id and self.agent_shm is not None:
             view = self.agent_shm.view(offset, size)
             try:
-                return self._deserialize(bytes(view), is_error)
+                return bytes(view), is_error
             finally:
                 view.release()
         if addr is None:
             raise ObjectLostError(
                 f"object {object_id} lives on node {node_id} with no "
                 f"reachable transfer server")
-        return self._deserialize(
-            self._pull_p2p(object_id, addr, size), is_error)
+        return self._pull_p2p(object_id, addr, size), is_error
 
     def _deserialize(self, payload: bytes, is_error: bool) -> Any:
         value = serialization.loads(payload)

@@ -128,23 +128,17 @@ class DashboardServer:
         if path == "/metrics":
             return um.prometheus_text()
         if path == "/":
-            from ray_tpu.util import state as us2
+            # Web UI (reference: dashboard/client/src React app; here a
+            # single self-contained SPA over the same JSON endpoints).
+            import os
 
-            summary = us2.summarize_tasks()
-            rows = "".join(
-                f"<tr><td>{name}</td><td>{info['total']}</td>"
-                f"<td>{json.dumps(info['state_counts'])}</td></tr>"
-                for name, info in summary.items()
-            )
-            return (
-                "<html><head><title>ray_tpu dashboard</title></head><body>"
-                "<h2>ray_tpu cluster</h2>"
-                f"<pre>{json.dumps(ray_tpu.cluster_resources(), indent=1)}</pre>"
-                "<h3>Tasks</h3><table border=1><tr><th>name</th><th>total</th>"
-                f"<th>states</th></tr>{rows}</table>"
-                "<p>API: /api/cluster /api/actors /api/tasks /api/objects "
-                "/api/workers /api/jobs /metrics</p></body></html>"
-            )
+            ui = os.path.join(os.path.dirname(__file__),
+                              "dashboard_ui.html")
+            try:
+                with open(ui, encoding="utf-8") as f:
+                    return f.read()
+            except OSError:
+                return "<html><body>dashboard_ui.html missing</body></html>"
         return None
 
     def _serve(self) -> None:

@@ -88,3 +88,19 @@ def test_from_torch_roundtrip():
     ds = ray_tpu.data.from_torch(DS())
     assert ds.count() == 4
     assert ds.sum("y") == 0 + 1 + 4 + 9
+
+
+def test_dashboard_web_ui_served():
+    """'/' serves the SPA (reference: dashboard/client web UI)."""
+    from ray_tpu.dashboard import start_dashboard, stop_dashboard
+
+    port = start_dashboard()
+    try:
+        html = _get(port, "/")
+        assert "<html" in html.lower()
+        assert "ray_tpu dashboard" in html
+        # The SPA drives the same JSON APIs.
+        for endpoint in ("/api/cluster", "/api/tasks", "/api/actors"):
+            assert endpoint in html
+    finally:
+        stop_dashboard()
