@@ -42,8 +42,6 @@ PROFILES = {
 
 
 def run(profile_name: str) -> dict:
-    import numpy as np
-
     import ray_tpu
 
     p = PROFILES[profile_name]

@@ -1,0 +1,117 @@
+"""Multi-slice meshes: scaling past one ICI domain over DCN.
+
+SURVEY.md §7 hard part (f): a single TPU slice is one ICI torus; going
+bigger means multiple slices whose only link is the data-center network.
+The standard recipe (the public scaling playbook, and what the reference
+delegates to NCCL process groups across nodes — train/torch/config.py:115)
+is HIERARCHICAL parallelism:
+
+- a ``dcn`` mesh axis spans slices — put DATA parallelism (or pipeline
+  stages) there: one gradient all-reduce per step amortizes the thin
+  DCN link;
+- every other axis (fsdp/tensor/sequence/expert) stays INSIDE a slice,
+  where per-layer collectives ride ICI.
+
+``build_multislice_mesh`` materializes that layout with jax's
+``mesh_utils.create_hybrid_device_mesh`` on real multi-slice TPU
+topologies (devices carry ``slice_index``), and falls back to a
+partitioned layout on hosts without slice info (CPU testing: the first
+mesh axis spans the simulated slices), so multi-slice programs compile
+and run on the virtual CPU mesh exactly like single-slice ones.
+
+Usage:
+
+    mesh = build_multislice_mesh(num_slices=2, per_slice=MeshConfig(
+        fsdp=2, tensor=2))
+    # axes: ("dcn", "fsdp", "tensor") — shard batch over ("dcn", "fsdp"),
+    # params over fsdp/tensor; XLA inserts DCN collectives only for the
+    # dcn axis.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ray_tpu.parallel.mesh import BATCH_AXES, MeshConfig
+
+AXIS_DCN = "dcn"
+
+
+def detect_num_slices(devices=None) -> int:
+    """Distinct ``slice_index`` values across devices (1 when the
+    backend exposes none — single slice or CPU)."""
+    import jax
+
+    if devices is None:
+        devices = jax.devices()
+    slices = {getattr(d, "slice_index", 0) or 0 for d in devices}
+    return max(1, len(slices))
+
+
+def build_multislice_mesh(num_slices: int | None = None,
+                          per_slice: MeshConfig | None = None,
+                          devices=None):
+    """A Mesh whose leading ``dcn`` axis spans slices and whose
+    remaining axes factor each slice's devices per ``per_slice``.
+
+    On real multi-slice hardware the device order comes from
+    ``mesh_utils.create_hybrid_device_mesh`` (DCN axis outermost, ICI
+    axes laid on each slice's torus). Elsewhere the devices are split
+    into ``num_slices`` contiguous groups — the simulation used by the
+    CPU-mesh tests and the multi-chip dryrun.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    if num_slices is None:
+        num_slices = detect_num_slices(devices)
+    if len(devices) % num_slices:
+        raise ValueError(
+            f"{len(devices)} devices not divisible into {num_slices} slices")
+    per = len(devices) // num_slices
+    per_slice = per_slice or MeshConfig()
+    sizes = per_slice.resolve(per)
+    axis_names = tuple(a for a in per_slice.axis_order if sizes[a] > 1)
+    ici_shape = tuple(sizes[a] for a in axis_names)
+    if not axis_names:
+        axis_names, ici_shape = ("data",), (1,)
+
+    real_slices = {getattr(d, "slice_index", None) for d in devices}
+    if real_slices != {None} and len(real_slices) == num_slices:
+        from jax.experimental import mesh_utils
+
+        # Shapes must be same-rank, elementwise-multiplied: a leading
+        # size-1 ICI dim paired with the slice count makes axis 0 the
+        # pure-DCN axis and leaves each slice's torus on the ICI axes
+        # (a plain (num_slices,) dcn shape would np.block-concatenate
+        # slices along the LAST axis and scramble the hierarchy).
+        mesh_devices = mesh_utils.create_hybrid_device_mesh(
+            (1,) + ici_shape,
+            (num_slices,) + (1,) * len(ici_shape),
+            devices=devices, process_is_granule=False)
+    else:
+        mesh_devices = np.asarray(devices).reshape(
+            (num_slices,) + ici_shape)
+    return Mesh(mesh_devices, (AXIS_DCN,) + axis_names)
+
+
+def multislice_batch_axes(mesh) -> tuple:
+    """Axes a global batch dimension shards over in a multi-slice mesh:
+    the dcn axis (data parallel across slices) plus the usual batch-like
+    ICI axes."""
+    present = tuple(a for a in (AXIS_DCN,) + BATCH_AXES
+                    if mesh.shape.get(a, 1) > 1)
+    return present or (AXIS_DCN,)
+
+
+def dcn_allreduce_axes(mesh) -> tuple:
+    """Axes gradients reduce over for hierarchical DP: jax's psum over
+    ("dcn", "data", "fsdp") compiles to an ICI reduce-scatter/all-gather
+    within each slice plus ONE cross-slice all-reduce on the wire —
+    XLA's collective hierarchy handles the split; callers just name the
+    axes."""
+    return multislice_batch_axes(mesh)
