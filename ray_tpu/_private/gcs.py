@@ -1302,6 +1302,77 @@ class Head:
                     avail[k] = avail.get(k, 0) + v
             return {"total": total, "available": avail}
 
+    def _h_profile_worker(self, body, conn):
+        """Live stack capture of a worker (reference:
+        dashboard/modules/reporter/profile_manager.py:191 — py-spy; here
+        the worker's registered faulthandler SIGUSR1 hook appends every
+        thread's stack to its log, which this handler harvests)."""
+        import signal
+
+        worker_id = body["worker_id"]
+        # Clamped: this handler polls on the requesting connection's
+        # reader thread, so only ITS client stalls, and boundedly.
+        timeout_s = min(5.0, max(0.2, float(body.get("timeout_s", 3.0))))
+        with self.lock:
+            rec = self.workers.get(worker_id)
+            if rec is None:
+                return {"worker_id": worker_id, "error": "unknown worker"}
+            pid, node_id, local = rec.pid, rec.node_id, rec.proc is not None
+            agent = self.node_agents.get(node_id)
+        path = os.path.join(self.session_dir, "logs", f"{worker_id}.log")
+        before = 0
+        if local:
+            try:
+                before = os.path.getsize(path)
+            except OSError:
+                before = 0
+        try:
+            if local:
+                os.kill(pid, signal.SIGUSR1)
+            elif agent is not None:
+                agent.cast("signal_worker",
+                           {"worker_id": worker_id,
+                            "signum": int(signal.SIGUSR1)})
+            else:
+                return {"worker_id": worker_id,
+                        "error": f"node {node_id} has no agent connection"}
+        except Exception as e:  # noqa: BLE001
+            return {"worker_id": worker_id, "error": str(e)}
+        if not local:
+            return {"worker_id": worker_id, "signalled": True,
+                    "note": "remote worker: dump lands in its node-local "
+                            "log"}
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            try:
+                size = os.path.getsize(path)
+            except OSError:
+                size = before
+            if size > before:
+                with open(path, "rb") as f:
+                    f.seek(before)
+                    dump = f.read().decode("utf-8", errors="replace")
+                # Ordinary log output can land in the window too: only a
+                # faulthandler header marks the actual dump, and the
+                # thread list may still be flushing — keep polling until
+                # the marker shows (returning from the marker on).
+                marker = dump.find("Thread 0x")
+                if marker < 0:
+                    marker = dump.find("Current thread")
+                if marker >= 0:
+                    time.sleep(0.2)  # let the remaining threads flush
+                    with open(path, "rb") as f:
+                        f.seek(before)
+                        dump = f.read().decode("utf-8", errors="replace")
+                    marker2 = dump.find("Thread 0x")
+                    if marker2 < 0:
+                        marker2 = dump.find("Current thread")
+                    return {"worker_id": worker_id, "pid": pid,
+                            "stacks": dump[marker2:].splitlines()}
+            time.sleep(0.05)
+        return {"worker_id": worker_id, "pid": pid, "stacks": [],
+                "error": "no dump appeared (worker busy in native code?)"}
+
     def _h_get_nodes(self, body, conn):
         with self.lock:
             return {

@@ -202,6 +202,18 @@ class NodeAgent:
     def _handle(self, kind: str, body: dict, conn: rpc.Connection):
         if kind == "spawn_worker":
             self._spawn(body)
+        elif kind == "signal_worker":
+            # Dashboard live profiling: poke the worker's faulthandler
+            # (reference: reporter/profile_manager.py stack capture).
+            import signal as _signal
+
+            proc = self.procs.get(body["worker_id"])
+            if proc is not None and proc.poll() is None:
+                try:
+                    proc.send_signal(body.get("signum",
+                                              int(_signal.SIGUSR1)))
+                except OSError:
+                    pass
         elif kind == "free_object":
             # Head directory says the object's refcount hit zero.
             with self._store_lock:

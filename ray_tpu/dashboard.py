@@ -51,6 +51,16 @@ def _log_index() -> list[dict]:
     return out
 
 
+def _profile_worker(worker_id: str) -> dict:
+    """Delegate to the head (the dashboard actor runs in a worker
+    process): the head signals the worker's faulthandler and harvests
+    the stack dump from its log."""
+    from ray_tpu._private.worker_context import global_runtime
+
+    return global_runtime().conn.call(
+        "profile_worker", {"worker_id": worker_id}, timeout=15)
+
+
 def _log_tail(name: str, max_bytes: int = 64 * 1024) -> dict:
     import os
 
@@ -119,6 +129,13 @@ class DashboardServer:
             from ray_tpu import serve
 
             return {"deployments": serve.status()}
+        if path.startswith("/api/profile/"):
+            # Live stack dump of a worker (reference:
+            # dashboard/modules/reporter/profile_manager.py:191 — py-spy
+            # stack capture; here the workers' registered faulthandler
+            # SIGUSR1 hook writes every thread's stack into the worker
+            # log, which this endpoint harvests).
+            return _profile_worker(path[len("/api/profile/"):])
         if path == "/api/logs":
             # Reference: dashboard/modules/log — per-worker log index.
             return {"logs": _log_index()}

@@ -30,8 +30,6 @@ Usage:
 
 from __future__ import annotations
 
-import math
-
 import numpy as np
 
 from ray_tpu.parallel.mesh import BATCH_AXES, MeshConfig
@@ -81,7 +79,16 @@ def build_multislice_mesh(num_slices: int | None = None,
         axis_names, ici_shape = ("data",), (1,)
 
     real_slices = {getattr(d, "slice_index", None) for d in devices}
-    if real_slices != {None} and len(real_slices) == num_slices:
+    if real_slices != {None} and len(real_slices) != num_slices:
+        # Silently reshaping would lay ICI axes ACROSS physical slice
+        # boundaries — per-layer collectives on the thin DCN link, the
+        # exact layout this module exists to prevent.
+        raise ValueError(
+            f"requested {num_slices} slices but the devices span "
+            f"{len(real_slices)} physical slices "
+            f"({sorted(real_slices)}); pass num_slices=None to use the "
+            f"detected count")
+    if real_slices != {None}:
         from jax.experimental import mesh_utils
 
         # Shapes must be same-rank, elementwise-multiplied: a leading

@@ -104,3 +104,39 @@ def test_dashboard_web_ui_served():
             assert endpoint in html
     finally:
         stop_dashboard()
+
+
+def test_dashboard_live_profile_endpoint():
+    """/api/profile/{worker_id}: faulthandler stack capture of a live
+    worker (reference: reporter/profile_manager.py py-spy flow)."""
+    import time
+
+    from ray_tpu._private.worker_context import get_head
+    from ray_tpu.dashboard import start_dashboard, stop_dashboard
+
+    @ray_tpu.remote
+    class Sleeper:
+        def park(self):
+            time.sleep(20)
+
+        def ping(self):
+            return 1
+
+    s = Sleeper.remote()
+    ray_tpu.get(s.ping.remote(), timeout=30)
+    s.park.remote()  # in-flight: the dump shows it on the stack
+    time.sleep(0.5)
+    head = get_head()
+    worker_id = next(w.worker_id for w in head.workers.values()
+                     if w.actor_id == s._actor_id and w.proc is not None)
+    port = start_dashboard()
+    try:
+        out = _get(port, f"/api/profile/{worker_id}")
+        assert out.get("stacks"), out
+        text = "\n".join(out["stacks"])
+        assert "Thread" in text and "park" in text, text[:500]
+        unknown = _get(port, "/api/profile/worker-nope")
+        assert unknown["error"] == "unknown worker"
+    finally:
+        stop_dashboard()
+        ray_tpu.kill(s)
