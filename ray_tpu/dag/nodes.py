@@ -327,4 +327,13 @@ class CompiledDAG:
                 ch.close()
             except Exception:
                 pass
+        # Remove the shm names now that every loop thread has been woken
+        # with ChannelClosed: live mappings stay valid while they drain,
+        # and channels of crashed actors (attach count stuck > 0) are
+        # reclaimed instead of leaking in /dev/shm.
+        for ch in self._channels.values():
+            try:
+                ch.unlink()
+            except Exception:
+                pass
         self._channels = {}

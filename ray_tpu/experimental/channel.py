@@ -51,6 +51,8 @@ def _load_lib() -> ctypes.CDLL:
     lib.rtpu_chan_write_acquire.argtypes = [ctypes.c_int64, ctypes.c_double]
     lib.rtpu_chan_write_commit.restype = ctypes.c_int
     lib.rtpu_chan_write_commit.argtypes = [ctypes.c_int64, ctypes.c_uint64]
+    lib.rtpu_chan_write_abort.restype = ctypes.c_int
+    lib.rtpu_chan_write_abort.argtypes = [ctypes.c_int64]
     lib.rtpu_chan_read_acquire.restype = ctypes.c_int64
     lib.rtpu_chan_read_acquire.argtypes = [
         ctypes.c_int64, ctypes.POINTER(ctypes.c_void_p), ctypes.c_double]
@@ -62,6 +64,8 @@ def _load_lib() -> ctypes.CDLL:
     lib.rtpu_chan_is_closed.argtypes = [ctypes.c_int64]
     lib.rtpu_chan_destroy.restype = ctypes.c_int
     lib.rtpu_chan_destroy.argtypes = [ctypes.c_int64, ctypes.c_int]
+    lib.rtpu_chan_force_unlink.restype = ctypes.c_int
+    lib.rtpu_chan_force_unlink.argtypes = [ctypes.c_char_p]
     return lib
 
 
@@ -133,8 +137,16 @@ class Channel:
         ptr = lib.rtpu_chan_write_acquire(self._h, ctypes.c_double(timeout_s))
         if not ptr:
             self._raise_wait_failure("write")
-        view = (ctypes.c_char * self.capacity).from_address(ptr)
-        n = serialization.write_to(memoryview(view).cast("B"), header, buffers)
+        try:
+            view = (ctypes.c_char * self.capacity).from_address(ptr)
+            n = serialization.write_to(memoryview(view).cast("B"), header,
+                                       buffers)
+        except BaseException:
+            # Nothing was published; release the acquired slot so the
+            # NEXT write sees the real error's aftermath as a clean slot
+            # instead of a permanent bogus ChannelTimeout.
+            lib.rtpu_chan_write_abort(self._h)
+            raise
         if lib.rtpu_chan_write_commit(self._h, n) != 0:
             raise RuntimeError("channel write commit failed")
 
@@ -181,6 +193,13 @@ class Channel:
         """Wake all blocked peers with ChannelClosed."""
         _get_lib().rtpu_chan_close(self._h)
 
+    def unlink(self) -> None:
+        """Force-remove the shm NAME now (mappings stay valid until
+        their holders detach or die). Compiled-DAG teardown calls this
+        after close() so channels of crashed actors — whose attach
+        counts never reach zero — cannot leak /dev/shm regions."""
+        _get_lib().rtpu_chan_force_unlink(self.name.encode())
+
     def _raise_wait_failure(self, op: str) -> None:
         if _get_lib().rtpu_chan_is_closed(self._h):
             raise ChannelClosed(self.name)
@@ -191,7 +210,12 @@ class Channel:
         return (Channel, (self.capacity, self.num_readers, self.name, False))
 
     def __del__(self):
+        # Detach only: the native side keeps a process-shared attach
+        # refcount and the LAST detacher unlinks the shm name, so a
+        # creator handle GC'd early cannot invalidate readers that still
+        # hold the channel (reference: mutable objects outlive the
+        # creating worker until every reader releases them).
         try:
-            _get_lib().rtpu_chan_destroy(self._h, 1 if self._creator else 0)
+            _get_lib().rtpu_chan_destroy(self._h, 0)
         except Exception:
             pass

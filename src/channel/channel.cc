@@ -51,7 +51,7 @@
 
 namespace {
 
-constexpr uint64_t kMagic = 0x5254505543484133ULL;  // "RTPUCHA3"
+constexpr uint64_t kMagic = 0x5254505543484134ULL;  // "RTPUCHA4"
 constexpr size_t kHeaderSize = 512;
 constexpr uint32_t kMaxSlots = 16;
 
@@ -70,6 +70,10 @@ struct Header {
   uint32_t num_slots;
   std::atomic<uint32_t> seq;    // messages published (futex word)
   std::atomic<uint32_t> closed;
+  std::atomic<uint32_t> attach; // live handles across all processes; the
+                                // LAST detacher unlinks the shm name, so a
+                                // creator GC'd early can't yank the region
+                                // from readers still holding it
   std::atomic<uint32_t> acks[kMaxSlots];  // futex words
   std::atomic<uint64_t> len[kMaxSlots];
 };
@@ -186,6 +190,7 @@ int64_t open_impl(const char* name, uint64_t capacity, uint32_t num_readers,
     hdr->num_slots = num_slots;
     hdr->seq.store(0, std::memory_order_relaxed);
     hdr->closed.store(0, std::memory_order_relaxed);
+    hdr->attach.store(1, std::memory_order_relaxed);
     for (uint32_t i = 0; i < kMaxSlots; i++) {
       // Every slot starts fully acked: the first num_slots writes
       // proceed immediately.
@@ -196,6 +201,8 @@ int64_t open_impl(const char* name, uint64_t capacity, uint32_t num_readers,
   } else if (hdr->magic != kMagic) {
     munmap(mem, map_size);
     return -EINVAL;
+  } else {
+    hdr->attach.fetch_add(1, std::memory_order_acq_rel);
   }
   auto* c = new Chan();
   c->hdr = hdr;
@@ -250,6 +257,16 @@ int rtpu_chan_write_commit(int64_t h, uint64_t len) {
   c->hdr->acks[slot].store(0, std::memory_order_relaxed);
   c->hdr->seq.fetch_add(1, std::memory_order_release);
   futex_wake_all(&c->hdr->seq);
+  return 0;
+}
+
+// Abandon an acquired-but-uncommitted write slot (e.g. serialization into
+// the mapped region raised mid-way). Nothing is published; the next
+// write_acquire starts fresh on the same slot.
+int rtpu_chan_write_abort(int64_t h) {
+  Chan* c = get_handle(h);
+  if (!c || c->acquired_write_slot < 0) return -1;
+  c->acquired_write_slot = -1;
   return 0;
 }
 
@@ -314,18 +331,37 @@ int rtpu_chan_is_closed(int64_t h) {
   return (c && c->hdr->closed.load(std::memory_order_acquire)) ? 1 : 0;
 }
 
-// Unmap; optionally unlink the shm name (creator side).
-int rtpu_chan_destroy(int64_t h, int unlink_shm) {
+// Detach this handle. The shm name is unlinked only when the LAST
+// attached handle (across all processes) detaches — a creator handle
+// GC'd while a reader still drains cannot yank the region (the old
+// creator-unlinks rule did exactly that). `force_unlink` (=2) unlinks
+// unconditionally.
+int rtpu_chan_destroy(int64_t h, int force_unlink) {
   Chan* c = get_handle(h);
   if (!c) return -1;
   {
     std::lock_guard<std::mutex> g(g_lock);
     g_chans[h] = nullptr;
   }
+  uint32_t prev = c->hdr->attach.fetch_sub(1, std::memory_order_acq_rel);
+  bool last = (prev <= 1);
   munmap(reinterpret_cast<void*>(c->hdr), c->map_size);
-  if (unlink_shm) shm_unlink(c->name.c_str());
+  // A crashed peer never decrements its attach count; compiled-DAG
+  // teardown force-unlinks every channel name it created
+  // (rtpu_chan_force_unlink) so those regions are reclaimed once the
+  // surviving mappings close. Ad-hoc channels whose holders all crash
+  // leak the name until reboot — standard POSIX shm semantics.
+  if (last || force_unlink == 2) shm_unlink(c->name.c_str());
   delete c;
   return 0;
+}
+
+// Remove the shm NAME regardless of attach count (existing mappings
+// stay valid; the memory is reclaimed when they unmap or die). Used by
+// compiled-DAG teardown, which knows every reader has been woken by
+// close() and no new opens are coming.
+int rtpu_chan_force_unlink(const char* name) {
+  return shm_unlink(name) == 0 ? 0 : -errno;
 }
 
 }  // extern "C"

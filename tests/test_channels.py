@@ -72,6 +72,64 @@ def test_channel_ring_runahead():
     assert rd.read() == 4
 
 
+def test_channel_survives_creator_gc():
+    """The shm region must outlive the CREATOR handle: the last attached
+    handle unlinks, not the creating one (old bug: __del__ on the
+    creator unlinked while a reader still drained the ring)."""
+    import gc
+
+    ch = Channel(capacity=1 << 16, num_readers=1, num_slots=4)
+    name = ch.name
+    rd = Channel(name=name, _create=False)
+    for i in range(3):
+        ch.write(i)
+    del ch
+    gc.collect()
+    # Reader still drains the messages AND the region is still openable.
+    assert [rd.read() for _ in range(3)] == [0, 1, 2]
+    rd2 = Channel(name=name, _create=False)
+    del rd2
+    shm_path = "/dev/shm" + name
+    import os as _os
+    assert _os.path.exists(shm_path)
+    del rd
+    gc.collect()
+    assert not _os.path.exists(shm_path)  # last detacher unlinked
+
+
+def test_channel_write_abort_on_serialization_failure(monkeypatch):
+    """A failure AFTER write_acquire (serializing into the mapped slot)
+    must abort the acquired slot — otherwise every later write_acquire
+    returns NULL and is misreported as ChannelTimeout forever."""
+    from ray_tpu._private import serialization
+
+    ch = Channel(capacity=1 << 16, num_readers=1, num_slots=1)
+    rd = Channel(name=ch.name, _create=False)
+
+    real_write_to = serialization.write_to
+    calls = {"n": 0}
+
+    def failing_write_to(view, header, buffers):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("boom mid-slot")
+        return real_write_to(view, header, buffers)
+
+    monkeypatch.setattr(serialization, "write_to", failing_write_to)
+    with pytest.raises(RuntimeError, match="boom mid-slot"):
+        ch.write("doomed")
+    # Pre-acquire failures (plain unpicklable value) must not wedge
+    # either — serialize() raises before the slot is touched.
+    class Bomb:
+        def __reduce__(self):
+            raise RuntimeError("boom early")
+
+    with pytest.raises(Exception, match="boom early"):
+        ch.write(Bomb())
+    ch.write("after")  # would raise ChannelTimeout if the slot leaked
+    assert rd.read() == "after"
+
+
 def test_channel_cross_process(cluster):
     """A channel pickled to an actor moves data without the object
     store per message."""
