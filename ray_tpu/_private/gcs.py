@@ -60,7 +60,8 @@ class ObjectEntry:
     __slots__ = (
         "object_id", "state", "offset", "size", "inline", "spill_path",
         "refcount", "read_pins", "task_pins", "lru", "is_error", "owner_id",
-        "created_at", "location", "remote_offset",
+        "created_at", "location", "remote_offset", "borrowers",
+        "container_pins", "contained",
     )
 
     def __init__(self, object_id: str, owner_id: str):
@@ -77,6 +78,16 @@ class ObjectEntry:
         self.is_error = False
         self.owner_id = owner_id
         self.created_at = time.time()
+        # Borrow protocol (reference: reference_count.h:72): client ids
+        # holding a live deserialized copy of this ref. The entry cannot
+        # be freed while any borrower lives; a borrower's death or
+        # del_borrow removes it.
+        self.borrowers: set[str] = set()
+        # Containment: count of SEALED objects whose payload embeds this
+        # ref (each pins this entry until that container is freed), and
+        # the ids this entry's own payload embeds.
+        self.container_pins = 0
+        self.contained: tuple = ()
         # P2P: node hosting the payload in its agent store (the head
         # keeps only this directory entry; reference:
         # ownership_based_object_directory.h:39).
@@ -120,7 +131,7 @@ class WorkerRecord:
 class ActorRecord:
     __slots__ = (
         "spec", "state", "worker_id", "node_id", "restarts", "pending",
-        "death_cause", "created_at",
+        "death_cause", "created_at", "arg_pins_held",
     )
 
     def __init__(self, spec: ActorSpec):
@@ -132,6 +143,10 @@ class ActorRecord:
         self.pending: deque[TaskSpec] = deque()
         self.death_cause = ""
         self.created_at = time.time()
+        # Init-arg objects stay pinned for the actor's restartable
+        # lifetime (restarts replay the creation args); released once at
+        # the permanent-DEAD transition.
+        self.arg_pins_held = False
 
 
 class PlacementGroupRecord:
@@ -247,17 +262,29 @@ class Head:
         # first reconnecting client.
         self._snapshot_path = config.gcs_snapshot_path or None
         self._snapshot_dirty = False
-        if self._snapshot_path and os.path.exists(self._snapshot_path):
+        self._wal = None
+        if self._snapshot_path:
             from ray_tpu._private import gcs_persistence
 
-            payload = gcs_persistence.load_snapshot(self._snapshot_path)
+            payload = None
+            if os.path.exists(self._snapshot_path):
+                payload = gcs_persistence.load_snapshot(self._snapshot_path)
+            from_seg = payload.get("wal_seg", 0) if payload else 0
+            ops, last_seg = gcs_persistence.WriteAheadLog.read_ops(
+                self._snapshot_path, from_seg)
+            if payload is None and ops:
+                payload = gcs_persistence.empty_payload()
             if payload is not None:
+                if ops:
+                    gcs_persistence.apply_ops(payload, ops)
                 stats = gcs_persistence.restore_into(self, payload)
-                print(f"ray_tpu head: restored snapshot "
+                print(f"ray_tpu head: restored snapshot+wal "
                       f"({stats['actors_restored']} actors to restart, "
-                      f"{stats['kv_keys']} KV keys, {stats['pgs']} PGs)",
+                      f"{stats['kv_keys']} KV keys, {stats['pgs']} PGs, "
+                      f"{len(ops)} WAL ops)",
                       file=sys.stderr)
-        if self._snapshot_path:
+            self._wal = gcs_persistence.WriteAheadLog(
+                self._snapshot_path, last_seg)
             threading.Thread(target=self._snapshot_loop, daemon=True,
                              name="gcs-snapshot").start()
 
@@ -344,6 +371,17 @@ class Head:
         persistence is disabled)."""
         self._snapshot_dirty = True
 
+    def _wal_append(self, op: tuple) -> None:
+        """lock held. Append one durable op (reference: the Redis store
+        client persisting each table mutation, redis_store_client.h:111).
+        Ops since the last snapshot replay on restart, so a kill -9
+        between snapshots loses nothing."""
+        if self._wal is not None:
+            try:
+                self._wal.append(op)
+            except Exception:
+                traceback.print_exc()
+
     def _snapshot_loop(self) -> None:
         while not self._shutdown:
             time.sleep(self.config.gcs_snapshot_interval_s)
@@ -356,9 +394,17 @@ class Head:
         try:
             with self.lock:
                 self._snapshot_dirty = False
+                # Rotate FIRST: ops after this instant land in the new
+                # segment, which the snapshot names — replay over it
+                # reconstructs exactly the post-snapshot mutations.
+                new_seg = self._wal.rotate() if self._wal else 0
                 payload = gcs_persistence.build_payload(self)
+                payload["wal_seg"] = new_seg
             # Pickle + fsync outside the lock: RPC handlers keep running.
             gcs_persistence.write_blob(payload, self._snapshot_path)
+            if self._wal is not None:
+                # Snapshot durably subsumes the older segments.
+                self._wal.prune_below(new_seg)
         except Exception:
             traceback.print_exc()
 
@@ -462,6 +508,26 @@ class Head:
         with self.lock:
             self.clients.pop(client_id, None)
             rec = self.workers.get(client_id)
+            # Borrower death releases its borrows (reference:
+            # reference_count.h WaitForRefRemoved resolves when the
+            # borrower dies), and the owner's registration count dies
+            # with the owner (its del_ref may never arrive). Payloads
+            # live in head/agent arenas, so objects survive their
+            # owner's death for remaining borrowers/pins and free when
+            # the last of those drops.
+            affected = []
+            for e in self.objects.values():
+                changed = False
+                if client_id in e.borrowers:
+                    e.borrowers.discard(client_id)
+                    changed = True
+                if e.owner_id == client_id and e.refcount > 0:
+                    e.refcount -= 1
+                    changed = True
+                if changed:
+                    affected.append(e)
+            for e in affected:
+                self._maybe_free(e)
         if rec is not None:
             self._handle_worker_death(rec)
 
@@ -667,6 +733,7 @@ class Head:
                 raise rpc.RpcError(f"seal of unknown object {body['object_id']}")
             entry.state = SEALED
             entry.is_error = body.get("is_error", False)
+            self._register_contained(entry, body.get("contained_ids"))
             self._lru_tick += 1
             entry.lru = self._lru_tick
             self._on_sealed(entry.object_id)
@@ -691,6 +758,7 @@ class Head:
             entry.is_error = body.get("is_error", False)
             if entry.refcount == 0:
                 entry.refcount = 1
+            self._register_contained(entry, body.get("contained_ids"))
             self._lru_tick += 1
             entry.lru = self._lru_tick
             self.objects[object_id] = entry
@@ -708,6 +776,7 @@ class Head:
             entry.is_error = body.get("is_error", False)
             if entry.refcount == 0:
                 entry.refcount = 1
+            self._register_contained(entry, body.get("contained_ids"))
             self._lru_tick += 1
             entry.lru = self._lru_tick
             self.objects[object_id] = entry
@@ -865,6 +934,59 @@ class Head:
                     e.refcount += 1
         return None
 
+    def _h_add_borrow(self, body: dict, conn):
+        """A client deserialized a copy of these refs (reference:
+        reference_count.h:72 borrower registration). Arrives on the
+        client's ordered connection before whatever releases the
+        in-flight pin that covered the deserialization."""
+        client_id = conn.peer_info.get("client_id")
+        if not client_id:
+            return None
+        with self.lock:
+            for oid in body["ids"]:
+                e = self.objects.get(oid)
+                if e is not None:
+                    e.borrowers.add(client_id)
+        return None
+
+    def _h_del_borrow(self, body: dict, conn):
+        client_id = conn.peer_info.get("client_id")
+        if not client_id:
+            return None
+        with self.lock:
+            for oid in body["ids"]:
+                e = self.objects.get(oid)
+                if e is not None:
+                    e.borrowers.discard(client_id)
+                    self._maybe_free(e)
+        return None
+
+    def _release_container_pins(self, ids) -> None:
+        """lock held. Drop one containment pin per id and re-check
+        freeability — the single release path symmetric with
+        _register_contained (may cascade through nested containers)."""
+        for cid in ids:
+            ce = self.objects.get(cid)
+            if ce is not None and ce.container_pins > 0:
+                ce.container_pins -= 1
+                self._maybe_free(ce)
+
+    def _register_contained(self, entry: ObjectEntry, contained_ids) -> None:
+        """lock held. Pin every object embedded in this sealed payload
+        until the container itself is freed. A re-seal (task retry /
+        lineage re-execution) may embed a DIFFERENT set of fresh nested
+        puts: release the old pins and register the new so pins stay
+        symmetric with the release in _maybe_free."""
+        new = tuple(contained_ids or ())
+        if new == entry.contained:
+            return
+        old, entry.contained = entry.contained, new
+        self._release_container_pins(old)
+        for cid in new:
+            ce = self.objects.get(cid)
+            if ce is not None:
+                ce.container_pins += 1
+
     def _h_free_objects(self, body: dict, conn):
         with self.lock:
             for oid in body["ids"]:
@@ -875,9 +997,19 @@ class Head:
         return {}
 
     def _maybe_free(self, entry: ObjectEntry, force: bool = False) -> None:
+        if self.objects.get(entry.object_id) is not entry:
+            # Already freed (or superseded): callers may hold stale
+            # entries gathered before a cascading containment free —
+            # a second pass must not double-free the arena region.
+            return
         if entry.refcount > 0 and not force:
             return
         if entry.task_pins > 0 and not force:
+            return
+        if (entry.borrowers or entry.container_pins > 0) and not force:
+            # A process still holds a deserialized copy, or a sealed
+            # object embeds this ref: the borrow protocol keeps it alive
+            # (reference: reference_count.h:72).
             return
         if entry.read_pins > 0:
             # A client still holds a shm meta for this object; freeing now
@@ -897,6 +1029,11 @@ class Head:
                 except rpc.ConnectionLost:
                     pass
         self.objects.pop(entry.object_id, None)
+        # The container is gone: release its containment pins so the
+        # embedded objects can free (possibly cascading through nested
+        # containers).
+        contained, entry.contained = entry.contained, ()
+        self._release_container_pins(contained)
 
     # --- KV store (reference: GCS InternalKV, gcs_service.proto) ---
 
@@ -906,6 +1043,7 @@ class Head:
             if not body.get("overwrite", True) and key in self.kv:
                 return {"added": False}
             self.kv[key] = body["value"]
+            self._wal_append(("kv_put", key[0], key[1], body["value"]))
             self._mark_dirty()
         return {"added": True}
 
@@ -917,6 +1055,7 @@ class Head:
         with self.lock:
             existed = self.kv.pop((body.get("ns", ""), body["key"]), None) is not None
             if existed:
+                self._wal_append(("kv_del", body.get("ns", ""), body["key"]))
                 self._mark_dirty()
         return {"deleted": existed}
 
@@ -948,6 +1087,14 @@ class Head:
 
     # --- task submission ---
 
+    @staticmethod
+    def _pinned_ids(spec) -> list:
+        """Ids a task's flight pins: scheduling deps (top-level args)
+        plus refs nested inside arg containers (disjoint by construction
+        — pack_args dedups). Pin and release MUST both use this list."""
+        return list(spec.deps) + list(getattr(spec, "borrowed_ids", None)
+                                      or ())
+
     def _h_submit_task(self, body, conn):
         spec: TaskSpec = body["spec"]
         with self.lock:
@@ -955,7 +1102,7 @@ class Head:
                 entry = self.objects.get(oid) or ObjectEntry(oid, spec.owner_id)
                 entry.refcount = max(entry.refcount, 1)
                 self.objects[oid] = entry
-            for dep in spec.deps:
+            for dep in self._pinned_ids(spec):
                 e = self.objects.get(dep)
                 if e is not None:
                     e.task_pins += 1
@@ -1011,6 +1158,11 @@ class Head:
             e.inline = None
             if e.refcount == 0:
                 e.refcount = 1
+            # The re-executed task will re-seal with ITS OWN nested puts
+            # (fresh random ids): release the stale containment pins and
+            # clear the set so the new seal registers the new children.
+            contained, e.contained = e.contained, ()
+            self._release_container_pins(contained)
             self.objects[rid] = e
         # Validate/recover ALL deps before pinning ANY: a failure must not
         # touch pins that belong to other in-flight consumers of the deps.
@@ -1029,7 +1181,7 @@ class Head:
                 for rid in spec.return_ids:
                     self._seal_error(rid, msg, kind="object_lost")
                 return True  # error is sealed; getters unblock with it
-        for dep in spec.deps:
+        for dep in self._pinned_ids(spec):
             e = self.objects.get(dep)
             if e is not None:
                 e.task_pins += 1
@@ -1074,11 +1226,15 @@ class Head:
                     t["state"] = FAILED if body.get("failed") else FINISHED
                     t["finished_at"] = time.time()
                     self.finished_tasks.append(spec.task_id)
-                for dep in spec.deps:
-                    e = self.objects.get(dep)
-                    if e is not None and e.task_pins > 0:
-                        e.task_pins -= 1
-                        self._maybe_free(e)
+                if not spec.actor_creation:
+                    # Creation-arg pins are held for the actor's
+                    # restartable lifetime, released once at permanent
+                    # DEAD (_release_actor_arg_pins) — not per attempt.
+                    for dep in self._pinned_ids(spec):
+                        e = self.objects.get(dep)
+                        if e is not None and e.task_pins > 0:
+                            e.task_pins -= 1
+                            self._maybe_free(e)
             if rec.actor_id is None:
                 if not rec.inflight:
                     rec.busy = False
@@ -1089,7 +1245,9 @@ class Head:
                     actor.state = "ALIVE" if not body.get("failed") else "DEAD"
                     self._mark_dirty()
                     if actor.state == "DEAD":
+                        self._wal_append(("actor_dead", rec.actor_id))
                         actor.death_cause = "creation task failed"
+                        self._release_actor_arg_pins(actor)
                         self._drain_actor_queue(actor)
                         if actor.spec.name:
                             self.named_actors.pop(
@@ -1113,6 +1271,19 @@ class Head:
 
     # --- actors ---
 
+    def _release_actor_arg_pins(self, actor: ActorRecord) -> None:
+        """lock held. Drop the creation-arg pins exactly once, at the
+        actor's permanent-DEAD transition (restarts replay the creation
+        args, so per-attempt release would free them too early)."""
+        if not actor.arg_pins_held:
+            return
+        actor.arg_pins_held = False
+        for dep in self._pinned_ids(actor.spec):
+            e = self.objects.get(dep)
+            if e is not None and e.task_pins > 0:
+                e.task_pins -= 1
+                self._maybe_free(e)
+
     def _h_create_actor(self, body, conn):
         spec: ActorSpec = body["spec"]
         with self.lock:
@@ -1121,7 +1292,17 @@ class Head:
                 if key in self.named_actors:
                     raise rpc.RpcError(f"actor name {spec.name!r} already taken")
                 self.named_actors[key] = spec.actor_id
-            self.actors[spec.actor_id] = ActorRecord(spec)
+            rec = ActorRecord(spec)
+            # Pin init-arg objects (top-level AND nested) for the
+            # actor's restartable lifetime; the submitter may drop its
+            # refs right after this call returns.
+            for dep in self._pinned_ids(spec):
+                e = self.objects.get(dep)
+                if e is not None:
+                    e.task_pins += 1
+            rec.arg_pins_held = True
+            self.actors[spec.actor_id] = rec
+            self._wal_append(("actor_create", spec))
             self._mark_dirty()
         self.dispatch_event.set()
         return {"actor_id": spec.actor_id}
@@ -1133,7 +1314,7 @@ class Head:
                 entry = self.objects.get(oid) or ObjectEntry(oid, spec.owner_id)
                 entry.refcount = max(entry.refcount, 1)
                 self.objects[oid] = entry
-            for dep in spec.deps:
+            for dep in self._pinned_ids(spec):
                 e = self.objects.get(dep)
                 if e is not None:
                     e.task_pins += 1
@@ -1189,6 +1370,13 @@ class Head:
                 return {}
             if body.get("no_restart", True):
                 actor.spec.max_restarts = 0
+                # Durable: a head crash between this kill and the
+                # worker-death processing must not resurrect the actor
+                # from the WAL's actor_create (whose pickled spec still
+                # has the original budget).
+                self._wal_append(("actor_max_restarts",
+                                  body["actor_id"], 0))
+                self._mark_dirty()
             rec = self.workers.get(actor.worker_id) if actor.worker_id else None
         if rec is not None and rec.proc is not None:
             rec.proc.kill()
@@ -1203,7 +1391,9 @@ class Head:
             with self.lock:
                 actor.state = "DEAD"
                 actor.death_cause = "killed before start"
+                self._release_actor_arg_pins(actor)
                 self._drain_actor_queue(actor)
+                self._wal_append(("actor_dead", body["actor_id"]))
                 self._mark_dirty()
         return {}
 
@@ -1235,6 +1425,8 @@ class Head:
         rec = PlacementGroupRecord(pg_id, body.get("name", ""), body["bundles"], body["strategy"])
         with self.lock:
             self.pgs[pg_id] = rec
+            self._wal_append(("pg_create", pg_id, rec.name, rec.bundles,
+                              rec.strategy))
             self._mark_dirty()
             # `ready()` object: sealed once the gang reservation commits.
             entry = ObjectEntry(pg_id + ":ready", "head")
@@ -1279,6 +1471,7 @@ class Head:
         with self.lock:
             rec = self.pgs.pop(body["pg_id"], None)
             if rec is not None:
+                self._wal_append(("pg_remove", body["pg_id"]))
                 self._mark_dirty()
             if rec is not None and rec.state == "CREATED":
                 for node_id, bundle in zip(rec.node_per_bundle, rec.bundles):
@@ -1430,6 +1623,9 @@ class Head:
                         "size": e.size,
                         "refcount": e.refcount,
                         "owner": e.owner_id,
+                        "borrowers": sorted(e.borrowers),
+                        "container_pins": e.container_pins,
+                        "task_pins": e.task_pins,
                     }
                     for e in self.objects.values()
                 ]
@@ -1721,6 +1917,7 @@ class Head:
             func_id=spec.cls_func_id,
             args=spec.init_args,
             deps=spec.deps,
+            borrowed_ids=list(getattr(spec, "borrowed_ids", None) or ()),
             return_ids=[spec.actor_id + ":creation"],
             resources=spec.resources,
             owner_id=spec.owner_id,
@@ -1903,11 +2100,13 @@ class Head:
             actor.restarts += 1
             actor.state = "PENDING_CREATION"
             actor.worker_id = None
+            self._wal_append(("actor_restarts", rec.actor_id, actor.restarts))
             self._mark_dirty()
             # queued (not yet pushed) calls survive the restart
         else:
             actor.state = "DEAD"
             actor.death_cause = "worker process died"
+            self._release_actor_arg_pins(actor)
             if creation_spec is not None:
                 self._seal_error(
                     rec.actor_id + ":creation",
@@ -1917,6 +2116,7 @@ class Head:
             self._drain_actor_queue(actor)
             if actor.spec.name:
                 self.named_actors.pop((actor.spec.namespace, actor.spec.name), None)
+            self._wal_append(("actor_dead", rec.actor_id))
             self._mark_dirty()
 
     def _fail_task(self, spec: TaskSpec, message: str, kind: str = "task_error") -> None:
@@ -1928,11 +2128,12 @@ class Head:
             t["finished_at"] = time.time()
         for oid in spec.return_ids:
             self._seal_error(oid, message, kind)
-        for dep in spec.deps:
-            e = self.objects.get(dep)
-            if e is not None and e.task_pins > 0:
-                e.task_pins -= 1
-                self._maybe_free(e)
+        if not spec.actor_creation:
+            for dep in self._pinned_ids(spec):
+                e = self.objects.get(dep)
+                if e is not None and e.task_pins > 0:
+                    e.task_pins -= 1
+                    self._maybe_free(e)
 
     def _seal_inline(self, object_id: str, value) -> None:
         """lock held. Seal a head-produced value (e.g. PG readiness)."""
@@ -1968,6 +2169,8 @@ class Head:
         self._shutdown = True
         if self._snapshot_path and self._snapshot_dirty:
             self._snapshot_now()
+        if self._wal is not None:
+            self._wal.close()
         if self.memory_monitor is not None:
             self.memory_monitor.stop()
         with self.lock:

@@ -1,12 +1,16 @@
-"""Head state snapshot/restore (GCS fault tolerance).
+"""Head state snapshot/restore + write-ahead log (GCS fault tolerance).
 
 Counterpart of the reference's persistent GCS storage + restart recovery
 (reference: gcs/store_client/redis_store_client.h:111 — Redis-backed
 head tables; gcs/gcs_server/gcs_init_data.h — bulk-loading all tables on
-GCS restart; gcs_redis_failure_detector.h). Design difference: a single
-periodic snapshot FILE (atomic replace) instead of an external Redis —
-the head is the only writer, so a write-behind snapshot of its in-memory
-tables gives the same restart story without a second service.
+GCS restart; gcs_redis_failure_detector.h). Design difference: instead
+of an external Redis, a periodic snapshot FILE (atomic replace) plus an
+append-only WAL of every durable-table mutation — the reference's Redis
+writes each mutation as it happens; here each mutation appends one
+framed op, so state created AFTER the last snapshot survives a kill -9.
+Restart = load snapshot, replay WAL segments newer than it, then the
+normal bulk restore. Snapshots compact the log: each snapshot rotates to
+a fresh segment and prunes the ones it subsumes.
 
 What persists: the KV store (which also carries serialized functions and
 actor class blobs, so restarts can respawn actors), actor specs and
@@ -31,15 +35,24 @@ if TYPE_CHECKING:
 FORMAT_VERSION = 1
 
 
+def _frozen(obj: Any) -> Any:
+    """Pickle-roundtrip copy: the payload must not alias live mutable
+    head records (ActorSpec, pg.bundles), because the big pickle in
+    write_blob runs OUTSIDE head.lock and a concurrent mutation (e.g.
+    _h_kill_actor flipping spec.max_restarts) would tear the snapshot."""
+    return pickle.loads(pickle.dumps(obj, protocol=5))
+
+
 def build_payload(head: "Head") -> dict:
     """Serialize the durable tables into a picklable payload. Caller
     holds head.lock — keep this cheap; the disk write happens outside
-    the lock (write_blob)."""
+    the lock (write_blob). Mutable records are copied here, under the
+    lock; immutable values (KV bytes, id strings) are shared."""
     actors = []
     for actor_id, rec in head.actors.items():
         actors.append({
             "actor_id": actor_id,
-            "spec": rec.spec,
+            "spec": _frozen(rec.spec),
             "state": rec.state,
             "restarts": rec.restarts,
         })
@@ -48,7 +61,7 @@ def build_payload(head: "Head") -> dict:
         pgs.append({
             "pg_id": pg_id,
             "name": pg.name,
-            "bundles": pg.bundles,
+            "bundles": _frozen(pg.bundles),
             "strategy": pg.strategy,
         })
     return {
@@ -83,6 +96,166 @@ def write_blob(payload: dict, path: str) -> None:
         except OSError:
             pass
         raise
+
+
+class WriteAheadLog:
+    """Append-only framed op log: ``<u32 len><u32 crc32><pickle(op)>``.
+
+    Segment files live beside the snapshot (``{path}.wal.{seg}``). Each
+    append is written + flushed, so ops survive a head kill -9 (page
+    cache persists across process death; full-host durability would add
+    fsync, deliberately not paid per-op). A torn final frame — the crash
+    landed mid-append — is detected by length/CRC and dropped."""
+
+    def __init__(self, base_path: str, seg: int = 0):
+        self.base = base_path
+        self.seg = seg
+        self._f = None
+        self._open()
+
+    def _seg_path(self, seg: int) -> str:
+        return f"{self.base}.wal.{seg}"
+
+    def _open(self) -> None:
+        d = os.path.dirname(os.path.abspath(self.base)) or "."
+        os.makedirs(d, exist_ok=True)
+        self._f = open(self._seg_path(self.seg), "ab")
+
+    def append(self, op: tuple) -> None:
+        import struct
+        import zlib
+
+        blob = pickle.dumps(op, protocol=5)
+        self._f.write(struct.pack("<II", len(blob), zlib.crc32(blob)))
+        self._f.write(blob)
+        self._f.flush()
+
+    def rotate(self) -> int:
+        """Start a new segment; returns ITS number (ops appended from
+        now land there — a snapshot built at this instant records it)."""
+        self._f.close()
+        self.seg += 1
+        self._open()
+        return self.seg
+
+    def prune_below(self, seg: int) -> None:
+        """Delete segments subsumed by a successfully written snapshot."""
+        s = seg - 1
+        while s >= 0 and os.path.exists(self._seg_path(s)):
+            try:
+                os.unlink(self._seg_path(s))
+            except OSError:
+                break
+            s -= 1
+
+    def close(self) -> None:
+        try:
+            self._f.close()
+        except Exception:
+            pass
+
+    @staticmethod
+    def existing_segments(base_path: str) -> "list[int]":
+        """Sorted segment numbers present on disk."""
+        import glob
+        import re
+
+        segs = []
+        for p in glob.glob(glob.escape(base_path) + ".wal.*"):
+            m = re.search(r"\.wal\.(\d+)$", p)
+            if m:
+                segs.append(int(m.group(1)))
+        return sorted(segs)
+
+    @staticmethod
+    def read_ops(base_path: str, from_seg: int) -> "tuple[list, int]":
+        """All ops of on-disk segments >= from_seg in order, and the
+        highest segment number present on disk at all (the restarted
+        head appends after it — never below, or stale higher-numbered
+        segments would later be reopened and their ancient ops replayed
+        over newer state). Discovery is by directory listing, not by
+        counting up from from_seg: if the snapshot is unreadable
+        (from_seg falls back to 0) the pre-compaction segments are gone,
+        and a contiguous walk from 0 would silently find nothing."""
+        import struct
+        import zlib
+
+        segs = WriteAheadLog.existing_segments(base_path)
+        last_seg = max(segs, default=from_seg)
+        ops: list = []
+        for seg in segs:
+            if seg < from_seg:
+                continue
+            with open(f"{base_path}.wal.{seg}", "rb") as f:
+                data = f.read()
+            pos = 0
+            while pos + 8 <= len(data):
+                ln, crc = struct.unpack_from("<II", data, pos)
+                frame = data[pos + 8: pos + 8 + ln]
+                if len(frame) < ln or zlib.crc32(frame) != crc:
+                    break  # torn tail: crash mid-append
+                try:
+                    ops.append(pickle.loads(frame))
+                except Exception:
+                    break
+                pos += 8 + ln
+        return ops, last_seg
+
+
+def empty_payload() -> dict:
+    """Skeleton payload for WAL-only recovery (head died before the
+    first snapshot was ever written)."""
+    return {"version": FORMAT_VERSION, "written_at": 0.0,
+            "session_id": None, "node_id": None, "kv": {}, "actors": [],
+            "named_actors": {}, "pgs": []}
+
+
+def apply_ops(payload: dict, ops: list) -> dict:
+    """Replay WAL ops INTO the snapshot payload (mutating it), so the
+    single restore_into path below applies the combined state with its
+    usual semantics (restart budgets, named-actor filtering, PG
+    re-placement)."""
+    actors = {e["actor_id"]: e for e in payload.get("actors", [])}
+    pgs = {e["pg_id"]: e for e in payload.get("pgs", [])}
+    for op in ops:
+        kind = op[0]
+        if kind == "kv_put":
+            payload["kv"][(op[1], op[2])] = op[3]
+        elif kind == "kv_del":
+            payload["kv"].pop((op[1], op[2]), None)
+        elif kind == "actor_create":
+            spec = op[1]
+            actors[spec.actor_id] = {
+                "actor_id": spec.actor_id, "spec": spec,
+                "state": "PENDING_CREATION", "restarts": 0,
+            }
+            if spec.name:
+                payload["named_actors"][(spec.namespace, spec.name)] = (
+                    spec.actor_id)
+        elif kind == "actor_dead":
+            e = actors.get(op[1])
+            if e is not None:
+                e["state"] = "DEAD"
+                spec = e["spec"]
+                if spec.name:
+                    payload["named_actors"].pop(
+                        (spec.namespace, spec.name), None)
+        elif kind == "actor_restarts":
+            e = actors.get(op[1])
+            if e is not None:
+                e["restarts"] = op[2]
+        elif kind == "actor_max_restarts":
+            e = actors.get(op[1])
+            if e is not None:
+                e["spec"].max_restarts = op[2]
+        elif kind == "pg_create":
+            pgs[op[1]] = {"pg_id": op[1], "name": op[2], "bundles": op[3],
+                          "strategy": op[4]}
+        elif kind == "pg_remove":
+            pgs.pop(op[1], None)
+    payload["actors"] = list(actors.values())
+    payload["pgs"] = list(pgs.values())
+    return payload
 
 
 def load_snapshot(path: str) -> "dict | None":

@@ -5,9 +5,15 @@ python/ray/includes/unique_ids.pxi). 16 random bytes, hex-rendered.
 
 ObjectRef carries an `owned` bit: the process that created the ref (the
 owner, reference: src/ray/core_worker/reference_count.h:72) decrements the
-owner refcount on GC; deserialized copies are borrows and do not. Borrowed
-refs are kept alive while in-flight tasks hold them via head-side arg pinning
-(see gcs.py ObjectDirectory.pin_for_task).
+owner refcount on GC. Deserialized copies are BORROWS (reference:
+reference_count.h borrower bookkeeping): deserialization registers the
+borrow with this process's runtime (which tells the head directory), and
+the borrowed ref's GC releases it. In-flight windows — args en route to a
+worker, payloads being read — are covered by head-side task/read pinning;
+at-rest containment (a ref serialized inside a stored object) is covered
+by the directory's container pins. Together: an object lives while any
+process holds a deserialized ref, any sealed object embeds it, or any
+in-flight task references it.
 """
 
 from __future__ import annotations
@@ -63,6 +69,8 @@ class PlacementGroupID(BaseID):
 # Registered at runtime by the worker/driver core so ObjectRef GC can notify
 # the owner directory without an import cycle.
 _ref_removed_callback: Callable[[str], None] | None = None
+_borrow_added_callback: Callable[[str], None] | None = None
+_borrow_removed_callback: Callable[[str], None] | None = None
 _ref_lock = threading.Lock()
 
 
@@ -72,6 +80,36 @@ def set_ref_removed_callback(cb: Callable[[str], None] | None) -> None:
         _ref_removed_callback = cb
 
 
+def set_borrow_callbacks(added: Callable[[str], None] | None,
+                         removed: Callable[[str], None] | None) -> None:
+    """Installed by CoreRuntime: `added` fires when a ref is deserialized
+    in this process (a borrow begins), `removed` when a borrowed ref is
+    GC'd (the borrow ends). Reference: reference_count.h:72 borrower
+    registration / WaitForRefRemoved."""
+    global _borrow_added_callback, _borrow_removed_callback
+    with _ref_lock:
+        _borrow_added_callback = added
+        _borrow_removed_callback = removed
+
+
+def _restore_ref(hex_str: str) -> "ObjectRef":
+    """Unpickle target for ObjectRef: the deserialized copy is a borrow,
+    registered with the local runtime so the head keeps the object alive
+    until this process drops it (or dies)."""
+    with _ref_lock:
+        cb = _borrow_added_callback
+    if cb is None:
+        # No runtime in this process (head unpickling specs, plain
+        # tooling): an inert ref with no lifetime participation.
+        return ObjectRef(hex_str)
+    ref = ObjectRef(hex_str, _borrowed=True)
+    try:
+        cb(hex_str)
+    except Exception:
+        ref._borrowed = False  # never release a borrow that never registered
+    return ref
+
+
 class ObjectRef:
     """Future-like handle to an object in the cluster.
 
@@ -79,11 +117,13 @@ class ObjectRef:
     semantics from src/ray/core_worker/reference_count.h.
     """
 
-    __slots__ = ("_hex", "_owned", "__weakref__")
+    __slots__ = ("_hex", "_owned", "_borrowed", "__weakref__")
 
-    def __init__(self, hex_str: str | None = None, *, _owned: bool = False):
+    def __init__(self, hex_str: str | None = None, *, _owned: bool = False,
+                 _borrowed: bool = False):
         self._hex = hex_str or _hex_id()
         self._owned = _owned
+        self._borrowed = _borrowed
 
     def hex(self) -> str:
         return self._hex
@@ -101,20 +141,26 @@ class ObjectRef:
         return f"ObjectRef({self._hex[:12]})"
 
     def __reduce__(self):
-        # Deserialized copies are borrows: they never decrement the owner
-        # count (the borrow is covered by task-arg pinning at the directory).
-        return (ObjectRef, (self._hex,))
+        # Deserialized copies are borrows: the restore hook registers
+        # them with the receiving process's runtime, which keeps the
+        # owner count from releasing the object while they live.
+        return (_restore_ref, (self._hex,))
 
     def __del__(self):
-        if self._owned:
-            try:
+        try:
+            if self._owned:
                 with _ref_lock:
                     cb = _ref_removed_callback
                 if cb is not None:
                     cb(self._hex)
-            except Exception:
-                # Interpreter teardown: module globals may already be None.
-                pass
+            elif self._borrowed:
+                with _ref_lock:
+                    cb = _borrow_removed_callback
+                if cb is not None:
+                    cb(self._hex)
+        except Exception:
+            # Interpreter teardown: module globals may already be None.
+            pass
 
     # Allow `ray_tpu.get(ref)` ergonomics in asyncio contexts later.
     def future(self):

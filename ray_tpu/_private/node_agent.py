@@ -262,6 +262,15 @@ class NodeAgent:
             with self._store_lock:
                 self.store.free(body["offset"])
             return {}
+        if kind == "abort_sealed":
+            # Writer-side rollback: seal_local succeeded but the head
+            # directory registration failed — without this the sealed
+            # bytes have no directory entry and nothing ever frees them.
+            with self._store_lock:
+                loc = self.local_objects.pop(body["object_id"], None)
+                if loc is not None:
+                    self.store.free(loc[0])
+            return {}
         raise rpc.RpcError(f"unknown transfer op {kind!r}")
 
     def _spawn(self, body: dict) -> None:

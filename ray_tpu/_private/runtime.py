@@ -109,7 +109,31 @@ class CoreRuntime:
                 self.agent_addr = None
         self._fn_cache: dict[str, Any] = {}
         self._fn_ids: dict = {}  # id(fn) -> (weakref(fn), func_id)
+        # Local borrow counts per object id (reference:
+        # reference_count.h:72 borrower bookkeeping). The head learns
+        # only the 0<->1 transitions; repeat deserializations of the
+        # same id in this process stay local.
+        #
+        # GC discipline: ref releases arrive from __del__, which CPython
+        # may run inside ANY allocation — including while this very
+        # thread holds _borrows_lock or the connection's send lock. So
+        # the __del__ paths only append to a lock-free deque (atomic,
+        # never blocks); a flusher thread drains it, updates counts, and
+        # casts batched del_ref/del_borrow. Borrow ADDS stay synchronous
+        # (they are called from unpickling, never from __del__) because
+        # their ordering against the covering pin's release matters.
+        self._borrows: dict[str, int] = {}
+        self._borrows_lock = threading.Lock()
+        import collections as _collections
+
+        self._release_queue: "_collections.deque[tuple[str, str]]" = (
+            _collections.deque())
         ids_mod.set_ref_removed_callback(self._on_ref_removed)
+        ids_mod.set_borrow_callbacks(self._on_borrow_added,
+                                     self._on_borrow_removed)
+        self._release_thread = threading.Thread(
+            target=self._release_loop, daemon=True, name="ref-release")
+        self._release_thread.start()
 
     # ------------------------------------------------------------------
     # inbound messages
@@ -220,12 +244,78 @@ class CoreRuntime:
         return waiter_id, fut
 
     def _on_ref_removed(self, hex_id: str) -> None:
-        if self._closed or self.conn.closed:
+        """__del__ path: enqueue only (see the GC discipline note)."""
+        if self._closed:
             return
-        try:
-            self.conn.cast("del_ref", {"ids": [hex_id]})
-        except rpc.ConnectionLost:
-            pass
+        self._release_queue.append(("owned", hex_id))
+
+    def _on_borrow_added(self, hex_id: str) -> None:
+        """A ref was deserialized in this process. Registration reaches
+        the head on this connection BEFORE the task-done/read-done that
+        releases the in-flight pin covering the deserialization (same
+        ordered connection), so there is no free window. The cast stays
+        under _borrows_lock so the flusher's del_borrow for the same id
+        cannot misorder against it."""
+        if self._closed:
+            return
+        with self._borrows_lock:
+            n = self._borrows.get(hex_id, 0)
+            self._borrows[hex_id] = n + 1
+            if n == 0:
+                try:
+                    self.conn.cast("add_borrow", {"ids": [hex_id]})
+                except rpc.ConnectionLost:
+                    pass
+
+    def _on_borrow_removed(self, hex_id: str) -> None:
+        """__del__ path: enqueue only (see the GC discipline note)."""
+        if self._closed:
+            return
+        self._release_queue.append(("borrow", hex_id))
+
+    def _drain_releases(self) -> None:
+        """Flusher body: batch queued releases into del_ref/del_borrow
+        casts. Count updates and their casts share one _borrows_lock
+        hold per batch, keeping per-id transition order consistent with
+        concurrent synchronous adds."""
+        while True:
+            owned: list[str] = []
+            borrows: list[str] = []
+            with self._borrows_lock:
+                for _ in range(256):
+                    try:
+                        kind, hex_id = self._release_queue.popleft()
+                    except IndexError:
+                        break
+                    if kind == "owned":
+                        owned.append(hex_id)
+                        continue
+                    n = self._borrows.get(hex_id, 0) - 1
+                    if n <= 0:
+                        self._borrows.pop(hex_id, None)
+                        borrows.append(hex_id)
+                    else:
+                        self._borrows[hex_id] = n
+                if (owned or borrows) and not self.conn.closed:
+                    try:
+                        if owned:
+                            self.conn.cast("del_ref", {"ids": owned})
+                        if borrows:
+                            self.conn.cast("del_borrow", {"ids": borrows})
+                    except rpc.ConnectionLost:
+                        pass
+            if not owned and not borrows:
+                return
+
+    def _release_loop(self) -> None:
+        import time as _time
+
+        while not self._closed:
+            try:
+                self._drain_releases()
+            except Exception:
+                pass
+            _time.sleep(0.05)
 
     # ------------------------------------------------------------------
     # objects
@@ -236,7 +326,7 @@ class CoreRuntime:
         return self._agent_conn
 
     def _put_p2p(self, object_id: str, header, buffers, size: int,
-                 is_error: bool) -> bool:
+                 is_error: bool, contained: "list[str] | None" = None) -> bool:
         """Store into this node's agent arena; register directory-only
         with the head. Returns False when the local store is full (the
         caller falls back to the inline path)."""
@@ -258,13 +348,40 @@ class CoreRuntime:
                 "object_id": object_id, "node_id": self.node_id,
                 "offset": offset, "size": size,
                 "owner_id": self.client_id, "is_error": is_error,
+                "contained_ids": contained or [],
             })
             return True
-        except BaseException:
+        except rpc.ConnectionLost:
+            # Ambiguous: the head may have APPLIED put_p2p before the
+            # connection dropped, in which case the directory routes
+            # readers here — freeing the sealed bytes would dangle that
+            # entry (or serve recycled memory). Leave them; the arena
+            # reclaims on agent restart.
             if not sealed:
-                # Pre-seal failure only: once sealed, the agent's object
-                # map owns the offset — freeing it here would recycle
-                # memory a directory-routed reader may still pull.
+                try:
+                    self._agent().call("abort_alloc", {"offset": offset})
+                except Exception:
+                    pass
+            raise
+        except rpc.RpcError:
+            # The head DEFINITIVELY rejected the registration (an error
+            # REPLY arrived): no directory entry exists, so no reader
+            # can be routed here — unseal and free, or the arena leaks
+            # the bytes until agent restart.
+            try:
+                if not sealed:
+                    self._agent().call("abort_alloc", {"offset": offset})
+                else:
+                    self._agent().call("abort_sealed",
+                                       {"object_id": object_id})
+            except Exception:
+                pass
+            raise
+        except BaseException:
+            # Anything else (KeyboardInterrupt mid-call, ...) is as
+            # ambiguous as a dropped connection: never free sealed bytes
+            # the directory might reference.
+            if not sealed:
                 try:
                     self._agent().call("abort_alloc", {"offset": offset})
                 except Exception:
@@ -293,11 +410,18 @@ class CoreRuntime:
 
     def put(self, value: Any, *, _object_id: str | None = None, _is_error: bool = False) -> ObjectRef:
         object_id = _object_id or os.urandom(16).hex()
-        header, buffers = serialization.serialize(value)
+        # Refs serialized INSIDE the value become containment pins at the
+        # directory: the stored object keeps its contained objects alive
+        # until it is itself freed (reference: reference_count.h nested
+        # refs "contained in owned object").
+        with serialization.collect_refs() as collected:
+            header, buffers = serialization.serialize(value)
+        contained = sorted(set(collected))
         size = serialization.serialized_size(header, buffers)
         if (self.shm is None and self.agent_shm is not None
                 and size > GLOBAL_CONFIG.max_inline_object_size):
-            if self._put_p2p(object_id, header, buffers, size, _is_error):
+            if self._put_p2p(object_id, header, buffers, size, _is_error,
+                             contained):
                 return ObjectRef(object_id, _owned=_object_id is None)
         if self.shm is None or size <= GLOBAL_CONFIG.max_inline_object_size:
             payload = bytearray(size)
@@ -309,6 +433,7 @@ class CoreRuntime:
                     "payload": bytes(payload),
                     "owner_id": self.client_id,
                     "is_error": _is_error,
+                    "contained_ids": contained,
                 },
             )
         else:
@@ -329,7 +454,9 @@ class CoreRuntime:
             view = self.shm.view(reply["offset"], size)
             serialization.write_to(view, header, buffers)
             view.release()
-            self.conn.call("seal_object", {"object_id": object_id, "is_error": _is_error})
+            self.conn.call("seal_object",
+                           {"object_id": object_id, "is_error": _is_error,
+                            "contained_ids": contained})
         return ObjectRef(object_id, _owned=_object_id is None)
 
     def get(self, refs: ObjectRef | Sequence[ObjectRef], timeout: float | None = None) -> Any:
@@ -532,6 +659,30 @@ class CoreRuntime:
         not_ready = [by_id[i] for i in id_list if i not in ready_set]
         return ready, not_ready
 
+    def wait_async(self, refs: Sequence[ObjectRef],
+                   num_returns: int = 1) -> Future:
+        """Non-blocking wait: a concurrent Future resolving to the list
+        of ready ObjectRefs once >= num_returns are sealed (the head
+        pushes wait_ready — no polling, no thread parked per waiter).
+        Powers the async serve path."""
+        id_list = [r.hex() for r in refs]
+        by_id = {r.hex(): r for r in refs}
+        waiter_id, fut = self._new_waiter()
+        result: Future = Future()
+
+        def _done(f: Future):
+            try:
+                ready_set = set(f.result()["ready"])
+                result.set_result(
+                    [by_id[i] for i in id_list if i in ready_set])
+            except Exception as e:  # noqa: BLE001
+                result.set_exception(e)
+
+        fut.add_done_callback(_done)
+        self.conn.cast("wait", {"waiter_id": waiter_id, "ids": id_list,
+                                "num_returns": num_returns})
+        return result
+
     def free(self, refs: Sequence[ObjectRef], force: bool = False) -> None:
         self.conn.call("free_objects", {"ids": [r.hex() for r in refs], "force": force})
 
@@ -576,11 +727,21 @@ class CoreRuntime:
     # tasks / actors
 
     @staticmethod
-    def pack_args(args: tuple, kwargs: dict) -> tuple[bytes, list[str]]:
+    def pack_args(args: tuple,
+                  kwargs: dict) -> tuple[bytes, list[str], list[str]]:
+        """Returns (payload, deps, borrowed): deps are TOP-LEVEL refs
+        (resolved + awaited before dispatch, reference semantics);
+        borrowed are refs nested inside containers — passed as-is but
+        pinned for the task's flight (reference: reference_count.h
+        serialized-ref borrows)."""
         deps = [
-            a.hex() for a in list(args) + list(kwargs.values()) if isinstance(a, ObjectRef)
+            a.hex() for a in list(args) + list(kwargs.values())
+            if isinstance(a, ObjectRef)
         ]
-        return serialization.dumps_scoped((args, kwargs)), deps
+        with serialization.collect_refs() as collected:
+            packed = serialization.dumps_scoped((args, kwargs))
+        borrowed = sorted(set(collected) - set(deps))
+        return packed, deps, borrowed
 
     def submit_task(self, spec: TaskSpec) -> None:
         self.conn.cast("submit_task", {"spec": spec})
@@ -608,6 +769,7 @@ class CoreRuntime:
     def close(self) -> None:
         self._closed = True
         ids_mod.set_ref_removed_callback(None)
+        ids_mod.set_borrow_callbacks(None, None)
         self.conn.close()
         if self.shm is not None:
             self.shm.close()

@@ -15,15 +15,39 @@ alignment guarantees.
 
 from __future__ import annotations
 
+import contextlib
 import io
 import pickle
 import struct
+import threading
 from typing import Any
 
 import cloudpickle
 
+from ray_tpu._private.ids import ObjectRef
+
 MAGIC = 0x52545055  # 'RTPU'
 _ALIGN = 64
+
+# Per-thread ObjectRef collector: while active, every ObjectRef pickled
+# (at any nesting depth) is recorded. The runtime uses this for
+# containment pins (refs serialized into a stored object) and for
+# pinning refs nested inside task args (reference: reference_count.h
+# "contained in owned object" / serialized-ref tracking).
+_ref_collector = threading.local()
+
+
+@contextlib.contextmanager
+def collect_refs():
+    """Context manager yielding a list that accumulates the hex ids of
+    every ObjectRef serialized within (nested scopes stack)."""
+    prev = getattr(_ref_collector, "ids", None)
+    collected: list[str] = []
+    _ref_collector.ids = collected
+    try:
+        yield collected
+    finally:
+        _ref_collector.ids = prev
 
 
 def _pad(n: int) -> int:
@@ -70,6 +94,11 @@ class _RuntimePickler(cloudpickle.Pickler):
     priority, which is exactly the per-pickler scoping we need."""
 
     def reducer_override(self, obj):
+        if type(obj) is ObjectRef:
+            lst = getattr(_ref_collector, "ids", None)
+            if lst is not None:
+                lst.append(obj.hex())
+            return NotImplemented  # normal __reduce__ path
         reducer = custom_reducers.get(type(obj))
         if reducer is not None:
             return reducer(obj)
@@ -77,7 +106,7 @@ class _RuntimePickler(cloudpickle.Pickler):
 
 
 def _dump(obj: Any, protocol: int = 5, buffer_callback=None) -> bytes:
-    if not custom_reducers:
+    if not custom_reducers and getattr(_ref_collector, "ids", None) is None:
         return cloudpickle.dumps(obj, protocol=protocol,
                                  buffer_callback=buffer_callback)
     f = io.BytesIO()

@@ -37,6 +37,12 @@ class TaskSpec:
     # core_worker/transport/concurrency_group_manager.h:37). None =
     # method-level annotation or the default group.
     concurrency_group: str | None = None
+    # Object ids NESTED inside args (inside containers, not top-level).
+    # Not dependencies — they don't gate scheduling — but the head pins
+    # them for the task's flight so the submitter may drop its refs
+    # immediately after a fire-and-forget submit (reference:
+    # reference_count.h serialized-in-task-args borrows).
+    borrowed_ids: list = dataclasses.field(default_factory=list)
 
 
 @dataclasses.dataclass
@@ -59,3 +65,5 @@ class ActorSpec:
     # @ray.remote(concurrency_groups={...})). Applies to threaded AND
     # async actors; the default group runs at max_concurrency.
     concurrency_groups: dict | None = None
+    # Refs nested inside init_args (see TaskSpec.borrowed_ids).
+    borrowed_ids: list = dataclasses.field(default_factory=list)

@@ -80,7 +80,7 @@ class ActorHandle:
     def _submit_method(self, method: str, args, kwargs, num_returns,
                        concurrency_group: str | None = None):
         rt = global_runtime()
-        packed, deps = rt.pack_args(args, kwargs)
+        packed, deps, borrowed = rt.pack_args(args, kwargs)
         streaming = num_returns in ("streaming", "dynamic")
         if streaming:
             num_returns = 1
@@ -92,6 +92,7 @@ class ActorHandle:
             func_id="",  # resolved from the actor instance worker-side
             args=packed,
             deps=deps,
+            borrowed_ids=borrowed,
             return_ids=return_ids,
             resources={},
             owner_id=rt.client_id,
@@ -141,7 +142,7 @@ class ActorClass:
         rt = global_runtime()
         opts = self._opts
         cls_func_id = rt.register_function(self._cls)
-        packed, deps = rt.pack_args(args, kwargs)
+        packed, deps, borrowed = rt.pack_args(args, kwargs)
         actor_id = "actor-" + uuid.uuid4().hex[:12]
         # Actors hold 0 CPUs while idle by default (many actors per node),
         # mirroring the reference's default actor resource semantics.
@@ -152,6 +153,7 @@ class ActorClass:
             cls_func_id=cls_func_id,
             init_args=packed,
             deps=deps,
+            borrowed_ids=borrowed,
             resources=_normalize_resources(
                 opts.get("num_cpus"),
                 opts.get("num_tpus") or opts.get("num_gpus"),

@@ -104,7 +104,7 @@ class RemoteFunction:
         )
         num_returns = 1 if streaming else int(nr_opt)
         func_id = rt.register_function(self._fn)
-        packed, deps = rt.pack_args(args, kwargs)
+        packed, deps, borrowed = rt.pack_args(args, kwargs)
         return_ids = [os.urandom(16).hex() for _ in range(num_returns)]
         spec = TaskSpec(
             task_id="task-" + uuid.uuid4().hex[:12],
@@ -112,6 +112,7 @@ class RemoteFunction:
             func_id=func_id,
             args=packed,
             deps=deps,
+            borrowed_ids=borrowed,
             return_ids=return_ids,
             resources=_normalize_resources(
                 opts.get("num_cpus"),

@@ -29,9 +29,11 @@ def _free_port() -> int:
     return port
 
 
-def _start_head(port: int, snap: str) -> subprocess.Popen:
+def _start_head(port: int, snap: str, extra_env: dict | None = None
+                ) -> subprocess.Popen:
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(extra_env or {})
     proc = subprocess.Popen(
         [sys.executable, "-m", "ray_tpu.scripts", "start", "--head",
          "--port", str(port), "--num-cpus", "4",
@@ -129,6 +131,69 @@ def test_kill_head_restart_recovers(tmp_path):
         assert _wait_for(driver_ok, 60, "second driver reconnect")
         val = _wait_for(actor_back, 60, "second actor restart")
         assert val == 1
+    finally:
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+        if head.poll() is None:
+            head.kill()
+
+
+def test_wal_survives_kill_between_snapshots(tmp_path):
+    """State created AFTER the last snapshot survives a head kill -9:
+    the WAL (reference: redis_store_client.h:111 — per-mutation durable
+    writes) replays over the stale snapshot. The snapshot interval is
+    set to an hour so NOTHING here is ever snapshotted — recovery comes
+    from the op log alone."""
+    port = _free_port()
+    snap = str(tmp_path / "gcs.snap")
+    no_snap = {"RAY_TPU_GCS_SNAPSHOT_INTERVAL_S": "3600"}
+    head = _start_head(port, snap, no_snap)
+    try:
+        ray_tpu.init(address=f"127.0.0.1:{port}")
+
+        @ray_tpu.remote(max_restarts=2, name="wal-actor",
+                        lifetime="detached")
+        class Keeper:
+            def ping(self):
+                return "alive"
+
+        k = Keeper.remote()
+        assert ray_tpu.get(k.ping.remote(), timeout=30) == "alive"
+
+        from ray_tpu._private.worker_context import global_runtime
+
+        rt = global_runtime()
+        rt.kv_put("wal-key", b"wal-value", ns="chaos")
+        rt.kv_put("doomed", b"x", ns="chaos")
+        rt.kv_del("doomed", ns="chaos")
+        # No sleep for a snapshot interval: the WAL is all there is.
+        assert not os.path.exists(snap), "snapshot should not exist yet"
+
+        head.send_signal(signal.SIGKILL)
+        head.wait(timeout=10)
+        head = _start_head(port, snap, no_snap)
+
+        def driver_ok():
+            @ray_tpu.remote
+            def ping():
+                return "pong"
+
+            return ray_tpu.get(ping.remote(), timeout=10) == "pong"
+
+        assert _wait_for(driver_ok, 60, "driver reconnect")
+        # KV put AND del both replayed from the WAL.
+        assert rt.kv_get("wal-key", ns="chaos") == b"wal-value"
+        assert rt.kv_get("doomed", ns="chaos") is None
+
+        # The actor — created after the (nonexistent) snapshot — was
+        # restored from the WAL and restarted under its name.
+        def actor_back():
+            h = ray_tpu.get_actor("wal-actor")
+            return ray_tpu.get(h.ping.remote(), timeout=10) == "alive"
+
+        assert _wait_for(actor_back, 60, "actor restart from WAL")
     finally:
         try:
             ray_tpu.shutdown()

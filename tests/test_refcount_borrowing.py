@@ -1,0 +1,187 @@
+"""Distributed reference counting: the borrow protocol.
+
+Adversarial scenarios from the reference's ownership model
+(reference: src/ray/core_worker/reference_count.h:72 — borrower
+bookkeeping, WaitForRefRemoved; contained-object tracking for refs
+serialized inside values). Each test is built to break a directory that
+relies on task-arg pinning alone:
+
+  1. a ref smuggled into ACTOR STATE outlives the task that carried it;
+  2. a ref returned INSIDE A CONTAINER outlives the producing worker's
+     locals;
+  3. the OWNER dies while borrowers still hold the ref;
+  4. a nested ref in task args survives the submitter dropping its copy
+     right after a fire-and-forget submit.
+"""
+
+from __future__ import annotations
+
+import gc
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import state as us
+
+
+@pytest.fixture
+def cluster():
+    ray_tpu.init(num_cpus=4, object_store_memory=64 * 1024 * 1024)
+    yield
+    ray_tpu.shutdown()
+
+
+def _entry(hex_id: str) -> "dict | None":
+    for row in us.list_objects(limit=100000):
+        if row["object_id"] == hex_id:
+            return row
+    return None
+
+
+def _wait_freed(hex_id: str, timeout: float = 10.0) -> bool:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if _entry(hex_id) is None:
+            return True
+        time.sleep(0.1)
+    return False
+
+
+def test_ref_in_actor_state_outlives_passing_task(cluster):
+    """The actor stores the deserialized ref in self; the driver drops
+    its owned copy and the carrying task finishes (releasing its arg
+    pin). The actor's borrow must keep the object alive."""
+
+    @ray_tpu.remote
+    class Holder:
+        def __init__(self):
+            self.ref = None
+
+        def hold(self, container):
+            # nested (non-dep) ref: arrives via deserialization
+            self.ref = container[0]
+            return "held"
+
+        def fetch(self):
+            return ray_tpu.get(self.ref, timeout=30)
+
+        def drop(self):
+            self.ref = None
+            gc.collect()
+            return "dropped"
+
+    h = Holder.remote()
+    ref = ray_tpu.put({"payload": list(range(1000))})
+    hex_id = ref.hex()
+    assert ray_tpu.get(h.hold.remote([ref]), timeout=30) == "held"
+    # Owner (driver) drops its copy; the carrying task already finished.
+    del ref
+    gc.collect()
+    # Churn so any erroneous free would have happened.
+    for _ in range(3):
+        ray_tpu.get(ray_tpu.put("churn"), timeout=30)
+    time.sleep(0.5)
+    entry = _entry(hex_id)
+    assert entry is not None, "object freed while actor holds a borrow"
+    assert ray_tpu.get(h.fetch.remote(), timeout=30)["payload"][999] == 999
+    # Borrow released -> object must eventually free.
+    assert ray_tpu.get(h.drop.remote(), timeout=30) == "dropped"
+    assert _wait_freed(hex_id), "object leaked after last borrow dropped"
+
+
+def test_ref_returned_inside_container(cluster):
+    """A task puts an object and returns its ref inside a list. The
+    worker's locals are GC'd when the task ends; the CONTAINER object's
+    containment pin must keep the inner object alive until the driver
+    deserializes (becoming a borrower) and beyond."""
+
+    @ray_tpu.remote
+    def produce():
+        inner = ray_tpu.put({"x": 42})
+        return [inner]
+
+    out_ref = produce.remote()
+    container = ray_tpu.get(out_ref, timeout=30)
+    inner_ref = container[0]
+    hex_id = inner_ref.hex()
+    # Drop the container OBJECT (head entry) — the driver's borrow alone
+    # must now hold the inner object.
+    del out_ref
+    del container
+    gc.collect()
+    for _ in range(3):
+        ray_tpu.get(ray_tpu.put("churn"), timeout=30)
+    time.sleep(0.5)
+    assert _entry(hex_id) is not None, (
+        "inner object freed while driver borrows it")
+    assert ray_tpu.get(inner_ref, timeout=30) == {"x": 42}
+    del inner_ref
+    gc.collect()
+    assert _wait_freed(hex_id), "inner object leaked after borrow dropped"
+
+
+def test_owner_death_with_live_borrowers(cluster):
+    """An actor owns an object; the driver borrows the ref. Killing the
+    owner must not invalidate the borrower's access (the payload lives
+    in the head/agent arena, not the owner process)."""
+
+    @ray_tpu.remote
+    class Owner:
+        def make(self):
+            return [ray_tpu.put("precious")]
+
+    o = Owner.remote()
+    container = ray_tpu.get(o.make.remote(), timeout=30)
+    ref = container[0]
+    ray_tpu.kill(o)
+    time.sleep(1.0)
+    gc.collect()
+    assert ray_tpu.get(ref, timeout=30) == "precious"
+    hex_id = ref.hex()
+    del container
+    del ref
+    gc.collect()
+    assert _wait_freed(hex_id), "object leaked after owner death + drop"
+
+
+def test_nested_arg_ref_survives_fire_and_forget(cluster):
+    """Submit with the ref nested in a container arg, drop the driver's
+    copy immediately; the task only reads it later. The submit-time
+    borrowed-id pin must cover the flight."""
+
+    @ray_tpu.remote
+    def late_read(container, delay):
+        time.sleep(delay)
+        return ray_tpu.get(container[0], timeout=30)
+
+    ref = ray_tpu.put("late")
+    fut = late_read.remote([ref], 1.0)
+    del ref
+    gc.collect()
+    assert ray_tpu.get(fut, timeout=30) == "late"
+
+
+def test_borrow_released_on_borrower_death(cluster):
+    """A worker process dying must implicitly release its borrows."""
+
+    @ray_tpu.remote
+    class Croaker:
+        def __init__(self):
+            self.ref = None
+
+        def hold(self, container):
+            self.ref = container[0]
+            return "held"
+
+    c = Croaker.remote()
+    ref = ray_tpu.put("mortal")
+    hex_id = ref.hex()
+    assert ray_tpu.get(c.hold.remote([ref]), timeout=30) == "held"
+    del ref
+    gc.collect()
+    time.sleep(0.3)
+    assert _entry(hex_id) is not None
+    ray_tpu.kill(c)  # borrower dies -> borrow drops -> object frees
+    assert _wait_freed(hex_id, timeout=15), (
+        "borrow not released on borrower death")
