@@ -68,6 +68,32 @@ class ObjectRefGenerator:
 
     next = __next__
 
+    async def next_ref_async(self) -> "ObjectRef | None":
+        """Async analogue of __next__: awaits head-pushed readiness
+        instead of parking a thread in wait(). Returns None at
+        end-of-stream (StopIteration cannot cross a coroutine). Task
+        failures raise here once produced items are consumed."""
+        import asyncio
+
+        rt = global_runtime()
+        i = self._index
+        if self._count is not None:
+            if i >= self._count:
+                return None
+            self._index += 1
+            return ObjectRef(item_object_id(self._task_id, i), _owned=True)
+        item = ObjectRef(item_object_id(self._task_id, i), _owned=True)
+        while True:
+            ready = await asyncio.wrap_future(
+                rt.wait_async([item, self._done], num_returns=1))
+            if item in ready:
+                self._index += 1
+                return item
+            self._count = int(await asyncio.wrap_future(
+                rt.get_async(self._done)))
+            if i >= self._count:
+                return None
+
     def completed(self) -> ObjectRef:
         """Ref sealed when the generator task finishes (int item count)."""
         return self._done

@@ -84,9 +84,39 @@ class LLMEngine:
                 params = _load_checkpoint(config.checkpoint_path)
             else:
                 params = tfm.init_params(jax.random.PRNGKey(config.seed), c)
-        self.params = params
         B = config.max_num_seqs
-        self.cache = model_runner.init_slot_cache(c, B, self.max_len)
+        cache = model_runner.init_slot_cache(c, B, self.max_len)
+        # Tensor parallelism (reference: vllm_engine_stage.py:646
+        # tensor_parallel_size): TPU-natively this is pure PLACEMENT —
+        # shard weights megatron-style (models.partition_specs) and the
+        # slot KV cache on its kv_heads axis over a 1-D "tensor" mesh;
+        # the SAME jitted prefill/decode then runs SPMD, with GSPMD
+        # inserting the per-block psums. No second code path.
+        self.mesh = None
+        tp = int(config.tensor_parallel_size or 1)
+        if tp > 1:
+            devs = jax.devices()
+            if len(devs) < tp:
+                raise ValueError(
+                    f"tensor_parallel_size={tp} but only {len(devs)} "
+                    f"devices visible")
+            if c.n_heads % tp or c.kv_heads % tp:
+                raise ValueError(
+                    f"tensor_parallel_size={tp} must divide heads "
+                    f"({c.n_heads}) and kv_heads ({c.kv_heads})")
+            from jax.sharding import Mesh, NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            from ray_tpu.parallel.sharding import shard_params
+
+            self.mesh = Mesh(np.asarray(devs[:tp]), (tfm.AXIS_TENSOR,))
+            params, _ = shard_params(params, self.mesh,
+                                     tfm.partition_specs(c))
+            kv_spec = NamedSharding(
+                self.mesh, P(None, None, None, tfm.AXIS_TENSOR, None))
+            cache = {k: jax.device_put(v, kv_spec) for k, v in cache.items()}
+        self.params = params
+        self.cache = cache
         # Host-side scheduling state (uploaded per decode call): keeping
         # positions on host avoids a device→host sync per slot per token.
         self.positions = np.zeros((B,), np.int32)
@@ -284,7 +314,13 @@ class AsyncLLMEngine:
             self._wake.wait()
             while True:
                 with self._lock:
-                    if not self.engine.has_unfinished():
+                    # Drive only while ASYNC-owned requests are pending.
+                    # Foreign (sync generate()) requests are stepped by
+                    # their own caller; spinning on them here would busy-
+                    # loop forever if step() raises persistently after
+                    # _fail_all cleared everything we own.
+                    if (not (self._waiters or self._streams)
+                            or not self.engine.has_unfinished()):
                         self._wake.clear()
                         break
                     try:
@@ -319,8 +355,12 @@ class AsyncLLMEngine:
             fut.set_result(out)
 
     def _fail_all(self, exc: Exception) -> None:
-        """lock held. Resolve every pending request with the failure and
-        reset the engine's queues so the loop can go idle."""
+        """lock held. Resolve every async-owned pending request with the
+        failure and evict only those from the engine's queues. Requests
+        admitted by a concurrent sync ``engine.generate()`` caller stay:
+        wiping them would make that caller's ``has_unfinished()`` loop
+        exit early and KeyError on its own (vanished) request ids."""
+        owned = set(self._waiters) | set(self._streams)
         for fut in self._waiters.values():
             if not fut.done():
                 fut.set_exception(exc)
@@ -329,8 +369,12 @@ class AsyncLLMEngine:
             q.put(exc)  # aiter re-raises it
         self._streams.clear()
         self._seen.clear()
-        self.engine.waiting.clear()
-        self.engine.slots = [None] * len(self.engine.slots)
+        import collections as _collections
+        self.engine.waiting = _collections.deque(
+            r for r in self.engine.waiting if r.request_id not in owned)
+        self.engine.slots = [
+            None if (r is not None and r.request_id in owned) else r
+            for r in self.engine.slots]
 
     def _push_stream_tokens(self) -> None:
         """lock held. Emit tokens generated since the last step to any

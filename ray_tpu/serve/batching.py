@@ -25,12 +25,15 @@ from typing import Any, Callable
 class _BatchState:
     """Per-(instance, method) pending batch."""
 
-    __slots__ = ("items", "futures", "flusher")
+    __slots__ = ("items", "futures", "flusher", "pin")
 
     def __init__(self):
         self.items: list = []
         self.futures: list = []
         self.flusher: asyncio.Task | None = None
+        # Only set for non-weakref-able instances: pins the instance so
+        # its id() can never be recycled onto this state (see _state_for).
+        self.pin = None
 
 
 def batch(_fn: Callable | None = None, *, max_batch_size: int = 10,
@@ -46,7 +49,29 @@ def batch(_fn: Callable | None = None, *, max_batch_size: int = 10,
                 "@serve.batch requires an async def function (it awaits "
                 f"the batch on the replica event loop); got {fn!r}"
             )
-        states: dict[int, _BatchState] = {}  # id(instance) or 0 for free fns
+        # Keyed by id(instance) for IDENTITY semantics (a WeakKeyDict
+        # would collapse __eq__-equal instances into one shared state and
+        # reject __slots__ classes), with a weakref finalizer removing
+        # the entry at collection — the finalizer runs before the id can
+        # be recycled, so a new instance at the same address can never
+        # inherit a dead instance's pending items/futures. Instances
+        # that cannot be weak-referenced are pinned instead (bounded
+        # leak beats a wrong-self flush).
+        import weakref
+
+        states: dict[int, _BatchState] = {}
+        free_state = _BatchState()  # free functions share one batch
+
+        def _state_for(inst) -> _BatchState:
+            key = id(inst)
+            st = states.get(key)
+            if st is None:
+                st = states[key] = _BatchState()
+                try:
+                    weakref.finalize(inst, states.pop, key, None)
+                except TypeError:
+                    st.pin = inst
+            return st
 
         async def flush_after_wait(state: _BatchState, bound_args):
             try:
@@ -87,15 +112,14 @@ def batch(_fn: Callable | None = None, *, max_batch_size: int = 10,
             # Bound method: args = (self, item); free function: (item,).
             if len(args) == 2:
                 bound_args, item = (args[0],), args[1]
-                key = id(args[0])
+                state = _state_for(args[0])
             elif len(args) == 1:
                 bound_args, item = (), args[0]
-                key = 0
+                state = free_state
             else:
                 raise TypeError(
                     "@serve.batch methods take exactly one request item"
                 )
-            state = states.setdefault(key, _BatchState())
             fut = asyncio.get_running_loop().create_future()
             state.items.append(item)
             state.futures.append(fut)

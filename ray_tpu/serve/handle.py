@@ -56,14 +56,35 @@ class DeploymentResponse:
         """The underlying ObjectRef (composition: pass to other calls)."""
         return self._ref
 
-    def __await__(self):
-        """Awaitable inside async deployments (reference: DeploymentHandle
-        responses are awaitable in replica code). The blocking get runs
-        in the loop's default executor so the replica loop stays free."""
+    async def _result_async(self, timeout_s: float | None = None) -> Any:
+        """Truly async result: awaits the head-pushed object resolution
+        (runtime.get_async) — no thread parked for the request's
+        lifetime, which is what lets one proxy process hold hundreds of
+        in-flight requests (reference: serve/_private/proxy.py:754 fully
+        async proxy). Replica-death retry re-routes like result()."""
         import asyncio
 
-        loop = asyncio.get_event_loop()
-        return loop.run_in_executor(None, self.result).__await__()
+        from ray_tpu._private.worker_context import global_runtime
+
+        try:
+            fut = asyncio.wrap_future(global_runtime().get_async(self._ref))
+            value = await asyncio.wait_for(fut, timeout_s)
+        except ActorError:
+            if self._finish() and self._retry is not None:
+                # retry() may force-refresh against the controller
+                # (blocking RPC): keep it off the loop.
+                loop = asyncio.get_running_loop()
+                nxt = await loop.run_in_executor(None, self._retry)
+                if nxt is not None:
+                    return await nxt._result_async(timeout_s)
+            raise
+        self._finish()
+        return value
+
+    def __await__(self):
+        """Awaitable inside async deployments and the proxy (reference:
+        DeploymentHandle responses are awaitable in replica code)."""
+        return self._result_async().__await__()
 
 
 _ASTOP = object()  # end-of-stream sentinel for async iteration
@@ -104,23 +125,40 @@ class DeploymentResponseGenerator:
         return self
 
     async def __anext__(self):
-        """Async iteration for async deployments composing streams.
-        StopIteration cannot cross an executor future (the event loop
-        rewrites it to RuntimeError), so end-of-stream travels as a
-        sentinel."""
+        """Async iteration for async deployments and the SSE proxy:
+        awaits head-pushed item readiness (no thread parked per item).
+        Falls back to an executor step for generators lacking the async
+        protocol (e.g. a plain iterator injected in tests)."""
         import asyncio
 
-        def step():
-            try:
-                return self.__next__()
-            except StopIteration:
-                return _ASTOP
+        next_async = getattr(self._gen, "next_ref_async", None)
+        if next_async is None:
+            def step():
+                try:
+                    return self.__next__()
+                except StopIteration:
+                    return _ASTOP
 
-        loop = asyncio.get_event_loop()
-        item = await loop.run_in_executor(None, step)
-        if item is _ASTOP:
+            loop = asyncio.get_event_loop()
+            item = await loop.run_in_executor(None, step)
+            if item is _ASTOP:
+                raise StopAsyncIteration
+            return item
+        from ray_tpu._private.worker_context import global_runtime
+
+        try:
+            ref = await next_async()
+        except Exception:
+            self._finish()
+            raise
+        if ref is None:
+            self._finish()
             raise StopAsyncIteration
-        return item
+        try:
+            return await asyncio.wrap_future(global_runtime().get_async(ref))
+        except Exception:
+            self._finish()
+            raise
 
     def close(self):
         """Release routing accounting when abandoning the stream early

@@ -108,12 +108,16 @@ class HTTPProxy:
                 # (reference: streaming responses through the proxy).
                 return await self._stream_sse(web, request, handle_, payload)
             try:
-                def call() -> Any:
-                    # Routing (blocking controller RPCs, retry sleeps) AND
-                    # the result wait both stay off the event loop.
-                    return handle_.remote(payload).result(timeout_s=30.0)
-
-                result = await asyncio.get_running_loop().run_in_executor(None, call)
+                # Submit via a SHORT executor hop (routing can hit a
+                # blocking controller refresh ~1/s), then await the
+                # result fully async: an in-flight request holds no
+                # thread (reference: serve/_private/proxy.py:754 async
+                # proxy). Concurrency is bounded by memory, not pool
+                # size.
+                loop = asyncio.get_running_loop()
+                resp_obj = await loop.run_in_executor(
+                    None, lambda: handle_.remote(payload))
+                result = await resp_obj._result_async(timeout_s=30.0)
             except Exception as e:  # noqa: BLE001 — surface to the client
                 return web.json_response({"error": str(e)}, status=500)
             return self._encode(web, result)
@@ -134,64 +138,40 @@ class HTTPProxy:
         self._loop.run_until_complete(run())
 
     async def _stream_sse(self, web, request, handle_, payload):
+        """Fully async SSE: submit via a short executor hop, then
+        async-iterate the response generator — each item awaits a
+        head-pushed readiness notification, so a stream in flight holds
+        NO thread (the old design parked one pump thread per stream,
+        capping concurrent streams at the pool size). Backpressure is
+        inherent: the next item is requested only after the previous
+        write completes."""
         loop = asyncio.get_running_loop()
-        # Bounded queue = backpressure: a slow client blocks the pump
-        # thread instead of buffering the stream unboundedly.
-        queue: asyncio.Queue = asyncio.Queue(maxsize=16)
-        stop = threading.Event()
-
-        def pump():
-            gen = None
-            try:
-                gen = handle_.options(stream=True).remote(payload)
-                for item in gen:
-                    if stop.is_set():
-                        break
-                    fut = asyncio.run_coroutine_threadsafe(
-                        queue.put(("item", item)), loop
-                    )
-                    fut.result(timeout=60)
-            except Exception as e:  # noqa: BLE001
-                if not stop.is_set():
-                    try:
-                        asyncio.run_coroutine_threadsafe(
-                            queue.put(("error", str(e))), loop
-                        ).result(timeout=5)
-                    except Exception:
-                        pass
-            finally:
-                # Early termination must release routing accounting.
-                if gen is not None and hasattr(gen, "close"):
-                    gen.close()
-                try:
-                    asyncio.run_coroutine_threadsafe(
-                        queue.put(("end", None)), loop
-                    ).result(timeout=5)
-                except Exception:
-                    pass
-
         resp = web.StreamResponse(headers={
             "Content-Type": "text/event-stream",
             "Cache-Control": "no-cache",
         })
         await resp.prepare(request)
-        threading.Thread(target=pump, daemon=True).start()
+        gen = None
         try:
-            while True:
-                kind, item = await queue.get()
-                if kind == "end":
-                    break
-                if kind == "error":
-                    await resp.write(f"event: error\ndata: {json.dumps(item)}\n\n".encode())
-                    break
-                await resp.write(f"data: {json.dumps(item, default=str)}\n\n".encode())
+            gen = await loop.run_in_executor(
+                None, lambda: handle_.options(stream=True).remote(payload))
+            async for item in gen:
+                await resp.write(
+                    f"data: {json.dumps(item, default=str)}\n\n".encode())
             await resp.write_eof()
+        except Exception as e:  # noqa: BLE001
+            # Replica error mid-stream (surface to the client) or the
+            # client disconnected (write raised — nothing to surface).
+            try:
+                await resp.write(
+                    f"event: error\ndata: {json.dumps(str(e))}\n\n".encode())
+                await resp.write_eof()
+            except Exception:
+                pass
         finally:
-            # Client gone (write raised) or stream done: stop the pump and
-            # drain so a blocked put() wakes up.
-            stop.set()
-            while not queue.empty():
-                queue.get_nowait()
+            # Early termination must release routing accounting.
+            if gen is not None and hasattr(gen, "close"):
+                gen.close()
         return resp
 
     def _match_route(self, path: str) -> str | None:
