@@ -207,6 +207,8 @@ class Head:
         self.get_waiters: dict[str, tuple[rpc.Connection, set[str]]] = {}
         self._waiter_ids: dict[str, list[str]] = {}
         self.wait_waiters: dict[str, tuple[rpc.Connection, list[str], int]] = {}
+        # Sampling-profiler rendezvous: req_id -> (event, result holder).
+        self.profile_waiters: dict[str, tuple[threading.Event, dict]] = {}
         self.kv: dict[tuple[str, str], bytes] = {}
         self.actors: dict[str, ActorRecord] = {}
         self.named_actors: dict[tuple[str, str], str] = {}
@@ -1353,6 +1355,21 @@ class Head:
         rec = self.workers.get(actor.worker_id)
         if rec is None or rec.conn is None:
             return
+        if getattr(actor.spec, "allow_out_of_order", False):
+            # Out-of-order execution (opt-in; reference:
+            # out_of_order_actor_submit_queue.h): every dep-ready call
+            # dispatches NOW; calls parked on unresolved args do not
+            # block later ones. Ready calls still arrive at the worker
+            # in submission order relative to each other.
+            parked: deque[TaskSpec] = deque()
+            while actor.pending:
+                spec = actor.pending.popleft()
+                if all(self._is_ready(d) for d in spec.deps):
+                    self._push_to_worker(rec, spec)
+                else:
+                    parked.append(spec)
+            actor.pending = parked
+            return
         # Strict submission-order dispatch: stop at the first call whose
         # args are not yet available (later calls must not overtake it —
         # per-handle ordering, reference: sequential_actor_submit_queue.h).
@@ -1495,14 +1512,62 @@ class Head:
                     avail[k] = avail.get(k, 0) + v
             return {"total": total, "available": avail}
 
+    def _h_profile_result(self, body, conn):
+        """A worker's sampling run finished: wake the parked request."""
+        with self.lock:
+            waiter = self.profile_waiters.get(body.get("req_id") or "")
+        if waiter is not None:
+            ev, holder = waiter
+            holder.update(body)
+            ev.set()
+        return None
+
     def _h_profile_worker(self, body, conn):
         """Live stack capture of a worker (reference:
-        dashboard/modules/reporter/profile_manager.py:191 — py-spy; here
-        the worker's registered faulthandler SIGUSR1 hook appends every
-        thread's stack to its log, which this handler harvests)."""
+        dashboard/modules/reporter/profile_manager.py:191 — py-spy).
+        Two modes:
+          - default: one faulthandler snapshot ("where is it stuck"),
+            harvested from the worker log;
+          - sample_s > 0: the worker samples all threads at `hz` for
+            that long and reports folded collapsed stacks ("where does
+            time GO") over its own connection — no log scanning, no
+            cross-request interleaving."""
         import signal
 
         worker_id = body["worker_id"]
+        sample_s = float(body.get("sample_s") or 0.0)
+        if sample_s > 0:
+            sample_s = min(15.0, max(0.1, sample_s))
+            with self.lock:
+                rec = self.workers.get(worker_id)
+                wconn = rec.conn if rec is not None else None
+            if wconn is None:
+                return {"worker_id": worker_id,
+                        "error": "unknown worker or no connection"}
+
+            def rendezvous() -> dict:
+                # Runs on a DeferredReply thread: waiting out the sample
+                # must not park the requesting connection's reader (the
+                # dashboard multiplexes every /api call over one conn).
+                req_id = uuid.uuid4().hex[:16]
+                ev = threading.Event()
+                holder: dict = {}
+                with self.lock:
+                    self.profile_waiters[req_id] = (ev, holder)
+                try:
+                    wconn.cast("profile_start", {
+                        "req_id": req_id, "duration_s": sample_s,
+                        "hz": int(body.get("hz") or 50)})
+                    if not ev.wait(sample_s + 10.0):
+                        return {"worker_id": worker_id,
+                                "error": "sampling timed out"}
+                finally:
+                    with self.lock:
+                        self.profile_waiters.pop(req_id, None)
+                holder.pop("req_id", None)
+                return {"worker_id": worker_id, **holder}
+
+            return rpc.DeferredReply(rendezvous)
         # Clamped: this handler polls on the requesting connection's
         # reader thread, so only ITS client stalls, and boundedly.
         timeout_s = min(5.0, max(0.2, float(body.get("timeout_s", 3.0))))

@@ -36,6 +36,17 @@ class ConnectionLost(RpcError):
     pass
 
 
+class DeferredReply:
+    """Returned by a handler to move its (slow) body OFF the
+    connection's reader thread: ``run`` executes on a dedicated thread
+    and its return value / exception becomes the reply. Without this, a
+    long-blocking handler stalls every other message multiplexed on the
+    same connection."""
+
+    def __init__(self, run):
+        self._run = run
+
+
 class Connection:
     """One bidirectional framed-message connection.
 
@@ -129,9 +140,31 @@ class Connection:
             self._dispatch(kind, msg_id, payload)
         self._shutdown()
 
+    def _finish_deferred(self, deferred: "DeferredReply",
+                         msg_id: int) -> None:
+        try:
+            result = deferred._run()
+            if msg_id:
+                self._send(REPLY, msg_id, result)
+        except ConnectionLost:
+            pass
+        except Exception:
+            if msg_id:
+                try:
+                    self._send(ERROR, msg_id, traceback.format_exc())
+                except ConnectionLost:
+                    pass
+
     def _dispatch(self, kind: str, msg_id: int, payload: dict) -> None:
         try:
             result = self._handler(kind, payload, self) if self._handler else None
+            if isinstance(result, DeferredReply):
+                # Slow handler: finish on a dedicated thread so this
+                # connection's reader keeps dispatching other messages.
+                threading.Thread(
+                    target=self._finish_deferred, args=(result, msg_id),
+                    daemon=True, name="rpc-deferred").start()
+                return
             if msg_id:
                 self._send(REPLY, msg_id, result)
         except ConnectionLost:

@@ -67,3 +67,7 @@ class ActorSpec:
     concurrency_groups: dict | None = None
     # Refs nested inside init_args (see TaskSpec.borrowed_ids).
     borrowed_ids: list = dataclasses.field(default_factory=list)
+    # Opt-in out-of-order execution (reference:
+    # out_of_order_actor_submit_queue.h): calls whose args are ready
+    # may overtake earlier calls parked on unresolved args.
+    allow_out_of_order: bool = False

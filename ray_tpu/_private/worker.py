@@ -146,6 +146,12 @@ class Worker:
                 )
             self._set_tpu_env(body.get("tpu_chips"))
             self.executor.submit(self._run_task_guarded, body["spec"], None)
+        elif kind == "profile_start":
+            # Sampling profiler (reference: reporter/profile_manager.py
+            # :191 — py-spy record). Runs on its own thread so task
+            # execution AND message dispatch continue while sampling.
+            threading.Thread(target=self._sample_profile, args=(body,),
+                             daemon=True, name="profiler").start()
         elif kind == "kill":
             self._exit.set()
             os._exit(0)
@@ -153,6 +159,48 @@ class Worker:
             pass  # queued-task cancellation is handled head-side; running
             # tasks are force-cancelled by killing the worker process.
         return None
+
+    def _sample_profile(self, body: dict) -> None:
+        """Where does time GO (not just where is it stuck): sample every
+        thread's stack at ``hz`` for ``duration_s`` via
+        sys._current_frames(), fold into collapsed-stack counts
+        (flamegraph input format), and cast the aggregate back to the
+        head. Pure-Python py-spy analogue — no ptrace, no py-spy
+        dependency (reference: profile_manager.py:191)."""
+        import collections as _collections
+        import time as _time
+        import traceback as _traceback
+
+        duration = min(30.0, max(0.1, float(body.get("duration_s", 5.0))))
+        hz = min(200, max(1, int(body.get("hz", 50))))
+        me = threading.get_ident()
+        folded: _collections.Counter = _collections.Counter()
+        samples = 0
+        deadline = _time.time() + duration
+        while _time.time() < deadline:
+            for tid, frame in sys._current_frames().items():
+                if tid == me:
+                    continue
+                stack = _traceback.extract_stack(frame)
+                if not stack:
+                    continue
+                folded[";".join(
+                    f"{os.path.basename(f.filename)}:{f.name}"
+                    for f in stack)] += 1
+            samples += 1
+            _time.sleep(1.0 / hz)
+        try:
+            self.runtime.conn.cast("profile_result", {
+                "req_id": body.get("req_id"),
+                "worker_id": self.worker_id,
+                "samples": samples,
+                "duration_s": duration,
+                "hz": hz,
+                # Top 500 folded stacks: "file:func;file:func;..." -> hits.
+                "folded": dict(folded.most_common(500)),
+            })
+        except Exception:
+            pass
 
     @staticmethod
     def _set_tpu_env(chips) -> None:

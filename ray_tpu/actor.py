@@ -172,6 +172,8 @@ class ActorClass:
             scheduling_strategy=opts.get("scheduling_strategy"),
             runtime_env=_pack_env(opts.get("runtime_env"), rt),
             lifetime=opts.get("lifetime"),
+            allow_out_of_order=bool(
+                opts.get("allow_out_of_order_execution", False)),
         )
         rt.create_actor(spec)
         import inspect

@@ -51,14 +51,21 @@ def _log_index() -> list[dict]:
     return out
 
 
-def _profile_worker(worker_id: str) -> dict:
+def _profile_worker(worker_id: str, query: "dict | None" = None) -> dict:
     """Delegate to the head (the dashboard actor runs in a worker
-    process): the head signals the worker's faulthandler and harvests
-    the stack dump from its log."""
+    process). ?duration=N samples the worker for N seconds and returns
+    folded collapsed stacks (flamegraph input — where time GOES);
+    without it, one faulthandler snapshot (where it is STUCK)."""
     from ray_tpu._private.worker_context import global_runtime
 
-    return global_runtime().conn.call(
-        "profile_worker", {"worker_id": worker_id}, timeout=15)
+    q = query or {}
+    body = {"worker_id": worker_id}
+    if q.get("duration"):
+        body["sample_s"] = float(q["duration"])
+        body["hz"] = int(q.get("hz", 50))
+    timeout = 15 + float(body.get("sample_s") or 0)
+    return global_runtime().conn.call("profile_worker", body,
+                                      timeout=timeout)
 
 
 def _log_tail(name: str, max_bytes: int = 64 * 1024) -> dict:
@@ -99,7 +106,7 @@ class DashboardServer:
     # ------------------------------------------------------------------
 
     @staticmethod
-    def _payload(path: str):
+    def _payload(path: str, query: "dict | None" = None):
         from ray_tpu.util import metrics as um
         from ray_tpu.util import state as us
 
@@ -135,7 +142,7 @@ class DashboardServer:
             # stack capture; here the workers' registered faulthandler
             # SIGUSR1 hook writes every thread's stack into the worker
             # log, which this endpoint harvests).
-            return _profile_worker(path[len("/api/profile/"):])
+            return _profile_worker(path[len("/api/profile/"):], query)
         if path == "/api/logs":
             # Reference: dashboard/modules/log — per-worker log index.
             return {"logs": _log_index()}
@@ -164,7 +171,8 @@ class DashboardServer:
         async def handle(request: "web.Request") -> "web.Response":
             loop = asyncio.get_running_loop()
             try:
-                payload = await loop.run_in_executor(None, self._payload, request.path)
+                payload = await loop.run_in_executor(
+                    None, self._payload, request.path, dict(request.query))
             except Exception as e:  # noqa: BLE001
                 return web.json_response({"error": str(e)}, status=500)
             if payload is None:

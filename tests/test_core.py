@@ -358,3 +358,48 @@ def test_runtime_context_in_task(cluster):
 
     task_id, node_id = ray_tpu.get(ctx.remote())
     assert task_id.startswith("task-") and node_id.startswith("node-")
+
+
+# ----------------------------------------------------- out-of-order actors
+
+
+def test_out_of_order_actor_execution(cluster):
+    """Opt-in out-of-order execution (reference:
+    out_of_order_actor_submit_queue.h): a call parked on an unresolved
+    arg does not block later dep-ready calls; the default stays strict
+    submission order."""
+
+    @ray_tpu.remote
+    def slow_dep():
+        time.sleep(2.0)
+        return "late"
+
+    @ray_tpu.remote(allow_out_of_order_execution=True)
+    class OOO:
+        def eat(self, x):
+            return x
+
+        def quick(self):
+            return "quick"
+
+    a = OOO.remote()
+    blocked = a.eat.remote(slow_dep.remote())  # parked on the slow dep
+    t0 = time.time()
+    assert ray_tpu.get(a.quick.remote(), timeout=30) == "quick"
+    assert time.time() - t0 < 1.5  # overtook the parked call
+    assert ray_tpu.get(blocked, timeout=30) == "late"
+
+    # Control: the DEFAULT actor preserves submission order.
+    @ray_tpu.remote
+    class Ordered:
+        def eat(self, x):
+            return x
+
+        def quick(self):
+            return "quick"
+
+    b = Ordered.remote()
+    b.eat.remote(slow_dep.remote())
+    t0 = time.time()
+    assert ray_tpu.get(b.quick.remote(), timeout=30) == "quick"
+    assert time.time() - t0 > 1.0  # waited behind the parked call

@@ -140,3 +140,49 @@ def test_dashboard_live_profile_endpoint():
     finally:
         stop_dashboard()
         ray_tpu.kill(s)
+
+
+def test_dashboard_sampling_profiler():
+    """/api/profile/{id}?duration=N: folded collapsed stacks showing
+    where a BUSY worker spends time — not just one snapshot (reference:
+    profile_manager.py:191 py-spy record)."""
+    import time
+
+    from ray_tpu._private.worker_context import get_head
+    from ray_tpu.dashboard import start_dashboard, stop_dashboard
+
+    @ray_tpu.remote
+    class Burner:
+        def spin_hotly(self, seconds):
+            t0 = time.time()
+            x = 0
+            while time.time() - t0 < seconds:
+                x += 1
+            return x
+
+        def ping(self):
+            return 1
+
+    b = Burner.remote()
+    ray_tpu.get(b.ping.remote(), timeout=30)
+    fut = b.spin_hotly.remote(6.0)
+    time.sleep(0.3)
+    head = get_head()
+    worker_id = next(w.worker_id for w in head.workers.values()
+                     if w.actor_id == b._actor_id and w.proc is not None)
+    port = start_dashboard()
+    try:
+        out = _get(port, f"/api/profile/{worker_id}?duration=1.5")
+        assert out.get("samples", 0) > 10, out
+        folded = out.get("folded") or {}
+        assert folded, out
+        # The hot method dominates the folded stacks.
+        hot = sum(n for stack, n in folded.items() if "spin_hotly" in stack)
+        total = sum(folded.values())
+        assert hot > 0.2 * total, (hot, total, list(folded)[:5])
+        # Folded format: outer;...;inner frames joined by ';'.
+        assert any(";" in stack for stack in folded)
+    finally:
+        stop_dashboard()
+        ray_tpu.get(fut, timeout=30)
+        ray_tpu.kill(b)
