@@ -14,7 +14,7 @@ from __future__ import annotations
 import builtins
 import dataclasses
 import itertools
-from typing import Any, Callable, Iterator, Optional
+from typing import Any, Callable, ClassVar, Iterator, Optional
 
 import numpy as np
 
@@ -49,8 +49,17 @@ class DataContext:
 
     use_tasks: bool = True  # fan stages out as cluster tasks when possible
     parallelism: int = 4  # max in-flight stage tasks (backpressure window)
+    # Byte budget for completed-but-unconsumed stage outputs (reference:
+    # streaming_executor.py:48 resource-budget backpressure — output
+    # queues bounded by BYTES, not count). Producers stop submitting
+    # while the buffered bytes exceed this; a slow consumer therefore
+    # caps memory at ~budget + parallelism in-flight blocks regardless
+    # of dataset size.
+    target_max_bytes_in_flight: int = 256 * 1024 * 1024
+    # Filled by the executor per run: {"max_bytes_buffered": N, ...}.
+    stats: dict = dataclasses.field(default_factory=dict)
 
-    _current: "DataContext | None" = None
+    _current: "ClassVar[DataContext | None]" = None
 
     @staticmethod
     def get_current() -> "DataContext":
@@ -80,14 +89,18 @@ class Dataset:
         batch_size: int | None = None,
         batch_format: str = "numpy",
         fn_constructor_args: tuple = (),
+        zero_copy_batch: bool = False,
     ) -> "Dataset":
         if isinstance(fn, type):
             ctor = fn
             args = fn_constructor_args
             return self._append(
-                MapBatches(None, batch_size, batch_format, lambda: ctor(*args))
+                MapBatches(None, batch_size, batch_format,
+                           lambda: ctor(*args),
+                           zero_copy_batch=zero_copy_batch)
             )
-        return self._append(MapBatches(fn, batch_size, batch_format))
+        return self._append(MapBatches(fn, batch_size, batch_format,
+                                       zero_copy_batch=zero_copy_batch))
 
     def filter(self, fn: Callable) -> "Dataset":
         return self._append(Filter(fn))
@@ -136,8 +149,10 @@ class Dataset:
         batch_size: int | None = 256,
         batch_format: str = "numpy",
         drop_last: bool = False,
+        zero_copy_batch: bool = False,
     ) -> Iterator[Any]:
-        stream = _rebatch(self.iter_blocks(), batch_size)
+        stream = _rebatch(self.iter_blocks(), batch_size,
+                          zero_copy=zero_copy_batch)
         for block in stream:
             acc = BlockAccessor(block)
             if drop_last and batch_size and acc.num_rows() < batch_size:

@@ -49,6 +49,11 @@ class MapBatches(LogicalOp):
     batch_size: int | None = None
     batch_format: str = "numpy"
     fn_constructor: Callable | None = None  # class-based UDF (actor-ish)
+    # Zero-copy batches (reference: map_batches(zero_copy_batch=True)):
+    # a batch that is one contiguous run of a source block is passed as
+    # a SLICE (arrow slice / numpy view) instead of a copy. The UDF must
+    # not mutate it in place.
+    zero_copy_batch: bool = False
 
 
 @dataclass
@@ -130,7 +135,8 @@ def _apply_op(op, blocks: Iterator[Block]) -> Iterator[Block]:
         if op.fn_constructor is not None:
             inst = op.fn_constructor()
             fn = inst.__call__ if callable(inst) else inst
-        for block in _rebatch(blocks, op.batch_size):
+        for block in _rebatch(blocks, op.batch_size,
+                              zero_copy=op.zero_copy_batch):
             batch = BlockAccessor(block).to_batch(op.batch_format)
             out = fn(batch)
             if out is None:
@@ -176,7 +182,8 @@ def _apply_op(op, blocks: Iterator[Block]) -> Iterator[Block]:
         raise TypeError(f"not a fusable op: {op}")
 
 
-def _rebatch(blocks: Iterator[Block], batch_size: int | None) -> Iterator[Block]:
+def _rebatch(blocks: Iterator[Block], batch_size: int | None,
+             zero_copy: bool = False) -> Iterator[Block]:
     """Re-chunk a block stream to exactly ``batch_size`` rows (last batch
     may be short). None → pass blocks through unchanged. Slices directly
     out of the buffered blocks — only the emitted batch is materialized,
@@ -202,7 +209,13 @@ def _rebatch(blocks: Iterator[Block], batch_size: int | None) -> Iterator[Block]
             else:
                 buf[0] = (blk, off + take)
             need -= take
-        # Always concat (even one part): it copies numpy slices, so the
+        if zero_copy and len(parts) == 1:
+            # One contiguous run of a source block: hand out the slice
+            # itself (arrow slice / numpy view — no bytes move). Caller
+            # opted in and must not mutate (reference:
+            # map_batches(zero_copy_batch=True) semantics).
+            return parts[0]
+        # Concat (even one part): it copies numpy slices, so the
         # emitted batch never aliases buffered source blocks — consumers
         # may mutate batches in place without corrupting the lazy plan.
         return BlockAccessor.concat(parts)
@@ -235,10 +248,16 @@ def run_fused_stage(source, ops: list) -> list[Block]:
 # -- streaming driver --------------------------------------------------------
 
 def _bounded_map(inputs: list, fn: Callable, parallelism: int,
-                 use_tasks: bool) -> Iterator[list[Block]]:
+                 use_tasks: bool, max_bytes: "int | None" = None,
+                 stats: "dict | None" = None) -> Iterator[list[Block]]:
     """Apply ``fn`` over ``inputs`` with at most ``parallelism`` in
-    flight; yield results in submission order (streaming backpressure —
-    the role of the reference's resource-budget OpState queues)."""
+    flight AND at most ``max_bytes`` of completed-but-unconsumed output
+    buffered; yield results in submission order (the reference's
+    resource-budget OpState queues, streaming_executor.py:48 — bounded
+    by BYTES, not count). The local thread path enforces the byte
+    budget exactly (outputs buffer in driver memory); the cluster-task
+    path keeps the count window (completed blocks wait in the object
+    store, where eviction/spilling governs memory, not this driver)."""
     if parallelism <= 1 or len(inputs) <= 1:
         for item in inputs:
             yield fn(item)
@@ -257,20 +276,53 @@ def _bounded_map(inputs: list, fn: Callable, parallelism: int,
             yield ray_tpu.get(pending.pop(next_yield))
             next_yield += 1
     else:
+        import threading
+
+        lock = threading.Lock()
+        buffered = {"bytes": 0, "peak": 0}
+
+        def run_sized(item):
+            out = fn(item)
+            n = sum(BlockAccessor(b).size_bytes() for b in out)
+            with lock:
+                buffered["bytes"] += n
+                buffered["peak"] = max(buffered["peak"], buffered["bytes"])
+            return out, n
+
         with ThreadPoolExecutor(max_workers=parallelism) as pool:
             futs = {}
             next_submit = 0
             next_yield = 0
             while next_yield < len(inputs):
                 while next_submit < len(inputs) and len(futs) < parallelism:
-                    futs[next_submit] = pool.submit(fn, inputs[next_submit])
+                    if max_bytes is not None and futs:
+                        with lock:
+                            over = buffered["bytes"] >= max_bytes
+                        if over:
+                            # Budget exhausted: stop producing until the
+                            # consumer drains (futs is non-empty, so the
+                            # yield below always makes progress).
+                            break
+                    futs[next_submit] = pool.submit(run_sized,
+                                                    inputs[next_submit])
                     next_submit += 1
-                yield futs.pop(next_yield).result()
+                out, n = futs.pop(next_yield).result()
+                with lock:
+                    buffered["bytes"] -= n
                 next_yield += 1
+                yield out
+            if stats is not None:
+                stats["max_bytes_buffered"] = max(
+                    stats.get("max_bytes_buffered", 0), buffered["peak"])
 
 
 def execute_plan(plan: list, ctx) -> Iterator[Block]:
     """Stream blocks out of a logical plan."""
+    stats = getattr(ctx, "stats", None)
+    if stats is not None:
+        # Per-run high-water mark: a smaller run after a larger one must
+        # not report the stale peak.
+        stats.pop("max_bytes_buffered", None)
     i = 0
     stream: Iterator[Block] | None = None
     while i < len(plan):
@@ -289,8 +341,11 @@ def execute_plan(plan: list, ctx) -> Iterator[Block]:
                 return run_fused_stage(src, list(_fused))
 
             def gen(inputs=inputs, run=run, use_tasks=use_tasks):
-                for out in _bounded_map(list(inputs), run, ctx.parallelism,
-                                        use_tasks):
+                for out in _bounded_map(
+                        list(inputs), run, ctx.parallelism, use_tasks,
+                        max_bytes=getattr(ctx, "target_max_bytes_in_flight",
+                                          None),
+                        stats=getattr(ctx, "stats", None)):
                     yield from out
 
             stream = gen()
