@@ -50,9 +50,22 @@ class _DagError:
         raise TaskError(self.message, self.tb, "compiled_dag")
 
 
-def build_plan(root, channel_capacity: int) -> "dict | None":
+def build_plan(root, channel_capacity: int,
+               placement=None,
+               driver_node: "str | None" = None) -> "dict | None":
     """Analyze the graph; returns {actors, plans, channels, output} or
-    None when the graph shape is not channel-compilable."""
+    None when the graph shape is not channel-compilable.
+
+    ``placement`` is a callable ``(actor_ids) -> dict | None`` invoked
+    ONCE with the participating actor ids; with it (and
+    ``driver_node``) every channel is assigned a transport: "shm" when
+    the writer and ALL readers share a node, else "tcp" (a DCN streamed
+    channel, reference: torch_tensor_nccl_channel.py:44 cross-host
+    channels). When placement is unavailable (callable absent, lookup
+    failed, or an actor unplaced) the plan assumes a same-host shm
+    graph — ``plan["local"]`` True — and the driver's ready-handshake
+    timeout remains the safety net for actors that turn out to be
+    off-host."""
     from ray_tpu.dag.nodes import (
         ClassMethodNode,
         DAGNode,
@@ -104,23 +117,50 @@ def build_plan(root, channel_capacity: int) -> "dict | None":
                     consumers.setdefault(dep._uuid, set()).add(actor_of(n))
     out_uuids = {n._uuid for n in output_nodes}
 
+    actor_nodes = None
+    if placement is not None and driver_node is not None:
+        actor_nodes = placement(sorted({actor_of(n) for n in method_nodes}))
+    nodes_known = actor_nodes is not None and driver_node is not None
+
+    def node_of(aid: str) -> "str | None":
+        if aid == "driver":
+            return driver_node
+        return (actor_nodes or {}).get(aid)
+
+    def transport_for(writer: str, reader_aids) -> str:
+        if not nodes_known:
+            return "shm"  # legacy assumption: same-host graph
+        home = node_of(writer)
+        if home is None:
+            return "tcp"  # unknown placement: the safe transport
+        return "shm" if all(node_of(r) == home for r in reader_aids) \
+            else "tcp"
+
     tag = uuid.uuid4().hex[:8]
-    channels: dict[str, dict] = {}  # name -> {capacity, num_readers}
+    channels: dict[str, dict] = {}  # name -> {capacity, num_readers, ...}
     chan_of: dict[str, str] = {}  # producing node uuid -> channel name
     for n in method_nodes:
-        readers = len(consumers.get(n._uuid, ()))
+        reader_aids = list(consumers.get(n._uuid, ()))
         if n._uuid in out_uuids:
-            readers += 1  # the driver
-        if readers:
+            reader_aids.append("driver")
+        if reader_aids:
             name = f"/rtpu-dag-{tag}-{n._uuid}"
             chan_of[n._uuid] = name
-            channels[name] = {"capacity": channel_capacity,
-                              "num_readers": readers}
+            channels[name] = {
+                "capacity": channel_capacity,
+                "num_readers": len(reader_aids),
+                "writer": actor_of(n),
+                "transport": transport_for(actor_of(n), reader_aids),
+            }
     input_chan = None
     if input_consumers:
         input_chan = f"/rtpu-dag-{tag}-input"
-        channels[input_chan] = {"capacity": channel_capacity,
-                                "num_readers": len(input_consumers)}
+        channels[input_chan] = {
+            "capacity": channel_capacity,
+            "num_readers": len(input_consumers),
+            "writer": "driver",
+            "transport": transport_for("driver", input_consumers),
+        }
 
     def src_of(dep) -> tuple:
         if isinstance(dep, InputNode):
@@ -180,34 +220,112 @@ def build_plan(root, channel_capacity: int) -> "dict | None":
                     step["acquire"].append(src[1])
         plan["read_channels"] = sorted(plan["read_channels"])
         plan["write_channels"] = sorted(plan["write_channels"])
-        channels[plan["ready_channel"]] = {"capacity": 1 << 16,
-                                           "num_readers": 1}
+
+    local = (not nodes_known) or all(
+        node_of(aid) == driver_node for aid in plans)
+    for aid, plan in plans.items():
+        if local:
+            # Single-phase shm flow: ready-channel handshake.
+            channels[plan["ready_channel"]] = {
+                "capacity": 1 << 16, "num_readers": 1,
+                "writer": aid, "transport": "shm"}
+        else:
+            # Two-phase flow: per-actor channel specs travel with the
+            # plan; the task returns are the handshake.
+            plan.pop("ready_channel", None)
+            plan["setup_key"] = f"{tag}-{aid}"
+            plan["channel_specs"] = {
+                name: channels[name]
+                for name in plan["read_channels"] + plan["write_channels"]
+            }
 
     return {
         "plans": plans,
         "handles": handles,
         "channels": channels,
         "input_chan": input_chan,
+        "local": local,
         "output_chans": [chan_of[u] for u in
                          [n._uuid for n in output_nodes]],
         "multi_output": isinstance(root, MultiOutputNode),
     }
 
 
-def actor_dag_loop(instance, plan: dict) -> str:
+# Channels created in the setup phase, parked until the run phase
+# arrives with the dial map (keyed by the plan's setup_key).
+_DAG_SETUP: dict[str, dict] = {}
+
+
+def actor_dag_loop(instance, plan: dict):
     """Start the resident loop ON the actor's worker (dispatched by
     worker._run_task under the reserved method name LOOP_METHOD —
     reference: the pinned actor executables of compiled_dag_node.py,
     which run on a dedicated execution thread so the actor keeps serving
     normal method calls).
 
-    Channel setup + the ready handshake happen synchronously — failures
-    there seal this task's return ref as an error for the driver — then
-    the run loop moves to its own daemon thread and this task returns.
-    The thread exits when any input channel is closed (teardown)."""
+    Single-phase (plan without "phase"): the driver created every shm
+    channel; open by name, ready-handshake, spawn the loop.
+
+    Two-phase (cross-node graphs): "setup" creates the channels this
+    actor WRITES (shm homed here, or TCP listeners — reference:
+    torch_tensor_nccl_channel.py:44 cross-host channels) and returns
+    their endpoints; "run" receives the merged dial map, opens the read
+    side, and spawns the loop. The task returns are the handshake."""
     import threading
 
     from ray_tpu.experimental.channel import Channel
+
+    phase = plan.get("phase")
+    if phase == "cleanup":
+        # A partner actor's setup failed and the driver is falling back:
+        # release this actor's parked channels (TCP listeners, shm
+        # segments) instead of leaking them for the process lifetime.
+        stash = _DAG_SETUP.pop(plan["setup_key"], None)
+        if stash:
+            for ch in stash["writes"].values():
+                try:
+                    ch.close()
+                except Exception:
+                    pass
+                try:
+                    ch.unlink()
+                except Exception:
+                    pass
+        return "cleaned"
+    if phase == "setup":
+        from ray_tpu.experimental.tcp_channel import TcpChannelServer
+
+        writes: dict[str, Any] = {}
+        endpoints: dict[str, tuple] = {}
+        for name in plan["write_channels"]:
+            spec = plan["channel_specs"][name]
+            if spec["transport"] == "tcp":
+                ch = TcpChannelServer(name, num_readers=spec["num_readers"])
+                endpoints[name] = ch.endpoint
+            else:
+                ch = Channel(capacity=spec["capacity"],
+                             num_readers=spec["num_readers"], name=name)
+            writes[name] = ch
+        _DAG_SETUP[plan["setup_key"]] = {"writes": writes}
+        return endpoints
+    if phase == "run":
+        from ray_tpu.experimental.tcp_channel import TcpChannelReader
+
+        stash = _DAG_SETUP.pop(plan["setup_key"])
+        writes = stash["writes"]
+        dial = plan["dial"]
+        reads = {}
+        for name in plan["read_channels"]:
+            spec = plan["channel_specs"][name]
+            if spec["transport"] == "tcp":
+                reads[name] = TcpChannelReader(name, dial[name])
+            else:
+                reads[name] = Channel(name=name, _create=False)
+        threading.Thread(
+            target=_run_dag_loop, args=(instance, plan, reads, writes),
+            daemon=True, name="dag-loop",
+        ).start()
+        return "started"
 
     reads = {name: Channel(name=name, _create=False)
              for name in plan["read_channels"]}
@@ -280,3 +398,12 @@ def _run_dag_loop(instance, plan: dict, reads: dict, writes: dict) -> str:
 
         traceback.print_exc()
         return "crashed"
+    finally:
+        # Propagate teardown: closing this loop's endpoints wakes peers
+        # up/downstream (a TCP close frame or the shm closed flag), so
+        # one closed edge drains the whole pipeline.
+        for ch in list(reads.values()) + list(writes.values()):
+            try:
+                ch.close()
+            except Exception:
+                pass

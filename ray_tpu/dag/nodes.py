@@ -204,6 +204,7 @@ class CompiledDAG:
         self._root = root
         self._destroyed = False
         self._mode = "legacy"
+        self._compile_failure: str | None = None
         self._channels: dict = {}
         self._loop_refs: list = []
         self._exec_seq = 0
@@ -211,19 +212,53 @@ class CompiledDAG:
         self._partial_outs: list = []
         try:
             self._try_compile_channels(channel_capacity)
-        except Exception:
+        except Exception as e:  # noqa: BLE001
+            self._compile_failure = repr(e)
             self._teardown_channels()
             self._mode = "legacy"
 
     # -- channel mode ------------------------------------------------------
 
+    @staticmethod
+    def _actor_nodes(aids) -> "dict | None":
+        """actor_id -> node_id for the participating actors. Polls
+        briefly for actors still being placed; returns None when
+        placement stays unknown — build_plan then assumes a same-host
+        shm graph and the ready-handshake timeout is the safety net."""
+        import time as _time
+
+        import ray_tpu.util.state as us
+
+        deadline = _time.monotonic() + 5.0
+        while True:
+            try:
+                rows = {a["actor_id"]: a.get("node_id")
+                        for a in us.list_actors(limit=100000)}
+            except Exception:
+                return None
+            nodes = {aid: rows.get(aid) for aid in aids}
+            if all(v is not None for v in nodes.values()):
+                return nodes
+            if _time.monotonic() > deadline:
+                return None
+            _time.sleep(0.2)
+
     def _try_compile_channels(self, capacity: int) -> None:
+        from ray_tpu._private.worker_context import global_runtime
         from ray_tpu.actor import ActorMethod
         from ray_tpu.dag import channel_exec
         from ray_tpu.experimental.channel import Channel, ChannelTimeout
 
-        plan = channel_exec.build_plan(self._root, capacity)
+        driver_node = global_runtime().node_id
+        plan = channel_exec.build_plan(self._root, capacity,
+                                       self._actor_nodes, driver_node)
         if plan is None:
+            self._compile_failure = (
+                "graph is not channel-compilable (non-actor nodes or "
+                "const-only sources)")
+            return
+        if not plan["local"]:
+            self._compile_mixed(plan)
             return
         # Driver creates every channel up front; actors open by name.
         for name, spec in plan["channels"].items():
@@ -245,6 +280,79 @@ class CompiledDAG:
                 ch.end_read()
         except ChannelTimeout:
             raise RuntimeError("compiled-DAG ready handshake timed out")
+        self._mode = "channels"
+
+    def _compile_mixed(self, plan) -> None:
+        """Cross-node compile (reference: cross-host channels,
+        torch_tensor_nccl_channel.py:44): shm where writer+readers share
+        a node, TCP elsewhere. Two phases — every actor first creates
+        the channels it WRITES (returning TCP endpoints), then starts
+        its loop with the merged dial map. Task returns are the
+        handshake."""
+        import ray_tpu
+        from ray_tpu.actor import ActorMethod
+        from ray_tpu.dag import channel_exec
+        from ray_tpu.experimental.channel import Channel
+        from ray_tpu.experimental.tcp_channel import (
+            TcpChannelReader,
+            TcpChannelServer,
+        )
+
+        endpoints: dict = {}
+        for name, spec in plan["channels"].items():
+            if spec["writer"] != "driver":
+                continue
+            if spec["transport"] == "tcp":
+                ch = TcpChannelServer(name, num_readers=spec["num_readers"])
+                endpoints[name] = ch.endpoint
+            else:
+                ch = Channel(capacity=spec["capacity"],
+                             num_readers=spec["num_readers"], name=name)
+            self._channels[name] = ch
+        setup_refs = {
+            aid: ActorMethod(plan["handles"][aid],
+                             channel_exec.LOOP_METHOD).remote(
+                                 {**aplan, "phase": "setup"})
+            for aid, aplan in plan["plans"].items()
+        }
+        try:
+            for aid, ref in setup_refs.items():
+                endpoints.update(ray_tpu.get(ref, timeout=30))
+            self._plan = plan
+            self._loop_refs = [
+                ActorMethod(plan["handles"][aid],
+                            channel_exec.LOOP_METHOD).remote(
+                                {**aplan, "phase": "run",
+                                 "dial": endpoints})
+                for aid, aplan in plan["plans"].items()
+            ]
+            for ref in self._loop_refs:
+                started = ray_tpu.get(ref, timeout=30)
+                if started != "started":
+                    raise RuntimeError(f"loop start returned {started!r}")
+        except BaseException:
+            # Partner actors that DID finish setup hold parked channels
+            # (TCP listeners, shm segments): release them, or repeated
+            # failed compiles leak sockets for the actors' lifetimes.
+            for aid, aplan in plan["plans"].items():
+                try:
+                    ActorMethod(plan["handles"][aid],
+                                channel_exec.LOOP_METHOD).remote(
+                                    {"phase": "cleanup",
+                                     "setup_key": aplan["setup_key"]})
+                except Exception:
+                    pass
+            raise
+        # Open the driver's read side of the output channels.
+        for name in plan["output_chans"]:
+            if name in self._channels:
+                continue
+            spec = plan["channels"][name]
+            if spec["transport"] == "tcp":
+                self._channels[name] = TcpChannelReader(name,
+                                                        endpoints[name])
+            else:
+                self._channels[name] = Channel(name=name, _create=False)
         self._mode = "channels"
 
     def _read_output(self, timeout_s: float) -> Any:
@@ -277,9 +385,12 @@ class CompiledDAG:
                     if _time.monotonic() > deadline:
                         raise
             try:
-                import copy
+                if not getattr(ch, "owns_payload", False):
+                    # shm slot: the view dies at end_read — copy out.
+                    # TCP readers own their recv buffer; no copy needed.
+                    import copy
 
-                value = copy.deepcopy(value)
+                    value = copy.deepcopy(value)
             finally:
                 ch.end_read()
             outs.append(value)
@@ -316,6 +427,18 @@ class CompiledDAG:
         ref = CompiledDAGRef(self, self._exec_seq)
         self._exec_seq += 1
         return ref
+
+    def ensure_compiled(self) -> "CompiledDAG":
+        """Assert the channel fast path was taken. The compiler silently
+        falls back to per-call ObjectRef execution for shapes it cannot
+        compile; callers that DEPEND on channel performance (pipelines
+        sized around the ~order-of-magnitude win) use this to turn the
+        silent degradation into an error."""
+        if self._mode != "channels":
+            raise RuntimeError(
+                "compiled DAG fell back to the legacy ObjectRef path: "
+                + (self._compile_failure or "unknown reason"))
+        return self
 
     def teardown(self) -> None:
         self._teardown_channels()

@@ -357,6 +357,10 @@ def test_compiled_dag_function_node_falls_back(cluster):
     compiled = dag.experimental_compile()
     try:
         assert compiled._mode == "legacy"
+        # ensure_compiled turns the silent fallback into an error users
+        # can opt into (the fast path was NOT taken here).
+        with pytest.raises(RuntimeError, match="fell back"):
+            compiled.ensure_compiled()
         ref = compiled.execute(10)
         assert ray_tpu.get(ref, timeout=30) == 9
     finally:
