@@ -11,9 +11,20 @@ plugins that work with zero egress:
                  stored in the cluster KV, extracted + chdir'd worker-side
   py_modules   — same packaging, each entry prepended to sys.path
 
-pip/conda envs require network egress and are rejected with a clear error
-(pre-bake packages into the image instead — the reference's recommended
-production posture as well).
+  pip          — per-env package directory (reference:
+                 _private/runtime_env/pip.py). Zero-egress posture: pip
+                 runs with --no-index by DEFAULT, resolving from local
+                 wheel dirs (``find_links``) or explicit index config —
+                 installs land in a content-hashed --target directory
+                 built once per node and path-scoped per task. Process
+                 isolation is path-level (this runtime shares one
+                 interpreter per worker), vs the reference's per-process
+                 virtualenv; clashing binary deps should still be
+                 pre-baked into the image.
+
+conda/uv envs are rejected with a clear error (they manage whole
+interpreter environments — pre-bake instead, the reference's
+recommended production posture as well).
 """
 
 from __future__ import annotations
@@ -62,12 +73,12 @@ def pack(runtime_env: dict | None, rt) -> dict | None:
     to URIs (reference: working_dir.py upload_package_if_needed)."""
     if not runtime_env:
         return runtime_env
-    for bad in ("pip", "conda", "uv"):
+    for bad in ("conda", "uv"):
         if runtime_env.get(bad):
             raise ValueError(
-                f"runtime_env[{bad!r}] needs network egress, which this "
-                f"deployment does not have; pre-install the packages in "
-                f"the worker image instead"
+                f"runtime_env[{bad!r}] manages whole interpreter "
+                f"environments; pre-install in the worker image, or use "
+                f"runtime_env['pip'] with local wheels (find_links)"
             )
     for bad in ("container", "image_uri"):
         if runtime_env.get(bad):
@@ -84,6 +95,23 @@ def pack(runtime_env: dict | None, rt) -> dict | None:
         rt.kv_put(uri, blob, ns="__runtime_env__", overwrite=False)
         return uri
 
+    if env.get("pip"):
+        spec = normalize_pip_spec(env["pip"])
+        fl = spec.get("find_links")
+        if fl and not fl.startswith(("pkg:", "http://", "https://",
+                                     "file://")):
+            if not os.path.isdir(fl):
+                raise ValueError(
+                    f"runtime_env pip find_links {fl!r} is not a "
+                    f"directory on the driver (bad specs fail at "
+                    f"submit, not on a worker)")
+            # Ship the wheel dir through the cluster KV so workers on
+            # EVERY node can resolve from it, not just the driver host.
+            blob = _zip_dir(fl)
+            uri = "pkg:" + hashlib.sha256(blob).hexdigest()[:32]
+            rt.kv_put(uri, blob, ns="__runtime_env__", overwrite=False)
+            spec["find_links"] = uri
+        env["pip"] = spec
     if env.get("working_dir") and not str(env["working_dir"]).startswith("pkg:"):
         env["working_dir"] = upload(env["working_dir"])
     if env.get("py_modules"):
@@ -95,6 +123,86 @@ def pack(runtime_env: dict | None, rt) -> dict | None:
             for m in env["py_modules"]
         ]
     return env
+
+
+def normalize_pip_spec(spec) -> dict:
+    """Canonical pip spec (reference: pip.py accepts a list of
+    requirements or {"packages": [...], ...}). Driver-side validation so
+    bad specs fail at submit, not on a worker."""
+    if isinstance(spec, (list, tuple)):
+        spec = {"packages": list(spec)}
+    if not isinstance(spec, dict) or not spec.get("packages"):
+        raise ValueError(
+            "runtime_env['pip'] must be a list of requirements or "
+            "{'packages': [...], 'find_links': dir, 'index_url': url}")
+    out = {"packages": [str(p) for p in spec["packages"]]}
+    for key in ("find_links", "index_url"):
+        if spec.get(key):
+            out[key] = str(spec[key])
+    return out
+
+
+def _pip_env_dir(spec: dict, cache_dir: str,
+                 find_links_path: "str | None" = None) -> str:
+    """Install the spec's packages into a content-hashed --target dir,
+    once per node (reference: pip.py building one virtualenv per env
+    hash; here a path-scoped package dir — same caching contract).
+    Zero-egress default: --no-index unless the spec names an index, so
+    resolution comes from local wheel dirs (find_links)."""
+    import subprocess
+
+    key = hashlib.sha256(repr(sorted(spec.items())).encode()).hexdigest()[:24]
+    target = os.path.join(cache_dir, "pip_envs", key)
+    marker = target + ".ok"
+    if os.path.exists(marker):
+        return target
+    os.makedirs(os.path.dirname(target), exist_ok=True)
+    lock_path = target + ".lock"
+    # One installer per node: concurrent workers serialize on the lock
+    # file; losers find the marker and return.
+    import fcntl
+
+    with open(lock_path, "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)
+        try:
+            if os.path.exists(marker):
+                return target
+            # Install into a scratch dir and atomically rename (same
+            # recipe as _materialize): a worker killed mid-install must
+            # not leave a partial tree that a retrying pip would keep
+            # (pip without --upgrade refuses to replace existing package
+            # dirs, rc 0) and the marker would then cement.
+            import shutil
+
+            tmp = target + f".tmp{os.getpid()}"
+            shutil.rmtree(tmp, ignore_errors=True)
+            cmd = [sys.executable, "-m", "pip", "install", "--quiet",
+                   "--no-cache-dir", "--target", tmp]
+            if spec.get("index_url"):
+                cmd += ["--index-url", spec["index_url"]]
+            else:
+                cmd += ["--no-index"]
+            if find_links_path or spec.get("find_links"):
+                cmd += ["--find-links",
+                        find_links_path or spec["find_links"]]
+            cmd += spec["packages"]
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=600)
+            if proc.returncode != 0:
+                shutil.rmtree(tmp, ignore_errors=True)
+                raise RuntimeError(
+                    f"runtime_env pip install failed "
+                    f"(rc={proc.returncode}): {proc.stderr[-2000:]}\n"
+                    f"(zero-egress default is --no-index: provide "
+                    f"'find_links' with local wheels, or an explicit "
+                    f"'index_url')")
+            shutil.rmtree(target, ignore_errors=True)
+            os.rename(tmp, target)
+            with open(marker, "w") as f:
+                f.write("ok")
+        finally:
+            fcntl.flock(lock, fcntl.LOCK_UN)
+    return target
 
 
 class AppliedEnv:
@@ -119,8 +227,27 @@ class AppliedEnv:
             target = _materialize(uri, rt, cache_dir)
             sys.path.insert(0, target)
             self._added_paths.append(target)
+        pip_spec = runtime_env.get("pip")
+        if pip_spec:
+            spec = normalize_pip_spec(pip_spec)
+            fl = spec.get("find_links")
+            if fl and fl.startswith("pkg:"):
+                # KV-hosted wheel dir: extract locally, install from it.
+                # The env-dir hash stays keyed on the URI (stable across
+                # nodes); only the pip command sees the local path.
+                local = _materialize(fl, rt, cache_dir)
+                target = _pip_env_dir(spec, cache_dir, find_links_path=local)
+            else:
+                target = _pip_env_dir(spec, cache_dir)
+            sys.path.insert(0, target)
+            self._added_paths.append(target)
 
     def undo(self) -> None:
+        # Path scoping is exact; MODULES a task imported stay cached in
+        # sys.modules (one interpreter per worker — the reference gets
+        # stricter isolation from per-process virtualenvs). Conflicting
+        # package VERSIONS across envs in one worker should use
+        # dedicated actors.
         if self._saved_cwd is not None:
             try:
                 os.chdir(self._saved_cwd)

@@ -166,13 +166,18 @@ class Worker:
         sys._current_frames(), fold into collapsed-stack counts
         (flamegraph input format), and cast the aggregate back to the
         head. Pure-Python py-spy analogue — no ptrace, no py-spy
-        dependency (reference: profile_manager.py:191)."""
+        dependency (reference: profile_manager.py:191). mode="memory"
+        instead traces allocations for the window via tracemalloc (the
+        memray-attach analogue, profile_manager.py memory profiling)."""
         import collections as _collections
         import time as _time
         import traceback as _traceback
 
         duration = min(30.0, max(0.1, float(body.get("duration_s", 5.0))))
         hz = min(200, max(1, int(body.get("hz", 50))))
+        if body.get("mode") == "memory":
+            self._sample_memory(body, duration)
+            return
         me = threading.get_ident()
         folded: _collections.Counter = _collections.Counter()
         samples = 0
@@ -198,6 +203,43 @@ class Worker:
                 "hz": hz,
                 # Top 500 folded stacks: "file:func;file:func;..." -> hits.
                 "folded": dict(folded.most_common(500)),
+            })
+        except Exception:
+            pass
+
+    def _sample_memory(self, body: dict, duration: float) -> None:
+        """Allocation tracing for one window: tracemalloc on, wait,
+        snapshot, report the top allocating stacks (bytes + counts)."""
+        import time as _time
+        import tracemalloc
+
+        was_tracing = tracemalloc.is_tracing()
+        if not was_tracing:
+            tracemalloc.start(16)
+        try:
+            base = tracemalloc.take_snapshot()
+            _time.sleep(duration)
+            snap = tracemalloc.take_snapshot()
+            stats = snap.compare_to(base, "traceback")
+            folded = {}
+            for st in stats[:200]:
+                if st.size_diff <= 0:
+                    continue
+                key = ";".join(
+                    f"{os.path.basename(f.filename)}:{f.lineno}"
+                    for f in reversed(st.traceback))
+                folded[key] = {"bytes": st.size_diff,
+                               "count": st.count_diff}
+        finally:
+            if not was_tracing:
+                tracemalloc.stop()
+        try:
+            self.runtime.conn.cast("profile_result", {
+                "req_id": body.get("req_id"),
+                "worker_id": self.worker_id,
+                "mode": "memory",
+                "duration_s": duration,
+                "allocations": folded,
             })
         except Exception:
             pass
@@ -460,7 +502,9 @@ class Worker:
             # must store a TaskError into the return ids like any other
             # task failure (or the driver's get would hang forever).
             if spec.runtime_env and (
-                spec.runtime_env.get("working_dir") or spec.runtime_env.get("py_modules")
+                spec.runtime_env.get("working_dir")
+                or spec.runtime_env.get("py_modules")
+                or spec.runtime_env.get("pip")
             ):
                 from ray_tpu._private.runtime_env import AppliedEnv
 

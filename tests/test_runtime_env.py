@@ -99,13 +99,62 @@ def test_actor_keeps_working_dir(tmp_path):
     ray_tpu.kill(a)
 
 
-def test_pip_rejected():
-    @ray_tpu.remote(runtime_env={"pip": ["requests"]})
+def test_conda_rejected():
+    @ray_tpu.remote(runtime_env={"conda": {"dependencies": ["requests"]}})
     def f():
         return 1
 
-    with pytest.raises(ValueError, match="egress"):
+    with pytest.raises(ValueError, match="conda"):
         f.remote()
+
+
+def _make_wheel(dist_dir) -> None:
+    """Minimal hand-built wheel (a zip with dist-info): lets the pip
+    runtime env be exercised fully OFFLINE — no index, no network."""
+    import zipfile
+
+    di = "testpkg_rt-1.0.dist-info"
+    with zipfile.ZipFile(dist_dir / "testpkg_rt-1.0-py3-none-any.whl",
+                         "w") as zf:
+        zf.writestr("testpkg_rt/__init__.py", "VALUE = 2026\n")
+        zf.writestr(f"{di}/METADATA",
+                    "Metadata-Version: 2.1\nName: testpkg-rt\n"
+                    "Version: 1.0\n")
+        zf.writestr(f"{di}/WHEEL",
+                    "Wheel-Version: 1.0\nGenerator: test\n"
+                    "Root-Is-Purelib: true\nTag: py3-none-any\n")
+        zf.writestr(f"{di}/RECORD", "")
+
+
+def test_pip_env_from_local_wheels(tmp_path):
+    """runtime_env['pip'] with find_links (reference: runtime_env/pip.py
+    — here --no-index by default, resolving from a local wheel dir that
+    ships through the cluster KV)."""
+    wheels = tmp_path / "wheels"
+    wheels.mkdir()
+    _make_wheel(wheels)
+
+    @ray_tpu.remote(runtime_env={"pip": {"packages": ["testpkg-rt"],
+                                         "find_links": str(wheels)}})
+    def use():
+        import testpkg_rt
+
+        return testpkg_rt.VALUE
+
+    assert ray_tpu.get(use.remote(), timeout=120) == 2026
+    # The cached env dir is reused: a second task is fast (no reinstall).
+    assert ray_tpu.get(use.remote(), timeout=30) == 2026
+
+
+def test_pip_env_unresolvable_fails_loudly():
+    """Zero-egress default: a package with no local wheel fails with a
+    pointer at find_links/index_url, not a hang."""
+    @ray_tpu.remote(runtime_env={"pip": ["definitely-not-a-real-pkg-xyz"]})
+    def f():
+        return 1
+
+    with pytest.raises(Exception, match="no-index|find_links|pip"):
+        ray_tpu.get(f.remote(), timeout=120)
 
 
 def test_actor_keeps_env_vars():
@@ -158,9 +207,9 @@ def test_init_runtime_env_failure_cleans_up():
 
     if ray_tpu.is_initialized():
         ray_tpu.shutdown()
-    with pytest.raises(ValueError, match="pip"):
+    with pytest.raises(ValueError, match="conda"):
         ray_tpu.init(num_cpus=1, object_store_memory=32 * 1024 * 1024,
-                     runtime_env={"pip": ["requests"]})
+                     runtime_env={"conda": ["requests"]})
     assert not ray_tpu.is_initialized()
     # A corrected retry works.
     ray_tpu.init(num_cpus=1, object_store_memory=32 * 1024 * 1024)
