@@ -1557,7 +1557,8 @@ class Head:
                 try:
                     wconn.cast("profile_start", {
                         "req_id": req_id, "duration_s": sample_s,
-                        "hz": int(body.get("hz") or 50)})
+                        "hz": int(body.get("hz") or 50),
+                        "mode": body.get("mode") or "cpu"})
                     if not ev.wait(sample_s + 10.0):
                         return {"worker_id": worker_id,
                                 "error": "sampling timed out"}

@@ -63,6 +63,8 @@ def _profile_worker(worker_id: str, query: "dict | None" = None) -> dict:
     if q.get("duration"):
         body["sample_s"] = float(q["duration"])
         body["hz"] = int(q.get("hz", 50))
+        if q.get("mode"):
+            body["mode"] = q["mode"]  # "cpu" (default) | "memory"
     timeout = 15 + float(body.get("sample_s") or 0)
     return global_runtime().conn.call("profile_worker", body,
                                       timeout=timeout)

@@ -186,3 +186,45 @@ def test_dashboard_sampling_profiler():
         stop_dashboard()
         ray_tpu.get(fut, timeout=30)
         ray_tpu.kill(b)
+
+
+def test_dashboard_memory_profiler():
+    """?duration=N&mode=memory: tracemalloc allocation tracing for the
+    window (reference: profile_manager.py memray attach)."""
+    import time
+
+    from ray_tpu._private.worker_context import get_head
+    from ray_tpu.dashboard import start_dashboard, stop_dashboard
+
+    @ray_tpu.remote
+    class Alloc:
+        def churn(self, seconds):
+            t0 = time.time()
+            keep = []
+            while time.time() - t0 < seconds:
+                keep.append(bytes(64 * 1024))
+                if len(keep) > 64:
+                    keep.pop(0)
+            return len(keep)
+
+        def ping(self):
+            return 1
+
+    a = Alloc.remote()
+    ray_tpu.get(a.ping.remote(), timeout=30)
+    fut = a.churn.remote(5.0)
+    time.sleep(0.3)
+    head = get_head()
+    worker_id = next(w.worker_id for w in head.workers.values()
+                     if w.actor_id == a._actor_id and w.proc is not None)
+    port = start_dashboard()
+    try:
+        out = _get(port,
+                   f"/api/profile/{worker_id}?duration=1.5&mode=memory")
+        allocs = out.get("allocations") or {}
+        assert allocs, out
+        assert sum(v["bytes"] for v in allocs.values()) > 64 * 1024
+    finally:
+        stop_dashboard()
+        ray_tpu.get(fut, timeout=30)
+        ray_tpu.kill(a)
