@@ -141,8 +141,8 @@ def blockwise_attention(q, k, v, *, causal: bool = True, block_k: int = 512,
 # ---------------------------------------------------------------------------
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
-                      *, block_q, block_k, n_k, causal, scale):
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
+                      acc_ref, *, block_q, block_k, n_k, causal, scale):
     import jax.experimental.pallas as pl
 
     q_blk = pl.program_id(1)
@@ -194,9 +194,15 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
     @pl.when(k_blk == n_k - 1)
     def _emit():
         o_ref[0] = (acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)).astype(o_ref.dtype)
+        # logsumexp row statistic: the backward kernels reconstruct the
+        # NORMALIZED probabilities as exp(s - lse) without re-running the
+        # online softmax.
+        lse_ref[0] = (m_ref[:] +
+                      jnp.log(jnp.maximum(l_ref[:], 1e-30)))[:, 0]
 
 
-def _flash_forward(q, k, v, *, causal, block_q, block_k, interpret):
+def _flash_forward(q, k, v, *, causal, block_q, block_k, interpret,
+                   return_lse: bool = False):
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -217,7 +223,7 @@ def _flash_forward(q, k, v, *, causal, block_q, block_k, interpret):
         _flash_fwd_kernel, block_q=block_q, block_k=block_k, n_k=n_k,
         causal=causal, scale=scale,
     )
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(bh, n_q, n_k),
         in_specs=[
@@ -225,8 +231,14 @@ def _flash_forward(q, k, v, *, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, block_k, d), lambda b_, i, j: (b_, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b_, i, j: (b_, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b_, i, j: (b_, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b_, i, j: (b_, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b_, i, j: (b_, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, tq), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -234,7 +246,188 @@ def _flash_forward(q, k, v, *, causal, block_q, block_k, interpret):
         ],
         interpret=interpret,
     )(qf, kf, vf)
-    return out.reshape(b, h, tq, d).transpose(0, 2, 1, 3)
+    out = out.reshape(b, h, tq, d).transpose(0, 2, 1, 3)
+    return (out, lse) if return_lse else out
+
+
+# ---------------------------------------------------------------------------
+# Pallas flash-attention backward kernels.
+#
+# Standard flash backward (FlashAttention-2 style): with the forward's
+# logsumexp L and delta = rowsum(dO * O), for each (q, k) block pair
+#   p  = exp(s - L)                 (normalized probabilities, recomputed)
+#   dv += p^T dO
+#   dp = dO V^T
+#   ds = p * (dp - delta) * scale
+#   dq += ds K ;  dk += ds^T Q
+# Two kernels: dq accumulates over key blocks (grid b,i,j — the forward's
+# layout), dk/dv accumulate over query blocks (grid b,j,i). O(T) memory;
+# the O(T^2) probabilities exist only as VMEM tiles.
+# ---------------------------------------------------------------------------
+
+
+def _bwd_block(q, k, v, g, lse, delta, *, q_blk, k_blk, block_q, block_k,
+               causal, scale):
+    """Shared per-tile math: returns (ds [bq,bk] f32, p [bq,bk] f32)."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale
+    mask = None
+    if causal:
+        q_pos = q_blk * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_pos = k_blk * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = q_pos >= k_pos
+        s = jnp.where(mask, s, _NEG_INF)
+    p = jnp.exp(s - lse[:, None])
+    if mask is not None:
+        p = jnp.where(mask, p, 0.0)
+    dp = jax.lax.dot_general(
+        g, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+    ds = p * (dp - delta[:, None]) * scale
+    return ds, p
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+                         dq_ref, acc_ref, *, block_q, block_k, n_k, causal,
+                         scale):
+    import jax.experimental.pallas as pl
+
+    q_blk = pl.program_id(1)
+    k_blk = pl.program_id(2)
+
+    @pl.when(k_blk == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    def _compute():
+        ds, _ = _bwd_block(
+            q_ref[0], k_ref[0], v_ref[0].astype(jnp.float32),
+            g_ref[0].astype(jnp.float32), lse_ref[0], delta_ref[0],
+            q_blk=q_blk, k_blk=k_blk, block_q=block_q, block_k=block_k,
+            causal=causal, scale=scale)
+        acc_ref[:] += jax.lax.dot_general(
+            ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(k_blk * block_k <= q_blk * block_q + block_q - 1)
+        def _():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(k_blk == n_k - 1)
+    def _emit():
+        dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, dk_acc, dv_acc, *, block_q,
+                          block_k, n_q, causal, scale):
+    import jax.experimental.pallas as pl
+
+    k_blk = pl.program_id(1)
+    q_blk = pl.program_id(2)
+
+    @pl.when(q_blk == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    def _compute():
+        g = g_ref[0].astype(jnp.float32)
+        ds, p = _bwd_block(
+            q_ref[0], k_ref[0], v_ref[0].astype(jnp.float32), g,
+            lse_ref[0], delta_ref[0],
+            q_blk=q_blk, k_blk=k_blk, block_q=block_q, block_k=block_k,
+            causal=causal, scale=scale)
+        dv_acc[:] += jax.lax.dot_general(
+            p, g, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dk_acc[:] += jax.lax.dot_general(
+            ds, q_ref[0].astype(jnp.float32), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        # Skip query blocks entirely ABOVE the diagonal for this key
+        # block (no query there attends to these keys).
+        @pl.when(q_blk * block_q + block_q - 1 >= k_blk * block_k)
+        def _():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(q_blk == n_q - 1)
+    def _emit():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, out, lse, g, *, causal, block_q, block_k,
+                    interpret):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    scale = 1.0 / math.sqrt(d)
+    bh = b * h
+    flat = lambda x, t: x.transpose(0, 2, 1, 3).reshape(bh, t, d)  # noqa: E731
+    qf, gf, of = flat(q, tq), flat(g, tq), flat(out, tq)
+    kf, vf = flat(k, tk), flat(v, tk)
+    block_q = min(block_q, tq)
+    block_k = min(block_k, tk)
+    n_q, n_k = tq // block_q, tk // block_k
+    # delta = rowsum(dO * O): one fused elementwise pass in XLA.
+    delta = (gf.astype(jnp.float32) * of.astype(jnp.float32)).sum(-1)
+
+    common = dict(block_q=block_q, block_k=block_k, causal=causal,
+                  scale=scale)
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, n_k=n_k, **common),
+        grid=(bh, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b_, i, j: (b_, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b_, i, j: (b_, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b_, i, j: (b_, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b_, i, j: (b_, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b_, i, j: (b_, i)),
+            pl.BlockSpec((1, block_q), lambda b_, i, j: (b_, i)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b_, i, j: (b_, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(qf, kf, vf, gf, lse, delta)
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, n_q=n_q, **common),
+        grid=(bh, n_k, n_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b_, j, i: (b_, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b_, j, i: (b_, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b_, j, i: (b_, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b_, j, i: (b_, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b_, j, i: (b_, i)),
+            pl.BlockSpec((1, block_q), lambda b_, j, i: (b_, i)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b_, j, i: (b_, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b_, j, i: (b_, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, tk, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, gf, lse, delta)
+    unflat = lambda x, t: x.reshape(b, h, t, d).transpose(0, 2, 1, 3)  # noqa: E731
+    return unflat(dq, tq), unflat(dk, tk), unflat(dv, tk)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
@@ -242,8 +435,9 @@ def flash_attention(q, k, v, causal: bool = True, block_q: int = 256,
                     block_k: int = 256):
     """Pallas flash attention (TPU kernel; interpreter on CPU).
 
-    Training through it is supported: backward runs the O(T)-memory
-    blockwise path under autodiff (recompute, flash-style).
+    Training runs the Pallas BACKWARD kernels (dq pass + dk/dv pass,
+    probabilities recomputed per tile from the saved logsumexp): O(T)
+    memory end to end, no XLA recompute graph.
     """
     interpret = jax.devices()[0].platform != "tpu"
     return _flash_forward(
@@ -253,18 +447,21 @@ def flash_attention(q, k, v, causal: bool = True, block_q: int = 256,
 
 
 def _flash_fwd_rule(q, k, v, causal, block_q, block_k):
-    out = flash_attention(q, k, v, causal, block_q, block_k)
-    return out, (q, k, v)
+    interpret = jax.devices()[0].platform != "tpu"
+    out, lse = _flash_forward(
+        q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+        interpret=interpret, return_lse=True,
+    )
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd_rule(causal, block_q, block_k, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: blockwise_attention(q_, k_, v_, causal=causal,
-                                               block_k=block_k),
-        q, k, v,
+    q, k, v, out, lse = res
+    interpret = jax.devices()[0].platform != "tpu"
+    return _flash_backward(
+        q, k, v, out, lse, g, causal=causal, block_q=block_q,
+        block_k=block_k, interpret=interpret,
     )
-    return vjp(g)
 
 
 flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
