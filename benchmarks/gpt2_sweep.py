@@ -22,8 +22,9 @@ import optax
 def run(batch=24, seq=1024, steps=10, **cfg_kw):
     from ray_tpu import models
 
-    cfg = models.gpt2_small(max_seq_len=seq, remat=False, scan_layers=False,
-                            **cfg_kw)
+    cfg_kw.setdefault("remat", False)
+    cfg_kw.setdefault("scan_layers", False)
+    cfg = models.gpt2_small(max_seq_len=seq, **cfg_kw)
     opt = optax.chain(optax.clip_by_global_norm(1.0),
                       optax.adamw(3e-4, weight_decay=0.1))
     state = models.init_train_state(jax.random.PRNGKey(0), cfg, opt)
@@ -57,6 +58,18 @@ def main():
         dict(loss_chunk=2048, vocab_size=50304),
         dict(batch=28, loss_chunk=4096, vocab_size=50304),
         dict(batch=20, loss_chunk=4096, vocab_size=50304),
+        # Flash with the PALLAS BACKWARD kernels (round 3): the earlier
+        # T=1024 loss to plain attention was measured with the XLA
+        # blockwise backward — the kernel backward changes the math.
+        dict(loss_chunk=4096, vocab_size=50304, attn_impl="flash"),
+        dict(batch=28, loss_chunk=4096, vocab_size=50304,
+             attn_impl="flash"),
+        dict(batch=32, loss_chunk=4096, vocab_size=50304,
+             attn_impl="flash"),
+        # Flash frees the score buffers: remat may stop paying for
+        # itself — re-check the no-remat choice at the bigger batch.
+        dict(batch=32, loss_chunk=4096, vocab_size=50304,
+             attn_impl="flash", remat=True),
     ]
     if args.quick:
         grid = grid[:2]

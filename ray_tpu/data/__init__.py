@@ -23,9 +23,12 @@ from ray_tpu.data.dataset import (
     read_datasource,
     read_csv,
     read_json,
+    read_images,
     read_numpy,
     read_parquet,
+    read_sql,
     read_text,
+    read_tfrecords,
 )
 
 __all__ = [
@@ -49,7 +52,10 @@ __all__ = [
     "ReadTask",
     "read_csv",
     "read_json",
+    "read_images",
     "read_numpy",
     "read_parquet",
+    "read_sql",
     "read_text",
+    "read_tfrecords",
 ]

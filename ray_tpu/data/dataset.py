@@ -278,6 +278,9 @@ class Dataset:
     def write_json(self, path: str) -> list[str]:
         return self._write(path, ds_mod.write_json_block)
 
+    def write_tfrecords(self, path: str) -> list[str]:
+        return self._write(path, ds_mod.write_tfrecord_block)
+
     # -- train integration -------------------------------------------------
 
     def split(self, n: int) -> list["Dataset"]:
@@ -450,6 +453,28 @@ def read_numpy(paths) -> Dataset:
 
 def read_binary_files(paths, *, include_paths: bool = False) -> Dataset:
     return Dataset([Read(tasks=ds_mod.binary_tasks(paths, include_paths=include_paths))])
+
+
+def read_tfrecords(paths) -> Dataset:
+    """TFRecord files of tf.train.Example records, decoded WITHOUT a
+    TensorFlow dependency (reference: read_tfrecords, read_api.py)."""
+    return Dataset([Read(tasks=ds_mod.tfrecord_tasks(paths))])
+
+
+def read_sql(sql: str, connection_factory) -> Dataset:
+    """Rows from a DB-API query (reference: read_sql,
+    datasource/sql_datasource.py). ``connection_factory`` is a zero-arg
+    callable returning a fresh connection (picklable, runs on the
+    executing worker)."""
+    return Dataset([Read(tasks=ds_mod.sql_tasks(sql, connection_factory))])
+
+
+def read_images(paths, *, size: "tuple | None" = None, mode: str = "RGB",
+                include_paths: bool = False) -> Dataset:
+    """Decoded image arrays via Pillow (reference: read_images,
+    datasource/image_datasource.py)."""
+    return Dataset([Read(tasks=ds_mod.image_tasks(
+        paths, size=size, mode=mode, include_paths=include_paths))])
 
 
 def from_huggingface(hf_dataset) -> Dataset:

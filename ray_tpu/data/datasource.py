@@ -154,7 +154,287 @@ def binary_tasks(paths, *, include_paths: bool = False) -> list[ReadTask]:
     return _file_tasks(paths, read)
 
 
+# -- TFRecord (pure Python: framing + tf.train.Example codec) ---------------
+#
+# Reference: data read_tfrecords/write_tfrecords (read_api.py), which lean
+# on TensorFlow. TPU-natively TF is not a dependency, so both the record
+# framing (length + masked CRC32C) and the tf.train.Example protobuf are
+# implemented directly — the format is small and stable.
+
+_CRC32C_TABLE = None
+_NATIVE_CRC32C = None
+
+
+def _crc32c(data: bytes) -> int:
+    global _CRC32C_TABLE, _NATIVE_CRC32C
+    if _NATIVE_CRC32C is None:
+        # Per-byte Python CRC is the write-path bottleneck on big
+        # datasets: prefer a native implementation when one is baked in.
+        try:
+            import crc32c as _c  # type: ignore
+
+            _NATIVE_CRC32C = _c.crc32c
+        except ImportError:
+            try:
+                import google_crc32c as _g  # type: ignore
+
+                _NATIVE_CRC32C = lambda d: int.from_bytes(  # noqa: E731
+                    _g.Checksum(d).digest(), "big")
+            except ImportError:
+                _NATIVE_CRC32C = False
+    if _NATIVE_CRC32C:
+        return _NATIVE_CRC32C(data)
+    if _CRC32C_TABLE is None:
+        table = []
+        for i in range(256):
+            c = i
+            for _ in range(8):
+                c = (c >> 1) ^ 0x82F63B78 if c & 1 else c >> 1
+            table.append(c)
+        _CRC32C_TABLE = table
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = _CRC32C_TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = _crc32c(data)
+    return ((crc >> 15 | crc << 17) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        out.append(b | (0x80 if n else 0))
+        if not n:
+            return bytes(out)
+
+
+def _read_varint(buf: bytes, pos: int) -> tuple[int, int]:
+    shift = n = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        n |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return n, pos
+        shift += 7
+
+
+def _ld(field: int, payload: bytes) -> bytes:  # length-delimited field
+    return _varint(field << 3 | 2) + _varint(len(payload)) + payload
+
+
+def encode_example(row: dict) -> bytes:
+    """dict -> serialized tf.train.Example. Columns map to the standard
+    feature kinds: bytes/str -> bytes_list, floats -> float_list (packed
+    f32), ints -> int64_list (packed varints)."""
+    import struct
+
+    feats = b""
+    for key, val in row.items():
+        arr = np.atleast_1d(np.asarray(val))
+        if arr.dtype.kind in ("S", "U", "O"):
+            payload = b"".join(
+                _ld(1, v if isinstance(v, bytes) else str(v).encode())
+                for v in arr.tolist())
+            feature = _ld(1, payload)  # Feature.bytes_list
+        elif arr.dtype.kind == "f":
+            packed = struct.pack(f"<{arr.size}f",
+                                 *arr.astype(np.float32).ravel().tolist())
+            feature = _ld(2, _ld(1, packed))  # Feature.float_list (packed)
+        else:
+            packed = b"".join(_varint(int(v) & (1 << 64) - 1)
+                              for v in arr.ravel().tolist())
+            feature = _ld(3, _ld(1, packed))  # Feature.int64_list (packed)
+        entry = _ld(1, key.encode()) + _ld(2, feature)
+        feats += _ld(1, entry)  # Features.feature map entry
+    return _ld(1, feats)  # Example.features
+
+
+def decode_example(data: bytes) -> dict:
+    """Serialized tf.train.Example -> {name: list} feature dict."""
+    import struct
+
+    def fields(buf):
+        pos = 0
+        while pos < len(buf):
+            tag, pos = _read_varint(buf, pos)
+            field, wire = tag >> 3, tag & 7
+            if wire == 2:
+                ln, pos = _read_varint(buf, pos)
+                yield field, buf[pos:pos + ln]
+                pos += ln
+            elif wire == 0:
+                v, pos = _read_varint(buf, pos)
+                yield field, v
+            elif wire == 5:
+                yield field, buf[pos:pos + 4]
+                pos += 4
+            else:  # pragma: no cover - not produced by Example
+                raise ValueError(f"unsupported wire type {wire}")
+
+    out: dict = {}
+    for f1, features in fields(data):
+        if f1 != 1:
+            continue
+        for f2, entry in fields(features):
+            if f2 != 1:
+                continue
+            name, feature = None, b""
+            for f3, v in fields(entry):
+                if f3 == 1:
+                    name = v.decode()
+                elif f3 == 2:
+                    feature = v
+            values: list = []
+            for kind, payload in fields(feature):
+                if kind == 1:  # bytes_list
+                    values = [v for f, v in fields(payload) if f == 1]
+                elif kind == 2:  # float_list
+                    floats: list = []
+                    for f, v in fields(payload):
+                        if isinstance(v, bytes) and len(v) % 4 == 0:
+                            floats.extend(
+                                struct.unpack(f"<{len(v) // 4}f", v))
+                        elif isinstance(v, bytes):
+                            floats.append(struct.unpack("<f", v)[0])
+                    values = floats
+                elif kind == 3:  # int64_list
+                    def signed(n: int) -> int:
+                        return n - (1 << 64) if n >= 1 << 63 else n
+
+                    ints: list = []
+                    for f, v in fields(payload):
+                        if isinstance(v, bytes):  # packed
+                            pos = 0
+                            while pos < len(v):
+                                n, pos = _read_varint(v, pos)
+                                ints.append(signed(n))
+                        else:  # unpacked varint (equally valid wire form)
+                            ints.append(signed(v))
+                    values = ints
+            if name is not None:
+                out[name] = values
+    return out
+
+
+def tfrecord_tasks(paths) -> list[ReadTask]:
+    def read(path):
+        rows = []
+        with open(path, "rb") as f:
+            while True:
+                head = f.read(12)
+                if not head:
+                    break
+                if len(head) < 12:
+                    raise ValueError(
+                        f"truncated TFRecord header in {path!r} at "
+                        f"offset {f.tell() - len(head)}")
+                (length,) = np.frombuffer(head[:8], "<u8")
+                (len_crc,) = np.frombuffer(head[8:], "<u4")
+                if int(len_crc) != _masked_crc(head[:8]):
+                    raise ValueError(
+                        f"corrupt TFRecord length CRC in {path!r} at "
+                        f"offset {f.tell() - 12}")
+                data = f.read(int(length))
+                tail = f.read(4)
+                if len(data) < int(length) or len(tail) < 4:
+                    raise ValueError(
+                        f"truncated TFRecord data in {path!r} "
+                        f"(wanted {int(length)} bytes)")
+                (data_crc,) = np.frombuffer(tail, "<u4")
+                if int(data_crc) != _masked_crc(data):
+                    raise ValueError(
+                        f"corrupt TFRecord data CRC in {path!r}")
+                ex = decode_example(data)
+                rows.append({k: (v[0] if len(v) == 1 else v)
+                             for k, v in ex.items()})
+        if rows:
+            from ray_tpu.data.block import BlockAccessor
+
+            # Examples may carry sparse/optional features: normalize to
+            # the UNION of keys (missing -> None) before columnizing.
+            keys = sorted({k for r in rows for k in r})
+            yield BlockAccessor.from_rows(
+                [{k: r.get(k) for k in keys} for r in rows])
+
+    return _file_tasks(paths, read)
+
+
+def sql_tasks(sql: str, connection_factory) -> list[ReadTask]:
+    """One task running the query through a DB-API connection factory
+    (reference: data read_sql, datasource/sql_datasource.py — the
+    factory pattern keeps connections picklable)."""
+    def read():
+        conn = connection_factory()
+        try:
+            cur = conn.cursor()
+            cur.execute(sql)
+            names = [d[0] for d in cur.description]
+            rows = cur.fetchall()
+        finally:
+            conn.close()
+        if rows:
+            from ray_tpu.data.block import BlockAccessor
+
+            yield BlockAccessor.from_rows(
+                [dict(zip(names, r)) for r in rows])
+
+    return [ReadTask(read)]  # row/byte counts unknown until the query runs
+
+
+_IMAGE_EXTS = (".png", ".jpg", ".jpeg", ".bmp", ".gif", ".webp", ".tif",
+               ".tiff")
+
+
+def image_tasks(paths, *, size: "tuple | None" = None,
+                mode: str = "RGB", include_paths: bool = False
+                ) -> list[ReadTask]:
+    """Decoded image arrays (reference: read_images,
+    datasource/image_datasource.py — which filters directories by image
+    extension for the same reason: one stray README must not abort the
+    read). Requires Pillow."""
+    def read(path):
+        from PIL import Image
+
+        img = Image.open(path).convert(mode)
+        if size is not None:
+            img = img.resize(size)
+        block = {"image": np.asarray(img)[None]}
+        if include_paths:
+            block["path"] = np.asarray([path], dtype=object)
+        yield block
+
+    files = [p for p in _expand_paths(paths)
+             if p.lower().endswith(_IMAGE_EXTS)]
+    if not files:
+        raise FileNotFoundError(f"no image files matched {paths!r}")
+    return _file_tasks(files, read)
+
+
 # -- writers ----------------------------------------------------------------
+
+def write_tfrecord_block(block: Block, path: str, idx: int) -> str:
+    from ray_tpu.data.block import BlockAccessor
+
+    os.makedirs(path, exist_ok=True)
+    out = os.path.join(path, f"part-{idx:06d}.tfrecords")
+    with open(out, "wb") as f:
+        for row in BlockAccessor(block).iter_rows():
+            if not isinstance(row, dict):
+                row = {"item": row}
+            data = encode_example(row)
+            head = np.uint64(len(data)).tobytes()
+            f.write(head)
+            f.write(np.uint32(_masked_crc(head)).tobytes())
+            f.write(data)
+            f.write(np.uint32(_masked_crc(data)).tobytes())
+    return out
+
 
 def write_parquet_block(block: Block, path: str, idx: int) -> str:
     import pyarrow.parquet as pq
