@@ -111,10 +111,48 @@ class WriteAheadLog:
         self.base = base_path
         self.seg = seg
         self._f = None
+        # Reopening after a crash: a frame torn mid-append would poison
+        # every LATER append (readers stop at the first bad frame), so
+        # truncate the segment to its valid prefix before appending.
+        self._repair(self._seg_path(seg))
         self._open()
 
     def _seg_path(self, seg: int) -> str:
         return f"{self.base}.wal.{seg}"
+
+    @staticmethod
+    def _scan(data: bytes) -> "tuple[list, int]":
+        """(decoded ops, length of the valid prefix). ONE validity rule
+        shared by repair and replay: a frame counts only if its CRC
+        matches AND it unpickles — a repair keeping frames that replay
+        rejects would strand every op appended after them."""
+        import struct
+        import zlib
+
+        ops: list = []
+        pos = 0
+        while pos + 8 <= len(data):
+            ln, crc = struct.unpack_from("<II", data, pos)
+            frame = data[pos + 8: pos + 8 + ln]
+            if len(frame) < ln or zlib.crc32(frame) != crc:
+                break
+            try:
+                ops.append(pickle.loads(frame))
+            except Exception:
+                break  # e.g. a zero-filled tail: ln=0/crc=0 is CRC-"valid"
+            pos += 8 + ln
+        return ops, pos
+
+    @staticmethod
+    def _repair(path: str) -> None:
+        if not os.path.exists(path):
+            return
+        with open(path, "rb") as f:
+            data = f.read()
+        _, valid = WriteAheadLog._scan(data)
+        if valid < len(data):
+            with open(path, "ab") as f:
+                f.truncate(valid)
 
     def _open(self) -> None:
         d = os.path.dirname(os.path.abspath(self.base)) or "."
@@ -177,9 +215,6 @@ class WriteAheadLog:
         counting up from from_seg: if the snapshot is unreadable
         (from_seg falls back to 0) the pre-compaction segments are gone,
         and a contiguous walk from 0 would silently find nothing."""
-        import struct
-        import zlib
-
         segs = WriteAheadLog.existing_segments(base_path)
         last_seg = max(segs, default=from_seg)
         ops: list = []
@@ -188,17 +223,8 @@ class WriteAheadLog:
                 continue
             with open(f"{base_path}.wal.{seg}", "rb") as f:
                 data = f.read()
-            pos = 0
-            while pos + 8 <= len(data):
-                ln, crc = struct.unpack_from("<II", data, pos)
-                frame = data[pos + 8: pos + 8 + ln]
-                if len(frame) < ln or zlib.crc32(frame) != crc:
-                    break  # torn tail: crash mid-append
-                try:
-                    ops.append(pickle.loads(frame))
-                except Exception:
-                    break
-                pos += 8 + ln
+            seg_ops, _ = WriteAheadLog._scan(data)
+            ops.extend(seg_ops)
         return ops, last_seg
 
 

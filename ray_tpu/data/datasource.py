@@ -350,17 +350,22 @@ def tfrecord_tasks(paths) -> list[ReadTask]:
                 if int(data_crc) != _masked_crc(data):
                     raise ValueError(
                         f"corrupt TFRecord data CRC in {path!r}")
-                ex = decode_example(data)
-                rows.append({k: (v[0] if len(v) == 1 else v)
-                             for k, v in ex.items()})
+                rows.append(decode_example(data))
         if rows:
             from ray_tpu.data.block import BlockAccessor
 
             # Examples may carry sparse/optional features: normalize to
-            # the UNION of keys (missing -> None) before columnizing.
+            # the UNION of keys (missing -> None). Collapse a feature to
+            # scalars only when EVERY record has exactly one value —
+            # per-column consistency, never scalar-vs-list mixed rows.
             keys = sorted({k for r in rows for k in r})
-            yield BlockAccessor.from_rows(
-                [{k: r.get(k) for k in keys} for r in rows])
+            scalar = {k: all(len(r[k]) == 1 for r in rows if k in r)
+                      for k in keys}
+            yield BlockAccessor.from_rows([
+                {k: (r[k][0] if scalar[k] else r.get(k))
+                 if k in r else None
+                 for k in keys}
+                for r in rows])
 
     return _file_tasks(paths, read)
 
@@ -403,7 +408,9 @@ def image_tasks(paths, *, size: "tuple | None" = None,
 
         img = Image.open(path).convert(mode)
         if size is not None:
-            img = img.resize(size)
+            # size is (height, width) — reference ImageDatasource
+            # convention; PIL's resize takes (width, height).
+            img = img.resize((size[1], size[0]))
         block = {"image": np.asarray(img)[None]}
         if include_paths:
             block["path"] = np.asarray([path], dtype=object)
@@ -419,6 +426,8 @@ def image_tasks(paths, *, size: "tuple | None" = None,
 # -- writers ----------------------------------------------------------------
 
 def write_tfrecord_block(block: Block, path: str, idx: int) -> str:
+    import struct
+
     from ray_tpu.data.block import BlockAccessor
 
     os.makedirs(path, exist_ok=True)
@@ -428,11 +437,13 @@ def write_tfrecord_block(block: Block, path: str, idx: int) -> str:
             if not isinstance(row, dict):
                 row = {"item": row}
             data = encode_example(row)
-            head = np.uint64(len(data)).tobytes()
+            # Explicit little-endian framing (the spec; native tobytes
+            # would byte-swap on BE hosts and fail the reader's CRCs).
+            head = struct.pack("<Q", len(data))
             f.write(head)
-            f.write(np.uint32(_masked_crc(head)).tobytes())
+            f.write(struct.pack("<I", _masked_crc(head)))
             f.write(data)
-            f.write(np.uint32(_masked_crc(data)).tobytes())
+            f.write(struct.pack("<I", _masked_crc(data)))
     return out
 
 

@@ -190,6 +190,18 @@ def test_tfrecords_sparse_features_and_unpacked_ints(tmp_path):
     assert [r["a"] for r in rows] == [1, 3]
     assert rows[0]["b"] == 2 and rows[1]["b"] is None
 
+    # Ragged list features stay LISTS for every record of the column
+    # (no scalar-vs-list mixing when some records have length 1).
+    out2 = tmp_path / "ragged.tfrecords"
+    with open(out2, "wb") as f:
+        for row in [{"ids": [7]}, {"ids": [3, 4]}]:
+            data = encode_example(row)
+            head = np.uint64(len(data)).tobytes()
+            f.write(head + np.uint32(_masked_crc(head)).tobytes())
+            f.write(data + np.uint32(_masked_crc(data)).tobytes())
+    ragged = list(rd.read_tfrecords(str(out2)).iter_rows())
+    assert ragged[0]["ids"] == [7] and ragged[1]["ids"] == [3, 4]
+
     # Corruption is loud, not silent.
     blob = out.read_bytes()
     (out.parent / "bad.tfrecords").write_bytes(blob[:-6])  # truncated

@@ -203,6 +203,57 @@ def test_wal_survives_kill_between_snapshots(tmp_path):
             head.kill()
 
 
+def test_wal_torn_tail_and_rotation(tmp_path):
+    """WriteAheadLog unit behavior: a frame torn mid-append (the crash
+    case) is dropped without losing earlier ops; rotation + prune keep
+    only segments a snapshot hasn't subsumed; discovery by directory
+    listing survives a missing low segment (unreadable-snapshot
+    recovery)."""
+    from ray_tpu._private.gcs_persistence import WriteAheadLog
+
+    base = str(tmp_path / "gcs.snap")
+    wal = WriteAheadLog(base)
+    wal.append(("kv_put", "", "a", b"1"))
+    wal.append(("kv_put", "", "b", b"2"))
+    seg1 = wal.rotate()
+    wal.append(("kv_del", "", "a"))
+    wal.close()
+
+    ops, last = WriteAheadLog.read_ops(base, 0)
+    assert [o[0] for o in ops] == ["kv_put", "kv_put", "kv_del"]
+    assert last == seg1
+
+    # Tear the tail of the newest segment mid-frame.
+    seg_path = f"{base}.wal.{seg1}"
+    blob = open(seg_path, "rb").read()
+    open(seg_path, "wb").write(blob[:-3])
+    ops, _ = WriteAheadLog.read_ops(base, 0)
+    assert [o[0] for o in ops] == ["kv_put", "kv_put"]  # torn op dropped
+
+    # Prune below the rotated segment (snapshot subsumed seg 0).
+    wal2 = WriteAheadLog(base, seg1)
+    wal2.prune_below(seg1)
+    wal2.append(("kv_put", "", "c", b"3"))
+    wal2.close()
+    assert not os.path.exists(f"{base}.wal.0")
+    # Unreadable-snapshot fallback (from_seg=0): listing finds the
+    # surviving high segment instead of walking up from a missing 0.
+    ops, last = WriteAheadLog.read_ops(base, 0)
+    assert ("kv_put", "", "c", b"3") in ops and last == seg1
+
+    # Zero-filled tail (power loss + size-before-data metadata): ln=0/
+    # crc=0 is CRC-"valid" but unpicklable. Repair must reject it too,
+    # or ops appended after reopen would be stranded behind it.
+    seg_path2 = f"{base}.wal.{seg1}"
+    with open(seg_path2, "ab") as f:
+        f.write(b"\x00" * 16)
+    wal3 = WriteAheadLog(base, seg1)  # repairs on open
+    wal3.append(("kv_put", "", "d", b"4"))
+    wal3.close()
+    ops, _ = WriteAheadLog.read_ops(base, 0)
+    assert ("kv_put", "", "d", b"4") in ops, ops
+
+
 def test_head_restart_readopts_node_agent(tmp_path):
     """A node agent survives the head restart: it re-registers under the
     same node_id and its resources are schedulable again."""

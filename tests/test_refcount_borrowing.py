@@ -162,6 +162,31 @@ def test_nested_arg_ref_survives_fire_and_forget(cluster):
     assert ray_tpu.get(fut, timeout=30) == "late"
 
 
+def test_borrow_churn_stress(cluster):
+    """Rapid borrow/release churn across workers: refs repeatedly
+    shipped nested, held briefly, dropped. Every object must survive
+    while referenced and the directory must converge to empty after —
+    no early frees (KeyError/ObjectLost) and no leaks."""
+
+    @ray_tpu.remote
+    def relay(container, i):
+        value = ray_tpu.get(container[0], timeout=30)
+        return value + i
+
+    refs = [ray_tpu.put(i * 100) for i in range(8)]
+    hexes = [r.hex() for r in refs]
+    # Comprehension scope: no loop variable survives to pin the last ref.
+    outs = [relay.remote([r], round_i)
+            for round_i in range(5) for r in refs]
+    values = ray_tpu.get(outs, timeout=60)
+    assert len(values) == 40
+    assert values[0] == 0 and values[-1] == 704
+    del refs, outs, values
+    gc.collect()
+    for h in hexes:
+        assert _wait_freed(h, timeout=20), f"leak: {h}"
+
+
 def test_borrow_released_on_borrower_death(cluster):
     """A worker process dying must implicitly release its borrows."""
 
