@@ -45,7 +45,10 @@ def run(profile_name: str) -> dict:
     import ray_tpu
 
     p = PROFILES[profile_name]
-    results: dict = {"profile": profile_name, "ncpu": os.cpu_count()}
+    # Box-state context: numbers on a shared 1-core box swing several-x
+    # with background load; recording it makes runs comparable.
+    results: dict = {"profile": profile_name, "ncpu": os.cpu_count(),
+                     "loadavg_1m": round(os.getloadavg()[0], 2)}
 
     ray_tpu.init(num_cpus=max(4, os.cpu_count() or 4),
                  object_store_memory=768 * 1024 * 1024)

@@ -73,18 +73,83 @@ class Connection:
         self._pending_lock = threading.Lock()
         self._next_id = 0
         self._closed = threading.Event()
+        # Async send plane: _send serializes the message immediately
+        # (snapshot semantics — callers may mutate the body after) but
+        # the socket write happens on this connection's writer thread.
+        # Senders holding big locks (the head's global lock during a
+        # dispatch pass) therefore never block on a slow peer's socket;
+        # profiling the 100k-task flood showed exactly that convoy:
+        # worker seal RPCs queuing behind dispatch's in-lock sendalls.
+        import collections as _collections
+
+        self._send_q: "_collections.deque[bytes]" = _collections.deque()
+        self._send_ev = threading.Event()
+        self._writer_idle = threading.Event()
+        self._writer_idle.set()
+        self._writer = threading.Thread(target=self._write_loop,
+                                        daemon=True,
+                                        name=f"rpc-write-{name}")
+        self._writer.start()
         self._reader = threading.Thread(target=self._read_loop, daemon=True, name=f"rpc-read-{name}")
         self._reader.start()
 
     # --- sending ---
 
+    _SEND_HIGH_WATER = 65536  # frames; past this, senders block (the
+    # backpressure the old synchronous sendall gave for free — without
+    # it a wedged peer grows the queue until the process OOMs)
+
     def _send(self, kind: str, msg_id: int, body: Any) -> None:
+        if self._closed.is_set():
+            raise ConnectionLost("connection closed")
         data = pickle.dumps((kind, msg_id, body), protocol=5)
-        with self._send_lock:
-            try:
-                self._sock.sendall(_HDR.pack(len(data)) + data)
-            except OSError as e:
-                raise ConnectionLost(str(e)) from e
+        while len(self._send_q) > self._SEND_HIGH_WATER:
+            if self._closed.is_set():
+                raise ConnectionLost("connection closed")
+            import time as _time
+
+            _time.sleep(0.001)
+        self._send_q.append(_HDR.pack(len(data)) + data)
+        self._send_ev.set()
+        if self._closed.is_set():
+            # _shutdown raced the append: the writer may already have
+            # exited, so this frame might never go out — surface it the
+            # way the old synchronous path did.
+            raise ConnectionLost("connection closed")
+
+    def _write_loop(self) -> None:
+        while True:
+            self._send_ev.wait()
+            self._send_ev.clear()
+            while self._send_q:
+                self._writer_idle.clear()
+                # Coalesce everything queued into ONE sendall: under
+                # backlog this amortizes the syscall and the thread
+                # handoff across many messages.
+                frames = []
+                while True:
+                    try:
+                        frames.append(self._send_q.popleft())
+                    except IndexError:
+                        break
+                try:
+                    with self._send_lock:
+                        self._sock.sendall(b"".join(frames))
+                except OSError:
+                    # Peer gone on the SEND side (the reader may still
+                    # be parked in recv): run the full teardown so
+                    # pending calls fail fast and on_close dead-peer
+                    # pruning fires, exactly like the old synchronous
+                    # ConnectionLost.
+                    self._send_q.clear()
+                    self._writer_idle.set()
+                    self._shutdown()
+                    return
+                finally:
+                    if not self._send_q:
+                        self._writer_idle.set()
+            if self._closed.is_set() and not self._send_q:
+                return
 
     def call(self, kind: str, body: dict | None = None, timeout: float | None = None) -> Any:
         """Request/response; raises RpcError on remote exception."""
@@ -189,6 +254,7 @@ class Connection:
         if self._closed.is_set():
             return
         self._closed.set()
+        self._send_ev.set()  # wake the writer so it can exit
         with self._pending_lock:
             pending = list(self._pending.values())
             self._pending.clear()
@@ -206,6 +272,17 @@ class Connection:
                 pass
 
     def close(self) -> None:
+        # Bounded drain: messages cast just before close (final
+        # read_done/del_ref notifications) should still go out — both
+        # the queued frames AND a batch the writer already popped and is
+        # mid-sendall on (writer_idle covers that window).
+        import time as _time
+
+        deadline = _time.monotonic() + 2.0
+        while ((self._send_q or not self._writer_idle.is_set())
+               and _time.monotonic() < deadline
+               and not self._closed.is_set()):
+            _time.sleep(0.005)
         try:
             self._sock.shutdown(socket.SHUT_RDWR)
         except OSError:

@@ -178,6 +178,25 @@ class Worker:
         if body.get("mode") == "memory":
             self._sample_memory(body, duration)
             return
+        include_idle = bool(body.get("include_idle", False))
+        # py-spy's default --idle=false: threads parked in a wait
+        # primitive tell you nothing about where time GOES and dilute
+        # the shares of the threads doing work (a process has a dozen
+        # service threads parked in recv/wait at any instant). C
+        # builtins (time.sleep, sock.recv_into) leave NO Python frame,
+        # so the filter matches both the pure-Python wait wrappers by
+        # leaf name AND blocking-call leaves by their source line.
+        _IDLE_LEAVES = {"wait", "_recv_exact", "accept", "select",
+                        "poll", "_wait_for_tstate_lock"}
+        _IDLE_CALLS = (".sleep(", ".wait(", ".recv(", ".recv_into(",
+                       ".accept(", ".select(", ".poll(", ".acquire(")
+
+        def _is_idle(leaf) -> bool:
+            if leaf.name in _IDLE_LEAVES:
+                return True
+            line = leaf.line or ""
+            return any(c in line for c in _IDLE_CALLS)
+
         me = threading.get_ident()
         folded: _collections.Counter = _collections.Counter()
         samples = 0
@@ -188,6 +207,8 @@ class Worker:
                     continue
                 stack = _traceback.extract_stack(frame)
                 if not stack:
+                    continue
+                if not include_idle and _is_idle(stack[-1]):
                     continue
                 folded[";".join(
                     f"{os.path.basename(f.filename)}:{f.name}"
