@@ -1558,7 +1558,8 @@ class Head:
                     wconn.cast("profile_start", {
                         "req_id": req_id, "duration_s": sample_s,
                         "hz": int(body.get("hz") or 50),
-                        "mode": body.get("mode") or "cpu"})
+                        "mode": body.get("mode") or "cpu",
+                        "include_idle": bool(body.get("include_idle"))})
                     if not ev.wait(sample_s + 10.0):
                         return {"worker_id": worker_id,
                                 "error": "sampling timed out"}

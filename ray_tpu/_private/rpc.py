@@ -68,7 +68,6 @@ class Connection:
         self._on_close = on_close
         self.name = name
         self.peer_info: dict = {}  # set during registration by the server
-        self._send_lock = threading.Lock()
         self._pending: dict[int, Future] = {}
         self._pending_lock = threading.Lock()
         self._next_id = 0
@@ -83,6 +82,12 @@ class Connection:
         import collections as _collections
 
         self._send_q: "_collections.deque[bytes]" = _collections.deque()
+        self._send_q_bytes = 0          # guarded by _sendq_lock
+        self._sendq_lock = threading.Lock()
+        # Signaled by the writer after it credits drained bytes, so
+        # senders blocked at the high-water mark wake exactly when
+        # space opens instead of sleep-polling.
+        self._sendq_drained = threading.Condition(self._sendq_lock)
         self._send_ev = threading.Event()
         self._writer_idle = threading.Event()
         self._writer_idle.set()
@@ -95,21 +100,25 @@ class Connection:
 
     # --- sending ---
 
-    _SEND_HIGH_WATER = 65536  # frames; past this, senders block (the
-    # backpressure the old synchronous sendall gave for free — without
-    # it a wedged peer grows the queue until the process OOMs)
+    _SEND_HIGH_WATER_BYTES = 64 << 20  # queued BYTES; past this,
+    # senders block (the backpressure the old synchronous sendall gave
+    # for free — without it a wedged peer reading nothing while large
+    # casts flow, e.g. pubsub fan-out of MB-sized payloads, grows the
+    # queue until the process OOMs; a frame count would not bound that)
 
     def _send(self, kind: str, msg_id: int, body: Any) -> None:
         if self._closed.is_set():
             raise ConnectionLost("connection closed")
         data = pickle.dumps((kind, msg_id, body), protocol=5)
-        while len(self._send_q) > self._SEND_HIGH_WATER:
+        frame = _HDR.pack(len(data)) + data
+        with self._sendq_lock:
+            while (self._send_q_bytes > self._SEND_HIGH_WATER_BYTES
+                   and not self._closed.is_set()):
+                self._sendq_drained.wait(timeout=1.0)
             if self._closed.is_set():
                 raise ConnectionLost("connection closed")
-            import time as _time
-
-            _time.sleep(0.001)
-        self._send_q.append(_HDR.pack(len(data)) + data)
+            self._send_q.append(frame)
+            self._send_q_bytes += len(frame)
         self._send_ev.set()
         if self._closed.is_set():
             # _shutdown raced the append: the writer may already have
@@ -127,27 +136,37 @@ class Connection:
                 # backlog this amortizes the syscall and the thread
                 # handoff across many messages.
                 frames = []
+                batch_bytes = 0
                 while True:
                     try:
-                        frames.append(self._send_q.popleft())
+                        f = self._send_q.popleft()
                     except IndexError:
                         break
+                    frames.append(f)
+                    batch_bytes += len(f)
                 try:
-                    with self._send_lock:
-                        self._sock.sendall(b"".join(frames))
+                    self._sock.sendall(b"".join(frames))
                 except OSError:
                     # Peer gone on the SEND side (the reader may still
                     # be parked in recv): run the full teardown so
                     # pending calls fail fast and on_close dead-peer
                     # pruning fires, exactly like the old synchronous
                     # ConnectionLost.
-                    self._send_q.clear()
+                    with self._sendq_lock:
+                        self._send_q.clear()
+                        self._send_q_bytes = 0
+                        self._sendq_drained.notify_all()
                     self._writer_idle.set()
                     self._shutdown()
                     return
-                finally:
-                    if not self._send_q:
-                        self._writer_idle.set()
+                # Credit the watermark only after the bytes hit the
+                # socket, so blocked senders stay coupled to actual
+                # drain progress, not just queue hand-off.
+                with self._sendq_lock:
+                    self._send_q_bytes -= batch_bytes
+                    self._sendq_drained.notify_all()
+                if not self._send_q:
+                    self._writer_idle.set()
             if self._closed.is_set() and not self._send_q:
                 return
 
@@ -255,6 +274,10 @@ class Connection:
             return
         self._closed.set()
         self._send_ev.set()  # wake the writer so it can exit
+        with self._sendq_lock:
+            # Wake senders parked at the high-water mark: the queue
+            # will never drain now, they must raise ConnectionLost.
+            self._sendq_drained.notify_all()
         with self._pending_lock:
             pending = list(self._pending.values())
             self._pending.clear()

@@ -65,6 +65,11 @@ def _profile_worker(worker_id: str, query: "dict | None" = None) -> dict:
         body["hz"] = int(q.get("hz", 50))
         if q.get("mode"):
             body["mode"] = q["mode"]  # "cpu" (default) | "memory"
+        if q.get("include_idle"):
+            # ?include_idle=1 keeps parked/blocked threads in the
+            # profile (needed to see WHERE a deadlocked worker waits —
+            # the default filter would render it as an empty graph).
+            body["include_idle"] = q["include_idle"] not in ("0", "false")
     timeout = 15 + float(body.get("sample_s") or 0)
     return global_runtime().conn.call("profile_worker", body,
                                       timeout=timeout)

@@ -43,6 +43,18 @@ class LLMConfig:
     max_num_seqs: int = 8  # decode slots (continuous-batching width)
     max_seq_len: int = 512  # KV-cache capacity per slot
     prefill_buckets: tuple[int, ...] = (32, 64, 128, 256)
+    # Chunked prefill (reference: vLLM enable_chunked_prefill): prompts
+    # longer than this prefill in fixed-size chunks via prefill_at, so
+    # one long prompt never compiles a prompt-length-sized program or
+    # monopolizes the step loop. 0 = whole-prompt (bucketed) prefill.
+    prefill_chunk: int = 0
+    # Automatic prefix caching (reference: vLLM --enable-prefix-caching):
+    # completed prompts' K/V rows are kept (device-resident, LRU) at
+    # prefix_block granularity; a new prompt sharing a cached prefix
+    # skips recomputing it and prefills only the tail.
+    enable_prefix_caching: bool = False
+    prefix_block: int = 32           # match/store granularity, tokens
+    prefix_cache_entries: int = 16   # LRU capacity (entries, not bytes)
     # "byte" (offline-safe, vocab 256+specials) or a HF tokenizer path.
     tokenizer: str = "byte"
     # Sharding: number of mesh devices for tensor parallelism (1 = none).

@@ -124,6 +124,14 @@ class LLMEngine:
         self.temps = np.zeros((B,), np.float32)
         self.slots: list[Request | None] = [None] * B
         self.waiting: collections.deque[Request] = collections.deque()
+        # Prefix cache: token-tuple -> (k, v) device arrays [L, plen, KV,
+        # Dh], LRU-ordered. Entries are written at prefix_block
+        # granularity after a prompt's prefill and installed into a slot
+        # on a later match (vLLM automatic-prefix-caching counterpart).
+        self._prefix_pool: "collections.OrderedDict[tuple, tuple]" = (
+            collections.OrderedDict())
+        self.prefix_cache_hits = 0
+        self.prefix_cache_queries = 0
         self._rng = jax.random.PRNGKey(config.seed + 1)
         self._step_count = 0
         # generate()/step() mutate slot state and the donated cache buffer;
@@ -143,6 +151,11 @@ class LLMEngine:
         toks = (self.tokenizer.encode(prompt) if isinstance(prompt, str)
                 else list(prompt))
         toks = toks[: self.max_len - 1]
+        if not toks:
+            raise ValueError(
+                f"request {request_id!r} has an empty prompt (prefill "
+                f"needs at least one token to produce next-token logits)"
+            )
         self.waiting.append(Request(request_id, toks, sp))
 
     def has_unfinished(self) -> bool:
@@ -161,21 +174,117 @@ class LLMEngine:
             if self.slots[slot] is not None or not self.waiting:
                 continue
             req = self.waiting.popleft()
-            L = len(req.prompt_tokens)
-            S = self._bucket(L)
-            padded = np.full((1, S), 0, np.int32)
-            padded[0, :L] = req.prompt_tokens
-            last_logits, self.cache = model_runner.prefill(
-                self.params, jnp.asarray(padded), jnp.int32(L),
-                jnp.int32(slot), self.cache, config=self.model_config,
-            )
+            last_logits = self._prefill_into(slot, req.prompt_tokens)
             tok = self._sample_host(np.asarray(last_logits), req.params)
-            self.positions[slot] = L
+            self.positions[slot] = len(req.prompt_tokens)
             self.slots[slot] = req
             self.temps[slot] = req.params.temperature
             self.last_tokens[slot] = tok
             req.generated.append(tok)
             self._maybe_finish(slot, outputs)
+
+    def _prefill_into(self, slot: int, toks: list[int]):
+        """Write a prompt's K/V into ``slot`` (prefix-cache install +
+        chunked or whole-prompt prefill) and return the last-token
+        logits [V]."""
+        cfg = self.config
+        L = len(toks)
+        pos0 = 0
+        if cfg.enable_prefix_caching:
+            pos0 = self._install_cached_prefix(slot, toks)
+        chunk = cfg.prefill_chunk if cfg.prefill_chunk > 0 else L - pos0
+        last_logits = None
+        off = pos0
+        while off < L:
+            take = min(chunk, L - off)
+            # Padded width comes from the bucket set so chunk shapes
+            # stay bounded (each distinct width is one XLA compile).
+            S = self._bucket(take)
+            if off + S > self.max_len:
+                # Near the cache cap (rare): pad exactly to the cap —
+                # an out-of-range dynamic_update_slice start would
+                # silently clamp and shift the write onto earlier rows.
+                S = self.max_len - off
+                take = min(take, S)
+            part = toks[off:off + take]
+            padded = np.zeros((1, S), np.int32)
+            padded[0, :len(part)] = part
+            if off == 0 and len(part) == L:
+                # Whole prompt in one go: within-chunk attention ([S,S]
+                # scores, no history pass) is the cheapest path.
+                last_logits, self.cache = model_runner.prefill(
+                    self.params, jnp.asarray(padded), jnp.int32(len(part)),
+                    jnp.int32(slot), self.cache, config=self.model_config,
+                )
+            else:
+                last_logits, self.cache = model_runner.prefill_at(
+                    self.params, jnp.asarray(padded), jnp.int32(len(part)),
+                    jnp.int32(off), jnp.int32(slot), self.cache,
+                    config=self.model_config,
+                )
+            off += len(part)
+        if cfg.enable_prefix_caching:
+            self._store_prefix(slot, toks)
+        return last_logits
+
+    # -- prefix cache ------------------------------------------------------
+
+    @staticmethod
+    def _common_prefix(a: tuple, b: list[int]) -> int:
+        n = min(len(a), len(b))
+        for i in range(n):
+            if a[i] != b[i]:
+                return i
+        return n
+
+    def _install_cached_prefix(self, slot: int, toks: list[int]) -> int:
+        """Find the entry sharing the longest common prefix with the
+        prompt (block-rounded — an entry's sub-prefix is just a row
+        slice, so divergence mid-entry still hits) and copy those K/V
+        rows into the slot. Returns the number of prompt tokens covered
+        (<= len(toks) - 1: at least one token must prefill to yield the
+        next-token logits)."""
+        self.prefix_cache_queries += 1
+        block = max(1, self.config.prefix_block)
+        limit = len(toks) - 1
+        best_key, best_d = None, 0
+        for key in self._prefix_pool:
+            d = min(self._common_prefix(key, toks), limit)
+            d = (d // block) * block
+            if d > best_d:
+                best_key, best_d = key, d
+        if best_key is None:
+            return 0
+        self._prefix_pool.move_to_end(best_key)
+        kp, vp = self._prefix_pool[best_key]
+        if best_d < kp.shape[1]:
+            kp, vp = kp[:, :best_d], vp[:, :best_d]
+        self.cache = model_runner.install_prefix(
+            self.cache, jnp.int32(slot), kp, vp)
+        self.prefix_cache_hits += 1
+        return best_d
+
+    def _store_prefix(self, slot: int, toks: list[int]) -> None:
+        """Save this prompt's K/V rows (block-rounded, capped to L-1 so
+        the entry serves an identical future prompt) unless an existing
+        entry already covers them; LRU-evict beyond capacity."""
+        block = max(1, self.config.prefix_block)
+        plen = ((len(toks) - 1) // block) * block
+        if plen < block:
+            return
+        key = tuple(toks[:plen])
+        for existing in list(self._prefix_pool):
+            if len(existing) >= plen:
+                if existing[:plen] == key:
+                    self._prefix_pool.move_to_end(existing)
+                    return  # covered by a (longer) entry's slice
+            elif key[:len(existing)] == existing:
+                del self._prefix_pool[existing]  # we supersede it
+        kp, vp = model_runner.read_prefix(self.cache, jnp.int32(slot),
+                                          length=plen)
+        self._prefix_pool[key] = (kp, vp)
+        while len(self._prefix_pool) > self.config.prefix_cache_entries:
+            self._prefix_pool.popitem(last=False)
 
     def _sample_host(self, logits: np.ndarray, sp: SamplingParams) -> int:
         if sp.temperature <= 0.0:
@@ -259,11 +368,20 @@ class LLMEngine:
 
         with self._lock:
             tag = uuid.uuid4().hex[:8]
-            order: list[str] = []
-            for i, p in enumerate(prompts):
-                rid = f"req-{tag}-{i}"
-                order.append(rid)
-                self.add_request(rid, p, sampling_params)
+            # Tokenize/validate every prompt BEFORE enqueuing any: a
+            # mid-batch validation error must not leave earlier requests
+            # orphaned in the waiting queue (their outputs would be
+            # silently dropped by the next caller's step loop).
+            toks_list = [
+                (self.tokenizer.encode(p) if isinstance(p, str) else list(p))
+                for p in prompts
+            ]
+            for i, toks in enumerate(toks_list):
+                if not toks:
+                    raise ValueError(f"prompt {i} of this batch is empty")
+            order = [f"req-{tag}-{i}" for i in range(len(toks_list))]
+            for rid, toks in zip(order, toks_list):
+                self.add_request(rid, toks, sampling_params)
             mine = set(order)
             done: dict[str, RequestOutput] = {}
             # Step until THIS call's requests finish. Other requests

@@ -115,10 +115,100 @@ def prefill(params, tokens, true_len, slot, cache, *, config: TransformerConfig)
     x, (k_new, v_new) = jax.lax.scan(
         body, x, (params["layers"], cache["k"], cache["v"])
     )
-    logits = _final_logits(x, params, c, dt)  # [1, S, V]
-    last = jax.lax.dynamic_index_in_dim(logits[0], true_len - 1, axis=0,
-                                        keepdims=False)
+    # LM head on the last real token only: prompt logits are never
+    # needed, and skipping the [S, V] head matmul is the single biggest
+    # prefill-FLOPs saving (V >> D).
+    xl = jax.lax.dynamic_slice_in_dim(x, true_len - 1, 1, axis=1)
+    last = _final_logits(xl, params, c, dt)[0, 0]
     return last, {"k": k_new, "v": v_new}
+
+
+@partial(jax.jit, static_argnames=("config",), donate_argnames=("cache",))
+def prefill_at(params, tokens, true_len, pos0, slot, cache,
+               *, config: TransformerConfig):
+    """Continuation prefill: write a prompt chunk [1, S] into slot rows
+    [pos0, pos0+S) and attend over the slot's full history.
+
+    Unlike ``prefill`` (pos0 == 0, attention within the chunk), each
+    query row here also attends to the K/V already in the slot — rows
+    written by an installed prefix-cache entry (install_prefix) or by
+    earlier chunks of a chunked prefill. Masking is positional
+    (``k_pos <= pos0 + i``), so stale rows beyond the written history
+    are never attended. Returns (last_logits [V] float32, cache').
+
+    The caller must guarantee pos0 + S <= cache length: XLA's
+    dynamic_update_slice clamps out-of-range starts, which would silently
+    shift the write into earlier (valid) rows.
+    """
+    c = config
+    dt = c.compute_dtype
+    _, S = tokens.shape
+    positions = pos0 + jnp.arange(S)
+    # Padding rows may index past the position tables; clamp — those
+    # rows are masked out of every later attention anyway.
+    safe_pos = jnp.minimum(positions, c.max_seq_len - 1)
+
+    x = params["embed"]["tokens"][tokens].astype(dt)
+    if c.arch == "gpt2":
+        x = x + params["embed"]["pos"][safe_pos].astype(dt)
+        rope = None
+    else:
+        rope = rope_frequencies(c.head_dim, c.max_seq_len, theta=c.rope_theta)
+
+    def body(x, xs):
+        lp, kc, vc = xs
+        h = _norm1(x, lp, c)
+        q = jnp.einsum("btd,dhk->bthk", h, lp["attn"]["wq"].astype(dt))
+        k = jnp.einsum("btd,dhk->bthk", h, lp["attn"]["wk"].astype(dt))
+        v = jnp.einsum("btd,dhk->bthk", h, lp["attn"]["wv"].astype(dt))
+        if rope is not None:
+            q = apply_rope(q, *rope, positions=safe_pos)
+            k = apply_rope(k, *rope, positions=safe_pos)
+        kc = jax.lax.dynamic_update_slice(kc, k, (slot, pos0, 0, 0))
+        vc = jax.lax.dynamic_update_slice(vc, v, (slot, pos0, 0, 0))
+        ks = jax.lax.dynamic_slice_in_dim(kc, slot, 1, axis=0)  # [1,T,..]
+        vs = jax.lax.dynamic_slice_in_dim(vc, slot, 1, axis=0)
+        kf, vf = _expand_gqa(ks, vs, c)
+        o = dot_product_attention(q, kf, vf, causal=True,
+                                  q_offset=pos0).astype(dt)
+        o = jnp.einsum("bshk,hkd->bsd", o, lp["attn"]["wo"].astype(dt))
+        x = x + o
+        return x + _mlp(x, lp, c, dt), (kc, vc)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        body, x, (params["layers"], cache["k"], cache["v"])
+    )
+    xl = jax.lax.dynamic_slice_in_dim(x, true_len - 1, 1, axis=1)
+    last = _final_logits(xl, params, c, dt)[0, 0]
+    return last, {"k": k_new, "v": v_new}
+
+
+@partial(jax.jit, static_argnames=("length",))
+def read_prefix(cache, slot, length: int):
+    """Copy the first ``length`` K/V rows of ``slot`` out of the cache
+    (device-resident; fed back via install_prefix on a prefix-cache hit).
+    Returns (k, v) of shape [L, length, KV, Dh]."""
+    L, _, _, KV, Dh = cache["k"].shape
+    k = jax.lax.dynamic_slice(cache["k"], (0, slot, 0, 0, 0),
+                              (L, 1, length, KV, Dh))
+    v = jax.lax.dynamic_slice(cache["v"], (0, slot, 0, 0, 0),
+                              (L, 1, length, KV, Dh))
+    return k[:, 0], v[:, 0]
+
+
+@jax.jit
+def install_prefix(cache, slot, k_prefix, v_prefix):
+    """Write a cached prefix's K/V rows into slot rows [0, length).
+
+    Not donated: under tensor parallelism the cache carries an explicit
+    NamedSharding and the host-pool prefix arrays do not — donation
+    would force a layout round-trip; a copy keeps the resident sharding.
+    """
+    k = jax.lax.dynamic_update_slice(
+        cache["k"], k_prefix[:, None], (0, slot, 0, 0, 0))
+    v = jax.lax.dynamic_update_slice(
+        cache["v"], v_prefix[:, None], (0, slot, 0, 0, 0))
+    return {"k": k, "v": v}
 
 
 @partial(jax.jit, static_argnames=("config",), donate_argnames=("cache",))

@@ -1,0 +1,128 @@
+"""Prefix caching + chunked prefill (reference: vLLM automatic prefix
+caching / enable_chunked_prefill, consumed by ray.llm's engine kwargs —
+llm/_internal/batch/stages/vllm_engine_stage.py). Correctness bar: every
+cached/chunked path must be bit-identical to the cold whole-prompt path
+under greedy decoding (same params, same static shapes per step)."""
+
+from __future__ import annotations
+
+import pytest
+
+from ray_tpu.llm import LLMConfig, LLMEngine, SamplingParams
+from ray_tpu.models import transformer as tfm
+
+
+def _engine(**kw) -> LLMEngine:
+    kw.setdefault("model", tfm.tiny(vocab_size=512, max_seq_len=256,
+                                    dtype="float32"))
+    kw.setdefault("max_num_seqs", 2)
+    kw.setdefault("max_seq_len", 128)
+    kw.setdefault("prefill_buckets", (8, 16, 32, 64))
+    return LLMEngine(LLMConfig(**kw))
+
+
+def _greedy(engine: LLMEngine, prompts, max_tokens=8):
+    outs = engine.generate(
+        prompts, SamplingParams(max_tokens=max_tokens, temperature=0.0))
+    return [o.token_ids for o in outs]
+
+
+PROMPT = "the quick brown fox jumps over the lazy dog and keeps running"
+
+
+class TestChunkedPrefill:
+    def test_matches_whole_prompt_prefill(self):
+        cold = _engine()
+        chunked = _engine(prefill_chunk=8)
+        assert _greedy(cold, [PROMPT]) == _greedy(chunked, [PROMPT])
+
+    def test_chunk_larger_than_prompt(self):
+        cold = _engine()
+        chunked = _engine(prefill_chunk=1024)
+        assert _greedy(cold, ["hi"]) == _greedy(chunked, ["hi"])
+
+    def test_llama_arch_rope_offsets(self):
+        model = tfm.tiny(vocab_size=512, max_seq_len=256, dtype="float32",
+                         arch="llama")
+        cold = _engine(model=model)
+        chunked = _engine(model=model, prefill_chunk=8)
+        assert _greedy(cold, [PROMPT]) == _greedy(chunked, [PROMPT])
+
+    def test_near_cache_capacity(self):
+        # Prompt long enough that the last chunk's padded bucket would
+        # overrun max_len without the clamp in _prefill_into.
+        cold = _engine(max_seq_len=64)
+        chunked = _engine(max_seq_len=64, prefill_chunk=16)
+        long_prompt = "x" * 61  # 62 tokens with BOS, truncated to 63 cap
+        assert (_greedy(cold, [long_prompt], max_tokens=4)
+                == _greedy(chunked, [long_prompt], max_tokens=4))
+
+
+class TestPrefixCache:
+    def test_identical_prompt_hits_and_matches(self):
+        cold = _engine()
+        cached = _engine(enable_prefix_caching=True, prefix_block=8)
+        want = _greedy(cold, [PROMPT])
+        assert _greedy(cached, [PROMPT]) == want  # cold fill
+        assert cached.prefix_cache_hits == 0
+        assert _greedy(cached, [PROMPT]) == want  # served from cache
+        assert cached.prefix_cache_hits == 1
+
+    def test_shared_prefix_divergent_tail(self):
+        p1 = PROMPT + " first tail here"
+        p2 = PROMPT + " second, different"
+        cold = _engine()
+        cached = _engine(enable_prefix_caching=True, prefix_block=8)
+        want = _greedy(cold, [p2])
+        _greedy(cached, [p1])
+        assert _greedy(cached, [p2]) == want
+        assert cached.prefix_cache_hits == 1
+
+    def test_combined_with_chunked_prefill(self):
+        cold = _engine()
+        cached = _engine(enable_prefix_caching=True, prefix_block=8,
+                         prefill_chunk=8)
+        want = _greedy(cold, [PROMPT])
+        assert _greedy(cached, [PROMPT]) == want
+        assert _greedy(cached, [PROMPT]) == want
+        assert cached.prefix_cache_hits == 1
+
+    def test_short_prompts_never_cached(self):
+        cached = _engine(enable_prefix_caching=True, prefix_block=32)
+        _greedy(cached, ["hi"])  # 3 tokens < block
+        assert len(cached._prefix_pool) == 0
+
+    def test_lru_eviction_bounds_pool(self):
+        cached = _engine(enable_prefix_caching=True, prefix_block=8,
+                         prefix_cache_entries=2)
+        for i in range(4):
+            _greedy(cached, [f"prompt number {i} " + "pad " * 5],
+                    max_tokens=2)
+        assert len(cached._prefix_pool) <= 2
+
+    def test_superseded_entries_collapse(self):
+        # A longer prompt extending a cached one replaces it (its slice
+        # covers the shorter entry), keeping the pool at one entry.
+        cached = _engine(enable_prefix_caching=True, prefix_block=8)
+        _greedy(cached, [PROMPT], max_tokens=2)
+        _greedy(cached, [PROMPT + " plus a considerably longer tail"],
+                max_tokens=2)
+        assert len(cached._prefix_pool) == 1
+
+    def test_multi_slot_interleaving(self):
+        # Two requests sharing a prefix admitted into different slots in
+        # one batch: slot isolation of install/read paths.
+        cold = _engine()
+        cached = _engine(enable_prefix_caching=True, prefix_block=8)
+        p1, p2 = PROMPT + " alpha", PROMPT + " beta"
+        want = _greedy(cold, [p1, p2])
+        _greedy(cached, [PROMPT], max_tokens=2)  # seed the pool
+        assert _greedy(cached, [p1, p2]) == want
+        assert cached.prefix_cache_hits == 2
+
+
+class TestEmptyPrompt:
+    def test_empty_token_list_rejected(self):
+        eng = _engine()
+        with pytest.raises(ValueError, match="empty prompt"):
+            eng.add_request("r0", [])
