@@ -55,6 +55,15 @@ class LLMConfig:
     enable_prefix_caching: bool = False
     prefix_block: int = 32           # match/store granularity, tokens
     prefix_cache_entries: int = 16   # LRU capacity (entries, not bytes)
+    # Speculative decoding (reference: vLLM speculative_model /
+    # num_speculative_tokens): a small draft model proposes tokens, the
+    # target model verifies a whole window in one pass. Greedy-only —
+    # steps with any temperature>0 slot fall back to normal decode.
+    # Accepted values mirror `model` (TransformerConfig or factory name).
+    speculative_model: Any = None
+    num_speculative_tokens: int = 4
+    speculative_checkpoint_path: str | None = None
+    speculative_seed: int = 7
     # "byte" (offline-safe, vocab 256+specials) or a HF tokenizer path.
     tokenizer: str = "byte"
     # Sharding: number of mesh devices for tensor parallelism (1 = none).
@@ -64,30 +73,49 @@ class LLMConfig:
     checkpoint_path: str | None = None
     seed: int = 0
 
+    def _resolve_named(self, name: str, checkpoint_path: "str | None",
+                       what: str) -> tfm.TransformerConfig:
+        factory = getattr(tfm, name, None)
+        if factory is None:
+            raise ValueError(
+                f"unknown {what} {name!r}: not a TransformerConfig and not "
+                f"a factory in ray_tpu.models.transformer"
+            )
+        cfg = factory()
+        if (self.tokenizer == "byte" and cfg.vocab_size < 512
+                and not checkpoint_path):
+            # Factory-named models with no checkpoint are randomly
+            # initialized, so the vocab can be grown to fit the byte
+            # tokenizer's specials (259 ids; 512 keeps the lm_head
+            # MXU-tile aligned). With a checkpoint the config must
+            # match the saved shapes — the engine's vocab guard then
+            # reports the mismatch loudly instead.
+            cfg = dataclasses.replace(cfg, vocab_size=512)
+        return cfg
+
     def resolve_model(self) -> tfm.TransformerConfig:
         if isinstance(self.model, tfm.TransformerConfig):
-            cfg = self.model
-        elif isinstance(self.model, str) or self.model is None:
-            name = self.model or self.model_id
-            factory = getattr(tfm, name, None)
-            if factory is None:
-                raise ValueError(
-                    f"unknown model {name!r}: not a TransformerConfig and not "
-                    f"a factory in ray_tpu.models.transformer"
-                )
-            cfg = factory()
-            if (self.tokenizer == "byte" and cfg.vocab_size < 512
-                    and not self.checkpoint_path):
-                # Factory-named models with no checkpoint are randomly
-                # initialized, so the vocab can be grown to fit the byte
-                # tokenizer's specials (259 ids; 512 keeps the lm_head
-                # MXU-tile aligned). With a checkpoint the config must
-                # match the saved shapes — the engine's vocab guard then
-                # reports the mismatch loudly instead.
-                cfg = dataclasses.replace(cfg, vocab_size=512)
-        else:
-            raise TypeError(f"model must be TransformerConfig or str, got {type(self.model)}")
-        # The engine clamps its cache length to the model's position
-        # capacity (LLMEngine.max_len), so a default 512 geometry works
-        # with short-context models out of the box.
-        return cfg
+            return self.model
+        if isinstance(self.model, str) or self.model is None:
+            # The engine clamps its cache length to the model's position
+            # capacity (LLMEngine.max_len), so a default 512 geometry
+            # works with short-context models out of the box.
+            return self._resolve_named(self.model or self.model_id,
+                                       self.checkpoint_path, "model")
+        raise TypeError(
+            f"model must be TransformerConfig or str, got {type(self.model)}")
+
+    def resolve_speculative_model(self) -> "tfm.TransformerConfig | None":
+        """Draft-model config for speculative decoding (None = off).
+        Same resolution rules as resolve_model."""
+        sm = self.speculative_model
+        if sm is None:
+            return None
+        if isinstance(sm, tfm.TransformerConfig):
+            return sm
+        if isinstance(sm, str):
+            return self._resolve_named(sm, self.speculative_checkpoint_path,
+                                       "speculative model")
+        raise TypeError(
+            f"speculative_model must be TransformerConfig or str, "
+            f"got {type(sm)}")

@@ -132,6 +132,36 @@ class LLMEngine:
             collections.OrderedDict())
         self.prefix_cache_hits = 0
         self.prefix_cache_queries = 0
+        # Speculative decoding: a draft model shadows the batch (own
+        # slot cache, prefilled alongside the target); each engine step
+        # chains k-1 draft proposals and verifies the window with ONE
+        # target pass (model_runner.verify), greedy acceptance host-side.
+        self.draft = None
+        self.spec_k = max(2, int(config.num_speculative_tokens))
+        dc = config.resolve_speculative_model()
+        if dc is not None:
+            if dc.n_experts > 0:
+                raise NotImplementedError("MoE draft models not supported")
+            if dc.vocab_size != c.vocab_size:
+                raise ValueError(
+                    f"draft vocab_size ({dc.vocab_size}) must equal target "
+                    f"vocab_size ({c.vocab_size}): proposals are target ids")
+            if dc.max_seq_len < self.max_len:
+                raise ValueError(
+                    f"draft max_seq_len ({dc.max_seq_len}) < engine cache "
+                    f"length ({self.max_len})")
+            if config.speculative_checkpoint_path:
+                dparams = _load_checkpoint(config.speculative_checkpoint_path)
+            else:
+                dparams = tfm.init_params(
+                    jax.random.PRNGKey(config.speculative_seed), dc)
+            self.draft = {
+                "config": dc,
+                "params": dparams,
+                "cache": model_runner.init_slot_cache(dc, B, self.max_len),
+            }
+        self.spec_stats = {"proposed": 0, "accepted": 0, "spec_steps": 0,
+                           "fallback_steps": 0}
         self._rng = jax.random.PRNGKey(config.seed + 1)
         self._step_count = 0
         # generate()/step() mutate slot state and the donated cache buffer;
@@ -225,7 +255,23 @@ class LLMEngine:
             off += len(part)
         if cfg.enable_prefix_caching:
             self._store_prefix(slot, toks)
+        if self.draft is not None:
+            self._draft_prefill(slot, toks)
         return last_logits
+
+    def _draft_prefill(self, slot: int, toks: list[int]) -> None:
+        """Mirror the prompt into the draft model's slot cache so its
+        proposals start from real context. One bucketed whole-prompt
+        prefill suffices: prompts are capped at max_len - 1 and _bucket
+        never exceeds max_len, so no chunking/cap handling is needed."""
+        d = self.draft
+        L = len(toks)
+        S = self._bucket(L)
+        padded = np.zeros((1, S), np.int32)
+        padded[0, :L] = toks
+        _, d["cache"] = model_runner.prefill(
+            d["params"], jnp.asarray(padded), jnp.int32(L),
+            jnp.int32(slot), d["cache"], config=d["config"])
 
     # -- prefix cache ------------------------------------------------------
 
@@ -332,6 +378,22 @@ class LLMEngine:
         active = [i for i, s in enumerate(self.slots) if s is not None]
         if not active:
             return outputs
+        if (self.draft is not None
+                and all(self.temps[s] <= 0.0 for s in active)):
+            return self._spec_step(active, outputs)
+        if self.draft is not None:
+            self.spec_stats["fallback_steps"] += 1
+            # Keep the draft cache in lockstep through fallback steps:
+            # write draft K/V rows for the tokens this step consumes
+            # (output discarded). Skipping this leaves permanent holes
+            # the next _spec_step's chain would attend, collapsing
+            # acceptance for the rest of those slots' lifetimes.
+            self._rng, dkey = jax.random.split(self._rng)
+            _, _, self.draft["cache"] = model_runner.decode(
+                self.draft["params"], jnp.asarray(self.last_tokens),
+                jnp.asarray(self.positions), self.draft["cache"],
+                jnp.asarray(self.temps), dkey,
+                config=self.draft["config"])
         self._rng, key = jax.random.split(self._rng)
         toks, _logits, self.cache = model_runner.decode(
             self.params,
@@ -353,6 +415,72 @@ class LLMEngine:
             self.last_tokens[slot] = tok
             req.generated.append(tok)
             self._maybe_finish(slot, outputs)
+        return outputs
+
+    def _spec_step(self, active: list[int],
+                   outputs: list[RequestOutput]) -> list[RequestOutput]:
+        """One speculative iteration (all active slots greedy).
+
+        Chain k-1 draft-model decodes to propose a window, verify the
+        whole window with one target pass, then accept the longest
+        prefix where each proposal equals the target's greedy choice —
+        plus the target's own next token as a bonus. Emitted tokens are
+        bit-identical to plain greedy decoding (acceptance only keeps
+        proposals the target would have produced), so speculation is
+        purely a latency/throughput trade: 1 target pass per up-to-k
+        tokens instead of per token.
+        """
+        d = self.draft
+        k = self.spec_k
+        cur = self.last_tokens.copy()
+        pos = self.positions.copy()
+        window = [cur.copy()]
+        zero_t = jnp.zeros((len(self.slots),), jnp.float32)
+        for _ in range(k - 1):
+            self._rng, key = jax.random.split(self._rng)
+            toks_j, _, d["cache"] = model_runner.decode(
+                d["params"], jnp.asarray(cur), jnp.asarray(pos),
+                d["cache"], zero_t, key, config=d["config"])
+            cur = np.asarray(toks_j).copy()
+            pos = pos + 1
+            window.append(cur.copy())
+        # One extra draft decode consuming the LAST proposal (output
+        # discarded): if the full window is accepted, that proposal's
+        # draft K/V row must exist — otherwise the draft cache carries a
+        # permanently stale row and every later proposal degrades.
+        self._rng, key = jax.random.split(self._rng)
+        _, _, d["cache"] = model_runner.decode(
+            d["params"], jnp.asarray(cur), jnp.asarray(pos), d["cache"],
+            zero_t, key, config=d["config"])
+        tokens_window = np.stack(window, axis=1)  # [B, k]
+
+        logits, self.cache = model_runner.verify(
+            self.params, jnp.asarray(tokens_window),
+            jnp.asarray(self.positions), self.cache,
+            config=self.model_config)
+        greedy = np.asarray(logits.argmax(-1)).astype(np.int64)  # [B, k]
+
+        self._step_count += 1
+        self.spec_stats["spec_steps"] += 1
+        for slot in active:
+            prop = tokens_window[slot]
+            g = greedy[slot]
+            n = 0
+            while n < k - 1 and prop[n + 1] == g[n]:
+                n += 1
+            self.spec_stats["proposed"] += k - 1
+            self.spec_stats["accepted"] += n
+            # prop[1..n] are the accepted drafts (== g[0..n-1]); g[n] is
+            # the target's next token after them (the bonus).
+            emitted = [int(t) for t in prop[1:n + 1]] + [int(g[n])]
+            req = self.slots[slot]
+            for tok in emitted:
+                self.positions[slot] += 1
+                self.last_tokens[slot] = tok
+                req.generated.append(tok)
+                self._maybe_finish(slot, outputs)
+                if self.slots[slot] is None:
+                    break
         return outputs
 
     # -- convenience batch API --------------------------------------------

@@ -212,6 +212,78 @@ def install_prefix(cache, slot, k_prefix, v_prefix):
 
 
 @partial(jax.jit, static_argnames=("config",), donate_argnames=("cache",))
+def verify(params, tokens, positions, cache, *, config: TransformerConfig):
+    """Speculative-decoding verify step: score a window of K proposed
+    tokens per slot in ONE target-model pass (reference: vLLM
+    speculative decoding / spec_decode worker; greedy acceptance is done
+    host-side in the engine).
+
+    tokens [B, K]: token j of slot b sits at global position
+    positions[b] + j; its K/V row is written there, and its output
+    logits predict position positions[b] + j + 1. Per-slot causal mask:
+    ``k_pos <= positions[b] + j``. Rows written for later-rejected
+    tokens are stale-but-masked: they sit beyond the slot's rolled-back
+    position and every future decode/verify overwrites its own row
+    before attending to it (same invariant as chunked prefill).
+    Returns (logits [B, K, V] float32, cache').
+    """
+    c = config
+    dt = c.compute_dtype
+    B, K = tokens.shape
+    T = cache["k"].shape[2]
+    barange = jnp.arange(B)
+    posmat = positions[:, None] + jnp.arange(K)[None, :]        # [B, K]
+    safe_pos = jnp.minimum(posmat, c.max_seq_len - 1)
+
+    x = params["embed"]["tokens"][tokens].astype(dt)            # [B, K, D]
+    if c.arch == "gpt2":
+        x = x + params["embed"]["pos"][safe_pos].astype(dt)
+        rope = None
+    else:
+        cos, sin = rope_frequencies(c.head_dim, c.max_seq_len,
+                                    theta=c.rope_theta)
+        rope = (cos[safe_pos][:, :, None, :], sin[safe_pos][:, :, None, :])
+
+    def rot(t):  # t: [B, K, H, Dh]; rope tables [B, K, 1, Dh/2]
+        cb, sb = rope
+        t1, t2 = jnp.split(t.astype(jnp.float32), 2, axis=-1)
+        return jnp.concatenate([t1 * cb - t2 * sb, t2 * cb + t1 * sb],
+                               axis=-1).astype(t.dtype)
+
+    # [B, K, T]: key row t visible to query j of slot b iff t <= pos[b]+j
+    kmask = jnp.arange(T)[None, None, :] <= posmat[:, :, None]
+
+    def body(x, xs):
+        lp, kc, vc = xs  # kc/vc: [B, T, KV, Dh]
+        h = _norm1(x, lp, c)
+        q = jnp.einsum("btd,dhk->bthk", h, lp["attn"]["wq"].astype(dt))
+        k = jnp.einsum("btd,dhk->bthk", h, lp["attn"]["wk"].astype(dt))
+        v = jnp.einsum("btd,dhk->bthk", h, lp["attn"]["wv"].astype(dt))
+        if rope is not None:
+            q, k = rot(q), rot(k)
+        kc = kc.at[barange[:, None], posmat].set(k)
+        vc = vc.at[barange[:, None], posmat].set(v)
+        kf, vf = _expand_gqa(kc, vc, c)  # [B, T, H, Dh]
+        scale = 1.0 / (c.head_dim ** 0.5)
+        scores = jnp.einsum("bqhk,bthk->bhqt",
+                            (q * scale).astype(jnp.float32),
+                            kf.astype(jnp.float32))  # [B, H, K, T]
+        scores = jnp.where(kmask[:, None], scores, -1e30)
+        p = jax.nn.softmax(scores, axis=-1)
+        o = jnp.einsum("bhqt,bthk->bqhk", p,
+                       vf.astype(jnp.float32)).astype(dt)
+        o = jnp.einsum("bshk,hkd->bsd", o, lp["attn"]["wo"].astype(dt))
+        x = x + o
+        return x + _mlp(x, lp, c, dt), (kc, vc)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        body, x, (params["layers"], cache["k"], cache["v"])
+    )
+    logits = _final_logits(x, params, c, dt)  # [B, K, V]
+    return logits, {"k": k_new, "v": v_new}
+
+
+@partial(jax.jit, static_argnames=("config",), donate_argnames=("cache",))
 def decode(params, tokens, positions, cache, temperature, rng,
            *, config: TransformerConfig):
     """One decode step for all slots: tokens [B], positions [B].

@@ -1,0 +1,139 @@
+"""Speculative decoding (reference: vLLM speculative_model /
+num_speculative_tokens, surfaced through ray.llm engine kwargs —
+llm/_internal/batch/stages/vllm_engine_stage.py). Greedy acceptance
+must make emitted tokens bit-identical to plain decoding: speculation
+is a throughput trade, never a sampling change."""
+
+from __future__ import annotations
+
+import pytest
+
+from ray_tpu.llm import LLMConfig, LLMEngine, SamplingParams
+from ray_tpu.models import transformer as tfm
+
+
+def _model(**kw):
+    kw.setdefault("vocab_size", 512)
+    kw.setdefault("max_seq_len", 256)
+    kw.setdefault("dtype", "float32")
+    return tfm.tiny(**kw)
+
+
+def _engine(**kw) -> LLMEngine:
+    kw.setdefault("model", _model())
+    kw.setdefault("max_num_seqs", 2)
+    kw.setdefault("max_seq_len", 96)
+    kw.setdefault("prefill_buckets", (8, 16, 32, 64))
+    return LLMEngine(LLMConfig(**kw))
+
+
+def _greedy(engine, prompts, max_tokens=12):
+    outs = engine.generate(
+        prompts, SamplingParams(max_tokens=max_tokens, temperature=0.0))
+    return [o.token_ids for o in outs]
+
+
+PROMPTS = ["the quick brown fox", "pack my box with five dozen"]
+
+
+class TestSpeculativeDecoding:
+    def test_perfect_draft_matches_and_accelerates(self):
+        # Draft == target (same config/seed): every proposal accepted,
+        # so steps collapse by ~k while outputs stay identical.
+        cold = _engine()
+        spec = _engine(speculative_model=_model(),
+                       speculative_seed=0,  # == target init seed
+                       num_speculative_tokens=4)
+        want = _greedy(cold, PROMPTS)
+        assert _greedy(spec, PROMPTS) == want
+        st = spec.spec_stats
+        assert st["spec_steps"] > 0 and st["fallback_steps"] == 0
+        assert st["accepted"] == st["proposed"]  # perfect draft
+        assert spec._step_count < cold._step_count
+
+    def test_bad_draft_still_exact(self):
+        # Draft with different (random) weights: proposals mostly
+        # rejected, outputs still bit-identical to plain decoding.
+        cold = _engine()
+        spec = _engine(speculative_model=_model(),
+                       speculative_seed=99,
+                       num_speculative_tokens=4)
+        assert _greedy(spec, PROMPTS) == _greedy(cold, PROMPTS)
+
+    def test_smaller_draft_architecture(self):
+        draft = _model(n_layers=1, d_model=32, n_heads=2)
+        cold = _engine()
+        spec = _engine(speculative_model=draft, num_speculative_tokens=3)
+        assert _greedy(spec, PROMPTS) == _greedy(cold, PROMPTS)
+
+    def test_temperature_falls_back(self):
+        spec = _engine(speculative_model=_model(), num_speculative_tokens=4)
+        outs = spec.generate(["sampled text"],
+                             SamplingParams(max_tokens=6, temperature=0.8))
+        assert len(outs) == 1 and len(outs[0].token_ids) >= 1
+        assert spec.spec_stats["spec_steps"] == 0
+        assert spec.spec_stats["fallback_steps"] > 0
+
+    def test_stop_token_inside_accepted_window(self):
+        # Force a stop token the perfect draft will propose mid-window:
+        # generation must truncate at it, not run past.
+        cold = _engine()
+        want = _greedy(cold, [PROMPTS[0]], max_tokens=12)[0]
+        assert len(want) >= 4
+        stop = want[3]
+        spec = _engine(speculative_model=_model(), speculative_seed=0,
+                       num_speculative_tokens=4)
+        sp = SamplingParams(max_tokens=12, temperature=0.0,
+                            stop_token_ids=(int(stop),))
+        got = spec.generate([PROMPTS[0]], sp)[0]
+        # Truncation at the FIRST occurrence, exactly like plain decode.
+        assert got.token_ids == want[:want.index(stop)]
+        assert got.finish_reason == "stop"
+
+    def test_near_cache_capacity(self):
+        # Slots close to max_len: verify windows partially overrun the
+        # cache; emitted tokens past capacity must never surface.
+        cold = _engine(max_seq_len=24)
+        spec = _engine(max_seq_len=24, speculative_model=_model(),
+                       speculative_seed=0, num_speculative_tokens=4)
+        want = _greedy(cold, PROMPTS, max_tokens=32)
+        assert _greedy(spec, PROMPTS, max_tokens=32) == want
+        for o in spec.generate(PROMPTS,
+                               SamplingParams(max_tokens=64,
+                                              temperature=0.0)):
+            assert o.finish_reason == "length"
+
+    def test_fallback_keeps_draft_in_lockstep(self):
+        # A temperature>0 request forces fallback steps; the greedy
+        # request's draft rows must still be written during them, so
+        # once speculation resumes a perfect draft stays perfect.
+        cold = _engine()
+        want = _greedy(cold, [PROMPTS[0]], max_tokens=20)[0]
+        spec = _engine(speculative_model=_model(), speculative_seed=0,
+                       num_speculative_tokens=4)
+        spec.add_request("a", spec.tokenizer.encode(PROMPTS[0]),
+                         SamplingParams(max_tokens=20, temperature=0.0))
+        spec.add_request("b", spec.tokenizer.encode(PROMPTS[1]),
+                         SamplingParams(max_tokens=4, temperature=0.9))
+        done = {}
+        while spec.has_unfinished():
+            for out in spec.step():
+                done[out.request_id] = out
+        assert done["a"].token_ids == want
+        st = spec.spec_stats
+        assert st["fallback_steps"] > 0 and st["spec_steps"] > 0
+        assert st["accepted"] == st["proposed"], st  # no draft holes
+
+    def test_vocab_mismatch_rejected(self):
+        with pytest.raises(ValueError, match="vocab_size"):
+            _engine(speculative_model=_model(vocab_size=1024))
+
+    def test_composes_with_prefix_caching(self):
+        cold = _engine()
+        spec = _engine(speculative_model=_model(), speculative_seed=0,
+                       num_speculative_tokens=4,
+                       enable_prefix_caching=True, prefix_block=8)
+        want = _greedy(cold, [PROMPTS[0]])
+        assert _greedy(spec, [PROMPTS[0]]) == want
+        assert _greedy(spec, [PROMPTS[0]]) == want  # cache-hit path
+        assert spec.prefix_cache_hits == 1
