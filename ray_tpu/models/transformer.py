@@ -597,20 +597,31 @@ def make_train_step(config: TransformerConfig, optimizer, *, mesh=None,
     def loss_fn(params, batch):
         return lm_loss(params, batch, config, mesh=mesh, z_loss=z_loss)
 
+    fused = hasattr(optimizer, "apply")  # ops.optim.FusedClipAdamW
+
     def train_step(state, batch):
         (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             state["params"], batch
         )
-        updates, opt_state = optimizer.update(
-            grads, state["opt_state"], state["params"]
-        )
-        params = jax.tree.map(
-            lambda p, u: (p + u.astype(p.dtype)), state["params"], updates
-        )
-        gnorm = jnp.sqrt(sum(
-            jnp.sum(jnp.square(g.astype(jnp.float32)))
-            for g in jax.tree.leaves(grads)
-        ))
+        if fused:
+            # Single fused pass: clip + AdamW + param update in one
+            # kernel per leaf, grad norm shared with the metric (the
+            # optax path below reads the grads three times for the same
+            # result — ~35 ms/step on GPT-2 124M @ v5e).
+            params, opt_state, gnorm = optimizer.apply(
+                grads, state["opt_state"], state["params"]
+            )
+        else:
+            updates, opt_state = optimizer.update(
+                grads, state["opt_state"], state["params"]
+            )
+            params = jax.tree.map(
+                lambda p, u: (p + u.astype(p.dtype)), state["params"], updates
+            )
+            gnorm = jnp.sqrt(sum(
+                jnp.sum(jnp.square(g.astype(jnp.float32)))
+                for g in jax.tree.leaves(grads)
+            ))
         metrics = dict(metrics, grad_norm=gnorm)
         return {"params": params, "opt_state": opt_state,
                 "step": state["step"] + 1}, metrics

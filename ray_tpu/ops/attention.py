@@ -196,9 +196,11 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
         o_ref[0] = (acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)).astype(o_ref.dtype)
         # logsumexp row statistic: the backward kernels reconstruct the
         # NORMALIZED probabilities as exp(s - lse) without re-running the
-        # online softmax.
-        lse_ref[0] = (m_ref[:] +
-                      jnp.log(jnp.maximum(l_ref[:], 1e-30)))[:, 0]
+        # online softmax. Kept [block_q, 1] — a rank-2 (bh, tq) output
+        # would need a (1, block_q) block whose second-minor dim (1) the
+        # Mosaic lowering rejects (must be 8-divisible or the full array
+        # dim); the trailing singleton makes every block dim legal.
+        lse_ref[0] = m_ref[:] + jnp.log(jnp.maximum(l_ref[:], 1e-30))
 
 
 def _flash_forward(q, k, v, *, causal, block_q, block_k, interpret,
@@ -233,11 +235,11 @@ def _flash_forward(q, k, v, *, causal, block_q, block_k, interpret,
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b_, i, j: (b_, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b_, i, j: (b_, i)),
+            pl.BlockSpec((1, block_q, 1), lambda b_, i, j: (b_, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, tq), jnp.float32),
+            jax.ShapeDtypeStruct((bh, tq, 1), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -268,7 +270,11 @@ def _flash_forward(q, k, v, *, causal, block_q, block_k, interpret,
 
 def _bwd_block(q, k, v, g, lse, delta, *, q_blk, k_blk, block_q, block_k,
                causal, scale):
-    """Shared per-tile math: returns (ds [bq,bk] f32, p [bq,bk] f32)."""
+    """Shared per-tile math: returns (ds [bq,bk] f32, p [bq,bk] f32).
+
+    lse/delta arrive as [block_q, 1] column tiles (see the forward's
+    _emit note on Mosaic block-shape legality) and broadcast over keys.
+    """
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     ) * scale
@@ -280,12 +286,12 @@ def _bwd_block(q, k, v, g, lse, delta, *, q_blk, k_blk, block_q, block_k,
             jnp.int32, (block_q, block_k), 1)
         mask = q_pos >= k_pos
         s = jnp.where(mask, s, _NEG_INF)
-    p = jnp.exp(s - lse[:, None])
+    p = jnp.exp(s - lse)
     if mask is not None:
         p = jnp.where(mask, p, 0.0)
     dp = jax.lax.dot_general(
         g, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
-    ds = p * (dp - delta[:, None]) * scale
+    ds = p * (dp - delta) * scale
     return ds, p
 
 
@@ -380,8 +386,10 @@ def _flash_backward(q, k, v, out, lse, g, *, causal, block_q, block_k,
     block_q = min(block_q, tq)
     block_k = min(block_k, tk)
     n_q, n_k = tq // block_q, tk // block_k
-    # delta = rowsum(dO * O): one fused elementwise pass in XLA.
-    delta = (gf.astype(jnp.float32) * of.astype(jnp.float32)).sum(-1)
+    # delta = rowsum(dO * O): one fused elementwise pass in XLA. Kept as
+    # a [bh, tq, 1] column (same block-legality story as lse).
+    delta = (gf.astype(jnp.float32) * of.astype(jnp.float32)).sum(
+        -1, keepdims=True)
 
     common = dict(block_q=block_q, block_k=block_k, causal=causal,
                   scale=scale)
@@ -393,8 +401,8 @@ def _flash_backward(q, k, v, out, lse, g, *, causal, block_q, block_k,
             pl.BlockSpec((1, block_k, d), lambda b_, i, j: (b_, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b_, i, j: (b_, j, 0)),
             pl.BlockSpec((1, block_q, d), lambda b_, i, j: (b_, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b_, i, j: (b_, i)),
-            pl.BlockSpec((1, block_q), lambda b_, i, j: (b_, i)),
+            pl.BlockSpec((1, block_q, 1), lambda b_, i, j: (b_, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b_, i, j: (b_, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b_, i, j: (b_, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
@@ -409,8 +417,8 @@ def _flash_backward(q, k, v, out, lse, g, *, causal, block_q, block_k,
             pl.BlockSpec((1, block_k, d), lambda b_, j, i: (b_, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b_, j, i: (b_, j, 0)),
             pl.BlockSpec((1, block_q, d), lambda b_, j, i: (b_, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b_, j, i: (b_, i)),
-            pl.BlockSpec((1, block_q), lambda b_, j, i: (b_, i)),
+            pl.BlockSpec((1, block_q, 1), lambda b_, j, i: (b_, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b_, j, i: (b_, i, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b_, j, i: (b_, j, 0)),
