@@ -137,9 +137,17 @@ class LLMEngine:
         # chains k-1 draft proposals and verifies the window with ONE
         # target pass (model_runner.verify), greedy acceptance host-side.
         self.draft = None
-        self.spec_k = max(2, int(config.num_speculative_tokens))
+        # vLLM semantics: num_speculative_tokens = draft proposals per
+        # verify window. The window itself is one longer (the last
+        # emitted token leads it), so spec_k = proposals + 1 and a step
+        # emits up to num_speculative_tokens + 1 tokens (drafts + bonus).
+        self.spec_k = int(config.num_speculative_tokens) + 1
         dc = config.resolve_speculative_model()
         if dc is not None:
+            if config.num_speculative_tokens < 1:
+                raise ValueError(
+                    f"num_speculative_tokens must be >= 1, got "
+                    f"{config.num_speculative_tokens}")
             if dc.n_experts > 0:
                 raise NotImplementedError("MoE draft models not supported")
             if dc.vocab_size != c.vocab_size:
@@ -387,13 +395,20 @@ class LLMEngine:
             # write draft K/V rows for the tokens this step consumes
             # (output discarded). Skipping this leaves permanent holes
             # the next _spec_step's chain would attend, collapsing
-            # acceptance for the rest of those slots' lifetimes.
-            self._rng, dkey = jax.random.split(self._rng)
-            _, _, self.draft["cache"] = model_runner.decode(
-                self.draft["params"], jnp.asarray(self.last_tokens),
-                jnp.asarray(self.positions), self.draft["cache"],
-                jnp.asarray(self.temps), dkey,
-                config=self.draft["config"])
+            # acceptance for the rest of those slots' lifetimes. Only
+            # greedy slots can ever re-enter _spec_step, though — a
+            # sampled slot's temperature is fixed at admit time and a
+            # future greedy occupant re-prefills the draft slot — so an
+            # all-sampled batch skips the draft pass entirely instead of
+            # paying a full extra forward per token for rows nobody will
+            # read.
+            if any(self.temps[s] <= 0.0 for s in active):
+                self._rng, dkey = jax.random.split(self._rng)
+                _, _, self.draft["cache"] = model_runner.decode(
+                    self.draft["params"], jnp.asarray(self.last_tokens),
+                    jnp.asarray(self.positions), self.draft["cache"],
+                    jnp.asarray(self.temps), dkey,
+                    config=self.draft["config"])
         self._rng, key = jax.random.split(self._rng)
         toks, _logits, self.cache = model_runner.decode(
             self.params,

@@ -597,7 +597,9 @@ def make_train_step(config: TransformerConfig, optimizer, *, mesh=None,
     def loss_fn(params, batch):
         return lm_loss(params, batch, config, mesh=mesh, z_loss=z_loss)
 
-    fused = hasattr(optimizer, "apply")  # ops.optim.FusedClipAdamW
+    from ray_tpu.ops.optim import FusedClipAdamW
+
+    fused = isinstance(optimizer, FusedClipAdamW)
 
     def train_step(state, batch):
         (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(

@@ -137,3 +137,43 @@ class TestSpeculativeDecoding:
         assert _greedy(spec, [PROMPTS[0]]) == want
         assert _greedy(spec, [PROMPTS[0]]) == want  # cache-hit path
         assert spec.prefix_cache_hits == 1
+
+    def test_vllm_knob_semantics(self):
+        # num_speculative_tokens follows the vLLM meaning: that many
+        # draft proposals per verify window (so "proposed" grows by
+        # exactly N per spec step, and up to N+1 tokens emit per step).
+        n = 3
+        spec = _engine(speculative_model=_model(), speculative_seed=0,
+                       num_speculative_tokens=n)
+        _greedy(spec, PROMPTS[:1])
+        st = spec.spec_stats
+        assert st["spec_steps"] > 0
+        assert st["proposed"] == n * st["spec_steps"]
+        # num_speculative_tokens=1 is honored (one proposal), not bumped.
+        one = _engine(speculative_model=_model(), speculative_seed=0,
+                      num_speculative_tokens=1)
+        assert one.spec_k == 2
+        cold = _engine()
+        assert _greedy(one, PROMPTS[:1]) == _greedy(cold, PROMPTS[:1])
+
+    def test_all_sampled_batch_skips_draft_lockstep(self):
+        # With no greedy slot active the fallback path must not pay a
+        # draft forward per token (nobody can ever read those rows).
+        import ray_tpu.llm.model_runner as mr
+        spec = _engine(speculative_model=_model(), num_speculative_tokens=4)
+        calls = {"n": 0}
+        orig = mr.decode
+        def counting(params, *a, **kw):
+            calls["n"] += 1
+            return orig(params, *a, **kw)
+        mr.decode = counting
+        try:
+            spec.generate(["sampled"], SamplingParams(max_tokens=5,
+                                                      temperature=0.9))
+        finally:
+            mr.decode = orig
+        st = spec.spec_stats
+        assert st["fallback_steps"] > 0 and st["spec_steps"] == 0
+        # One target decode per fallback step, zero draft lockstep calls
+        # (admit/prefill passes are not mr.decode calls).
+        assert calls["n"] == st["fallback_steps"]
