@@ -51,17 +51,17 @@ def run_bench() -> None:
     """The measurement itself (child process; safe to init jax here)."""
     import jax
 
-    import optax
-
     from ray_tpu import models
+    from ray_tpu.ops.optim import FusedClipAdamW
 
     on_tpu = jax.devices()[0].platform == "tpu"
     if on_tpu:
         # Tuned on v5e: unrolled layers + no remat + bf16 attention
         # score/prob buffers (ops/attention.py dtype policy) + chunked
         # LM-head CE (the [B,T,50k] fp32 logits are never materialized,
-        # freeing HBM for batch 24). Measured 90.9k tok/s/chip vs 54.5k
-        # for the original scan+remat layout.
+        # freeing HBM for batch 24) + fused clip+AdamW (ops/optim.py —
+        # the optax chain plus a separate grad-norm metric cost ~35ms
+        # of HBM passes per ~290ms step).
         batch, seq, steps = 24, 1024, 10
         cfg = models.gpt2_small(max_seq_len=seq, remat=False,
                                 scan_layers=False, loss_chunk=4096)
@@ -70,10 +70,8 @@ def run_bench() -> None:
         batch, seq, steps = 4, 128, 3
         cfg = models.tiny(max_seq_len=seq, dtype="float32")
 
-    opt = optax.chain(
-        optax.clip_by_global_norm(1.0),
-        optax.adamw(3e-4, weight_decay=0.1),
-    )
+    opt = FusedClipAdamW(learning_rate=3e-4, weight_decay=0.1,
+                         clip_norm=1.0)
     state = models.init_train_state(jax.random.PRNGKey(0), cfg, opt)
     step = jax.jit(models.make_train_step(cfg, opt), donate_argnums=(0,))
     tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq + 1), 0,

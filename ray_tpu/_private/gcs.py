@@ -46,6 +46,7 @@ CREATING, SEALED, SPILLED, LOST = "CREATING", "SEALED", "SPILLED", "LOST"
 # group is still pending) — dispatch must requeue, never fall through to the
 # default policy.
 UNPLACEABLE = object()
+_SCAN_KEY = ("strategy",)  # ready-queue key for explicit-strategy tasks
 
 PENDING, SCHEDULED, RUNNING, FINISHED, FAILED = (
     "PENDING_ARGS_AVAIL",
@@ -213,7 +214,23 @@ class Head:
         self.actors: dict[str, ActorRecord] = {}
         self.named_actors: dict[tuple[str, str], str] = {}
         self.pgs: dict[str, PlacementGroupRecord] = {}
-        self.task_queue: deque[TaskSpec] = deque()
+        # Dispatch queues, shape-keyed (reference analogues: the
+        # raylet's per-SchedulingClass task queues in
+        # cluster_task_manager.h:45 and the DependencyManager's
+        # object->waiting-task index, dependency_manager.h:55).
+        #   ready_queues[("shape", rkey)] — default-strategy tasks with
+        #     all deps ready, grouped by resource shape: every entry
+        #     shares placement feasibility, so dispatch tries heads and
+        #     stops at the first resource failure — a saturated pass is
+        #     O(#shapes), not O(#queued).
+        #   ready_queues[_SCAN_KEY] — tasks with explicit scheduling
+        #     strategies (PG/affinity/spread); feasibility varies per
+        #     task, so these keep the budgeted skip-over scan.
+        #   dep_blocked[object_id] — tasks waiting on that object;
+        #     _on_sealed moves them to a ready queue (event-driven, no
+        #     rescans).
+        self.ready_queues: dict[tuple, deque[TaskSpec]] = {}
+        self.dep_blocked: dict[str, list[TaskSpec]] = {}
         self.tasks: dict[str, dict] = {}  # task_id -> state record (state API)
         self.finished_tasks: deque[str] = deque(maxlen=config.task_events_max_buffer)
         self.workers: dict[str, WorkerRecord] = {}
@@ -788,6 +805,19 @@ class Head:
 
     def _on_sealed(self, object_id: str) -> None:
         """Resolve get/wait waiters; wake dependency-blocked tasks. lock held."""
+        blocked = self.dep_blocked.pop(object_id, None)
+        if blocked:
+            for spec in blocked:
+                pending = getattr(spec, "_deps_pending", None)
+                if pending is None:
+                    continue  # already woken (stale index entry)
+                pending.discard(object_id)
+                if pending:
+                    continue  # still waiting on other deps
+                spec._deps_pending = None
+                q = self.ready_queues.setdefault(self._queue_key(spec),
+                                                 deque())
+                q.append(spec)
         for waiter_id, (conn, ids) in list(self.get_waiters.items()):
             if object_id in ids:
                 ids.discard(object_id)
@@ -1121,10 +1151,34 @@ class Head:
             if spec.actor_id is not None:
                 self._enqueue_actor_task(spec)
             else:
-                self.task_queue.append(spec)
+                self._enqueue_task_spec(spec)
                 self._record_lineage(spec)
         self.dispatch_event.set()
         return None
+
+    def _queue_key(self, spec: TaskSpec) -> tuple:
+        if spec.scheduling_strategy is not None:
+            return _SCAN_KEY
+        rkey = getattr(spec, "_rkey", None)
+        if rkey is None:
+            rkey = tuple(sorted(spec.resources.items()))
+            spec._rkey = rkey
+        return ("shape", rkey)
+
+    def _enqueue_task_spec(self, spec: TaskSpec, front: bool = False) -> None:
+        """lock held. Route a normal task to the dependency index (any
+        unready arg) or its ready queue."""
+        # Deduped: f.remote(x, x) lists the dep twice, but the spec must
+        # register under each distinct object exactly once or the seal
+        # wake-up would enqueue (and execute) the task twice.
+        unready = {d for d in spec.deps if not self._is_ready(d)}
+        if unready:
+            spec._deps_pending = unready
+            for d in unready:
+                self.dep_blocked.setdefault(d, []).append(spec)
+            return
+        q = self.ready_queues.setdefault(self._queue_key(spec), deque())
+        q.appendleft(spec) if front else q.append(spec)
 
     def _record_lineage(self, spec: TaskSpec) -> None:
         """lock held. Remember who produces each return id (bounded)."""
@@ -1191,7 +1245,7 @@ class Head:
         if t is not None:
             t["state"] = PENDING
             t["reconstructions"] = used + 1
-        self.task_queue.append(spec)
+        self._enqueue_task_spec(spec)
         self.dispatch_event.set()
         return True
 
@@ -1200,11 +1254,24 @@ class Head:
         # public `cancel(ref)` passes the ref).
         task_id = body["task_id"]
         with self.lock:
-            for spec in list(self.task_queue):
-                if spec.task_id == task_id or task_id in spec.return_ids:
-                    self.task_queue.remove(spec)
-                    self._fail_task(spec, "TaskCancelledError: cancelled before execution")
-                    return {"cancelled": True}
+            for q in self.ready_queues.values():
+                for spec in list(q):
+                    if spec.task_id == task_id or task_id in spec.return_ids:
+                        q.remove(spec)
+                        self._fail_task(spec, "TaskCancelledError: cancelled before execution")
+                        return {"cancelled": True}
+            for oid, specs in list(self.dep_blocked.items()):
+                for spec in specs:
+                    if spec.task_id == task_id or task_id in spec.return_ids:
+                        # Drop it from EVERY dep's wait list, not just
+                        # this one, or a later seal would resurrect it.
+                        for o2, s2 in list(self.dep_blocked.items()):
+                            if spec in s2:
+                                s2.remove(spec)
+                                if not s2:
+                                    del self.dep_blocked[o2]
+                        self._fail_task(spec, "TaskCancelledError: cancelled before execution")
+                        return {"cancelled": True}
             # Running: signal the worker.
             for rec in self.workers.values():
                 if task_id in rec.inflight and rec.conn:
@@ -1770,99 +1837,155 @@ class Head:
                     # Calls parked behind unresolved args: deps may have
                     # sealed since (the seal sets dispatch_event).
                     self._flush_actor(actor)
-            # 2. normal tasks FIFO with skip-over for blocked ones.
-            # Per-pass scan budgets keep a deep backlog LINEAR: without
-            # them a 100k-task flood re-runs pick_node over the whole
-            # queue on every pass (O(N^2) total — observed as a 0%-CPU-
-            # looking livelock at the scale envelope). Once dispatch
-            # saturates (consecutive no-idle-worker misses) or the scan
-            # budget is spent, the rest of the queue carries over
-            # untouched; the next capacity event rescans from the front.
-            requeue: deque[TaskSpec] = deque()
+            # 2. normal tasks. Shape-keyed ready queues make a saturated
+            # pass O(#shapes): every task in a shape queue shares
+            # placement feasibility and default strategy, so dispatch
+            # drains heads until the first resource/worker failure and
+            # moves to the next shape. Dep-blocked tasks never appear
+            # here (they sit in dep_blocked until _on_sealed wakes
+            # them). This loop runs UNDER the head lock — anything
+            # per-queued-task here directly stalls worker put/finish
+            # RPCs, which is why the old single-queue skip-over scan
+            # (O(#queued) per pass, ResourceSet parse per scan) capped
+            # the flood envelope at a few hundred tasks/s.
             spawned = False
-            no_worker_misses = 0
-            scanned = 0
-            # Per-pass memo: a deep backlog is mostly identical specs,
-            # and this loop runs UNDER the head lock — every repeated
-            # pick_node / idle-worker scan here directly stalls worker
-            # put/finish RPCs. Cache keyed by resource shape (default
-            # strategy only); invalidated when an allocation fails.
-            pick_cache: dict = {}
             no_worker: set = set()
-            _MISS = object()
-            while self.task_queue:
-                if no_worker_misses >= 64 or scanned >= 4096:
-                    # Budget exhausted: ROTATE — unscanned tasks go to
-                    # the FRONT of the next pass and the scanned-but-
-                    # unplaced prefix to the back, so a long infeasible
-                    # prefix cannot starve feasible tasks behind it
-                    # (FIFO is already best-effort due to skip-over).
-                    rest = self.task_queue
-                    self.task_queue = deque()
-                    rest.extend(requeue)
-                    requeue = rest
-                    break
-                spec = self.task_queue.popleft()
-                scanned += 1
-                try:
-                    if not self._validate_strategy(spec):
-                        continue  # failed with an error object
-                    if not all(self._is_ready(d) for d in spec.deps):
-                        requeue.append(spec)
-                        continue
-                    strategy = self._resolve_strategy(spec)
-                    if strategy is UNPLACEABLE:
-                        requeue.append(spec)
-                        continue
-                    demand = self._effective_demand(spec.resources, spec.scheduling_strategy)
-                    rkey = (tuple(sorted(spec.resources.items()))
-                            if spec.scheduling_strategy is None else None)
-                    node = pick_cache.get(rkey, _MISS) if rkey is not None \
-                        else _MISS
-                    if node is _MISS:
-                        node = self.scheduler.pick_node(demand, strategy)
-                        if rkey is not None:
-                            pick_cache[rkey] = node
-                    if node is None:
-                        requeue.append(spec)
-                        continue
-                    need_tpu = float(spec.resources.get("TPU", 0)) > 0
-                    if (node.node_id, need_tpu) in no_worker:
-                        requeue.append(spec)
-                        no_worker_misses += 1
-                        continue
-                    rec = self._idle_worker(node.node_id, need_tpu)
-                    if rec is None:
-                        if not spawned and self._can_spawn(node.node_id,
-                                                           need_tpu):
-                            self.spawn_worker(node.node_id,
-                                              tpu_capable=need_tpu)
-                            spawned = True
-                        no_worker.add((node.node_id, need_tpu))
-                        requeue.append(spec)
-                        no_worker_misses += 1
-                        continue
-                    if not self._try_allocate(
-                        rec, node.node_id, spec.resources, spec.scheduling_strategy
-                    ):
-                        pick_cache.pop(rkey, None)
-                        requeue.append(spec)
-                        continue
-                    no_worker_misses = 0
-                    # Drop the memoized pick after a successful dispatch:
-                    # the allocation changed utilization, and the hybrid
-                    # pack/spread policy must see it (native parity). The
-                    # memo then only dedupes the SCAN-miss path, which is
-                    # what made deep backlogs quadratic.
-                    if rkey is not None:
-                        pick_cache.pop(rkey, None)
-                    self._push_to_worker(rec, spec)
-                except Exception:
-                    # One malformed spec must not wedge the dispatch loop or
-                    # drop the requeue of healthy tasks.
-                    traceback.print_exc()
-                    self._fail_task(spec, f"SchedulingError: {traceback.format_exc()}")
-            self.task_queue = requeue
+            for key in [k for k in self.ready_queues if k != _SCAN_KEY]:
+                q = self.ready_queues.get(key)
+                while q:
+                    spec = q[0]
+                    # Tracks whether THIS spec left the queue: the except
+                    # handler must never pop a task it didn't process (a
+                    # failure after the success-path pop would otherwise
+                    # silently drop the NEXT queued task).
+                    popped = False
+                    try:
+                        # Deps were ready at enqueue; free/loss since is
+                        # possible (and rare) — re-route to dep_blocked.
+                        if spec.deps and not all(
+                                self._is_ready(d) for d in spec.deps):
+                            q.popleft()
+                            popped = True
+                            self._enqueue_task_spec(spec)
+                            continue
+                        demand = getattr(spec, "_demand", None)
+                        if demand is None:
+                            demand = self._effective_demand(
+                                spec.resources, None)
+                            spec._demand = demand
+                        node = self.scheduler.pick_node(demand, None)
+                        if node is None:
+                            break  # shape unplaceable until capacity frees
+                        need_tpu = float(spec.resources.get("TPU", 0)) > 0
+                        if (node.node_id, need_tpu) in no_worker:
+                            break
+                        rec = self._idle_worker(node.node_id, need_tpu)
+                        if rec is None:
+                            if not spawned and self._can_spawn(node.node_id,
+                                                               need_tpu):
+                                self.spawn_worker(node.node_id,
+                                                  tpu_capable=need_tpu)
+                                spawned = True
+                            no_worker.add((node.node_id, need_tpu))
+                            break
+                        if not self._try_allocate(rec, node.node_id,
+                                                  spec.resources, None):
+                            break
+                        q.popleft()
+                        popped = True
+                        self._push_to_worker(rec, spec)
+                    except Exception:
+                        # One malformed spec must not wedge the loop.
+                        traceback.print_exc()
+                        if not popped:
+                            q.popleft()
+                        self._fail_task(
+                            spec,
+                            f"SchedulingError: {traceback.format_exc()}")
+                if not q:
+                    self.ready_queues.pop(key, None)
+            # 2b. explicit-strategy tasks (PG bundles, node affinity,
+            # SPREAD): feasibility is per task, so these keep the
+            # budgeted skip-over scan with rotation.
+            scan_q = self.ready_queues.get(_SCAN_KEY)
+            if scan_q:
+                self._dispatch_scan_queue(scan_q, no_worker, spawned)
+                if not scan_q:
+                    self.ready_queues.pop(_SCAN_KEY, None)
+
+    def _dispatch_scan_queue(self, queue, no_worker: set,
+                             spawned: bool) -> None:
+        """lock held. Budgeted skip-over scan for explicit-strategy
+        tasks; on budget exhaustion the queue rotates so a long
+        infeasible prefix cannot starve feasible tasks behind it
+        (FIFO is already best-effort due to skip-over)."""
+        requeue: deque[TaskSpec] = deque()
+        misses = 0
+        scanned = 0
+        while queue:
+            if misses >= 64 or scanned >= 4096:
+                # ROTATE: unscanned tasks go to the FRONT of the next
+                # pass, the scanned-but-unplaced prefix to the back.
+                requeue.extendleft(reversed(queue))
+                queue.clear()
+                break
+            spec = queue.popleft()
+            scanned += 1
+            try:
+                if not self._validate_strategy(spec):
+                    continue  # failed with an error object
+                if not all(self._is_ready(d) for d in spec.deps):
+                    requeue.append(spec)
+                    continue
+                strategy = self._resolve_strategy(spec)
+                if strategy is UNPLACEABLE:
+                    requeue.append(spec)
+                    continue
+                demand = getattr(spec, "_demand", None)
+                if demand is None:
+                    demand = self._effective_demand(
+                        spec.resources, spec.scheduling_strategy)
+                    spec._demand = demand
+                node = self.scheduler.pick_node(demand, strategy)
+                if node is None:
+                    # Not a budgeted miss: feasibility varies per task
+                    # here, and counting currently-infeasible entries
+                    # would end the pass after 64 of them — a feasible
+                    # task behind a few hundred pending-PG tasks would
+                    # then wait many rotations instead of one
+                    # 4096-entry scan.
+                    requeue.append(spec)
+                    continue
+                need_tpu = float(spec.resources.get("TPU", 0)) > 0
+                if (node.node_id, need_tpu) in no_worker:
+                    requeue.append(spec)
+                    misses += 1
+                    continue
+                rec = self._idle_worker(node.node_id, need_tpu)
+                if rec is None:
+                    if not spawned and self._can_spawn(node.node_id,
+                                                       need_tpu):
+                        self.spawn_worker(node.node_id,
+                                          tpu_capable=need_tpu)
+                        spawned = True
+                    no_worker.add((node.node_id, need_tpu))
+                    requeue.append(spec)
+                    misses += 1
+                    continue
+                if not self._try_allocate(
+                    rec, node.node_id, spec.resources,
+                    spec.scheduling_strategy
+                ):
+                    requeue.append(spec)
+                    continue
+                misses = 0
+                self._push_to_worker(rec, spec)
+            except Exception:
+                # One malformed spec must not wedge the dispatch loop or
+                # drop the requeue of healthy tasks.
+                traceback.print_exc()
+                self._fail_task(spec, f"SchedulingError: {traceback.format_exc()}")
+        queue.extend(requeue)
 
     def _validate_strategy(self, spec: TaskSpec) -> bool:
         """Fail specs with malformed strategies up front. lock held."""
@@ -1962,11 +2085,24 @@ class Head:
         node = self.scheduler.pick_node(demand, strategy)
         if node is None:
             return
-        rec = self.spawn_worker(
-            node.node_id,
-            tpu_capable=float(spec.resources.get("TPU", 0)) > 0)
+        need_tpu = float(spec.resources.get("TPU", 0)) > 0
+        # Reuse an idle pool worker instead of forking a fresh
+        # interpreter (reference: WorkerPool::PopWorker serves actor
+        # creation from the pool, raylet/worker_pool.h:224) — actor
+        # spawn drops from ~interpreter-start (250ms+) to one RPC.
+        # Runtime envs are applied in-worker by the creation task, so
+        # any pool worker qualifies — except for TPU actors: a pooled
+        # worker may already have initialized jax on its CPU pin, and
+        # a jax backend cannot be re-pointed at the chips post-import.
+        rec = None if need_tpu else self._idle_worker(node.node_id, False)
+        reused = rec is not None
+        if not reused:
+            rec = self.spawn_worker(node.node_id, tpu_capable=need_tpu)
         rec.actor_id = spec.actor_id
         if not self._try_allocate(rec, node.node_id, spec.resources, spec.scheduling_strategy):
+            if reused:
+                rec.actor_id = None  # back to the pool, untouched
+                return
             if rec.proc is not None:
                 rec.proc.kill()
             # Remote spawn: the worker registers, finds its record gone,
@@ -2135,7 +2271,7 @@ class Head:
                         if t:
                             t["state"] = PENDING
                             t["retries"] = spec.retries_used
-                        self.task_queue.appendleft(spec)
+                        self._enqueue_task_spec(spec, front=True)
                     else:
                         self._fail_task(
                             spec,

@@ -212,19 +212,26 @@ def test_dashboard_memory_profiler():
 
     a = Alloc.remote()
     ray_tpu.get(a.ping.remote(), timeout=30)
-    fut = a.churn.remote(5.0)
+    # Long churn + retried short windows: on a loaded 1-CPU CI box the
+    # churn loop can be starved for a whole 1.5 s window, which is a
+    # scheduling artifact, not a profiler bug.
+    fut = a.churn.remote(20.0)
     time.sleep(0.3)
     head = get_head()
     worker_id = next(w.worker_id for w in head.workers.values()
                      if w.actor_id == a._actor_id and w.proc is not None)
     port = start_dashboard()
     try:
-        out = _get(port,
-                   f"/api/profile/{worker_id}?duration=1.5&mode=memory")
-        allocs = out.get("allocations") or {}
-        assert allocs, out
-        assert sum(v["bytes"] for v in allocs.values()) > 64 * 1024
+        out = {}
+        for _ in range(4):
+            out = _get(port,
+                       f"/api/profile/{worker_id}?duration=1.5&mode=memory")
+            allocs = out.get("allocations") or {}
+            if allocs and sum(v["bytes"] for v in allocs.values()) > 64 * 1024:
+                break
+        else:
+            raise AssertionError(f"no allocations captured in 4 windows: {out}")
     finally:
         stop_dashboard()
-        ray_tpu.get(fut, timeout=30)
+        ray_tpu.get(fut, timeout=60)
         ray_tpu.kill(a)

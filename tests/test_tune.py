@@ -183,13 +183,19 @@ def _curve(config):
 def test_asha_stops_bad_trials(tmp_path):
     grid = tune.Tuner(
         _curve,
-        param_space={"slope": tune.grid_search([1, 2, 3, 4, 5, 6, 7, 8])},
+        # DESCENDING slopes: trials start in grid order under the
+        # concurrency cap, so the second wave (slopes 4..1) reports
+        # rung-1 metrics strictly below the first wave's medians and
+        # some trial is culled under ANY intra-wave arrival order. An
+        # ascending grid is timing-dependent: if each wave's results
+        # arrive in start order, every newcomer beats the running
+        # median and nothing is ever cut (the flake seen under load).
+        param_space={"slope": tune.grid_search([8, 7, 6, 5, 4, 3, 2, 1])},
         tune_config=tune.TuneConfig(
             metric="acc",
             mode="max",
             # grace 1 => rungs at 1,2,4: enough cut points that some trial
-            # is culled under any async arrival order (the flake seen with
-            # grace 2 under machine load was all trials slipping through).
+            # is culled under any async arrival order.
             scheduler=tune.ASHAScheduler(max_t=8, grace_period=1, reduction_factor=2),
             max_concurrent_trials=4,
         ),
