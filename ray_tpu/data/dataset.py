@@ -425,10 +425,14 @@ def from_arrow(table) -> Dataset:
     return Dataset([InputData(blocks=[table])])
 
 
-def from_pandas(df) -> Dataset:
+def _df_to_block(df):
     import pyarrow as pa
 
-    return Dataset([InputData(blocks=[pa.Table.from_pandas(df, preserve_index=False)])])
+    return pa.Table.from_pandas(df, preserve_index=False)
+
+
+def from_pandas(df) -> Dataset:
+    return Dataset([InputData(blocks=[_df_to_block(df)])])
 
 
 def read_parquet(paths, *, columns: list[str] | None = None) -> Dataset:
@@ -467,6 +471,77 @@ def read_sql(sql: str, connection_factory) -> Dataset:
     callable returning a fresh connection (picklable, runs on the
     executing worker)."""
     return Dataset([Read(tasks=ds_mod.sql_tasks(sql, connection_factory))])
+
+
+def read_avro(paths) -> Dataset:
+    """Avro object-container files, decoded without an avro-package
+    dependency (reference: read_avro, datasource/avro_datasource.py)."""
+    return Dataset([Read(tasks=ds_mod.avro_tasks(paths))])
+
+
+def read_webdataset(paths, *, decode: bool = True) -> Dataset:
+    """WebDataset tar shards: files sharing a basename form one sample
+    (reference: read_webdataset, datasource/webdataset_datasource.py)."""
+    return Dataset([Read(tasks=ds_mod.webdataset_tasks(paths, decode=decode))])
+
+
+def read_parquet_bulk(paths, *, columns: list[str] | None = None) -> Dataset:
+    """One block per file with no cross-file metadata/schema
+    unification up front (reference: read_parquet_bulk, read_api.py —
+    the many-small-files fast path). Our parquet reader is already
+    per-file, so this differs from read_parquet only in skipping
+    directory expansion niceties the slow path adds later."""
+    return Dataset([Read(tasks=ds_mod.parquet_tasks(paths, columns))])
+
+
+def from_blocks(blocks: list) -> Dataset:
+    """Dataset over pre-built blocks (reference: from_blocks,
+    read_api.py)."""
+    return Dataset([InputData(blocks=list(blocks))])
+
+
+def _get_refs(refs) -> list:
+    import ray_tpu
+
+    if not isinstance(refs, (list, tuple)):
+        refs = [refs]
+    return ray_tpu.get(list(refs))
+
+
+def from_pandas_refs(refs) -> Dataset:
+    """Dataset from ObjectRefs of pandas DataFrames (reference:
+    from_pandas_refs, read_api.py)."""
+    return Dataset([InputData(blocks=[_df_to_block(df)
+                                      for df in _get_refs(refs)])])
+
+
+def from_numpy_refs(refs) -> Dataset:
+    """Dataset from ObjectRefs of numpy arrays (reference:
+    from_numpy_refs, read_api.py)."""
+    return Dataset([InputData(blocks=[{"data": a} for a in _get_refs(refs)])])
+
+
+def from_arrow_refs(refs) -> Dataset:
+    """Dataset from ObjectRefs of Arrow tables (reference:
+    from_arrow_refs, read_api.py)."""
+    return Dataset([InputData(blocks=_get_refs(refs))])
+
+
+def from_tf(tf_dataset) -> Dataset:
+    """Ingest a tf.data.Dataset by materializing it (reference: from_tf,
+    read_api.py — likewise eager: 'loads the entire dataset into
+    memory')."""
+    rows = []
+    for item in tf_dataset.as_numpy_iterator():
+        if isinstance(item, dict):
+            rows.append(item)
+        elif isinstance(item, (tuple, list)):
+            rows.append({f"item_{i}": v for i, v in enumerate(item)})
+        else:
+            rows.append({"item": item})
+    from ray_tpu.data.block import BlockAccessor
+
+    return Dataset([InputData(blocks=[BlockAccessor.from_rows(rows)])])
 
 
 def read_images(paths, *, size: "tuple | None" = None, mode: str = "RGB",

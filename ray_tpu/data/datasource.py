@@ -392,6 +392,253 @@ def sql_tasks(sql: str, connection_factory) -> list[ReadTask]:
     return [ReadTask(read)]  # row/byte counts unknown until the query runs
 
 
+# ---------------------------------------------------------------------------
+# Avro Object Container Files — self-contained binary decoder (reference:
+# data read_avro, datasource/avro_datasource.py, which delegates to the
+# `avro` package; this image ships no avro lib, so the container format
+# and binary encoding are implemented directly from the Avro 1.11 spec).
+# ---------------------------------------------------------------------------
+
+
+class _AvroReader:
+    """Streaming decoder over one Avro container file."""
+
+    MAGIC = b"Obj\x01"
+
+    def __init__(self, data: bytes):
+        self.buf = data
+        self.pos = 0
+        if data[:4] != self.MAGIC:
+            raise ValueError("not an Avro object container file (bad magic)")
+        self.pos = 4
+        meta = self._map_bytes()
+        import json as _json
+
+        self.schema = _json.loads(meta[b"avro.schema"].decode())
+        self.codec = meta.get(b"avro.codec", b"null").decode()
+        if self.codec not in ("null", "deflate"):
+            raise ValueError(f"unsupported Avro codec {self.codec!r}")
+        self.sync = self._fixed(16)
+        # Named-type registry so schemas can reference records/enums/
+        # fixed by name.
+        self.named: dict = {}
+        self._register(self.schema)
+
+    # -- varint/zigzag primitives ------------------------------------------
+
+    def _long(self) -> int:
+        shift = 0
+        acc = 0
+        while True:
+            b = self.buf[self.pos]
+            self.pos += 1
+            acc |= (b & 0x7F) << shift
+            if not b & 0x80:
+                break
+            shift += 7
+        return (acc >> 1) ^ -(acc & 1)  # zigzag
+
+    def _fixed(self, n: int) -> bytes:
+        out = self.buf[self.pos:self.pos + n]
+        self.pos += n
+        return out
+
+    def _bytes(self) -> bytes:
+        return self._fixed(self._long())
+
+    def _map_bytes(self) -> dict:
+        out = {}
+        while True:
+            n = self._long()
+            if n == 0:
+                break
+            if n < 0:  # block with byte size prefix
+                n = -n
+                self._long()
+            for _ in range(n):
+                k = self._bytes()
+                out[k] = self._bytes()
+        return out
+
+    # -- schema-driven decode ----------------------------------------------
+
+    def _register(self, schema, namespace: str = "") -> None:
+        if isinstance(schema, dict):
+            t = schema.get("type")
+            if t in ("record", "enum", "fixed") and "name" in schema:
+                # Spec naming: a name may carry its own namespace (or a
+                # dotted fullname); otherwise it inherits the enclosing
+                # one. Register BOTH the fullname and the short name so
+                # either reference style resolves.
+                name = schema["name"]
+                if "." in name:
+                    namespace, _, name = name.rpartition(".")
+                else:
+                    namespace = schema.get("namespace", namespace)
+                self.named[name] = schema
+                if namespace:
+                    self.named[f"{namespace}.{name}"] = schema
+            if t == "record":
+                for f in schema.get("fields", ()):
+                    self._register(f.get("type"), namespace)
+            elif t == "array":
+                self._register(schema.get("items"), namespace)
+            elif t == "map":
+                self._register(schema.get("values"), namespace)
+        elif isinstance(schema, list):
+            for s in schema:
+                self._register(s, namespace)
+
+    def _decode(self, schema):
+        if isinstance(schema, str):
+            schema = self.named.get(schema, schema)
+        if isinstance(schema, list):  # union: long index, then value
+            return self._decode(schema[self._long()])
+        if isinstance(schema, dict):
+            t = schema["type"]
+            if t == "record":
+                return {f["name"]: self._decode(f["type"])
+                        for f in schema["fields"]}
+            if t == "array":
+                out = []
+                while True:
+                    n = self._long()
+                    if n == 0:
+                        break
+                    if n < 0:
+                        n = -n
+                        self._long()  # skip block byte size
+                    out.extend(self._decode(schema["items"])
+                               for _ in range(n))
+                return out
+            if t == "map":
+                out = {}
+                while True:
+                    n = self._long()
+                    if n == 0:
+                        break
+                    if n < 0:
+                        n = -n
+                        self._long()
+                    for _ in range(n):
+                        key = self._fixed(self._long()).decode()
+                        out[key] = self._decode(schema["values"])
+                return out
+            if t == "enum":
+                return schema["symbols"][self._long()]
+            if t == "fixed":
+                return self._fixed(schema["size"])
+            schema = t  # primitive spelled as {"type": "long"} etc.
+        if schema == "null":
+            return None
+        if schema == "boolean":
+            b = self.buf[self.pos]
+            self.pos += 1
+            return bool(b)
+        if schema in ("int", "long"):
+            return self._long()
+        if schema == "float":
+            import struct
+
+            (v,) = struct.unpack("<f", self._fixed(4))
+            return v
+        if schema == "double":
+            import struct
+
+            (v,) = struct.unpack("<d", self._fixed(8))
+            return v
+        if schema == "bytes":
+            return self._bytes()
+        if schema == "string":
+            return self._bytes().decode()
+        raise ValueError(f"unsupported Avro schema {schema!r}")
+
+    def records(self):
+        import zlib
+
+        while self.pos < len(self.buf):
+            count = self._long()
+            size = self._long()
+            payload = self._fixed(size)
+            if self.codec == "deflate":
+                payload = zlib.decompress(payload, -15)
+            sub = _AvroReader.__new__(_AvroReader)
+            sub.buf, sub.pos = payload, 0
+            sub.schema, sub.named = self.schema, self.named
+            for _ in range(count):
+                yield sub._decode(self.schema)
+            if self._fixed(16) != self.sync:
+                raise ValueError("Avro sync-marker mismatch (corrupt block)")
+
+
+def avro_tasks(paths) -> list[ReadTask]:
+    def read(path):
+        with open(path, "rb") as f:
+            rows = list(_AvroReader(f.read()).records())
+        if rows:
+            from ray_tpu.data.block import BlockAccessor
+
+            yield BlockAccessor.from_rows(
+                [r if isinstance(r, dict) else {"value": r} for r in rows])
+
+    return _file_tasks(paths, read)
+
+
+def webdataset_tasks(paths, *, decode: bool = True) -> list[ReadTask]:
+    """Tar shards of samples (reference: data read_webdataset,
+    datasource/webdataset_datasource.py). Files sharing a basename up to
+    the first dot form one sample; the remaining extension names the
+    column. ``decode`` converts .txt/.json/.cls payloads (text, JSON,
+    int class id); every other field stays raw bytes."""
+    def read(path):
+        import json as _json
+        import tarfile
+
+        rows: list[dict] = []
+        cur_key = None
+        cur: dict = {}
+        with tarfile.open(path, "r:*") as tf:
+            for info in tf:
+                if not info.isfile():
+                    continue
+                # Key = full path up to the first dot of the BASENAME
+                # (directories included): same-named files in different
+                # tar directories are distinct samples.
+                dirname, base = os.path.split(info.name)
+                stem, _, ext = base.partition(".")
+                key = f"{dirname}/{stem}" if dirname else stem
+                if key != cur_key:
+                    if cur:
+                        rows.append(cur)
+                    cur_key, cur = key, {"__key__": key}
+                payload = tf.extractfile(info).read()
+                if decode:
+                    if ext in ("txt", "text"):
+                        payload = payload.decode()
+                    elif ext == "json":
+                        payload = _json.loads(payload)
+                    elif ext == "cls":
+                        payload = int(payload.decode().strip())
+                cur[ext] = payload
+        if cur:
+            rows.append(cur)
+        if rows:
+            from ray_tpu.data.block import BlockAccessor
+
+            # Samples may carry heterogeneous fields (optional captions
+            # or metadata); normalize to the union so from_rows (which
+            # derives columns from the first row) neither drops fields
+            # nor KeyErrors.
+            cols: dict = {}
+            for r in rows:
+                for k in r:
+                    cols.setdefault(k, None)
+            rows = [{k: r.get(k) for k in cols} for r in rows]
+            yield BlockAccessor.from_rows(rows)
+
+    return _file_tasks(paths, read)
+
+
 _IMAGE_EXTS = (".png", ".jpg", ".jpeg", ".bmp", ".gif", ".webp", ".tif",
                ".tiff")
 

@@ -410,3 +410,187 @@ def test_trainer_consumes_streaming_split(tmp_path):
         assert result.metrics["shard_sum"] >= 0
     finally:
         ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# round-3 datasources: avro, webdataset, refs, tf
+
+
+def _zigzag(n: int) -> bytes:
+    # Independent encoder (not the reader's code) per the Avro 1.11 spec.
+    u = (n << 1) ^ (n >> 63)
+    out = bytearray()
+    while True:
+        b = u & 0x7F
+        u >>= 7
+        if u:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _avro_bytes(b: bytes) -> bytes:
+    return _zigzag(len(b)) + b
+
+
+def _write_avro(path, rows, codec=b"null"):
+    import json
+    import struct
+    import zlib
+
+    schema = {
+        "type": "record", "name": "R",
+        "fields": [
+            {"name": "id", "type": "long"},
+            {"name": "name", "type": "string"},
+            {"name": "score", "type": "double"},
+            {"name": "tags", "type": {"type": "array", "items": "string"}},
+            {"name": "opt", "type": ["null", "long"]},
+        ],
+    }
+    body = bytearray()
+    for r in rows:
+        body += _zigzag(r["id"])
+        body += _avro_bytes(r["name"].encode())
+        body += struct.pack("<d", r["score"])
+        if r["tags"]:
+            body += _zigzag(len(r["tags"]))
+            for t in r["tags"]:
+                body += _avro_bytes(t.encode())
+        body += _zigzag(0)  # array terminator
+        if r["opt"] is None:
+            body += _zigzag(0)
+        else:
+            body += _zigzag(1) + _zigzag(r["opt"])
+    payload = bytes(body)
+    if codec == b"deflate":
+        payload = zlib.compress(payload)[2:-4]  # raw deflate
+    sync = b"S" * 16
+    meta = (_zigzag(2)
+            + _avro_bytes(b"avro.schema")
+            + _avro_bytes(json.dumps(schema).encode())
+            + _avro_bytes(b"avro.codec") + _avro_bytes(codec)
+            + _zigzag(0))
+    with open(path, "wb") as f:
+        f.write(b"Obj\x01" + meta + sync)
+        f.write(_zigzag(len(rows)) + _zigzag(len(payload)) + payload + sync)
+
+
+ROWS = [
+    {"id": 1, "name": "a", "score": 0.5, "tags": ["x", "y"], "opt": None},
+    {"id": -7, "name": "bb", "score": -2.25, "tags": [], "opt": 42},
+    {"id": 2**40, "name": "", "score": 0.0, "tags": ["z"], "opt": -1},
+]
+
+
+@pytest.mark.parametrize("codec", [b"null", b"deflate"])
+def test_read_avro(tmp_path, codec):
+    p = str(tmp_path / "f.avro")
+    _write_avro(p, ROWS, codec=codec)
+    got = ray_tpu.data.read_avro(p).take_all()
+    assert got == ROWS
+
+
+def test_read_webdataset(tmp_path):
+    import io
+    import json
+    import tarfile
+
+    p = str(tmp_path / "shard-000.tar")
+    with tarfile.open(p, "w") as tf:
+        for key, cls, meta in [("s1", 3, {"a": 1}), ("s2", 9, {"b": 2})]:
+            for ext, payload in [
+                ("jpg", b"\xff\xd8fakejpeg"),
+                ("cls", str(cls).encode()),
+                ("json", json.dumps(meta).encode()),
+                ("txt", f"caption of {key}".encode()),
+            ]:
+                data = payload
+                info = tarfile.TarInfo(f"{key}.{ext}")
+                info.size = len(data)
+                tf.addfile(info, io.BytesIO(data))
+    rows = ray_tpu.data.read_webdataset(p).take_all()
+    assert [r["__key__"] for r in rows] == ["s1", "s2"]
+    assert rows[0]["cls"] == 3 and rows[1]["cls"] == 9
+    assert rows[0]["json"] == {"a": 1}
+    assert rows[0]["txt"] == "caption of s1"
+    assert rows[0]["jpg"].startswith(b"\xff\xd8")  # raw bytes kept
+
+
+def test_from_refs_and_blocks():
+    import numpy as np
+    import pandas as pd
+    import pyarrow as pa
+
+    df = pd.DataFrame({"x": [1, 2, 3]})
+    tbl = pa.Table.from_pandas(pd.DataFrame({"x": [4, 5]}),
+                               preserve_index=False)
+    arr = np.arange(4)
+    ds = ray_tpu.data.from_pandas_refs([ray_tpu.put(df)])
+    assert [r["x"] for r in ds.take_all()] == [1, 2, 3]
+    ds = ray_tpu.data.from_arrow_refs([ray_tpu.put(tbl)])
+    assert [r["x"] for r in ds.take_all()] == [4, 5]
+    ds = ray_tpu.data.from_numpy_refs([ray_tpu.put(arr)])
+    assert [r["data"] for r in ds.take_all()] == [0, 1, 2, 3]
+    ds = ray_tpu.data.from_blocks([{"x": np.array([7, 8])}])
+    assert [r["x"] for r in ds.take_all()] == [7, 8]
+
+
+def test_from_tf():
+    tf = pytest.importorskip("tensorflow")
+    tfds = tf.data.Dataset.from_tensor_slices({"a": [1, 2, 3],
+                                               "b": [4.0, 5.0, 6.0]})
+    ds = ray_tpu.data.from_tf(tfds)
+    rows = ds.take_all()
+    assert [int(r["a"]) for r in rows] == [1, 2, 3]
+    assert [float(r["b"]) for r in rows] == [4.0, 5.0, 6.0]
+
+
+def test_webdataset_heterogeneous_and_dirs(tmp_path):
+    import io
+    import tarfile
+
+    p = str(tmp_path / "s.tar")
+    with tarfile.open(p, "w") as tf:
+        # a/0001 and b/0001: same basename, different dirs = 2 samples;
+        # only a/ has a txt (optional field).
+        for name, data in [("a/0001.jpg", b"ja"), ("a/0001.txt", b"ca"),
+                           ("b/0001.jpg", b"jb")]:
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+    rows = ray_tpu.data.read_webdataset(p).take_all()
+    assert [r["__key__"] for r in rows] == ["a/0001", "b/0001"]
+    assert rows[0]["jpg"] == b"ja" and rows[1]["jpg"] == b"jb"
+    assert rows[0]["txt"] == "ca" and rows[1]["txt"] is None
+
+
+def test_avro_namespaced_named_types(tmp_path):
+    import json
+    import struct
+
+    # Record with an enum referenced by FULLNAME (what most writers emit).
+    schema = {
+        "type": "record", "name": "R", "namespace": "com.x",
+        "fields": [
+            {"name": "color",
+             "type": {"type": "enum", "name": "Color",
+                      "symbols": ["RED", "BLUE"]}},
+            {"name": "again", "type": "com.x.Color"},
+        ],
+    }
+    body = _zigzag(0) + _zigzag(1) + _zigzag(1) + _zigzag(0)  # RED,BLUE,BLUE,RED
+    sync = b"S" * 16
+    meta = (_zigzag(2)
+            + _avro_bytes(b"avro.schema")
+            + _avro_bytes(json.dumps(schema).encode())
+            + _avro_bytes(b"avro.codec") + _avro_bytes(b"null")
+            + _zigzag(0))
+    p = str(tmp_path / "ns.avro")
+    with open(p, "wb") as f:
+        f.write(b"Obj\x01" + meta + sync)
+        f.write(_zigzag(2) + _zigzag(len(body)) + body + sync)
+    rows = ray_tpu.data.read_avro(p).take_all()
+    assert rows == [{"color": "RED", "again": "BLUE"},
+                    {"color": "BLUE", "again": "RED"}]
