@@ -68,6 +68,12 @@ class LLMConfig:
     tokenizer: str = "byte"
     # Sharding: number of mesh devices for tensor parallelism (1 = none).
     tensor_parallel_size: int = 1
+    # Pipeline parallelism (reference: vllm_engine_stage.py:647
+    # pipeline_parallel_size): layer segments shard over a pipeline mesh
+    # axis via shard_map (llm/pp_runner.py) — buys model-size capacity
+    # beyond one chip. Mutually exclusive with tensor_parallel_size > 1,
+    # chunked prefill, prefix caching, and speculative decoding for now.
+    pipeline_parallel_size: int = 1
     sampling_defaults: SamplingParams = field(default_factory=SamplingParams)
     # Optional checkpoint directory (orbax/npz) to load params from.
     checkpoint_path: str | None = None

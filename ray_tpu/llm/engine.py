@@ -85,7 +85,33 @@ class LLMEngine:
             else:
                 params = tfm.init_params(jax.random.PRNGKey(config.seed), c)
         B = config.max_num_seqs
-        cache = model_runner.init_slot_cache(c, B, self.max_len)
+        # Pipeline parallelism (reference: vllm_engine_stage.py:647):
+        # stage-sliced params + cache through shard_map (pp_runner.py).
+        # The runner mirrors model_runner's prefill/decode signatures, so
+        # the host-side scheduler below is identical either way.
+        self._mr = model_runner
+        pp = int(getattr(config, "pipeline_parallel_size", 1) or 1)
+        if pp > 1:
+            if int(config.tensor_parallel_size or 1) > 1:
+                raise NotImplementedError(
+                    "pipeline_parallel_size and tensor_parallel_size "
+                    "cannot be combined yet")
+            if config.prefill_chunk:
+                raise NotImplementedError(
+                    "chunked prefill is not supported with "
+                    "pipeline_parallel_size > 1")
+            if config.enable_prefix_caching:
+                raise NotImplementedError(
+                    "prefix caching is not supported with "
+                    "pipeline_parallel_size > 1")
+            if config.resolve_speculative_model() is not None:
+                raise NotImplementedError(
+                    "speculative decoding is not supported with "
+                    "pipeline_parallel_size > 1")
+            from ray_tpu.llm.pp_runner import PPRunner
+
+            self._mr = PPRunner(c, pp)
+        cache = self._mr.init_slot_cache(c, B, self.max_len)
         # Tensor parallelism (reference: vllm_engine_stage.py:646
         # tensor_parallel_size): TPU-natively this is pure PLACEMENT —
         # shard weights megatron-style (models.partition_specs) and the
@@ -94,7 +120,9 @@ class LLMEngine:
         # inserting the per-block psums. No second code path.
         self.mesh = None
         tp = int(config.tensor_parallel_size or 1)
-        if tp > 1:
+        if pp > 1:
+            params = self._mr.shard_params(params)
+        elif tp > 1:
             devs = jax.devices()
             if len(devs) < tp:
                 raise ValueError(
@@ -250,7 +278,7 @@ class LLMEngine:
             if off == 0 and len(part) == L:
                 # Whole prompt in one go: within-chunk attention ([S,S]
                 # scores, no history pass) is the cheapest path.
-                last_logits, self.cache = model_runner.prefill(
+                last_logits, self.cache = self._mr.prefill(
                     self.params, jnp.asarray(padded), jnp.int32(len(part)),
                     jnp.int32(slot), self.cache, config=self.model_config,
                 )
@@ -410,7 +438,7 @@ class LLMEngine:
                     jnp.asarray(self.temps), dkey,
                     config=self.draft["config"])
         self._rng, key = jax.random.split(self._rng)
-        toks, _logits, self.cache = model_runner.decode(
+        toks, _logits, self.cache = self._mr.decode(
             self.params,
             jnp.asarray(self.last_tokens),
             jnp.asarray(self.positions),

@@ -74,27 +74,23 @@ def _final_logits(x, params, c, dt):
                       preferred_element_type=jnp.float32)
 
 
-@partial(jax.jit, static_argnames=("config",), donate_argnames=("cache",))
-def prefill(params, tokens, true_len, slot, cache, *, config: TransformerConfig):
-    """Run one padded prompt [1, S] and write K/V into cache slot.
-
-    Returns (last_logits [V] float32, cache'). ``true_len`` is the
-    unpadded prompt length; the returned logits are taken at position
-    true_len-1, so right-padding never leaks into the first sampled
-    token (causal attention at that position only sees real tokens).
-    """
-    c = config
-    dt = c.compute_dtype
-    _, S = tokens.shape
-    positions = jnp.arange(S)
-
+def embed_tokens(params, tokens, positions, c, dt):
+    """Token (+ learned-position / rope-table) embedding shared by the
+    single-program and pipeline runners. Returns (x, rope) where rope is
+    None for gpt2 or the (cos, sin) tables for rope archs."""
     x = params["embed"]["tokens"][tokens].astype(dt)
     if c.arch == "gpt2":
         x = x + params["embed"]["pos"][positions].astype(dt)
-        rope = None
-    else:
-        rope = rope_frequencies(c.head_dim, c.max_seq_len, theta=c.rope_theta)
+        return x, None
+    return x, rope_frequencies(c.head_dim, c.max_seq_len,
+                               theta=c.rope_theta)
 
+
+def make_prefill_body(c, dt, positions, rope, slot):
+    """Per-layer scan body for whole-prompt prefill: xs = (layer params,
+    layer k-cache [slots,T,KV,Dh], layer v-cache). Shared by prefill()
+    and the pipeline runner's stage segments so attention/masking/dtype
+    fixes can never diverge between them."""
     def body(x, xs):
         lp, kc, vc = xs
         h = _norm1(x, lp, c)
@@ -112,6 +108,69 @@ def prefill(params, tokens, true_len, slot, cache, *, config: TransformerConfig)
         x = x + o
         return x + _mlp(x, lp, c, dt), (kc, vc)
 
+    return body
+
+
+def make_decode_body(c, dt, positions, rope_tables, kmask, barange):
+    """Per-layer scan body for the all-slots decode step: xs = (layer
+    params, layer k-cache [B,T,KV,Dh], layer v-cache). ``rope_tables``
+    are the per-slot [B,1,1,Dh/2] cos/sin gathers (None for gpt2)."""
+    def rot(t):  # t: [B, 1, H, Dh]
+        cb, sb = rope_tables
+        t1, t2 = jnp.split(t.astype(jnp.float32), 2, axis=-1)
+        return jnp.concatenate([t1 * cb - t2 * sb, t2 * cb + t1 * sb],
+                               axis=-1).astype(t.dtype)
+
+    def body(x, xs):
+        lp, kc, vc = xs
+        h = _norm1(x, lp, c)
+        q = jnp.einsum("btd,dhk->bthk", h, lp["attn"]["wq"].astype(dt))
+        k = jnp.einsum("btd,dhk->bthk", h, lp["attn"]["wk"].astype(dt))
+        v = jnp.einsum("btd,dhk->bthk", h, lp["attn"]["wv"].astype(dt))
+        if rope_tables is not None:
+            q, k = rot(q), rot(k)
+        kc = kc.at[barange, positions].set(k[:, 0])
+        vc = vc.at[barange, positions].set(v[:, 0])
+        kf, vf = _expand_gqa(kc, vc, c)  # [B, T, H, Dh]
+        scale = 1.0 / (c.head_dim ** 0.5)
+        scores = jnp.einsum("bshk,bthk->bhst", (q * scale).astype(jnp.float32),
+                            kf.astype(jnp.float32))  # [B, H, 1, T]
+        scores = jnp.where(kmask[:, None, None, :], scores, -1e30)
+        p = jax.nn.softmax(scores, axis=-1)
+        o = jnp.einsum("bhst,bthk->bshk", p, vf.astype(jnp.float32)).astype(dt)
+        o = jnp.einsum("bshk,hkd->bsd", o, lp["attn"]["wo"].astype(dt))
+        x = x + o
+        return x + _mlp(x, lp, c, dt), (kc, vc)
+
+    return body
+
+
+def sample_tokens(logits, temperature, rng):
+    """In-program sampling: greedy where temperature == 0, categorical
+    otherwise. logits [B, V] float32."""
+    B = logits.shape[0]
+    greedy = logits.argmax(-1).astype(jnp.int32)
+    temp = jnp.clip(temperature, 1e-6, None)[:, None]
+    keys = jax.random.split(rng, B)
+    sampled = jax.vmap(jax.random.categorical)(keys, logits / temp).astype(jnp.int32)
+    return jnp.where(temperature <= 0.0, greedy, sampled)
+
+
+@partial(jax.jit, static_argnames=("config",), donate_argnames=("cache",))
+def prefill(params, tokens, true_len, slot, cache, *, config: TransformerConfig):
+    """Run one padded prompt [1, S] and write K/V into cache slot.
+
+    Returns (last_logits [V] float32, cache'). ``true_len`` is the
+    unpadded prompt length; the returned logits are taken at position
+    true_len-1, so right-padding never leaks into the first sampled
+    token (causal attention at that position only sees real tokens).
+    """
+    c = config
+    dt = c.compute_dtype
+    _, S = tokens.shape
+    positions = jnp.arange(S)
+    x, rope = embed_tokens(params, tokens, positions, c, dt)
+    body = make_prefill_body(c, dt, positions, rope, slot)
     x, (k_new, v_new) = jax.lax.scan(
         body, x, (params["layers"], cache["k"], cache["v"])
     )
@@ -297,53 +356,20 @@ def decode(params, tokens, positions, cache, temperature, rng,
     dt = c.compute_dtype
     B = tokens.shape[0]
     T = cache["k"].shape[2]
-    barange = jnp.arange(B)
-
-    x = params["embed"]["tokens"][tokens][:, None, :].astype(dt)  # [B,1,D]
-    if c.arch == "gpt2":
-        x = x + params["embed"]["pos"][positions][:, None, :].astype(dt)
-        rope = None
-    else:
-        cos, sin = rope_frequencies(c.head_dim, c.max_seq_len, theta=c.rope_theta)
+    x, rope = embed_tokens(params, tokens[:, None], positions[:, None],
+                           c, dt)  # [B,1,D]
+    rope_tables = None
+    if rope is not None:
+        cos, sin = rope
         # Per-slot rotation tables [B, 1, 1, Dh/2].
-        rope = (cos[positions][:, None, None, :], sin[positions][:, None, None, :])
-
-    def rot(t):  # t: [B, 1, H, Dh]
-        cb, sb = rope
-        t1, t2 = jnp.split(t.astype(jnp.float32), 2, axis=-1)
-        return jnp.concatenate([t1 * cb - t2 * sb, t2 * cb + t1 * sb],
-                               axis=-1).astype(t.dtype)
-
+        rope_tables = (cos[positions][:, None, None, :],
+                       sin[positions][:, None, None, :])
     kmask = (jnp.arange(T)[None, :] <= positions[:, None])  # [B, T]
-
-    def body(x, xs):
-        lp, kc, vc = xs  # kc/vc: [B, T, KV, Dh]
-        h = _norm1(x, lp, c)
-        q = jnp.einsum("btd,dhk->bthk", h, lp["attn"]["wq"].astype(dt))
-        k = jnp.einsum("btd,dhk->bthk", h, lp["attn"]["wk"].astype(dt))
-        v = jnp.einsum("btd,dhk->bthk", h, lp["attn"]["wv"].astype(dt))
-        if rope is not None:
-            q, k = rot(q), rot(k)
-        kc = kc.at[barange, positions].set(k[:, 0])
-        vc = vc.at[barange, positions].set(v[:, 0])
-        kf, vf = _expand_gqa(kc, vc, c)  # [B, T, H, Dh]
-        scale = 1.0 / (c.head_dim ** 0.5)
-        scores = jnp.einsum("bshk,bthk->bhst", (q * scale).astype(jnp.float32),
-                            kf.astype(jnp.float32))  # [B, H, 1, T]
-        scores = jnp.where(kmask[:, None, None, :], scores, -1e30)
-        p = jax.nn.softmax(scores, axis=-1)
-        o = jnp.einsum("bhst,bthk->bshk", p, vf.astype(jnp.float32)).astype(dt)
-        o = jnp.einsum("bshk,hkd->bsd", o, lp["attn"]["wo"].astype(dt))
-        x = x + o
-        return x + _mlp(x, lp, c, dt), (kc, vc)
-
+    body = make_decode_body(c, dt, positions, rope_tables, kmask,
+                            jnp.arange(B))
     x, (k_new, v_new) = jax.lax.scan(
         body, x, (params["layers"], cache["k"], cache["v"])
     )
     logits = _final_logits(x, params, c, dt)[:, 0]  # [B, V]
-    greedy = logits.argmax(-1).astype(jnp.int32)
-    temp = jnp.clip(temperature, 1e-6, None)[:, None]
-    keys = jax.random.split(rng, B)
-    sampled = jax.vmap(jax.random.categorical)(keys, logits / temp).astype(jnp.int32)
-    toks = jnp.where(temperature <= 0.0, greedy, sampled)
+    toks = sample_tokens(logits, temperature, rng)
     return toks, logits, {"k": k_new, "v": v_new}
