@@ -65,6 +65,9 @@ def main():
         # of optax/gnorm HBM passes per step.
         dict(loss_chunk=4096, vocab_size=50304),     # bench config
         dict(loss_chunk=4096),                       # unpadded baseline
+        # Accuracy metric off: saves the per-chunk argmax sweep over the
+        # float32 logits (fwd + remat recompute).
+        dict(loss_chunk=4096, vocab_size=50304, ce_accuracy=False),
         dict(batch=28, loss_chunk=4096, vocab_size=50304),
         dict(batch=32, loss_chunk=4096, vocab_size=50304),
         dict(batch=20, loss_chunk=4096, vocab_size=50304),

@@ -81,6 +81,11 @@ class TransformerConfig:
     # tensor is never materialized (peak-memory, not FLOPs, is what caps
     # batch size on a single chip). 0 = off (single fused head matmul).
     loss_chunk: int = 0
+    # Token-accuracy metric in the CE loss: an argmax sweep over the
+    # [*, vocab] float32 logits per chunk, in the forward AND its remat
+    # recompute. Throughput-bench configs turn it off (the metric dict
+    # then reports accuracy 0.0).
+    ce_accuracy: bool = True
     # Mixture of Experts (llama arch only; 0 = dense FFN). Greenfield vs
     # the reference (SURVEY.md §2.4: EP absent upstream) — see ops/moe.py.
     n_experts: int = 0
@@ -502,7 +507,7 @@ def cross_entropy_loss(logits, targets, *, mask=None, z_loss: float = 0.0):
 
 
 def chunked_ce_loss(x, head, targets, *, mask=None, z_loss: float = 0.0,
-                    chunk: int = 2048):
+                    chunk: int = 2048, accuracy: bool = True):
     """CE over a chunked LM head: x [B,T,D] (final hidden), head [D,V].
 
     Logits exist only chunk-at-a-time inside a remat'd lax.scan — the
@@ -537,9 +542,10 @@ def chunked_ce_loss(x, head, targets, *, mask=None, z_loss: float = 0.0,
         nll = lse - gold
         if z_loss:
             nll = nll + z_loss * jnp.square(lse)
-        correct = (logits.argmax(-1) == tb).astype(jnp.float32)
-        return (nll_sum + (nll * mb).sum(),
-                correct_sum + (correct * mb).sum()), None
+        if accuracy:
+            correct = (logits.argmax(-1) == tb).astype(jnp.float32)
+            correct_sum = correct_sum + (correct * mb).sum()
+        return (nll_sum + (nll * mb).sum(), correct_sum), None
 
     (nll_sum, correct_sum), _ = jax.lax.scan(
         body, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
@@ -572,7 +578,8 @@ def lm_loss(params, batch, config: TransformerConfig, *, mesh=None,
                 else params["lm_head"]).astype(config.compute_dtype)
         loss, metrics = chunked_ce_loss(x, head, tgt, mask=mask,
                                         z_loss=z_loss,
-                                        chunk=config.loss_chunk)
+                                        chunk=config.loss_chunk,
+                                        accuracy=config.ce_accuracy)
     else:
         logits, aux = forward(params, inp, config, mesh=mesh, return_aux=True)
         loss, metrics = cross_entropy_loss(logits, tgt, mask=mask,
