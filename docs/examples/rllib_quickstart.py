@@ -1,0 +1,24 @@
+"""RLlib doc-code (reference analogue:
+doc/source/rllib/doc_code/getting_started.py — PPO on CartPole)."""
+
+import ray_tpu
+from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
+
+ray_tpu.init(num_cpus=2, object_store_memory=64 * 1024 * 1024)
+
+config = (
+    PPOConfig()
+    .environment("CartPole-v1")
+    .env_runners(num_env_runners=0)   # sample in-process for doc speed
+    .training(train_batch_size=256, minibatch_size=64, num_epochs=2)
+)
+algo = PPO(config)
+r1 = algo.train()
+assert "env_runners" in r1 or "episode_return_mean" in str(r1)
+r2 = algo.train()
+assert algo.iteration == 2
+ckpt = algo.save()
+assert ckpt
+algo.stop()
+ray_tpu.shutdown()
+print("RLLIB OK")

@@ -8,13 +8,14 @@ delegated CUDA engine.
 
 from ray_tpu.llm.batch import LLMPredictor, build_llm_processor
 from ray_tpu.llm.config import LLMConfig, SamplingParams
-from ray_tpu.llm.engine import LLMEngine, RequestOutput
+from ray_tpu.llm.engine import AsyncLLMEngine, LLMEngine, RequestOutput
 from ray_tpu.llm.serving import LLMServer, build_openai_app
 
 __all__ = [
     "LLMConfig",
     "SamplingParams",
     "LLMEngine",
+    "AsyncLLMEngine",
     "RequestOutput",
     "LLMServer",
     "build_openai_app",

@@ -64,6 +64,21 @@ class Trainable:
         result.setdefault(TRAINING_ITERATION, self.iteration)
         return result
 
+    def save(self, checkpoint_dir: str | None = None) -> str:
+        """Checkpoint to ``checkpoint_dir`` (default: a fresh
+        ``checkpoint_{iteration:06d}`` under the trial dir) and return
+        the path (reference: Trainable.save, trainable.py:467)."""
+        dest = checkpoint_dir or os.path.join(
+            self.trial_dir, f"checkpoint_{self.iteration:06d}")
+        os.makedirs(dest, exist_ok=True)
+        self.save_checkpoint(dest)
+        return dest
+
+    def restore(self, checkpoint_path: str) -> None:
+        """Load state saved by :meth:`save` (reference:
+        Trainable.restore, trainable.py:507)."""
+        self.load_checkpoint(checkpoint_path)
+
 
 class _StopTrial(Exception):
     """Raised inside a function trainable's thread to unwind it."""
