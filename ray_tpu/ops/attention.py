@@ -475,18 +475,22 @@ def _flash_bwd_rule(causal, block_q, block_k, res, g):
 flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
-def attention(q, k, v, *, causal: bool = True, impl: str = "auto"):
+def attention(q, k, v, *, causal: bool = True, impl: str = "auto",
+              block_q: int = 256, block_k: int = 256):
     """Dispatch: 'reference' | 'blockwise' | 'flash' | 'auto'.
 
     'auto' uses the Pallas kernel on TPU when shapes tile cleanly, else
-    the blockwise path.
+    the blockwise path. ``block_q``/``block_k`` size the flash kernel's
+    VMEM tiles (bigger tiles amortize grid overhead and lengthen the
+    MXU contractions; bounded by VMEM — the f32 score tile alone is
+    block_q*block_k*4 bytes).
     """
     if impl == "reference":
         return dot_product_attention(q, k, v, causal=causal)
     if impl == "blockwise":
         return blockwise_attention(q, k, v, causal=causal)
     if impl == "flash":
-        return flash_attention(q, k, v, causal)
+        return flash_attention(q, k, v, causal, block_q, block_k)
     tq, tk = q.shape[1], k.shape[1]
     on_tpu = jax.devices()[0].platform == "tpu"
     # Short sequences: the O(T^2) scores tensor is small enough that XLA's
@@ -495,6 +499,6 @@ def attention(q, k, v, *, causal: bool = True, impl: str = "auto"):
     # stops fitting in VMEM-sized tiles.
     if tk <= 1024:
         return dot_product_attention(q, k, v, causal=causal)
-    if on_tpu and tq % 256 == 0 and tk % 256 == 0:
-        return flash_attention(q, k, v, causal)
+    if on_tpu and tq % block_q == 0 and tk % block_k == 0:
+        return flash_attention(q, k, v, causal, block_q, block_k)
     return blockwise_attention(q, k, v, causal=causal)
