@@ -40,6 +40,8 @@ from ray_tpu.dag.nodes import DAGNode, FunctionNode
 __all__ = [
     "run", "run_async", "resume", "resume_async", "get_output",
     "get_status", "list_all", "delete", "continuation", "Continuation",
+    "EventListener", "KVEventListener", "TimerListener", "trigger_event",
+    "wait_for_event", "sleep",
 ]
 
 
@@ -503,6 +505,31 @@ def trigger_event(event_key: str, payload: Any = True) -> None:
 
     global_runtime().kv_put(event_key, serialization.dumps(payload),
                             ns="__wf_events__")
+
+
+class TimerListener(EventListener):
+    """Event at a wall-clock timestamp (reference: event_listener.py
+    TimerListener)."""
+
+    def __init__(self, timestamp: float):
+        self.timestamp = float(timestamp)
+
+    def poll_for_event(self) -> float:
+        time.sleep(max(0.0, self.timestamp - time.time()))
+        return self.timestamp
+
+
+def sleep(duration: float) -> DAGNode:
+    """A workflow step resolving ``duration`` seconds after it first
+    runs (reference: workflow/api.py sleep). The deadline is computed in
+    its own checkpointed step, so a crash/resume waits out the ORIGINAL
+    deadline instead of restarting the clock."""
+    import ray_tpu as _rt
+
+    def _end_time(d):
+        return time.time() + d
+
+    return wait_for_event(TimerListener, _rt.remote(_end_time).bind(duration))
 
 
 def _poll_listener(listener_cls, *args, **kwargs):

@@ -303,3 +303,25 @@ def test_wait_for_event_custom_listener():
 
     with pytest.raises(TypeError, match="EventListener"):
         workflow.wait_for_event(123)
+
+
+def test_workflow_sleep_resumes_original_deadline(tmp_path):
+    """workflow.sleep computes its deadline in a checkpointed step
+    (reference: workflow/api.py sleep + TimerListener): the wait is
+    against wall-clock, and completes promptly once the deadline has
+    passed."""
+    import time
+
+    from ray_tpu import workflow
+
+    @ray_tpu.remote
+    def stamp(ts):
+        return ("done", float(ts))
+
+    t0 = time.time()
+    out = workflow.run(stamp.bind(workflow.sleep(0.8)),
+                       workflow_id=f"wf-sleep-{os.getpid()}")
+    waited = time.time() - t0
+    assert out[0] == "done"
+    assert out[1] >= t0 + 0.75
+    assert waited >= 0.75
