@@ -36,6 +36,8 @@ from ray_tpu.tune.trainable import (
     get_trial_dir,
     get_trial_id,
     report,
+    with_parameters,
+    with_resources,
 )
 from ray_tpu.tune.tuner import ResultGrid, Trial, TuneConfig, TuneController, Tuner, run
 

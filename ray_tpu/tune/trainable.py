@@ -11,6 +11,7 @@ experiment loop.
 
 from __future__ import annotations
 
+import functools
 import inspect
 import os
 import queue
@@ -78,6 +79,64 @@ class Trainable:
         """Load state saved by :meth:`save` (reference:
         Trainable.restore, trainable.py:507)."""
         self.load_checkpoint(checkpoint_path)
+
+
+def with_resources(trainable, resources: dict):
+    """Attach per-trial resource requests to a trainable (reference:
+    tune/trainable/util.py:147 with_resources). ``resources`` uses the
+    reference's shorthand ({"cpu": 2, "gpu"/"tpu": 1, custom: n}) or
+    our remote-options form ({"num_cpus": 2, "resources": {...}});
+    overrides TuneConfig.trial_resources for this trainable."""
+    opts: dict = {}
+    custom: dict = {}
+    for k, v in resources.items():
+        if k in ("cpu", "CPU", "num_cpus"):
+            opts["num_cpus"] = v
+        elif k in ("tpu", "TPU", "gpu", "GPU", "num_tpus"):
+            opts["num_tpus"] = v
+        elif k == "resources" and isinstance(v, dict):
+            custom.update(v)
+        else:
+            custom[k] = v
+    if custom:
+        opts["resources"] = custom
+
+    if isinstance(trainable, type):
+        wrapped = type(trainable.__name__, (trainable,), {})
+    else:
+        @functools.wraps(trainable)
+        def wrapped(*a, **kw):
+            return trainable(*a, **kw)
+    wrapped._tune_resources = opts
+    return wrapped
+
+
+def with_parameters(trainable, **kwargs):
+    """Bind large objects to a trainable via the object store so every
+    trial resolves them from shm instead of re-pickling them into each
+    actor (reference: tune/trainable/util.py:21 with_parameters). The
+    trainable receives them as keyword arguments after ``config``."""
+    import ray_tpu
+
+    ray_tpu.api.auto_init()
+    refs = {k: ray_tpu.put(v) for k, v in kwargs.items()}
+
+    if isinstance(trainable, type):
+        raise TypeError(
+            "with_parameters supports function trainables; class "
+            "trainables can take ObjectRefs in their config directly")
+
+    @functools.wraps(trainable)
+    def wrapped(config):
+        import ray_tpu as _rt
+
+        resolved = {k: _rt.get(r) for k, r in refs.items()}
+        return trainable(config, **resolved)
+
+    # Keep any resource annotation from an inner with_resources wrap.
+    if hasattr(trainable, "_tune_resources"):
+        wrapped._tune_resources = trainable._tune_resources
+    return wrapped
 
 
 class _StopTrial(Exception):

@@ -137,9 +137,11 @@ class TuneController:
         self._deadline = (
             time.monotonic() + tune_config.time_budget_s if tune_config.time_budget_s else None
         )
-        self._remote_actor_cls = ray_tpu.remote(
-            **(tune_config.trial_resources or {"num_cpus": 0})
-        )(TrialActor)
+        # Per-trainable annotation (tune.with_resources) overrides the
+        # TuneConfig-wide trial_resources.
+        trial_res = (getattr(trainable, "_tune_resources", None)
+                     or tune_config.trial_resources or {"num_cpus": 0})
+        self._remote_actor_cls = ray_tpu.remote(**trial_res)(TrialActor)
 
     # ------------------------------------------------------------------
 

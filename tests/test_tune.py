@@ -306,3 +306,27 @@ def test_max_concurrent_trials_and_time_fields(tmp_path):
     ).fit()
     assert len(grid) == 6
     assert all("time_total_s" in r.metrics for r in grid)
+
+
+def test_with_parameters_and_resources(tmp_path):
+    """tune.with_parameters binds large objects through the object store;
+    tune.with_resources attaches per-trial resource requests (reference:
+    tune/trainable/util.py:21,147)."""
+    import numpy as np
+
+    big = np.arange(1000)
+
+    def train_fn(config, data):
+        tune.report({"total": float(data.sum()) + config["x"]})
+
+    wrapped = tune.with_resources(
+        tune.with_parameters(train_fn, data=big), {"cpu": 1})
+    assert wrapped._tune_resources == {"num_cpus": 1}
+    grid = tune.Tuner(
+        wrapped,
+        param_space={"x": tune.grid_search([1, 2])},
+        tune_config=tune.TuneConfig(metric="total", mode="max"),
+        run_config=tune.RunConfig(name="wp", storage_path=str(tmp_path)),
+    ).fit()
+    totals = sorted(r.metrics["total"] for r in grid)
+    assert totals == [499501.0, 499502.0]
