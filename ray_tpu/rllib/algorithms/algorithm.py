@@ -364,6 +364,46 @@ class Algorithm(Trainable):
     def get_weights(self):
         return self.learner_group.get_weights()
 
+    def get_module(self, module_id: str | None = None):
+        """A LOCAL RLModule carrying the current trained weights
+        (reference: Algorithm.get_module). Built lazily from this
+        algorithm's module spec; refreshed with the learner weights on
+        every call so it tracks training."""
+        from ray_tpu.rllib.env.multi_agent import DEFAULT_MODULE_ID
+
+        module_id = module_id or DEFAULT_MODULE_ID
+        cache = getattr(self, "_inference_modules", None)
+        if cache is None:
+            cache = self._inference_modules = {}
+        module = cache.get(module_id)
+        if module is None:
+            module = cache[module_id] = (
+                self.algo_config.rl_module_specs()[module_id].build())
+        weights = self.learner_group.get_weights()
+        # Multi-learner/multi-module weight dicts key by module id.
+        if isinstance(weights, dict) and module_id in weights:
+            weights = weights[module_id]
+        module.set_weights(weights)
+        return module
+
+    def compute_single_action(self, observation, *, explore: bool = False,
+                              module_id: str | None = None) -> int:
+        """Action for ONE observation from the current policy (reference:
+        Algorithm.compute_single_action, algorithms/algorithm.py:3770).
+        ``explore=False`` is the greedy/deterministic action;
+        ``explore=True`` samples the action distribution."""
+        import numpy as np
+
+        module = self.get_module(module_id)
+        obs = np.asarray(observation, dtype=np.float32)[None, :]
+        out = module.forward_inference(obs)
+        logits = out["action_dist_inputs"][0]
+        if not explore:
+            return int(np.argmax(logits))
+        z = logits - logits.max()
+        p = np.exp(z) / np.exp(z).sum()
+        return int(self._rng.choice(len(p), p=p))
+
     def cleanup(self) -> None:
         if getattr(self, "env_runner_group", None) is not None:
             self.env_runner_group.stop()

@@ -276,3 +276,34 @@ def test_algorithm_is_tune_trainable(tmp_path):
     assert len(grid) == 2
     assert grid.num_errors == 0
     assert all(r.metrics["training_iteration"] == 2 for r in grid)
+
+
+def test_compute_single_action_after_training():
+    """Algorithm.compute_single_action serves the trained policy for one
+    observation (reference: algorithms/algorithm.py:3770)."""
+    import numpy as np
+
+    from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
+
+    config = (
+        PPOConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_env_runners=0)
+        .training(train_batch_size=256, minibatch_size=64, num_epochs=1)
+    )
+    algo = PPO(config)
+    algo.train()
+    obs = np.zeros(4, dtype=np.float32)
+    a_greedy = algo.compute_single_action(obs)
+    assert a_greedy in (0, 1)
+    # Deterministic: same obs, same greedy action.
+    assert algo.compute_single_action(obs) == a_greedy
+    # Exploration samples — all values legal.
+    acts = {algo.compute_single_action(obs, explore=True) for _ in range(20)}
+    assert acts <= {0, 1}
+    # The module tracks training (weights refresh on each call).
+    m1 = algo.get_module()
+    algo.train()
+    m2 = algo.get_module()
+    assert m1 is m2  # cached instance, refreshed weights
+    algo.stop()
