@@ -28,6 +28,7 @@ from ray_tpu.serve.deployment import (
 from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
 from ray_tpu.serve.batching import batch
 from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
+from ray_tpu.serve.replica import ReplicaContext, get_replica_context
 
 __all__ = [
     "batch",
@@ -44,6 +45,8 @@ __all__ = [
     "get_deployment_handle",
     "get_grpc_port",
     "get_proxy_port",
+    "get_replica_context",
+    "ReplicaContext",
     "run",
     "run_from_config",
     "build",

@@ -20,12 +20,38 @@ from typing import Any
 _STOP = object()
 
 
+class ReplicaContext:
+    """Metadata about the replica hosting the current code (reference:
+    serve/api.py get_replica_context / ReplicaContext)."""
+
+    def __init__(self, deployment: str, replica_id: str, servable_object):
+        self.deployment = deployment
+        self.replica_id = replica_id
+        self.servable_object = servable_object
+
+
+_replica_context: "ReplicaContext | None" = None
+
+
+def get_replica_context() -> ReplicaContext:
+    if _replica_context is None:
+        raise RuntimeError(
+            "get_replica_context() may only be called inside a Serve "
+            "replica (deployment __init__ or request handling)")
+    return _replica_context
+
+
 class Replica:
     def __init__(self, cls_or_fn, init_args: tuple, init_kwargs: dict,
                  deployment_name: str, replica_id: str,
                  max_ongoing_requests: int = 16):
         self.deployment_name = deployment_name
         self.replica_id = replica_id
+        # Visible to user code from __init__ onward (the context is set
+        # BEFORE the servable constructs, matching reference timing; the
+        # servable_object field is filled in right after construction).
+        global _replica_context
+        _replica_context = ReplicaContext(deployment_name, replica_id, None)
         self._ongoing = 0
         self._total = 0
         self._lock = threading.Lock()
@@ -39,6 +65,7 @@ class Replica:
             self.instance = cls_or_fn(*init_args, **init_kwargs)
         else:
             self.instance = cls_or_fn  # plain function deployment
+        _replica_context.servable_object = self.instance
 
     def _resolve_call(self, method: str, args: tuple, kwargs: dict):
         """Shared request plumbing: await composed upstream ObjectRefs

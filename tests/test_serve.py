@@ -397,3 +397,32 @@ def test_async_deployment_composition_await():
 
     h = serve.run(Up.bind(Down.bind()), proxy=False)
     assert h.remote(40).result(timeout_s=30) == 42
+
+
+def test_get_replica_context():
+    """serve.get_replica_context() exposes replica metadata to user code
+    from __init__ onward (reference: serve/api.py get_replica_context)."""
+    from ray_tpu import serve
+
+    @serve.deployment
+    class WhoAmI:
+        def __init__(self):
+            ctx = serve.get_replica_context()
+            self.boot_deployment = ctx.deployment
+
+        def __call__(self):
+            ctx = serve.get_replica_context()
+            return {
+                "deployment": ctx.deployment,
+                "replica_id": ctx.replica_id,
+                "boot": self.boot_deployment,
+                "servable_is_self": ctx.servable_object is self,
+            }
+
+    handle = serve.run(WhoAmI.bind(), proxy=False)
+    out = handle.remote().result()
+    assert out["deployment"] == "WhoAmI"
+    assert out["boot"] == "WhoAmI"
+    assert out["replica_id"].startswith("WhoAmI")
+    assert out["servable_is_self"] is True
+    serve.shutdown()
