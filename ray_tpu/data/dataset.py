@@ -200,6 +200,19 @@ class Dataset:
                 for k, v in batch.items()
             }
 
+    def iter_tf_batches(self, *, batch_size: int | None = 256,
+                        drop_last: bool = False) -> Iterator[dict]:
+        """Batches as tf tensors (reference: iter_tf_batches,
+        data/iterator.py:378)."""
+        import tensorflow as tf
+
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       drop_last=drop_last):
+            yield {
+                k: tf.convert_to_tensor(v) if v.dtype != object else v
+                for k, v in batch.items()
+            }
+
     # -- consumption -------------------------------------------------------
 
     def take(self, n: int = 20) -> list:
@@ -288,6 +301,67 @@ class Dataset:
         blocks = list(self.repartition(n).iter_blocks())
         # repartition yields exactly n blocks
         return [Dataset([InputData(blocks=[b])]) for b in blocks]
+
+    def split_at_indices(self, indices: list[int]) -> list["Dataset"]:
+        """Materialize and split at row indices (reference:
+        Dataset.split_at_indices, dataset.py:1923): ``[2, 5]`` yields
+        rows [0,2), [2,5), [5,end)."""
+        if sorted(indices) != list(indices) or any(i < 0 for i in indices):
+            raise ValueError("indices must be non-negative and sorted")
+        rows = self.take_all()
+        bounds = [0, *indices, len(rows)]
+        out = []
+        for lo, hi in zip(bounds[:-1], bounds[1:]):
+            part = rows[max(lo, 0):max(hi, 0)]
+            out.append(from_items(part) if part else
+                       Dataset([InputData(blocks=[])]))
+        return out
+
+    def train_test_split(self, test_size: "int | float", *,
+                         shuffle: bool = False, seed: int | None = None,
+                         ) -> "tuple[Dataset, Dataset]":
+        """Materializing train/test split (reference:
+        Dataset.train_test_split, dataset.py:2079). ``test_size`` is a
+        fraction (0, 1) or an absolute row count; the train split is the
+        complement."""
+        ds = self.random_shuffle(seed=seed) if shuffle else self
+        n = ds.count()
+        if isinstance(test_size, float):
+            if not 0.0 < test_size < 1.0:
+                raise ValueError(
+                    f"float test_size must be in (0, 1), got {test_size}")
+            k = int(n * test_size)
+        else:
+            if not 0 <= int(test_size) <= n:
+                raise ValueError(
+                    f"int test_size must be in [0, {n}], got {test_size}")
+            k = int(test_size)
+        train, test = ds.split_at_indices([n - k])
+        return train, test
+
+    def random_sample(self, fraction: float, *,
+                      seed: int | None = None) -> "Dataset":
+        """Bernoulli row sample (reference: Dataset.random_sample,
+        dataset.py:1549) — each row kept independently with probability
+        ``fraction``, so the result size is approximate."""
+        if not 0.0 <= fraction <= 1.0:
+            raise ValueError(f"fraction must be in [0, 1], got {fraction}")
+        rng = np.random.default_rng(seed)
+
+        def sample(batch: dict) -> dict:
+            num = len(next(iter(batch.values()))) if batch else 0
+            keep = rng.random(num) < fraction
+            return {k: np.asarray(v)[keep] for k, v in batch.items()}
+
+        return self.map_batches(sample)
+
+    def take_batch(self, batch_size: int = 20) -> dict:
+        """First up-to-``batch_size`` rows as one columnar batch
+        (reference: Dataset.take_batch, dataset.py:2704)."""
+        for batch in self.limit(batch_size).iter_batches(
+                batch_size=batch_size, drop_last=False):
+            return batch
+        raise ValueError("dataset is empty")
 
     def streaming_split(self, n: int) -> list["DataIterator"]:
         """Per-worker streaming shards (reference: Dataset.streaming_split

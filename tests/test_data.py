@@ -594,3 +594,37 @@ def test_avro_namespaced_named_types(tmp_path):
     rows = ray_tpu.data.read_avro(p).take_all()
     assert rows == [{"color": "RED", "again": "BLUE"},
                     {"color": "BLUE", "again": "RED"}]
+
+
+def test_split_at_indices_and_train_test_split():
+    ds = rd.range(10)
+    parts = ds.split_at_indices([3, 7])
+    assert [p.count() for p in parts] == [3, 4, 3]
+    assert [r["id"] for r in parts[1].take_all()] == [3, 4, 5, 6]
+    train, test = rd.range(8).train_test_split(test_size=0.25)
+    assert train.count() == 6 and test.count() == 2
+    assert [r["id"] for r in test.take_all()] == [6, 7]
+    train, test = rd.range(8).train_test_split(test_size=3, shuffle=True,
+                                               seed=0)
+    assert train.count() == 5 and test.count() == 3
+    ids = sorted(r["id"] for r in train.take_all()) + sorted(
+        r["id"] for r in test.take_all())
+    assert sorted(ids) == list(range(8))
+    with pytest.raises(ValueError):
+        rd.range(4).train_test_split(test_size=1.5)
+
+
+def test_random_sample_and_take_batch():
+    ds = rd.range(2000)
+    got = ds.random_sample(0.25, seed=7).count()
+    assert 350 < got < 650  # ~500 expected
+    assert rd.range(5).random_sample(0.0).count() == 0
+    batch = rd.range(100).take_batch(8)
+    assert list(batch["id"]) == list(range(8))
+
+
+def test_iter_tf_batches():
+    tf = pytest.importorskip("tensorflow")
+    batches = list(rd.range(10).iter_tf_batches(batch_size=4))
+    assert [int(b["id"].shape[0]) for b in batches] == [4, 4, 2]
+    assert batches[0]["id"].dtype == tf.int64
