@@ -1272,11 +1272,23 @@ class Head:
                                     del self.dep_blocked[o2]
                         self._fail_task(spec, "TaskCancelledError: cancelled before execution")
                         return {"cancelled": True}
-            # Running: signal the worker.
+            # Dep-parked actor calls (args still resolving).
+            for actor in self.actors.values():
+                for spec in list(actor.pending):
+                    if spec.task_id == task_id or task_id in spec.return_ids:
+                        actor.pending.remove(spec)
+                        self._fail_task(spec, "TaskCancelledError: cancelled before execution")
+                        return {"cancelled": True}
+            # Pushed to a worker (running, or queued in its executor —
+            # actor calls wait there, not head-side): signal it. The
+            # public cancel(ref) passes a RETURN id, so match those too.
             for rec in self.workers.values():
-                if task_id in rec.inflight and rec.conn:
+                spec = rec.inflight.get(task_id) or next(
+                    (s for s in rec.inflight.values()
+                     if task_id in s.return_ids), None)
+                if spec is not None and rec.conn:
                     try:
-                        rec.conn.cast("cancel", {"task_id": task_id})
+                        rec.conn.cast("cancel", {"task_id": spec.task_id})
                     except rpc.ConnectionLost:
                         pass
                     return {"cancelled": False, "signalled": True}

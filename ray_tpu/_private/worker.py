@@ -102,6 +102,7 @@ class Worker:
         self._store_pool = ThreadPoolExecutor(max_workers=4,
                                               thread_name_prefix="actor-store")
         self._exit = threading.Event()
+        self._cancelled_ids: set[str] = set()
         self.runtime = CoreRuntime(
             head_addr,
             client_type="worker",
@@ -156,8 +157,13 @@ class Worker:
             self._exit.set()
             os._exit(0)
         elif kind == "cancel":
-            pass  # queued-task cancellation is handled head-side; running
-            # tasks are force-cancelled by killing the worker process.
+            # Queued-but-not-started tasks (actor calls wait in this
+            # worker's executor, reference: actor_scheduling_queue.h) are
+            # dropped at pickup: _run_task_guarded checks this set before
+            # executing and stores TaskCancelledError instead. RUNNING
+            # tasks are not interrupted (reference recursive=False
+            # semantics: running actor tasks need force/kill).
+            self._cancelled_ids.add(body["task_id"])
         return None
 
     def _sample_profile(self, body: dict) -> None:
@@ -354,10 +360,19 @@ class Worker:
         sem = self.async_exec.semaphore(self._task_group(spec))
         async with sem:
             try:
-                failed = not await self._run_task_async(spec)
+                if spec.task_id in self._cancelled_ids:
+                    self._cancelled_ids.discard(spec.task_id)
+                    self._store_error(
+                        spec,
+                        TaskError("TaskCancelledError: cancelled before "
+                                  "execution", "", spec.name))
+                    failed = True
+                else:
+                    failed = not await self._run_task_async(spec)
             except Exception:
                 traceback.print_exc()
                 failed = True
+        self._cancelled_ids.discard(spec.task_id)
         try:
             self.runtime.conn.cast(
                 "task_finished",
@@ -452,11 +467,23 @@ class Worker:
         failed = False
         start = time.time()
         try:
-            failed = not self._run_task(spec, tpu_chips)
+            if spec.task_id in self._cancelled_ids:
+                self._cancelled_ids.discard(spec.task_id)
+                self._store_error(
+                    spec,
+                    TaskError("TaskCancelledError: cancelled before "
+                              "execution", "", spec.name))
+                failed = True
+            else:
+                failed = not self._run_task(spec, tpu_chips)
         except Exception:
             traceback.print_exc()
             failed = True
         finally:
+            # A cancel that raced an already-running task left its id in
+            # the set (running tasks are not interrupted); clear it so
+            # the set stays bounded by the queue depth.
+            self._cancelled_ids.discard(spec.task_id)
             try:
                 self.runtime.conn.cast(
                     "task_finished",

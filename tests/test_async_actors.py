@@ -225,3 +225,33 @@ def test_sync_actor_unchanged(cluster):
     s = S.remote()
     outs = ray_tpu.get([s.add.remote(i) for i in range(5)], timeout=30)
     assert outs[-1] == [0, 1, 2, 3, 4]
+
+
+def test_cancel_queued_actor_call(cluster):
+    """cancel(ref) on an actor call queued behind a running one drops it
+    before execution: get raises TaskCancelledError-tagged TaskError
+    instead of hanging, and the running call is untouched (reference:
+    actor-task cancel semantics, recursive=False)."""
+    import time
+
+    import pytest
+
+    @ray_tpu.remote
+    class A:
+        def slow(self):
+            time.sleep(3)
+            return "done"
+
+        def quick(self):
+            return "q"
+
+    a = A.remote()
+    assert ray_tpu.get(a.quick.remote(), timeout=30) == "q"
+    r1 = a.slow.remote()     # occupies the single-threaded executor
+    r2 = a.slow.remote()     # queued behind r1
+    ray_tpu.cancel(r2)
+    with pytest.raises(Exception, match="TaskCancelled"):
+        ray_tpu.get(r2, timeout=30)
+    assert ray_tpu.get(r1, timeout=30) == "done"
+    # Still serving after the cancel.
+    assert ray_tpu.get(a.quick.remote(), timeout=30) == "q"
