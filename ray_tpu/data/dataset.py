@@ -141,7 +141,45 @@ class Dataset:
     # -- execution ---------------------------------------------------------
 
     def iter_blocks(self) -> Iterator[Block]:
-        return execute_plan(self._plan, DataContext.get_current())
+        ctx = DataContext.get_current()
+        return self._instrumented(execute_plan(self._plan, ctx), ctx)
+
+    def _instrumented(self, stream: Iterator[Block], ctx) -> Iterator[Block]:
+        """Record per-run execution stats while the stream drains."""
+        import time as _time
+
+        t0 = _time.perf_counter()
+        blocks = rows = nbytes = 0
+        try:
+            for b in stream:
+                acc = BlockAccessor(b)
+                blocks += 1
+                rows += acc.num_rows()
+                nbytes += acc.size_bytes()
+                yield b
+        finally:
+            self._last_stats = {
+                "wall_s": _time.perf_counter() - t0,
+                "blocks": blocks,
+                "rows": rows,
+                "bytes": nbytes,
+                "max_bytes_buffered": ctx.stats.get("max_bytes_buffered"),
+            }
+
+    def stats(self) -> str:
+        """Execution summary for the most recent iteration of THIS
+        dataset (reference: Dataset.stats, dataset.py:5227)."""
+        s = getattr(self, "_last_stats", None)
+        if not s:
+            return "No execution stats yet: iterate the dataset first."
+        mb = s["bytes"] / (1024 * 1024)
+        rate = s["rows"] / s["wall_s"] if s["wall_s"] > 0 else float("inf")
+        out = (f"Dataset execution: {s['blocks']} blocks, {s['rows']} rows, "
+               f"{mb:.1f} MiB in {s['wall_s']:.3f}s ({rate:,.0f} rows/s)")
+        if s.get("max_bytes_buffered") is not None:
+            out += (f"; peak buffered "
+                    f"{s['max_bytes_buffered'] / (1024 * 1024):.1f} MiB")
+        return out
 
     def iter_batches(
         self,

@@ -628,3 +628,12 @@ def test_iter_tf_batches():
     batches = list(rd.range(10).iter_tf_batches(batch_size=4))
     assert [int(b["id"].shape[0]) for b in batches] == [4, 4, 2]
     assert batches[0]["id"].dtype == tf.int64
+
+
+def test_dataset_stats():
+    ds = rd.range(1000).map_batches(lambda b: {"id": b["id"] * 2})
+    assert "iterate" in ds.stats()
+    total = ds.sum("id")
+    assert total == 999000
+    s = ds.stats()
+    assert "1000 rows" in s and "rows/s" in s
