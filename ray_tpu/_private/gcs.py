@@ -1493,6 +1493,19 @@ class Head:
                 self._mark_dirty()
         return {}
 
+    def _h_list_named_actors(self, body, conn):
+        """Names of live named actors (reference:
+        util/__init__.py:29 list_named_actors)."""
+        with self.lock:
+            if body.get("all_namespaces"):
+                return {"actors": [
+                    {"namespace": ns, "name": name}
+                    for (ns, name) in self.named_actors
+                ]}
+            ns = body.get("namespace", "")
+            return {"actors": [name for (n, name) in self.named_actors
+                               if n == ns]}
+
     def _h_get_named_actor(self, body, conn):
         with self.lock:
             actor_id = self.named_actors.get((body.get("namespace", ""), body["name"]))

@@ -10,6 +10,22 @@ from ray_tpu.util.placement_group import (
 from ray_tpu.util.queue import Empty, Full, Queue
 from ray_tpu.util.serialization import deregister_serializer, register_serializer
 
+def list_named_actors(all_namespaces: bool = False) -> list:
+    """Names of live named actors (reference: util/__init__.py:29).
+
+    With ``all_namespaces``, returns [{"namespace": ..., "name": ...}]
+    dicts; otherwise the names in the CURRENT namespace."""
+    from ray_tpu import api
+    from ray_tpu._private.worker_context import global_runtime
+
+    api.auto_init()
+    return global_runtime().conn.call(
+        "list_named_actors",
+        {"all_namespaces": all_namespaces,
+         "namespace": api._namespace},
+    )["actors"]
+
+
 __all__ = [
     "ActorPool",
     "Empty",
@@ -17,6 +33,7 @@ __all__ = [
     "Queue",
     "deregister_serializer",
     "register_serializer",
+    "list_named_actors",
     "placement_group",
     "placement_group_table",
     "remove_placement_group",

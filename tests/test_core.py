@@ -431,3 +431,21 @@ def test_duplicate_pending_dep_runs_once(cluster):
     y = slow.remote()
     rs = [add.remote(y, y) for _ in range(4)]
     assert [v for v, _, _ in ray_tpu.get(rs, timeout=60)] == [6, 6, 6, 6]
+
+
+def test_list_named_actors(cluster):
+    from ray_tpu.util import list_named_actors
+
+    @ray_tpu.remote
+    class N:
+        def ping(self):
+            return 1
+
+    h = N.options(name="alpha").remote()
+    ray_tpu.get(h.ping.remote(), timeout=30)
+    names = list_named_actors()
+    assert "alpha" in names
+    both = list_named_actors(all_namespaces=True)
+    assert {"namespace": "", "name": "alpha"} in both or any(
+        e["name"] == "alpha" for e in both)
+    ray_tpu.kill(h)
