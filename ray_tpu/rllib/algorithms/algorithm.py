@@ -58,10 +58,23 @@ class AlgorithmConfig:
         self.policies: dict | None = None
         self.policy_mapping_fn = None
         self.policies_to_train: list | None = None
+        # evaluation (reference: AlgorithmConfig.evaluation —
+        # evaluation_interval in train iterations, duration in episodes)
+        self.evaluation_interval: int | None = None
+        self.evaluation_duration = 10
         # misc
         self.seed = 0
 
     # --- fluent sections ---
+
+    def evaluation(self, *, evaluation_interval: int | None = None,
+                   evaluation_duration: int | None = None,
+                   ) -> "AlgorithmConfig":
+        if evaluation_interval is not None:
+            self.evaluation_interval = evaluation_interval
+        if evaluation_duration is not None:
+            self.evaluation_duration = evaluation_duration
+        return self
 
     def environment(self, env: Any = None, *, observation_dim: int | None = None,
                     action_dim: int | None = None) -> "AlgorithmConfig":
@@ -317,7 +330,71 @@ class Algorithm(Trainable):
                 # Keep running-normalizer stats consistent across remote
                 # runners (reference: MeanStdFilter periodic sync).
                 self.env_runner_group.sync_connector_states()
+        interval = self.algo_config.evaluation_interval
+        if interval and (self.iteration + 1) % interval == 0:
+            result["evaluation"] = self.evaluate()
         return result
+
+    def evaluate(self, duration: int | None = None) -> dict:
+        """Greedy-policy evaluation for ``duration`` episodes (reference:
+        Algorithm.evaluate / config.evaluation). Uses the current
+        learner weights and the training runners' frozen connector
+        statistics (observation normalizers are applied, not updated)."""
+        from ray_tpu.rllib.connectors import build_pipeline
+        from ray_tpu.rllib.env.env_runner import _make_env_fn
+
+        cfg = self.algo_config
+        if cfg.env is None:
+            raise ValueError("evaluate() requires an environment")
+        if cfg.is_multi_agent:
+            raise NotImplementedError(
+                "evaluate() supports single-agent configs")
+        n_episodes = int(duration or cfg.evaluation_duration)
+        env = _make_env_fn(cfg.env)()
+        module = self.get_module()
+        pipe = build_pipeline(getattr(cfg, "env_to_module_connector", None))
+        group = getattr(self, "env_runner_group", None)
+        if (pipe is not None and group is not None
+                and hasattr(group, "get_connector_state")):
+            state = group.get_connector_state()
+            if state is not None:
+                pipe.set_state(state)
+        returns: list[float] = []
+        lengths: list[int] = []
+        try:
+            for ep in range(n_episodes):
+                obs = env.reset(seed=cfg.seed + 10_000 + ep)[0]
+                total, steps, done = 0.0, 0, False
+                while not done and steps < 100_000:
+                    o = np.asarray(obs, np.float32)[None, :]
+                    if pipe is not None:
+                        o = np.asarray(pipe(o, update=False))
+                    logits = module.forward_inference(o)["action_dist_inputs"][0]
+                    if cfg.continuous:
+                        # Mean action: first half of the dist inputs.
+                        act = np.asarray(logits[: len(logits) // 2])
+                    else:
+                        act = int(np.argmax(logits))
+                    obs, r, term, trunc, _ = env.step(act)
+                    total += float(r)
+                    steps += 1
+                    done = bool(term or trunc)
+                returns.append(total)
+                lengths.append(steps)
+        finally:
+            try:
+                env.close()
+            except Exception:
+                pass
+        return {
+            "env_runners": {
+                "episode_return_mean": float(np.mean(returns)),
+                "episode_return_max": float(np.max(returns)),
+                "episode_return_min": float(np.min(returns)),
+                "episode_len_mean": float(np.mean(lengths)),
+                "episodes_this_iter": n_episodes,
+            }
+        }
 
     def train(self) -> dict:  # Trainable.train adds iteration bookkeeping
         return super().train()

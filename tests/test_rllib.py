@@ -307,3 +307,29 @@ def test_compute_single_action_after_training():
     m2 = algo.get_module()
     assert m1 is m2  # cached instance, refreshed weights
     algo.stop()
+
+
+def test_evaluate_and_evaluation_interval():
+    """Algorithm.evaluate runs greedy episodes with frozen connector
+    stats; evaluation_interval attaches results to train() (reference:
+    Algorithm.evaluate / AlgorithmConfig.evaluation)."""
+    from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
+
+    config = (
+        PPOConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_env_runners=0)
+        .training(train_batch_size=256, minibatch_size=64, num_epochs=1)
+        .evaluation(evaluation_interval=2, evaluation_duration=3)
+    )
+    algo = PPO(config)
+    ev = algo.evaluate()
+    er = ev["env_runners"]
+    assert er["episodes_this_iter"] == 3
+    assert er["episode_return_min"] <= er["episode_return_mean"] <= er["episode_return_max"]
+    assert er["episode_len_mean"] >= 1
+    r1 = algo.train()
+    assert "evaluation" not in r1  # iteration 1, interval 2
+    r2 = algo.train()
+    assert r2["evaluation"]["env_runners"]["episodes_this_iter"] == 3
+    algo.stop()
