@@ -271,6 +271,32 @@ class RuntimeContext:
     def get_node_id(self) -> str:
         return self.node_id
 
+    def get_worker_id(self) -> str:
+        """Worker process id, or 'driver' in the driver (reference:
+        RuntimeContext.get_worker_id)."""
+        return os.environ.get("RAY_TPU_WORKER_ID", "driver")
+
+    def get_job_id(self) -> str:
+        """Submitted-job id, or 'driver' for a bare driver (reference:
+        RuntimeContext.get_job_id; set by the job supervisor for
+        entrypoint processes and inherited by their tasks)."""
+        return os.environ.get("RAY_TPU_JOB_ID", "driver")
+
+    def get_task_name(self) -> str | None:
+        ctx = worker_context.get_task_context()
+        return getattr(ctx, "task_name", None) or ctx.task_id
+
+    def get_runtime_env(self) -> dict:
+        """The merged runtime env in effect for the current task/actor
+        (reference: RuntimeContext.runtime_env)."""
+        ctx = worker_context.get_task_context()
+        return dict(getattr(ctx, "runtime_env", None) or {})
+
+    @property
+    def gcs_address(self) -> str:
+        host, port = global_runtime().address
+        return f"{host}:{port}"
+
     @property
     def namespace(self) -> str:
         return _namespace

@@ -449,3 +449,25 @@ def test_list_named_actors(cluster):
     assert {"namespace": "", "name": "alpha"} in both or any(
         e["name"] == "alpha" for e in both)
     ray_tpu.kill(h)
+
+
+def test_runtime_context_surface(cluster):
+    ctx = ray_tpu.get_runtime_context()
+    assert ctx.get_worker_id() == "driver"
+    assert ctx.get_job_id() == "driver"
+    assert ":" in ctx.gcs_address
+
+    @ray_tpu.remote
+    def probe():
+        c = ray_tpu.get_runtime_context()
+        return {
+            "worker": c.get_worker_id(),
+            "task": c.get_task_id(),
+            "env": c.get_runtime_env(),
+        }
+
+    out = ray_tpu.get(probe.options(
+        runtime_env={"env_vars": {"X": "1"}}).remote(), timeout=30)
+    assert out["worker"].startswith("worker-")
+    assert out["task"].startswith("task-")
+    assert out["env"].get("env_vars") == {"X": "1"}
