@@ -1772,6 +1772,22 @@ class Head:
                 ]
             }
 
+    def _h_list_placement_groups(self, body, conn):
+        with self.lock:
+            return {
+                "placement_groups": [
+                    {
+                        "placement_group_id": pg.pg_id,
+                        "name": pg.name,
+                        "state": pg.state,
+                        "strategy": pg.strategy,
+                        "bundles": [dict(b) for b in pg.bundles],
+                        "node_per_bundle": list(pg.node_per_bundle or ()),
+                    }
+                    for pg in self.pgs.values()
+                ]
+            }
+
     def _h_list_objects(self, body, conn):
         with self.lock:
             return {

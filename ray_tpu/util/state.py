@@ -75,6 +75,21 @@ def list_nodes(*, filters=None, limit: int = 1000) -> list[dict]:
     return _filtered(rows, filters)[:limit]
 
 
+def list_placement_groups(*, filters=None, limit: int = 1000) -> list[dict]:
+    """Reference: util/state list_placement_groups."""
+    rows = _call("list_placement_groups")["placement_groups"]
+    return _filtered(rows, filters)[:limit]
+
+
+def list_jobs(*, filters=None, limit: int = 1000) -> list[dict]:
+    """Submitted jobs (reference: util/state list_jobs / JobSubmissionClient
+    list_jobs)."""
+    from ray_tpu import job_submission
+
+    rows = [dict(j) for j in job_submission.list_jobs()]
+    return _filtered(rows, filters)[:limit]
+
+
 def summarize_tasks() -> dict:
     """Counts by (name, state) — reference: util/state/api.py:1368."""
     by_name: dict[str, Counter] = {}

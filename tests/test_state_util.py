@@ -263,3 +263,18 @@ def test_summarize_objects():
     assert summary["total_bytes"] > 0
     assert "SEALED" in summary["state_counts"]
     del ref
+
+
+def test_list_placement_groups_and_jobs():
+    from ray_tpu.util import placement_group, remove_placement_group
+    from ray_tpu.util.state import list_jobs, list_placement_groups
+
+    pg = placement_group([{"CPU": 1}], strategy="PACK", name="statepg")
+    ray_tpu.get(pg.ready(), timeout=30)
+    rows = list_placement_groups()
+    mine = [r for r in rows if r["name"] == "statepg"]
+    assert mine and mine[0]["state"] == "CREATED"
+    assert mine[0]["bundles"] == [{"CPU": 1}]
+    assert list_placement_groups(filters=[("state", "=", "CREATED")])
+    remove_placement_group(pg)
+    assert isinstance(list_jobs(), list)
