@@ -1297,6 +1297,10 @@ class Head:
     def _h_task_finished(self, body, conn):
         worker_id = body["worker_id"]
         with self.lock:
+            # Piggybacked profile events (one cast per task instead of
+            # two — the completion path is the control plane's hottest).
+            if body.get("events"):
+                self.task_events.extend(body["events"])
             rec = self.workers.get(worker_id)
             if rec is None:
                 return None

@@ -377,16 +377,13 @@ class Worker:
             self.runtime.conn.cast(
                 "task_finished",
                 {"worker_id": self.worker_id, "task_id": spec.task_id,
-                 "failed": failed},
-            )
-            self.runtime.conn.cast(
-                "task_events",
-                {"events": [{
-                    "task_id": spec.task_id, "name": spec.name,
-                    "worker_id": self.worker_id, "node_id": self.node_id,
-                    "pid": os.getpid(), "start": start,
-                    "end": time.time(), "failed": failed,
-                }]},
+                 "failed": failed,
+                 "events": [{
+                     "task_id": spec.task_id, "name": spec.name,
+                     "worker_id": self.worker_id, "node_id": self.node_id,
+                     "pid": os.getpid(), "start": start,
+                     "end": time.time(), "failed": failed,
+                 }]},
             )
         except Exception:
             pass
@@ -485,20 +482,16 @@ class Worker:
             # the set stays bounded by the queue depth.
             self._cancelled_ids.discard(spec.task_id)
             try:
+                # Completion + profile event in ONE cast (reference:
+                # core_worker/task_event_buffer.h:225 batches events for
+                # the same reason — the completion path is the control
+                # plane's hottest message).
                 self.runtime.conn.cast(
                     "task_finished",
                     {
                         "worker_id": self.worker_id,
                         "task_id": spec.task_id,
                         "failed": failed,
-                    },
-                )
-                # Profile event → head task-event buffer (reference:
-                # core_worker/task_event_buffer.h:225 → GcsTaskManager;
-                # consumed by `ray timeline`, profiling.py:124).
-                self.runtime.conn.cast(
-                    "task_events",
-                    {
                         "events": [
                             {
                                 "task_id": spec.task_id,
@@ -510,7 +503,7 @@ class Worker:
                                 "end": time.time(),
                                 "failed": failed,
                             }
-                        ]
+                        ],
                     },
                 )
             except Exception:
