@@ -2331,10 +2331,29 @@ class Head:
         actor = self.actors.get(rec.actor_id)
         if actor is None or actor.state == "DEAD":
             return
+        will_restart = actor.spec.max_restarts != 0 and (
+            actor.spec.max_restarts < 0
+            or actor.restarts < actor.spec.max_restarts
+        )
+        retry_budget = int(getattr(actor.spec, "max_task_retries", 0))
         creation_spec = None
+        retried: list[TaskSpec] = []
         for spec in inflight:
             if spec.actor_creation:
                 creation_spec = spec
+                continue
+            if (will_restart and retry_budget != 0
+                    and (retry_budget < 0
+                         or spec.retries_used < retry_budget)):
+                # max_task_retries: the call replays on the restarted
+                # incarnation (reference: @ray.remote(max_task_retries)
+                # — at-least-once actor-method semantics, opt-in).
+                spec.retries_used += 1
+                t = self.tasks.get(spec.task_id)
+                if t:
+                    t["state"] = PENDING
+                    t["retries"] = spec.retries_used
+                retried.append(spec)
                 continue
             # In-flight calls die with the actor.
             self._fail_task(
@@ -2342,9 +2361,13 @@ class Head:
                 f"ActorDiedError: actor {rec.actor_id} died while running {spec.name}",
                 kind="actor_died",
             )
-        if actor.spec.max_restarts != 0 and (
-            actor.spec.max_restarts < 0 or actor.restarts < actor.spec.max_restarts
-        ):
+        if retried:
+            # Ahead of already-queued calls, in submission order, so the
+            # restarted incarnation replays the stream where it broke.
+            for spec in sorted(retried, key=lambda s: s.seq_no,
+                               reverse=True):
+                actor.pending.appendleft(spec)
+        if will_restart:
             actor.restarts += 1
             actor.state = "PENDING_CREATION"
             actor.worker_id = None

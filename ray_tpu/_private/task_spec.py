@@ -57,6 +57,10 @@ class ActorSpec:
     max_restarts: int
     max_concurrency: int
     owner_id: str
+    # Per-method replay budget across actor RESTARTS (reference:
+    # @ray.remote(max_task_retries=N) — in-flight calls on a dying actor
+    # are re-queued onto the restarted incarnation instead of erroring).
+    max_task_retries: int = 0
     scheduling_strategy: Any = None
     runtime_env: dict | None = None
     lifetime: str | None = None  # "detached" or None

@@ -162,6 +162,7 @@ class ActorClass:
                 default_cpus=0.0,
             ),
             max_restarts=int(opts.get("max_restarts", GLOBAL_CONFIG.actor_max_restarts_default)),
+            max_task_retries=int(opts.get("max_task_retries", 0)),
             # 0 = unset: async actors then default to 1000-way
             # concurrency, while an EXPLICIT max_concurrency=1 really
             # serializes their coroutines (reference semantics).

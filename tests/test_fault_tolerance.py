@@ -164,3 +164,53 @@ def test_actor_restart_then_named_lookup(cluster):
     assert val == 1  # state reset by restart
     b = ray_tpu.get_actor("phoenix")
     assert ray_tpu.get(b.bump.remote()) == 2
+
+
+def test_actor_max_task_retries(cluster, tmp_path):
+    """@remote(max_restarts, max_task_retries): a method call in flight
+    when the actor dies replays on the restarted incarnation instead of
+    raising ActorDiedError (reference: max_task_retries at-least-once
+    actor-call semantics). Without the option, in-flight calls still
+    die with the actor."""
+    import os
+    import time
+
+    marker = str(tmp_path / "attempted")
+
+    @ray_tpu.remote(max_restarts=2, max_task_retries=2)
+    class Flaky:
+        def work(self, marker):
+            if not os.path.exists(marker):
+                open(marker, "w").close()
+                os._exit(1)  # die mid-call on the first attempt
+            return "recovered"
+
+        def ping(self):
+            return 1
+
+    a = Flaky.remote()
+    ray_tpu.get(a.ping.remote(), timeout=30)
+    assert ray_tpu.get(a.work.remote(marker), timeout=60) == "recovered"
+    # The actor restarted exactly once and still serves.
+    assert ray_tpu.get(a.ping.remote(), timeout=30) == 1
+    ray_tpu.kill(a)
+
+    # Default (max_task_retries=0): the in-flight call errors.
+    marker2 = str(tmp_path / "attempted2")
+
+    @ray_tpu.remote(max_restarts=2)
+    class Fatal:
+        def work(self, marker):
+            if not os.path.exists(marker):
+                open(marker, "w").close()
+                os._exit(1)
+            return "never retried"
+
+        def ping(self):
+            return 1
+
+    b = Fatal.remote()
+    ray_tpu.get(b.ping.remote(), timeout=30)
+    with pytest.raises(Exception, match="ActorDied"):
+        ray_tpu.get(b.work.remote(marker2), timeout=60)
+    ray_tpu.kill(b)
