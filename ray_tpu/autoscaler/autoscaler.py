@@ -134,6 +134,16 @@ class StandardAutoscaler:
                 filters=[("state", "=", "PENDING_CREATION")], limit=10000
             )
         ]
+        # Persistent sdk.request_resources hints: the cluster scales to
+        # ACCOMMODATE these shapes (they join the bin-pack demand set;
+        # existing free capacity satisfies them first — reference
+        # semantics, autoscaler/sdk/sdk.py:206).
+        try:
+            from ray_tpu.autoscaler.sdk import requested_resources
+
+            demands += requested_resources()
+        except Exception:
+            pass
         return [d for d in demands if d]
 
     @staticmethod

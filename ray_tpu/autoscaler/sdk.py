@@ -1,0 +1,42 @@
+"""Programmatic autoscaler hints (reference: ray.autoscaler.sdk.sdk.py
+:206 request_resources).
+
+The request persists in the head KV until overridden by another call;
+the autoscaler's demand source folds it in alongside live queued-task
+demand, so the cluster scales to ACCOMMODATE the request (capacity
+check, not additive to running work — reference semantics).
+"""
+
+from __future__ import annotations
+
+import json
+
+_NS = "__autoscaler__"
+_KEY = "requested_resources"
+
+
+def request_resources(num_cpus: "int | None" = None,
+                      bundles: "list[dict] | None" = None) -> None:
+    """Persistently request that the cluster scale to fit ``num_cpus``
+    1-CPU slots and/or the given resource ``bundles``. Overridden by the
+    next call; ``request_resources()`` with no args clears the request."""
+    from ray_tpu import api
+    from ray_tpu._private.worker_context import global_runtime
+
+    api.auto_init()
+    req: list[dict] = []
+    if num_cpus:
+        req.extend({"CPU": 1.0} for _ in range(int(num_cpus)))
+    for b in bundles or ():
+        if not isinstance(b, dict):
+            raise TypeError(f"bundles must be resource dicts, got {b!r}")
+        req.append({k: float(v) for k, v in b.items()})
+    global_runtime().kv_put(_KEY, json.dumps(req).encode(), ns=_NS)
+
+
+def requested_resources() -> list[dict]:
+    """The currently persisted request (empty when none)."""
+    from ray_tpu._private.worker_context import global_runtime
+
+    raw = global_runtime().kv_get(_KEY, ns=_NS)
+    return json.loads(raw) if raw else []

@@ -83,3 +83,26 @@ def test_max_workers_cap():
     res = rec.reconcile([{"CPU": 4.0}] * 9, lambda cid: False,
                         lambda cid: False)
     assert sum(res["launched"].values()) == 5  # capped by max_workers
+
+
+def test_request_resources_sdk(tmp_path):
+    """sdk.request_resources persists a demand hint the autoscaler's
+    demand source folds in (reference: autoscaler/sdk/sdk.py:206)."""
+    import ray_tpu
+    from ray_tpu.autoscaler import StandardAutoscaler
+    from ray_tpu.autoscaler.sdk import request_resources, requested_resources
+
+    ray_tpu.init(num_cpus=1, object_store_memory=32 * 1024 * 1024,
+                 ignore_reinit_error=True)
+    try:
+        request_resources(num_cpus=2, bundles=[{"CPU": 4.0}])
+        got = requested_resources()
+        assert got == [{"CPU": 1.0}, {"CPU": 1.0}, {"CPU": 4.0}]
+        # Demand source folds the hints into the bin-pack input.
+        demands = StandardAutoscaler._head_demand()
+        assert {"CPU": 4.0} in demands
+        # Overridden by the next call; no-arg clears.
+        request_resources()
+        assert requested_resources() == []
+    finally:
+        ray_tpu.shutdown()
