@@ -9,6 +9,7 @@ load."""
 from ray_tpu.serve.api import (
     build,
     delete,
+    get_app_handle,
     get_deployment_handle,
     get_grpc_port,
     get_proxy_port,
@@ -45,6 +46,7 @@ __all__ = [
     "get_deployment_handle",
     "get_grpc_port",
     "get_proxy_port",
+    "get_app_handle",
     "get_replica_context",
     "ReplicaContext",
     "run",

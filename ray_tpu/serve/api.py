@@ -70,15 +70,58 @@ def _specs_from_app(app: Application, route_prefix: str | None) -> list[dict]:
     return specs
 
 
-def run(app: Application | Deployment, *, route_prefix: str | None = None,
-        _blocking_ready: bool = True, proxy: bool = True) -> DeploymentHandle:
-    """Deploy an application; returns a handle to its ingress deployment."""
+def run(app: Application | Deployment, *, name: str | None = None,
+        route_prefix: str | None = None, _blocking_ready: bool = True,
+        proxy: bool = True) -> DeploymentHandle:
+    """Deploy an application; returns a handle to its ingress deployment.
+
+    An explicit ``name`` makes this a NAMED application (reference:
+    multi-app serve.run(name=...)): several apps coexist on one cluster,
+    each owning its deployments and route prefix; re-running a name
+    REPLACES that app (its stale deployments are removed), names owned
+    by other apps are protected, serve.delete(name) removes exactly it,
+    and get_app_handle(name) resolves its ingress. Unnamed runs keep the
+    additive single-app behavior (deployments accumulate under the
+    "default" app)."""
     if isinstance(app, Deployment):
         app = app.bind()
     controller = start(proxy=proxy)
     specs = _specs_from_app(app, route_prefix)
+    app_tag = name or "default"
+    for s in specs:
+        s["app"] = app_tag
+    dep_names = {s["name"] for s in specs}
+    existing = ray_tpu.get(controller.status.remote())
+    # Ownership guard for ALL runs: an unnamed run must not silently
+    # steal (and re-tag) a named app's deployment either.
+    for dn, st in existing.items():
+        owner = st.get("app")
+        if dn in dep_names and owner not in (None, app_tag):
+            raise ValueError(
+                f"deployment name {dn!r} already belongs to "
+                f"application {owner!r}")
+    stale: list[str] = []
+    if name is not None:
+        stale = [dn for dn, st in existing.items()
+                 if st.get("app") == name and dn not in dep_names]
     _deploy_specs(controller, specs, wait=_blocking_ready)
+    for dn in stale:
+        ray_tpu.get(controller.delete_deployment.remote(dn))
+    ray_tpu.get(controller.set_app_ingress.remote(app_tag,
+                                                  app.deployment.name))
     return DeploymentHandle(app.deployment.name)
+
+
+def get_app_handle(name: str) -> DeploymentHandle:
+    """Handle to a named application's ingress deployment (reference:
+    serve.get_app_handle)."""
+    controller = _resolve_controller()
+    if controller is None:
+        raise RayTpuError("serve is not running")
+    ingress = ray_tpu.get(controller.get_app_ingress.remote(name))
+    if ingress is None:
+        raise ValueError(f"no application named {name!r}")
+    return DeploymentHandle(ingress)
 
 
 def _wait_ready(controller, name: str, timeout_s: float = 30.0) -> None:
@@ -132,8 +175,14 @@ def status() -> dict:
 
 
 def delete(name: str) -> None:
+    """Delete a named APPLICATION (all its deployments) if ``name``
+    matches one (reference: serve.delete(app_name)); otherwise delete
+    the single deployment of that name."""
     if _controller is not None:
-        ray_tpu.get(_controller.delete_deployment.remote(name))
+        if ray_tpu.get(_controller.get_app_ingress.remote(name)) is not None:
+            ray_tpu.get(_controller.delete_application.remote(name))
+        else:
+            ray_tpu.get(_controller.delete_deployment.remote(name))
         if _proxy is not None:
             routes = ray_tpu.get(_controller.get_routes.remote())
             ray_tpu.get(_proxy.update_routes.remote(routes))

@@ -60,6 +60,10 @@ class ServeController:
 
     def __init__(self):
         self._deployments: dict[str, _DeploymentState] = {}
+        # Named applications (reference: multi-app serve): app name ->
+        # ingress deployment name. Deployment specs carry their owning
+        # app under spec["app"].
+        self._apps: dict[str, str] = {}
         self._lock = threading.RLock()
         self._stop = threading.Event()
         self._thread = threading.Thread(
@@ -106,7 +110,39 @@ class ServeController:
 
     def status(self) -> dict:
         with self._lock:
-            return {name: st.status() for name, st in self._deployments.items()}
+            out = {}
+            for name, st in self._deployments.items():
+                s = st.status()
+                s["app"] = st.spec.get("app")
+                out[name] = s
+            return out
+
+    def set_app_ingress(self, app: str, ingress: str) -> None:
+        with self._lock:
+            self._apps[app] = ingress
+
+    def get_app_ingress(self, app: str) -> "str | None":
+        with self._lock:
+            return self._apps.get(app)
+
+    def list_applications(self) -> dict:
+        with self._lock:
+            return {
+                app: {
+                    "ingress": ingress,
+                    "deployments": [n for n, st in self._deployments.items()
+                                    if st.spec.get("app") == app],
+                }
+                for app, ingress in self._apps.items()
+            }
+
+    def delete_application(self, app: str) -> None:
+        with self._lock:
+            names = [n for n, st in self._deployments.items()
+                     if st.spec.get("app") == app]
+            self._apps.pop(app, None)
+        for n in names:
+            self.delete_deployment(n)
 
     def delete_deployment(self, name: str) -> None:
         with self._lock:

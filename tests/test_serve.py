@@ -426,3 +426,84 @@ def test_get_replica_context():
     assert out["replica_id"].startswith("WhoAmI")
     assert out["servable_is_self"] is True
     serve.shutdown()
+
+
+def test_named_multi_application():
+    """Named apps coexist, each with its own route and lifecycle
+    (reference: serve.run(name=...), get_app_handle, delete(app))."""
+    import json
+    import urllib.request
+
+    from ray_tpu import serve
+
+    serve.shutdown()  # leftover unnamed-app deployments would collide
+
+    @serve.deployment
+    class Alpha:
+        def __call__(self, x):
+            return {"app": "alpha", "x": x}
+
+    @serve.deployment
+    class Beta:
+        def __call__(self, x):
+            return {"app": "beta", "x": x}
+
+    serve.run(Alpha.bind(), name="alpha", route_prefix="/alpha", proxy=True)
+    serve.run(Beta.bind(), name="beta", route_prefix="/beta", proxy=True)
+
+    assert serve.get_app_handle("alpha").remote(1).result()["app"] == "alpha"
+    assert serve.get_app_handle("beta").remote(2).result()["app"] == "beta"
+
+    port = serve.get_proxy_port()
+    body = json.dumps(7).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/beta", data=body,
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        assert json.loads(r.read()) == {"app": "beta", "x": 7}
+
+    st = serve.status()
+    assert st["Alpha"]["app"] == "alpha" and st["Beta"]["app"] == "beta"
+
+    # Cross-app deployment-name theft is rejected.
+    @serve.deployment(name="Alpha")
+    class Impostor:
+        def __call__(self, x):
+            return "stolen"
+
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="belongs to application"):
+        serve.run(Impostor.bind(), name="gamma", proxy=False)
+
+    # delete(app) removes exactly that app.
+    serve.delete("alpha")
+    st = serve.status()
+    assert "Alpha" not in st and "Beta" in st
+    with _pytest.raises(ValueError, match="no application"):
+        serve.get_app_handle("alpha")
+    assert serve.get_app_handle("beta").remote(3).result()["x"] == 3
+    serve.shutdown()
+
+
+def test_unnamed_run_cannot_steal_named_app():
+    from ray_tpu import serve
+
+    serve.shutdown()
+
+    @serve.deployment(name="Owned")
+    class Owned:
+        def __call__(self, x):
+            return "owned"
+
+    serve.run(Owned.bind(), name="myapp", proxy=False)
+
+    @serve.deployment(name="Owned")
+    class Thief:
+        def __call__(self, x):
+            return "stolen"
+
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="belongs to application"):
+        serve.run(Thief.bind(), proxy=False)
+    assert serve.get_app_handle("myapp").remote(0).result() == "owned"
+    serve.shutdown()
