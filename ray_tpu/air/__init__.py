@@ -1,0 +1,24 @@
+"""AIR common namespace (reference: python/ray/air — ScalingConfig /
+RunConfig / FailureConfig / CheckpointConfig / Result shared by Train
+and Tune, air/config.py). The classes live in ray_tpu.train.config; this
+package keeps the reference's import paths working:
+
+    from ray_tpu.air import ScalingConfig, RunConfig
+    from ray_tpu.air.config import FailureConfig
+"""
+
+from ray_tpu.train.config import (
+    CheckpointConfig,
+    FailureConfig,
+    Result,
+    RunConfig,
+    ScalingConfig,
+)
+
+__all__ = [
+    "CheckpointConfig",
+    "FailureConfig",
+    "Result",
+    "RunConfig",
+    "ScalingConfig",
+]

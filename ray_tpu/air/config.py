@@ -1,0 +1,9 @@
+"""reference: python/ray/air/config.py import-path parity."""
+
+from ray_tpu.train.config import (  # noqa: F401
+    CheckpointConfig,
+    FailureConfig,
+    Result,
+    RunConfig,
+    ScalingConfig,
+)

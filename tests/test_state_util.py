@@ -278,3 +278,15 @@ def test_list_placement_groups_and_jobs():
     assert list_placement_groups(filters=[("state", "=", "CREATED")])
     remove_placement_group(pg)
     assert isinstance(list_jobs(), list)
+
+
+def test_air_namespace_parity():
+    """reference import paths (python/ray/air/config.py) resolve to the
+    shared Train/Tune config classes."""
+    from ray_tpu import air
+    from ray_tpu.air.config import RunConfig as RC2
+    from ray_tpu.train.config import RunConfig, ScalingConfig
+
+    assert air.ScalingConfig is ScalingConfig
+    assert air.RunConfig is RunConfig is RC2
+    assert air.ScalingConfig(num_workers=2).num_workers == 2
