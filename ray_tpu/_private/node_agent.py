@@ -353,6 +353,9 @@ def main() -> None:
     p.add_argument("--num-cpus", type=float, default=None)
     p.add_argument("--num-tpus", type=float, default=None)
     p.add_argument("--resources", default=None, help='JSON, e.g. \'{"side": 1}\'')
+    p.add_argument("--labels", default=None,
+                   help='JSON node labels, e.g. \'{"zone": "us-a"}\' '
+                        '(NodeLabelSchedulingStrategy targets)')
     p.add_argument("--node-id", default=None)
     p.add_argument("--force-remote-objects", action="store_true")
     args = p.parse_args()
@@ -364,6 +367,7 @@ def main() -> None:
         num_cpus=args.num_cpus,
         num_tpus=args.num_tpus,
         resources=json.loads(args.resources) if args.resources else None,
+        labels=json.loads(args.labels) if args.labels else None,
         node_id=args.node_id,
         force_remote_objects=args.force_remote_objects,
     )

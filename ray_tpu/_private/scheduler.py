@@ -136,6 +136,59 @@ class PlacementGroupSchedulingStrategy:
     placement_group_bundle_index: int = -1
 
 
+# Label match expressions (reference: util/scheduling_strategies.py
+# In/NotIn/Exists/DoesNotExist for NodeLabelSchedulingStrategy).
+
+
+class In:
+    def __init__(self, *values):
+        self.values = set(values)
+
+    def matches(self, v) -> bool:
+        return v is not None and v in self.values
+
+
+class NotIn:
+    def __init__(self, *values):
+        self.values = set(values)
+
+    def matches(self, v) -> bool:
+        return v is not None and v not in self.values
+
+
+class Exists:
+    def matches(self, v) -> bool:
+        return v is not None
+
+
+class DoesNotExist:
+    def matches(self, v) -> bool:
+        return v is None
+
+
+def _labels_match(labels: dict, conditions: dict) -> bool:
+    for key, expr in (conditions or {}).items():
+        v = labels.get(key)
+        if hasattr(expr, "matches"):
+            if not expr.matches(v):
+                return False
+        elif v != expr:  # plain value = equality
+            return False
+    return True
+
+
+@dataclasses.dataclass
+class NodeLabelSchedulingStrategy:
+    """Schedule onto nodes by label (reference:
+    util/scheduling_strategies.py:135). ``hard`` conditions filter
+    candidate nodes; ``soft`` conditions are preferred but not required.
+    Values may be plain strings (equality) or In/NotIn/Exists/
+    DoesNotExist expressions."""
+
+    hard: dict
+    soft: dict | None = None
+
+
 class ClusterScheduler:
     """Picks a node for each resource demand.
 
@@ -201,6 +254,23 @@ class ClusterScheduler:
             if not strategy.soft:
                 return None
             # fall through to default policy
+        if isinstance(strategy, NodeLabelSchedulingStrategy):
+            hard = [n for n in nodes
+                    if _labels_match(n.labels, strategy.hard)
+                    and n.available.fits(demand)]
+            if not hard:
+                return None
+            soft = [n for n in hard
+                    if _labels_match(n.labels, strategy.soft or {})]
+            pool = soft or hard
+            # Hybrid tie-break within the labeled pool.
+            below = [n for n in pool
+                     if n.utilization() < self.spread_threshold]
+            if below:
+                return max(below, key=lambda n: (_round4(n.utilization()),
+                                                 n.node_id))
+            return min(pool, key=lambda n: (_round4(n.utilization()),
+                                            n.node_id))
         if self._native is not None:
             picked = self._native.pick_node(
                 demand.to_dict(), spread=strategy == "SPREAD"

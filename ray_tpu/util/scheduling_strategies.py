@@ -9,7 +9,12 @@ is the user-facing import path:
 """
 
 from ray_tpu._private.scheduler import (
+    DoesNotExist,
+    Exists,
+    In,
     NodeAffinitySchedulingStrategy,
+    NodeLabelSchedulingStrategy,
+    NotIn,
     PlacementGroupSchedulingStrategy,
 )
 
@@ -20,5 +25,10 @@ __all__ = [
     "DEFAULT_SCHEDULING_STRATEGY",
     "SPREAD_SCHEDULING_STRATEGY",
     "NodeAffinitySchedulingStrategy",
+    "NodeLabelSchedulingStrategy",
     "PlacementGroupSchedulingStrategy",
+    "In",
+    "NotIn",
+    "Exists",
+    "DoesNotExist",
 ]

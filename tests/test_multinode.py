@@ -23,12 +23,15 @@ from ray_tpu._private.worker_context import get_head
 
 
 def _start_agent(address: str, *, resources: str, node_id: str,
-                 force_remote: bool = True) -> subprocess.Popen:
+                 force_remote: bool = True,
+                 labels: str | None = None) -> subprocess.Popen:
     cmd = [
         sys.executable, "-m", "ray_tpu._private.node_agent",
         "--address", address, "--num-cpus", "4",
         "--resources", resources, "--node-id", node_id,
     ]
+    if labels:
+        cmd += ["--labels", labels]
     if force_remote:
         cmd.append("--force-remote-objects")
     env = dict(os.environ)
@@ -370,3 +373,41 @@ def test_cross_node_compiled_dag_beats_by_ref(cluster_2n):
     loaded = os.getloadavg()[0] > 4.0 * (os.cpu_count() or 1)
     bar = 1.5 if loaded else 3.0
     assert max(ratios) > bar, (ratios, os.getloadavg())
+
+
+def test_node_label_scheduling(cluster_2n):
+    """NodeLabelSchedulingStrategy (reference:
+    util/scheduling_strategies.py:135): hard label conditions pin to
+    matching nodes; In/NotIn expressions work; no match -> task waits."""
+    from ray_tpu.util.scheduling_strategies import (
+        In,
+        NodeLabelSchedulingStrategy,
+    )
+
+    agent = _start_agent(
+        ray_tpu.get_runtime_context().gcs_address,
+        resources='{"labelled": 1}', node_id="node-labelled",
+        labels='{"zone": "us-a", "tier": "gold"}')
+    try:
+        _wait_nodes(3)
+
+        @ray_tpu.remote(num_cpus=0.1)
+        def where():
+            return ray_tpu.get_runtime_context().get_node_id()
+
+        s = NodeLabelSchedulingStrategy(hard={"zone": "us-a"})
+        assert ray_tpu.get(where.options(scheduling_strategy=s).remote(),
+                           timeout=60) == "node-labelled"
+        s = NodeLabelSchedulingStrategy(hard={"tier": In("gold", "silver")})
+        assert ray_tpu.get(where.options(scheduling_strategy=s).remote(),
+                           timeout=60) == "node-labelled"
+        # Unsatisfiable hard condition: the task stays queued.
+        s = NodeLabelSchedulingStrategy(hard={"zone": "eu-x"})
+        ref = where.options(scheduling_strategy=s).remote()
+        import pytest as _pytest
+
+        with _pytest.raises(Exception):
+            ray_tpu.get(ref, timeout=3)
+        ray_tpu.cancel(ref)
+    finally:
+        agent.send_signal(signal.SIGKILL)
