@@ -2,6 +2,10 @@
 
 from ray_tpu.accelerators.accelerator import (
     AcceleratorManager,
+    AMDGPUAcceleratorManager,
+    HPUAcceleratorManager,
+    IntelGPUAcceleratorManager,
+    NPUAcceleratorManager,
     NeuronAcceleratorManager,
     NvidiaGPUAcceleratorManager,
     detect_node_accelerators,
@@ -13,6 +17,10 @@ from ray_tpu.accelerators.tpu import TPUAcceleratorManager
 
 __all__ = [
     "AcceleratorManager",
+    "AMDGPUAcceleratorManager",
+    "HPUAcceleratorManager",
+    "IntelGPUAcceleratorManager",
+    "NPUAcceleratorManager",
     "NeuronAcceleratorManager",
     "NvidiaGPUAcceleratorManager",
     "TPUAcceleratorManager",

@@ -110,6 +110,119 @@ class NeuronAcceleratorManager(AcceleratorManager):
         os.environ["NEURON_RT_VISIBLE_CORES"] = ",".join(str(i) for i in ids)
 
 
+class AMDGPUAcceleratorManager(AcceleratorManager):
+    """Reference: _private/accelerators/amd_gpu.py — resource "GPU"
+    (shared with NVIDIA; a node has one vendor), HIP_VISIBLE_DEVICES
+    pinning (ROCR_VISIBLE_DEVICES honored for discovery), /dev/kfd +
+    /sys/class/kfd topology discovery."""
+
+    @staticmethod
+    def get_resource_name() -> str:
+        return "GPU"
+
+    @staticmethod
+    def get_visible_accelerator_ids_env_var() -> str:
+        return "HIP_VISIBLE_DEVICES"
+
+    @staticmethod
+    def get_current_node_num_accelerators() -> int:
+        for var in ("HIP_VISIBLE_DEVICES", "ROCR_VISIBLE_DEVICES"):
+            v = os.environ.get(var)
+            if v is not None:
+                return 0 if v == "" else len(v.split(","))
+        if not os.path.exists("/dev/kfd"):
+            return 0
+        return len(glob.glob("/sys/class/kfd/kfd/topology/nodes/*/gpu_id"))
+
+    @staticmethod
+    def get_current_process_visible_accelerator_ids() -> Optional[list[str]]:
+        v = os.environ.get("HIP_VISIBLE_DEVICES")
+        if v is None:
+            return None
+        return [] if v == "" else v.split(",")
+
+    @staticmethod
+    def set_current_process_visible_accelerator_ids(ids: list[str]) -> None:
+        os.environ["HIP_VISIBLE_DEVICES"] = ",".join(str(i) for i in ids)
+
+
+class IntelGPUAcceleratorManager(AcceleratorManager):
+    """Reference: _private/accelerators/intel_gpu.py — resource "GPU",
+    ONEAPI_DEVICE_SELECTOR pinning, /dev/dri render-node discovery."""
+
+    @staticmethod
+    def get_resource_name() -> str:
+        return "GPU"
+
+    @staticmethod
+    def get_visible_accelerator_ids_env_var() -> str:
+        return "ONEAPI_DEVICE_SELECTOR"
+
+    @staticmethod
+    def get_current_node_num_accelerators() -> int:
+        sel = os.environ.get("ONEAPI_DEVICE_SELECTOR")
+        if sel is not None:
+            # "level_zero:0,1" style — count the device list.
+            ids = sel.split(":", 1)[-1]
+            return 0 if not ids else len(ids.split(","))
+        return len(glob.glob("/dev/dri/renderD*")) if os.path.isdir(
+            "/dev/dri") else 0
+
+    @staticmethod
+    def set_current_process_visible_accelerator_ids(ids: list[str]) -> None:
+        os.environ["ONEAPI_DEVICE_SELECTOR"] = "level_zero:" + ",".join(
+            str(i) for i in ids)
+
+
+class HPUAcceleratorManager(AcceleratorManager):
+    """Reference: _private/accelerators/hpu.py — Habana Gaudi, resource
+    "HPU", HABANA_VISIBLE_MODULES pinning, /dev/accel discovery."""
+
+    @staticmethod
+    def get_resource_name() -> str:
+        return "HPU"
+
+    @staticmethod
+    def get_visible_accelerator_ids_env_var() -> str:
+        return "HABANA_VISIBLE_MODULES"
+
+    @staticmethod
+    def get_current_node_num_accelerators() -> int:
+        v = os.environ.get("HABANA_VISIBLE_MODULES")
+        if v is not None:
+            return 0 if v == "" else len(v.split(","))
+        return len(glob.glob("/dev/accel/accel[0-9]*"))
+
+    @staticmethod
+    def set_current_process_visible_accelerator_ids(ids: list[str]) -> None:
+        os.environ["HABANA_VISIBLE_MODULES"] = ",".join(str(i) for i in ids)
+
+
+class NPUAcceleratorManager(AcceleratorManager):
+    """Reference: _private/accelerators/npu.py — Ascend, resource "NPU",
+    ASCEND_RT_VISIBLE_DEVICES pinning, /dev/davinci? discovery."""
+
+    @staticmethod
+    def get_resource_name() -> str:
+        return "NPU"
+
+    @staticmethod
+    def get_visible_accelerator_ids_env_var() -> str:
+        return "ASCEND_RT_VISIBLE_DEVICES"
+
+    @staticmethod
+    def get_current_node_num_accelerators() -> int:
+        v = os.environ.get("ASCEND_RT_VISIBLE_DEVICES")
+        if v is not None:
+            return 0 if v == "" else len(v.split(","))
+        return len(glob.glob("/dev/davinci[0-9]*"))
+
+    @staticmethod
+    def set_current_process_visible_accelerator_ids(ids: list[str]) -> None:
+        os.environ["ASCEND_RT_VISIBLE_DEVICES"] = ",".join(
+            str(i) for i in ids)
+
+
 _MANAGERS: dict[str, type[AcceleratorManager]] = {}
 
 
@@ -128,13 +241,23 @@ def get_all_accelerator_managers() -> list[type[AcceleratorManager]]:
 
 def detect_node_accelerators() -> dict[str, float]:
     """Resources contributed by every registered manager on this node
-    (reference: resource_spec.py resolving managers at node start)."""
+    (reference: resource_spec.py resolving managers at node start).
+
+    Several vendors share the "GPU" resource name (NVIDIA/AMD/Intel — a
+    node has one vendor); the registry holds the default (NVIDIA) and
+    the others probe here as fallbacks, first nonzero count wins."""
     out: dict[str, float] = {}
     for mgr in _MANAGERS.values():
         n = mgr.get_current_node_num_accelerators()
         if n > 0:
             out[mgr.get_resource_name()] = float(n)
             out.update(mgr.get_current_node_additional_resources())
+    if "GPU" not in out:
+        for mgr in (AMDGPUAcceleratorManager, IntelGPUAcceleratorManager):
+            n = mgr.get_current_node_num_accelerators()
+            if n > 0:
+                out["GPU"] = float(n)
+                break
     return out
 
 
@@ -154,7 +277,8 @@ def _register_builtins() -> None:
     from ray_tpu.accelerators.tpu import TPUAcceleratorManager
 
     for mgr in (TPUAcceleratorManager, NvidiaGPUAcceleratorManager,
-                NeuronAcceleratorManager):
+                NeuronAcceleratorManager, HPUAcceleratorManager,
+                NPUAcceleratorManager):
         register_accelerator_manager(mgr)
 
 

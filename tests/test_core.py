@@ -471,3 +471,30 @@ def test_runtime_context_surface(cluster):
     assert out["worker"].startswith("worker-")
     assert out["task"].startswith("task-")
     assert out["env"].get("env_vars") == {"X": "1"}
+
+
+def test_accelerator_manager_vendors(monkeypatch):
+    """Vendor managers mirror the reference env-var contracts
+    (amd_gpu.py / intel_gpu.py / hpu.py / npu.py)."""
+    from ray_tpu.accelerators import (
+        AMDGPUAcceleratorManager,
+        HPUAcceleratorManager,
+        IntelGPUAcceleratorManager,
+        NPUAcceleratorManager,
+    )
+
+    monkeypatch.setenv("HIP_VISIBLE_DEVICES", "0,1")
+    assert AMDGPUAcceleratorManager.get_current_node_num_accelerators() == 2
+    assert AMDGPUAcceleratorManager.get_current_process_visible_accelerator_ids() == ["0", "1"]
+    AMDGPUAcceleratorManager.set_current_process_visible_accelerator_ids(["3"])
+    assert os.environ["HIP_VISIBLE_DEVICES"] == "3"
+
+    monkeypatch.setenv("HABANA_VISIBLE_MODULES", "0,1,2")
+    assert HPUAcceleratorManager.get_current_node_num_accelerators() == 3
+    assert HPUAcceleratorManager.get_resource_name() == "HPU"
+
+    monkeypatch.setenv("ASCEND_RT_VISIBLE_DEVICES", "")
+    assert NPUAcceleratorManager.get_current_node_num_accelerators() == 0
+
+    monkeypatch.setenv("ONEAPI_DEVICE_SELECTOR", "level_zero:0,1")
+    assert IntelGPUAcceleratorManager.get_current_node_num_accelerators() == 2
