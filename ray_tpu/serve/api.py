@@ -351,4 +351,14 @@ def run_from_config(config: dict | str, *, proxy: bool = True) -> None:
                                 for k, v in d.get("init_kwargs", {}).items()},
                 "route_prefix": d.get("route_prefix"),
             })
+        app_name = app.get("name", "default")
+        for s in specs:
+            s["app"] = app_name
         _deploy_specs(controller, specs)
+        if specs:
+            # Ingress = the routed deployment (or the last listed one),
+            # registered so get_app_handle(name) works for declarative
+            # deploys too.
+            ingress = next((s["name"] for s in specs
+                            if s.get("route_prefix")), specs[-1]["name"])
+            ray_tpu.get(controller.set_app_ingress.remote(app_name, ingress))
