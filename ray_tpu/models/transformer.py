@@ -91,6 +91,15 @@ class TransformerConfig:
     # recompute. Throughput-bench configs turn it off (the metric dict
     # then reports accuracy 0.0).
     ce_accuracy: bool = True
+    # Chunked-CE backward strategy. "fused": custom-VJP that computes
+    # dlogits = softmax - onehot analytically INSIDE the forward scan
+    # and saves only dx/dhead — each chunk's logits are computed exactly
+    # once per train step. "checkpoint": jax.checkpoint around the chunk
+    # body — the backward recomputes every chunk's logits (an extra
+    # head matmul, ~10% of GPT-2 124M's step FLOPs). Both are O(T)
+    # memory; eval (no grad) never pays the fused path's extra work
+    # because custom_vjp only runs it under differentiation.
+    ce_impl: str = "fused"           # "fused" | "checkpoint"
     # Mixture of Experts (llama arch only; 0 = dense FFN). Greenfield vs
     # the reference (SURVEY.md §2.4: EP absent upstream) — see ops/moe.py.
     n_experts: int = 0
@@ -543,15 +552,8 @@ def chunked_ce_loss(x, head, targets, *, mask=None, z_loss: float = 0.0,
         xb, tb, mb = xs
         logits = jnp.einsum("cd,dv->cv", xb, head,
                             preferred_element_type=jnp.float32)
-        lse = jax.scipy.special.logsumexp(logits, axis=-1)
-        gold = jnp.take_along_axis(logits, tb[:, None], axis=-1)[:, 0]
-        nll = lse - gold
-        if z_loss:
-            nll = nll + z_loss * jnp.square(lse)
-        if accuracy:
-            correct = (logits.argmax(-1) == tb).astype(jnp.float32)
-            correct_sum = correct_sum + (correct * mb).sum()
-        return (nll_sum + (nll * mb).sum(), correct_sum), None
+        nll_s, corr_s, _ = _ce_chunk_stats(logits, tb, mb, z_loss, accuracy)
+        return (nll_sum + nll_s, correct_sum + corr_s), None
 
     (nll_sum, correct_sum), _ = jax.lax.scan(
         body, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
@@ -562,6 +564,117 @@ def chunked_ce_loss(x, head, targets, *, mask=None, z_loss: float = 0.0,
     acc = correct_sum / denom
     return loss, {"loss": loss, "accuracy": acc,
                   "perplexity": jnp.exp(jnp.minimum(loss, 20.0))}
+
+
+def _ce_chunk_stats(logits, tb, mb, z_loss, accuracy):
+    """Shared per-chunk CE statistics: (nll_masked_sum, correct_masked_sum,
+    lse). logits fp32 [c,V]; tb [c] int; mb [c] fp32."""
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, tb[:, None], axis=-1)[:, 0]
+    nll = lse - gold
+    if z_loss:
+        nll = nll + z_loss * jnp.square(lse)
+    correct = ((logits.argmax(-1) == tb).astype(jnp.float32) * mb).sum() \
+        if accuracy else jnp.zeros((), jnp.float32)
+    return (nll * mb).sum(), correct, lse
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def fused_chunked_ce_loss(x, head, targets, mask, z_loss, chunk, accuracy):
+    """Chunked LM-head CE whose BACKWARD is computed analytically in the
+    forward scan (dlogits = softmax - onehot), so each chunk's logits
+    matmul runs exactly once per train step — vs jax.checkpoint's
+    recompute-in-backward (see TransformerConfig.ce_impl). x [N,D]
+    (flattened final hidden), head [D,V], targets [N] int, mask [N] f32.
+    Returns (loss, acc). The un-differentiated call (eval) skips the
+    gradient work entirely."""
+    nll_sum, correct_sum, denom = _fused_ce_scan(
+        x, head, targets, mask, z_loss, chunk, accuracy, want_grads=False)
+    return nll_sum / denom, correct_sum / denom
+
+
+def _fused_ce_scan(x, head, targets, mask, z_loss, chunk, accuracy,
+                   want_grads):
+    """Scan over token chunks. Returns (nll_sum, correct_sum, denom) and,
+    with want_grads, also (dx [N,D] f32-accurate, dhead [D,V] f32): the
+    cotangents of x/head for a unit loss cotangent, already including
+    the 1/denom and z_loss terms."""
+    N, D = x.shape
+    V = head.shape[1]
+    chunk = min(chunk, N)
+    n_chunks = (N + chunk - 1) // chunk
+    pad = n_chunks * chunk - N
+    xf, tf, mf = x, targets, mask
+    if pad:
+        xf = jnp.concatenate([xf, jnp.zeros((pad, D), xf.dtype)])
+        tf = jnp.concatenate([tf, jnp.zeros((pad,), tf.dtype)])
+        mf = jnp.concatenate([mf, jnp.zeros((pad,), mf.dtype)])
+    xc = xf.reshape(n_chunks, chunk, D)
+    tc = tf.reshape(n_chunks, chunk)
+    mc = mf.reshape(n_chunks, chunk)
+    denom = jnp.maximum(mf.sum(), 1.0)
+
+    def body(carry, xs):
+        xb, tb, mb = xs
+        logits = jnp.einsum("cd,dv->cv", xb, head,
+                            preferred_element_type=jnp.float32)
+        nll_s, corr_s, lse = _ce_chunk_stats(logits, tb, mb, z_loss,
+                                             accuracy)
+        if not want_grads:
+            nll_sum, correct_sum = carry
+            return (nll_sum + nll_s, correct_sum + corr_s), None
+        nll_sum, correct_sum, dhead = carry
+        # dloss/dlogits for loss = sum(nll*m)/denom:
+        #   (softmax * (1 + 2*z*lse) - onehot) * m / denom
+        p = jnp.exp(logits - lse[:, None])
+        dl = p * (1.0 + 2.0 * z_loss * lse)[:, None] if z_loss else p
+        # onehot subtraction as an iota-compare (TPU scatter is slow)
+        onehot = (jax.lax.broadcasted_iota(jnp.int32, dl.shape, 1)
+                  == tb[:, None])
+        dl = (dl - onehot.astype(dl.dtype)) * (mb / denom)[:, None]
+        # bf16 matmul operands (MXU), fp32 accumulation: same precision
+        # story as the rest of the model's backward.
+        dlc = dl.astype(head.dtype)
+        dxb = jnp.einsum("cv,dv->cd", dlc, head,
+                         preferred_element_type=jnp.float32)
+        dhead = dhead + jnp.einsum("cd,cv->dv", xb.astype(head.dtype), dlc,
+                                   preferred_element_type=jnp.float32)
+        return (nll_sum + nll_s, correct_sum + corr_s, dhead), dxb
+
+    zero = jnp.zeros((), jnp.float32)
+    if not want_grads:
+        (nll_sum, correct_sum), _ = jax.lax.scan(body, (zero, zero),
+                                                 (xc, tc, mc))
+        return nll_sum, correct_sum, denom
+    dhead0 = jnp.zeros((D, V), jnp.float32)
+    (nll_sum, correct_sum, dhead), dxc = jax.lax.scan(
+        body, (zero, zero, dhead0), (xc, tc, mc))
+    dx = dxc.reshape(n_chunks * chunk, D)[:N]
+    return (nll_sum, correct_sum, denom), (dx, dhead)
+
+
+def _fused_ce_fwd(x, head, targets, mask, z_loss, chunk, accuracy):
+    (nll_sum, correct_sum, denom), (dx, dhead) = _fused_ce_scan(
+        x, head, targets, mask, z_loss, chunk, accuracy, want_grads=True)
+    return ((nll_sum / denom, correct_sum / denom),
+            (dx.astype(x.dtype), dhead.astype(head.dtype)))
+
+
+def _fused_ce_bwd(z_loss, chunk, accuracy, res, g):
+    import numpy as np
+
+    dx, dhead = res
+    g_loss, _g_acc = g  # accuracy is a metric; its cotangent is dropped
+    n = dx.shape[0]
+    # targets are int (float0 cotangent); mask is standardized to f32 by
+    # the callers (lm_loss) so its zero cotangent dtype is static here.
+    return ((dx * g_loss).astype(dx.dtype),
+            (dhead * g_loss).astype(dhead.dtype),
+            np.zeros((n,), jax.dtypes.float0),
+            jnp.zeros((n,), jnp.float32))
+
+
+fused_chunked_ce_loss.defvjp(_fused_ce_fwd, _fused_ce_bwd)
 
 
 def lm_loss(params, batch, config: TransformerConfig, *, mesh=None,
@@ -582,10 +695,25 @@ def lm_loss(params, batch, config: TransformerConfig, *, mesh=None,
                          return_hidden=True)
         head = (params["embed"]["tokens"].T if config.tied
                 else params["lm_head"]).astype(config.compute_dtype)
-        loss, metrics = chunked_ce_loss(x, head, tgt, mask=mask,
-                                        z_loss=z_loss,
-                                        chunk=config.loss_chunk,
-                                        accuracy=config.ce_accuracy)
+        if config.ce_impl not in ("fused", "checkpoint"):
+            raise ValueError(
+                f"ce_impl must be 'fused' or 'checkpoint', got "
+                f"{config.ce_impl!r}")
+        if config.ce_impl == "fused":
+            B, T, D = x.shape
+            mf = (mask.reshape(-1).astype(jnp.float32) if mask is not None
+                  else jnp.ones((B * T,), jnp.float32))
+            loss, acc = fused_chunked_ce_loss(
+                x.reshape(B * T, D), head, tgt.reshape(-1), mf,
+                float(z_loss), int(config.loss_chunk),
+                bool(config.ce_accuracy))
+            metrics = {"loss": loss, "accuracy": acc,
+                       "perplexity": jnp.exp(jnp.minimum(loss, 20.0))}
+        else:
+            loss, metrics = chunked_ce_loss(x, head, tgt, mask=mask,
+                                            z_loss=z_loss,
+                                            chunk=config.loss_chunk,
+                                            accuracy=config.ce_accuracy)
     else:
         logits, aux = forward(params, inp, config, mesh=mesh, return_aux=True)
         loss, metrics = cross_entropy_loss(logits, tgt, mask=mask,

@@ -475,9 +475,40 @@ def _flash_bwd_rule(causal, block_q, block_k, res, g):
 flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
+def flash_attention_jax(q, k, v, *, causal: bool = True,
+                        block_q: int = 512, block_k: int = 512):
+    """jax's bundled Pallas TPU flash kernel (fwd + dq/dkv backwards),
+    called through its public API. Shapes here are [B,T,H,D]; the
+    kernel wants [B,H,T,D]. Falls back to blockwise off-TPU (the
+    bundled kernel has no interpret path wired through this API)."""
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    bq = min(block_q, tq)
+    bk = min(block_k, tk)
+    if (jax.devices()[0].platform != "tpu" or tq % bq or tk % bk):
+        # Off-TPU (no interpret path wired through this API) or shapes
+        # the kernel can't tile — same guard the 'auto' path applies.
+        return blockwise_attention(q, k, v, causal=causal)
+    from jax.experimental.pallas.ops.tpu import flash_attention as fa
+    sizes = fa.BlockSizes(
+        block_q=bq, block_k_major=bk, block_k=bk, block_b=1,
+        block_q_major_dkv=bq, block_k_major_dkv=bk,
+        block_k_dkv=bk, block_q_dkv=bq,
+        block_k_major_dq=bk, block_k_dq=bk, block_q_dq=bq,
+    )
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    o = fa.flash_attention(qt, kt, vt, causal=causal,
+                           sm_scale=1.0 / math.sqrt(d),
+                           block_sizes=sizes)
+    return o.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
 def attention(q, k, v, *, causal: bool = True, impl: str = "auto",
               block_q: int = 256, block_k: int = 256):
-    """Dispatch: 'reference' | 'blockwise' | 'flash' | 'auto'.
+    """Dispatch: 'reference' | 'blockwise' | 'flash' | 'flash_jax' |
+    'auto'.
 
     'auto' uses the Pallas kernel on TPU when shapes tile cleanly, else
     the blockwise path. ``block_q``/``block_k`` size the flash kernel's
@@ -491,6 +522,9 @@ def attention(q, k, v, *, causal: bool = True, impl: str = "auto",
         return blockwise_attention(q, k, v, causal=causal)
     if impl == "flash":
         return flash_attention(q, k, v, causal, block_q, block_k)
+    if impl == "flash_jax":
+        return flash_attention_jax(q, k, v, causal=causal,
+                                   block_q=block_q, block_k=block_k)
     tq, tk = q.shape[1], k.shape[1]
     on_tpu = jax.devices()[0].platform == "tpu"
     # Short sequences: the O(T^2) scores tensor is small enough that XLA's

@@ -354,7 +354,22 @@ def run_from_config(config: dict | str, *, proxy: bool = True) -> None:
         app_name = app.get("name", "default")
         for s in specs:
             s["app"] = app_name
+        # Same semantics as run(name=...): a declarative deploy must not
+        # steal another app's deployments, and REPLACES its own app —
+        # deployments dropped from the config are removed.
+        dep_names = {s["name"] for s in specs}
+        existing = ray_tpu.get(controller.status.remote())
+        for dn, st in existing.items():
+            owner = st.get("app")
+            if dn in dep_names and owner not in (None, app_name):
+                raise ValueError(
+                    f"deployment name {dn!r} already belongs to "
+                    f"application {owner!r}")
+        stale = [dn for dn, st in existing.items()
+                 if st.get("app") == app_name and dn not in dep_names]
         _deploy_specs(controller, specs)
+        for dn in stale:
+            ray_tpu.get(controller.delete_deployment.remote(dn))
         if specs:
             # Ingress = the routed deployment (or the last listed one),
             # registered so get_app_handle(name) works for declarative

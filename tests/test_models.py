@@ -173,6 +173,35 @@ def test_chunked_ce_matches_dense_loss():
         assert np.allclose(np.asarray(a), np.asarray(b), atol=1e-4)
 
 
+def test_fused_ce_matches_checkpoint_ce():
+    """ce_impl="fused" (analytic dlogits in the forward scan) must agree
+    with ce_impl="checkpoint" (jax.checkpoint recompute) in loss AND
+    gradients, including z_loss and a padding mask."""
+    import jax
+    import numpy as np
+
+    from ray_tpu.models import transformer as tfm
+
+    c_f = tfm.tiny(dtype="float32", loss_chunk=64, ce_impl="fused")
+    c_c = tfm.tiny(dtype="float32", loss_chunk=64, ce_impl="checkpoint")
+    params = tfm.init_params(jax.random.PRNGKey(0), c_f)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 33), 0,
+                              c_f.vocab_size)
+    mask = (jax.random.uniform(jax.random.PRNGKey(2), (2, 33)) > 0.2)
+    batch = {"tokens": toks, "mask": mask.astype(np.float32)}
+    for z in (0.0, 1e-3):
+        l1, m1 = tfm.lm_loss(params, batch, c_f, z_loss=z)
+        l2, m2 = tfm.lm_loss(params, batch, c_c, z_loss=z)
+        assert np.allclose(float(l1), float(l2), rtol=1e-5), z
+        assert np.allclose(float(m1["accuracy"]), float(m2["accuracy"]))
+        g1 = jax.grad(lambda p: tfm.lm_loss(p, batch, c_f, z_loss=z)[0])(
+            params)
+        g2 = jax.grad(lambda p: tfm.lm_loss(p, batch, c_c, z_loss=z)[0])(
+            params)
+        for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+            assert np.allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
 def test_fused_clip_adamw_matches_optax():
     """ops.optim.FusedClipAdamW must reproduce
     optax.chain(clip_by_global_norm, adamw) exactly — it is an HBM-pass

@@ -42,6 +42,30 @@ def test_serve_build_and_run_from_config():
     serve.delete("Doubler")
 
 
+def test_run_from_config_replaces_app():
+    """Re-deploying a named app from config removes deployments dropped
+    from the config, and a config deploy cannot steal another app's
+    deployment (same semantics as serve.run(name=...))."""
+    from tests.serve_config_helpers import Chain, Doubler
+
+    config = serve.build(Chain.bind(Doubler.bind()), name="cfgapp")
+    serve.run_from_config(config, proxy=False)
+    assert serve.get_deployment_handle("Doubler").remote(4).result() == 8
+
+    # Drop Doubler (Chain without the inner handle arg won't resolve it,
+    # so build a one-deployment app directly in config form).
+    solo = serve.build(Doubler.bind(), name="cfgapp")
+    serve.run_from_config(solo, proxy=False)
+    status = serve.status()
+    assert "Chain" not in status, "stale deployment must be removed"
+
+    # A different app may not steal cfgapp's deployment name.
+    other = serve.build(Doubler.bind(), name="otherapp")
+    with pytest.raises(Exception, match="belongs to"):
+        serve.run_from_config(other, proxy=False)
+    serve.delete("cfgapp")
+
+
 def test_serve_build_rejects_main_classes():
     @serve.deployment
     class Local:  # defined in the test module at runtime — importable
