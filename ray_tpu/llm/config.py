@@ -22,13 +22,58 @@ from ray_tpu.models import transformer as tfm
 
 @dataclass
 class SamplingParams:
-    """Per-request sampling knobs (reference: vLLM SamplingParams subset)."""
+    """Per-request sampling knobs (reference: vLLM SamplingParams).
+
+    Extended sampling (top_k/top_p, penalties, per-request seed,
+    logprobs) runs as a separate jitted program over the decode step's
+    logits, engaged only when a batch member needs it — plain
+    greedy/temperature batches keep the in-decode sampling fast path.
+    """
 
     max_tokens: int = 64
     temperature: float = 0.0
+    # Nucleus/top-k filtering (vLLM semantics: top_k <= 0 disables,
+    # top_p = 1.0 disables). Applied after penalties and temperature.
+    top_k: int = 0
+    top_p: float = 1.0
+    # OpenAI-style penalties on generated tokens (presence: flat once a
+    # token has appeared; frequency: per occurrence) and HF-style
+    # repetition penalty (> 1.0 shrinks logits of any token present in
+    # the prompt OR generated so far).
+    presence_penalty: float = 0.0
+    frequency_penalty: float = 0.0
+    repetition_penalty: float = 1.0
+    # Per-request determinism: same seed -> same sample sequence,
+    # independent of batch composition. None -> engine-drawn.
+    seed: "int | None" = None
+    # Return the chosen token's logprob and the top-N alternatives per
+    # generated token (vLLM logprobs=N). 0 disables.
+    logprobs: int = 0
     stop_token_ids: tuple[int, ...] = ()
+    # Stop STRINGS (detokenized match, vLLM `stop`): generation ends at
+    # the first occurrence; the match is trimmed from the output text.
+    stop: tuple[str, ...] = ()
     # Reserved for future logit-processing extensions.
     extra: dict[str, Any] = field(default_factory=dict)
+
+    def needs_advanced(self) -> bool:
+        """True when this request needs the extended sampling program."""
+        return bool(
+            self.top_k > 0 or self.top_p < 1.0
+            or self.presence_penalty != 0.0 or self.frequency_penalty != 0.0
+            or self.repetition_penalty != 1.0 or self.seed is not None
+            or self.logprobs > 0
+        )
+
+    def greedy_equivalent(self) -> bool:
+        """True when sampling reduces to plain argmax over the RAW
+        logits (speculative decoding's verify contract): temperature 0
+        and nothing that reshapes the distribution's argmax. top_k/top_p
+        never change the argmax; penalties do."""
+        return (self.temperature <= 0.0
+                and self.presence_penalty == 0.0
+                and self.frequency_penalty == 0.0
+                and self.repetition_penalty == 1.0)
 
 
 @dataclass

@@ -32,6 +32,10 @@ from ray_tpu.llm.config import LLMConfig, SamplingParams
 from ray_tpu.llm.tokenizer import load_tokenizer
 from ray_tpu.models import transformer as tfm
 
+# Static top-k width of the device logprob output (one extra compile per
+# distinct static value — so one cap for everyone, vLLM max_logprobs).
+MAX_LOGPROBS = 20
+
 
 @dataclasses.dataclass
 class Request:
@@ -41,6 +45,9 @@ class Request:
     generated: list[int] = dataclasses.field(default_factory=list)
     finished: bool = False
     finish_reason: str | None = None
+    # Per generated token (only when params.logprobs > 0):
+    # {"token_id", "logprob", "top": {token_id: logprob, ...}}
+    logprobs: "list[dict] | None" = None
 
 
 @dataclasses.dataclass
@@ -50,6 +57,8 @@ class RequestOutput:
     text: str
     finish_reason: str | None
     num_prompt_tokens: int
+    # vLLM-style per-token logprobs (None unless requested).
+    logprobs: "list[dict] | None" = None
 
 
 class LLMEngine:
@@ -150,6 +159,24 @@ class LLMEngine:
         self.positions = np.zeros((B,), np.int32)
         self.last_tokens = np.zeros((B,), np.int32)
         self.temps = np.zeros((B,), np.float32)
+        # Extended sampling (vLLM SamplingParams parity): per-slot knobs
+        # uploaded to the advanced_sample program only when some active
+        # slot needs it (plain batches keep the in-decode fast path).
+        self.top_ks = np.zeros((B,), np.int32)
+        self.top_ps = np.ones((B,), np.float32)
+        self.pres_pens = np.zeros((B,), np.float32)
+        self.freq_pens = np.zeros((B,), np.float32)
+        self.rep_pens = np.ones((B,), np.float32)
+        self.seeds = np.zeros((B,), np.int32)
+        # Device-resident penalty state (updated in-program).
+        self._counts = jnp.zeros((B, c.vocab_size), jnp.int32)
+        self._prompt_mask = jnp.zeros((B, c.vocab_size), jnp.bool_)
+        self._plain = np.ones((B,), bool)  # slot uses the fast path
+        # Slot is compatible with the speculative-decode path: sampling
+        # reduces to raw-logits argmax (greedy_equivalent — top_k/top_p
+        # never change the argmax, penalties do) and no logprobs are
+        # requested (the spec path has no logprob plumbing).
+        self._spec_ok = np.ones((B,), bool)
         self.slots: list[Request | None] = [None] * B
         self.waiting: collections.deque[Request] = collections.deque()
         # Prefix cache: token-tuple -> (k, v) device arrays [L, plen, KV,
@@ -214,6 +241,10 @@ class LLMEngine:
     def add_request(self, request_id: str, prompt: str | list[int],
                     sampling_params: SamplingParams | None = None) -> None:
         sp = sampling_params or self.config.sampling_defaults
+        if sp.logprobs > MAX_LOGPROBS:
+            raise ValueError(
+                f"logprobs={sp.logprobs} exceeds the engine cap "
+                f"{MAX_LOGPROBS} (the device program's static top-k)")
         toks = (self.tokenizer.encode(prompt) if isinstance(prompt, str)
                 else list(prompt))
         toks = toks[: self.max_len - 1]
@@ -240,11 +271,36 @@ class LLMEngine:
             if self.slots[slot] is not None or not self.waiting:
                 continue
             req = self.waiting.popleft()
+            sp = req.params
             last_logits = self._prefill_into(slot, req.prompt_tokens)
-            tok = self._sample_host(np.asarray(last_logits), req.params)
             self.positions[slot] = len(req.prompt_tokens)
             self.slots[slot] = req
-            self.temps[slot] = req.params.temperature
+            self.temps[slot] = sp.temperature
+            self.top_ks[slot] = max(0, sp.top_k)
+            self.top_ps[slot] = sp.top_p
+            self.pres_pens[slot] = sp.presence_penalty
+            self.freq_pens[slot] = sp.frequency_penalty
+            self.rep_pens[slot] = sp.repetition_penalty
+            self._plain[slot] = not sp.needs_advanced()
+            self._spec_ok[slot] = sp.greedy_equivalent() and sp.logprobs == 0
+            if sp.seed is not None:
+                self.seeds[slot] = np.int32(np.uint32(sp.seed & 0xFFFFFFFF))
+            else:
+                self._rng, k = jax.random.split(self._rng)
+                self.seeds[slot] = np.int32(
+                    np.uint32(int(jax.random.bits(k, dtype=jnp.uint32))))
+            if sp.logprobs > 0:
+                req.logprobs = []
+            tok = self._sample_host(np.asarray(last_logits), slot, req)
+            if not self._plain[slot]:
+                # Seed the device-side penalty state: prompt token set +
+                # the first sampled token.
+                hist = np.zeros((self.model_config.vocab_size,), bool)
+                hist[np.asarray(req.prompt_tokens, np.int64)] = True
+                self._counts, self._prompt_mask = (
+                    model_runner.reset_slot_sampling(
+                        self._counts, self._prompt_mask, jnp.int32(slot),
+                        jnp.asarray(hist), jnp.int32(tok)))
             self.last_tokens[slot] = tok
             req.generated.append(tok)
             self._maybe_finish(slot, outputs)
@@ -368,11 +424,67 @@ class LLMEngine:
         while len(self._prefix_pool) > self.config.prefix_cache_entries:
             self._prefix_pool.popitem(last=False)
 
-    def _sample_host(self, logits: np.ndarray, sp: SamplingParams) -> int:
+    @staticmethod
+    def _host_filter(x: np.ndarray, sp: SamplingParams) -> np.ndarray:
+        """Numpy mirror of filter_top_k_top_p with the same clamps as
+        the device program: top_k clamped into [1, V], top_p <= 0 keeps
+        (at least) the crossing token, so no user value can crash."""
+        V = len(x)
+        if sp.top_k and sp.top_k > 0:
+            k = min(max(int(sp.top_k), 1), V)
+            kth = np.partition(x, V - k)[V - k]
+            x = np.where(x >= kth, x, -np.inf)
+        if sp.top_p < 1.0:
+            order = np.argsort(-x)
+            px = np.exp(x[order] - x[order[0]])
+            px = px / px.sum()
+            cum = np.cumsum(px)
+            keep_sorted = (cum - px) < sp.top_p
+            keep_sorted[0] = True  # the crossing token is always kept
+            cutoff = x[order[np.nonzero(keep_sorted)[0][-1]]]
+            x = np.where(x >= cutoff, x, -np.inf)
+        return x
+
+    def _sample_host(self, logits: np.ndarray, slot: int, req: Request) -> int:
+        """First-token sampling (host side, numpy): same pipeline as the
+        device program — penalties -> temperature -> top_k/top_p ->
+        sample — seeded from (seed, step=0) for determinism. Later
+        tokens come from the in-decode or advanced_sample programs."""
+        sp = req.params
+        logits = logits.astype(np.float64)
+        if sp.repetition_penalty != 1.0:
+            seen = np.unique(np.asarray(req.prompt_tokens, np.int64))
+            vals = logits[seen]
+            logits[seen] = np.where(vals > 0,
+                                    vals / sp.repetition_penalty,
+                                    vals * sp.repetition_penalty)
+        # presence/frequency apply to GENERATED tokens only — none yet.
         if sp.temperature <= 0.0:
-            return int(logits.argmax())
-        self._rng, key = jax.random.split(self._rng)
-        return int(jax.random.categorical(key, jnp.asarray(logits) / sp.temperature))
+            tok = int(logits.argmax())
+            dist = logits
+        else:
+            dist = self._host_filter(logits / max(sp.temperature, 1e-6), sp)
+            p = np.exp(dist - dist.max())
+            p = p / p.sum()
+            rng = np.random.default_rng(int(np.uint32(self.seeds[slot])))
+            tok = int(rng.choice(len(p), p=p))
+        if req.logprobs is not None:
+            # Same distribution the device program reports: the final
+            # processed one (penalized for greedy rows, penalized+
+            # temperature+filtered for sampled rows).
+            req.logprobs.append(self._host_logprob_entry(dist, sp, tok))
+        return tok
+
+    @staticmethod
+    def _host_logprob_entry(dist: np.ndarray, sp: SamplingParams,
+                            tok: int) -> dict:
+        """Logprob record over the final processed distribution."""
+        logp = dist - np.logaddexp.reduce(dist[np.isfinite(dist)])
+        n = min(sp.logprobs, len(logp))
+        top_idx = np.argpartition(-logp, n - 1)[:n] if n > 0 else []
+        return {"token_id": tok, "logprob": float(logp[tok]),
+                "top": {int(i): float(logp[i])
+                        for i in sorted(top_idx, key=lambda i: -logp[i])}}
 
     def _stop_ids(self, sp: SamplingParams) -> set[int]:
         stop = set(sp.stop_token_ids)
@@ -385,22 +497,47 @@ class LLMEngine:
         req = self.slots[slot]
         pos = int(self.positions[slot])
         reason = None
+        text = None
         if req.generated and req.generated[-1] in self._stop_ids(req.params):
             req.generated.pop()  # don't surface the stop token
+            if req.logprobs:
+                req.logprobs = req.logprobs[: len(req.generated)]
             reason = "stop"
-        elif len(req.generated) >= req.params.max_tokens:
-            reason = "length"
-        elif pos >= self.max_len - 1:
-            reason = "length"  # KV cache exhausted
+        elif req.params.stop:
+            # Stop STRINGS (vLLM `stop`): end at the first occurrence,
+            # trimming the match (and anything after) from the text.
+            # Cheap per-token check: decode only a TAIL window (stop
+            # strings are short; earlier occurrences were checked on
+            # earlier tokens), sized so a match spanning the boundary
+            # can't be missed; on a hit, decode once in full to find the
+            # exact cut position.
+            max_chars = max(len(s) for s in req.params.stop)
+            window = min(len(req.generated), 16 + 2 * max_chars)
+            tail = self.tokenizer.decode(req.generated[-window:])
+            if any(s in tail for s in req.params.stop):
+                decoded = self.tokenizer.decode(req.generated)
+                cut = min((i for i in
+                           (decoded.find(s) for s in req.params.stop)
+                           if i >= 0), default=-1)
+                if cut >= 0:
+                    text = decoded[:cut]
+                    reason = "stop"
+        if reason is None:
+            if len(req.generated) >= req.params.max_tokens:
+                reason = "length"
+            elif pos >= self.max_len - 1:
+                reason = "length"  # KV cache exhausted
         if reason is not None:
             req.finished = True
             req.finish_reason = reason
             outputs.append(RequestOutput(
                 request_id=req.request_id,
                 token_ids=list(req.generated),
-                text=self.tokenizer.decode(req.generated),
+                text=(text if text is not None
+                      else self.tokenizer.decode(req.generated)),
                 finish_reason=reason,
                 num_prompt_tokens=len(req.prompt_tokens),
+                logprobs=req.logprobs,
             ))
             self.slots[slot] = None
 
@@ -414,8 +551,7 @@ class LLMEngine:
         active = [i for i, s in enumerate(self.slots) if s is not None]
         if not active:
             return outputs
-        if (self.draft is not None
-                and all(self.temps[s] <= 0.0 for s in active)):
+        if self.draft is not None and all(self._spec_ok[s] for s in active):
             return self._spec_step(active, outputs)
         if self.draft is not None:
             self.spec_stats["fallback_steps"] += 1
@@ -430,7 +566,7 @@ class LLMEngine:
             # all-sampled batch skips the draft pass entirely instead of
             # paying a full extra forward per token for rows nobody will
             # read.
-            if any(self.temps[s] <= 0.0 for s in active):
+            if any(self._spec_ok[s] for s in active):
                 self._rng, dkey = jax.random.split(self._rng)
                 _, _, self.draft["cache"] = model_runner.decode(
                     self.draft["params"], jnp.asarray(self.last_tokens),
@@ -438,7 +574,7 @@ class LLMEngine:
                     jnp.asarray(self.temps), dkey,
                     config=self.draft["config"])
         self._rng, key = jax.random.split(self._rng)
-        toks, _logits, self.cache = self._mr.decode(
+        toks, logits, self.cache = self._mr.decode(
             self.params,
             jnp.asarray(self.last_tokens),
             jnp.asarray(self.positions),
@@ -447,6 +583,29 @@ class LLMEngine:
             key,
             config=self.model_config,
         )
+        lp_info = None
+        if not all(self._plain[s] for s in active):
+            # Extended sampling program over this step's logits: replaces
+            # the in-decode choice for the whole batch (plain slots get
+            # identical semantics — penalties off, filters open).
+            want_lp = any(self.slots[s] is not None
+                          and self.slots[s].params.logprobs > 0
+                          for s in active)
+            steps = np.asarray([len(self.slots[s].generated)
+                                if self.slots[s] is not None else 0
+                                for s in range(len(self.slots))], np.int32)
+            toks, chosen_lp, top_vals, top_ids, self._counts = (
+                model_runner.advanced_sample(
+                    logits, jnp.asarray(self.temps),
+                    jnp.asarray(self.top_ks), jnp.asarray(self.top_ps),
+                    jnp.asarray(self.pres_pens), jnp.asarray(self.freq_pens),
+                    jnp.asarray(self.rep_pens), self._counts,
+                    self._prompt_mask, jnp.asarray(self.seeds),
+                    jnp.asarray(steps),
+                    max_logprobs=MAX_LOGPROBS if want_lp else 0))
+            if want_lp:
+                lp_info = (np.asarray(chosen_lp), np.asarray(top_vals),
+                           np.asarray(top_ids))
         toks = np.asarray(toks)
         # Only active slots advance; inactive slots' writes land at their
         # stale position and are reclaimed by the next prefill's mask.
@@ -457,6 +616,15 @@ class LLMEngine:
             tok = int(toks[slot])
             self.last_tokens[slot] = tok
             req.generated.append(tok)
+            if req.logprobs is not None and lp_info is not None:
+                chosen_lp, top_vals, top_ids = lp_info
+                n = req.params.logprobs
+                req.logprobs.append({
+                    "token_id": tok, "logprob": float(chosen_lp[slot]),
+                    "top": {int(i): float(v)
+                            for i, v in zip(top_ids[slot][:n],
+                                            top_vals[slot][:n])},
+                })
             self._maybe_finish(slot, outputs)
         return outputs
 
@@ -529,9 +697,11 @@ class LLMEngine:
     # -- convenience batch API --------------------------------------------
 
     def generate(self, prompts: Iterable[str | list[int]],
-                 sampling_params: SamplingParams | None = None,
+                 sampling_params: "SamplingParams | list[SamplingParams] | None" = None,
                  ) -> list[RequestOutput]:
-        """Run a batch of prompts to completion. Thread-safe: concurrent
+        """Run a batch of prompts to completion. ``sampling_params`` may
+        be one SamplingParams for the whole batch or a list (one per
+        prompt — vLLM generate() parity). Thread-safe: concurrent
         callers (threaded serving replicas) are serialized on the engine
         lock, and request ids are unique per call so interleaved batches
         can never swap outputs."""
@@ -550,9 +720,17 @@ class LLMEngine:
             for i, toks in enumerate(toks_list):
                 if not toks:
                     raise ValueError(f"prompt {i} of this batch is empty")
+            if isinstance(sampling_params, (list, tuple)):
+                if len(sampling_params) != len(toks_list):
+                    raise ValueError(
+                        f"sampling_params list ({len(sampling_params)}) must "
+                        f"match prompts ({len(toks_list)})")
+                sp_list = list(sampling_params)
+            else:
+                sp_list = [sampling_params] * len(toks_list)
             order = [f"req-{tag}-{i}" for i in range(len(toks_list))]
-            for rid, toks in zip(order, toks_list):
-                self.add_request(rid, toks, sampling_params)
+            for rid, toks, sp in zip(order, toks_list, sp_list):
+                self.add_request(rid, toks, sp)
             mine = set(order)
             done: dict[str, RequestOutput] = {}
             # Step until THIS call's requests finish. Other requests

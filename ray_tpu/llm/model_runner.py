@@ -156,6 +156,114 @@ def sample_tokens(logits, temperature, rng):
     return jnp.where(temperature <= 0.0, greedy, sampled)
 
 
+def penalize_logits(logits, counts, prompt_mask, presence, frequency,
+                    repetition):
+    """Apply OpenAI presence/frequency penalties (generated tokens) and
+    the HF repetition penalty (prompt + generated) to logits [B, V].
+
+    counts [B, V] int32 — per-slot generated-token histogram;
+    prompt_mask [B, V] bool — token appeared in the prompt.
+    """
+    seen_gen = counts > 0
+    seen_any = seen_gen | prompt_mask
+    rep = repetition[:, None]
+    logits = jnp.where(
+        seen_any, jnp.where(logits > 0, logits / rep, logits * rep), logits)
+    logits = logits - presence[:, None] * seen_gen.astype(logits.dtype)
+    logits = logits - frequency[:, None] * counts.astype(logits.dtype)
+    return logits
+
+
+def filter_top_k_top_p(logits, top_k, top_p):
+    """Mask logits outside the per-row top-k / nucleus-p sets to -inf.
+
+    top_k [B] int32 (<= 0 disables); top_p [B] float32 (1.0 disables).
+    Ties at the top-k threshold keep every tied token (vLLM keeps
+    exactly k; the sampled distribution differs only on exact ties).
+    """
+    B, V = logits.shape
+    sorted_desc = -jnp.sort(-logits, axis=-1)  # [B, V] descending
+    # top-k threshold: the k-th largest value (k clamped into [1, V]).
+    k = jnp.clip(jnp.where(top_k <= 0, V, top_k), 1, V)
+    kth = jnp.take_along_axis(sorted_desc, (k - 1)[:, None], axis=-1)
+    keep = logits >= kth
+    # nucleus: keep the smallest prefix of the sorted distribution whose
+    # cumulative probability reaches top_p (the crossing token is kept).
+    probs_sorted = jax.nn.softmax(sorted_desc, axis=-1)
+    cum = jnp.cumsum(probs_sorted, axis=-1)
+    in_nucleus_sorted = (cum - probs_sorted) < top_p[:, None]
+    # Threshold value = smallest sorted logit still inside the nucleus.
+    big = jnp.where(in_nucleus_sorted, sorted_desc, jnp.inf)
+    p_thresh = jnp.min(big, axis=-1, keepdims=True)
+    keep = keep & (logits >= p_thresh)
+    return jnp.where(keep, logits, _NEG_INF_SAMPLE)
+
+
+_NEG_INF_SAMPLE = -1e30
+
+
+@partial(jax.jit, static_argnames=("max_logprobs",),
+         donate_argnames=("counts",))
+def advanced_sample(logits, temps, top_ks, top_ps, presence, frequency,
+                    repetition, counts, prompt_mask, seeds, steps,
+                    *, max_logprobs: int = 0):
+    """Extended sampling program (vLLM SamplingParams parity), run on
+    the logits the decode step returns when any active slot needs more
+    than greedy/temperature.
+
+    Order (vLLM): penalties -> temperature -> top_k/top_p -> sample.
+    Per-slot determinism: key_b = fold_in(PRNGKey(seed_b), step_b), so a
+    request's sample stream is independent of batch composition.
+
+    Returns (tokens [B] i32, chosen_logprob [B] f32, top_vals [B, N],
+    top_ids [B, N] (N = max_logprobs; empty when 0), counts') where
+    counts' includes the sampled token.
+    """
+    B, V = logits.shape
+    pen = penalize_logits(logits, counts, prompt_mask, presence, frequency,
+                          repetition)
+    greedy = pen.argmax(-1).astype(jnp.int32)
+    scaled = pen / jnp.clip(temps, 1e-6, None)[:, None]
+    filtered = filter_top_k_top_p(scaled, top_ks, top_ps)
+
+    def one_key(seed, step):
+        return jax.random.fold_in(jax.random.PRNGKey(seed), step)
+
+    keys = jax.vmap(one_key)(seeds, steps)
+    sampled = jax.vmap(jax.random.categorical)(keys, filtered).astype(
+        jnp.int32)
+    toks = jnp.where(temps <= 0.0, greedy, sampled)
+    # Logprobs over the distribution actually sampled from (greedy rows
+    # report over the penalized+filtered distribution too — vLLM
+    # reports from the final processed distribution).
+    dist = jnp.where(temps[:, None] <= 0.0, pen, filtered)
+    logp = jax.nn.log_softmax(dist, axis=-1)
+    chosen_lp = jnp.take_along_axis(logp, toks[:, None], axis=-1)[:, 0]
+    if max_logprobs > 0:
+        top_vals, top_ids = jax.lax.top_k(logp, max_logprobs)
+    else:
+        top_vals = jnp.zeros((B, 0), jnp.float32)
+        top_ids = jnp.zeros((B, 0), jnp.int32)
+    onehot = (jax.lax.broadcasted_iota(jnp.int32, (B, V), 1)
+              == toks[:, None])
+    counts = counts + onehot.astype(counts.dtype)
+    return toks, chosen_lp, top_vals, top_ids.astype(jnp.int32), counts
+
+
+@partial(jax.jit, donate_argnames=("counts", "prompt_mask"))
+def reset_slot_sampling(counts, prompt_mask, slot, prompt_hist, first_tok):
+    """Re-initialize one slot's penalty state at admit time: generated
+    counts = just the first sampled token; prompt_mask = the prompt's
+    token set."""
+    V = counts.shape[1]
+    row = (jax.lax.broadcasted_iota(jnp.int32, (V,), 0)
+           == first_tok).astype(counts.dtype)
+    counts = jax.lax.dynamic_update_slice(counts, row[None], (slot, 0))
+    prompt_mask = jax.lax.dynamic_update_slice(
+        prompt_mask, prompt_hist[None].astype(prompt_mask.dtype), (slot, 0))
+    return counts, prompt_mask
+
+
 @partial(jax.jit, static_argnames=("config",), donate_argnames=("cache",))
 def prefill(params, tokens, true_len, slot, cache, *, config: TransformerConfig):
     """Run one padded prompt [1, S] and write K/V into cache slot.

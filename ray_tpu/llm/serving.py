@@ -59,16 +59,40 @@ class LLMServer:
     def _sampling(self, payload: dict) -> SamplingParams:
         d = self.config.sampling_defaults
         stop_ids = tuple(payload.get("stop_token_ids", d.stop_token_ids))
-        # OpenAI "stop" strings: supported for stops that tokenize to a
-        # single id (the engine stops on token ids, not substrings).
+        # OpenAI "stop" strings: single-token stops detect on the id
+        # (cheap, no detokenization); multi-token stops go through the
+        # engine's stop-string matcher.
+        stop_strings: tuple[str, ...] = tuple(d.stop)
         for s in _as_list(payload.get("stop")):
             toks = _encode_plain(self.engine.tokenizer, s)
             if len(toks) == 1:
                 stop_ids += (toks[0],)
+            else:
+                stop_strings += (s,)
+        # OpenAI: logprobs (bool) + top_logprobs (int); vLLM: logprobs=N.
+        # Clamped to the engine cap (OpenAI itself caps top_logprobs at 20).
+        from ray_tpu.llm.engine import MAX_LOGPROBS
+
+        lp = payload.get("logprobs", d.logprobs)
+        if isinstance(lp, bool):
+            lp = int(payload.get("top_logprobs", 1)) if lp else 0
+        lp = min(int(lp or 0), MAX_LOGPROBS)
+        seed = payload.get("seed", d.seed)
         return SamplingParams(
             max_tokens=int(payload.get("max_tokens", d.max_tokens)),
             temperature=float(payload.get("temperature", d.temperature)),
+            top_k=int(payload.get("top_k", d.top_k)),
+            top_p=float(payload.get("top_p", d.top_p)),
+            presence_penalty=float(payload.get("presence_penalty",
+                                               d.presence_penalty)),
+            frequency_penalty=float(payload.get("frequency_penalty",
+                                                d.frequency_penalty)),
+            repetition_penalty=float(payload.get("repetition_penalty",
+                                                 d.repetition_penalty)),
+            seed=(int(seed) if seed is not None else None),
+            logprobs=int(lp or 0),
             stop_token_ids=stop_ids,
+            stop=stop_strings,
         )
 
     def _render_chat(self, messages: list[dict]) -> str:
@@ -128,10 +152,25 @@ class LLMServer:
             "created": int(time.time()),
             "model": self.config.model_id,
             "choices": [
-                {"index": i, "text": o.text, "finish_reason": o.finish_reason}
+                {"index": i, "text": o.text, "finish_reason": o.finish_reason,
+                 **({"logprobs": self._openai_logprobs(o)}
+                    if o.logprobs is not None else {})}
                 for i, o in enumerate(outs)
             ],
             "usage": self._usage(outs),
+        }
+
+    def _openai_logprobs(self, out) -> dict:
+        """OpenAI text-completions logprobs block from the engine's
+        per-token records."""
+        tok = self.engine.tokenizer
+        return {
+            "tokens": [tok.decode([e["token_id"]]) for e in out.logprobs],
+            "token_logprobs": [e["logprob"] for e in out.logprobs],
+            "top_logprobs": [
+                {tok.decode([i]): v for i, v in e["top"].items()}
+                for e in out.logprobs
+            ],
         }
 
     async def chat(self, payload: dict) -> dict:
@@ -146,6 +185,14 @@ class LLMServer:
                 "index": 0,
                 "message": {"role": "assistant", "content": out.text},
                 "finish_reason": out.finish_reason,
+                **({"logprobs": {"content": [
+                    {"token": self.engine.tokenizer.decode([e["token_id"]]),
+                     "logprob": e["logprob"],
+                     "top_logprobs": [
+                         {"token": self.engine.tokenizer.decode([i]),
+                          "logprob": v} for i, v in e["top"].items()]}
+                    for e in out.logprobs]}}
+                   if out.logprobs is not None else {}),
             }],
             "usage": self._usage([out]),
         }

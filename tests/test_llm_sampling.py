@@ -1,0 +1,255 @@
+"""Extended sampling parity (reference: vLLM SamplingParams — top_k/top_p,
+presence/frequency/repetition penalties, per-request seed, logprobs, stop
+strings). Device program: ray_tpu/llm/model_runner.py advanced_sample."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.llm import LLMConfig, LLMEngine, SamplingParams
+from ray_tpu.llm import model_runner
+from ray_tpu.models import transformer as tfm
+
+
+def tiny_config(**kw):
+    defaults = dict(
+        model=tfm.tiny(vocab_size=512, max_seq_len=128),
+        max_num_seqs=4,
+        max_seq_len=64,
+        prefill_buckets=(8, 16, 32),
+        sampling_defaults=SamplingParams(max_tokens=8),
+    )
+    defaults.update(kw)
+    return LLMConfig(**defaults)
+
+
+# -- device program unit tests ------------------------------------------
+
+
+def _run_advanced(logits, *, temps=None, top_ks=None, top_ps=None,
+                  pres=None, freq=None, rep=None, counts=None,
+                  prompt_mask=None, seeds=None, steps=None, max_logprobs=0):
+    B, V = logits.shape
+    z = lambda v, d: jnp.asarray(v if v is not None else d)  # noqa: E731
+    return model_runner.advanced_sample(
+        jnp.asarray(logits, jnp.float32),
+        z(temps, np.zeros(B, np.float32)),
+        z(top_ks, np.zeros(B, np.int32)),
+        z(top_ps, np.ones(B, np.float32)),
+        z(pres, np.zeros(B, np.float32)),
+        z(freq, np.zeros(B, np.float32)),
+        z(rep, np.ones(B, np.float32)),
+        z(counts, np.zeros((B, V), np.int32)),
+        z(prompt_mask, np.zeros((B, V), bool)),
+        z(seeds, np.arange(B, dtype=np.int32)),
+        z(steps, np.zeros(B, np.int32)),
+        max_logprobs=max_logprobs,
+    )
+
+
+def test_advanced_greedy_matches_argmax():
+    logits = np.random.default_rng(0).normal(size=(3, 64)).astype(np.float32)
+    toks, lp, _, _, _ = _run_advanced(logits)
+    assert np.array_equal(np.asarray(toks), logits.argmax(-1))
+    # chosen logprob equals log-softmax at the argmax
+    ref = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+    assert np.allclose(np.asarray(lp), ref[np.arange(3), logits.argmax(-1)],
+                       atol=1e-5)
+
+
+def test_top_k_restricts_support():
+    rng = np.random.default_rng(1)
+    logits = rng.normal(size=(2, 64)).astype(np.float32)
+    allowed = np.argsort(-logits, axis=-1)[:, :5]
+    for step in range(20):
+        toks, _, _, _, _ = _run_advanced(
+            logits, temps=np.full(2, 1.5, np.float32),
+            top_ks=np.full(2, 5, np.int32),
+            steps=np.full(2, step, np.int32))
+        for b in range(2):
+            assert int(toks[b]) in allowed[b]
+
+
+def test_top_p_restricts_support():
+    # One dominant token (p > 0.9) -> top_p=0.5 must always pick it.
+    logits = np.full((1, 32), -4.0, np.float32)
+    logits[0, 7] = 6.0
+    for step in range(10):
+        toks, _, _, _, _ = _run_advanced(
+            logits, temps=np.ones(1, np.float32),
+            top_ps=np.full(1, 0.5, np.float32),
+            steps=np.full(1, step, np.int32))
+        assert int(toks[0]) == 7
+
+
+def test_penalties_shift_distribution():
+    logits = np.ones((1, 16), np.float32)
+    logits[0, 3] = 2.0
+    counts = np.zeros((1, 16), np.int32)
+    counts[0, 3] = 4
+    # Strong frequency penalty pushes token 3 below the rest (greedy).
+    toks, _, _, _, _ = _run_advanced(
+        logits, counts=counts, freq=np.full(1, 1.0, np.float32))
+    assert int(toks[0]) != 3
+    # Repetition penalty: prompt tokens are damped too.
+    pm = np.zeros((1, 16), bool)
+    pm[0, 3] = True
+    toks, _, _, _, _ = _run_advanced(
+        logits, prompt_mask=pm, rep=np.full(1, 10.0, np.float32))
+    assert int(toks[0]) != 3
+    # numpy cross-check of the penalized logits themselves
+    pen = np.asarray(model_runner.penalize_logits(
+        jnp.asarray(logits), jnp.asarray(counts), jnp.asarray(pm),
+        jnp.asarray(np.full(1, 0.5, np.float32)),
+        jnp.asarray(np.full(1, 0.25, np.float32)),
+        jnp.asarray(np.full(1, 2.0, np.float32))))
+    exp = logits.copy()
+    exp[0, 3] = exp[0, 3] / 2.0        # repetition (seen via counts+prompt)
+    exp[0, 3] -= 0.5                   # presence (counts > 0)
+    exp[0, 3] -= 0.25 * 4              # frequency * count
+    assert np.allclose(pen, exp, atol=1e-6)
+
+
+def test_counts_updated_with_sampled_token():
+    logits = np.ones((2, 8), np.float32)
+    logits[:, 5] = 3.0
+    toks, _, _, _, counts = _run_advanced(logits)
+    counts = np.asarray(counts)
+    for b in range(2):
+        assert counts[b, int(toks[b])] == 1
+        assert counts.sum() == 2
+
+
+def test_logprobs_topk():
+    logits = np.random.default_rng(3).normal(size=(1, 32)).astype(np.float32)
+    _, lp, vals, ids, _ = _run_advanced(logits, max_logprobs=4)
+    ref = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+    order = np.argsort(-ref[0])[:4]
+    assert np.array_equal(np.asarray(ids)[0], order)
+    assert np.allclose(np.asarray(vals)[0], ref[0][order], atol=1e-5)
+
+
+def test_seeded_sampling_deterministic():
+    logits = np.random.default_rng(4).normal(size=(1, 64)).astype(np.float32)
+    a = _run_advanced(logits, temps=np.ones(1, np.float32),
+                      seeds=np.full(1, 42, np.int32),
+                      steps=np.full(1, 3, np.int32))[0]
+    b = _run_advanced(logits, temps=np.ones(1, np.float32),
+                      seeds=np.full(1, 42, np.int32),
+                      steps=np.full(1, 3, np.int32))[0]
+    c = _run_advanced(logits, temps=np.ones(1, np.float32),
+                      seeds=np.full(1, 43, np.int32),
+                      steps=np.full(1, 3, np.int32))[0]
+    assert int(a[0]) == int(b[0])
+    # different seed gives an independent stream (not necessarily a
+    # different token for one draw; check over several steps)
+    diff = any(
+        int(_run_advanced(logits, temps=np.ones(1, np.float32),
+                          seeds=np.full(1, 42, np.int32),
+                          steps=np.full(1, s, np.int32))[0][0])
+        != int(_run_advanced(logits, temps=np.ones(1, np.float32),
+                             seeds=np.full(1, 43, np.int32),
+                             steps=np.full(1, s, np.int32))[0][0])
+        for s in range(8))
+    assert diff or int(a[0]) != int(c[0])
+
+
+# -- engine-level tests -------------------------------------------------
+
+
+def test_engine_seed_reproducible():
+    cfg = tiny_config()
+    eng = LLMEngine(cfg)
+    sp = SamplingParams(max_tokens=8, temperature=1.0, seed=7)
+    a = eng.generate(["hello world"], sp)[0]
+    b = eng.generate(["hello world"], sp)[0]
+    assert a.token_ids == b.token_ids
+
+
+def test_engine_logprobs_roundtrip():
+    cfg = tiny_config()
+    eng = LLMEngine(cfg)
+    sp = SamplingParams(max_tokens=5, logprobs=3)
+    out = eng.generate(["hi"], sp)[0]
+    assert out.logprobs is not None
+    assert len(out.logprobs) == len(out.token_ids)
+    for e in out.logprobs:
+        assert e["token_id"] in (out.token_ids)
+        assert len(e["top"]) <= 3
+        assert e["logprob"] <= 0.0 + 1e-6
+
+
+def test_engine_repetition_penalty_reduces_repeats():
+    """With an untrained tiny model greedy decode tends to loop; a heavy
+    repetition penalty must strictly reduce repeat fraction."""
+    cfg = tiny_config()
+    eng = LLMEngine(cfg)
+
+    def repeat_frac(toks):
+        return 0.0 if len(toks) <= 1 else 1 - len(set(toks)) / len(toks)
+
+    plain = eng.generate(["abcabc"], SamplingParams(max_tokens=16))[0]
+    pen = eng.generate(
+        ["abcabc"],
+        SamplingParams(max_tokens=16, repetition_penalty=5.0,
+                       presence_penalty=2.0, frequency_penalty=2.0))[0]
+    assert repeat_frac(pen.token_ids) <= repeat_frac(plain.token_ids)
+    # and with penalties OFF the output matches plain greedy exactly
+    # (advanced path with neutral knobs = fast path)
+    plain2 = eng.generate(
+        ["abcabc"], SamplingParams(max_tokens=16, seed=1))[0]
+    assert plain2.token_ids == plain.token_ids
+
+
+def test_engine_stop_strings():
+    cfg = tiny_config()
+    eng = LLMEngine(cfg)
+    ref = eng.generate(["q"], SamplingParams(max_tokens=12))[0]
+    if len(ref.text) < 3:
+        pytest.skip("tiny model emitted too little text to split")
+    stop = ref.text[1:3]
+    out = eng.generate(
+        ["q"], SamplingParams(max_tokens=12, stop=(stop,)))[0]
+    assert stop not in out.text
+    assert out.finish_reason == "stop"
+    assert ref.text.startswith(out.text)
+
+
+def test_extreme_user_values_do_not_crash():
+    """top_p=0, top_k > vocab: the host first-token sampler must clamp
+    like the device program instead of crashing (review regression)."""
+    cfg = tiny_config()
+    eng = LLMEngine(cfg)
+    out = eng.generate(
+        ["x"], SamplingParams(max_tokens=3, temperature=1.0, top_p=0.0,
+                              seed=1))[0]
+    assert len(out.token_ids) >= 1
+    out = eng.generate(
+        ["x"], SamplingParams(max_tokens=3, temperature=1.0,
+                              top_k=10_000_000, seed=1))[0]
+    assert len(out.token_ids) >= 1
+
+
+def test_logprobs_above_cap_rejected():
+    from ray_tpu.llm.engine import MAX_LOGPROBS
+
+    cfg = tiny_config()
+    eng = LLMEngine(cfg)
+    with pytest.raises(ValueError, match="logprobs"):
+        eng.generate(["x"], SamplingParams(max_tokens=2,
+                                           logprobs=MAX_LOGPROBS + 1))
+
+
+def test_mixed_batch_plain_and_advanced():
+    """Plain-greedy requests must produce identical output whether or
+    not an advanced request shares their batch."""
+    cfg = tiny_config()
+    eng = LLMEngine(cfg)
+    plain_sp = SamplingParams(max_tokens=8)
+    solo = eng.generate(["determinism"], plain_sp)[0]
+    mixed = eng.generate(
+        ["determinism", "other prompt"],
+        [plain_sp, SamplingParams(max_tokens=8, temperature=1.0, top_k=4,
+                                  repetition_penalty=2.0, seed=5)])[0]
+    assert solo.token_ids == mixed.token_ids
