@@ -786,22 +786,27 @@ class Head:
         return {}
 
     def _h_put_inline(self, body: dict, conn):
-        object_id = body["object_id"]
         with self.lock:
-            entry = self.objects.get(object_id) or ObjectEntry(object_id, body["owner_id"])
-            entry.inline = body["payload"]
-            entry.size = len(entry.inline)
-            entry.state = SEALED
-            entry.is_error = body.get("is_error", False)
-            if entry.refcount == 0:
-                entry.refcount = 1
-            self._register_contained(entry, body.get("contained_ids"))
-            self._lru_tick += 1
-            entry.lru = self._lru_tick
-            self.objects[object_id] = entry
-            self._on_sealed(object_id)
+            self._seal_inline_locked(body)
         self.dispatch_event.set()
         return {}
+
+    def _seal_inline_locked(self, body: dict) -> None:
+        """lock held. Seal one inline object (put_inline call or a
+        result piggybacked on task_finished)."""
+        object_id = body["object_id"]
+        entry = self.objects.get(object_id) or ObjectEntry(object_id, body["owner_id"])
+        entry.inline = body["payload"]
+        entry.size = len(entry.inline)
+        entry.state = SEALED
+        entry.is_error = body.get("is_error", False)
+        if entry.refcount == 0:
+            entry.refcount = 1
+        self._register_contained(entry, body.get("contained_ids"))
+        self._lru_tick += 1
+        entry.lru = self._lru_tick
+        self.objects[object_id] = entry
+        self._on_sealed(object_id)
 
     def _on_sealed(self, object_id: str) -> None:
         """Resolve get/wait waiters; wake dependency-blocked tasks. lock held."""
@@ -1297,12 +1302,21 @@ class Head:
     def _h_task_finished(self, body, conn):
         worker_id = body["worker_id"]
         with self.lock:
-            # Piggybacked profile events (one cast per task instead of
-            # two — the completion path is the control plane's hottest).
+            # Piggybacked inline RESULTS (sealed before the completion
+            # bookkeeping below, same order the split put_inline +
+            # task_finished messages guaranteed) and profile events —
+            # one cast per task carries everything, replacing a blocking
+            # put_inline round trip on the control plane's hottest path.
+            for rbody in body.get("results") or ():
+                self._seal_inline_locked(rbody)
             if body.get("events"):
                 self.task_events.extend(body["events"])
             rec = self.workers.get(worker_id)
             if rec is None:
+                # Worker record already reaped (death raced the final
+                # cast) — but the seals above may have readied
+                # dep-blocked tasks, so the dispatcher must still wake.
+                self.dispatch_event.set()
                 return None
             spec = rec.inflight.pop(body.get("task_id", ""), None)
             if spec is not None:

@@ -418,23 +418,37 @@ class CoreRuntime:
             header, buffers = serialization.serialize(value)
         contained = sorted(set(collected))
         size = serialization.serialized_size(header, buffers)
+        self._store_serialized(object_id, header, buffers, size, contained,
+                               _is_error)
+        return ObjectRef(object_id, _owned=_object_id is None)
+
+    def _inline_body(self, object_id, header, buffers, size, contained,
+                     is_error) -> dict:
+        payload = bytearray(size)
+        serialization.write_to(memoryview(payload), header, buffers)
+        return {
+            "object_id": object_id,
+            "payload": bytes(payload),
+            "owner_id": self.client_id,
+            "is_error": is_error,
+            "contained_ids": contained,
+        }
+
+    def _store_serialized(self, object_id, header, buffers, size, contained,
+                          _is_error) -> None:
+        """Store an already-serialized value: p2p arena, inline call, or
+        shm create/seal — the storage decision shared by put() and the
+        deferred task-result path."""
         if (self.shm is None and self.agent_shm is not None
                 and size > GLOBAL_CONFIG.max_inline_object_size):
             if self._put_p2p(object_id, header, buffers, size, _is_error,
                              contained):
-                return ObjectRef(object_id, _owned=_object_id is None)
+                return
         if self.shm is None or size <= GLOBAL_CONFIG.max_inline_object_size:
-            payload = bytearray(size)
-            serialization.write_to(memoryview(payload), header, buffers)
             self.conn.call(
                 "put_inline",
-                {
-                    "object_id": object_id,
-                    "payload": bytes(payload),
-                    "owner_id": self.client_id,
-                    "is_error": _is_error,
-                    "contained_ids": contained,
-                },
+                self._inline_body(object_id, header, buffers, size,
+                                  contained, _is_error),
             )
         else:
             try:
@@ -457,7 +471,25 @@ class CoreRuntime:
             self.conn.call("seal_object",
                            {"object_id": object_id, "is_error": _is_error,
                             "contained_ids": contained})
-        return ObjectRef(object_id, _owned=_object_id is None)
+
+    def put_deferred(self, value: Any, object_id: str,
+                     is_error: bool = False) -> "dict | None":
+        """Inline-store body for piggybacking on the task_finished cast
+        (the completion path is the control plane's hottest message:
+        result + completion in ONE cast replaces a blocking put_inline
+        round trip per task). Values too big to inline are stored
+        through the normal path HERE (serialized exactly once) and None
+        is returned."""
+        with serialization.collect_refs() as collected:
+            header, buffers = serialization.serialize(value)
+        contained = sorted(set(collected))
+        size = serialization.serialized_size(header, buffers)
+        if size > GLOBAL_CONFIG.max_inline_object_size:
+            self._store_serialized(object_id, header, buffers, size,
+                                   contained, is_error)
+            return None
+        return self._inline_body(object_id, header, buffers, size, contained,
+                                 is_error)
 
     def get(self, refs: ObjectRef | Sequence[ObjectRef], timeout: float | None = None) -> Any:
         single = isinstance(refs, ObjectRef)

@@ -347,7 +347,11 @@ class Worker:
             self.runtime.conn.cast(
                 "task_finished",
                 {"worker_id": self.worker_id, "task_id": spec.task_id,
-                 "failed": True},
+                 "failed": True,
+                 # The error objects may have been deferred into the
+                 # spec buffer by _store_error — without carrying them
+                 # here the caller's get would hang forever.
+                 "results": getattr(spec, "_deferred_results", None) or []},
             )
         except Exception:
             pass
@@ -357,6 +361,7 @@ class Worker:
 
         start = time.time()
         failed = False
+        spec._deferred_results = []
         sem = self.async_exec.semaphore(self._task_group(spec))
         async with sem:
             try:
@@ -378,6 +383,7 @@ class Worker:
                 "task_finished",
                 {"worker_id": self.worker_id, "task_id": spec.task_id,
                  "failed": failed,
+                 "results": spec._deferred_results,
                  "events": [{
                      "task_id": spec.task_id, "name": spec.name,
                      "worker_id": self.worker_id, "node_id": self.node_id,
@@ -463,6 +469,7 @@ class Worker:
 
         failed = False
         start = time.time()
+        spec._deferred_results = []
         try:
             if spec.task_id in self._cancelled_ids:
                 self._cancelled_ids.discard(spec.task_id)
@@ -492,6 +499,7 @@ class Worker:
                         "worker_id": self.worker_id,
                         "task_id": spec.task_id,
                         "failed": failed,
+                        "results": spec._deferred_results,
                         "events": [
                             {
                                 "task_id": spec.task_id,
@@ -559,7 +567,7 @@ class Worker:
                 cls = self.runtime.get_function(spec.func_id)
                 self.actor_instance = cls(*args, **kwargs)
                 self._setup_actor_executor()
-                self.runtime.put("ok", _object_id=spec.return_ids[0])
+                self._put_result(spec, "ok", spec.return_ids[0])
                 return True
             if spec.actor_id is not None:
                 if spec.method_name == "__rtpu_dag_loop__":
@@ -605,10 +613,24 @@ class Worker:
         return ([self._resolve(a) for a in args],
                 {k: self._resolve(v) for k, v in kwargs.items()})
 
+    def _put_result(self, spec: TaskSpec, value, oid: str,
+                    is_error: bool = False) -> None:
+        """Store one task return: deferred into the task_finished cast
+        when small (one message carries results + completion; reference
+        rationale: task_event_buffer.h batching on the hottest path),
+        normal put() otherwise (shm/p2p objects need registration)."""
+        buf = getattr(spec, "_deferred_results", None)
+        if buf is not None:
+            body = self.runtime.put_deferred(value, oid, is_error)
+            if body is not None:
+                buf.append(body)
+            return  # big values were stored by put_deferred itself
+        self.runtime.put(value, _object_id=oid, _is_error=is_error)
+
     def _store_error(self, spec: TaskSpec, err: TaskError) -> None:
         for oid in spec.return_ids:
             try:
-                self.runtime.put(err, _object_id=oid, _is_error=True)
+                self._put_result(spec, err, oid, is_error=True)
             except Exception:
                 traceback.print_exc()
 
@@ -638,7 +660,7 @@ class Worker:
         if n == 0:
             return
         if n == 1:
-            self.runtime.put(result, _object_id=spec.return_ids[0])
+            self._put_result(spec, result, spec.return_ids[0])
             return
         values = list(result) if isinstance(result, (tuple, list)) else None
         if values is None or len(values) != n:
@@ -648,7 +670,7 @@ class Worker:
                 f"{len(result) if hasattr(result, '__len__') else 'n/a'}"
             )
         for oid, v in zip(spec.return_ids, values):
-            self.runtime.put(v, _object_id=oid)
+            self._put_result(spec, v, oid)
 
     def main_loop(self) -> None:
         self._exit.wait()
