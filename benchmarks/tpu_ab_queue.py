@@ -1,0 +1,103 @@
+"""Prioritized TPU A/B queue for the GPT-2 headline bench.
+
+Runs configs in priority order, appending one JSON line per result to
+``benchmarks/ab_results.jsonl`` as each finishes — so a flaky tunnel
+window still yields whatever it had time for. Each config runs in a
+fresh subprocess (a hung compile can't wedge the queue; OOMs are
+isolated).
+
+    python benchmarks/tpu_ab_queue.py [--timeout-s 900]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+RESULTS = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "ab_results.jsonl")
+
+# Priority order: answer the biggest open questions first. Every config
+# gets the bench's chunked LM-head CE (loss_chunk default below) — the
+# TransformerConfig default of 0 would silently measure the dense path.
+_BASE = dict(loss_chunk=4096)
+QUEUE = [
+    # 1. control: the known 90.9k config (validates the window itself)
+    dict(ce_impl="checkpoint"),
+    # 2. the fused-CE candidate (expected ~+9% FLOPs saving)
+    dict(ce_impl="fused"),
+    # 3. fused CE without the accuracy argmax
+    dict(ce_impl="fused", ce_accuracy=False),
+    # 4. jax's bundled flash kernel (removes 7.2 GB of saved probs)
+    dict(ce_impl="fused", attn_impl="flash_jax"),
+    dict(ce_impl="fused", attn_impl="flash_jax",
+         flash_block_q=1024, flash_block_k=1024),
+    # 5. flash frees the score buffers -> bigger batches feed the MXU
+    dict(batch=32, ce_impl="fused", attn_impl="flash_jax"),
+    dict(batch=48, ce_impl="fused", attn_impl="flash_jax"),
+    dict(batch=64, ce_impl="fused", attn_impl="flash_jax"),
+    # 6. own-kernel flash re-check with fused CE
+    dict(ce_impl="fused", attn_impl="flash",
+         flash_block_q=512, flash_block_k=512),
+    # 7. dots-remat at larger batch (cheap backward recompute)
+    dict(batch=48, ce_impl="fused", remat=True, remat_policy="dots"),
+    # 8. CE chunk size sensitivity under fused
+    dict(ce_impl="fused", loss_chunk=8192),
+    dict(ce_impl="fused", loss_chunk=2048),
+]
+
+
+def run_one(kw: dict, timeout_s: float) -> dict:
+    prog = (
+        "import sys, json; sys.path.insert(0, %r)\n"
+        "from benchmarks.gpt2_sweep import run\n"
+        "r = run(**json.loads(%r))\n"
+        "print('RESULT ' + json.dumps(r if isinstance(r, str) else round(r, 1)))\n"
+        % (os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+           json.dumps(kw))
+    )
+    t0 = time.time()
+    try:
+        p = subprocess.run([sys.executable, "-c", prog],
+                           capture_output=True, text=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return {**kw, "tok_s": "TIMEOUT", "wall_s": round(time.time() - t0, 1)}
+    out = next((ln for ln in reversed(p.stdout.splitlines())
+                if ln.startswith("RESULT ")), None)
+    tok_s = json.loads(out[7:]) if out else f"NO_OUTPUT rc={p.returncode}"
+    return {**kw, "tok_s": tok_s, "wall_s": round(time.time() - t0, 1)}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--timeout-s", type=float, default=900)
+    args = ap.parse_args()
+    done = set()
+    if os.path.exists(RESULTS):
+        with open(RESULTS) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(rec.get("tok_s"), (int, float)):
+                    done.add(json.dumps(
+                        {k: v for k, v in rec.items()
+                         if k not in ("tok_s", "wall_s")}, sort_keys=True))
+    for kw in QUEUE:
+        kw = {**_BASE, **kw}
+        key = json.dumps(kw, sort_keys=True)
+        if key in done:
+            continue
+        rec = run_one(kw, args.timeout_s)
+        with open(RESULTS, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        print(json.dumps(rec), flush=True)
+
+
+if __name__ == "__main__":
+    main()
