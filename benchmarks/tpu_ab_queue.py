@@ -24,7 +24,7 @@ RESULTS = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 # Priority order: answer the biggest open questions first. Every config
 # gets the bench's chunked LM-head CE (loss_chunk default below) — the
 # TransformerConfig default of 0 would silently measure the dense path.
-_BASE = dict(loss_chunk=4096)
+_BASE = dict(loss_chunk=4096, vocab_size=50304)  # the measured bench config
 QUEUE = [
     # 1. control: the known 90.9k config (validates the window itself)
     dict(ce_impl="checkpoint"),

@@ -267,43 +267,101 @@ class LLMEngine:
         return self.max_len
 
     def _admit(self, outputs: list[RequestOutput]) -> None:
+        # Batched admission (vLLM batches prefills): same-bucket prompts
+        # prefill in ONE [N, S] program — fills the MXU batch dim and
+        # amortizes dispatch. Prefix caching, chunked prefill, and
+        # speculative drafts need per-prompt handling (different pos0 /
+        # a draft mirror), so those engines admit sequentially.
+        cfg = self.config
+        batchable = (cfg.prefill_chunk == 0
+                     and not cfg.enable_prefix_caching
+                     and self.draft is None
+                     # PP runs prefill through the PPRunner's shard_map
+                     # (stage-sliced params); the plain-jit batched
+                     # program would gather every stage's weights.
+                     and self._mr is model_runner)
+        admits: list[tuple[int, Request]] = []
         for slot in range(len(self.slots)):
             if self.slots[slot] is not None or not self.waiting:
                 continue
-            req = self.waiting.popleft()
-            sp = req.params
-            last_logits = self._prefill_into(slot, req.prompt_tokens)
-            self.positions[slot] = len(req.prompt_tokens)
-            self.slots[slot] = req
-            self.temps[slot] = sp.temperature
-            self.top_ks[slot] = max(0, sp.top_k)
-            self.top_ps[slot] = sp.top_p
-            self.pres_pens[slot] = sp.presence_penalty
-            self.freq_pens[slot] = sp.frequency_penalty
-            self.rep_pens[slot] = sp.repetition_penalty
-            self._plain[slot] = not sp.needs_advanced()
-            self._spec_ok[slot] = sp.greedy_equivalent() and sp.logprobs == 0
-            if sp.seed is not None:
-                self.seeds[slot] = np.int32(np.uint32(sp.seed & 0xFFFFFFFF))
-            else:
-                self._rng, k = jax.random.split(self._rng)
-                self.seeds[slot] = np.int32(
-                    np.uint32(int(jax.random.bits(k, dtype=jnp.uint32))))
-            if sp.logprobs > 0:
-                req.logprobs = []
-            tok = self._sample_host(np.asarray(last_logits), slot, req)
-            if not self._plain[slot]:
-                # Seed the device-side penalty state: prompt token set +
-                # the first sampled token.
-                hist = np.zeros((self.model_config.vocab_size,), bool)
-                hist[np.asarray(req.prompt_tokens, np.int64)] = True
-                self._counts, self._prompt_mask = (
-                    model_runner.reset_slot_sampling(
-                        self._counts, self._prompt_mask, jnp.int32(slot),
-                        jnp.asarray(hist), jnp.int32(tok)))
-            self.last_tokens[slot] = tok
-            req.generated.append(tok)
-            self._maybe_finish(slot, outputs)
+            admits.append((slot, self.waiting.popleft()))
+        if not admits:
+            return
+        if not batchable or len(admits) == 1:
+            for slot, req in admits:
+                last_logits = self._prefill_into(slot, req.prompt_tokens)
+                self._finish_admit(slot, req, np.asarray(last_logits),
+                                   outputs)
+            return
+        groups: dict[int, list] = {}
+        for slot, req in admits:
+            S = self._bucket(len(req.prompt_tokens))
+            groups.setdefault(S, []).append((slot, req))
+        B = len(self.slots)
+        for S, group in sorted(groups.items()):
+            if len(group) == 1:
+                slot, req = group[0]
+                last_logits = self._prefill_into(slot, req.prompt_tokens)
+                self._finish_admit(slot, req, np.asarray(last_logits),
+                                   outputs)
+                continue
+            # Pad the group to the next power of two (bounded compile
+            # count); pad rows use slot index B — out of range, dropped
+            # by the scatter (model_runner.prefill_batch mode="drop").
+            N = 1 << (len(group) - 1).bit_length()
+            toks = np.zeros((N, S), np.int32)
+            lens = np.ones((N,), np.int32)
+            slots_arr = np.full((N,), B, np.int32)
+            for j, (slot, req) in enumerate(group):
+                L = len(req.prompt_tokens)
+                toks[j, :L] = req.prompt_tokens
+                lens[j] = L
+                slots_arr[j] = slot
+            logits, self.cache = model_runner.prefill_batch(
+                self.params, jnp.asarray(toks), jnp.asarray(lens),
+                jnp.asarray(slots_arr), self.cache,
+                config=self.model_config)
+            logits_np = np.asarray(logits)
+            for j, (slot, req) in enumerate(group):
+                self._finish_admit(slot, req, logits_np[j], outputs)
+
+    def _finish_admit(self, slot: int, req: Request,
+                      last_logits: np.ndarray,
+                      outputs: list[RequestOutput]) -> None:
+        """Per-request state wiring after its prompt K/V is in ``slot``
+        and its last-token logits are on host."""
+        sp = req.params
+        self.positions[slot] = len(req.prompt_tokens)
+        self.slots[slot] = req
+        self.temps[slot] = sp.temperature
+        self.top_ks[slot] = max(0, sp.top_k)
+        self.top_ps[slot] = sp.top_p
+        self.pres_pens[slot] = sp.presence_penalty
+        self.freq_pens[slot] = sp.frequency_penalty
+        self.rep_pens[slot] = sp.repetition_penalty
+        self._plain[slot] = not sp.needs_advanced()
+        self._spec_ok[slot] = sp.greedy_equivalent() and sp.logprobs == 0
+        if sp.seed is not None:
+            self.seeds[slot] = np.int32(np.uint32(sp.seed & 0xFFFFFFFF))
+        else:
+            self._rng, k = jax.random.split(self._rng)
+            self.seeds[slot] = np.int32(
+                np.uint32(int(jax.random.bits(k, dtype=jnp.uint32))))
+        if sp.logprobs > 0:
+            req.logprobs = []
+        tok = self._sample_host(last_logits, slot, req)
+        if not self._plain[slot]:
+            # Seed the device-side penalty state: prompt token set +
+            # the first sampled token.
+            hist = np.zeros((self.model_config.vocab_size,), bool)
+            hist[np.asarray(req.prompt_tokens, np.int64)] = True
+            self._counts, self._prompt_mask = (
+                model_runner.reset_slot_sampling(
+                    self._counts, self._prompt_mask, jnp.int32(slot),
+                    jnp.asarray(hist), jnp.int32(tok)))
+        self.last_tokens[slot] = tok
+        req.generated.append(tok)
+        self._maybe_finish(slot, outputs)
 
     def _prefill_into(self, slot: int, toks: list[int]):
         """Write a prompt's K/V into ``slot`` (prefix-cache install +

@@ -86,11 +86,22 @@ def embed_tokens(params, tokens, positions, c, dt):
                                theta=c.rope_theta)
 
 
-def make_prefill_body(c, dt, positions, rope, slot):
+def make_prefill_body(c, dt, positions, rope, slot, *, cache_write=None):
     """Per-layer scan body for whole-prompt prefill: xs = (layer params,
-    layer k-cache [slots,T,KV,Dh], layer v-cache). Shared by prefill()
-    and the pipeline runner's stage segments so attention/masking/dtype
-    fixes can never diverge between them."""
+    layer k-cache [slots,T,KV,Dh], layer v-cache). Shared by prefill(),
+    prefill_batch() (via ``cache_write``), and the pipeline runner's
+    stage segments so attention/masking/dtype fixes can never diverge
+    between them.
+
+    ``cache_write(kc, k) -> kc'`` overrides how a layer's new K (or V)
+    rows land in the cache; the default writes one slot's rows at
+    ``slot``.
+    """
+    if cache_write is None:
+        def cache_write(cache_arr, new):
+            return jax.lax.dynamic_update_slice(cache_arr, new,
+                                                (slot, 0, 0, 0))
+
     def body(x, xs):
         lp, kc, vc = xs
         h = _norm1(x, lp, c)
@@ -100,8 +111,8 @@ def make_prefill_body(c, dt, positions, rope, slot):
         if rope is not None:
             q = apply_rope(q, *rope, positions=positions)
             k = apply_rope(k, *rope, positions=positions)
-        kc = jax.lax.dynamic_update_slice(kc, k, (slot, 0, 0, 0))
-        vc = jax.lax.dynamic_update_slice(vc, v, (slot, 0, 0, 0))
+        kc = cache_write(kc, k)
+        vc = cache_write(vc, v)
         kf, vf = _expand_gqa(k, v, c)
         o = dot_product_attention(q, kf, vf, causal=True).astype(dt)
         o = jnp.einsum("bshk,hkd->bsd", o, lp["attn"]["wo"].astype(dt))
@@ -287,6 +298,42 @@ def prefill(params, tokens, true_len, slot, cache, *, config: TransformerConfig)
     # prefill-FLOPs saving (V >> D).
     xl = jax.lax.dynamic_slice_in_dim(x, true_len - 1, 1, axis=1)
     last = _final_logits(xl, params, c, dt)[0, 0]
+    return last, {"k": k_new, "v": v_new}
+
+
+@partial(jax.jit, static_argnames=("config",), donate_argnames=("cache",))
+def prefill_batch(params, tokens, true_lens, slots, cache,
+                  *, config: TransformerConfig):
+    """Batched whole-prompt prefill: N same-bucket prompts in ONE
+    program (vLLM batches prefills; on TPU this also fills the MXU
+    batch dim and amortizes per-call dispatch). tokens [N, S],
+    true_lens [N], slots [N] — distinct in-range indices for real
+    rows; PAD rows must use an OUT-OF-RANGE index (the scatter runs
+    mode="drop"), never a repeated in-range slot (duplicate scatter
+    writes have unspecified order). Returns (last_logits [N,V], cache').
+
+    Each prompt attends only within itself (batched causal attention),
+    exactly as N sequential prefill() calls would.
+    """
+    c = config
+    dt = c.compute_dtype
+    N, S = tokens.shape
+    positions = jnp.arange(S)
+    x, rope = embed_tokens(params, tokens, positions, c, dt)  # [N,S,D]
+
+    def scatter_rows(cache_arr, new):
+        # mode="drop": padded group members carry an out-of-range slot
+        # index and write nothing (JAX scatter OOB-drop semantics).
+        return cache_arr.at[slots, :S].set(new, mode="drop")
+
+    body = make_prefill_body(c, dt, positions, rope, None,
+                             cache_write=scatter_rows)
+    x, (k_new, v_new) = jax.lax.scan(
+        body, x, (params["layers"], cache["k"], cache["v"])
+    )
+    xl = jnp.take_along_axis(
+        x, (true_lens - 1)[:, None, None].astype(jnp.int32), axis=1)  # [N,1,D]
+    last = _final_logits(xl, params, c, dt)[:, 0]  # [N, V]
     return last, {"k": k_new, "v": v_new}
 
 

@@ -241,6 +241,24 @@ def test_logprobs_above_cap_rejected():
                                            logprobs=MAX_LOGPROBS + 1))
 
 
+def test_batched_prefill_matches_sequential():
+    """Batched same-bucket admission (prefill_batch) must produce the
+    same greedy outputs as one-at-a-time generation with the same
+    params — including mixed bucket sizes and an odd group padded to a
+    power of two."""
+    cfg = tiny_config(max_num_seqs=6)
+    eng = LLMEngine(cfg)
+    prompts = ["a", "bb", "ccc",                      # bucket 8 (x3, pads to 4)
+               "d" * 12, "e" * 13,                    # bucket 16 (x2)
+               "f" * 20]                              # bucket 32 (x1)
+    sp = SamplingParams(max_tokens=6)
+    batched = eng.generate(prompts, sp)
+    solo_eng = LLMEngine(cfg, params=eng.params)
+    for p, out in zip(prompts, batched):
+        solo = solo_eng.generate([p], sp)[0]
+        assert solo.token_ids == out.token_ids, p
+
+
 def test_mixed_batch_plain_and_advanced():
     """Plain-greedy requests must produce identical output whether or
     not an advanced request shares their batch."""
