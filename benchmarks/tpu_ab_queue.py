@@ -85,15 +85,15 @@ def main() -> None:
                 except json.JSONDecodeError:
                     continue
                 if isinstance(rec.get("tok_s"), (int, float)):
-                    done.add(json.dumps(
-                        {k: v for k, v in rec.items()
-                         if k not in ("tok_s", "wall_s")}, sort_keys=True))
-    for kw in QUEUE:
-        kw = {**_BASE, **kw}
-        key = json.dumps(kw, sort_keys=True)
+                    done.add(rec.get("_key"))
+    for raw in QUEUE:
+        # The resume key is the RAW queue entry, recorded verbatim — so
+        # editing _BASE defaults can never invalidate prior results.
+        key = json.dumps(raw, sort_keys=True)
         if key in done:
             continue
-        rec = run_one(kw, args.timeout_s)
+        rec = run_one({**_BASE, **raw}, args.timeout_s)
+        rec["_key"] = key
         with open(RESULTS, "a") as f:
             f.write(json.dumps(rec) + "\n")
         print(json.dumps(rec), flush=True)

@@ -22,8 +22,11 @@ from ray_tpu.models.transformer import (
     llama3_8b,
     lm_loss,
     make_train_step,
+    mistral_7b,
+    mixtral_8x7b,
     moe_small,
     partition_specs,
+    qwen2_7b,
     tiny,
     tiny_moe,
 )
@@ -46,6 +49,9 @@ __all__ = [
     "llama3_8b",
     "lm_loss",
     "make_train_step",
+    "mistral_7b",
+    "mixtral_8x7b",
     "partition_specs",
+    "qwen2_7b",
     "tiny",
 ]

@@ -186,6 +186,34 @@ def llama3_8b(**kw) -> TransformerConfig:
     )
 
 
+def mistral_7b(**kw) -> TransformerConfig:
+    """Mistral-7B-v0.1 geometry (GQA 8 kv-heads, 32k positions)."""
+    return replace(
+        TransformerConfig(
+            vocab_size=32000, n_layers=32, d_model=4096, n_heads=32,
+            n_kv_heads=8, d_ff=14336, max_seq_len=32768, arch="llama",
+        ),
+        **kw,
+    )
+
+
+def qwen2_7b(**kw) -> TransformerConfig:
+    """Qwen2-7B geometry (GQA 4 kv-heads, 1M rope theta)."""
+    return replace(
+        TransformerConfig(
+            vocab_size=152064, n_layers=28, d_model=3584, n_heads=28,
+            n_kv_heads=4, d_ff=18944, max_seq_len=32768, arch="llama",
+            rope_theta=1000000.0,
+        ),
+        **kw,
+    )
+
+
+def mixtral_8x7b(**kw) -> TransformerConfig:
+    """Mixtral-8x7B geometry: Mistral-7B dims with 8 experts, top-2."""
+    return mistral_7b(n_experts=8, expert_top_k=2, **kw)
+
+
 def moe_small(**kw) -> TransformerConfig:
     """Mixtral-style MoE on the small-llama geometry: 8 experts, top-2.
     Per-token FLOPs ≈ dense small; total params ≈ 8× the FFN stack."""
@@ -725,7 +753,7 @@ def lm_loss(params, batch, config: TransformerConfig, *, mesh=None,
 
 
 def make_train_step(config: TransformerConfig, optimizer, *, mesh=None,
-                    z_loss: float = 0.0):
+                    z_loss: float = 0.0, accum_steps: int = 1):
     """Build the jittable training step.
 
     state: {"params", "opt_state", "step"}. With a mesh, jit it with
@@ -733,6 +761,17 @@ def make_train_step(config: TransformerConfig, optimizer, *, mesh=None,
     parallel.sharding.shard_params); GSPMD inserts the grad
     reduce-scatters/all-reduces the reference gets from DDP/FSDP wrappers
     (reference: train/torch/train_loop_utils.py:12,36).
+
+    ``accum_steps > 1`` enables gradient accumulation: every batch leaf's
+    leading dim must be a multiple of accum_steps; the step scans over
+    accum_steps microbatches, accumulates grads in fp32 weighted by each
+    microbatch's valid-token count (so masked batches match the
+    unaccumulated step's per-token weighting), and applies the optimizer
+    ONCE — the activation-memory footprint of a 1/accum batch at the
+    effective batch size of the whole one. Every metric lm_loss reports
+    (incl. router_aux for MoE) is the same weighted average; perplexity
+    is the weighted mean of per-microbatch perplexities (exp is convex,
+    so it can sit slightly above the unaccumulated exp-of-mean value).
     """
 
     def loss_fn(params, batch):
@@ -742,10 +781,59 @@ def make_train_step(config: TransformerConfig, optimizer, *, mesh=None,
 
     fused = isinstance(optimizer, FusedClipAdamW)
 
+    def grads_of(params, batch):
+        if accum_steps <= 1:
+            return jax.value_and_grad(loss_fn, has_aux=True)(params, batch)
+
+        def to_micro(x):
+            n = x.shape[0]
+            if n % accum_steps:
+                raise ValueError(
+                    f"batch dim {n} not divisible by accum_steps "
+                    f"{accum_steps}")
+            return x.reshape(accum_steps, n // accum_steps, *x.shape[1:])
+
+        micro = jax.tree.map(to_micro, batch)
+
+        def micro_weight(mb):
+            # Valid-TARGET-token count: lm_loss means over this, so
+            # weighting by it reproduces the full-batch per-token mean.
+            mask = mb.get("mask")
+            if mask is not None:
+                m = mask[:, 1:] if "tokens" in mb else mask
+                return m.astype(jnp.float32).sum()
+            toks = mb["tokens"] if "tokens" in mb else mb["targets"]
+            n_t = toks.shape[0] * (toks.shape[1] - (1 if "tokens" in mb
+                                                    else 0))
+            return jnp.float32(n_t)
+
+        # Metric structure is config-static: one abstract eval gives the
+        # zero carry for ANY key set lm_loss reports (router_aux, ...).
+        first = jax.tree.map(lambda x: x[0], micro)
+        m_shape = jax.eval_shape(loss_fn, params, first)[1]
+        mzero = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), m_shape)
+        gzero = jax.tree.map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params)
+
+        def scan_body(carry, mb):
+            gsum, msum, wsum = carry
+            (loss, metrics), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, mb)
+            w = micro_weight(mb)
+            gsum = jax.tree.map(
+                lambda a, g: a + w * g.astype(jnp.float32), gsum, grads)
+            msum = jax.tree.map(lambda a, m: a + w * m, msum, metrics)
+            return (gsum, msum, wsum + w), None
+
+        (gsum, msum, wsum), _ = jax.lax.scan(
+            scan_body, (gzero, mzero, jnp.zeros((), jnp.float32)), micro)
+        inv = 1.0 / jnp.maximum(wsum, 1.0)
+        grads = jax.tree.map(lambda g: g * inv, gsum)
+        metrics = jax.tree.map(lambda m: m * inv, msum)
+        return (metrics["loss"], metrics), grads
+
     def train_step(state, batch):
-        (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            state["params"], batch
-        )
+        (loss, metrics), grads = grads_of(state["params"], batch)
         if fused:
             # Single fused pass: clip + AdamW + param update in one
             # kernel per leaf, grad norm shared with the metric (the

@@ -173,6 +173,67 @@ def test_chunked_ce_matches_dense_loss():
         assert np.allclose(np.asarray(a), np.asarray(b), atol=1e-4)
 
 
+def test_grad_accumulation_matches_full_batch():
+    """accum_steps=2 over a batch of 4 must match the plain step on the
+    same 4 rows (same grads -> same params after one optimizer apply)."""
+    import jax
+    import numpy as np
+
+    from ray_tpu.models import transformer as tfm
+
+    cfg = tfm.tiny(dtype="float32", loss_chunk=64)
+    opt = optax.adam(1e-3)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (4, 33), 0,
+                              cfg.vocab_size)
+    batch = {"tokens": toks}
+
+    s0 = models.init_train_state(jax.random.PRNGKey(0), cfg, opt)
+    step_full = jax.jit(tfm.make_train_step(cfg, opt))
+    s_full, m_full = step_full(s0, batch)
+
+    s0b = models.init_train_state(jax.random.PRNGKey(0), cfg, opt)
+    step_acc = jax.jit(tfm.make_train_step(cfg, opt, accum_steps=2))
+    s_acc, m_acc = step_acc(s0b, batch)
+
+    assert np.allclose(float(m_full["loss"]), float(m_acc["loss"]),
+                       rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(s_full["params"]),
+                    jax.tree.leaves(s_acc["params"])):
+        assert np.allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+    # UNEVEN mask: microbatches must weight by valid-token count to
+    # match the full-batch per-token mean.
+    mask = np.ones((4, 33), np.float32)
+    mask[2:, 5:] = 0.0  # rows 2-3 mostly masked
+    mb = {"tokens": toks, "mask": jnp.asarray(mask)}
+    s1, mf = step_full(models.init_train_state(jax.random.PRNGKey(0), cfg,
+                                               opt), mb)
+    s2, ma = step_acc(models.init_train_state(jax.random.PRNGKey(0), cfg,
+                                              opt), mb)
+    assert np.allclose(float(mf["loss"]), float(ma["loss"]), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(s1["params"]),
+                    jax.tree.leaves(s2["params"])):
+        assert np.allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_grad_accumulation_moe_keeps_router_aux():
+    """Accumulated MoE steps must still report router_aux (generic
+    metric accumulation, not a hardcoded key set)."""
+    import jax
+
+    from ray_tpu.models import transformer as tfm
+
+    cfg = tfm.tiny_moe(dtype="float32")
+    opt = optax.adam(1e-3)
+    s0 = models.init_train_state(jax.random.PRNGKey(0), cfg, opt)
+    step = jax.jit(tfm.make_train_step(cfg, opt, accum_steps=2))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (4, 33), 0,
+                              cfg.vocab_size)
+    _, m = step(s0, {"tokens": toks})
+    assert "router_aux" in m
+    assert np.isfinite(float(m["router_aux"]))
+
+
 def test_fused_ce_matches_checkpoint_ce():
     """ce_impl="fused" (analytic dlogits in the forward scan) must agree
     with ce_impl="checkpoint" (jax.checkpoint recompute) in loss AND
