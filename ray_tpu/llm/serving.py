@@ -144,21 +144,79 @@ class LLMServer:
         import asyncio
 
         sp = self._sampling(payload)
+        n = max(1, int(payload.get("n", 1)))
+        raw_bo = payload.get("best_of")
+        best_of = n if raw_bo is None else int(raw_bo)
+        if best_of < 1 or best_of < n:
+            raise ValueError(
+                f"best_of ({best_of}) must be >= 1 and >= n ({n})")
+        if best_of > 1 and sp.temperature <= 0.0:
+            # n identical greedy streams at n-fold cost (vLLM rejects
+            # best_of > 1 with greedy sampling for the same reason).
+            raise ValueError(
+                "n/best_of > 1 requires temperature > 0 (greedy sampling "
+                "would return identical completions)")
         outs = await asyncio.gather(
-            *[self.async_engine.generate(p, sp) for p in prompts])
+            *[self.async_engine.generate(p, spi)
+              for p in prompts
+              for spi in self._fan_out(sp, best_of, rank=best_of > n)])
+        # Group the best_of samples per prompt; rank by CUMULATIVE
+        # logprob when pruning best_of -> n (vLLM best_of semantics).
+        choices = []
+        for pi in range(len(prompts)):
+            group = outs[pi * best_of:(pi + 1) * best_of]
+            if best_of > n:
+                group = sorted(group, key=self._cumulative_logprob,
+                               reverse=True)[:n]
+            for o in group:
+                choices.append(
+                    {"index": len(choices), "text": o.text,
+                     "finish_reason": o.finish_reason,
+                     **({"logprobs": self._openai_logprobs(o)}
+                        if o.logprobs is not None and sp.logprobs > 0
+                        else {})})
+        # OpenAI usage accounting: each prompt counted ONCE; completion
+        # tokens include every best_of sample (pruned ones were still
+        # generated and paid for).
+        usage = {
+            "prompt_tokens": sum(
+                outs[pi * best_of].num_prompt_tokens
+                for pi in range(len(prompts))),
+            "completion_tokens": sum(len(o.token_ids) for o in outs),
+        }
+        usage["total_tokens"] = (usage["prompt_tokens"]
+                                 + usage["completion_tokens"])
         return {
             "id": f"cmpl-{uuid.uuid4().hex[:24]}",
             "object": "text_completion",
             "created": int(time.time()),
             "model": self.config.model_id,
-            "choices": [
-                {"index": i, "text": o.text, "finish_reason": o.finish_reason,
-                 **({"logprobs": self._openai_logprobs(o)}
-                    if o.logprobs is not None else {})}
-                for i, o in enumerate(outs)
-            ],
-            "usage": self._usage(outs),
+            "choices": choices,
+            "usage": usage,
         }
+
+    def _fan_out(self, sp: SamplingParams, k: int,
+                 rank: bool = False) -> "list[SamplingParams]":
+        """k independent sampling streams for n/best_of: derived seeds
+        (stable when the user pinned one); ``rank`` forces logprobs on
+        so best_of pruning has a ranking signal."""
+        import dataclasses
+
+        if k == 1:
+            return [sp]
+        out = []
+        for i in range(k):
+            out.append(dataclasses.replace(
+                sp,
+                seed=(sp.seed + i if sp.seed is not None else None),
+                logprobs=max(sp.logprobs, 1) if rank else sp.logprobs))
+        return out
+
+    @staticmethod
+    def _cumulative_logprob(o) -> float:
+        if not o.logprobs:
+            return float("-inf")
+        return sum(e["logprob"] for e in o.logprobs)
 
     def _openai_logprobs(self, out) -> dict:
         """OpenAI text-completions logprobs block from the engine's

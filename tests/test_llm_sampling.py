@@ -259,6 +259,45 @@ def test_batched_prefill_matches_sequential():
         assert solo.token_ids == out.token_ids, p
 
 
+def test_serving_n_and_best_of():
+    """OpenAI `n` returns n choices per prompt; best_of > n samples
+    best_of streams and keeps the top n by mean logprob."""
+    import asyncio
+
+    from ray_tpu.llm.serving import LLMServer
+
+    srv = LLMServer(tiny_config(max_num_seqs=8))
+
+    async def go():
+        r = await srv.completions({"prompt": "hi", "n": 3,
+                                   "temperature": 0.9, "seed": 5,
+                                   "max_tokens": 6})
+        assert len(r["choices"]) == 3
+        assert [c["index"] for c in r["choices"]] == [0, 1, 2]
+        assert len({c["text"] for c in r["choices"]}) >= 2
+        assert all("logprobs" not in c for c in r["choices"])
+        r2 = await srv.completions({"prompt": ["a", "b"], "n": 2,
+                                    "best_of": 3, "temperature": 0.9,
+                                    "max_tokens": 4})
+        assert len(r2["choices"]) == 4  # 2 prompts x n=2
+        # usage: prompt counted once per prompt (same as an n=1 run);
+        # completions include the pruned best_of samples
+        r1 = await srv.completions({"prompt": ["a", "b"],
+                                    "temperature": 0.9, "max_tokens": 4})
+        assert (r2["usage"]["prompt_tokens"]
+                == r1["usage"]["prompt_tokens"])
+        assert r2["usage"]["completion_tokens"] > 4 * 2
+        with pytest.raises(ValueError, match="best_of"):
+            await srv.completions({"prompt": "x", "n": 3, "best_of": 2})
+        with pytest.raises(ValueError, match="best_of"):
+            await srv.completions({"prompt": "x", "best_of": 0,
+                                   "temperature": 0.9})
+        with pytest.raises(ValueError, match="temperature"):
+            await srv.completions({"prompt": "x", "n": 2})
+
+    asyncio.run(go())
+
+
 def test_mixed_batch_plain_and_advanced():
     """Plain-greedy requests must produce identical output whether or
     not an advanced request shares their batch."""
