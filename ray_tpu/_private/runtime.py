@@ -748,6 +748,24 @@ class CoreRuntime:
     def get_function(self, func_id: str) -> Any:
         fn = self._fn_cache.get(func_id)
         if fn is None:
+            if func_id.startswith("path:"):
+                # Cross-language invocation (reference:
+                # cross_language.python_function — Java/C++ frontends
+                # name Python functions by import path instead of
+                # shipping pickled bytes): "path:module.sub:attr".
+                import importlib
+
+                mod_name, _, attr = func_id[5:].partition(":")
+                if not mod_name or not attr:
+                    raise RayTpuError(
+                        f"malformed cross-language function id {func_id!r}"
+                        f" (want 'path:module:attr')")
+                obj = importlib.import_module(mod_name)
+                for part in attr.split("."):
+                    obj = getattr(obj, part)
+                fn = getattr(obj, "_fn", obj)  # unwrap @remote
+                self._fn_cache[func_id] = fn
+                return fn
             reply = self.conn.call("kv_get", {"ns": "__functions__", "key": func_id})
             if reply["value"] is None:
                 raise RayTpuError(f"function {func_id} not found in KV")

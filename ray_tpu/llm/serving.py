@@ -144,7 +144,9 @@ class LLMServer:
         import asyncio
 
         sp = self._sampling(payload)
-        n = max(1, int(payload.get("n", 1)))
+        n = int(payload.get("n", 1))
+        if n < 1:
+            raise ValueError(f"n must be >= 1, got {n}")
         raw_bo = payload.get("best_of")
         best_of = n if raw_bo is None else int(raw_bo)
         if best_of < 1 or best_of < n:

@@ -1,0 +1,343 @@
+// Minimal pickle writer/reader for the ray_tpu control-plane protocol.
+//
+// The wire frames carry pickled (kind, msg_id, body) tuples
+// (ray_tpu/_private/rpc.py). A native client needs just enough pickle:
+//   write: protocol 3 — None/bool/int/float/str/bytes/list/dict/tuple,
+//          plus GLOBAL+NEWOBJ+BUILD for dataclass instances (TaskSpec).
+//   read:  the opcodes CPython's default protocol (5) emits for plain
+//          data (FRAME/MEMOIZE/SHORT_BINUNICODE/...), with a memo table.
+// Anything outside that vocabulary raises — the replies this client
+// consumes are dicts of scalars/containers by protocol design.
+//
+// Counterpart of the reference's cross-language serialization surface
+// (reference: cpp/ frontend + java msgpack bridge); ours speaks the
+// Python control plane natively so no interpreter is embedded.
+
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <stdexcept>
+#include <string>
+#include <utility>
+#include <vector>
+
+namespace rtpu {
+
+struct PVal;
+using PList = std::vector<PVal>;
+using PItems = std::vector<std::pair<PVal, PVal>>;
+
+struct PVal {
+  enum class Kind { None, Bool, Int, Float, Str, Bytes, List, Tuple, Dict,
+                    Instance };
+  Kind kind = Kind::None;
+  bool b = false;
+  int64_t i = 0;
+  double f = 0.0;
+  std::string s;        // Str (utf-8) or Bytes
+  std::shared_ptr<PList> seq;    // List / Tuple
+  std::shared_ptr<PItems> items; // Dict
+
+  PVal() = default;
+  static PVal none() { return PVal(); }
+  static PVal boolean(bool v) { PVal p; p.kind = Kind::Bool; p.b = v; return p; }
+  static PVal integer(int64_t v) { PVal p; p.kind = Kind::Int; p.i = v; return p; }
+  static PVal real(double v) { PVal p; p.kind = Kind::Float; p.f = v; return p; }
+  static PVal str(std::string v) { PVal p; p.kind = Kind::Str; p.s = std::move(v); return p; }
+  static PVal bytes(std::string v) { PVal p; p.kind = Kind::Bytes; p.s = std::move(v); return p; }
+  static PVal list(PList v = {}) { PVal p; p.kind = Kind::List; p.seq = std::make_shared<PList>(std::move(v)); return p; }
+  static PVal tuple(PList v = {}) { PVal p; p.kind = Kind::Tuple; p.seq = std::make_shared<PList>(std::move(v)); return p; }
+  static PVal dict(PItems v = {}) { PVal p; p.kind = Kind::Dict; p.items = std::make_shared<PItems>(std::move(v)); return p; }
+  // A Python class instance: GLOBAL module\ncls + NEWOBJ() + BUILD state.
+  // `s` holds "module\ncls"; `items` the state dict.
+  static PVal instance(const std::string& module, const std::string& cls,
+                       PItems state) {
+    PVal p; p.kind = Kind::Instance; p.s = module + "\n" + cls;
+    p.items = std::make_shared<PItems>(std::move(state));
+    return p;
+  }
+
+  bool is_none() const { return kind == Kind::None; }
+  // Dict lookup by string key (linear; bodies are small).
+  const PVal* find(const std::string& key) const {
+    if (kind != Kind::Dict) return nullptr;
+    for (const auto& kv : *items)
+      if (kv.first.kind == Kind::Str && kv.first.s == key) return &kv.second;
+    return nullptr;
+  }
+  const PVal& at(const std::string& key) const {
+    const PVal* v = find(key);
+    if (!v) throw std::runtime_error("minipickle: missing key " + key);
+    return *v;
+  }
+};
+
+// ---------------------------------------------------------------- writer
+
+class Pickler {
+ public:
+  // One complete pickle stream for `v`.
+  static std::string dumps(const PVal& v) {
+    Pickler p;
+    p.out_ += "\x80\x03";  // PROTO 3
+    p.write(v);
+    p.out_ += '.';
+    return std::move(p.out_);
+  }
+
+  void write(const PVal& v) {
+    if (v.kind == PVal::Kind::Instance) {
+      out_ += 'c';
+      out_ += v.s;   // "module\ncls"
+      out_ += '\n';
+      out_ += ')';   // EMPTY_TUPLE (no __new__ args)
+      out_ += '\x81';  // NEWOBJ
+      write_dict_items(*v.items);
+      out_ += 'b';   // BUILD (sets __dict__)
+      return;
+    }
+    switch (v.kind) {
+      case PVal::Kind::None: out_ += 'N'; break;
+      case PVal::Kind::Bool: out_ += (v.b ? '\x88' : '\x89'); break;
+      case PVal::Kind::Int: write_int(v.i); break;
+      case PVal::Kind::Float: write_float(v.f); break;
+      case PVal::Kind::Str: {
+        out_ += 'X';
+        put_le32(static_cast<uint32_t>(v.s.size()));
+        out_ += v.s;
+        break;
+      }
+      case PVal::Kind::Bytes: {
+        out_ += 'B';  // BINBYTES (proto 3)
+        put_le32(static_cast<uint32_t>(v.s.size()));
+        out_ += v.s;
+        break;
+      }
+      case PVal::Kind::List: {
+        out_ += ']';
+        if (!v.seq->empty()) {
+          out_ += '(';
+          for (const auto& e : *v.seq) write(e);
+          out_ += 'e';  // APPENDS
+        }
+        break;
+      }
+      case PVal::Kind::Tuple: {
+        const auto& seq = *v.seq;
+        if (seq.empty()) { out_ += ')'; break; }
+        if (seq.size() <= 3) {
+          for (const auto& e : seq) write(e);
+          out_ += static_cast<char>(seq.size() == 1   ? '\x85'
+                                    : seq.size() == 2 ? '\x86'
+                                                      : '\x87');
+        } else {
+          out_ += '(';
+          for (const auto& e : seq) write(e);
+          out_ += 't';
+        }
+        break;
+      }
+      case PVal::Kind::Dict:
+        write_dict_items(*v.items);
+        break;
+      case PVal::Kind::Instance:
+        break;  // handled above
+    }
+  }
+
+ private:
+  void write_dict_items(const PItems& items) {
+    out_ += '}';
+    if (!items.empty()) {
+      out_ += '(';
+      for (const auto& kv : items) { write(kv.first); write(kv.second); }
+      out_ += 'u';  // SETITEMS
+    }
+  }
+  void put_le32(uint32_t n) {
+    char b[4];
+    std::memcpy(b, &n, 4);  // little-endian hosts only (x86/arm64)
+    out_.append(b, 4);
+  }
+  void write_int(int64_t n) {
+    if (n >= 0 && n < 256) {
+      out_ += 'K';
+      out_ += static_cast<char>(n);
+    } else if (n >= INT32_MIN && n <= INT32_MAX) {
+      out_ += 'J';
+      int32_t v = static_cast<int32_t>(n);
+      char b[4];
+      std::memcpy(b, &v, 4);
+      out_.append(b, 4);
+    } else {
+      out_ += '\x8a';  // LONG1
+      out_ += static_cast<char>(8);
+      char b[8];
+      std::memcpy(b, &n, 8);
+      out_.append(b, 8);
+    }
+  }
+  void write_float(double d) {
+    out_ += 'G';  // BINFLOAT: big-endian IEEE 754
+    uint64_t bits;
+    std::memcpy(&bits, &d, 8);
+    for (int i = 7; i >= 0; --i)
+      out_ += static_cast<char>((bits >> (8 * i)) & 0xFF);
+  }
+  std::string out_;
+};
+
+// ---------------------------------------------------------------- reader
+
+class Unpickler {
+ public:
+  static PVal loads(const std::string& data) {
+    Unpickler u(data);
+    return u.run();
+  }
+
+ private:
+  explicit Unpickler(const std::string& d) : d_(d) {}
+
+  const std::string& d_;
+  size_t pos_ = 0;
+  std::vector<PVal> stack_;
+  std::vector<size_t> marks_;
+  std::vector<PVal> memo_;
+
+  uint8_t u8() { need(1); return static_cast<uint8_t>(d_[pos_++]); }
+  uint16_t u16() { need(2); uint16_t v; std::memcpy(&v, d_.data() + pos_, 2); pos_ += 2; return v; }
+  uint32_t u32() { need(4); uint32_t v; std::memcpy(&v, d_.data() + pos_, 4); pos_ += 4; return v; }
+  uint64_t u64() { need(8); uint64_t v; std::memcpy(&v, d_.data() + pos_, 8); pos_ += 8; return v; }
+  std::string take(size_t n) { need(n); std::string s = d_.substr(pos_, n); pos_ += n; return s; }
+  void need(size_t n) {
+    if (pos_ + n > d_.size()) throw std::runtime_error("minipickle: truncated");
+  }
+  PVal pop() {
+    if (stack_.empty()) throw std::runtime_error("minipickle: stack underflow");
+    PVal v = std::move(stack_.back());
+    stack_.pop_back();
+    return v;
+  }
+
+  PVal run() {
+    while (pos_ < d_.size()) {
+      uint8_t op = u8();
+      switch (op) {
+        case 0x80: u8(); break;                    // PROTO n
+        case 0x95: u64(); break;                   // FRAME len
+        case '.': return pop();                    // STOP
+        case 'N': stack_.push_back(PVal::none()); break;
+        case 0x88: stack_.push_back(PVal::boolean(true)); break;
+        case 0x89: stack_.push_back(PVal::boolean(false)); break;
+        case 'K': stack_.push_back(PVal::integer(u8())); break;
+        case 'M': stack_.push_back(PVal::integer(u16())); break;
+        case 'J': {
+          uint32_t v = u32();
+          int32_t sv;
+          std::memcpy(&sv, &v, 4);
+          stack_.push_back(PVal::integer(sv));
+          break;
+        }
+        case 0x8a: {  // LONG1
+          uint8_t n = u8();
+          if (n > 8) throw std::runtime_error("minipickle: LONG1 > 8 bytes");
+          std::string raw = take(n);
+          int64_t v = 0;
+          if (n) {
+            uint64_t uv = 0;
+            std::memcpy(&uv, raw.data(), n);
+            // sign-extend from byte n
+            if (n < 8 && (raw[n - 1] & 0x80)) uv |= ~0ULL << (8 * n);
+            std::memcpy(&v, &uv, 8);
+          }
+          stack_.push_back(PVal::integer(v));
+          break;
+        }
+        case 'G': {  // BINFLOAT big-endian
+          std::string raw = take(8);
+          uint64_t bits = 0;
+          for (int i = 0; i < 8; ++i)
+            bits = (bits << 8) | static_cast<uint8_t>(raw[i]);
+          double dv;
+          std::memcpy(&dv, &bits, 8);
+          stack_.push_back(PVal::real(dv));
+          break;
+        }
+        case 0x8c: { size_t n = u8(); stack_.push_back(PVal::str(take(n))); break; }   // SHORT_BINUNICODE
+        case 'X': { size_t n = u32(); stack_.push_back(PVal::str(take(n))); break; }   // BINUNICODE
+        case 0x8d: { size_t n = u64(); stack_.push_back(PVal::str(take(n))); break; }  // BINUNICODE8
+        case 'C': { size_t n = u8(); stack_.push_back(PVal::bytes(take(n))); break; }  // SHORT_BINBYTES
+        case 'B': { size_t n = u32(); stack_.push_back(PVal::bytes(take(n))); break; } // BINBYTES
+        case 0x8e: { size_t n = u64(); stack_.push_back(PVal::bytes(take(n))); break; }// BINBYTES8
+        case 0x94: memo_.push_back(stack_.back()); break;                              // MEMOIZE
+        case 'q': { u8(); memo_.push_back(stack_.back()); break; }                     // BINPUT
+        case 'r': { u32(); memo_.push_back(stack_.back()); break; }                    // LONG_BINPUT
+        case 'h': { stack_.push_back(memo_at(u8())); break; }                          // BINGET
+        case 'j': { stack_.push_back(memo_at(u32())); break; }                         // LONG_BINGET
+        case '(': marks_.push_back(stack_.size()); break;                              // MARK
+        case ')': stack_.push_back(PVal::tuple()); break;
+        case 0x85: { PVal a = pop(); stack_.push_back(PVal::tuple({std::move(a)})); break; }
+        case 0x86: { PVal b2 = pop(), a = pop(); stack_.push_back(PVal::tuple({std::move(a), std::move(b2)})); break; }
+        case 0x87: { PVal c = pop(), b2 = pop(), a = pop(); stack_.push_back(PVal::tuple({std::move(a), std::move(b2), std::move(c)})); break; }
+        case 't': { stack_.push_back(PVal::tuple(pop_to_mark())); break; }
+        case ']': stack_.push_back(PVal::list()); break;
+        case 'a': { PVal v = pop(); stack_.back().seq->push_back(std::move(v)); break; }  // APPEND
+        case 'e': {  // APPENDS
+          PList items = pop_to_mark();
+          auto& target = *stack_.back().seq;
+          for (auto& it : items) target.push_back(std::move(it));
+          break;
+        }
+        case '}': stack_.push_back(PVal::dict()); break;
+        case 's': {  // SETITEM
+          PVal v = pop(), k = pop();
+          stack_.back().items->emplace_back(std::move(k), std::move(v));
+          break;
+        }
+        case 'u': {  // SETITEMS
+          PList kv = pop_to_mark();
+          auto& target = *stack_.back().items;
+          for (size_t i = 0; i + 1 < kv.size(); i += 2)
+            target.emplace_back(std::move(kv[i]), std::move(kv[i + 1]));
+          break;
+        }
+        case 0x8f: stack_.push_back(PVal::list()); break;  // EMPTY_SET -> list
+        case 0x90: {  // ADDITEMS (set)
+          PList items = pop_to_mark();
+          auto& target = *stack_.back().seq;
+          for (auto& it : items) target.push_back(std::move(it));
+          break;
+        }
+        default:
+          throw std::runtime_error(
+              "minipickle: unsupported opcode 0x" + hex2(op) + " at " +
+              std::to_string(pos_ - 1));
+      }
+    }
+    throw std::runtime_error("minipickle: no STOP");
+  }
+
+  PList pop_to_mark() {
+    if (marks_.empty()) throw std::runtime_error("minipickle: no MARK");
+    size_t m = marks_.back();
+    marks_.pop_back();
+    PList out(std::make_move_iterator(stack_.begin() + m),
+              std::make_move_iterator(stack_.end()));
+    stack_.resize(m);
+    return out;
+  }
+  const PVal& memo_at(size_t i) {
+    if (i >= memo_.size()) throw std::runtime_error("minipickle: bad memo ref");
+    return memo_[i];
+  }
+  static std::string hex2(uint8_t v) {
+    const char* h = "0123456789abcdef";
+    return std::string(1, h[v >> 4]) + h[v & 0xF];
+  }
+};
+
+}  // namespace rtpu

@@ -1,0 +1,11 @@
+"""Importable task targets for cross-language clients (the native C++
+client submits functions by import path — "path:module:attr", see
+runtime.get_function)."""
+
+
+def add_scaled(a, b, scale=1):
+    return (a + b) * scale
+
+
+def echo(x):
+    return x
