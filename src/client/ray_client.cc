@@ -48,6 +48,11 @@ std::string hex_id() {
 constexpr uint32_t kMagic = 0x52545055;  // 'RTPU'
 constexpr size_t kAlign = 64;
 
+// ((args...), {kwargs}) — the worker's cloudpickle.loads consumes this.
+std::string pack_args(const PList& args, const PItems& kwargs) {
+  return Pickler::dumps(PVal::tuple({PVal::tuple(args), PVal::dict(kwargs)}));
+}
+
 std::string wrap_object(const PVal& value) {
   std::string header = Pickler::dumps(value);
   std::string out;
@@ -187,10 +192,7 @@ class RayTpuClient {
                      const PItems& kwargs = {}, double num_cpus = 1.0) {
     std::string task_id = hex_id();
     std::string ret_id = hex_id();
-    // args pickle: ((a1, ...), {kw...}) — standard pickle, loadable by
-    // the worker's cloudpickle.loads.
-    std::string packed = Pickler::dumps(PVal::tuple({
-        PVal::tuple(args), PVal::dict(kwargs)}));
+    std::string packed = pack_args(args, kwargs);
     PVal spec = PVal::instance(
         "ray_tpu._private.task_spec", "TaskSpec", {
             {PVal::str("task_id"), PVal::str(task_id)},
@@ -216,6 +218,86 @@ class RayTpuClient {
         });
     cast("submit_task", PVal::dict({{PVal::str("spec"), spec}}));
     return ret_id;
+  }
+
+  // ---- actors ----
+
+  // Create a Python actor by class import path; methods are then
+  // invoked with call_actor. Head-side registration is synchronous;
+  // the instance itself constructs asynchronously (calls queue).
+  std::string create_actor(const std::string& class_path, const PList& args,
+                           const PItems& kwargs = {},
+                           double num_cpus = 0.0) {
+    std::string actor_id = "actor-" + hex_id().substr(0, 12);
+    std::string packed = pack_args(args, kwargs);
+    PVal spec = PVal::instance(
+        "ray_tpu._private.task_spec", "ActorSpec", {
+            {PVal::str("actor_id"), PVal::str(actor_id)},
+            {PVal::str("name"), PVal::none()},
+            {PVal::str("namespace"), PVal::str("default")},
+            {PVal::str("cls_func_id"), PVal::str("path:" + class_path)},
+            {PVal::str("init_args"), PVal::bytes(packed)},
+            {PVal::str("deps"), PVal::list()},
+            {PVal::str("resources"), PVal::dict({
+                {PVal::str("CPU"), PVal::real(num_cpus)}})},
+            {PVal::str("max_restarts"), PVal::integer(0)},
+            {PVal::str("max_concurrency"), PVal::integer(0)},
+            {PVal::str("owner_id"), PVal::str(client_id_)},
+            {PVal::str("max_task_retries"), PVal::integer(0)},
+            {PVal::str("scheduling_strategy"), PVal::none()},
+            {PVal::str("runtime_env"), PVal::none()},
+            {PVal::str("lifetime"), PVal::none()},
+            {PVal::str("concurrency_groups"), PVal::none()},
+            {PVal::str("borrowed_ids"), PVal::list()},
+            {PVal::str("allow_out_of_order"), PVal::boolean(false)},
+        });
+    call("create_actor", PVal::dict({{PVal::str("spec"), spec}}));
+    return actor_id;
+  }
+
+  // Invoke a method on a created actor; returns the result object id.
+  std::string call_actor(const std::string& actor_id,
+                         const std::string& method, const PList& args,
+                         const PItems& kwargs = {}) {
+    std::string task_id = "task-" + hex_id().substr(0, 12);
+    std::string ret_id = hex_id();
+    std::string packed = pack_args(args, kwargs);
+    int64_t seq;
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      seq = ++actor_seq_[actor_id];
+    }
+    PVal spec = PVal::instance(
+        "ray_tpu._private.task_spec", "TaskSpec", {
+            {PVal::str("task_id"), PVal::str(task_id)},
+            {PVal::str("name"), PVal::str("actor." + method)},
+            {PVal::str("func_id"), PVal::str("")},
+            {PVal::str("args"), PVal::bytes(packed)},
+            {PVal::str("deps"), PVal::list()},
+            {PVal::str("return_ids"), PVal::list({PVal::str(ret_id)})},
+            {PVal::str("resources"), PVal::dict()},
+            {PVal::str("owner_id"), PVal::str(client_id_)},
+            {PVal::str("max_retries"), PVal::integer(0)},
+            {PVal::str("retries_used"), PVal::integer(0)},
+            {PVal::str("streaming"), PVal::boolean(false)},
+            {PVal::str("scheduling_strategy"), PVal::none()},
+            {PVal::str("runtime_env"), PVal::none()},
+            {PVal::str("actor_id"), PVal::str(actor_id)},
+            {PVal::str("actor_creation"), PVal::boolean(false)},
+            {PVal::str("method_name"), PVal::str(method)},
+            {PVal::str("seq_no"), PVal::integer(seq)},
+            {PVal::str("concurrency_group"), PVal::none()},
+            {PVal::str("borrowed_ids"), PVal::list()},
+        });
+    cast("submit_actor_task", PVal::dict({{PVal::str("spec"), spec}}));
+    return ret_id;
+  }
+
+  void kill_actor(const std::string& actor_id) {
+    call("kill_actor", PVal::dict({
+        {PVal::str("actor_id"), PVal::str(actor_id)},
+        {PVal::str("no_restart"), PVal::boolean(true)},
+    }));
   }
 
   // ---- kv ----
@@ -340,6 +422,7 @@ class RayTpuClient {
   std::condition_variable cv_;
   int64_t next_id_ = 1;  // the server's reply check is `if msg_id:` — 0
                          // reads as a cast and would never get a reply
+  std::map<std::string, int64_t> actor_seq_;  // per-actor call ordering
   std::map<int64_t, PVal> pending_;
   std::map<int64_t, bool> pending_done_;
   std::map<std::string, PVal> waiters_;
@@ -389,9 +472,20 @@ int main(int argc, char** argv) {
                    static_cast<long long>(result.i));
       return 1;
     }
-    std::printf("task ok: add_scaled(20, 11, scale=2) = %lld\n"
-                "NATIVE_CLIENT_OK\n",
+    std::printf("task ok: add_scaled(20, 11, scale=2) = %lld\n",
                 static_cast<long long>(result.i));
+
+    // cross-language actor: Python class by import path
+    std::string actor = client.create_actor(
+        "tests.cross_lang_helpers:Accumulator", {rtpu::PVal::integer(100)});
+    std::string r1 = client.call_actor(actor, "add", {rtpu::PVal::integer(7)});
+    std::string r2 = client.call_actor(actor, "add", {rtpu::PVal::integer(5)});
+    if (client.get(r1, 60.0).i != 107 || client.get(r2, 60.0).i != 112) {
+      std::fprintf(stderr, "actor results wrong\n");
+      return 1;
+    }
+    client.kill_actor(actor);
+    std::printf("actor ok: 100 +7 +5 = 112\nNATIVE_CLIENT_OK\n");
     return 0;
   } catch (const std::exception& e) {
     std::fprintf(stderr, "rtpu-client: %s\n", e.what());

@@ -9,3 +9,14 @@ def add_scaled(a, b, scale=1):
 
 def echo(x):
     return x
+
+
+class Accumulator:
+    """Cross-language actor target (created by class import path)."""
+
+    def __init__(self, start=0):
+        self.total = start
+
+    def add(self, x):
+        self.total += x
+        return self.total
