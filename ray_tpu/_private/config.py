@@ -37,6 +37,12 @@ class Config:
     # control plane inline rather than shm (reference analogue:
     # max_direct_call_object_size in ray_config_def.h).
     max_inline_object_size: int = 100 * 1024
+    # Zero-copy ray_tpu.get for shm objects (reference: plasma's
+    # read-only mmap'd numpy views): arrays alias the store buffer and
+    # the read pin holds until they die. Disabled, get() copies out and
+    # releases the pin immediately (arrays are read-only either way —
+    # the copy path is bytes-backed).
+    zero_copy_get: bool = True
     object_spilling_dir: str = ""
     # Backend selection JSON (reference: RAY_object_spilling_config):
     # {"type": "filesystem"|"smart_open", "params": {...}}

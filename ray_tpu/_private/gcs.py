@@ -62,7 +62,7 @@ class ObjectEntry:
         "object_id", "state", "offset", "size", "inline", "spill_path",
         "refcount", "read_pins", "task_pins", "lru", "is_error", "owner_id",
         "created_at", "location", "remote_offset", "borrowers",
-        "container_pins", "contained",
+        "container_pins", "contained", "pin_holders",
     )
 
     def __init__(self, object_id: str, owner_id: str):
@@ -74,6 +74,10 @@ class ObjectEntry:
         self.spill_path: str | None = None
         self.refcount = 0
         self.read_pins = 0
+        # read_pins by holder client (zero-copy gets hold pins for the
+        # life of the aliasing arrays, so a crashed client's pins must
+        # be reaped on disconnect or the object could never spill/free).
+        self.pin_holders: dict[str, int] = {}
         self.task_pins = 0
         self.lru = 0
         self.is_error = False
@@ -537,6 +541,12 @@ class Head:
             affected = []
             for e in self.objects.values():
                 changed = False
+                held = e.pin_holders.pop(client_id, 0)
+                if held:
+                    # Reap the dead client's read pins (zero-copy gets
+                    # hold them until arrays die — which never comes).
+                    e.read_pins = max(0, e.read_pins - held)
+                    changed = True
                 if client_id in e.borrowers:
                     e.borrowers.discard(client_id)
                     changed = True
@@ -688,9 +698,23 @@ class Head:
         with self.lock:
             offset = self._alloc_with_spill(size)
             if offset is None:
+                pinned = sum(
+                    e.size for e in self.objects.values()
+                    if e.read_pins > 0 and e.offset is not None)
+                hint = ""
+                if pinned:
+                    # Zero-copy gets hold read pins for the life of
+                    # their aliasing arrays, and pinned objects cannot
+                    # spill (reference: plasma pinned-buffer semantics).
+                    hint = (
+                        f"; {pinned} bytes are read-pinned by live "
+                        f"zero-copy arrays — drop them, copy out, or "
+                        f"disable zero_copy_get"
+                    )
                 raise rpc.RpcError(
                     f"ObjectStoreFullError: cannot allocate {size} bytes "
-                    f"(in use {self.arena.in_use}/{self.arena.capacity})"
+                    f"(in use {self.arena.in_use}/{self.arena.capacity}"
+                    f"{hint})"
                 )
             entry = self.objects.get(object_id) or ObjectEntry(object_id, owner)
             if entry.offset is not None:
@@ -842,7 +866,8 @@ class Head:
         e = self.objects.get(object_id)
         return e is not None and e.state in (SEALED, SPILLED)
 
-    def _meta_for(self, entry: ObjectEntry, remote: bool = False) -> tuple:
+    def _meta_for(self, entry: ObjectEntry, remote: bool = False,
+                  client_id: "str | None" = None) -> tuple:
         if entry.inline is not None:
             return ("inline", entry.inline, entry.is_error)
         if entry.state == SPILLED:
@@ -859,6 +884,9 @@ class Head:
                 # metas: the free_object cast to the agent must not fire
                 # mid-pull (client sends read_done when finished).
                 entry.read_pins += 1
+                if client_id:
+                    entry.pin_holders[client_id] = (
+                        entry.pin_holders.get(client_id, 0) + 1)
                 return ("p2p", entry.object_id, entry.location,
                         self.node_transfer_addrs.get(entry.location),
                         entry.remote_offset, entry.size, entry.is_error)
@@ -871,6 +899,9 @@ class Head:
                     entry.is_error,
                 )
             entry.read_pins += 1
+            if client_id:
+                entry.pin_holders[client_id] = (
+                    entry.pin_holders.get(client_id, 0) + 1)
             return ("shm", entry.offset, entry.size, entry.is_error)
         return ("lost", f"object {entry.object_id} is {entry.state}", False)
 
@@ -883,7 +914,9 @@ class Head:
             if entry is None:
                 metas[oid] = ("lost", f"object {oid} unknown (freed?)", False)
             else:
-                metas[oid] = self._meta_for(entry, remote=remote)
+                metas[oid] = self._meta_for(
+                    entry, remote=remote,
+                    client_id=conn.peer_info.get("client_id"))
         # The cast happens OFF the head lock path: for remote clients the
         # metas embed full payloads, and a blocking sendall to a slow peer
         # under self.lock would freeze all scheduling.
@@ -917,11 +950,16 @@ class Head:
         return None
 
     def _h_read_done(self, body: dict, conn):
+        client_id = conn.peer_info.get("client_id")
         with self.lock:
             for oid in body["ids"]:
                 e = self.objects.get(oid)
                 if e is not None and e.read_pins > 0:
                     e.read_pins -= 1
+                    if client_id and e.pin_holders.get(client_id):
+                        e.pin_holders[client_id] -= 1
+                        if not e.pin_holders[client_id]:
+                            del e.pin_holders[client_id]
                     if e.refcount <= 0:
                         self._maybe_free(e)
         return None

@@ -43,6 +43,57 @@ _ERROR_KINDS = {
 }
 
 
+class _ShmReadPin:
+    """One zero-copy read's deferred release. Each out-of-band buffer is
+    wrapped in a weakref-able uint8 array; the reconstructed user arrays
+    hold those wrappers through their .base chains, so a finalizer per
+    wrapper counts down exactly when the last aliasing array dies — at
+    zero the store views are released and the head's read pin dropped.
+    Buffers that pickle COPIES from (bytes/bytearray payloads) drop
+    their wrapper at the first gc after loads, releasing promptly."""
+
+    __slots__ = ("hex_id", "runtime", "outstanding", "lock", "views",
+                 "released")
+
+    def __init__(self, hex_id: str, runtime, views):
+        self.hex_id = hex_id
+        self.runtime = runtime
+        self.outstanding = 0
+        self.lock = threading.Lock()
+        self.views = views
+        self.released = False
+
+    def track(self, n: int) -> None:
+        self.outstanding = n
+
+    def dec(self) -> None:
+        with self.lock:
+            self.outstanding -= 1
+            if self.outstanding > 0 or self.released:
+                return
+            self.released = True
+        self._release_views_and_pin()
+
+    def release_now(self) -> None:
+        """Immediate release (no-buffer and error paths)."""
+        with self.lock:
+            if self.released:
+                return
+            self.released = True
+        self._release_views_and_pin()
+
+    def _release_views_and_pin(self) -> None:
+        for v in self.views:
+            try:
+                v.release()
+            except BufferError:
+                pass
+        try:
+            self.runtime.conn.cast("read_done", {"ids": [self.hex_id]})
+        except Exception:
+            pass  # connection gone: the head reaps pins with the client
+
+
 class CoreRuntime:
     def __init__(
         self,
@@ -536,15 +587,20 @@ class CoreRuntime:
             return self._deserialize(meta[1], meta[2])
         if meta[0] == "shm":
             _, offset, size, is_error = meta
-            read_ids.append(hex_id)
             view = self.shm.view(offset, size)
-            try:
-                # Copy out of shm before releasing the read pin so the
-                # head may spill/evict afterwards. (Zero-copy pinned
-                # reads are a planned optimization.)
-                return self._deserialize(bytes(view), is_error)
-            finally:
-                view.release()
+            if is_error or not GLOBAL_CONFIG.zero_copy_get:
+                read_ids.append(hex_id)
+                try:
+                    return self._deserialize(bytes(view), is_error)
+                finally:
+                    view.release()
+            # Zero-copy read (reference: plasma's read-only mmap'd numpy
+            # views): arrays alias the store buffer through a READ-ONLY
+            # view; the head-side read pin is held until every aliasing
+            # array is gone (deferred release, _ShmReadPin), so spilling
+            # or eviction can never pull the mapping out from under live
+            # arrays. NOT appended to read_ids — the pin owns release.
+            return self._read_shm_zero_copy(hex_id, view)
         if meta[0] == "p2p":
             read_ids.append(hex_id)  # p2p metas are read-pinned too
             return self._read_p2p_retrying(hex_id, meta, read_ids)
@@ -656,6 +712,39 @@ class CoreRuntime:
                 f"object {object_id} lives on node {node_id} with no "
                 f"reachable transfer server")
         return self._pull_p2p(object_id, addr, size), is_error
+
+    def _read_shm_zero_copy(self, hex_id: str, view) -> Any:
+        """Deserialize directly out of the store mapping; see
+        _ShmReadPin for the lifetime machinery."""
+        import weakref
+
+        ro = view.toreadonly()
+        pin = _ShmReadPin(hex_id, self, (ro, view))
+        wrappers = []
+
+        def wrap(mv):
+            # Lazy numpy: reached only for out-of-band buffers (tensor
+            # payloads); pure-Python objects never import it.
+            import numpy as _np
+
+            holder = _np.frombuffer(mv, dtype=_np.uint8)
+            wrappers.append(holder)
+            return holder
+
+        try:
+            value = serialization.loads_from(ro, wrap_buffer=wrap)
+        except BaseException:
+            wrappers.clear()
+            pin.release_now()
+            raise
+        if not wrappers:
+            # No out-of-band buffers: nothing aliases the store.
+            pin.release_now()
+            return value
+        pin.track(len(wrappers))
+        for holder in wrappers:
+            weakref.finalize(holder, pin.dec)
+        return value
 
     def _deserialize(self, payload: bytes, is_error: bool) -> Any:
         value = serialization.loads(payload)

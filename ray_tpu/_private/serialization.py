@@ -165,8 +165,12 @@ def dumps(obj: Any) -> bytes:
     return bytes(out)
 
 
-def loads_from(view: memoryview) -> Any:
-    """Deserializes from a view; numpy arrays are zero-copy into the view."""
+def loads_from(view: memoryview, *, wrap_buffer=None) -> Any:
+    """Deserializes from a view; numpy arrays are zero-copy into the view.
+
+    ``wrap_buffer(mv) -> buffer`` intercepts each out-of-band buffer
+    slice before pickle consumes it — the zero-copy read path wraps
+    slices in weakref-able holders to track aliasing-array lifetime."""
     magic, hlen = struct.unpack_from("<IQ", view, 0)
     if magic != MAGIC:
         raise ValueError("corrupt object payload")
@@ -179,7 +183,10 @@ def loads_from(view: memoryview) -> Any:
     for _ in range(nbuf):
         off, blen = struct.unpack_from("<QQ", view, pos)
         pos += 16
-        bufs.append(view[off : off + blen])
+        b = view[off : off + blen]
+        if wrap_buffer is not None:
+            b = wrap_buffer(b)
+        bufs.append(b)
     return pickle.loads(header, buffers=bufs)
 
 

@@ -125,8 +125,14 @@ class ShmClient:
 
     def close(self) -> None:
         if self._mm is not None:
-            self._view.release()
-            self._mm.close()
+            try:
+                self._view.release()
+                self._mm.close()
+            except BufferError:
+                # Zero-copy arrays from get() may legitimately outlive
+                # the runtime; their buffer exports keep the mapping
+                # alive until they are GC'd (process teardown unmaps).
+                pass
             self._mm = None
 
     def __del__(self):  # pragma: no cover

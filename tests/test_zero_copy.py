@@ -1,0 +1,112 @@
+"""Zero-copy shm reads (reference: plasma's read-only mmap'd numpy
+views): ray_tpu.get of a big numpy object returns arrays aliasing the
+store buffer; the head-side read pin holds until the arrays die."""
+
+import gc
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def cluster():
+    ray_tpu.init(num_cpus=2, object_store_memory=64 * 1024 * 1024)
+    yield
+    ray_tpu.shutdown()
+
+
+def _entry(hex_id):
+    from ray_tpu._private.worker_context import get_head
+
+    return get_head().objects.get(hex_id)
+
+
+def test_get_returns_readonly_view(cluster):
+    arr = np.arange(200_000, dtype=np.float64)
+    ref = ray_tpu.put(arr)
+    got = ray_tpu.get(ref)
+    assert np.array_equal(got, arr)
+    assert not got.flags.writeable  # aliases the store: read-only
+    with pytest.raises((ValueError, RuntimeError)):
+        got[0] = 1.0
+
+
+def test_pin_released_when_array_dies(cluster):
+    ref = ray_tpu.put(np.ones(150_000))
+    got = ray_tpu.get(ref)
+    e = _entry(ref.hex())
+    assert e is not None and e.read_pins >= 1
+    del got
+    gc.collect()
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        if _entry(ref.hex()).read_pins == 0:
+            break
+        time.sleep(0.05)
+    assert _entry(ref.hex()).read_pins == 0
+
+
+def test_nested_and_multiple_arrays_share_one_pin(cluster):
+    val = {"a": np.ones(120_000), "b": np.zeros(120_000)}
+    ref = ray_tpu.put(val)
+    got = ray_tpu.get(ref)
+    a = got["a"]
+    del got
+    gc.collect()
+    # one array still alive -> pin must hold
+    time.sleep(0.3)
+    assert _entry(ref.hex()).read_pins >= 1
+    assert float(a.sum()) == 120_000.0  # buffer still mapped + valid
+    del a
+    gc.collect()
+    deadline = time.time() + 5
+    while time.time() < deadline and _entry(ref.hex()).read_pins:
+        time.sleep(0.05)
+    assert _entry(ref.hex()).read_pins == 0
+
+
+def test_non_array_shm_values_release_immediately(cluster):
+    big = "x" * 500_000  # shm-sized but no buffer-backed leaves
+    ref = ray_tpu.put(big)
+    got = ray_tpu.get(ref)
+    assert got == big
+    deadline = time.time() + 5
+    while time.time() < deadline and _entry(ref.hex()).read_pins:
+        time.sleep(0.05)
+    assert _entry(ref.hex()).read_pins == 0
+
+
+def test_zero_copy_disabled_releases_immediately(cluster):
+    """Kill switch: the copy path releases the read pin during get, even
+    while the returned (copied) array stays alive."""
+    from ray_tpu._private.config import GLOBAL_CONFIG
+
+    old = GLOBAL_CONFIG.zero_copy_get
+    GLOBAL_CONFIG.zero_copy_get = False
+    try:
+        ref = ray_tpu.put(np.ones(150_000))
+        got = ray_tpu.get(ref)
+        deadline = time.time() + 5
+        while time.time() < deadline and _entry(ref.hex()).read_pins:
+            time.sleep(0.05)
+        assert _entry(ref.hex()).read_pins == 0
+        assert float(got.sum()) == 150_000.0  # the copy is intact
+    finally:
+        GLOBAL_CONFIG.zero_copy_get = old
+
+
+def test_task_results_roundtrip_through_zero_copy(cluster):
+    @ray_tpu.remote
+    def produce():
+        return np.arange(300_000, dtype=np.float32)
+
+    @ray_tpu.remote
+    def consume(a):
+        return float(a.sum())
+
+    ref = produce.remote()
+    assert ray_tpu.get(consume.remote(ref)) == float(
+        np.arange(300_000, dtype=np.float32).sum())
