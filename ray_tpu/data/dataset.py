@@ -498,6 +498,37 @@ class GroupedData:
     def max(self, cols: list[str] | str | None = None) -> Dataset:
         return self._agg(np.max, [cols] if isinstance(cols, str) else cols)
 
+    def std(self, cols: list[str] | str | None = None,
+            ddof: int = 1) -> Dataset:
+        return self._agg(lambda v: np.std(v, ddof=ddof) if len(v) > ddof
+                         else 0.0,
+                         [cols] if isinstance(cols, str) else cols)
+
+    def aggregate(self, **named_aggs: "tuple[str, Callable]") -> Dataset:
+        """Generic multi-aggregate (reference: grouped_data.py
+        GroupedData.aggregate with AggregateFn): each kwarg maps an
+        output column to ``(input_column, fn)`` where fn reduces the
+        group's numpy column to a scalar.
+
+            ds.groupby("k").aggregate(total=("v", np.sum),
+                                      biggest=("v", np.max))
+        """
+        if self._key in named_aggs:
+            raise ValueError(
+                f"aggregate: output column {self._key!r} would overwrite "
+                f"the group key")
+        rows = []
+        for key_val, group in self._groups():
+            row = {self._key: key_val}
+            for out_col, (in_col, fn) in named_aggs.items():
+                if in_col not in group:
+                    raise KeyError(
+                        f"aggregate: column {in_col!r} not in dataset "
+                        f"(have {sorted(group)})")
+                row[out_col] = fn(group[in_col])
+            rows.append(row)
+        return from_items(rows)
+
     def map_groups(self, fn: Callable) -> Dataset:
         out_blocks = []
         for _, group in self._groups():

@@ -113,6 +113,29 @@ def test_groupby():
     assert maxes == {"a": 3, "b": 10}
 
 
+def test_groupby_std_and_aggregate():
+    import numpy as np
+
+    ds = rd.from_items(
+        [{"k": "a", "v": 1}, {"k": "a", "v": 3}, {"k": "a", "v": 5},
+         {"k": "b", "v": 10}]
+    )
+    stds = {r["k"]: r["v"] for r in ds.groupby("k").std("v").take_all()}
+    assert abs(stds["a"] - 2.0) < 1e-9  # std([1,3,5], ddof=1) = 2
+    assert stds["b"] == 0.0  # single element: defined as 0
+    rows = ds.groupby("k").aggregate(
+        total=("v", np.sum), spread=("v", lambda v: v.max() - v.min()),
+    ).take_all()
+    agg = {r["k"]: (r["total"], r["spread"]) for r in rows}
+    assert agg == {"a": (9, 4), "b": (10, 0)}
+    import pytest as _pytest
+
+    with _pytest.raises(KeyError, match="nope"):
+        ds.groupby("k").aggregate(x=("nope", np.sum)).take_all()
+    with _pytest.raises(ValueError, match="group key"):
+        ds.groupby("k").aggregate(k=("v", np.sum))
+
+
 def test_class_udf_map_batches():
     class AddConst:
         def __init__(self, c):
