@@ -118,6 +118,76 @@ class LLMServer:
             return await self.completions(payload)
         return self.models()
 
+    async def stream_events(self, payload: Any = None):
+        """OpenAI streaming protocol handler (``"stream": true``): an
+        async generator of chunk objects, terminated by the literal
+        "[DONE]" sentinel (the proxy emits it unquoted). Routed here by
+        the HTTP proxy for SSE requests — __call__ stays the plain JSON
+        path."""
+        payload = payload if isinstance(payload, dict) else {}
+        is_chat = "messages" in payload
+        if not is_chat and "prompt" not in payload:
+            yield self.models()
+            return
+        if int(payload.get("n", 1)) > 1 or payload.get("best_of"):
+            raise ValueError("streaming supports n=1 without best_of")
+        sp = self._sampling(payload)
+        prompt = (self._render_chat(payload["messages"]) if is_chat
+                  else payload["prompt"])
+        if isinstance(prompt, list) and prompt and not all(
+                isinstance(t, int) for t in prompt):
+            raise ValueError("streaming supports a single prompt")
+        rid = f"{'chatcmpl' if is_chat else 'cmpl'}-{uuid.uuid4().hex[:24]}"
+        created = int(time.time())
+        base = {
+            "id": rid,
+            "object": ("chat.completion.chunk" if is_chat
+                       else "text_completion"),
+            "created": created,
+            "model": self.config.model_id,
+        }
+        if is_chat:
+            yield {**base, "choices": [{
+                "index": 0, "delta": {"role": "assistant", "content": ""},
+                "finish_reason": None}]}
+        toks: list[int] = []
+        emitted = 0  # chars of decoded text already sent
+        aiter = await self.async_engine.generate(prompt, sp, stream=True)
+        out = None
+        async for item in aiter:
+            if not isinstance(item, int):
+                out = item  # terminal RequestOutput
+                break
+            toks.append(item)
+            # Incremental detokenization: decode the full sequence and
+            # emit the stable new suffix (BPE merges can rewrite the
+            # tail, so never emit per-token decodes blindly).
+            text = self.engine.tokenizer.decode(toks)
+            piece, emitted = text[emitted:], len(text)
+            if not piece:
+                continue
+            if is_chat:
+                yield {**base, "choices": [{
+                    "index": 0, "delta": {"content": piece},
+                    "finish_reason": None}]}
+            else:
+                yield {**base, "choices": [{
+                    "index": 0, "text": piece, "finish_reason": None}]}
+        # Trailing text the finishing step produced (stop-string
+        # trimming may also SHORTEN the final text — re-emit nothing in
+        # that case, but always close with the finish_reason chunk).
+        final_text = out.text if out is not None else ""
+        piece = final_text[emitted:] if len(final_text) > emitted else ""
+        finish = out.finish_reason if out is not None else "stop"
+        if is_chat:
+            yield {**base, "choices": [{
+                "index": 0, "delta": ({"content": piece} if piece else {}),
+                "finish_reason": finish}]}
+        else:
+            yield {**base, "choices": [{
+                "index": 0, "text": piece, "finish_reason": finish}]}
+        yield "[DONE]"
+
     def models(self) -> dict:
         return {
             "object": "list",

@@ -99,13 +99,26 @@ class ServeController:
                 "replicas": [(rid, actor) for rid, actor in st.replicas.items()],
             }
 
-    def get_routes(self) -> dict[str, str]:
+    def get_routes(self) -> dict[str, dict]:
+        """prefix -> {"name", "sse_method"}. ``sse_method`` names an
+        async-generator method the HTTP proxy should dispatch
+        Accept: text/event-stream requests to (e.g. the OpenAI
+        ``stream_events`` protocol handler); None = stream __call__."""
+        import inspect
+
         with self._lock:
             routes = {}
             for st in self._deployments.values():
                 prefix = st.spec.get("route_prefix")
-                if prefix:
-                    routes[prefix] = st.spec["name"]
+                if not prefix:
+                    continue
+                cls = st.spec.get("cls")
+                sse = None
+                if cls is not None and inspect.isasyncgenfunction(
+                        getattr(cls, "stream_events", None)):
+                    sse = "stream_events"
+                routes[prefix] = {"name": st.spec["name"],
+                                  "sse_method": sse}
             return routes
 
     def status(self) -> dict:

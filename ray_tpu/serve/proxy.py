@@ -56,14 +56,19 @@ class HTTPProxy:
     def get_grpc_port(self) -> int:
         return self._grpc.get_port() if self._grpc is not None else -1
 
-    def update_routes(self, routes: dict[str, str]) -> None:
-        """route_prefix -> deployment name (pushed by serve.run/delete).
-        Handles are populated BEFORE the route table swap (requests racing
-        this update must never see a route without a handle), and stale
-        handles are dropped."""
+    def update_routes(self, routes: dict) -> None:
+        """route_prefix -> {"name", "sse_method"} (pushed by
+        serve.run/delete; legacy plain-string values are normalized).
+        Handles are populated BEFORE the route table swap (requests
+        racing this update must never see a route without a handle),
+        and stale handles are dropped."""
+        routes = {prefix: (v if isinstance(v, dict)
+                           else {"name": v, "sse_method": None})
+                  for prefix, v in routes.items()}
         handles = {
-            name: self._handles.get(name) or DeploymentHandle(name)
-            for name in routes.values()
+            v["name"]: self._handles.get(v["name"])
+            or DeploymentHandle(v["name"])
+            for v in routes.values()
         }
         self._handles.update(handles)
         self._routes = dict(routes)
@@ -71,7 +76,8 @@ class HTTPProxy:
             if name not in handles:
                 del self._handles[name]
         if self._grpc is not None:
-            self._grpc.update_routes(routes)
+            self._grpc.update_routes(
+                {prefix: v["name"] for prefix, v in routes.items()})
 
     def ping(self) -> str:
         return "pong"
@@ -83,11 +89,12 @@ class HTTPProxy:
 
         async def handle(request: "web.Request") -> "web.Response":
             path = request.path.rstrip("/") or "/"
-            name = self._match_route(path)
-            if name is None:
+            meta = self._match_route(path)
+            if meta is None:
                 return web.json_response(
                     {"error": f"no route for {path}"}, status=404
                 )
+            name = meta["name"]
             if request.method == "POST":
                 raw = await request.read()
                 try:
@@ -102,11 +109,20 @@ class HTTPProxy:
                 return web.json_response(
                     {"error": f"no route for {path}"}, status=404
                 )
-            if "text/event-stream" in request.headers.get("Accept", ""):
-                # SSE streaming: the deployment method must be a generator;
-                # each yielded item becomes one `data:` event as produced
-                # (reference: streaming responses through the proxy).
-                return await self._stream_sse(web, request, handle_, payload)
+            wants_sse = ("text/event-stream" in request.headers.get("Accept", "")
+                         or (isinstance(payload, dict)
+                             and payload.get("stream") is True
+                             and meta.get("sse_method")))
+            if wants_sse:
+                # SSE streaming: each yielded item becomes one `data:`
+                # event as produced. Deployments declaring a dedicated
+                # async-generator protocol handler (sse_method, e.g.
+                # the OpenAI `stream_events`) get SSE routed there —
+                # their __call__ stays a plain JSON method; otherwise
+                # __call__ itself must be a generator.
+                return await self._stream_sse(
+                    web, request, handle_, payload,
+                    method=meta.get("sse_method"))
             try:
                 # Submit via a SHORT executor hop (routing can hit a
                 # blocking controller refresh ~1/s), then await the
@@ -137,7 +153,8 @@ class HTTPProxy:
         asyncio.set_event_loop(self._loop)
         self._loop.run_until_complete(run())
 
-    async def _stream_sse(self, web, request, handle_, payload):
+    async def _stream_sse(self, web, request, handle_, payload,
+                          method: "str | None" = None):
         """Fully async SSE: submit via a short executor hop, then
         async-iterate the response generator — each item awaits a
         head-pushed readiness notification, so a stream in flight holds
@@ -153,9 +170,16 @@ class HTTPProxy:
         await resp.prepare(request)
         gen = None
         try:
+            opts = {"stream": True}
+            if method:
+                opts["method_name"] = method
             gen = await loop.run_in_executor(
-                None, lambda: handle_.options(stream=True).remote(payload))
+                None, lambda: handle_.options(**opts).remote(payload))
             async for item in gen:
+                if item == "[DONE]":
+                    # OpenAI stream terminator: literal, not JSON.
+                    await resp.write(b"data: [DONE]\n\n")
+                    continue
                 await resp.write(
                     f"data: {json.dumps(item, default=str)}\n\n".encode())
             await resp.write_eof()
@@ -174,13 +198,13 @@ class HTTPProxy:
                 gen.close()
         return resp
 
-    def _match_route(self, path: str) -> str | None:
+    def _match_route(self, path: str) -> "dict | None":
         # Longest-prefix match (reference: proxy route matching).
         best, best_len = None, -1
-        for prefix, name in self._routes.items():
+        for prefix, meta in self._routes.items():
             p = prefix.rstrip("/") or "/"
             if (path == p or path.startswith(p + "/") or p == "/") and len(p) > best_len:
-                best, best_len = name, len(p)
+                best, best_len = meta, len(p)
         return best
 
     @staticmethod
