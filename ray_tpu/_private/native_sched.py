@@ -18,6 +18,9 @@ def _load():
     path = os.path.join(
         os.path.dirname(os.path.dirname(__file__)), "_native", "libsched.so"
     )
+    from ray_tpu._private.native_build import ensure_native
+
+    ensure_native()  # also rebuilds when sources are newer than the .so
     if not os.path.exists(path):
         return None
     try:

@@ -24,9 +24,15 @@ import sys
 
 def _load_lib() -> ctypes.CDLL | None:
     path = os.path.join(os.path.dirname(os.path.dirname(__file__)), "_native", "libobjstore.so")
+    from ray_tpu._private.native_build import ensure_native
+
+    ensure_native()  # also rebuilds when sources are newer than the .so
     if not os.path.exists(path):
         return None
-    lib = ctypes.CDLL(path)
+    try:
+        lib = ctypes.CDLL(path)
+    except OSError:
+        return None  # corrupt/partial artifact — pure-Python fallback
     lib.store_create.restype = ctypes.c_void_p
     lib.store_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
     lib.store_attach.restype = ctypes.c_void_p

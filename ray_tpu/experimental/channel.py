@@ -36,6 +36,9 @@ from ray_tpu._private import serialization
 def _load_lib() -> ctypes.CDLL:
     path = os.path.join(os.path.dirname(os.path.dirname(__file__)),
                         "_native", "libchannel.so")
+    from ray_tpu._private.native_build import ensure_native
+
+    ensure_native()  # also rebuilds when sources are newer than the .so
     if not os.path.exists(path):
         raise RuntimeError(
             "libchannel.so not built; run `make -C src` at the repo root")

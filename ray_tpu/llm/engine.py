@@ -579,6 +579,18 @@ class LLMEngine:
                            if i >= 0), default=-1)
                 if cut >= 0:
                     text = decoded[:cut]
+                    # Keep token_ids/logprobs consistent with the trimmed
+                    # text: retain the shortest token prefix whose decode
+                    # covers the kept text (the last kept token may decode
+                    # to a partial overlap with the stop string).
+                    n = len(req.generated)
+                    while n > 0 and len(
+                            self.tokenizer.decode(req.generated[:n - 1])
+                    ) >= cut:
+                        n -= 1
+                    req.generated = req.generated[:n]
+                    if req.logprobs:
+                        req.logprobs = req.logprobs[:n]
                     reason = "stop"
         if reason is None:
             if len(req.generated) >= req.params.max_tokens:

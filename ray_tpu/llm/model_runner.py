@@ -203,6 +203,10 @@ def filter_top_k_top_p(logits, top_k, top_p):
     probs_sorted = jax.nn.softmax(sorted_desc, axis=-1)
     cum = jnp.cumsum(probs_sorted, axis=-1)
     in_nucleus_sorted = (cum - probs_sorted) < top_p[:, None]
+    # top_p == 0.0 would otherwise produce an empty nucleus (p_thresh =
+    # +inf, every logit masked); always keep the argmax, matching the
+    # host mirror _host_filter's keep_sorted[0] = True.
+    in_nucleus_sorted = in_nucleus_sorted.at[:, 0].set(True)
     # Threshold value = smallest sorted logit still inside the nucleus.
     big = jnp.where(in_nucleus_sorted, sorted_desc, jnp.inf)
     p_thresh = jnp.min(big, axis=-1, keepdims=True)

@@ -99,7 +99,10 @@ class TransformerConfig:
     # head matmul, ~10% of GPT-2 124M's step FLOPs). Both are O(T)
     # memory; eval (no grad) never pays the fused path's extra work
     # because custom_vjp only runs it under differentiation.
-    ce_impl: str = "fused"           # "fused" | "checkpoint"
+    ce_impl: str = "checkpoint"      # "fused" | "checkpoint"
+    # Default stays "checkpoint" (the TPU-measured config) until the
+    # hardware A/B (benchmarks/tpu_ab_queue.py) confirms the fused
+    # chunked-CE backward on the real chip; flip here + bench.py together.
     # Mixture of Experts (llama arch only; 0 = dense FFN). Greenfield vs
     # the reference (SURVEY.md §2.4: EP absent upstream) — see ops/moe.py.
     n_experts: int = 0

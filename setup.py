@@ -1,0 +1,22 @@
+"""Wheel build hook: compile the native C++ components (src/ ->
+ray_tpu/_native/*.so) before packaging, so wheels ship binaries built
+from the checked-in sources rather than committed artifacts (which are
+gitignored — ADVICE r3). Source dists carry src/ via MANIFEST.in and
+rebuild on demand at first use (ray_tpu/_private/native_build.py)."""
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+
+class BuildPyWithNative(build_py):
+    def run(self):
+        import subprocess
+        import os
+
+        src = os.path.join(os.path.dirname(os.path.abspath(__file__)), "src")
+        if os.path.isdir(src):
+            subprocess.run(["make", "-C", src, "-j4"], check=True)
+        super().run()
+
+
+setup(cmdclass={"build_py": BuildPyWithNative})

@@ -61,6 +61,12 @@ def pytest_configure(config):
         f"{jax.devices()}"
     )
 
+    # Native artifacts are not committed (ADVICE r3): build them from
+    # src/ before any test imports a ctypes loader.
+    from ray_tpu._private.native_build import ensure_native
+
+    ensure_native()
+
 
 @pytest.fixture
 def ray_start():

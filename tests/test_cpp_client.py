@@ -28,8 +28,15 @@ def _head_address():
     return global_runtime().address
 
 
-@pytest.mark.skipif(not os.path.exists(DEMO),
-                    reason="native client not built (make -C src)")
+def _demo_built() -> bool:
+    from ray_tpu._private.native_build import ensure_native
+
+    ensure_native()
+    return os.path.exists(DEMO)
+
+
+@pytest.mark.skipif(not _demo_built(),
+                    reason="native client failed to build (make -C src)")
 def test_native_client_roundtrip(cluster):
     host, port = _head_address()
     env = dict(os.environ)

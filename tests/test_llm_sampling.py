@@ -310,3 +310,53 @@ def test_mixed_batch_plain_and_advanced():
         [plain_sp, SamplingParams(max_tokens=8, temperature=1.0, top_k=4,
                                   repetition_penalty=2.0, seed=5)])[0]
     assert solo.token_ids == mixed.token_ids
+
+
+def test_top_p_zero_device_program_is_greedy():
+    """top_p == 0.0 must keep the argmax in the nucleus, not mask the
+    whole vocab and sample uniformly (ADVICE r3: the +inf p_thresh bug).
+    The device filter must agree with the host mirror's
+    keep_sorted[0] = True clamp."""
+    rng = np.random.default_rng(0)
+    logits = rng.normal(size=(4, 64)).astype(np.float32)
+    filtered = model_runner.filter_top_k_top_p(
+        jnp.asarray(logits), jnp.zeros(4, jnp.int32),
+        jnp.zeros(4, jnp.float32))
+    filtered = np.asarray(filtered)
+    # Exactly the per-row argmax survives; everything else is masked.
+    for b in range(4):
+        kept = np.flatnonzero(filtered[b] > -1e29)
+        assert kept.tolist() == [int(logits[b].argmax())]
+
+
+def test_top_p_zero_samples_argmax_not_uniform():
+    """With top_p=0 and temperature>0, sampling must be deterministic
+    greedy regardless of seed (regression: uniform-over-vocab garbage)."""
+    cfg = tiny_config()
+    eng = LLMEngine(cfg)
+    outs = [eng.generate(
+        ["x"], SamplingParams(max_tokens=4, temperature=1.0, top_p=0.0,
+                              seed=s))[0].token_ids for s in (1, 2, 3)]
+    greedy = eng.generate(
+        ["x"], SamplingParams(max_tokens=4, temperature=0.0))[0].token_ids
+    assert outs[0] == outs[1] == outs[2] == greedy
+
+
+def test_stop_string_trims_token_ids_and_logprobs():
+    """Stop-string finish must keep token_ids/logprobs consistent with
+    the trimmed text (ADVICE r3: only the text was cut)."""
+    cfg = tiny_config()
+    eng = LLMEngine(cfg)
+    ref = eng.generate(["q"], SamplingParams(max_tokens=12, logprobs=1))[0]
+    if len(ref.text) < 3:
+        pytest.skip("tiny model emitted too little text to split")
+    stop = ref.text[1:3]
+    out = eng.generate(
+        ["q"], SamplingParams(max_tokens=12, stop=(stop,), logprobs=1))[0]
+    assert out.finish_reason == "stop"
+    assert len(out.logprobs) == len(out.token_ids)
+    decoded = eng.tokenizer.decode(out.token_ids)
+    # kept tokens cover the kept text and nothing decodable beyond the
+    # partial overlap with the stop match
+    assert decoded.startswith(out.text) or out.text.startswith(decoded)
+    assert stop not in out.text
