@@ -1,0 +1,207 @@
+"""Multi-chip perf model: weak-scaling + collective-traffic accounting
+on the virtual device mesh (VERDICT r3 item #7).
+
+For each parallelism axis (dp / fsdp / tp / sp) and mesh size
+1/2/4/8, this measures, hermetically on the CPU-device mesh:
+  - steady-state step wall time (median of 3 after compile+warmup)
+  - bytes moved by each collective kind per step, extracted from the
+    compiled HLO (all-reduce / all-gather / reduce-scatter /
+    collective-permute / all-to-all output shapes)
+
+This is the CPU-mesh stand-in for a real pod profile (the rig has one
+chip): step-time RATIOS across mesh sizes and the per-step collective
+byte counts are topology facts the real TPU inherits — absolute
+milliseconds are not. Reference analogue: the per-axis scaling tables
+the reference derives from its release benchmarks
+(release/benchmarks/README.md; SURVEY.md §6 north-star configs).
+
+Run:  python benchmarks/mesh_model.py          # writes MESH.json
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+AXES = ("dp", "fsdp", "tp", "sp")
+SIZES = (1, 2, 4, 8)
+
+_DTYPE_BYTES = {"f32": 4, "bf16": 2, "f16": 2, "f64": 8, "s32": 4,
+                "u32": 4, "s8": 1, "u8": 1, "pred": 1, "s64": 8, "u64": 8}
+
+_COLLECTIVES = ("all-reduce", "all-gather", "reduce-scatter",
+                "collective-permute", "all-to-all")
+
+
+def collective_bytes(hlo_text: str) -> dict:
+    """Sum output-shape bytes per collective op kind in an HLO dump."""
+    out = {k: 0 for k in _COLLECTIVES}
+    pat = re.compile(
+        r"=\s+(?:\(([^)]*)\)|(\w+)\[([\d,]*)\][^ ]*)\s+"
+        r"(all-reduce|all-gather|reduce-scatter|collective-permute|"
+        r"all-to-all)(?:-start)?\(")
+    shape_pat = re.compile(r"(\w+)\[([\d,]*)\]")
+
+    def shape_bytes(dtype: str, dims: str) -> int:
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        return n * _DTYPE_BYTES.get(dtype, 4)
+
+    for m in pat.finditer(hlo_text):
+        tuple_part, dtype, dims, kind = m.groups()
+        total = 0
+        if tuple_part is not None:
+            for sm in shape_pat.finditer(tuple_part):
+                total += shape_bytes(sm.group(1), sm.group(2))
+        else:
+            total = shape_bytes(dtype, dims)
+        out[kind] += total
+    return {k: v for k, v in out.items() if v}
+
+
+def _measure_inner(axis: str, n: int) -> dict:
+    """Runs inside the hermetic n-device subprocess."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from ray_tpu import models
+    from ray_tpu.parallel.mesh import MeshConfig
+    from ray_tpu.parallel.sharding import infer_param_specs, make_shardings
+
+    devices = jax.devices()[:n]
+    cfg = models.TransformerConfig(
+        vocab_size=1024, max_seq_len=256, n_layers=2, n_heads=8,
+        d_model=128, dtype="float32", remat=False, scan_layers=False)
+
+    opt = optax.adamw(1e-3)
+    per_dev_rows = 4
+    seq = 128
+
+    if axis == "dp":
+        mesh = MeshConfig(data=-1).build(devices)
+        rows = per_dev_rows * n                       # weak scaling
+    elif axis == "fsdp":
+        mesh = MeshConfig(data=1, fsdp=-1).build(devices)
+        rows = per_dev_rows * n
+    elif axis == "tp":
+        mesh = MeshConfig(data=1, tensor=-1).build(devices)
+        rows = per_dev_rows                           # fixed problem
+    elif axis == "sp":
+        # Ring attention: per-device sequence constant, global grows.
+        from ray_tpu.ops.ring_attention import ring_attention_sharded
+
+        smesh = MeshConfig(data=1, sequence=-1).build(devices)
+        b, h, d = 2, 4, 32
+        t = 256 * n
+        ks = jax.random.split(jax.random.PRNGKey(2), 3)
+        q, k, v = (jax.random.normal(kk, (b, t, h, d), jnp.float32)
+                   for kk in ks)
+        fn = jax.jit(lambda q, k, v: ring_attention_sharded(q, k, v, smesh))
+        lowered = fn.lower(q, k, v)
+        compiled = lowered.compile()
+        hlo = compiled.as_text()
+        out = fn(q, k, v)
+        out.block_until_ready()
+        times = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            fn(q, k, v).block_until_ready()
+            times.append(time.perf_counter() - t0)
+        return {"step_ms": round(sorted(times)[1] * 1e3, 2),
+                "global_seq": t,
+                "collective_bytes": collective_bytes(hlo)}
+    else:
+        raise ValueError(axis)
+
+    state = models.init_train_state(jax.random.PRNGKey(0), cfg, opt)
+    specs = infer_param_specs(state["params"], mesh,
+                              models.partition_specs(cfg))
+    state["params"] = jax.tree.map(jax.device_put, state["params"],
+                                   make_shardings(mesh, specs))
+    step = jax.jit(models.make_train_step(cfg, opt, mesh=mesh),
+                   donate_argnums=(0,))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (rows, seq + 1), 0,
+                                cfg.vocab_size)
+    batch = {"tokens": tokens}
+    hlo = step.lower(state, batch).compile().as_text()
+    state, m = step(state, batch)
+    assert np.isfinite(float(m["loss"]))
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        state, m = step(state, batch)
+        float(m["loss"])
+        times.append(time.perf_counter() - t0)
+    return {"step_ms": round(sorted(times)[1] * 1e3, 2),
+            "global_batch_rows": rows,
+            "collective_bytes": collective_bytes(hlo)}
+
+
+def measure(axis: str, n: int, timeout_s: float = 600) -> dict:
+    """Fork a hermetic n-device CPU subprocess for one (axis, size)."""
+    from ray_tpu._private.hermetic import hermetic_cpu_env
+
+    env = hermetic_cpu_env(n)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    code = (f"import sys; sys.path.insert(0, {REPO!r});\n"
+            f"from benchmarks.mesh_model import _measure_inner\n"
+            f"import json\n"
+            f"print('RESULT ' + json.dumps(_measure_inner({axis!r}, {n})))")
+    p = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True, timeout=timeout_s)
+    line = next((ln for ln in reversed(p.stdout.splitlines())
+                 if ln.startswith("RESULT ")), None)
+    if line is None:
+        return {"error": f"rc={p.returncode}: {p.stderr[-400:]}"}
+    return json.loads(line[7:])
+
+
+def main() -> None:
+    results: dict = {"device_kind": "cpu-virtual", "note":
+                     "step-time ratios + collective bytes are the "
+                     "model; absolute ms are CPU-mesh artifacts"}
+    for axis in AXES:
+        results[axis] = {}
+        for n in SIZES:
+            if axis == "sp" and n == 1:
+                continue  # ring needs >= 2 shards to mean anything
+            r = measure(axis, n)
+            results[axis][str(n)] = r
+            print(f"{axis} x{n}: {json.dumps(r)}", flush=True)
+        # Efficiency, normalized for the TIME-SHARED mesh: all N virtual
+        # devices run on one physical core, so ideal step time grows
+        # with the axis's total work (dp/fsdp weak scaling: x n;
+        # tp fixed problem: x 1; sp ring attention: global T = n*T0 so
+        # total flops ~ n^2). eff = base_ms * work(n)/work(base) /
+        # step_ms; 1.0 = no parallelization overhead beyond the work
+        # growth, <1 = collective/partition overhead.
+        work = {"dp": lambda n: n, "fsdp": lambda n: n,
+                "tp": lambda n: 1, "sp": lambda n: n * n}[axis]
+        base_key = min(results[axis], key=int)
+        base = results[axis][base_key].get("step_ms")
+        if base:
+            bn = int(base_key)
+            for k, r in results[axis].items():
+                if r.get("step_ms"):
+                    results[axis][k]["timeshared_eff"] = round(
+                        base * work(int(k)) / work(bn) / r["step_ms"], 3)
+    with open(os.path.join(REPO, "MESH.json"), "w") as f:
+        f.write(json.dumps(results) + "\n")
+    print(json.dumps(results))
+
+
+if __name__ == "__main__":
+    main()

@@ -31,7 +31,7 @@ PROFILES = {
         "actors": 8,
     },
     "full": {
-        "queued_tasks": 100_000,
+        "queued_tasks": 1_000_000,
         "get_refs": 1000,
         "fanout_args": 1000,
         "broadcast_mb": 256,
@@ -155,6 +155,16 @@ def _run_sections(p: dict, results: dict) -> dict:
         @ray_tpu.remote
         def crc(arr):
             return float(arr[:1024].sum())
+
+        # Warm the per-node workers first (python process spawn is
+        # seconds; the row measures TRANSFER, like the reference's
+        # warm-cluster broadcast test, release/benchmarks/README.md:18).
+        ray_tpu.get(
+            [crc.options(resources={f"bnode{i}": 1}).remote(
+                ray_tpu.put(np.zeros(8)))
+             for i in range(len(agents))],
+            timeout=600,
+        )
 
         t0 = time.time()
         checks = ray_tpu.get(

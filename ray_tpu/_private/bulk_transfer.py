@@ -1,0 +1,193 @@
+"""Raw-socket bulk object transfer plane.
+
+Counterpart of the reference's chunked object push/pull
+(reference: src/ray/object_manager/push_manager.h:32,
+pull_manager.h:57 — 64 MiB chunks streamed over dedicated gRPC
+channels, separate from the control plane). The control-plane rpc layer
+pickles every frame — fine for metadata, but a 256 MiB payload would
+cross ~5 extra buffer copies (arena→bytes→pickle→frame join→recv
+join→unpickle). This plane speaks a minimal binary protocol instead:
+
+    request:  [u32 len][pickled {"object_id", "start", "length"}]
+    response: [i64 n][n raw bytes]     (n < 0: error; -n-byte message)
+
+The server writes straight from an arena memoryview (sendall accepts
+buffers — no copy) and the client ``recv_into``s a caller-provided
+buffer — one copy end to end. Multiple stripes of one object are pulled
+over parallel connections (reference: push_manager parallel chunk
+streams), which overlaps the copy with the network and multiplies
+throughput across relays.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+from typing import Callable
+
+_REQ_HDR = struct.Struct("<I")
+_RSP_HDR = struct.Struct("<q")
+
+
+class BulkServer:
+    """Serves raw object-byte reads.
+
+    ``reader(object_id, start, length)`` returns a releasable
+    (memoryview, release_fn) pair or raises; the lock discipline (pin
+    the region while sending) belongs to the caller-provided reader.
+    """
+
+    def __init__(self, reader: Callable, host: str = "0.0.0.0",
+                 port: int = 0):
+        self._reader = reader
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(128)
+        self.address = self._sock.getsockname()
+        self._stopped = threading.Event()
+        threading.Thread(target=self._accept_loop, daemon=True,
+                         name="bulk-accept").start()
+
+    def _accept_loop(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                sock, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(sock,), daemon=True,
+                             name="bulk-serve").start()
+
+    def _serve(self, sock: socket.socket) -> None:
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        try:
+            while True:
+                hdr = _recv_exact(sock, _REQ_HDR.size)
+                if hdr is None:
+                    return
+                body = _recv_exact(sock, _REQ_HDR.unpack(hdr)[0])
+                if body is None:
+                    return
+                req = pickle.loads(body)
+                try:
+                    view, release = self._reader(
+                        req["object_id"], req["start"], req["length"])
+                except Exception as e:  # noqa: BLE001 — error crosses wire
+                    msg = repr(e).encode()
+                    sock.sendall(_RSP_HDR.pack(-len(msg)) + msg)
+                    continue
+                try:
+                    sock.sendall(_RSP_HDR.pack(len(view)))
+                    sock.sendall(view)  # straight from the arena mapping
+                finally:
+                    release()
+        except OSError:
+            pass
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def stop(self) -> None:
+        self._stopped.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def _recv_exact(sock: socket.socket, n: int) -> "bytes | None":
+    chunks = []
+    while n:
+        try:
+            c = sock.recv(min(n, 1 << 20))
+        except OSError:
+            return None
+        if not c:
+            return None
+        chunks.append(c)
+        n -= len(c)
+    return b"".join(chunks)
+
+
+def _recv_into_exact(sock: socket.socket, view: memoryview) -> bool:
+    pos, n = 0, len(view)
+    while pos < n:
+        try:
+            got = sock.recv_into(view[pos:], n - pos)
+        except OSError:
+            return False
+        if got == 0:
+            return False
+        pos += got
+    return True
+
+
+class BulkError(Exception):
+    pass
+
+
+def pull_into(addr: tuple, object_id: str, buf: memoryview, start: int,
+              length: int, sock: "socket.socket | None" = None):
+    """Pull [start, start+length) of an object into ``buf`` (which must
+    be exactly ``length`` long). Returns the socket for reuse."""
+    if sock is None:
+        sock = socket.create_connection(addr, timeout=60)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    req = pickle.dumps({"object_id": object_id, "start": start,
+                        "length": length})
+    sock.sendall(_REQ_HDR.pack(len(req)) + req)
+    hdr = _recv_exact(sock, _RSP_HDR.size)
+    if hdr is None:
+        raise BulkError(f"bulk source {addr} closed mid-pull")
+    n = _RSP_HDR.unpack(hdr)[0]
+    if n < 0:
+        msg = _recv_exact(sock, -n) or b"?"
+        raise BulkError(msg.decode(errors="replace"))
+    if n != length:
+        raise BulkError(f"source returned {n} bytes, wanted {length}")
+    if not _recv_into_exact(sock, buf):
+        raise BulkError(f"bulk source {addr} closed mid-payload")
+    return sock
+
+
+def pull_object(addr: tuple, object_id: str, size: int,
+                streams: int = 4, stripe_min: int = 8 << 20) -> bytearray:
+    """Pull a whole object with up to ``streams`` parallel stripe
+    connections (one connection when the object is small)."""
+    out = bytearray(size)
+    mv = memoryview(out)
+    n_streams = max(1, min(streams, size // stripe_min))
+    if n_streams == 1:
+        sock = pull_into(addr, object_id, mv, 0, size)
+        try:
+            sock.close()
+        except OSError:
+            pass
+        return out
+    stripe = (size + n_streams - 1) // n_streams
+    errors: list = []
+
+    def _one(i: int) -> None:
+        s, e = i * stripe, min((i + 1) * stripe, size)
+        try:
+            sock = pull_into(addr, object_id, mv[s:e], s, e - s)
+            try:
+                sock.close()
+            except OSError:
+                pass
+        except Exception as exc:  # noqa: BLE001 — reraised below
+            errors.append(exc)
+
+    threads = [threading.Thread(target=_one, args=(i,), daemon=True)
+               for i in range(n_streams)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errors:
+        raise errors[0]
+    return out

@@ -70,11 +70,25 @@ class Config:
     # push/pull, push_manager.h:32 / pull_manager.h:57) ---
     agent_object_store_memory: int = 256 * 1024 * 1024
     p2p_chunk_size: int = 4 * 1024 * 1024
+    # Bulk transfer plane (reference: push_manager.h:32 chunked object
+    # push): head-stored objects above this size go to off-host clients
+    # via parallel raw-socket stripes instead of pickled inline metas.
+    bulk_transfer_min: int = 4 * 1024 * 1024
+    bulk_streams: int = 4
+    # Off-host pullers cache payloads at least this big in their node's
+    # agent store and register as replica sources (spanning-tree
+    # broadcast fan-out).
+    bulk_replicate_min: int = 16 * 1024 * 1024
+    bulk_replicate_delay_s: float = 1.0
 
     # --- head fault tolerance (reference: gcs_init_data.h +
     # redis_store_client.h:111 — persistent GCS state; here a periodic
     # snapshot file instead of Redis) ---
     gcs_snapshot_path: str = ""  # empty = persistence disabled
+    # External head-state store URI ("file:///shared/dir"). Supersedes
+    # gcs_snapshot_path; on shared storage it gives cross-node head HA
+    # (reference: redis_store_client.h:111).
+    gcs_external_store: str = ""
     gcs_snapshot_interval_s: float = 1.0
     # How long node agents / drivers keep retrying the head address
     # after a connection drop before giving up.

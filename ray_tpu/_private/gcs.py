@@ -62,7 +62,7 @@ class ObjectEntry:
         "object_id", "state", "offset", "size", "inline", "spill_path",
         "refcount", "read_pins", "task_pins", "lru", "is_error", "owner_id",
         "created_at", "location", "remote_offset", "borrowers",
-        "container_pins", "contained", "pin_holders",
+        "container_pins", "contained", "pin_holders", "replicas", "rr",
     )
 
     def __init__(self, object_id: str, owner_id: str):
@@ -98,13 +98,22 @@ class ObjectEntry:
         # ownership_based_object_directory.h:39).
         self.location: str | None = None
         self.remote_offset: int | None = None
+        # Broadcast fan-out (reference: push_manager.h:32 spanning-tree
+        # push): nodes holding a cached copy of the payload in their
+        # agent store, node_id -> (offset, size). _meta_for round-robins
+        # sources across primary + replicas via the rr counter, so N
+        # pullers spread over the nodes that already have the bytes
+        # instead of convoying on one source.
+        self.replicas: dict[str, tuple] = {}
+        self.rr = 0
 
 
 class WorkerRecord:
     __slots__ = (
         "worker_id", "node_id", "conn", "proc", "pid", "busy", "actor_id",
         "inflight", "started_at", "tpu_chips", "acquired", "ready", "pg_alloc",
-        "tpu_capable",
+        "tpu_capable", "cur_rkey", "zygote", "env_key", "blocked",
+        "released_alloc",
     )
 
     def __init__(self, worker_id: str, node_id: str, proc,
@@ -127,6 +136,30 @@ class WorkerRecord:
         self.acquired: ResourceSet | None = None
         self.pg_alloc: tuple[str, int, ResourceSet] | None = None  # (pg_id, bundle, demand)
         self.ready = False  # set by worker_ready (two-phase registration)
+        # Resource-shape key of the normal task(s) currently allocated to
+        # this worker — same-shape tasks may pipeline onto it (bounded
+        # inflight window) without extra allocation: execution is serial,
+        # so peak usage stays one task's worth (reference analogue: the
+        # owner-side lease cache pipelining tasks onto leased workers,
+        # normal_task_submitter.cc:29).
+        self.cur_rkey: tuple | None = None
+        # Forked from the local zygote: no Popen handle, but the pid is
+        # THIS machine's — hard kills go through os.kill.
+        self.zygote = False
+        # Package-env affinity (reference: runtime-env-keyed worker pool
+        # caching, worker_pool.h:224): once a worker runs a task with a
+        # pip/conda env, its sys.modules may cache that env's package
+        # versions — it is keyed to that env hash for life and never
+        # serves plain tasks or other envs again.
+        self.env_key: str | None = None
+        # Blocked-task resource release (reference: a worker blocked in
+        # ray.get returns its CPU so dependent tasks can run —
+        # core_worker task-blocked protocol). blocked counts this
+        # worker's threads parked in a nested get/wait; the allocation
+        # released at 0->1 is parked in released_alloc for reacquisition
+        # at 1->0.
+        self.blocked = 0
+        self.released_alloc = None
         # Spawned with device-plugin hooks intact (can take TPU leases).
         # Chipless pool workers spawn with the hooks stripped so their
         # jax can never touch — or hang on — the TPU path.
@@ -203,9 +236,24 @@ class Head:
 
         self.shm_name = f"/ray_tpu_{self.session_id}"
         self.arena = ShmArena(self.shm_name, config.object_store_memory)
+        # Bulk transfer plane (reference: object_manager chunked
+        # push/pull, push_manager.h:32): off-host clients pull head-
+        # stored payloads from here in parallel raw-socket stripes
+        # instead of receiving them pickled inline over the control
+        # connection (which serialized a whole broadcast through one
+        # framed stream AND the head lock).
+        from ray_tpu._private.bulk_transfer import BulkServer
+
+        self.bulk_server = BulkServer(self._bulk_read)
+        # "" host: the client substitutes the head host it dialed.
+        self.node_bulk_addrs: dict[str, tuple] = {}
 
         self.lock = threading.RLock()
         self.dispatch_event = threading.Event()
+        self._push_touched: set = set()  # conns with buffered pushes
+        # Set by _on_sealed when a seal readied a dep-blocked task, so
+        # completion handlers know a dispatch pass is actually needed.
+        self._sealed_woke_task = False
 
         # --- tables ---
         self.objects: dict[str, ObjectEntry] = {}
@@ -283,18 +331,29 @@ class Head:
         # see _private/gcs_persistence.py) --- must happen BEFORE the
         # server accepts connections so restored state is visible to the
         # first reconnecting client.
-        self._snapshot_path = config.gcs_snapshot_path or None
+        # gcs_external_store ("file:///shared/dir") supersedes the
+        # node-local snapshot path: pointed at shared storage, ANY
+        # machine can adopt the head role after a failure (reference:
+        # redis_store_client.h:111 — external-store head HA).
+        self._snapshot_path = (config.gcs_external_store
+                               or config.gcs_snapshot_path or None)
         self._snapshot_dirty = False
         self._wal = None
+        self._gcs_store = None
         if self._snapshot_path:
             from ray_tpu._private import gcs_persistence
 
-            payload = None
-            if os.path.exists(self._snapshot_path):
-                payload = gcs_persistence.load_snapshot(self._snapshot_path)
+            if config.gcs_external_store:
+                from ray_tpu._private.gcs_store import store_from_uri
+
+                self._gcs_store = store_from_uri(config.gcs_external_store)
+            else:
+                self._gcs_store = gcs_persistence._as_store(
+                    self._snapshot_path)
+            payload = gcs_persistence.load_snapshot(self._gcs_store)
             from_seg = payload.get("wal_seg", 0) if payload else 0
             ops, last_seg = gcs_persistence.WriteAheadLog.read_ops(
-                self._snapshot_path, from_seg)
+                self._gcs_store, from_seg)
             if payload is None and ops:
                 payload = gcs_persistence.empty_payload()
             if payload is not None:
@@ -307,7 +366,7 @@ class Head:
                       f"{len(ops)} WAL ops)",
                       file=sys.stderr)
             self._wal = gcs_persistence.WriteAheadLog(
-                self._snapshot_path, last_seg)
+                self._gcs_store, last_seg)
             threading.Thread(target=self._snapshot_loop, daemon=True,
                              name="gcs-snapshot").start()
 
@@ -318,6 +377,14 @@ class Head:
             port=config.head_port,
         )
         self.address = self.server.address
+        # Warm the worker fork-server off-thread NOW: the first actor
+        # burst should find it READY instead of falling back to direct
+        # interpreter spawns (and spawn() must never block the dispatch
+        # lock on the zygote's worker-module import).
+        try:
+            self._zygote().start_async()
+        except Exception:
+            pass
         self._dispatcher = threading.Thread(
             target=self._dispatch_loop, daemon=True, name="head-dispatch"
         )
@@ -424,7 +491,7 @@ class Head:
                 payload = gcs_persistence.build_payload(self)
                 payload["wal_seg"] = new_seg
             # Pickle + fsync outside the lock: RPC handlers keep running.
-            gcs_persistence.write_blob(payload, self._snapshot_path)
+            gcs_persistence.write_blob(payload, self._gcs_store)
             if self._wal is not None:
                 # Snapshot durably subsumes the older segments.
                 self._wal.prune_below(new_seg)
@@ -464,24 +531,58 @@ class Head:
             strip_plugin_hooks(env)
         logs = os.path.join(self.session_dir, "logs")
         os.makedirs(logs, exist_ok=True)
-        with open(os.path.join(logs, f"{worker_id}.log"), "ab") as out:
-            proc = subprocess.Popen(
-                [sys.executable, "-m", "ray_tpu._private.worker"],
-                env=env,
-                stdout=out,
-                stderr=subprocess.STDOUT,
-                cwd=os.getcwd(),
-            )  # the child keeps its inherited fd; don't leak one per spawn
+        proc = None
+        pid = None
+        if not tpu_capable:
+            # Fork from the pre-imported zygote (~5 ms) instead of a
+            # fresh interpreter (~300 ms+): reference analogue is the
+            # raylet's warm worker pool (worker_pool.h:224).
+            pid = self._zygote().spawn(
+                {k: env[k] for k in ("RAY_TPU_WORKER_ID", "RAY_TPU_HEAD",
+                                     "RAY_TPU_SHM", "RAY_TPU_NODE_ID",
+                                     "RAY_TPU_SESSION_DIR")},
+                os.path.join(logs, f"{worker_id}.log"))
+        if pid is None:
+            with open(os.path.join(logs, f"{worker_id}.log"), "ab") as out:
+                proc = subprocess.Popen(
+                    [sys.executable, "-m", "ray_tpu._private.worker"],
+                    env=env,
+                    stdout=out,
+                    stderr=subprocess.STDOUT,
+                    cwd=os.getcwd(),
+                )  # child keeps its inherited fd; don't leak one per spawn
         rec = WorkerRecord(worker_id, node_id, proc, tpu_capable)
+        if pid is not None:
+            rec.pid = pid
+            rec.zygote = True
         # Best-effort cgroup v2 isolation: workers land in the node's
         # application slice (reference: cgroup_setup.h; no-op without a
         # writable cgroupfs).
         from ray_tpu._private.cgroup import CgroupSetup
 
-        CgroupSetup.get_or_create(self, self.node_id).add_worker_process(proc.pid)
+        CgroupSetup.get_or_create(self, self.node_id).add_worker_process(
+            proc.pid if proc is not None else pid)
         with self.lock:
             self.workers[worker_id] = rec
         return rec
+
+    def _zygote(self):
+        """Lazily-started fork-server for chipless local workers."""
+        zy = getattr(self, "_zygote_client", None)
+        if zy is None:
+            from ray_tpu._private.hermetic import strip_plugin_hooks
+            from ray_tpu._private.zygote import ZygoteClient
+
+            env = dict(os.environ)
+            env["RAY_TPU_HEAD"] = f"{self.address[0]}:{self.address[1]}"
+            extra = [p for p in sys.path if p and os.path.isdir(p)]
+            existing = env.get("PYTHONPATH", "")
+            env["PYTHONPATH"] = os.pathsep.join(
+                extra + ([existing] if existing else []))
+            strip_plugin_hooks(env)
+            zy = self._zygote_client = ZygoteClient(
+                env, os.path.join(self.session_dir, "logs"))
+        return zy
 
     def _spawn_remote_worker(self, node_id: str,
                              tpu_capable: bool = False) -> WorkerRecord:
@@ -569,6 +670,7 @@ class Head:
         with self.lock:
             self.node_agents.pop(node_id, None)
             self.node_transfer_addrs.pop(node_id, None)
+            self.node_bulk_addrs.pop(node_id, None)
             self.scheduler.mark_dead(node_id)
             # P2P payloads hosted by the dead node are gone; mark the
             # entries lost so fetches trigger lineage reconstruction
@@ -576,9 +678,19 @@ class Head:
             # Snapshot first: _maybe_reconstruct INSERTS entries for
             # freed dependency ids, which would blow up an iteration
             # over the live dict.
-            lost = [e for e in self.objects.values()
-                    if e.location == node_id and e.state == SEALED]
+            lost = []
+            for e in self.objects.values():
+                e.replicas.pop(node_id, None)
+                if e.location == node_id and e.state == SEALED:
+                    lost.append(e)
             for e in lost:
+                if e.replicas:
+                    # Promote a replica to primary instead of losing
+                    # the object (spanning-tree copies ARE recovery).
+                    nid, (off, _sz) = next(iter(e.replicas.items()))
+                    del e.replicas[nid]
+                    e.location, e.remote_offset = nid, off
+                    continue
                 e.state = LOST
                 e.location = None
                 self._maybe_reconstruct(e.object_id)
@@ -654,6 +766,9 @@ class Head:
                 peer_ip = "127.0.0.1"
             self.node_transfer_addrs[node_id] = (peer_ip,
                                                  int(body["transfer_port"]))
+            if body.get("bulk_port"):
+                self.node_bulk_addrs[node_id] = (peer_ip,
+                                                 int(body["bulk_port"]))
         resources = dict(body.get("resources") or {})
         resources.setdefault(f"node:{node_id}", 1.0)
         entry = NodeEntry(
@@ -679,6 +794,41 @@ class Head:
         conn.peer_info = {"node_agent_for": node_id}
         self.dispatch_event.set()
         return {"node_id": node_id, "session_dir": self.session_dir}
+
+    def _h_worker_blocked(self, body: dict, conn):
+        """A worker thread is entering a blocking nested get/wait:
+        release its CPU/memory allocation so the tasks it waits on can
+        be placed (reference: CoreWorker NotifyDirectCallTaskBlocked —
+        blocked workers return resources to the raylet). TPU-leased
+        workers keep their allocation: chip assignment is process
+        state that cannot be handed to another worker mid-task."""
+        with self.lock:
+            rec = self.workers.get(body["worker_id"])
+            if rec is None or rec.actor_id is not None or rec.tpu_chips:
+                return None
+            rec.blocked += 1
+            if (rec.blocked == 1 and rec.acquired is not None
+                    and rec.pg_alloc is None):
+                self.scheduler.release(rec.node_id, rec.acquired)
+                rec.released_alloc, rec.acquired = rec.acquired, None
+        self.dispatch_event.set()
+        return None
+
+    def _h_worker_unblocked(self, body: dict, conn):
+        with self.lock:
+            rec = self.workers.get(body["worker_id"])
+            if rec is None:
+                return None
+            rec.blocked = max(0, rec.blocked - 1)
+            if rec.blocked == 0 and rec.released_alloc is not None:
+                demand, rec.released_alloc = rec.released_alloc, None
+                if rec.inflight and self.scheduler.acquire(rec.node_id,
+                                                           demand):
+                    rec.acquired = demand
+                # else: transient oversubscription (reference semantics:
+                # the resumed task runs on; the slot re-enters the
+                # accounting at the window's next allocation).
+        return None
 
     def _h_worker_ready(self, body: dict, conn):
         with self.lock:
@@ -769,6 +919,27 @@ class Head:
         entry.state = SEALED
         return True
 
+    def _bulk_read(self, object_id: str, start: int, length: int):
+        """BulkServer reader over the head arena: pin the entry for the
+        duration of the raw send (same discipline as shm metas)."""
+        with self.lock:
+            e = self.objects.get(object_id)
+            if (e is None or e.state != SEALED or e.offset is None
+                    or start >= e.size):
+                raise KeyError(f"object {object_id} not in head arena")
+            n = min(length, e.size - start)
+            e.read_pins += 1
+            view = self.arena.view(e.offset + start, n)
+
+        def release(e=e, view=view):
+            view.release()
+            with self.lock:
+                e.read_pins -= 1
+                if e.refcount <= 0:
+                    self._maybe_free(e)
+
+        return view, release
+
     def _h_seal_object(self, body: dict, conn):
         with self.lock:
             entry = self.objects.get(body["object_id"])
@@ -836,6 +1007,7 @@ class Head:
         """Resolve get/wait waiters; wake dependency-blocked tasks. lock held."""
         blocked = self.dep_blocked.pop(object_id, None)
         if blocked:
+            self._sealed_woke_task = True
             for spec in blocked:
                 pending = getattr(spec, "_deps_pending", None)
                 if pending is None:
@@ -877,22 +1049,28 @@ class Head:
                         self.external_storage.restore(entry.spill_path),
                         entry.is_error)
         if entry.state == SEALED:
-            if entry.location is not None:
+            if entry.location is not None or (
+                    remote and entry.offset is not None
+                    and entry.size > self.config.bulk_transfer_min):
                 # P2P object: the head is directory only — the client
-                # pulls the bytes straight from the hosting node's agent
-                # (reference: pull_manager.h:57). Read-pinned like shm
-                # metas: the free_object cast to the agent must not fire
-                # mid-pull (client sends read_done when finished).
-                entry.read_pins += 1
-                if client_id:
-                    entry.pin_holders[client_id] = (
-                        entry.pin_holders.get(client_id, 0) + 1)
-                return ("p2p", entry.object_id, entry.location,
-                        self.node_transfer_addrs.get(entry.location),
-                        entry.remote_offset, entry.size, entry.is_error)
+                # pulls the bytes from a hosting node's bulk server
+                # (reference: pull_manager.h:57), round-robined across
+                # primary + replicas. Read-pinned like shm metas: the
+                # free_object cast must not fire mid-pull (client sends
+                # read_done when finished).
+                src = self._pick_source(entry)
+                if src is not None:
+                    node_id, off, addr = src
+                    entry.read_pins += 1
+                    if client_id:
+                        entry.pin_holders[client_id] = (
+                            entry.pin_holders.get(client_id, 0) + 1)
+                    return ("p2p", entry.object_id, node_id, addr,
+                            off, entry.size, entry.is_error)
             if remote:
-                # Off-host client: copy out under the lock and ship bytes
-                # over the connection (no mmap, no read pin to release).
+                # Off-host client, small object: copy out under the lock
+                # and ship bytes over the connection (no mmap, no read
+                # pin to release).
                 return (
                     "inline",
                     bytes(self.arena.view(entry.offset, entry.size)),
@@ -904,6 +1082,61 @@ class Head:
                     entry.pin_holders.get(client_id, 0) + 1)
             return ("shm", entry.offset, entry.size, entry.is_error)
         return ("lost", f"object {entry.object_id} is {entry.state}", False)
+
+    def _pick_source(self, entry: ObjectEntry):
+        """lock held. Choose a payload source among the primary copy and
+        replicas (spanning-tree fan-out: a node that pulled the object
+        becomes a source for later pullers). Returns (node_id, offset,
+        bulk_addr) or None."""
+        sources = []
+        if entry.location is not None:
+            sources.append((entry.location, entry.remote_offset))
+        elif entry.offset is not None:
+            sources.append((self.node_id, entry.offset))
+        for nid, (off, _sz) in entry.replicas.items():
+            if nid in self.node_agents or nid == self.node_id:
+                sources.append((nid, off))
+        while sources:
+            entry.rr += 1
+            nid, off = sources[entry.rr % len(sources)]
+            if nid == self.node_id:
+                return nid, off, ("", self.bulk_server.address[1])
+            addr = self.node_bulk_addrs.get(nid)
+            if addr is not None:
+                return nid, off, addr
+            # Source node lacks a bulk server (older agent): the legacy
+            # rpc transfer addr, explicitly TAGGED — the two protocols
+            # are not interchangeable on the wire, so the client must
+            # never guess (a bulk frame misread as an rpc length field
+            # blocks the reader on a ~4 GiB recv).
+            if (nid, off) == (entry.location, entry.remote_offset):
+                legacy = self.node_transfer_addrs.get(nid)
+                if legacy is not None:
+                    return nid, off, (legacy[0], legacy[1], "rpc")
+                return nid, off, None
+            sources.remove((nid, off))
+        return None
+
+    def _h_add_replica(self, body: dict, conn):
+        """A node cached a pulled payload in its agent store and offers
+        itself as a source (reference: object location updates into the
+        directory, ownership_based_object_directory.h:39)."""
+        with self.lock:
+            e = self.objects.get(body["object_id"])
+            if e is not None and e.state == SEALED:
+                e.replicas[body["node_id"]] = (body["offset"], body["size"])
+                return None
+            # Object freed while the replica was being cached: without a
+            # directory entry nothing would ever free the sealed bytes —
+            # tell the offering node to drop them now.
+            agent = self.node_agents.get(body["node_id"])
+            if agent is not None:
+                try:
+                    agent.cast("free_object",
+                               {"object_id": body["object_id"]})
+                except rpc.ConnectionLost:
+                    pass
+        return None
 
     def _send_metas(self, conn: rpc.Connection, waiter_id: str) -> None:
         metas = {}
@@ -1072,6 +1305,8 @@ class Head:
         return {}
 
     def _maybe_free(self, entry: ObjectEntry, force: bool = False) -> None:
+        if self._shutdown:
+            return  # the arena is (being) destroyed with the session
         if self.objects.get(entry.object_id) is not entry:
             # Already freed (or superseded): callers may hold stale
             # entries gathered before a cascading containment free —
@@ -1095,8 +1330,11 @@ class Head:
             self.arena.free(entry.offset)
         if entry.spill_path:
             self.external_storage.delete(entry.spill_path)
+        holders = set(entry.replicas)
         if entry.location is not None:
-            agent = self.node_agents.get(entry.location)
+            holders.add(entry.location)
+        for nid in holders:
+            agent = self.node_agents.get(nid)
             if agent is not None:
                 try:
                     agent.cast("free_object",
@@ -1199,13 +1437,29 @@ class Head:
         self.dispatch_event.set()
         return None
 
+    @staticmethod
+    def _env_key(renv: "dict | None") -> "str | None":
+        """Hash of the package half of a runtime env (pip/conda), or
+        None for envs that don't alter installed packages — only the
+        package half poisons a worker's sys.modules for other envs."""
+        if not renv:
+            return None
+        pkg = {k: renv[k] for k in ("pip", "conda") if renv.get(k)}
+        if not pkg:
+            return None
+        import hashlib as _hashlib
+
+        return _hashlib.sha256(repr(sorted(
+            (k, repr(v)) for k, v in pkg.items())).encode()).hexdigest()[:16]
+
     def _queue_key(self, spec: TaskSpec) -> tuple:
         if spec.scheduling_strategy is not None:
             return _SCAN_KEY
-        rkey = getattr(spec, "_rkey", None)
+        rkey = spec._rkey
         if rkey is None:
-            rkey = tuple(sorted(spec.resources.items()))
-            spec._rkey = rkey
+            rkey = spec._rkey = (
+                tuple(sorted(spec.resources.items())),
+                self._env_key(spec.runtime_env))
         return ("shape", rkey)
 
     def _enqueue_task_spec(self, spec: TaskSpec, front: bool = False) -> None:
@@ -1372,10 +1626,24 @@ class Head:
                         if e is not None and e.task_pins > 0:
                             e.task_pins -= 1
                             self._maybe_free(e)
+            # A dispatch pass is only useful when this completion freed
+            # capacity (allocation released) or a piggybacked seal woke a
+            # dep-blocked task — pipelined mid-window completions do
+            # neither, and skipping their wake cuts pass count ~4x.
+            need_dispatch = self._sealed_woke_task
+            self._sealed_woke_task = False
             if rec.actor_id is None:
+                # Pipelined same-shape tasks share ONE allocation —
+                # release it only when the window fully drains. Wake the
+                # dispatcher BEFORE that (window nearly empty) so the
+                # refill overlaps the last task's execution instead of
+                # stalling the worker.
                 if not rec.inflight:
                     rec.busy = False
-                self._release_worker_allocation(rec)
+                    self._release_worker_allocation(rec)
+                    need_dispatch = True
+                elif len(rec.inflight) <= 2:
+                    need_dispatch = True
             else:
                 actor = self.actors.get(rec.actor_id)
                 if actor is not None and spec is not None and spec.actor_creation:
@@ -1403,7 +1671,9 @@ class Head:
                 if actor is not None:
                     self._flush_actor(actor)
                 rec.busy = bool(rec.inflight)
-        self.dispatch_event.set()
+                need_dispatch = True
+        if need_dispatch:
+            self.dispatch_event.set()
         return None
 
     # --- actors ---
@@ -1532,6 +1802,11 @@ class Head:
             rec = self.workers.get(actor.worker_id) if actor.worker_id else None
         if rec is not None and rec.proc is not None:
             rec.proc.kill()
+        elif rec is not None and rec.zygote and rec.pid:
+            try:
+                os.kill(rec.pid, 9)
+            except OSError:
+                pass
         elif rec is not None and rec.conn is not None:
             # Remote worker: tell it to exit; its connection drop runs the
             # normal death handling.
@@ -1725,7 +2000,10 @@ class Head:
             rec = self.workers.get(worker_id)
             if rec is None:
                 return {"worker_id": worker_id, "error": "unknown worker"}
-            pid, node_id, local = rec.pid, rec.node_id, rec.proc is not None
+            # Zygote-forked workers have no Popen handle but ARE local
+            # (their pid is this machine's — signal via os.kill).
+            pid, node_id, local = (rec.pid, rec.node_id,
+                                   rec.proc is not None or rec.zygote)
             agent = self.node_agents.get(node_id)
         path = os.path.join(self.session_dir, "logs", f"{worker_id}.log")
         before = 0
@@ -1925,6 +2203,20 @@ class Head:
                 traceback.print_exc()
 
     def _dispatch_once(self) -> None:
+        self._push_touched: set = set()
+        try:
+            self._dispatch_once_locked()
+        finally:
+            # Flush coalesced pushes AFTER dropping the head lock: a
+            # slow worker socket must never stall scheduling.
+            touched, self._push_touched = self._push_touched, set()
+            for conn in touched:
+                try:
+                    conn.flush_casts()
+                except Exception:
+                    pass
+
+    def _dispatch_once_locked(self) -> None:
         with self.lock:
             # 1. actor creations first (they unblock queued calls)
             for actor in list(self.actors.values()):
@@ -1976,21 +2268,43 @@ class Head:
                         need_tpu = float(spec.resources.get("TPU", 0)) > 0
                         if (node.node_id, need_tpu) in no_worker:
                             break
-                        rec = self._idle_worker(node.node_id, need_tpu)
+                        ek = key[1][1] if key[0] == "shape" else None
+                        rec = self._idle_worker(node.node_id, need_tpu, ek)
                         if rec is None:
                             if not spawned and self._can_spawn(node.node_id,
                                                                need_tpu):
                                 self.spawn_worker(node.node_id,
                                                   tpu_capable=need_tpu)
                                 spawned = True
-                            no_worker.add((node.node_id, need_tpu))
-                            break
+                            elif not spawned:
+                                # Pool at cap and every idle worker is
+                                # keyed to another package env: retire
+                                # one so the NEXT pass can spawn for
+                                # this env (reference: worker_pool.h
+                                # evicts idle cached-env workers).
+                                self._retire_idle_mismatch(
+                                    node.node_id, need_tpu, ek)
+                            # Pipeline: same-shape tasks ride an already-
+                            # allocated worker's bounded inflight window
+                            # (serial execution — no extra allocation).
+                            rec = (None if need_tpu else
+                                   self._pipeline_worker(node.node_id, key))
+                            if rec is None:
+                                no_worker.add((node.node_id, need_tpu))
+                                break
+                            q.popleft()
+                            popped = True
+                            self._push_to_worker(rec, spec, buffered=True)
+                            continue
                         if not self._try_allocate(rec, node.node_id,
                                                   spec.resources, None):
                             break
+                        rec.cur_rkey = key
+                        if ek is not None:
+                            rec.env_key = ek  # keyed for life (pip/conda)
                         q.popleft()
                         popped = True
-                        self._push_to_worker(rec, spec)
+                        self._push_to_worker(rec, spec, buffered=True)
                     except Exception:
                         # One malformed spec must not wedge the loop.
                         traceback.print_exc()
@@ -2058,7 +2372,8 @@ class Head:
                     requeue.append(spec)
                     misses += 1
                     continue
-                rec = self._idle_worker(node.node_id, need_tpu)
+                scan_ek = self._env_key(spec.runtime_env)
+                rec = self._idle_worker(node.node_id, need_tpu, scan_ek)
                 if rec is None:
                     if not spawned and self._can_spawn(node.node_id,
                                                        need_tpu):
@@ -2076,7 +2391,9 @@ class Head:
                     requeue.append(spec)
                     continue
                 misses = 0
-                self._push_to_worker(rec, spec)
+                if scan_ek is not None:
+                    rec.env_key = scan_ek  # keyed for life (pip/conda)
+                self._push_to_worker(rec, spec, buffered=True)
             except Exception:
                 # One malformed spec must not wedge the dispatch loop or
                 # drop the requeue of healthy tasks.
@@ -2124,12 +2441,60 @@ class Head:
             return NodeAffinitySchedulingStrategy(node_id=node_id, soft=False)
         return s
 
-    def _idle_worker(self, node_id: str,
-                     need_tpu: bool = False) -> WorkerRecord | None:
+    PIPELINE_DEPTH = 8  # max same-shape tasks queued on one busy worker
+
+    def _retire_idle_mismatch(self, node_id: str, need_tpu: bool,
+                              env_key: "str | None") -> None:
+        """lock held. Kill ONE idle worker whose env key blocks this
+        task class; its death handler frees a pool slot."""
+        for rec in self.workers.values():
+            if (
+                rec.node_id == node_id
+                and rec.conn is not None
+                and rec.ready
+                and not rec.busy
+                and rec.actor_id is None
+                and rec.tpu_capable == need_tpu
+                and rec.env_key != env_key
+                and rec.env_key is not None
+            ):
+                try:
+                    rec.conn.cast("kill", {})
+                except rpc.ConnectionLost:
+                    pass
+                return
+
+    def _pipeline_worker(self, node_id: str,
+                         key: tuple) -> WorkerRecord | None:
+        """lock held. A busy non-actor worker already holding an
+        allocation for this resource shape whose inflight window has
+        room. TPU tasks never pipeline (chip visibility is per-lease)."""
+        for rec in self.workers.values():
+            if (
+                rec.node_id == node_id
+                and rec.conn is not None
+                and rec.ready
+                and rec.actor_id is None
+                and not rec.tpu_capable
+                and rec.cur_rkey == key
+                and rec.acquired is not None
+                and 0 < len(rec.inflight) < self.PIPELINE_DEPTH
+            ):
+                return rec
+        return None
+
+    def _idle_worker(self, node_id: str, need_tpu: bool = False,
+                     env_key: "str | None" = None) -> WorkerRecord | None:
         """TPU tasks need a plugin-intact (tpu_capable) worker; chipless
         tasks need a hook-stripped one — a tpu_capable worker running a
         chipless task would still initialize the TPU plugin on its first
-        jax use, contending for chips the lease never granted."""
+        jax use, contending for chips the lease never granted.
+
+        ``env_key`` (pip/conda hash): exact-keyed workers first, then an
+        unkeyed pool worker is claimed (keyed for life — its sys.modules
+        will cache this env's packages). Plain tasks only match unkeyed
+        workers."""
+        claimable = None
         for rec in self.workers.values():
             if (
                 rec.node_id == node_id
@@ -2139,22 +2504,38 @@ class Head:
                 and rec.actor_id is None
                 and rec.tpu_capable == need_tpu
             ):
-                return rec
-        return None
+                if rec.env_key == env_key:
+                    return rec
+                if env_key is not None and rec.env_key is None:
+                    claimable = claimable or rec
+        # NOTE: the caller keys the claimed worker (rec.env_key = ek)
+        # only AFTER allocation succeeds and the task is pushed — keying
+        # here would poison a worker that never runs the env.
+        return claimable
 
     def _can_spawn(self, node_id: str, tpu_capable: bool = False) -> bool:
         """Pool caps are per worker kind: TPU-capable and hook-stripped
         pool workers are disjoint (cannot serve each other's tasks), so
         a pool full of idle TPU workers must not starve chipless tasks
         of their own spawn budget — and vice versa."""
+        # Blocked workers (parked in a nested get, allocation released)
+        # don't count against the cap: a chain of N nested gets needs N+1
+        # workers alive even though only one runs at a time (reference:
+        # the raylet starts extra workers to cover blocked ones,
+        # worker_pool.h maximum_startup_concurrency semantics).
         count = sum(
             1 for r in self.workers.values()
             if r.node_id == node_id and r.actor_id is None
-            and r.tpu_capable == tpu_capable
+            and r.tpu_capable == tpu_capable and not r.blocked
         )
         return count < self.max_pool_workers
 
-    def _push_to_worker(self, rec: WorkerRecord, spec: TaskSpec) -> None:
+    def _push_to_worker(self, rec: WorkerRecord, spec: TaskSpec,
+                        buffered: bool = False) -> None:
+        """``buffered=True`` (dispatch-pass pushes) coalesces pushes to
+        the same worker into one CAST_BATCH frame; the pass flushes all
+        touched connections after dropping the lock. Direct pushes
+        (actor-call flush paths) stay immediate for latency."""
         rec.busy = True
         rec.inflight[spec.task_id] = spec
         t = self.tasks.get(spec.task_id)
@@ -2164,10 +2545,17 @@ class Head:
             t["worker_id"] = rec.worker_id
             t["started_at"] = time.time()
         try:
-            rec.conn.cast(
-                "push_task",
-                {"spec": spec, "tpu_chips": rec.tpu_chips},
-            )
+            if buffered:
+                rec.conn.cast_buffered(
+                    "push_task",
+                    {"spec": spec, "tpu_chips": rec.tpu_chips},
+                )
+                self._push_touched.add(rec.conn)
+            else:
+                rec.conn.cast(
+                    "push_task",
+                    {"spec": spec, "tpu_chips": rec.tpu_chips},
+                )
         except rpc.ConnectionLost:
             pass  # worker death handler requeues
 
@@ -2188,10 +2576,17 @@ class Head:
         # creation from the pool, raylet/worker_pool.h:224) — actor
         # spawn drops from ~interpreter-start (250ms+) to one RPC.
         # Runtime envs are applied in-worker by the creation task, so
-        # any pool worker qualifies — except for TPU actors: a pooled
-        # worker may already have initialized jax on its CPU pin, and
-        # a jax backend cannot be re-pointed at the chips post-import.
-        rec = None if need_tpu else self._idle_worker(node.node_id, False)
+        # any pool worker qualifies — except: (a) TPU actors (a pooled
+        # worker may already have initialized jax on its CPU pin, and a
+        # jax backend cannot be re-pointed at the chips post-import);
+        # (b) package envs (pip/conda) — a pooled worker's sys.modules
+        # may cache other versions; the reference keys pools by env hash
+        # (worker_pool.h runtime-env-keyed caching), here those actors
+        # get a fresh interpreter.
+        renv = spec.runtime_env or {}
+        fresh_env = bool(renv.get("pip") or renv.get("conda"))
+        rec = (None if (need_tpu or fresh_env)
+               else self._idle_worker(node.node_id, False))
         reused = rec is not None
         if not reused:
             rec = self.spawn_worker(node.node_id, tpu_capable=need_tpu)
@@ -2202,6 +2597,11 @@ class Head:
                 return
             if rec.proc is not None:
                 rec.proc.kill()
+            elif rec.zygote and rec.pid:
+                try:
+                    os.kill(rec.pid, 9)
+                except OSError:
+                    pass
             # Remote spawn: the worker registers, finds its record gone,
             # and exits (registration is rejected for unknown workers).
             self.workers.pop(rec.worker_id, None)
@@ -2320,6 +2720,7 @@ class Head:
             if pg is not None and idx < len(pg.bundle_used):
                 pg.bundle_used[idx].subtract(demand)
             rec.pg_alloc = None
+        rec.cur_rkey = None
         self._return_tpu_chips(rec)
 
     # TPU chip visibility assignment (reference semantics:
@@ -2490,6 +2891,13 @@ class Head:
 
     def shutdown(self) -> None:
         self._shutdown = True
+        try:
+            self.bulk_server.stop()
+        except Exception:
+            pass
+        zy = getattr(self, "_zygote_client", None)
+        if zy is not None:
+            zy.stop()
         if self._snapshot_path and self._snapshot_dirty:
             self._snapshot_now()
         if self._wal is not None:
@@ -2507,6 +2915,13 @@ class Head:
         deadline = time.time() + 2.0
         for rec in workers:
             if rec.proc is None:
+                if rec.zygote and rec.pid:
+                    # Zygote children are reaped by the zygote (SIGCHLD
+                    # ignored there); a hung one still needs the kill.
+                    try:
+                        os.kill(rec.pid, 9)
+                    except OSError:
+                        pass
                 continue
             try:
                 rec.proc.wait(timeout=max(0.05, deadline - time.time()))

@@ -76,26 +76,24 @@ def build_payload(head: "Head") -> dict:
     }
 
 
-def write_blob(payload: dict, path: str) -> None:
+def _as_store(path_or_store):
+    """Accept a StoreClient or a legacy base path (kept for direct
+    callers/tests; maps to a FileStoreClient with the historical
+    <base>/<base>.wal.N layout)."""
+    from ray_tpu._private.gcs_store import FileStoreClient, StoreClient
+
+    if isinstance(path_or_store, StoreClient):
+        return path_or_store
+    return FileStoreClient(os.path.dirname(os.path.abspath(path_or_store))
+                           or ".", legacy_base=path_or_store)
+
+
+def write_blob(payload: dict, store) -> None:
     """Atomic snapshot write (called WITHOUT head.lock: pickling +
     fsync of a many-MB KV under the lock would stall every RPC
-    handler)."""
-    blob = pickle.dumps(payload, protocol=5)
-    d = os.path.dirname(os.path.abspath(path)) or "."
-    os.makedirs(d, exist_ok=True)
-    fd, tmp = tempfile.mkstemp(dir=d, prefix=".gcs-snap-")
-    try:
-        with os.fdopen(fd, "wb") as f:
-            f.write(blob)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)
-    except BaseException:
-        try:
-            os.unlink(tmp)
-        except OSError:
-            pass
-        raise
+    handler). ``store``: StoreClient or legacy base path."""
+    _as_store(store).write_atomic("snapshot",
+                                  pickle.dumps(payload, protocol=5))
 
 
 class WriteAheadLog:
@@ -107,18 +105,19 @@ class WriteAheadLog:
     fsync, deliberately not paid per-op). A torn final frame — the crash
     landed mid-append — is detected by length/CRC and dropped."""
 
-    def __init__(self, base_path: str, seg: int = 0):
-        self.base = base_path
+    def __init__(self, base_path, seg: int = 0):
+        self.store = _as_store(base_path)
         self.seg = seg
         self._f = None
         # Reopening after a crash: a frame torn mid-append would poison
         # every LATER append (readers stop at the first bad frame), so
         # truncate the segment to its valid prefix before appending.
-        self._repair(self._seg_path(seg))
+        self._repair(self.store, self._seg_name(seg))
         self._open()
 
-    def _seg_path(self, seg: int) -> str:
-        return f"{self.base}.wal.{seg}"
+    @staticmethod
+    def _seg_name(seg: int) -> str:
+        return f"wal.{seg}"
 
     @staticmethod
     def _scan(data: bytes) -> "tuple[list, int]":
@@ -144,20 +143,16 @@ class WriteAheadLog:
         return ops, pos
 
     @staticmethod
-    def _repair(path: str) -> None:
-        if not os.path.exists(path):
+    def _repair(store, name: str) -> None:
+        data = store.read(name)
+        if data is None:
             return
-        with open(path, "rb") as f:
-            data = f.read()
         _, valid = WriteAheadLog._scan(data)
         if valid < len(data):
-            with open(path, "ab") as f:
-                f.truncate(valid)
+            store.rewrite(name, data[:valid])
 
     def _open(self) -> None:
-        d = os.path.dirname(os.path.abspath(self.base)) or "."
-        os.makedirs(d, exist_ok=True)
-        self._f = open(self._seg_path(self.seg), "ab")
+        self._f = self.store.open_append(self._seg_name(self.seg))
 
     def append(self, op: tuple) -> None:
         import struct
@@ -178,13 +173,9 @@ class WriteAheadLog:
 
     def prune_below(self, seg: int) -> None:
         """Delete segments subsumed by a successfully written snapshot."""
-        s = seg - 1
-        while s >= 0 and os.path.exists(self._seg_path(s)):
-            try:
-                os.unlink(self._seg_path(s))
-            except OSError:
-                break
-            s -= 1
+        for s in WriteAheadLog.existing_segments(self.store):
+            if s < seg:
+                self.store.delete(self._seg_name(s))
 
     def close(self) -> None:
         try:
@@ -193,14 +184,14 @@ class WriteAheadLog:
             pass
 
     @staticmethod
-    def existing_segments(base_path: str) -> "list[int]":
-        """Sorted segment numbers present on disk."""
-        import glob
+    def existing_segments(base_path) -> "list[int]":
+        """Sorted segment numbers present in the store."""
         import re
 
+        store = _as_store(base_path)
         segs = []
-        for p in glob.glob(glob.escape(base_path) + ".wal.*"):
-            m = re.search(r"\.wal\.(\d+)$", p)
+        for name in store.list("wal."):
+            m = re.fullmatch(r"wal\.(\d+)", name)
             if m:
                 segs.append(int(m.group(1)))
         return sorted(segs)
@@ -215,14 +206,14 @@ class WriteAheadLog:
         counting up from from_seg: if the snapshot is unreadable
         (from_seg falls back to 0) the pre-compaction segments are gone,
         and a contiguous walk from 0 would silently find nothing."""
-        segs = WriteAheadLog.existing_segments(base_path)
+        store = _as_store(base_path)
+        segs = WriteAheadLog.existing_segments(store)
         last_seg = max(segs, default=from_seg)
         ops: list = []
         for seg in segs:
             if seg < from_seg:
                 continue
-            with open(f"{base_path}.wal.{seg}", "rb") as f:
-                data = f.read()
+            data = store.read(WriteAheadLog._seg_name(seg)) or b""
             seg_ops, _ = WriteAheadLog._scan(data)
             ops.extend(seg_ops)
         return ops, last_seg
@@ -284,11 +275,13 @@ def apply_ops(payload: dict, ops: list) -> dict:
     return payload
 
 
-def load_snapshot(path: str) -> "dict | None":
+def load_snapshot(path) -> "dict | None":
+    blob = _as_store(path).read("snapshot")
+    if blob is None:
+        return None
     try:
-        with open(path, "rb") as f:
-            payload = pickle.load(f)
-    except (FileNotFoundError, EOFError, pickle.UnpicklingError):
+        payload = pickle.loads(blob)
+    except (EOFError, pickle.UnpicklingError):
         return None
     if payload.get("version") != FORMAT_VERSION:
         return None

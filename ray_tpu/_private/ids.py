@@ -23,8 +23,35 @@ import threading
 from typing import Callable
 
 
+# Fast unique ids: a per-process random 16-hex prefix + a 16-hex counter
+# renders in ~0.3 us vs ~5 us for uuid4 — at flood submission rates
+# (2 ids per task) id generation alone was ~5% of the per-task budget.
+# Uniqueness: prefix collisions across processes are 2^-64-scale, the
+# counter handles within-process.
+_ID_PREFIX = os.urandom(8).hex()
+_id_counter = iter(range(1, 1 << 62)).__next__
+_ID_FMT = (_ID_PREFIX + "%016x").__mod__
+
+
 def _hex_id() -> str:
-    return os.urandom(16).hex()
+    return _ID_FMT(_id_counter())
+
+
+def fast_hex_id() -> str:
+    """32-hex unique id (shared generator with ObjectRef ids)."""
+    return _ID_FMT(_id_counter())
+
+
+def _reseed_after_fork() -> None:
+    """A forked child inherits prefix AND counter state — both must
+    change or parent and child mint identical ids."""
+    global _ID_PREFIX, _id_counter, _ID_FMT
+    _ID_PREFIX = os.urandom(8).hex()
+    _id_counter = iter(range(1, 1 << 62)).__next__
+    _ID_FMT = (_ID_PREFIX + "%016x").__mod__
+
+
+os.register_at_fork(after_in_child=_reseed_after_fork)
 
 
 class BaseID:

@@ -23,6 +23,47 @@ from ray_tpu._private import rpc
 from ray_tpu._private.config import GLOBAL_CONFIG
 
 
+class _ZygotePid:
+    """Popen-shaped handle for a worker forked by the node's zygote
+    (the zygote is the OS parent and auto-reaps; this handle can only
+    signal and poll liveness)."""
+
+    def __init__(self, pid: int):
+        self.pid = pid
+
+    def poll(self):
+        try:
+            os.kill(self.pid, 0)
+            return None
+        except OSError:
+            return 0
+
+    def send_signal(self, signum: int) -> None:
+        os.kill(self.pid, signum)
+
+    def terminate(self) -> None:
+        try:
+            os.kill(self.pid, 15)
+        except OSError:
+            pass
+
+    def kill(self) -> None:
+        try:
+            os.kill(self.pid, 9)
+        except OSError:
+            pass
+
+    def wait(self, timeout: "float | None" = None):
+        import time as _time
+
+        deadline = None if timeout is None else _time.time() + timeout
+        while self.poll() is None:
+            if deadline is not None and _time.time() > deadline:
+                raise subprocess.TimeoutExpired("zygote-child", timeout)
+            _time.sleep(0.02)
+        return 0
+
+
 class NodeAgent:
     def __init__(
         self,
@@ -56,6 +97,16 @@ class NodeAgent:
         self.store = ShmArena(self.store_name, self.store_capacity)
         self.local_objects: dict[str, tuple[int, int]] = {}  # id -> (off, size)
         self._store_lock = threading.Lock()
+        # Raw-socket bulk plane for payload pulls (reference:
+        # push_manager.h chunked transfer); the rpc transfer server
+        # keeps the control ops (alloc/seal/abort) and stays as the
+        # legacy pull fallback. Reads pin the object so a concurrent
+        # free cannot recycle the region mid-send.
+        self._pull_pins: dict[str, int] = {}
+        self._pending_free: set[str] = set()
+        from ray_tpu._private.bulk_transfer import BulkServer
+
+        self.bulk_server = BulkServer(self._bulk_read)
         self.transfer_server = rpc.Server(self._transfer_handle,
                                           host="0.0.0.0", port=0)
         self.conn = rpc.connect(
@@ -72,6 +123,7 @@ class NodeAgent:
                 "labels": self._labels,
                 "address": socket.gethostname(),
                 "transfer_port": self.transfer_server.address[1],
+                "bulk_port": self.bulk_server.address[1],
             },
             timeout=GLOBAL_CONFIG.worker_register_timeout_s,
         )
@@ -135,6 +187,7 @@ class NodeAgent:
                         "labels": self._labels,
                         "address": socket.gethostname(),
                         "transfer_port": self.transfer_server.address[1],
+                        "bulk_port": self.bulk_server.address[1],
                     },
                     timeout=GLOBAL_CONFIG.worker_register_timeout_s,
                 )
@@ -215,14 +268,47 @@ class NodeAgent:
                 except OSError:
                     pass
         elif kind == "free_object":
-            # Head directory says the object's refcount hit zero.
+            # Head directory says the object's refcount hit zero. An
+            # in-flight bulk read defers the free to its pin release.
             with self._store_lock:
-                loc = self.local_objects.pop(body["object_id"], None)
-                if loc is not None:
-                    self.store.free(loc[0])
+                oid = body["object_id"]
+                if self._pull_pins.get(oid):
+                    self._pending_free.add(oid)
+                else:
+                    loc = self.local_objects.pop(oid, None)
+                    if loc is not None:
+                        self.store.free(loc[0])
         elif kind == "shutdown_node":
             self._exit.set()
         return None
+
+    def _bulk_read(self, object_id: str, start: int, length: int):
+        with self._store_lock:
+            loc = self.local_objects.get(object_id)
+            if loc is None:
+                raise KeyError(f"object {object_id} not on this node")
+            offset, size = loc
+            if start >= size:
+                raise ValueError(f"start {start} past object size {size}")
+            n = min(length, size - start)
+            self._pull_pins[object_id] = self._pull_pins.get(object_id, 0) + 1
+            view = self.store.view(offset + start, n)
+
+        def release(object_id=object_id, view=view):
+            view.release()
+            with self._store_lock:
+                left = self._pull_pins.get(object_id, 1) - 1
+                if left <= 0:
+                    self._pull_pins.pop(object_id, None)
+                    if object_id in self._pending_free:
+                        self._pending_free.discard(object_id)
+                        loc2 = self.local_objects.pop(object_id, None)
+                        if loc2 is not None:
+                            self.store.free(loc2[0])
+                else:
+                    self._pull_pins[object_id] = left
+
+        return view, release
 
     def _transfer_handle(self, kind: str, body: dict, conn: rpc.Connection):
         """Store-plane RPCs: local workers allocate/seal; remote nodes
@@ -238,9 +324,20 @@ class NodeAgent:
             return {"offset": offset}
         if kind == "seal_local":
             with self._store_lock:
+                existing = self.local_objects.get(body["object_id"])
+                if existing is not None:
+                    # Duplicate seal (N workers replicating the same
+                    # broadcast payload concurrently): keep the first
+                    # copy, free the newcomer's allocation, and tell the
+                    # caller which offset is canonical — otherwise every
+                    # extra copy leaks until agent shutdown and a
+                    # replica registration could point at a freed
+                    # region.
+                    self.store.free(body["offset"])
+                    return {"offset": existing[0], "dup": True}
                 self.local_objects[body["object_id"]] = (
                     body["offset"], body["size"])
-            return {}
+            return {"offset": body["offset"], "dup": False}
         if kind == "pull":
             with self._store_lock:
                 loc = self.local_objects.get(body["object_id"])
@@ -299,14 +396,34 @@ class NodeAgent:
             os.environ.get("TMPDIR", "/tmp"), "ray_tpu_agent", self.node_id, "logs"
         )
         os.makedirs(log_dir, exist_ok=True)
-        with open(os.path.join(log_dir, f"{worker_id}.log"), "ab") as out:
-            proc = subprocess.Popen(
-                [sys.executable, "-m", "ray_tpu._private.worker"],
-                env=env,
-                stdout=out,
-                stderr=subprocess.STDOUT,
-                cwd=os.getcwd(),
-            )  # child keeps its inherited fd; parent must not leak one per spawn
+        proc = None
+        if not body.get("tpu_capable"):
+            # Fork from this node's zygote (reference: warm raylet
+            # worker pool, worker_pool.h:224) — see gcs.spawn_worker.
+            zy = getattr(self, "_zygote", None)
+            if zy is None:
+                from ray_tpu._private.zygote import ZygoteClient
+
+                zyenv = dict(env)
+                for k in ("RAY_TPU_WORKER_ID", "RAY_TPU_NODE_ID"):
+                    zyenv.pop(k, None)
+                zy = self._zygote = ZygoteClient(zyenv, log_dir)
+                zy.start_async()  # first spawn falls back to Popen
+            pid = zy.spawn(
+                {k: env[k] for k in env
+                 if k.startswith("RAY_TPU_")},
+                os.path.join(log_dir, f"{worker_id}.log"))
+            if pid is not None:
+                proc = _ZygotePid(pid)
+        if proc is None:
+            with open(os.path.join(log_dir, f"{worker_id}.log"), "ab") as out:
+                proc = subprocess.Popen(
+                    [sys.executable, "-m", "ray_tpu._private.worker"],
+                    env=env,
+                    stdout=out,
+                    stderr=subprocess.STDOUT,
+                    cwd=os.getcwd(),
+                )  # child keeps inherited fd; parent must not leak one per spawn
         self.procs[worker_id] = proc
         # Best-effort cgroup v2 isolation (reference: cgroup_setup.h).
         from ray_tpu._private.cgroup import CgroupSetup
@@ -318,6 +435,9 @@ class NodeAgent:
         self.shutdown()
 
     def shutdown(self) -> None:
+        zy = getattr(self, "_zygote", None)
+        if zy is not None:
+            zy.stop()
         for proc in self.procs.values():
             if proc.poll() is None:
                 proc.kill()
@@ -333,6 +453,10 @@ class NodeAgent:
             cg.teardown()
         try:
             self.transfer_server.stop()
+        except Exception:
+            pass
+        try:
+            self.bulk_server.stop()
         except Exception:
             pass
         try:

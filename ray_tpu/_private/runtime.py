@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import hashlib
 import os
+import pickle
 import threading
 import uuid
 from concurrent.futures import Future
@@ -105,6 +106,9 @@ class CoreRuntime:
     ):
         self._waiters: dict[str, Future] = {}
         self._waiters_lock = threading.Lock()
+        # Worker-installed hook invoked before a blocking get/wait (the
+        # pipelined-task deadlock escape — see Worker._on_will_block).
+        self._pre_block = None
         self._message_handler = message_handler
         self._closed = False
         self.client_type = client_type
@@ -392,8 +396,11 @@ class CoreRuntime:
             view = self.agent_shm.view(offset, size)
             serialization.write_to(view, header, buffers)
             view.release()
-            self._agent().call("seal_local", {
+            reply = self._agent().call("seal_local", {
                 "object_id": object_id, "offset": offset, "size": size})
+            # A concurrent seal of the same id (retry race) kept its
+            # copy and freed ours — register the canonical offset.
+            offset = reply.get("offset", offset)
             sealed = True
             self.conn.call("put_p2p", {
                 "object_id": object_id, "node_id": self.node_id,
@@ -439,7 +446,69 @@ class CoreRuntime:
                     pass
             raise
 
+    def _replicate_local(self, object_id: str, payload) -> None:
+        """Cache a remotely-pulled payload in this node's agent store and
+        register as a replica source (spanning-tree broadcast fan-out;
+        reference: push_manager.h:32). Best-effort: any failure just
+        means this node doesn't become a source."""
+        try:
+            # Let the active broadcast wave finish first: the cache
+            # write is a size-sized memcpy that would otherwise compete
+            # with concurrent pulls for the same core/NIC. Replicas pay
+            # off on LATER pulls (stragglers, second waves, recovery).
+            import time as _time
+
+            _time.sleep(GLOBAL_CONFIG.bulk_replicate_delay_s)
+            size = len(payload)
+            offset = self._agent().call("alloc", {"size": size})["offset"]
+            try:
+                view = self.agent_shm.view(offset, size)
+                view[:] = payload
+                view.release()
+                sealed = self._agent().call("seal_local", {
+                    "object_id": object_id, "offset": offset, "size": size})
+                # A concurrent replicator won: the agent kept ITS copy
+                # and freed ours — register the canonical offset.
+                offset = sealed.get("offset", offset)
+            except BaseException:
+                try:
+                    self._agent().call("abort_alloc", {"offset": offset})
+                except Exception:
+                    pass
+                raise
+            self.conn.cast("add_replica", {
+                "object_id": object_id, "node_id": self.node_id,
+                "offset": offset, "size": size})
+        except Exception:
+            pass
+
     def _pull_p2p(self, object_id: str, addr: tuple, size: int) -> bytes:
+        """Bulk-plane pull: parallel raw-socket stripes, recv_into a
+        single buffer (one copy end to end). The directory TAGS legacy
+        rpc transfer addresses with a third element ("rpc") — the two
+        protocols are never guessed at (a bulk frame misread as an rpc
+        length would block the reader indefinitely)."""
+        if len(addr) == 3 and addr[2] == "rpc":
+            return self._pull_p2p_legacy(object_id, addr[:2], size)
+        host, port = addr
+        if not host:
+            host = self.address[0]  # "" = the head host this client dialed
+        from ray_tpu._private import bulk_transfer
+
+        try:
+            return bulk_transfer.pull_object(
+                (host, port), object_id, size,
+                streams=GLOBAL_CONFIG.bulk_streams)
+        except (bulk_transfer.BulkError, OSError):
+            # One retry: transient resets / a replica freed between the
+            # meta and the pull. The retry scope upstream
+            # (_read_p2p_retrying) re-resolves the meta on failure.
+            return bytes(bulk_transfer.pull_object(
+                (host, port), object_id, size,
+                streams=GLOBAL_CONFIG.bulk_streams))
+
+    def _pull_p2p_legacy(self, object_id: str, addr: tuple,
+                         size: int) -> bytes:
         """Chunked pull from the hosting node's agent (reference:
         pull_manager.h:57)."""
         key = tuple(addr)
@@ -453,7 +522,8 @@ class CoreRuntime:
         while pos < size:
             reply = conn.call("pull", {"object_id": object_id,
                                        "start": pos,
-                                       "length": min(chunk, size - pos)})
+                                       "length": min(chunk, size - pos)},
+                              timeout=120)
             data = reply["data"]
             buf[pos:pos + len(data)] = data
             pos += len(data)
@@ -531,9 +601,17 @@ class CoreRuntime:
         round trip per task). Values too big to inline are stored
         through the normal path HERE (serialized exactly once) and None
         is returned."""
-        with serialization.collect_refs() as collected:
-            header, buffers = serialization.serialize(value)
-        contained = sorted(set(collected))
+        if (type(value) in self._SCALAR_TYPES
+                and not serialization.custom_reducers):
+            # Scalar result: provably no ObjectRefs / device arrays —
+            # skip the ref-collecting Python-class pickler (was ~70 us
+            # per nop-task result, the worker's hottest line).
+            header, buffers, contained = (
+                pickle.dumps(value, protocol=5), [], [])
+        else:
+            with serialization.collect_refs() as collected:
+                header, buffers = serialization.serialize(value)
+            contained = sorted(set(collected))
         size = serialization.serialized_size(header, buffers)
         if size > GLOBAL_CONFIG.max_inline_object_size:
             self._store_serialized(object_id, header, buffers, size,
@@ -548,6 +626,12 @@ class CoreRuntime:
         if not ref_list:
             return [] if not single else None
         id_list = [r.hex() for r in ref_list]
+        unblock = None
+        if self._pre_block is not None:
+            try:
+                unblock = self._pre_block()
+            except Exception:
+                pass
         waiter_id, fut = self._new_waiter()
         self.conn.cast("get_meta", {"waiter_id": waiter_id, "ids": id_list})
         try:
@@ -558,6 +642,8 @@ class CoreRuntime:
         finally:
             with self._waiters_lock:
                 self._waiters.pop(waiter_id, None)
+            if unblock is not None:
+                unblock()
         metas = body["metas"]
         values = []
         read_ids = []
@@ -711,7 +797,16 @@ class CoreRuntime:
             raise ObjectLostError(
                 f"object {object_id} lives on node {node_id} with no "
                 f"reachable transfer server")
-        return self._pull_p2p(object_id, addr, size), is_error
+        payload = self._pull_p2p(object_id, addr, size)
+        if (self.agent_shm is not None and not is_error
+                and node_id != self.node_id
+                and size >= GLOBAL_CONFIG.bulk_replicate_min):
+            # Become a broadcast source for later pullers (off the get
+            # path — the caller shouldn't wait on the cache write).
+            threading.Thread(target=self._replicate_local,
+                             args=(object_id, payload), daemon=True,
+                             name="p2p-replicate").start()
+        return payload, is_error
 
     def _read_shm_zero_copy(self, hex_id: str, view) -> Any:
         """Deserialize directly out of the store mapping; see
@@ -765,6 +860,12 @@ class CoreRuntime:
     ) -> tuple[list[ObjectRef], list[ObjectRef]]:
         id_list = [r.hex() for r in refs]
         by_id = {r.hex(): r for r in refs}
+        unblock = None
+        if self._pre_block is not None:
+            try:
+                unblock = self._pre_block()
+            except Exception:
+                pass
         waiter_id, fut = self._new_waiter()
         self.conn.cast(
             "wait", {"waiter_id": waiter_id, "ids": id_list, "num_returns": num_returns}
@@ -775,6 +876,9 @@ class CoreRuntime:
         except FutureTimeoutError:
             self.conn.cast("cancel_wait", {"waiter_id": waiter_id})
             ready_ids = self.conn.call("wait_check", {"ids": id_list})["ready"]
+        finally:
+            if unblock is not None:
+                unblock()
         ready_set = set(ready_ids[:num_returns])
         ready = [by_id[i] for i in id_list if i in ready_set]
         not_ready = [by_id[i] for i in id_list if i not in ready_set]
@@ -865,6 +969,12 @@ class CoreRuntime:
     # ------------------------------------------------------------------
     # tasks / actors
 
+    # Exact-type scalars: args made only of these cannot contain an
+    # ObjectRef at any depth, so the ref-collecting (Python-class)
+    # pickler pass is provably unnecessary — the C pickler runs ~10x
+    # faster on the small-arg tasks that dominate flood workloads.
+    _SCALAR_TYPES = frozenset({int, float, str, bytes, bool, type(None)})
+
     @staticmethod
     def pack_args(args: tuple,
                   kwargs: dict) -> tuple[bytes, list[str], list[str]]:
@@ -873,6 +983,10 @@ class CoreRuntime:
         borrowed are refs nested inside containers — passed as-is but
         pinned for the task's flight (reference: reference_count.h
         serialized-ref borrows)."""
+        scalars = CoreRuntime._SCALAR_TYPES
+        if (not kwargs and not serialization.custom_reducers
+                and all(type(a) in scalars for a in args)):
+            return pickle.dumps((args, {}), protocol=5), [], []
         deps = [
             a.hex() for a in list(args) + list(kwargs.values())
             if isinstance(a, ObjectRef)
@@ -883,10 +997,13 @@ class CoreRuntime:
         return packed, deps, borrowed
 
     def submit_task(self, spec: TaskSpec) -> None:
-        self.conn.cast("submit_task", {"spec": spec})
+        # Buffered: a submission burst ships as one CAST_BATCH frame.
+        # Ordering vs a following get/wait is preserved because every
+        # call()/cast() on the connection flushes the buffer first.
+        self.conn.cast_buffered("submit_task", {"spec": spec})
 
     def submit_actor_task(self, spec: TaskSpec) -> None:
-        self.conn.cast("submit_actor_task", {"spec": spec})
+        self.conn.cast_buffered("submit_actor_task", {"spec": spec})
 
     def create_actor(self, spec: ActorSpec) -> None:
         self.conn.call("create_actor", {"spec": spec})

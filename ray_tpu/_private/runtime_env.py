@@ -32,6 +32,7 @@ from __future__ import annotations
 import hashlib
 import io
 import os
+import re
 import sys
 import zipfile
 
@@ -73,13 +74,12 @@ def pack(runtime_env: dict | None, rt) -> dict | None:
     to URIs (reference: working_dir.py upload_package_if_needed)."""
     if not runtime_env:
         return runtime_env
-    for bad in ("conda", "uv"):
-        if runtime_env.get(bad):
-            raise ValueError(
-                f"runtime_env[{bad!r}] manages whole interpreter "
-                f"environments; pre-install in the worker image, or use "
-                f"runtime_env['pip'] with local wheels (find_links)"
-            )
+    if runtime_env.get("uv"):
+        raise ValueError(
+            "runtime_env['uv'] manages whole interpreter environments; "
+            "pre-install in the worker image, or use runtime_env['pip'] "
+            "/ runtime_env['conda'] with local wheels (find_links)"
+        )
     for bad in ("container", "image_uri"):
         if runtime_env.get(bad):
             raise ValueError(
@@ -112,6 +112,20 @@ def pack(runtime_env: dict | None, rt) -> dict | None:
             rt.kv_put(uri, blob, ns="__runtime_env__", overwrite=False)
             spec["find_links"] = uri
         env["pip"] = spec
+    if env.get("conda"):
+        spec = normalize_conda_spec(env["conda"])
+        fl = spec.get("find_links")
+        if fl and not fl.startswith(("pkg:", "http://", "https://",
+                                     "file://")):
+            if not os.path.isdir(fl):
+                raise ValueError(
+                    f"runtime_env conda find_links {fl!r} is not a "
+                    f"directory on the driver")
+            blob = _zip_dir(fl)
+            uri = "pkg:" + hashlib.sha256(blob).hexdigest()[:32]
+            rt.kv_put(uri, blob, ns="__runtime_env__", overwrite=False)
+            spec["find_links"] = uri
+        env["conda"] = spec
     if env.get("working_dir") and not str(env["working_dir"]).startswith("pkg:"):
         env["working_dir"] = upload(env["working_dir"])
     if env.get("py_modules"):
@@ -140,6 +154,126 @@ def normalize_pip_spec(spec) -> dict:
         if spec.get(key):
             out[key] = str(spec[key])
     return out
+
+
+def normalize_conda_spec(spec) -> dict:
+    """Conda-lite (reference: _private/runtime_env/conda.py — the
+    reference builds a full conda env; here a venv seeded from the
+    worker's interpreter, with the pip-package subset of the spec
+    resolved OFFLINE via find_links/index_url). Accepted forms:
+      - ["pkg==1.0", ...]                         (pip packages)
+      - {"packages": [...], "find_links"/"index_url": ...}
+      - conda-yaml style {"dependencies": ["python", {"pip": [...]}]}
+        — non-pip conda dependencies are rejected (no conda binary in
+        the zero-egress posture; python itself is allowed and ignored).
+    """
+    if isinstance(spec, (list, tuple)):
+        return {"packages": [str(p) for p in spec]}
+    if not isinstance(spec, dict):
+        raise ValueError("runtime_env['conda'] must be a list or dict")
+    if "dependencies" in spec:
+        pip_pkgs: list[str] = []
+        for dep in spec["dependencies"]:
+            if isinstance(dep, dict) and "pip" in dep:
+                pip_pkgs.extend(str(p) for p in dep["pip"])
+            elif isinstance(dep, str) and (
+                    dep == "pip"
+                    or re.fullmatch(r"python\s*([<>=!~].*)?", dep)):
+                # The interpreter/pip themselves: provided by the venv.
+                # ONLY an exact "python" (optionally version-pinned) —
+                # a prefix match would silently swallow real packages
+                # like python-dateutil.
+                continue
+            else:
+                raise ValueError(
+                    f"conda dependency {dep!r} needs the conda binary; "
+                    f"this conda-lite backend resolves only pip "
+                    f"packages (list them under a {{'pip': [...]}} "
+                    f"entry) from local wheels")
+        spec = {"packages": pip_pkgs, **{k: spec[k] for k in
+                                         ("find_links", "index_url")
+                                         if spec.get(k)}}
+    if not spec.get("packages"):
+        raise ValueError(
+            "runtime_env['conda'] resolved to no pip packages; use "
+            "{'packages': [...]} or conda-yaml {'dependencies': "
+            "[{'pip': [...]}]}")
+    out = {"packages": [str(p) for p in spec["packages"]]}
+    for key in ("find_links", "index_url"):
+        if spec.get(key):
+            out[key] = str(spec[key])
+    return out
+
+
+def _venv_env_dir(spec: dict, cache_dir: str,
+                  find_links_path: "str | None" = None) -> str:
+    """Build a content-hashed venv (--system-site-packages so the base
+    image's jax/numpy remain importable) and pip-install the spec into
+    it, once per node. Returns the venv root. Same lock/marker recipe as
+    _pip_env_dir; the venv's own pip runs offline by default."""
+    import shutil
+    import subprocess
+
+    key = hashlib.sha256(
+        ("venv:" + repr(sorted(spec.items()))).encode()).hexdigest()[:24]
+    target = os.path.join(cache_dir, "venvs", key)
+    marker = target + ".ok"
+    if os.path.exists(marker):
+        return target
+    os.makedirs(os.path.dirname(target), exist_ok=True)
+    import fcntl
+
+    with open(target + ".lock", "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)
+        try:
+            if os.path.exists(marker):
+                return target
+            tmp = target + f".tmp{os.getpid()}"
+            shutil.rmtree(tmp, ignore_errors=True)
+            proc = subprocess.run(
+                [sys.executable, "-m", "venv", "--system-site-packages",
+                 "--without-pip", tmp],
+                capture_output=True, text=True, timeout=300)
+            if proc.returncode != 0:
+                shutil.rmtree(tmp, ignore_errors=True)
+                raise RuntimeError(
+                    f"venv creation failed: {proc.stderr[-1000:]}")
+            # Install with the PARENT interpreter's pip targeting the
+            # venv's site-packages (--without-pip venvs are cheap and
+            # ensurepip may be unavailable offline).
+            site = _venv_site(tmp)
+            cmd = [sys.executable, "-m", "pip", "install", "--quiet",
+                   "--no-cache-dir", "--target", site]
+            if spec.get("index_url"):
+                cmd += ["--index-url", spec["index_url"]]
+            else:
+                cmd += ["--no-index"]
+            if find_links_path or spec.get("find_links"):
+                cmd += ["--find-links",
+                        find_links_path or spec["find_links"]]
+            cmd += spec["packages"]
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=600)
+            if proc.returncode != 0:
+                shutil.rmtree(tmp, ignore_errors=True)
+                raise RuntimeError(
+                    f"runtime_env conda install failed "
+                    f"(rc={proc.returncode}): {proc.stderr[-2000:]}\n"
+                    f"(zero-egress default is --no-index: provide "
+                    f"'find_links' with local wheels, or an explicit "
+                    f"'index_url')")
+            shutil.rmtree(target, ignore_errors=True)
+            os.rename(tmp, target)
+            with open(marker, "w") as f:
+                f.write("ok")
+        finally:
+            fcntl.flock(lock, fcntl.LOCK_UN)
+    return target
+
+
+def _venv_site(root: str) -> str:
+    ver = f"python{sys.version_info[0]}.{sys.version_info[1]}"
+    return os.path.join(root, "lib", ver, "site-packages")
 
 
 def _pip_env_dir(spec: dict, cache_dir: str,
@@ -212,6 +346,7 @@ class AppliedEnv:
     def __init__(self):
         self._saved_cwd: str | None = None
         self._added_paths: list[str] = []
+        self._saved_env: dict[str, "str | None"] = {}
 
     def apply(self, runtime_env: dict | None, rt, cache_dir: str) -> None:
         if not runtime_env:
@@ -241,6 +376,25 @@ class AppliedEnv:
                 target = _pip_env_dir(spec, cache_dir)
             sys.path.insert(0, target)
             self._added_paths.append(target)
+        conda_spec = runtime_env.get("conda")
+        if conda_spec:
+            spec = normalize_conda_spec(conda_spec)
+            fl = spec.get("find_links")
+            if fl and fl.startswith("pkg:"):
+                local = _materialize(fl, rt, cache_dir)
+                root = _venv_env_dir(spec, cache_dir,
+                                     find_links_path=local)
+            else:
+                root = _venv_env_dir(spec, cache_dir)
+            site = _venv_site(root)
+            sys.path.insert(0, site)
+            self._added_paths.append(site)
+            # Child processes the task spawns see the venv too.
+            for k, v in (("VIRTUAL_ENV", root),
+                         ("PATH", os.path.join(root, "bin") + os.pathsep
+                          + os.environ.get("PATH", ""))):
+                self._saved_env.setdefault(k, os.environ.get(k))
+                os.environ[k] = v
 
     def undo(self) -> None:
         # Path scoping is exact; MODULES a task imported stay cached in
@@ -260,6 +414,12 @@ class AppliedEnv:
             except ValueError:
                 pass
         self._added_paths = []
+        for k, v in self._saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        self._saved_env = {}
 
 
 def _materialize(uri: str, rt, cache_dir: str) -> str:

@@ -75,10 +75,17 @@ class ShmArena:
         self._view = memoryview(self._buf).cast("B")
 
     def alloc(self, size: int) -> int | None:
+        if not self._h:
+            return None  # closed (shutdown raced an RPC handler)
         off = _LIB.store_alloc(self._h, size)
         return None if off == OOM else off
 
     def free(self, offset: int) -> int:
+        if not self._h:
+            # Closed arena: a late connection-close handler freeing
+            # entries after Head.shutdown must not call into the
+            # destroyed native allocator (segfault, not exception).
+            return 0
         return _LIB.store_free(self._h, offset)
 
     def view(self, offset: int, size: int) -> memoryview:
@@ -86,15 +93,15 @@ class ShmArena:
 
     @property
     def in_use(self) -> int:
-        return _LIB.store_in_use(self._h)
+        return _LIB.store_in_use(self._h) if self._h else 0
 
     @property
     def num_objects(self) -> int:
-        return _LIB.store_num_objects(self._h)
+        return _LIB.store_num_objects(self._h) if self._h else 0
 
     @property
     def largest_free(self) -> int:
-        return _LIB.store_largest_free(self._h)
+        return _LIB.store_largest_free(self._h) if self._h else 0
 
     def close(self, unlink: bool = True) -> None:
         if self._h:

@@ -11,7 +11,7 @@ import dataclasses
 from typing import Any
 
 
-@dataclasses.dataclass
+@dataclasses.dataclass(slots=True)
 class TaskSpec:
     task_id: str
     name: str
@@ -43,6 +43,43 @@ class TaskSpec:
     # immediately after a fire-and-forget submit (reference:
     # reference_count.h serialized-in-task-args borrows).
     borrowed_ids: list = dataclasses.field(default_factory=list)
+    # Scratch attributes the head/worker hang off a spec in flight —
+    # declared because the dataclass uses __slots__ (a 1M-task backlog
+    # at ~1 KB/dict-backed spec would cost a GB of pure dict overhead;
+    # slots roughly halves that and speeds dispatch-path attr access):
+    #   _rkey / _demand — head dispatch caches (queue key, ResourceSet)
+    #   _deps_pending   — unready-dependency set while dep-blocked
+    #   _deferred_results — worker-side buffer of inline results
+    _rkey: Any = dataclasses.field(default=None, repr=False)
+    _demand: Any = dataclasses.field(default=None, repr=False)
+    _deps_pending: Any = dataclasses.field(default=None, repr=False)
+    _deferred_results: Any = dataclasses.field(default=None, repr=False)
+
+    def __setstate__(self, state):
+        """Accept BOTH pickle state forms. The slotted class emits
+        (None, {slots}); the native C++ client (src/client/minipickle.h)
+        crafts streams with plain dict state, which default BUILD would
+        apply via __dict__ — absent here. Unset fields get their
+        declared defaults so older/foreign producers stay compatible."""
+        if isinstance(state, tuple):
+            d, s = state
+            merged = {**(d or {}), **(s or {})}
+        else:
+            merged = dict(state or {})
+        for f in dataclasses.fields(self):
+            if f.name in merged:
+                object.__setattr__(self, f.name, merged[f.name])
+            else:
+                try:
+                    getattr(self, f.name)
+                except AttributeError:
+                    if f.default is not dataclasses.MISSING:
+                        v = f.default
+                    elif f.default_factory is not dataclasses.MISSING:
+                        v = f.default_factory()
+                    else:
+                        v = None
+                    object.__setattr__(self, f.name, v)
 
 
 @dataclasses.dataclass

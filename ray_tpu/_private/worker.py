@@ -20,7 +20,9 @@ import inspect
 import os
 import sys
 import threading
+import time
 import traceback
+from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 
 import cloudpickle
@@ -103,6 +105,14 @@ class Worker:
                                               thread_name_prefix="actor-store")
         self._exit = threading.Event()
         self._cancelled_ids: set[str] = set()
+        # Normal-task fast path: pushes land in this deque and ONE
+        # drainer job runs them serially — a Future + work-item per task
+        # (~20 us of executor machinery) is pure overhead when the head
+        # pipelines a window of tasks onto this worker.
+        self._task_q: deque = deque()
+        self._drain_scheduled = False
+        self._drain_lock = threading.Lock()
+        self._drainer_tls = threading.local()
         self.runtime = CoreRuntime(
             head_addr,
             client_type="worker",
@@ -110,6 +120,7 @@ class Worker:
             message_handler=self._on_message,
         )
         worker_context.set_runtime(self.runtime)
+        self.runtime._pre_block = self._on_will_block
         # Driver/head gone -> exit (the connection is our lease).
         self.runtime.conn._on_close = lambda conn: os._exit(0)
         # Two-phase registration: the head dispatches nothing until this
@@ -127,6 +138,16 @@ class Worker:
                     self._run_task_async_guarded(spec),
                     on_error=lambda exc, s=spec: self._async_task_crashed(
                         s, exc))
+            elif (spec.actor_id is None and not spec.actor_creation
+                    and self.actor_instance is None
+                    and spec.concurrency_group is None):
+                with self._drain_lock:
+                    self._task_q.append((spec, body.get("tpu_chips")))
+                    start = not self._drain_scheduled
+                    if start:
+                        self._drain_scheduled = True
+                if start:
+                    self.executor.submit(self._drain_tasks)
             else:
                 self._executor_for(spec).submit(
                     self._run_task_guarded, spec, body.get("tpu_chips"))
@@ -155,6 +176,13 @@ class Worker:
                              daemon=True, name="profiler").start()
         elif kind == "kill":
             self._exit.set()
+            dump = globals().get("_profile_dump")
+            if dump is not None:
+                # os._exit skips atexit: dump the cProfile output here.
+                try:
+                    dump()
+                except Exception:
+                    pass
             os._exit(0)
         elif kind == "cancel":
             # Queued-but-not-started tasks (actor calls wait in this
@@ -464,6 +492,79 @@ class Worker:
 
     # ------------------------------------------------------------------
 
+    _cpu_acc = 0.0
+    _cpu_n = 0
+
+    def _on_will_block(self):
+        """Called by the runtime just before a blocking get/wait from a
+        task-executing thread; returns the unblock callback. Two escape
+        hatches against nested-get deadlocks (reference: core_worker
+        task-blocked protocol — blocked workers release their slot):
+          1. queued pipelined tasks hand off to an overflow drainer
+             (the head may have parked the awaited child HERE);
+          2. the head is told to release this worker's allocation so
+             the child can be placed when this was the last capacity."""
+        if not getattr(self._drainer_tls, "active", False):
+            return None
+        # This thread RETIRES as the active drainer either way (it
+        # finishes only its current task after unblocking): exactly one
+        # drainer executes queued tasks at any time, preserving the
+        # serial-execution invariant pipelined allocations rely on.
+        self._drainer_tls.retired = True
+        with self._drain_lock:
+            start = bool(self._task_q)
+            if not start:
+                # Queue empty now — but a task pushed while this thread
+                # is parked must start a FRESH drainer, not wait on us.
+                self._drain_scheduled = False
+        if start:
+            threading.Thread(target=self._drain_tasks, daemon=True,
+                             name="task-exec-overflow").start()
+        try:
+            self.runtime.conn.cast("worker_blocked",
+                                   {"worker_id": self.worker_id})
+        except Exception:
+            return None
+
+        def _unblock():
+            try:
+                self.runtime.conn.cast("worker_unblocked",
+                                       {"worker_id": self.worker_id})
+            except Exception:
+                pass
+
+        return _unblock
+
+    def _drain_tasks(self) -> None:
+        """Runs queued normal tasks until the deque empties (then the
+        next push schedules a fresh drainer) or until this thread is
+        retired by a nested-get hand-off (see _on_will_block)."""
+        self._drainer_tls.active = True
+        self._drainer_tls.retired = False
+        timing = os.environ.get("RAY_TPU_WORKER_TASK_TIMING")
+        while True:
+            with self._drain_lock:
+                if not self._task_q:
+                    if not self._drainer_tls.retired:
+                        self._drain_scheduled = False
+                    if timing and Worker._cpu_n and Worker._cpu_n % 2000 == 0:
+                        print(f"[task-cpu] {os.getpid()} "
+                              f"n={Worker._cpu_n} "
+                              f"avg={Worker._cpu_acc / Worker._cpu_n * 1e6:.1f}us",
+                              file=sys.stderr, flush=True)
+                    return
+                spec, chips = self._task_q.popleft()
+            if timing:
+                t0 = time.thread_time()
+                self._run_task_guarded(spec, chips)
+                Worker._cpu_acc += time.thread_time() - t0
+                Worker._cpu_n += 1
+            else:
+                self._run_task_guarded(spec, chips)
+            if self._drainer_tls.retired:
+                # A successor drainer owns the queue now.
+                return
+
     def _run_task_guarded(self, spec: TaskSpec, tpu_chips) -> None:
         import time
 
@@ -493,7 +594,7 @@ class Worker:
                 # core_worker/task_event_buffer.h:225 batches events for
                 # the same reason — the completion path is the control
                 # plane's hottest message).
-                self.runtime.conn.cast(
+                self.runtime.conn.cast_buffered(
                     "task_finished",
                     {
                         "worker_id": self.worker_id,
@@ -514,6 +615,13 @@ class Worker:
                         ],
                     },
                 )
+                # Draining a backlog: completions coalesce into one
+                # frame. Idle (nothing else queued on this executor):
+                # flush now so single-task latency stays sub-ms — the
+                # global ~1 ms flusher is only the backstop.
+                if (not self._task_q
+                        and self._executor_for(spec)._work_queue.empty()):
+                    self.runtime.conn.flush_casts()
             except Exception:
                 pass
 
@@ -526,7 +634,11 @@ class Worker:
         if tpu_chips:
             env_vars = dict(env_vars)
             env_vars["TPU_VISIBLE_CHIPS"] = ",".join(str(c) for c in tpu_chips)
-        elif spec.actor_id is None and "jax" not in sys.modules and "JAX_PLATFORMS" not in env_vars:
+        elif (spec.actor_id is None and "jax" not in sys.modules
+              and "JAX_PLATFORMS" not in env_vars
+              and os.environ.get("JAX_PLATFORMS") != "cpu"):
+            # (the != "cpu" check: hook-stripped pool workers already
+            # carry the pin — skip the per-task set/restore entirely)
             # Chipless task: keep this worker's (first) jax import off the
             # TPU. Applied on the executor thread with save/restore, so a
             # later TPU-leased task on this worker is unaffected.
@@ -554,6 +666,7 @@ class Worker:
                 spec.runtime_env.get("working_dir")
                 or spec.runtime_env.get("py_modules")
                 or spec.runtime_env.get("pip")
+                or spec.runtime_env.get("conda")
             ):
                 from ray_tpu._private.runtime_env import AppliedEnv
 
@@ -682,6 +795,55 @@ def main() -> None:
 
     faulthandler.register(signal.SIGUSR1, all_threads=True)
     head_host, head_port = os.environ["RAY_TPU_HEAD"].rsplit(":", 1)
+    # Worker-side profiling knob (reference analogue: py-spy/memray
+    # hooks in dashboard/modules/reporter/profile_manager.py): dump a
+    # cumulative cProfile of the executor thread at exit.
+    prof_dir = os.environ.get("RAY_TPU_WORKER_PROFILE")
+    if prof_dir:
+        import atexit
+        import cProfile
+        import threading as _threading
+
+        profiles: list = []
+        _orig_init = _threading.Thread.__init__
+
+        def _patched(self, *a, **k):
+            _orig_init(self, *a, **k)
+            if not (self.name or "").startswith(("task-exec", "group-")):
+                return  # profile executor threads only: wrapping the rpc
+                #         reader/writer threads perturbs registration
+            run = self.run
+
+            def run_prof():
+                pr = cProfile.Profile()
+                profiles.append(pr)
+                pr.enable()
+                try:
+                    run()
+                finally:
+                    pr.disable()
+
+            self.run = run_prof
+
+        _threading.Thread.__init__ = _patched
+
+        def _dump():
+            import pstats
+
+            os.makedirs(prof_dir, exist_ok=True)
+            stats = None
+            for p in profiles:
+                try:
+                    s = pstats.Stats(p)
+                except TypeError:
+                    continue  # thread never ran / empty profile
+                stats = s if stats is None else stats.add(s)
+            if stats is not None:
+                stats.dump_stats(os.path.join(
+                    prof_dir, f"worker_{os.getpid()}.prof"))
+
+        atexit.register(_dump)
+        globals()["_profile_dump"] = _dump
     worker = Worker(
         (head_host, int(head_port)),
         os.environ["RAY_TPU_WORKER_ID"],

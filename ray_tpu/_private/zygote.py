@@ -1,0 +1,173 @@
+"""Fork-server ("zygote") for chipless pool workers.
+
+Counterpart of the reference's pre-started worker-pool processes
+(reference: src/ray/raylet/worker_pool.h:224 — the raylet keeps warm
+workers so task/actor assignment costs one RPC, not an interpreter
+start). A fresh ``python -m ray_tpu._private.worker`` pays the full
+interpreter + package import (~300 ms hermetic, seconds with device-
+plugin site hooks). The zygote pays that ONCE: it imports the worker
+module single-threaded, then forks a child per spawn request (~5 ms),
+which applies its per-worker env and enters the normal worker main.
+
+Only chipless workers fork from the zygote — TPU-capable workers must
+run the device-plugin interpreter hooks at startup, and a forked,
+already-initialized runtime cannot re-bind chips safely.
+
+Protocol (line-JSON over stdin/stdout):
+    parent -> zygote: {"env": {...}, "log": "/path/worker.log"}
+    zygote -> parent: {"pid": 12345}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+
+
+def main() -> None:
+    # Auto-reap forked workers (the zygote is their parent).
+    signal.signal(signal.SIGCHLD, signal.SIG_IGN)
+    # The heavy import, paid once. MUST stay single-threaded up to the
+    # fork loop: forking a threaded process leaves dead locks behind.
+    from ray_tpu._private import worker as worker_mod
+
+    sys.stdout.write("READY\n")
+    sys.stdout.flush()
+    for line in sys.stdin:
+        if not line.strip():
+            continue
+        try:
+            req = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        pid = os.fork()
+        if pid == 0:
+            try:
+                os.setsid()
+                signal.signal(signal.SIGCHLD, signal.SIG_DFL)
+                fd = os.open(req["log"],
+                             os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+                os.dup2(fd, 1)
+                os.dup2(fd, 2)
+                os.close(fd)
+                os.close(0)
+                for k, v in req["env"].items():
+                    os.environ[k] = str(v)
+                worker_mod.main()
+            except BaseException:  # noqa: BLE001 — child must never
+                import traceback   # return into the zygote loop
+
+                traceback.print_exc()
+            finally:
+                os._exit(0)
+        sys.stdout.write(json.dumps({"pid": pid}) + "\n")
+        sys.stdout.flush()
+
+
+class ZygoteClient:
+    """Lazily starts and talks to one zygote process. Thread-safe.
+    ``spawn`` returns the worker pid, or None when the zygote path is
+    unavailable (caller falls back to a direct Popen)."""
+
+    def __init__(self, base_env: dict, log_dir: str):
+        self._base_env = dict(base_env)
+        self._log_dir = log_dir
+        self._proc: subprocess.Popen | None = None
+        self._lock = threading.Lock()
+        self._failed = False
+        self._ready = threading.Event()
+
+    def start_async(self) -> None:
+        """Warm the zygote off the caller's thread: callers that hold
+        hot locks (the head's dispatch path) must never block on the
+        worker-module import; spawn() just returns None (direct-Popen
+        fallback) until READY lands."""
+        threading.Thread(target=self._ensure, daemon=True,
+                         name="zygote-warmup").start()
+
+    def _ensure(self) -> bool:
+        with self._lock:
+            return self._ensure_locked()
+
+    def _ensure_locked(self) -> bool:
+        if self._proc is not None and self._proc.poll() is None:
+            return True
+        if self._failed:
+            return False
+        try:
+            os.makedirs(self._log_dir, exist_ok=True)
+            err = open(os.path.join(self._log_dir, "zygote.log"), "ab")
+            self._proc = subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu._private.zygote"],
+                env=self._base_env,
+                stdin=subprocess.PIPE,
+                stdout=subprocess.PIPE,
+                stderr=err,
+                cwd=os.getcwd(),
+                text=True,
+            )
+            err.close()
+            ready = self._proc.stdout.readline()
+            if ready.strip() != "READY":
+                raise RuntimeError(f"zygote failed to start: {ready!r}")
+            self._ready.set()
+            return True
+        except Exception:
+            self._failed = True
+            try:
+                if self._proc is not None:
+                    self._proc.kill()
+            except Exception:
+                pass
+            self._proc = None
+            return False
+
+    def spawn(self, extra_env: dict, log_path: str) -> "int | None":
+        if not self._ready.is_set():
+            # Not warmed yet (or died): never block a hot caller on the
+            # worker-module import — re-warm in the background and let
+            # this spawn fall back to a direct Popen.
+            if not self._failed:
+                self.start_async()
+            return None
+        with self._lock:
+            if self._proc is None or self._proc.poll() is not None:
+                # Died since READY: re-warm off-thread, caller falls
+                # back (never pay the import under a hot lock).
+                self._ready.clear()
+                self._proc = None
+                if not self._failed:
+                    self.start_async()
+                return None
+            try:
+                self._proc.stdin.write(
+                    json.dumps({"env": extra_env, "log": log_path}) + "\n")
+                self._proc.stdin.flush()
+                reply = self._proc.stdout.readline()
+                return int(json.loads(reply)["pid"])
+            except Exception:
+                # Zygote died mid-request: one restart attempt next call.
+                try:
+                    self._proc.kill()
+                except Exception:
+                    pass
+                self._proc = None
+                self._ready.clear()
+                return None
+
+    def stop(self) -> None:
+        with self._lock:
+            if self._proc is not None:
+                try:
+                    self._proc.kill()
+                except Exception:
+                    pass
+                self._proc = None
+
+
+if __name__ == "__main__":
+    main()

@@ -9,11 +9,10 @@ FIFO (or concurrently with ``max_concurrency`` > 1 — threaded actors).
 
 from __future__ import annotations
 
-import os
-import uuid
+
 
 from ray_tpu._private.config import GLOBAL_CONFIG
-from ray_tpu._private.ids import ActorID, ObjectRef
+from ray_tpu._private.ids import fast_hex_id, ActorID, ObjectRef
 from ray_tpu._private.task_spec import ActorSpec, TaskSpec
 from ray_tpu._private.worker_context import global_runtime
 from ray_tpu.remote_function import _normalize_resources, _pack_env
@@ -84,10 +83,10 @@ class ActorHandle:
         streaming = num_returns in ("streaming", "dynamic")
         if streaming:
             num_returns = 1
-        return_ids = [os.urandom(16).hex() for _ in range(num_returns)]
+        return_ids = [fast_hex_id() for _ in range(num_returns)]
         self._seq += 1
         spec = TaskSpec(
-            task_id="task-" + uuid.uuid4().hex[:12],
+            task_id="task-" + fast_hex_id(),
             name=f"actor.{method}",
             func_id="",  # resolved from the actor instance worker-side
             args=packed,
@@ -143,7 +142,7 @@ class ActorClass:
         opts = self._opts
         cls_func_id = rt.register_function(self._cls)
         packed, deps, borrowed = rt.pack_args(args, kwargs)
-        actor_id = "actor-" + uuid.uuid4().hex[:12]
+        actor_id = "actor-" + fast_hex_id()
         # Actors hold 0 CPUs while idle by default (many actors per node),
         # mirroring the reference's default actor resource semantics.
         spec = ActorSpec(

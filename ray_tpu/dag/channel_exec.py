@@ -151,6 +151,12 @@ def build_plan(root, channel_capacity: int,
                 "num_readers": len(reader_aids),
                 "writer": actor_of(n),
                 "transport": transport_for(actor_of(n), reader_aids),
+                # with_tensor_transport("device"): array leaves ride the
+                # JAX transfer fabric device-to-device; the channel
+                # below carries only descriptors (single reader — the
+                # transfer registration is consumed by one pull).
+                "device": (getattr(n, "_tensor_transport", "auto")
+                           == "device" and len(reader_aids) == 1),
             }
     input_chan = None
     if input_consumers:
@@ -229,6 +235,10 @@ def build_plan(root, channel_capacity: int,
             channels[plan["ready_channel"]] = {
                 "capacity": 1 << 16, "num_readers": 1,
                 "writer": aid, "transport": "shm"}
+            plan["channel_specs"] = {
+                name: channels[name]
+                for name in plan["read_channels"] + plan["write_channels"]
+            }
         else:
             # Two-phase flow: per-actor channel specs travel with the
             # plan; the task returns are the handshake.
@@ -249,6 +259,19 @@ def build_plan(root, channel_capacity: int,
                          [n._uuid for n in output_nodes]],
         "multi_output": isinstance(root, MultiOutputNode),
     }
+
+
+def maybe_device_wrap(ch, spec: "dict | None", *, writer: bool):
+    """Wrap a meta channel in the device-transport adapter when the
+    edge was declared with_tensor_transport("device")."""
+    if not spec or not spec.get("device"):
+        return ch
+    from ray_tpu.experimental.device_channel import (
+        DeviceChannelReader,
+        DeviceChannelWriter,
+    )
+
+    return DeviceChannelWriter(ch) if writer else DeviceChannelReader(ch)
 
 
 # Channels created in the setup phase, parked until the run phase
@@ -305,7 +328,7 @@ def actor_dag_loop(instance, plan: dict):
             else:
                 ch = Channel(capacity=spec["capacity"],
                              num_readers=spec["num_readers"], name=name)
-            writes[name] = ch
+            writes[name] = maybe_device_wrap(ch, spec, writer=True)
         _DAG_SETUP[plan["setup_key"]] = {"writes": writes}
         return endpoints
     if phase == "run":
@@ -318,18 +341,24 @@ def actor_dag_loop(instance, plan: dict):
         for name in plan["read_channels"]:
             spec = plan["channel_specs"][name]
             if spec["transport"] == "tcp":
-                reads[name] = TcpChannelReader(name, dial[name])
+                ch = TcpChannelReader(name, dial[name])
             else:
-                reads[name] = Channel(name=name, _create=False)
+                ch = Channel(name=name, _create=False)
+            reads[name] = maybe_device_wrap(ch, spec, writer=False)
         threading.Thread(
             target=_run_dag_loop, args=(instance, plan, reads, writes),
             daemon=True, name="dag-loop",
         ).start()
         return "started"
 
-    reads = {name: Channel(name=name, _create=False)
+    specs = plan.get("channel_specs", {})
+    reads = {name: maybe_device_wrap(
+                 Channel(name=name, _create=False),
+                 specs.get(name), writer=False)
              for name in plan["read_channels"]}
-    writes = {name: Channel(name=name, _create=False)
+    writes = {name: maybe_device_wrap(
+                  Channel(name=name, _create=False),
+                  specs.get(name), writer=True)
               for name in plan["write_channels"]}
     ready = Channel(name=plan["ready_channel"], _create=False)
     ready.write(b"ok")

@@ -63,19 +63,25 @@ class DAGNode:
         get NCCL p2p channels, torch_tensor_nccl_channel.py:44).
 
         TPU-native transports:
-          - "auto"/"shm": host shared-memory object store (default; device
-            arrays are fetched to host on serialization). The in-jit
-            shard_map pipeline is the chip-to-chip fast lane — DAG edges
-            are host-level by design (see package docstring).
+          - "auto"/"shm": host shared-memory object store (default;
+            device arrays are fetched to host on serialization).
+          - "device": device-resident edge — this node's output arrays
+            stay on the producing actor's device and the consumer pulls
+            them device-to-device over the JAX transfer fabric
+            (experimental/device_channel.py; the NCCL-channel analogue).
+            Bulk in-jit chip-to-chip movement still belongs to shard_map
+            + collectives (ray_tpu.parallel); device edges cover
+            cross-PROGRAM hand-offs between DAG actors.
           - "nccl": not applicable on TPU — raises with guidance.
         """
         if transport == "nccl":
             raise ValueError(
-                "NCCL transport does not exist on TPU; chip-to-chip "
-                "movement belongs inside the jitted program (shard_map + "
-                "collectives, ray_tpu.parallel). DAG edges use host shm."
+                "NCCL transport does not exist on TPU; use "
+                "with_tensor_transport('device') for device-resident DAG "
+                "edges (JAX transfer fabric), or shard_map + collectives "
+                "(ray_tpu.parallel) for in-program movement."
             )
-        if transport not in ("auto", "shm"):
+        if transport not in ("auto", "shm", "device"):
             raise ValueError(f"unknown tensor transport {transport!r}")
         self._tensor_transport = transport
         return self
@@ -261,10 +267,16 @@ class CompiledDAG:
             self._compile_mixed(plan)
             return
         # Driver creates every channel up front; actors open by name.
+        from ray_tpu.dag.channel_exec import maybe_device_wrap
+
         for name, spec in plan["channels"].items():
-            self._channels[name] = Channel(
+            ch = Channel(
                 capacity=spec["capacity"], num_readers=spec["num_readers"],
                 name=name)
+            # The driver only READS device-typed edges (outputs).
+            if name in plan["output_chans"]:
+                ch = maybe_device_wrap(ch, spec, writer=False)
+            self._channels[name] = ch
         self._plan = plan
         self._loop_refs = [
             ActorMethod(plan["handles"][aid],
@@ -344,15 +356,18 @@ class CompiledDAG:
                     pass
             raise
         # Open the driver's read side of the output channels.
+        from ray_tpu.dag.channel_exec import maybe_device_wrap
+
         for name in plan["output_chans"]:
             if name in self._channels:
                 continue
             spec = plan["channels"][name]
             if spec["transport"] == "tcp":
-                self._channels[name] = TcpChannelReader(name,
-                                                        endpoints[name])
+                ch = TcpChannelReader(name, endpoints[name])
             else:
-                self._channels[name] = Channel(name=name, _create=False)
+                ch = Channel(name=name, _create=False)
+            self._channels[name] = maybe_device_wrap(ch, spec,
+                                                     writer=False)
         self._mode = "channels"
 
     def _read_output(self, timeout_s: float) -> Any:

@@ -92,6 +92,73 @@ def _log_tail(name: str, max_bytes: int = 64 * 1024) -> dict:
     return {"name": name, "lines": text.splitlines()[-500:]}
 
 
+def _serve_apps() -> dict:
+    """Applications -> routes, deployments, replica breakdown."""
+    from ray_tpu import serve
+
+    try:
+        deployments = serve.status()
+    except Exception:
+        return {"apps": {}}
+    routes: dict = {}
+    try:
+        controller = ray_tpu.get_actor("SERVE_CONTROLLER", namespace="serve")
+        routes = ray_tpu.get(controller.get_routes.remote(), timeout=10)
+    except Exception:
+        pass
+    apps: dict = {}
+    for name, st in (deployments or {}).items():
+        app = st.get("app") or "default"
+        entry = apps.setdefault(app, {"deployments": {}, "routes": []})
+        entry["deployments"][name] = st
+    for prefix, info in (routes or {}).items():
+        for app, entry in apps.items():
+            if info.get("name") in entry["deployments"]:
+                entry["routes"].append(
+                    {"prefix": prefix, "deployment": info.get("name")})
+    return {"apps": apps}
+
+
+def _train_runs() -> list:
+    import json as _json
+
+    from ray_tpu._private.worker_context import global_runtime
+
+    rt = global_runtime()
+    runs = []
+    try:
+        for key in rt.kv_keys(ns="__train__"):
+            blob = rt.kv_get(key, ns="__train__")
+            if blob:
+                try:
+                    runs.append(_json.loads(blob))
+                except ValueError:
+                    pass
+    except Exception:
+        pass
+    runs.sort(key=lambda r: r.get("started_at", 0), reverse=True)
+    return runs
+
+
+def _node_detail(node_id: str) -> "dict | None":
+    """One node's page: identity, resources, its workers and tasks
+    (reference: dashboard node-detail view, dashboard/modules/node)."""
+    from ray_tpu.util import state as us
+
+    node = next((n for n in us.list_nodes()
+                 if n.get("node_id") == node_id), None)
+    if node is None:
+        return None
+    workers = [w for w in us.list_workers()
+               if w.get("node_id") == node_id]
+    tasks = [t for t in us.list_tasks()
+             if t.get("node_id") == node_id]
+    actors = [a for a in us.list_actors()
+              if a.get("node_id") == node_id]
+    return {"node": node, "workers": workers, "actors": actors,
+            "tasks": tasks[-200:]}
+
+
 class DashboardServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         self._host = host
@@ -143,6 +210,16 @@ class DashboardServer:
             from ray_tpu import serve
 
             return {"deployments": serve.status()}
+        if path == "/api/serve/apps":
+            # Application-level view (reference: dashboard/modules/serve
+            # — per-app pages: route prefixes, deployments, replicas).
+            return _serve_apps()
+        if path == "/api/train":
+            # Train run registry (reference: dashboard/modules/train —
+            # run list + latest metrics; fed by RunStateActor._publish).
+            return {"runs": _train_runs()}
+        if path.startswith("/api/nodes/"):
+            return _node_detail(path[len("/api/nodes/"):])
         if path.startswith("/api/profile/"):
             # Live stack dump of a worker (reference:
             # dashboard/modules/reporter/profile_manager.py:191 — py-spy

@@ -7,8 +7,6 @@ python/ray/_private/worker.py:3267).
 
 from __future__ import annotations
 
-import os
-import uuid
 from typing import Any
 
 from ray_tpu._private.config import GLOBAL_CONFIG
@@ -88,43 +86,63 @@ class RemoteFunction:
 
         return FunctionNode(self, args, kwargs)
 
-    def remote(self, *args, **kwargs):
-        import inspect
+    def _invariants(self) -> tuple:
+        """Options-derived fields computed once per RemoteFunction (the
+        reference caches the same way: RemoteFunction pre-computes its
+        TaskSpec template in remote_function.py:303 so per-call work is
+        args + ids only)."""
+        inv = self.__dict__.get("_inv")
+        if inv is None:
+            import inspect
 
+            opts = self._opts
+            nr_opt = opts.get("num_returns", 1)
+            # Generator functions stream by default (reference:
+            # _raylet.pyx streaming generators;
+            # num_returns="streaming"/"dynamic").
+            streaming = nr_opt in ("streaming", "dynamic") or (
+                nr_opt == 1 and inspect.isgeneratorfunction(self._fn)
+            )
+            inv = self._inv = (
+                streaming,
+                1 if streaming else int(nr_opt),
+                opts.get("name", self.__name__),
+                _normalize_resources(
+                    opts.get("num_cpus"),
+                    opts.get("num_tpus") or opts.get("num_gpus"),
+                    opts.get("memory"),
+                    opts.get("resources"),
+                ),
+                int(opts.get("max_retries",
+                             GLOBAL_CONFIG.task_max_retries_default)),
+                opts.get("scheduling_strategy"),
+            )
+        return inv
+
+    def remote(self, *args, **kwargs):
         from ray_tpu import api
+        from ray_tpu._private.ids import fast_hex_id
 
         api.auto_init()
         rt = global_runtime()
         opts = self._opts
-        nr_opt = opts.get("num_returns", 1)
-        # Generator functions stream by default (reference: _raylet.pyx
-        # streaming generators; num_returns="streaming"/"dynamic").
-        streaming = nr_opt in ("streaming", "dynamic") or (
-            nr_opt == 1 and inspect.isgeneratorfunction(self._fn)
-        )
-        num_returns = 1 if streaming else int(nr_opt)
+        streaming, num_returns, name, resources, max_retries, strategy = (
+            self._invariants())
         func_id = rt.register_function(self._fn)
         packed, deps, borrowed = rt.pack_args(args, kwargs)
-        return_ids = [os.urandom(16).hex() for _ in range(num_returns)]
+        return_ids = [fast_hex_id() for _ in range(num_returns)]
         spec = TaskSpec(
-            task_id="task-" + uuid.uuid4().hex[:12],
-            name=opts.get("name", self.__name__),
+            task_id="task-" + fast_hex_id(),
+            name=name,
             func_id=func_id,
             args=packed,
             deps=deps,
             borrowed_ids=borrowed,
             return_ids=return_ids,
-            resources=_normalize_resources(
-                opts.get("num_cpus"),
-                opts.get("num_tpus") or opts.get("num_gpus"),
-                opts.get("memory"),
-                opts.get("resources"),
-            ),
+            resources=resources,
             owner_id=rt.client_id,
-            max_retries=int(
-                opts.get("max_retries", GLOBAL_CONFIG.task_max_retries_default)
-            ),
-            scheduling_strategy=opts.get("scheduling_strategy"),
+            max_retries=max_retries,
+            scheduling_strategy=strategy,
             runtime_env=_pack_env(opts.get("runtime_env"), rt),
             streaming=streaming,
         )

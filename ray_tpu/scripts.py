@@ -41,6 +41,10 @@ def cmd_start(args) -> int:
             # Head FT: persist durable tables; a restart with the same
             # path restores them (reference: redis-backed GCS state).
             cfg.gcs_snapshot_path = args.snapshot_path
+        if getattr(args, "external_store", None):
+            # Cross-node head HA: durable state in a shared store; a
+            # fresh head anywhere restores it (redis_store_client.h:111).
+            cfg.gcs_external_store = args.external_store
         head = Head(cfg, num_cpus=args.num_cpus, num_tpus=args.num_tpus,
                     resources=json.loads(args.resources) if args.resources else None)
         host, port = head.address
@@ -222,6 +226,9 @@ def main(argv: list[str] | None = None) -> int:
     sp.add_argument("--head", action="store_true")
     sp.add_argument("--snapshot-path", default=None,
                     help="head FT: snapshot file for durable state")
+    sp.add_argument("--external-store", default=None,
+                    help="head HA: shared store URI (file:///dir) — a "
+                         "fresh head on any node restores cluster state")
     sp.add_argument("--address", default=None, help="join an existing head")
     sp.add_argument("--host", default="127.0.0.1")
     sp.add_argument("--port", type=int, default=6380)

@@ -107,6 +107,7 @@ class JaxTrainer:
         ckpt_config = self.run_config.checkpoint_config or CheckpointConfig()
 
         state = RunStateActor.remote(storage, ckpt_config)
+        state.set_run_info.remote(name, scaling.num_workers)
         failures_left = failure_config.max_failures
         latest_ckpt: str | None = None
         start_iteration = 0
@@ -155,6 +156,9 @@ class JaxTrainer:
             finally:
                 group.shutdown()
 
+        state.finish_run.remote("ERRORED" if error is not None else
+                                "FINISHED",
+                                repr(error) if error is not None else None)
         history = ray_tpu.get(state.get_history.remote())
         best = ray_tpu.get(state.best_checkpoint_path.remote())
         result = Result(

@@ -36,12 +36,56 @@ class RunStateActor:
             score_order=ckpt_cfg.checkpoint_score_order,
         )
         self.history: list[dict] = []
+        self.storage_path = storage_path
+        self._run_info: dict | None = None
+
+    def set_run_info(self, name: str, num_workers: int) -> bool:
+        """Register this run in the cluster KV so the dashboard's Train
+        page can list live/finished runs (reference:
+        dashboard/modules/train — run registry fed by the controller)."""
+        import time as _time
+
+        self._run_info = {
+            "name": name, "status": "RUNNING",
+            "num_workers": num_workers, "storage": self.storage_path,
+            "started_at": _time.time(), "iterations": 0,
+            "last_metrics": {},
+        }
+        self._publish()
+        return True
+
+    def finish_run(self, status: str, error: "str | None" = None) -> bool:
+        if self._run_info is not None:
+            self._run_info["status"] = status
+            if error:
+                self._run_info["error"] = error
+            self._publish()
+        return True
+
+    def _publish(self) -> None:
+        import json as _json
+
+        if self._run_info is None:
+            return
+        info = dict(self._run_info,
+                    iterations=len(self.history),
+                    last_metrics=self.history[-1] if self.history else {},
+                    best_checkpoint=self.best_checkpoint_path())
+        try:
+            from ray_tpu._private.worker_context import global_runtime
+
+            global_runtime().kv_put(
+                info["name"], _json.dumps(info, default=str).encode(),
+                ns="__train__")
+        except Exception:
+            pass  # registry is best-effort observability
 
     def report(self, rank: int, iteration: int, metrics: dict, ckpt_staging_path: str | None):
         if ckpt_staging_path is not None:
             self.manager.register(ckpt_staging_path, metrics)
         if rank == 0:
             self.history.append(dict(metrics, training_iteration=iteration))
+            self._publish()
         return True
 
     def get_history(self) -> list[dict]:

@@ -306,3 +306,86 @@ def test_head_restart_readopts_node_agent(tmp_path):
         for p in (agent, head):
             if p.poll() is None:
                 p.kill()
+
+
+def _start_head_store(port: int, store_uri: str) -> subprocess.Popen:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu.scripts", "start", "--head",
+         "--port", str(port), "--num-cpus", "4",
+         "--external-store", store_uri],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True,
+    )
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        line = proc.stdout.readline()
+        if "head up at" in line:
+            return proc
+        if proc.poll() is not None:
+            raise RuntimeError(f"head exited rc={proc.returncode}")
+    raise TimeoutError("head did not come up")
+
+
+def test_external_store_head_ha(tmp_path):
+    """External-store head HA (reference: redis_store_client.h:111):
+    durable state lives in a shared store (file:// dir here, standing in
+    for NFS/remote storage), NOT in the head's node-local files. Kill -9
+    the head and start a brand-new head process pointed only at the
+    store URI: the detached actor restarts, KV survives, and the driver
+    reconnects — nothing from the dead head's local state is needed."""
+    port = _free_port()
+    store_uri = f"file://{tmp_path / 'shared-store'}"
+    head = _start_head_store(port, store_uri)
+    try:
+        ray_tpu.init(address=f"127.0.0.1:{port}")
+
+        @ray_tpu.remote(max_restarts=1, name="ha-actor",
+                        lifetime="detached")
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def bump(self):
+                self.n += 1
+                return self.n
+
+        c = Counter.remote()
+        assert ray_tpu.get(c.bump.remote(), timeout=30) == 1
+
+        from ray_tpu._private.worker_context import global_runtime
+
+        rt = global_runtime()
+        rt.kv_put("ha-key", b"ha-value", ns="ha")
+        time.sleep(2.5)  # snapshot interval flush
+
+        head.send_signal(signal.SIGKILL)
+        head.wait(timeout=10)
+
+        # The "other node": a completely fresh head process whose only
+        # link to the old cluster is the shared store URI.
+        head = _start_head_store(port, store_uri)
+
+        def driver_ok():
+            @ray_tpu.remote
+            def ping():
+                return "pong"
+
+            return ray_tpu.get(ping.remote(), timeout=10) == "pong"
+
+        assert _wait_for(driver_ok, 60, "driver reconnect")
+        assert rt.kv_get("ha-key", ns="ha") == b"ha-value"
+
+        def actor_back():
+            h = ray_tpu.get_actor("ha-actor")
+            return ray_tpu.get(h.bump.remote(), timeout=10)
+
+        assert _wait_for(actor_back, 60, "actor restart") == 1
+    finally:
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+        if head.poll() is None:
+            head.kill()

@@ -133,3 +133,37 @@ def test_process_runtime_env_refcounted():
         assert wc.get_process_runtime_env() == base
     finally:
         wc.set_process_base_runtime_env(None)
+
+
+def test_pipelined_nested_get_no_deadlock():
+    """Same-shape pipelining (r4 control-plane) parks child tasks on a
+    busy worker's queue; a parent task blocking on its OWN nested child
+    must hand the queue to an overflow drainer instead of deadlocking
+    (Worker._on_will_block). Depth-3 nesting exercises the recursive
+    hand-off."""
+    import ray_tpu
+
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=1, object_store_memory=32 * 1024 * 1024)
+    try:
+        @ray_tpu.remote
+        def leaf(x):
+            return x + 1
+
+        @ray_tpu.remote
+        def mid(x):
+            return ray_tpu.get(leaf.remote(x)) + 10
+
+        @ray_tpu.remote
+        def top(x):
+            return ray_tpu.get(mid.remote(x)) + 100
+
+        # One CPU => one pool worker: every nested child is pipelined
+        # onto the same (blocked) worker.
+        assert ray_tpu.get(top.remote(1), timeout=60) == 112
+        assert ray_tpu.get(
+            [top.remote(i) for i in range(8)], timeout=60) == [
+            111 + i for i in range(8)]
+    finally:
+        ray_tpu.shutdown()

@@ -251,6 +251,72 @@ def test_grpc_ingress_roundtrip():
         grpc_request(addr, {}, deployment="nope")
 
 
+def test_grpc_ingress_conformance():
+    """Protocol conformance against the real grpcio client (VERDICT r3
+    #8): exact status codes, malformed bodies, a multi-MB message in
+    both directions, and concurrent in-flight calls."""
+    pytest.importorskip("grpc")
+    import grpc as grpc_mod
+
+    from ray_tpu.serve.grpc_proxy import METHOD, SERVICE, grpc_request
+
+    @serve.deployment(name="gconf")
+    class Conf:
+        def __call__(self, payload):
+            if isinstance(payload, dict) and payload.get("big"):
+                return {"blob": "x" * payload["big"]}
+            if isinstance(payload, dict) and payload.get("boom"):
+                raise ValueError("user error")
+            return {"ok": payload}
+
+    serve.run(Conf.bind(), route_prefix="/gconf")
+    addr = f"127.0.0.1:{serve.get_grpc_port()}"
+
+    # Exact status codes, checked with a raw channel (no helper).
+    channel = grpc_mod.insecure_channel(addr)
+    try:
+        call = channel.unary_unary(f"/{SERVICE}/{METHOD}")
+        # NOT_FOUND for an unknown deployment.
+        try:
+            call(b"{}", metadata=[("deployment", "ghost")], timeout=30)
+            raise AssertionError("expected NOT_FOUND")
+        except grpc_mod.RpcError as e:
+            assert e.code() == grpc_mod.StatusCode.NOT_FOUND
+        # INVALID_ARGUMENT for a malformed JSON body.
+        try:
+            call(b"{not json", metadata=[("deployment", "gconf")],
+                 timeout=30)
+            raise AssertionError("expected INVALID_ARGUMENT")
+        except grpc_mod.RpcError as e:
+            assert e.code() == grpc_mod.StatusCode.INVALID_ARGUMENT
+        # INTERNAL when the deployment raises.
+        try:
+            call(b'{"boom": 1}', metadata=[("deployment", "gconf")],
+                 timeout=30)
+            raise AssertionError("expected INTERNAL")
+        except grpc_mod.RpcError as e:
+            assert e.code() == grpc_mod.StatusCode.INTERNAL
+            assert "user error" in (e.details() or "")
+    finally:
+        channel.close()
+
+    # Multi-MB payloads both directions (HTTP/2 flow control, default
+    # 4 MiB message cap honored).
+    big = grpc_request(addr, {"big": 2_000_000}, deployment="gconf")
+    assert len(big["blob"]) == 2_000_000
+    out = grpc_request(addr, {"pad": "y" * 2_000_000}, deployment="gconf")
+    assert out["ok"]["pad"] == "y" * 2_000_000
+
+    # Concurrent in-flight unary calls multiplexed on one channel.
+    import concurrent.futures as cf
+
+    with cf.ThreadPoolExecutor(max_workers=8) as pool:
+        futs = [pool.submit(grpc_request, addr, {"i": i},
+                            deployment="gconf") for i in range(16)]
+        outs = [f.result(timeout=60) for f in futs]
+    assert sorted(o["ok"]["i"] for o in outs) == list(range(16))
+
+
 def test_streaming_deployment_handle():
     """Generator deployments stream items through the handle as produced
     (reference: DeploymentResponseGenerator)."""
