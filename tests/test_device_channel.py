@@ -13,6 +13,8 @@ from ray_tpu.dag import InputNode, MultiOutputNode
 
 @pytest.fixture(scope="module")
 def cluster():
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
     ray_tpu.init(num_cpus=3, object_store_memory=128 * 1024 * 1024)
     yield
     ray_tpu.shutdown()
