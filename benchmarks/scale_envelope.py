@@ -42,7 +42,14 @@ PROFILES = {
 
 
 def run(profile_name: str) -> dict:
+    import gc
+
     import ray_tpu
+
+    # A million in-flight specs/refs make default-threshold cyclic GC a
+    # measurable tax in the driver+head process; collect in larger
+    # batches for the envelope run (workers self-tune in worker.main).
+    gc.set_threshold(100_000, 50, 50)
 
     p = PROFILES[profile_name]
     # Box-state context: numbers on a shared 1-core box swing several-x

@@ -791,9 +791,15 @@ class Worker:
 
 def main() -> None:
     import faulthandler
+    import gc
     import signal
 
     faulthandler.register(signal.SIGUSR1, all_threads=True)
+    # Flood workloads allocate millions of small objects; default gen0
+    # thresholds make cyclic GC a measurable tax (reference analogue:
+    # the reference's workers also tune GC). Collection still happens,
+    # just in larger batches. User code can re-tune freely.
+    gc.set_threshold(50_000, 25, 25)
     head_host, head_port = os.environ["RAY_TPU_HEAD"].rsplit(":", 1)
     # Worker-side profiling knob (reference analogue: py-spy/memray
     # hooks in dashboard/modules/reporter/profile_manager.py): dump a
