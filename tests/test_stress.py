@@ -107,3 +107,107 @@ def test_actor_swarm():
     assert ray_tpu.get([a.value.remote() for a in actors]) == [10] * 16
     for a in actors:
         ray_tpu.kill(a)
+
+
+def test_pipelined_flood_with_worker_chaos():
+    """r4 control plane under chaos (reference: chaos release tests,
+    release/nightly_tests/chaos_test): a pipelined task flood keeps
+    completing while workers are SIGKILLed mid-window — retries replay
+    the killed workers' whole inflight windows, the zygote respawns pool
+    workers, and nothing deadlocks."""
+    import os
+    import random
+    import signal
+    import threading
+    import time
+
+    from ray_tpu._private.worker_context import get_head
+
+    head = get_head()
+
+    @ray_tpu.remote(max_retries=5)
+    def slow_inc(x):
+        time.sleep(0.002)
+        return x + 1
+
+    stop = threading.Event()
+    killed = {"n": 0}
+
+    def killer():
+        rng = random.Random(7)
+        while not stop.is_set():
+            time.sleep(0.25)
+            with head.lock:
+                victims = [r for r in head.workers.values()
+                           if r.pid and r.actor_id is None and r.busy]
+                if not victims:
+                    continue
+                pid = rng.choice(victims).pid
+            try:
+                os.kill(pid, signal.SIGKILL)
+                killed["n"] += 1
+            except OSError:
+                pass
+
+    t = threading.Thread(target=killer, daemon=True)
+    t.start()
+    try:
+        refs = [slow_inc.remote(i) for i in range(600)]
+        out = ray_tpu.get(refs, timeout=300)
+    finally:
+        stop.set()
+        t.join(timeout=5)
+    assert out == list(range(1, 601))
+    assert killed["n"] >= 1, "chaos never fired"
+
+
+def test_nested_get_flood_with_worker_chaos():
+    """Blocked-worker protocol under chaos: parents blocked in nested
+    gets while their children (and the parents themselves) are being
+    killed — the release/reacquire bookkeeping and overflow drainers
+    must converge to correct results, never deadlock."""
+    import os
+    import random
+    import signal
+    import threading
+    import time
+
+    from ray_tpu._private.worker_context import get_head
+
+    head = get_head()
+
+    @ray_tpu.remote(max_retries=5)
+    def child(x):
+        time.sleep(0.005)
+        return x * 2
+
+    @ray_tpu.remote(max_retries=5)
+    def parent(x):
+        return ray_tpu.get(child.remote(x)) + 1
+
+    stop = threading.Event()
+
+    def killer():
+        rng = random.Random(11)
+        while not stop.is_set():
+            time.sleep(0.4)
+            with head.lock:
+                victims = [r for r in head.workers.values()
+                           if r.pid and r.actor_id is None and r.busy]
+                if not victims:
+                    continue
+                pid = rng.choice(victims).pid
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except OSError:
+                pass
+
+    t = threading.Thread(target=killer, daemon=True)
+    t.start()
+    try:
+        refs = [parent.remote(i) for i in range(120)]
+        out = ray_tpu.get(refs, timeout=300)
+    finally:
+        stop.set()
+        t.join(timeout=5)
+    assert out == [i * 2 + 1 for i in range(120)]
