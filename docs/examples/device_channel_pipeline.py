@@ -1,0 +1,53 @@
+"""Device-resident DAG pipeline (reference: GPU NCCL channels,
+torch_tensor_nccl_channel.py:44 — here the JAX transfer fabric).
+
+Two actors form a compiled pipeline; the array produced by the first
+stays ON DEVICE and is pulled device-to-device by the second. Run on
+any backend (CPU devices included):
+
+    JAX_PLATFORMS=cpu python docs/examples/device_channel_pipeline.py
+"""
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.dag import InputNode
+
+
+@ray_tpu.remote
+class Embedder:
+    def embed(self, tokens):
+        import jax.numpy as jnp
+
+        table = jnp.ones((256, 64), jnp.float32) * 0.01
+        return table[jnp.asarray(tokens)]          # stays on device
+
+
+@ray_tpu.remote
+class Scorer:
+    def score(self, embeddings):
+        import jax
+
+        assert isinstance(embeddings, jax.Array)    # arrived on device
+        return float(embeddings.sum())
+
+
+def main():
+    ray_tpu.init(num_cpus=3)
+    try:
+        emb, sco = Embedder.remote(), Scorer.remote()
+        with InputNode() as tokens:
+            out = sco.score.bind(
+                emb.embed.bind(tokens).with_tensor_transport("device"))
+        dag = out.experimental_compile()
+        dag.ensure_compiled()   # raise instead of silently falling back
+        for batch in (np.arange(8), np.arange(16), np.arange(32)):
+            print("score:", ray_tpu.get(dag.execute(batch), timeout=60))
+        dag.teardown()
+        print("OK")
+    finally:
+        ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

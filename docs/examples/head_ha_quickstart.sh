@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# External-store head HA (reference: redis_store_client.h:111).
+#
+# Durable cluster state (actors, KV, placement groups, nodes) lives in a
+# shared store, NOT on the head's local disk — so a replacement head on
+# ANY machine restores the cluster. With file:// the store is a
+# directory: put it on NFS/shared storage in real deployments.
+set -euo pipefail
+
+STORE="file:///shared/cluster-state"     # any shared mount
+PORT=6380
+
+# 1. First head (machine A):
+ray-tpu start --head --port "$PORT" --external-store "$STORE" &
+
+# 2. Drivers connect as usual; detached actors + KV survive failovers:
+#      ray_tpu.init(address="headA:$PORT")
+#      Counter.options(name="svc", lifetime="detached",
+#                      max_restarts=-1).remote()
+
+# 3. Machine A dies. On machine B, point a FRESH head at the store —
+#    same port, new node, zero local state:
+#      ray-tpu start --head --port $PORT --external-store $STORE
+#    Detached actors restart, the KV is intact, drivers and node agents
+#    re-register automatically (see tests/test_head_ft.py::
+#    test_external_store_head_ha for the scripted version).
+wait
