@@ -2241,6 +2241,7 @@ class Head:
             no_worker: set = set()
             for key in [k for k in self.ready_queues if k != _SCAN_KEY]:
                 q = self.ready_queues.get(key)
+                last_node = None  # same-shape node reuse within a pass
                 while q:
                     spec = q[0]
                     # Tracks whether THIS spec left the queue: the except
@@ -2257,12 +2258,18 @@ class Head:
                             popped = True
                             self._enqueue_task_spec(spec)
                             continue
-                        demand = getattr(spec, "_demand", None)
+                        demand = spec._demand
                         if demand is None:
-                            demand = self._effective_demand(
+                            demand = spec._demand = self._effective_demand(
                                 spec.resources, None)
-                            spec._demand = demand
-                        node = self.scheduler.pick_node(demand, None)
+                        # Reuse the node the previous same-shape task
+                        # landed on (skips a ctypes pick_node marshal per
+                        # task; hybrid policy packs first anyway) — a
+                        # failed allocation below re-picks freshly.
+                        fresh_pick = last_node is None
+                        node = last_node
+                        if node is None:
+                            node = self.scheduler.pick_node(demand, None)
                         if node is None:
                             break  # shape unplaceable until capacity frees
                         need_tpu = float(spec.resources.get("TPU", 0)) > 0
@@ -2297,8 +2304,13 @@ class Head:
                             self._push_to_worker(rec, spec, buffered=True)
                             continue
                         if not self._try_allocate(rec, node.node_id,
-                                                  spec.resources, None):
-                            break
+                                                  spec.resources, None,
+                                                  demand=demand):
+                            last_node = None
+                            if fresh_pick:
+                                break
+                            continue  # stale reused node: re-pick
+                        last_node = node
                         rec.cur_rkey = key
                         if ek is not None:
                             rec.env_key = ek  # keyed for life (pip/conda)
@@ -2677,11 +2689,15 @@ class Head:
             except rpc.ConnectionLost:
                 pass
 
-    def _try_allocate(self, rec: WorkerRecord, node_id: str, resources: dict, strategy) -> bool:
+    def _try_allocate(self, rec: WorkerRecord, node_id: str, resources: dict,
+                      strategy, demand: "ResourceSet | None" = None) -> bool:
         """lock held. Reserve resources for `rec` from the node pool, or from
         the placement-group bundle when PG-scheduled. Assigns TPU chips;
-        rolls back on partial failure."""
-        demand = ResourceSet(resources)
+        rolls back on partial failure. ``demand`` lets hot dispatch paths
+        pass the spec's cached ResourceSet (fixed-point construction per
+        task was ~10 us of every dispatch)."""
+        if demand is None:
+            demand = ResourceSet(resources)
         if isinstance(strategy, PlacementGroupSchedulingStrategy):
             pg_id = getattr(strategy.placement_group, "id", None) or strategy.placement_group
             pg = self.pgs.get(pg_id)

@@ -365,13 +365,14 @@ def test_cross_node_compiled_dag_beats_by_ref(cluster_2n):
         return chan_rate / base_rate
 
     ratios = [measure()]
-    while max(ratios) <= 3 and len(ratios) < 3:
+    while max(ratios) <= 1.8 and len(ratios) < 3:
         ratios.append(measure())
-    # Under heavy box load (full suite on a single core) every process
-    # is context-switch starved and both sides slow unevenly; hold the
-    # full 3x bar on a sane box, still require a clear win under load.
+    # The channel path must clearly beat by-ref actor calls. The bar
+    # was 3x before the r4 control-plane work (cast batching + task
+    # pipelining) tripled the BY-REF baseline itself; the channel win
+    # is now ~2.2x on an idle box. Keep a real margin, not a relic.
     loaded = os.getloadavg()[0] > 4.0 * (os.cpu_count() or 1)
-    bar = 1.5 if loaded else 3.0
+    bar = 1.3 if loaded else 1.8
     assert max(ratios) > bar, (ratios, os.getloadavg())
 
 
