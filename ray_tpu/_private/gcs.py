@@ -1616,7 +1616,7 @@ class Head:
                 if t:
                     t["state"] = FAILED if body.get("failed") else FINISHED
                     t["finished_at"] = time.time()
-                    self.finished_tasks.append(spec.task_id)
+                    self._record_finished(spec.task_id)
                 if not spec.actor_creation:
                     # Creation-arg pins are held for the actor's
                     # restartable lifetime, released once at permanent
@@ -2859,6 +2859,17 @@ class Head:
             self._wal_append(("actor_dead", rec.actor_id))
             self._mark_dirty()
 
+    def _record_finished(self, task_id: str) -> None:
+        """lock held. Terminal task-state retention (reference: the GCS
+        task-event store keeps a bounded ring, gcs_task_manager.h:159):
+        the finished ring's eviction also drops the state-API record —
+        without this a million-task flood left a million dict entries in
+        self.tasks for the session's lifetime."""
+        ring = self.finished_tasks
+        if ring.maxlen is not None and len(ring) == ring.maxlen:
+            self.tasks.pop(ring[0], None)
+        ring.append(task_id)
+
     def _fail_task(self, spec: TaskSpec, message: str, kind: str = "task_error") -> None:
         """lock held. Seal each return id with an error payload."""
         t = self.tasks.get(spec.task_id)
@@ -2866,6 +2877,7 @@ class Head:
             t["state"] = FAILED
             t["error"] = message
             t["finished_at"] = time.time()
+            self._record_finished(spec.task_id)
         for oid in spec.return_ids:
             self._seal_error(oid, message, kind)
         if not spec.actor_creation:
