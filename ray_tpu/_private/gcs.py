@@ -289,6 +289,10 @@ class Head:
         self.clients: dict[str, rpc.Connection] = {}  # client_id -> conn
         self.task_events: deque[dict] = deque(maxlen=config.task_events_max_buffer)
         self.metrics: dict[str, Any] = {}
+        # Core runtime counters (reference: DEFINE_stats core metric set,
+        # src/ray/stats/metric_defs.h:46 — `tasks`, `actors`, …); gauges
+        # are derived from the live tables at scrape time.
+        self.stats = {"tasks_finished": 0, "tasks_failed": 0}
         self.node_agents: dict[str, rpc.Connection] = {}  # node_id -> agent conn
         self.node_transfer_addrs: dict[str, tuple] = {}  # node_id -> (ip, port)
         from concurrent.futures import ThreadPoolExecutor
@@ -1617,6 +1621,8 @@ class Head:
                     t["state"] = FAILED if body.get("failed") else FINISHED
                     t["finished_at"] = time.time()
                     self._record_finished(spec.task_id)
+                self.stats["tasks_failed" if body.get("failed")
+                           else "tasks_finished"] += 1
                 if not spec.actor_creation:
                     # Creation-arg pins are held for the actor's
                     # restartable lifetime, released once at permanent
@@ -2859,6 +2865,28 @@ class Head:
             self._wal_append(("actor_dead", rec.actor_id))
             self._mark_dirty()
 
+    def _h_runtime_stats(self, body, conn):
+        """Core runtime metric snapshot for the Prometheus exposition
+        (reference: the C++ DEFINE_stats registry exported through the
+        metrics agent)."""
+        with self.lock:
+            workers_alive = sum(1 for r in self.workers.values()
+                                if r.conn is not None)
+            actors_alive = sum(1 for a in self.actors.values()
+                               if a.state == "ALIVE")
+            return {
+                "counters": dict(self.stats),
+                "gauges": {
+                    "workers_alive": workers_alive,
+                    "actors_alive": actors_alive,
+                    "object_store_num_objects": len(self.objects),
+                    "object_store_used_bytes": self.arena.in_use,
+                    "nodes_alive": 1 + len(self.node_agents),
+                    "tasks_pending": sum(len(q) for q in
+                                         self.ready_queues.values()),
+                },
+            }
+
     def _record_finished(self, task_id: str) -> None:
         """lock held. Terminal task-state retention (reference: the GCS
         task-event store keeps a bounded ring, gcs_task_manager.h:159):
@@ -2878,6 +2906,7 @@ class Head:
             t["error"] = message
             t["finished_at"] = time.time()
             self._record_finished(spec.task_id)
+        self.stats["tasks_failed"] += 1
         for oid in spec.return_ids:
             self._seal_error(oid, message, kind)
         if not spec.actor_creation:

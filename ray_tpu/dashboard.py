@@ -235,6 +235,25 @@ class DashboardServer:
             return _log_tail(name)
         if path == "/metrics":
             return um.prometheus_text()
+        if path == "/api/prometheus_sd":
+            # Prometheus http_sd_configs body (reference:
+            # dashboard/modules/metrics service discovery file).
+            from ray_tpu.util import metrics_export
+
+            q = query or {}
+            return metrics_export.prometheus_sd(
+                q.get("host", "127.0.0.1"), int(q.get("port", 0)) or 0)
+        if path == "/api/grafana_dashboard":
+            # Importable Grafana dashboard JSON over the runtime metric
+            # set + any user metrics currently registered (reference:
+            # dashboard/modules/metrics/dashboards generation).
+            from ray_tpu.util import metrics_export
+
+            try:
+                user_metrics = sorted(um.get_metrics_report())
+            except Exception:
+                user_metrics = []
+            return metrics_export.grafana_dashboard(user_metrics)
         if path == "/":
             # Web UI (reference: dashboard/client/src React app; here a
             # single self-contained SPA over the same JSON endpoints).

@@ -175,10 +175,31 @@ def get_metrics_report() -> dict[str, dict]:
     return agg
 
 
+def runtime_stats_text() -> str:
+    """Core runtime metric exposition (reference: the C++ DEFINE_stats
+    set — tasks/actors/objects — exported through the metrics agent)."""
+    try:
+        snap = global_runtime().conn.call("runtime_stats", {}, timeout=10)
+    except Exception:
+        return ""
+    lines = []
+    for name, value in snap.get("counters", {}).items():
+        full = f"ray_tpu_{name}_total"
+        lines.append(f"# TYPE {full} counter")
+        lines.append(f"{full} {value}")
+    for name, value in snap.get("gauges", {}).items():
+        full = f"ray_tpu_{name}"
+        lines.append(f"# TYPE {full} gauge")
+        lines.append(f"{full} {value}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
 def prometheus_text() -> str:
     """Prometheus exposition format (the per-node MetricsAgent surface,
-    reference: _private/metrics_agent.py:492)."""
-    lines = []
+    reference: _private/metrics_agent.py:492). Core runtime metrics
+    first, then user-defined Counter/Gauge/Histogram series."""
+    lines = [runtime_stats_text().rstrip("\n")]
+    lines = [ln for ln in lines if ln]
     for name, entry in get_metrics_report().items():
         lines.append(f"# TYPE {name} {entry['type']}")
         for tags, value in entry["series"].items():

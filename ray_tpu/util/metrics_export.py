@@ -1,0 +1,100 @@
+"""Prometheus + Grafana integration artifacts.
+
+Counterpart of the reference's dashboard metrics module
+(reference: python/ray/dashboard/modules/metrics/ — it writes a
+Prometheus scrape config and generates Grafana dashboard JSON for the
+cluster's metric set). Here the same two artifacts are generated from
+the live registry and the dashboard's exposition endpoint:
+
+    GET /api/prometheus_sd       Prometheus HTTP service-discovery body
+    GET /api/grafana_dashboard   importable Grafana dashboard JSON
+
+or from Python::
+
+    from ray_tpu.util.metrics_export import (
+        grafana_dashboard, prometheus_scrape_config)
+"""
+
+from __future__ import annotations
+
+import json
+
+
+def prometheus_sd(dashboard_host: str, dashboard_port: int) -> list:
+    """HTTP service-discovery payload (prometheus http_sd_configs):
+    point prometheus at GET /api/prometheus_sd and it scrapes every
+    listed target's /metrics (reference: the dashboard's
+    prometheus_service_discovery file)."""
+    return [{
+        "targets": [f"{dashboard_host}:{dashboard_port}"],
+        "labels": {"job": "ray_tpu", "__metrics_path__": "/metrics"},
+    }]
+
+
+def prometheus_scrape_config(dashboard_host: str,
+                             dashboard_port: int) -> str:
+    """A ready-to-paste prometheus.yml scrape_configs entry."""
+    return (
+        "scrape_configs:\n"
+        "  - job_name: ray_tpu\n"
+        "    metrics_path: /metrics\n"
+        "    static_configs:\n"
+        f"      - targets: ['{dashboard_host}:{dashboard_port}']\n"
+    )
+
+
+def _panel(panel_id: int, title: str, expr: str, unit: str,
+           x: int, y: int) -> dict:
+    return {
+        "id": panel_id,
+        "title": title,
+        "type": "timeseries",
+        "gridPos": {"h": 8, "w": 12, "x": x, "y": y},
+        "datasource": {"type": "prometheus", "uid": "${datasource}"},
+        "fieldConfig": {"defaults": {"unit": unit}},
+        "targets": [{"expr": expr, "refId": "A"}],
+    }
+
+
+def grafana_dashboard(extra_metrics: "list[str] | None" = None) -> dict:
+    """Importable Grafana dashboard covering the core runtime metrics
+    (reference: dashboard/modules/metrics/dashboards/*_dashboard_panels
+    — default panels generated for the cluster metric set). User
+    metrics passed in ``extra_metrics`` get a generic panel each."""
+    panels = [
+        _panel(1, "Tasks finished / s",
+               "rate(ray_tpu_tasks_finished_total[1m])", "ops", 0, 0),
+        _panel(2, "Tasks failed / s",
+               "rate(ray_tpu_tasks_failed_total[1m])", "ops", 12, 0),
+        _panel(3, "Object store used bytes",
+               "ray_tpu_object_store_used_bytes", "bytes", 0, 8),
+        _panel(4, "Objects in store",
+               "ray_tpu_object_store_num_objects", "short", 12, 8),
+        _panel(5, "Workers alive",
+               "ray_tpu_workers_alive", "short", 0, 16),
+        _panel(6, "Actors alive",
+               "ray_tpu_actors_alive", "short", 12, 16),
+    ]
+    next_id = 7
+    y = 24
+    for i, name in enumerate(extra_metrics or []):
+        panels.append(_panel(next_id, name, name, "short",
+                             (i % 2) * 12, y + (i // 2) * 8))
+        next_id += 1
+    return {
+        "title": "ray_tpu cluster",
+        "uid": "ray-tpu-cluster",
+        "schemaVersion": 39,
+        "timezone": "browser",
+        "refresh": "10s",
+        "time": {"from": "now-30m", "to": "now"},
+        "templating": {"list": [{
+            "name": "datasource", "type": "datasource",
+            "query": "prometheus",
+        }]},
+        "panels": panels,
+    }
+
+
+def grafana_dashboard_json(extra_metrics: "list[str] | None" = None) -> str:
+    return json.dumps(grafana_dashboard(extra_metrics), indent=2)

@@ -287,3 +287,42 @@ def test_dashboard_serve_apps_train_and_node_detail():
     finally:
         serve.delete("Doubler")
         stop_dashboard()
+
+
+def test_metrics_runtime_exposition_and_grafana():
+    """Core runtime metrics in the Prometheus exposition + generated
+    Grafana dashboard / service discovery (reference:
+    dashboard/modules/metrics — scrape config + dashboard JSON)."""
+    from ray_tpu.dashboard import start_dashboard, stop_dashboard
+
+    @ray_tpu.remote
+    def unit():
+        return 1
+
+    assert ray_tpu.get([unit.remote() for _ in range(5)],
+                       timeout=60) == [1] * 5
+
+    port = start_dashboard()
+    try:
+        text = _get(port, "/metrics")
+        assert "# TYPE ray_tpu_tasks_finished_total counter" in text
+        finished = next(float(ln.split()[1]) for ln in text.splitlines()
+                        if ln.startswith("ray_tpu_tasks_finished_total "))
+        assert finished >= 5
+        assert "ray_tpu_workers_alive" in text
+        assert "ray_tpu_object_store_used_bytes" in text
+
+        dash = _get(port, "/api/grafana_dashboard")
+        assert dash["uid"] == "ray-tpu-cluster"
+        exprs = [t["expr"] for p in dash["panels"]
+                 for t in p.get("targets", [])]
+        # Every default panel queries a metric the exposition emits.
+        for expr in exprs[:6]:
+            name = expr.split("(")[-1].split("[")[0].rstrip(")")
+            assert name in text, (name, expr)
+
+        sd = _get(port, "/api/prometheus_sd?host=1.2.3.4&port=9999")
+        assert sd[0]["targets"] == ["1.2.3.4:9999"]
+        assert sd[0]["labels"]["__metrics_path__"] == "/metrics"
+    finally:
+        stop_dashboard()
