@@ -36,6 +36,9 @@ class SamplingParams:
     # top_p = 1.0 disables). Applied after penalties and temperature.
     top_k: int = 0
     top_p: float = 1.0
+    # vLLM min_p: drop tokens whose post-temperature probability is
+    # below min_p * max_prob (0.0 disables).
+    min_p: float = 0.0
     # OpenAI-style penalties on generated tokens (presence: flat once a
     # token has appeared; frequency: per occurrence) and HF-style
     # repetition penalty (> 1.0 shrinks logits of any token present in
@@ -53,13 +56,19 @@ class SamplingParams:
     # Stop STRINGS (detokenized match, vLLM `stop`): generation ends at
     # the first occurrence; the match is trimmed from the output text.
     stop: tuple[str, ...] = ()
+    # vLLM min_tokens: suppress EVERY stop condition (eos, stop ids,
+    # stop strings) until this many tokens have been generated.
+    min_tokens: int = 0
+    # vLLM ignore_eos: keep generating through the tokenizer's eos
+    # (explicit stop_token_ids still apply) — benchmarking workloads.
+    ignore_eos: bool = False
     # Reserved for future logit-processing extensions.
     extra: dict[str, Any] = field(default_factory=dict)
 
     def needs_advanced(self) -> bool:
         """True when this request needs the extended sampling program."""
         return bool(
-            self.top_k > 0 or self.top_p < 1.0
+            self.top_k > 0 or self.top_p < 1.0 or self.min_p > 0.0
             or self.presence_penalty != 0.0 or self.frequency_penalty != 0.0
             or self.repetition_penalty != 1.0 or self.seed is not None
             or self.logprobs > 0

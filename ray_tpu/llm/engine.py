@@ -164,6 +164,7 @@ class LLMEngine:
         # slot needs it (plain batches keep the in-decode fast path).
         self.top_ks = np.zeros((B,), np.int32)
         self.top_ps = np.ones((B,), np.float32)
+        self.min_ps = np.zeros((B,), np.float32)
         self.pres_pens = np.zeros((B,), np.float32)
         self.freq_pens = np.zeros((B,), np.float32)
         self.rep_pens = np.ones((B,), np.float32)
@@ -336,6 +337,7 @@ class LLMEngine:
         self.temps[slot] = sp.temperature
         self.top_ks[slot] = max(0, sp.top_k)
         self.top_ps[slot] = sp.top_p
+        self.min_ps[slot] = sp.min_p
         self.pres_pens[slot] = sp.presence_penalty
         self.freq_pens[slot] = sp.frequency_penalty
         self.rep_pens[slot] = sp.repetition_penalty
@@ -501,6 +503,11 @@ class LLMEngine:
             keep_sorted[0] = True  # the crossing token is always kept
             cutoff = x[order[np.nonzero(keep_sorted)[0][-1]]]
             x = np.where(x >= cutoff, x, -np.inf)
+        if sp.min_p > 0.0:
+            # Same rule as the device program: drop tokens whose
+            # probability is below min_p * max_prob (argmax survives).
+            mp = min(max(sp.min_p, 0.0), 1.0)
+            x = np.where(x >= x.max() + np.log(max(mp, 1e-10)), x, -np.inf)
         return x
 
     def _sample_host(self, logits: np.ndarray, slot: int, req: Request) -> int:
@@ -546,6 +553,10 @@ class LLMEngine:
 
     def _stop_ids(self, sp: SamplingParams) -> set[int]:
         stop = set(sp.stop_token_ids)
+        if sp.ignore_eos:
+            # vLLM ignore_eos: generate through the tokenizer's eos;
+            # EXPLICIT stop_token_ids still apply.
+            return stop
         eos = getattr(self.tokenizer, "eos_token_id", None)
         if eos is not None:
             stop.add(int(eos))
@@ -556,12 +567,16 @@ class LLMEngine:
         pos = int(self.positions[slot])
         reason = None
         text = None
-        if req.generated and req.generated[-1] in self._stop_ids(req.params):
+        # vLLM min_tokens: every stop condition is suppressed until the
+        # request has generated at least this many tokens.
+        stops_armed = len(req.generated) >= req.params.min_tokens
+        if (stops_armed and req.generated
+                and req.generated[-1] in self._stop_ids(req.params)):
             req.generated.pop()  # don't surface the stop token
             if req.logprobs:
                 req.logprobs = req.logprobs[: len(req.generated)]
             reason = "stop"
-        elif req.params.stop:
+        elif stops_armed and req.params.stop:
             # Stop STRINGS (vLLM `stop`): end at the first occurrence,
             # trimming the match (and anything after) from the text.
             # Cheap per-token check: decode only a TAIL window (stop
@@ -574,8 +589,17 @@ class LLMEngine:
             tail = self.tokenizer.decode(req.generated[-window:])
             if any(s in tail for s in req.params.stop):
                 decoded = self.tokenizer.decode(req.generated)
+                # min_tokens suppressed earlier matches; on arming, only
+                # matches extending past the suppressed prefix count
+                # (vLLM keeps a search offset for the same reason).
+                start = 0
+                if req.params.min_tokens > 0:
+                    prefix = self.tokenizer.decode(
+                        req.generated[:req.params.min_tokens])
+                    start = max(0, len(prefix) - max_chars + 1)
                 cut = min((i for i in
-                           (decoded.find(s) for s in req.params.stop)
+                           (decoded.find(s, start)
+                            for s in req.params.stop)
                            if i >= 0), default=-1)
                 if cut >= 0:
                     text = decoded[:cut]
@@ -668,6 +692,7 @@ class LLMEngine:
                 model_runner.advanced_sample(
                     logits, jnp.asarray(self.temps),
                     jnp.asarray(self.top_ks), jnp.asarray(self.top_ps),
+                    jnp.asarray(self.min_ps),
                     jnp.asarray(self.pres_pens), jnp.asarray(self.freq_pens),
                     jnp.asarray(self.rep_pens), self._counts,
                     self._prompt_mask, jnp.asarray(self.seeds),

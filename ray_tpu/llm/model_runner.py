@@ -185,12 +185,15 @@ def penalize_logits(logits, counts, prompt_mask, presence, frequency,
     return logits
 
 
-def filter_top_k_top_p(logits, top_k, top_p):
-    """Mask logits outside the per-row top-k / nucleus-p sets to -inf.
+def filter_top_k_top_p(logits, top_k, top_p, min_p=None):
+    """Mask logits outside the per-row top-k / nucleus-p / min-p sets
+    to -inf.
 
-    top_k [B] int32 (<= 0 disables); top_p [B] float32 (1.0 disables).
-    Ties at the top-k threshold keep every tied token (vLLM keeps
-    exactly k; the sampled distribution differs only on exact ties).
+    top_k [B] int32 (<= 0 disables); top_p [B] float32 (1.0 disables);
+    min_p [B] float32 (vLLM semantics: drop tokens with probability
+    below min_p * max_prob; 0.0 disables). Ties at the top-k threshold
+    keep every tied token (vLLM keeps exactly k; the sampled
+    distribution differs only on exact ties).
     """
     B, V = logits.shape
     sorted_desc = -jnp.sort(-logits, axis=-1)  # [B, V] descending
@@ -211,6 +214,14 @@ def filter_top_k_top_p(logits, top_k, top_p):
     big = jnp.where(in_nucleus_sorted, sorted_desc, jnp.inf)
     p_thresh = jnp.min(big, axis=-1, keepdims=True)
     keep = keep & (logits >= p_thresh)
+    if min_p is not None:
+        # prob(tok) < min_p * prob(argmax)  <=>
+        # logit < max_logit + log(min_p); argmax always survives.
+        max_logit = logits.max(axis=-1, keepdims=True)
+        mp = jnp.clip(min_p, 0.0, 1.0)[:, None]
+        keep = keep & jnp.where(
+            mp > 0.0, logits >= max_logit + jnp.log(jnp.maximum(mp, 1e-10)),
+            True)
     return jnp.where(keep, logits, _NEG_INF_SAMPLE)
 
 
@@ -219,9 +230,9 @@ _NEG_INF_SAMPLE = -1e30
 
 @partial(jax.jit, static_argnames=("max_logprobs",),
          donate_argnames=("counts",))
-def advanced_sample(logits, temps, top_ks, top_ps, presence, frequency,
-                    repetition, counts, prompt_mask, seeds, steps,
-                    *, max_logprobs: int = 0):
+def advanced_sample(logits, temps, top_ks, top_ps, min_ps, presence,
+                    frequency, repetition, counts, prompt_mask, seeds,
+                    steps, *, max_logprobs: int = 0):
     """Extended sampling program (vLLM SamplingParams parity), run on
     the logits the decode step returns when any active slot needs more
     than greedy/temperature.
@@ -239,7 +250,7 @@ def advanced_sample(logits, temps, top_ks, top_ps, presence, frequency,
                           repetition)
     greedy = pen.argmax(-1).astype(jnp.int32)
     scaled = pen / jnp.clip(temps, 1e-6, None)[:, None]
-    filtered = filter_top_k_top_p(scaled, top_ks, top_ps)
+    filtered = filter_top_k_top_p(scaled, top_ks, top_ps, min_ps)
 
     def one_key(seed, step):
         return jax.random.fold_in(jax.random.PRNGKey(seed), step)

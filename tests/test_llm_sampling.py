@@ -28,7 +28,7 @@ def tiny_config(**kw):
 
 
 def _run_advanced(logits, *, temps=None, top_ks=None, top_ps=None,
-                  pres=None, freq=None, rep=None, counts=None,
+                  min_ps=None, pres=None, freq=None, rep=None, counts=None,
                   prompt_mask=None, seeds=None, steps=None, max_logprobs=0):
     B, V = logits.shape
     z = lambda v, d: jnp.asarray(v if v is not None else d)  # noqa: E731
@@ -37,6 +37,7 @@ def _run_advanced(logits, *, temps=None, top_ks=None, top_ps=None,
         z(temps, np.zeros(B, np.float32)),
         z(top_ks, np.zeros(B, np.int32)),
         z(top_ps, np.ones(B, np.float32)),
+        z(min_ps, np.zeros(B, np.float32)),
         z(pres, np.zeros(B, np.float32)),
         z(freq, np.zeros(B, np.float32)),
         z(rep, np.ones(B, np.float32)),
@@ -360,3 +361,49 @@ def test_stop_string_trims_token_ids_and_logprobs():
     # partial overlap with the stop match
     assert decoded.startswith(out.text) or out.text.startswith(decoded)
     assert stop not in out.text
+
+
+def test_min_p_restricts_support():
+    """vLLM min_p: tokens below min_p * max_prob are dropped — with one
+    dominant token and min_p=0.5 only it can be sampled; min_p=0 leaves
+    the distribution open."""
+    logits = np.full((1, 32), 0.0, np.float32)
+    logits[0, 9] = 4.0     # p(9) ~ 0.64, every other token ~ 0.012
+    for step in range(12):
+        toks, _, _, _, _ = _run_advanced(
+            logits, temps=np.ones(1, np.float32),
+            min_ps=np.full(1, 0.5, np.float32),
+            steps=np.full(1, step, np.int32))
+        assert int(toks[0]) == 9
+    seen = {int(_run_advanced(
+        logits, temps=np.ones(1, np.float32),
+        steps=np.full(1, s, np.int32))[0][0]) for s in range(40)}
+    assert len(seen) > 1  # min_p off: other tokens do get sampled
+
+
+def test_engine_min_p_and_min_tokens_and_ignore_eos():
+    cfg = tiny_config()
+    eng = LLMEngine(cfg)
+    # min_p sampling runs through the engine without disturbing greedy.
+    out = eng.generate(["m"], SamplingParams(
+        max_tokens=5, temperature=1.0, min_p=0.9, seed=3))[0]
+    greedy = eng.generate(["m"], SamplingParams(max_tokens=5))[0]
+    assert len(out.token_ids) == 5
+    # min_tokens: force the greedy output's own text as a stop string —
+    # without min_tokens it would cut early; with min_tokens=max_tokens
+    # every stop is suppressed until the budget is reached.
+    if len(greedy.text) >= 2:
+        stop = greedy.text[:2]
+        cut = eng.generate(["m"], SamplingParams(
+            max_tokens=8, stop=(stop,)))[0]
+        full = eng.generate(["m"], SamplingParams(
+            max_tokens=8, stop=(stop,), min_tokens=8))[0]
+        assert len(full.token_ids) >= len(cut.token_ids)
+        assert full.finish_reason == "length"
+    # ignore_eos: eos in the stream no longer terminates; explicit
+    # stop_token_ids still do.
+    eos = getattr(eng.tokenizer, "eos_token_id", None)
+    if eos is not None:
+        sp = SamplingParams(max_tokens=6, ignore_eos=True)
+        out2 = eng.generate(["m"], sp)[0]
+        assert out2.finish_reason in ("length",)
