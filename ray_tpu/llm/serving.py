@@ -83,6 +83,7 @@ class LLMServer:
             temperature=float(payload.get("temperature", d.temperature)),
             top_k=int(payload.get("top_k", d.top_k)),
             top_p=float(payload.get("top_p", d.top_p)),
+            min_p=float(payload.get("min_p", d.min_p)),
             presence_penalty=float(payload.get("presence_penalty",
                                                d.presence_penalty)),
             frequency_penalty=float(payload.get("frequency_penalty",
@@ -93,6 +94,8 @@ class LLMServer:
             logprobs=int(lp or 0),
             stop_token_ids=stop_ids,
             stop=stop_strings,
+            min_tokens=int(payload.get("min_tokens", d.min_tokens)),
+            ignore_eos=bool(payload.get("ignore_eos", d.ignore_eos)),
         )
 
     def _render_chat(self, messages: list[dict]) -> str:
