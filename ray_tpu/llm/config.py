@@ -62,6 +62,11 @@ class SamplingParams:
     # vLLM ignore_eos: keep generating through the tokenizer's eos
     # (explicit stop_token_ids still apply) — benchmarking workloads.
     ignore_eos: bool = False
+    # OpenAI logit_bias: ((token_id, bias), ...) added to the logits
+    # before sampling (affects greedy too). Capped at MAX_LOGIT_BIAS
+    # entries per request — the device program carries a fixed-width
+    # scatter (one compile for everyone).
+    logit_bias: tuple = ()
     # Reserved for future logit-processing extensions.
     extra: dict[str, Any] = field(default_factory=dict)
 
@@ -71,7 +76,7 @@ class SamplingParams:
             self.top_k > 0 or self.top_p < 1.0 or self.min_p > 0.0
             or self.presence_penalty != 0.0 or self.frequency_penalty != 0.0
             or self.repetition_penalty != 1.0 or self.seed is not None
-            or self.logprobs > 0
+            or self.logprobs > 0 or self.logit_bias
         )
 
     def greedy_equivalent(self) -> bool:

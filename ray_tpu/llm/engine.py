@@ -35,6 +35,8 @@ from ray_tpu.models import transformer as tfm
 # Static top-k width of the device logprob output (one extra compile per
 # distinct static value — so one cap for everyone, vLLM max_logprobs).
 MAX_LOGPROBS = 20
+# Static per-slot width of the logit_bias scatter in the device program.
+MAX_LOGIT_BIAS = 16
 
 
 @dataclasses.dataclass
@@ -165,6 +167,8 @@ class LLMEngine:
         self.top_ks = np.zeros((B,), np.int32)
         self.top_ps = np.ones((B,), np.float32)
         self.min_ps = np.zeros((B,), np.float32)
+        self.bias_ids = np.zeros((B, MAX_LOGIT_BIAS), np.int32)
+        self.bias_vals = np.zeros((B, MAX_LOGIT_BIAS), np.float32)
         self.pres_pens = np.zeros((B,), np.float32)
         self.freq_pens = np.zeros((B,), np.float32)
         self.rep_pens = np.ones((B,), np.float32)
@@ -246,6 +250,16 @@ class LLMEngine:
             raise ValueError(
                 f"logprobs={sp.logprobs} exceeds the engine cap "
                 f"{MAX_LOGPROBS} (the device program's static top-k)")
+        if len(sp.logit_bias) > MAX_LOGIT_BIAS:
+            raise ValueError(
+                f"logit_bias with {len(sp.logit_bias)} entries exceeds "
+                f"the engine cap {MAX_LOGIT_BIAS} (the device program's "
+                f"static scatter width)")
+        for tid, _b in sp.logit_bias:
+            if not 0 <= int(tid) < self.model_config.vocab_size:
+                raise ValueError(
+                    f"logit_bias token id {tid} outside vocab "
+                    f"[0, {self.model_config.vocab_size})")
         toks = (self.tokenizer.encode(prompt) if isinstance(prompt, str)
                 else list(prompt))
         toks = toks[: self.max_len - 1]
@@ -338,6 +352,11 @@ class LLMEngine:
         self.top_ks[slot] = max(0, sp.top_k)
         self.top_ps[slot] = sp.top_p
         self.min_ps[slot] = sp.min_p
+        self.bias_ids[slot] = 0
+        self.bias_vals[slot] = 0.0
+        for j, (tid, b) in enumerate(sp.logit_bias[:MAX_LOGIT_BIAS]):
+            self.bias_ids[slot, j] = int(tid)
+            self.bias_vals[slot, j] = float(b)
         self.pres_pens[slot] = sp.presence_penalty
         self.freq_pens[slot] = sp.frequency_penalty
         self.rep_pens[slot] = sp.repetition_penalty
@@ -517,6 +536,8 @@ class LLMEngine:
         tokens come from the in-decode or advanced_sample programs."""
         sp = req.params
         logits = logits.astype(np.float64)
+        for tid, b in sp.logit_bias:
+            logits[int(tid)] += float(b)
         if sp.repetition_penalty != 1.0:
             seen = np.unique(np.asarray(req.prompt_tokens, np.int64))
             vals = logits[seen]
@@ -697,6 +718,7 @@ class LLMEngine:
                     jnp.asarray(self.rep_pens), self._counts,
                     self._prompt_mask, jnp.asarray(self.seeds),
                     jnp.asarray(steps),
+                    jnp.asarray(self.bias_ids), jnp.asarray(self.bias_vals),
                     max_logprobs=MAX_LOGPROBS if want_lp else 0))
             if want_lp:
                 lp_info = (np.asarray(chosen_lp), np.asarray(top_vals),

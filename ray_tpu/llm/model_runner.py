@@ -232,7 +232,8 @@ _NEG_INF_SAMPLE = -1e30
          donate_argnames=("counts",))
 def advanced_sample(logits, temps, top_ks, top_ps, min_ps, presence,
                     frequency, repetition, counts, prompt_mask, seeds,
-                    steps, *, max_logprobs: int = 0):
+                    steps, bias_ids=None, bias_vals=None,
+                    *, max_logprobs: int = 0):
     """Extended sampling program (vLLM SamplingParams parity), run on
     the logits the decode step returns when any active slot needs more
     than greedy/temperature.
@@ -248,6 +249,10 @@ def advanced_sample(logits, temps, top_ks, top_ps, min_ps, presence,
     B, V = logits.shape
     pen = penalize_logits(logits, counts, prompt_mask, presence, frequency,
                           repetition)
+    if bias_ids is not None:
+        # OpenAI logit_bias: fixed-width per-slot scatter-add (padded
+        # entries carry bias 0.0, so a padding id of 0 is a no-op).
+        pen = pen.at[jnp.arange(B)[:, None], bias_ids].add(bias_vals)
     greedy = pen.argmax(-1).astype(jnp.int32)
     scaled = pen / jnp.clip(temps, 1e-6, None)[:, None]
     filtered = filter_top_k_top_p(scaled, top_ks, top_ps, min_ps)

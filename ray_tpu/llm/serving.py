@@ -96,6 +96,12 @@ class LLMServer:
             stop=stop_strings,
             min_tokens=int(payload.get("min_tokens", d.min_tokens)),
             ignore_eos=bool(payload.get("ignore_eos", d.ignore_eos)),
+            # OpenAI logit_bias arrives as {"token_id": bias} with
+            # string keys.
+            logit_bias=tuple(
+                (int(k), float(v))
+                for k, v in (payload.get("logit_bias") or {}).items()
+            ) or d.logit_bias,
         )
 
     def _render_chat(self, messages: list[dict]) -> str:

@@ -407,3 +407,45 @@ def test_engine_min_p_and_min_tokens_and_ignore_eos():
         sp = SamplingParams(max_tokens=6, ignore_eos=True)
         out2 = eng.generate(["m"], sp)[0]
         assert out2.finish_reason in ("length",)
+
+
+def test_logit_bias_device_and_engine():
+    """OpenAI logit_bias: a large positive bias forces a token (greedy
+    AND sampled); the engine enforces the static scatter width."""
+    logits = np.zeros((2, 32), np.float32)
+    logits[:, 3] = 5.0
+    bias_ids = np.zeros((2, 16), np.int32)
+    bias_vals = np.zeros((2, 16), np.float32)
+    bias_ids[0, 0], bias_vals[0, 0] = 11, 100.0   # row 0: force token 11
+    toks, _, _, _, _ = model_runner.advanced_sample(
+        jnp.asarray(logits), jnp.zeros(2, jnp.float32),
+        jnp.zeros(2, jnp.int32), jnp.ones(2, jnp.float32),
+        jnp.zeros(2, jnp.float32), jnp.zeros(2, jnp.float32),
+        jnp.zeros(2, jnp.float32), jnp.ones(2, jnp.float32),
+        jnp.zeros((2, 32), jnp.int32), jnp.zeros((2, 32), bool),
+        jnp.arange(2, dtype=jnp.int32), jnp.zeros(2, jnp.int32),
+        jnp.asarray(bias_ids), jnp.asarray(bias_vals))
+    assert int(toks[0]) == 11      # biased row
+    assert int(toks[1]) == 3       # unbiased row keeps its argmax
+
+    cfg = tiny_config()
+    eng = LLMEngine(cfg)
+    forced = 17
+    out = eng.generate(["bias"], SamplingParams(
+        max_tokens=4, logit_bias=((forced, 200.0),)))[0]
+    assert all(t == forced for t in out.token_ids), out.token_ids
+    # sampled path too
+    out2 = eng.generate(["bias"], SamplingParams(
+        max_tokens=4, temperature=1.0, seed=1,
+        logit_bias=((forced, 200.0),)))[0]
+    assert all(t == forced for t in out2.token_ids), out2.token_ids
+
+    from ray_tpu.llm.engine import MAX_LOGIT_BIAS
+
+    too_many = tuple((i, 1.0) for i in range(MAX_LOGIT_BIAS + 1))
+    with pytest.raises(ValueError, match="logit_bias"):
+        eng.generate(["x"], SamplingParams(max_tokens=2,
+                                           logit_bias=too_many))
+    with pytest.raises(ValueError, match="vocab"):
+        eng.generate(["x"], SamplingParams(max_tokens=2,
+                                           logit_bias=((10**9, 1.0),)))
