@@ -125,7 +125,7 @@ def test_pipelined_flood_with_worker_chaos():
 
     head = get_head()
 
-    @ray_tpu.remote(max_retries=5)
+    @ray_tpu.remote(max_retries=60)
     def slow_inc(x):
         time.sleep(0.002)
         return x + 1
@@ -176,12 +176,12 @@ def test_nested_get_flood_with_worker_chaos():
 
     head = get_head()
 
-    @ray_tpu.remote(max_retries=5)
+    @ray_tpu.remote(max_retries=60)
     def child(x):
         time.sleep(0.005)
         return x * 2
 
-    @ray_tpu.remote(max_retries=5)
+    @ray_tpu.remote(max_retries=60)
     def parent(x):
         return ray_tpu.get(child.remote(x)) + 1
 
