@@ -293,3 +293,63 @@ def test_standard_autoscaler_loop_scales_up_and_down():
     r = scaler.update()
     assert len(provider.non_terminated_nodes()) == 1
     assert len(r["terminated"]) == 2
+
+
+def test_local_provider_autoscales_real_capacity():
+    """LocalNodeProvider (reference: autoscaler local/fake-multi-node
+    providers): the v1 autoscaler's launch decision spawns a REAL agent
+    subprocess, the node registers with the head, queued work schedules
+    onto the new capacity, and terminate_node kills the agent."""
+    import os
+    import time
+
+    import ray_tpu
+    from ray_tpu.autoscaler.local import LocalNodeProvider
+
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=1, object_store_memory=64 * 1024 * 1024)
+    provider = None
+    try:
+        env = dict(os.environ)
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        provider = LocalNodeProvider(
+            node_types={"cpu-node": {"num_cpus": 2,
+                                     "resources": {"annex": 1}}},
+            env=env)
+
+        @ray_tpu.remote(resources={"annex": 0.1})
+        def on_annex():
+            return "scaled"
+
+        # The demand: tasks needing a resource only the new node type
+        # has — unplaceable until the autoscaler launches one.
+        refs = [on_annex.remote() for _ in range(3)]
+
+        cfg = AutoscalerConfig(
+            node_types=[NodeType("cpu-node", {"CPU": 2, "annex": 1},
+                                 max_workers=2)],
+            idle_timeout_s=3600.0,
+        )
+        scaler = StandardAutoscaler(provider, cfg)
+        deadline = time.time() + 60
+        launched = 0
+        while time.time() < deadline:
+            launched += sum(scaler.update()["launched"].values())
+            if launched:
+                break
+            time.sleep(0.5)
+        assert launched >= 1, "autoscaler never launched for the demand"
+        assert ray_tpu.get(refs, timeout=120) == ["scaled"] * 3
+
+        nodes = provider.non_terminated_nodes()
+        assert nodes and all(provider.is_running(n) for n in nodes)
+        assert provider.node_type_of(nodes[0]) == "cpu-node"
+        for n in nodes:
+            provider.terminate_node(n)
+        assert provider.non_terminated_nodes() == []
+    finally:
+        if provider is not None:
+            provider.shutdown()
+        ray_tpu.shutdown()
