@@ -72,11 +72,14 @@ def run_one(kw: dict, timeout_s: float) -> dict:
     return {**kw, "tok_s": tok_s, "wall_s": round(time.time() - t0, 1)}
 
 
-def main() -> None:
+_MAX_FAILURES = 2  # attempts per config before it is retired
+
+
+def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--timeout-s", type=float, default=900)
     args = ap.parse_args()
-    done = set()
+    done, failures = set(), {}
     if os.path.exists(RESULTS):
         with open(RESULTS) as f:
             for line in f:
@@ -84,20 +87,38 @@ def main() -> None:
                     rec = json.loads(line)
                 except json.JSONDecodeError:
                     continue
+                key = rec.get("_key")
                 if isinstance(rec.get("tok_s"), (int, float)):
-                    done.add(rec.get("_key"))
+                    done.add(key)
+                else:
+                    # TIMEOUT / NO_OUTPUT: retire after _MAX_FAILURES so
+                    # a deterministically-broken config can't monopolize
+                    # every future TPU window (the poller relaunches the
+                    # queue on each up-probe).
+                    failures[key] = failures.get(key, 0) + 1
     for raw in QUEUE:
         # The resume key is the RAW queue entry, recorded verbatim — so
         # editing _BASE defaults can never invalidate prior results.
         key = json.dumps(raw, sort_keys=True)
-        if key in done:
+        if key in done or failures.get(key, 0) >= _MAX_FAILURES:
             continue
         rec = run_one({**_BASE, **raw}, args.timeout_s)
         rec["_key"] = key
         with open(RESULTS, "a") as f:
             f.write(json.dumps(rec) + "\n")
         print(json.dumps(rec), flush=True)
+        if isinstance(rec.get("tok_s"), (int, float)):
+            done.add(key)
+        else:
+            failures[key] = failures.get(key, 0) + 1
+    # rc 0: every config has a result or is retired; rc 3: entries
+    # remain (window was cut short) — the poller reruns only on rc 3.
+    remaining = sum(
+        1 for raw in QUEUE
+        if (k := json.dumps(raw, sort_keys=True)) not in done
+        and failures.get(k, 0) < _MAX_FAILURES)
+    return 0 if remaining == 0 else 3
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

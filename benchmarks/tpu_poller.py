@@ -67,7 +67,7 @@ def main() -> int:
     args = ap.parse_args()
 
     deadline = time.time() + args.window_s
-    attempt = 0
+    attempt, any_up, queue_done = 0, False, False
     log({"event": "poller_start", "window_s": args.window_s,
          "interval_s": args.interval_s, "pid": os.getpid()})
     while time.time() < deadline:
@@ -78,17 +78,25 @@ def main() -> int:
              "detail": detail, "probe_s": round(time.time() - t0, 1)})
         if plat == "tpu":
             log({"event": "tpu_up", "attempt": attempt})
-            env = dict(os.environ)
-            env["JAX_PLATFORMS"] = "tpu"
-            r = subprocess.run(
-                [sys.executable, os.path.join(HERE, "tpu_ab_queue.py"),
-                 "--timeout-s", "900"], env=env)
-            log({"event": "ab_queue_done", "rc": r.returncode})
-            return 0
+            any_up = True
+            if not queue_done:
+                env = dict(os.environ)
+                env["JAX_PLATFORMS"] = "tpu"
+                r = subprocess.run(
+                    [sys.executable,
+                     os.path.join(HERE, "tpu_ab_queue.py"),
+                     "--timeout-s", "900"], env=env)
+                log({"event": "ab_queue_done", "rc": r.returncode})
+                # rc 0 = every config has a result or is retired; rc 3
+                # = the window was cut short, so a later TPU window
+                # resumes the queue. Any other rc (crash) also stops
+                # relaunching — a broken queue must not eat the window.
+                queue_done = r.returncode != 3
         time.sleep(max(0, min(args.interval_s,
                               deadline - time.time())))
-    log({"event": "window_expired", "attempts": attempt})
-    return 1
+    log({"event": "window_expired", "attempts": attempt,
+         "saw_tpu": any_up})
+    return 0 if any_up else 1
 
 
 if __name__ == "__main__":
