@@ -63,6 +63,7 @@ class ObjectEntry:
         "refcount", "read_pins", "task_pins", "lru", "is_error", "owner_id",
         "created_at", "location", "remote_offset", "borrowers",
         "container_pins", "contained", "pin_holders", "replicas", "rr",
+        "owner_resident",
     )
 
     def __init__(self, object_id: str, owner_id: str):
@@ -106,6 +107,12 @@ class ObjectEntry:
         # instead of convoying on one source.
         self.replicas: dict[str, tuple] = {}
         self.rr = 0
+        # Owner-resident object (reference: core_worker in-process store
+        # + ownership, core_worker.h:172): the payload lives in the
+        # OWNING runtime's store, delivered there directly by the
+        # executor; this directory entry holds metadata only and the
+        # value fate-shares with the owner process.
+        self.owner_resident = False
 
 
 class WorkerRecord:
@@ -287,6 +294,18 @@ class Head:
         self.finished_tasks: deque[str] = deque(maxlen=config.task_events_max_buffer)
         self.workers: dict[str, WorkerRecord] = {}
         self.clients: dict[str, rpc.Connection] = {}  # client_id -> conn
+        # client_id -> (host, port) of the client's owner-plane server
+        # (direct result delivery + peer value fetch; the head hands
+        # these out in "owner" metas).
+        self.client_owner_addrs: dict[str, tuple] = {}
+        # Liveness backstop for in-flight direct seals: object_id ->
+        # executing worker_id, registered when a task finishes with
+        # owner-destined results and cleared when the owner confirms.
+        # A worker that dies in the gap gets its pending ids error-
+        # sealed so waiters never hang on a seal that was lost with the
+        # process.
+        self._pending_owner_seals: dict[str, str] = {}
+        self._worker_pending_seals: dict[str, set] = {}
         self.task_events: deque[dict] = deque(maxlen=config.task_events_max_buffer)
         self.metrics: dict[str, Any] = {}
         # Core runtime counters (reference: DEFINE_stats core metric set,
@@ -635,6 +654,7 @@ class Head:
             return
         with self.lock:
             self.clients.pop(client_id, None)
+            self.client_owner_addrs.pop(client_id, None)
             rec = self.workers.get(client_id)
             # Borrower death releases its borrows (reference:
             # reference_count.h WaitForRefRemoved resolves when the
@@ -657,6 +677,13 @@ class Head:
                     changed = True
                 if e.owner_id == client_id and e.refcount > 0:
                     e.refcount -= 1
+                    changed = True
+                if (e.owner_resident and e.owner_id == client_id
+                        and e.inline is None and e.state == SEALED):
+                    # The value lived in the owner's process: it is gone
+                    # (reference: OwnerDiedError fate-sharing). Remaining
+                    # borrowers' fetches raise ObjectLostError.
+                    e.state = LOST
                     changed = True
                 if changed:
                     affected.append(e)
@@ -722,6 +749,9 @@ class Head:
                 rec.conn = conn
                 rec.pid = body.get("pid", rec.pid)
                 self.clients[client_id] = conn
+                if body.get("owner_addr"):
+                    self.client_owner_addrs[client_id] = tuple(
+                        body["owner_addr"])
                 conn.peer_info = {"client_id": client_id, "type": "worker",
                                   "remote": remote}
             self.dispatch_event.set()
@@ -733,7 +763,11 @@ class Head:
                 stale = conn.peer_info.get("client_id")
                 if stale:
                     self.clients.pop(stale, None)
+                    self.client_owner_addrs.pop(stale, None)
                 self.clients[client_id] = conn
+                if body.get("owner_addr"):
+                    self.client_owner_addrs[client_id] = tuple(
+                        body["owner_addr"])
             conn.peer_info = {"client_id": client_id, "type": "driver",
                               "remote": remote}
         return {
@@ -990,6 +1024,20 @@ class Head:
         self.dispatch_event.set()
         return {}
 
+    def _h_owner_sealed(self, body: dict, conn):
+        """An owning runtime confirms holding directly-delivered result
+        payloads: seal the directory entries (dependency wakeup, wait
+        readiness) — metadata only, the bytes never transited the
+        head."""
+        with self.lock:
+            for sbody in body["objects"]:
+                self._seal_remote_locked(sbody)
+            need = self._sealed_woke_task
+            self._sealed_woke_task = False
+        if need:
+            self.dispatch_event.set()
+        return None
+
     def _seal_inline_locked(self, body: dict) -> None:
         """lock held. Seal one inline object (put_inline call or a
         result piggybacked on task_finished)."""
@@ -1005,6 +1053,38 @@ class Head:
         self._lru_tick += 1
         entry.lru = self._lru_tick
         self.objects[object_id] = entry
+        self._on_sealed(object_id)
+
+    def _seal_remote_locked(self, body: dict) -> None:
+        """lock held. Record an owner-resident seal: the payload went
+        straight from the executor to the owning runtime; this entry is
+        directory-only (dependency wakeup, wait readiness, borrow/pin
+        bookkeeping, owner liveness). Only EXISTING entries update — a
+        missing entry means the object was already freed (fire-and-
+        forget submit whose ref died), and recreating it would leak."""
+        object_id = body["object_id"]
+        entry = self.objects.get(object_id)
+        if entry is None:
+            return
+        w = self._pending_owner_seals.pop(object_id, None)
+        if w is not None:
+            s = self._worker_pending_seals.get(w)
+            if s:
+                s.discard(object_id)
+        if entry.inline is not None:
+            # A death-backstop error seal raced the owner confirmation:
+            # keep the inline error (at-least-once semantics; the owner-
+            # local fast path may still serve the late good value).
+            return
+        entry.size = body.get("size", 0)
+        entry.state = SEALED
+        entry.owner_resident = True
+        entry.is_error = body.get("is_error", False)
+        if entry.refcount == 0:
+            entry.refcount = 1
+        self._register_contained(entry, body.get("contained_ids"))
+        self._lru_tick += 1
+        entry.lru = self._lru_tick
         self._on_sealed(object_id)
 
     def _on_sealed(self, object_id: str) -> None:
@@ -1046,6 +1126,19 @@ class Head:
                   client_id: "str | None" = None) -> tuple:
         if entry.inline is not None:
             return ("inline", entry.inline, entry.is_error)
+        if (entry.owner_resident and entry.state == SEALED
+                and entry.offset is None and entry.location is None):
+            # Directory-only entry: the value lives in the owning
+            # runtime's store — the client resolves it there (owner-
+            # local hit or a peer fetch). No head-side pin: the owner's
+            # store is not subject to arena eviction.
+            addr = self.client_owner_addrs.get(entry.owner_id)
+            if addr is not None:
+                return ("owner", addr[0], addr[1], entry.is_error)
+            return ("lost",
+                    f"object {entry.object_id}: owner {entry.owner_id} "
+                    "is gone (owner-resident value fate-shares with its "
+                    "owner)", False)
         if entry.state == SPILLED:
             if not self._restore(entry):
                 # Slow path: serve straight from external storage.
@@ -1345,7 +1438,29 @@ class Head:
                                {"object_id": entry.object_id})
                 except rpc.ConnectionLost:
                     pass
+        if ((entry.owner_resident or entry.state == CREATING
+                or entry.is_error)
+                and entry.owner_id in self.client_owner_addrs):
+            # The payload lives (owner_resident), may yet arrive
+            # (CREATING: a pending result whose direct seal is in
+            # flight), or was PUSHED to the owner (error seals —
+            # _seal_error mirrors them into the owner store, which
+            # would otherwise never purge them): tell the owner the
+            # cluster is done with this object so it can drop/tombstone
+            # the id (buffered — frees arrive in bursts and coalesce).
+            oconn = self.clients.get(entry.owner_id)
+            if oconn is not None:
+                try:
+                    oconn.cast_buffered("owned_freed",
+                                        {"ids": [entry.object_id]})
+                except rpc.ConnectionLost:
+                    pass
         self.objects.pop(entry.object_id, None)
+        w = self._pending_owner_seals.pop(entry.object_id, None)
+        if w is not None:
+            s = self._worker_pending_seals.get(w)
+            if s:
+                s.discard(entry.object_id)
         # The container is gone: release its containment pins so the
         # embedded objects can free (possibly cascading through nested
         # containers).
@@ -1596,91 +1711,113 @@ class Head:
         return {"cancelled": False}
 
     def _h_task_finished(self, body, conn):
-        worker_id = body["worker_id"]
         with self.lock:
-            # Piggybacked inline RESULTS (sealed before the completion
-            # bookkeeping below, same order the split put_inline +
-            # task_finished messages guaranteed) and profile events —
-            # one cast per task carries everything, replacing a blocking
-            # put_inline round trip on the control plane's hottest path.
-            for rbody in body.get("results") or ():
-                self._seal_inline_locked(rbody)
-            if body.get("events"):
-                self.task_events.extend(body["events"])
-            rec = self.workers.get(worker_id)
-            if rec is None:
-                # Worker record already reaped (death raced the final
-                # cast) — but the seals above may have readied
-                # dep-blocked tasks, so the dispatcher must still wake.
-                self.dispatch_event.set()
-                return None
-            spec = rec.inflight.pop(body.get("task_id", ""), None)
-            if spec is not None:
-                t = self.tasks.get(spec.task_id)
-                if t:
-                    t["state"] = FAILED if body.get("failed") else FINISHED
-                    t["finished_at"] = time.time()
-                    self._record_finished(spec.task_id)
-                self.stats["tasks_failed" if body.get("failed")
-                           else "tasks_finished"] += 1
-                if not spec.actor_creation:
-                    # Creation-arg pins are held for the actor's
-                    # restartable lifetime, released once at permanent
-                    # DEAD (_release_actor_arg_pins) — not per attempt.
-                    for dep in self._pinned_ids(spec):
-                        e = self.objects.get(dep)
-                        if e is not None and e.task_pins > 0:
-                            e.task_pins -= 1
-                            self._maybe_free(e)
-            # A dispatch pass is only useful when this completion freed
-            # capacity (allocation released) or a piggybacked seal woke a
-            # dep-blocked task — pipelined mid-window completions do
-            # neither, and skipping their wake cuts pass count ~4x.
-            need_dispatch = self._sealed_woke_task
-            self._sealed_woke_task = False
-            if rec.actor_id is None:
-                # Pipelined same-shape tasks share ONE allocation —
-                # release it only when the window fully drains. Wake the
-                # dispatcher BEFORE that (window nearly empty) so the
-                # refill overlaps the last task's execution instead of
-                # stalling the worker.
-                if not rec.inflight:
-                    rec.busy = False
-                    self._release_worker_allocation(rec)
-                    need_dispatch = True
-                elif len(rec.inflight) <= 2:
-                    need_dispatch = True
-            else:
-                actor = self.actors.get(rec.actor_id)
-                if actor is not None and spec is not None and spec.actor_creation:
-                    actor.state = "ALIVE" if not body.get("failed") else "DEAD"
-                    self._mark_dirty()
-                    if actor.state == "DEAD":
-                        self._wal_append(("actor_dead", rec.actor_id))
-                        actor.death_cause = "creation task failed"
-                        self._release_actor_arg_pins(actor)
-                        self._drain_actor_queue(actor)
-                        if actor.spec.name:
-                            self.named_actors.pop(
-                                (actor.spec.namespace, actor.spec.name), None
-                            )
-                        # Retire the dedicated worker and return its
-                        # reservation — otherwise failed creations leak
-                        # CPUs/chips and a zombie process each.
-                        self._release_worker_allocation(rec)
-                        if rec.conn is not None:
-                            try:
-                                rec.conn.cast("kill", {})
-                            except rpc.ConnectionLost:
-                                pass
-                # flush queued calls for this actor
-                if actor is not None:
-                    self._flush_actor(actor)
-                rec.busy = bool(rec.inflight)
-                need_dispatch = True
-        if need_dispatch:
+            need = self._task_finished_locked(body)
+        if need:
             self.dispatch_event.set()
         return None
+
+    def _task_finished_locked(self, body) -> bool:
+        """lock held. One task completion; returns whether the
+        dispatcher should wake."""
+        worker_id = body["worker_id"]
+        # Piggybacked inline RESULTS (sealed before the completion
+        # bookkeeping below, same order the split put_inline +
+        # task_finished messages guaranteed) and profile events —
+        # one cast per task carries everything, replacing a blocking
+        # put_inline round trip on the control plane's hottest path.
+        for rbody in body.get("results") or ():
+            self._seal_inline_locked(rbody)
+        if body.get("events"):
+            self.task_events.extend(body["events"])
+        rec = self.workers.get(worker_id)
+        if rec is None:
+            # Worker record already reaped (death raced the final
+            # cast) — but the seals above may have readied
+            # dep-blocked tasks, so the dispatcher must still wake.
+            # (No sealed_pending registration: the death handler
+            # already error-sealed or retried this task's returns.)
+            return True
+        for sp in body.get("sealed_pending") or ():
+            oid = sp["object_id"]
+            e = self.objects.get(oid)
+            if e is not None and e.state == CREATING:
+                # Containment pins register EAGERLY, before the owner's
+                # seal confirmation: the executing worker's del_ref for
+                # a ref returned inside a container must not free the
+                # inner object while the confirmation is in flight.
+                # (_register_contained is idempotent for the identical
+                # tuple arriving later via owner_sealed.)
+                if sp.get("contained_ids"):
+                    self._register_contained(e, sp["contained_ids"])
+                self._pending_owner_seals[oid] = worker_id
+                self._worker_pending_seals.setdefault(
+                    worker_id, set()).add(oid)
+        spec = rec.inflight.pop(body.get("task_id", ""), None)
+        if spec is not None:
+            t = self.tasks.get(spec.task_id)
+            if t:
+                t["state"] = FAILED if body.get("failed") else FINISHED
+                t["finished_at"] = time.time()
+                self._record_finished(spec.task_id)
+            self.stats["tasks_failed" if body.get("failed")
+                       else "tasks_finished"] += 1
+            if not spec.actor_creation:
+                # Creation-arg pins are held for the actor's
+                # restartable lifetime, released once at permanent
+                # DEAD (_release_actor_arg_pins) — not per attempt.
+                for dep in self._pinned_ids(spec):
+                    e = self.objects.get(dep)
+                    if e is not None and e.task_pins > 0:
+                        e.task_pins -= 1
+                        self._maybe_free(e)
+        # A dispatch pass is only useful when this completion freed
+        # capacity (allocation released) or a piggybacked seal woke a
+        # dep-blocked task — pipelined mid-window completions do
+        # neither, and skipping their wake cuts pass count ~4x.
+        need_dispatch = self._sealed_woke_task
+        self._sealed_woke_task = False
+        if rec.actor_id is None:
+            # Pipelined same-shape tasks share ONE allocation —
+            # release it only when the window fully drains. Wake the
+            # dispatcher BEFORE that (window nearly empty) so the
+            # refill overlaps the last task's execution instead of
+            # stalling the worker.
+            if not rec.inflight:
+                rec.busy = False
+                self._release_worker_allocation(rec)
+                need_dispatch = True
+            elif len(rec.inflight) <= 2:
+                need_dispatch = True
+        else:
+            actor = self.actors.get(rec.actor_id)
+            if actor is not None and spec is not None and spec.actor_creation:
+                actor.state = "ALIVE" if not body.get("failed") else "DEAD"
+                self._mark_dirty()
+                if actor.state == "DEAD":
+                    self._wal_append(("actor_dead", rec.actor_id))
+                    actor.death_cause = "creation task failed"
+                    self._release_actor_arg_pins(actor)
+                    self._drain_actor_queue(actor)
+                    if actor.spec.name:
+                        self.named_actors.pop(
+                            (actor.spec.namespace, actor.spec.name), None
+                        )
+                    # Retire the dedicated worker and return its
+                    # reservation — otherwise failed creations leak
+                    # CPUs/chips and a zombie process each.
+                    self._release_worker_allocation(rec)
+                    if rec.conn is not None:
+                        try:
+                            rec.conn.cast("kill", {})
+                        except rpc.ConnectionLost:
+                            pass
+            # flush queued calls for this actor
+            if actor is not None:
+                self._flush_actor(actor)
+            rec.busy = bool(rec.inflight)
+            need_dispatch = True
+        return need_dispatch
 
     # --- actors ---
 
@@ -2779,6 +2916,19 @@ class Head:
         with self.lock:
             self.workers.pop(rec.worker_id, None)
             self._release_worker_allocation(rec)
+            # Direct seals this worker reported but whose owner never
+            # confirmed: the seal died in the worker's send buffer.
+            # Error-seal the still-unsealed entries so waiters resolve
+            # instead of hanging on a value that will never arrive.
+            for oid in self._worker_pending_seals.pop(rec.worker_id, ()):
+                self._pending_owner_seals.pop(oid, None)
+                e = self.objects.get(oid)
+                if e is not None and e.state == CREATING:
+                    self._seal_error(
+                        oid,
+                        f"WorkerCrashedError: worker {rec.worker_id} "
+                        "died before its result reached the owner",
+                        "worker_crashed")
             inflight = list(rec.inflight.values())
             rec.inflight = {}
             if rec.actor_id is not None:
@@ -2943,6 +3093,18 @@ class Head:
             entry.refcount = 1
         self.objects[object_id] = entry
         self._on_sealed(object_id)
+        # The owner's get() waits LOCALLY for results it expects: push
+        # the error seal to its owner plane so that wait resolves
+        # without the stall-probe fallback.
+        if entry.owner_id in self.client_owner_addrs:
+            oconn = self.clients.get(entry.owner_id)
+            if oconn is not None:
+                try:
+                    oconn.cast_buffered("seal_objects", {"objects": [
+                        {"object_id": object_id, "payload": payload,
+                         "is_error": True}]})
+                except rpc.ConnectionLost:
+                    pass
 
     # ------------------------------------------------------------------
 

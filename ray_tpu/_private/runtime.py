@@ -44,6 +44,11 @@ _ERROR_KINDS = {
 }
 
 
+# Owner-store sentinel: the result was too big to inline and lives in
+# the head/agent store — resolve it through a head meta.
+_REMOTE = object()
+
+
 class _ShmReadPin:
     """One zero-copy read's deferred release. Each out-of-band buffer is
     wrapped in a weakref-able uint8 array; the reconstructed user arrays
@@ -113,8 +118,44 @@ class CoreRuntime:
         self._closed = False
         self.client_type = client_type
         self.address = address  # head (host, port) — job drivers reconnect here
+        # --- owner plane (reference: core_worker.h:172 ownership — the
+        # SUBMITTER of a task owns its results). Every runtime hosts a
+        # tiny server; executors deliver inline results straight here
+        # and borrowers/peers fetch values from the owner, so result
+        # payloads never transit the head (it keeps a slim directory
+        # entry only, for dependency wakeup and liveness).
+        self._owned_store: dict[str, tuple] = {}
+        self._owned_cond = threading.Condition()
+        # Return ids of tasks this runtime submitted whose results have
+        # not yet reached the owner plane. get() waits LOCALLY on these
+        # — every outcome is delivered here (inline payload, "stored
+        # big, ask the head" marker, or a head-pushed error seal), so
+        # the head never serves the owner's own result lookups.
+        self._expected_owned: "set[str]" = set()
+        self._owned_waiters = 0  # getters in the local wait loop
+        # Recently-freed owned ids: a seal can arrive AFTER the local
+        # ref died (fire-and-forget submit) — without the tombstone the
+        # payload would be orphaned in _owned_store forever.
+        self._dead_owned: "set[str]" = set()
+        self._dead_owned_fifo: "list[str]" = []
+        self._owner_conns: dict[tuple, rpc.Connection] = {}
+        self._owner_conns_lock = threading.Lock()
+        try:
+            self.owner_server: "rpc.Server | None" = rpc.Server(
+                self._handle_peer, host="0.0.0.0")
+        except OSError:
+            self.owner_server = None
+        self.owner_addr: "tuple[str, int] | None" = None
         self.conn = rpc.connect(address, handler=self._handle,
                                 name=client_type, on_close=self._on_conn_lost)
+        if self.owner_server is not None:
+            # Advertise the interface this host reaches the head from —
+            # remote workers connect back to it for result delivery.
+            try:
+                adv_ip = self.conn._sock.getsockname()[0]
+            except OSError:
+                adv_ip = "127.0.0.1"
+            self.owner_addr = (adv_ip, self.owner_server.address[1])
         # Off-host clients (ray:// drivers, or forced-remote for tests)
         # skip the shm fast path; the head ships object payloads inline
         # over the connection.
@@ -122,7 +163,8 @@ class CoreRuntime:
         reg = self.conn.call(
             "register",
             {"client_type": client_type, "worker_id": worker_id,
-             "pid": os.getpid(), "can_shm": can_shm},
+             "pid": os.getpid(), "can_shm": can_shm,
+             "owner_addr": self.owner_addr},
             timeout=GLOBAL_CONFIG.worker_register_timeout_s,
         )
         self.client_id = reg["client_id"]
@@ -137,7 +179,8 @@ class CoreRuntime:
                 reg = self.conn.call(
                     "register",
                     {"client_type": client_type, "worker_id": worker_id,
-                     "pid": os.getpid(), "can_shm": False},
+                     "pid": os.getpid(), "can_shm": False,
+                     "owner_addr": self.owner_addr},
                     timeout=GLOBAL_CONFIG.worker_register_timeout_s,
                 )
                 self.client_id = reg["client_id"]
@@ -194,6 +237,19 @@ class CoreRuntime:
     # inbound messages
 
     def _handle(self, kind: str, body: dict, conn: rpc.Connection):
+        if kind == "owned_freed":
+            # The head freed directory entries this runtime owns: drop
+            # the payloads and tombstone the ids (a late direct seal
+            # must not orphan bytes in the store).
+            for oid in body["ids"]:
+                self._purge_owned(oid)
+            return None
+        if kind == "seal_objects":
+            # Head-pushed seals (error results for retries-exhausted /
+            # cancelled / crashed tasks): store locally so the owner-
+            # local wait resolves; no notify — the head already knows.
+            self._store_owned_and_notify(body["objects"], notify=False)
+            return None
         if kind in ("objects_ready", "wait_ready", "pg_ready"):
             with self._waiters_lock:
                 fut = self._waiters.pop(body["waiter_id"], None)
@@ -248,7 +304,8 @@ class CoreRuntime:
                     "register",
                     {"client_type": self.client_type, "worker_id": None,
                      "pid": os.getpid(),
-                     "can_shm": getattr(self, "shm", None) is not None},
+                     "can_shm": getattr(self, "shm", None) is not None,
+                     "owner_addr": self.owner_addr},
                     timeout=GLOBAL_CONFIG.worker_register_timeout_s,
                 )
                 if reg["shm_name"] is not None:
@@ -265,7 +322,8 @@ class CoreRuntime:
                             "register",
                             {"client_type": self.client_type,
                              "worker_id": None, "pid": os.getpid(),
-                             "can_shm": False},
+                             "can_shm": False,
+                             "owner_addr": self.owner_addr},
                             timeout=GLOBAL_CONFIG.worker_register_timeout_s,
                         )
                 self.client_id = reg["client_id"]
@@ -343,6 +401,10 @@ class CoreRuntime:
                     except IndexError:
                         break
                     if kind == "owned":
+                        # NOT purged from the owned store here: the head
+                        # decides when the cluster is done with the
+                        # object (in-flight tasks may still fetch the
+                        # value from this store) and casts owned_freed.
                         owned.append(hex_id)
                         continue
                     n = self._borrows.get(hex_id, 0) - 1
@@ -371,6 +433,164 @@ class CoreRuntime:
             except Exception:
                 pass
             _time.sleep(0.05)
+
+    # ------------------------------------------------------------------
+    # owner plane (reference: core_worker.h:172 — the submitter owns its
+    # task results; the in-process store holds them and peers resolve
+    # values from the owner, the head being directory only)
+
+    def _handle_peer(self, kind: str, body: dict, conn: rpc.Connection):
+        if kind == "seal_objects":
+            self._store_owned_and_notify(body["objects"])
+            return None
+        if kind == "fetch_object":
+            with self._owned_cond:
+                v = self._owned_store.get(body["object_id"])
+            if v is None:
+                raise rpc.RpcError(
+                    f"object {body['object_id']} not in owner store")
+            return {"payload": v[0], "is_error": v[1]}
+        raise rpc.RpcError(f"unknown peer message {kind!r}")
+
+    def _store_owned_and_notify(self, objs: "list[dict]",
+                                notify: bool = True) -> None:
+        """Store directly-delivered result payloads (or "stored big,
+        ask the head" markers), then send the head its slim directory
+        notification. Ordering is the invariant that makes owner
+        residency safe: the head marks an entry SEALED only after the
+        OWNER confirms holding the bytes, so 'head says sealed' always
+        implies the value is fetchable. notify=False for seals PUSHED BY
+        the head itself (error seals — it already knows)."""
+        with self._owned_cond:
+            for rec in objs:
+                oid = rec["object_id"]
+                self._expected_owned.discard(oid)
+                if oid in self._dead_owned:
+                    continue  # local ref already died: drop the payload
+                if rec.get("remote"):
+                    self._owned_store[oid] = (_REMOTE, False)
+                else:
+                    self._owned_store[oid] = (
+                        rec["payload"], rec.get("is_error", False))
+            if self._owned_waiters:
+                self._owned_cond.notify_all()
+        if not notify:
+            return
+        slim = [{"object_id": r["object_id"], "owner_id": self.client_id,
+                 "size": len(r["payload"]),
+                 "is_error": r.get("is_error", False),
+                 "contained_ids": r.get("contained_ids") or []}
+                for r in objs if not r.get("remote")]
+        if not slim:
+            return
+        try:
+            self.conn.cast_buffered("owner_sealed", {"objects": slim})
+        except rpc.ConnectionLost:
+            pass
+
+    def _purge_owned(self, hex_id: str) -> None:
+        """The cluster is done with an owned object: drop its payload
+        and tombstone the id so a late direct seal (still in flight from
+        the executor) can't orphan bytes in the store."""
+        with self._owned_cond:
+            self._owned_store.pop(hex_id, None)
+            self._expected_owned.discard(hex_id)
+            if hex_id not in self._dead_owned:
+                self._dead_owned.add(hex_id)
+                self._dead_owned_fifo.append(hex_id)
+                if len(self._dead_owned_fifo) > 65536:
+                    self._dead_owned.discard(self._dead_owned_fifo.pop(0))
+            self._owned_cond.notify_all()
+
+    def _peer_owner_conn(self, addr: tuple) -> rpc.Connection:
+        with self._owner_conns_lock:
+            c = self._owner_conns.get(addr)
+        if c is not None and not c.closed:
+            return c
+        c = rpc.connect(addr, name="owner-peer")
+        with self._owner_conns_lock:
+            self._owner_conns[addr] = c
+        return c
+
+    def seal_to_owner(self, addr, bodies: "list[dict]") -> bool:
+        """Deliver inline task results directly to the owning runtime
+        (buffered; the global cast flusher bounds latency to ~1 ms).
+        Returns False when the owner is unreachable — the caller falls
+        back to routing the payloads through the head."""
+        addr = tuple(addr)
+        if self.owner_addr is not None and addr == tuple(self.owner_addr):
+            # Executing our own submission: store + notify directly.
+            self._store_owned_and_notify(bodies)
+            return True
+        try:
+            conn = self._peer_owner_conn(addr)
+            conn.cast_buffered("seal_objects", {"objects": bodies})
+            return True
+        except (OSError, rpc.RpcError, rpc.ConnectionLost):
+            return False
+
+    def _await_expected(self, waiting: "list[str]", local: dict,
+                        missing: "list[str]", deadline, timeout,
+                        ref_list) -> None:
+        """_owned_cond held. Wait for expected result deliveries,
+        moving arrivals into ``local`` (payloads) or ``missing`` (big-
+        object markers / forgotten ids — resolved via head metas).
+        Scans are coalesced to ~50/s for wide waits so a flood of
+        per-task seal notifications can't make the rescan quadratic.
+        A 5 s no-progress stall falls everything back to the head (the
+        safety net for delivery holes, e.g. a head restart)."""
+        import time as _time
+
+        last_progress = last_scan = _time.monotonic()
+        while waiting:
+            remaining = (None if deadline is None
+                         else deadline - _time.monotonic())
+            if remaining is not None and remaining <= 0:
+                raise GetTimeoutError(
+                    f"get timed out after {timeout}s on {ref_list}")
+            self._owned_cond.wait(
+                min(0.25, remaining) if remaining is not None else 0.25)
+            now = _time.monotonic()
+            if len(waiting) > 64 and now - last_scan < 0.02:
+                continue  # coalesce wakeups; rescan at most ~50x/s
+            last_scan = now
+            progressed, still = False, []
+            for hex_id in waiting:
+                v = self._owned_store.get(hex_id)
+                if v is None:
+                    if hex_id in self._expected_owned:
+                        still.append(hex_id)
+                    else:  # freed/forgotten: ask the head
+                        missing.append(hex_id)
+                        progressed = True
+                elif v[0] is _REMOTE:
+                    missing.append(hex_id)
+                    progressed = True
+                else:
+                    local[hex_id] = v
+                    progressed = True
+            waiting[:] = still
+            if progressed:
+                last_progress = now
+            elif now - last_progress > 5.0:
+                missing.extend(waiting)  # stalled: safety net
+                del waiting[:]
+
+    def _await_owned_local(self, hex_id: str, deadline) -> "tuple | None":
+        """Wait for an in-flight direct seal of an object this runtime
+        owns. Returns the (payload, is_error) pair or None on timeout."""
+        import time as _time
+
+        with self._owned_cond:
+            while True:
+                v = self._owned_store.get(hex_id)
+                if v is not None:
+                    return v
+                remaining = (None if deadline is None
+                             else deadline - _time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    return None
+                self._owned_cond.wait(min(remaining or 1.0, 1.0))
 
     # ------------------------------------------------------------------
     # objects
@@ -621,56 +841,123 @@ class CoreRuntime:
                                  is_error)
 
     def get(self, refs: ObjectRef | Sequence[ObjectRef], timeout: float | None = None) -> Any:
+        import time as _time
+
         single = isinstance(refs, ObjectRef)
         ref_list = [refs] if single else list(refs)
         if not ref_list:
             return [] if not single else None
         id_list = [r.hex() for r in ref_list]
+        deadline = None if timeout is None else _time.monotonic() + timeout
+        # Phase 1 — owner plane (reference: in-process store,
+        # core_worker.h:172). Results this runtime owns are DELIVERED
+        # here by executors: resolve present ones locally and wait
+        # locally for expected ones. Every outcome reaches this store
+        # (inline payload, big-object marker, head-pushed error seal),
+        # so the head serves none of the owner's own result lookups; a
+        # stall probe falls back to the head as the safety net for
+        # delivery holes (e.g. a head restart that lost owner state).
+        local: dict[str, tuple] = {}
+        missing: list[str] = []
         unblock = None
         if self._pre_block is not None:
             try:
                 unblock = self._pre_block()
             except Exception:
                 pass
-        waiter_id, fut = self._new_waiter()
-        self.conn.cast("get_meta", {"waiter_id": waiter_id, "ids": id_list})
         try:
-            body = fut.result(timeout)
-        except FutureTimeoutError:
-            self.conn.cast("cancel_wait", {"waiter_id": waiter_id})
-            raise GetTimeoutError(f"get timed out after {timeout}s on {ref_list}") from None
+            with self._owned_cond:
+                waiting: list[str] = []
+                for hex_id in id_list:
+                    v = self._owned_store.get(hex_id)
+                    if v is not None and v[0] is not _REMOTE:
+                        local[hex_id] = v
+                    elif v is not None:
+                        missing.append(hex_id)  # big: head meta
+                    elif hex_id in self._expected_owned:
+                        waiting.append(hex_id)
+                    else:
+                        missing.append(hex_id)
+                if waiting:
+                    self._owned_waiters += 1
+                    try:
+                        self._await_expected(waiting, local, missing,
+                                             deadline, timeout, ref_list)
+                    finally:
+                        self._owned_waiters -= 1
+            # Phase 2 — head metas for everything else.
+            metas: dict = {}
+            if missing:
+                remaining = (None if deadline is None
+                             else max(0.0, deadline - _time.monotonic()))
+                waiter_id, fut = self._new_waiter()
+                self.conn.cast("get_meta",
+                               {"waiter_id": waiter_id, "ids": missing})
+                try:
+                    body = fut.result(remaining)
+                except FutureTimeoutError:
+                    self.conn.cast("cancel_wait", {"waiter_id": waiter_id})
+                    raise GetTimeoutError(f"get timed out after {timeout}s on {ref_list}") from None
+                finally:
+                    with self._waiters_lock:
+                        self._waiters.pop(waiter_id, None)
+                metas = body["metas"]
         finally:
-            with self._waiters_lock:
-                self._waiters.pop(waiter_id, None)
             if unblock is not None:
                 unblock()
-        metas = body["metas"]
         values = []
         read_ids = []
         visited = 0
         try:
             for hex_id in id_list:
-                values.append(
-                    self._value_from_meta(hex_id, metas[hex_id], read_ids))
+                if hex_id in local:
+                    values.append(self._deserialize(*local[hex_id]))
+                else:
+                    values.append(self._value_from_meta(
+                        hex_id, metas[hex_id], read_ids, deadline))
                 visited += 1
         finally:
             # The head pinned EVERY shm/p2p meta up front; if resolution
             # raised mid-batch (e.g. a stored task error), the unvisited
             # metas' pins must still be released or their objects leak.
             for hex_id in id_list[visited + 1:]:
-                if metas[hex_id][0] in ("shm", "p2p"):
+                if hex_id not in local and metas[hex_id][0] in ("shm", "p2p"):
                     read_ids.append(hex_id)
             if read_ids:
                 self.conn.cast("read_done", {"ids": read_ids})
         return values[0] if single else values
 
     def _value_from_meta(self, hex_id: str, meta: tuple,
-                         read_ids: list) -> Any:
+                         read_ids: list, deadline=None) -> Any:
         """Resolve one object meta to its value. ``read_ids`` collects
         ids whose head-side read pin must be released (the caller casts
         read_done)."""
         if meta[0] == "inline":
             return self._deserialize(meta[1], meta[2])
+        if meta[0] == "owner":
+            # ("owner", host, port, is_error): the value lives in the
+            # owning runtime's in-process store. Resolve locally when
+            # this runtime IS the owner (the direct seal is at most a
+            # flush interval behind the head's directory update), else
+            # pull from the owner peer.
+            _, host, port, is_error = meta
+            if (self.owner_addr is not None
+                    and (host, port) == tuple(self.owner_addr)):
+                v = self._await_owned_local(hex_id, deadline)
+                if v is None:
+                    raise GetTimeoutError(
+                        f"get timed out awaiting owned object {hex_id}")
+                return self._deserialize(*v)
+            try:
+                r = self._peer_owner_conn((host, port)).call(
+                    "fetch_object", {"object_id": hex_id}, timeout=60)
+            except (OSError, rpc.RpcError, rpc.ConnectionLost):
+                # Owner-resident objects fate-share with their owner
+                # (reference: OwnerDiedError semantics).
+                raise ObjectLostError(
+                    f"object {hex_id}: owner at {host}:{port} is gone"
+                ) from None
+            return self._deserialize(r["payload"], r["is_error"])
         if meta[0] == "shm":
             _, offset, size, is_error = meta
             view = self.shm.view(offset, size)
@@ -734,6 +1021,18 @@ class CoreRuntime:
                 return self._deserialize(payload, is_error)
 
     def get_async(self, ref: ObjectRef) -> Future:
+        # Owner-local fast path (same as get()); _REMOTE markers mean
+        # "stored big, resolve via head meta" — fall through.
+        v = self._owned_store.get(ref.hex())
+        if v is not None and v[0] is _REMOTE:
+            v = None
+        if v is not None:
+            result = Future()
+            try:
+                result.set_result(self._deserialize(*v))
+            except Exception as e:  # noqa: BLE001 — stored task error
+                result.set_exception(e)
+            return result
         waiter_id, fut = self._new_waiter()
         result: Future = Future()
 
@@ -750,15 +1049,17 @@ class CoreRuntime:
                     finally:
                         view.release()
                         self.conn.cast("read_done", {"ids": [ref.hex()]})
-                elif meta[0] == "p2p":
-                    # Chunked network pull: never on the connection's
-                    # dispatch thread (it would stall every other
-                    # incoming head message for the transfer duration).
+                elif meta[0] in ("p2p", "owner"):
+                    # Network pull: never on the connection's dispatch
+                    # thread (it would stall every other incoming head
+                    # message for the transfer duration).
                     def _pull():
-                        # The initial meta carried a read pin already.
-                        read_ids: list = [ref.hex()]
+                        # p2p metas carried a read pin; owner metas are
+                        # not pinned on the head.
+                        read_ids: list = (
+                            [ref.hex()] if meta[0] == "p2p" else [])
                         try:
-                            result.set_result(self._read_p2p_retrying(
+                            result.set_result(self._value_from_meta(
                                 ref.hex(), meta, read_ids))
                         except Exception as e:  # noqa: BLE001
                             result.set_exception(e)
@@ -996,13 +1297,28 @@ class CoreRuntime:
         borrowed = sorted(set(collected) - set(deps))
         return packed, deps, borrowed
 
+    def _register_expected(self, spec: TaskSpec) -> None:
+        """Owner plane active: get() on these return ids waits locally —
+        every outcome (payload, big-object marker, error push) is
+        delivered to this runtime."""
+        if self.owner_addr is None or spec.streaming:
+            return
+        with self._owned_cond:
+            for oid in spec.return_ids:
+                self._expected_owned.add(oid)
+
     def submit_task(self, spec: TaskSpec) -> None:
+        # Results come straight back to this runtime's owner plane.
+        spec.owner_addr = self.owner_addr
+        self._register_expected(spec)
         # Buffered: a submission burst ships as one CAST_BATCH frame.
         # Ordering vs a following get/wait is preserved because every
         # call()/cast() on the connection flushes the buffer first.
         self.conn.cast_buffered("submit_task", {"spec": spec})
 
     def submit_actor_task(self, spec: TaskSpec) -> None:
+        spec.owner_addr = self.owner_addr
+        self._register_expected(spec)
         self.conn.cast_buffered("submit_actor_task", {"spec": spec})
 
     def create_actor(self, spec: ActorSpec) -> None:
@@ -1026,6 +1342,16 @@ class CoreRuntime:
         self._closed = True
         ids_mod.set_ref_removed_callback(None)
         ids_mod.set_borrow_callbacks(None, None)
+        if self.owner_server is not None:
+            self.owner_server.stop()
+        with self._owner_conns_lock:
+            peers = list(self._owner_conns.values())
+            self._owner_conns.clear()
+        for c in peers:
+            try:
+                c.close()
+            except Exception:
+                pass
         self.conn.close()
         if self.shm is not None:
             self.shm.close()

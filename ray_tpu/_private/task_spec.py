@@ -21,6 +21,12 @@ class TaskSpec:
     return_ids: list[str]
     resources: dict[str, float]
     owner_id: str  # client id of the submitter
+    # (host, port) of the submitter's owner-plane server: the executor
+    # delivers inline results DIRECTLY there, bypassing the head
+    # (reference: owner-resident in-process store + direct actor/task
+    # replies, core_worker.h:172 ownership model). None = head-routed
+    # results (older producers, e.g. the native C++ client).
+    owner_addr: Any = None
     max_retries: int = 0
     retries_used: int = 0
     # Streaming generator task: yielded items are stored under
@@ -50,10 +56,13 @@ class TaskSpec:
     #   _rkey / _demand — head dispatch caches (queue key, ResourceSet)
     #   _deps_pending   — unready-dependency set while dep-blocked
     #   _deferred_results — worker-side buffer of inline results
+    #   _remote_markers — worker-side "stored big, ask the head" notes
+    #                     delivered to the owner alongside inline seals
     _rkey: Any = dataclasses.field(default=None, repr=False)
     _demand: Any = dataclasses.field(default=None, repr=False)
     _deps_pending: Any = dataclasses.field(default=None, repr=False)
     _deferred_results: Any = dataclasses.field(default=None, repr=False)
+    _remote_markers: Any = dataclasses.field(default=None, repr=False)
 
     def __setstate__(self, state):
         """Accept BOTH pickle state forms. The slotted class emits

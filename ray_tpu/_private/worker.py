@@ -372,14 +372,26 @@ class Worker:
         except Exception:
             traceback.print_exc()
         try:
+            # The error objects may have been deferred into the spec
+            # buffer by _store_error — without delivering them (owner
+            # plane, or head fallback) the caller's get would hang.
+            results = getattr(spec, "_deferred_results", None) or []
+            markers = getattr(spec, "_remote_markers", None) or []
+            sealed_pending = None
+            if (results or markers) and getattr(spec, "owner_addr", None):
+                if self.runtime.seal_to_owner(spec.owner_addr,
+                                              results + markers):
+                    sealed_pending = [
+                        {"object_id": b["object_id"],
+                         "contained_ids": b.get("contained_ids") or []}
+                        for b in results]
+                    results = []
             self.runtime.conn.cast(
                 "task_finished",
                 {"worker_id": self.worker_id, "task_id": spec.task_id,
                  "failed": True,
-                 # The error objects may have been deferred into the
-                 # spec buffer by _store_error — without carrying them
-                 # here the caller's get would hang forever.
-                 "results": getattr(spec, "_deferred_results", None) or []},
+                 "results": results,
+                 "sealed_pending": sealed_pending},
             )
         except Exception:
             pass
@@ -390,6 +402,7 @@ class Worker:
         start = time.time()
         failed = False
         spec._deferred_results = []
+        spec._remote_markers = []
         sem = self.async_exec.semaphore(self._task_group(spec))
         async with sem:
             try:
@@ -407,11 +420,24 @@ class Worker:
                 failed = True
         self._cancelled_ids.discard(spec.task_id)
         try:
+            # Same owner-resident routing as the sync drainer path.
+            results = spec._deferred_results
+            markers = spec._remote_markers or []
+            sealed_pending = None
+            if (results or markers) and getattr(spec, "owner_addr", None):
+                if self.runtime.seal_to_owner(spec.owner_addr,
+                                              results + markers):
+                    sealed_pending = [
+                        {"object_id": b["object_id"],
+                         "contained_ids": b.get("contained_ids") or []}
+                        for b in results]
+                    results = []
             self.runtime.conn.cast(
                 "task_finished",
                 {"worker_id": self.worker_id, "task_id": spec.task_id,
                  "failed": failed,
-                 "results": spec._deferred_results,
+                 "results": results,
+                 "sealed_pending": sealed_pending,
                  "events": [{
                      "task_id": spec.task_id, "name": spec.name,
                      "worker_id": self.worker_id, "node_id": self.node_id,
@@ -571,6 +597,7 @@ class Worker:
         failed = False
         start = time.time()
         spec._deferred_results = []
+        spec._remote_markers = []
         try:
             if spec.task_id in self._cancelled_ids:
                 self._cancelled_ids.discard(spec.task_id)
@@ -590,6 +617,31 @@ class Worker:
             # the set stays bounded by the queue depth.
             self._cancelled_ids.discard(spec.task_id)
             try:
+                # Owner-resident result delivery (reference ownership
+                # model, core_worker.h:172): inline results go STRAIGHT
+                # to the submitting runtime's owner plane; the head gets
+                # only the ids to expect ("sealed_pending" — its
+                # directory seals when the OWNER confirms receipt, so a
+                # lost seal can never strand a waiter). Falls back to
+                # head-routed payloads when the owner is unreachable.
+                results = spec._deferred_results
+                markers = spec._remote_markers or []
+                sealed_pending = None
+                if (results or markers) and getattr(spec, "owner_addr",
+                                                    None):
+                    if self.runtime.seal_to_owner(spec.owner_addr,
+                                                  results + markers):
+                        # contained_ids ride along so the head can pin
+                        # container contents EAGERLY — this worker's
+                        # del_ref for a returned-inside-a-container ref
+                        # must not race the owner's (slower) seal
+                        # confirmation and free the inner object.
+                        sealed_pending = [
+                            {"object_id": b["object_id"],
+                             "contained_ids": b.get("contained_ids")
+                             or []}
+                            for b in results]
+                        results = []
                 # Completion + profile event in ONE cast (reference:
                 # core_worker/task_event_buffer.h:225 batches events for
                 # the same reason — the completion path is the control
@@ -600,7 +652,8 @@ class Worker:
                         "worker_id": self.worker_id,
                         "task_id": spec.task_id,
                         "failed": failed,
-                        "results": spec._deferred_results,
+                        "results": results,
+                        "sealed_pending": sealed_pending,
                         "events": [
                             {
                                 "task_id": spec.task_id,
@@ -737,6 +790,12 @@ class Worker:
             body = self.runtime.put_deferred(value, oid, is_error)
             if body is not None:
                 buf.append(body)
+            elif getattr(spec, "_remote_markers", None) is not None:
+                # Stored big through the shm/p2p path: tell the owner to
+                # resolve this id via a head meta (its local wait must
+                # not stall on a payload that will never be delivered).
+                spec._remote_markers.append(
+                    {"object_id": oid, "remote": True})
             return  # big values were stored by put_deferred itself
         self.runtime.put(value, _object_id=oid, _is_error=is_error)
 
