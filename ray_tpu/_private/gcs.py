@@ -36,7 +36,8 @@ from ray_tpu._private.scheduler import (
     ResourceSet,
 )
 from ray_tpu._private.shm_store import ShmArena
-from ray_tpu._private.task_spec import ActorSpec, TaskSpec
+from ray_tpu._private.task_spec import (ActorSpec, TaskSpec, pack_spec,
+                                        spec_from_body)
 
 # Object directory entry states.
 CREATING, SEALED, SPILLED, LOST = "CREATING", "SEALED", "SPILLED", "LOST"
@@ -687,6 +688,26 @@ class Head:
                     changed = True
                 if changed:
                     affected.append(e)
+            # In-flight results destined for the dead owner: the direct
+            # seal (if any) died with it and no owner_sealed will ever
+            # confirm. Error-seal still-CREATING entries someone else
+            # still references so their gets resolve instead of hanging;
+            # unreferenced ones fall to _maybe_free below. refcount is
+            # restored to 0 after sealing (the seal helper re-registers
+            # 1, but this owner is gone and will never del_ref) so the
+            # entry frees when the last borrower/pin drops.
+            orphaned = [e.object_id for e in self.objects.values()
+                        if e.owner_id == client_id and e.state == CREATING
+                        and (e.borrowers or e.task_pins > 0
+                             or e.container_pins > 0 or e.refcount > 0)]
+            for oid in orphaned:
+                self._seal_error(
+                    oid,
+                    f"OwnerDiedError: owner {client_id} died before "
+                    "the value was delivered", "object_lost")
+                e = self.objects.get(oid)
+                if e is not None:
+                    e.refcount = 0
             for e in affected:
                 self._maybe_free(e)
         if rec is not None:
@@ -753,7 +774,8 @@ class Head:
                     self.client_owner_addrs[client_id] = tuple(
                         body["owner_addr"])
                 conn.peer_info = {"client_id": client_id, "type": "worker",
-                                  "remote": remote}
+                                  "remote": remote,
+                                  "specenc": bool(body.get("specenc"))}
             self.dispatch_event.set()
         else:
             client_id = "driver-" + uuid.uuid4().hex[:8]
@@ -770,9 +792,12 @@ class Head:
                         body["owner_addr"])
             conn.peer_info = {"client_id": client_id, "type": "driver",
                               "remote": remote}
+        from ray_tpu._private.task_spec import _specenc
+
         return {
             "client_id": client_id,
             "shm_name": None if remote else self.shm_name,
+            "specenc": _specenc() is not None,
             "shm_capacity": self.config.object_store_memory,
             # A worker's node is where it was spawned (P2P object
             # locations are recorded against it); drivers sit on the
@@ -1134,7 +1159,8 @@ class Head:
             # store is not subject to arena eviction.
             addr = self.client_owner_addrs.get(entry.owner_id)
             if addr is not None:
-                return ("owner", addr[0], addr[1], entry.is_error)
+                return ("owner", addr[0], addr[1], entry.is_error,
+                        entry.owner_id)
             return ("lost",
                     f"object {entry.object_id}: owner {entry.owner_id} "
                     "is gone (owner-resident value fate-shares with its "
@@ -1528,7 +1554,7 @@ class Head:
                                       or ())
 
     def _h_submit_task(self, body, conn):
-        spec: TaskSpec = body["spec"]
+        spec: TaskSpec = spec_from_body(body)
         with self.lock:
             for oid in spec.return_ids:
                 entry = self.objects.get(oid) or ObjectEntry(oid, spec.owner_id)
@@ -1728,6 +1754,20 @@ class Head:
         # put_inline round trip on the control plane's hottest path.
         for rbody in body.get("results") or ():
             self._seal_inline_locked(rbody)
+            # Head-routed fallback (owner was unreachable from the
+            # executor): the owner may still be waiting locally for this
+            # id — push an ask-the-head marker so its get resolves now
+            # instead of riding the 5 s stall probe.
+            e = self.objects.get(rbody["object_id"])
+            if e is not None and e.owner_id in self.client_owner_addrs:
+                oconn = self.clients.get(e.owner_id)
+                if oconn is not None:
+                    try:
+                        oconn.cast_buffered("seal_objects", {"objects": [
+                            {"object_id": rbody["object_id"],
+                             "remote": True}]})
+                    except rpc.ConnectionLost:
+                        pass
         if body.get("events"):
             self.task_events.extend(body["events"])
         rec = self.workers.get(worker_id)
@@ -1858,7 +1898,7 @@ class Head:
         return {"actor_id": spec.actor_id}
 
     def _h_submit_actor_task(self, body, conn):
-        spec: TaskSpec = body["spec"]
+        spec: TaskSpec = spec_from_body(body)
         with self.lock:
             for oid in spec.return_ids:
                 entry = self.objects.get(oid) or ObjectEntry(oid, spec.owner_id)
@@ -2700,17 +2740,16 @@ class Head:
             t["worker_id"] = rec.worker_id
             t["started_at"] = time.time()
         try:
+            packed = (pack_spec(spec)
+                      if rec.conn.peer_info.get("specenc") else None)
+            push_body = ({"spec_bin": packed} if packed is not None
+                         else {"spec": spec})
+            push_body["tpu_chips"] = rec.tpu_chips
             if buffered:
-                rec.conn.cast_buffered(
-                    "push_task",
-                    {"spec": spec, "tpu_chips": rec.tpu_chips},
-                )
+                rec.conn.cast_buffered("push_task", push_body)
                 self._push_touched.add(rec.conn)
             else:
-                rec.conn.cast(
-                    "push_task",
-                    {"spec": spec, "tpu_chips": rec.tpu_chips},
-                )
+                rec.conn.cast("push_task", push_body)
         except rpc.ConnectionLost:
             pass  # worker death handler requeues
 

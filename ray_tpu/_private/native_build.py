@@ -31,15 +31,26 @@ _TARGETS = ("libobjstore.so", "libsched.so", "libchannel.so",
             "rtpu_client_demo")
 
 
+def _targets() -> tuple:
+    """_specenc.so (CPython extension) joins the target set only where
+    the Python dev headers exist — its make rule skips otherwise, and
+    treating it as required would flag every build stale forever."""
+    import shutil
+
+    if shutil.which("python3-config"):
+        return _TARGETS + ("_specenc.so",)
+    return _TARGETS
+
+
 def _stale() -> bool:
     try:
         newest_src = max(
             os.path.getmtime(os.path.join(root, f))
             for root, _, files in os.walk(_SRC) for f in files
-            if f.endswith((".cc", ".h")))
+            if f.endswith((".cc", ".h", ".c")))
     except ValueError:
         return False  # no sources (installed wheel) — nothing to build
-    for t in _TARGETS:
+    for t in _targets():
         p = os.path.join(_OUT, t)
         if not os.path.exists(p) or os.path.getmtime(p) < newest_src:
             return True
@@ -54,7 +65,7 @@ def ensure_native(quiet: bool = True) -> bool:
     with _lock:
         if _done:
             return all(os.path.exists(os.path.join(_OUT, t))
-                       for t in _TARGETS)
+                       for t in _targets())
         if not os.path.isdir(_SRC):
             _done = True
             return False
@@ -77,4 +88,4 @@ def ensure_native(quiet: bool = True) -> bool:
         finally:
             _done = True
         return all(os.path.exists(os.path.join(_OUT, t))
-                   for t in _TARGETS)
+                   for t in _targets())

@@ -160,13 +160,19 @@ class CoreRuntime:
         # skip the shm fast path; the head ships object payloads inline
         # over the connection.
         can_shm = not force_remote and os.environ.get("RAY_TPU_REMOTE") != "1"
+        from ray_tpu._private.task_spec import _specenc
+
         reg = self.conn.call(
             "register",
             {"client_type": client_type, "worker_id": worker_id,
              "pid": os.getpid(), "can_shm": can_shm,
-             "owner_addr": self.owner_addr},
+             "owner_addr": self.owner_addr,
+             "specenc": _specenc() is not None},
             timeout=GLOBAL_CONFIG.worker_register_timeout_s,
         )
+        # Compiled-spec negotiation: pack only when the head can unpack
+        # (mixed hosts may lack the extension; Makefile skips it there).
+        self._head_specenc = bool(reg.get("specenc"))
         self.client_id = reg["client_id"]
         self.node_id = reg["node_id"]
         self.session_dir = reg["session_dir"]
@@ -329,6 +335,7 @@ class CoreRuntime:
                 self.client_id = reg["client_id"]
                 self.node_id = reg["node_id"]
                 self.session_dir = reg["session_dir"]
+                self._head_specenc = bool(reg.get("specenc"))
                 # The new head's KV may lack function blobs exported to
                 # the old one (no snapshot, or crash inside the flush
                 # window): drop the "already exported" cache so the next
@@ -446,10 +453,15 @@ class CoreRuntime:
         if kind == "fetch_object":
             with self._owned_cond:
                 v = self._owned_store.get(body["object_id"])
-            if v is None:
+            if v is None or v[0] is _REMOTE:
                 raise rpc.RpcError(
                     f"object {body['object_id']} not in owner store")
             return {"payload": v[0], "is_error": v[1]}
+        if kind == "whoami":
+            # Peer identity check: a mis-advertised owner address (e.g.
+            # loopback seen from another host) must not silently swallow
+            # seals meant for a different runtime.
+            return {"client_id": self.client_id}
         raise rpc.RpcError(f"unknown peer message {kind!r}")
 
     def _store_owned_and_notify(self, objs: "list[dict]",
@@ -502,28 +514,44 @@ class CoreRuntime:
                     self._dead_owned.discard(self._dead_owned_fifo.pop(0))
             self._owned_cond.notify_all()
 
-    def _peer_owner_conn(self, addr: tuple) -> rpc.Connection:
+    def _peer_owner_conn(self, addr: tuple,
+                         expect_owner: "str | None" = None
+                         ) -> rpc.Connection:
         with self._owner_conns_lock:
             c = self._owner_conns.get(addr)
-        if c is not None and not c.closed:
-            return c
-        c = rpc.connect(addr, name="owner-peer")
-        with self._owner_conns_lock:
-            self._owner_conns[addr] = c
+        if c is None or c.closed:
+            c = rpc.connect(addr, name="owner-peer")
+            # Verify who answered: an advertised loopback address dialed
+            # from another host reaches the WRONG process — one-way
+            # seals would vanish silently. One RPC per (peer, addr).
+            try:
+                who = c.call("whoami", {}, timeout=10)
+                c.peer_info["owner_id"] = who.get("client_id")
+            except (rpc.RpcError, rpc.ConnectionLost):
+                c.peer_info["owner_id"] = None
+            with self._owner_conns_lock:
+                self._owner_conns[addr] = c
+        if (expect_owner is not None
+                and c.peer_info.get("owner_id") not in (None, expect_owner)):
+            raise rpc.RpcError(
+                f"owner address {addr} answered as "
+                f"{c.peer_info.get('owner_id')}, expected {expect_owner}")
         return c
 
-    def seal_to_owner(self, addr, bodies: "list[dict]") -> bool:
+    def seal_to_owner(self, addr, bodies: "list[dict]",
+                      expect_owner: "str | None" = None) -> bool:
         """Deliver inline task results directly to the owning runtime
         (buffered; the global cast flusher bounds latency to ~1 ms).
-        Returns False when the owner is unreachable — the caller falls
-        back to routing the payloads through the head."""
+        Returns False when the owner is unreachable or the address
+        answers as a different runtime — the caller falls back to
+        routing the payloads through the head."""
         addr = tuple(addr)
         if self.owner_addr is not None and addr == tuple(self.owner_addr):
             # Executing our own submission: store + notify directly.
             self._store_owned_and_notify(bodies)
             return True
         try:
-            conn = self._peer_owner_conn(addr)
+            conn = self._peer_owner_conn(addr, expect_owner=expect_owner)
             conn.cast_buffered("seal_objects", {"objects": bodies})
             return True
         except (OSError, rpc.RpcError, rpc.ConnectionLost):
@@ -935,12 +963,13 @@ class CoreRuntime:
         if meta[0] == "inline":
             return self._deserialize(meta[1], meta[2])
         if meta[0] == "owner":
-            # ("owner", host, port, is_error): the value lives in the
-            # owning runtime's in-process store. Resolve locally when
-            # this runtime IS the owner (the direct seal is at most a
-            # flush interval behind the head's directory update), else
-            # pull from the owner peer.
-            _, host, port, is_error = meta
+            # ("owner", host, port, is_error, owner_id): the value lives
+            # in the owning runtime's in-process store. Resolve locally
+            # when this runtime IS the owner (the direct seal is at most
+            # a flush interval behind the head's directory update), else
+            # pull from the owner peer (identity-verified).
+            _, host, port, is_error = meta[:4]
+            owner_id = meta[4] if len(meta) > 4 else None
             if (self.owner_addr is not None
                     and (host, port) == tuple(self.owner_addr)):
                 v = self._await_owned_local(hex_id, deadline)
@@ -949,7 +978,8 @@ class CoreRuntime:
                         f"get timed out awaiting owned object {hex_id}")
                 return self._deserialize(*v)
             try:
-                r = self._peer_owner_conn((host, port)).call(
+                r = self._peer_owner_conn(
+                    (host, port), expect_owner=owner_id).call(
                     "fetch_object", {"object_id": hex_id}, timeout=60)
             except (OSError, rpc.RpcError, rpc.ConnectionLost):
                 # Owner-resident objects fate-share with their owner
@@ -1307,6 +1337,17 @@ class CoreRuntime:
             for oid in spec.return_ids:
                 self._expected_owned.add(oid)
 
+    def _spec_body(self, spec: TaskSpec) -> dict:
+        """Compiled spec encoding when both ends support it
+        (task_spec.pack_spec; negotiated at register)."""
+        if getattr(self, "_head_specenc", False):
+            from ray_tpu._private.task_spec import pack_spec
+
+            packed = pack_spec(spec)
+            if packed is not None:
+                return {"spec_bin": packed}
+        return {"spec": spec}
+
     def submit_task(self, spec: TaskSpec) -> None:
         # Results come straight back to this runtime's owner plane.
         spec.owner_addr = self.owner_addr
@@ -1314,12 +1355,12 @@ class CoreRuntime:
         # Buffered: a submission burst ships as one CAST_BATCH frame.
         # Ordering vs a following get/wait is preserved because every
         # call()/cast() on the connection flushes the buffer first.
-        self.conn.cast_buffered("submit_task", {"spec": spec})
+        self.conn.cast_buffered("submit_task", self._spec_body(spec))
 
     def submit_actor_task(self, spec: TaskSpec) -> None:
         spec.owner_addr = self.owner_addr
         self._register_expected(spec)
-        self.conn.cast_buffered("submit_actor_task", {"spec": spec})
+        self.conn.cast_buffered("submit_actor_task", self._spec_body(spec))
 
     def create_actor(self, spec: ActorSpec) -> None:
         self.conn.call("create_actor", {"spec": spec})

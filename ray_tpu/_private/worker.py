@@ -131,7 +131,9 @@ class Worker:
 
     def _on_message(self, kind: str, body: dict):
         if kind == "push_task":
-            spec = body["spec"]
+            from ray_tpu._private.task_spec import spec_from_body
+
+            spec = spec_from_body(body)
             if (self.async_exec is not None and spec.actor_id is not None
                     and not spec.actor_creation):
                 self.async_exec.submit(
@@ -362,6 +364,32 @@ class Worker:
                 for name, limit in groups.items()
             }
 
+    def _route_results(self, spec) -> "tuple[list, list | None]":
+        """Owner-resident result routing shared by the sync drainer,
+        the async-actor path, and the coroutine-failure fallback:
+        deliver inline results + big-object markers straight to the
+        submitting runtime (verified by owner id), returning what must
+        still ride task_finished — (head_routed_results,
+        sealed_pending)."""
+        results = getattr(spec, "_deferred_results", None) or []
+        markers = getattr(spec, "_remote_markers", None) or []
+        sealed_pending = None
+        if (results or markers) and getattr(spec, "owner_addr", None):
+            if self.runtime.seal_to_owner(spec.owner_addr,
+                                          results + markers,
+                                          expect_owner=spec.owner_id):
+                # contained_ids ride along so the head can pin container
+                # contents EAGERLY — this worker's del_ref for a
+                # returned-inside-a-container ref must not race the
+                # owner's (slower) seal confirmation and free the inner
+                # object.
+                sealed_pending = [
+                    {"object_id": b["object_id"],
+                     "contained_ids": b.get("contained_ids") or []}
+                    for b in results]
+                results = []
+        return results, sealed_pending
+
     def _async_task_crashed(self, spec: TaskSpec, exc: BaseException) -> None:
         """A coroutine failed outside its own error handling (before the
         guarded try, or the loop rejected it): store the error and report
@@ -375,17 +403,7 @@ class Worker:
             # The error objects may have been deferred into the spec
             # buffer by _store_error — without delivering them (owner
             # plane, or head fallback) the caller's get would hang.
-            results = getattr(spec, "_deferred_results", None) or []
-            markers = getattr(spec, "_remote_markers", None) or []
-            sealed_pending = None
-            if (results or markers) and getattr(spec, "owner_addr", None):
-                if self.runtime.seal_to_owner(spec.owner_addr,
-                                              results + markers):
-                    sealed_pending = [
-                        {"object_id": b["object_id"],
-                         "contained_ids": b.get("contained_ids") or []}
-                        for b in results]
-                    results = []
+            results, sealed_pending = self._route_results(spec)
             self.runtime.conn.cast(
                 "task_finished",
                 {"worker_id": self.worker_id, "task_id": spec.task_id,
@@ -420,18 +438,7 @@ class Worker:
                 failed = True
         self._cancelled_ids.discard(spec.task_id)
         try:
-            # Same owner-resident routing as the sync drainer path.
-            results = spec._deferred_results
-            markers = spec._remote_markers or []
-            sealed_pending = None
-            if (results or markers) and getattr(spec, "owner_addr", None):
-                if self.runtime.seal_to_owner(spec.owner_addr,
-                                              results + markers):
-                    sealed_pending = [
-                        {"object_id": b["object_id"],
-                         "contained_ids": b.get("contained_ids") or []}
-                        for b in results]
-                    results = []
+            results, sealed_pending = self._route_results(spec)
             self.runtime.conn.cast(
                 "task_finished",
                 {"worker_id": self.worker_id, "task_id": spec.task_id,
@@ -624,24 +631,7 @@ class Worker:
                 # directory seals when the OWNER confirms receipt, so a
                 # lost seal can never strand a waiter). Falls back to
                 # head-routed payloads when the owner is unreachable.
-                results = spec._deferred_results
-                markers = spec._remote_markers or []
-                sealed_pending = None
-                if (results or markers) and getattr(spec, "owner_addr",
-                                                    None):
-                    if self.runtime.seal_to_owner(spec.owner_addr,
-                                                  results + markers):
-                        # contained_ids ride along so the head can pin
-                        # container contents EAGERLY — this worker's
-                        # del_ref for a returned-inside-a-container ref
-                        # must not race the owner's (slower) seal
-                        # confirmation and free the inner object.
-                        sealed_pending = [
-                            {"object_id": b["object_id"],
-                             "contained_ids": b.get("contained_ids")
-                             or []}
-                            for b in results]
-                        results = []
+                results, sealed_pending = self._route_results(spec)
                 # Completion + profile event in ONE cast (reference:
                 # core_worker/task_event_buffer.h:225 batches events for
                 # the same reason — the completion path is the control
