@@ -29,14 +29,23 @@ PROFILES = {
         "broadcast_mb": 16,
         "broadcast_nodes": 2,
         "actors": 8,
+        "actor_swarm": 30,
+        "placement_groups": 10,
     },
     "full": {
         "queued_tasks": 1_000_000,
         "get_refs": 1000,
         "fanout_args": 1000,
         "broadcast_mb": 256,
-        "broadcast_nodes": 3,
+        "broadcast_nodes": 8,
         "actors": 40,
+        # Reference envelope rows: "many nodes actor tests" 40k actors /
+        # 1k placement groups across a 50+ node cluster
+        # (release/benchmarks/README.md:10-12). Scaled to one box:
+        # 2,000 resident actor PROCESSES (zygote-forked, num_cpus=0)
+        # and 500 concurrent placement groups.
+        "actor_swarm": 2000,
+        "placement_groups": 500,
     },
 }
 
@@ -132,6 +141,55 @@ def _run_sections(p: dict, results: dict) -> dict:
     assert len(set(pids)) == p["actors"]
     for a in actors:
         ray_tpu.kill(a)
+
+    # 4b. Actor swarm at scale: resident PROCESS count (reference row:
+    #     40k actors across 50+ nodes; one-box scaling via zygote-forked
+    #     num_cpus=0 actors).
+    @ray_tpu.remote(num_cpus=0)
+    class SwarmMember:
+        def ping(self):
+            return 1
+
+    n_swarm = p["actor_swarm"]
+    t0 = time.time()
+    swarm = [SwarmMember.remote() for _ in range(n_swarm)]
+    # All alive: every member answers one call.
+    pings = ray_tpu.get([a.ping.remote() for a in swarm], timeout=3600)
+    spawn_dt = time.time() - t0
+    assert sum(pings) == n_swarm
+    from ray_tpu.util.state import list_actors
+
+    alive = sum(1 for a in list_actors(limit=n_swarm + 100)
+                if a.get("state") == "ALIVE")
+    results["actor_swarm"] = n_swarm
+    results["actor_swarm_resident"] = alive
+    results["actor_spawn_per_s"] = round(n_swarm / spawn_dt, 1)
+    t0 = time.time()
+    ray_tpu.get([a.ping.remote() for a in swarm], timeout=3600)
+    results["actor_swarm_call_per_s"] = round(
+        n_swarm / (time.time() - t0), 1)
+    for a in swarm:
+        ray_tpu.kill(a)
+    del swarm
+
+    # 4c. Placement groups: concurrent gang reservations (reference row:
+    #     1k placement groups; head-side reconcile only, tiny bundles).
+    from ray_tpu.util.placement_group import (placement_group,
+                                              remove_placement_group)
+
+    n_pg = p["placement_groups"]
+    t0 = time.time()
+    pgs = [placement_group([{"CPU": 0.001}], strategy="PACK")
+           for _ in range(n_pg)]
+    for pg in pgs:
+        pg.wait(timeout_seconds=600)
+    create_dt = time.time() - t0
+    results["placement_groups"] = n_pg
+    results["pg_create_per_s"] = round(n_pg / create_dt, 1)
+    t0 = time.time()
+    for pg in pgs:
+        remove_placement_group(pg)
+    results["pg_remove_per_s"] = round(n_pg / (time.time() - t0), 1)
 
     # 5. Broadcast a large object to simulated nodes (reference row:
     #    1 GiB broadcast to 50+ nodes): every agent node pulls the

@@ -432,14 +432,22 @@ class CoreRuntime:
                 return
 
     def _release_loop(self) -> None:
+        """Idle-adaptive: a busy runtime drains every 50 ms, an idle one
+        backs off to 2 s. A 20 Hz fixed tick looks free until a 2,000-
+        actor swarm runs on one core — 2,000 processes x 20 wakeups/s of
+        scheduler work saturated the box with zero useful work (found by
+        the scale envelope's actor axis)."""
         import time as _time
 
+        delay = 0.05
         while not self._closed:
+            had_work = bool(self._release_queue)
             try:
                 self._drain_releases()
             except Exception:
                 pass
-            _time.sleep(0.05)
+            delay = 0.05 if had_work else min(delay * 2, 2.0)
+            _time.sleep(delay)
 
     # ------------------------------------------------------------------
     # owner plane (reference: core_worker.h:172 — the submitter owns its
@@ -480,7 +488,10 @@ class CoreRuntime:
                 if oid in self._dead_owned:
                     continue  # local ref already died: drop the payload
                 if rec.get("remote"):
-                    self._owned_store[oid] = (_REMOTE, False)
+                    # Never clobber a real payload already delivered (a
+                    # retried task's head-routed attempt can race the
+                    # first attempt's direct seal).
+                    self._owned_store.setdefault(oid, (_REMOTE, False))
                 else:
                     self._owned_store[oid] = (
                         rec["payload"], rec.get("is_error", False))
@@ -523,16 +534,23 @@ class CoreRuntime:
             c = rpc.connect(addr, name="owner-peer")
             # Verify who answered: an advertised loopback address dialed
             # from another host reaches the WRONG process — one-way
-            # seals would vanish silently. One RPC per (peer, addr).
+            # seals would vanish silently. One RPC per (peer, addr). A
+            # failed handshake is NOT cached as trusted: the connection
+            # is dropped and the caller falls back to head routing.
             try:
                 who = c.call("whoami", {}, timeout=10)
                 c.peer_info["owner_id"] = who.get("client_id")
             except (rpc.RpcError, rpc.ConnectionLost):
-                c.peer_info["owner_id"] = None
+                try:
+                    c.close()
+                except Exception:
+                    pass
+                raise rpc.RpcError(
+                    f"owner address {addr} failed identity check")
             with self._owner_conns_lock:
                 self._owner_conns[addr] = c
         if (expect_owner is not None
-                and c.peer_info.get("owner_id") not in (None, expect_owner)):
+                and c.peer_info.get("owner_id") != expect_owner):
             raise rpc.RpcError(
                 f"owner address {addr} answered as "
                 f"{c.peer_info.get('owner_id')}, expected {expect_owner}")
@@ -982,8 +1000,15 @@ class CoreRuntime:
                     (host, port), expect_owner=owner_id).call(
                     "fetch_object", {"object_id": hex_id}, timeout=60)
             except (OSError, rpc.RpcError, rpc.ConnectionLost):
-                # Owner-resident objects fate-share with their owner
-                # (reference: OwnerDiedError semantics).
+                # The owner may have moved the value (e.g. a retried
+                # task's head-routed attempt replaced its store entry
+                # with a marker): re-resolve through the head once
+                # before declaring it lost with its owner (reference:
+                # OwnerDiedError semantics).
+                fresh = self._reresolve_meta(hex_id)
+                if fresh is not None and fresh[0] != "owner":
+                    return self._value_from_meta(hex_id, fresh, read_ids,
+                                                 deadline)
                 raise ObjectLostError(
                     f"object {hex_id}: owner at {host}:{port} is gone"
                 ) from None
@@ -1008,6 +1033,22 @@ class CoreRuntime:
             read_ids.append(hex_id)  # p2p metas are read-pinned too
             return self._read_p2p_retrying(hex_id, meta, read_ids)
         raise ObjectLostError(meta[1])
+
+    def _reresolve_meta(self, hex_id: str) -> "tuple | None":
+        """One synchronous head round trip for a fresh meta (fallback
+        path for stale owner/p2p metas). None on timeout."""
+        waiter_id, fut = self._new_waiter()
+        self.conn.cast("get_meta", {"waiter_id": waiter_id,
+                                    "ids": [hex_id]})
+        try:
+            body = fut.result(30)
+        except FutureTimeoutError:
+            self.conn.cast("cancel_wait", {"waiter_id": waiter_id})
+            return None
+        finally:
+            with self._waiters_lock:
+                self._waiters.pop(waiter_id, None)
+        return body["metas"][hex_id]
 
     def _read_p2p_retrying(self, hex_id: str, meta: tuple,
                            read_ids: list, attempts: int = 4) -> Any:

@@ -67,6 +67,11 @@ class SamplingParams:
     # entries per request — the device program carries a fixed-width
     # scatter (one compile for everyone).
     logit_bias: tuple = ()
+    # OpenAI response_format: None, {"type": "json_object"}, or
+    # {"type": "json_schema", "json_schema": {"schema": {...}}} —
+    # enforced by a per-step vocab mask over the JSON grammar
+    # (ray_tpu.llm.guided; reference surface: json_mode_utils.py).
+    response_format: "Any | None" = None
     # Reserved for future logit-processing extensions.
     extra: dict[str, Any] = field(default_factory=dict)
 
@@ -121,6 +126,13 @@ class LLMConfig:
     # Accepted values mirror `model` (TransformerConfig or factory name).
     speculative_model: Any = None
     num_speculative_tokens: int = 4
+    # Multi-LoRA serving (reference: server_models.py LoraConfig /
+    # vLLM-delegated multi-LoRA; native execution here — S-LoRA-style
+    # batched gather, ray_tpu.llm.lora). {"max_adapters": N,
+    # "max_rank": R}; adapters load/swap at runtime via
+    # engine.add_lora()/remove_lora(), and a request selects one with
+    # model="<model_id>:<adapter>" (or SamplingParams.extra["lora"]).
+    lora: "dict | None" = None
     speculative_checkpoint_path: str | None = None
     speculative_seed: int = 7
     # "byte" (offline-safe, vocab 256+specials) or a HF tokenizer path.

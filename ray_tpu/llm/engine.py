@@ -47,9 +47,15 @@ class Request:
     generated: list[int] = dataclasses.field(default_factory=list)
     finished: bool = False
     finish_reason: str | None = None
+    # Adapter pool index, resolved ONCE at admission (an unload between
+    # intake validation and admission fails the request, not the loop).
+    lora_ix: int = 0
     # Per generated token (only when params.logprobs > 0):
     # {"token_id", "logprob", "top": {token_id: logprob, ...}}
     logprobs: "list[dict] | None" = None
+    # Constraint driver (ray_tpu.llm.guided.GuidedJson) when the request
+    # asked for response_format json mode; None otherwise.
+    guided: "object | None" = None
 
 
 @dataclasses.dataclass
@@ -61,6 +67,9 @@ class RequestOutput:
     num_prompt_tokens: int
     # vLLM-style per-token logprobs (None unless requested).
     logprobs: "list[dict] | None" = None
+    # Guided-decoding verdict: None, or an error string when the output
+    # failed the constraint (truncated JSON / schema mismatch).
+    error: "str | None" = None
 
 
 class LLMEngine:
@@ -240,6 +249,63 @@ class LLMEngine:
         # AsyncLLMEngine driving the same engine) are handed here instead
         # of being dropped — see AsyncLLMEngine, which registers itself.
         self._foreign_output_listener = None
+        # Lazy per-tokenizer JSON token masker (guided decoding).
+        self._json_masker = None
+        # Multi-LoRA pool: per-slot adapter index 0 = null adapter.
+        self.lora_mgr = None
+        self.lora_ix = np.zeros((config.max_num_seqs,), np.int32)
+        if config.lora:
+            if (config.prefill_chunk or config.enable_prefix_caching
+                    or config.resolve_speculative_model() is not None
+                    or self._mr is not model_runner):
+                raise ValueError(
+                    "lora is not supported together with chunked "
+                    "prefill, prefix caching, speculative decoding, or "
+                    "pipeline parallelism")
+            from ray_tpu.llm.lora import LoRAManager
+
+            mc = self.model_config
+            hdh = mc.n_heads * mc.head_dim
+            kvdh = mc.kv_heads * mc.head_dim
+            self.lora_mgr = LoRAManager(
+                mc.n_layers,
+                {"wq": (mc.d_model, hdh), "wk": (mc.d_model, kvdh),
+                 "wv": (mc.d_model, kvdh), "wo": (hdh, mc.d_model)},
+                max_adapters=int(config.lora.get("max_adapters", 8)),
+                max_rank=int(config.lora.get("max_rank", 16)))
+
+    # -- multi-LoRA (reference: LoraConfig serving surface) ----------------
+
+    def add_lora(self, name: str, tensors, alpha: float = 16.0) -> None:
+        """Load (or hot-overwrite) an adapter. ``tensors`` is a
+        {"wq": (A, B), ...} dict, an .npz path, or a LoRAAdapter."""
+        if self.lora_mgr is None:
+            raise ValueError("engine was not configured with lora=")
+        from ray_tpu.llm.lora import LoRAAdapter
+
+        if isinstance(tensors, LoRAAdapter):
+            ad = tensors
+        elif isinstance(tensors, str):
+            ad = LoRAAdapter.load(name, tensors, alpha=alpha)
+        else:
+            ad = LoRAAdapter(name, tensors, alpha=alpha)
+        with self._lock:
+            self.lora_mgr.add(ad)
+
+    def remove_lora(self, name: str) -> bool:
+        if self.lora_mgr is None:
+            return False
+        with self._lock:
+            return self.lora_mgr.remove(name)
+
+    def list_loras(self) -> "list[str]":
+        return [] if self.lora_mgr is None else self.lora_mgr.loaded()
+
+    def _req_lora_ix(self, req: Request) -> int:
+        name = (req.params.extra or {}).get("lora")
+        if not name:
+            return 0
+        return self.lora_mgr.index_of(name)
 
     # -- request intake ----------------------------------------------------
 
@@ -268,10 +334,103 @@ class LLMEngine:
                 f"request {request_id!r} has an empty prompt (prefill "
                 f"needs at least one token to produce next-token logits)"
             )
-        self.waiting.append(Request(request_id, toks, sp))
+        lname = (sp.extra or {}).get("lora")
+        if lname:
+            if self.lora_mgr is None:
+                raise ValueError(
+                    f"request selects LoRA adapter {lname!r} but the "
+                    "engine has no lora= config")
+            try:
+                self.lora_mgr.index_of(lname)
+            except KeyError as e:
+                raise ValueError(str(e)) from None
+        req = Request(request_id, toks, sp)
+        if sp.response_format is not None:
+            req.guided = self._make_guided(sp.response_format)
+        self.waiting.append(req)
 
     def has_unfinished(self) -> bool:
         return bool(self.waiting) or any(s is not None for s in self.slots)
+
+    # -- guided decoding (reference surface: response_format /
+    #    json_mode_utils.py; enforcement is native here: ray_tpu.llm.guided)
+
+    def _make_guided(self, rf) -> "object":
+        from ray_tpu.llm import guided as gd
+
+        if not isinstance(rf, dict) or rf.get("type") not in (
+                "json_object", "json_schema", "text"):
+            raise ValueError(
+                f"response_format must be {{'type': 'json_object'|"
+                f"'json_schema'|'text'}}, got {rf!r}")
+        if rf.get("type") == "text":
+            return None
+        schema = None
+        if rf.get("type") == "json_schema":
+            js = rf.get("json_schema") or {}
+            schema = js.get("schema") if isinstance(js, dict) else None
+            if schema is not None and not isinstance(schema, dict):
+                raise ValueError("json_schema.schema must be an object")
+        if self._json_masker is None:
+            tok = self.tokenizer
+            v_tok = len(tok)
+            texts = [tok.decode([i], skip_special_tokens=False)
+                     if i != getattr(tok, "eos_token_id", -1) else ""
+                     for i in range(v_tok)]
+            # Pad to the model's (padded) vocab: ids past the tokenizer
+            # range must never be sampled under a constraint.
+            texts += [""] * (self.model_config.vocab_size - v_tok)
+            self._json_masker = gd.JsonTokenMasker(
+                texts, eos_id=int(getattr(tok, "eos_token_id", 0) or 0))
+        return gd.GuidedJson(self._json_masker,
+                             mode=rf["type"], schema=schema)
+
+    def _guided_sample(self, req: Request, slot: int,
+                       logits_row: np.ndarray) -> int:
+        """Host-side constrained pick: mask the step's logits to the
+        tokens the JSON automaton allows, then run the request's
+        temperature pipeline over what remains."""
+        sp = req.params
+        mask = req.guided.allowed_mask()
+        lg = logits_row.astype(np.float64)
+        for tid, b in sp.logit_bias:
+            lg[int(tid)] += float(b)
+        if sp.repetition_penalty != 1.0:
+            seen = np.unique(np.asarray(
+                list(req.prompt_tokens) + list(req.generated), np.int64))
+            vals = lg[seen]
+            lg[seen] = np.where(vals > 0, vals / sp.repetition_penalty,
+                                vals * sp.repetition_penalty)
+        if (sp.presence_penalty or sp.frequency_penalty) and req.generated:
+            cnt = np.bincount(np.asarray(req.generated, np.int64),
+                              minlength=lg.shape[0])[: lg.shape[0]]
+            lg -= (sp.frequency_penalty * cnt
+                   + sp.presence_penalty * (cnt > 0))
+        lg[~mask] = -np.inf
+        if not np.isfinite(lg).any():
+            # Automaton cornered (shouldn't happen: eos is allowed once
+            # complete) — force eos so the request terminates.
+            return int(self._json_masker.eos_id)
+        if sp.temperature <= 0.0:
+            tok = int(lg.argmax())
+            dist = lg
+        else:
+            dist = self._host_filter(lg / max(sp.temperature, 1e-6), sp)
+            dist[~mask] = -np.inf
+            p = np.exp(dist - dist[np.isfinite(dist)].max())
+            p[~np.isfinite(p)] = 0.0
+            s = p.sum()
+            if s <= 0:
+                tok = int(lg.argmax())
+            else:
+                rng = np.random.default_rng(
+                    int(np.uint32(self.seeds[slot]))
+                    + len(req.generated) + 1)
+                tok = int(rng.choice(len(p), p=p / s))
+        if req.logprobs is not None:
+            req.logprobs.append(self._host_logprob_entry(dist, sp, tok))
+        req.guided.accept(tok)
+        return tok
 
     # -- scheduling --------------------------------------------------------
 
@@ -297,14 +456,33 @@ class LLMEngine:
                      and self._mr is model_runner)
         admits: list[tuple[int, Request]] = []
         for slot in range(len(self.slots)):
-            if self.slots[slot] is not None or not self.waiting:
+            if self.slots[slot] is not None:
                 continue
-            admits.append((slot, self.waiting.popleft()))
+            while self.waiting:
+                req = self.waiting.popleft()
+                if self.lora_mgr is not None:
+                    # Resolve the adapter index HERE: an unload racing
+                    # the queue fails this one request with a clean
+                    # output instead of throwing inside the step loop.
+                    try:
+                        req.lora_ix = self._req_lora_ix(req)
+                    except KeyError as e:
+                        req.finished = True
+                        req.finish_reason = "error"
+                        outputs.append(RequestOutput(
+                            request_id=req.request_id, token_ids=[],
+                            text="", finish_reason="error",
+                            num_prompt_tokens=len(req.prompt_tokens),
+                            error=str(e)))
+                        continue
+                admits.append((slot, req))
+                break
         if not admits:
             return
         if not batchable or len(admits) == 1:
             for slot, req in admits:
-                last_logits = self._prefill_into(slot, req.prompt_tokens)
+                last_logits = self._prefill_into(
+                    slot, req.prompt_tokens, lora_ix=req.lora_ix)
                 self._finish_admit(slot, req, np.asarray(last_logits),
                                    outputs)
             return
@@ -316,7 +494,8 @@ class LLMEngine:
         for S, group in sorted(groups.items()):
             if len(group) == 1:
                 slot, req = group[0]
-                last_logits = self._prefill_into(slot, req.prompt_tokens)
+                last_logits = self._prefill_into(
+                    slot, req.prompt_tokens, lora_ix=req.lora_ix)
                 self._finish_admit(slot, req, np.asarray(last_logits),
                                    outputs)
                 continue
@@ -332,10 +511,17 @@ class LLMEngine:
                 toks[j, :L] = req.prompt_tokens
                 lens[j] = L
                 slots_arr[j] = slot
+            lkw = {}
+            if self.lora_mgr is not None:
+                aix = np.zeros((N,), np.int32)
+                for j, (_slot, r) in enumerate(group):
+                    aix[j] = r.lora_ix
+                lkw = {"lora": self.lora_mgr.lora_tree(),
+                       "lora_ix": jnp.asarray(aix)}
             logits, self.cache = model_runner.prefill_batch(
                 self.params, jnp.asarray(toks), jnp.asarray(lens),
                 jnp.asarray(slots_arr), self.cache,
-                config=self.model_config)
+                config=self.model_config, **lkw)
             logits_np = np.asarray(logits)
             for j, (slot, req) in enumerate(group):
                 self._finish_admit(slot, req, logits_np[j], outputs)
@@ -357,11 +543,16 @@ class LLMEngine:
         for j, (tid, b) in enumerate(sp.logit_bias[:MAX_LOGIT_BIAS]):
             self.bias_ids[slot, j] = int(tid)
             self.bias_vals[slot, j] = float(b)
+        if self.lora_mgr is not None:
+            self.lora_ix[slot] = req.lora_ix
         self.pres_pens[slot] = sp.presence_penalty
         self.freq_pens[slot] = sp.frequency_penalty
         self.rep_pens[slot] = sp.repetition_penalty
         self._plain[slot] = not sp.needs_advanced()
-        self._spec_ok[slot] = sp.greedy_equivalent() and sp.logprobs == 0
+        # Guided slots pick host-side (masked); speculation's greedy
+        # contract doesn't hold for them.
+        self._spec_ok[slot] = (sp.greedy_equivalent() and sp.logprobs == 0
+                               and req.guided is None)
         if sp.seed is not None:
             self.seeds[slot] = np.int32(np.uint32(sp.seed & 0xFFFFFFFF))
         else:
@@ -370,7 +561,10 @@ class LLMEngine:
                 np.uint32(int(jax.random.bits(k, dtype=jnp.uint32))))
         if sp.logprobs > 0:
             req.logprobs = []
-        tok = self._sample_host(last_logits, slot, req)
+        if req.guided is not None:
+            tok = self._guided_sample(req, slot, last_logits)
+        else:
+            tok = self._sample_host(last_logits, slot, req)
         if not self._plain[slot]:
             # Seed the device-side penalty state: prompt token set +
             # the first sampled token.
@@ -384,7 +578,8 @@ class LLMEngine:
         req.generated.append(tok)
         self._maybe_finish(slot, outputs)
 
-    def _prefill_into(self, slot: int, toks: list[int]):
+    def _prefill_into(self, slot: int, toks: list[int],
+                      lora_ix: int = 0):
         """Write a prompt's K/V into ``slot`` (prefix-cache install +
         chunked or whole-prompt prefill) and return the last-token
         logits [V]."""
@@ -413,9 +608,14 @@ class LLMEngine:
             if off == 0 and len(part) == L:
                 # Whole prompt in one go: within-chunk attention ([S,S]
                 # scores, no history pass) is the cheapest path.
+                lkw = {}
+                if self.lora_mgr is not None:
+                    lkw = {"lora": self.lora_mgr.lora_tree(),
+                           "lora_ix": jnp.asarray([lora_ix], jnp.int32)}
                 last_logits, self.cache = self._mr.prefill(
                     self.params, jnp.asarray(padded), jnp.int32(len(part)),
                     jnp.int32(slot), self.cache, config=self.model_config,
+                    **lkw,
                 )
             else:
                 last_logits, self.cache = model_runner.prefill_at(
@@ -645,6 +845,9 @@ class LLMEngine:
         if reason is not None:
             req.finished = True
             req.finish_reason = reason
+            guided_err = None
+            if req.guided is not None:
+                _ok, guided_err = req.guided.finished_ok()
             outputs.append(RequestOutput(
                 request_id=req.request_id,
                 token_ids=list(req.generated),
@@ -653,6 +856,7 @@ class LLMEngine:
                 finish_reason=reason,
                 num_prompt_tokens=len(req.prompt_tokens),
                 logprobs=req.logprobs,
+                error=guided_err,
             ))
             self.slots[slot] = None
 
@@ -689,6 +893,10 @@ class LLMEngine:
                     jnp.asarray(self.temps), dkey,
                     config=self.draft["config"])
         self._rng, key = jax.random.split(self._rng)
+        lkw = {}
+        if self.lora_mgr is not None:
+            lkw = {"lora": self.lora_mgr.lora_tree(),
+                   "lora_ix": jnp.asarray(self.lora_ix)}
         toks, logits, self.cache = self._mr.decode(
             self.params,
             jnp.asarray(self.last_tokens),
@@ -697,6 +905,7 @@ class LLMEngine:
             jnp.asarray(self.temps),
             key,
             config=self.model_config,
+            **lkw,
         )
         lp_info = None
         if not all(self._plain[s] for s in active):
@@ -724,16 +933,28 @@ class LLMEngine:
                 lp_info = (np.asarray(chosen_lp), np.asarray(top_vals),
                            np.asarray(top_ids))
         toks = np.asarray(toks)
+        # Guided slots re-pick host-side under the JSON vocab mask (the
+        # device program chose unconstrained; logits are this step's).
+        guided_overrides: dict[int, int] = {}
+        if any(self.slots[s] is not None and self.slots[s].guided
+               is not None for s in active):
+            logits_np = np.asarray(logits)
+            for slot in active:
+                req = self.slots[slot]
+                if req is not None and req.guided is not None:
+                    guided_overrides[slot] = self._guided_sample(
+                        req, slot, logits_np[slot])
         # Only active slots advance; inactive slots' writes land at their
         # stale position and are reclaimed by the next prefill's mask.
         self.positions[active] += 1
         self._step_count += 1
         for slot in active:
             req = self.slots[slot]
-            tok = int(toks[slot])
+            tok = guided_overrides.get(slot, int(toks[slot]))
             self.last_tokens[slot] = tok
             req.generated.append(tok)
-            if req.logprobs is not None and lp_info is not None:
+            if (req.logprobs is not None and lp_info is not None
+                    and slot not in guided_overrides):
                 chosen_lp, top_vals, top_ids = lp_info
                 n = req.params.logprobs
                 req.logprobs.append({

@@ -1,0 +1,202 @@
+"""Multi-LoRA adapter management for the decode engine.
+
+Counterpart of the reference's serve-side LoRA surface (reference:
+python/ray/llm/_internal/serve/configs/server_models.py LoraConfig —
+dynamic_lora_loading_path, max_num_adapters_per_replica; the reference
+delegates execution to vLLM's multi-LoRA). TPU-native execution model
+(S-LoRA-style batched gather, reshaped for the MXU):
+
+- Every adapter's A/B factors are stacked into per-target tensors
+  A[n_adapters, L, d, r], B[n_adapters, L, r, out] resident on device.
+- Each decode slot carries an adapter index (0 = the reserved null
+  adapter, all zeros), so ONE jitted decode program serves any mix of
+  adapters in a batch: the per-layer delta is
+      h @ A[aix, layer] @ B[aix, layer] * (alpha / r)
+  — two small einsums gathered by batch row, no recompilation on
+  adapter swap, static shapes for XLA.
+- Loading a new adapter writes into a preallocated slot of the stacked
+  tensors (device put of one adapter's factors), so hot-swap never
+  reshapes the program's inputs.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+import numpy as np
+
+# Projection targets LoRA can attach to, in the transformer params
+# layout (models/transformer.py): layers/attn/{wq,wk,wv,wo} and the MLP.
+TARGETS = ("wq", "wk", "wv", "wo")
+
+
+class LoRAAdapter:
+    """One adapter's factors, host-side.
+
+    tensors: {"wq": (A [L, d, r], B [L, r, out]), ...} — any subset of
+    TARGETS. alpha scales the delta by alpha / r (standard LoRA)."""
+
+    def __init__(self, name: str, tensors: "dict[str, tuple]",
+                 alpha: float = 16.0):
+        self.name = name
+        self.tensors = {}
+        self.rank = None
+        for tgt, (A, B) in tensors.items():
+            if tgt not in TARGETS:
+                raise ValueError(f"unknown LoRA target {tgt!r}; "
+                                 f"supported: {TARGETS}")
+            A = np.asarray(A, dtype=np.float32)
+            B = np.asarray(B, dtype=np.float32)
+            if A.ndim != 3 or B.ndim != 3 or A.shape[2] != B.shape[1]:
+                raise ValueError(
+                    f"{tgt}: want A [L,d,r] and B [L,r,out], got "
+                    f"{A.shape} / {B.shape}")
+            if self.rank is None:
+                self.rank = A.shape[2]
+            elif A.shape[2] != self.rank:
+                raise ValueError("all targets must share one rank")
+            self.tensors[tgt] = (A, B)
+        if self.rank is None:
+            raise ValueError("adapter has no tensors")
+        self.alpha = float(alpha)
+
+    @property
+    def scale(self) -> float:
+        return self.alpha / self.rank
+
+    @classmethod
+    def load(cls, name: str, path: str, alpha: float = 16.0):
+        """Load from an .npz with keys '{target}.A' / '{target}.B'."""
+        z = np.load(path)
+        tensors: dict = {}
+        for tgt in TARGETS:
+            if f"{tgt}.A" in z and f"{tgt}.B" in z:
+                tensors[tgt] = (z[f"{tgt}.A"], z[f"{tgt}.B"])
+        return cls(name, tensors, alpha=alpha)
+
+
+class LoRAManager:
+    """Stacked device-resident adapter pool + name -> index registry.
+
+    Index 0 is the reserved null adapter (zero factors): slots without
+    an adapter compute a delta of exactly zero through the same program.
+    """
+
+    def __init__(self, n_layers: int, dims: "dict[str, tuple]",
+                 max_adapters: int = 8, max_rank: int = 16):
+        """dims: target -> (in_dim, out_dim). For the transformer layout
+        (models/transformer.py): wq (d, H*Dh), wk/wv (d, KV*Dh),
+        wo (H*Dh, d)."""
+        import jax.numpy as jnp
+
+        self.max_adapters = max_adapters
+        self.max_rank = max_rank
+        self.n_layers = n_layers
+        self.dims = dict(dims)
+        self._lock = threading.Lock()
+        self._names: dict[str, int] = {}
+        self._free = list(range(1, max_adapters))
+        self._scales = np.zeros((max_adapters,), np.float32)
+        # Stacked factors, zero-initialized (null adapter = index 0).
+        self.stacked: dict[str, tuple] = {}
+        for tgt, (din, dout) in self.dims.items():
+            A = jnp.zeros((max_adapters, n_layers, din, max_rank),
+                          jnp.float32)
+            B = jnp.zeros((max_adapters, n_layers, max_rank, dout),
+                          jnp.float32)
+            self.stacked[tgt] = (A, B)
+
+    # -- registry ----------------------------------------------------------
+
+    def index_of(self, name: "str | None") -> int:
+        if not name:
+            return 0
+        with self._lock:
+            ix = self._names.get(name)
+        if ix is None:
+            raise KeyError(f"LoRA adapter {name!r} is not loaded")
+        return ix
+
+    def loaded(self) -> "list[str]":
+        with self._lock:
+            return sorted(self._names)
+
+    def add(self, adapter: LoRAAdapter) -> int:
+        """Load (or overwrite) an adapter into a pool slot."""
+        import jax.numpy as jnp
+
+        if adapter.rank > self.max_rank:
+            raise ValueError(
+                f"adapter rank {adapter.rank} > pool max_rank "
+                f"{self.max_rank}")
+        with self._lock:
+            ix = self._names.get(adapter.name)
+            if ix is None:
+                if not self._free:
+                    raise RuntimeError(
+                        f"LoRA pool full ({self.max_adapters - 1} "
+                        "adapters); unload one first")
+                ix = self._free.pop(0)
+                self._names[adapter.name] = ix
+            self._scales[ix] = adapter.scale
+            for tgt, (A, B) in self.stacked.items():
+                if tgt in adapter.tensors:
+                    a_np, b_np = adapter.tensors[tgt]
+                    r = a_np.shape[2]
+                    a_pad = np.zeros(A.shape[1:], np.float32)
+                    b_pad = np.zeros(B.shape[1:], np.float32)
+                    a_pad[:, :, :r] = a_np
+                    b_pad[:, :r, :] = b_np
+                else:
+                    a_pad = np.zeros(A.shape[1:], np.float32)
+                    b_pad = np.zeros(B.shape[1:], np.float32)
+                self.stacked[tgt] = (A.at[ix].set(jnp.asarray(a_pad)),
+                                     B.at[ix].set(jnp.asarray(b_pad)))
+        return ix
+
+    def remove(self, name: str) -> bool:
+        import jax.numpy as jnp
+
+        with self._lock:
+            ix = self._names.pop(name, None)
+            if ix is None:
+                return False
+            self._free.append(ix)
+            self._scales[ix] = 0.0
+            # Zero the slot so a stale index computes a zero delta.
+            for tgt, (A, B) in self.stacked.items():
+                self.stacked[tgt] = (
+                    A.at[ix].set(jnp.zeros(A.shape[1:], jnp.float32)),
+                    B.at[ix].set(jnp.zeros(B.shape[1:], jnp.float32)),
+                )
+            return True
+
+    # -- program inputs ----------------------------------------------------
+
+    def lora_tree(self) -> dict:
+        """The pytree handed to the decode/prefill programs: stacked
+        factors plus per-adapter scales."""
+        import jax.numpy as jnp
+
+        return {
+            "scales": jnp.asarray(self._scales),
+            **{tgt: {"A": A, "B": B}
+               for tgt, (A, B) in self.stacked.items()},
+        }
+
+
+def lora_delta(h, lora_layer: dict, aix, scales):
+    """Per-layer, per-target LoRA delta for a batch of rows.
+
+    h: [B, T, d]; lora_layer: {"A": [n, d, r], "B": [n, r, out]} for ONE
+    layer (pre-sliced by the scan); aix: int32 [B] adapter index per
+    row; scales: [n]. Returns [B, T, out]."""
+    import jax.numpy as jnp
+
+    A = lora_layer["A"][aix]          # [B, d, r]   (gather by row)
+    B = lora_layer["B"][aix]          # [B, r, out]
+    s = scales[aix]                   # [B]
+    t = jnp.einsum("btd,bdr->btr", h, A)
+    d = jnp.einsum("btr,bro->bto", t, B)
+    return d * s[:, None, None]

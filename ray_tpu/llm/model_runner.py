@@ -86,7 +86,16 @@ def embed_tokens(params, tokens, positions, c, dt):
                                theta=c.rope_theta)
 
 
-def make_prefill_body(c, dt, positions, rope, slot, *, cache_write=None):
+def _lora_layers_xs(lora):
+    """Stacked adapter factors [n, L, ...] -> per-layer scan xs
+    [L, n, ...] plus the (aix, scales) gather context."""
+    return {t: {"A": jnp.moveaxis(lora[t]["A"], 1, 0),
+                "B": jnp.moveaxis(lora[t]["B"], 1, 0)}
+            for t in lora if t != "scales"}
+
+
+def make_prefill_body(c, dt, positions, rope, slot, *, cache_write=None,
+                      lora_ctx=None):
     """Per-layer scan body for whole-prompt prefill: xs = (layer params,
     layer k-cache [slots,T,KV,Dh], layer v-cache). Shared by prefill(),
     prefill_batch() (via ``cache_write``), and the pipeline runner's
@@ -103,11 +112,19 @@ def make_prefill_body(c, dt, positions, rope, slot, *, cache_write=None):
                                                 (slot, 0, 0, 0))
 
     def body(x, xs):
-        lp, kc, vc = xs
+        if lora_ctx is None:
+            lp, kc, vc = xs
+            ll = None
+        else:
+            lp, kc, vc, ll = xs
         h = _norm1(x, lp, c)
         q = jnp.einsum("btd,dhk->bthk", h, lp["attn"]["wq"].astype(dt))
         k = jnp.einsum("btd,dhk->bthk", h, lp["attn"]["wk"].astype(dt))
         v = jnp.einsum("btd,dhk->bthk", h, lp["attn"]["wv"].astype(dt))
+        if ll is not None:
+            # Batched multi-adapter LoRA (S-LoRA-style gather; see
+            # ray_tpu.llm.lora): per-row adapter index, one program.
+            q, k, v = _lora_qkv(h, q, k, v, ll, lora_ctx, dt)
         if rope is not None:
             q = apply_rope(q, *rope, positions=positions)
             k = apply_rope(k, *rope, positions=positions)
@@ -115,14 +132,47 @@ def make_prefill_body(c, dt, positions, rope, slot, *, cache_write=None):
         vc = cache_write(vc, v)
         kf, vf = _expand_gqa(k, v, c)
         o = dot_product_attention(q, kf, vf, causal=True).astype(dt)
-        o = jnp.einsum("bshk,hkd->bsd", o, lp["attn"]["wo"].astype(dt))
+        o = _wo_proj(o, lp, ll, lora_ctx, dt)
         x = x + o
         return x + _mlp(x, lp, c, dt), (kc, vc)
 
     return body
 
 
-def make_decode_body(c, dt, positions, rope_tables, kmask, barange):
+def _lora_qkv(h, q, k, v, ll, lora_ctx, dt):
+    """Add each projection's gathered low-rank delta (zero for rows on
+    the null adapter)."""
+    from ray_tpu.llm.lora import lora_delta
+
+    aix, scales = lora_ctx
+    for tgt, t in (("wq", q), ("wk", k), ("wv", v)):
+        if tgt in ll:
+            d = lora_delta(h, ll[tgt], aix, scales).astype(dt)
+            if tgt == "wq":
+                q = t + d.reshape(t.shape)
+            elif tgt == "wk":
+                k = t + d.reshape(t.shape)
+            else:
+                v = t + d.reshape(t.shape)
+    return q, k, v
+
+
+def _wo_proj(o, lp, ll, lora_ctx, dt):
+    """Output projection with optional LoRA delta (input is the
+    flattened [B, S, H*Dh] attention output)."""
+    out = jnp.einsum("bshk,hkd->bsd", o, lp["attn"]["wo"].astype(dt))
+    if ll is not None and "wo" in ll:
+        from ray_tpu.llm.lora import lora_delta
+
+        aix, scales = lora_ctx
+        B, S = o.shape[0], o.shape[1]
+        flat = o.reshape(B, S, -1)
+        out = out + lora_delta(flat, ll["wo"], aix, scales).astype(dt)
+    return out
+
+
+def make_decode_body(c, dt, positions, rope_tables, kmask, barange,
+                     lora_ctx=None):
     """Per-layer scan body for the all-slots decode step: xs = (layer
     params, layer k-cache [B,T,KV,Dh], layer v-cache). ``rope_tables``
     are the per-slot [B,1,1,Dh/2] cos/sin gathers (None for gpt2)."""
@@ -133,11 +183,17 @@ def make_decode_body(c, dt, positions, rope_tables, kmask, barange):
                                axis=-1).astype(t.dtype)
 
     def body(x, xs):
-        lp, kc, vc = xs
+        if lora_ctx is None:
+            lp, kc, vc = xs
+            ll = None
+        else:
+            lp, kc, vc, ll = xs
         h = _norm1(x, lp, c)
         q = jnp.einsum("btd,dhk->bthk", h, lp["attn"]["wq"].astype(dt))
         k = jnp.einsum("btd,dhk->bthk", h, lp["attn"]["wk"].astype(dt))
         v = jnp.einsum("btd,dhk->bthk", h, lp["attn"]["wv"].astype(dt))
+        if ll is not None:
+            q, k, v = _lora_qkv(h, q, k, v, ll, lora_ctx, dt)
         if rope_tables is not None:
             q, k = rot(q), rot(k)
         kc = kc.at[barange, positions].set(k[:, 0])
@@ -149,7 +205,7 @@ def make_decode_body(c, dt, positions, rope_tables, kmask, barange):
         scores = jnp.where(kmask[:, None, None, :], scores, -1e30)
         p = jax.nn.softmax(scores, axis=-1)
         o = jnp.einsum("bhst,bthk->bshk", p, vf.astype(jnp.float32)).astype(dt)
-        o = jnp.einsum("bshk,hkd->bsd", o, lp["attn"]["wo"].astype(dt))
+        o = _wo_proj(o, lp, ll, lora_ctx, dt)
         x = x + o
         return x + _mlp(x, lp, c, dt), (kc, vc)
 
@@ -296,7 +352,8 @@ def reset_slot_sampling(counts, prompt_mask, slot, prompt_hist, first_tok):
 
 
 @partial(jax.jit, static_argnames=("config",), donate_argnames=("cache",))
-def prefill(params, tokens, true_len, slot, cache, *, config: TransformerConfig):
+def prefill(params, tokens, true_len, slot, cache, *,
+            config: TransformerConfig, lora=None, lora_ix=None):
     """Run one padded prompt [1, S] and write K/V into cache slot.
 
     Returns (last_logits [V] float32, cache'). ``true_len`` is the
@@ -309,10 +366,13 @@ def prefill(params, tokens, true_len, slot, cache, *, config: TransformerConfig)
     _, S = tokens.shape
     positions = jnp.arange(S)
     x, rope = embed_tokens(params, tokens, positions, c, dt)
-    body = make_prefill_body(c, dt, positions, rope, slot)
-    x, (k_new, v_new) = jax.lax.scan(
-        body, x, (params["layers"], cache["k"], cache["v"])
-    )
+    lora_ctx = None if lora is None else (lora_ix, lora["scales"])
+    body = make_prefill_body(c, dt, positions, rope, slot,
+                             lora_ctx=lora_ctx)
+    xs = (params["layers"], cache["k"], cache["v"])
+    if lora is not None:
+        xs = xs + (_lora_layers_xs(lora),)
+    x, (k_new, v_new) = jax.lax.scan(body, x, xs)
     # LM head on the last real token only: prompt logits are never
     # needed, and skipping the [S, V] head matmul is the single biggest
     # prefill-FLOPs saving (V >> D).
@@ -323,7 +383,7 @@ def prefill(params, tokens, true_len, slot, cache, *, config: TransformerConfig)
 
 @partial(jax.jit, static_argnames=("config",), donate_argnames=("cache",))
 def prefill_batch(params, tokens, true_lens, slots, cache,
-                  *, config: TransformerConfig):
+                  *, config: TransformerConfig, lora=None, lora_ix=None):
     """Batched whole-prompt prefill: N same-bucket prompts in ONE
     program (vLLM batches prefills; on TPU this also fills the MXU
     batch dim and amortizes per-call dispatch). tokens [N, S],
@@ -346,11 +406,14 @@ def prefill_batch(params, tokens, true_lens, slots, cache,
         # index and write nothing (JAX scatter OOB-drop semantics).
         return cache_arr.at[slots, :S].set(new, mode="drop")
 
+    lora_ctx = None if lora is None else (lora_ix, lora["scales"])
     body = make_prefill_body(c, dt, positions, rope, None,
-                             cache_write=scatter_rows)
-    x, (k_new, v_new) = jax.lax.scan(
-        body, x, (params["layers"], cache["k"], cache["v"])
-    )
+                             cache_write=scatter_rows,
+                             lora_ctx=lora_ctx)
+    xs = (params["layers"], cache["k"], cache["v"])
+    if lora is not None:
+        xs = xs + (_lora_layers_xs(lora),)
+    x, (k_new, v_new) = jax.lax.scan(body, x, xs)
     xl = jnp.take_along_axis(
         x, (true_lens - 1)[:, None, None].astype(jnp.int32), axis=1)  # [N,1,D]
     last = _final_logits(xl, params, c, dt)[:, 0]  # [N, V]
@@ -519,7 +582,7 @@ def verify(params, tokens, positions, cache, *, config: TransformerConfig):
 
 @partial(jax.jit, static_argnames=("config",), donate_argnames=("cache",))
 def decode(params, tokens, positions, cache, temperature, rng,
-           *, config: TransformerConfig):
+           *, config: TransformerConfig, lora=None, lora_ix=None):
     """One decode step for all slots: tokens [B], positions [B].
 
     Writes each slot's new K/V row at its own position, attends with the
@@ -540,11 +603,13 @@ def decode(params, tokens, positions, cache, temperature, rng,
         rope_tables = (cos[positions][:, None, None, :],
                        sin[positions][:, None, None, :])
     kmask = (jnp.arange(T)[None, :] <= positions[:, None])  # [B, T]
+    lora_ctx = None if lora is None else (lora_ix, lora["scales"])
     body = make_decode_body(c, dt, positions, rope_tables, kmask,
-                            jnp.arange(B))
-    x, (k_new, v_new) = jax.lax.scan(
-        body, x, (params["layers"], cache["k"], cache["v"])
-    )
+                            jnp.arange(B), lora_ctx=lora_ctx)
+    xs = (params["layers"], cache["k"], cache["v"])
+    if lora is not None:
+        xs = xs + (_lora_layers_xs(lora),)
+    x, (k_new, v_new) = jax.lax.scan(body, x, xs)
     logits = _final_logits(x, params, c, dt)[:, 0]  # [B, V]
     toks = sample_tokens(logits, temperature, rng)
     return toks, logits, {"k": k_new, "v": v_new}

@@ -102,7 +102,38 @@ class LLMServer:
                 (int(k), float(v))
                 for k, v in (payload.get("logit_bias") or {}).items()
             ) or d.logit_bias,
+            # OpenAI response_format (json mode / json-schema mode):
+            # enforced by the engine's guided decoder.
+            response_format=payload.get("response_format",
+                                        d.response_format),
+            # Multi-LoRA: model "<model_id>:<adapter>" selects a loaded
+            # adapter for this request (vLLM-style per-request LoRA).
+            extra=self._lora_extra(payload),
         )
+
+    def _lora_extra(self, payload: dict) -> dict:
+        """Merged SamplingParams.extra: configured defaults, plus the
+        model-suffix adapter selector — only when this engine actually
+        serves adapters (a ':' in a model id must not be hijacked on a
+        lora-less deployment)."""
+        d = self.config.sampling_defaults
+        extra = dict(d.extra or {})
+        model = payload.get("model") or ""
+        if (isinstance(model, str) and ":" in model
+                and getattr(self.engine, "lora_mgr", None) is not None):
+            extra["lora"] = model.split(":", 1)[1]
+        return extra
+
+    def load_lora_adapter(self, payload: dict) -> dict:
+        """Dynamic adapter load (reference: LoraConfig
+        dynamic_lora_loading_path; vLLM /v1/load_lora_adapter)."""
+        self.engine.add_lora(payload["lora_name"], payload["lora_path"],
+                             alpha=float(payload.get("alpha", 16.0)))
+        return {"loaded": self.engine.list_loras()}
+
+    def unload_lora_adapter(self, payload: dict) -> dict:
+        removed = self.engine.remove_lora(payload["lora_name"])
+        return {"removed": removed, "loaded": self.engine.list_loras()}
 
     def _render_chat(self, messages: list[dict]) -> str:
         # Minimal chat template (byte tokenizer has no special chat tokens).
@@ -253,6 +284,7 @@ class LLMServer:
                 choices.append(
                     {"index": len(choices), "text": o.text,
                      "finish_reason": o.finish_reason,
+                     **({"guided_error": o.error} if o.error else {}),
                      **({"logprobs": self._openai_logprobs(o)}
                         if o.logprobs is not None and sp.logprobs > 0
                         else {})})
@@ -324,6 +356,7 @@ class LLMServer:
                 "index": 0,
                 "message": {"role": "assistant", "content": out.text},
                 "finish_reason": out.finish_reason,
+                **({"guided_error": out.error} if out.error else {}),
                 **({"logprobs": {"content": [
                     {"token": self.engine.tokenizer.decode([e["token_id"]]),
                      "logprob": e["logprob"],

@@ -1,0 +1,103 @@
+"""Multi-LoRA serving (reference: server_models.py LoraConfig — adapter
+registry + per-request selection, execution delegated to vLLM there;
+native S-LoRA-style batched-gather execution here, ray_tpu.llm.lora)."""
+
+import numpy as np
+import pytest
+
+from ray_tpu.llm.config import LLMConfig, SamplingParams
+from ray_tpu.llm.engine import LLMEngine
+
+
+@pytest.fixture(scope="module")
+def engine():
+    cfg = LLMConfig(model_id="tiny", model="tiny", max_num_seqs=2,
+                    max_seq_len=256,
+                    lora={"max_adapters": 4, "max_rank": 8})
+    return LLMEngine(cfg)
+
+
+def _strong_adapter(mc, seed=0):
+    rng = np.random.default_rng(seed)
+    L, d = mc.n_layers, mc.d_model
+    out = mc.n_heads * mc.head_dim
+    A = rng.standard_normal((L, d, 8)).astype(np.float32) * 4.0
+    B = rng.standard_normal((L, 8, out)).astype(np.float32) * 4.0
+    return {"wq": (A, B)}
+
+
+def _run(engine, prompt, lora=None, n=12):
+    sp = SamplingParams(max_tokens=n, temperature=0.0,
+                        extra=({"lora": lora} if lora else {}))
+    engine.add_request("r", prompt, sp)
+    outs = []
+    while not outs:
+        outs = engine.step()
+    return outs[0].token_ids
+
+
+def test_adapter_changes_output_base_unaffected(engine):
+    base = _run(engine, "hello world")
+    assert base == _run(engine, "hello world")  # greedy deterministic
+    engine.add_lora("bender", _strong_adapter(engine.model_config),
+                    alpha=64.0)
+    assert engine.list_loras() == ["bender"]
+    with_lora = _run(engine, "hello world", lora="bender")
+    assert with_lora != base
+    # Null-adapter requests see the exact base model while the adapter
+    # is resident (mixed-batch semantics of the gathered delta).
+    assert _run(engine, "hello world") == base
+
+
+def test_swap_and_reload(engine):
+    engine.add_lora("bender", _strong_adapter(engine.model_config),
+                    alpha=64.0)
+    ref = _run(engine, "abc", lora="bender")
+    assert engine.remove_lora("bender")
+    with pytest.raises(ValueError):
+        _run(engine, "abc", lora="bender")
+    engine.add_lora("bender", _strong_adapter(engine.model_config),
+                    alpha=64.0)
+    assert _run(engine, "abc", lora="bender") == ref
+
+
+def test_unknown_adapter_rejected(engine):
+    with pytest.raises(ValueError):
+        engine.add_request("x", "hi", SamplingParams(
+            extra={"lora": "nope"}))
+
+
+def test_mixed_batch_isolation(engine):
+    """Two slots decoding concurrently — one with an adapter, one
+    without — produce the same tokens as when run alone."""
+    engine.add_lora("bender", _strong_adapter(engine.model_config),
+                    alpha=64.0)
+    solo_base = _run(engine, "xyz")
+    solo_lora = _run(engine, "xyz", lora="bender")
+    engine.add_request("a", "xyz", SamplingParams(max_tokens=12,
+                                                  temperature=0.0))
+    engine.add_request("b", "xyz", SamplingParams(
+        max_tokens=12, temperature=0.0, extra={"lora": "bender"}))
+    done = {}
+    while len(done) < 2:
+        for o in engine.step():
+            done[o.request_id] = o.token_ids
+    assert done["a"] == solo_base
+    assert done["b"] == solo_lora
+
+
+def test_serving_model_suffix_selects_adapter():
+    from types import SimpleNamespace
+
+    from ray_tpu.llm.config import SamplingParams
+    from ray_tpu.llm.serving import LLMServer
+
+    stub = SimpleNamespace(
+        engine=SimpleNamespace(lora_mgr=object()),
+        config=SimpleNamespace(sampling_defaults=SamplingParams()))
+    extra = LLMServer._lora_extra(stub, {"model": "tiny:bender"})
+    assert extra == {"lora": "bender"}
+    assert LLMServer._lora_extra(stub, {"model": "tiny"}) == {}
+    # A ':' in the model id of a LORA-LESS deployment is not hijacked.
+    stub.engine = SimpleNamespace(lora_mgr=None)
+    assert LLMServer._lora_extra(stub, {"model": "ft:base:org"}) == {}
