@@ -307,6 +307,9 @@ class Head:
         # process.
         self._pending_owner_seals: dict[str, str] = {}
         self._worker_pending_seals: dict[str, set] = {}
+        # owner_id -> freed object ids awaiting one coalesced
+        # owned_freed cast (flushed per dispatch pass).
+        self._owned_freed_buf: dict[str, list] = {}
         self.task_events: deque[dict] = deque(maxlen=config.task_events_max_buffer)
         self.metrics: dict[str, Any] = {}
         # Core runtime counters (reference: DEFINE_stats core metric set,
@@ -1473,14 +1476,11 @@ class Head:
             # _seal_error mirrors them into the owner store, which
             # would otherwise never purge them): tell the owner the
             # cluster is done with this object so it can drop/tombstone
-            # the id (buffered — frees arrive in bursts and coalesce).
-            oconn = self.clients.get(entry.owner_id)
-            if oconn is not None:
-                try:
-                    oconn.cast_buffered("owned_freed",
-                                        {"ids": [entry.object_id]})
-                except rpc.ConnectionLost:
-                    pass
+            # the id. Buffered per owner and flushed by the dispatcher
+            # in ONE cast per pass — a million-object drain must not
+            # become a million owned_freed messages.
+            self._owned_freed_buf.setdefault(
+                entry.owner_id, []).append(entry.object_id)
         self.objects.pop(entry.object_id, None)
         w = self._pending_owner_seals.pop(entry.object_id, None)
         if w is not None:
@@ -1589,7 +1589,7 @@ class Head:
         package half poisons a worker's sys.modules for other envs."""
         if not renv:
             return None
-        pkg = {k: renv[k] for k in ("pip", "conda") if renv.get(k)}
+        pkg = {k: renv[k] for k in ("pip", "conda", "uv") if renv.get(k)}
         if not pkg:
             return None
         import hashlib as _hashlib
@@ -2398,6 +2398,23 @@ class Head:
                     conn.flush_casts()
                 except Exception:
                     pass
+            self._flush_owned_freed()
+
+    def _flush_owned_freed(self) -> None:
+        """One owned_freed cast per owner per pass (frees accumulate in
+        _owned_freed_buf under the lock)."""
+        if not self._owned_freed_buf:
+            return
+        with self.lock:
+            buf, self._owned_freed_buf = self._owned_freed_buf, {}
+        for owner_id, ids in buf.items():
+            oconn = self.clients.get(owner_id)
+            if oconn is None:
+                continue
+            try:
+                oconn.cast_buffered("owned_freed", {"ids": ids})
+            except rpc.ConnectionLost:
+                pass
 
     def _dispatch_once_locked(self) -> None:
         with self.lock:
@@ -2778,7 +2795,8 @@ class Head:
         # (worker_pool.h runtime-env-keyed caching), here those actors
         # get a fresh interpreter.
         renv = spec.runtime_env or {}
-        fresh_env = bool(renv.get("pip") or renv.get("conda"))
+        fresh_env = bool(renv.get("pip") or renv.get("conda")
+                         or renv.get("uv"))
         rec = (None if (need_tpu or fresh_env)
                else self._idle_worker(node.node_id, False))
         reused = rec is not None

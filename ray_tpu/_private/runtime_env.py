@@ -22,7 +22,7 @@ plugins that work with zero egress:
                  virtualenv; clashing binary deps should still be
                  pre-baked into the image.
 
-conda/uv envs are rejected with a clear error (they manage whole
+container/image_uri envs are rejected with a clear error (they need
 interpreter environments — pre-bake instead, the reference's
 recommended production posture as well).
 """
@@ -74,12 +74,6 @@ def pack(runtime_env: dict | None, rt) -> dict | None:
     to URIs (reference: working_dir.py upload_package_if_needed)."""
     if not runtime_env:
         return runtime_env
-    if runtime_env.get("uv"):
-        raise ValueError(
-            "runtime_env['uv'] manages whole interpreter environments; "
-            "pre-install in the worker image, or use runtime_env['pip'] "
-            "/ runtime_env['conda'] with local wheels (find_links)"
-        )
     for bad in ("container", "image_uri"):
         if runtime_env.get(bad):
             raise ValueError(
@@ -112,6 +106,20 @@ def pack(runtime_env: dict | None, rt) -> dict | None:
             rt.kv_put(uri, blob, ns="__runtime_env__", overwrite=False)
             spec["find_links"] = uri
         env["pip"] = spec
+    if env.get("uv"):
+        spec = normalize_uv_spec(env["uv"])
+        fl = spec.get("find_links")
+        if fl and not fl.startswith(("pkg:", "http://", "https://",
+                                     "file://")):
+            if not os.path.isdir(fl):
+                raise ValueError(
+                    f"runtime_env uv find_links {fl!r} is not a "
+                    f"directory on the driver")
+            blob = _zip_dir(fl)
+            uri = "pkg:" + hashlib.sha256(blob).hexdigest()[:32]
+            rt.kv_put(uri, blob, ns="__runtime_env__", overwrite=False)
+            spec["find_links"] = uri
+        env["uv"] = spec
     if env.get("conda"):
         spec = normalize_conda_spec(env["conda"])
         fl = spec.get("find_links")
@@ -148,6 +156,25 @@ def normalize_pip_spec(spec) -> dict:
     if not isinstance(spec, dict) or not spec.get("packages"):
         raise ValueError(
             "runtime_env['pip'] must be a list of requirements or "
+            "{'packages': [...], 'find_links': dir, 'index_url': url}")
+    out = {"packages": [str(p) for p in spec["packages"]]}
+    for key in ("find_links", "index_url"):
+        if spec.get(key):
+            out[key] = str(spec[key])
+    return out
+
+
+def normalize_uv_spec(spec) -> dict:
+    """uv env spec (reference: _private/runtime_env/uv.py — accepts a
+    requirements list or {"packages": [...], "uv_version", "uv_check",
+    ...}). The version/check knobs are image-management concerns and
+    are ignored here (the image ships one uv); packages resolve
+    OFFLINE by default like the pip path."""
+    if isinstance(spec, (list, tuple)):
+        spec = {"packages": list(spec)}
+    if not isinstance(spec, dict) or not spec.get("packages"):
+        raise ValueError(
+            "runtime_env['uv'] must be a list of requirements or "
             "{'packages': [...], 'find_links': dir, 'index_url': url}")
     out = {"packages": [str(p) for p in spec["packages"]]}
     for key in ("find_links", "index_url"):
@@ -257,7 +284,7 @@ def _venv_env_dir(spec: dict, cache_dir: str,
             if proc.returncode != 0:
                 shutil.rmtree(tmp, ignore_errors=True)
                 raise RuntimeError(
-                    f"runtime_env conda install failed "
+                    f"runtime_env venv install failed "
                     f"(rc={proc.returncode}): {proc.stderr[-2000:]}\n"
                     f"(zero-egress default is --no-index: provide "
                     f"'find_links' with local wheels, or an explicit "
@@ -274,6 +301,73 @@ def _venv_env_dir(spec: dict, cache_dir: str,
 def _venv_site(root: str) -> str:
     ver = f"python{sys.version_info[0]}.{sys.version_info[1]}"
     return os.path.join(root, "lib", ver, "site-packages")
+
+
+def _uv_env_dir(spec: dict, cache_dir: str,
+                find_links_path: "str | None" = None) -> str:
+    """Build a content-hashed venv with the uv toolchain (reference:
+    _private/runtime_env/uv.py — uv venv + uv pip install per env
+    hash). Same lock/marker/atomic-rename recipe as _venv_env_dir;
+    offline by default (--no-index + find_links). Falls back to the
+    python -m venv + pip recipe when no uv binary is on PATH."""
+    import shutil
+    import subprocess
+
+    uv = shutil.which("uv")
+    if uv is None:
+        return _venv_env_dir(spec, cache_dir,
+                             find_links_path=find_links_path)
+    key = hashlib.sha256(
+        ("uv:" + repr(sorted(spec.items()))).encode()).hexdigest()[:24]
+    target = os.path.join(cache_dir, "uv_envs", key)
+    marker = target + ".ok"
+    if os.path.exists(marker):
+        return target
+    os.makedirs(os.path.dirname(target), exist_ok=True)
+    import fcntl
+
+    with open(target + ".lock", "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)
+        try:
+            if os.path.exists(marker):
+                return target
+            tmp = target + f".tmp{os.getpid()}"
+            shutil.rmtree(tmp, ignore_errors=True)
+            proc = subprocess.run(
+                [uv, "venv", "--system-site-packages",
+                 "--python", sys.executable, tmp],
+                capture_output=True, text=True, timeout=300)
+            if proc.returncode != 0:
+                shutil.rmtree(tmp, ignore_errors=True)
+                raise RuntimeError(
+                    f"uv venv creation failed: {proc.stderr[-1000:]}")
+            cmd = [uv, "pip", "install",
+                   "--python", os.path.join(tmp, "bin", "python")]
+            if spec.get("index_url"):
+                cmd += ["--index-url", spec["index_url"]]
+            else:
+                cmd += ["--no-index"]
+            if find_links_path or spec.get("find_links"):
+                cmd += ["--find-links",
+                        find_links_path or spec["find_links"]]
+            cmd += spec["packages"]
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=600)
+            if proc.returncode != 0:
+                shutil.rmtree(tmp, ignore_errors=True)
+                raise RuntimeError(
+                    f"runtime_env uv install failed "
+                    f"(rc={proc.returncode}): {proc.stderr[-2000:]}\n"
+                    f"(zero-egress default is --no-index: provide "
+                    f"'find_links' with local wheels, or an explicit "
+                    f"'index_url')")
+            shutil.rmtree(target, ignore_errors=True)
+            os.rename(tmp, target)
+            with open(marker, "w") as f:
+                f.write("ok")
+        finally:
+            fcntl.flock(lock, fcntl.LOCK_UN)
+    return target
 
 
 def _pip_env_dir(spec: dict, cache_dir: str,
@@ -386,15 +480,29 @@ class AppliedEnv:
                                      find_links_path=local)
             else:
                 root = _venv_env_dir(spec, cache_dir)
-            site = _venv_site(root)
-            sys.path.insert(0, site)
-            self._added_paths.append(site)
-            # Child processes the task spawns see the venv too.
-            for k, v in (("VIRTUAL_ENV", root),
-                         ("PATH", os.path.join(root, "bin") + os.pathsep
-                          + os.environ.get("PATH", ""))):
-                self._saved_env.setdefault(k, os.environ.get(k))
-                os.environ[k] = v
+            self._enter_venv(root)
+        uv_spec = runtime_env.get("uv")
+        if uv_spec:
+            spec = normalize_uv_spec(uv_spec)
+            fl = spec.get("find_links")
+            if fl and fl.startswith("pkg:"):
+                local = _materialize(fl, rt, cache_dir)
+                root = _uv_env_dir(spec, cache_dir,
+                                   find_links_path=local)
+            else:
+                root = _uv_env_dir(spec, cache_dir)
+            self._enter_venv(root)
+
+    def _enter_venv(self, root: str) -> None:
+        site = _venv_site(root)
+        sys.path.insert(0, site)
+        self._added_paths.append(site)
+        # Child processes the task spawns see the venv too.
+        for k, v in (("VIRTUAL_ENV", root),
+                     ("PATH", os.path.join(root, "bin") + os.pathsep
+                      + os.environ.get("PATH", ""))):
+            self._saved_env.setdefault(k, os.environ.get(k))
+            os.environ[k] = v
 
     def undo(self) -> None:
         # Path scoping is exact; MODULES a task imported stay cached in

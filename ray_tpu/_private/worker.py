@@ -110,6 +110,12 @@ class Worker:
         # (~20 us of executor machinery) is pure overhead when the head
         # pipelines a window of tasks onto this worker.
         self._task_q: deque = deque()
+        # Per-owner buffered seals (flood batching, _route_results).
+        # Guarded by _seal_lock: the drainer thread fills it, and the
+        # runtime's release loop drains stale batches (bounded latency
+        # when a long task follows a burst).
+        self._seal_buf: dict = {}
+        self._seal_lock = threading.Lock()
         self._drain_scheduled = False
         self._drain_lock = threading.Lock()
         self._drainer_tls = threading.local()
@@ -120,6 +126,10 @@ class Worker:
             message_handler=self._on_message,
         )
         worker_context.set_runtime(self.runtime)
+        # The runtime's adaptive release loop also drains stale seal
+        # batches (a burst buffered before a long task must not wait
+        # for the task to end).
+        self.runtime._aux_flush = self._flush_stale_seals
         self.runtime._pre_block = self._on_will_block
         # Driver/head gone -> exit (the connection is our lease).
         self.runtime.conn._on_close = lambda conn: os._exit(0)
@@ -364,20 +374,48 @@ class Worker:
                 for name, limit in groups.items()
             }
 
-    def _route_results(self, spec) -> "tuple[list, list | None]":
+    def _route_results(self, spec, buffer: bool = False
+                       ) -> "tuple[list, list | None]":
         """Owner-resident result routing shared by the sync drainer,
         the async-actor path, and the coroutine-failure fallback:
         deliver inline results + big-object markers straight to the
         submitting runtime (verified by owner id), returning what must
         still ride task_finished — (head_routed_results,
-        sealed_pending)."""
+        sealed_pending).
+
+        buffer=True (the drainer's flood path) coalesces many tasks'
+        seals into ONE seal_objects message per owner — the owner then
+        stores + confirms a whole batch in one dispatch. Safe to defer:
+        the head marks entries SEALED only on the owner's confirmation,
+        and a worker death with buffered seals error-seals the pending
+        ids (the sealed_pending backstop)."""
         results = getattr(spec, "_deferred_results", None) or []
         markers = getattr(spec, "_remote_markers", None) or []
         sealed_pending = None
         if (results or markers) and getattr(spec, "owner_addr", None):
-            if self.runtime.seal_to_owner(spec.owner_addr,
-                                          results + markers,
-                                          expect_owner=spec.owner_id):
+            addr = tuple(spec.owner_addr)
+            delivered = False
+            if buffer:
+                with self._seal_lock:
+                    buf = self._seal_buf.get(addr)
+                    if buf is None:
+                        buf = self._seal_buf[addr] = {
+                            "owner": spec.owner_id, "items": [],
+                            "t0": time.time()}
+                    if buf["owner"] == spec.owner_id:
+                        if not buf["items"]:
+                            buf["t0"] = time.time()
+                        buf["items"].extend(results + markers)
+                        delivered = True
+                flush = delivered and (
+                    len(buf["items"]) >= 64
+                    or time.time() - buf["t0"] > 0.05)
+                if flush:
+                    self._flush_seals(addr)
+            if not delivered:
+                delivered = self.runtime.seal_to_owner(
+                    addr, results + markers, expect_owner=spec.owner_id)
+            if delivered:
                 # contained_ids ride along so the head can pin container
                 # contents EAGERLY — this worker's del_ref for a
                 # returned-inside-a-container ref must not race the
@@ -389,6 +427,33 @@ class Worker:
                     for b in results]
                 results = []
         return results, sealed_pending
+
+    def _flush_stale_seals(self) -> None:
+        with self._seal_lock:
+            stale = [a for a, b in self._seal_buf.items()
+                     if b["items"] and time.time() - b["t0"] > 0.05]
+        for a in stale:
+            self._flush_seals(a)
+
+    def _flush_seals(self, addr=None) -> None:
+        """Ship buffered owner seals. On delivery failure the payloads
+        head-route via put_inline casts (entries seal there; the head's
+        marker push resolves the owner's local wait)."""
+        with self._seal_lock:
+            addrs = [addr] if addr is not None else list(self._seal_buf)
+            bufs = [(a, self._seal_buf.pop(a, None)) for a in addrs]
+        for a, buf in bufs:
+            if not buf or not buf["items"]:
+                continue
+            if not self.runtime.seal_to_owner(a, buf["items"],
+                                              expect_owner=buf["owner"]):
+                for item in buf["items"]:
+                    if item.get("remote"):
+                        continue  # already in the head/agent store
+                    try:
+                        self.runtime.conn.cast_buffered("put_inline", item)
+                    except Exception:
+                        pass
 
     def _async_task_crashed(self, spec: TaskSpec, exc: BaseException) -> None:
         """A coroutine failed outside its own error handling (before the
@@ -537,6 +602,12 @@ class Worker:
              (the head may have parked the awaited child HERE);
           2. the head is told to release this worker's allocation so
              the child can be placed when this was the last capacity."""
+        # Completed tasks' buffered owner seals must not wait out this
+        # block: whoever awaits those results gets them now.
+        try:
+            self._flush_seals()
+        except Exception:
+            pass
         if not getattr(self._drainer_tls, "active", False):
             return None
         # This thread RETIRES as the active drainer either way (it
@@ -631,7 +702,7 @@ class Worker:
                 # directory seals when the OWNER confirms receipt, so a
                 # lost seal can never strand a waiter). Falls back to
                 # head-routed payloads when the owner is unreachable.
-                results, sealed_pending = self._route_results(spec)
+                results, sealed_pending = self._route_results(spec, buffer=True)
                 # Completion + profile event in ONE cast (reference:
                 # core_worker/task_event_buffer.h:225 batches events for
                 # the same reason — the completion path is the control
@@ -664,6 +735,7 @@ class Worker:
                 # global ~1 ms flusher is only the backstop.
                 if (not self._task_q
                         and self._executor_for(spec)._work_queue.empty()):
+                    self._flush_seals()
                     self.runtime.conn.flush_casts()
             except Exception:
                 pass
@@ -710,6 +782,7 @@ class Worker:
                 or spec.runtime_env.get("py_modules")
                 or spec.runtime_env.get("pip")
                 or spec.runtime_env.get("conda")
+                or spec.runtime_env.get("uv")
             ):
                 from ray_tpu._private.runtime_env import AppliedEnv
 

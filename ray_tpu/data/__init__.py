@@ -5,6 +5,7 @@ builds a logical plan run by a streaming executor over the cluster;
 blocks are Arrow tables / numpy dicts). Batches come out as numpy or jax
 arrays shaped for an XLA step; `streaming_split` feeds JaxTrainer workers."""
 
+from ray_tpu.data.actor_pool import ActorPoolStrategy
 from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata
 from ray_tpu.data.datasource import Datasource, ReadTask
 from ray_tpu.data.dataset import (
@@ -40,6 +41,7 @@ from ray_tpu.data.dataset import (
 )
 
 __all__ = [
+    "ActorPoolStrategy",
     "Block",
     "BlockAccessor",
     "BlockMetadata",

@@ -90,6 +90,7 @@ class Dataset:
         batch_format: str = "numpy",
         fn_constructor_args: tuple = (),
         zero_copy_batch: bool = False,
+        compute: Any = None,
     ) -> "Dataset":
         if isinstance(fn, type):
             ctor = fn
@@ -97,8 +98,13 @@ class Dataset:
             return self._append(
                 MapBatches(None, batch_size, batch_format,
                            lambda: ctor(*args),
-                           zero_copy_batch=zero_copy_batch)
+                           zero_copy_batch=zero_copy_batch,
+                           compute=compute)
             )
+        if compute is not None:
+            raise ValueError(
+                "compute='actors' requires a CLASS UDF (the pool exists "
+                "to amortize expensive per-worker setup)")
         return self._append(MapBatches(fn, batch_size, batch_format,
                                        zero_copy_batch=zero_copy_batch))
 

@@ -49,6 +49,10 @@ class MapBatches(LogicalOp):
     batch_size: int | None = None
     batch_format: str = "numpy"
     fn_constructor: Callable | None = None  # class-based UDF (actor-ish)
+    # "actors" / ActorPoolStrategy: run this stage on a managed actor
+    # pool (ray_tpu.data.actor_pool — the reference's
+    # ActorPoolMapOperator). None = stateless tasks/threads.
+    compute: Any = None
     # Zero-copy batches (reference: map_batches(zero_copy_batch=True)):
     # a batch that is one contiguous run of a source block is passed as
     # a SLICE (arrow slice / numpy view) instead of a copy. The UDF must
@@ -133,7 +137,16 @@ def _apply_op(op, blocks: Iterator[Block]) -> Iterator[Block]:
     if isinstance(op, MapBatches):
         fn = op.fn
         if op.fn_constructor is not None:
-            inst = op.fn_constructor()
+            if op.compute is not None:
+                # compute='actors' inline fallback: amortize the
+                # constructor across blocks (instance shared by the
+                # local thread pool — actors give true isolation).
+                inst = getattr(op, "_cached_inst", None)
+                if inst is None:
+                    inst = op.fn_constructor()
+                    op._cached_inst = inst
+            else:
+                inst = op.fn_constructor()
             fn = inst.__call__ if callable(inst) else inst
         for block in _rebatch(blocks, op.batch_size,
                               zero_copy=op.zero_copy_batch):
@@ -331,11 +344,59 @@ def execute_plan(plan: list, ctx) -> Iterator[Block]:
             # Fuse the longest run of fusable ops after the source.
             j = i + 1
             fused = []
+            seen_pool = False
             while j < len(plan) and isinstance(plan[j], FUSABLE):
-                fused.append(plan[j])
+                nxt = plan[j]
+                if isinstance(nxt, MapBatches) and nxt.compute is not None:
+                    if seen_pool:
+                        # A second pool stage keeps its OWN strategy:
+                        # stop fusing so each pool honors its
+                        # size/resource request.
+                        break
+                    seen_pool = True
+                fused.append(nxt)
                 j += 1
             inputs = op.tasks if isinstance(op, Read) else op.blocks
             use_tasks = ctx.use_tasks and _cluster_up()
+
+            pool_op = next(
+                (f for f in fused
+                 if isinstance(f, MapBatches) and f.compute is not None),
+                None)
+            if pool_op is not None and use_tasks:
+                # Actor-pool stage (reference:
+                # actor_pool_map_operator.py): class UDFs build once per
+                # pool worker; blocks stream through the pool with
+                # backlog-driven scale-up and restart-on-death. The pool
+                # is constructed INSIDE the generator: an abandoned or
+                # failing plan must not leak live actors.
+                from ray_tpu.data.actor_pool import (ActorPool,
+                                                     resolve_strategy)
+
+                strategy = resolve_strategy(pool_op.compute)
+
+                def gen_pool(inputs=inputs, strategy=strategy,
+                             _fused=tuple(fused)):
+                    pool = ActorPool(strategy, _fused, ctx.parallelism)
+                    try:
+                        for out in pool.map(list(inputs)):
+                            yield from out
+                    finally:
+                        pool.shutdown()
+                        if getattr(ctx, "stats", None) is not None:
+                            ctx.stats["actor_pool"] = pool.stats
+
+                stream = gen_pool()
+                i = j
+                continue
+            if pool_op is not None:
+                import warnings
+
+                warnings.warn(
+                    "map_batches(compute='actors') without an initialized "
+                    "cluster runs inline; the class UDF is cached per "
+                    "stage (shared across the local thread pool)",
+                    stacklevel=2)
 
             def run(src, _fused=tuple(fused)):
                 return run_fused_stage(src, list(_fused))

@@ -1,0 +1,91 @@
+"""Actor-pool map stages (reference: data/_internal/execution/operators/
+actor_pool_map_operator.py — pool lifecycle: min/max size, backlog
+scale-up, idle scale-down, restart-on-death)."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rdata
+
+
+@pytest.fixture
+def cluster():
+    ray_tpu.init(num_cpus=4, object_store_memory=96 * 1024 * 1024)
+    yield
+    ray_tpu.shutdown()
+
+
+class AddBias:
+    """Class UDF with observable construction cost/count."""
+
+    def __init__(self, bias=100):
+        self.bias = bias
+        self.pid = os.getpid()
+
+    def __call__(self, batch):
+        return {"id": batch["id"] + self.bias,
+                "pid": np.full_like(batch["id"], self.pid)}
+
+
+def test_pool_runs_class_udf(cluster):
+    ds = rdata.range(200).map_batches(
+        AddBias, batch_size=20, compute="actors")
+    vals = sorted(r["id"] for r in ds.take_all())
+    assert vals == [i + 100 for i in range(200)]
+
+
+def test_constructor_amortized_across_blocks(cluster):
+    """One pool worker handles many blocks through ONE instance: the
+    reported pids collapse to at most pool-size distinct values."""
+    ds = rdata.range(400).map_batches(
+        AddBias, batch_size=10,
+        compute=rdata.ActorPoolStrategy(min_size=1, max_size=2))
+    pids = {int(r["pid"]) for r in ds.take_all()}
+    assert 1 <= len(pids) <= 2  # 40 blocks, <= 2 workers
+
+
+def test_pool_scales_up_under_backlog(cluster):
+    ctx = rdata.DataContext.get_current()
+    ds = rdata.range(300).map_batches(
+        AddBias, batch_size=10,
+        compute=rdata.ActorPoolStrategy(min_size=1, max_size=3))
+    out = ds.take_all()
+    assert len(out) == 300
+    stats = (ctx.stats or {}).get("actor_pool")
+    assert stats and stats["spawned"] >= 1
+    assert stats["peak_size"] <= 3
+
+
+class CrashOnce:
+    """Dies on the first block of a fresh process (pool must replace
+    the worker and replay the block)."""
+
+    MARK = "/tmp/ray_tpu_pool_crash_once"
+
+    def __call__(self, batch):
+        if not os.path.exists(self.MARK):
+            with open(self.MARK, "w") as f:
+                f.write("x")
+            os._exit(1)
+        return {"id": batch["id"] * 2}
+
+
+def test_restart_on_death_replays_block(cluster):
+    if os.path.exists(CrashOnce.MARK):
+        os.remove(CrashOnce.MARK)
+    ds = rdata.range(60).map_batches(
+        CrashOnce, batch_size=10,
+        compute=rdata.ActorPoolStrategy(min_size=1, max_size=1,
+                                        max_restarts=2))
+    vals = sorted(r["id"] for r in ds.take_all())
+    assert vals == [i * 2 for i in range(60)]
+    os.remove(CrashOnce.MARK)
+
+
+def test_plain_fn_with_compute_rejected(cluster):
+    with pytest.raises(ValueError, match="CLASS UDF"):
+        rdata.range(10).map_batches(lambda b: b, compute="actors")

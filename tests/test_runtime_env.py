@@ -272,9 +272,9 @@ def test_init_runtime_env_failure_cleans_up():
 
     if ray_tpu.is_initialized():
         ray_tpu.shutdown()
-    with pytest.raises(ValueError, match="uv"):
+    with pytest.raises(ValueError, match="container"):
         ray_tpu.init(num_cpus=1, object_store_memory=32 * 1024 * 1024,
-                     runtime_env={"uv": ["requests"]})
+                     runtime_env={"container": {"image": "x"}})
     assert not ray_tpu.is_initialized()
     # A corrected retry works.
     ray_tpu.init(num_cpus=1, object_store_memory=32 * 1024 * 1024)
@@ -315,3 +315,34 @@ def test_actor_method_nested_inheritance():
         ray_tpu.kill(a)
     finally:
         ray_tpu.shutdown()
+
+
+def test_uv_env_from_local_wheels(tmp_path):
+    """runtime_env['uv'] (reference: _private/runtime_env/uv.py): a
+    content-hashed venv built with the uv toolchain, resolving OFFLINE
+    from a local wheel dir shipped through the cluster KV."""
+    wheels = tmp_path / "wheels"
+    wheels.mkdir()
+    _make_wheel(wheels)
+
+    @ray_tpu.remote(runtime_env={"uv": {"packages": ["testpkg-rt"],
+                                        "find_links": str(wheels)}})
+    def use():
+        import testpkg_rt
+
+        return testpkg_rt.VALUE, os.environ.get("VIRTUAL_ENV", "")
+
+    value, venv = ray_tpu.get(use.remote(), timeout=180)
+    assert value == 2026
+    assert "uv_envs" in venv or "venvs" in venv  # uv path (or fallback)
+    # Cached env dir reused on the second call.
+    assert ray_tpu.get(use.remote(), timeout=60)[0] == 2026
+
+
+def test_uv_bad_spec_rejected():
+    with pytest.raises(Exception, match="uv"):
+        @ray_tpu.remote(runtime_env={"uv": {"bogus_key": True}})
+        def f():
+            return 1
+
+        ray_tpu.get(f.remote(), timeout=60)
