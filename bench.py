@@ -73,12 +73,14 @@ def run_bench() -> None:
         # the optax chain plus a separate grad-norm metric cost ~35ms
         # of HBM passes per ~290ms step).
         batch, seq, steps = 24, 1024, 10
-        # ce_impl pinned to the TPU-measured config (90.9k tok/s/chip);
-        # the fused-CE path is CPU-validated but a TPU A/B is pending —
-        # flip once benchmarks/gpt2_sweep.py confirms it on hardware.
+        # Pinned to the round-5 hardware A/B winner (ab_results.jsonl):
+        # fused chunked-CE backward (+5.6% over checkpoint — one head
+        # matmul per chunk instead of two) with the accuracy argmax off
+        # (+2.8% — throughput benches don't pay for metrics): 98.7k
+        # tok/s/chip vs 90.9k for the round-2 checkpoint config.
         cfg = models.gpt2_small(max_seq_len=seq, remat=False,
                                 scan_layers=False, loss_chunk=4096,
-                                ce_impl="checkpoint")
+                                ce_impl="fused", ce_accuracy=False)
     else:
         # CPU smoke mode: tiny model so the bench completes anywhere.
         batch, seq, steps = 4, 128, 3

@@ -48,6 +48,13 @@ QUEUE = [
     # 8. CE chunk size sensitivity under fused
     dict(ce_impl="fused", loss_chunk=8192),
     dict(ce_impl="fused", loss_chunk=2048),
+    # 9. combined winner sweeps (round 5: fused+no-argmax hit 98.7k;
+    # stack the chunk-size and batch axes on top of it)
+    dict(ce_impl="fused", ce_accuracy=False, loss_chunk=8192),
+    dict(ce_impl="fused", ce_accuracy=False, loss_chunk=2048),
+    dict(batch=32, ce_impl="fused", ce_accuracy=False),
+    dict(batch=28, ce_impl="fused", ce_accuracy=False),
+    dict(batch=20, ce_impl="fused", ce_accuracy=False),
 ]
 
 

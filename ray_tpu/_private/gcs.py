@@ -417,6 +417,15 @@ class Head:
         )
         self._dispatcher.start()
 
+        # Resource-view syncer (reference: ray_syncer.h:83): replicate
+        # version-stamped node resource views to every agent so state
+        # reads and spillback pre-filtering never funnel through the
+        # head's call path.
+        from ray_tpu._private.resource_syncer import ViewPublisher
+
+        self._view_publisher = ViewPublisher(self)
+        self._view_publisher.start()
+
         # Warm pool (reference: WorkerPool pre-starting idle language
         # workers, raylet/worker_pool.h:224): first tasks skip the
         # process-spawn + import latency. Opt-in via
@@ -1531,6 +1540,14 @@ class Head:
     def _h_subscribe(self, body, conn):
         with self.lock:
             self._subscribers.setdefault(body["topic"], []).append(conn)
+        # Fresh resource-view subscribers get a full snapshot at once
+        # (reference: per-connection snapshot on sync startup) instead
+        # of waiting out the anti-entropy period.
+        from ray_tpu._private import resource_syncer
+
+        if (body["topic"] == resource_syncer.TOPIC
+                and getattr(self, "_view_publisher", None) is not None):
+            self._view_publisher.broadcast_snapshot()
         return {}
 
     def _h_publish(self, body, conn):
@@ -2261,11 +2278,26 @@ class Head:
 
     def _h_list_tasks(self, body, conn):
         state = body.get("state")
+        task_id = body.get("task_id")
+        worker_id = body.get("worker_id")
         with self.lock:
-            if state is not None:
-                # Server-side state filter: hot pollers (autoscaler) must
-                # not ship the whole task table per tick.
-                recs = [t for t in self.tasks.values() if t["state"] == state]
+            if task_id is not None:
+                # Point lookup (dashboard drill-down): never ship the
+                # table to select one row. Remaining pushed-down
+                # filters still apply — the client stripped them.
+                t = self.tasks.get(task_id)
+                recs = [t] if t is not None and (
+                    (state is None or t["state"] == state)
+                    and (worker_id is None
+                         or t.get("worker_id") == worker_id)) else []
+            elif state is not None or worker_id is not None:
+                # Server-side filters: hot pollers (autoscaler) and the
+                # per-actor task view must not ship the whole task
+                # table per request.
+                recs = [t for t in self.tasks.values()
+                        if (state is None or t["state"] == state)
+                        and (worker_id is None
+                             or t.get("worker_id") == worker_id)]
             else:
                 recs = list(self.tasks.values())
         limit = body.get("limit", 1000)
@@ -2280,6 +2312,7 @@ class Head:
                         "name": a.spec.name,
                         "state": a.state,
                         "node_id": a.node_id,
+                        "worker_id": a.worker_id,
                         "pid": self.workers[a.worker_id].pid if a.worker_id in self.workers else None,
                         "restarts": a.restarts,
                         "class_name": a.spec.name or a.spec.cls_func_id,
@@ -2370,8 +2403,13 @@ class Head:
             return {"metrics": dict(self.metrics)}
 
     def _h_get_task_events(self, body, conn):
+        task_ids = body.get("task_ids")
         with self.lock:
-            return {"events": list(self.task_events)[-body.get("limit", 10000):]}
+            events = list(self.task_events)
+        if task_ids is not None:
+            wanted = set(task_ids)
+            events = [e for e in events if e.get("task_id") in wanted]
+        return {"events": events[-body.get("limit", 10000):]}
 
     # ------------------------------------------------------------------
     # dispatch loop (the raylet role)
@@ -3167,6 +3205,9 @@ class Head:
 
     def shutdown(self) -> None:
         self._shutdown = True
+        vp = getattr(self, "_view_publisher", None)
+        if vp is not None:
+            vp.stop()
         try:
             self.bulk_server.stop()
         except Exception:

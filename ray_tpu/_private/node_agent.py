@@ -82,6 +82,14 @@ class NodeAgent:
         self._exit = threading.Event()
         self._labels = labels or {}
         self._resources = self._detect_resources(num_cpus, num_tpus, resources)
+        # Synced cluster resource view (reference: ray_syncer.h:83 —
+        # each raylet holds everyone's versioned resource view). Built
+        # before any server starts so cluster_view queries never race
+        # construction; populated once registration subscribes.
+        from ray_tpu._private.resource_syncer import TOPIC, ClusterView
+
+        self.cluster_view = ClusterView()
+        self._view_topic = TOPIC
         # --- node-local object store + P2P transfer server (reference:
         # per-node plasma store + chunked push/pull, push_manager.h:32 /
         # pull_manager.h:57). Large objects created on this node live in
@@ -129,6 +137,14 @@ class NodeAgent:
         )
         self.node_id = reply["node_id"]
         self.session_dir = reply["session_dir"]
+        # Subscribe to the resource-view sync stream: triggers an
+        # immediate full snapshot from the head; deltas stream in as
+        # pubsub casts handled in _handle.
+        try:
+            self.conn.call("subscribe", {"topic": self._view_topic},
+                           timeout=10)
+        except rpc.RpcError:
+            pass  # older head without the syncer; view stays empty
         # OOM protection for THIS node: the agent watches local memory and
         # reports pressure; the head (which owns the worker/task tables and
         # the retriable-first policy) picks and kills a victim scoped to
@@ -202,6 +218,11 @@ class NodeAgent:
                         except Exception:
                             pass
                     self.local_objects.clear()
+                try:
+                    conn.call("subscribe", {"topic": self._view_topic},
+                              timeout=10)
+                except rpc.RpcError:
+                    pass
                 print(f"node agent {self.node_id}: re-registered with "
                       f"restarted head", flush=True)
                 return
@@ -278,6 +299,9 @@ class NodeAgent:
                     loc = self.local_objects.pop(oid, None)
                     if loc is not None:
                         self.store.free(loc[0])
+        elif kind == "pubsub_message":
+            if body.get("topic") == self._view_topic:
+                self.cluster_view.apply(body.get("data") or {})
         elif kind == "shutdown_node":
             self._exit.set()
         return None
@@ -314,6 +338,14 @@ class NodeAgent:
         """Store-plane RPCs: local workers allocate/seal; remote nodes
         pull chunks (reference: ObjectManager push/pull protocol,
         push_manager.h:32 — here pull-based: the consumer drives)."""
+        if kind == "cluster_view":
+            # Head-free cluster state read served from the synced view
+            # (reference: each raylet answers resource queries from its
+            # ray_syncer-replicated view, not by asking the GCS).
+            out = self.cluster_view.to_dict()
+            out["totals"] = self.cluster_view.totals()
+            out["node_id"] = self.node_id
+            return out
         if kind == "alloc":
             with self._store_lock:
                 offset = self.store.alloc(body["size"])

@@ -159,6 +159,48 @@ def _node_detail(node_id: str) -> "dict | None":
             "tasks": tasks[-200:]}
 
 
+def _task_detail(task_id: str) -> "dict | None":
+    """One task's page: record + profile events + owning worker's log
+    tail (reference: dashboard task detail view — dashboard/modules/job
+    task drill-down over state + events + logs)."""
+    from ray_tpu.util import state as us
+
+    task = us.get_task(task_id)
+    if task is None:
+        return None
+    events = us.get_task_events(task_ids=[task_id])
+    log: dict = {}
+    wid = task.get("worker_id")
+    if wid:
+        log = _log_tail(str(wid), max_bytes=16 * 1024)
+        log["lines"] = log.get("lines", [])[-100:]
+    return {"task": task, "events": events, "worker_log": log}
+
+
+def _actor_detail(actor_id: str) -> "dict | None":
+    """One actor's page: record + its tasks + events + worker log tail
+    (reference: dashboard/modules/actor — actor detail view)."""
+    from ray_tpu.util import state as us
+
+    actor = us.get_actor(actor_id)
+    if actor is None:
+        return None
+    wid = actor.get("worker_id")
+    # The head returns the LAST `limit` matching rows — exactly the
+    # window the page shows, so a long-lived actor's full task history
+    # never ships per poll.
+    tasks = us.list_tasks(filters=[("worker_id", "=", wid)],
+                          limit=200) if wid else []
+    events = us.get_task_events(task_ids=[t["task_id"] for t in tasks],
+                                limit=500)
+    log: dict = {}
+    if wid:
+        log = _log_tail(str(wid), max_bytes=16 * 1024)
+        log["lines"] = log.get("lines", [])[-100:]
+    return {"actor": actor, "tasks": tasks, "events": events,
+            "worker_log": log}
+
+
 class DashboardServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         self._host = host
@@ -220,6 +262,10 @@ class DashboardServer:
             return {"runs": _train_runs()}
         if path.startswith("/api/nodes/"):
             return _node_detail(path[len("/api/nodes/"):])
+        if path.startswith("/api/tasks/"):
+            return _task_detail(path[len("/api/tasks/"):])
+        if path.startswith("/api/actors/"):
+            return _actor_detail(path[len("/api/actors/"):])
         if path.startswith("/api/profile/"):
             # Live stack dump of a worker (reference:
             # dashboard/modules/reporter/profile_manager.py:191 — py-spy

@@ -99,10 +99,11 @@ class TransformerConfig:
     # head matmul, ~10% of GPT-2 124M's step FLOPs). Both are O(T)
     # memory; eval (no grad) never pays the fused path's extra work
     # because custom_vjp only runs it under differentiation.
-    ce_impl: str = "checkpoint"      # "fused" | "checkpoint"
-    # Default stays "checkpoint" (the TPU-measured config) until the
-    # hardware A/B (benchmarks/tpu_ab_queue.py) confirms the fused
-    # chunked-CE backward on the real chip; flip here + bench.py together.
+    ce_impl: str = "fused"           # "fused" | "checkpoint"
+    # Default is "fused": confirmed on hardware (v5e A/B, round 5 —
+    # benchmarks/ab_results.jsonl): 96.0k tok/s/chip vs 90.9k for
+    # "checkpoint" on GPT-2 124M @ T=1024 (the saved head-matmul
+    # recompute is ~10% of step FLOPs).
     # Mixture of Experts (llama arch only; 0 = dense FFN). Greenfield vs
     # the reference (SURVEY.md §2.4: EP absent upstream) — see ops/moe.py.
     n_experts: int = 0

@@ -44,11 +44,12 @@ def list_tasks(*, filters=None, limit: int = 1000) -> list[dict]:
     # rows aren't silently missed.
     filters = list(filters or [])
     body: dict = {}
-    for f in filters:
-        if f[0] == "state" and f[1] == "=":
-            body["state"] = f[2]
+    for f in list(filters):
+        # Equality filters on indexed/point keys push down to the head
+        # (hot path for autoscaler/dashboard polls and drill-downs).
+        if f[1] == "=" and f[0] in ("state", "task_id", "worker_id"):
+            body[f[0]] = f[2]
             filters.remove(f)
-            break
     # Only filters that remain CLIENT-side force a full-table fetch.
     body["limit"] = limit if not filters else 1_000_000
     rows = _call("list_tasks", body)["tasks"]
@@ -90,6 +91,19 @@ def list_jobs(*, filters=None, limit: int = 1000) -> list[dict]:
     return _filtered(rows, filters)[:limit]
 
 
+def get_task(task_id: str) -> "dict | None":
+    """One task's record (reference: util/state/api.py get_task).
+    Point lookup pushed down to the head — never ships the table."""
+    rows = _call("list_tasks", {"task_id": task_id, "limit": 1})["tasks"]
+    return dict(rows[0]) if rows else None
+
+
+def get_actor(actor_id: str) -> "dict | None":
+    """One actor's record (reference: util/state/api.py get_actor)."""
+    rows = _call("list_actors")["actors"]
+    return next((dict(r) for r in rows if r.get("actor_id") == actor_id), None)
+
+
 def summarize_tasks() -> dict:
     """Counts by (name, state) — reference: util/state/api.py:1368."""
     by_name: dict[str, Counter] = {}
@@ -125,8 +139,12 @@ def object_store_stats() -> dict:
     return _call("store_stats")
 
 
-def get_task_events(limit: int = 10000) -> list[dict]:
-    return _call("get_task_events", {"limit": limit})["events"]
+def get_task_events(limit: int = 10000,
+                    task_ids: "list[str] | None" = None) -> list[dict]:
+    body: dict = {"limit": limit}
+    if task_ids is not None:
+        body["task_ids"] = list(task_ids)
+    return _call("get_task_events", body)["events"]
 
 
 def timeline(filename: str | None = None) -> "list | str":

@@ -1,0 +1,230 @@
+"""Resource-view syncer: versioned head→agent replication of the
+cluster resource view (reference: src/ray/common/ray_syncer/
+ray_syncer.h:83 — RESOURCE_VIEW sync between raylets and the GCS;
+version-stamped deltas + snapshot anti-entropy; each node answers
+resource queries from its replicated view)."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import rpc
+from ray_tpu._private.resource_syncer import ClusterView, ViewPublisher
+from ray_tpu._private.worker_context import get_head
+
+
+def _start_agent(address: str, *, resources: str, node_id: str):
+    cmd = [
+        sys.executable, "-m", "ray_tpu._private.node_agent",
+        "--address", address, "--num-cpus", "2",
+        "--resources", resources, "--node-id", node_id,
+    ]
+    env = dict(os.environ)
+    env.pop("RAY_TPU_REMOTE", None)
+    return subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT)
+
+
+def _wait_nodes(n: int, timeout: float = 20.0) -> None:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if len([x for x in ray_tpu.nodes() if x["alive"]]) >= n:
+            return
+        time.sleep(0.2)
+    raise TimeoutError(f"never reached {n} nodes: {ray_tpu.nodes()}")
+
+
+def _agent_view(node_id: str) -> dict:
+    """Query the agent's public server directly — the head-free path."""
+    head = get_head()
+    with head.lock:
+        addr = head.node_transfer_addrs[node_id]
+    conn = rpc.connect(tuple(addr))
+    try:
+        return conn.call("cluster_view", {}, timeout=10)
+    finally:
+        conn.close()
+
+
+@pytest.fixture()
+def cluster_3n():
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    # Fast sync ticks so convergence assertions don't wait out defaults.
+    os.environ["RAY_TPU_RESOURCE_SYNC_PERIOD_S"] = "0.1"
+    ray_tpu.init(num_cpus=2, object_store_memory=64 * 1024 * 1024)
+    head = get_head()
+    address = f"{head.address[0]}:{head.address[1]}"
+    agents = [
+        _start_agent(address, resources='{"side": 2}', node_id="sync-a"),
+        _start_agent(address, resources='{"side": 2}', node_id="sync-b"),
+    ]
+    try:
+        _wait_nodes(3)
+        yield agents
+    finally:
+        for a in agents:
+            if a.poll() is None:
+                a.kill()
+                a.wait(timeout=10)
+        ray_tpu.shutdown()
+        os.environ.pop("RAY_TPU_RESOURCE_SYNC_PERIOD_S", None)
+
+
+def _converged(node_id: str, want_nodes: int,
+               timeout: float = 10.0) -> dict:
+    deadline = time.monotonic() + timeout
+    last = {}
+    while time.monotonic() < deadline:
+        last = _agent_view(node_id)
+        alive = [n for n in last["nodes"].values() if n["alive"]]
+        if len(alive) >= want_nodes:
+            return last
+        time.sleep(0.1)
+    raise AssertionError(f"view never converged on {node_id}: {last}")
+
+
+def test_view_replicates_to_all_agents(cluster_3n):
+    """Every agent's synced view carries every node, and aggregate
+    totals match the head's cluster_resources()."""
+    for nid in ("sync-a", "sync-b"):
+        view = _converged(nid, 3)
+        assert set(view["nodes"]) == {n["node_id"]
+                                      for n in ray_tpu.nodes()}
+        assert view["totals"]["total"]["CPU"] == \
+            ray_tpu.cluster_resources()["CPU"]
+        assert view["totals"]["total"]["side"] == 4.0
+        # Versions are stamped on every entry.
+        assert all(n["version"] >= 1 for n in view["nodes"].values())
+
+
+def test_view_tracks_grants_and_versions_bump(cluster_3n):
+    """Scheduling load on a node shows up in every OTHER node's view
+    (availability drop), with that node's version bumped."""
+    view0 = _converged("sync-b", 3)
+    v0 = view0["nodes"]["sync-a"]["version"]
+
+    @ray_tpu.remote(resources={"side": 1}, num_cpus=0)
+    class Holder:
+        def node(self):
+            return ray_tpu.get_runtime_context().get_node_id()
+
+        def hold(self):
+            return True
+
+    # Two holders pin side=1 each; at least one lands on sync-a.
+    holders = [Holder.remote() for _ in range(2)]
+    nodes = ray_tpu.get([h.node.remote() for h in holders], timeout=60)
+    assert set(nodes) == {"sync-a", "sync-b"}
+
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        view = _agent_view("sync-b")
+        a = view["nodes"].get("sync-a", {})
+        if a.get("available", {}).get("side") == 1.0:
+            break
+        time.sleep(0.1)
+    else:
+        raise AssertionError(f"grant never synced: {_agent_view('sync-b')}")
+    assert a["version"] > v0
+    for h in holders:
+        ray_tpu.kill(h)
+
+
+def test_view_sees_node_death(cluster_3n):
+    """Killing an agent flips it dead (or removes it) in peers' views."""
+    _converged("sync-b", 3)
+    agent_a = cluster_3n[0]  # sync-a's process
+    agent_a.kill()
+    agent_a.wait(timeout=10)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        view = _agent_view("sync-b")
+        a = view["nodes"].get("sync-a")
+        if a is None or not a["alive"]:
+            return
+        time.sleep(0.2)
+    raise AssertionError(f"death never synced: {_agent_view('sync-b')}")
+
+
+def test_publisher_delta_coalescing():
+    """Unit: quiet ticks publish nothing; changes publish only the
+    changed nodes; snapshots carry everything; stale seqs are ignored."""
+
+    class _Node:
+        def __init__(self, nid, avail):
+            import types
+
+            self.node_id = nid
+            self.address = "h"
+            self.alive = True
+            self.labels = {}
+            self.total = types.SimpleNamespace(to_dict=lambda: {"CPU": 4.0})
+            self.available = types.SimpleNamespace(
+                to_dict=lambda a=avail: dict(a))
+
+    class _Head:
+        def __init__(self):
+            import threading
+            import types
+
+            self.lock = threading.Lock()
+            self._subscribers = {}
+            self.scheduler = types.SimpleNamespace(nodes={})
+
+    head = _Head()
+    avail_a = {"CPU": 4.0}
+    head.scheduler.nodes["a"] = _Node("a", avail_a)
+    head.scheduler.nodes["b"] = _Node("b", {"CPU": 4.0})
+    pub = ViewPublisher(head, period_s=3600)  # manual ticks only
+
+    snap = pub.collect(snapshot=True)
+    assert snap["snapshot"] and len(snap["deltas"]) == 2
+
+    # Quiet tick: nothing to say.
+    assert pub.collect(snapshot=False) is None
+
+    # One node changes: only it appears in the delta.
+    avail_a["CPU"] = 2.0
+    d = pub.collect(snapshot=False)
+    assert [x["node_id"] for x in d["deltas"]] == ["a"]
+    assert d["deltas"][0]["version"] == 2
+
+    # Node removal surfaces in `removed`.
+    del head.scheduler.nodes["b"]
+    d2 = pub.collect(snapshot=False)
+    assert d2["removed"] == ["b"]
+
+    # Receiver: applies in order, ignores stale seq replays.
+    view = ClusterView()
+    view.apply(snap)
+    assert set(view.nodes) == {"a", "b"}
+    view.apply(d)
+    assert view.nodes["a"]["available"]["CPU"] == 2.0
+    view.apply(d2)
+    assert "b" not in view.nodes
+    stale = dict(d, seq=d["seq"] - 5,
+                 deltas=[dict(d["deltas"][0], available={"CPU": 9.0},
+                              version=1)])
+    view.apply(stale)
+    assert view.nodes["a"]["available"]["CPU"] == 2.0
+    assert view.totals()["available"]["CPU"] == 2.0
+
+    # Head restart: a NEW publisher incarnation restarts seq at 1. Its
+    # deltas must not be discarded as stale — but only its snapshot may
+    # switch the epoch (deltas against an unseen base are dropped).
+    pub2 = ViewPublisher(head, period_s=3600)
+    assert pub2.pub_id != pub.pub_id
+    d_new = pub2.collect(snapshot=False)   # all nodes "changed" to pub2
+    view.apply(d_new)
+    assert view.last_pub != pub2.pub_id    # delta alone can't switch
+    snap2 = pub2.collect(snapshot=True)
+    view.apply(snap2)
+    assert view.last_pub == pub2.pub_id and view.last_seq == snap2["seq"]
+    assert set(view.nodes) == {"a"}
