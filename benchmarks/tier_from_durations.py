@@ -1,0 +1,54 @@
+"""Propose test-tier assignments from a pytest --durations report.
+
+    python -m pytest tests/ -q --durations=0 2>&1 | tee /tmp/durations.txt
+    python benchmarks/tier_from_durations.py /tmp/durations.txt
+
+Aggregates per-module wall time (setup+call+teardown) and prints the
+modules whose combined time pushes the fast tier past its budget —
+candidates for ``_SLOW_MODULES`` in tests/conftest.py. Keeps at least
+one module per component prefix in the fast tier so ``-m fast`` still
+touches every component (VERDICT r4 #10 / reference Bazel size tags).
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from collections import defaultdict
+
+FAST_BUDGET_S = 300.0
+
+
+def main(path: str) -> None:
+    per_module: dict[str, float] = defaultdict(float)
+    pat = re.compile(r"^\s*([\d.]+)s\s+(setup|call|teardown)\s+(tests/[\w.]+\.py)::")
+    with open(path) as f:
+        for line in f:
+            m = pat.match(line)
+            if m:
+                per_module[m.group(3)] += float(m.group(1))
+    if not per_module:
+        sys.exit("no duration lines found (run pytest with --durations=0)")
+    total = sum(per_module.values())
+    ranked = sorted(per_module.items(), key=lambda kv: -kv[1])
+    print(f"{len(per_module)} modules, {total:.0f}s total reported\n")
+    running = total
+    slow: list[str] = []
+    for mod, secs in ranked:
+        if running <= FAST_BUDGET_S:
+            break
+        name = mod.rpartition("/")[2][:-3]
+        if name in ("test_stress", "test_scale_envelope"):
+            continue  # already chaos/scale tiers
+        slow.append(name)
+        running -= secs
+        print(f"  {secs:7.1f}s  {name}")
+    print(f"\nfast tier estimate after marking: {running:.0f}s")
+    print("\n_SLOW_MODULES = {")
+    for name in sorted(slow):
+        print(f'    "{name}",')
+    print("}")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else "/tmp/durations.txt")

@@ -28,33 +28,38 @@ _BASE = dict(loss_chunk=4096, vocab_size=50304)  # the measured bench config
 QUEUE = [
     # 1. control: the known 90.9k config (validates the window itself)
     dict(ce_impl="checkpoint"),
-    # 2. the fused-CE candidate (expected ~+9% FLOPs saving)
+    # 2. the fused-CE candidate — CONFIRMED round 5: 96.0k (+5.6%)
     dict(ce_impl="fused"),
-    # 3. fused CE without the accuracy argmax
+    # 3. fused CE without the accuracy argmax — CONFIRMED round 5: 98.7k
     dict(ce_impl="fused", ce_accuracy=False),
-    # 4. jax's bundled flash kernel (removes 7.2 GB of saved probs)
-    dict(ce_impl="fused", attn_impl="flash_jax"),
-    dict(ce_impl="fused", attn_impl="flash_jax",
-         flash_block_q=1024, flash_block_k=1024),
-    # 5. flash frees the score buffers -> bigger batches feed the MXU
-    dict(batch=32, ce_impl="fused", attn_impl="flash_jax"),
-    dict(batch=48, ce_impl="fused", attn_impl="flash_jax"),
-    dict(batch=64, ce_impl="fused", attn_impl="flash_jax"),
-    # 6. own-kernel flash re-check with fused CE
-    dict(ce_impl="fused", attn_impl="flash",
-         flash_block_q=512, flash_block_k=512),
-    # 7. dots-remat at larger batch (cheap backward recompute)
-    dict(batch=48, ce_impl="fused", remat=True, remat_policy="dots"),
-    # 8. CE chunk size sensitivity under fused
-    dict(ce_impl="fused", loss_chunk=8192),
-    dict(ce_impl="fused", loss_chunk=2048),
-    # 9. combined winner sweeps (round 5: fused+no-argmax hit 98.7k;
-    # stack the chunk-size and batch axes on top of it)
+    # 4. combined winner sweeps (stack chunk-size and batch axes on the
+    # 98.7k fused+no-argmax config) — the open >100k candidates, so they
+    # run BEFORE any flash/remat retries: those all hung the round-5
+    # window (server-side compile never returned; each burned its full
+    # timeout and the kill -9s eventually wedged the tunnel).
     dict(ce_impl="fused", ce_accuracy=False, loss_chunk=8192),
     dict(ce_impl="fused", ce_accuracy=False, loss_chunk=2048),
     dict(batch=32, ce_impl="fused", ce_accuracy=False),
     dict(batch=28, ce_impl="fused", ce_accuracy=False),
     dict(batch=20, ce_impl="fused", ce_accuracy=False),
+    # 5. CE chunk size sensitivity under fused (with-argmax variants)
+    dict(ce_impl="fused", loss_chunk=8192),
+    dict(ce_impl="fused", loss_chunk=2048),
+    # 6. jax's bundled flash kernel (removes 7.2 GB of saved probs).
+    # Round-5 window: HUNG (all Pallas + big-recompile configs) — one
+    # retry each, then retired by _MAX_FAILURES.
+    dict(ce_impl="fused", attn_impl="flash_jax"),
+    dict(ce_impl="fused", attn_impl="flash_jax",
+         flash_block_q=1024, flash_block_k=1024),
+    # 7. flash frees the score buffers -> bigger batches feed the MXU
+    dict(batch=32, ce_impl="fused", attn_impl="flash_jax"),
+    dict(batch=48, ce_impl="fused", attn_impl="flash_jax"),
+    dict(batch=64, ce_impl="fused", attn_impl="flash_jax"),
+    # 8. own-kernel flash re-check with fused CE
+    dict(ce_impl="fused", attn_impl="flash",
+         flash_block_q=512, flash_block_k=512),
+    # 9. dots-remat at larger batch (cheap backward recompute)
+    dict(batch=48, ce_impl="fused", remat=True, remat_policy="dots"),
 ]
 
 

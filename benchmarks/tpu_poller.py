@@ -82,10 +82,15 @@ def main() -> int:
             if not queue_done:
                 env = dict(os.environ)
                 env["JAX_PLATFORMS"] = "tpu"
+                # 420 s: every config that ever completed on hardware
+                # did so in <= 225 s; the round-5 window showed hung
+                # (server-side-compile) configs burn their FULL timeout
+                # and repeated long hangs can wedge the tunnel for the
+                # configs after them.
                 r = subprocess.run(
                     [sys.executable,
                      os.path.join(HERE, "tpu_ab_queue.py"),
-                     "--timeout-s", "900"], env=env)
+                     "--timeout-s", "420"], env=env)
                 log({"event": "ab_queue_done", "rc": r.returncode})
                 # rc 0 = every config has a result or is retired; rc 3
                 # = the window was cut short, so a later TPU window

@@ -2371,6 +2371,44 @@ class Head:
                 ]
             }
 
+    def _h_log_index(self, body, conn):
+        """Per-worker log file index (reference: `ray logs` listing via
+        the dashboard log module — dashboard/modules/log)."""
+        from ray_tpu._private import log_utils
+
+        return {"logs": log_utils.log_index(
+            os.path.join(self.session_dir, "logs"))}
+
+    def _h_log_tail(self, body, conn):
+        """Tail one worker log (reference: `ray logs <file>`)."""
+        from ray_tpu._private import log_utils
+
+        return log_utils.log_tail(
+            os.path.join(self.session_dir, "logs"), body["name"],
+            int(body.get("max_bytes", 64 * 1024)))
+
+    def _h_stop_cluster(self, body, conn):
+        """`ray-tpu stop` (reference: `ray stop`): ask every agent to
+        shut down, then schedule the head's own exit off-thread so this
+        reply still reaches the caller."""
+        with self.lock:
+            agents = list(self.node_agents.values())
+        for a in agents:
+            try:
+                a.cast("shutdown_node", {})
+            except rpc.ConnectionLost:
+                pass
+
+        def _exit():
+            time.sleep(0.5)
+            self.shutdown()
+            os._exit(0)
+
+        if not body.get("head_keepalive"):
+            threading.Thread(target=_exit, daemon=True,
+                             name="stop-cluster").start()
+        return {"stopping": True, "agents": len(agents)}
+
     def _h_store_stats(self, body, conn):
         with self.lock:
             return {
@@ -3012,13 +3050,32 @@ class Head:
             self.workers.pop(rec.worker_id, None)
             self._release_worker_allocation(rec)
             # Direct seals this worker reported but whose owner never
-            # confirmed: the seal died in the worker's send buffer.
-            # Error-seal the still-unsealed entries so waiters resolve
-            # instead of hanging on a value that will never arrive.
+            # confirmed: the seal died in the worker's send buffer and
+            # the result is lost. The task already left rec.inflight
+            # (the head saw its seal report), so the inflight-retry
+            # path below can't save it — recover through lineage
+            # re-execution like any other lost object (reference:
+            # object_recovery_manager.h:43; regression test:
+            # test_stress.py pipelined-flood chaos), and error-seal
+            # only when the object is unrecoverable.
+            # Two phases, like node-death recovery: mark EVERY lost
+            # entry first, then reconstruct. A multi-return task has
+            # all its return ids in the pending set; the first
+            # _maybe_reconstruct resurrects the siblings to CREATING
+            # and enqueues the spec once — interleaving the marking
+            # would flip a resurrected sibling back to LOST and enqueue
+            # the same spec again (double execution, budget double-
+            # charged).
+            doomed_seals = []
             for oid in self._worker_pending_seals.pop(rec.worker_id, ()):
                 self._pending_owner_seals.pop(oid, None)
                 e = self.objects.get(oid)
                 if e is not None and e.state == CREATING:
+                    e.state = LOST
+                    e.location = None
+                    doomed_seals.append(oid)
+            for oid in doomed_seals:
+                if not self._maybe_reconstruct(oid):
                     self._seal_error(
                         oid,
                         f"WorkerCrashedError: worker {rec.worker_id} "

@@ -35,20 +35,9 @@ def _logs_dir() -> str | None:
 
 
 def _log_index() -> list[dict]:
-    import os
+    from ray_tpu._private import log_utils
 
-    d = _logs_dir()
-    if not d or not os.path.isdir(d):
-        return []
-    out = []
-    for name in sorted(os.listdir(d)):
-        if name.endswith(".log"):
-            try:
-                size = os.path.getsize(os.path.join(d, name))
-            except OSError:
-                size = 0
-            out.append({"name": name[:-4], "bytes": size})
-    return out
+    return log_utils.log_index(_logs_dir())
 
 
 def _profile_worker(worker_id: str, query: "dict | None" = None) -> dict:
@@ -76,20 +65,9 @@ def _profile_worker(worker_id: str, query: "dict | None" = None) -> dict:
 
 
 def _log_tail(name: str, max_bytes: int = 64 * 1024) -> dict:
-    import os
+    from ray_tpu._private import log_utils
 
-    d = _logs_dir()
-    if not d or "/" in name or ".." in name:
-        return {"name": name, "lines": []}
-    path = os.path.join(d, f"{name}.log")
-    try:
-        size = os.path.getsize(path)
-        with open(path, "rb") as f:
-            f.seek(max(0, size - max_bytes))
-            text = f.read().decode("utf-8", errors="replace")
-    except OSError:
-        return {"name": name, "lines": []}
-    return {"name": name, "lines": text.splitlines()[-500:]}
+    return log_utils.log_tail(_logs_dir(), name, max_bytes)
 
 
 def _serve_apps() -> dict:

@@ -146,6 +146,66 @@ def cmd_summary(args) -> int:
     return 0
 
 
+def cmd_memory(args) -> int:
+    """Object-store memory report (reference: `ray memory` —
+    _private/internal_api.py memory_summary: per-object refcount/size/
+    owner table + store totals)."""
+    from ray_tpu.util import state as us
+
+    _connect(args.address)
+    objs = us.list_objects(limit=args.limit)
+    stats = us.object_store_stats()
+    if args.json:
+        print(json.dumps({"objects": objs, "store": stats}, indent=2,
+                         default=str))
+        return 0
+    hdr = f"{'OBJECT ID':42} {'STATE':10} {'SIZE':>12} {'REFS':>5} " \
+          f"{'PINS':>5} OWNER"
+    print(hdr)
+    print("-" * len(hdr))
+    total = 0
+    for o in objs:
+        size = int(o.get("size") or 0)
+        total += size
+        pins = int(o.get("container_pins") or 0) + int(o.get("task_pins")
+                                                       or 0)
+        print(f"{o['object_id']:42} {o['state']:10} {size:>12} "
+              f"{o.get('refcount', 0):>5} {pins:>5} {o.get('owner', '')}")
+    print(f"\n{len(objs)} objects, {total} bytes referenced; store: "
+          f"{stats.get('in_use', 0)}/{stats.get('capacity', 0)} "
+          f"bytes used, {stats.get('num_objects', 0)} resident")
+    return 0
+
+
+def cmd_logs(args) -> int:
+    """List or tail cluster worker logs (reference: `ray logs [file]`)."""
+    from ray_tpu._private.worker_context import global_runtime
+
+    _connect(args.address)
+    conn = global_runtime().conn
+    if not args.name:
+        for e in conn.call("log_index", {})["logs"]:
+            print(f"{e['bytes']:>10}  {e['name']}")
+        return 0
+    reply = conn.call("log_tail", {"name": args.name,
+                                   "max_bytes": args.max_bytes})
+    lines = reply["lines"][-args.tail:] if args.tail > 0 else []
+    for ln in lines:
+        print(ln)
+    return 0
+
+
+def cmd_stop(args) -> int:
+    """Stop the cluster: all agents, then the head (reference: `ray
+    stop`)."""
+    from ray_tpu._private.worker_context import global_runtime
+
+    _connect(args.address)
+    reply = global_runtime().conn.call("stop_cluster", {})
+    print(f"stopping head + {reply['agents']} node agent(s)")
+    return 0
+
+
 def cmd_timeline(args) -> int:
     from ray_tpu.util import state as us
 
@@ -263,6 +323,24 @@ def main(argv: list[str] | None = None) -> int:
     s.add_argument("--address", required=True)
     s.add_argument("--limit", type=int, default=100)
     s.set_defaults(fn=cmd_list)
+
+    s = sub.add_parser("memory", help="object-store memory report")
+    s.add_argument("--address", required=True)
+    s.add_argument("--limit", type=int, default=200)
+    s.add_argument("--json", action="store_true")
+    s.set_defaults(fn=cmd_memory)
+
+    s = sub.add_parser("logs", help="list or tail cluster worker logs")
+    s.add_argument("name", nargs="?", default=None,
+                   help="log name from the listing (omit to list)")
+    s.add_argument("--address", required=True)
+    s.add_argument("--tail", type=int, default=100)
+    s.add_argument("--max-bytes", type=int, default=64 * 1024)
+    s.set_defaults(fn=cmd_logs)
+
+    s = sub.add_parser("stop", help="stop all agents and the head")
+    s.add_argument("--address", required=True)
+    s.set_defaults(fn=cmd_stop)
 
     s = sub.add_parser("timeline")
     s.add_argument("--address", required=True)

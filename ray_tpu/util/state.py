@@ -139,6 +139,18 @@ def object_store_stats() -> dict:
     return _call("store_stats")
 
 
+def list_logs() -> list[dict]:
+    """Worker log index on the head (reference: util/state list_logs)."""
+    return _call("log_index")["logs"]
+
+
+def get_log(name: str, *, tail: int = 500,
+            max_bytes: int = 64 * 1024) -> list[str]:
+    """Tail one worker log (reference: util/state get_log)."""
+    reply = _call("log_tail", {"name": name, "max_bytes": max_bytes})
+    return reply["lines"][-tail:] if tail > 0 else []
+
+
 def get_task_events(limit: int = 10000,
                     task_ids: "list[str] | None" = None) -> list[dict]:
     body: dict = {"limit": limit}

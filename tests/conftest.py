@@ -80,7 +80,154 @@ _CHAOS_MODULES = {
 _SCALE_MODULES = {
     "test_scale_envelope",
 }
-_SLOW_MODULES: set = set()  # filled from measured durations
+_SLOW_MODULES: set = set()
+
+# Individual tests >= ~4 s measured (full-suite --durations=0 run,
+# benchmarks/tier_from_durations.py proposes updates). Marking tests,
+# not modules, keeps every component represented in the fast tier.
+# test_core::test_simple_task is deliberately NOT here: its measured
+# 60 s is one-time cluster warmup (native build + worker jax imports)
+# that whichever test runs first would pay anyway, and it is the canary.
+_SLOW_TESTS = {
+    "test_graft_entry::test_dryrun_multichip_8",
+    "test_train_elastic::test_elastic_restart_shrinks_world",
+    "test_streaming_generators::test_error_mid_stream",
+    "test_core::test_actor_handle_passing",
+    "test_train_integrations::test_tensorflow_trainer_multiworker",
+    "test_rllib_dreamerv3::test_dreamerv3_trains_and_losses_improve",
+    "test_data::test_from_tf",
+    "test_train_integrations::test_transformers_report_callback",
+    "test_ops_parallel::test_ring_attention_grads_flow",
+    "test_models::test_grad_accumulation_matches_full_batch",
+    "test_models::test_fused_ce_matches_checkpoint_ce",
+    "test_train_torch::test_torch_trainer_ddp_converges_and_syncs",
+    "test_dashboard_data::test_dashboard_memory_profiler",
+    "test_rllib::test_algorithm_is_tune_trainable",
+    "test_models::test_sharded_train_step[gpt2]",
+    "test_models::test_sharded_train_step[llama]",
+    "test_rllib::test_ppo_remote_env_runners",
+    "test_rllib_offline::test_cql_learns_expert_policy_offline",
+    "test_rllib::test_impala_trains_with_async_runners",
+    "test_moe::test_expert_parallel_train_step_on_mesh",
+    "test_rllib_algos::test_appo_runs_cartpole",
+    "test_models::test_chunked_ce_matches_dense_loss",
+    "test_rllib_dreamerv3::test_dreamerv3_checkpoint_roundtrip",
+    "test_train::test_trainer_dp_two_workers_loss_drops",
+    "test_llm_e2e::test_openai_http_endpoints",
+    "test_multislice::test_hierarchical_train_step_2x4",
+    "test_models::test_fused_clip_adamw_matches_optax",
+    "test_moe::test_moe_forward_loss_and_grads_finite",
+    "test_models::test_fused_adamw_in_train_step",
+    "test_doc_examples::test_doc_example_runs[llm_quickstart.py]",
+    "test_models::test_grad_accumulation_moe_keeps_router_aux",
+    "test_doc_examples::test_doc_example_runs[train_torch_quickstart.py]",
+    "test_llm_sampling::test_serving_n_and_best_of",
+    "test_doc_examples::test_doc_example_runs[rllib_quickstart.py]",
+    "test_head_ft::test_kill_head_restart_recovers",
+    "test_llm_sampling::test_batched_prefill_matches_sequential",
+    "test_models::test_train_step_learns[gpt2]",
+    "test_models::test_decode_matches_forward[gpt2]",
+    "test_ops_parallel::test_ring_attention_matches_reference[True]",
+    "test_llm::test_single_request_roundtrip",
+    "test_ops_parallel::test_flash_backward_kernels_multiblock[True]",
+    "test_llm_spec::TestSpeculativeDecoding::test_smaller_draft_architecture",
+    "test_fault_tolerance::test_reconstruction_cap",
+    "test_rllib_offline::test_cql_checkpoint_restores_targets_and_bc_counter",
+    "test_dashboard_data::test_dashboard_sampling_profiler",
+    "test_device_channel::test_device_edge_between_actors",
+    "test_llm::test_tp2_decode_matches_tp1",
+    "test_models::test_decode_matches_forward[llama]",
+    "test_ops_parallel::test_flash_gradients_match_reference",
+    "test_jax_distributed::test_two_process_jax_cluster",
+    "test_rllib_algos::test_sac_runs_pendulum",
+    "test_doc_examples::test_doc_example_runs[device_channel_pipeline.py]",
+    "test_models::test_train_step_learns[llama]",
+    "test_device_channel::test_device_edge_repeated_executions",
+    "test_tune::test_asha_stops_bad_trials",
+    "test_moe::test_moe_single_expert_matches_dense_swiglu",
+    "test_tune::test_pbt_synch_exploits_better_config",
+    "test_rllib_multi_agent::test_multi_agent_ppo_learns_signal_match",
+    "test_jax_distributed::test_jax_trainer_distributed_on",
+    "test_head_ft::test_external_store_head_ha",
+    "test_rllib::test_ppo_learns_cartpole",
+    "test_device_channel::test_device_edge_pytree_and_driver_read",
+    "test_llm_spec::TestSpeculativeDecoding::test_near_cache_capacity",
+    "test_llm_spec::TestSpeculativeDecoding::"
+    "test_perfect_draft_matches_and_accelerates",
+    "test_llm::test_pp2_decode_matches_pp1",
+    "test_core::test_out_of_order_actor_execution",
+    "test_multinode::test_node_label_scheduling",
+    "test_models::test_loss_mask",
+    "test_llm_e2e::test_batch_inference_over_dataset",
+    "test_cpp_api::test_cpp_frontend_builds_and_runs",
+    # 2-4 s band (same measurement run):
+    "test_tune_hyperband::test_hyperband_prunes_to_best",
+    "test_llm_prefix::TestChunkedPrefill::test_llama_arch_rope_offsets",
+    "test_ops_parallel::test_ring_attention_matches_reference[False]",
+    "test_llm::test_continuous_batching_staggered_admission",
+    "test_llm_lora::test_adapter_changes_output_base_unaffected",
+    "test_refcount_borrowing::test_ref_in_actor_state_outlives_passing_task",
+    "test_tune::test_max_concurrent_trials_and_time_fields",
+    "test_llm_prefix::TestChunkedPrefill::test_matches_whole_prompt_prefill",
+    "test_ownership::test_result_lands_in_owner_store",
+    "test_llm::test_greedy_matches_reference_generate",
+    "test_async_actors::test_cancel_queued_actor_call",
+    "test_refcount_borrowing::test_ref_returned_inside_container",
+    "test_fault_tolerance::test_reconstruction_is_transparent_to_wait",
+    "test_ownership::test_dependent_task_fetches_from_owner",
+    "test_models::test_generate[gpt2]",
+    "test_ownership::test_fire_and_forget_then_dependent",
+    "test_rllib::test_env_runner_batch_layout",
+    "test_llm_prefix::TestChunkedPrefill::test_near_cache_capacity",
+    "test_moe::test_capacity_overflow_drops_tokens",
+    "test_refcount_borrowing::test_borrow_churn_stress",
+    "test_ops_parallel::test_spmd_pipeline_matches_sequential",
+    "test_multinode::test_p2p_object_transfer_bypasses_head",
+    "test_tune::test_tuner_function_trainable",
+    "test_multinode::test_node_death_fails_over",
+    "test_runtime_env::test_conda_lite_venv_isolated_version",
+    "test_refcount_borrowing::test_owner_death_with_live_borrowers",
+    "test_ownership::test_error_results_via_owner_plane",
+    "test_cli_job_serve::test_serve_deploy_status_shutdown",
+    "test_ops_parallel::test_blockwise_matches_reference",
+    "test_rllib_offline::test_marwil_beats_bc_on_mixed_data",
+    "test_models::test_generate[llama]",
+    "test_rllib_connectors::test_ppo_with_connectors_learns",
+    "test_models::test_causality[gpt2]",
+    "test_worker_hermetic::test_tpu_worker_keeps_plugin_and_pins_chips",
+    "test_ownership::test_big_results_take_store_path",
+    "test_rllib::test_rl_module_forward_and_weights",
+    "test_channels::test_compiled_dag_function_node_falls_back",
+    "test_moe::test_topk_dispatch_shapes_and_mass",
+    "test_head_ft::test_head_restart_readopts_node_agent",
+    "test_models::test_forward_shapes[llama]",
+    "test_collective::test_broadcast_slow_joiner",
+    "test_worker_hermetic::test_chipless_worker_strips_plugin_hooks",
+    "test_refcount_borrowing::test_nested_arg_ref_survives_fire_and_forget",
+    "test_rllib::test_compute_single_action_after_training",
+    "test_ops_parallel::test_blockwise_noncausal_with_padding",
+    "test_llm::test_default_config_works_with_byte_tokenizer",
+    "test_dashboard_data::test_from_huggingface_roundtrip",
+    "test_rllib::test_evaluate_and_evaluation_interval",
+    "test_rllib::test_ppo_checkpoint_roundtrip",
+    "test_models::test_forward_shapes[gpt2]",
+    "test_rllib_multi_agent::test_multi_agent_shared_policy_and_checkpoint",
+    "test_rllib_algos::test_dqn_learns_cartpole",
+    "test_rllib_offline::test_marwil_beta_zero_is_bc",
+    "test_review_regressions::test_pipelined_nested_get_no_deadlock",
+    "test_rllib_dreamerv3::test_symlog_twohot_roundtrip",
+    "test_zero_copy::test_nested_and_multiple_arrays_share_one_pin",
+    "test_ops_parallel::test_flash_backward_kernels_multiblock[False]",
+    "test_train_torch::test_torch_trainer_single_worker_no_pg",
+    "test_llm_prefix::TestPrefixCache::test_multi_slot_interleaving",
+    "test_llm_prefix::TestPrefixCache::test_shared_prefix_divergent_tail",
+    "test_serve::test_autoscaling_scales_up_under_load",
+    "test_doc_examples::test_doc_example_runs[serve_quickstart.py]",
+    "test_doc_examples::test_doc_example_runs[tune_quickstart.py]",
+    "test_core::test_duplicate_pending_dep_runs_once",
+    "test_cpp_client::test_malformed_path_func_id_errors",
+    "test_util_bridges::test_pool_map_and_starmap",
+}
 
 
 def pytest_collection_modifyitems(config, items):
@@ -92,6 +239,14 @@ def pytest_collection_modifyitems(config, items):
             item.add_marker(pytest.mark.scale)
         elif mod in _SLOW_MODULES:
             item.add_marker(pytest.mark.slow)
+        else:
+            # item.name carries parametrization ([gpt2]); class-scoped
+            # tests join as Class::name to match the set's keys.
+            cls = getattr(item, "cls", None)
+            key = (f"{mod}::{cls.__name__}::{item.name}" if cls
+                   else f"{mod}::{item.name}")
+            if key in _SLOW_TESTS:
+                item.add_marker(pytest.mark.slow)
         if not any(m.name in ("slow", "chaos", "scale")
                    for m in item.iter_markers()):
             item.add_marker(pytest.mark.fast)

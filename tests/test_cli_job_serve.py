@@ -69,6 +69,64 @@ def test_job_stop(cli_head):
     assert st["status"] in ("STOPPED", "FAILED")
 
 
+def test_memory_and_logs_cli(cli_head):
+    """`ray-tpu memory` (reference: `ray memory` — internal_api.py
+    memory_summary) and `ray-tpu logs [name]` (reference: `ray logs`)."""
+    # Park an object in the cluster via a job so memory has a row.
+    out = _cli("job", "submit", "--address", cli_head, "--wait", "--",
+               sys.executable, "-c",
+               "import ray_tpu, os;"
+               f"ray_tpu.init(address={cli_head!r});"
+               "r = ray_tpu.put(b'x' * 100_000);"
+               "print('LOGS-CLI-LINE');"
+               "ray_tpu.shutdown()")
+    assert out.returncode == 0, out.stdout + out.stderr
+
+    mem = _cli("memory", "--address", cli_head)
+    assert mem.returncode == 0, mem.stdout + mem.stderr
+    assert "OBJECT ID" in mem.stdout and "store:" in mem.stdout
+    mem_j = json.loads(_cli("memory", "--address", cli_head,
+                            "--json").stdout)
+    assert "store" in mem_j and isinstance(mem_j["objects"], list)
+    # The text summary renders the REAL store stats keys — a head with
+    # a default store must show nonzero capacity, not "0/0".
+    assert mem_j["store"]["capacity"] > 0
+    assert f"/{mem_j['store']['capacity']} bytes used" in mem.stdout
+
+    idx = _cli("logs", "--address", cli_head)
+    assert idx.returncode == 0, idx.stdout + idx.stderr
+    names = [ln.split()[-1] for ln in idx.stdout.splitlines() if ln.strip()]
+    assert names, "no logs listed"
+    found = False
+    for name in names:
+        tail = _cli("logs", name, "--address", cli_head)
+        assert tail.returncode == 0
+        if "LOGS-CLI-LINE" in tail.stdout:
+            found = True
+    assert found, f"job print not in any log: {names}"
+
+
+def test_stop_cli():
+    """`ray-tpu stop` terminates a CLI-started head (reference: `ray
+    stop`). Own head — the module fixture's must survive other tests."""
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu.scripts", "start", "--head",
+         "--port", "0", "--num-cpus", "2"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    try:
+        address = proc.stdout.readline().strip().rsplit(" ", 1)[-1]
+        assert ":" in address
+        out = _cli("stop", "--address", address)
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert "stopping head" in out.stdout
+        assert proc.wait(timeout=20) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
+
+
 def test_serve_deploy_status_shutdown(cli_head, tmp_path):
     config = {
         "applications": [{

@@ -14,6 +14,16 @@ from ray_tpu.data.block import BlockAccessor
 from ray_tpu.data.executor import _rebatch
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _shutdown_leaked_runtime():
+    """Many tests here run data ops that auto-init the runtime without
+    an explicit init/shutdown pair; tear it down at module end so the
+    next module's fresh `ray_tpu.init()` doesn't see a live session."""
+    yield
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+
+
 # ---------------------------------------------------------------------------
 # blocks
 

@@ -14,6 +14,8 @@ from ray_tpu import data as rdata
 
 @pytest.fixture
 def cluster():
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()  # e.g. an auto-init leaked by a prior module
     ray_tpu.init(num_cpus=4, object_store_memory=96 * 1024 * 1024)
     yield
     ray_tpu.shutdown()

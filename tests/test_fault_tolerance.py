@@ -133,6 +133,38 @@ def test_tasks_survive_chaos_worker_killing(cluster):
     assert killed >= 1, "chaos loop never found a worker to kill"
 
 
+def test_multi_return_tasks_survive_chaos(cluster):
+    """Multi-return tasks under worker SIGKILL: a task whose seals die
+    unconfirmed registers ALL its return ids as pending — recovery must
+    mark every lost sibling before reconstructing so the spec is
+    enqueued once, not once per return id (regression: round-5 lost-
+    seal recovery; both values must arrive and match)."""
+
+    @ray_tpu.remote(max_retries=10, num_returns=2)
+    def pair(i):
+        time.sleep(0.12)
+        return i, i * 10
+
+    pairs = [pair.remote(i) for i in range(10)]
+    deadline = time.monotonic() + 20
+    killed = 0
+    my_pid = os.getpid()
+    while killed < 3 and time.monotonic() < deadline:
+        busy = [w for w in us.list_workers(filters=[("busy", "=", "True")])
+                if w["pid"] not in (None, my_pid) and not w["actor_id"]]
+        if busy:
+            try:
+                os.kill(busy[0]["pid"], signal.SIGKILL)
+                killed += 1
+            except ProcessLookupError:
+                pass
+        time.sleep(0.2)
+    flat = ray_tpu.get([r for pr in pairs for r in pr], timeout=60)
+    for i in range(10):
+        assert flat[2 * i] == i and flat[2 * i + 1] == i * 10
+    assert killed >= 1, "chaos loop never found a worker to kill"
+
+
 def test_actor_restart_then_named_lookup(cluster):
     @ray_tpu.remote(max_restarts=2, name="phoenix")
     class Phoenix:
