@@ -77,31 +77,47 @@ class ZygoteClient:
         self._base_env = dict(base_env)
         self._log_dir = log_dir
         self._proc: subprocess.Popen | None = None
+        # _lock guards the request channel + published state and is only
+        # ever held for FAST operations (state flips, one fork
+        # round-trip). The slow warmup (Popen + READY readline) runs in
+        # a dedicated thread holding NO lock — state is published under
+        # _lock only at the end. start_async()/spawn() therefore never
+        # block on a warmup in flight, and a hung zygote child can wedge
+        # only its own warmup thread, never the dispatch path.
         self._lock = threading.Lock()
         self._failed = False
+        self._stopped = False
         self._ready = threading.Event()
+        self._warming = False
+        self._warm_started_at: "float | None" = None
 
     def start_async(self) -> None:
         """Warm the zygote off the caller's thread: callers that hold
         hot locks (the head's dispatch path) must never block on the
-        worker-module import; spawn() just returns None (direct-Popen
-        fallback) until READY lands."""
-        threading.Thread(target=self._ensure, daemon=True,
+        worker-module import; spawn() falls back to a direct Popen
+        once the warmup grace window passes. Must not be called while
+        holding self._lock."""
+        import time
+
+        with self._lock:
+            if (self._warming or self._failed or self._stopped
+                    or self._ready.is_set()):
+                return
+            self._warming = True
+            # Re-anchored on EVERY warmup start (not just the first):
+            # a re-warm after a zygote death needs its own full grace
+            # window or burst callers all fall back to Popen storms.
+            self._warm_started_at = time.monotonic()
+        threading.Thread(target=self._warmup, daemon=True,
                          name="zygote-warmup").start()
 
-    def _ensure(self) -> bool:
-        with self._lock:
-            return self._ensure_locked()
-
-    def _ensure_locked(self) -> bool:
-        if self._proc is not None and self._proc.poll() is None:
-            return True
-        if self._failed:
-            return False
+    def _warmup(self) -> None:
+        """Slow path, lock-free: fork the zygote and wait for READY."""
+        proc = None
         try:
             os.makedirs(self._log_dir, exist_ok=True)
             err = open(os.path.join(self._log_dir, "zygote.log"), "ab")
-            self._proc = subprocess.Popen(
+            proc = subprocess.Popen(
                 [sys.executable, "-m", "ray_tpu._private.zygote"],
                 env=self._base_env,
                 stdin=subprocess.PIPE,
@@ -111,56 +127,89 @@ class ZygoteClient:
                 text=True,
             )
             err.close()
-            ready = self._proc.stdout.readline()
+            ready = proc.stdout.readline()
             if ready.strip() != "READY":
                 raise RuntimeError(f"zygote failed to start: {ready!r}")
-            self._ready.set()
-            return True
         except Exception:
-            self._failed = True
             try:
-                if self._proc is not None:
-                    self._proc.kill()
+                if proc is not None:
+                    proc.kill()
             except Exception:
                 pass
-            self._proc = None
-            return False
+            with self._lock:
+                self._failed = True
+                self._warming = False
+            return
+        with self._lock:
+            self._warming = False
+            if self._stopped:
+                # stop() raced the warmup: don't publish a process
+                # nobody will ever reap.
+                try:
+                    proc.kill()
+                except Exception:
+                    pass
+                return
+            self._proc = proc
+            self._ready.set()
 
     def spawn(self, extra_env: dict, log_path: str) -> "int | None":
         if not self._ready.is_set():
-            # Not warmed yet (or died): never block a hot caller on the
-            # worker-module import — re-warm in the background and let
-            # this spawn fall back to a direct Popen.
-            if not self._failed:
-                self.start_async()
-            return None
+            if self._failed or self._stopped:
+                return None
+            # Not warmed yet (or died): re-warm in the background. A
+            # burst of spawns during warmup used to ALL fall back to
+            # direct Popens — on a small box, N concurrent interpreter
+            # starts thrash each other (measured: 40 actor creations =
+            # 12 s cold vs 0.7 s warm). Instead, wait for READY within
+            # a grace window anchored at warmup START (not per-call, so
+            # a serial caller like the dispatch loop stalls at most
+            # `grace` total across the whole burst), then fall back.
+            import time
+
+            self.start_async()
+            with self._lock:
+                started = self._warm_started_at
+            if started is not None:
+                grace = float(os.environ.get(
+                    "RAY_TPU_ZYGOTE_SPAWN_GRACE_S", "6"))
+                remaining = started + grace - time.monotonic()
+                if remaining > 0:
+                    self._ready.wait(remaining)
+            if not self._ready.is_set():
+                return None
+        rewarm = False
+        pid = None
         with self._lock:
             if self._proc is None or self._proc.poll() is not None:
-                # Died since READY: re-warm off-thread, caller falls
-                # back (never pay the import under a hot lock).
+                # Died since READY: re-warm off-thread (outside the
+                # lock — start_async takes it), caller falls back.
                 self._ready.clear()
                 self._proc = None
-                if not self._failed:
-                    self.start_async()
-                return None
-            try:
-                self._proc.stdin.write(
-                    json.dumps({"env": extra_env, "log": log_path}) + "\n")
-                self._proc.stdin.flush()
-                reply = self._proc.stdout.readline()
-                return int(json.loads(reply)["pid"])
-            except Exception:
-                # Zygote died mid-request: one restart attempt next call.
+                rewarm = not self._failed and not self._stopped
+            else:
                 try:
-                    self._proc.kill()
+                    self._proc.stdin.write(
+                        json.dumps({"env": extra_env,
+                                    "log": log_path}) + "\n")
+                    self._proc.stdin.flush()
+                    reply = self._proc.stdout.readline()
+                    pid = int(json.loads(reply)["pid"])
                 except Exception:
-                    pass
-                self._proc = None
-                self._ready.clear()
-                return None
+                    # Zygote died mid-request: restart attempt next call.
+                    try:
+                        self._proc.kill()
+                    except Exception:
+                        pass
+                    self._proc = None
+                    self._ready.clear()
+        if rewarm:
+            self.start_async()
+        return pid
 
     def stop(self) -> None:
         with self._lock:
+            self._stopped = True
             if self._proc is not None:
                 try:
                     self._proc.kill()

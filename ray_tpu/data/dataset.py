@@ -30,6 +30,7 @@ from ray_tpu.data.executor import (
     LogicalOp,
     MapBatches,
     MapRows,
+    RandomizeBlockOrder,
     RandomShuffle,
     Read,
     RenameColumns,
@@ -134,6 +135,11 @@ class Dataset:
 
     def random_shuffle(self, *, seed: int | None = None) -> "Dataset":
         return self._append(RandomShuffle(seed))
+
+    def randomize_block_order(self, *, seed: int | None = None) -> "Dataset":
+        """Shuffle block order without repacking rows (reference:
+        Dataset.randomize_block_order)."""
+        return self._append(RandomizeBlockOrder(seed))
 
     def sort(self, key: str, *, descending: bool = False) -> "Dataset":
         return self._append(Sort(key, descending))
@@ -338,6 +344,48 @@ class Dataset:
     def write_tfrecords(self, path: str) -> list[str]:
         return self._write(path, ds_mod.write_tfrecord_block)
 
+    def write_numpy(self, path: str, *,
+                    column: str | None = None) -> list[str]:
+        """.npy (one column) / .npz (whole block) shards (reference:
+        Dataset.write_numpy)."""
+        return self._write(
+            path, lambda b, p, i: ds_mod.write_numpy_block(b, p, i, column))
+
+    def write_sql(self, sql: str, connection_factory) -> int:
+        """Insert every row through a DB-API connection; returns rows
+        written (reference: Dataset.write_sql — same
+        (sql, connection_factory) contract as read_sql)."""
+        return sum(ds_mod.write_sql_block(b, sql, connection_factory)
+                   for b in self.iter_blocks())
+
+    def write_webdataset(self, path: str) -> list[str]:
+        """Tar shards, inverse of read_webdataset (reference:
+        Dataset.write_webdataset)."""
+        return self._write(path, ds_mod.write_webdataset_block)
+
+    def write_images(self, path: str, column: str = "image", *,
+                     file_format: str = "png") -> list[str]:
+        """One image file per row (reference: Dataset.write_images)."""
+        outs: list[str] = []
+        for i, b in enumerate(self.iter_blocks()):
+            outs.extend(ds_mod.write_images_block(b, path, i, column,
+                                                  file_format))
+        return outs
+
+    def write_datasink(self, datasink: "Datasink") -> None:
+        """Stream blocks through a custom sink (reference:
+        Dataset.write_datasink / datasource.Datasink lifecycle:
+        on_write_start -> write(block) per block -> on_write_complete,
+        or on_write_failed with the exception)."""
+        datasink.on_write_start()
+        try:
+            for block in self.iter_blocks():
+                datasink.write(block)
+        except Exception as e:
+            datasink.on_write_failed(e)
+            raise
+        datasink.on_write_complete()
+
     # -- train integration -------------------------------------------------
 
     def split(self, n: int) -> list["Dataset"]:
@@ -414,9 +462,181 @@ class Dataset:
         concurrently without materializing the whole dataset."""
         return [DataIterator(self, i, n) for i in builtins.range(n)]
 
+    def iterator(self) -> "DataIterator":
+        """Whole-dataset DataIterator (reference: Dataset.iterator)."""
+        return DataIterator(self, 0, 1)
+
+    # -- introspection -----------------------------------------------------
+
+    @property
+    def context(self) -> DataContext:
+        """The execution context this plan runs under (reference:
+        Dataset.context)."""
+        return DataContext.get_current()
+
+    def copy(self) -> "Dataset":
+        """Shallow plan copy (reference: Dataset.copy — plans are
+        immutable, so a list copy is a full logical copy)."""
+        return Dataset(list(self._plan))
+
+    def show(self, limit: int = 20) -> None:
+        """Print up to ``limit`` rows (reference: Dataset.show).
+        numpy scalars display as plain Python values."""
+        for row in self.take(limit):
+            if isinstance(row, dict):
+                row = {k: (v.item() if isinstance(v, np.generic) else v)
+                       for k, v in row.items()}
+            print(row)
+
+    def num_blocks(self) -> int:
+        """Block count after execution (reference: Dataset.num_blocks)."""
+        return sum(1 for _ in self.iter_blocks())
+
+    def size_bytes(self) -> int:
+        """Total block bytes after execution (reference:
+        Dataset.size_bytes)."""
+        return sum(BlockAccessor(b).size_bytes() for b in self.iter_blocks())
+
+    def input_files(self) -> list[str]:
+        """Source file paths of the plan's read ops (reference:
+        Dataset.input_files). Empty for in-memory sources."""
+        files: list[str] = []
+        for op in self._plan:
+            if isinstance(op, Read):
+                for task in op.tasks:
+                    meta = getattr(task, "metadata", None)
+                    files.extend(getattr(meta, "input_files", None) or ())
+        return files
+
+    def names(self) -> list[str]:
+        """Column names (reference: Dataset.schema().names)."""
+        return self.columns()
+
+    def types(self) -> list:
+        """Column dtypes of the first block, schema order (reference:
+        Dataset.schema().types)."""
+        for block in self.iter_blocks():
+            acc = BlockAccessor(block)
+            batch = acc.to_batch("numpy")
+            return [np.asarray(batch[c]).dtype for c in acc.column_names()]
+        return []
+
+    def split_proportionately(self, proportions: list[float],
+                              ) -> list["Dataset"]:
+        """Materializing split by fractions; the remainder becomes the
+        final extra split (reference: Dataset.split_proportionately,
+        ``[0.7, 0.2]`` -> three datasets at 70%/20%/10%)."""
+        if not proportions or any(p <= 0 for p in proportions) \
+                or sum(proportions) >= 1.0:
+            raise ValueError("proportions must be positive and sum to <1")
+        n = self.count()
+        bounds, acc = [], 0.0
+        for p in proportions:
+            acc += p
+            # round, not int: float accumulation (0.7+0.2 ->
+            # 0.8999999…) would truncate a row out of the wrong split.
+            bounds.append(round(n * acc))
+        return self.split_at_indices(bounds)
+
+    # -- ref-level conversions (reference: to_*_refs — per-block object
+    # refs so downstream consumers fetch shards without a driver concat)
+
+    def to_numpy_refs(self) -> list:
+        import ray_tpu
+
+        return [ray_tpu.put(BlockAccessor(b).to_numpy())
+                for b in self.iter_blocks()]
+
+    def to_pandas_refs(self) -> list:
+        import ray_tpu
+
+        return [ray_tpu.put(BlockAccessor(b).to_pandas())
+                for b in self.iter_blocks()]
+
+    def to_arrow_refs(self) -> list:
+        import ray_tpu
+
+        return [ray_tpu.put(BlockAccessor(b).to_arrow())
+                for b in self.iter_blocks()]
+
+    # -- framework-native datasets ----------------------------------------
+
+    def to_tf(self, feature_columns, label_columns, *,
+              batch_size: int = 256):
+        """tf.data.Dataset of (features, labels) (reference:
+        Dataset.to_tf). Columns may be a name or list of names; a single
+        name yields a bare tensor, a list a dict of tensors."""
+        import tensorflow as tf
+
+        # One plan execution for both signatures — _spec per column set
+        # would re-run the whole read/map pipeline twice at graph-
+        # definition time.
+        probe = self.take_batch(1)
+
+        def _spec(cols):
+            def one(c):
+                v = np.asarray(probe[c])
+                return tf.TensorSpec(shape=(None,) + v.shape[1:],
+                                     dtype=tf.as_dtype(v.dtype))
+            if isinstance(cols, str):
+                return one(cols)
+            return {c: one(c) for c in cols}
+
+        def _pick(batch, cols):
+            if isinstance(cols, str):
+                return tf.convert_to_tensor(batch[cols])
+            return {c: tf.convert_to_tensor(batch[c]) for c in cols}
+
+        def gen():
+            for batch in self.iter_batches(batch_size=batch_size):
+                yield _pick(batch, feature_columns), _pick(batch, label_columns)
+
+        return tf.data.Dataset.from_generator(
+            gen, output_signature=(_spec(feature_columns),
+                                   _spec(label_columns)))
+
+    def to_torch(self, *, label_column: str | None = None,
+                 batch_size: int = 256):
+        """torch IterableDataset of (features_dict, label) batches —
+        or plain batch dicts without a label column (reference:
+        Dataset.to_torch)."""
+        import torch
+
+        outer = self
+
+        class _IterTorch(torch.utils.data.IterableDataset):
+            def __iter__(self):
+                for batch in outer.iter_torch_batches(
+                        batch_size=batch_size):
+                    if label_column is None:
+                        yield batch
+                    else:
+                        label = batch.pop(label_column)
+                        yield batch, label
+
+        return _IterTorch()
+
     def __repr__(self):
         names = [type(op).__name__ for op in self._plan]
         return f"Dataset({' -> '.join(names)})"
+
+
+class Datasink:
+    """Custom write target (reference: data/datasource/datasink.py
+    Datasink — subclass and override write(); the lifecycle hooks are
+    optional)."""
+
+    def on_write_start(self) -> None:
+        pass
+
+    def write(self, block: Block) -> None:
+        raise NotImplementedError
+
+    def on_write_complete(self) -> None:
+        pass
+
+    def on_write_failed(self, error: Exception) -> None:
+        pass
 
 
 class DataIterator:

@@ -757,3 +757,101 @@ class Datasource:
 
     def get_name(self) -> str:
         return type(self).__name__
+
+
+def write_numpy_block(block: Block, path: str, idx: int,
+                      column: "str | None" = None) -> str:
+    """One .npy per block (reference: Dataset.write_numpy — a single
+    column as a stacked array, or the whole block as a structured dict
+    via np.savez when no column is named)."""
+    from ray_tpu.data.block import BlockAccessor
+
+    os.makedirs(path, exist_ok=True)
+    batch = BlockAccessor(block).to_numpy()
+    if column is not None:
+        out = os.path.join(path, f"part-{idx:06d}.npy")
+        np.save(out, np.asarray(batch[column]))
+    else:
+        out = os.path.join(path, f"part-{idx:06d}.npz")
+        np.savez(out, **{k: np.asarray(v) for k, v in batch.items()})
+    return out
+
+
+def write_sql_block(block: Block, sql: str, connection_factory) -> int:
+    """executemany one block through a DB-API connection (reference:
+    Dataset.write_sql — same (sql, connection_factory) contract)."""
+    from ray_tpu.data.block import BlockAccessor
+
+    conn = connection_factory()
+    try:
+        rows = []
+        for row in BlockAccessor(block).iter_rows():
+            if not isinstance(row, dict):
+                row = {"item": row}
+            rows.append(tuple(
+                v.item() if isinstance(v, np.generic) else v
+                for v in row.values()))
+        cur = conn.cursor()
+        cur.executemany(sql, rows)
+        conn.commit()
+        return len(rows)
+    finally:
+        conn.close()
+
+
+def write_webdataset_block(block: Block, path: str, idx: int) -> str:
+    """One tar shard per block, inverse of webdataset_tasks: each row
+    becomes `<key>.<column>` members; bytes stay raw, str -> .txt-style
+    text, int -> .cls, everything else JSON (reference:
+    Dataset.write_webdataset)."""
+    import io
+    import json as _json
+    import tarfile
+
+    from ray_tpu.data.block import BlockAccessor
+
+    os.makedirs(path, exist_ok=True)
+    out = os.path.join(path, f"part-{idx:06d}.tar")
+    with tarfile.open(out, "w") as tf:
+        for i, row in enumerate(BlockAccessor(block).iter_rows()):
+            if not isinstance(row, dict):
+                row = {"bin": row}
+            key = str(row.get("__key__") or f"{idx:06d}-{i:06d}")
+            for col, value in row.items():
+                if col == "__key__":
+                    continue
+                if isinstance(value, np.generic):
+                    value = value.item()
+                if isinstance(value, bytes):
+                    payload = value
+                elif isinstance(value, str):
+                    payload = value.encode()
+                elif isinstance(value, int):
+                    payload = str(value).encode()
+                else:
+                    if isinstance(value, np.ndarray):
+                        value = value.tolist()
+                    payload = _json.dumps(value).encode()
+                info = tarfile.TarInfo(f"{key}.{col}")
+                info.size = len(payload)
+                tf.addfile(info, io.BytesIO(payload))
+    return out
+
+
+def write_images_block(block: Block, path: str, idx: int,
+                       column: str = "image",
+                       file_format: str = "png") -> list[str]:
+    """One image file per row from an array column (reference:
+    Dataset.write_images)."""
+    from PIL import Image
+
+    from ray_tpu.data.block import BlockAccessor
+
+    os.makedirs(path, exist_ok=True)
+    outs = []
+    for i, row in enumerate(BlockAccessor(block).iter_rows()):
+        arr = np.asarray(row[column])
+        out = os.path.join(path, f"img-{idx:06d}-{i:06d}.{file_format}")
+        Image.fromarray(arr).save(out)
+        outs.append(out)
+    return outs

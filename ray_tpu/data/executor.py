@@ -112,6 +112,14 @@ class RandomShuffle(LogicalOp):
 
 
 @dataclass
+class RandomizeBlockOrder(LogicalOp):
+    """Shuffle BLOCK order only (reference: randomize_block_order —
+    cheap decorrelation without the row-level shuffle's full repack)."""
+
+    seed: int | None = None
+
+
+@dataclass
 class Sort(LogicalOp):
     key: str
     descending: bool = False
@@ -424,6 +432,13 @@ def execute_plan(plan: list, ctx) -> Iterator[Block]:
         elif isinstance(op, RandomShuffle):
             blocks = list(stream)
             stream = iter(_shuffle(blocks, op.seed))
+            i += 1
+        elif isinstance(op, RandomizeBlockOrder):
+            blocks = list(stream)
+            import numpy as _np
+
+            _np.random.default_rng(op.seed).shuffle(blocks)
+            stream = iter(blocks)
             i += 1
         elif isinstance(op, Sort):
             blocks = list(stream)

@@ -1,0 +1,191 @@
+"""Round-5 Dataset API widening (reference: data/dataset.py — show/
+num_blocks/size_bytes/input_files/names/types/copy/context/iterator/
+randomize_block_order/split_proportionately/to_*_refs/to_tf/to_torch/
+write_numpy/write_sql/write_webdataset/write_images/write_datasink)."""
+
+from __future__ import annotations
+
+import os
+import sqlite3
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rd
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _teardown():
+    yield
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+
+
+def test_introspection_surface(tmp_path):
+    f = tmp_path / "in.csv"
+    f.write_text("a,b\n1,x\n2,y\n3,z\n")
+    ds = rd.read_csv(str(f))
+    assert ds.input_files() == [str(f)]
+    assert ds.names() == ["a", "b"]
+    types = ds.types()
+    assert len(types) == 2 and types[0].kind in "il"
+    assert ds.num_blocks() >= 1
+    assert ds.size_bytes() > 0
+    assert ds.copy()._plan is not ds._plan
+    assert ds.copy().take_all() == ds.take_all()
+    assert ds.context is rd.DataContext.get_current()
+
+
+def test_show_prints_rows(capsys):
+    rd.range(3).show()
+    out = capsys.readouterr().out
+    assert "{'id': 0}" in out and "{'id': 2}" in out
+
+
+def test_randomize_block_order_preserves_rows():
+    ds = rd.range(100, parallelism=10)
+    plain = [r["id"] for r in ds.take_all()]
+    shuffled = [r["id"] for r in ds.randomize_block_order(seed=7).take_all()]
+    assert sorted(shuffled) == plain
+    # Same seed -> same order; block interiors stay contiguous.
+    again = [r["id"] for r in ds.randomize_block_order(seed=7).take_all()]
+    assert shuffled == again
+
+
+def test_split_proportionately():
+    parts = rd.range(100).split_proportionately([0.7, 0.2])
+    sizes = [p.count() for p in parts]
+    assert sizes == [70, 20, 10]
+    assert sorted(r["id"] for p in parts for r in p.take_all()) == \
+        list(range(100))
+    with pytest.raises(ValueError):
+        rd.range(10).split_proportionately([0.9, 0.2])
+
+
+def test_iterator_covers_whole_dataset():
+    it = rd.range(20).iterator()
+    total = sum(int(b["id"].sum()) for b in it.iter_batches(batch_size=8))
+    assert total == sum(range(20))
+
+
+def test_to_refs_roundtrip():
+    ray_tpu.init(num_cpus=2, object_store_memory=64 * 1024 * 1024,
+                 ignore_reinit_error=True)
+    ds = rd.range(10, parallelism=2)
+    nrefs = ds.to_numpy_refs()
+    assert len(nrefs) == 2
+    fetched = [ray_tpu.get(r) for r in nrefs]
+    assert sorted(int(x) for f in fetched for x in f["id"]) == list(range(10))
+    prefs = ds.to_pandas_refs()
+    assert sum(len(ray_tpu.get(r)) for r in prefs) == 10
+    arefs = ds.to_arrow_refs()
+    assert sum(ray_tpu.get(r).num_rows for r in arefs) == 10
+
+
+def test_to_tf_dataset():
+    import tensorflow as tf
+
+    ds = rd.range(32).add_column("label", lambda r: r["id"] % 2)
+    tfds = ds.to_tf("id", "label", batch_size=16)
+    batches = list(tfds)
+    assert len(batches) == 2
+    feats, labels = batches[0]
+    assert isinstance(feats, tf.Tensor) and int(tf.size(feats)) == 16
+    # Dict form with column lists.
+    tfds2 = ds.to_tf(["id"], ["label"], batch_size=32)
+    feats2, labels2 = next(iter(tfds2))
+    assert set(feats2.keys()) == {"id"} and set(labels2.keys()) == {"label"}
+
+
+def test_to_torch_dataset():
+    import torch
+
+    ds = rd.range(12).add_column("y", lambda r: r["id"] * 2)
+    loader = ds.to_torch(label_column="y", batch_size=6)
+    batches = list(loader)
+    assert len(batches) == 2
+    feats, label = batches[0]
+    assert isinstance(label, torch.Tensor) and len(label) == 6
+    assert torch.equal(label, feats["id"] * 2)
+
+
+def test_write_numpy(tmp_path):
+    ds = rd.range(10, parallelism=2)
+    outs = ds.write_numpy(str(tmp_path / "col"), column="id")
+    assert all(o.endswith(".npy") for o in outs)
+    vals = np.concatenate([np.load(o) for o in outs])
+    assert sorted(vals.tolist()) == list(range(10))
+    outs2 = ds.write_numpy(str(tmp_path / "all"))
+    loaded = np.load(outs2[0])
+    assert "id" in loaded
+
+
+def test_write_sql_roundtrip(tmp_path):
+    db = str(tmp_path / "t.db")
+    conn = sqlite3.connect(db)
+    conn.execute("CREATE TABLE t (id INTEGER, name TEXT)")
+    conn.commit()
+    conn.close()
+
+    ds = rd.from_items([{"id": i, "name": f"n{i}"} for i in range(7)])
+    wrote = ds.write_sql("INSERT INTO t VALUES (?, ?)",
+                         lambda: sqlite3.connect(db))
+    assert wrote == 7
+    back = rd.read_sql("SELECT id, name FROM t ORDER BY id",
+                       lambda: sqlite3.connect(db))
+    rows = back.take_all()
+    assert len(rows) == 7 and rows[3]["name"] == "n3"
+
+
+def test_write_webdataset_roundtrip(tmp_path):
+    items = [{"__key__": f"s{i:03d}", "txt": f"text-{i}", "cls": i,
+              "bin": bytes([i] * 4)} for i in range(5)]
+    outs = rd.from_items(items).write_webdataset(str(tmp_path / "wds"))
+    assert all(o.endswith(".tar") for o in outs)
+    back = rd.read_webdataset([str(tmp_path / "wds")]).take_all()
+    by_key = {r["__key__"]: r for r in back}
+    assert len(by_key) == 5
+    assert by_key["s002"]["txt"] == "text-2"
+    assert by_key["s002"]["cls"] == 2
+    assert by_key["s002"]["bin"] == bytes([2] * 4)
+
+
+def test_write_images_roundtrip(tmp_path):
+    arrs = [np.full((4, 4, 3), i * 20, dtype=np.uint8) for i in range(3)]
+    ds = rd.from_items([{"image": a} for a in arrs])
+    outs = ds.write_images(str(tmp_path / "imgs"))
+    assert len(outs) == 3 and all(o.endswith(".png") for o in outs)
+    back = rd.read_images(str(tmp_path / "imgs")).take_all()
+    vals = sorted(int(np.asarray(r["image"]).flat[0]) for r in back)
+    assert vals == [0, 20, 40]
+
+
+def test_write_datasink_lifecycle():
+    events = []
+
+    class Sink(rd.Datasink):
+        def on_write_start(self):
+            events.append("start")
+
+        def write(self, block):
+            events.append(("block", rd.BlockAccessor(block).num_rows()))
+
+        def on_write_complete(self):
+            events.append("done")
+
+        def on_write_failed(self, error):
+            events.append(("failed", str(error)))
+
+    rd.range(10, parallelism=2).write_datasink(Sink())
+    assert events[0] == "start" and events[-1] == "done"
+    assert sum(n for tag, n in events[1:-1] if tag == "block") == 10
+
+    class Boom(Sink):
+        def write(self, block):
+            raise RuntimeError("sink exploded")
+
+    events.clear()
+    with pytest.raises(RuntimeError):
+        rd.range(4).write_datasink(Boom())
+    assert ("failed", "sink exploded") in events
