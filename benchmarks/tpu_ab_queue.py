@@ -81,7 +81,11 @@ def run_one(kw: dict, timeout_s: float) -> dict:
     out = next((ln for ln in reversed(p.stdout.splitlines())
                 if ln.startswith("RESULT ")), None)
     tok_s = json.loads(out[7:]) if out else f"NO_OUTPUT rc={p.returncode}"
-    return {**kw, "tok_s": tok_s, "wall_s": round(time.time() - t0, 1)}
+    # "t" lets bench.py age-gate records: the file is append-only across
+    # rounds, and a stale round's number must never masquerade as this
+    # round's hardware measurement.
+    return {**kw, "tok_s": tok_s, "wall_s": round(time.time() - t0, 1),
+            "t": round(time.time(), 1)}
 
 
 _MAX_FAILURES = 2  # attempts per config before it is retired

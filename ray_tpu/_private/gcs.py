@@ -436,14 +436,41 @@ class Head:
         n_prestart = min(config.worker_pool_prestart, self.max_pool_workers)
         n_tpu = min(n_prestart // 2, int(node_resources.get("TPU", 0))) \
             if node_resources.get("TPU", 0) else 0
+        deferred_prestart = 0
         for i in range(n_prestart):
             try:
-                self.spawn_worker(self.node_id, tpu_capable=i < n_tpu)
+                if self.spawn_worker(self.node_id,
+                                     tpu_capable=i < n_tpu) is None:
+                    deferred_prestart += 1
             except Exception:
                 traceback.print_exc()
                 print("ray_tpu: worker prestart failed; first tasks will "
                       "pay cold-start latency", file=sys.stderr)
                 break
+        if deferred_prestart:
+            # Zygote was mid-warmup at init: finish the warm pool the
+            # moment it is READY (prestart isn't dispatch-driven, so the
+            # on_ready dispatch kick alone wouldn't respawn these).
+            def _finish_prestart(n=deferred_prestart):
+                zy = self._zygote()
+                # Wake on SUCCESS or FAILURE: a failed warmup must fall
+                # through to direct Popens in ~1 s, not sit out the
+                # whole window (only success sets _ready).
+                deadline = time.time() + 30
+                while time.time() < deadline:
+                    if zy._ready.is_set() or zy._failed:
+                        break
+                    time.sleep(0.1)
+                for _ in range(n):
+                    if self._shutdown:
+                        return
+                    try:
+                        self.spawn_worker(self.node_id)
+                    except Exception:
+                        return
+
+            threading.Thread(target=_finish_prestart, daemon=True,
+                             name="prestart-finish").start()
 
         # OOM protection: kill-and-retry busy workers under host memory
         # pressure (memory_monitor.py; reference memory_monitor.h:52).
@@ -535,11 +562,18 @@ class Head:
             traceback.print_exc()
 
     def spawn_worker(self, node_id: str,
-                     tpu_capable: bool = False) -> WorkerRecord:
+                     tpu_capable: bool = False) -> "WorkerRecord | None":
         """Start a pool worker on `node_id`: fork locally, or route the
         spawn through the node's agent connection for remote nodes
         (reference analogue: WorkerPool::StartWorkerProcess,
         raylet/worker_pool.h:224; remote = raylet-side pool).
+
+        Returns None when the spawn is DEFERRED: the zygote fork-server
+        is mid-warmup, so instead of a direct interpreter Popen (a burst
+        of which thrashes a small box — 40 actor creations measured 12 s
+        as a Popen storm vs ~1 s deferred-then-forked) the caller should
+        retry on the next dispatch pass; zygote.on_ready sets
+        dispatch_event so that pass happens immediately.
 
         ``tpu_capable`` workers keep any TPU device-plugin startup hooks
         so they can take chip leases; chipless pool workers spawn with
@@ -573,11 +607,14 @@ class Head:
             # Fork from the pre-imported zygote (~5 ms) instead of a
             # fresh interpreter (~300 ms+): reference analogue is the
             # raylet's warm worker pool (worker_pool.h:224).
-            pid = self._zygote().spawn(
+            zy = self._zygote()
+            pid = zy.spawn(
                 {k: env[k] for k in ("RAY_TPU_WORKER_ID", "RAY_TPU_HEAD",
                                      "RAY_TPU_SHM", "RAY_TPU_NODE_ID",
                                      "RAY_TPU_SESSION_DIR")},
                 os.path.join(logs, f"{worker_id}.log"))
+            if pid is None and zy.deferral_active():
+                return None  # warmup imminent; retry next dispatch pass
         if pid is None:
             with open(os.path.join(logs, f"{worker_id}.log"), "ab") as out:
                 proc = subprocess.Popen(
@@ -618,6 +655,9 @@ class Head:
             strip_plugin_hooks(env)
             zy = self._zygote_client = ZygoteClient(
                 env, os.path.join(self.session_dir, "logs"))
+            # Deferred spawns retry the moment warmup lands (or fails —
+            # then they fall back to direct Popens on the next pass).
+            zy.on_ready = self.dispatch_event.set
         return zy
 
     def _spawn_remote_worker(self, node_id: str,
@@ -2878,6 +2918,9 @@ class Head:
         reused = rec is not None
         if not reused:
             rec = self.spawn_worker(node.node_id, tpu_capable=need_tpu)
+            if rec is None:
+                return  # spawn deferred (zygote warming); actor stays
+                # PENDING_CREATION and the on_ready dispatch retries
         rec.actor_id = spec.actor_id
         if not self._try_allocate(rec, node.node_id, spec.resources, spec.scheduling_strategy):
             if reused:

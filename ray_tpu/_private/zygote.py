@@ -68,6 +68,29 @@ def main() -> None:
         sys.stdout.flush()
 
 
+def _read_line_bounded(fd: int, timeout_s: float) -> str:
+    """Read one newline-terminated line from a raw fd within a
+    deadline; raises TimeoutError on ANY stall, including mid-line."""
+    import select
+    import time
+
+    deadline = time.monotonic() + timeout_s
+    buf = b""
+    while True:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            raise TimeoutError("zygote fork reply timed out")
+        r, _, _ = select.select([fd], [], [], remaining)
+        if not r:
+            raise TimeoutError("zygote fork reply timed out")
+        chunk = os.read(fd, 4096)
+        if not chunk:
+            raise EOFError("zygote closed its stdout")
+        buf += chunk
+        if b"\n" in buf:
+            return buf.split(b"\n", 1)[0].decode()
+
+
 class ZygoteClient:
     """Lazily starts and talks to one zygote process. Thread-safe.
     ``spawn`` returns the worker pid, or None when the zygote path is
@@ -79,17 +102,21 @@ class ZygoteClient:
         self._proc: subprocess.Popen | None = None
         # _lock guards the request channel + published state and is only
         # ever held for FAST operations (state flips, one fork
-        # round-trip). The slow warmup (Popen + READY readline) runs in
-        # a dedicated thread holding NO lock — state is published under
-        # _lock only at the end. start_async()/spawn() therefore never
-        # block on a warmup in flight, and a hung zygote child can wedge
-        # only its own warmup thread, never the dispatch path.
+        # round-trip — bounded by _REPLY_TIMEOUT_S via select, so a
+        # zygote that accepts a request and never replies costs at most
+        # that before being declared dead). The slow warmup (Popen +
+        # READY readline) runs in a dedicated thread holding NO lock —
+        # state is published under _lock only at the end, and
+        # ``on_ready`` fires (also lock-free) so the head's dispatch
+        # loop can immediately retry spawns it deferred.
         self._lock = threading.Lock()
         self._failed = False
         self._stopped = False
         self._ready = threading.Event()
         self._warming = False
         self._warm_started_at: "float | None" = None
+        self._direct_spawns_this_warmup = 0
+        self.on_ready: "Callable[[], None] | None" = None
 
     def start_async(self) -> None:
         """Warm the zygote off the caller's thread: callers that hold
@@ -108,6 +135,7 @@ class ZygoteClient:
             # a re-warm after a zygote death needs its own full grace
             # window or burst callers all fall back to Popen storms.
             self._warm_started_at = time.monotonic()
+            self._direct_spawns_this_warmup = 0
         threading.Thread(target=self._warmup, daemon=True,
                          name="zygote-warmup").start()
 
@@ -139,6 +167,9 @@ class ZygoteClient:
             with self._lock:
                 self._failed = True
                 self._warming = False
+            cb = self.on_ready
+            if cb is not None:
+                cb()  # deferred spawns must retry (and fall back) NOW
             return
         with self._lock:
             self._warming = False
@@ -152,32 +183,48 @@ class ZygoteClient:
                 return
             self._proc = proc
             self._ready.set()
+        cb = self.on_ready
+        if cb is not None:
+            cb()
+
+    def deferral_active(self) -> bool:
+        """True when a spawn arriving mid-warmup should be DEFERRED
+        (retried on ``on_ready``) instead of falling back to a direct
+        Popen. Policy: the first few spawns of a warmup window go direct
+        — a small cold cluster must not wait out the zygote import just
+        to run 4 parallel tasks — but a BURST beyond that budget defers:
+        N concurrent interpreter starts thrash a small box (measured: 40
+        actor creations = 12 s as a Popen storm vs ~1 s deferred-then-
+        forked). The caller (the head's dispatch loop) never blocks a
+        lock waiting either way. Calling this counts one direct spawn
+        against the window's budget when it returns False."""
+        import time
+
+        if self._ready.is_set() or self._failed or self._stopped:
+            return False
+        budget = int(os.environ.get("RAY_TPU_ZYGOTE_DIRECT_SPAWN_BUDGET",
+                                    "4"))
+        grace = float(os.environ.get("RAY_TPU_ZYGOTE_SPAWN_GRACE_S", "6"))
+        with self._lock:
+            if not self._warming or self._warm_started_at is None:
+                return False
+            if time.monotonic() >= self._warm_started_at + grace:
+                return False
+            if self._direct_spawns_this_warmup < budget:
+                self._direct_spawns_this_warmup += 1
+                return False
+            return True
+
+    _REPLY_TIMEOUT_S = 10.0  # fork replies take ~5 ms; 10 s = dead
 
     def spawn(self, extra_env: dict, log_path: str) -> "int | None":
+        """Never blocks on warmup: returns None when the zygote is not
+        READY. Callers check ``deferral_active()`` to decide between
+        deferring (warmup imminent) and a direct-Popen fallback."""
         if not self._ready.is_set():
-            if self._failed or self._stopped:
-                return None
-            # Not warmed yet (or died): re-warm in the background. A
-            # burst of spawns during warmup used to ALL fall back to
-            # direct Popens — on a small box, N concurrent interpreter
-            # starts thrash each other (measured: 40 actor creations =
-            # 12 s cold vs 0.7 s warm). Instead, wait for READY within
-            # a grace window anchored at warmup START (not per-call, so
-            # a serial caller like the dispatch loop stalls at most
-            # `grace` total across the whole burst), then fall back.
-            import time
-
-            self.start_async()
-            with self._lock:
-                started = self._warm_started_at
-            if started is not None:
-                grace = float(os.environ.get(
-                    "RAY_TPU_ZYGOTE_SPAWN_GRACE_S", "6"))
-                remaining = started + grace - time.monotonic()
-                if remaining > 0:
-                    self._ready.wait(remaining)
-            if not self._ready.is_set():
-                return None
+            if not self._failed and not self._stopped:
+                self.start_async()
+            return None
         rewarm = False
         pid = None
         with self._lock:
@@ -193,7 +240,16 @@ class ZygoteClient:
                         json.dumps({"env": extra_env,
                                     "log": log_path}) + "\n")
                     self._proc.stdin.flush()
-                    reply = self._proc.stdout.readline()
+                    # Bounded read: a zygote that accepted the request
+                    # but never replies (or stalls mid-line) must not
+                    # wedge this lock (and the head dispatch thread
+                    # behind it) forever. Raw-fd select+read loop up to
+                    # the deadline — a buffered readline would block
+                    # past select() on a PARTIAL line. The warmup
+                    # readline consumed exactly the READY line, so the
+                    # buffered reader holds no reply bytes.
+                    reply = _read_line_bounded(
+                        self._proc.stdout.fileno(), self._REPLY_TIMEOUT_S)
                     pid = int(json.loads(reply)["pid"])
                 except Exception:
                     # Zygote died mid-request: restart attempt next call.

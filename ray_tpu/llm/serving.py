@@ -158,6 +158,50 @@ class LLMServer:
             return await self.completions(payload)
         return self.models()
 
+    async def route_request(self, path: str, payload: Any = None) -> dict:
+        """Path-aware dispatch (the proxy passes the subpath below the
+        route prefix — real OpenAI URL routing instead of payload-shape
+        inference; reference: serve router URL dispatch +
+        vLLM's /tokenize /detokenize API)."""
+        payload = payload if isinstance(payload, dict) else {}
+        p = path.rstrip("/")
+        if p.endswith("/chat/completions"):
+            return await self.chat(payload)
+        if p.endswith("/completions"):
+            return await self.completions(payload)
+        if p.endswith("/models"):
+            return self.models()
+        if p.endswith("/tokenize"):
+            return self.tokenize(payload)
+        if p.endswith("/detokenize"):
+            return self.detokenize(payload)
+        if p.endswith("/load_lora_adapter"):
+            return self.load_lora_adapter(payload)
+        if p.endswith("/unload_lora_adapter"):
+            return self.unload_lora_adapter(payload)
+        # Unknown subpath: fall back to shape dispatch (old clients).
+        return await self.__call__(payload)
+
+    def tokenize(self, payload: dict) -> dict:
+        """vLLM-compatible POST /tokenize: {"prompt"} -> token ids
+        (chat form renders the messages through the chat template
+        first)."""
+        if "messages" in payload:
+            text = self._render_chat(payload["messages"])
+        else:
+            text = payload.get("prompt", "")
+        add_special = bool(payload.get("add_special_tokens", True))
+        tok = self.engine.tokenizer
+        ids = (list(tok.encode(text)) if add_special
+               else _encode_plain(tok, text))
+        return {"tokens": ids, "count": len(ids),
+                "max_model_len": self.config.max_seq_len}
+
+    def detokenize(self, payload: dict) -> dict:
+        """vLLM-compatible POST /detokenize: {"tokens"} -> text."""
+        ids = [int(t) for t in payload.get("tokens", [])]
+        return {"prompt": self.engine.tokenizer.decode(ids)}
+
     async def stream_events(self, payload: Any = None):
         """OpenAI streaming protocol handler (``"stream": true``): an
         async generator of chunk objects, terminated by the literal

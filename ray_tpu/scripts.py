@@ -268,6 +268,41 @@ def cmd_serve(args) -> int:
             print(f"  {name}: {info['running_replicas']}/"
                   f"{info['target_replicas']} replicas")
         return 0
+    if args.serve_cmd == "run":
+        # `serve run pkg.mod:app` (reference: serve/scripts.py run —
+        # deploy an import path; `:` splits module from attribute).
+        import importlib
+
+        target = args.import_path
+        mod_name, _, attr = target.partition(":")
+        if not attr:
+            raise SystemExit(
+                f"import path must be 'module:attribute', got {target!r}")
+        from ray_tpu.serve.deployment import Application, Deployment
+
+        app = getattr(importlib.import_module(mod_name), attr)
+        if not isinstance(app, (Application, Deployment)) and callable(app):
+            # A builder function (e.g. build_openai_app-style) — only
+            # zero-arg builders are runnable from the CLI.
+            import inspect as _inspect
+
+            sig = _inspect.signature(app)
+            required = [p for p in sig.parameters.values()
+                        if p.default is p.empty
+                        and p.kind not in (p.VAR_POSITIONAL, p.VAR_KEYWORD)]
+            if required:
+                raise SystemExit(
+                    f"{target!r} is a builder with required arguments; "
+                    "deploy it via a config file instead")
+            app = app()
+        serve.run(app, route_prefix=args.route_prefix)
+        print(f"running {target}")
+        if args.blocking:
+            import time as _time
+
+            while True:
+                _time.sleep(3600)
+        return 0
     if args.serve_cmd == "status":
         print(json.dumps(serve.status(), indent=2, default=str))
         return 0
@@ -372,6 +407,11 @@ def main(argv: list[str] | None = None) -> int:
     v = ssub.add_parser("deploy")
     v.add_argument("--address", required=True)
     v.add_argument("config_file")
+    v = ssub.add_parser("run", help="deploy an import path (module:app)")
+    v.add_argument("--address", required=True)
+    v.add_argument("--route-prefix", default=None)
+    v.add_argument("--blocking", action="store_true")
+    v.add_argument("import_path")
     for name in ("status", "shutdown"):
         v = ssub.add_parser(name)
         v.add_argument("--address", required=True)

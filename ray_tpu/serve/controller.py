@@ -100,10 +100,17 @@ class ServeController:
             }
 
     def get_routes(self) -> dict[str, dict]:
-        """prefix -> {"name", "sse_method"}. ``sse_method`` names an
-        async-generator method the HTTP proxy should dispatch
-        Accept: text/event-stream requests to (e.g. the OpenAI
-        ``stream_events`` protocol handler); None = stream __call__."""
+        """prefix -> {"name", "sse_method", "ws_method", "ws_stream"}.
+
+        ``sse_method`` names an async-generator method the HTTP proxy
+        should dispatch Accept: text/event-stream requests to (e.g. the
+        OpenAI ``stream_events`` protocol handler); None = stream
+        __call__. ``ws_method`` names a ``ws_message`` handler that
+        makes the route WebSocket-upgradable (reference: serve's
+        FastAPI websocket ingress — serve/_private/http_util.py ASGI
+        passthrough); ``ws_stream`` is True when it is an async
+        generator (each yielded item becomes one outbound frame per
+        inbound message)."""
         import inspect
 
         with self._lock:
@@ -117,8 +124,19 @@ class ServeController:
                 if cls is not None and inspect.isasyncgenfunction(
                         getattr(cls, "stream_events", None)):
                     sse = "stream_events"
-                routes[prefix] = {"name": st.spec["name"],
-                                  "sse_method": sse}
+                ws = getattr(cls, "ws_message", None) if cls else None
+                pathm = getattr(cls, "route_request", None) if cls else None
+                routes[prefix] = {
+                    "name": st.spec["name"],
+                    "sse_method": sse,
+                    "ws_method": "ws_message" if callable(ws) else None,
+                    "ws_stream": bool(ws) and inspect.isasyncgenfunction(ws),
+                    # Path-aware ingress (reference: real URL routing in
+                    # the serve ASGI app): non-streaming requests go to
+                    # route_request(subpath, payload) when declared.
+                    "path_method": "route_request" if callable(pathm)
+                    else None,
+                }
             return routes
 
     def status(self) -> dict:

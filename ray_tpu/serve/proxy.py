@@ -109,6 +109,9 @@ class HTTPProxy:
                 return web.json_response(
                     {"error": f"no route for {path}"}, status=404
                 )
+            if (request.headers.get("Upgrade", "").lower() == "websocket"
+                    and meta.get("ws_method")):
+                return await self._handle_ws(web, request, handle_, meta)
             wants_sse = ("text/event-stream" in request.headers.get("Accept", "")
                          or (isinstance(payload, dict)
                              and payload.get("stream") is True
@@ -131,8 +134,20 @@ class HTTPProxy:
                 # proxy). Concurrency is bounded by memory, not pool
                 # size.
                 loop = asyncio.get_running_loop()
-                resp_obj = await loop.run_in_executor(
-                    None, lambda: handle_.remote(payload))
+                if meta.get("path_method"):
+                    # Path-aware deployment: it receives the subpath
+                    # below its route prefix plus the payload — real
+                    # URL routing (reference: serve's ASGI app routes).
+                    prefix = meta.get("_prefix", "/")
+                    sub = path[len(prefix):] if prefix != "/" else path
+                    sub = sub or "/"
+                    resp_obj = await loop.run_in_executor(
+                        None, lambda: handle_.options(
+                            method_name=meta["path_method"]).remote(
+                                sub, payload))
+                else:
+                    resp_obj = await loop.run_in_executor(
+                        None, lambda: handle_.remote(payload))
                 result = await resp_obj._result_async(timeout_s=30.0)
             except Exception as e:  # noqa: BLE001 — surface to the client
                 return web.json_response({"error": str(e)}, status=500)
@@ -198,14 +213,79 @@ class HTTPProxy:
                 gen.close()
         return resp
 
+    async def _handle_ws(self, web, request, handle_, meta):
+        """WebSocket ingress (reference: serve's FastAPI websocket
+        routes through the ASGI proxy; here the deployment declares a
+        ``ws_message`` handler). Per inbound frame: JSON-decode when
+        possible, dispatch to the replica, and send the reply — every
+        yielded item of an async-generator handler becomes one outbound
+        frame, so token-streaming chat works over one socket. The
+        connection closes when the client closes; a replica error
+        surfaces as an error frame, not a dropped socket."""
+        from aiohttp import WSMsgType
+
+        loop = asyncio.get_running_loop()
+        ws = web.WebSocketResponse()
+        await ws.prepare(request)
+        method = meta["ws_method"]
+        streaming = bool(meta.get("ws_stream"))
+        async for msg in ws:
+            if msg.type in (WSMsgType.CLOSE, WSMsgType.CLOSING,
+                            WSMsgType.ERROR):
+                break
+            if msg.type == WSMsgType.BINARY:
+                # The contract is one reply per inbound frame — an
+                # unsupported frame type gets an error reply, never
+                # silence (the client would block on its receive).
+                try:
+                    await ws.send_str(json.dumps(
+                        {"error": "binary frames not supported; "
+                                  "send JSON text frames"}))
+                except Exception:
+                    break
+                continue
+            if msg.type != WSMsgType.TEXT:
+                continue  # ping/pong handled by aiohttp
+            try:
+                payload = json.loads(msg.data)
+            except json.JSONDecodeError:
+                payload = msg.data
+            gen = None
+            try:
+                if streaming:
+                    gen = await loop.run_in_executor(
+                        None, lambda: handle_.options(
+                            stream=True, method_name=method).remote(payload))
+                    async for item in gen:
+                        await ws.send_str(json.dumps(item, default=str))
+                else:
+                    resp_obj = await loop.run_in_executor(
+                        None, lambda: handle_.options(
+                            method_name=method).remote(payload))
+                    result = await resp_obj._result_async(timeout_s=30.0)
+                    await ws.send_str(json.dumps(result, default=str))
+            except Exception as e:  # noqa: BLE001 — surface per-frame
+                try:
+                    await ws.send_str(json.dumps({"error": str(e)}))
+                except Exception:
+                    break  # client gone mid-reply
+            finally:
+                if gen is not None and hasattr(gen, "close"):
+                    gen.close()
+        return ws
+
     def _match_route(self, path: str) -> "dict | None":
-        # Longest-prefix match (reference: proxy route matching).
-        best, best_len = None, -1
+        # Longest-prefix match (reference: proxy route matching). The
+        # matched prefix rides along so path-aware deployments receive
+        # the subpath below their mount point.
+        best, best_len, best_prefix = None, -1, "/"
         for prefix, meta in self._routes.items():
             p = prefix.rstrip("/") or "/"
             if (path == p or path.startswith(p + "/") or p == "/") and len(p) > best_len:
-                best, best_len = meta, len(p)
-        return best
+                best, best_len, best_prefix = meta, len(p), p
+        if best is None:
+            return None
+        return {**best, "_prefix": best_prefix}
 
     @staticmethod
     def _encode(web, result: Any):

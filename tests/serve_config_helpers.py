@@ -17,3 +17,8 @@ class Chain:
     def __call__(self, x):
         doubled = self.inner.remote(x).result()
         return doubled + 1
+
+
+def doubler_app():
+    """Zero-arg builder for `ray-tpu serve run` tests."""
+    return Doubler.bind()

@@ -157,3 +157,16 @@ def test_serve_deploy_status_shutdown(cli_head, tmp_path):
     st2 = _cli("serve", "status", "--address", cli_head)
     assert st2.returncode == 0
     assert json.loads(st2.stdout) == {}
+
+
+def test_serve_run_import_path(cli_head):
+    """`ray-tpu serve run module:attr` (reference: serve/scripts.py run)
+    deploys a zero-arg builder or a bound app by import path."""
+    out = _cli("serve", "run", "--address", cli_head,
+               "tests.serve_config_helpers:doubler_app")
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "running" in out.stdout
+    st = _cli("serve", "status", "--address", cli_head)
+    assert "Doubler" in st.stdout
+    down = _cli("serve", "shutdown", "--address", cli_head)
+    assert down.returncode == 0

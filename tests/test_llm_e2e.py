@@ -68,6 +68,15 @@ def test_openai_http_endpoints():
         r = json.loads(resp.read())
     assert r["object"] == "list"
 
+    # Path-aware routing: /tokenize and /detokenize roundtrip
+    # (reference: vLLM tokenize API; proxy passes the subpath so
+    # {"prompt"} at /tokenize is NOT treated as a completion request).
+    t = _post(f"{base}/tokenize", {"prompt": "hello world"})
+    assert t["count"] == len(t["tokens"]) > 0
+    assert "max_model_len" in t
+    d = _post(f"{base}/detokenize", {"tokens": t["tokens"]})
+    assert "hello world" in d["prompt"]
+
 
 def test_batch_inference_over_dataset():
     import ray_tpu.data as rdata

@@ -1,0 +1,144 @@
+"""WebSocket ingress (reference: serve's FastAPI websocket routes via
+the ASGI proxy — here a deployment's ``ws_message`` handler makes its
+route upgradable; async-generator handlers stream one frame per yielded
+item)."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _cluster():
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4, object_store_memory=64 * 1024 * 1024)
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+@serve.deployment
+class EchoWS:
+    def __call__(self, payload):
+        return {"via": "http", "got": payload}
+
+    async def ws_message(self, message):
+        if isinstance(message, dict):
+            return {"via": "ws", "sum": message.get("a", 0) + message.get("b", 0)}
+        return {"via": "ws", "echo": message}
+
+
+@serve.deployment
+class TokenStreamWS:
+    async def ws_message(self, message):
+        for tok in str(message.get("text", "")).split():
+            yield {"token": tok}
+        yield {"done": True}
+
+
+def _ws_roundtrip(port, path, sends, expect_per_send=1):
+    """Connect, send each payload, collect replies."""
+    import aiohttp
+
+    async def go():
+        out = []
+        async with aiohttp.ClientSession() as sess:
+            async with sess.ws_connect(
+                    f"http://127.0.0.1:{port}{path}") as ws:
+                for payload in sends:
+                    await ws.send_str(json.dumps(payload))
+                    for _ in range(expect_per_send):
+                        msg = await asyncio.wait_for(ws.receive(), timeout=60)
+                        out.append(json.loads(msg.data))
+        return out
+
+    return asyncio.new_event_loop().run_until_complete(go())
+
+
+def test_ws_request_response_and_http_coexist():
+    serve.run(EchoWS.bind(), route_prefix="/echo")
+    port = serve.get_proxy_port()
+
+    replies = _ws_roundtrip(port, "/echo",
+                            [{"a": 2, "b": 3}, {"a": 10, "b": 1}])
+    assert replies == [{"via": "ws", "sum": 5}, {"via": "ws", "sum": 11}]
+
+    # The same route still answers plain HTTP POSTs via __call__.
+    import urllib.request
+
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/echo",
+        data=json.dumps({"x": 1}).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=60) as r:
+        body = json.loads(r.read())
+    assert body["via"] == "http"
+    serve.delete("EchoWS")
+
+
+def test_ws_streaming_handler_one_frame_per_item():
+    serve.run(TokenStreamWS.bind(), route_prefix="/stream")
+    port = serve.get_proxy_port()
+    replies = _ws_roundtrip(port, "/stream",
+                            [{"text": "to the moon"}], expect_per_send=4)
+    assert replies[:3] == [{"token": "to"}, {"token": "the"},
+                           {"token": "moon"}]
+    assert replies[3] == {"done": True}
+    serve.delete("TokenStreamWS")
+
+
+def test_ws_binary_frame_gets_error_reply():
+    """One reply per inbound frame even for unsupported types: a binary
+    frame gets an error frame back, never silence (the client would
+    otherwise block on its receive)."""
+    serve.run(EchoWS.bind(), route_prefix="/binecho")
+    port = serve.get_proxy_port()
+    import aiohttp
+
+    async def go():
+        async with aiohttp.ClientSession() as sess:
+            async with sess.ws_connect(
+                    f"http://127.0.0.1:{port}/binecho") as ws:
+                await ws.send_bytes(b"\x00\x01")
+                err = json.loads(
+                    (await asyncio.wait_for(ws.receive(), 30)).data)
+                # The socket stays usable for text frames afterwards.
+                await ws.send_str(json.dumps({"a": 1, "b": 1}))
+                ok = json.loads(
+                    (await asyncio.wait_for(ws.receive(), 30)).data)
+        return err, ok
+
+    err, ok = asyncio.new_event_loop().run_until_complete(go())
+    assert "error" in err and "binary" in err["error"]
+    assert ok == {"via": "ws", "sum": 2}
+    serve.delete("EchoWS")
+
+
+def test_ws_upgrade_without_handler_is_rejected():
+    @serve.deployment
+    class PlainHTTP:
+        def __call__(self, payload):
+            return {"plain": True}
+
+    serve.run(PlainHTTP.bind(), route_prefix="/plain")
+    port = serve.get_proxy_port()
+    import aiohttp
+
+    async def go():
+        async with aiohttp.ClientSession() as sess:
+            try:
+                async with sess.ws_connect(
+                        f"http://127.0.0.1:{port}/plain"):
+                    return "connected"
+            except aiohttp.WSServerHandshakeError:
+                return "rejected"
+
+    assert asyncio.new_event_loop().run_until_complete(go()) == "rejected"
+    serve.delete("PlainHTTP")
