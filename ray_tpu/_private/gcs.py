@@ -121,7 +121,7 @@ class WorkerRecord:
         "worker_id", "node_id", "conn", "proc", "pid", "busy", "actor_id",
         "inflight", "started_at", "tpu_chips", "acquired", "ready", "pg_alloc",
         "tpu_capable", "cur_rkey", "zygote", "env_key", "blocked",
-        "released_alloc",
+        "released_alloc", "retiring",
     )
 
     def __init__(self, worker_id: str, node_id: str, proc,
@@ -144,6 +144,12 @@ class WorkerRecord:
         self.acquired: ResourceSet | None = None
         self.pg_alloc: tuple[str, int, ResourceSet] | None = None  # (pg_id, bundle, demand)
         self.ready = False  # set by worker_ready (two-phase registration)
+        # max_calls handshake: the worker asked to exit; no new work is
+        # dispatched to it, and the head releases it (exit_worker cast)
+        # once every pending owner-seal confirmation has landed — an
+        # immediate exit would strand just-delivered results as "lost"
+        # and re-execute their tasks through lineage recovery.
+        self.retiring = False
         # Resource-shape key of the normal task(s) currently allocated to
         # this worker — same-shape tasks may pipeline onto it (bounded
         # inflight window) without extra allocation: execution is serial,
@@ -1148,6 +1154,8 @@ class Head:
             s = self._worker_pending_seals.get(w)
             if s:
                 s.discard(object_id)
+                if not s:
+                    self._maybe_release_retiree(w)
         if entry.inline is not None:
             # A death-backstop error seal raced the owner confirmation:
             # keep the inline error (at-least-once semantics; the owner-
@@ -1536,6 +1544,8 @@ class Head:
             s = self._worker_pending_seals.get(w)
             if s:
                 s.discard(entry.object_id)
+                if not s:
+                    self._maybe_release_retiree(w)
         # The container is gone: release its containment pins so the
         # embedded objects can free (possibly cascading through nested
         # containers).
@@ -1884,6 +1894,8 @@ class Head:
                 rec.busy = False
                 self._release_worker_allocation(rec)
                 need_dispatch = True
+                if rec.retiring:
+                    self._maybe_release_retiree(rec.worker_id)
             elif len(rec.inflight) <= 2:
                 need_dispatch = True
         else:
@@ -2449,6 +2461,40 @@ class Head:
                              name="stop-cluster").start()
         return {"stopping": True, "agents": len(agents)}
 
+    def _h_worker_retiring(self, body, conn):
+        """max_calls worker recycling, phase 1 (reference: the worker's
+        graceful Disconnect handshake with its raylet): mark the worker
+        retiring — nothing new dispatches to it — and release it the
+        moment its delivered results are all owner-confirmed."""
+        with self.lock:
+            rec = self.workers.get(body["worker_id"])
+            if rec is None:
+                return None
+            if rec.actor_id is not None:
+                # The dispatcher converted this worker to an actor in
+                # the window before the retiring cast arrived: the
+                # retirement is void (the worker cancels its side on
+                # become_actor) — killing a live actor would burn its
+                # restart budget.
+                return None
+            rec.retiring = True
+            self._maybe_release_retiree(rec.worker_id)
+        return None
+
+    def _maybe_release_retiree(self, worker_id: str) -> None:
+        """lock held. Phase 2: every pending owner-seal confirmed and
+        nothing inflight -> tell the worker it may exit."""
+        rec = self.workers.get(worker_id)
+        if rec is None or not rec.retiring or rec.actor_id is not None:
+            return
+        if rec.inflight or self._worker_pending_seals.get(worker_id):
+            return
+        if rec.conn is not None:
+            try:
+                rec.conn.cast("exit_worker", {})
+            except rpc.ConnectionLost:
+                pass
+
     def _h_store_stats(self, body, conn):
         with self.lock:
             return {
@@ -2804,6 +2850,7 @@ class Head:
                 and rec.ready
                 and rec.actor_id is None
                 and not rec.tpu_capable
+                and not rec.retiring
                 and rec.cur_rkey == key
                 and rec.acquired is not None
                 and 0 < len(rec.inflight) < self.PIPELINE_DEPTH
@@ -2830,6 +2877,7 @@ class Head:
                 and rec.ready
                 and not rec.busy
                 and rec.actor_id is None
+                and not rec.retiring
                 and rec.tpu_capable == need_tpu
             ):
                 if rec.env_key == env_key:

@@ -51,6 +51,11 @@ class TaskSpec:
     # immediately after a fire-and-forget submit (reference:
     # reference_count.h serialized-in-task-args borrows).
     borrowed_ids: list = dataclasses.field(default_factory=list)
+    # Worker recycling (reference: @ray.remote(max_calls=N),
+    # remote_function.py — the worker process exits after executing N
+    # calls of this function; the standard lever against native-memory
+    # leaks/fragmentation, e.g. XLA device allocator churn). 0 = never.
+    max_calls: int = 0
     # Scratch attributes the head/worker hang off a spec in flight —
     # declared because the dataclass uses __slots__ (a 1M-task backlog
     # at ~1 KB/dict-backed spec would cost a GB of pure dict overhead;
@@ -149,6 +154,7 @@ def pack_spec(spec: "TaskSpec") -> "bytes | None":
             spec.actor_id, bool(spec.actor_creation), spec.method_name,
             spec.seq_no, spec.concurrency_group,
             list(spec.borrowed_ids or ()),
+            spec.max_calls,
         ))
     except (TypeError, ValueError, OverflowError):
         return None  # exotic field value: pickle fallback

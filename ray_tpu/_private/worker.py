@@ -105,6 +105,10 @@ class Worker:
                                               thread_name_prefix="actor-store")
         self._exit = threading.Event()
         self._cancelled_ids: set[str] = set()
+        # Per-function execution counts for @remote(max_calls=N) worker
+        # recycling (reference: remote_function.py max_calls — the
+        # standard lever against native-memory leaks/fragmentation).
+        self._calls_by_func: dict[str, int] = {}
         # Normal-task fast path: pushes land in this deque and ONE
         # drainer job runs them serially — a Future + work-item per task
         # (~20 us of executor machinery) is pure overhead when the head
@@ -140,6 +144,14 @@ class Worker:
     # ------------------------------------------------------------------
 
     def _on_message(self, kind: str, body: dict):
+        if kind == "exit_worker":
+            # max_calls handshake phase 2: every delivered result is
+            # owner-confirmed; safe to recycle this process.
+            t = getattr(self, "_retire_timer", None)
+            if t is not None:
+                t.cancel()
+            self._exit.set()
+            return
         if kind == "push_task":
             from ray_tpu._private.task_spec import spec_from_body
 
@@ -164,6 +176,15 @@ class Worker:
                 self._executor_for(spec).submit(
                     self._run_task_guarded, spec, body.get("tpu_chips"))
         elif kind == "become_actor":
+            # An actor conversion reprieves any pending max_calls
+            # retirement (the head ignores worker_retiring from actor
+            # workers; the local timer must not kill the live actor).
+            t = getattr(self, "_retire_timer", None)
+            if t is not None:
+                t.cancel()
+                self._retire_timer = None
+            self._retiring_sent = False
+            self._recycle_pending = False
             self.actor_id = body["actor_id"]
             # Actor-lifetime env: actor METHOD tasks carry no runtime_env
             # of their own; nested submissions inherit the creation env.
@@ -519,6 +540,7 @@ class Worker:
             )
         except Exception:
             pass
+        self._count_call(spec)
 
     async def _run_task_async(self, spec: TaskSpec) -> bool:
         """Async-actor method execution: coroutines await on the loop;
@@ -739,6 +761,52 @@ class Worker:
                     self.runtime.conn.flush_casts()
             except Exception:
                 pass
+            self._count_call(spec)
+
+    def _count_call(self, spec: TaskSpec) -> None:
+        """@remote(max_calls=N): after the Nth completed call of a
+        function, this worker exits — results were already delivered
+        and sealed, so the head sees a clean death with no inflight
+        work. Pipelined tasks already queued on this worker DRAIN
+        first (a max_retries=0 task must never be lost to a recycle);
+        fresh processes replace it through the normal pool path."""
+        mc = getattr(spec, "max_calls", 0)
+        if mc:
+            n = self._calls_by_func.get(spec.func_id, 0) + 1
+            self._calls_by_func[spec.func_id] = n
+            if n >= mc:
+                self._recycle_pending = True
+        if not getattr(self, "_recycle_pending", False) \
+                or getattr(self, "_retiring_sent", False):
+            return
+        try:
+            if self._task_q or not self._executor_for(spec)._work_queue.empty():
+                return  # drain the pipeline window first
+            self._flush_seals()
+            self.runtime.conn.flush_casts()
+            # Handshake, not immediate exit: dying before the OWNER
+            # confirms the just-delivered results would make the head
+            # treat them as lost-with-the-worker and re-execute the
+            # tasks through lineage recovery (observed as double
+            # execution). The head stops dispatching to us now and
+            # casts exit_worker once every pending seal is confirmed;
+            # the timer is the backstop against a head that never
+            # answers (kill -9 mid-handshake).
+            self._retiring_sent = True
+            self.runtime.conn.cast("worker_retiring",
+                                   {"worker_id": self.worker_id})
+            # Long LEAK backstop only — not a liveness mechanism. A
+            # live head always answers with exit_worker (and a dead
+            # head's conn-close already os._exits us); a short timer
+            # would re-create the exit-before-seal-confirm double
+            # execution whenever an owner confirms slowly. Daemon +
+            # cancellable: it must neither pin the dying process open
+            # nor fire after an actor conversion reprieves us.
+            self._retire_timer = threading.Timer(120.0, self._exit.set)
+            self._retire_timer.daemon = True
+            self._retire_timer.start()
+        except Exception:
+            self._exit.set()  # can't reach the head: just go
 
     def _run_task(self, spec: TaskSpec, tpu_chips) -> bool:
         """Returns True on success. Stores results/errors for return ids."""

@@ -116,6 +116,7 @@ class RemoteFunction:
                 int(opts.get("max_retries",
                              GLOBAL_CONFIG.task_max_retries_default)),
                 opts.get("scheduling_strategy"),
+                int(opts.get("max_calls", 0)),
             )
         return inv
 
@@ -126,8 +127,8 @@ class RemoteFunction:
         api.auto_init()
         rt = global_runtime()
         opts = self._opts
-        streaming, num_returns, name, resources, max_retries, strategy = (
-            self._invariants())
+        (streaming, num_returns, name, resources, max_retries, strategy,
+         max_calls) = self._invariants()
         func_id = rt.register_function(self._fn)
         packed, deps, borrowed = rt.pack_args(args, kwargs)
         return_ids = [fast_hex_id() for _ in range(num_returns)]
@@ -145,6 +146,7 @@ class RemoteFunction:
             scheduling_strategy=strategy,
             runtime_env=_pack_env(opts.get("runtime_env"), rt),
             streaming=streaming,
+            max_calls=max_calls,
         )
         rt.submit_task(spec)
         if streaming:

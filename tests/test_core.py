@@ -498,3 +498,23 @@ def test_accelerator_manager_vendors(monkeypatch):
 
     monkeypatch.setenv("ONEAPI_DEVICE_SELECTOR", "level_zero:0,1")
     assert IntelGPUAcceleratorManager.get_current_node_num_accelerators() == 2
+
+
+def test_max_calls_recycles_worker(cluster):
+    """@remote(max_calls=N): the executing worker exits after N
+    completed calls of that function and a fresh process replaces it
+    (reference: remote_function.py max_calls — the lever against
+    native-memory leaks). All results still arrive."""
+    import os as _os
+
+    @ray_tpu.remote(max_calls=2)
+    def pid():
+        return _os.getpid()
+
+    pids = [ray_tpu.get(pid.remote(), timeout=60) for _ in range(6)]
+    # 6 calls at max_calls=2 => at least 3 distinct processes.
+    assert len(set(pids)) >= 3, pids
+    # No two consecutive pairs share beyond the budget.
+    from collections import Counter
+
+    assert max(Counter(pids).values()) <= 2, pids

@@ -344,10 +344,11 @@ def test_dashboard_task_and_actor_drilldown():
             _get(port, "/api/tasks/nonexistent")
         assert ei.value.code == 404
 
-        # SPA carries the drill-down wiring.
+        # SPA carries the drill-down wiring + embedded metrics charts.
         ui = _get(port, "/")
         assert "/api/tasks/" in ui and "/api/actors/" in ui
         assert "taskId" in ui and "actorId" in ui
+        assert 'data-view="metrics"' in ui and "spark(" in ui
     finally:
         stop_dashboard()
 
