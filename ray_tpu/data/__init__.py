@@ -79,3 +79,8 @@ __all__ = [
     "from_arrow_refs",
     "from_tf",
 ]
+
+# Preprocessors ride the package namespace like the reference's
+# ray.data.preprocessors (fit via Dataset aggregates, transform via
+# map_batches).
+from ray_tpu.data import preprocessors  # noqa: E402,F401

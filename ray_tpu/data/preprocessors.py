@@ -1,0 +1,411 @@
+"""Data preprocessors: stateful fit/transform over Datasets.
+
+Counterpart of the reference's ``ray.data.preprocessors`` package
+(reference: python/ray/data/preprocessor.py:28 Preprocessor ABC +
+preprocessors/{scaler,encoder,imputer,chain,concatenator,normalizer,
+discretizer}.py). Rebuilt numpy-first: fitting runs through the
+Dataset's columnar aggregates, transforms are plain batch functions
+applied via ``map_batches`` — the shape an XLA training pipeline feeds
+from. Fitted state serializes with the object (cloudpickle), so a
+preprocessor fit on a driver travels to Train workers."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+
+class PreprocessorNotFittedException(RuntimeError):
+    """Transform requested before fit (reference: preprocessor.py:21)."""
+
+
+class Preprocessor:
+    """fit/transform over Datasets + transform_batch for serving-time
+    single batches (reference: Preprocessor ABC, preprocessor.py:28).
+
+    Subclasses override ``_fit(dataset)`` (stateful; set
+    ``_is_fittable = False`` for stateless transforms) and
+    ``_transform_batch(batch) -> batch``."""
+
+    _is_fittable = True
+
+    def __init__(self):
+        self._fitted = False
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def fit(self, dataset) -> "Preprocessor":
+        if self._is_fittable:
+            self._fit(dataset)
+        self._fitted = True
+        return self
+
+    def fit_transform(self, dataset):
+        return self.fit(dataset).transform(dataset)
+
+    def transform(self, dataset):
+        self._check_fitted()
+        return dataset.map_batches(self._transform_batch)
+
+    def transform_batch(self, batch: dict) -> dict:
+        """One in-memory columnar batch (serving-time path)."""
+        self._check_fitted()
+        return self._transform_batch({k: np.asarray(v)
+                                      for k, v in batch.items()})
+
+    def _check_fitted(self) -> None:
+        if self._is_fittable and not self._fitted:
+            raise PreprocessorNotFittedException(
+                f"{type(self).__name__} must be fit before transform")
+
+    # -- overrides ---------------------------------------------------------
+
+    def _fit(self, dataset) -> None:
+        raise NotImplementedError
+
+    def _transform_batch(self, batch: dict) -> dict:
+        raise NotImplementedError
+
+
+class Chain(Preprocessor):
+    """Sequential composition; fit stage i on the output of stages <i
+    (reference: preprocessors/chain.py)."""
+
+    def __init__(self, *preprocessors: Preprocessor):
+        super().__init__()
+        self.preprocessors = list(preprocessors)
+        # A chain of stateless members is itself stateless (the
+        # reference derives Chain's fittable state from its members):
+        # a serving path must not need a meaningless fit() call.
+        self._is_fittable = any(p._is_fittable for p in self.preprocessors)
+
+    def _fit(self, dataset) -> None:
+        for p in self.preprocessors:
+            dataset = p.fit(dataset).transform(dataset)
+
+    def transform(self, dataset):
+        self._check_fitted()
+        for p in self.preprocessors:
+            dataset = p.transform(dataset)
+        return dataset
+
+    def transform_batch(self, batch: dict) -> dict:
+        self._check_fitted()
+        for p in self.preprocessors:
+            batch = p.transform_batch(batch)
+        return batch
+
+
+class StandardScaler(Preprocessor):
+    """(x - mean) / std per column (reference: scaler.py StandardScaler).
+    Zero-variance columns scale to 0 (the reference's behavior)."""
+
+    def __init__(self, columns: list[str]):
+        super().__init__()
+        self.columns = list(columns)
+        self.stats_: dict[str, tuple] = {}
+
+    def _fit(self, dataset) -> None:
+        for c in self.columns:
+            vals = dataset._column_values(c).astype(np.float64)
+            self.stats_[c] = (float(vals.mean()), float(vals.std()))
+
+    def _transform_batch(self, batch: dict) -> dict:
+        out = dict(batch)
+        for c in self.columns:
+            mean, std = self.stats_[c]
+            v = np.asarray(batch[c], dtype=np.float64)
+            out[c] = (v - mean) / std if std > 0 else np.zeros_like(v)
+        return out
+
+
+class MinMaxScaler(Preprocessor):
+    """(x - min) / (max - min) per column (reference: scaler.py
+    MinMaxScaler)."""
+
+    def __init__(self, columns: list[str]):
+        super().__init__()
+        self.columns = list(columns)
+        self.stats_: dict[str, tuple] = {}
+
+    def _fit(self, dataset) -> None:
+        for c in self.columns:
+            vals = dataset._column_values(c).astype(np.float64)
+            self.stats_[c] = (float(vals.min()), float(vals.max()))
+
+    def _transform_batch(self, batch: dict) -> dict:
+        out = dict(batch)
+        for c in self.columns:
+            lo, hi = self.stats_[c]
+            v = np.asarray(batch[c], dtype=np.float64)
+            span = hi - lo
+            out[c] = (v - lo) / span if span > 0 else np.zeros_like(v)
+        return out
+
+
+class RobustScaler(Preprocessor):
+    """(x - median) / IQR per column (reference: scaler.py
+    RobustScaler; quantile_range as fractions)."""
+
+    def __init__(self, columns: list[str],
+                 quantile_range: tuple = (0.25, 0.75)):
+        super().__init__()
+        self.columns = list(columns)
+        self.quantile_range = quantile_range
+        self.stats_: dict[str, tuple] = {}
+
+    def _fit(self, dataset) -> None:
+        lo_q, hi_q = self.quantile_range
+        for c in self.columns:
+            vals = dataset._column_values(c).astype(np.float64)
+            med = float(np.median(vals))
+            iqr = float(np.quantile(vals, hi_q) - np.quantile(vals, lo_q))
+            self.stats_[c] = (med, iqr)
+
+    def _transform_batch(self, batch: dict) -> dict:
+        out = dict(batch)
+        for c in self.columns:
+            med, iqr = self.stats_[c]
+            v = np.asarray(batch[c], dtype=np.float64)
+            out[c] = (v - med) / iqr if iqr > 0 else np.zeros_like(v)
+        return out
+
+
+def _encode_sorted(values: np.ndarray, cats: np.ndarray,
+                   column: str) -> np.ndarray:
+    """Vectorized codes against a SORTED category array (the hot
+    map_batches path must not run per-element dict lookups): position
+    via searchsorted, then one equality sweep flags unseen values."""
+    idx = np.searchsorted(cats, values)
+    idx_c = np.clip(idx, 0, len(cats) - 1)
+    bad = cats[idx_c] != values
+    if bad.any():
+        raise ValueError(
+            f"unseen value {values[bad][0]!r} in {column!r}")
+    return idx_c.astype(np.int64)
+
+
+class LabelEncoder(Preprocessor):
+    """Categorical column -> dense int codes, sorted-unique order
+    (reference: encoder.py LabelEncoder). Unseen values raise."""
+
+    def __init__(self, label_column: str):
+        super().__init__()
+        self.label_column = label_column
+        self.stats_: Any = None  # sorted category array
+
+    def _fit(self, dataset) -> None:
+        self.stats_ = np.sort(np.asarray(
+            dataset.unique(self.label_column)))
+
+    def _transform_batch(self, batch: dict) -> dict:
+        out = dict(batch)
+        out[self.label_column] = _encode_sorted(
+            np.asarray(batch[self.label_column]), self.stats_,
+            self.label_column)
+        return out
+
+    def inverse_transform_batch(self, batch: dict) -> dict:
+        out = dict(batch)
+        out[self.label_column] = self.stats_[
+            np.asarray(batch[self.label_column], dtype=np.int64)]
+        return out
+
+
+class OrdinalEncoder(Preprocessor):
+    """Like LabelEncoder over several feature columns (reference:
+    encoder.py OrdinalEncoder)."""
+
+    def __init__(self, columns: list[str]):
+        super().__init__()
+        self.columns = list(columns)
+        self.stats_: dict[str, np.ndarray] = {}
+
+    def _fit(self, dataset) -> None:
+        for c in self.columns:
+            self.stats_[c] = np.sort(np.asarray(dataset.unique(c)))
+
+    def _transform_batch(self, batch: dict) -> dict:
+        out = dict(batch)
+        for c in self.columns:
+            out[c] = _encode_sorted(np.asarray(batch[c]),
+                                    self.stats_[c], c)
+        return out
+
+
+class OneHotEncoder(Preprocessor):
+    """Column -> one indicator column per category, named
+    ``{col}_{value}`` (reference: encoder.py OneHotEncoder). Unseen
+    values encode as all-zeros."""
+
+    def __init__(self, columns: list[str]):
+        super().__init__()
+        self.columns = list(columns)
+        self.stats_: dict[str, list] = {}
+
+    def _fit(self, dataset) -> None:
+        for c in self.columns:
+            self.stats_[c] = sorted(dataset.unique(c))
+
+    def _transform_batch(self, batch: dict) -> dict:
+        out = dict(batch)
+        for c in self.columns:
+            vals = np.asarray(out.pop(c))
+            for cat in self.stats_[c]:
+                out[f"{c}_{cat}"] = (vals == cat).astype(np.int64)
+        return out
+
+
+def _missing_mask(arr: np.ndarray) -> np.ndarray:
+    """Missing = NaN for float arrays; None-or-NaN elements for object
+    arrays (categorical columns carry missing values as None)."""
+    if arr.dtype.kind == "f":
+        return np.isnan(arr)
+    if arr.dtype == object:
+        return np.asarray([
+            x is None or (isinstance(x, float) and np.isnan(x))
+            for x in arr.tolist()])
+    return np.zeros(len(arr), dtype=bool)
+
+
+class SimpleImputer(Preprocessor):
+    """Fill missing values with mean/median/most_frequent/constant
+    (reference: imputer.py SimpleImputer). mean/median are numeric;
+    most_frequent and constant also handle categorical (object/str)
+    columns — most_frequent's primary reference use case."""
+
+    def __init__(self, columns: list[str], strategy: str = "mean",
+                 fill_value: Any = None):
+        super().__init__()
+        if strategy not in ("mean", "median", "most_frequent", "constant"):
+            raise ValueError(f"unknown strategy {strategy!r}")
+        if strategy == "constant" and fill_value is None:
+            raise ValueError("strategy='constant' requires fill_value")
+        self.columns = list(columns)
+        self.strategy = strategy
+        self.fill_value = fill_value
+        self.stats_: dict[str, Any] = {}
+        self._is_fittable = strategy != "constant"
+
+    def _fit(self, dataset) -> None:
+        for c in self.columns:
+            vals = dataset._column_values(c)
+            if self.strategy == "most_frequent":
+                ok = vals[~_missing_mask(vals)]
+                uniq, counts = np.unique(ok, return_counts=True)
+                self.stats_[c] = uniq[counts.argmax()]
+                continue
+            fvals = vals.astype(np.float64)
+            ok = fvals[~np.isnan(fvals)]
+            self.stats_[c] = (float(ok.mean()) if self.strategy == "mean"
+                              else float(np.median(ok)))
+
+    def _transform_batch(self, batch: dict) -> dict:
+        out = dict(batch)
+        for c in self.columns:
+            fill = (self.fill_value if self.strategy == "constant"
+                    else self.stats_[c])
+            v = np.asarray(batch[c])
+            if v.dtype.kind == "f" or (v.dtype != object
+                                       and self.strategy in
+                                       ("mean", "median")):
+                v = v.astype(np.float64).copy()
+                v[np.isnan(v)] = fill
+            else:
+                v = v.astype(object).copy()
+                v[_missing_mask(v)] = fill
+            out[c] = v
+        return out
+
+
+class Concatenator(Preprocessor):
+    """Merge feature columns into one 2-D ``output_column_name`` array —
+    the model-input shape (reference: concatenator.py). Stateless."""
+
+    _is_fittable = False
+
+    def __init__(self, columns: list[str],
+                 output_column_name: str = "concat_out",
+                 dtype=np.float32, drop: bool = True):
+        super().__init__()
+        self.columns = list(columns)
+        self.output_column_name = output_column_name
+        self.dtype = dtype
+        self.drop = drop
+
+    def _transform_batch(self, batch: dict) -> dict:
+        out = dict(batch)
+        parts = []
+        for c in self.columns:
+            v = np.asarray(batch[c])
+            parts.append(v.reshape(len(v), -1))
+            if self.drop:
+                out.pop(c, None)
+        out[self.output_column_name] = np.concatenate(
+            parts, axis=1).astype(self.dtype)
+        return out
+
+
+class Normalizer(Preprocessor):
+    """Row-wise lp-normalization over feature columns (reference:
+    normalizer.py). Stateless."""
+
+    _is_fittable = False
+
+    def __init__(self, columns: list[str], norm: str = "l2"):
+        super().__init__()
+        if norm not in ("l1", "l2", "max"):
+            raise ValueError(f"unknown norm {norm!r}")
+        self.columns = list(columns)
+        self.norm = norm
+
+    def _transform_batch(self, batch: dict) -> dict:
+        out = dict(batch)
+        mat = np.stack([np.asarray(batch[c], dtype=np.float64)
+                        for c in self.columns], axis=1)
+        if self.norm == "l1":
+            d = np.abs(mat).sum(axis=1)
+        elif self.norm == "l2":
+            d = np.sqrt((mat * mat).sum(axis=1))
+        else:
+            d = np.abs(mat).max(axis=1)
+        d[d == 0] = 1.0
+        for i, c in enumerate(self.columns):
+            out[c] = mat[:, i] / d
+        return out
+
+
+class UniformKBinsDiscretizer(Preprocessor):
+    """Equal-width binning into int bin ids (reference:
+    discretizer.py UniformKBinsDiscretizer)."""
+
+    def __init__(self, columns: list[str], bins: int):
+        super().__init__()
+        self.columns = list(columns)
+        self.bins = int(bins)
+        self.stats_: dict[str, tuple] = {}
+
+    def _fit(self, dataset) -> None:
+        for c in self.columns:
+            vals = dataset._column_values(c).astype(np.float64)
+            # Interior edges cached at fit (the transform runs per
+            # batch on the streaming path).
+            self.stats_[c] = np.linspace(float(vals.min()),
+                                         float(vals.max()),
+                                         self.bins + 1)[1:-1]
+
+    def _transform_batch(self, batch: dict) -> dict:
+        out = dict(batch)
+        for c in self.columns:
+            v = np.asarray(batch[c], dtype=np.float64)
+            if np.isnan(v).any():
+                # NaN would silently land in the TOP bin (NaN compares
+                # greater-than in digitize) — a missing value must not
+                # become a legitimate-looking category.
+                raise ValueError(
+                    f"NaN in {c!r}: impute (SimpleImputer) before "
+                    "discretizing")
+            out[c] = np.clip(np.digitize(v, self.stats_[c]), 0,
+                             self.bins - 1).astype(np.int64)
+        return out

@@ -1,0 +1,189 @@
+"""Data preprocessors (reference: ray.data.preprocessors —
+preprocessor.py Preprocessor ABC + scaler/encoder/imputer/chain/
+concatenator/normalizer/discretizer modules)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rd
+from ray_tpu.data.preprocessors import (
+    Chain,
+    Concatenator,
+    LabelEncoder,
+    MinMaxScaler,
+    Normalizer,
+    OneHotEncoder,
+    OrdinalEncoder,
+    Preprocessor,
+    PreprocessorNotFittedException,
+    RobustScaler,
+    SimpleImputer,
+    StandardScaler,
+    UniformKBinsDiscretizer,
+)
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _teardown():
+    yield
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+
+
+def _col(ds, c):
+    return np.asarray([r[c] for r in ds.take_all()])
+
+
+def test_standard_scaler_fit_transform():
+    ds = rd.from_items([{"x": float(i), "y": float(i * 10)}
+                        for i in range(10)])
+    sc = StandardScaler(["x"])
+    out = sc.fit_transform(ds)
+    xs = _col(out, "x")
+    assert abs(xs.mean()) < 1e-9 and abs(xs.std() - 1.0) < 1e-9
+    assert _col(out, "y")[3] == 30.0  # untouched column
+
+    # Serving-time batch path matches the dataset path.
+    b = sc.transform_batch({"x": np.arange(10.0), "y": np.zeros(10)})
+    np.testing.assert_allclose(b["x"], xs, rtol=1e-12)
+
+
+def test_unfitted_raises():
+    sc = StandardScaler(["x"])
+    with pytest.raises(PreprocessorNotFittedException):
+        sc.transform(rd.range(3))
+    # Stateless preprocessors never require fit.
+    c = Concatenator(["id"], output_column_name="f")
+    assert "f" in c.transform_batch({"id": np.arange(4)})
+
+
+def test_min_max_and_robust_scalers():
+    ds = rd.from_items([{"x": float(v)} for v in [0, 5, 10]])
+    mm = MinMaxScaler(["x"]).fit(ds)
+    np.testing.assert_allclose(
+        mm.transform_batch({"x": np.array([0.0, 5.0, 10.0])})["x"],
+        [0.0, 0.5, 1.0])
+
+    rb = RobustScaler(["x"]).fit(
+        rd.from_items([{"x": float(v)} for v in range(1, 102)]))
+    out = rb.transform_batch({"x": np.array([51.0])})
+    assert abs(out["x"][0]) < 1e-9  # median maps to 0
+
+
+def test_label_and_ordinal_encoders():
+    ds = rd.from_items([{"cls": c, "f": c} for c in
+                        ["cat", "dog", "cat", "bird"]])
+    le = LabelEncoder("cls").fit(ds)
+    enc = le.transform_batch({"cls": np.array(["bird", "cat", "dog"])})
+    assert enc["cls"].tolist() == [0, 1, 2]  # sorted-unique codes
+    dec = le.inverse_transform_batch(enc)
+    assert dec["cls"].tolist() == ["bird", "cat", "dog"]
+    with pytest.raises(ValueError, match="unseen"):
+        le.transform_batch({"cls": np.array(["fish"])})
+
+    oe = OrdinalEncoder(["f"]).fit(ds)
+    assert oe.transform_batch(
+        {"f": np.array(["dog", "bird"])})["f"].tolist() == [2, 0]
+
+
+def test_one_hot_encoder():
+    ds = rd.from_items([{"c": v} for v in ["a", "b", "a"]])
+    oh = OneHotEncoder(["c"]).fit(ds)
+    out = oh.transform_batch({"c": np.array(["b", "a", "zzz"])})
+    assert "c" not in out
+    assert out["c_a"].tolist() == [0, 1, 0]
+    assert out["c_b"].tolist() == [1, 0, 0]  # unseen -> all-zero row
+
+
+def test_simple_imputer_strategies():
+    ds = rd.from_items([{"x": v} for v in [1.0, np.nan, 3.0]])
+    mean = SimpleImputer(["x"], strategy="mean").fit(ds)
+    assert mean.transform_batch(
+        {"x": np.array([np.nan])})["x"][0] == 2.0
+    const = SimpleImputer(["x"], strategy="constant", fill_value=-1.0)
+    assert const.transform_batch(
+        {"x": np.array([np.nan, 5.0])})["x"].tolist() == [-1.0, 5.0]
+    with pytest.raises(ValueError):
+        SimpleImputer(["x"], strategy="constant")
+
+
+def test_concatenator_and_normalizer():
+    out = Concatenator(["a", "b"], output_column_name="feat").\
+        transform_batch({"a": np.array([1.0, 2.0]),
+                         "b": np.array([3.0, 4.0]),
+                         "keep": np.array([9, 9])})
+    assert out["feat"].shape == (2, 2) and out["feat"].dtype == np.float32
+    assert "a" not in out and "keep" in out
+
+    nm = Normalizer(["a", "b"], norm="l2").transform_batch(
+        {"a": np.array([3.0]), "b": np.array([4.0])})
+    np.testing.assert_allclose([nm["a"][0], nm["b"][0]], [0.6, 0.8])
+
+
+def test_discretizer_bins():
+    ds = rd.from_items([{"x": float(v)} for v in range(100)])
+    kb = UniformKBinsDiscretizer(["x"], bins=4).fit(ds)
+    out = kb.transform_batch({"x": np.array([0.0, 30.0, 60.0, 99.0])})
+    assert out["x"].tolist() == [0, 1, 2, 3]
+    # NaN must not silently become the top bin.
+    with pytest.raises(ValueError, match="NaN"):
+        kb.transform_batch({"x": np.array([np.nan])})
+
+
+def test_imputer_categorical_most_frequent_and_constant():
+    ds = rd.from_items([{"c": v} for v in
+                        ["sf", "sf", None, "nyc", None]])
+    mf = SimpleImputer(["c"], strategy="most_frequent").fit(ds)
+    out = mf.transform_batch({"c": np.array(["nyc", None], dtype=object)})
+    assert out["c"].tolist() == ["nyc", "sf"]
+    const = SimpleImputer(["c"], strategy="constant",
+                          fill_value="unknown")
+    out2 = const.transform_batch({"c": np.array([None, "sf"],
+                                                dtype=object)})
+    assert out2["c"].tolist() == ["unknown", "sf"]
+
+
+def test_stateless_chain_needs_no_fit():
+    ch = Chain(Normalizer(["a", "b"]),
+               Concatenator(["a", "b"], output_column_name="f"))
+    out = ch.transform_batch({"a": np.array([3.0]),
+                              "b": np.array([4.0])})
+    np.testing.assert_allclose(out["f"], [[0.6, 0.8]])
+
+
+def test_chain_fits_each_stage_on_prior_output():
+    ds = rd.from_items([{"x": float(i), "c": ["a", "b"][i % 2]}
+                        for i in range(8)])
+    chain = Chain(
+        StandardScaler(["x"]),
+        OneHotEncoder(["c"]),
+        Concatenator(["x", "c_a", "c_b"], output_column_name="features"),
+    )
+    out = chain.fit_transform(ds)
+    rows = out.take_all()
+    assert set(rows[0]) == {"features"}
+    assert rows[0]["features"].shape == (3,)
+    # The batch path composes identically.
+    b = chain.transform_batch({"x": np.array([0.0]),
+                               "c": np.array(["a"])})
+    assert b["features"].shape == (1, 3)
+
+
+def test_fitted_preprocessor_travels_to_train_workers(tmp_path):
+    """The fit-on-driver / transform-on-worker flow Train uses
+    (reference: preprocessors serialized into Train checkpoints)."""
+    ray_tpu.init(num_cpus=2, object_store_memory=64 * 1024 * 1024,
+                 ignore_reinit_error=True)
+    ds = rd.from_items([{"x": float(i)} for i in range(16)])
+    sc = StandardScaler(["x"]).fit(ds)
+
+    @ray_tpu.remote
+    def worker_transform(p: Preprocessor, xs):
+        return p.transform_batch({"x": np.asarray(xs)})["x"].mean()
+
+    m = ray_tpu.get(worker_transform.remote(sc, list(range(16))),
+                    timeout=60)
+    assert abs(m) < 1e-9
