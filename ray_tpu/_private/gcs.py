@@ -2921,8 +2921,11 @@ class Head:
             t["worker_id"] = rec.worker_id
             t["started_at"] = time.time()
         try:
-            packed = (pack_spec(spec)
+            packed = ((spec._packed_bin or pack_spec(spec))
                       if rec.conn.peer_info.get("specenc") else None)
+            # The cached bytes served their one reuse; a retained spec
+            # (inflight map, lineage) must not keep a duplicate copy.
+            spec._packed_bin = None
             push_body = ({"spec_bin": packed} if packed is not None
                          else {"spec": spec})
             push_body["tpu_chips"] = rec.tpu_chips
@@ -3180,6 +3183,7 @@ class Head:
                 for spec in inflight:
                     if spec.retries_used < spec.max_retries:
                         spec.retries_used += 1
+                        spec._packed_bin = None  # packed field changed
                         t = self.tasks.get(spec.task_id)
                         if t:
                             t["state"] = PENDING
@@ -3217,6 +3221,7 @@ class Head:
                 # incarnation (reference: @ray.remote(max_task_retries)
                 # — at-least-once actor-method semantics, opt-in).
                 spec.retries_used += 1
+                spec._packed_bin = None  # packed field changed
                 t = self.tasks.get(spec.task_id)
                 if t:
                     t["state"] = PENDING

@@ -70,6 +70,31 @@ class TaskSpec:
     _deps_pending: Any = dataclasses.field(default=None, repr=False)
     _deferred_results: Any = dataclasses.field(default=None, repr=False)
     _remote_markers: Any = dataclasses.field(default=None, repr=False)
+    # Submit-time compiled encoding, reused verbatim for the worker push
+    # (the hot path packed every spec TWICE: submitter->head and
+    # head->worker). Must be invalidated wherever a PACKED field mutates
+    # after unpack — today that is only retries_used on the retry path.
+    # Cached only under _PACKED_CACHE_MAX bytes (a million-spec backlog
+    # must not hold a duplicate serialized copy of large args), cleared
+    # after the push, and stripped from pickle below.
+    _packed_bin: Any = dataclasses.field(default=None, repr=False)
+
+    _SCRATCH = ("_rkey", "_demand", "_deps_pending", "_deferred_results",
+                "_remote_markers", "_packed_bin")
+
+    def __getstate__(self):
+        """Strip scratch slots (dispatch caches, the packed-bytes
+        duplicate) from pickle: a pickle-fallback push must not ship a
+        second serialized copy of the spec inside itself."""
+        slots = {}
+        for f in dataclasses.fields(self):
+            if f.name in self._SCRATCH:
+                continue
+            try:
+                slots[f.name] = getattr(self, f.name)
+            except AttributeError:
+                pass
+        return (None, slots)
 
     def __setstate__(self, state):
         """Accept BOTH pickle state forms. The slotted class emits
@@ -169,13 +194,19 @@ def unpack_spec(data: bytes) -> "TaskSpec":
     return TaskSpec(*vals)
 
 
+_PACKED_CACHE_MAX = 4096
+
+
 def spec_from_body(body: dict) -> "TaskSpec":
     """Spec from a control-plane message: compiled encoding when the
     sender used it, pickled dataclass otherwise."""
     spec = body.get("spec")
     if spec is not None:
         return spec
-    return unpack_spec(body["spec_bin"])
+    spec = unpack_spec(body["spec_bin"])
+    if len(body["spec_bin"]) <= _PACKED_CACHE_MAX:
+        spec._packed_bin = body["spec_bin"]
+    return spec
 
 
 @dataclasses.dataclass

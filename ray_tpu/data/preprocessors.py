@@ -108,8 +108,13 @@ class StandardScaler(Preprocessor):
 
     def _fit(self, dataset) -> None:
         for c in self.columns:
+            # nan-aware like the reference's null-skipping aggregates:
+            # a single NaN must not poison the stats (NaN stats would
+            # silently zero the whole column through the zero-variance
+            # branch).
             vals = dataset._column_values(c).astype(np.float64)
-            self.stats_[c] = (float(vals.mean()), float(vals.std()))
+            self.stats_[c] = (float(np.nanmean(vals)),
+                              float(np.nanstd(vals)))
 
     def _transform_batch(self, batch: dict) -> dict:
         out = dict(batch)
@@ -132,7 +137,8 @@ class MinMaxScaler(Preprocessor):
     def _fit(self, dataset) -> None:
         for c in self.columns:
             vals = dataset._column_values(c).astype(np.float64)
-            self.stats_[c] = (float(vals.min()), float(vals.max()))
+            self.stats_[c] = (float(np.nanmin(vals)),
+                              float(np.nanmax(vals)))
 
     def _transform_batch(self, batch: dict) -> dict:
         out = dict(batch)
@@ -159,8 +165,9 @@ class RobustScaler(Preprocessor):
         lo_q, hi_q = self.quantile_range
         for c in self.columns:
             vals = dataset._column_values(c).astype(np.float64)
-            med = float(np.median(vals))
-            iqr = float(np.quantile(vals, hi_q) - np.quantile(vals, lo_q))
+            med = float(np.nanmedian(vals))
+            iqr = float(np.nanquantile(vals, hi_q)
+                        - np.nanquantile(vals, lo_q))
             self.stats_[c] = (med, iqr)
 
     def _transform_batch(self, batch: dict) -> dict:
@@ -390,9 +397,9 @@ class UniformKBinsDiscretizer(Preprocessor):
         for c in self.columns:
             vals = dataset._column_values(c).astype(np.float64)
             # Interior edges cached at fit (the transform runs per
-            # batch on the streaming path).
-            self.stats_[c] = np.linspace(float(vals.min()),
-                                         float(vals.max()),
+            # batch on the streaming path); nan-aware bounds.
+            self.stats_[c] = np.linspace(float(np.nanmin(vals)),
+                                         float(np.nanmax(vals)),
                                          self.bins + 1)[1:-1]
 
     def _transform_batch(self, batch: dict) -> dict:

@@ -133,6 +133,23 @@ def test_discretizer_bins():
         kb.transform_batch({"x": np.array([np.nan])})
 
 
+def test_nan_in_fit_column_does_not_poison_stats():
+    """A NaN at FIT time must not corrupt stats (NaN stats silently
+    zeroed columns via the zero-variance branch / top-binned all
+    values). Fit aggregates are nan-aware like the reference's
+    null-skipping aggregates."""
+    ds = rd.from_items([{"x": v} for v in [1.0, np.nan, 3.0]])
+    sc = StandardScaler(["x"]).fit(ds)
+    out = sc.transform_batch({"x": np.array([1.0, 3.0])})
+    np.testing.assert_allclose(out["x"], [-1.0, 1.0])
+    mm = MinMaxScaler(["x"]).fit(ds)
+    np.testing.assert_allclose(
+        mm.transform_batch({"x": np.array([1.0, 3.0])})["x"], [0.0, 1.0])
+    kb = UniformKBinsDiscretizer(["x"], bins=2).fit(ds)
+    assert kb.transform_batch(
+        {"x": np.array([1.0, 3.0])})["x"].tolist() == [0, 1]
+
+
 def test_imputer_categorical_most_frequent_and_constant():
     ds = rd.from_items([{"c": v} for v in
                         ["sf", "sf", None, "nyc", None]])
