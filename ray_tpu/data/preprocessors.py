@@ -20,6 +20,21 @@ class PreprocessorNotFittedException(RuntimeError):
     """Transform requested before fit (reference: preprocessor.py:21)."""
 
 
+def _fit_columns(dataset, columns: list) -> dict:
+    """All requested columns in ONE plan execution (per-column
+    Dataset._column_values calls would re-run the whole upstream plan
+    once per column — O(columns x dataset) fit cost)."""
+    parts: dict = {c: [] for c in columns}
+    from ray_tpu.data.block import BlockAccessor
+
+    for block in dataset.iter_blocks():
+        batch = BlockAccessor(block).to_numpy()
+        for c in columns:
+            parts[c].append(np.asarray(batch[c]))
+    return {c: (np.concatenate(v) if v else np.array([]))
+            for c, v in parts.items()}
+
+
 class Preprocessor:
     """fit/transform over Datasets + transform_batch for serving-time
     single batches (reference: Preprocessor ABC, preprocessor.py:28).
@@ -107,12 +122,12 @@ class StandardScaler(Preprocessor):
         self.stats_: dict[str, tuple] = {}
 
     def _fit(self, dataset) -> None:
+        # One plan execution for every column; nan-aware like the
+        # reference's null-skipping aggregates (a single NaN must not
+        # poison the stats into zeroing the column).
+        cols = _fit_columns(dataset, self.columns)
         for c in self.columns:
-            # nan-aware like the reference's null-skipping aggregates:
-            # a single NaN must not poison the stats (NaN stats would
-            # silently zero the whole column through the zero-variance
-            # branch).
-            vals = dataset._column_values(c).astype(np.float64)
+            vals = cols[c].astype(np.float64)
             self.stats_[c] = (float(np.nanmean(vals)),
                               float(np.nanstd(vals)))
 
@@ -135,8 +150,9 @@ class MinMaxScaler(Preprocessor):
         self.stats_: dict[str, tuple] = {}
 
     def _fit(self, dataset) -> None:
+        cols = _fit_columns(dataset, self.columns)
         for c in self.columns:
-            vals = dataset._column_values(c).astype(np.float64)
+            vals = cols[c].astype(np.float64)
             self.stats_[c] = (float(np.nanmin(vals)),
                               float(np.nanmax(vals)))
 
@@ -163,8 +179,9 @@ class RobustScaler(Preprocessor):
 
     def _fit(self, dataset) -> None:
         lo_q, hi_q = self.quantile_range
+        cols = _fit_columns(dataset, self.columns)
         for c in self.columns:
-            vals = dataset._column_values(c).astype(np.float64)
+            vals = cols[c].astype(np.float64)
             med = float(np.nanmedian(vals))
             iqr = float(np.nanquantile(vals, hi_q)
                         - np.nanquantile(vals, lo_q))
@@ -296,8 +313,9 @@ class SimpleImputer(Preprocessor):
         self._is_fittable = strategy != "constant"
 
     def _fit(self, dataset) -> None:
+        cols = _fit_columns(dataset, self.columns)
         for c in self.columns:
-            vals = dataset._column_values(c)
+            vals = cols[c]
             if self.strategy == "most_frequent":
                 ok = vals[~_missing_mask(vals)]
                 uniq, counts = np.unique(ok, return_counts=True)
@@ -319,9 +337,12 @@ class SimpleImputer(Preprocessor):
                                        ("mean", "median")):
                 v = v.astype(np.float64).copy()
                 v[np.isnan(v)] = fill
-            else:
-                v = v.astype(object).copy()
+            elif v.dtype == object:
+                v = v.copy()
                 v[_missing_mask(v)] = fill
+            # else: integer/bool columns have no missing representation
+            # — pass through untouched (converting to object would push
+            # a clean numeric column off the device fast path).
             out[c] = v
         return out
 
@@ -346,7 +367,11 @@ class Concatenator(Preprocessor):
         parts = []
         for c in self.columns:
             v = np.asarray(batch[c])
-            parts.append(v.reshape(len(v), -1))
+            # reshape(-1) cannot infer a width for 0-row blocks (a
+            # zero-row parquet row-group reaches here via streaming);
+            # derive the width from the trailing shape instead.
+            width = int(np.prod(v.shape[1:])) if v.ndim > 1 else 1
+            parts.append(v.reshape(len(v), width))
             if self.drop:
                 out.pop(c, None)
         out[self.output_column_name] = np.concatenate(
@@ -394,8 +419,9 @@ class UniformKBinsDiscretizer(Preprocessor):
         self.stats_: dict[str, tuple] = {}
 
     def _fit(self, dataset) -> None:
+        cols = _fit_columns(dataset, self.columns)
         for c in self.columns:
-            vals = dataset._column_values(c).astype(np.float64)
+            vals = cols[c].astype(np.float64)
             # Interior edges cached at fit (the transform runs per
             # batch on the streaming path); nan-aware bounds.
             self.stats_[c] = np.linspace(float(np.nanmin(vals)),
@@ -415,4 +441,144 @@ class UniformKBinsDiscretizer(Preprocessor):
                     "discretizing")
             out[c] = np.clip(np.digitize(v, self.stats_[c]), 0,
                              self.bins - 1).astype(np.int64)
+        return out
+
+
+# -- text family (reference: preprocessors/{tokenizer,hasher,
+# vectorizer}.py) ------------------------------------------------------------
+
+
+def _default_tokenize(s: str) -> list[str]:
+    """The reference's simple_split_tokenizer: lowercase, split on
+    non-alphanumeric runs."""
+    import re
+
+    return [t for t in re.split(r"[^a-z0-9]+", str(s).lower()) if t]
+
+
+class Tokenizer(Preprocessor):
+    """String column -> list-of-tokens column (reference:
+    tokenizer.py Tokenizer). Stateless; tokenization_fn pluggable."""
+
+    _is_fittable = False
+
+    def __init__(self, columns: list[str], tokenization_fn=None):
+        super().__init__()
+        self.columns = list(columns)
+        self.tokenization_fn = tokenization_fn or _default_tokenize
+
+    def _transform_batch(self, batch: dict) -> dict:
+        out = dict(batch)
+        for c in self.columns:
+            cells = np.asarray(batch[c]).tolist()
+            # 1-D object array of LISTS: np.asarray would collapse
+            # equal-length token lists into a 2-D array.
+            col = np.empty(len(cells), dtype=object)
+            for i, s in enumerate(cells):
+                col[i] = self.tokenization_fn(s)
+            out[c] = col
+        return out
+
+
+class FeatureHasher(Preprocessor):
+    """Token-count columns -> fixed-width hashed feature matrix
+    (reference: hasher.py FeatureHasher — the hashing trick keeps
+    vocabulary out of memory). Stateless; input columns hold token
+    LISTS (e.g. Tokenizer output) or raw strings."""
+
+    _is_fittable = False
+
+    def __init__(self, columns: list[str], num_features: int,
+                 output_column_name: str = "hashed_features"):
+        super().__init__()
+        self.columns = list(columns)
+        self.num_features = int(num_features)
+        self.output_column_name = output_column_name
+
+    def _transform_batch(self, batch: dict) -> dict:
+        import zlib
+
+        out = dict(batch)
+        n = len(np.asarray(batch[self.columns[0]], dtype=object))
+        mat = np.zeros((n, self.num_features), dtype=np.float32)
+        for c in self.columns:
+            col = np.asarray(batch[c], dtype=object)
+            for i, cell in enumerate(col.tolist()):
+                tokens = (cell if isinstance(cell, (list, tuple, np.ndarray))
+                          else _default_tokenize(cell))
+                for tok in tokens:
+                    h = zlib.crc32(f"{c}={tok}".encode()) % self.num_features
+                    mat[i, h] += 1.0
+            out.pop(c, None)
+        out[self.output_column_name] = mat
+        return out
+
+
+class CountVectorizer(Preprocessor):
+    """Fit a vocabulary over a text column; transform to per-token
+    count columns ``{col}_{token}`` for the top max_features tokens
+    (reference: vectorizer.py CountVectorizer)."""
+
+    def __init__(self, columns: list[str], tokenization_fn=None,
+                 max_features: int | None = None):
+        super().__init__()
+        self.columns = list(columns)
+        self.tokenization_fn = tokenization_fn or _default_tokenize
+        self.max_features = max_features
+        self.stats_: dict[str, list] = {}
+
+    def _fit(self, dataset) -> None:
+        from collections import Counter
+
+        cols = _fit_columns(dataset, self.columns)
+        for c in self.columns:
+            counts: Counter = Counter()
+            for s in cols[c].tolist():
+                counts.update(self.tokenization_fn(s))
+            vocab = (counts.most_common(self.max_features)
+                     if self.max_features else sorted(counts.items()))
+            self.stats_[c] = sorted(t for t, _ in vocab)
+
+    def _transform_batch(self, batch: dict) -> dict:
+        from collections import Counter
+
+        out = dict(batch)
+        for c in self.columns:
+            vocab = self.stats_[c]
+            cells = np.asarray(out.pop(c), dtype=object).tolist()
+            token_counts = [Counter(self.tokenization_fn(s))
+                            for s in cells]
+            for tok in vocab:
+                out[f"{c}_{tok}"] = np.asarray(
+                    [tc.get(tok, 0) for tc in token_counts],
+                    dtype=np.int64)
+        return out
+
+
+class HashingVectorizer(Preprocessor):
+    """Text column -> fixed-width hashed count matrix, no fitted
+    vocabulary (reference: vectorizer.py HashingVectorizer)."""
+
+    _is_fittable = False
+
+    def __init__(self, columns: list[str], num_features: int,
+                 tokenization_fn=None):
+        super().__init__()
+        self.columns = list(columns)
+        self.num_features = int(num_features)
+        self.tokenization_fn = tokenization_fn or _default_tokenize
+
+    def _transform_batch(self, batch: dict) -> dict:
+        import zlib
+
+        out = dict(batch)
+        for c in self.columns:
+            cells = np.asarray(out.pop(c), dtype=object).tolist()
+            mat = np.zeros((len(cells), self.num_features),
+                           dtype=np.float32)
+            for i, s in enumerate(cells):
+                for tok in self.tokenization_fn(s):
+                    mat[i, zlib.crc32(tok.encode())
+                        % self.num_features] += 1.0
+            out[f"{c}_hashed"] = mat
         return out

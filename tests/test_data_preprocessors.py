@@ -204,3 +204,44 @@ def test_fitted_preprocessor_travels_to_train_workers(tmp_path):
     m = ray_tpu.get(worker_transform.remote(sc, list(range(16))),
                     timeout=60)
     assert abs(m) < 1e-9
+
+
+def test_tokenizer_and_count_vectorizer():
+    from ray_tpu.data.preprocessors import CountVectorizer, Tokenizer
+
+    ds = rd.from_items([{"text": "the cat sat"},
+                        {"text": "the dog SAT!"}])
+    tok = Tokenizer(["text"]).transform_batch(
+        {"text": np.array(["Hello, World"], dtype=object)})
+    assert tok["text"][0] == ["hello", "world"]
+
+    cv = CountVectorizer(["text"]).fit(ds)
+    out = cv.transform_batch({"text": np.array(["the the cat"],
+                                               dtype=object)})
+    assert "text" not in out
+    assert out["text_the"].tolist() == [2]
+    assert out["text_cat"].tolist() == [1]
+    assert out["text_dog"].tolist() == [0]
+
+    top = CountVectorizer(["text"], max_features=2).fit(ds)
+    # 'the' (2) and 'sat' (2) are the top-2 tokens.
+    cols = [k for k in top.transform_batch(
+        {"text": np.array(["x"], dtype=object)}) if k.startswith("text_")]
+    assert sorted(cols) == ["text_sat", "text_the"]
+
+
+def test_feature_hasher_and_hashing_vectorizer():
+    from ray_tpu.data.preprocessors import (FeatureHasher,
+                                            HashingVectorizer, Tokenizer)
+
+    hv = HashingVectorizer(["text"], num_features=16)
+    out = hv.transform_batch({"text": np.array(["cat cat dog"],
+                                               dtype=object)})
+    mat = out["text_hashed"]
+    assert mat.shape == (1, 16) and mat.sum() == 3.0 and mat.max() == 2.0
+
+    fh = Chain(Tokenizer(["text"]),
+               FeatureHasher(["text"], num_features=8))
+    out2 = fh.transform_batch({"text": np.array(["a b a"], dtype=object)})
+    assert out2["hashed_features"].shape == (1, 8)
+    assert out2["hashed_features"].sum() == 3.0
