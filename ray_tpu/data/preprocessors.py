@@ -20,6 +20,24 @@ class PreprocessorNotFittedException(RuntimeError):
     """Transform requested before fit (reference: preprocessor.py:21)."""
 
 
+def _as_column(values) -> np.ndarray:
+    """1-D column array. List-valued cells (e.g. genre lists) become a
+    1-D OBJECT array of lists — a bare np.asarray would collapse
+    equal-width lists into 2-D (breaking cross-block concatenation the
+    moment widths differ) and reject ragged ones outright."""
+    if isinstance(values, np.ndarray) and values.ndim == 1 \
+            and values.dtype != object:
+        return values
+    vals = list(values) if not isinstance(values, np.ndarray) \
+        else values.tolist()
+    if any(isinstance(x, (list, tuple, np.ndarray)) for x in vals):
+        col = np.empty(len(vals), dtype=object)
+        for i, x in enumerate(vals):
+            col[i] = x
+        return col
+    return np.asarray(vals)
+
+
 def _fit_columns(dataset, columns: list) -> dict:
     """All requested columns in ONE plan execution (per-column
     Dataset._column_values calls would re-run the whole upstream plan
@@ -30,7 +48,7 @@ def _fit_columns(dataset, columns: list) -> dict:
     for block in dataset.iter_blocks():
         batch = BlockAccessor(block).to_numpy()
         for c in columns:
-            parts[c].append(np.asarray(batch[c]))
+            parts[c].append(_as_column(batch[c]))
     return {c: (np.concatenate(v) if v else np.array([]))
             for c, v in parts.items()}
 
@@ -64,9 +82,12 @@ class Preprocessor:
         return dataset.map_batches(self._transform_batch)
 
     def transform_batch(self, batch: dict) -> dict:
-        """One in-memory columnar batch (serving-time path)."""
+        """One in-memory columnar batch (serving-time path). List-
+        valued columns (ragged or uniform) coerce to 1-D object
+        arrays — the input shape MultiHotEncoder/FeatureHasher
+        document."""
         self._check_fitted()
-        return self._transform_batch({k: np.asarray(v)
+        return self._transform_batch({k: _as_column(v)
                                       for k, v in batch.items()})
 
     def _check_fitted(self) -> None:
@@ -220,8 +241,8 @@ class LabelEncoder(Preprocessor):
         self.stats_: Any = None  # sorted category array
 
     def _fit(self, dataset) -> None:
-        self.stats_ = np.sort(np.asarray(
-            dataset.unique(self.label_column)))
+        vals = _fit_columns(dataset, [self.label_column])[self.label_column]
+        self.stats_ = np.unique(vals)  # sorted
 
     def _transform_batch(self, batch: dict) -> dict:
         out = dict(batch)
@@ -247,8 +268,9 @@ class OrdinalEncoder(Preprocessor):
         self.stats_: dict[str, np.ndarray] = {}
 
     def _fit(self, dataset) -> None:
+        cols = _fit_columns(dataset, self.columns)
         for c in self.columns:
-            self.stats_[c] = np.sort(np.asarray(dataset.unique(c)))
+            self.stats_[c] = np.unique(cols[c])  # sorted
 
     def _transform_batch(self, batch: dict) -> dict:
         out = dict(batch)
@@ -269,8 +291,9 @@ class OneHotEncoder(Preprocessor):
         self.stats_: dict[str, list] = {}
 
     def _fit(self, dataset) -> None:
+        cols = _fit_columns(dataset, self.columns)
         for c in self.columns:
-            self.stats_[c] = sorted(dataset.unique(c))
+            self.stats_[c] = sorted(np.unique(cols[c]).tolist())
 
     def _transform_batch(self, batch: dict) -> dict:
         out = dict(batch)
@@ -581,4 +604,106 @@ class HashingVectorizer(Preprocessor):
                     mat[i, zlib.crc32(tok.encode())
                         % self.num_features] += 1.0
             out[f"{c}_hashed"] = mat
+        return out
+
+
+class MaxAbsScaler(Preprocessor):
+    """x / max(|x|) per column (reference: scaler.py MaxAbsScaler)."""
+
+    def __init__(self, columns: list[str]):
+        super().__init__()
+        self.columns = list(columns)
+        self.stats_: dict[str, float] = {}
+
+    def _fit(self, dataset) -> None:
+        cols = _fit_columns(dataset, self.columns)
+        for c in self.columns:
+            vals = cols[c].astype(np.float64)
+            self.stats_[c] = float(np.nanmax(np.abs(vals)))
+
+    def _transform_batch(self, batch: dict) -> dict:
+        out = dict(batch)
+        for c in self.columns:
+            m = self.stats_[c]
+            v = np.asarray(batch[c], dtype=np.float64)
+            out[c] = v / m if m > 0 else np.zeros_like(v)
+        return out
+
+
+class MultiHotEncoder(Preprocessor):
+    """List-valued column -> fixed-width multi-hot count vector over the
+    fitted vocabulary (reference: encoder.py MultiHotEncoder — e.g. a
+    movie's genre list)."""
+
+    def __init__(self, columns: list[str]):
+        super().__init__()
+        self.columns = list(columns)
+        self.stats_: dict[str, list] = {}
+
+    def _fit(self, dataset) -> None:
+        cols = _fit_columns(dataset, self.columns)
+        for c in self.columns:
+            vocab: set = set()
+            for cell in cols[c].tolist():
+                vocab.update(cell if isinstance(
+                    cell, (list, tuple, np.ndarray)) else [cell])
+            self.stats_[c] = sorted(vocab)
+
+    def _transform_batch(self, batch: dict) -> dict:
+        out = dict(batch)
+        for c in self.columns:
+            vocab = {v: i for i, v in enumerate(self.stats_[c])}
+            cells = np.asarray(batch[c], dtype=object).tolist()
+            mat = np.zeros((len(cells), len(vocab)), dtype=np.int64)
+            for i, cell in enumerate(cells):
+                items = (cell if isinstance(cell, (list, tuple, np.ndarray))
+                         else [cell])
+                for item in items:
+                    j = vocab.get(item)
+                    if j is not None:
+                        mat[i, j] += 1
+            out[c] = mat
+        return out
+
+
+class PowerTransformer(Preprocessor):
+    """Yeo-Johnson / Box-Cox power transform with a user-chosen power
+    (reference: transformer.py PowerTransformer — the reference also
+    takes the power as a parameter rather than estimating it).
+    Stateless. Box-Cox requires positive data."""
+
+    _is_fittable = False
+
+    def __init__(self, columns: list[str], power: float,
+                 method: str = "yeo-johnson"):
+        super().__init__()
+        if method not in ("yeo-johnson", "box-cox"):
+            raise ValueError(f"unknown method {method!r}")
+        self.columns = list(columns)
+        self.power = float(power)
+        self.method = method
+
+    def _transform_batch(self, batch: dict) -> dict:
+        lmb = self.power
+        out = dict(batch)
+        for c in self.columns:
+            v = np.asarray(batch[c], dtype=np.float64)
+            if self.method == "box-cox":
+                if (v <= 0).any():
+                    raise ValueError("box-cox requires positive data")
+                out[c] = (np.log(v) if lmb == 0
+                          else (np.power(v, lmb) - 1) / lmb)
+                continue
+            # yeo-johnson, piecewise around 0
+            pos = v >= 0
+            r = np.empty_like(v)
+            if lmb != 0:
+                r[pos] = (np.power(v[pos] + 1, lmb) - 1) / lmb
+            else:
+                r[pos] = np.log1p(v[pos])
+            if lmb != 2:
+                r[~pos] = -(np.power(1 - v[~pos], 2 - lmb) - 1) / (2 - lmb)
+            else:
+                r[~pos] = -np.log1p(-v[~pos])
+            out[c] = r
         return out

@@ -12,6 +12,21 @@ import pytest
 from ray_tpu._private import bulk_transfer
 
 
+def _wait_pins_released(reader, timeout=5.0):
+    """The server releases a read pin AFTER its send completes — the
+    client can hold the full payload while that server thread hasn't
+    run yet (observed flaky under a loaded box). Eventual release is
+    the contract; poll for it."""
+    import time
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if reader.pins == 0:
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"pins never released: {reader.pins}")
+
+
 class _MemReader:
     """BulkServer reader over an in-memory dict, counting live pins."""
 
@@ -41,7 +56,7 @@ def test_single_stream_roundtrip():
         out = bulk_transfer.pull_object(
             srv.address, "obj", len(data), streams=4)
         assert bytes(out) == data
-        assert reader.pins == 0
+        _wait_pins_released(reader)
     finally:
         srv.stop()
 
@@ -54,7 +69,7 @@ def test_parallel_stripes_roundtrip():
         out = bulk_transfer.pull_object(
             srv.address, "big", len(data), streams=4, stripe_min=4 << 20)
         assert bytes(out) == data
-        assert reader.pins == 0
+        _wait_pins_released(reader)
     finally:
         srv.stop()
 

@@ -245,3 +245,30 @@ def test_feature_hasher_and_hashing_vectorizer():
     out2 = fh.transform_batch({"text": np.array(["a b a"], dtype=object)})
     assert out2["hashed_features"].shape == (1, 8)
     assert out2["hashed_features"].sum() == 3.0
+
+
+def test_maxabs_multihot_power():
+    from ray_tpu.data.preprocessors import (MaxAbsScaler, MultiHotEncoder,
+                                            PowerTransformer)
+
+    ds = rd.from_items([{"x": v} for v in [-4.0, 2.0]])
+    ma = MaxAbsScaler(["x"]).fit(ds)
+    np.testing.assert_allclose(
+        ma.transform_batch({"x": np.array([-4.0, 2.0])})["x"], [-1.0, 0.5])
+
+    genres = rd.from_items([{"g": ["scifi", "drama"]},
+                            {"g": ["drama"]}])
+    mh = MultiHotEncoder(["g"]).fit(genres)
+    out = mh.transform_batch({"g": np.array([["drama", "drama"],
+                                             ["scifi"]], dtype=object)})
+    assert out["g"].tolist() == [[2, 0], [0, 1]]  # cols: drama, scifi
+
+    pt = PowerTransformer(["x"], power=0.0, method="box-cox")
+    np.testing.assert_allclose(
+        pt.transform_batch({"x": np.array([1.0, np.e])})["x"], [0.0, 1.0])
+    yj = PowerTransformer(["x"], power=1.0)
+    np.testing.assert_allclose(
+        yj.transform_batch({"x": np.array([-1.0, 0.0, 3.0])})["x"],
+        [-1.0, 0.0, 3.0])
+    with pytest.raises(ValueError, match="positive"):
+        pt.transform_batch({"x": np.array([-1.0])})
