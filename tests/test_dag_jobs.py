@@ -341,7 +341,7 @@ def test_local_provider_autoscales_real_capacity():
                 break
             time.sleep(0.5)
         assert launched >= 1, "autoscaler never launched for the demand"
-        assert ray_tpu.get(refs, timeout=120) == ["scaled"] * 3
+        assert ray_tpu.get(refs, timeout=300) == ["scaled"] * 3  # generous: autoscale + agent spawn under a loaded 1-CPU box
 
         nodes = provider.non_terminated_nodes()
         assert nodes and all(provider.is_running(n) for n in nodes)
