@@ -1,0 +1,48 @@
+"""Feature-preprocessing doc-code: fit a chain on a Dataset, feed a
+training loop, reuse the fitted chain for a serving batch (reference
+analogue: doc/source/data preprocessors user guide)."""
+
+import numpy as np
+
+import ray_tpu
+import ray_tpu.data as rdata
+from ray_tpu.data.preprocessors import (
+    Chain,
+    Concatenator,
+    OneHotEncoder,
+    StandardScaler,
+)
+
+ray_tpu.init(num_cpus=2, object_store_memory=64 * 1024 * 1024)
+
+# Raw tabular rows -> model-ready feature vectors.
+ds = rdata.from_items([
+    {"age": float(20 + i % 40), "city": ["sf", "nyc", "tok"][i % 3],
+     "label": i % 2}
+    for i in range(90)
+])
+train_ds, test_ds = ds.train_test_split(0.2, shuffle=True, seed=0)
+
+pipe = Chain(
+    StandardScaler(["age"]),
+    OneHotEncoder(["city"]),
+    Concatenator(["age", "city_nyc", "city_sf", "city_tok"],
+                 output_column_name="features"),
+)
+train_feat = pipe.fit_transform(train_ds)
+
+# Batches arrive device-shaped: a (B, 4) feature matrix + labels.
+for batch in train_feat.iter_batches(batch_size=24):
+    assert batch["features"].shape[1] == 4
+    assert set(batch) == {"features", "label"}
+
+# The FITTED pipe transforms held-out data and serving-time batches
+# with the training statistics.
+assert pipe.transform(test_ds).count() == 18
+serving = pipe.transform_batch(
+    {"age": np.array([30.0]), "city": np.array(["nyc"]),
+     "label": np.array([0])})
+assert serving["features"].shape == (1, 4)
+
+ray_tpu.shutdown()
+print("OK")

@@ -1,0 +1,49 @@
+"""WebSocket serving doc-code: a deployment's ``ws_message`` handler
+streams one frame per yielded item over a single socket — the
+token-streaming chat shape (reference analogue: serve websocket docs)."""
+
+import asyncio
+import json
+
+import ray_tpu
+from ray_tpu import serve
+
+ray_tpu.init(num_cpus=2, object_store_memory=64 * 1024 * 1024)
+
+
+@serve.deployment
+class EchoChat:
+    def __call__(self, payload):  # plain HTTP POSTs still work
+        return {"via": "http"}
+
+    async def ws_message(self, message):
+        for token in str(message.get("text", "")).split():
+            yield {"token": token}
+        yield {"done": True}
+
+
+serve.run(EchoChat.bind(), route_prefix="/chat")
+port = serve.get_proxy_port()
+
+
+async def chat():
+    import aiohttp
+
+    frames = []
+    async with aiohttp.ClientSession() as session:
+        async with session.ws_connect(
+                f"http://127.0.0.1:{port}/chat") as ws:
+            await ws.send_str(json.dumps({"text": "streams over sockets"}))
+            for _ in range(4):
+                msg = await asyncio.wait_for(ws.receive(), timeout=60)
+                frames.append(json.loads(msg.data))
+    return frames
+
+
+frames = asyncio.new_event_loop().run_until_complete(chat())
+assert [f.get("token") for f in frames[:3]] == ["streams", "over", "sockets"]
+assert frames[3] == {"done": True}
+
+serve.shutdown()
+ray_tpu.shutdown()
+print("OK")
