@@ -17,7 +17,6 @@ import socket
 import subprocess
 import sys
 import threading
-from typing import Optional
 
 from ray_tpu._private import rpc
 from ray_tpu._private.config import GLOBAL_CONFIG

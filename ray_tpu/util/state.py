@@ -8,7 +8,6 @@ from __future__ import annotations
 
 import time
 from collections import Counter
-from typing import Any
 
 from ray_tpu._private.worker_context import global_runtime
 
