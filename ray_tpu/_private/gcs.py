@@ -2323,6 +2323,14 @@ class Head:
                         "resources": n.total.to_dict(),
                         "available": n.available.to_dict(),
                         "labels": n.labels,
+                        # Reference parity: ray.nodes() rows carry
+                        # NodeManagerAddress/ObjectManagerPort; these
+                        # are the agent's public control (transfer) and
+                        # raw-socket bulk endpoints.
+                        "transfer_address": self.node_transfer_addrs.get(
+                            n.node_id),
+                        "bulk_address": self.node_bulk_addrs.get(
+                            n.node_id),
                     }
                     for n in self.scheduler.nodes.values()
                 ]

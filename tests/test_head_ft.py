@@ -278,8 +278,26 @@ def test_head_restart_readopts_node_agent(tmp_path):
         agent_node = next(n["node_id"] for n in ray_tpu.nodes()
                           if n["resources"].get("side"))
 
+        # The agent's public address survives the restart; grab it
+        # while the old head can still answer nodes().
+        agent_addr = next(n["transfer_address"] for n in ray_tpu.nodes()
+                          if n["node_id"] == agent_node)
+        from ray_tpu._private import rpc as _rpc
+
+        def _agent_view():
+            c = _rpc.connect(tuple(agent_addr))
+            try:
+                return c.call("cluster_view", {}, timeout=10)
+            finally:
+                c.close()
+
         head.send_signal(signal.SIGKILL)
         head.wait(timeout=10)
+        # Baseline AFTER the old head is dead (direct agent RPC — no
+        # head involved): any view update beyond this count can only
+        # come from the NEW head's publisher, so the recovery assertion
+        # cannot pass on the stale pre-restart view.
+        updates_before = _agent_view()["updates"]
         head = _start_head(port, snap)
 
         def agent_readopted():
@@ -298,6 +316,19 @@ def test_head_restart_readopts_node_agent(tmp_path):
             return isinstance(ray_tpu.get(sided.remote(), timeout=15), int)
 
         assert _wait_for(side_task_ok, 60, "scheduling on re-adopted node")
+
+        # The agent's SYNCED resource view recovers across the restart:
+        # the new head's publisher has a fresh epoch whose snapshot the
+        # agent must accept (resource_syncer pub-id reset). `updates`
+        # must EXCEED the post-kill baseline — only new-epoch messages
+        # can move it, so a broken epoch reset fails here instead of
+        # passing on the frozen pre-restart view.
+        def view_recovered():
+            view = _agent_view()
+            alive = [x for x in view["nodes"].values() if x["alive"]]
+            return view["updates"] > updates_before and len(alive) >= 2
+
+        assert _wait_for(view_recovered, 60, "synced view after restart")
     finally:
         try:
             ray_tpu.shutdown()
