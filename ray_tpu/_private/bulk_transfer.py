@@ -27,6 +27,8 @@ import struct
 import threading
 from typing import Callable
 
+from ray_tpu._private import faultinject
+
 _REQ_HDR = struct.Struct("<I")
 _RSP_HDR = struct.Struct("<q")
 
@@ -134,6 +136,18 @@ def pull_into(addr: tuple, object_id: str, buf: memoryview, start: int,
               length: int, sock: "socket.socket | None" = None):
     """Pull [start, start+length) of an object into ``buf`` (which must
     be exactly ``length`` long). Returns the socket for reuse."""
+    if faultinject.active() is not None:
+        # Chaos plane: the bulk plane fails like a flaky link — drops
+        # and resets surface as BulkError (the caller's retry policy
+        # re-resolves and re-pulls), delays slow the stripe down.
+        try:
+            drop, _dup = faultinject.apply_send(
+                f"bulk|{addr[0]}:{addr[1]}", "bulk_pull")
+        except faultinject.FaultInjectedError as e:
+            raise BulkError(str(e)) from None
+        if drop:
+            raise BulkError(
+                f"injected bulk-pull loss for {object_id} from {addr}")
     if sock is None:
         sock = socket.create_connection(addr, timeout=60)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -154,19 +168,37 @@ def pull_into(addr: tuple, object_id: str, buf: memoryview, start: int,
     return sock
 
 
-def pull_object(addr: tuple, object_id: str, size: int,
-                streams: int = 4, stripe_min: int = 8 << 20) -> bytearray:
-    """Pull a whole object with up to ``streams`` parallel stripe
-    connections (one connection when the object is small)."""
-    out = bytearray(size)
-    mv = memoryview(out)
-    n_streams = max(1, min(streams, size // stripe_min))
-    if n_streams == 1:
-        sock = pull_into(addr, object_id, mv, 0, size)
+def _pull_stripe(addr: tuple, object_id: str, view: memoryview, start: int,
+                 length: int, retry) -> None:
+    """One stripe, retried per the policy (fresh connection each
+    attempt — a reset socket is never reused)."""
+
+    def _attempt(_budget):
+        sock = pull_into(addr, object_id, view, start, length)
         try:
             sock.close()
         except OSError:
             pass
+
+    if retry is None:
+        _attempt(None)
+    else:
+        retry.run(_attempt, retry_on=(BulkError, OSError),
+                  describe=f"bulk pull {object_id}[{start}:{start+length}]")
+
+
+def pull_object(addr: tuple, object_id: str, size: int,
+                streams: int = 4, stripe_min: int = 8 << 20,
+                retry=None) -> bytearray:
+    """Pull a whole object with up to ``streams`` parallel stripe
+    connections (one connection when the object is small). ``retry``
+    (a retry.RetryPolicy) makes each stripe survive transient resets /
+    injected drops with backoff instead of failing the whole pull."""
+    out = bytearray(size)
+    mv = memoryview(out)
+    n_streams = max(1, min(streams, size // stripe_min))
+    if n_streams == 1:
+        _pull_stripe(addr, object_id, mv, 0, size, retry)
         return out
     stripe = (size + n_streams - 1) // n_streams
     errors: list = []
@@ -174,11 +206,7 @@ def pull_object(addr: tuple, object_id: str, size: int,
     def _one(i: int) -> None:
         s, e = i * stripe, min((i + 1) * stripe, size)
         try:
-            sock = pull_into(addr, object_id, mv[s:e], s, e - s)
-            try:
-                sock.close()
-            except OSError:
-                pass
+            _pull_stripe(addr, object_id, mv[s:e], s, e - s, retry)
         except Exception as exc:  # noqa: BLE001 — reraised below
             errors.append(exc)
 

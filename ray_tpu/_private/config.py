@@ -58,8 +58,35 @@ class Config:
     # --- fault tolerance ---
     task_max_retries_default: int = 3
     actor_max_restarts_default: int = 0
+    # Agent heartbeat cadence / the head's death grace for a silent
+    # (partitioned, not just disconnected) node — reference:
+    # gcs_health_check_manager.h:45 period/timeout pair.
     health_check_period_s: float = 1.0
     health_check_timeout_s: float = 30.0
+
+    # --- chaos plane / unified retry policy ---
+    # Deterministic fault injection (faultinject.py): JSON spec with a
+    # seed and drop/delay/dup/error/partition rules, filterable by peer
+    # and message kind. Usually set via the RAY_TPU_FAULT_SPEC env var
+    # so spawned agents/workers inherit it.
+    fault_spec: dict | None = None
+    # RetryPolicy defaults (retry.py; reference analogue: the retryable
+    # gRPC client's backoff + server-unavailable timeout,
+    # rpc/retryable_grpc_client.h). Applied at the idempotent control-
+    # plane edges: registration, owner-plane fetches, bulk pulls,
+    # reconnect loops.
+    rpc_retry_max_attempts: int = 4
+    rpc_retry_base_delay_s: float = 0.05
+    rpc_retry_max_delay_s: float = 2.0
+    rpc_retry_jitter: float = 0.2
+    rpc_retry_deadline_s: float = 30.0
+    rpc_attempt_timeout_s: float = 10.0
+    # Circuit breaker: consecutive failures against one target before
+    # calls fail fast, and how long the circuit stays open.
+    rpc_breaker_threshold: int = 5
+    rpc_breaker_reset_s: float = 5.0
+    # TCP connect timeout for control-plane dials (was hardcoded 30 s).
+    rpc_connect_timeout_s: float = 30.0
     # Lineage reconstruction (reference: task_manager.h:223 max_lineage_bytes,
     # object_recovery_manager.h:43): producing TaskSpecs retained per return
     # object, re-executed when a freed/lost object is fetched again.

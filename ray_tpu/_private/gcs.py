@@ -324,6 +324,13 @@ class Head:
         self.stats = {"tasks_finished": 0, "tasks_failed": 0}
         self.node_agents: dict[str, rpc.Connection] = {}  # node_id -> agent conn
         self.node_transfer_addrs: dict[str, tuple] = {}  # node_id -> (ip, port)
+        # Liveness beyond the TCP session (reference: GCS health checks,
+        # gcs_health_check_manager.h:45): agents heartbeat every
+        # health_check_period_s; a node silent past
+        # health_check_timeout_s is declared dead even though its
+        # connection never closed — the partitioned-node case the
+        # conn-close lease alone cannot see.
+        self._agent_last_seen: dict[str, float] = {}
         from concurrent.futures import ThreadPoolExecutor
 
         # Meta replies (which may embed payload bytes for remote clients)
@@ -423,6 +430,13 @@ class Head:
         )
         self._dispatcher.start()
 
+        # Health plane: declare silent/partitioned nodes dead after the
+        # grace, and reap worker records whose process never registered
+        # (a spawn cast lost to a fault/crash would otherwise hold a
+        # pool slot and its leased tasks forever).
+        threading.Thread(target=self._health_loop, daemon=True,
+                         name="head-health").start()
+
         # Resource-view syncer (reference: ray_syncer.h:83): replicate
         # version-stamped node resource views to every agent so state
         # reads and spillback pre-filtering never funnel through the
@@ -470,6 +484,12 @@ class Head:
                 for _ in range(n):
                     if self._shutdown:
                         return
+                    # Dispatch may have spawned workers during warmup:
+                    # re-check the pool cap per respawn so the deferred
+                    # batch tops the pool up without overshooting it.
+                    with self.lock:
+                        if not self._can_spawn(self.node_id):
+                            return
                     try:
                         self.spawn_worker(self.node_id)
                     except Exception:
@@ -673,21 +693,30 @@ class Head:
         worker_id = "worker-" + uuid.uuid4().hex[:8]
         rec = WorkerRecord(worker_id, node_id, None, tpu_capable)
         with self.lock:
-            agent = self.node_agents.get(node_id)
             self.workers[worker_id] = rec
-        if agent is not None:
+        body = {
+            "worker_id": worker_id,
+            "head": f"{self.address[0]}:{self.address[1]}",
+            "node_id": node_id,
+            "tpu_capable": tpu_capable,
+        }
+        # A transient send failure (injected reset, agent mid-re-join)
+        # re-resolves the agent connection and retries once — without
+        # sleeping: callers may hold the dispatch lock. A spawn that is
+        # lost anyway is recovered by the health loop's ghost reaper
+        # (the record never registers and is reaped after the register
+        # timeout, requeueing its leased tasks).
+        last_agent = None
+        for _ in range(2):
+            with self.lock:
+                agent = self.node_agents.get(node_id)
+            if agent is None or agent is last_agent:
+                break  # node gone (death handling owns rec) or no new conn
             try:
-                agent.cast(
-                    "spawn_worker",
-                    {
-                        "worker_id": worker_id,
-                        "head": f"{self.address[0]}:{self.address[1]}",
-                        "node_id": node_id,
-                        "tpu_capable": tpu_capable,
-                    },
-                )
+                agent.cast("spawn_worker", body)
+                break
             except rpc.ConnectionLost:
-                pass  # node-death handler cleans the record up
+                last_agent = agent
         return rec
 
     # ------------------------------------------------------------------
@@ -772,13 +801,17 @@ class Head:
             self._handle_worker_death(rec)
 
     def _handle_node_death(self, node_id: str) -> None:
-        """Agent connection dropped: the whole node is gone (reference:
-        GcsNodeManager node-death path + health checks,
-        gcs_health_check_manager.h:45 — here the TCP session IS the
-        lease). Workers of the node are declared dead so their tasks
-        retry elsewhere; the node leaves the schedulable set."""
+        """Agent connection dropped OR the node went silent past the
+        health grace: the whole node is gone (reference: GcsNodeManager
+        node-death path + health checks, gcs_health_check_manager.h:45
+        — the TCP session is the lease, heartbeats cover partitions).
+        Workers of the node are declared dead so their leased tasks
+        requeue elsewhere; the node leaves the schedulable set; objects
+        that lived only there reconstruct through lineage or error-seal
+        with provenance so waiters raise instead of hanging."""
         with self.lock:
             self.node_agents.pop(node_id, None)
+            self._agent_last_seen.pop(node_id, None)
             self.node_transfer_addrs.pop(node_id, None)
             self.node_bulk_addrs.pop(node_id, None)
             self.scheduler.mark_dead(node_id)
@@ -803,11 +836,89 @@ class Head:
                     continue
                 e.state = LOST
                 e.location = None
-                self._maybe_reconstruct(e.object_id)
+                if not self._maybe_reconstruct(e.object_id):
+                    # Unreconstructable (put() data has no lineage, or
+                    # the budget is exhausted): waiters must raise, not
+                    # hang — seal an ObjectLostError that names the
+                    # dead node and the owner.
+                    self._seal_error(
+                        e.object_id,
+                        f"ObjectLostError: object {e.object_id} was "
+                        f"lost with node {node_id} and has no lineage "
+                        f"to reconstruct from",
+                        "object_lost",
+                        provenance={"object_id": e.object_id,
+                                    "node_id": node_id,
+                                    "owner_id": e.owner_id})
             doomed = [r for r in self.workers.values() if r.node_id == node_id]
         for rec in doomed:
+            # The agent died but its worker processes may be orphaned
+            # alive and still connected: tell them to exit so ghosts
+            # don't keep computing against a node the scheduler already
+            # buried (their in-flight tasks requeue below either way).
+            if rec.conn is not None:
+                try:
+                    rec.conn.cast("kill", {})
+                except rpc.ConnectionLost:
+                    pass
             self._handle_worker_death(rec)
         self.dispatch_event.set()
+
+    # --- health plane (reference: gcs_health_check_manager.h:45) ------
+
+    def _h_agent_heartbeat(self, body: dict, conn):
+        """Agent liveness beacon (cast every health_check_period_s)."""
+        with self.lock:
+            nid = body.get("node_id")
+            if nid in self.node_agents:
+                self._agent_last_seen[nid] = time.time()
+        return None
+
+    def _health_loop(self) -> None:
+        period = max(0.1, self.config.health_check_period_s)
+        while not self._shutdown:
+            time.sleep(period)
+            try:
+                self._health_check_once()
+            except Exception:
+                traceback.print_exc()
+
+    def _health_check_once(self) -> None:
+        now = time.time()
+        grace = self.config.health_check_timeout_s
+        with self.lock:
+            silent = [
+                (nid, self.node_agents.get(nid))
+                for nid, seen in self._agent_last_seen.items()
+                if now - seen > grace and nid in self.node_agents
+            ]
+            # Worker records whose process never registered within the
+            # register timeout (spawn cast lost, interpreter crashed at
+            # boot): reap them so their pool slot frees and any leased
+            # tasks requeue — otherwise a single lost spawn_worker
+            # wedges its shape's dispatch queue forever.
+            ghosts = [
+                r for r in self.workers.values()
+                if r.conn is None and not r.ready
+                and now - r.started_at > self.config.worker_register_timeout_s
+            ]
+        for nid, conn in silent:
+            print(f"ray_tpu head: node {nid} silent for >{grace:.0f}s — "
+                  f"declaring it dead", file=sys.stderr)
+            self._handle_node_death(nid)
+            if conn is not None:
+                # Close AFTER the death handling: _on_conn_close sees
+                # the agent table already cleared and no-ops, and a
+                # healed partition re-joins through register_node.
+                try:
+                    conn.close()
+                except Exception:
+                    pass
+        for rec in ghosts:
+            print(f"ray_tpu head: worker {rec.worker_id} never registered "
+                  f"within {self.config.worker_register_timeout_s:.0f}s — "
+                  f"reaping", file=sys.stderr)
+            self._handle_worker_death(rec)
 
     # --- registration ---
 
@@ -907,6 +1018,7 @@ class Head:
                 old.peer_info.pop("node_agent_for", None)
             self.scheduler.add_node(entry)
             self.node_agents[node_id] = conn
+            self._agent_last_seen[node_id] = time.time()
             # New capacity: retry pending placement groups (also the
             # re-placement path for PGs restored from a head snapshot).
             for pg in self.pgs.values():
@@ -1744,7 +1856,9 @@ class Head:
                     t_rec["state"] = FAILED
                     t_rec["error"] = msg
                 for rid in spec.return_ids:
-                    self._seal_error(rid, msg, kind="object_lost")
+                    self._seal_error(rid, msg, kind="object_lost",
+                                     provenance={"object_id": rid,
+                                                 "owner_id": spec.owner_id})
                 return True  # error is sealed; getters unblock with it
         for dep in self._pinned_ids(spec):
             e = self.objects.get(dep)
@@ -2363,24 +2477,31 @@ class Head:
         limit = body.get("limit", 1000)
         return {"tasks": recs[-limit:]}
 
+    def _actor_row(self, a: ActorRecord) -> dict:
+        return {
+            "actor_id": a.spec.actor_id,
+            "name": a.spec.name,
+            "state": a.state,
+            "node_id": a.node_id,
+            "worker_id": a.worker_id,
+            "pid": self.workers[a.worker_id].pid if a.worker_id in self.workers else None,
+            "restarts": a.restarts,
+            "class_name": a.spec.name or a.spec.cls_func_id,
+            "resources": dict(a.spec.resources or {}),
+        }
+
     def _h_list_actors(self, body, conn):
+        actor_id = body.get("actor_id")
         with self.lock:
-            return {
-                "actors": [
-                    {
-                        "actor_id": a.spec.actor_id,
-                        "name": a.spec.name,
-                        "state": a.state,
-                        "node_id": a.node_id,
-                        "worker_id": a.worker_id,
-                        "pid": self.workers[a.worker_id].pid if a.worker_id in self.workers else None,
-                        "restarts": a.restarts,
-                        "class_name": a.spec.name or a.spec.cls_func_id,
-                        "resources": dict(a.spec.resources or {}),
-                    }
-                    for a in self.actors.values()
-                ]
-            }
+            if actor_id is not None:
+                # Point lookup pushed down (mirrors _h_list_tasks'
+                # task_id path): get_actor() and the dashboard actor
+                # drill-down must not ship the whole actor table.
+                a = self.actors.get(actor_id)
+                return {"actors": [self._actor_row(a)] if a is not None
+                        else []}
+            return {"actors": [self._actor_row(a)
+                               for a in self.actors.values()]}
 
     def _h_list_placement_groups(self, body, conn):
         with self.lock:
@@ -3150,6 +3271,8 @@ class Head:
         (gcs/gcs_server/gcs_actor_manager.h:96 max_restarts)."""
         with self.lock:
             self.workers.pop(rec.worker_id, None)
+            getattr(self, "_pending_creation_push", {}).pop(
+                rec.worker_id, None)
             self._release_worker_allocation(rec)
             # Direct seals this worker reported but whose owner never
             # confirmed: the seal died in the worker's send buffer and
@@ -3210,6 +3333,23 @@ class Head:
         """lock held."""
         actor = self.actors.get(rec.actor_id)
         if actor is None or actor.state == "DEAD":
+            return
+        if rec.conn is None and not rec.ready:
+            # The worker process never came up (lost spawn cast, boot
+            # crash — reaped by the health loop): that is a scheduling-
+            # plane failure, not an actor crash. Reschedule the
+            # creation WITHOUT charging the max_restarts budget; the
+            # stale creation task record is closed out (a fresh spec is
+            # minted by the next _try_start_actor).
+            for spec in inflight:
+                if spec.actor_creation:
+                    t = self.tasks.get(spec.task_id)
+                    if t:
+                        t["state"] = FAILED
+                        t["error"] = ("worker never registered; "
+                                      "rescheduling actor creation")
+            actor.state = "PENDING_CREATION"
+            actor.worker_id = None
             return
         will_restart = actor.spec.max_restarts != 0 and (
             actor.spec.max_restarts < 0
@@ -3336,10 +3476,16 @@ class Head:
         self.objects[object_id] = entry
         self._on_sealed(object_id)
 
-    def _seal_error(self, object_id: str, message: str, kind: str) -> None:
+    def _seal_error(self, object_id: str, message: str, kind: str,
+                    provenance: "dict | None" = None) -> None:
         from ray_tpu._private import serialization
 
-        payload = serialization.dumps({"__rtpu_error__": kind, "message": message})
+        body = {"__rtpu_error__": kind, "message": message}
+        if provenance:
+            # Structured loss context (node/owner/object); the client's
+            # _deserialize rebuilds a provenance-carrying exception.
+            body["provenance"] = provenance
+        payload = serialization.dumps(body)
         entry = self.objects.get(object_id) or ObjectEntry(object_id, "head")
         entry.inline = payload
         entry.size = len(payload)

@@ -116,12 +116,20 @@ class NodeAgent:
         self.bulk_server = BulkServer(self._bulk_read)
         self.transfer_server = rpc.Server(self._transfer_handle,
                                           host="0.0.0.0", port=0)
+        from ray_tpu._private.retry import default_policy
+
+        self._retry_policy = default_policy()
         self.conn = rpc.connect(
             head_address,
             handler=self._handle,
             name="node_agent",
             on_close=self._on_head_lost,
+            retry=self._retry_policy,
         )
+        # Registration is idempotent (re-join with the same node_id is a
+        # supported path), so it rides the unified retry policy: under
+        # injected faults a dropped register frame backs off and
+        # resends instead of killing the agent at boot.
         reply = self.conn.call(
             "register_node",
             {
@@ -133,6 +141,7 @@ class NodeAgent:
                 "bulk_port": self.bulk_server.address[1],
             },
             timeout=GLOBAL_CONFIG.worker_register_timeout_s,
+            retry=self._retry_policy,
         )
         self.node_id = reply["node_id"]
         self.session_dir = reply["session_dir"]
@@ -152,6 +161,20 @@ class NodeAgent:
             target=self._memory_watch, daemon=True, name="agent-mem-watch"
         )
         self._mem_thread.start()
+        # Liveness beacon (reference: raylet->GCS heartbeats feeding
+        # gcs_health_check_manager.h:45): lets the head declare this
+        # node dead after the health grace even when the TCP session
+        # stays technically open (partition, injected drop).
+        threading.Thread(target=self._heartbeat_loop, daemon=True,
+                         name="agent-heartbeat").start()
+
+    def _heartbeat_loop(self) -> None:
+        period = max(0.1, GLOBAL_CONFIG.health_check_period_s)
+        while not self._exit.wait(period):
+            try:
+                self.conn.cast("agent_heartbeat", {"node_id": self.node_id})
+            except (rpc.ConnectionLost, rpc.RpcError):
+                pass  # reconnect loop owns recovery
 
     def _on_head_lost(self, _conn) -> None:
         """Head connection dropped. Instead of dying (the pre-FT lease
@@ -185,6 +208,9 @@ class NodeAgent:
                     except Exception:
                         pass
         self.procs.clear()
+        from ray_tpu._private.retry import backoff_delays
+
+        delays = backoff_delays(self._retry_policy)
         while time.time() < deadline and not self._exit.is_set():
             conn = None
             try:
@@ -234,7 +260,11 @@ class NodeAgent:
                         conn.close()
                     except Exception:
                         pass
-                time.sleep(1.0)
+                # Unified backoff (was a fixed 1 s poll): decorrelated
+                # exponential delays so a head restart isn't greeted by
+                # a synchronized re-register storm from every agent.
+                time.sleep(min(next(delays),
+                               max(0.0, deadline - time.time())))
         self._exit.set()
 
     def _memory_watch(self) -> None:

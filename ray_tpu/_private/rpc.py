@@ -20,7 +20,10 @@ import struct
 import threading
 import traceback
 from concurrent.futures import Future
+from concurrent.futures import TimeoutError as _FutTimeout
 from typing import Any, Callable
+
+from ray_tpu._private import faultinject
 
 _HDR = struct.Struct("<I")
 
@@ -161,9 +164,37 @@ class Connection:
     # casts flow, e.g. pubsub fan-out of MB-sized payloads, grows the
     # queue until the process OOMs; a frame count would not bound that)
 
+    def _peer_desc(self) -> str:
+        """Descriptor the chaos plane's peer filters match against:
+        connection name plus whatever identity registration attached."""
+        info = self.peer_info
+        parts = [self.name]
+        cid = info.get("client_id")
+        if cid:
+            parts.append(cid)
+        t = info.get("type")
+        if t:
+            parts.append(t)
+        nid = info.get("node_agent_for")
+        if nid:
+            parts.append(f"node_agent_for:{nid}")
+        return "|".join(parts)
+
     def _send(self, kind: str, msg_id: int, body: Any) -> None:
         if self._closed.is_set():
             raise ConnectionLost("connection closed")
+        dup = False
+        if faultinject.active() is not None:
+            # Chaos plane (faultinject.py): a matching rule may delay
+            # (slept here, backpressuring the sender like a slow link),
+            # drop, duplicate, or reset this frame.
+            try:
+                drop, dup = faultinject.apply_send(self._peer_desc(), kind)
+            except faultinject.FaultInjectedError as e:
+                raise ConnectionLost(str(e)) from None
+            if drop:
+                return  # lost on the wire; recovery is the caller's
+                # retry policy (calls) or at-least-once design (casts)
         data = pickle.dumps((kind, msg_id, body), protocol=5)
         frame = _HDR.pack(len(data)) + data
         with self._sendq_lock:
@@ -174,6 +205,9 @@ class Connection:
                 raise ConnectionLost("connection closed")
             self._send_q.append(frame)
             self._send_q_bytes += len(frame)
+            if dup:  # injected duplication (at-least-once chaos)
+                self._send_q.append(frame)
+                self._send_q_bytes += len(frame)
         self._send_ev.set()
         if self._closed.is_set():
             # _shutdown raced the append: the writer may already have
@@ -251,8 +285,52 @@ class Connection:
             else:
                 self._send(CAST_BATCH, 0, buf)
 
-    def call(self, kind: str, body: dict | None = None, timeout: float | None = None) -> Any:
-        """Request/response; raises RpcError on remote exception."""
+    def call(self, kind: str, body: dict | None = None,
+             timeout: float | None = None, retry=None) -> Any:
+        """Request/response; raises RpcError on remote exception.
+
+        ``retry`` (a retry.RetryPolicy) turns the call into a retried
+        idempotent operation: each attempt is a FRESH request (new
+        msg_id — a late reply to a superseded attempt is discarded by
+        the pending-map pop), timeouts and transient resets back off
+        per the policy, and the policy's deadline bounds the whole
+        exchange. Only pass it for calls safe to execute at-least-once.
+        With ``retry`` given, ``timeout`` caps one attempt, not the
+        whole operation."""
+        if retry is None:
+            return self._call_once(kind, body, timeout)
+        import time as _time
+
+        deadline = (None if retry.deadline_s is None
+                    else _time.monotonic() + retry.deadline_s)
+        last: BaseException | None = None
+        for attempt in range(1, retry.max_attempts + 1):
+            budget = retry.attempt_timeout_s
+            if timeout is not None:
+                budget = timeout if budget is None else min(budget, timeout)
+            if deadline is not None:
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    break
+                budget = remaining if budget is None else min(budget,
+                                                              remaining)
+            try:
+                return self._call_once(kind, body, budget)
+            except _FutTimeout as e:
+                last = e
+            except ConnectionLost as e:
+                if self._closed.is_set():
+                    raise  # socket is gone for good: resending here is
+                    # hopeless — the caller owns re-dialing
+                last = e  # injected/transient reset: retry
+            if attempt < retry.max_attempts:
+                _time.sleep(retry.delay(attempt))
+        if last is None:
+            last = _FutTimeout(f"call {kind!r}: retry deadline exhausted")
+        raise last
+
+    def _call_once(self, kind: str, body: dict | None,
+                   timeout: float | None) -> Any:
         self.flush_casts()
         fut: Future = Future()
         with self._pending_lock:
@@ -295,6 +373,9 @@ class Connection:
             if body is None:
                 break
             kind, msg_id, payload = pickle.loads(body)
+            if faultinject.active() is not None and faultinject.apply_recv(
+                    self._peer_desc(), kind):
+                continue  # injected recv-side loss
             if kind == REPLY or kind == ERROR:
                 with self._pending_lock:
                     fut = self._pending.pop(msg_id, None)
@@ -464,7 +545,24 @@ class Server:
             c.close()
 
 
-def connect(address: tuple[str, int], handler=None, on_close=None, name: str = "") -> Connection:
-    sock = socket.create_connection(address, timeout=30)
-    sock.settimeout(None)
+def connect(address: tuple[str, int], handler=None, on_close=None,
+            name: str = "", retry=None) -> Connection:
+    """Dial a peer. ``retry`` (a retry.RetryPolicy) backs off transient
+    dial failures (connection refused mid-restart, injected resets)
+    instead of failing on the first; the policy's deadline bounds the
+    whole dial. The connect timeout itself comes from config
+    (rpc_connect_timeout_s) instead of the old hardcoded 30 s."""
+    from ray_tpu._private.config import GLOBAL_CONFIG as _cfg
+
+    def _dial(budget: "float | None") -> socket.socket:
+        sock = socket.create_connection(
+            address, timeout=budget or _cfg.rpc_connect_timeout_s)
+        sock.settimeout(None)
+        return sock
+
+    if retry is None:
+        sock = _dial(_cfg.rpc_connect_timeout_s)
+    else:
+        sock = retry.run(_dial, retry_on=(OSError,),
+                         describe=f"connect {address}")
     return Connection(sock, handler, on_close, name=name)

@@ -160,8 +160,13 @@ class CoreRuntime:
         # skip the shm fast path; the head ships object payloads inline
         # over the connection.
         can_shm = not force_remote and os.environ.get("RAY_TPU_REMOTE") != "1"
+        from ray_tpu._private.retry import default_policy
         from ray_tpu._private.task_spec import _specenc
 
+        # Registration is idempotent on one connection (the head drops a
+        # stale same-conn registration), so it rides the unified retry
+        # policy — a dropped/delayed register frame under injected
+        # faults backs off and resends instead of failing init.
         reg = self.conn.call(
             "register",
             {"client_type": client_type, "worker_id": worker_id,
@@ -169,6 +174,7 @@ class CoreRuntime:
              "owner_addr": self.owner_addr,
              "specenc": _specenc() is not None},
             timeout=GLOBAL_CONFIG.worker_register_timeout_s,
+            retry=default_policy(),
         )
         # Compiled-spec negotiation: pack only when the head can unpack
         # (mixed hosts may lack the extension; Makefile skips it there).
@@ -299,6 +305,9 @@ class CoreRuntime:
     def _reconnect_loop(self) -> None:
         import time
 
+        from ray_tpu._private.retry import backoff_delays, default_policy
+
+        delays = backoff_delays(default_policy())
         deadline = time.time() + GLOBAL_CONFIG.driver_reconnect_grace_s
         while not self._closed and time.time() < deadline:
             conn = None
@@ -354,7 +363,10 @@ class CoreRuntime:
                         conn.close()
                     except Exception:
                         pass
-                time.sleep(1.0)
+                # Unified backoff (was a fixed 1 s poll): fast first
+                # retries after a blip, capped exponential after.
+                time.sleep(min(next(delays),
+                               max(0.0, deadline - time.time())))
 
     def _new_waiter(self) -> tuple[str, Future]:
         waiter_id = uuid.uuid4().hex[:16]
@@ -554,25 +566,49 @@ class CoreRuntime:
     def _peer_owner_conn(self, addr: tuple,
                          expect_owner: "str | None" = None
                          ) -> rpc.Connection:
+        from ray_tpu._private.retry import (CircuitOpenError, breaker_for,
+                                            default_policy)
+
         with self._owner_conns_lock:
             c = self._owner_conns.get(addr)
         if c is None or c.closed:
-            c = rpc.connect(addr, name="owner-peer")
+            # Per-owner circuit breaker (unified retry plane): once an
+            # owner address has failed the threshold consecutively, stop
+            # paying a dial+handshake timeout per caller — fail fast so
+            # gets fall back to head routing / ObjectLostError within
+            # milliseconds instead of convoying on a dead peer.
+            breaker = breaker_for(f"owner:{addr[0]}:{addr[1]}")
+            if not breaker.allow():
+                raise rpc.RpcError(
+                    f"owner address {addr} circuit open "
+                    f"({breaker.threshold} consecutive failures)")
+            try:
+                c = rpc.connect(addr, name="owner-peer")
+            except OSError:
+                breaker.record_failure()
+                raise
             # Verify who answered: an advertised loopback address dialed
             # from another host reaches the WRONG process — one-way
             # seals would vanish silently. One RPC per (peer, addr). A
             # failed handshake is NOT cached as trusted: the connection
             # is dropped and the caller falls back to head routing.
+            # Retried per the policy: an injected drop of the whoami
+            # frame must not misclassify a healthy owner as dead.
             try:
-                who = c.call("whoami", {}, timeout=10)
+                who = c.call("whoami", {}, timeout=10,
+                             retry=default_policy(deadline_s=10.0,
+                                                  attempt_timeout_s=3.0))
                 c.peer_info["owner_id"] = who.get("client_id")
-            except (rpc.RpcError, rpc.ConnectionLost):
+            except (rpc.RpcError, rpc.ConnectionLost, CircuitOpenError,
+                    FutureTimeoutError):
+                breaker.record_failure()
                 try:
                     c.close()
                 except Exception:
                     pass
                 raise rpc.RpcError(
                     f"owner address {addr} failed identity check")
+            breaker.record_success()
             with self._owner_conns_lock:
                 self._owner_conns[addr] = c
         if (expect_owner is not None
@@ -786,18 +822,16 @@ class CoreRuntime:
         if not host:
             host = self.address[0]  # "" = the head host this client dialed
         from ray_tpu._private import bulk_transfer
+        from ray_tpu._private.retry import default_policy
 
-        try:
-            return bulk_transfer.pull_object(
-                (host, port), object_id, size,
-                streams=GLOBAL_CONFIG.bulk_streams)
-        except (bulk_transfer.BulkError, OSError):
-            # One retry: transient resets / a replica freed between the
-            # meta and the pull. The retry scope upstream
-            # (_read_p2p_retrying) re-resolves the meta on failure.
-            return bytes(bulk_transfer.pull_object(
-                (host, port), object_id, size,
-                streams=GLOBAL_CONFIG.bulk_streams))
+        # Per-stripe backoff under the unified policy (replaces the old
+        # hand-rolled single re-try): transient resets / injected drops
+        # re-pull the stripe; the retry scope upstream
+        # (_read_p2p_retrying) re-resolves the meta on terminal failure.
+        return bulk_transfer.pull_object(
+            (host, port), object_id, size,
+            streams=GLOBAL_CONFIG.bulk_streams,
+            retry=default_policy())
 
     def _pull_p2p_legacy(self, object_id: str, addr: tuple,
                          size: int) -> bytes:
@@ -808,14 +842,18 @@ class CoreRuntime:
         if conn is None or conn.closed:
             conn = self._peer_conns[key] = rpc.connect(
                 (addr[0], int(addr[1])), name="pull")
+        from ray_tpu._private.retry import default_policy
+
         chunk = GLOBAL_CONFIG.p2p_chunk_size
         buf = bytearray(size)
         pos = 0
+        policy = default_policy(attempt_timeout_s=120.0,
+                                deadline_s=None)
         while pos < size:
             reply = conn.call("pull", {"object_id": object_id,
                                        "start": pos,
                                        "length": min(chunk, size - pos)},
-                              timeout=120)
+                              timeout=120, retry=policy)
             data = reply["data"]
             buf[pos:pos + len(data)] = data
             pos += len(data)
@@ -1021,11 +1059,17 @@ class CoreRuntime:
                     raise GetTimeoutError(
                         f"get timed out awaiting owned object {hex_id}")
                 return self._deserialize(*v)
+            from ray_tpu._private.retry import default_policy
+
             try:
+                # Idempotent read: retried per the unified policy, so an
+                # injected drop/delay costs one backoff, not the object.
                 r = self._peer_owner_conn(
                     (host, port), expect_owner=owner_id).call(
-                    "fetch_object", {"object_id": hex_id}, timeout=60)
-            except (OSError, rpc.RpcError, rpc.ConnectionLost):
+                    "fetch_object", {"object_id": hex_id}, timeout=60,
+                    retry=default_policy())
+            except (OSError, rpc.RpcError, rpc.ConnectionLost,
+                    FutureTimeoutError):
                 # The owner may have moved the value (e.g. a retried
                 # task's head-routed attempt replaced its store entry
                 # with a marker): re-resolve through the head once
@@ -1036,7 +1080,8 @@ class CoreRuntime:
                     return self._value_from_meta(hex_id, fresh, read_ids,
                                                  deadline)
                 raise ObjectLostError(
-                    f"object {hex_id}: owner at {host}:{port} is gone"
+                    f"object {hex_id}: owner at {host}:{port} is gone",
+                    object_id=hex_id, owner_id=owner_id,
                 ) from None
             return self._deserialize(r["payload"], r["is_error"])
         if meta[0] == "shm":
@@ -1194,7 +1239,8 @@ class CoreRuntime:
         if addr is None:
             raise ObjectLostError(
                 f"object {object_id} lives on node {node_id} with no "
-                f"reachable transfer server")
+                f"reachable transfer server",
+                object_id=object_id, node_id=node_id)
         payload = self._pull_p2p(object_id, addr, size)
         if (self.agent_shm is not None and not is_error
                 and node_id != self.node_id
@@ -1244,6 +1290,15 @@ class CoreRuntime:
         if is_error:
             if isinstance(value, dict) and "__rtpu_error__" in value:
                 exc_cls = _ERROR_KINDS.get(value["__rtpu_error__"], RayTpuError)
+                if exc_cls is ObjectLostError:
+                    # Head-sealed losses carry provenance (which object,
+                    # which node's death lost it, who owned it).
+                    prov = value.get("provenance") or {}
+                    raise ObjectLostError(
+                        value["message"],
+                        object_id=prov.get("object_id"),
+                        node_id=prov.get("node_id"),
+                        owner_id=prov.get("owner_id"))
                 raise exc_cls(value["message"])
             if isinstance(value, BaseException):
                 raise value

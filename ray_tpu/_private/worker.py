@@ -780,8 +780,15 @@ class Worker:
                 or getattr(self, "_retiring_sent", False):
             return
         try:
-            if self._task_q or not self._executor_for(spec)._work_queue.empty():
-                return  # drain the pipeline window first
+            # Sent IMMEDIATELY once the budget trips — not gated on an
+            # empty pipeline queue: under sustained dispatch the head
+            # keeps the queue non-empty at nearly every completion, so
+            # the old gate could defer retirement for a whole flood
+            # (exactly the native-leak workload max_calls bounds). The
+            # head stops dispatching to a retiring worker and its
+            # _maybe_release_retiree waits for the inflight window AND
+            # pending owner-seal confirmations to drain before casting
+            # exit_worker, so already-queued tasks still complete.
             self._flush_seals()
             self.runtime.conn.flush_casts()
             # Handshake, not immediate exit: dying before the OWNER

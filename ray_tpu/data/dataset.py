@@ -377,8 +377,11 @@ class Dataset:
         Dataset.write_datasink / datasource.Datasink lifecycle:
         on_write_start -> write(block) per block -> on_write_complete,
         or on_write_failed with the exception)."""
-        datasink.on_write_start()
         try:
+            # on_write_start inside the try: a staging-setup failure is
+            # a write failure per the documented lifecycle and must
+            # route through on_write_failed before re-raising.
+            datasink.on_write_start()
             for block in self.iter_blocks():
                 datasink.write(block)
         except Exception as e:

@@ -48,7 +48,36 @@ class ActorUnavailableError(ActorError):
 
 
 class ObjectLostError(RayTpuError):
-    """The object's value was lost and could not be reconstructed."""
+    """The object's value was lost and could not be reconstructed.
+
+    Carries provenance when the runtime knows it (reference analogue:
+    ray.exceptions.ObjectLostError's object_ref_hex/owner context):
+    which object, which node hosted the payload, who owned it — so a
+    node-death loss reads as "lost with node-X" instead of a bare hang
+    or an anonymous timeout.
+    """
+
+    def __init__(self, message: str, *, object_id: str | None = None,
+                 node_id: str | None = None, owner_id: str | None = None):
+        self.object_id = object_id
+        self.node_id = node_id
+        self.owner_id = owner_id
+        prov = ", ".join(
+            f"{k}={v}" for k, v in (("object", object_id),
+                                    ("node", node_id),
+                                    ("owner", owner_id)) if v)
+        super().__init__(f"{message} [{prov}]" if prov else message)
+        self._message = message
+
+    def __reduce__(self):
+        return (_rebuild_object_lost,
+                (self._message, self.object_id, self.node_id,
+                 self.owner_id))
+
+
+def _rebuild_object_lost(message, object_id, node_id, owner_id):
+    return ObjectLostError(message, object_id=object_id, node_id=node_id,
+                           owner_id=owner_id)
 
 
 class ObjectStoreFullError(RayTpuError):

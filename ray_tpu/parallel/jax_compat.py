@@ -1,0 +1,49 @@
+"""Version-bridging shims for the jax APIs the parallel layer uses.
+
+The codebase targets current jax: ``jax.shard_map`` as a public
+callable, the VMA (varying-manual-axes) system with ``lax.pcast`` and
+the ``check_vma`` kwarg. Older jax (< 0.5) ships shard_map under
+``jax.experimental.shard_map`` and has no VMA tracking at all. These
+shims delegate directly on new jax and degrade faithfully on old:
+
+  - ``shard_map``: same signature either way; ``check_vma`` maps to the
+    old ``check_rep`` kwarg (both gate the same static replication
+    check, just over different tracking machinery).
+  - ``pcast``: a VMA *annotation* (marks a value per-axis varying), not
+    data movement — the identity where VMA does not exist.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def shard_map(f, *, mesh, in_specs, out_specs,
+              check_vma: "bool | None" = None, **kw):
+    if hasattr(jax, "shard_map"):
+        if check_vma is not None:
+            kw["check_vma"] = check_vma
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, **kw)
+    from jax.experimental.shard_map import shard_map as _sm
+
+    if check_vma is not None:
+        kw["check_rep"] = check_vma
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)
+
+
+def pcast(x, axes, to: str = "varying"):
+    fn = getattr(jax.lax, "pcast", None)
+    if fn is not None:
+        return fn(x, axes, to=to)
+    return x  # pre-VMA jax: nothing to annotate
+
+
+def axis_size(axis_name):
+    """``lax.axis_size`` (new jax) or the classic ``psum(1, axis)``
+    idiom (pre-0.5 jax) — both yield the mapped axis's size inside
+    shard_map/pmap."""
+    fn = getattr(jax.lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis_name)
+    return jax.lax.psum(1, axis_name)

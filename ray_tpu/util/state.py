@@ -56,7 +56,16 @@ def list_tasks(*, filters=None, limit: int = 1000) -> list[dict]:
 
 
 def list_actors(*, filters=None, limit: int = 1000) -> list[dict]:
-    rows = _call("list_actors")["actors"]
+    # An actor_id equality filter is a point lookup — pushed down to the
+    # head (mirrors the task_id pushdown in list_tasks) so drill-downs
+    # never ship the whole actor table.
+    filters = list(filters or [])
+    body: dict = {}
+    for f in list(filters):
+        if f[1] == "=" and f[0] == "actor_id":
+            body["actor_id"] = f[2]
+            filters.remove(f)
+    rows = _call("list_actors", body)["actors"]
     return _filtered(rows, filters)[:limit]
 
 
@@ -98,9 +107,10 @@ def get_task(task_id: str) -> "dict | None":
 
 
 def get_actor(actor_id: str) -> "dict | None":
-    """One actor's record (reference: util/state/api.py get_actor)."""
-    rows = _call("list_actors")["actors"]
-    return next((dict(r) for r in rows if r.get("actor_id") == actor_id), None)
+    """One actor's record (reference: util/state/api.py get_actor).
+    Point lookup pushed down to the head — never ships the table."""
+    rows = _call("list_actors", {"actor_id": actor_id})["actors"]
+    return dict(rows[0]) if rows else None
 
 
 def summarize_tasks() -> dict:

@@ -1,0 +1,118 @@
+"""Shared helpers for the chaos-plane test matrix.
+
+Modeled on the reference's fault-injection strategy (SURVEY.md §4 —
+RayletKiller / WorkerKillerActor in _private/test_utils.py:1449): spec
+builders for the deterministic fault plane (faultinject.py), agent
+process management for whole-node death tests, and busy-worker killers.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import ray_tpu
+
+
+def drop_delay_spec(peer: str = "node_agent", *, drop: float = 0.05,
+                    delay_ms: float = 50.0, seed: int = 7,
+                    kind: str = "*", direction: str = "send") -> dict:
+    """The acceptance-criteria spec: probabilistic drop + added latency
+    on every message matching ``peer``/``kind``."""
+    return {"seed": seed, "rules": [
+        {"peer": peer, "kind": kind, "direction": direction,
+         "drop": drop, "delay_ms": delay_ms},
+    ]}
+
+
+def partition_spec(kind: str, peer: str = "", seed: int = 11) -> dict:
+    """Hard partition: drop EVERYTHING matching the filter."""
+    return {"seed": seed, "rules": [
+        {"peer": peer, "kind": kind, "partition": True},
+    ]}
+
+
+def spec_env(spec: dict, base: "dict | None" = None) -> dict:
+    """Env for a subprocess that should boot with the fault plane on."""
+    env = dict(os.environ if base is None else base)
+    env["RAY_TPU_FAULT_SPEC"] = json.dumps(spec)
+    return env
+
+
+def start_agent(address: str, *, node_id: str, num_cpus: int = 4,
+                resources: "dict | None" = None,
+                force_remote: bool = True,
+                extra_env: "dict | None" = None) -> subprocess.Popen:
+    """One node agent joining ``address`` (same pattern as
+    test_multinode, plus an env hook for fault specs)."""
+    cmd = [
+        sys.executable, "-m", "ray_tpu._private.node_agent",
+        "--address", address, "--num-cpus", str(num_cpus),
+        "--node-id", node_id,
+    ]
+    if resources:
+        cmd += ["--resources", json.dumps(resources)]
+    if force_remote:
+        cmd.append("--force-remote-objects")
+    env = dict(os.environ)
+    env.pop("RAY_TPU_REMOTE", None)
+    env.pop("RAY_TPU_FAULT_SPEC", None)
+    env.update(extra_env or {})
+    return subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT)
+
+
+def stop_agent(agent: "subprocess.Popen | None") -> None:
+    if agent is not None and agent.poll() is None:
+        agent.kill()
+        try:
+            agent.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            pass
+
+
+def wait_nodes(n: int, timeout: float = 30.0) -> None:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        alive = [x for x in ray_tpu.nodes() if x["alive"]]
+        if len(alive) >= n:
+            return
+        time.sleep(0.2)
+    raise TimeoutError(f"cluster never reached {n} nodes: {ray_tpu.nodes()}")
+
+
+def wait_alive_nodes_at_most(n: int, timeout: float = 30.0) -> None:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        alive = [x for x in ray_tpu.nodes() if x["alive"]]
+        if len(alive) <= n:
+            return
+        time.sleep(0.2)
+    raise TimeoutError(
+        f"node never declared dead: {[x for x in ray_tpu.nodes() if x['alive']]}")
+
+
+def kill_busy_workers(count: int, deadline_s: float = 20.0,
+                      sleep_s: float = 0.2) -> int:
+    """SIGKILL up to ``count`` busy non-actor workers (never ourselves).
+    Returns how many were actually killed."""
+    from ray_tpu.util import state as us
+
+    my_pid = os.getpid()
+    killed = 0
+    deadline = time.monotonic() + deadline_s
+    while killed < count and time.monotonic() < deadline:
+        busy = [w for w in us.list_workers(filters=[("busy", "=", "True")])
+                if w["pid"] not in (None, my_pid) and not w["actor_id"]]
+        if busy:
+            try:
+                os.kill(busy[0]["pid"], signal.SIGKILL)
+                killed += 1
+            except ProcessLookupError:
+                pass
+        time.sleep(sleep_s)
+    return killed

@@ -55,7 +55,13 @@ def pytest_configure(config):
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 8)
+    try:
+        jax.config.update("jax_num_cpu_devices", 8)
+    except AttributeError:
+        # Older jax (< 0.5) has no jax_num_cpu_devices option; the
+        # XLA_FLAGS host_platform_device_count pin set above (before
+        # any backend init) provides the same 8-device CPU mesh.
+        pass
     assert jax.device_count() == 8, (
         "tests require the virtual 8-device CPU mesh, got "
         f"{jax.devices()}"
@@ -88,6 +94,12 @@ _SLOW_MODULES: set = set()
 # test_core::test_simple_task is deliberately NOT here: its measured
 # 60 s is one-time cluster warmup (native build + worker jax imports)
 # that whichever test runs first would pay anyway, and it is the canary.
+# Re-measured 2026-08 (chaos-plane PR): fast tier = 232 s reported /
+# <4 min wall on an undisturbed run — under the 300 s budget with no
+# further demotions; the chaos-plane workload matrix is slow-marked
+# inline (tests/test_chaos_plane.py), its SIGKILL/partition recovery
+# tests deliberately stay fast. Measure on an idle box only: parallel
+# pytest sessions inflate sub-second tests to tens of seconds.
 _SLOW_TESTS = {
     "test_graft_entry::test_dryrun_multichip_8",
     "test_train_elastic::test_elastic_restart_shrinks_world",

@@ -1,0 +1,547 @@
+"""Chaos plane: deterministic fault injection, unified retry policy,
+whole-node death recovery.
+
+Mirrors the reference's fault-injection strategy (SURVEY.md §4 — every
+RPC edge has retry/timeout semantics, the GCS reconciles node death
+end-to-end, and faults are a *tested input*): the matrix injects
+drop/delay/dup/error/partition into the transport (faultinject.py),
+asserts the RetryPolicy absorbs them, and exercises whole-node death —
+SIGKILL and partition — asserting requeue + lineage reconstruction +
+actor restart and a provenance-carrying ObjectLostError instead of a
+hang for unreconstructable objects.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import time
+from concurrent.futures import TimeoutError as FutTimeout
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private import faultinject, rpc
+from ray_tpu._private.faultinject import FaultPlane
+from ray_tpu._private.retry import (CircuitBreaker, CircuitOpenError,
+                                    RetryPolicy)
+from ray_tpu._private.worker_context import get_head
+from ray_tpu.exceptions import ObjectLostError
+from ray_tpu.util.placement_group import (placement_group,
+                                          remove_placement_group)
+
+import chaos_utils as cu
+
+# ---------------------------------------------------------------------------
+# fault plane: determinism + filtering (no cluster)
+
+
+def test_fault_plane_same_seed_same_decisions():
+    spec = {"seed": 42, "rules": [{"drop": 0.3}]}
+    p1, p2 = FaultPlane.from_spec(spec), FaultPlane.from_spec(spec)
+    seq1 = [p1.decide("send", "p", "k") is not None for _ in range(300)]
+    seq2 = [p2.decide("send", "p", "k") is not None for _ in range(300)]
+    assert seq1 == seq2
+    assert any(seq1) and not all(seq1)  # actually probabilistic
+
+
+def test_fault_plane_different_seed_differs():
+    s1 = [FaultPlane.from_spec({"seed": 1, "rules": [{"drop": 0.5}]})
+          .decide("send", "p", "k") is not None for _ in range(200)]
+    p2 = FaultPlane.from_spec({"seed": 2, "rules": [{"drop": 0.5}]})
+    s2 = [p2.decide("send", "p", "k") is not None for _ in range(200)]
+    assert s1 != s2
+
+
+def test_fault_rules_filter_by_peer_and_kind():
+    plane = FaultPlane.from_spec({"rules": [
+        {"peer": "node_agent", "kind": "spawn_*", "partition": True}]})
+    assert plane.decide("send", "node_agent|x", "spawn_worker").drop
+    assert plane.decide("send", "node_agent|x", "task_finished") is None
+    assert plane.decide("send", "worker|w-1", "spawn_worker") is None
+    # recv direction not matched by a send-direction rule
+    assert plane.decide("recv", "node_agent|x", "spawn_worker") is None
+
+
+def test_fault_rule_rejects_unknown_fields():
+    with pytest.raises(ValueError, match="unknown fault-rule"):
+        FaultPlane.from_spec({"rules": [{"dorp": 0.5}]})
+
+
+def test_partition_rule_drops_everything():
+    plane = FaultPlane.from_spec({"rules": [
+        {"kind": "agent_heartbeat", "partition": True}]})
+    for _ in range(50):
+        act = plane.decide("send", "anything", "agent_heartbeat")
+        assert act is not None and act.drop
+    assert plane.stats["drop:agent_heartbeat"] == 50
+
+
+def test_inject_context_scopes_and_restores():
+    assert faultinject.active() is None or True  # whatever the env says
+    before = faultinject.active()
+    with faultinject.inject({"rules": [{"drop": 1.0}]}) as plane:
+        assert faultinject.active() is plane
+        with faultinject.inject({"rules": []}) as inner:
+            assert faultinject.active() is inner
+        assert faultinject.active() is plane
+    assert faultinject.active() is before
+
+
+def test_delay_action_sleeps_on_send():
+    with faultinject.inject({"rules": [
+            {"kind": "ping", "delay_ms": 80}]}) as plane:
+        t0 = time.monotonic()
+        drop, dup = faultinject.apply_send("p", "ping")
+        took = time.monotonic() - t0
+        assert not drop and not dup
+        assert took >= 0.06
+        assert plane.stats["delay:ping"] == 1
+
+
+# ---------------------------------------------------------------------------
+# retry policy + circuit breaker (no cluster)
+
+
+def test_retry_policy_backoff_grows_and_caps():
+    p = RetryPolicy(base_delay_s=0.1, multiplier=2.0, max_delay_s=0.5,
+                    jitter=0.0)
+    assert [p.delay(i) for i in (1, 2, 3, 4, 5)] == [0.1, 0.2, 0.4, 0.5, 0.5]
+    pj = RetryPolicy(base_delay_s=0.1, jitter=0.2)
+    for i in range(1, 5):
+        assert 0.0 <= pj.delay(i) <= pj.max_delay_s * 1.2
+
+
+def test_retry_policy_run_retries_then_succeeds():
+    calls = []
+
+    def flaky(_budget):
+        calls.append(_budget)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    p = RetryPolicy(max_attempts=5, base_delay_s=0.01, jitter=0.0)
+    assert p.run(flaky, retry_on=(OSError,)) == "ok"
+    assert len(calls) == 3
+
+
+def test_retry_policy_deadline_bounds_total_time():
+    p = RetryPolicy(max_attempts=100, base_delay_s=0.05, max_delay_s=0.05,
+                    jitter=0.0, deadline_s=0.3, attempt_timeout_s=None)
+    t0 = time.monotonic()
+    with pytest.raises(OSError):
+        p.run(lambda _b: (_ for _ in ()).throw(OSError("down")),
+              retry_on=(OSError,))
+    assert time.monotonic() - t0 < 2.0
+
+
+def test_retry_policy_non_retryable_propagates_immediately():
+    calls = []
+
+    def boom(_b):
+        calls.append(1)
+        raise ValueError("logic bug")
+
+    with pytest.raises(ValueError):
+        RetryPolicy(max_attempts=5, base_delay_s=0.01).run(
+            boom, retry_on=(OSError,))
+    assert len(calls) == 1
+
+
+def test_circuit_breaker_opens_and_half_open_probe():
+    b = CircuitBreaker(threshold=3, reset_s=0.2, name="t")
+    for _ in range(3):
+        assert b.allow()
+        b.record_failure()
+    assert b.open and not b.allow()  # open: fail fast
+    time.sleep(0.25)
+    assert b.allow()       # the single half-open probe
+    assert not b.allow()   # concurrent callers still fail fast
+    b.record_success()
+    assert b.allow() and not b.open
+
+
+def test_retry_run_respects_open_breaker():
+    b = CircuitBreaker(threshold=1, reset_s=60.0)
+    b.record_failure()
+    with pytest.raises(CircuitOpenError):
+        RetryPolicy(max_attempts=3, base_delay_s=0.01).run(
+            lambda _b: "never", breaker=b, describe="probe")
+
+
+# ---------------------------------------------------------------------------
+# rpc transport under injection (loopback server, no cluster)
+
+
+@pytest.fixture()
+def echo_pair():
+    hits = {"echo": 0, "note": 0}
+
+    def handler(kind, body, conn):
+        if kind in hits:
+            hits[kind] += 1
+        return body
+
+    server = rpc.Server(handler)
+    conn = rpc.connect(("127.0.0.1", server.address[1]), name="chaos-client")
+    yield conn, hits
+    conn.close()
+    server.stop()
+
+
+def test_call_retry_absorbs_dropped_replies(echo_pair):
+    conn, hits = echo_pair
+    # Half the replies vanish; the retried call resends (fresh msg_id)
+    # and lands within the attempt budget.
+    with faultinject.inject({"seed": 5, "rules": [
+            {"kind": rpc.REPLY, "drop": 0.5}]}) as plane:
+        policy = RetryPolicy(max_attempts=10, base_delay_s=0.01,
+                             jitter=0.0, deadline_s=20.0,
+                             attempt_timeout_s=0.25)
+        for i in range(10):
+            assert conn.call("echo", {"i": i}, retry=policy) == {"i": i}
+        assert plane.stats["drop:" + rpc.REPLY] >= 1
+    assert hits["echo"] >= 10  # at-least-once: drops re-executed
+
+
+def test_call_without_retry_times_out_under_reply_partition(echo_pair):
+    conn, _ = echo_pair
+    with faultinject.inject({"rules": [
+            {"kind": rpc.REPLY, "partition": True}]}):
+        with pytest.raises(FutTimeout):
+            conn.call("echo", {"x": 1}, timeout=0.3)
+
+
+def test_call_retry_absorbs_recv_side_request_loss(echo_pair):
+    conn, _ = echo_pair
+    # The server's reader drops half the incoming requests.
+    with faultinject.inject({"seed": 9, "rules": [
+            {"kind": "echo", "direction": "recv", "drop": 0.5}]}):
+        policy = RetryPolicy(max_attempts=10, base_delay_s=0.01,
+                             jitter=0.0, attempt_timeout_s=0.25)
+        assert conn.call("echo", {"v": 7}, retry=policy) == {"v": 7}
+
+
+def test_call_retry_absorbs_injected_connection_errors(echo_pair):
+    conn, _ = echo_pair
+    with faultinject.inject({"seed": 3, "rules": [
+            {"kind": "echo", "error": 0.5}]}):
+        policy = RetryPolicy(max_attempts=10, base_delay_s=0.01,
+                             jitter=0.0, attempt_timeout_s=0.5)
+        for i in range(5):
+            assert conn.call("echo", {"i": i}, retry=policy) == {"i": i}
+
+
+def test_injected_error_without_retry_raises_connection_lost(echo_pair):
+    conn, _ = echo_pair
+    with faultinject.inject({"rules": [{"kind": "echo", "error": 1.0}]}):
+        with pytest.raises(rpc.ConnectionLost, match="injected"):
+            conn.call("echo", {})
+    # The socket itself survived the injected error: plane off, all good.
+    assert conn.call("echo", {"back": 1}, timeout=5) == {"back": 1}
+
+
+def test_dup_action_duplicates_cast(echo_pair):
+    conn, hits = echo_pair
+    with faultinject.inject({"rules": [{"kind": "note", "dup": 1.0}]}):
+        conn.cast("note", {})
+        deadline = time.monotonic() + 5
+        while hits["note"] < 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+    assert hits["note"] == 2
+
+
+def test_delay_rule_slows_but_completes(echo_pair):
+    conn, _ = echo_pair
+    with faultinject.inject({"rules": [{"kind": "echo", "delay_ms": 60}]}):
+        t0 = time.monotonic()
+        assert conn.call("echo", {"ok": 1}, timeout=5) == {"ok": 1}
+        assert time.monotonic() - t0 >= 0.05
+
+
+# ---------------------------------------------------------------------------
+# bulk plane under injection (no cluster)
+
+
+def test_bulk_pull_retries_injected_faults():
+    from ray_tpu._private import bulk_transfer
+
+    payload = os.urandom(256 * 1024)
+
+    def reader(object_id, start, length):
+        view = memoryview(payload)[start:start + length]
+        return view, lambda: None
+
+    server = bulk_transfer.BulkServer(reader, host="127.0.0.1")
+    try:
+        addr = ("127.0.0.1", server.address[1])
+        with faultinject.inject({"seed": 13, "rules": [
+                {"peer": "bulk|", "drop": 0.5}]}) as plane:
+            policy = RetryPolicy(max_attempts=12, base_delay_s=0.01,
+                                 jitter=0.0, deadline_s=30.0)
+            out = bulk_transfer.pull_object(addr, "obj", len(payload),
+                                            retry=policy)
+            assert bytes(out) == payload
+            assert plane.stats["drop:bulk_pull"] >= 1
+        # Without retry, a partitioned bulk plane raises BulkError fast.
+        with faultinject.inject({"rules": [
+                {"peer": "bulk|", "partition": True}]}):
+            with pytest.raises(bulk_transfer.BulkError):
+                bulk_transfer.pull_object(addr, "obj", len(payload))
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# config knobs
+
+
+def test_fault_and_retry_config_env_knobs(monkeypatch):
+    from ray_tpu._private.config import Config
+
+    monkeypatch.setenv("RAY_TPU_FAULT_SPEC",
+                       '{"seed": 4, "rules": [{"drop": 0.1}]}')
+    monkeypatch.setenv("RAY_TPU_RPC_RETRY_MAX_ATTEMPTS", "7")
+    monkeypatch.setenv("RAY_TPU_RPC_BREAKER_THRESHOLD", "2")
+    cfg = Config().apply_overrides()
+    assert cfg.fault_spec == {"seed": 4, "rules": [{"drop": 0.1}]}
+    assert cfg.rpc_retry_max_attempts == 7
+    assert cfg.rpc_breaker_threshold == 2
+
+
+# ---------------------------------------------------------------------------
+# whole-node death: SIGKILL and partition
+# (head + one agent node as a subprocess, like test_multinode)
+
+
+@pytest.fixture()
+def chaos_cluster():
+    """Head (2 CPUs) + agent node (4 CPUs) with tight health timing."""
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2, object_store_memory=64 * 1024 * 1024,
+                 _system_config={"health_check_period_s": 0.5,
+                                 "health_check_timeout_s": 4.0})
+    head = get_head()
+    address = f"{head.address[0]}:{head.address[1]}"
+    agents: list = []
+    yield address, agents
+    for a in agents:
+        cu.stop_agent(a)
+    ray_tpu.shutdown()
+
+
+def test_agent_sigkill_mid_flood_recovers(chaos_cluster):
+    """SIGKILL the node agent while a retryable task flood is leased on
+    it: tasks requeue onto surviving nodes, a lost P2P object
+    reconstructs through lineage, and the actor restarts elsewhere."""
+    address, agents = chaos_cluster
+    agent = cu.start_agent(address, node_id="node-chaos")
+    agents.append(agent)
+    cu.wait_nodes(2)
+
+    # Lineage bait: a P2P payload hosted only on the doomed node.
+    @ray_tpu.remote(max_retries=3)
+    def produce():
+        return np.full(1024 * 1024, 3.0)  # 8 MiB -> agent store
+
+    obj = produce.options(
+        scheduling_strategy=ray_tpu.NodeAffinitySchedulingStrategy(
+            node_id="node-chaos", soft=True)).remote()
+    assert ray_tpu.get(obj, timeout=60).sum() == 3.0 * 1024 * 1024
+
+    @ray_tpu.remote(max_restarts=2)
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def bump(self):
+            self.n += 1
+            return self.n
+
+    counter = Counter.options(
+        scheduling_strategy=ray_tpu.NodeAffinitySchedulingStrategy(
+            node_id="node-chaos", soft=True)).remote()
+    assert ray_tpu.get(counter.bump.remote(), timeout=60) == 1
+
+    @ray_tpu.remote(max_retries=5)
+    def work(i):
+        time.sleep(0.25)
+        return i * 7
+
+    refs = [work.remote(i) for i in range(16)]
+    time.sleep(1.0)  # let leases land on the agent node
+    agent.send_signal(signal.SIGKILL)
+    agent.wait(timeout=10)
+
+    # Requeue: every leased task completes on the surviving node.
+    results = ray_tpu.get(refs, timeout=120)
+    assert sorted(results) == [i * 7 for i in range(16)]
+    cu.wait_alive_nodes_at_most(1, timeout=30)
+
+    # Lineage reconstruction: the P2P payload died with the node.
+    assert ray_tpu.get(obj, timeout=60).sum() == 3.0 * 1024 * 1024
+
+    # Actor restart: fresh incarnation (state reset), same handle.
+    deadline = time.monotonic() + 60
+    val = None
+    while time.monotonic() < deadline:
+        try:
+            val = ray_tpu.get(counter.bump.remote(), timeout=10)
+            break
+        except Exception:
+            time.sleep(0.3)
+    assert val == 1  # restarted => state reset
+
+
+def test_unreconstructable_put_raises_object_lost(chaos_cluster):
+    """put() data hosted on a killed node has no lineage: the get must
+    raise a provenance-carrying ObjectLostError, not hang."""
+    address, agents = chaos_cluster
+    agent = cu.start_agent(address, node_id="node-loss")
+    agents.append(agent)
+    cu.wait_nodes(2)
+
+    @ray_tpu.remote(resources={"node:node-loss": 0.001})
+    def stash():
+        # 8 MiB put from a worker on the agent node -> agent store,
+        # directory-only on the head, NO lineage (it's a put).
+        return [ray_tpu.put(np.ones(1024 * 1024))]
+
+    (inner,) = ray_tpu.get(stash.remote(), timeout=60)
+    head = get_head()
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        e = head.objects.get(inner.hex())
+        if e is not None and e.location == "node-loss":
+            break
+        time.sleep(0.2)
+    e = head.objects.get(inner.hex())
+    assert e is not None and e.location == "node-loss", \
+        "test setup: put payload should live on the agent node"
+
+    agent.send_signal(signal.SIGKILL)
+    agent.wait(timeout=10)
+
+    t0 = time.monotonic()
+    with pytest.raises(ObjectLostError) as info:
+        ray_tpu.get(inner, timeout=60)
+    assert time.monotonic() - t0 < 30, "loss must surface, not hang"
+    # Provenance: which node lost it and who owned it.
+    assert info.value.node_id == "node-loss"
+    assert info.value.owner_id
+    assert "node-loss" in str(info.value)
+
+
+def test_partitioned_node_declared_dead_after_grace(chaos_cluster):
+    """A node partitioned from the head (heartbeats and re-registration
+    lost in transit; TCP session never closes by itself) is declared
+    dead after health_check_timeout_s and its work requeues.
+    Reference: gcs_health_check_manager.h:45."""
+    address, agents = chaos_cluster
+    agent = cu.start_agent(address, node_id="node-part")
+    agents.append(agent)
+    cu.wait_nodes(2)
+
+    @ray_tpu.remote(max_retries=5)
+    def work(i):
+        time.sleep(0.3)
+        return i + 100
+
+    # Head-side partition: the head stops hearing the agent — both its
+    # heartbeats and any re-registration attempt vanish on arrival.
+    with faultinject.inject({"rules": [
+            {"kind": "agent_heartbeat", "direction": "recv",
+             "partition": True},
+            {"kind": "register_node", "direction": "recv",
+             "partition": True}]}):
+        refs = [work.remote(i) for i in range(8)]
+        # The node goes silent past the 4 s grace: declared dead even
+        # though its connection never closed; leased work requeues.
+        cu.wait_alive_nodes_at_most(1, timeout=30)
+        assert sorted(ray_tpu.get(refs, timeout=120)) == \
+            list(range(100, 108))
+
+
+# ---------------------------------------------------------------------------
+# the acceptance matrix: 5% drop + 50 ms delay on head<->agent RPCs
+# (slow tier: several cluster bring-ups under injected latency)
+
+
+@pytest.mark.slow
+def test_workloads_complete_under_head_agent_drop_delay(chaos_cluster):
+    """With the acceptance-criteria spec injected on BOTH ends of the
+    head<->agent link (the agent process via RAY_TPU_FAULT_SPEC, the
+    head in-process via inject()), the fault-tolerance workloads still
+    complete: retries absorb the faults. Matrix: tasks, actors,
+    generators, bulk transfer, placement groups."""
+    address, agents = chaos_cluster
+    spec = cu.drop_delay_spec("node_agent", drop=0.05, delay_ms=50)
+    agent = cu.start_agent(address, node_id="node-chaos2",
+                           extra_env=cu.spec_env(spec))
+    agents.append(agent)
+    # Head-side sends to the agent match "node_agent" via the
+    # "node_agent_for:<id>" descriptor suffix.
+    with faultinject.inject(spec) as plane:
+        cu.wait_nodes(2)
+
+        # -- tasks under retries (the test_fault_tolerance workload) --
+        @ray_tpu.remote(max_retries=10)
+        def chunk(i):
+            time.sleep(0.1)
+            return i
+
+        refs = [chunk.remote(i) for i in range(12)]
+        assert sorted(ray_tpu.get(refs, timeout=120)) == list(range(12))
+
+        # -- actors --
+        @ray_tpu.remote(max_restarts=2)
+        class Acc:
+            def __init__(self):
+                self.v = 0
+
+            def add(self, d):
+                self.v += d
+                return self.v
+
+        acc = Acc.remote()
+        for i in range(5):
+            assert ray_tpu.get(acc.add.remote(1), timeout=60) == i + 1
+
+        # -- streaming generators --
+        @ray_tpu.remote
+        def gen(n):
+            for i in range(n):
+                yield i * 2
+
+        got = [ray_tpu.get(r, timeout=60) for r in gen.remote(5)]
+        assert got == [0, 2, 4, 6, 8]
+
+        # -- bulk transfer (P2P payload crosses the injected link) --
+        @ray_tpu.remote(resources={"node:node-chaos2": 0.001},
+                        max_retries=5)
+        def produce():
+            return np.arange(1024 * 1024, dtype=np.float64)  # 8 MiB
+
+        arr = ray_tpu.get(produce.remote(), timeout=120)
+        assert arr.shape == (1024 * 1024,) and arr[-1] == 1024 * 1024 - 1
+
+        # -- placement groups --
+        pg = placement_group([{"CPU": 1}], strategy="PACK")
+        ray_tpu.get(pg.ready(), timeout=60)
+
+        @ray_tpu.remote(num_cpus=1, max_retries=5)
+        def in_pg():
+            return "pg-ok"
+
+        strat = ray_tpu.PlacementGroupSchedulingStrategy(placement_group=pg)
+        assert ray_tpu.get(
+            in_pg.options(scheduling_strategy=strat).remote(),
+            timeout=120) == "pg-ok"
+        remove_placement_group(pg)
+
+        # The chaos was real: the plane actually dropped/delayed frames.
+        assert sum(v for k, v in plane.stats.items()
+                   if k.startswith(("drop:", "delay:"))) > 0

@@ -167,3 +167,34 @@ def test_pipelined_nested_get_no_deadlock():
             111 + i for i in range(8)]
     finally:
         ray_tpu.shutdown()
+
+
+def test_datasink_setup_failure_routes_through_on_write_failed():
+    """Datasink lifecycle (reference: data/datasource/datasink.py): a
+    failure in on_write_start is a WRITE failure — it must invoke
+    on_write_failed with the exception before re-raising, exactly like
+    a failure in write() (regression: on_write_start used to run
+    outside the try, skipping the failure hook)."""
+    from ray_tpu.data import from_items
+    from ray_tpu.data.dataset import Datasink
+
+    events: list = []
+
+    class FailsAtSetup(Datasink):
+        def on_write_start(self):
+            events.append("start")
+            raise RuntimeError("staging setup failed")
+
+        def write(self, block):
+            events.append("write")
+
+        def on_write_complete(self):
+            events.append("complete")
+
+        def on_write_failed(self, error):
+            events.append(("failed", str(error)))
+
+    ds = from_items([{"x": 1}, {"x": 2}])
+    with pytest.raises(RuntimeError, match="staging setup failed"):
+        ds.write_datasink(FailsAtSetup())
+    assert events == ["start", ("failed", "staging setup failed")]
