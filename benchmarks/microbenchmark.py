@@ -90,10 +90,33 @@ def main(as_json: bool = False) -> dict:
            lambda: ray_tpu.get([actor.ping.remote() for _ in range(N)]),
            N, results=results)
 
+    # actor call pipelining: K calls in flight on the direct plane
+    # (owner→worker window) before the barrier get — measures how much
+    # the per-call overhead amortizes under pipeline depth.
+    for depth in (8, 32):
+        timeit(f"single client actor pipeline depth {depth}",
+               lambda d=depth: ray_tpu.get(
+                   [actor.ping.remote() for _ in range(d)]),
+               depth, results=results)
+
     # actor arg passing by reference
     timeit("actor calls with 1MiB arg (by ref)",
            lambda: ray_tpu.get(actor.ping.remote(ref_big)),
            results=results)
+
+    # lease-cached same-shape task throughput (direct-call plane): after
+    # the first submission mints a worker lease for the shape, same-shape
+    # tasks dispatch owner→worker with zero head frames.
+    @ray_tpu.remote
+    def leased_task(i):
+        return i
+
+    ray_tpu.get([leased_task.remote(i) for i in range(8)])  # warm lease
+    timeit("single client leased tasks sync",
+           lambda: ray_tpu.get(leased_task.remote(1)), results=results)
+    timeit("single client leased tasks async",
+           lambda: ray_tpu.get([leased_task.remote(i) for i in range(N)]),
+           N, results=results)
 
     ray_tpu.kill(actor)
     ray_tpu.shutdown()

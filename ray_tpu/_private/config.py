@@ -108,6 +108,34 @@ class Config:
     bulk_replicate_min: int = 16 * 1024 * 1024
     bulk_replicate_delay_s: float = 1.0
 
+    # --- direct-call plane (reference: Ray's core-worker "direct call"
+    # architecture — the submitter owns its tasks and talks to leased
+    # workers directly; the GCS is a directory, not a router.
+    # normal_task_submitter.cc:29 lease cache + direct actor transport)
+    # Master switch: 0 falls every submission back to head routing.
+    direct_call_enabled: bool = True
+    # Owner-side bounded inflight window per actor route / task lease:
+    # calls beyond it queue locally (actors, ordering preserved) or
+    # spill back to the head path (leased tasks).
+    direct_window: int = 64
+    # Worker-side back-pressure: a worker rejects direct pushes past
+    # this many queued+running direct tasks (safety valve against a
+    # misbehaving owner; rejection spills the call to the head path).
+    direct_worker_inflight_max: int = 256
+    # Watchdog: a direct-dispatched call unresolved after this long is
+    # re-routed through the head (covers a dropped/blackholed direct
+    # link; worker/actor death re-routes immediately via revoke casts).
+    direct_resubmit_timeout_s: float = 10.0
+    # Worker lease grants for same-shape normal tasks: time and call-
+    # count bounds (whichever trips first ends the lease).
+    lease_ttl_s: float = 10.0
+    lease_max_calls: int = 100_000
+    # Owner-side inflight per leased worker. Default 1: a normal task
+    # never queues behind another on a leased worker (a slow task must
+    # not serialize quick ones); parallelism comes from the lease POOL
+    # growing across workers, and overflow rides the head path.
+    lease_window: int = 1
+
     # --- head fault tolerance (reference: gcs_init_data.h +
     # redis_store_client.h:111 — persistent GCS state; here a periodic
     # snapshot file instead of Redis) ---

@@ -36,8 +36,8 @@ from ray_tpu._private.scheduler import (
     ResourceSet,
 )
 from ray_tpu._private.shm_store import ShmArena
-from ray_tpu._private.task_spec import (ActorSpec, TaskSpec, pack_spec,
-                                        spec_from_body)
+from ray_tpu._private.task_spec import (ActorSpec, TaskSpec, env_pkg_key,
+                                        pack_spec, spec_from_body)
 
 # Object directory entry states.
 CREATING, SEALED, SPILLED, LOST = "CREATING", "SEALED", "SPILLED", "LOST"
@@ -121,7 +121,8 @@ class WorkerRecord:
         "worker_id", "node_id", "conn", "proc", "pid", "busy", "actor_id",
         "inflight", "started_at", "tpu_chips", "acquired", "ready", "pg_alloc",
         "tpu_capable", "cur_rkey", "zygote", "env_key", "blocked",
-        "released_alloc", "retiring",
+        "released_alloc", "retiring", "leased_to", "lease_deadline",
+        "lease_key",
     )
 
     def __init__(self, worker_id: str, node_id: str, proc,
@@ -178,12 +179,21 @@ class WorkerRecord:
         # Chipless pool workers spawn with the hooks stripped so their
         # jax can never touch — or hang on — the TPU path.
         self.tpu_capable = tpu_capable
+        # Direct-call plane worker lease (reference: the raylet-granted
+        # worker lease the owner-side cache pipelines onto,
+        # normal_task_submitter.cc:29): while leased_to an owner, this
+        # worker dispatches ONLY that owner's direct pushes — it leaves
+        # the idle/pipeline pools and keeps its allocation until the
+        # lease is returned, expires, or the worker dies.
+        self.leased_to: str | None = None
+        self.lease_deadline = 0.0
+        self.lease_key = None
 
 
 class ActorRecord:
     __slots__ = (
         "spec", "state", "worker_id", "node_id", "restarts", "pending",
-        "death_cause", "created_at", "arg_pins_held",
+        "death_cause", "created_at", "arg_pins_held", "direct_watchers",
     )
 
     def __init__(self, spec: ActorSpec):
@@ -199,6 +209,10 @@ class ActorRecord:
         # lifetime (restarts replay the creation args); released once at
         # the permanent-DEAD transition.
         self.arg_pins_held = False
+        # Owners granted a direct route to this actor's worker: each
+        # gets an actor_direct_revoke cast when the worker dies so
+        # in-flight direct calls re-route instead of hanging.
+        self.direct_watchers: set[str] = set()
 
 
 class PlacementGroupRecord:
@@ -313,6 +327,13 @@ class Head:
         # process.
         self._pending_owner_seals: dict[str, str] = {}
         self._worker_pending_seals: dict[str, set] = {}
+        # Direct-plane completion tombstones: a worker's task_finished
+        # can beat the owner's batched task_started (different
+        # connections, no ordering) — remember recently-finished ids so
+        # the late task_started doesn't register a phantom inflight
+        # entry that would pin the worker busy forever.
+        self._early_finished: set[str] = set()
+        self._early_finished_fifo: deque[str] = deque()
         # owner_id -> freed object ids awaiting one coalesced
         # owned_freed cast (flushed per dispatch pass).
         self._owned_freed_buf: dict[str, list] = {}
@@ -743,6 +764,13 @@ class Head:
         with self.lock:
             self.clients.pop(client_id, None)
             self.client_owner_addrs.pop(client_id, None)
+            # A dead owner's worker leases end now (its direct pushes
+            # died with it; the workers must rejoin the pool).
+            for w in self.workers.values():
+                if w.leased_to == client_id:
+                    self._end_lease(w)
+            for a in self.actors.values():
+                a.direct_watchers.discard(client_id)
             rec = self.workers.get(client_id)
             # Borrower death releases its borrows (reference:
             # reference_count.h WaitForRefRemoved resolves when the
@@ -902,6 +930,14 @@ class Head:
                 if r.conn is None and not r.ready
                 and now - r.started_at > self.config.worker_register_timeout_s
             ]
+            # Direct-plane lease safety net: the owner returns leases on
+            # expiry itself; a crashed/partitioned owner can't, so the
+            # head reaps past deadline + grace or its worker (and
+            # allocation) would be pinned forever.
+            for r in self.workers.values():
+                if (r.leased_to is not None
+                        and now > r.lease_deadline + 2.0):
+                    self._end_lease(r, revoke=True)
         for nid, conn in silent:
             print(f"ray_tpu head: node {nid} silent for >{grace:.0f}s — "
                   f"declaring it dead", file=sys.stderr)
@@ -1260,7 +1296,15 @@ class Head:
         object_id = body["object_id"]
         entry = self.objects.get(object_id)
         if entry is None:
-            return
+            if not body.get("direct"):
+                return
+            # Direct-dispatched task result whose task_started cast lost
+            # the race (or was lost): create the directory entry so
+            # cross-client waits/deps on this ref resolve. The owner's
+            # del_ref follows on this same ordered connection, so the
+            # refcount cannot have been decremented already.
+            entry = ObjectEntry(object_id, body.get("owner_id", ""))
+            self.objects[object_id] = entry
         w = self._pending_owner_seals.pop(object_id, None)
         if w is not None:
             s = self._worker_pending_seals.get(w)
@@ -1734,6 +1778,14 @@ class Head:
 
     def _h_submit_task(self, body, conn):
         spec: TaskSpec = spec_from_body(body)
+        if body.get("lease_key") is not None:
+            # The owner wants a direct-dispatch lease for this shape:
+            # granted in _push_to_worker once the task lands on a
+            # leasable worker (same placement machinery, zero extra
+            # round trips — the grant rides back as a buffered cast).
+            spec._lease_key = tuple(
+                tuple(k) if isinstance(k, list) else k
+                for k in body["lease_key"])
         with self.lock:
             for oid in spec.return_ids:
                 entry = self.objects.get(oid) or ObjectEntry(oid, spec.owner_id)
@@ -1761,20 +1813,9 @@ class Head:
         self.dispatch_event.set()
         return None
 
-    @staticmethod
-    def _env_key(renv: "dict | None") -> "str | None":
-        """Hash of the package half of a runtime env (pip/conda), or
-        None for envs that don't alter installed packages — only the
-        package half poisons a worker's sys.modules for other envs."""
-        if not renv:
-            return None
-        pkg = {k: renv[k] for k in ("pip", "conda", "uv") if renv.get(k)}
-        if not pkg:
-            return None
-        import hashlib as _hashlib
-
-        return _hashlib.sha256(repr(sorted(
-            (k, repr(v)) for k, v in pkg.items())).encode()).hexdigest()[:16]
+    # Package-env hash shared with the owner-side lease cache (the two
+    # sides must key shapes identically) — see task_spec.env_pkg_key.
+    _env_key = staticmethod(env_pkg_key)
 
     def _queue_key(self, spec: TaskSpec) -> tuple:
         if spec.scheduling_strategy is not None:
@@ -1975,6 +2016,15 @@ class Head:
                 self._worker_pending_seals.setdefault(
                     worker_id, set()).add(oid)
         spec = rec.inflight.pop(body.get("task_id", ""), None)
+        if spec is None and body.get("task_id"):
+            # Direct-plane race: the completion beat the owner's batched
+            # task_started. Tombstone the id so the late registration
+            # doesn't create a phantom inflight entry.
+            self._early_finished.add(body["task_id"])
+            self._early_finished_fifo.append(body["task_id"])
+            if len(self._early_finished_fifo) > 65536:
+                self._early_finished.discard(
+                    self._early_finished_fifo.popleft())
         if spec is not None:
             t = self.tasks.get(spec.task_id)
             if t:
@@ -2003,10 +2053,21 @@ class Head:
             # release it only when the window fully drains. Wake the
             # dispatcher BEFORE that (window nearly empty) so the
             # refill overlaps the last task's execution instead of
-            # stalling the worker.
+            # stalling the worker. LEASED workers keep their allocation
+            # through idle gaps — the owner is still pushing to them
+            # directly; the lease end releases it.
             if not rec.inflight:
+                # busy answers "is it EXECUTING" (autoscaler idle
+                # checks, kill policies) — a leased-but-idle worker is
+                # not busy; only its allocation stays held for the
+                # lease's remaining life. Leased completions still wake
+                # the dispatcher: head-queued spillover may be waiting
+                # for exactly this worker's pipeline window (the
+                # all-capacity-leased fallback), and a 0.2 s poll tick
+                # per refill wave would throttle whole bursts.
                 rec.busy = False
-                self._release_worker_allocation(rec)
+                if rec.leased_to is None:
+                    self._release_worker_allocation(rec)
                 need_dispatch = True
                 if rec.retiring:
                     self._maybe_release_retiree(rec.worker_id)
@@ -2017,6 +2078,11 @@ class Head:
             if actor is not None and spec is not None and spec.actor_creation:
                 actor.state = "ALIVE" if not body.get("failed") else "DEAD"
                 self._mark_dirty()
+                if actor.state == "ALIVE":
+                    # Direct-call plane: owners that asked for this
+                    # actor's route before creation finished (or that
+                    # lost it to a restart) get the grant pushed now.
+                    self._push_direct_grants(actor)
                 if actor.state == "DEAD":
                     self._wal_append(("actor_dead", rec.actor_id))
                     actor.death_cause = "creation task failed"
@@ -2102,6 +2168,285 @@ class Head:
             }
             self._enqueue_actor_task(spec)
         self.dispatch_event.set()
+        return None
+
+    # --- direct-call plane (reference: direct_actor_transport.h +
+    # normal_task_submitter.cc:29 — the owner dispatches to workers
+    # directly; the head is a directory + async bookkeeper) ---
+
+    def _h_actor_direct_info(self, body, conn):
+        """An owner asks for an actor's direct route (cast; the grant
+        comes back as a cast so the submit path never blocks). Granted
+        only for ALIVE actors whose worker runs a peer server; the
+        owner is registered as a watcher for death revokes."""
+        owner_id = conn.peer_info.get("client_id")
+        with self.lock:
+            actor = self.actors.get(body["actor_id"])
+            if actor is None or not owner_id:
+                return None
+            # Watchers get the grant pushed the moment the actor is (or
+            # becomes, incl. after a restart) ALIVE — and the revoke
+            # when its worker dies.
+            actor.direct_watchers.add(owner_id)
+            grant = self._direct_grant_body(actor)
+        if grant is not None:
+            try:
+                conn.cast_buffered("actor_direct_grant", grant)
+            except rpc.ConnectionLost:
+                pass
+        return None
+
+    def _direct_grant_body(self, actor: ActorRecord) -> "dict | None":
+        """lock held. Grant payload for an ALIVE actor's direct route,
+        or None when the actor isn't routable (pending, retiring worker,
+        worker without a peer server)."""
+        if actor.state != "ALIVE":
+            return None
+        rec = self.workers.get(actor.worker_id or "")
+        if rec is None or rec.conn is None or rec.retiring:
+            return None
+        addr = self.client_owner_addrs.get(rec.worker_id)
+        if addr is None:
+            return None  # worker has no peer server: head path only
+        return {
+            "actor_id": actor.spec.actor_id,
+            "addr": tuple(addr),
+            "worker_id": rec.worker_id,
+            "tpu_chips": list(rec.tpu_chips),
+            "specenc": bool(rec.conn.peer_info.get("specenc")),
+            "out_of_order": bool(getattr(
+                actor.spec, "allow_out_of_order", False)),
+        }
+
+    def _push_direct_grants(self, actor: ActorRecord) -> None:
+        """lock held. The actor just became ALIVE: push the direct
+        route to every owner that asked for it (first-call requesters
+        and owners re-routing after a restart)."""
+        grant = self._direct_grant_body(actor)
+        if grant is None:
+            return
+        for owner_id in actor.direct_watchers:
+            oconn = self.clients.get(owner_id)
+            if oconn is not None:
+                try:
+                    oconn.cast_buffered("actor_direct_grant", grant)
+                except rpc.ConnectionLost:
+                    pass
+
+    def _h_task_started(self, body, conn):
+        """Async bookkeeping for a DIRECT-dispatched task (batched cast,
+        off the submission latency path): directory entries for the
+        return ids, dep pins, task-state row, lineage, and inflight
+        registration so the head's own death machinery re-routes the
+        task if the worker dies."""
+        spec: TaskSpec = spec_from_body(body)
+        worker_id = body.get("worker_id")
+        with self.lock:
+            known = spec.task_id in self.tasks
+            finished = spec.task_id in self._early_finished
+            if finished:
+                self._early_finished.discard(spec.task_id)
+            if not known:
+                for oid in spec.return_ids:
+                    entry = self.objects.get(oid) or ObjectEntry(
+                        oid, spec.owner_id)
+                    entry.refcount = max(entry.refcount, 1)
+                    self.objects[oid] = entry
+                for dep in self._pinned_ids(spec):
+                    e = self.objects.get(dep)
+                    if e is not None:
+                        e.task_pins += 1
+                self.tasks[spec.task_id] = {
+                    "task_id": spec.task_id,
+                    "name": spec.name,
+                    "state": RUNNING,
+                    "type": ("ACTOR_TASK" if spec.actor_id
+                             else "NORMAL_TASK"),
+                    "submitted_at": time.time(),
+                    "started_at": time.time(),
+                    "node_id": None,
+                    "worker_id": worker_id,
+                    "direct": True,
+                }
+                if spec.actor_id is None:
+                    self._record_lineage(spec)
+            rec = self.workers.get(worker_id or "")
+            if rec is not None and not finished and not known:
+                rec.inflight[spec.task_id] = spec
+                rec.busy = True
+                self.tasks[spec.task_id]["node_id"] = rec.node_id
+            elif finished and not known:
+                # The completion beat this registration: the task-state
+                # row (created above or by recover) closes out here; the
+                # seals already flowed through owner_sealed.
+                t = self.tasks.get(spec.task_id)
+                if t is not None and t["state"] == RUNNING:
+                    t["state"] = FINISHED
+                    t["finished_at"] = time.time()
+                    self._record_finished(spec.task_id)
+                # Pins taken above are released now (no inflight entry
+                # will ever pop to release them).
+                if not known and not spec.actor_creation:
+                    for dep in self._pinned_ids(spec):
+                        e = self.objects.get(dep)
+                        if e is not None and e.task_pins > 0:
+                            e.task_pins -= 1
+                            self._maybe_free(e)
+        return None
+
+    def _h_direct_recover(self, body, conn):
+        """The owner re-routes direct calls it can no longer trust to a
+        dead/unreachable worker (call, retried client-side). Deduped by
+        task state: anything the head already requeued through its own
+        death handling — or that already finished — is skipped, so
+        recovery never double-submits (at-least-once only when the
+        direct link itself silently ate the push or the ack)."""
+        accepted = []
+        with self.lock:
+            for sbody in body.get("specs") or ():
+                spec: TaskSpec = spec_from_body(sbody)
+                t = self.tasks.get(spec.task_id)
+                if t is not None and t["state"] in (FINISHED, FAILED):
+                    continue
+                if t is not None and t["state"] == PENDING:
+                    continue  # head already requeued it (death path)
+                stale_wid = sbody.get("worker_id") or t and t.get(
+                    "worker_id")
+                if stale_wid:
+                    stale = self.workers.get(stale_wid)
+                    if stale is not None:
+                        stale.inflight.pop(spec.task_id, None)
+                if t is None:
+                    # task_started never landed: full registration.
+                    for oid in spec.return_ids:
+                        entry = self.objects.get(oid) or ObjectEntry(
+                            oid, spec.owner_id)
+                        entry.refcount = max(entry.refcount, 1)
+                        self.objects[oid] = entry
+                    for dep in self._pinned_ids(spec):
+                        e = self.objects.get(dep)
+                        if e is not None:
+                            e.task_pins += 1
+                    self.tasks[spec.task_id] = {
+                        "task_id": spec.task_id,
+                        "name": spec.name,
+                        "state": PENDING,
+                        "type": ("ACTOR_TASK" if spec.actor_id
+                                 else "NORMAL_TASK"),
+                        "submitted_at": time.time(),
+                        "node_id": None,
+                        "worker_id": None,
+                        "direct": True,
+                    }
+                    if spec.actor_id is None:
+                        self._record_lineage(spec)
+                else:
+                    t["state"] = PENDING
+                    t["worker_id"] = None
+                accepted.append(spec.task_id)
+                if spec.actor_id is not None:
+                    actor = self.actors.get(spec.actor_id)
+                    if actor is not None and actor.state != "DEAD":
+                        # Recovered calls predate anything the owner
+                        # head-routed after the spillback: front of the
+                        # queue, in seq order (mirrors the death
+                        # handler's replay ordering).
+                        idx = next(
+                            (i for i, p in enumerate(actor.pending)
+                             if p.owner_id == spec.owner_id
+                             and p.seq_no > spec.seq_no),
+                            len(actor.pending))
+                        actor.pending.insert(idx, spec)
+                        if actor.state == "ALIVE":
+                            self._flush_actor(actor)
+                    else:
+                        self._enqueue_actor_task(spec)  # fails: dead
+                else:
+                    self._enqueue_task_spec(spec)
+        self.dispatch_event.set()
+        return {"accepted": accepted}
+
+    def _grant_lease(self, rec: WorkerRecord, spec: TaskSpec) -> None:
+        """lock held. A normal task carrying a lease request just landed
+        on a leasable worker: hand the owner a time/count-bounded direct
+        route (reference: worker leases, normal_task_submitter.cc:29)."""
+        if (rec.actor_id is not None or rec.tpu_capable or rec.retiring
+                or rec.leased_to is not None or rec.conn is None):
+            return
+        # Lease POOL per (owner, shape): one lease per distinct worker,
+        # granted as same-shape spillover lands on fresh leasable
+        # workers — the pool converges on the shape's real parallelism.
+        # Deduped per worker (a submission burst carries the request on
+        # every task until the first grant lands) and capped so one
+        # owner cannot lease an entire large pool away.
+        owner_leases = getattr(self, "_owner_leases", None)
+        if owner_leases is None:
+            owner_leases = self._owner_leases = {}
+        lk = (spec.owner_id, spec._lease_key)
+        held = owner_leases.setdefault(lk, set())
+        held &= set(self.workers)  # drop dead workers from the count
+        owner_leases[lk] = held
+        if rec.worker_id in held or len(held) >= 16:
+            return
+        addr = self.client_owner_addrs.get(rec.worker_id)
+        oconn = self.clients.get(spec.owner_id)
+        if addr is None or oconn is None:
+            return
+        held.add(rec.worker_id)
+        rec.leased_to = spec.owner_id
+        rec.lease_deadline = time.time() + self.config.lease_ttl_s
+        rec.lease_key = spec._lease_key
+        try:
+            oconn.cast_buffered("lease_grant", {
+                "key": spec._lease_key,
+                "addr": tuple(addr),
+                "worker_id": rec.worker_id,
+                "ttl_s": self.config.lease_ttl_s,
+                "max_calls": self.config.lease_max_calls,
+                "window": self.config.lease_window,
+                "specenc": bool(rec.conn.peer_info.get("specenc")),
+            })
+        except rpc.ConnectionLost:
+            held.discard(rec.worker_id)
+            rec.leased_to = None
+            rec.lease_key = None
+
+    def _end_lease(self, rec: WorkerRecord, revoke: bool = False) -> None:
+        """lock held. Clear a worker's lease; optionally tell the owner
+        (worker death/retirement — the owner must stop pushing). The
+        allocation releases once nothing is inflight."""
+        owner = rec.leased_to
+        if owner is not None and rec.lease_key is not None:
+            ol = getattr(self, "_owner_leases", None)
+            if ol is not None:
+                held = ol.get((owner, rec.lease_key))
+                if held is not None:
+                    held.discard(rec.worker_id)
+                    if not held:
+                        ol.pop((owner, rec.lease_key), None)
+        rec.leased_to = None
+        rec.lease_deadline = 0.0
+        rec.lease_key = None
+        if revoke and owner:
+            oconn = self.clients.get(owner)
+            if oconn is not None:
+                try:
+                    oconn.cast_buffered("lease_revoke",
+                                        {"worker_id": rec.worker_id})
+                except rpc.ConnectionLost:
+                    pass
+        if not rec.inflight and rec.worker_id in self.workers:
+            rec.busy = False
+            self._release_worker_allocation(rec)
+            self.dispatch_event.set()
+
+    def _h_lease_return(self, body, conn):
+        """Owner voluntarily returns a lease (expiry, shutdown)."""
+        with self.lock:
+            rec = self.workers.get(body["worker_id"])
+            if rec is not None and rec.leased_to == conn.peer_info.get(
+                    "client_id"):
+                self._end_lease(rec)
         return None
 
     def _enqueue_actor_task(self, spec: TaskSpec) -> None:
@@ -2607,6 +2952,11 @@ class Head:
                 # restart budget.
                 return None
             rec.retiring = True
+            if rec.leased_to is not None:
+                # A retiring worker's lease is void: the owner falls
+                # back to the head path (its queued direct pushes are
+                # direct_rej'd by the worker and spill back too).
+                self._end_lease(rec, revoke=True)
             self._maybe_release_retiree(rec.worker_id)
         return None
 
@@ -2762,7 +3112,19 @@ class Head:
                         if node is None:
                             node = self.scheduler.pick_node(demand, None)
                         if node is None:
-                            break  # shape unplaceable until capacity frees
+                            # No free capacity anywhere — but the
+                            # owner's own leases may HOLD it all: its
+                            # spillover rides those workers' pipeline
+                            # windows (the lease-held allocation), or
+                            # the shape truly waits for capacity.
+                            lw = self._lease_matched_worker(
+                                None, key, spec.owner_id)
+                            if lw is None:
+                                break  # unplaceable until capacity frees
+                            q.popleft()
+                            popped = True
+                            self._push_to_worker(lw, spec, buffered=True)
+                            continue
                         need_tpu = float(spec.resources.get("TPU", 0)) > 0
                         if (node.node_id, need_tpu) in no_worker:
                             break
@@ -2785,8 +3147,16 @@ class Head:
                             # Pipeline: same-shape tasks ride an already-
                             # allocated worker's bounded inflight window
                             # (serial execution — no extra allocation).
+                            # LAST resort: this owner's own leased
+                            # workers — without that fallback, an owner
+                            # whose leases cover the whole pool
+                            # deadlocks its spillover until lease
+                            # expiry (every worker's allocation is
+                            # lease-held, so nothing else can place).
                             rec = (None if need_tpu else
-                                   self._pipeline_worker(node.node_id, key))
+                                   self._pipeline_worker(node.node_id, key)
+                                   or self._lease_matched_worker(
+                                       node.node_id, key, spec.owner_id))
                             if rec is None:
                                 no_worker.add((node.node_id, need_tpu))
                                 break
@@ -2967,6 +3337,39 @@ class Head:
                     pass
                 return
 
+    def _lease_matched_worker(self, node_id: "str | None", key: tuple,
+                              owner_id: str) -> "WorkerRecord | None":
+        """lock held. A worker LEASED to this very owner for this very
+        shape still serves the owner's head-routed spillover (bounded
+        by the pipeline depth, riding the allocation the lease already
+        holds). Without this, an owner whose leases cover the whole
+        pool deadlocks its own overflow until the leases expire: the
+        owner spills because every lease has a task inflight, and the
+        head can't place the spillover because every worker's
+        allocation is lease-held."""
+        if key[0] != "shape":
+            return None
+        best = None
+        for rec in self.workers.values():
+            if (
+                (node_id is None or rec.node_id == node_id)
+                and rec.conn is not None
+                and rec.ready
+                and rec.actor_id is None
+                and not rec.retiring
+                and rec.leased_to == owner_id
+                and rec.lease_key == key[1]
+                and len(rec.inflight) < self.PIPELINE_DEPTH
+            ):
+                # Least-loaded: an IDLE leased worker must win over one
+                # mid-task, or a quick task gets parked behind a slow
+                # one while capacity sits idle.
+                if best is None or len(rec.inflight) < len(best.inflight):
+                    best = rec
+                    if not rec.inflight:
+                        break
+        return best
+
     def _pipeline_worker(self, node_id: str,
                          key: tuple) -> WorkerRecord | None:
         """lock held. A busy non-actor worker already holding an
@@ -2980,6 +3383,7 @@ class Head:
                 and rec.actor_id is None
                 and not rec.tpu_capable
                 and not rec.retiring
+                and rec.leased_to is None
                 and rec.cur_rkey == key
                 and rec.acquired is not None
                 and 0 < len(rec.inflight) < self.PIPELINE_DEPTH
@@ -3007,6 +3411,7 @@ class Head:
                 and not rec.busy
                 and rec.actor_id is None
                 and not rec.retiring
+                and rec.leased_to is None
                 and rec.tpu_capable == need_tpu
             ):
                 if rec.env_key == env_key:
@@ -3065,6 +3470,9 @@ class Head:
                 rec.conn.cast("push_task", push_body)
         except rpc.ConnectionLost:
             pass  # worker death handler requeues
+        if spec._lease_key is not None and spec.actor_id is None:
+            self._grant_lease(rec, spec)
+            spec._lease_key = None
 
     def _try_start_actor(self, actor: ActorRecord) -> None:
         """lock held. Reserve resources, spawn a dedicated worker, send the
@@ -3273,6 +3681,10 @@ class Head:
             self.workers.pop(rec.worker_id, None)
             getattr(self, "_pending_creation_push", {}).pop(
                 rec.worker_id, None)
+            if rec.leased_to is not None:
+                # Direct-plane lease dies with the worker: tell the
+                # owner to stop pushing and fall back to the head path.
+                self._end_lease(rec, revoke=True)
             self._release_worker_allocation(rec)
             # Direct seals this worker reported but whose owner never
             # confirmed: the seal died in the worker's send buffer and
@@ -3334,6 +3746,19 @@ class Head:
         actor = self.actors.get(rec.actor_id)
         if actor is None or actor.state == "DEAD":
             return
+        # Direct-plane revoke: every owner holding a direct route to
+        # this worker must stop pushing NOW — their in-flight direct
+        # calls re-route through direct_recover / the requeue below
+        # instead of hanging on a dead socket.
+        for owner_id in actor.direct_watchers:
+            oconn = self.clients.get(owner_id)
+            if oconn is not None:
+                try:
+                    oconn.cast_buffered("actor_direct_revoke",
+                                        {"actor_id": rec.actor_id})
+                except rpc.ConnectionLost:
+                    pass
+        actor.direct_watchers.clear()
         if rec.conn is None and not rec.ready:
             # The worker process never came up (lost spawn cast, boot
             # crash — reaped by the health loop): that is a scheduling-

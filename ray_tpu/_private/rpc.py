@@ -36,7 +36,13 @@ class _CastFlusher:
     """Module-global flusher for buffered casts: bounds the latency of a
     lone ``cast_buffered`` (a sender that buffers and then goes quiet) to
     ~1 ms without a timer thread per connection. Connections register
-    when their buffer becomes non-empty."""
+    when their buffer becomes non-empty; under a sustained burst the
+    flusher keeps the connection HOT (drained every pass) so senders
+    skip the register lock/notify churn entirely until it goes quiet."""
+
+    # Passes a hot connection may sit with an empty buffer before it is
+    # dropped back to register()-driven tracking.
+    _IDLE_PASSES = 8
 
     def __init__(self):
         self._pending: set = set()
@@ -44,6 +50,8 @@ class _CastFlusher:
         self._thread: threading.Thread | None = None
 
     def register(self, conn: "Connection") -> None:
+        if conn._flusher_hot:
+            return  # already on the hot list: the loop will drain it
         with self._cond:
             self._pending.add(conn)
             if self._thread is None:
@@ -53,20 +61,42 @@ class _CastFlusher:
             self._cond.notify()
 
     def _loop(self) -> None:
+        import time as _time
+
+        hot: dict = {}  # conn -> consecutive empty passes
         while True:
             with self._cond:
-                while not self._pending:
+                while not self._pending and not hot:
                     self._cond.wait()
-                conns = list(self._pending)
+                for c in self._pending:
+                    hot[c] = 0
+                    c._flusher_hot = True
                 self._pending.clear()
             # Tiny coalescing window: lets a burst in progress finish
             # filling the buffer so the flush ships one big frame.
-            threading.Event().wait(0.001)
-            for c in conns:
+            # (time.sleep, not a fresh threading.Event per pass — the
+            # Event allocated a lock + object per millisecond forever.)
+            _time.sleep(0.001)
+            for c in list(hot):
                 try:
-                    c.flush_casts()
+                    had = bool(c._cast_buf)
+                    if had:
+                        c.flush_casts()
+                        hot[c] = 0
+                    else:
+                        hot[c] += 1
                 except Exception:
-                    pass
+                    hot[c] = self._IDLE_PASSES
+                if hot[c] >= self._IDLE_PASSES or c.closed:
+                    # Quiet (or dead): stop polling it. Order matters:
+                    # clear the flag FIRST, then re-check the buffer — a
+                    # cast_buffered racing the drop either sees the
+                    # cleared flag and registers itself, or its item is
+                    # already in the buffer and the re-check re-adopts.
+                    c._flusher_hot = False
+                    del hot[c]
+                    if c._cast_buf and not c.closed:
+                        self.register(c)
 
 
 _cast_flusher = _CastFlusher()
@@ -112,6 +142,16 @@ class Connection:
         self._on_close = on_close
         self.name = name
         self.peer_info: dict = {}  # set during registration by the server
+        # Cheap dispatch-plane counters (exposed via
+        # ray_tpu.util.metrics.rpc_counters): frames that actually hit
+        # the wire, synchronous request/response calls, and a per-kind
+        # message census. The frame-count regression guard
+        # (tests/test_dispatch_fastpath.py) asserts steady-state direct
+        # dispatch adds ZERO per-call frames on the head connection —
+        # a deterministic check, not a timing benchmark.
+        self.frames_sent = 0
+        self.calls_sent = 0
+        self.sent_kinds: dict[str, int] = {}
         self._pending: dict[int, Future] = {}
         self._pending_lock = threading.Lock()
         self._next_id = 0
@@ -140,6 +180,10 @@ class Connection:
         # never reordered after a later synchronous message.
         self._cast_buf: list = []
         self._cast_lock = threading.Lock()
+        # True while the global cast flusher is actively polling this
+        # connection (sustained-burst mode): cast_buffered skips the
+        # register() lock/notify round entirely.
+        self._flusher_hot = False
         # Serializes buffer-swap + send in flush_casts: without it the
         # global flusher could swap the buffer, get preempted before
         # sending, and let a later direct cast()/call() frame overtake
@@ -197,6 +241,10 @@ class Connection:
                 # retry policy (calls) or at-least-once design (casts)
         data = pickle.dumps((kind, msg_id, body), protocol=5)
         frame = _HDR.pack(len(data)) + data
+        # Counter writes are racy-but-monotonic ints (GIL-atomic enough
+        # for a regression guard; exactness is not load-bearing).
+        self.frames_sent += 1
+        self.sent_kinds[kind] = self.sent_kinds.get(kind, 0) + 1
         with self._sendq_lock:
             while (self._send_q_bytes > self._SEND_HIGH_WATER_BYTES
                    and not self._closed.is_set()):
@@ -280,8 +328,13 @@ class Connection:
                 if not self._cast_buf:
                     return
                 buf, self._cast_buf = self._cast_buf, []
+            for k, _ in buf:
+                # Per-kind census for buffered casts too (they reach
+                # _send only as one CAST_BATCH frame).
+                self.sent_kinds[k] = self.sent_kinds.get(k, 0) + 1
             if len(buf) == 1:
                 self._send(buf[0][0], 0, buf[0][1])
+                self.sent_kinds[buf[0][0]] -= 1  # _send counted it
             else:
                 self._send(CAST_BATCH, 0, buf)
 
@@ -332,6 +385,7 @@ class Connection:
     def _call_once(self, kind: str, body: dict | None,
                    timeout: float | None) -> Any:
         self.flush_casts()
+        self.calls_sent += 1
         fut: Future = Future()
         with self._pending_lock:
             self._next_id += 1

@@ -241,6 +241,20 @@ class CoreRuntime:
         ids_mod.set_ref_removed_callback(self._on_ref_removed)
         ids_mod.set_borrow_callbacks(self._on_borrow_added,
                                      self._on_borrow_removed)
+        # --- direct-call plane (reference: direct_actor_transport.h +
+        # the owner-side lease cache, normal_task_submitter.cc:29):
+        # steady-state actor calls and lease-cached same-shape tasks go
+        # owner→worker on peer connections; the head is demoted to
+        # batched async bookkeeping. Workers execute tasks, so they host
+        # the receiving half (Worker sets _peer_task_handler); every
+        # runtime gets the submitting half.
+        self._peer_task_handler = None
+        self._direct = None
+        if (GLOBAL_CONFIG.direct_call_enabled
+                and self.owner_addr is not None):
+            from ray_tpu._private.direct import DirectPlane
+
+            self._direct = DirectPlane(self)
         self._release_thread = threading.Thread(
             target=self._release_loop, daemon=True, name="ref-release")
         self._release_thread.start()
@@ -277,6 +291,11 @@ class CoreRuntime:
                         self.conn.cast("read_done", {"ids": stale})
                     except rpc.ConnectionLost:
                         pass
+            return None
+        if (self._direct is not None
+                and kind in ("actor_direct_grant", "actor_direct_revoke",
+                             "lease_grant", "lease_revoke")
+                and self._direct.on_head_msg(kind, body)):
             return None
         if self._message_handler is not None:
             return self._message_handler(kind, body)
@@ -464,6 +483,13 @@ class CoreRuntime:
                     aux()
                 except Exception:
                     pass
+            if self._direct is not None:
+                try:
+                    # Direct-plane watchdog: expired leases, unacked /
+                    # revoked direct calls re-routing through the head.
+                    self._direct.tick()
+                except Exception:
+                    pass
             delay = 0.05 if had_work else min(delay * 2, 2.0)
             _time.sleep(delay)
 
@@ -476,6 +502,17 @@ class CoreRuntime:
         if kind == "seal_objects":
             self._store_owned_and_notify(body["objects"])
             return None
+        if kind == "direct_push":
+            # Direct-call plane: an owner pushed a task straight to this
+            # runtime's worker half (reference: direct task submission,
+            # direct_actor_transport.h). Only task-executing runtimes
+            # accept it; the error reply makes a mis-addressed push
+            # visible instead of silently vanishing.
+            h = self._peer_task_handler
+            if h is None:
+                raise rpc.RpcError(
+                    f"runtime {self.client_id} does not execute tasks")
+            return h(body, conn)
         if kind == "fetch_object":
             with self._owned_cond:
                 v = self._owned_store.get(body["object_id"])
@@ -515,11 +552,28 @@ class CoreRuntime:
                         rec["payload"], rec.get("is_error", False))
             if self._owned_waiters:
                 self._owned_cond.notify_all()
+        direct_oids: "frozenset | tuple" = ()
+        if self._direct is not None:
+            # Snapshot which of these ids were direct-dispatched BEFORE
+            # the resolution hook pops their tracking entries.
+            oids = [r["object_id"] for r in objs]
+            direct_oids = self._direct.known_direct_oids(oids)
+            # Direct-plane resolution hook: frees inflight-window slots,
+            # drains owner-side pending queues, clears drain barriers.
+            try:
+                self._direct.on_resolved(oids)
+            except Exception:
+                pass
         if not notify:
             return
         slim = [{"object_id": r["object_id"], "owner_id": self.client_id,
                  "size": len(r["payload"]),
                  "is_error": r.get("is_error", False),
+                 # Direct-dispatched task results: the head may not have
+                 # a directory entry yet (the batched task_started cast
+                 # can lose the race with this seal) — tell it to create
+                 # one instead of dropping the seal.
+                 "direct": r["object_id"] in direct_oids,
                  "contained_ids": r.get("contained_ids") or []}
                 for r in objs if not r.get("remote")]
         if not slim:
@@ -562,10 +616,41 @@ class CoreRuntime:
                 if len(self._dead_owned_fifo) > 65536:
                     self._dead_owned.discard(self._dead_owned_fifo.pop(0))
             self._owned_cond.notify_all()
+        if self._direct is not None:
+            # A freed id resolves its direct-plane tracking too (the
+            # window must not stay clogged by fire-and-forget results).
+            try:
+                self._direct.on_resolved([hex_id])
+            except Exception:
+                pass
+
+    def _handle_direct_client(self, kind: str, body: dict,
+                              conn: rpc.Connection):
+        """Handler for messages a WORKER pushes back over an
+        owner-initiated peer connection: direct-plane delivery acks and
+        back-pressure rejections."""
+        if kind in ("direct_ack", "direct_rej") and self._direct is not None:
+            self._direct.on_worker_msg(kind, body)
+        return None
+
+    def _on_peer_conn_close(self, conn: rpc.Connection) -> None:
+        """A peer connection died: prune the cache and tell the direct
+        plane so routes/leases over it re-route through the head."""
+        addr = getattr(conn, "_peer_addr", None)
+        if addr is None:
+            return
+        with self._owner_conns_lock:
+            if self._owner_conns.get(addr) is conn:
+                self._owner_conns.pop(addr, None)
+        if self._direct is not None and not self._closed:
+            try:
+                self._direct.on_peer_close(addr)
+            except Exception:
+                pass
 
     def _peer_owner_conn(self, addr: tuple,
-                         expect_owner: "str | None" = None
-                         ) -> rpc.Connection:
+                         expect_owner: "str | None" = None,
+                         handler=None) -> rpc.Connection:
         from ray_tpu._private.retry import (CircuitOpenError, breaker_for,
                                             default_policy)
 
@@ -583,7 +668,11 @@ class CoreRuntime:
                     f"owner address {addr} circuit open "
                     f"({breaker.threshold} consecutive failures)")
             try:
-                c = rpc.connect(addr, name="owner-peer")
+                c = rpc.connect(addr, name="owner-peer",
+                                handler=handler or
+                                self._handle_direct_client,
+                                on_close=self._on_peer_conn_close)
+                c._peer_addr = addr
             except OSError:
                 breaker.record_failure()
                 raise
@@ -660,7 +749,15 @@ class CoreRuntime:
                 min(0.25, remaining) if remaining is not None else 0.25)
             now = _time.monotonic()
             if len(waiting) > 64 and now - last_scan < 0.02:
-                continue  # coalesce wakeups; rescan at most ~50x/s
+                # Coalesce wakeups (rescan at most ~50x/s for wide
+                # waits) — but sleep only the REMAINDER of the window,
+                # never re-park on the condition: the notify this wake
+                # consumed may have been the LAST seal batch (direct
+                # dispatch delivers results in a few big bursts), and
+                # a plain `continue` would strand the getter for the
+                # full 0.25 s timeout after every burst.
+                self._owned_cond.wait(max(0.001, 0.02 - (now - last_scan)))
+                now = _time.monotonic()
             last_scan = now
             progressed, still = False, []
             for hex_id in waiting:
@@ -1474,6 +1571,21 @@ class CoreRuntime:
         # Results come straight back to this runtime's owner plane.
         spec.owner_addr = self.owner_addr
         self._register_expected(spec)
+        if self._direct is not None:
+            # Lease-cached fast path (reference: the owner-side lease
+            # cache, normal_task_submitter.cc:29): same-shape tasks ride
+            # a granted worker lease owner→worker, zero head frames.
+            if self._direct.submit_task(spec):
+                return
+            body = self._spec_body(spec)
+            want = self._direct.lease_want(spec)
+            if want is not None:
+                # Piggyback the lease request on the head submit: the
+                # head grants once it places this task on a leasable
+                # worker, and subsequent same-shape tasks go direct.
+                body["lease_key"] = want
+            self.conn.cast_buffered("submit_task", body)
+            return
         # Buffered: a submission burst ships as one CAST_BATCH frame.
         # Ordering vs a following get/wait is preserved because every
         # call()/cast() on the connection flushes the buffer first.
@@ -1482,6 +1594,11 @@ class CoreRuntime:
     def submit_actor_task(self, spec: TaskSpec) -> None:
         spec.owner_addr = self.owner_addr
         self._register_expected(spec)
+        # Direct fast path: once the head has granted this owner the
+        # actor's worker address, calls pipeline owner→worker (peer
+        # connection FIFO + owner-side window) without a head hop.
+        if self._direct is not None and self._direct.submit_actor(spec):
+            return
         self.conn.cast_buffered("submit_actor_task", self._spec_body(spec))
 
     def create_actor(self, spec: ActorSpec) -> None:
@@ -1503,6 +1620,11 @@ class CoreRuntime:
 
     def close(self) -> None:
         self._closed = True
+        if self._direct is not None:
+            try:
+                self._direct.close()
+            except Exception:
+                pass
         ids_mod.set_ref_removed_callback(None)
         ids_mod.set_borrow_callbacks(None, None)
         if self.owner_server is not None:

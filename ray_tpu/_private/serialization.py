@@ -19,6 +19,7 @@ import contextlib
 import io
 import pickle
 import struct
+import sys
 import threading
 from typing import Any
 
@@ -102,11 +103,28 @@ class _RuntimePickler(cloudpickle.Pickler):
         reducer = custom_reducers.get(type(obj))
         if reducer is not None:
             return reducer(obj)
+        if "jax" in sys.modules:
+            # Device→host conversion at ANY nesting depth: a jax.Array
+            # inside a list/dict/dataclass pickles as its host numpy
+            # copy (device buffers are not picklable). The old top-level
+            # _to_host only caught bare arrays — nested ones crashed the
+            # pickler. Guarded on sys.modules so jax-free processes
+            # never pay the import.
+            import jax
+
+            if isinstance(obj, jax.Array):
+                import numpy as np
+
+                return np.asarray(obj).__reduce_ex__(5)
         return super().reducer_override(obj)
 
 
 def _dump(obj: Any, protocol: int = 5, buffer_callback=None) -> bytes:
-    if not custom_reducers and getattr(_ref_collector, "ids", None) is None:
+    # The C-pickler fast path is only safe when no per-runtime reducer
+    # can fire: custom reducers, an active ref collector, or a loaded
+    # jax (nested device arrays need reducer_override's host conversion).
+    if (not custom_reducers and "jax" not in sys.modules
+            and getattr(_ref_collector, "ids", None) is None):
         return cloudpickle.dumps(obj, protocol=protocol,
                                  buffer_callback=buffer_callback)
     f = io.BytesIO()

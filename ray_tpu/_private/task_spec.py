@@ -65,11 +65,17 @@ class TaskSpec:
     #   _deferred_results — worker-side buffer of inline results
     #   _remote_markers — worker-side "stored big, ask the head" notes
     #                     delivered to the owner alongside inline seals
+    #   _lease_key      — head-side: owner wants a worker lease for this
+    #                     task shape (echoed back in the lease_grant)
+    #   _direct         — worker-side: task arrived over the direct
+    #                     plane (owner→worker push, not a head dispatch)
     _rkey: Any = dataclasses.field(default=None, repr=False)
     _demand: Any = dataclasses.field(default=None, repr=False)
     _deps_pending: Any = dataclasses.field(default=None, repr=False)
     _deferred_results: Any = dataclasses.field(default=None, repr=False)
     _remote_markers: Any = dataclasses.field(default=None, repr=False)
+    _lease_key: Any = dataclasses.field(default=None, repr=False)
+    _direct: Any = dataclasses.field(default=None, repr=False)
     # Submit-time compiled encoding, reused verbatim for the worker push
     # (the hot path packed every spec TWICE: submitter->head and
     # head->worker). Must be invalidated wherever a PACKED field mutates
@@ -80,7 +86,7 @@ class TaskSpec:
     _packed_bin: Any = dataclasses.field(default=None, repr=False)
 
     _SCRATCH = ("_rkey", "_demand", "_deps_pending", "_deferred_results",
-                "_remote_markers", "_packed_bin")
+                "_remote_markers", "_packed_bin", "_lease_key", "_direct")
 
     def __getstate__(self):
         """Strip scratch slots (dispatch caches, the packed-bytes
@@ -121,6 +127,32 @@ class TaskSpec:
                     else:
                         v = None
                     object.__setattr__(self, f.name, v)
+
+
+def env_pkg_key(renv: "dict | None") -> "str | None":
+    """Hash of the package half of a runtime env (pip/conda), or None
+    for envs that don't alter installed packages — only the package
+    half poisons a worker's sys.modules for other envs. Shared by the
+    head's shape-keyed ready queues and the owner-side lease cache
+    (their keys MUST match or lease grants would never be spent)."""
+    if not renv:
+        return None
+    pkg = {k: renv[k] for k in ("pip", "conda", "uv") if renv.get(k)}
+    if not pkg:
+        return None
+    import hashlib as _hashlib
+
+    return _hashlib.sha256(repr(sorted(
+        (k, repr(v)) for k, v in pkg.items())).encode()).hexdigest()[:16]
+
+
+def shape_key(spec: "TaskSpec") -> tuple:
+    """Resource-shape key of a default-strategy task: every task with
+    the same key shares placement feasibility, so a worker lease
+    granted for one serves them all (reference analogue: the owner-side
+    lease cache keyed by SchedulingClass, normal_task_submitter.cc:29)."""
+    return (tuple(sorted((spec.resources or {}).items())),
+            env_pkg_key(spec.runtime_env))
 
 
 # --- compiled fast path (reference: the C++ TaskSpecification built/

@@ -123,6 +123,9 @@ class Worker:
         self._drain_scheduled = False
         self._drain_lock = threading.Lock()
         self._drainer_tls = threading.local()
+        # Direct-call plane: tasks pushed owner→worker without a head
+        # hop, counted for worker-side back-pressure (_on_direct_push).
+        self._direct_inflight = 0
         self.runtime = CoreRuntime(
             head_addr,
             client_type="worker",
@@ -130,6 +133,9 @@ class Worker:
             message_handler=self._on_message,
         )
         worker_context.set_runtime(self.runtime)
+        # Accept direct submissions on the runtime's peer server (the
+        # same socket owners fetch objects from).
+        self.runtime._peer_task_handler = self._on_direct_push
         # The runtime's adaptive release loop also drains stale seal
         # batches (a burst buffered before a long task must not wait
         # for the task to end).
@@ -155,26 +161,8 @@ class Worker:
         if kind == "push_task":
             from ray_tpu._private.task_spec import spec_from_body
 
-            spec = spec_from_body(body)
-            if (self.async_exec is not None and spec.actor_id is not None
-                    and not spec.actor_creation):
-                self.async_exec.submit(
-                    self._run_task_async_guarded(spec),
-                    on_error=lambda exc, s=spec: self._async_task_crashed(
-                        s, exc))
-            elif (spec.actor_id is None and not spec.actor_creation
-                    and self.actor_instance is None
-                    and spec.concurrency_group is None):
-                with self._drain_lock:
-                    self._task_q.append((spec, body.get("tpu_chips")))
-                    start = not self._drain_scheduled
-                    if start:
-                        self._drain_scheduled = True
-                if start:
-                    self.executor.submit(self._drain_tasks)
-            else:
-                self._executor_for(spec).submit(
-                    self._run_task_guarded, spec, body.get("tpu_chips"))
+            self._dispatch_spec(spec_from_body(body),
+                                body.get("tpu_chips"))
         elif kind == "become_actor":
             # An actor conversion reprieves any pending max_calls
             # retirement (the head ignores worker_retiring from actor
@@ -226,6 +214,63 @@ class Worker:
             # semantics: running actor tasks need force/kill).
             self._cancelled_ids.add(body["task_id"])
         return None
+
+    def _dispatch_spec(self, spec, tpu_chips) -> None:
+        """Route one spec into the execution machinery — shared by
+        head pushes (push_task) and direct owner pushes (direct_push):
+        async-actor loop, the normal-task drainer deque, or the
+        (concurrency-group) thread pools."""
+        if (self.async_exec is not None and spec.actor_id is not None
+                and not spec.actor_creation):
+            self.async_exec.submit(
+                self._run_task_async_guarded(spec),
+                on_error=lambda exc, s=spec: self._async_task_crashed(
+                    s, exc))
+        elif (spec.actor_id is None and not spec.actor_creation
+                and self.actor_instance is None
+                and spec.concurrency_group is None):
+            with self._drain_lock:
+                self._task_q.append((spec, tpu_chips))
+                start = not self._drain_scheduled
+                if start:
+                    self._drain_scheduled = True
+            if start:
+                self.executor.submit(self._drain_tasks)
+        else:
+            self._executor_for(spec).submit(
+                self._run_task_guarded, spec, tpu_chips)
+
+    def _on_direct_push(self, body: dict, conn) -> None:
+        """Direct-call plane receiver (reference: task_receiver.cc:38
+        HandleTask — workers accept submissions straight from owners).
+        Ordering rides the peer connection's FIFO (this handler runs on
+        its reader thread, in arrival order, into FIFO executors);
+        ``direct_ack`` is the owner's delivery receipt (its watchdog
+        re-routes unacked calls through the head), and past the
+        inflight high-water mark — or while retiring — pushes are
+        REJECTED so the owner spills back to the head path instead of
+        deepening an unbounded queue on a dying/overloaded worker."""
+        from ray_tpu._private.config import GLOBAL_CONFIG
+        from ray_tpu._private.task_spec import spec_from_body
+
+        spec = spec_from_body(body)
+        limit = GLOBAL_CONFIG.direct_worker_inflight_max
+        if (self._exit.is_set()
+                or getattr(self, "_recycle_pending", False)
+                or getattr(self, "_retiring_sent", False)
+                or self._direct_inflight >= limit):
+            try:
+                conn.cast_buffered("direct_rej", {"task_id": spec.task_id})
+            except Exception:
+                pass
+            return
+        spec._direct = True
+        self._direct_inflight += 1
+        try:
+            conn.cast_buffered("direct_ack", {"task_ids": [spec.task_id]})
+        except Exception:
+            pass
+        self._dispatch_spec(spec, body.get("tpu_chips"))
 
     def _sample_profile(self, body: dict) -> None:
         """Where does time GO (not just where is it stuck): sample every
@@ -770,6 +815,9 @@ class Worker:
         work. Pipelined tasks already queued on this worker DRAIN
         first (a max_retries=0 task must never be lost to a recycle);
         fresh processes replace it through the normal pool path."""
+        if getattr(spec, "_direct", None):
+            # Direct-plane inflight accounting (back-pressure window).
+            self._direct_inflight = max(0, self._direct_inflight - 1)
         mc = getattr(spec, "max_calls", 0)
         if mc:
             n = self._calls_by_func.get(spec.func_id, 0) + 1

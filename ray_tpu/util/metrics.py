@@ -228,3 +228,24 @@ def _cumulative(buckets: list[int]) -> list[int]:
         total += b
         out.append(total)
     return out
+
+
+def rpc_counters() -> dict:
+    """Dispatch-plane RPC counters for THIS process (cheap ints kept on
+    every rpc.Connection): per-kind message census and frame counts for
+    the head connection and every peer (direct-call) connection, plus
+    the direct plane's own dispatch counters. The frame-count
+    regression guard (tests/test_dispatch_fastpath.py) is built on
+    these — steady-state direct dispatch must add ZERO per-call frames
+    on the head connection."""
+    rt = global_runtime()
+
+    def _conn(c) -> dict:
+        return {"frames_sent": c.frames_sent, "calls_sent": c.calls_sent,
+                "sent_kinds": dict(c.sent_kinds)}
+
+    with rt._owner_conns_lock:
+        peers = {f"{a[0]}:{a[1]}": _conn(c)
+                 for a, c in rt._owner_conns.items()}
+    direct = rt._direct.snapshot() if rt._direct is not None else {}
+    return {"head": _conn(rt.conn), "peers": peers, "direct": direct}
