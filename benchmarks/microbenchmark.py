@@ -120,9 +120,47 @@ def main(as_json: bool = False) -> dict:
 
     ray_tpu.kill(actor)
     ray_tpu.shutdown()
+    bench_event_overhead(results)
     if as_json:
         print(json.dumps({"microbenchmark": results}))
     return results
+
+
+def bench_event_overhead(results: dict) -> None:
+    """Flight-recorder overhead: pipelined direct actor calls with the
+    tracing plane on vs off (RAY_TPU_TASK_EVENTS_ENABLED — inherited by
+    spawned workers, so the whole cluster flips). Events ride existing
+    messages, so the delta is the stamping cost (a few time.time()
+    calls and dict writes per task), not extra frames."""
+    import os
+
+    from ray_tpu._private import config as config_mod
+
+    for mode in ("on", "off"):
+        # Env var: spawned workers and the head's fresh Config pick it
+        # up; the in-place mutation flips the driver-side stamping
+        # (modules bound GLOBAL_CONFIG by reference at import).
+        os.environ["RAY_TPU_TASK_EVENTS_ENABLED"] = (
+            "1" if mode == "on" else "0")
+        config_mod.GLOBAL_CONFIG.task_events_enabled = (mode == "on")
+        ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024 * 1024,
+                     log_to_driver=False)
+
+        @ray_tpu.remote
+        class EvEcho:
+            def ping(self, x=None):
+                return x
+
+        actor = EvEcho.remote()
+        ray_tpu.get([actor.ping.remote() for _ in range(64)])  # warm
+        timeit(f"actor pipeline depth 32 events {mode}",
+               lambda: ray_tpu.get(
+                   [actor.ping.remote() for _ in range(32)]),
+               32, results=results)
+        ray_tpu.kill(actor)
+        ray_tpu.shutdown()
+    os.environ.pop("RAY_TPU_TASK_EVENTS_ENABLED", None)
+    config_mod.GLOBAL_CONFIG.task_events_enabled = True
 
 
 if __name__ == "__main__":

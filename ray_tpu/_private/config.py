@@ -168,6 +168,21 @@ class Config:
     # --- task events / observability ---
     task_events_max_buffer: int = 100000
     metrics_report_interval_s: float = 5.0
+    # Flight-recorder tracing plane (_private/events.py): stamp per-hop
+    # lifecycle phases onto existing control-plane messages and keep a
+    # bounded head-side event table rendered by util.state.timeline().
+    # Costs a few time.time() calls and floats per task; disable for
+    # overhead-sensitive floods (benchmarks/microbenchmark.py measures
+    # the delta).
+    task_events_enabled: bool = True
+    # How often each runtime piggybacks its rpc counter snapshot (and
+    # buffered chaos events) to the head — the cluster-wide half of
+    # ray_tpu.util.metrics.rpc_counters(). Amortized, never per-call.
+    rpc_report_interval_s: float = 5.0
+    # Agent clock probe cadence: one NTP-style clock_sync call per this
+    # many heartbeats feeds the head's per-node clock-offset table used
+    # to align cross-node trace spans.
+    clock_sync_every_n_heartbeats: int = 5
 
     def apply_overrides(self, overrides: dict | None = None) -> "Config":
         cfg = dataclasses.replace(self)

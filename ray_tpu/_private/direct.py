@@ -273,6 +273,15 @@ class DirectPlane:
         body = self._spec_body(spec, specenc)
         if tpu_chips:
             body["tpu_chips"] = tpu_chips
+        evt = None
+        if spec._evt is not None:
+            # Flight recorder: the direct-plane push stamp rides the
+            # push itself AND the buffered task_started bookkeeping (so
+            # the head's event table sees in-flight direct tasks too) —
+            # zero new frames, two floats on frames that already flow.
+            evt = dict(spec._evt)
+            evt["push"] = time.time()
+            body["evt"] = evt
         try:
             conn = self.rt._peer_owner_conn(
                 tuple(addr), expect_owner=worker_id,
@@ -288,6 +297,8 @@ class DirectPlane:
         started = self._spec_body(spec, self.rt._head_specenc)
         started["worker_id"] = worker_id
         started["direct"] = kind
+        if evt is not None:
+            started["evt"] = evt
         try:
             self.rt.conn.cast_buffered("task_started", started)
         except rpc.ConnectionLost:

@@ -117,12 +117,19 @@ class FaultPlane:
 
     def __init__(self, rules: list[FaultRule], seed: int = 0):
         import random
+        from collections import deque
 
         self.rules = rules
         self.seed = seed
         self._rng = random.Random(seed)
         self._lock = threading.Lock()
         self.stats: Counter = Counter()
+        # Flight-recorder feed: every applied fault is also an instant
+        # event, drained into the head's event table (directly when this
+        # process hosts the head, else piggybacked on the next
+        # rpc_report cast) so chaos-test failures are READABLE in the
+        # same Perfetto trace as the task lifecycle spans.
+        self.events: deque = deque(maxlen=1000)
 
     @classmethod
     def from_spec(cls, spec: dict) -> "FaultPlane":
@@ -144,21 +151,35 @@ class FaultPlane:
                 r_error = self._rng.random() if rule.error else 1.0
             if r_error < rule.error:
                 self.stats[f"error:{kind}"] += 1
+                self._record("error", direction, peer_desc, kind)
                 return Action(error=True)
             if r_drop < rule.drop:
                 self.stats[f"drop:{kind}"] += 1
+                self._record("drop", direction, peer_desc, kind)
                 return Action(drop=True)
             if act is None:
                 act = Action()
             if r_delay < rule.delay_prob and rule.delay_s:
                 act.delay_s = max(act.delay_s, rule.delay_s)
                 self.stats[f"delay:{kind}"] += 1
+                self._record("delay", direction, peer_desc, kind,
+                             delay_s=rule.delay_s)
             if r_dup < rule.dup:
                 act.dup = True
                 self.stats[f"dup:{kind}"] += 1
+                self._record("dup", direction, peer_desc, kind)
         if act is not None and not (act.delay_s or act.dup):
             return None
         return act
+
+    def _record(self, action: str, direction: str, peer_desc: str,
+                kind: str, delay_s: float = 0.0) -> None:
+        ev = {"event": "chaos", "ts": time.time(), "action": action,
+              "direction": direction, "peer": peer_desc, "kind": kind,
+              "pid": os.getpid()}
+        if delay_s:
+            ev["delay_s"] = delay_s
+        self.events.append(ev)
 
 
 _plane: FaultPlane | None = None
@@ -195,6 +216,21 @@ def configure(spec: dict | None) -> FaultPlane | None:
         _plane = FaultPlane.from_spec(spec) if spec is not None else None
         _loaded = True
     return _plane
+
+
+def drain_events() -> "list[dict]":
+    """Pop the active plane's buffered chaos instants (empty when no
+    plane is installed). deque.popleft is atomic, so concurrent
+    recorders never lose an event to the drain."""
+    pl = active()
+    if pl is None:
+        return []
+    out: list[dict] = []
+    while True:
+        try:
+            out.append(pl.events.popleft())
+        except IndexError:
+            return out
 
 
 @contextmanager

@@ -337,7 +337,21 @@ class Head:
         # owner_id -> freed object ids awaiting one coalesced
         # owned_freed cast (flushed per dispatch pass).
         self._owned_freed_buf: dict[str, list] = {}
-        self.task_events: deque[dict] = deque(maxlen=config.task_events_max_buffer)
+        # Flight-recorder event table (reference: gcs_task_manager.h:159
+        # bounded task-event ring): lifecycle events merged per task as
+        # stamps arrive on submit/task_started/task_finished/owner_sealed,
+        # plus user spans, profile events, and chaos instants.
+        from ray_tpu._private.events import EventTable
+
+        self.task_events = EventTable(config.task_events_max_buffer)
+        # Per-node clock offsets (node_clock - head_clock), estimated
+        # NTP-style over the agent heartbeat loop; timeline() aligns
+        # cross-node spans with them.
+        self.clock_offsets: dict[str, float] = {}
+        # Cluster-wide rpc counter snapshots: client_id -> last report
+        # (workers/drivers via the amortized rpc_report cast, agents
+        # piggybacked on their heartbeats).
+        self.rpc_reports: dict[str, dict] = {}
         self.metrics: dict[str, Any] = {}
         # Core runtime counters (reference: DEFINE_stats core metric set,
         # src/ray/stats/metric_defs.h:46 — `tasks`, `actors`, …); gauges
@@ -764,6 +778,7 @@ class Head:
         with self.lock:
             self.clients.pop(client_id, None)
             self.client_owner_addrs.pop(client_id, None)
+            self.rpc_reports.pop(client_id, None)
             # A dead owner's worker leases end now (its direct pushes
             # died with it; the workers must rejoin the pool).
             for w in self.workers.values():
@@ -842,6 +857,8 @@ class Head:
             self._agent_last_seen.pop(node_id, None)
             self.node_transfer_addrs.pop(node_id, None)
             self.node_bulk_addrs.pop(node_id, None)
+            self.clock_offsets.pop(node_id, None)
+            self.rpc_reports.pop(f"agent:{node_id}", None)
             self.scheduler.mark_dead(node_id)
             # P2P payloads hosted by the dead node are gone; mark the
             # entries lost so fetches trigger lineage reconstruction
@@ -895,11 +912,41 @@ class Head:
     # --- health plane (reference: gcs_health_check_manager.h:45) ------
 
     def _h_agent_heartbeat(self, body: dict, conn):
-        """Agent liveness beacon (cast every health_check_period_s)."""
+        """Agent liveness beacon (cast every health_check_period_s).
+        Piggybacks the node's estimated clock offset (timeline
+        alignment) and the agent's rpc counter snapshot (cluster-wide
+        rpc_counters aggregation) — observability rides the beacon that
+        already flows instead of new frames."""
         with self.lock:
             nid = body.get("node_id")
             if nid in self.node_agents:
                 self._agent_last_seen[nid] = time.time()
+                if body.get("clock_offset") is not None:
+                    self.clock_offsets[nid] = float(body["clock_offset"])
+                if body.get("rpc") is not None:
+                    self.rpc_reports[f"agent:{nid}"] = {
+                        "counters": body["rpc"], "ts": time.time()}
+        return None
+
+    def _h_clock_sync(self, body: dict, conn):
+        """NTP-style probe target: the agent records t0/t1 around this
+        call and estimates its node's offset as (t0+t1)/2 - t_head
+        (reference analogue: the profiling timeline's cross-node clock
+        alignment in the GCS usage/metrics plumbing)."""
+        return {"t_head": time.time()}
+
+    def _h_rpc_report(self, body: dict, conn):
+        """A runtime's amortized counter snapshot (and buffered chaos
+        events) — the cluster-wide half of util.metrics.rpc_counters."""
+        cid = body.get("client_id") or conn.peer_info.get("client_id")
+        with self.lock:
+            if cid:
+                self.rpc_reports[cid] = {
+                    "counters": body.get("counters") or {},
+                    "type": body.get("client_type"),
+                    "ts": time.time()}
+        if body.get("chaos_events"):
+            self.task_events.extend(body["chaos_events"])
         return None
 
     def _health_loop(self) -> None:
@@ -1265,6 +1312,12 @@ class Head:
                 self._seal_remote_locked(sbody)
             need = self._sealed_woke_task
             self._sealed_woke_task = False
+            if body.get("t_resolve") and self.config.task_events_enabled:
+                # Flight recorder: the owner holds the results — stamp
+                # the resolve phase on the producing tasks' timelines.
+                self.task_events.resolve(
+                    [o["object_id"] for o in body["objects"]],
+                    body["t_resolve"])
         if need:
             self.dispatch_event.set()
         return None
@@ -1778,6 +1831,7 @@ class Head:
 
     def _h_submit_task(self, body, conn):
         spec: TaskSpec = spec_from_body(body)
+        self._adopt_evt(spec, body)
         if body.get("lease_key") is not None:
             # The owner wants a direct-dispatch lease for this shape:
             # granted in _push_to_worker once the task lands on a
@@ -1812,6 +1866,27 @@ class Head:
                 self._record_lineage(spec)
         self.dispatch_event.set()
         return None
+
+    # --- flight recorder (events.py) ----------------------------------
+
+    def _adopt_evt(self, spec: TaskSpec, body: dict) -> None:
+        """A head-routed submission landed: adopt the owner's phase
+        stamps onto the in-process spec and add the enqueue stamp. The
+        stamps ride the eventual push_task body to the worker, which
+        returns the full timeline inside task_finished."""
+        if not self.config.task_events_enabled:
+            return
+        evt = dict(body.get("evt") or {})
+        evt["enqueue"] = time.time()
+        spec._evt = evt
+        self.task_events.register_oids(spec.task_id, spec.return_ids)
+
+    def _client_node(self, client_id: "str | None") -> "str | None":
+        """lock held (or best-effort). The node a client's clock lives
+        on: workers map through their record; drivers co-locate with the
+        head (offset 0 either way when unknown)."""
+        rec = self.workers.get(client_id or "")
+        return rec.node_id if rec is not None else self.node_id
 
     # Package-env hash shared with the owner-side lease cache (the two
     # sides must key shapes identically) — see task_spec.env_pkg_key.
@@ -1991,6 +2066,14 @@ class Head:
                     except rpc.ConnectionLost:
                         pass
         if body.get("events"):
+            for ev in body["events"]:
+                # Clock-domain annotation for cross-node alignment: the
+                # owner's submit/push/resolve stamps are on the owner
+                # node's clock, the worker's on its node's clock.
+                if (isinstance(ev, dict) and "phases" in ev
+                        and "owner_node_id" not in ev):
+                    ev["owner_node_id"] = self._client_node(
+                        ev.get("owner_id"))
             self.task_events.extend(body["events"])
         rec = self.workers.get(worker_id)
         if rec is None:
@@ -2148,6 +2231,7 @@ class Head:
 
     def _h_submit_actor_task(self, body, conn):
         spec: TaskSpec = spec_from_body(body)
+        self._adopt_evt(spec, body)
         with self.lock:
             for oid in spec.return_ids:
                 entry = self.objects.get(oid) or ObjectEntry(oid, spec.owner_id)
@@ -2270,6 +2354,26 @@ class Head:
                 }
                 if spec.actor_id is None:
                     self._record_lineage(spec)
+            if (self.config.task_events_enabled and not known
+                    and body.get("evt")):
+                # Flight recorder: a partial lifecycle record makes the
+                # in-flight direct task visible in the timeline NOW; the
+                # worker's task_finished completes it (merge by task id)
+                # and owner_sealed adds the resolve stamp.
+                wrec = self.workers.get(worker_id or "")
+                self.task_events.merge({
+                    "task_id": spec.task_id,
+                    "name": spec.name,
+                    "worker_id": worker_id,
+                    "node_id": wrec.node_id if wrec is not None else None,
+                    "pid": wrec.pid if wrec is not None else None,
+                    "owner_id": spec.owner_id,
+                    "owner_node_id": self._client_node(spec.owner_id),
+                    "direct": True,
+                    "phases": dict(body["evt"]),
+                })
+                self.task_events.register_oids(spec.task_id,
+                                               spec.return_ids)
             rec = self.workers.get(worker_id or "")
             if rec is not None and not finished and not known:
                 rec.inflight[spec.task_id] = spec
@@ -3006,13 +3110,22 @@ class Head:
             return {"metrics": dict(self.metrics)}
 
     def _h_get_task_events(self, body, conn):
-        task_ids = body.get("task_ids")
+        from ray_tpu._private import faultinject
+
+        # Chaos instants injected in THIS process (local clusters: the
+        # head shares the driver process, covering owner-side injection
+        # deterministically); remote processes piggyback theirs on the
+        # periodic rpc_report cast.
+        chaos = faultinject.drain_events()
+        if chaos:
+            self.task_events.extend(chaos)
+        events = self.task_events.snapshot(
+            limit=body.get("limit", 10000),
+            task_ids=body.get("task_ids"))
         with self.lock:
-            events = list(self.task_events)
-        if task_ids is not None:
-            wanted = set(task_ids)
-            events = [e for e in events if e.get("task_id") in wanted]
-        return {"events": events[-body.get("limit", 10000):]}
+            offsets = dict(self.clock_offsets)
+        return {"events": events, "clock_offsets": offsets,
+                "head_node_id": self.node_id}
 
     # ------------------------------------------------------------------
     # dispatch loop (the raylet role)
@@ -3463,6 +3576,14 @@ class Head:
             push_body = ({"spec_bin": packed} if packed is not None
                          else {"spec": spec})
             push_body["tpu_chips"] = rec.tpu_chips
+            if spec._evt is not None:
+                # Flight recorder: the head's dispatch stamp joins the
+                # owner's submit/enqueue stamps on the push it already
+                # rides (retries re-stamp — the timeline shows the
+                # attempt that actually executed).
+                evt = dict(spec._evt)
+                evt["dispatch"] = time.time()
+                push_body["evt"] = evt
             if buffered:
                 rec.conn.cast_buffered("push_task", push_body)
                 self._push_touched.add(rec.conn)
@@ -3845,6 +3966,8 @@ class Head:
                                 if r.conn is not None)
             actors_alive = sum(1 for a in self.actors.values()
                                if a.state == "ALIVE")
+            rpc = {cid: dict(r.get("counters") or {})
+                   for cid, r in self.rpc_reports.items()}
             return {
                 "counters": dict(self.stats),
                 "gauges": {
@@ -3855,6 +3978,20 @@ class Head:
                     "nodes_alive": 1 + len(self.node_agents),
                     "tasks_pending": sum(len(q) for q in
                                          self.ready_queues.values()),
+                },
+                # Phase-latency histograms (queue wait / dispatch / exec
+                # / result transfer) from the flight-recorder plane.
+                "histograms": self.task_events.hist_snapshot(),
+                # Cluster-wide per-process rpc counters: every runtime's
+                # snapshot (amortized rpc_report casts + agent
+                # heartbeats), so the zero-head-frames property is
+                # checkable for the whole cluster, not just locally.
+                "rpc": {
+                    "clients": rpc,
+                    "total_head_frames": sum(
+                        (c.get("head") or {}).get("frames_sent", 0)
+                        for c in rpc.values()),
+                    "clock_offsets": dict(self.clock_offsets),
                 },
             }
 

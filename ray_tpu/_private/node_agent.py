@@ -17,6 +17,7 @@ import socket
 import subprocess
 import sys
 import threading
+from collections import deque
 
 from ray_tpu._private import rpc
 from ray_tpu._private.config import GLOBAL_CONFIG
@@ -169,10 +170,40 @@ class NodeAgent:
                          name="agent-heartbeat").start()
 
     def _heartbeat_loop(self) -> None:
+        import time as _time
+
         period = max(0.1, GLOBAL_CONFIG.health_check_period_s)
+        every_n = max(1, int(GLOBAL_CONFIG.clock_sync_every_n_heartbeats))
+        # Recent NTP-style probes as (rtt, offset); the min-RTT sample
+        # wins — queueing delay only ever inflates RTT, so the tightest
+        # round trip carries the least-biased offset estimate.
+        probes: "deque[tuple[float, float]]" = deque(maxlen=8)
+        beat = 0
         while not self._exit.wait(period):
+            body: dict = {"node_id": self.node_id}
+            if beat % every_n == 0:
+                try:
+                    # Clock probe (timeline alignment): offset estimate
+                    # = (t0+t1)/2 - t_head, i.e. node_clock - head_clock
+                    # assuming symmetric network latency.
+                    t0 = _time.time()
+                    reply = self.conn.call("clock_sync", {}, timeout=5)
+                    t1 = _time.time()
+                    probes.append(((t1 - t0),
+                                   (t0 + t1) / 2.0 - reply["t_head"]))
+                except Exception:
+                    pass  # older head / transient failure: keep beating
+            if probes:
+                body["clock_offset"] = min(probes)[1]
+            # Cluster-wide rpc counter aggregation: this agent's own
+            # head-connection census rides the beacon.
+            body["rpc"] = {"head": {
+                "frames_sent": self.conn.frames_sent,
+                "calls_sent": self.conn.calls_sent,
+                "sent_kinds": dict(self.conn.sent_kinds)}}
+            beat += 1
             try:
-                self.conn.cast("agent_heartbeat", {"node_id": self.node_id})
+                self.conn.cast("agent_heartbeat", body)
             except (rpc.ConnectionLost, rpc.RpcError):
                 pass  # reconnect loop owns recovery
 

@@ -14,6 +14,7 @@ import hashlib
 import os
 import pickle
 import threading
+import time
 import uuid
 from concurrent.futures import Future
 from concurrent.futures import TimeoutError as FutureTimeoutError
@@ -255,9 +256,39 @@ class CoreRuntime:
             from ray_tpu._private.direct import DirectPlane
 
             self._direct = DirectPlane(self)
+        self._last_rpc_report = 0.0
         self._release_thread = threading.Thread(
             target=self._release_loop, daemon=True, name="ref-release")
         self._release_thread.start()
+
+    def rpc_counter_snapshot(self) -> dict:
+        """This process's dispatch-plane counters (the per-process half
+        of ray_tpu.util.metrics.rpc_counters, sans the runtime lookup)."""
+        def _conn(c) -> dict:
+            return {"frames_sent": c.frames_sent,
+                    "calls_sent": c.calls_sent,
+                    "sent_kinds": dict(c.sent_kinds)}
+
+        with self._owner_conns_lock:
+            peers = {f"{a[0]}:{a[1]}": _conn(c)
+                     for a, c in self._owner_conns.items()}
+        return {"head": _conn(self.conn), "peers": peers,
+                "direct": (self._direct.snapshot()
+                           if self._direct is not None else {})}
+
+    def report_rpc_now(self) -> None:
+        """Ship this process's counter snapshot (plus buffered chaos
+        events) to the head. Called from the release loop on the
+        rpc_report_interval_s cadence; tests call it directly."""
+        from ray_tpu._private import faultinject
+
+        body = {"client_id": self.client_id, "client_type": self.client_type,
+                "counters": self.rpc_counter_snapshot()}
+        chaos = faultinject.drain_events()
+        if chaos:
+            body["chaos_events"] = chaos
+        if not self.conn.closed:
+            self.conn.cast_buffered("rpc_report", body)
 
     # ------------------------------------------------------------------
     # inbound messages
@@ -490,6 +521,18 @@ class CoreRuntime:
                     self._direct.tick()
                 except Exception:
                     pass
+            now = _time.monotonic()
+            if (now - self._last_rpc_report
+                    >= GLOBAL_CONFIG.rpc_report_interval_s):
+                self._last_rpc_report = now
+                try:
+                    # Cluster-wide counter aggregation: this process's
+                    # dispatch-plane census (and any buffered chaos
+                    # events) rides ONE amortized buffered cast — the
+                    # per-call head-frame count stays untouched.
+                    self.report_rpc_now()
+                except Exception:
+                    pass
             delay = 0.05 if had_work else min(delay * 2, 2.0)
             _time.sleep(delay)
 
@@ -578,18 +621,24 @@ class CoreRuntime:
                 for r in objs if not r.get("remote")]
         if not slim:
             return
+        body = {"objects": slim}
+        if GLOBAL_CONFIG.task_events_enabled:
+            # Flight recorder: the owner now HOLDS these results — the
+            # resolve stamp rides the confirmation the head needs anyway
+            # (one float per batch, zero extra frames).
+            body["t_resolve"] = time.time()
         # Local mode: the head runs in THIS process (driver == head
         # host) — confirm by direct call instead of a socket round trip
         # (one fewer message per task on the completion path).
         head = self._inproc_head()
         if head is not None:
             try:
-                head._h_owner_sealed({"objects": slim}, None)
+                head._h_owner_sealed(body, None)
                 return
             except Exception:
                 pass
         try:
-            self.conn.cast_buffered("owner_sealed", {"objects": slim})
+            self.conn.cast_buffered("owner_sealed", body)
         except rpc.ConnectionLost:
             pass
 
@@ -1571,6 +1620,11 @@ class CoreRuntime:
         # Results come straight back to this runtime's owner plane.
         spec.owner_addr = self.owner_addr
         self._register_expected(spec)
+        if GLOBAL_CONFIG.task_events_enabled:
+            # Flight recorder (events.py): the owner-side submit stamp.
+            # Lives on the spec's scratch slot while in this process;
+            # each wire hop carries it in the message's "evt" field.
+            spec._evt = {"submit": time.time()}
         if self._direct is not None:
             # Lease-cached fast path (reference: the owner-side lease
             # cache, normal_task_submitter.cc:29): same-shape tasks ride
@@ -1578,6 +1632,8 @@ class CoreRuntime:
             if self._direct.submit_task(spec):
                 return
             body = self._spec_body(spec)
+            if spec._evt is not None:
+                body["evt"] = dict(spec._evt)
             want = self._direct.lease_want(spec)
             if want is not None:
                 # Piggyback the lease request on the head submit: the
@@ -1589,17 +1645,25 @@ class CoreRuntime:
         # Buffered: a submission burst ships as one CAST_BATCH frame.
         # Ordering vs a following get/wait is preserved because every
         # call()/cast() on the connection flushes the buffer first.
-        self.conn.cast_buffered("submit_task", self._spec_body(spec))
+        body = self._spec_body(spec)
+        if spec._evt is not None:
+            body["evt"] = dict(spec._evt)
+        self.conn.cast_buffered("submit_task", body)
 
     def submit_actor_task(self, spec: TaskSpec) -> None:
         spec.owner_addr = self.owner_addr
         self._register_expected(spec)
+        if GLOBAL_CONFIG.task_events_enabled:
+            spec._evt = {"submit": time.time()}
         # Direct fast path: once the head has granted this owner the
         # actor's worker address, calls pipeline owner→worker (peer
         # connection FIFO + owner-side window) without a head hop.
         if self._direct is not None and self._direct.submit_actor(spec):
             return
-        self.conn.cast_buffered("submit_actor_task", self._spec_body(spec))
+        body = self._spec_body(spec)
+        if spec._evt is not None:
+            body["evt"] = dict(spec._evt)
+        self.conn.cast_buffered("submit_actor_task", body)
 
     def create_actor(self, spec: ActorSpec) -> None:
         self.conn.call("create_actor", {"spec": spec})

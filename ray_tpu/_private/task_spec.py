@@ -69,6 +69,12 @@ class TaskSpec:
     #                     task shape (echoed back in the lease_grant)
     #   _direct         — worker-side: task arrived over the direct
     #                     plane (owner→worker push, not a head dispatch)
+    #   _evt            — flight-recorder phase stamps accumulated while
+    #                     the spec is in THIS process ({phase: ts},
+    #                     events.py); each wire hop copies them into the
+    #                     carrying message's "evt" field instead of the
+    #                     spec pickle, so disabled-events payloads are
+    #                     byte-identical to the pre-tracing wire format
     _rkey: Any = dataclasses.field(default=None, repr=False)
     _demand: Any = dataclasses.field(default=None, repr=False)
     _deps_pending: Any = dataclasses.field(default=None, repr=False)
@@ -76,6 +82,7 @@ class TaskSpec:
     _remote_markers: Any = dataclasses.field(default=None, repr=False)
     _lease_key: Any = dataclasses.field(default=None, repr=False)
     _direct: Any = dataclasses.field(default=None, repr=False)
+    _evt: Any = dataclasses.field(default=None, repr=False)
     # Submit-time compiled encoding, reused verbatim for the worker push
     # (the hot path packed every spec TWICE: submitter->head and
     # head->worker). Must be invalidated wherever a PACKED field mutates
@@ -86,7 +93,8 @@ class TaskSpec:
     _packed_bin: Any = dataclasses.field(default=None, repr=False)
 
     _SCRATCH = ("_rkey", "_demand", "_deps_pending", "_deferred_results",
-                "_remote_markers", "_packed_bin", "_lease_key", "_direct")
+                "_remote_markers", "_packed_bin", "_lease_key", "_direct",
+                "_evt")
 
     def __getstate__(self):
         """Strip scratch slots (dispatch caches, the packed-bytes

@@ -28,6 +28,7 @@ from concurrent.futures import ThreadPoolExecutor
 import cloudpickle
 
 from ray_tpu._private import worker_context
+from ray_tpu._private.config import GLOBAL_CONFIG
 from ray_tpu._private.ids import ObjectRef
 from ray_tpu._private.runtime import CoreRuntime
 from ray_tpu._private.task_spec import TaskSpec
@@ -161,8 +162,9 @@ class Worker:
         if kind == "push_task":
             from ray_tpu._private.task_spec import spec_from_body
 
-            self._dispatch_spec(spec_from_body(body),
-                                body.get("tpu_chips"))
+            spec = spec_from_body(body)
+            self._stamp_recv(spec, body)
+            self._dispatch_spec(spec, body.get("tpu_chips"))
         elif kind == "become_actor":
             # An actor conversion reprieves any pending max_calls
             # retirement (the head ignores worker_retiring from actor
@@ -215,6 +217,19 @@ class Worker:
             self._cancelled_ids.add(body["task_id"])
         return None
 
+    @staticmethod
+    def _stamp_recv(spec, body: dict) -> None:
+        """Flight recorder: adopt the phase stamps that rode the push
+        (owner submit / head dispatch / direct push) and add the arrival
+        stamp. The full timeline returns to the head inside the
+        task_finished event — no extra frames anywhere."""
+        evt = body.get("evt")
+        if evt is None and not GLOBAL_CONFIG.task_events_enabled:
+            return
+        evt = dict(evt) if evt is not None else {}
+        evt["recv"] = time.time()
+        spec._evt = evt
+
     def _dispatch_spec(self, spec, tpu_chips) -> None:
         """Route one spec into the execution machinery — shared by
         head pushes (push_task) and direct owner pushes (direct_push):
@@ -254,6 +269,7 @@ class Worker:
         from ray_tpu._private.task_spec import spec_from_body
 
         spec = spec_from_body(body)
+        self._stamp_recv(spec, body)
         limit = GLOBAL_CONFIG.direct_worker_inflight_max
         if (self._exit.is_set()
                 or getattr(self, "_recycle_pending", False)
@@ -545,6 +561,40 @@ class Worker:
         except Exception:
             pass
 
+    def _lifecycle_events(self, spec: TaskSpec, start: float, end: float,
+                          failed: bool) -> "list | None":
+        """The task_finished event payload: the classic exec span plus
+        the flight-recorder phase stamps accumulated along the task's
+        route (owner submit, head enqueue/dispatch or direct push, our
+        recv) completed with exec/seal. None when events are disabled —
+        the completion cast is then byte-identical to the pre-tracing
+        wire format."""
+        if not GLOBAL_CONFIG.task_events_enabled:
+            return None
+        phases = dict(spec._evt) if spec._evt is not None else {}
+        phases.setdefault("exec_start", start)
+        phases["exec_end"] = end
+        # Results were just routed to the owner plane (or deferred into
+        # this very cast): stamp the seal hand-off.
+        phases["seal"] = time.time()
+        ev = {
+            "task_id": spec.task_id,
+            "name": spec.name,
+            "worker_id": self.worker_id,
+            "node_id": self.node_id,
+            "pid": os.getpid(),
+            "owner_id": spec.owner_id,
+            "start": start,
+            "end": end,
+            "failed": failed,
+            "phases": phases,
+        }
+        if spec.actor_id is not None:
+            ev["actor_id"] = spec.actor_id
+        if getattr(spec, "_direct", None):
+            ev["direct"] = True
+        return [ev]
+
     async def _run_task_async_guarded(self, spec: TaskSpec) -> None:
         import time
 
@@ -576,12 +626,8 @@ class Worker:
                  "failed": failed,
                  "results": results,
                  "sealed_pending": sealed_pending,
-                 "events": [{
-                     "task_id": spec.task_id, "name": spec.name,
-                     "worker_id": self.worker_id, "node_id": self.node_id,
-                     "pid": os.getpid(), "start": start,
-                     "end": time.time(), "failed": failed,
-                 }]},
+                 "events": self._lifecycle_events(
+                     spec, start, time.time(), failed)},
             )
         except Exception:
             pass
@@ -782,18 +828,8 @@ class Worker:
                         "failed": failed,
                         "results": results,
                         "sealed_pending": sealed_pending,
-                        "events": [
-                            {
-                                "task_id": spec.task_id,
-                                "name": spec.name,
-                                "worker_id": self.worker_id,
-                                "node_id": self.node_id,
-                                "pid": os.getpid(),
-                                "start": start,
-                                "end": time.time(),
-                                "failed": failed,
-                            }
-                        ],
+                        "events": self._lifecycle_events(
+                            spec, start, time.time(), failed),
                     },
                 )
                 # Draining a backlog: completions coalesce into one

@@ -175,9 +175,19 @@ def get_metrics_report() -> dict[str, dict]:
     return agg
 
 
+def _escape_label_value(value) -> str:
+    """Prometheus exposition label-value escaping: backslash, double
+    quote, and newline must be escaped or the sample line is invalid
+    (and silently corrupts every later line of the scrape)."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def runtime_stats_text() -> str:
     """Core runtime metric exposition (reference: the C++ DEFINE_stats
-    set — tasks/actors/objects — exported through the metrics agent)."""
+    set — tasks/actors/objects — exported through the metrics agent),
+    plus the flight-recorder phase-latency histograms (queue wait /
+    dispatch / exec / result transfer)."""
     try:
         snap = global_runtime().conn.call("runtime_stats", {}, timeout=10)
     except Exception:
@@ -191,6 +201,14 @@ def runtime_stats_text() -> str:
         full = f"ray_tpu_{name}"
         lines.append(f"# TYPE {full} gauge")
         lines.append(f"{full} {value}")
+    for name, h in snap.get("histograms", {}).items():
+        full = f"ray_tpu_phase_{name}_seconds"
+        lines.append(f"# TYPE {full} histogram")
+        for b, c in zip(list(h["boundaries"]) + [float("inf")],
+                        _cumulative(h["buckets"])):
+            lines.append(f'{full}_bucket{{le="{b}"}} {c}')
+        lines.append(f"{full}_sum {h['sum']}")
+        lines.append(f"{full}_count {h['count']}")
     return "\n".join(lines) + ("\n" if lines else "")
 
 
@@ -207,7 +225,8 @@ def prometheus_text() -> str:
             # reporter label so duplicate-named samples stay distinct.
             pairs = [("reporter", v) if k == "__reporter__" else (k, v)
                      for k, v in tags]
-            label_body = ",".join(f'{k}="{v}"' for k, v in pairs)
+            label_body = ",".join(
+                f'{k}="{_escape_label_value(v)}"' for k, v in pairs)
             label = "{" + label_body + "}" if label_body else ""
             if entry["type"] == "histogram":
                 for b, c in zip(value["boundaries"] + [float("inf")],
@@ -249,3 +268,15 @@ def rpc_counters() -> dict:
                  for a, c in rt._owner_conns.items()}
     direct = rt._direct.snapshot() if rt._direct is not None else {}
     return {"head": _conn(rt.conn), "peers": peers, "direct": direct}
+
+
+def cluster_rpc_counters() -> dict:
+    """CLUSTER-wide rpc counters: every runtime's snapshot as last
+    reported to the head (workers/drivers piggyback on the amortized
+    rpc_report cast, node agents on their heartbeats). The whole-cluster
+    complement of rpc_counters() — lets the zero-head-frames property of
+    the direct plane be checked for every process, not just this one.
+    Shape: {"clients": {client_id: snapshot}, "total_head_frames": int,
+    "clock_offsets": {node_id: seconds}}."""
+    snap = global_runtime().conn.call("runtime_stats", {}, timeout=10)
+    return snap.get("rpc") or {"clients": {}, "total_head_frames": 0}

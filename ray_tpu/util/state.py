@@ -113,15 +113,49 @@ def get_actor(actor_id: str) -> "dict | None":
     return dict(rows[0]) if rows else None
 
 
+def _percentile(sorted_vals: list, q: float) -> float:
+    """Nearest-rank percentile over a pre-sorted list."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
 def summarize_tasks() -> dict:
-    """Counts by (name, state) — reference: util/state/api.py:1368."""
+    """Counts by (name, state) — reference: util/state/api.py:1368 —
+    plus per-phase latency breakdowns (p50/p95 of queue wait, dispatch,
+    exec, result transfer) derived from the flight-recorder lifecycle
+    events, clock-aligned across nodes."""
+    from ray_tpu._private.events import phase_latencies
+
     by_name: dict[str, Counter] = {}
     for t in list_tasks(limit=100000):
         by_name.setdefault(t["name"], Counter())[t["state"]] += 1
-    return {
-        name: {"state_counts": dict(states), "total": sum(states.values())}
-        for name, states in by_name.items()
-    }
+    # Phase latencies per task name from the head's event table.
+    lat_by_name: dict[str, dict[str, list]] = {}
+    data = get_timeline_data()
+    for ev in data["events"]:
+        if not isinstance(ev, dict) or "phases" not in ev \
+                or not ev.get("name"):
+            continue
+        aligned = _aligned(ev, data)
+        bucket = lat_by_name.setdefault(ev["name"], {})
+        for phase, dt in phase_latencies(aligned).items():
+            bucket.setdefault(phase, []).append(max(0.0, dt))
+    out = {}
+    for name, states in by_name.items():
+        entry = {"state_counts": dict(states),
+                 "total": sum(states.values())}
+        lats = lat_by_name.get(name)
+        if lats:
+            entry["phase_latency_s"] = {
+                phase: {"p50": _percentile(sorted(vals), 0.50),
+                        "p95": _percentile(sorted(vals), 0.95),
+                        "count": len(vals)}
+                for phase, vals in lats.items()}
+        out[name] = entry
+    return out
 
 
 def summarize_actors() -> dict:
@@ -168,31 +202,115 @@ def get_task_events(limit: int = 10000,
     return _call("get_task_events", body)["events"]
 
 
+def get_timeline_data(limit: int = 10000) -> dict:
+    """Raw flight-recorder feed: events PLUS the head's per-node clock
+    offsets and node id — everything timeline() needs to align
+    cross-node spans onto one clock."""
+    reply = _call("get_task_events", {"limit": limit})
+    return {"events": reply["events"],
+            "clock_offsets": reply.get("clock_offsets") or {},
+            "head_node_id": reply.get("head_node_id")}
+
+
+def _aligned(ev: dict, data: dict) -> dict:
+    from ray_tpu._private.events import align_phases
+
+    return align_phases(ev, data["clock_offsets"], data["head_node_id"])
+
+
 def timeline(filename: str | None = None) -> "list | str":
-    """Chrome-trace export of task profile events (reference:
+    """Chrome-trace export of the task flight recorder (reference:
     _private/profiling.py:124 `ray timeline`). Load the result in
-    chrome://tracing or Perfetto."""
-    events = get_task_events()
-    trace = []
-    node_index: dict[str, int] = {}  # Chrome traces want integer pids
-    for ev in events:
-        pid = node_index.setdefault(ev["node_id"], len(node_index))
-        trace.append(
-            {
-                "cat": "task",
-                "name": ev["name"],
-                "ph": "X",  # complete event
-                "ts": ev["start"] * 1e6,
+    chrome://tracing or Perfetto (ui.perfetto.dev).
+
+    Per task: the classic execution span (cat "task") on the executing
+    node's track, one sub-span per lifecycle segment (cat "phase":
+    submit/queue/dispatch/dequeue/exec/seal/resolve — owner- and
+    head-side segments render on their own tracks), and flow arrows
+    (cat "lifecycle") connecting submit → push/dispatch → exec → resolve
+    across pids. Chaos-plane faults appear as instant events (cat
+    "chaos") on the node that injected them; user tracing.span events
+    keep their old rendering. Cross-node timestamps are aligned onto the
+    head's clock via the heartbeat-estimated offsets."""
+    from ray_tpu._private.events import PHASE_DOMAIN, PHASE_SEGMENTS
+
+    data = get_timeline_data()
+    trace: list = []
+    track_index: dict = {}  # Chrome traces want integer pids
+
+    def _pid(label) -> int:
+        return track_index.setdefault(label or "?", len(track_index))
+
+    for ev in data["events"]:
+        if not isinstance(ev, dict):
+            continue
+        if ev.get("event") == "chaos":
+            trace.append({
+                "cat": "chaos", "ph": "i", "s": "p",
+                "name": f"fault:{ev.get('action')}:{ev.get('kind')}",
+                "ts": ev["ts"] * 1e6,
+                "pid": _pid("chaos"), "tid": int(ev.get("pid") or 0),
+                "args": {k: ev.get(k) for k in
+                         ("action", "direction", "peer", "kind",
+                          "delay_s") if ev.get(k) is not None},
+            })
+            continue
+        phases = _aligned(ev, data) if "phases" in ev else {}
+        worker_pid = _pid(ev.get("node_id"))
+        worker_tid = int(ev.get("pid") or 0)
+        name = ev.get("name")
+        args = {"task_id": ev.get("task_id"),
+                "node_id": ev.get("node_id"),
+                "failed": ev.get("failed", False)}
+        if ev.get("start") is not None and ev.get("end") is not None:
+            # The classic execution / user-span complete event (kept
+            # verbatim: existing tooling and tests key on it).
+            off = (data["clock_offsets"].get(ev.get("node_id"), 0.0)
+                   if ev.get("node_id") else 0.0)
+            trace.append({
+                "cat": "span" if ev.get("event") == "span" else "task",
+                "name": name, "ph": "X",
+                "ts": (ev["start"] - off) * 1e6,
                 "dur": (ev["end"] - ev["start"]) * 1e6,
-                "pid": pid,
-                "tid": int(ev["pid"]),
-                "args": {
-                    "task_id": ev["task_id"],
-                    "node_id": ev["node_id"],
-                    "failed": ev.get("failed", False),
-                },
-            }
-        )
+                "pid": worker_pid, "tid": worker_tid,
+                "args": {**args, **(
+                    {"parent": ev.get("parent"),
+                     **(ev.get("attributes") or {})}
+                    if ev.get("event") == "span" else {})},
+            })
+        if not phases:
+            continue
+        owner_pid = _pid(ev.get("owner_node_id") or "owner")
+        head_pid = _pid(data.get("head_node_id") or "head")
+        track_for = {"owner": (owner_pid, 0), "head": (head_pid, 0),
+                     "worker": (worker_pid, worker_tid)}
+        for a, b, label in PHASE_SEGMENTS:
+            ta, tb = phases.get(a), phases.get(b)
+            if ta is None or tb is None:
+                continue
+            pid_, tid_ = track_for[PHASE_DOMAIN.get(a, "worker")]
+            trace.append({
+                "cat": "phase", "name": label, "ph": "X",
+                "ts": ta * 1e6, "dur": max(0.0, tb - ta) * 1e6,
+                "pid": pid_, "tid": tid_,
+                "args": {**args, "from": a, "to": b},
+            })
+        # Flow arrows: submit (owner) → recv (worker) → resolve (owner)
+        # connect the per-task story across pids. A lone point would
+        # render as a dangling arrow, so fewer than two emit nothing.
+        flow_points = [(p, *track_for[PHASE_DOMAIN[p]])
+                       for p in ("submit", "recv", "resolve")
+                       if p in phases]
+        if len(flow_points) >= 2:
+            for i, (p, pid_, tid_) in enumerate(flow_points):
+                ph = "s" if i == 0 else ("f" if i == len(flow_points) - 1
+                                         else "t")
+                step = {"cat": "lifecycle", "name": "task-flow",
+                        "ph": ph, "id": ev.get("task_id"),
+                        "ts": phases[p] * 1e6, "pid": pid_, "tid": tid_}
+                if ph == "f":
+                    step["bp"] = "e"
+                trace.append(step)
     if filename is None:
         return trace
     import json

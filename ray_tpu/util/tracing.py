@@ -72,13 +72,22 @@ def span(name: str, **attributes: Any):
         from ray_tpu._private import worker_context
 
         ctx = worker_context.get_task_context()
+        # Worker/actor identity from the runtime context (a worker
+        # runtime's client id IS its worker id) — without it user spans
+        # emitted from tasks carried "worker_id": None and refused to
+        # group with their task's lifecycle spans in the timeline.
+        rt = worker_context.try_runtime()
+        worker_id = (rt.client_id if rt is not None
+                     and rt.client_type == "worker" else None)
         _emit({
             "event": "span",
             "name": name,
             "parent": parent,
             "task_id": getattr(ctx, "task_id", None),
-            "worker_id": None,
-            "node_id": getattr(ctx, "node_id", None),
+            "worker_id": worker_id,
+            "actor_id": getattr(ctx, "actor_id", None),
+            "node_id": (getattr(ctx, "node_id", None)
+                        or (rt.node_id if rt is not None else None)),
             "pid": os.getpid(),
             "start": start,
             "end": end,

@@ -195,6 +195,59 @@ def test_explicit_strategy_tasks_never_lease(cluster):
     assert len(rt._direct.lease_pools) == before
 
 
+# ------------------------------------------- event-plane frame guard
+
+
+def test_event_plane_zero_per_call_head_frames(cluster):
+    """The flight-recorder tracing plane (events enabled by DEFAULT)
+    must ride existing messages only: steady-state direct actor calls
+    still make ZERO per-call synchronous head RPCs, ZERO head
+    submissions, and ZERO dedicated event frames — yet the lifecycle
+    events (with the direct-plane push stamp) reach the head's table."""
+    from ray_tpu._private.config import GLOBAL_CONFIG
+    from ray_tpu.util import state as us
+
+    assert GLOBAL_CONFIG.task_events_enabled  # the default ships ON
+
+    @ray_tpu.remote
+    class Traced:
+        def ping(self, x=None):
+            return x
+
+    a = Traced.remote()
+    rt = global_runtime()
+    assert ray_tpu.get(a.ping.remote(1)) == 1
+    _wait(lambda: rt._direct.routes[a._actor_id].mode == "direct",
+          msg="actor route never entered direct mode")
+
+    N = 30
+    before_submit = rt.conn.sent_kinds.get("submit_actor_task", 0)
+    before_calls = rt.conn.calls_sent
+    before_push = _direct_push_count(rt)
+    # No dedicated event traffic either: "task_events" frames are the
+    # user-span side channel, never the lifecycle plane's carrier.
+    before_task_events = rt.conn.sent_kinds.get("task_events", 0)
+    for i in range(N):
+        assert ray_tpu.get(a.ping.remote(i)) == i
+    assert rt.conn.sent_kinds.get("submit_actor_task", 0) == before_submit
+    assert rt.conn.calls_sent == before_calls
+    assert rt.conn.sent_kinds.get("task_events", 0) == before_task_events
+    assert _direct_push_count(rt) - before_push == N
+
+    # ...and the instrumentation actually recorded the calls: direct
+    # lifecycle events (push stamp present) for this actor reached the
+    # head piggybacked on task_started/task_finished.
+    def _events_arrived():
+        evs = [e for e in us.get_task_events()
+               if isinstance(e, dict)
+               and e.get("actor_id") == a._actor_id
+               and "push" in (e.get("phases") or {})
+               and "exec_end" in (e.get("phases") or {})]
+        return len(evs) >= N
+    _wait(_events_arrived, msg="lifecycle events piggybacked to head")
+    ray_tpu.kill(a)
+
+
 # ------------------------------------------------------- metrics surface
 
 
