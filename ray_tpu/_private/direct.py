@@ -90,7 +90,7 @@ class _Lease:
     owner round-robins across them."""
 
     __slots__ = ("key", "addr", "worker_id", "specenc", "deadline",
-                 "calls_left", "window", "inflight")
+                 "calls_left", "window", "inflight", "cool_until")
 
     def __init__(self, key, addr, worker_id, specenc, ttl, calls, window):
         self.key = key
@@ -101,6 +101,10 @@ class _Lease:
         self.calls_left = calls
         self.window = max(1, window)
         self.inflight = 0
+        # Set on a direct_rej bounce (worker busy with head-pushed
+        # work): round-robin skips this lease until the stamp passes so
+        # a burst doesn't ping-pong every task off the same worker.
+        self.cool_until = 0.0
 
     def usable(self) -> bool:
         return self.calls_left > 0 and time.monotonic() < self.deadline
@@ -200,10 +204,12 @@ class DirectPlane:
                 return False
             self._rr += 1
             n = len(pool)
+            now = time.monotonic()
             lease = next(
                 (pool[(self._rr + i) % n] for i in range(n)
                  if pool[(self._rr + i) % n].inflight
-                 < pool[(self._rr + i) % n].window), None)
+                 < pool[(self._rr + i) % n].window
+                 and pool[(self._rr + i) % n].cool_until <= now), None)
             if lease is None:
                 self.stats["spillbacks"] += 1
                 return False               # pool busy: head path
@@ -384,7 +390,29 @@ class DirectPlane:
         elif kind == "direct_rej":
             # Worker-side back-pressure / retirement: spill to the head.
             self.stats["spillbacks"] += 1
-            self._expire_task(body.get("task_id", ""))
+            tid = body.get("task_id", "")
+            item = None
+            with self.lock:
+                rec = self.lease_tasks.pop(tid, None)
+                if rec is not None:
+                    lease = rec[4]
+                    if lease is not None:
+                        lease.inflight = max(0, lease.inflight - 1)
+                        lease.cool_until = time.monotonic() + 0.25
+                    for oid in rec[1]:
+                        self.by_oid.pop(oid, None)
+                    item = (rec[0], lease.worker_id if lease else None)
+            if item is not None:
+                # A bounced lease task re-routes NOW, off this reader
+                # thread — the watchdog's idle backoff (up to 2 s) is
+                # too slow for a task its caller may be blocked on.
+                threading.Thread(target=self._send_recover,
+                                 args=([item],), daemon=True,
+                                 name="lease-rej-recover").start()
+            else:
+                # Actor-route call: the watchdog re-routes it (and
+                # everything queued behind it) in seq order.
+                self._expire_task(tid)
 
     def on_peer_close(self, addr: tuple) -> None:
         """A direct connection died: every route/lease over it re-routes

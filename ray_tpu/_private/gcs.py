@@ -2477,6 +2477,14 @@ class Head:
         if (rec.actor_id is not None or rec.tpu_capable or rec.retiring
                 or rec.leased_to is not None or rec.conn is None):
             return
+        # Only a worker whose sole inflight task is the one that carried
+        # the request is leasable: granting on a worker mid-way through
+        # OTHER work hands the owner a "fast direct route" to the
+        # busiest worker in the pool (a quick direct push then queues
+        # behind a possibly minutes-long head task), and the lease pins
+        # that worker's allocation on top of it.
+        if len(rec.inflight) > 1:
+            return
         # Lease POOL per (owner, shape): one lease per distinct worker,
         # granted as same-shape spillover lands on fresh leasable
         # workers — the pool converges on the shape's real parallelism.
@@ -3226,18 +3234,27 @@ class Head:
                             node = self.scheduler.pick_node(demand, None)
                         if node is None:
                             # No free capacity anywhere — but the
-                            # owner's own leases may HOLD it all: its
-                            # spillover rides those workers' pipeline
-                            # windows (the lease-held allocation), or
-                            # the shape truly waits for capacity.
+                            # owner's own leases may HOLD it all: an
+                            # IDLE leased worker of this very shape
+                            # serves the owner's spillover directly
+                            # (riding the lease-held allocation).
                             lw = self._lease_matched_worker(
                                 None, key, spec.owner_id)
-                            if lw is None:
+                            if lw is not None:
+                                q.popleft()
+                                popped = True
+                                self._push_to_worker(lw, spec,
+                                                     buffered=True)
+                                continue
+                            # Or an idle lease (other shape / other
+                            # owner) pins the capacity: reclaim one and
+                            # re-pick — otherwise every queued task
+                            # starves for the lease's remaining TTL.
+                            if self._reclaim_idle_lease():
+                                node = self.scheduler.pick_node(
+                                    demand, None)
+                            if node is None:
                                 break  # unplaceable until capacity frees
-                            q.popleft()
-                            popped = True
-                            self._push_to_worker(lw, spec, buffered=True)
-                            continue
                         need_tpu = float(spec.resources.get("TPU", 0)) > 0
                         if (node.node_id, need_tpu) in no_worker:
                             break
@@ -3257,6 +3274,27 @@ class Head:
                                 # evicts idle cached-env workers).
                                 self._retire_idle_mismatch(
                                     node.node_id, need_tpu, ek)
+                            # Capacity is ARRIVING (a pool worker of
+                            # this kind is mid-boot on the node) or can
+                            # still be spawned (pool below cap — the
+                            # spawn above may have been deferred by a
+                            # warming zygote): leave the task queued
+                            # for the fresh worker instead of parking
+                            # it behind a busy one — a quick task must
+                            # not serialize behind a slow one while
+                            # real parallelism is ~100 ms away.
+                            # worker_ready / zygote.on_ready set
+                            # dispatch_event (plus the dispatch loop's
+                            # 200 ms backstop tick), so waiting here
+                            # cannot strand the queue; pipelining
+                            # remains the fallback once the pool is at
+                            # cap with every worker ready.
+                            if (self._booting_worker(node.node_id,
+                                                     need_tpu)
+                                    or self._can_spawn(node.node_id,
+                                                       need_tpu)):
+                                no_worker.add((node.node_id, need_tpu))
+                                break
                             # Pipeline: same-shape tasks ride an already-
                             # allocated worker's bounded inflight window
                             # (serial execution — no extra allocation).
@@ -3344,6 +3382,11 @@ class Head:
                         spec.resources, spec.scheduling_strategy)
                     spec._demand = demand
                 node = self.scheduler.pick_node(demand, strategy)
+                if node is None and self._reclaim_idle_lease():
+                    # Capacity may sit idle-pinned under a lease (PG
+                    # demand is bundle-reserved and unaffected, but
+                    # affinity/SPREAD tasks compete with leases).
+                    node = self.scheduler.pick_node(demand, strategy)
                 if node is None:
                     # Not a budgeted miss: feasibility varies per task
                     # here, and counting currently-infeasible entries
@@ -3472,16 +3515,57 @@ class Head:
                 and not rec.retiring
                 and rec.leased_to == owner_id
                 and rec.lease_key == key[1]
-                and len(rec.inflight) < self.PIPELINE_DEPTH
+                # IDLE leases only: parking a task on a leased worker
+                # mid-task serializes it behind work of UNKNOWN length
+                # (a quick task behind a minutes-long one) while every
+                # completion would have re-woken dispatch within
+                # milliseconds anyway — leased completions set
+                # need_dispatch, and the 200 ms backstop tick covers
+                # lease expiry, so waiting cannot deadlock: spillover
+                # places the moment any of the owner's leased workers
+                # drains.
+                and not rec.inflight
             ):
-                # Least-loaded: an IDLE leased worker must win over one
-                # mid-task, or a quick task gets parked behind a slow
-                # one while capacity sits idle.
-                if best is None or len(rec.inflight) < len(best.inflight):
-                    best = rec
-                    if not rec.inflight:
-                        break
+                best = rec
+                break
         return best
+
+    def _reclaim_idle_lease(self) -> bool:
+        """lock held. Under capacity pressure an IDLE leased worker's
+        pinned allocation is dead weight: queued tasks of every other
+        shape and owner starve behind it for the lease's remaining TTL
+        (observed: a stale 2-CPU lease plus a nested-owner lease
+        idle-pinning 3 of a node's 4 CPUs for the full 10 s TTL).
+        Revoke one — the owner falls back to the head path and re-earns
+        a lease wherever its next spillover lands (reference analogue:
+        idle leased workers are returned to the raylet on demand,
+        normal_task_submitter.cc ReturnWorker). Oldest grant (nearest
+        deadline) goes first."""
+        victim = None
+        for rec in self.workers.values():
+            if (rec.leased_to is not None and not rec.inflight
+                    and not rec.retiring and rec.acquired is not None
+                    and (victim is None
+                         or rec.lease_deadline < victim.lease_deadline)):
+                victim = rec
+        if victim is None:
+            return False
+        self._end_lease(victim, revoke=True)
+        return True
+
+    def _booting_worker(self, node_id: str, tpu_capable: bool) -> bool:
+        """lock held. A pool worker of this kind was spawned on the
+        node but has not finished two-phase registration — fresh
+        capacity is arriving, so dispatch should WAIT for it rather
+        than queue behind a busy worker's pipeline window. (A boot that
+        never completes is reaped by the ghost-worker reaper, whose
+        death handling re-sets dispatch_event.)"""
+        return any(
+            r.node_id == node_id and r.actor_id is None
+            and r.tpu_capable == tpu_capable and not r.retiring
+            and (r.conn is None or not r.ready)
+            for r in self.workers.values()
+        )
 
     def _pipeline_worker(self, node_id: str,
                          key: tuple) -> WorkerRecord | None:

@@ -127,6 +127,13 @@ class Worker:
         # Direct-call plane: tasks pushed owner→worker without a head
         # hop, counted for worker-side back-pressure (_on_direct_push).
         self._direct_inflight = 0
+        # Head-pushed normal tasks queued or running here. The head
+        # grants a lease on the very push that makes this worker busy,
+        # so the owner's lease can look idle while a head task runs —
+        # a lease push accepted then would QUEUE behind it (a 30 s head
+        # task serializing a 1 ms leased one). While this is non-zero,
+        # _on_direct_push bounces lease pushes back to the head path.
+        self._head_busy = 0
         self.runtime = CoreRuntime(
             head_addr,
             client_type="worker",
@@ -164,6 +171,9 @@ class Worker:
 
             spec = spec_from_body(body)
             self._stamp_recv(spec, body)
+            if spec.actor_id is None and not spec.actor_creation:
+                with self._drain_lock:
+                    self._head_busy += 1
             self._dispatch_spec(spec, body.get("tpu_chips"))
         elif kind == "become_actor":
             # An actor conversion reprieves any pending max_calls
@@ -274,7 +284,12 @@ class Worker:
         if (self._exit.is_set()
                 or getattr(self, "_recycle_pending", False)
                 or getattr(self, "_retiring_sent", False)
-                or self._direct_inflight >= limit):
+                or self._direct_inflight >= limit
+                # A lease task must not queue behind head-pushed work
+                # the owner cannot see (lease window accounting only
+                # covers the owner's OWN direct pushes) — bounce it so
+                # the head dispatches it on a genuinely idle worker.
+                or (spec.actor_id is None and self._head_busy > 0)):
             try:
                 conn.cast_buffered("direct_rej", {"task_id": spec.task_id})
             except Exception:
@@ -854,6 +869,9 @@ class Worker:
         if getattr(spec, "_direct", None):
             # Direct-plane inflight accounting (back-pressure window).
             self._direct_inflight = max(0, self._direct_inflight - 1)
+        elif spec.actor_id is None and not spec.actor_creation:
+            with self._drain_lock:
+                self._head_busy = max(0, self._head_busy - 1)
         mc = getattr(spec, "max_calls", 0)
         if mc:
             n = self._calls_by_func.get(spec.func_id, 0) + 1
