@@ -121,6 +121,7 @@ def main(as_json: bool = False) -> dict:
     ray_tpu.kill(actor)
     ray_tpu.shutdown()
     bench_event_overhead(results)
+    bench_forensics_overhead(results)
     if as_json:
         print(json.dumps({"microbenchmark": results}))
     return results
@@ -161,6 +162,41 @@ def bench_event_overhead(results: dict) -> None:
         ray_tpu.shutdown()
     os.environ.pop("RAY_TPU_TASK_EVENTS_ENABLED", None)
     config_mod.GLOBAL_CONFIG.task_events_enabled = True
+
+
+def bench_forensics_overhead(results: dict) -> None:
+    """Crash-forensics overhead: pipelined direct actor calls with the
+    post-mortem plane on vs off (RAY_TPU_CRASH_FORENSICS_ENABLED —
+    workers read it at boot). Arming is one-time; the steady-state cost
+    is the per-task beacon stamp (an mmap slice write), so the on/off
+    delta must be within noise — the CI guard for "forensics is
+    steady-state free"."""
+    import os
+
+    from ray_tpu._private import config as config_mod
+
+    for mode in ("on", "off"):
+        os.environ["RAY_TPU_CRASH_FORENSICS_ENABLED"] = (
+            "1" if mode == "on" else "0")
+        config_mod.GLOBAL_CONFIG.crash_forensics_enabled = (mode == "on")
+        ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024 * 1024,
+                     log_to_driver=False)
+
+        @ray_tpu.remote
+        class FxEcho:
+            def ping(self, x=None):
+                return x
+
+        actor = FxEcho.remote()
+        ray_tpu.get([actor.ping.remote() for _ in range(64)])  # warm
+        timeit(f"actor pipeline depth 32 forensics {mode}",
+               lambda: ray_tpu.get(
+                   [actor.ping.remote() for _ in range(32)]),
+               32, results=results)
+        ray_tpu.kill(actor)
+        ray_tpu.shutdown()
+    os.environ.pop("RAY_TPU_CRASH_FORENSICS_ENABLED", None)
+    config_mod.GLOBAL_CONFIG.crash_forensics_enabled = True
 
 
 if __name__ == "__main__":

@@ -183,6 +183,16 @@ class Config:
     # many heartbeats feeds the head's per-node clock-offset table used
     # to align cross-node trace spans.
     clock_sync_every_n_heartbeats: int = 5
+    # Post-mortem crash forensics (_private/forensics.py): workers arm
+    # faulthandler + excepthooks into a per-worker crash file and stamp
+    # a tiny mmap'd beacon per task; supervisors reap the real exit
+    # status, classify it, and keep a bounded crash-report table on the
+    # head. Arming is one-time at boot and the beacon write is an mmap
+    # slice per task — steady-state free (microbenchmark measures the
+    # on/off delta).
+    crash_forensics_enabled: bool = True
+    # Bounded head-side crash report table (oldest evicted past this).
+    crash_reports_max: int = 256
 
     def apply_overrides(self, overrides: dict | None = None) -> "Config":
         cfg = dataclasses.replace(self)

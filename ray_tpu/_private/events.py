@@ -288,6 +288,29 @@ class EventTable:
 
     # -- snapshots -------------------------------------------------------
 
+    def by_worker(self, worker_id: str, limit: int = 5,
+                  scan_cap: int = 2000) -> list:
+        """The last few lifecycle events of ONE worker — the crash
+        plane's flight-recorder cross-link: what the dead worker's
+        timeline looked like right up to the death. Scans from the
+        newest end and gives up after ``scan_cap`` entries: this runs
+        on the death path, which must never walk a 100k-event ring
+        under the table lock."""
+        out: list = []
+        with self._lock:
+            scanned = 0
+            for e in reversed(self._events):
+                scanned += 1
+                if scanned > scan_cap or len(out) >= limit:
+                    break
+                if isinstance(e, dict) and "phases" in e \
+                        and e.get("worker_id") == worker_id:
+                    ev = dict(e)
+                    ev["phases"] = dict(e.get("phases") or {})
+                    out.append(ev)
+        out.reverse()
+        return out
+
     def snapshot(self, limit: int = 10000, task_ids=None) -> list:
         with self._lock:
             events = list(self._events)

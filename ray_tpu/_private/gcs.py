@@ -122,7 +122,7 @@ class WorkerRecord:
         "inflight", "started_at", "tpu_chips", "acquired", "ready", "pg_alloc",
         "tpu_capable", "cur_rkey", "zygote", "env_key", "blocked",
         "released_alloc", "retiring", "leased_to", "lease_deadline",
-        "lease_key",
+        "lease_key", "expected_exit",
     )
 
     def __init__(self, worker_id: str, node_id: str, proc,
@@ -188,6 +188,12 @@ class WorkerRecord:
         self.leased_to: str | None = None
         self.lease_deadline = 0.0
         self.lease_key = None
+        # Crash forensics: the supervisor's recorded kill intent
+        # ("memory_monitor" | "intended_kill" | "retired" | "shutdown" |
+        # "node_death" | "spawn_failure", detail), set BEFORE the head
+        # kills/releases this worker so its own kills never classify as
+        # anonymous SIGKILLs (reference: WorkerExitType INTENDED_*).
+        self.expected_exit: tuple | None = None
 
 
 class ActorRecord:
@@ -344,6 +350,17 @@ class Head:
         from ray_tpu._private.events import EventTable
 
         self.task_events = EventTable(config.task_events_max_buffer)
+        # Crash forensics plane (reference: the GCS worker-death table
+        # with WorkerExitType + exit_detail): bounded table of
+        # classified crash reports keyed by worker_id (node deaths under
+        # "node:<id>"), deaths-by-reason counters for the
+        # ray_tpu_worker_deaths_total{reason=...} exposition, and the
+        # lazily-built cgroup oom_kill watcher for kernel-OOM
+        # attribution of local worker SIGKILLs.
+        self.crash_reports: dict[str, dict] = {}
+        self._crash_fifo: deque[str] = deque()
+        self.death_counts: dict[str, int] = {}
+        self._oom_watch = None
         # Per-node clock offsets (node_clock - head_clock), estimated
         # NTP-style over the agent heartbeat loop; timeline() aligns
         # cross-node spans with them.
@@ -853,6 +870,7 @@ class Head:
         that lived only there reconstruct through lineage or error-seal
         with provenance so waiters raise instead of hanging."""
         with self.lock:
+            last_seen = self._agent_last_seen.get(node_id)
             self.node_agents.pop(node_id, None)
             self._agent_last_seen.pop(node_id, None)
             self.node_transfer_addrs.pop(node_id, None)
@@ -860,6 +878,28 @@ class Head:
             self.clock_offsets.pop(node_id, None)
             self.rpc_reports.pop(f"agent:{node_id}", None)
             self.scheduler.mark_dead(node_id)
+            doomed = [r for r in self.workers.values()
+                      if r.node_id == node_id]
+            # Node-death forensics: the node gets the same post-mortem
+            # treatment as a worker — a classified report ("presumed
+            # dead: heartbeat age, tasks in flight") in the crash table,
+            # carried into every error this death seals.
+            age = (time.time() - last_seen) if last_seen else None
+            node_detail = (
+                "node presumed dead: last heartbeat "
+                + (f"{age:.1f}s ago" if age is not None
+                   else "never received")
+                + f", {sum(len(r.inflight) for r in doomed)} task(s) "
+                  f"in flight on it")
+            self._record_crash({
+                "worker_id": f"node:{node_id}", "node_id": node_id,
+                "pid": None, "exit_type": "node_death",
+                "exit_detail": node_detail,
+                "workers_lost": [r.worker_id for r in doomed],
+                "source": "head", "ts": time.time()}, count=False)
+            for rec in doomed:
+                if rec.expected_exit is None:
+                    rec.expected_exit = ("node_death", node_detail)
             # P2P payloads hosted by the dead node are gone; mark the
             # entries lost so fetches trigger lineage reconstruction
             # instead of hanging (reference: object_recovery_manager.h).
@@ -890,12 +930,11 @@ class Head:
                         e.object_id,
                         f"ObjectLostError: object {e.object_id} was "
                         f"lost with node {node_id} and has no lineage "
-                        f"to reconstruct from",
+                        f"to reconstruct from ({node_detail})",
                         "object_lost",
                         provenance={"object_id": e.object_id,
                                     "node_id": node_id,
                                     "owner_id": e.owner_id})
-            doomed = [r for r in self.workers.values() if r.node_id == node_id]
         for rec in doomed:
             # The agent died but its worker processes may be orphaned
             # alive and still connected: tell them to exit so ghosts
@@ -1001,6 +1040,12 @@ class Head:
             print(f"ray_tpu head: worker {rec.worker_id} never registered "
                   f"within {self.config.worker_register_timeout_s:.0f}s — "
                   f"reaping", file=sys.stderr)
+            if rec.expected_exit is None:
+                rec.expected_exit = (
+                    "spawn_failure",
+                    f"worker never registered within "
+                    f"{self.config.worker_register_timeout_s:.0f}s "
+                    f"(lost spawn cast or interpreter crash at boot)")
             self._handle_worker_death(rec)
 
     # --- registration ---
@@ -2172,9 +2217,11 @@ class Head:
                     self._release_actor_arg_pins(actor)
                     self._drain_actor_queue(actor)
                     if actor.spec.name:
-                        self.named_actors.pop(
-                            (actor.spec.namespace, actor.spec.name), None
-                        )
+                        # Guarded like the death path: never unregister
+                        # a successor that re-took the name.
+                        key = (actor.spec.namespace, actor.spec.name)
+                        if self.named_actors.get(key) == rec.actor_id:
+                            self.named_actors.pop(key, None)
                     # Retire the dedicated worker and return its
                     # reservation — otherwise failed creations leak
                     # CPUs/chips and a zombie process each.
@@ -2622,7 +2669,19 @@ class Head:
                 self._wal_append(("actor_max_restarts",
                                   body["actor_id"], 0))
                 self._mark_dirty()
+                # The actor is doomed NOW: unregister its name so a
+                # concurrent get_actor cannot hand out a handle that
+                # dies mid-first-call (the kill → worker-death window
+                # is real — the death path reaps the exit status and
+                # builds the crash report before the DEAD transition).
+                if actor.spec.name:
+                    key = (actor.spec.namespace, actor.spec.name)
+                    if self.named_actors.get(key) == body["actor_id"]:
+                        self.named_actors.pop(key, None)
             rec = self.workers.get(actor.worker_id) if actor.worker_id else None
+            if rec is not None and rec.expected_exit is None:
+                rec.expected_exit = ("intended_kill",
+                                     "ray_tpu.kill(actor) requested")
         if rec is not None and rec.proc is not None:
             rec.proc.kill()
         elif rec is not None and rec.zygote and rec.pid:
@@ -3081,6 +3140,10 @@ class Head:
         if rec.inflight or self._worker_pending_seals.get(worker_id):
             return
         if rec.conn is not None:
+            if rec.expected_exit is None:
+                rec.expected_exit = (
+                    "retired", "max_calls budget reached; clean "
+                    "retirement after owner-confirmed results")
             try:
                 rec.conn.cast("exit_worker", {})
             except rpc.ConnectionLost:
@@ -3116,6 +3179,43 @@ class Head:
     def _h_get_metrics(self, body, conn):
         with self.lock:
             return {"metrics": dict(self.metrics)}
+
+    def _h_worker_death(self, body, conn):
+        """A node agent's reaper classified one of its workers' exits
+        (real wait status + crash file + beacon + log tail). Merge it
+        into the crash table: the head's conn-close path usually ran
+        first with only intent/connection knowledge, and this report
+        carries the evidence (see _record_crash's rank merge)."""
+        report = body.get("report") or {}
+        wid = report.get("worker_id") or body.get("worker_id")
+        if not wid:
+            return None
+        report.setdefault("worker_id", wid)
+        with self.lock:
+            self._record_crash(report)
+        return None
+
+    def _h_list_crash_reports(self, body, conn):
+        """Crash-report table reads (util.state.list_crash_reports /
+        get_crash_report, `ray-tpu crashes`, dashboard). A worker_id
+        point lookup returns the FULL report; the listing ships bounded
+        summary rows (no stacks/log tails)."""
+        wid = body.get("worker_id")
+        with self.lock:
+            if wid is not None:
+                r = self.crash_reports.get(wid)
+                return {"reports": [dict(r)] if r else []}
+            rows = [self.crash_reports[w] for w in self._crash_fifo
+                    if w in self.crash_reports]
+            limit = int(body.get("limit", 100))
+            summary_keys = ("worker_id", "node_id", "pid", "actor_id",
+                            "exit_type", "exit_detail", "exit_code",
+                            "term_signal", "signal_name", "last_task",
+                            "source", "ts")
+            return {"reports": [
+                {k: r.get(k) for k in summary_keys if r.get(k)
+                 is not None}
+                for r in rows[-limit:]]}
 
     def _h_get_task_events(self, body, conn):
         from ray_tpu._private import faultinject
@@ -3874,15 +3974,189 @@ class Head:
             rec.tpu_chips = []
 
     # ------------------------------------------------------------------
-    # failure handling
+    # failure handling + crash forensics
+
+    def _mark_expected_exit(self, worker_id: str, intent: str,
+                            detail: str) -> None:
+        """Record the head's kill intent BEFORE the kill lands, so the
+        death classifies as what it is (memory-monitor victim, ray
+        kill, retirement) instead of an anonymous SIGKILL/exit."""
+        with self.lock:
+            rec = self.workers.get(worker_id)
+            if rec is not None and rec.expected_exit is None:
+                rec.expected_exit = (intent, detail)
+
+    def _oom_delta(self) -> int:
+        """cgroup oom_kill events since the last check on THIS node."""
+        from ray_tpu._private import forensics
+
+        if self._oom_watch is None:
+            cg = getattr(self, "_cgroup", None)
+            extra = ()
+            if cg is not None and cg.enabled and cg.workers_path:
+                extra = (os.path.join(cg.workers_path, "memory.events"),)
+            self._oom_watch = forensics.OomWatch(extra)
+            return 0  # first call establishes the baseline
+        return self._oom_watch.delta()
+
+    def _reap_exit_status(self, rec: WorkerRecord, wait_s: float = 0.5
+                          ) -> "tuple[int | None, int | None]":
+        """(exit_code, term_signal) of a LOCAL worker. Bounded wait: the
+        conn close usually races the process teardown by mere
+        milliseconds, and this runs on the dead conn's reader thread."""
+        if rec.proc is not None:
+            deadline = time.time() + wait_s
+            while True:
+                rc = rec.proc.poll()
+                if rc is not None:
+                    return (rc, None) if rc >= 0 else (None, -rc)
+                if time.time() >= deadline:
+                    return None, None
+                time.sleep(0.02)
+        if rec.zygote and rec.pid:
+            zy = getattr(self, "_zygote_client", None)
+            if zy is not None:
+                from ray_tpu._private.forensics import split_status
+
+                return split_status(zy.exit_status(rec.pid, wait_s=wait_s))
+        return None, None
+
+    def _build_crash_report(self, rec: WorkerRecord) -> dict:
+        """Classify one worker death with everything the HEAD can see
+        synchronously: its kill intent, the local wait status + crash
+        file + beacon + log tail (head-spawned workers), and the dead
+        worker's last flight-recorder events. Remote workers get a thin
+        report here; the node agent's reaper ships the evidence-rich
+        one asynchronously (worker_death) and _record_crash upgrades."""
+        from ray_tpu._private import forensics
+
+        local = rec.proc is not None or rec.zygote
+        exit_code = term_signal = None
+        if local and (rec.expected_exit is None
+                      or rec.expected_exit[0] != "node_death"):
+            exit_code, term_signal = self._reap_exit_status(rec)
+        logs = os.path.join(self.session_dir, "logs")
+        report = forensics.collect_report(
+            rec.worker_id, rec.node_id, rec.pid,
+            exit_code=exit_code, term_signal=term_signal,
+            crash_dir=logs if local else None,
+            log_path=os.path.join(logs, f"{rec.worker_id}.log")
+            if local else None,
+            expected=rec.expected_exit,
+            oom_killed=(term_signal == 9 and local
+                        and self._oom_delta() > 0),
+            source="head")
+        if rec.actor_id:
+            report["actor_id"] = rec.actor_id
+        with self.lock:
+            infl = [(s.task_id, s.name) for s in rec.inflight.values()]
+        if infl:
+            report["last_task"] = {"task_id": infl[-1][0],
+                                   "name": infl[-1][1]}
+        # Cross-link the flight recorder: what the worker's timeline
+        # looked like right up to the death.
+        report["events"] = self.task_events.by_worker(rec.worker_id)
+        return report
+
+    def _record_crash(self, report: dict, count: bool = True) -> dict:
+        """lock held. Insert or merge one crash report into the bounded
+        table; returns the stored record. Merging upgrades the stored
+        reason only with a MORE specific one (forensics.REASON_RANK):
+        supervisor intents stick, evidence beats guesswork, and whoever
+        arrives second (head conn-close path vs agent reaper) fills in
+        the fields the other could not see."""
+        from ray_tpu._private.forensics import REASON_RANK
+
+        wid = report["worker_id"]
+        cur = self.crash_reports.get(wid)
+        if cur is None:
+            self.crash_reports[wid] = report
+            self._crash_fifo.append(wid)
+            while len(self._crash_fifo) > self.config.crash_reports_max:
+                self.crash_reports.pop(self._crash_fifo.popleft(), None)
+            if count:
+                r = report["exit_type"]
+                self.death_counts[r] = self.death_counts.get(r, 0) + 1
+            # Death instant on the Perfetto timeline.
+            self.task_events.append({
+                "event": "worker_death", "worker_id": wid,
+                "node_id": report.get("node_id"),
+                "reason": report["exit_type"],
+                "detail": report.get("exit_detail"),
+                "pid": report.get("pid"),
+                "ts": report.get("ts") or time.time()})
+            return report
+        for k in ("exit_code", "term_signal", "signal_name", "stack",
+                  "log_tail", "beacon", "last_task", "actor_id", "pid",
+                  "events"):
+            v = report.get(k)
+            if v not in (None, [], {}, "") and not cur.get(k):
+                cur[k] = v
+        new_r, old_r = report["exit_type"], cur["exit_type"]
+        if REASON_RANK.get(new_r, 0) > REASON_RANK.get(old_r, 0):
+            cur["exit_type"] = new_r
+            cur["exit_detail"] = report.get("exit_detail") or \
+                cur.get("exit_detail")
+            if count:
+                self.death_counts[old_r] = max(
+                    0, self.death_counts.get(old_r, 1) - 1)
+                self.death_counts[new_r] = \
+                    self.death_counts.get(new_r, 0) + 1
+        return cur
+
+    @staticmethod
+    def _death_blurb(report: "dict | None", stack_lines: int = 8) -> str:
+        """The classified-death suffix user-facing errors carry: reason,
+        last task provenance, node, and a bounded stack excerpt."""
+        if not report:
+            return "reason: unknown"
+        blurb = f"reason: {report.get('exit_type', 'unknown')}"
+        detail = report.get("exit_detail")
+        if detail:
+            blurb += f" ({detail})"
+        lt = report.get("last_task")
+        if lt:
+            blurb += f"; last task {lt.get('name')} [{lt.get('task_id')}]"
+        if report.get("node_id"):
+            blurb += f"; node {report['node_id']}"
+        stack = report.get("stack") or []
+        if stack:
+            excerpt = "\n    ".join(stack[:stack_lines])
+            blurb += f"\n  post-mortem stack excerpt:\n    {excerpt}"
+        return blurb
 
     def _handle_worker_death(self, rec: WorkerRecord) -> None:
         """Worker connection dropped or process died.
 
         Reference analogues: task retry on worker crash
         (core_worker/task_manager.h:216 max_retries), actor restart
-        (gcs/gcs_server/gcs_actor_manager.h:96 max_restarts)."""
+        (gcs/gcs_server/gcs_actor_manager.h:96 max_restarts); death
+        classification + exit_detail propagation mirrors the reference's
+        WorkerExitType plumbing through the GCS death path."""
+        # Forensics first (no lock: bounded file IO + status reap) so
+        # every error sealed below carries the classified reason. A
+        # shutting-down head skips the evidence collection: every
+        # worker dies at once there and nobody will read the reports —
+        # N× (status wait + file reads) on the dying conns' reader
+        # threads is pure teardown drag.
+        try:
+            if self._shutdown:
+                crash = {"worker_id": rec.worker_id,
+                         "node_id": rec.node_id, "pid": rec.pid,
+                         "exit_type": "shutdown",
+                         "exit_detail": "cluster shutdown",
+                         "source": "head", "ts": time.time()}
+            else:
+                crash = self._build_crash_report(rec)
+        except Exception:
+            traceback.print_exc()
+            crash = {"worker_id": rec.worker_id, "node_id": rec.node_id,
+                     "pid": rec.pid, "exit_type": "unknown",
+                     "exit_detail": "forensics collection failed",
+                     "ts": time.time()}
         with self.lock:
+            crash = self._record_crash(crash)
+            blurb = self._death_blurb(crash)
             self.workers.pop(rec.worker_id, None)
             getattr(self, "_pending_creation_push", {}).pop(
                 rec.worker_id, None)
@@ -3921,7 +4195,8 @@ class Head:
                     self._seal_error(
                         oid,
                         f"WorkerCrashedError: worker {rec.worker_id} "
-                        "died before its result reached the owner",
+                        f"died before its result reached the owner "
+                        f"[{blurb}]",
                         "worker_crashed")
             inflight = list(rec.inflight.values())
             rec.inflight = {}
@@ -3941,7 +4216,8 @@ class Head:
                         self._fail_task(
                             spec,
                             f"WorkerCrashedError: worker {rec.worker_id} died while "
-                            f"running {spec.name} (after {spec.retries_used} retries)",
+                            f"running {spec.name} (after {spec.retries_used} retries) "
+                            f"[{blurb}]",
                             kind="worker_crashed",
                         )
         self.dispatch_event.set()
@@ -3951,6 +4227,7 @@ class Head:
         actor = self.actors.get(rec.actor_id)
         if actor is None or actor.state == "DEAD":
             return
+        blurb = self._death_blurb(self.crash_reports.get(rec.worker_id))
         # Direct-plane revoke: every owner holding a direct route to
         # this worker must stop pushing NOW — their in-flight direct
         # calls re-route through direct_recover / the requeue below
@@ -4009,7 +4286,8 @@ class Head:
             # In-flight calls die with the actor.
             self._fail_task(
                 spec,
-                f"ActorDiedError: actor {rec.actor_id} died while running {spec.name}",
+                f"ActorDiedError: actor {rec.actor_id} died while running "
+                f"{spec.name} [{blurb}]",
                 kind="actor_died",
             )
         if retried:
@@ -4027,17 +4305,26 @@ class Head:
             # queued (not yet pushed) calls survive the restart
         else:
             actor.state = "DEAD"
-            actor.death_cause = "worker process died"
+            # Structured death context (not a bare string): subsequent
+            # method calls raise ActorDiedError carrying the classified
+            # reason + last-task provenance + stack excerpt.
+            actor.death_cause = f"worker process died [{blurb}]"
             self._release_actor_arg_pins(actor)
             if creation_spec is not None:
                 self._seal_error(
                     rec.actor_id + ":creation",
-                    "ActorDiedError: actor creation worker died",
+                    f"ActorDiedError: actor creation worker died [{blurb}]",
                     kind="actor_died",
                 )
             self._drain_actor_queue(actor)
             if actor.spec.name:
-                self.named_actors.pop((actor.spec.namespace, actor.spec.name), None)
+                # Guarded: kill_actor already freed the name, and a NEW
+                # same-named actor may have registered in the window
+                # before this death processed — an unconditional pop
+                # would silently unregister the successor.
+                key = (actor.spec.namespace, actor.spec.name)
+                if self.named_actors.get(key) == rec.actor_id:
+                    self.named_actors.pop(key, None)
             self._wal_append(("actor_dead", rec.actor_id))
             self._mark_dirty()
 
@@ -4066,6 +4353,9 @@ class Head:
                 # Phase-latency histograms (queue wait / dispatch / exec
                 # / result transfer) from the flight-recorder plane.
                 "histograms": self.task_events.hist_snapshot(),
+                # Crash-forensics plane: classified worker deaths for
+                # the ray_tpu_worker_deaths_total{reason=...} counters.
+                "worker_deaths": dict(self.death_counts),
                 # Cluster-wide per-process rpc counters: every runtime's
                 # snapshot (amortized rpc_report casts + agent
                 # heartbeats), so the zero-head-frames property is
@@ -4176,6 +4466,9 @@ class Head:
             self.memory_monitor.stop()
         with self.lock:
             workers = list(self.workers.values())
+            for rec in workers:
+                if rec.expected_exit is None:
+                    rec.expected_exit = ("shutdown", "cluster shutdown")
         for rec in workers:
             try:
                 if rec.conn:

@@ -107,6 +107,15 @@ class MemoryMonitor:
             return False
         self._last_kill = now
         self.num_kills += 1
+        # Crash-forensics intent: this SIGKILL must classify as a
+        # memory-monitor kill, not an anonymous external kill.
+        if victim.expected_exit is None:
+            victim.expected_exit = (
+                "memory_monitor",
+                f"killed by the memory monitor's OOM policy on node "
+                f"{node_id} (host memory {used}/{total} bytes, "
+                f"threshold {self._threshold:.2f}); running: "
+                f"{', '.join(task_names) or '<idle>'}")
         self._head.metrics["memory_monitor_kills"] = self.num_kills
         self._head.task_events.append({
             "event": "oom_kill",

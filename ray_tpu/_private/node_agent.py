@@ -146,6 +146,13 @@ class NodeAgent:
         )
         self.node_id = reply["node_id"]
         self.session_dir = reply["session_dir"]
+        # Per-node worker log + crash-forensics dir: workers arm their
+        # crash file/beacon here (RAY_TPU_CRASH_DIR at spawn) and the
+        # reaper reads the evidence post-mortem.
+        self.log_dir = os.path.join(
+            os.environ.get("TMPDIR", "/tmp"), "ray_tpu_agent",
+            self.node_id, "logs")
+        os.makedirs(self.log_dir, exist_ok=True)
         # Subscribe to the resource-view sync stream: triggers an
         # immediate full snapshot from the head; deltas stream in as
         # pubsub casts handled in _handle.
@@ -168,6 +175,65 @@ class NodeAgent:
         # stays technically open (partition, injected drop).
         threading.Thread(target=self._heartbeat_loop, daemon=True,
                          name="agent-heartbeat").start()
+        # Crash forensics: reap real exit statuses of this node's
+        # workers, classify them (forensics.py), and ship a bounded
+        # crash report to the head with the worker_death cast
+        # (reference: the raylet reporting WorkerExitType + exit_detail
+        # through the GCS death path).
+        threading.Thread(target=self._reap_loop, daemon=True,
+                         name="agent-reaper").start()
+
+    def _reap_loop(self) -> None:
+        from ray_tpu._private import forensics
+        from ray_tpu._private.cgroup import CgroupSetup
+
+        cg = CgroupSetup.get_or_create(self, self.node_id)
+        oom = forensics.OomWatch(
+            (os.path.join(cg.workers_path, "memory.events"),)
+            if cg.enabled and cg.workers_path else ())
+        while not self._exit.wait(0.2):
+            dead = [(wid, proc) for wid, proc in list(self.procs.items())
+                    if proc.poll() is not None]
+            for wid, proc in dead:
+                if self.procs.get(wid) is proc:
+                    self.procs.pop(wid, None)
+                try:
+                    self._report_worker_death(wid, proc, oom)
+                except Exception:
+                    pass
+                try:
+                    cg.remove_worker(proc.pid)
+                except Exception:
+                    pass
+
+    def _report_worker_death(self, worker_id: str, proc, oom) -> None:
+        from ray_tpu._private import forensics
+
+        exit_code = term_signal = None
+        if isinstance(proc, _ZygotePid):
+            # Forked from the node zygote: the zygote is the OS parent
+            # and recorded the waitpid status in its exit file.
+            zy = getattr(self, "_zygote", None)
+            if zy is not None:
+                status = zy.exit_status(proc.pid, wait_s=0.5)
+                exit_code, term_signal = forensics.split_status(status)
+        else:
+            rc = proc.returncode
+            if rc is not None:
+                exit_code, term_signal = (rc, None) if rc >= 0 else \
+                    (None, -rc)
+        report = forensics.collect_report(
+            worker_id, self.node_id, proc.pid,
+            exit_code=exit_code, term_signal=term_signal,
+            crash_dir=self.log_dir,
+            log_path=os.path.join(self.log_dir, f"{worker_id}.log"),
+            oom_killed=(term_signal == 9 and oom.delta() > 0),
+            source="agent")
+        try:
+            self.conn.cast("worker_death",
+                           {"worker_id": worker_id, "report": report})
+        except Exception:
+            pass  # head unreachable: its own conn-close path classifies
 
     def _heartbeat_loop(self) -> None:
         import time as _time
@@ -484,10 +550,10 @@ class NodeAgent:
         env["RAY_TPU_AGENT_STORE"] = (
             f"{self.store_name}:{self.store_capacity}:"
             f"127.0.0.1:{self.transfer_server.address[1]}")
-        log_dir = os.path.join(
-            os.environ.get("TMPDIR", "/tmp"), "ray_tpu_agent", self.node_id, "logs"
-        )
-        os.makedirs(log_dir, exist_ok=True)
+        # Crash file + beacon land next to the worker log (forensics.arm
+        # in the worker; the reaper reads them post-mortem).
+        env["RAY_TPU_CRASH_DIR"] = self.log_dir
+        log_dir = self.log_dir
         proc = None
         if not body.get("tpu_capable"):
             # Fork from this node's zygote (reference: warm raylet

@@ -83,6 +83,10 @@ class TaskSpec:
     _lease_key: Any = dataclasses.field(default=None, repr=False)
     _direct: Any = dataclasses.field(default=None, repr=False)
     _evt: Any = dataclasses.field(default=None, repr=False)
+    #   _cpu_time       — worker-side: executor-thread CPU seconds of
+    #                     the exec span, stamped onto the lifecycle
+    #                     event (wall-vs-CPU skew in summarize_tasks)
+    _cpu_time: Any = dataclasses.field(default=None, repr=False)
     # Submit-time compiled encoding, reused verbatim for the worker push
     # (the hot path packed every spec TWICE: submitter->head and
     # head->worker). Must be invalidated wherever a PACKED field mutates
@@ -94,7 +98,7 @@ class TaskSpec:
 
     _SCRATCH = ("_rkey", "_demand", "_deps_pending", "_deferred_results",
                 "_remote_markers", "_packed_bin", "_lease_key", "_direct",
-                "_evt")
+                "_evt", "_cpu_time")
 
     def __getstate__(self):
         """Strip scratch slots (dispatch caches, the packed-bytes

@@ -27,7 +27,7 @@ from concurrent.futures import ThreadPoolExecutor
 
 import cloudpickle
 
-from ray_tpu._private import worker_context
+from ray_tpu._private import forensics, worker_context
 from ray_tpu._private.config import GLOBAL_CONFIG
 from ray_tpu._private.ids import ObjectRef
 from ray_tpu._private.runtime import CoreRuntime
@@ -608,6 +608,11 @@ class Worker:
             ev["actor_id"] = spec.actor_id
         if getattr(spec, "_direct", None):
             ev["direct"] = True
+        # Executor-thread CPU seconds for the exec span: wall >> cpu
+        # reads as GIL starvation or blocking IO in summarize_tasks().
+        cpu = getattr(spec, "_cpu_time", None)
+        if cpu is not None:
+            ev["cpu_time"] = cpu
         return [ev]
 
     async def _run_task_async_guarded(self, spec: TaskSpec) -> None:
@@ -617,6 +622,10 @@ class Worker:
         failed = False
         spec._deferred_results = []
         spec._remote_markers = []
+        # Interleaved coroutines share one beacon: last writer wins,
+        # which is exactly the "what was it doing at the instant of
+        # death" question the beacon answers.
+        forensics.beacon_update(spec.task_id, spec.name, "exec")
         sem = self.async_exec.semaphore(self._task_group(spec))
         async with sem:
             try:
@@ -632,6 +641,7 @@ class Worker:
             except Exception:
                 traceback.print_exc()
                 failed = True
+        forensics.beacon_update(phase="idle")
         self._cancelled_ids.discard(spec.task_id)
         try:
             results, sealed_pending = self._route_results(spec)
@@ -718,9 +728,6 @@ class Worker:
 
     # ------------------------------------------------------------------
 
-    _cpu_acc = 0.0
-    _cpu_n = 0
-
     def _on_will_block(self):
         """Called by the runtime just before a blocking get/wait from a
         task-executing thread; returns the unblock callback. Two escape
@@ -770,29 +777,22 @@ class Worker:
     def _drain_tasks(self) -> None:
         """Runs queued normal tasks until the deque empties (then the
         next push schedules a fresh drainer) or until this thread is
-        retired by a nested-get hand-off (see _on_will_block)."""
+        retired by a nested-get hand-off (see _on_will_block).
+
+        Per-task CPU time is stamped into the lifecycle event plane
+        (``cpu_time`` on the task_finished event, _run_task_guarded) —
+        wall-vs-CPU skew shows up in summarize_tasks() instead of the
+        old RAY_TPU_WORKER_TASK_TIMING stderr prints."""
         self._drainer_tls.active = True
         self._drainer_tls.retired = False
-        timing = os.environ.get("RAY_TPU_WORKER_TASK_TIMING")
         while True:
             with self._drain_lock:
                 if not self._task_q:
                     if not self._drainer_tls.retired:
                         self._drain_scheduled = False
-                    if timing and Worker._cpu_n and Worker._cpu_n % 2000 == 0:
-                        print(f"[task-cpu] {os.getpid()} "
-                              f"n={Worker._cpu_n} "
-                              f"avg={Worker._cpu_acc / Worker._cpu_n * 1e6:.1f}us",
-                              file=sys.stderr, flush=True)
                     return
                 spec, chips = self._task_q.popleft()
-            if timing:
-                t0 = time.thread_time()
-                self._run_task_guarded(spec, chips)
-                Worker._cpu_acc += time.thread_time() - t0
-                Worker._cpu_n += 1
-            else:
-                self._run_task_guarded(spec, chips)
+            self._run_task_guarded(spec, chips)
             if self._drainer_tls.retired:
                 # A successor drainer owns the queue now.
                 return
@@ -802,6 +802,11 @@ class Worker:
 
         failed = False
         start = time.time()
+        # Wall-vs-CPU skew stamp (GIL-starved / IO-blocked tasks): two
+        # thread_time() reads per task, carried on the lifecycle event.
+        cpu0 = time.thread_time() if GLOBAL_CONFIG.task_events_enabled \
+            else None
+        forensics.beacon_update(spec.task_id, spec.name, "exec")
         spec._deferred_results = []
         spec._remote_markers = []
         try:
@@ -818,6 +823,9 @@ class Worker:
             traceback.print_exc()
             failed = True
         finally:
+            if cpu0 is not None:
+                spec._cpu_time = time.thread_time() - cpu0
+            forensics.beacon_update(phase="idle")
             # A cancel that raced an already-running task left its id in
             # the set (running tasks are not interrupted); clear it so
             # the set stays bounded by the queue depth.
@@ -1094,6 +1102,12 @@ def main() -> None:
     import signal
 
     faulthandler.register(signal.SIGUSR1, all_threads=True)
+    # Crash forensics black box (forensics.py): faulthandler armed into
+    # a per-worker crash file (fatal signals dump all-thread stacks),
+    # sys/threading excepthooks appended there, and the mmap'd beacon
+    # the agent/head read post-mortem — even after SIGKILL.
+    if GLOBAL_CONFIG.crash_forensics_enabled:
+        forensics.arm()
     # Flood workloads allocate millions of small objects; default gen0
     # thresholds make cyclic GC a measurable tax (reference analogue:
     # the reference's workers also tune GC). Collection still happens,

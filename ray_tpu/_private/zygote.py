@@ -29,8 +29,36 @@ import threading
 
 
 def main() -> None:
-    # Auto-reap forked workers (the zygote is their parent).
-    signal.signal(signal.SIGCHLD, signal.SIG_IGN)
+    # Reap forked workers (the zygote is their parent) AND preserve
+    # their exit statuses for the crash-forensics plane: the head/agent
+    # cannot waitpid a zygote child, so the real wait status — the
+    # ground truth for "SIGSEGV vs OOM-kill vs clean exit"
+    # classification — would be discarded with a plain SIG_IGN. Exits
+    # append to a JSONL file the supervisor's classifier reads
+    # (_private/forensics / ZygoteClient.exit_status). Python signal
+    # handlers run at bytecode boundaries, so the file append is safe.
+    exit_file = os.environ.get("RAY_TPU_ZYGOTE_EXIT_FILE")
+    if exit_file:
+        import time as _time
+
+        def _reap(signum, frame):
+            while True:
+                try:
+                    pid, status = os.waitpid(-1, os.WNOHANG)
+                except ChildProcessError:
+                    return
+                if pid == 0:
+                    return
+                try:
+                    with open(exit_file, "a") as f:
+                        f.write(json.dumps({"pid": pid, "status": status,
+                                            "ts": _time.time()}) + "\n")
+                except OSError:
+                    pass
+
+        signal.signal(signal.SIGCHLD, _reap)
+    else:
+        signal.signal(signal.SIGCHLD, signal.SIG_IGN)
     # The heavy import, paid once. MUST stay single-threaded up to the
     # fork loop: forking a threaded process leaves dead locks behind.
     from ray_tpu._private import worker as worker_mod
@@ -99,6 +127,12 @@ class ZygoteClient:
     def __init__(self, base_env: dict, log_dir: str):
         self._base_env = dict(base_env)
         self._log_dir = log_dir
+        # Child exit statuses land here (see main()'s SIGCHLD handler);
+        # exit_status() is the forensics plane's lookup. RAY_TPU_ prefix
+        # so agent-side zygote forks forward it to grandchildren too.
+        self.exit_file = os.path.join(log_dir, "zygote_exits.jsonl")
+        self._base_env.setdefault("RAY_TPU_ZYGOTE_EXIT_FILE",
+                                  self.exit_file)
         self._proc: subprocess.Popen | None = None
         # _lock guards the request channel + published state and is only
         # ever held for FAST operations (state flips, one fork
@@ -262,6 +296,32 @@ class ZygoteClient:
         if rewarm:
             self.start_async()
         return pid
+
+    def exit_status(self, pid: int, wait_s: float = 0.0) -> "int | None":
+        """The raw waitpid status of a zygote-forked worker, or None if
+        its exit was never recorded (zygote predates the exit file, or
+        the child is still alive). ``wait_s`` bounds a short poll: the
+        SIGCHLD append races the supervisor noticing the death by a few
+        milliseconds."""
+        import time
+
+        deadline = time.monotonic() + max(0.0, wait_s)
+        while True:
+            status = None
+            try:
+                with open(self.exit_file) as f:
+                    for line in f:
+                        try:
+                            rec = json.loads(line)
+                        except ValueError:
+                            continue
+                        if rec.get("pid") == pid:
+                            status = rec.get("status")
+            except OSError:
+                pass
+            if status is not None or time.monotonic() >= deadline:
+                return status
+            time.sleep(0.05)
 
     def stop(self) -> None:
         with self._lock:

@@ -8,6 +8,7 @@ frontend is out of scope). JSON API over aiohttp in a dedicated actor:
     GET /api/cluster resources + nodes + object store stats
     GET /api/actors  /api/tasks  /api/objects  /api/workers  /api/jobs
     GET /api/task_summary
+    GET /api/crashes /api/crashes/<worker_id>   post-mortem crash reports
     GET /metrics     Prometheus exposition text
 """
 
@@ -244,6 +245,14 @@ class DashboardServer:
             return _task_detail(path[len("/api/tasks/"):])
         if path.startswith("/api/actors/"):
             return _actor_detail(path[len("/api/actors/"):])
+        if path == "/api/crashes":
+            # Crash-forensics plane (reference: the dashboard's worker
+            # death listings with exit type/detail): classified
+            # worker/node death reports from the head's bounded table.
+            return {"crashes": us.list_crash_reports()}
+        if path.startswith("/api/crashes/"):
+            report = us.get_crash_report(path[len("/api/crashes/"):])
+            return report if report is not None else None
         if path.startswith("/api/profile/"):
             # Live stack dump of a worker (reference:
             # dashboard/modules/reporter/profile_manager.py:191 — py-spy

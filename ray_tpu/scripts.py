@@ -195,6 +195,60 @@ def cmd_logs(args) -> int:
     return 0
 
 
+def cmd_crashes(args) -> int:
+    """Post-mortem crash reports (`ray-tpu crashes [worker_id]`):
+    classified worker/node deaths from the head's forensics table;
+    a worker_id argument prints the full report (stack excerpt, log
+    tail, beacon)."""
+    from ray_tpu.util import state as us
+
+    _connect(args.address)
+    if args.worker_id:
+        report = us.get_crash_report(args.worker_id)
+        if report is None:
+            print(f"no crash report for {args.worker_id}")
+            return 1
+        if args.json:
+            print(json.dumps(report, indent=2, default=str))
+            return 0
+        print(f"worker   {report.get('worker_id')}  "
+              f"(pid {report.get('pid')}, node {report.get('node_id')})")
+        print(f"reason   {report.get('exit_type')}: "
+              f"{report.get('exit_detail')}")
+        sig = report.get("signal_name") or report.get("term_signal")
+        print(f"status   exit_code={report.get('exit_code')} "
+              f"signal={sig}")
+        lt = report.get("last_task")
+        if lt:
+            print(f"last task  {lt.get('name')} [{lt.get('task_id')}]")
+        if report.get("beacon"):
+            print(f"beacon   {json.dumps(report['beacon'])}")
+        for title, key in (("post-mortem stack", "stack"),
+                           ("log tail", "log_tail")):
+            lines = report.get(key) or []
+            if lines:
+                print(f"\n--- {title} ---")
+                for ln in lines:
+                    print(f"  {ln}")
+        return 0
+    rows = us.list_crash_reports(limit=args.limit)
+    if args.json:
+        print(json.dumps(rows, indent=2, default=str))
+        return 0
+    hdr = f"{'WORKER':24} {'NODE':16} {'REASON':20} {'SIG/CODE':>8} " \
+          f"{'LAST TASK':24} DETAIL"
+    print(hdr)
+    print("-" * len(hdr))
+    for r in rows:
+        sig = r.get("signal_name") or r.get("exit_code")
+        lt = (r.get("last_task") or {}).get("name") or ""
+        print(f"{r.get('worker_id', ''):24} {r.get('node_id') or '':16} "
+              f"{r.get('exit_type', ''):20} {str(sig if sig is not None else ''):>8} "
+              f"{lt:24} {r.get('exit_detail', '')}")
+    print(f"\n{len(rows)} report(s)")
+    return 0
+
+
 def cmd_stop(args) -> int:
     """Stop the cluster: all agents, then the head (reference: `ray
     stop`)."""
@@ -372,6 +426,15 @@ def main(argv: list[str] | None = None) -> int:
     s.add_argument("--tail", type=int, default=100)
     s.add_argument("--max-bytes", type=int, default=64 * 1024)
     s.set_defaults(fn=cmd_logs)
+
+    s = sub.add_parser("crashes",
+                       help="post-mortem worker crash reports")
+    s.add_argument("worker_id", nargs="?", default=None,
+                   help="print one full report (stacks, log tail, beacon)")
+    s.add_argument("--address", required=True)
+    s.add_argument("--limit", type=int, default=100)
+    s.add_argument("--json", action="store_true")
+    s.set_defaults(fn=cmd_crashes)
 
     s = sub.add_parser("stop", help="stop all agents and the head")
     s.add_argument("--address", required=True)

@@ -209,6 +209,25 @@ def runtime_stats_text() -> str:
             lines.append(f'{full}_bucket{{le="{b}"}} {c}')
         lines.append(f"{full}_sum {h['sum']}")
         lines.append(f"{full}_count {h['count']}")
+    # Crash-forensics plane: classified worker deaths by reason
+    # (reference analogue: the worker-death metrics keyed by
+    # WorkerExitType in the GCS).
+    deaths = snap.get("worker_deaths") or {}
+    if deaths:
+        lines.append("# TYPE ray_tpu_worker_deaths_total counter")
+        for reason in sorted(deaths):
+            lines.append(
+                f'ray_tpu_worker_deaths_total'
+                f'{{reason="{_escape_label_value(reason)}"}} '
+                f"{deaths[reason]}")
+    # Cluster-wide head frame census (the zero-per-call-head-frames
+    # property, scrapeable): total frames every reporting process has
+    # sent the head.
+    rpc = snap.get("rpc") or {}
+    if rpc.get("total_head_frames") is not None:
+        lines.append("# TYPE ray_tpu_rpc_head_frames_total counter")
+        lines.append(
+            f"ray_tpu_rpc_head_frames_total {rpc['total_head_frames']}")
     return "\n".join(lines) + ("\n" if lines else "")
 
 

@@ -43,8 +43,17 @@ def prometheus_scrape_config(dashboard_host: str,
     )
 
 
-def _panel(panel_id: int, title: str, expr: str, unit: str,
+def _panel(panel_id: int, title: str, expr, unit: str,
            x: int, y: int) -> dict:
+    """``expr`` is one PromQL string or a list of (expr, legend)
+    pairs — multi-target panels render each series with its legend
+    (p50/p95 pairs share one panel)."""
+    if isinstance(expr, str):
+        targets = [{"expr": expr, "refId": "A"}]
+    else:
+        targets = [{"expr": e, "legendFormat": legend,
+                    "refId": chr(ord("A") + i)}
+                   for i, (e, legend) in enumerate(expr)]
     return {
         "id": panel_id,
         "title": title,
@@ -52,14 +61,30 @@ def _panel(panel_id: int, title: str, expr: str, unit: str,
         "gridPos": {"h": 8, "w": 12, "x": x, "y": y},
         "datasource": {"type": "prometheus", "uid": "${datasource}"},
         "fieldConfig": {"defaults": {"unit": unit}},
-        "targets": [{"expr": expr, "refId": "A"}],
+        "targets": targets,
     }
+
+
+def _quantile_targets(phase: str) -> list:
+    hist = f"ray_tpu_phase_{phase}_seconds_bucket"
+    return [
+        (f"histogram_quantile(0.5, sum(rate({hist}[5m])) by (le))", "p50"),
+        (f"histogram_quantile(0.95, sum(rate({hist}[5m])) by (le))", "p95"),
+    ]
+
+
+# Flight-recorder phase-latency histograms exported by the head
+# (ray_tpu_phase_*_seconds, util/metrics.runtime_stats_text).
+_PHASES = ("queue_wait", "dispatch", "exec", "result_transfer")
 
 
 def grafana_dashboard(extra_metrics: "list[str] | None" = None) -> dict:
     """Importable Grafana dashboard covering the core runtime metrics
     (reference: dashboard/modules/metrics/dashboards/*_dashboard_panels
-    — default panels generated for the cluster metric set). User
+    — default panels generated for the cluster metric set), the
+    flight-recorder phase-latency histograms (p50/p95 of queue wait /
+    dispatch / exec / result transfer), the cluster RPC head-frame
+    census, and the crash-forensics deaths-by-reason counters. User
     metrics passed in ``extra_metrics`` get a generic panel each."""
     panels = [
         _panel(1, "Tasks finished / s",
@@ -77,6 +102,25 @@ def grafana_dashboard(extra_metrics: "list[str] | None" = None) -> dict:
     ]
     next_id = 7
     y = 24
+    # Per-phase latency quantiles (PR 3 tracing plane).
+    for i, phase in enumerate(_PHASES):
+        panels.append(_panel(
+            next_id, f"Task {phase.replace('_', ' ')} latency (p50/p95)",
+            _quantile_targets(phase), "s",
+            (i % 2) * 12, y + (i // 2) * 8))
+        next_id += 1
+    y += 16
+    # Cluster RPC census + crash-forensics deaths.
+    panels.append(_panel(
+        next_id, "Head control-plane frames / s (cluster total)",
+        "rate(ray_tpu_rpc_head_frames_total[1m])", "ops", 0, y))
+    next_id += 1
+    panels.append(_panel(
+        next_id, "Worker deaths by reason / 5m",
+        "sum by (reason) (increase(ray_tpu_worker_deaths_total[5m]))",
+        "short", 12, y))
+    next_id += 1
+    y += 8
     for i, name in enumerate(extra_metrics or []):
         panels.append(_panel(next_id, name, name, "short",
                              (i % 2) * 12, y + (i // 2) * 8))

@@ -143,6 +143,12 @@ def summarize_tasks() -> dict:
         bucket = lat_by_name.setdefault(ev["name"], {})
         for phase, dt in phase_latencies(aligned).items():
             bucket.setdefault(phase, []).append(max(0.0, dt))
+        # Executor-thread CPU seconds (worker-stamped): exec_cpu far
+        # below exec reads as a GIL-starved or IO/lock-blocked task —
+        # visible here instead of the old stderr timing prints.
+        if isinstance(ev.get("cpu_time"), (int, float)):
+            bucket.setdefault("exec_cpu", []).append(
+                max(0.0, ev["cpu_time"]))
     out = {}
     for name, states in by_name.items():
         entry = {"state_counts": dict(states),
@@ -194,6 +200,94 @@ def get_log(name: str, *, tail: int = 500,
     return reply["lines"][-tail:] if tail > 0 else []
 
 
+def list_crash_reports(*, filters=None, limit: int = 100) -> list[dict]:
+    """Classified worker/node death reports from the head's bounded
+    crash-forensics table (reference analogue: the GCS worker-death
+    table with WorkerExitType + exit_detail). Summary rows — use
+    get_crash_report() for the full evidence (stacks, log tail,
+    beacon, flight-recorder cross-link)."""
+    rows = _call("list_crash_reports", {"limit": limit})["reports"]
+    return _filtered(rows, filters)[:limit]
+
+
+def get_crash_report(worker_id: str) -> "dict | None":
+    """One death's FULL post-mortem report: classification
+    (exit_type/exit_detail), real exit code / terminating signal,
+    faulthandler stack excerpt, log tail, the worker's last beacon
+    (task, phase, rss, cpu at the instant of death), and its last
+    flight-recorder events. Node deaths live under ``node:<node_id>``."""
+    rows = _call("list_crash_reports", {"worker_id": worker_id})["reports"]
+    return dict(rows[0]) if rows else None
+
+
+def profile_worker(worker_id: str, duration_s: float = 5.0, *,
+                   mode: str = "cpu", hz: int = 50,
+                   include_idle: bool = False) -> dict:
+    """Sample one live worker's threads for ``duration_s`` seconds and
+    return folded collapsed stacks (``{"file:func;file:func": hits}``)
+    — the Python API over the worker's sampling profiler that was
+    previously reachable only through the dashboard's /api/profile
+    endpoint. ``mode="memory"`` traces allocations (tracemalloc window)
+    instead. Render with save_flamegraph() / save_speedscope()."""
+    body = {"worker_id": worker_id, "sample_s": float(duration_s),
+            "hz": int(hz), "mode": mode, "include_idle": bool(include_idle)}
+    return global_runtime().conn.call("profile_worker", body,
+                                      timeout=float(duration_s) + 20.0)
+
+
+def save_flamegraph(profile: dict, path: str) -> str:
+    """Write a profile_worker() result as collapsed-stack lines — the
+    input format of flamegraph.pl / inferno / speedscope's importer."""
+    folded = profile.get("folded") or {}
+    with open(path, "w") as f:
+        for stack, hits in folded.items():
+            f.write(f"{stack} {hits}\n")
+    return path
+
+
+def to_speedscope(profile: dict, name: str = "ray_tpu worker") -> dict:
+    """Convert a profile_worker() result to the speedscope file format
+    (https://www.speedscope.app) — paste/drag the saved JSON into the
+    web UI for an interactive flamegraph."""
+    folded = profile.get("folded") or {}
+    frames: list[dict] = []
+    index: dict[str, int] = {}
+    samples, weights = [], []
+    for stack, hits in folded.items():
+        sample = []
+        for frame in stack.split(";"):
+            i = index.get(frame)
+            if i is None:
+                i = index[frame] = len(frames)
+                frames.append({"name": frame})
+            sample.append(i)
+        samples.append(sample)
+        weights.append(hits)
+    total = sum(weights)
+    return {
+        "$schema": "https://www.speedscope.app/file-format-schema.json",
+        "shared": {"frames": frames},
+        "profiles": [{
+            "type": "sampled",
+            "name": f"{name} ({profile.get('worker_id', '?')})",
+            "unit": "none",
+            "startValue": 0,
+            "endValue": total,
+            "samples": samples,
+            "weights": weights,
+        }],
+    }
+
+
+def save_speedscope(profile: dict, path: str,
+                    name: str = "ray_tpu worker") -> str:
+    import json
+
+    with open(path, "w") as f:
+        json.dump(to_speedscope(profile, name), f)
+    return path
+
+
 def get_task_events(limit: int = 10000,
                     task_ids: "list[str] | None" = None) -> list[dict]:
     body: dict = {"limit": limit}
@@ -243,6 +337,23 @@ def timeline(filename: str | None = None) -> "list | str":
 
     for ev in data["events"]:
         if not isinstance(ev, dict):
+            continue
+        if ev.get("event") in ("worker_death", "oom_kill"):
+            # Crash-forensics instants: classified worker deaths and
+            # memory-monitor kills on the dead worker's node track.
+            off = (data["clock_offsets"].get(ev.get("node_id"), 0.0)
+                   if ev.get("node_id") else 0.0)
+            reason = ev.get("reason") or "oom_kill"
+            trace.append({
+                "cat": "death", "ph": "i", "s": "p",
+                "name": f"death:{reason}:{(ev.get('worker_id') or '')[:16]}",
+                "ts": (ev["ts"] - off) * 1e6,
+                "pid": _pid(ev.get("node_id")),
+                "tid": int(ev.get("pid") or 0),
+                "args": {k: ev.get(k) for k in
+                         ("worker_id", "node_id", "reason", "detail",
+                          "tasks") if ev.get(k) is not None},
+            })
             continue
         if ev.get("event") == "chaos":
             trace.append({

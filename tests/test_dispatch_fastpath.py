@@ -251,6 +251,55 @@ def test_event_plane_zero_per_call_head_frames(cluster):
     ray_tpu.kill(a)
 
 
+def test_forensics_plane_zero_per_call_head_frames(cluster):
+    """The crash-forensics plane (enabled by DEFAULT) is worker-local:
+    faulthandler arming is one-time at boot and the beacon is an mmap
+    write — steady-state direct actor calls still make ZERO per-call
+    synchronous head RPCs and ZERO head submissions, and no dedicated
+    forensics frames exist on the task path (worker_death is a per-death
+    agent cast, not a per-call one)."""
+    import os
+
+    from ray_tpu._private.config import GLOBAL_CONFIG
+
+    assert GLOBAL_CONFIG.crash_forensics_enabled  # the default ships ON
+
+    @ray_tpu.remote
+    class Forensic:
+        def ping(self, x=None):
+            return x
+
+        def beacon_exists(self):
+            from ray_tpu._private import forensics
+
+            crash_dir = forensics.crash_dir_from_env()
+            wid = os.environ.get("RAY_TPU_WORKER_ID")
+            return (crash_dir is not None and wid is not None
+                    and os.path.isfile(forensics.beacon_path(crash_dir,
+                                                             wid)))
+
+    a = Forensic.remote()
+    rt = global_runtime()
+    assert ray_tpu.get(a.ping.remote(1)) == 1
+    # The worker actually armed its black box (beacon on disk).
+    assert ray_tpu.get(a.beacon_exists.remote())
+    _wait(lambda: rt._direct.routes[a._actor_id].mode == "direct",
+          msg="actor route never entered direct mode")
+
+    N = 30
+    before_submit = rt.conn.sent_kinds.get("submit_actor_task", 0)
+    before_calls = rt.conn.calls_sent
+    before_push = _direct_push_count(rt)
+    before_death = rt.conn.sent_kinds.get("worker_death", 0)
+    for i in range(N):
+        assert ray_tpu.get(a.ping.remote(i)) == i
+    assert rt.conn.sent_kinds.get("submit_actor_task", 0) == before_submit
+    assert rt.conn.calls_sent == before_calls
+    assert rt.conn.sent_kinds.get("worker_death", 0) == before_death
+    assert _direct_push_count(rt) - before_push == N
+    ray_tpu.kill(a)
+
+
 # ------------------------------------------------------- metrics surface
 
 

@@ -1,0 +1,460 @@
+"""Crash-forensics plane.
+
+Modeled on the reference's structured worker-death diagnostics
+(WorkerExitType + exit_detail through the GCS death path, OOM
+attribution in the raylet): unit tests for the exit classifier and the
+black-box primitives (beacon, stack excerpts, speedscope export), and
+chaos-driven end-to-end tests asserting that injected SIGKILL/SIGSEGV
+deaths produce correctly classified, retrievable crash reports whose
+classification also rides the user-facing errors.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import forensics
+from ray_tpu._private.worker_context import global_runtime
+from ray_tpu.util import metrics as um
+from ray_tpu.util import state as us
+
+
+def _wait(pred, timeout=20.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        v = pred()
+        if v:
+            return v
+        time.sleep(0.05)
+    raise TimeoutError(f"never happened: {msg}")
+
+
+# ======================================================= classification
+
+
+def test_classify_clean_and_intent_exits():
+    assert forensics.classify_exit(exit_code=0)[0] == forensics.CLEAN_EXIT
+    assert forensics.classify_exit(
+        exit_code=0, expected=("retired", "max_calls"))[0] \
+        == forensics.RETIRED
+    assert forensics.classify_exit(
+        exit_code=0, expected=("shutdown", ""))[0] == forensics.SHUTDOWN
+    reason, detail = forensics.classify_exit(
+        exit_code=0, expected=("intended_kill", "ray_tpu.kill()"))
+    assert reason == forensics.INTENDED_KILL and "kill" in detail
+
+
+def test_classify_sigkill_paths():
+    # Unattributed SIGKILL.
+    reason, detail = forensics.classify_exit(term_signal=signal.SIGKILL)
+    assert reason == forensics.SIGKILL and "unattributed" in detail
+    # Kernel OOM evidence wins.
+    assert forensics.classify_exit(
+        term_signal=signal.SIGKILL, oom_killed=True)[0] \
+        == forensics.KERNEL_OOM
+    # Memory-monitor intent wins even over OOM evidence ordering.
+    assert forensics.classify_exit(
+        term_signal=signal.SIGKILL,
+        expected=("memory_monitor", "policy kill"))[0] \
+        == forensics.MEMORY_MONITOR_KILL
+    # Intent-marked SIGKILL (ray_tpu.kill).
+    assert forensics.classify_exit(
+        term_signal=signal.SIGKILL, expected=("intended_kill", ""))[0] \
+        == forensics.INTENDED_KILL
+
+
+def test_classify_fatal_signal_and_exceptions():
+    reason, detail = forensics.classify_exit(
+        term_signal=signal.SIGSEGV,
+        crash_text="Fatal Python error: Segmentation fault\n"
+                   "Thread 0x01 (most recent call first):\n")
+    assert reason == forensics.FATAL_SIGNAL
+    assert "SIGSEGV" in detail and "captured" in detail
+    assert forensics.classify_exit(term_signal=signal.SIGABRT)[0] \
+        == forensics.FATAL_SIGNAL
+    assert forensics.classify_exit(term_signal=signal.SIGTERM)[0] \
+        == forensics.TERMINATED
+    assert forensics.classify_exit(
+        exit_code=1, crash_text="Uncaught exception in thread x:\n"
+                                "Traceback (most recent call last):")[0] \
+        == forensics.UNCAUGHT_EXCEPTION
+    assert forensics.classify_exit(exit_code=3)[0] == forensics.UNKNOWN
+    assert forensics.classify_exit()[0] == forensics.UNKNOWN
+
+
+def test_classify_node_and_spawn_intents():
+    reason, detail = forensics.classify_exit(
+        expected=("node_death", "presumed dead: 31.0s"))
+    assert reason == forensics.NODE_DEATH and "presumed" in detail
+    assert forensics.classify_exit(
+        expected=("spawn_failure", "never registered"))[0] \
+        == forensics.SPAWN_FAILURE
+
+
+def test_reason_rank_orders_intent_over_evidence_over_guess():
+    r = forensics.REASON_RANK
+    assert r[forensics.UNKNOWN] < r[forensics.SIGKILL] \
+        < r[forensics.FATAL_SIGNAL] < r[forensics.MEMORY_MONITOR_KILL]
+    assert r[forensics.KERNEL_OOM] > r[forensics.SIGKILL]
+
+
+def test_split_status():
+    assert forensics.split_status(None) == (None, None)
+    assert forensics.split_status(0) == (0, None)
+    assert forensics.split_status(9) == (None, 9)        # SIGKILL
+    assert forensics.split_status(11) == (None, 11)      # SIGSEGV
+    assert forensics.split_status(3 << 8) == (3, None)   # exit(3)
+
+
+# ========================================================= black box
+
+
+def test_beacon_roundtrip_and_torn_read(tmp_path):
+    path = str(tmp_path / "w.beacon")
+    b = forensics.Beacon(path)
+    b.update("task-1", "f", "exec")
+    rec = forensics.read_beacon(path)
+    assert rec["task_id"] == "task-1" and rec["phase"] == "exec"
+    assert rec["pid"] == os.getpid() and rec["rss"] > 0
+    # The beacon is a plain file: readable with no process behind it.
+    b.close()
+    assert forensics.read_beacon(path)["task_id"] == "task-1"
+    # Garbage (torn write) reads as "no beacon", never raises.
+    with open(path, "wb") as f:
+        f.write(b"RTB1" + (9999).to_bytes(4, "little") + b"junk")
+    assert forensics.read_beacon(path) is None
+    assert forensics.read_beacon(str(tmp_path / "missing")) is None
+
+
+def test_stack_excerpt_anchors_last_dump():
+    text = ("boot noise\nFatal Python error: Aborted\n"
+            "Thread 0x01 (most recent call first):\n  File \"a.py\"\n")
+    ex = forensics.stack_excerpt(text)
+    assert ex[0].startswith("Fatal Python error")
+    assert forensics.stack_excerpt("") == []
+    assert forensics.stack_excerpt("no markers at all") == []
+
+
+def test_collect_report_without_evidence(tmp_path):
+    report = forensics.collect_report(
+        "w-1", "node-1", 123, exit_code=0, crash_dir=str(tmp_path),
+        log_path=str(tmp_path / "nope.log"))
+    assert report["exit_type"] == forensics.CLEAN_EXIT
+    assert report["stack"] == [] and report["log_tail"] == []
+    assert report["beacon"] is None
+
+
+def test_oom_watch_counts_and_deltas(tmp_path):
+    ev = tmp_path / "memory.events"
+    ev.write_text("low 0\nhigh 2\noom 1\noom_kill 1\noom_group_kill 0\n")
+    w = forensics.OomWatch((str(ev),))
+    assert w.delta() == 0  # baseline established at construction
+    ev.write_text("low 0\nhigh 2\noom 3\noom_kill 3\noom_group_kill 0\n")
+    assert w.delta() == 2
+    assert w.delta() == 0
+
+
+def test_speedscope_and_flamegraph_export(tmp_path):
+    prof = {"worker_id": "w-1",
+            "folded": {"a.py:main;b.py:inner": 7, "a.py:main": 3}}
+    sc = us.to_speedscope(prof)
+    assert sc["profiles"][0]["endValue"] == 10
+    assert len(sc["shared"]["frames"]) == 2  # main deduped across stacks
+    fg = us.save_flamegraph(prof, str(tmp_path / "fg.txt"))
+    lines = open(fg).read().splitlines()
+    assert "a.py:main;b.py:inner 7" in lines
+    p = us.save_speedscope(prof, str(tmp_path / "sc.json"))
+    assert json.load(open(p))["shared"]["frames"]
+
+
+# ==================================================== end-to-end (chaos)
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4, object_store_memory=64 * 1024 * 1024)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_sigkilled_worker_classified_with_last_task(cluster):
+    """Acceptance: a chaos-plane SIGKILL'd worker yields a retrievable
+    crash report with a classified exit reason, and the user-facing
+    error for its in-flight task carries that reason plus last-task
+    provenance."""
+
+    @ray_tpu.remote(max_retries=0)
+    def doomed_sleep():
+        time.sleep(30)
+        return 1
+
+    ref = doomed_sleep.remote()
+    busy = _wait(
+        lambda: [w for w in us.list_workers()
+                 if w["busy"] and not w["actor_id"] and w["pid"]],
+        msg="task never occupied a worker")
+    victim = busy[0]
+    time.sleep(0.3)  # let the exec-phase beacon stamp land
+    os.kill(victim["pid"], signal.SIGKILL)
+    with pytest.raises(Exception) as ei:
+        ray_tpu.get(ref, timeout=20)
+    msg = str(ei.value)
+    assert "reason: sigkill" in msg
+    assert "last task doomed_sleep" in msg
+    assert victim["node_id"] in msg
+
+    report = _wait(lambda: us.get_crash_report(victim["worker_id"]),
+                   msg="crash report never appeared")
+    assert report["exit_type"] == "sigkill"
+    assert report["term_signal"] == signal.SIGKILL
+    assert report["signal_name"] == "SIGKILL"
+    assert report["last_task"]["name"] == "doomed_sleep"
+    # The beacon froze at the instant of death: mid-exec on this task.
+    assert report["beacon"] is not None
+    assert report["beacon"]["phase"] == "exec"
+    assert report["beacon"]["task_id"] == report["last_task"]["task_id"]
+
+
+def test_sigsegv_actor_carries_stack_excerpt(cluster):
+    """Acceptance: injected SIGSEGV classifies as fatal_signal and the
+    ActorDiedError carries a faulthandler stack excerpt; subsequent
+    calls fail with the same classified death cause."""
+
+    @ray_tpu.remote
+    class Segfaulter:
+        def ping(self):
+            return 1
+
+        def segv(self):
+            os.kill(os.getpid(), signal.SIGSEGV)
+            time.sleep(30)  # the signal kills us mid-call
+
+    a = Segfaulter.remote()
+    assert ray_tpu.get(a.ping.remote()) == 1
+    actor_row = us.get_actor(a._actor_id)
+    wid = actor_row["worker_id"]
+    ref = a.segv.remote()
+    with pytest.raises(Exception) as ei:
+        ray_tpu.get(ref, timeout=20)
+    msg = str(ei.value)
+    assert "reason: fatal_signal" in msg
+    assert "SIGSEGV" in msg
+    assert "Fatal Python error" in msg  # stack excerpt rode the error
+
+    # Subsequent calls carry the classified death cause too.
+    with pytest.raises(Exception) as ei2:
+        ray_tpu.get(a.ping.remote(), timeout=10)
+    assert "fatal_signal" in str(ei2.value)
+
+    report = _wait(lambda: us.get_crash_report(wid),
+                   msg="segv crash report")
+    assert report["exit_type"] == "fatal_signal"
+    assert report["term_signal"] == signal.SIGSEGV
+    assert any("Fatal Python error" in ln for ln in report["stack"])
+    # Flight-recorder cross-link: the dead worker's last events ride
+    # the report.
+    assert report.get("events")
+
+
+def test_intended_kill_and_retirement_classify_clean(cluster):
+    @ray_tpu.remote
+    class Victim:
+        def ping(self):
+            return 1
+
+    a = Victim.remote()
+    assert ray_tpu.get(a.ping.remote()) == 1
+    wid = us.get_actor(a._actor_id)["worker_id"]
+    ray_tpu.kill(a)
+    report = _wait(lambda: us.get_crash_report(wid),
+                   msg="kill report")
+    assert report["exit_type"] == "intended_kill"
+
+    # max_calls retirement: a clean, classified death — not noise.
+    @ray_tpu.remote(max_calls=1)
+    def one_shot():
+        return os.environ.get("RAY_TPU_WORKER_ID")
+
+    retiree = ray_tpu.get(one_shot.remote())
+    report = _wait(lambda: us.get_crash_report(retiree),
+                   msg="retirement report")
+    assert report["exit_type"] == "retired"
+    assert "max_calls" in report["exit_detail"]
+
+
+def test_memory_monitor_kill_classified(cluster):
+    """A memory-monitor victim classifies as memory_monitor_kill (the
+    head records its intent before the SIGKILL), never as an anonymous
+    external kill."""
+    from ray_tpu._private.memory_monitor import MemoryMonitor
+    from ray_tpu._private.worker_context import get_head
+
+    head = get_head()
+    mon = MemoryMonitor(head, threshold=0.9, min_kill_interval_s=0.0,
+                        usage_fn=lambda: (95, 100))
+
+    marker = f"/tmp/ray_tpu_forensics_oom_{os.getpid()}"
+
+    @ray_tpu.remote(max_retries=1)
+    def hog(path):
+        # First attempt sleeps long (the kill victim); the retry after
+        # the kill returns immediately.
+        if not os.path.exists(path):
+            with open(path, "w") as f:
+                f.write("1")
+            time.sleep(30)
+        return 1
+
+    try:
+        ref = hog.remote(marker)
+        victim = _wait(
+            lambda: [w for w in us.list_workers()
+                     if w["busy"] and not w["actor_id"]],
+            msg="hog never occupied a worker")[0]
+        _wait(lambda: os.path.exists(marker), msg="hog never started")
+        assert mon.tick(), "monitor should have killed the busy worker"
+        report = _wait(lambda: us.get_crash_report(victim["worker_id"]),
+                       msg="memory-monitor kill report")
+        assert report["exit_type"] == "memory_monitor_kill"
+        assert "OOM policy" in report["exit_detail"]
+        assert "hog" in report["exit_detail"]  # running tasks named
+        assert ray_tpu.get(ref, timeout=30) == 1  # retried cleanly
+    finally:
+        if os.path.exists(marker):
+            os.unlink(marker)
+
+
+def test_death_counters_and_prometheus_labels(cluster):
+    snap = global_runtime().conn.call("runtime_stats", {})
+    deaths = snap.get("worker_deaths") or {}
+    # Earlier tests produced at least these classifications.
+    assert deaths.get("sigkill", 0) >= 1
+    assert deaths.get("fatal_signal", 0) >= 1
+    text = um.runtime_stats_text()
+    assert 'ray_tpu_worker_deaths_total{reason="sigkill"}' in text
+    assert 'ray_tpu_worker_deaths_total{reason="fatal_signal"}' in text
+    assert "ray_tpu_rpc_head_frames_total" in text
+
+
+def test_crash_listing_and_timeline_instants(cluster):
+    rows = us.list_crash_reports()
+    assert rows and all("exit_type" in r for r in rows)
+    # Summary rows are bounded: no stacks/log tails ride the listing.
+    assert all("stack" not in r and "log_tail" not in r for r in rows)
+    trace = us.timeline()
+    deaths = [t for t in trace if t.get("cat") == "death"]
+    assert any(t["name"].startswith("death:sigkill") for t in deaths)
+    assert any(t["name"].startswith("death:fatal_signal")
+               for t in deaths)
+
+
+def test_profile_worker_state_api(cluster):
+    @ray_tpu.remote
+    class Spinner:
+        def spin(self, dt):
+            t0 = time.monotonic()
+            n = 0
+            while time.monotonic() - t0 < dt:
+                n += 1
+            return n
+
+    a = Spinner.remote()
+    # Creation must complete first: a mid-creation worker has no head
+    # connection yet and profile_start would bounce.
+    _wait(lambda: (us.get_actor(a._actor_id) or {}).get("state")
+          == "ALIVE", msg="spinner actor alive")
+    wid = us.get_actor(a._actor_id)["worker_id"]
+    ref = a.spin.remote(1.2)
+    prof = us.profile_worker(wid, duration_s=0.5)
+    assert prof.get("samples", 0) > 0, prof
+    assert isinstance(prof.get("folded"), dict)
+    ray_tpu.get(ref)
+    ray_tpu.kill(a)
+
+
+def test_cpu_time_stamp_shows_blocked_tasks(cluster):
+    """Satellite: wall-vs-CPU skew rides the event plane (cpu_time on
+    the lifecycle event, exec_cpu in summarize_tasks) instead of the
+    old RAY_TPU_WORKER_TASK_TIMING stderr prints."""
+
+    @ray_tpu.remote
+    def blocked_nap():
+        time.sleep(0.4)
+        return 1
+
+    assert ray_tpu.get(blocked_nap.remote()) == 1
+
+    def _ev():
+        evs = [e for e in us.get_task_events()
+               if isinstance(e, dict) and e.get("name") == "blocked_nap"
+               and e.get("cpu_time") is not None]
+        return evs
+    evs = _wait(_ev, msg="cpu_time-stamped event")
+    phases = evs[-1]["phases"]
+    wall = phases["exec_end"] - phases["exec_start"]
+    assert evs[-1]["cpu_time"] < wall / 4  # slept, didn't burn CPU
+    summ = us.summarize_tasks()
+    lat = summ["blocked_nap"]["phase_latency_s"]
+    assert "exec_cpu" in lat and lat["exec_cpu"]["count"] >= 1
+    assert lat["exec_cpu"]["p50"] < lat["exec"]["p50"]
+
+
+# ------------------------------------------------- remote (agent) path
+
+
+@pytest.mark.slow
+def test_agent_worker_death_report_reaches_head(cluster):
+    """The node agent's reaper classifies ITS workers' exits from the
+    real wait status and ships the report to the head (worker_death),
+    upgrading the head's thin conn-close classification."""
+    from tests import chaos_utils
+
+    agent = chaos_utils.start_agent(
+        ray_tpu.get_runtime_context().gcs_address,
+        node_id="forensics-node", num_cpus=2,
+        resources={"forensics": 2.0})
+    try:
+        chaos_utils.wait_nodes(2)
+
+        @ray_tpu.remote(max_retries=0, resources={"forensics": 1.0})
+        def remote_sleep():
+            time.sleep(30)
+            return 1
+
+        ref = remote_sleep.remote()
+
+        def _busy_remote():
+            return [w for w in us.list_workers()
+                    if w["busy"] and w["node_id"] == "forensics-node"
+                    and w["pid"]]
+        victim = _wait(_busy_remote, msg="remote worker busy")[0]
+        os.kill(victim["pid"], signal.SIGKILL)
+        with pytest.raises(Exception):
+            ray_tpu.get(ref, timeout=20)
+
+        def _classified():
+            r = us.get_crash_report(victim["worker_id"])
+            return r if r and r.get("term_signal") == signal.SIGKILL \
+                else None
+        report = _wait(_classified, timeout=15,
+                       msg="agent report never upgraded the record")
+        assert report["exit_type"] == "sigkill"
+        # Now kill the whole agent: node death gets its own report.
+        chaos_utils.stop_agent(agent)
+        agent = None
+        node_report = _wait(
+            lambda: us.get_crash_report("node:forensics-node"),
+            timeout=60, msg="node death report")
+        assert node_report["exit_type"] == "node_death"
+        assert "presumed dead" in node_report["exit_detail"]
+    finally:
+        if agent is not None:
+            chaos_utils.stop_agent(agent)
