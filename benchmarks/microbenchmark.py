@@ -122,9 +122,72 @@ def main(as_json: bool = False) -> dict:
     ray_tpu.shutdown()
     bench_event_overhead(results)
     bench_forensics_overhead(results)
+    bench_admission_overhead(results)
+    bench_deadline_overhead(results)
     if as_json:
         print(json.dumps({"microbenchmark": results}))
     return results
+
+
+def bench_admission_overhead(results: dict) -> None:
+    """Admission-gate overhead: the owner-side gate is a pending-set
+    size check per submit and the head gate two dict lookups — with
+    default budgets (never tripping) the on/off delta must be within
+    run noise (±5%, the CI guard for "admission control is free on the
+    healthy path"). "off" disables both budgets entirely."""
+    from ray_tpu._private import config as config_mod
+
+    for mode in ("on", "off"):
+        cfg = config_mod.GLOBAL_CONFIG
+        saved = (cfg.admission_max_pending_per_owner,
+                 cfg.admission_max_pending_total)
+        if mode == "off":
+            cfg.admission_max_pending_per_owner = 0
+            cfg.admission_max_pending_total = 0
+        ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024 * 1024,
+                     log_to_driver=False,
+                     _system_config=(
+                         {} if mode == "on"
+                         else {"admission_max_pending_per_owner": 0,
+                               "admission_max_pending_total": 0}))
+
+        @ray_tpu.remote
+        def adm(i):
+            return i
+
+        N = 100
+        ray_tpu.get([adm.remote(i) for i in range(64)])  # warm
+        timeit(f"tasks async admission {mode}",
+               lambda: ray_tpu.get([adm.remote(i) for i in range(N)]),
+               N, results=results)
+        ray_tpu.shutdown()
+        (cfg.admission_max_pending_per_owner,
+         cfg.admission_max_pending_total) = saved
+
+
+def bench_deadline_overhead(results: dict) -> None:
+    """Deadline-stamping overhead: .options(timeout_s=...) costs one
+    time.time() at submit, one optional trailing field in the compiled
+    spec encoding, and a float comparison at each queue hop. Generous
+    deadlines never shed, so the delta vs unstamped tasks must be
+    within run noise (±5%)."""
+    ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024 * 1024,
+                 log_to_driver=False)
+
+    @ray_tpu.remote
+    def dl(i):
+        return i
+
+    N = 100
+    ray_tpu.get([dl.remote(i) for i in range(64)])  # warm
+    timeit("tasks async deadline off",
+           lambda: ray_tpu.get([dl.remote(i) for i in range(N)]),
+           N, results=results)
+    stamped = dl.options(timeout_s=3600.0)
+    timeit("tasks async deadline on",
+           lambda: ray_tpu.get([stamped.remote(i) for i in range(N)]),
+           N, results=results)
+    ray_tpu.shutdown()
 
 
 def bench_event_overhead(results: dict) -> None:

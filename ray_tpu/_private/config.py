@@ -156,6 +156,39 @@ class Config:
     memory_monitor_enabled: bool = True
     memory_usage_threshold: float = 0.95
     memory_monitor_interval_s: float = 1.0
+    # Soft watermark BELOW the kill threshold (overload-protection
+    # plane): a node past it is "pressured" — it stops receiving new
+    # placements and lease grants, and its workers bounce direct pushes
+    # (direct_rej → head path) until usage recovers. Backpressure
+    # instead of the kill threshold's reactive SIGKILL. >= the kill
+    # threshold (or >= 1.0) disables the soft watermark.
+    memory_pressure_threshold: float = 0.80
+    # Hysteresis: a pressured node recovers only once usage drops this
+    # far BELOW the watermark (flap damping).
+    memory_pressure_hysteresis: float = 0.03
+
+    # --- overload protection: deadlines + admission control ---
+    # Default task deadline stamped at submit (seconds; 0 = none).
+    # Per-call override: fn.options(timeout_s=...). Expired tasks are
+    # shed at every queue hop with a typed TaskTimeoutError instead of
+    # burning capacity.
+    task_timeout_s_default: float = 0.0
+    # Admission budgets: pending (queued, not yet executing) tasks per
+    # owner and cluster-wide. The owner runtime enforces its own budget
+    # at submit (blocking by default); the head enforces both as the
+    # authoritative backstop and rejects over-budget submissions with a
+    # typed PendingCallsLimitError seal + a backpressure cast. Fairness
+    # is per-owner: one hot client exhausts ITS budget (or its fair
+    # share of the global one) while others keep submitting. <= 0
+    # disables a budget.
+    admission_max_pending_per_owner: int = 200_000
+    admission_max_pending_total: int = 1_000_000
+    # What an over-budget submit does at the OWNER: "block" (default)
+    # parks the submitting thread until the backlog drains; "fail"
+    # raises PendingCallsLimitError immediately.
+    admission_mode: str = "block"
+    # Blocking-submit gives up (PendingCallsLimitError) after this long.
+    admission_block_timeout_s: float = 60.0
 
     # --- networking ---
     head_host: str = "127.0.0.1"  # 0.0.0.0 for multi-host clusters

@@ -134,7 +134,10 @@ class DirectPlane:
         self._rr = 0
         # Counters surfaced through ray_tpu.util.metrics.rpc_counters.
         self.stats = {"direct_actor_calls": 0, "direct_lease_tasks": 0,
-                      "spillbacks": 0, "recovered": 0}
+                      "spillbacks": 0, "recovered": 0,
+                      # Overload plane: deadline-expired calls shed from
+                      # the owner-side queues, and direct cancellations.
+                      "shed_owner_queue": 0, "cancelled_owner_queue": 0}
 
     # ------------------------------------------------------------------
     # submission fast paths (called from CoreRuntime.submit_*)
@@ -165,9 +168,12 @@ class DirectPlane:
     def _drain_route(self, r: _ActorRoute) -> None:
         """Pop+push queued calls while the inflight window has room.
         The per-route send lock makes pop-to-wire atomic across the
-        submitter and resolver threads — ordered actors rely on it."""
+        submitter and resolver threads — ordered actors rely on it.
+        Deadline-expired calls are shed at pop (typed TaskTimeoutError
+        sealed locally) instead of burning the window."""
         with r.send_lock:
             while True:
+                expired = None
                 with self.lock:
                     if (r.mode != "direct" or r.addr is None
                             or not r.pending
@@ -175,9 +181,30 @@ class DirectPlane:
                                 >= self.window)):
                         return
                     spec = r.pending.popleft()
-                    addr, wid = r.addr, r.worker_id
-                    chips, enc = r.tpu_chips, r.specenc
+                    if spec.deadline and time.time() > spec.deadline:
+                        r.tasks.pop(spec.task_id, None)
+                        self.stats["shed_owner_queue"] += 1
+                        expired = spec
+                    else:
+                        addr, wid = r.addr, r.worker_id
+                        chips, enc = r.tpu_chips, r.specenc
+                if expired is not None:
+                    self._seal_shed(expired)
+                    continue
                 self._push(addr, wid, spec, chips, enc, kind="actor")
+
+    def _seal_shed(self, spec: TaskSpec) -> None:
+        """Seal a TaskTimeoutError for a deadline-expired call shed
+        owner-side (never sent anywhere). Outside self.lock — sealing
+        re-enters the plane through on_resolved."""
+        try:
+            self.rt.seal_local_error(
+                spec.return_ids,
+                f"TaskTimeoutError: task {spec.name} exceeded its "
+                f"deadline while queued owner-side (shed before dispatch)",
+                kind="task_timeout")
+        except Exception:
+            pass
 
     @staticmethod
     def _lease_eligible(spec: TaskSpec) -> bool:
@@ -192,6 +219,14 @@ class DirectPlane:
         beyond the pool's idle capacity spills back to the head (which
         dispatches in parallel and grows the pool with fresh grants)."""
         if not self._lease_eligible(spec):
+            return False
+        if spec.deps and any(d in self.rt._expected_owned
+                             for d in spec.deps):
+            # A dep THIS owner is still awaiting would make the leased
+            # worker block in arg resolution — binding the lease (window
+            # 1) to a wait of unknown length, invisible to deadline
+            # shedding. The head parks it in dep_blocked instead and
+            # dispatches on the seal (event-driven, no worker held).
             return False
         key = shape_key(spec)
         with self.lock:
@@ -524,10 +559,83 @@ class DirectPlane:
     # ------------------------------------------------------------------
     # watchdog (driven from the runtime's release loop)
 
+    def cancel_local(self, target_id: str) -> "str | None":
+        """Owner-side half of ray_tpu.cancel for direct-plane tasks the
+        head cannot see: a call queued owner-side in the direct window
+        is removed and sealed with the standard cancellation error
+        ("cancelled"); a call already pushed owner→worker is signalled
+        over the peer connection ("signalled" — the worker drops it at
+        pickup, exactly like the head's cancel cast). None = this plane
+        does not know the task (head path owns it). ``target_id``
+        matches a task id or any of its return ids (the public
+        cancel(ref) passes the ref)."""
+        cancelled = None
+        signal_addr = None
+        task_id = None
+        with self.lock:
+            info = self.by_oid.get(target_id)
+            for r in self.routes.values():
+                spec = next(
+                    (s for s in r.pending
+                     if s.task_id == target_id
+                     or target_id in s.return_ids), None)
+                if spec is not None:
+                    r.pending.remove(spec)
+                    r.tasks.pop(spec.task_id, None)
+                    self.stats["cancelled_owner_queue"] += 1
+                    cancelled = spec
+                    break
+            if cancelled is None and info is not None:
+                kind, route_key, task_id = info
+                if kind == "actor":
+                    r = self.routes.get(route_key)
+                    if (r is not None and r.addr is not None
+                            and task_id in r.tasks):
+                        signal_addr = r.addr
+                elif kind == "lease":
+                    rec = self.lease_tasks.get(task_id)
+                    if rec is not None and rec[4] is not None:
+                        signal_addr = rec[4].addr
+        if cancelled is not None:
+            try:
+                self.rt.seal_local_error(
+                    cancelled.return_ids,
+                    "TaskCancelledError: cancelled before execution")
+            except Exception:
+                pass
+            return "cancelled"
+        if signal_addr is not None:
+            try:
+                conn = self.rt._peer_owner_conn(tuple(signal_addr))
+                conn.cast("cancel_direct", {"task_id": task_id})
+                return "signalled"
+            except (OSError, rpc.RpcError, rpc.ConnectionLost):
+                return None  # peer gone: head-side recovery owns it
+        return None
+
     def tick(self) -> None:
         timeout = GLOBAL_CONFIG.direct_resubmit_timeout_s
         now = time.monotonic()
         recover: list = []
+        shed: list = []
+        wall = time.time()
+        with self.lock:
+            # Overload plane: deadline-expired calls still parked in the
+            # owner-side direct queues are shed here (pop-time checks in
+            # _drain_route cover the hot path; this sweep catches calls
+            # a full window keeps parked).
+            for r in self.routes.values():
+                if not r.pending:
+                    continue
+                expired = [s for s in r.pending
+                           if s.deadline and wall > s.deadline]
+                for s in expired:
+                    r.pending.remove(s)
+                    r.tasks.pop(s.task_id, None)
+                    self.stats["shed_owner_queue"] += 1
+                    shed.append(s)
+        for s in shed:
+            self._seal_shed(s)
         with self.lock:
             for r in self.routes.values():
                 pending_ids = {s.task_id for s in r.pending}

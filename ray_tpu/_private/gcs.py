@@ -373,7 +373,31 @@ class Head:
         # Core runtime counters (reference: DEFINE_stats core metric set,
         # src/ray/stats/metric_defs.h:46 — `tasks`, `actors`, …); gauges
         # are derived from the live tables at scrape time.
-        self.stats = {"tasks_finished": 0, "tasks_failed": 0}
+        self.stats = {"tasks_finished": 0, "tasks_failed": 0,
+                      "admission_rejected": 0}
+        # --- overload-protection plane ---
+        # Admission budgets: queued-but-not-executing tasks per owner
+        # and cluster-wide, maintained via spec._queued transitions
+        # (enqueue +1, dispatch/failure -1) so the gate in the submit
+        # handlers is O(1) under flood.
+        self.pending_by_owner: dict[str, int] = {}
+        self.pending_total = 0
+        # Deadline sheds by hop ({where: count} →
+        # ray_tpu_tasks_shed_total{where=...}).
+        self.shed_counts: dict[str, int] = {}
+        # In-flight tasks already sent a deadline cancel cast (dedup so
+        # the health sweep doesn't re-signal every tick).
+        self._expiry_signalled: set[str] = set()
+        # Memory-aware backpressure: node_id -> {"used", "total", "ts",
+        # "remote"}; pressured nodes receive no placements or lease
+        # grants until recovery. Remote entries expire if the agent's
+        # refresh casts stop (self-healing against a lost recovery
+        # cast); the head node's own entry is managed by its
+        # MemoryMonitor in-process.
+        self.pressured_nodes: dict[str, dict] = {}
+        # Cheap skip for the health loop's expiry sweeps: False until
+        # the first deadline-stamped submission arrives.
+        self._any_deadlines = False
         self.node_agents: dict[str, rpc.Connection] = {}  # node_id -> agent conn
         self.node_transfer_addrs: dict[str, tuple] = {}  # node_id -> (ip, port)
         # Liveness beyond the TCP session (reference: GCS health checks,
@@ -560,6 +584,8 @@ class Head:
                 self,
                 threshold=config.memory_usage_threshold,
                 interval_s=config.memory_monitor_interval_s,
+                soft_threshold=config.memory_pressure_threshold,
+                hysteresis=config.memory_pressure_hysteresis,
             )
             self.memory_monitor.start()
 
@@ -1000,6 +1026,7 @@ class Head:
     def _health_check_once(self) -> None:
         now = time.time()
         grace = self.config.health_check_timeout_s
+        self._overload_sweep(now)
         with self.lock:
             silent = [
                 (nid, self.node_agents.get(nid))
@@ -1047,6 +1074,89 @@ class Head:
                     f"{self.config.worker_register_timeout_s:.0f}s "
                     f"(lost spawn cast or interpreter crash at boot)")
             self._handle_worker_death(rec)
+
+    def _overload_sweep(self, now: float) -> None:
+        """Overload-protection housekeeping, once per health tick:
+        (1) expire stale REMOTE pressure entries whose agent stopped
+        refreshing (a lost recovery cast must not wedge a node out of
+        the scheduler forever); (2) shed deadline-expired tasks still
+        parked in queues the pop-time checks haven't visited (dep-
+        blocked, unplaceable ready queues, dep-parked actor calls);
+        (3) signal in-flight expiry to workers via the existing cancel
+        cast (queued-not-started work drops at pickup)."""
+        cancel_casts: list = []
+        stale_after = max(5.0, 3.0 * self.config.memory_monitor_interval_s)
+        with self.lock:
+            for nid, info in list(self.pressured_nodes.items()):
+                if info.get("remote") and now - info.get("ts", 0) > stale_after:
+                    self.pressured_nodes.pop(nid, None)
+                    self.task_events.append({
+                        "event": "overload", "kind": "mem_recovered",
+                        "node_id": nid, "stale": True, "ts": now})
+                    self.dispatch_event.set()
+            if not self._any_deadlines:
+                return
+            saw_deadline = False
+            # Ready queues (incl. the scan queue): tasks a full cluster
+            # keeps parked still expire on time.
+            for key in list(self.ready_queues):
+                q = self.ready_queues.get(key)
+                if q is None:
+                    continue
+                expired = [s for s in q if self._expired(s, now)]
+                saw_deadline = saw_deadline or any(s.deadline for s in q)
+                for s in expired:
+                    q.remove(s)
+                    self._shed_expired(s, "head_queue")
+                if not q:
+                    self.ready_queues.pop(key, None)
+            # Dep-blocked tasks register under EVERY unready dep: drop
+            # expired specs from all lists before sealing (dedup by id).
+            doomed: dict[str, TaskSpec] = {}
+            for specs in self.dep_blocked.values():
+                for s in specs:
+                    if self._expired(s, now):
+                        doomed[s.task_id] = s
+                    elif s.deadline:
+                        saw_deadline = True
+            for s in doomed.values():
+                for oid, lst in list(self.dep_blocked.items()):
+                    if s in lst:
+                        lst.remove(s)
+                        if not lst:
+                            del self.dep_blocked[oid]
+                self._shed_expired(s, "dep_blocked")
+            # Dep-parked / not-yet-alive actor calls.
+            for actor in self.actors.values():
+                expired = [s for s in actor.pending
+                           if self._expired(s, now)]
+                saw_deadline = saw_deadline or any(
+                    s.deadline for s in actor.pending)
+                for s in expired:
+                    actor.pending.remove(s)
+                    self._shed_expired(s, "actor_queue")
+            # In-flight expiry: reuse the existing cancel cast — the
+            # worker drops a queued-not-started task at pickup with the
+            # worker_queue shed path; running tasks are not interrupted
+            # (same contract as ray_tpu.cancel).
+            for rec in self.workers.values():
+                for spec in list(rec.inflight.values()):
+                    if spec.deadline:
+                        saw_deadline = True
+                    if (self._expired(spec, now)
+                            and spec.task_id not in self._expiry_signalled
+                            and rec.conn is not None):
+                        self._expiry_signalled.add(spec.task_id)
+                        cancel_casts.append((rec.conn, spec.task_id))
+            if len(self._expiry_signalled) > 65536:
+                self._expiry_signalled.clear()  # bound (re-signal is ok)
+            if not saw_deadline and not cancel_casts:
+                self._any_deadlines = False
+        for conn, task_id in cancel_casts:
+            try:
+                conn.cast("cancel", {"task_id": task_id})
+            except rpc.ConnectionLost:
+                pass
 
     # --- registration ---
 
@@ -1112,6 +1222,54 @@ class Head:
                 body.get("total_bytes", 0),
             )
         return None
+
+    def _h_mem_pressure(self, body: dict, conn: rpc.Connection):
+        """A node agent crossed (or recovered from) the soft memory
+        watermark: flip its pressure state. Agents re-cast every monitor
+        tick while pressured, so the entry's ts stays fresh and the
+        health loop can expire entries whose agent went silent."""
+        self.set_node_pressure(
+            body["node_id"], bool(body.get("pressured")),
+            body.get("used_bytes", 0), body.get("total_bytes", 0),
+            remote=True)
+        return None
+
+    def set_node_pressure(self, node_id: str, pressured: bool,
+                          used: int = 0, total: int = 0,
+                          remote: bool = False) -> None:
+        """Memory-aware backpressure switch for one node (overload
+        plane): while pressured, the node receives no new placements or
+        lease grants, and its existing idle leases are revoked so owners
+        stop pushing to it. Recovery re-wakes the dispatcher."""
+        with self.lock:
+            was = node_id in self.pressured_nodes
+            if pressured:
+                self.pressured_nodes[node_id] = {
+                    "used": used, "total": total, "ts": time.time(),
+                    "remote": remote}
+            else:
+                self.pressured_nodes.pop(node_id, None)
+            if was == pressured:
+                return
+            if pressured:
+                # Owners holding leases here must stop pushing NOW —
+                # revoke them; in-flight work drains, new work re-routes
+                # through the head, which won't place here either.
+                for rec in self.workers.values():
+                    if rec.node_id == node_id and rec.leased_to is not None:
+                        self._end_lease(rec, revoke=True)
+            self.task_events.append({
+                "event": "overload",
+                "kind": "mem_pressure" if pressured else "mem_recovered",
+                "node_id": node_id,
+                "used_bytes": used, "total_bytes": total,
+                "ts": time.time(),
+            })
+        print(f"ray_tpu head: node {node_id} "
+              f"{'PRESSURED' if pressured else 'recovered'} "
+              f"(mem {used}/{total})", file=sys.stderr)
+        if not pressured:
+            self.dispatch_event.set()
 
     def _h_register_node(self, body: dict, conn: rpc.Connection):
         """A node agent joins the cluster (reference: raylet registration
@@ -1886,6 +2044,10 @@ class Head:
                 tuple(k) if isinstance(k, list) else k
                 for k in body["lease_key"])
         with self.lock:
+            if not self._admission_check(spec, conn):
+                return None  # typed rejection sealed + backpressure cast
+            if spec.deadline:
+                self._any_deadlines = True
             for oid in spec.return_ids:
                 entry = self.objects.get(oid) or ObjectEntry(oid, spec.owner_id)
                 entry.refcount = max(entry.refcount, 1)
@@ -1904,7 +2066,11 @@ class Head:
                 "worker_id": None,
                 "resources": dict(spec.resources or {}),
             }
-            if spec.actor_id is not None:
+            if self._expired(spec):
+                # Dead on arrival (owner queued it past its deadline, or
+                # the submit itself sat in a flooded socket): shed now.
+                self._shed_expired(spec, "submit")
+            elif spec.actor_id is not None:
                 self._enqueue_actor_task(spec)
             else:
                 self._enqueue_task_spec(spec)
@@ -1947,9 +2113,115 @@ class Head:
                 self._env_key(spec.runtime_env))
         return ("shape", rkey)
 
+    # --- overload-protection plane: pending budgets + deadline sheds --
+
+    def _pending_inc(self, spec: TaskSpec) -> None:
+        """lock held. Count a spec entering a head queue (ready/dep/
+        actor). Guarded by spec._queued so re-enqueues are idempotent."""
+        if spec._queued:
+            return
+        spec._queued = True
+        self.pending_total += 1
+        self.pending_by_owner[spec.owner_id] = (
+            self.pending_by_owner.get(spec.owner_id, 0) + 1)
+
+    def _pending_dec(self, spec: TaskSpec) -> None:
+        """lock held. A spec left the queued state (dispatched or
+        failed)."""
+        if not spec._queued:
+            return
+        spec._queued = None
+        self.pending_total = max(0, self.pending_total - 1)
+        n = self.pending_by_owner.get(spec.owner_id, 0) - 1
+        if n <= 0:
+            self.pending_by_owner.pop(spec.owner_id, None)
+        else:
+            self.pending_by_owner[spec.owner_id] = n
+
+    def _admission_check(self, spec: TaskSpec, conn) -> bool:
+        """lock held. Head-side admission gate (the authoritative
+        backstop behind the owner runtime's own blocking gate): False =
+        REJECT — the return ids get a typed PendingCallsLimitError seal
+        and the owner a backpressure cast. Fairness is per-owner: the
+        per-owner budget trips first for a hot client, and when the
+        GLOBAL budget trips, owners still under their fair share keep
+        submitting (the hot owner is the one rejected)."""
+        if spec.actor_creation:
+            return True  # creations are cluster setup, never load
+        cfg = self.config
+        per_owner = int(cfg.admission_max_pending_per_owner)
+        total = int(cfg.admission_max_pending_total)
+        mine = self.pending_by_owner.get(spec.owner_id, 0)
+        over = None
+        if per_owner > 0 and mine >= per_owner:
+            over = ("owner", mine, per_owner)
+        elif total > 0 and self.pending_total >= total:
+            fair = max(1, total // max(1, len(self.pending_by_owner) or 1))
+            if mine >= fair:
+                over = ("global", self.pending_total, total)
+        if over is None:
+            return True
+        scope, n, limit = over
+        self.stats["admission_rejected"] += 1
+        msg = (f"PendingCallsLimitError: submission of {spec.name} "
+               f"rejected by admission control: {scope} pending budget "
+               f"exhausted ({n}/{limit})")
+        t = self.tasks.get(spec.task_id)
+        if t is None:
+            self.tasks[spec.task_id] = t = {
+                "task_id": spec.task_id, "name": spec.name,
+                "state": FAILED, "type": ("ACTOR_TASK" if spec.actor_id
+                                          else "NORMAL_TASK"),
+                "submitted_at": time.time(), "node_id": None,
+                "worker_id": None}
+        t["state"] = FAILED
+        t["error"] = msg
+        t["finished_at"] = time.time()
+        self._record_finished(spec.task_id)
+        for oid in spec.return_ids:
+            entry = self.objects.get(oid) or ObjectEntry(oid, spec.owner_id)
+            entry.refcount = max(entry.refcount, 1)
+            self.objects[oid] = entry
+            self._seal_error(oid, msg, kind="pending_calls_limit")
+        self.task_events.append({
+            "event": "overload", "kind": "admission_reject",
+            "task_id": spec.task_id, "owner_id": spec.owner_id,
+            "scope": scope, "pending": n, "limit": limit,
+            "ts": time.time()})
+        # Typed backpressure signal: the owner runtime turns this into
+        # blocking-submit (default) or fast-fail for subsequent calls.
+        oconn = self.clients.get(spec.owner_id) or conn
+        if oconn is not None:
+            try:
+                oconn.cast_buffered("backpressure", {
+                    "scope": scope, "pending": n, "limit": limit,
+                    "retry_after_s": 1.0})
+            except rpc.ConnectionLost:
+                pass
+        return False
+
+    def _shed_expired(self, spec: TaskSpec, where: str) -> None:
+        """lock held. A deadline-expired task leaves the system with a
+        typed TaskTimeoutError seal instead of burning capacity."""
+        self.shed_counts[where] = self.shed_counts.get(where, 0) + 1
+        self.task_events.append({
+            "event": "overload", "kind": "shed", "where": where,
+            "task_id": spec.task_id, "name": spec.name,
+            "owner_id": spec.owner_id, "ts": time.time()})
+        self._fail_task(
+            spec,
+            f"TaskTimeoutError: task {spec.name} exceeded its deadline "
+            f"while queued ({where}); shed before execution",
+            kind="task_timeout")
+
+    @staticmethod
+    def _expired(spec: TaskSpec, now: "float | None" = None) -> bool:
+        return bool(spec.deadline) and (now or time.time()) > spec.deadline
+
     def _enqueue_task_spec(self, spec: TaskSpec, front: bool = False) -> None:
         """lock held. Route a normal task to the dependency index (any
         unready arg) or its ready queue."""
+        self._pending_inc(spec)
         # Deduped: f.remote(x, x) lists the dep twice, but the spec must
         # register under each distinct object exactly once or the seal
         # wake-up would enqueue (and execute) the task twice.
@@ -2143,6 +2415,17 @@ class Head:
                 self._pending_owner_seals[oid] = worker_id
                 self._worker_pending_seals.setdefault(
                     worker_id, set()).add(oid)
+        if body.get("shed"):
+            # Worker-side deadline shed (executor-queue hop): attribute
+            # it in the same counter family as the head's own sheds.
+            where = str(body["shed"])
+            self.shed_counts[where] = self.shed_counts.get(where, 0) + 1
+            self.task_events.append({
+                "event": "overload", "kind": "shed", "where": where,
+                "task_id": body.get("task_id"), "worker_id": worker_id,
+                "ts": time.time()})
+        if body.get("task_id"):
+            self._expiry_signalled.discard(body["task_id"])
         spec = rec.inflight.pop(body.get("task_id", ""), None)
         if spec is None and body.get("task_id"):
             # Direct-plane race: the completion beat the owner's batched
@@ -2280,6 +2563,10 @@ class Head:
         spec: TaskSpec = spec_from_body(body)
         self._adopt_evt(spec, body)
         with self.lock:
+            if not self._admission_check(spec, conn):
+                return None  # typed rejection sealed + backpressure cast
+            if spec.deadline:
+                self._any_deadlines = True
             for oid in spec.return_ids:
                 entry = self.objects.get(oid) or ObjectEntry(oid, spec.owner_id)
                 entry.refcount = max(entry.refcount, 1)
@@ -2297,7 +2584,10 @@ class Head:
                 "node_id": None,
                 "worker_id": None,
             }
-            self._enqueue_actor_task(spec)
+            if self._expired(spec):
+                self._shed_expired(spec, "submit")
+            else:
+                self._enqueue_actor_task(spec)
         self.dispatch_event.set()
         return None
 
@@ -2507,6 +2797,7 @@ class Head:
                              if p.owner_id == spec.owner_id
                              and p.seq_no > spec.seq_no),
                             len(actor.pending))
+                        self._pending_inc(spec)
                         actor.pending.insert(idx, spec)
                         if actor.state == "ALIVE":
                             self._flush_actor(actor)
@@ -2522,7 +2813,11 @@ class Head:
         on a leasable worker: hand the owner a time/count-bounded direct
         route (reference: worker leases, normal_task_submitter.cc:29)."""
         if (rec.actor_id is not None or rec.tpu_capable or rec.retiring
-                or rec.leased_to is not None or rec.conn is None):
+                or rec.leased_to is not None or rec.conn is None
+                # Memory-aware backpressure: pressured nodes grant no
+                # leases — a lease is a standing invitation to push
+                # work at a node that must shed load instead.
+                or rec.node_id in self.pressured_nodes):
             return
         # Only a worker whose sole inflight task is the one that carried
         # the request is leasable: granting on a worker mid-way through
@@ -2618,6 +2913,7 @@ class Head:
                 kind="actor_died",
             )
             return
+        self._pending_inc(spec)
         actor.pending.append(spec)
         if actor.state == "ALIVE":
             self._flush_actor(actor)
@@ -2639,7 +2935,9 @@ class Head:
             parked: deque[TaskSpec] = deque()
             while actor.pending:
                 spec = actor.pending.popleft()
-                if all(self._is_ready(d) for d in spec.deps):
+                if self._expired(spec):
+                    self._shed_expired(spec, "actor_queue")
+                elif all(self._is_ready(d) for d in spec.deps):
                     self._push_to_worker(rec, spec)
                 else:
                     parked.append(spec)
@@ -2650,6 +2948,12 @@ class Head:
         # per-handle ordering, reference: sequential_actor_submit_queue.h).
         while actor.pending:
             spec = actor.pending[0]
+            if self._expired(spec):
+                # Expired calls shed in order (a typed error IS the
+                # call's outcome, so ordering is preserved).
+                actor.pending.popleft()
+                self._shed_expired(spec, "actor_queue")
+                continue
             if not all(self._is_ready(d) for d in spec.deps):
                 break
             actor.pending.popleft()
@@ -3301,6 +3605,10 @@ class Head:
             # the flood envelope at a few hundred tasks/s.
             spawned = False
             no_worker: set = set()
+            # Memory-aware backpressure: pressured nodes receive no new
+            # placements this pass (recovery re-wakes the dispatcher).
+            pressured = (frozenset(self.pressured_nodes)
+                         if self.pressured_nodes else None)
             for key in [k for k in self.ready_queues if k != _SCAN_KEY]:
                 q = self.ready_queues.get(key)
                 last_node = None  # same-shape node reuse within a pass
@@ -3312,6 +3620,13 @@ class Head:
                     # silently drop the NEXT queued task).
                     popped = False
                     try:
+                        if self._expired(spec):
+                            # Overload plane: expired work is shed at
+                            # the pop instead of burning a dispatch.
+                            q.popleft()
+                            popped = True
+                            self._shed_expired(spec, "head_queue")
+                            continue
                         # Deps were ready at enqueue; free/loss since is
                         # possible (and rare) — re-route to dep_blocked.
                         if spec.deps and not all(
@@ -3331,7 +3646,8 @@ class Head:
                         fresh_pick = last_node is None
                         node = last_node
                         if node is None:
-                            node = self.scheduler.pick_node(demand, None)
+                            node = self.scheduler.pick_node(
+                                demand, None, exclude=pressured)
                         if node is None:
                             # No free capacity anywhere — but the
                             # owner's own leases may HOLD it all: an
@@ -3352,7 +3668,7 @@ class Head:
                             # starves for the lease's remaining TTL.
                             if self._reclaim_idle_lease():
                                 node = self.scheduler.pick_node(
-                                    demand, None)
+                                    demand, None, exclude=pressured)
                             if node is None:
                                 break  # unplaceable until capacity frees
                         need_tpu = float(spec.resources.get("TPU", 0)) > 0
@@ -3467,6 +3783,9 @@ class Head:
             spec = queue.popleft()
             scanned += 1
             try:
+                if self._expired(spec):
+                    self._shed_expired(spec, "head_queue")
+                    continue
                 if not self._validate_strategy(spec):
                     continue  # failed with an error object
                 if not all(self._is_ready(d) for d in spec.deps):
@@ -3481,12 +3800,16 @@ class Head:
                     demand = self._effective_demand(
                         spec.resources, spec.scheduling_strategy)
                     spec._demand = demand
-                node = self.scheduler.pick_node(demand, strategy)
+                pressured = (frozenset(self.pressured_nodes)
+                             if self.pressured_nodes else None)
+                node = self.scheduler.pick_node(demand, strategy,
+                                                exclude=pressured)
                 if node is None and self._reclaim_idle_lease():
                     # Capacity may sit idle-pinned under a lease (PG
                     # demand is bundle-reserved and unaffected, but
                     # affinity/SPREAD tasks compete with leases).
-                    node = self.scheduler.pick_node(demand, strategy)
+                    node = self.scheduler.pick_node(demand, strategy,
+                                                    exclude=pressured)
                 if node is None:
                     # Not a budgeted miss: feasibility varies per task
                     # here, and counting currently-infeasible entries
@@ -3613,6 +3936,7 @@ class Head:
                 and rec.ready
                 and rec.actor_id is None
                 and not rec.retiring
+                and rec.node_id not in self.pressured_nodes
                 and rec.leased_to == owner_id
                 and rec.lease_key == key[1]
                 # IDLE leases only: parking a task on a leased worker
@@ -3672,6 +3996,8 @@ class Head:
         """lock held. A busy non-actor worker already holding an
         allocation for this resource shape whose inflight window has
         room. TPU tasks never pipeline (chip visibility is per-lease)."""
+        if node_id in self.pressured_nodes:
+            return None  # pressured: no new work, not even pipelined
         for rec in self.workers.values():
             if (
                 rec.node_id == node_id
@@ -3743,6 +4069,7 @@ class Head:
         the same worker into one CAST_BATCH frame; the pass flushes all
         touched connections after dropping the lock. Direct pushes
         (actor-call flush paths) stay immediate for latency."""
+        self._pending_dec(spec)
         rec.busy = True
         rec.inflight[spec.task_id] = spec
         t = self.tasks.get(spec.task_id)
@@ -3787,7 +4114,10 @@ class Head:
         if strategy is UNPLACEABLE:
             return
         demand = self._effective_demand(spec.resources, spec.scheduling_strategy)
-        node = self.scheduler.pick_node(demand, strategy)
+        node = self.scheduler.pick_node(
+            demand, strategy,
+            exclude=(frozenset(self.pressured_nodes)
+                     if self.pressured_nodes else None))
         if node is None:
             return
         need_tpu = float(spec.resources.get("TPU", 0)) > 0
@@ -4295,6 +4625,7 @@ class Head:
             # restarted incarnation replays the stream where it broke.
             for spec in sorted(retried, key=lambda s: s.seq_no,
                                reverse=True):
+                self._pending_inc(spec)
                 actor.pending.appendleft(spec)
         if will_restart:
             actor.restarts += 1
@@ -4339,6 +4670,8 @@ class Head:
                                if a.state == "ALIVE")
             rpc = {cid: dict(r.get("counters") or {})
                    for cid, r in self.rpc_reports.items()}
+            from ray_tpu._private.retry import breaker_snapshot
+
             return {
                 "counters": dict(self.stats),
                 "gauges": {
@@ -4349,7 +4682,21 @@ class Head:
                     "nodes_alive": 1 + len(self.node_agents),
                     "tasks_pending": sum(len(q) for q in
                                          self.ready_queues.values()),
+                    # Overload-protection plane gauges.
+                    "admission_pending_total": self.pending_total,
+                    "admission_pending_owners": len(self.pending_by_owner),
+                    "mem_pressured_nodes": len(self.pressured_nodes),
                 },
+                # Deadline sheds by hop
+                # (ray_tpu_tasks_shed_total{where=...}).
+                "tasks_shed": dict(self.shed_counts),
+                # Memory-pressure state per node (operator view).
+                "pressured_nodes": {
+                    nid: {k: info.get(k) for k in ("used", "total", "ts")}
+                    for nid, info in self.pressured_nodes.items()},
+                # Unified retry plane: the head process's own breakers;
+                # each client's ride inside rpc.clients[*].breakers.
+                "breakers": breaker_snapshot(),
                 # Phase-latency histograms (queue wait / dispatch / exec
                 # / result transfer) from the flight-recorder plane.
                 "histograms": self.task_events.hist_snapshot(),
@@ -4382,6 +4729,8 @@ class Head:
 
     def _fail_task(self, spec: TaskSpec, message: str, kind: str = "task_error") -> None:
         """lock held. Seal each return id with an error payload."""
+        self._pending_dec(spec)
+        self._expiry_signalled.discard(spec.task_id)
         t = self.tasks.get(spec.task_id)
         if t:
             t["state"] = FAILED

@@ -52,6 +52,44 @@ def system_memory_usage() -> tuple[int, int]:
     return used, total
 
 
+class PressureGauge:
+    """Cheap cached answer to "is THIS host past the soft memory
+    watermark?" — one /proc/meminfo read per check interval, with
+    hysteresis so the state doesn't flap at the boundary. Workers use
+    it to bounce direct pushes (direct_rej) while pressured; recomputed
+    lazily on access, so idle processes never poll."""
+
+    def __init__(self, usage_fn: Callable[[], tuple[int, int]] | None = None):
+        from ray_tpu._private.config import GLOBAL_CONFIG as _cfg
+
+        self._usage_fn = usage_fn or system_memory_usage
+        self._soft = float(_cfg.memory_pressure_threshold)
+        self._hyst = float(_cfg.memory_pressure_hysteresis)
+        self._interval = max(0.2, float(_cfg.memory_monitor_interval_s))
+        self._enabled = (_cfg.memory_monitor_enabled and self._soft > 0
+                         and self._soft < 1.0)
+        self._last_check = 0.0
+        self._pressured = False
+
+    def pressured(self) -> bool:
+        if not self._enabled:
+            return False
+        now = time.monotonic()
+        if now - self._last_check >= self._interval:
+            self._last_check = now
+            try:
+                used, total = self._usage_fn()
+            except Exception:
+                return self._pressured
+            if total > 0:
+                ratio = used / total
+                if self._pressured:
+                    self._pressured = ratio >= self._soft - self._hyst
+                else:
+                    self._pressured = ratio >= self._soft
+        return self._pressured
+
+
 class MemoryMonitor:
     def __init__(
         self,
@@ -60,6 +98,8 @@ class MemoryMonitor:
         interval_s: float = 1.0,
         usage_fn: Callable[[], tuple[int, int]] | None = None,
         min_kill_interval_s: float = 2.0,
+        soft_threshold: float | None = None,
+        hysteresis: float = 0.03,
     ):
         self._head = head
         self._threshold = threshold
@@ -70,6 +110,14 @@ class MemoryMonitor:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self.num_kills = 0
+        # Soft watermark BELOW the kill threshold (overload-protection
+        # plane): past it the head node is marked "pressured" — no new
+        # placements or lease grants land on it — long before the
+        # reactive SIGKILL defense has to fire. Disabled when >= the
+        # kill threshold.
+        self._soft = soft_threshold
+        self._hysteresis = hysteresis
+        self._soft_pressured = False
 
     def start(self) -> None:
         self._thread = threading.Thread(
@@ -90,7 +138,25 @@ class MemoryMonitor:
     def tick(self) -> bool:
         """One poll of the HEAD host; returns True if a worker was killed."""
         used, total = self._usage_fn()
-        if total <= 0 or used / total < self._threshold:
+        if total <= 0:
+            return False
+        ratio = used / total
+        # Soft watermark first: backpressure (stop placements and lease
+        # grants, bounce direct pushes) kicks in well below the kill
+        # threshold, so graceful degradation gets a chance to work
+        # before the reactive SIGKILL defense.
+        soft = self._soft
+        if soft is not None and 0 < soft < self._threshold:
+            if not self._soft_pressured and ratio >= soft:
+                self._soft_pressured = True
+                self._head.set_node_pressure(
+                    self._head.node_id, True, used, total)
+            elif (self._soft_pressured
+                  and ratio < soft - self._hysteresis):
+                self._soft_pressured = False
+                self._head.set_node_pressure(
+                    self._head.node_id, False, used, total)
+        if ratio < self._threshold:
             return False
         return self.kill_on_node(self._head.node_id, used, total)
 

@@ -370,10 +370,36 @@ class NodeAgent:
         cfg = GLOBAL_CONFIG
         if not cfg.memory_monitor_enabled or cfg.memory_usage_threshold >= 1.0:
             return
+        soft = float(cfg.memory_pressure_threshold)
+        soft_on = 0 < soft < cfg.memory_usage_threshold
+        pressured = False
         while not self._exit.wait(cfg.memory_monitor_interval_s):
             try:
                 used, total = system_memory_usage()
-                if total > 0 and used / total >= cfg.memory_usage_threshold:
+                if total <= 0:
+                    continue
+                ratio = used / total
+                # Soft watermark (overload plane): while this node is
+                # past it, the head stops placing work and granting
+                # leases here. Re-cast every tick while pressured — the
+                # head expires stale pressure entries, so a lost
+                # recovery cast can never wedge the node out of the
+                # scheduler forever.
+                if soft_on:
+                    was = pressured
+                    if pressured:
+                        pressured = (ratio
+                                     >= soft - cfg.memory_pressure_hysteresis)
+                    else:
+                        pressured = ratio >= soft
+                    if pressured or was:
+                        self.conn.cast("mem_pressure", {
+                            "node_id": self.node_id,
+                            "pressured": pressured,
+                            "used_bytes": used,
+                            "total_bytes": total,
+                        })
+                if ratio >= cfg.memory_usage_threshold:
                     self.conn.cast("oom_pressure", {
                         "node_id": self.node_id,
                         "used_bytes": used,

@@ -46,6 +46,10 @@ class CircuitBreaker:
         self._opened_at: float | None = None
         self._probing = False
         self._lock = threading.Lock()
+        # Operator-facing history (breaker_snapshot / `ray-tpu health`):
+        # how often this target tripped and when it last did (epoch).
+        self.trip_count = 0
+        self.last_trip_at: float | None = None
 
     def allow(self) -> bool:
         """True when a call may proceed (closed, or the one half-open
@@ -71,6 +75,9 @@ class CircuitBreaker:
             self._failures += 1
             self._probing = False
             if self._failures >= self.threshold:
+                if self._opened_at is None:
+                    self.trip_count += 1
+                    self.last_trip_at = time.time()
                 self._opened_at = time.monotonic()
 
     @property
@@ -100,6 +107,32 @@ def breaker_for(key: str, threshold: int | None = None,
                 name=key,
             )
         return b
+
+
+def breaker_snapshot() -> dict:
+    """Operator view of this process's per-target circuit breakers:
+    {target: {open, failures, trip_count, last_trip_at, open_age_s,
+    threshold, reset_s}}. Rides rpc_report snapshots head-ward so
+    runtime_stats / `ray-tpu health` can show WHY traffic to a peer is
+    being shed (satellite of the overload-protection plane)."""
+    with _breakers_lock:
+        breakers = list(_breakers.items())
+    out = {}
+    for key, b in breakers:
+        with b._lock:
+            open_now = (b._opened_at is not None
+                        and time.monotonic() - b._opened_at < b.reset_s)
+            out[key] = {
+                "open": open_now,
+                "failures": b._failures,
+                "trip_count": b.trip_count,
+                "last_trip_at": b.last_trip_at,
+                "open_age_s": (round(time.monotonic() - b._opened_at, 3)
+                               if b._opened_at is not None else None),
+                "threshold": b.threshold,
+                "reset_s": b.reset_s,
+            }
+    return out
 
 
 @dataclasses.dataclass

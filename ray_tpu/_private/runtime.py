@@ -32,8 +32,10 @@ from ray_tpu.exceptions import (
     ActorDiedError,
     GetTimeoutError,
     ObjectLostError,
+    PendingCallsLimitError,
     RayTpuError,
     TaskError,
+    TaskTimeoutError,
     WorkerCrashedError,
 )
 
@@ -42,6 +44,8 @@ _ERROR_KINDS = {
     "actor_died": ActorDiedError,
     "task_error": RayTpuError,
     "object_lost": ObjectLostError,
+    "task_timeout": TaskTimeoutError,
+    "pending_calls_limit": PendingCallsLimitError,
 }
 
 
@@ -115,6 +119,13 @@ class CoreRuntime:
         # Worker-installed hook invoked before a blocking get/wait (the
         # pipelined-task deadlock escape — see Worker._on_will_block).
         self._pre_block = None
+        # Overload protection: head-signalled backpressure horizon
+        # (monotonic). While in the future, submits block (default) or
+        # fast-fail per admission_mode. Set by "backpressure" casts.
+        self._backpressure_until = 0.0
+        # Worker-installed hook for direct-plane cancellation pushed
+        # over a peer connection ("cancel_direct").
+        self._peer_cancel_handler = None
         self._message_handler = message_handler
         self._closed = False
         self.client_type = client_type
@@ -272,9 +283,16 @@ class CoreRuntime:
         with self._owner_conns_lock:
             peers = {f"{a[0]}:{a[1]}": _conn(c)
                      for a, c in self._owner_conns.items()}
+        from ray_tpu._private.retry import breaker_snapshot
+
         return {"head": _conn(self.conn), "peers": peers,
                 "direct": (self._direct.snapshot()
-                           if self._direct is not None else {})}
+                           if self._direct is not None else {}),
+                # Unified retry plane: this process's per-target circuit
+                # breakers (open/closed, consecutive failures, trip
+                # times) — surfaced cluster-wide via rpc_report so
+                # operators can see WHY traffic to a peer is shed.
+                "breakers": breaker_snapshot()}
 
     def report_rpc_now(self) -> None:
         """Ship this process's counter snapshot (plus buffered chaos
@@ -322,6 +340,16 @@ class CoreRuntime:
                         self.conn.cast("read_done", {"ids": stale})
                     except rpc.ConnectionLost:
                         pass
+            return None
+        if kind == "backpressure":
+            # Typed admission-control signal: the head shed (or is about
+            # to shed) this owner's submissions. Blocking-submit parks
+            # new submits until the horizon passes; fast-fail mode makes
+            # them raise PendingCallsLimitError immediately.
+            delay = max(0.05, float(body.get("retry_after_s", 1.0)))
+            with self._owned_cond:
+                self._backpressure_until = max(
+                    self._backpressure_until, time.monotonic() + delay)
             return None
         if (self._direct is not None
                 and kind in ("actor_direct_grant", "actor_direct_revoke",
@@ -563,6 +591,14 @@ class CoreRuntime:
                 raise rpc.RpcError(
                     f"object {body['object_id']} not in owner store")
             return {"payload": v[0], "is_error": v[1]}
+        if kind == "cancel_direct":
+            # Direct-plane cancellation: the owner cancels a task it
+            # pushed straight to this worker (queued in the executor,
+            # not yet running). No-op on non-executing runtimes.
+            h = self._peer_cancel_handler
+            if h is not None:
+                h(body)
+            return None
         if kind == "whoami":
             # Peer identity check: a mis-advertised owner address (e.g.
             # loopback seen from another host) must not silently swallow
@@ -579,6 +615,22 @@ class CoreRuntime:
         OWNER confirms holding the bytes, so 'head says sealed' always
         implies the value is fetchable. notify=False for seals PUSHED BY
         the head itself (error seals — it already knows)."""
+        direct_oids: "frozenset | tuple" = ()
+        if self._direct is not None:
+            # Snapshot which of these ids were direct-dispatched BEFORE
+            # the resolution hook pops their tracking entries.
+            oids = [r["object_id"] for r in objs]
+            direct_oids = self._direct.known_direct_oids(oids)
+            # Direct-plane resolution hook: frees inflight-window slots,
+            # drains owner-side pending queues, clears drain barriers.
+            # BEFORE the store+notify below: a getter woken by this seal
+            # may submit its next call immediately, and that call must
+            # find the lease window slot already free — notify-first
+            # made a sync submit loop spill to the head on the race.
+            try:
+                self._direct.on_resolved(oids)
+            except Exception:
+                pass
         with self._owned_cond:
             for rec in objs:
                 oid = rec["object_id"]
@@ -595,18 +647,6 @@ class CoreRuntime:
                         rec["payload"], rec.get("is_error", False))
             if self._owned_waiters:
                 self._owned_cond.notify_all()
-        direct_oids: "frozenset | tuple" = ()
-        if self._direct is not None:
-            # Snapshot which of these ids were direct-dispatched BEFORE
-            # the resolution hook pops their tracking entries.
-            oids = [r["object_id"] for r in objs]
-            direct_oids = self._direct.known_direct_oids(oids)
-            # Direct-plane resolution hook: frees inflight-window slots,
-            # drains owner-side pending queues, clears drain barriers.
-            try:
-                self._direct.on_resolved(oids)
-            except Exception:
-                pass
         if not notify:
             return
         slim = [{"object_id": r["object_id"], "owner_id": self.client_id,
@@ -1605,6 +1645,79 @@ class CoreRuntime:
             for oid in spec.return_ids:
                 self._expected_owned.add(oid)
 
+    def seal_local_error(self, return_ids, message: str,
+                         kind: str = "task_error") -> None:
+        """Seal a typed error for owned return ids WITHOUT a round trip:
+        stored straight into the owner store (local gets resolve now)
+        and confirmed head-ward through the normal owner_sealed path so
+        cross-client waiters and the directory stay consistent. Used by
+        the owner-side overload plane (deadline sheds, direct-queue
+        cancellation) — the error exists before the head ever saw the
+        task."""
+        payload = serialization.dumps(
+            {"__rtpu_error__": kind, "message": message})
+        self._store_owned_and_notify(
+            [{"object_id": oid, "payload": payload, "is_error": True}
+             for oid in return_ids])
+
+    def admission_pending(self) -> int:
+        """Results this owner has submitted for but not yet received —
+        the owner-side half of the pending-task budget."""
+        return len(self._expected_owned)
+
+    def _admission_gate(self, spec: TaskSpec) -> None:
+        """Owner-side admission control, applied BEFORE a submission
+        leaves this process: past the per-owner pending budget (or
+        while the head signals backpressure), block until the backlog
+        drains (default) or raise PendingCallsLimitError
+        (admission_mode="fail"). The head enforces the same budgets as
+        the authoritative backstop; gating here turns its typed signal
+        into submit-side flow control instead of failed tasks."""
+        if self.owner_addr is None:
+            return  # no owner plane: the head's backstop gate governs
+        limit = int(GLOBAL_CONFIG.admission_max_pending_per_owner)
+        over = limit > 0 and len(self._expected_owned) >= limit
+        import time as _time
+
+        now = _time.monotonic()
+        pressured = now < self._backpressure_until
+        if not over and not pressured:
+            return
+        why = (f"owner pending budget exhausted "
+               f"({len(self._expected_owned)}/{limit} results outstanding)"
+               if over else "head signalled backpressure")
+        if GLOBAL_CONFIG.admission_mode == "fail":
+            raise PendingCallsLimitError(
+                f"submission of {spec.name} rejected: {why} "
+                f"(admission_mode=fail)")
+        # Blocking-submit: park until under the resume watermark (90% of
+        # the budget — resubmitting at exactly limit-1 would thrash) and
+        # past any backpressure horizon.
+        deadline = now + max(0.1, GLOBAL_CONFIG.admission_block_timeout_s)
+        resume = max(1, int(limit * 0.9)) if limit > 0 else 0
+        with self._owned_cond:
+            self._owned_waiters += 1
+            try:
+                while True:
+                    now = _time.monotonic()
+                    ok = limit <= 0 or len(self._expected_owned) < resume
+                    if ok and now >= self._backpressure_until:
+                        return
+                    if now >= deadline:
+                        raise PendingCallsLimitError(
+                            f"submission of {spec.name} still over budget "
+                            f"after blocking "
+                            f"{GLOBAL_CONFIG.admission_block_timeout_s:.0f}s"
+                            f": {why}")
+                    wait_s = min(0.25, deadline - now)
+                    if now < self._backpressure_until:
+                        wait_s = min(wait_s,
+                                     max(0.01,
+                                         self._backpressure_until - now))
+                    self._owned_cond.wait(wait_s)
+            finally:
+                self._owned_waiters -= 1
+
     def _spec_body(self, spec: TaskSpec) -> dict:
         """Compiled spec encoding when both ends support it
         (task_spec.pack_spec; negotiated at register)."""
@@ -1617,6 +1730,7 @@ class CoreRuntime:
         return {"spec": spec}
 
     def submit_task(self, spec: TaskSpec) -> None:
+        self._admission_gate(spec)
         # Results come straight back to this runtime's owner plane.
         spec.owner_addr = self.owner_addr
         self._register_expected(spec)
@@ -1651,6 +1765,7 @@ class CoreRuntime:
         self.conn.cast_buffered("submit_task", body)
 
     def submit_actor_task(self, spec: TaskSpec) -> None:
+        self._admission_gate(spec)
         spec.owner_addr = self.owner_addr
         self._register_expected(spec)
         if GLOBAL_CONFIG.task_events_enabled:

@@ -243,12 +243,20 @@ class ClusterScheduler:
 
     # --- selection ---
 
-    def pick_node(self, demand: ResourceSet, strategy=None) -> NodeEntry | None:
+    def pick_node(self, demand: ResourceSet, strategy=None,
+                  exclude=None) -> NodeEntry | None:
+        """``exclude``: node ids that must not receive placements right
+        now (memory-pressured nodes, overload-protection plane). Hard
+        affinity to an excluded node waits rather than mis-placing."""
         nodes = self.alive_nodes()
+        if exclude:
+            nodes = [n for n in nodes if n.node_id not in exclude]
         if not nodes:
             return None
         if isinstance(strategy, NodeAffinitySchedulingStrategy):
             node = self.nodes.get(strategy.node_id)
+            if exclude and strategy.node_id in exclude:
+                node = None
             if node is not None and node.alive and node.available.fits(demand):
                 return node
             if not strategy.soft:
@@ -271,7 +279,9 @@ class ClusterScheduler:
                                                  n.node_id))
             return min(pool, key=lambda n: (_round4(n.utilization()),
                                             n.node_id))
-        if self._native is not None:
+        if self._native is not None and not exclude:
+            # The C++ core has no exclusion filter; pressured-node
+            # passes take the (rare) Python path below instead.
             picked = self._native.pick_node(
                 demand.to_dict(), spread=strategy == "SPREAD"
             )

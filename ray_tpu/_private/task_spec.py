@@ -56,6 +56,13 @@ class TaskSpec:
     # calls of this function; the standard lever against native-memory
     # leaks/fragmentation, e.g. XLA device allocator churn). 0 = never.
     max_calls: int = 0
+    # Overload-protection deadline (epoch seconds; 0 = none), stamped at
+    # submit from .options(timeout_s=...) / task_timeout_s_default.
+    # Checked at every queue hop (owner direct queues, head ready/dep/
+    # actor queues, worker executor queue): expired work is shed with a
+    # TaskTimeoutError error-seal instead of executing. Rides the spec
+    # itself, so it crosses every dispatch path with zero extra frames.
+    deadline: float = 0.0
     # Scratch attributes the head/worker hang off a spec in flight —
     # declared because the dataclass uses __slots__ (a 1M-task backlog
     # at ~1 KB/dict-backed spec would cost a GB of pure dict overhead;
@@ -75,8 +82,14 @@ class TaskSpec:
     #                     carrying message's "evt" field instead of the
     #                     spec pickle, so disabled-events payloads are
     #                     byte-identical to the pre-tracing wire format
+    #   _queued         — head-side: this spec is counted in the
+    #                     admission plane's pending budgets (set on
+    #                     enqueue, cleared on dispatch/failure) so
+    #                     re-enqueues and double-fails never skew the
+    #                     per-owner/global counters
     _rkey: Any = dataclasses.field(default=None, repr=False)
     _demand: Any = dataclasses.field(default=None, repr=False)
+    _queued: Any = dataclasses.field(default=None, repr=False)
     _deps_pending: Any = dataclasses.field(default=None, repr=False)
     _deferred_results: Any = dataclasses.field(default=None, repr=False)
     _remote_markers: Any = dataclasses.field(default=None, repr=False)
@@ -98,7 +111,7 @@ class TaskSpec:
 
     _SCRATCH = ("_rkey", "_demand", "_deps_pending", "_deferred_results",
                 "_remote_markers", "_packed_bin", "_lease_key", "_direct",
-                "_evt", "_cpu_time")
+                "_evt", "_cpu_time", "_queued")
 
     def __getstate__(self):
         """Strip scratch slots (dispatch caches, the packed-bytes
@@ -224,7 +237,12 @@ def pack_spec(spec: "TaskSpec") -> "bytes | None":
             spec.seq_no, spec.concurrency_group,
             list(spec.borrowed_ids or ()),
             spec.max_calls,
-        ))
+            # Optional trailing fields (the codec is length-prefixed and
+            # unpack maps positionally onto the dataclass, so omitting
+            # them keeps deadline-free payloads byte-identical to the
+            # pre-overload-plane wire format):
+            #   22. deadline — overload-protection expiry stamp
+        ) + ((spec.deadline,) if spec.deadline else ()))
     except (TypeError, ValueError, OverflowError):
         return None  # exotic field value: pickle fallback
 

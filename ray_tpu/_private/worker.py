@@ -144,6 +144,18 @@ class Worker:
         # Accept direct submissions on the runtime's peer server (the
         # same socket owners fetch objects from).
         self.runtime._peer_task_handler = self._on_direct_push
+        # Direct-plane cancellation (owner→worker "cancel_direct" over
+        # the same peer conn): queued-but-not-started tasks are dropped
+        # at pickup, exactly like the head's cancel cast.
+        self.runtime._peer_cancel_handler = (
+            lambda body: self._cancelled_ids.add(body["task_id"]))
+        # Overload plane: cached host-memory soft-watermark gauge —
+        # while this node is pressured, direct pushes bounce (direct_rej
+        # → head path) so owners stop deepening queues on a node the
+        # memory monitor is about to defend by killing.
+        from ray_tpu._private.memory_monitor import PressureGauge
+
+        self._pressure = PressureGauge()
         # The runtime's adaptive release loop also drains stale seal
         # batches (a burst buffered before a long task must not wait
         # for the task to end).
@@ -289,7 +301,12 @@ class Worker:
                 # the owner cannot see (lease window accounting only
                 # covers the owner's OWN direct pushes) — bounce it so
                 # the head dispatches it on a genuinely idle worker.
-                or (spec.actor_id is None and self._head_busy > 0)):
+                or (spec.actor_id is None and self._head_busy > 0)
+                # Memory-aware backpressure: past the soft watermark
+                # this node must shed load, not accumulate it — the
+                # bounce re-routes through the head, which stopped
+                # placing onto pressured nodes.
+                or (spec.actor_id is None and self._pressure.pressured())):
             try:
                 conn.cast_buffered("direct_rej", {"task_id": spec.task_id})
             except Exception:
@@ -627,9 +644,23 @@ class Worker:
         # death" question the beacon answers.
         forensics.beacon_update(spec.task_id, spec.name, "exec")
         sem = self.async_exec.semaphore(self._task_group(spec))
+        shed = None
         async with sem:
             try:
-                if spec.task_id in self._cancelled_ids:
+                if spec.deadline and time.time() > spec.deadline:
+                    from ray_tpu.exceptions import TaskTimeoutError
+
+                    self._cancelled_ids.discard(spec.task_id)
+                    self._store_error(
+                        spec,
+                        TaskTimeoutError(
+                            f"task {spec.name} exceeded its deadline "
+                            f"before execution (shed in worker "
+                            f"{self.worker_id} executor queue)",
+                            task_id=spec.task_id, where="worker_queue"))
+                    failed = True
+                    shed = "worker_queue"
+                elif spec.task_id in self._cancelled_ids:
                     self._cancelled_ids.discard(spec.task_id)
                     self._store_error(
                         spec,
@@ -643,17 +674,18 @@ class Worker:
                 failed = True
         forensics.beacon_update(phase="idle")
         self._cancelled_ids.discard(spec.task_id)
+        self._release_slot(spec)
         try:
             results, sealed_pending = self._route_results(spec)
-            self.runtime.conn.cast(
-                "task_finished",
-                {"worker_id": self.worker_id, "task_id": spec.task_id,
-                 "failed": failed,
-                 "results": results,
-                 "sealed_pending": sealed_pending,
-                 "events": self._lifecycle_events(
-                     spec, start, time.time(), failed)},
-            )
+            done = {"worker_id": self.worker_id, "task_id": spec.task_id,
+                    "failed": failed,
+                    "results": results,
+                    "sealed_pending": sealed_pending,
+                    "events": self._lifecycle_events(
+                        spec, start, time.time(), failed)}
+            if shed is not None:
+                done["shed"] = shed
+            self.runtime.conn.cast("task_finished", done)
         except Exception:
             pass
         self._count_call(spec)
@@ -809,8 +841,30 @@ class Worker:
         forensics.beacon_update(spec.task_id, spec.name, "exec")
         spec._deferred_results = []
         spec._remote_markers = []
+        shed = None
         try:
-            if spec.task_id in self._cancelled_ids:
+            # Deadline first: the head's in-flight expiry signal rides
+            # the cancel cast, so an expired task may be BOTH cancelled
+            # and past deadline — the typed TaskTimeoutError is the
+            # truthful outcome either way.
+            if spec.deadline and time.time() > spec.deadline:
+                # Overload plane: the deadline expired while this task
+                # sat in the executor queue — shed it (typed error)
+                # instead of burning the worker on a result nobody can
+                # use anymore.
+                from ray_tpu.exceptions import TaskTimeoutError
+
+                self._cancelled_ids.discard(spec.task_id)
+                self._store_error(
+                    spec,
+                    TaskTimeoutError(
+                        f"task {spec.name} exceeded its deadline before "
+                        f"execution (shed in worker "
+                        f"{self.worker_id} executor queue)",
+                        task_id=spec.task_id, where="worker_queue"))
+                failed = True
+                shed = "worker_queue"
+            elif spec.task_id in self._cancelled_ids:
                 self._cancelled_ids.discard(spec.task_id)
                 self._store_error(
                     spec,
@@ -830,6 +884,12 @@ class Worker:
             # the set (running tasks are not interrupted); clear it so
             # the set stays bounded by the queue depth.
             self._cancelled_ids.discard(spec.task_id)
+            # Inflight accounting BEFORE the results ship: a sync caller
+            # wakes the instant the seal lands and may push its next
+            # direct call immediately — that push must not bounce off a
+            # stale _head_busy/_direct_inflight for work that already
+            # finished (the bounce costs a head spill + lease cooldown).
+            self._release_slot(spec)
             try:
                 # Owner-resident result delivery (reference ownership
                 # model, core_worker.h:172): inline results go STRAIGHT
@@ -843,18 +903,20 @@ class Worker:
                 # core_worker/task_event_buffer.h:225 batches events for
                 # the same reason — the completion path is the control
                 # plane's hottest message).
-                self.runtime.conn.cast_buffered(
-                    "task_finished",
-                    {
-                        "worker_id": self.worker_id,
-                        "task_id": spec.task_id,
-                        "failed": failed,
-                        "results": results,
-                        "sealed_pending": sealed_pending,
-                        "events": self._lifecycle_events(
-                            spec, start, time.time(), failed),
-                    },
-                )
+                done = {
+                    "worker_id": self.worker_id,
+                    "task_id": spec.task_id,
+                    "failed": failed,
+                    "results": results,
+                    "sealed_pending": sealed_pending,
+                    "events": self._lifecycle_events(
+                        spec, start, time.time(), failed),
+                }
+                if shed is not None:
+                    # Shed attribution rides the completion cast that
+                    # already flows (ray_tpu_tasks_shed_total{where=...}).
+                    done["shed"] = shed
+                self.runtime.conn.cast_buffered("task_finished", done)
                 # Draining a backlog: completions coalesce into one
                 # frame. Idle (nothing else queued on this executor):
                 # flush now so single-task latency stays sub-ms — the
@@ -867,6 +929,19 @@ class Worker:
                 pass
             self._count_call(spec)
 
+    def _release_slot(self, spec: TaskSpec) -> None:
+        """Release this task's inflight-window accounting (direct-plane
+        back-pressure window / head-busy gate). Called exactly once per
+        task from the completion paths, BEFORE results ship, so an owner
+        reacting to the seal never races stale accounting into a
+        direct_rej bounce for work that already finished."""
+        if getattr(spec, "_direct", None):
+            # Direct-plane inflight accounting (back-pressure window).
+            self._direct_inflight = max(0, self._direct_inflight - 1)
+        elif spec.actor_id is None and not spec.actor_creation:
+            with self._drain_lock:
+                self._head_busy = max(0, self._head_busy - 1)
+
     def _count_call(self, spec: TaskSpec) -> None:
         """@remote(max_calls=N): after the Nth completed call of a
         function, this worker exits — results were already delivered
@@ -874,12 +949,6 @@ class Worker:
         work. Pipelined tasks already queued on this worker DRAIN
         first (a max_retries=0 task must never be lost to a recycle);
         fresh processes replace it through the normal pool path."""
-        if getattr(spec, "_direct", None):
-            # Direct-plane inflight accounting (back-pressure window).
-            self._direct_inflight = max(0, self._direct_inflight - 1)
-        elif spec.actor_id is None and not spec.actor_creation:
-            with self._drain_lock:
-                self._head_busy = max(0, self._head_busy - 1)
         mc = getattr(spec, "max_calls", 0)
         if mc:
             n = self._calls_by_func.get(spec.func_id, 0) + 1

@@ -20,22 +20,26 @@ from ray_tpu.remote_function import _normalize_resources, _pack_env
 
 class ActorMethod:
     def __init__(self, handle: "ActorHandle", name: str, num_returns=1,
-                 concurrency_group: str | None = None):
+                 concurrency_group: str | None = None,
+                 timeout_s: float | None = None):
         self._handle = handle
         self._name = name
         self._num_returns = num_returns
         self._concurrency_group = concurrency_group
+        self._timeout_s = timeout_s
 
-    def options(self, num_returns=None, concurrency_group=None, **_):
+    def options(self, num_returns=None, concurrency_group=None,
+                timeout_s=None, **_):
         return ActorMethod(
             self._handle, self._name,
             self._num_returns if num_returns is None else num_returns,
-            concurrency_group or self._concurrency_group)
+            concurrency_group or self._concurrency_group,
+            self._timeout_s if timeout_s is None else timeout_s)
 
     def remote(self, *args, **kwargs):
         return self._handle._submit_method(
             self._name, args, kwargs, self._num_returns,
-            self._concurrency_group)
+            self._concurrency_group, self._timeout_s)
 
     def bind(self, *args, **kwargs):
         """Capture this call as a DAG node (reference: dag/class_node.py)."""
@@ -77,7 +81,8 @@ class ActorHandle:
         return ActorMethod(self, name, nr, group)
 
     def _submit_method(self, method: str, args, kwargs, num_returns,
-                       concurrency_group: str | None = None):
+                       concurrency_group: str | None = None,
+                       timeout_s: float | None = None):
         rt = global_runtime()
         packed, deps, borrowed = rt.pack_args(args, kwargs)
         streaming = num_returns in ("streaming", "dynamic")
@@ -101,6 +106,12 @@ class ActorHandle:
             streaming=streaming,
             concurrency_group=concurrency_group,
         )
+        timeout_s = (timeout_s if timeout_s is not None
+                     else GLOBAL_CONFIG.task_timeout_s_default)
+        if timeout_s:
+            import time as _time
+
+            spec.deadline = _time.time() + float(timeout_s)
         rt.submit_actor_task(spec)
         if streaming:
             from ray_tpu.generator import ObjectRefGenerator

@@ -216,6 +216,18 @@ def kill(actor_handle, *, no_restart: bool = True) -> None:
 
 def cancel(ref: ObjectRef, *, force: bool = False) -> None:
     rt = global_runtime()
+    # Direct-plane tasks first: a call queued owner-side in the direct
+    # window, or pushed owner→worker before the batched task_started
+    # lands, is invisible to the head's cancel scan — the owner's own
+    # direct plane removes it (owner queue) or signals the worker over
+    # the peer connection it was pushed on.
+    if rt._direct is not None:
+        outcome = rt._direct.cancel_local(ref.hex())
+        if outcome == "cancelled":
+            return  # removed + error-sealed locally; head never saw it
+        # "signalled": the worker will drop it at pickup — still fall
+        # through so the head's record (if any) is signalled too, and
+        # to cover a task that re-routed head-ward in the race window.
     # Map the return ref back to its task via the head's task table.
     rt.conn.call("cancel_task", {"task_id": ref.hex(), "force": force})
 

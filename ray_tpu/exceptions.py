@@ -88,6 +88,43 @@ class GetTimeoutError(RayTpuError, TimeoutError):
     """`get` exceeded its timeout."""
 
 
+class TaskTimeoutError(RayTpuError, TimeoutError):
+    """The task's deadline (``.options(timeout_s=...)`` or the
+    ``task_timeout_s_default`` knob) expired before it finished.
+
+    Expired work is SHED at every queue hop — owner-side direct queues,
+    the head's ready/dep-blocked/actor queues, and the worker executor
+    queue — so a saturated cluster stops burning capacity on results
+    nobody can use anymore. ``where`` names the hop that shed the task.
+    """
+
+    def __init__(self, message: str, *, task_id: str | None = None,
+                 where: str | None = None):
+        self.task_id = task_id
+        self.where = where
+        super().__init__(message)
+
+    def __reduce__(self):
+        return (_rebuild_task_timeout,
+                (self.args[0] if self.args else "", self.task_id,
+                 self.where))
+
+
+def _rebuild_task_timeout(message, task_id, where):
+    return TaskTimeoutError(message, task_id=task_id, where=where)
+
+
+class PendingCallsLimitError(RayTpuError):
+    """Submission rejected by admission control: the owner's (or the
+    cluster's) pending-task budget is exhausted.
+
+    Raised at ``.remote()`` in fast-fail mode (``admission_mode="fail"``
+    or when blocking-submit times out), and sealed into the rejected
+    task's return refs when the head's backstop gate sheds an
+    over-budget submission.
+    """
+
+
 class PlacementGroupUnschedulableError(RayTpuError):
     """The placement group cannot fit on the cluster."""
 

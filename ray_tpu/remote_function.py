@@ -117,6 +117,11 @@ class RemoteFunction:
                              GLOBAL_CONFIG.task_max_retries_default)),
                 opts.get("scheduling_strategy"),
                 int(opts.get("max_calls", 0)),
+                # Overload protection: .options(timeout_s=...) stamps a
+                # deadline on the spec at submit; 0/None = the
+                # task_timeout_s_default knob (0 = no deadline).
+                float(opts.get("timeout_s")
+                      or GLOBAL_CONFIG.task_timeout_s_default or 0.0),
             )
         return inv
 
@@ -128,7 +133,7 @@ class RemoteFunction:
         rt = global_runtime()
         opts = self._opts
         (streaming, num_returns, name, resources, max_retries, strategy,
-         max_calls) = self._invariants()
+         max_calls, timeout_s) = self._invariants()
         func_id = rt.register_function(self._fn)
         packed, deps, borrowed = rt.pack_args(args, kwargs)
         return_ids = [fast_hex_id() for _ in range(num_returns)]
@@ -148,6 +153,10 @@ class RemoteFunction:
             streaming=streaming,
             max_calls=max_calls,
         )
+        if timeout_s:
+            import time as _time
+
+            spec.deadline = _time.time() + timeout_s
         rt.submit_task(spec)
         if streaming:
             from ray_tpu.generator import ObjectRefGenerator

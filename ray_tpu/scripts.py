@@ -249,6 +249,54 @@ def cmd_crashes(args) -> int:
     return 0
 
 
+def cmd_health(args) -> int:
+    """Overload / retry-plane health view (`ray-tpu health`): pending
+    budgets, deadline sheds, admission rejections, memory-pressured
+    nodes, and per-target circuit breakers (open state + trip history)
+    so an operator can see why traffic to a peer is being shed."""
+    import time as _time
+
+    from ray_tpu.util import state as us
+
+    _connect(args.address)
+    h = us.health_summary()
+    if args.json:
+        print(json.dumps(h, indent=2, default=str))
+        return 0
+    g = h["gauges"]
+    print(f"nodes alive      {g.get('nodes_alive', '?')}  "
+          f"(pressured: {g.get('mem_pressured_nodes', 0)})")
+    print(f"workers alive    {g.get('workers_alive', '?')}")
+    print(f"tasks pending    {g.get('admission_pending_total', 0)} "
+          f"across {g.get('admission_pending_owners', 0)} owner(s)")
+    print(f"admission        {h['counters'].get('admission_rejected', 0)} "
+          f"rejected")
+    if h["tasks_shed"]:
+        shed = ", ".join(f"{k}={v}" for k, v in
+                         sorted(h["tasks_shed"].items()))
+        print(f"deadline sheds   {shed}")
+    for nid, info in h["pressured_nodes"].items():
+        used, total = info.get("used") or 0, info.get("total") or 0
+        pct = f"{100.0 * used / total:.0f}%" if total else "?"
+        print(f"PRESSURED node   {nid}  mem {pct} ({used}/{total})")
+    if h["worker_deaths"]:
+        deaths = ", ".join(f"{k}={v}" for k, v in
+                           sorted(h["worker_deaths"].items()))
+        print(f"worker deaths    {deaths}")
+    if not h["breakers"]:
+        print("breakers         all closed, no trips")
+    for scope, table in h["breakers"].items():
+        for target, b in table.items():
+            age = b.get("last_trip_at")
+            ago = (f"{_time.time() - age:.0f}s ago"
+                   if age else "never")
+            state = "OPEN" if b.get("open") else "closed"
+            print(f"breaker          [{scope}] {target}: {state}, "
+                  f"{b.get('trip_count', 0)} trip(s), last {ago}, "
+                  f"{b.get('failures', 0)} consecutive failure(s)")
+    return 0
+
+
 def cmd_stop(args) -> int:
     """Stop the cluster: all agents, then the head (reference: `ray
     stop`)."""
@@ -435,6 +483,13 @@ def main(argv: list[str] | None = None) -> int:
     s.add_argument("--limit", type=int, default=100)
     s.add_argument("--json", action="store_true")
     s.set_defaults(fn=cmd_crashes)
+
+    s = sub.add_parser("health",
+                       help="overload + retry-plane health (budgets, "
+                            "sheds, pressure, circuit breakers)")
+    s.add_argument("--address", required=True)
+    s.add_argument("--json", action="store_true")
+    s.set_defaults(fn=cmd_health)
 
     s = sub.add_parser("stop", help="stop all agents and the head")
     s.add_argument("--address", required=True)

@@ -220,6 +220,27 @@ def runtime_stats_text() -> str:
                 f'ray_tpu_worker_deaths_total'
                 f'{{reason="{_escape_label_value(reason)}"}} '
                 f"{deaths[reason]}")
+    # Overload-protection plane: deadline sheds by queue hop. The
+    # admission counter rides the generic counters block above
+    # (ray_tpu_admission_rejected_total) and the pressure gauge the
+    # gauges block (ray_tpu_mem_pressured_nodes).
+    shed = snap.get("tasks_shed") or {}
+    if shed:
+        lines.append("# TYPE ray_tpu_tasks_shed_total counter")
+        for where in sorted(shed):
+            lines.append(
+                f'ray_tpu_tasks_shed_total'
+                f'{{where="{_escape_label_value(where)}"}} {shed[where]}')
+    # Unified retry plane: open circuit breakers in the head process
+    # (per-client breakers ride the rpc clients snapshots).
+    breakers = snap.get("breakers") or {}
+    if breakers:
+        lines.append("# TYPE ray_tpu_rpc_breaker_open gauge")
+        for target in sorted(breakers):
+            lines.append(
+                f'ray_tpu_rpc_breaker_open'
+                f'{{target="{_escape_label_value(target)}"}} '
+                f"{1 if breakers[target].get('open') else 0}")
     # Cluster-wide head frame census (the zero-per-call-head-frames
     # property, scrapeable): total frames every reporting process has
     # sent the head.

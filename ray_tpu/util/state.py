@@ -200,6 +200,36 @@ def get_log(name: str, *, tail: int = 500,
     return reply["lines"][-tail:] if tail > 0 else []
 
 
+def health_summary() -> dict:
+    """Operator health view (`ray-tpu health` backs onto this): overload
+    state (pending budgets, deadline sheds, admission rejections,
+    memory-pressured nodes) and the unified retry plane's circuit
+    breakers — the head process's own plus every reporting client's, so
+    "why is traffic to that peer being shed" has one answer surface."""
+    snap = _call("runtime_stats")
+    clients = (snap.get("rpc") or {}).get("clients") or {}
+    client_breakers = {
+        cid: {t: b for t, b in (c.get("breakers") or {}).items()}
+        for cid, c in clients.items() if c.get("breakers")}
+    open_breakers = {}
+    for scope, table in [("head", snap.get("breakers") or {})] + [
+            (cid, t) for cid, t in client_breakers.items()]:
+        for target, b in table.items():
+            if b.get("open") or b.get("trip_count"):
+                open_breakers.setdefault(scope, {})[target] = b
+    gauges = snap.get("gauges") or {}
+    return {
+        "gauges": gauges,
+        "counters": snap.get("counters") or {},
+        "tasks_shed": snap.get("tasks_shed") or {},
+        "pressured_nodes": snap.get("pressured_nodes") or {},
+        "worker_deaths": snap.get("worker_deaths") or {},
+        # Breakers that are open now or have tripped before, per
+        # process ("head" = the head process itself).
+        "breakers": open_breakers,
+    }
+
+
 def list_crash_reports(*, filters=None, limit: int = 100) -> list[dict]:
     """Classified worker/node death reports from the head's bounded
     crash-forensics table (reference analogue: the GCS worker-death
@@ -353,6 +383,27 @@ def timeline(filename: str | None = None) -> "list | str":
                 "args": {k: ev.get(k) for k in
                          ("worker_id", "node_id", "reason", "detail",
                           "tasks") if ev.get(k) is not None},
+            })
+            continue
+        if ev.get("event") == "overload":
+            # Overload-protection instants: deadline sheds, admission
+            # rejections, memory-pressure transitions — rendered on the
+            # affected node's track (or a dedicated "overload" track).
+            kind = ev.get("kind") or "shed"
+            off = (data["clock_offsets"].get(ev.get("node_id"), 0.0)
+                   if ev.get("node_id") else 0.0)
+            trace.append({
+                "cat": "overload", "ph": "i", "s": "p",
+                "name": f"overload:{kind}"
+                        + (f":{ev['where']}" if ev.get("where") else ""),
+                "ts": (ev["ts"] - off) * 1e6,
+                "pid": _pid(ev.get("node_id") or "overload"),
+                "tid": 0,
+                "args": {k: ev.get(k) for k in
+                         ("kind", "where", "task_id", "name", "owner_id",
+                          "scope", "pending", "limit", "node_id",
+                          "used_bytes", "total_bytes")
+                         if ev.get(k) is not None},
             })
             continue
         if ev.get("event") == "chaos":

@@ -671,3 +671,118 @@ def test_direct_link_drop_spills_back_to_head(direct_cluster):
     assert ray_tpu.get(a.read.remote(), timeout=60) == 8
     # Every blackholed call was re-routed through the head.
     assert rt._direct.stats["recovered"] - recovered_before >= 8
+
+
+# ---------------------------------------------------------------------------
+# overload-protection plane under chaos: flood + drop/delay
+
+
+@pytest.mark.slow
+def test_overload_flood_under_drop_delay_degrades_gracefully(chaos_cluster):
+    """Sustained ~10x-capacity submit flood while the head<->agent link
+    drops and delays frames: the overload plane keeps the head queue
+    depth bounded (admission budgets), sheds expired work with typed
+    TaskTimeoutError, fast-fails over-budget submits with typed
+    PendingCallsLimitError instead of letting the backlog grow into an
+    OOM-kill cascade, and returns to steady state once the flood stops
+    (no worker memory-monitor-killed along the way)."""
+    import threading
+
+    from ray_tpu._private.config import GLOBAL_CONFIG
+    from ray_tpu.exceptions import (PendingCallsLimitError,
+                                    TaskTimeoutError)
+
+    address, agents = chaos_cluster
+    head = get_head()
+    spec = cu.drop_delay_spec("node_agent", drop=0.05, delay_ms=30)
+    agent = cu.start_agent(address, node_id="node-flood",
+                           extra_env=cu.spec_env(spec))
+    agents.append(agent)
+    kills_before = (head.memory_monitor.num_kills
+                    if head.memory_monitor else 0)
+    saved = (GLOBAL_CONFIG.admission_max_pending_per_owner,
+             GLOBAL_CONFIG.admission_mode)
+    budget = 24
+    GLOBAL_CONFIG.admission_max_pending_per_owner = budget
+    head.config.admission_max_pending_per_owner = budget
+    max_pending = [0]
+    stop = threading.Event()
+
+    def sample():
+        while not stop.is_set():
+            max_pending[0] = max(max_pending[0], head.pending_total)
+            time.sleep(0.005)
+
+    sampler = threading.Thread(target=sample, daemon=True)
+    sampler.start()
+    try:
+        with faultinject.inject(spec) as plane:
+            cu.wait_nodes(2)
+
+            @ray_tpu.remote(max_retries=5)
+            def grind(t):
+                time.sleep(t)
+                return 1
+
+            # Phase 1 — blocking-submit flood, deadline-stamped: ~6 CPUs
+            # of capacity vs 120 x 0.2 s of demand with 2 s deadlines.
+            refs = [grind.options(timeout_s=2.0).remote(0.2)
+                    for _ in range(120)]
+            done = shed = 0
+            for r in refs:
+                try:
+                    assert ray_tpu.get(r, timeout=120) == 1
+                    done += 1
+                except TaskTimeoutError:
+                    shed += 1
+            assert done + shed == 120
+            assert done > 0, "the flood must not starve all work"
+
+            # Phase 2 — fast-fail mode: over-budget submits are TYPED
+            # rejections at .remote(), never an unbounded queue.
+            GLOBAL_CONFIG.admission_mode = "fail"
+            refs2, rejected = [], 0
+            for _ in range(80):
+                try:
+                    refs2.append(grind.options(timeout_s=5.0).remote(0.1))
+                except PendingCallsLimitError:
+                    rejected += 1
+            assert rejected > 0, "over-budget submits must be rejected"
+            done2 = shed2 = 0
+            for r in refs2:
+                try:
+                    ray_tpu.get(r, timeout=120)
+                    done2 += 1
+                except TaskTimeoutError:
+                    shed2 += 1
+            assert done2 + shed2 == len(refs2)
+
+            # The chaos was real.
+            assert sum(v for k, v in plane.stats.items()
+                       if k.startswith(("drop:", "delay:"))) > 0
+
+        stop.set()
+        sampler.join(timeout=5)
+        # Bounded head backlog throughout the flood: the owner budget
+        # caps queued+inflight, so head-side pending can never exceed it
+        # (small slack for requeues riding worker death/retry paths).
+        assert max_pending[0] <= budget + 4, \
+            f"head queue depth {max_pending[0]} escaped the budget"
+        # Graceful degradation — not an OOM-kill cascade.
+        kills_after = (head.memory_monitor.num_kills
+                       if head.memory_monitor else 0)
+        assert kills_after == kills_before
+        # Recovery: the cluster serves normally after the flood.
+        GLOBAL_CONFIG.admission_mode = "block"
+        assert ray_tpu.get(
+            [grind.options(timeout_s=60.0).remote(0.01)
+             for _ in range(8)], timeout=120) == [1] * 8
+        deadline = time.monotonic() + 30
+        while head.pending_total and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert head.pending_total == 0
+    finally:
+        stop.set()
+        (GLOBAL_CONFIG.admission_max_pending_per_owner,
+         GLOBAL_CONFIG.admission_mode) = saved
+        head.config.admission_max_pending_per_owner = saved[0]

@@ -1,0 +1,427 @@
+"""Overload-protection plane: task deadlines, admission control, and
+memory-aware backpressure.
+
+The acceptance property: a deadline-stamped submit flood at ~10x worker
+capacity degrades GRACEFULLY — expired tasks shed before execution with
+a typed TaskTimeoutError, over-budget submits are rejected/blocked with
+typed errors, head queue depth stays bounded, a soft-watermark-pressured
+node receives no new placements until recovery, and no worker is
+memory-monitor-killed during the flood (backpressure fires long before
+the SIGKILL defense has to).
+"""
+
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.config import GLOBAL_CONFIG
+from ray_tpu._private.worker_context import get_head, global_runtime
+from ray_tpu.exceptions import PendingCallsLimitError, TaskTimeoutError
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=2, object_store_memory=64 * 1024 * 1024,
+                 log_to_driver=False)
+    yield
+    ray_tpu.shutdown()
+
+
+def _wait(pred, timeout=15.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise TimeoutError(f"never happened: {msg}")
+
+
+# ------------------------------------------------------ task deadlines
+
+
+def test_deadline_flood_sheds_expired(cluster):
+    """Submit ~10x capacity of deadline-stamped work: the excess expires
+    in queue and is shed with TaskTimeoutError BEFORE execution; the
+    cluster drains to steady state with zero pending budget leaked and
+    no memory-monitor kill."""
+    head = get_head()
+    kills_before = (head.memory_monitor.num_kills
+                    if head.memory_monitor else 0)
+    shed_before = sum(head.shed_counts.values())
+
+    @ray_tpu.remote
+    def busy(t):
+        time.sleep(t)
+        return 1
+
+    # 2 CPUs x ~1 s of deadline vs 40 x 0.25 s of demand = ~10x over.
+    refs = [busy.options(timeout_s=1.0).remote(0.25) for _ in range(40)]
+    done, shed = 0, 0
+    for r in refs:
+        try:
+            assert ray_tpu.get(r, timeout=60) == 1
+            done += 1
+        except TaskTimeoutError:
+            shed += 1
+    assert done + shed == 40
+    assert shed > 0, "an overcommitted flood must shed"
+    assert done > 0, "deadline shedding must not starve feasible work"
+    assert sum(head.shed_counts.values()) - shed_before >= shed
+    # Budget accounting drains to zero — nothing leaked.
+    _wait(lambda: head.pending_total == 0, msg="pending budget drained")
+    assert not head.pending_by_owner
+    # Graceful degradation, not the kill threshold.
+    kills_after = (head.memory_monitor.num_kills
+                   if head.memory_monitor else 0)
+    assert kills_after == kills_before
+
+
+def test_deadline_generous_never_sheds(cluster):
+    @ray_tpu.remote
+    def quick(x):
+        return x + 1
+
+    refs = [quick.options(timeout_s=60.0).remote(i) for i in range(20)]
+    assert ray_tpu.get(refs, timeout=60) == [i + 1 for i in range(20)]
+
+
+def test_deadline_sheds_in_worker_queue(cluster):
+    """A call queued in the WORKER's executor (behind a long-running
+    actor call) expires there — the worker sheds it at pickup with the
+    typed error instead of executing it late."""
+
+    @ray_tpu.remote
+    class Busy:
+        def work(self, t):
+            time.sleep(t)
+            return t
+
+    a = Busy.remote()
+    assert ray_tpu.get(a.work.remote(0)) == 0
+    long_ref = a.work.remote(2.0)
+    time.sleep(0.1)
+    late = a.work.options(timeout_s=0.5).remote(0)
+    with pytest.raises(TaskTimeoutError):
+        ray_tpu.get(late, timeout=30)
+    assert ray_tpu.get(long_ref, timeout=30) == 2.0
+    ray_tpu.kill(a)
+
+
+def test_dep_blocked_deadline_sheds(cluster):
+    """A task parked on a never-sealed dependency expires in dep_blocked
+    (health-loop sweep) instead of hanging forever."""
+    import os
+
+    head = get_head()
+
+    @ray_tpu.remote
+    def consume(x):
+        return x
+
+    hole = ray_tpu.ObjectRef(os.urandom(16).hex())  # never produced
+    # num_cpus=2: a fresh resource shape, so no cached worker lease can
+    # short-circuit the head path (a foreign never-produced dep is not
+    # locally detectable owner-side) — the task must park in dep_blocked.
+    ref = consume.options(timeout_s=1.0, num_cpus=2).remote(hole)
+    with pytest.raises(TaskTimeoutError):
+        ray_tpu.get(ref, timeout=30)
+    assert head.shed_counts.get("dep_blocked", 0) >= 1
+
+
+# --------------------------------------------------- admission control
+
+
+def test_admission_fast_fail_typed(cluster):
+    """admission_mode="fail": an over-budget submit raises
+    PendingCallsLimitError at .remote() instead of queueing."""
+    saved = (GLOBAL_CONFIG.admission_max_pending_per_owner,
+             GLOBAL_CONFIG.admission_mode)
+    GLOBAL_CONFIG.admission_max_pending_per_owner = 8
+    GLOBAL_CONFIG.admission_mode = "fail"
+    try:
+
+        @ray_tpu.remote
+        def slow():
+            time.sleep(0.3)
+            return 1
+
+        refs, rejected = [], 0
+        for _ in range(30):
+            try:
+                refs.append(slow.remote())
+            except PendingCallsLimitError:
+                rejected += 1
+        assert rejected > 0, "over-budget submits must fast-fail"
+        assert ray_tpu.get(refs, timeout=60) == [1] * len(refs)
+    finally:
+        (GLOBAL_CONFIG.admission_max_pending_per_owner,
+         GLOBAL_CONFIG.admission_mode) = saved
+
+
+def test_admission_blocking_bounds_head_queue(cluster):
+    """Default blocking-submit: the owner gate parks the submitting
+    thread, so the head's pending budget (and with it queue depth / RSS)
+    stays bounded through a flood instead of growing with it."""
+    head = get_head()
+    saved = GLOBAL_CONFIG.admission_max_pending_per_owner
+    GLOBAL_CONFIG.admission_max_pending_per_owner = 12
+    max_seen = [0]
+    stop = threading.Event()
+
+    def sample():
+        while not stop.is_set():
+            max_seen[0] = max(max_seen[0], head.pending_total)
+            time.sleep(0.005)
+
+    t = threading.Thread(target=sample, daemon=True)
+    t.start()
+    try:
+
+        @ray_tpu.remote
+        def tick():
+            time.sleep(0.05)
+            return 1
+
+        t0 = time.monotonic()
+        refs = [tick.remote() for _ in range(60)]
+        submit_dt = time.monotonic() - t0
+        assert ray_tpu.get(refs, timeout=60) == [1] * 60
+        stop.set()
+        t.join(timeout=5)
+        # The owner budget (12 outstanding incl. running) bounds the
+        # head's queued backlog well below the flood size.
+        assert max_seen[0] <= 14, \
+            f"head queue depth {max_seen[0]} not bounded by the budget"
+        assert submit_dt > 0.2, "submission should have been throttled"
+    finally:
+        stop.set()
+        GLOBAL_CONFIG.admission_max_pending_per_owner = saved
+
+
+def test_admission_head_backstop_rejects_typed(cluster):
+    """An owner that ignores its local budget (old client, misconfig)
+    hits the head's authoritative gate: rejected tasks carry
+    PendingCallsLimitError and the owner receives a backpressure cast."""
+    head = get_head()
+    rt = global_runtime()
+    saved_local = GLOBAL_CONFIG.admission_max_pending_per_owner
+    saved_head = head.config.admission_max_pending_per_owner
+    GLOBAL_CONFIG.admission_max_pending_per_owner = 1_000_000
+    head.config.admission_max_pending_per_owner = 6
+    rejected_before = head.stats["admission_rejected"]
+    bp_before = rt._backpressure_until
+    try:
+
+        @ray_tpu.remote
+        def slow():
+            time.sleep(0.3)
+            return 1
+
+        refs = [slow.remote() for _ in range(30)]
+        ok, rejected = 0, 0
+        for r in refs:
+            try:
+                ray_tpu.get(r, timeout=60)
+                ok += 1
+            except PendingCallsLimitError:
+                rejected += 1
+        assert rejected > 0 and ok > 0
+        assert head.stats["admission_rejected"] - rejected_before == rejected
+        assert rt._backpressure_until > bp_before, \
+            "backpressure cast never reached the owner"
+    finally:
+        GLOBAL_CONFIG.admission_max_pending_per_owner = saved_local
+        head.config.admission_max_pending_per_owner = saved_head
+        with rt._owned_cond:
+            rt._backpressure_until = 0.0
+
+
+# ------------------------------------------- memory-aware backpressure
+
+
+def test_pressured_node_receives_no_placements(cluster):
+    """Past the soft watermark a node stops receiving placements; on
+    recovery the queued work dispatches. No kill is involved."""
+    head = get_head()
+    kills_before = (head.memory_monitor.num_kills
+                    if head.memory_monitor else 0)
+
+    @ray_tpu.remote
+    def f(x):
+        return x * 2
+
+    assert ray_tpu.get(f.remote(1)) == 2  # warm a worker
+    rt = global_runtime()
+    head.set_node_pressure(head.node_id, True, 85, 100)
+    try:
+        # Pressure revokes the owner's cached leases (cast); wait for
+        # the revoke to land so the submit can't ride a stale lease.
+        _wait(lambda: not rt._direct.lease_pools,
+              msg="leases revoked under pressure")
+        ref = f.remote(21)
+        with pytest.raises(ray_tpu.exceptions.GetTimeoutError):
+            ray_tpu.get(ref, timeout=1.5)
+        # The task is parked, not failed.
+        assert head.pending_total >= 1
+    finally:
+        head.set_node_pressure(head.node_id, False)
+    assert ray_tpu.get(ref, timeout=30) == 42
+    kills_after = (head.memory_monitor.num_kills
+                   if head.memory_monitor else 0)
+    assert kills_after == kills_before
+
+
+def test_pressure_revokes_and_blocks_leases(cluster):
+    """Lease grants are part of placement: a pressured node grants no
+    new leases and existing ones are revoked (owners stop pushing)."""
+    head = get_head()
+    rt = global_runtime()
+
+    @ray_tpu.remote
+    def g(x):
+        return x
+
+    assert ray_tpu.get(g.remote(0)) == 0
+    _wait(lambda: len(rt._direct.lease_pools) > 0, msg="lease minted")
+    head.set_node_pressure(head.node_id, True, 85, 100)
+    try:
+        _wait(lambda: not any(r.leased_to for r in head.workers.values()),
+              msg="leases revoked under pressure")
+        # While pressured no NEW lease can be granted head-side.
+        with head.lock:
+            for rec in head.workers.values():
+                assert rec.leased_to is None
+    finally:
+        head.set_node_pressure(head.node_id, False)
+    assert ray_tpu.get(g.remote(7), timeout=30) == 7
+
+
+def test_memory_monitor_soft_watermark_transitions(cluster):
+    """MemoryMonitor drives pressure purely off the usage ratio, with
+    hysteresis, and never kills below the hard threshold."""
+    from ray_tpu._private.memory_monitor import MemoryMonitor
+
+    head = get_head()
+    usage = {"v": (50, 100)}
+    mm = MemoryMonitor(head, threshold=0.95,
+                       usage_fn=lambda: usage["v"],
+                       soft_threshold=0.80, hysteresis=0.03)
+    assert not mm.tick()
+    assert head.node_id not in head.pressured_nodes
+    usage["v"] = (84, 100)
+    assert not mm.tick()  # pressured, NOT killed
+    assert head.node_id in head.pressured_nodes
+    usage["v"] = (79, 100)  # inside the hysteresis band: still pressured
+    mm.tick()
+    assert head.node_id in head.pressured_nodes
+    usage["v"] = (70, 100)
+    mm.tick()
+    assert head.node_id not in head.pressured_nodes
+    assert mm.num_kills == 0
+
+
+def test_stale_remote_pressure_expires(cluster):
+    """A remote node's pressure entry whose agent stopped refreshing
+    (lost recovery cast, dead agent) self-heals via the health sweep."""
+    head = get_head()
+    head.set_node_pressure("node-ghost", True, 90, 100, remote=True)
+    with head.lock:
+        head.pressured_nodes["node-ghost"]["ts"] = time.time() - 3600
+    head._overload_sweep(time.time())
+    assert "node-ghost" not in head.pressured_nodes
+
+
+# --------------------------------- direct-plane cancel (regression fix)
+
+
+def test_cancel_owner_queued_direct_call(cluster):
+    """Regression (fails pre-fix): a call queued OWNER-side in the
+    direct window was invisible to the head's cancel scan —
+    ray_tpu.cancel returned {"cancelled": False} and the call executed
+    anyway. It must be removed from the owner queue and error-sealed."""
+    rt = global_runtime()
+
+    @ray_tpu.remote
+    class S:
+        def work(self, t):
+            time.sleep(t)
+            return t
+
+    a = S.remote()
+    assert ray_tpu.get(a.work.remote(0)) == 0
+    _wait(lambda: rt._direct.routes[a._actor_id].mode == "direct",
+          msg="route direct")
+    saved_window = rt._direct.window
+    rt._direct.window = 1
+    try:
+        long_ref = a.work.remote(2.0)
+        queued = a.work.remote(0)
+        time.sleep(0.2)
+        route = rt._direct.routes[a._actor_id]
+        assert any(queued.hex() in s.return_ids for s in route.pending), \
+            "call should be parked in the owner-side direct queue"
+        before = rt._direct.stats["cancelled_owner_queue"]
+        ray_tpu.cancel(queued)
+        with pytest.raises(Exception, match="TaskCancelledError"):
+            ray_tpu.get(queued, timeout=10)
+        assert rt._direct.stats["cancelled_owner_queue"] == before + 1
+        assert ray_tpu.get(long_ref, timeout=30) == 2.0
+    finally:
+        rt._direct.window = saved_window
+        ray_tpu.kill(a)
+
+
+def test_cancel_direct_pushed_call_signals_worker(cluster):
+    """A direct call already pushed owner→worker (queued in the worker's
+    executor behind a running call) is signalled over the peer
+    connection and dropped at pickup."""
+    rt = global_runtime()
+
+    @ray_tpu.remote
+    class S2:
+        def work(self, t):
+            time.sleep(t)
+            return t
+
+    a = S2.remote()
+    assert ray_tpu.get(a.work.remote(0)) == 0
+    _wait(lambda: rt._direct.routes[a._actor_id].mode == "direct",
+          msg="route direct")
+    long_ref = a.work.remote(2.0)
+    target = a.work.remote(0)
+    time.sleep(0.2)
+    ray_tpu.cancel(target)
+    with pytest.raises(Exception, match="TaskCancelledError"):
+        ray_tpu.get(target, timeout=15)
+    assert ray_tpu.get(long_ref, timeout=30) == 2.0
+    ray_tpu.kill(a)
+
+
+# --------------------------------------------------- operator surfaces
+
+
+def test_overload_surfaces_exposed(cluster):
+    """Counters, gauges, instants, and the health view all report the
+    overload plane's decisions."""
+    from ray_tpu.util import metrics
+    from ray_tpu.util import state as us
+
+    head = get_head()
+    assert sum(head.shed_counts.values()) > 0  # earlier tests shed
+    txt = metrics.runtime_stats_text()
+    assert "ray_tpu_tasks_shed_total" in txt
+    assert "ray_tpu_admission_rejected_total" in txt
+    assert "ray_tpu_mem_pressured_nodes" in txt
+    h = us.health_summary()
+    assert h["tasks_shed"]
+    assert h["counters"]["admission_rejected"] > 0
+    assert "admission_pending_total" in h["gauges"]
+    # Perfetto instants for sheds / rejections / pressure transitions.
+    cats = [t for t in us.timeline()
+            if isinstance(t, dict) and t.get("cat") == "overload"]
+    kinds = {t["args"].get("kind") for t in cats}
+    assert "shed" in kinds
+    assert "admission_reject" in kinds
+    assert "mem_pressure" in kinds
