@@ -92,8 +92,11 @@ def main(as_json: bool = False) -> dict:
 
     # actor call pipelining: K calls in flight on the direct plane
     # (owner→worker window) before the barrier get — measures how much
-    # the per-call overhead amortizes under pipeline depth.
-    for depth in (8, 32):
+    # the per-call overhead amortizes under pipeline depth. Depth 512
+    # is the headline pipelined direct-plane number: past the
+    # direct_window (64) calls queue owner-side, so this measures the
+    # full submit→push→exec→seal loop at saturation.
+    for depth in (8, 32, 512):
         timeit(f"single client actor pipeline depth {depth}",
                lambda d=depth: ray_tpu.get(
                    [actor.ping.remote() for _ in range(d)]),
@@ -120,6 +123,8 @@ def main(as_json: bool = False) -> dict:
 
     ray_tpu.kill(actor)
     ray_tpu.shutdown()
+    bench_wire_binary(results)
+    bench_seal_coalescing(results)
     bench_event_overhead(results)
     bench_forensics_overhead(results)
     bench_admission_overhead(results)
@@ -127,6 +132,81 @@ def main(as_json: bool = False) -> dict:
     if as_json:
         print(json.dumps({"microbenchmark": results}))
     return results
+
+
+def bench_wire_binary(results: dict) -> None:
+    """Binary hot-path wire format on/off (RAY_TPU_WIRE_BINARY —
+    negotiated per connection at register/whoami, so flipping the env
+    before init flips the whole cluster): pipelined direct actor calls
+    and lease-cached task floods pay one pickle round trip per frame
+    when OFF, the wirefmt.py compact frames when ON."""
+    import os
+
+    from ray_tpu._private import config as config_mod
+
+    for mode in ("on", "off"):
+        os.environ["RAY_TPU_WIRE_BINARY"] = "1" if mode == "on" else "0"
+        config_mod.GLOBAL_CONFIG.wire_binary = (mode == "on")
+        ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024 * 1024,
+                     log_to_driver=False)
+
+        @ray_tpu.remote
+        class WEcho:
+            def ping(self, x=None):
+                return x
+
+        actor = WEcho.remote()
+        ray_tpu.get([actor.ping.remote() for _ in range(64)])  # warm
+        timeit(f"actor pipeline depth 512 wire_binary {mode}",
+               lambda: ray_tpu.get(
+                   [actor.ping.remote() for _ in range(512)]),
+               512, results=results)
+
+        @ray_tpu.remote
+        def wtask(i):
+            return i
+
+        N = 100
+        ray_tpu.get([wtask.remote(i) for i in range(64)])  # warm leases
+        timeit(f"tasks async wire_binary {mode}",
+               lambda: ray_tpu.get([wtask.remote(i) for i in range(N)]),
+               N, results=results)
+        ray_tpu.kill(actor)
+        ray_tpu.shutdown()
+    os.environ.pop("RAY_TPU_WIRE_BINARY", None)
+    config_mod.GLOBAL_CONFIG.wire_binary = True
+
+
+def bench_seal_coalescing(results: dict) -> None:
+    """Seal/ack coalescing on/off (RAY_TPU_WIRE_COALESCE): with it OFF
+    every buffered ack/seal pays its own record framing inside the
+    cast batch; ON merges consecutive same-kind records into one frame
+    body (rpc.Connection.flush_casts)."""
+    import os
+
+    from ray_tpu._private import config as config_mod
+
+    for mode in ("on", "off"):
+        os.environ["RAY_TPU_WIRE_COALESCE"] = "1" if mode == "on" else "0"
+        config_mod.GLOBAL_CONFIG.wire_coalesce = (mode == "on")
+        ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024 * 1024,
+                     log_to_driver=False)
+
+        @ray_tpu.remote
+        class CEcho:
+            def ping(self, x=None):
+                return x
+
+        actor = CEcho.remote()
+        ray_tpu.get([actor.ping.remote() for _ in range(64)])  # warm
+        timeit(f"actor pipeline depth 512 seal_coalescing {mode}",
+               lambda: ray_tpu.get(
+                   [actor.ping.remote() for _ in range(512)]),
+               512, results=results)
+        ray_tpu.kill(actor)
+        ray_tpu.shutdown()
+    os.environ.pop("RAY_TPU_WIRE_COALESCE", None)
+    config_mod.GLOBAL_CONFIG.wire_coalesce = True
 
 
 def bench_admission_overhead(results: dict) -> None:

@@ -55,6 +55,15 @@ def run(profile_name: str) -> dict:
 
     import ray_tpu
 
+    # Box-budget override: the full swarm row needs ~8 threads per
+    # resident worker; a container whose pid/thread budget can't hold
+    # profile-sized swarms (fork: Resource temporarily unavailable)
+    # caps it here. The emitted JSON records the size actually run.
+    swarm_env = os.environ.get("SCALE_ACTOR_SWARM")
+    if swarm_env:
+        PROFILES[profile_name] = dict(PROFILES[profile_name],
+                                      actor_swarm=int(swarm_env))
+
     # A million in-flight specs/refs make default-threshold cyclic GC a
     # measurable tax in the driver+head process; collect in larger
     # batches for the envelope run (workers self-tune in worker.main).
@@ -153,23 +162,47 @@ def _run_sections(p: dict, results: dict) -> dict:
     n_swarm = p["actor_swarm"]
     t0 = time.time()
     swarm = [SwarmMember.remote() for _ in range(n_swarm)]
-    # All alive: every member answers one call.
-    pings = ray_tpu.get([a.ping.remote() for a in swarm], timeout=3600)
+    # All alive: every member answers one call. The envelope MEASURES
+    # rather than crashes when the box can't hold the full swarm (a
+    # 1-core container under a spawn storm can time out registrations
+    # and lose members): failed pings count against
+    # actor_swarm_resident instead of aborting the whole run — the
+    # resident number IS the envelope.
+    def _ping_all():
+        refs = []
+        good, bad = 0, 0
+        for a in swarm:
+            try:
+                refs.append(a.ping.remote())
+            except Exception:
+                bad += 1
+        for r in refs:  # parallel burst; per-ref resolve tolerates loss
+            try:
+                good += int(ray_tpu.get(r, timeout=600) == 1)
+            except Exception:
+                bad += 1
+        return good, bad
+
+    ok, failed = _ping_all()
     spawn_dt = time.time() - t0
-    assert sum(pings) == n_swarm
     from ray_tpu.util.state import list_actors
 
     alive = sum(1 for a in list_actors(limit=n_swarm + 100)
                 if a.get("state") == "ALIVE")
     results["actor_swarm"] = n_swarm
-    results["actor_swarm_resident"] = alive
+    results["actor_swarm_resident"] = min(alive, ok)
+    results["actor_swarm_failed"] = failed
     results["actor_spawn_per_s"] = round(n_swarm / spawn_dt, 1)
     t0 = time.time()
-    ray_tpu.get([a.ping.remote() for a in swarm], timeout=3600)
-    results["actor_swarm_call_per_s"] = round(
-        n_swarm / (time.time() - t0), 1)
+    called, _bad = _ping_all()
+    if called:
+        results["actor_swarm_call_per_s"] = round(
+            called / (time.time() - t0), 1)
     for a in swarm:
-        ray_tpu.kill(a)
+        try:
+            ray_tpu.kill(a)
+        except Exception:
+            pass
     del swarm
 
     # 4c. Placement groups: concurrent gang reservations (reference row:

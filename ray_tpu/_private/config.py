@@ -136,6 +136,23 @@ class Config:
     # growing across workers, and overflow rides the head path.
     lease_window: int = 1
 
+    # --- native-speed control plane (binary wire format) ---
+    # Compact binary framing for HOT control-plane messages (direct
+    # pushes, acks, seals, task_started/task_finished — wirefmt.py)
+    # instead of per-frame pickle. Negotiated per connection at
+    # register/whoami, so mixed-version peers transparently stay on
+    # pickle framing. 0 disables advertising/accepting it everywhere.
+    wire_binary: bool = True
+    # Coalesce consecutive same-kind buffered casts (delivery acks,
+    # seal batches) into one frame with N records before framing —
+    # flood traffic stops paying per-record framing. Record order is
+    # preserved (only adjacent records merge).
+    wire_coalesce: bool = True
+    # (RAY_TPU_NATIVE=0 additionally forces the pure-Python codec in
+    # place of the _specenc.so C fast lane — read directly from the
+    # env in wirefmt.py/native_build.py since it gates extension
+    # LOADING, which happens before any Config exists.)
+
     # --- head fault tolerance (reference: gcs_init_data.h +
     # redis_store_client.h:111 — persistent GCS state; here a periodic
     # snapshot file instead of Redis) ---

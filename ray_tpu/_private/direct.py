@@ -54,7 +54,8 @@ from collections import deque
 
 from ray_tpu._private import rpc
 from ray_tpu._private.config import GLOBAL_CONFIG
-from ray_tpu._private.task_spec import TaskSpec, pack_spec, shape_key
+from ray_tpu._private.task_spec import (TaskSpec, pack_spec_cached,
+                                        shape_key)
 
 
 class _ActorRoute:
@@ -299,9 +300,16 @@ class DirectPlane:
     # wire
 
     def _spec_body(self, spec: TaskSpec, specenc: bool) -> dict:
+        """Compiled-encoding body. The packed bytes stay CACHED on the
+        spec (pack_spec_cached): one push used to pack twice (the push
+        itself + the task_started bookkeeping cast re-packed because
+        the cache was dropped after first use), and recovery paths —
+        retry, re-push after a direct_rej bounce, spillback through
+        direct_recover — re-encoded from scratch. Owner-side specs are
+        dropped when their task resolves, so the small cached copy
+        can't accumulate."""
         if specenc:
-            packed = spec._packed_bin or pack_spec(spec)
-            spec._packed_bin = None
+            packed = pack_spec_cached(spec)
             if packed is not None:
                 return {"spec_bin": packed}
         return {"spec": spec}
@@ -320,7 +328,10 @@ class DirectPlane:
             # push itself AND the buffered task_started bookkeeping (so
             # the head's event table sees in-flight direct tasks too) —
             # zero new frames, two floats on frames that already flow.
-            evt = dict(spec._evt)
+            # The spec's own stamp dict is reused as the wire payload
+            # (not copied): the spec is owner-resident and nothing
+            # mutates its stamps after this push.
+            evt = spec._evt
             evt["push"] = time.time()
             body["evt"] = evt
         try:

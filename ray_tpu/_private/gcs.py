@@ -1162,6 +1162,16 @@ class Head:
 
     def _h_register(self, body: dict, conn: rpc.Connection):
         ctype = body["client_type"]  # "driver" | "worker"
+        from ray_tpu._private import wirefmt
+
+        # Binary wire negotiation (wirefmt.py): hot frames head→client
+        # go binary only when the client advertised the same wire
+        # version AND this head has it enabled; the reply tells the
+        # client whether to do the same. The register exchange itself
+        # is always pickled, so negotiation can't race a binary frame.
+        head_wire = (wirefmt.WIRE_VERSION if self.config.wire_binary
+                     else 0)
+        conn.wire_binary = body.get("wire") == head_wire != 0
         # Off-host clients can't mmap the head's shared memory; their
         # object path degrades to inline payloads over the connection
         # (reference analogue: remote plasma access goes through the
@@ -1205,6 +1215,7 @@ class Head:
             "client_id": client_id,
             "shm_name": None if remote else self.shm_name,
             "specenc": _specenc() is not None,
+            "wire": head_wire,
             "shm_capacity": self.config.object_store_memory,
             # A worker's node is where it was spawned (P2P object
             # locations are recorded against it); drivers sit on the

@@ -62,6 +62,12 @@ def ensure_native(quiet: bool = True) -> bool:
     the artifacts exist afterwards. Never raises: callers have graceful
     pure-Python fallbacks."""
     global _done
+    if os.environ.get("RAY_TPU_NATIVE", "1").lower() in ("0", "false",
+                                                         "no"):
+        # Kill switch for the whole native lane: consumers (wirefmt's
+        # codec, native_sched, ...) fall back to pure Python. Lets CI
+        # exercise the fallback paths on a box that HAS a compiler.
+        return False
     with _lock:
         if _done:
             return all(os.path.exists(os.path.join(_OUT, t))

@@ -6,10 +6,14 @@ plane rides DCN/loopback TCP; the data plane (tensors) never touches this —
 it uses XLA collectives over ICI (SURVEY.md §5 "Distributed communication
 backend").
 
-Frame: [u32 length][pickled (kind, msg_id, body)]. Each connection is
-bidirectional: either side can issue requests ("call") and push one-way
-notifications ("cast"). A reader thread per connection dispatches to the
-registered handler; replies resolve per-call futures.
+Frame: [u32 length][payload]. The payload is pickled (kind, msg_id,
+body) on the cold path, or — for HOT kinds, to peers that negotiated it
+— the compact binary frame format from wirefmt.py (leading 0xA9 magic;
+a pickle stream always leads with 0x80, so the reader self-detects).
+Each connection is bidirectional: either side can issue requests
+("call") and push one-way notifications ("cast"). A reader thread per
+connection dispatches to the registered handler; replies resolve
+per-call futures.
 """
 
 from __future__ import annotations
@@ -23,9 +27,20 @@ from concurrent.futures import Future
 from concurrent.futures import TimeoutError as _FutTimeout
 from typing import Any, Callable
 
-from ray_tpu._private import faultinject
+from ray_tpu._private import faultinject, wirefmt
 
 _HDR = struct.Struct("<I")
+
+_cfg = None
+
+
+def _config():
+    global _cfg
+    if _cfg is None:
+        from ray_tpu._private.config import GLOBAL_CONFIG
+
+        _cfg = GLOBAL_CONFIG
+    return _cfg
 
 REPLY = "__reply__"
 ERROR = "__error__"
@@ -152,6 +167,11 @@ class Connection:
         self.frames_sent = 0
         self.calls_sent = 0
         self.sent_kinds: dict[str, int] = {}
+        # Binary hot-path wire format (wirefmt.py): gates SENDING only
+        # (decode is self-detecting). False until the registration /
+        # whoami handshake confirms the peer advertised the same wire
+        # version — mixed-version peers stay on pickle framing.
+        self.wire_binary = False
         self._pending: dict[int, Future] = {}
         self._pending_lock = threading.Lock()
         self._next_id = 0
@@ -239,7 +259,10 @@ class Connection:
             if drop:
                 return  # lost on the wire; recovery is the caller's
                 # retry policy (calls) or at-least-once design (casts)
-        data = pickle.dumps((kind, msg_id, body), protocol=5)
+        data = (wirefmt.encode(kind, msg_id, body)
+                if self.wire_binary else None)
+        if data is None:  # cold kind / exotic body / un-negotiated peer
+            data = pickle.dumps((kind, msg_id, body), protocol=5)
         frame = _HDR.pack(len(data)) + data
         # Counter writes are racy-but-monotonic ints (GIL-atomic enough
         # for a regression guard; exactness is not load-bearing).
@@ -328,15 +351,30 @@ class Connection:
                 if not self._cast_buf:
                     return
                 buf, self._cast_buf = self._cast_buf, []
-            for k, _ in buf:
-                # Per-kind census for buffered casts too (they reach
-                # _send only as one CAST_BATCH frame).
-                self.sent_kinds[k] = self.sent_kinds.get(k, 0) + 1
-            if len(buf) == 1:
-                self._send(buf[0][0], 0, buf[0][1])
-                self.sent_kinds[buf[0][0]] -= 1  # _send counted it
+            # Seal/ack coalescing (wirefmt.coalesce_casts): consecutive
+            # same-kind records (delivery acks, seal batches) merge into
+            # ONE frame with N records — flood traffic stops paying
+            # per-record framing. Only adjacent records merge, so the
+            # buffered order across kinds is preserved, and the merged
+            # frame carries its REAL kind, so the chaos plane's per-kind
+            # matching (faultinject.apply_send in _send) sees seal/ack
+            # frames it previously only saw as opaque CAST_BATCHes.
+            if _config().wire_coalesce:
+                merged = wirefmt.coalesce_casts(buf)
             else:
-                self._send(CAST_BATCH, 0, buf)
+                merged = [(k, b, 1) for k, b in buf]
+            if len(merged) == 1:
+                k, b, n = merged[0]
+                if n > 1:
+                    # Per-kind census counts RECORDS (rpc_counters must
+                    # stay truthful under merging); _send adds the 1.
+                    self.sent_kinds[k] = self.sent_kinds.get(k, 0) + n - 1
+                self._send(k, 0, b)
+            else:
+                for k, _b, n in merged:
+                    self.sent_kinds[k] = self.sent_kinds.get(k, 0) + n
+                self._send(CAST_BATCH, 0,
+                           [(k, b) for k, b, _n in merged])
 
     def call(self, kind: str, body: dict | None = None,
              timeout: float | None = None, retry=None) -> Any:
@@ -426,7 +464,24 @@ class Connection:
             body = self._recv_exact(_HDR.unpack(hdr)[0])
             if body is None:
                 break
-            kind, msg_id, payload = pickle.loads(body)
+            try:
+                if body and body[0] == wirefmt.WIRE_MAGIC:
+                    kind, msg_id, payload = wirefmt.decode_frame(body)
+                else:
+                    kind, msg_id, payload = pickle.loads(body)
+            except Exception:
+                # Corrupt/undecodable frame (wirefmt raises the typed
+                # WireDecodeError; a poisoned pickle raises its own):
+                # frame sync on this stream cannot be trusted anymore —
+                # close the connection (pending calls fail fast, the
+                # peer re-dials) instead of killing the reader thread
+                # with the pending map still armed (which would HANG
+                # every outstanding call forever).
+                import sys
+
+                print(f"[rpc] {self.name}: closing on undecodable frame:"
+                      f"\n{traceback.format_exc()}", file=sys.stderr)
+                break
             if faultinject.active() is not None and faultinject.apply_recv(
                     self._peer_desc(), kind):
                 continue  # injected recv-side loss

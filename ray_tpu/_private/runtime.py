@@ -184,13 +184,19 @@ class CoreRuntime:
             {"client_type": client_type, "worker_id": worker_id,
              "pid": os.getpid(), "can_shm": can_shm,
              "owner_addr": self.owner_addr,
-             "specenc": _specenc() is not None},
+             "specenc": _specenc() is not None,
+             "wire": self._wire_version()},
             timeout=GLOBAL_CONFIG.worker_register_timeout_s,
             retry=default_policy(),
         )
         # Compiled-spec negotiation: pack only when the head can unpack
         # (mixed hosts may lack the extension; Makefile skips it there).
         self._head_specenc = bool(reg.get("specenc"))
+        # Binary wire negotiation: hot frames to the head go binary
+        # only when it advertised the same wire version (wirefmt.py);
+        # mixed-version peers keep pickle framing.
+        self.conn.wire_binary = (
+            reg.get("wire") == self._wire_version() != 0)
         self.client_id = reg["client_id"]
         self.node_id = reg["node_id"]
         self.session_dir = reg["session_dir"]
@@ -204,7 +210,9 @@ class CoreRuntime:
                     "register",
                     {"client_type": client_type, "worker_id": worker_id,
                      "pid": os.getpid(), "can_shm": False,
-                     "owner_addr": self.owner_addr},
+                     "owner_addr": self.owner_addr,
+                     "specenc": _specenc() is not None,
+                     "wire": self._wire_version()},
                     timeout=GLOBAL_CONFIG.worker_register_timeout_s,
                 )
                 self.client_id = reg["client_id"]
@@ -311,6 +319,14 @@ class CoreRuntime:
     # ------------------------------------------------------------------
     # inbound messages
 
+    @staticmethod
+    def _wire_version() -> int:
+        """The binary wire version this runtime advertises (0 = binary
+        framing disabled by config — peers negotiate down to pickle)."""
+        from ray_tpu._private import wirefmt
+
+        return wirefmt.WIRE_VERSION if GLOBAL_CONFIG.wire_binary else 0
+
     def _handle(self, kind: str, body: dict, conn: rpc.Connection):
         if kind == "owned_freed":
             # The head freed directory entries this runtime owns: drop
@@ -398,7 +414,8 @@ class CoreRuntime:
                     {"client_type": self.client_type, "worker_id": None,
                      "pid": os.getpid(),
                      "can_shm": getattr(self, "shm", None) is not None,
-                     "owner_addr": self.owner_addr},
+                     "owner_addr": self.owner_addr,
+                     "wire": self._wire_version()},
                     timeout=GLOBAL_CONFIG.worker_register_timeout_s,
                 )
                 if reg["shm_name"] is not None:
@@ -416,13 +433,16 @@ class CoreRuntime:
                             {"client_type": self.client_type,
                              "worker_id": None, "pid": os.getpid(),
                              "can_shm": False,
-                             "owner_addr": self.owner_addr},
+                             "owner_addr": self.owner_addr,
+                             "wire": self._wire_version()},
                             timeout=GLOBAL_CONFIG.worker_register_timeout_s,
                         )
                 self.client_id = reg["client_id"]
                 self.node_id = reg["node_id"]
                 self.session_dir = reg["session_dir"]
                 self._head_specenc = bool(reg.get("specenc"))
+                conn.wire_binary = (
+                    reg.get("wire") == self._wire_version() != 0)
                 # The new head's KV may lack function blobs exported to
                 # the old one (no snapshot, or crash inside the flush
                 # window): drop the "already exported" cache so the next
@@ -602,8 +622,16 @@ class CoreRuntime:
         if kind == "whoami":
             # Peer identity check: a mis-advertised owner address (e.g.
             # loopback seen from another host) must not silently swallow
-            # seals meant for a different runtime.
-            return {"client_id": self.client_id}
+            # seals meant for a different runtime. Doubles as the wire
+            # negotiation for peer connections — the dialer's version
+            # rides the request, ours rides the reply, and each side
+            # enables binary SENDING only on a version match (this
+            # reply itself is always pickled, so no binary frame can
+            # precede the handshake in either direction).
+            if body.get("wire") == self._wire_version() != 0:
+                conn.wire_binary = True
+            return {"client_id": self.client_id,
+                    "wire": self._wire_version()}
         raise rpc.RpcError(f"unknown peer message {kind!r}")
 
     def _store_owned_and_notify(self, objs: "list[dict]",
@@ -765,6 +793,15 @@ class CoreRuntime:
             except OSError:
                 breaker.record_failure()
                 raise
+            except RuntimeError as e:
+                # pthread_create EAGAIN: the box hit a thread/pid limit
+                # mid-dial (observed under a 2,000-actor swarm on a
+                # 1-core container). The direct plane has a head-path
+                # fallback by design — fail THIS dial like an
+                # unreachable peer instead of crashing the submitter.
+                breaker.record_failure()
+                raise rpc.RpcError(f"owner dial {addr} failed: {e}") \
+                    from None
             # Verify who answered: an advertised loopback address dialed
             # from another host reaches the WRONG process — one-way
             # seals would vanish silently. One RPC per (peer, addr). A
@@ -773,10 +810,13 @@ class CoreRuntime:
             # Retried per the policy: an injected drop of the whoami
             # frame must not misclassify a healthy owner as dead.
             try:
-                who = c.call("whoami", {}, timeout=10,
+                who = c.call("whoami", {"wire": self._wire_version()},
+                             timeout=10,
                              retry=default_policy(deadline_s=10.0,
                                                   attempt_timeout_s=3.0))
                 c.peer_info["owner_id"] = who.get("client_id")
+                c.wire_binary = (
+                    who.get("wire") == self._wire_version() != 0)
             except (rpc.RpcError, rpc.ConnectionLost, CircuitOpenError,
                     FutureTimeoutError):
                 breaker.record_failure()
@@ -1720,11 +1760,14 @@ class CoreRuntime:
 
     def _spec_body(self, spec: TaskSpec) -> dict:
         """Compiled spec encoding when both ends support it
-        (task_spec.pack_spec; negotiated at register)."""
+        (task_spec.pack_spec; negotiated at register). The packed bytes
+        cache on the spec (pack_spec_cached), so a direct-plane
+        spillback that already packed for a lease push reuses them
+        here verbatim instead of re-encoding."""
         if getattr(self, "_head_specenc", False):
-            from ray_tpu._private.task_spec import pack_spec
+            from ray_tpu._private.task_spec import pack_spec_cached
 
-            packed = pack_spec(spec)
+            packed = pack_spec_cached(spec)
             if packed is not None:
                 return {"spec_bin": packed}
         return {"spec": spec}

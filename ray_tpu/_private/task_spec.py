@@ -8,7 +8,6 @@ python/ray/_raylet.pyx submit_task :3709 / create_actor :3795).
 from __future__ import annotations
 
 import dataclasses
-import os
 import pickle
 from typing import Any
 
@@ -100,13 +99,16 @@ class TaskSpec:
     #                     the exec span, stamped onto the lifecycle
     #                     event (wall-vs-CPU skew in summarize_tasks)
     _cpu_time: Any = dataclasses.field(default=None, repr=False)
-    # Submit-time compiled encoding, reused verbatim for the worker push
-    # (the hot path packed every spec TWICE: submitter->head and
-    # head->worker). Must be invalidated wherever a PACKED field mutates
-    # after unpack — today that is only retries_used on the retry path.
-    # Cached only under _PACKED_CACHE_MAX bytes (a million-spec backlog
-    # must not hold a duplicate serialized copy of large args), cleared
-    # after the push, and stripped from pickle below.
+    # Submit-time compiled encoding, reused verbatim for every later
+    # send of this spec: worker pushes, the task_started bookkeeping
+    # cast, retries/re-pushes/spillback after a bounce (recovery paths
+    # must not re-encode — see pack_spec_cached). Must be invalidated
+    # wherever a PACKED field mutates after unpack — today that is only
+    # retries_used on the retry path. Cached only under
+    # _PACKED_CACHE_MAX bytes (a million-spec backlog must not hold a
+    # duplicate serialized copy of large args; the head also drops it
+    # from long-retained specs after its push), and stripped from
+    # pickle below.
     _packed_bin: Any = dataclasses.field(default=None, repr=False)
 
     _SCRATCH = ("_rkey", "_demand", "_deps_pending", "_deferred_results",
@@ -187,35 +189,20 @@ def shape_key(spec: "TaskSpec") -> tuple:
 # submit+dispatch; src/specenc/specenc.c packs the spec's typed fields
 # straight to bytes. The two arbitrary-object fields
 # (scheduling_strategy, runtime_env) are pickled as embedded blobs —
-# and are None on the hot path. pack_spec returns None when the
-# extension is unavailable or a field doesn't fit the codec; callers
-# fall back to pickling the dataclass, so foreign producers (the C++
-# minipickle client) and exotic field values keep working.
-
-_enc = None
-_enc_tried = False
+# and are None on the hot path. The codec now lives behind
+# wirefmt.codec(): the C extension where it builds, a byte-identical
+# pure-Python fallback everywhere else (RAY_TPU_NATIVE=0 forces it) —
+# so the compiled encoding is ALWAYS available and every peer
+# advertises specenc. pack_spec returns None only when a field doesn't
+# fit the codec; callers fall back to pickling the dataclass, so
+# foreign producers (the C++ minipickle client) and exotic field
+# values keep working.
 
 
 def _specenc():
-    global _enc, _enc_tried
-    if _enc_tried:
-        return _enc
-    _enc_tried = True
-    try:
-        from ray_tpu._private import native_build
+    from ray_tpu._private import wirefmt
 
-        native_build.ensure_native()
-        path = os.path.join(native_build._OUT, "_specenc.so")
-        if os.path.exists(path):
-            import importlib.util
-
-            spec = importlib.util.spec_from_file_location("_specenc", path)
-            mod = importlib.util.module_from_spec(spec)
-            spec.loader.exec_module(mod)
-            _enc = mod
-    except Exception:
-        _enc = None
-    return _enc
+    return wirefmt.codec()
 
 
 def pack_spec(spec: "TaskSpec") -> "bytes | None":
@@ -257,6 +244,21 @@ def unpack_spec(data: bytes) -> "TaskSpec":
 
 
 _PACKED_CACHE_MAX = 4096
+
+
+def pack_spec_cached(spec: "TaskSpec") -> "bytes | None":
+    """pack_spec with the result cached on the spec (small specs only):
+    the owner packs ONCE per task and every subsequent send — the
+    task_started bookkeeping cast, a retry, a re-push after a bounce,
+    spillback to the head — reuses the bytes verbatim. The cache is
+    invalidated wherever a packed field mutates (retries_used on the
+    head's retry path) and stripped from pickle (__getstate__)."""
+    packed = spec._packed_bin
+    if packed is None:
+        packed = pack_spec(spec)
+        if packed is not None and len(packed) <= _PACKED_CACHE_MAX:
+            spec._packed_bin = packed
+    return packed
 
 
 def spec_from_body(body: dict) -> "TaskSpec":

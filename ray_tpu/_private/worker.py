@@ -31,7 +31,7 @@ from ray_tpu._private import forensics, worker_context
 from ray_tpu._private.config import GLOBAL_CONFIG
 from ray_tpu._private.ids import ObjectRef
 from ray_tpu._private.runtime import CoreRuntime
-from ray_tpu._private.task_spec import TaskSpec
+from ray_tpu._private.task_spec import TaskSpec, spec_from_body
 from ray_tpu.exceptions import TaskError
 
 
@@ -179,8 +179,6 @@ class Worker:
             self._exit.set()
             return
         if kind == "push_task":
-            from ray_tpu._private.task_spec import spec_from_body
-
             spec = spec_from_body(body)
             self._stamp_recv(spec, body)
             if spec.actor_id is None and not spec.actor_creation:
@@ -255,17 +253,29 @@ class Worker:
     def _dispatch_spec(self, spec, tpu_chips) -> None:
         """Route one spec into the execution machinery — shared by
         head pushes (push_task) and direct owner pushes (direct_push):
-        async-actor loop, the normal-task drainer deque, or the
-        (concurrency-group) thread pools."""
+        async-actor loop, the serial drainer deque, or the
+        (concurrency-group) thread pools.
+
+        The drainer deque covers BOTH pipelined normal tasks and
+        ordered (max_concurrency 1, ungrouped) actor method calls: a
+        Future + work-item per call (~10 us of ThreadPoolExecutor
+        machinery) is pure overhead when the owner pipelines a window
+        of calls — one drainer job runs them serially in arrival
+        order, which is exactly the ordered-actor contract."""
         if (self.async_exec is not None and spec.actor_id is not None
                 and not spec.actor_creation):
             self.async_exec.submit(
                 self._run_task_async_guarded(spec),
                 on_error=lambda exc, s=spec: self._async_task_crashed(
                     s, exc))
-        elif (spec.actor_id is None and not spec.actor_creation
-                and self.actor_instance is None
-                and spec.concurrency_group is None):
+        elif (not spec.actor_creation
+                and spec.concurrency_group is None
+                and not self.group_execs
+                and ((spec.actor_id is None
+                      and self.actor_instance is None)
+                     or (spec.actor_id is not None
+                         and self.actor_instance is not None
+                         and self.actor_max_concurrency <= 1))):
             with self._drain_lock:
                 self._task_q.append((spec, tpu_chips))
                 start = not self._drain_scheduled
@@ -287,9 +297,6 @@ class Worker:
         inflight high-water mark — or while retiring — pushes are
         REJECTED so the owner spills back to the head path instead of
         deepening an unbounded queue on a dying/overloaded worker."""
-        from ray_tpu._private.config import GLOBAL_CONFIG
-        from ray_tpu._private.task_spec import spec_from_body
-
         spec = spec_from_body(body)
         self._stamp_recv(spec, body)
         limit = GLOBAL_CONFIG.direct_worker_inflight_max
@@ -776,6 +783,14 @@ class Worker:
         except Exception:
             pass
         if not getattr(self._drainer_tls, "active", False):
+            return None
+        if self.actor_instance is not None:
+            # Ordered-actor semantics: a method blocked in a nested get
+            # blocks the calls queued behind it (reference: threaded
+            # actors with max_concurrency=1 do not interleave). The
+            # drainer hand-off below is the NORMAL-task deadlock
+            # escape; handing off here would let a later call overtake
+            # the blocked one.
             return None
         # This thread RETIRES as the active drainer either way (it
         # finishes only its current task after unblocking): exactly one

@@ -78,7 +78,13 @@ class ActorHandle:
         nr, group = self._method_meta.get(name, (1, None))
         if name in self._gen_methods:
             nr = "streaming"
-        return ActorMethod(self, name, nr, group)
+        m = ActorMethod(self, name, nr, group)
+        # Cache on the instance: ``handle.method`` in a hot submit loop
+        # resolves from __dict__ from now on, skipping this method and
+        # the per-call ActorMethod allocation. (__reduce__ rebuilds
+        # handles from ids only, so the cache never rides a pickle.)
+        self.__dict__[name] = m
+        return m
 
     def _submit_method(self, method: str, args, kwargs, num_returns,
                        concurrency_group: str | None = None,

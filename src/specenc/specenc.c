@@ -16,10 +16,22 @@
  * Interface (see task_spec.pack_spec / unpack_spec wrappers):
  *   pack(tuple) -> bytes     tuple of tagged-codable values
  *   unpack(bytes) -> tuple
+ *   pack_value(obj) -> bytes   one tagged value, no header (the binary
+ *   unpack_value(bytes) -> obj wire frames supply their own header —
+ *                              ray_tpu/_private/wirefmt.py)
  * Supported values: None, bool, int (64-bit signed), float, str,
- * bytes, list[str], dict[str,float], (str,int) pair. Anything else
- * raises TypeError — the wrapper falls back to pickle for the whole
- * spec, so foreign producers (the C++ minipickle client) keep working.
+ * bytes, list, tuple, dict with str keys, (str,int) pair. Anything
+ * else raises TypeError — the wrapper falls back to pickle for the
+ * whole spec/frame, so foreign producers (the C++ minipickle client)
+ * and exotic field values keep working.
+ *
+ * The generic container tags (T_LIST/T_MAP/T_TUPLE) are ADDITIVE to
+ * the v1 spec layout: pack() of a spec tuple emits exactly the same
+ * bytes as before (all-numeric dicts keep the compact T_DSF form the
+ * resources field always used), so packed specs stay byte-compatible
+ * across the upgrade. ray_tpu/_private/wirefmt.py carries a pure-
+ * Python codec for the identical byte format — mandatory fallback
+ * where this extension can't build.
  */
 
 #define PY_SSIZE_T_CLEAN
@@ -30,6 +42,11 @@
 #define MAGIC 0xA7u
 #define VERSION 1u
 
+/* Containers nest in practice <= ~6 deep (a frame body holding a list
+ * of record dicts); the cap exists so a corrupt/hostile buffer cannot
+ * recurse the C stack away. */
+#define MAX_DEPTH 64
+
 enum {
   T_NONE = 0,
   T_STR = 1,
@@ -39,8 +56,11 @@ enum {
   T_TRUE = 5,
   T_FALSE = 6,
   T_LSTR = 7,    /* list of str */
-  T_DSF = 8,     /* dict str -> float */
+  T_DSF = 8,     /* dict str -> float (all-numeric values) */
   T_PAIR_SI = 9, /* (str, int) — owner_addr */
+  T_LIST = 10,   /* generic list: varint n, then n values */
+  T_MAP = 11,    /* dict str -> any: varint n, then n (key, value) */
+  T_TUPLE = 12,  /* generic tuple: varint n, then n values */
 };
 
 /* ---- growable output buffer ---- */
@@ -106,7 +126,11 @@ static int enc_str_body(Out *o, PyObject *s) {
   return out_bytes(o, p, n);
 }
 
-static int enc_value(Out *o, PyObject *v) {
+static int enc_value(Out *o, PyObject *v, int depth) {
+  if (depth > MAX_DEPTH) {
+    PyErr_SetString(PyExc_TypeError, "specenc: nesting too deep");
+    return -1;
+  }
   if (v == Py_None) return out_u8(o, T_NONE);
   if (v == Py_True) return out_u8(o, T_TRUE);
   if (v == Py_False) return out_u8(o, T_FALSE);
@@ -137,51 +161,76 @@ static int enc_value(Out *o, PyObject *v) {
   }
   if (PyList_Check(v)) {
     Py_ssize_t n = PyList_GET_SIZE(v);
-    if (out_u8(o, T_LSTR) < 0) return -1;
+    /* All-str lists keep the compact T_LSTR tag (the v1 spec layout
+     * for deps/return_ids); anything else takes the generic tag. */
+    int all_str = 1;
+    for (Py_ssize_t k = 0; k < n; k++)
+      if (!PyUnicode_Check(PyList_GET_ITEM(v, k))) {
+        all_str = 0;
+        break;
+      }
+    if (out_u8(o, all_str ? T_LSTR : T_LIST) < 0) return -1;
     if (out_varint(o, (uint64_t)n) < 0) return -1;
     for (Py_ssize_t k = 0; k < n; k++) {
       PyObject *it = PyList_GET_ITEM(v, k);
-      if (!PyUnicode_Check(it)) {
-        PyErr_SetString(PyExc_TypeError, "list items must be str");
+      if (all_str ? enc_str_body(o, it) < 0
+                  : enc_value(o, it, depth + 1) < 0)
         return -1;
-      }
-      if (enc_str_body(o, it) < 0) return -1;
     }
     return 0;
   }
   if (PyDict_Check(v)) {
-    if (out_u8(o, T_DSF) < 0) return -1;
-    if (out_varint(o, (uint64_t)PyDict_GET_SIZE(v)) < 0) return -1;
+    /* All-numeric (non-bool) values keep the compact T_DSF float map
+     * (the v1 layout for the resources field — ints become floats,
+     * exactly as before); mixed values take the generic map, which
+     * preserves each value's type. */
     PyObject *key, *val;
     Py_ssize_t pos = 0;
+    int all_num = 1;
     while (PyDict_Next(v, &pos, &key, &val)) {
       if (!PyUnicode_Check(key)) {
         PyErr_SetString(PyExc_TypeError, "dict keys must be str");
         return -1;
       }
-      double d;
-      if (PyFloat_Check(val))
-        d = PyFloat_AS_DOUBLE(val);
-      else if (PyLong_Check(val)) {
-        d = PyLong_AsDouble(val);
-        if (d == -1.0 && PyErr_Occurred()) return -1;
-      } else {
-        PyErr_SetString(PyExc_TypeError, "dict values must be numeric");
-        return -1;
-      }
+      if (!PyFloat_Check(val) && !(PyLong_Check(val) && !PyBool_Check(val)))
+        all_num = 0;
+    }
+    if (out_u8(o, all_num ? T_DSF : T_MAP) < 0) return -1;
+    if (out_varint(o, (uint64_t)PyDict_GET_SIZE(v)) < 0) return -1;
+    pos = 0;
+    while (PyDict_Next(v, &pos, &key, &val)) {
       if (enc_str_body(o, key) < 0) return -1;
-      if (out_bytes(o, (const char *)&d, 8) < 0) return -1;
+      if (all_num) {
+        double d;
+        if (PyFloat_Check(val))
+          d = PyFloat_AS_DOUBLE(val);
+        else {
+          d = PyLong_AsDouble(val);
+          if (d == -1.0 && PyErr_Occurred()) return -1;
+        }
+        if (out_bytes(o, (const char *)&d, 8) < 0) return -1;
+      } else {
+        if (enc_value(o, val, depth + 1) < 0) return -1;
+      }
     }
     return 0;
   }
-  if (PyTuple_Check(v) && PyTuple_GET_SIZE(v) == 2 &&
-      PyUnicode_Check(PyTuple_GET_ITEM(v, 0)) &&
-      PyLong_Check(PyTuple_GET_ITEM(v, 1))) {
-    int64_t i = PyLong_AsLongLong(PyTuple_GET_ITEM(v, 1));
-    if (i == -1 && PyErr_Occurred()) return -1;
-    if (out_u8(o, T_PAIR_SI) < 0) return -1;
-    if (enc_str_body(o, PyTuple_GET_ITEM(v, 0)) < 0) return -1;
-    return out_varint(o, zigzag(i));
+  if (PyTuple_Check(v)) {
+    if (PyTuple_GET_SIZE(v) == 2 && PyUnicode_Check(PyTuple_GET_ITEM(v, 0)) &&
+        PyLong_Check(PyTuple_GET_ITEM(v, 1)) &&
+        !PyBool_Check(PyTuple_GET_ITEM(v, 1))) {
+      int64_t i = PyLong_AsLongLong(PyTuple_GET_ITEM(v, 1));
+      if (i == -1 && PyErr_Occurred()) return -1;
+      if (out_u8(o, T_PAIR_SI) < 0) return -1;
+      if (enc_str_body(o, PyTuple_GET_ITEM(v, 0)) < 0) return -1;
+      return out_varint(o, zigzag(i));
+    }
+    Py_ssize_t n = PyTuple_GET_SIZE(v);
+    if (out_u8(o, T_TUPLE) < 0) return -1;
+    if (out_varint(o, (uint64_t)n) < 0) return -1;
+    for (Py_ssize_t k = 0; k < n; k++)
+      if (enc_value(o, PyTuple_GET_ITEM(v, k), depth + 1) < 0) return -1;
+    return 0;
   }
   PyErr_Format(PyExc_TypeError, "specenc: unsupported value type %s",
                Py_TYPE(v)->tp_name);
@@ -239,8 +288,24 @@ static PyObject *dec_str(In *in) {
   return PyUnicode_DecodeUTF8(p, (Py_ssize_t)n, "strict");
 }
 
-static PyObject *dec_value(In *in) {
+/* Preallocating containers from a length prefix lets a corrupt frame
+ * demand petabytes; every element costs >= min_per bytes, so a count
+ * exceeding the remaining buffer is provably truncation/corruption. */
+static int in_count(In *in, uint64_t *n, uint64_t min_per) {
+  if (in_varint(in, n) < 0) return -1;
+  if (min_per && *n > (uint64_t)(in->end - in->p) / min_per) {
+    PyErr_SetString(PyExc_ValueError, "specenc: implausible count");
+    return -1;
+  }
+  return 0;
+}
+
+static PyObject *dec_value(In *in, int depth) {
   uint8_t tag;
+  if (depth > MAX_DEPTH) {
+    PyErr_SetString(PyExc_ValueError, "specenc: nesting too deep");
+    return NULL;
+  }
   if (in_u8(in, &tag) < 0) return NULL;
   switch (tag) {
     case T_NONE:
@@ -269,24 +334,50 @@ static PyObject *dec_value(In *in) {
       memcpy(&d, p, 8);
       return PyFloat_FromDouble(d);
     }
-    case T_LSTR: {
+    case T_LSTR:
+    case T_LIST:
+    case T_TUPLE: {
       uint64_t n;
-      if (in_varint(in, &n) < 0) return NULL;
-      PyObject *lst = PyList_New((Py_ssize_t)n);
+      if (in_count(in, &n, 1) < 0) return NULL;
+      PyObject *lst = (tag == T_TUPLE) ? PyTuple_New((Py_ssize_t)n)
+                                       : PyList_New((Py_ssize_t)n);
       if (!lst) return NULL;
       for (uint64_t k = 0; k < n; k++) {
-        PyObject *s = dec_str(in);
+        PyObject *s = (tag == T_LSTR) ? dec_str(in)
+                                      : dec_value(in, depth + 1);
         if (!s) {
           Py_DECREF(lst);
           return NULL;
         }
-        PyList_SET_ITEM(lst, (Py_ssize_t)k, s);
+        if (tag == T_TUPLE)
+          PyTuple_SET_ITEM(lst, (Py_ssize_t)k, s);
+        else
+          PyList_SET_ITEM(lst, (Py_ssize_t)k, s);
       }
       return lst;
     }
+    case T_MAP: {
+      uint64_t n;
+      if (in_count(in, &n, 2) < 0) return NULL;
+      PyObject *d = PyDict_New();
+      if (!d) return NULL;
+      for (uint64_t k = 0; k < n; k++) {
+        PyObject *key = dec_str(in);
+        PyObject *val = key ? dec_value(in, depth + 1) : NULL;
+        if (!val || PyDict_SetItem(d, key, val) < 0) {
+          Py_XDECREF(key);
+          Py_XDECREF(val);
+          Py_DECREF(d);
+          return NULL;
+        }
+        Py_DECREF(key);
+        Py_DECREF(val);
+      }
+      return d;
+    }
     case T_DSF: {
       uint64_t n;
-      if (in_varint(in, &n) < 0) return NULL;
+      if (in_count(in, &n, 9) < 0) return NULL;
       PyObject *d = PyDict_New();
       if (!d) return NULL;
       for (uint64_t k = 0; k < n; k++) {
@@ -352,7 +443,7 @@ static PyObject *specenc_pack(PyObject *self, PyObject *arg) {
       out_varint(&o, (uint64_t)n) < 0)
     goto fail;
   for (Py_ssize_t k = 0; k < n; k++)
-    if (enc_value(&o, PyTuple_GET_ITEM(arg, k)) < 0) goto fail;
+    if (enc_value(&o, PyTuple_GET_ITEM(arg, k), 0) < 0) goto fail;
   {
     PyObject *res = PyBytes_FromStringAndSize(o.buf, o.len);
     PyMem_Free(o.buf);
@@ -383,7 +474,7 @@ static PyObject *specenc_unpack(PyObject *self, PyObject *arg) {
   tup = PyTuple_New((Py_ssize_t)n);
   if (!tup) goto done;
   for (uint64_t k = 0; k < n; k++) {
-    PyObject *v = dec_value(&in);
+    PyObject *v = dec_value(&in, 0);
     if (!v) {
       Py_CLEAR(tup);
       goto done;
@@ -395,11 +486,41 @@ done:
   return tup;
 }
 
+static PyObject *specenc_pack_value(PyObject *self, PyObject *arg) {
+  Out o = {0};
+  if (enc_value(&o, arg, 0) < 0) {
+    PyMem_Free(o.buf);
+    return NULL;
+  }
+  PyObject *res = PyBytes_FromStringAndSize(o.buf, o.len);
+  PyMem_Free(o.buf);
+  return res;
+}
+
+static PyObject *specenc_unpack_value(PyObject *self, PyObject *arg) {
+  Py_buffer view;
+  if (PyObject_GetBuffer(arg, &view, PyBUF_SIMPLE) < 0) return NULL;
+  In in = {(const char *)view.buf, (const char *)view.buf + view.len};
+  PyObject *v = dec_value(&in, 0);
+  if (v && in.p != in.end) {
+    /* A decoder that silently ignores trailing bytes would mask a
+     * misframed stream; the wire layer treats this as corruption. */
+    Py_CLEAR(v);
+    PyErr_SetString(PyExc_ValueError, "specenc: trailing bytes");
+  }
+  PyBuffer_Release(&view);
+  return v;
+}
+
 static PyMethodDef methods[] = {
     {"pack", specenc_pack, METH_O,
      "pack(tuple) -> bytes: tagged compact encoding"},
     {"unpack", specenc_unpack, METH_O,
      "unpack(bytes) -> tuple: inverse of pack"},
+    {"pack_value", specenc_pack_value, METH_O,
+     "pack_value(obj) -> bytes: one tagged value, no header"},
+    {"unpack_value", specenc_unpack_value, METH_O,
+     "unpack_value(bytes) -> obj: inverse of pack_value"},
     {NULL, NULL, 0, NULL},
 };
 

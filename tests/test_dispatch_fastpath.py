@@ -303,6 +303,40 @@ def test_forensics_plane_zero_per_call_head_frames(cluster):
 # ------------------------------------------------------- metrics surface
 
 
+def test_binary_wire_negotiated_by_default(cluster):
+    """The binary hot-path wire format ships ON: the head connection
+    and the direct-plane peer connections all negotiated it, and the
+    hot kinds actually rode it (sent_kinds census shows direct_push
+    frames on a binary-enabled conn). The zero-head-frames guards in
+    this module therefore certify the BINARY dispatch path."""
+    from ray_tpu._private.config import GLOBAL_CONFIG
+
+    assert GLOBAL_CONFIG.wire_binary  # the default ships ON
+    rt = global_runtime()
+    assert rt.conn.wire_binary, "head connection never negotiated binary"
+
+    @ray_tpu.remote
+    def warm(x):
+        return x
+
+    # Warm until a lease-backed DIRECT push happened: the owner→worker
+    # peer connection only exists once the direct plane used it.
+    assert ray_tpu.get(warm.remote(0)) == 0
+    _wait(lambda: len(rt._direct.lease_pools) > 0, msg="no lease granted")
+    before_push = _direct_push_count(rt)
+    deadline = time.monotonic() + 15
+    i = 1
+    while _direct_push_count(rt) == before_push:
+        assert time.monotonic() < deadline, "no direct push ever happened"
+        assert ray_tpu.get(warm.remote(i)) == i
+        i += 1
+    with rt._owner_conns_lock:
+        conns = list(rt._owner_conns.values())
+    assert conns, "no peer connections established"
+    assert all(c.wire_binary for c in conns), \
+        "peer connections never negotiated binary"
+
+
 def test_rpc_counters_exposed(cluster):
     from ray_tpu.util import metrics
 

@@ -1,0 +1,452 @@
+"""Binary hot-path wire format tests (wirefmt.py + src/specenc/specenc.c).
+
+Every round-trip test runs TWICE — once against the native _specenc.so
+C fast lane and once against the pure-Python fallback codec — so a
+build environment without a compiler or Python headers can't silently
+drop native coverage (the native param skips with a reason there), and
+a box WITH the extension still proves the fallback. The two codecs must
+be byte-identical: a cluster can mix processes where only some managed
+to build the extension.
+
+Also carries the decoder robustness contract: truncated/corrupted
+binary frames raise the typed WireDecodeError (never hang, never leak
+another exception type), and a Connection that receives an undecodable
+frame CLOSES instead of leaving its reader dead with pending calls
+armed. Plus the packed-spec reuse regression (a recovered direct task
+must reuse its cached encoding, not re-pack).
+"""
+
+import pickle
+import random
+import threading
+import time
+
+import pytest
+
+from ray_tpu._private import faultinject, rpc, task_spec, wirefmt
+from ray_tpu._private.task_spec import TaskSpec, pack_spec, unpack_spec
+
+
+@pytest.fixture(params=["native", "pure"])
+def wire_codec(request, monkeypatch):
+    """The active codec for wirefmt/pack_spec, parametrized over both
+    implementations (tier-1 must exercise BOTH paths)."""
+    if request.param == "native":
+        monkeypatch.delenv("RAY_TPU_NATIVE", raising=False)
+        c = wirefmt._load_codec()
+        if c is wirefmt.PY_CODEC:
+            pytest.skip("native _specenc.so unavailable "
+                        "(no compiler / Python dev headers on this box)")
+    else:
+        c = wirefmt.PY_CODEC
+    monkeypatch.setattr(wirefmt, "_codec", c)
+    return c
+
+
+def _spec(deadline=0.0) -> TaskSpec:
+    return TaskSpec(
+        task_id="t" * 16, name="fn", func_id="f" * 16, args=b"\x80\x05args",
+        deps=["d" * 16], return_ids=["r" * 16], resources={"CPU": 1},
+        owner_id="owner-1", owner_addr=("127.0.0.1", 4242),
+        max_retries=3, retries_used=1, deadline=deadline)
+
+
+def _hot_bodies() -> dict:
+    sb = pack_spec(_spec(deadline=time.time() + 60))
+    return {
+        "direct_push": {"spec_bin": sb, "evt": {"submit": 1.5, "push": 2.5},
+                        "tpu_chips": [0, 1]},
+        "direct_ack": {"task_ids": ["a" * 16, "b" * 16]},
+        "direct_rej": {"task_id": "a" * 16},
+        "owner_sealed": {"objects": [
+            {"object_id": "o" * 16, "owner_id": "w", "size": 11,
+             "is_error": False, "direct": True, "contained_ids": []}],
+            "t_resolve": 3.25},
+        "task_started": {"spec_bin": sb, "worker_id": "w-1",
+                         "direct": "actor", "evt": {"push": 2.5}},
+        "task_finished": {"worker_id": "w-1", "task_id": "a" * 16,
+                          "failed": False,
+                          "results": [{"object_id": "o" * 16,
+                                       "payload": b"\x00\xffpayload",
+                                       "is_error": False,
+                                       "contained_ids": ["c" * 16]}],
+                          "sealed_pending": None,
+                          "events": [{"task_id": "a" * 16, "name": "fn",
+                                      "pid": 1234, "failed": False,
+                                      "phases": {"recv": 1.0,
+                                                 "exec_end": 2.0}}]},
+        "seal_objects": {"objects": [{"object_id": "o" * 16,
+                                      "remote": True}]},
+        "push_task": {"spec_bin": sb, "tpu_chips": [],
+                      "evt": {"dispatch": 9.0}},
+        "submit_task": {"spec_bin": sb, "evt": {"submit": 1.0},
+                        "lease_key": ((("CPU", 1.0),), None)},
+        "cancel_direct": {"task_id": "a" * 16},
+    }
+
+
+# ------------------------------------------------------------ round trip
+
+
+def test_every_hot_kind_round_trips(wire_codec):
+    for kind, body in _hot_bodies().items():
+        data = wirefmt.encode(kind, 0, body)
+        assert data is not None, f"{kind} should be binary-encodable"
+        assert data[0] == wirefmt.WIRE_MAGIC
+        k, msg_id, out = wirefmt.decode_frame(data)
+        assert (k, msg_id) == (kind, 0)
+        assert out == body, kind
+
+
+def test_cast_batch_round_trips_and_mixed_falls_back(wire_codec):
+    records = [(k, b) for k, b in _hot_bodies().items()]
+    data = wirefmt.encode("__cast_batch__", 0, records)
+    assert data is not None
+    k, _mid, out = wirefmt.decode_frame(data)
+    assert k == "__cast_batch__"
+    assert [tuple(r) for r in out] == records
+    # A batch holding any COLD kind must fall back whole to pickle.
+    assert wirefmt.encode("__cast_batch__", 0,
+                          records + [("register", {})]) is None
+
+
+def test_cold_kinds_and_exotic_bodies_fall_back_to_pickle(wire_codec):
+    assert wirefmt.encode("register", 1, {"pid": 1}) is None
+    assert wirefmt.encode("rpc_report", 0, {}) is None
+    # Hot kind, uncodable body (arbitrary object): pickle fallback.
+    assert wirefmt.encode("direct_push", 0, {"spec": _spec()}) is None
+
+
+def test_packed_spec_deadline_trailing_field(wire_codec):
+    """The PR 5 deadline rides the compiled encoding as an optional
+    TRAILING field: absent-deadline payloads stay byte-identical to the
+    pre-overload-plane format, and both codecs agree byte-for-byte."""
+    plain = pack_spec(_spec())
+    with_dl = pack_spec(_spec(deadline=1234.5))
+    assert plain is not None and with_dl is not None
+    assert len(with_dl) > len(plain)
+    assert unpack_spec(plain).deadline == 0.0
+    assert unpack_spec(with_dl).deadline == 1234.5
+    # Byte-parity between the C fast lane and the pure-Python fallback
+    # (a mixed cluster packs on one implementation, unpacks on the
+    # other).
+    for s in (_spec(), _spec(deadline=1234.5)):
+        tup = (s.task_id, s.name, s.func_id, s.args, list(s.deps),
+               list(s.return_ids), s.resources, s.owner_id,
+               tuple(s.owner_addr), s.max_retries, s.retries_used)
+        assert wirefmt.PY_CODEC.pack(tup) == wire_codec.pack(tup)
+        assert wirefmt.PY_CODEC.unpack(wire_codec.pack(tup)) == tup
+
+
+def test_random_value_trees_round_trip(wire_codec):
+    rng = random.Random(20260804)
+
+    def val(depth=0):
+        c = rng.randrange(10 if depth < 3 else 7)
+        if c == 0:
+            return None
+        if c == 1:
+            return rng.choice([True, False])
+        if c == 2:
+            return rng.randrange(-2 ** 48, 2 ** 48)
+        if c == 3:
+            return rng.random() * 1e9
+        if c == 4:
+            return "s" * rng.randrange(8)
+        if c == 5:
+            return bytes(rng.randrange(256)
+                         for _ in range(rng.randrange(8)))
+        if c == 6:
+            return rng.choice(["", "a", "κλειδί"])
+        if c == 7:
+            return [val(depth + 1) for _ in range(rng.randrange(4))]
+        if c == 8:
+            return tuple(val(depth + 1) for _ in range(rng.randrange(4)))
+        return {f"k{i}": val(depth + 1) for i in range(rng.randrange(4))}
+
+    for _ in range(300):
+        v = val()
+        data = wire_codec.pack_value(v)
+        assert wire_codec.unpack_value(data) == v
+        # Cross-implementation parity on every sample.
+        assert wirefmt.PY_CODEC.pack_value(v) == data
+        assert wirefmt.PY_CODEC.unpack_value(data) == v
+
+
+def test_compact_tags_preserve_container_types(wire_codec):
+    """int-vs-float and list-vs-tuple fidelity for the generic tags
+    (the all-numeric dict keeps v1's float-map form, as the spec's
+    resources field always did)."""
+    v = {"size": 3, "name": "x", "ids": ["a"], "pair": ("h", 1),
+         "nested": ({"ok": True},)}
+    out = wire_codec.unpack_value(wire_codec.pack_value(v))
+    assert out == v
+    assert type(out["size"]) is int
+    assert type(out["pair"]) is tuple
+    assert type(out["nested"]) is tuple
+    # All-numeric dicts normalize to float (byte-compat with v1).
+    assert wire_codec.unpack_value(
+        wire_codec.pack_value({"CPU": 1})) == {"CPU": 1.0}
+
+
+# ------------------------------------------------- decoder robustness
+
+
+def test_truncated_frames_raise_typed_error(wire_codec):
+    for kind, body in _hot_bodies().items():
+        data = wirefmt.encode(kind, 0, body)
+        step = max(1, len(data) // 64)  # sample cut points on big frames
+        for cut in range(0, len(data), step):
+            with pytest.raises(wirefmt.WireDecodeError):
+                wirefmt.decode_frame(data[:cut])
+
+
+def test_corrupted_frames_never_leak_or_hang(wire_codec):
+    rng = random.Random(7)
+    base = wirefmt.encode("task_finished", 0,
+                          _hot_bodies()["task_finished"])
+    for _ in range(400):
+        buf = bytearray(base)
+        for _ in range(rng.randrange(1, 4)):
+            buf[rng.randrange(len(buf))] = rng.randrange(256)
+        buf = bytes(buf)
+        if buf[0] != wirefmt.WIRE_MAGIC:
+            continue  # reader would route it to pickle.loads instead
+        t0 = time.monotonic()
+        try:
+            wirefmt.decode_frame(buf)  # may survive a payload-byte flip
+        except wirefmt.WireDecodeError:
+            pass  # the ONLY allowed failure type
+        assert time.monotonic() - t0 < 1.0
+
+
+def test_implausible_counts_and_bad_header_rejected(wire_codec):
+    # Version from the future: negotiate-down peers never send it, but
+    # a corrupted byte can claim it.
+    with pytest.raises(wirefmt.WireDecodeError):
+        wirefmt.decode_frame(bytes([wirefmt.WIRE_MAGIC, 99, 1, 0, 0]))
+    with pytest.raises(wirefmt.WireDecodeError):
+        wirefmt.decode_frame(bytes([wirefmt.WIRE_MAGIC, 1, 250, 0, 0]))
+    # A container length prefix far past the buffer must error, not
+    # preallocate petabytes or spin.
+    giant = bytes([wirefmt.WIRE_MAGIC, 1, 2, 0, 0,
+                   10]) + b"\xff\xff\xff\xff\xff\xff\xff\xff\x7f"
+    with pytest.raises(wirefmt.WireDecodeError):
+        wirefmt.decode_frame(giant)
+    with pytest.raises(ValueError):
+        wire_codec.unpack_value(
+            bytes([10]) + b"\xff\xff\xff\xff\x0f")
+    # Trailing garbage after a valid value = misframed stream.
+    with pytest.raises(ValueError):
+        wire_codec.unpack_value(wire_codec.pack_value(1) + b"\x00")
+
+
+def test_connection_closes_on_undecodable_frame():
+    """A poisoned frame must close the connection (pending calls fail
+    fast) — never kill the reader thread silently, which would hang
+    every outstanding call forever."""
+    seen = []
+
+    def handler(kind, body, conn):
+        seen.append(kind)
+        return {"ok": True}
+
+    server = rpc.Server(handler)
+    try:
+        conn = rpc.connect(server.address, name="fuzz")
+        assert conn.call("anything", {}, timeout=5) == {"ok": True}
+        deadline = time.monotonic() + 5
+        while not server.connections and time.monotonic() < deadline:
+            time.sleep(0.01)
+        server_conn = server.connections[0]
+        # Garbage binary frame straight onto the socket, then a valid
+        # pickled frame behind it: the valid frame must NOT be
+        # dispatched (the stream is out of trust after the poison).
+        bad = bytes([wirefmt.WIRE_MAGIC, 1, 250, 0, 0, 99])
+        good = pickle.dumps(("late_cast", 0, {}), protocol=5)
+        conn._sock.sendall(rpc._HDR.pack(len(bad)) + bad
+                           + rpc._HDR.pack(len(good)) + good)
+        deadline = time.monotonic() + 5
+        while not server_conn.closed and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert server_conn.closed, "poisoned conn never closed"
+        assert "late_cast" not in seen
+    finally:
+        server.stop()
+
+
+# ------------------------------------------ coalescing + counters/chaos
+
+
+def test_coalesce_casts_merges_adjacent_same_kind_only():
+    buf = [("direct_ack", {"task_ids": ["a"]}),
+           ("direct_ack", {"task_ids": ["b", "c"]}),
+           ("seal_objects", {"objects": [1]}),
+           ("seal_objects", {"objects": [2]}),
+           ("direct_push", {"spec_bin": b"x"}),
+           ("direct_ack", {"task_ids": ["d"]})]
+    out = wirefmt.coalesce_casts(buf)
+    assert [(k, n) for k, _b, n in out] == [
+        ("direct_ack", 2), ("seal_objects", 2), ("direct_push", 1),
+        ("direct_ack", 1)]
+    assert out[0][1] == {"task_ids": ["a", "b", "c"]}
+    assert out[1][1] == {"objects": [1, 2]}
+    # owner_sealed keeps the latest resolve stamp across merged records.
+    merged = wirefmt.coalesce_casts(
+        [("owner_sealed", {"objects": [1], "t_resolve": 1.0}),
+         ("owner_sealed", {"objects": [2], "t_resolve": 2.0})])
+    assert merged[0][1] == {"objects": [1, 2], "t_resolve": 2.0}
+
+
+class _Loopback:
+    """A served connection pair with receipt recording."""
+
+    def __init__(self):
+        self.received = []
+        self.ev = threading.Event()
+        self.server = rpc.Server(self._handle)
+        self.conn = rpc.connect(self.server.address, name="test")
+        # Keep the global ~1 ms flusher's hands off this connection:
+        # the tests below assert exact frame/merge boundaries, so the
+        # flush must be the explicit one.
+        self.conn._flusher_hot = True
+
+    def _handle(self, kind, body, conn):
+        self.received.append((kind, body))
+        self.ev.set()
+        return None
+
+    def wait(self, n, timeout=5.0):
+        deadline = time.monotonic() + timeout
+        while len(self.received) < n and time.monotonic() < deadline:
+            self.ev.wait(0.05)
+            self.ev.clear()
+        return self.received
+
+    def close(self):
+        self.conn.close()
+        self.server.stop()
+
+
+@pytest.mark.parametrize("binary", [True, False])
+def test_flush_coalesces_acks_and_counters_count_records(binary):
+    """N buffered acks ship as ONE frame whose body holds N records —
+    and frames_sent/sent_kinds stay truthful (records, not frames), on
+    the binary and the pickled path identically."""
+    lb = _Loopback()
+    try:
+        lb.conn.wire_binary = binary
+        frames0 = lb.conn.frames_sent
+        for i in range(10):
+            lb.conn.cast_buffered("direct_ack", {"task_ids": [f"t{i}"]})
+        lb.conn.flush_casts()
+        got = lb.wait(1)
+        assert len(got) == 1
+        assert got[0][0] == "direct_ack"
+        assert got[0][1]["task_ids"] == [f"t{i}" for i in range(10)]
+        assert lb.conn.sent_kinds["direct_ack"] == 10
+        assert lb.conn.frames_sent == frames0 + 1
+    finally:
+        lb.close()
+
+
+@pytest.mark.parametrize("binary", [True, False])
+def test_chaos_injection_sees_merged_frame_kinds(binary):
+    """faultinject.apply_send must see binary/coalesced frames under
+    their REAL kind: a drop rule for direct_ack kills the merged ack
+    frame (frame-level granularity, exactly like per-frame injection on
+    the pickled path), and dup delivers it twice."""
+    lb = _Loopback()
+    try:
+        lb.conn.wire_binary = binary
+        with faultinject.inject({"seed": 1, "rules": [
+                {"kind": "direct_ack", "drop": 1.0}]}):
+            for i in range(5):
+                lb.conn.cast_buffered("direct_ack",
+                                      {"task_ids": [f"t{i}"]})
+            lb.conn.flush_casts()
+            lb.conn.cast("probe", {})  # un-matched kind: sails through
+        got = lb.wait(1)
+        assert [k for k, _ in got] == ["probe"], \
+            "dropped merged ack frame must not arrive"
+        lb.received.clear()
+        with faultinject.inject({"seed": 1, "rules": [
+                {"kind": "seal_objects", "dup": 1.0}]}):
+            lb.conn.cast_buffered("seal_objects", {"objects": [
+                {"object_id": "o1", "remote": True}]})
+            lb.conn.cast_buffered("seal_objects", {"objects": [
+                {"object_id": "o2", "remote": True}]})
+            lb.conn.flush_casts()
+        got = lb.wait(2)
+        assert [k for k, _ in got] == ["seal_objects", "seal_objects"]
+        assert got[0][1] == got[1][1]  # the duplicated merged frame
+        assert [o["object_id"] for o in got[0][1]["objects"]] == [
+            "o1", "o2"]
+    finally:
+        lb.close()
+
+
+def test_binary_frames_flow_between_real_connections(wire_codec):
+    """End-to-end over a real socket with binary negotiated ON: hot
+    casts and batches arrive intact (decoded by the self-detecting
+    reader), cold calls still round-trip via pickle."""
+    lb = _Loopback()
+    try:
+        lb.conn.wire_binary = True
+        body = _hot_bodies()["direct_push"]
+        lb.conn.cast("direct_push", body)
+        lb.conn.cast_buffered("direct_push", body)
+        lb.conn.cast_buffered("task_finished",
+                              _hot_bodies()["task_finished"])
+        lb.conn.flush_casts()
+        got = lb.wait(3)
+        assert [k for k, _ in got] == ["direct_push", "direct_push",
+                                       "task_finished"]
+        assert got[0][1] == body and got[1][1] == body
+    finally:
+        lb.close()
+
+
+# ------------------------------------------------ RAY_TPU_NATIVE gate
+
+
+def test_native_kill_switch_forces_pure_python(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_NATIVE", "0")
+    assert wirefmt._load_codec() is wirefmt.PY_CODEC
+    from ray_tpu._private import native_build
+
+    assert native_build.ensure_native() is False
+
+
+# -------------------------------------- packed-spec reuse (recovery)
+
+
+def test_recovered_direct_task_reuses_packed_bytes(wire_codec,
+                                                   monkeypatch):
+    """Regression: _spec_body dropped the compiled encoding after its
+    first use, so the task_started cast re-packed every push and every
+    recovery path (retry, direct_rej re-push, spillback) re-encoded
+    from scratch. The cache must survive across sends."""
+    from ray_tpu._private.direct import DirectPlane
+
+    spec = _spec(deadline=time.time() + 60)
+    body1 = DirectPlane._spec_body(None, spec, True)
+    assert "spec_bin" in body1
+    assert spec._packed_bin == body1["spec_bin"]
+
+    def _boom(_spec):
+        raise AssertionError("re-packed a spec with cached bytes")
+
+    monkeypatch.setattr(task_spec, "pack_spec", _boom)
+    # Second send (the re-push/recovery path) must reuse the bytes.
+    body2 = DirectPlane._spec_body(None, spec, True)
+    assert body2["spec_bin"] is body1["spec_bin"]
+    # The cache is scratch: never shipped inside a pickled spec.
+    assert pickle.loads(pickle.dumps(spec))._packed_bin is None
+    # Oversized specs are not cached (a million-spec backlog must not
+    # hold duplicate arg bytes).
+    monkeypatch.undo()
+    big = _spec()
+    big.args = b"x" * (task_spec._PACKED_CACHE_MAX + 1)
+    DirectPlane._spec_body(None, big, True)
+    assert big._packed_bin is None
