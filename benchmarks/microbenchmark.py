@@ -129,6 +129,7 @@ def main(as_json: bool = False) -> dict:
     bench_forensics_overhead(results)
     bench_admission_overhead(results)
     bench_deadline_overhead(results)
+    bench_census_overhead(results)
     if as_json:
         print(json.dumps({"microbenchmark": results}))
     return results
@@ -268,6 +269,40 @@ def bench_deadline_overhead(results: dict) -> None:
            lambda: ray_tpu.get([stamped.remote(i) for i in range(N)]),
            N, results=results)
     ray_tpu.shutdown()
+
+
+def bench_census_overhead(results: dict) -> None:
+    """Object-census overhead (RAY_TPU_OBJECT_CENSUS_ENABLED): the
+    steady-state cost is one interned-callsite lookup + a dict write
+    per put/submit and a dict pop per ref release — the summary ships
+    piggybacked on the amortized rpc_report cast, never per call. The
+    on/off delta across task floods and put loops must be within run
+    noise (±5%, the CI guard for "the census is steady-state free")."""
+    import os
+
+    from ray_tpu._private import config as config_mod
+
+    for mode in ("on", "off"):
+        os.environ["RAY_TPU_OBJECT_CENSUS_ENABLED"] = (
+            "1" if mode == "on" else "0")
+        config_mod.GLOBAL_CONFIG.object_census_enabled = (mode == "on")
+        ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024 * 1024,
+                     log_to_driver=False)
+
+        @ray_tpu.remote
+        def ctask(i):
+            return i
+
+        N = 100
+        ray_tpu.get([ctask.remote(i) for i in range(64)])  # warm leases
+        timeit(f"tasks async census {mode}",
+               lambda: ray_tpu.get([ctask.remote(i) for i in range(N)]),
+               N, results=results)
+        timeit(f"put sync census {mode}",
+               lambda: ray_tpu.put(b"x" * 100), results=results)
+        ray_tpu.shutdown()
+    os.environ.pop("RAY_TPU_OBJECT_CENSUS_ENABLED", None)
+    config_mod.GLOBAL_CONFIG.object_census_enabled = True
 
 
 def bench_event_overhead(results: dict) -> None:

@@ -20,6 +20,10 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    # Runnable as `python benchmarks/scale_envelope.py` from anywhere:
+    # script mode puts benchmarks/ (not the repo root) on sys.path.
+    sys.path.insert(0, REPO)
 
 PROFILES = {
     "quick": {
@@ -223,6 +227,40 @@ def _run_sections(p: dict, results: dict) -> dict:
     for pg in pgs:
         remove_placement_group(pg)
     results["pg_remove_per_s"] = round(n_pg / (time.time() - t0), 1)
+
+    # 4d. Object-plane footprint: a `ray-tpu memory --format json`
+    #     snapshot against the live head, so SCALE.json records what
+    #     the object table + censuses look like after the flood
+    #     sections (observe-first contract for the object-plane arc).
+    from ray_tpu._private.worker_context import get_head as _gh
+
+    addr = _gh().address
+    try:
+        out = subprocess.run(
+            [sys.executable, "-m", "ray_tpu.scripts", "memory",
+             "--format", "json", "--address", f"{addr[0]}:{addr[1]}",
+             "--limit", "10"],
+            capture_output=True, text=True, timeout=120,
+            env={**os.environ,
+                 "PYTHONPATH": REPO + os.pathsep
+                 + os.environ.get("PYTHONPATH", "")})
+        mem = json.loads(out.stdout)
+        store = mem.get("store") or {}
+        summary = mem.get("summary") or {}
+        results["object_plane"] = {
+            "store_in_use": store.get("in_use"),
+            "store_entries": store.get("num_entries"),
+            "pinned_bytes": store.get("pinned_bytes"),
+            "reclaimable_bytes": store.get("reclaimable_bytes"),
+            "fragmented_free": store.get("fragmented_free"),
+            "census_groups": len(summary.get("groups") or {}),
+            "census_live_bytes": sum(
+                c.get("live_bytes", 0) for c in
+                (summary.get("census_clients") or {}).values()),
+            "leak_suspects": len(summary.get("leak_suspects") or []),
+        }
+    except Exception as e:  # noqa: BLE001 — the snapshot must never
+        results["object_plane"] = {"error": str(e)}  # fail the envelope
 
     # 5. Broadcast a large object to simulated nodes (reference row:
     #    1 GiB broadcast to 50+ nodes): every agent node pulls the

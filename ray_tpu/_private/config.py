@@ -233,6 +233,35 @@ class Config:
     # many heartbeats feeds the head's per-node clock-offset table used
     # to align cross-node trace spans.
     clock_sync_every_n_heartbeats: int = 5
+    # Object-plane observability (_private/objcensus.py): each owner
+    # runtime tracks its live ObjectRefs with the creating callsite
+    # (interned — the hot path pays one dict lookup), size, and kind;
+    # a bounded per-callsite summary piggybacks on the amortized
+    # rpc_report cast and feeds `ray-tpu memory` + the leak detector.
+    # Zero new per-call head frames (guard: test_dispatch_fastpath).
+    object_census_enabled: bool = True
+    # Owner-side census table bound (records beyond it are counted as
+    # dropped, never tracked — a runaway ref leak must not leak the
+    # instrument too).
+    object_census_max_entries: int = 100_000
+    # Callsite groups per piggybacked census report (rest fold into an
+    # "(other callsites)" bucket) and sample object ids per group (the
+    # head's per-object callsite attribution for drill-downs).
+    object_census_report_groups: int = 64
+    object_census_sample_ids: int = 8
+    # Leak detector (head-side sweep, observe-only — flags, never
+    # kills): a callsite whose live bytes grew monotonically across
+    # this many consecutive census reports becomes a suspect; an object
+    # SEALED but never fetched past the TTL becomes a suspect; borrows
+    # outliving their owner's ref become suspects.
+    object_leak_windows: int = 3
+    object_leak_ttl_s: float = 300.0
+    # Sweep cadence (rides the head health loop) and a per-entry scan
+    # cap: past it the sealed-never-read sweep is skipped that tick (a
+    # million-object flood must not stall the health loop).
+    object_leak_sweep_interval_s: float = 5.0
+    object_leak_scan_cap: int = 250_000
+
     # Post-mortem crash forensics (_private/forensics.py): workers arm
     # faulthandler + excepthooks into a per-worker crash file and stamp
     # a tiny mmap'd beacon per task; supervisors reap the real exit

@@ -257,6 +257,8 @@ class DirectPlane:
             for oid in spec.return_ids:
                 self.by_oid[oid] = ("lease", key, spec.task_id)
             addr, wid, enc = lease.addr, lease.worker_id, lease.specenc
+        if self.rt._census is not None:
+            self.rt._census.mark_direct(spec.return_ids)
         self._push(addr, wid, spec, [], enc, kind="lease")
         return True
 
@@ -284,6 +286,10 @@ class DirectPlane:
                                time.monotonic(), False]
         for oid in spec.return_ids:
             self.by_oid[oid] = (kind, route_key, spec.task_id)
+        if self.rt._census is not None:
+            # Object census: these returns rode the direct plane (the
+            # `ray-tpu memory` kind column shows return+direct).
+            self.rt._census.mark_direct(spec.return_ids)
 
     def _maybe_request_info_locked(self, r: _ActorRoute) -> None:
         now = time.monotonic()

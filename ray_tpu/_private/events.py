@@ -179,6 +179,13 @@ class EventTable:
         self._by_task: dict[str, dict] = {}
         self._oid_task: dict[str, str] = {}
         self._oid_fifo: deque = deque()
+        # Persistent (bounded) object -> producing-task index for the
+        # object plane's lineage cross-link: _oid_task above is POPPED
+        # when the owner confirms the seal (resolve attribution), but
+        # `ray-tpu memory` drill-downs need "which task produced this
+        # object" for the object's whole life.
+        self._oid_producer: dict[str, str] = {}
+        self._oid_producer_fifo: deque = deque()
         self._lock = threading.Lock()
         self.phase_hists: dict[str, PhaseHistogram] = {}
 
@@ -257,8 +264,31 @@ class EventTable:
                 if oid not in self._oid_task:
                     self._oid_task[oid] = task_id
                     self._oid_fifo.append(oid)
+                if oid not in self._oid_producer:
+                    self._oid_producer[oid] = task_id
+                    self._oid_producer_fifo.append(oid)
             while len(self._oid_fifo) > self.maxlen:
                 self._oid_task.pop(self._oid_fifo.popleft(), None)
+            while len(self._oid_producer_fifo) > self.maxlen:
+                self._oid_producer.pop(
+                    self._oid_producer_fifo.popleft(), None)
+
+    def producer_task(self, oid: str) -> "str | None":
+        """The task id whose return this object is, if still indexed
+        (bounded FIFO — floods evict oldest first)."""
+        with self._lock:
+            return self._oid_producer.get(oid)
+
+    def task_record(self, task_id: str) -> "dict | None":
+        """A copy of one task's merged lifecycle event (phases, worker,
+        node, name) — the flight-recorder half of an object drill-down."""
+        with self._lock:
+            ev = self._by_task.get(task_id)
+            if ev is None:
+                return None
+            out = dict(ev)
+            out["phases"] = dict(ev.get("phases") or {})
+            return out
 
     def resolve(self, oids, ts: float) -> None:
         """The owner confirmed holding these results: stamp the resolve

@@ -64,7 +64,7 @@ class ObjectEntry:
         "refcount", "read_pins", "task_pins", "lru", "is_error", "owner_id",
         "created_at", "location", "remote_offset", "borrowers",
         "container_pins", "contained", "pin_holders", "replicas", "rr",
-        "owner_resident",
+        "owner_resident", "reads", "last_read",
     )
 
     def __init__(self, object_id: str, owner_id: str):
@@ -76,6 +76,11 @@ class ObjectEntry:
         self.spill_path: str | None = None
         self.refcount = 0
         self.read_pins = 0
+        # Object-plane observability: how many times a meta for this
+        # entry was served (leak detector: SEALED + never read past the
+        # TTL = suspect) and when last.
+        self.reads = 0
+        self.last_read = 0.0
         # read_pins by holder client (zero-copy gets hold pins for the
         # life of the aliasing arrays, so a crashed client's pins must
         # be reaped on disconnect or the object could never spill/free).
@@ -369,6 +374,20 @@ class Head:
         # (workers/drivers via the amortized rpc_report cast, agents
         # piggybacked on their heartbeats).
         self.rpc_reports: dict[str, dict] = {}
+        # --- object-plane observability ---
+        # Owner censuses (objcensus.py summaries piggybacked on
+        # rpc_report): client_id -> {"ts", "groups", "live_objects",
+        # "live_bytes", ...}. Merged with self.objects into the
+        # `ray-tpu memory` view (memory_summary handler).
+        self.object_census: dict[str, dict] = {}
+        # Leak-detector trend windows: (client_id, callsite) ->
+        # deque[(ts, bytes, count)], one sample per census REPORT (not
+        # per sweep — "grew across N report windows" means N reports).
+        self._census_history: dict[tuple, deque] = {}
+        # Leak suspects (observe-only: flagged with trend data, never
+        # killed): suspect key -> record. Swept by the health loop.
+        self.leak_suspects: dict[str, dict] = {}
+        self._last_leak_sweep = 0.0
         self.metrics: dict[str, Any] = {}
         # Core runtime counters (reference: DEFINE_stats core metric set,
         # src/ray/stats/metric_defs.h:46 — `tasks`, `actors`, …); gauges
@@ -822,6 +841,16 @@ class Head:
             self.clients.pop(client_id, None)
             self.client_owner_addrs.pop(client_id, None)
             self.rpc_reports.pop(client_id, None)
+            # A dead owner's census dies with it (its refs are gone);
+            # its leak-trend windows and callsite suspects clear too.
+            if self.object_census.pop(client_id, None) is not None:
+                for key in [k for k in self._census_history
+                            if k[0] == client_id]:
+                    del self._census_history[key]
+                for key in [k for k in self.leak_suspects
+                            if self.leak_suspects[k].get("owner")
+                            == client_id]:
+                    del self.leak_suspects[key]
             # A dead owner's worker leases end now (its direct pushes
             # died with it; the workers must rejoin the pool).
             for w in self.workers.values():
@@ -1010,9 +1039,50 @@ class Head:
                     "counters": body.get("counters") or {},
                     "type": body.get("client_type"),
                     "ts": time.time()}
+                if body.get("census") is not None:
+                    self._census_intake(cid, body["census"])
         if body.get("chaos_events"):
             self.task_events.extend(body["chaos_events"])
         return None
+
+    def _census_intake(self, cid: str, census: dict) -> None:
+        """lock held. Store an owner's piggybacked census summary and
+        advance the leak detector's per-callsite trend windows — one
+        sample per REPORT, so "grew across N windows" means N
+        consecutive reports, independent of sweep cadence."""
+        now = time.time()
+        census = dict(census)
+        census["ts"] = now
+        self.object_census[cid] = census
+        groups = census.get("groups") or {}
+        keep = max(3, int(self.config.object_leak_windows) + 1)
+        for site, g in groups.items():
+            if site == "(other callsites)":
+                continue
+            hist = self._census_history.get((cid, site))
+            if hist is None:
+                hist = self._census_history[(cid, site)] = deque(
+                    maxlen=keep)
+            hist.append((now, int(g.get("bytes", 0)),
+                         int(g.get("count", 0))))
+        # Callsites that vanished from this owner's report released
+        # everything: their trend (and any standing suspect) clears.
+        for key in [k for k in self._census_history
+                    if k[0] == cid and k[1] not in groups]:
+            del self._census_history[key]
+            self.leak_suspects.pop(f"growth:{key[0]}:{key[1]}", None)
+
+    def _census_attribution(self) -> dict:
+        """lock held. Per-object callsite attribution merged from every
+        owner's census sample ids: oid -> (owner_client, callsite,
+        kind-ish record). Bounded by clients x report_groups x
+        sample_ids."""
+        out: dict = {}
+        for cid, rep in self.object_census.items():
+            for site, g in (rep.get("groups") or {}).items():
+                for oid in g.get("sample_ids") or ():
+                    out.setdefault(oid, (cid, site))
+        return out
 
     def _health_loop(self) -> None:
         period = max(0.1, self.config.health_check_period_s)
@@ -1027,6 +1097,10 @@ class Head:
         now = time.time()
         grace = self.config.health_check_timeout_s
         self._overload_sweep(now)
+        if (now - self._last_leak_sweep
+                >= self.config.object_leak_sweep_interval_s):
+            self._last_leak_sweep = now
+            self._leak_sweep(now)
         with self.lock:
             silent = [
                 (nid, self.node_agents.get(nid))
@@ -1157,6 +1231,135 @@ class Head:
                 conn.cast("cancel", {"task_id": task_id})
             except rpc.ConnectionLost:
                 pass
+
+    # --- object-plane leak detector (observe-only) --------------------
+
+    def _leak_sweep(self, now: float) -> None:
+        """Flag suspect object groups with trend data — never frees or
+        kills anything (reference analogue: `ray memory`'s leak-hunting
+        workflow, here automated). Three detectors:
+
+        (1) growth — a (owner, callsite) whose live bytes grew strictly
+            monotonically across object_leak_windows consecutive census
+            reports (the classic append-refs-in-a-loop leak);
+        (2) unawaited — objects SEALED longer than object_leak_ttl_s
+            ago that nothing ever fetched (head-store entries by their
+            read counter; owner-resident groups by the census's
+            unawaited count + age);
+        (3) orphan borrows — entries whose owner-side ref died
+            (refcount <= 0) but borrowers still pin them.
+
+        Suspects keep first_seen across sweeps; entries that stop
+        matching clear. Surfaced via memory_summary / `ray-tpu memory
+        --leaks` / the ray_tpu_object_leak_suspects gauge."""
+        windows = max(2, int(self.config.object_leak_windows))
+        ttl = float(self.config.object_leak_ttl_s)
+        seen: set = set()
+        with self.lock:
+            # (1) monotonic per-callsite growth across report windows.
+            for (cid, site), hist in self._census_history.items():
+                if len(hist) < windows:
+                    continue
+                tail = list(hist)[-windows:]
+                growing = all(tail[i][1] < tail[i + 1][1]
+                              for i in range(len(tail) - 1))
+                key = f"growth:{cid}:{site}"
+                if growing and tail[-1][1] > 0:
+                    seen.add(key)
+                    rec = self.leak_suspects.get(key)
+                    if rec is None:
+                        rec = self.leak_suspects[key] = {
+                            "kind": "growing_callsite", "callsite": site,
+                            "owner": cid, "first_seen": now}
+                    rec.update({
+                        "last_seen": now,
+                        "bytes": tail[-1][1], "count": tail[-1][2],
+                        "trend_bytes": [b for _t, b, _c in tail],
+                        "trend_counts": [c for _t, _b, c in tail],
+                        "windows": len(tail),
+                        "detail": (f"live bytes grew {tail[0][1]} -> "
+                                   f"{tail[-1][1]} across {len(tail)} "
+                                   f"report windows"),
+                    })
+            # (2a) owner-resident / census view: callsite groups whose
+            # oldest member outlived the TTL with unawaited refs.
+            for cid, rep in self.object_census.items():
+                for site, g in (rep.get("groups") or {}).items():
+                    if (g.get("unawaited", 0) > 0
+                            and g.get("oldest_age_s", 0) > ttl):
+                        key = f"unawaited_cs:{cid}:{site}"
+                        seen.add(key)
+                        rec = self.leak_suspects.get(key)
+                        if rec is None:
+                            rec = self.leak_suspects[key] = {
+                                "kind": "unawaited_callsite",
+                                "callsite": site, "owner": cid,
+                                "first_seen": now}
+                        rec.update({
+                            "last_seen": now,
+                            "count": g.get("unawaited", 0),
+                            "bytes": g.get("bytes", 0),
+                            "oldest_age_s": g.get("oldest_age_s", 0),
+                            "detail": (f"{g.get('unawaited', 0)} ref(s) "
+                                       f"never awaited, oldest "
+                                       f"{g.get('oldest_age_s', 0):.0f}s "
+                                       f"old (ttl {ttl:.0f}s)"),
+                        })
+            # (2b)+(3) per-entry scans, capped so a million-object
+            # flood never stalls the health loop under the head lock.
+            scanned_entries = (
+                len(self.objects) <= self.config.object_leak_scan_cap)
+            if scanned_entries:
+                attribution = self._census_attribution()
+                budget = 100  # suspects per kind per sweep (bounded)
+                for e in self.objects.values():
+                    if e.state == SEALED and e.reads == 0 \
+                            and not e.is_error and not e.owner_resident \
+                            and now - e.created_at > ttl and budget > 0:
+                        key = f"unawaited:{e.object_id}"
+                        seen.add(key)
+                        rec = self.leak_suspects.get(key)
+                        if rec is None:
+                            budget -= 1
+                            cs = attribution.get(e.object_id)
+                            rec = self.leak_suspects[key] = {
+                                "kind": "sealed_never_read",
+                                "object_id": e.object_id,
+                                "owner": e.owner_id,
+                                "callsite": cs[1] if cs else None,
+                                "first_seen": now}
+                        rec.update({
+                            "last_seen": now, "bytes": e.size,
+                            "age_s": round(now - e.created_at, 1),
+                            "detail": (f"sealed {now - e.created_at:.0f}s "
+                                       f"ago, never fetched"),
+                        })
+                    if e.borrowers and e.refcount <= 0:
+                        key = f"borrow:{e.object_id}"
+                        seen.add(key)
+                        rec = self.leak_suspects.get(key)
+                        if rec is None:
+                            rec = self.leak_suspects[key] = {
+                                "kind": "borrow_outlives_owner",
+                                "object_id": e.object_id,
+                                "owner": e.owner_id,
+                                "first_seen": now}
+                        rec.update({
+                            "last_seen": now, "bytes": e.size,
+                            "borrowers": sorted(e.borrowers),
+                            "detail": (f"owner ref released but "
+                                       f"{len(e.borrowers)} borrower(s) "
+                                       f"still pin it"),
+                        })
+            # Clear suspects that stopped matching (swept kinds only —
+            # growth suspects also clear in _census_intake when their
+            # callsite vanishes from the owner's report; per-entry kinds
+            # keep their state when the capped scan was skipped).
+            for key in [k for k in self.leak_suspects if k not in seen]:
+                if (not scanned_entries
+                        and key.startswith(("unawaited:", "borrow:"))):
+                    continue
+                del self.leak_suspects[key]
 
     # --- registration ---
 
@@ -1632,6 +1835,10 @@ class Head:
 
     def _meta_for(self, entry: ObjectEntry, remote: bool = False,
                   client_id: "str | None" = None) -> tuple:
+        # Leak-detector input: this entry was fetched (sealed-but-never-
+        # read objects past the TTL are suspects; a read clears them).
+        entry.reads += 1
+        entry.last_read = time.time()
         if entry.inline is not None:
             return ("inline", entry.inline, entry.is_error)
         if (entry.owner_resident and entry.state == SEALED
@@ -3350,23 +3557,123 @@ class Head:
                 ]
             }
 
+    def _object_node(self, e: ObjectEntry) -> str:
+        """lock held. Which node holds this object's bytes: the P2P
+        hosting node, the head arena's node, or (owner-resident) the
+        owning runtime's node."""
+        if e.location is not None:
+            return e.location
+        if e.offset is not None or e.inline is not None:
+            return self.node_id
+        if e.owner_resident:
+            w = self.workers.get(e.owner_id)
+            if w is not None:
+                return w.node_id
+        return self.node_id
+
+    def _object_row(self, e: ObjectEntry,
+                    attribution: "dict | None" = None) -> dict:
+        """lock held. One full state-API row for an object directory
+        entry (reference: util/state list_objects columns + the `ray
+        memory` per-ref table)."""
+        row = {
+            "object_id": e.object_id,
+            "state": e.state,
+            "size": e.size,
+            "refcount": e.refcount,
+            "owner": e.owner_id,
+            "borrowers": sorted(e.borrowers),
+            "container_pins": e.container_pins,
+            "task_pins": e.task_pins,
+            "read_pins": e.read_pins,
+            "node_id": self._object_node(e),
+            "owner_resident": e.owner_resident,
+            "is_error": e.is_error,
+            "created_at": e.created_at,
+            "age_s": round(time.time() - e.created_at, 1),
+            "reads": e.reads,
+            "spilled": e.state == SPILLED,
+            "location": e.location,
+            "replicas": sorted(e.replicas),
+        }
+        task_id = self.lineage[e.object_id].task_id \
+            if e.object_id in self.lineage \
+            else self.task_events.producer_task(e.object_id)
+        if task_id is not None:
+            row["task_id"] = task_id
+        cs = (attribution or {}).get(e.object_id)
+        if cs is not None:
+            row["callsite"] = cs[1]
+        return row
+
     def _h_list_objects(self, body, conn):
+        body = body or {}
+        object_id = body.get("object_id")
         with self.lock:
-            return {
-                "objects": [
-                    {
-                        "object_id": e.object_id,
-                        "state": e.state,
-                        "size": e.size,
-                        "refcount": e.refcount,
-                        "owner": e.owner_id,
-                        "borrowers": sorted(e.borrowers),
-                        "container_pins": e.container_pins,
-                        "task_pins": e.task_pins,
-                    }
-                    for e in self.objects.values()
-                ]
-            }
+            attribution = self._census_attribution()
+            if object_id is not None:
+                # Point lookup pushed down (mirrors _h_list_tasks'
+                # task_id path): a drill-down must never ship the whole
+                # object table.
+                e = self.objects.get(object_id)
+                return {"objects": [self._object_row(e, attribution)]
+                        if e is not None else []}
+            limit = int(body.get("limit", 1_000_000))
+            rows = [self._object_row(e, attribution)
+                    for e in self.objects.values()]
+        return {"objects": rows[-limit:]}
+
+    def _lineage_chain(self, oid: str, depth: int = 5,
+                       fanout: int = 4) -> dict:
+        """lock held. The lineage chain for one object id: obj ← task ←
+        args ← … (reference: the ownership/lineage walk behind
+        `ray memory` debugging + ObjectRecoveryManager's recursive
+        reconstruction). Bounded depth and per-task arg fanout."""
+        node: dict = {"object_id": oid}
+        spec = self.lineage.get(oid)
+        task_id = spec.task_id if spec is not None \
+            else self.task_events.producer_task(oid)
+        if task_id is None:
+            return node
+        t = self.tasks.get(task_id) or {}
+        task: dict = {
+            "task_id": task_id,
+            "name": spec.name if spec is not None else t.get("name"),
+            "state": t.get("state"),
+            "worker_id": t.get("worker_id"),
+            "node_id": t.get("node_id"),
+        }
+        ev = self.task_events.task_record(task_id)
+        if ev is not None:
+            # Flight-recorder cross-link: the producing task's phase
+            # stamps ride the drill-down (obj ← task ← its timeline).
+            task["phases"] = ev.get("phases") or {}
+            if ev.get("actor_id"):
+                task["actor_id"] = ev["actor_id"]
+        node["task"] = task
+        deps = list(spec.deps or ()) if spec is not None else []
+        if deps and depth > 0:
+            node["args"] = [self._lineage_chain(d, depth - 1, fanout)
+                            for d in deps[:fanout]]
+            if len(deps) > fanout:
+                node["args_truncated"] = len(deps) - fanout
+        return node
+
+    def _h_get_object(self, body, conn):
+        """Object drill-down: the full row, the owner census record
+        (callsite/kind) when known, and the lineage chain."""
+        oid = body["object_id"]
+        with self.lock:
+            e = self.objects.get(oid)
+            attribution = self._census_attribution()
+            row = self._object_row(e, attribution) if e is not None \
+                else None
+            chain = self._lineage_chain(oid)
+        if row is None and "task" not in chain:
+            return {"object": None}
+        out = row or {"object_id": oid, "state": "FREED"}
+        out["lineage"] = chain
+        return {"object": out}
 
     def _h_list_workers(self, body, conn):
         with self.lock:
@@ -3464,15 +3771,102 @@ class Head:
             except rpc.ConnectionLost:
                 pass
 
+    def _store_stats_locked(self) -> dict:
+        """lock held. Arena stats plus the pin/fragmentation breakdown
+        that makes memory-pressure decisions explainable: how much of
+        the in-use arena is pinned (cannot spill/evict) vs reclaimable,
+        and how many eviction candidates the spill scan would find."""
+        pinned_bytes = reclaimable_bytes = 0
+        eviction_candidates = num_spilled = 0
+        for e in self.objects.values():
+            if e.state == SPILLED:
+                num_spilled += 1
+            if e.offset is None:
+                continue  # not arena-resident (inline/p2p/owner/spilled)
+            if e.state != SEALED:
+                continue
+            if e.read_pins > 0:
+                # The same predicate as _alloc_with_spill's candidate
+                # scan: read-pinned sealed bytes can neither spill nor
+                # free until the pins drop.
+                pinned_bytes += e.size
+            else:
+                reclaimable_bytes += e.size
+                eviction_candidates += 1
+        capacity, in_use = self.arena.capacity, self.arena.in_use
+        largest_free = self.arena.largest_free
+        return {
+            "capacity": capacity,
+            "in_use": in_use,
+            "num_objects": self.arena.num_objects,
+            "largest_free": largest_free,
+            "num_entries": len(self.objects),
+            "num_spilled": num_spilled,
+            # Free space the allocator cannot serve as one block — the
+            # fragmentation the arena's best-fit policy is fighting.
+            "fragmented_free": max(0, capacity - in_use - largest_free),
+            "pinned_bytes": pinned_bytes,
+            "reclaimable_bytes": reclaimable_bytes,
+            "eviction_candidates": eviction_candidates,
+        }
+
     def _h_store_stats(self, body, conn):
         with self.lock:
+            return self._store_stats_locked()
+
+    def _h_memory_summary(self, body, conn):
+        """The cluster-wide `ray-tpu memory` feed (reference:
+        _private/internal_api.py memory_summary): owner censuses merged
+        by callsite, directory bytes grouped by node and state, store
+        stats, and the leak detector's current suspects — one call, no
+        full object table transfer."""
+        with self.lock:
+            groups: dict[str, dict] = {}
+            census_clients: dict[str, dict] = {}
+            for cid, rep in self.object_census.items():
+                census_clients[cid] = {
+                    "live_objects": rep.get("live_objects", 0),
+                    "live_bytes": rep.get("live_bytes", 0),
+                    "dropped": rep.get("dropped", 0),
+                    "ts": rep.get("ts"),
+                }
+                for site, g in (rep.get("groups") or {}).items():
+                    m = groups.get(site)
+                    if m is None:
+                        m = groups[site] = {
+                            "count": 0, "bytes": 0, "kinds": {},
+                            "unawaited": 0, "oldest_age_s": 0.0,
+                            "owners": []}
+                    m["count"] += g.get("count", 0)
+                    m["bytes"] += g.get("bytes", 0)
+                    m["unawaited"] += g.get("unawaited", 0)
+                    m["oldest_age_s"] = max(m["oldest_age_s"],
+                                            g.get("oldest_age_s", 0.0))
+                    for k, v in (g.get("kinds") or {}).items():
+                        m["kinds"][k] = m["kinds"].get(k, 0) + v
+                    if cid not in m["owners"]:
+                        m["owners"].append(cid)
+            by_node: dict[str, dict] = {}
+            by_state: dict[str, dict] = {}
+            for e in self.objects.values():
+                node = self._object_node(e)
+                b = by_node.setdefault(node, {})
+                s = b.setdefault(e.state, {"count": 0, "bytes": 0})
+                s["count"] += 1
+                s["bytes"] += e.size
+                s2 = by_state.setdefault(e.state, {"count": 0, "bytes": 0})
+                s2["count"] += 1
+                s2["bytes"] += e.size
             return {
-                "capacity": self.arena.capacity,
-                "in_use": self.arena.in_use,
-                "num_objects": self.arena.num_objects,
-                "largest_free": self.arena.largest_free,
+                "store": self._store_stats_locked(),
+                "groups": groups,
+                "by_node": by_node,
+                "by_state": by_state,
+                "census_clients": census_clients,
+                "leak_suspects": [dict(r) for r in
+                                  self.leak_suspects.values()],
                 "num_entries": len(self.objects),
-                "num_spilled": sum(1 for e in self.objects.values() if e.state == SPILLED),
+                "total_bytes": sum(v["bytes"] for v in by_state.values()),
             }
 
     def _h_task_events(self, body, conn):
@@ -4725,7 +5119,36 @@ class Head:
                         for c in rpc.values()),
                     "clock_offsets": dict(self.clock_offsets),
                 },
+                # Object-plane observability: store bytes by node/state
+                # (ray_tpu_object_store_bytes{node,state}), live refs by
+                # kind from the owner censuses (ray_tpu_objects_live
+                # {kind}), top callsites by bytes, and the leak
+                # detector's suspect count.
+                "objects": self._objects_stats_locked(),
             }
+
+    def _objects_stats_locked(self) -> dict:
+        by_node_state: dict[str, dict] = {}
+        for e in self.objects.values():
+            node = self._object_node(e)
+            b = by_node_state.setdefault(node, {})
+            b[e.state] = b.get(e.state, 0) + e.size
+        live_by_kind: dict[str, int] = {}
+        by_callsite: dict[str, int] = {}
+        for rep in self.object_census.values():
+            for site, g in (rep.get("groups") or {}).items():
+                by_callsite[site] = (by_callsite.get(site, 0)
+                                     + g.get("bytes", 0))
+                for k, v in (g.get("kinds") or {}).items():
+                    live_by_kind[k] = live_by_kind.get(k, 0) + v
+        top = sorted(by_callsite.items(), key=lambda kv: kv[1],
+                     reverse=True)[:10]
+        return {
+            "by_node_state": by_node_state,
+            "live_by_kind": live_by_kind,
+            "top_callsite_bytes": dict(top),
+            "leak_suspects": len(self.leak_suspects),
+        }
 
     def _record_finished(self, task_id: str) -> None:
         """lock held. Terminal task-state retention (reference: the GCS

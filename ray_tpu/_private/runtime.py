@@ -258,6 +258,18 @@ class CoreRuntime:
 
         self._release_queue: "_collections.deque[tuple[str, str]]" = (
             _collections.deque())
+        # --- object census (objcensus.py; reference: the per-worker
+        # reference table behind `ray memory`, reference_count.h:72):
+        # every owned ref tracked with its creating callsite/kind/size;
+        # a bounded per-callsite summary piggybacks on rpc_report.
+        self._census = None
+        self._callsite = None
+        if GLOBAL_CONFIG.object_census_enabled:
+            from ray_tpu._private import objcensus
+
+            self._census = objcensus.OwnerCensus(
+                GLOBAL_CONFIG.object_census_max_entries)
+            self._callsite = objcensus.callsite
         ids_mod.set_ref_removed_callback(self._on_ref_removed)
         ids_mod.set_borrow_callbacks(self._on_borrow_added,
                                      self._on_borrow_removed)
@@ -313,6 +325,13 @@ class CoreRuntime:
         chaos = faultinject.drain_events()
         if chaos:
             body["chaos_events"] = chaos
+        if self._census is not None:
+            # Object census piggyback: the bounded per-callsite summary
+            # rides the SAME amortized report cast — zero new per-call
+            # head frames (guard: test_dispatch_fastpath's census test).
+            body["census"] = self._census.summary(
+                GLOBAL_CONFIG.object_census_report_groups,
+                GLOBAL_CONFIG.object_census_sample_ids)
         if not self.conn.closed:
             self.conn.cast_buffered("rpc_report", body)
 
@@ -523,6 +542,10 @@ class CoreRuntime:
                         # object (in-flight tasks may still fetch the
                         # value from this store) and casts owned_freed.
                         owned.append(hex_id)
+                        if self._census is not None:
+                            # The local ref died: the census tracks
+                            # LIVE refs, so the record retires now.
+                            self._census.release(hex_id)
                         continue
                     n = self._borrows.get(hex_id, 0) - 1
                     if n <= 0:
@@ -659,6 +682,11 @@ class CoreRuntime:
                 self._direct.on_resolved(oids)
             except Exception:
                 pass
+        if self._census is not None:
+            for rec in objs:
+                if not rec.get("remote"):
+                    self._census.update_size(rec["object_id"],
+                                             len(rec["payload"]))
         with self._owned_cond:
             for rec in objs:
                 oid = rec["object_id"]
@@ -724,6 +752,8 @@ class CoreRuntime:
         """The cluster is done with an owned object: drop its payload
         and tombstone the id so a late direct seal (still in flight from
         the executor) can't orphan bytes in the store."""
+        if self._census is not None:
+            self._census.release(hex_id)
         with self._owned_cond:
             self._owned_store.pop(hex_id, None)
             self._expected_owned.discard(hex_id)
@@ -1095,6 +1125,18 @@ class CoreRuntime:
             header, buffers = serialization.serialize(value)
         contained = sorted(set(collected))
         size = serialization.serialized_size(header, buffers)
+        if self._census is not None and _object_id is None:
+            # Census: owned put, attributed to the first user frame.
+            # Kind mirrors the storage decision in _store_serialized.
+            if (self.shm is None and self.agent_shm is not None
+                    and size > GLOBAL_CONFIG.max_inline_object_size):
+                kind = "p2p"
+            elif (self.shm is None
+                    or size <= GLOBAL_CONFIG.max_inline_object_size):
+                kind = "inline"
+            else:
+                kind = "shm"
+            self._census.record(object_id, kind, size, self._callsite())
         self._store_serialized(object_id, header, buffers, size, contained,
                                _is_error)
         return ObjectRef(object_id, _owned=_object_id is None)
@@ -1184,6 +1226,10 @@ class CoreRuntime:
         if not ref_list:
             return [] if not single else None
         id_list = [r.hex() for r in ref_list]
+        if self._census is not None:
+            # Leak detector input: these refs were awaited (a sealed-
+            # but-never-fetched object past the TTL is a suspect).
+            self._census.mark_awaited(id_list)
         deadline = None if timeout is None else _time.monotonic() + timeout
         # Phase 1 — owner plane (reference: in-process store,
         # core_worker.h:172). Results this runtime owns are DELIVERED
@@ -1391,6 +1437,8 @@ class CoreRuntime:
     def get_async(self, ref: ObjectRef) -> Future:
         # Owner-local fast path (same as get()); _REMOTE markers mean
         # "stored big, resolve via head meta" — fall through.
+        if self._census is not None:
+            self._census.mark_awaited((ref.hex(),))
         v = self._owned_store.get(ref.hex())
         if v is not None and v[0] is _REMOTE:
             v = None
@@ -1684,6 +1732,11 @@ class CoreRuntime:
         with self._owned_cond:
             for oid in spec.return_ids:
                 self._expected_owned.add(oid)
+        if self._census is not None:
+            # Census: task returns this runtime will own, attributed to
+            # the .remote() callsite (size stamps when the seal lands).
+            self._census.record_many(spec.return_ids, "return",
+                                     self._callsite())
 
     def seal_local_error(self, return_ids, message: str,
                          kind: str = "task_error") -> None:

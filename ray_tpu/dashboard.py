@@ -219,7 +219,14 @@ class DashboardServer:
         if path == "/api/task_summary":
             return us.summarize_tasks()
         if path == "/api/objects":
-            return {"objects": us.list_objects()}
+            # Objects view (reference: `ray memory` rendered in the
+            # dashboard): full rows + the callsite-grouped census /
+            # leak-suspect summary in one payload.
+            return {"objects": us.list_objects(),
+                    "summary": us.memory_summary()}
+        if path.startswith("/api/objects/"):
+            obj = us.get_object(path[len("/api/objects/"):])
+            return obj if obj is not None else None
         if path == "/api/workers":
             return {"workers": us.list_workers()}
         if path == "/api/jobs":

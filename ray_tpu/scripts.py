@@ -146,34 +146,164 @@ def cmd_summary(args) -> int:
     return 0
 
 
+_MEM_UNITS = {"B": 1, "KB": 1024, "MB": 1024 ** 2, "GB": 1024 ** 3}
+
+
+def _fmt_bytes(n, units: str) -> str:
+    div = _MEM_UNITS.get(units, 1)
+    if div == 1:
+        return str(int(n or 0))
+    return f"{(n or 0) / div:.2f}{units}"
+
+
+def _render_memory_groups(summary: dict, group_by: str, sort_by: str,
+                          units: str) -> list:
+    """The `ray memory`-style grouped table (reference: `ray memory
+    --group-by ...`): one row per callsite / node / state with object
+    counts and live bytes, sorted by size (default) or count."""
+    rows: list = []
+    if group_by == "callsite":
+        src = summary.get("groups") or {}
+        items = [(site, g.get("count", 0), g.get("bytes", 0),
+                  g.get("unawaited", 0),
+                  ",".join(sorted(g.get("kinds") or {})))
+                 for site, g in src.items()]
+    elif group_by == "node":
+        items = []
+        for node, states in (summary.get("by_node") or {}).items():
+            count = sum(s.get("count", 0) for s in states.values())
+            size = sum(s.get("bytes", 0) for s in states.values())
+            items.append((node, count, size, "",
+                          ",".join(sorted(states))))
+    else:  # state
+        items = [(state, s.get("count", 0), s.get("bytes", 0), "", "")
+                 for state, s in (summary.get("by_state") or {}).items()]
+    items.sort(key=lambda r: r[1] if sort_by == "count" else r[2],
+               reverse=True)
+    label = group_by.upper()
+    hdr = f"{label:58} {'OBJECTS':>8} {'SIZE':>12} {'UNAWAITED':>9} KINDS"
+    rows.append(f"=== Grouped by {group_by} (sort: {sort_by}) ===")
+    rows.append(hdr)
+    rows.append("-" * len(hdr))
+    for name, count, size, unawaited, kinds in items:
+        rows.append(f"{str(name)[:58]:58} {count:>8} "
+                    f"{_fmt_bytes(size, units):>12} {str(unawaited):>9} "
+                    f"{kinds}")
+    if not items:
+        rows.append("(no census reports yet — owners report every "
+                    "rpc_report_interval_s)")
+    return rows
+
+
+def _render_memory_leaks(suspects: list, units: str) -> list:
+    rows = ["=== Leak suspects ==="]
+    if not suspects:
+        rows.append("(none)")
+        return rows
+    for s in suspects:
+        where = s.get("callsite") or s.get("object_id") or "?"
+        trend = s.get("trend_bytes")
+        extra = f"  trend={trend}" if trend else ""
+        rows.append(f"[{s.get('kind')}] {where}  "
+                    f"bytes={_fmt_bytes(s.get('bytes', 0), units)}  "
+                    f"owner={s.get('owner', '')}  "
+                    f"{s.get('detail', '')}{extra}")
+    return rows
+
+
+def _render_lineage(chain: dict, indent: int = 0) -> list:
+    rows = []
+    pad = "  " * indent
+    task = chain.get("task")
+    if task is None:
+        rows.append(f"{pad}{chain.get('object_id')}  (no lineage "
+                    f"recorded — put() or evicted entry)")
+        return rows
+    rows.append(f"{pad}{chain.get('object_id')}  <- task "
+                f"{task.get('name')} [{task.get('task_id')}] "
+                f"{task.get('state') or ''} on {task.get('node_id') or '?'}")
+    for arg in chain.get("args") or ():
+        rows.extend(_render_lineage(arg, indent + 1))
+    if chain.get("args_truncated"):
+        rows.append(f"{pad}  ... {chain['args_truncated']} more arg(s)")
+    return rows
+
+
 def cmd_memory(args) -> int:
-    """Object-store memory report (reference: `ray memory` —
-    _private/internal_api.py memory_summary: per-object refcount/size/
-    owner table + store totals)."""
+    """Cluster memory report (reference: `ray memory` —
+    _private/internal_api.py memory_summary): callsite-grouped live-ref
+    census, per-object table, shm-store pin/fragmentation stats, leak
+    suspects, and per-object lineage drill-down."""
     from ray_tpu.util import state as us
 
     _connect(args.address)
+    as_json = args.json or getattr(args, "format", None) == "json"
+    units = getattr(args, "units", "B") or "B"
+    if getattr(args, "object_id", None):
+        obj = us.get_object(args.object_id)
+        if obj is None:
+            print(f"object {args.object_id} not found (freed and no "
+                  f"lineage recorded)")
+            return 1
+        if as_json:
+            print(json.dumps({"object": obj}, indent=2, default=str))
+            return 0
+        print(f"object   {obj.get('object_id')}")
+        for key in ("state", "size", "owner", "node_id", "callsite",
+                    "refcount", "borrowers", "task_pins",
+                    "container_pins", "read_pins", "reads", "age_s",
+                    "owner_resident", "task_id"):
+            if obj.get(key) not in (None, [], {}):
+                print(f"{key:14} {obj[key]}")
+        print("lineage:")
+        for ln in _render_lineage(obj.get("lineage") or
+                                  {"object_id": obj.get("object_id")}, 1):
+            print(ln)
+        return 0
     objs = us.list_objects(limit=args.limit)
     stats = us.object_store_stats()
-    if args.json:
-        print(json.dumps({"objects": objs, "store": stats}, indent=2,
-                         default=str))
+    summary = us.memory_summary()
+    if as_json:
+        print(json.dumps({"objects": objs, "store": stats,
+                          "summary": summary,
+                          "leaks": summary.get("leak_suspects") or []},
+                         indent=2, default=str))
         return 0
+    group_by = getattr(args, "group_by", "callsite") or "callsite"
+    sort_by = getattr(args, "sort_by", "size") or "size"
+    for ln in _render_memory_groups(summary, group_by, sort_by, units):
+        print(ln)
+    print()
     hdr = f"{'OBJECT ID':42} {'STATE':10} {'SIZE':>12} {'REFS':>5} " \
-          f"{'PINS':>5} OWNER"
+          f"{'PINS':>5} {'OWNER':18} CALLSITE"
     print(hdr)
     print("-" * len(hdr))
+    key = (lambda o: int(o.get("size") or 0)) if sort_by == "size" \
+        else (lambda o: o.get("created_at") or 0)
     total = 0
-    for o in objs:
+    for o in sorted(objs, key=key, reverse=True):
         size = int(o.get("size") or 0)
         total += size
         pins = int(o.get("container_pins") or 0) + int(o.get("task_pins")
                                                        or 0)
-        print(f"{o['object_id']:42} {o['state']:10} {size:>12} "
-              f"{o.get('refcount', 0):>5} {pins:>5} {o.get('owner', '')}")
+        print(f"{o['object_id']:42} {o['state']:10} "
+              f"{_fmt_bytes(size, units):>12} "
+              f"{o.get('refcount', 0):>5} {pins:>5} "
+              f"{str(o.get('owner', ''))[:18]:18} "
+              f"{o.get('callsite', '')}")
     print(f"\n{len(objs)} objects, {total} bytes referenced; store: "
           f"{stats.get('in_use', 0)}/{stats.get('capacity', 0)} "
-          f"bytes used, {stats.get('num_objects', 0)} resident")
+          f"bytes used, {stats.get('num_objects', 0)} resident, "
+          f"{_fmt_bytes(stats.get('pinned_bytes', 0), units)} pinned / "
+          f"{_fmt_bytes(stats.get('reclaimable_bytes', 0), units)} "
+          f"reclaimable, {stats.get('eviction_candidates', 0)} eviction "
+          f"candidate(s), {_fmt_bytes(stats.get('fragmented_free', 0), units)} "
+          f"fragmented free")
+    suspects = summary.get("leak_suspects") or []
+    if suspects or getattr(args, "leaks", False):
+        print()
+        for ln in _render_memory_leaks(suspects, units):
+            print(ln)
     return 0
 
 
@@ -461,10 +591,23 @@ def main(argv: list[str] | None = None) -> int:
     s.add_argument("--limit", type=int, default=100)
     s.set_defaults(fn=cmd_list)
 
-    s = sub.add_parser("memory", help="object-store memory report")
+    s = sub.add_parser("memory",
+                       help="cluster memory report: callsite-grouped "
+                            "census, leak suspects, lineage drill-down")
+    s.add_argument("object_id", nargs="?", default=None,
+                   help="drill into one object (full row + lineage)")
     s.add_argument("--address", required=True)
     s.add_argument("--limit", type=int, default=200)
     s.add_argument("--json", action="store_true")
+    s.add_argument("--format", choices=["table", "json"], default="table")
+    s.add_argument("--group-by", dest="group_by", default="callsite",
+                   choices=["callsite", "node", "state"])
+    s.add_argument("--sort-by", dest="sort_by", default="size",
+                   choices=["size", "count"])
+    s.add_argument("--units", default="B",
+                   choices=["B", "KB", "MB", "GB"])
+    s.add_argument("--leaks", action="store_true",
+                   help="always print the leak-suspect section")
     s.set_defaults(fn=cmd_memory)
 
     s = sub.add_parser("logs", help="list or tail cluster worker logs")

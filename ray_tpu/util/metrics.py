@@ -241,6 +241,40 @@ def runtime_stats_text() -> str:
                 f'ray_tpu_rpc_breaker_open'
                 f'{{target="{_escape_label_value(target)}"}} '
                 f"{1 if breakers[target].get('open') else 0}")
+    # Object-plane observability: store bytes by node/state, live refs
+    # by census kind, top callsites by live bytes, and the leak
+    # detector's suspect count.
+    objects = snap.get("objects") or {}
+    by_node_state = objects.get("by_node_state") or {}
+    if by_node_state:
+        lines.append("# TYPE ray_tpu_object_store_bytes gauge")
+        for node in sorted(by_node_state):
+            for state in sorted(by_node_state[node]):
+                lines.append(
+                    f'ray_tpu_object_store_bytes'
+                    f'{{node="{_escape_label_value(node)}",'
+                    f'state="{_escape_label_value(state)}"}} '
+                    f"{by_node_state[node][state]}")
+    live_by_kind = objects.get("live_by_kind") or {}
+    if live_by_kind:
+        lines.append("# TYPE ray_tpu_objects_live gauge")
+        for kind in sorted(live_by_kind):
+            lines.append(
+                f'ray_tpu_objects_live'
+                f'{{kind="{_escape_label_value(kind)}"}} '
+                f"{live_by_kind[kind]}")
+    top_cs = objects.get("top_callsite_bytes") or {}
+    if top_cs:
+        lines.append("# TYPE ray_tpu_object_callsite_bytes gauge")
+        for site in sorted(top_cs):
+            lines.append(
+                f'ray_tpu_object_callsite_bytes'
+                f'{{callsite="{_escape_label_value(site)}"}} '
+                f"{top_cs[site]}")
+    if "leak_suspects" in objects:
+        lines.append("# TYPE ray_tpu_object_leak_suspects gauge")
+        lines.append(
+            f"ray_tpu_object_leak_suspects {objects['leak_suspects']}")
     # Cluster-wide head frame census (the zero-per-call-head-frames
     # property, scrapeable): total frames every reporting process has
     # sent the head.

@@ -121,6 +121,26 @@ def grafana_dashboard(extra_metrics: "list[str] | None" = None) -> dict:
         "short", 12, y))
     next_id += 1
     y += 8
+    # Object-plane observability row (PR 7): live bytes by state,
+    # top-callsite attribution, leak-suspect trend.
+    panels.append(_panel(
+        next_id, "Object store bytes by state",
+        "sum by (state) (ray_tpu_object_store_bytes)", "bytes", 0, y))
+    next_id += 1
+    panels.append(_panel(
+        next_id, "Object bytes by top callsites",
+        "topk(10, ray_tpu_object_callsite_bytes)", "bytes", 12, y))
+    next_id += 1
+    y += 8
+    panels.append(_panel(
+        next_id, "Live object refs by kind",
+        "sum by (kind) (ray_tpu_objects_live)", "short", 0, y))
+    next_id += 1
+    panels.append(_panel(
+        next_id, "Object leak suspects",
+        "ray_tpu_object_leak_suspects", "short", 12, y))
+    next_id += 1
+    y += 8
     for i, name in enumerate(extra_metrics or []):
         panels.append(_panel(next_id, name, name, "short",
                              (i % 2) * 12, y + (i // 2) * 8))

@@ -70,8 +70,27 @@ def list_actors(*, filters=None, limit: int = 1000) -> list[dict]:
 
 
 def list_objects(*, filters=None, limit: int = 1000) -> list[dict]:
-    rows = _call("list_objects")["objects"]
+    # An object_id equality filter is a point lookup — pushed down to
+    # the head (mirrors the task_id/actor_id pushdowns above) so
+    # drill-downs never transfer the whole object table.
+    filters = list(filters or [])
+    body: dict = {}
+    for f in list(filters):
+        if f[1] == "=" and f[0] == "object_id":
+            body["object_id"] = f[2]
+            filters.remove(f)
+    body["limit"] = limit if not filters else 1_000_000
+    rows = _call("list_objects", body)["objects"]
     return _filtered(rows, filters)[:limit]
+
+
+def get_object(object_id: str) -> "dict | None":
+    """One object's full record + lineage chain (``obj ← task ← args ←
+    …``) and the producing task's flight-recorder phases — the
+    `ray-tpu memory <object_id>` drill-down. Point lookup pushed down
+    to the head."""
+    reply = _call("get_object", {"object_id": object_id})
+    return reply.get("object")
 
 
 def list_workers(*, filters=None, limit: int = 1000) -> list[dict]:
@@ -170,22 +189,41 @@ def summarize_actors() -> dict:
 
 
 def summarize_objects() -> dict:
-    """Counts + bytes by state (reference: util/state summarize_objects)."""
+    """Counts + bytes by state (reference: util/state summarize_objects),
+    plus per-callsite and per-node groupings from the object census
+    (head-merged owner reports; see memory_summary for the raw feed)."""
     objs = list_objects(limit=100000)
     states = Counter(o["state"] for o in objs)
     size_by_state: dict[str, int] = Counter()
     for o in objs:
         size_by_state[o["state"]] += int(o.get("size", 0) or 0)
+    mem = memory_summary()
     return {
         "state_counts": dict(states),
         "bytes_by_state": dict(size_by_state),
         "total": len(objs),
         "total_bytes": sum(size_by_state.values()),
+        # Callsite-attributed live refs (owner censuses, merged across
+        # clients by the head) and directory bytes per node.
+        "by_callsite": mem.get("groups") or {},
+        "by_node": mem.get("by_node") or {},
     }
 
 
 def object_store_stats() -> dict:
+    """Shm-store stats incl. the pin/fragmentation breakdown
+    (pinned vs reclaimable sealed bytes, eviction-candidate count,
+    fragmented free space) that explains memory-pressure decisions."""
     return _call("store_stats")
+
+
+def memory_summary() -> dict:
+    """The `ray-tpu memory` feed (reference: `ray memory` /
+    internal_api.py memory_summary): owner censuses merged by callsite
+    (count/bytes/kinds/unawaited per creating callsite), directory
+    bytes by node and state, store stats, per-client census health,
+    and the leak detector's current suspects with trend data."""
+    return _call("memory_summary")
 
 
 def list_logs() -> list[dict]:

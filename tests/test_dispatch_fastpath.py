@@ -251,6 +251,57 @@ def test_event_plane_zero_per_call_head_frames(cluster):
     ray_tpu.kill(a)
 
 
+def test_census_plane_zero_per_call_head_frames(cluster):
+    """The object census (enabled by DEFAULT) rides piggybacked frames
+    only: its summary travels inside the amortized rpc_report cast, so
+    steady-state direct actor calls still make ZERO per-call
+    synchronous head RPCs, ZERO head submissions, no dedicated census
+    frame kind exists at all, and rpc_report traffic stays amortized
+    (does not scale with call count) — yet the census actually tracked
+    every call's return ref."""
+    from ray_tpu._private.config import GLOBAL_CONFIG
+
+    assert GLOBAL_CONFIG.object_census_enabled  # the default ships ON
+
+    @ray_tpu.remote
+    class Census:
+        def ping(self, x=None):
+            return x
+
+    a = Census.remote()
+    rt = global_runtime()
+    assert rt._census is not None
+    assert ray_tpu.get(a.ping.remote(1)) == 1
+    _wait(lambda: rt._direct.routes[a._actor_id].mode == "direct",
+          msg="actor route never entered direct mode")
+
+    N = 30
+    before_submit = rt.conn.sent_kinds.get("submit_actor_task", 0)
+    before_calls = rt.conn.calls_sent
+    before_push = _direct_push_count(rt)
+    before_report = rt.conn.sent_kinds.get("rpc_report", 0)
+    tracked = 0
+    for i in range(N):
+        r = a.ping.remote(i)
+        rec = rt._census.get(r.hex())
+        if rec is not None and rec["kind"] == "return":
+            tracked += 1
+        assert ray_tpu.get(r) == i
+    assert rt.conn.sent_kinds.get("submit_actor_task", 0) == before_submit
+    assert rt.conn.calls_sent == before_calls
+    assert _direct_push_count(rt) - before_push == N
+    # No dedicated census frame kind exists anywhere on the head conn —
+    # the summary is a FIELD of rpc_report, never its own frame...
+    assert "census" not in rt.conn.sent_kinds
+    # ...and rpc_report stays amortized (interval-driven, not per-call).
+    assert (rt.conn.sent_kinds.get("rpc_report", 0)
+            - before_report) <= 2
+    # The instrumentation really ran: every call's return was tracked
+    # with the census BEFORE its seal resolved it.
+    assert tracked == N
+    ray_tpu.kill(a)
+
+
 def test_forensics_plane_zero_per_call_head_frames(cluster):
     """The crash-forensics plane (enabled by DEFAULT) is worker-local:
     faulthandler arming is one-time at boot and the beacon is an mmap

@@ -1,0 +1,416 @@
+"""Object-plane observability: owner ref census, callsite attribution,
+`ray-tpu memory` surfaces, lineage drill-down, and the leak detector.
+
+The census rides piggybacked rpc_report casts only (the zero-per-call-
+head-frames guard lives in tests/test_dispatch_fastpath.py); these
+tests cover the DATA: per-callsite grouping, head-side merge, full
+state-API rows, point-lookup pushdown, lineage chains, store-stats
+pin/fragmentation breakdown, metrics exposition, and the three leak
+detectors (growing callsite, sealed-never-read, borrow-outliving-owner)
+— a deliberately leaked callsite loop must be flagged within 3 report
+windows while the same loop with releases stays clean.
+"""
+
+import json
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.worker_context import get_head, global_runtime
+from ray_tpu.util import state as us
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4, object_store_memory=64 * 1024 * 1024)
+    yield
+    ray_tpu.shutdown()
+
+
+def _report_now():
+    """One deterministic census report: flush owner-side releases so
+    dropped refs leave the census, then ship + flush the piggybacked
+    rpc_report cast and give the head's reader a beat to apply it."""
+    rt = global_runtime()
+    rt._drain_releases()
+    rt.report_rpc_now()
+    rt.conn.flush_casts()
+    time.sleep(0.25)
+
+
+# ------------------------------------------------------- owner census
+
+
+def test_census_records_put_and_return_callsites(cluster):
+    rt = global_runtime()
+    ref = ray_tpu.put(b"x" * 512)  # CALLSITE-PUT
+    rec = rt._census.get(ref.hex())
+    assert rec is not None
+    assert rec["kind"] == "inline"
+    assert rec["size"] > 0
+    assert "test_object_observability" in rec["callsite"]
+
+    @ray_tpu.remote
+    def produce():
+        return 1
+
+    r = produce.remote()  # CALLSITE-RETURN
+    rec = rt._census.get(r.hex())
+    assert rec is not None and rec["kind"] == "return"
+    assert "test_object_observability" in rec["callsite"]
+    assert ray_tpu.get(r) == 1
+    # get() marks the ref awaited; the seal stamped its size.
+    rec = rt._census.get(r.hex())
+    assert rec["awaited"] and rec["size"] > 0
+    # Releasing the refs retires the census records.
+    ref_hex, r_hex = ref.hex(), r.hex()
+    del ref, r
+    rt._drain_releases()
+    assert rt._census.get(ref_hex) is None
+    assert rt._census.get(r_hex) is None
+
+
+def test_census_summary_groups_by_callsite(cluster):
+    rt = global_runtime()
+    refs = [ray_tpu.put(b"g" * 256) for _ in range(8)]  # noqa: F841
+    summ = rt._census.summary()
+    groups = [site for site in summ["groups"]
+              if "test_census_summary_groups" in site
+              or "listcomp" in site]
+    assert groups, f"no group for this test's puts: {list(summ['groups'])}"
+    g = summ["groups"][groups[0]]
+    assert g["count"] >= 8 and g["bytes"] > 0
+    assert g["sample_ids"]
+    assert summ["live_objects"] >= 8
+
+
+def test_census_summary_bounded_groups(cluster):
+    from ray_tpu._private.objcensus import OwnerCensus
+
+    c = OwnerCensus()
+    for i in range(50):
+        c.record(f"oid{i}", "put", size=i + 1, site=f"site{i}.py:1:f")
+    s = c.summary(max_groups=10)
+    assert len(s["groups"]) == 11  # 10 + the "(other callsites)" fold
+    assert "(other callsites)" in s["groups"]
+    folded = s["groups"]["(other callsites)"]
+    assert folded["count"] == 40
+    assert s["live_bytes"] == sum(range(1, 51))
+
+
+def test_census_table_bounded(cluster):
+    from ray_tpu._private.objcensus import OwnerCensus
+
+    c = OwnerCensus(max_entries=5)
+    for i in range(8):
+        c.record(f"oid{i}", "put", size=1)
+    assert len(c) == 5 and c.dropped == 3
+
+
+# ------------------------------------------- head merge + state API
+
+
+def test_list_objects_full_rows_and_pushdown(cluster):
+    ref = ray_tpu.put(b"row" * 100)
+    _report_now()
+    rows = us.list_objects(limit=100000)
+    mine = next(r for r in rows if r["object_id"] == ref.hex())
+    for key in ("state", "size", "refcount", "owner", "node_id",
+                "created_at", "age_s", "reads", "borrowers",
+                "task_pins", "container_pins", "read_pins"):
+        assert key in mine, f"missing column {key}"
+    assert mine["state"] == "SEALED"
+    # The owner census attributed this put's callsite.
+    assert "callsite" in mine
+    # object_id filter ships to the head as a point lookup.
+    one = us.list_objects(filters=[("object_id", "=", ref.hex())])
+    assert len(one) == 1 and one[0]["object_id"] == ref.hex()
+    assert us.list_objects(filters=[("object_id", "=", "f" * 32)]) == []
+
+
+def test_get_object_lineage_chain(cluster):
+    @ray_tpu.remote
+    def stage1():
+        return 10
+
+    @ray_tpu.remote
+    def stage2(x):
+        return x + 1
+
+    a = stage1.remote()
+    b = stage2.remote(a)
+    assert ray_tpu.get(b) == 11
+    obj = us.get_object(b.hex())
+    assert obj is not None
+    chain = obj["lineage"]
+    assert chain["task"]["name"] == "stage2"
+    # obj <- task <- args <- ... : the arg's own producing task rides
+    # the chain one level down.
+    args = chain.get("args") or []
+    assert any((arg.get("task") or {}).get("name") == "stage1"
+               for arg in args), chain
+    assert us.get_object("e" * 32) is None
+
+
+def test_object_drilldown_has_flight_recorder_phases(cluster):
+    @ray_tpu.remote
+    def traced_producer():
+        return 42
+
+    r = traced_producer.remote()
+    assert ray_tpu.get(r) == 42
+    deadline = time.time() + 10
+    phases = {}
+    while time.time() < deadline:
+        obj = us.get_object(r.hex())
+        phases = ((obj or {}).get("lineage", {}).get("task", {})
+                  .get("phases") or {})
+        if "exec_end" in phases:
+            break
+        time.sleep(0.1)
+    assert "exec_end" in phases, phases
+
+
+def test_store_stats_pin_breakdown(cluster):
+    import numpy as np
+
+    big = ray_tpu.put(np.zeros(64 * 1024))  # > inline cap -> shm arena
+    stats = us.object_store_stats()
+    for key in ("fragmented_free", "pinned_bytes", "reclaimable_bytes",
+                "eviction_candidates", "capacity", "in_use"):
+        assert key in stats
+    assert stats["reclaimable_bytes"] > 0 or stats["pinned_bytes"] > 0
+    # A zero-copy read pins the bytes: they leave the reclaimable pool.
+    val = ray_tpu.get(big)
+    stats2 = us.object_store_stats()
+    assert stats2["pinned_bytes"] >= len(val.tobytes()) or \
+        stats2["eviction_candidates"] <= stats["eviction_candidates"]
+    del val, big
+
+
+def test_memory_summary_merges_census_and_directory(cluster):
+    keep = [ray_tpu.put(b"m" * 300) for _ in range(4)]  # noqa: F841
+    _report_now()
+    mem = us.memory_summary()
+    assert mem["store"]["capacity"] > 0
+    assert mem["groups"], "no merged callsite groups"
+    site, g = next(iter(sorted(mem["groups"].items(),
+                               key=lambda kv: -kv[1]["bytes"])))
+    assert g["count"] > 0 and g["owners"]
+    assert mem["by_state"].get("SEALED", {}).get("count", 0) > 0
+    assert mem["by_node"]
+    assert "leak_suspects" in mem
+    summ = us.summarize_objects()
+    assert summ["by_callsite"] and summ["by_node"]
+
+
+# ------------------------------------------------------- CLI rendering
+
+
+def test_memory_cli_renders_callsite_table(cluster, monkeypatch, capsys):
+    from ray_tpu import scripts
+
+    keep = [ray_tpu.put(b"c" * 400) for _ in range(3)]  # noqa: F841
+    _report_now()
+    monkeypatch.setattr(scripts, "_connect", lambda addr: None)
+    rc = scripts.main(["memory", "--address", "ignored",
+                       "--sort-by", "size", "--units", "KB"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "Grouped by callsite" in out
+    assert "OBJECT ID" in out and "store:" in out
+    assert "test_object_observability" in out  # callsite attribution
+    assert "KB" in out
+    # --format json carries objects + store + summary + leaks.
+    rc = scripts.main(["memory", "--address", "ignored",
+                       "--format", "json"])
+    out = capsys.readouterr().out
+    data = json.loads(out)
+    assert rc == 0
+    assert {"objects", "store", "summary", "leaks"} <= set(data)
+    assert data["store"]["capacity"] > 0
+
+
+def test_memory_cli_object_drilldown(cluster, monkeypatch, capsys):
+    from ray_tpu import scripts
+
+    @ray_tpu.remote
+    def cli_producer():
+        return 7
+
+    r = cli_producer.remote()
+    assert ray_tpu.get(r) == 7
+    monkeypatch.setattr(scripts, "_connect", lambda addr: None)
+    rc = scripts.main(["memory", r.hex(), "--address", "ignored"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "lineage:" in out and "cli_producer" in out
+
+
+def test_memory_cli_group_by_node_and_state(cluster, monkeypatch, capsys):
+    from ray_tpu import scripts
+
+    keep = ray_tpu.put(b"n" * 100)  # noqa: F841
+    monkeypatch.setattr(scripts, "_connect", lambda addr: None)
+    for group in ("node", "state"):
+        rc = scripts.main(["memory", "--address", "ignored",
+                           "--group-by", group])
+        out = capsys.readouterr().out
+        assert rc == 0 and f"Grouped by {group}" in out
+
+
+# ------------------------------------------------------- leak detector
+
+
+LEAKED = []
+
+
+def _leaky_loop(n):
+    for _ in range(n):
+        LEAKED.append(ray_tpu.put(b"L" * 1000))  # the leaking callsite
+
+
+def _clean_loop(n):
+    for _ in range(n):
+        r = ray_tpu.put(b"C" * 1000)  # released every iteration
+        del r
+
+
+def test_leak_detector_flags_growing_callsite(cluster):
+    """Acceptance: a deliberate ObjectRef leak in a loop is flagged by
+    the leak detector with its creating callsite within 3 report
+    windows, while the same loop with releases stays clean."""
+    head = get_head()
+    windows = head.config.object_leak_windows
+    for _ in range(windows):
+        _leaky_loop(5)
+        _clean_loop(5)
+        _report_now()
+    head._leak_sweep(time.time())
+    growth = [s for s in head.leak_suspects.values()
+              if s["kind"] == "growing_callsite"]
+    leaky = [s for s in growth if "_leaky_loop" in (s.get("callsite")
+                                                    or "")]
+    assert leaky, f"leaky callsite not flagged: {growth}"
+    s = leaky[0]
+    assert s["windows"] >= windows
+    assert s["trend_bytes"] == sorted(s["trend_bytes"])
+    assert s["bytes"] >= 5 * windows * 1000
+    # The released loop never accumulates: not a suspect.
+    assert not any("_clean_loop" in (x.get("callsite") or "")
+                   for x in head.leak_suspects.values()), \
+        head.leak_suspects
+    # Releasing the leak clears the suspect on the next report+sweep.
+    LEAKED.clear()
+    _report_now()
+    head._leak_sweep(time.time())
+    assert not any("_leaky_loop" in (x.get("callsite") or "")
+                   for x in head.leak_suspects.values())
+
+
+def test_leak_detector_sealed_never_read(cluster):
+    head = get_head()
+    ref = ray_tpu.put(b"unread" * 50)
+    old_ttl = head.config.object_leak_ttl_s
+    head.config.object_leak_ttl_s = 0.05
+    try:
+        time.sleep(0.1)
+        head._leak_sweep(time.time())
+        mine = [s for s in head.leak_suspects.values()
+                if s["kind"] == "sealed_never_read"
+                and s.get("object_id") == ref.hex()]
+        assert mine, head.leak_suspects
+        # Reading the object clears the suspect.
+        assert ray_tpu.get(ref) == b"unread" * 50
+        head._leak_sweep(time.time())
+        assert not any(s.get("object_id") == ref.hex()
+                       and s["kind"] == "sealed_never_read"
+                       for s in head.leak_suspects.values())
+    finally:
+        head.config.object_leak_ttl_s = old_ttl
+        head._leak_sweep(time.time())
+
+
+def test_leak_detector_borrow_outlives_owner(cluster):
+    head = get_head()
+    ref = ray_tpu.put(b"borrowed" * 10)
+    oid = ref.hex()
+    e = head.objects[oid]
+    with head.lock:
+        e.borrowers.add("phantom-client")
+        e.refcount = 0
+    try:
+        head._leak_sweep(time.time())
+        mine = [s for s in head.leak_suspects.values()
+                if s["kind"] == "borrow_outlives_owner"
+                and s.get("object_id") == oid]
+        assert mine and "phantom-client" in mine[0]["borrowers"]
+    finally:
+        with head.lock:
+            e.borrowers.discard("phantom-client")
+            e.refcount = 1
+        head._leak_sweep(time.time())
+        assert not any(s.get("object_id") == oid
+                       for s in head.leak_suspects.values())
+
+
+def test_leak_suspects_in_metrics_and_summary(cluster):
+    from ray_tpu.util import metrics as um
+
+    head = get_head()
+    head._leak_sweep(time.time())
+    text = um.runtime_stats_text()
+    assert "ray_tpu_object_leak_suspects" in text
+    assert "ray_tpu_object_store_bytes" in text
+    mem = us.memory_summary()
+    assert isinstance(mem["leak_suspects"], list)
+
+
+# ------------------------------------------------------- metrics/export
+
+
+def test_object_gauges_exposed(cluster):
+    from ray_tpu.util import metrics as um
+
+    keep = ray_tpu.put(b"gauge" * 20)  # noqa: F841
+    _report_now()
+    text = um.runtime_stats_text()
+    assert 'ray_tpu_object_store_bytes{node="' in text
+    assert 'ray_tpu_objects_live{kind="' in text
+    assert "ray_tpu_object_callsite_bytes" in text
+
+
+def test_grafana_dashboard_has_object_panels(cluster):
+    from ray_tpu.util.metrics_export import grafana_dashboard
+
+    dash = grafana_dashboard()
+    titles = [p["title"] for p in dash["panels"]]
+    assert "Object store bytes by state" in titles
+    assert "Object bytes by top callsites" in titles
+    assert "Object leak suspects" in titles
+
+
+def test_census_disabled_kill_switch(cluster):
+    """RAY_TPU_OBJECT_CENSUS_ENABLED=0 must leave every surface alive
+    (empty censuses, no crashes) — gated paths all None-check."""
+    from ray_tpu._private.objcensus import OwnerCensus
+
+    rt = global_runtime()
+    saved = rt._census
+    rt._census = None
+    try:
+        ref = ray_tpu.put(b"off")
+        assert ray_tpu.get(ref) == b"off"
+
+        @ray_tpu.remote
+        def off_task():
+            return 1
+
+        assert ray_tpu.get(off_task.remote()) == 1
+        rt.report_rpc_now()
+        mem = us.memory_summary()
+        assert "groups" in mem
+    finally:
+        rt._census = saved
+        assert isinstance(rt._census, OwnerCensus)
