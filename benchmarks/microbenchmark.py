@@ -123,6 +123,7 @@ def main(as_json: bool = False) -> dict:
 
     ray_tpu.kill(actor)
     ray_tpu.shutdown()
+    bench_data_plane(results)
     bench_wire_binary(results)
     bench_seal_coalescing(results)
     bench_event_overhead(results)
@@ -133,6 +134,46 @@ def main(as_json: bool = False) -> dict:
     if as_json:
         print(json.dumps({"microbenchmark": results}))
     return results
+
+
+def bench_data_plane(results: dict) -> None:
+    """Data-plane put/get throughput (PR 8): bulk numpy through the
+    arena (put + the zero-copy get path) in GiB/s, and the colocated
+    device-result cache for jax.Arrays (a cache hit costs a dict
+    lookup, not a device→host→device round trip)."""
+    import gc
+
+    ray_tpu.init(num_cpus=2, object_store_memory=768 * 1024 * 1024,
+                 log_to_driver=False)
+    try:
+        size = 16 << 20
+        gib = size / float(1 << 30)
+        arr = np.random.rand(size // 8)  # 16 MiB of float64
+
+        def put_once():
+            ray_tpu.put(arr)  # ref dies -> release flusher frees async
+
+        timeit("put 16MiB numpy GiB/s", put_once, gib, results=results)
+        gc.collect()
+        ref = ray_tpu.put(arr)
+
+        def get_once():
+            v = ray_tpu.get(ref)
+            assert v.shape == arr.shape
+
+        timeit("get 16MiB numpy zero-copy GiB/s", get_once, gib,
+               results=results)
+        try:
+            import jax.numpy as jnp
+
+            jarr = jnp.asarray(arr)
+            jref = ray_tpu.put(jarr)
+            timeit("get 16MiB jax colocated GiB/s",
+                   lambda: ray_tpu.get(jref), gib, results=results)
+        except Exception:
+            pass  # jax-free box: skip the device-cache op
+    finally:
+        ray_tpu.shutdown()
 
 
 def bench_wire_binary(results: dict) -> None:

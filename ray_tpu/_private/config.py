@@ -106,7 +106,38 @@ class Config:
     # agent store and register as replica sources (spanning-tree
     # broadcast fan-out).
     bulk_replicate_min: int = 16 * 1024 * 1024
-    bulk_replicate_delay_s: float = 1.0
+    # Relay-tree broadcast registers sources IN-WAVE: a completed reader
+    # becomes a pull source immediately (0.0) so later readers of the
+    # same object fan out across the tree instead of convoying on one
+    # primary. Raise to defer replica cache writes past a latency-
+    # sensitive window.
+    bulk_replicate_delay_s: float = 0.0
+
+    # --- zero-copy data plane (metadata-only seals + p2p payload
+    # pulls + relay-tree broadcast; RAY_TPU_DATA_PLANE=0 master kill
+    # switch lives in dataplane.py — read from the env so spawned
+    # workers inherit it) ---
+    # Serialized results at least this big seal METADATA-ONLY: the
+    # payload stays in the producing node's arena and the owner
+    # receives a location record (nbytes, dtype/shape/sharding, holder
+    # address) instead of bytes; getters pull peer-to-peer.
+    data_plane_min_bytes: int = 100 * 1024
+    # Relay fan-out: how many concurrent remote-host pulls one object
+    # serves before additional pullers are parked to wait for a relay
+    # source (a completed reader) to register. <= 0 disables gating.
+    relay_fanout: int = 3
+    # Safety valve: a parked puller is released to the primary source
+    # after this long even if no relay appeared.
+    relay_max_defer_s: float = 5.0
+    # Same-host readers (boot id match) map the holder node's arena
+    # directly instead of pulling over a socket — the host-colocated
+    # fast path (multiple logical nodes per TPU host share RAM).
+    data_plane_host_shm: bool = True
+    # Colocated device-result cache: a get() in the producing process
+    # returns the original device-resident jax.Array (no D2H2D round
+    # trip). Bounds on entries and resident bytes.
+    device_result_cache_entries: int = 64
+    device_result_cache_bytes: int = 256 * 1024 * 1024
 
     # --- direct-call plane (reference: Ray's core-worker "direct call"
     # architecture — the submitter owns its tasks and talks to leased

@@ -1,0 +1,220 @@
+"""Zero-copy data plane: shared helpers for payload movement.
+
+Counterpart of the reference's plasma + push/pull-manager layer
+(reference: src/ray/object_manager/push_manager.h:32, pull_manager.h:57,
+plasma/store.h:55) rebuilt TPU-natively: payload bytes move peer-to-peer
+over the bulk plane (or not at all, for host-colocated readers) while
+the control plane carries metadata-only seals.
+
+This module holds the pieces every layer shares:
+
+  * ``enabled()`` — the RAY_TPU_DATA_PLANE=0 kill switch. Off, workers
+    fall back to the PR-era behavior (payloads stored through the head
+    paths, owners resolve via head metas, no device cache).
+  * Transfer accounting — ``record(path, nbytes)`` counters behind
+    ``ray_tpu_object_bytes_transferred_total{path=...}``. Paths:
+      p2p       bytes pulled from a primary holder over the bulk plane
+      relay     bytes pulled from a relay (replica) source
+      local     bytes read from a host-mapped arena (no network)
+      zero_copy bytes served as aliasing views (no host copy at all)
+      inline    payload bytes that rode control-plane frames
+      spill     bytes restored from external storage
+    ``host_copies`` counts host-side payload copies on the read path —
+    the structural guard that a large result reaches the caller with at
+    most ONE copy end to end.
+  * ``host_id()`` — boot-scoped host identity: two "nodes" (simulated
+    or real) sharing it share physical RAM, so readers may map the
+    holder's arena directly instead of pulling bytes through a socket.
+  * ``array_meta(value)`` — dtype/shape (+ sharding for jax.Array)
+    stamped into metadata-only seals so consumers can reason about a
+    tensor result without ever deserializing the payload.
+  * ``DeviceCache`` — the colocated fast path: a bounded cache of
+    device-resident jax.Array results keyed by object id. A get() in
+    the producing process returns the SAME device array — no
+    device→host→device round trip.
+  * ``rematerialize(value, meta)`` — the cross-node half: a pulled
+    host view becomes a jax.Array again via jax.device_put, preserving
+    dtype/shape from the seal metadata.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+from typing import Any
+
+_TRANSFER_PATHS = ("p2p", "relay", "local", "zero_copy", "inline", "spill")
+
+
+def enabled() -> bool:
+    """Master kill switch (read per call — tests flip the env var)."""
+    return os.environ.get("RAY_TPU_DATA_PLANE", "1").lower() not in (
+        "0", "false", "no")
+
+
+# ---------------------------------------------------------------------------
+# transfer accounting
+
+# Mutated with GIL-atomic ops only (dict __setitem__ on str keys) — the
+# hot path never takes a lock; snapshots copy atomically via dict().
+_bytes: dict[str, int] = {}
+_copies: dict[str, int] = {}
+
+
+def record(path: str, nbytes: int, copies: int = 1) -> None:
+    """One payload movement of ``nbytes`` over ``path`` costing
+    ``copies`` host-side copies (0 for aliasing zero-copy reads)."""
+    _bytes[path] = _bytes.get(path, 0) + int(nbytes)
+    if copies:
+        _copies[path] = _copies.get(path, 0) + int(copies)
+
+
+def counters() -> dict:
+    """Snapshot: {"bytes": {path: n}, "host_copies": {path: n}}."""
+    return {"bytes": dict(_bytes), "host_copies": dict(_copies)}
+
+
+def reset_counters() -> None:
+    """Tests only."""
+    _bytes.clear()
+    _copies.clear()
+
+
+# ---------------------------------------------------------------------------
+# host identity
+
+_host_id: "str | None" = None
+
+
+def host_id() -> str:
+    """Boot-scoped host identity: processes sharing it share physical
+    memory (and /dev/shm), so arenas are cross-mappable between them.
+    Containers with private /dev/shm also get distinct ids via the
+    shm namespace device stamp."""
+    global _host_id
+    if _host_id is None:
+        boot = ""
+        try:
+            with open("/proc/sys/kernel/random/boot_id") as f:
+                boot = f.read().strip()
+        except OSError:
+            boot = "no-boot-id"
+        try:
+            st = os.stat("/dev/shm")
+            boot += f":{st.st_dev}:{st.st_ino}"
+        except OSError:
+            pass
+        _host_id = boot
+    return _host_id
+
+
+# ---------------------------------------------------------------------------
+# tensor seal metadata
+
+def array_meta(value: Any) -> "dict | None":
+    """Metadata-only description of a top-level tensor result: consumers
+    of a metadata seal learn dtype/shape (+ sharding + device residency
+    for jax.Array) without deserializing the payload. None for
+    non-tensor values. Never imports numpy/jax into a process that
+    hasn't already."""
+    mods = sys.modules
+    np = mods.get("numpy")
+    if np is not None and isinstance(value, np.ndarray):
+        return {"kind": "ndarray", "dtype": str(value.dtype),
+                "shape": tuple(value.shape)}
+    if "jax" in mods:
+        try:
+            import jax
+
+            if isinstance(value, jax.Array):
+                meta = {"kind": "jax", "dtype": str(value.dtype),
+                        "shape": tuple(value.shape)}
+                try:
+                    meta["sharding"] = repr(value.sharding)
+                except Exception:
+                    pass
+                return meta
+        except Exception:
+            pass
+    if isinstance(value, (bytes, bytearray)):
+        return {"kind": "bytes", "shape": (len(value),)}
+    return None
+
+
+def rematerialize(value: Any, meta: "dict | None") -> Any:
+    """Cross-node device fast path: a host numpy view pulled over the
+    data plane becomes a device-resident jax.Array again when the seal
+    metadata says the producer returned one. dtype/shape ride the
+    deserialized array itself; sharding is advisory metadata (a single
+    device_put cannot reproduce a multi-device layout — the caller's
+    mesh context governs)."""
+    if not meta or meta.get("kind") != "jax" or "jax" not in sys.modules:
+        return value
+    try:
+        import jax
+
+        return jax.device_put(value)
+    except Exception:
+        return value
+
+
+# ---------------------------------------------------------------------------
+# colocated device-result cache
+
+class DeviceCache:
+    """Bounded LRU of device-resident results keyed by object id.
+
+    The producing process keeps the ORIGINAL jax.Array of a large
+    result alongside the serialized copy it stored for remote readers;
+    a colocated get() returns that same (immutable) array — zero
+    device→host→device round trips, sharding intact. Entries retire on
+    LRU pressure (count and byte bounds) and when the cluster frees the
+    object. jax.Arrays are immutable, so handing back the same object
+    is semantically identical to a fresh deserialization."""
+
+    def __init__(self, max_entries: int, max_bytes: int):
+        self.max_entries = max_entries
+        self.max_bytes = max_bytes
+        self._lock = threading.Lock()
+        self._entries: "dict[str, tuple[Any, int]]" = {}
+        self._bytes = 0
+        self.hits = 0
+
+    def put(self, hex_id: str, value: Any, nbytes: int) -> None:
+        if self.max_entries <= 0 or nbytes > self.max_bytes:
+            return
+        with self._lock:
+            old = self._entries.pop(hex_id, None)
+            if old is not None:
+                self._bytes -= old[1]
+            self._entries[hex_id] = (value, nbytes)
+            self._bytes += nbytes
+            while self._entries and (len(self._entries) > self.max_entries
+                                     or self._bytes > self.max_bytes):
+                oldest = next(iter(self._entries))
+                if oldest == hex_id and len(self._entries) == 1:
+                    break  # never evict the entry just inserted
+                _v, b = self._entries.pop(oldest)
+                self._bytes -= b
+
+    def get(self, hex_id: str) -> Any:
+        with self._lock:
+            ent = self._entries.pop(hex_id, None)
+            if ent is None:
+                return None
+            # Move-to-back keeps the LRU order honest on dict pop/insert.
+            self._entries[hex_id] = ent
+            self.hits += 1
+            return ent[0]
+
+    def pop(self, hex_id: str) -> None:
+        with self._lock:
+            ent = self._entries.pop(hex_id, None)
+            if ent is not None:
+                self._bytes -= ent[1]
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"entries": len(self._entries), "bytes": self._bytes,
+                    "hits": self.hits}

@@ -64,7 +64,7 @@ class ObjectEntry:
         "refcount", "read_pins", "task_pins", "lru", "is_error", "owner_id",
         "created_at", "location", "remote_offset", "borrowers",
         "container_pins", "contained", "pin_holders", "replicas", "rr",
-        "owner_resident", "reads", "last_read",
+        "owner_resident", "reads", "last_read", "pull_clients",
     )
 
     def __init__(self, object_id: str, owner_id: str):
@@ -113,6 +113,12 @@ class ObjectEntry:
         # instead of convoying on one source.
         self.replicas: dict[str, tuple] = {}
         self.rr = 0
+        # Relay-tree gating: in-flight remote bulk pulls by client id
+        # (incremented when a gateable p2p meta is served, decremented
+        # at that client's read_done). Past relay_fanout, additional
+        # remote pullers park until a relay source registers or a slot
+        # frees — O(N) convoys on one source become a tree.
+        self.pull_clients: dict[str, int] = {}
         # Owner-resident object (reference: core_worker in-process store
         # + ownership, core_worker.h:172): the payload lives in the
         # OWNING runtime's store, delivered there directly by the
@@ -419,6 +425,16 @@ class Head:
         self._any_deadlines = False
         self.node_agents: dict[str, rpc.Connection] = {}  # node_id -> agent conn
         self.node_transfer_addrs: dict[str, tuple] = {}  # node_id -> (ip, port)
+        # Data plane: per-node arena identity (store name, capacity,
+        # host id) stamped into p2p metas so host-colocated readers map
+        # the holder arena directly.
+        self.node_store_info: dict[str, dict] = {}
+        # Relay-tree broadcast gating: per-object count of in-flight
+        # remote pulls (incremented when a gateable p2p meta is served,
+        # decremented at read_done) and the pullers parked waiting for
+        # a relay source to register. waiter_id -> (conn, parked_at).
+        self._relay_parked: dict[str, deque] = {}
+        self._parked_waiters: dict[str, tuple] = {}
         # Liveness beyond the TCP session (reference: GCS health checks,
         # gcs_health_check_manager.h:45): agents heartbeat every
         # health_check_period_s; a node silent past
@@ -930,6 +946,7 @@ class Head:
             self._agent_last_seen.pop(node_id, None)
             self.node_transfer_addrs.pop(node_id, None)
             self.node_bulk_addrs.pop(node_id, None)
+            self.node_store_info.pop(node_id, None)
             self.clock_offsets.pop(node_id, None)
             self.rpc_reports.pop(f"agent:{node_id}", None)
             self.scheduler.mark_dead(node_id)
@@ -973,6 +990,14 @@ class Head:
                     nid, (off, _sz) = next(iter(e.replicas.items()))
                     del e.replicas[nid]
                     e.location, e.remote_offset = nid, off
+                    continue
+                if e.spill_path:
+                    # The primary died but a spill copy survives in
+                    # external storage: serve via restore instead of
+                    # declaring the object lost.
+                    e.state = SPILLED
+                    e.location = None
+                    e.remote_offset = None
                     continue
                 e.state = LOST
                 e.location = None
@@ -1097,6 +1122,8 @@ class Head:
         now = time.time()
         grace = self.config.health_check_timeout_s
         self._overload_sweep(now)
+        if self._parked_waiters:
+            self._relay_sweep()
         if (now - self._last_leak_sweep
                 >= self.config.object_leak_sweep_interval_s):
             self._last_leak_sweep = now
@@ -1394,7 +1421,8 @@ class Head:
                     self.client_owner_addrs[client_id] = tuple(
                         body["owner_addr"])
                 conn.peer_info = {"client_id": client_id, "type": "worker",
-                                  "remote": remote,
+                                  "remote": remote, "node_id": rec.node_id,
+                                  "host": body.get("host"),
                                   "specenc": bool(body.get("specenc"))}
             self.dispatch_event.set()
         else:
@@ -1411,7 +1439,8 @@ class Head:
                     self.client_owner_addrs[client_id] = tuple(
                         body["owner_addr"])
             conn.peer_info = {"client_id": client_id, "type": "driver",
-                              "remote": remote}
+                              "remote": remote, "node_id": self.node_id,
+                              "host": body.get("host")}
         from ray_tpu._private.task_spec import _specenc
 
         return {
@@ -1482,8 +1511,76 @@ class Head:
         print(f"ray_tpu head: node {node_id} "
               f"{'PRESSURED' if pressured else 'recovered'} "
               f"(mem {used}/{total})", file=sys.stderr)
+        if pressured:
+            # Data-plane spill gating (PR 5 watermarks → external
+            # storage): a pressured node's cold object primaries move
+            # to disk and its redundant relay replicas free outright.
+            try:
+                self._spill_node_objects(node_id)
+            except Exception:
+                pass
         if not pressured:
             self.dispatch_event.set()
+
+    def _spill_node_objects(self, node_id: str,
+                            max_objects: int = 8) -> None:
+        """Pick a memory-pressured node's spill victims: its coldest
+        unpinned primaries (bytes move to external storage through the
+        agent's spill-with-consent protocol) and every redundant relay
+        replica it hosts (freed outright — other copies exist)."""
+        agent = self.node_agents.get(node_id)
+        if agent is None:
+            return
+        with self.lock:
+            cands = sorted(
+                (e for e in self.objects.values()
+                 if e.location == node_id and e.state == SEALED
+                 and not e.spill_path and e.read_pins == 0
+                 and not e.pull_clients
+                 and e.size >= self.config.bulk_transfer_min),
+                key=lambda e: e.lru)
+            ids = [e.object_id for e in cands[:max_objects]]
+            for e in self.objects.values():
+                if node_id in e.replicas and e.location != node_id:
+                    del e.replicas[node_id]
+                    try:
+                        agent.cast("free_object",
+                                   {"object_id": e.object_id})
+                    except rpc.ConnectionLost:
+                        pass
+        if ids:
+            try:
+                agent.cast("spill_objects", {"ids": ids})
+            except rpc.ConnectionLost:
+                pass
+
+    def _h_object_spilled(self, body: dict, conn):
+        """An agent wrote an object's bytes to external storage and
+        asks to drop its arena copy. Granted only when no reader holds
+        a meta into that arena (the spill file is recorded either way —
+        it doubles as the node-death recovery copy)."""
+        with self.lock:
+            e = self.objects.get(body["object_id"])
+            if e is None:
+                # Freed while the agent was writing: nothing references
+                # the spill copy either.
+                return {"drop": True, "delete": True}
+            e.spill_path = body["path"]
+            if (e.location != body.get("node_id") or e.read_pins > 0
+                    or e.pull_clients):
+                return {"drop": False}
+            if e.replicas:
+                # A relay replica survives in RAM: promote it to
+                # primary; the spill file stays as the backstop.
+                nid, (off, _sz) = next(iter(e.replicas.items()))
+                del e.replicas[nid]
+                e.location, e.remote_offset = nid, off
+            else:
+                e.location = None
+                e.remote_offset = None
+                e.state = SPILLED
+            self._relay_release(body["object_id"])
+            return {"drop": True}
 
     def _h_register_node(self, body: dict, conn: rpc.Connection):
         """A node agent joins the cluster (reference: raylet registration
@@ -1501,6 +1598,14 @@ class Head:
             if body.get("bulk_port"):
                 self.node_bulk_addrs[node_id] = (peer_ip,
                                                  int(body["bulk_port"]))
+            if body.get("store_name"):
+                # Data plane: the node's arena identity + host id let
+                # host-colocated readers map the arena directly instead
+                # of pulling bytes through a socket (p2p meta "extra").
+                self.node_store_info[node_id] = {
+                    "store": body["store_name"],
+                    "cap": int(body.get("store_capacity") or 0),
+                    "host": body.get("host_id")}
         resources = dict(body.get("resources") or {})
         resources.setdefault(f"node:{node_id}", 1.0)
         entry = NodeEntry(
@@ -1834,7 +1939,9 @@ class Head:
         return e is not None and e.state in (SEALED, SPILLED)
 
     def _meta_for(self, entry: ObjectEntry, remote: bool = False,
-                  client_id: "str | None" = None) -> tuple:
+                  client_id: "str | None" = None,
+                  client_node: "str | None" = None,
+                  client_host: "str | None" = None) -> tuple:
         # Leak-detector input: this entry was fetched (sealed-but-never-
         # read objects past the TTL are suspects; a read clears them).
         entry.reads += 1
@@ -1871,15 +1978,30 @@ class Head:
                 # primary + replicas. Read-pinned like shm metas: the
                 # free_object cast must not fire mid-pull (client sends
                 # read_done when finished).
-                src = self._pick_source(entry)
+                src = self._pick_source(entry, client_node)
                 if src is not None:
                     node_id, off, addr = src
                     entry.read_pins += 1
                     if client_id:
                         entry.pin_holders[client_id] = (
                             entry.pin_holders.get(client_id, 0) + 1)
+                    # Data-plane "extra": the source arena's identity
+                    # (host-colocated readers map it directly) and
+                    # whether this source is a relay (a replica, not
+                    # the primary) for the transfer-path counters.
+                    info = self._node_store_meta(node_id)
+                    extra = dict(info) if info else {}
+                    extra["relay"] = node_id != (entry.location
+                                                 or self.node_id)
+                    if client_id and self._pull_counted(
+                            entry, node_id, client_node, client_host,
+                            extra):
+                        # Remote bulk pull expected: account the slot
+                        # for relay fan-out gating (read_done frees it).
+                        entry.pull_clients[client_id] = (
+                            entry.pull_clients.get(client_id, 0) + 1)
                     return ("p2p", entry.object_id, node_id, addr,
-                            off, entry.size, entry.is_error)
+                            off, entry.size, entry.is_error, extra)
             if remote:
                 # Off-host client, small object: copy out under the lock
                 # and ship bytes over the connection (no mmap, no read
@@ -1896,11 +2018,40 @@ class Head:
             return ("shm", entry.offset, entry.size, entry.is_error)
         return ("lost", f"object {entry.object_id} is {entry.state}", False)
 
-    def _pick_source(self, entry: ObjectEntry):
+    def _node_store_meta(self, node_id: str) -> "dict | None":
+        """Arena identity of a source node for the p2p meta's extra
+        (store name + capacity + host id; host-colocated readers use it
+        to map the arena instead of pulling)."""
+        if node_id == self.node_id:
+            from ray_tpu._private import dataplane
+
+            return {"store": self.shm_name,
+                    "cap": self.config.object_store_memory,
+                    "host": dataplane.host_id()}
+        return self.node_store_info.get(node_id)
+
+    def _pull_counted(self, entry: ObjectEntry, src_node: str,
+                      client_node, client_host, extra: dict) -> bool:
+        """Whether serving this meta consumes a relay fan-out slot: only
+        readers that will actually PULL bytes over the network count —
+        same-node readers copy out of their mapped arena, and clients
+        that advertised a matching host id map the source arena
+        directly."""
+        if self.config.relay_fanout <= 0:
+            return False
+        if client_node is not None and client_node == src_node:
+            return False
+        if client_host and extra.get("host") == client_host:
+            return False
+        return True
+
+    def _pick_source(self, entry: ObjectEntry,
+                     client_node: "str | None" = None):
         """lock held. Choose a payload source among the primary copy and
         replicas (spanning-tree fan-out: a node that pulled the object
-        becomes a source for later pullers). Returns (node_id, offset,
-        bulk_addr) or None."""
+        becomes a source for later pullers), preferring a copy on the
+        REQUESTER's own node (it reads its mapped arena — no transfer
+        at all). Returns (node_id, offset, bulk_addr) or None."""
         sources = []
         if entry.location is not None:
             sources.append((entry.location, entry.remote_offset))
@@ -1909,6 +2060,12 @@ class Head:
         for nid, (off, _sz) in entry.replicas.items():
             if nid in self.node_agents or nid == self.node_id:
                 sources.append((nid, off))
+        if client_node is not None:
+            for nid, off in sources:
+                if nid == client_node and nid != self.node_id:
+                    addr = self.node_bulk_addrs.get(nid)
+                    if addr is not None:
+                        return nid, off, addr
         while sources:
             entry.rr += 1
             nid, off = sources[entry.rr % len(sources)]
@@ -1938,6 +2095,9 @@ class Head:
             e = self.objects.get(body["object_id"])
             if e is not None and e.state == SEALED:
                 e.replicas[body["node_id"]] = (body["offset"], body["size"])
+                # Relay tree: a new source exists — parked pullers fan
+                # out onto it immediately.
+                self._relay_release(body["object_id"])
                 return None
             # Object freed while the replica was being cached: without a
             # directory entry nothing would ever free the sealed bytes —
@@ -1951,9 +2111,86 @@ class Head:
                     pass
         return None
 
-    def _send_metas(self, conn: rpc.Connection, waiter_id: str) -> None:
+    def _relay_gated(self, ids, conn) -> "str | None":
+        """lock held. The object id whose relay fan-out budget is
+        exhausted for this (pulling) client, or None. Parked waiters
+        re-check when a pull slot frees or a relay source registers —
+        the health loop's relay_max_defer_s sweep is the safety valve."""
+        if self.config.relay_fanout <= 0:
+            return None
+        client_node = conn.peer_info.get("node_id")
+        client_host = conn.peer_info.get("host")
+        remote = bool(conn.peer_info.get("remote"))
+        for oid in ids:
+            e = self.objects.get(oid)
+            if (e is None or e.state != SEALED or e.inline is not None
+                    or e.owner_resident):
+                continue
+            p2p_like = e.location is not None or (
+                remote and e.offset is not None
+                and e.size > self.config.bulk_transfer_min)
+            if not p2p_like:
+                continue
+            if sum(e.pull_clients.values()) < self.config.relay_fanout:
+                continue
+            # A slot-exempt reader (same node/host as some source) never
+            # parks: probe with the same predicate the server applies.
+            src_nodes = set(e.replicas)
+            src_nodes.add(e.location or self.node_id)
+            exempt = False
+            for nid in src_nodes:
+                info = self._node_store_meta(nid) or {}
+                if not self._pull_counted(e, nid, client_node,
+                                          client_host, info):
+                    exempt = True
+                    break
+            if not exempt:
+                return oid
+        return None
+
+    def _relay_release(self, object_id: str) -> None:
+        """lock held. A pull slot freed (read_done) or a new source
+        registered (add_replica): re-run parked pullers of this object
+        through the meta path (they may park again if the budget is
+        still exhausted)."""
+        q = self._relay_parked.pop(object_id, None)
+        if not q:
+            return
+        for waiter_id in q:
+            parked = self._parked_waiters.pop(waiter_id, None)
+            if parked is not None:
+                self._send_metas(parked[0], waiter_id)
+
+    def _relay_sweep(self) -> None:
+        """Health-loop safety valve: a puller parked past
+        relay_max_defer_s is released to whatever sources exist (gating
+        is an optimization; it must never become a hang)."""
+        cutoff = time.time() - self.config.relay_max_defer_s
+        with self.lock:
+            stale = [w for w, (_c, t0) in self._parked_waiters.items()
+                     if t0 < cutoff]
+            for waiter_id in stale:
+                conn, _t0 = self._parked_waiters.pop(waiter_id)
+                for q in self._relay_parked.values():
+                    try:
+                        q.remove(waiter_id)
+                    except ValueError:
+                        pass
+                self._send_metas(conn, waiter_id, gate=False)
+
+    def _send_metas(self, conn: rpc.Connection, waiter_id: str,
+                    gate: bool = True) -> None:
         metas = {}
-        ids = self._waiter_ids.pop(waiter_id, [])
+        ids = self._waiter_ids.get(waiter_id) or []
+        if gate:
+            gated_oid = self._relay_gated(ids, conn)
+            if gated_oid is not None:
+                self._relay_parked.setdefault(
+                    gated_oid, deque()).append(waiter_id)
+                self._parked_waiters[waiter_id] = (conn, time.time())
+                return
+        self._waiter_ids.pop(waiter_id, None)
+        self._parked_waiters.pop(waiter_id, None)
         remote = bool(conn.peer_info.get("remote"))
         for oid in ids:
             entry = self.objects.get(oid)
@@ -1962,7 +2199,9 @@ class Head:
             else:
                 metas[oid] = self._meta_for(
                     entry, remote=remote,
-                    client_id=conn.peer_info.get("client_id"))
+                    client_id=conn.peer_info.get("client_id"),
+                    client_node=conn.peer_info.get("node_id"),
+                    client_host=conn.peer_info.get("host"))
         # The cast happens OFF the head lock path: for remote clients the
         # metas embed full payloads, and a blocking sendall to a slow peer
         # under self.lock would freeze all scheduling.
@@ -2006,6 +2245,14 @@ class Head:
                         e.pin_holders[client_id] -= 1
                         if not e.pin_holders[client_id]:
                             del e.pin_holders[client_id]
+                    if client_id and e.pull_clients.get(client_id):
+                        # A relay fan-out slot freed: parked pullers of
+                        # this object re-run the meta path (the freed
+                        # slot or a fresh replica serves them).
+                        e.pull_clients[client_id] -= 1
+                        if not e.pull_clients[client_id]:
+                            del e.pull_clients[client_id]
+                        self._relay_release(oid)
                     if e.refcount <= 0:
                         self._maybe_free(e)
         return None
@@ -2036,6 +2283,7 @@ class Head:
             self.get_waiters.pop(body["waiter_id"], None)
             if hasattr(self, "_waiter_ids"):
                 self._waiter_ids.pop(body["waiter_id"], None)
+            self._parked_waiters.pop(body["waiter_id"], None)
         return None
 
     def _h_del_ref(self, body: dict, conn):
@@ -2143,6 +2391,7 @@ class Head:
             self.arena.free(entry.offset)
         if entry.spill_path:
             self.external_storage.delete(entry.spill_path)
+        self._relay_parked.pop(entry.object_id, None)
         holders = set(entry.replicas)
         if entry.location is not None:
             holders.add(entry.location)
@@ -5075,7 +5324,21 @@ class Head:
                                if a.state == "ALIVE")
             rpc = {cid: dict(r.get("counters") or {})
                    for cid, r in self.rpc_reports.items()}
+            from ray_tpu._private import dataplane
             from ray_tpu._private.retry import breaker_snapshot
+
+            # Data-plane transfer accounting: every runtime's byte/copy
+            # counters (ridden in on rpc_report) plus this process's
+            # own, summed by path for
+            # ray_tpu_object_bytes_transferred_total{path=...}.
+            xfer_bytes: dict[str, int] = {}
+            xfer_copies: dict[str, int] = {}
+            for snap in [dataplane.counters()] + [
+                    c.get("transfers") or {} for c in rpc.values()]:
+                for path, n in (snap.get("bytes") or {}).items():
+                    xfer_bytes[path] = xfer_bytes.get(path, 0) + n
+                for path, n in (snap.get("host_copies") or {}).items():
+                    xfer_copies[path] = xfer_copies.get(path, 0) + n
 
             return {
                 "counters": dict(self.stats),
@@ -5125,6 +5388,10 @@ class Head:
                 # {kind}), top callsites by bytes, and the leak
                 # detector's suspect count.
                 "objects": self._objects_stats_locked(),
+                # Data-plane transfer census
+                # (ray_tpu_object_bytes_transferred_total{path=...}).
+                "transfers": {"bytes": xfer_bytes,
+                              "host_copies": xfer_copies},
             }
 
     def _objects_stats_locked(self) -> dict:

@@ -23,6 +23,12 @@ from ray_tpu._private import rpc
 from ray_tpu._private.config import GLOBAL_CONFIG
 
 
+def _host_id() -> str:
+    from ray_tpu._private import dataplane
+
+    return dataplane.host_id()
+
+
 class _ZygotePid:
     """Popen-shaped handle for a worker forked by the node's zygote
     (the zygote is the OS parent and auto-reaps; this handle can only
@@ -140,6 +146,9 @@ class NodeAgent:
                 "address": socket.gethostname(),
                 "transfer_port": self.transfer_server.address[1],
                 "bulk_port": self.bulk_server.address[1],
+                "store_name": self.store_name,
+                "store_capacity": self.store_capacity,
+                "host_id": _host_id(),
             },
             timeout=GLOBAL_CONFIG.worker_register_timeout_s,
             retry=self._retry_policy,
@@ -326,6 +335,9 @@ class NodeAgent:
                         "address": socket.gethostname(),
                         "transfer_port": self.transfer_server.address[1],
                         "bulk_port": self.bulk_server.address[1],
+                        "store_name": self.store_name,
+                        "store_capacity": self.store_capacity,
+                        "host_id": _host_id(),
                     },
                     timeout=GLOBAL_CONFIG.worker_register_timeout_s,
                 )
@@ -451,12 +463,77 @@ class NodeAgent:
                     loc = self.local_objects.pop(oid, None)
                     if loc is not None:
                         self.store.free(loc[0])
+        elif kind == "spill_objects":
+            # Memory-pressured node (PR 5 watermarks): the head picked
+            # cold primaries to move into external storage. Off the
+            # dispatch thread — spilling writes files.
+            threading.Thread(target=self._spill_objects,
+                             args=(list(body.get("ids") or ()),),
+                             daemon=True, name="agent-spill").start()
         elif kind == "pubsub_message":
             if body.get("topic") == self._view_topic:
                 self.cluster_view.apply(body.get("data") or {})
         elif kind == "shutdown_node":
             self._exit.set()
         return None
+
+    def _spill_store(self):
+        """External storage for this node's spills: the session spill
+        dir (shared storage in production — S3-style via the
+        object_spilling_config backends; one filesystem on a dev box),
+        so the head can restore/delete the copies and they survive this
+        node's death."""
+        store = getattr(self, "_spill_backend", None)
+        if store is None:
+            from ray_tpu._private.external_storage import FileSystemStorage
+
+            store = self._spill_backend = FileSystemStorage(
+                os.path.join(self.session_dir, "spill"))
+        return store
+
+    def _spill_objects(self, ids: list) -> None:
+        """Spill-with-consent protocol: write the bytes to external
+        storage FIRST, then ask the head to drop the arena copy — the
+        head refuses while any reader holds a meta into this arena, in
+        which case the spill file stays as a backup (it doubles as the
+        node-death recovery copy)."""
+        from ray_tpu._private import dataplane
+
+        store = self._spill_store()
+        for oid in ids:
+            with self._store_lock:
+                loc = self.local_objects.get(oid)
+                if loc is None:
+                    continue
+                view = self.store.view(loc[0], loc[1])
+                try:
+                    data = bytes(view)
+                finally:
+                    view.release()
+            try:
+                path = store.spill(oid, memoryview(data))
+            except OSError:
+                continue
+            dataplane.record("spill", len(data))
+            try:
+                reply = self.conn.call(
+                    "object_spilled",
+                    {"object_id": oid, "node_id": self.node_id,
+                     "path": path}, timeout=30)
+            except (rpc.RpcError, rpc.ConnectionLost):
+                continue  # head unreachable: keep both copies
+            if reply.get("delete"):
+                store.delete(path)
+            if reply.get("drop"):
+                # Same deferred-free discipline as free_object: an
+                # in-flight bulk read pins the region.
+                with self._store_lock:
+                    if self._pull_pins.get(oid):
+                        self._pending_free.add(oid)
+                    else:
+                        loc2 = self.local_objects.pop(oid, None)
+                        if loc2 is not None:
+                            self.store.free(loc2[0])
 
     def _bulk_read(self, object_id: str, start: int, length: int):
         with self._store_lock:
@@ -506,6 +583,16 @@ class NodeAgent:
                     f"ObjectStoreFullError: agent store cannot allocate "
                     f"{body['size']} bytes")
             return {"offset": offset}
+        if kind == "locate":
+            # Data plane: direct arena readers (no head pin) bracket
+            # their copy with two locates — unchanged (offset, size)
+            # across the read proves the region wasn't spilled/freed
+            # mid-copy (ids never re-seal at a different offset within
+            # one agent lifetime).
+            with self._store_lock:
+                loc = self.local_objects.get(body["object_id"])
+            return {"offset": loc[0] if loc else None,
+                    "size": loc[1] if loc else None}
         if kind == "seal_local":
             with self._store_lock:
                 existing = self.local_objects.get(body["object_id"])
@@ -575,7 +662,8 @@ class NodeAgent:
         # objects (P2P data plane; name:capacity:host:port).
         env["RAY_TPU_AGENT_STORE"] = (
             f"{self.store_name}:{self.store_capacity}:"
-            f"127.0.0.1:{self.transfer_server.address[1]}")
+            f"127.0.0.1:{self.transfer_server.address[1]}:"
+            f"{self.bulk_server.address[1]}")
         # Crash file + beacon land next to the worker log (forensics.arm
         # in the worker; the reaper reads them post-mortem).
         env["RAY_TPU_CRASH_DIR"] = self.log_dir

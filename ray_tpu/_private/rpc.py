@@ -166,6 +166,7 @@ class Connection:
         # a deterministic check, not a timing benchmark.
         self.frames_sent = 0
         self.calls_sent = 0
+        self.bytes_sent = 0
         self.sent_kinds: dict[str, int] = {}
         # Binary hot-path wire format (wirefmt.py): gates SENDING only
         # (decode is self-detecting). False until the registration /
@@ -267,6 +268,7 @@ class Connection:
         # Counter writes are racy-but-monotonic ints (GIL-atomic enough
         # for a regression guard; exactness is not load-bearing).
         self.frames_sent += 1
+        self.bytes_sent += len(frame)
         self.sent_kinds[kind] = self.sent_kinds.get(kind, 0) + 1
         with self._sendq_lock:
             while (self._send_q_bytes > self._SEND_HIGH_WATER_BYTES

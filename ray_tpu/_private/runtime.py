@@ -22,6 +22,7 @@ from typing import Any, Callable, Sequence
 
 import cloudpickle
 
+from ray_tpu._private import dataplane as _dp
 from ray_tpu._private import ids as ids_mod
 from ray_tpu._private import rpc, serialization
 from ray_tpu._private.config import GLOBAL_CONFIG
@@ -50,8 +51,14 @@ _ERROR_KINDS = {
 
 
 # Owner-store sentinel: the result was too big to inline and lives in
-# the head/agent store — resolve it through a head meta.
+# the head/agent store — resolve it through a head meta (or, when the
+# slot carries a metadata-only seal's location record, straight from
+# the holder node with zero head frames).
 _REMOTE = object()
+
+# "Not servable on this path" sentinel for the zero-copy p2p probe
+# (None is a legitimate deserialized value).
+_MISS = object()
 
 
 class _ShmReadPin:
@@ -184,6 +191,7 @@ class CoreRuntime:
             {"client_type": client_type, "worker_id": worker_id,
              "pid": os.getpid(), "can_shm": can_shm,
              "owner_addr": self.owner_addr,
+             "host": _dp.host_id(),
              "specenc": _specenc() is not None,
              "wire": self._wire_version()},
             timeout=GLOBAL_CONFIG.worker_register_timeout_s,
@@ -211,6 +219,7 @@ class CoreRuntime:
                     {"client_type": client_type, "worker_id": worker_id,
                      "pid": os.getpid(), "can_shm": False,
                      "owner_addr": self.owner_addr,
+             "host": _dp.host_id(),
                      "specenc": _specenc() is not None,
                      "wire": self._wire_version()},
                     timeout=GLOBAL_CONFIG.worker_register_timeout_s,
@@ -226,17 +235,55 @@ class CoreRuntime:
         # head. RAY_TPU_AGENT_STORE=name:capacity:host:port.
         self.agent_shm = None
         self.agent_addr: tuple[str, int] | None = None
+        self.agent_store_name: "str | None" = None
+        self.agent_store_capacity = 0
+        self.agent_bulk_port = 0
         self._agent_conn: rpc.Connection | None = None
         self._peer_conns: dict[tuple, rpc.Connection] = {}
         store_env = os.environ.get("RAY_TPU_AGENT_STORE")
         if store_env and client_type == "worker":
             try:
-                name, cap, host, port = store_env.rsplit(":", 3)
+                # name:capacity:host:port[:bulk_port] — the trailing
+                # bulk port (data plane) lets this worker seal
+                # metadata-only results that name a pullable holder
+                # address; absent with an older agent, results fall
+                # back to head-meta resolution.
+                parts = store_env.rsplit(":", 4)
+                if len(parts) == 5 and parts[4].isdigit():
+                    name, cap, host, port, bulk = parts
+                else:
+                    name, cap, host, port = store_env.rsplit(":", 3)
+                    bulk = "0"
                 self.agent_shm = ShmClient(name, int(cap))
                 self.agent_addr = (host, int(port))
+                self.agent_store_name = name
+                self.agent_store_capacity = int(cap)
+                self.agent_bulk_port = int(bulk)
             except (ValueError, FileNotFoundError):
                 self.agent_shm = None
                 self.agent_addr = None
+        # --- zero-copy data plane (dataplane.py): colocated device-
+        # result cache, host-mapped arena attachments for same-host
+        # reads, and the transfer byte counters that ride rpc_report.
+        from ray_tpu._private import dataplane
+
+        self._dataplane_on = dataplane.enabled()
+        self._device_cache = None
+        if self._dataplane_on:
+            self._device_cache = dataplane.DeviceCache(
+                GLOBAL_CONFIG.device_result_cache_entries,
+                GLOBAL_CONFIG.device_result_cache_bytes)
+        # Host-mapped arenas of OTHER nodes on this host (boot-id
+        # match): store name -> ShmClient (None caches an attach
+        # failure). RAY_TPU_REMOTE=1 simulates off-host placement, so
+        # it disables host mapping too unless RAY_TPU_HOST_SHM=1
+        # explicitly re-enables it (benchmarks measuring the colocated
+        # fast path on simulated nodes).
+        self._host_shms: dict = {}
+        self._host_shm_ok = (
+            self._dataplane_on and GLOBAL_CONFIG.data_plane_host_shm
+            and (os.environ.get("RAY_TPU_REMOTE") != "1"
+                 or os.environ.get("RAY_TPU_HOST_SHM") == "1"))
         self._fn_cache: dict[str, Any] = {}
         self._fn_ids: dict = {}  # id(fn) -> (weakref(fn), func_id)
         # Local borrow counts per object id (reference:
@@ -303,11 +350,17 @@ class CoreRuntime:
         with self._owner_conns_lock:
             peers = {f"{a[0]}:{a[1]}": _conn(c)
                      for a, c in self._owner_conns.items()}
+        from ray_tpu._private import dataplane
         from ray_tpu._private.retry import breaker_snapshot
 
         return {"head": _conn(self.conn), "peers": peers,
                 "direct": (self._direct.snapshot()
                            if self._direct is not None else {}),
+                # Data-plane transfer accounting: payload bytes moved by
+                # path (p2p/relay/local/zero_copy/inline/spill) and the
+                # host-copy census. Rides the SAME amortized rpc_report
+                # cast as the rest of this snapshot — zero new frames.
+                "transfers": dataplane.counters(),
                 # Unified retry plane: this process's per-target circuit
                 # breakers (open/closed, consecutive failures, trip
                 # times) — surfaced cluster-wide via rpc_report so
@@ -434,6 +487,7 @@ class CoreRuntime:
                      "pid": os.getpid(),
                      "can_shm": getattr(self, "shm", None) is not None,
                      "owner_addr": self.owner_addr,
+             "host": _dp.host_id(),
                      "wire": self._wire_version()},
                     timeout=GLOBAL_CONFIG.worker_register_timeout_s,
                 )
@@ -453,6 +507,7 @@ class CoreRuntime:
                              "worker_id": None, "pid": os.getpid(),
                              "can_shm": False,
                              "owner_addr": self.owner_addr,
+             "host": _dp.host_id(),
                              "wire": self._wire_version()},
                             timeout=GLOBAL_CONFIG.worker_register_timeout_s,
                         )
@@ -542,6 +597,10 @@ class CoreRuntime:
                         # object (in-flight tasks may still fetch the
                         # value from this store) and casts owned_freed.
                         owned.append(hex_id)
+                        if self._device_cache is not None:
+                            # The local ref died: the device array must
+                            # not stay resident on its account.
+                            self._device_cache.pop(hex_id)
                         if self._census is not None:
                             # The local ref died: the census tracks
                             # LIVE refs, so the record retires now.
@@ -614,7 +673,15 @@ class CoreRuntime:
 
     def _handle_peer(self, kind: str, body: dict, conn: rpc.Connection):
         if kind == "seal_objects":
-            self._store_owned_and_notify(body["objects"])
+            # Metadata-only seals name their holder by node + ports
+            # only; the routable IP is the one fact the executor cannot
+            # know better than we do — it is where this frame came from.
+            peer_ip = None
+            try:
+                peer_ip = conn._sock.getpeername()[0]
+            except (OSError, AttributeError):
+                pass
+            self._store_owned_and_notify(body["objects"], peer_ip=peer_ip)
             return None
         if kind == "direct_push":
             # Direct-call plane: an owner pushed a task straight to this
@@ -658,7 +725,8 @@ class CoreRuntime:
         raise rpc.RpcError(f"unknown peer message {kind!r}")
 
     def _store_owned_and_notify(self, objs: "list[dict]",
-                                notify: bool = True) -> None:
+                                notify: bool = True,
+                                peer_ip: "str | None" = None) -> None:
         """Store directly-delivered result payloads (or "stored big,
         ask the head" markers), then send the head its slim directory
         notification. Ordering is the invariant that makes owner
@@ -687,6 +755,12 @@ class CoreRuntime:
                 if not rec.get("remote"):
                     self._census.update_size(rec["object_id"],
                                              len(rec["payload"]))
+                elif rec.get("loc"):
+                    # Metadata-only seal: the size is IN the metadata —
+                    # census sizes land without the payload ever being
+                    # pulled, let alone deserialized.
+                    self._census.update_size(rec["object_id"],
+                                             int(rec["loc"].get("size", 0)))
         with self._owned_cond:
             for rec in objs:
                 oid = rec["object_id"]
@@ -694,10 +768,16 @@ class CoreRuntime:
                 if oid in self._dead_owned:
                     continue  # local ref already died: drop the payload
                 if rec.get("remote"):
-                    # Never clobber a real payload already delivered (a
-                    # retried task's head-routed attempt can race the
-                    # first attempt's direct seal).
-                    self._owned_store.setdefault(oid, (_REMOTE, False))
+                    # Metadata-only seal: keep the holder location so
+                    # get() pulls the payload straight from the holder
+                    # node (head fallback on any miss). Never clobber a
+                    # real payload already delivered (a retried task's
+                    # head-routed attempt can race the first attempt's
+                    # direct seal).
+                    loc = rec.get("loc")
+                    if loc is not None and peer_ip and not loc.get("ip"):
+                        loc = dict(loc, ip=peer_ip)
+                    self._owned_store.setdefault(oid, (_REMOTE, loc))
                 else:
                     self._owned_store[oid] = (
                         rec["payload"], rec.get("is_error", False))
@@ -754,6 +834,8 @@ class CoreRuntime:
         the executor) can't orphan bytes in the store."""
         if self._census is not None:
             self._census.release(hex_id)
+        if self._device_cache is not None:
+            self._device_cache.pop(hex_id)
         with self._owned_cond:
             self._owned_store.pop(hex_id, None)
             self._expected_owned.discard(hex_id)
@@ -887,7 +969,7 @@ class CoreRuntime:
 
     def _await_expected(self, waiting: "list[str]", local: dict,
                         missing: "list[str]", deadline, timeout,
-                        ref_list) -> None:
+                        ref_list, locs: "dict | None" = None) -> None:
         """_owned_cond held. Wait for expected result deliveries,
         moving arrivals into ``local`` (payloads) or ``missing`` (big-
         object markers / forgotten ids — resolved via head metas).
@@ -928,7 +1010,10 @@ class CoreRuntime:
                         missing.append(hex_id)
                         progressed = True
                 elif v[0] is _REMOTE:
-                    missing.append(hex_id)
+                    if locs is not None and v[1]:
+                        locs[hex_id] = v[1]  # metadata seal: direct pull
+                    else:
+                        missing.append(hex_id)
                     progressed = True
                 else:
                     local[hex_id] = v
@@ -965,15 +1050,17 @@ class CoreRuntime:
         return self._agent_conn
 
     def _put_p2p(self, object_id: str, header, buffers, size: int,
-                 is_error: bool, contained: "list[str] | None" = None) -> bool:
+                 is_error: bool,
+                 contained: "list[str] | None" = None) -> "int | None":
         """Store into this node's agent arena; register directory-only
-        with the head. Returns False when the local store is full (the
-        caller falls back to the inline path)."""
+        with the head. Returns the sealed arena offset, or None when
+        the local store is full (the caller falls back to the inline
+        path)."""
         try:
             offset = self._agent().call("alloc", {"size": size})["offset"]
         except rpc.RpcError as e:
             if "ObjectStoreFullError" in str(e):
-                return False
+                return None
             raise
         sealed = False
         try:
@@ -992,7 +1079,7 @@ class CoreRuntime:
                 "owner_id": self.client_id, "is_error": is_error,
                 "contained_ids": contained or [],
             })
-            return True
+            return offset
         except rpc.ConnectionLost:
             # Ambiguous: the head may have APPLIED put_p2p before the
             # connection dropped, in which case the directory routes
@@ -1036,13 +1123,15 @@ class CoreRuntime:
         reference: push_manager.h:32). Best-effort: any failure just
         means this node doesn't become a source."""
         try:
-            # Let the active broadcast wave finish first: the cache
-            # write is a size-sized memcpy that would otherwise compete
-            # with concurrent pulls for the same core/NIC. Replicas pay
-            # off on LATER pulls (stragglers, second waves, recovery).
-            import time as _time
+            # In-wave relay registration (delay 0 by default): the
+            # sooner this copy is in the directory, the sooner later
+            # pullers of the same object fan out across the tree
+            # instead of convoying on the primary. A configured delay
+            # defers the memcpy past a latency-sensitive window.
+            if GLOBAL_CONFIG.bulk_replicate_delay_s > 0:
+                import time as _time
 
-            _time.sleep(GLOBAL_CONFIG.bulk_replicate_delay_s)
+                _time.sleep(GLOBAL_CONFIG.bulk_replicate_delay_s)
             size = len(payload)
             offset = self._agent().call("alloc", {"size": size})["offset"]
             try:
@@ -1137,8 +1226,18 @@ class CoreRuntime:
             else:
                 kind = "shm"
             self._census.record(object_id, kind, size, self._callsite())
+        arr = None
+        if (self._device_cache is not None and not _is_error
+                and size >= GLOBAL_CONFIG.data_plane_min_bytes):
+            from ray_tpu._private import dataplane
+
+            arr = dataplane.array_meta(value)
+            if arr is not None and arr.get("kind") == "jax":
+                # Colocated fast path: keep the device-resident array so
+                # a same-process get() skips the host round trip.
+                self._device_cache.put(object_id, value, size)
         self._store_serialized(object_id, header, buffers, size, contained,
-                               _is_error)
+                               _is_error, arr=arr)
         return ObjectRef(object_id, _owned=_object_id is None)
 
     def _inline_body(self, object_id, header, buffers, size, contained,
@@ -1154,15 +1253,31 @@ class CoreRuntime:
         }
 
     def _store_serialized(self, object_id, header, buffers, size, contained,
-                          _is_error) -> None:
+                          _is_error, arr=None) -> "dict | None":
         """Store an already-serialized value: p2p arena, inline call, or
         shm create/seal — the storage decision shared by put() and the
-        deferred task-result path."""
+        deferred task-result path. Returns the holder-location record
+        for arena-resident payloads (the metadata-only seal the owner
+        resolves getters from, zero head frames), else None (inline and
+        head-arena objects resolve through head metas)."""
         if (self.shm is None and self.agent_shm is not None
                 and size > GLOBAL_CONFIG.max_inline_object_size):
-            if self._put_p2p(object_id, header, buffers, size, _is_error,
-                             contained):
-                return
+            offset = self._put_p2p(object_id, header, buffers, size,
+                                   _is_error, contained)
+            if offset is not None:
+                if (not self._dataplane_on
+                        or size < GLOBAL_CONFIG.data_plane_min_bytes):
+                    return None
+                from ray_tpu._private import dataplane
+
+                return {"node": self.node_id, "off": offset, "size": size,
+                        "bulk_port": self.agent_bulk_port or None,
+                        "xfer_port": (self.agent_addr[1]
+                                      if self.agent_addr else None),
+                        "store": self.agent_store_name,
+                        "cap": self.agent_store_capacity,
+                        "host": dataplane.host_id(),
+                        "is_error": _is_error, "arr": arr}
         if self.shm is None or size <= GLOBAL_CONFIG.max_inline_object_size:
             self.conn.call(
                 "put_inline",
@@ -1197,8 +1312,10 @@ class CoreRuntime:
         (the completion path is the control plane's hottest message:
         result + completion in ONE cast replaces a blocking put_inline
         round trip per task). Values too big to inline are stored
-        through the normal path HERE (serialized exactly once) and None
-        is returned."""
+        through the normal path HERE (serialized exactly once); arena-
+        resident payloads return a metadata-only marker carrying the
+        holder location (the owner resolves getters straight from this
+        node), plain big values return None (head-meta resolution)."""
         if (type(value) in self._SCALAR_TYPES
                 and not serialization.custom_reducers):
             # Scalar result: provably no ObjectRefs / device arrays —
@@ -1212,8 +1329,18 @@ class CoreRuntime:
             contained = sorted(set(collected))
         size = serialization.serialized_size(header, buffers)
         if size > GLOBAL_CONFIG.max_inline_object_size:
-            self._store_serialized(object_id, header, buffers, size,
-                                   contained, is_error)
+            arr = None
+            if (self._device_cache is not None and not is_error
+                    and size >= GLOBAL_CONFIG.data_plane_min_bytes):
+                from ray_tpu._private import dataplane
+
+                arr = dataplane.array_meta(value)
+                if arr is not None and arr.get("kind") == "jax":
+                    self._device_cache.put(object_id, value, size)
+            loc = self._store_serialized(object_id, header, buffers, size,
+                                         contained, is_error, arr=arr)
+            if loc is not None:
+                return {"object_id": object_id, "remote": True, "loc": loc}
             return None
         return self._inline_body(object_id, header, buffers, size, contained,
                                  is_error)
@@ -1231,6 +1358,19 @@ class CoreRuntime:
             # but-never-fetched object past the TTL is a suspect).
             self._census.mark_awaited(id_list)
         deadline = None if timeout is None else _time.monotonic() + timeout
+        # Phase 0 — colocated device fast path: results produced in THIS
+        # process keep their device-resident jax.Array in the bounded
+        # device cache; a colocated get() returns that same (immutable)
+        # array — no device→host→device round trip, sharding intact.
+        device_hits: dict[str, Any] = {}
+        if self._device_cache is not None:
+            for hex_id in id_list:
+                v = self._device_cache.get(hex_id)
+                if v is not None:
+                    device_hits[hex_id] = v
+            if len(device_hits) == len(id_list):
+                vals = [device_hits[h] for h in id_list]
+                return vals[0] if single else vals
         # Phase 1 — owner plane (reference: in-process store,
         # core_worker.h:172). Results this runtime owns are DELIVERED
         # here by executors: resolve present ones locally and wait
@@ -1247,15 +1387,24 @@ class CoreRuntime:
                 unblock = self._pre_block()
             except Exception:
                 pass
+        locs: dict[str, dict] = {}
         try:
             with self._owned_cond:
                 waiting: list[str] = []
                 for hex_id in id_list:
+                    if hex_id in device_hits:
+                        continue
                     v = self._owned_store.get(hex_id)
                     if v is not None and v[0] is not _REMOTE:
                         local[hex_id] = v
                     elif v is not None:
-                        missing.append(hex_id)  # big: head meta
+                        if v[1]:
+                            # Metadata-only seal: the holder location
+                            # came with the seal — pull peer-to-peer,
+                            # zero head frames (below, off this lock).
+                            locs[hex_id] = v[1]
+                        else:
+                            missing.append(hex_id)  # big: head meta
                     elif hex_id in self._expected_owned:
                         waiting.append(hex_id)
                     else:
@@ -1264,9 +1413,24 @@ class CoreRuntime:
                     self._owned_waiters += 1
                     try:
                         self._await_expected(waiting, local, missing,
-                                             deadline, timeout, ref_list)
+                                             deadline, timeout, ref_list,
+                                             locs)
                     finally:
                         self._owned_waiters -= 1
+            # Phase 1b — direct pulls for metadata-only seals (off the
+            # condition lock: these hit the network). Any failure falls
+            # back to the head meta path, which re-resolves against the
+            # directory (surviving replica, spill copy, or a typed
+            # provenance-carrying loss).
+            for hex_id, loc in locs.items():
+                try:
+                    got = self._value_from_loc(hex_id, loc)
+                except Exception:  # noqa: BLE001 — head path is fallback
+                    got = None
+                if got is None:
+                    missing.append(hex_id)
+                else:
+                    local[hex_id] = got
             # Phase 2 — head metas for everything else.
             metas: dict = {}
             if missing:
@@ -1292,7 +1456,9 @@ class CoreRuntime:
         visited = 0
         try:
             for hex_id in id_list:
-                if hex_id in local:
+                if hex_id in device_hits:
+                    values.append(device_hits[hex_id])
+                elif hex_id in local:
                     values.append(self._deserialize(*local[hex_id]))
                 else:
                     values.append(self._value_from_meta(
@@ -1303,7 +1469,9 @@ class CoreRuntime:
             # raised mid-batch (e.g. a stored task error), the unvisited
             # metas' pins must still be released or their objects leak.
             for hex_id in id_list[visited + 1:]:
-                if hex_id not in local and metas[hex_id][0] in ("shm", "p2p"):
+                if (hex_id not in local and hex_id not in device_hits
+                        and metas.get(hex_id, ())[:1]
+                        and metas[hex_id][0] in ("shm", "p2p")):
                     read_ids.append(hex_id)
             if read_ids:
                 self.conn.cast("read_done", {"ids": read_ids})
@@ -1373,9 +1541,162 @@ class CoreRuntime:
             # arrays. NOT appended to read_ids — the pin owns release.
             return self._read_shm_zero_copy(hex_id, view)
         if meta[0] == "p2p":
+            value = self._p2p_zero_copy(hex_id, meta)
+            if value is not _MISS:
+                # Aliasing view straight out of a host-mapped arena:
+                # the _ShmReadPin owns the read pin (released when the
+                # last aliasing array dies) — NOT appended to read_ids.
+                return value
             read_ids.append(hex_id)  # p2p metas are read-pinned too
             return self._read_p2p_retrying(hex_id, meta, read_ids)
         raise ObjectLostError(meta[1])
+
+    def _host_arena(self, store: "str | None", capacity: int,
+                    host: "str | None"):
+        """Map another node's arena when it shares this host (boot-id
+        match): logical nodes on one TPU host share physical RAM, so a
+        'remote' payload is a memoryview away. Returns a cached
+        ShmClient or None (off-host, unmappable, or disabled)."""
+        if not self._host_shm_ok or not store or not host:
+            return None
+        from ray_tpu._private import dataplane
+
+        if host != dataplane.host_id():
+            return None
+        client = self._host_shms.get(store)
+        if client is None and store not in self._host_shms:
+            try:
+                client = ShmClient(store, int(capacity))
+            except (OSError, ValueError):
+                client = None  # cache the failure: no retry per read
+            self._host_shms[store] = client
+        return client
+
+    def _locate_on_agent(self, conn, object_id: str):
+        """One cheap transfer-plane round trip: (offset, size) if the
+        object is still resident in that agent's arena, else None."""
+        try:
+            r = conn.call("locate", {"object_id": object_id}, timeout=30)
+        except (rpc.RpcError, rpc.ConnectionLost, OSError,
+                FutureTimeoutError):
+            return None
+        return (r["offset"], r["size"]) if r.get("offset") is not None \
+            else None
+
+    def _read_validated(self, arena, conn, object_id: str, size: int):
+        """Copy an object out of a host-mapped arena with the
+        locate/read/locate handshake: direct reads carry no head pin,
+        so the holder could spill or free the region mid-read — two
+        matching locates bracket the copy (ids never re-seal at a
+        different offset within an agent lifetime, so unchanged means
+        the bytes are the object's). None on any mismatch; the caller
+        falls back to a pulled or head-resolved copy."""
+        loc1 = self._locate_on_agent(conn, object_id)
+        if loc1 is None or loc1[1] != size:
+            return None
+        view = arena.view(loc1[0], size)
+        try:
+            payload = bytes(view)
+        except (ValueError, IndexError):
+            return None
+        finally:
+            view.release()
+        if self._locate_on_agent(conn, object_id) != loc1:
+            return None
+        return payload
+
+    def _agent_xfer_conn(self, addr: tuple):
+        """Cached transfer-plane connection to a (possibly remote-node,
+        same-host) agent."""
+        key = (addr[0], int(addr[1]))
+        conn = self._peer_conns.get(key)
+        if conn is None or conn.closed:
+            conn = self._peer_conns[key] = rpc.connect(key, name="xfer")
+        return conn
+
+    def _value_from_loc(self, hex_id: str, loc: dict):
+        """Resolve a metadata-only seal straight from its holder — the
+        zero-head-frames read path. Returns (payload, is_error, arr)
+        for _deserialize, or None when the holder cannot serve (the
+        caller falls back to a head meta, which re-resolves against
+        replicas / spill copies / lineage). Direct reads are unpinned,
+        so every shared-memory shortcut runs the validated-read
+        handshake instead of trusting a stale offset."""
+        from ray_tpu._private import dataplane
+
+        size = int(loc.get("size") or 0)
+        is_error = bool(loc.get("is_error"))
+        arr = loc.get("arr")
+        if size <= 0:
+            return None
+        # Same node: this process maps the holder arena already.
+        if (loc.get("node") == self.node_id and self.agent_shm is not None
+                and self.agent_addr is not None):
+            try:
+                payload = self._read_validated(
+                    self.agent_shm, self._agent(), hex_id, size)
+            except (rpc.ConnectionLost, OSError):
+                payload = None
+            if payload is not None:
+                dataplane.record("local", size)
+                return payload, is_error, arr
+        # Same host, different node: map the holder's arena file.
+        ip, xfer = loc.get("ip"), loc.get("xfer_port")
+        arena = self._host_arena(loc.get("store"), loc.get("cap") or 0,
+                                 loc.get("host"))
+        if arena is not None and ip and xfer:
+            try:
+                payload = self._read_validated(
+                    arena, self._agent_xfer_conn((ip, xfer)), hex_id, size)
+            except (rpc.ConnectionLost, OSError):
+                payload = None
+            if payload is not None:
+                dataplane.record("local", size)
+                return payload, is_error, arr
+        # Cross-host: striped bulk pull from the holder node.
+        port = int(loc.get("bulk_port") or 0)
+        if not ip or not port:
+            return None
+        try:
+            payload = self._pull_p2p(hex_id, (ip, port), size)
+        except Exception:  # noqa: BLE001 — head path is the fallback
+            return None
+        dataplane.record("p2p", size)
+        self._maybe_replicate(hex_id, payload, size, is_error,
+                              loc.get("node"))
+        return payload, is_error, arr
+
+    def _p2p_zero_copy(self, hex_id: str, meta: tuple):
+        """Zero-copy resolution of a read-pinned p2p meta when the
+        holder arena is mappable from this process (same node, or same
+        host via boot-id match). Safe without validation: the meta
+        carries a head read pin, and both frees and head-driven spill
+        skip pinned entries — the _ShmReadPin holds that pin until the
+        last aliasing array dies. Returns _MISS when unmappable (the
+        caller pulls a copy instead)."""
+        from ray_tpu._private import dataplane
+
+        _, object_id, node_id, addr, offset, size, is_error = meta[:7]
+        extra = meta[7] if len(meta) > 7 else None
+        if (not self._dataplane_on or is_error
+                or not GLOBAL_CONFIG.zero_copy_get):
+            return _MISS
+        if node_id == self.node_id and self.agent_shm is not None:
+            arena = self.agent_shm
+        else:
+            arena = None
+            if extra:
+                arena = self._host_arena(extra.get("store"),
+                                         extra.get("cap") or 0,
+                                         extra.get("host"))
+        if arena is None:
+            return _MISS
+        try:
+            view = arena.view(offset, size)
+        except (ValueError, IndexError):
+            return _MISS
+        dataplane.record("zero_copy", size, copies=0)
+        return self._read_shm_zero_copy(hex_id, view)
 
     def _reresolve_meta(self, hex_id: str) -> "tuple | None":
         """One synchronous head round trip for a fresh meta (fallback
@@ -1439,6 +1760,12 @@ class CoreRuntime:
         # "stored big, resolve via head meta" — fall through.
         if self._census is not None:
             self._census.mark_awaited((ref.hex(),))
+        if self._device_cache is not None:
+            cached = self._device_cache.get(ref.hex())
+            if cached is not None:
+                result = Future()
+                result.set_result(cached)
+                return result
         v = self._owned_store.get(ref.hex())
         if v is not None and v[0] is _REMOTE:
             v = None
@@ -1470,10 +1797,11 @@ class CoreRuntime:
                     # thread (it would stall every other incoming head
                     # message for the transfer duration).
                     def _pull():
-                        # p2p metas carried a read pin; owner metas are
-                        # not pinned on the head.
-                        read_ids: list = (
-                            [ref.hex()] if meta[0] == "p2p" else [])
+                        # _value_from_meta appends the pinned id itself
+                        # for p2p metas (pre-seeding it here too used to
+                        # double-release the pin); owner metas are not
+                        # pinned on the head.
+                        read_ids: list = []
                         try:
                             result.set_result(self._value_from_meta(
                                 ref.hex(), meta, read_ids))
@@ -1500,31 +1828,63 @@ class CoreRuntime:
 
     def _fetch_p2p_bytes(self, meta: tuple) -> tuple:
         """Transport half of a p2p read: ("p2p", object_id, node_id,
-        (ip, port), offset, size, is_error) -> (payload, is_error).
-        Same-node readers map the agent arena directly; everyone else
-        pulls chunks from the hosting node's transfer server."""
-        _, object_id, node_id, addr, offset, size, is_error = meta
+        (ip, port), offset, size, is_error[, extra]) -> (payload,
+        is_error). Same-node readers copy out of the mapped agent
+        arena; same-host readers (extra carries the holder's store
+        name + host id) map the holder arena directly; everyone else
+        pulls striped chunks from the hosting node's bulk server."""
+        from ray_tpu._private import dataplane
+
+        _, object_id, node_id, addr, offset, size, is_error = meta[:7]
+        extra = meta[7] if len(meta) > 7 else None
         if node_id == self.node_id and self.agent_shm is not None:
             view = self.agent_shm.view(offset, size)
             try:
+                dataplane.record("local", size)
                 return bytes(view), is_error
             finally:
                 view.release()
+        if extra:
+            # Host-colocated copy read: the meta's read pin makes the
+            # (offset, size) stable, so a direct arena copy is safe.
+            arena = self._host_arena(extra.get("store"),
+                                     extra.get("cap") or 0,
+                                     extra.get("host"))
+            if arena is not None:
+                try:
+                    view = arena.view(offset, size)
+                    try:
+                        dataplane.record("local", size)
+                        return bytes(view), is_error
+                    finally:
+                        view.release()
+                except (ValueError, IndexError):
+                    pass  # implausible offset: fall through to a pull
         if addr is None:
             raise ObjectLostError(
                 f"object {object_id} lives on node {node_id} with no "
                 f"reachable transfer server",
                 object_id=object_id, node_id=node_id)
         payload = self._pull_p2p(object_id, addr, size)
-        if (self.agent_shm is not None and not is_error
-                and node_id != self.node_id
-                and size >= GLOBAL_CONFIG.bulk_replicate_min):
-            # Become a broadcast source for later pullers (off the get
-            # path — the caller shouldn't wait on the cache write).
-            threading.Thread(target=self._replicate_local,
-                             args=(object_id, payload), daemon=True,
-                             name="p2p-replicate").start()
+        dataplane.record(
+            "relay" if extra and extra.get("relay") else "p2p", size)
+        if node_id != self.node_id:
+            self._maybe_replicate(object_id, payload, size, is_error,
+                                  node_id)
         return payload, is_error
+
+    def _maybe_replicate(self, object_id: str, payload, size: int,
+                         is_error: bool, source_node) -> None:
+        """Relay-tree fan-out: a completed reader registers its copy as
+        a pull source (off the get path — the caller never waits on the
+        cache write)."""
+        if (self.agent_shm is None or is_error
+                or source_node == self.node_id
+                or size < GLOBAL_CONFIG.bulk_replicate_min):
+            return
+        threading.Thread(target=self._replicate_local,
+                         args=(object_id, payload), daemon=True,
+                         name="p2p-replicate").start()
 
     def _read_shm_zero_copy(self, hex_id: str, view) -> Any:
         """Deserialize directly out of the store mapping; see
@@ -1559,8 +1919,17 @@ class CoreRuntime:
             weakref.finalize(holder, pin.dec)
         return value
 
-    def _deserialize(self, payload: bytes, is_error: bool) -> Any:
+    def _deserialize(self, payload: bytes, is_error: bool,
+                     arr: "dict | None" = None) -> Any:
         value = serialization.loads(payload)
+        if not is_error and arr is not None:
+            # Device-aware cross-node path: the seal metadata says the
+            # producer returned a device array — rematerialize from the
+            # zero-copy host view (dtype/shape ride the array itself;
+            # sharding is advisory).
+            from ray_tpu._private import dataplane
+
+            value = dataplane.rematerialize(value, arr)
         if is_error:
             if isinstance(value, dict) and "__rtpu_error__" in value:
                 exc_cls = _ERROR_KINDS.get(value["__rtpu_error__"], RayTpuError)

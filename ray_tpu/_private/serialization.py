@@ -55,28 +55,6 @@ def _pad(n: int) -> int:
     return (n + _ALIGN - 1) & ~(_ALIGN - 1)
 
 
-def _to_host(obj: Any) -> Any:
-    """Move jax arrays to host numpy before pickling (device buffers are not
-    picklable; tensors normally shouldn't transit the object store at all —
-    see shm_store docstring — but small ones are allowed for convenience)."""
-    import sys
-
-    if "jax" not in sys.modules:
-        # jax was never imported in this process, so obj cannot be a jax
-        # array — and we must NOT pay the jax import (it dominates a
-        # worker's first-task latency for plain-Python workloads).
-        return obj
-    try:
-        import jax
-        import numpy as np
-
-        if isinstance(obj, jax.Array):
-            return np.asarray(obj)
-    except Exception:
-        pass
-    return obj
-
-
 # Reducers installed via ray_tpu.util.register_serializer. Scoped to THIS
 # serializer (reference: the worker's SerializationContext custom-type
 # table, _private/serialization.py) — plain pickle.dumps/copy.deepcopy in
@@ -142,8 +120,14 @@ def dumps_scoped(obj: Any, protocol: int = 5) -> bytes:
 
 
 def serialize(obj: Any) -> tuple[bytes, list[pickle.PickleBuffer]]:
-    """Returns (header_bytes, oob_buffers)."""
-    obj = _to_host(obj)
+    """Returns (header_bytes, oob_buffers).
+
+    jax.Arrays — top-level OR nested — convert to host numpy exactly
+    once, in _RuntimePickler.reducer_override (the old top-level
+    _to_host pre-pass was redundant with it and made bare arrays pay a
+    second isinstance/convert probe). _dump only routes through the
+    Python-class pickler when jax is loaded, so jax-free processes keep
+    the C fast path."""
     buffers: list[pickle.PickleBuffer] = []
     return _dump(obj, 5, buffers.append), buffers
 

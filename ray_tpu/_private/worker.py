@@ -1120,14 +1120,23 @@ class Worker:
         buf = getattr(spec, "_deferred_results", None)
         if buf is not None:
             body = self.runtime.put_deferred(value, oid, is_error)
-            if body is not None:
+            markers = getattr(spec, "_remote_markers", None)
+            if body is not None and body.get("remote"):
+                # Metadata-only seal: the payload stays in this node's
+                # arena; the marker carries the holder location (+
+                # dtype/shape/sharding for tensors) so the owner
+                # resolves getters straight from here — zero payload
+                # bytes on the owner/head control planes.
+                if markers is not None:
+                    markers.append(body)
+            elif body is not None:
                 buf.append(body)
-            elif getattr(spec, "_remote_markers", None) is not None:
-                # Stored big through the shm/p2p path: tell the owner to
-                # resolve this id via a head meta (its local wait must
-                # not stall on a payload that will never be delivered).
-                spec._remote_markers.append(
-                    {"object_id": oid, "remote": True})
+            elif markers is not None:
+                # Stored big through the head-arena shm path: tell the
+                # owner to resolve this id via a head meta (its local
+                # wait must not stall on a payload that will never be
+                # delivered).
+                markers.append({"object_id": oid, "remote": True})
             return  # big values were stored by put_deferred itself
         self.runtime.put(value, _object_id=oid, _is_error=is_error)
 

@@ -275,6 +275,29 @@ def runtime_stats_text() -> str:
         lines.append("# TYPE ray_tpu_object_leak_suspects gauge")
         lines.append(
             f"ray_tpu_object_leak_suspects {objects['leak_suspects']}")
+    # Zero-copy data plane: payload bytes moved by transfer path
+    # (p2p primary pulls, relay pulls, host-local arena reads,
+    # zero-copy aliasing views, inline control-plane payloads, spill
+    # restores) and the host-side copy census behind the one-copy
+    # structural guard.
+    transfers = snap.get("transfers") or {}
+    xfer_bytes = transfers.get("bytes") or {}
+    if xfer_bytes:
+        lines.append("# TYPE ray_tpu_object_bytes_transferred_total"
+                     " counter")
+        for path in sorted(xfer_bytes):
+            lines.append(
+                f'ray_tpu_object_bytes_transferred_total'
+                f'{{path="{_escape_label_value(path)}"}} '
+                f"{xfer_bytes[path]}")
+    xfer_copies = transfers.get("host_copies") or {}
+    if xfer_copies:
+        lines.append("# TYPE ray_tpu_object_host_copies_total counter")
+        for path in sorted(xfer_copies):
+            lines.append(
+                f'ray_tpu_object_host_copies_total'
+                f'{{path="{_escape_label_value(path)}"}} '
+                f"{xfer_copies[path]}")
     # Cluster-wide head frame census (the zero-per-call-head-frames
     # property, scrapeable): total frames every reporting process has
     # sent the head.

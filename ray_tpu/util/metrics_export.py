@@ -141,6 +141,22 @@ def grafana_dashboard(extra_metrics: "list[str] | None" = None) -> dict:
         "ray_tpu_object_leak_suspects", "short", 12, y))
     next_id += 1
     y += 8
+    # Data-plane row (PR 8): payload movement by path (p2p primaries,
+    # relay sources, host-local arena reads, zero-copy views, inline
+    # control-plane payloads, spill restores) + the host-copy census
+    # behind the one-copy guard.
+    panels.append(_panel(
+        next_id, "Object bytes transferred / s by path",
+        "sum by (path) "
+        "(rate(ray_tpu_object_bytes_transferred_total[1m]))",
+        "Bps", 0, y))
+    next_id += 1
+    panels.append(_panel(
+        next_id, "Host-side payload copies / s by path",
+        "sum by (path) (rate(ray_tpu_object_host_copies_total[1m]))",
+        "ops", 12, y))
+    next_id += 1
+    y += 8
     for i, name in enumerate(extra_metrics or []):
         panels.append(_panel(next_id, name, name, "short",
                              (i % 2) * 12, y + (i // 2) * 8))

@@ -74,6 +74,68 @@ def test_parallel_stripes_roundtrip():
         srv.stop()
 
 
+def test_request_framing_is_binary_no_pickle():
+    """The bulk request header is the PR 6 tagged binary encoding —
+    round-trips through the wirefmt codec, never pickle."""
+    frame = bulk_transfer._encode_request("obj-1", 512, 4096)
+    assert frame[4:5] != b"\x80", "request must not be a pickle stream"
+    (n,) = bulk_transfer._REQ_HDR.unpack(frame[:4])
+    assert n == len(frame) - 4
+    assert bulk_transfer._decode_request(frame[4:]) == ("obj-1", 512, 4096)
+
+
+def test_corrupt_request_typed_error_and_close():
+    """Corrupt or legacy-pickled requests raise the typed
+    BulkRequestError server-side and CLOSE the connection (the mirror
+    of the control plane's WireDecodeError contract)."""
+    import pickle
+    import socket
+    import struct
+
+    # Decoder contract: pickle explicitly rejected, garbage typed.
+    with pytest.raises(bulk_transfer.BulkRequestError, match="pickle"):
+        bulk_transfer._decode_request(
+            pickle.dumps({"object_id": "x", "start": 0, "length": 1}))
+    with pytest.raises(bulk_transfer.BulkRequestError):
+        bulk_transfer._decode_request(b"\xff\xfe garbage")
+    good = bulk_transfer._encode_request("obj", 0, 64)[4:]
+    for cut in (1, len(good) // 2, len(good) - 1):
+        with pytest.raises(bulk_transfer.BulkRequestError):
+            bulk_transfer._decode_request(good[:cut])
+
+    # Server contract: a poisoned frame closes the connection; a fresh
+    # dial still works (per-connection blast radius).
+    reader = _MemReader({"obj": b"x" * 1024})
+    srv = bulk_transfer.BulkServer(reader, host="127.0.0.1")
+    try:
+        sock = socket.create_connection(srv.address, timeout=10)
+        bad = pickle.dumps({"object_id": "obj", "start": 0, "length": 8})
+        sock.sendall(struct.pack("<I", len(bad)) + bad)
+        assert sock.recv(16) == b"", "server must close on corrupt request"
+        sock.close()
+        out = bulk_transfer.pull_object(srv.address, "obj", 1024)
+        assert bytes(out) == b"x" * 1024
+    finally:
+        srv.stop()
+
+
+def test_pull_buffer_not_zero_filled():
+    """pull_object's default destination comes from alloc_pull_buffer
+    (no zero-fill tax at broadcast sizes) and still round-trips."""
+    buf = bulk_transfer.alloc_pull_buffer(4096)
+    assert memoryview(buf).nbytes == 4096
+    data = os.urandom(1 << 20)
+    reader = _MemReader({"obj": data})
+    srv = bulk_transfer.BulkServer(reader, host="127.0.0.1")
+    try:
+        out = bulk_transfer.pull_object(srv.address, "obj", len(data),
+                                        out=bulk_transfer.alloc_pull_buffer(
+                                            len(data)))
+        assert bytes(out) == data
+    finally:
+        srv.stop()
+
+
 def test_unknown_object_raises():
     reader = _MemReader({})
     srv = bulk_transfer.BulkServer(reader, host="127.0.0.1")

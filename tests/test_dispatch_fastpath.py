@@ -431,6 +431,31 @@ def test_toplevel_jax_array_still_serializes(cluster):
     np.testing.assert_allclose(np.asarray(out), np.ones((4, 4)))
 
 
+def test_large_results_zero_payload_bytes_on_head_conn(cluster):
+    """Data-plane guard: a multi-megabyte task result never rides the
+    head connection as payload — the worker lands the bytes in the
+    node arena (shm on the head node, p2p on agents) and every frame
+    the owner exchanges with the head is metadata-sized. Asserted at
+    the byte level (rpc.Connection.bytes_sent), not just frame kinds."""
+    import ray_tpu
+
+    @ray_tpu.remote
+    def big(n):
+        return np.arange(n, dtype=np.float64)
+
+    rt = global_runtime()
+    ray_tpu.get(big.remote(4), timeout=60)  # warm the worker + lease
+    n = 1_000_000  # 8 MB
+    before_bytes = rt.conn.bytes_sent
+    before_inline = rt.conn.sent_kinds.get("put_inline", 0)
+    vals = ray_tpu.get([big.remote(n) for _ in range(3)], timeout=120)
+    assert all(float(v[-1]) == n - 1 for v in vals)
+    assert rt.conn.sent_kinds.get("put_inline", 0) == before_inline
+    sent = rt.conn.bytes_sent - before_bytes
+    assert sent < 3 * n * 8 // 100, \
+        f"{sent} bytes crossed the head connection for 24 MB of results"
+
+
 # --------------------------------- lease starvation regression guards
 
 
