@@ -737,6 +737,25 @@ class DirectPlane:
                 "outstanding": sum(len(t) for t in self._tables()),
             }
 
+    def route_load(self, actor_id: str) -> dict:
+        """Owner-side load view of one actor route, for load-aware
+        routing (serve DeploymentHandle): ``outstanding`` calls not yet
+        resolved, ``unacked`` of those pushed but not delivery-acked,
+        and ``queued`` parked owner-side behind the direct window. A
+        dead or wedged replica shows up as growing ``unacked`` within
+        one ack RTT — long before health probes or the resubmit
+        watchdog fire — so routers can deprioritize it immediately."""
+        with self.lock:
+            r = self.routes.get(actor_id)
+            if r is None:
+                return {"outstanding": 0, "unacked": 0, "queued": 0,
+                        "mode": "head"}
+            pending_ids = {s.task_id for s in r.pending}
+            unacked = sum(1 for tid, rec in r.tasks.items()
+                          if tid not in pending_ids and not rec[3])
+            return {"outstanding": len(r.tasks), "unacked": unacked,
+                    "queued": len(r.pending), "mode": r.mode}
+
     def close(self) -> None:
         with self.lock:
             for pool in list(self.lease_pools.values()):

@@ -21,6 +21,7 @@ from __future__ import annotations
 import collections
 import dataclasses
 import threading
+import time
 from typing import Any, Iterable
 
 import jax
@@ -1094,6 +1095,13 @@ class AsyncLLMEngine:
 
     Optionally streams: ``generate(..., stream=True)`` returns an async
     iterator of incremental token ids as the slot advances.
+
+    Serving integration: ``generate(..., deadline=...)`` carries the
+    request's wall-clock deadline into the decode loop — each step
+    EVICTS owned requests whose deadline expired (waiting or mid-decode)
+    with a typed ``TaskTimeoutError``, freeing their slots for live
+    requests instead of finishing tokens nobody will read. ``snapshot()``
+    reports the token-level batch view for replica telemetry.
     """
 
     def __init__(self, engine: LLMEngine):
@@ -1106,6 +1114,8 @@ class AsyncLLMEngine:
         self._waiters: dict[str, Any] = {}          # rid -> concurrent Future
         self._streams: dict[str, _queue.SimpleQueue] = {}
         self._seen: dict[str, int] = {}             # rid -> tokens streamed
+        self._deadlines: dict[str, float] = {}      # rid -> wall-clock s
+        self._evicted_deadline = 0
         self._wake = threading.Event()
         # If someone calls the sync engine.generate() while we have
         # requests in flight, its stepping delivers our outputs here.
@@ -1129,6 +1139,7 @@ class AsyncLLMEngine:
                         self._wake.clear()
                         break
                     try:
+                        self._evict_expired()
                         outs = self.engine.step()
                         self._push_stream_tokens()
                     except Exception as e:  # noqa: BLE001
@@ -1155,9 +1166,58 @@ class AsyncLLMEngine:
                 q.put(int(tok))
             q.put(out)  # terminal: the RequestOutput itself
         self._seen.pop(out.request_id, None)
+        self._deadlines.pop(out.request_id, None)
         fut = self._waiters.pop(out.request_id, None)
         if fut is not None and not fut.done():
             fut.set_result(out)
+
+    def _evict_expired(self) -> None:
+        """lock held. Continuous-batching admission control, evict side:
+        owned requests whose serving deadline passed are failed with a
+        typed TaskTimeoutError and removed from the engine's queues —
+        a decode slot finishing tokens for a caller that already got
+        HTTP 408 is pure waste under saturation."""
+        if not self._deadlines:
+            return
+        now = time.time()
+        expired = [rid for rid, dl in self._deadlines.items() if now > dl]
+        if not expired:
+            return
+        from ray_tpu.exceptions import TaskTimeoutError
+
+        for rid in expired:
+            self._deadlines.pop(rid, None)
+            exc = TaskTimeoutError(
+                "TaskTimeoutError: request exceeded its deadline during "
+                "LLM decode (evicted from the running batch)",
+                where="llm_decode")
+            fut = self._waiters.pop(rid, None)
+            if fut is not None and not fut.done():
+                fut.set_exception(exc)
+            q = self._streams.pop(rid, None)
+            if q is not None:
+                q.put(exc)
+            self._seen.pop(rid, None)
+            self._evicted_deadline += 1
+        gone = set(expired)
+        import collections as _collections
+        self.engine.waiting = _collections.deque(
+            r for r in self.engine.waiting if r.request_id not in gone)
+        self.engine.slots = [
+            None if (r is not None and r.request_id in gone) else r
+            for r in self.engine.slots]
+
+    def snapshot(self) -> dict:
+        """Token-level batch view for replica telemetry (Replica
+        .get_metrics surfaces it as the ``engine`` block)."""
+        with self._lock:
+            return {
+                "waiting": len(self.engine.waiting),
+                "active": sum(1 for s in self.engine.slots if s is not None),
+                "slots": len(self.engine.slots),
+                "owned": len(self._waiters) + len(self._streams),
+                "evicted_deadline": self._evicted_deadline,
+            }
 
     def _fail_all(self, exc: Exception) -> None:
         """lock held. Resolve every async-owned pending request with the
@@ -1174,6 +1234,8 @@ class AsyncLLMEngine:
             q.put(exc)  # aiter re-raises it
         self._streams.clear()
         self._seen.clear()
+        for rid in owned:
+            self._deadlines.pop(rid, None)
         import collections as _collections
         self.engine.waiting = _collections.deque(
             r for r in self.engine.waiting if r.request_id not in owned)
@@ -1199,10 +1261,12 @@ class AsyncLLMEngine:
 
     async def generate(self, prompt: "str | list[int]",
                        sampling_params: SamplingParams | None = None,
-                       stream: bool = False):
+                       stream: bool = False,
+                       deadline: "float | None" = None):
         """Awaitable single-request generation; with stream=True returns
         an async iterator yielding token ids then the final
-        RequestOutput."""
+        RequestOutput. ``deadline`` (wall-clock seconds) makes the
+        decode loop evict this request once expired."""
         import asyncio
         import concurrent.futures
         import queue as _queue
@@ -1222,6 +1286,8 @@ class AsyncLLMEngine:
                 self.engine.add_request(rid, toks, sampling_params)
                 self._streams[rid] = q
                 self._seen[rid] = 0
+                if deadline is not None:
+                    self._deadlines[rid] = deadline
             self._wake.set()
 
             async def aiter():
@@ -1238,6 +1304,8 @@ class AsyncLLMEngine:
         with self._lock:
             self.engine.add_request(rid, toks, sampling_params)
             self._waiters[rid] = fut
+            if deadline is not None:
+                self._deadlines[rid] = deadline
         self._wake.set()
         return await asyncio.wrap_future(fut)
 

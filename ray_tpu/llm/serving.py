@@ -54,6 +54,21 @@ class LLMServer:
         # of serializing whole generate() calls.
         self.async_engine = AsyncLLMEngine(self.engine)
 
+    @staticmethod
+    def _deadline() -> "float | None":
+        """The serving deadline for the current request (replica stamps
+        it from the handle's timeout before user code runs). Carried
+        into the decode loop so expired requests are EVICTED mid-decode
+        instead of finishing tokens nobody will read."""
+        from ray_tpu.serve.scheduler import get_request_deadline
+
+        return get_request_deadline()
+
+    def serve_batch_stats(self) -> dict:
+        """Replica telemetry hook (Replica.get_metrics → ``engine``
+        block): the token-level continuous-batching view."""
+        return self.async_engine.snapshot()
+
     # -- OpenAI schema helpers --------------------------------------------
 
     def _sampling(self, payload: dict) -> SamplingParams:
@@ -236,7 +251,8 @@ class LLMServer:
                 "finish_reason": None}]}
         toks: list[int] = []
         emitted = 0  # chars of decoded text already sent
-        aiter = await self.async_engine.generate(prompt, sp, stream=True)
+        aiter = await self.async_engine.generate(
+            prompt, sp, stream=True, deadline=self._deadline())
         out = None
         async for item in aiter:
             if not isinstance(item, int):
@@ -313,7 +329,7 @@ class LLMServer:
                 "n/best_of > 1 requires temperature > 0 (greedy sampling "
                 "would return identical completions)")
         outs = await asyncio.gather(
-            *[self.async_engine.generate(p, spi)
+            *[self.async_engine.generate(p, spi, deadline=self._deadline())
               for p in prompts
               for spi in self._fan_out(sp, best_of, rank=best_of > n)])
         # Group the best_of samples per prompt; rank by CUMULATIVE
@@ -390,7 +406,8 @@ class LLMServer:
 
     async def chat(self, payload: dict) -> dict:
         prompt = self._render_chat(payload["messages"])
-        out = await self.async_engine.generate(prompt, self._sampling(payload))
+        out = await self.async_engine.generate(
+            prompt, self._sampling(payload), deadline=self._deadline())
         return {
             "id": f"chatcmpl-{uuid.uuid4().hex[:24]}",
             "object": "chat.completion",

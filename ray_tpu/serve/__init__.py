@@ -30,9 +30,17 @@ from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
 from ray_tpu.serve.batching import batch
 from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 from ray_tpu.serve.replica import ReplicaContext, get_replica_context
+from ray_tpu.serve.scheduler import (
+    ContinuousBatcher,
+    LatencyModel,
+    get_request_deadline,
+)
 
 __all__ = [
     "batch",
+    "ContinuousBatcher",
+    "LatencyModel",
+    "get_request_deadline",
     "get_multiplexed_model_id",
     "multiplexed",
     "Application",

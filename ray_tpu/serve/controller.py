@@ -6,7 +6,28 @@ Counterpart of the reference's ServeController actor
 deployment's replica set toward its target (scale up/down, replace dead
 replicas, autoscale from ongoing-request metrics). Handles/proxies read
 the versioned routing table (`get_replicas`) — the pull analogue of the
-reference's LongPollHost config pushdown (long_poll.py:204)."""
+reference's LongPollHost config pushdown (long_poll.py:204).
+
+Serving-plane additions:
+
+* metrics-driven autoscaling — the target follows ongoing requests AND
+  replica-reported batch queue depth, with ingress QPS tracked from
+  replica totals; upscale is HELD while the PR 5 overload plane reports
+  memory-pressured nodes (adding replicas to a cluster already shedding
+  by watermark makes the spiral worse);
+* scale-down DRAINS — a doomed replica stops admitting, finishes its
+  in-flight requests (``Replica.drain``), and only then is killed;
+* device-cache-aware placement (PR 8) — when a deployment's init args
+  carry ObjectRefs (model weights by reference), new replicas prefer
+  the node already holding the payload (soft node affinity), so scale-up
+  hits the zero-copy host arena instead of re-pulling weights;
+* ``ray_tpu_serve_*`` gauges pushed every reconcile tick (qps, queue
+  depth, batch size p50, shed total, replicas) for the Prometheus
+  exposition and the Grafana serving row;
+* a best-effort ``autoscaler.sdk.request_resources`` hint when the
+  replica target grows, so cluster autoscaling can add capacity ahead
+  of placement.
+"""
 
 from __future__ import annotations
 
@@ -22,6 +43,9 @@ from ray_tpu.serve.deployment import AutoscalingConfig, DeploymentConfig
 from ray_tpu.serve.handle import DeploymentHandle
 from ray_tpu.serve.replica import Replica
 
+# Bound on how long a scale-down drain may hold a doomed replica alive.
+DRAIN_TIMEOUT_S = 10.0
+
 
 class _HandleMarker:
     """Placeholder for a child deployment in init args (resolved to a
@@ -36,6 +60,7 @@ class _DeploymentState:
         self.spec = spec
         self.config: DeploymentConfig = spec["config"]
         self.replicas: dict[str, Any] = {}  # rid -> ActorHandle
+        self.draining: dict[str, tuple] = {}  # rid -> (actor, ref, deadline)
         self.version = 0
         self.last_metrics: dict[str, dict] = {}
         self.target = self.config.num_replicas
@@ -43,13 +68,21 @@ class _DeploymentState:
         if asc is not None:
             self.target = max(asc.min_replicas, min(self.config.num_replicas, asc.max_replicas))
         self._last_downscale = time.monotonic()
+        # Ingress QPS estimated from replica request totals.
+        self.qps = 0.0
+        self._prev_total = 0
+        self._prev_total_t = time.monotonic()
 
     def status(self) -> dict:
         return {
             "name": self.spec["name"],
             "target_replicas": self.target,
             "running_replicas": len(self.replicas),
+            "draining_replicas": len(self.draining),
             "version": self.version,
+            "qps": round(self.qps, 2),
+            "qdepth": sum(m.get("qdepth", 0)
+                          for m in self.last_metrics.values()),
         }
 
 
@@ -66,6 +99,9 @@ class ServeController:
         self._apps: dict[str, str] = {}
         self._lock = threading.RLock()
         self._stop = threading.Event()
+        self._mem_checked = 0.0
+        self._mem_pressured_cached = False
+        self._gauges = None
         self._thread = threading.Thread(
             target=self._reconcile_loop, daemon=True, name="serve-reconcile"
         )
@@ -97,6 +133,14 @@ class ServeController:
             return {
                 "version": st.version,
                 "replicas": [(rid, actor) for rid, actor in st.replicas.items()],
+                # Per-replica load view for the handle's routing score
+                # (queue depth the owner-side direct plane cannot see).
+                "telemetry": {
+                    rid: {"qdepth": m.get("qdepth", 0),
+                          "ongoing": m.get("ongoing", 0)}
+                    for rid, m in st.last_metrics.items()
+                    if rid in st.replicas
+                },
             }
 
     def get_routes(self) -> dict[str, dict]:
@@ -181,6 +225,8 @@ class ServeController:
         if st is not None:
             for actor in st.replicas.values():
                 self._kill(actor)
+            for actor, _ref, _dl in st.draining.values():
+                self._kill(actor)
 
     def shutdown_deployments(self) -> None:
         with self._lock:
@@ -210,6 +256,8 @@ class ServeController:
             self._probe_health(st)
             self._autoscale(st)
             self._scale_to_target(st)
+            self._reap_draining(st)
+        self._export_metrics(states)
 
     def _probe_health(self, st: _DeploymentState) -> None:
         dead = []
@@ -246,18 +294,60 @@ class ServeController:
                     if actor is not None:
                         self._kill(actor)
                 st.version += 1
+        # Ingress QPS from replica totals (cumulative, so replica death
+        # can dip the sum — clamp at zero and resync).
+        total = sum(m.get("total", 0) for m in st.last_metrics.values())
+        now = time.monotonic()
+        dt = now - st._prev_total_t
+        if dt >= 1.0:
+            st.qps = max(0.0, (total - st._prev_total) / dt)
+            st._prev_total, st._prev_total_t = total, now
+
+    def _mem_pressured(self) -> bool:
+        """PR 5 overload signal, cached ~1 s: any node over the soft
+        memory watermark. While pressured, upscale is held — more
+        replicas on a spilling cluster amplify the pressure."""
+        now = time.monotonic()
+        if now - self._mem_checked < 1.0:
+            return self._mem_pressured_cached
+        self._mem_checked = now
+        try:
+            from ray_tpu._private.worker_context import global_runtime
+
+            stats = global_runtime().conn.call("runtime_stats", {}, timeout=5)
+            gauges = stats.get("gauges") or {}
+            self._mem_pressured_cached = bool(
+                gauges.get("mem_pressured_nodes", 0))
+        except Exception:  # noqa: BLE001 — no signal = not pressured
+            self._mem_pressured_cached = False
+        return self._mem_pressured_cached
 
     def _autoscale(self, st: _DeploymentState) -> None:
         asc: AutoscalingConfig | None = st.config.autoscaling_config
         if asc is None:
             return
+        # Load = executing requests + replica-side batch queue depth:
+        # a replica admitting into a deep batch queue is loaded even
+        # while its ongoing count looks tame.
         ongoing = sum(m.get("ongoing", 0) for m in st.last_metrics.values())
-        desired = math.ceil(ongoing / max(asc.target_ongoing_requests, 1e-9))
+        qdepth = sum(m.get("qdepth", 0) for m in st.last_metrics.values())
+        desired = math.ceil(
+            (ongoing + qdepth) / max(asc.target_ongoing_requests, 1e-9))
         desired = max(asc.min_replicas, min(asc.max_replicas, desired))
         now = time.monotonic()
         if desired > st.target:
+            if self._mem_pressured():
+                return  # hold: scaling into memory pressure makes it worse
             st.target = desired  # upscale immediately
             st._last_downscale = now
+            try:
+                # Cluster-autoscaler hint: ask for capacity to fit the
+                # new target ahead of placement (best-effort).
+                from ray_tpu.autoscaler import sdk as autoscaler_sdk
+
+                autoscaler_sdk.request_resources(num_cpus=desired)
+            except Exception:  # noqa: BLE001
+                pass
         elif desired < st.target:
             if now - st._last_downscale >= asc.downscale_delay_s:
                 st.target = max(desired, st.target - 1)  # step down gently
@@ -278,8 +368,33 @@ class ServeController:
                 for rid in doomed:
                     actor = st.replicas.pop(rid)
                     st.last_metrics.pop(rid, None)
-                    self._kill(actor)
+                    # Drain before kill: the version bump re-routes new
+                    # traffic away; in-flight requests finish on the
+                    # doomed replica, which is reaped once drained (or
+                    # at the drain deadline).
+                    try:
+                        ref = actor.drain.remote(timeout_s=DRAIN_TIMEOUT_S)
+                    except RayTpuError:
+                        ref = None
+                    st.draining[rid] = (
+                        actor, ref, time.monotonic() + DRAIN_TIMEOUT_S + 2.0)
                 st.version += 1
+
+    def _reap_draining(self, st: _DeploymentState) -> None:
+        with self._lock:
+            items = list(st.draining.items())
+        for rid, (actor, ref, deadline) in items:
+            done = time.monotonic() > deadline
+            if not done and ref is not None:
+                try:
+                    ready, _ = ray_tpu.wait([ref], timeout=0)
+                    done = bool(ready)
+                except RayTpuError:
+                    done = True  # replica died mid-drain: just reap
+            if done:
+                with self._lock:
+                    st.draining.pop(rid, None)
+                self._kill(actor)
 
     def _start_replica(self, st: _DeploymentState) -> tuple[str, Any]:
         spec = st.spec
@@ -287,13 +402,100 @@ class ServeController:
         opts = dict(spec["config"].ray_actor_options)
         opts.setdefault("num_cpus", 0)
         opts["max_concurrency"] = max(2, spec["config"].max_ongoing_requests)
+        if "scheduling_strategy" not in opts:
+            node = self._weights_node(spec)
+            if node:
+                from ray_tpu.util.scheduling_strategies import (
+                    NodeAffinitySchedulingStrategy,
+                )
+
+                opts["scheduling_strategy"] = NodeAffinitySchedulingStrategy(
+                    node, soft=True)
         actor_cls = ray_tpu.remote(**opts)(Replica)
         init_args = tuple(self._resolve(a) for a in spec["init_args"])
         init_kwargs = {k: self._resolve(v) for k, v in spec["init_kwargs"].items()}
         actor = actor_cls.remote(
             spec["cls"], init_args, init_kwargs, spec["name"], rid,
-            max_ongoing_requests=spec["config"].max_ongoing_requests)
+            max_ongoing_requests=spec["config"].max_ongoing_requests,
+            max_queued_requests=getattr(
+                spec["config"], "max_queued_requests", None))
         return rid, actor
+
+    @staticmethod
+    def _weights_node(spec: dict) -> "str | None":
+        """Device-cache-aware placement (PR 8): when init args carry
+        ObjectRefs (model weights passed by reference), prefer the node
+        already holding the payload — its host arena / device cache
+        serves the weights zero-copy instead of re-pulling them."""
+        from ray_tpu._private.ids import ObjectRef
+
+        for a in (list(spec.get("init_args") or ())
+                  + list((spec.get("init_kwargs") or {}).values())):
+            if not isinstance(a, ObjectRef):
+                continue
+            try:
+                from ray_tpu.util import state as us
+
+                row = us.get_object(a.hex())
+            except Exception:  # noqa: BLE001 — placement hint only
+                return None
+            if not row:
+                continue
+            node = row.get("node_id") or row.get("location")
+            if not node:
+                reps = row.get("replicas") or []
+                node = reps[0] if reps else None
+            if node:
+                return node
+        return None
+
+    # -- metrics exposition ------------------------------------------------
+
+    def _export_metrics(self, states: list) -> None:
+        """Push the serving-plane gauges (Prometheus `ray_tpu_serve_*`,
+        Grafana serving row) once per reconcile tick — cheap sets, the
+        metric layer amortizes the actual head casts."""
+        try:
+            g = self._gauges
+            if g is None:
+                from ray_tpu.util.metrics import Gauge
+
+                g = self._gauges = {
+                    "qps": Gauge("ray_tpu_serve_qps",
+                                 "Ingress requests/s per deployment",
+                                 tag_keys=("deployment",)),
+                    "qdepth": Gauge("ray_tpu_serve_queue_depth",
+                                    "Replica batch-queue depth",
+                                    tag_keys=("deployment",)),
+                    "batch_p50": Gauge("ray_tpu_serve_batch_size_p50",
+                                       "Median assembled batch size",
+                                       tag_keys=("deployment",)),
+                    "shed": Gauge("ray_tpu_serve_shed_total",
+                                  "Requests shed (deadline/queue-full)",
+                                  tag_keys=("deployment",)),
+                    "replicas": Gauge("ray_tpu_serve_replicas",
+                                      "Running replicas",
+                                      tag_keys=("deployment",)),
+                    "ongoing": Gauge("ray_tpu_serve_ongoing",
+                                     "Executing requests",
+                                     tag_keys=("deployment",)),
+                }
+            for st in states:
+                tags = {"deployment": st.spec["name"]}
+                metrics = list(st.last_metrics.values())
+                g["qps"].set(st.qps, tags)
+                g["qdepth"].set(
+                    sum(m.get("qdepth", 0) for m in metrics), tags)
+                sizes = [m.get("batch_size_p50", 0.0) for m in metrics
+                         if m.get("batch_size_p50")]
+                g["batch_p50"].set(max(sizes) if sizes else 0.0, tags)
+                g["shed"].set(
+                    sum(m.get("shed_total", 0) for m in metrics), tags)
+                g["replicas"].set(len(st.replicas), tags)
+                g["ongoing"].set(
+                    sum(m.get("ongoing", 0) for m in metrics), tags)
+        except Exception:  # noqa: BLE001 — telemetry must not stall serving
+            pass
 
     @staticmethod
     def _resolve(arg):

@@ -27,6 +27,10 @@ class AutoscalingConfig:
 class DeploymentConfig:
     num_replicas: int = 1
     max_ongoing_requests: int = 16
+    # Bounded replica-side admission: past max_ongoing + max_queued the
+    # replica sheds with PendingCallsLimitError (HTTP 503). None =
+    # unbounded queueing (legacy behavior).
+    max_queued_requests: Optional[int] = None
     autoscaling_config: Optional[AutoscalingConfig] = None
     ray_actor_options: dict = dataclasses.field(default_factory=dict)
     health_check_period_s: float = 2.0
@@ -45,6 +49,7 @@ class Deployment:
 
     def options(self, *, num_replicas: int | None = None, name: str | None = None,
                 max_ongoing_requests: int | None = None,
+                max_queued_requests: int | None = None,
                 autoscaling_config: AutoscalingConfig | dict | None = None,
                 ray_actor_options: dict | None = None,
                 route_prefix: str | None = None) -> "Deployment":
@@ -53,6 +58,8 @@ class Deployment:
             cfg.num_replicas = num_replicas
         if max_ongoing_requests is not None:
             cfg.max_ongoing_requests = max_ongoing_requests
+        if max_queued_requests is not None:
+            cfg.max_queued_requests = max_queued_requests
         if autoscaling_config is not None:
             if isinstance(autoscaling_config, dict):
                 autoscaling_config = AutoscalingConfig(**autoscaling_config)
@@ -101,6 +108,7 @@ class Application:
 
 def deployment(cls: type | None = None, *, name: str | None = None,
                num_replicas: int = 1, max_ongoing_requests: int = 16,
+               max_queued_requests: int | None = None,
                autoscaling_config: AutoscalingConfig | dict | None = None,
                ray_actor_options: dict | None = None,
                route_prefix: str | None = None) -> Any:
@@ -114,6 +122,7 @@ def deployment(cls: type | None = None, *, name: str | None = None,
         cfg = DeploymentConfig(
             num_replicas=num_replicas,
             max_ongoing_requests=max_ongoing_requests,
+            max_queued_requests=max_queued_requests,
             autoscaling_config=asc,
             ray_actor_options=ray_actor_options or {},
         )

@@ -71,10 +71,20 @@ class GrpcIngress:
                     grpc.StatusCode.NOT_FOUND,
                     f"no deployment {deployment!r}; known: {sorted(self._routes.values())}",
                 )
+            # Per-request deadline rides the gRPC deadline when the
+            # client set one (context.time_remaining), default 60 s —
+            # stamped onto the TaskSpec like the HTTP ingress does.
+            remaining = None
             try:
-                result = handle.remote(payload).result(timeout_s=60.0)
+                remaining = context.time_remaining()
+            except Exception:  # noqa: BLE001
+                pass
+            timeout_s = min(remaining, 600.0) if remaining else 60.0
+            try:
+                result = handle.options(timeout_s=timeout_s).remote(
+                    payload).result(timeout_s=timeout_s + 5.0)
             except Exception as e:  # noqa: BLE001
-                context.abort(grpc.StatusCode.INTERNAL, str(e))
+                context.abort(*self._classify(grpc, e))
             try:
                 if encoding == "pickle":
                     from ray_tpu._private.serialization import dumps_scoped
@@ -99,6 +109,27 @@ class GrpcIngress:
         self._server.add_generic_rpc_handlers((handler,))
         self._port = self._server.add_insecure_port(f"{host}:{port}")
         self._server.start()
+
+    @staticmethod
+    def _classify(grpc, e: Exception):
+        """Typed overload mapping, mirroring HTTPProxy._error_response:
+        admission sheds → RESOURCE_EXHAUSTED, deadline sheds →
+        DEADLINE_EXCEEDED, everything else INTERNAL. Replica-raised
+        errors arrive as TaskError (sealed repr), hence the string
+        match beside the isinstance checks."""
+        from ray_tpu.exceptions import (
+            PendingCallsLimitError,
+            TaskTimeoutError,
+        )
+
+        msg = str(e)
+        if isinstance(e, PendingCallsLimitError) \
+                or "PendingCallsLimitError" in msg:
+            return grpc.StatusCode.RESOURCE_EXHAUSTED, msg
+        if isinstance(e, (TaskTimeoutError, TimeoutError)) \
+                or "TaskTimeoutError" in msg:
+            return grpc.StatusCode.DEADLINE_EXCEEDED, msg
+        return grpc.StatusCode.INTERNAL, msg
 
     def _resolve(self, deployment: str | None) -> DeploymentHandle | None:
         if deployment is None:

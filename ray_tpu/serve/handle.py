@@ -3,9 +3,26 @@
 Counterpart of the reference's DeploymentHandle (serve/handle.py:625) and
 the power-of-two-choices replica scheduler
 (serve/_private/replica_scheduler/pow_2_scheduler.py): pick two random
-replicas, route to the one with fewer requests this handle has in flight.
-Replica-set changes propagate by version polling against the controller —
-the long-poll (long_poll.py:204) analogue with a pull cadence."""
+replicas, route to the one with the lower load score. Replica-set changes
+propagate by version polling against the controller — the long-poll
+(long_poll.py:204) analogue with a pull cadence.
+
+Load-aware routing (serving plane): the score is NOT just this handle's
+submitted count. It folds in
+
+* the direct plane's owner-side view (``DirectPlane.route_load``):
+  calls pushed but not yet delivery-ACKED weigh heavily — a dead or
+  restarting replica stops acking within one RTT, so power-of-two
+  deprioritizes it immediately instead of letting it absorb half the
+  flood until the next controller refresh; owner-queued calls behind
+  the direct window count too;
+* replica-reported queue depth from the controller's telemetry table
+  (batch queues the owner cannot see).
+
+``options(timeout_s=...)`` stamps a per-request deadline onto the
+TaskSpec (PR 5): expired requests are shed at every hop — owner queue,
+worker pickup, replica pickup, batch assembly — with a typed
+``TaskTimeoutError`` instead of queueing unboundedly."""
 
 from __future__ import annotations
 
@@ -16,6 +33,12 @@ from typing import Any
 
 import ray_tpu
 from ray_tpu.exceptions import ActorError, RayTpuError
+
+# Weight of an unacked pushed call in the routing score: one unacked
+# call outweighs several submitted-and-acked ones, so a replica that
+# stopped acking (dead, wedged, restarting) loses power-of-two contests
+# right away.
+_UNACKED_WEIGHT = 8
 
 
 class DeploymentResponse:
@@ -55,6 +78,16 @@ class DeploymentResponse:
     def ref(self):
         """The underlying ObjectRef (composition: pass to other calls)."""
         return self._ref
+
+    def cancel(self) -> None:
+        """Best-effort cancel of the in-flight replica call (direct-plane
+        cancel first, head fallback). The proxy maps client disconnects
+        here so abandoned requests stop burning replica capacity."""
+        self._finish()
+        try:
+            ray_tpu.cancel(self._ref)
+        except Exception:  # noqa: BLE001 — cancel is advisory
+            pass
 
     async def _result_async(self, timeout_s: float | None = None) -> Any:
         """Truly async result: awaits the head-pushed object resolution
@@ -183,9 +216,12 @@ class DeploymentHandle:
         self._version = -1
         self._last_refresh = 0.0
         self._inflight: dict[str, int] = {}
+        self._reported: dict[str, int] = {}  # rid -> controller-reported qdepth
         self._lock = threading.Lock()
         self._stream = False
         self._model_id = ""  # multiplexing (serve/multiplex.py)
+        self._timeout_s: "float | None" = None
+        self._max_retries = 2
 
     # -- controller discovery (lazy: handles are cheap to pickle) ----------
 
@@ -208,11 +244,34 @@ class DeploymentHandle:
             self._inflight = {
                 rid: self._inflight.get(rid, 0) for rid, _ in self._replicas
             }
+            self._reported = {
+                rid: int(t.get("qdepth", 0))
+                for rid, t in (info.get("telemetry") or {}).items()
+            }
 
     # -- routing -----------------------------------------------------------
 
+    def _load(self, rid: str, actor) -> int:
+        """Routing score for one replica: this handle's submitted count
+        + controller-reported batch queue depth + the direct plane's
+        owner-side view, with UNACKED pushes weighted heavily (acked
+        inflight is the real signal — a dead replica's submitted count
+        would otherwise drain to zero on error callbacks and make it
+        look idle)."""
+        load = self._inflight.get(rid, 0) + self._reported.get(rid, 0)
+        try:
+            from ray_tpu._private.worker_context import global_runtime
+
+            plane = getattr(global_runtime(), "_direct", None)
+            if plane is not None:
+                rl = plane.route_load(actor._actor_id)
+                load += rl["queued"] + _UNACKED_WEIGHT * rl["unacked"]
+        except Exception:  # noqa: BLE001 — scoring must never fail a route
+            pass
+        return load
+
     def _pick(self):
-        """Power-of-two-choices over this handle's in-flight counts; a
+        """Power-of-two-choices over per-replica load scores; a
         multiplexed model id instead routes by rendezvous hashing so the
         model's replica-local cache keeps hitting (serve/multiplex.py)."""
         with self._lock:
@@ -228,22 +287,28 @@ class DeploymentHandle:
         if len(reps) == 1:
             return reps[0]
         a, b = random.sample(reps, 2)
-        return a if self._inflight.get(a[0], 0) <= self._inflight.get(b[0], 0) else b
+        return a if self._load(a[0], a[1]) <= self._load(b[0], b[1]) else b
 
     def options(self, *, method_name: str | None = None,
                 stream: bool | None = None,
-                multiplexed_model_id: str | None = None) -> "DeploymentHandle":
+                multiplexed_model_id: str | None = None,
+                timeout_s: float | None = None,
+                max_retries: int | None = None) -> "DeploymentHandle":
         h = DeploymentHandle(self.deployment_name, self._controller,
                              method_name or self._method)
         h._stream = self._stream if stream is None else stream
         h._model_id = (self._model_id if multiplexed_model_id is None
                        else multiplexed_model_id)
+        h._timeout_s = self._timeout_s if timeout_s is None else timeout_s
+        h._max_retries = (self._max_retries if max_retries is None
+                          else max(0, int(max_retries)))
         # Share router state with the parent: the replica cache stays warm
         # (no per-call controller RPC) and power-of-two choices sees ALL
         # in-flight requests, not just this method-view's.
         h._replicas, h._version = self._replicas, self._version
         h._last_refresh = self._last_refresh
         h._inflight = self._inflight
+        h._reported = self._reported
         h._lock = self._lock
         return h
 
@@ -257,13 +322,21 @@ class DeploymentHandle:
         self.__dict__[name] = child
         return child
 
-    def remote(self, *args, _retries_left: int = 2, **kwargs) -> DeploymentResponse:
+    def remote(self, *args, _retries_left: "int | None" = None,
+               **kwargs) -> DeploymentResponse:
         self._refresh()
+        if _retries_left is None:
+            _retries_left = self._max_retries
         # Unwrap response objects for composition: pass the underlying ref
         # so the downstream task consumes the upstream output directly.
         args = tuple(a.ref if isinstance(a, DeploymentResponse) else a for a in args)
         kwargs = {k: (v.ref if isinstance(v, DeploymentResponse) else v)
                   for k, v in kwargs.items()}
+        # timeout_s → TaskSpec deadline: the wall-clock deadline rides
+        # BOTH the spec (owner/head/worker hops shed expired work) and
+        # the replica call payload (replica pickup + batch assembly).
+        deadline = (time.time() + self._timeout_s
+                    if self._timeout_s else None)
 
         def retry() -> "DeploymentResponse | None":
             if _retries_left <= 0:
@@ -291,13 +364,21 @@ class DeploymentHandle:
                 if self._stream:
                     # Streaming: the replica's generator method returns an
                     # ObjectRefGenerator; items surface as produced.
-                    gen = actor.handle_request_streaming.remote(
-                        self._method, args, kwargs, self._model_id
+                    m = actor.handle_request_streaming
+                    if self._timeout_s:
+                        m = m.options(timeout_s=self._timeout_s)
+                    gen = m.remote(
+                        self._method, args, kwargs, self._model_id, deadline
                     )
                     return DeploymentResponseGenerator(gen, on_done=done)
-                ref = actor.handle_request.remote(
-                    self._method, args, kwargs, self._model_id)
-                return DeploymentResponse(ref, on_done=done, retry=retry)
+                m = actor.handle_request
+                if self._timeout_s:
+                    m = m.options(timeout_s=self._timeout_s)
+                ref = m.remote(
+                    self._method, args, kwargs, self._model_id, deadline)
+                return DeploymentResponse(
+                    ref, on_done=done,
+                    retry=retry if _retries_left > 0 else None)
             except ActorError as e:
                 done()
                 last_err = e

@@ -4,7 +4,21 @@ Counterpart of the reference's HTTPProxy/ProxyActor (serve/_private/proxy.py
 :754,:1131 — uvicorn/ASGI). Here: an aiohttp server on its own event-loop
 thread inside a proxy actor. Routes come from the controller's route table
 (route_prefix → deployment); requests are routed through a DeploymentHandle
-(power-of-two choices) and awaited without blocking the loop."""
+(power-of-two choices) and awaited without blocking the loop.
+
+Serving-plane duties at the ingress hop:
+
+* deadline stamping — ``X-Request-Timeout-S`` (default 30 s) becomes the
+  request deadline the handle stamps onto the TaskSpec, so expired work
+  sheds at every hop (owner queue, head, worker pickup, batch queue);
+* typed overload mapping — ``PendingCallsLimitError`` (admission shed)
+  → HTTP 503 with Retry-After, ``TaskTimeoutError`` (deadline shed) →
+  HTTP 408; everything else stays 500;
+* client-disconnect propagation — when the HTTP client goes away the
+  awaiting coroutine is cancelled and the proxy forwards the cancel to
+  the in-flight replica call (``ray_tpu.cancel``), so abandoned work
+  stops burning replica capacity (reference: serve/_private/proxy.py
+  disconnect handling)."""
 
 from __future__ import annotations
 
@@ -126,6 +140,16 @@ class HTTPProxy:
                 return await self._stream_sse(
                     web, request, handle_, payload,
                     method=meta.get("sse_method"))
+            # Per-request deadline: the handle stamps it onto the
+            # TaskSpec so expired requests shed at every hop instead of
+            # completing into the void.
+            try:
+                timeout_s = float(
+                    request.headers.get("X-Request-Timeout-S", 30.0))
+            except (TypeError, ValueError):
+                timeout_s = 30.0
+            timeout_s = max(0.001, min(timeout_s, 600.0))
+            resp_obj = None
             try:
                 # Submit via a SHORT executor hop (routing can hit a
                 # blocking controller refresh ~1/s), then await the
@@ -143,20 +167,38 @@ class HTTPProxy:
                     sub = sub or "/"
                     resp_obj = await loop.run_in_executor(
                         None, lambda: handle_.options(
-                            method_name=meta["path_method"]).remote(
-                                sub, payload))
+                            method_name=meta["path_method"],
+                            timeout_s=timeout_s).remote(sub, payload))
                 else:
                     resp_obj = await loop.run_in_executor(
-                        None, lambda: handle_.remote(payload))
-                result = await resp_obj._result_async(timeout_s=30.0)
+                        None, lambda: handle_.options(
+                            timeout_s=timeout_s).remote(payload))
+                result = await resp_obj._result_async(
+                    timeout_s=timeout_s + 5.0)
+            except asyncio.CancelledError:
+                # Client disconnected while we awaited the replica:
+                # propagate the cancel so the in-flight call stops
+                # burning replica capacity, then let aiohttp tear the
+                # transport down.
+                if resp_obj is not None:
+                    loop = asyncio.get_running_loop()
+                    loop.run_in_executor(None, resp_obj.cancel)
+                raise
             except Exception as e:  # noqa: BLE001 — surface to the client
-                return web.json_response({"error": str(e)}, status=500)
+                return self._error_response(web, e)
             return self._encode(web, result)
 
         async def run():
             app = web.Application()
             app.router.add_route("*", "/{tail:.*}", handle)
-            runner = web.AppRunner(app)
+            # handler_cancellation: aiohttp >= 3.9 stopped cancelling
+            # handlers on client disconnect by default — the serving
+            # plane WANTS the cancel (it propagates to the in-flight
+            # replica call so abandoned work is dropped).
+            try:
+                runner = web.AppRunner(app, handler_cancellation=True)
+            except TypeError:  # older aiohttp: cancellation is the default
+                runner = web.AppRunner(app)
             await runner.setup()
             site = web.SockSite(runner, self._sock)
             await site.start()
@@ -286,6 +328,31 @@ class HTTPProxy:
         if best is None:
             return None
         return {**best, "_prefix": best_prefix}
+
+    @staticmethod
+    def _error_response(web, e: Exception):
+        """Typed overload mapping. Replica-raised errors cross the wire
+        as TaskError (the worker seals repr(exc)), so classification
+        string-matches the type name in the message alongside the
+        isinstance checks for locally-raised instances."""
+        from ray_tpu.exceptions import (
+            PendingCallsLimitError,
+            TaskTimeoutError,
+        )
+
+        msg = str(e)
+        if isinstance(e, PendingCallsLimitError) \
+                or "PendingCallsLimitError" in msg:
+            return web.json_response(
+                {"error": msg, "type": "PendingCallsLimitError",
+                 "retry_after_s": 0.5},
+                status=503, headers={"Retry-After": "1"})
+        if isinstance(e, (TaskTimeoutError, TimeoutError, asyncio.TimeoutError)) \
+                or "TaskTimeoutError" in msg:
+            return web.json_response(
+                {"error": msg, "type": "TaskTimeoutError"}, status=408)
+        return web.json_response(
+            {"error": msg, "type": type(e).__name__}, status=500)
 
     @staticmethod
     def _encode(web, result: Any):

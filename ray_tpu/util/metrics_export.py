@@ -157,6 +157,32 @@ def grafana_dashboard(extra_metrics: "list[str] | None" = None) -> dict:
         "ops", 12, y))
     next_id += 1
     y += 8
+    # Serving-plane row (PR 10): ingress QPS + replica count, batch
+    # queue depth, continuous-batching batch size, and overload sheds.
+    panels.append(_panel(
+        next_id, "Serve ingress QPS by deployment",
+        "sum by (deployment) (ray_tpu_serve_qps)", "ops", 0, y))
+    next_id += 1
+    panels.append(_panel(
+        next_id, "Serve replicas / ongoing by deployment",
+        [("sum by (deployment) (ray_tpu_serve_replicas)", "replicas"),
+         ("sum by (deployment) (ray_tpu_serve_ongoing)", "ongoing")],
+        "short", 12, y))
+    next_id += 1
+    y += 8
+    panels.append(_panel(
+        next_id, "Serve batch queue depth / batch size p50",
+        [("sum by (deployment) (ray_tpu_serve_queue_depth)", "queue depth"),
+         ("max by (deployment) (ray_tpu_serve_batch_size_p50)",
+          "batch size p50")],
+        "short", 0, y))
+    next_id += 1
+    panels.append(_panel(
+        next_id, "Serve requests shed / 5m (deadline + queue-full)",
+        "sum by (deployment) (increase(ray_tpu_serve_shed_total[5m]))",
+        "short", 12, y))
+    next_id += 1
+    y += 8
     for i, name in enumerate(extra_metrics or []):
         panels.append(_panel(next_id, name, name, "short",
                              (i % 2) * 12, y + (i // 2) * 8))

@@ -96,6 +96,27 @@ def wait_alive_nodes_at_most(n: int, timeout: float = 30.0) -> None:
         f"node never declared dead: {[x for x in ray_tpu.nodes() if x['alive']]}")
 
 
+def kill_actor_worker(actor_id: str, deadline_s: float = 20.0,
+                      sleep_s: float = 0.1) -> bool:
+    """SIGKILL the worker process hosting ``actor_id`` (serve chaos:
+    replica death mid-request). Returns True if a process was killed."""
+    from ray_tpu.util import state as us
+
+    my_pid = os.getpid()
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        for w in us.list_workers():
+            if w.get("actor_id") == actor_id and w.get("pid") not in (None,
+                                                                      my_pid):
+                try:
+                    os.kill(w["pid"], signal.SIGKILL)
+                    return True
+                except ProcessLookupError:
+                    return False
+        time.sleep(sleep_s)
+    return False
+
+
 def kill_busy_workers(count: int, deadline_s: float = 20.0,
                       sleep_s: float = 0.2) -> int:
     """SIGKILL up to ``count`` busy non-actor workers (never ourselves).

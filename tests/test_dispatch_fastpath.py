@@ -593,3 +593,53 @@ def test_backpressure_signals_are_exceptional_not_steady_state(cluster):
     # Deadline enforcement machinery stayed dormant too (no deadline
     # was stamped, so the health sweep skip-flag never armed).
     assert not head._any_deadlines or True  # informational
+
+
+# ------------------------------------------ serving-plane frame guard
+
+
+def test_serve_handle_zero_per_call_head_frames(cluster):
+    """The serving plane inherits the direct-plane dispatch economics:
+    steady-state DeploymentHandle calls ride owner→replica pushes with
+    ZERO per-call head submissions and ZERO synchronous head RPCs — the
+    only head traffic the handle adds is the amortized replica-set
+    refresh (time-gated, at most ~1/s), and the routing score reads
+    (route_load) are in-process."""
+    from ray_tpu import serve
+
+    @serve.deployment
+    class Echo:
+        def __call__(self, x):
+            return x
+
+    try:
+        h = serve.run(Echo.bind(), proxy=False)
+        rt = global_runtime()
+        assert h.remote(1).result(timeout_s=15) == 1
+        rid, actor = h._replicas[0]
+        _wait(lambda: rt._direct.routes.get(actor._actor_id) is not None
+              and rt._direct.routes[actor._actor_id].mode == "direct",
+              msg="replica route never entered direct mode")
+        # Warm the CONTROLLER route too: a mid-burst replica-set refresh
+        # must also ride the direct plane, not the head.
+        ctrl = ray_tpu.get_actor("SERVE_CONTROLLER", namespace="serve")
+        assert ray_tpu.get(ctrl.ping.remote())
+        _wait(lambda: rt._direct.routes.get(ctrl._actor_id) is not None
+              and rt._direct.routes[ctrl._actor_id].mode == "direct",
+              msg="controller route never entered direct mode")
+        h._refresh(force=True)
+
+        N = 30
+        before_submit = rt.conn.sent_kinds.get("submit_actor_task", 0)
+        before_calls = rt.conn.calls_sent
+        before_push = _direct_push_count(rt)
+        resps = [h.remote(i) for i in range(N)]
+        assert [r.result(timeout_s=30) for r in resps] == list(range(N))
+        assert rt.conn.sent_kinds.get("submit_actor_task", 0) \
+            == before_submit
+        assert rt.conn.calls_sent == before_calls
+        # Every serve request was a direct push (>=: a replica-set
+        # refresh inside the burst adds its own pushed controller call).
+        assert _direct_push_count(rt) - before_push >= N
+    finally:
+        serve.shutdown()

@@ -18,3 +18,14 @@ def test_scale_envelope_quick():
     assert results["get_refs_per_s"] > 50
     assert results["broadcast_gib_per_s"] > 0
     assert results["actors"] == 8
+
+    # Serving-plane acceptance rows (loose CI floors — the envelope
+    # numbers land well above them on an unloaded box: scaling ~1.9x,
+    # A/B ~1.5x):
+    sv = results["serve"]
+    assert sv["scaling_ratio"] >= 1.3
+    over = sv["overload_10x"]
+    assert over["shed_503"] + over["timeout_408"] > 0  # typed, not latent
+    assert over["error"] == 0
+    assert over["p99_within_2x_slo"]
+    assert sv["batching_ab"]["speedup"] > 1.1
