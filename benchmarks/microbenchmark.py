@@ -131,6 +131,7 @@ def main(as_json: bool = False) -> dict:
     bench_admission_overhead(results)
     bench_deadline_overhead(results)
     bench_census_overhead(results)
+    bench_trace_overhead(results)
     if as_json:
         print(json.dumps({"microbenchmark": results}))
     return results
@@ -416,6 +417,50 @@ def bench_forensics_overhead(results: dict) -> None:
         ray_tpu.shutdown()
     os.environ.pop("RAY_TPU_CRASH_FORENSICS_ENABLED", None)
     config_mod.GLOBAL_CONFIG.crash_forensics_enabled = True
+
+
+def bench_trace_overhead(results: dict) -> None:
+    """Request-tracing overhead: pipelined direct actor calls with a
+    sampled trace context ambient on every call (sample rate 1.0 — the
+    worst case: every spec carries the trailing trace field and every
+    task emits a span on its existing task_finished cast) vs the trace
+    plane disabled (RAY_TPU_TRACE_ENABLED=0 — specs byte-identical to
+    the pre-tracing wire format). Spans ride amortized casts, so the
+    on/off delta must be within run noise (±5%) — the CI guard for
+    "tracing is steady-state free"."""
+    import os
+
+    from ray_tpu._private import config as config_mod
+    from ray_tpu._private import traceplane, worker_context
+
+    for mode in ("on", "off"):
+        os.environ["RAY_TPU_TRACE_ENABLED"] = "1" if mode == "on" else "0"
+        config_mod.GLOBAL_CONFIG.trace_enabled = (mode == "on")
+        config_mod.GLOBAL_CONFIG.trace_sample_rate = 1.0
+        ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024 * 1024,
+                     log_to_driver=False)
+
+        @ray_tpu.remote
+        class TrEcho:
+            def ping(self, x=None):
+                return x
+
+        actor = TrEcho.remote()
+        ray_tpu.get([actor.ping.remote() for _ in range(64)])  # warm
+        ctx = traceplane.mint_trace("bench-trace") if mode == "on" else None
+        tok = worker_context.push_trace_context(ctx) if ctx else None
+        try:
+            timeit(f"actor pipeline depth 32 tracing {mode}",
+                   lambda: ray_tpu.get(
+                       [actor.ping.remote() for _ in range(32)]),
+                   32, results=results)
+        finally:
+            if tok is not None:
+                worker_context.pop_trace_context(tok)
+        ray_tpu.kill(actor)
+        ray_tpu.shutdown()
+    os.environ.pop("RAY_TPU_TRACE_ENABLED", None)
+    config_mod.GLOBAL_CONFIG.trace_enabled = True
 
 
 if __name__ == "__main__":

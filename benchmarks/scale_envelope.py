@@ -390,7 +390,39 @@ def _run_sections(p: dict, results: dict) -> dict:
             except Exception:
                 pass
 
-    # 6. Serving plane: saturation at ~10x overload (successful p99
+    # 6. Request-tracing plane: a traced-task flood (fresh trace id per
+    #    wave, every spec carrying the trailing trace field) pressures
+    #    the head's bounded trace table; SCALE.json records throughput
+    #    under full sampling plus what tail-based retention holds
+    #    afterwards (retained/exemplar/folded/dropped counters).
+    from ray_tpu._private import traceplane, worker_context
+    from ray_tpu._private.worker_context import global_runtime
+
+    waves, per = 40, 20
+    t0 = time.time()
+    for w in range(waves):
+        ctx = traceplane.mint_trace(f"scale-trace-{w}")
+        tok = worker_context.push_trace_context(ctx)
+        try:
+            ray_tpu.get([nop.remote(i) for i in range(per)])
+        finally:
+            worker_context.pop_trace_context(tok)
+    dt = time.time() - t0
+    global_runtime().report_rpc_now()  # flush any buffered user spans
+    snap = global_runtime().conn.call("runtime_stats", {}, timeout=30)
+    tr = snap.get("tracing") or {}
+    results["tracing"] = {
+        "traced_tasks": waves * per,
+        "traces_minted": waves,
+        "traced_tasks_per_s": round(waves * per / dt, 1),
+        "retained": tr.get("retained"),
+        "exemplars": tr.get("exemplars"),
+        "uniform_kept": tr.get("uniform_kept"),
+        "folded": (tr.get("folded") or {}).get("count"),
+        "spans_dropped_owner_side": tr.get("spans_dropped_owner_side"),
+    }
+
+    # 7. Serving plane: saturation at ~10x overload (successful p99
     #    stays bounded by the deadline plane while the excess sheds
     #    with TYPED errors), replica scaling 1 -> 2, and the
     #    continuous-vs-fixed batching A/B.

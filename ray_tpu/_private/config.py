@@ -264,6 +264,32 @@ class Config:
     # many heartbeats feeds the head's per-node clock-offset table used
     # to align cross-node trace spans.
     clock_sync_every_n_heartbeats: int = 5
+    # Request-scoped distributed tracing (_private/traceplane.py):
+    # a trace context minted at the serve proxy (or tracing.span)
+    # rides TaskSpecs as an optional trailing compiled-encoding field
+    # and is inherited by nested .remote() calls; span records ride the
+    # existing task_finished/rpc_report casts into a bounded head-side
+    # table of causal trace trees. RAY_TPU_TRACE_ENABLED=0 is the kill
+    # switch: nothing is minted/stamped and every frame is byte-
+    # identical to the pre-tracing wire format.
+    trace_enabled: bool = True
+    # Fraction of proxy-minted traces that record spans (the sampled
+    # bit; unsampled requests still propagate ids for log correlation).
+    trace_sample_rate: float = 1.0
+    # Head-side trace table bound: past it, non-exemplar traces fold
+    # into counts (tail-based retention keeps slow/error/shed
+    # exemplars and a uniform 1-in-N sample in full detail).
+    trace_table_max: int = 512
+    trace_max_spans: int = 256  # spans retained per trace
+    # A trace whose root span exceeds this duration is a slow exemplar.
+    trace_slow_threshold_s: float = 0.5
+    # Uniform tail sample: every Nth non-exemplar trace survives
+    # folding (<= 0 keeps exemplars only).
+    trace_uniform_keep_nth: int = 16
+    # Owner-side user-span buffer (util.tracing spans flush on the
+    # amortized rpc_report cast, never per-span): spans past the bound
+    # are counted as dropped, not sent.
+    trace_span_buffer_max: int = 2048
     # Object-plane observability (_private/objcensus.py): each owner
     # runtime tracks its live ObjectRefs with the creating callsite
     # (interned — the hot path pays one dict lookup), size, and kind;

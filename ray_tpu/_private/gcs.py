@@ -361,6 +361,14 @@ class Head:
         from ray_tpu._private.events import EventTable
 
         self.task_events = EventTable(config.task_events_max_buffer)
+        # Request-tracing table (traceplane.py): causal trace trees
+        # assembled from lifecycle events / span records that arrive on
+        # the SAME task_finished / task_events / rpc_report messages the
+        # flight recorder already rides — tail-based retention keeps
+        # slow/error/shed exemplars and a uniform sample in full detail.
+        from ray_tpu._private.traceplane import TraceTable
+
+        self.traces = TraceTable(config)
         # Crash forensics plane (reference: the GCS worker-death table
         # with WorkerExitType + exit_detail): bounded table of
         # classified crash reports keyed by worker_id (node deaths under
@@ -1068,6 +1076,11 @@ class Head:
                     self._census_intake(cid, body["census"])
         if body.get("chaos_events"):
             self.task_events.extend(body["chaos_events"])
+        if body.get("spans"):
+            self.task_events.extend(body["spans"])
+            self.traces.intake(body["spans"])
+        if body.get("spans_dropped"):
+            self.traces.note_dropped(body["spans_dropped"])
         return None
 
     def _census_intake(self, cid: str, census: dict) -> None:
@@ -2859,6 +2872,7 @@ class Head:
                     ev["owner_node_id"] = self._client_node(
                         ev.get("owner_id"))
             self.task_events.extend(body["events"])
+            self.traces.intake(body["events"])
         rec = self.workers.get(worker_id)
         if rec is None:
             # Worker record already reaped (death raced the final
@@ -3941,19 +3955,49 @@ class Head:
 
     def _h_log_index(self, body, conn):
         """Per-worker log file index (reference: `ray logs` listing via
-        the dashboard log module — dashboard/modules/log)."""
+        the dashboard log module — dashboard/modules/log). With a
+        node_id the request forwards over the agent's own connection
+        (rpc conns are bidirectional), so every node's logs are
+        listable from the driver."""
+        fwd = self._forward_to_agent("log_index", body)
+        if fwd is not None:
+            return fwd
         from ray_tpu._private import log_utils
 
         return {"logs": log_utils.log_index(
             os.path.join(self.session_dir, "logs"))}
 
     def _h_log_tail(self, body, conn):
-        """Tail one worker log (reference: `ray logs <file>`)."""
+        """Tail one worker log (reference: `ray logs <file>`), locally
+        or on a remote node via its agent (body["node_id"])."""
+        fwd = self._forward_to_agent("log_tail", body)
+        if fwd is not None:
+            return fwd
         from ray_tpu._private import log_utils
 
         return log_utils.log_tail(
             os.path.join(self.session_dir, "logs"), body["name"],
             int(body.get("max_bytes", 64 * 1024)))
+
+    def _forward_to_agent(self, kind: str, body: dict) -> "dict | None":
+        """Route a log request to the named node's agent; None means
+        'serve locally' (no node_id given). Blocking call on the
+        requesting client's reader thread — acceptable for CLI log
+        requests, which are rare and small."""
+        node_id = body.get("node_id")
+        if not node_id:
+            return None
+        with self.lock:
+            agent = self.node_agents.get(node_id)
+        empty = ({"logs": []} if kind == "log_index"
+                 else {"name": body.get("name", ""), "lines": []})
+        if agent is None:
+            return {"error": f"no agent for node {node_id!r}", **empty}
+        try:
+            return agent.call(kind, {k: v for k, v in body.items()
+                                     if k != "node_id"}, timeout=10.0) or empty
+        except Exception as e:  # ConnectionLost / futures TimeoutError
+            return {"error": f"agent unreachable: {e!r}", **empty}
 
     def _h_stop_cluster(self, body, conn):
         """`ray-tpu stop` (reference: `ray stop`): ask every agent to
@@ -4121,7 +4165,20 @@ class Head:
     def _h_task_events(self, body, conn):
         with self.lock:
             self.task_events.extend(body["events"])
+        self.traces.intake(body["events"])
         return None
+
+    def _h_get_trace(self, body, conn):
+        """One causal trace tree, full span detail (util.state.get_trace,
+        `ray-tpu trace <id>`, dashboard /api/traces/<id>)."""
+        return {"trace": self.traces.get(body["trace_id"])}
+
+    def _h_list_traces(self, body, conn):
+        """Retained trace summaries, newest first; exemplars_only skips
+        the uniform sample (dashboard Traces view default)."""
+        return {"traces": self.traces.list(
+            limit=int(body.get("limit", 100)),
+            exemplars_only=bool(body.get("exemplars_only")))}
 
     def _h_report_metrics(self, body, conn):
         with self.lock:
@@ -5392,6 +5449,9 @@ class Head:
                 # (ray_tpu_object_bytes_transferred_total{path=...}).
                 "transfers": {"bytes": xfer_bytes,
                               "host_copies": xfer_copies},
+                # Request-tracing plane: retained/exemplar trace counts,
+                # tail-fold aggregates, and owner-side span-buffer drops.
+                "tracing": self.traces.stats(),
             }
 
     def _objects_stats_locked(self) -> dict:

@@ -473,6 +473,20 @@ class NodeAgent:
         elif kind == "pubsub_message":
             if body.get("topic") == self._view_topic:
                 self.cluster_view.apply(body.get("data") or {})
+        elif kind == "log_index":
+            # Remote-node log access: the head forwards `ray-tpu logs
+            # --node <id>` here so every node's worker logs are
+            # listable/tailable from the driver (reference: the
+            # dashboard log module's per-node agent routes).
+            from ray_tpu._private import log_utils
+
+            return {"logs": log_utils.log_index(self.log_dir)}
+        elif kind == "log_tail":
+            from ray_tpu._private import log_utils
+
+            return log_utils.log_tail(
+                self.log_dir, body["name"],
+                int(body.get("max_bytes", 64 * 1024)))
         elif kind == "shutdown_node":
             self._exit.set()
         return None

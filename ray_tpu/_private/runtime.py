@@ -371,13 +371,21 @@ class CoreRuntime:
         """Ship this process's counter snapshot (plus buffered chaos
         events) to the head. Called from the release loop on the
         rpc_report_interval_s cadence; tests call it directly."""
-        from ray_tpu._private import faultinject
+        from ray_tpu._private import faultinject, traceplane
 
         body = {"client_id": self.client_id, "client_type": self.client_type,
                 "counters": self.rpc_counter_snapshot()}
         chaos = faultinject.drain_events()
         if chaos:
             body["chaos_events"] = chaos
+        # Trace-plane piggyback: buffered user/proxy/serve spans (and
+        # the buffer's drop counter) ride the SAME amortized cast —
+        # span() in a hot loop costs a deque append, never a frame.
+        spans, dropped = traceplane.drain_spans()
+        if spans:
+            body["spans"] = spans
+        if dropped:
+            body["spans_dropped"] = dropped
         if self._census is not None:
             # Object census piggyback: the bounded per-callsite summary
             # rides the SAME amortized report cast — zero new per-call
@@ -652,8 +660,18 @@ class CoreRuntime:
                 except Exception:
                     pass
             now = _time.monotonic()
-            if (now - self._last_rpc_report
-                    >= GLOBAL_CONFIG.rpc_report_interval_s):
+            due = (now - self._last_rpc_report
+                   >= GLOBAL_CONFIG.rpc_report_interval_s)
+            if not due:
+                # Early flush for buffered trace spans: a finished
+                # request's spans must not wait out a full report
+                # interval to become visible on the head (still
+                # amortized — at most one extra report per second).
+                from ray_tpu._private import traceplane
+
+                due = (now - self._last_rpc_report >= 1.0
+                       and traceplane.pending_spans_age() > 1.0)
+            if due:
                 self._last_rpc_report = now
                 try:
                     # Cluster-wide counter aggregation: this process's
@@ -2180,6 +2198,21 @@ class CoreRuntime:
             finally:
                 self._owned_waiters -= 1
 
+    @staticmethod
+    def _stamp_trace(spec: TaskSpec) -> None:
+        """Request tracing: copy the ambient (trace_id, parent_span_id,
+        sampled) context onto the spec — it rides the compiled encoding
+        as an optional trailing field (task_spec._trailing), so traced
+        submissions cross every dispatch path with zero extra frames
+        and traceless payloads stay byte-identical."""
+        if not GLOBAL_CONFIG.trace_enabled:
+            return
+        from ray_tpu._private import worker_context
+
+        tc = worker_context.get_trace_context()
+        if tc is not None:
+            spec.trace_ctx = tuple(tc)
+
     def _spec_body(self, spec: TaskSpec) -> dict:
         """Compiled spec encoding when both ends support it
         (task_spec.pack_spec; negotiated at register). The packed bytes
@@ -2204,6 +2237,7 @@ class CoreRuntime:
             # Lives on the spec's scratch slot while in this process;
             # each wire hop carries it in the message's "evt" field.
             spec._evt = {"submit": time.time()}
+        self._stamp_trace(spec)
         if self._direct is not None:
             # Lease-cached fast path (reference: the owner-side lease
             # cache, normal_task_submitter.cc:29): same-shape tasks ride
@@ -2235,6 +2269,7 @@ class CoreRuntime:
         self._register_expected(spec)
         if GLOBAL_CONFIG.task_events_enabled:
             spec._evt = {"submit": time.time()}
+        self._stamp_trace(spec)
         # Direct fast path: once the head has granted this owner the
         # actor's worker address, calls pipeline owner→worker (peer
         # connection FIFO + owner-side window) without a head hop.

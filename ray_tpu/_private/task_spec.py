@@ -62,6 +62,14 @@ class TaskSpec:
     # TaskTimeoutError error-seal instead of executing. Rides the spec
     # itself, so it crosses every dispatch path with zero extra frames.
     deadline: float = 0.0
+    # Request-tracing context (trace_id, parent_span_id, sampled) or
+    # None, stamped at submit from the ambient trace context
+    # (worker_context) minted at the serve proxy / tracing.span. The
+    # task's own span id IS its task_id; nested submissions inherit the
+    # trace with this task as parent. Rides the spec like deadline — an
+    # optional trailing field of the compiled encoding, zero extra
+    # frames, byte-identical payloads when absent.
+    trace_ctx: Any = None
     # Scratch attributes the head/worker hang off a spec in flight —
     # declared because the dataclass uses __slots__ (a 1M-task backlog
     # at ~1 KB/dict-backed spec would cost a GB of pure dict overhead;
@@ -229,9 +237,24 @@ def pack_spec(spec: "TaskSpec") -> "bytes | None":
             # them keeps deadline-free payloads byte-identical to the
             # pre-overload-plane wire format):
             #   22. deadline — overload-protection expiry stamp
-        ) + ((spec.deadline,) if spec.deadline else ()))
+            #   23. trace_ctx — (trace_id, parent_span_id, sampled);
+            #       packing it forces deadline out too (possibly 0.0)
+            #       to keep the positional mapping intact
+        ) + _trailing(spec))
     except (TypeError, ValueError, OverflowError):
         return None  # exotic field value: pickle fallback
+
+
+def _trailing(spec: "TaskSpec") -> tuple:
+    """Optional trailing fields of the compiled encoding, oldest first.
+    A later field forces every earlier one out (unpack is positional);
+    each combination that omits a tail keeps its payload byte-identical
+    to the format that predated the omitted fields."""
+    if spec.trace_ctx is not None:
+        return (spec.deadline, tuple(spec.trace_ctx))
+    if spec.deadline:
+        return (spec.deadline,)
+    return ()
 
 
 def unpack_spec(data: bytes) -> "TaskSpec":

@@ -637,6 +637,15 @@ class Worker:
         cpu = getattr(spec, "_cpu_time", None)
         if cpu is not None:
             ev["cpu_time"] = cpu
+        # Request tracing: a sampled trace context turns this lifecycle
+        # event into a trace span (the task's span id IS its task id;
+        # the parent rode the spec). The fields ride the SAME
+        # task_finished cast — traceless events stay byte-identical.
+        tc = getattr(spec, "trace_ctx", None)
+        if tc and int(tc[2] or 0):
+            ev["trace_id"] = tc[0]
+            ev["span_id"] = spec.task_id
+            ev["parent_span_id"] = tc[1]
         return [ev]
 
     async def _run_task_async_guarded(self, spec: TaskSpec) -> None:
@@ -708,6 +717,7 @@ class Worker:
         worker_context.set_task_context(
             worker_context.TaskContext(spec.task_id, self.actor_id,
                                        self.node_id, inherited))
+        self._adopt_trace(spec)
         try:
             args, kwargs = await loop.run_in_executor(
                 self._fetch_pool, self._load_args, spec)
@@ -744,7 +754,18 @@ class Worker:
             return False
         finally:
             worker_context.set_task_context(None)
+            worker_context.set_trace_context(None)
             worker_context.pop_process_runtime_env(env_token)
+
+    @staticmethod
+    def _adopt_trace(spec: TaskSpec) -> None:
+        """Request tracing: adopt the trace context that rode the spec,
+        with this task's span (= its task id) as the new parent — any
+        nested .remote() from the user code chains causally. Cleared in
+        the caller's finally alongside the task context."""
+        tc = getattr(spec, "trace_ctx", None)
+        worker_context.set_trace_context(
+            (tc[0], spec.task_id, tc[2]) if tc else None)
 
     async def _store_async_gen(self, spec: TaskSpec, agen) -> None:
         """Streaming async generator (reference: async generators over
@@ -1035,6 +1056,7 @@ class Worker:
             worker_context.TaskContext(spec.task_id, self.actor_id,
                                        self.node_id, inherited_env)
         )
+        self._adopt_trace(spec)
         # Thread-local context misses user-spawned threads; keep a
         # process-level fallback too, refcounted so a finished task's env
         # never lingers (restored to the actor-lifetime env in finally).
@@ -1092,6 +1114,7 @@ class Worker:
             return False
         finally:
             worker_context.set_task_context(None)
+            worker_context.set_trace_context(None)
             worker_context.pop_process_runtime_env(env_token)
             if spec.actor_creation:
                 # The actor's runtime env (working_dir, env_vars) lives for
@@ -1201,6 +1224,14 @@ def main() -> None:
     # the agent/head read post-mortem — even after SIGKILL.
     if GLOBAL_CONFIG.crash_forensics_enabled:
         forensics.arm()
+    # Trace-correlated logs: worker stderr lands in {worker_id}.log, so
+    # stamping [trace=<id>] into every log record made while a traced
+    # task executes lets `ray-tpu logs --trace <id>` grep a request's
+    # log lines across the whole cluster.
+    if GLOBAL_CONFIG.trace_enabled:
+        from ray_tpu.util.tracing import install_log_correlation
+
+        install_log_correlation()
     # Flood workloads allocate millions of small objects; default gen0
     # thresholds make cyclic GC a measurable tax (reference analogue:
     # the reference's workers also tune GC). Collection still happens,

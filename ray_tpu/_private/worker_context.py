@@ -23,6 +23,13 @@ _head = None  # set when this process hosts the head (driver)
 # loop each keep their own task context across awaits.
 _task_context: "contextvars.ContextVar[TaskContext | None]" = (
     contextvars.ContextVar("ray_tpu_task_context", default=None))
+# Ambient request-tracing context: (trace_id, parent_span_id, sampled)
+# or None. Minted at the serve proxy (or a tracing.span), stamped onto
+# every TaskSpec at submit (runtime.submit_task), adopted by the worker
+# around task execution with the task's own span as the new parent —
+# so nested .remote() calls chain causally with no explicit plumbing.
+_trace_context: "contextvars.ContextVar[tuple | None]" = (
+    contextvars.ContextVar("ray_tpu_trace_context", default=None))
 
 
 def set_runtime(rt, head=None) -> None:
@@ -125,3 +132,23 @@ def set_task_context(ctx: TaskContext | None) -> None:
 
 def get_task_context() -> TaskContext:
     return _task_context.get() or TaskContext()
+
+
+def set_trace_context(ctx: "tuple | None") -> None:
+    """Set the ambient (trace_id, parent_span_id, sampled) context."""
+    _trace_context.set(ctx)
+
+
+def push_trace_context(ctx: "tuple | None"):
+    """Token-returning variant for scoped sets on shared executor
+    threads (the proxy's submit hop): reset with pop_trace_context so
+    the context can't leak to the thread's next unrelated request."""
+    return _trace_context.set(ctx)
+
+
+def pop_trace_context(token) -> None:
+    _trace_context.reset(token)
+
+
+def get_trace_context() -> "tuple | None":
+    return _trace_context.get()

@@ -267,6 +267,15 @@ class DashboardServer:
             # SIGUSR1 hook writes every thread's stack into the worker
             # log, which this endpoint harvests).
             return _profile_worker(path[len("/api/profile/"):], query)
+        if path == "/api/traces":
+            # Request-tracing plane: retained trace summaries (tail
+            # exemplars + uniform sample), newest first.
+            q = query or {}
+            return {"traces": us.list_traces(
+                limit=int(q.get("limit", 100)),
+                exemplars_only=q.get("exemplars") in ("1", "true"))}
+        if path.startswith("/api/traces/"):
+            return us.get_trace(path[len("/api/traces/"):])
         if path == "/api/logs":
             # Reference: dashboard/modules/log — per-worker log index.
             return {"logs": _log_index()}

@@ -57,6 +57,13 @@ class Request:
     # Constraint driver (ray_tpu.llm.guided.GuidedJson) when the request
     # asked for response_format json mode; None otherwise.
     guided: "object | None" = None
+    # Request-tracing context (trace_id, parent_span_id, sampled)
+    # captured from the ambient contextvar at add_request — the engine
+    # emits per-request "llm.prefill" / "llm.decode" spans into the
+    # caller's trace (bounded: two spans per request, never per token).
+    trace_ctx: Any = None
+    t_add: float = 0.0       # enqueue wall time (queue-wait start)
+    t_first: float = 0.0     # first-token wall time (decode start)
 
 
 @dataclasses.dataclass
@@ -348,6 +355,10 @@ class LLMEngine:
         req = Request(request_id, toks, sp)
         if sp.response_format is not None:
             req.guided = self._make_guided(sp.response_format)
+        from ray_tpu._private import worker_context
+
+        req.trace_ctx = worker_context.get_trace_context()
+        req.t_add = time.time()
         self.waiting.append(req)
 
     def has_unfinished(self) -> bool:
@@ -577,6 +588,11 @@ class LLMEngine:
                     jnp.asarray(hist), jnp.int32(tok)))
         self.last_tokens[slot] = tok
         req.generated.append(tok)
+        # Queue-wait + prefill up to the first sampled token, into the
+        # request's trace (captured at add_request).
+        req.t_first = time.time()
+        self._emit_span(req, "llm.prefill", req.t_add, req.t_first,
+                        {"prompt_tokens": len(req.prompt_tokens)})
         self._maybe_finish(slot, outputs)
 
     def _prefill_into(self, slot: int, toks: list[int],
@@ -859,7 +875,39 @@ class LLMEngine:
                 logprobs=req.logprobs,
                 error=guided_err,
             ))
+            self._emit_span(
+                req, "llm.decode", req.t_first or req.t_add, time.time(),
+                {"tokens": len(req.generated), "finish_reason": reason})
             self.slots[slot] = None
+
+    @staticmethod
+    def _emit_span(req: Request, name: str, start: float, end: float,
+                   attributes: "dict | None" = None) -> None:
+        """Buffer one engine span into the request's trace (flushed on
+        the owner's amortized rpc_report — zero per-span frames). No-op
+        for untraced/unsampled requests, so batch generate() stays
+        span-free."""
+        tc = req.trace_ctx
+        if not (tc and int(tc[2] or 0)):
+            return
+        import os
+
+        from ray_tpu._private import traceplane
+
+        traceplane.buffer_span({
+            "event": "span",
+            "name": name,
+            "kind": "llm",
+            "trace_id": tc[0],
+            "span_id": traceplane.new_span_id(),
+            "parent_span_id": tc[1],
+            "pid": os.getpid(),
+            "start": start,
+            "end": end,
+            "failed": False,
+            "attributes": {"request_id": req.request_id,
+                           **(attributes or {})},
+        })
 
     # -- the engine iteration ---------------------------------------------
 

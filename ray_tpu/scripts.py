@@ -308,21 +308,171 @@ def cmd_memory(args) -> int:
 
 
 def cmd_logs(args) -> int:
-    """List or tail cluster worker logs (reference: `ray logs [file]`)."""
+    """List or tail cluster worker logs (reference: `ray logs [file]`).
+    --node routes through that node's agent (remote-node log access);
+    --trace greps every log on every node for one request's
+    [trace=<id>]-stamped lines (trace-correlated logs)."""
     from ray_tpu._private.worker_context import global_runtime
 
     _connect(args.address)
     conn = global_runtime().conn
+    node_id = getattr(args, "node", None)
+    base = {"node_id": node_id} if node_id else {}
+    if getattr(args, "trace", None):
+        return _grep_trace_logs(conn, args)
     if not args.name:
-        for e in conn.call("log_index", {})["logs"]:
+        reply = conn.call("log_index", dict(base))
+        if reply.get("error"):
+            print(reply["error"], file=sys.stderr)
+            return 1
+        for e in reply["logs"]:
             print(f"{e['bytes']:>10}  {e['name']}")
         return 0
     reply = conn.call("log_tail", {"name": args.name,
-                                   "max_bytes": args.max_bytes})
+                                   "max_bytes": args.max_bytes, **base})
+    if reply.get("error"):
+        print(reply["error"], file=sys.stderr)
+        return 1
     lines = reply["lines"][-args.tail:] if args.tail > 0 else []
     for ln in lines:
         print(ln)
     return 0
+
+
+def _grep_trace_logs(conn, args) -> int:
+    """Client-side grep for one trace's log lines: walk the head's
+    session logs plus every node agent's log dir, tail each file, and
+    keep the [trace=<id>]-prefixed lines (stamped by the workers'
+    logging filter while a traced task executes)."""
+    from ray_tpu.util import state as us
+
+    needle = f"[trace={args.trace}]"
+    sources = [(None, "head")]
+    try:
+        sources += [(n["node_id"], n["node_id"]) for n in us.list_nodes()]
+    except Exception:
+        pass
+    hits = 0
+    for node_id, label in sources:
+        body = {"node_id": node_id} if node_id else {}
+        try:
+            index = conn.call("log_index", dict(body)).get("logs") or []
+        except Exception:
+            continue
+        for e in index:
+            reply = conn.call("log_tail", {
+                "name": e["name"], "max_bytes": args.max_bytes, **body})
+            for ln in reply.get("lines") or []:
+                if needle in ln:
+                    print(f"{label}/{e['name']}: {ln}")
+                    hits += 1
+    if not hits:
+        print(f"no log lines found for trace {args.trace}")
+    return 0
+
+
+def cmd_trace(args) -> int:
+    """Causal trace waterfall (`ray-tpu trace <id>`), or the retained
+    trace list with no id. --perfetto exports one trace as a Chrome
+    JSON trace (open in Perfetto / chrome://tracing) with one row per
+    process and proper parent nesting."""
+    from ray_tpu.util import state as us
+
+    _connect(args.address)
+    if not args.trace_id:
+        rows = us.list_traces(limit=args.limit,
+                              exemplars_only=args.exemplars)
+        if not rows:
+            print("no traces retained")
+            return 0
+        print(f"{'TRACE':<34} {'ROOT':<24} {'SPANS':>5} "
+              f"{'DUR_MS':>8}  FLAGS")
+        for r in rows:
+            flags = ",".join(f for f in ("error", "shed", "slow")
+                             if r.get(f)) or "-"
+            print(f"{r['trace_id']:<34} {r.get('root') or '?':<24} "
+                  f"{r['spans']:>5} {r['duration_s'] * 1e3:>8.1f}  "
+                  f"{flags}")
+        return 0
+    tr = us.get_trace(args.trace_id)
+    if tr is None:
+        print(f"no trace {args.trace_id!r} retained (folded, or never "
+              f"sampled — see `ray-tpu trace` for the retained set)")
+        return 1
+    spans = tr.get("spans_detail") or []
+    if args.perfetto:
+        _write_perfetto(args.perfetto, tr, spans)
+        print(f"wrote {args.perfetto}")
+        return 0
+    flags = ",".join(f for f in ("error", "shed", "slow")
+                     if tr.get(f)) or "-"
+    print(f"trace {tr['trace_id']}  root={tr.get('root') or '?'}  "
+          f"spans={tr['spans']}  dur={tr['duration_s'] * 1e3:.1f}ms  "
+          f"flags={flags}")
+    _print_waterfall(spans, tr.get("start") or 0.0,
+                     max(tr.get("duration_s") or 0.0, 1e-9))
+    return 0
+
+
+def _print_waterfall(spans: list, t0: float, total: float) -> None:
+    """Indented causal tree, one line per span, with an offset/duration
+    bar scaled to the trace: `<indent><name> [pid/node] |--=====--|`."""
+    by_id = {s["span_id"]: s for s in spans}
+    children: dict = {}
+    roots = []
+    for s in spans:
+        p = s.get("parent_span_id") or ""
+        if p and p in by_id:
+            children.setdefault(p, []).append(s)
+        else:
+            roots.append(s)
+    width = 40
+
+    def bar(s):
+        off = int((max(0.0, s["start"] - t0) / total) * width)
+        dur = max(1, int(((s["end"] - s["start"]) / total) * width))
+        off = min(off, width - 1)
+        dur = min(dur, width - off)
+        return "." * off + "=" * dur + "." * (width - off - dur)
+
+    def walk(s, depth):
+        where = s.get("worker_id") or s.get("node_id") \
+            or (f"pid:{s['pid']}" if s.get("pid") else "?")
+        ms = (s["end"] - s["start"]) * 1e3
+        mark = " FAILED" if s.get("failed") else ""
+        print(f"  {'  ' * depth}{s.get('name'):<{30 - 2 * min(depth, 8)}}"
+              f" |{bar(s)}| {ms:>8.1f}ms  [{s.get('kind', '?')}"
+              f" {where}]{mark}")
+        for c in sorted(children.get(s["span_id"], []),
+                        key=lambda x: x["start"]):
+            walk(c, depth + 1)
+
+    for r in sorted(roots, key=lambda x: x["start"]):
+        walk(r, 0)
+
+
+def _write_perfetto(path: str, tr: dict, spans: list) -> None:
+    """Chrome JSON trace: complete ("X") events, one pid row per
+    process, span hierarchy recoverable via the id args."""
+    events = []
+    for s in spans:
+        events.append({
+            "name": s.get("name"),
+            "cat": s.get("kind", "span"),
+            "ph": "X",
+            "ts": s["start"] * 1e6,
+            "dur": max(0.0, (s["end"] - s["start"]) * 1e6),
+            "pid": s.get("pid") or 0,
+            "tid": s.get("worker_id") or s.get("task_id") or 0,
+            "args": {k: s.get(k) for k in
+                     ("span_id", "parent_span_id", "task_id",
+                      "worker_id", "node_id", "attributes", "failed")
+                     if s.get(k) is not None},
+        })
+    with open(path, "w") as f:
+        json.dump({"traceEvents": events,
+                   "displayTimeUnit": "ms",
+                   "otherData": {"trace_id": tr["trace_id"]}}, f)
 
 
 def cmd_crashes(args) -> int:
@@ -610,12 +760,28 @@ def main(argv: list[str] | None = None) -> int:
                    help="always print the leak-suspect section")
     s.set_defaults(fn=cmd_memory)
 
+    s = sub.add_parser("trace",
+                       help="request-trace waterfall / list / export")
+    s.add_argument("trace_id", nargs="?", default=None,
+                   help="trace id (from X-Trace-Id / list); omit to list")
+    s.add_argument("--address", required=True)
+    s.add_argument("--limit", type=int, default=50)
+    s.add_argument("--exemplars", action="store_true",
+                   help="list only slow/error/shed exemplar traces")
+    s.add_argument("--perfetto", default=None, metavar="FILE",
+                   help="export the trace as Chrome/Perfetto JSON")
+    s.set_defaults(fn=cmd_trace)
+
     s = sub.add_parser("logs", help="list or tail cluster worker logs")
     s.add_argument("name", nargs="?", default=None,
                    help="log name from the listing (omit to list)")
     s.add_argument("--address", required=True)
     s.add_argument("--tail", type=int, default=100)
     s.add_argument("--max-bytes", type=int, default=64 * 1024)
+    s.add_argument("--node", default=None,
+                   help="node id: list/tail that node's logs via its agent")
+    s.add_argument("--trace", default=None,
+                   help="trace id: grep all logs for the request's lines")
     s.set_defaults(fn=cmd_logs)
 
     s = sub.add_parser("crashes",

@@ -104,8 +104,15 @@ def batch(_fn: Callable | None = None, *, max_batch_size: int = 10,
             batcher = _batcher_for(inst)
             # The caller's deadline (handle timeout_s → TaskSpec
             # deadline → replica contextvar) rides into the queue so
-            # assembly can shed expired work.
-            fut = batcher.submit(item, deadline=get_request_deadline())
+            # assembly can shed expired work; the ambient trace context
+            # (adopted from the spec around task execution) rides along
+            # so each coalesced item keeps its own span inside the
+            # shared batch-exec span.
+            from ray_tpu._private import worker_context
+
+            fut = batcher.submit(item, deadline=get_request_deadline(),
+                                 trace_ctx=worker_context
+                                 .get_trace_context())
             return await fut
 
         wrapper._ray_tpu_serve_batch = True  # introspection/testing

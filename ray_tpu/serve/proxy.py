@@ -24,10 +24,13 @@ from __future__ import annotations
 
 import asyncio
 import json
+import os
 import socket
 import threading
+import time
 from typing import Any
 
+from ray_tpu._private import traceplane, worker_context
 from ray_tpu.serve.handle import DeploymentHandle
 
 
@@ -126,6 +129,13 @@ class HTTPProxy:
             if (request.headers.get("Upgrade", "").lower() == "websocket"
                     and meta.get("ws_method")):
                 return await self._handle_ws(web, request, handle_, meta)
+            # Request tracing: mint (or adopt X-Request-Id as) the trace
+            # at the ingress hop; the context rides every nested
+            # .remote() via the ambient contextvar, and the trace id is
+            # echoed back as X-Trace-Id for client-side correlation.
+            trace_ctx = traceplane.mint_trace(
+                request.headers.get("X-Request-Id"))
+            t0 = time.time()
             wants_sse = ("text/event-stream" in request.headers.get("Accept", "")
                          or (isinstance(payload, dict)
                              and payload.get("stream") is True
@@ -139,7 +149,8 @@ class HTTPProxy:
                 # __call__ itself must be a generator.
                 return await self._stream_sse(
                     web, request, handle_, payload,
-                    method=meta.get("sse_method"))
+                    method=meta.get("sse_method"), trace_ctx=trace_ctx,
+                    t0=t0)
             # Per-request deadline: the handle stamps it onto the
             # TaskSpec so expired requests shed at every hop instead of
             # completing into the void.
@@ -166,13 +177,15 @@ class HTTPProxy:
                     sub = path[len(prefix):] if prefix != "/" else path
                     sub = sub or "/"
                     resp_obj = await loop.run_in_executor(
-                        None, lambda: handle_.options(
-                            method_name=meta["path_method"],
-                            timeout_s=timeout_s).remote(sub, payload))
+                        None, lambda: self._with_trace(
+                            trace_ctx, lambda: handle_.options(
+                                method_name=meta["path_method"],
+                                timeout_s=timeout_s).remote(sub, payload)))
                 else:
                     resp_obj = await loop.run_in_executor(
-                        None, lambda: handle_.options(
-                            timeout_s=timeout_s).remote(payload))
+                        None, lambda: self._with_trace(
+                            trace_ctx, lambda: handle_.options(
+                                timeout_s=timeout_s).remote(payload)))
                 result = await resp_obj._result_async(
                     timeout_s=timeout_s + 5.0)
             except asyncio.CancelledError:
@@ -185,8 +198,13 @@ class HTTPProxy:
                     loop.run_in_executor(None, resp_obj.cancel)
                 raise
             except Exception as e:  # noqa: BLE001 — surface to the client
-                return self._error_response(web, e)
-            return self._encode(web, result)
+                # Shed/error responses close the trace too — 503/408
+                # exemplars are exactly what tail-based retention keeps.
+                return self._finish_trace(
+                    trace_ctx, request, self._error_response(web, e),
+                    t0, error=e)
+            return self._finish_trace(
+                trace_ctx, request, self._encode(web, result), t0)
 
         async def run():
             app = web.Application()
@@ -211,7 +229,8 @@ class HTTPProxy:
         self._loop.run_until_complete(run())
 
     async def _stream_sse(self, web, request, handle_, payload,
-                          method: "str | None" = None):
+                          method: "str | None" = None, trace_ctx=None,
+                          t0: float = 0.0):
         """Fully async SSE: submit via a short executor hop, then
         async-iterate the response generator — each item awaits a
         head-pushed readiness notification, so a stream in flight holds
@@ -220,10 +239,13 @@ class HTTPProxy:
         inherent: the next item is requested only after the previous
         write completes."""
         loop = asyncio.get_running_loop()
-        resp = web.StreamResponse(headers={
+        headers = {
             "Content-Type": "text/event-stream",
             "Cache-Control": "no-cache",
-        })
+        }
+        if trace_ctx is not None:
+            headers["X-Trace-Id"] = trace_ctx[0]
+        resp = web.StreamResponse(headers=headers)
         await resp.prepare(request)
         gen = None
         try:
@@ -231,7 +253,9 @@ class HTTPProxy:
             if method:
                 opts["method_name"] = method
             gen = await loop.run_in_executor(
-                None, lambda: handle_.options(**opts).remote(payload))
+                None, lambda: self._with_trace(
+                    trace_ctx,
+                    lambda: handle_.options(**opts).remote(payload)))
             async for item in gen:
                 if item == "[DONE]":
                     # OpenAI stream terminator: literal, not JSON.
@@ -253,6 +277,7 @@ class HTTPProxy:
             # Early termination must release routing accounting.
             if gen is not None and hasattr(gen, "close"):
                 gen.close()
+            self._record_root_span(trace_ctx, request, 200, t0)
         return resp
 
     async def _handle_ws(self, web, request, handle_, meta):
@@ -328,6 +353,56 @@ class HTTPProxy:
         if best is None:
             return None
         return {**best, "_prefix": best_prefix}
+
+    @staticmethod
+    def _with_trace(trace_ctx, fn):
+        """Run the submit closure with the request's trace context
+        ambient. Contextvars don't cross run_in_executor, and executor
+        threads are REUSED — push/pop (not set) so the context can't
+        leak into the thread's next unrelated request."""
+        if trace_ctx is None:
+            return fn()
+        tok = worker_context.push_trace_context(trace_ctx)
+        try:
+            return fn()
+        finally:
+            worker_context.pop_trace_context(tok)
+
+    def _finish_trace(self, trace_ctx, request, resp, t0,
+                      error: "Exception | None" = None):
+        """Echo X-Trace-Id and close the request's root span. Runs on
+        the success AND error/shed paths — a 503/408 response is
+        exactly the tail exemplar the head's trace table retains."""
+        if trace_ctx is None:
+            return resp
+        resp.headers["X-Trace-Id"] = trace_ctx[0]
+        self._record_root_span(trace_ctx, request,
+                               getattr(resp, "status", 200), t0,
+                               error=error)
+        return resp
+
+    @staticmethod
+    def _record_root_span(trace_ctx, request, status, t0, error=None):
+        if trace_ctx is None or not int(trace_ctx[2] or 0):
+            return
+        attrs = {"method": request.method, "path": request.path,
+                 "status": status}
+        if error is not None:
+            attrs["error"] = repr(error)
+        traceplane.buffer_span({
+            "event": "span",
+            "name": "http.request",
+            "kind": "proxy",
+            "trace_id": trace_ctx[0],
+            "span_id": trace_ctx[1],
+            "parent_span_id": "",
+            "pid": os.getpid(),
+            "start": t0,
+            "end": time.time(),
+            "failed": status >= 500,
+            "status": status,
+            "attributes": attrs,
+        })
 
     @staticmethod
     def _error_response(web, e: Exception):

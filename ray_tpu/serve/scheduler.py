@@ -168,7 +168,7 @@ class ContinuousBatcher:
         self._max_concurrent_batches = max_concurrent_batches
         self._max_queue_len = max_queue_len
         self.model = LatencyModel()
-        self._queue: deque = deque()  # (item, future, deadline)
+        self._queue: deque = deque()  # (item, future, deadline, trace_ctx)
         self._wakeup: "asyncio.Event | None" = None
         self._scheduler: "asyncio.Task | None" = None
         self._batches: set = set()
@@ -183,8 +183,8 @@ class ContinuousBatcher:
 
     # -- submission --------------------------------------------------------
 
-    def submit(self, item: Any, deadline: "float | None" = None
-               ) -> "asyncio.Future":
+    def submit(self, item: Any, deadline: "float | None" = None,
+               trace_ctx: "tuple | None" = None) -> "asyncio.Future":
         loop = asyncio.get_running_loop()
         if self._closed:
             raise RuntimeError(f"batcher {self._name} is shut down")
@@ -196,7 +196,7 @@ class ContinuousBatcher:
                 f"PendingCallsLimitError: @serve.batch queue for "
                 f"{self._name} is full ({self._max_queue_len} waiting)")
         fut = loop.create_future()
-        self._queue.append((item, fut, deadline))
+        self._queue.append((item, fut, deadline, trace_ctx))
         self.stats["submitted"] += 1
         if self._wakeup is None:
             self._wakeup = asyncio.Event()
@@ -256,7 +256,7 @@ class ContinuousBatcher:
             return
         now = time.time()
         kept: deque = deque()
-        for item, fut, dl in self._queue:
+        for item, fut, dl, tc in self._queue:
             if fut.done():  # caller gone (cancelled/disconnected)
                 self.stats["shed_cancelled"] += 1
                 continue
@@ -266,8 +266,13 @@ class ContinuousBatcher:
                     "TaskTimeoutError: request exceeded its deadline "
                     "while queued for batching (shed before execution)",
                     where="serve_batcher"))
+                # Shed span: failed + shed attribute makes the trace a
+                # tail exemplar at the head (never folded first).
+                self._emit_span(tc, f"{self._name}.shed", now, now,
+                                failed=True,
+                                attributes={"shed": "serve_batcher"})
                 continue
-            kept.append((item, fut, dl))
+            kept.append((item, fut, dl, tc))
         self._queue = kept
 
     async def _run_batch(self, batch: list) -> None:
@@ -277,6 +282,8 @@ class ContinuousBatcher:
         self.stats["items"] += len(items)
         self._recent_sizes.append(len(items))
         t0 = time.perf_counter()
+        wall0 = time.time()
+        failed = False
         try:
             results = await self._fn(items)
             self.model.observe(len(items), time.perf_counter() - t0)
@@ -294,10 +301,72 @@ class ContinuousBatcher:
                     f.cancel()
             raise
         except Exception as e:  # noqa: BLE001 — propagate to every caller
+            failed = True
             self.stats["batch_errors"] += 1
             for f in futures:
                 if not f.done():
                     f.set_exception(e)
+        self._trace_batch(batch, wall0, time.time(), failed)
+
+    def _trace_batch(self, batch: list, start: float, end: float,
+                     failed: bool) -> None:
+        """Per-trace view of a coalesced batch: each distinct sampled
+        trace in the batch gets a "batch_exec" span under its own
+        caller span (a shared batch_id attribute ties the copies
+        together), and every item keeps its own "batch_item" child —
+        so one request's trace shows exactly its share of the shared
+        execution, including who it was coalesced with."""
+        traced = [tc for _i, _f, _d, tc in batch if tc and int(tc[2] or 0)]
+        if not traced:
+            return
+        from ray_tpu._private import traceplane
+
+        batch_id = traceplane.new_span_id()
+        exec_span_of: dict[str, str] = {}
+        for tc in traced:
+            if tc[0] in exec_span_of:
+                continue
+            sid = traceplane.new_span_id()
+            exec_span_of[tc[0]] = sid
+            self._emit_span(
+                tc, f"{self._name}.batch_exec", start, end, failed=failed,
+                span_id=sid,
+                attributes={"batch_id": batch_id,
+                            "batch_size": len(batch)})
+        for idx, (_item, _fut, _dl, tc) in enumerate(batch):
+            if not (tc and int(tc[2] or 0)):
+                continue
+            self._emit_span(
+                tc, f"{self._name}.batch_item", start, end, failed=failed,
+                parent_span_id=exec_span_of[tc[0]],
+                attributes={"batch_id": batch_id, "index": idx})
+
+    def _emit_span(self, tc: "tuple | None", name: str, start: float,
+                   end: float, *, failed: bool = False,
+                   span_id: "str | None" = None,
+                   parent_span_id: "str | None" = None,
+                   attributes: "dict | None" = None) -> None:
+        """Buffer one serve-plane span into the request's trace (rides
+        the next amortized rpc_report — zero per-span frames)."""
+        if not (tc and int(tc[2] or 0)):
+            return
+        import os
+
+        from ray_tpu._private import traceplane
+
+        traceplane.buffer_span({
+            "event": "span",
+            "name": name,
+            "kind": "serve",
+            "trace_id": tc[0],
+            "span_id": span_id or traceplane.new_span_id(),
+            "parent_span_id": parent_span_id or tc[1],
+            "pid": os.getpid(),
+            "start": start,
+            "end": end,
+            "failed": failed,
+            "attributes": attributes or {},
+        })
 
     # -- introspection / teardown ------------------------------------------
 
@@ -330,7 +399,7 @@ class ContinuousBatcher:
             if not b.done():
                 b.cancel()
         while self._queue:
-            _item, fut, _dl = self._queue.popleft()
+            _item, fut, _dl, _tc = self._queue.popleft()
             if not fut.done():
                 fut.cancel()
         if self._wakeup is not None:

@@ -224,13 +224,42 @@ def runtime_stats_text() -> str:
     # admission counter rides the generic counters block above
     # (ray_tpu_admission_rejected_total) and the pressure gauge the
     # gauges block (ray_tpu_mem_pressured_nodes).
+    tracing = snap.get("tracing") or {}
+    exemplar_ids = tracing.get("exemplar_ids") or {}
     shed = snap.get("tasks_shed") or {}
     if shed:
+        # OpenMetrics-style exemplar suffix: a shed spike comes with a
+        # retained trace id to drill into (`ray-tpu trace <id>`).
+        ex = (f' # {{trace_id="{exemplar_ids["shed"]}"}} 1'
+              if exemplar_ids.get("shed") else "")
         lines.append("# TYPE ray_tpu_tasks_shed_total counter")
         for where in sorted(shed):
             lines.append(
                 f'ray_tpu_tasks_shed_total'
-                f'{{where="{_escape_label_value(where)}"}} {shed[where]}')
+                f'{{where="{_escape_label_value(where)}"}} '
+                f'{shed[where]}{ex}')
+    # Request-tracing plane: retention/fold/drop gauges, plus one info
+    # series per exemplar kind so the serve p99 dashboards can link
+    # "slow right now" to a concrete retained trace.
+    if tracing:
+        for key, metric in (("retained", "ray_tpu_traces_retained"),
+                            ("exemplars", "ray_tpu_traces_exemplars")):
+            lines.append(f"# TYPE {metric} gauge")
+            lines.append(f"{metric} {tracing.get(key, 0)}")
+        folded = tracing.get("folded") or {}
+        lines.append("# TYPE ray_tpu_traces_folded_total counter")
+        lines.append(f"ray_tpu_traces_folded_total {folded.get('count', 0)}")
+        lines.append("# TYPE ray_tpu_trace_spans_dropped_total counter")
+        lines.append(f"ray_tpu_trace_spans_dropped_total "
+                     f"{tracing.get('spans_dropped_owner_side', 0)}")
+        if exemplar_ids:
+            lines.append("# TYPE ray_tpu_trace_exemplar_info gauge")
+            for kind in sorted(exemplar_ids):
+                lines.append(
+                    f'ray_tpu_trace_exemplar_info'
+                    f'{{kind="{_escape_label_value(kind)}",'
+                    f'trace_id="{_escape_label_value(exemplar_ids[kind])}"'
+                    f'}} 1')
     # Unified retry plane: open circuit breakers in the head process
     # (per-client breakers ride the rpc clients snapshots).
     breakers = snap.get("breakers") or {}

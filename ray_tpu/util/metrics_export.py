@@ -183,6 +183,22 @@ def grafana_dashboard(extra_metrics: "list[str] | None" = None) -> dict:
         "short", 12, y))
     next_id += 1
     y += 8
+    # Request-tracing row: retention/exemplar gauges plus the per-kind
+    # exemplar-id info series (the drill-down trace id for a p99/shed
+    # spike — `ray-tpu trace <id>` renders the waterfall).
+    panels.append(_panel(
+        next_id, "Traces retained / exemplars",
+        [("ray_tpu_traces_retained", "retained"),
+         ("ray_tpu_traces_exemplars", "exemplars")], "short", 0, y))
+    next_id += 1
+    panels.append(_panel(
+        next_id, "Trace folds + span drops / 5m",
+        [("increase(ray_tpu_traces_folded_total[5m])", "folded"),
+         ("increase(ray_tpu_trace_spans_dropped_total[5m])",
+          "spans dropped")],
+        "short", 12, y))
+    next_id += 1
+    y += 8
     for i, name in enumerate(extra_metrics or []):
         panels.append(_panel(next_id, name, name, "short",
                              (i % 2) * 12, y + (i // 2) * 8))

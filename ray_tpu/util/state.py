@@ -226,16 +226,38 @@ def memory_summary() -> dict:
     return _call("memory_summary")
 
 
-def list_logs() -> list[dict]:
-    """Worker log index on the head (reference: util/state list_logs)."""
-    return _call("log_index")["logs"]
+def list_logs(*, node_id: "str | None" = None) -> list[dict]:
+    """Worker log index (reference: util/state list_logs). With a
+    node_id the head forwards to that node's agent, so every node's
+    logs are listable from the driver."""
+    body = {"node_id": node_id} if node_id else {}
+    return _call("log_index", body)["logs"]
 
 
-def get_log(name: str, *, tail: int = 500,
-            max_bytes: int = 64 * 1024) -> list[str]:
-    """Tail one worker log (reference: util/state get_log)."""
-    reply = _call("log_tail", {"name": name, "max_bytes": max_bytes})
+def get_log(name: str, *, tail: int = 500, max_bytes: int = 64 * 1024,
+            node_id: "str | None" = None) -> list[str]:
+    """Tail one worker log (reference: util/state get_log), locally or
+    on a remote node via its agent."""
+    body = {"name": name, "max_bytes": max_bytes}
+    if node_id:
+        body["node_id"] = node_id
+    reply = _call("log_tail", body)
     return reply["lines"][-tail:] if tail > 0 else []
+
+
+def get_trace(trace_id: str) -> "dict | None":
+    """One causal trace tree: summary plus full span detail
+    (`ray-tpu trace <id>` backs onto this)."""
+    return _call("get_trace", {"trace_id": trace_id})["trace"]
+
+
+def list_traces(*, limit: int = 100,
+                exemplars_only: bool = False) -> list[dict]:
+    """Retained trace summaries, newest first. Tail-based retention:
+    slow/error/shed exemplars and a uniform 1-in-N sample keep full
+    detail; folded traces appear only in runtime_stats counters."""
+    return _call("list_traces", {
+        "limit": limit, "exemplars_only": exemplars_only})["traces"]
 
 
 def health_summary() -> dict:

@@ -36,8 +36,15 @@ def test_list_tasks_and_summary():
         return x * 2
 
     ray_tpu.get([work.remote(i) for i in range(5)])
-    tasks = us.list_tasks()
-    mine = [t for t in tasks if t["name"] == "work"]
+    # get() resolves on the owner plane; the head's task_finished
+    # bookkeeping cast is asynchronous — give it a bounded beat.
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        mine = [t for t in us.list_tasks() if t["name"] == "work"]
+        if len(mine) == 5 and all(t["state"] == "FINISHED"
+                                  for t in mine):
+            break
+        time.sleep(0.1)
     assert len(mine) == 5
     assert all(t["state"] == "FINISHED" for t in mine)
     summary = us.summarize_tasks()

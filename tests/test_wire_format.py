@@ -43,12 +43,13 @@ def wire_codec(request, monkeypatch):
     return c
 
 
-def _spec(deadline=0.0) -> TaskSpec:
+def _spec(deadline=0.0, trace_ctx=None) -> TaskSpec:
     return TaskSpec(
         task_id="t" * 16, name="fn", func_id="f" * 16, args=b"\x80\x05args",
         deps=["d" * 16], return_ids=["r" * 16], resources={"CPU": 1},
         owner_id="owner-1", owner_addr=("127.0.0.1", 4242),
-        max_retries=3, retries_used=1, deadline=deadline)
+        max_retries=3, retries_used=1, deadline=deadline,
+        trace_ctx=trace_ctx)
 
 
 def _hot_bodies() -> dict:
@@ -134,6 +135,36 @@ def test_packed_spec_deadline_trailing_field(wire_codec):
         tup = (s.task_id, s.name, s.func_id, s.args, list(s.deps),
                list(s.return_ids), s.resources, s.owner_id,
                tuple(s.owner_addr), s.max_retries, s.retries_used)
+        assert wirefmt.PY_CODEC.pack(tup) == wire_codec.pack(tup)
+        assert wirefmt.PY_CODEC.unpack(wire_codec.pack(tup)) == tup
+
+
+def test_packed_spec_trace_ctx_trailing_field(wire_codec):
+    """The trace context rides the compiled encoding as the second
+    optional trailing field: traceless payloads stay byte-identical to
+    the deadline-era format, a trace context forces the deadline out
+    too (possibly 0.0 — the unpack mapping is positional), and both
+    codecs agree byte-for-byte."""
+    ctx = ("req-" + "a" * 28, "b" * 16, 1)
+    plain = pack_spec(_spec())
+    with_dl = pack_spec(_spec(deadline=1234.5))
+    with_tc = pack_spec(_spec(trace_ctx=ctx))
+    with_both = pack_spec(_spec(deadline=1234.5, trace_ctx=ctx))
+    assert len(with_tc) > len(plain)
+    # Round trips: every combination restores exactly what was packed.
+    s = unpack_spec(with_tc)
+    assert tuple(s.trace_ctx) == ctx and s.deadline == 0.0
+    s = unpack_spec(with_both)
+    assert tuple(s.trace_ctx) == ctx and s.deadline == 1234.5
+    assert unpack_spec(plain).trace_ctx is None
+    assert unpack_spec(with_dl).trace_ctx is None
+    # Byte-parity across codecs for the trace-bearing tail (the nested
+    # (str, str, int) tuple exercises the generic value-tree path).
+    for s in (_spec(trace_ctx=ctx), _spec(deadline=9.5, trace_ctx=ctx)):
+        tup = (s.task_id, s.name, s.func_id, s.args, list(s.deps),
+               list(s.return_ids), s.resources, s.owner_id,
+               tuple(s.owner_addr), s.max_retries, s.retries_used,
+               s.deadline, tuple(s.trace_ctx))
         assert wirefmt.PY_CODEC.pack(tup) == wire_codec.pack(tup)
         assert wirefmt.PY_CODEC.unpack(wire_codec.pack(tup)) == tup
 
