@@ -37,6 +37,10 @@ PROFILES = {
         "placement_groups": 10,
         "serve_per_thread": 6,
         "serve_ab_requests": 300,
+        "llm_ab_requests": 32,
+        "llm_ab_clients": 8,
+        "llm_ab_prompt_tokens": 64,
+        "llm_ab_prefix_tokens": 32,
     },
     "full": {
         "queued_tasks": 1_000_000,
@@ -54,6 +58,10 @@ PROFILES = {
         "placement_groups": 500,
         "serve_per_thread": 30,
         "serve_ab_requests": 1200,
+        "llm_ab_requests": 96,
+        "llm_ab_clients": 8,
+        "llm_ab_prompt_tokens": 96,
+        "llm_ab_prefix_tokens": 64,
     },
 }
 
@@ -427,6 +435,21 @@ def _run_sections(p: dict, results: dict) -> dict:
     #    with TYPED errors), replica scaling 1 -> 2, and the
     #    continuous-vs-fixed batching A/B.
     results["serve"] = _serve_section(p)
+
+    # 8. LLM inference plane: monolithic vs disaggregated prefill/decode
+    #    pools A/B over the paged-KV engine (equal chips; goodput/chip,
+    #    p99, handoff latency/bytes, prefix hit rate, page utilization).
+    #    Subprocess like the batching A/B: the bench boots its own
+    #    cluster + serve apps and must not disturb this one.
+    results["llm"] = json.loads(subprocess.check_output(
+        [sys.executable,
+         os.path.join(REPO, "benchmarks", "llm_disagg_ab.py"), "--json"],
+        env=dict(os.environ, JAX_PLATFORMS="cpu",
+                 LLM_AB_REQUESTS=str(p["llm_ab_requests"]),
+                 LLM_AB_CLIENTS=str(p["llm_ab_clients"]),
+                 LLM_AB_PROMPT_TOKENS=str(p["llm_ab_prompt_tokens"]),
+                 LLM_AB_PREFIX_TOKENS=str(p["llm_ab_prefix_tokens"])),
+        timeout=900).decode())
     return results
 
 

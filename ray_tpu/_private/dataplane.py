@@ -19,6 +19,9 @@ This module holds the pieces every layer shares:
       zero_copy bytes served as aliasing views (no host copy at all)
       inline    payload bytes that rode control-plane frames
       spill     bytes restored from external storage
+      handoff   KV pages moved prefill→decode (LLM disaggregation);
+                always copies=0 — the record is resolved via the same
+                local/p2p machinery, this path just sizes the handoff
     ``host_copies`` counts host-side payload copies on the read path —
     the structural guard that a large result reaches the caller with at
     most ONE copy end to end.
@@ -44,7 +47,8 @@ import sys
 import threading
 from typing import Any
 
-_TRANSFER_PATHS = ("p2p", "relay", "local", "zero_copy", "inline", "spill")
+_TRANSFER_PATHS = ("p2p", "relay", "local", "zero_copy", "inline", "spill",
+                   "handoff")
 
 
 def enabled() -> bool:

@@ -9,7 +9,9 @@ delegated CUDA engine.
 from ray_tpu.llm.batch import LLMPredictor, build_llm_processor
 from ray_tpu.llm.config import LLMConfig, SamplingParams
 from ray_tpu.llm.engine import AsyncLLMEngine, LLMEngine, RequestOutput
-from ray_tpu.llm.serving import LLMServer, build_openai_app
+from ray_tpu.llm.serving import (DecodeServer, LLMRouter, LLMServer,
+                                 PrefillServer, build_disaggregated_app,
+                                 build_openai_app)
 
 __all__ = [
     "LLMConfig",
@@ -18,7 +20,11 @@ __all__ = [
     "AsyncLLMEngine",
     "RequestOutput",
     "LLMServer",
+    "PrefillServer",
+    "DecodeServer",
+    "LLMRouter",
     "build_openai_app",
+    "build_disaggregated_app",
     "LLMPredictor",
     "build_llm_processor",
 ]

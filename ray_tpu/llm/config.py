@@ -119,6 +119,20 @@ class LLMConfig:
     enable_prefix_caching: bool = False
     prefix_block: int = 32           # match/store granularity, tokens
     prefix_cache_entries: int = 16   # LRU capacity (entries, not bytes)
+    # Paged KV cache (reference: vLLM paged attention; TPU-native shape
+    # in ray_tpu.llm.kv_pages): tokens per KV page. 0 keeps the dense
+    # per-slot [max_seq_len] cache; > 0 switches the engine to a page
+    # pool + per-sequence block tables, which is what makes prefix
+    # caching copy-free (page pinning) and prefill→decode handoff
+    # possible. Disaggregated serving requires it.
+    kv_page_size: int = 0
+    # Page-pool capacity. 0 = auto: max_num_seqs * ceil(max_len/page)
+    # + 1 (full dense equivalent; smaller values overcommit and rely on
+    # admission backpressure + prefix-LRU eviction under pressure).
+    kv_num_pages: int = 0
+    # Disaggregated serving: end-to-end deadline stamped by the router
+    # on the prefill→decode leg (seconds; 0 = no handoff deadline).
+    handoff_timeout_s: float = 0.0
     # Speculative decoding (reference: vLLM speculative_model /
     # num_speculative_tokens): a small draft model proposes tokens, the
     # target model verifies a whole window in one pass. Greedy-only —

@@ -28,8 +28,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ray_tpu.llm import model_runner
+from ray_tpu.llm import kv_pages, model_runner
 from ray_tpu.llm.config import LLMConfig, SamplingParams
+from ray_tpu.llm.kv_pages import KVPageError
 from ray_tpu.llm.tokenizer import load_tokenizer
 from ray_tpu.models import transformer as tfm
 
@@ -64,6 +65,10 @@ class Request:
     trace_ctx: Any = None
     t_add: float = 0.0       # enqueue wall time (queue-wait start)
     t_first: float = 0.0     # first-token wall time (decode start)
+    # Disaggregated serving: the sealed KV-page record produced by a
+    # prefill replica's prefill_detached(). When set, admission installs
+    # the pages via _resume_into instead of running prefill.
+    handoff: "dict | None" = None
 
 
 @dataclasses.dataclass
@@ -139,7 +144,33 @@ class LLMEngine:
             from ray_tpu.llm.pp_runner import PPRunner
 
             self._mr = PPRunner(c, pp)
-        cache = self._mr.init_slot_cache(c, B, self.max_len)
+        # Paged KV (reference: vLLM paged attention; llm/kv_pages.py):
+        # fixed-size pages + per-slot block tables replace the dense
+        # per-slot [max_len] cache. Host-side accounting lives in the
+        # allocator; all scheduling below stays identical except where
+        # pages are allocated/freed.
+        self.page_size = int(getattr(config, "kv_page_size", 0) or 0)
+        self.kv_alloc = None
+        self._page_tables: list[list[int]] = []
+        if self.page_size > 0:
+            if (pp > 1 or int(config.tensor_parallel_size or 1) > 1
+                    or config.resolve_speculative_model() is not None
+                    or config.prefill_chunk):
+                raise ValueError(
+                    "kv_page_size (paged KV) is not supported together "
+                    "with tensor/pipeline parallelism, speculative "
+                    "decoding, or chunked prefill yet")
+            self._max_blocks = -(-self.max_len // self.page_size)
+            n_pages = int(getattr(config, "kv_num_pages", 0) or 0)
+            if n_pages <= 0:
+                n_pages = B * self._max_blocks + 1
+            self.kv_alloc = kv_pages.KVPageAllocator(n_pages,
+                                                     self.page_size)
+            self._page_tables = [[] for _ in range(B)]
+            self._block_tables = np.zeros((B, self._max_blocks), np.int32)
+            cache = kv_pages.init_page_pool(c, n_pages, self.page_size)
+        else:
+            cache = self._mr.init_slot_cache(c, B, self.max_len)
         # Tensor parallelism (reference: vllm_engine_stage.py:646
         # tensor_parallel_size): TPU-natively this is pure PLACEMENT —
         # shard weights megatron-style (models.partition_specs) and the
@@ -304,7 +335,11 @@ class LLMEngine:
         if self.lora_mgr is None:
             return False
         with self._lock:
-            return self.lora_mgr.remove(name)
+            # Quiesce hook: indices still referenced by an in-flight
+            # sequence are retired, not recycled — step() reclaims them
+            # once the last referencing slot finishes (see LoRAManager).
+            return self.lora_mgr.remove(name,
+                                        active=self._active_lora_ixs())
 
     def list_loras(self) -> "list[str]":
         return [] if self.lora_mgr is None else self.lora_mgr.loaded()
@@ -314,6 +349,11 @@ class LLMEngine:
         if not name:
             return 0
         return self.lora_mgr.index_of(name)
+
+    def _active_lora_ixs(self) -> set[int]:
+        """Adapter indices referenced by slots still decoding."""
+        return {int(self.lora_ix[i])
+                for i, s in enumerate(self.slots) if s is not None}
 
     # -- request intake ----------------------------------------------------
 
@@ -363,6 +403,155 @@ class LLMEngine:
 
     def has_unfinished(self) -> bool:
         return bool(self.waiting) or any(s is not None for s in self.slots)
+
+    # -- disaggregated prefill/decode (zero-copy KV handoff) ---------------
+
+    def prefill_detached(self, prompt: "str | list[int]",
+                         sampling_params: "SamplingParams | None" = None,
+                         ) -> dict:
+        """Prefill-pool side of disaggregated serving: run ONE prompt's
+        prefill, sample its first token, and return a self-contained
+        KV-page record — then immediately free the slot and pages. The
+        record's K/V arrays dominate its size, so returning it from a
+        serve replica seals it metadata-only on the data plane (PR 8)
+        and the decode replica pulls the payload p2p/arena — the head
+        connection never carries the KV bytes."""
+        if self.kv_alloc is None:
+            raise ValueError(
+                "prefill_detached requires paged KV (kv_page_size > 0)")
+        sp = sampling_params or self.config.sampling_defaults
+        if sp.response_format is not None:
+            raise ValueError(
+                "guided decoding cannot cross a prefill/decode handoff "
+                "(the JSON automaton state is host-local)")
+        if sp.logprobs > MAX_LOGPROBS:
+            raise ValueError(
+                f"logprobs={sp.logprobs} exceeds the engine cap "
+                f"{MAX_LOGPROBS}")
+        toks = (self.tokenizer.encode(prompt) if isinstance(prompt, str)
+                else list(prompt))
+        toks = toks[: self.max_len - 1]
+        if not toks:
+            raise ValueError("empty prompt")
+        from ray_tpu._private import worker_context
+
+        with self._lock:
+            slot = next((i for i, s in enumerate(self.slots) if s is None),
+                        None)
+            if slot is None:
+                from ray_tpu.exceptions import PendingCallsLimitError
+                raise PendingCallsLimitError(
+                    "no free prefill slot (all "
+                    f"{len(self.slots)} busy)")
+            import uuid as _uuid
+            req = Request(f"pfd-{_uuid.uuid4().hex[:12]}", toks, sp)
+            if self.lora_mgr is not None:
+                req.lora_ix = self._req_lora_ix(req)
+            req.trace_ctx = worker_context.get_trace_context()
+            req.t_add = time.time()
+            try:
+                try:
+                    last_logits = self._prefill_into(slot, toks,
+                                                     lora_ix=req.lora_ix)
+                except KVPageError as e:
+                    # Retryable backpressure, same contract as a full
+                    # admission queue.
+                    from ray_tpu.exceptions import PendingCallsLimitError
+                    raise PendingCallsLimitError(str(e)) from None
+                self.slots[slot] = req
+                if sp.seed is not None:
+                    self.seeds[slot] = np.int32(
+                        np.uint32(sp.seed & 0xFFFFFFFF))
+                else:
+                    self._rng, k = jax.random.split(self._rng)
+                    self.seeds[slot] = np.int32(np.uint32(
+                        int(jax.random.bits(k, dtype=jnp.uint32))))
+                if sp.logprobs > 0:
+                    req.logprobs = []
+                tok = self._sample_host(np.asarray(last_logits), slot, req)
+                req.t_first = time.time()
+                self._emit_span(req, "llm.prefill", req.t_add, req.t_first,
+                                {"prompt_tokens": len(toks),
+                                 "handoff": True})
+                pages = list(self._page_tables[slot])
+                k_pages, v_pages = kv_pages.read_pages(
+                    self.cache, jnp.asarray(np.asarray(pages, np.int32)))
+                return {
+                    "fmt": 1,
+                    "model_id": self.config.model_id,
+                    "page_size": self.page_size,
+                    "prompt_tokens": list(toks),
+                    "first_token": int(tok),
+                    "seed": sp.seed,
+                    "lora": (sp.extra or {}).get("lora") or "",
+                    "logprobs0": (req.logprobs[0] if req.logprobs
+                                  else None),
+                    "sealed_at": time.time(),
+                    "k": np.asarray(k_pages),
+                    "v": np.asarray(v_pages),
+                }
+            finally:
+                self._release_slot(slot)
+
+    def add_handoff_request(self, request_id: str, handoff: dict,
+                            sampling_params: "SamplingParams | None" = None,
+                            ) -> None:
+        """Decode-pool side: enqueue a request whose prompt K/V arrives
+        as a prefill_detached() record. Admission installs the pages
+        (_resume_into) instead of prefilling."""
+        if self.kv_alloc is None:
+            raise ValueError(
+                "handoff decode requires paged KV (kv_page_size > 0)")
+        for key in ("k", "v", "prompt_tokens", "first_token", "page_size"):
+            if key not in handoff:
+                raise ValueError(f"malformed handoff record: missing {key!r}")
+        if int(handoff["page_size"]) != self.page_size:
+            raise ValueError(
+                f"handoff page_size {handoff['page_size']} != engine "
+                f"page_size {self.page_size}")
+        c = self.model_config
+        k = np.asarray(handoff["k"])
+        want = (c.n_layers, k.shape[1], self.page_size, c.kv_heads,
+                c.head_dim)
+        if k.ndim != 5 or k.shape != want:
+            raise ValueError(
+                f"handoff KV shape {k.shape} does not match engine "
+                f"geometry {want}")
+        if k.shape[1] > self._max_blocks:
+            raise ValueError(
+                f"handoff carries {k.shape[1]} pages > engine max "
+                f"{self._max_blocks}")
+        sp = sampling_params or self.config.sampling_defaults
+        if handoff.get("lora") and not (sp.extra or {}).get("lora"):
+            sp = dataclasses.replace(
+                sp, extra={**(sp.extra or {}), "lora": handoff["lora"]})
+        if (sp.extra or {}).get("lora") and self.lora_mgr is None:
+            raise ValueError(
+                f"handoff selects LoRA adapter "
+                f"{(sp.extra or {}).get('lora')!r} but the engine has "
+                "no lora= config")
+        req = Request(request_id, list(handoff["prompt_tokens"]), sp)
+        req.handoff = handoff
+        from ray_tpu._private import worker_context
+
+        req.trace_ctx = worker_context.get_trace_context()
+        req.t_add = time.time()
+        self.waiting.append(req)
+
+    def _resume_into(self, slot: int, req: Request) -> int:
+        """Install a handoff record's KV pages into ``slot`` and return
+        the prefill-side first token. Raises KVPageError (caller
+        requeues) when the pool can't cover the record."""
+        h = req.handoff
+        n = int(np.asarray(h["k"]).shape[1])
+        pages = self._alloc_pages(n)
+        self._page_tables[slot] = pages
+        self._block_tables[slot, :] = 0
+        self._block_tables[slot, :n] = pages
+        self.cache = kv_pages.write_pages(
+            self.cache, jnp.asarray(np.asarray(pages, np.int32)),
+            jnp.asarray(h["k"]), jnp.asarray(h["v"]))
+        return int(h["first_token"])
 
     # -- guided decoding (reference surface: response_format /
     #    json_mode_utils.py; enforcement is native here: ray_tpu.llm.guided)
@@ -491,13 +680,47 @@ class LLMEngine:
                 break
         if not admits:
             return
-        if not batchable or len(admits) == 1:
-            for slot, req in admits:
-                last_logits = self._prefill_into(
-                    slot, req.prompt_tokens, lora_ix=req.lora_ix)
-                self._finish_admit(slot, req, np.asarray(last_logits),
-                                   outputs)
+        if (not batchable or len(admits) == 1
+                or any(r.handoff is not None for _, r in admits)):
+            for i, (slot, req) in enumerate(admits):
+                try:
+                    if req.handoff is not None:
+                        tok0 = self._resume_into(slot, req)
+                        self._finish_admit(slot, req, None, outputs,
+                                           first_tok=tok0)
+                    else:
+                        last_logits = self._prefill_into(
+                            slot, req.prompt_tokens, lora_ix=req.lora_ix)
+                        self._finish_admit(slot, req,
+                                           np.asarray(last_logits),
+                                           outputs)
+                except KVPageError:
+                    # Page pool exhausted even after prefix eviction:
+                    # requeue this and the rest at the queue head —
+                    # finishing sequences will free pages.
+                    self.waiting.extendleft(
+                        r for _, r in reversed(admits[i:]))
+                    return
             return
+        if self.kv_alloc is not None:
+            # Pre-allocate every admit's pages (the batched program needs
+            # complete block tables); exhaustion requeues the remainder.
+            kept: list[tuple[int, Request]] = []
+            for i, (slot, req) in enumerate(admits):
+                try:
+                    pages = self._alloc_pages(
+                        -(-len(req.prompt_tokens) // self.page_size))
+                except KVPageError:
+                    self.waiting.extendleft(
+                        r for _, r in reversed(admits[i:]))
+                    break
+                self._page_tables[slot] = pages
+                self._block_tables[slot, :] = 0
+                self._block_tables[slot, :len(pages)] = pages
+                kept.append((slot, req))
+            admits = kept
+            if not admits:
+                return
         groups: dict[int, list] = {}
         for slot, req in admits:
             S = self._bucket(len(req.prompt_tokens))
@@ -530,19 +753,35 @@ class LLMEngine:
                     aix[j] = r.lora_ix
                 lkw = {"lora": self.lora_mgr.lora_tree(),
                        "lora_ix": jnp.asarray(aix)}
-            logits, self.cache = model_runner.prefill_batch(
-                self.params, jnp.asarray(toks), jnp.asarray(lens),
-                jnp.asarray(slots_arr), self.cache,
-                config=self.model_config, **lkw)
+            if self.kv_alloc is not None:
+                # Pad group members carry out-of-range page ids in EVERY
+                # block-table entry so the page scatter drops them.
+                bts = np.full((N, self._max_blocks),
+                              self.kv_alloc.num_pages, np.int32)
+                for j, (slot, _req) in enumerate(group):
+                    bts[j] = self._block_tables[slot]
+                logits, self.cache = kv_pages.paged_prefill_batch(
+                    self.params, jnp.asarray(toks), jnp.asarray(lens),
+                    jnp.asarray(bts), self.cache,
+                    config=self.model_config, **lkw)
+            else:
+                logits, self.cache = model_runner.prefill_batch(
+                    self.params, jnp.asarray(toks), jnp.asarray(lens),
+                    jnp.asarray(slots_arr), self.cache,
+                    config=self.model_config, **lkw)
             logits_np = np.asarray(logits)
             for j, (slot, req) in enumerate(group):
                 self._finish_admit(slot, req, logits_np[j], outputs)
 
     def _finish_admit(self, slot: int, req: Request,
-                      last_logits: np.ndarray,
-                      outputs: list[RequestOutput]) -> None:
+                      last_logits: "np.ndarray | None",
+                      outputs: list[RequestOutput],
+                      first_tok: "int | None" = None) -> None:
         """Per-request state wiring after its prompt K/V is in ``slot``
-        and its last-token logits are on host."""
+        and its last-token logits are on host. ``first_tok`` short-cuts
+        sampling for handoff resumes: the prefill replica already
+        sampled token 0 (and emitted the llm.prefill span), so the
+        decode side just installs it."""
         sp = req.params
         self.positions[slot] = len(req.prompt_tokens)
         self.slots[slot] = req
@@ -573,7 +812,12 @@ class LLMEngine:
                 np.uint32(int(jax.random.bits(k, dtype=jnp.uint32))))
         if sp.logprobs > 0:
             req.logprobs = []
-        if req.guided is not None:
+        if first_tok is not None:
+            tok = int(first_tok)
+            if (req.logprobs is not None and req.handoff is not None
+                    and req.handoff.get("logprobs0") is not None):
+                req.logprobs.append(req.handoff["logprobs0"])
+        elif req.guided is not None:
             tok = self._guided_sample(req, slot, last_logits)
         else:
             tok = self._sample_host(last_logits, slot, req)
@@ -589,10 +833,13 @@ class LLMEngine:
         self.last_tokens[slot] = tok
         req.generated.append(tok)
         # Queue-wait + prefill up to the first sampled token, into the
-        # request's trace (captured at add_request).
+        # request's trace (captured at add_request). Handoff resumes
+        # skip it — the prefill replica emitted its own llm.prefill span
+        # and the decode-side gap is the llm.handoff span.
         req.t_first = time.time()
-        self._emit_span(req, "llm.prefill", req.t_add, req.t_first,
-                        {"prompt_tokens": len(req.prompt_tokens)})
+        if first_tok is None:
+            self._emit_span(req, "llm.prefill", req.t_add, req.t_first,
+                            {"prompt_tokens": len(req.prompt_tokens)})
         self._maybe_finish(slot, outputs)
 
     def _prefill_into(self, slot: int, toks: list[int],
@@ -600,6 +847,8 @@ class LLMEngine:
         """Write a prompt's K/V into ``slot`` (prefix-cache install +
         chunked or whole-prompt prefill) and return the last-token
         logits [V]."""
+        if self.kv_alloc is not None:
+            return self._prefill_into_paged(slot, toks, lora_ix=lora_ix)
         cfg = self.config
         L = len(toks)
         pos0 = 0
@@ -646,6 +895,141 @@ class LLMEngine:
         if self.draft is not None:
             self._draft_prefill(slot, toks)
         return last_logits
+
+    # -- paged KV (llm/kv_pages.py) ---------------------------------------
+
+    def _alloc_pages(self, n: int) -> list[int]:
+        """Allocate ``n`` pages, LRU-evicting prefix-cache entries under
+        pressure (their pages are only reclaimed once no slot shares
+        them — refcounts — so eviction never corrupts a live sequence)."""
+        while True:
+            try:
+                return self.kv_alloc.alloc(n)
+            except KVPageError:
+                if not self._evict_one_prefix():
+                    raise
+
+    def _evict_one_prefix(self) -> bool:
+        if self.kv_alloc is None or not self._prefix_pool:
+            return False
+        _, pages = self._prefix_pool.popitem(last=False)
+        self.kv_alloc.free(pages)
+        return True
+
+    def _release_slot(self, slot: int) -> None:
+        """Retire a slot: decref its KV pages (paged mode) and clear it.
+        Every path that vacates a slot — normal finish, deadline
+        eviction, _fail_all — must come through here or pages leak."""
+        if self.kv_alloc is not None and self._page_tables[slot]:
+            self.kv_alloc.free(self._page_tables[slot])
+            self._page_tables[slot] = []
+            self._block_tables[slot, :] = 0
+        self.slots[slot] = None
+
+    def _prefill_into_paged(self, slot: int, toks: list[int],
+                            lora_ix: int = 0):
+        """Paged-mode prompt prefill: pin any shared prefix pages, then
+        allocate + fill the tail. Exception-safe: on pool exhaustion all
+        refs taken here are released before the KVPageError propagates
+        (the caller requeues the request)."""
+        cfg = self.config
+        L = len(toks)
+        page = self.page_size
+        pos0 = 0
+        table: list[int] = list(self._page_tables[slot])
+        if not table:
+            if cfg.enable_prefix_caching:
+                pos0, table = self._install_cached_prefix_paged(toks)
+            n_tail = -(-L // page) - len(table)
+            try:
+                tail = self._alloc_pages(n_tail) if n_tail > 0 else []
+            except KVPageError:
+                self.kv_alloc.free(table)  # undo the prefix pins
+                raise
+            table = table + tail
+            self._page_tables[slot] = table
+            self._block_tables[slot, :] = 0
+            self._block_tables[slot, :len(table)] = table
+        bt = jnp.asarray(self._block_tables[slot])
+        lkw = {}
+        if self.lora_mgr is not None:
+            lkw = {"lora": self.lora_mgr.lora_tree(),
+                   "lora_ix": jnp.asarray([lora_ix], jnp.int32)}
+        T = self._max_blocks * page
+        S = min(self._bucket(L - pos0), T - pos0)
+        padded = np.zeros((1, S), np.int32)
+        padded[0, :L - pos0] = toks[pos0:]
+        if pos0 == 0:
+            last_logits, self.cache = kv_pages.paged_prefill(
+                self.params, jnp.asarray(padded), jnp.int32(L), bt,
+                self.cache, config=self.model_config, **lkw)
+        else:
+            # Tail-only prefill past a pinned prefix: pos0 is
+            # page-aligned (installs hand out whole pages), so the tail
+            # lands in freshly allocated pages and the shared ones stay
+            # read-only — copy-on-write by construction.
+            last_logits, self.cache = kv_pages.paged_prefill_at(
+                self.params, jnp.asarray(padded), jnp.int32(L - pos0),
+                jnp.int32(pos0), bt, self.cache,
+                config=self.model_config)
+        if cfg.enable_prefix_caching:
+            self._store_prefix_paged(slot, toks)
+        return last_logits
+
+    def _install_cached_prefix_paged(self, toks: list[int]):
+        """Paged prefix hit = page *pinning*, not a row copy: find the
+        longest page-aligned common prefix in the pool and incref its
+        pages. Returns (covered_tokens, pinned_pages)."""
+        self.prefix_cache_queries += 1
+        page = self.page_size
+        limit = len(toks) - 1
+        best_key, best_d = None, 0
+        for key in self._prefix_pool:
+            d = min(self._common_prefix(key, toks), limit)
+            d = (d // page) * page
+            if d > best_d:
+                best_key, best_d = key, d
+        if best_key is None:
+            return 0, []
+        self._prefix_pool.move_to_end(best_key)
+        pages = list(self._prefix_pool[best_key][: best_d // page])
+        self.kv_alloc.incref(pages)
+        self.prefix_cache_hits += 1
+        return best_d, pages
+
+    def _store_prefix_paged(self, slot: int, toks: list[int]) -> None:
+        """Pin this prompt's leading pages as a prefix-cache entry (the
+        paged counterpart of _store_prefix — no bytes copied, the entry
+        just holds a reference)."""
+        page = self.page_size
+        plen = ((len(toks) - 1) // page) * page
+        if plen < page:
+            return
+        key = tuple(toks[:plen])
+        for existing in list(self._prefix_pool):
+            if len(existing) >= plen:
+                if existing[:plen] == key:
+                    self._prefix_pool.move_to_end(existing)
+                    return  # covered by a (longer) entry's page prefix
+            elif key[:len(existing)] == existing:
+                self.kv_alloc.free(self._prefix_pool.pop(existing))
+        pages = list(self._page_tables[slot][: plen // page])
+        self.kv_alloc.incref(pages)
+        self._prefix_pool[key] = pages
+        while len(self._prefix_pool) > self.config.prefix_cache_entries:
+            _, old = self._prefix_pool.popitem(last=False)
+            self.kv_alloc.free(old)
+
+    def kv_stats(self) -> dict:
+        """Paged-KV + prefix-cache accounting for telemetry/gauges."""
+        out = {
+            "paged": self.kv_alloc is not None,
+            "prefix_hits": self.prefix_cache_hits,
+            "prefix_queries": self.prefix_cache_queries,
+        }
+        if self.kv_alloc is not None:
+            out.update(self.kv_alloc.stats())
+        return out
 
     def _draft_prefill(self, slot: int, toks: list[int]) -> None:
         """Mirror the prompt into the draft model's slot cache so its
@@ -878,7 +1262,7 @@ class LLMEngine:
             self._emit_span(
                 req, "llm.decode", req.t_first or req.t_add, time.time(),
                 {"tokens": len(req.generated), "finish_reason": reason})
-            self.slots[slot] = None
+            self._release_slot(slot)
 
     @staticmethod
     def _emit_span(req: Request, name: str, start: float, end: float,
@@ -909,6 +1293,55 @@ class LLMEngine:
                            **(attributes or {})},
         })
 
+    def _ensure_page_capacity(self, active: list[int],
+                              outputs: list[RequestOutput]) -> list[int]:
+        """Paged mode: this step's KV write for slot b lands at logical
+        position pos[b] — if that crosses into an unallocated page, grow
+        the slot's block table now (on-demand allocation is what lets
+        the pool overcommit). A slot that cannot get a page even after
+        prefix eviction finishes with "length" — bounded, never wedged."""
+        page = self.page_size
+        still: list[int] = []
+        for slot in active:
+            blk = int(self.positions[slot]) // page
+            table = self._page_tables[slot]
+            if blk < len(table):
+                still.append(slot)
+                continue
+            try:
+                new = self._alloc_pages(1)
+            except KVPageError:
+                self._finish_forced(slot, "length", outputs)
+                continue
+            table.append(new[0])
+            self._block_tables[slot, len(table) - 1] = new[0]
+            still.append(slot)
+        return still
+
+    def _finish_forced(self, slot: int, reason: str,
+                       outputs: list[RequestOutput]) -> None:
+        """Finish a slot outside the normal stop rules (page-pool
+        exhaustion): surface what was generated with ``reason``."""
+        req = self.slots[slot]
+        req.finished = True
+        req.finish_reason = reason
+        guided_err = None
+        if req.guided is not None:
+            _ok, guided_err = req.guided.finished_ok()
+        outputs.append(RequestOutput(
+            request_id=req.request_id,
+            token_ids=list(req.generated),
+            text=self.tokenizer.decode(req.generated),
+            finish_reason=reason,
+            num_prompt_tokens=len(req.prompt_tokens),
+            logprobs=req.logprobs,
+            error=guided_err,
+        ))
+        self._emit_span(
+            req, "llm.decode", req.t_first or req.t_add, time.time(),
+            {"tokens": len(req.generated), "finish_reason": reason})
+        self._release_slot(slot)
+
     # -- the engine iteration ---------------------------------------------
 
     def step(self) -> list[RequestOutput]:
@@ -917,6 +1350,8 @@ class LLMEngine:
         outputs: list[RequestOutput] = []
         self._admit(outputs)
         active = [i for i, s in enumerate(self.slots) if s is not None]
+        if self.kv_alloc is not None and active:
+            active = self._ensure_page_capacity(active, outputs)
         if not active:
             return outputs
         if self.draft is not None and all(self._spec_ok[s] for s in active):
@@ -946,16 +1381,29 @@ class LLMEngine:
         if self.lora_mgr is not None:
             lkw = {"lora": self.lora_mgr.lora_tree(),
                    "lora_ix": jnp.asarray(self.lora_ix)}
-        toks, logits, self.cache = self._mr.decode(
-            self.params,
-            jnp.asarray(self.last_tokens),
-            jnp.asarray(self.positions),
-            self.cache,
-            jnp.asarray(self.temps),
-            key,
-            config=self.model_config,
-            **lkw,
-        )
+        if self.kv_alloc is not None:
+            toks, logits, self.cache = kv_pages.paged_decode(
+                self.params,
+                jnp.asarray(self.last_tokens),
+                jnp.asarray(self.positions),
+                jnp.asarray(self._block_tables),
+                self.cache,
+                jnp.asarray(self.temps),
+                key,
+                config=self.model_config,
+                **lkw,
+            )
+        else:
+            toks, logits, self.cache = self._mr.decode(
+                self.params,
+                jnp.asarray(self.last_tokens),
+                jnp.asarray(self.positions),
+                self.cache,
+                jnp.asarray(self.temps),
+                key,
+                config=self.model_config,
+                **lkw,
+            )
         lp_info = None
         if not all(self._plain[s] for s in active):
             # Extended sampling program over this step's logits: replaces
@@ -1013,6 +1461,10 @@ class LLMEngine:
                                             top_vals[slot][:n])},
                 })
             self._maybe_finish(slot, outputs)
+        if self.lora_mgr is not None and self.lora_mgr.has_retired():
+            # Quiesce-complete check: recycle adapter slots whose last
+            # referencing sequence finished this step.
+            self.lora_mgr.reclaim(self._active_lora_ixs())
         return outputs
 
     def _spec_step(self, active: list[int],
@@ -1251,9 +1703,11 @@ class AsyncLLMEngine:
         import collections as _collections
         self.engine.waiting = _collections.deque(
             r for r in self.engine.waiting if r.request_id not in gone)
-        self.engine.slots = [
-            None if (r is not None and r.request_id in gone) else r
-            for r in self.engine.slots]
+        # Through _release_slot, not a bare None: deadline eviction must
+        # free the slot's KV pages (paged mode) or they leak for good.
+        for i, r in enumerate(self.engine.slots):
+            if r is not None and r.request_id in gone:
+                self.engine._release_slot(i)
 
     def snapshot(self) -> dict:
         """Token-level batch view for replica telemetry (Replica
@@ -1265,6 +1719,7 @@ class AsyncLLMEngine:
                 "slots": len(self.engine.slots),
                 "owned": len(self._waiters) + len(self._streams),
                 "evicted_deadline": self._evicted_deadline,
+                "kv": self.engine.kv_stats(),
             }
 
     def _fail_all(self, exc: Exception) -> None:
@@ -1287,9 +1742,9 @@ class AsyncLLMEngine:
         import collections as _collections
         self.engine.waiting = _collections.deque(
             r for r in self.engine.waiting if r.request_id not in owned)
-        self.engine.slots = [
-            None if (r is not None and r.request_id in owned) else r
-            for r in self.engine.slots]
+        for i, r in enumerate(self.engine.slots):
+            if r is not None and r.request_id in owned:
+                self.engine._release_slot(i)
 
     def _push_stream_tokens(self) -> None:
         """lock held. Emit tokens generated since the last step to any
@@ -1351,6 +1806,26 @@ class AsyncLLMEngine:
         fut: concurrent.futures.Future = concurrent.futures.Future()
         with self._lock:
             self.engine.add_request(rid, toks, sampling_params)
+            self._waiters[rid] = fut
+            if deadline is not None:
+                self._deadlines[rid] = deadline
+        self._wake.set()
+        return await asyncio.wrap_future(fut)
+
+    async def generate_from_handoff(self, handoff: dict,
+                                    sampling_params: SamplingParams | None = None,
+                                    deadline: "float | None" = None):
+        """Awaitable continuation of a prefill_detached() record:
+        installs the handed-off KV pages at admission and decodes under
+        the same continuous batcher / deadline eviction as generate()."""
+        import asyncio
+        import concurrent.futures
+        import uuid as _uuid
+
+        rid = f"hreq-{_uuid.uuid4().hex[:12]}"
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+        with self._lock:
+            self.engine.add_handoff_request(rid, handoff, sampling_params)
             self._waiters[rid] = fut
             if deadline is not None:
                 self._deadlines[rid] = deadline

@@ -1,0 +1,332 @@
+"""Paged KV cache: fixed-size pages, block tables, and refcounted sharing.
+
+The dense slot cache (model_runner.init_slot_cache) reserves
+``max_seq_len`` rows per slot up front, so short sequences strand memory
+and a cached prefix must be *copied* into every slot that reuses it.
+This module is the TPU-native analogue of vLLM's paged attention
+(reference: llm/_internal/batch/stages/vllm_engine_stage.py): KV lives
+in one pool of fixed-size pages
+
+    cache = {"k": [L, P, page, KV, Dh], "v": [L, P, page, KV, Dh]}
+
+and each sequence owns an ordered list of page ids — its *block table*.
+XLA still sees static shapes: block tables are fixed-width int32
+``[B, MAXB]`` (MAXB = ceil(max_len / page)), decode gathers the pool by
+table (``pool[tables] -> [B, MAXB*page, ...]``) and scatters the new row
+at ``(tables[b, pos//page], pos % page)``, and every program donates the
+cache exactly like the dense path.
+
+Page 0 is reserved scratch: unused block-table entries are 0, so padded
+or stale writes land there harmlessly — the positional mask
+(``k_pos <= pos[b]``) already guarantees those rows are never attended.
+
+Sharing is copy-on-write by construction: a prefix-cache entry pins its
+pages with a refcount and sharers only ever *read* them — a sequence's
+own writes (chunk tail, decode rows) always target pages past the
+shared prefix, because installs are page-aligned. ``KVPageAllocator``
+does the host-side accounting; it is not thread-safe on its own and
+must be driven under the engine lock.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.llm import model_runner
+from ray_tpu.models.transformer import TransformerConfig, _expand_gqa
+from ray_tpu.ops.attention import dot_product_attention
+from ray_tpu.ops.layers import apply_rope, rope_frequencies
+
+
+class KVPageError(RuntimeError):
+    """KV page pool exhausted (or accounting violated)."""
+
+
+class KVPageAllocator:
+    """Host-side page accounting: free stack + per-page refcounts.
+
+    Pages are shared (prefix cache) by increfing; ``free`` decrefs and
+    only returns a page to the free stack when its count reaches zero.
+    Page 0 is reserved and never allocated."""
+
+    def __init__(self, num_pages: int, page_size: int):
+        if num_pages < 2:
+            raise ValueError("need at least 2 pages (page 0 is reserved)")
+        if page_size < 1:
+            raise ValueError("page_size must be >= 1")
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        # LIFO free stack keeps hot pages hot; page 0 excluded.
+        self._free = list(range(self.num_pages - 1, 0, -1))
+        self._ref = [0] * self.num_pages
+
+    def alloc(self, n: int) -> "list[int]":
+        """Take ``n`` pages (refcount 1 each). Atomic: raises
+        KVPageError without mutating state if the pool can't cover it."""
+        if n < 0:
+            raise ValueError("n must be >= 0")
+        if n > len(self._free):
+            raise KVPageError(
+                f"KV page pool exhausted: need {n}, "
+                f"{len(self._free)} free of {self.num_pages - 1}")
+        pages = [self._free.pop() for _ in range(n)]
+        for p in pages:
+            self._ref[p] = 1
+        return pages
+
+    def incref(self, pages) -> None:
+        for p in pages:
+            if self._ref[p] <= 0:
+                raise KVPageError(f"incref of free page {p}")
+            self._ref[p] += 1
+
+    def free(self, pages) -> None:
+        """Decref; pages hitting zero return to the free stack."""
+        for p in pages:
+            if self._ref[p] <= 0:
+                raise KVPageError(f"double free of page {p}")
+            self._ref[p] -= 1
+            if self._ref[p] == 0:
+                self._free.append(p)
+
+    def refcount(self, page: int) -> int:
+        return self._ref[page]
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def num_in_use(self) -> int:
+        return (self.num_pages - 1) - len(self._free)
+
+    def utilization(self) -> float:
+        total = self.num_pages - 1
+        return (self.num_in_use / total) if total else 0.0
+
+    def stats(self) -> dict:
+        return {
+            "page_size": self.page_size,
+            "pages_total": self.num_pages - 1,
+            "pages_in_use": self.num_in_use,
+            "pages_free": self.num_free,
+            "utilization": self.utilization(),
+        }
+
+
+def init_page_pool(config: TransformerConfig, num_pages: int,
+                   page_size: int):
+    c = config
+    shape = (c.n_layers, num_pages, page_size, c.kv_heads, c.head_dim)
+    return {
+        "k": jnp.zeros(shape, c.compute_dtype),
+        "v": jnp.zeros(shape, c.compute_dtype),
+    }
+
+
+def _paged_rows(new, nb: int, page: int):
+    """[1, S, KV, Dh] chunk K/V -> [nb, page, KV, Dh] page rows (zero
+    padded past S; padding pages map to scratch/overwritten rows)."""
+    _, S, KV, Dh = new.shape
+    rows = new[0]
+    if S < nb * page:
+        rows = jnp.pad(rows, ((0, nb * page - S), (0, 0), (0, 0)))
+    return rows.reshape(nb, page, KV, Dh)
+
+
+@partial(jax.jit, static_argnames=("config",), donate_argnames=("cache",))
+def paged_prefill(params, tokens, true_len, block_table, cache, *,
+                  config: TransformerConfig, lora=None, lora_ix=None):
+    """Whole-prompt prefill [1, S] scattering K/V rows into the pages of
+    ``block_table`` [MAXB] int32. Padding rows past the prompt's pages
+    hit table entries of 0 (scratch). Returns (last_logits [V], cache')."""
+    c = config
+    dt = c.compute_dtype
+    _, S = tokens.shape
+    L, P, page, KV, Dh = cache["k"].shape
+    nb = -(-S // page)
+    positions = jnp.arange(S)
+    x, rope = model_runner.embed_tokens(params, tokens, positions, c, dt)
+
+    def cache_write(cache_arr, new):
+        rows = _paged_rows(new, nb, page)
+        return cache_arr.at[block_table[:nb]].set(rows, mode="drop")
+
+    lora_ctx = None if lora is None else (lora_ix, lora["scales"])
+    body = model_runner.make_prefill_body(c, dt, positions, rope, None,
+                                          cache_write=cache_write,
+                                          lora_ctx=lora_ctx)
+    xs = (params["layers"], cache["k"], cache["v"])
+    if lora is not None:
+        xs = xs + (model_runner._lora_layers_xs(lora),)
+    x, (k_new, v_new) = jax.lax.scan(body, x, xs)
+    xl = jax.lax.dynamic_slice_in_dim(x, true_len - 1, 1, axis=1)
+    last = model_runner._final_logits(xl, params, c, dt)[0, 0]
+    return last, {"k": k_new, "v": v_new}
+
+
+@partial(jax.jit, static_argnames=("config",), donate_argnames=("cache",))
+def paged_prefill_batch(params, tokens, true_lens, block_tables, cache,
+                        *, config: TransformerConfig, lora=None,
+                        lora_ix=None):
+    """Batched whole-prompt prefill over pages: tokens [N, S],
+    block_tables [N, MAXB]. Real rows carry in-range page ids (0-padded
+    past their pages — scratch); PAD group members must carry an
+    OUT-OF-RANGE id (>= P) in every entry so mode="drop" discards them.
+    Returns (last_logits [N, V], cache')."""
+    c = config
+    dt = c.compute_dtype
+    N, S = tokens.shape
+    L, P, page, KV, Dh = cache["k"].shape
+    nb = -(-S // page)
+    positions = jnp.arange(S)
+    x, rope = model_runner.embed_tokens(params, tokens, positions, c, dt)
+
+    def cache_write(cache_arr, new):  # new [N, S, KV, Dh]
+        rows = new
+        if S < nb * page:
+            rows = jnp.pad(rows, ((0, 0), (0, nb * page - S), (0, 0),
+                                  (0, 0)))
+        rows = rows.reshape(N, nb, page, KV, Dh)
+        return cache_arr.at[block_tables[:, :nb]].set(rows, mode="drop")
+
+    lora_ctx = None if lora is None else (lora_ix, lora["scales"])
+    body = model_runner.make_prefill_body(c, dt, positions, rope, None,
+                                          cache_write=cache_write,
+                                          lora_ctx=lora_ctx)
+    xs = (params["layers"], cache["k"], cache["v"])
+    if lora is not None:
+        xs = xs + (model_runner._lora_layers_xs(lora),)
+    x, (k_new, v_new) = jax.lax.scan(body, x, xs)
+    xl = jnp.take_along_axis(
+        x, (true_lens - 1)[:, None, None].astype(jnp.int32), axis=1)
+    last = model_runner._final_logits(xl, params, c, dt)[:, 0]
+    return last, {"k": k_new, "v": v_new}
+
+
+@partial(jax.jit, static_argnames=("config",), donate_argnames=("cache",))
+def paged_prefill_at(params, tokens, true_len, pos0, block_table, cache,
+                     *, config: TransformerConfig):
+    """Continuation prefill over pages: write chunk [1, S] at logical
+    positions [pos0, pos0+S) and attend over the sequence's full paged
+    history (shared prefix pages included — this is what makes a prefix
+    hit a *pin* instead of a copy).
+
+    ``pos0`` MUST be page-aligned (installs hand out whole pages) and
+    the caller must cap S so ``pos0//page + ceil(S/page) <= MAXB`` —
+    dynamic_slice clamps out-of-range starts, which would silently remap
+    the chunk onto earlier pages. Returns (last_logits [V], cache')."""
+    c = config
+    dt = c.compute_dtype
+    _, S = tokens.shape
+    L, P, page, KV, Dh = cache["k"].shape
+    MAXB = block_table.shape[0]
+    T = MAXB * page
+    nb = -(-S // page)
+    positions = pos0 + jnp.arange(S)
+    safe_pos = jnp.minimum(positions, c.max_seq_len - 1)
+
+    x = params["embed"]["tokens"][tokens].astype(dt)
+    if c.arch == "gpt2":
+        x = x + params["embed"]["pos"][safe_pos].astype(dt)
+        rope = None
+    else:
+        rope = rope_frequencies(c.head_dim, c.max_seq_len,
+                                theta=c.rope_theta)
+
+    bt_chunk = jax.lax.dynamic_slice(block_table, (pos0 // page,), (nb,))
+
+    def body(x, xs):
+        lp, kc, vc = xs  # kc/vc: [P, page, KV, Dh]
+        h = model_runner._norm1(x, lp, c)
+        q = jnp.einsum("btd,dhk->bthk", h, lp["attn"]["wq"].astype(dt))
+        k = jnp.einsum("btd,dhk->bthk", h, lp["attn"]["wk"].astype(dt))
+        v = jnp.einsum("btd,dhk->bthk", h, lp["attn"]["wv"].astype(dt))
+        if rope is not None:
+            q = apply_rope(q, *rope, positions=safe_pos)
+            k = apply_rope(k, *rope, positions=safe_pos)
+        kc = kc.at[bt_chunk].set(_paged_rows(k, nb, page), mode="drop")
+        vc = vc.at[bt_chunk].set(_paged_rows(v, nb, page), mode="drop")
+        ks = kc[block_table].reshape(1, T, KV, Dh)
+        vs = vc[block_table].reshape(1, T, KV, Dh)
+        kf, vf = _expand_gqa(ks, vs, c)
+        o = dot_product_attention(q, kf, vf, causal=True,
+                                  q_offset=pos0).astype(dt)
+        o = jnp.einsum("bshk,hkd->bsd", o, lp["attn"]["wo"].astype(dt))
+        x = x + o
+        return x + model_runner._mlp(x, lp, c, dt), (kc, vc)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        body, x, (params["layers"], cache["k"], cache["v"])
+    )
+    xl = jax.lax.dynamic_slice_in_dim(x, true_len - 1, 1, axis=1)
+    last = model_runner._final_logits(xl, params, c, dt)[0, 0]
+    return last, {"k": k_new, "v": v_new}
+
+
+@partial(jax.jit, static_argnames=("config",), donate_argnames=("cache",))
+def paged_decode(params, tokens, positions, block_tables, cache,
+                 temperature, rng, *, config: TransformerConfig,
+                 lora=None, lora_ix=None):
+    """One decode step for all slots over the page pool: tokens [B],
+    positions [B], block_tables [B, MAXB]. The new K/V row scatters to
+    ``(tables[b, pos//page], pos % page)`` *before* the table gather, so
+    a freshly reclaimed page's stale rows are overwritten before the
+    mask could ever reach them (same invariant as the dense path).
+    Returns (sampled_tokens [B] i32, logits [B, V] f32, cache')."""
+    c = config
+    dt = c.compute_dtype
+    B = tokens.shape[0]
+    L, P, page, KV, Dh = cache["k"].shape
+    MAXB = block_tables.shape[1]
+    T = MAXB * page
+    x, rope = model_runner.embed_tokens(params, tokens[:, None],
+                                        positions[:, None], c, dt)
+    rope_tables = None
+    if rope is not None:
+        cos, sin = rope
+        rope_tables = (cos[positions][:, None, None, :],
+                       sin[positions][:, None, None, :])
+    kmask = (jnp.arange(T)[None, :] <= positions[:, None])  # [B, T]
+    barange = jnp.arange(B)
+    phys = block_tables[barange, positions // page]          # [B]
+    rows = positions % page                                  # [B]
+
+    def cache_update(cache_arr, new):  # new [B, KV, Dh]
+        return cache_arr.at[phys, rows].set(new)
+
+    def cache_view(cache_arr):
+        return cache_arr[block_tables].reshape(B, T, KV, Dh)
+
+    lora_ctx = None if lora is None else (lora_ix, lora["scales"])
+    body = model_runner.make_decode_body(c, dt, positions, rope_tables,
+                                         kmask, barange,
+                                         lora_ctx=lora_ctx,
+                                         cache_update=cache_update,
+                                         cache_view=cache_view)
+    xs = (params["layers"], cache["k"], cache["v"])
+    if lora is not None:
+        xs = xs + (model_runner._lora_layers_xs(lora),)
+    x, (k_new, v_new) = jax.lax.scan(body, x, xs)
+    logits = model_runner._final_logits(x, params, c, dt)[:, 0]
+    toks = model_runner.sample_tokens(logits, temperature, rng)
+    return toks, logits, {"k": k_new, "v": v_new}
+
+
+@jax.jit
+def read_pages(cache, pages):
+    """Copy ``pages`` ([n] int32) out of the pool — the payload of a
+    prefill→decode handoff. Returns (k, v) [L, n, page, KV, Dh]."""
+    return cache["k"][:, pages], cache["v"][:, pages]
+
+
+@partial(jax.jit, donate_argnames=("cache",))
+def write_pages(cache, pages, k, v):
+    """Install handed-off K/V pages ([L, n, page, KV, Dh]) at ``pages``."""
+    return {
+        "k": cache["k"].at[:, pages].set(k),
+        "v": cache["v"].at[:, pages].set(v),
+    }

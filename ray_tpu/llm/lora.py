@@ -97,6 +97,11 @@ class LoRAManager:
         self._lock = threading.Lock()
         self._names: dict[str, int] = {}
         self._free = list(range(1, max_adapters))
+        # Indices unloaded while an in-flight sequence still referenced
+        # them: factors stay intact (the sequence keeps computing the
+        # delta it started with) and the slot is only recycled once the
+        # engine confirms quiescence via reclaim().
+        self._retired: set[int] = set()
         self._scales = np.zeros((max_adapters,), np.float32)
         # Stacked factors, zero-initialized (null adapter = index 0).
         self.stacked: dict[str, tuple] = {}
@@ -155,22 +160,51 @@ class LoRAManager:
                                      B.at[ix].set(jnp.asarray(b_pad)))
         return ix
 
-    def remove(self, name: str) -> bool:
-        import jax.numpy as jnp
-
+    def remove(self, name: str, active=()) -> bool:
+        """Unload an adapter. ``active`` is the set of adapter indices
+        still referenced by in-flight sequences (the engine's quiesce
+        hook): a referenced slot is *retired* — name unregistered, but
+        factors kept so those sequences finish with the deltas they
+        started with — and only recycled by a later reclaim(). Without
+        the deferral, remove→add can hand the slot to a new adapter
+        while an in-flight batch row still gathers it, silently swapping
+        its deltas mid-sequence."""
         with self._lock:
             ix = self._names.pop(name, None)
             if ix is None:
                 return False
-            self._free.append(ix)
-            self._scales[ix] = 0.0
-            # Zero the slot so a stale index computes a zero delta.
-            for tgt, (A, B) in self.stacked.items():
-                self.stacked[tgt] = (
-                    A.at[ix].set(jnp.zeros(A.shape[1:], jnp.float32)),
-                    B.at[ix].set(jnp.zeros(B.shape[1:], jnp.float32)),
-                )
+            if ix in active:
+                self._retired.add(ix)
+            else:
+                self._release_slot_locked(ix)
             return True
+
+    def reclaim(self, active=()) -> int:
+        """Recycle retired slots no longer referenced by any in-flight
+        sequence. Called by the engine between steps; returns how many
+        slots were freed."""
+        with self._lock:
+            done = [ix for ix in self._retired if ix not in active]
+            for ix in done:
+                self._retired.discard(ix)
+                self._release_slot_locked(ix)
+            return len(done)
+
+    def has_retired(self) -> bool:
+        with self._lock:
+            return bool(self._retired)
+
+    def _release_slot_locked(self, ix: int) -> None:
+        import jax.numpy as jnp
+
+        self._free.append(ix)
+        self._scales[ix] = 0.0
+        # Zero the slot so a stale index computes a zero delta.
+        for tgt, (A, B) in self.stacked.items():
+            self.stacked[tgt] = (
+                A.at[ix].set(jnp.zeros(A.shape[1:], jnp.float32)),
+                B.at[ix].set(jnp.zeros(B.shape[1:], jnp.float32)),
+            )
 
     # -- program inputs ----------------------------------------------------
 

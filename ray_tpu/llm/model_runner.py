@@ -172,10 +172,23 @@ def _wo_proj(o, lp, ll, lora_ctx, dt):
 
 
 def make_decode_body(c, dt, positions, rope_tables, kmask, barange,
-                     lora_ctx=None):
+                     lora_ctx=None, cache_update=None, cache_view=None):
     """Per-layer scan body for the all-slots decode step: xs = (layer
     params, layer k-cache [B,T,KV,Dh], layer v-cache). ``rope_tables``
-    are the per-slot [B,1,1,Dh/2] cos/sin gathers (None for gpt2)."""
+    are the per-slot [B,1,1,Dh/2] cos/sin gathers (None for gpt2).
+
+    ``cache_update(kc, new [B,KV,Dh]) -> kc'`` overrides where each
+    slot's new row lands (default: ``kc[b, pos[b]]``), and
+    ``cache_view(kc) -> [B, T, KV, Dh]`` overrides how attention sees
+    the cache (default: identity) — together they let the paged runner
+    (llm/kv_pages.py) route the same body through a page pool."""
+    if cache_update is None:
+        def cache_update(cache_arr, new):
+            return cache_arr.at[barange, positions].set(new)
+    if cache_view is None:
+        def cache_view(cache_arr):
+            return cache_arr
+
     def rot(t):  # t: [B, 1, H, Dh]
         cb, sb = rope_tables
         t1, t2 = jnp.split(t.astype(jnp.float32), 2, axis=-1)
@@ -196,9 +209,9 @@ def make_decode_body(c, dt, positions, rope_tables, kmask, barange,
             q, k, v = _lora_qkv(h, q, k, v, ll, lora_ctx, dt)
         if rope_tables is not None:
             q, k = rot(q), rot(k)
-        kc = kc.at[barange, positions].set(k[:, 0])
-        vc = vc.at[barange, positions].set(v[:, 0])
-        kf, vf = _expand_gqa(kc, vc, c)  # [B, T, H, Dh]
+        kc = cache_update(kc, k[:, 0])
+        vc = cache_update(vc, v[:, 0])
+        kf, vf = _expand_gqa(cache_view(kc), cache_view(vc), c)  # [B,T,H,Dh]
         scale = 1.0 / (c.head_dim ** 0.5)
         scores = jnp.einsum("bshk,bthk->bhst", (q * scale).astype(jnp.float32),
                             kf.astype(jnp.float32))  # [B, H, 1, T]

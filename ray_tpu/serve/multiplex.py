@@ -31,6 +31,7 @@ from __future__ import annotations
 import asyncio
 import contextvars
 import functools
+import weakref
 from collections import OrderedDict
 from typing import Callable
 
@@ -85,6 +86,23 @@ class _ModelCache:
                 fut.cancel()
 
 
+class _InstanceRegistry(weakref.WeakKeyDictionary):
+    """Weakref-keyed cache registry that pickles as EMPTY: caches are
+    per-process state (weakrefs and loaded models don't travel), and a
+    deployment class defined in a driver script is shipped to replicas
+    by value — the registry must not drag dead-process caches along."""
+
+    def __reduce__(self):
+        return (self.__class__, ())
+
+
+class _FallbackRegistry(dict):
+    """id()-keyed fallback registry; same pickle-as-empty contract."""
+
+    def __reduce__(self):
+        return (self.__class__, ())
+
+
 def multiplexed(_fn: Callable | None = None, *,
                 max_num_models_per_replica: int = 3):
     """Decorate an async model loader taking a model id (reference:
@@ -96,16 +114,23 @@ def multiplexed(_fn: Callable | None = None, *,
                 "@serve.multiplexed requires an async def loader; got "
                 f"{fn!r}"
             )
-        caches: dict[int, _ModelCache] = {}
+        # Bound loaders key their cache by a weakref to the instance:
+        # an id()-keyed dict is never pruned, so entries leak across
+        # replica instance lifetimes, and a recycled id() can hand a
+        # fresh instance a dead instance's cache. The id-keyed fallback
+        # survives only for unbound loaders (key 0) and instances that
+        # cannot be weak-referenced (e.g. __slots__ without __weakref__).
+        caches: _InstanceRegistry = _InstanceRegistry()
+        fallback_caches: _FallbackRegistry = _FallbackRegistry()
 
         @functools.wraps(fn)
         async def wrapper(*args):
             if len(args) == 2:
                 bound_args, model_id = (args[0],), args[1]
-                key = id(args[0])
+                registry, key = caches, args[0]
             elif len(args) == 1:
                 bound_args, model_id = (), args[0]
-                key = 0
+                registry, key = fallback_caches, 0
             else:
                 raise TypeError(
                     "@serve.multiplexed loaders take exactly one model id"
@@ -115,11 +140,19 @@ def multiplexed(_fn: Callable | None = None, *,
                     "no model id: pass one explicitly or set it on the "
                     "handle via .options(multiplexed_model_id=...)"
                 )
-            cache = caches.setdefault(
-                key, _ModelCache(max_num_models_per_replica))
+            try:
+                cache = registry.get(key)
+            except TypeError:  # non-weakrefable instance
+                registry, key = fallback_caches, id(args[0])
+                cache = registry.get(key)
+            if cache is None:
+                cache = _ModelCache(max_num_models_per_replica)
+                registry[key] = cache
             return await cache.get(fn, bound_args, model_id)
 
         wrapper._ray_tpu_serve_multiplexed = True
+        wrapper._model_caches = caches
+        wrapper._model_caches_fallback = fallback_caches
         return wrapper
 
     if _fn is not None:

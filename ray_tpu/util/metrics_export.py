@@ -199,6 +199,30 @@ def grafana_dashboard(extra_metrics: "list[str] | None" = None) -> dict:
         "short", 12, y))
     next_id += 1
     y += 8
+    # LLM serving row: prefix-cache efficiency + paged-KV pressure per
+    # pool (mono / prefill / decode), decode-queue depth, and the
+    # disaggregation handoff's byte rate (the data-plane transfer
+    # counter's "handoff" path — no dedicated LLM byte gauge exists).
+    panels.append(_panel(
+        next_id, "LLM prefix-cache hit rate / queue depth by pool",
+        [("ray_tpu_llm_prefix_hit_rate", "hit rate"),
+         ("sum by (pool) (ray_tpu_llm_queue_depth)", "queue depth")],
+        "short", 0, y))
+    next_id += 1
+    panels.append(_panel(
+        next_id, "LLM KV pages by pool (in use / free)",
+        [("sum by (pool) (ray_tpu_llm_kv_pages_in_use)", "in use"),
+         ("sum by (pool) (ray_tpu_llm_kv_pages_free)", "free")],
+        "short", 12, y))
+    next_id += 1
+    y += 8
+    panels.append(_panel(
+        next_id, "LLM prefill→decode handoff bytes / s",
+        "sum(rate(ray_tpu_object_bytes_transferred_total"
+        "{path=\"handoff\"}[1m]))",
+        "Bps", 0, y))
+    next_id += 1
+    y += 8
     for i, name in enumerate(extra_metrics or []):
         panels.append(_panel(next_id, name, name, "short",
                              (i % 2) * 12, y + (i // 2) * 8))

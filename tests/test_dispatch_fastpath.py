@@ -711,3 +711,70 @@ def test_serve_handle_zero_per_call_head_frames(cluster):
         assert _direct_push_count(rt) - before_push >= N
     finally:
         serve.shutdown()
+
+
+def test_llm_handoff_zero_payload_bytes_on_head_conn(cluster):
+    """Disaggregation guard: a prefill→decode KV handoff record (a few
+    hundred KB of paged keys/values) never rides the owner's head
+    connection as payload. The prefill replica seals it metadata-only
+    in its arena (>= data_plane_min_bytes) and the decode replica pulls
+    the bytes peer-to-peer when it resolves the argument — the owner
+    only ever moves refs. Asserted at the byte level, same vantage as
+    the large-results guard above, with the driver playing the router's
+    pipelined prefill→decode pattern."""
+    from ray_tpu import serve
+    from ray_tpu.llm import LLMConfig, SamplingParams, build_disaggregated_app
+    from ray_tpu.models import transformer as tfm
+
+    cfg = LLMConfig(
+        model=tfm.tiny(vocab_size=512, max_seq_len=256, dtype="float32"),
+        max_num_seqs=2,
+        max_seq_len=256,
+        prefill_buckets=(256,),
+        kv_page_size=16,
+        sampling_defaults=SamplingParams(max_tokens=2),
+    )
+    # 230 tokens (byte tokenizer) x 2 layers x 4 kv heads x 16 head dim
+    # x fp32 x {k,v} ~= 240 KB per record — well above the 100 KiB
+    # metadata-only seal threshold.
+    prompt = ("zero copy handoff " * 16)[:230]
+    try:
+        serve.run(build_disaggregated_app(cfg, name="llm-fast"),
+                  name="llm-fast", proxy=False)
+        ph = serve.get_deployment_handle("llm-fast-prefill")
+        dh = serve.get_deployment_handle("llm-fast-decode")
+        rt = global_runtime()
+        # Warm both engines' compiles end-to-end, then wait for the
+        # replica routes to flip direct so steady state has no head hop.
+        rec = ph.prefill.remote({"prompt": prompt})
+        r = dh.decode.remote(rec, {"prompt": prompt}).result(timeout_s=600)
+        assert r["object"] == "text_completion"
+        for h in (ph, dh):
+            h._refresh(force=True)
+            _, actor = h._replicas[0]
+            _wait(lambda a=actor: rt._direct.routes.get(a._actor_id)
+                  is not None
+                  and rt._direct.routes[a._actor_id].mode == "direct",
+                  msg="replica route never entered direct mode")
+        hand0 = dh.handoff_stats.remote().result(timeout_s=30)
+
+        N = 3
+        before_bytes = rt.conn.bytes_sent
+        before_inline = rt.conn.sent_kinds.get("put_inline", 0)
+        for _ in range(N):
+            rec = ph.prefill.remote({"prompt": prompt})  # NOT awaited
+            r = dh.decode.remote(rec, {"prompt": prompt}).result(
+                timeout_s=120)
+            assert r["usage"]["completion_tokens"] >= 1
+        sent = rt.conn.bytes_sent - before_bytes
+        hand = dh.handoff_stats.remote().result(timeout_s=30)
+        moved = hand["bytes"] - hand0["bytes"]
+        assert hand["count"] - hand0["count"] == N
+        # Each record really was payload-sized (seal threshold crossed).
+        assert moved // N > 100 * 1024
+        # ...and the records never went inline through the head.
+        assert rt.conn.sent_kinds.get("put_inline", 0) == before_inline
+        assert sent < moved // 20, \
+            f"{sent} head-connection bytes for {moved} bytes of KV handoff"
+    finally:
+        serve.shutdown()

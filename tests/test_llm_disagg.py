@@ -1,0 +1,218 @@
+"""Disaggregated LLM serving end-to-end (reference: vLLM P/D
+disaggregation + ray.llm serve tests): prefill pool seals zero-copy KV
+handoff records, decode pool resumes them under continuous batching,
+per-request LoRA rides serve's model multiplexing, and a SIGKILLed
+decode replica recovers without wedging the app or leaking KV pages on
+the surviving prefill pool."""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.llm import (
+    LLMConfig,
+    SamplingParams,
+    build_disaggregated_app,
+    build_openai_app,
+)
+from ray_tpu.models import transformer as tfm
+
+from chaos_utils import kill_actor_worker
+
+
+def tiny_config(**kw):
+    defaults = dict(
+        model=tfm.tiny(vocab_size=512, max_seq_len=128),
+        max_num_seqs=2,
+        max_seq_len=48,
+        prefill_buckets=(8, 16, 32),
+        kv_page_size=8,
+        lora={"max_adapters": 4, "max_rank": 8},
+        sampling_defaults=SamplingParams(max_tokens=4),
+    )
+    defaults.update(kw)
+    return LLMConfig(**defaults)
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _cluster():
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=8, object_store_memory=128 * 1024 * 1024)
+    yield
+    try:
+        serve.shutdown()
+    finally:
+        ray_tpu.shutdown()
+
+
+@pytest.fixture(scope="module")
+def disagg(_cluster):
+    app = build_disaggregated_app(tiny_config(), name="llm-dis")
+    h = serve.run(app, name="llm-dis", proxy=False)
+    yield h
+
+
+def _wait(pred, timeout=60.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.2)
+    raise TimeoutError(f"timed out waiting for {msg}")
+
+
+def test_completion_roundtrip(disagg):
+    r = disagg.remote({"prompt": "hello", "max_tokens": 3}).result(
+        timeout_s=300)
+    assert r["object"] == "text_completion"
+    assert r["usage"]["completion_tokens"] <= 3
+    assert r["usage"]["prompt_tokens"] > 0
+    assert r["choices"][0]["finish_reason"] in ("stop", "length")
+
+
+def test_chat_roundtrip(disagg):
+    r = disagg.options(method_name="route_request").remote(
+        "/v1/chat/completions",
+        {"messages": [{"role": "user", "content": "hi"}],
+         "max_tokens": 3}).result(timeout_s=300)
+    assert r["object"] == "chat.completion"
+    assert r["choices"][0]["message"]["role"] == "assistant"
+
+
+def test_batch_prompts_merge(disagg):
+    r = disagg.remote({"prompt": ["aa", "bb", "cc"],
+                       "max_tokens": 2}).result(timeout_s=300)
+    assert [c["index"] for c in r["choices"]] == [0, 1, 2]
+    assert r["usage"]["completion_tokens"] <= 6
+
+
+def test_matches_monolithic_greedy(disagg):
+    """The handoff is exact: resumed decode must emit the same greedy
+    tokens as a colocated prefill+decode replica."""
+    mono = serve.run(build_openai_app(tiny_config(), name="llm-dis-mono"),
+                     name="mono", route_prefix="/mono", proxy=False)
+    try:
+        for prompt in ("hello", "the quick brown fox"):
+            rm = mono.remote({"prompt": prompt, "max_tokens": 4}).result(
+                timeout_s=300)
+            rd = disagg.remote({"prompt": prompt, "max_tokens": 4}).result(
+                timeout_s=300)
+            assert rm["choices"][0]["text"] == rd["choices"][0]["text"]
+            assert rm["usage"] == rd["usage"]
+    finally:
+        serve.delete("mono")
+
+
+def _adapter_npz(path, mc) -> str:
+    rng = np.random.default_rng(7)
+    L, d = mc.n_layers, mc.d_model
+    out = mc.n_heads * mc.head_dim
+    np.savez(path,
+             **{"wq.A": rng.standard_normal((L, d, 8)).astype(np.float32) * 4,
+                "wq.B": rng.standard_normal((L, 8, out)).astype(
+                    np.float32) * 4})
+    return str(path)
+
+
+def test_lora_multiplexed_per_request(disagg, tmp_path):
+    """model "tiny:boost" routes through serve multiplexing: the router
+    stamps multiplexed_model_id, the decode replica's @multiplexed
+    loader resolves the adapter, and output diverges from base while
+    plain "tiny" requests stay untouched."""
+    path = _adapter_npz(tmp_path / "boost.npz", tiny_config().model)
+    r = disagg.options(method_name="load_lora_adapter").remote(
+        {"lora_name": "boost", "lora_path": path, "alpha": 64.0}).result(
+        timeout_s=300)
+    assert "boost" in r["loaded"]
+
+    base = disagg.remote({"prompt": "hello world", "max_tokens": 6,
+                          "model": "tiny"}).result(timeout_s=300)
+    boosted = disagg.remote({"prompt": "hello world", "max_tokens": 6,
+                             "model": "tiny:boost"}).result(timeout_s=300)
+    assert boosted["choices"][0]["text"] != base["choices"][0]["text"]
+    assert boosted["model"] == "tiny:boost"
+    # Repeat request: multiplex cache hit, same adapter, same output.
+    again = disagg.remote({"prompt": "hello world", "max_tokens": 6,
+                           "model": "tiny:boost"}).result(timeout_s=300)
+    assert again["choices"][0]["text"] == boosted["choices"][0]["text"]
+    # Base requests still see the exact base model (mixed-batch
+    # isolation of the gathered LoRA delta).
+    rebase = disagg.remote({"prompt": "hello world", "max_tokens": 6,
+                            "model": "tiny"}).result(timeout_s=300)
+    assert rebase["choices"][0]["text"] == base["choices"][0]["text"]
+
+
+def test_unknown_adapter_rejected(disagg):
+    with pytest.raises(Exception, match="lora|adapter"):
+        disagg.remote({"prompt": "x", "max_tokens": 2,
+                       "model": "tiny:nope"}).result(timeout_s=300)
+
+
+def test_stats_and_no_prefill_leak(disagg):
+    st = disagg.options(method_name="stats").remote().result(timeout_s=60)
+    assert st["handoff"]["count"] >= 1
+    assert st["handoff"]["bytes"] > 0
+    assert st["handoff"]["latency_p95_s"] >= st["handoff"]["latency_p50_s"]
+    # Every prefill sealed its record and freed its pages — the prefill
+    # pool idles at zero page occupancy (no prefix cache configured).
+    assert st["prefill"]["kv"]["paged"] is True
+    assert st["prefill"]["kv"]["pages_in_use"] == 0
+    assert st["decode"]["kv"]["pages_in_use"] == 0
+
+
+def test_decode_replica_sigkill_recovers(disagg):
+    """Chaos: SIGKILL the decode replica's worker mid-decode. The
+    controller restarts it, subsequent requests succeed, and the
+    surviving prefill pool leaks no pages for the orphaned handoffs."""
+    dh = serve.get_deployment_handle("llm-dis-decode")
+    dh._refresh(force=True)
+    assert dh._replicas, "decode pool has no replicas"
+    victim_rid, victim_actor = dh._replicas[0]
+
+    # Keep the decode pool busy (max_num_seqs=2 → queueing), then kill.
+    futs = [disagg.remote({"prompt": f"chaos {i}", "max_tokens": 32})
+            for i in range(4)]
+    time.sleep(0.3)
+    assert kill_actor_worker(victim_actor._actor_id)
+    # In-flight outcomes are environment-dependent (handle retry may
+    # replay onto the restarted replica); tolerate either.
+    for f in futs:
+        try:
+            f.result(timeout_s=300)
+        except Exception:  # noqa: BLE001 — death mid-request is the point
+            pass
+
+    def _recovered():
+        # status() alone can race ahead of the controller noticing the
+        # death: insist the victim replica is GONE from the routing set
+        # and a running replacement exists.
+        st = serve.status().get("llm-dis-decode")
+        if not st or st["running_replicas"] < 1:
+            return False
+        dh._refresh(force=True)
+        return victim_rid not in {rid for rid, _ in dh._replicas}
+
+    _wait(_recovered, timeout=120, msg="decode replica restart")
+    # With a single decode replica there is a real unavailability window
+    # (nobody to retry onto while the replacement initializes); the
+    # contract is recovery, not zero downtime — so retry until it lands.
+    deadline = time.monotonic() + 120
+    while True:
+        try:
+            r = disagg.remote({"prompt": "after chaos",
+                               "max_tokens": 3}).result(timeout_s=300)
+            break
+        except Exception:  # noqa: BLE001 — replacement still warming up
+            if time.monotonic() > deadline:
+                raise
+            time.sleep(1.0)
+    assert r["object"] == "text_completion"
+    st = disagg.options(method_name="stats").remote().result(timeout_s=60)
+    assert st["prefill"]["kv"]["pages_in_use"] == 0
+    assert st["decode"]["kv"]["pages_in_use"] == 0

@@ -86,6 +86,39 @@ def test_mixed_batch_isolation(engine):
     assert done["b"] == solo_lora
 
 
+def test_remove_quiesces_inflight_then_recycles(engine):
+    """remove_lora with an in-flight sequence retires the slot: the
+    sequence finishes with exactly the deltas it started with, the slot
+    is NOT handed to the next add_lora while referenced, and it recycles
+    only after the engine's quiesce-complete reclaim (regression:
+    remove→add handed the slot straight to a new adapter, silently
+    swapping an in-flight row's deltas mid-sequence)."""
+    mc = engine.model_config
+    engine.add_lora("qa", _strong_adapter(mc, seed=3), alpha=64.0)
+    want = _run(engine, "quiesce", lora="qa")
+    ix_qa = engine.lora_mgr.index_of("qa")
+
+    engine.add_request("infl", "quiesce", SamplingParams(
+        max_tokens=12, temperature=0.0, extra={"lora": "qa"}))
+    assert engine.step() == []  # prefilled, still in flight
+    assert engine.remove_lora("qa")
+    assert engine.lora_mgr.has_retired()  # referenced → retired, not freed
+    engine.add_lora("qb", _strong_adapter(mc, seed=4), alpha=64.0)
+    assert engine.lora_mgr.index_of("qb") != ix_qa
+
+    outs = []
+    while not outs:
+        outs = engine.step()
+    assert outs[0].token_ids == want  # original deltas to the end
+    # The finishing step ran the quiesce-complete reclaim: the slot is
+    # recyclable now, and the next add gets it back.
+    assert not engine.lora_mgr.has_retired()
+    engine.add_lora("qc", _strong_adapter(mc, seed=5), alpha=64.0)
+    assert engine.lora_mgr.index_of("qc") == ix_qa
+    for name in ("qb", "qc"):
+        assert engine.remove_lora(name)
+
+
 def test_serving_model_suffix_selects_adapter():
     from types import SimpleNamespace
 

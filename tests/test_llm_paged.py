@@ -1,0 +1,287 @@
+"""Paged KV cache (reference: vLLM PagedAttention, TPU-native shape in
+ray_tpu.llm.kv_pages). Correctness bar: the paged engine must be
+bit-identical to the dense per-slot cache under greedy decoding on every
+path (single, batched admission, prefix-cached, handoff resume), and the
+page allocator must never leak — every slot-vacating path (finish,
+deadline eviction, owner-death _fail_all) returns its pages."""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+import pytest
+
+from ray_tpu.exceptions import TaskTimeoutError
+from ray_tpu.llm import LLMConfig, LLMEngine, SamplingParams
+from ray_tpu.llm.kv_pages import KVPageAllocator, KVPageError
+from ray_tpu.models import transformer as tfm
+
+
+def _engine(**kw) -> LLMEngine:
+    kw.setdefault("model", tfm.tiny(vocab_size=512, max_seq_len=256,
+                                    dtype="float32"))
+    kw.setdefault("max_num_seqs", 2)
+    kw.setdefault("max_seq_len", 64)
+    kw.setdefault("prefill_buckets", (16, 32))
+    return LLMEngine(LLMConfig(**kw))
+
+
+def _greedy(engine: LLMEngine, prompts, max_tokens=8):
+    outs = engine.generate(
+        prompts, SamplingParams(max_tokens=max_tokens, temperature=0.0))
+    return [o.token_ids for o in outs]
+
+
+PROMPT = "the quick brown fox jumps over the lazy dog"
+
+
+class TestAllocator:
+    def test_alloc_free_roundtrip(self):
+        a = KVPageAllocator(num_pages=9, page_size=8)
+        assert a.num_free == 8  # page 0 reserved scratch
+        pages = a.alloc(3)
+        assert len(set(pages)) == 3 and 0 not in pages
+        assert a.num_in_use == 3
+        a.free(pages)
+        assert a.num_in_use == 0 and a.num_free == 8
+
+    def test_alloc_exhaustion_is_atomic(self):
+        a = KVPageAllocator(num_pages=5, page_size=8)
+        a.alloc(2)
+        with pytest.raises(KVPageError):
+            a.alloc(3)  # only 2 left
+        assert a.num_in_use == 2  # failed alloc mutated nothing
+
+    def test_refcount_cow_sharing(self):
+        a = KVPageAllocator(num_pages=9, page_size=8)
+        pages = a.alloc(2)
+        a.incref(pages)
+        assert all(a.refcount(p) == 2 for p in pages)
+        a.free(pages)  # first owner drops: still held
+        assert a.num_in_use == 2
+        a.free(pages)  # last owner drops: actually freed
+        assert a.num_in_use == 0
+
+    def test_double_free_raises(self):
+        a = KVPageAllocator(num_pages=5, page_size=8)
+        pages = a.alloc(1)
+        a.free(pages)
+        with pytest.raises(KVPageError):
+            a.free(pages)
+
+    def test_stats(self):
+        a = KVPageAllocator(num_pages=9, page_size=8)
+        a.alloc(4)
+        s = a.stats()
+        assert s["pages_total"] == 8
+        assert s["pages_in_use"] == 4
+        assert s["pages_free"] == 4
+        assert s["page_size"] == 8
+        assert s["utilization"] == pytest.approx(0.5)
+
+
+class TestPagedEquivalence:
+    def test_single_prompt_matches_dense(self):
+        dense = _engine()
+        paged = _engine(kv_page_size=8)
+        assert _greedy(dense, [PROMPT]) == _greedy(paged, [PROMPT])
+        assert paged.kv_alloc.num_in_use == 0  # no leak after finish
+
+    def test_batched_admission_matches_dense(self):
+        prompts = [PROMPT, "hello world", "a completely different prompt",
+                   "short"]
+        dense = _engine(max_num_seqs=4)
+        paged = _engine(max_num_seqs=4, kv_page_size=8)
+        assert _greedy(dense, prompts) == _greedy(paged, prompts)
+        assert paged.kv_alloc.num_in_use == 0
+
+    def test_decode_page_boundary_growth(self):
+        # Decode crossing page boundaries allocates on demand: prompt 9
+        # tokens + 16 generated crosses two 8-token page edges.
+        dense = _engine()
+        paged = _engine(kv_page_size=8)
+        assert (_greedy(dense, ["grow across"], max_tokens=16)
+                == _greedy(paged, ["grow across"], max_tokens=16))
+        assert paged.kv_alloc.num_in_use == 0
+
+    def test_pool_exhaustion_finishes_with_length(self):
+        # 5 usable pages (6 minus scratch) and a prompt needing 2: the
+        # decode outgrows the pool mid-generation and must finish with
+        # "length" (bounded) instead of wedging or leaking.
+        paged = _engine(kv_page_size=8, kv_num_pages=4)
+        outs = paged.generate(
+            [PROMPT[:14]],
+            SamplingParams(max_tokens=40, temperature=0.0, ignore_eos=True))
+        assert outs[0].finish_reason == "length"
+        assert paged.kv_alloc.num_in_use == 0
+
+
+class TestPagedPrefixCache:
+    def test_hit_matches_dense_and_pins_pages(self):
+        dense = _engine()
+        paged = _engine(kv_page_size=8, enable_prefix_caching=True,
+                        prefix_block=8)
+        want = _greedy(dense, [PROMPT])
+        assert _greedy(paged, [PROMPT]) == want  # cold fill
+        assert paged.prefix_cache_hits == 0
+        pinned = paged.kv_alloc.num_in_use
+        assert pinned > 0  # pool entry holds its pages after finish
+        assert _greedy(paged, [PROMPT]) == want  # served from shared pages
+        assert paged.prefix_cache_hits == 1
+        assert paged.kv_alloc.num_in_use == pinned  # no growth, no leak
+
+    def test_shared_pages_are_the_same_physical_pages(self):
+        # COW by construction: installing a cached prefix must hand back
+        # the POOL's page ids (refcount bumped), not copies.
+        paged = _engine(kv_page_size=8, enable_prefix_caching=True,
+                        prefix_block=8)
+        _greedy(paged, [PROMPT], max_tokens=2)
+        (entry_pages,) = [list(e) for e in paged._prefix_pool.values()]
+        toks = paged.tokenizer.encode(PROMPT)
+        with paged._lock:
+            pos0, pages = paged._install_cached_prefix_paged(list(toks))
+        assert pos0 > 0 and pos0 % paged.page_size == 0
+        assert pages == entry_pages[:len(pages)]  # shared, not duplicated
+        assert all(paged.kv_alloc.refcount(p) == 2 for p in pages)
+        paged.kv_alloc.free(pages)  # undo the install's pin
+        assert all(paged.kv_alloc.refcount(p) == 1 for p in pages)
+
+    def test_divergent_tail_matches_dense(self):
+        p1 = PROMPT + " one tail"
+        p2 = PROMPT + " other tl"
+        dense = _engine()
+        paged = _engine(kv_page_size=8, enable_prefix_caching=True,
+                        prefix_block=8)
+        want = _greedy(dense, [p2])
+        _greedy(paged, [p1])
+        assert _greedy(paged, [p2]) == want
+        assert paged.prefix_cache_hits == 1
+
+    def test_lru_eviction_frees_pages(self):
+        paged = _engine(kv_page_size=8, enable_prefix_caching=True,
+                        prefix_block=8, prefix_cache_entries=1)
+        _greedy(paged, [PROMPT], max_tokens=2)
+        _greedy(paged, ["a totally different prompt body"], max_tokens=2)
+        assert len(paged._prefix_pool) == 1
+        # Exactly the surviving entry's pages remain held.
+        held = sum(len(e) for e in paged._prefix_pool.values())
+        assert paged.kv_alloc.num_in_use == held
+
+
+class TestPagedLifecycle:
+    def test_deadline_eviction_frees_pages(self):
+        from ray_tpu.llm.engine import AsyncLLMEngine
+
+        paged = _engine(max_num_seqs=4, kv_page_size=8, max_seq_len=256)
+        aeng = AsyncLLMEngine(paged)
+
+        async def main():
+            live = asyncio.ensure_future(aeng.generate(
+                [1, 2, 3],
+                SamplingParams(max_tokens=48, temperature=0.0,
+                               ignore_eos=True)))
+            doomed = asyncio.ensure_future(aeng.generate(
+                [4, 5, 6],
+                SamplingParams(max_tokens=200, temperature=0.0,
+                               ignore_eos=True),
+                deadline=time.time() + 300))
+            # Catch the doomed request genuinely mid-decode (slot held,
+            # pages allocated), then lapse its deadline by hand: a small
+            # absolute deadline races completion on a warm engine (48
+            # tokens take < 50 ms once JIT caches are hot), which is a
+            # flake, not the eviction path this test pins.
+            rid = None
+            for _ in range(1000):
+                rid = next(iter(aeng._deadlines), None)
+                if rid is not None and any(
+                        s is not None and s.request_id == rid
+                        for s in paged.slots):
+                    break
+                await asyncio.sleep(0.01)
+            assert rid is not None, "doomed request never reached a slot"
+            with aeng._lock:
+                aeng._deadlines[rid] = time.time() - 1.0
+            with pytest.raises(TaskTimeoutError):
+                await asyncio.wait_for(doomed, timeout=30)
+            out = await asyncio.wait_for(live, timeout=120)
+            assert len(out.token_ids) > 0
+
+        asyncio.run(main())
+        assert paged.kv_alloc.num_in_use == 0
+
+    def test_fail_all_frees_pages(self):
+        from ray_tpu.llm.engine import AsyncLLMEngine
+
+        paged = _engine(max_num_seqs=4, kv_page_size=8)
+        aeng = AsyncLLMEngine(paged)
+
+        async def main():
+            sp = SamplingParams(max_tokens=64, temperature=0.0,
+                                ignore_eos=True)
+            fut = asyncio.ensure_future(aeng.generate([7, 8, 9], sp))
+            # Wait until it holds a slot (and pages), then kill everything
+            # the way replica teardown does.
+            for _ in range(200):
+                if any(s is not None for s in paged.slots):
+                    break
+                await asyncio.sleep(0.02)
+            aeng._fail_all(RuntimeError("replica torn down"))
+            with pytest.raises(RuntimeError):
+                await asyncio.wait_for(fut, timeout=30)
+
+        asyncio.run(main())
+        assert paged.kv_alloc.num_in_use == 0
+
+
+class TestHandoffRecord:
+    def test_roundtrip_matches_dense(self):
+        dense = _engine()
+        want = _greedy(dense, [PROMPT])[0]
+
+        sp = SamplingParams(max_tokens=8, temperature=0.0)
+        pre = _engine(kv_page_size=8)
+        dec = _engine(kv_page_size=8)
+        rec = pre.prefill_detached(PROMPT, sp)
+        assert pre.kv_alloc.num_in_use == 0  # record is self-contained
+        dec.add_handoff_request("h0", rec, sp)
+        outs: list = []
+        for _ in range(64):
+            outs += dec.step()
+            if outs:
+                break
+        assert outs[0].token_ids == want
+        assert dec.kv_alloc.num_in_use == 0
+
+    def test_requires_paged(self):
+        dense = _engine()
+        with pytest.raises(ValueError, match="paged"):
+            dense.prefill_detached(PROMPT, SamplingParams(max_tokens=2))
+
+    def test_malformed_record_rejected(self):
+        dec = _engine(kv_page_size=8)
+        with pytest.raises(ValueError, match="missing"):
+            dec.add_handoff_request("h1", {"k": None},
+                                    SamplingParams(max_tokens=2))
+
+    def test_page_size_mismatch_rejected(self):
+        pre = _engine(kv_page_size=8)
+        dec = _engine(kv_page_size=16)
+        rec = pre.prefill_detached(PROMPT, SamplingParams(max_tokens=2))
+        with pytest.raises(ValueError, match="page"):
+            dec.add_handoff_request("h2", rec, SamplingParams(max_tokens=2))
+
+
+class TestPagedConfigGuards:
+    def test_paged_excludes_chunked_prefill(self):
+        with pytest.raises(ValueError, match="paged"):
+            _engine(kv_page_size=8, prefill_chunk=8)
+
+    def test_kv_stats_shape(self):
+        paged = _engine(kv_page_size=8)
+        s = paged.kv_stats()
+        assert s["paged"] is True
+        assert {"pages_total", "pages_in_use", "pages_free",
+                "utilization", "page_size"} <= set(s)
+        dense = _engine()
+        assert dense.kv_stats()["paged"] is False

@@ -29,3 +29,19 @@ def test_scale_envelope_quick():
     assert over["error"] == 0
     assert over["p99_within_2x_slo"]
     assert sv["batching_ab"]["speedup"] > 1.1
+
+    # LLM inference plane (disaggregated vs monolithic A/B, equal
+    # chips, equal offered load). Acceptance is SLO goodput/chip:
+    # completion tokens within the latency SLO at a fixed open-loop
+    # arrival rate (half the slower side's measured capacity). Both
+    # sides attain ~100% on an unloaded box → ratio 1.0; the floor
+    # leaves room for a few SLO misses on a shared CI box.
+    llm = results["llm"]
+    assert llm["mono"]["errors"] == 0
+    assert llm["disagg"]["errors"] == 0
+    assert llm["mono"]["slo_attainment"] > 0.8
+    assert llm["disagg"]["slo_attainment"] > 0.8
+    assert llm["goodput_ratio"] >= 0.9
+    assert llm["handoff"]["count"] >= llm["requests"]
+    assert llm["handoff"]["bytes"] > 0
+    assert llm["disagg"]["prefix_hit_rate"] > 0

@@ -162,6 +162,72 @@ def test_multiplexed_routing_affinity():
         assert len(pids) == 1, (mid, pids)
 
 
+def test_multiplexed_cache_keyed_by_live_instance():
+    """Bound loaders key their per-instance LRU by weakref: instances
+    never share caches, and dropping an instance drops its cache
+    (regression: the id()-keyed registry was never pruned, leaking
+    caches across replica instance lifetimes — and a recycled id()
+    could hand a fresh instance a dead instance's models)."""
+    import asyncio
+    import gc
+
+    from ray_tpu.serve.multiplex import multiplexed
+
+    class Host:
+        @multiplexed(max_num_models_per_replica=2)
+        async def get_model(self, model_id: str):
+            return {"id": model_id, "owner": id(self)}
+
+    async def main():
+        a, b = Host(), Host()
+        assert (await a.get_model("m"))["owner"] == id(a)
+        # b gets its own cache — a shared cache would serve a's model.
+        assert (await b.get_model("m"))["owner"] == id(b)
+        assert len(Host.get_model._model_caches) == 2
+        del a
+        gc.collect()
+        assert len(Host.get_model._model_caches) == 1
+        del b
+        gc.collect()
+        assert len(Host.get_model._model_caches) == 0
+
+    asyncio.run(main())
+
+
+def test_multiplexed_unbound_and_slotted_loaders_fall_back():
+    """Loaders that can't be weakref-keyed still multiplex: unbound
+    functions use the shared fallback slot, __slots__ instances without
+    __weakref__ fall back to id()-keyed caches."""
+    import asyncio
+
+    from ray_tpu.serve.multiplex import multiplexed
+
+    loads = []
+
+    @multiplexed(max_num_models_per_replica=2)
+    async def load(model_id: str):
+        loads.append(model_id)
+        return model_id.upper()
+
+    class Slotted:
+        __slots__ = ()
+
+        @multiplexed(max_num_models_per_replica=2)
+        async def get_model(self, model_id: str):
+            return model_id * 2
+
+    async def main():
+        assert await load("x") == "X"
+        assert await load("x") == "X"  # second hit served from cache
+        assert loads == ["x"]
+        s = Slotted()
+        assert await s.get_model("y") == "yy"
+        assert len(Slotted.get_model._model_caches) == 0
+        assert len(Slotted.get_model._model_caches_fallback) == 1
+
+    asyncio.run(main())
+
+
 def test_multiplexed_requires_model_id():
     @serve.deployment(num_replicas=1)
     class M:
