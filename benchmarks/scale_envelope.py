@@ -450,6 +450,25 @@ def _run_sections(p: dict, results: dict) -> dict:
                  LLM_AB_PROMPT_TOKENS=str(p["llm_ab_prompt_tokens"]),
                  LLM_AB_PREFIX_TOKENS=str(p["llm_ab_prefix_tokens"])),
         timeout=900).decode())
+
+    # 9. Invariant analysis plane: lint the tree the envelope just
+    #    exercised. Records how much surface the cross-checkers cover
+    #    and that the shipped tree is clean (active == 0 modulo the
+    #    written-down baseline) — drift here is an invariant regression
+    #    the same run would otherwise hide.
+    from tools import rtlint
+    from tools.rtlint.core import RepoTree
+    t0 = time.monotonic()
+    active, counts, suppressed = rtlint.run_lint()
+    lint_dt = time.monotonic() - t0
+    results["static_analysis"] = {
+        "modules_scanned": len(RepoTree(rtlint.REPO_ROOT).modules),
+        "passes": counts,
+        "raw_findings": sum(counts.values()),
+        "active_findings": len(active),
+        "baselined": len(suppressed),
+        "elapsed_s": round(lint_dt, 3),
+    }
     return results
 
 

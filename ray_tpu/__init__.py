@@ -11,6 +11,15 @@ Public surface mirrors the reference's `ray` package:
     ray_tpu.get_actor, ray_tpu.util.placement_group, ...
 """
 
+# Arm the lock-order witness FIRST when RAY_TPU_LOCK_WITNESS=1: the
+# factories must be patched before any runtime module allocates its
+# locks. Spawned workers inherit the env var and arm themselves here
+# too. No-op (nothing patched, zero overhead) when the knob is unset.
+from ray_tpu._private import lockwitness as _lockwitness
+
+_lockwitness.maybe_install()
+del _lockwitness
+
 from ray_tpu._version import __version__
 from ray_tpu._private.ids import ObjectRef
 from ray_tpu._private.scheduler import (

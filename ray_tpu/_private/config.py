@@ -342,3 +342,72 @@ class Config:
 
 
 GLOBAL_CONFIG = Config().apply_overrides()
+
+
+# Env-ONLY knobs: RAY_TPU_* names read directly from the environment
+# rather than through the Config table above (they are needed before
+# the table exists, differ per process, or gate import-time machinery).
+# Every such read anywhere in the tree must have an entry here — the
+# invariant checker (`ray-tpu lint`, RT-K001) cross-references this
+# registry against the AST, so an ad-hoc os.environ.get("RAY_TPU_...")
+# fails CI until it is declared. Tags:
+#   "operator" — a real tuning/override surface; must also appear in
+#                the README knob tables (RT-K002).
+#   "internal" — spawn plumbing the runtime sets for its own children
+#                (worker identity, session paths); declared so the
+#                propagation set is auditable, not operator docs.
+ENV_KNOBS = {
+    # -- operator surface --------------------------------------------
+    "RAY_TPU_ADDRESS": (
+        "operator", "head address for ray_tpu.init(); empty starts a "
+        "local cluster"),
+    "RAY_TPU_NATIVE": (
+        "operator", "0 forces pure-Python codec/native fallbacks "
+        "everywhere (read pre-Config at import time)"),
+    "RAY_TPU_DATA_PLANE": (
+        "operator", "0 kills the zero-copy data plane"),
+    "RAY_TPU_HOST_SHM": (
+        "operator", "0 disables same-host shared-memory object reads"),
+    "RAY_TPU_AGENT_STORE": (
+        "operator", "0 disables the node-agent shared object store"),
+    "RAY_TPU_CRASH_DIR": (
+        "operator", "override the per-worker crash-forensics "
+        "directory"),
+    "RAY_TPU_USAGE_STATS_ENABLED": (
+        "operator", "0 disables anonymous usage-stats reporting"),
+    "RAY_TPU_WORKER_PROFILE": (
+        "operator", "1 arms the worker-side profiler at boot"),
+    "RAY_TPU_RESOURCE_SYNC_PERIOD_S": (
+        "operator", "resource-view publish cadence (seconds)"),
+    "RAY_TPU_RESOURCE_SYNC_SNAPSHOT_TICKS": (
+        "operator", "full-snapshot interval in publish ticks"),
+    "RAY_TPU_WORKFLOW_DIR": (
+        "operator", "workflow checkpoint root (default: ~/.ray_tpu)"),
+    "RAY_TPU_LOCK_WITNESS": (
+        "operator", "1 arms the runtime lock-order witness: every "
+        "ray_tpu lock acquisition feeds a live ordering graph and "
+        "cycles (potential deadlocks) are reported with both stacks"),
+    # -- internal spawn plumbing -------------------------------------
+    "RAY_TPU_HEAD": (
+        "internal", "head host:port handed to spawned workers"),
+    "RAY_TPU_WORKER_ID": (
+        "internal", "worker identity stamped by the spawner"),
+    "RAY_TPU_NODE_ID": (
+        "internal", "node identity stamped by the node agent"),
+    "RAY_TPU_NODE_IP": (
+        "internal", "advertised node IP for cross-node channels"),
+    "RAY_TPU_JOB_ID": (
+        "internal", "job attribution for spawned workers"),
+    "RAY_TPU_SESSION_DIR": (
+        "internal", "per-session scratch root (logs, sockets, crash "
+        "files)"),
+    "RAY_TPU_REMOTE": (
+        "internal", "marks a process as a remote (non-head) runtime"),
+    "RAY_TPU_ZYGOTE_EXIT_FILE": (
+        "internal", "zygote supervisor exit-status handoff path"),
+    "RAY_TPU_ZYGOTE_DIRECT_SPAWN_BUDGET": (
+        "internal", "direct-spawn fallback budget while the zygote "
+        "warms"),
+    "RAY_TPU_ZYGOTE_SPAWN_GRACE_S": (
+        "internal", "grace window before spawn deferral trips"),
+}

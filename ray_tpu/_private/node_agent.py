@@ -260,11 +260,18 @@ class NodeAgent:
                 try:
                     # Clock probe (timeline alignment): offset estimate
                     # = (t0+t1)/2 - t_head, i.e. node_clock - head_clock
-                    # assuming symmetric network latency.
+                    # assuming symmetric network latency. The offset is
+                    # wall-clock by contract (it aligns wall timelines
+                    # across nodes); the RTT used to RANK probes is an
+                    # elapsed time and must be monotonic — an NTP step
+                    # mid-probe would otherwise crown a garbage sample
+                    # as the "tightest" round trip.
+                    m0 = _time.monotonic()
                     t0 = _time.time()
                     reply = self.conn.call("clock_sync", {}, timeout=5)
                     t1 = _time.time()
-                    probes.append(((t1 - t0),
+                    m1 = _time.monotonic()
+                    probes.append(((m1 - m0),
                                    (t0 + t1) / 2.0 - reply["t_head"]))
                 except Exception:
                     pass  # older head / transient failure: keep beating

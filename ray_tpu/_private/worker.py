@@ -589,8 +589,11 @@ class Worker:
             # The error objects may have been deferred into the spec
             # buffer by _store_error — without delivering them (owner
             # plane, or head fallback) the caller's get would hang.
+            # Buffered like the sync path: flush_casts runs ~1ms behind
+            # and cast() flushes the buffer first, so ordering against
+            # any later immediate frame is preserved.
             results, sealed_pending = self._route_results(spec)
-            self.runtime.conn.cast(
+            self.runtime.conn.cast_buffered(
                 "task_finished",
                 {"worker_id": self.worker_id, "task_id": spec.task_id,
                  "failed": True,
@@ -701,7 +704,11 @@ class Worker:
                         spec, start, time.time(), failed)}
             if shed is not None:
                 done["shed"] = shed
-            self.runtime.conn.cast("task_finished", done)
+            # Buffered like the sync path (_run_task_guarded): the
+            # async plane was paying a per-call head frame here for no
+            # ordering benefit — cast() flushes the buffer first, so
+            # buffered frames never reorder against immediate ones.
+            self.runtime.conn.cast_buffered("task_finished", done)
         except Exception:
             pass
         self._count_call(spec)

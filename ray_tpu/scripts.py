@@ -529,6 +529,43 @@ def cmd_crashes(args) -> int:
     return 0
 
 
+def cmd_lint(args) -> int:
+    """Invariant analysis (`ray-tpu lint`): the tools/rtlint static
+    cross-checkers — wire-protocol kinds vs dispatch tables, env knobs
+    vs the config registry, lock discipline and lock-order cycles,
+    wall/monotonic clock splits, metric catalog + label cardinality,
+    and the direct-plane head-frame budget. Exit 0 means every
+    invariant holds (modulo the written baseline); findings exit 1
+    with file:line callsites. Catalog: docs/INVARIANTS.md."""
+    import os
+
+    try:
+        from tools.rtlint.__main__ import main as lint_main
+    except ImportError:
+        # running from an installed wheel won't find the repo-root
+        # `tools` package on sys.path; a source checkout will.
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        if not os.path.isdir(os.path.join(root, "tools", "rtlint")):
+            print("ray-tpu lint runs against a source checkout "
+                  "(tools/rtlint is not shipped in wheels)",
+                  file=sys.stderr)
+            return 2
+        sys.path.insert(0, root)
+        from tools.rtlint.__main__ import main as lint_main
+
+    argv: list[str] = []
+    if args.root is not None:
+        argv += ["--root", args.root]
+    if args.baseline is not None:
+        argv += ["--baseline", args.baseline]
+    for name in args.passes or ():
+        argv += ["--pass", name]
+    argv += ["--format", args.format]
+    if args.write_baseline:
+        argv += ["--write-baseline", args.write_baseline]
+    return lint_main(argv)
+
+
 def cmd_health(args) -> int:
     """Overload / retry-plane health view (`ray-tpu health`): pending
     budgets, deadline sheds, admission rejections, memory-pressured
@@ -799,6 +836,20 @@ def main(argv: list[str] | None = None) -> int:
     s.add_argument("--address", required=True)
     s.add_argument("--json", action="store_true")
     s.set_defaults(fn=cmd_health)
+
+    s = sub.add_parser(
+        "lint",
+        help="run the invariant cross-checkers (tools/rtlint): wire "
+             "kinds, env knobs, locks, clocks, metrics, frame budget")
+    s.add_argument("--root", default=None,
+                   help="repo root to lint (default: this checkout)")
+    s.add_argument("--baseline", default=None,
+                   help="baseline.toml path ('' disables)")
+    s.add_argument("--pass", dest="passes", action="append",
+                   metavar="NAME", help="run only this pass (repeatable)")
+    s.add_argument("--format", choices=("text", "json"), default="text")
+    s.add_argument("--write-baseline", metavar="PATH")
+    s.set_defaults(fn=cmd_lint)
 
     s = sub.add_parser("stop", help="stop all agents and the head")
     s.add_argument("--address", required=True)

@@ -12,6 +12,14 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# Arm the lock-order witness for the whole tier-1 run (and, via env
+# inheritance, every worker subprocess the tests spawn). Set before
+# the hermetic re-exec below so it survives the execve; the session
+# fixture at the bottom fails the run if any acquisition-order cycle
+# (potential deadlock) was observed. Opt out with
+# RAY_TPU_LOCK_WITNESS=0.
+os.environ.setdefault("RAY_TPU_LOCK_WITNESS", "1")
+
 import pytest  # noqa: E402
 
 
@@ -262,6 +270,19 @@ def pytest_collection_modifyitems(config, items):
         if not any(m.name in ("slow", "chaos", "scale")
                    for m in item.iter_markers()):
             item.add_marker(pytest.mark.fast)
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _lock_witness_gate():
+    """Fail the session if the armed lock witness saw an acquisition-
+    order cycle anywhere in the run — a potential deadlock even if the
+    wedging interleaving never fired (docs/INVARIANTS.md, RT-L003's
+    dynamic complement)."""
+    yield
+    from ray_tpu._private import lockwitness
+
+    if lockwitness.installed() and lockwitness.cycles():
+        raise AssertionError(lockwitness.report())
 
 
 @pytest.fixture

@@ -1,0 +1,49 @@
+"""rtlint — the invariant analysis plane.
+
+AST-based cross-checkers for the conventions the runtime's planes rest
+on: wire kinds need receivers (and hot ones binary codes), env knobs
+need declarations, locks nest one way, clocks split wall/monotonic by
+contract, metric series are documented with bounded labels, and the
+direct-plane hot paths never send unbuffered head frames.
+
+Run it:
+
+    python -m tools.rtlint            # text report, exit 1 on findings
+    ray-tpu lint                      # same, via the operator CLI
+    ray-tpu lint --format json        # machine-readable
+
+Accepted findings live in ``tools/rtlint/baseline.toml`` with written
+rationales; the tier-1 test (tests/test_static_analysis.py) asserts
+the tree has zero non-baselined findings, so a regression against any
+invariant fails CI with the exact callsite.
+"""
+
+from __future__ import annotations
+
+import os
+
+from tools.rtlint.core import Baseline, Finding, RepoTree, run_passes
+from tools.rtlint.passes import ALL_PASSES
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+BASELINE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "baseline.toml")
+
+
+def run_lint(root: "str | None" = None,
+             baseline_path: "str | None" = None,
+             passes=None):
+    """(active findings, per-pass raw counts, suppressed findings)
+    for the tree at ``root`` (default: this repo)."""
+    root = root or REPO_ROOT
+    if baseline_path is None:
+        baseline_path = BASELINE_PATH
+    baseline = Baseline.load(baseline_path) if baseline_path \
+        else Baseline()
+    instances = [p() for p in (passes or ALL_PASSES)]
+    return run_passes(root, instances, baseline)
+
+
+__all__ = ["run_lint", "run_passes", "Baseline", "Finding", "RepoTree",
+           "ALL_PASSES", "REPO_ROOT", "BASELINE_PATH"]

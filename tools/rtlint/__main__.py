@@ -1,0 +1,72 @@
+"""``python -m tools.rtlint`` — run the invariant cross-checkers.
+
+Exit status: 0 clean (after baseline), 1 findings, 2 usage/parse
+trouble. ``ray-tpu lint`` is the same entry point through the operator
+CLI (ray_tpu/scripts.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    from tools import rtlint
+    from tools.rtlint.core import Baseline
+    from tools.rtlint.passes import ALL_PASSES
+
+    by_name = {p.name: p for p in ALL_PASSES}
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.rtlint",
+        description="ray_tpu invariant analysis (static cross-checks)")
+    ap.add_argument("--root", default=None,
+                    help="repo root to lint (default: this repo)")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline.toml path ('' disables)")
+    ap.add_argument("--pass", dest="passes", action="append",
+                    choices=sorted(by_name),
+                    help="run only this pass (repeatable)")
+    ap.add_argument("--format", choices=("text", "json"),
+                    default="text")
+    ap.add_argument("--write-baseline", metavar="PATH",
+                    help="write a suppression file covering every "
+                         "current finding (placeholder reasons — edit "
+                         "before committing)")
+    args = ap.parse_args(argv)
+
+    passes = ([by_name[n] for n in args.passes] if args.passes
+              else None)
+    t0 = time.monotonic()
+    findings, counts, suppressed = rtlint.run_lint(
+        args.root, baseline_path=args.baseline, passes=passes)
+    elapsed = time.monotonic() - t0
+
+    if args.write_baseline:
+        with open(args.write_baseline, "w", encoding="utf-8") as f:
+            f.write(Baseline.render(findings, "TODO: justify"))
+        print(f"wrote {len(findings)} suppressions to "
+              f"{args.write_baseline}", file=sys.stderr)
+
+    if args.format == "json":
+        print(json.dumps({
+            "findings": [vars(f) for f in findings],
+            "suppressed": len(suppressed),
+            "pass_counts": counts,
+            "elapsed_s": round(elapsed, 3),
+        }, indent=2, sort_keys=True))
+    else:
+        for f in findings:
+            print(f.render())
+        summary = ", ".join(f"{k}={v}" for k, v in sorted(
+            counts.items()))
+        print(f"rtlint: {len(findings)} finding(s), "
+              f"{len(suppressed)} baselined ({summary})",
+              file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,265 @@
+"""rtlint core: the repo snapshot passes run against, findings, baseline.
+
+The runtime's correctness rests on cross-file conventions (wire kinds
+must have receivers, knobs must be declared, locks must nest one way)
+that no single module can check locally. rtlint parses the whole tree
+once into a ``RepoTree`` and hands it to each pass; passes return
+``Finding``s, and ``baseline.toml`` suppresses the ones that are
+understood-and-accepted, each with a written rationale (reference: Ray
+ships the same idea as a wall of CI lint/sanitizer jobs around its C++
+core — here the invariants are Python-visible, so an AST walk is
+enough).
+
+A finding is stable across unrelated edits: the baseline matches on
+(id, path, symbol-or-message-substring), never on line numbers.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import fnmatch
+import os
+
+try:  # 3.11+
+    import tomllib as _toml
+except ImportError:  # pragma: no cover - environment-dependent
+    import tomli as _toml  # type: ignore[no-redef]
+
+# Directories (relative to the repo root) whose .py files the passes
+# scan. Tests and benchmarks are deliberately out of scope: they are
+# allowed to poke internals (seeded-violation fixtures would otherwise
+# trip the very passes they test).
+SCAN_DIRS = ("ray_tpu",)
+SKIP_PARTS = {"__pycache__"}
+
+
+@dataclasses.dataclass
+class Finding:
+    id: str          # e.g. "RT-W001"
+    path: str        # repo-relative, forward slashes
+    line: int
+    message: str
+    symbol: str = ""  # dotted context, e.g. "Gcs._h_submit_task"
+
+    def render(self) -> str:
+        sym = f" [{self.symbol}]" if self.symbol else ""
+        return f"{self.path}:{self.line}: {self.id}{sym} {self.message}"
+
+
+class Module:
+    """One parsed source file."""
+
+    def __init__(self, root: str, relpath: str):
+        self.relpath = relpath.replace(os.sep, "/")
+        with open(os.path.join(root, relpath), "r", encoding="utf-8") as f:
+            self.source = f.read()
+        self.tree = ast.parse(self.source, filename=self.relpath)
+        self.lines = self.source.splitlines()
+
+    @property
+    def name(self) -> str:
+        return os.path.basename(self.relpath)
+
+
+class RepoTree:
+    """The parsed repo: every runtime module plus the doc files the
+    cross-checks validate against (README knob table, observability
+    doc). Parsed once, shared by all passes."""
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        self.modules: list[Module] = []
+        self.errors: list[Finding] = []
+        for scan in SCAN_DIRS:
+            base = os.path.join(self.root, scan)
+            for dirpath, dirnames, filenames in os.walk(base):
+                dirnames[:] = sorted(
+                    d for d in dirnames if d not in SKIP_PARTS)
+                for fn in sorted(filenames):
+                    if not fn.endswith(".py"):
+                        continue
+                    rel = os.path.relpath(
+                        os.path.join(dirpath, fn), self.root)
+                    try:
+                        self.modules.append(Module(self.root, rel))
+                    except SyntaxError as e:
+                        self.errors.append(Finding(
+                            "RT-X001", rel.replace(os.sep, "/"),
+                            e.lineno or 0, f"syntax error: {e.msg}"))
+        self._docs: dict[str, str] = {}
+
+    def module(self, relpath: str) -> "Module | None":
+        for m in self.modules:
+            if m.relpath == relpath:
+                return m
+        return None
+
+    def doc_text(self, relpath: str) -> str:
+        """Text of a non-Python repo file ('' when absent)."""
+        if relpath not in self._docs:
+            p = os.path.join(self.root, relpath)
+            try:
+                with open(p, "r", encoding="utf-8") as f:
+                    self._docs[relpath] = f.read()
+            except OSError:
+                self._docs[relpath] = ""
+        return self._docs[relpath]
+
+
+# ---------------------------------------------------------------------------
+# shared AST helpers
+
+def dotted(node: ast.AST) -> str:
+    """'self._lock' for Attribute/Name chains, '' for anything else."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def const_str(node: ast.AST) -> "str | None":
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def enclosing_symbols(tree: ast.Module) -> "dict[int, str]":
+    """lineno -> dotted enclosing def/class name, for finding symbols."""
+    out: dict[int, str] = {}
+
+    def walk(node: ast.AST, prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                name = f"{prefix}.{child.name}" if prefix else child.name
+                for sub in ast.walk(child):
+                    ln = getattr(sub, "lineno", None)
+                    if ln is not None:
+                        out[ln] = name
+                walk(child, name)
+    walk(tree, "")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# baseline
+
+class Baseline:
+    """baseline.toml: the accepted-findings ledger.
+
+    Entries match on finding id + path glob + (optional) substring of
+    the message or symbol — never line numbers, so refactors that move
+    code don't churn the file. Every entry carries a ``reason``; an
+    entry that matches nothing is itself reported (RT-X002) so the
+    ledger can only shrink.
+
+        [[suppress]]
+        id = "RT-L002"
+        path = "ray_tpu/_private/gcs.py"
+        match = "_h_submit_task"      # optional substring
+        reason = "why this is accepted"
+    """
+
+    def __init__(self, entries: "list[dict] | None" = None,
+                 path: str = ""):
+        self.entries = entries or []
+        self.path = path
+        self.hits = [0] * len(self.entries)
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        if not os.path.exists(path):
+            return cls([], path)
+        with open(path, "rb") as f:
+            data = _toml.load(f)
+        entries = list(data.get("suppress", []))
+        for i, e in enumerate(entries):
+            for key in ("id", "path", "reason"):
+                if not e.get(key):
+                    raise ValueError(
+                        f"{path}: suppress[{i}] missing required "
+                        f"key {key!r}")
+        return cls(entries, path)
+
+    def suppresses(self, f: Finding) -> bool:
+        for i, e in enumerate(self.entries):
+            if e["id"] != f.id:
+                continue
+            if not fnmatch.fnmatch(f.path, e["path"]):
+                continue
+            m = e.get("match")
+            if m and m not in f.message and m not in f.symbol:
+                continue
+            self.hits[i] += 1
+            return True
+        return False
+
+    def unused(self, id_prefixes: "set[str] | None" = None,
+               ) -> "list[Finding]":
+        """Entries that matched nothing. With ``id_prefixes``, only
+        entries whose id belongs to a pass that actually RAN count —
+        a --pass-filtered run must not call the other passes'
+        suppressions stale."""
+        out = []
+        for i, e in enumerate(self.entries):
+            if self.hits[i]:
+                continue
+            if id_prefixes is not None and not any(
+                    e["id"].startswith(p) for p in id_prefixes):
+                continue
+            out.append(Finding(
+                    "RT-X002", self.path or "baseline.toml", 0,
+                    f"stale suppression (id={e['id']} path={e['path']}"
+                    f"{' match=' + e['match'] if e.get('match') else ''})"
+                    " matched no finding — delete it"))
+        return out
+
+    @staticmethod
+    def render(findings: "list[Finding]", reason: str) -> str:
+        """A baseline.toml body suppressing ``findings`` (the
+        --write-baseline escape hatch; each entry still needs a human
+        to replace the placeholder reason)."""
+        chunks = ["# rtlint baseline — each entry documents an accepted",
+                  "# finding. Match is (id, path glob, substring); line",
+                  "# numbers are deliberately not part of the match.",
+                  ""]
+        for f in findings:
+            chunks.append("[[suppress]]")
+            chunks.append(f'id = "{f.id}"')
+            chunks.append(f'path = "{f.path}"')
+            if f.symbol:
+                chunks.append(f'match = "{f.symbol}"')
+            chunks.append(f'reason = "{reason}"')
+            chunks.append("")
+        return "\n".join(chunks)
+
+
+# ---------------------------------------------------------------------------
+# driver
+
+def run_passes(root: str, passes, baseline: "Baseline | None" = None,
+               ) -> "tuple[list[Finding], dict[str, int], list[Finding]]":
+    """Run ``passes`` over the tree at ``root``.
+
+    Returns (active findings, per-pass raw counts, suppressed).
+    Parse errors surface as RT-X001 findings; stale baseline entries
+    as RT-X002.
+    """
+    tree = RepoTree(root)
+    baseline = baseline or Baseline()
+    raw_counts: dict[str, int] = {}
+    active: list[Finding] = list(tree.errors)
+    suppressed: list[Finding] = []
+    for p in passes:
+        found = sorted(p.run(tree), key=lambda f: (f.path, f.line, f.id))
+        raw_counts[p.name] = len(found)
+        for f in found:
+            (suppressed if baseline.suppresses(f) else active).append(f)
+    prefixes = {p.id_prefix for p in passes if getattr(p, "id_prefix", "")}
+    active.extend(baseline.unused(prefixes))
+    return active, raw_counts, suppressed

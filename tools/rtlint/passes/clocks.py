@@ -1,0 +1,170 @@
+"""RT-C: clock-discipline pass.
+
+The runtime uses two clocks with opposite contracts. Cross-node
+ABSOLUTE deadlines (task deadlines shed at every hop, heartbeat
+stamps, trace spans aligned via the NTP-style offset table) must be
+``time.time()``: wall clock is the only clock that means anything on
+another machine. LOCAL elapsed-time measurement (retry backoff,
+timeout loops, phase latencies) must be ``time.monotonic()``: wall
+clock steps under NTP correction, and an elapsed computed from it can
+go negative or jump minutes — a retry loop that waits on a stepped
+wall clock is a hang in production and unreproducible in tests.
+
+The split, as enforced here:
+
+  RT-C001  ``a - b`` where BOTH operands provably come from
+           ``time.time()`` (a direct call, a local assigned exactly
+           ``t = time.time()``, or a self-attribute every assignment
+           of which in the class is ``time.time()``). That expression
+           is an elapsed-time measurement on the wall clock — use
+           ``time.monotonic()`` for both ends.
+  RT-C002  the same subtraction with one wall and one monotonic
+           operand — always a bug, the result is meaningless.
+
+Deadline arithmetic stays invisible to the pass by construction:
+``deadline = time.time() + timeout`` binds the name to a BinOp, not to
+``time.time()``, so ``deadline - time.time()`` (remaining budget) and
+``time.time() >= deadline`` never flag. A wall timestamp that crosses
+a process boundary (e.g. ``body["ts"]``) has unknown provenance and
+never flags either — the pass only claims what it can prove.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.rtlint.core import Finding, RepoTree, dotted, \
+    enclosing_symbols
+
+_WALL = {"time.time"}
+_MONO = {"time.monotonic", "time.perf_counter"}
+
+
+def _time_aliases(t: ast.Module) -> "dict[str, str]":
+    """Canonical 'time.<fn>' spelling for every local alias of the
+    time module's clocks: ``import time as _time`` and
+    ``from time import monotonic as now`` both resolve."""
+    out: dict[str, str] = {}
+    for node in ast.walk(t):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "time":
+                    out[a.asname or "time"] = "time"
+        elif isinstance(node, ast.ImportFrom) and node.module == "time":
+            for a in node.names:
+                out[a.asname or a.name] = f"time.{a.name}"
+    return out
+
+
+def _clock_of_call(node: ast.AST,
+                   aliases: "dict[str, str]") -> "str | None":
+    if not isinstance(node, ast.Call):
+        return None
+    d = dotted(node.func)
+    if "." in d:
+        mod, attr = d.rsplit(".", 1)
+        if aliases.get(mod) == "time":
+            d = f"time.{attr}"
+    else:
+        d = aliases.get(d, d)
+    if d in _WALL:
+        return "wall"
+    if d in _MONO:
+        return "mono"
+    return None
+
+
+class ClocksPass:
+    name = "clocks"
+    id_prefix = "RT-C"
+
+    def run(self, tree: RepoTree) -> "list[Finding]":
+        out: list[Finding] = []
+        for mod in tree.modules:
+            syms = enclosing_symbols(mod.tree)
+            aliases = _time_aliases(mod.tree)
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.ClassDef):
+                    attr_clock = self._attr_provenance(node, aliases)
+                    for item in node.body:
+                        if isinstance(item, (ast.FunctionDef,
+                                             ast.AsyncFunctionDef)):
+                            self._check_fn(mod, item, attr_clock,
+                                           syms, aliases, out)
+                elif isinstance(node, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    # module-level function (class methods are handled
+                    # above with attribute provenance)
+                    if syms.get(node.lineno, "").count(".") == 0:
+                        self._check_fn(mod, node, {}, syms, aliases,
+                                       out)
+        return out
+
+    @staticmethod
+    def _attr_provenance(cls: ast.ClassDef,
+                         aliases: "dict[str, str]") -> "dict[str, str]":
+        """self.X -> clock, for attrs whose every assignment in the
+        class is one clock's bare call."""
+        clocks: dict[str, set] = {}
+        for node in ast.walk(cls):
+            if not isinstance(node, ast.Assign):
+                continue
+            for tgt in node.targets:
+                d = dotted(tgt)
+                if not d.startswith("self."):
+                    continue
+                clocks.setdefault(d, set()).add(
+                    _clock_of_call(node.value, aliases))
+        return {d: next(iter(cs)) for d, cs in clocks.items()
+                if len(cs) == 1 and None not in cs}
+
+    def _check_fn(self, mod, fn, attr_clock, syms, aliases,
+                  out) -> None:
+        local: dict[str, str] = {}
+        # one linear pre-pass for local provenance: t = time.time()
+        for node in ast.walk(fn):
+            if (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                c = _clock_of_call(node.value, aliases)
+                name = node.targets[0].id
+                if c is not None:
+                    # a name rebound across clocks is ambiguous: drop
+                    local[name] = c if local.get(name, c) == c \
+                        else "mixed"
+                elif name in local:
+                    local[name] = "mixed"
+
+        def classify(node: ast.AST) -> "str | None":
+            c = _clock_of_call(node, aliases)
+            if c is not None:
+                return c
+            if isinstance(node, ast.Name):
+                c = local.get(node.id)
+                return c if c in ("wall", "mono") else None
+            d = dotted(node)
+            if d:
+                return attr_clock.get(d)
+            return None
+
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.BinOp)
+                    and isinstance(node.op, ast.Sub)):
+                continue
+            lc, rc = classify(node.left), classify(node.right)
+            if lc is None or rc is None:
+                continue
+            sym = syms.get(node.lineno, "")
+            if lc == "wall" and rc == "wall":
+                out.append(Finding(
+                    "RT-C001", mod.relpath, node.lineno,
+                    "elapsed time computed from time.time() — wall "
+                    "clock steps under NTP; use time.monotonic() for "
+                    "both ends (absolute cross-node deadlines are the "
+                    "only wall-clock arithmetic)", sym))
+            elif {lc, rc} == {"wall", "mono"}:
+                out.append(Finding(
+                    "RT-C002", mod.relpath, node.lineno,
+                    "subtraction mixes time.time() and "
+                    "time.monotonic() operands — the result is "
+                    "meaningless", sym))

@@ -1,0 +1,127 @@
+"""RT-F: head-frame budget pass.
+
+The direct-call plane's whole point is that steady-state dispatch
+costs ZERO per-call head frames: owners push to workers, workers ack
+and seal owner-ward, and the head sees only amortized buffered
+bookkeeping (``cast_buffered`` records coalesce into ~1 frame/ms).
+The runtime guards this dynamically — ``tests/test_dispatch_fastpath``
+counts actual head frames — but only for the paths the tests drive.
+This pass is the static complement: from each function on the direct
+push/ack/seal hot paths, walk the same-module call graph and flag any
+reachable UNBUFFERED send on a head connection.
+
+  RT-F001  ``<head conn>.cast(...)`` or ``.call(...)`` reachable from
+           a hot-path entry — a per-call synchronous head frame (or
+           worse, a blocking round trip) on the path the direct plane
+           exists to keep off the head
+
+``cast_buffered`` is always allowed (that IS the amortization
+mechanism), and sends on peer connections (owner→worker pushes,
+worker→owner seals) are the fast path itself — only receivers whose
+expression is a known head-connection attribute count. Entries and
+head-conn spellings are declared per module below; a new hot-path
+function must be added here when the plane grows (the seeded fixture
+in tests/test_static_analysis.py proves the walk catches transitive
+violations).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.rtlint.core import Finding, RepoTree, dotted, \
+    enclosing_symbols
+
+# module -> (hot-path entry function names, head-connection exprs)
+HOT_PATHS = {
+    "ray_tpu/_private/direct.py": (
+        {"_push", "_drain_route", "submit_actor", "submit_task",
+         "on_worker_msg", "on_resolved", "_seal_shed", "_spec_body"},
+        {"self.rt.conn", "rt.conn"},
+    ),
+    "ray_tpu/_private/worker.py": (
+        {"_on_direct_push", "_dispatch_spec", "_run_task_guarded",
+         "_route_results"},
+        {"self.runtime.conn", "runtime.conn"},
+    ),
+    "ray_tpu/_private/runtime.py": (
+        {"_handle_direct_client", "_store_owned_and_notify"},
+        {"self.conn"},
+    ),
+}
+
+_UNBUFFERED = {"cast", "call"}
+
+
+class FrameBudgetPass:
+    name = "framebudget"
+    id_prefix = "RT-F"
+
+    def run(self, tree: RepoTree) -> "list[Finding]":
+        out: list[Finding] = []
+        for relpath, (entries, head_conns) in HOT_PATHS.items():
+            mod = tree.module(relpath)
+            if mod is None:
+                continue
+            self._check_module(mod, entries, head_conns, out)
+        return out
+
+    def _check_module(self, mod, entries, head_conns, out) -> None:
+        syms = enclosing_symbols(mod.tree)
+        # function name -> (called same-module names, violations)
+        calls: dict[str, set[str]] = {}
+        sites: dict[str, list[tuple[int, str]]] = {}
+        fn_names: set[str] = set()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fn_names.add(node.name)
+
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            name = node.name
+            callees = calls.setdefault(name, set())
+            bad = sites.setdefault(name, [])
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                if (isinstance(sub.func, ast.Name)
+                        and sub.func.id in fn_names):
+                    callees.add(sub.func.id)
+                    continue
+                if not isinstance(sub.func, ast.Attribute):
+                    continue
+                # Only self-calls extend the walk: a generic attribute
+                # whose name collides with a module function (dict
+                # .get vs CoreRuntime.get) is not an edge.
+                if (sub.func.attr in fn_names
+                        and dotted(sub.func.value) == "self"):
+                    callees.add(sub.func.attr)
+                if sub.func.attr in _UNBUFFERED \
+                        and dotted(sub.func.value) in head_conns:
+                    bad.append((sub.lineno, sub.func.attr))
+
+        reported: set[int] = set()
+        for entry in sorted(entries):
+            seen: set[str] = set()
+            stack = [(entry, [entry])]
+            while stack:
+                fn, path = stack.pop()
+                if fn in seen:
+                    continue
+                seen.add(fn)
+                for lineno, attr in sites.get(fn, ()):
+                    if lineno in reported:
+                        continue
+                    reported.add(lineno)
+                    chain = " -> ".join(path)
+                    out.append(Finding(
+                        "RT-F001", mod.relpath, lineno,
+                        f"unbuffered head send .{attr}() on the "
+                        f"direct-plane hot path ({chain}) — use "
+                        f"cast_buffered or move it off the per-call "
+                        f"path", syms.get(lineno, "")))
+                for callee in sorted(calls.get(fn, ())):
+                    if callee not in seen:
+                        stack.append((callee, path + [callee]))

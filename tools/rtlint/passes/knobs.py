@@ -1,0 +1,153 @@
+"""RT-K: config-knob cross-check.
+
+The runtime's config story is a single typed table
+(``_private/config.py`` — the counterpart of the reference's 224-entry
+``RAY_CONFIG`` table), but over twelve PRs a second, invisible config
+surface grew: ``os.environ.get("RAY_TPU_...")`` reads scattered across
+the tree, each inventing a knob nothing declares. Operators can't
+discover them, spawn plumbing can't audit what it must propagate, and
+a typo'd name silently reads the default forever.
+
+The contract this pass enforces: every ``RAY_TPU_*`` env read must
+resolve to either
+
+  * a ``Config`` dataclass field (read as ``RAY_TPU_<FIELD>``), or
+  * an entry in ``config.ENV_KNOBS`` — the declared registry of
+    env-ONLY names, each tagged ``"operator"`` (a real tuning knob:
+    must also appear in the README knob tables) or ``"internal"``
+    (spawn plumbing like RAY_TPU_WORKER_ID: declared and described,
+    but not operator documentation).
+
+Checks:
+  RT-K001  RAY_TPU_* env read with no Config field / ENV_KNOBS entry
+  RT-K002  operator-tagged ENV_KNOBS entry missing from README.md
+  RT-K003  dynamically-composed RAY_TPU_* env read outside the config
+           table reader (unauditable: the name isn't in the source)
+  RT-K004  ENV_KNOBS entry that nothing reads (stale declaration)
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.rtlint.core import (Finding, RepoTree, const_str, dotted,
+                               enclosing_symbols)
+
+CONFIG_PATH = "ray_tpu/_private/config.py"
+
+# Modules allowed to compose env names dynamically: the Config table
+# reader itself (RAY_TPU_{field} for every field is the whole point).
+DYNAMIC_OK = {CONFIG_PATH}
+
+_READ_FUNCS = {"os.environ.get", "os.getenv", "environ.get",
+               "os.environ.pop", "os.environ.setdefault"}
+
+
+def _env_name(node: ast.AST) -> "tuple[str | None, bool]":
+    """(literal env name or None, is_dynamic_ray_tpu_name)."""
+    s = const_str(node)
+    if s is not None:
+        return (s, False) if s.startswith("RAY_TPU_") else (None, False)
+    if isinstance(node, ast.JoinedStr) and node.values:
+        first = node.values[0]
+        s = const_str(first)
+        if s and s.startswith("RAY_TPU_"):
+            return None, True
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        s = const_str(node.left)
+        if s and s.startswith("RAY_TPU_"):
+            return None, True
+    return None, False
+
+
+class KnobsPass:
+    name = "knobs"
+    id_prefix = "RT-K"
+
+    def run(self, tree: RepoTree) -> "list[Finding]":
+        fields, knobs, knob_lines = self._declarations(tree)
+        valid = {f"RAY_TPU_{f.upper()}" for f in fields} | set(knobs)
+        readme = tree.doc_text("README.md")
+        out: list[Finding] = []
+        read_names: set[str] = set()
+
+        for mod in tree.modules:
+            syms = None
+            for node in ast.walk(mod.tree):
+                name = None
+                dyn = False
+                site = node
+                if isinstance(node, ast.Call):
+                    fn = dotted(node.func)
+                    if fn in _READ_FUNCS and node.args:
+                        name, dyn = _env_name(node.args[0])
+                elif (isinstance(node, ast.Subscript)
+                      and isinstance(node.ctx, ast.Load)
+                      and dotted(node.value).endswith("environ")):
+                    name, dyn = _env_name(node.slice)
+                if name is None and not dyn:
+                    continue
+                if syms is None:
+                    syms = enclosing_symbols(mod.tree)
+                sym = syms.get(site.lineno, "")
+                if dyn:
+                    if mod.relpath not in DYNAMIC_OK:
+                        out.append(Finding(
+                            "RT-K003", mod.relpath, site.lineno,
+                            "dynamically-composed RAY_TPU_* env read — "
+                            "the knob name must be a source literal so "
+                            "it can be declared and audited", sym))
+                    continue
+                read_names.add(name)
+                if name not in valid:
+                    out.append(Finding(
+                        "RT-K001", mod.relpath, site.lineno,
+                        f"undeclared env knob {name!r}: add a Config "
+                        f"field or an ENV_KNOBS entry in "
+                        f"{CONFIG_PATH}", sym))
+
+        for name, (kind, _desc) in sorted(knobs.items()):
+            if kind == "operator" and name not in readme:
+                out.append(Finding(
+                    "RT-K002", CONFIG_PATH, knob_lines.get(name, 0),
+                    f"operator knob {name!r} is declared but missing "
+                    f"from the README knob tables", "ENV_KNOBS"))
+            if name not in read_names:
+                out.append(Finding(
+                    "RT-K004", CONFIG_PATH, knob_lines.get(name, 0),
+                    f"ENV_KNOBS entry {name!r} is never read anywhere "
+                    f"— delete the stale declaration", "ENV_KNOBS"))
+        return out
+
+    @staticmethod
+    def _declarations(tree: RepoTree):
+        """(config field names, ENV_KNOBS dict name->(kind, desc),
+        name->lineno) parsed from the config module AST."""
+        mod = tree.module(CONFIG_PATH)
+        fields: set[str] = set()
+        knobs: dict[str, tuple[str, str]] = {}
+        lines: dict[str, int] = {}
+        if mod is None:
+            return fields, knobs, lines
+        for node in mod.tree.body:
+            if isinstance(node, ast.ClassDef) and node.name == "Config":
+                for item in node.body:
+                    if (isinstance(item, ast.AnnAssign)
+                            and isinstance(item.target, ast.Name)):
+                        fields.add(item.target.id)
+            if (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and node.targets[0].id == "ENV_KNOBS"
+                    and isinstance(node.value, ast.Dict)):
+                for k, v in zip(node.value.keys, node.value.values):
+                    name = const_str(k)
+                    if name is None:
+                        continue
+                    kind, desc = "internal", ""
+                    if isinstance(v, ast.Tuple) and len(v.elts) >= 2:
+                        kind = const_str(v.elts[0]) or "internal"
+                        desc = const_str(v.elts[1]) or ""
+                    knobs[name] = (kind, desc)
+                    lines[name] = k.lineno
+        return fields, knobs, lines

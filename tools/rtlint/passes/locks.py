@@ -1,0 +1,278 @@
+"""RT-L: lock-discipline pass.
+
+31 locks across ``_private/`` are ordered only by habit, and the rpc
+layer runs every handler on a per-connection reader thread — the two
+classic distributed-runtime deadlocks (lock-order inversion, blocking
+inside a lock that a reader thread also wants) are one refactor away
+at any time. This pass makes the habits machine-checked:
+
+  RT-L001  bare ``lock.acquire()`` statement not immediately followed
+           by a try whose ``finally`` releases the same lock, or a
+           bare ``lock.release()`` outside any ``finally`` — an
+           exception between the two leaks the lock forever. Use
+           ``with``.
+  RT-L002  blocking operation (``time.sleep``, socket I/O, sync
+           ``conn.call``, ``Future.result``, ``select``) lexically
+           inside a ``with <lock>:`` body — every other thread that
+           wants the lock stalls behind the wait; on a reader-thread
+           handler that is a whole-connection stall.
+  RT-L003  cycle in the statically-extracted lock-order graph. Edges
+           come from lexically nested ``with`` blocks plus one level
+           of same-module call expansion (a ``with A:`` body calling a
+           method whose own body takes B adds A→B). Keys are
+           ``module:object.attr`` so two instances of the same
+           attribute are one node — exactly the granularity the
+           runtime's ordering habit uses.
+
+Lock expressions are recognized by provenance, not by name: any
+attribute/name somewhere assigned ``threading.Lock()`` /
+``threading.RLock()`` / ``threading.Condition(...)`` is a lock;
+``memoryview.release()`` and scheduler ``acquire(node, demand)`` never
+match. The dynamic complement (actual acquisition order, cross-thread)
+is ``_private/lockwitness.py``; this pass is the half that runs
+without executing anything.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.rtlint.core import (Finding, RepoTree, dotted,
+                               enclosing_symbols)
+
+_LOCK_FACTORIES = {"threading.Lock", "threading.RLock",
+                   "threading.Condition", "Lock", "RLock", "Condition"}
+
+# Attribute names that block the calling thread. `.wait` is exempt:
+# Condition.wait RELEASES the lock while waiting (that's its job), and
+# Event.wait under a lock is rare enough to review by hand.
+_BLOCKING_ATTRS = {"sleep", "recv", "recv_into", "recvfrom", "accept",
+                   "connect", "sendall", "result", "select"}
+
+
+def _stmt_lists(node: ast.AST):
+    for field in ("body", "orelse", "finalbody"):
+        stmts = getattr(node, field, None)
+        if isinstance(stmts, list) and stmts \
+                and isinstance(stmts[0], ast.stmt):
+            yield field, stmts
+    for h in getattr(node, "handlers", []) or []:
+        yield "handler", h.body
+
+
+class LocksPass:
+    name = "locks"
+    id_prefix = "RT-L"
+
+    def run(self, tree: RepoTree) -> "list[Finding]":
+        out: list[Finding] = []
+        for mod in tree.modules:
+            lock_names = self._lock_names(mod.tree)
+            if not lock_names:
+                continue
+            syms = enclosing_symbols(mod.tree)
+            self._check_bare(mod, lock_names, syms, out)
+            self._check_blocking(mod, lock_names, syms, out)
+            self._check_order(mod, lock_names, syms, out)
+        return out
+
+    # -- lock census --------------------------------------------------
+
+    @staticmethod
+    def _lock_names(t: ast.Module) -> "set[str]":
+        """Last-segment names of everything assigned a lock factory."""
+        names: set[str] = set()
+        for node in ast.walk(t):
+            if not (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)):
+                continue
+            if dotted(node.value.func) not in _LOCK_FACTORIES:
+                continue
+            for tgt in node.targets:
+                d = dotted(tgt)
+                if d:
+                    names.add(d.rsplit(".", 1)[-1])
+        return names
+
+    @staticmethod
+    def _is_lock(expr: ast.AST, lock_names: "set[str]") -> str:
+        d = dotted(expr)
+        if d and d.rsplit(".", 1)[-1] in lock_names:
+            return d
+        return ""
+
+    # -- RT-L001 ------------------------------------------------------
+
+    def _check_bare(self, mod, lock_names, syms, out) -> None:
+        def lock_method_stmt(stmt, method) -> str:
+            if (isinstance(stmt, ast.Expr)
+                    and isinstance(stmt.value, ast.Call)
+                    and isinstance(stmt.value.func, ast.Attribute)
+                    and stmt.value.func.attr == method):
+                return self._is_lock(stmt.value.func.value, lock_names)
+            return ""
+
+        def releases_in_finally(try_node, lock) -> bool:
+            return any(lock_method_stmt(s, "release") == lock
+                       for s in try_node.finalbody)
+
+        for node in ast.walk(mod.tree):
+            for _field, stmts in _stmt_lists(node):
+                for i, stmt in enumerate(stmts):
+                    lock = lock_method_stmt(stmt, "acquire")
+                    if lock:
+                        nxt = stmts[i + 1] if i + 1 < len(stmts) else None
+                        if not (isinstance(nxt, ast.Try)
+                                and releases_in_finally(nxt, lock)):
+                            out.append(Finding(
+                                "RT-L001", mod.relpath, stmt.lineno,
+                                f"bare {lock}.acquire() without an "
+                                f"immediate try/finally release — use "
+                                f"'with {lock}:'",
+                                syms.get(stmt.lineno, "")))
+                    rel = lock_method_stmt(stmt, "release")
+                    if rel and _field != "finalbody":
+                        out.append(Finding(
+                            "RT-L001", mod.relpath, stmt.lineno,
+                            f"{rel}.release() outside a finally block "
+                            f"— an exception above it leaks the lock",
+                            syms.get(stmt.lineno, "")))
+
+    # -- RT-L002 ------------------------------------------------------
+
+    def _check_blocking(self, mod, lock_names, syms, out) -> None:
+        def walk_under_lock(node):
+            """ast.walk minus nested def/lambda bodies — a closure
+            body runs later, not under the lock."""
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda)):
+                    continue
+                yield child
+                yield from walk_under_lock(child)
+
+        def scan_body(stmts, lock, lineno) -> None:
+            for stmt in stmts:
+                if isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    continue  # a def in the with body runs later
+                for node in walk_under_lock(stmt):
+                    if not (isinstance(node, ast.Call) and isinstance(
+                            node.func, ast.Attribute)):
+                        continue
+                    attr = node.func.attr
+                    recv = dotted(node.func.value)
+                    blocking = (
+                        attr in _BLOCKING_ATTRS
+                        # sync control-plane RPC: a round trip to a
+                        # peer while every other thread queues on the
+                        # lock (conn-shaped receivers only; scheduler
+                        # .call etc. don't match).
+                        or (attr == "call" and "conn" in recv.lower()))
+                    if blocking:
+                        out.append(Finding(
+                            "RT-L002", mod.relpath, node.lineno,
+                            f"blocking op .{attr}() inside 'with "
+                            f"{lock}:' (entered line {lineno}) — move "
+                            f"the wait outside the critical section",
+                            syms.get(node.lineno, "")))
+
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.With):
+                continue
+            for item in node.items:
+                lock = self._is_lock(item.context_expr, lock_names)
+                if lock:
+                    scan_body(node.body, lock, node.lineno)
+
+    # -- RT-L003 ------------------------------------------------------
+
+    def _check_order(self, mod, lock_names, syms, out) -> None:
+        base = mod.name
+        # function name -> set of lock keys acquired anywhere inside
+        fn_locks: dict[str, set[str]] = {}
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                acquired = set()
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.With):
+                        for item in sub.items:
+                            lk = self._is_lock(item.context_expr,
+                                               lock_names)
+                            if lk:
+                                acquired.add(f"{base}:{lk}")
+                fn_locks.setdefault(node.name, set()).update(acquired)
+
+        edges: dict[tuple[str, str], tuple[int, str]] = {}
+
+        def visit(stmts, held: "list[str]") -> None:
+            for stmt in stmts:
+                if isinstance(stmt, ast.With):
+                    keys = []
+                    for item in stmt.items:
+                        lk = self._is_lock(item.context_expr, lock_names)
+                        if lk:
+                            keys.append(f"{base}:{lk}")
+                    for key in keys:
+                        for outer in held:
+                            if outer != key:
+                                edges.setdefault(
+                                    (outer, key),
+                                    (stmt.lineno,
+                                     syms.get(stmt.lineno, "")))
+                    for _f, body in _stmt_lists(stmt):
+                        visit(body, held + keys)
+                    continue
+                if isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    visit(stmt.body, [])
+                    continue
+                if held:
+                    # one-level call expansion: with A held, calling a
+                    # same-module function that takes B is an A→B edge
+                    for node in ast.walk(stmt):
+                        if (isinstance(node, ast.Call)
+                                and isinstance(node.func, ast.Attribute)
+                                and node.func.attr in fn_locks):
+                            for key in fn_locks[node.func.attr]:
+                                for outer in held:
+                                    if outer != key:
+                                        edges.setdefault(
+                                            (outer, key),
+                                            (node.lineno,
+                                             syms.get(node.lineno, "")))
+                for _f, body in _stmt_lists(stmt):
+                    visit(body, held)
+
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                visit(node.body, [])
+
+        # cycle detection over the module's lock-order graph
+        graph: dict[str, set[str]] = {}
+        for (a, b) in edges:
+            graph.setdefault(a, set()).add(b)
+        seen: set[str] = set()
+        reported: set[frozenset] = set()
+
+        def dfs(n: str, stack: "list[str]") -> None:
+            if n in stack:
+                cyc = stack[stack.index(n):] + [n]
+                key = frozenset(cyc)
+                if key not in reported:
+                    reported.add(key)
+                    line, sym = edges.get((cyc[0], cyc[1]), (0, ""))
+                    out.append(Finding(
+                        "RT-L003", mod.relpath, line,
+                        "lock-order cycle: " + " -> ".join(cyc)
+                        + " — two threads taking opposite ends "
+                        "deadlock", sym))
+                return
+            if n in seen:
+                return
+            seen.add(n)
+            for m in graph.get(n, ()):
+                dfs(m, stack + [n])
+
+        for n in sorted(graph):
+            dfs(n, [])

@@ -1,0 +1,185 @@
+"""RT-W: wire-protocol cross-check.
+
+The control plane is held together by string message kinds: a sender
+does ``conn.cast("seal_objects", ...)`` and trusts that SOME peer
+dispatch table has a receiver. Nothing enforced that trust — a typo'd
+or half-removed kind meant the frame arrived, hit no handler, and was
+dropped (or worse: a HOT kind missing its ``wirefmt.KIND_CODES`` entry
+silently fell back to per-frame pickle, eating the binary-wire win
+without failing anything).
+
+This pass extracts, from the AST alone:
+
+  * every kind SENT: the literal first argument of any
+    ``.cast(...)`` / ``.call(...)`` / ``.cast_buffered(...)`` call;
+  * every kind RECEIVED: ``_h_<kind>`` handler methods (the gcs
+    getattr dispatch) plus every string compared against a variable
+    literally named ``kind`` (the worker/node-agent/runtime/direct
+    if-elif dispatch chains) — comparisons, `in`-tuples, and match
+    statements all reduce to Compare nodes;
+  * the ``KIND_CODES`` table from ``_private/wirefmt.py``.
+
+Checks:
+  RT-W001  kind sent somewhere but no dispatch table receives it
+  RT-W002  hot-path kind missing a KIND_CODES binary code
+  RT-W003  KIND_CODES entry that nothing ever sends (dead wire code)
+  RT-W004  KIND_CODES entry with no receiver anywhere
+
+HOT_KINDS is the curated per-call steady-state set: kinds emitted
+once per task on the direct dispatch / seal / ack paths. Amortized
+kinds (lease_grant, rpc_report, actor_direct_*) are deliberately not
+hot: they ship one frame per route/interval, so pickle framing costs
+nothing measurable.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.rtlint.core import (Finding, RepoTree, const_str,
+                               enclosing_symbols)
+
+# Wire kinds are lowercase_words (or the dunder transport kinds).
+# Anything else that reaches a .cast()/.call() first argument is a
+# different API wearing the same method name (memoryview.cast("B")).
+_KIND_RE = re.compile(r"^(__)?[a-z][a-z0-9_]+$")
+
+# Per-call kinds on the direct push/ack/seal steady-state paths. A new
+# kind on those paths must be added BOTH here and to KIND_CODES (the
+# seeded-violation test in tests/test_static_analysis.py proves the
+# pass fires when one half is forgotten).
+HOT_KINDS = frozenset({
+    "direct_push", "direct_ack", "direct_rej",
+    "owner_sealed", "seal_objects", "put_inline",
+    "task_started", "task_finished",
+    "push_task", "submit_task", "submit_actor_task",
+    "cancel_direct", "del_ref", "del_borrow", "add_borrow",
+})
+
+# Kinds consumed below the dispatch tables: the rpc frame demux itself
+# (batch container, call replies) — they never reach a handler chain
+# by design.
+TRANSPORT_KINDS = frozenset({"__cast_batch__", "__reply__"})
+
+_SEND_METHODS = {"cast", "call", "cast_buffered"}
+
+
+class WirePass:
+    name = "wire"
+    id_prefix = "RT-W"
+
+    def run(self, tree: RepoTree) -> "list[Finding]":
+        sent: dict[str, list[tuple[str, int, str]]] = {}
+        received: set[str] = set()
+
+        for mod in tree.modules:
+            syms = None
+            for node in ast.walk(mod.tree):
+                # senders
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in _SEND_METHODS
+                        and node.args):
+                    kind = const_str(node.args[0])
+                    if kind is not None and _KIND_RE.match(kind):
+                        if syms is None:
+                            syms = enclosing_symbols(mod.tree)
+                        sent.setdefault(kind, []).append(
+                            (mod.relpath, node.lineno,
+                             syms.get(node.lineno, "")))
+                # receivers: _h_* handlers
+                if (isinstance(node, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef))
+                        and node.name.startswith("_h_")):
+                    received.add(node.name[3:])
+                # receivers: `kind == "x"` / `kind in ("x", "y")`
+                if isinstance(node, ast.Compare):
+                    names = [n.id for n in (
+                        [node.left] + list(node.comparators))
+                        if isinstance(n, ast.Name)]
+                    if "kind" not in names:
+                        continue
+                    for cmp_node in [node.left] + list(node.comparators):
+                        s = const_str(cmp_node)
+                        if s is not None:
+                            received.add(s)
+                        elif isinstance(cmp_node, (ast.Tuple, ast.List,
+                                                   ast.Set)):
+                            for el in cmp_node.elts:
+                                s = const_str(el)
+                                if s is not None:
+                                    received.add(s)
+
+        kind_codes = self._kind_codes(tree)
+        out: list[Finding] = []
+
+        for kind in sorted(sent):
+            if kind in TRANSPORT_KINDS:
+                continue
+            if kind not in received:
+                path, line, sym = sent[kind][0]
+                out.append(Finding(
+                    "RT-W001", path, line,
+                    f"wire kind {kind!r} is sent here but no dispatch "
+                    f"table receives it (checked _h_* handlers and "
+                    f"kind == ... chains tree-wide)", sym))
+
+        wf = tree.module("ray_tpu/_private/wirefmt.py")
+        if wf is None:
+            # no wire-format module in this tree (seeded fixtures):
+            # there is no KIND_CODES table to cross-check against
+            return out
+        wf_path = wf.relpath
+        for kind in sorted(HOT_KINDS):
+            if kind not in kind_codes:
+                sites = sent.get(kind)
+                path, line, sym = (sites[0] if sites
+                                   else (wf_path, 0, ""))
+                out.append(Finding(
+                    "RT-W002", path, line,
+                    f"hot-path kind {kind!r} has no wirefmt.KIND_CODES "
+                    f"entry — every frame pays a pickle round trip",
+                    sym))
+        for kind, line in sorted(kind_codes.items()):
+            if kind in TRANSPORT_KINDS:
+                continue
+            if kind not in sent:
+                out.append(Finding(
+                    "RT-W003", wf_path, line,
+                    f"KIND_CODES entry {kind!r} is never sent anywhere "
+                    f"— dead wire-protocol surface (codes are append-"
+                    f"only; leave a comment if reserved)", "KIND_CODES"))
+            if kind not in received:
+                out.append(Finding(
+                    "RT-W004", wf_path, line,
+                    f"KIND_CODES entry {kind!r} has no receiver in any "
+                    f"dispatch table", "KIND_CODES"))
+        return out
+
+    @staticmethod
+    def _kind_codes(tree: RepoTree) -> "dict[str, int]":
+        """KIND_CODES keys -> lineno, resolved from the wirefmt AST
+        (string keys plus the _CAST_BATCH name constant)."""
+        mod = tree.module("ray_tpu/_private/wirefmt.py")
+        if mod is None:
+            return {}
+        consts: dict[str, str] = {}
+        out: dict[str, int] = {}
+        for node in mod.tree.body:
+            if (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                tgt = node.targets[0].id
+                s = const_str(node.value)
+                if s is not None:
+                    consts[tgt] = s
+                if tgt == "KIND_CODES" and isinstance(node.value,
+                                                     ast.Dict):
+                    for k in node.value.keys:
+                        s = const_str(k)
+                        if s is None and isinstance(k, ast.Name):
+                            s = consts.get(k.id)
+                        if s is not None:
+                            out[s] = k.lineno
+        return out
