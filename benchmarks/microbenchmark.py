@@ -125,6 +125,7 @@ def main(as_json: bool = False) -> dict:
     ray_tpu.shutdown()
     bench_data_plane(results)
     bench_wire_binary(results)
+    bench_native_loop(results)
     bench_seal_coalescing(results)
     bench_event_overhead(results)
     bench_forensics_overhead(results)
@@ -218,6 +219,53 @@ def bench_wire_binary(results: dict) -> None:
         ray_tpu.shutdown()
     os.environ.pop("RAY_TPU_WIRE_BINARY", None)
     config_mod.GLOBAL_CONFIG.wire_binary = True
+
+
+def bench_native_loop(results: dict) -> None:
+    """Native C event-loop fast lane on/off (RAY_TPU_NATIVE_LOOP): the
+    same depth-512 pipelined actor flood and leased-task flood, once
+    through the C reader/flusher/ack-sink lane and once through the
+    pure-Python loops. Skipped (recorded as the literal string
+    "unavailable") when the box cannot build _evloop.so — then both
+    modes would measure the identical Python lane."""
+    import os
+
+    from ray_tpu._private import config as config_mod, evloop
+
+    if evloop.module() is None:
+        results["native_loop"] = "unavailable"
+        return
+    for mode in ("on", "off"):
+        os.environ["RAY_TPU_NATIVE_LOOP"] = "1" if mode == "on" else "0"
+        config_mod.GLOBAL_CONFIG.native_loop = (mode == "on")
+        ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024 * 1024,
+                     log_to_driver=False)
+
+        @ray_tpu.remote
+        class NEcho:
+            def ping(self, x=None):
+                return x
+
+        actor = NEcho.remote()
+        ray_tpu.get([actor.ping.remote() for _ in range(64)])  # warm
+        timeit(f"actor pipeline depth 512 native_loop {mode}",
+               lambda: ray_tpu.get(
+                   [actor.ping.remote() for _ in range(512)]),
+               512, results=results)
+
+        @ray_tpu.remote
+        def ntask(i):
+            return i
+
+        N = 100
+        ray_tpu.get([ntask.remote(i) for i in range(64)])  # warm leases
+        timeit(f"tasks async native_loop {mode}",
+               lambda: ray_tpu.get([ntask.remote(i) for i in range(N)]),
+               N, results=results)
+        ray_tpu.kill(actor)
+        ray_tpu.shutdown()
+    os.environ.pop("RAY_TPU_NATIVE_LOOP", None)
+    config_mod.GLOBAL_CONFIG.native_loop = True
 
 
 def bench_seal_coalescing(results: dict) -> None:

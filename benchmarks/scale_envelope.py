@@ -430,13 +430,23 @@ def _run_sections(p: dict, results: dict) -> dict:
         "spans_dropped_owner_side": tr.get("spans_dropped_owner_side"),
     }
 
-    # 7. Serving plane: saturation at ~10x overload (successful p99
+    # 7. Native fast lane (PR 14): is the C event loop actually armed
+    #    on this envelope's connections, and what does the steady-state
+    #    direct plane look like through it — a depth-512 pipelined
+    #    actor drain rate plus per-phase p50/p95 pulled from the same
+    #    ray_tpu_phase_* histograms the exporter publishes. A run with
+    #    armed=False (no toolchain, or the kill switch) still records
+    #    the block so round-over-round diffs show WHICH lane produced
+    #    the numbers.
+    results["native_fast_lane"] = _native_fast_lane_section()
+
+    # 8. Serving plane: saturation at ~10x overload (successful p99
     #    stays bounded by the deadline plane while the excess sheds
     #    with TYPED errors), replica scaling 1 -> 2, and the
     #    continuous-vs-fixed batching A/B.
     results["serve"] = _serve_section(p)
 
-    # 8. LLM inference plane: monolithic vs disaggregated prefill/decode
+    # 9. LLM inference plane: monolithic vs disaggregated prefill/decode
     #    pools A/B over the paged-KV engine (equal chips; goodput/chip,
     #    p99, handoff latency/bytes, prefix hit rate, page utilization).
     #    Subprocess like the batching A/B: the bench boots its own
@@ -451,7 +461,7 @@ def _run_sections(p: dict, results: dict) -> dict:
                  LLM_AB_PREFIX_TOKENS=str(p["llm_ab_prefix_tokens"])),
         timeout=900).decode())
 
-    # 9. Invariant analysis plane: lint the tree the envelope just
+    # 10. Invariant analysis plane: lint the tree the envelope just
     #    exercised. Records how much surface the cross-checkers cover
     #    and that the shipped tree is clean (active == 0 modulo the
     #    written-down baseline) — drift here is an invariant regression
@@ -470,6 +480,80 @@ def _run_sections(p: dict, results: dict) -> dict:
         "elapsed_s": round(lint_dt, 3),
     }
     return results
+
+
+def _hist_quantile(h: dict, q: float) -> "float | None":
+    """Linear-interpolated quantile from an exported phase histogram
+    ({boundaries, buckets, sum, count} — util/metrics exposition
+    shape). The open last bucket reports its lower edge (can't
+    interpolate into +inf)."""
+    total = h.get("count") or 0
+    if not total:
+        return None
+    target = q * total
+    bounds = list(h["boundaries"])
+    cum = 0.0
+    for i, c in enumerate(h["buckets"]):
+        if cum + c >= target and c:
+            lo = bounds[i - 1] if i else 0.0
+            if i >= len(bounds):
+                return round(lo, 6)
+            hi = bounds[i]
+            return round(lo + (hi - lo) * (target - cum) / c, 6)
+        cum += c
+    return round(bounds[-1], 6) if bounds else None
+
+
+def _native_fast_lane_section() -> dict:
+    import ray_tpu
+    from ray_tpu._private import evloop
+    from ray_tpu._private.worker_context import global_runtime
+
+    rt = global_runtime()
+    out: dict = {
+        "armed": bool(evloop.lane_enabled()
+                      and rt.conn._native is not None),
+    }
+
+    @ray_tpu.remote
+    class LaneEcho:
+        def ping(self, x=None):
+            return x
+
+    actor = LaneEcho.remote()
+    ray_tpu.get([actor.ping.remote() for _ in range(64)])  # warm
+    depth, waves = 512, 6
+    t0 = time.time()
+    for _ in range(waves):
+        ray_tpu.get([actor.ping.remote() for _ in range(depth)],
+                    timeout=600)
+    dt = time.time() - t0
+    out["pipeline_depth"] = depth
+    out["pipelined_calls_per_s"] = round(depth * waves / dt, 1)
+    # Census AFTER the flood: owner->worker conns are dialed lazily on
+    # first direct dispatch, so counting before would always read 0/0.
+    with rt._owner_conns_lock:
+        owner_native = [c._native is not None
+                        for c in rt._owner_conns.values()]
+    out["owner_conns_native"] = sum(owner_native)
+    out["owner_conns_total"] = len(owner_native)
+    ray_tpu.kill(actor)
+
+    # Per-phase latency through whatever lane is armed: the same
+    # ray_tpu_phase_* histograms the Prometheus exporter publishes,
+    # collapsed to p50/p95 so SCALE.json diffs catch a lane-level
+    # latency regression without a scrape stack.
+    try:
+        snap = rt.conn.call("runtime_stats", {}, timeout=30)
+        out["phase_latency"] = {
+            name: {"p50_s": _hist_quantile(h, 0.5),
+                   "p95_s": _hist_quantile(h, 0.95),
+                   "count": h.get("count")}
+            for name, h in sorted((snap.get("histograms") or {}).items())
+        }
+    except Exception as e:
+        out["phase_latency"] = {"error": str(e)}
+    return out
 
 
 def _serve_section(p: dict) -> dict:

@@ -179,6 +179,18 @@ class Config:
     # flood traffic stops paying per-record framing. Record order is
     # preserved (only adjacent records merge).
     wire_coalesce: bool = True
+    # Native event-loop fast lane (src/eventloop → _evloop.so): a
+    # Connection moves its reader/writer threads and the cast
+    # coalescer into C pthreads that touch Python once per BATCH of
+    # frames. Requires wire_binary; chaos-armed sessions route casts
+    # back through the Python buffer so faultinject matching is
+    # unchanged. 0 (RAY_TPU_NATIVE_LOOP=0) pins today's pure-Python
+    # rpc loop even where the extension compiled.
+    native_loop: bool = True
+    # High-water mark (MiB) for the native lane's send ring — past it,
+    # senders block GIL-free until the writer drains (same 64 MiB
+    # backpressure contract as the Python _SEND_HIGH_WATER_BYTES).
+    evloop_ring_mb: int = 64
     # (RAY_TPU_NATIVE=0 additionally forces the pure-Python codec in
     # place of the _specenc.so C fast lane — read directly from the
     # env in wirefmt.py/native_build.py since it gates extension

@@ -630,7 +630,24 @@ class DirectPlane:
                 return None  # peer gone: head-side recovery owns it
         return None
 
+    def _drain_native_acks(self) -> None:
+        """Fold delivery acks the native readers consumed in C (ack
+        sink, rpc.Connection.set_ack_sink) into the same bookkeeping
+        the Python path uses. Bulk drain: one Python pass per watchdog
+        tick / route_load instead of one wakeup per ack frame."""
+        rt = self.rt
+        lock = getattr(rt, "_owner_conns_lock", None)
+        if lock is None:
+            return
+        with lock:
+            conns = list(rt._owner_conns.values())
+        for c in conns:
+            tids = c.take_native_acks()
+            if tids:
+                self.on_worker_msg("direct_ack", {"task_ids": tids})
+
     def tick(self) -> None:
+        self._drain_native_acks()
         timeout = GLOBAL_CONFIG.direct_resubmit_timeout_s
         now = time.monotonic()
         recover: list = []
@@ -745,6 +762,7 @@ class DirectPlane:
         dead or wedged replica shows up as growing ``unacked`` within
         one ack RTT — long before health probes or the resubmit
         watchdog fire — so routers can deprioritize it immediately."""
+        self._drain_native_acks()
         with self.lock:
             r = self.routes.get(actor_id)
             if r is None:

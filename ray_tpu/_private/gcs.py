@@ -344,6 +344,13 @@ class Head:
         # process.
         self._pending_owner_seals: dict[str, str] = {}
         self._worker_pending_seals: dict[str, set] = {}
+        # Producing spec for each pending ACTOR-task seal. Actor methods
+        # have no lineage entry (single-method reconstruction cannot
+        # honor incarnation ordering), so a seal that dies with the
+        # worker must replay through the actor restart path instead —
+        # this map is what makes that replay possible. Normal tasks
+        # recover via _maybe_reconstruct and are never stashed here.
+        self._pending_seal_specs: dict[str, TaskSpec] = {}
         # Direct-plane completion tombstones: a worker's task_finished
         # can beat the owner's batched task_started (different
         # connections, no ordering) — remember recently-finished ids so
@@ -1894,6 +1901,7 @@ class Head:
             entry = ObjectEntry(object_id, body.get("owner_id", ""))
             self.objects[object_id] = entry
         w = self._pending_owner_seals.pop(object_id, None)
+        self._pending_seal_specs.pop(object_id, None)
         if w is not None:
             s = self._worker_pending_seals.get(w)
             if s:
@@ -2432,6 +2440,7 @@ class Head:
                 entry.owner_id, []).append(entry.object_id)
         self.objects.pop(entry.object_id, None)
         w = self._pending_owner_seals.pop(entry.object_id, None)
+        self._pending_seal_specs.pop(entry.object_id, None)
         if w is not None:
             s = self._worker_pending_seals.get(w)
             if s:
@@ -2917,6 +2926,14 @@ class Head:
             if len(self._early_finished_fifo) > 65536:
                 self._early_finished.discard(
                     self._early_finished_fifo.popleft())
+        if spec is not None and spec.actor_id is not None:
+            # Remember who produced each still-unconfirmed actor seal:
+            # if this worker dies before the owner confirms, the death
+            # handler replays the spec on the restarted incarnation
+            # (actor methods have no lineage for _maybe_reconstruct).
+            for sp in body.get("sealed_pending") or ():
+                if sp["object_id"] in self._pending_owner_seals:
+                    self._pending_seal_specs[sp["object_id"]] = spec
         if spec is not None:
             t = self.tasks.get(spec.task_id)
             if t:
@@ -5223,14 +5240,37 @@ class Head:
             # would flip a resurrected sibling back to LOST and enqueue
             # the same spec again (double execution, budget double-
             # charged).
+            # Actor-task seals take a different road: no lineage entry
+            # (see _pending_seal_specs), so the producing spec rejoins
+            # the in-flight set and replays on the restarted
+            # incarnation under the same max_task_retries budget — the
+            # at-least-once contract already covering calls that died
+            # mid-execution covers calls whose RESULT died in the
+            # send buffer too. Dedup by task id: a multi-return method
+            # has every return id in the pending set but must requeue
+            # once.
             doomed_seals = []
+            doomed_replay = []
+            replay_tids = set()
+            actor_alive = (rec.actor_id is not None
+                           and (a := self.actors.get(rec.actor_id))
+                           is not None and a.state != "DEAD")
             for oid in self._worker_pending_seals.pop(rec.worker_id, ()):
                 self._pending_owner_seals.pop(oid, None)
+                spec = self._pending_seal_specs.pop(oid, None)
                 e = self.objects.get(oid)
-                if e is not None and e.state == CREATING:
-                    e.state = LOST
-                    e.location = None
-                    doomed_seals.append(oid)
+                if e is None or e.state != CREATING:
+                    continue
+                if spec is not None and actor_alive:
+                    # Leave the entry CREATING: the replayed attempt
+                    # (or _fail_task, budget exhausted) re-seals it.
+                    if spec.task_id not in replay_tids:
+                        replay_tids.add(spec.task_id)
+                        doomed_replay.append(spec)
+                    continue
+                e.state = LOST
+                e.location = None
+                doomed_seals.append(oid)
             for oid in doomed_seals:
                 if not self._maybe_reconstruct(oid):
                     self._seal_error(
@@ -5242,7 +5282,8 @@ class Head:
             inflight = list(rec.inflight.values())
             rec.inflight = {}
             if rec.actor_id is not None:
-                self._handle_actor_worker_death(rec, inflight)
+                self._handle_actor_worker_death(
+                    rec, inflight + doomed_replay)
             else:
                 for spec in inflight:
                     if spec.retries_used < spec.max_retries:

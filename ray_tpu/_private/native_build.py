@@ -32,13 +32,14 @@ _TARGETS = ("libobjstore.so", "libsched.so", "libchannel.so",
 
 
 def _targets() -> tuple:
-    """_specenc.so (CPython extension) joins the target set only where
-    the Python dev headers exist — its make rule skips otherwise, and
-    treating it as required would flag every build stale forever."""
+    """CPython extensions (_specenc.so, _evloop.so) join the target set
+    only where the Python dev headers exist — their make rules skip
+    otherwise, and treating them as required would flag every build
+    stale forever."""
     import shutil
 
     if shutil.which("python3-config"):
-        return _TARGETS + ("_specenc.so",)
+        return _TARGETS + ("_specenc.so", "_evloop.so")
     return _TARGETS
 
 

@@ -27,7 +27,7 @@ from concurrent.futures import Future
 from concurrent.futures import TimeoutError as _FutTimeout
 from typing import Any, Callable
 
-from ray_tpu._private import faultinject, wirefmt
+from ray_tpu._private import evloop, faultinject, wirefmt
 
 _HDR = struct.Struct("<I")
 
@@ -214,12 +214,33 @@ class Connection:
         self._send_ev = threading.Event()
         self._writer_idle = threading.Event()
         self._writer_idle.set()
-        self._writer = threading.Thread(target=self._write_loop,
-                                        daemon=True,
-                                        name=f"rpc-write-{name}")
-        self._writer.start()
-        self._reader = threading.Thread(target=self._read_loop, daemon=True, name=f"rpc-read-{name}")
-        self._reader.start()
+        # Native fast lane (evloop.py → src/eventloop): when armed, the
+        # reader/writer threads and the cast coalescer live in C
+        # pthreads owning a dup() of this socket's fd; Python sees one
+        # callback per BATCH of inbound frames (_native_deliver) and
+        # hands complete outbound frames to the C send ring. The
+        # Python threads below simply aren't started — every slow-path
+        # method (dispatch, futures, faultinject, close semantics)
+        # is shared between both lanes.
+        self._native = None
+        self._native_cast_pending = False
+        if evloop.lane_enabled():
+            mod = evloop.module()
+            try:
+                self._native = mod.attach(
+                    sock.fileno(), self._native_deliver,
+                    max(1, int(_config().evloop_ring_mb)) << 20)
+            except OSError:
+                self._native = None
+        if self._native is None:
+            self._writer = threading.Thread(target=self._write_loop,
+                                            daemon=True,
+                                            name=f"rpc-write-{name}")
+            self._writer.start()
+            self._reader = threading.Thread(
+                target=self._read_loop, daemon=True,
+                name=f"rpc-read-{name}")
+            self._reader.start()
 
     # --- sending ---
 
@@ -270,6 +291,16 @@ class Connection:
         self.frames_sent += 1
         self.bytes_sent += len(frame)
         self.sent_kinds[kind] = self.sent_kinds.get(kind, 0) + 1
+        if self._native is not None:
+            # Native ring: blocks GIL-free past the high-water mark;
+            # False means the lane already observed the peer gone.
+            mod = evloop.module()
+            ok = mod.send(self._native, frame)
+            if dup:
+                mod.send(self._native, frame)
+            if not ok or self._closed.is_set():
+                raise ConnectionLost("connection closed")
+            return
         with self._sendq_lock:
             while (self._send_q_bytes > self._SEND_HIGH_WATER_BYTES
                    and not self._closed.is_set()):
@@ -338,7 +369,36 @@ class Connection:
         """Buffered one-way notification: coalesced with other buffered
         casts into one CAST_BATCH frame. Flushed by the next call()/
         cast() on this connection (ordering preserved), when the buffer
-        reaches CAST_BATCH_MAX, or by the global ~1 ms flusher."""
+        reaches CAST_BATCH_MAX, or by the global ~1 ms flusher.
+
+        Native lane: binary-encodable records hand their already-tagged
+        payload bytes to the C coalescer (same adjacent-merge + batch
+        semantics, flushed by the native ~1 ms flusher) and Python is
+        done in one encode. Records the lane cannot carry — pickle-only
+        kinds/bodies, an un-negotiated peer — and EVERY record while
+        the chaos plane is armed take today's Python buffer, so
+        faultinject.apply_send keeps seeing each flushed frame with its
+        real kind. The two buffers never interleave out of order: each
+        entry point drains the other buffer before switching."""
+        if (self._native is not None and self.wire_binary
+                and faultinject.active() is None):
+            payload = wirefmt.cast_payload(wirefmt.encode(kind, 0,
+                                                          body or {}))
+            if payload is not None:
+                if self._cast_buf:
+                    self.flush_casts()  # ordering hand-off Python→C
+                # Record census at buffer time (the C flusher's merged
+                # frames fold in via _sync_native_counters).
+                self.sent_kinds[kind] = self.sent_kinds.get(kind, 0) + 1
+                self._native_cast_pending = True
+                if not evloop.module().cast(
+                        self._native, wirefmt.KIND_CODES[kind], payload):
+                    raise ConnectionLost("connection closed")
+                return
+        if self._native is not None and self._native_cast_pending:
+            # ordering hand-off C→Python before buffering the cold one
+            self._native_cast_pending = False
+            evloop.module().flush(self._native)
         with self._cast_lock:
             self._cast_buf.append((kind, body or {}))
             n = len(self._cast_buf)
@@ -347,7 +407,51 @@ class Connection:
         elif n == 1:
             _cast_flusher.register(self)
 
+    def _sync_native_counters(self) -> None:
+        """Fold the C flusher's frame/byte counts into the Python
+        counters (delta-and-reset, so folding is idempotent-safe from
+        any caller: flush, close, metrics scrape)."""
+        if self._native is None:
+            return
+        try:
+            fr, by = evloop.module().take_counters(self._native)
+        except Exception:
+            return
+        if fr:
+            self.frames_sent += fr
+            self.bytes_sent += by
+
+    def take_native_acks(self) -> list:
+        """Task ids whose direct_ack frames the native reader consumed
+        (ack sink). Empty unless set_ack_sink(True) armed the sink."""
+        if self._native is None:
+            return []
+        try:
+            return evloop.module().take_acks(self._native)
+        except Exception:
+            return []
+
+    def set_ack_sink(self, on: bool) -> None:
+        """Owner-side fast path: when on, inbound top-level direct_ack
+        casts are parsed and retained entirely in C (drained via
+        take_native_acks) instead of waking Python per frame. direct_rej
+        and batched acks still deliver normally. No-op without the
+        native lane."""
+        if self._native is None:
+            return
+        try:
+            evloop.module().set_ack_sink(self._native, bool(on))
+        except Exception:
+            pass
+
     def flush_casts(self) -> None:
+        if self._native is not None and self._native_cast_pending:
+            # Synchronous barrier before calls/casts: the C flusher
+            # merges + frames whatever is buffered NOW, preserving the
+            # buffered-cast-before-later-call ordering contract.
+            self._native_cast_pending = False
+            evloop.module().flush(self._native)
+            self._sync_native_counters()
         with self._flush_lock:
             with self._cast_lock:
                 if not self._cast_buf:
@@ -458,6 +562,49 @@ class Connection:
             n -= len(chunk)
         return b"".join(chunks)
 
+    def _native_deliver(self, batch) -> bool:
+        """Inbound dispatch for the native lane: called from the C
+        reader thread with a LIST of frames — each either an already-
+        decoded ``(kind, msg_id, payload)`` tuple (binary hot frame) or
+        raw frame bytes (pickle stream, exotic body, or anything the C
+        decoder declined: Python replays the decode so there is exactly
+        ONE source of error semantics). ``None`` means EOF. Returning
+        False stops the C reader; mirrors _read_loop line for line."""
+        if batch is None:
+            self._shutdown()
+            return False
+        for item in batch:
+            if type(item) is tuple:
+                kind, msg_id, payload = item
+            else:
+                try:
+                    if item and item[0] == wirefmt.WIRE_MAGIC:
+                        kind, msg_id, payload = wirefmt.decode_frame(item)
+                    else:
+                        kind, msg_id, payload = pickle.loads(item)
+                except Exception:
+                    import sys
+
+                    print(f"[rpc] {self.name}: closing on undecodable "
+                          f"frame:\n{traceback.format_exc()}",
+                          file=sys.stderr)
+                    self._shutdown()
+                    return False
+            if faultinject.active() is not None and faultinject.apply_recv(
+                    self._peer_desc(), kind):
+                continue  # injected recv-side loss
+            if kind == REPLY or kind == ERROR:
+                with self._pending_lock:
+                    fut = self._pending.pop(msg_id, None)
+                if fut is not None:
+                    if kind == ERROR:
+                        fut.set_exception(RpcError(payload))
+                    else:
+                        fut.set_result(payload)
+                continue
+            self._dispatch(kind, msg_id, payload)
+        return not self._closed.is_set()
+
     def _read_loop(self) -> None:
         while not self._closed.is_set():
             hdr = self._recv_exact(_HDR.size)
@@ -552,6 +699,12 @@ class Connection:
         if self._closed.is_set():
             return
         self._closed.set()
+        if self._native is not None:
+            self._sync_native_counters()
+            try:
+                evloop.module().close(self._native)
+            except Exception:
+                pass
         self._send_ev.set()  # wake the writer so it can exit
         with self._sendq_lock:
             # Wake senders parked at the high-water mark: the queue
@@ -584,11 +737,18 @@ class Connection:
             self.flush_casts()
         except ConnectionLost:
             pass
-        deadline = _time.monotonic() + 2.0
-        while ((self._send_q or not self._writer_idle.is_set())
-               and _time.monotonic() < deadline
-               and not self._closed.is_set()):
-            _time.sleep(0.005)
+        if self._native is not None:
+            try:
+                evloop.module().drain(self._native, 2.0)
+            except Exception:
+                pass
+            self._sync_native_counters()
+        else:
+            deadline = _time.monotonic() + 2.0
+            while ((self._send_q or not self._writer_idle.is_set())
+                   and _time.monotonic() < deadline
+                   and not self._closed.is_set()):
+                _time.sleep(0.005)
         try:
             self._sock.shutdown(socket.SHUT_RDWR)
         except OSError:

@@ -23,6 +23,7 @@ from typing import Any, Callable, Sequence
 import cloudpickle
 
 from ray_tpu._private import dataplane as _dp
+from ray_tpu._private import faultinject
 from ray_tpu._private import ids as ids_mod
 from ray_tpu._private import rpc, serialization
 from ray_tpu._private.config import GLOBAL_CONFIG
@@ -964,6 +965,14 @@ class CoreRuntime:
             raise rpc.RpcError(
                 f"owner address {addr} answered as "
                 f"{c.peer_info.get('owner_id')}, expected {expect_owner}")
+        # Native fast lane, owner side: let the C reader consume
+        # top-level direct_ack casts (the per-call delivery-ack flood)
+        # without waking Python; the direct plane drains them in bulk
+        # (_drain_native_acks). Re-evaluated on every lookup so arming
+        # the chaos plane mid-session routes acks back through Python,
+        # where faultinject.apply_recv sees each frame. No-op on
+        # pure-Python connections.
+        c.set_ack_sink(faultinject.active() is None)
         return c
 
     def seal_to_owner(self, addr, bodies: "list[dict]",

@@ -400,8 +400,21 @@ class WireDecodeError(Exception):
 
 # Hot frames are casts (msg_id 0) in the overwhelming majority: their
 # 5-byte header is constant per kind, so precompute it.
+CAST_HDR_LEN = 5
 _HDR0 = {k: bytes((WIRE_MAGIC, WIRE_VERSION, c, 0, 0))
          for k, c in KIND_CODES.items()}
+
+
+def cast_payload(data: "bytes | None") -> "bytes | None":
+    """The tagged body of an encoded CAST frame, or None when `data`
+    is not a canonical zero-flags/zero-msg-id cast (reply, error, or
+    pickle fallback). The native event loop (src/eventloop) re-frames
+    from (kind code, payload) alone, re-synthesizing this exact 5-byte
+    header on the wire — the two sides must agree on its layout, so
+    the check lives here next to _HDR0 rather than in rpc.py."""
+    if data is not None and data[3] == 0 and data[4] == 0:
+        return data[CAST_HDR_LEN:]
+    return None
 
 
 def encode(kind: str, msg_id: int, body) -> "bytes | None":

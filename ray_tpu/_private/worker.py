@@ -127,6 +127,12 @@ class Worker:
         # Direct-call plane: tasks pushed owner→worker without a head
         # hop, counted for worker-side back-pressure (_on_direct_push).
         self._direct_inflight = 0
+        # Retirement latches, initialized here so the per-push accept
+        # check in _on_direct_push reads plain attributes — it runs
+        # once per frame of a native-reader delivery batch, and a
+        # defensive getattr chain there is measurable at 100k pushes/s.
+        self._recycle_pending = False
+        self._retiring_sent = False
         # Head-pushed normal tasks queued or running here. The head
         # grants a lease on the very push that makes this worker busy,
         # so the owner's lease can look idle while a head task runs —
@@ -301,8 +307,8 @@ class Worker:
         self._stamp_recv(spec, body)
         limit = GLOBAL_CONFIG.direct_worker_inflight_max
         if (self._exit.is_set()
-                or getattr(self, "_recycle_pending", False)
-                or getattr(self, "_retiring_sent", False)
+                or self._recycle_pending
+                or self._retiring_sent
                 or self._direct_inflight >= limit
                 # A lease task must not queue behind head-pushed work
                 # the owner cannot see (lease window accounting only
@@ -998,8 +1004,7 @@ class Worker:
             self._calls_by_func[spec.func_id] = n
             if n >= mc:
                 self._recycle_pending = True
-        if not getattr(self, "_recycle_pending", False) \
-                or getattr(self, "_retiring_sent", False):
+        if not self._recycle_pending or self._retiring_sent:
             return
         try:
             # Sent IMMEDIATELY once the budget trips — not gated on an

@@ -386,6 +386,9 @@ def rpc_counters() -> dict:
     rt = global_runtime()
 
     def _conn(c) -> dict:
+        sync = getattr(c, "_sync_native_counters", None)
+        if sync is not None:
+            sync()  # fold native-lane flusher frames before reading
         return {"frames_sent": c.frames_sent, "calls_sent": c.calls_sent,
                 "sent_kinds": dict(c.sent_kinds)}
 

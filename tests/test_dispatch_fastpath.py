@@ -454,6 +454,32 @@ def test_binary_wire_negotiated_by_default(cluster):
         "peer connections never negotiated binary"
 
 
+def test_native_loop_armed_on_cluster_conns(cluster):
+    """Where the box can build _evloop.so, real cluster connections
+    run on the C event loop — the zero-head-frames guards above then
+    certify the NATIVE dispatch path, not a quiet Python fallback.
+    (Skip mirrors test_wire_format's native param: boxes without a
+    toolchain run the Python loop by design.)"""
+    from ray_tpu._private import evloop
+
+    if not evloop.lane_enabled():
+        pytest.skip("native _evloop.so unavailable "
+                    "(no compiler/headers, or RAY_TPU_NATIVE[_LOOP]=0)")
+    rt = global_runtime()
+    assert rt.conn._native is not None, \
+        "head connection fell back to the Python reader"
+
+    @ray_tpu.remote
+    def warm(x):
+        return x
+
+    assert ray_tpu.get(warm.remote(7)) == 7
+    with rt._owner_conns_lock:
+        conns = list(rt._owner_conns.values())
+    assert all(c._native is not None for c in conns), \
+        "a direct-plane peer connection fell back to the Python reader"
+
+
 def test_rpc_counters_exposed(cluster):
     from ray_tpu.util import metrics
 

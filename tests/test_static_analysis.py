@@ -106,6 +106,49 @@ def test_wire_kind_codes_cross_checks(tmp_path):
     assert "RT-W002" in ids(found)
 
 
+def test_wire_native_enum_cross_check(tmp_path):
+    """RT-W005 catches every direction of KIND_CODES <-> rt_kind skew:
+    a code value mismatch, a wirefmt kind the C enum lacks, and a C
+    enum entry wirefmt lacks (incl. the CAST_BATCH <-> __cast_batch__
+    dunder mapping)."""
+    root = seed(tmp_path, {
+        "ray_tpu/_private/wirefmt.py": '''
+            KIND_CODES = {"direct_push": 1, "owner_sealed": 4}
+            ''',
+        "src/eventloop/eventloop.c": '''
+            enum rt_kind {
+                RT_KIND_DIRECT_PUSH = 2,
+                RT_KIND_CAST_BATCH = 11,
+            };
+            #define RT_KIND_MAX 16
+            ''',
+    })
+    found = [f for f in lint(root, WirePass) if f.id == "RT-W005"]
+    msgs = "\n".join(f.message for f in found)
+    assert len(found) == 3
+    assert "misroute" in msgs                      # direct_push 1 vs 2
+    assert "'owner_sealed'" in msgs                # missing in C
+    assert "'__cast_batch__'" in msgs              # missing in wirefmt
+    # the skewed-value finding anchors at the C enum line
+    assert any(f.path == "src/eventloop/eventloop.c" for f in found)
+
+
+def test_wire_native_enum_in_sync_is_clean(tmp_path):
+    """Matching tables produce no RT-W005 noise."""
+    root = seed(tmp_path, {
+        "ray_tpu/_private/wirefmt.py": '''
+            KIND_CODES = {"direct_push": 1, "__cast_batch__": 11}
+            ''',
+        "src/eventloop/eventloop.c": '''
+            enum rt_kind {
+                RT_KIND_DIRECT_PUSH = 1,
+                RT_KIND_CAST_BATCH = 11,
+            };
+            ''',
+    })
+    assert "RT-W005" not in ids(lint(root, WirePass))
+
+
 # ---------------------------------------------------------------------------
 # RT-K: config knobs
 

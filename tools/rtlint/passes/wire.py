@@ -24,6 +24,11 @@ Checks:
   RT-W002  hot-path kind missing a KIND_CODES binary code
   RT-W003  KIND_CODES entry that nothing ever sends (dead wire code)
   RT-W004  KIND_CODES entry with no receiver anywhere
+  RT-W005  KIND_CODES out of sync with the native event loop's
+           rt_kind enum (src/eventloop/eventloop.c) — missing entry
+           either side, or same kind bound to different code values.
+           The C reader demuxes by these numbers GIL-free; a skew is
+           a silent cross-language misroute, not a crash.
 
 HOT_KINDS is the curated per-call steady-state set: kinds emitted
 once per task on the direct dispatch / seal / ack paths. Amortized
@@ -63,6 +68,11 @@ HOT_KINDS = frozenset({
 TRANSPORT_KINDS = frozenset({"__cast_batch__", "__reply__"})
 
 _SEND_METHODS = {"cast", "call", "cast_buffered"}
+
+# The native event loop's kind enum: `RT_KIND_DIRECT_PUSH = 1,`.
+# (#define RT_KIND_MAX carries no '=' and stays unmatched.)
+_C_ENUM_RE = re.compile(r"RT_KIND_([A-Z_]+)\s*=\s*(\d+)")
+_C_SRC = "src/eventloop/eventloop.c"
 
 
 class WirePass:
@@ -141,7 +151,7 @@ class WirePass:
                     f"hot-path kind {kind!r} has no wirefmt.KIND_CODES "
                     f"entry — every frame pays a pickle round trip",
                     sym))
-        for kind, line in sorted(kind_codes.items()):
+        for kind, (line, _code) in sorted(kind_codes.items()):
             if kind in TRANSPORT_KINDS:
                 continue
             if kind not in sent:
@@ -155,17 +165,64 @@ class WirePass:
                     "RT-W004", wf_path, line,
                     f"KIND_CODES entry {kind!r} has no receiver in any "
                     f"dispatch table", "KIND_CODES"))
+        out.extend(self._check_native_enum(tree, wf_path, kind_codes))
         return out
 
     @staticmethod
-    def _kind_codes(tree: RepoTree) -> "dict[str, int]":
-        """KIND_CODES keys -> lineno, resolved from the wirefmt AST
-        (string keys plus the _CAST_BATCH name constant)."""
+    def _check_native_enum(tree: RepoTree, wf_path: str,
+                           kind_codes: "dict[str, tuple[int, int | None]]",
+                           ) -> "list[Finding]":
+        """RT-W005: the C demux enum and KIND_CODES must be the same
+        table. Pure-text extraction on the C side (no compiler in the
+        lint path); the dunder transport kind maps CAST_BATCH <->
+        __cast_batch__."""
+        text = tree.doc_text(_C_SRC)
+        if not text or not kind_codes:
+            return []  # no native source / no table in this tree
+        c_codes: dict[str, tuple[int, int]] = {}
+        for lineno, raw in enumerate(text.splitlines(), 1):
+            m = _C_ENUM_RE.search(raw)
+            if m:
+                name = m.group(1).lower()
+                kind = name if name in kind_codes else f"__{name}__"
+                c_codes[kind] = (lineno, int(m.group(2)))
+        out: list[Finding] = []
+        for kind, (line, code) in sorted(kind_codes.items()):
+            if kind not in c_codes:
+                out.append(Finding(
+                    "RT-W005", wf_path, line,
+                    f"KIND_CODES entry {kind!r} (= {code}) has no "
+                    f"RT_KIND_* counterpart in {_C_SRC} — the native "
+                    f"reader cannot demux it and every such frame "
+                    f"falls back to Python delivery", "KIND_CODES"))
+            elif code is not None and c_codes[kind][1] != code:
+                out.append(Finding(
+                    "RT-W005", _C_SRC, c_codes[kind][0],
+                    f"native enum binds {kind!r} to "
+                    f"{c_codes[kind][1]} but wirefmt.KIND_CODES says "
+                    f"{code} — cross-language frame misroute",
+                    "rt_kind"))
+        for kind, (line, code) in sorted(c_codes.items()):
+            if kind not in kind_codes:
+                out.append(Finding(
+                    "RT-W005", _C_SRC, line,
+                    f"native enum entry for {kind!r} (= {code}) has no "
+                    f"wirefmt.KIND_CODES counterpart — dead native "
+                    f"demux surface (codes are append-only; comment "
+                    f"if reserved)", "rt_kind"))
+        return out
+
+    @staticmethod
+    def _kind_codes(tree: RepoTree) -> "dict[str, tuple[int, int | None]]":
+        """KIND_CODES keys -> (lineno, numeric code), resolved from the
+        wirefmt AST (string keys plus the _CAST_BATCH name constant).
+        The code value feeds the RT-W005 native-enum cross-check; None
+        for a non-literal value keeps the rest of the pass alive."""
         mod = tree.module("ray_tpu/_private/wirefmt.py")
         if mod is None:
             return {}
         consts: dict[str, str] = {}
-        out: dict[str, int] = {}
+        out: dict[str, tuple[int, "int | None"]] = {}
         for node in mod.tree.body:
             if (isinstance(node, ast.Assign)
                     and len(node.targets) == 1
@@ -176,10 +233,12 @@ class WirePass:
                     consts[tgt] = s
                 if tgt == "KIND_CODES" and isinstance(node.value,
                                                      ast.Dict):
-                    for k in node.value.keys:
+                    for k, v in zip(node.value.keys, node.value.values):
                         s = const_str(k)
                         if s is None and isinstance(k, ast.Name):
                             s = consts.get(k.id)
                         if s is not None:
-                            out[s] = k.lineno
+                            code = (v.value if isinstance(v, ast.Constant)
+                                    and isinstance(v.value, int) else None)
+                            out[s] = (k.lineno, code)
         return out
