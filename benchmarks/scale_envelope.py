@@ -461,7 +461,16 @@ def _run_sections(p: dict, results: dict) -> dict:
                  LLM_AB_PREFIX_TOKENS=str(p["llm_ab_prefix_tokens"])),
         timeout=900).decode())
 
-    # 10. Invariant analysis plane: lint the tree the envelope just
+    # 10. Sharded head A/B: shards=1 vs shards=min(4, ncpu) over the
+    #    depth-512 pipelined flood + leased-task flood, with per-shard
+    #    pid/affinity/CPU-utilization rows. Subprocess per mode (each
+    #    boots its own cluster); a <2-core box records an EXPLICIT skip
+    #    with the reason — flat parity numbers from core-starved shards
+    #    would read as "sharding does not help" when the box simply
+    #    cannot show it.
+    results["head_shards"] = _head_shards_section()
+
+    # 11. Invariant analysis plane: lint the tree the envelope just
     #    exercised. Records how much surface the cross-checkers cover
     #    and that the shipped tree is clean (active == 0 modulo the
     #    written-down baseline) — drift here is an invariant regression
@@ -554,6 +563,110 @@ def _native_fast_lane_section() -> dict:
     except Exception as e:
         out["phase_latency"] = {"error": str(e)}
     return out
+
+
+def _head_shards_section() -> dict:
+    ncpu = os.cpu_count() or 1
+    if ncpu < 2:
+        return {
+            "skipped": True, "ncpu": ncpu,
+            "reason": ("box has a single CPU core: dispatch shards "
+                       "time-share it and cannot demonstrate parallel "
+                       "head throughput; run on a multi-core box for "
+                       "the shards=N >= shards=1 envelope"),
+        }
+    shards_n = min(4, ncpu)
+    out: dict = {"ncpu": ncpu, "shards_n": shards_n}
+    for label, n in (("shards_1", 1), (f"shards_{shards_n}", shards_n)):
+        out[label] = json.loads(subprocess.check_output(
+            [sys.executable, os.path.abspath(__file__),
+             "--head-shards-child", str(n)],
+            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+            timeout=900).decode())
+    base = out["shards_1"]["pipelined_calls_per_s"]
+    multi = out[f"shards_{shards_n}"]["pipelined_calls_per_s"]
+    out["speedup"] = round(multi / max(base, 1e-9), 2)
+    # The envelope claim, asserted — never silently recorded as parity.
+    out["assert_ok"] = multi >= base
+    return out
+
+
+def _proc_cpu_seconds(pid: int) -> "float | None":
+    """utime+stime of one process in seconds (/proc/<pid>/stat)."""
+    try:
+        with open(f"/proc/{pid}/stat", "rb") as f:
+            fields = f.read().rsplit(b")", 1)[1].split()
+        hz = os.sysconf("SC_CLK_TCK")
+        return (int(fields[11]) + int(fields[12])) / hz
+    except (OSError, IndexError, ValueError):
+        return None
+
+
+def _head_shards_child(n: int) -> None:
+    """One A/B arm: boot a cluster at head_shards=n, drive the
+    depth-512 pipelined flood + leased-task flood, and report rates
+    plus per-shard pid/affinity/CPU-utilization. Runs as a subprocess
+    of the envelope so each arm gets a pristine cluster."""
+    import ray_tpu
+    from ray_tpu._private.worker_context import get_head
+
+    ray_tpu.init(num_cpus=max(4, os.cpu_count() or 4),
+                 object_store_memory=256 * 1024 * 1024,
+                 log_to_driver=False,
+                 _system_config={"head_shards": n})
+    out: dict = {"shards": n}
+    try:
+        head = get_head()
+        pids = head.shard_pids() if hasattr(head, "shard_pids") else []
+
+        @ray_tpu.remote
+        class ShardEcho:
+            def ping(self, x=None):
+                return x
+
+        actor = ShardEcho.remote()
+        ray_tpu.get([actor.ping.remote() for _ in range(64)])  # warm
+
+        @ray_tpu.remote
+        def stask(i):
+            return i
+
+        ray_tpu.get([stask.remote(i) for i in range(64)])  # warm leases
+
+        cpu0 = {pid: _proc_cpu_seconds(pid) for pid in pids}
+        depth, waves = 512, 6
+        t0 = time.time()
+        for _ in range(waves):
+            ray_tpu.get([actor.ping.remote() for _ in range(depth)],
+                        timeout=600)
+        pipelined_dt = time.time() - t0
+        t0 = time.time()
+        flood = 1000
+        ray_tpu.get([stask.remote(i) for i in range(flood)],
+                    timeout=600)
+        flood_dt = time.time() - t0
+        elapsed = pipelined_dt + flood_dt
+
+        out["pipelined_calls_per_s"] = round(
+            depth * waves / pipelined_dt, 1)
+        out["flood_tasks_per_s"] = round(flood / flood_dt, 1)
+        shard_rows = []
+        for i, pid in enumerate(pids):
+            row: dict = {"index": i, "pid": pid}
+            try:
+                row["cpu_affinity"] = sorted(os.sched_getaffinity(pid))
+            except (AttributeError, OSError):
+                row["cpu_affinity"] = None
+            c0, c1 = cpu0.get(pid), _proc_cpu_seconds(pid)
+            row["cpu_util"] = (round((c1 - c0) / elapsed, 3)
+                               if c0 is not None and c1 is not None
+                               else None)
+            shard_rows.append(row)
+        out["shard_procs"] = shard_rows
+        ray_tpu.kill(actor)
+    finally:
+        ray_tpu.shutdown()
+    print(json.dumps(out))
 
 
 def _serve_section(p: dict) -> dict:
@@ -725,6 +838,9 @@ def _serve_section(p: dict) -> dict:
 
 def main() -> None:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if len(sys.argv) > 2 and sys.argv[1] == "--head-shards-child":
+        _head_shards_child(int(sys.argv[2]))
+        return
     profile = (sys.argv[1] if len(sys.argv) > 1
                else os.environ.get("SCALE_PROFILE", "full"))
     results = run(profile)

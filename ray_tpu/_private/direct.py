@@ -466,6 +466,26 @@ class DirectPlane:
                 # everything queued behind it) in seq order.
                 self._expire_task(tid)
 
+    def on_reconnect(self) -> None:
+        """The driver re-registered with a new/restarted head — possibly
+        a DIFFERENT dispatch shard of a sharded head (head_shards.py).
+        Every grant the old head issued is void there: drop all routes
+        back to head mode and all leases without lease_return (the old
+        head is gone; the new one never issued them). In-flight calls
+        re-route through the new head on the next watchdog tick with the
+        usual seq-order/dedup machinery."""
+        with self.lock:
+            for r in self.routes.values():
+                r.addr = None
+                r.worker_id = None
+                r.mode = "head"
+                for rec in r.tasks.values():
+                    rec[2] = 0.0
+            for pool in list(self.lease_pools.values()):
+                for lease in list(pool):
+                    self._remove_lease_locked(lease, ret=False)
+            self._lease_wants.clear()
+
     def on_peer_close(self, addr: tuple) -> None:
         """A direct connection died: every route/lease over it re-routes
         through the head (picked up by the next watchdog tick)."""

@@ -186,6 +186,12 @@ class EventTable:
         # object" for the object's whole life.
         self._oid_producer: dict[str, str] = {}
         self._oid_producer_fifo: deque = deque()
+        # Owner confirmations that arrived BEFORE the worker's report
+        # registered the oid (direct tasks report to the head over a
+        # socket; a local-mode owner confirms in-process and can win
+        # that race) — parked here so register_oids claims the stamp.
+        self._pending_resolve: dict[str, float] = {}
+        self._pending_resolve_fifo: deque = deque()
         self._lock = threading.Lock()
         self.phase_hists: dict[str, PhaseHistogram] = {}
 
@@ -267,6 +273,10 @@ class EventTable:
                 if oid not in self._oid_producer:
                     self._oid_producer[oid] = task_id
                     self._oid_producer_fifo.append(oid)
+                ts = self._pending_resolve.pop(oid, None)
+                if ts is not None:
+                    self._oid_task.pop(oid, None)
+                    self._resolve_locked(task_id, ts)
             while len(self._oid_fifo) > self.maxlen:
                 self._oid_task.pop(self._oid_fifo.popleft(), None)
             while len(self._oid_producer_fifo) > self.maxlen:
@@ -299,22 +309,30 @@ class EventTable:
             for oid in oids or ():
                 task_id = self._oid_task.pop(oid, None)
                 if task_id is None:
+                    self._pending_resolve[oid] = ts
+                    self._pending_resolve_fifo.append(oid)
+                    while len(self._pending_resolve_fifo) > self.maxlen:
+                        self._pending_resolve.pop(
+                            self._pending_resolve_fifo.popleft(), None)
                     continue
-                ev = self._by_task.get(task_id)
-                if ev is None:
-                    ev = {"task_id": task_id, "phases": {}}
-                    self._by_task[task_id] = ev
-                    self._append_locked(ev)
-                phases = ev.setdefault("phases", {})
-                if "resolve" not in phases:
-                    phases["resolve"] = ts
-                    done = phases.get("seal", phases.get("exec_end"))
-                    if done is not None:
-                        h = self.phase_hists.get("result_transfer")
-                        if h is None:
-                            h = self.phase_hists["result_transfer"] = \
-                                PhaseHistogram()
-                        h.observe(ts - done)
+                self._resolve_locked(task_id, ts)
+
+    def _resolve_locked(self, task_id: str, ts: float) -> None:
+        ev = self._by_task.get(task_id)
+        if ev is None:
+            ev = {"task_id": task_id, "phases": {}}
+            self._by_task[task_id] = ev
+            self._append_locked(ev)
+        phases = ev.setdefault("phases", {})
+        if "resolve" not in phases:
+            phases["resolve"] = ts
+            done = phases.get("seal", phases.get("exec_end"))
+            if done is not None:
+                h = self.phase_hists.get("result_transfer")
+                if h is None:
+                    h = self.phase_hists["result_transfer"] = \
+                        PhaseHistogram()
+                h.observe(ts - done)
 
     # -- snapshots -------------------------------------------------------
 

@@ -268,8 +268,14 @@ class Head:
         num_tpus: float | None = None,
         resources: dict[str, float] | None = None,
         session_dir: str | None = None,
+        shard_ctx=None,
     ):
         self.config = config
+        # Sharded-head mode (head_shards.ShardCtx): None means the
+        # single-process head — every shard branch below is behind
+        # `self.shard is not None`, so shards=1 never runs sharding
+        # code (the bit-identical kill switch).
+        self.shard = shard_ctx
         self.session_id = uuid.uuid4().hex[:12]
         self.session_dir = session_dir or f"/tmp/ray_tpu/session_{self.session_id}"
         os.makedirs(self.session_dir, exist_ok=True)
@@ -471,6 +477,21 @@ class Head:
         self._lru_tick = 0
         self._shutdown = False
         self._subscribers: dict[str, list[rpc.Connection]] = {}  # pubsub topic
+        # --- cross-shard tables (empty/idle at shards=1) ---
+        # Metas for objects owned by OTHER shards, learned through the
+        # bus (dir_obj_lookup replies + pushed xshard_sealed casts).
+        # Every cross-shard meta is PIN-FREE (inline copy / owner
+        # pointer / unpinned p2p) so no pin lifecycle spans shards;
+        # bounded FIFO — a consumer that comes back later just re-asks.
+        self._xshard_metas: dict[str, tuple] = {}
+        self._xshard_meta_fifo: deque[str] = deque()
+        # Owner side: oid -> set of shard indexes to push the meta to
+        # when it seals (registered by their pending lookups).
+        self._xshard_watch: dict[str, set] = {}
+        # actor_id -> owning shard index, learned via dir_find_actor /
+        # dir_name_get (a stale entry self-heals: the forward errors
+        # and the next locate re-asks).
+        self._xshard_actors: dict[str, int] = {}
 
         # --- local node (head node) ---
         node_resources = self._detect_resources(num_cpus, num_tpus, resources)
@@ -668,8 +689,188 @@ class Head:
             res["memory"] = float(psutil.virtual_memory().total)
         except Exception:
             res["memory"] = 8e9
+        shard = getattr(self, "shard", None)
+        if shard is not None and shard.total > 1:
+            # Each shard of a sharded head detects the SAME host memory;
+            # divide so the cross-shard cluster_resources sum stays the
+            # real host total instead of total × shards.
+            res["memory"] /= shard.total
         res[f"node:{self.node_id if hasattr(self, 'node_id') else '127.0.0.1'}"] = 1.0
         return res
+
+    # ------------------------------------------------------------------
+    # cross-shard plumbing (every entry point no-ops at shards=1)
+
+    def _new_worker_id(self) -> str:
+        """Mint a worker id; in shard mode it is rejection-sampled so
+        shard_for(worker_id) == this shard — the router can then land a
+        re-dialing worker back on the shard that owns its record."""
+        if self.shard is None:
+            return "worker-" + uuid.uuid4().hex[:8]
+        from ray_tpu._private.head_shards import mint_for_shard
+
+        return mint_for_shard("worker-", self.shard.index,
+                              self.shard.total)
+
+    def _client_cast(self, client_id: str, kind: str, body: dict) -> None:
+        """Push to a client by id, wherever its connection lives: the
+        local conn when we host it, else relayed through the shard bus
+        (the owner of a forwarded actor task is on another shard).
+        Safe under self.lock (cast_buffered only serializes+queues)."""
+        c = self.clients.get(client_id)
+        if c is not None:
+            try:
+                c.cast_buffered(kind, body)
+            except rpc.ConnectionLost:
+                pass
+        elif self.shard is not None:
+            self.shard.relay_client_cast(client_id, kind, body)
+
+    def _dir_name_del(self, key: tuple, actor_id: str) -> None:
+        """Release a name's directory claim (cast; guarded shard-side
+        and directory-side against a successor that re-took it)."""
+        if self.shard is not None:
+            self.shard.bus_cast("dir_name_del", {
+                "key": list(key), "actor_id": actor_id})
+
+    def _locate_actor_shard(self, actor_id: str) -> "int | None":
+        """Which shard hosts this actor? NEVER call under self.lock —
+        it blocks on a bus round-trip."""
+        cached = self._xshard_actors.get(actor_id)
+        if cached is not None:
+            return cached
+        try:
+            r = self.shard.bus_call("dir_find_actor",
+                                    {"actor_id": actor_id})
+        except rpc.RpcError:
+            return None
+        shard = r.get("shard") if r else None
+        if shard is not None:
+            self._xshard_actors[actor_id] = shard
+        return shard
+
+    def _xshard_track(self, ids) -> None:
+        """Resolve ids this shard doesn't own before the waiter parks:
+        ask the directory to fan a pin-free lookup out to the other
+        shards, record the metas, and register a sealed-watch for the
+        still-pending ones. Runs OUTSIDE self.lock (bus round-trip)."""
+        with self.lock:
+            unknown = [i for i in ids
+                       if i not in self.objects
+                       and i not in self._xshard_metas]
+        if not unknown:
+            return
+        try:
+            r = self.shard.bus_call("dir_obj_lookup", {
+                "ids": unknown, "shard": self.shard.index})
+        except rpc.RpcError:
+            return
+        metas = (r or {}).get("metas") or {}
+        if metas:
+            with self.lock:
+                for oid, meta in metas.items():
+                    self._xshard_meta_put(oid, meta)
+
+    def _xshard_meta_put(self, oid: str, meta) -> None:
+        """lock held. Record a bus-served meta (bounded FIFO)."""
+        if oid not in self._xshard_metas:
+            self._xshard_meta_fifo.append(oid)
+            while len(self._xshard_meta_fifo) > 8192:
+                self._xshard_metas.pop(self._xshard_meta_fifo.popleft(),
+                                       None)
+        self._xshard_metas[oid] = tuple(meta)
+
+    def _xshard_ref_relay(self, op: str, ids, conn) -> None:
+        """Forward ref/borrow ops on ids another shard owns (cast:
+        refcounts tolerate async application; the owner's own live ref
+        covers the gap)."""
+        if not ids or self.shard is None:
+            return
+        self.shard.bus_cast("dir_obj_ref", {
+            "op": op, "ids": list(ids),
+            "client_id": conn.peer_info.get("client_id"),
+            "shard": self.shard.index})
+
+    def _xshard_fanout(self, kind: str, body: dict) -> list:
+        """State-query merge: collect the other shards' replies for
+        this read-only handler through the directory. NEVER under
+        self.lock. `_shard_local` marks a fanned-out copy so the
+        receiving shard answers locally instead of re-fanning."""
+        if self.shard is None or body.get("_shard_local"):
+            return []
+        try:
+            r = self.shard.bus_call(
+                "dir_fanout",
+                {"kind": kind, "body": dict(body, _shard_local=True)})
+        except rpc.RpcError:
+            return []
+        return [x for x in (r or {}).get("replies", []) if x]
+
+    # -- bus-served handlers (arrive from other shards / the directory)
+
+    def _h_has_actor(self, body: dict, conn):
+        with self.lock:
+            return {"have": body["actor_id"] in self.actors}
+
+    def _h_xshard_obj_lookup(self, body: dict, conn):
+        """Pin-free meta service for another shard's consumers; pending
+        ids register a sealed-watch pushed from _on_sealed."""
+        watcher = body.get("watcher")
+        metas = {}
+        with self.lock:
+            for oid in body["ids"]:
+                e = self.objects.get(oid)
+                if e is None:
+                    continue
+                if e.state in (SEALED, SPILLED) or e.inline is not None \
+                        or e.owner_resident:
+                    meta = self._meta_for(e, remote=True, pin=False)
+                    if meta[0] != "lost":
+                        metas[oid] = meta
+                        continue
+                if watcher is not None:
+                    self._xshard_watch.setdefault(oid, set()).add(watcher)
+        return {"metas": metas}
+
+    def _h_xshard_sealed(self, body: dict, conn):
+        with self.lock:
+            self._xshard_meta_put(body["object_id"], body["meta"])
+            self._on_sealed(body["object_id"])
+        self.dispatch_event.set()
+        return None
+
+    def _h_xshard_obj_ref(self, body: dict, conn):
+        client_id = body.get("client_id")
+        op = body["op"]
+        with self.lock:
+            for oid in body["ids"]:
+                e = self.objects.get(oid)
+                if e is None:
+                    continue
+                if op == "add_ref":
+                    e.refcount += 1
+                elif op == "del_ref":
+                    e.refcount -= 1
+                    self._maybe_free(e)
+                elif op == "add_borrow" and client_id:
+                    e.borrowers.add(client_id)
+                elif op == "del_borrow" and client_id:
+                    e.borrowers.discard(client_id)
+                    self._maybe_free(e)
+        return None
+
+    def _h_xshard_client_gone(self, body: dict, conn):
+        """A client hosted on another shard disconnected: clear its
+        borrower marks and direct-watcher registrations here."""
+        client_id = body["client_id"]
+        with self.lock:
+            for e in self.objects.values():
+                if client_id in e.borrowers:
+                    e.borrowers.discard(client_id)
+                    self._maybe_free(e)
+            for a in self.actors.values():
+                a.direct_watchers.discard(client_id)
+        return None
 
     # --- head FT: write-behind snapshots --------------------------------
 
@@ -736,7 +937,7 @@ class Head:
         and would capture or hang the worker's jax on the TPU path."""
         if node_id != self.node_id:
             return self._spawn_remote_worker(node_id, tpu_capable)
-        worker_id = "worker-" + uuid.uuid4().hex[:8]
+        worker_id = self._new_worker_id()
         env = dict(os.environ)
         env["RAY_TPU_WORKER_ID"] = worker_id
         env["RAY_TPU_HEAD"] = f"{self.address[0]}:{self.address[1]}"
@@ -818,7 +1019,7 @@ class Head:
                              tpu_capable: bool = False) -> WorkerRecord:
         """Ask the node's agent to fork a worker (reference: raylet spawns
         its own workers after the GCS-side lease decision)."""
-        worker_id = "worker-" + uuid.uuid4().hex[:8]
+        worker_id = self._new_worker_id()
         rec = WorkerRecord(worker_id, node_id, None, tpu_capable)
         with self.lock:
             self.workers[worker_id] = rec
@@ -868,6 +1069,11 @@ class Head:
         client_id = info.get("client_id")
         if client_id is None:
             return
+        if self.shard is not None:
+            # Other shards may hold this client's borrows / direct
+            # watches (cross-shard actor calls): broadcast the death.
+            self.shard.bus_cast("dir_client_gone", {
+                "client_id": client_id, "shard": self.shard.index})
         with self.lock:
             self.clients.pop(client_id, None)
             self.client_owner_addrs.pop(client_id, None)
@@ -1446,7 +1652,12 @@ class Head:
                                   "specenc": bool(body.get("specenc"))}
             self.dispatch_event.set()
         else:
-            client_id = "driver-" + uuid.uuid4().hex[:8]
+            # Sharded head: the router minted an id hashed to this
+            # shard (adopt_meta rides the fd handoff) so that
+            # shard_for(client_id) == its hosting shard everywhere.
+            meta = getattr(conn, "adopt_meta", None)
+            client_id = (meta or {}).get("client_id") \
+                or "driver-" + uuid.uuid4().hex[:8]
             with self.lock:
                 # Shm-fallback re-register on the same connection: drop the
                 # first registration's entry.
@@ -1463,7 +1674,7 @@ class Head:
                               "host": body.get("host")}
         from ray_tpu._private.task_spec import _specenc
 
-        return {
+        reply = {
             "client_id": client_id,
             "shm_name": None if remote else self.shm_name,
             "specenc": _specenc() is not None,
@@ -1475,6 +1686,11 @@ class Head:
             "node_id": rec.node_id if ctype == "worker" else self.node_id,
             "session_dir": self.session_dir,
         }
+        if self.shard is not None:
+            # Only in shard mode: the shards=1 reply stays bit-identical.
+            reply["shard"] = self.shard.index
+            reply["head_shards"] = self.shard.total
+        return reply
 
     def _h_oom_pressure(self, body: dict, conn: rpc.Connection):
         """A node agent reports host memory pressure: run the kill policy
@@ -1607,7 +1823,10 @@ class Head:
         with the GCS node table, gcs_node_manager.h:49)."""
         from ray_tpu._private.scheduler import NodeEntry, ResourceSet
 
-        node_id = body.get("node_id") or ("node-" + uuid.uuid4().hex[:8])
+        node_id = (body.get("node_id")
+                   or (getattr(conn, "adopt_meta", None)
+                       or {}).get("node_id")
+                   or ("node-" + uuid.uuid4().hex[:8]))
         if body.get("transfer_port"):
             try:
                 peer_ip = conn._sock.getpeername()[0]
@@ -1651,7 +1870,11 @@ class Head:
                     self._try_place_pg(pg)
         conn.peer_info = {"node_agent_for": node_id}
         self.dispatch_event.set()
-        return {"node_id": node_id, "session_dir": self.session_dir}
+        reply = {"node_id": node_id, "session_dir": self.session_dir}
+        if self.shard is not None:
+            reply["shard"] = self.shard.index
+            reply["head_shards"] = self.shard.total
+        return reply
 
     def _h_worker_blocked(self, body: dict, conn):
         """A worker thread is entering a blocking nested get/wait:
@@ -1926,6 +2149,18 @@ class Head:
 
     def _on_sealed(self, object_id: str) -> None:
         """Resolve get/wait waiters; wake dependency-blocked tasks. lock held."""
+        watchers = self._xshard_watch.pop(object_id, None)
+        if watchers and self.shard is not None:
+            # Another shard's consumer asked for this object before it
+            # sealed: push the (pin-free) meta now. Cast — safe under
+            # the lock (cast_buffered serializes and queues).
+            e = self.objects.get(object_id)
+            if e is not None and e.state in (SEALED, SPILLED):
+                meta = self._meta_for(e, remote=True, pin=False)
+                for shard in watchers:
+                    self.shard.bus_cast("dir_fwd_cast", {
+                        "shard": shard, "kind": "xshard_sealed",
+                        "body": {"object_id": object_id, "meta": meta}})
         blocked = self.dep_blocked.pop(object_id, None)
         if blocked:
             self._sealed_woke_task = True
@@ -1957,12 +2192,21 @@ class Head:
 
     def _is_ready(self, object_id: str) -> bool:
         e = self.objects.get(object_id)
-        return e is not None and e.state in (SEALED, SPILLED)
+        if e is None:
+            # Another shard's object whose meta the bus delivered.
+            return object_id in self._xshard_metas
+        return e.state in (SEALED, SPILLED)
 
     def _meta_for(self, entry: ObjectEntry, remote: bool = False,
                   client_id: "str | None" = None,
                   client_node: "str | None" = None,
-                  client_host: "str | None" = None) -> tuple:
+                  client_host: "str | None" = None,
+                  pin: bool = True) -> tuple:
+        # pin=False (cross-shard bus lookups only): serve the meta
+        # without read pins or pull-slot accounting — no pin lifecycle
+        # may span shards (there is no cross-shard read_done), so bus
+        # metas ride the unpinned paths (inline copy / owner pointer /
+        # validated p2p read).
         # Leak-detector input: this entry was fetched (sealed-but-never-
         # read objects past the TTL are suspects; a read clears them).
         entry.reads += 1
@@ -2002,10 +2246,11 @@ class Head:
                 src = self._pick_source(entry, client_node)
                 if src is not None:
                     node_id, off, addr = src
-                    entry.read_pins += 1
-                    if client_id:
-                        entry.pin_holders[client_id] = (
-                            entry.pin_holders.get(client_id, 0) + 1)
+                    if pin:
+                        entry.read_pins += 1
+                        if client_id:
+                            entry.pin_holders[client_id] = (
+                                entry.pin_holders.get(client_id, 0) + 1)
                     # Data-plane "extra": the source arena's identity
                     # (host-colocated readers map it directly) and
                     # whether this source is a relay (a replica, not
@@ -2014,7 +2259,7 @@ class Head:
                     extra = dict(info) if info else {}
                     extra["relay"] = node_id != (entry.location
                                                  or self.node_id)
-                    if client_id and self._pull_counted(
+                    if pin and client_id and self._pull_counted(
                             entry, node_id, client_node, client_host,
                             extra):
                         # Remote bulk pull expected: account the slot
@@ -2216,6 +2461,10 @@ class Head:
         for oid in ids:
             entry = self.objects.get(oid)
             if entry is None:
+                xmeta = self._xshard_metas.get(oid)
+                if xmeta is not None:
+                    metas[oid] = xmeta
+                    continue
                 metas[oid] = ("lost", f"object {oid} unknown (freed?)", False)
             else:
                 metas[oid] = self._meta_for(
@@ -2236,6 +2485,8 @@ class Head:
 
     def _h_get_meta(self, body: dict, conn):
         waiter_id, ids = body["waiter_id"], body["ids"]
+        if self.shard is not None:
+            self._xshard_track(ids)
         with self.lock:
             self._waiter_ids[waiter_id] = list(ids)
             missing = set()
@@ -2280,6 +2531,8 @@ class Head:
 
     def _h_wait(self, body: dict, conn):
         waiter_id, ids, num_returns = body["waiter_id"], body["ids"], body["num_returns"]
+        if self.shard is not None:
+            self._xshard_track(ids)
         with self.lock:
             for i in ids:
                 if not self._is_ready(i):
@@ -2292,6 +2545,8 @@ class Head:
         return None
 
     def _h_wait_check(self, body: dict, conn):
+        if self.shard is not None:
+            self._xshard_track(body["ids"])
         with self.lock:
             for i in body["ids"]:
                 if not self._is_ready(i):
@@ -2308,20 +2563,28 @@ class Head:
         return None
 
     def _h_del_ref(self, body: dict, conn):
+        unknown = []
         with self.lock:
             for oid in body["ids"]:
                 e = self.objects.get(oid)
                 if e is not None:
                     e.refcount -= 1
                     self._maybe_free(e)
+                elif self.shard is not None:
+                    unknown.append(oid)
+        self._xshard_ref_relay("del_ref", unknown, conn)
         return None
 
     def _h_add_ref(self, body: dict, conn):
+        unknown = []
         with self.lock:
             for oid in body["ids"]:
                 e = self.objects.get(oid)
                 if e is not None:
                     e.refcount += 1
+                elif self.shard is not None:
+                    unknown.append(oid)
+        self._xshard_ref_relay("add_ref", unknown, conn)
         return None
 
     def _h_add_borrow(self, body: dict, conn):
@@ -2332,23 +2595,31 @@ class Head:
         client_id = conn.peer_info.get("client_id")
         if not client_id:
             return None
+        unknown = []
         with self.lock:
             for oid in body["ids"]:
                 e = self.objects.get(oid)
                 if e is not None:
                     e.borrowers.add(client_id)
+                elif self.shard is not None:
+                    unknown.append(oid)
+        self._xshard_ref_relay("add_borrow", unknown, conn)
         return None
 
     def _h_del_borrow(self, body: dict, conn):
         client_id = conn.peer_info.get("client_id")
         if not client_id:
             return None
+        unknown = []
         with self.lock:
             for oid in body["ids"]:
                 e = self.objects.get(oid)
                 if e is not None:
                     e.borrowers.discard(client_id)
                     self._maybe_free(e)
+                elif self.shard is not None:
+                    unknown.append(oid)
+        self._xshard_ref_relay("del_borrow", unknown, conn)
         return None
 
     def _release_container_pins(self, ids) -> None:
@@ -2862,15 +3133,13 @@ class Head:
             # id — push an ask-the-head marker so its get resolves now
             # instead of riding the 5 s stall probe.
             e = self.objects.get(rbody["object_id"])
-            if e is not None and e.owner_id in self.client_owner_addrs:
-                oconn = self.clients.get(e.owner_id)
-                if oconn is not None:
-                    try:
-                        oconn.cast_buffered("seal_objects", {"objects": [
-                            {"object_id": rbody["object_id"],
-                             "remote": True}]})
-                    except rpc.ConnectionLost:
-                        pass
+            if e is not None and (
+                    e.owner_id in self.client_owner_addrs
+                    or (self.shard is not None
+                        and e.owner_id not in self.clients)):
+                self._client_cast(e.owner_id, "seal_objects", {
+                    "objects": [{"object_id": rbody["object_id"],
+                                 "remote": True}]})
         if body.get("events"):
             for ev in body["events"]:
                 # Clock-domain annotation for cross-node alignment: the
@@ -3003,6 +3272,7 @@ class Head:
                         key = (actor.spec.namespace, actor.spec.name)
                         if self.named_actors.get(key) == rec.actor_id:
                             self.named_actors.pop(key, None)
+                            self._dir_name_del(key, rec.actor_id)
                     # Retire the dedicated worker and return its
                     # reservation — otherwise failed creations leak
                     # CPUs/chips and a zombie process each.
@@ -3036,6 +3306,17 @@ class Head:
 
     def _h_create_actor(self, body, conn):
         spec: ActorSpec = body["spec"]
+        if spec.name and self.shard is not None:
+            # Cluster-wide atomic claim in the directory (outside
+            # self.lock: bus round-trip). The local table below stays
+            # the authority for THIS shard's names; the directory
+            # arbitrates across shards.
+            r = self.shard.bus_call("dir_name_put", {
+                "key": [spec.namespace, spec.name],
+                "actor_id": spec.actor_id, "shard": self.shard.index})
+            if not (r or {}).get("ok"):
+                raise rpc.RpcError(
+                    f"actor name {spec.name!r} already taken")
         with self.lock:
             if spec.name:
                 key = (spec.namespace, spec.name)
@@ -3059,6 +3340,21 @@ class Head:
 
     def _h_submit_actor_task(self, body, conn):
         spec: TaskSpec = spec_from_body(body)
+        if self.shard is not None and not conn.peer_info.get("relay"):
+            with self.lock:
+                known = spec.actor_id in self.actors
+            if not known:
+                # Another shard's actor: forward the whole submit to
+                # its hosting shard (cast — this handler replies None
+                # either way; results flow back over the owner plane /
+                # relayed seal pushes). The owner rides along so the
+                # receiving shard can push to it through the bus.
+                shard = self._locate_actor_shard(spec.actor_id)
+                if shard is not None and shard != self.shard.index:
+                    self.shard.bus_cast("dir_fwd_cast", {
+                        "shard": shard, "kind": "submit_actor_task",
+                        "body": dict(body, _relay_owner=spec.owner_id)})
+                    return None
         self._adopt_evt(spec, body)
         with self.lock:
             if not self._admission_check(spec, conn):
@@ -3099,6 +3395,20 @@ class Head:
         only for ALIVE actors whose worker runs a peer server; the
         owner is registered as a watcher for death revokes."""
         owner_id = conn.peer_info.get("client_id")
+        if (self.shard is not None and owner_id
+                and not conn.peer_info.get("relay")):
+            with self.lock:
+                have = body["actor_id"] in self.actors
+            if not have:
+                # The actor lives on another shard: forward the watch
+                # registration there; the grant/revoke casts come back
+                # relayed through the bus to this owner.
+                shard = self._locate_actor_shard(body["actor_id"])
+                if shard is not None and shard != self.shard.index:
+                    self.shard.bus_cast("dir_fwd_cast", {
+                        "shard": shard, "kind": "actor_direct_info",
+                        "body": dict(body, _relay_owner=owner_id)})
+                    return None
         with self.lock:
             actor = self.actors.get(body["actor_id"])
             if actor is None or not owner_id:
@@ -3145,12 +3455,7 @@ class Head:
         if grant is None:
             return
         for owner_id in actor.direct_watchers:
-            oconn = self.clients.get(owner_id)
-            if oconn is not None:
-                try:
-                    oconn.cast_buffered("actor_direct_grant", grant)
-                except rpc.ConnectionLost:
-                    pass
+            self._client_cast(owner_id, "actor_direct_grant", grant)
 
     def _h_task_started(self, body, conn):
         """Async bookkeeping for a DIRECT-dispatched task (batched cast,
@@ -3240,9 +3545,32 @@ class Head:
         death handling — or that already finished — is skipped, so
         recovery never double-submits (at-least-once only when the
         direct link itself silently ate the push or the ack)."""
+        specs = list(body.get("specs") or ())
+        if self.shard is not None and not conn.peer_info.get("relay"):
+            # Items for actors hosted on other shards recover THERE
+            # (forwarded whole, owner riding along); the rest proceed
+            # locally. Locate runs outside self.lock (bus round-trip).
+            keep = []
+            for sbody in specs:
+                spec = spec_from_body(sbody)
+                if spec.actor_id is not None:
+                    with self.lock:
+                        known = spec.actor_id in self.actors
+                    if not known:
+                        shard = self._locate_actor_shard(spec.actor_id)
+                        if shard is not None \
+                                and shard != self.shard.index:
+                            self.shard.bus_cast("dir_fwd_cast", {
+                                "shard": shard,
+                                "kind": "direct_recover",
+                                "body": {"specs": [sbody],
+                                         "_relay_owner": spec.owner_id}})
+                            continue
+                keep.append(sbody)
+            specs = keep
         accepted = []
         with self.lock:
-            for sbody in body.get("specs") or ():
+            for sbody in specs:
                 spec: TaskSpec = spec_from_body(sbody)
                 t = self.tasks.get(spec.task_id)
                 if t is not None and t["state"] in (FINISHED, FAILED):
@@ -3460,6 +3788,15 @@ class Head:
     def _h_kill_actor(self, body, conn):
         with self.lock:
             actor = self.actors.get(body["actor_id"])
+        if actor is None and self.shard is not None \
+                and not body.get("_shard_local"):
+            shard = self._locate_actor_shard(body["actor_id"])
+            if shard is not None and shard != self.shard.index:
+                return self.shard.bus_call("dir_fwd", {
+                    "shard": shard, "kind": "kill_actor",
+                    "body": dict(body, _shard_local=True)})
+        with self.lock:
+            actor = self.actors.get(body["actor_id"])
             if actor is None:
                 return {}
             if body.get("no_restart", True):
@@ -3480,6 +3817,7 @@ class Head:
                     key = (actor.spec.namespace, actor.spec.name)
                     if self.named_actors.get(key) == body["actor_id"]:
                         self.named_actors.pop(key, None)
+                        self._dir_name_del(key, body["actor_id"])
             rec = self.workers.get(actor.worker_id) if actor.worker_id else None
             if rec is not None and rec.expected_exit is None:
                 rec.expected_exit = ("intended_kill",
@@ -3511,27 +3849,42 @@ class Head:
     def _h_list_named_actors(self, body, conn):
         """Names of live named actors (reference:
         util/__init__.py:29 list_named_actors)."""
-        with self.lock:
-            if body.get("all_namespaces"):
-                return {"actors": [
-                    {"namespace": ns, "name": name}
-                    for (ns, name) in self.named_actors
-                ]}
-            ns = body.get("namespace", "")
-            return {"actors": [name for (n, name) in self.named_actors
-                               if n == ns]}
+        if self.shard is not None:
+            # The directory's claim table is the cluster-wide view.
+            r = self.shard.bus_call("dir_name_list", {})
+            names = [tuple(k) for k in (r or {}).get("names", [])]
+        else:
+            with self.lock:
+                names = list(self.named_actors)
+        if body.get("all_namespaces"):
+            return {"actors": [
+                {"namespace": ns, "name": name}
+                for (ns, name) in names
+            ]}
+        ns = body.get("namespace", "")
+        return {"actors": [name for (n, name) in names if n == ns]}
 
     def _h_get_named_actor(self, body, conn):
+        key = (body.get("namespace", ""), body["name"])
         with self.lock:
-            actor_id = self.named_actors.get((body.get("namespace", ""), body["name"]))
-            if actor_id is None:
-                raise rpc.RpcError(f"no actor named {body['name']!r}")
-            actor = self.actors[actor_id]
-            return {
-                "actor_id": actor_id,
-                "cls_func_id": actor.spec.cls_func_id,
-                "max_concurrency": actor.spec.max_concurrency,
-            }
+            actor_id = self.named_actors.get(key)
+            if actor_id is not None:
+                actor = self.actors[actor_id]
+                return {
+                    "actor_id": actor_id,
+                    "cls_func_id": actor.spec.cls_func_id,
+                    "max_concurrency": actor.spec.max_concurrency,
+                }
+        if self.shard is not None and not body.get("_shard_local"):
+            # Another shard may hold the name: the directory knows.
+            r = self.shard.bus_call("dir_name_get", {"key": list(key)})
+            shard = (r or {}).get("shard")
+            if shard is not None and shard != self.shard.index:
+                self._xshard_actors[r["actor_id"]] = shard
+                return self.shard.bus_call("dir_fwd", {
+                    "shard": shard, "kind": "get_named_actor",
+                    "body": dict(body, _shard_local=True)})
+        raise rpc.RpcError(f"no actor named {body['name']!r}")
 
     def _drain_actor_queue(self, actor: ActorRecord) -> None:
         while actor.pending:
@@ -3617,7 +3970,12 @@ class Head:
                     total[k] = total.get(k, 0) + v
                 for k, v in n.available.to_dict().items():
                     avail[k] = avail.get(k, 0) + v
-            return {"total": total, "available": avail}
+        for r in self._xshard_fanout("cluster_resources", body):
+            for k, v in (r.get("total") or {}).items():
+                total[k] = total.get(k, 0) + v
+            for k, v in (r.get("available") or {}).items():
+                avail[k] = avail.get(k, 0) + v
+        return {"total": total, "available": avail}
 
     def _h_profile_result(self, body, conn):
         """A worker's sampling run finished: wake the parked request."""
@@ -3745,8 +4103,7 @@ class Head:
 
     def _h_get_nodes(self, body, conn):
         with self.lock:
-            return {
-                "nodes": [
+            nodes = [
                     {
                         "node_id": n.node_id,
                         "address": n.address,
@@ -3766,7 +4123,9 @@ class Head:
                     }
                     for n in self.scheduler.nodes.values()
                 ]
-            }
+        for r in self._xshard_fanout("get_nodes", body):
+            nodes.extend(r.get("nodes") or [])
+        return {"nodes": nodes}
 
     def _h_list_tasks(self, body, conn):
         state = body.get("state")
@@ -3792,6 +4151,8 @@ class Head:
                              or t.get("worker_id") == worker_id)]
             else:
                 recs = list(self.tasks.values())
+        for r in self._xshard_fanout("list_tasks", body):
+            recs.extend(r.get("tasks") or [])
         limit = body.get("limit", 1000)
         return {"tasks": recs[-limit:]}
 
@@ -3816,15 +4177,18 @@ class Head:
                 # task_id path): get_actor() and the dashboard actor
                 # drill-down must not ship the whole actor table.
                 a = self.actors.get(actor_id)
-                return {"actors": [self._actor_row(a)] if a is not None
-                        else []}
-            return {"actors": [self._actor_row(a)
-                               for a in self.actors.values()]}
+                rows = [self._actor_row(a)] if a is not None else []
+            else:
+                rows = [self._actor_row(a)
+                        for a in self.actors.values()]
+        if actor_id is None or not rows:
+            for r in self._xshard_fanout("list_actors", body):
+                rows.extend(r.get("actors") or [])
+        return {"actors": rows}
 
     def _h_list_placement_groups(self, body, conn):
         with self.lock:
-            return {
-                "placement_groups": [
+            pgs = [
                     {
                         "placement_group_id": pg.pg_id,
                         "name": pg.name,
@@ -3835,7 +4199,9 @@ class Head:
                     }
                     for pg in self.pgs.values()
                 ]
-            }
+        for r in self._xshard_fanout("list_placement_groups", body):
+            pgs.extend(r.get("placement_groups") or [])
+        return {"placement_groups": pgs}
 
     def _object_node(self, e: ObjectEntry) -> str:
         """lock held. Which node holds this object's bytes: the P2P
@@ -3896,11 +4262,17 @@ class Head:
                 # task_id path): a drill-down must never ship the whole
                 # object table.
                 e = self.objects.get(object_id)
-                return {"objects": [self._object_row(e, attribution)]
-                        if e is not None else []}
-            limit = int(body.get("limit", 1_000_000))
-            rows = [self._object_row(e, attribution)
-                    for e in self.objects.values()]
+                rows = [self._object_row(e, attribution)] \
+                    if e is not None else []
+            else:
+                rows = [self._object_row(e, attribution)
+                        for e in self.objects.values()]
+        if object_id is None or not rows:
+            for r in self._xshard_fanout("list_objects", body):
+                rows.extend(r.get("objects") or [])
+        if object_id is not None:
+            return {"objects": rows}
+        limit = int(body.get("limit", 1_000_000))
         return {"objects": rows[-limit:]}
 
     def _lineage_chain(self, oid: str, depth: int = 5,
@@ -3950,6 +4322,10 @@ class Head:
                 else None
             chain = self._lineage_chain(oid)
         if row is None and "task" not in chain:
+            # Not ours: the owning shard has the row + lineage.
+            for r in self._xshard_fanout("get_object", body):
+                if r.get("object"):
+                    return r
             return {"object": None}
         out = row or {"object_id": oid, "state": "FREED"}
         out["lineage"] = chain
@@ -3957,8 +4333,7 @@ class Head:
 
     def _h_list_workers(self, body, conn):
         with self.lock:
-            return {
-                "workers": [
+            workers = [
                     {
                         "worker_id": w.worker_id,
                         "node_id": w.node_id,
@@ -3968,7 +4343,9 @@ class Head:
                     }
                     for w in self.workers.values()
                 ]
-            }
+        for r in self._xshard_fanout("list_workers", body):
+            workers.extend(r.get("workers") or [])
+        return {"workers": workers}
 
     def _h_log_index(self, body, conn):
         """Per-worker log file index (reference: `ray logs` listing via
@@ -4030,6 +4407,11 @@ class Head:
 
         def _exit():
             time.sleep(0.5)
+            if self.shard is not None:
+                # Whole-cluster stop: the directory tears every shard
+                # down (including this one) with recorded intent.
+                self.shard.bus_cast("dir_stop", {})
+                time.sleep(10)  # the shard_stop cast exits us first
             self.shutdown()
             os._exit(0)
 
@@ -4122,7 +4504,12 @@ class Head:
 
     def _h_store_stats(self, body, conn):
         with self.lock:
-            return self._store_stats_locked()
+            stats = self._store_stats_locked()
+        for r in self._xshard_fanout("store_stats", body):
+            for k, v in r.items():
+                if isinstance(v, (int, float)):
+                    stats[k] = stats.get(k, 0) + v
+        return stats
 
     def _h_memory_summary(self, body, conn):
         """The cluster-wide `ray-tpu memory` feed (reference:
@@ -4167,7 +4554,7 @@ class Head:
                 s2 = by_state.setdefault(e.state, {"count": 0, "bytes": 0})
                 s2["count"] += 1
                 s2["bytes"] += e.size
-            return {
+            out = {
                 "store": self._store_stats_locked(),
                 "groups": groups,
                 "by_node": by_node,
@@ -4178,6 +4565,26 @@ class Head:
                 "num_entries": len(self.objects),
                 "total_bytes": sum(v["bytes"] for v in by_state.values()),
             }
+        for r in self._xshard_fanout("memory_summary", body):
+            # Censuses/suspects concat; directory counters sum; nested
+            # node/state groups merge per bucket.
+            out["groups"].update(r.get("groups") or {})
+            out["census_clients"].update(r.get("census_clients") or {})
+            out["leak_suspects"].extend(r.get("leak_suspects") or [])
+            for node, states in (r.get("by_node") or {}).items():
+                b = out["by_node"].setdefault(node, {})
+                for st, s in states.items():
+                    m = b.setdefault(st, {"count": 0, "bytes": 0})
+                    m["count"] += s.get("count", 0)
+                    m["bytes"] += s.get("bytes", 0)
+            for st, s in (r.get("by_state") or {}).items():
+                m = out["by_state"].setdefault(st,
+                                               {"count": 0, "bytes": 0})
+                m["count"] += s.get("count", 0)
+                m["bytes"] += s.get("bytes", 0)
+            out["num_entries"] += r.get("num_entries", 0)
+            out["total_bytes"] += r.get("total_bytes", 0)
+        return out
 
     def _h_task_events(self, body, conn):
         with self.lock:
@@ -4188,14 +4595,24 @@ class Head:
     def _h_get_trace(self, body, conn):
         """One causal trace tree, full span detail (util.state.get_trace,
         `ray-tpu trace <id>`, dashboard /api/traces/<id>)."""
-        return {"trace": self.traces.get(body["trace_id"])}
+        trace = self.traces.get(body["trace_id"])
+        if trace is None:
+            # A trace assembles on the shard its owner registered with.
+            for r in self._xshard_fanout("get_trace", body):
+                if r.get("trace") is not None:
+                    return r
+        return {"trace": trace}
 
     def _h_list_traces(self, body, conn):
         """Retained trace summaries, newest first; exemplars_only skips
         the uniform sample (dashboard Traces view default)."""
-        return {"traces": self.traces.list(
-            limit=int(body.get("limit", 100)),
-            exemplars_only=bool(body.get("exemplars_only")))}
+        limit = int(body.get("limit", 100))
+        traces = self.traces.list(
+            limit=limit,
+            exemplars_only=bool(body.get("exemplars_only")))
+        for r in self._xshard_fanout("list_traces", body):
+            traces.extend(r.get("traces") or [])
+        return {"traces": traces[:limit]}
 
     def _h_report_metrics(self, body, conn):
         with self.lock:
@@ -4210,7 +4627,10 @@ class Head:
 
     def _h_get_metrics(self, body, conn):
         with self.lock:
-            return {"metrics": dict(self.metrics)}
+            metrics = dict(self.metrics)
+        for r in self._xshard_fanout("get_metrics", body):
+            metrics.update(r.get("metrics") or {})
+        return {"metrics": metrics}
 
     def _h_worker_death(self, body, conn):
         """A node agent's reaper classified one of its workers' exits
@@ -4236,18 +4656,27 @@ class Head:
         with self.lock:
             if wid is not None:
                 r = self.crash_reports.get(wid)
-                return {"reports": [dict(r)] if r else []}
-            rows = [self.crash_reports[w] for w in self._crash_fifo
-                    if w in self.crash_reports]
-            limit = int(body.get("limit", 100))
-            summary_keys = ("worker_id", "node_id", "pid", "actor_id",
-                            "exit_type", "exit_detail", "exit_code",
-                            "term_signal", "signal_name", "last_task",
-                            "source", "ts")
-            return {"reports": [
-                {k: r.get(k) for k in summary_keys if r.get(k)
-                 is not None}
-                for r in rows[-limit:]]}
+                reports = [dict(r)] if r else []
+            else:
+                rows = [self.crash_reports[w] for w in self._crash_fifo
+                        if w in self.crash_reports]
+                limit = int(body.get("limit", 100))
+                summary_keys = ("worker_id", "node_id", "pid",
+                                "actor_id", "exit_type", "exit_detail",
+                                "exit_code", "term_signal",
+                                "signal_name", "last_task",
+                                "source", "ts", "reason", "detail",
+                                "kind")
+                reports = [
+                    {k: r.get(k) for k in summary_keys if r.get(k)
+                     is not None}
+                    for r in rows[-limit:]]
+        if wid is None or not reports:
+            # Other shards' tables + the directory's own shard-death
+            # reports (appended by its fanout handler).
+            for r in self._xshard_fanout("list_crash_reports", body):
+                reports.extend(r.get("reports") or [])
+        return {"reports": reports}
 
     def _h_get_task_events(self, body, conn):
         from ray_tpu._private import faultinject
@@ -4264,6 +4693,9 @@ class Head:
             task_ids=body.get("task_ids"))
         with self.lock:
             offsets = dict(self.clock_offsets)
+        for r in self._xshard_fanout("get_task_events", body):
+            events.extend(r.get("events") or [])
+            offsets.update(r.get("clock_offsets") or {})
         return {"events": events, "clock_offsets": offsets,
                 "head_node_id": self.node_id}
 
@@ -4302,13 +4734,9 @@ class Head:
         with self.lock:
             buf, self._owned_freed_buf = self._owned_freed_buf, {}
         for owner_id, ids in buf.items():
-            oconn = self.clients.get(owner_id)
-            if oconn is None:
+            if owner_id not in self.clients and self.shard is None:
                 continue
-            try:
-                oconn.cast_buffered("owned_freed", {"ids": ids})
-            except rpc.ConnectionLost:
-                pass
+            self._client_cast(owner_id, "owned_freed", {"ids": ids})
 
     def _dispatch_once_locked(self) -> None:
         with self.lock:
@@ -5315,13 +5743,8 @@ class Head:
         # calls re-route through direct_recover / the requeue below
         # instead of hanging on a dead socket.
         for owner_id in actor.direct_watchers:
-            oconn = self.clients.get(owner_id)
-            if oconn is not None:
-                try:
-                    oconn.cast_buffered("actor_direct_revoke",
-                                        {"actor_id": rec.actor_id})
-                except rpc.ConnectionLost:
-                    pass
+            self._client_cast(owner_id, "actor_direct_revoke",
+                              {"actor_id": rec.actor_id})
         actor.direct_watchers.clear()
         if rec.conn is None and not rec.ready:
             # The worker process never came up (lost spawn cast, boot
@@ -5408,6 +5831,7 @@ class Head:
                 key = (actor.spec.namespace, actor.spec.name)
                 if self.named_actors.get(key) == rec.actor_id:
                     self.named_actors.pop(key, None)
+                    self._dir_name_del(key, rec.actor_id)
             self._wal_append(("actor_dead", rec.actor_id))
             self._mark_dirty()
 
@@ -5438,7 +5862,7 @@ class Head:
                 for path, n in (snap.get("host_copies") or {}).items():
                     xfer_copies[path] = xfer_copies.get(path, 0) + n
 
-            return {
+            out = {
                 "counters": dict(self.stats),
                 "gauges": {
                     "workers_alive": workers_alive,
@@ -5494,6 +5918,31 @@ class Head:
                 # tail-fold aggregates, and owner-side span-buffer drops.
                 "tracing": self.traces.stats(),
             }
+        for r in self._xshard_fanout("runtime_stats", body):
+            # Numeric merge: counters/gauges/deaths/sheds sum; per-
+            # client rpc maps concat (client ids are disjoint between
+            # shards by construction of the owner hash).
+            for sect in ("counters", "gauges", "tasks_shed",
+                         "worker_deaths"):
+                for k, v in (r.get(sect) or {}).items():
+                    if isinstance(v, (int, float)):
+                        out[sect][k] = out[sect].get(k, 0) + v
+            rrpc = r.get("rpc") or {}
+            out["rpc"]["clients"].update(rrpc.get("clients") or {})
+            out["rpc"]["total_head_frames"] += rrpc.get(
+                "total_head_frames", 0)
+            out["rpc"]["clock_offsets"].update(
+                rrpc.get("clock_offsets") or {})
+            out["pressured_nodes"].update(r.get("pressured_nodes") or {})
+            for path, n in ((r.get("transfers") or {}).get("bytes")
+                            or {}).items():
+                out["transfers"]["bytes"][path] = \
+                    out["transfers"]["bytes"].get(path, 0) + n
+            for path, n in ((r.get("transfers") or {}).get("host_copies")
+                            or {}).items():
+                out["transfers"]["host_copies"][path] = \
+                    out["transfers"]["host_copies"].get(path, 0) + n
+        return out
 
     def _objects_stats_locked(self) -> dict:
         by_node_state: dict[str, dict] = {}
@@ -5585,15 +6034,12 @@ class Head:
         # The owner's get() waits LOCALLY for results it expects: push
         # the error seal to its owner plane so that wait resolves
         # without the stall-probe fallback.
-        if entry.owner_id in self.client_owner_addrs:
-            oconn = self.clients.get(entry.owner_id)
-            if oconn is not None:
-                try:
-                    oconn.cast_buffered("seal_objects", {"objects": [
-                        {"object_id": object_id, "payload": payload,
-                         "is_error": True}]})
-                except rpc.ConnectionLost:
-                    pass
+        if (entry.owner_id in self.client_owner_addrs
+                or (self.shard is not None
+                    and entry.owner_id not in self.clients)):
+            self._client_cast(entry.owner_id, "seal_objects", {
+                "objects": [{"object_id": object_id, "payload": payload,
+                             "is_error": True}]})
 
     # ------------------------------------------------------------------
 

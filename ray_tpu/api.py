@@ -66,9 +66,12 @@ def init(
                 )
             address = env_addr
         if address is None:
-            from ray_tpu._private.gcs import Head
+            # create_head: a plain Head at head_shards==1, the router +
+            # shard-process directory above (see _private/head_shards.py).
+            from ray_tpu._private.head_shards import create_head
 
-            head = Head(cfg, num_cpus=num_cpus, num_tpus=num_tpus, resources=resources)
+            head = create_head(cfg, num_cpus=num_cpus,
+                               num_tpus=num_tpus, resources=resources)
             rt = CoreRuntime(head.address, client_type="driver")
             worker_context.set_runtime(rt, head)
             if log_to_driver:

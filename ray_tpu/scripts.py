@@ -30,7 +30,7 @@ def _connect(address: str) -> None:
 def cmd_start(args) -> int:
     if args.head:
         from ray_tpu._private.config import Config
-        from ray_tpu._private.gcs import Head
+        from ray_tpu._private.head_shards import create_head
 
         cfg = Config()
         cfg.head_host = args.host
@@ -45,8 +45,9 @@ def cmd_start(args) -> int:
             # Cross-node head HA: durable state in a shared store; a
             # fresh head anywhere restores it (redis_store_client.h:111).
             cfg.gcs_external_store = args.external_store
-        head = Head(cfg, num_cpus=args.num_cpus, num_tpus=args.num_tpus,
-                    resources=json.loads(args.resources) if args.resources else None)
+        head = create_head(
+            cfg, num_cpus=args.num_cpus, num_tpus=args.num_tpus,
+            resources=json.loads(args.resources) if args.resources else None)
         host, port = head.address
         if host == "0.0.0.0":
             import socket

@@ -148,6 +148,23 @@ def test_resolve_phase_recorded():
     assert ph["resolve"] >= ph["exec_end"] - 0.001
 
 
+def test_resolve_confirmation_beats_registration():
+    """Direct tasks register their return ids via the worker's socket
+    report while a local-mode owner confirms seals in-process — the
+    confirmation can win that race. The stamp must be parked and
+    claimed by the late registration, not silently dropped."""
+    t = ev_mod.EventTable(100)
+    t.resolve(["oid-early"], 123.0)           # owner confirm first
+    t.register_oids("task-early", ["oid-early"])  # worker report second
+    rec = t.task_record("task-early")
+    assert rec is not None and rec["phases"]["resolve"] == 123.0
+    # Normal order still works and the parked entry was consumed.
+    t.register_oids("task-late", ["oid-late"])
+    t.resolve(["oid-late"], 456.0)
+    assert t.task_record("task-late")["phases"]["resolve"] == 456.0
+    assert not t._pending_resolve
+
+
 # ------------------------------------------------- clock alignment
 
 

@@ -1,4 +1,4 @@
-"""The six rtlint passes, in catalog order (docs/INVARIANTS.md)."""
+"""The seven rtlint passes, in catalog order (docs/INVARIANTS.md)."""
 
 from tools.rtlint.passes.wire import WirePass
 from tools.rtlint.passes.knobs import KnobsPass
@@ -6,9 +6,11 @@ from tools.rtlint.passes.locks import LocksPass
 from tools.rtlint.passes.clocks import ClocksPass
 from tools.rtlint.passes.metrics import MetricsPass
 from tools.rtlint.passes.framebudget import FrameBudgetPass
+from tools.rtlint.passes.shardbus import ShardBusPass
 
 ALL_PASSES = (WirePass, KnobsPass, LocksPass, ClocksPass, MetricsPass,
-              FrameBudgetPass)
+              FrameBudgetPass, ShardBusPass)
 
 __all__ = ["ALL_PASSES", "WirePass", "KnobsPass", "LocksPass",
-           "ClocksPass", "MetricsPass", "FrameBudgetPass"]
+           "ClocksPass", "MetricsPass", "FrameBudgetPass",
+           "ShardBusPass"]

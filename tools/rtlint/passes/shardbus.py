@@ -1,0 +1,131 @@
+"""RT-F1xx: sharded-head bus discipline pass.
+
+The sharded head (ray_tpu/_private/head_shards.py) splits state two
+ways: shard-LOCAL tables live inside each shard's ``Head`` and
+directory-GLOBAL tables (named-actor registry, shard roster, shard
+crash reports) live only in the parent ``ShardDirectory``. The whole
+consistency story rests on one rule: shard-side code NEVER reaches
+into a directory table directly — every cross-shard read/write goes
+through the shard bus (``bus_call``/``bus_cast``), where the directory
+arbitrates under its own lock. A direct attribute reach would compile
+and even work in-process (shards=1 tests exercise exactly that
+topology), then corrupt silently once shards are real processes.
+
+  RT-F101  code outside ``ShardDirectory`` touches an attribute named
+           in head_shards.DIRECTORY_TABLES — reach through the shard
+           bus instead
+  RT-F102  ``bus_call``/``bus_cast`` sends a literal kind no
+           ``_h_<kind>`` handler (or ``_handle_bus`` literal dispatch
+           arm) receives — the call will raise "no handler" at runtime
+           on a path only multi-shard topologies execute
+
+The table list is DECLARED in head_shards.py (``DIRECTORY_TABLES``)
+rather than hardcoded here, so adding a directory table automatically
+extends the check; the seeded fixtures in
+tests/test_static_analysis.py prove both directions.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.rtlint.core import Finding, RepoTree, enclosing_symbols
+
+_DECL_MODULE = "ray_tpu/_private/head_shards.py"
+_DECL_NAME = "DIRECTORY_TABLES"
+_OWNER_CLASS = "ShardDirectory"
+_BUS_SENDS = {"bus_call", "bus_cast"}
+
+
+def _declared_tables(tree: RepoTree) -> "set[str]":
+    mod = tree.module(_DECL_MODULE)
+    if mod is None:
+        return set()
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == _DECL_NAME
+                   for t in node.targets):
+            continue
+        out: set[str] = set()
+        for sub in ast.walk(node.value):
+            if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+                out.add(sub.value)
+        return out
+    return set()
+
+
+def _handler_kinds(tree: RepoTree) -> "set[str]":
+    """Every bus kind something receives: ``_h_<kind>`` defs anywhere
+    plus literal ``kind == "..."`` arms inside ``_handle_bus``."""
+    kinds: set[str] = set()
+    for mod in tree.modules:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if node.name.startswith("_h_"):
+                    kinds.add(node.name[3:])
+                if node.name == "_handle_bus":
+                    for sub in ast.walk(node):
+                        if (isinstance(sub, ast.Compare)
+                                and isinstance(sub.left, ast.Name)
+                                and sub.left.id == "kind"):
+                            for comp in sub.comparators:
+                                if (isinstance(comp, ast.Constant)
+                                        and isinstance(comp.value, str)):
+                                    kinds.add(comp.value)
+    return kinds
+
+
+class ShardBusPass:
+    name = "shardbus"
+    id_prefix = "RT-F1"
+
+    def run(self, tree: RepoTree) -> "list[Finding]":
+        out: list[Finding] = []
+        tables = _declared_tables(tree)
+        handled = _handler_kinds(tree)
+        for mod in tree.modules:
+            syms = enclosing_symbols(mod.tree)
+            if tables:
+                self._check_table_reach(mod, tables, syms, out)
+            self._check_orphan_kinds(mod, handled, syms, out)
+        return out
+
+    def _check_table_reach(self, mod, tables, syms, out) -> None:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            if node.name == _OWNER_CLASS:
+                continue
+            for sub in ast.walk(node):
+                if (isinstance(sub, ast.Attribute)
+                        and sub.attr in tables):
+                    out.append(Finding(
+                        "RT-F101", mod.relpath, sub.lineno,
+                        f"directory-global table .{sub.attr} touched "
+                        f"outside {_OWNER_CLASS} — shard-side code must "
+                        f"go through the shard bus (bus_call/bus_cast), "
+                        f"never reach into directory state",
+                        syms.get(sub.lineno, "")))
+
+    def _check_orphan_kinds(self, mod, handled, syms, out) -> None:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if not (isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _BUS_SENDS):
+                continue
+            if not node.args:
+                continue
+            kind = node.args[0]
+            if not (isinstance(kind, ast.Constant)
+                    and isinstance(kind.value, str)):
+                continue  # dynamic kind: out of static reach
+            if kind.value in handled:
+                continue
+            out.append(Finding(
+                "RT-F102", mod.relpath, node.lineno,
+                f"shard-bus kind '{kind.value}' has no _h_{kind.value} "
+                f"handler (or _handle_bus dispatch arm) anywhere — the "
+                f"send will fail only on multi-shard topologies",
+                syms.get(node.lineno, "")))
