@@ -134,6 +134,7 @@ def main(as_json: bool = False) -> dict:
     bench_deadline_overhead(results)
     bench_census_overhead(results)
     bench_trace_overhead(results)
+    bench_profiling_overhead(results)
     if as_json:
         print(json.dumps({"microbenchmark": results}))
     return results
@@ -559,6 +560,41 @@ def bench_trace_overhead(results: dict) -> None:
         ray_tpu.shutdown()
     os.environ.pop("RAY_TPU_TRACE_ENABLED", None)
     config_mod.GLOBAL_CONFIG.trace_enabled = True
+
+
+def bench_profiling_overhead(results: dict) -> None:
+    """Continuous-profiling overhead: pipelined direct actor calls with
+    the always-on sampler armed in every process (RAY_TPU_PROFILING_ENABLED
+    — workers read it at boot, the driver re-arms per mode) vs disarmed.
+    The sampler is duty-cycled (default 19 Hz for 20% of each second) and
+    window summaries ride the existing amortized rpc_report casts, so the
+    on/off delta must stay ≤3% — the CI guard for "profiling is always-on
+    affordable"."""
+    import os
+
+    from ray_tpu._private import profplane
+
+    for mode in ("on", "off"):
+        os.environ["RAY_TPU_PROFILING_ENABLED"] = "1" if mode == "on" else "0"
+        profplane.disarm()  # arm() is per-process-global; reset per mode
+        ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024 * 1024,
+                     log_to_driver=False)
+
+        @ray_tpu.remote
+        class PfEcho:
+            def ping(self, x=None):
+                return x
+
+        actor = PfEcho.remote()
+        ray_tpu.get([actor.ping.remote() for _ in range(64)])  # warm
+        timeit(f"actor pipeline depth 32 profiling {mode}",
+               lambda: ray_tpu.get(
+                   [actor.ping.remote() for _ in range(32)]),
+               32, results=results)
+        ray_tpu.kill(actor)
+        ray_tpu.shutdown()
+    os.environ.pop("RAY_TPU_PROFILING_ENABLED", None)
+    profplane.disarm()
 
 
 if __name__ == "__main__":

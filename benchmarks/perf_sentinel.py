@@ -1,0 +1,256 @@
+"""Perf-regression sentinel.
+
+Counterpart of the reference's release-perf gating (reference:
+release/microbenchmark + the perf-dashboards that diff nightly numbers):
+run a REDUCED core-op program N times, summarize each op as
+median/MAD across runs, and compare against the committed baseline
+(``benchmarks/perf_baseline.json``) with a per-op noise band. A run
+
+    python benchmarks/perf_sentinel.py            # gate vs baseline
+    python benchmarks/perf_sentinel.py --write-baseline
+    python benchmarks/perf_sentinel.py --json
+
+exits nonzero when any op's median rate falls below the baseline median
+by more than the band, and appends one JSONL line per invocation to
+``benchmarks/perf_trajectory.jsonl`` — the long-run perf history the
+continuous-profiling plane's flamegraph diffs (``ray-tpu profile
+--diff``) are read against: the sentinel says THAT a regression landed,
+the profile diff says WHERE the cycles went.
+
+Noise model: shared-CI boxes are noisy, so the band is
+``max(noise_floor, k * MAD / median)`` of the baseline samples — MAD is
+robust to one bad run, the floor (default 25%) absorbs scheduler jitter
+on small machines. Rates are ops/s (higher is better); only the
+regression direction gates.
+
+``--inject-slowdown op=factor`` divides the measured rates of matching
+ops post-measurement — the seeded-regression self-test (and the e2e
+test suite) uses it to prove the gate actually trips.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    # script mode puts benchmarks/ (not the repo root) on sys.path.
+    sys.path.insert(0, REPO)
+BASELINE_PATH = os.path.join(REPO, "benchmarks", "perf_baseline.json")
+TRAJECTORY_PATH = os.path.join(REPO, "benchmarks", "perf_trajectory.jsonl")
+
+# Reduced op program: the four core-plane shapes whose regressions have
+# historically mattered (task dispatch, pipelined direct actor calls,
+# object-store put/get). Each entry maps name -> (build, multiplier)
+# where build(ray_tpu, actor) returns the timed thunk.
+DEFAULT_RUNS = 3
+_BATCH = 50
+_DEPTH = 32
+
+
+def _ops_program():
+    import ray_tpu
+
+    @ray_tpu.remote
+    def small_task():
+        return b"ok"
+
+    @ray_tpu.remote
+    class Echo:
+        def ping(self, x=None):
+            return x
+
+    actor = Echo.remote()
+    ray_tpu.get([small_task.remote() for _ in range(64)])  # warm pool
+    ray_tpu.get([actor.ping.remote() for _ in range(64)])  # warm actor
+    ref = ray_tpu.put(b"y" * 100)
+    return {
+        "tasks_async": (
+            lambda: ray_tpu.get(
+                [small_task.remote() for _ in range(_BATCH)]), _BATCH),
+        "actor_pipeline_32": (
+            lambda: ray_tpu.get(
+                [actor.ping.remote() for _ in range(_DEPTH)]), _DEPTH),
+        "put_small": (lambda: ray_tpu.put(b"x" * 100), 1),
+        "get_small": (lambda: ray_tpu.get(ref), 1),
+    }
+
+
+def _rate(fn, multiplier: int, min_time_s: float) -> float:
+    fn()  # warmup
+    start = time.perf_counter()
+    count = 0
+    while time.perf_counter() - start < min_time_s:
+        fn()
+        count += 1
+    return count * multiplier / (time.perf_counter() - start)
+
+
+def measure_ops(op_names: "list[str] | None", runs: int,
+                min_time_s: float = 0.3) -> "dict[str, list[float]]":
+    """Real measurement: one runtime, ``runs`` interleaved rounds over
+    the op program (interleaving spreads slow-system windows across ops
+    instead of concentrating them in one). Tests inject a fake in its
+    place — the gate logic below never touches the runtime."""
+    import ray_tpu
+    ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024 * 1024,
+                 log_to_driver=False)
+    try:
+        program = _ops_program()
+        if op_names:
+            program = {k: v for k, v in program.items() if k in op_names}
+        samples: dict[str, list[float]] = {k: [] for k in program}
+        for _ in range(runs):
+            for name, (fn, mult) in program.items():
+                samples[name].append(_rate(fn, mult, min_time_s))
+        return samples
+    finally:
+        ray_tpu.shutdown()
+
+
+def median(xs: "list[float]") -> float:
+    s = sorted(xs)
+    n = len(s)
+    return s[n // 2] if n % 2 else (s[n // 2 - 1] + s[n // 2]) / 2.0
+
+
+def mad(xs: "list[float]") -> float:
+    m = median(xs)
+    return median([abs(x - m) for x in xs])
+
+
+def summarize(samples: "dict[str, list[float]]") -> dict:
+    return {name: {"median": median(xs), "mad": mad(xs),
+                   "samples": [round(x, 1) for x in xs]}
+            for name, xs in samples.items() if xs}
+
+
+def noise_band(base: dict, *, floor: float = 0.25, k: float = 4.0) -> float:
+    """Relative tolerance for one op: k*MAD/median of the baseline
+    samples, floored — a band the committed baseline itself defines, so
+    a noisy op self-widens instead of flapping the gate."""
+    m = base.get("median") or 0.0
+    if m <= 0:
+        return floor
+    return max(floor, k * (base.get("mad") or 0.0) / m)
+
+
+def compare(current: dict, baseline: dict, *, floor: float = 0.25,
+            k: float = 4.0) -> "tuple[dict, list[str]]":
+    """Gate: per-op report + the list of regressed op names. Ops absent
+    from the baseline (newly added) report ratio=None and never gate."""
+    report: dict = {}
+    regressions: list[str] = []
+    for name, cur in current.items():
+        base = baseline.get("ops", {}).get(name)
+        if base is None:
+            report[name] = {"median": cur["median"], "ratio": None,
+                            "status": "no-baseline"}
+            continue
+        band = noise_band(base, floor=floor, k=k)
+        ratio = cur["median"] / base["median"] if base["median"] else None
+        regressed = ratio is not None and ratio < 1.0 - band
+        report[name] = {
+            "median": round(cur["median"], 1),
+            "baseline_median": round(base["median"], 1),
+            "ratio": round(ratio, 4) if ratio is not None else None,
+            "band": round(band, 4),
+            "status": "REGRESSION" if regressed else "ok",
+        }
+        if regressed:
+            regressions.append(name)
+    return report, regressions
+
+
+def _parse_slowdowns(specs: "list[str]") -> "dict[str, float]":
+    out: dict[str, float] = {}
+    for spec in specs or []:
+        name, _, factor = spec.partition("=")
+        out[name] = float(factor or "2.0")
+    return out
+
+
+def run_sentinel(argv: "list[str] | None" = None,
+                 measure=measure_ops) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--runs", type=int, default=DEFAULT_RUNS)
+    p.add_argument("--ops", help="comma-separated op subset")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="record this run as the committed baseline")
+    p.add_argument("--json", action="store_true")
+    p.add_argument("--baseline", default=BASELINE_PATH)
+    p.add_argument("--trajectory", default=TRAJECTORY_PATH)
+    p.add_argument("--noise-floor", type=float, default=0.25)
+    p.add_argument("--mad-k", type=float, default=4.0)
+    p.add_argument("--inject-slowdown", action="append", metavar="OP=F",
+                   help="divide OP's measured rates by F (self-test)")
+    args = p.parse_args(argv)
+
+    op_names = args.ops.split(",") if args.ops else None
+    samples = measure(op_names, args.runs)
+    for name, factor in _parse_slowdowns(args.inject_slowdown).items():
+        if name in samples:
+            samples[name] = [x / factor for x in samples[name]]
+    current = summarize(samples)
+
+    if args.write_baseline:
+        baseline = {"created": round(time.time(), 1), "runs": args.runs,
+                    "ops": current}
+        with open(args.baseline, "w") as f:
+            json.dump(baseline, f, indent=2, sort_keys=True)
+            f.write("\n")
+        out = {"wrote_baseline": args.baseline, "ops": current}
+        print(json.dumps(out) if args.json else
+              f"perf_sentinel: baseline written -> {args.baseline} "
+              f"({len(current)} ops, {args.runs} runs)")
+        _append_trajectory(args.trajectory, args.runs, current, [], None)
+        return 0
+
+    try:
+        with open(args.baseline) as f:
+            baseline = json.load(f)
+    except FileNotFoundError:
+        print(f"perf_sentinel: no baseline at {args.baseline} — run with "
+              "--write-baseline first", file=sys.stderr)
+        return 2
+
+    report, regressions = compare(current, baseline,
+                                  floor=args.noise_floor, k=args.mad_k)
+    _append_trajectory(args.trajectory, args.runs, current, regressions,
+                       report)
+    if args.json:
+        print(json.dumps({"report": report, "regressions": regressions}))
+    else:
+        for name, r in sorted(report.items()):
+            ratio = ("      -" if r.get("ratio") is None
+                     else f"{r['ratio']:7.3f}")
+            print(f"{name:<22} median {r['median']:>12,.1f}/s  "
+                  f"ratio {ratio}  [{r['status']}]")
+        if regressions:
+            print(f"perf_sentinel: REGRESSION in {', '.join(regressions)}",
+                  file=sys.stderr)
+        else:
+            print("perf_sentinel: ok (within noise bands)")
+    return 1 if regressions else 0
+
+
+def _append_trajectory(path: str, runs: int, current: dict,
+                       regressions: "list[str]",
+                       report: "dict | None") -> None:
+    entry = {"ts": round(time.time(), 1), "runs": runs,
+             "ops": {k: {"median": round(v["median"], 1),
+                         "mad": round(v["mad"], 1)}
+                     for k, v in current.items()},
+             "regressions": regressions}
+    if report:
+        entry["ratios"] = {k: r.get("ratio") for k, r in report.items()}
+    with open(path, "a") as f:
+        f.write(json.dumps(entry, sort_keys=True) + "\n")
+
+
+if __name__ == "__main__":
+    sys.exit(run_sentinel())

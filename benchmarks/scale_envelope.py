@@ -488,6 +488,30 @@ def _run_sections(p: dict, results: dict) -> dict:
         "baselined": len(suppressed),
         "elapsed_s": round(lint_dt, 3),
     }
+
+    # 12. Continuous-profiling plane: the perf-regression sentinel run
+    #    against its committed baseline (benchmarks/perf_baseline.json).
+    #    SCALE.json records the per-op ratios and whether the gate
+    #    tripped — the envelope's own "did this tree get slower" bit;
+    #    flamegraph diffs (ray-tpu profile --diff) answer the WHERE.
+    t0 = time.time()
+    try:
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "benchmarks", "perf_sentinel.py"),
+             "--json", "--runs", "3"],
+            capture_output=True, timeout=600,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        sent = json.loads(proc.stdout.decode().strip().splitlines()[-1])
+        results["profiling"] = {
+            "sentinel_exit": proc.returncode,
+            "regressions": sent.get("regressions", []),
+            "ratios": {k: r.get("ratio")
+                       for k, r in sent.get("report", {}).items()},
+            "elapsed_s": round(time.time() - t0, 1),
+        }
+    except Exception as e:  # noqa: BLE001 — envelope records, not gates
+        results["profiling"] = {"error": str(e)}
     return results
 
 

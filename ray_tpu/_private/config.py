@@ -349,6 +349,30 @@ class Config:
     # Bounded head-side crash report table (oldest evicted past this).
     crash_reports_max: int = 256
 
+    # Continuous profiling plane (_private/profplane.py): every runtime
+    # process arms a duty-cycled sampling profiler at boot (kill switch
+    # RAY_TPU_PROFILING_ENABLED=0; rate/duty knobs RAY_TPU_PROFILE_HZ /
+    # RAY_TPU_PROFILE_DUTY_CYCLE — env-only: read pre-runtime and
+    # inherited by every spawned process). Window summaries piggyback
+    # on the amortized report casts; the head keeps a bounded cluster
+    # table keyed (node, role, window).
+    profiling_window_s: float = 5.0          # summary cadence (= report)
+    profiling_table_max: int = 4096          # owner-side folded stacks
+    profiling_report_stacks: int = 64        # top-K per shipped window
+    profiling_sidecar_stacks: int = 200      # stacks in the crash sidecar
+    # GIL-starvation exemplar trigger: exec wall >= min_wall_s AND
+    # cpu <= wall * cpu_ratio pins the window's profile as an exemplar.
+    profiling_gil_min_wall_s: float = 0.5
+    profiling_gil_cpu_ratio: float = 0.25
+    # Head-side cluster profile table bound (oldest UNPINNED window
+    # evicted past it; regression-pinned windows survive).
+    cluster_profile_max_windows: int = 512
+    # Phase-regression pinning: a queue_wait/dispatch p95 above
+    # factor * trailing median (given >= min_count observations) pins
+    # the head/shard flamegraphs for that window.
+    profiling_regression_factor: float = 2.0
+    profiling_regression_min_count: int = 200
+
     def apply_overrides(self, overrides: dict | None = None) -> "Config":
         cfg = dataclasses.replace(self)
         for f in dataclasses.fields(cfg):
@@ -396,6 +420,18 @@ ENV_KNOBS = {
         "operator", "0 disables anonymous usage-stats reporting"),
     "RAY_TPU_WORKER_PROFILE": (
         "operator", "1 arms the worker-side profiler at boot"),
+    "RAY_TPU_PROFILING_ENABLED": (
+        "operator", "0 kills the continuous profiling plane: no "
+        "sampler thread, no profile report fields, bit-identical "
+        "report casts"),
+    "RAY_TPU_PROFILE_HZ": (
+        "operator", "continuous-profiler sample rate during active "
+        "bursts (default 19 Hz; prime avoids aliasing with periodic "
+        "runtime loops)"),
+    "RAY_TPU_PROFILE_DUTY_CYCLE": (
+        "operator", "fraction of each sampling cycle the continuous "
+        "profiler is active (default 0.2 — steady-state cost is "
+        "duty * hz stack walks/s per process)"),
     "RAY_TPU_RESOURCE_SYNC_PERIOD_S": (
         "operator", "resource-view publish cadence (seconds)"),
     "RAY_TPU_RESOURCE_SYNC_SNAPSHOT_TICKS": (

@@ -189,6 +189,24 @@ def beacon_path(crash_dir: str, worker_id: str) -> str:
     return os.path.join(crash_dir, f"{worker_id}.beacon")
 
 
+def profile_path(crash_dir: str, worker_id: str) -> str:
+    """The continuous profiler's last-window sidecar (profplane.py):
+    one bounded JSON file next to the beacon, overwritten atomically
+    per window — readable after SIGKILL like the beacon."""
+    return os.path.join(crash_dir, f"{worker_id}.profile")
+
+
+def read_profile_sidecar(path: str) -> "dict | None":
+    """Best-effort read of a dead worker's last profile window (the
+    "what it was burning CPU on" half of the post-mortem)."""
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return rec if isinstance(rec, dict) else None
+
+
 # ----------------------------------------------------------------------
 # the beacon
 
@@ -431,6 +449,8 @@ def collect_report(worker_id: str, node_id: "str | None",
         crash_text=crash_text, oom_killed=oom_killed)
     beacon = read_beacon(beacon_path(crash_dir, worker_id)) \
         if crash_dir else None
+    profile = read_profile_sidecar(profile_path(crash_dir, worker_id)) \
+        if crash_dir else None
     report = {
         "worker_id": worker_id,
         "node_id": node_id,
@@ -443,6 +463,10 @@ def collect_report(worker_id: str, node_id: "str | None",
         "stack": stack_excerpt(crash_text),
         "log_tail": read_log_tail(log_path),
         "beacon": beacon,
+        # Continuous-profiler join (profplane sidecar): the dead
+        # worker's last sampled window — where its CPU went right
+        # before the death, even after SIGKILL.
+        "profile": profile,
         "source": source,
         "ts": time.time(),
     }

@@ -258,6 +258,29 @@ class PlacementGroupRecord:
         return remaining.fits(demand)
 
 
+def _hist_quantile_dict(h: dict, q: float) -> "float | None":
+    """Linear-interpolated quantile from an exported phase histogram
+    dict ({boundaries, buckets, sum, count} — PhaseHistogram.to_dict
+    shape). The open last bucket reports its lower edge (cannot
+    interpolate into +inf). Used by the profiling plane's
+    phase-regression sentinel."""
+    total = h.get("count") or 0
+    if not total:
+        return None
+    target = q * total
+    bounds = list(h["boundaries"])
+    cum = 0.0
+    for i, c in enumerate(h["buckets"]):
+        if cum + c >= target and c:
+            lo = bounds[i - 1] if i else 0.0
+            if i >= len(bounds):
+                return lo
+            hi = bounds[i]
+            return lo + (hi - lo) * (target - cum) / c
+        cum += c
+    return bounds[-1] if bounds else None
+
+
 class Head:
     """The head service. Runs inside the driver process (threads)."""
 
@@ -415,6 +438,29 @@ class Head:
         # killed): suspect key -> record. Swept by the health loop.
         self.leak_suspects: dict[str, dict] = {}
         self._last_leak_sweep = 0.0
+        # --- continuous profiling plane (profplane.py) ---
+        # Cluster profile table: (node, role, window_index) -> merged
+        # window record {"node","role","ident","pid","start","end",
+        # "samples","folded",...}. Window index = floor(end_ts /
+        # profiling_window_s) so summaries from different processes on
+        # the same node+role land in one mergeable bucket. Bounded FIFO
+        # (cluster_profile_max_windows); eviction skips PINNED windows
+        # (phase-regression exemplars) until they age past the pin cap.
+        self.cluster_profile: dict[tuple, dict] = {}
+        self._profile_fifo: deque[tuple] = deque()
+        self.profile_stats = {"windows_total": 0, "dropped_windows": 0,
+                              "gil_exemplars": 0, "pinned": 0}
+        # GIL-starvation exemplars (wall >> cpu tasks auto-captured by
+        # the owning worker's sampler): bounded recents, newest last.
+        self._gil_exemplars: deque[dict] = deque(maxlen=16)
+        # Phase-regression sentinel state: trailing p95 per phase
+        # (queue_wait/dispatch), sampled once per health tick from the
+        # cumulative phase histograms; a tick whose p95 exceeds the
+        # trailing median by profiling_regression_factor pins the
+        # head/shard flamegraph windows covering that tick.
+        self._phase_p95_hist: dict[str, deque] = {}
+        self._phase_prev_counts: dict[str, int] = {}
+        self._pinned_windows: set[tuple] = set()
         self.metrics: dict[str, Any] = {}
         # Core runtime counters (reference: DEFINE_stats core metric set,
         # src/ray/stats/metric_defs.h:46 — `tasks`, `actors`, …); gauges
@@ -506,6 +552,16 @@ class Head:
             )
         )
         self.node_resources = node_resources
+        # Continuous profiling plane: the head (or this dispatch shard)
+        # samples its own dispatch/health/send threads from boot. Its
+        # windows are merged into cluster_profile by the health tick —
+        # no rpc needed for the in-process role. Shards run the same
+        # Head class; the role tag keeps their flamegraphs separable so
+        # PR 17's per-shard CPU rows become attributable.
+        from ray_tpu._private import profplane
+
+        profplane.arm("shard" if self.shard is not None else "head",
+                      self.node_id)
         # TPU chip pool for visibility pinning (reference:
         # python/ray/_private/accelerators/tpu.py:193).
         self.tpu_chip_pool: dict[str, list[int]] = {
@@ -1266,6 +1322,8 @@ class Head:
                 if body.get("rpc") is not None:
                     self.rpc_reports[f"agent:{nid}"] = {
                         "counters": body["rpc"], "ts": time.time()}
+                if body.get("profile") is not None:
+                    self._profile_intake(nid, body["profile"])
         return None
 
     def _h_clock_sync(self, body: dict, conn):
@@ -1287,6 +1345,15 @@ class Head:
                     "ts": time.time()}
                 if body.get("census") is not None:
                     self._census_intake(cid, body["census"])
+                if body.get("profile") is not None:
+                    prof = body["profile"]
+                    # Node attribution: workers resolve through their
+                    # registration record; drivers (and anything else
+                    # without one) count against the head's node — in
+                    # this runtime the driver process runs there.
+                    rec = self.workers.get(prof.get("ident") or "")
+                    node = rec.node_id if rec is not None else self.node_id
+                    self._profile_intake(node, prof)
         if body.get("chaos_events"):
             self.task_events.extend(body["chaos_events"])
         if body.get("spans"):
@@ -1335,6 +1402,99 @@ class Head:
                     out.setdefault(oid, (cid, site))
         return out
 
+    # --- continuous profiling plane (profplane.py head side) ----------
+
+    def _profile_intake(self, node: str, prof: dict) -> None:
+        """lock held. Merge one process's sampler window summary into
+        the bounded cluster profile table. Key = (node, role, window
+        index): two workers on one node in the same window MERGE — the
+        table answers "where does this node+role burn CPU", the sidecar
+        next to the .beacon answers the per-process question."""
+        from ray_tpu._private import profplane
+
+        role = prof.get("role") or "worker"
+        end = float(prof.get("end") or time.time())
+        win = int(end // max(0.5, self.config.profiling_window_s))
+        key = (node, role, win)
+        rec = self.cluster_profile.get(key)
+        if rec is None:
+            rec = self.cluster_profile[key] = {
+                "node": node, "role": role, "window": win,
+                "start": float(prof.get("start") or end), "end": end,
+                "samples": 0, "sample_cost_s": 0.0, "dropped": 0,
+                "pids": [], "folded": {}}
+            self._profile_fifo.append(key)
+            self.profile_stats["windows_total"] += 1
+        rec["start"] = min(rec["start"], float(prof.get("start") or end))
+        rec["end"] = max(rec["end"], end)
+        rec["samples"] += int(prof.get("samples") or 0)
+        rec["sample_cost_s"] += float(prof.get("sample_cost_s") or 0.0)
+        rec["dropped"] += int(prof.get("dropped") or 0)
+        pid = prof.get("pid")
+        if pid is not None and pid not in rec["pids"]:
+            rec["pids"].append(pid)
+        profplane.merge_folded(rec["folded"], prof.get("folded") or {},
+                               cap=self.config.profiling_table_max)
+        gil = prof.get("gil_exemplar")
+        if gil:
+            self.profile_stats["gil_exemplars"] += 1
+            self._gil_exemplars.append(
+                {**gil, "node": node, "role": role, "window": win,
+                 "ident": prof.get("ident"), "ts": end})
+        # FIFO eviction, skipping pinned windows (phase-regression
+        # exemplars survive until the pin set itself is rotated).
+        cap = max(8, self.config.cluster_profile_max_windows)
+        while len(self.cluster_profile) > cap and self._profile_fifo:
+            victim = self._profile_fifo.popleft()
+            if victim in self._pinned_windows:
+                self._profile_fifo.append(victim)
+                if all(k in self._pinned_windows
+                       for k in self._profile_fifo):
+                    break  # everything pinned: stop, table stays at cap
+                continue
+            if self.cluster_profile.pop(victim, None) is not None:
+                self.profile_stats["dropped_windows"] += 1
+
+    def _profile_phase_sweep(self, now: float) -> None:
+        """lock held. Phase-regression sentinel: once per health tick,
+        read the cumulative queue_wait/dispatch histograms; if a
+        phase's p95 drifted past profiling_regression_factor x the
+        trailing median, PIN the head/shard flamegraph windows covering
+        this tick so the evidence outlives FIFO eviction."""
+        hists = self.task_events.hist_snapshot()
+        win = int(now // max(0.5, self.config.profiling_window_s))
+        for phase in ("queue_wait", "dispatch"):
+            h = hists.get(phase)
+            if not h or h.get("count", 0) < \
+                    self.config.profiling_regression_min_count:
+                continue
+            # Only sample when new observations landed since last tick
+            # (a quiet cluster must not re-pin on a stale p95 forever).
+            if h["count"] == self._phase_prev_counts.get(phase):
+                continue
+            self._phase_prev_counts[phase] = h["count"]
+            p95 = _hist_quantile_dict(h, 0.95)
+            if p95 is None:
+                continue
+            hist = self._phase_p95_hist.setdefault(phase, deque(maxlen=32))
+            if len(hist) >= 4:
+                med = sorted(hist)[len(hist) // 2]
+                if med > 0 and p95 > med * \
+                        self.config.profiling_regression_factor:
+                    for key in list(self.cluster_profile):
+                        if key[1] in ("head", "shard") and \
+                                key[2] in (win, win - 1):
+                            if key not in self._pinned_windows:
+                                self._pinned_windows.add(key)
+                                self.profile_stats["pinned"] += 1
+                                self.cluster_profile[key]["pinned"] = {
+                                    "phase": phase, "p95": p95,
+                                    "trailing_median": med, "ts": now}
+            hist.append(p95)
+        # Rotate the pin set: pins on evicted-from-fifo... windows whose
+        # record aged out of the table entirely have nothing to protect.
+        self._pinned_windows &= set(self.cluster_profile)
+
     def _health_loop(self) -> None:
         period = max(0.1, self.config.health_check_period_s)
         while not self._shutdown:
@@ -1354,6 +1514,20 @@ class Head:
                 >= self.config.object_leak_sweep_interval_s):
             self._last_leak_sweep = now
             self._leak_sweep(now)
+        # Profiling plane: the head/shard role is in-process — its
+        # sampler window merges straight into cluster_profile on the
+        # health tick (no rpc), and the same tick runs the
+        # phase-regression sentinel that pins suspect windows.
+        from ray_tpu._private import profplane
+
+        self_prof = profplane.report_summary()
+        with self.lock:
+            if self_prof is not None:
+                self._profile_intake(self.node_id, self_prof)
+            try:
+                self._profile_phase_sweep(now)
+            except Exception:
+                pass  # sentinel is observe-only; never wedge health
         with self.lock:
             silent = [
                 (nid, self.node_agents.get(nid))
@@ -4101,6 +4275,44 @@ class Head:
         return {"worker_id": worker_id, "pid": pid, "stacks": [],
                 "error": "no dump appeared (worker busy in native code?)"}
 
+    def _h_cluster_profile(self, body, conn):
+        """Continuous-profiling state query (util.state.cluster_profile
+        / `ray-tpu profile`): the bounded cluster profile table,
+        filtered by role/node/window, plus GIL-starvation exemplars and
+        plane counters. Sharded head: each shard contributes its own
+        table through the directory fanout — window records keep their
+        (node, role) identity so the merged view stays attributable."""
+        role = body.get("role")
+        node = body.get("node")
+        window = body.get("window")
+        with self.lock:
+            wins = []
+            for (n, r, w), rec in self.cluster_profile.items():
+                if role is not None and r != role:
+                    continue
+                if node is not None and n != node:
+                    continue
+                if window is not None and w != int(window):
+                    continue
+                rec = dict(rec)
+                rec["folded"] = dict(rec["folded"])
+                rec["pinned_flag"] = (n, r, w) in self._pinned_windows
+                wins.append(rec)
+            out = {
+                "windows": sorted(wins, key=lambda x: (x["end"],
+                                                       x["node"],
+                                                       x["role"])),
+                "gil_exemplars": list(self._gil_exemplars),
+                "stats": dict(self.profile_stats),
+                "window_s": self.config.profiling_window_s,
+            }
+        for rep in self._xshard_fanout("cluster_profile", body):
+            out["windows"].extend(rep.get("windows") or ())
+            out["gil_exemplars"].extend(rep.get("gil_exemplars") or ())
+            for k, v in (rep.get("stats") or {}).items():
+                out["stats"][k] = out["stats"].get(k, 0) + v
+        return out
+
     def _h_get_nodes(self, body, conn):
         with self.lock:
             nodes = [
@@ -5917,6 +6129,10 @@ class Head:
                 # Request-tracing plane: retained/exemplar trace counts,
                 # tail-fold aggregates, and owner-side span-buffer drops.
                 "tracing": self.traces.stats(),
+                # Continuous profiling plane: table occupancy, window
+                # churn, GIL exemplars, and per-role self-time top-N
+                # (ray_tpu_profile_* series in util/metrics).
+                "profiling": self._profiling_stats_locked(),
             }
         for r in self._xshard_fanout("runtime_stats", body):
             # Numeric merge: counters/gauges/deaths/sheds sum; per-
@@ -5942,7 +6158,44 @@ class Head:
                             or {}).items():
                 out["transfers"]["host_copies"][path] = \
                     out["transfers"]["host_copies"].get(path, 0) + n
+            # Profiling plane: counters sum; per-(role,frame) self-time
+            # sums (shards report role="shard", so the merged top-N
+            # attributes shard CPU separately from the parent head's).
+            rprof = r.get("profiling") or {}
+            for k in ("windows", "windows_total", "dropped_windows",
+                      "gil_exemplars", "pinned", "samples_total"):
+                out["profiling"][k] = (out["profiling"].get(k, 0)
+                                       + rprof.get(k, 0))
+            for role, frames in (rprof.get("self_time") or {}).items():
+                mine = out["profiling"]["self_time"].setdefault(role, {})
+                for frame, n in frames.items():
+                    mine[frame] = mine.get(frame, 0) + n
         return out
+
+    def _profiling_stats_locked(self) -> dict:
+        """lock held. Profiling-plane metric snapshot: plane counters
+        plus per-role leaf-frame self-time hits (top-N per role, the
+        Grafana "where do cycles go" panel's series)."""
+        from ray_tpu._private import profplane
+
+        self_time: dict[str, dict[str, int]] = {}
+        samples = 0
+        for (_n, role, _w), rec in self.cluster_profile.items():
+            samples += rec.get("samples", 0)
+            agg = self_time.setdefault(role, {})
+            for frame, hits in profplane.self_time(
+                    rec.get("folded") or {}).items():
+                agg[frame] = agg.get(frame, 0) + hits
+        top_n = 8
+        return {
+            "windows": len(self.cluster_profile),
+            "samples_total": samples,
+            "self_time": {
+                role: dict(sorted(frames.items(), key=lambda kv: kv[1],
+                                  reverse=True)[:top_n])
+                for role, frames in self_time.items()},
+            **dict(self.profile_stats),
+        }
 
     def _objects_stats_locked(self) -> dict:
         by_node_state: dict[str, dict] = {}

@@ -166,6 +166,13 @@ class NodeAgent:
             os.environ.get("TMPDIR", "/tmp"), "ray_tpu_agent",
             self.node_id, "logs")
         os.makedirs(self.log_dir, exist_ok=True)
+        # Continuous profiling plane: the agent samples its own service
+        # threads (reap/mem-watch/pull server) from boot; window
+        # summaries piggyback on agent_heartbeat. Armed after
+        # registration so the role is tagged with the minted node_id.
+        from ray_tpu._private import profplane
+
+        profplane.arm("agent", self.node_id)
         # Subscribe to the resource-view sync stream: triggers an
         # immediate full snapshot from the head; deltas stream in as
         # pubsub casts handled in _handle.
@@ -287,6 +294,13 @@ class NodeAgent:
                 "frames_sent": self.conn.frames_sent,
                 "calls_sent": self.conn.calls_sent,
                 "sent_kinds": dict(self.conn.sent_kinds)}}
+            # Profiling-plane piggyback: the agent's sampler window
+            # rides the heartbeat it already sends — zero new frames.
+            from ray_tpu._private import profplane
+
+            prof = profplane.report_summary()
+            if prof is not None:
+                body["profile"] = prof
             beat += 1
             try:
                 self.conn.cast("agent_heartbeat", body)

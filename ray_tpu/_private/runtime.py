@@ -325,6 +325,14 @@ class CoreRuntime:
         ids_mod.set_ref_removed_callback(self._on_ref_removed)
         ids_mod.set_borrow_callbacks(self._on_borrow_added,
                                      self._on_borrow_removed)
+        # --- continuous profiling plane (profplane.py): every runtime
+        # process samples its own threads on a duty cycle from boot;
+        # window summaries piggyback on rpc_report below. Workers armed
+        # themselves (role "worker") in worker.main before constructing
+        # the runtime — arm() is idempotent, so this is a no-op there.
+        from ray_tpu._private import profplane
+
+        profplane.arm(self.client_type or "driver", self.client_id)
         # --- direct-call plane (reference: direct_actor_transport.h +
         # the owner-side lease cache, normal_task_submitter.cc:29):
         # steady-state actor calls and lease-cached same-shape tasks go
@@ -398,6 +406,16 @@ class CoreRuntime:
             body["census"] = self._census.summary(
                 GLOBAL_CONFIG.object_census_report_groups,
                 GLOBAL_CONFIG.object_census_sample_ids)
+        # Profiling-plane piggyback: the continuous sampler's bounded
+        # window summary rides the SAME amortized cast (zero new
+        # per-call head frames; guard: test_dispatch_fastpath's
+        # profiling test). None when no window has elapsed yet or the
+        # RAY_TPU_PROFILING_ENABLED kill switch is off.
+        from ray_tpu._private import profplane
+
+        prof = profplane.report_summary()
+        if prof is not None:
+            body["profile"] = prof
         if not self.conn.closed:
             self.conn.cast_buffered("rpc_report", body)
 

@@ -341,10 +341,19 @@ class Worker:
         head. Pure-Python py-spy analogue — no ptrace, no py-spy
         dependency (reference: profile_manager.py:191). mode="memory"
         instead traces allocations for the window via tracemalloc (the
-        memray-attach analogue, profile_manager.py memory profiling)."""
+        memray-attach analogue, profile_manager.py memory profiling).
+
+        Unified with the continuous profiling plane (profplane.py):
+        when the armed sampler exists, the probe BORROWS its stream —
+        the sampler's rate is raised for the window and each sample is
+        teed to this probe — so on-demand + continuous sampling never
+        run two sampler threads or double-count. The pre-profplane
+        inline loop survives only as the kill-switch fallback."""
         import collections as _collections
         import time as _time
         import traceback as _traceback
+
+        from ray_tpu._private import profplane
 
         duration = min(30.0, max(0.1, float(body.get("duration_s", 5.0))))
         hz = min(200, max(1, int(body.get("hz", 50))))
@@ -352,42 +361,34 @@ class Worker:
             self._sample_memory(body, duration)
             return
         include_idle = bool(body.get("include_idle", False))
-        # py-spy's default --idle=false: threads parked in a wait
-        # primitive tell you nothing about where time GOES and dilute
-        # the shares of the threads doing work (a process has a dozen
-        # service threads parked in recv/wait at any instant). C
-        # builtins (time.sleep, sock.recv_into) leave NO Python frame,
-        # so the filter matches both the pure-Python wait wrappers by
-        # leaf name AND blocking-call leaves by their source line.
-        _IDLE_LEAVES = {"wait", "_recv_exact", "accept", "select",
-                        "poll", "_wait_for_tstate_lock"}
-        _IDLE_CALLS = (".sleep(", ".wait(", ".recv(", ".recv_into(",
-                       ".accept(", ".select(", ".poll(", ".acquire(")
-
-        def _is_idle(leaf) -> bool:
-            if leaf.name in _IDLE_LEAVES:
-                return True
-            line = leaf.line or ""
-            return any(c in line for c in _IDLE_CALLS)
-
-        me = threading.get_ident()
-        folded: _collections.Counter = _collections.Counter()
-        samples = 0
-        deadline = _time.time() + duration
-        while _time.time() < deadline:
-            for tid, frame in sys._current_frames().items():
-                if tid == me:
-                    continue
-                stack = _traceback.extract_stack(frame)
-                if not stack:
-                    continue
-                if not include_idle and _is_idle(stack[-1]):
-                    continue
-                folded[";".join(
-                    f"{os.path.basename(f.filename)}:{f.name}"
-                    for f in stack)] += 1
-            samples += 1
-            _time.sleep(1.0 / hz)
+        armed = profplane.sampler()
+        if armed is not None:
+            res = armed.borrow(duration, hz=hz, include_idle=include_idle)
+            samples, folded_out = res["samples"], res["folded"]
+        else:
+            me = threading.get_ident()
+            folded: _collections.Counter = _collections.Counter()
+            samples = 0
+            deadline = _time.time() + duration
+            while _time.time() < deadline:
+                for tid, frame in sys._current_frames().items():
+                    if tid == me:
+                        continue
+                    stack = _traceback.extract_stack(frame)
+                    if not stack:
+                        continue
+                    if not include_idle and \
+                            profplane.is_idle_leaf(stack[-1]):
+                        continue
+                    folded[profplane.fold_stack(stack)] += 1
+                samples += 1
+                _time.sleep(1.0 / hz)
+            folded_out = dict(folded.most_common(500))
+        # Top 500 folded stacks: "file:func;file:func;..." -> hits.
+        if len(folded_out) > 500:
+            folded_out = dict(sorted(folded_out.items(),
+                                     key=lambda kv: kv[1],
+                                     reverse=True)[:500])
         try:
             self.runtime.conn.cast("profile_result", {
                 "req_id": body.get("req_id"),
@@ -395,8 +396,7 @@ class Worker:
                 "samples": samples,
                 "duration_s": duration,
                 "hz": hz,
-                # Top 500 folded stacks: "file:func;file:func;..." -> hits.
-                "folded": dict(folded.most_common(500)),
+                "folded": folded_out,
             })
         except Exception:
             pass
@@ -883,6 +883,7 @@ class Worker:
 
         failed = False
         start = time.time()
+        mono0 = time.monotonic()
         # Wall-vs-CPU skew stamp (GIL-starved / IO-blocked tasks): two
         # thread_time() reads per task, carried on the lifecycle event.
         cpu0 = time.thread_time() if GLOBAL_CONFIG.task_events_enabled \
@@ -928,6 +929,14 @@ class Worker:
         finally:
             if cpu0 is not None:
                 spec._cpu_time = time.thread_time() - cpu0
+                # GIL/IO starvation join: a task whose wall time dwarfs
+                # its CPU time gets a profile exemplar pinned to the
+                # current sampling window (profplane.note_task_cpu).
+                from ray_tpu._private import profplane
+
+                profplane.note_task_cpu(
+                    spec.task_id, spec.name,
+                    time.monotonic() - mono0, spec._cpu_time)
             forensics.beacon_update(phase="idle")
             # A cancel that raced an already-running task left its id in
             # the set (running tasks are not interrupted); clear it so
@@ -1236,6 +1245,13 @@ def main() -> None:
     # the agent/head read post-mortem — even after SIGKILL.
     if GLOBAL_CONFIG.crash_forensics_enabled:
         forensics.arm()
+    # Continuous profiling plane (profplane.py): every worker samples
+    # its own threads on a duty cycle from boot; window summaries ride
+    # the runtime's amortized rpc_report cast and the last window
+    # persists to a sidecar next to the .beacon for crash forensics.
+    from ray_tpu._private import profplane
+
+    profplane.arm("worker", os.environ.get("RAY_TPU_WORKER_ID"))
     # Trace-correlated logs: worker stderr lands in {worker_id}.log, so
     # stamping [trace=<id>] into every log record made while a traced
     # task executes lets `ray-tpu logs --trace <id>` grep a request's

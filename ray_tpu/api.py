@@ -13,7 +13,7 @@ import os
 import threading
 from typing import Any, Sequence
 
-from ray_tpu._private import worker_context
+from ray_tpu._private import profplane, worker_context
 from ray_tpu._private.config import GLOBAL_CONFIG, Config
 from ray_tpu._private.ids import ObjectRef
 from ray_tpu._private.runtime import CoreRuntime
@@ -145,6 +145,10 @@ def _teardown_locked() -> None:
         pass
     if head is not None:
         head.shutdown()
+    # The driver's continuous profiler stands down with its runtime: a
+    # process that is no longer attached must not keep a sampler thread
+    # (init() re-arms).
+    profplane.disarm()
 
 
 def shutdown() -> None:

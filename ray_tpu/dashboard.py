@@ -9,6 +9,7 @@ frontend is out of scope). JSON API over aiohttp in a dedicated actor:
     GET /api/actors  /api/tasks  /api/objects  /api/workers  /api/jobs
     GET /api/task_summary
     GET /api/crashes /api/crashes/<worker_id>   post-mortem crash reports
+    GET /api/profiles   merged cluster profile table (continuous plane)
     GET /metrics     Prometheus exposition text
 """
 
@@ -260,6 +261,16 @@ class DashboardServer:
         if path.startswith("/api/crashes/"):
             report = us.get_crash_report(path[len("/api/crashes/"):])
             return report if report is not None else None
+        if path == "/api/profiles":
+            # Continuous profiling plane: the head's merged cluster
+            # profile table (always-on duty-cycled samples from every
+            # runtime process, keyed node/role/window) + GIL exemplars
+            # and plane counters. ?role=&node=&window= filter.
+            q = query or {}
+            return us.cluster_profile(
+                role=q.get("role") or None,
+                node=q.get("node") or None,
+                window=int(q["window"]) if q.get("window") else None)
         if path.startswith("/api/profile/"):
             # Live stack dump of a worker (reference:
             # dashboard/modules/reporter/profile_manager.py:191 — py-spy

@@ -504,6 +504,17 @@ def cmd_crashes(args) -> int:
             print(f"last task  {lt.get('name')} [{lt.get('task_id')}]")
         if report.get("beacon"):
             print(f"beacon   {json.dumps(report['beacon'])}")
+        prof = report.get("profile")
+        if prof:
+            # Profiling-plane sidecar: the worker's last sampled window
+            # — "what it was burning CPU on" at the end of its life.
+            print(f"\n--- last profile window ({prof.get('samples', 0)} "
+                  f"samples, {prof.get('role', 'worker')}) ---")
+            top = sorted((prof.get("folded") or {}).items(),
+                         key=lambda kv: -kv[1])[:8]
+            for stack, hits in top:
+                label = stack if len(stack) <= 90 else "…" + stack[-89:]
+                print(f"  {hits:>6}  {label}")
         for title, key in (("post-mortem stack", "stack"),
                            ("log tail", "log_tail")):
             lines = report.get(key) or []
@@ -527,6 +538,111 @@ def cmd_crashes(args) -> int:
               f"{r.get('exit_type', ''):20} {str(sig if sig is not None else ''):>8} "
               f"{lt:24} {r.get('exit_detail', '')}")
     print(f"\n{len(rows)} report(s)")
+    return 0
+
+
+def _merged_folded(windows: list, cap: int = 4096) -> dict:
+    from ray_tpu._private import profplane
+
+    merged: dict = {}
+    for w in windows:
+        profplane.merge_folded(merged, w.get("folded") or {}, cap=cap)
+    return merged
+
+
+def _print_folded(folded: dict, top: int, total_hint=None) -> None:
+    total = total_hint if total_hint is not None else \
+        sum(abs(v) for v in folded.values()) or 1
+    width = 30
+    rows = sorted(folded.items(), key=lambda kv: -abs(kv[1]))[:top]
+    for stack, hits in rows:
+        share = abs(hits) / total
+        bar = "#" * max(1, int(share * width)) if hits else ""
+        # Deep stacks: keep the leafward frames (where the time IS).
+        label = stack if len(stack) <= 100 else "…" + stack[-99:]
+        val = f"{hits:+.2%}" if isinstance(hits, float) else f"{hits:>6}"
+        print(f"  {val}  {bar:<{width}}  {label}")
+
+
+def cmd_profile(args) -> int:
+    """Continuous-profiling plane (`ray-tpu profile`): render the
+    head's merged cluster profile table as a text flamegraph summary —
+    always-on duty-cycled samples from every runtime process, merged
+    by (node, role, window). `--diff A B` prints the differential
+    folded output between two window indexes (per-sample share, so a
+    busy and a quiet window compare honestly)."""
+    from ray_tpu._private import profplane
+    from ray_tpu.util import state as us
+
+    _connect(args.address)
+    prof = us.cluster_profile(role=args.role, node=args.node,
+                              window=args.window)
+    windows = prof.get("windows") or []
+    if args.json:
+        print(json.dumps(prof, indent=2, default=str))
+        return 0
+    if args.diff:
+        a_win, b_win = (int(x) for x in args.diff)
+        a = _merged_folded([w for w in windows if w["window"] == a_win])
+        b = _merged_folded([w for w in windows if w["window"] == b_win])
+        if not a or not b:
+            print(f"no profile data for window "
+                  f"{a_win if not a else b_win}")
+            return 1
+        d = profplane.diff_folded(a, b)
+        print(f"differential profile: window {a_win} -> {b_win} "
+              f"(signed per-sample share; + = grew)")
+        _print_folded(d, args.top, total_hint=1.0)
+        return 0
+    if not windows:
+        print("no profile windows yet (plane disabled via "
+              "RAY_TPU_PROFILING_ENABLED=0, or no window elapsed — "
+              "windows ship every profiling_window_s on the amortized "
+              "report casts)")
+        return 1
+    merged = _merged_folded(windows)
+    if args.output:
+        with open(args.output, "w") as f:
+            for stack, hits in merged.items():
+                f.write(f"{stack} {hits}\n")
+        print(f"wrote {len(merged)} collapsed stacks to {args.output}")
+    if args.speedscope:
+        us.save_speedscope({"folded": merged, "worker_id": "cluster"},
+                           args.speedscope, name="ray_tpu cluster")
+        print(f"wrote speedscope profile to {args.speedscope}")
+    if args.output or args.speedscope:
+        return 0
+    stats = prof.get("stats") or {}
+    roles = sorted({w["role"] for w in windows})
+    nodes = sorted({w["node"] for w in windows})
+    pids = sorted({p for w in windows for p in (w.get("pids") or ())})
+    samples = sum(w.get("samples") or 0 for w in windows)
+    cost = sum(w.get("sample_cost_s") or 0.0 for w in windows)
+    print(f"cluster profile: {len(windows)} window(s), {samples} samples "
+          f"across {len(pids)} pid(s)  [roles: {', '.join(roles)};"
+          f" nodes: {', '.join(nodes)}]")
+    print(f"  plane: {stats.get('windows_total', 0)} windows merged, "
+          f"{stats.get('dropped_windows', 0)} evicted, "
+          f"{stats.get('pinned', 0)} pinned (phase regressions), "
+          f"{stats.get('gil_exemplars', 0)} GIL exemplars; "
+          f"sampling cost {cost:.3f}s")
+    pinned = [w for w in windows if w.get("pinned")]
+    for w in pinned:
+        pin = w["pinned"]
+        print(f"  PINNED window {w['window']} ({w['role']}@{w['node']}): "
+              f"{pin['phase']} p95 {pin['p95'] * 1e3:.1f}ms vs trailing "
+              f"median {pin['trailing_median'] * 1e3:.1f}ms")
+    print("\ntop self-time frames (leaf hits):")
+    _print_folded(profplane.self_time(merged), args.top)
+    print("\ntop stacks:")
+    _print_folded(merged, args.top)
+    exemplars = prof.get("gil_exemplars") or []
+    if exemplars:
+        print("\nGIL-starvation exemplars (wall >> cpu tasks):")
+        for ex in exemplars[-5:]:
+            print(f"  {ex.get('name')} [{(ex.get('task_id') or '')[:16]}] "
+                  f"wall {ex.get('wall_s')}s cpu {ex.get('cpu_s')}s "
+                  f"({ex.get('role')}@{ex.get('node')})")
     return 0
 
 
@@ -830,6 +946,29 @@ def main(argv: list[str] | None = None) -> int:
     s.add_argument("--limit", type=int, default=100)
     s.add_argument("--json", action="store_true")
     s.set_defaults(fn=cmd_crashes)
+
+    s = sub.add_parser(
+        "profile",
+        help="merged cluster flamegraph from the always-on profiling "
+             "plane (filter --role/--node/--window, diff windows, "
+             "export collapsed stacks / speedscope)")
+    s.add_argument("--address", required=True)
+    s.add_argument("--role", default=None,
+                   choices=["head", "shard", "agent", "worker", "driver"])
+    s.add_argument("--node", default=None, help="node id filter")
+    s.add_argument("--window", type=int, default=None,
+                   help="window index filter (floor(ts / window_s))")
+    s.add_argument("--diff", nargs=2, metavar=("A", "B"), default=None,
+                   help="differential folded output between two "
+                        "window indexes (per-sample share)")
+    s.add_argument("--speedscope", default=None, metavar="FILE",
+                   help="export merged profile as speedscope JSON")
+    s.add_argument("-o", "--output", default=None, metavar="FILE",
+                   help="write merged collapsed-stack lines "
+                        "(flamegraph.pl input)")
+    s.add_argument("--top", type=int, default=15)
+    s.add_argument("--json", action="store_true")
+    s.set_defaults(fn=cmd_profile)
 
     s = sub.add_parser("health",
                        help="overload + retry-plane health (budgets, "

@@ -327,6 +327,37 @@ def runtime_stats_text() -> str:
                 f'ray_tpu_object_host_copies_total'
                 f'{{path="{_escape_label_value(path)}"}} '
                 f"{xfer_copies[path]}")
+    # Continuous profiling plane: cluster profile table occupancy and
+    # churn, plus per-(role, frame) self-time hits — the top-N leaf
+    # frames per role, bounded by the head's top-N fold so the frame
+    # label cardinality stays fixed regardless of code shape.
+    profiling = snap.get("profiling") or {}
+    if profiling:
+        lines.append("# TYPE ray_tpu_profile_windows gauge")
+        lines.append(f"ray_tpu_profile_windows "
+                     f"{profiling.get('windows', 0)}")
+        lines.append("# TYPE ray_tpu_profile_pinned_windows gauge")
+        lines.append(f"ray_tpu_profile_pinned_windows "
+                     f"{profiling.get('pinned', 0)}")
+        for key, metric in (
+                ("windows_total", "ray_tpu_profile_windows_total"),
+                ("dropped_windows",
+                 "ray_tpu_profile_windows_dropped_total"),
+                ("samples_total", "ray_tpu_profile_samples_total"),
+                ("gil_exemplars",
+                 "ray_tpu_profile_gil_exemplars_total")):
+            lines.append(f"# TYPE {metric} counter")
+            lines.append(f"{metric} {profiling.get(key, 0)}")
+        self_time = profiling.get("self_time") or {}
+        if self_time:
+            lines.append("# TYPE ray_tpu_profile_self_hits gauge")
+            for role in sorted(self_time):
+                for frame in sorted(self_time[role]):
+                    lines.append(
+                        f'ray_tpu_profile_self_hits'
+                        f'{{role="{_escape_label_value(role)}",'
+                        f'frame="{_escape_label_value(frame)}"}} '
+                        f"{self_time[role][frame]}")
     # Cluster-wide head frame census (the zero-per-call-head-frames
     # property, scrapeable): total frames every reporting process has
     # sent the head.

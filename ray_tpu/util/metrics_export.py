@@ -223,6 +223,23 @@ def grafana_dashboard(extra_metrics: "list[str] | None" = None) -> dict:
         "Bps", 0, y))
     next_id += 1
     y += 8
+    # Continuous-profiling row (PR 18): self-time top-N frames per role
+    # (where the cluster's CPU cycles GO, from the always-on sampler)
+    # and the plane's window/exemplar churn.
+    panels.append(_panel(
+        next_id, "Profile self-time top frames (hits, by role)",
+        "topk(10, sum by (role, frame) (ray_tpu_profile_self_hits))",
+        "short", 0, y))
+    next_id += 1
+    panels.append(_panel(
+        next_id, "Profile windows / GIL exemplars / pins",
+        [("ray_tpu_profile_windows", "windows held"),
+         ("ray_tpu_profile_pinned_windows", "pinned"),
+         ("increase(ray_tpu_profile_gil_exemplars_total[5m])",
+          "GIL exemplars / 5m")],
+        "short", 12, y))
+    next_id += 1
+    y += 8
     for i, name in enumerate(extra_metrics or []):
         panels.append(_panel(next_id, name, name, "short",
                              (i % 2) * 12, y + (i // 2) * 8))

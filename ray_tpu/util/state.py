@@ -325,6 +325,31 @@ def profile_worker(worker_id: str, duration_s: float = 5.0, *,
                                       timeout=float(duration_s) + 20.0)
 
 
+def cluster_profile(*, role: "str | None" = None,
+                    node: "str | None" = None,
+                    window: "int | None" = None) -> dict:
+    """The continuous profiling plane's merged cluster table
+    (`ray-tpu profile` backs onto this): every process samples its own
+    threads on a duty cycle from boot (head, dispatch shards, node
+    agents, workers, drivers — role-tagged), window summaries ride the
+    amortized rpc_report/heartbeat casts, and the head merges them into
+    bounded windows keyed (node, role, window index).
+
+    Returns ``{"windows": [...], "gil_exemplars": [...], "stats": {...},
+    "window_s": float}``. Each window carries ``folded`` collapsed
+    stacks mergeable with profile_worker() output — render via
+    save_flamegraph()/save_speedscope() after merging with
+    profplane.merge_folded, or let the CLI do it."""
+    body: dict = {}
+    if role is not None:
+        body["role"] = role
+    if node is not None:
+        body["node"] = node
+    if window is not None:
+        body["window"] = int(window)
+    return _call("cluster_profile", body)
+
+
 def save_flamegraph(profile: dict, path: str) -> str:
     """Write a profile_worker() result as collapsed-stack lines — the
     input format of flamegraph.pl / inferno / speedscope's importer."""

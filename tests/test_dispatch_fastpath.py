@@ -417,6 +417,59 @@ def test_forensics_plane_zero_per_call_head_frames(cluster):
     ray_tpu.kill(a)
 
 
+def test_profiling_plane_zero_per_call_head_frames(cluster):
+    """The continuous profiler (enabled by DEFAULT) is a per-process
+    daemon sampler whose window summaries ride the amortized rpc_report
+    cast: steady-state direct actor calls still make ZERO per-call
+    synchronous head RPCs and ZERO head submissions, no dedicated
+    profile-report frame kind exists anywhere on the head conn, and
+    rpc_report traffic stays amortized (does not scale with call
+    count) — while the sampler is demonstrably armed and sampling."""
+    from ray_tpu._private import profplane
+
+    assert profplane.enabled()  # the default ships ON
+    s = profplane.sampler()
+    assert s is not None  # armed at init, before any call ran
+
+    @ray_tpu.remote
+    class Prof:
+        def ping(self, x=None):
+            return x
+
+        def sampler_armed(self):
+            from ray_tpu._private import profplane
+
+            w = profplane.sampler()
+            return w is not None and w.role == "worker"
+
+    a = Prof.remote()
+    rt = global_runtime()
+    assert ray_tpu.get(a.ping.remote(1)) == 1
+    # The worker really armed its own sampler at boot.
+    assert ray_tpu.get(a.sampler_armed.remote())
+    _wait(lambda: rt._direct.routes[a._actor_id].mode == "direct",
+          msg="actor route never entered direct mode")
+
+    N = 30
+    before_submit = rt.conn.sent_kinds.get("submit_actor_task", 0)
+    before_calls = rt.conn.calls_sent
+    before_push = _direct_push_count(rt)
+    before_report = rt.conn.sent_kinds.get("rpc_report", 0)
+    for i in range(N):
+        assert ray_tpu.get(a.ping.remote(i)) == i
+    assert rt.conn.sent_kinds.get("submit_actor_task", 0) == before_submit
+    assert rt.conn.calls_sent == before_calls
+    assert _direct_push_count(rt) - before_push == N
+    # No dedicated profile frame kind: the window summary is a FIELD of
+    # rpc_report, never its own cast (profile_worker/profile_result are
+    # the user-initiated on-demand probe, not a per-call path)...
+    assert "profile_report" not in rt.conn.sent_kinds
+    # ...and rpc_report stays amortized (interval-driven, not per-call).
+    assert (rt.conn.sent_kinds.get("rpc_report", 0)
+            - before_report) <= 2
+    ray_tpu.kill(a)
+
+
 # ------------------------------------------------------- metrics surface
 
 

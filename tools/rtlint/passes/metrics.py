@@ -65,10 +65,16 @@ DASHBOARD_MODULE = "ray_tpu/util/metrics_export.py"
 #                trace table is hard-bounded (trace_table_max=512,
 #                exemplar retention keeps a fixed-size working set)
 #   state      — object lifecycle states (fixed enum in object store)
+#   role       — profiling-plane process roles (fixed enum: head /
+#                shard / agent / worker / driver)
+#   frame      — ray_tpu_profile_self_hits only: the head folds
+#                self-time to a fixed top-N per role before exposition,
+#                so cardinality is N*roles regardless of code shape
 ALLOWED_LABELS = {
     "node_id", "node", "reason", "phase", "where", "le", "deployment",
     "model", "pool", "callsite", "peer", "job", "kind", "quantile",
     "trace_id", "name", "direction", "path", "target", "state",
+    "role", "frame",
 }
 
 _METRIC_CTORS = {"Gauge", "Counter", "Histogram", "Summary"}
