@@ -512,7 +512,114 @@ def _run_sections(p: dict, results: dict) -> dict:
         }
     except Exception as e:  # noqa: BLE001 — envelope records, not gates
         results["profiling"] = {"error": str(e)}
+
+    # 13. Telemetry-history + SLO alerting plane: what the embedded
+    #    tsdb retained over THIS run (the envelope is its own flood),
+    #    range-query latency against that live store, and the full
+    #    alert lifecycle — a seeded burn-rate SLO breach fires on the
+    #    head's own health loop, the record pins a real trace exemplar
+    #    and an overlapping profiling window (the cross-plane join an
+    #    operator pages on), then resolves into history when the
+    #    breach is withdrawn.
+    results["telemetry_history"] = _telemetry_section(nop)
     return results
+
+
+def _telemetry_section(nop) -> dict:
+    import statistics
+
+    import ray_tpu
+    from ray_tpu._private import traceplane
+    from ray_tpu._private.worker_context import get_head, global_runtime
+    from ray_tpu.util import state as us
+
+    head = get_head()
+    out: dict = {"enabled": head.tsdb is not None
+                 and head.alerts is not None}
+    if not out["enabled"]:
+        return out
+    # Default cadences are 10s; tighten the LIVE head's sweep for the
+    # lifecycle measurement (restored below — this is the last section).
+    saved = (head.config.tsdb_sample_interval_s,
+             head.config.alerts_eval_interval_s)
+    head.config.tsdb_sample_interval_s = 0.5
+    head.config.alerts_eval_interval_s = 0.5
+
+    # Evidence ground truth: a slow-rooted trace (what the serve proxy
+    # emits around an over-SLO request) so the join has an exemplar to
+    # pin even if earlier sections' traces were folded.
+    ctx = traceplane.mint_trace("scale-slo-breach")
+    now = time.time()
+    traceplane.buffer_span({
+        "event": "span", "name": "http.request", "kind": "proxy",
+        "trace_id": ctx[0], "span_id": ctx[1], "parent_span_id": "",
+        "pid": os.getpid(), "start": now - 1.0, "end": now,
+        "failed": False, "status": 200, "attributes": {}})
+    global_runtime().report_rpc_now()
+
+    # The flood the breach rides on (keeps phase gauges fresh).
+    ray_tpu.get([nop.remote(i) for i in range(500)])
+
+    t0 = time.time()
+    lat = []
+    for _ in range(50):
+        q0 = time.monotonic()
+        r = us.query_metrics("ray_tpu_tasks_finished_total",
+                             start=t0 - 1800)
+        lat.append((time.monotonic() - q0) * 1000)
+    out["query_p50_ms"] = round(statistics.median(lat), 3)
+    out["query_series"] = len(r["series"])
+
+    seeded = {
+        "name": "scale-seeded-slo-breach", "kind": "burn_rate",
+        "series": "ray_tpu_phase_p99_seconds",
+        "labels": {"phase": "exec"}, "over": 0.0, "objective": 0.99,
+        "fast_window_s": 300.0, "slow_window_s": 3600.0,
+        "burn_factor": 14.4, "for_s": 0.0, "severity": "page",
+        "summary": "seeded envelope breach"}
+    with head.alerts._lock:
+        head.alerts.rules.append(seeded)
+    fired = resolved = None
+    deadline = time.time() + 60
+    try:
+        while time.time() < deadline and fired is None:
+            fired = next((a for a in us.list_alerts()["alerts"]
+                          if a["name"] == seeded["name"]
+                          and a["state"] == "firing"), None)
+            time.sleep(0.25)
+        if fired is not None:
+            with head.alerts._lock:
+                seeded["series"] = "ray_tpu_series_nobody_emits"
+            while time.time() < deadline and resolved is None:
+                resolved = next(
+                    (a for a in us.list_alerts(history=True)["alerts"]
+                     if a["name"] == seeded["name"]
+                     and a["state"] == "resolved"), None)
+                time.sleep(0.25)
+    finally:
+        with head.alerts._lock:
+            if seeded in head.alerts.rules:
+                head.alerts.rules.remove(seeded)
+            head.alerts.active.pop(seeded["name"], None)
+        (head.config.tsdb_sample_interval_s,
+         head.config.alerts_eval_interval_s) = saved
+
+    snap = global_runtime().conn.call("runtime_stats", {}, timeout=30)
+    out["store"] = snap.get("telemetry")
+    out["rules"] = (snap.get("alerts") or {}).get("rules")
+    out["seeded_alert_fired"] = fired is not None
+    if fired is not None:
+        ev = fired.get("context") or {}
+        wins = ev.get("profile_windows") or []
+        out["fired_burn_fast"] = round(fired.get("burn_fast") or 0, 1)
+        out["trace_exemplars"] = ev.get("trace_exemplars") or []
+        out["profile_windows_overlapping"] = len(wins)
+        out["evidence_complete"] = bool(
+            out["trace_exemplars"]
+            and any(w.get("end", 0) >= fired["fired_at"]
+                    - (seeded["fast_window_s"] + 60) for w in wins))
+    out["seeded_alert_resolved"] = resolved is not None
+    return out
 
 
 def _hist_quantile(h: dict, q: float) -> "float | None":

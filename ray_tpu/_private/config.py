@@ -373,6 +373,28 @@ class Config:
     profiling_regression_factor: float = 2.0
     profiling_regression_min_count: int = 200
 
+    # Telemetry history + SLO alerting plane (_private/tsdb.py +
+    # _private/alertplane.py): the head retains bounded metric history
+    # in two downsampling tiers and evaluates a declarative alert-rule
+    # registry on the health tick. Ingestion rides the existing
+    # amortized casts only (kill switches RAY_TPU_TSDB_ENABLED /
+    # RAY_TPU_ALERTS_ENABLED — env-only: read pre-Config and in every
+    # process).
+    tsdb_raw_resolution_s: float = 10.0      # raw tier bucket width
+    tsdb_raw_retention_s: float = 1800.0     # raw tier: ~10s x 30min
+    tsdb_rollup_resolution_s: float = 60.0   # rollup tier bucket width
+    tsdb_rollup_retention_s: float = 86400.0  # rollups: 1min x 24h
+    tsdb_max_series: int = 2048              # past it: (other series) fold
+    tsdb_sample_interval_s: float = 10.0     # head self-sample cadence
+    alerts_eval_interval_s: float = 10.0     # rule sweep cadence
+    alerts_history_max: int = 256            # resolved-alert ring bound
+    alerts_max_rules: int = 128              # rule registry bound
+    # Stock SLO rule thresholds (alertplane.default_rules).
+    alert_phase_p95_warn_s: float = 2.0      # queue-wait p95 warn line
+    alert_serve_p99_slo_s: float = 2.0       # exec p99 SLO objective
+    alert_worker_death_rate: float = 0.2     # deaths/s over 5min = page
+    alert_kv_pages_min: float = 1.0          # free KV pages floor
+
     def apply_overrides(self, overrides: dict | None = None) -> "Config":
         cfg = dataclasses.replace(self)
         for f in dataclasses.fields(cfg):
@@ -432,6 +454,19 @@ ENV_KNOBS = {
         "operator", "fraction of each sampling cycle the continuous "
         "profiler is active (default 0.2 — steady-state cost is "
         "duty * hz stack walks/s per process)"),
+    "RAY_TPU_TSDB_ENABLED": (
+        "operator", "0 kills the embedded time-series store: no metric "
+        "history retained, query_metrics answers empty"),
+    "RAY_TPU_ALERTS_ENABLED": (
+        "operator", "0 kills the SLO alert engine: no rule evaluation, "
+        "empty alert surfaces"),
+    "RAY_TPU_ALERT_WEBHOOK": (
+        "operator", "URL POSTed a JSON alert record on every "
+        "firing/resolved transition (best-effort, 2s timeout)"),
+    "RAY_TPU_METRICS_TIMESTAMPS": (
+        "operator", "1 appends millisecond sample timestamps to gauge "
+        "lines in the Prometheus exposition (scrape-time vs "
+        "sample-time skew becomes visible)"),
     "RAY_TPU_RESOURCE_SYNC_PERIOD_S": (
         "operator", "resource-view publish cadence (seconds)"),
     "RAY_TPU_RESOURCE_SYNC_SNAPSHOT_TICKS": (

@@ -562,6 +562,22 @@ class Head:
 
         profplane.arm("shard" if self.shard is not None else "head",
                       self.node_id)
+        # --- telemetry history + SLO alerting plane (tsdb.py /
+        # alertplane.py) --- bounded embedded time-series store fed
+        # from the EXISTING amortized casts (rpc_report, heartbeats,
+        # report_metrics) plus this process's own tables sampled on the
+        # health tick, and the declarative alert engine evaluated on
+        # the same tick. Sharded head: each shard keeps its own store
+        # and engine; queries/alert listings fan out like every other
+        # state read.
+        from ray_tpu._private import alertplane
+        from ray_tpu._private import tsdb as tsdb_mod
+
+        self.tsdb = tsdb_mod.SeriesStore(config) \
+            if tsdb_mod.enabled() else None
+        self.alerts = alertplane.AlertEngine(config) \
+            if (self.tsdb is not None and alertplane.enabled()) else None
+        self._last_tsdb_sample = 0.0
         # TPU chip pool for visibility pinning (reference:
         # python/ray/_private/accelerators/tpu.py:193).
         self.tpu_chip_pool: dict[str, list[int]] = {
@@ -1324,6 +1340,22 @@ class Head:
                         "counters": body["rpc"], "ts": time.time()}
                 if body.get("profile") is not None:
                     self._profile_intake(nid, body["profile"])
+        # Telemetry history: the agent's tiny node-health sample (load
+        # average, available memory) becomes per-node gauge series —
+        # `ray-tpu top`'s node rows and the dashboard sparklines read
+        # these. Outside self.lock; the store has its own.
+        sys_sample = body.get("sys")
+        if sys_sample and self.tsdb is not None and nid:
+            now = time.time()
+            labels = {"node_id": nid}
+            for field, metric in (
+                    ("load1", "ray_tpu_node_load1"),
+                    ("mem_available_bytes",
+                     "ray_tpu_node_mem_available_bytes"),
+                    ("mem_total_bytes", "ray_tpu_node_mem_total_bytes")):
+                if sys_sample.get(field) is not None:
+                    self.tsdb.ingest(metric, labels, sys_sample[field],
+                                     now, "gauge")
         return None
 
     def _h_clock_sync(self, body: dict, conn):
@@ -1495,6 +1527,127 @@ class Head:
         # record aged out of the table entirely have nothing to protect.
         self._pinned_windows &= set(self.cluster_profile)
 
+    # --- telemetry history + SLO alerting (tsdb.py / alertplane.py) ---
+
+    def _telemetry_sweep(self, now: float) -> None:
+        """Health-tick half of the telemetry plane: (1) every
+        tsdb_sample_interval_s, snapshot this head's core tables into
+        the time-series store (derived phase p95/p99 gauges included —
+        the alert rules' latency SLOs read these, not raw histograms);
+        (2) run the alert-rule sweep (its own cadence gate). NEVER
+        called under self.lock — the snapshot takes it briefly."""
+        if self.tsdb is None:
+            return
+        if now - self._last_tsdb_sample >= \
+                self.config.tsdb_sample_interval_s:
+            self._last_tsdb_sample = now
+            with self.lock:
+                counters = dict(self.stats)
+                shed = dict(self.shed_counts)
+                deaths = dict(self.death_counts)
+                hists = self.task_events.hist_snapshot()
+                gauges = {
+                    "workers_alive": sum(
+                        1 for r in self.workers.values()
+                        if r.conn is not None),
+                    "actors_alive": sum(
+                        1 for a in self.actors.values()
+                        if a.state == "ALIVE"),
+                    "nodes_alive": 1 + len(self.node_agents),
+                    "tasks_pending": sum(
+                        len(q) for q in self.ready_queues.values()),
+                    "object_store_num_objects": len(self.objects),
+                    "object_store_used_bytes": self.arena.in_use,
+                    "mem_pressured_nodes": len(self.pressured_nodes),
+                    "admission_pending_total": self.pending_total,
+                }
+                head_frames = sum(
+                    ((r.get("counters") or {}).get("head") or {})
+                    .get("frames_sent", 0)
+                    for r in self.rpc_reports.values())
+            ing = self.tsdb.ingest
+            # Sharded head: every shard samples its OWN tables, and two
+            # shards' cumulative counters must stay distinct series —
+            # merging them into one would interleave unrelated counter
+            # values. The shard label is bounded by head_shards; a
+            # single-process head keeps the unlabelled pre-shard shape.
+            base = {} if self.shard is None \
+                else {"shard": str(self.shard.index)}
+            for name, v in counters.items():
+                ing(f"ray_tpu_{name}_total", base or None, v, now,
+                    "counter")
+            for name, v in gauges.items():
+                ing(f"ray_tpu_{name}", base or None, v, now, "gauge")
+            ing("ray_tpu_rpc_head_frames_total", base or None,
+                head_frames, now, "counter")
+            for where, v in shed.items():
+                ing("ray_tpu_tasks_shed_total",
+                    {**base, "where": where}, v, now, "counter")
+            for reason, v in deaths.items():
+                ing("ray_tpu_worker_deaths_total",
+                    {**base, "reason": reason}, v, now, "counter")
+            for phase, h in hists.items():
+                for q, metric in ((0.95, "ray_tpu_phase_p95_seconds"),
+                                  (0.99, "ray_tpu_phase_p99_seconds")):
+                    val = _hist_quantile_dict(h, q)
+                    if val is not None:
+                        ing(metric, {**base, "phase": phase}, val,
+                            now, "gauge")
+            # The head's own host is a node too: self-sample load/mem
+            # so `ray-tpu top` has node rows even in-process, where no
+            # node agent exists to piggyback them on a heartbeat.
+            from ray_tpu._private.node_agent import _sys_sample
+
+            sys_sample = _sys_sample()
+            labels = {"node_id": self.node_id}
+            for field, metric in (
+                    ("load1", "ray_tpu_node_load1"),
+                    ("mem_available_bytes",
+                     "ray_tpu_node_mem_available_bytes"),
+                    ("mem_total_bytes",
+                     "ray_tpu_node_mem_total_bytes")):
+                if sys_sample.get(field) is not None:
+                    ing(metric, labels, sys_sample[field], now, "gauge")
+        if self.alerts is not None:
+            self.alerts.evaluate(self.tsdb, now,
+                                 context_fn=self._alert_context)
+            self.alerts.note_resolved()
+
+    def _alert_context(self, rec: dict) -> dict:
+        """Cross-plane join, run once when an alert FIRES: pin the
+        evidence an operator needs — retained trace exemplar ids
+        (PR 11), profile windows overlapping the alert window (PR 18),
+        and crash reports in it (PR 4) — onto the alert record before
+        it ships to sinks."""
+        fired = rec.get("fired_at") or time.time()
+        rule = rec.get("rule") or {}
+        win = float(rule.get("fast_window_s")
+                    or rule.get("window_s") or 300.0)
+        start = fired - win
+        with self.lock:
+            exemplar_ids = (self.traces.stats()
+                            .get("exemplar_ids") or {})
+            profile_windows = [
+                {"node": n, "role": r, "window": w,
+                 "start": round(pw["start"], 3),
+                 "end": round(pw["end"], 3)}
+                for (n, r, w), pw in self.cluster_profile.items()
+                if pw["end"] >= start and pw["start"] <= fired][-8:]
+            crash_keys = ("worker_id", "node_id", "exit_type",
+                          "reason", "ts")
+            crashes = [
+                {k: r.get(k) for k in crash_keys if r.get(k) is not None}
+                for r in (self.crash_reports.get(w)
+                          for w in self._crash_fifo)
+                if r is not None and start <= (r.get("ts") or 0) <= fired
+            ][-8:]
+        return {
+            "trace_exemplars": sorted(set(exemplar_ids.values()))[:8],
+            "exemplar_kinds": dict(exemplar_ids),
+            "profile_windows": profile_windows,
+            "crash_reports": crashes,
+        }
+
     def _health_loop(self) -> None:
         period = max(0.1, self.config.health_check_period_s)
         while not self._shutdown:
@@ -1528,6 +1681,15 @@ class Head:
                 self._profile_phase_sweep(now)
             except Exception:
                 pass  # sentinel is observe-only; never wedge health
+        # Telemetry plane: sample the head's own runtime stats into the
+        # tsdb and run the alert-rule sweep — both amortized on this
+        # tick, both observe-only (never wedge health). Runs OUTSIDE
+        # self.lock: the sweep takes it briefly for the snapshot and
+        # the alert context join, and the engine has its own lock.
+        try:
+            self._telemetry_sweep(now)
+        except Exception:
+            pass
         with self.lock:
             silent = [
                 (nid, self.node_agents.get(nid))
@@ -4835,6 +4997,25 @@ class Head:
             if overflow > 0:
                 for key in list(self.metrics)[:overflow]:
                     del self.metrics[key]
+        # Telemetry history: user metric points land in the tsdb keyed
+        # by (name, tags) — reporters of one tagset interleave into one
+        # series (counters therefore answer min/max/sum honestly but
+        # rate only approximately across reporters). Rides this
+        # already-amortized flush cast; histograms keep their scalar
+        # sum (the per-bucket history lives in the rollup of the raw
+        # exposition, not here).
+        if self.tsdb is not None:
+            now = time.time()
+            for point in body["metrics"].values():
+                name = point.get("name")
+                if not name:
+                    continue
+                value = point.get("value")
+                if isinstance(value, dict):
+                    value = value.get("sum")
+                self.tsdb.ingest(name, point.get("tags"), value,
+                                 point.get("ts") or now,
+                                 point.get("type") or "gauge")
         return None
 
     def _h_get_metrics(self, body, conn):
@@ -4843,6 +5024,53 @@ class Head:
         for r in self._xshard_fanout("get_metrics", body):
             metrics.update(r.get("metrics") or {})
         return {"metrics": metrics}
+
+    def _h_query_metrics(self, body, conn):
+        """Telemetry-history range query (util.state.query_metrics /
+        `ray-tpu metrics query` / dashboard /api/metrics/query).
+        Sharded head: every shard holds its own store, so replies merge
+        by (name, labels) — same-keyed series from different shards
+        concatenate their buckets in time order."""
+        from ray_tpu._private import tsdb as tsdb_mod
+
+        series = [] if self.tsdb is None else self.tsdb.query(
+            body.get("name") or "", body.get("labels"),
+            body.get("start"), body.get("end"), body.get("step"))
+        for r in self._xshard_fanout("query_metrics", body):
+            series.extend(r.get("series") or [])
+        merged: dict[tuple, dict] = {}
+        for s in series:
+            key = (s["name"], tsdb_mod.label_key(s.get("labels")))
+            cur = merged.get(key)
+            if cur is None:
+                merged[key] = s
+            else:
+                cur["points"] = sorted(
+                    cur["points"] + s["points"], key=lambda b: b[0])
+        return {"series": list(merged.values()),
+                "enabled": self.tsdb is not None}
+
+    def _h_list_alerts(self, body, conn):
+        """Alert-table read (util.state.list_alerts / `ray-tpu alerts`
+        / dashboard /api/alerts): active (pending+firing) records,
+        optionally the resolved history, plus engine counters. Each
+        shard evaluates its own rules over its own store; rows carry
+        the rule name so merged views stay attributable."""
+        include_history = bool(body.get("history"))
+        alerts = [] if self.alerts is None \
+            else self.alerts.list(include_history)
+        stats = {} if self.alerts is None else self.alerts.stats()
+        for r in self._xshard_fanout("list_alerts", body):
+            alerts.extend(r.get("alerts") or [])
+            for k, v in (r.get("stats") or {}).items():
+                if isinstance(v, (int, float)):
+                    stats[k] = stats.get(k, 0) + v
+                elif isinstance(v, dict):
+                    mine = stats.setdefault(k, {})
+                    for sk, sv in v.items():
+                        mine[sk] = mine.get(sk, 0) + sv
+        return {"alerts": alerts, "stats": stats,
+                "enabled": self.alerts is not None}
 
     def _h_worker_death(self, body, conn):
         """A node agent's reaper classified one of its workers' exits
@@ -6134,6 +6362,15 @@ class Head:
                 # (ray_tpu_profile_* series in util/metrics).
                 "profiling": self._profiling_stats_locked(),
             }
+        # Telemetry history + alerting plane self-metrics (outside
+        # self.lock — both keep their own): ray_tpu_tsdb_* gauges and
+        # the ray_tpu_alerts_firing{severity} exposition read these.
+        out["telemetry"] = self.tsdb.stats() if self.tsdb is not None \
+            else {"series": 0, "points": 0, "ingested_total": 0,
+                  "dropped_total": 0}
+        out["alerts"] = self.alerts.stats() if self.alerts is not None \
+            else {}
+        out["head_shards"] = 1 if self.shard is None else self.shard.total
         for r in self._xshard_fanout("runtime_stats", body):
             # Numeric merge: counters/gauges/deaths/sheds sum; per-
             # client rpc maps concat (client ids are disjoint between
@@ -6170,6 +6407,19 @@ class Head:
                 mine = out["profiling"]["self_time"].setdefault(role, {})
                 for frame, n in frames.items():
                     mine[frame] = mine.get(frame, 0) + n
+            # Telemetry + alert planes: per-shard stores/engines, so
+            # occupancy counters sum and the firing-by-severity map
+            # merges per key.
+            for k, v in (r.get("telemetry") or {}).items():
+                if isinstance(v, (int, float)):
+                    out["telemetry"][k] = out["telemetry"].get(k, 0) + v
+            for k, v in (r.get("alerts") or {}).items():
+                if isinstance(v, (int, float)):
+                    out["alerts"][k] = out["alerts"].get(k, 0) + v
+                elif isinstance(v, dict):
+                    mine = out["alerts"].setdefault(k, {})
+                    for sk, sv in v.items():
+                        mine[sk] = mine.get(sk, 0) + sv
         return out
 
     def _profiling_stats_locked(self) -> dict:

@@ -29,6 +29,31 @@ def _host_id() -> str:
     return dataplane.host_id()
 
 
+def _sys_sample() -> dict:
+    """Node-health gauges for the heartbeat's telemetry piggyback:
+    1-minute load average plus /proc/meminfo available/total. Cheap
+    (two syscalls, one small read), best-effort (an exotic platform
+    just omits the field)."""
+    out: dict = {}
+    try:
+        out["load1"] = round(os.getloadavg()[0], 3)
+    except (OSError, AttributeError):
+        pass
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemTotal:"):
+                    out["mem_total_bytes"] = int(line.split()[1]) * 1024
+                elif line.startswith("MemAvailable:"):
+                    out["mem_available_bytes"] = \
+                        int(line.split()[1]) * 1024
+                if len(out) >= 3:
+                    break
+    except OSError:
+        pass
+    return out
+
+
 class _ZygotePid:
     """Popen-shaped handle for a worker forked by the node's zygote
     (the zygote is the OS parent and auto-reaps; this handle can only
@@ -301,6 +326,13 @@ class NodeAgent:
             prof = profplane.report_summary()
             if prof is not None:
                 body["profile"] = prof
+            # Telemetry-history piggyback: a tiny node-health sample
+            # (load average + memory) becomes per-node gauge series in
+            # the head's tsdb — `ray-tpu top`'s node rows. Same beacon,
+            # zero new frames.
+            sys_sample = _sys_sample()
+            if sys_sample:
+                body["sys"] = sys_sample
             beat += 1
             try:
                 self.conn.cast("agent_heartbeat", body)

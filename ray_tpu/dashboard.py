@@ -293,6 +293,36 @@ class DashboardServer:
         if path.startswith("/api/logs/"):
             name = path[len("/api/logs/"):]
             return _log_tail(name)
+        if path == "/api/metrics/query":
+            # Telemetry-history range query against the head's embedded
+            # tsdb (raw ~10s buckets for 30min, 1min rollups for 24h).
+            # ?name= is required; ?label.k=v filters; ?start/end/step
+            # shape the window (the Charts SPA view's data source).
+            q = query or {}
+            name = q.get("name")
+            if not name:
+                return {"error": "name= required", "series": []}
+            labels = {k[len("label."):]: v for k, v in q.items()
+                      if k.startswith("label.")}
+            return us.query_metrics(
+                name, labels or None,
+                float(q["start"]) if q.get("start") else None,
+                float(q["end"]) if q.get("end") else None,
+                float(q["step"]) if q.get("step") else None)
+        if path == "/api/alerts":
+            # SLO alert plane: active pending/firing records (with the
+            # cross-plane evidence pinned at fire time) + engine stats;
+            # ?history=1 adds the resolved ring.
+            q = query or {}
+            return us.list_alerts(
+                history=q.get("history") in ("1", "true"))
+        if path == "/api/grafana_alerts":
+            # Grafana-provisionable alert-rule bundle rendered from the
+            # SAME rule registry the head's engine evaluates — dashboards
+            # and alerting can never drift apart.
+            from ray_tpu.util import metrics_export
+
+            return metrics_export.grafana_alert_rules()
         if path == "/metrics":
             return um.prometheus_text()
         if path == "/api/prometheus_sd":

@@ -731,6 +731,247 @@ def cmd_health(args) -> int:
     return 0
 
 
+_SPARK_BARS = "▁▂▃▄▅▆▇█"
+
+
+def _sparkline(values: "list[float]", width: int = 24) -> str:
+    """Tiny block-char sparkline (fixed palette, no deps). Values are
+    resampled to ``width`` columns and scaled to the window's max."""
+    vals = [v for v in values if v is not None]
+    if not vals:
+        return ""
+    if len(vals) > width:
+        # Tail-biased resample: the most recent samples matter most.
+        stride = len(vals) / width
+        vals = [vals[min(len(vals) - 1, int(i * stride))]
+                for i in range(width)]
+    hi = max(vals)
+    lo = min(vals)
+    span = (hi - lo) or 1.0
+    return "".join(
+        _SPARK_BARS[int((v - lo) / span * (len(_SPARK_BARS) - 1))]
+        for v in vals)
+
+
+def _counter_rates(series: "list[dict]") -> "list[float]":
+    """Per-bucket rates from a query_metrics counter reply (summed
+    across matching series, consecutive-bucket deltas / dt)."""
+    buckets: dict[float, float] = {}
+    for s in series:
+        for b in s.get("points") or ():
+            buckets[b[0]] = buckets.get(b[0], 0.0) + b[5]
+    ordered = sorted(buckets.items())
+    rates = []
+    for (t0, v0), (t1, v1) in zip(ordered, ordered[1:]):
+        dt = t1 - t0
+        if dt > 0:
+            rates.append(max(0.0, v1 - v0) / dt)
+    return rates
+
+
+def cmd_top(args) -> int:
+    """Live cluster view (`ray-tpu top`): one refreshing screen with
+    nodes, shards, tasks/s (with history sparkline from the embedded
+    tsdb), phase p95s, firing alerts, and the hottest flamegraph leaf
+    from the continuous profiler — the "is the cluster healthy right
+    now" answer without a dashboard deployment."""
+    import time as _time
+
+    from ray_tpu._private.worker_context import global_runtime
+    from ray_tpu.util import state as us
+
+    _connect(args.address)
+    iterations = 1 if args.once else (args.iterations or 0)
+    shown = 0
+    while True:
+        snap = global_runtime().conn.call("runtime_stats", {},
+                                          timeout=10)
+        rate_q = us.query_metrics("ray_tpu_tasks_finished_total",
+                                  start=_time.time() - 600)
+        p95_q = us.query_metrics("ray_tpu_phase_p95_seconds",
+                                 start=_time.time() - 120)
+        load_q = us.query_metrics("ray_tpu_node_load1",
+                                  start=_time.time() - 120)
+        alerts = us.list_alerts()
+        if args.json:
+            print(json.dumps({
+                "gauges": snap.get("gauges"),
+                "counters": snap.get("counters"),
+                "tasks_shed": snap.get("tasks_shed"),
+                "telemetry": snap.get("telemetry"),
+                "alerts": alerts,
+                "tasks_per_s": _counter_rates(
+                    rate_q.get("series") or []),
+            }, indent=2, default=str))
+        else:
+            if not args.once and shown:
+                print("\x1b[2J\x1b[H", end="")
+            _render_top(snap, rate_q, p95_q, load_q, alerts)
+        shown += 1
+        if iterations and shown >= iterations:
+            return 0
+        try:
+            _time.sleep(max(0.2, args.interval))
+        except KeyboardInterrupt:
+            return 0
+
+
+def _render_top(snap: dict, rate_q: dict, p95_q: dict, load_q: dict,
+                alerts: dict) -> None:
+    import time as _time
+
+    g = snap.get("gauges") or {}
+    c = snap.get("counters") or {}
+    print(f"ray-tpu top — {_time.strftime('%H:%M:%S')}")
+    print(f"nodes {g.get('nodes_alive', '?')} "
+          f"(pressured {g.get('mem_pressured_nodes', 0)})   "
+          f"head shards {snap.get('head_shards', 1)}   "
+          f"workers {g.get('workers_alive', '?')}   "
+          f"actors {g.get('actors_alive', '?')}   "
+          f"pending {g.get('tasks_pending', 0)}")
+    rates = _counter_rates(rate_q.get("series") or [])
+    spark = _sparkline(rates)
+    now_rate = rates[-1] if rates else 0.0
+    shed = sum((snap.get("tasks_shed") or {}).values())
+    print(f"tasks: {c.get('tasks_finished', 0)} finished "
+          f"({now_rate:.1f}/s {spark}), "
+          f"{c.get('tasks_failed', 0)} failed, {shed} shed")
+    p95s = []
+    for s in (p95_q.get("series") or []):
+        pts = s.get("points") or []
+        if pts:
+            phase = (s.get("labels") or {}).get("phase", "?")
+            p95s.append(f"{phase} {pts[-1][5] * 1e3:.1f}ms")
+    if p95s:
+        print(f"phase p95: {'  '.join(sorted(p95s))}")
+    tele = snap.get("telemetry") or {}
+    print(f"tsdb: {tele.get('series', 0)} series, "
+          f"{tele.get('points', 0)} points retained "
+          f"({tele.get('dropped_total', 0)} folded)")
+    firing = [a for a in (alerts.get("alerts") or [])
+              if a.get("state") == "firing"]
+    if firing:
+        for a in firing:
+            print(f"ALERT [{a.get('severity')}] {a.get('name')} "
+                  f"value={a.get('value')} — {a.get('summary', '')}")
+    else:
+        print("alerts: none firing")
+    # Hottest self-time leaf across roles (the continuous profiler's
+    # one-line answer to "what is the cluster busy doing").
+    best = ("", "", 0)
+    for role, frames in ((snap.get("profiling") or {})
+                         .get("self_time") or {}).items():
+        for frame, hits in frames.items():
+            if hits > best[2]:
+                best = (role, frame, hits)
+    if best[2]:
+        print(f"top flame leaf: {best[1]} ({best[0]}, {best[2]} hits)")
+    loads = []
+    for s in (load_q.get("series") or []):
+        pts = s.get("points") or []
+        if pts:
+            nid = (s.get("labels") or {}).get("node_id", "?")
+            loads.append(f"  {nid}  load1 {pts[-1][5]:.2f}")
+    if loads:
+        print("nodes:")
+        for row in sorted(loads):
+            print(row)
+
+
+def cmd_alerts(args) -> int:
+    """SLO alert table (`ray-tpu alerts`): active pending/firing
+    records, `--history` adds the resolved ring. Firing rows print the
+    cross-plane evidence pinned at fire time (trace exemplars, profile
+    windows, crash reports)."""
+    import time as _time
+
+    from ray_tpu.util import state as us
+
+    _connect(args.address)
+    reply = us.list_alerts(history=args.history)
+    if args.format == "json":
+        print(json.dumps(reply, indent=2, default=str))
+        return 0
+    rows = reply.get("alerts") or []
+    stats = reply.get("stats") or {}
+    if not reply.get("enabled", True):
+        print("alert engine disabled (RAY_TPU_ALERTS_ENABLED=0)")
+        return 0
+    print(f"{stats.get('rules', 0)} rule(s): "
+          f"{stats.get('firing', 0)} firing, "
+          f"{stats.get('pending', 0)} pending, "
+          f"{stats.get('fired_total', 0)} fired / "
+          f"{stats.get('resolved_total', 0)} resolved lifetime")
+    if not rows:
+        print("no active alerts" + ("" if args.history
+                                    else " (--history for resolved)"))
+        return 0
+    now = _time.time()
+    for a in rows:
+        at = a.get("fired_at") or a.get("since")
+        ago = f"{now - at:.0f}s ago" if at else "?"
+        print(f"[{a.get('state', '?'):8}] {a.get('severity', '?'):4} "
+              f"{a.get('name')}  value={a.get('value')}  ({ago})")
+        if a.get("summary"):
+            print(f"           {a['summary']}")
+        ctx = a.get("context") or {}
+        if ctx.get("trace_exemplars"):
+            print(f"           traces: "
+                  f"{', '.join(ctx['trace_exemplars'][:4])}")
+        if ctx.get("profile_windows"):
+            wins = ctx["profile_windows"]
+            print(f"           profile windows: {len(wins)} overlapping "
+                  f"(e.g. {wins[-1]['role']}@{wins[-1]['node']} "
+                  f"window {wins[-1]['window']})")
+        if ctx.get("crash_reports"):
+            print(f"           crashes in window: "
+                  f"{len(ctx['crash_reports'])}")
+    return 0
+
+
+def cmd_metrics(args) -> int:
+    """Telemetry-history queries (`ray-tpu metrics query NAME`): range
+    reads from the head's embedded tsdb — raw ~10s buckets for the
+    last 30min, 1min rollups for 24h."""
+    import time as _time
+
+    from ray_tpu.util import state as us
+
+    _connect(args.address)
+    if args.metrics_cmd != "query":
+        raise SystemExit(f"unknown metrics command {args.metrics_cmd!r}")
+    labels = {}
+    for kv in args.label or ():
+        k, _, v = kv.partition("=")
+        labels[k] = v
+    start = args.start if args.start is not None else \
+        _time.time() - args.window
+    reply = us.query_metrics(args.name, labels or None, start,
+                             args.end, args.step)
+    if args.format == "json":
+        print(json.dumps(reply, indent=2, default=str))
+        return 0
+    series = reply.get("series") or []
+    if not reply.get("enabled", True):
+        print("telemetry store disabled (RAY_TPU_TSDB_ENABLED=0)")
+        return 0
+    if not series:
+        print(f"no retained points for {args.name!r} in the window")
+        return 1
+    for s in series:
+        pts = s.get("points") or []
+        if not pts:
+            continue
+        label = ",".join(f"{k}={v}" for k, v in
+                         sorted((s.get("labels") or {}).items()))
+        vals = [b[5] for b in pts]
+        print(f"{s['name']}{{{label}}}  [{s.get('kind')}] "
+              f"{len(pts)} bucket(s) @ {s.get('resolution_s', 0):.0f}s")
+        print(f"  last={vals[-1]:.6g} min={min(b[1] for b in pts):.6g} "
+              f"max={max(b[2] for b in pts):.6g}  {_sparkline(vals)}")
+    return 0
+
+
 def cmd_stop(args) -> int:
     """Stop the cluster: all agents, then the head (reference: `ray
     stop`)."""
@@ -976,6 +1217,52 @@ def main(argv: list[str] | None = None) -> int:
     s.add_argument("--address", required=True)
     s.add_argument("--json", action="store_true")
     s.set_defaults(fn=cmd_health)
+
+    s = sub.add_parser(
+        "top",
+        help="live cluster view: nodes, tasks/s with sparkline, phase "
+             "p95s, firing alerts, hottest flamegraph leaf")
+    s.add_argument("--address", required=True)
+    s.add_argument("--interval", type=float, default=2.0,
+                   help="refresh period in seconds")
+    s.add_argument("--once", action="store_true",
+                   help="render a single frame and exit")
+    s.add_argument("--iterations", type=int, default=0,
+                   help="exit after N frames (0 = until ^C)")
+    s.add_argument("--json", action="store_true")
+    s.set_defaults(fn=cmd_top)
+
+    s = sub.add_parser(
+        "alerts",
+        help="SLO alert table from the burn-rate engine "
+             "(--history adds resolved alerts)")
+    s.add_argument("--address", required=True)
+    s.add_argument("--history", action="store_true",
+                   help="include the resolved-alert ring")
+    s.add_argument("--format", choices=["table", "json"],
+                   default="table")
+    s.set_defaults(fn=cmd_alerts)
+
+    s = sub.add_parser(
+        "metrics",
+        help="query the head's embedded metric history "
+             "(raw 10s buckets 30min, 1min rollups 24h)")
+    msub = s.add_subparsers(dest="metrics_cmd", required=True)
+    m = msub.add_parser("query", help="range-query one series name")
+    m.add_argument("name", help="series name, e.g. ray_tpu_phase_p95_seconds")
+    m.add_argument("--address", required=True)
+    m.add_argument("--label", action="append", metavar="K=V",
+                   help="label filter (repeatable)")
+    m.add_argument("--start", type=float, default=None,
+                   help="unix start time (default: now - window)")
+    m.add_argument("--end", type=float, default=None)
+    m.add_argument("--step", type=float, default=None,
+                   help="coalesce buckets to this resolution")
+    m.add_argument("--window", type=float, default=600.0,
+                   help="lookback seconds when --start is omitted")
+    m.add_argument("--format", choices=["table", "json"],
+                   default="table")
+    s.set_defaults(fn=cmd_metrics)
 
     s = sub.add_parser(
         "lint",

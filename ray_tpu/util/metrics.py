@@ -9,6 +9,7 @@ keyed by (name, reporter, tags) and aggregated on read; `get_metrics_report`
 from __future__ import annotations
 
 import bisect
+import os
 import threading
 import time
 import uuid
@@ -175,6 +176,19 @@ def get_metrics_report() -> dict[str, dict]:
     return agg
 
 
+def _timestamp_suffix(now_ms: "int | None" = None) -> str:
+    """Optional millisecond sample timestamps on gauge lines
+    (exposition-format spec: ``name{labels} value [timestamp_ms]``),
+    OFF by default — turned on via RAY_TPU_METRICS_TIMESTAMPS so
+    scrape-time vs sample-time skew becomes visible. Counters stay
+    bare: their value IS cumulative, the scrape time is the honest
+    sample time."""
+    if os.environ.get("RAY_TPU_METRICS_TIMESTAMPS", "0").lower() \
+            not in ("1", "true", "yes", "on"):
+        return ""
+    return f" {now_ms if now_ms is not None else int(time.time() * 1000)}"
+
+
 def _escape_label_value(value) -> str:
     """Prometheus exposition label-value escaping: backslash, double
     quote, and newline must be escaped or the sample line is invalid
@@ -193,6 +207,7 @@ def runtime_stats_text() -> str:
     except Exception:
         return ""
     lines = []
+    ts_suffix = _timestamp_suffix()
     for name, value in snap.get("counters", {}).items():
         full = f"ray_tpu_{name}_total"
         lines.append(f"# TYPE {full} counter")
@@ -200,7 +215,7 @@ def runtime_stats_text() -> str:
     for name, value in snap.get("gauges", {}).items():
         full = f"ray_tpu_{name}"
         lines.append(f"# TYPE {full} gauge")
-        lines.append(f"{full} {value}")
+        lines.append(f"{full} {value}{ts_suffix}")
     for name, h in snap.get("histograms", {}).items():
         full = f"ray_tpu_phase_{name}_seconds"
         lines.append(f"# TYPE {full} histogram")
@@ -358,6 +373,38 @@ def runtime_stats_text() -> str:
                         f'{{role="{_escape_label_value(role)}",'
                         f'frame="{_escape_label_value(frame)}"}} '
                         f"{self_time[role][frame]}")
+    # Telemetry history + SLO alerting plane self-metrics: store
+    # occupancy (series/points), the (other series) fold counter, and
+    # the firing-alert gauge by severity — the plane watching the
+    # cluster must itself be watchable, or a melted store goes
+    # unnoticed until an alert silently fails to fire.
+    telemetry = snap.get("telemetry") or {}
+    if telemetry:
+        lines.append("# TYPE ray_tpu_tsdb_series gauge")
+        lines.append(f"ray_tpu_tsdb_series "
+                     f"{telemetry.get('series', 0)}{ts_suffix}")
+        lines.append("# TYPE ray_tpu_tsdb_points gauge")
+        lines.append(f"ray_tpu_tsdb_points "
+                     f"{telemetry.get('points', 0)}{ts_suffix}")
+        lines.append("# TYPE ray_tpu_tsdb_dropped_total counter")
+        lines.append(f"ray_tpu_tsdb_dropped_total "
+                     f"{telemetry.get('dropped_total', 0)}")
+    alerts = snap.get("alerts") or {}
+    if alerts:
+        from ray_tpu._private.alertplane import SEVERITIES
+
+        by_sev = alerts.get("firing_by_severity") or {}
+        lines.append("# TYPE ray_tpu_alerts_firing gauge")
+        for sev in SEVERITIES:
+            lines.append(
+                f'ray_tpu_alerts_firing'
+                f'{{severity="{_escape_label_value(sev)}"}} '
+                f"{by_sev.get(sev, 0)}{ts_suffix}")
+        for key, metric in (
+                ("fired_total", "ray_tpu_alerts_fired_total"),
+                ("resolved_total", "ray_tpu_alerts_resolved_total")):
+            lines.append(f"# TYPE {metric} counter")
+            lines.append(f"{metric} {alerts.get(key, 0)}")
     # Cluster-wide head frame census (the zero-per-call-head-frames
     # property, scrapeable): total frames every reporting process has
     # sent the head.
@@ -375,6 +422,7 @@ def prometheus_text() -> str:
     first, then user-defined Counter/Gauge/Histogram series."""
     lines = [runtime_stats_text().rstrip("\n")]
     lines = [ln for ln in lines if ln]
+    ts_suffix = _timestamp_suffix()
     for name, entry in get_metrics_report().items():
         lines.append(f"# TYPE {name} {entry['type']}")
         for tags, value in entry["series"].items():
@@ -394,7 +442,8 @@ def prometheus_text() -> str:
                 lines.append(f"{name}_sum{label} {value['sum']}")
                 lines.append(f"{name}_count{label} {value['count']}")
             else:
-                lines.append(f"{name}{label} {value}")
+                suffix = ts_suffix if entry["type"] == "gauge" else ""
+                lines.append(f"{name}{label} {value}{suffix}")
     return "\n".join(lines) + "\n"
 
 

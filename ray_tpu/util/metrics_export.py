@@ -261,3 +261,108 @@ def grafana_dashboard(extra_metrics: "list[str] | None" = None) -> dict:
 
 def grafana_dashboard_json(extra_metrics: "list[str] | None" = None) -> str:
     return json.dumps(grafana_dashboard(extra_metrics), indent=2)
+
+
+# ----------------------------------------------------------------------
+# Alert-rule export: the SAME registry the head's in-cluster engine
+# evaluates (alertplane.default_rules), rendered to a Grafana
+# provisioning bundle — external alerting can never drift from what the
+# cluster itself watches.
+
+def _selector(name: str, labels: "dict | None") -> str:
+    if not labels:
+        return name
+    sel = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return f"{name}{{{sel}}}"
+
+
+def _window(seconds: float) -> str:
+    s = int(seconds)
+    return f"{s // 60}m" if s >= 60 and s % 60 == 0 else f"{s}s"
+
+
+def _threshold_expr(rule: dict) -> str:
+    sel = _selector(rule["series"], rule.get("labels"))
+    win = _window(float(rule.get("window_s", 60.0)))
+    agg = rule.get("agg", "last")
+    if agg == "rate":
+        return f"sum(rate({sel}[{win}]))"
+    if agg == "avg":
+        return f"avg(avg_over_time({sel}[{win}]))"
+    if agg == "min":
+        return f"min(min_over_time({sel}[{win}]))"
+    if agg == "max":
+        return f"max(max_over_time({sel}[{win}]))"
+    return f"max({sel})"  # "last": newest sample per series, folded
+
+
+def _burn_expr(rule: dict, window_s: float) -> str:
+    budget = max(1e-9, 1.0 - float(rule["objective"]))
+    win = _window(window_s)
+    if rule.get("bad") and rule.get("total"):
+        bad = _selector(rule["bad"], rule.get("bad_labels"))
+        total = _selector(rule["total"], rule.get("total_labels"))
+        return (f"(sum(rate({bad}[{win}])) / "
+                f"sum(rate({total}[{win}]))) / {budget:g}")
+    sel = _selector(rule["series"], rule.get("labels"))
+    over = float(rule["over"])
+    # Time-fraction the gauge sat above the SLO bound, vs budget.
+    return (f"avg_over_time(({sel} > bool {over:g})[{win}:]) "
+            f"/ {budget:g}")
+
+
+def grafana_alert_rules(rules: "list[dict] | None" = None) -> dict:
+    """Grafana alert-provisioning bundle (apiVersion 1 file format)
+    rendered from the head's rule registry. Threshold rules become a
+    single-query classic condition; burn-rate rules render the
+    multi-window AND (fast > factor and slow > factor) exactly as the
+    in-cluster engine evaluates them."""
+    if rules is None:
+        from ray_tpu._private import alertplane
+        from ray_tpu._private.config import Config
+
+        rules = alertplane.default_rules(Config())
+    out_rules = []
+    for rule in rules:
+        if rule.get("kind") == "burn_rate":
+            factor = float(rule.get("burn_factor", 14.4))
+            fast = _burn_expr(rule,
+                              float(rule.get("fast_window_s", 300.0)))
+            slow = _burn_expr(rule,
+                              float(rule.get("slow_window_s", 3600.0)))
+            expr = f"({fast} > {factor:g}) and ({slow} > {factor:g})"
+        else:
+            op = rule.get("op", ">")
+            expr = (f"{_threshold_expr(rule)} {op} "
+                    f"{float(rule['threshold']):g}")
+        out_rules.append({
+            "uid": f"ray-tpu-{rule['name']}",
+            "title": rule["name"],
+            "condition": "A",
+            "for": _window(float(rule.get("for_s", 0.0))) if
+                   rule.get("for_s") else "0s",
+            "labels": {"severity": rule.get("severity", "warn"),
+                       "source": "ray_tpu"},
+            "annotations": {"summary": rule.get("summary", "")},
+            "data": [{
+                "refId": "A",
+                "relativeTimeRange": {"from": 3600, "to": 0},
+                "datasourceUid": "${datasource}",
+                "model": {"expr": expr, "refId": "A",
+                          "instant": True},
+            }],
+        })
+    return {
+        "apiVersion": 1,
+        "groups": [{
+            "orgId": 1,
+            "name": "ray_tpu_slo",
+            "folder": "ray_tpu",
+            "interval": "30s",
+            "rules": out_rules,
+        }],
+    }
+
+
+def grafana_alert_rules_json(rules: "list[dict] | None" = None) -> str:
+    return json.dumps(grafana_alert_rules(rules), indent=2)

@@ -350,6 +350,44 @@ def cluster_profile(*, role: "str | None" = None,
     return _call("cluster_profile", body)
 
 
+def query_metrics(name: str, labels: "dict | None" = None,
+                  start: "float | None" = None,
+                  end: "float | None" = None,
+                  step: "float | None" = None) -> dict:
+    """Range query against the head's embedded time-series store
+    (`ray-tpu metrics query` and the dashboard Charts view back onto
+    this). History is retained in two tiers — raw ~10s buckets for the
+    last ~30min, 1min rollups for ~24h — and the store answers from
+    whichever tier covers ``start`` (``step`` coarser than the tier
+    resolution resamples).
+
+    Returns ``{"series": [{"name", "labels", "kind", "resolution_s",
+    "points"}], "enabled": bool}``; each point is a
+    ``[ts, min, max, sum, count, last]`` aggregate bucket. Under a
+    sharded head every shard's store is queried and same-keyed series
+    merge. Empty when ``RAY_TPU_TSDB_ENABLED=0``."""
+    body: dict = {"name": name}
+    if labels:
+        body["labels"] = dict(labels)
+    if start is not None:
+        body["start"] = float(start)
+    if end is not None:
+        body["end"] = float(end)
+    if step is not None:
+        body["step"] = float(step)
+    return _call("query_metrics", body)
+
+
+def list_alerts(*, history: bool = False) -> dict:
+    """The SLO alert engine's table (`ray-tpu alerts` backs onto
+    this): active records (pending + firing) and, with
+    ``history=True``, the bounded resolved ring. Returns
+    ``{"alerts": [...], "stats": {...}, "enabled": bool}``; a firing
+    record pins its cross-plane evidence under ``context`` (trace
+    exemplar ids, overlapping profile windows, crash reports)."""
+    return _call("list_alerts", {"history": bool(history)})
+
+
 def save_flamegraph(profile: dict, path: str) -> str:
     """Write a profile_worker() result as collapsed-stack lines — the
     input format of flamegraph.pl / inferno / speedscope's importer."""

@@ -1,0 +1,699 @@
+"""Telemetry history + SLO alerting plane (tsdb.py / alertplane.py).
+
+Unit layer: ring-buffer tier bounds and downsampling, the
+``(other series)`` cardinality fold, window algebra, threshold
+firing→resolved lifecycle with for-duration hysteresis, multi-window
+burn-rate math on synthetic series, and the webhook sink against a
+real local HTTP server.
+
+E2E layer (module cluster, fast knobs): the head's health-tick
+self-sample populates the store, ``util.state.query_metrics`` range
+queries work, a seeded SLO violation fires a burn-rate alert whose
+record pins REAL cross-plane evidence (a retained trace exemplar id
+and an overlapping continuous-profiling window), then resolves into
+the history ring; kill switches empty every surface; the operator CLI
+(``ray-tpu top`` / ``alerts`` / ``metrics query``) renders and emits
+parseable JSON.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import alertplane, tsdb
+from ray_tpu._private.config import Config
+from ray_tpu._private.worker_context import get_head, global_runtime
+from ray_tpu.util import state as us
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(
+        num_cpus=4, object_store_memory=64 * 1024 * 1024,
+        _system_config={
+            "health_check_period_s": 0.2,
+            "tsdb_sample_interval_s": 0.25,
+            "alerts_eval_interval_s": 0.25,
+            "trace_slow_threshold_s": 0.01,
+            "profiling_window_s": 1.0,
+        })
+    yield
+    ray_tpu.shutdown()
+
+
+def _wait(pred, timeout=30.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        v = pred()
+        if v:
+            return v
+        time.sleep(0.05)
+    raise TimeoutError(f"never happened: {msg}")
+
+
+def _cfg(**over):
+    return Config().apply_overrides(over)
+
+
+# ---------------------------------------------------------------------------
+# tsdb unit: tiers, bounds, downsampling, fold
+
+
+def test_tsdb_tier_bounds_and_downsample():
+    cfg = _cfg(tsdb_raw_resolution_s=1.0, tsdb_raw_retention_s=10.0,
+               tsdb_rollup_resolution_s=5.0, tsdb_rollup_retention_s=60.0)
+    store = tsdb.SeriesStore(cfg)
+    t0 = 1000.0
+    for i in range(120):
+        store.ingest("m", {"a": "1"}, float(i), t0 + i)
+    # Ring bounds hold regardless of ingest volume.
+    s = store._series[("m", (("a", "1"),))]
+    assert len(s.raw.buckets) <= 10
+    assert len(s.rollup.buckets) <= 12
+    now = t0 + 119
+    # Recent window reads the raw tier at raw resolution...
+    res = store.query("m", {"a": "1"}, start=now - 5, now=now)
+    assert len(res) == 1 and res[0]["resolution_s"] == 1.0
+    assert res[0]["points"]
+    # ...a window reaching past raw retention reads the rollups...
+    res = store.query("m", start=now - 50, now=now)
+    assert res[0]["resolution_s"] == 5.0
+    # rollup buckets aggregate the raw samples they cover
+    b = res[0]["points"][0]
+    assert b[tsdb.COUNT] >= 2 and b[tsdb.MIN] < b[tsdb.MAX]
+    # ...and an explicit coarse step coalesces further.
+    res = store.query("m", start=now - 50, end=now, step=20.0, now=now)
+    pts = res[0]["points"]
+    assert all(p[tsdb.TS] % 20 == 0 for p in pts)
+    assert sum(p[tsdb.COUNT] for p in pts) >= 10
+
+
+def test_tsdb_bucket_aggregates_and_label_match():
+    cfg = _cfg(tsdb_raw_resolution_s=10.0)
+    store = tsdb.SeriesStore(cfg)
+    for v in (3.0, 1.0, 2.0):
+        store.ingest("g", {"pool": "p", "x": "y"}, v, 1005.0)
+    res = store.query("g", {"pool": "p"}, start=990, end=1010, now=1010)
+    assert len(res) == 1  # subset label match
+    b = res[0]["points"][0]
+    assert b[tsdb.MIN] == 1.0 and b[tsdb.MAX] == 3.0
+    assert b[tsdb.SUM] == 6.0 and b[tsdb.COUNT] == 3
+    assert b[tsdb.LAST] == 2.0
+    # Mismatched filter matches nothing; non-numeric values are dropped.
+    assert store.query("g", {"pool": "other"}, now=1010) == []
+    store.ingest("g", None, "not-a-number", 1006.0)
+    assert store.stats()["ingested_total"] == 3
+
+
+def test_tsdb_series_bound_folds_to_other():
+    cfg = _cfg(tsdb_max_series=8)
+    store = tsdb.SeriesStore(cfg)
+    for i in range(20):
+        store.ingest(f"series_{i}", None, 1.0, 1000.0 + i)
+    st = store.stats()
+    assert st["series"] == 9  # 8 real + the catch-all
+    assert st["dropped_total"] == 12
+    assert tsdb.OTHER_SERIES in store.names()
+    other = store.query(tsdb.OTHER_SERIES, now=1100)
+    assert sum(b[tsdb.COUNT] for b in other[0]["points"]) == 12
+
+
+def test_tsdb_window_algebra():
+    pts = [[0, 1.0, 3.0, 4.0, 2, 3.0], [10, 2.0, 8.0, 10.0, 2, 8.0]]
+    assert tsdb.agg_over(pts, "min") == 1.0
+    assert tsdb.agg_over(pts, "max") == 8.0
+    assert tsdb.agg_over(pts, "last") == 8.0
+    assert tsdb.agg_over(pts, "sum") == 14.0
+    assert tsdb.agg_over(pts, "avg") == pytest.approx(14.0 / 4)
+    assert tsdb.agg_over(pts, "rate") == pytest.approx((8.0 - 3.0) / 10)
+    assert tsdb.agg_over([pts[0]], "rate") == 0.0  # one bucket: no slope
+    assert tsdb.agg_over([], "avg") is None
+    with pytest.raises(ValueError):
+        tsdb.agg_over(pts, "median")
+
+
+# ---------------------------------------------------------------------------
+# alert engine unit: lifecycle, hysteresis, burn-rate math
+
+
+def _threshold_rule(**over):
+    rule = {
+        "name": "unit-threshold", "kind": "threshold", "series": "g",
+        "agg": "last", "window_s": 60.0, "op": ">", "threshold": 5.0,
+        "for_s": 0.0, "severity": "warn", "summary": "unit",
+    }
+    rule.update(over)
+    return rule
+
+
+def test_threshold_lifecycle_firing_then_resolved():
+    store = tsdb.SeriesStore(_cfg(tsdb_raw_resolution_s=1.0))
+    eng = alertplane.AlertEngine(_cfg(), rules=[_threshold_rule()])
+    t = 1000.0
+    store.ingest("g", None, 9.0, t)
+    fired = eng.evaluate(store, now=t, force=True)
+    assert [r["name"] for r in fired] == ["unit-threshold"]
+    assert eng.active["unit-threshold"]["state"] == "firing"
+    assert eng.fired_total == 1
+    # Still bad: stays firing, no duplicate fire.
+    store.ingest("g", None, 8.0, t + 2)
+    assert eng.evaluate(store, now=t + 2, force=True) == []
+    # Recovered: firing -> resolved, moved to history.
+    store.ingest("g", None, 1.0, t + 70)  # old samples age out of window
+    assert eng.evaluate(store, now=t + 70, force=True) == []
+    assert "unit-threshold" not in eng.active
+    assert eng.resolved_total == 1
+    hist = eng.list(include_history=True)
+    assert hist and hist[-1]["state"] == "resolved"
+    assert hist[-1]["resolved_at"] == t + 70
+    # note_resolved announces each resolution exactly once.
+    assert [r["name"] for r in eng.note_resolved()] == ["unit-threshold"]
+    assert eng.note_resolved() == []
+
+
+def test_threshold_for_duration_hysteresis():
+    """A breach shorter than for_s never fires — and the pending timer
+    RESETS on recovery (a second blip starts from zero)."""
+    store = tsdb.SeriesStore(_cfg(tsdb_raw_resolution_s=1.0))
+    eng = alertplane.AlertEngine(
+        _cfg(), rules=[_threshold_rule(for_s=10.0, window_s=5.0)])
+    t = 2000.0
+    store.ingest("g", None, 9.0, t)
+    assert eng.evaluate(store, now=t, force=True) == []
+    assert eng.active["unit-threshold"]["state"] == "pending"
+    # Blip ends before for_s: pending record vanishes without firing.
+    store.ingest("g", None, 1.0, t + 4)
+    assert eng.evaluate(store, now=t + 4, force=True) == []
+    assert eng.active == {} and eng.fired_total == 0
+    # Second breach must hold for the FULL for_s from its own start.
+    store.ingest("g", None, 9.0, t + 6)
+    assert eng.evaluate(store, now=t + 6, force=True) == []
+    store.ingest("g", None, 9.0, t + 10)
+    assert eng.evaluate(store, now=t + 10, force=True) == []
+    store.ingest("g", None, 9.0, t + 17)
+    fired = eng.evaluate(store, now=t + 17, force=True)
+    assert len(fired) == 1 and fired[0]["fired_at"] == t + 17
+
+
+def test_threshold_no_data_never_fires():
+    store = tsdb.SeriesStore(_cfg())
+    eng = alertplane.AlertEngine(
+        _cfg(), rules=[_threshold_rule(op="<", threshold=100.0)])
+    assert eng.evaluate(store, now=1000.0, force=True) == []
+    assert eng.active == {}
+
+
+def test_burn_rate_counter_pair_math():
+    """bad/total = 10% against a 99.9% objective => burn 100x."""
+    store = tsdb.SeriesStore(_cfg(tsdb_raw_resolution_s=1.0))
+    t = 5000.0
+    for i in range(0, 100, 2):
+        store.ingest("bad_total", None, float(i) * 0.1, t + i, "counter")
+        store.ingest("all_total", None, float(i), t + i, "counter")
+    rule = {"name": "b", "kind": "burn_rate", "bad": "bad_total",
+            "total": "all_total", "objective": 0.999,
+            "fast_window_s": 50.0, "slow_window_s": 200.0,
+            "burn_factor": 14.4, "for_s": 0.0, "severity": "page"}
+    now = t + 98
+    fast = alertplane.burn_rate(store, rule, 50.0, now)
+    slow = alertplane.burn_rate(store, rule, 200.0, now)
+    assert fast == pytest.approx(100.0, rel=0.01)
+    assert slow == pytest.approx(100.0, rel=0.01)
+    eng = alertplane.AlertEngine(_cfg(), rules=[rule])
+    fired = eng.evaluate(store, now=now, force=True)
+    assert len(fired) == 1
+    assert fired[0]["burn_fast"] == pytest.approx(100.0, rel=0.01)
+
+
+def test_burn_rate_requires_both_windows():
+    """Fast window hot but slow window cold => NO page (the multi-
+    window rule exists exactly to suppress this flap)."""
+    store = tsdb.SeriesStore(_cfg(tsdb_raw_resolution_s=1.0))
+    t = 6000.0
+    # 200s of clean traffic, then a 20s burst at the end: the burst is
+    # 90% errors (way past a 99% objective) but the full window's
+    # 18/218 ~ 8% keeps the SLOW burn under the factor.
+    for i in range(0, 220, 2):
+        store.ingest("all_total", None, float(i), t + i, "counter")
+        bad = 0.0 if i < 200 else float(i - 200) * 0.9
+        store.ingest("bad_total", None, bad, t + i, "counter")
+    rule = {"name": "b", "kind": "burn_rate", "bad": "bad_total",
+            "total": "all_total", "objective": 0.99,
+            "fast_window_s": 20.0, "slow_window_s": 2000.0,
+            "burn_factor": 14.4, "for_s": 0.0, "severity": "page"}
+    now = t + 218
+    fast = alertplane.burn_rate(store, rule, 20.0, now)
+    slow = alertplane.burn_rate(store, rule, 2000.0, now)
+    assert fast > 14.4          # the burst alone looks like a cliff
+    assert slow < 14.4          # ...but the hour says budget is fine
+    eng = alertplane.AlertEngine(_cfg(), rules=[rule])
+    assert eng.evaluate(store, now=now, force=True) == []
+
+
+def test_burn_rate_gauge_form():
+    """Latency-gauge SLO: fraction of observed time above ``over``."""
+    store = tsdb.SeriesStore(_cfg(tsdb_raw_resolution_s=1.0))
+    t = 7000.0
+    # 40 buckets, half above the 2.0s bound.
+    for i in range(40):
+        store.ingest("p99", {"phase": "exec"},
+                     5.0 if i % 2 else 0.5, t + i)
+    rule = {"name": "g", "kind": "burn_rate", "series": "p99",
+            "labels": {"phase": "exec"}, "over": 2.0,
+            "objective": 0.99, "fast_window_s": 60.0,
+            "slow_window_s": 600.0, "burn_factor": 14.4,
+            "for_s": 0.0, "severity": "page"}
+    burn = alertplane.burn_rate(store, rule, 60.0, t + 39)
+    assert burn == pytest.approx(0.5 / 0.01, rel=0.01)  # 50x budget
+    # No data in window -> None -> never fires.
+    assert alertplane.burn_rate(store, rule, 60.0, t + 5000) is None
+
+
+def test_default_rules_reference_config_thresholds():
+    cfg = _cfg(alert_serve_p99_slo_s=1.25, alert_kv_pages_min=7.0)
+    rules = alertplane.default_rules(cfg)
+    by_name = {r["name"]: r for r in rules}
+    assert by_name["serve-p99-slo-burn"]["over"] == 1.25
+    assert by_name["kv-page-exhaustion"]["threshold"] == 7.0
+    assert all(r["severity"] in alertplane.SEVERITIES for r in rules)
+    # The engine caps the registry at alerts_max_rules.
+    eng = alertplane.AlertEngine(
+        _cfg(alerts_max_rules=2), rules=rules)
+    assert len(eng.rules) == 2
+
+
+# ---------------------------------------------------------------------------
+# webhook sink against a real local HTTP server
+
+
+def test_webhook_sink_posts_transitions(monkeypatch):
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+
+    got: "list[dict]" = []
+    done = threading.Event()
+
+    class Hook(BaseHTTPRequestHandler):
+        def do_POST(self):
+            body = self.rfile.read(int(self.headers["Content-Length"]))
+            got.append(json.loads(body))
+            if len(got) >= 2:
+                done.set()
+            self.send_response(204)
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    srv = HTTPServer(("127.0.0.1", 0), Hook)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        monkeypatch.setenv(
+            "RAY_TPU_ALERT_WEBHOOK",
+            f"http://127.0.0.1:{srv.server_port}/alert")
+        store = tsdb.SeriesStore(_cfg(tsdb_raw_resolution_s=1.0))
+        eng = alertplane.AlertEngine(
+            _cfg(), rules=[_threshold_rule(window_s=5.0)])
+        t = 9000.0
+        store.ingest("g", None, 9.0, t)
+        assert len(eng.evaluate(store, now=t, force=True)) == 1
+        store.ingest("g", None, 1.0, t + 10)
+        eng.evaluate(store, now=t + 10, force=True)
+        eng.note_resolved()
+        assert done.wait(10), f"webhook saw {len(got)} posts"
+        transitions = {p["transition"] for p in got}
+        assert transitions == {"FIRING", "RESOLVED"}
+        assert all(p["name"] == "unit-threshold" for p in got)
+        assert got[0]["severity"] == "warn"
+    finally:
+        srv.shutdown()
+
+
+def test_webhook_failure_is_swallowed(monkeypatch):
+    """A dead receiver must cost nothing: firing still works."""
+    monkeypatch.setenv("RAY_TPU_ALERT_WEBHOOK",
+                       "http://127.0.0.1:1/nothing-listens-here")
+    store = tsdb.SeriesStore(_cfg(tsdb_raw_resolution_s=1.0))
+    eng = alertplane.AlertEngine(_cfg(), rules=[_threshold_rule()])
+    store.ingest("g", None, 9.0, 100.0)
+    assert len(eng.evaluate(store, now=100.0, force=True)) == 1
+
+
+# ---------------------------------------------------------------------------
+# kill switches
+
+
+def test_kill_switch_env_parsing(monkeypatch):
+    for off in ("0", "false", "no", "off", "FALSE"):
+        monkeypatch.setenv("RAY_TPU_TSDB_ENABLED", off)
+        monkeypatch.setenv("RAY_TPU_ALERTS_ENABLED", off)
+        assert not tsdb.enabled() and not alertplane.enabled()
+    for on in ("1", "true", "yes"):
+        monkeypatch.setenv("RAY_TPU_TSDB_ENABLED", on)
+        monkeypatch.setenv("RAY_TPU_ALERTS_ENABLED", on)
+        assert tsdb.enabled() and alertplane.enabled()
+    monkeypatch.delenv("RAY_TPU_TSDB_ENABLED")
+    monkeypatch.delenv("RAY_TPU_ALERTS_ENABLED")
+    assert tsdb.enabled() and alertplane.enabled()  # defaults ship ON
+
+
+def test_disabled_surfaces_answer_empty(cluster, monkeypatch):
+    """With the stores gone (what the kill switches produce at boot),
+    every query surface answers empty-but-well-formed instead of
+    erroring."""
+    head = get_head()
+    monkeypatch.setattr(head, "tsdb", None)
+    monkeypatch.setattr(head, "alerts", None)
+    r = us.query_metrics("ray_tpu_tasks_finished_total")
+    assert r == {"series": [], "enabled": False}
+    a = us.list_alerts()
+    assert a["alerts"] == [] and a["stats"] == {}
+    assert a["enabled"] is False
+    # The sweep is a no-op, not a crash.
+    head._telemetry_sweep(time.time())
+
+
+# ---------------------------------------------------------------------------
+# e2e: sampling, query surface, seeded SLO breach with cross-plane joins
+
+
+def test_e2e_head_samples_history(cluster):
+    @ray_tpu.remote
+    def f(x):
+        return x + 1
+
+    assert ray_tpu.get([f.remote(i) for i in range(20)]) == \
+        list(range(1, 21))
+    def _sampled():
+        r = us.query_metrics("ray_tpu_tasks_finished_total")["series"]
+        # The series may exist from a pre-task sweep at value 0: wait
+        # for a sweep that has SEEN the completions, not mere existence.
+        if r and r[0]["points"][-1][tsdb.LAST] >= 20:
+            return r
+        return None
+
+    r = _wait(_sampled, msg="tasks_finished never reached the tsdb")
+    pts = r[0]["points"]
+    assert pts and all(len(b) == 6 for b in pts)
+    assert [b[tsdb.TS] for b in pts] == sorted(b[tsdb.TS] for b in pts)
+    assert pts[-1][tsdb.LAST] >= 20
+    # Derived phase quantile gauges carry the phase label.
+    r = _wait(lambda: (us.query_metrics("ray_tpu_phase_p95_seconds")
+                       ["series"] or None),
+              msg="phase p95 gauges never sampled")
+    assert any(s["labels"].get("phase") == "exec" for s in r)
+    # Gauges sampled from the head's own tables.
+    g = us.query_metrics("ray_tpu_workers_alive")["series"]
+    assert g and g[0]["points"][-1][tsdb.LAST] >= 1
+    # Self-metrics ride the exposition.
+    from ray_tpu.util import metrics as um
+
+    text = um.prometheus_text()
+    assert "ray_tpu_tsdb_series " in text
+    assert 'ray_tpu_alerts_firing{severity="page"} 0' in text
+
+
+def test_e2e_node_system_sample(cluster):
+    """Per-node load1/meminfo gauge series exist with the node_id
+    label — agent heartbeats piggyback them on multi-node clusters;
+    in-process the head self-samples its own host."""
+    r = _wait(lambda: (us.query_metrics("ray_tpu_node_load1")["series"]
+                       or None),
+              msg="node load1 gauge never sampled")
+    assert r[0]["labels"].get("node_id")
+    mem = us.query_metrics("ray_tpu_node_mem_total_bytes")["series"]
+    assert mem and mem[0]["points"][-1][tsdb.LAST] > 0
+
+
+def test_e2e_seeded_slo_breach_fires_with_evidence_then_resolves(cluster):
+    """The acceptance scenario: a seeded burn-rate breach fires on the
+    head's own health loop (not a forced evaluate), the record pins a
+    REAL retained trace exemplar id and an overlapping profiling
+    window, and withdrawing the breach resolves it into history."""
+    from ray_tpu._private import traceplane, worker_context
+
+    head = get_head()
+
+    # Ground truth for the joins: a slow traced call becomes a retained
+    # exemplar, and the always-on profiler ships windows.
+    @ray_tpu.remote
+    def slow(x):
+        time.sleep(0.05)
+        return x
+
+    ctx = traceplane.mint_trace("slo-breach-evidence")
+    assert ctx and ctx[2] == 1
+    t0 = time.time()
+    tok = worker_context.push_trace_context(ctx)
+    try:
+        assert ray_tpu.get(slow.remote(1)) == 1
+    finally:
+        worker_context.pop_trace_context(tok)
+    # The root span is the entry surface's job (the serve proxy emits
+    # it around the request); mimic that here so the >threshold
+    # duration marks the trace slow -> retained as an exemplar.
+    import os as _os
+
+    traceplane.buffer_span({
+        "event": "span", "name": "http.request", "kind": "proxy",
+        "trace_id": ctx[0], "span_id": ctx[1], "parent_span_id": "",
+        "pid": _os.getpid(), "start": t0, "end": time.time(),
+        "failed": False, "status": 200, "attributes": {},
+    })
+    _wait(lambda: (head.traces.stats().get("exemplar_ids") or None),
+          msg="trace exemplar never retained")
+    _wait(lambda: len(head.cluster_profile) > 0,
+          msg="no profiling windows")
+
+    @ray_tpu.remote
+    def burn(x):
+        return x
+
+    assert ray_tpu.get([burn.remote(i) for i in range(30)]) == \
+        list(range(30))
+
+    # Seed: the stock serve-p99 rule shape with an impossible SLO —
+    # "exec p99 must be 0s" — so 100% of observed buckets violate a
+    # 99% objective => burn 100x on every window, deterministically
+    # (a counter-pair seed would stop firing once the counter
+    # plateaus and its windowed rate decays to 0).
+    seeded = {
+        "name": "seeded-slo-breach", "kind": "burn_rate",
+        "series": "ray_tpu_phase_p99_seconds",
+        "labels": {"phase": "exec"}, "over": 0.0,
+        "objective": 0.99, "fast_window_s": 120.0,
+        "slow_window_s": 600.0, "burn_factor": 14.4, "for_s": 0.0,
+        "severity": "page", "summary": "seeded breach (test)",
+    }
+    with head.alerts._lock:
+        head.alerts.rules.append(seeded)
+    try:
+        rec = _wait(
+            lambda: next((a for a in us.list_alerts()["alerts"]
+                          if a["name"] == "seeded-slo-breach"
+                          and a["state"] == "firing"), None),
+            msg="seeded rule never fired on the health loop")
+        assert rec["severity"] == "page"
+        assert rec["burn_fast"] > 14.4 and rec["burn_slow"] > 14.4
+        ctx_ev = rec.get("context") or {}
+        # >=1 real trace exemplar id, resolvable through the trace API.
+        assert ctx_ev.get("trace_exemplars")
+        tid = ctx_ev["trace_exemplars"][0]
+        assert us.get_trace(tid) is not None
+        # >=1 profiling window overlapping the alert window.
+        wins = ctx_ev.get("profile_windows")
+        assert wins and wins[-1]["end"] >= rec["fired_at"] - 120.0
+        # Exposition reflects the firing severity while it burns.
+        from ray_tpu.util import metrics as um
+
+        assert 'ray_tpu_alerts_firing{severity="page"} 1' \
+            in um.prometheus_text()
+        # Withdraw the breach: point the rule at a silent series -> no
+        # data -> condition clears -> firing -> resolved into history.
+        with head.alerts._lock:
+            seeded["series"] = "ray_tpu_series_nobody_emits"
+        hist = _wait(
+            lambda: next((a for a in us.list_alerts(history=True)
+                          ["alerts"]
+                          if a["name"] == "seeded-slo-breach"
+                          and a["state"] == "resolved"), None),
+            msg="seeded rule never resolved")
+        assert hist["resolved_at"] >= hist["fired_at"]
+        assert us.list_alerts(history=True)["stats"]["resolved_total"] >= 1
+    finally:
+        with head.alerts._lock:
+            head.alerts.rules.remove(seeded)
+            head.alerts.active.pop("seeded-slo-breach", None)
+
+
+# ---------------------------------------------------------------------------
+# exposition timestamps (RAY_TPU_METRICS_TIMESTAMPS)
+
+
+def test_prometheus_timestamps_and_escaping(cluster, monkeypatch):
+    import re
+
+    from ray_tpu.util import metrics as um
+
+    gauge = um.Gauge("alertplane_test_gauge", tag_keys=("deployment",))
+    gauge.set(1.5, {"deployment": 'a"b\\c\nd'})
+    gauge._flush(force=True)
+    _wait(lambda: "alertplane_test_gauge" in um.prometheus_text(),
+          msg="user gauge never reached the head")
+
+    # Default: NO timestamps anywhere (bit-compatible with pre-PR).
+    text = um.prometheus_text()
+    for line in text.splitlines():
+        if line.startswith("ray_tpu_workers_alive"):
+            assert re.fullmatch(r"ray_tpu_workers_alive \S+", line)
+    # Label escaping: backslash, quote, newline all survive.
+    assert 'deployment="a\\"b\\\\c\\nd"' in text
+
+    monkeypatch.setenv("RAY_TPU_METRICS_TIMESTAMPS", "1")
+    text = um.prometheus_text()
+    stamped = [ln for ln in text.splitlines()
+               if ln.startswith("ray_tpu_workers_alive")]
+    assert stamped and all(
+        re.fullmatch(r"ray_tpu_workers_alive \S+ \d{13}", ln)
+        for ln in stamped)
+    # User gauge samples are stamped too...
+    user = [ln for ln in text.splitlines()
+            if ln.startswith("alertplane_test_gauge")]
+    assert user and all(re.search(r" \d{13}$", ln) for ln in user)
+    # ...counters stay bare (cumulative value, scrape-time semantics).
+    counters = [ln for ln in text.splitlines()
+                if ln.startswith("ray_tpu_tasks_finished_total")]
+    assert counters and all(
+        not re.search(r" \d{13}$", ln) for ln in counters)
+
+
+# ---------------------------------------------------------------------------
+# Grafana alert-rule export rides the same registry
+
+
+def test_grafana_alert_rules_render_from_registry():
+    from ray_tpu.util import metrics_export
+
+    bundle = metrics_export.grafana_alert_rules()
+    rules = bundle["groups"][0]["rules"]
+    names = {r["title"] for r in rules}
+    assert names == {r["name"]
+                     for r in alertplane.default_rules(Config())}
+    by_name = {r["title"]: r for r in rules}
+    burn = by_name["shed-ratio-slo-burn"]["data"][0]["model"]["expr"]
+    # Multi-window AND, both sides against the burn factor.
+    assert " and " in burn and burn.count("> 14.4") == 2
+    assert "ray_tpu_tasks_shed_total" in burn
+    thr = by_name["kv-page-exhaustion"]["data"][0]["model"]["expr"]
+    assert thr.startswith("min(min_over_time(")
+    assert by_name["kv-page-exhaustion"]["labels"]["severity"] == "page"
+    assert by_name["phase-p95-queue-wait"]["for"] == "30s"
+    json.loads(metrics_export.grafana_alert_rules_json())  # valid JSON
+
+
+# ---------------------------------------------------------------------------
+# operator CLI: ray-tpu top / alerts / metrics query
+
+
+def test_cli_surfaces(cluster, capsys, monkeypatch):
+    from ray_tpu import scripts
+
+    monkeypatch.setattr(scripts, "_connect", lambda addr: None)
+
+    @ray_tpu.remote
+    def f(x):
+        return x
+
+    assert ray_tpu.get([f.remote(i) for i in range(10)]) == \
+        list(range(10))
+    _wait(lambda: us.query_metrics("ray_tpu_tasks_finished_total")
+          ["series"] or None, msg="history for CLI")
+
+    def _args(**kw):
+        return type("Args", (), kw)()
+
+    # top: one frame, human-readable.
+    assert scripts.cmd_top(_args(address="local", interval=0.1,
+                                 once=True, iterations=0,
+                                 json=False)) == 0
+    out = capsys.readouterr().out
+    assert "ray-tpu top" in out and "tasks:" in out
+    assert "tsdb:" in out and "alert" in out.lower()
+
+    # top --json: machine-readable snapshot.
+    assert scripts.cmd_top(_args(address="local", interval=0.1,
+                                 once=True, iterations=0,
+                                 json=True)) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert "gauges" in doc and "alerts" in doc
+
+    # alerts table + JSON.
+    assert scripts.cmd_alerts(_args(address="local", history=True,
+                                    format="table")) == 0
+    out = capsys.readouterr().out
+    assert "rule(s):" in out
+    assert scripts.cmd_alerts(_args(address="local", history=False,
+                                    format="json")) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["enabled"] is True and "stats" in doc
+
+    # metrics query via the full argparse path (table, then JSON).
+    assert scripts.main([
+        "metrics", "query", "ray_tpu_tasks_finished_total",
+        "--address", "ignored", "--window", "600"]) == 0
+    out = capsys.readouterr().out
+    assert "ray_tpu_tasks_finished_total" in out and "last=" in out
+    assert scripts.main([
+        "metrics", "query", "ray_tpu_tasks_finished_total",
+        "--address", "ignored", "--format", "json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["series"] and doc["series"][0]["points"]
+    # Unknown series: empty table, exit 1.
+    assert scripts.main([
+        "metrics", "query", "ray_tpu_series_nobody_emits_total",
+        "--address", "ignored"]) == 1
+    capsys.readouterr()
+
+
+# ---------------------------------------------------------------------------
+# dashboard: /api/metrics/query, /api/alerts, /api/grafana_alerts, Charts SPA
+
+
+def test_e2e_dashboard_metrics_endpoints(cluster):
+    import urllib.request
+
+    from ray_tpu.dashboard import start_dashboard, stop_dashboard
+
+    def _get(port, path):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+            return r.read().decode()
+
+    _wait(lambda: us.query_metrics("ray_tpu_tasks_finished_total")
+          ["series"] or None, msg="history for dashboard")
+    port = start_dashboard()
+    try:
+        doc = json.loads(_get(
+            port, "/api/metrics/query?name=ray_tpu_tasks_finished_total"))
+        assert doc["enabled"] is True and doc["series"]
+        assert doc["series"][0]["points"]
+        # Label filtering via label.-prefixed query params.
+        doc = json.loads(_get(
+            port, "/api/metrics/query?name=ray_tpu_phase_p95_seconds"
+                  "&label.phase=exec"))
+        assert all(s["labels"].get("phase") == "exec"
+                   for s in doc["series"])
+        a = json.loads(_get(port, "/api/alerts?history=1"))
+        assert a["enabled"] is True and a["stats"]["rules"] >= 5
+        g = json.loads(_get(port, "/api/grafana_alerts"))
+        assert g["groups"][0]["rules"]
+        # The SPA drives these APIs: Charts view + alert badge.
+        html = _get(port, "/")
+        assert "/api/metrics/query" in html and "Charts" in html
+        assert "alertbadge" in html
+    finally:
+        stop_dashboard()

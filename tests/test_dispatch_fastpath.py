@@ -857,3 +857,58 @@ def test_llm_handoff_zero_payload_bytes_on_head_conn(cluster):
             f"{sent} head-connection bytes for {moved} bytes of KV handoff"
     finally:
         serve.shutdown()
+
+
+# ------------------------------------ telemetry-plane frame guard
+
+
+def test_telemetry_plane_zero_per_call_head_frames(cluster):
+    """The metric-history store + alert engine (enabled by DEFAULT) are
+    head-LOCAL consumers of telemetry that already flows: the tsdb
+    ingests from the amortized rpc_report/heartbeat/report_metrics
+    casts and the head's own health-tick self-sample. A steady-state
+    direct-call burst therefore makes ZERO per-call synchronous head
+    RPCs, ZERO head submissions, and grows NO frame kind on the head
+    conn proportionally to call count — while the store and engine are
+    demonstrably armed (query surfaces live, rules loaded)."""
+    from ray_tpu._private import alertplane, tsdb
+    from ray_tpu._private.worker_context import get_head
+
+    assert tsdb.enabled() and alertplane.enabled()  # defaults ship ON
+    head = get_head()
+    assert head.tsdb is not None and head.alerts is not None
+    assert len(head.alerts.rules) >= 5  # stock SLO registry loaded
+
+    @ray_tpu.remote
+    class Tele:
+        def ping(self, x=None):
+            return x
+
+    a = Tele.remote()
+    rt = global_runtime()
+    assert ray_tpu.get(a.ping.remote(1)) == 1
+    _wait(lambda: rt._direct.routes[a._actor_id].mode == "direct",
+          msg="actor route never entered direct mode")
+
+    N = 30
+    before_submit = rt.conn.sent_kinds.get("submit_actor_task", 0)
+    before_calls = rt.conn.calls_sent
+    before_push = _direct_push_count(rt)
+    before_kinds = dict(rt.conn.sent_kinds)
+    for i in range(N):
+        assert ray_tpu.get(a.ping.remote(i)) == i
+    assert rt.conn.sent_kinds.get("submit_actor_task", 0) == before_submit
+    assert rt.conn.calls_sent == before_calls
+    assert _direct_push_count(rt) - before_push == N
+    # No dedicated telemetry frame kind ever appears on the head conn:
+    # ingestion rides EXISTING casts, evaluation is a head-local sweep.
+    for kind in ("tsdb_ingest", "alert_eval", "telemetry_report"):
+        assert kind not in rt.conn.sent_kinds
+    # The existing feeder casts stayed amortized (interval-driven, not
+    # per-call): the telemetry plane added no traffic of its own.
+    for kind in ("rpc_report", "report_metrics"):
+        delta = rt.conn.sent_kinds.get(kind, 0) \
+            - before_kinds.get(kind, 0)
+        assert delta <= 4, \
+            f"feeder cast {kind!r} grew by {delta} over {N} calls"
+    ray_tpu.kill(a)

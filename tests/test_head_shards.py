@@ -329,3 +329,111 @@ def test_shutdown_reaps_all_shard_processes():
         return True
 
     assert _wait_for(all_dead, 20, "shard processes reaped")
+
+
+# ---------------------------------------------------------------------------
+# telemetry plane: shard-local stores + fanout merge (PR 19)
+
+_CHILD_TELEMETRY = """
+import sys
+import ray_tpu
+from ray_tpu._private.worker_context import global_runtime
+
+ray_tpu.init(address=sys.argv[1], log_to_driver=False)
+rt = global_runtime()
+print("CHILD_SHARD", rt.head_shard)
+
+@ray_tpu.remote
+def child_task(i):
+    return i
+
+assert ray_tpu.get([child_task.remote(i) for i in range(10)],
+                   timeout=60) == list(range(10))
+rt.report_rpc_now()  # flush this driver's rpc_report to its shard
+print("CHILD_DONE")
+ray_tpu.shutdown()
+"""
+
+
+def test_sharded_telemetry_fanout_merges_stores(tmp_path):
+    """Each shard keeps its OWN tsdb + alert engine; a driver attached
+    to the router must see the MERGED view: history points sampled on
+    shard B are visible through shard A's reply, and list_alerts sums
+    both engines' rule registries (5 stock rules x 2 shards = 10 is
+    the deterministic fanout proof)."""
+    ray_tpu.init(num_cpus=4, object_store_memory=64 * 1024 * 1024,
+                 log_to_driver=False,
+                 _system_config={"head_shards": 2,
+                                 "health_check_period_s": 0.2,
+                                 "tsdb_sample_interval_s": 0.25,
+                                 "alerts_eval_interval_s": 0.25})
+    try:
+        from ray_tpu.util import state as us
+
+        rt = global_runtime()
+        assert rt.head_shards == 2
+
+        @ray_tpu.remote
+        def f(x):
+            return x + 1
+
+        assert ray_tpu.get([f.remote(i) for i in range(40)],
+                           timeout=60) == list(range(1, 41))
+
+        # Workers hash across shards, so each shard's sweep only sees
+        # its own completions; each shard's series stays distinct
+        # (shard label), and summing them must total every completion.
+        def merged_total():
+            r = us.query_metrics("ray_tpu_tasks_finished_total")
+            total = sum(s["points"][-1][5]
+                        for s in r["series"] if s["points"])
+            return total >= 40
+
+        assert _wait_for(merged_total, 30, "merged finished-count")
+        r = us.query_metrics("ray_tpu_tasks_finished_total")
+        assert r["enabled"] is True
+        shards_seen = {s["labels"].get("shard") for s in r["series"]}
+        assert shards_seen <= {"0", "1"} and shards_seen
+        for s in r["series"]:
+            ts = [b[0] for b in s["points"]]
+            assert ts == sorted(ts)  # merge keeps per-series order
+
+        # Alert fanout: 5 stock rules per shard-local engine.
+        a = us.list_alerts()
+        assert a["enabled"] is True
+        assert a["stats"]["rules"] == 10
+
+        # runtime_stats decorates the merged telemetry block too.
+        snap = rt.conn.call("runtime_stats", {}, timeout=10)
+        assert snap["head_shards"] == 2
+        assert snap["telemetry"]["series"] >= 2
+        assert snap["alerts"]["rules"] == 10
+
+        # Satellite regression: an rpc_report landing on the OTHER
+        # shard is visible from this router-attached driver. A second
+        # driver round-robins to the other shard and runs tasks there;
+        # its workers' reports must show up in the merged rpc census
+        # with worker ids hashing to both shards.
+        script = tmp_path / "child_telemetry.py"
+        script.write_text(_CHILD_TELEMETRY, encoding="utf-8")
+        host, port = get_head().address
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        out = subprocess.run(
+            [sys.executable, str(script), f"{host}:{port}"],
+            env=env, capture_output=True, text=True, timeout=120)
+        assert "CHILD_DONE" in out.stdout, (out.stdout, out.stderr)
+        child_shard = int(out.stdout.split("CHILD_SHARD")[1].split()[0])
+        assert child_shard != rt.head_shard  # round-robin: other shard
+
+        from ray_tpu.util.metrics import cluster_rpc_counters
+
+        def both_shards_report():
+            clients = cluster_rpc_counters()["clients"]
+            return {shard_for(cid, 2) for cid in clients
+                    if cid.startswith("worker-")} == {0, 1}
+
+        assert _wait_for(both_shards_report, 30,
+                         "worker rpc_reports from both shards")
+    finally:
+        ray_tpu.shutdown()

@@ -45,3 +45,18 @@ def test_scale_envelope_quick():
     assert llm["handoff"]["count"] >= llm["requests"]
     assert llm["handoff"]["bytes"] > 0
     assert llm["disagg"]["prefix_hit_rate"] > 0
+
+    # Telemetry-history + SLO alerting plane (PR 19 acceptance): the
+    # envelope's own flood is retained as history, a seeded burn-rate
+    # breach fires on the head's health loop carrying >=1 real trace
+    # exemplar and an overlapping profiling window, then resolves.
+    th = results["telemetry_history"]
+    assert th["enabled"]
+    assert th["store"]["series"] > 0 and th["store"]["points"] > 0
+    assert th["query_series"] >= 1
+    assert th["seeded_alert_fired"]
+    assert th["fired_burn_fast"] > 14.4
+    assert th["trace_exemplars"]
+    assert th["profile_windows_overlapping"] >= 1
+    assert th["evidence_complete"]
+    assert th["seeded_alert_resolved"]

@@ -414,6 +414,62 @@ def test_metrics_prose_mentions_are_not_emissions(tmp_path):
     assert lint(root, MetricsPass) == []
 
 
+def test_metrics_alert_rule_consumer_uncatalogued(tmp_path):
+    """RT-M003: an alert rule watching a series the catalog doesn't
+    document — classic 'rule over a series nothing emits'."""
+    root = seed(tmp_path, {
+        "ray_tpu/_private/alertplane.py": '''
+            def default_rules(config):
+                return [{
+                    "name": "ghost", "kind": "threshold",
+                    "series": "ray_tpu_series_nobody_emits",
+                    "agg": "last", "op": ">", "threshold": 1.0,
+                }]
+            ''',
+        "docs/OBSERVABILITY.md": "`ray_tpu_known_total` only\n",
+    })
+    found = lint(root, MetricsPass)
+    assert [f.id for f in found] == ["RT-M003"]
+    assert "ray_tpu_series_nobody_emits" in found[0].message
+    assert "alert rule" in found[0].message
+
+
+def test_metrics_query_consumer_uncatalogued(tmp_path):
+    """RT-M003 fires on operator-surface range queries too (the CLI /
+    dashboard side), in any module."""
+    root = seed(tmp_path, {"ray_tpu/scripts.py": '''
+        def top(us):
+            return us.query_metrics("ray_tpu_phantom_gauge",
+                                    start=0.0)
+        '''})
+    found = lint(root, MetricsPass)
+    assert [f.id for f in found] == ["RT-M003"]
+    assert "query_metrics() consumer" in found[0].message
+
+
+def test_metrics_catalogued_consumers_are_clean(tmp_path):
+    """Rules and queries over documented series produce nothing; a
+    dynamic first argument is never harvested."""
+    root = seed(tmp_path, {
+        "ray_tpu/_private/alertplane.py": '''
+            def default_rules(config):
+                return [{
+                    "name": "ok", "kind": "burn_rate",
+                    "bad": "ray_tpu_bad_total",
+                    "total": "ray_tpu_all_total",
+                }]
+            ''',
+        "ray_tpu/scripts.py": '''
+            def top(us, name):
+                us.query_metrics("ray_tpu_bad_total")
+                us.query_metrics(name)  # dynamic: not harvested
+            ''',
+        "docs/OBSERVABILITY.md":
+            "`ray_tpu_bad_total` bad\n`ray_tpu_all_total` all\n",
+    })
+    assert lint(root, MetricsPass) == []
+
+
 # ---------------------------------------------------------------------------
 # RT-F: head-frame budget
 

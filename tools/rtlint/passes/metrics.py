@@ -15,6 +15,14 @@ Checks:
            registry below — either add it here with a written
            cardinality argument (as a pass change, reviewed), or drop
            the label
+  RT-M003  series CONSUMED by the alerting/operator plane — referenced
+           in an alert-rule dict (``alertplane.py`` ``series``/``bad``/
+           ``total`` values) or range-queried by an operator surface
+           (a ``query_metrics("...")`` call, e.g. ``ray-tpu top``) —
+           that the OBSERVABILITY.md catalog doesn't document. An
+           alert over an uncatalogued series is one an operator cannot
+           look up at 3am; usually it means the rule watches a series
+           nothing emits.
 
 Series are harvested from EMISSION contexts only, because plenty of
 non-metric strings start with ``ray_tpu_`` (thread names, contextvar
@@ -52,6 +60,11 @@ DOCS = "docs/OBSERVABILITY.md"
 # Every ray_tpu_* token in this module is a PromQL/dashboard mention.
 DASHBOARD_MODULE = "ray_tpu/util/metrics_export.py"
 
+# Alert-rule registry module: dict values under these keys name the
+# series the in-cluster SLO engine evaluates (RT-M003 consumers).
+ALERT_MODULE = "ray_tpu/_private/alertplane.py"
+_RULE_SERIES_KEYS = {"series", "bad", "total"}
+
 # Label keys with a bounded value set, and why they are bounded:
 #   node_id/node/peer/target — cluster nodes / connections, lease-
 #                bounded (hundreds at most)
@@ -70,11 +83,16 @@ DASHBOARD_MODULE = "ray_tpu/util/metrics_export.py"
 #   frame      — ray_tpu_profile_self_hits only: the head folds
 #                self-time to a fixed top-N per role before exposition,
 #                so cardinality is N*roles regardless of code shape
+#   severity   — alert-plane severity: fixed enum (page/warn/info,
+#                alertplane.SEVERITIES), every value pre-registered in
+#                the exposition so cardinality is exactly 3
+#   shard      — head shard index on a sharded head's tsdb self-
+#                samples: bounded by head_shards (single digits)
 ALLOWED_LABELS = {
     "node_id", "node", "reason", "phase", "where", "le", "deployment",
     "model", "pool", "callsite", "peer", "job", "kind", "quantile",
     "trace_id", "name", "direction", "path", "target", "state",
-    "role", "frame",
+    "role", "frame", "severity", "shard",
 }
 
 _METRIC_CTORS = {"Gauge", "Counter", "Histogram", "Summary"}
@@ -124,9 +142,51 @@ class MetricsPass:
                 f"metric series {series!r} is emitted here but not "
                 f"documented in {DOCS}", sym))
 
+        def flag_consumer(series, mod, lineno, sym, what):
+            series = _HIST_SUFFIX.sub("", series)
+            if series in documented or (series, "m3") in seen_series:
+                return
+            seen_series.add((series, "m3"))
+            out.append(Finding(
+                "RT-M003", mod.relpath, lineno,
+                f"{what} reads series {series!r} but {DOCS} does not "
+                f"catalog it — either it is emitted-but-undocumented "
+                f"or the consumer watches a series nothing emits", sym))
+
         for mod in tree.modules:
             harvest_all = mod.relpath == DASHBOARD_MODULE
             syms = None
+            # RT-M003 consumer side (a): alert-rule dict values.
+            if mod.relpath == ALERT_MODULE:
+                syms = enclosing_symbols(mod.tree)
+                for node in ast.walk(mod.tree):
+                    if not isinstance(node, ast.Dict):
+                        continue
+                    for k, v in zip(node.keys, node.values):
+                        key = const_str(k) if k is not None else None
+                        val = const_str(v)
+                        if key in _RULE_SERIES_KEYS and val \
+                                and _SERIES_RE.fullmatch(val):
+                            flag_consumer(val, mod, v.lineno,
+                                          syms.get(v.lineno, ""),
+                                          "alert rule")
+            # RT-M003 consumer side (b): operator-surface range queries
+            # (ray-tpu top / metrics CLI, dashboard endpoints).
+            for node in ast.walk(mod.tree):
+                if not (isinstance(node, ast.Call) and node.args):
+                    continue
+                fn = node.func
+                fname = fn.attr if isinstance(fn, ast.Attribute) \
+                    else fn.id if isinstance(fn, ast.Name) else ""
+                if fname != "query_metrics":
+                    continue
+                s = const_str(node.args[0])
+                if s and _SERIES_RE.fullmatch(s):
+                    if syms is None:
+                        syms = enclosing_symbols(mod.tree)
+                    flag_consumer(s, mod, node.lineno,
+                                  syms.get(node.lineno, ""),
+                                  "query_metrics() consumer")
             # f-string constant parts are re-examined as a whole
             # below (split exposition strings like
             # f'ray_tpu_x' f'{{node="{n}"}}'); skip them standalone.
